@@ -1,0 +1,45 @@
+"""dcos_commons_tpu — a TPU-native service-scheduler SDK.
+
+A ground-up re-design of the capabilities of the DC/OS SDK
+(reference: ``r2dedios/dcos-commons``) for TPU clusters:
+
+* Declarative YAML ``ServiceSpec`` (pods -> tasks -> resources) where **TPU
+  chips and ICI topology are first-class scheduled resources** alongside
+  cpus/mem/disk/ports (the reference gates plain ``gpus`` at
+  ``sdk/scheduler/.../framework/FrameworkRunner.java:191-194``).
+* A plan engine (plan -> phase -> step) with serial/parallel/canary/dependency
+  strategies, launch backoff, and interrupt/proceed/force-complete controls
+  (reference ``scheduler/plan/``).
+* An agent-inventory resource matcher replacing the Mesos offer market
+  (reference ``offer/evaluate/OfferEvaluator.java``): we own both sides of the
+  protocol, so no decline/revive/suppress mechanics — but placement rules,
+  reservation bookkeeping, launch WAL, and orphaned-resource GC all carry over.
+* Durable state in a pluggable KV-tree persister (reference ``storage/Persister``
+  + ``curator/CuratorPersister``), here: in-memory + fsync'd file store.
+* Recovery manager with TRANSIENT (restart in place) vs PERMANENT (replace)
+  classification, plus TPU **gang semantics** the reference never needed:
+  one worker death => whole-job barrier re-form.
+* Task-side bootstrap exporting the JAX distributed-init contract
+  (``JAX_COORDINATOR_ADDRESS`` / ``JAX_PROCESS_ID`` / ``JAX_NUM_PROCESSES``)
+  into each sandbox (reference ``sdk/bootstrap/main.go``).
+* ``frameworks/jax`` workloads: the compute path is pure JAX/XLA — pjit +
+  NamedSharding over a ``jax.sharding.Mesh``, ring attention over an ICI ring,
+  Ulysses all-to-all sequence parallelism, MoE expert parallelism.
+
+Layer map (outer -> inner), mirroring SURVEY.md section 1:
+
+    specification/   typed spec + YAML front-end        (ref L5)
+    config/          versioned config rollout + validators
+    plan/            plan engine + strategies + backoff (ref L3)
+    matching/        resource matcher + placement DSL   (ref L4)
+    agent/           per-host agent model + fake agent  (ref L0/L8 agent side)
+    scheduler/       service lifecycle, recovery, GC    (ref L1/L2)
+    state/           StateStore/ConfigStore/Persister   (ref L6)
+    http/            REST control surface               (ref L7)
+    cli/             tpuctl                             (ref L9)
+    bootstrap/       in-sandbox task init               (ref L8)
+    testing/         Send/Expect simulation harness     (ref L10)
+    parallel/ ops/ models/   the TPU compute layer (no reference analogue)
+"""
+
+__version__ = "0.1.0"

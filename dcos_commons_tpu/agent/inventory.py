@@ -1,0 +1,74 @@
+"""Agent inventory model — what a per-host agent advertises to the scheduler.
+
+Reference analogue: a Mesos *offer* (``offer/MesosResourcePool.java:24``
+pools an offer's reserved/unreserved/atomic resources). We collapse the offer
+market into an **inventory** model: agents continuously advertise their total
+resources plus current reservations; the matcher computes availability
+directly (SURVEY.md section 7 design stance — no decline/revive/suppress).
+
+TPU fields: each agent reports its local chip count and, when part of a pod
+slice, the slice id and its ICI coordinates — this is what the reference's
+``bootstrap`` (``sdk/bootstrap/main.go``) never had and our matcher's gang
+placement consumes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class TpuInventory:
+    """Local TPU chips as inventoried by the agent (``/dev/accel*`` probe in
+    the C++ agent; synthetic in the fake agent)."""
+
+    chips: int = 0
+    slice_id: Optional[str] = None       # e.g. "slice-0" — one ICI domain
+    topology: Optional[str] = None       # e.g. "v4-32", "4x4x4"
+    coords: Optional[Tuple[int, ...]] = None  # this host's coords in the slice
+    worker_index: Optional[int] = None   # stable host index within the slice
+
+
+@dataclass(frozen=True)
+class PortRange:
+    begin: int
+    end: int  # inclusive
+
+    def __contains__(self, port: int) -> bool:
+        return self.begin <= port <= self.end
+
+
+@dataclass(frozen=True)
+class AgentInfo:
+    """One host's advertised inventory + identity."""
+
+    agent_id: str
+    hostname: str
+    cpus: float
+    memory_mb: int
+    disk_mb: int = 0
+    ports: Tuple[PortRange, ...] = (PortRange(10000, 20000),)
+    tpu: TpuInventory = field(default_factory=TpuInventory)
+    attributes: Mapping[str, str] = field(default_factory=dict)
+    zone: Optional[str] = None
+    region: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class TaskRecord:
+    """Where a launched task lives — the matcher's view of cluster state used
+    by placement rules (reference rules read ``Collection<TaskInfo>`` +
+    stored offer attributes via ``offer/taskdata/TaskLabelReader``)."""
+
+    task_name: str          # "<pod>-<idx>-<task>"
+    pod_type: str
+    pod_index: int
+    agent_id: str
+    hostname: str
+    zone: Optional[str] = None
+    region: Optional[str] = None
+
+    @property
+    def pod_instance_name(self) -> str:
+        return f"{self.pod_type}-{self.pod_index}"

@@ -1,0 +1,6 @@
+from .placement import (AgentRule, AndRule, AttributeRule, HostnameRule,
+                        MaxPerHostnameRule, MaxPerRegionRule, MaxPerZoneRule,
+                        NotRule, OrRule, Outcome, PlacementRule, RegionRule,
+                        RoundRobinByHostnameRule, RoundRobinByZoneRule,
+                        StringMatcher, TaskTypeRule, TpuSliceRule, ZoneRule,
+                        parse_marathon_constraints, rule_from_json, rule_to_json)

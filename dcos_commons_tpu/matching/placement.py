@@ -1,0 +1,570 @@
+"""Declarative placement-rule DSL.
+
+Reference: ``offer/evaluate/placement/`` (38 files) — JSON-serializable rule
+objects combined with And/Or/Not, matched against offers + running tasks.
+We keep the same shape: each rule is a small frozen dataclass with
+``filter(agent, pod_instance, tasks) -> Outcome``, serialized as
+``{"type": ..., ...}`` JSON so rules survive the ConfigStore round-trip
+(the reference registers subtypes with Jackson in ``DefaultServiceSpec``).
+
+Rules implemented (reference file in parens):
+
+* and / or / not                  (``AndRule/OrRule/NotRule``)
+* hostname / agent / attribute / zone / region
+  (``HostnameRule/AgentRule/AttributeRule/ZoneRule/RegionRule``)
+* max-per-hostname / -zone / -region / -attribute   (``MaxPer*Rule``)
+* round-robin-by-hostname / -zone    (``RoundRobinBy*Rule``)
+* task-type colocate / avoid         (``TaskTypeRule``)
+* marathon constraint strings        (``MarathonConstraintParser.java:26``)
+* tpu-slice  — TPU-native: restrict to agents of a single named slice /
+  topology; gang consistency is enforced by the evaluator, this rule handles
+  the per-agent admissibility part.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Optional, Sequence, Tuple
+
+from ..agent.inventory import AgentInfo, TaskRecord
+
+
+@dataclass(frozen=True)
+class Outcome:
+    """Reference ``offer/evaluate/EvaluationOutcome.java`` — pass/fail plus a
+    human-readable reason tree surfaced by the debug endpoint."""
+
+    passes: bool
+    reason: str
+
+    @staticmethod
+    def ok(reason: str) -> "Outcome":
+        return Outcome(True, reason)
+
+    @staticmethod
+    def fail(reason: str) -> "Outcome":
+        return Outcome(False, reason)
+
+
+class PlacementRule:
+    """Base: ``filter`` decides whether ``agent`` may host ``pod_instance``.
+
+    ``tasks`` excludes tasks of the pod instance being (re)placed — the
+    reference pre-filters with ``PlacementUtils.filterMatchingTasks`` so a pod
+    being replaced doesn't veto its own new home.
+    """
+
+    type: str = "abstract"
+
+    def filter(self, agent: AgentInfo, pod_instance_name: str,
+               tasks: Sequence[TaskRecord]) -> Outcome:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict[str, Any]:
+        raise NotImplementedError
+
+
+_REGISTRY: dict[str, Callable[[Mapping[str, Any]], PlacementRule]] = {}
+
+
+def _register(type_name: str):
+    def deco(cls):
+        cls.type = type_name
+        _REGISTRY[type_name] = cls._from_dict
+        return cls
+    return deco
+
+
+def rule_to_json(rule: PlacementRule) -> dict[str, Any]:
+    return rule.to_dict()
+
+
+def rule_from_json(data: Mapping[str, Any] | str) -> PlacementRule:
+    if isinstance(data, str):
+        data = json.loads(data)
+    factory = _REGISTRY.get(data["type"])
+    if factory is None:
+        raise ValueError(f"unknown placement rule type: {data['type']}")
+    return factory(data)
+
+
+def _other_pod_tasks(pod_instance_name: str, tasks: Sequence[TaskRecord]):
+    return [t for t in tasks if t.pod_instance_name != pod_instance_name]
+
+
+# --------------------------------------------------------------------------
+# matchers (reference ExactMatcher / AnyMatcher / RegexMatcher)
+
+@dataclass(frozen=True)
+class StringMatcher:
+    """``exact:x`` | ``regex:p`` | ``glob:g`` | ``any``."""
+
+    kind: str
+    value: str = ""
+
+    def matches(self, s: Optional[str]) -> bool:
+        if s is None:
+            return False
+        if self.kind == "any":
+            return True
+        if self.kind == "exact":
+            return s == self.value
+        if self.kind == "regex":
+            return re.fullmatch(self.value, s) is not None
+        if self.kind == "glob":
+            return fnmatch.fnmatch(s, self.value)
+        raise ValueError(self.kind)
+
+    def to_dict(self):
+        return {"kind": self.kind, "value": self.value}
+
+    @staticmethod
+    def exact(value: str) -> "StringMatcher":
+        return StringMatcher("exact", value)
+
+    @staticmethod
+    def regex(value: str) -> "StringMatcher":
+        return StringMatcher("regex", value)
+
+    @staticmethod
+    def glob(value: str) -> "StringMatcher":
+        return StringMatcher("glob", value)
+
+    @staticmethod
+    def any() -> "StringMatcher":
+        return StringMatcher("any")
+
+
+# --------------------------------------------------------------------------
+# combinators
+
+@_register("and")
+@dataclass(frozen=True)
+class AndRule(PlacementRule):
+    rules: Tuple[PlacementRule, ...]
+
+    def filter(self, agent, pod_instance_name, tasks) -> Outcome:
+        for r in self.rules:
+            o = r.filter(agent, pod_instance_name, tasks)
+            if not o.passes:
+                return Outcome.fail(f"and: {o.reason}")
+        return Outcome.ok("and: all passed")
+
+    def to_dict(self):
+        return {"type": self.type, "rules": [r.to_dict() for r in self.rules]}
+
+    @staticmethod
+    def _from_dict(d):
+        return AndRule(tuple(rule_from_json(r) for r in d["rules"]))
+
+
+@_register("or")
+@dataclass(frozen=True)
+class OrRule(PlacementRule):
+    rules: Tuple[PlacementRule, ...]
+
+    def filter(self, agent, pod_instance_name, tasks) -> Outcome:
+        reasons = []
+        for r in self.rules:
+            o = r.filter(agent, pod_instance_name, tasks)
+            if o.passes:
+                return o
+            reasons.append(o.reason)
+        return Outcome.fail("or: none passed: " + "; ".join(reasons))
+
+    def to_dict(self):
+        return {"type": self.type, "rules": [r.to_dict() for r in self.rules]}
+
+    @staticmethod
+    def _from_dict(d):
+        return OrRule(tuple(rule_from_json(r) for r in d["rules"]))
+
+
+@_register("not")
+@dataclass(frozen=True)
+class NotRule(PlacementRule):
+    rule: PlacementRule
+
+    def filter(self, agent, pod_instance_name, tasks) -> Outcome:
+        o = self.rule.filter(agent, pod_instance_name, tasks)
+        return Outcome(not o.passes, f"not({o.reason})")
+
+    def to_dict(self):
+        return {"type": self.type, "rule": self.rule.to_dict()}
+
+    @staticmethod
+    def _from_dict(d):
+        return NotRule(rule_from_json(d["rule"]))
+
+
+# --------------------------------------------------------------------------
+# identity rules
+
+@dataclass(frozen=True)
+class _FieldMatchRule(PlacementRule):
+    matcher: StringMatcher
+
+    def _value(self, agent: AgentInfo) -> Optional[str]:
+        raise NotImplementedError
+
+    def filter(self, agent, pod_instance_name, tasks) -> Outcome:
+        v = self._value(agent)
+        if self.matcher.matches(v):
+            return Outcome.ok(f"{self.type} {v!r} matches")
+        return Outcome.fail(f"{self.type} {v!r} does not match {self.matcher.to_dict()}")
+
+    def to_dict(self):
+        return {"type": self.type, "matcher": self.matcher.to_dict()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(StringMatcher(**d["matcher"]))
+
+
+@_register("hostname")
+@dataclass(frozen=True)
+class HostnameRule(_FieldMatchRule):
+    def _value(self, agent):
+        return agent.hostname
+
+
+@_register("agent")
+@dataclass(frozen=True)
+class AgentRule(_FieldMatchRule):
+    def _value(self, agent):
+        return agent.agent_id
+
+
+@_register("zone")
+@dataclass(frozen=True)
+class ZoneRule(_FieldMatchRule):
+    def _value(self, agent):
+        return agent.zone
+
+
+@_register("region")
+@dataclass(frozen=True)
+class RegionRule(_FieldMatchRule):
+    def _value(self, agent):
+        return agent.region
+
+
+@_register("attribute")
+@dataclass(frozen=True)
+class AttributeRule(PlacementRule):
+    """Matches ``key:value`` attribute strings (reference
+    ``AttributeRule`` + ``AttributeStringUtils``)."""
+
+    matcher: StringMatcher
+
+    def filter(self, agent, pod_instance_name, tasks) -> Outcome:
+        for k, v in agent.attributes.items():
+            if self.matcher.matches(f"{k}:{v}"):
+                return Outcome.ok(f"attribute {k}:{v} matches")
+        return Outcome.fail(f"no attribute matches {self.matcher.to_dict()}")
+
+    def to_dict(self):
+        return {"type": self.type, "matcher": self.matcher.to_dict()}
+
+    @staticmethod
+    def _from_dict(d):
+        return AttributeRule(StringMatcher(**d["matcher"]))
+
+
+@_register("tpu-slice")
+@dataclass(frozen=True)
+class TpuSliceRule(PlacementRule):
+    """Admit only agents that belong to a TPU slice (optionally a specific
+    slice id / topology). Cross-agent gang *consistency* — all pods of a job
+    on ONE slice — is enforced by the evaluator's gang pass; see
+    ``matching/evaluator.py``."""
+
+    slice_id: Optional[str] = None
+    topology: Optional[str] = None
+
+    def filter(self, agent, pod_instance_name, tasks) -> Outcome:
+        t = agent.tpu
+        if t.chips <= 0 or t.slice_id is None:
+            return Outcome.fail(f"agent {agent.agent_id} has no TPU slice membership")
+        if self.slice_id is not None and t.slice_id != self.slice_id:
+            return Outcome.fail(f"agent in slice {t.slice_id}, want {self.slice_id}")
+        if self.topology is not None and t.topology != self.topology:
+            return Outcome.fail(f"agent topology {t.topology}, want {self.topology}")
+        return Outcome.ok(f"agent in slice {t.slice_id} ({t.topology})")
+
+    def to_dict(self):
+        return {"type": self.type, "slice_id": self.slice_id, "topology": self.topology}
+
+    @staticmethod
+    def _from_dict(d):
+        return TpuSliceRule(d.get("slice_id"), d.get("topology"))
+
+
+# --------------------------------------------------------------------------
+# counting rules
+
+def _group_key(task: TaskRecord, agents: Mapping[str, AgentInfo], by: str) -> Optional[str]:
+    if by == "hostname":
+        return task.hostname
+    if by == "zone":
+        return task.zone
+    if by == "region":
+        return task.region
+    raise ValueError(by)
+
+
+def _agent_key(agent: AgentInfo, by: str) -> Optional[str]:
+    if by == "hostname":
+        return agent.hostname
+    if by == "zone":
+        return agent.zone
+    if by == "region":
+        return agent.region
+    raise ValueError(by)
+
+
+@dataclass(frozen=True)
+class _MaxPerRule(PlacementRule):
+    """Reference ``MaxPerHostnameRule``/``MaxPerZoneRule``/... — at most
+    ``max_count`` instances of this pod type per hostname/zone/region."""
+
+    max_count: int
+    by: str = "hostname"
+    task_filter: Optional[StringMatcher] = None
+
+    def filter(self, agent, pod_instance_name, tasks) -> Outcome:
+        pod_type = pod_instance_name.rsplit("-", 1)[0]
+        key = _agent_key(agent, self.by)
+        count = 0
+        counted_pods = set()
+        for t in _other_pod_tasks(pod_instance_name, tasks):
+            if t.pod_type != pod_type:
+                continue
+            if self.task_filter and not self.task_filter.matches(t.task_name):
+                continue
+            tk = _group_key(t, {}, self.by)
+            if tk is not None and tk == key and t.pod_instance_name not in counted_pods:
+                counted_pods.add(t.pod_instance_name)
+                count += 1
+        if count < self.max_count:
+            return Outcome.ok(f"{count} < max {self.max_count} per {self.by} {key!r}")
+        return Outcome.fail(f"already {count} {pod_type} pods on {self.by} {key!r}")
+
+    def to_dict(self):
+        return {"type": self.type, "max_count": self.max_count, "by": self.by,
+                "task_filter": self.task_filter.to_dict() if self.task_filter else None}
+
+    @classmethod
+    def _from_dict(cls, d):
+        tf = d.get("task_filter")
+        return cls(d["max_count"], d.get("by", "hostname"),
+                   StringMatcher(**tf) if tf else None)
+
+
+@_register("max-per-hostname")
+@dataclass(frozen=True)
+class MaxPerHostnameRule(_MaxPerRule):
+    by: str = "hostname"
+
+
+@_register("max-per-zone")
+@dataclass(frozen=True)
+class MaxPerZoneRule(_MaxPerRule):
+    by: str = "zone"
+
+
+@_register("max-per-region")
+@dataclass(frozen=True)
+class MaxPerRegionRule(_MaxPerRule):
+    by: str = "region"
+
+
+@dataclass(frozen=True)
+class _RoundRobinRule(PlacementRule):
+    """Reference ``RoundRobinByHostnameRule`` etc.: admit the agent iff its
+    group's current count of this pod type is minimal among known groups —
+    producing an even spread as instances deploy serially. ``group_count``
+    (e.g. total hostnames) bounds the spread the way the reference's
+    ``agent-count`` parameter does."""
+
+    group_count: Optional[int] = None
+    by: str = "hostname"
+
+    def filter(self, agent, pod_instance_name, tasks) -> Outcome:
+        pod_type = pod_instance_name.rsplit("-", 1)[0]
+        key = _agent_key(agent, self.by)
+        if key is None:
+            return Outcome.fail(f"agent has no {self.by}")
+        counts: dict[str, int] = {}
+        seen_pods = set()
+        for t in _other_pod_tasks(pod_instance_name, tasks):
+            if t.pod_type != pod_type or t.pod_instance_name in seen_pods:
+                continue
+            seen_pods.add(t.pod_instance_name)
+            k = _group_key(t, {}, self.by)
+            if k is not None:
+                counts[k] = counts.get(k, 0) + 1
+        my = counts.get(key, 0)
+        known = len(counts) if key in counts else len(counts) + 1
+        if self.group_count is not None and known < self.group_count:
+            # unseen groups exist; only admit groups at the global minimum of 0
+            floor = 0
+        else:
+            floor = min(counts.values(), default=0)
+        if my <= floor:
+            return Outcome.ok(f"round-robin: {self.by} {key!r} at floor ({my})")
+        return Outcome.fail(f"round-robin: {self.by} {key!r} has {my} > floor {floor}")
+
+    def to_dict(self):
+        return {"type": self.type, "group_count": self.group_count, "by": self.by}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d.get("group_count"), d.get("by", "hostname"))
+
+
+@_register("round-robin-hostname")
+@dataclass(frozen=True)
+class RoundRobinByHostnameRule(_RoundRobinRule):
+    by: str = "hostname"
+
+
+@_register("round-robin-zone")
+@dataclass(frozen=True)
+class RoundRobinByZoneRule(_RoundRobinRule):
+    by: str = "zone"
+
+
+@_register("task-type")
+@dataclass(frozen=True)
+class TaskTypeRule(PlacementRule):
+    """Colocate with / avoid agents running tasks of pod type ``pod_type``
+    (reference ``TaskTypeRule.java`` COLOCATE/AVOID behaviors)."""
+
+    pod_type: str
+    behavior: str  # "colocate" | "avoid"
+
+    def filter(self, agent, pod_instance_name, tasks) -> Outcome:
+        present = any(
+            t.pod_type == self.pod_type and t.agent_id == agent.agent_id
+            for t in _other_pod_tasks(pod_instance_name, tasks))
+        if self.behavior == "colocate":
+            return (Outcome.ok(f"colocated with {self.pod_type}") if present
+                    else Outcome.fail(f"no {self.pod_type} task on agent"))
+        if self.behavior == "avoid":
+            return (Outcome.fail(f"{self.pod_type} task present on agent") if present
+                    else Outcome.ok(f"agent free of {self.pod_type}"))
+        raise ValueError(self.behavior)
+
+    def to_dict(self):
+        return {"type": self.type, "pod_type": self.pod_type, "behavior": self.behavior}
+
+    @staticmethod
+    def _from_dict(d):
+        return TaskTypeRule(d["pod_type"], d["behavior"])
+
+
+# --------------------------------------------------------------------------
+# marathon-style constraint strings
+
+def parse_marathon_constraints(text: str) -> PlacementRule:
+    """Parse ``[["hostname","UNIQUE"], ["zone","GROUP_BY","3"], ...]`` or the
+    colon form ``hostname:UNIQUE`` (reference
+    ``MarathonConstraintParser.java:26``). Supported operators: UNIQUE,
+    CLUSTER, GROUP_BY, LIKE, UNLIKE, MAX_PER, IS.
+    """
+    text = text.strip()
+    if not text:
+        raise ValueError("empty constraint")
+    if text.startswith("["):
+        raw = json.loads(text)
+        if raw and isinstance(raw[0], str):  # single constraint ["hostname","UNIQUE"]
+            raw = [raw]
+    else:
+        raw = [text.split(":")]
+    rules = [_one_marathon_rule([str(p) for p in entry]) for entry in raw]
+    return rules[0] if len(rules) == 1 else AndRule(tuple(rules))
+
+
+def _one_marathon_rule(parts: Sequence[str]) -> PlacementRule:
+    if len(parts) < 2:
+        raise ValueError(f"constraint needs [field, operator(, value)]: {parts}")
+    fieldname, op = parts[0], parts[1].upper()
+    value = parts[2] if len(parts) > 2 else None
+    by = fieldname if fieldname in ("hostname", "zone", "region") else None
+
+    def field_rule(matcher: StringMatcher) -> PlacementRule:
+        if fieldname == "hostname":
+            return HostnameRule(matcher)
+        if fieldname == "zone":
+            return ZoneRule(matcher)
+        if fieldname == "region":
+            return RegionRule(matcher)
+        return AttributeRule(StringMatcher(matcher.kind, f"{fieldname}:{matcher.value}")
+                             if matcher.kind != "any" else matcher)
+
+    if op in ("MAX_PER", "CLUSTER", "IS", "LIKE", "UNLIKE") and value is None:
+        raise ValueError(f"constraint operator {op} requires a value: {parts}")
+    if op == "UNIQUE":
+        if by:
+            return _MAX_PER_TYPES[by](max_count=1)
+        return MaxPerAttributeRule(max_count=1, attribute=fieldname)
+    if op == "MAX_PER":
+        n = int(value)
+        if by:
+            return _MAX_PER_TYPES[by](max_count=n)
+        return MaxPerAttributeRule(max_count=n, attribute=fieldname)
+    if op in ("CLUSTER", "IS"):
+        return field_rule(StringMatcher.exact(value))
+    if op == "LIKE":
+        return field_rule(StringMatcher.regex(value))
+    if op == "UNLIKE":
+        return NotRule(field_rule(StringMatcher.regex(value)))
+    if op == "GROUP_BY":
+        n = int(value) if value else None
+        if by:
+            return _ROUND_ROBIN_TYPES[by](group_count=n)
+        raise ValueError(f"GROUP_BY unsupported for attribute {fieldname}")
+    raise ValueError(f"unsupported constraint operator: {op}")
+
+
+@_register("max-per-attribute")
+@dataclass(frozen=True)
+class MaxPerAttributeRule(PlacementRule):
+    """Reference ``MaxPerAttributeRule`` — at most N pod instances per
+    distinct value of attribute ``attribute``."""
+
+    max_count: int
+    attribute: str
+
+    def filter(self, agent, pod_instance_name, tasks) -> Outcome:
+        my_value = agent.attributes.get(self.attribute)
+        if my_value is None:
+            return Outcome.ok(f"agent lacks attribute {self.attribute}; unconstrained")
+        pod_type = pod_instance_name.rsplit("-", 1)[0]
+        # TaskRecord doesn't carry agent attributes; count pods of this type
+        # on this agent (exact per-attribute-value counting needs the agent
+        # registry, which the evaluator-level gang pass has — this per-agent
+        # approximation matches the reference's behavior for the common
+        # one-agent-per-attribute-value deployments).
+        count = len({
+            t.pod_instance_name for t in _other_pod_tasks(pod_instance_name, tasks)
+            if t.pod_type == pod_type and t.agent_id == agent.agent_id})
+        if count < self.max_count:
+            return Outcome.ok(f"{count} < {self.max_count} per {self.attribute}")
+        return Outcome.fail(f"{count} pods already on {self.attribute}={my_value}")
+
+    def to_dict(self):
+        return {"type": self.type, "max_count": self.max_count, "attribute": self.attribute}
+
+    @staticmethod
+    def _from_dict(d):
+        return MaxPerAttributeRule(d["max_count"], d["attribute"])
+
+
+_MAX_PER_TYPES = {"hostname": MaxPerHostnameRule, "zone": MaxPerZoneRule,
+                  "region": MaxPerRegionRule}
+_ROUND_ROBIN_TYPES = {"hostname": RoundRobinByHostnameRule, "zone": RoundRobinByZoneRule}

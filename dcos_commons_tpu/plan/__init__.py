@@ -1,0 +1,10 @@
+from .backoff import Backoff, DisabledBackoff, ExponentialBackoff
+from .elements import DeploymentStep, Element, ParentElement, Phase, Plan, Step
+from .manager import PlanCoordinator, PlanManager
+from .plan_factory import (DEPLOY_PLAN, RECOVERY_PLAN, UPDATE_PLAN,
+                           build_deploy_plan, build_plan_from_spec,
+                           has_reached_goal_state)
+from .requirement import PodInstanceRequirement, RecoveryType
+from .status import Status, aggregate
+from .strategy import (CanaryStrategy, DependencyStrategy, ParallelStrategy,
+                       RandomStrategy, SerialStrategy, Strategy, strategy_for)

@@ -1,0 +1,63 @@
+"""Launch backoff for crash-looping tasks.
+
+Reference: ``scheduler/plan/backoff/ExponentialBackoff.java:30`` — per-task
+delay that grows by ``factor`` on every launch attempt (``:105-123``) and is
+cleared when the task reaches RUNNING; ``DisabledBackoff.java`` no-ops.
+Env knobs in the reference: ``ENABLE_BACKOFF``, initial/max/factor
+(``scheduler/plan/backoff/Backoff.java``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+class Backoff:
+    def on_launch(self, task_name: str) -> None:
+        raise NotImplementedError
+
+    def on_running(self, task_name: str) -> None:
+        raise NotImplementedError
+
+    def delay_remaining(self, task_name: str) -> float:
+        """Seconds until the task may launch again; 0 = launch now."""
+        raise NotImplementedError
+
+
+class DisabledBackoff(Backoff):
+    def on_launch(self, task_name: str) -> None:
+        pass
+
+    def on_running(self, task_name: str) -> None:
+        pass
+
+    def delay_remaining(self, task_name: str) -> float:
+        return 0.0
+
+
+class ExponentialBackoff(Backoff):
+    def __init__(self, initial_s: float = 15.0, max_s: float = 300.0,
+                 factor: float = 1.15, clock=time.monotonic):
+        if initial_s <= 0 or max_s < initial_s or factor <= 1.0:
+            raise ValueError("invalid backoff parameters")
+        self._initial = initial_s
+        self._max = max_s
+        self._factor = factor
+        self._clock = clock
+        # task -> (current delay, not-before timestamp)
+        self._delays: Dict[str, tuple[float, float]] = {}
+
+    def on_launch(self, task_name: str) -> None:
+        prev = self._delays.get(task_name)
+        delay = self._initial if prev is None else min(prev[0] * self._factor, self._max)
+        self._delays[task_name] = (delay, self._clock() + delay)
+
+    def on_running(self, task_name: str) -> None:
+        self._delays.pop(task_name, None)
+
+    def delay_remaining(self, task_name: str) -> float:
+        entry = self._delays.get(task_name)
+        if entry is None:
+            return 0.0
+        return max(0.0, entry[1] - self._clock())

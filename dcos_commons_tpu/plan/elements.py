@@ -1,0 +1,302 @@
+"""Plan -> Phase -> Step element tree.
+
+Reference: ``scheduler/plan/`` — ``Element.java``, ``ParentElement.java``,
+``Step.java``, ``DeploymentStep.java`` (the TaskStatus -> step status state
+machine at ``:163-258``), ``Phase.java``, ``Plan.java``,
+``Interruptible.java``.
+
+Threading note: like the reference, all mutation happens on the scheduler's
+single evaluation thread (``framework/OfferProcessor.java:57``); elements are
+not internally locked.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from ..specification.spec import GoalState
+from ..state.tasks import TaskState, TaskStatus
+from .backoff import Backoff, DisabledBackoff
+from .requirement import PodInstanceRequirement, RecoveryType
+from .status import Status, aggregate
+from .strategy import SerialStrategy, Strategy
+
+
+class Element:
+    """Reference ``scheduler/plan/Element.java``."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.errors: List[str] = []
+
+    @property
+    def status(self) -> Status:
+        raise NotImplementedError
+
+    @property
+    def is_complete(self) -> bool:
+        return self.status is Status.COMPLETE
+
+    def restart(self) -> None:
+        raise NotImplementedError
+
+    def force_complete(self) -> None:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "status": self.status.value,
+                "errors": list(self.errors)}
+
+
+class Step(Element):
+    """Leaf element. Subclasses decide what work it represents."""
+
+    def start(self) -> Optional[PodInstanceRequirement]:
+        """Called when selected as a candidate; returns the work to match."""
+        raise NotImplementedError
+
+    def update_status(self, status: TaskStatus) -> None:
+        """TaskStatus feed (reference ``DeploymentStep.update``)."""
+
+    def on_launch(self, task_name_to_id: Dict[str, str]) -> None:
+        """The matcher launched tasks for this step."""
+
+    def on_no_match(self, reason: str) -> None:
+        """No agent satisfied the requirement this cycle."""
+
+    @property
+    def asset(self) -> Optional[str]:
+        return None
+
+    @property
+    def is_eligible(self) -> bool:
+        """May be offered work this cycle (reference ``PlanUtils.isEligible``:
+        pending/prepared/delayed steps, not interrupted)."""
+        return self.status in (Status.PENDING, Status.PREPARED, Status.DELAYED)
+
+
+class DeploymentStep(Step):
+    """Launch (or relaunch) a pod instance's tasks and drive them to goal.
+
+    Reference ``scheduler/plan/DeploymentStep.java``; initial-status logic
+    from ``DefaultStepFactory.java:56-199`` lives in
+    ``plan_factory.build_deploy_plan`` (COMPLETE iff the task already runs at
+    the target config and reached its goal).
+    """
+
+    def __init__(self, name: str, requirement: PodInstanceRequirement,
+                 backoff: Optional[Backoff] = None,
+                 initial_status: Status = Status.PENDING):
+        super().__init__(name)
+        self.requirement = requirement
+        self._backoff = backoff or DisabledBackoff()
+        self._status = initial_status
+        # task instance name -> launched task id (current attempt)
+        self._launched: Dict[str, str] = {}
+        # task instance name -> per-task Status
+        tasks = requirement.task_instance_names()
+        self._task_status: Dict[str, Status] = {
+            t: initial_status for t in tasks}
+        self._goals: Dict[str, GoalState] = {}
+        self._readiness_required: Dict[str, bool] = {}
+        pod = requirement.pod_instance.pod
+        for spec_name in requirement.task_names:
+            task_spec = pod.task(spec_name)
+            instance_name = requirement.pod_instance.task_instance_name(spec_name)
+            self._goals[instance_name] = task_spec.goal
+            self._readiness_required[instance_name] = task_spec.readiness_check is not None
+
+    # -- selection / launch -------------------------------------------------
+
+    @property
+    def asset(self) -> Optional[str]:
+        return self.requirement.asset
+
+    @property
+    def status(self) -> Status:
+        if self.errors:
+            return Status.ERROR
+        return self._status
+
+    def start(self) -> Optional[PodInstanceRequirement]:
+        delay = max((self._backoff.delay_remaining(t) for t in self._task_status),
+                    default=0.0)
+        if delay > 0:
+            self._status = Status.DELAYED
+            return None
+        if self._status is Status.DELAYED:
+            self._status = Status.PENDING
+        return self.requirement
+
+    def on_launch(self, task_name_to_id: Dict[str, str]) -> None:
+        for task_name, task_id in task_name_to_id.items():
+            if task_name in self._task_status:
+                self._launched[task_name] = task_id
+                self._task_status[task_name] = Status.STARTING
+                self._backoff.on_launch(task_name)
+        self._recompute()
+
+    def on_no_match(self, reason: str) -> None:
+        # stays PENDING; the outcome tracker records the reason
+        pass
+
+    # -- status feed --------------------------------------------------------
+
+    def update_status(self, status: TaskStatus) -> None:
+        task_name = self._task_for_id(status.task_id)
+        if task_name is None:
+            return
+        goal = self._goals[task_name]
+        state = status.state
+        if state in (TaskState.STAGING, TaskState.STARTING):
+            new = Status.STARTING
+        elif state is TaskState.RUNNING:
+            self._backoff.on_running(task_name)
+            if goal is GoalState.RUNNING and (
+                    not self._readiness_required[task_name] or status.readiness_passed):
+                new = Status.COMPLETE
+            else:
+                new = Status.STARTED
+        elif state is TaskState.FINISHED:
+            # FINISH/ONCE goals complete on exit 0; a RUNNING-goal task that
+            # exits must be relaunched (reference DeploymentStep.java:205-221)
+            new = Status.COMPLETE if goal.terminal else Status.PENDING
+        elif state.failed:
+            new = Status.PENDING
+        else:
+            return
+        if self._task_status.get(task_name) is Status.COMPLETE and new is not Status.COMPLETE:
+            # regressions of completed tasks are recovery's business, not the
+            # deploy step's (reference keeps completed steps complete)
+            return
+        self._task_status[task_name] = new
+        self._recompute()
+
+    def _task_for_id(self, task_id: str) -> Optional[str]:
+        for name, tid in self._launched.items():
+            if tid == task_id:
+                return name
+        return None
+
+    def _recompute(self) -> None:
+        statuses = list(self._task_status.values())
+        if all(s is Status.COMPLETE for s in statuses):
+            self._status = Status.COMPLETE
+        elif any(s is Status.PENDING for s in statuses):
+            # any task needing (re)launch pulls the whole step back — the pod
+            # relaunches as a unit (reference DeploymentStep essential-task
+            # failure semantics)
+            if self._status is not Status.DELAYED:
+                self._status = Status.PENDING
+        elif any(s is Status.STARTING for s in statuses):
+            self._status = Status.STARTING
+        elif any(s is Status.STARTED for s in statuses):
+            self._status = Status.STARTED
+
+    # -- operator controls ---------------------------------------------------
+
+    def restart(self) -> None:
+        self._status = Status.PENDING
+        for t in self._task_status:
+            self._task_status[t] = Status.PENDING
+        self._launched.clear()
+
+    def force_complete(self) -> None:
+        self._status = Status.COMPLETE
+        for t in self._task_status:
+            self._task_status[t] = Status.COMPLETE
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        d["tasks"] = {t: s.value for t, s in self._task_status.items()}
+        return d
+
+
+class ParentElement(Element):
+    """Reference ``scheduler/plan/ParentElement.java`` + ``Interruptible``."""
+
+    def __init__(self, name: str, children: Sequence[Element],
+                 strategy: Optional[Strategy] = None):
+        super().__init__(name)
+        self.children = list(children)
+        self.strategy = strategy or SerialStrategy()
+        self._interrupted = False
+
+    @property
+    def status(self) -> Status:
+        if self.errors:
+            return Status.ERROR
+        return aggregate((c.status for c in self.children),
+                         interrupted=self._interrupted)
+
+    def interrupt(self) -> None:
+        self._interrupted = True
+
+    def proceed(self) -> None:
+        self._interrupted = False
+        self.strategy.proceed()
+
+    @property
+    def interrupted(self) -> bool:
+        return self._interrupted
+
+    def restart(self) -> None:
+        for c in self.children:
+            c.restart()
+
+    def force_complete(self) -> None:
+        for c in self.children:
+            c.force_complete()
+
+    def candidates(self, dirty_assets: Iterable[str]) -> List[Step]:
+        if self._interrupted:
+            return []
+        dirty = set(dirty_assets)
+        out: List[Step] = []
+        for child in self.strategy.candidates(self.children):
+            if isinstance(child, ParentElement):
+                out.extend(child.candidates(dirty))
+            elif isinstance(child, Step):
+                if child.is_eligible and (child.asset is None or child.asset not in dirty):
+                    out.append(child)
+        return out
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        d["strategy"] = type(self.strategy).__name__
+        d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+class Phase(ParentElement):
+    """Reference ``scheduler/plan/Phase.java``."""
+
+    @property
+    def steps(self) -> List[Step]:
+        return [c for c in self.children if isinstance(c, Step)]
+
+
+class Plan(ParentElement):
+    """Reference ``scheduler/plan/Plan.java``."""
+
+    def __init__(self, name: str, phases: Sequence[Phase],
+                 strategy: Optional[Strategy] = None):
+        super().__init__(name, phases, strategy)
+
+    @property
+    def phases(self) -> List[Phase]:
+        return [c for c in self.children if isinstance(c, Phase)]
+
+    @property
+    def steps(self) -> List[Step]:
+        return [s for p in self.phases for s in p.steps]
+
+    def update_status(self, status: TaskStatus) -> None:
+        for step in self.steps:
+            step.update_status(status)
+
+    def dirty_assets(self) -> set[str]:
+        """Assets of steps currently doing work (reference
+        ``DefaultPlanCoordinator`` collects these across plans)."""
+        return {s.asset for s in self.steps
+                if s.asset is not None and s.status.running}

@@ -1,0 +1,73 @@
+"""Plan managers + the cross-plan coordinator.
+
+Reference: ``scheduler/plan/PlanManager.java:14`` /
+``DefaultPlanManager.java`` and ``DefaultPlanCoordinator.java:54-108``
+(dirty-asset conflict avoidance: two plans may never drive the same pod
+instance concurrently — deploy vs recovery vs decommission).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Set
+
+from ..state.tasks import TaskStatus
+from .elements import Plan, Step
+
+
+class PlanManager:
+    """Owns one plan; may regenerate it lazily (recovery overrides this)."""
+
+    def __init__(self, plan: Plan):
+        self._plan = plan
+
+    @property
+    def plan(self) -> Plan:
+        return self._plan
+
+    def get_candidates(self, dirty_assets: Iterable[str]) -> List[Step]:
+        return self._plan.candidates(dirty_assets)
+
+    def update(self, status: TaskStatus) -> None:
+        self._plan.update_status(status)
+
+    def dirty_assets(self) -> Set[str]:
+        return self._plan.dirty_assets()
+
+
+class PlanCoordinator:
+    """Reference ``DefaultPlanCoordinator.java:54-108``: managers in priority
+    order (deploy before recovery in the reference's list order; recovery
+    first here is equally valid as long as assets never overlap — we keep the
+    reference's order: earlier managers win contested assets)."""
+
+    def __init__(self, managers: Sequence[PlanManager]):
+        self._managers = list(managers)
+
+    @property
+    def managers(self) -> List[PlanManager]:
+        return self._managers
+
+    @property
+    def plans(self) -> List[Plan]:
+        return [m.plan for m in self._managers]
+
+    def get_candidates(self) -> List[Step]:
+        """All launchable steps this cycle, with dirty-asset exclusion across
+        plans: an asset claimed by any plan's in-progress step, or by an
+        earlier candidate, is off-limits."""
+        claimed: Set[str] = set()
+        for manager in self._managers:
+            claimed |= manager.dirty_assets()
+        out: List[Step] = []
+        for manager in self._managers:
+            for step in manager.get_candidates(claimed):
+                if step.asset is not None:
+                    if step.asset in claimed:
+                        continue
+                    claimed.add(step.asset)
+                out.append(step)
+        return out
+
+    def update(self, status: TaskStatus) -> None:
+        for manager in self._managers:
+            manager.update(status)

@@ -1,0 +1,124 @@
+"""Plan construction from a ServiceSpec.
+
+Reference: ``specification/PlanGenerator.java:39`` (YAML ``plans:`` ->
+Plan objects), ``scheduler/plan/DefaultStepFactory.java:56-199`` (initial
+COMPLETE vs PENDING via ``hasReachedGoalState``), and the default
+DeployPlanFactory behavior (one phase per pod, serial).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..specification.spec import (GoalState, PlanSpecModel, PodInstance,
+                                  ServiceSpec)
+from ..state.state_store import StateStore
+from ..state.tasks import TaskState
+from .backoff import Backoff
+from .elements import DeploymentStep, Phase, Plan
+from .requirement import PodInstanceRequirement
+from .status import Status
+from .strategy import strategy_for
+
+DEPLOY_PLAN = "deploy"
+UPDATE_PLAN = "update"
+RECOVERY_PLAN = "recovery"
+
+
+def has_reached_goal_state(state_store: StateStore, target_config_id: str,
+                           pod_instance: PodInstance, task_name: str) -> bool:
+    """Reference ``DefaultStepFactory.hasReachedGoalState:166-199``:
+
+    * RUNNING goal: stored task launched at the *target* config and currently
+      TASK_RUNNING (with readiness passed, if a readiness check is defined).
+    * ONCE goal: TASK_FINISHED at any config (once ever).
+    * FINISH goal: TASK_FINISHED at the target config (re-runs per config).
+    """
+    instance_name = pod_instance.task_instance_name(task_name)
+    task = state_store.fetch_task(instance_name)
+    if task is None:
+        return False
+    status = state_store.fetch_status(instance_name)
+    if status is None or status.task_id != task.task_id:
+        return False
+    task_spec = pod_instance.pod.task(task_name)
+    goal = task_spec.goal
+    if goal is GoalState.ONCE:
+        return status.state is TaskState.FINISHED
+    if goal is GoalState.FINISH:
+        return (status.state is TaskState.FINISHED
+                and task.target_config_id == target_config_id)
+    # RUNNING
+    if task.target_config_id != target_config_id:
+        return False
+    if status.state is not TaskState.RUNNING:
+        return False
+    if task_spec.readiness_check is not None and not status.readiness_passed:
+        return False
+    return True
+
+
+def _make_step(pod_instance: PodInstance, task_names: tuple[str, ...],
+               state_store: StateStore, target_config_id: str,
+               backoff: Optional[Backoff]) -> DeploymentStep:
+    complete = all(
+        has_reached_goal_state(state_store, target_config_id, pod_instance, t)
+        for t in task_names)
+    return DeploymentStep(
+        name=f"{pod_instance.name}:[{','.join(task_names)}]",
+        requirement=PodInstanceRequirement(pod_instance, task_names),
+        backoff=backoff,
+        initial_status=Status.COMPLETE if complete else Status.PENDING,
+    )
+
+
+def build_deploy_plan(spec: ServiceSpec, state_store: StateStore,
+                      target_config_id: str, backoff: Optional[Backoff] = None,
+                      plan_name: str = DEPLOY_PLAN) -> Plan:
+    """Default deploy plan: one serial phase per pod, one step per instance
+    covering all of the pod's tasks. If the spec's YAML defines a plan named
+    ``plan_name``, that definition wins (reference ``SchedulerBuilder.
+    getPlans:494-499`` prefers YAML plans)."""
+    custom = spec.plan(plan_name)
+    if custom is not None:
+        return build_plan_from_spec(spec, custom, state_store, target_config_id, backoff)
+    phases = []
+    for pod in spec.pods:
+        steps = []
+        for index in range(pod.count):
+            pod_instance = PodInstance(pod, index)
+            task_names = tuple(t.name for t in pod.tasks)
+            steps.append(_make_step(pod_instance, task_names, state_store,
+                                    target_config_id, backoff))
+        phases.append(Phase(pod.type, steps, strategy_for("serial")))
+    return Plan(plan_name, phases, strategy_for("serial"))
+
+
+def build_plan_from_spec(spec: ServiceSpec, plan_spec: PlanSpecModel,
+                         state_store: StateStore, target_config_id: str,
+                         backoff: Optional[Backoff] = None) -> Plan:
+    """YAML ``plans:`` DSL -> Plan (reference ``PlanGenerator.java:39``; the
+    per-step task-list form is the hdfs pattern, ``svc.yml:566-596``)."""
+    phases = []
+    for phase_spec in plan_spec.phases:
+        pod = spec.pod(phase_spec.pod_type)
+        steps = []
+        if phase_spec.steps:
+            default_tasks = tuple(t.name for t in pod.tasks)
+            explicit = {s.pod_instance: s for s in phase_spec.steps if s.pod_instance >= 0}
+            default_entry = next(
+                (s for s in phase_spec.steps if s.pod_instance < 0), None)
+            for index in range(pod.count):
+                entry = explicit.get(index, default_entry)
+                if entry is None:
+                    continue
+                task_names = entry.tasks or default_tasks
+                steps.append(_make_step(PodInstance(pod, index), tuple(task_names),
+                                        state_store, target_config_id, backoff))
+        else:
+            task_names = tuple(t.name for t in pod.tasks)
+            for index in range(pod.count):
+                steps.append(_make_step(PodInstance(pod, index), task_names,
+                                        state_store, target_config_id, backoff))
+        phases.append(Phase(phase_spec.name, steps, strategy_for(phase_spec.strategy)))
+    return Plan(plan_spec.name, phases, strategy_for(plan_spec.strategy))

@@ -1,0 +1,40 @@
+"""PodInstanceRequirement — the unit of work a Step hands to the matcher.
+
+Reference: ``scheduler/plan/PodInstanceRequirement.java:17`` + recovery type
+from ``scheduler/recovery/RecoveryType.java``.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Mapping, Tuple
+
+from ..specification.spec import PodInstance
+
+
+class RecoveryType(enum.Enum):
+    NONE = "NONE"            # normal deployment
+    TRANSIENT = "TRANSIENT"  # relaunch in place, reuse reservations
+    PERMANENT = "PERMANENT"  # replace: fresh placement, old resources GC'd
+
+
+@dataclass(frozen=True)
+class PodInstanceRequirement:
+    pod_instance: PodInstance
+    task_names: Tuple[str, ...]          # spec-level task names to launch
+    recovery_type: RecoveryType = RecoveryType.NONE
+    env_overrides: Mapping[str, str] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return f"{self.pod_instance.name}:[{','.join(self.task_names)}]"
+
+    @property
+    def asset(self) -> str:
+        """Dirty-asset key for plan coordination (reference
+        ``DefaultPlanCoordinator.java:54-108``)."""
+        return self.pod_instance.name
+
+    def task_instance_names(self) -> list[str]:
+        return [self.pod_instance.task_instance_name(t) for t in self.task_names]

@@ -1,0 +1,53 @@
+"""Plan element status model.
+
+Reference: ``scheduler/plan/Status.java:22-93`` — the per-element state
+machine PENDING -> PREPARED -> STARTING -> STARTED -> COMPLETE with the side
+states ERROR / WAITING (interrupted) / DELAYED (launch backoff) and the
+derived parent state IN_PROGRESS.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Iterable
+
+
+class Status(enum.Enum):
+    ERROR = "ERROR"
+    WAITING = "WAITING"        # interrupted by operator (or canary gate)
+    PENDING = "PENDING"
+    PREPARED = "PREPARED"      # matched/dirty: work identified, not yet launched
+    STARTING = "STARTING"      # launch sent, no TASK_RUNNING yet
+    STARTED = "STARTED"        # running, awaiting readiness/goal
+    COMPLETE = "COMPLETE"
+    IN_PROGRESS = "IN_PROGRESS"  # parent-only aggregate
+    DELAYED = "DELAYED"        # launch backoff active
+
+    @property
+    def running(self) -> bool:
+        """Occupies its asset: a concurrent plan must not touch the same pod
+        (reference ``Status.isRunning`` used by dirty-asset avoidance)."""
+        return self in (Status.PREPARED, Status.STARTING, Status.STARTED,
+                        Status.IN_PROGRESS)
+
+
+def aggregate(statuses: Iterable[Status], interrupted: bool = False) -> Status:
+    """Parent status from child statuses (reference
+    ``ParentElement.getStatus`` / ``PlanUtils.getAggregateStatus``)."""
+    statuses = list(statuses)
+    if not statuses:
+        return Status.COMPLETE
+    if any(s is Status.ERROR for s in statuses):
+        return Status.ERROR
+    if all(s is Status.COMPLETE for s in statuses):
+        return Status.COMPLETE
+    if interrupted:
+        return Status.WAITING
+    if any(s is Status.WAITING for s in statuses):
+        return Status.WAITING
+    if all(s is Status.PENDING for s in statuses):
+        return Status.PENDING
+    if any(s is Status.DELAYED for s in statuses) and not any(
+            s.running for s in statuses):
+        return Status.DELAYED
+    return Status.IN_PROGRESS

@@ -1,0 +1,114 @@
+"""Deployment strategies — the ordering/gating of elements within a parent.
+
+Reference: ``scheduler/plan/strategy/`` — ``SerialStrategy``,
+``ParallelStrategy``, ``CanaryStrategy.java:30`` (manual ``proceed()``
+gates), ``DependencyStrategy`` + ``DependencyStrategyHelper`` (arbitrary
+DAG), ``RandomStrategy``.
+
+A strategy never looks at eligibility (PENDING vs STARTING etc.) — it only
+decides which children are *reachable* now; the parent filters reachable
+steps by eligibility and dirty assets.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import TYPE_CHECKING, Dict, List, Sequence
+
+from .status import Status
+
+if TYPE_CHECKING:
+    from .elements import Element
+
+
+class Strategy:
+    def candidates(self, elements: Sequence["Element"]) -> List["Element"]:
+        raise NotImplementedError
+
+    def proceed(self) -> None:
+        """Canary gate advance; no-op for most strategies."""
+
+
+class SerialStrategy(Strategy):
+    """Children proceed strictly in order; a child is reachable only when all
+    earlier children are COMPLETE."""
+
+    def candidates(self, elements):
+        for el in elements:
+            if el.status is not Status.COMPLETE:
+                return [el]
+        return []
+
+
+class ParallelStrategy(Strategy):
+    def candidates(self, elements):
+        return [el for el in elements if el.status is not Status.COMPLETE]
+
+
+class RandomStrategy(Strategy):
+    """Parallel reachability, randomized order (reference RandomStrategy)."""
+
+    def __init__(self, rng: random.Random | None = None):
+        self._rng = rng or random.Random()
+
+    def candidates(self, elements):
+        out = [el for el in elements if el.status is not Status.COMPLETE]
+        self._rng.shuffle(out)
+        return out
+
+
+class CanaryStrategy(Strategy):
+    """Reference ``CanaryStrategy.java:30``: block until ``proceed()``; the
+    first proceed releases only the first child (the canary); the second
+    proceed releases the rest via the wrapped strategy."""
+
+    def __init__(self, wrapped: Strategy | None = None):
+        self._wrapped = wrapped or SerialStrategy()
+        self._proceeds = 0
+
+    def proceed(self) -> None:
+        self._proceeds += 1
+
+    def candidates(self, elements):
+        if self._proceeds == 0 or not elements:
+            return []
+        if self._proceeds == 1:
+            first = elements[0]
+            return [first] if first.status is not Status.COMPLETE else []
+        return self._wrapped.candidates(elements)
+
+
+class DependencyStrategy(Strategy):
+    """Arbitrary DAG: ``deps[name]`` lists names that must be COMPLETE first
+    (reference ``DependencyStrategyHelper``)."""
+
+    def __init__(self, deps: Dict[str, Sequence[str]]):
+        self._deps = {k: tuple(v) for k, v in deps.items()}
+
+    def candidates(self, elements):
+        by_name = {el.name: el for el in elements}
+        out = []
+        for el in elements:
+            if el.status is Status.COMPLETE:
+                continue
+            blockers = self._deps.get(el.name, ())
+            if all(by_name[b].status is Status.COMPLETE
+                   for b in blockers if b in by_name):
+                out.append(el)
+        return out
+
+
+def strategy_for(name: str) -> Strategy:
+    """YAML strategy name -> instance (reference ``StrategyGenerator``)."""
+    name = (name or "serial").lower()
+    if name == "serial":
+        return SerialStrategy()
+    if name == "parallel":
+        return ParallelStrategy()
+    if name == "random":
+        return RandomStrategy()
+    if name in ("canary", "serial-canary"):
+        return CanaryStrategy(SerialStrategy())
+    if name == "parallel-canary":
+        return CanaryStrategy(ParallelStrategy())
+    raise ValueError(f"unknown strategy: {name}")

@@ -1,0 +1,472 @@
+"""Typed service specification model.
+
+Reference: ``sdk/scheduler/.../specification/`` — the
+``ServiceSpec/PodSpec/TaskSpec/ResourceSet/ResourceSpec`` interface family
+(``ServiceSpec.java:13``, ``PodSpec.java:19``, ``TaskSpec.java:15``,
+``ResourceSet.java:12``, ``GoalState.java:6-28``).
+
+Design departures from the reference (TPU-first, not a port):
+
+* Resources are plain quantities on a :class:`ResourceSet` — no Mesos
+  role/principal/reservation-label plumbing, because we own both sides of the
+  scheduler<->agent protocol.
+* ``tpus`` is a first-class scalar next to ``cpus``/``memory``, and a pod may
+  declare a :class:`TpuSpec` asking for gang placement over a named slice
+  topology — the capability the reference only sketches for ``gpus``
+  (``FrameworkRunner.java:191-194``).
+* Everything is a frozen dataclass: specs are values, compared structurally.
+  Config-change detection (reference ``DefaultConfigurationUpdater``) is a
+  ``!=`` on the dataclass tree / its canonical JSON.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import asdict, dataclass, field, replace
+from typing import Any, Mapping, Optional, Sequence
+
+from ..matching.placement import PlacementRule, rule_from_json, rule_to_json
+
+
+class GoalState(enum.Enum):
+    """Reference ``specification/GoalState.java:6-28``.
+
+    RUNNING: long-lived; relaunched on exit.
+    FINISH:  run to completion once per target config; re-run on config change.
+    ONCE:    run to completion once ever.
+    """
+
+    RUNNING = "RUNNING"
+    FINISH = "FINISH"
+    ONCE = "ONCE"
+
+    @property
+    def terminal(self) -> bool:
+        return self is not GoalState.RUNNING
+
+
+class VolumeType(enum.Enum):
+    ROOT = "ROOT"    # carved out of the agent's root disk
+    MOUNT = "MOUNT"  # a dedicated mount volume, exclusively consumed
+
+
+@dataclass(frozen=True)
+class VolumeSpec:
+    """Reference ``specification/VolumeSpec.java`` / ``DefaultVolumeSpec``."""
+
+    container_path: str
+    size_mb: int
+    type: VolumeType = VolumeType.ROOT
+
+    def validate(self) -> list[str]:
+        errs = []
+        if self.size_mb <= 0:
+            errs.append(f"volume {self.container_path}: size must be > 0")
+        if not self.container_path or self.container_path.startswith("/"):
+            errs.append(
+                f"volume path must be relative to the sandbox: {self.container_path!r}")
+        return errs
+
+
+@dataclass(frozen=True)
+class PortSpec:
+    """Reference ``specification/PortSpec.java`` + ``NamedVIPSpec``.
+
+    ``port == 0`` requests a dynamic port chosen by the matcher from the
+    agent's port ranges (reference ``PortEvaluationStage``). ``env_key`` is
+    exported into the task env; ``vip`` optionally exposes
+    ``<name>.<service>.l4lb``-style stable addressing.
+    """
+
+    name: str
+    port: int = 0
+    env_key: Optional[str] = None
+    vip: Optional[str] = None
+    vip_port: Optional[int] = None
+
+    @property
+    def env_name(self) -> str:
+        return self.env_key or f"PORT_{self.name.upper().replace('-', '_')}"
+
+
+@dataclass(frozen=True)
+class TpuSpec:
+    """TPU resource request — the reason this SDK exists.
+
+    ``chips``: chips reserved for each task instance (agents inventory their
+    local chips the way the reference's agents advertise ``gpus``).
+
+    ``topology``: optional slice topology the whole *pod group* must land on
+    (e.g. ``"v4-32"`` or ``"4x4x4"``); combined with ``gang=True`` the matcher
+    enforces all-or-nothing placement of every pod instance onto agents of a
+    single slice with mutually consistent ICI coordinates — a constraint Mesos
+    never had (SURVEY.md section 7 "hard parts" (3)).
+    """
+
+    chips: int = 0
+    topology: Optional[str] = None
+    gang: bool = True
+
+
+@dataclass(frozen=True)
+class ResourceSet:
+    """Reference ``specification/ResourceSet.java:12`` / ``DefaultResourceSet``.
+
+    A named bundle of resources consumed by exactly one task at a time.
+    Multiple tasks may *share* a resource set (reference cassandra sidecars:
+    backup/restore tasks reuse the node's resources) — the matcher reuses the
+    existing reservation instead of reserving twice.
+    """
+
+    id: str
+    cpus: float = 0.0
+    memory_mb: int = 0
+    disk_mb: int = 0
+    tpus: int = 0
+    ports: tuple[PortSpec, ...] = ()
+    volumes: tuple[VolumeSpec, ...] = ()
+
+    def validate(self) -> list[str]:
+        errs = []
+        if self.cpus < 0 or self.memory_mb < 0 or self.disk_mb < 0 or self.tpus < 0:
+            errs.append(f"resource set {self.id}: negative resource")
+        if self.cpus == 0 and self.memory_mb == 0 and self.tpus == 0:
+            errs.append(f"resource set {self.id}: must request cpus, memory, or tpus")
+        seen = set()
+        for p in self.ports:
+            if p.name in seen:
+                errs.append(f"resource set {self.id}: duplicate port name {p.name}")
+            seen.add(p.name)
+        for v in self.volumes:
+            errs.extend(v.validate())
+        return errs
+
+
+@dataclass(frozen=True)
+class HealthCheckSpec:
+    """Reference ``specification/HealthCheckSpec.java`` — liveness probe; a
+    failing health check makes the agent kill the task (then recovery applies)."""
+
+    cmd: str
+    interval_s: float = 30.0
+    grace_period_s: float = 60.0
+    max_consecutive_failures: int = 3
+    timeout_s: float = 20.0
+    delay_s: float = 0.0
+
+
+@dataclass(frozen=True)
+class ReadinessCheckSpec:
+    """Reference ``specification/ReadinessCheckSpec.java`` — a deploy step only
+    reaches COMPLETE once the readiness check passes (``DeploymentStep.java:
+    222-258`` reads the readiness result from task labels)."""
+
+    cmd: str
+    interval_s: float = 5.0
+    timeout_s: float = 10.0
+    delay_s: float = 0.0
+
+
+@dataclass(frozen=True)
+class ConfigFileSpec:
+    """Reference ``specification/ConfigFileSpec.java`` — a mustache template
+    rendered by bootstrap inside the sandbox (``sdk/bootstrap/main.go:351-376``)."""
+
+    name: str
+    relative_path: str
+    template: str
+
+
+@dataclass(frozen=True)
+class DiscoverySpec:
+    prefix: Optional[str] = None
+    visibility: str = "CLUSTER"
+
+
+@dataclass(frozen=True)
+class TaskSpec:
+    """Reference ``specification/TaskSpec.java:15`` / ``DefaultTaskSpec``."""
+
+    name: str
+    goal: GoalState
+    cmd: str
+    resource_set_id: str
+    env: Mapping[str, str] = field(default_factory=dict)
+    configs: tuple[ConfigFileSpec, ...] = ()
+    health_check: Optional[HealthCheckSpec] = None
+    readiness_check: Optional[ReadinessCheckSpec] = None
+    discovery: Optional[DiscoverySpec] = None
+    essential: bool = True
+    kill_grace_period_s: int = 0
+    uris: tuple[str, ...] = ()
+
+    def validate(self) -> list[str]:
+        errs = []
+        if not self.cmd:
+            errs.append(f"task {self.name}: empty cmd")
+        if "__" in self.name:
+            errs.append(f"task {self.name}: '__' is reserved (task-id codec)")
+        return errs
+
+
+@dataclass(frozen=True)
+class PodSpec:
+    """Reference ``specification/PodSpec.java:19`` / ``DefaultPodSpec``."""
+
+    type: str
+    count: int
+    tasks: tuple[TaskSpec, ...]
+    resource_sets: tuple[ResourceSet, ...]
+    user: Optional[str] = None
+    image: Optional[str] = None
+    networks: tuple[str, ...] = ()
+    placement_rule: Optional[PlacementRule] = None
+    tpu: Optional[TpuSpec] = None
+    pre_reserved_role: Optional[str] = None
+    allow_decommission: bool = True
+    share_pid_namespace: bool = False
+
+    def validate(self) -> list[str]:
+        errs = []
+        if self.count < 1:
+            errs.append(f"pod {self.type}: count must be >= 1")
+        if not self.tasks:
+            errs.append(f"pod {self.type}: no tasks")
+        if "__" in self.type or "-" in self.type and self.type.rsplit("-", 1)[-1].isdigit():
+            # '<type>-<int>' must parse unambiguously back to (type, index).
+            errs.append(f"pod type {self.type!r} collides with instance-name codec")
+        rs_ids = {r.id for r in self.resource_sets}
+        if len(rs_ids) != len(self.resource_sets):
+            errs.append(f"pod {self.type}: duplicate resource set ids")
+        task_names = set()
+        for t in self.tasks:
+            if t.name in task_names:
+                errs.append(f"pod {self.type}: duplicate task name {t.name}")
+            task_names.add(t.name)
+            if t.resource_set_id not in rs_ids:
+                errs.append(
+                    f"pod {self.type}/{t.name}: unknown resource set {t.resource_set_id}")
+            errs.extend(t.validate())
+        for r in self.resource_sets:
+            errs.extend(r.validate())
+        total_tpus = sum(r.tpus for r in self.resource_sets)
+        if total_tpus and self.tpu is None:
+            errs.append(
+                f"pod {self.type}: tpus requested in resource sets but no TpuSpec")
+        return errs
+
+    def resource_set(self, rs_id: str) -> ResourceSet:
+        for r in self.resource_sets:
+            if r.id == rs_id:
+                return r
+        raise KeyError(rs_id)
+
+    def task(self, name: str) -> TaskSpec:
+        for t in self.tasks:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+
+@dataclass(frozen=True)
+class ReplacementFailurePolicy:
+    """Reference ``specification/ReplacementFailurePolicy.java`` — automatic
+    TRANSIENT->PERMANENT escalation timers consumed by the recovery monitor
+    (``SchedulerBuilder.java:568-577``)."""
+
+    permanent_failure_timeout_s: Optional[float] = None
+    min_replace_delay_s: float = 0.0
+
+
+@dataclass(frozen=True)
+class StepSpecEntry:
+    """One YAML plan step: which pod instance(s), which tasks.
+
+    Reference ``specification/yaml/RawPlan/RawPhase/RawStep`` + hdfs
+    ``svc.yml:566-596`` per-step task lists.
+    """
+
+    pod_instance: int  # index within the pod, or -1 for "default/every"
+    tasks: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class PhaseSpec:
+    name: str
+    pod_type: str
+    strategy: str = "serial"
+    steps: tuple[StepSpecEntry, ...] = ()  # empty => one step per pod instance
+
+
+@dataclass(frozen=True)
+class PlanSpecModel:
+    name: str
+    strategy: str = "serial"
+    phases: tuple[PhaseSpec, ...] = ()
+
+
+@dataclass(frozen=True)
+class ServiceSpec:
+    """Reference ``specification/ServiceSpec.java:13`` / ``DefaultServiceSpec``."""
+
+    name: str
+    pods: tuple[PodSpec, ...]
+    user: Optional[str] = None
+    web_url: Optional[str] = None
+    replacement_failure_policy: Optional[ReplacementFailurePolicy] = None
+    plans: tuple[PlanSpecModel, ...] = ()
+
+    def validate(self) -> list[str]:
+        errs = []
+        if not self.name:
+            errs.append("service name is empty")
+        if not self.pods:
+            errs.append("service has no pods")
+        pod_types = set()
+        for p in self.pods:
+            if p.type in pod_types:
+                errs.append(f"duplicate pod type {p.type}")
+            pod_types.add(p.type)
+            errs.extend(p.validate())
+        for plan in self.plans:
+            for phase in plan.phases:
+                if phase.pod_type not in pod_types:
+                    errs.append(
+                        f"plan {plan.name}/phase {phase.name}: unknown pod {phase.pod_type}")
+        return errs
+
+    def pod(self, pod_type: str) -> PodSpec:
+        for p in self.pods:
+            if p.type == pod_type:
+                return p
+        raise KeyError(pod_type)
+
+    def plan(self, name: str) -> Optional[PlanSpecModel]:
+        for pl in self.plans:
+            if pl.name == name:
+                return pl
+        return None
+
+    # -- canonical serialization (ConfigStore payloads; reference
+    #    DefaultServiceSpec's Jackson round-trip + SerializationUtils) -------
+
+    def to_json(self) -> str:
+        def encode(obj: Any) -> Any:
+            if isinstance(obj, enum.Enum):
+                return obj.value
+            raise TypeError(type(obj))
+
+        data = asdict(self)
+        for pod, pod_data in zip(self.pods, data["pods"]):
+            pod_data["placement_rule"] = (
+                rule_to_json(pod.placement_rule) if pod.placement_rule else None)
+        return json.dumps(data, default=encode, sort_keys=True, indent=1)
+
+    @staticmethod
+    def from_json(text: str) -> "ServiceSpec":
+        data = json.loads(text)
+        return _service_from_dict(data)
+
+
+def _service_from_dict(data: Mapping[str, Any]) -> ServiceSpec:
+    pods = []
+    for pd in data["pods"]:
+        rule = pd.get("placement_rule")
+        pods.append(PodSpec(
+            type=pd["type"],
+            count=pd["count"],
+            tasks=tuple(_task_from_dict(t) for t in pd["tasks"]),
+            resource_sets=tuple(_rs_from_dict(r) for r in pd["resource_sets"]),
+            user=pd.get("user"),
+            image=pd.get("image"),
+            networks=tuple(pd.get("networks", ())),
+            placement_rule=rule_from_json(rule) if rule else None,
+            tpu=TpuSpec(**pd["tpu"]) if pd.get("tpu") else None,
+            pre_reserved_role=pd.get("pre_reserved_role"),
+            allow_decommission=pd.get("allow_decommission", True),
+            share_pid_namespace=pd.get("share_pid_namespace", False),
+        ))
+    rfp = data.get("replacement_failure_policy")
+    return ServiceSpec(
+        name=data["name"],
+        pods=tuple(pods),
+        user=data.get("user"),
+        web_url=data.get("web_url"),
+        replacement_failure_policy=ReplacementFailurePolicy(**rfp) if rfp else None,
+        plans=tuple(
+            PlanSpecModel(
+                name=pl["name"],
+                strategy=pl.get("strategy", "serial"),
+                phases=tuple(
+                    PhaseSpec(
+                        name=ph["name"],
+                        pod_type=ph["pod_type"],
+                        strategy=ph.get("strategy", "serial"),
+                        steps=tuple(
+                            StepSpecEntry(pod_instance=s["pod_instance"],
+                                          tasks=tuple(s["tasks"]))
+                            for s in ph.get("steps", ())),
+                    )
+                    for ph in pl.get("phases", ())
+                ),
+            )
+            for pl in data.get("plans", ())
+        ),
+    )
+
+
+def _task_from_dict(t: Mapping[str, Any]) -> TaskSpec:
+    return TaskSpec(
+        name=t["name"],
+        goal=GoalState(t["goal"]),
+        cmd=t["cmd"],
+        resource_set_id=t["resource_set_id"],
+        env=dict(t.get("env", {})),
+        configs=tuple(ConfigFileSpec(**c) for c in t.get("configs", ())),
+        health_check=HealthCheckSpec(**t["health_check"]) if t.get("health_check") else None,
+        readiness_check=(
+            ReadinessCheckSpec(**t["readiness_check"]) if t.get("readiness_check") else None),
+        discovery=DiscoverySpec(**t["discovery"]) if t.get("discovery") else None,
+        essential=t.get("essential", True),
+        kill_grace_period_s=t.get("kill_grace_period_s", 0),
+        uris=tuple(t.get("uris", ())),
+    )
+
+
+def _rs_from_dict(r: Mapping[str, Any]) -> ResourceSet:
+    return ResourceSet(
+        id=r["id"],
+        cpus=r.get("cpus", 0.0),
+        memory_mb=r.get("memory_mb", 0),
+        disk_mb=r.get("disk_mb", 0),
+        tpus=r.get("tpus", 0),
+        ports=tuple(PortSpec(**p) for p in r.get("ports", ())),
+        volumes=tuple(
+            VolumeSpec(container_path=v["container_path"], size_mb=v["size_mb"],
+                       type=VolumeType(v["type"]) if isinstance(v.get("type"), str)
+                       else v.get("type", VolumeType.ROOT))
+            for v in r.get("volumes", ())),
+    )
+
+
+@dataclass(frozen=True)
+class PodInstance:
+    """A concrete (pod spec, index) pair — reference ``specification/PodInstance.java``."""
+
+    pod: PodSpec
+    index: int
+
+    @property
+    def name(self) -> str:
+        return f"{self.pod.type}-{self.index}"
+
+    def task_instance_name(self, task: TaskSpec | str) -> str:
+        task_name = task if isinstance(task, str) else task.name
+        return f"{self.name}-{task_name}"
+
+
+def with_pod_count(spec: ServiceSpec, pod_type: str, count: int) -> ServiceSpec:
+    """Structural update helper (specs are immutable values)."""
+    pods = tuple(replace(p, count=count) if p.type == pod_type else p for p in spec.pods)
+    return replace(spec, pods=pods)

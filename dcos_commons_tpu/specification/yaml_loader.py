@@ -1,0 +1,303 @@
+"""YAML service definition front-end.
+
+Reference: ``specification/yaml/RawServiceSpec.java:24`` (mustache render +
+parse) and ``YAMLToInternalMappers.java:83`` (the 805-LoC semantic mapping:
+resource-set synthesis for inline task resources, ``TASKCFG_ALL_*`` env
+routing, port/volume conversion, plan parsing).
+
+Our YAML dialect (close to the reference svc.yml, TPU fields added)::
+
+    name: {{FRAMEWORK_NAME}}
+    pods:
+      hello:
+        count: {{HELLO_COUNT}}
+        placement: '[["hostname", "UNIQUE"]]'
+        tpu:                      # optional — TPU gang request
+          chips: 4
+          topology: v4-32
+        resource-sets:            # optional; tasks may also inline resources
+          node-resources:
+            cpus: 1.0
+            memory: 4096
+            tpus: 4
+        tasks:
+          server:
+            goal: RUNNING
+            cmd: ./run.sh
+            cpus: 0.5             # inline => synthesized resource set
+            memory: 256
+            ports:
+              http: {port: 0, vip: server}
+            volumes:
+              - {path: data, size: 1024, type: ROOT}
+            env: {FOO: bar}
+            configs:
+              app-conf: {template: cfg.mustache, dest: conf/app.cfg}
+            health-check: {cmd: ./ok.sh, interval: 30, grace-period: 60}
+            readiness-check: {cmd: ./ready.sh, interval: 5}
+    plans:
+      deploy:
+        strategy: serial
+        phases:
+          server-deploy:
+            pod: hello
+            strategy: parallel
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping, Optional
+
+import yaml
+
+from ..matching.placement import parse_marathon_constraints, rule_from_json
+from ..utils.template import render_template
+from .spec import (ConfigFileSpec, DiscoverySpec, GoalState, HealthCheckSpec,
+                   PhaseSpec, PlanSpecModel, PodSpec, PortSpec,
+                   ReadinessCheckSpec, ReplacementFailurePolicy, ResourceSet,
+                   ServiceSpec, StepSpecEntry, TaskSpec, TpuSpec, VolumeSpec,
+                   VolumeType)
+
+TASKCFG_ALL_PREFIX = "TASKCFG_ALL_"
+TASKCFG_POD_PREFIX = "TASKCFG_"
+
+
+def load_service_yaml(path: str | os.PathLike,
+                      env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
+    """Render + parse a service YAML file (reference ``RawServiceSpec.newBuilder``)."""
+    with open(path) as f:
+        return load_service_yaml_str(f.read(), env, base_dir=os.path.dirname(path))
+
+
+def load_service_yaml_str(text: str, env: Optional[Mapping[str, str]] = None,
+                          base_dir: str = ".") -> ServiceSpec:
+    env = dict(env if env is not None else os.environ)
+    rendered = render_template(text, env, strict=True)
+    raw = yaml.safe_load(rendered)
+    spec = _map_raw(raw, env, base_dir)
+    errors = spec.validate()
+    if errors:
+        raise ValueError("invalid service spec:\n  " + "\n  ".join(errors))
+    return spec
+
+
+def taskcfg_env(env: Mapping[str, str], pod_type: str) -> dict[str, str]:
+    """``TASKCFG_ALL_X=v`` / ``TASKCFG_<POD>_X=v`` scheduler env -> per-task env
+    (reference ``config/TaskEnvRouter.java:26``)."""
+    out: dict[str, str] = {}
+    pod_prefix = f"{TASKCFG_POD_PREFIX}{pod_type.upper().replace('-', '_')}_"
+    for key, value in env.items():
+        if key.startswith(TASKCFG_ALL_PREFIX):
+            out[key[len(TASKCFG_ALL_PREFIX):]] = value
+    # pod-specific overrides ALL; applied second so it wins. For a pod whose
+    # name upper-cases to ALL_* the key matches both prefixes — pod-specific
+    # routing takes precedence for that pod (the reference's TaskEnvRouter
+    # simply can't scope such pods at all).
+    for key, value in env.items():
+        if key.startswith(pod_prefix):
+            out[key[len(pod_prefix):]] = value
+    return out
+
+
+def _map_raw(raw: Mapping[str, Any], env: Mapping[str, str], base_dir: str) -> ServiceSpec:
+    if not isinstance(raw, Mapping) or "name" not in raw or "pods" not in raw:
+        raise ValueError("service yaml must define 'name' and 'pods'")
+    pods = tuple(
+        _map_pod(pod_type, pod_raw or {}, env, base_dir)
+        for pod_type, pod_raw in raw["pods"].items())
+    rfp_raw = raw.get("replacement-failure-policy")
+    return ServiceSpec(
+        name=str(raw["name"]),
+        pods=pods,
+        user=raw.get("user"),
+        web_url=raw.get("web-url"),
+        replacement_failure_policy=ReplacementFailurePolicy(
+            permanent_failure_timeout_s=_seconds(rfp_raw.get("permanent-failure-timeout-mins"), 60),
+            min_replace_delay_s=_seconds(rfp_raw.get("min-replace-delay-mins"), 60) or 0.0,
+        ) if rfp_raw else None,
+        plans=_map_plans(raw.get("plans") or {}),
+    )
+
+
+def _seconds(value, scale) -> Optional[float]:
+    return None if value is None else float(value) * scale
+
+
+def _map_pod(pod_type: str, raw: Mapping[str, Any], env: Mapping[str, str],
+             base_dir: str) -> PodSpec:
+    resource_sets = [
+        _map_resource_set(rs_id, rs_raw or {})
+        for rs_id, rs_raw in (raw.get("resource-sets") or {}).items()]
+    routed_env = taskcfg_env(env, pod_type)
+
+    tasks = []
+    for task_name, task_raw in (raw.get("tasks") or {}).items():
+        task_raw = task_raw or {}
+        rs_id = task_raw.get("resource-set")
+        if rs_id is None:
+            # inline resources => synthesized per-task resource set
+            # (reference YAMLToInternalMappers "<taskname>-resources" synthesis)
+            rs_id = f"{task_name}-resources"
+            resource_sets.append(_map_resource_set(rs_id, task_raw))
+        tasks.append(_map_task(task_name, task_raw, rs_id, routed_env, base_dir))
+
+    placement = raw.get("placement")
+    if placement is None:
+        rule = None
+    elif isinstance(placement, str):
+        rule = parse_marathon_constraints(placement)
+    else:
+        rule = rule_from_json(placement)
+
+    tpu_raw = raw.get("tpu")
+    tpu = TpuSpec(
+        chips=int(tpu_raw.get("chips", 0)),
+        topology=tpu_raw.get("topology"),
+        gang=bool(tpu_raw.get("gang", True)),
+    ) if tpu_raw else None
+    if tpu is None and any(rs.tpus for rs in resource_sets):
+        tpu = TpuSpec(chips=max(rs.tpus for rs in resource_sets))
+
+    return PodSpec(
+        type=pod_type,
+        count=int(raw.get("count", 1)),
+        tasks=tuple(tasks),
+        resource_sets=tuple(resource_sets),
+        user=raw.get("user"),
+        image=raw.get("image"),
+        networks=tuple((raw.get("networks") or {}).keys()
+                       if isinstance(raw.get("networks"), Mapping)
+                       else raw.get("networks") or ()),
+        placement_rule=rule,
+        tpu=tpu,
+        pre_reserved_role=raw.get("pre-reserved-role"),
+        allow_decommission=bool(raw.get("allow-decommission", True)),
+        share_pid_namespace=bool(raw.get("share-pid-namespace", False)),
+    )
+
+
+def _map_resource_set(rs_id: str, raw: Mapping[str, Any]) -> ResourceSet:
+    ports = []
+    for name, port_raw in (raw.get("ports") or {}).items():
+        if isinstance(port_raw, Mapping):
+            ports.append(PortSpec(
+                name=name,
+                port=int(port_raw.get("port", 0)),
+                env_key=port_raw.get("env-key"),
+                vip=port_raw.get("vip"),
+                vip_port=port_raw.get("vip-port"),
+            ))
+        else:
+            ports.append(PortSpec(name=name, port=int(port_raw)))
+    volumes = []
+    vol_raw = raw.get("volume")
+    vols_raw = list(raw.get("volumes") or ([] if vol_raw is None else [vol_raw]))
+    if vol_raw is not None and raw.get("volumes"):
+        vols_raw.append(vol_raw)
+    for v in vols_raw:
+        volumes.append(VolumeSpec(
+            container_path=v["path"],
+            size_mb=int(v["size"]),
+            type=VolumeType(str(v.get("type", "ROOT")).upper()),
+        ))
+    return ResourceSet(
+        id=rs_id,
+        cpus=float(raw.get("cpus", 0.0)),
+        memory_mb=int(raw.get("memory", 0)),
+        disk_mb=int(raw.get("disk", 0)),
+        tpus=int(raw.get("tpus", 0)),
+        ports=tuple(ports),
+        volumes=tuple(volumes),
+    )
+
+
+def _map_task(name: str, raw: Mapping[str, Any], rs_id: str,
+              routed_env: Mapping[str, str], base_dir: str) -> TaskSpec:
+    env = dict(routed_env)
+    env.update({str(k): str(v) for k, v in (raw.get("env") or {}).items()})
+
+    configs = []
+    for cfg_name, cfg_raw in (raw.get("configs") or {}).items():
+        if "content" in cfg_raw:
+            # inline template body (tests / simple services)
+            template = cfg_raw["content"]
+        else:
+            template_path = os.path.join(base_dir, cfg_raw["template"])
+            try:
+                with open(template_path) as f:
+                    template = f.read()
+            except OSError as e:
+                raise ValueError(
+                    f"task {name}: config {cfg_name!r} template not readable: "
+                    f"{template_path} ({e})") from None
+        configs.append(ConfigFileSpec(
+            name=cfg_name, relative_path=cfg_raw["dest"], template=template))
+
+    hc_raw = raw.get("health-check")
+    rc_raw = raw.get("readiness-check")
+    disc_raw = raw.get("discovery")
+    return TaskSpec(
+        name=name,
+        goal=GoalState(str(raw.get("goal", "RUNNING")).upper()),
+        cmd=str(raw.get("cmd", "")),
+        resource_set_id=rs_id,
+        env=env,
+        configs=tuple(configs),
+        health_check=HealthCheckSpec(
+            cmd=hc_raw["cmd"],
+            interval_s=float(hc_raw.get("interval", 30)),
+            grace_period_s=float(hc_raw.get("grace-period", 60)),
+            max_consecutive_failures=int(hc_raw.get("max-consecutive-failures", 3)),
+            timeout_s=float(hc_raw.get("timeout", 20)),
+            delay_s=float(hc_raw.get("delay", 0)),
+        ) if hc_raw else None,
+        readiness_check=ReadinessCheckSpec(
+            cmd=rc_raw["cmd"],
+            interval_s=float(rc_raw.get("interval", 5)),
+            timeout_s=float(rc_raw.get("timeout", 10)),
+            delay_s=float(rc_raw.get("delay", 0)),
+        ) if rc_raw else None,
+        discovery=DiscoverySpec(
+            prefix=disc_raw.get("prefix"),
+            visibility=disc_raw.get("visibility", "CLUSTER"),
+        ) if disc_raw else None,
+        essential=bool(raw.get("essential", True)),
+        kill_grace_period_s=int(raw.get("kill-grace-period", 0)),
+        uris=tuple(raw.get("uris") or ()),
+    )
+
+
+def _map_plans(raw: Mapping[str, Any]) -> tuple[PlanSpecModel, ...]:
+    plans = []
+    for plan_name, plan_raw in raw.items():
+        plan_raw = plan_raw or {}
+        phases = []
+        for phase_name, phase_raw in (plan_raw.get("phases") or {}).items():
+            phase_raw = phase_raw or {}
+            steps = []
+            for step_raw in phase_raw.get("steps") or ():
+                # YAML form: [index, [task, ...]] or {pod-instance:, tasks:}
+                if isinstance(step_raw, Mapping):
+                    steps.append(StepSpecEntry(
+                        pod_instance=int(step_raw.get("pod-instance", -1)),
+                        tasks=tuple(step_raw.get("tasks") or ()),
+                    ))
+                else:
+                    idx, tasks = step_raw[0], step_raw[1] if len(step_raw) > 1 else ()
+                    idx = -1 if idx in ("default", None) else int(idx)
+                    steps.append(StepSpecEntry(
+                        pod_instance=idx,
+                        tasks=tuple(tasks) if isinstance(tasks, (list, tuple)) else (tasks,)))
+            phases.append(PhaseSpec(
+                name=phase_name,
+                pod_type=phase_raw["pod"],
+                strategy=str(phase_raw.get("strategy", "serial")).lower(),
+                steps=tuple(steps),
+            ))
+        plans.append(PlanSpecModel(
+            name=plan_name,
+            strategy=str(plan_raw.get("strategy", "serial")).lower(),
+            phases=tuple(phases),
+        ))
+    return tuple(plans)

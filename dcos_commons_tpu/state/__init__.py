@@ -1,0 +1,6 @@
+from .persister import (CachingPersister, FilePersister, MemPersister,
+                        NotFoundError, Persister, PersisterError)
+from .state_store import (ConfigStore, FrameworkStore, GoalOverride,
+                          OverrideProgress, SchemaVersionStore, StateStore,
+                          StateStoreError)
+from .tasks import StoredTask, TaskState, TaskStatus, TpuAssignment

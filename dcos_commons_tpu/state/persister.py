@@ -1,0 +1,306 @@
+"""KV-tree persistence abstraction.
+
+Reference: ``storage/Persister.java:15`` — a minimal hierarchical KV store
+(get/set/setMany/getChildren/recursiveDelete) that everything stateful sits
+on, with engines: ``MemPersister`` (tests), ``CuratorPersister`` (ZooKeeper,
+production), and a write-through RAM cache ``PersisterCache``.
+
+Engines here: :class:`MemPersister` and :class:`FilePersister` (fsync'd
+directory tree — the production engine until the etcd/raft backend lands),
+plus :class:`CachingPersister` mirroring ``storage/PersisterCache.java``.
+Paths are ``/``-separated; nodes may hold a value *and* children (like ZK).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Dict, Iterable, Mapping, Optional
+
+
+class PersisterError(Exception):
+    pass
+
+
+class NotFoundError(PersisterError):
+    pass
+
+
+def _split(path: str) -> list[str]:
+    parts = [p for p in path.split("/") if p]
+    for p in parts:
+        # dot-prefixed names are reserved for engine bookkeeping
+        # (FilePersister's .value/.journal files) — reject uniformly so all
+        # engines agree on the namespace
+        if p.startswith("."):
+            raise PersisterError(f"illegal path component {p!r} in {path!r}")
+    return parts
+
+
+class Persister:
+    """Interface (reference ``Persister.java:15``)."""
+
+    def get(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def set(self, path: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def set_many(self, values: Mapping[str, Optional[bytes]]) -> None:
+        """Atomic multi-write; ``None`` value = delete that path (reference
+        ``CuratorPersister.setMany:229`` uses ZK transactions)."""
+        raise NotImplementedError
+
+    def get_children(self, path: str) -> list[str]:
+        raise NotImplementedError
+
+    def recursive_delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    # -- conveniences shared by engines ------------------------------------
+
+    def get_or_none(self, path: str) -> Optional[bytes]:
+        try:
+            return self.get(path)
+        except NotFoundError:
+            return None
+
+    def recursive_paths(self, path: str = "") -> list[str]:
+        """All descendant paths (reference ``PersisterUtils.getAllData``)."""
+        out = []
+        for child in self.get_children(path):
+            child_path = f"{path}/{child}" if path else child
+            out.append(child_path)
+            out.extend(self.recursive_paths(child_path))
+        return out
+
+    def delete_all(self) -> None:
+        """Reference ``PersisterUtils.clearAllData``."""
+        for child in self.get_children(""):
+            self.recursive_delete(child)
+
+
+class _Node:
+    __slots__ = ("value", "children")
+
+    def __init__(self):
+        self.value: Optional[bytes] = None
+        self.children: Dict[str, "_Node"] = {}
+
+
+class MemPersister(Persister):
+    """Reference ``storage/MemPersister.java`` — in-memory tree for tests and
+    for the simulation harness."""
+
+    def __init__(self):
+        self._root = _Node()
+        self._lock = threading.RLock()
+
+    def _find(self, path: str, create: bool = False) -> Optional[_Node]:
+        node = self._root
+        for part in _split(path):
+            child = node.children.get(part)
+            if child is None:
+                if not create:
+                    return None
+                child = node.children[part] = _Node()
+            node = child
+        return node
+
+    def get(self, path: str) -> bytes:
+        with self._lock:
+            node = self._find(path)
+            if node is None or node.value is None:
+                raise NotFoundError(path)
+            return node.value
+
+    def set(self, path: str, value: bytes) -> None:
+        with self._lock:
+            self._find(path, create=True).value = value
+
+    def set_many(self, values: Mapping[str, Optional[bytes]]) -> None:
+        with self._lock:
+            for path, value in values.items():
+                if value is None:
+                    try:
+                        self.recursive_delete(path)
+                    except NotFoundError:
+                        pass
+                else:
+                    self.set(path, value)
+
+    def get_children(self, path: str) -> list[str]:
+        with self._lock:
+            node = self._find(path)
+            if node is None:
+                if not _split(path):
+                    return []  # empty root
+                raise NotFoundError(path)
+            return sorted(node.children)
+
+    def recursive_delete(self, path: str) -> None:
+        with self._lock:
+            parts = _split(path)
+            if not parts:
+                raise PersisterError("refusing to delete root; use delete_all")
+            parent = self._find("/".join(parts[:-1])) if parts[:-1] else self._root
+            if parent is None or parts[-1] not in parent.children:
+                raise NotFoundError(path)
+            del parent.children[parts[-1]]
+
+
+class FilePersister(Persister):
+    """Durable Persister over a directory tree.
+
+    Layout: each node ``a/b`` is a directory ``<root>/a/b/``; its value lives
+    in ``<root>/a/b/.value``. Writes are atomic (tmp + rename + dirsync).
+    ``set_many`` gains atomicity through a journal file replayed on open —
+    the moral equivalent of the reference's ZK transactions
+    (``CuratorPersister.java:229-241``).
+    """
+
+    VALUE = ".value"
+    JOURNAL = ".journal"
+
+    def __init__(self, root: str):
+        self._root = os.path.abspath(root)
+        os.makedirs(self._root, exist_ok=True)
+        self._lock = threading.RLock()
+        self._replay_journal()
+
+    # -- journal -----------------------------------------------------------
+
+    def _journal_path(self) -> str:
+        return os.path.join(self._root, self.JOURNAL)
+
+    def _replay_journal(self) -> None:
+        journal = self._journal_path()
+        if not os.path.exists(journal):
+            return
+        import json
+        with open(journal, "rb") as f:
+            try:
+                entries = json.loads(f.read().decode())
+            except ValueError:
+                entries = None  # torn write: journal never committed; discard
+        if entries is not None:
+            for path, hexval in entries.items():
+                if hexval is None:
+                    try:
+                        self.recursive_delete(path)
+                    except NotFoundError:
+                        pass
+                else:
+                    self.set(path, bytes.fromhex(hexval))
+        os.unlink(journal)
+
+    # -- paths -------------------------------------------------------------
+
+    def _dir(self, path: str) -> str:
+        return os.path.join(self._root, *_split(path))
+
+    def _value_file(self, path: str) -> str:
+        return os.path.join(self._dir(path), self.VALUE)
+
+    # -- Persister ---------------------------------------------------------
+
+    def get(self, path: str) -> bytes:
+        with self._lock:
+            try:
+                with open(self._value_file(path), "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                raise NotFoundError(path) from None
+
+    def set(self, path: str, value: bytes) -> None:
+        with self._lock:
+            d = self._dir(path)
+            os.makedirs(d, exist_ok=True)
+            tmp = os.path.join(d, f"{self.VALUE}.tmp.{os.getpid()}")
+            with open(tmp, "wb") as f:
+                f.write(value)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(d, self.VALUE))
+            dirfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+
+    def set_many(self, values: Mapping[str, Optional[bytes]]) -> None:
+        import json
+        with self._lock:
+            payload = {p: (v.hex() if v is not None else None)
+                       for p, v in values.items()}
+            tmp = self._journal_path() + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(json.dumps(payload).encode())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._journal_path())  # commit point
+            self._replay_journal()
+
+    def get_children(self, path: str) -> list[str]:
+        with self._lock:
+            d = self._dir(path)
+            if not os.path.isdir(d):
+                raise NotFoundError(path)
+            return sorted(c for c in os.listdir(d)
+                          if not c.startswith(".") and os.path.isdir(os.path.join(d, c)))
+
+    def recursive_delete(self, path: str) -> None:
+        with self._lock:
+            if not _split(path):
+                raise PersisterError("refusing to delete root; use delete_all")
+            d = self._dir(path)
+            if not os.path.isdir(d):
+                raise NotFoundError(path)
+            shutil.rmtree(d)
+
+
+class CachingPersister(Persister):
+    """Write-through full-RAM cache (reference ``storage/PersisterCache.java``,
+    toggled by ``DISABLE_STATE_CACHE``): reads served from memory, writes go
+    to the backend first, then update the cache."""
+
+    def __init__(self, backend: Persister):
+        self._backend = backend
+        self._cache = MemPersister()
+        self._lock = threading.RLock()
+        for path in backend.recursive_paths():
+            value = backend.get_or_none(path)
+            if value is not None:
+                self._cache.set(path, value)
+            else:
+                self._cache._find(path, create=True)  # value-less interior node
+
+    def get(self, path: str) -> bytes:
+        with self._lock:
+            return self._cache.get(path)
+
+    def set(self, path: str, value: bytes) -> None:
+        with self._lock:
+            self._backend.set(path, value)
+            self._cache.set(path, value)
+
+    def set_many(self, values: Mapping[str, Optional[bytes]]) -> None:
+        with self._lock:
+            self._backend.set_many(values)
+            self._cache.set_many(values)
+
+    def get_children(self, path: str) -> list[str]:
+        with self._lock:
+            return self._cache.get_children(path)
+
+    def recursive_delete(self, path: str) -> None:
+        with self._lock:
+            self._backend.recursive_delete(path)
+            self._cache.recursive_delete(path)
+
+    def close(self) -> None:
+        self._backend.close()

@@ -1,0 +1,284 @@
+"""StateStore / ConfigStore / FrameworkStore / schema versioning.
+
+Reference: ``state/StateStore.java:58`` (tasks ``:213``, statuses ``:257``,
+properties ``:463-547``, goal overrides ``:569-630``),
+``state/ConfigStore.java:34`` (UUID-keyed configs + target pointer
+``:245-276``), ``state/FrameworkStore.java``,
+``state/SchemaVersionStore.java``, ``state/PersistentLaunchRecorder.java``
+(launch WAL written BEFORE accept — ``scheduler/DefaultScheduler.java:453-466``).
+
+Tree layout under the persister root (one service's namespace)::
+
+    Tasks/<task_name>/TaskInfo
+    Tasks/<task_name>/TaskStatus
+    Tasks/<task_name>/Override
+    Properties/<key>
+    Configurations/<uuid>
+    ConfigTarget
+    FrameworkID
+    SchemaVersion
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from typing import Iterable, Mapping, Optional
+
+from ..specification.spec import ServiceSpec
+from ..utils.ids import new_uuid
+from .persister import NotFoundError, Persister
+from .tasks import StoredTask, TaskStatus
+
+CURRENT_SCHEMA_VERSION = 1
+
+
+class StateStoreError(Exception):
+    pass
+
+
+class GoalOverride(enum.Enum):
+    """Reference ``state/GoalStateOverride.java`` — operator pause/resume."""
+
+    NONE = "NONE"
+    PAUSED = "PAUSED"
+
+
+class OverrideProgress(enum.Enum):
+    PENDING = "PENDING"        # override requested, relaunch not yet done
+    IN_PROGRESS = "IN_PROGRESS"
+    COMPLETE = "COMPLETE"
+
+
+def _esc(key: str) -> str:
+    if "/" in key or key.startswith("."):
+        raise StateStoreError(f"illegal key: {key!r}")
+    return key
+
+
+class SchemaVersionStore:
+    """Reference ``state/SchemaVersionStore.java`` — refuse to run against a
+    newer-schema state tree (``SchedulerRunner.java:88``)."""
+
+    PATH = "SchemaVersion"
+
+    def __init__(self, persister: Persister):
+        self._persister = persister
+
+    def check(self) -> None:
+        raw = self._persister.get_or_none(self.PATH)
+        if raw is None:
+            self._persister.set(self.PATH, str(CURRENT_SCHEMA_VERSION).encode())
+            return
+        found = int(raw.decode())
+        if found != CURRENT_SCHEMA_VERSION:
+            raise StateStoreError(
+                f"state schema version {found} != supported {CURRENT_SCHEMA_VERSION}")
+
+
+class FrameworkStore:
+    """Reference ``state/FrameworkStore.java`` — the registered framework id."""
+
+    PATH = "FrameworkID"
+
+    def __init__(self, persister: Persister):
+        self._persister = persister
+
+    def store_framework_id(self, framework_id: str) -> None:
+        self._persister.set(self.PATH, framework_id.encode())
+
+    def fetch_framework_id(self) -> Optional[str]:
+        raw = self._persister.get_or_none(self.PATH)
+        return raw.decode() if raw is not None else None
+
+    def clear(self) -> None:
+        try:
+            self._persister.recursive_delete(self.PATH)
+        except NotFoundError:
+            pass
+
+
+class StateStore:
+    """Reference ``state/StateStore.java:58``."""
+
+    TASKS = "Tasks"
+    PROPERTIES = "Properties"
+    TASK_INFO = "TaskInfo"
+    TASK_STATUS = "TaskStatus"
+    OVERRIDE = "Override"
+
+    def __init__(self, persister: Persister, namespace: str = ""):
+        self._persister = persister
+        self._ns = f"Services/{_esc(namespace)}/" if namespace else ""
+
+    def _path(self, *parts: str) -> str:
+        return self._ns + "/".join(parts)
+
+    # -- tasks -------------------------------------------------------------
+
+    def store_tasks(self, tasks: Iterable[StoredTask]) -> None:
+        """Reference ``storeTasks:213`` — atomic multi-write (the launch WAL:
+        called before the agent is instructed to launch)."""
+        self._persister.set_many({
+            self._path(self.TASKS, _esc(t.task_name), self.TASK_INFO): t.to_json()
+            for t in tasks})
+
+    def fetch_task(self, task_name: str) -> Optional[StoredTask]:
+        raw = self._persister.get_or_none(
+            self._path(self.TASKS, _esc(task_name), self.TASK_INFO))
+        return StoredTask.from_json(raw) if raw is not None else None
+
+    def fetch_task_names(self) -> list[str]:
+        try:
+            return self._persister.get_children(self._path(self.TASKS).rstrip("/"))
+        except NotFoundError:
+            return []
+
+    def fetch_tasks(self) -> list[StoredTask]:
+        out = []
+        for name in self.fetch_task_names():
+            t = self.fetch_task(name)
+            if t is not None:
+                out.append(t)
+        return out
+
+    def store_status(self, task_name: str, status: TaskStatus) -> None:
+        """Reference ``storeStatus:257`` — validates the status belongs to the
+        stored task id (stale statuses from a previous launch are dropped by
+        the caller; we enforce the id match here)."""
+        task = self.fetch_task(task_name)
+        if task is not None and task.task_id != status.task_id:
+            raise StateStoreError(
+                f"status task id {status.task_id} != stored {task.task_id}")
+        self._persister.set(
+            self._path(self.TASKS, _esc(task_name), self.TASK_STATUS),
+            status.to_json())
+
+    def fetch_status(self, task_name: str) -> Optional[TaskStatus]:
+        raw = self._persister.get_or_none(
+            self._path(self.TASKS, _esc(task_name), self.TASK_STATUS))
+        return TaskStatus.from_json(raw) if raw is not None else None
+
+    def fetch_statuses(self) -> dict[str, TaskStatus]:
+        out = {}
+        for name in self.fetch_task_names():
+            s = self.fetch_status(name)
+            if s is not None:
+                out[name] = s
+        return out
+
+    def delete_task(self, task_name: str) -> None:
+        """Reference ``clearTask`` — used by decommission/replace GC."""
+        try:
+            self._persister.recursive_delete(self._path(self.TASKS, _esc(task_name)))
+        except NotFoundError:
+            pass
+
+    # -- goal overrides (pause/resume) -------------------------------------
+
+    def store_override(self, task_name: str, override: GoalOverride,
+                       progress: OverrideProgress) -> None:
+        self._persister.set(
+            self._path(self.TASKS, _esc(task_name), self.OVERRIDE),
+            json.dumps({"override": override.value, "progress": progress.value}).encode())
+
+    def fetch_override(self, task_name: str) -> tuple[GoalOverride, OverrideProgress]:
+        raw = self._persister.get_or_none(
+            self._path(self.TASKS, _esc(task_name), self.OVERRIDE))
+        if raw is None:
+            return GoalOverride.NONE, OverrideProgress.COMPLETE
+        data = json.loads(raw.decode())
+        return GoalOverride(data["override"]), OverrideProgress(data["progress"])
+
+    # -- properties --------------------------------------------------------
+
+    def store_property(self, key: str, value: bytes) -> None:
+        self._persister.set(self._path(self.PROPERTIES, _esc(key)), value)
+
+    def fetch_property(self, key: str) -> Optional[bytes]:
+        return self._persister.get_or_none(self._path(self.PROPERTIES, _esc(key)))
+
+    def fetch_property_keys(self) -> list[str]:
+        try:
+            return self._persister.get_children(self._path(self.PROPERTIES).rstrip("/"))
+        except NotFoundError:
+            return []
+
+    def clear_property(self, key: str) -> None:
+        try:
+            self._persister.recursive_delete(self._path(self.PROPERTIES, _esc(key)))
+        except NotFoundError:
+            pass
+
+    # deploy-complete marker (reference StateStoreUtils deploy-type property)
+    DEPLOY_COMPLETED = "deployment-completed"
+
+    def set_deploy_completed(self) -> None:
+        self.store_property(self.DEPLOY_COMPLETED, b"true")
+
+    def deploy_completed(self) -> bool:
+        return self.fetch_property(self.DEPLOY_COMPLETED) == b"true"
+
+    def delete_all(self) -> None:
+        for child in (self.TASKS, self.PROPERTIES):
+            try:
+                self._persister.recursive_delete(self._path(child).rstrip("/"))
+            except NotFoundError:
+                pass
+
+
+class ConfigStore:
+    """Reference ``state/ConfigStore.java:34`` — UUID-keyed immutable specs
+    plus a target pointer; rollout = write candidate, validate, move target."""
+
+    CONFIGS = "Configurations"
+    TARGET = "ConfigTarget"
+
+    def __init__(self, persister: Persister, namespace: str = ""):
+        self._persister = persister
+        self._ns = f"Services/{_esc(namespace)}/" if namespace else ""
+
+    def _path(self, *parts: str) -> str:
+        return self._ns + "/".join(parts)
+
+    def store(self, spec: ServiceSpec) -> str:
+        config_id = new_uuid()
+        self._persister.set(self._path(self.CONFIGS, config_id),
+                            spec.to_json().encode())
+        return config_id
+
+    def fetch(self, config_id: str) -> ServiceSpec:
+        raw = self._persister.get_or_none(self._path(self.CONFIGS, _esc(config_id)))
+        if raw is None:
+            raise StateStoreError(f"no such config: {config_id}")
+        return ServiceSpec.from_json(raw.decode())
+
+    def list_ids(self) -> list[str]:
+        try:
+            return self._persister.get_children(self._path(self.CONFIGS).rstrip("/"))
+        except NotFoundError:
+            return []
+
+    def set_target(self, config_id: str) -> None:
+        if config_id not in self.list_ids():
+            raise StateStoreError(f"cannot target unknown config {config_id}")
+        self._persister.set(self._path(self.TARGET), config_id.encode())
+
+    def get_target(self) -> Optional[str]:
+        raw = self._persister.get_or_none(self._path(self.TARGET))
+        return raw.decode() if raw is not None else None
+
+    def fetch_target_spec(self) -> Optional[ServiceSpec]:
+        target = self.get_target()
+        return self.fetch(target) if target else None
+
+    def prune(self, in_use: Iterable[str]) -> list[str]:
+        """Reference ``DefaultConfigurationUpdater.cleanupDuplicateAndUnusedConfigs``
+        — drop configs no live task references and that aren't the target."""
+        keep = set(in_use) | {self.get_target()}
+        removed = []
+        for config_id in self.list_ids():
+            if config_id not in keep:
+                self._persister.recursive_delete(self._path(self.CONFIGS, config_id))
+                removed.append(config_id)
+        return removed

@@ -1,0 +1,143 @@
+"""Task records and status model.
+
+Reference: Mesos ``TaskInfo``/``TaskStatus`` protobufs plus the label side
+channel (``offer/taskdata/TaskLabelReader/Writer.java``). We fold the labels
+(target config id, readiness result, permanently-failed marker, TPU process
+assignment) into one explicit :class:`StoredTask` record — no protobuf, no
+hidden label codec.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import time
+from dataclasses import asdict, dataclass, field, replace
+from typing import Mapping, Optional, Tuple
+
+from ..specification.spec import GoalState
+
+
+class TaskState(enum.Enum):
+    """Reference: Mesos TaskState subset actually consumed by the SDK
+    (``scheduler/plan/DeploymentStep.java:178-258``)."""
+
+    STAGING = "TASK_STAGING"
+    STARTING = "TASK_STARTING"
+    RUNNING = "TASK_RUNNING"
+    FINISHED = "TASK_FINISHED"
+    FAILED = "TASK_FAILED"
+    KILLED = "TASK_KILLED"
+    ERROR = "TASK_ERROR"
+    LOST = "TASK_LOST"
+    GONE = "TASK_GONE"          # agent partitioned / removed
+    UNREACHABLE = "TASK_UNREACHABLE"
+
+    @property
+    def terminal(self) -> bool:
+        return self in _TERMINAL
+
+    @property
+    def failed(self) -> bool:
+        """Terminal-and-not-successful (reference ``TaskUtils.isRecoveryNeeded``)."""
+        return self in _FAILED
+
+
+_TERMINAL = {TaskState.FINISHED, TaskState.FAILED, TaskState.KILLED,
+             TaskState.ERROR, TaskState.LOST, TaskState.GONE}
+_FAILED = {TaskState.FAILED, TaskState.KILLED, TaskState.ERROR,
+           TaskState.LOST, TaskState.GONE}
+
+
+@dataclass(frozen=True)
+class TpuAssignment:
+    """The JAX distributed-init contract pinned at launch time.
+
+    Bootstrap exports these as ``JAX_PROCESS_ID`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_COORDINATOR_ADDRESS`` (BASELINE.json north star; replaces the
+    reference bootstrap's DNS self-resolution role, ``sdk/bootstrap/main.go``).
+    ``process_id`` must be *stable across pod replace* (SURVEY.md section 7
+    hard part (4)) — it is derived from the pod instance index, not from the
+    agent, so a replaced worker rejoins with the same rank.
+    """
+
+    process_id: int
+    num_processes: int
+    coordinator_address: str     # "<host>:<port>" of process 0
+    chips: int = 0
+    slice_id: Optional[str] = None
+    topology: Optional[str] = None
+    worker_coords: Optional[Tuple[int, ...]] = None
+
+
+@dataclass(frozen=True)
+class StoredTask:
+    """Durable launch record (reference TaskInfo + labels)."""
+
+    task_name: str               # "<pod>-<idx>-<task>"
+    task_id: str                 # task_name + "__" + uuid, new per launch
+    pod_type: str
+    pod_index: int
+    task_spec_name: str          # spec-level task name e.g. "server"
+    resource_set_id: str
+    agent_id: str
+    hostname: str
+    target_config_id: str        # reference TaskLabelWriter.setTargetConfiguration
+    goal: GoalState
+    essential: bool = True
+    env: Mapping[str, str] = field(default_factory=dict)
+    cmd: str = ""
+    zone: Optional[str] = None
+    region: Optional[str] = None
+    permanently_failed: bool = False   # reference FailureUtils label
+    tpu: Optional[TpuAssignment] = None
+
+    @property
+    def pod_instance_name(self) -> str:
+        return f"{self.pod_type}-{self.pod_index}"
+
+    def to_json(self) -> bytes:
+        data = asdict(self)
+        data["goal"] = self.goal.value
+        return json.dumps(data, sort_keys=True).encode()
+
+    @staticmethod
+    def from_json(raw: bytes) -> "StoredTask":
+        data = json.loads(raw.decode())
+        tpu = data.get("tpu")
+        if tpu and tpu.get("worker_coords") is not None:
+            tpu["worker_coords"] = tuple(tpu["worker_coords"])
+        data["goal"] = GoalState(data["goal"])
+        data["tpu"] = TpuAssignment(**tpu) if tpu else None
+        return StoredTask(**data)
+
+    def failed_permanently(self) -> "StoredTask":
+        return replace(self, permanently_failed=True)
+
+
+@dataclass(frozen=True)
+class TaskStatus:
+    """Reference: Mesos TaskStatus, as emitted by our agents."""
+
+    task_id: str
+    state: TaskState
+    message: str = ""
+    timestamp: float = 0.0
+    readiness_passed: bool = False   # reference readiness-check result label
+    agent_id: Optional[str] = None
+
+    @staticmethod
+    def now(task_id: str, state: TaskState, message: str = "", **kw) -> "TaskStatus":
+        return TaskStatus(task_id=task_id, state=state, message=message,
+                          timestamp=time.time(), **kw)
+
+    def to_json(self) -> bytes:
+        data = asdict(self)
+        data["state"] = self.state.value
+        return json.dumps(data, sort_keys=True).encode()
+
+    @staticmethod
+    def from_json(raw: bytes) -> "TaskStatus":
+        data = json.loads(raw.decode())
+        data["state"] = TaskState(data["state"])
+        return TaskStatus(**data)

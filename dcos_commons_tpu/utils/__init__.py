@@ -1,0 +1,2 @@
+from .ids import new_uuid, task_id_to_name, make_task_id, pod_instance_name
+from .template import render_template, TemplateError

@@ -1,0 +1,37 @@
+"""ID codecs.
+
+Reference: ``offer/CommonIdUtils.java`` (task-id <-> task-name codec). The
+reference embeds the task name into the Mesos task-id string with a ``__``
+separator and a UUID suffix; we keep the same scheme so that a task-id alone
+is enough to route a status update back to its pod instance.
+"""
+
+from __future__ import annotations
+
+import uuid
+
+_SEP = "__"
+
+
+def new_uuid() -> str:
+    return str(uuid.uuid4())
+
+
+def make_task_id(task_name: str) -> str:
+    """``<task_name>__<uuid>`` (reference ``CommonIdUtils.toTaskId``)."""
+    if _SEP in task_name:
+        raise ValueError(f"task name may not contain '{_SEP}': {task_name}")
+    return f"{task_name}{_SEP}{uuid.uuid4()}"
+
+
+def task_id_to_name(task_id: str) -> str:
+    """Inverse of :func:`make_task_id` (reference ``CommonIdUtils.toTaskName``)."""
+    name, sep, _ = task_id.rpartition(_SEP)
+    if not sep:
+        raise ValueError(f"malformed task id: {task_id}")
+    return name
+
+
+def pod_instance_name(pod_type: str, index: int) -> str:
+    """``<pod>-<index>``, e.g. ``hello-0`` (reference ``PodInstance.getName``)."""
+    return f"{pod_type}-{index}"

@@ -1,0 +1,81 @@
+"""Mustache-style ``{{VAR}}`` template rendering.
+
+Reference: ``specification/yaml/TemplateUtils.java`` — renders service YAML
+and task config templates against an env map, with *missing-value errors*
+(the reference distinguishes strict rendering for ``svc.yml`` from lenient
+rendering for task config templates).
+
+Supported syntax (the subset the reference actually uses):
+
+* ``{{KEY}}``         — substitute; error in strict mode when missing.
+* ``{{#KEY}}..{{/KEY}}`` — section: rendered iff KEY is present and truthy
+  (non-empty, not "false"). No list iteration — env values are strings.
+* ``{{^KEY}}..{{/KEY}}`` — inverted section.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Mapping
+
+_TAG = re.compile(r"\{\{\s*([#^/]?)\s*([A-Za-z0-9_.\-]+)\s*\}\}")
+
+
+class TemplateError(ValueError):
+    """Raised in strict mode for missing values or unbalanced sections."""
+
+
+def _truthy(value: str | None) -> bool:
+    return value is not None and value != "" and value.lower() != "false"
+
+
+def render_template(text: str, env: Mapping[str, str], *, strict: bool = True) -> str:
+    """Render ``text`` against ``env``.
+
+    In strict mode a ``{{KEY}}`` with no binding raises :class:`TemplateError`
+    (reference ``TemplateUtils.renderMustacheThrowIfMissing``); otherwise it
+    renders as the empty string.
+    """
+    out, _ = _render(text, env, 0, None, strict, emit=True)
+    return out
+
+
+def _render(
+    text: str,
+    env: Mapping[str, str],
+    pos: int,
+    until: str | None,
+    strict: bool,
+    emit: bool,
+) -> tuple[str, int]:
+    parts: list[str] = []
+    while True:
+        match = _TAG.search(text, pos)
+        if match is None:
+            if until is not None:
+                raise TemplateError(f"unclosed section {{{{#{until}}}}}")
+            if emit:
+                parts.append(text[pos:])
+            return "".join(parts), len(text)
+        if emit:
+            parts.append(text[pos : match.start()])
+        kind, key = match.group(1), match.group(2)
+        pos = match.end()
+        if kind == "/":
+            if key != until:
+                raise TemplateError(f"unexpected {{{{/{key}}}}}")
+            return "".join(parts), pos
+        if kind in ("#", "^"):
+            present = _truthy(env.get(key))
+            render_body = emit and (present if kind == "#" else not present)
+            body, pos = _render(text, env, pos, key, strict, render_body)
+            if render_body:
+                parts.append(body)
+        else:
+            value = env.get(key)
+            if value is None:
+                if strict and emit:
+                    raise TemplateError(f"missing template value: {key}")
+                value = ""
+            if emit:
+                parts.append(value)

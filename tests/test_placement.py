@@ -1,0 +1,128 @@
+"""Placement DSL tests (reference ``offer/evaluate/placement/*Test`` coverage)."""
+
+import pytest
+
+from dcos_commons_tpu.agent import AgentInfo, TaskRecord, TpuInventory
+from dcos_commons_tpu.matching import (AndRule, HostnameRule, MaxPerHostnameRule,
+                                       MaxPerZoneRule, NotRule, OrRule,
+                                       RoundRobinByHostnameRule, StringMatcher,
+                                       TaskTypeRule, TpuSliceRule, ZoneRule,
+                                       parse_marathon_constraints, rule_from_json,
+                                       rule_to_json)
+
+
+def agent(i, zone=None, tpu=TpuInventory(), attrs=None):
+    return AgentInfo(agent_id=f"a{i}", hostname=f"host{i}", cpus=8, memory_mb=32768,
+                     tpu=tpu, zone=zone, region="us-central1",
+                     attributes=attrs or {})
+
+
+def task(pod_type, idx, agent_info):
+    return TaskRecord(task_name=f"{pod_type}-{idx}-server", pod_type=pod_type,
+                      pod_index=idx, agent_id=agent_info.agent_id,
+                      hostname=agent_info.hostname, zone=agent_info.zone,
+                      region=agent_info.region)
+
+
+def test_hostname_rule():
+    r = HostnameRule(StringMatcher.exact("host1"))
+    assert r.filter(agent(1), "hello-0", []).passes
+    assert not r.filter(agent(2), "hello-0", []).passes
+
+
+def test_combinators():
+    r = AndRule((HostnameRule(StringMatcher.glob("host*")),
+                 NotRule(HostnameRule(StringMatcher.exact("host2")))))
+    assert r.filter(agent(1), "p-0", []).passes
+    assert not r.filter(agent(2), "p-0", []).passes
+    r2 = OrRule((HostnameRule(StringMatcher.exact("hostX")),
+                 ZoneRule(StringMatcher.exact("z1"))))
+    assert r2.filter(agent(1, zone="z1"), "p-0", []).passes
+    assert not r2.filter(agent(1, zone="z2"), "p-0", []).passes
+
+
+def test_max_per_hostname():
+    r = MaxPerHostnameRule(max_count=1)
+    a1, a2 = agent(1), agent(2)
+    tasks = [task("hello", 0, a1)]
+    assert not r.filter(a1, "hello-1", tasks).passes
+    assert r.filter(a2, "hello-1", tasks).passes
+    # replacing the same pod instance doesn't veto itself
+    assert r.filter(a1, "hello-0", tasks).passes
+    # other pod types don't count
+    assert r.filter(a1, "world-0", tasks).passes
+
+
+def test_max_per_zone():
+    r = MaxPerZoneRule(max_count=2)
+    a1, a2, a3 = agent(1, "z1"), agent(2, "z1"), agent(3, "z2")
+    tasks = [task("c", 0, a1), task("c", 1, a2)]
+    assert not r.filter(a1, "c-2", tasks).passes
+    assert r.filter(a3, "c-2", tasks).passes
+
+
+def test_round_robin_hostname():
+    r = RoundRobinByHostnameRule(group_count=3)
+    a1, a2, a3 = agent(1), agent(2), agent(3)
+    assert r.filter(a1, "p-0", []).passes
+    tasks = [task("p", 0, a1)]
+    # host1 now above the floor while unseen hosts remain
+    assert not r.filter(a1, "p-1", tasks).passes
+    assert r.filter(a2, "p-1", tasks).passes
+    tasks.append(task("p", 1, a2))
+    assert r.filter(a3, "p-2", tasks).passes
+    tasks.append(task("p", 2, a3))
+    # all groups seen, floor is 1 -> host1 admissible again
+    assert r.filter(a1, "p-3", tasks).passes
+
+
+def test_task_type_rules():
+    a1, a2 = agent(1), agent(2)
+    tasks = [task("seed", 0, a1)]
+    colocate = TaskTypeRule("seed", "colocate")
+    avoid = TaskTypeRule("seed", "avoid")
+    assert colocate.filter(a1, "node-0", tasks).passes
+    assert not colocate.filter(a2, "node-0", tasks).passes
+    assert not avoid.filter(a1, "node-0", tasks).passes
+    assert avoid.filter(a2, "node-0", tasks).passes
+
+
+def test_tpu_slice_rule():
+    r = TpuSliceRule(topology="v4-32")
+    on_slice = agent(1, tpu=TpuInventory(chips=4, slice_id="s0", topology="v4-32"))
+    off_slice = agent(2)
+    wrong_topo = agent(3, tpu=TpuInventory(chips=4, slice_id="s1", topology="v4-16"))
+    assert r.filter(on_slice, "w-0", []).passes
+    assert not r.filter(off_slice, "w-0", []).passes
+    assert not r.filter(wrong_topo, "w-0", []).passes
+
+
+def test_marathon_constraints():
+    r = parse_marathon_constraints('[["hostname", "UNIQUE"]]')
+    assert isinstance(r, MaxPerHostnameRule) and r.max_count == 1
+    r = parse_marathon_constraints('hostname:UNIQUE')
+    assert isinstance(r, MaxPerHostnameRule)
+    r = parse_marathon_constraints('[["zone", "GROUP_BY", "3"]]')
+    assert r.type == "round-robin-zone"
+    r = parse_marathon_constraints('[["hostname", "CLUSTER", "host7"], ["zone", "MAX_PER", "2"]]')
+    assert isinstance(r, AndRule)
+    assert r.filter(agent(7, zone="z1"), "p-0", []).passes
+    assert not r.filter(agent(8, zone="z1"), "p-0", []).passes
+    r = parse_marathon_constraints('[["hostname", "LIKE", "host[12]"]]')
+    assert r.filter(agent(1), "p-0", []).passes
+    assert not r.filter(agent(3), "p-0", []).passes
+    r = parse_marathon_constraints('[["hostname", "UNLIKE", "host1"]]')
+    assert not r.filter(agent(1), "p-0", []).passes
+
+
+def test_json_round_trip():
+    rules = [
+        AndRule((HostnameRule(StringMatcher.regex("h.*")),
+                 OrRule((MaxPerZoneRule(2), NotRule(TaskTypeRule("x", "avoid")))))),
+        TpuSliceRule(slice_id="s0", topology="4x4x4"),
+        RoundRobinByHostnameRule(group_count=5),
+        parse_marathon_constraints('[["hostname", "UNIQUE"]]'),
+    ]
+    for r in rules:
+        back = rule_from_json(rule_to_json(r))
+        assert back == r, r

@@ -1,0 +1,343 @@
+"""Plan engine tests.
+
+Mirrors reference coverage in ``sdk/scheduler/src/test/.../plan/`` —
+``DefaultPlanCoordinatorTest``, ``DeploymentStepTest``, strategy tests,
+``ExponentialBackoffTest``.
+"""
+
+import pytest
+
+from dcos_commons_tpu.plan import (CanaryStrategy, DependencyStrategy,
+                                   DeploymentStep, ExponentialBackoff,
+                                   ParallelStrategy, Phase, Plan,
+                                   PlanCoordinator, PlanManager,
+                                   PodInstanceRequirement, SerialStrategy,
+                                   Status, build_deploy_plan,
+                                   build_plan_from_spec, strategy_for)
+from dcos_commons_tpu.specification import (PodInstance,
+                                            load_service_yaml_str)
+from dcos_commons_tpu.state import (MemPersister, StateStore, StoredTask,
+                                    TaskState, TaskStatus)
+from dcos_commons_tpu.specification import GoalState
+from dcos_commons_tpu.utils import make_task_id
+
+YML = """
+name: svc
+pods:
+  hello:
+    count: 2
+    tasks:
+      server: {goal: RUNNING, cmd: run, cpus: 0.1, memory: 32}
+  world:
+    count: 2
+    tasks:
+      server: {goal: RUNNING, cmd: run, cpus: 0.1, memory: 32}
+      init: {goal: ONCE, cmd: init, cpus: 0.1, memory: 32}
+"""
+
+SPEC = load_service_yaml_str(YML, {})
+TARGET = "cfg-1"
+
+
+def fresh_plan(**kw):
+    return build_deploy_plan(SPEC, StateStore(MemPersister()), TARGET, **kw)
+
+
+def launch(step, state_store=None):
+    """Simulate the matcher launching all tasks of a step; returns name->id."""
+    req = step.start()
+    assert req is not None
+    ids = {t: make_task_id(t) for t in req.task_instance_names()}
+    step.on_launch(ids)
+    return ids
+
+
+def run_all(step, ids, readiness=True):
+    for name, tid in ids.items():
+        task_spec_name = name.rsplit("-", 1)[-1]
+        spec_goal = None
+        state = TaskState.RUNNING
+        if task_spec_name == "init":
+            state = TaskState.FINISHED
+        step.update_status(TaskStatus.now(tid, state, readiness_passed=readiness))
+
+
+class TestDeployPlanShape:
+    def test_structure(self):
+        plan = fresh_plan()
+        assert [p.name for p in plan.phases] == ["hello", "world"]
+        assert [s.name for s in plan.phases[0].steps] == [
+            "hello-0:[server]", "hello-1:[server]"]
+        assert plan.status is Status.PENDING
+
+    def test_serial_ordering(self):
+        plan = fresh_plan()
+        cands = plan.candidates([])
+        assert [s.name for s in cands] == ["hello-0:[server]"]
+        ids = launch(cands[0], None)
+        assert plan.status is Status.IN_PROGRESS
+        # while hello-0 is STARTING, nothing else is a candidate (serial)
+        assert plan.candidates([]) == []
+        run_all(cands[0], ids)
+        assert cands[0].status is Status.COMPLETE
+        assert [s.name for s in plan.candidates([])] == ["hello-1:[server]"]
+
+    def test_full_deploy_to_complete(self):
+        plan = fresh_plan()
+        for _ in range(10):
+            cands = plan.candidates([])
+            if not cands:
+                break
+            for step in cands:
+                run_all(step, launch(step))
+        assert plan.status is Status.COMPLETE
+
+    def test_dirty_assets_excluded(self):
+        plan = fresh_plan()
+        assert plan.candidates(["hello-0"]) == []
+
+
+class TestStepStateMachine:
+    def make_step(self):
+        pod = SPEC.pod("world")
+        req = PodInstanceRequirement(PodInstance(pod, 0), ("server", "init"))
+        return DeploymentStep("world-0:[server,init]", req)
+
+    def test_multi_task_completion(self):
+        step = self.make_step()
+        ids = launch(step)
+        assert step.status is Status.STARTING
+        step.update_status(TaskStatus.now(ids["world-0-server"], TaskState.RUNNING))
+        # init not finished yet
+        assert step.status is not Status.COMPLETE
+        step.update_status(TaskStatus.now(ids["world-0-init"], TaskState.FINISHED))
+        assert step.status is Status.COMPLETE
+
+    def test_failure_returns_to_pending(self):
+        step = self.make_step()
+        ids = launch(step)
+        step.update_status(TaskStatus.now(ids["world-0-server"], TaskState.FAILED))
+        assert step.status is Status.PENDING
+
+    def test_running_goal_task_exit_is_not_complete(self):
+        pod = SPEC.pod("hello")
+        step = DeploymentStep(
+            "hello-0:[server]", PodInstanceRequirement(PodInstance(pod, 0), ("server",)))
+        ids = launch(step)
+        step.update_status(TaskStatus.now(ids["hello-0-server"], TaskState.FINISHED))
+        assert step.status is Status.PENDING
+
+    def test_unknown_task_id_ignored(self):
+        step = self.make_step()
+        launch(step)
+        before = step.status
+        step.update_status(TaskStatus.now(make_task_id("other-0-x"), TaskState.FAILED))
+        assert step.status is before
+
+    def test_force_complete_and_restart(self):
+        step = self.make_step()
+        step.force_complete()
+        assert step.status is Status.COMPLETE
+        step.restart()
+        assert step.status is Status.PENDING
+
+
+class TestReadiness:
+    YML_READY = """
+name: svc
+pods:
+  web:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: run
+        cpus: 0.1
+        memory: 32
+        readiness-check: {cmd: ./ready.sh}
+"""
+
+    def test_readiness_gates_complete(self):
+        spec = load_service_yaml_str(self.YML_READY, {})
+        plan = build_deploy_plan(spec, StateStore(MemPersister()), TARGET)
+        step = plan.steps[0]
+        ids = launch(step)
+        tid = ids["web-0-server"]
+        step.update_status(TaskStatus.now(tid, TaskState.RUNNING, readiness_passed=False))
+        assert step.status is Status.STARTED
+        step.update_status(TaskStatus.now(tid, TaskState.RUNNING, readiness_passed=True))
+        assert step.status is Status.COMPLETE
+
+
+class TestInitialStatusFromState:
+    def test_already_deployed_tasks_complete(self):
+        store = StateStore(MemPersister())
+        tid = make_task_id("hello-0-server")
+        store.store_tasks([StoredTask(
+            task_name="hello-0-server", task_id=tid, pod_type="hello", pod_index=0,
+            task_spec_name="server", resource_set_id="server-resources",
+            agent_id="a1", hostname="h1", target_config_id=TARGET,
+            goal=GoalState.RUNNING)])
+        store.store_status("hello-0-server", TaskStatus.now(tid, TaskState.RUNNING))
+        plan = build_deploy_plan(SPEC, store, TARGET)
+        assert plan.phases[0].steps[0].status is Status.COMPLETE
+        assert plan.phases[0].steps[1].status is Status.PENDING
+
+    def test_config_change_resets_running_tasks(self):
+        store = StateStore(MemPersister())
+        tid = make_task_id("hello-0-server")
+        store.store_tasks([StoredTask(
+            task_name="hello-0-server", task_id=tid, pod_type="hello", pod_index=0,
+            task_spec_name="server", resource_set_id="server-resources",
+            agent_id="a1", hostname="h1", target_config_id="old-cfg",
+            goal=GoalState.RUNNING)])
+        store.store_status("hello-0-server", TaskStatus.now(tid, TaskState.RUNNING))
+        plan = build_deploy_plan(SPEC, store, TARGET)
+        assert plan.phases[0].steps[0].status is Status.PENDING
+
+    def test_once_task_stays_complete_across_configs(self):
+        store = StateStore(MemPersister())
+        tid = make_task_id("world-0-init")
+        store.store_tasks([StoredTask(
+            task_name="world-0-init", task_id=tid, pod_type="world", pod_index=0,
+            task_spec_name="init", resource_set_id="init-resources",
+            agent_id="a1", hostname="h1", target_config_id="old-cfg",
+            goal=GoalState.ONCE)])
+        store.store_status("world-0-init", TaskStatus.now(tid, TaskState.FINISHED))
+        pod = SPEC.pod("world")
+        from dcos_commons_tpu.plan import has_reached_goal_state
+        assert has_reached_goal_state(store, TARGET, PodInstance(pod, 0), "init")
+        assert not has_reached_goal_state(store, TARGET, PodInstance(pod, 0), "server")
+
+
+class TestStrategies:
+    def test_parallel(self):
+        plan = fresh_plan()
+        plan.phases[0].strategy = ParallelStrategy()
+        cands = plan.candidates([])
+        assert [s.name for s in cands] == ["hello-0:[server]", "hello-1:[server]"]
+
+    def test_canary(self):
+        plan = fresh_plan()
+        phase = plan.phases[0]
+        phase.strategy = CanaryStrategy()
+        assert plan.candidates([]) == []
+        phase.strategy.proceed()
+        assert [s.name for s in plan.candidates([])] == ["hello-0:[server]"]
+        run_all(phase.steps[0], launch(phase.steps[0]))
+        # canary complete, but second proceed not yet given
+        assert plan.candidates([]) == []
+        phase.strategy.proceed()
+        assert [s.name for s in plan.candidates([])] == ["hello-1:[server]"]
+
+    def test_dependency(self):
+        plan = fresh_plan()
+        phase = plan.phases[0]
+        phase.strategy = DependencyStrategy(
+            {"hello-0:[server]": ["hello-1:[server]"]})
+        cands = plan.candidates([])
+        assert [s.name for s in cands] == ["hello-1:[server]"]
+        run_all(phase.steps[1], launch(phase.steps[1]))
+        assert [s.name for s in plan.candidates([])] == ["hello-0:[server]"]
+
+    def test_interrupt_proceed(self):
+        plan = fresh_plan()
+        plan.phases[0].interrupt()
+        assert plan.candidates([]) == []
+        assert plan.phases[0].status is Status.WAITING
+        plan.phases[0].proceed()
+        assert len(plan.candidates([])) == 1
+
+    def test_strategy_for(self):
+        assert isinstance(strategy_for("serial"), SerialStrategy)
+        assert isinstance(strategy_for("parallel"), ParallelStrategy)
+        assert isinstance(strategy_for("canary"), CanaryStrategy)
+        with pytest.raises(ValueError):
+            strategy_for("bogus")
+
+
+class TestCustomPlans:
+    YML_PLANS = """
+name: svc
+pods:
+  data:
+    count: 2
+    tasks:
+      bootstrap: {goal: ONCE, cmd: b, cpus: 0.1, memory: 32}
+      node: {goal: RUNNING, cmd: n, cpus: 0.1, memory: 32}
+plans:
+  deploy:
+    strategy: serial
+    phases:
+      data-phase:
+        pod: data
+        strategy: parallel
+        steps:
+          - [0, [bootstrap, node]]
+          - [1, [node]]
+"""
+
+    def test_yaml_plan_wins(self):
+        spec = load_service_yaml_str(self.YML_PLANS, {})
+        plan = build_deploy_plan(spec, StateStore(MemPersister()), TARGET)
+        phase = plan.phases[0]
+        assert phase.name == "data-phase"
+        assert [s.name for s in phase.steps] == [
+            "data-0:[bootstrap,node]", "data-1:[node]"]
+        assert len(plan.candidates([])) == 2  # parallel
+
+
+class TestCoordinator:
+    def test_priority_and_dirty_assets(self):
+        plan_a = fresh_plan()
+        plan_b = fresh_plan()
+        coord = PlanCoordinator([PlanManager(plan_a), PlanManager(plan_b)])
+        cands = coord.get_candidates()
+        # both plans want hello-0; only the first manager gets it
+        assert len(cands) == 1
+        assert cands[0] is plan_a.phases[0].steps[0]
+
+    def test_in_progress_asset_blocks_other_plan(self):
+        plan_a = fresh_plan()
+        plan_b = fresh_plan()
+        coord = PlanCoordinator([PlanManager(plan_a), PlanManager(plan_b)])
+        step_a = plan_a.phases[0].steps[0]
+        launch(step_a)  # hello-0 now STARTING in plan_a
+        cands = coord.get_candidates()
+        assert all(s.asset != "hello-0" for s in cands)
+
+
+class TestBackoff:
+    def test_exponential_growth_and_clear(self):
+        clock = [0.0]
+        b = ExponentialBackoff(initial_s=10, max_s=40, factor=2.0,
+                               clock=lambda: clock[0])
+        assert b.delay_remaining("t") == 0
+        b.on_launch("t")
+        assert b.delay_remaining("t") == pytest.approx(10)
+        clock[0] = 10
+        assert b.delay_remaining("t") == 0
+        b.on_launch("t")
+        assert b.delay_remaining("t") == pytest.approx(20)
+        b.on_launch("t")
+        b.on_launch("t")
+        assert b.delay_remaining("t") <= 40 + 1e-9
+        b.on_running("t")
+        assert b.delay_remaining("t") == 0
+
+    def test_delayed_step(self):
+        clock = [0.0]
+        b = ExponentialBackoff(initial_s=10, max_s=40, factor=2.0,
+                               clock=lambda: clock[0])
+        pod = SPEC.pod("hello")
+        step = DeploymentStep(
+            "hello-0:[server]",
+            PodInstanceRequirement(PodInstance(pod, 0), ("server",)), backoff=b)
+        ids = launch(step)
+        step.update_status(TaskStatus.now(ids["hello-0-server"], TaskState.FAILED))
+        assert step.status is Status.PENDING
+        assert step.start() is None  # backoff active
+        assert step.status is Status.DELAYED
+        clock[0] = 11
+        assert step.start() is not None
+        assert step.status is Status.PENDING

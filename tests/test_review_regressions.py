@@ -1,0 +1,104 @@
+"""Regression tests for code-review findings (round 1, milestone 1)."""
+
+import pytest
+
+from dcos_commons_tpu.matching import parse_marathon_constraints
+from dcos_commons_tpu.specification import ServiceSpec, load_service_yaml_str, taskcfg_env
+from dcos_commons_tpu.state import FilePersister, MemPersister, PersisterError
+
+
+def test_plan_step_json_round_trip_is_structurally_equal():
+    yml = """
+name: s
+pods:
+  p:
+    count: 1
+    tasks:
+      t: {goal: RUNNING, cmd: x, cpus: 0.1, memory: 32}
+plans:
+  deploy:
+    phases:
+      ph:
+        pod: p
+        steps:
+          - [0, [t]]
+"""
+    spec = load_service_yaml_str(yml, {})
+    back = ServiceSpec.from_json(spec.to_json())
+    assert back == spec
+    assert hash(back.plans[0].phases[0].steps[0]) == hash(spec.plans[0].phases[0].steps[0])
+
+
+def test_file_persister_refuses_root_delete(tmp_path):
+    p = FilePersister(str(tmp_path / "s"))
+    p.set("a", b"1")
+    with pytest.raises(PersisterError, match="refusing to delete root"):
+        p.recursive_delete("")
+    with pytest.raises(PersisterError, match="refusing to delete root"):
+        p.recursive_delete("/")
+    assert p.get("a") == b"1"
+
+
+@pytest.mark.parametrize("engine", [MemPersister, None])
+def test_dot_paths_rejected_everywhere(engine, tmp_path):
+    p = engine() if engine else FilePersister(str(tmp_path / "s"))
+    with pytest.raises(PersisterError):
+        p.set("foo/.bar", b"v")
+    with pytest.raises(PersisterError):
+        p.set("..", b"v")
+
+
+def test_missing_config_template_raises():
+    yml = """
+name: s
+pods:
+  p:
+    count: 1
+    tasks:
+      t:
+        goal: RUNNING
+        cmd: x
+        cpus: 0.1
+        memory: 32
+        configs:
+          app: {template: does-not-exist.mustache, dest: app.cfg}
+"""
+    with pytest.raises(ValueError, match="template not readable"):
+        load_service_yaml_str(yml, {}, base_dir="/tmp")
+
+
+def test_inline_config_content_allowed():
+    yml = """
+name: s
+pods:
+  p:
+    count: 1
+    tasks:
+      t:
+        goal: RUNNING
+        cmd: x
+        cpus: 0.1
+        memory: 32
+        configs:
+          app: {content: "key={{VALUE}}", dest: app.cfg}
+"""
+    # the svc.yml itself is strictly rendered first, so inline content sees
+    # the scheduler env; task-time placeholders belong in template files
+    spec = load_service_yaml_str(yml, {"VALUE": "v1"})
+    assert spec.pod("p").task("t").configs[0].template == "key=v1"
+
+
+def test_taskcfg_all_prefixed_pod_name():
+    env = {"TASKCFG_ALL_NODES_FOO": "1", "TASKCFG_ALL_COMMON": "c"}
+    # pod 'all-nodes': pod-specific prefix TASKCFG_ALL_NODES_ wins for it
+    assert taskcfg_env(env, "all-nodes") == {"FOO": "1", "COMMON": "c",
+                                             "NODES_FOO": "1"}
+    # other pods see it as a global NODES_FOO (ambiguity documented)
+    assert taskcfg_env(env, "hello") == {"NODES_FOO": "1", "COMMON": "c"}
+
+
+def test_marathon_like_without_value_fails_at_parse():
+    with pytest.raises(ValueError, match="requires a value"):
+        parse_marathon_constraints('[["hostname", "LIKE"]]')
+    with pytest.raises(ValueError, match="requires a value"):
+        parse_marathon_constraints('[["zone", "MAX_PER"]]')

@@ -1,0 +1,160 @@
+"""Spec model + YAML loader tests.
+
+Mirrors the reference's ``specification`` unit tests
+(``sdk/scheduler/src/test/.../specification/``): YAML parse, resource-set
+synthesis, env routing, validation, JSON round-trip.
+"""
+
+import pytest
+
+from dcos_commons_tpu.specification import (GoalState, PodInstance, ServiceSpec,
+                                            TpuSpec, VolumeType,
+                                            load_service_yaml_str, taskcfg_env)
+
+SIMPLE_YML = """
+name: {{FRAMEWORK_NAME}}
+pods:
+  hello:
+    count: {{HELLO_COUNT}}
+    placement: '[["hostname", "UNIQUE"]]'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "echo hello && sleep 1000"
+        cpus: {{HELLO_CPUS}}
+        memory: 256
+        ports:
+          http: {port: 0, vip: web}
+        volumes:
+          - {path: hello-container-path, size: 1024, type: ROOT}
+        env:
+          SLEEP_DURATION: "1000"
+"""
+
+ENV = {"FRAMEWORK_NAME": "hello-world", "HELLO_COUNT": "2", "HELLO_CPUS": "0.5"}
+
+
+def test_yaml_basic():
+    spec = load_service_yaml_str(SIMPLE_YML, ENV)
+    assert spec.name == "hello-world"
+    pod = spec.pod("hello")
+    assert pod.count == 2
+    assert pod.placement_rule is not None
+    task = pod.task("server")
+    assert task.goal is GoalState.RUNNING
+    assert task.env["SLEEP_DURATION"] == "1000"
+    # inline resources synthesized into a resource set
+    rs = pod.resource_set(task.resource_set_id)
+    assert rs.cpus == 0.5
+    assert rs.memory_mb == 256
+    assert rs.ports[0].name == "http" and rs.ports[0].vip == "web"
+    assert rs.volumes[0].size_mb == 1024
+    assert rs.volumes[0].type is VolumeType.ROOT
+
+
+def test_json_round_trip():
+    spec = load_service_yaml_str(SIMPLE_YML, ENV)
+    back = ServiceSpec.from_json(spec.to_json())
+    assert back == spec
+    assert back.to_json() == spec.to_json()
+
+
+def test_taskcfg_routing():
+    env = dict(ENV)
+    env["TASKCFG_ALL_COMMON"] = "everyone"
+    env["TASKCFG_HELLO_ONLY_HELLO"] = "just-hello"
+    env["TASKCFG_WORLD_ONLY_WORLD"] = "just-world"
+    spec = load_service_yaml_str(SIMPLE_YML, env)
+    task_env = spec.pod("hello").task("server").env
+    assert task_env["COMMON"] == "everyone"
+    assert task_env["ONLY_HELLO"] == "just-hello"
+    assert "ONLY_WORLD" not in task_env
+    routed = taskcfg_env(env, "world")
+    assert routed == {"COMMON": "everyone", "ONLY_WORLD": "just-world"}
+
+
+def test_validation_rejects_bad_count():
+    bad = SIMPLE_YML.replace("count: {{HELLO_COUNT}}", "count: 0")
+    with pytest.raises(ValueError, match="count must be >= 1"):
+        load_service_yaml_str(bad, ENV)
+
+
+def test_validation_rejects_empty_cmd():
+    bad = SIMPLE_YML.replace('cmd: "echo hello && sleep 1000"', 'cmd: ""')
+    with pytest.raises(ValueError, match="empty cmd"):
+        load_service_yaml_str(bad, ENV)
+
+
+TPU_YML = """
+name: jax-svc
+pods:
+  worker:
+    count: 4
+    tpu:
+      chips: 4
+      topology: v4-32
+    resource-sets:
+      worker-resources:
+        cpus: 8
+        memory: 16384
+        tpus: 4
+    tasks:
+      train:
+        goal: RUNNING
+        cmd: python train.py
+        resource-set: worker-resources
+"""
+
+
+def test_tpu_pod():
+    spec = load_service_yaml_str(TPU_YML, {})
+    pod = spec.pod("worker")
+    assert pod.tpu == TpuSpec(chips=4, topology="v4-32", gang=True)
+    assert pod.resource_set("worker-resources").tpus == 4
+    back = ServiceSpec.from_json(spec.to_json())
+    assert back.pod("worker").tpu == pod.tpu
+
+
+def test_tpu_inferred_from_resource_set():
+    yml = TPU_YML.replace("    tpu:\n      chips: 4\n      topology: v4-32\n", "")
+    spec = load_service_yaml_str(yml, {})
+    assert spec.pod("worker").tpu == TpuSpec(chips=4, topology=None, gang=True)
+
+
+PLANS_YML = """
+name: plan-svc
+pods:
+  data:
+    count: 2
+    tasks:
+      bootstrap: {goal: ONCE, cmd: ./bootstrap, cpus: 0.1, memory: 32}
+      node: {goal: RUNNING, cmd: ./node, cpus: 1, memory: 1024}
+plans:
+  deploy:
+    strategy: serial
+    phases:
+      data-phase:
+        pod: data
+        strategy: parallel
+        steps:
+          - [0, [bootstrap, node]]
+          - [1, [node]]
+"""
+
+
+def test_custom_plan_parse():
+    spec = load_service_yaml_str(PLANS_YML, {})
+    plan = spec.plan("deploy")
+    assert plan is not None and plan.strategy == "serial"
+    phase = plan.phases[0]
+    assert phase.pod_type == "data" and phase.strategy == "parallel"
+    assert phase.steps[0].pod_instance == 0
+    assert phase.steps[0].tasks == ("bootstrap", "node")
+    assert phase.steps[1].tasks == ("node",)
+
+
+def test_pod_instance_names():
+    spec = load_service_yaml_str(SIMPLE_YML, ENV)
+    inst = PodInstance(spec.pod("hello"), 1)
+    assert inst.name == "hello-1"
+    assert inst.task_instance_name("server") == "hello-1-server"

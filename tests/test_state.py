@@ -1,0 +1,213 @@
+"""State layer tests (reference ``state/*Test``, ``storage/*Test``,
+``curator/CuratorPersisterTest`` behaviors)."""
+
+import pytest
+
+from dcos_commons_tpu.specification import GoalState, load_service_yaml_str
+from dcos_commons_tpu.state import (CachingPersister, ConfigStore, FilePersister,
+                                    FrameworkStore, GoalOverride, MemPersister,
+                                    NotFoundError, OverrideProgress,
+                                    SchemaVersionStore, StateStore,
+                                    StateStoreError, StoredTask, TaskState,
+                                    TaskStatus, TpuAssignment)
+from dcos_commons_tpu.utils import make_task_id
+
+YML = """
+name: svc
+pods:
+  hello:
+    count: 1
+    tasks:
+      server: {goal: RUNNING, cmd: run, cpus: 0.1, memory: 32}
+"""
+
+
+def stored_task(name="hello-0-server", task_id=None, **kw):
+    defaults = dict(
+        task_name=name, task_id=task_id or make_task_id(name), pod_type="hello",
+        pod_index=0, task_spec_name="server", resource_set_id="server-resources",
+        agent_id="a1", hostname="host1", target_config_id="cfg-1",
+        goal=GoalState.RUNNING)
+    defaults.update(kw)
+    return StoredTask(**defaults)
+
+
+@pytest.fixture(params=["mem", "file", "cached-file"])
+def persister(request, tmp_path):
+    if request.param == "mem":
+        return MemPersister()
+    if request.param == "file":
+        return FilePersister(str(tmp_path / "state"))
+    return CachingPersister(FilePersister(str(tmp_path / "state")))
+
+
+class TestPersister:
+    def test_get_set(self, persister):
+        persister.set("a/b/c", b"v1")
+        assert persister.get("a/b/c") == b"v1"
+        persister.set("a/b/c", b"v2")
+        assert persister.get("a/b/c") == b"v2"
+
+    def test_missing_raises(self, persister):
+        with pytest.raises(NotFoundError):
+            persister.get("nope")
+
+    def test_children(self, persister):
+        persister.set("a/x", b"1")
+        persister.set("a/y", b"2")
+        persister.set("a/y/z", b"3")
+        assert persister.get_children("a") == ["x", "y"]
+        assert persister.get_children("a/y") == ["z"]
+        with pytest.raises(NotFoundError):
+            persister.get_children("missing")
+
+    def test_recursive_delete(self, persister):
+        persister.set("a/b/c", b"1")
+        persister.set("a/b2", b"2")
+        persister.recursive_delete("a/b")
+        with pytest.raises(NotFoundError):
+            persister.get("a/b/c")
+        assert persister.get("a/b2") == b"2"
+
+    def test_set_many_with_delete(self, persister):
+        persister.set("x", b"old")
+        persister.set("y", b"keep")
+        persister.set_many({"x": None, "z/deep": b"new"})
+        assert persister.get_or_none("x") is None
+        assert persister.get("y") == b"keep"
+        assert persister.get("z/deep") == b"new"
+
+    def test_recursive_paths(self, persister):
+        persister.set("a/b", b"1")
+        persister.set("c", b"2")
+        assert set(persister.recursive_paths()) == {"a", "a/b", "c"}
+
+
+def test_file_persister_survives_reopen(tmp_path):
+    root = str(tmp_path / "state")
+    p = FilePersister(root)
+    p.set("Tasks/t1/TaskInfo", b"payload")
+    p.set_many({"Properties/k": b"v"})
+    p2 = FilePersister(root)
+    assert p2.get("Tasks/t1/TaskInfo") == b"payload"
+    assert p2.get("Properties/k") == b"v"
+
+
+def test_file_persister_discards_torn_journal(tmp_path):
+    root = str(tmp_path / "state")
+    p = FilePersister(root)
+    p.set("k", b"committed")
+    (tmp_path / "state" / FilePersister.JOURNAL).write_bytes(b'{"k": "6465')  # torn
+    p2 = FilePersister(root)
+    assert p2.get("k") == b"committed"
+
+
+def test_caching_persister_preloads(tmp_path):
+    root = str(tmp_path / "state")
+    backing = FilePersister(root)
+    backing.set("a/b", b"v")
+    cached = CachingPersister(FilePersister(root))
+    assert cached.get("a/b") == b"v"
+    cached.set("a/c", b"w")
+    assert FilePersister(root).get("a/c") == b"w"
+
+
+class TestStateStore:
+    def test_task_round_trip(self):
+        store = StateStore(MemPersister())
+        t = stored_task(tpu=TpuAssignment(
+            process_id=0, num_processes=4, coordinator_address="host1:8476",
+            chips=4, slice_id="s0", topology="v4-32", worker_coords=(0, 0, 0)))
+        store.store_tasks([t])
+        assert store.fetch_task("hello-0-server") == t
+        assert store.fetch_task_names() == ["hello-0-server"]
+        assert store.fetch_tasks() == [t]
+
+    def test_status_requires_matching_id(self):
+        store = StateStore(MemPersister())
+        t = stored_task()
+        store.store_tasks([t])
+        good = TaskStatus.now(t.task_id, TaskState.RUNNING)
+        store.store_status(t.task_name, good)
+        assert store.fetch_status(t.task_name).state is TaskState.RUNNING
+        stale = TaskStatus.now(make_task_id(t.task_name), TaskState.FAILED)
+        with pytest.raises(StateStoreError):
+            store.store_status(t.task_name, stale)
+
+    def test_overrides(self):
+        store = StateStore(MemPersister())
+        assert store.fetch_override("x") == (GoalOverride.NONE, OverrideProgress.COMPLETE)
+        store.store_override("x", GoalOverride.PAUSED, OverrideProgress.PENDING)
+        assert store.fetch_override("x") == (GoalOverride.PAUSED, OverrideProgress.PENDING)
+
+    def test_properties_and_deploy_marker(self):
+        store = StateStore(MemPersister())
+        store.store_property("k", b"v")
+        assert store.fetch_property("k") == b"v"
+        assert store.fetch_property_keys() == ["k"]
+        assert not store.deploy_completed()
+        store.set_deploy_completed()
+        assert store.deploy_completed()
+        store.clear_property("k")
+        assert store.fetch_property("k") is None
+
+    def test_namespacing(self):
+        p = MemPersister()
+        s1, s2 = StateStore(p, "svc1"), StateStore(p, "svc2")
+        s1.store_tasks([stored_task()])
+        assert s2.fetch_task_names() == []
+        assert s1.fetch_task_names() == ["hello-0-server"]
+
+    def test_delete_task(self):
+        store = StateStore(MemPersister())
+        t = stored_task()
+        store.store_tasks([t])
+        store.store_status(t.task_name, TaskStatus.now(t.task_id, TaskState.RUNNING))
+        store.delete_task(t.task_name)
+        assert store.fetch_task(t.task_name) is None
+        assert store.fetch_status(t.task_name) is None
+
+
+class TestConfigStore:
+    def test_target_lifecycle(self):
+        spec = load_service_yaml_str(YML, {})
+        cs = ConfigStore(MemPersister())
+        assert cs.get_target() is None
+        cid = cs.store(spec)
+        cs.set_target(cid)
+        assert cs.get_target() == cid
+        assert cs.fetch_target_spec() == spec
+
+    def test_target_must_exist(self):
+        cs = ConfigStore(MemPersister())
+        with pytest.raises(StateStoreError):
+            cs.set_target("nope")
+
+    def test_prune(self):
+        spec = load_service_yaml_str(YML, {})
+        cs = ConfigStore(MemPersister())
+        old = cs.store(spec)
+        target = cs.store(spec)
+        in_use = cs.store(spec)
+        cs.set_target(target)
+        removed = cs.prune(in_use=[in_use])
+        assert removed == [old]
+        assert set(cs.list_ids()) == {target, in_use}
+
+
+def test_framework_store():
+    fs = FrameworkStore(MemPersister())
+    assert fs.fetch_framework_id() is None
+    fs.store_framework_id("fw-123")
+    assert fs.fetch_framework_id() == "fw-123"
+    fs.clear()
+    assert fs.fetch_framework_id() is None
+
+
+def test_schema_version_gate():
+    p = MemPersister()
+    SchemaVersionStore(p).check()  # writes current
+    SchemaVersionStore(p).check()  # idempotent
+    p.set(SchemaVersionStore.PATH, b"99")
+    with pytest.raises(StateStoreError, match="schema version 99"):
+        SchemaVersionStore(p).check()

@@ -1,0 +1,50 @@
+import pytest
+
+from dcos_commons_tpu.utils.template import TemplateError, render_template
+
+
+def test_simple_substitution():
+    assert render_template("hello {{WHO}}!", {"WHO": "world"}) == "hello world!"
+
+
+def test_missing_strict_raises():
+    with pytest.raises(TemplateError, match="missing template value: WHO"):
+        render_template("hello {{WHO}}", {})
+
+
+def test_missing_lenient_empty():
+    assert render_template("hello {{WHO}}!", {}, strict=False) == "hello !"
+
+
+def test_section_truthy():
+    tpl = "{{#FLAG}}on={{V}}{{/FLAG}}{{^FLAG}}off{{/FLAG}}"
+    assert render_template(tpl, {"FLAG": "true", "V": "1"}) == "on=1"
+    assert render_template(tpl, {"FLAG": "false"}) == "off"
+    assert render_template(tpl, {}) == "off"
+    assert render_template(tpl, {"FLAG": ""}) == "off"
+
+
+def test_nested_sections():
+    tpl = "{{#A}}a{{#B}}b{{/B}}{{/A}}"
+    assert render_template(tpl, {"A": "1", "B": "1"}) == "ab"
+    assert render_template(tpl, {"A": "1"}) == "a"
+    assert render_template(tpl, {"B": "1"}) == ""
+
+
+def test_suppressed_section_missing_values_ok():
+    # values inside a suppressed section must not trigger strict errors
+    assert render_template("{{#A}}{{MISSING}}{{/A}}", {}) == ""
+
+
+def test_unclosed_section():
+    with pytest.raises(TemplateError, match="unclosed"):
+        render_template("{{#A}}body", {"A": "1"})
+
+
+def test_mismatched_close():
+    with pytest.raises(TemplateError, match="unexpected"):
+        render_template("{{#A}}{{/B}}", {"A": "1"})
+
+
+def test_whitespace_in_tags():
+    assert render_template("{{ KEY }}", {"KEY": "v"}) == "v"
