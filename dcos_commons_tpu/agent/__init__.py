@@ -1,1 +1,3 @@
+from .client import AgentClient, StatusCallback
+from .fake import FakeCluster, FakeTask, TaskBehavior
 from .inventory import AgentInfo, PortRange, TaskRecord, TpuInventory

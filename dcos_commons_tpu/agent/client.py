@@ -1,0 +1,41 @@
+"""Scheduler-side agent transport interface.
+
+Reference: the Mesos scheduler driver boundary
+(``framework/SchedulerDriverFactory.java:27`` — C++ JNI libmesos or V1 HTTP)
+collapsed to the three verbs this SDK actually needs once the offer market
+is gone: launch, kill, reconcile. Implementations:
+
+* :class:`~dcos_commons_tpu.agent.fake.FakeCluster` — in-process agents for
+  tests/simulation (tier-2 harness, reference ``sdk/testing``).
+* the C++ agent daemon speaking gRPC (``native/``), wrapped by a Python
+  client with the same interface.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Protocol, Sequence
+
+from ..matching.evaluator import LaunchPlan
+from ..state.tasks import TaskStatus
+from .inventory import AgentInfo
+
+StatusCallback = Callable[[str, TaskStatus], None]  # (task_name, status)
+
+
+class AgentClient(Protocol):
+    def agents(self) -> Sequence[AgentInfo]:
+        """Current inventory of registered, healthy agents."""
+
+    def launch(self, plan: LaunchPlan) -> None:
+        """Start the plan's tasks on its agent. Must be preceded by the
+        launch WAL write (StoredTasks + reservations)."""
+
+    def kill(self, agent_id: str, task_id: str, grace_period_s: float = 0.0) -> None:
+        """Kill one task; a terminal status will be delivered."""
+
+    def running_task_ids(self, agent_id: str) -> Sequence[str]:
+        """Explicit reconciliation: what is actually running on the agent
+        (reference ``ExplicitReconciler``/``ImplicitReconciler``)."""
+
+    def set_status_callback(self, callback: StatusCallback) -> None:
+        """Register the scheduler's status-update sink."""

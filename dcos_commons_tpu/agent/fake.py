@@ -1,0 +1,156 @@
+"""Fake in-process cluster — the simulation backend.
+
+Reference: the tier-2 test harness substrate (``sdk/testing`` mocks the
+SchedulerDriver and synthesizes offers/statuses). Our fake cluster plays the
+*agent* side of the AgentClient protocol: tasks "launch" instantly and emit
+scripted status sequences, so a whole service (plans, matcher, recovery,
+state) runs end-to-end in-process with no hardware and no sleeps.
+
+Behavior modes per task (set via ``script``):
+* AUTO_RUN (default): STAGING -> RUNNING (readiness passed) immediately.
+* AUTO_FINISH: STAGING -> RUNNING -> FINISHED (for ONCE/FINISH tasks the
+  mode is chosen automatically from the launch's goal).
+* MANUAL: emit nothing; the test drives statuses via ``send_status``.
+* CRASH: STAGING -> FAILED (crash-loop simulation).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..matching.evaluator import LaunchPlan, TaskLaunch
+from ..state.tasks import TaskState, TaskStatus
+from .client import StatusCallback
+from .inventory import AgentInfo
+
+
+class TaskBehavior(enum.Enum):
+    AUTO_RUN = "auto-run"
+    AUTO_FINISH = "auto-finish"
+    MANUAL = "manual"
+    CRASH = "crash"
+
+
+@dataclass
+class FakeTask:
+    launch: TaskLaunch
+    agent_id: str
+    state: TaskState = TaskState.STAGING
+
+    @property
+    def task_id(self) -> str:
+        return self.launch.task_id
+
+    @property
+    def task_name(self) -> str:
+        return self.launch.task_name
+
+
+class FakeCluster:
+    """Implements :class:`~dcos_commons_tpu.agent.client.AgentClient`."""
+
+    def __init__(self, agents: Sequence[AgentInfo]):
+        self._agents: Dict[str, AgentInfo] = {a.agent_id: a for a in agents}
+        self._tasks: Dict[str, FakeTask] = {}  # task_id -> FakeTask
+        self._callback: Optional[StatusCallback] = None
+        # task_spec_name or task_name -> behavior override
+        self._script: Dict[str, TaskBehavior] = {}
+        self._launch_log: List[LaunchPlan] = []
+        self._kill_log: List[str] = []
+
+    # -- test scripting ----------------------------------------------------
+
+    def script(self, task_name: str, behavior: TaskBehavior) -> None:
+        """Override behavior for a task (matched by full instance name first,
+        then by spec-level task name)."""
+        self._script[task_name] = behavior
+
+    @property
+    def launch_log(self) -> List[LaunchPlan]:
+        return self._launch_log
+
+    @property
+    def kill_log(self) -> List[str]:
+        return self._kill_log
+
+    def add_agent(self, agent: AgentInfo) -> None:
+        self._agents[agent.agent_id] = agent
+
+    def remove_agent(self, agent_id: str) -> List[FakeTask]:
+        """Simulate host loss: agent gone, its tasks implicitly dead (no
+        status is emitted — the scheduler must detect via reconciliation,
+        like a Mesos agent partition)."""
+        self._agents.pop(agent_id, None)
+        lost = [t for t in self._tasks.values() if t.agent_id == agent_id]
+        for t in lost:
+            del self._tasks[t.task_id]
+        return lost
+
+    def task(self, task_name: str) -> Optional[FakeTask]:
+        for t in self._tasks.values():
+            if t.task_name == task_name:
+                return t
+        return None
+
+    def send_status(self, task_id: str, state: TaskState, message: str = "",
+                    readiness_passed: bool = False) -> None:
+        task = self._tasks.get(task_id)
+        task_name = task.task_name if task else task_id.rsplit("__", 1)[0]
+        if task is not None:
+            task.state = state
+            if state.terminal:
+                del self._tasks[task_id]
+        if self._callback is not None:
+            self._callback(task_name, TaskStatus.now(
+                task_id, state, message=message,
+                readiness_passed=readiness_passed,
+                agent_id=task.agent_id if task else None))
+
+    # -- AgentClient -------------------------------------------------------
+
+    def agents(self) -> Sequence[AgentInfo]:
+        return list(self._agents.values())
+
+    def set_status_callback(self, callback: StatusCallback) -> None:
+        self._callback = callback
+
+    def launch(self, plan: LaunchPlan) -> None:
+        if plan.agent.agent_id not in self._agents:
+            raise RuntimeError(f"launch on unknown agent {plan.agent.agent_id}")
+        self._launch_log.append(plan)
+        for launch in plan.launches:
+            task = FakeTask(launch=launch, agent_id=plan.agent.agent_id)
+            self._tasks[launch.task_id] = task
+            behavior = self._behavior(launch)
+            self.send_status(launch.task_id, TaskState.STAGING)
+            if behavior is TaskBehavior.MANUAL:
+                continue
+            if behavior is TaskBehavior.CRASH:
+                self.send_status(launch.task_id, TaskState.FAILED, message="crash")
+            elif behavior is TaskBehavior.AUTO_FINISH:
+                self.send_status(launch.task_id, TaskState.RUNNING)
+                self.send_status(launch.task_id, TaskState.FINISHED)
+            else:
+                self.send_status(launch.task_id, TaskState.RUNNING,
+                                 readiness_passed=True)
+
+    def _behavior(self, launch: TaskLaunch) -> TaskBehavior:
+        if launch.task_name in self._script:
+            return self._script[launch.task_name]
+        if launch.task_spec_name in self._script:
+            return self._script[launch.task_spec_name]
+        if launch.goal in ("FINISH", "ONCE"):
+            return TaskBehavior.AUTO_FINISH
+        return TaskBehavior.AUTO_RUN
+
+    def kill(self, agent_id: str, task_id: str, grace_period_s: float = 0.0) -> None:
+        self._kill_log.append(task_id)
+        if task_id in self._tasks:
+            self.send_status(task_id, TaskState.KILLED, message="killed by scheduler")
+        # unknown task: nothing to do; scheduler already considers it dead
+
+    def running_task_ids(self, agent_id: str) -> Sequence[str]:
+        return [t.task_id for t in self._tasks.values()
+                if t.agent_id == agent_id and not t.state.terminal]

@@ -68,6 +68,7 @@ class TaskRecord:
     hostname: str
     zone: Optional[str] = None
     region: Optional[str] = None
+    permanently_failed: bool = False  # reference FailureUtils label
 
     @property
     def pod_instance_name(self) -> str:

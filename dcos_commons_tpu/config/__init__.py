@@ -1,0 +1,4 @@
+from .updater import (DEFAULT_VALIDATORS, ConfigurationUpdater, UpdateResult,
+                      pods_cannot_shrink, service_name_cannot_change,
+                      tpu_cannot_change, user_cannot_change,
+                      volumes_cannot_change)

@@ -1,0 +1,179 @@
+"""Versioned configuration rollout.
+
+Reference: ``config/DefaultConfigurationUpdater.java`` +
+``config/validate/`` (19 validators) wired at
+``scheduler/SchedulerBuilder.java:469-511``: serialize the candidate spec,
+diff against the current target, run validators; on error KEEP the old
+target and surface the errors (deploy blocked, service keeps running);
+otherwise store the candidate as the new target UUID and prune unused
+configs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+from ..specification.spec import PodSpec, ServiceSpec
+from ..state.state_store import ConfigStore, StateStore
+
+# validator: (old_spec or None, new_spec) -> error strings
+ConfigValidator = Callable[[Optional[ServiceSpec], ServiceSpec], List[str]]
+
+
+@dataclass(frozen=True)
+class UpdateResult:
+    target_id: str
+    errors: tuple[str, ...] = ()
+
+    @property
+    def accepted(self) -> bool:
+        return not self.errors
+
+
+def _pods_by_type(spec: Optional[ServiceSpec]) -> dict[str, PodSpec]:
+    return {p.type: p for p in spec.pods} if spec else {}
+
+
+# --------------------------------------------------------------------------
+# validators (reference config/validate/)
+
+def service_name_cannot_change(old, new):
+    """Reference ``ServiceNameCannotBreakDNS`` (rename breaks discovery)."""
+    if old is not None and old.name != new.name:
+        return [f"service name cannot change: {old.name!r} -> {new.name!r}"]
+    return []
+
+
+def user_cannot_change(old, new):
+    """Reference ``UserCannotChange``."""
+    errs = []
+    if old is not None and old.user != new.user:
+        errs.append(f"service user cannot change: {old.user!r} -> {new.user!r}")
+    old_pods = _pods_by_type(old)
+    for pod in new.pods:
+        prev = old_pods.get(pod.type)
+        if prev is not None and prev.user != pod.user:
+            errs.append(f"pod {pod.type}: user cannot change "
+                        f"({prev.user!r} -> {pod.user!r})")
+    return errs
+
+
+def pods_cannot_shrink(old, new):
+    """Reference ``PodSpecsCannotShrink``: removing pods or lowering count is
+    only allowed for pods that opted into decommissioning."""
+    errs = []
+    new_pods = _pods_by_type(new)
+    for pod_type, prev in _pods_by_type(old).items():
+        cur = new_pods.get(pod_type)
+        if cur is None:
+            if not prev.allow_decommission:
+                errs.append(f"pod {pod_type} cannot be removed "
+                            f"(allow-decommission is false)")
+        elif cur.count < prev.count and not prev.allow_decommission:
+            errs.append(f"pod {pod_type}: count cannot shrink {prev.count} -> "
+                        f"{cur.count} (allow-decommission is false)")
+    return errs
+
+
+def volumes_cannot_change(old, new):
+    """Reference ``TaskVolumesCannotChange`` — volumes pin data to agents."""
+    errs = []
+    old_pods = _pods_by_type(old)
+    for pod in new.pods:
+        prev = old_pods.get(pod.type)
+        if prev is None:
+            continue
+        prev_rs = {r.id: r for r in prev.resource_sets}
+        for rs in pod.resource_sets:
+            p = prev_rs.get(rs.id)
+            if p is not None and p.volumes != rs.volumes:
+                errs.append(f"pod {pod.type}/resource-set {rs.id}: volumes "
+                            f"cannot change")
+    return errs
+
+
+def tpu_cannot_change(old, new):
+    """TPU-native: slice topology/chip requests reshape the gang; changing
+    them in place would break stable process ids — require replace-style
+    redeploy via a new service (the reference's closest analogues are
+    ``PreReservationCannotChange``/``RegionCannotChange``)."""
+    errs = []
+    old_pods = _pods_by_type(old)
+    for pod in new.pods:
+        prev = old_pods.get(pod.type)
+        if prev is not None and prev.tpu != pod.tpu:
+            errs.append(f"pod {pod.type}: tpu request cannot change "
+                        f"({prev.tpu} -> {pod.tpu})")
+    return errs
+
+
+DEFAULT_VALIDATORS: tuple[ConfigValidator, ...] = (
+    service_name_cannot_change,
+    user_cannot_change,
+    pods_cannot_shrink,
+    volumes_cannot_change,
+    tpu_cannot_change,
+)
+
+
+class ConfigurationUpdater:
+    """Reference ``DefaultConfigurationUpdater.updateConfiguration``."""
+
+    def __init__(self, config_store: ConfigStore, state_store: StateStore,
+                 validators: Sequence[ConfigValidator] = DEFAULT_VALIDATORS):
+        self._configs = config_store
+        self._state = state_store
+        self._validators = list(validators)
+
+    def update(self, candidate: ServiceSpec) -> UpdateResult:
+        old_id = self._configs.get_target()
+        old_spec = self._configs.fetch(old_id) if old_id else None
+
+        errors: List[str] = []
+        for validate in self._validators:
+            errors.extend(validate(old_spec, candidate))
+
+        if errors:
+            if old_id is None:
+                # no previous target to fall back to: hard failure
+                raise ValueError("initial config invalid:\n  " + "\n  ".join(errors))
+            # keep old target; deployment continues on the previous config
+            # (reference SchedulerBuilder.java:479-492)
+            return UpdateResult(target_id=old_id, errors=tuple(errors))
+
+        if old_spec is not None and old_spec == candidate:
+            return UpdateResult(target_id=old_id)
+
+        new_id = self._configs.store(candidate)
+        self._configs.set_target(new_id)
+        self._relabel_unchanged_tasks(candidate, new_id)
+        in_use = {t.target_config_id for t in self._state.fetch_tasks()}
+        self._configs.prune(in_use)
+        return UpdateResult(target_id=new_id)
+
+    def _relabel_unchanged_tasks(self, new_spec: ServiceSpec, new_id: str) -> None:
+        """Tasks whose pod spec is identical between their stored config and
+        the new target get their config label rewritten instead of relaunched
+        (reference ``DefaultConfigurationUpdater`` unchanged-task relabel;
+        consumed by ``DefaultStepFactory.hasReachedGoalState``)."""
+        from dataclasses import replace as dc_replace
+        new_pods = _pods_by_type(new_spec)
+        spec_cache: dict[str, Optional[ServiceSpec]] = {}
+        for task in self._state.fetch_tasks():
+            if task.target_config_id == new_id:
+                continue
+            if task.target_config_id not in spec_cache:
+                try:
+                    spec_cache[task.target_config_id] = self._configs.fetch(
+                        task.target_config_id)
+                except Exception:
+                    spec_cache[task.target_config_id] = None
+            task_spec = spec_cache[task.target_config_id]
+            if task_spec is None:
+                continue
+            old_pod = _pods_by_type(task_spec).get(task.pod_type)
+            new_pod = new_pods.get(task.pod_type)
+            if old_pod is not None and old_pod == new_pod:
+                self._state.store_tasks(
+                    [dc_replace(task, target_config_id=new_id)])

@@ -1,3 +1,7 @@
+from .evaluator import (Evaluator, LaunchPlan, TaskLaunch, service_hostname)
+from .ledger import (Availability, Reservation, ReservationLedger,
+                     VolumeReservation)
+from .outcome import EvaluationOutcome, OutcomeNode, OutcomeTracker
 from .placement import (AgentRule, AndRule, AttributeRule, HostnameRule,
                         MaxPerHostnameRule, MaxPerRegionRule, MaxPerZoneRule,
                         NotRule, OrRule, Outcome, PlacementRule, RegionRule,

@@ -1,0 +1,366 @@
+"""The resource matcher — OfferEvaluator analogue.
+
+Reference: ``offer/evaluate/OfferEvaluator.java:113-248`` (loop offers x
+stages; first fully-passing offer wins), ``:411-522`` (new-launch pipeline:
+executor -> placement -> volumes -> TLS -> per-resource-set reserve ->
+launch), ``:538-596`` (existing pod: reuse reservations / in-place update),
+``PodInfoBuilder.java`` (TaskInfo construction + env injection).
+
+Differences (TPU-first): agents are inventoried, not offered; the pipeline
+runs over candidate agents. Two passes the reference never had:
+
+* **gang feasibility** — a pod with ``TpuSpec(gang=True)`` must land every
+  instance on ONE slice; before placing the first instance we check the
+  slice can hold the entire pod group, and later instances are pinned to the
+  chosen slice (SURVEY.md section 7 hard part (3)).
+* **stable TPU process ids** — ``JAX_PROCESS_ID = pod index``,
+  ``JAX_NUM_PROCESSES = pod count x chips-per-host grouping``, coordinator
+  address derived from instance 0's stable service-discovery name, so a
+  replaced worker rejoins the same rank (hard part (4)).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ..agent.inventory import AgentInfo, TaskRecord
+from ..plan.requirement import PodInstanceRequirement, RecoveryType
+from ..specification.spec import PodSpec, ResourceSet
+from ..state.tasks import TpuAssignment
+from ..utils.ids import make_task_id, new_uuid
+from .ledger import Availability, Reservation, ReservationLedger, VolumeReservation
+from .outcome import EvaluationOutcome, OutcomeNode
+
+JAX_COORDINATOR_PORT = 8476
+ENV_TASK_NAME = "TASK_NAME"
+ENV_POD_INSTANCE_INDEX = "POD_INSTANCE_INDEX"
+ENV_FRAMEWORK_NAME = "FRAMEWORK_NAME"
+ENV_FRAMEWORK_HOST = "FRAMEWORK_HOST"
+
+
+def service_hostname(service_name: str, pod_instance_name: str) -> str:
+    """Stable discovery name for a pod instance (reference autoip DNS
+    ``<task>.<framework>.autoip.dcos.thisdcos.directory``,
+    ``offer/taskdata/EnvConstants.java:26-34``)."""
+    return f"{pod_instance_name}.{service_name}.tpu.local"
+
+
+@dataclass(frozen=True)
+class TaskLaunch:
+    """One task to start on the chosen agent (reference TaskInfo)."""
+
+    task_name: str            # "<pod>-<idx>-<task>"
+    task_id: str
+    task_spec_name: str
+    cmd: str
+    env: Mapping[str, str]
+    resource_set_id: str
+    goal: str
+    essential: bool
+    config_templates: Tuple[Tuple[str, str, str], ...] = ()  # (name, dest, template)
+    health_check_cmd: Optional[str] = None
+    readiness_check_cmd: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class LaunchPlan:
+    """The matcher's output for one requirement (reference: the list of
+    ``OfferRecommendation``s for one step)."""
+
+    requirement: PodInstanceRequirement
+    agent: AgentInfo
+    launches: Tuple[TaskLaunch, ...]
+    reservations: Tuple[Reservation, ...]
+    tpu: Optional[TpuAssignment] = None
+
+    def task_ids(self) -> Dict[str, str]:
+        return {l.task_name: l.task_id for l in self.launches}
+
+
+class Evaluator:
+    """Matches one PodInstanceRequirement against the agent inventory."""
+
+    def __init__(self, service_name: str, outcome_tracker=None):
+        self._service_name = service_name
+        self._tracker = outcome_tracker
+
+    def evaluate(self, requirement: PodInstanceRequirement,
+                 agents: Sequence[AgentInfo], tasks: Sequence[TaskRecord],
+                 ledger: ReservationLedger) -> Tuple[Optional[LaunchPlan], OutcomeNode]:
+        """First agent passing every stage wins (reference
+        ``OfferEvaluator.java:137-247``)."""
+        root = OutcomeNode.root(requirement.name)
+        pod = requirement.pod_instance.pod
+        pod_name = requirement.pod_instance.name
+
+        # a permanently-failed pod is a fresh launch no matter which plan
+        # drives it (reference OfferEvaluator.java:263-277 consults the
+        # FailureUtils label, not the plan)
+        replace_mode = (
+            requirement.recovery_type is RecoveryType.PERMANENT
+            or any(t.permanently_failed for t in tasks
+                   if t.pod_instance_name == pod_name))
+        pinned_agent = None if replace_mode else \
+            self._pinned_agent(requirement, ledger)
+        gang_slice, gang_err = self._gang_slice(requirement, agents, tasks, ledger)
+        if gang_err is not None:
+            root.add(EvaluationOutcome.fail("gang", gang_err))
+            self._record(root)
+            return None, root
+
+        candidates = list(agents)
+        if pinned_agent is not None:
+            candidates = [a for a in candidates if a.agent_id == pinned_agent]
+            if not candidates:
+                root.add(EvaluationOutcome.fail(
+                    "pin", f"pinned agent {pinned_agent} not in inventory"))
+                self._record(root)
+                return None, root
+        elif replace_mode:
+            # replace exists to move off a suspect host: try the previous
+            # agent LAST (still feasible when it's the only host)
+            previous = {t.agent_id for t in tasks
+                        if t.pod_instance_name == pod_name}
+            candidates.sort(key=lambda a: a.agent_id in previous)
+
+        for agent in candidates:
+            node = root.child(f"agent:{agent.agent_id}")
+            plan = self._evaluate_agent(requirement, agent, tasks, ledger,
+                                        gang_slice, pinned_agent, node,
+                                        replace_mode)
+            if plan is not None:
+                node.add(EvaluationOutcome.ok("launch", f"all stages passed on {agent.agent_id}"))
+                self._record(root)
+                return plan, root
+        self._record(root)
+        return None, root
+
+    # -- pinning & gang ----------------------------------------------------
+
+    def _pinned_agent(self, requirement: PodInstanceRequirement,
+                      ledger: ReservationLedger) -> Optional[str]:
+        """A pod holding volumes or doing TRANSIENT recovery relaunches on its
+        existing agent (reference: volumes pin tasks; ``RecoveryType.TRANSIENT``
+        reuses reservations)."""
+        if requirement.recovery_type is RecoveryType.PERMANENT:
+            return None
+        held = ledger.for_pod(requirement.pod_instance.name)
+        if held:
+            return held[0].agent_id
+        return None
+
+    def _gang_slice(self, requirement: PodInstanceRequirement,
+                    agents: Sequence[AgentInfo], tasks: Sequence[TaskRecord],
+                    ledger: ReservationLedger) -> Tuple[Optional[str], Optional[str]]:
+        """Returns (slice_id or None, error or None).
+
+        If the pod demands gang TPU placement: later instances are pinned to
+        the slice the first instance chose; the first instance picks a slice
+        that can hold the WHOLE pod group (all-or-nothing feasibility).
+        """
+        pod = requirement.pod_instance.pod
+        if pod.tpu is None or not pod.tpu.gang or pod.tpu.chips <= 0:
+            return None, None
+        # slice already chosen by a sibling instance?
+        pod_type = pod.type
+        agents_by_id = {a.agent_id: a for a in agents}
+        for record in tasks:
+            if record.pod_type == pod_type and record.pod_instance_name != \
+                    requirement.pod_instance.name:
+                sibling_agent = agents_by_id.get(record.agent_id)
+                if sibling_agent is not None and sibling_agent.tpu.slice_id:
+                    return sibling_agent.tpu.slice_id, None
+        for res in ledger.all():
+            if res.tpus > 0 and res.pod_instance_name.rsplit("-", 1)[0] == pod_type \
+                    and res.pod_instance_name != requirement.pod_instance.name:
+                res_agent = agents_by_id.get(res.agent_id)
+                if res_agent is not None and res_agent.tpu.slice_id:
+                    return res_agent.tpu.slice_id, None
+        # first instance: find a slice that can hold the whole group
+        needed_hosts = pod.count
+        per_host_chips = pod.tpu.chips
+        slices: Dict[str, List[AgentInfo]] = {}
+        for a in agents:
+            if a.tpu.slice_id is None or a.tpu.chips <= 0:
+                continue
+            if pod.tpu.topology and a.tpu.topology != pod.tpu.topology:
+                continue
+            slices.setdefault(a.tpu.slice_id, []).append(a)
+        exclude = requirement.pod_instance.name
+        for slice_id, members in sorted(slices.items()):
+            capable = 0
+            for a in members:
+                avail = ledger.available(a, exclude_pod=exclude)
+                if avail.tpus >= per_host_chips:
+                    capable += 1
+            if capable >= needed_hosts:
+                return slice_id, None
+        topo = f" with topology {pod.tpu.topology}" if pod.tpu.topology else ""
+        return None, (
+            f"no TPU slice{topo} can hold all {needed_hosts} instances of pod "
+            f"{pod.type} ({per_host_chips} chips/host); gang placement is "
+            f"all-or-nothing")
+
+    # -- per-agent pipeline ------------------------------------------------
+
+    def _evaluate_agent(self, requirement: PodInstanceRequirement,
+                        agent: AgentInfo, tasks: Sequence[TaskRecord],
+                        ledger: ReservationLedger, gang_slice: Optional[str],
+                        pinned_agent: Optional[str], node: OutcomeNode,
+                        replace_mode: bool = False) -> Optional[LaunchPlan]:
+        pod = requirement.pod_instance.pod
+        pod_name = requirement.pod_instance.name
+
+        # stage: gang slice membership
+        if gang_slice is not None and agent.tpu.slice_id != gang_slice:
+            node.add(EvaluationOutcome.fail(
+                "gang", f"agent not in chosen slice {gang_slice}"))
+            return None
+
+        # stage: placement rule (skipped for pinned relaunch-in-place, like
+        # the reference skipping placement for existing pods,
+        # OfferEvaluator.java:263-277)
+        if pod.placement_rule is not None and pinned_agent is None:
+            outcome = pod.placement_rule.filter(agent, pod_name, tasks)
+            node.add(EvaluationOutcome("placement", outcome.passes, outcome.reason))
+            if not outcome.passes:
+                return None
+
+        # stage: per-resource-set reserve (reuse existing reservation if held)
+        avail = ledger.available(agent, exclude_pod=pod_name)
+        needed_sets = {pod.task(t).resource_set_id for t in requirement.task_names}
+        new_reservations: List[Reservation] = []
+        reservations_by_set: Dict[str, Reservation] = {}
+        for rs_id in sorted(needed_sets):
+            rs = pod.resource_set(rs_id)
+            existing = ledger.get(pod_name, rs_id)
+            if existing is not None and existing.agent_id == agent.agent_id \
+                    and not replace_mode:
+                reservations_by_set[rs_id] = existing
+                node.add(EvaluationOutcome.ok(
+                    f"reserve:{rs_id}", "reusing existing reservation"))
+                continue
+            reason = avail.fits(rs.cpus, rs.memory_mb, rs.disk_mb, rs.tpus)
+            if reason is not None:
+                node.add(EvaluationOutcome.fail(f"reserve:{rs_id}", reason))
+                return None
+            avail.take(rs.cpus, rs.memory_mb, rs.disk_mb, rs.tpus)
+            ports: Dict[str, int] = {}
+            ok = True
+            for port_spec in rs.ports:
+                allocated = avail.allocate_port(port_spec.port)
+                if allocated is None:
+                    node.add(EvaluationOutcome.fail(
+                        f"ports:{rs_id}", f"port {port_spec.name} "
+                        f"({port_spec.port or 'dynamic'}) unavailable"))
+                    ok = False
+                    break
+                ports[port_spec.name] = allocated
+            if not ok:
+                return None
+            volumes = tuple(
+                VolumeReservation(container_path=v.container_path,
+                                  size_mb=v.size_mb, volume_id=new_uuid())
+                for v in rs.volumes)
+            reservation = Reservation(
+                pod_instance_name=pod_name, resource_set_id=rs_id,
+                agent_id=agent.agent_id, cpus=rs.cpus, memory_mb=rs.memory_mb,
+                disk_mb=rs.disk_mb, tpus=rs.tpus, ports=ports, volumes=volumes)
+            new_reservations.append(reservation)
+            reservations_by_set[rs_id] = reservation
+            node.add(EvaluationOutcome.ok(
+                f"reserve:{rs_id}",
+                f"reserved cpus={rs.cpus} mem={rs.memory_mb} tpus={rs.tpus} "
+                f"ports={ports}"))
+
+        # stage: TPU process assignment
+        tpu_assignment = self._tpu_assignment(requirement, agent)
+        if tpu_assignment is not None:
+            node.add(EvaluationOutcome.ok(
+                "tpu", f"process {tpu_assignment.process_id}/"
+                       f"{tpu_assignment.num_processes} @ "
+                       f"{tpu_assignment.coordinator_address}"))
+
+        # stage: launch construction
+        launches = tuple(
+            self._build_launch(requirement, agent, task_name,
+                               reservations_by_set, tpu_assignment)
+            for task_name in requirement.task_names)
+        return LaunchPlan(requirement=requirement, agent=agent,
+                          launches=launches,
+                          reservations=tuple(new_reservations),
+                          tpu=tpu_assignment)
+
+    def _tpu_assignment(self, requirement: PodInstanceRequirement,
+                        agent: AgentInfo) -> Optional[TpuAssignment]:
+        pod = requirement.pod_instance.pod
+        if pod.tpu is None or pod.tpu.chips <= 0:
+            return None
+        coordinator = service_hostname(
+            self._service_name, f"{pod.type}-0")
+        return TpuAssignment(
+            process_id=requirement.pod_instance.index,
+            num_processes=pod.count,
+            coordinator_address=f"{coordinator}:{JAX_COORDINATOR_PORT}",
+            chips=pod.tpu.chips,
+            slice_id=agent.tpu.slice_id,
+            topology=pod.tpu.topology or agent.tpu.topology,
+            worker_coords=agent.tpu.coords,
+        )
+
+    def _build_launch(self, requirement: PodInstanceRequirement,
+                      agent: AgentInfo, task_spec_name: str,
+                      reservations_by_set: Mapping[str, Reservation],
+                      tpu: Optional[TpuAssignment]) -> TaskLaunch:
+        pod = requirement.pod_instance.pod
+        task_spec = pod.task(task_spec_name)
+        task_name = requirement.pod_instance.task_instance_name(task_spec_name)
+        reservation = reservations_by_set[task_spec.resource_set_id]
+
+        # env contract (reference EnvConstants.java:12-62 + PodInfoBuilder)
+        env: Dict[str, str] = dict(task_spec.env)
+        env.update(requirement.env_overrides)
+        env[ENV_TASK_NAME] = task_name
+        env[ENV_POD_INSTANCE_INDEX] = str(requirement.pod_instance.index)
+        env[ENV_FRAMEWORK_NAME] = self._service_name
+        env[ENV_FRAMEWORK_HOST] = f"{self._service_name}.tpu.local"
+        for port_name, port in reservation.ports.items():
+            port_spec = next(p for p in pod.resource_set(
+                task_spec.resource_set_id).ports if p.name == port_name)
+            env[port_spec.env_name] = str(port)
+        if tpu is not None:
+            env["JAX_PROCESS_ID"] = str(tpu.process_id)
+            env["JAX_NUM_PROCESSES"] = str(tpu.num_processes)
+            env["JAX_COORDINATOR_ADDRESS"] = tpu.coordinator_address
+            env["TPU_CHIPS_PER_PROCESS"] = str(tpu.chips)
+            if tpu.slice_id:
+                env["TPU_SLICE_ID"] = tpu.slice_id
+            if tpu.topology:
+                env["TPU_TOPOLOGY"] = tpu.topology
+            if tpu.worker_coords is not None:
+                env["TPU_WORKER_COORDS"] = ",".join(map(str, tpu.worker_coords))
+        if agent.zone:
+            env["ZONE"] = agent.zone
+        if agent.region:
+            env["REGION"] = agent.region
+
+        return TaskLaunch(
+            task_name=task_name,
+            task_id=make_task_id(task_name),
+            task_spec_name=task_spec_name,
+            cmd=task_spec.cmd,
+            env=env,
+            resource_set_id=task_spec.resource_set_id,
+            goal=task_spec.goal.value,
+            essential=task_spec.essential,
+            config_templates=tuple(
+                (c.name, c.relative_path, c.template) for c in task_spec.configs),
+            health_check_cmd=task_spec.health_check.cmd if task_spec.health_check else None,
+            readiness_check_cmd=(
+                task_spec.readiness_check.cmd if task_spec.readiness_check else None),
+        )
+
+    def _record(self, root: OutcomeNode) -> None:
+        if self._tracker is not None:
+            self._tracker.record(root)

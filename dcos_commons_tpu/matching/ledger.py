@@ -1,0 +1,151 @@
+"""Reservation ledger — the scheduler-side resource bookkeeping.
+
+Reference: Mesos did this bookkeeping for the SDK (RESERVE/UNRESERVE/CREATE/
+DESTROY operations against offers, ``offer/MesosResourcePool.java:24``,
+``offer/ReserveOfferRecommendation.java``). We own both sides, so the
+scheduler keeps an explicit ledger: which pod instance holds how much of
+which agent. The ledger is rebuilt from the state store on restart (launch
+WAL = StoredTasks) and GC'd when pods are replaced/decommissioned —
+the ``getUnexpectedResources`` analogue (``DefaultScheduler.java:483-538``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Dict, Iterable, Mapping, Optional, Tuple
+
+from ..agent.inventory import AgentInfo
+
+
+@dataclass(frozen=True)
+class VolumeReservation:
+    container_path: str
+    size_mb: int
+    volume_id: str      # stable id; the agent maps it to a host directory
+
+
+@dataclass(frozen=True)
+class Reservation:
+    """Resources held by one resource set of one pod instance on one agent."""
+
+    pod_instance_name: str
+    resource_set_id: str
+    agent_id: str
+    cpus: float = 0.0
+    memory_mb: int = 0
+    disk_mb: int = 0
+    tpus: int = 0
+    ports: Mapping[str, int] = field(default_factory=dict)   # port name -> number
+    volumes: Tuple[VolumeReservation, ...] = ()
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.pod_instance_name, self.resource_set_id)
+
+    def to_json(self) -> bytes:
+        return json.dumps(asdict(self), sort_keys=True).encode()
+
+    @staticmethod
+    def from_json(raw: bytes) -> "Reservation":
+        data = json.loads(raw.decode())
+        data["ports"] = dict(data.get("ports", {}))
+        data["volumes"] = tuple(VolumeReservation(**v) for v in data.get("volumes", ()))
+        return Reservation(**data)
+
+
+class ReservationLedger:
+    """In-memory view; persisted via the state store's property space by the
+    scheduler (rebuild-on-restart, like the reference re-reading TaskInfos)."""
+
+    def __init__(self, reservations: Iterable[Reservation] = ()):
+        self._by_key: Dict[Tuple[str, str], Reservation] = {}
+        for r in reservations:
+            self._by_key[r.key] = r
+
+    def all(self) -> list[Reservation]:
+        return list(self._by_key.values())
+
+    def get(self, pod_instance_name: str, resource_set_id: str) -> Optional[Reservation]:
+        return self._by_key.get((pod_instance_name, resource_set_id))
+
+    def for_pod(self, pod_instance_name: str) -> list[Reservation]:
+        return [r for r in self._by_key.values()
+                if r.pod_instance_name == pod_instance_name]
+
+    def for_agent(self, agent_id: str) -> list[Reservation]:
+        return [r for r in self._by_key.values() if r.agent_id == agent_id]
+
+    def add(self, reservation: Reservation) -> None:
+        self._by_key[reservation.key] = reservation
+
+    def remove_pod(self, pod_instance_name: str) -> list[Reservation]:
+        """Unreserve everything a pod instance holds (replace/decommission)."""
+        removed = [r for r in self._by_key.values()
+                   if r.pod_instance_name == pod_instance_name]
+        for r in removed:
+            del self._by_key[r.key]
+        return removed
+
+    # -- availability ------------------------------------------------------
+
+    def available(self, agent: AgentInfo,
+                  exclude_pod: Optional[str] = None) -> "Availability":
+        held = [r for r in self.for_agent(agent.agent_id)
+                if r.pod_instance_name != exclude_pod]
+        used_ports = {p for r in held for p in r.ports.values()}
+        return Availability(
+            cpus=agent.cpus - sum(r.cpus for r in held),
+            memory_mb=agent.memory_mb - sum(r.memory_mb for r in held),
+            disk_mb=agent.disk_mb - sum(r.disk_mb for r in held),
+            tpus=agent.tpu.chips - sum(r.tpus for r in held),
+            used_ports=used_ports,
+            agent=agent,
+        )
+
+
+@dataclass
+class Availability:
+    """What's left of an agent after existing reservations (the
+    ``MesosResourcePool`` analogue for one agent)."""
+
+    cpus: float
+    memory_mb: int
+    disk_mb: int
+    tpus: int
+    used_ports: set[int]
+    agent: AgentInfo
+
+    def fits(self, cpus: float, memory_mb: int, disk_mb: int, tpus: int) -> Optional[str]:
+        """None if it fits, else a human-readable shortfall reason."""
+        if cpus > self.cpus + 1e-9:
+            return f"insufficient cpus: want {cpus}, have {self.cpus:g}"
+        if memory_mb > self.memory_mb:
+            return f"insufficient memory: want {memory_mb}MB, have {self.memory_mb}MB"
+        if disk_mb > self.disk_mb:
+            return f"insufficient disk: want {disk_mb}MB, have {self.disk_mb}MB"
+        if tpus > self.tpus:
+            return f"insufficient tpus: want {tpus}, have {self.tpus}"
+        return None
+
+    def take(self, cpus: float, memory_mb: int, disk_mb: int, tpus: int) -> None:
+        self.cpus -= cpus
+        self.memory_mb -= memory_mb
+        self.disk_mb -= disk_mb
+        self.tpus -= tpus
+
+    def allocate_port(self, requested: int = 0) -> Optional[int]:
+        """Fixed port if requested != 0, else first free dynamic port from the
+        agent's ranges (reference ``PortEvaluationStage`` dynamic ports)."""
+        if requested:
+            for rng in self.agent.ports:
+                if requested in rng and requested not in self.used_ports:
+                    self.used_ports.add(requested)
+                    return requested
+            return None
+        for rng in self.agent.ports:
+            for port in range(rng.begin, rng.end + 1):
+                if port not in self.used_ports:
+                    self.used_ports.add(port)
+                    return port
+        return None

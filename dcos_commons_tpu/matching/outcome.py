@@ -1,0 +1,101 @@
+"""Evaluation outcome tree + history ring buffer.
+
+Reference: ``offer/evaluate/EvaluationOutcome.java`` (per-stage pass/fail
+reason tree), ``offer/history/OfferOutcomeTracker.java`` +
+``debug/OfferOutcomeTrackerV2.java`` (ring buffer behind ``/v1/debug/offers``
+with failure-reason aggregation).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+
+@dataclass(frozen=True)
+class EvaluationOutcome:
+    stage: str
+    passes: bool
+    reason: str
+
+    @staticmethod
+    def ok(stage: str, reason: str) -> "EvaluationOutcome":
+        return EvaluationOutcome(stage, True, reason)
+
+    @staticmethod
+    def fail(stage: str, reason: str) -> "EvaluationOutcome":
+        return EvaluationOutcome(stage, False, reason)
+
+
+class OutcomeNode:
+    """One evaluation attempt: requirement -> per-agent children -> stages."""
+
+    def __init__(self, name: str, timestamp: Optional[float] = None):
+        self.name = name
+        self.timestamp = timestamp if timestamp is not None else time.time()
+        self.outcomes: List[EvaluationOutcome] = []
+        self.children: List["OutcomeNode"] = []
+
+    @staticmethod
+    def root(name: str) -> "OutcomeNode":
+        return OutcomeNode(name)
+
+    def child(self, name: str) -> "OutcomeNode":
+        node = OutcomeNode(name, self.timestamp)
+        self.children.append(node)
+        return node
+
+    def add(self, outcome: EvaluationOutcome) -> None:
+        self.outcomes.append(outcome)
+
+    @property
+    def passed(self) -> bool:
+        return (all(o.passes for o in self.outcomes)
+                and (not self.children or any(c.passed for c in self.children)))
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "timestamp": self.timestamp,
+            "passed": self.passed,
+            "outcomes": [
+                {"stage": o.stage, "passed": o.passes, "reason": o.reason}
+                for o in self.outcomes],
+            "children": [c.to_dict() for c in self.children],
+        }
+
+    def failure_reasons(self) -> list[str]:
+        out = [f"{self.name}/{o.stage}: {o.reason}"
+               for o in self.outcomes if not o.passes]
+        for c in self.children:
+            out.extend(c.failure_reasons())
+        return out
+
+
+class OutcomeTracker:
+    """Ring buffer of recent evaluation outcomes (reference keeps 100,
+    ``OfferOutcomeTracker``)."""
+
+    def __init__(self, capacity: int = 100):
+        self._buffer: Deque[OutcomeNode] = collections.deque(maxlen=capacity)
+
+    def record(self, node: OutcomeNode) -> None:
+        self._buffer.append(node)
+
+    def recent(self) -> list[OutcomeNode]:
+        return list(self._buffer)
+
+    def to_dict(self) -> dict:
+        nodes = self.recent()
+        failures: dict[str, int] = {}
+        for n in nodes:
+            if not n.passed:
+                for reason in n.failure_reasons():
+                    failures[reason] = failures.get(reason, 0) + 1
+        return {
+            "outcomes": [n.to_dict() for n in nodes],
+            "failure_summary": dict(
+                sorted(failures.items(), key=lambda kv: -kv[1])),
+        }

@@ -64,6 +64,9 @@ class Step(Element):
     def on_no_match(self, reason: str) -> None:
         """No agent satisfied the requirement this cycle."""
 
+    def mark_prepared(self) -> None:
+        """Kill-before-relaunch issued for this step's tasks; default no-op."""
+
     @property
     def asset(self) -> Optional[str]:
         return None
@@ -73,6 +76,53 @@ class Step(Element):
         """May be offered work this cycle (reference ``PlanUtils.isEligible``:
         pending/prepared/delayed steps, not interrupted)."""
         return self.status in (Status.PENDING, Status.PREPARED, Status.DELAYED)
+
+
+class ActionStep(Step):
+    """A step whose work is a scheduler-side action, not a task launch —
+    the shape of the reference's decommission/uninstall steps
+    (``TriggerDecommissionStep``, ``ResourceCleanupStep``,
+    ``EraseTaskStateStep``, ``DeregisterStep``). ``action()`` returns True
+    when the work is complete; False retries next cycle."""
+
+    def __init__(self, name: str, action, asset: Optional[str] = None,
+                 initial_status: Status = Status.PENDING):
+        super().__init__(name)
+        self._action = action
+        self._asset = asset
+        self._status = initial_status
+
+    @property
+    def status(self) -> Status:
+        if self.errors:
+            return Status.ERROR
+        return self._status
+
+    @property
+    def asset(self) -> Optional[str]:
+        return self._asset
+
+    def start(self) -> Optional[PodInstanceRequirement]:
+        return None  # no launch work; the scheduler calls execute()
+
+    def execute(self) -> bool:
+        try:
+            done = self._action()
+        except Exception as e:  # noqa: BLE001 — surfaced as plan error
+            self.errors.append(f"{self.name}: {e}")
+            return False
+        self.errors.clear()
+        self._status = Status.COMPLETE if done else Status.PREPARED
+        return done
+
+    def restart(self) -> None:
+        """Operator recovery path: clears ERROR state so the action retries."""
+        self.errors.clear()
+        self._status = Status.PENDING
+
+    def force_complete(self) -> None:
+        self.errors.clear()
+        self._status = Status.COMPLETE
 
 
 class DeploymentStep(Step):
@@ -139,6 +189,13 @@ class DeploymentStep(Step):
     def on_no_match(self, reason: str) -> None:
         # stays PENDING; the outcome tracker records the reason
         pass
+
+    def mark_prepared(self) -> None:
+        """Kill-before-relaunch issued; awaiting terminal statuses before the
+        new launch (reference ``PlanScheduler.java:126-165`` kills tasks, then
+        the step launches on a later cycle)."""
+        if self._status in (Status.PENDING, Status.DELAYED):
+            self._status = Status.PREPARED
 
     # -- status feed --------------------------------------------------------
 

@@ -53,16 +53,22 @@ class PlanCoordinator:
 
     def get_candidates(self) -> List[Step]:
         """All launchable steps this cycle, with dirty-asset exclusion across
-        plans: an asset claimed by any plan's in-progress step, or by an
-        earlier candidate, is off-limits."""
+        plans: an asset claimed by ANOTHER plan's in-progress step, or by an
+        earlier candidate, is off-limits. A plan's own in-progress steps do
+        not block it — a PREPARED step is itself the candidate that continues
+        (reference ``DefaultPlanCoordinator.java:54-108`` accumulates a
+        manager's dirty assets after collecting its candidates)."""
+        dirty_by_manager = [m.dirty_assets() for m in self._managers]
         claimed: Set[str] = set()
-        for manager in self._managers:
-            claimed |= manager.dirty_assets()
         out: List[Step] = []
-        for manager in self._managers:
-            for step in manager.get_candidates(claimed):
+        for i, manager in enumerate(self._managers):
+            dirty = set(claimed)
+            for j, other_dirty in enumerate(dirty_by_manager):
+                if j != i:
+                    dirty |= other_dirty
+            for step in manager.get_candidates(dirty):
                 if step.asset is not None:
-                    if step.asset in claimed:
+                    if step.asset in dirty or step.asset in claimed:
                         continue
                     claimed.add(step.asset)
                 out.append(step)

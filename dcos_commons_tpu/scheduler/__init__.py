@@ -1,0 +1,4 @@
+from .core import ServiceScheduler
+from .recovery import (FailureMonitor, NeverFailureMonitor,
+                       RecoveryPlanManager, TestingFailureMonitor,
+                       TimedFailureMonitor, needs_recovery)

@@ -1,0 +1,319 @@
+"""ServiceScheduler — the service lifecycle engine.
+
+Reference: this class rolls together ``scheduler/DefaultScheduler.java`` +
+``scheduler/AbstractScheduler.java`` + the offer-cycle halves of
+``framework/OfferProcessor.java`` (there is no offer market to manage, so
+queue/decline/revive/suppress disappear; what remains is exactly the
+reference's evaluate->WAL->accept->status loop):
+
+* boot: schema gate, config update w/ validators, stores, plan managers
+  (``SchedulerBuilder.java:331-552``)
+* ``run_cycle()``: candidates -> kill-before-relaunch -> evaluate -> launch
+  WAL -> launch (``OfferProcessor.java:412-484``, ``PlanScheduler.java:50-165``,
+  ``DefaultScheduler.java:431-470``)
+* ``handle_status()``: store -> feed plans -> kill unknown tasks
+  (``FrameworkScheduler.statusUpdate:273-297``,
+  ``DefaultScheduler.processStatusUpdate:541-568``)
+* ``reconcile()``: agent-truth vs state-store truth on (re)start
+  (``ExplicitReconciler``/``ImplicitReconciler``)
+* operator verbs: ``restart_pod`` / ``replace_pod`` / pause / resume
+  (``http/endpoints/PodResource.java:47-111``)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+from ..agent.client import AgentClient
+from ..agent.inventory import TaskRecord
+from ..config.updater import (DEFAULT_VALIDATORS, ConfigurationUpdater,
+                              UpdateResult)
+from ..matching.evaluator import Evaluator, LaunchPlan, TaskLaunch
+from ..matching.outcome import OutcomeTracker
+from ..plan.backoff import Backoff, DisabledBackoff
+from ..plan.elements import ActionStep, Plan
+from ..plan.manager import PlanCoordinator, PlanManager
+from ..plan.plan_factory import build_deploy_plan, build_plan_from_spec
+from ..plan.requirement import RecoveryType
+from ..plan.status import Status
+from ..specification.spec import GoalState, ServiceSpec
+from ..state.persister import Persister
+from ..state.reservation_store import ReservationStore
+from ..state.state_store import (ConfigStore, FrameworkStore, GoalOverride,
+                                 OverrideProgress, SchemaVersionStore,
+                                 StateStore, StateStoreError)
+from ..state.tasks import StoredTask, TaskState, TaskStatus
+from .recovery import FailureMonitor, RecoveryPlanManager, RecoveryOverrider
+
+log = logging.getLogger(__name__)
+
+
+class ServiceScheduler:
+    def __init__(self, spec: ServiceSpec, persister: Persister,
+                 cluster: AgentClient, namespace: str = "",
+                 failure_monitor: Optional[FailureMonitor] = None,
+                 backoff: Optional[Backoff] = None,
+                 validators=DEFAULT_VALIDATORS,
+                 recovery_overriders: Sequence[RecoveryOverrider] = (),
+                 uninstall: bool = False):
+        SchemaVersionStore(persister).check()
+        self.state = StateStore(persister, namespace)
+        self.configs = ConfigStore(persister, namespace)
+        self.framework_store = FrameworkStore(persister)
+        self.reservation_store = ReservationStore(persister, namespace)
+        self.cluster = cluster
+        self.uninstall_mode = uninstall
+
+        if uninstall:
+            # teardown works against whatever config is already stored
+            # (reference SchedulerBuilder.java:401-436 -> UninstallScheduler)
+            self.config_errors = ()
+            target = self.configs.get_target()
+            self.target_config_id = target or self.configs.store(spec)
+            if target is None:
+                self.configs.set_target(self.target_config_id)
+        else:
+            update: UpdateResult = ConfigurationUpdater(
+                self.configs, self.state, validators).update(spec)
+            self.config_errors = update.errors
+            self.target_config_id = update.target_id
+        # on validation errors the OLD target stays active
+        # (reference SchedulerBuilder.java:479-492)
+        self.spec: ServiceSpec = self.configs.fetch(self.target_config_id)
+
+        self.backoff = backoff or DisabledBackoff()
+        self.outcome_tracker = OutcomeTracker()
+        self.evaluator = Evaluator(self.spec.name, self.outcome_tracker)
+        self.ledger = self.reservation_store.load_ledger()
+
+        if uninstall:
+            from .decommission import build_uninstall_plan
+            self.deploy_manager = PlanManager(build_uninstall_plan(self))
+            self.recovery_manager = None
+            self.coordinator = PlanCoordinator([self.deploy_manager])
+        else:
+            from .decommission import DecommissionPlanManager
+            deploy_plan = build_deploy_plan(
+                self.spec, self.state, self.target_config_id, self.backoff)
+            if self.config_errors:
+                deploy_plan.errors.extend(self.config_errors)
+            self.deploy_manager = PlanManager(deploy_plan)
+            self.recovery_manager = RecoveryPlanManager(
+                lambda: self.spec, self.state, failure_monitor, self.backoff,
+                recovery_overriders)
+            self.decommission_manager = DecommissionPlanManager(self)
+            self.other_managers: List[PlanManager] = [
+                PlanManager(build_plan_from_spec(
+                    self.spec, ps, self.state, self.target_config_id, self.backoff))
+                for ps in self.spec.plans if ps.name not in ("deploy", "update")]
+            self.coordinator = PlanCoordinator(
+                [self.deploy_manager, self.recovery_manager,
+                 self.decommission_manager] + self.other_managers)
+
+        cluster.set_status_callback(self.handle_status)
+        self.reconcile()
+
+    @property
+    def uninstall_complete(self) -> bool:
+        return (self.uninstall_mode
+                and self.deploy_manager.plan.status is Status.COMPLETE)
+
+    # -- plans -------------------------------------------------------------
+
+    @property
+    def plans(self) -> List[Plan]:
+        return self.coordinator.plans
+
+    def plan(self, name: str) -> Optional[Plan]:
+        for p in self.plans:
+            if p.name == name:
+                return p
+        return None
+
+    # -- reconciliation ----------------------------------------------------
+
+    def reconcile(self) -> None:
+        """Compare agent truth with stored truth: stored-but-not-running ->
+        synthesize LOST; running-but-not-stored -> kill the zombie
+        (reference implicit reconciliation + ``FrameworkScheduler.java:283-297``)."""
+        reported: Dict[str, str] = {}  # task_id -> agent_id
+        for agent in self.cluster.agents():
+            for task_id in self.cluster.running_task_ids(agent.agent_id):
+                reported[task_id] = agent.agent_id
+        for task in self.state.fetch_tasks():
+            status = self.state.fetch_status(task.task_name)
+            alive_in_store = status is None or (
+                status.task_id == task.task_id and not status.state.terminal)
+            if task.task_id in reported:
+                reported.pop(task.task_id)
+            elif alive_in_store:
+                lost = TaskStatus.now(task.task_id, TaskState.LOST,
+                                      message="not reported by any agent")
+                self.handle_status(task.task_name, lost)
+        for task_id, agent_id in reported.items():
+            log.warning("killing unknown task %s on %s", task_id, agent_id)
+            self.cluster.kill(agent_id, task_id)
+
+    # -- status feed -------------------------------------------------------
+
+    def handle_status(self, task_name: str, status: TaskStatus) -> None:
+        try:
+            self.state.store_status(task_name, status)
+        except StateStoreError:
+            # stale generation: a status for a task id we've since replaced
+            if not status.state.terminal and status.agent_id:
+                self.cluster.kill(status.agent_id, status.task_id)
+            return
+        self.coordinator.update(status)
+
+    # -- the cycle ---------------------------------------------------------
+
+    def run_cycle(self) -> int:
+        """One evaluation pass; returns the number of actions (launches +
+        kill batches) issued — zero means the cycle found no work."""
+        agents = list(self.cluster.agents())
+        actions = 0
+        for step in list(self.coordinator.get_candidates()):
+            if isinstance(step, ActionStep):
+                step.execute()
+                actions += 1
+                continue
+            requirement = step.start()
+            if requirement is None:
+                continue
+            if self._kill_before_relaunch(requirement):
+                step.mark_prepared()
+                actions += 1
+                continue
+            if requirement.recovery_type is RecoveryType.PERMANENT:
+                removed = self.ledger.remove_pod(requirement.pod_instance.name)
+                self.reservation_store.remove(removed)
+            task_records = self._task_records()
+            plan, outcome = self.evaluator.evaluate(
+                requirement, agents, task_records, self.ledger)
+            if plan is None:
+                step.on_no_match("; ".join(outcome.failure_reasons()[:5]))
+                continue
+            # WAL + step bookkeeping BEFORE the agent is instructed: statuses
+            # may arrive synchronously (fake cluster) or at any time after
+            # launch; the step must already know its task ids
+            self._persist_launch(plan)
+            step.on_launch(plan.task_ids())
+            self.cluster.launch(plan)
+            actions += 1
+        if (not self.uninstall_mode
+                and self.deploy_manager.plan.status is Status.COMPLETE
+                and not self.state.deploy_completed()):
+            self.state.set_deploy_completed()
+        return actions
+
+    def run_until_quiet(self, max_cycles: int = 50) -> int:
+        """Drive cycles until nothing launches (tests / sync deployments)."""
+        cycles = 0
+        while cycles < max_cycles:
+            cycles += 1
+            if self.run_cycle() == 0:
+                break
+        return cycles
+
+    def _kill_before_relaunch(self, requirement) -> bool:
+        """Kill live tasks being redeployed; returns True if kills are in
+        flight (reference ``PlanScheduler.java:126-165``)."""
+        pending = False
+        for task_name in requirement.task_instance_names():
+            task = self.state.fetch_task(task_name)
+            if task is None:
+                continue
+            status = self.state.fetch_status(task_name)
+            if (status is not None and status.task_id == task.task_id
+                    and not status.state.terminal):
+                grace = task_grace_period(requirement, task)
+                self.cluster.kill(task.agent_id, task.task_id, grace)
+                pending = True
+        return pending
+
+    def _persist_launch(self, plan: LaunchPlan) -> None:
+        """WAL: tasks + reservations persisted before the agent is instructed
+        (reference ``PersistentLaunchRecorder.record()`` before ``accept()``,
+        ``DefaultScheduler.java:453-466``)."""
+        stored = [self._stored_task(plan, launch) for launch in plan.launches]
+        self.state.store_tasks(stored)
+        for r in plan.reservations:
+            self.ledger.add(r)
+        self.reservation_store.store(plan.reservations)
+
+    def _stored_task(self, plan: LaunchPlan, launch: TaskLaunch) -> StoredTask:
+        pod_instance = plan.requirement.pod_instance
+        return StoredTask(
+            task_name=launch.task_name,
+            task_id=launch.task_id,
+            pod_type=pod_instance.pod.type,
+            pod_index=pod_instance.index,
+            task_spec_name=launch.task_spec_name,
+            resource_set_id=launch.resource_set_id,
+            agent_id=plan.agent.agent_id,
+            hostname=plan.agent.hostname,
+            target_config_id=self.target_config_id,
+            goal=GoalState(launch.goal),
+            essential=launch.essential,
+            env=dict(launch.env),
+            cmd=launch.cmd,
+            zone=plan.agent.zone,
+            region=plan.agent.region,
+            tpu=plan.tpu,
+        )
+
+    def _task_records(self) -> List[TaskRecord]:
+        out = []
+        for task in self.state.fetch_tasks():
+            out.append(TaskRecord(
+                task_name=task.task_name, pod_type=task.pod_type,
+                pod_index=task.pod_index, agent_id=task.agent_id,
+                hostname=task.hostname, zone=task.zone, region=task.region,
+                permanently_failed=task.permanently_failed))
+        return out
+
+    # -- operator verbs ----------------------------------------------------
+
+    def pod_instance_task_names(self, pod_instance_name: str) -> List[str]:
+        return [t.task_name for t in self.state.fetch_tasks()
+                if t.pod_instance_name == pod_instance_name]
+
+    def restart_pod(self, pod_instance_name: str) -> List[str]:
+        """Kill tasks in place; recovery relaunches them TRANSIENT
+        (reference ``PodQueries.restart``)."""
+        killed = []
+        for task_name in self.pod_instance_task_names(pod_instance_name):
+            task = self.state.fetch_task(task_name)
+            status = self.state.fetch_status(task_name)
+            if (task and status and status.task_id == task.task_id
+                    and not status.state.terminal):
+                self.cluster.kill(task.agent_id, task.task_id)
+                killed.append(task_name)
+        return killed
+
+    def replace_pod(self, pod_instance_name: str) -> List[str]:
+        """Mark permanently failed + kill; recovery replaces elsewhere
+        (reference ``pod replace`` -> ``FailureUtils.setPermanentlyFailed``,
+        SURVEY.md section 3.4)."""
+        touched = []
+        for task_name in self.pod_instance_task_names(pod_instance_name):
+            task = self.state.fetch_task(task_name)
+            if task is None:
+                continue
+            self.state.store_tasks([task.failed_permanently()])
+            status = self.state.fetch_status(task_name)
+            if (status and status.task_id == task.task_id
+                    and not status.state.terminal):
+                self.cluster.kill(task.agent_id, task.task_id)
+            touched.append(task_name)
+        return touched
+
+
+def task_grace_period(requirement, task: StoredTask) -> float:
+    try:
+        spec = requirement.pod_instance.pod.task(task.task_spec_name)
+        return float(spec.kill_grace_period_s)
+    except KeyError:
+        return 0.0

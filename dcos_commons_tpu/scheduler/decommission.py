@@ -1,0 +1,130 @@
+"""Decommission (scale-down) and uninstall (full teardown) plans.
+
+Reference: ``scheduler/decommission/DecommissionPlanFactory.java:61``
+(per-pod kill -> cleanup phases ``:133-147``, highest index first) and
+``scheduler/uninstall/UninstallPlanFactory.java:39-100`` (kill-tasks ->
+unreserve-per-agent -> deregister), ``UninstallScheduler.java``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..plan.elements import ActionStep, Phase, Plan
+from ..plan.manager import PlanManager
+from ..plan.status import Status
+from ..plan.strategy import ParallelStrategy, SerialStrategy
+from ..specification.spec import ServiceSpec
+from ..state.reservation_store import ReservationStore
+from ..state.state_store import StateStore
+from ..state.tasks import StoredTask
+
+DECOMMISSION_PLAN_NAME = "decommission"
+UNINSTALL_PLAN_NAME = "uninstall"
+
+
+def _kill_pod_action(scheduler, pod_instance_name: str) -> Callable[[], bool]:
+    """Kill all live tasks of the pod; complete when all are terminal
+    (reference ``TriggerDecommissionStep`` + ``TaskKillStep``)."""
+    def action() -> bool:
+        alive = False
+        for task_name in scheduler.pod_instance_task_names(pod_instance_name):
+            task = scheduler.state.fetch_task(task_name)
+            status = scheduler.state.fetch_status(task_name)
+            if (task and status and status.task_id == task.task_id
+                    and not status.state.terminal):
+                scheduler.cluster.kill(task.agent_id, task.task_id)
+                alive = True
+        return not alive
+    return action
+
+
+def _unreserve_pod_action(scheduler, pod_instance_name: str) -> Callable[[], bool]:
+    """Release the pod's reservations (reference ``ResourceCleanupStep``)."""
+    def action() -> bool:
+        removed = scheduler.ledger.remove_pod(pod_instance_name)
+        scheduler.reservation_store.remove(removed)
+        return True
+    return action
+
+
+def _erase_pod_action(scheduler, pod_instance_name: str) -> Callable[[], bool]:
+    """Erase the pod's task records (reference ``EraseTaskStateStep``)."""
+    def action() -> bool:
+        for task_name in scheduler.pod_instance_task_names(pod_instance_name):
+            scheduler.state.delete_task(task_name)
+        return True
+    return action
+
+
+def _pod_teardown_phase(scheduler, pod_instance_name: str,
+                        phase_prefix: str) -> Phase:
+    return Phase(
+        f"{phase_prefix}-{pod_instance_name}",
+        [
+            ActionStep(f"kill-{pod_instance_name}",
+                       _kill_pod_action(scheduler, pod_instance_name),
+                       asset=pod_instance_name),
+            ActionStep(f"unreserve-{pod_instance_name}",
+                       _unreserve_pod_action(scheduler, pod_instance_name),
+                       asset=pod_instance_name),
+            ActionStep(f"erase-{pod_instance_name}",
+                       _erase_pod_action(scheduler, pod_instance_name),
+                       asset=pod_instance_name),
+        ],
+        SerialStrategy())
+
+
+class DecommissionPlanManager(PlanManager):
+    """Regenerates phases for pod instances beyond the target count
+    (highest index first, reference ``DecommissionPlanFactory.java:101-147``)."""
+
+    def __init__(self, scheduler):
+        super().__init__(Plan(DECOMMISSION_PLAN_NAME, [], ParallelStrategy()))
+        self._scheduler = scheduler
+
+    def get_candidates(self, dirty_assets):
+        self._update_plan()
+        return super().get_candidates(dirty_assets)
+
+    def _update_plan(self) -> None:
+        spec: ServiceSpec = self._scheduler.spec
+        pods_by_type = {p.type: p for p in spec.pods}
+        excess: List[str] = []
+        for task in self._scheduler.state.fetch_tasks():
+            pod = pods_by_type.get(task.pod_type)
+            if pod is None or task.pod_index >= pod.count:
+                excess.append(task.pod_instance_name)
+        excess_sorted = sorted(set(excess),
+                               key=lambda n: -int(n.rsplit("-", 1)[1]))
+        # prune completed/stale phases; keep in-flight ones
+        existing = {}
+        for phase in self._plan.phases:
+            pod_name = phase.name.split("-", 1)[1]
+            if phase.status is Status.COMPLETE and pod_name not in excess_sorted:
+                continue
+            existing[pod_name] = phase
+        self._plan.children = [
+            existing.get(name) or _pod_teardown_phase(
+                self._scheduler, name, "decommission")
+            for name in excess_sorted
+        ] or list(existing.values())
+
+
+def build_uninstall_plan(scheduler) -> Plan:
+    """Full teardown: per-pod kill/unreserve/erase (parallel), then
+    deregister + wipe (reference ``UninstallPlanFactory.java:42-100``)."""
+    pod_names = sorted({t.pod_instance_name
+                        for t in scheduler.state.fetch_tasks()})
+    phases = [_pod_teardown_phase(scheduler, name, "uninstall")
+              for name in pod_names]
+
+    def deregister() -> bool:
+        scheduler.framework_store.clear()
+        scheduler.state.delete_all()
+        return True
+
+    phases.append(Phase("deregister", [ActionStep("deregister", deregister)],
+                        SerialStrategy()))
+    plan = Plan(UNINSTALL_PLAN_NAME, phases, SerialStrategy())
+    return plan

@@ -1,0 +1,247 @@
+"""Failure detection and the recovery plan manager.
+
+Reference: ``scheduler/recovery/`` — ``DefaultRecoveryPlanManager.java:53``
+(plan regenerated lazily on each candidates pass ``:140-145``; new failed
+pods ``:286-358``; transient->permanent escalation ``:380-400``),
+``RecoveryType.java``, ``FailureUtils`` (permanently-failed marker),
+``monitor/TimedFailureMonitor.java`` (auto-escalation from
+``ReplacementFailurePolicy``), ``RecoveryPlanOverriderFactory`` (service
+hooks, e.g. cassandra seed-replace).
+
+TPU addition — **gang recovery**: for a pod with ``TpuSpec(gang=True)``, one
+worker's permanent failure forces a whole-group barrier re-form: the failed
+instance is replaced AND every sibling is restarted in place so
+``jax.distributed`` can re-initialize with the same stable process ids
+(SURVEY.md section 7 hard part (3); the reference's closest analogue is
+``CassandraRecoveryPlanOverrider.java:53-162``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..plan.backoff import Backoff
+from ..plan.elements import DeploymentStep, Phase, Plan, Step
+from ..plan.manager import PlanManager
+from ..plan.requirement import PodInstanceRequirement, RecoveryType
+from ..plan.status import Status
+from ..plan.strategy import ParallelStrategy, SerialStrategy
+from ..specification.spec import GoalState, PodInstance, ServiceSpec
+from ..state.state_store import StateStore
+from ..state.tasks import StoredTask, TaskState, TaskStatus
+
+RECOVERY_PLAN_NAME = "recovery"
+
+# hook: (spec, pod_instance, recovery_type) -> Phase, or None to use default
+RecoveryOverrider = Callable[[ServiceSpec, PodInstance, RecoveryType],
+                             Optional[Phase]]
+
+
+class FailureMonitor:
+    """Decides when a failed task stops being TRANSIENT (relaunch in place)
+    and becomes PERMANENT (replace elsewhere)."""
+
+    def is_permanent(self, task: StoredTask, status: TaskStatus) -> bool:
+        raise NotImplementedError
+
+
+class NeverFailureMonitor(FailureMonitor):
+    """Reference ``NeverFailureMonitor`` — operators escalate manually via
+    ``pod replace``."""
+
+    def is_permanent(self, task, status) -> bool:
+        return False
+
+
+class TimedFailureMonitor(FailureMonitor):
+    """Reference ``TimedFailureMonitor`` — escalate after the
+    ``replacement-failure-policy`` timeout."""
+
+    def __init__(self, permanent_failure_timeout_s: float, clock=time.time):
+        self._timeout = permanent_failure_timeout_s
+        self._clock = clock
+
+    def is_permanent(self, task, status) -> bool:
+        return (self._clock() - status.timestamp) >= self._timeout
+
+
+class TestingFailureMonitor(FailureMonitor):
+    """Reference ``monitor/TestingFailureMonitor`` — force classification."""
+
+    __test__ = False  # not a pytest class
+
+    def __init__(self, *permanent_task_names: str):
+        self.permanent = set(permanent_task_names)
+
+    def is_permanent(self, task, status) -> bool:
+        return task.task_name in self.permanent
+
+
+def needs_recovery(task: StoredTask, status: Optional[TaskStatus]) -> bool:
+    """Reference ``TaskUtils.isRecoveryNeeded``: terminal-and-failed, or a
+    RUNNING-goal task that exited cleanly (must run forever)."""
+    if status is None:
+        return False
+    if task.goal is GoalState.RUNNING:
+        return status.state.terminal
+    return status.state.failed
+
+
+class RecoveryPlanManager(PlanManager):
+    """Rebuilds its plan from state-store failures on every candidates call."""
+
+    def __init__(self, spec_supplier: Callable[[], ServiceSpec],
+                 state_store: StateStore,
+                 failure_monitor: Optional[FailureMonitor] = None,
+                 backoff: Optional[Backoff] = None,
+                 overriders: Sequence[RecoveryOverrider] = ()):
+        super().__init__(Plan(RECOVERY_PLAN_NAME, [], ParallelStrategy()))
+        self._spec_supplier = spec_supplier
+        self._state = state_store
+        self._monitor = failure_monitor or NeverFailureMonitor()
+        self._backoff = backoff
+        self._overriders = list(overriders)
+
+    # -- plan regeneration --------------------------------------------------
+
+    def get_candidates(self, dirty_assets):
+        self._update_plan(dirty_assets)
+        return super().get_candidates(dirty_assets)
+
+    def _update_plan(self, dirty_assets) -> None:
+        """Add phases for newly-failed pods; prune phases that are COMPLETE
+        or stale (untouched AND the pod no longer needs recovery — e.g. the
+        deploy plan relaunched it first). The recovery plan is transient
+        state, unlike the deploy plan."""
+        spec = self._spec_supplier()
+        failures = self._find_failed_pods(spec)
+
+        kept = []
+        for phase in self._plan.phases:
+            if phase.status is Status.COMPLETE:
+                continue
+            started = any(
+                s.status not in (Status.PENDING, Status.DELAYED)
+                for s in phase.steps)
+            still_failing = any(
+                s.asset in failures for s in phase.steps if s.asset is not None)
+            if started or still_failing:
+                kept.append(phase)
+        self._plan.children = kept
+        existing_assets = {
+            step.asset
+            for phase in self._plan.phases for step in phase.steps
+            if step.asset is not None and not step.is_complete}
+        covered_by_gang = set()
+        for pod_instance_name, (pod_instance, recovery_type) in sorted(failures.items()):
+            if pod_instance_name in existing_assets or pod_instance_name in dirty_assets:
+                continue
+            if pod_instance_name in covered_by_gang:
+                continue
+            phase = self._phase_for(spec, pod_instance, recovery_type)
+            if phase is None:
+                continue
+            for step in phase.steps:
+                if step.asset:
+                    covered_by_gang.add(step.asset)
+            # don't add a phase that touches assets another recovery phase owns
+            if any(s.asset in existing_assets for s in phase.steps if s.asset):
+                continue
+            self._plan.children.append(phase)
+
+    def _find_failed_pods(self, spec: ServiceSpec
+                          ) -> Dict[str, tuple[PodInstance, RecoveryType]]:
+        """Reference ``getNewFailedPods`` (``DefaultRecoveryPlanManager.java:
+        286-358``): scan stored statuses, group by pod instance, classify."""
+        out: Dict[str, tuple[PodInstance, RecoveryType]] = {}
+        pods_by_type = {p.type: p for p in spec.pods}
+        for task in self._state.fetch_tasks():
+            pod = pods_by_type.get(task.pod_type)
+            if pod is None or task.pod_index >= pod.count:
+                continue  # decommission's business, not recovery's
+            status = self._state.fetch_status(task.task_name)
+            if status is not None and status.task_id != task.task_id:
+                continue  # stale status from an older launch
+            if not needs_recovery(task, status):
+                continue
+            recovery = RecoveryType.TRANSIENT
+            if task.permanently_failed:
+                recovery = RecoveryType.PERMANENT
+            elif self._monitor.is_permanent(task, status):
+                recovery = RecoveryType.PERMANENT
+                # persist the escalation (reference FailureUtils.
+                # setPermanentlyFailed) so the evaluator and any plan driving
+                # this pod see a replace, not a pinned relaunch
+                self._state.store_tasks([task.failed_permanently()])
+            pod_instance = PodInstance(pod, task.pod_index)
+            prev = out.get(pod_instance.name)
+            if prev is None or recovery is RecoveryType.PERMANENT:
+                out[pod_instance.name] = (pod_instance, recovery)
+        return out
+
+    def _phase_for(self, spec: ServiceSpec, pod_instance: PodInstance,
+                   recovery_type: RecoveryType) -> Optional[Phase]:
+        for overrider in self._overriders:
+            phase = overrider(spec, pod_instance, recovery_type)
+            if phase is not None:
+                return phase
+        pod = pod_instance.pod
+        if (pod.tpu is not None and pod.tpu.gang
+                and recovery_type is RecoveryType.PERMANENT):
+            return self._gang_phase(pod_instance, recovery_type)
+        return Phase(
+            f"recover-{pod_instance.name}",
+            [self._recovery_step(pod_instance, recovery_type)],
+            SerialStrategy())
+
+    def _gang_phase(self, failed: PodInstance,
+                    recovery_type: RecoveryType) -> Phase:
+        """Replace the failed worker first, then restart every sibling in
+        place (parallel) so the gang re-forms with stable ranks."""
+        pod = failed.pod
+        steps: List[Step] = [self._recovery_step(failed, recovery_type)]
+        for index in range(pod.count):
+            if index == failed.index:
+                continue
+            steps.append(self._recovery_step(
+                PodInstance(pod, index), RecoveryType.TRANSIENT,
+                name_suffix=":gang-restart"))
+        return Phase(f"recover-gang-{failed.name}", steps, SerialStrategy())
+
+    def _recovery_step(self, pod_instance: PodInstance,
+                       recovery_type: RecoveryType,
+                       name_suffix: str = "") -> DeploymentStep:
+        # recover the pod's failed tasks plus — for essential failures — the
+        # whole pod (the pod relaunches as a unit; nonessential-only failures
+        # relaunch just those tasks, reference RecoveryPlanManager essential
+        # semantics)
+        failed_tasks: List[str] = []
+        nonessential_only = True
+        for task_spec in pod_instance.pod.tasks:
+            instance_name = pod_instance.task_instance_name(task_spec.name)
+            task = self._state.fetch_task(instance_name)
+            status = self._state.fetch_status(instance_name) if task else None
+            if task is not None and needs_recovery(task, status):
+                failed_tasks.append(task_spec.name)
+                if task_spec.essential:
+                    nonessential_only = False
+        if not failed_tasks or not nonessential_only:
+            # essential failure (or forced recovery): whole pod, minus tasks
+            # already at a terminal goal (ONCE tasks don't re-run on recovery)
+            task_names = tuple(
+                t.name for t in pod_instance.pod.tasks
+                if not (t.goal is GoalState.ONCE and self._once_done(pod_instance, t.name)))
+        else:
+            task_names = tuple(failed_tasks)
+        return DeploymentStep(
+            name=f"{pod_instance.name}:[{','.join(task_names)}]{name_suffix}",
+            requirement=PodInstanceRequirement(
+                pod_instance, task_names, recovery_type=recovery_type),
+            backoff=self._backoff,
+            initial_status=Status.PENDING)
+
+    def _once_done(self, pod_instance: PodInstance, task_name: str) -> bool:
+        instance_name = pod_instance.task_instance_name(task_name)
+        status = self._state.fetch_status(instance_name)
+        return status is not None and status.state is TaskState.FINISHED

@@ -1,0 +1,54 @@
+"""Durable reservation records.
+
+Reference analogue: Mesos kept reservation truth in the cluster and the SDK
+recovered it from offers (``getUnexpectedResources`` GC,
+``DefaultScheduler.java:483-538``). We own the ledger, so it must be durable
+and rebuilt on scheduler restart — written in the same breath as the launch
+WAL (reservations BEFORE instructing the agent, mirroring
+``PersistentLaunchRecorder.record()`` before ``accept()``).
+
+Tree: ``Reservations/<pod_instance>__<resource_set_id>``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..matching.ledger import Reservation, ReservationLedger
+from .persister import NotFoundError, Persister
+from .state_store import _esc
+
+
+class ReservationStore:
+    ROOT = "Reservations"
+
+    def __init__(self, persister: Persister, namespace: str = ""):
+        self._persister = persister
+        self._ns = f"Services/{_esc(namespace)}/" if namespace else ""
+
+    def _key(self, reservation_key: tuple[str, str]) -> str:
+        pod, rs = reservation_key
+        return f"{self._ns}{self.ROOT}/{_esc(pod)}__{_esc(rs)}"
+
+    def store(self, reservations: Iterable[Reservation]) -> None:
+        values = {self._key(r.key): r.to_json() for r in reservations}
+        if values:
+            self._persister.set_many(values)
+
+    def remove(self, reservations: Iterable[Reservation]) -> None:
+        values = {self._key(r.key): None for r in reservations}
+        if values:
+            self._persister.set_many(values)
+
+    def load_ledger(self) -> ReservationLedger:
+        root = f"{self._ns}{self.ROOT}"
+        try:
+            children = self._persister.get_children(root)
+        except NotFoundError:
+            return ReservationLedger()
+        reservations = []
+        for child in children:
+            raw = self._persister.get_or_none(f"{root}/{child}")
+            if raw is not None:
+                reservations.append(Reservation.from_json(raw))
+        return ReservationLedger(reservations)

@@ -1,0 +1,97 @@
+"""Decommission + uninstall tests (reference
+``scheduler/decommission/DecommissionPlanFactoryTest``,
+``frameworks/helloworld/.../ServiceTest.java:374`` decommission scenario,
+``uninstall/UninstallSchedulerTest``)."""
+
+from dcos_commons_tpu.agent import AgentInfo, FakeCluster, PortRange
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler import ServiceScheduler
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import MemPersister
+
+YML3 = """
+name: svc
+pods:
+  node:
+    count: 3
+    allow-decommission: true
+    tasks:
+      server: {goal: RUNNING, cmd: run, cpus: 0.5, memory: 256}
+"""
+
+YML2 = YML3.replace("count: 3", "count: 2")
+
+
+def agents(n=3):
+    return [AgentInfo(agent_id=f"a{i}", hostname=f"h{i}", cpus=4,
+                      memory_mb=8192, disk_mb=8192,
+                      ports=(PortRange(10000, 10100),)) for i in range(n)]
+
+
+def test_scale_down_decommissions_highest_index():
+    persister = MemPersister()
+    cluster = FakeCluster(agents())
+    sched = ServiceScheduler(load_service_yaml_str(YML3, {}), persister, cluster)
+    sched.run_until_quiet()
+    assert len(sched.state.fetch_tasks()) == 3
+    reservations_before = len(sched.ledger.all())
+
+    sched2 = ServiceScheduler(load_service_yaml_str(YML2, {}), persister, cluster)
+    sched2.run_until_quiet()
+    # node-2 torn down: killed, unreserved, erased
+    names = {t.task_name for t in sched2.state.fetch_tasks()}
+    assert names == {"node-0-server", "node-1-server"}
+    assert len(sched2.ledger.all()) == reservations_before - 1
+    assert not any(r.pod_instance_name == "node-2"
+                   for r in sched2.ledger.all())
+    decommission = sched2.plan("decommission")
+    assert decommission.status is Status.COMPLETE
+    assert any("node-2" in tid for tid in cluster.kill_log)
+    # deploy plan unaffected
+    assert sched2.plan("deploy").status is Status.COMPLETE
+
+
+def test_scale_down_without_allow_decommission_rejected():
+    yml_locked = YML3.replace("allow-decommission: true",
+                              "allow-decommission: false")
+    persister = MemPersister()
+    cluster = FakeCluster(agents())
+    sched = ServiceScheduler(load_service_yaml_str(yml_locked, {}), persister, cluster)
+    sched.run_until_quiet()
+    shrunk = yml_locked.replace("count: 3", "count: 2")
+    sched2 = ServiceScheduler(load_service_yaml_str(shrunk, {}), persister, cluster)
+    assert sched2.config_errors
+    sched2.run_until_quiet()
+    assert len(sched2.state.fetch_tasks()) == 3  # nothing torn down
+
+
+def test_uninstall_tears_everything_down():
+    persister = MemPersister()
+    cluster = FakeCluster(agents())
+    sched = ServiceScheduler(load_service_yaml_str(YML3, {}), persister, cluster)
+    sched.run_until_quiet()
+    assert len(cluster.launch_log) == 3
+
+    sched_un = ServiceScheduler(load_service_yaml_str(YML3, {}), persister,
+                                cluster, uninstall=True)
+    sched_un.run_until_quiet()
+    assert sched_un.uninstall_complete
+    assert sched_un.state.fetch_tasks() == []
+    assert sched_un.ledger.all() == [] or all(
+        False for _ in sched_un.reservation_store.load_ledger().all())
+    assert len(cluster.kill_log) == 3
+    # no tasks left running on any agent
+    for agent in cluster.agents():
+        assert cluster.running_task_ids(agent.agent_id) == []
+
+
+def test_uninstall_plan_shape():
+    persister = MemPersister()
+    cluster = FakeCluster(agents())
+    sched = ServiceScheduler(load_service_yaml_str(YML3, {}), persister, cluster)
+    sched.run_until_quiet()
+    sched_un = ServiceScheduler(load_service_yaml_str(YML3, {}), persister,
+                                cluster, uninstall=True)
+    plan = sched_un.plan("uninstall")
+    assert [p.name for p in plan.phases] == [
+        "uninstall-node-0", "uninstall-node-1", "uninstall-node-2", "deregister"]

@@ -1,0 +1,339 @@
+"""End-to-end scheduler simulation tests against the fake cluster.
+
+Reference tier-2 coverage (``frameworks/helloworld/.../ServiceTest.java:43``
+default deployment, ``:228`` failure->recovery, ``:463-530``
+transient->permanent escalation; ``SchedulerRestartServiceTest.java``), plus
+the TPU gang scenarios the reference never had.
+"""
+
+import pytest
+
+from dcos_commons_tpu.agent import (AgentInfo, FakeCluster, PortRange,
+                                    TaskBehavior, TpuInventory)
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler import ServiceScheduler, TestingFailureMonitor
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import MemPersister, TaskState
+
+HELLO_YML = """
+name: hello-world
+pods:
+  hello:
+    count: 2
+    placement: '[["hostname", "UNIQUE"]]'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "echo hello && sleep 1000"
+        cpus: 0.5
+        memory: 256
+        env: {SLEEP: "1000"}
+  world:
+    count: 1
+    tasks:
+      init: {goal: ONCE, cmd: ./init, cpus: 0.1, memory: 32, essential: false}
+      server: {goal: RUNNING, cmd: ./world, cpus: 0.5, memory: 256}
+"""
+
+JAX_YML = """
+name: jax
+pods:
+  worker:
+    count: 2
+    tpu: {chips: 4, topology: v4-16}
+    resource-sets:
+      wres: {cpus: 2, memory: 4096, tpus: 4}
+    tasks:
+      train: {goal: RUNNING, cmd: python train.py, resource-set: wres}
+"""
+
+
+def cpu_agents(n):
+    return [AgentInfo(agent_id=f"a{i}", hostname=f"host{i}", cpus=4,
+                      memory_mb=16384, disk_mb=32768,
+                      ports=(PortRange(10000, 10100),))
+            for i in range(n)]
+
+
+def tpu_agents(n, slice_id="s0", topology="v4-16"):
+    return [AgentInfo(agent_id=f"t{i}", hostname=f"tpu{i}", cpus=8,
+                      memory_mb=32768, disk_mb=32768,
+                      tpu=TpuInventory(chips=4, slice_id=slice_id,
+                                       topology=topology, coords=(i, 0, 0),
+                                       worker_index=i))
+            for i in range(n)]
+
+
+def make(yml=HELLO_YML, agents=None, persister=None, cluster=None, **kw):
+    spec = load_service_yaml_str(yml, {})
+    persister = persister or MemPersister()
+    cluster = cluster or FakeCluster(agents if agents is not None else cpu_agents(3))
+    sched = ServiceScheduler(spec, persister, cluster, **kw)
+    return sched, cluster, persister
+
+
+class TestDeployment:
+    def test_deploys_to_complete(self):
+        sched, cluster, _ = make()
+        sched.run_until_quiet()
+        deploy = sched.plan("deploy")
+        assert deploy.status is Status.COMPLETE
+        assert sched.state.deploy_completed()
+        # hostname UNIQUE honored
+        hosts = {p.agent.hostname for p in cluster.launch_log
+                 if p.requirement.pod_instance.pod.type == "hello"}
+        assert len(hosts) == 2
+        # ONCE task ran to FINISHED, server RUNNING
+        assert sched.state.fetch_status("world-0-init").state is TaskState.FINISHED
+        assert sched.state.fetch_status("world-0-server").state is TaskState.RUNNING
+
+    def test_insufficient_cluster_blocks_not_crashes(self):
+        sched, cluster, _ = make(agents=cpu_agents(1))
+        sched.run_until_quiet()
+        # hello needs 2 unique hostnames; only 1 agent
+        deploy = sched.plan("deploy")
+        assert deploy.status is Status.IN_PROGRESS
+        assert sched.state.fetch_status("hello-0-server").state is TaskState.RUNNING
+        # outcome tracker explains why
+        summary = sched.outcome_tracker.to_dict()["failure_summary"]
+        assert any("hostname" in k for k in summary)
+        # adding an agent unblocks
+        cluster.add_agent(cpu_agents(2)[1])
+        sched.run_until_quiet()
+        assert deploy.status is Status.COMPLETE
+
+    def test_restart_is_idempotent(self):
+        sched, cluster, persister = make()
+        sched.run_until_quiet()
+        launches_before = len(cluster.launch_log)
+        # scheduler process restart: same persister, same cluster
+        spec = load_service_yaml_str(HELLO_YML, {})
+        sched2 = ServiceScheduler(spec, persister, cluster)
+        sched2.run_until_quiet()
+        assert sched2.plan("deploy").status is Status.COMPLETE
+        assert len(cluster.launch_log) == launches_before  # nothing relaunched
+        # ledger rebuilt from durable reservations
+        assert len(sched2.ledger.all()) == len(sched.ledger.all()) > 0
+
+
+class TestRecovery:
+    def test_transient_recovery_in_place(self):
+        sched, cluster, _ = make()
+        sched.run_until_quiet()
+        victim = cluster.task("hello-0-server")
+        old_agent = victim.agent_id
+        cluster.send_status(victim.task_id, TaskState.FAILED, message="oom")
+        sched.run_until_quiet()
+        assert sched.state.fetch_status("hello-0-server").state is TaskState.RUNNING
+        new_task = sched.state.fetch_task("hello-0-server")
+        assert new_task.agent_id == old_agent  # relaunched in place
+        assert sched.plan("recovery").status is Status.COMPLETE
+        assert sched.plan("deploy").status is Status.COMPLETE  # untouched
+
+    def test_permanent_recovery_via_monitor_moves_pod(self):
+        sched, cluster, persister = make(
+            failure_monitor=TestingFailureMonitor("hello-0-server"))
+        sched.run_until_quiet()
+        victim = cluster.task("hello-0-server")
+        old_agent = victim.agent_id
+        cluster.send_status(victim.task_id, TaskState.FAILED)
+        sched.run_until_quiet()
+        new_task = sched.state.fetch_task("hello-0-server")
+        assert sched.state.fetch_status("hello-0-server").state is TaskState.RUNNING
+        assert new_task.agent_id != old_agent  # replaced elsewhere
+        # old reservation released, new one held
+        agents_holding = {r.agent_id for r in sched.ledger.for_pod("hello-0")}
+        assert agents_holding == {new_task.agent_id}
+
+    def test_operator_pod_replace(self):
+        sched, cluster, _ = make()
+        sched.run_until_quiet()
+        old_agent = sched.state.fetch_task("hello-1-server").agent_id
+        sched.replace_pod("hello-1")
+        sched.run_until_quiet()
+        new_task = sched.state.fetch_task("hello-1-server")
+        assert new_task.agent_id != old_agent
+        assert not new_task.permanently_failed  # fresh record
+        assert sched.state.fetch_status("hello-1-server").state is TaskState.RUNNING
+
+    def test_operator_pod_restart(self):
+        sched, cluster, _ = make()
+        sched.run_until_quiet()
+        old_agent = sched.state.fetch_task("hello-1-server").agent_id
+        old_id = sched.state.fetch_task("hello-1-server").task_id
+        sched.restart_pod("hello-1")
+        sched.run_until_quiet()
+        new_task = sched.state.fetch_task("hello-1-server")
+        assert new_task.agent_id == old_agent
+        assert new_task.task_id != old_id
+
+    def test_nonessential_task_recovers_alone(self):
+        yml = HELLO_YML.replace(
+            "init: {goal: ONCE, cmd: ./init, cpus: 0.1, memory: 32, essential: false}",
+            "sidecar: {goal: RUNNING, cmd: ./side, cpus: 0.1, memory: 32, essential: false}")
+        sched, cluster, _ = make(yml)
+        sched.run_until_quiet()
+        server_id = sched.state.fetch_task("world-0-server").task_id
+        sidecar = cluster.task("world-0-sidecar")
+        cluster.send_status(sidecar.task_id, TaskState.FAILED)
+        sched.run_until_quiet()
+        # sidecar relaunched, server untouched
+        assert sched.state.fetch_status("world-0-sidecar").state is TaskState.RUNNING
+        assert sched.state.fetch_task("world-0-server").task_id == server_id
+
+    def test_agent_loss_detected_by_reconcile(self):
+        from dcos_commons_tpu.scheduler import TimedFailureMonitor
+        sched, cluster, persister = make()
+        sched.run_until_quiet()
+        dead_agent = sched.state.fetch_task("hello-0-server").agent_id
+        cluster.remove_agent(dead_agent)  # no statuses emitted — host vanished
+        # restart scheduler: reconcile synthesizes LOST; without escalation
+        # the pod stays pinned to its (gone) agent awaiting its return
+        spec = load_service_yaml_str(HELLO_YML, {})
+        sched2 = ServiceScheduler(spec, persister, cluster)
+        assert sched2.state.fetch_status("hello-0-server").state is TaskState.LOST
+        sched2.run_until_quiet()
+        assert sched2.state.fetch_status("hello-0-server").state is TaskState.LOST
+        # with a failure monitor the loss escalates to PERMANENT and moves
+        sched3 = ServiceScheduler(spec, persister, cluster,
+                                  failure_monitor=TimedFailureMonitor(0.0))
+        sched3.run_until_quiet()
+        new_task = sched3.state.fetch_task("hello-0-server")
+        assert sched3.state.fetch_status("hello-0-server").state is TaskState.RUNNING
+        assert new_task.agent_id != dead_agent
+
+    def test_zombie_task_killed_on_reconcile(self):
+        sched, cluster, persister = make()
+        sched.run_until_quiet()
+        # fabricate a zombie: agent runs a task the store no longer knows
+        victim = cluster.task("hello-0-server")
+        sched.state.delete_task("hello-0-server")
+        spec = load_service_yaml_str(HELLO_YML, {})
+        sched2 = ServiceScheduler(spec, persister, cluster)
+        assert victim.task_id in cluster.kill_log
+
+
+class TestCrashLoopBackoff:
+    def test_delayed_after_crashes(self):
+        from dcos_commons_tpu.plan import ExponentialBackoff
+        clock = [0.0]
+        backoff = ExponentialBackoff(initial_s=100, max_s=1000, factor=2.0,
+                                     clock=lambda: clock[0])
+        sched, cluster, _ = make(backoff=backoff)
+        cluster.script("hello-0-server", TaskBehavior.CRASH)
+        sched.run_until_quiet()
+        # crashed once, then backoff delays the relaunch
+        step = sched.plan("deploy").phases[0].steps[0]
+        assert step.status is Status.DELAYED
+        # time passes -> relaunch happens (still crashing -> delayed again)
+        clock[0] = 150
+        sched.run_until_quiet()
+        assert step.status is Status.DELAYED
+        # task fixed -> deploy completes
+        cluster.script("hello-0-server", TaskBehavior.AUTO_RUN)
+        clock[0] = 500
+        sched.run_until_quiet()
+        assert sched.plan("deploy").status is Status.COMPLETE
+
+
+class TestConfigUpdate:
+    def test_rolling_update_relaunches_changed_pods_only(self):
+        sched, cluster, persister = make()
+        sched.run_until_quiet()
+        world_id = sched.state.fetch_task("world-0-server").task_id
+        # change hello's env -> only hello pods roll
+        new_yml = HELLO_YML.replace('SLEEP: "1000"', 'SLEEP: "2000"')
+        spec2 = load_service_yaml_str(new_yml, {})
+        sched2 = ServiceScheduler(spec2, persister, cluster)
+        assert sched2.target_config_id != sched.target_config_id
+        deploy = sched2.plan("deploy")
+        hello_steps = {s.name: s.status for s in deploy.phases[0].steps}
+        assert all(s is Status.PENDING for s in hello_steps.values())
+        world_steps = [s.status for s in deploy.phases[1].steps]
+        assert world_steps == [Status.COMPLETE]
+        sched2.run_until_quiet()
+        assert deploy.status is Status.COMPLETE
+        assert sched2.state.fetch_task("hello-0-server").env["SLEEP"] == "2000"
+        assert sched2.state.fetch_task("world-0-server").task_id == world_id
+        # old tasks were killed before relaunch
+        assert len(cluster.kill_log) == 2
+
+    def test_invalid_update_keeps_old_target(self):
+        sched, cluster, persister = make()
+        sched.run_until_quiet()
+        bad_yml = HELLO_YML.replace("name: hello-world", "name: renamed")
+        spec2 = load_service_yaml_str(bad_yml, {})
+        sched2 = ServiceScheduler(spec2, persister, cluster)
+        assert sched2.config_errors
+        assert sched2.target_config_id == sched.target_config_id
+        assert sched2.spec.name == "hello-world"
+        assert sched2.plan("deploy").errors
+        assert sched2.plan("deploy").status is Status.ERROR
+
+    def test_noop_update_same_target(self):
+        sched, _, persister = make()
+        sched.run_until_quiet()
+        spec2 = load_service_yaml_str(HELLO_YML, {})
+        sched2 = ServiceScheduler(spec2, persister, FakeCluster(cpu_agents(3)))
+        assert sched2.target_config_id == sched.target_config_id
+
+
+class TestTpuGang:
+    def test_gang_deploy_with_stable_ranks(self):
+        sched, cluster, _ = make(JAX_YML, agents=tpu_agents(3))
+        sched.run_until_quiet()
+        assert sched.plan("deploy").status is Status.COMPLETE
+        t0 = sched.state.fetch_task("worker-0-train")
+        t1 = sched.state.fetch_task("worker-1-train")
+        assert t0.tpu.process_id == 0 and t1.tpu.process_id == 1
+        assert t0.tpu.num_processes == 2
+        assert t0.env["JAX_COORDINATOR_ADDRESS"] == "worker-0.jax.tpu.local:8476"
+        assert t0.env["JAX_COORDINATOR_ADDRESS"] == t1.env["JAX_COORDINATOR_ADDRESS"]
+        assert t0.tpu.slice_id == t1.tpu.slice_id == "s0"
+        assert t0.agent_id != t1.agent_id  # 4 chips each on 4-chip hosts
+
+    def test_gang_infeasible_without_full_slice(self):
+        # 2 hosts exist but in different slices -> all-or-nothing refusal
+        agents = tpu_agents(1, "s0") + [
+            AgentInfo(agent_id="tx", hostname="tpux", cpus=8, memory_mb=32768,
+                      tpu=TpuInventory(chips=4, slice_id="s1", topology="v4-16"))]
+        sched, cluster, _ = make(JAX_YML, agents=agents)
+        sched.run_until_quiet()
+        assert sched.plan("deploy").status is not Status.COMPLETE
+        assert len(cluster.launch_log) == 0  # nothing half-placed
+        summary = sched.outcome_tracker.to_dict()["failure_summary"]
+        assert any("all-or-nothing" in k for k in summary)
+
+    def test_gang_permanent_recovery_restarts_all_workers(self):
+        sched, cluster, _ = make(
+            JAX_YML, agents=tpu_agents(3),
+            failure_monitor=TestingFailureMonitor("worker-1-train"))
+        sched.run_until_quiet()
+        w0_before = sched.state.fetch_task("worker-0-train")
+        w1_agent_before = sched.state.fetch_task("worker-1-train").agent_id
+        victim = cluster.task("worker-1-train")
+        cluster.send_status(victim.task_id, TaskState.FAILED, message="chip down")
+        sched.run_until_quiet()
+        # worker-1 replaced, worker-0 restarted in place (gang re-form)
+        w0_after = sched.state.fetch_task("worker-0-train")
+        w1_after = sched.state.fetch_task("worker-1-train")
+        assert w1_after.agent_id != w1_agent_before
+        assert w0_after.task_id != w0_before.task_id       # restarted
+        assert w0_after.agent_id == w0_before.agent_id     # in place
+        # ranks stable across the re-form
+        assert w0_after.tpu.process_id == 0
+        assert w1_after.tpu.process_id == 1
+        assert sched.state.fetch_status("worker-0-train").state is TaskState.RUNNING
+        assert sched.state.fetch_status("worker-1-train").state is TaskState.RUNNING
+
+    def test_transient_gang_failure_relaunches_in_place_only(self):
+        sched, cluster, _ = make(JAX_YML, agents=tpu_agents(2))
+        sched.run_until_quiet()
+        w0_id = sched.state.fetch_task("worker-0-train").task_id
+        victim = cluster.task("worker-1-train")
+        old_agent = victim.agent_id
+        cluster.send_status(victim.task_id, TaskState.FAILED)
+        sched.run_until_quiet()
+        w1 = sched.state.fetch_task("worker-1-train")
+        assert w1.agent_id == old_agent
+        assert sched.state.fetch_task("worker-0-train").task_id == w0_id
