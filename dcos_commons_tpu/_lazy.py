@@ -1,0 +1,27 @@
+"""Shared lazy re-export helper for cycle-breaking package __init__s."""
+
+from __future__ import annotations
+
+import importlib
+from typing import Dict, Tuple
+
+
+def lazy_exports(package: str, mapping: Dict[str, str],
+                 package_globals: dict) -> Tuple:
+    """Return (__getattr__, __dir__) implementing cached lazy re-exports.
+
+    ``mapping`` maps exported name -> submodule. Resolved names are cached
+    into the package globals so each import runs once.
+    """
+    def __getattr__(name):
+        if name in mapping:
+            mod = importlib.import_module(f".{mapping[name]}", package)
+            value = getattr(mod, name)
+            package_globals[name] = value
+            return value
+        raise AttributeError(f"module {package!r} has no attribute {name!r}")
+
+    def __dir__():
+        return sorted(set(package_globals) | set(mapping))
+
+    return __getattr__, __dir__

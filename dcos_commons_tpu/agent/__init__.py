@@ -1,3 +1,15 @@
-from .client import AgentClient, StatusCallback
-from .fake import FakeCluster, FakeTask, TaskBehavior
-from .inventory import AgentInfo, PortRange, TaskRecord, TpuInventory
+"""Agent layer: inventory model, transport interface, fake in-process cluster.
+
+``client``/``fake`` are re-exported lazily: ``matching.evaluator`` imports
+``agent.inventory`` while ``specification`` is still initializing, and the
+eager chain (client -> state -> specification) would close an import cycle.
+"""
+
+from .inventory import AgentInfo, PortRange, TaskRecord, TpuInventory  # noqa: F401
+
+from .._lazy import lazy_exports
+
+__getattr__, __dir__ = lazy_exports(__name__, {
+    "AgentClient": "client", "StatusCallback": "client",
+    "FakeCluster": "fake", "FakeTask": "fake", "TaskBehavior": "fake",
+}, globals())

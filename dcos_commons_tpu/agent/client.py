@@ -13,11 +13,13 @@ is gone: launch, kill, reconcile. Implementations:
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Protocol, Sequence
+from typing import TYPE_CHECKING, Callable, Optional, Protocol, Sequence
 
-from ..matching.evaluator import LaunchPlan
 from ..state.tasks import TaskStatus
 from .inventory import AgentInfo
+
+if TYPE_CHECKING:  # break specification -> matching -> agent import cycle
+    from ..matching.evaluator import LaunchPlan
 
 StatusCallback = Callable[[str, TaskStatus], None]  # (task_name, status)
 

@@ -1,10 +1,22 @@
-from .evaluator import (Evaluator, LaunchPlan, TaskLaunch, service_hostname)
-from .ledger import (Availability, Reservation, ReservationLedger,
+"""Resource matching: evaluator pipeline, reservation ledger, placement DSL.
+
+``evaluator`` is re-exported lazily: ``specification.spec`` imports
+``matching.placement`` during its own init, and evaluator's eager deps
+(agent, plan) would close the cycle back into ``specification``.
+"""
+
+from .ledger import (Availability, Reservation, ReservationLedger,  # noqa: F401
                      VolumeReservation)
-from .outcome import EvaluationOutcome, OutcomeNode, OutcomeTracker
-from .placement import (AgentRule, AndRule, AttributeRule, HostnameRule,
+from .outcome import EvaluationOutcome, OutcomeNode, OutcomeTracker  # noqa: F401
+from .placement import (AgentRule, AndRule, AttributeRule, HostnameRule,  # noqa: F401
                         MaxPerHostnameRule, MaxPerRegionRule, MaxPerZoneRule,
                         NotRule, OrRule, Outcome, PlacementRule, RegionRule,
                         RoundRobinByHostnameRule, RoundRobinByZoneRule,
                         StringMatcher, TaskTypeRule, TpuSliceRule, ZoneRule,
                         parse_marathon_constraints, rule_from_json, rule_to_json)
+
+from .._lazy import lazy_exports
+
+__getattr__, __dir__ = lazy_exports(__name__, {
+    "Evaluator": "evaluator", "LaunchPlan": "evaluator",
+    "TaskLaunch": "evaluator", "service_hostname": "evaluator"}, globals())

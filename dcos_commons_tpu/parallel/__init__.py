@@ -1,0 +1,19 @@
+"""TPU-native parallelism layer.
+
+The reference SDK's only "parallelism" is deployment ordering
+(``sdk/scheduler/.../scheduler/plan/strategy/``) and its only distributed
+channel is the Mesos driver (``framework/SchedulerDriverFactory.java:27``).
+This package is the build's first-class replacement for the data plane:
+SPMD over a :class:`jax.sharding.Mesh` with XLA collectives riding ICI.
+
+Modules
+-------
+mesh            MeshSpec (dp/pp/sp/tp/ep axes), NamedSharding helpers
+distributed     ``jax.distributed`` bring-up from the bootstrap env contract
+ring_attention  sequence-parallel blockwise attention (shard_map + ppermute)
+ulysses         all-to-all head<->sequence resharded attention
+pipeline        pipeline-parallel microbatch loop (shard_map + ppermute)
+moe             expert-parallel mixture-of-experts (all_to_all dispatch)
+"""
+
+from .mesh import AXES, MeshSpec, named_sharding, P  # noqa: F401

@@ -1,0 +1,64 @@
+"""Multi-host bring-up from the scheduler's sandbox env contract.
+
+The reference's task-side bootstrap (``sdk/bootstrap/main.go:466-513``)
+injects DNS/env so tasks can find each other; our bootstrap (see
+``dcos_commons_tpu/bootstrap``) additionally exports the JAX distributed
+contract into every task sandbox:
+
+    JAX_COORDINATOR_ADDRESS   host:port of pod instance 0
+    JAX_PROCESS_ID            == POD_INSTANCE_INDEX
+    JAX_NUM_PROCESSES         pod count
+    TPU_SLICE_TOPOLOGY        e.g. "4x4" (informational)
+
+This module is the task-side consumer: call :func:`initialize` first thing
+in a training main; it is a no-op for single-process jobs so the same entry
+point runs on one chip or a pod.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+COORDINATOR_ENV = "JAX_COORDINATOR_ADDRESS"
+PROCESS_ID_ENV = "JAX_PROCESS_ID"
+NUM_PROCESSES_ENV = "JAX_NUM_PROCESSES"
+TOPOLOGY_ENV = "TPU_SLICE_TOPOLOGY"
+
+
+def env_contract(environ=None) -> Optional[dict]:
+    """Parse the bootstrap contract from ``environ``; None if absent."""
+    env = os.environ if environ is None else environ
+    addr = env.get(COORDINATOR_ENV)
+    if not addr:
+        return None
+    return {
+        "coordinator_address": addr,
+        "process_id": int(env.get(PROCESS_ID_ENV, "0")),
+        "num_processes": int(env.get(NUM_PROCESSES_ENV, "1")),
+        "topology": env.get(TOPOLOGY_ENV),
+    }
+
+
+def initialize(environ=None) -> dict:
+    """Bring up ``jax.distributed`` if the env contract asks for >1 process.
+
+    Returns the parsed contract (or a synthesized single-process one), so
+    callers can log their coordinates. Safe to call unconditionally.
+    """
+    contract = env_contract(environ)
+    if contract is None or contract["num_processes"] <= 1:
+        return contract or {"coordinator_address": None, "process_id": 0,
+                            "num_processes": 1, "topology": None}
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=contract["coordinator_address"],
+        num_processes=contract["num_processes"],
+        process_id=contract["process_id"])
+    log.info("jax.distributed up: process %d/%d via %s",
+             contract["process_id"], contract["num_processes"],
+             contract["coordinator_address"])
+    return contract

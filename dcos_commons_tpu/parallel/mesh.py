@@ -1,0 +1,112 @@
+"""Device-mesh construction and sharding helpers.
+
+TPU-first replacement for the reference's deployment-side notion of
+parallelism (``scheduler/plan/strategy/``): here parallelism is a physical
+device mesh with named axes, and "strategy" is a :class:`jax.sharding.
+PartitionSpec` over those axes. Collectives are inserted by XLA from the
+shardings; nothing in this module talks to the network directly.
+
+Canonical axis order (outer -> inner): ``dp, pp, sp, tp, ep``.  Inner axes
+(``tp``/``ep``) get the fastest ICI links when the physical topology allows,
+matching the usual cost ordering (tensor-parallel collectives are per-layer,
+data-parallel collectives are per-step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+#: canonical mesh axes, outermost first
+AXES: Tuple[str, ...] = ("dp", "pp", "sp", "tp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Sizes for each named mesh axis.
+
+    Axes of size 1 are kept in the mesh (they cost nothing and keep
+    PartitionSpecs uniform across configurations), so a model written once
+    against ``("dp", "pp", "sp", "tp", "ep")`` runs unchanged from 1 chip to
+    a multi-slice pod.
+    """
+
+    dp: int = 1   # data parallel (batch)
+    pp: int = 1   # pipeline parallel (layer stages)
+    sp: int = 1   # sequence/context parallel (ring attention)
+    tp: int = 1   # tensor/model parallel (weight shards)
+    ep: int = 1   # expert parallel (MoE experts)
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXES)
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.axis_sizes())
+
+    @classmethod
+    def auto(cls, n_devices: int,
+             prefer: Sequence[str] = ("tp", "pp", "ep", "sp")) -> "MeshSpec":
+        """Factorize ``n_devices`` into a full five-axis mesh.
+
+        Greedily gives each preferred axis a factor of 2 (so every
+        parallelism mode is genuinely exercised when enough devices exist),
+        then pours the remainder into ``dp``. 8 devices -> tp=2, pp=2, ep=2;
+        32 devices -> tp=2, pp=2, ep=2, sp=2, dp=2.
+        """
+        sizes = {a: 1 for a in AXES}
+        remaining = n_devices
+        for axis in prefer:
+            if remaining % 2 == 0 and remaining >= 2:
+                sizes[axis] = 2
+                remaining //= 2
+        sizes["dp"] = remaining
+        return cls(**sizes)
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        """Build a :class:`jax.sharding.Mesh` over ``devices``.
+
+        On TPU, ``mesh_utils.create_device_mesh`` lays axes onto the physical
+        ICI topology so inner-axis collectives ride the shortest links; on
+        CPU/virtual devices it falls back to a plain reshape.
+        """
+        if devices is None:
+            devices = jax.devices()
+        shape = self.axis_sizes()
+        if self.size != len(devices):
+            raise ValueError(
+                f"mesh {dict(zip(AXES, shape))} needs {self.size} devices, "
+                f"have {len(devices)}")
+        try:
+            from jax.experimental import mesh_utils
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=list(devices))
+        except Exception:
+            dev_array = np.array(list(devices)).reshape(shape)
+        return Mesh(dev_array, AXES)
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """``NamedSharding(mesh, P(*spec))`` with axis-name validation."""
+    for entry in spec:
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            if name is not None and name not in mesh.axis_names:
+                raise ValueError(
+                    f"axis {name!r} not in mesh axes {mesh.axis_names}")
+    return NamedSharding(mesh, P(*spec))
+
+
+def local_chunk(global_dim: int, mesh: Mesh, axis: str) -> int:
+    """Size of one shard of ``global_dim`` along mesh axis ``axis``."""
+    n = mesh.shape[axis]
+    if global_dim % n != 0:
+        raise ValueError(f"dim {global_dim} not divisible by {axis}={n}")
+    return global_dim // n

@@ -1,0 +1,81 @@
+"""Ring attention: sequence-parallel blockwise attention over an ICI ring.
+
+Long-context support is first-class in this build (the reference schedules
+databases, not models — SURVEY.md §5 "long-context"). The sequence dimension
+is sharded over the ``sp`` mesh axis; each step of the ring computes one
+(query-block x key-block) tile with a streaming (flash-style) softmax, then
+rotates the K/V shards one hop with ``lax.ppermute`` so per-hop transfers
+ride neighbouring ICI links and compute overlaps communication.
+
+Memory per device is O(S_local^2-free): activations are [B, S/ring, H, D];
+the full [S, S] score matrix never materializes.
+
+Used inside ``shard_map``; :func:`make_ring_attention` wires the specs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def _ring_attention_inner(q, k, v, *, axis_name: str, causal: bool,
+                          sm_scale: Optional[float]):
+    """Per-shard body. q/k/v: [B, S_local, H, D]; runs under shard_map."""
+    ring = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    # fp32 accumulators regardless of input dtype (bf16 in, fp32 softmax)
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = me * s_local + lax.iota(jnp.int32, s_local)
+
+    def step(carry, t):
+        o, m, l, k_cur, v_cur = carry
+        src = (me - t) % ring  # which shard's K/V we hold at ring step t
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            k_cur.astype(jnp.float32))
+        if causal:
+            k_pos = src * s_local + lax.iota(jnp.int32, s_local)
+            mask = q_pos[:, None] >= k_pos[None, :]          # [Sq, Sk]
+            scores = jnp.where(mask[None, None], scores, _NEG)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)          # kill masked 1s
+        alpha = jnp.exp(m - m_new)                           # [B, H, Sq]
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = (o * alpha.transpose(0, 2, 1)[..., None]
+                 + jnp.einsum("bhqk,bkhd->bqhd", p,
+                              v_cur.astype(jnp.float32)))
+        perm = [(j, (j + 1) % ring) for j in range(ring)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s_local), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    (o, _, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
+                                  jnp.arange(ring))
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, *, causal: bool = True,
+                        sm_scale: Optional[float] = None,
+                        spec: P = P("dp", "sp", "tp", None)):
+    """Build a [B, S, H, D] attention fn: S sharded over ``sp``, heads over
+    ``tp`` (head groups are independent, so ring + tensor parallel compose
+    with no extra collectives)."""
+    inner = functools.partial(_ring_attention_inner, axis_name="sp",
+                              causal=causal, sm_scale=sm_scale)
+    return jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)

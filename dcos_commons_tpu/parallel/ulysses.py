@@ -1,0 +1,64 @@
+"""Ulysses-style sequence parallelism: all-to-all head<->sequence reshard.
+
+The alternative long-context mode (SURVEY.md §2.4): instead of rotating K/V
+around a ring, one ``lax.all_to_all`` converts the layout from
+sequence-sharded/full-heads to full-sequence/head-sharded, attention runs
+locally over a head subset, and a second all-to-all restores the layout.
+Two collectives per attention call regardless of sequence length — cheaper
+than a ring when head count >= sp size and the all-to-all fits ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def full_attention(q, k, v, *, causal: bool, sm_scale: Optional[float] = None):
+    """Dense softmax attention, [B, S, H, D] layout, fp32 softmax."""
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                        k.astype(jnp.float32))
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = (lax.iota(jnp.int32, s_q)[:, None]
+                >= lax.iota(jnp.int32, s_k)[None, :])
+        scores = jnp.where(mask[None, None], scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _ulysses_inner(q, k, v, *, axis_name: str, causal: bool,
+                   sm_scale: Optional[float]):
+    """Per-shard body: [B, S_local, H, D] in, heads divisible by sp size."""
+    # scatter heads (axis 2), gather sequence (axis 1)
+    a2a = functools.partial(lax.all_to_all, axis_name=axis_name,
+                            split_axis=2, concat_axis=1, tiled=True)
+    out = full_attention(a2a(q), a2a(k), a2a(v), causal=causal,
+                         sm_scale=sm_scale)
+    # inverse: scatter sequence, gather heads
+    return lax.all_to_all(out, axis_name=axis_name, split_axis=1,
+                          concat_axis=2, tiled=True)
+
+
+def make_ulysses_attention(mesh: Mesh, *, causal: bool = True,
+                           sm_scale: Optional[float] = None,
+                           spec: P = P("dp", "sp", "tp", None)):
+    """[B, S, H, D] attention with S sharded over ``sp`` via head scatter.
+
+    Local head count (after any ``tp`` sharding) must be divisible by the
+    ``sp`` axis size.
+    """
+    inner = functools.partial(_ulysses_inner, axis_name="sp", causal=causal,
+                              sm_scale=sm_scale)
+    return jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)

@@ -1,0 +1,19 @@
+"""Force JAX onto a virtual 8-device CPU mesh (import before using jax).
+
+The environment's sitecustomize imports jax and registers a real-TPU PJRT
+backend at interpreter start, so setting ``JAX_PLATFORMS`` here is too late;
+``jax.config.update`` still wins because backend *selection* is lazy.
+Shared by conftest.py and ad-hoc scripts.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -3,17 +3,14 @@
 Multi-chip TPU hardware is not available in CI; all sharding/collective tests
 run on ``--xla_force_host_platform_device_count=8`` CPU devices, the same
 mechanism the driver uses for the multi-chip dry run (see
-``__graft_entry__.dryrun_multichip``). Must be set before jax is imported
-anywhere in the test process.
+``__graft_entry__.dryrun_multichip``). The environment's sitecustomize
+imports jax and registers the real-TPU backend before conftest runs, so the
+platform override lives in ``tests/_jax_cpu.py`` (env + jax.config.update).
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tests._jax_cpu  # noqa: E402,F401
