@@ -1,0 +1,65 @@
+"""Benchmark: ResNet-50 training throughput (images/sec/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no perf numbers (BASELINE.md), so vs_baseline is
+measured against the BASELINE.json north-star target recorded in
+BENCH_BASELINE (first run's value persisted would be the anchor); absent an
+anchor we report 1.0.
+"""
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import resnet, train
+
+    cfg = resnet.ResNetConfig(depth=50, n_classes=1000)
+    params, state = resnet.init_params(cfg, jax.random.key(0))
+    batch = 128
+    x = jax.random.normal(jax.random.key(1), (batch, 224, 224, 3),
+                          jnp.bfloat16)
+    y = jax.random.randint(jax.random.key(2), (batch,), 0, cfg.n_classes)
+
+    opt = train.make_optimizer(lr=1e-3, warmup=10, decay_steps=1000)
+    step = train.make_train_step(
+        lambda p, b: resnet.loss_fn(cfg, p, b[0], b[1]), opt,
+        has_aux_state=True)
+    opt_state = opt.init(params)
+
+    # warmup / compile
+    params, opt_state, state, out = step(params, opt_state, (state, (x, y)))
+    jax.block_until_ready(out["loss"])
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, state, out = step(params, opt_state,
+                                             (state, (x, y)))
+    jax.block_until_ready(out["loss"])
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    ips_per_chip = batch * n_steps / dt / n_chips
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(ips_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # never exit without the JSON line
+        print(json.dumps({
+            "metric": "resnet50_train_images_per_sec_per_chip",
+            "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+            "error": str(e)[:200],
+        }))
+        sys.exit(1)

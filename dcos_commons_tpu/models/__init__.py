@@ -1,0 +1,18 @@
+"""Model families for ``frameworks/jax`` workloads.
+
+Mirrors the reference's shipped example frameworks (helloworld / cassandra /
+hdfs under ``frameworks/``, SURVEY.md §2.3): here the "examples" are the
+BASELINE.json configs — MNIST MLP (single chip), ResNet-50 (data parallel),
+and the flagship Llama-style transformer (tensor/sequence/pipeline/expert
+parallel via ``dcos_commons_tpu.parallel``).
+
+All models are pure-functional JAX: params are pytrees of arrays, layers are
+stacked and scanned (one compiled layer body regardless of depth), weights
+ride in bf16 with fp32 master copies owned by the optimizer.
+"""
+
+from dcos_commons_tpu.models.mlp import MLPConfig
+from dcos_commons_tpu.models.resnet import ResNetConfig
+from dcos_commons_tpu.models.llama import LlamaConfig
+
+__all__ = ["MLPConfig", "ResNetConfig", "LlamaConfig"]

@@ -1,0 +1,271 @@
+"""Llama-style decoder-only transformer — the flagship model.
+
+TPU-first design decisions (vs. a torch port):
+
+* **Stacked layers + ``lax.scan``** — one compiled layer body, O(1) HLO size
+  in depth; XLA pipelines the per-layer matmuls onto the MXU.
+* **GSPMD sharding via `PartitionSpec`s** (`param_specs`): weights shard over
+  the ``tp`` mesh axis megatron-style (column-parallel in-proj, row-parallel
+  out-proj), activations over ``dp`` (batch) and ``sp`` (sequence); XLA
+  inserts the all-reduces on ICI.
+* **Swappable attention**: ``dense`` (GSPMD, any mesh), ``ring``
+  (`parallel.ring_attention`, long-context over an ICI ring), or ``ulysses``
+  (`parallel.ulysses`, all-to-all head scatter) — same [B, S, H, D] layout.
+* bf16 weights/activations, fp32 softmax/norm/logits.
+
+Reference parity: this is BASELINE.json config #5 ("Llama-3-8B inference,
+scheduler-placed model-parallel shards"); the reference repo itself ships no
+models (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dcos_commons_tpu.ops import (apply_rope, gqa_attention, repeat_kv,
+                                  rms_norm, rope_frequencies,
+                                  softmax_cross_entropy)
+from dcos_commons_tpu.parallel.ring_attention import make_ring_attention
+from dcos_commons_tpu.parallel.ulysses import make_ulysses_attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    attn_impl: str = "dense"        # dense | ring | ulysses
+    dtype: Any = jnp.bfloat16
+    remat: bool = True              # jax.checkpoint each layer (training)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """4-layer toy config for tests and the multi-chip dry run."""
+        defaults = dict(vocab_size=256, dim=64, n_layers=4, n_heads=8,
+                        n_kv_heads=4, ffn_dim=128, max_seq=128,
+                        remat=False)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# parameters
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
+    """Scaled-normal init; stacked [L, ...] layer weights."""
+    k = jax.random.split(key, 10)
+    d, f, L = cfg.dim, cfg.ffn_dim, cfg.n_layers
+    qd = cfg.n_heads * cfg.head_dim
+    kvd = cfg.n_kv_heads * cfg.head_dim
+    dt = cfg.dtype
+
+    def norm2(key, *shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] ** -0.5)
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        "embed": norm2(k[0], cfg.vocab_size, d, scale=d ** -0.5),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), dt),
+            "wq": norm2(k[1], L, d, qd),
+            "wk": norm2(k[2], L, d, kvd),
+            "wv": norm2(k[3], L, d, kvd),
+            "wo": norm2(k[4], L, qd, d, scale=(qd ** -0.5) / (2 * L) ** 0.5),
+            "ffn_norm": jnp.ones((L, d), dt),
+            "w_gate": norm2(k[5], L, d, f),
+            "w_up": norm2(k[6], L, d, f),
+            "w_down": norm2(k[7], L, f, d, scale=(f ** -0.5) / (2 * L) ** 0.5),
+        },
+        "norm": jnp.ones((d,), dt),
+        "lm_head": norm2(k[8], d, cfg.vocab_size),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """Megatron-style tp sharding: column-parallel in-projections,
+    row-parallel out-projections; embeddings sharded over vocab."""
+    return {
+        "embed": P("tp", None),
+        "layers": {
+            "attn_norm": P(),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "ffn_norm": P(),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+        "norm": P(),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def shard_params(params: Params, mesh: Mesh, cfg: LlamaConfig) -> Params:
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+def _make_attn_fn(cfg: LlamaConfig, mesh: Optional[Mesh]) -> Callable:
+    """Returns f(q, k, v) on [B, S, H, D] with H == n_heads (KV repeated)."""
+    if cfg.attn_impl == "dense" or mesh is None:
+        return lambda q, k, v: gqa_attention(q, k, v, causal=True)
+    if cfg.attn_impl == "ring":
+        return make_ring_attention(mesh, causal=True)
+    if cfg.attn_impl == "ulysses":
+        return make_ulysses_attention(mesh, causal=True)
+    raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
+
+
+def _constrain(x, mesh: Optional[Mesh], *spec):
+    if mesh is None:
+        return x
+    return lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+            mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """tokens [B, S] int32 -> logits [B, S, V] fp32."""
+    b, s = tokens.shape
+    rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    attn_fn = _make_attn_fn(cfg, mesh)
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = _constrain(x, mesh, "dp", "sp", None)
+
+    def layer(x, lp):
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, rope)
+        k = apply_rope(k, rope)
+        # ring/ulysses shard heads over tp: repeat KV so head counts match
+        o = attn_fn(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep))
+        x = x + o.reshape(b, s, -1) @ lp["wo"]
+        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
+        up = (h @ lp["w_up"]).astype(jnp.float32)
+        x = x + ((gate * up).astype(cfg.dtype) @ lp["w_down"])
+        x = _constrain(x, mesh, "dp", "sp", None)
+        return x, None
+
+    body = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return _constrain(logits, mesh, "dp", "sp", None)
+
+
+def loss_fn(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+            mesh: Optional[Mesh] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Next-token LM loss over tokens [B, S] -> (loss, accuracy)."""
+    logits = forward(cfg, params, tokens[:, :-1], mesh)
+    return softmax_cross_entropy(logits, tokens[:, 1:], z_loss=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode (inference path; BASELINE.json config #5)
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_seq: int) -> Params:
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def cache_specs() -> Params:
+    return {"k": P(None, "dp", None, "tp", None),
+            "v": P(None, "dp", None, "tp", None)}
+
+
+def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
+                pos: jnp.ndarray, token: jnp.ndarray,
+                mesh: Optional[Mesh] = None
+                ) -> Tuple[jnp.ndarray, Params]:
+    """One greedy-decode step.
+
+    token [B] int32, pos scalar int32 (current length). Returns
+    (logits [B, V], updated cache). Static shapes: the cache is a fixed
+    [max] ring written at ``pos`` via dynamic_update_slice, masked reads.
+    """
+    b = token.shape[0]
+    rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+
+    x = params["embed"].astype(cfg.dtype)[token][:, None, :]   # [B, 1, D]
+
+    def layer(carry, inputs):
+        x, layer_idx = carry
+        lp, k_cache, v_cache = inputs
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, rope, pos)
+        k = apply_rope(k, rope, pos)
+        k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
+        v_cache = lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
+        o = gqa_attention(q, k_cache, v_cache, causal=False,
+                          q_offset=pos, kv_len=pos + 1)
+        x = x + o.reshape(b, 1, -1) @ lp["wo"]
+        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
+        up = (h @ lp["w_up"]).astype(jnp.float32)
+        x = x + ((gate * up).astype(cfg.dtype) @ lp["w_down"])
+        return (x, layer_idx + 1), (k_cache, v_cache)
+
+    (x, _), (k_new, v_new) = lax.scan(
+        layer, (x, 0), (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = (x[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def generate(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,
+             steps: int, mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """Greedy generation: prefill via forward(), then scan decode steps."""
+    b, s = prompt.shape
+    cache = init_kv_cache(cfg, b, cfg.max_seq)
+    # prefill: run each prompt token through decode (simple, cache-exact)
+    def prefill(carry, i):
+        cache, _ = carry
+        logits, cache = decode_step(cfg, params, cache, i, prompt[:, i], mesh)
+        return (cache, logits), None
+    (cache, logits), _ = lax.scan(
+        prefill, (cache, jnp.zeros((b, cfg.vocab_size), jnp.float32)),
+        jnp.arange(s))
+
+    def step(carry, i):
+        cache, logits = carry
+        tok = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        logits, cache = decode_step(cfg, params, cache, s + i, tok, mesh)
+        return (cache, logits), tok
+
+    (_, _), toks = lax.scan(step, (cache, logits), jnp.arange(steps))
+    return jnp.swapaxes(toks, 0, 1)                        # [B, steps]

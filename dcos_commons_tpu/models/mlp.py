@@ -1,0 +1,57 @@
+"""MNIST MLP — the minimum end-to-end workload (BASELINE.json config #3).
+
+Single chip, no collectives: this is the model the `frameworks/jax` service
+deploys to prove the whole slice (spec -> plan -> match -> launch ->
+bootstrap -> train) before any parallelism is involved (SURVEY.md §7 step 9a).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dcos_commons_tpu.ops import softmax_cross_entropy
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Tuple[int, ...] = (512, 256)
+    n_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+
+def init_params(cfg: MLPConfig, key: jax.Array) -> Params:
+    dims = (cfg.in_dim,) + cfg.hidden + (cfg.n_classes,)
+    keys = jax.random.split(key, len(dims) - 1)
+    return {
+        f"layer{i}": {
+            "w": (jax.random.normal(k, (din, dout), jnp.float32)
+                  * (2.0 / din) ** 0.5).astype(cfg.dtype),
+            "b": jnp.zeros((dout,), cfg.dtype),
+        }
+        for i, (k, din, dout) in enumerate(zip(keys, dims[:-1], dims[1:]))
+    }
+
+
+def forward(cfg: MLPConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """x [B, in_dim] -> logits [B, n_classes] fp32."""
+    x = x.astype(cfg.dtype)
+    n = len(params)
+    for i in range(n):
+        lp = params[f"layer{i}"]
+        x = x @ lp["w"] + lp["b"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x.astype(jnp.float32)
+
+
+def loss_fn(cfg: MLPConfig, params: Params, batch: Tuple[jnp.ndarray,
+            jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    x, y = batch
+    return softmax_cross_entropy(forward(cfg, params, x), y)

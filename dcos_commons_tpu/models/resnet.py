@@ -1,0 +1,170 @@
+"""ResNet-50 — the data-parallel north-star workload (BASELINE.json #4).
+
+TPU-first choices: NHWC layout (the TPU-native conv layout), bf16 weights
+and activations with fp32 batch-norm statistics, and a pure-functional
+(params, state) split so the whole train step jits as one XLA program with
+the cross-replica gradient all-reduce inserted by GSPMD from the ``dp``
+batch sharding. BN running stats are updated in the step (momentum EMA).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dcos_commons_tpu.ops import softmax_cross_entropy
+
+Params = Dict[str, Any]
+BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
+          101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+BOTTLENECK = {50, 101, 152}
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    depth: int = 50
+    n_classes: int = 1000
+    width: int = 64
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def stage_blocks(self) -> Tuple[int, ...]:
+        return BLOCKS[self.depth]
+
+    @property
+    def bottleneck(self) -> bool:
+        return self.depth in BOTTLENECK
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+            * (2.0 / fan_in) ** 0.5).astype(dtype)
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def init_params(cfg: ResNetConfig, key: jax.Array) -> Tuple[Params, Params]:
+    """Returns (params, bn_state)."""
+    keys = iter(jax.random.split(key, 256))
+    p: Params = {"stem": {"conv": _conv_init(next(keys), 7, 7, 3, cfg.width,
+                                             cfg.dtype),
+                          "bn": _bn_init(cfg.width)}}
+    s: Params = {"stem": {"bn": _bn_state(cfg.width)}}
+    cin = cfg.width
+    expansion = 4 if cfg.bottleneck else 1
+    for stage, n_blocks in enumerate(cfg.stage_blocks):
+        width = cfg.width * (2 ** stage)
+        cout = width * expansion
+        for b in range(n_blocks):
+            name = f"stage{stage}_block{b}"
+            stride = 2 if (b == 0 and stage > 0) else 1
+            bp: Params = {}
+            bs: Params = {}
+            if cfg.bottleneck:
+                convs = [(1, 1, cin, width, 1), (3, 3, width, width, stride),
+                         (1, 1, width, cout, 1)]
+            else:
+                convs = [(3, 3, cin, width, stride), (3, 3, width, cout, 1)]
+            for i, (kh, kw, ci, co, st) in enumerate(convs):
+                bp[f"conv{i}"] = _conv_init(next(keys), kh, kw, ci, co,
+                                            cfg.dtype)
+                bp[f"bn{i}"] = _bn_init(co)
+                bs[f"bn{i}"] = _bn_state(co)
+            if b == 0 and (cin != cout or stride != 1):
+                bp["proj"] = _conv_init(next(keys), 1, 1, cin, cout,
+                                        cfg.dtype)
+                bp["proj_bn"] = _bn_init(cout)
+                bs["proj_bn"] = _bn_state(cout)
+            p[name], s[name] = bp, bs
+            cin = cout
+    p["head"] = {"w": (jax.random.normal(next(keys), (cin, cfg.n_classes),
+                                         jnp.float32)
+                       * cin ** -0.5).astype(cfg.dtype),
+                 "b": jnp.zeros((cfg.n_classes,), jnp.float32)}
+    return p, s
+
+
+def _batch_norm(x, bn, st, cfg, train):
+    x32 = x.astype(jnp.float32)
+    if train:
+        mean = x32.mean(axis=(0, 1, 2))
+        var = x32.var(axis=(0, 1, 2))
+        m = cfg.bn_momentum
+        new_st = {"mean": m * st["mean"] + (1 - m) * mean,
+                  "var": m * st["var"] + (1 - m) * var}
+    else:
+        mean, var = st["mean"], st["var"]
+        new_st = st
+    y = (x32 - mean) * lax.rsqrt(var + cfg.bn_eps)
+    return (y * bn["scale"] + bn["bias"]).astype(x.dtype), new_st
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def forward(cfg: ResNetConfig, params: Params, state: Params,
+            x: jnp.ndarray, train: bool = True
+            ) -> Tuple[jnp.ndarray, Params]:
+    """x [B, H, W, 3] -> (logits [B, n_classes] fp32, new bn_state)."""
+    x = x.astype(cfg.dtype)
+    new_state: Params = {}
+    x = _conv(x, params["stem"]["conv"], stride=2)
+    x, st = _batch_norm(x, params["stem"]["bn"], state["stem"]["bn"], cfg,
+                        train)
+    new_state["stem"] = {"bn": st}
+    x = jax.nn.relu(x)
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                          "SAME")
+    for stage, n_blocks in enumerate(cfg.stage_blocks):
+        for b in range(n_blocks):
+            name = f"stage{stage}_block{b}"
+            bp, bs = params[name], state[name]
+            ns: Params = {}
+            stride = 2 if (b == 0 and stage > 0) else 1
+            shortcut = x
+            y = x
+            n_convs = 3 if cfg.bottleneck else 2
+            for i in range(n_convs):
+                st_i = stride if ((cfg.bottleneck and i == 1)
+                                  or (not cfg.bottleneck and i == 0)) else 1
+                y = _conv(y, bp[f"conv{i}"], st_i)
+                y, ns[f"bn{i}"] = _batch_norm(y, bp[f"bn{i}"], bs[f"bn{i}"],
+                                              cfg, train)
+                if i < n_convs - 1:
+                    y = jax.nn.relu(y)
+            if "proj" in bp:
+                shortcut = _conv(shortcut, bp["proj"], stride)
+                shortcut, ns["proj_bn"] = _batch_norm(
+                    shortcut, bp["proj_bn"], bs["proj_bn"], cfg, train)
+            x = jax.nn.relu(y + shortcut)
+            new_state[name] = ns
+    x = x.mean(axis=(1, 2)).astype(jnp.float32)          # global avg pool
+    logits = x @ params["head"]["w"].astype(jnp.float32) + params["head"]["b"]
+    return logits, new_state
+
+
+def loss_fn(cfg: ResNetConfig, params: Params, state: Params,
+            batch: Tuple[jnp.ndarray, jnp.ndarray]
+            ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, Params]]:
+    x, y = batch
+    logits, new_state = forward(cfg, params, state, x, train=True)
+    loss, acc = softmax_cross_entropy(logits, y)
+    return loss, (acc, new_state)

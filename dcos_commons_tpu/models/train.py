@@ -1,0 +1,92 @@
+"""Train-step builders: optax optimizer + GSPMD sharding in one jit.
+
+The training loop the ``frameworks/jax`` tasks run. Parallelism is purely
+declarative: params carry `NamedSharding`s from `param_specs`, the batch is
+sharded ("dp", ...) and XLA emits the gradient all-reduce over ICI — no
+hand-written collectives in the step (SURVEY.md §2.4 "Collectives backend").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01,
+                   warmup: int = 100, decay_steps: int = 10000,
+                   grad_clip: float = 1.0) -> optax.GradientTransformation:
+    sched = optax.warmup_cosine_decay_schedule(0.0, lr, warmup, decay_steps)
+    return optax.chain(optax.clip_by_global_norm(grad_clip),
+                       optax.adamw(sched, weight_decay=weight_decay))
+
+
+def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
+                    mesh: Optional[Mesh] = None,
+                    param_spec_tree: Any = None,
+                    batch_spec: Any = P("dp"),
+                    has_aux_state: bool = False) -> Callable:
+    """Build a jitted ``step(params, opt_state, batch[, aux]) -> ...``.
+
+    ``loss_fn(params, batch)`` -> scalar loss (or ``(loss, (metric, aux))``
+    when ``has_aux_state`` — the ResNet BN-state pattern).
+    With a mesh, params/opt-state are pinned to ``param_spec_tree`` and the
+    batch to ``batch_spec`` so GSPMD never resolves shardings ambiguously.
+    """
+
+    def step(params, opt_state, batch):
+        if has_aux_state:
+            (loss, (metric, aux)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+        else:
+            (loss, metric), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            aux = None
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        out = {"loss": loss, "metric": metric}
+        if has_aux_state:
+            return params, opt_state, aux, out
+        return params, opt_state, out
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def shardings_like(tree, spec_tree):
+        if spec_tree is None:
+            return None
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                            is_leaf=lambda s: isinstance(s, P))
+
+    p_shard = shardings_like(None, param_spec_tree)
+    b_shard = jax.tree.map(lambda s: NamedSharding(mesh, s), batch_spec,
+                           is_leaf=lambda s: isinstance(s, P))
+    # opt-state sharding mirrors params; let GSPMD propagate it from inputs.
+    return jax.jit(step, donate_argnums=(0, 1),
+                   in_shardings=(p_shard, None, b_shard) if p_shard
+                   else None)
+
+
+def init_opt_state(optimizer: optax.GradientTransformation, params,
+                   mesh: Optional[Mesh] = None,
+                   param_spec_tree: Any = None):
+    """Init optimizer state; with a mesh, moments inherit param shardings."""
+    opt_state = optimizer.init(params)
+    if mesh is None or param_spec_tree is None:
+        return opt_state
+    spec_by_shape: Dict[Tuple[int, ...], Any] = {}
+    flat_p = jax.tree.leaves(params)
+    flat_s = jax.tree.leaves(param_spec_tree,
+                             is_leaf=lambda s: isinstance(s, P))
+    for leaf, spec in zip(flat_p, flat_s):
+        spec_by_shape.setdefault(leaf.shape, spec)
+
+    def place(x):
+        if hasattr(x, "shape") and x.shape in spec_by_shape:
+            return jax.device_put(x, NamedSharding(mesh,
+                                                   spec_by_shape[x.shape]))
+        return x
+    return jax.tree.map(place, opt_state)

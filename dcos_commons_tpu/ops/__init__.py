@@ -1,0 +1,17 @@
+"""TPU-first primitive ops shared by the model families.
+
+Everything here is shape-static, jit-traceable, and bf16-in/fp32-accumulate
+so XLA can tile the matmuls onto the MXU and fuse the elementwise tails.
+"""
+
+from dcos_commons_tpu.ops.norms import rms_norm, layer_norm
+from dcos_commons_tpu.ops.rotary import rope_frequencies, apply_rope
+from dcos_commons_tpu.ops.attention import gqa_attention, repeat_kv
+from dcos_commons_tpu.ops.losses import softmax_cross_entropy
+
+__all__ = [
+    "rms_norm", "layer_norm",
+    "rope_frequencies", "apply_rope",
+    "gqa_attention", "repeat_kv",
+    "softmax_cross_entropy",
+]

@@ -1,0 +1,57 @@
+"""Dense grouped-query attention (the jit/GSPMD path).
+
+Layout is [B, S, H, D] throughout (matching ``parallel.ring_attention`` and
+``parallel.ulysses`` so the three attention impls are drop-in swappable).
+Softmax is fp32; inputs/outputs ride in the caller's dtype (bf16 on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def repeat_kv(kv: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[B, S, KV, D] -> [B, S, KV*n_rep, D] by head-group broadcast."""
+    if n_rep == 1:
+        return kv
+    b, s, h, d = kv.shape
+    return jnp.broadcast_to(kv[:, :, :, None, :],
+                            (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def gqa_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                  causal: bool = True,
+                  sm_scale: Optional[float] = None,
+                  q_offset: int | jnp.ndarray = 0,
+                  kv_len: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Attention with K/V head broadcast for GQA.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, KV, D] with H % KV == 0.
+    ``q_offset`` shifts query positions (decode: Sq=1, offset=cache length).
+    ``kv_len`` optionally masks out cache slots >= kv_len (padded KV cache).
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                        k.astype(jnp.float32))
+    s_q, s_k = scores.shape[-2], scores.shape[-1]
+    mask = None
+    if causal:
+        q_pos = q_offset + lax.iota(jnp.int32, s_q)[:, None]
+        mask = q_pos >= lax.iota(jnp.int32, s_k)[None, :]
+    if kv_len is not None:
+        valid = lax.iota(jnp.int32, s_k)[None, :] < kv_len
+        mask = valid if mask is None else (mask & valid)
+    if mask is not None:
+        scores = jnp.where(mask[None, None], scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,32 @@
+"""Loss functions (fp32 reductions, optional z-loss stabilizer)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray, *,
+                          mask: Optional[jnp.ndarray] = None,
+                          z_loss: float = 0.0
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Mean token cross-entropy. logits [..., V], labels [...] int32.
+
+    Returns (loss, accuracy). ``z_loss`` adds the usual log-Z^2 penalty that
+    keeps bf16 logits from drifting (weight is typically 1e-4).
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(logits, labels[..., None],
+                                     axis=-1)[..., 0]
+    nll = logz - true_logit
+    if z_loss:
+        nll = nll + z_loss * logz ** 2
+    correct = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    if mask is not None:
+        m = mask.astype(jnp.float32)
+        denom = jnp.maximum(m.sum(), 1.0)
+        return (nll * m).sum() / denom, (correct * m).sum() / denom
+    return nll.mean(), correct.mean()

@@ -1,0 +1,38 @@
+"""Rotary position embeddings (RoPE), decode-offset aware."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rope_frequencies(head_dim: int, max_seq: int,
+                     theta: float = 10000.0) -> jnp.ndarray:
+    """Precompute [max_seq, head_dim//2] complex-free cos/sin table.
+
+    Returns a stacked [2, max_seq, head_dim//2] fp32 array (cos, sin) so the
+    table lives in one buffer and slices cleanly under jit.
+    """
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                      dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                       # [S, D/2]
+    return jnp.stack([jnp.cos(freqs), jnp.sin(freqs)])
+
+
+def apply_rope(x: jnp.ndarray, table: jnp.ndarray,
+               offset: int | jnp.ndarray = 0) -> jnp.ndarray:
+    """Rotate [B, S, H, D] by positions ``offset..offset+S``.
+
+    ``offset`` may be a traced scalar (decode step); the slice uses
+    ``lax.dynamic_slice_in_dim`` so shapes stay static.
+    """
+    seq = x.shape[1]
+    half = x.shape[-1] // 2
+    cos = lax.dynamic_slice_in_dim(table[0], offset, seq)[None, :, None, :]
+    sin = lax.dynamic_slice_in_dim(table[1], offset, seq)[None, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)

@@ -34,6 +34,12 @@ def env_contract(environ=None) -> Optional[dict]:
     env = os.environ if environ is None else environ
     addr = env.get(COORDINATOR_ENV)
     if not addr:
+        n = int(env.get(NUM_PROCESSES_ENV, "1"))
+        if n > 1:
+            raise RuntimeError(
+                f"{NUM_PROCESSES_ENV}={n} but {COORDINATOR_ENV} is unset/"
+                "empty — refusing to run an unsynchronized multi-process "
+                "job as single-process")
         return None
     return {
         "coordinator_address": addr,

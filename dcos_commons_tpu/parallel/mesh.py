@@ -84,12 +84,12 @@ class MeshSpec:
             raise ValueError(
                 f"mesh {dict(zip(AXES, shape))} needs {self.size} devices, "
                 f"have {len(devices)}")
-        try:
+        if devices and devices[0].platform == "cpu":
+            dev_array = np.array(list(devices)).reshape(shape)
+        else:
             from jax.experimental import mesh_utils
             dev_array = mesh_utils.create_device_mesh(
                 shape, devices=list(devices))
-        except Exception:
-            dev_array = np.array(list(devices)).reshape(shape)
         return Mesh(dev_array, AXES)
 
 

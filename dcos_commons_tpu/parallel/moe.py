@@ -12,6 +12,7 @@ Inner (manual-collective) body + self-contained test wrapper, mirroring
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Tuple
 
 import jax
@@ -26,8 +27,8 @@ class MoEConfig:
     capacity_factor: float = 2.0  # tokens-per-expert = G/E * factor
 
     def capacity(self, num_tokens: int) -> int:
-        return max(1, int(num_tokens * self.capacity_factor
-                          / self.num_experts))
+        return max(1, math.ceil(num_tokens * self.capacity_factor
+                                / self.num_experts))
 
 
 def top2_dispatch(gates: jnp.ndarray, capacity: int
@@ -83,8 +84,10 @@ def moe_apply(x: jnp.ndarray, router_w: jnp.ndarray, w_in: jnp.ndarray,
     D, F] / w_out: [E_local, F, D] — this shard's experts.
     """
     ep = lax.axis_size(axis_name)
-    g, d = x.shape
-    e = cfg.num_experts
+    if cfg.num_experts % ep != 0:
+        raise ValueError(
+            f"num_experts={cfg.num_experts} not divisible by ep={ep}")
+    g = x.shape[0]
     cap = cfg.capacity(g)
     gates = jax.nn.softmax(
         jnp.einsum("gd,de->ge", x.astype(jnp.float32),

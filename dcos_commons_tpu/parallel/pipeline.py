@@ -18,7 +18,6 @@ schedule automatically.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable
 
 import jax
