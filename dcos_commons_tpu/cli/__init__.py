@@ -1,0 +1,14 @@
+"""tpuctl — operator CLI for the scheduler HTTP API.
+
+Reference: the Go CLI (``cli/commands.go:38-52``): ``dcos <svc>
+plan|pod|endpoints|debug|describe|update`` speaking the scheduler HTTP API
+via the DC/OS adminrouter (``cli/client/http.go``). Here: ``tpuctl`` (or
+``python -m dcos_commons_tpu.cli``) speaking the same ``/v1/*`` surface
+directly; ``--url`` / ``TPU_SCHEDULER_URL`` select the scheduler, and
+``--service <name>`` routes through the multi-service mount.
+A native C++ build of the same CLI lives in ``native/cli``.
+"""
+
+from dcos_commons_tpu.cli.main import main
+
+__all__ = ["main"]

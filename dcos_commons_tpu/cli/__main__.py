@@ -1,0 +1,5 @@
+import sys
+
+from dcos_commons_tpu.cli.main import main
+
+sys.exit(main())

@@ -1,0 +1,180 @@
+"""CLI implementation (argparse; stdlib only)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+class Client:
+    def __init__(self, base_url: str, service: Optional[str] = None):
+        self.base = base_url.rstrip("/")
+        self.prefix = f"/v1/service/{service}" if service else "/v1"
+
+    def call(self, method: str, path: str, body: Optional[bytes] = None):
+        url = f"{self.base}{self.prefix}/{path.lstrip('/')}"
+        req = urllib.request.Request(url, method=method, data=body)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read().decode() or "null")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read().decode())
+            except ValueError:
+                return e.code, {"error": str(e)}
+
+    def get(self, path):
+        return self.call("GET", path)
+
+    def post(self, path, body=None):
+        return self.call("POST", path, body)
+
+
+def _emit(code: int, payload) -> int:
+    print(json.dumps(payload, indent=2))
+    return 0 if code < 400 else 1
+
+
+def _plan_cmd(client: Client, args) -> int:
+    a = args.action
+    if a == "list":
+        return _emit(*client.get("plans"))
+    name = args.plan
+    if a == "show":
+        return _emit(*client.get(f"plans/{name}"))
+    qs = []
+    if getattr(args, "phase", None):
+        qs.append(f"phase={args.phase}")
+    if getattr(args, "step", None):
+        qs.append(f"step={args.step}")
+    suffix = ("?" + "&".join(qs)) if qs else ""
+    verb = {"start": "start", "stop": "stop", "continue": "continue",
+            "interrupt": "interrupt", "force-complete": "forceComplete",
+            "restart": "restart"}[a]
+    return _emit(*client.post(f"plans/{name}/{verb}{suffix}"))
+
+
+def _pod_cmd(client: Client, args) -> int:
+    a = args.action
+    if a == "list":
+        return _emit(*client.get("pod"))
+    if a == "status":
+        path = f"pod/{args.pod}/status" if args.pod else "pod/status"
+        return _emit(*client.get(path))
+    if a == "info":
+        return _emit(*client.get(f"pod/{args.pod}/info"))
+    body = None
+    if getattr(args, "tasks", None):
+        body = json.dumps({"tasks": args.tasks}).encode()
+    return _emit(*client.post(f"pod/{args.pod}/{a}", body))
+
+
+def _endpoints_cmd(client: Client, args) -> int:
+    if args.name:
+        return _emit(*client.get(f"endpoints/{args.name}"))
+    return _emit(*client.get("endpoints"))
+
+
+def _debug_cmd(client: Client, args) -> int:
+    path = {"offers": "debug/offers", "plans": "debug/plans",
+            "statuses": "debug/taskStatuses",
+            "reservations": "debug/reservations"}[args.what]
+    return _emit(*client.get(path))
+
+
+def _describe_cmd(client: Client, args) -> int:
+    return _emit(*client.get("configurations/target"))
+
+
+def _config_cmd(client: Client, args) -> int:
+    if args.action == "list":
+        return _emit(*client.get("configurations"))
+    if args.action == "target-id":
+        return _emit(*client.get("configurations/targetId"))
+    return _emit(*client.get(f"configurations/{args.config_id}"))
+
+
+def _state_cmd(client: Client, args) -> int:
+    if args.action == "framework-id":
+        return _emit(*client.get("state/frameworkId"))
+    if args.action == "properties":
+        return _emit(*client.get("state/properties"))
+    return _emit(*client.get(f"state/properties/{args.key}"))
+
+
+def _health_cmd(client: Client, args) -> int:
+    return _emit(*client.get("health"))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpuctl", description="Operator CLI for a TPU-SDK scheduler")
+    p.add_argument("--url", default=os.environ.get("TPU_SCHEDULER_URL",
+                                                   "http://127.0.0.1:8080"))
+    p.add_argument("--service", default=None,
+                   help="service name for multi-service schedulers")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    plan = sub.add_parser("plan", help="manage rollout plans")
+    plan.add_argument("action", choices=["list", "show", "start", "stop",
+                                         "continue", "interrupt",
+                                         "force-complete", "restart"])
+    plan.add_argument("plan", nargs="?", default="deploy")
+    plan.add_argument("--phase")
+    plan.add_argument("--step")
+    plan.set_defaults(fn=_plan_cmd)
+
+    pod = sub.add_parser("pod", help="inspect/operate pod instances")
+    pod.add_argument("action", choices=["list", "status", "info", "restart",
+                                        "replace", "pause", "resume"])
+    pod.add_argument("pod", nargs="?")
+    pod.add_argument("--tasks", nargs="*")
+    pod.set_defaults(fn=_pod_cmd)
+
+    ep = sub.add_parser("endpoints", help="service connection endpoints")
+    ep.add_argument("name", nargs="?")
+    ep.set_defaults(fn=_endpoints_cmd)
+
+    dbg = sub.add_parser("debug", help="scheduler internals")
+    dbg.add_argument("what", choices=["offers", "plans", "statuses",
+                                      "reservations"])
+    dbg.set_defaults(fn=_debug_cmd)
+
+    sub.add_parser("describe",
+                   help="show target configuration").set_defaults(
+        fn=_describe_cmd)
+
+    cfg = sub.add_parser("config", help="configuration history")
+    cfg.add_argument("action", choices=["list", "show", "target-id"])
+    cfg.add_argument("config_id", nargs="?")
+    cfg.set_defaults(fn=_config_cmd)
+
+    st = sub.add_parser("state", help="framework state")
+    st.add_argument("action", choices=["framework-id", "properties",
+                                       "property"])
+    st.add_argument("key", nargs="?")
+    st.set_defaults(fn=_state_cmd)
+
+    sub.add_parser("health", help="scheduler health").set_defaults(
+        fn=_health_cmd)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    client = Client(args.url, args.service)
+    try:
+        return args.fn(client, args)
+    except urllib.error.URLError as e:
+        print(f"error: cannot reach scheduler at {args.url}: {e}",
+              file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""HTTP control API (reference ``sdk/scheduler/.../http/``).
+
+REST surface under ``/v1/*`` mirroring the reference endpoint set
+(``http/endpoints/``, 20 files; shared logic in ``http/queries/``):
+plans, pod, endpoints, state, configurations, health, metrics, debug.
+Multi-service schedulers mount each service under ``/v1/service/<name>/*``
+(reference ``Multi*Resource.java``).
+"""
+
+from dcos_commons_tpu.http.server import ApiServer
+from dcos_commons_tpu.http.queries import (ApiError, ConfigQueries,
+                                           DebugQueries, EndpointQueries,
+                                           HealthQueries, PlanQueries,
+                                           PodQueries, StateQueries)
+
+__all__ = ["ApiServer", "ApiError", "PlanQueries", "PodQueries",
+           "EndpointQueries", "StateQueries", "ConfigQueries",
+           "HealthQueries", "DebugQueries"]

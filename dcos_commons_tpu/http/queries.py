@@ -1,0 +1,346 @@
+"""Endpoint logic, decoupled from the transport (reference ``http/queries/``).
+
+Every query object wraps a :class:`ServiceScheduler` and returns plain
+JSON-able dicts; :class:`ApiError` carries an HTTP status. The server layer
+(`server.py`) is a thin router over these, the same split the reference uses
+between ``http/endpoints/*Resource.java`` and ``http/queries/*Queries.java``.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import List, Optional
+
+from ..plan.elements import Phase, Plan, Step
+from ..plan.status import Status
+from ..state.state_store import GoalOverride
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+def _find_plan(scheduler, plan_name: str) -> Plan:
+    plan = scheduler.plan(plan_name)
+    if plan is None:
+        raise ApiError(404, f"no plan named {plan_name!r}")
+    return plan
+
+
+def _select(plan: Plan, phase: Optional[str], step: Optional[str]):
+    """Resolve the most specific element named by the query params
+    (reference ``PlansResource`` phase/step filtering)."""
+    if phase is None:
+        if step is not None:
+            raise ApiError(400, "step filter requires phase filter")
+        return plan
+    matches: List[Phase] = [p for p in plan.phases
+                            if p.name == phase or str(id(p)) == phase]
+    if not matches:
+        raise ApiError(404, f"no phase named {phase!r}")
+    if step is None:
+        return matches[0]
+    steps: List[Step] = [s for s in matches[0].steps if s.name == step]
+    if not steps:
+        raise ApiError(404, f"no step named {step!r} in phase {phase!r}")
+    return steps[0]
+
+
+class PlanQueries:
+    """Reference ``http/endpoints/PlansResource.java:47-123``."""
+
+    def __init__(self, scheduler):
+        self._scheduler = scheduler
+
+    def list(self) -> list:
+        return [p.name for p in self._scheduler.plans]
+
+    def get(self, plan_name: str) -> tuple:
+        """Returns (http_code, body): 200 when COMPLETE/WAITING, 503 while
+        the plan is still working (reference ``PlansResource.getPlanInfo``)."""
+        plan = _find_plan(self._scheduler, plan_name)
+        # response shape mirrors the reference plan JSON: phases -> steps
+        body = {
+            "name": plan.name,
+            "status": plan.status.name,
+            "errors": list(plan.errors),
+            "strategy": type(plan.strategy).__name__,
+            "phases": [{
+                "name": ph.name,
+                "status": ph.status.name,
+                "strategy": type(ph.strategy).__name__,
+                "steps": [s.to_dict() for s in ph.steps],
+            } for ph in plan.phases],
+        }
+        code = 200 if plan.status in (Status.COMPLETE, Status.WAITING) else 503
+        return code, body
+
+    def start(self, plan_name: str) -> dict:
+        plan = _find_plan(self._scheduler, plan_name)
+        plan.proceed()
+        return {"message": f"Started plan {plan_name}"}
+
+    def stop(self, plan_name: str) -> dict:
+        plan = _find_plan(self._scheduler, plan_name)
+        plan.interrupt()
+        plan.restart()
+        return {"message": f"Stopped plan {plan_name}"}
+
+    def continue_(self, plan_name: str, phase: Optional[str] = None) -> dict:
+        element = _select(_find_plan(self._scheduler, plan_name), phase, None)
+        element.proceed()
+        return {"message": f"Continued {element.name}"}
+
+    def interrupt(self, plan_name: str, phase: Optional[str] = None) -> dict:
+        element = _select(_find_plan(self._scheduler, plan_name), phase, None)
+        element.interrupt()
+        return {"message": f"Interrupted {element.name}"}
+
+    def force_complete(self, plan_name: str, phase: Optional[str] = None,
+                       step: Optional[str] = None) -> dict:
+        element = _select(_find_plan(self._scheduler, plan_name), phase, step)
+        element.force_complete()
+        return {"message": f"Force-completed {element.name}"}
+
+    def restart(self, plan_name: str, phase: Optional[str] = None,
+                step: Optional[str] = None) -> dict:
+        element = _select(_find_plan(self._scheduler, plan_name), phase, step)
+        element.restart()
+        element.proceed()
+        return {"message": f"Restarted {element.name}"}
+
+
+class PodQueries:
+    """Reference ``http/endpoints/PodResource.java:47-111``."""
+
+    def __init__(self, scheduler):
+        self._scheduler = scheduler
+
+    def _instances(self) -> list:
+        names = sorted({t.pod_instance_name
+                        for t in self._scheduler.state.fetch_tasks()})
+        return names
+
+    def list(self) -> list:
+        return self._instances()
+
+    def _pod_status(self, instance: str) -> dict:
+        tasks = []
+        for t in self._scheduler.state.fetch_tasks():
+            if t.pod_instance_name != instance:
+                continue
+            status = self._scheduler.state.fetch_status(t.task_name)
+            override, progress = self._scheduler.state.fetch_override(
+                t.task_name)
+            tasks.append({
+                "name": t.task_name,
+                "id": t.task_id,
+                "status": status.state.value if status else "NO_STATUS",
+                "override": override.value,
+                "overrideProgress": progress.value,
+            })
+        return {"name": instance, "tasks": tasks}
+
+    def status_all(self) -> dict:
+        return {"pods": [self._pod_status(i) for i in self._instances()]}
+
+    def status(self, instance: str) -> dict:
+        if instance not in self._instances():
+            raise ApiError(404, f"no pod instance {instance!r}")
+        return self._pod_status(instance)
+
+    def info(self, instance: str) -> list:
+        infos = []
+        for t in self._scheduler.state.fetch_tasks():
+            if t.pod_instance_name == instance:
+                infos.append(t.to_dict() if hasattr(t, "to_dict")
+                             else _stored_task_dict(t))
+        if not infos:
+            raise ApiError(404, f"no pod instance {instance!r}")
+        return infos
+
+    def restart(self, instance: str) -> dict:
+        killed = self._scheduler.restart_pod(instance)
+        return {"pod": instance, "tasks": killed}
+
+    def replace(self, instance: str) -> dict:
+        touched = self._scheduler.replace_pod(instance)
+        return {"pod": instance, "tasks": touched}
+
+    def pause(self, instance: str, tasks: Optional[list] = None) -> dict:
+        try:
+            return {"pod": instance,
+                    "tasks": self._scheduler.pause_pod(instance, tasks)}
+        except KeyError as e:
+            raise ApiError(404, str(e))
+
+    def resume(self, instance: str, tasks: Optional[list] = None) -> dict:
+        try:
+            return {"pod": instance,
+                    "tasks": self._scheduler.resume_pod(instance, tasks)}
+        except KeyError as e:
+            raise ApiError(404, str(e))
+
+
+def _stored_task_dict(t) -> dict:
+    import json
+    return json.loads(t.to_json().decode())
+
+
+class EndpointQueries:
+    """Reference ``http/endpoints/EndpointsResource.java:22``.
+
+    Endpoints are derived from launched tasks' port reservations: one entry
+    per named port (+ VIP names), listing native host:port addresses.
+    """
+
+    def __init__(self, scheduler):
+        self._scheduler = scheduler
+
+    def _endpoints(self) -> dict:
+        eps: dict = {}
+        spec = self._scheduler.spec
+        ledger = self._scheduler.ledger
+        for task in self._scheduler.state.fetch_tasks():
+            reservation = ledger.get(task.pod_instance_name,
+                                     task.resource_set_id)
+            if reservation is None:
+                continue
+            for port_name, port in reservation.ports.items():
+                entry = eps.setdefault(port_name, {"address": [], "dns": []})
+                entry["address"].append(f"{task.hostname}:{port}")
+                entry["dns"].append(
+                    f"{task.task_name}.{spec.name}.tpu.local:{port}")
+        return eps
+
+    def list(self) -> list:
+        return sorted(self._endpoints().keys())
+
+    def get(self, name: str) -> dict:
+        eps = self._endpoints()
+        if name not in eps:
+            raise ApiError(404, f"no endpoint named {name!r}")
+        return eps[name]
+
+
+class StateQueries:
+    """Reference ``http/endpoints/StateResource.java:26``."""
+
+    def __init__(self, scheduler):
+        self._scheduler = scheduler
+
+    def framework_id(self) -> dict:
+        fid = self._scheduler.framework_store.fetch_framework_id()
+        return {"frameworkId": fid}
+
+    def list_properties(self) -> list:
+        return self._scheduler.state.fetch_property_keys()
+
+    def get_property(self, key: str) -> dict:
+        value = self._scheduler.state.fetch_property(key)
+        if value is None:
+            raise ApiError(404, f"no property {key!r}")
+        return {"key": key,
+                "value": base64.b64encode(value).decode()}
+
+    def put_property(self, key: str, value: bytes) -> dict:
+        self._scheduler.state.store_property(key, value)
+        return {"key": key, "stored": len(value)}
+
+    def delete_property(self, key: str) -> dict:
+        self._scheduler.state.clear_property(key)
+        return {"key": key, "deleted": True}
+
+    def refresh_cache(self) -> dict:
+        # FilePersister/MemPersister read through; nothing cached to drop
+        return {"message": "Cache refreshed"}
+
+
+class ConfigQueries:
+    """Reference ``http/endpoints/ConfigResource.java``."""
+
+    def __init__(self, scheduler):
+        self._scheduler = scheduler
+
+    def list(self) -> list:
+        return self._scheduler.configs.list_ids()
+
+    def get(self, config_id: str) -> dict:
+        import json
+
+        from ..state.state_store import StateStoreError
+        try:
+            return json.loads(
+                self._scheduler.configs.fetch(config_id).to_json())
+        except StateStoreError:
+            raise ApiError(404, f"no configuration {config_id!r}")
+
+    def target_id(self) -> list:
+        target = self._scheduler.configs.get_target()
+        if target is None:
+            raise ApiError(404, "no target configuration")
+        return [target]
+
+    def target(self) -> dict:
+        return self.get(self.target_id()[0])
+
+
+class HealthQueries:
+    """Reference ``http/endpoints/HealthResource.java``: health == plan
+    state. 200 when deploy+recovery complete, 202 while working, 417 on
+    errored plans."""
+
+    def __init__(self, scheduler):
+        self._scheduler = scheduler
+
+    def health(self) -> tuple:
+        plans = self._scheduler.plans
+        if any(p.errors for p in plans):
+            return 417, {"healthy": False, "reason": "plan errors",
+                         "errors": [e for p in plans for e in p.errors]}
+        working = [p.name for p in plans
+                   if p.status not in (Status.COMPLETE, Status.WAITING)
+                   and len(p.steps) > 0]
+        if working:
+            return 202, {"healthy": True, "working": working}
+        return 200, {"healthy": True}
+
+
+class DebugQueries:
+    """Reference ``debug/`` trackers behind ``/v1/debug/*``."""
+
+    def __init__(self, scheduler):
+        self._scheduler = scheduler
+
+    def offers(self) -> dict:
+        """Per-evaluation pass/fail outcome trees
+        (reference ``OfferOutcomeTrackerV2``)."""
+        return self._scheduler.outcome_tracker.to_dict()
+
+    def plans(self) -> dict:
+        return {"plans": [p.to_dict() for p in self._scheduler.plans]}
+
+    def task_statuses(self) -> dict:
+        out = []
+        for name, status in sorted(
+                self._scheduler.state.fetch_statuses().items()):
+            out.append({"name": name, "taskId": status.task_id,
+                        "state": status.state.value,
+                        "message": status.message,
+                        "timestamp": status.timestamp})
+        return {"taskStatuses": out}
+
+    def reservations(self) -> dict:
+        ledger = self._scheduler.ledger
+        return {"reservations": [r.to_dict() if hasattr(r, "to_dict")
+                                 else _reservation_dict(r)
+                                 for r in ledger.all()]}
+
+
+def _reservation_dict(r) -> dict:
+    import dataclasses
+    d = dataclasses.asdict(r)
+    return {k: (dict(v) if isinstance(v, dict) else v) for k, v in d.items()}

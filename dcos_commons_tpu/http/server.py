@@ -1,0 +1,254 @@
+"""Threaded HTTP server + router (reference ``framework/ApiServer.java:39``).
+
+Stdlib-only (no Jetty/Jersey equivalent needed): a ThreadingHTTPServer with
+a regex route table. Single-service schedulers mount at ``/v1/*``;
+multi-service schedulers additionally mount each added service at
+``/v1/service/<name>/*`` (reference ``Multi*Resource.java`` x7).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from .queries import (ApiError, ConfigQueries, DebugQueries, EndpointQueries,
+                      HealthQueries, PlanQueries, PodQueries, StateQueries)
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[..., object]
+
+
+class _Routes:
+    """Per-service route table: (method, regex) -> handler(match, body)."""
+
+    def __init__(self, scheduler, metrics=None):
+        plans = PlanQueries(scheduler)
+        pods = PodQueries(scheduler)
+        endpoints = EndpointQueries(scheduler)
+        state = StateQueries(scheduler)
+        configs = ConfigQueries(scheduler)
+        health = HealthQueries(scheduler)
+        debug = DebugQueries(scheduler)
+        self.health = health
+        self.metrics = metrics
+
+        def q(params: dict, key: str) -> Optional[str]:
+            vals = params.get(key)
+            return vals[0] if vals else None
+
+        self.table: List[Tuple[str, re.Pattern, Handler]] = []
+
+        def add(method: str, pattern: str, fn: Handler) -> None:
+            self.table.append((method, re.compile(pattern + r"\Z"), fn))
+
+        # plans (reference PlansResource.java:47-123)
+        add("GET", r"plans", lambda m, p, b: plans.list())
+        add("GET", r"plans/([^/]+)", lambda m, p, b: plans.get(m[0]))
+        add("POST", r"plans/([^/]+)/start", lambda m, p, b: plans.start(m[0]))
+        add("POST", r"plans/([^/]+)/stop", lambda m, p, b: plans.stop(m[0]))
+        add("POST", r"plans/([^/]+)/continue",
+            lambda m, p, b: plans.continue_(m[0], q(p, "phase")))
+        add("POST", r"plans/([^/]+)/interrupt",
+            lambda m, p, b: plans.interrupt(m[0], q(p, "phase")))
+        add("POST", r"plans/([^/]+)/forceComplete",
+            lambda m, p, b: plans.force_complete(m[0], q(p, "phase"),
+                                                 q(p, "step")))
+        add("POST", r"plans/([^/]+)/restart",
+            lambda m, p, b: plans.restart(m[0], q(p, "phase"), q(p, "step")))
+
+        # pods (reference PodResource.java:47-111)
+        add("GET", r"pod", lambda m, p, b: pods.list())
+        add("GET", r"pod/status", lambda m, p, b: pods.status_all())
+        add("GET", r"pod/([^/]+)/status", lambda m, p, b: pods.status(m[0]))
+        add("GET", r"pod/([^/]+)/info", lambda m, p, b: pods.info(m[0]))
+        add("POST", r"pod/([^/]+)/restart", lambda m, p, b: pods.restart(m[0]))
+        add("POST", r"pod/([^/]+)/replace", lambda m, p, b: pods.replace(m[0]))
+        add("POST", r"pod/([^/]+)/pause",
+            lambda m, p, b: pods.pause(m[0], _body_tasks(b)))
+        add("POST", r"pod/([^/]+)/resume",
+            lambda m, p, b: pods.resume(m[0], _body_tasks(b)))
+
+        # endpoints
+        add("GET", r"endpoints", lambda m, p, b: endpoints.list())
+        add("GET", r"endpoints/([^/]+)", lambda m, p, b: endpoints.get(m[0]))
+
+        # state
+        add("GET", r"state/frameworkId", lambda m, p, b: state.framework_id())
+        add("GET", r"state/properties",
+            lambda m, p, b: state.list_properties())
+        add("GET", r"state/properties/([^/]+)",
+            lambda m, p, b: state.get_property(m[0]))
+        add("PUT", r"state/properties/([^/]+)",
+            lambda m, p, b: state.put_property(m[0], b or b""))
+        add("DELETE", r"state/properties/([^/]+)",
+            lambda m, p, b: state.delete_property(m[0]))
+        add("POST", r"state/refresh", lambda m, p, b: state.refresh_cache())
+
+        # configurations
+        add("GET", r"configurations", lambda m, p, b: configs.list())
+        add("GET", r"configurations/targetId",
+            lambda m, p, b: configs.target_id())
+        add("GET", r"configurations/target", lambda m, p, b: configs.target())
+        add("GET", r"configurations/([^/]+)", lambda m, p, b: configs.get(m[0]))
+
+        # debug
+        add("GET", r"debug/offers", lambda m, p, b: debug.offers())
+        add("GET", r"debug/plans", lambda m, p, b: debug.plans())
+        add("GET", r"debug/taskStatuses", lambda m, p, b: debug.task_statuses())
+        add("GET", r"debug/reservations",
+            lambda m, p, b: debug.reservations())
+
+    def dispatch(self, method: str, path: str, params: dict,
+                 body: Optional[bytes]) -> Tuple[int, object]:
+        if method == "GET" and path == "health":
+            return self.health.health()
+        for m, pattern, fn in self.table:
+            if m != method:
+                continue
+            match = pattern.match(path)
+            if match:
+                result = fn(list(match.groups()), params, body)
+                if (isinstance(result, tuple) and len(result) == 2
+                        and isinstance(result[0], int)):
+                    return result
+                return 200, result
+        return 404, {"error": f"no route for {method} /v1/{path}"}
+
+
+def _body_tasks(body: Optional[bytes]) -> Optional[list]:
+    """Parse the task filter: a bare JSON list (reference
+    ``RequestUtils.parseJsonList``) or ``{"tasks": [...]}``."""
+    if not body:
+        return None
+    try:
+        data = json.loads(body.decode())
+    except ValueError:
+        raise ApiError(400, "request body must be JSON")
+    if isinstance(data, list):
+        return data
+    if isinstance(data, dict):
+        tasks = data.get("tasks")
+        if tasks is None or isinstance(tasks, list):
+            return tasks
+    raise ApiError(400, "expected a JSON list or {\"tasks\": [...]}")
+
+
+class ApiServer:
+    """The scheduler's control-surface server.
+
+    Offers are effectively "declined" until the API server is up in the
+    reference (``FrameworkRunner.java:130-138``); here construction binds the
+    socket synchronously, so ``start()`` returning means ready.
+    """
+
+    def __init__(self, scheduler=None, port: int = 0, metrics=None,
+                 host: str = "127.0.0.1"):
+        self._services: Dict[str, _Routes] = {}
+        self._default: Optional[_Routes] = None
+        self._metrics = metrics
+        if scheduler is not None:
+            self._default = _Routes(scheduler, metrics)
+        outer = self
+
+        class RequestHandler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route to logging, not stderr
+                log.debug("api: " + fmt, *args)
+
+            def _respond(self, code: int, payload: object) -> None:
+                # bytes payloads are preformatted text (prometheus exposition)
+                if isinstance(payload, bytes):
+                    raw = payload
+                    content_type = "text/plain; version=0.0.4"
+                else:
+                    raw = json.dumps(payload, indent=2).encode()
+                    content_type = "application/json"
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def _handle(self, method: str) -> None:
+                try:
+                    parsed = urlparse(self.path)
+                    params = parse_qs(parsed.query)
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else None
+                    code, payload = outer._dispatch(method, parsed.path,
+                                                    params, body)
+                    self._respond(code, payload)
+                except ApiError as e:
+                    self._respond(e.code, {"error": e.message})
+                except Exception as e:  # pragma: no cover
+                    log.exception("api error")
+                    self._respond(500, {"error": str(e)})
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+        self._server = ThreadingHTTPServer((host, port), RequestHandler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- service registry (multi-service: Multi*Resource.java) -------------
+
+    def add_service(self, name: str, scheduler) -> None:
+        self._services[name] = _Routes(scheduler, self._metrics)
+
+    def remove_service(self, name: str) -> None:
+        self._services.pop(name, None)
+
+    def _dispatch(self, method: str, path: str, params: dict,
+                  body: Optional[bytes]) -> Tuple[int, object]:
+        if not path.startswith("/v1/"):
+            return 404, {"error": "not under /v1/"}
+        rest = path[len("/v1/"):].strip("/")
+        if self._metrics is not None and rest in ("metrics",
+                                                  "metrics/prometheus"):
+            if rest.endswith("prometheus"):
+                return 200, self._metrics.to_prometheus().encode()
+            return 200, self._metrics.to_dict()
+        if rest == "multi":
+            return 200, sorted(self._services.keys())
+        if rest.startswith("service/"):
+            parts = rest.split("/", 2)
+            if len(parts) < 3:
+                return 404, {"error": "expected /v1/service/<name>/<path>"}
+            routes = self._services.get(parts[1])
+            if routes is None:
+                return 404, {"error": f"no service named {parts[1]!r}"}
+            return routes.dispatch(method, parts[2], params, body)
+        if self._default is None:
+            return 404, {"error": "no default service mounted"}
+        return self._default.dispatch(method, rest, params, body)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="api-server", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

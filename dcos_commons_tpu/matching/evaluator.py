@@ -349,7 +349,7 @@ class Evaluator:
             task_name=task_name,
             task_id=make_task_id(task_name),
             task_spec_name=task_spec_name,
-            cmd=task_spec.cmd,
+            cmd=requirement.cmd_overrides.get(task_spec_name, task_spec.cmd),
             env=env,
             resource_set_id=task_spec.resource_set_id,
             goal=task_spec.goal.value,

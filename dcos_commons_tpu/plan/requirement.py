@@ -25,6 +25,9 @@ class PodInstanceRequirement:
     task_names: Tuple[str, ...]          # spec-level task names to launch
     recovery_type: RecoveryType = RecoveryType.NONE
     env_overrides: Mapping[str, str] = field(default_factory=dict)
+    # per-task cmd replacement (pause: reference GoalStateOverride PAUSED
+    # relaunches the task with a no-op command)
+    cmd_overrides: Mapping[str, str] = field(default_factory=dict)
 
     @property
     def name(self) -> str:

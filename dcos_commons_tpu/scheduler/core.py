@@ -22,6 +22,7 @@ reference's evaluate->WAL->accept->status loop):
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from typing import Dict, List, Optional, Sequence
 
@@ -165,7 +166,24 @@ class ServiceScheduler:
             if not status.state.terminal and status.agent_id:
                 self.cluster.kill(status.agent_id, status.task_id)
             return
+        if status.state is TaskState.RUNNING:
+            self._complete_override(task_name)
         self.coordinator.update(status)
+
+    def _complete_override(self, task_name: str) -> None:
+        """Advance a pause/resume override to COMPLETE once the relaunched
+        task is RUNNING with the matching cmd (paused -> PAUSE_CMD, resumed
+        -> real cmd)."""
+        override, progress = self.state.fetch_override(task_name)
+        if progress is OverrideProgress.COMPLETE:
+            return
+        task = self.state.fetch_task(task_name)
+        if task is None:
+            return
+        paused_cmd = task.cmd == self.PAUSE_CMD
+        if (override is GoalOverride.PAUSED) == paused_cmd:
+            self.state.store_override(task_name, override,
+                                      OverrideProgress.COMPLETE)
 
     # -- the cycle ---------------------------------------------------------
 
@@ -182,6 +200,7 @@ class ServiceScheduler:
             requirement = step.start()
             if requirement is None:
                 continue
+            requirement = self._apply_goal_overrides(requirement)
             if self._kill_before_relaunch(requirement):
                 step.mark_prepared()
                 actions += 1
@@ -280,18 +299,75 @@ class ServiceScheduler:
         return [t.task_name for t in self.state.fetch_tasks()
                 if t.pod_instance_name == pod_instance_name]
 
+    def _kill_if_running(self, task_name: str) -> bool:
+        """Kill the stored task iff its latest same-generation status is
+        non-terminal; returns True if a kill was issued."""
+        task = self.state.fetch_task(task_name)
+        status = self.state.fetch_status(task_name)
+        if (task and status and status.task_id == task.task_id
+                and not status.state.terminal):
+            self.cluster.kill(task.agent_id, task.task_id)
+            return True
+        return False
+
     def restart_pod(self, pod_instance_name: str) -> List[str]:
         """Kill tasks in place; recovery relaunches them TRANSIENT
         (reference ``PodQueries.restart``)."""
-        killed = []
-        for task_name in self.pod_instance_task_names(pod_instance_name):
-            task = self.state.fetch_task(task_name)
-            status = self.state.fetch_status(task_name)
-            if (task and status and status.task_id == task.task_id
-                    and not status.state.terminal):
-                self.cluster.kill(task.agent_id, task.task_id)
-                killed.append(task_name)
-        return killed
+        return [task_name
+                for task_name in self.pod_instance_task_names(pod_instance_name)
+                if self._kill_if_running(task_name)]
+
+    # -- pause / resume (reference GoalStateOverride, PodQueries.pause) ----
+
+    PAUSE_CMD = "sleep 315360000"  # relaunched paused tasks idle ~10 years
+
+    def _apply_goal_overrides(self, requirement):
+        """Swap in the pause no-op cmd for tasks whose stored override is
+        PAUSED (reference ``state/GoalStateOverride.java`` pause relaunch)."""
+        cmd_overrides = {}
+        for spec_name in requirement.task_names:
+            inst = requirement.pod_instance.task_instance_name(spec_name)
+            override, _ = self.state.fetch_override(inst)
+            if override is GoalOverride.PAUSED:
+                cmd_overrides[spec_name] = self.PAUSE_CMD
+                self.state.store_override(inst, GoalOverride.PAUSED,
+                                          OverrideProgress.IN_PROGRESS)
+        if not cmd_overrides:
+            return requirement
+        return dataclasses.replace(requirement, cmd_overrides=cmd_overrides)
+
+    def _set_override(self, pod_instance_name: str, override: GoalOverride,
+                      task_names: Optional[Sequence[str]] = None) -> List[str]:
+        instance_names = self.pod_instance_task_names(pod_instance_name)
+        if task_names:
+            # accept short spec names ("server") or full instance names
+            # ("hello-0-server"), reference RequestUtils.filterPodTasks
+            selected = []
+            for wanted in task_names:
+                full = (wanted if wanted in instance_names
+                        else f"{pod_instance_name}-{wanted}")
+                if full not in instance_names:
+                    raise KeyError(
+                        f"no task {wanted!r} in pod {pod_instance_name!r}")
+                selected.append(full)
+        else:
+            selected = instance_names
+        for task_name in selected:
+            self.state.store_override(task_name, override,
+                                      OverrideProgress.PENDING)
+            self._kill_if_running(task_name)
+        return selected
+
+    def pause_pod(self, pod_instance_name: str,
+                  task_names: Optional[Sequence[str]] = None) -> List[str]:
+        """Kill + relaunch with a no-op cmd; deploy stays COMPLETE-able."""
+        return self._set_override(pod_instance_name, GoalOverride.PAUSED,
+                                  task_names)
+
+    def resume_pod(self, pod_instance_name: str,
+                   task_names: Optional[Sequence[str]] = None) -> List[str]:
+        return self._set_override(pod_instance_name, GoalOverride.NONE,
+                                  task_names)
 
     def replace_pod(self, pod_instance_name: str) -> List[str]:
         """Mark permanently failed + kill; recovery replaces elsewhere
@@ -303,10 +379,7 @@ class ServiceScheduler:
             if task is None:
                 continue
             self.state.store_tasks([task.failed_permanently()])
-            status = self.state.fetch_status(task_name)
-            if (status and status.task_id == task.task_id
-                    and not status.state.terminal):
-                self.cluster.kill(task.agent_id, task.task_id)
+            self._kill_if_running(task_name)
             touched.append(task_name)
         return touched
 

@@ -1,0 +1,67 @@
+"""CLI tests driving a live ApiServer (reference CLI surface parity)."""
+
+import json
+
+import pytest
+
+from dcos_commons_tpu.cli.main import main
+from dcos_commons_tpu.http import ApiServer
+
+from tests.test_http import make_scheduler
+
+
+@pytest.fixture()
+def server():
+    sched = make_scheduler()
+    sched.run_until_quiet()
+    srv = ApiServer(sched, port=0)
+    srv.start()
+    yield sched, f"http://127.0.0.1:{srv.port}"
+    srv.stop()
+
+
+def run_cli(base, *argv, expect=0, capsys=None):
+    rc = main(["--url", base, *argv])
+    assert rc == expect
+    out = capsys.readouterr().out
+    return json.loads(out)
+
+
+def test_plan_commands(server, capsys):
+    _, base = server
+    assert "deploy" in run_cli(base, "plan", "list", capsys=capsys)
+    tree = run_cli(base, "plan", "show", "deploy", capsys=capsys)
+    assert tree["status"] == "COMPLETE"
+    run_cli(base, "plan", "restart", "deploy", capsys=capsys)
+    run_cli(base, "plan", "force-complete", "deploy", capsys=capsys)
+
+
+def test_pod_and_endpoints_and_debug(server, capsys):
+    sched, base = server
+    assert run_cli(base, "pod", "list", capsys=capsys) == ["hello-0",
+                                                           "hello-1"]
+    status = run_cli(base, "pod", "status", "hello-0", capsys=capsys)
+    assert status["tasks"]
+    run_cli(base, "pod", "replace", "hello-0", capsys=capsys)
+    assert sched.state.fetch_task("hello-0-server").permanently_failed
+    assert run_cli(base, "endpoints", capsys=capsys) == ["http"]
+    debug = run_cli(base, "debug", "reservations", capsys=capsys)
+    assert debug["reservations"]
+
+
+def test_describe_config_state_health(server, capsys):
+    sched, base = server
+    assert run_cli(base, "describe", capsys=capsys)["name"] == "websvc"
+    assert run_cli(base, "config", "list", capsys=capsys)
+    assert run_cli(base, "state", "framework-id", capsys=capsys)
+    assert run_cli(base, "health", capsys=capsys)["healthy"]
+
+
+def test_cli_unreachable():
+    assert main(["--url", "http://127.0.0.1:1", "plan", "list"]) == 2
+
+
+def test_cli_error_exit_code(server, capsys):
+    _, base = server
+    rc = main(["--url", base, "plan", "show", "bogus"])
+    assert rc == 1

@@ -1,0 +1,209 @@
+"""HTTP control API tests (reference ``http/endpoints`` behavior)."""
+
+import json
+import urllib.request
+import urllib.error
+
+import pytest
+
+from dcos_commons_tpu.agent import AgentInfo, FakeCluster, PortRange
+from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler import ServiceScheduler
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import MemPersister
+
+YML = """
+name: websvc
+pods:
+  hello:
+    count: 2
+    resource-sets:
+      server-res:
+        cpus: 0.5
+        memory: 256
+        ports:
+          http: {port: 0, vip: web, vip-port: 80}
+    tasks:
+      server: {goal: RUNNING, cmd: ./run, resource-set: server-res}
+"""
+
+
+def make_scheduler():
+    agents = [AgentInfo(agent_id=f"a{i}", hostname=f"h{i}", cpus=4,
+                        memory_mb=8192, disk_mb=10000,
+                        ports=(PortRange(10000, 10100),))
+              for i in range(2)]
+    cluster = FakeCluster(agents)
+    spec = load_service_yaml_str(YML)
+    return ServiceScheduler(spec, MemPersister(), cluster)
+
+
+@pytest.fixture()
+def api():
+    sched = make_scheduler()
+    sched.run_until_quiet()
+    server = ApiServer(sched, port=0)
+    server.start()
+    yield sched, f"http://127.0.0.1:{server.port}"
+    server.stop()
+
+
+def get(base, path, expect=200):
+    try:
+        with urllib.request.urlopen(base + path) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        assert e.code == expect, f"{path}: {e.code} != {expect}"
+        return e.code, json.loads(e.read().decode())
+
+
+def post(base, path, body=None, method="POST", expect=200):
+    req = urllib.request.Request(base + path, method=method,
+                                 data=body)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        assert e.code == expect, f"{path}: {e.code} != {expect}"
+        return e.code, json.loads(e.read().decode())
+
+
+def test_plans_listing_and_tree(api):
+    sched, base = api
+    _, names = get(base, "/v1/plans")
+    assert "deploy" in names and "recovery" in names
+    _, deploy = get(base, "/v1/plans/deploy")
+    assert deploy["status"] == "COMPLETE"
+    assert deploy["phases"][0]["steps"]
+    get(base, "/v1/plans/nope", expect=404)
+
+
+def test_plan_controls(api):
+    sched, base = api
+    post(base, "/v1/plans/deploy/restart")
+    post(base, "/v1/plans/deploy/interrupt")
+    _, deploy = get(base, "/v1/plans/deploy", expect=None) \
+        if False else get(base, "/v1/plans/deploy", expect=503)
+    post(base, "/v1/plans/deploy/continue")
+    post(base, "/v1/plans/deploy/forceComplete")
+    _, deploy = get(base, "/v1/plans/deploy")
+    assert deploy["status"] == "COMPLETE"
+
+
+def test_pod_status_and_info(api):
+    sched, base = api
+    _, pods = get(base, "/v1/pod")
+    assert pods == ["hello-0", "hello-1"]
+    _, status = get(base, "/v1/pod/hello-0/status")
+    assert status["tasks"][0]["status"] == "TASK_RUNNING"
+    _, info = get(base, "/v1/pod/hello-0/info")
+    assert info[0]["task_name"] == "hello-0-server"
+    _, all_status = get(base, "/v1/pod/status")
+    assert len(all_status["pods"]) == 2
+    get(base, "/v1/pod/hello-9/status", expect=404)
+
+
+def test_pod_restart_and_replace(api):
+    sched, base = api
+    before = sched.state.fetch_task("hello-0-server").task_id
+    _, out = post(base, "/v1/pod/hello-0/restart")
+    assert out["tasks"] == ["hello-0-server"]
+    sched.run_until_quiet()
+    after = sched.state.fetch_task("hello-0-server").task_id
+    assert before != after
+
+
+def test_pod_pause_resume(api):
+    sched, base = api
+    _, out = post(base, "/v1/pod/hello-0/pause")
+    assert out["tasks"] == ["hello-0-server"]
+    sched.run_until_quiet()
+    task = sched.state.fetch_task("hello-0-server")
+    assert task.cmd == ServiceScheduler.PAUSE_CMD
+    _, status = get(base, "/v1/pod/hello-0/status")
+    assert status["tasks"][0]["override"] == "PAUSED"
+    # paused relaunch reached RUNNING -> override progress COMPLETE
+    assert status["tasks"][0]["overrideProgress"] == "COMPLETE"
+    post(base, "/v1/pod/hello-0/resume")
+    sched.run_until_quiet()
+    task = sched.state.fetch_task("hello-0-server")
+    assert task.cmd == "./run"
+    _, status = get(base, "/v1/pod/hello-0/status")
+    assert status["tasks"][0]["override"] == "NONE"
+    assert status["tasks"][0]["overrideProgress"] == "COMPLETE"
+
+
+def test_pod_pause_task_filter(api):
+    sched, base = api
+    # bare JSON list body with a short task name (reference format)
+    _, out = post(base, "/v1/pod/hello-0/pause", b'["server"]')
+    assert out["tasks"] == ["hello-0-server"]
+    # unknown task -> 404, nothing paused
+    post(base, "/v1/pod/hello-0/pause", b'["nope"]', expect=404)
+    # malformed body -> 400
+    post(base, "/v1/pod/hello-0/pause", b'{bad json', expect=400)
+
+
+def test_endpoints(api):
+    sched, base = api
+    _, names = get(base, "/v1/endpoints")
+    assert names == ["http"]
+    _, ep = get(base, "/v1/endpoints/http")
+    assert len(ep["address"]) == 2
+    assert all(":" in a for a in ep["address"])
+    get(base, "/v1/endpoints/nope", expect=404)
+
+
+def test_state_properties(api):
+    sched, base = api
+    post(base, "/v1/state/properties/mykey", b"hello", method="PUT")
+    _, props = get(base, "/v1/state/properties")
+    assert "mykey" in props
+    _, val = get(base, "/v1/state/properties/mykey")
+    import base64
+    assert base64.b64decode(val["value"]) == b"hello"
+    post(base, "/v1/state/properties/mykey", method="DELETE")
+    get(base, "/v1/state/properties/mykey", expect=404)
+
+
+def test_configurations(api):
+    sched, base = api
+    _, ids = get(base, "/v1/configurations")
+    assert len(ids) == 1
+    _, target_id = get(base, "/v1/configurations/targetId")
+    assert target_id == [sched.target_config_id]
+    _, target = get(base, "/v1/configurations/target")
+    assert target["name"] == "websvc"
+    get(base, "/v1/configurations/bogus", expect=404)
+
+
+def test_health_and_debug(api):
+    sched, base = api
+    code, health = get(base, "/v1/health")
+    assert code == 200 and health["healthy"]
+    _, dbg = get(base, "/v1/debug/offers")
+    assert "outcomes" in dbg or dbg  # ring buffer dump
+    _, statuses = get(base, "/v1/debug/taskStatuses")
+    assert len(statuses["taskStatuses"]) == 2
+    _, res = get(base, "/v1/debug/reservations")
+    assert len(res["reservations"]) == 2
+
+
+def test_multi_service_mounts():
+    s1, s2 = make_scheduler(), make_scheduler()
+    s1.run_until_quiet()
+    server = ApiServer(port=0)
+    server.add_service("svc1", s1)
+    server.add_service("svc2", s2)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        _, names = get(base, "/v1/multi")
+        assert names == ["svc1", "svc2"]
+        _, plans = get(base, "/v1/service/svc1/plans")
+        assert "deploy" in plans
+        get(base, "/v1/service/nope/plans", expect=404)
+        get(base, "/v1/plans", expect=404)  # no default mounted
+    finally:
+        server.stop()
