@@ -12,4 +12,5 @@ from .._lazy import lazy_exports
 __getattr__, __dir__ = lazy_exports(__name__, {
     "AgentClient": "client", "StatusCallback": "client",
     "FakeCluster": "fake", "FakeTask": "fake", "TaskBehavior": "fake",
+    "RemoteCluster": "remote",
 }, globals())

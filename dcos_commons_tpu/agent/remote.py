@@ -1,0 +1,153 @@
+"""RemoteCluster — the scheduler side of the agent transport.
+
+Replaces the reference's Mesos driver boundary (``framework/
+SchedulerDriverFactory.java:27``, C++ ``libmesos`` via JNI): per-host agent
+daemons (the C++ ``tpu-agent`` under ``native/agent``) register and poll the
+scheduler over HTTP; the scheduler queues launch/kill commands per agent and
+ingests status updates from the poll body. Agent-initiated polling keeps the
+daemon dependency-free and NAT-friendly; the poll interval bounds command
+latency the way offer-cycle cadence did in Mesos.
+
+Liveness: an agent missing ``expiry_s`` of polls is dropped from
+:meth:`agents`, which makes its tasks eligible for LOST synthesis in
+``ServiceScheduler.reconcile`` — the Mesos agent-failover analogue.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from .client import StatusCallback
+from .inventory import AgentInfo, PortRange, TpuInventory
+from ..matching.evaluator import LaunchPlan
+from ..state.tasks import TaskState, TaskStatus
+
+log = logging.getLogger(__name__)
+
+
+def _now() -> float:
+    return time.time()
+
+
+class RemoteCluster:
+    """AgentClient implementation backed by polling remote agents."""
+
+    def __init__(self, expiry_s: float = 30.0, poll_interval_s: float = 1.0):
+        self._lock = threading.Lock()
+        self._expiry_s = expiry_s
+        self.poll_interval_s = poll_interval_s
+        self._agents: Dict[str, AgentInfo] = {}
+        self._last_seen: Dict[str, float] = {}
+        self._queues: Dict[str, List[dict]] = {}
+        self._running: Dict[str, List[str]] = {}
+        self._callback: Optional[StatusCallback] = None
+
+    # -- AgentClient interface --------------------------------------------
+
+    def agents(self) -> Sequence[AgentInfo]:
+        with self._lock:
+            cutoff = _now() - self._expiry_s
+            return [a for aid, a in self._agents.items()
+                    if self._last_seen.get(aid, 0) >= cutoff]
+
+    def launch(self, plan: LaunchPlan) -> None:
+        command = {"type": "launch", "tasks": [
+            {
+                "task_name": l.task_name,
+                "task_id": l.task_id,
+                "cmd": l.cmd,
+                "env": dict(l.env),
+                "goal": l.goal,
+                "config_templates": [
+                    {"name": n, "dest": d, "template": t}
+                    for n, d, t in l.config_templates],
+                "health_check_cmd": l.health_check_cmd,
+                "readiness_check_cmd": l.readiness_check_cmd,
+            } for l in plan.launches]}
+        with self._lock:
+            self._queues.setdefault(plan.agent.agent_id, []).append(command)
+
+    def kill(self, agent_id: str, task_id: str,
+             grace_period_s: float = 0.0) -> None:
+        with self._lock:
+            self._queues.setdefault(agent_id, []).append(
+                {"type": "kill", "task_id": task_id,
+                 "grace_period_s": grace_period_s})
+
+    def running_task_ids(self, agent_id: str) -> Sequence[str]:
+        with self._lock:
+            return list(self._running.get(agent_id, []))
+
+    def set_status_callback(self, callback: StatusCallback) -> None:
+        self._callback = callback
+
+    # -- transport side (called by the HTTP routes) ------------------------
+
+    def register(self, payload: dict) -> dict:
+        """POST /v1/agents/register body -> AgentInfo."""
+        tpu = payload.get("tpu") or {}
+        coords = tpu.get("coords")
+        info = AgentInfo(
+            agent_id=payload["agent_id"],
+            hostname=payload.get("hostname", payload["agent_id"]),
+            cpus=float(payload.get("cpus", 0)),
+            memory_mb=int(payload.get("memory_mb", 0)),
+            disk_mb=int(payload.get("disk_mb", 0)),
+            ports=tuple(PortRange(int(lo), int(hi))
+                        for lo, hi in payload.get("ports", [[10000, 20000]])),
+            tpu=TpuInventory(
+                chips=int(tpu.get("chips", 0)),
+                slice_id=tpu.get("slice_id"),
+                topology=tpu.get("topology"),
+                coords=tuple(coords) if coords else None,
+                worker_index=tpu.get("worker_index"),
+            ),
+            attributes=dict(payload.get("attributes", {})),
+            zone=payload.get("zone"),
+            region=payload.get("region"),
+        )
+        with self._lock:
+            self._agents[info.agent_id] = info
+            self._last_seen[info.agent_id] = _now()
+            self._queues.setdefault(info.agent_id, [])
+        return {"ok": True, "poll_interval_s": self.poll_interval_s}
+
+    def poll(self, agent_id: str, payload: dict) -> dict:
+        """POST /v1/agents/<id>/poll: heartbeat + statuses -> commands.
+
+        Statuses are parsed and dispatched *before* the command queue is
+        drained: a malformed status or a callback error must not lose
+        launch/kill commands the scheduler already WAL'd.
+        """
+        with self._lock:
+            if agent_id not in self._agents:
+                # unknown/expired agent must re-register (it keeps its
+                # queued statuses and resends them after registering)
+                return {"ok": False, "reregister": True, "commands": []}
+            self._last_seen[agent_id] = _now()
+            self._running[agent_id] = list(payload.get("running_task_ids",
+                                                       []))
+        callback = self._callback
+        for s in payload.get("statuses", []):
+            try:
+                status = TaskStatus(
+                    task_id=s["task_id"],
+                    state=TaskState(s["state"]),
+                    message=s.get("message", ""),
+                    timestamp=float(s.get("timestamp") or _now()),
+                    readiness_passed=bool(s.get("readiness_passed", False)),
+                    agent_id=agent_id,
+                )
+                if callback is not None:
+                    callback(s["task_name"], status)
+            except Exception:
+                log.exception("dropping bad status from agent %s: %r",
+                              agent_id, s)
+        with self._lock:
+            commands, self._queues[agent_id] = self._queues.get(agent_id,
+                                                                []), []
+        return {"ok": True, "commands": commands,
+                "poll_interval_s": self.poll_interval_s}

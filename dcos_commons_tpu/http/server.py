@@ -148,10 +148,11 @@ class ApiServer:
     """
 
     def __init__(self, scheduler=None, port: int = 0, metrics=None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", cluster=None):
         self._services: Dict[str, _Routes] = {}
         self._default: Optional[_Routes] = None
         self._metrics = metrics
+        self._cluster = cluster  # RemoteCluster: agent transport endpoint
         if scheduler is not None:
             self._default = _Routes(scheduler, metrics)
         outer = self
@@ -226,6 +227,8 @@ class ApiServer:
             return 200, self._metrics.to_dict()
         if rest == "multi":
             return 200, sorted(self._services.keys())
+        if rest.startswith("agents/") or rest == "agents":
+            return self._dispatch_agents(method, rest, body)
         if rest.startswith("service/"):
             parts = rest.split("/", 2)
             if len(parts) < 3:
@@ -237,6 +240,29 @@ class ApiServer:
         if self._default is None:
             return 404, {"error": "no default service mounted"}
         return self._default.dispatch(method, rest, params, body)
+
+    def _dispatch_agents(self, method: str, rest: str,
+                         body: Optional[bytes]) -> Tuple[int, object]:
+        """Agent transport routes (the reference's Mesos driver boundary):
+        POST /v1/agents/register, POST /v1/agents/<id>/poll,
+        GET /v1/agents."""
+        if self._cluster is None:
+            return 404, {"error": "no agent transport mounted"}
+        if method == "GET" and rest == "agents":
+            return 200, [a.agent_id for a in self._cluster.agents()]
+        try:
+            payload = json.loads(body.decode()) if body else {}
+        except ValueError:
+            return 400, {"error": "agent payload must be JSON"}
+        if method == "POST" and rest == "agents/register":
+            try:
+                return 200, self._cluster.register(payload)
+            except (KeyError, ValueError, TypeError) as e:
+                return 400, {"error": f"bad register payload: {e}"}
+        parts = rest.split("/")
+        if method == "POST" and len(parts) == 3 and parts[2] == "poll":
+            return 200, self._cluster.poll(parts[1], payload)
+        return 404, {"error": f"no agent route {method} /v1/{rest}"}
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 from ..agent.client import AgentClient
@@ -57,8 +59,19 @@ class ServiceScheduler:
                  backoff: Optional[Backoff] = None,
                  validators=DEFAULT_VALIDATORS,
                  recovery_overriders: Sequence[RecoveryOverrider] = (),
-                 uninstall: bool = False):
+                 uninstall: bool = False,
+                 agent_grace_s: float = 0.0):
         SchemaVersionStore(persister).check()
+        # serializes run_cycle against status callbacks arriving from other
+        # threads (RemoteCluster delivers on HTTP worker threads; the
+        # reference single-threads its offer pipeline the same way,
+        # OfferProcessor.java:57)
+        self._lock = threading.RLock()
+        # grace before tasks on an unreported agent are declared LOST;
+        # >0 for remote clusters where agents re-register asynchronously
+        # (Mesos agent-reregistration-timeout analogue)
+        self.agent_grace_s = agent_grace_s
+        self._agent_missing_since: Dict[str, float] = {}
         self.state = StateStore(persister, namespace)
         self.configs = ConfigStore(persister, namespace)
         self.framework_store = FrameworkStore(persister)
@@ -137,28 +150,53 @@ class ServiceScheduler:
     def reconcile(self) -> None:
         """Compare agent truth with stored truth: stored-but-not-running ->
         synthesize LOST; running-but-not-stored -> kill the zombie
-        (reference implicit reconciliation + ``FrameworkScheduler.java:283-297``)."""
-        reported: Dict[str, str] = {}  # task_id -> agent_id
-        for agent in self.cluster.agents():
-            for task_id in self.cluster.running_task_ids(agent.agent_id):
-                reported[task_id] = agent.agent_id
-        for task in self.state.fetch_tasks():
-            status = self.state.fetch_status(task.task_name)
-            alive_in_store = status is None or (
-                status.task_id == task.task_id and not status.state.terminal)
-            if task.task_id in reported:
-                reported.pop(task.task_id)
-            elif alive_in_store:
+        (reference implicit reconciliation + ``FrameworkScheduler.java:283-297``).
+
+        Tasks whose *agent* is not registered at all are only declared LOST
+        after ``agent_grace_s`` of continuous absence — a remote agent that
+        is merely slow to (re-)register must not trigger duplicate
+        relaunches while its processes are still running.
+        """
+        with self._lock:
+            live_agents = {a.agent_id for a in self.cluster.agents()}
+            reported: Dict[str, str] = {}  # task_id -> agent_id
+            for agent_id in live_agents:
+                for task_id in self.cluster.running_task_ids(agent_id):
+                    reported[task_id] = agent_id
+            now = time.monotonic()
+            for agent_id in live_agents:
+                self._agent_missing_since.pop(agent_id, None)
+            for task in self.state.fetch_tasks():
+                status = self.state.fetch_status(task.task_name)
+                alive_in_store = status is None or (
+                    status.task_id == task.task_id
+                    and not status.state.terminal)
+                if task.task_id in reported:
+                    reported.pop(task.task_id)
+                    continue
+                if not alive_in_store:
+                    continue
+                if task.agent_id not in live_agents:
+                    first = self._agent_missing_since.setdefault(
+                        task.agent_id, now)
+                    if now - first < self.agent_grace_s:
+                        continue  # still within re-registration grace
                 lost = TaskStatus.now(task.task_id, TaskState.LOST,
                                       message="not reported by any agent")
                 self.handle_status(task.task_name, lost)
-        for task_id, agent_id in reported.items():
-            log.warning("killing unknown task %s on %s", task_id, agent_id)
-            self.cluster.kill(agent_id, task_id)
+            for task_id, agent_id in reported.items():
+                log.warning("killing unknown task %s on %s", task_id,
+                            agent_id)
+                self.cluster.kill(agent_id, task_id)
 
     # -- status feed -------------------------------------------------------
 
     def handle_status(self, task_name: str, status: TaskStatus) -> None:
+        with self._lock:
+            self._handle_status_locked(task_name, status)
+
+    def _handle_status_locked(self, task_name: str,
+                              status: TaskStatus) -> None:
         try:
             self.state.store_status(task_name, status)
         except StateStoreError:
@@ -190,6 +228,14 @@ class ServiceScheduler:
     def run_cycle(self) -> int:
         """One evaluation pass; returns the number of actions (launches +
         kill batches) issued — zero means the cycle found no work."""
+        with self._lock:
+            return self._run_cycle_locked()
+
+    def _run_cycle_locked(self) -> int:
+        if self.agent_grace_s > 0:
+            # remote clusters: agents can die mid-run; re-check liveness
+            # every cycle (reference ImplicitReconciler periodic pass)
+            self.reconcile()
         agents = list(self.cluster.agents())
         actions = 0
         for step in list(self.coordinator.get_candidates()):
@@ -313,7 +359,9 @@ class ServiceScheduler:
     def restart_pod(self, pod_instance_name: str) -> List[str]:
         """Kill tasks in place; recovery relaunches them TRANSIENT
         (reference ``PodQueries.restart``)."""
-        return [task_name
+        with self._lock:
+            return [
+                task_name
                 for task_name in self.pod_instance_task_names(pod_instance_name)
                 if self._kill_if_running(task_name)]
 
@@ -338,6 +386,14 @@ class ServiceScheduler:
 
     def _set_override(self, pod_instance_name: str, override: GoalOverride,
                       task_names: Optional[Sequence[str]] = None) -> List[str]:
+        with self._lock:
+            return self._set_override_locked(pod_instance_name, override,
+                                             task_names)
+
+    def _set_override_locked(self, pod_instance_name: str,
+                             override: GoalOverride,
+                             task_names: Optional[Sequence[str]] = None
+                             ) -> List[str]:
         instance_names = self.pod_instance_task_names(pod_instance_name)
         if task_names:
             # accept short spec names ("server") or full instance names
@@ -374,13 +430,14 @@ class ServiceScheduler:
         (reference ``pod replace`` -> ``FailureUtils.setPermanentlyFailed``,
         SURVEY.md section 3.4)."""
         touched = []
-        for task_name in self.pod_instance_task_names(pod_instance_name):
-            task = self.state.fetch_task(task_name)
-            if task is None:
-                continue
-            self.state.store_tasks([task.failed_permanently()])
-            self._kill_if_running(task_name)
-            touched.append(task_name)
+        with self._lock:
+            for task_name in self.pod_instance_task_names(pod_instance_name):
+                task = self.state.fetch_task(task_name)
+                if task is None:
+                    continue
+                self.state.store_tasks([task.failed_permanently()])
+                self._kill_if_running(task_name)
+                touched.append(task_name)
         return touched
 
 

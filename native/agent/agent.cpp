@@ -1,0 +1,473 @@
+// tpu-agent — per-host agent daemon (C++17, no dependencies).
+//
+// The native equivalent of the reference's Mesos agent + default executor +
+// libmesos driver rolled into one (SURVEY.md §2.2 row 1): it inventories
+// the host (cpus/mem/disk/ports + TPU chips and ICI topology coords),
+// registers with the scheduler, polls for launch/kill commands, supervises
+// task processes in per-task sandboxes, and reports status updates
+// (TASK_RUNNING / TASK_FINISHED / TASK_FAILED / TASK_KILLED) on the next
+// poll — the reference's status-update channel
+// (FrameworkScheduler.statusUpdate, FrameworkScheduler.java:273).
+//
+// Protocol (scheduler side: dcos_commons_tpu/agent/remote.py):
+//   POST /v1/agents/register   {agent_id, hostname, cpus, ...} -> {ok}
+//   POST /v1/agents/<id>/poll  {running_task_ids, statuses} -> {commands}
+//
+// Tasks run as process groups under /bin/sh -c <cmd> in
+// <base_dir>/<task_id>/ with the launch env exported; kill sends SIGTERM to
+// the group, then SIGKILL after the grace period. Readiness checks
+// (reference ReadinessCheckSpec) run after launch; success is reported as
+// TASK_RUNNING with readiness_passed=true.
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "../common/http.hpp"
+#include "../common/json.hpp"
+
+using tpu::Json;
+
+namespace {
+
+double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return static_cast<double>(ts.tv_sec) + ts.tv_nsec * 1e-9;
+}
+
+struct RunningTask {
+  std::string task_id;
+  std::string task_name;
+  pid_t pid = -1;
+  std::string goal;               // RUNNING | ONCE | FINISH
+  pid_t readiness_pid = -1;       // readiness-check process, if any
+  bool readiness_reported = false;
+  bool kill_requested = false;
+  double sigkill_deadline = 0;    // when to escalate SIGTERM -> SIGKILL
+};
+
+struct Config {
+  std::string scheduler_url = "http://127.0.0.1:8080";
+  std::string agent_id;
+  std::string hostname;
+  std::string base_dir = "./sandboxes";
+  double cpus = 0;
+  long memory_mb = 0;
+  long disk_mb = 0;
+  long port_lo = 10000, port_hi = 20000;
+  int tpu_chips = -1;  // -1: probe /dev/accel*
+  std::string slice_id, topology, zone, region;
+  int worker_index = -1;
+  double poll_interval_s = 1.0;
+  long max_polls = -1;  // test hook: exit after N polls (-1 = forever)
+};
+
+int probe_tpu_chips() {
+  // TPU VM chips appear as /dev/accel0..N (PJRT libtpu contract)
+  int count = 0;
+  for (int i = 0; i < 64; ++i) {
+    std::string path = "/dev/accel" + std::to_string(i);
+    if (access(path.c_str(), F_OK) == 0) {
+      ++count;
+    }
+  }
+  return count;
+}
+
+std::string detect_hostname() {
+  char buf[256];
+  if (gethostname(buf, sizeof buf) == 0) return buf;
+  return "localhost";
+}
+
+double detect_cpus() {
+  long n = sysconf(_SC_NPROCESSORS_ONLN);
+  return n > 0 ? static_cast<double>(n) : 1.0;
+}
+
+long detect_memory_mb() {
+  long pages = sysconf(_SC_PHYS_PAGES);
+  long page_size = sysconf(_SC_PAGE_SIZE);
+  if (pages <= 0 || page_size <= 0) return 1024;
+  return pages / 1024 * page_size / 1024;
+}
+
+bool mkdirs(const std::string& path) {
+  std::string partial;
+  for (size_t i = 0; i < path.size(); ++i) {
+    partial += path[i];
+    if (path[i] == '/' || i + 1 == path.size()) {
+      if (partial == "/" || partial.empty()) continue;
+      if (mkdir(partial.c_str(), 0755) != 0 && errno != EEXIST) return false;
+    }
+  }
+  return true;
+}
+
+class Agent {
+ public:
+  explicit Agent(Config cfg) : cfg_(std::move(cfg)) {}
+
+  int run() {
+    if (!register_with_retry()) return 1;
+    long polls = 0;
+    while (cfg_.max_polls < 0 || polls < cfg_.max_polls) {
+      ++polls;
+      reap_children();
+      escalate_kills();
+      if (!poll_once()) {
+        // scheduler asked us to re-register (restarted / expired us)
+        if (!register_with_retry()) return 1;
+      }
+      usleep(static_cast<useconds_t>(cfg_.poll_interval_s * 1e6));
+    }
+    return 0;
+  }
+
+ private:
+  Config cfg_;
+  std::map<std::string, RunningTask> tasks_;  // task_id -> state
+  std::vector<Json> pending_statuses_;
+
+  // -- registration -----------------------------------------------------
+
+  Json inventory() const {
+    Json tpu = Json::object();
+    tpu.set("chips", cfg_.tpu_chips);
+    if (!cfg_.slice_id.empty()) tpu.set("slice_id", cfg_.slice_id);
+    if (!cfg_.topology.empty()) tpu.set("topology", cfg_.topology);
+    if (cfg_.worker_index >= 0) tpu.set("worker_index", cfg_.worker_index);
+    Json ports = Json::array();
+    Json range = Json::array();
+    range.push_back(static_cast<double>(cfg_.port_lo));
+    range.push_back(static_cast<double>(cfg_.port_hi));
+    ports.push_back(range);
+    Json body = Json::object();
+    body.set("agent_id", cfg_.agent_id)
+        .set("hostname", cfg_.hostname)
+        .set("cpus", cfg_.cpus)
+        .set("memory_mb", static_cast<double>(cfg_.memory_mb))
+        .set("disk_mb", static_cast<double>(cfg_.disk_mb))
+        .set("ports", ports)
+        .set("tpu", tpu);
+    if (!cfg_.zone.empty()) body.set("zone", cfg_.zone);
+    if (!cfg_.region.empty()) body.set("region", cfg_.region);
+    return body;
+  }
+
+  bool register_with_retry() {
+    std::string url = cfg_.scheduler_url + "/v1/agents/register";
+    for (int attempt = 0; attempt < 120; ++attempt) {
+      try {
+        auto resp = tpu::http_post(url, inventory().dump());
+        if (resp.status == 200 &&
+            Json::parse(resp.body).get("ok").as_bool()) {
+          std::cerr << "[tpu-agent] registered " << cfg_.agent_id
+                    << " with " << cfg_.scheduler_url << "\n";
+          return true;
+        }
+        std::cerr << "[tpu-agent] register rejected: " << resp.status
+                  << " " << resp.body << "\n";
+      } catch (const std::exception& e) {
+        std::cerr << "[tpu-agent] register retry: " << e.what() << "\n";
+      }
+      sleep(1);
+    }
+    return false;
+  }
+
+  // -- poll cycle --------------------------------------------------------
+
+  bool poll_once() {
+    Json running = Json::array();
+    for (const auto& [task_id, t] : tasks_) {
+      if (t.pid > 0) running.push_back(task_id);
+    }
+    Json statuses = Json::array();
+    for (auto& s : pending_statuses_) statuses.push_back(s);
+    Json body = Json::object();
+    body.set("running_task_ids", running).set("statuses", statuses);
+
+    std::string url =
+        cfg_.scheduler_url + "/v1/agents/" + cfg_.agent_id + "/poll";
+    Json reply;
+    try {
+      auto resp = tpu::http_post(url, body.dump());
+      if (resp.status != 200) {
+        std::cerr << "[tpu-agent] poll HTTP " << resp.status << "\n";
+        return true;  // transient; keep statuses queued
+      }
+      reply = Json::parse(resp.body);
+    } catch (const std::exception& e) {
+      std::cerr << "[tpu-agent] poll failed: " << e.what() << "\n";
+      return true;  // keep statuses for next successful poll
+    }
+    if (!reply.get("ok").as_bool() &&
+        reply.get("reregister").as_bool()) {
+      // scheduler restarted/expired us: keep queued statuses so terminal
+      // updates are re-delivered after re-registration
+      return false;
+    }
+    pending_statuses_.clear();
+    for (const auto& cmd : reply.get("commands").items()) {
+      const std::string type = cmd.get("type").as_string();
+      if (type == "launch") {
+        for (const auto& task : cmd.get("tasks").items()) launch(task);
+      } else if (type == "kill") {
+        kill_task(cmd.get("task_id").as_string(),
+                  cmd.get("grace_period_s").as_number(0));
+      }
+    }
+    return true;
+  }
+
+  // -- task lifecycle ----------------------------------------------------
+
+  void emit(const std::string& task_id, const std::string& task_name,
+            const std::string& state, const std::string& message,
+            bool readiness = false) {
+    Json s = Json::object();
+    s.set("task_id", task_id)
+        .set("task_name", task_name)
+        .set("state", state)
+        .set("message", message)
+        .set("timestamp", now_s());
+    if (readiness) s.set("readiness_passed", true);
+    pending_statuses_.push_back(std::move(s));
+  }
+
+  void launch(const Json& task) {
+    const std::string task_id = task.get("task_id").as_string();
+    const std::string task_name = task.get("task_name").as_string();
+    const std::string cmd = task.get("cmd").as_string();
+    std::string sandbox = cfg_.base_dir + "/" + task_id;
+    if (!mkdirs(sandbox)) {
+      emit(task_id, task_name, "TASK_FAILED",
+           "cannot create sandbox " + sandbox);
+      return;
+    }
+
+    // write config templates for tpu-bootstrap to render (reference:
+    // CONFIG_TEMPLATE_* env + ArtifactResource downloads)
+    std::vector<std::pair<std::string, std::string>> template_env;
+    int tmpl_idx = 0;
+    for (const auto& tmpl : task.get("config_templates").items()) {
+      std::string name = tmpl.get("name").as_string();
+      std::string src = sandbox + "/.tpu-templates/" + name;
+      mkdirs(sandbox + "/.tpu-templates");
+      std::ofstream f(src);
+      f << tmpl.get("template").as_string();
+      f.close();
+      template_env.emplace_back(
+          "CONFIG_TEMPLATE_" + std::to_string(tmpl_idx++),
+          src + "," + tmpl.get("dest").as_string());
+    }
+
+    pid_t pid = fork();
+    if (pid < 0) {
+      emit(task_id, task_name, "TASK_FAILED", "fork failed");
+      return;
+    }
+    if (pid == 0) {
+      // child: own process group so kill() reaps the whole task tree
+      setpgid(0, 0);
+      if (chdir(sandbox.c_str()) != 0) _exit(126);
+      // task env (launch env wins over inherited env)
+      for (const auto& [k, v] : task.get("env").fields()) {
+        setenv(k.c_str(), v.as_string().c_str(), 1);
+      }
+      for (const auto& [k, v] : template_env) {
+        setenv(k.c_str(), v.c_str(), 1);
+      }
+      setenv("TPU_SANDBOX", sandbox.c_str(), 1);
+      int out = open("stdout.log", O_WRONLY | O_CREAT | O_APPEND, 0644);
+      int err = open("stderr.log", O_WRONLY | O_CREAT | O_APPEND, 0644);
+      if (out >= 0) dup2(out, 1);
+      if (err >= 0) dup2(err, 2);
+      execl("/bin/sh", "sh", "-c", cmd.c_str(), (char*)nullptr);
+      _exit(127);
+    }
+    setpgid(pid, pid);  // also from parent (avoid the exec race)
+    {
+      std::ofstream pf(sandbox + "/task.pid");
+      pf << pid << "\n";
+    }
+
+    RunningTask rt;
+    rt.task_id = task_id;
+    rt.task_name = task_name;
+    rt.pid = pid;
+    rt.goal = task.get("goal").as_string();
+    tasks_[task_id] = rt;
+    emit(task_id, task_name, "TASK_RUNNING", "started pid " +
+                                                 std::to_string(pid));
+
+    const std::string readiness = task.get("readiness_check_cmd").as_string();
+    if (!readiness.empty()) {
+      pid_t rp = fork();
+      if (rp == 0) {
+        setpgid(0, 0);
+        if (chdir(sandbox.c_str()) != 0) _exit(126);
+        for (const auto& [k, v] : task.get("env").fields()) {
+          setenv(k.c_str(), v.as_string().c_str(), 1);
+        }
+        execl("/bin/sh", "sh", "-c", readiness.c_str(), (char*)nullptr);
+        _exit(127);
+      }
+      tasks_[task_id].readiness_pid = rp;
+    } else {
+      tasks_[task_id].readiness_reported = true;
+    }
+  }
+
+  void kill_task(const std::string& task_id, double grace_s) {
+    auto it = tasks_.find(task_id);
+    if (it == tasks_.end() || it->second.pid <= 0) {
+      return;  // already gone; reconciliation handles the rest
+    }
+    RunningTask& t = it->second;
+    t.kill_requested = true;
+    ::kill(-t.pid, SIGTERM);
+    if (t.readiness_pid > 0) {
+      ::kill(-t.readiness_pid, SIGKILL);  // its target task is going away
+    }
+    t.sigkill_deadline = now_s() + grace_s;
+  }
+
+  void escalate_kills() {
+    double now = now_s();
+    for (auto& [task_id, t] : tasks_) {
+      if (t.kill_requested && t.pid > 0 && now >= t.sigkill_deadline) {
+        ::kill(-t.pid, SIGKILL);
+      }
+    }
+  }
+
+  void reap_children() {
+    while (true) {
+      int status = 0;
+      pid_t pid = waitpid(-1, &status, WNOHANG);
+      if (pid <= 0) break;
+      for (auto it = tasks_.begin(); it != tasks_.end(); ++it) {
+        RunningTask& t = it->second;
+        if (t.readiness_pid == pid) {
+          t.readiness_pid = -1;
+          if (WIFEXITED(status) && WEXITSTATUS(status) == 0 &&
+              !t.readiness_reported) {
+            t.readiness_reported = true;
+            emit(t.task_id, t.task_name, "TASK_RUNNING", "readiness passed",
+                 /*readiness=*/true);
+          }
+          break;
+        }
+        if (t.pid == pid) {
+          int code = WIFEXITED(status) ? WEXITSTATUS(status) : -1;
+          std::string state;
+          std::string msg;
+          if (t.kill_requested) {
+            state = "TASK_KILLED";
+            msg = "killed by scheduler";
+          } else if (code == 0) {
+            state = "TASK_FINISHED";
+            msg = "exit 0";
+          } else {
+            state = "TASK_FAILED";
+            msg = WIFSIGNALED(status)
+                      ? ("signal " + std::to_string(WTERMSIG(status)))
+                      : ("exit " + std::to_string(code));
+          }
+          emit(t.task_id, t.task_name, state, msg);
+          if (t.readiness_pid > 0) {
+            ::kill(-t.readiness_pid, SIGKILL);  // don't leak the checker
+          }
+          t.pid = -1;
+          tasks_.erase(it);
+          break;
+        }
+      }
+    }
+  }
+};
+
+void usage(const char* argv0) {
+  std::cerr
+      << "usage: " << argv0 << " --scheduler URL [options]\n"
+      << "  --scheduler URL     scheduler base url (http://host:port)\n"
+      << "  --agent-id ID       unique agent id (default: hostname)\n"
+      << "  --base-dir DIR      sandbox root (default ./sandboxes)\n"
+      << "  --cpus N --memory-mb N --disk-mb N   advertised resources\n"
+      << "  --ports LO-HI       advertised port range\n"
+      << "  --tpu-chips N       TPU chips (default: probe /dev/accel*)\n"
+      << "  --slice-id S --topology T --worker-index N   ICI identity\n"
+      << "  --zone Z --region R\n"
+      << "  --poll-interval S   seconds between polls (default 1)\n"
+      << "  --max-polls N       exit after N polls (testing)\n";
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Config cfg;
+  cfg.hostname = detect_hostname();
+  cfg.cpus = detect_cpus();
+  cfg.memory_mb = detect_memory_mb();
+  cfg.disk_mb = 10240;
+
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) {
+        usage(argv[0]);
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--scheduler") cfg.scheduler_url = next();
+    else if (a == "--agent-id") cfg.agent_id = next();
+    else if (a == "--hostname") cfg.hostname = next();
+    else if (a == "--base-dir") cfg.base_dir = next();
+    else if (a == "--cpus") cfg.cpus = std::stod(next());
+    else if (a == "--memory-mb") cfg.memory_mb = std::stol(next());
+    else if (a == "--disk-mb") cfg.disk_mb = std::stol(next());
+    else if (a == "--ports") {
+      std::string v = next();
+      size_t dash = v.find('-');
+      if (dash == std::string::npos) {
+        usage(argv[0]);
+        return 2;
+      }
+      cfg.port_lo = std::stol(v.substr(0, dash));
+      cfg.port_hi = std::stol(v.substr(dash + 1));
+    } else if (a == "--tpu-chips") cfg.tpu_chips = std::stoi(next());
+    else if (a == "--slice-id") cfg.slice_id = next();
+    else if (a == "--topology") cfg.topology = next();
+    else if (a == "--worker-index") cfg.worker_index = std::stoi(next());
+    else if (a == "--zone") cfg.zone = next();
+    else if (a == "--region") cfg.region = next();
+    else if (a == "--poll-interval") cfg.poll_interval_s = std::stod(next());
+    else if (a == "--max-polls") cfg.max_polls = std::stol(next());
+    else {
+      usage(argv[0]);
+      return 2;
+    }
+  }
+  if (cfg.agent_id.empty()) cfg.agent_id = cfg.hostname;
+  if (cfg.tpu_chips < 0) cfg.tpu_chips = probe_tpu_chips();
+  mkdirs(cfg.base_dir);
+
+  signal(SIGPIPE, SIG_IGN);
+  return Agent(std::move(cfg)).run();
+}

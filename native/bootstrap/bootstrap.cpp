@@ -1,0 +1,196 @@
+// tpu-bootstrap — in-sandbox task initializer (C++17, no dependencies).
+//
+// Native equivalent of the reference's Go bootstrap (sdk/bootstrap/main.go):
+//   1. render CONFIG_TEMPLATE_<n>=<src>,<dst> templates against the task env
+//      (mustache-style {{VAR}} substitution, missing vars are fatal —
+//      reference TemplateUtils.renderMustache missing-value errors,
+//      main.go:351-376)
+//   2. wait until the JAX distributed coordinator (pod instance 0) is
+//      reachable, so jax.distributed.initialize() doesn't race the gang
+//      (replaces the reference's DNS self-resolution wait, main.go:218-287)
+//   3. echo the resolved TPU/JAX contract for the task log
+//
+// The scheduler's matcher injects JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID /
+// JAX_NUM_PROCESSES / TPU_* (dcos_commons_tpu/matching/evaluator.py), the
+// agent exports them into the sandbox, and the task cmd runs
+// `tpu-bootstrap && <real command>`.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string getenv_str(const char* name, const std::string& dflt = "") {
+  const char* v = getenv(name);
+  return v ? std::string(v) : dflt;
+}
+
+// {{VAR}} substitution from env; {{!comment}} dropped; missing var -> fatal.
+std::string render(const std::string& tmpl, const std::string& src,
+                   bool strict) {
+  std::string out;
+  size_t pos = 0;
+  while (pos < tmpl.size()) {
+    size_t open = tmpl.find("{{", pos);
+    if (open == std::string::npos) {
+      out += tmpl.substr(pos);
+      break;
+    }
+    out += tmpl.substr(pos, open - pos);
+    size_t close = tmpl.find("}}", open);
+    if (close == std::string::npos) {
+      std::cerr << "[tpu-bootstrap] unterminated {{ in " << src << "\n";
+      exit(1);
+    }
+    std::string key = tmpl.substr(open + 2, close - open - 2);
+    pos = close + 2;
+    if (!key.empty() && key[0] == '!') continue;  // comment
+    const char* val = getenv(key.c_str());
+    if (val == nullptr) {
+      if (strict) {
+        std::cerr << "[tpu-bootstrap] template " << src
+                  << " references undefined env var {{" << key << "}}\n";
+        exit(1);
+      }
+      continue;
+    }
+    out += val;
+  }
+  return out;
+}
+
+void render_templates(bool strict) {
+  for (int i = 0; i < 1024; ++i) {
+    std::string spec =
+        getenv_str(("CONFIG_TEMPLATE_" + std::to_string(i)).c_str());
+    if (spec.empty()) {
+      if (i == 0) continue;  // allow sparse numbering to start at 1
+      break;
+    }
+    size_t comma = spec.find(',');
+    if (comma == std::string::npos) {
+      std::cerr << "[tpu-bootstrap] bad CONFIG_TEMPLATE_" << i
+                << " (want <src>,<dst>): " << spec << "\n";
+      exit(1);
+    }
+    std::string src = spec.substr(0, comma);
+    std::string dst = spec.substr(comma + 1);
+    std::ifstream in(src);
+    if (!in) {
+      std::cerr << "[tpu-bootstrap] missing template " << src << "\n";
+      exit(1);
+    }
+    std::stringstream buf;
+    buf << in.rdbuf();
+    std::ofstream out(dst);
+    if (!out) {
+      std::cerr << "[tpu-bootstrap] cannot write " << dst << "\n";
+      exit(1);
+    }
+    out << render(buf.str(), src, strict);
+    std::cerr << "[tpu-bootstrap] rendered " << src << " -> " << dst << "\n";
+  }
+}
+
+bool tcp_reachable(const std::string& host, const std::string& port,
+                   int timeout_s) {
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof hints);
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0) {
+    return false;
+  }
+  bool ok = false;
+  for (struct addrinfo* ai = res; ai != nullptr && !ok; ai = ai->ai_next) {
+    int fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    struct timeval tv{timeout_s, 0};
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    ok = connect(fd, ai->ai_addr, ai->ai_addrlen) == 0;
+    close(fd);
+  }
+  freeaddrinfo(res);
+  return ok;
+}
+
+int wait_for_coordinator(int timeout_s) {
+  std::string addr = getenv_str("JAX_COORDINATOR_ADDRESS");
+  std::string num = getenv_str("JAX_NUM_PROCESSES", "1");
+  std::string pid = getenv_str("JAX_PROCESS_ID", "0");
+  if (addr.empty() || num == "1" || num.empty()) {
+    return 0;  // single-process: nothing to wait for
+  }
+  if (pid == "0") {
+    // we ARE the coordinator; peers wait for us
+    return 0;
+  }
+  size_t colon = addr.rfind(':');
+  if (colon == std::string::npos) {
+    std::cerr << "[tpu-bootstrap] bad JAX_COORDINATOR_ADDRESS " << addr
+              << "\n";
+    return 1;
+  }
+  std::string host = addr.substr(0, colon);
+  std::string port = addr.substr(colon + 1);
+  std::cerr << "[tpu-bootstrap] waiting for coordinator " << addr << "\n";
+  for (int waited = 0; waited < timeout_s; ++waited) {
+    if (tcp_reachable(host, port, 2)) {
+      std::cerr << "[tpu-bootstrap] coordinator reachable\n";
+      return 0;
+    }
+    sleep(1);
+  }
+  std::cerr << "[tpu-bootstrap] coordinator " << addr << " unreachable after "
+            << timeout_s << "s\n";
+  return 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  bool strict = true;
+  bool do_wait = true;
+  int timeout_s = 600;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    if (a == "--no-strict-templates") strict = false;
+    else if (a == "--no-wait") do_wait = false;
+    else if (a == "--wait-timeout" && i + 1 < argc) {
+      timeout_s = std::stoi(argv[++i]);
+    } else if (a == "--help" || a == "-h") {
+      std::cerr << "usage: tpu-bootstrap [--no-strict-templates] [--no-wait]"
+                << " [--wait-timeout S]\n";
+      return 0;
+    }
+  }
+
+  render_templates(strict);
+  if (do_wait) {
+    int rc = wait_for_coordinator(timeout_s);
+    if (rc != 0) return rc;
+  }
+
+  // echo the contract (reference bootstrap prints env at main.go:466-513)
+  std::cerr << "[tpu-bootstrap] TASK_NAME=" << getenv_str("TASK_NAME")
+            << " JAX_PROCESS_ID=" << getenv_str("JAX_PROCESS_ID", "-")
+            << " JAX_NUM_PROCESSES=" << getenv_str("JAX_NUM_PROCESSES", "-")
+            << " JAX_COORDINATOR_ADDRESS="
+            << getenv_str("JAX_COORDINATOR_ADDRESS", "-")
+            << " TPU_SLICE_ID=" << getenv_str("TPU_SLICE_ID", "-")
+            << " TPU_TOPOLOGY=" << getenv_str("TPU_TOPOLOGY", "-")
+            << " TPU_WORKER_COORDS=" << getenv_str("TPU_WORKER_COORDS", "-")
+            << "\n";
+  return 0;
+}

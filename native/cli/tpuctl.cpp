@@ -1,0 +1,149 @@
+// tpuctl — native operator CLI for the scheduler HTTP API (C++17).
+//
+// Native build of the same command surface as the Python CLI
+// (dcos_commons_tpu/cli/main.py), mirroring the reference's Go CLI
+// (cli/commands.go:38-52): plan / pod / endpoints / debug / describe /
+// config / state / health against /v1/* (or /v1/service/<name>/* with
+// --service).
+
+#include <cstdlib>
+#include <iostream>
+#include <string>
+#include <vector>
+
+#include "../common/http.hpp"
+#include "../common/json.hpp"
+
+namespace {
+
+struct Ctx {
+  std::string base = "http://127.0.0.1:8080";
+  std::string prefix = "/v1";
+};
+
+int emit(const tpu::HttpResponse& resp) {
+  // re-indent through the Json layer when possible for stable output
+  try {
+    std::cout << tpu::Json::parse(resp.body).dump() << "\n";
+  } catch (...) {
+    std::cout << resp.body << "\n";
+  }
+  return resp.status < 400 ? 0 : 1;
+}
+
+int get(const Ctx& ctx, const std::string& path) {
+  return emit(tpu::http_get(ctx.base + ctx.prefix + "/" + path));
+}
+
+int post(const Ctx& ctx, const std::string& path,
+         const std::string& body = "") {
+  return emit(tpu::http_post(ctx.base + ctx.prefix + "/" + path, body));
+}
+
+void usage() {
+  std::cerr
+      << "usage: tpuctl [--url URL] [--service NAME] <command> ...\n"
+      << "  plan list|show|start|stop|continue|interrupt|force-complete|"
+      << "restart [PLAN] [--phase P] [--step S]\n"
+      << "  pod list|status|info|restart|replace|pause|resume [POD]\n"
+      << "  endpoints [NAME]\n"
+      << "  debug offers|plans|statuses|reservations\n"
+      << "  describe | config list|show|target-id [ID]\n"
+      << "  state framework-id|properties|property [KEY]\n"
+      << "  health\n";
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Ctx ctx;
+  const char* env_url = getenv("TPU_SCHEDULER_URL");
+  if (env_url != nullptr) ctx.base = env_url;
+
+  std::vector<std::string> args;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    if (a == "--url" && i + 1 < argc) {
+      ctx.base = argv[++i];
+    } else if (a == "--service" && i + 1 < argc) {
+      ctx.prefix = std::string("/v1/service/") + argv[++i];
+    } else {
+      args.push_back(a);
+    }
+  }
+  if (args.empty()) {
+    usage();
+    return 2;
+  }
+
+  // extract --phase/--step wherever they appear
+  std::string phase, step;
+  std::vector<std::string> pos;
+  for (size_t i = 0; i < args.size(); ++i) {
+    if (args[i] == "--phase" && i + 1 < args.size()) phase = args[++i];
+    else if (args[i] == "--step" && i + 1 < args.size()) step = args[++i];
+    else pos.push_back(args[i]);
+  }
+
+  try {
+    const std::string& cmd = pos[0];
+    std::string action = pos.size() > 1 ? pos[1] : "";
+    std::string arg = pos.size() > 2 ? pos[2] : "";
+
+    if (cmd == "health") return get(ctx, "health");
+    if (cmd == "describe") return get(ctx, "configurations/target");
+
+    if (cmd == "plan") {
+      if (action == "list" || action.empty()) return get(ctx, "plans");
+      std::string plan = arg.empty() ? "deploy" : arg;
+      if (action == "show") return get(ctx, "plans/" + plan);
+      std::string verb = action == "force-complete" ? "forceComplete"
+                                                    : action;
+      std::string qs;
+      if (!phase.empty()) qs += (qs.empty() ? "?" : "&") + ("phase=" + phase);
+      if (!step.empty()) qs += (qs.empty() ? "?" : "&") + ("step=" + step);
+      return post(ctx, "plans/" + plan + "/" + verb + qs);
+    }
+
+    if (cmd == "pod") {
+      if (action == "list" || action.empty()) return get(ctx, "pod");
+      if (action == "status") {
+        return get(ctx, arg.empty() ? "pod/status" : "pod/" + arg +
+                                                         "/status");
+      }
+      if (action == "info") return get(ctx, "pod/" + arg + "/info");
+      return post(ctx, "pod/" + arg + "/" + action);
+    }
+
+    if (cmd == "endpoints") {
+      return get(ctx, action.empty() ? "endpoints" : "endpoints/" + action);
+    }
+
+    if (cmd == "debug") {
+      if (action == "offers") return get(ctx, "debug/offers");
+      if (action == "plans") return get(ctx, "debug/plans");
+      if (action == "statuses") return get(ctx, "debug/taskStatuses");
+      if (action == "reservations") return get(ctx, "debug/reservations");
+    }
+
+    if (cmd == "config") {
+      if (action == "list") return get(ctx, "configurations");
+      if (action == "target-id") return get(ctx, "configurations/targetId");
+      if (action == "show") {
+        return get(ctx, arg.empty() ? "configurations/target"
+                                    : "configurations/" + arg);
+      }
+    }
+
+    if (cmd == "state") {
+      if (action == "framework-id") return get(ctx, "state/frameworkId");
+      if (action == "properties") return get(ctx, "state/properties");
+      if (action == "property") return get(ctx, "state/properties/" + arg);
+    }
+  } catch (const std::exception& e) {
+    std::cerr << "error: " << e.what() << "\n";
+    return 2;
+  }
+  usage();
+  return 2;
+}

@@ -1,0 +1,134 @@
+// Minimal blocking HTTP/1.1 client over POSIX sockets (C++17, no deps).
+//
+// The native components' transport to the scheduler ApiServer — the role the
+// reference delegated to libmesos/JNI (scheduler side) and Go's net/http
+// (bootstrap/CLI side). Supports http://host:port/path only; each request
+// uses a fresh connection (Connection: close) — the protocol is low-rate
+// (1 Hz polls), so simplicity beats keep-alive.
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+namespace tpu {
+
+struct HttpResponse {
+  int status = 0;
+  std::string body;
+};
+
+struct Url {
+  std::string host;
+  std::string port;
+  std::string path;
+};
+
+inline Url parse_url(const std::string& url) {
+  const std::string scheme = "http://";
+  if (url.compare(0, scheme.size(), scheme) != 0) {
+    throw std::runtime_error("only http:// URLs supported: " + url);
+  }
+  std::string rest = url.substr(scheme.size());
+  size_t slash = rest.find('/');
+  std::string hostport = slash == std::string::npos ? rest
+                                                    : rest.substr(0, slash);
+  Url out;
+  out.path = slash == std::string::npos ? "/" : rest.substr(slash);
+  size_t colon = hostport.rfind(':');
+  if (colon == std::string::npos) {
+    out.host = hostport;
+    out.port = "80";
+  } else {
+    out.host = hostport.substr(0, colon);
+    out.port = hostport.substr(colon + 1);
+  }
+  return out;
+}
+
+inline HttpResponse http_request(const std::string& method,
+                                 const std::string& url,
+                                 const std::string& body = "",
+                                 int timeout_s = 30) {
+  Url u = parse_url(url);
+
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof hints);
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  int rc = getaddrinfo(u.host.c_str(), u.port.c_str(), &hints, &res);
+  if (rc != 0) {
+    throw std::runtime_error("resolve " + u.host + ": " + gai_strerror(rc));
+  }
+
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    struct timeval tv{timeout_s, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    throw std::runtime_error("connect to " + u.host + ":" + u.port +
+                             " failed");
+  }
+
+  std::string req = method + " " + u.path + " HTTP/1.1\r\n" +
+                    "Host: " + u.host + ":" + u.port + "\r\n" +
+                    "Content-Type: application/json\r\n" +
+                    "Content-Length: " + std::to_string(body.size()) +
+                    "\r\n" + "Connection: close\r\n\r\n" + body;
+  size_t sent = 0;
+  while (sent < req.size()) {
+    ssize_t n = send(fd, req.data() + sent, req.size() - sent, 0);
+    if (n <= 0) {
+      close(fd);
+      throw std::runtime_error("send failed");
+    }
+    sent += static_cast<size_t>(n);
+  }
+
+  std::string raw;
+  char buf[8192];
+  while (true) {
+    ssize_t n = recv(fd, buf, sizeof buf, 0);
+    if (n <= 0) break;
+    raw.append(buf, static_cast<size_t>(n));
+  }
+  close(fd);
+
+  size_t header_end = raw.find("\r\n\r\n");
+  if (header_end == std::string::npos) {
+    throw std::runtime_error("malformed HTTP response");
+  }
+  HttpResponse out;
+  size_t sp = raw.find(' ');
+  if (sp != std::string::npos) {
+    out.status = std::stoi(raw.substr(sp + 1, 3));
+  }
+  out.body = raw.substr(header_end + 4);
+  return out;
+}
+
+inline HttpResponse http_get(const std::string& url, int timeout_s = 30) {
+  return http_request("GET", url, "", timeout_s);
+}
+
+inline HttpResponse http_post(const std::string& url, const std::string& body,
+                              int timeout_s = 30) {
+  return http_request("POST", url, body, timeout_s);
+}
+
+}  // namespace tpu

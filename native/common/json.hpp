@@ -1,0 +1,333 @@
+// Minimal JSON value type + parser/serializer (C++17, no dependencies).
+//
+// Shared by the native components (tpu-agent, tpu-bootstrap, tpuctl) that
+// speak the scheduler's HTTP/JSON protocol — the role protobuf played on the
+// reference's libmesos boundary. Deliberately small: objects, arrays,
+// strings, doubles, bools, null; UTF-8 passthrough; \uXXXX parsed to UTF-8.
+
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace tpu {
+
+class Json;
+using JsonObject = std::map<std::string, Json>;
+using JsonArray = std::vector<Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Number), num_(v) {}
+  Json(int64_t v) : type_(Type::Number), num_(static_cast<double>(v)) {}
+  Json(double v) : type_(Type::Number), num_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array),
+                      arr_(std::make_shared<JsonArray>(std::move(a))) {}
+  Json(JsonObject o) : type_(Type::Object),
+                       obj_(std::make_shared<JsonObject>(std::move(o))) {}
+
+  static Json object() { return Json(JsonObject{}); }
+  static Json array() { return Json(JsonArray{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  double as_number(double dflt = 0) const {
+    return type_ == Type::Number ? num_ : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    return type_ == Type::Number ? static_cast<int64_t>(num_) : dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+
+  const JsonArray& items() const {
+    static const JsonArray empty;
+    return type_ == Type::Array ? *arr_ : empty;
+  }
+  JsonArray& items() {
+    if (type_ != Type::Array) throw std::runtime_error("not an array");
+    return *arr_;
+  }
+  const JsonObject& fields() const {
+    static const JsonObject empty;
+    return type_ == Type::Object ? *obj_ : empty;
+  }
+
+  // object access: get() is safe on any type (returns null Json on miss)
+  const Json& get(const std::string& key) const {
+    static const Json null_json;
+    if (type_ != Type::Object) return null_json;
+    auto it = obj_->find(key);
+    return it == obj_->end() ? null_json : it->second;
+  }
+  Json& set(const std::string& key, Json value) {
+    if (type_ != Type::Object) throw std::runtime_error("not an object");
+    (*obj_)[key] = std::move(value);
+    return *this;
+  }
+  void push_back(Json value) { items().push_back(std::move(value)); }
+
+  std::string dump() const {
+    std::ostringstream out;
+    write(out);
+    return out.str();
+  }
+
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size()) throw std::runtime_error("trailing JSON data");
+    return v;
+  }
+
+ private:
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  std::shared_ptr<JsonArray> arr_;
+  std::shared_ptr<JsonObject> obj_;
+
+  void write(std::ostringstream& out) const {
+    switch (type_) {
+      case Type::Null: out << "null"; break;
+      case Type::Bool: out << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) &&
+            std::fabs(num_) < 1e15) {
+          out << static_cast<int64_t>(num_);
+        } else {
+          out << num_;
+        }
+        break;
+      }
+      case Type::String: write_string(out, str_); break;
+      case Type::Array: {
+        out << '[';
+        bool first = true;
+        for (const auto& v : *arr_) {
+          if (!first) out << ',';
+          first = false;
+          v.write(out);
+        }
+        out << ']';
+        break;
+      }
+      case Type::Object: {
+        out << '{';
+        bool first = true;
+        for (const auto& [k, v] : *obj_) {
+          if (!first) out << ',';
+          first = false;
+          write_string(out, k);
+          out << ':';
+          v.write(out);
+        }
+        out << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& out, const std::string& s) {
+    out << '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out << "\\\""; break;
+        case '\\': out << "\\\\"; break;
+        case '\n': out << "\\n"; break;
+        case '\r': out << "\\r"; break;
+        case '\t': out << "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof buf, "\\u%04x", c);
+            out << buf;
+          } else {
+            out << c;
+          }
+      }
+    }
+    out << '"';
+  }
+
+  static void skip_ws(const std::string& t, size_t& pos) {
+    while (pos < t.size() &&
+           (t[pos] == ' ' || t[pos] == '\t' || t[pos] == '\n' ||
+            t[pos] == '\r')) {
+      ++pos;
+    }
+  }
+
+  static Json parse_value(const std::string& t, size_t& pos) {
+    skip_ws(t, pos);
+    if (pos >= t.size()) throw std::runtime_error("unexpected end of JSON");
+    char c = t[pos];
+    if (c == '{') return parse_object(t, pos);
+    if (c == '[') return parse_array(t, pos);
+    if (c == '"') return Json(parse_string(t, pos));
+    if (c == 't' || c == 'f') return parse_bool(t, pos);
+    if (c == 'n') {
+      expect(t, pos, "null");
+      return Json();
+    }
+    return parse_number(t, pos);
+  }
+
+  static void expect(const std::string& t, size_t& pos,
+                     const std::string& word) {
+    if (t.compare(pos, word.size(), word) != 0) {
+      throw std::runtime_error("bad JSON literal at " + std::to_string(pos));
+    }
+    pos += word.size();
+  }
+
+  static Json parse_bool(const std::string& t, size_t& pos) {
+    if (t[pos] == 't') {
+      expect(t, pos, "true");
+      return Json(true);
+    }
+    expect(t, pos, "false");
+    return Json(false);
+  }
+
+  static Json parse_number(const std::string& t, size_t& pos) {
+    size_t start = pos;
+    while (pos < t.size() &&
+           (isdigit(static_cast<unsigned char>(t[pos])) || t[pos] == '-' ||
+            t[pos] == '+' || t[pos] == '.' || t[pos] == 'e' ||
+            t[pos] == 'E')) {
+      ++pos;
+    }
+    if (pos == start) throw std::runtime_error("bad JSON number");
+    return Json(std::stod(t.substr(start, pos - start)));
+  }
+
+  static std::string parse_string(const std::string& t, size_t& pos) {
+    if (t[pos] != '"') throw std::runtime_error("expected string");
+    ++pos;
+    std::string out;
+    while (pos < t.size() && t[pos] != '"') {
+      char c = t[pos++];
+      if (c != '\\') {
+        out += c;
+        continue;
+      }
+      if (pos >= t.size()) throw std::runtime_error("bad escape");
+      char e = t[pos++];
+      switch (e) {
+        case '"': out += '"'; break;
+        case '\\': out += '\\'; break;
+        case '/': out += '/'; break;
+        case 'b': out += '\b'; break;
+        case 'f': out += '\f'; break;
+        case 'n': out += '\n'; break;
+        case 'r': out += '\r'; break;
+        case 't': out += '\t'; break;
+        case 'u': {
+          if (pos + 4 > t.size()) throw std::runtime_error("bad \\u");
+          unsigned code = std::stoul(t.substr(pos, 4), nullptr, 16);
+          pos += 4;
+          // encode UTF-8 (surrogate pairs folded to replacement scope)
+          if (code < 0x80) {
+            out += static_cast<char>(code);
+          } else if (code < 0x800) {
+            out += static_cast<char>(0xC0 | (code >> 6));
+            out += static_cast<char>(0x80 | (code & 0x3F));
+          } else {
+            out += static_cast<char>(0xE0 | (code >> 12));
+            out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+            out += static_cast<char>(0x80 | (code & 0x3F));
+          }
+          break;
+        }
+        default: throw std::runtime_error("bad escape char");
+      }
+    }
+    if (pos >= t.size()) throw std::runtime_error("unterminated string");
+    ++pos;  // closing quote
+    return out;
+  }
+
+  static Json parse_array(const std::string& t, size_t& pos) {
+    ++pos;  // [
+    Json arr = Json::array();
+    skip_ws(t, pos);
+    if (pos < t.size() && t[pos] == ']') {
+      ++pos;
+      return arr;
+    }
+    while (true) {
+      arr.push_back(parse_value(t, pos));
+      skip_ws(t, pos);
+      if (pos >= t.size()) throw std::runtime_error("unterminated array");
+      if (t[pos] == ',') {
+        ++pos;
+        continue;
+      }
+      if (t[pos] == ']') {
+        ++pos;
+        return arr;
+      }
+      throw std::runtime_error("bad array separator");
+    }
+  }
+
+  static Json parse_object(const std::string& t, size_t& pos) {
+    ++pos;  // {
+    Json obj = Json::object();
+    skip_ws(t, pos);
+    if (pos < t.size() && t[pos] == '}') {
+      ++pos;
+      return obj;
+    }
+    while (true) {
+      skip_ws(t, pos);
+      std::string key = parse_string(t, pos);
+      skip_ws(t, pos);
+      if (pos >= t.size() || t[pos] != ':') {
+        throw std::runtime_error("expected ':' in object");
+      }
+      ++pos;
+      obj.set(key, parse_value(t, pos));
+      skip_ws(t, pos);
+      if (pos >= t.size()) throw std::runtime_error("unterminated object");
+      if (t[pos] == ',') {
+        ++pos;
+        continue;
+      }
+      if (t[pos] == '}') {
+        ++pos;
+        return obj;
+      }
+      throw std::runtime_error("bad object separator");
+    }
+  }
+};
+
+}  // namespace tpu

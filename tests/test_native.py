@@ -1,0 +1,267 @@
+"""End-to-end tests with the real C++ agent, bootstrap, and CLI binaries.
+
+This is the distributed-mode slice: a live ApiServer + RemoteCluster on the
+scheduler side, a real ``tpu-agent`` process supervising real task processes
+in sandboxes, ``tpu-bootstrap`` rendering templates/waiting for the JAX
+coordinator, and ``tpuctl`` driving the HTTP API — the reference's
+driver/agent/executor/bootstrap/CLI boundary exercised for real
+(SURVEY.md §2.2).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+from dcos_commons_tpu.agent import RemoteCluster
+from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler import ServiceScheduler
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import MemPersister
+
+NATIVE = Path(__file__).resolve().parent.parent / "native"
+BIN = NATIVE / "bin"
+
+YML = """
+name: native-svc
+pods:
+  hello:
+    count: 1
+    tasks:
+      server: {goal: RUNNING, cmd: "sleep 600", cpus: 0.5, memory: 128}
+"""
+
+
+@pytest.fixture(scope="session")
+def native_bins():
+    subprocess.run(["make", "-C", str(NATIVE)], check=True,
+                   capture_output=True)
+    return BIN
+
+
+def wait_for(predicate, timeout=30, interval=0.05, message="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+@pytest.fixture()
+def stack(native_bins, tmp_path):
+    cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.05)
+    sched = ServiceScheduler(load_service_yaml_str(YML), MemPersister(),
+                             cluster)
+    server = ApiServer(sched, port=0, cluster=cluster)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    sandbox_root = tmp_path / "sandboxes"
+    agent = subprocess.Popen(
+        [str(native_bins / "tpu-agent"), "--scheduler", url,
+         "--agent-id", "n0", "--hostname", "node0",
+         "--cpus", "4", "--memory-mb", "4096", "--disk-mb", "10000",
+         "--base-dir", str(sandbox_root), "--poll-interval", "0.05",
+         "--tpu-chips", "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        yield sched, cluster, url, sandbox_root
+    finally:
+        agent.terminate()
+        agent.wait(timeout=5)
+        server.stop()
+
+
+def drive_to(sched, plan_name, status, timeout=30):
+    def check():
+        sched.run_cycle()
+        return sched.plan(plan_name).status is status
+    wait_for(check, timeout=timeout,
+             message=f"plan {plan_name} -> {status}")
+
+
+def task_pid(sandbox_root, task_id):
+    pid_file = sandbox_root / task_id / "task.pid"
+    if not pid_file.exists():
+        return None
+    return int(pid_file.read_text().strip())
+
+
+def test_agent_registration_and_deploy(stack):
+    sched, cluster, url, sandbox_root = stack
+    wait_for(lambda: cluster.agents(), message="agent registration")
+    agent = cluster.agents()[0]
+    assert agent.agent_id == "n0" and agent.cpus == 4.0
+
+    drive_to(sched, "deploy", Status.COMPLETE)
+    task = sched.state.fetch_task("hello-0-server")
+    assert task is not None
+    # the real process is alive in its sandbox
+    pid = wait_for(lambda: task_pid(sandbox_root, task.task_id),
+                   message="pid file")
+    os.kill(pid, 0)  # raises if no such process
+
+
+def test_task_failure_triggers_recovery(stack):
+    sched, cluster, url, sandbox_root = stack
+    wait_for(lambda: cluster.agents(), message="agent registration")
+    drive_to(sched, "deploy", Status.COMPLETE)
+    old_task = sched.state.fetch_task("hello-0-server")
+    pid = wait_for(lambda: task_pid(sandbox_root, old_task.task_id),
+                   message="pid file")
+
+    os.kill(pid, signal.SIGKILL)  # fault injection: kill the real process
+
+    def relaunched():
+        sched.run_cycle()
+        task = sched.state.fetch_task("hello-0-server")
+        status = sched.state.fetch_status("hello-0-server")
+        return (task and status and task.task_id != old_task.task_id
+                and status.task_id == task.task_id
+                and status.state.value == "TASK_RUNNING")
+    wait_for(relaunched, timeout=30, message="recovery relaunch")
+    assert sched.plan("recovery") is not None
+
+
+def test_scheduler_kill_path(stack):
+    sched, cluster, url, sandbox_root = stack
+    wait_for(lambda: cluster.agents(), message="agent registration")
+    drive_to(sched, "deploy", Status.COMPLETE)
+    task = sched.state.fetch_task("hello-0-server")
+    pid = wait_for(lambda: task_pid(sandbox_root, task.task_id),
+                   message="pid file")
+
+    sched.restart_pod("hello-0")  # kill via the scheduler->agent channel
+
+    def process_gone():
+        try:
+            os.kill(pid, 0)
+            return False
+        except ProcessLookupError:
+            return True
+    wait_for(process_gone, message="SIGTERM delivered")
+
+    def relaunched():
+        sched.run_cycle()
+        new = sched.state.fetch_task("hello-0-server")
+        status = sched.state.fetch_status("hello-0-server")
+        return (new and new.task_id != task.task_id and status
+                and status.task_id == new.task_id
+                and not status.state.terminal)
+    wait_for(relaunched, timeout=30, message="restart relaunch")
+
+
+def test_native_tpuctl(stack, native_bins):
+    sched, cluster, url, sandbox_root = stack
+    wait_for(lambda: cluster.agents(), message="agent registration")
+    drive_to(sched, "deploy", Status.COMPLETE)
+
+    out = subprocess.run(
+        [str(native_bins / "tpuctl"), "--url", url, "plan", "list"],
+        capture_output=True, text=True, check=True)
+    assert "deploy" in json.loads(out.stdout)
+
+    out = subprocess.run(
+        [str(native_bins / "tpuctl"), "--url", url, "pod", "status",
+         "hello-0"], capture_output=True, text=True, check=True)
+    assert json.loads(out.stdout)["tasks"][0]["status"] == "TASK_RUNNING"
+
+    rc = subprocess.run(
+        [str(native_bins / "tpuctl"), "--url", url, "plan", "show", "nope"],
+        capture_output=True, text=True)
+    assert rc.returncode == 1
+
+
+def test_agent_death_marks_tasks_lost_after_grace(native_bins, tmp_path):
+    """Agent stops polling -> tasks LOST only after the grace period."""
+    cluster = RemoteCluster(expiry_s=0.5, poll_interval_s=0.05)
+    sched = ServiceScheduler(load_service_yaml_str(YML), MemPersister(),
+                             cluster, agent_grace_s=1.0)
+    server = ApiServer(sched, port=0, cluster=cluster)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    agent = subprocess.Popen(
+        [str(native_bins / "tpu-agent"), "--scheduler", url,
+         "--agent-id", "dying", "--cpus", "4", "--memory-mb", "4096",
+         "--disk-mb", "10000", "--base-dir", str(tmp_path / "sb"),
+         "--poll-interval", "0.05", "--tpu-chips", "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        drive_to(sched, "deploy", Status.COMPLETE)
+        agent.kill()
+        agent.wait()
+
+        # within the grace window the task must NOT be lost
+        time.sleep(0.6)  # agent expired (0.5s) but grace (1s) not over
+        sched.run_cycle()
+        status = sched.state.fetch_status("hello-0-server")
+        assert status.state.value == "TASK_RUNNING"
+
+        def lost():
+            sched.run_cycle()
+            s = sched.state.fetch_status("hello-0-server")
+            return s.state.value == "TASK_LOST"
+        wait_for(lost, timeout=10, message="LOST after grace")
+    finally:
+        if agent.poll() is None:
+            agent.terminate()
+            agent.wait(timeout=5)
+        server.stop()
+
+
+# ---------------------------------------------------------------- bootstrap
+
+def test_bootstrap_template_render(native_bins, tmp_path):
+    src = tmp_path / "conf.tmpl"
+    dst = tmp_path / "conf.out"
+    src.write_text("host={{TASK_NAME}} port={{PORT_HTTP}} {{!note}}end\n")
+    env = dict(os.environ)
+    env.update({"CONFIG_TEMPLATE_0": f"{src},{dst}",
+                "TASK_NAME": "hello-0-server", "PORT_HTTP": "8080"})
+    subprocess.run([str(native_bins / "tpu-bootstrap"), "--no-wait"],
+                   env=env, check=True, capture_output=True)
+    assert dst.read_text() == "host=hello-0-server port=8080 end\n"
+
+
+def test_bootstrap_missing_var_fails(native_bins, tmp_path):
+    src = tmp_path / "conf.tmpl"
+    src.write_text("x={{UNDEFINED_VAR_XYZ}}\n")
+    env = dict(os.environ)
+    env["CONFIG_TEMPLATE_0"] = f"{src},{tmp_path / 'out'}"
+    rc = subprocess.run([str(native_bins / "tpu-bootstrap"), "--no-wait"],
+                        env=env, capture_output=True)
+    assert rc.returncode == 1
+    assert b"UNDEFINED_VAR_XYZ" in rc.stderr
+
+
+def test_bootstrap_waits_for_coordinator(native_bins):
+    # coordinator listening -> bootstrap proceeds
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    port = listener.getsockname()[1]
+    env = dict(os.environ)
+    env.update({"JAX_NUM_PROCESSES": "2", "JAX_PROCESS_ID": "1",
+                "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}"})
+    subprocess.run([str(native_bins / "tpu-bootstrap"), "--wait-timeout",
+                    "5"], env=env, check=True, capture_output=True)
+    listener.close()
+
+    # nobody listening -> bounded failure
+    env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    rc = subprocess.run([str(native_bins / "tpu-bootstrap"),
+                         "--wait-timeout", "2"], env=env,
+                        capture_output=True)
+    assert rc.returncode == 1
+
+    # process 0 never waits
+    env["JAX_PROCESS_ID"] = "0"
+    subprocess.run([str(native_bins / "tpu-bootstrap"), "--wait-timeout",
+                    "2"], env=env, check=True, capture_output=True)
