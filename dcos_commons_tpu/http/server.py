@@ -14,7 +14,7 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from .queries import (ApiError, ConfigQueries, DebugQueries, EndpointQueries,
                       HealthQueries, PlanQueries, PodQueries, StateQueries)
@@ -148,11 +148,12 @@ class ApiServer:
     """
 
     def __init__(self, scheduler=None, port: int = 0, metrics=None,
-                 host: str = "127.0.0.1", cluster=None):
+                 host: str = "127.0.0.1", cluster=None, multi=None):
         self._services: Dict[str, _Routes] = {}
         self._default: Optional[_Routes] = None
         self._metrics = metrics
         self._cluster = cluster  # RemoteCluster: agent transport endpoint
+        self._multi = multi  # MultiServiceScheduler: dynamic add/remove
         if scheduler is not None:
             self._default = _Routes(scheduler, metrics)
         outer = self
@@ -227,19 +228,54 @@ class ApiServer:
             return 200, self._metrics.to_dict()
         if rest == "multi":
             return 200, sorted(self._services.keys())
+        if rest.startswith("multi/"):
+            return self._dispatch_multi(method, unquote(rest.split("/", 1)[1]),
+                                        body)
         if rest.startswith("agents/") or rest == "agents":
             return self._dispatch_agents(method, rest, body)
         if rest.startswith("service/"):
             parts = rest.split("/", 2)
             if len(parts) < 3:
                 return 404, {"error": "expected /v1/service/<name>/<path>"}
-            routes = self._services.get(parts[1])
+            routes = self._services.get(unquote(parts[1]))
             if routes is None:
-                return 404, {"error": f"no service named {parts[1]!r}"}
+                return 404, {"error": f"no service named {unquote(parts[1])!r}"}
             return routes.dispatch(method, parts[2], params, body)
         if self._default is None:
             return 404, {"error": "no default service mounted"}
         return self._default.dispatch(method, rest, params, body)
+
+    def _dispatch_multi(self, method: str, name: str,
+                        body: Optional[bytes]) -> Tuple[int, object]:
+        """Dynamic multi-service management (reference: the helloworld
+        ``ExampleMultiServiceResource`` add/remove surface):
+        PUT /v1/multi/<name> with a YAML service body adds/updates a
+        service; DELETE /v1/multi/<name> starts its uninstall."""
+        if self._multi is None:
+            return 404, {"error": "not a multi-service scheduler"}
+        if method == "PUT":
+            if not body:
+                return 400, {"error": "expected a YAML service spec body"}
+            from ..specification.yaml_loader import load_service_yaml_str
+            try:
+                spec = load_service_yaml_str(body.decode())
+            except Exception as e:
+                return 400, {"error": f"bad service spec: {e}"}
+            if spec.name != name:
+                return 400, {"error": (f"spec name {spec.name!r} does not "
+                                       f"match URL name {name!r}")}
+            try:
+                self._multi.add_service(spec)
+            except ValueError as e:  # e.g. re-add while uninstalling
+                return 409, {"error": str(e)}
+            return 200, {"service": name, "status": "added"}
+        if method == "DELETE":
+            try:
+                self._multi.uninstall_service(name)
+            except KeyError:
+                return 404, {"error": f"no service named {name!r}"}
+            return 200, {"service": name, "status": "uninstalling"}
+        return 404, {"error": f"no multi route {method} /v1/multi/{name}"}
 
     def _dispatch_agents(self, method: str, rest: str,
                          body: Optional[bytes]) -> Tuple[int, object]:

@@ -1,4 +1,7 @@
 from .core import ServiceScheduler
+from .multi import (AllDiscipline, DisciplineSelectionStore,
+                    MultiServiceScheduler, OfferDiscipline,
+                    ParallelFootprintDiscipline, ServiceStore)
 from .recovery import (FailureMonitor, NeverFailureMonitor,
                        RecoveryPlanManager, TestingFailureMonitor,
                        TimedFailureMonitor, needs_recovery)

@@ -1,0 +1,386 @@
+"""Multi-service hosting: one framework process running N services.
+
+Reference: ``scheduler/multi/`` — ``MultiServiceManager.java:30`` (service
+registry), ``MultiServiceEventClient.java:48`` (status fan-out by task
+namespace ``:507``, uninstall-on-remove flow), ``ServiceStore.java`` /
+``ServiceFactory.java`` (persist specs so services are re-created on
+scheduler restart), ``OfferDiscipline.java`` +
+``ParallelFootprintDiscipline.java:24`` (cap the number of services
+expanding their resource footprint concurrently; ``RESERVE_DISCIPLINE`` env,
+``scheduler/SchedulerConfig.java:89``), ``AllDiscipline.java:10``,
+``DisciplineSelectionStore.java``.
+
+Differences from the reference, forced by the simpler (offer-market-free)
+agent model:
+
+* Status routing is by **task-id ownership** (the multi layer records which
+  service launched each task id, and rebuilds that map from the per-service
+  state stores on restart) rather than by a namespace label baked into the
+  Mesos task id.
+* Each child service sees the shared cluster through a
+  :class:`ServiceClusterView` that filters ``running_task_ids`` down to the
+  tasks that service owns — so one service's reconciliation can never kill
+  a sibling's tasks. Cluster-wide zombie cleanup (tasks owned by *no*
+  service) is the multi layer's job (:meth:`MultiServiceScheduler.reconcile`),
+  mirroring ``MultiServiceEventClient.getUnexpectedResources``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..agent.client import AgentClient, StatusCallback
+from ..agent.inventory import AgentInfo
+from ..state.persister import NotFoundError, Persister
+from ..state.state_store import StateStore
+from ..state.tasks import TaskStatus
+from ..specification.spec import ServiceSpec
+from .core import ServiceScheduler
+
+log = logging.getLogger(__name__)
+
+
+def _esc(name: str) -> str:
+    return name.replace("/", "%2F")
+
+
+class ServiceStore:
+    """Durable registry of added services (reference
+    ``scheduler/multi/ServiceStore.java``): the multi scheduler re-creates
+    every stored service on restart, before any reconciliation runs."""
+
+    ROOT = "multi/services"
+
+    def __init__(self, persister: Persister):
+        self._persister = persister
+
+    def store(self, spec: ServiceSpec) -> None:
+        self._persister.set(f"{self.ROOT}/{_esc(spec.name)}",
+                            spec.to_json().encode())
+
+    def fetch(self, name: str) -> Optional[ServiceSpec]:
+        raw = self._persister.get_or_none(f"{self.ROOT}/{_esc(name)}")
+        return ServiceSpec.from_json(raw.decode()) if raw is not None else None
+
+    def list_names(self) -> List[str]:
+        try:
+            children = self._persister.get_children(self.ROOT)
+        except NotFoundError:
+            return []
+        return sorted(k.replace("%2F", "/") for k in children)
+
+    def remove(self, name: str) -> None:
+        self._persister.recursive_delete(f"{self.ROOT}/{_esc(name)}")
+
+
+class DisciplineSelectionStore:
+    """Persists which services currently hold footprint grants (reference
+    ``scheduler/multi/DisciplineSelectionStore.java``) so grants survive a
+    scheduler restart and the cap cannot be exceeded across restarts."""
+
+    PATH = "multi/discipline/selected"
+
+    def __init__(self, persister: Persister):
+        self._persister = persister
+
+    def store(self, names: Sequence[str]) -> None:
+        self._persister.set(self.PATH, json.dumps(sorted(names)).encode())
+
+    def fetch(self) -> List[str]:
+        raw = self._persister.get_or_none(self.PATH)
+        return json.loads(raw.decode()) if raw is not None else []
+
+
+class OfferDiscipline:
+    """Decides, each cycle, whether a service may expand its resource
+    footprint (launch work needing new reservations). Reference
+    ``scheduler/multi/OfferDiscipline.java``."""
+
+    def update_services(self, names: Sequence[str]) -> None:
+        """Sync the known-service set (dropped services release grants)."""
+
+    def may_reserve(self, name: str, deploy_complete: bool) -> bool:
+        raise NotImplementedError
+
+
+class AllDiscipline(OfferDiscipline):
+    """No cap (reference ``AllDiscipline.java:10``)."""
+
+    def may_reserve(self, name: str, deploy_complete: bool) -> bool:
+        return True
+
+
+class ParallelFootprintDiscipline(OfferDiscipline):
+    """At most ``max_concurrent`` services may be expanding footprint at a
+    time (reference ``ParallelFootprintDiscipline.java:24``). A service holds
+    its grant from first need until its deploy plan completes; grants are
+    persisted via :class:`DisciplineSelectionStore`."""
+
+    def __init__(self, max_concurrent: int, store: DisciplineSelectionStore):
+        if max_concurrent < 1:
+            raise ValueError("max_concurrent must be >= 1")
+        self._max = max_concurrent
+        self._store = store
+        self._granted = set(store.fetch())
+
+    def update_services(self, names: Sequence[str]) -> None:
+        live = set(names)
+        if not live >= self._granted:
+            self._granted &= live
+            self._store.store(sorted(self._granted))
+
+    def may_reserve(self, name: str, deploy_complete: bool) -> bool:
+        if deploy_complete:
+            if name in self._granted:
+                self._granted.discard(name)
+                self._store.store(sorted(self._granted))
+            return True
+        if name in self._granted:
+            return True
+        if len(self._granted) >= self._max:
+            return False
+        self._granted.add(name)
+        self._store.store(sorted(self._granted))
+        return True
+
+
+class ServiceClusterView(AgentClient):
+    """A per-service window onto the shared cluster (reference: the fan-out
+    half of ``MultiServiceEventClient``): launches/kills pass through with
+    ownership recorded; ``running_task_ids`` is filtered to owned tasks so
+    per-service reconciliation never touches siblings."""
+
+    def __init__(self, multi: "MultiServiceScheduler", service_name: str):
+        self._multi = multi
+        self._name = service_name
+        self.callback: Optional[StatusCallback] = None
+
+    def agents(self) -> Sequence[AgentInfo]:
+        return self._multi.cluster.agents()
+
+    def launch(self, plan) -> None:
+        for launch in plan.launches:
+            self._multi._own(launch.task_id, self._name)
+        self._multi.cluster.launch(plan)
+
+    def kill(self, agent_id: str, task_id: str,
+             grace_period_s: float = 0.0) -> None:
+        self._multi.cluster.kill(agent_id, task_id, grace_period_s)
+
+    def running_task_ids(self, agent_id: str) -> Sequence[str]:
+        return [tid for tid in self._multi.cluster.running_task_ids(agent_id)
+                if self._multi._owner(tid) == self._name]
+
+    def set_status_callback(self, callback: StatusCallback) -> None:
+        self.callback = callback
+
+
+class MultiServiceScheduler:
+    """Hosts N :class:`ServiceScheduler` instances over one persister and one
+    cluster (reference ``MultiServiceManager`` + ``MultiServiceEventClient``
+    + ``MultiServiceRunner``). Each service's state lives under its own
+    namespace; specs are persisted so a restarted scheduler re-creates every
+    service before acting."""
+
+    def __init__(self, persister: Persister, cluster: AgentClient,
+                 discipline: Optional[OfferDiscipline] = None,
+                 scheduler_factory: Optional[Callable[..., ServiceScheduler]]
+                 = None,
+                 api_server=None):
+        self._lock = threading.RLock()
+        self.persister = persister
+        self.cluster = cluster
+        self.service_store = ServiceStore(persister)
+        self.discipline = discipline or AllDiscipline()
+        self._factory = scheduler_factory or ServiceScheduler
+        self._api_server = api_server
+        self._services: Dict[str, ServiceScheduler] = {}
+        self._views: Dict[str, ServiceClusterView] = {}
+        self._uninstalling: set[str] = set()
+        self._ownership: Dict[str, str] = {}  # task_id -> service name
+        cluster.set_status_callback(self._route_status)
+        self._restore()
+
+    # -- registry (MultiServiceManager) ------------------------------------
+
+    def set_api_server(self, api_server) -> None:
+        """Late-bind the API server (it needs the multi scheduler to exist
+        first) and mount every already-restored service's routes."""
+        with self._lock:
+            self._api_server = api_server
+            for name, scheduler in self._services.items():
+                api_server.add_service(name, scheduler)
+
+    def service_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._services.keys())
+
+    def get_service(self, name: str) -> Optional[ServiceScheduler]:
+        with self._lock:
+            return self._services.get(name)
+
+    def add_service(self, spec: ServiceSpec, **scheduler_kwargs
+                    ) -> ServiceScheduler:
+        """Register + persist a service; it deploys on subsequent cycles.
+        Re-adding an existing name with a changed spec is a config update
+        (the child's ConfigurationUpdater handles diff/validate/rollout)."""
+        with self._lock:
+            if spec.name in self._uninstalling:
+                raise ValueError(
+                    f"service {spec.name!r} is uninstalling; wait for "
+                    "removal before re-adding")
+            self.service_store.store(spec)
+            return self._mount(spec, uninstall=False, **scheduler_kwargs)
+
+    def uninstall_service(self, name: str) -> None:
+        """Flip the service into uninstall mode (reference
+        ``MultiServiceEventClient.uninstallRequested``): its plans are
+        replaced by the teardown plan; when that completes the service and
+        its stored spec are removed entirely."""
+        with self._lock:
+            if name in self._uninstalling:
+                return
+            spec = self.service_store.fetch(name)
+            if spec is None:
+                raise KeyError(f"no service named {name!r}")
+            self._uninstalling.add(name)
+            self._persist_uninstalling()
+            self._mount(spec, uninstall=True)
+
+    def _persist_uninstalling(self) -> None:
+        self.persister.set("multi/uninstalling",
+                           json.dumps(sorted(self._uninstalling)).encode())
+
+    def _mount(self, spec: ServiceSpec, uninstall: bool, **kwargs
+               ) -> ServiceScheduler:
+        view = ServiceClusterView(self, spec.name)
+        namespace = f"svc-{_esc(spec.name)}"
+        # ownership must be known BEFORE the child constructor reconciles,
+        # or the child would see its own running tasks as unowned zombies
+        for task in StateStore(self.persister, namespace).fetch_tasks():
+            self._ownership[task.task_id] = spec.name
+        scheduler = self._factory(
+            spec, self.persister, view, namespace=namespace,
+            uninstall=uninstall, **kwargs)
+        self._services[spec.name] = scheduler
+        self._views[spec.name] = view
+        if self._api_server is not None:
+            self._api_server.add_service(spec.name, scheduler)
+        return scheduler
+
+    def _restore(self) -> None:
+        """Re-create every stored service (reference ``ServiceFactory`` +
+        ``MultiServiceManager.restoreServices``)."""
+        raw = self.persister.get_or_none("multi/uninstalling")
+        self._uninstalling = set(json.loads(raw.decode())) if raw else set()
+        for name in self.service_store.list_names():
+            spec = self.service_store.fetch(name)
+            if spec is not None:
+                self._mount(spec, uninstall=name in self._uninstalling)
+
+    # -- status routing (MultiServiceEventClient.taskStatus:507) -----------
+
+    def _own(self, task_id: str, service: str) -> None:
+        with self._lock:
+            self._ownership[task_id] = service
+
+    def _owner(self, task_id: str) -> Optional[str]:
+        return self._ownership.get(task_id)
+
+    def _route_status(self, task_name: str, status: TaskStatus) -> None:
+        owner = self._owner(status.task_id)
+        if owner is None:
+            log.warning("status for unowned task %s (%s); dropping",
+                        status.task_id, status.state)
+            return
+        view = self._views.get(owner)
+        if view is not None and view.callback is not None:
+            view.callback(task_name, status)
+        if status.state.terminal:
+            # dead ids never run again; drop them so the ownership map does
+            # not grow one entry per relaunch over the daemon's lifetime
+            with self._lock:
+                self._ownership.pop(status.task_id, None)
+
+    # -- the cycle (MultiServiceRunner) ------------------------------------
+
+    def run_cycle(self) -> int:
+        """One pass over every service, discipline-gated; finalizes any
+        service whose uninstall plan completed. Returns total actions.
+
+        The whole pass holds the multi lock: an HTTP add/uninstall arriving
+        mid-cycle must not swap a child scheduler while its predecessor is
+        launching (the uninstall plan is built from the state store, so a
+        launch landing after plan construction would escape teardown).
+        Child cycles are fast (no network waits on the fake path; bounded
+        HTTP calls on the remote path), matching the reference's
+        single-threaded offer pipeline (``OfferProcessor.java:57``)."""
+        with self._lock:
+            services = list(self._services.items())
+            self.discipline.update_services([n for n, _ in services])
+            actions = 0
+            for name, scheduler in services:
+                deploy_complete = (
+                    scheduler.deploy_manager.plan.status.name == "COMPLETE")
+                # the discipline caps footprint *expansion* only; teardown
+                # (which frees resources) must never be gated, or a capped
+                # grant could deadlock an uninstall against a stuck deploy
+                if not scheduler.uninstall_mode and not self.discipline.may_reserve(
+                        name, deploy_complete):
+                    continue
+                actions += scheduler.run_cycle()
+                if scheduler.uninstall_complete:
+                    self._finalize_uninstall(name)
+            return actions
+
+    def run_until_quiet(self, max_cycles: int = 50) -> int:
+        cycles = 0
+        while cycles < max_cycles:
+            cycles += 1
+            if self.run_cycle() == 0:
+                break
+        return cycles
+
+    def _finalize_uninstall(self, name: str) -> None:
+        """Uninstall plan reached COMPLETE: drop the service, its stored
+        spec, and its state subtree (reference
+        ``MultiServiceEventClient.finished`` removal flow)."""
+        with self._lock:
+            scheduler = self._services.pop(name, None)
+            self._views.pop(name, None)
+            self.service_store.remove(name)
+            self._uninstalling.discard(name)
+            self._persist_uninstalling()
+            if scheduler is not None:
+                for task_id in [t for t, owner in self._ownership.items()
+                                if owner == name]:
+                    del self._ownership[task_id]
+                scheduler.state.delete_all()
+            if self._api_server is not None:
+                self._api_server.remove_service(name)
+        log.info("service %s uninstalled and removed", name)
+
+    # -- cluster-wide zombie cleanup ---------------------------------------
+
+    def reconcile(self) -> None:
+        """Kill running tasks owned by no registered service — the
+        multi-level ``getUnexpectedResources`` analogue. Per-service
+        reconciliation happens inside each child scheduler. Also prunes
+        ownership entries whose task is neither stored nor running."""
+        with self._lock:
+            running: set[str] = set()
+            for agent in self.cluster.agents():
+                for task_id in self.cluster.running_task_ids(agent.agent_id):
+                    running.add(task_id)
+                    if self._owner(task_id) is None:
+                        log.warning("killing unowned task %s on %s", task_id,
+                                    agent.agent_id)
+                        self.cluster.kill(agent.agent_id, task_id)
+            stored = {t.task_id for s in self._services.values()
+                      for t in s.state.fetch_tasks()}
+            for task_id in list(self._ownership):
+                if task_id not in running and task_id not in stored:
+                    del self._ownership[task_id]

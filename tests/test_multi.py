@@ -1,0 +1,374 @@
+"""Multi-service hosting tests.
+
+Reference coverage model: ``scheduler/multi/`` unit tests (service registry,
+spec persistence across restart, footprint discipline caps) and the dynamic
+multi-service integration test
+(``frameworks/helloworld/tests/test_multiservice_dynamic.py``).
+"""
+
+import pytest
+
+from dcos_commons_tpu.agent import AgentInfo, FakeCluster, PortRange
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler.multi import (AllDiscipline,
+                                              DisciplineSelectionStore,
+                                              MultiServiceScheduler,
+                                              ParallelFootprintDiscipline,
+                                              ServiceStore)
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import MemPersister, TaskState
+
+SVC_YML = """
+name: {name}
+pods:
+  hello:
+    count: 2
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "sleep 1000"
+        cpus: 0.5
+        memory: 256
+"""
+
+
+def spec(name):
+    return load_service_yaml_str(SVC_YML.format(name=name), {})
+
+
+def agents(n):
+    return [AgentInfo(agent_id=f"a{i}", hostname=f"host{i}", cpus=8,
+                      memory_mb=16384, disk_mb=32768,
+                      ports=(PortRange(10000, 10100),))
+            for i in range(n)]
+
+
+def make(persister=None, cluster=None, **kw):
+    persister = persister or MemPersister()
+    cluster = cluster or FakeCluster(agents(3))
+    return MultiServiceScheduler(persister, cluster, **kw), persister, cluster
+
+
+class TestRegistry:
+    def test_two_services_deploy_independently(self):
+        multi, _, cluster = make()
+        multi.add_service(spec("svc-a"))
+        multi.add_service(spec("svc-b"))
+        multi.run_until_quiet()
+        for name in ("svc-a", "svc-b"):
+            sched = multi.get_service(name)
+            assert sched.plan("deploy").status is Status.COMPLETE
+            assert len(sched.state.fetch_tasks()) == 2
+        # same task names in both services; statuses must not cross-route
+        a_ids = {t.task_id for t in multi.get_service("svc-a").state.fetch_tasks()}
+        b_ids = {t.task_id for t in multi.get_service("svc-b").state.fetch_tasks()}
+        assert not (a_ids & b_ids)
+
+    def test_status_routes_to_owner_only(self):
+        multi, _, cluster = make()
+        multi.add_service(spec("svc-a"))
+        multi.add_service(spec("svc-b"))
+        multi.run_until_quiet()
+        a = multi.get_service("svc-a")
+        b = multi.get_service("svc-b")
+        victim = a.state.fetch_task("hello-0-server")
+        cluster.send_status(victim.task_id, TaskState.FAILED, message="boom")
+        assert a.state.fetch_status("hello-0-server").state is TaskState.FAILED
+        assert b.state.fetch_status("hello-0-server").state is TaskState.RUNNING
+
+    def test_failure_recovery_stays_scoped(self):
+        multi, _, cluster = make()
+        multi.add_service(spec("svc-a"))
+        multi.add_service(spec("svc-b"))
+        multi.run_until_quiet()
+        a = multi.get_service("svc-a")
+        before_b = {t.task_id for t in
+                    multi.get_service("svc-b").state.fetch_tasks()}
+        victim = a.state.fetch_task("hello-0-server")
+        cluster.send_status(victim.task_id, TaskState.FAILED)
+        multi.run_until_quiet()
+        after = a.state.fetch_task("hello-0-server")
+        assert after.task_id != victim.task_id  # relaunched
+        assert a.state.fetch_status("hello-0-server").state is TaskState.RUNNING
+        after_b = {t.task_id for t in
+                   multi.get_service("svc-b").state.fetch_tasks()}
+        assert after_b == before_b  # sibling untouched
+
+    def test_add_existing_name_is_config_update(self):
+        multi, _, _ = make()
+        multi.add_service(spec("svc-a"))
+        multi.run_until_quiet()
+        updated = load_service_yaml_str(
+            SVC_YML.format(name="svc-a").replace("count: 2", "count: 3"), {})
+        multi.add_service(updated)
+        multi.run_until_quiet()
+        sched = multi.get_service("svc-a")
+        assert len(sched.state.fetch_tasks()) == 3
+
+
+class TestRestart:
+    def test_services_restored_from_store(self):
+        persister = MemPersister()
+        cluster = FakeCluster(agents(3))
+        multi, _, _ = make(persister, cluster)
+        multi.add_service(spec("svc-a"))
+        multi.add_service(spec("svc-b"))
+        multi.run_until_quiet()
+        ids_before = {t.task_id for t in
+                      multi.get_service("svc-a").state.fetch_tasks()}
+
+        # "restart": a fresh multi scheduler over the same persister+cluster
+        multi2 = MultiServiceScheduler(persister, cluster)
+        assert multi2.service_names() == ["svc-a", "svc-b"]
+        multi2.run_until_quiet()
+        ids_after = {t.task_id for t in
+                     multi2.get_service("svc-a").state.fetch_tasks()}
+        assert ids_after == ids_before  # nothing relaunched
+        assert cluster.kill_log == []
+
+    def test_unowned_zombie_killed_by_multi_reconcile(self):
+        persister = MemPersister()
+        cluster = FakeCluster(agents(3))
+        multi, _, _ = make(persister, cluster)
+        multi.add_service(spec("svc-a"))
+        multi.run_until_quiet()
+        a = multi.get_service("svc-a")
+        zombie = a.state.fetch_task("hello-1-server")
+        # erase the service's record of hello-1 -> the running task is orphaned
+        a.state.delete_task("hello-1-server")
+
+        multi2 = MultiServiceScheduler(persister, cluster)
+        multi2.reconcile()
+        assert zombie.task_id in cluster.kill_log
+
+
+class TestUninstall:
+    def test_uninstall_removes_everything(self):
+        multi, persister, cluster = make()
+        multi.add_service(spec("svc-a"))
+        multi.add_service(spec("svc-b"))
+        multi.run_until_quiet()
+        doomed_ids = {t.task_id for t in
+                      multi.get_service("svc-a").state.fetch_tasks()}
+        multi.uninstall_service("svc-a")
+        multi.run_until_quiet()
+        assert multi.service_names() == ["svc-b"]
+        assert multi.service_store.fetch("svc-a") is None
+        for task_id in doomed_ids:
+            assert task_id in cluster.kill_log
+        # survivor is untouched
+        b = multi.get_service("svc-b")
+        assert b.plan("deploy").status is Status.COMPLETE
+
+    def test_uninstall_survives_restart(self):
+        persister = MemPersister()
+        cluster = FakeCluster(agents(3))
+        multi, _, _ = make(persister, cluster)
+        multi.add_service(spec("svc-a"))
+        multi.run_until_quiet()
+        multi.uninstall_service("svc-a")
+        # restart before the uninstall plan runs: must resume uninstalling
+        multi2 = MultiServiceScheduler(persister, cluster)
+        multi2.run_until_quiet()
+        assert multi2.service_names() == []
+        assert multi2.service_store.fetch("svc-a") is None
+
+    def test_unknown_service_raises(self):
+        multi, _, _ = make()
+        with pytest.raises(KeyError):
+            multi.uninstall_service("nope")
+
+
+class TestDiscipline:
+    def test_footprint_cap_serializes_deployments(self):
+        persister = MemPersister()
+        cluster = FakeCluster(agents(4))
+        discipline = ParallelFootprintDiscipline(
+            1, DisciplineSelectionStore(persister))
+        multi = MultiServiceScheduler(persister, cluster,
+                                      discipline=discipline)
+        multi.add_service(spec("svc-a"))
+        multi.add_service(spec("svc-b"))
+        # one cycle: only the grant holder may expand footprint
+        multi.run_cycle()
+        launched = {t.task_name for p in cluster.launch_log
+                    for t in p.launches}
+        a_done = multi.get_service("svc-a").state.fetch_tasks()
+        b_done = multi.get_service("svc-b").state.fetch_tasks()
+        assert launched
+        assert (len(a_done) == 0) or (len(b_done) == 0)
+        # letting it run to quiet completes both (grant released on COMPLETE)
+        multi.run_until_quiet()
+        assert multi.get_service("svc-a").plan("deploy").status is Status.COMPLETE
+        assert multi.get_service("svc-b").plan("deploy").status is Status.COMPLETE
+
+    def test_grants_persist_across_restart(self):
+        persister = MemPersister()
+        store = DisciplineSelectionStore(persister)
+        d1 = ParallelFootprintDiscipline(1, store)
+        assert d1.may_reserve("a", deploy_complete=False)
+        assert not d1.may_reserve("b", deploy_complete=False)
+        # restart: grants reload from the persister
+        d2 = ParallelFootprintDiscipline(1, DisciplineSelectionStore(persister))
+        assert d2.may_reserve("a", deploy_complete=False)
+        assert not d2.may_reserve("b", deploy_complete=False)
+        # a completes -> grant released -> b may proceed
+        assert d2.may_reserve("a", deploy_complete=True)
+        assert d2.may_reserve("b", deploy_complete=False)
+
+    def test_dropped_service_releases_grant(self):
+        persister = MemPersister()
+        d = ParallelFootprintDiscipline(1, DisciplineSelectionStore(persister))
+        assert d.may_reserve("a", deploy_complete=False)
+        d.update_services(["b"])  # a removed
+        assert d.may_reserve("b", deploy_complete=False)
+
+    def test_all_discipline_never_gates(self):
+        d = AllDiscipline()
+        assert d.may_reserve("x", deploy_complete=False)
+
+
+class TestServiceStore:
+    def test_roundtrip_and_list(self):
+        persister = MemPersister()
+        store = ServiceStore(persister)
+        store.store(spec("x"))
+        store.store(spec("y"))
+        assert store.list_names() == ["x", "y"]
+        assert store.fetch("x").name == "x"
+        store.remove("x")
+        assert store.list_names() == ["y"]
+        assert store.fetch("x") is None
+
+
+class TestMultiHttp:
+    """Dynamic add/remove over HTTP (reference
+    ``ExampleMultiServiceResource`` + ``Multi*Resource.java`` routing)."""
+
+    def _request(self, base, method, path, body=None):
+        import json as _json
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(base + path, data=body, method=method)
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, _json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read().decode())
+
+    def test_add_list_uninstall_over_http(self):
+        from dcos_commons_tpu.http import ApiServer
+        persister = MemPersister()
+        cluster = FakeCluster(agents(3))
+        multi = MultiServiceScheduler(persister, cluster)
+        server = ApiServer(port=0, multi=multi)
+        multi.set_api_server(server)
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            yml = SVC_YML.format(name="web").encode()
+            code, out = self._request(base, "PUT", "/v1/multi/web", yml)
+            assert code == 200 and out["status"] == "added"
+            multi.run_until_quiet()
+            code, names = self._request(base, "GET", "/v1/multi")
+            assert names == ["web"]
+            # per-service routes are mounted under /v1/service/<name>/
+            code, plans = self._request(base, "GET", "/v1/service/web/plans")
+            assert code == 200
+            # name mismatch rejected
+            code, _ = self._request(
+                base, "PUT", "/v1/multi/other", yml)
+            assert code == 400
+            code, out = self._request(base, "DELETE", "/v1/multi/web")
+            assert code == 200 and out["status"] == "uninstalling"
+            multi.run_until_quiet()
+            code, names = self._request(base, "GET", "/v1/multi")
+            assert names == []
+            code, _ = self._request(base, "DELETE", "/v1/multi/web")
+            assert code == 404
+        finally:
+            server.stop()
+
+    def test_restored_services_are_mounted_on_api(self):
+        from dcos_commons_tpu.http import ApiServer
+        persister = MemPersister()
+        cluster = FakeCluster(agents(3))
+        multi = MultiServiceScheduler(persister, cluster)
+        multi.add_service(spec("web"))
+        multi.run_until_quiet()
+        # restart: services restored from the persister BEFORE the api
+        # server exists must still get /v1/service/<name>/ routes
+        multi2 = MultiServiceScheduler(persister, cluster)
+        server = ApiServer(port=0, multi=multi2)
+        multi2.set_api_server(server)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            code, _ = self._request(base, "GET", "/v1/service/web/plans")
+            assert code == 200
+        finally:
+            server.stop()
+
+    def test_add_while_uninstalling_is_409(self):
+        from dcos_commons_tpu.http import ApiServer
+        multi, _, cluster = make()
+        multi.add_service(spec("web"))
+        multi.run_until_quiet()
+        server = ApiServer(port=0, multi=multi)
+        multi.set_api_server(server)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            multi.uninstall_service("web")  # plan not yet run
+            yml = SVC_YML.format(name="web").encode()
+            code, _ = self._request(base, "PUT", "/v1/multi/web", yml)
+            assert code == 409
+        finally:
+            server.stop()
+
+    def test_percent_encoded_names_roundtrip(self):
+        from urllib.parse import quote
+        from dcos_commons_tpu.http import ApiServer
+        multi, _, cluster = make()
+        server = ApiServer(port=0, multi=multi)
+        multi.set_api_server(server)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            name = "folder/web"
+            yml = SVC_YML.format(name=name).encode()
+            enc = quote(name, safe="")
+            code, out = self._request(base, "PUT", f"/v1/multi/{enc}", yml)
+            assert code == 200, out
+            multi.run_until_quiet()
+            code, _ = self._request(base, "GET", f"/v1/service/{enc}/plans")
+            assert code == 200
+            code, out = self._request(base, "DELETE", f"/v1/multi/{enc}")
+            assert code == 200, out
+            multi.run_until_quiet()
+            assert multi.service_names() == []
+        finally:
+            server.stop()
+
+
+class TestDisciplineDoesNotGateTeardown:
+    def test_uninstall_proceeds_without_grant(self):
+        # svc-a holds the single grant forever (no agents can fit it);
+        # uninstalling svc-b must still tear down and free its resources
+        persister = MemPersister()
+        cluster = FakeCluster(agents(2))
+        discipline = ParallelFootprintDiscipline(
+            1, DisciplineSelectionStore(persister))
+        multi = MultiServiceScheduler(persister, cluster,
+                                      discipline=discipline)
+        big = load_service_yaml_str(
+            SVC_YML.format(name="svc-a").replace("cpus: 0.5", "cpus: 512"), {})
+        multi.add_service(big)
+        multi.run_until_quiet()  # svc-a stuck mid-deploy, holds the grant
+        assert multi.get_service("svc-a").plan("deploy").status is not Status.COMPLETE
+        multi.add_service(spec("svc-b"))
+        multi.run_cycle()
+        assert len(multi.get_service("svc-b").state.fetch_tasks()) == 0  # gated
+        multi.uninstall_service("svc-b")
+        multi.run_until_quiet()
+        assert multi.service_names() == ["svc-a"]  # svc-b teardown completed
