@@ -283,8 +283,10 @@ class ParentElement(Element):
     def status(self) -> Status:
         if self.errors:
             return Status.ERROR
-        return aggregate((c.status for c in self.children),
-                         interrupted=self._interrupted)
+        return aggregate(
+            (c.status for c in self.children),
+            interrupted=(self._interrupted
+                         or self.strategy.is_interrupted(self.children)))
 
     def interrupt(self) -> None:
         self._interrupted = True

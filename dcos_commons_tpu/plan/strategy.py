@@ -28,6 +28,12 @@ class Strategy:
     def proceed(self) -> None:
         """Canary gate advance; no-op for most strategies."""
 
+    def is_interrupted(self, elements: Sequence["Element"]) -> bool:
+        """True while the strategy itself is gating its children (canary
+        gates) with nothing released still running; surfaces as WAITING on
+        the parent element."""
+        return False
+
 
 class SerialStrategy(Strategy):
     """Children proceed strictly in order; a child is reachable only when all
@@ -60,11 +66,24 @@ class RandomStrategy(Strategy):
 class CanaryStrategy(Strategy):
     """Reference ``CanaryStrategy.java:30``: block until ``proceed()``; the
     first proceed releases only the first child (the canary); the second
-    proceed releases the rest via the wrapped strategy."""
+    proceed releases the rest via the wrapped strategy. While a gate is
+    closed the parent element reports WAITING (reference
+    ``CanaryStrategy`` interrupt semantics -> ``Status.WAITING``)."""
 
     def __init__(self, wrapped: Strategy | None = None):
         self._wrapped = wrapped or SerialStrategy()
         self._proceeds = 0
+
+    def is_interrupted(self, elements) -> bool:
+        # WAITING only while a gate is actually closed: before the first
+        # proceed, or after the canary completed and the rest are gated.
+        # While the released canary is deploying the plan shows IN_PROGRESS
+        # (reference CanaryStrategy semantics).
+        if self._proceeds == 0:
+            return True
+        if self._proceeds == 1:
+            return bool(elements) and elements[0].status is Status.COMPLETE
+        return False
 
     def proceed(self) -> None:
         self._proceeds += 1

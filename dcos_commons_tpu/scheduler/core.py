@@ -72,6 +72,7 @@ class ServiceScheduler:
         # (Mesos agent-reregistration-timeout analogue)
         self.agent_grace_s = agent_grace_s
         self._agent_missing_since: Dict[str, float] = {}
+        self.namespace = namespace
         self.state = StateStore(persister, namespace)
         self.configs = ConfigStore(persister, namespace)
         self.framework_store = FrameworkStore(persister)
@@ -225,13 +226,24 @@ class ServiceScheduler:
 
     # -- the cycle ---------------------------------------------------------
 
-    def run_cycle(self) -> int:
+    def run_cycle(self, allow_expand: bool = True) -> int:
         """One evaluation pass; returns the number of actions (launches +
-        kill batches) issued — zero means the cycle found no work."""
-        with self._lock:
-            return self._run_cycle_locked()
+        kill batches) issued — zero means the cycle found no work.
 
-    def _run_cycle_locked(self) -> int:
+        ``allow_expand=False`` (multi-service footprint discipline,
+        reference ``ParallelFootprintDiscipline``) gates only steps that
+        would *grow* the service's reservation footprint (first launch of a
+        pod, or a permanent replace); recovery relaunches on existing
+        reservations and config-update rollouts always proceed."""
+        with self._lock:
+            return self._run_cycle_locked(allow_expand)
+
+    def _expands_footprint(self, requirement) -> bool:
+        if requirement.recovery_type is RecoveryType.PERMANENT:
+            return True
+        return not self.ledger.for_pod(requirement.pod_instance.name)
+
+    def _run_cycle_locked(self, allow_expand: bool = True) -> int:
         if self.agent_grace_s > 0:
             # remote clusters: agents can die mid-run; re-check liveness
             # every cycle (reference ImplicitReconciler periodic pass)
@@ -245,6 +257,9 @@ class ServiceScheduler:
                 continue
             requirement = step.start()
             if requirement is None:
+                continue
+            if not allow_expand and self._expands_footprint(requirement):
+                step.on_no_match("footprint expansion gated by discipline")
                 continue
             requirement = self._apply_goal_overrides(requirement)
             if self._kill_before_relaunch(requirement):

@@ -120,7 +120,12 @@ def build_uninstall_plan(scheduler) -> Plan:
               for name in pod_names]
 
     def deregister() -> bool:
-        scheduler.framework_store.clear()
+        # the framework id is shared process-wide; a namespaced (multi-
+        # hosted) service's removal must not deregister the framework
+        # (reference: MultiServiceEventClient leaves the framework alone on
+        # per-service removal; only whole-scheduler uninstall deregisters)
+        if not scheduler.namespace:
+            scheduler.framework_store.clear()
         scheduler.state.delete_all()
         return True
 

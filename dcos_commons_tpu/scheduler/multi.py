@@ -30,10 +30,12 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import urllib.parse
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..agent.client import AgentClient, StatusCallback
 from ..agent.inventory import AgentInfo
+from ..plan.status import Status
 from ..state.persister import NotFoundError, Persister
 from ..state.state_store import StateStore
 from ..state.tasks import TaskStatus
@@ -44,7 +46,13 @@ log = logging.getLogger(__name__)
 
 
 def _esc(name: str) -> str:
-    return name.replace("/", "%2F")
+    # full percent-encoding: '%' itself must be escaped or names like
+    # 'a/b' and 'a%2Fb' collide onto one persister key / state namespace
+    return urllib.parse.quote(name, safe="")
+
+
+def _unesc(key: str) -> str:
+    return urllib.parse.unquote(key)
 
 
 class ServiceStore:
@@ -70,7 +78,7 @@ class ServiceStore:
             children = self._persister.get_children(self.ROOT)
         except NotFoundError:
             return []
-        return sorted(k.replace("%2F", "/") for k in children)
+        return sorted(_unesc(k) for k in children)
 
     def remove(self, name: str) -> None:
         self._persister.recursive_delete(f"{self.ROOT}/{_esc(name)}")
@@ -320,18 +328,22 @@ class MultiServiceScheduler:
         single-threaded offer pipeline (``OfferProcessor.java:57``)."""
         with self._lock:
             services = list(self._services.items())
-            self.discipline.update_services([n for n, _ in services])
+            # uninstalling services no longer count against the footprint
+            # cap (they only shrink); dropping them from the live set also
+            # releases any grant they held mid-deploy
+            self.discipline.update_services(
+                [n for n, s in services if not s.uninstall_mode])
             actions = 0
             for name, scheduler in services:
                 deploy_complete = (
-                    scheduler.deploy_manager.plan.status.name == "COMPLETE")
-                # the discipline caps footprint *expansion* only; teardown
-                # (which frees resources) must never be gated, or a capped
-                # grant could deadlock an uninstall against a stuck deploy
-                if not scheduler.uninstall_mode and not self.discipline.may_reserve(
-                        name, deploy_complete):
-                    continue
-                actions += scheduler.run_cycle()
+                    scheduler.deploy_manager.plan.status is Status.COMPLETE)
+                # the discipline caps footprint *expansion* only: a gated
+                # service still runs its cycle (recovery relaunches on
+                # existing reservations, config rollouts, teardown) — only
+                # steps that would grow its reservations are held back
+                allow_expand = scheduler.uninstall_mode or \
+                    self.discipline.may_reserve(name, deploy_complete)
+                actions += scheduler.run_cycle(allow_expand=allow_expand)
                 if scheduler.uninstall_complete:
                     self._finalize_uninstall(name)
             return actions
@@ -358,7 +370,15 @@ class MultiServiceScheduler:
                 for task_id in [t for t, owner in self._ownership.items()
                                 if owner == name]:
                     del self._ownership[task_id]
-                scheduler.state.delete_all()
+                # erase the ENTIRE namespace subtree (tasks, properties,
+                # configurations, config target): a later re-add of the same
+                # name must start from a clean slate, not inherit the dead
+                # service's target config
+                try:
+                    self.persister.recursive_delete(
+                        f"Services/{scheduler.namespace}")
+                except NotFoundError:
+                    pass
             if self._api_server is not None:
                 self._api_server.remove_service(name)
         log.info("service %s uninstalled and removed", name)

@@ -1,0 +1,55 @@
+"""Scheduler run loop.
+
+Reference: ``scheduler/SchedulerRunner.java:82-102`` (build + block forever;
+the Mesos driver thread delivers events) and ``MultiServiceRunner.java``.
+With no offer market, our loop is a plain periodic cycle driver: evaluate
+candidates against the agent inventory every ``interval_s`` (status updates
+arrive asynchronously via the agent transport callback and are handled
+immediately; the cycle only *launches* new work, so a multi-second period
+costs deploy latency, not correctness).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class CycleDriver:
+    """Drives ``run_cycle()`` on a :class:`ServiceScheduler` or
+    :class:`MultiServiceScheduler` from a background thread."""
+
+    def __init__(self, scheduler, interval_s: float = 1.0):
+        self.scheduler = scheduler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+
+    def start(self) -> "CycleDriver":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="scheduler-cycles", daemon=True)
+        self._thread.start()
+        return self
+
+    def poke(self) -> None:
+        """Run a cycle soon (new work arrived; reference revive analogue)."""
+        self._wake.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.scheduler.run_cycle()
+            self._wake.wait(self.interval_s)
+            self._wake.clear()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def __enter__(self) -> "CycleDriver":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,14 @@
+"""Test harnesses.
+
+Two tiers, mirroring the reference:
+
+* :mod:`.simulation` — the ``sdk/testing`` analogue
+  (``ServiceTestRunner.java:38``, ``Send.java``, ``Expect.java:42``): script
+  a real scheduler with synthetic agents/statuses as a sequence of ticks.
+* :mod:`.integration` — the ``testing/sdk_*`` analogue
+  (``sdk_install.py:97``, ``sdk_plan.py``, ``sdk_tasks.py``): drive a *live*
+  scheduler through its HTTP API with install/plan-wait/task-churn helpers.
+"""
+
+from .simulation import Expect, Send, ServiceTestRunner, TickFailure
+from . import integration

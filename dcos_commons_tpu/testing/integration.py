@@ -1,0 +1,242 @@
+"""Integration-test helpers driving a live scheduler over its HTTP API.
+
+Reference: the Python cluster-test library ``testing/`` —
+``sdk_install.py:97`` (install + await deploy plan), ``sdk_plan.py:29-195``
+(plan polling / force-complete), ``sdk_tasks.py:42-393`` (task-id churn
+checks), ``sdk_recovery.py`` (pod replace/restart assertions),
+``sdk_metrics.py:21-133``. These helpers talk only HTTP, so they work
+identically against an in-process :class:`ApiServer` in tests and a real
+deployed scheduler.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+
+DEFAULT_TIMEOUT_S = 15 * 60  # reference testing/sdk_plan.py:17
+
+
+class IntegrationError(AssertionError):
+    pass
+
+
+class ServiceClient:
+    """Thin JSON-over-HTTP client, service-scoped (multi-service schedulers
+    prefix ``/v1/service/<name>``, reference ``Multi*Resource.java``)."""
+
+    def __init__(self, base_url: str, service: Optional[str] = None,
+                 poll_interval_s: float = 0.25):
+        self.base = base_url.rstrip("/")
+        self.prefix = (f"/v1/service/{service}" if service else "/v1")
+        self.poll_interval_s = poll_interval_s
+
+    def call(self, method: str, path: str, body: Optional[bytes] = None,
+             root: bool = False):
+        prefix = "/v1" if root else self.prefix
+        url = f"{self.base}{prefix}/{path.lstrip('/')}"
+        req = urllib.request.Request(url, method=method, data=body)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read().decode() or "null")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read().decode())
+            except ValueError:
+                return e.code, {"error": str(e)}
+
+    def get(self, path: str, root: bool = False):
+        return self.call("GET", path, root=root)
+
+    def post(self, path: str, body: Optional[bytes] = None):
+        return self.call("POST", path, body)
+
+    # -- waiting primitives ------------------------------------------------
+
+    def wait_for(self, description: str, predicate,
+                 timeout_s: float = DEFAULT_TIMEOUT_S):
+        """Poll until predicate() is truthy (reference
+        ``sdk_plan.wait_for_plan_status`` retry loop)."""
+        deadline = time.monotonic() + timeout_s
+        last = None
+        while time.monotonic() < deadline:
+            last = predicate()
+            if last:
+                return last
+            time.sleep(self.poll_interval_s)
+        raise IntegrationError(
+            f"timed out after {timeout_s}s waiting for {description}; "
+            f"last={last!r}")
+
+
+# -- install / uninstall (sdk_install.py) ----------------------------------
+
+def install(base_url: str, name: str, yaml_text: str,
+            timeout_s: float = DEFAULT_TIMEOUT_S) -> ServiceClient:
+    """Add a service to a multi-service scheduler and await deploy COMPLETE
+    (reference ``sdk_install.install:97``)."""
+    client = ServiceClient(base_url, service=name)
+    req = urllib.request.Request(f"{base_url}/v1/multi/{name}",
+                                 method="PUT", data=yaml_text.encode())
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200
+    wait_for_deployment(client, timeout_s)
+    return client
+
+
+def uninstall(base_url: str, name: str,
+              timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
+    """Remove a service and await its disappearance (reference
+    ``sdk_install.uninstall``)."""
+    req = urllib.request.Request(f"{base_url}/v1/multi/{name}",
+                                 method="DELETE")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return  # already gone
+        raise
+    probe = ServiceClient(base_url)
+
+    def gone():
+        _, names = probe.get("multi", root=True)
+        return name not in names
+
+    probe.wait_for(f"service {name} removal", gone, timeout_s)
+
+
+# -- plans (sdk_plan.py) ----------------------------------------------------
+
+def get_plan(client: ServiceClient, plan: str = "deploy") -> dict:
+    code, body = client.get(f"plans/{plan}")
+    # the plans endpoint mirrors the reference: 200 when COMPLETE, 503 with
+    # the same body while the plan is in progress (PlansResource semantics)
+    if code not in (200, 503) or not isinstance(body, dict) \
+            or "status" not in body:
+        raise IntegrationError(f"plans/{plan} -> {code}: {body}")
+    return body
+
+
+def wait_for_plan_status(client: ServiceClient, plan: str, status: str,
+                         timeout_s: float = DEFAULT_TIMEOUT_S) -> dict:
+    def check():
+        body = get_plan(client, plan)
+        return body if body.get("status") == status else None
+
+    return client.wait_for(f"plan {plan} -> {status}", check, timeout_s)
+
+
+def wait_for_deployment(client: ServiceClient,
+                        timeout_s: float = DEFAULT_TIMEOUT_S) -> dict:
+    return wait_for_plan_status(client, "deploy", "COMPLETE", timeout_s)
+
+
+def force_complete(client: ServiceClient, plan: str, phase: str,
+                   step: str) -> None:
+    code, body = client.post(
+        f"plans/{plan}/forceComplete?phase={phase}&step={step}")
+    if code != 200:
+        raise IntegrationError(f"forceComplete -> {code}: {body}")
+
+
+# -- tasks (sdk_tasks.py) ---------------------------------------------------
+
+def get_task_ids(client: ServiceClient, prefix: str = "") -> Dict[str, str]:
+    """Map of instance name -> current task id, filtered by name prefix
+    (reference ``sdk_tasks.get_task_ids``)."""
+    code, body = client.get("pod/status")
+    if code != 200:
+        raise IntegrationError(f"pod/status -> {code}: {body}")
+    out: Dict[str, str] = {}
+    for pod in body.get("pods", []):
+        for task in pod.get("tasks", []):
+            if task["name"].startswith(prefix):
+                out[task["name"]] = task.get("id")
+    return out
+
+
+def check_tasks_updated(client: ServiceClient, prefix: str,
+                        old_ids: Dict[str, str],
+                        timeout_s: float = DEFAULT_TIMEOUT_S) -> Dict[str, str]:
+    """Wait until every matching task runs under a NEW id (reference
+    ``sdk_tasks.check_tasks_updated:309``)."""
+    def check():
+        now = get_task_ids(client, prefix)
+        changed = all(now.get(name) and now[name] != old
+                      for name, old in old_ids.items())
+        return now if changed and now else None
+
+    return client.wait_for(f"task ids under {prefix!r} to change", check,
+                           timeout_s)
+
+
+def check_tasks_not_updated(client: ServiceClient, prefix: str,
+                            old_ids: Dict[str, str]) -> None:
+    """Assert task ids did NOT churn (reference
+    ``sdk_tasks.check_tasks_not_updated:368``)."""
+    now = get_task_ids(client, prefix)
+    churned = {name for name, old in old_ids.items()
+               if now.get(name) != old}
+    if churned:
+        raise IntegrationError(f"tasks unexpectedly relaunched: "
+                               f"{sorted(churned)}")
+
+
+def wait_for_task_state(client: ServiceClient, task_name: str, state: str,
+                        timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
+    def check():
+        code, body = client.get("pod/status")
+        if code != 200:
+            return None
+        for pod in body.get("pods", []):
+            for task in pod.get("tasks", []):
+                if task["name"] == task_name and task.get("status") == state:
+                    return task
+        return None
+
+    client.wait_for(f"{task_name} -> {state}", check, timeout_s)
+
+
+# -- recovery (sdk_recovery.py) ---------------------------------------------
+
+def pod_replace(client: ServiceClient, pod_instance: str,
+                timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
+    """Replace a pod and await recovery COMPLETE (reference
+    ``sdk_recovery.check_pod_replace``)."""
+    old = get_task_ids(client, pod_instance)
+    code, body = client.post(f"pod/{pod_instance}/replace")
+    if code != 200:
+        raise IntegrationError(f"pod replace -> {code}: {body}")
+    check_tasks_updated(client, pod_instance, old, timeout_s)
+    wait_for_plan_status(client, "recovery", "COMPLETE", timeout_s)
+
+
+def pod_restart(client: ServiceClient, pod_instance: str,
+                timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
+    old = get_task_ids(client, pod_instance)
+    code, body = client.post(f"pod/{pod_instance}/restart")
+    if code != 200:
+        raise IntegrationError(f"pod restart -> {code}: {body}")
+    check_tasks_updated(client, pod_instance, old, timeout_s)
+
+
+# -- metrics (sdk_metrics.py) -----------------------------------------------
+
+def get_metrics(base_url: str) -> dict:
+    with urllib.request.urlopen(f"{base_url}/v1/metrics", timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+def wait_for_metric(base_url: str, name: str, predicate,
+                    timeout_s: float = 60.0) -> None:
+    client = ServiceClient(base_url)
+
+    def check():
+        value = get_metrics(base_url).get(name)
+        return value is not None and predicate(value)
+
+    client.wait_for(f"metric {name}", check, timeout_s)

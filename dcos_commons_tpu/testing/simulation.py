@@ -1,0 +1,362 @@
+"""Service simulation harness — script a real scheduler with fake agents.
+
+Reference: ``sdk/testing/.../ServiceTestRunner.java:38-112`` (render the
+service's actual YAML into a real scheduler over a mock driver),
+``Send.java`` / ``SendOffer.java`` / ``SendTaskStatus.java`` (stimulus
+ticks) and ``Expect.java:42-631`` (assertion ticks). A test is a list of
+ticks executed in order; the first failing tick raises :class:`TickFailure`
+naming the tick index, so scenario scripts read like the reference's::
+
+    ServiceTestRunner(SVC_YML).run([
+        Send.until_quiet(),
+        Expect.deployed(),
+        Send.task_status("hello-0-server", TaskState.FAILED),
+        Send.until_quiet(),
+        Expect.task_relaunched("hello-0-server"),
+    ])
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from ..agent.fake import FakeCluster, TaskBehavior
+from ..agent.inventory import AgentInfo, PortRange, TpuInventory
+from ..plan.status import Status
+from ..scheduler.core import ServiceScheduler
+from ..specification.spec import ServiceSpec
+from ..specification.yaml_loader import load_service_yaml_str
+from ..state.persister import MemPersister
+from ..state.tasks import TaskState
+
+
+class TickFailure(AssertionError):
+    def __init__(self, index: int, tick: "Tick", message: str):
+        super().__init__(f"tick[{index}] {tick.describe()}: {message}")
+        self.index = index
+        self.tick = tick
+
+
+class Tick:
+    """One simulation step (reference ``SimulationTick``)."""
+
+    def apply(self, runner: "ServiceTestRunner") -> None:
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class _LambdaTick(Tick):
+    def __init__(self, description: str, fn: Callable[["ServiceTestRunner"], None]):
+        self._description = description
+        self._fn = fn
+
+    def apply(self, runner: "ServiceTestRunner") -> None:
+        self._fn(runner)
+
+    def describe(self) -> str:
+        return self._description
+
+
+def default_agents(n: int = 3) -> List[AgentInfo]:
+    return [AgentInfo(agent_id=f"agent-{i}", hostname=f"host-{i}", cpus=8,
+                      memory_mb=16384, disk_mb=65536,
+                      ports=(PortRange(10000, 10500),))
+            for i in range(n)]
+
+
+def tpu_slice_agents(n: int = 4, chips: int = 4, slice_id: str = "slice-0",
+                     topology: str = "v4-16") -> List[AgentInfo]:
+    """A single-slice TPU pod: n hosts x chips, consistent coords."""
+    return [AgentInfo(agent_id=f"tpu-{i}", hostname=f"tpuhost-{i}", cpus=16,
+                      memory_mb=131072, disk_mb=131072,
+                      ports=(PortRange(10000, 10500),),
+                      tpu=TpuInventory(chips=chips, slice_id=slice_id,
+                                       topology=topology, coords=(i, 0, 0),
+                                       worker_index=i))
+            for i in range(n)]
+
+
+class ServiceTestRunner:
+    """Renders a service YAML (with template env, like the reference's
+    ``CosmosRenderer`` package defaults) into a real :class:`ServiceScheduler`
+    over a :class:`FakeCluster`, then executes tick scripts."""
+
+    def __init__(self, yaml_text: Optional[str] = None, *,
+                 spec: Optional[ServiceSpec] = None,
+                 env: Optional[dict] = None,
+                 agents: Optional[Sequence[AgentInfo]] = None,
+                 persister: Optional[MemPersister] = None,
+                 **scheduler_kwargs):
+        if (yaml_text is None) == (spec is None):
+            raise ValueError("provide exactly one of yaml_text or spec")
+        self.spec = spec or load_service_yaml_str(yaml_text, env or {})
+        self.persister = persister or MemPersister()
+        self.cluster = FakeCluster(agents if agents is not None
+                                   else default_agents())
+        self.scheduler_kwargs = scheduler_kwargs
+        self.scheduler = ServiceScheduler(self.spec, self.persister,
+                                          self.cluster, **scheduler_kwargs)
+        # Expect.launched_tasks consumes the launch log incrementally
+        self._launch_cursor = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def restart_scheduler(self, yaml_text: Optional[str] = None,
+                          env: Optional[dict] = None,
+                          **scheduler_kwargs) -> None:
+        """Simulate a scheduler process restart (same persister + cluster;
+        reference ``SchedulerRestartServiceTest``); optionally with a new
+        config to exercise update rollouts."""
+        if yaml_text is not None:
+            self.spec = load_service_yaml_str(yaml_text, env or {})
+        kwargs = {**self.scheduler_kwargs, **scheduler_kwargs}
+        self.scheduler = ServiceScheduler(self.spec, self.persister,
+                                          self.cluster, **kwargs)
+
+    def new_launches(self) -> List[str]:
+        """Instance names launched since the last call (consuming read)."""
+        plans = self.cluster.launch_log[self._launch_cursor:]
+        self._launch_cursor = len(self.cluster.launch_log)
+        return [t.task_name for p in plans for t in p.launches]
+
+    def run(self, ticks: Sequence[Tick]) -> ServiceScheduler:
+        for i, tick in enumerate(ticks):
+            try:
+                tick.apply(self)
+            except TickFailure:
+                raise
+            except AssertionError as e:
+                raise TickFailure(i, tick, str(e)) from e
+        return self.scheduler
+
+
+class Send:
+    """Stimulus ticks (reference ``Send.java``)."""
+
+    @staticmethod
+    def cycle(n: int = 1) -> Tick:
+        return _LambdaTick(f"Send.cycle({n})", lambda r: [
+            r.scheduler.run_cycle() for _ in range(n)])
+
+    @staticmethod
+    def until_quiet(max_cycles: int = 50) -> Tick:
+        return _LambdaTick("Send.until_quiet",
+                           lambda r: r.scheduler.run_until_quiet(max_cycles))
+
+    @staticmethod
+    def task_status(task_name: str, state: TaskState, message: str = "",
+                    readiness_passed: bool = False) -> Tick:
+        """Deliver a status for the task's *current* id (reference
+        ``SendTaskStatus``)."""
+        def fn(r: "ServiceTestRunner") -> None:
+            task = r.scheduler.state.fetch_task(task_name)
+            assert task is not None, f"no stored task named {task_name!r}"
+            r.cluster.send_status(task.task_id, state, message=message,
+                                  readiness_passed=readiness_passed)
+        return _LambdaTick(f"Send.task_status({task_name}, {state.name})", fn)
+
+    @staticmethod
+    def script(task_name: str, behavior: TaskBehavior) -> Tick:
+        return _LambdaTick(
+            f"Send.script({task_name}, {behavior.name})",
+            lambda r: r.cluster.script(task_name, behavior))
+
+    @staticmethod
+    def agent_added(agent: AgentInfo) -> Tick:
+        return _LambdaTick(f"Send.agent_added({agent.agent_id})",
+                           lambda r: r.cluster.add_agent(agent))
+
+    @staticmethod
+    def agent_lost(agent_id: str) -> Tick:
+        """Host dies silently: tasks vanish, no statuses (reference agent
+        partition; detection must come from reconciliation)."""
+        def fn(r: "ServiceTestRunner") -> None:
+            r.cluster.remove_agent(agent_id)
+            r.scheduler.reconcile()
+        return _LambdaTick(f"Send.agent_lost({agent_id})", fn)
+
+    @staticmethod
+    def pod_restart(pod_instance: str) -> Tick:
+        return _LambdaTick(f"Send.pod_restart({pod_instance})",
+                           lambda r: r.scheduler.restart_pod(pod_instance))
+
+    @staticmethod
+    def pod_replace(pod_instance: str) -> Tick:
+        return _LambdaTick(f"Send.pod_replace({pod_instance})",
+                           lambda r: r.scheduler.replace_pod(pod_instance))
+
+    @staticmethod
+    def scheduler_restart(yaml_text: Optional[str] = None,
+                          env: Optional[dict] = None) -> Tick:
+        return _LambdaTick("Send.scheduler_restart",
+                           lambda r: r.restart_scheduler(yaml_text, env))
+
+    @staticmethod
+    def plan_interrupt(plan: str, phase: Optional[str] = None) -> Tick:
+        def fn(r: "ServiceTestRunner") -> None:
+            p = r.scheduler.plan(plan)
+            assert p is not None, f"no plan {plan!r}"
+            (p if phase is None else _phase(p, phase)).interrupt()
+        return _LambdaTick(f"Send.plan_interrupt({plan})", fn)
+
+    @staticmethod
+    def plan_proceed(plan: str, phase: Optional[str] = None) -> Tick:
+        def fn(r: "ServiceTestRunner") -> None:
+            p = r.scheduler.plan(plan)
+            assert p is not None, f"no plan {plan!r}"
+            (p if phase is None else _phase(p, phase)).proceed()
+        return _LambdaTick(f"Send.plan_proceed({plan})", fn)
+
+
+def _phase(plan, phase_name: str):
+    for ph in plan.phases:
+        if ph.name == phase_name:
+            return ph
+    raise AssertionError(
+        f"no phase {phase_name!r} in plan {plan.name!r}; have "
+        f"{[p.name for p in plan.phases]}")
+
+
+def _step(plan, phase_name: str, step_name: str):
+    ph = _phase(plan, phase_name)
+    for st in ph.steps:
+        if st.name == step_name:
+            return st
+    raise AssertionError(
+        f"no step {step_name!r} in phase {phase_name!r}; have "
+        f"{[s.name for s in ph.steps]}")
+
+
+class Expect:
+    """Assertion ticks (reference ``Expect.java:47-631``)."""
+
+    @staticmethod
+    def deployed() -> Tick:
+        def fn(r: "ServiceTestRunner") -> None:
+            plan = r.scheduler.plan("deploy")
+            assert plan.status is Status.COMPLETE, (
+                f"deploy is {plan.status.name}: {plan.to_dict()}")
+        return _LambdaTick("Expect.deployed", fn)
+
+    @staticmethod
+    def plan_status(plan_name: str, status: Status) -> Tick:
+        def fn(r: "ServiceTestRunner") -> None:
+            plan = r.scheduler.plan(plan_name)
+            assert plan is not None, f"no plan {plan_name!r}"
+            assert plan.status is status, (
+                f"plan {plan_name!r} is {plan.status.name}, "
+                f"expected {status.name}")
+        return _LambdaTick(f"Expect.plan_status({plan_name}, {status.name})",
+                           fn)
+
+    @staticmethod
+    def step_status(plan_name: str, phase_name: str, step_name: str,
+                    status: Status) -> Tick:
+        def fn(r: "ServiceTestRunner") -> None:
+            plan = r.scheduler.plan(plan_name)
+            assert plan is not None, f"no plan {plan_name!r}"
+            st = _step(plan, phase_name, step_name)
+            assert st.status is status, (
+                f"step {step_name!r} is {st.status.name}, "
+                f"expected {status.name}")
+        return _LambdaTick(
+            f"Expect.step_status({plan_name}/{phase_name}/{step_name}, "
+            f"{status.name})", fn)
+
+    @staticmethod
+    def launched_tasks(*names: str) -> Tick:
+        """Exactly these instance names launched since the last consuming
+        read (reference ``Expect.launchedTasks``)."""
+        def fn(r: "ServiceTestRunner") -> None:
+            got = sorted(r.new_launches())
+            assert got == sorted(names), (
+                f"launched {got}, expected {sorted(names)}")
+        return _LambdaTick(f"Expect.launched_tasks{names}", fn)
+
+    @staticmethod
+    def no_launches() -> Tick:
+        def fn(r: "ServiceTestRunner") -> None:
+            got = r.new_launches()
+            assert got == [], f"unexpected launches: {got}"
+        return _LambdaTick("Expect.no_launches", fn)
+
+    @staticmethod
+    def known_tasks(*names: str) -> Tick:
+        """The state store knows exactly these instance names (reference
+        ``Expect.knownTasks``)."""
+        def fn(r: "ServiceTestRunner") -> None:
+            got = sorted(t.task_name for t in r.scheduler.state.fetch_tasks())
+            assert got == sorted(names), (
+                f"state store has {got}, expected {sorted(names)}")
+        return _LambdaTick(f"Expect.known_tasks{names}", fn)
+
+    @staticmethod
+    def task_state(task_name: str, state: TaskState) -> Tick:
+        def fn(r: "ServiceTestRunner") -> None:
+            status = r.scheduler.state.fetch_status(task_name)
+            assert status is not None, f"no status for {task_name!r}"
+            assert status.state is state, (
+                f"{task_name} is {status.state.name}, expected {state.name}")
+        return _LambdaTick(f"Expect.task_state({task_name}, {state.name})", fn)
+
+    @staticmethod
+    def task_killed(task_name: str) -> Tick:
+        def fn(r: "ServiceTestRunner") -> None:
+            killed_names = {k.rsplit("__", 1)[0] for k in r.cluster.kill_log}
+            assert task_name in killed_names, (
+                f"{task_name!r} not killed; kill log: "
+                f"{sorted(killed_names)}")
+        return _LambdaTick(f"Expect.task_killed({task_name})", fn)
+
+    @staticmethod
+    def task_relaunched(task_name: str, old_task_id: Optional[str] = None
+                        ) -> Tick:
+        """The task runs under a NEW id (recovery happened)."""
+        def fn(r: "ServiceTestRunner") -> None:
+            task = r.scheduler.state.fetch_task(task_name)
+            assert task is not None, f"no stored task {task_name!r}"
+            status = r.scheduler.state.fetch_status(task_name)
+            assert status is not None and status.state is TaskState.RUNNING, (
+                f"{task_name} not RUNNING after relaunch")
+            if old_task_id is not None:
+                assert task.task_id != old_task_id, (
+                    f"{task_name} still has old id {old_task_id}")
+        return _LambdaTick(f"Expect.task_relaunched({task_name})", fn)
+
+    @staticmethod
+    def recovery_step_status(step_name: str, status: Status) -> Tick:
+        """A step in the (dynamically regenerated) recovery plan (reference
+        ``Expect.recoveryStepStatus``)."""
+        def fn(r: "ServiceTestRunner") -> None:
+            plan = r.scheduler.plan("recovery")
+            assert plan is not None, "no recovery plan"
+            for ph in plan.phases:
+                for st in ph.steps:
+                    if st.name == step_name:
+                        assert st.status is status, (
+                            f"recovery step {step_name!r} is "
+                            f"{st.status.name}, expected {status.name}")
+                        return
+            raise AssertionError(
+                f"no recovery step {step_name!r}; plan: {plan.to_dict()}")
+        return _LambdaTick(
+            f"Expect.recovery_step_status({step_name}, {status.name})", fn)
+
+    @staticmethod
+    def reservations_exactly(pod_instances: Sequence[str]) -> Tick:
+        """The reservation ledger covers exactly these pod instances."""
+        def fn(r: "ServiceTestRunner") -> None:
+            got = sorted({res.pod_instance_name
+                          for res in r.scheduler.ledger.all()})
+            assert got == sorted(pod_instances), (
+                f"reservations for {got}, expected {sorted(pod_instances)}")
+        return _LambdaTick("Expect.reservations_exactly", fn)
+
+    @staticmethod
+    def that(description: str, predicate: Callable[["ServiceTestRunner"], bool]
+             ) -> Tick:
+        def fn(r: "ServiceTestRunner") -> None:
+            assert predicate(r), description
+        return _LambdaTick(f"Expect.that({description})", fn)

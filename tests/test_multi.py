@@ -372,3 +372,96 @@ class TestDisciplineDoesNotGateTeardown:
         multi.uninstall_service("svc-b")
         multi.run_until_quiet()
         assert multi.service_names() == ["svc-a"]  # svc-b teardown completed
+
+
+class TestReviewRegressions:
+    def test_per_service_uninstall_keeps_framework_id(self):
+        multi, persister, cluster = make()
+        multi.add_service(spec("svc-a"))
+        multi.add_service(spec("svc-b"))
+        multi.run_until_quiet()
+        from dcos_commons_tpu.state.state_store import FrameworkStore
+        fw = FrameworkStore(persister)
+        fw.store_framework_id("fw-123")
+        multi.uninstall_service("svc-a")
+        multi.run_until_quiet()
+        assert fw.fetch_framework_id() == "fw-123"  # shared id untouched
+
+    def test_readd_after_uninstall_starts_clean(self):
+        multi, persister, cluster = make()
+        three = load_service_yaml_str(
+            SVC_YML.format(name="svc-a").replace("count: 2", "count: 3"), {})
+        multi.add_service(three)
+        multi.run_until_quiet()
+        multi.uninstall_service("svc-a")
+        multi.run_until_quiet()
+        assert multi.service_names() == []
+        # re-add with a SMALLER count: must not hit pods_cannot_shrink
+        # against the dead service's leftover target config
+        multi.add_service(spec("svc-a"))
+        multi.run_until_quiet()
+        sched = multi.get_service("svc-a")
+        assert sched.config_errors == ()
+        assert sched.plan("deploy").status is Status.COMPLETE
+        assert len(sched.state.fetch_tasks()) == 2
+
+    def test_gated_service_still_recovers_failures(self):
+        persister = MemPersister()
+        cluster = FakeCluster(agents(4))
+        discipline = ParallelFootprintDiscipline(
+            1, DisciplineSelectionStore(persister))
+        multi = MultiServiceScheduler(persister, cluster,
+                                      discipline=discipline)
+        # svc-b deploys first (gets the grant is irrelevant; both complete)
+        multi.add_service(spec("svc-b"))
+        multi.run_until_quiet()
+        # svc-a: a spec that can never fully deploy -> holds the grant
+        big = load_service_yaml_str(
+            SVC_YML.format(name="svc-a").replace("cpus: 0.5", "cpus: 512"), {})
+        multi.add_service(big)
+        multi.run_until_quiet()
+        assert multi.get_service("svc-a").plan("deploy").status is not Status.COMPLETE
+        # now svc-b's deploy is COMPLETE so it passes may_reserve... make a
+        # THIRD mid-deploy service to be the gated one
+        multi.add_service(spec("svc-c"))
+        multi.run_cycle()
+        c = multi.get_service("svc-c")
+        assert len(c.state.fetch_tasks()) == 0  # gated from expanding
+        # fail one of svc-b's RUNNING tasks; even though the grant is held
+        # by svc-a, svc-b recovery (existing reservations) must proceed
+        b = multi.get_service("svc-b")
+        victim = b.state.fetch_task("hello-0-server")
+        cluster.send_status(victim.task_id, TaskState.FAILED)
+        multi.run_until_quiet()
+        assert b.state.fetch_status("hello-0-server").state is TaskState.RUNNING
+        assert b.state.fetch_task("hello-0-server").task_id != victim.task_id
+
+    def test_uninstalling_service_releases_grant(self):
+        persister = MemPersister()
+        cluster = FakeCluster(agents(2))
+        discipline = ParallelFootprintDiscipline(
+            1, DisciplineSelectionStore(persister))
+        multi = MultiServiceScheduler(persister, cluster,
+                                      discipline=discipline)
+        big = load_service_yaml_str(
+            SVC_YML.format(name="svc-a").replace("cpus: 0.5", "cpus: 512"), {})
+        multi.add_service(big)
+        multi.run_until_quiet()  # svc-a stuck, holds the grant
+        multi.add_service(spec("svc-b"))
+        multi.run_cycle()
+        assert len(multi.get_service("svc-b").state.fetch_tasks()) == 0
+        # uninstalling svc-a must release its grant -> svc-b deploys
+        multi.uninstall_service("svc-a")
+        multi.run_until_quiet()
+        assert multi.get_service("svc-b").plan("deploy").status is Status.COMPLETE
+
+    def test_slash_and_encoded_names_do_not_collide(self):
+        multi, _, _ = make()
+        multi.add_service(spec("a/b"))
+        multi.add_service(spec("a%2Fb"))
+        multi.run_until_quiet()
+        assert multi.service_names() == ["a%2Fb", "a/b"]
+        for name in ("a/b", "a%2Fb"):
+            sched = multi.get_service(name)
+            assert sched.plan("deploy").status is Status.COMPLETE
+            assert len(sched.state.fetch_tasks()) == 2

@@ -1,0 +1,209 @@
+"""Tests for the test harnesses themselves, written as the scenario scripts
+the reference ships (``frameworks/helloworld/.../ServiceTest.java:43``
+default deployment, ``:228`` failure->recovery, ``:463-530`` escalation;
+integration flows from ``testing/sdk_install.py`` / ``sdk_recovery.py``)."""
+
+import pytest
+
+from dcos_commons_tpu.agent import TaskBehavior
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler import TestingFailureMonitor
+from dcos_commons_tpu.scheduler.runner import CycleDriver
+from dcos_commons_tpu.state import TaskState
+from dcos_commons_tpu.testing import (Expect, Send, ServiceTestRunner,
+                                      TickFailure, integration)
+
+SVC_YML = """
+name: hello-world
+pods:
+  hello:
+    count: 2
+    tasks:
+      server: {goal: RUNNING, cmd: "./hello", cpus: 0.5, memory: 256}
+  world:
+    count: 1
+    tasks:
+      init: {goal: ONCE, cmd: ./init, cpus: 0.1, memory: 32, essential: false}
+      server: {goal: RUNNING, cmd: ./world, cpus: 0.5, memory: 256}
+"""
+
+CANARY_YML = """
+name: canary
+pods:
+  web:
+    count: 3
+    tasks:
+      server: {goal: RUNNING, cmd: ./run, cpus: 0.1, memory: 64}
+plans:
+  deploy:
+    strategy: serial
+    phases:
+      web-deploy: {pod: web, strategy: canary}
+"""
+
+
+class TestSimulationHarness:
+    def test_default_deployment(self):
+        ServiceTestRunner(SVC_YML).run([
+            Send.until_quiet(),
+            Expect.deployed(),
+            Expect.known_tasks("hello-0-server", "hello-1-server",
+                               "world-0-init", "world-0-server"),
+            Expect.task_state("hello-0-server", TaskState.RUNNING),
+            Expect.task_state("world-0-init", TaskState.FINISHED),
+            Expect.reservations_exactly(["hello-0", "hello-1", "world-0"]),
+        ])
+
+    def test_failure_and_recovery(self):
+        runner = ServiceTestRunner(SVC_YML)
+        runner.run([
+            Send.until_quiet(),
+            Expect.deployed(),
+            Send.task_status("hello-0-server", TaskState.FAILED,
+                             message="oom"),
+            Send.until_quiet(),
+            Expect.task_relaunched("hello-0-server"),
+            Expect.plan_status("recovery", Status.COMPLETE),
+            Expect.deployed(),
+        ])
+
+    def test_permanent_failure_replaces_elsewhere(self):
+        runner = ServiceTestRunner(
+            SVC_YML,
+            failure_monitor=TestingFailureMonitor("hello-0-server"))
+        sched = runner.run([
+            Send.until_quiet(),
+            Expect.deployed(),
+        ])
+        old_agent = sched.state.fetch_task("hello-0-server").agent_id
+        runner.run([
+            Send.task_status("hello-0-server", TaskState.FAILED),
+            Send.until_quiet(),
+            Expect.task_relaunched("hello-0-server"),
+        ])
+        # permanent recovery re-evaluates placement; with UNIQUE-free spec it
+        # may land anywhere, but its reservation must have been rebuilt
+        assert sched.state.fetch_task("hello-0-server").agent_id is not None
+        assert old_agent is not None
+
+    def test_scheduler_restart_preserves_tasks(self):
+        ServiceTestRunner(SVC_YML).run([
+            Send.until_quiet(),
+            Expect.launched_tasks("hello-0-server", "hello-1-server",
+                                  "world-0-init", "world-0-server"),
+            Expect.deployed(),
+            Send.scheduler_restart(),
+            Send.until_quiet(),
+            Expect.no_launches(),
+            Expect.deployed(),
+            Expect.known_tasks("hello-0-server", "hello-1-server",
+                               "world-0-init", "world-0-server"),
+        ])
+
+    def test_agent_loss_triggers_recovery(self):
+        from dcos_commons_tpu.scheduler import TimedFailureMonitor
+        # zero timeout: LOST tasks escalate to permanent immediately, so the
+        # pod is replaced onto a surviving agent (reference TimedFailureMonitor
+        # + ReplacementFailurePolicy)
+        runner = ServiceTestRunner(
+            SVC_YML, failure_monitor=TimedFailureMonitor(0.0))
+        sched = runner.run([
+            Send.until_quiet(),
+            Expect.deployed(),
+        ])
+        victim_agent = sched.state.fetch_task("hello-0-server").agent_id
+        runner.run([
+            Send.agent_lost(victim_agent),
+            Send.until_quiet(),
+            Expect.deployed(),
+        ])
+        for task in sched.state.fetch_tasks():
+            st = sched.state.fetch_status(task.task_name)
+            assert st is not None and st.state in (TaskState.RUNNING,
+                                                   TaskState.FINISHED)
+
+    def test_canary_gates_on_proceed(self):
+        ServiceTestRunner(CANARY_YML).run([
+            Send.until_quiet(),
+            # canary: nothing deploys until proceed
+            Expect.no_launches(),
+            Expect.plan_status("deploy", Status.WAITING),
+            Send.plan_proceed("deploy", "web-deploy"),
+            Send.until_quiet(),
+            Expect.launched_tasks("web-0-server"),
+            Send.plan_proceed("deploy", "web-deploy"),
+            Send.until_quiet(),
+            Expect.launched_tasks("web-1-server", "web-2-server"),
+            Expect.deployed(),
+        ])
+
+    def test_crash_loop_scripting(self):
+        runner = ServiceTestRunner(SVC_YML)
+        runner.cluster.script("hello-0-server", TaskBehavior.CRASH)
+        runner.run([Send.cycle(6)])
+        status = runner.scheduler.state.fetch_status("hello-0-server")
+        assert status is not None and status.state is TaskState.FAILED
+        # un-script the crash; recovery brings it up
+        runner.cluster.script("hello-0-server", TaskBehavior.AUTO_RUN)
+        runner.run([
+            Send.until_quiet(),
+            Expect.task_state("hello-0-server", TaskState.RUNNING),
+            Expect.deployed(),
+        ])
+
+    def test_tick_failure_names_the_tick(self):
+        with pytest.raises(TickFailure) as exc:
+            ServiceTestRunner(SVC_YML).run([
+                Send.until_quiet(),
+                Expect.known_tasks("nope-0-task"),
+            ])
+        assert "tick[1]" in str(exc.value)
+        assert "Expect.known_tasks" in str(exc.value)
+
+
+class TestIntegrationLib:
+    """The sdk_* analogue driving a REAL ApiServer + background CycleDriver
+    over HTTP only — an in-process stand-in for a deployed cluster."""
+
+    @pytest.fixture()
+    def live(self):
+        from dcos_commons_tpu.agent import FakeCluster
+        from dcos_commons_tpu.http import ApiServer
+        from dcos_commons_tpu.scheduler import MultiServiceScheduler
+        from dcos_commons_tpu.state import MemPersister
+        from dcos_commons_tpu.testing.simulation import default_agents
+
+        cluster = FakeCluster(default_agents(3))
+        multi = MultiServiceScheduler(MemPersister(), cluster)
+        server = ApiServer(port=0, multi=multi)
+        multi.set_api_server(server)
+        server.start()
+        driver = CycleDriver(multi, interval_s=0.05).start()
+        yield f"http://127.0.0.1:{server.port}"
+        driver.stop()
+        server.stop()
+
+    def test_install_replace_uninstall_flow(self, live):
+        client = integration.install(live, "hello-world", SVC_YML,
+                                     timeout_s=20)
+        ids = integration.get_task_ids(client, "hello")
+        assert set(ids) == {"hello-0-server", "hello-1-server"}
+
+        # pod restart churns ids (sdk_recovery.check_pod_restart)
+        integration.pod_restart(client, "hello-0", timeout_s=20)
+        new_ids = integration.get_task_ids(client, "hello")
+        assert new_ids["hello-0-server"] != ids["hello-0-server"]
+        integration.check_tasks_not_updated(
+            client, "hello-1", {"hello-1-server": ids["hello-1-server"]})
+
+        # pod replace completes recovery (sdk_recovery.check_pod_replace)
+        integration.pod_replace(client, "hello-1", timeout_s=20)
+
+        integration.uninstall(live, "hello-world", timeout_s=20)
+        code, names = client.get("multi", root=True)
+        assert names == []
+
+    def test_wait_timeout_raises(self, live):
+        client = integration.ServiceClient(live, poll_interval_s=0.01)
+        with pytest.raises(integration.IntegrationError):
+            client.wait_for("never", lambda: False, timeout_s=0.1)
