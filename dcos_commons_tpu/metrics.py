@@ -1,0 +1,201 @@
+"""Metrics registry: counters/gauges/timers, StatsD push, Prometheus/JSON.
+
+Reference: ``metrics/Metrics.java:66-190`` (Codahale ``MetricRegistry`` with
+StatsD push via ``STATSD_UDP_HOST/PORT`` and pull endpoints ``/v1/metrics`` +
+``/v1/metrics/prometheus``; counters for offers/declines/revives/operations/
+task statuses; per-plan status gauges) and ``metrics/PlanReporter.java``
+(periodic plan gauges). Stdlib-only; thread-safe.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+def _sanitize(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    s = "".join(out)
+    return s if not s[:1].isdigit() else "_" + s
+
+
+class Timer:
+    """Cumulative timer: count + total/max seconds (Codahale Timer analogue)."""
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def record(self, elapsed_s: float) -> None:
+        self.count += 1
+        self.total_s += elapsed_s
+        self.max_s = max(self.max_s, elapsed_s)
+
+    def to_dict(self) -> dict:
+        mean = self.total_s / self.count if self.count else 0.0
+        return {"count": self.count, "mean_s": round(mean, 6),
+                "max_s": round(self.max_s, 6)}
+
+
+class MetricsRegistry:
+    """Scheduler-wide metric registry.
+
+    Counters increment monotonically; gauges are sampled callables (so plan
+    status can be read live, the reference ``PlanGauge`` pattern,
+    ``Metrics.java:177-190``).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._timers: Dict[str, Timer] = {}
+        self._statsd: Optional[_StatsdPusher] = None
+
+    # -- instruments -------------------------------------------------------
+
+    def counter(self, name: str, delta: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + delta
+        if self._statsd is not None:
+            self._statsd.count(name, delta)
+
+    def gauge(self, name: str, supplier: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = supplier
+
+    def remove_gauge(self, name: str) -> None:
+        with self._lock:
+            self._gauges.pop(name, None)
+
+    def time(self, name: str):
+        """Context manager recording a timer sample."""
+        registry = self
+
+        class _Ctx:
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                elapsed = time.perf_counter() - self._t0
+                with registry._lock:
+                    timer = registry._timers.setdefault(name, Timer())
+                    timer.record(elapsed)
+                if registry._statsd is not None:
+                    registry._statsd.timing(name, elapsed)
+
+        return _Ctx()
+
+    # -- scheduler-standard counters (Metrics.java:100-165) ----------------
+
+    def record_cycle(self) -> None:
+        self.counter("scheduler.cycles")
+
+    def record_launch(self, n: int = 1) -> None:
+        self.counter("operations.launch", n)
+
+    def record_reserve(self, n: int = 1) -> None:
+        self.counter("operations.reserve", n)
+
+    def record_unreserve(self, n: int = 1) -> None:
+        self.counter("operations.unreserve", n)
+
+    def record_kill(self) -> None:
+        self.counter("operations.kill")
+
+    def record_task_status(self, state: str) -> None:
+        self.counter(f"task_status.{state.lower()}")
+
+    # -- export ------------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            gauges = {}
+            for name, fn in self._gauges.items():
+                try:
+                    gauges[name] = fn()
+                except Exception:
+                    gauges[name] = None
+            return {
+                "counters": dict(self._counters),
+                "gauges": gauges,
+                "timers": {n: t.to_dict() for n, t in self._timers.items()},
+            }
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (reference ``/v1/metrics/prometheus``)."""
+        data = self.to_dict()
+        lines = []
+        for name, value in sorted(data["counters"].items()):
+            m = _sanitize(name)
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {value}")
+        for name, value in sorted(data["gauges"].items()):
+            if value is None:
+                continue
+            m = _sanitize(name)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {value}")
+        for name, timer in sorted(data["timers"].items()):
+            m = _sanitize(name)
+            lines.append(f"# TYPE {m}_count counter")
+            lines.append(f"{m}_count {timer['count']}")
+            lines.append(f"{m}_mean_seconds {timer['mean_s']}")
+            lines.append(f"{m}_max_seconds {timer['max_s']}")
+        return "\n".join(lines) + "\n"
+
+    # -- statsd push (Metrics.configureStatsd:74-79) -----------------------
+
+    def configure_statsd(self, host: str, port: int, prefix: str = "tpu_sdk"
+                         ) -> None:
+        self._statsd = _StatsdPusher(host, port, prefix)
+
+
+class _StatsdPusher:
+    """Fire-and-forget StatsD datagrams (UDP; errors ignored by design)."""
+
+    def __init__(self, host: str, port: int, prefix: str):
+        self._addr = (host, port)
+        self._prefix = prefix
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def _send(self, payload: str) -> None:
+        try:
+            self._sock.sendto(payload.encode(), self._addr)
+        except OSError:
+            pass
+
+    def count(self, name: str, delta: float) -> None:
+        self._send(f"{self._prefix}.{name}:{delta}|c")
+
+    def timing(self, name: str, elapsed_s: float) -> None:
+        self._send(f"{self._prefix}.{name}:{elapsed_s * 1000:.3f}|ms")
+
+
+class PlanReporter:
+    """Registers live per-plan status gauges (reference
+    ``metrics/PlanReporter.java`` + ``PlanGauge``): value is the ordinal of
+    the plan's status so dashboards can alert on ERROR/IN_PROGRESS."""
+
+    STATUS_VALUES = {
+        "ERROR": -1, "COMPLETE": 0, "WAITING": 1, "PENDING": 2,
+        "IN_PROGRESS": 3, "PREPARED": 3, "STARTING": 3, "STARTED": 3,
+        "DELAYED": 4,
+    }
+
+    def __init__(self, registry: MetricsRegistry, scheduler,
+                 service_name: Optional[str] = None):
+        prefix = f"plan_status.{service_name}." if service_name else "plan_status."
+        for plan in scheduler.plans:
+            name = prefix + plan.name
+
+            def supplier(p=plan) -> float:
+                return float(self.STATUS_VALUES.get(p.status.value, 2))
+
+            registry.gauge(name, supplier)

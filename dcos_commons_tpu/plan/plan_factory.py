@@ -105,16 +105,25 @@ def build_plan_from_spec(spec: ServiceSpec, plan_spec: PlanSpecModel,
         steps = []
         if phase_spec.steps:
             default_tasks = tuple(t.name for t in pod.tasks)
-            explicit = {s.pod_instance: s for s in phase_spec.steps if s.pod_instance >= 0}
-            default_entry = next(
-                (s for s in phase_spec.steps if s.pod_instance < 0), None)
+            # Instance-major expansion: each instance gets one step per
+            # matching YAML entry, in entry order (the hdfs two-step
+            # format-then-start pattern, reference svc.yml:566-596 via
+            # PlanGenerator.java:39). `default` entries apply only to
+            # instances with no explicit entry.
+            explicit: dict[int, list] = {}
+            default_entries = []
+            for s in phase_spec.steps:
+                if s.pod_instance >= 0:
+                    explicit.setdefault(s.pod_instance, []).append(s)
+                else:
+                    default_entries.append(s)
             for index in range(pod.count):
-                entry = explicit.get(index, default_entry)
-                if entry is None:
-                    continue
-                task_names = entry.tasks or default_tasks
-                steps.append(_make_step(PodInstance(pod, index), tuple(task_names),
-                                        state_store, target_config_id, backoff))
+                entries = explicit.get(index, default_entries)
+                for entry in entries:
+                    task_names = entry.tasks or default_tasks
+                    steps.append(_make_step(
+                        PodInstance(pod, index), tuple(task_names),
+                        state_store, target_config_id, backoff))
         else:
             task_names = tuple(t.name for t in pod.tasks)
             for index in range(pod.count):

@@ -60,7 +60,8 @@ class ServiceScheduler:
                  validators=DEFAULT_VALIDATORS,
                  recovery_overriders: Sequence[RecoveryOverrider] = (),
                  uninstall: bool = False,
-                 agent_grace_s: float = 0.0):
+                 agent_grace_s: float = 0.0,
+                 metrics=None):
         SchemaVersionStore(persister).check()
         # serializes run_cycle against status callbacks arriving from other
         # threads (RemoteCluster delivers on HTTP worker threads; the
@@ -79,6 +80,8 @@ class ServiceScheduler:
         self.reservation_store = ReservationStore(persister, namespace)
         self.cluster = cluster
         self.uninstall_mode = uninstall
+        # optional MetricsRegistry (reference metrics/Metrics.java counters)
+        self.metrics = metrics
 
         if uninstall:
             # teardown works against whatever config is already stored
@@ -109,8 +112,24 @@ class ServiceScheduler:
             self.coordinator = PlanCoordinator([self.deploy_manager])
         else:
             from .decommission import DecommissionPlanManager
-            deploy_plan = build_deploy_plan(
-                self.spec, self.state, self.target_config_id, self.backoff)
+            # Once the initial deployment has completed, a plan named
+            # `update` (when defined) replaces the deploy plan on every
+            # subsequent boot, keeping the `deploy` name so operators/CLI
+            # see one rollout surface. Keyed off the persisted
+            # deploy-completed marker so the choice is stable across
+            # scheduler restarts mid-rollout (reference
+            # SchedulerBuilder.selectDeployPlan:644-677 uses the same
+            # persisted has-completed-deployment signal).
+            update_plan_spec = (self.spec.plan("update")
+                                if self.state.deploy_completed() else None)
+            if update_plan_spec is not None:
+                deploy_plan = build_plan_from_spec(
+                    self.spec, update_plan_spec, self.state,
+                    self.target_config_id, self.backoff)
+                deploy_plan.name = "deploy"
+            else:
+                deploy_plan = build_deploy_plan(
+                    self.spec, self.state, self.target_config_id, self.backoff)
             if self.config_errors:
                 deploy_plan.errors.extend(self.config_errors)
             self.deploy_manager = PlanManager(deploy_plan)
@@ -198,6 +217,8 @@ class ServiceScheduler:
 
     def _handle_status_locked(self, task_name: str,
                               status: TaskStatus) -> None:
+        if self.metrics is not None:
+            self.metrics.record_task_status(status.state.value)
         try:
             self.state.store_status(task_name, status)
         except StateStoreError:
@@ -244,6 +265,8 @@ class ServiceScheduler:
         return not self.ledger.for_pod(requirement.pod_instance.name)
 
     def _run_cycle_locked(self, allow_expand: bool = True) -> int:
+        if self.metrics is not None:
+            self.metrics.record_cycle()
         if self.agent_grace_s > 0:
             # remote clusters: agents can die mid-run; re-check liveness
             # every cycle (reference ImplicitReconciler periodic pass)
@@ -281,6 +304,8 @@ class ServiceScheduler:
             self._persist_launch(plan)
             step.on_launch(plan.task_ids())
             self.cluster.launch(plan)
+            if self.metrics is not None:
+                self.metrics.record_launch(len(plan.task_ids()))
             actions += 1
         if (not self.uninstall_mode
                 and self.deploy_manager.plan.status is Status.COMPLETE
@@ -368,6 +393,8 @@ class ServiceScheduler:
         if (task and status and status.task_id == task.task_id
                 and not status.state.terminal):
             self.cluster.kill(task.agent_id, task.task_id)
+            if self.metrics is not None:
+                self.metrics.record_kill()
             return True
         return False
 

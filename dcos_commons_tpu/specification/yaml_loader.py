@@ -146,7 +146,11 @@ def _map_pod(pod_type: str, raw: Mapping[str, Any], env: Mapping[str, str],
     if placement is None:
         rule = None
     elif isinstance(placement, str):
-        rule = parse_marathon_constraints(placement)
+        # an empty/whitespace constraint means "no constraint" (the reference
+        # MarathonConstraintParser.java:35 returns a pass-through for it, so
+        # svc.ymls can say placement: '{{POD_PLACEMENT}}' with empty default)
+        rule = (parse_marathon_constraints(placement)
+                if placement.strip() else None)
     else:
         rule = rule_from_json(placement)
 

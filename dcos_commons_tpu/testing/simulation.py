@@ -188,6 +188,20 @@ class Send:
                            lambda r: r.scheduler.replace_pod(pod_instance))
 
     @staticmethod
+    def pod_pause(pod_instance: str, tasks: Optional[Sequence[str]] = None
+                  ) -> Tick:
+        return _LambdaTick(f"Send.pod_pause({pod_instance})",
+                           lambda r: r.scheduler.pause_pod(pod_instance,
+                                                           tasks))
+
+    @staticmethod
+    def pod_resume(pod_instance: str, tasks: Optional[Sequence[str]] = None
+                   ) -> Tick:
+        return _LambdaTick(f"Send.pod_resume({pod_instance})",
+                           lambda r: r.scheduler.resume_pod(pod_instance,
+                                                            tasks))
+
+    @staticmethod
     def scheduler_restart(yaml_text: Optional[str] = None,
                           env: Optional[dict] = None) -> Tick:
         return _LambdaTick("Send.scheduler_restart",

@@ -1,0 +1,104 @@
+"""helloworld scheduler entry point.
+
+Reference ``frameworks/helloworld/src/main/java/.../Main.java:54-82``: one
+binary that runs mono-service (a single scenario YAML), static multi-service
+(several YAMLs hosted by one scheduler process), or dynamic multi-service
+(start empty; services added/removed at runtime over HTTP, the
+``ExampleMultiServiceResource`` pattern) depending on arguments.
+
+Usage::
+
+    python -m frameworks.helloworld.main [scenario ...] [--port N] [--state DIR]
+
+* no scenario args -> dynamic multi-service mode
+* one scenario     -> mono mode (e.g. ``svc``, ``simple``, ``canary``)
+* many scenarios   -> static multi mode, one service per YAML
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+from dcos_commons_tpu.agent.remote import RemoteCluster
+from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
+from dcos_commons_tpu.scheduler import (MultiServiceScheduler,
+                                        ServiceScheduler)
+from dcos_commons_tpu.scheduler.runner import CycleDriver
+from dcos_commons_tpu.state import FilePersister
+
+from . import scenarios
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("scenario", nargs="*",
+                   help="scenario YAML name(s) under dist/ (omit for dynamic "
+                        "multi-service mode)")
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get("API_PORT", "8080")))
+    p.add_argument("--state", default=os.environ.get("STATE_DIR", "./state"))
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="scheduler cycle period seconds")
+    p.add_argument("--list", action="store_true", help="list scenarios")
+    return p
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    args = build_parser().parse_args(argv)
+    if args.list:
+        print("\n".join(scenarios.list_scenarios()))
+        return 0
+
+    metrics = MetricsRegistry()
+    statsd_host = os.environ.get("STATSD_UDP_HOST")
+    if statsd_host:  # reference Metrics.configureStatsd:74-79
+        metrics.configure_statsd(statsd_host,
+                                 int(os.environ.get("STATSD_UDP_PORT", "8125")))
+    persister = FilePersister(args.state)
+    cluster = RemoteCluster()
+
+    if len(args.scenario) == 1:
+        # mono-service (reference Main.java runDefaultService path)
+        spec = scenarios.load_scenario(args.scenario[0])
+        scheduler = ServiceScheduler(spec, persister, cluster,
+                                     metrics=metrics)
+        server = ApiServer(scheduler, port=args.port, metrics=metrics,
+                           cluster=cluster)
+        PlanReporter(metrics, scheduler)
+        driver = CycleDriver(scheduler, interval_s=args.interval)
+    else:
+        # multi-service, static or dynamic (reference
+        # Main.java:54-82 multi paths + ExampleMultiServiceResource)
+        multi = MultiServiceScheduler(persister, cluster)
+        server = ApiServer(None, port=args.port, metrics=metrics,
+                           cluster=cluster, multi=multi)
+        multi.set_api_server(server)
+        for name in args.scenario:
+            spec = scenarios.load_scenario(name)
+            multi.add_service(spec)
+        driver = CycleDriver(multi, interval_s=args.interval)
+
+    server.start()
+    print(f"helloworld scheduler API on http://127.0.0.1:{server.port}/v1/",
+          flush=True)
+    try:
+        with driver:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,61 @@
+"""helloworld scenario registry.
+
+Reference: ``frameworks/helloworld/src/main/java/.../Scenario.java`` +
+``Main.java:54-82`` (yaml file selected by env/args; customizers applied per
+scenario) and ``CosmosRenderer`` (universe config.json defaults rendered into
+the scheduler env so templated svc.ymls resolve without a live cluster).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Mapping, Optional
+
+from dcos_commons_tpu.specification import ServiceSpec, load_service_yaml
+
+DIST = os.path.join(os.path.dirname(__file__), "dist")
+
+# Defaults mirroring universe/config.json option defaults (the reference
+# renders these via CosmosRenderer in tests; in production Marathon injects
+# them from the user's package options).
+DEFAULT_ENV: Mapping[str, str] = {
+    "FRAMEWORK_NAME": "hello-world",
+    "SERVICE_NAME": "hello-world",
+    "HELLO_COUNT": "1",
+    "WORLD_COUNT": "2",
+    "HELLO_CPUS": "0.1",
+    "HELLO_MEM": "256",
+    "HELLO_DISK": "25",
+    "WORLD_CPUS": "0.2",
+    "WORLD_MEM": "512",
+    "WORLD_DISK": "25",
+    "HELLO_PLACEMENT": "",
+    "WORLD_PLACEMENT": "",
+    "SLEEP_DURATION": "1000",
+    "DEPLOY_STRATEGY": "serial",
+    "HELLO_URI": "https://example.com/artifact.tar.gz",
+    "TPU_CHIPS": "4",
+    "TPU_TOPOLOGY": "v4-8",
+}
+
+
+def scenario_env(overrides: Optional[Mapping[str, str]] = None) -> dict:
+    env = dict(DEFAULT_ENV)
+    env.update(os.environ)
+    if overrides:
+        env.update(overrides)
+    return env
+
+
+def load_scenario(name: str = "svc",
+                  env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
+    """Load ``dist/<name>.yml`` with universe-default env rendering."""
+    path = os.path.join(DIST, f"{name}.yml")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"unknown scenario {name!r}; available: {sorted(list_scenarios())}")
+    return load_service_yaml(path, scenario_env(env))
+
+
+def list_scenarios() -> list[str]:
+    return sorted(f[:-4] for f in os.listdir(DIST) if f.endswith(".yml"))

@@ -1,0 +1,74 @@
+"""Metrics registry tests (reference metrics/Metrics.java + PlanReporter +
+testing/sdk_metrics.py assertions)."""
+
+import socket
+
+from dcos_commons_tpu.agent import AgentInfo, FakeCluster, PortRange
+from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
+from dcos_commons_tpu.scheduler import ServiceScheduler
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import MemPersister
+
+YML = """
+name: metricsvc
+pods:
+  hello:
+    count: 2
+    tasks:
+      server: {goal: RUNNING, cmd: ./run, cpus: 0.1, memory: 64}
+"""
+
+
+def make_scheduler(metrics):
+    agents = [AgentInfo(agent_id="a0", hostname="h0", cpus=4, memory_mb=8192,
+                        disk_mb=10000, ports=(PortRange(10000, 10100),))]
+    spec = load_service_yaml_str(YML)
+    return ServiceScheduler(spec, MemPersister(), FakeCluster(agents),
+                            metrics=metrics)
+
+
+class TestRegistry:
+    def test_scheduler_counters(self):
+        m = MetricsRegistry()
+        sched = make_scheduler(m)
+        sched.run_until_quiet()
+        data = m.to_dict()
+        assert data["counters"]["scheduler.cycles"] >= 1
+        assert data["counters"]["operations.launch"] == 2
+        assert data["counters"]["task_status.task_running"] >= 2
+
+    def test_plan_gauges(self):
+        m = MetricsRegistry()
+        sched = make_scheduler(m)
+        PlanReporter(m, sched)
+        sched.run_until_quiet()
+        assert m.to_dict()["gauges"]["plan_status.deploy"] == 0  # COMPLETE
+
+    def test_prometheus_exposition(self):
+        m = MetricsRegistry()
+        sched = make_scheduler(m)
+        PlanReporter(m, sched)
+        sched.run_until_quiet()
+        text = m.to_prometheus()
+        assert "# TYPE operations_launch counter" in text
+        assert "plan_status_deploy 0" in text
+
+    def test_timer(self):
+        m = MetricsRegistry()
+        with m.time("work"):
+            pass
+        stats = m.to_dict()["timers"]["work"]
+        assert stats["count"] == 1
+        assert stats["max_s"] >= 0
+
+    def test_statsd_push(self):
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(5)
+        port = recv.getsockname()[1]
+        m = MetricsRegistry()
+        m.configure_statsd("127.0.0.1", port)
+        m.counter("ops.launch", 3)
+        datagram = recv.recv(1024).decode()
+        assert datagram == "tpu_sdk.ops.launch:3|c"
+        recv.close()
