@@ -66,6 +66,7 @@ class RemoteCluster:
                     for n, d, t in l.config_templates],
                 "health_check_cmd": l.health_check_cmd,
                 "readiness_check_cmd": l.readiness_check_cmd,
+                "uris": list(l.uris),
             } for l in plan.launches]}
         with self._lock:
             self._queues.setdefault(plan.agent.agent_id, []).append(command)

@@ -61,6 +61,8 @@ class TaskLaunch:
     config_templates: Tuple[Tuple[str, str, str], ...] = ()  # (name, dest, template)
     health_check_cmd: Optional[str] = None
     readiness_check_cmd: Optional[str] = None
+    uris: Tuple[str, ...] = ()  # fetched into the sandbox pre-launch
+    # (reference: Mesos fetcher URIs, how sdk/bootstrap reaches the task)
 
 
 @dataclass(frozen=True)
@@ -275,7 +277,11 @@ class Evaluator:
                 f"ports={ports}"))
 
         # stage: TPU process assignment
-        tpu_assignment = self._tpu_assignment(requirement, agent)
+        tpu_assignment, tpu_err = self._tpu_assignment(requirement, agent,
+                                                       tasks)
+        if tpu_err is not None:
+            node.add(EvaluationOutcome.fail("tpu", tpu_err))
+            return None
         if tpu_assignment is not None:
             node.add(EvaluationOutcome.ok(
                 "tpu", f"process {tpu_assignment.process_id}/"
@@ -293,12 +299,30 @@ class Evaluator:
                           tpu=tpu_assignment)
 
     def _tpu_assignment(self, requirement: PodInstanceRequirement,
-                        agent: AgentInfo) -> Optional[TpuAssignment]:
+                        agent: AgentInfo, tasks: Sequence[TaskRecord]
+                        ) -> Tuple[Optional[TpuAssignment], Optional[str]]:
+        """Returns (assignment, error). A non-None error fails the match."""
         pod = requirement.pod_instance.pod
         if pod.tpu is None or pod.tpu.chips <= 0:
-            return None
-        coordinator = service_hostname(
-            self._service_name, f"{pod.type}-0")
+            return None, None
+        # Coordinator = the host where <pod>-0 actually runs. The scheduler
+        # owns placement, so it exports a directly-routable host instead of
+        # a DNS convention name (the reference leans on Mesos-DNS autoip,
+        # sdk/bootstrap/main.go:218-287; we ship no DNS tier). Stale-host
+        # hazard is covered by gang recovery: any membership change re-forms
+        # the whole gang, re-injecting fresh env everywhere.
+        if requirement.pod_instance.index == 0:
+            coordinator = agent.hostname
+        else:
+            rec = next((t for t in tasks
+                        if t.pod_type == pod.type and t.pod_index == 0), None)
+            if rec is None:
+                # no fabricated fallback address: fail the match so the step
+                # retries after instance 0 lands and its record is stored
+                return None, (
+                    f"coordinator placement unknown: {pod.type}-0 not "
+                    "launched yet; retrying after instance 0 lands")
+            coordinator = rec.hostname
         return TpuAssignment(
             process_id=requirement.pod_instance.index,
             num_processes=pod.count,
@@ -307,7 +331,7 @@ class Evaluator:
             slice_id=agent.tpu.slice_id,
             topology=pod.tpu.topology or agent.tpu.topology,
             worker_coords=agent.tpu.coords,
-        )
+        ), None
 
     def _build_launch(self, requirement: PodInstanceRequirement,
                       agent: AgentInfo, task_spec_name: str,
@@ -359,6 +383,7 @@ class Evaluator:
             health_check_cmd=task_spec.health_check.cmd if task_spec.health_check else None,
             readiness_check_cmd=(
                 task_spec.readiness_check.cmd if task_spec.readiness_check else None),
+            uris=tuple(task_spec.uris),
         )
 
     def _record(self, root: OutcomeNode) -> None:

@@ -115,18 +115,21 @@ class MetricsRegistry:
     # -- export ------------------------------------------------------------
 
     def to_dict(self) -> dict:
+        # snapshot under the lock, then run user-supplied gauge suppliers
+        # outside it: a supplier that touches the registry would deadlock
+        # the (non-reentrant) lock, and slow suppliers must not stall the
+        # scheduler cycle's counter() calls
         with self._lock:
-            gauges = {}
-            for name, fn in self._gauges.items():
-                try:
-                    gauges[name] = fn()
-                except Exception:
-                    gauges[name] = None
-            return {
-                "counters": dict(self._counters),
-                "gauges": gauges,
-                "timers": {n: t.to_dict() for n, t in self._timers.items()},
-            }
+            suppliers = dict(self._gauges)
+            counters = dict(self._counters)
+            timers = {n: t.to_dict() for n, t in self._timers.items()}
+        gauges = {}
+        for name, fn in suppliers.items():
+            try:
+                gauges[name] = fn()
+            except Exception:
+                gauges[name] = None
+        return {"counters": counters, "gauges": gauges, "timers": timers}
 
     def to_prometheus(self) -> str:
         """Prometheus text exposition (reference ``/v1/metrics/prometheus``)."""

@@ -194,6 +194,7 @@ class MultiServiceScheduler:
     service before acting."""
 
     def __init__(self, persister: Persister, cluster: AgentClient,
+                 metrics=None,
                  discipline: Optional[OfferDiscipline] = None,
                  scheduler_factory: Optional[Callable[..., ServiceScheduler]]
                  = None,
@@ -201,6 +202,7 @@ class MultiServiceScheduler:
         self._lock = threading.RLock()
         self.persister = persister
         self.cluster = cluster
+        self._metrics = metrics
         self.service_store = ServiceStore(persister)
         self.discipline = discipline or AllDiscipline()
         self._factory = scheduler_factory or ServiceScheduler
@@ -270,6 +272,8 @@ class MultiServiceScheduler:
         # or the child would see its own running tasks as unowned zombies
         for task in StateStore(self.persister, namespace).fetch_tasks():
             self._ownership[task.task_id] = spec.name
+        if self._metrics is not None:
+            kwargs.setdefault("metrics", self._metrics)
         scheduler = self._factory(
             spec, self.persister, view, namespace=namespace,
             uninstall=uninstall, **kwargs)

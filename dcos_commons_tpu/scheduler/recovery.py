@@ -187,8 +187,11 @@ class RecoveryPlanManager(PlanManager):
             if phase is not None:
                 return phase
         pod = pod_instance.pod
-        if (pod.tpu is not None and pod.tpu.gang
-                and recovery_type is RecoveryType.PERMANENT):
+        if pod.tpu is not None and pod.tpu.gang:
+            # Gang semantics Mesos never had (SURVEY.md §7 hard part (3)):
+            # any member death — transient or permanent — breaks the
+            # jax.distributed barrier, so the whole gang must re-form with
+            # stable ranks, not just the failed member.
             return self._gang_phase(pod_instance, recovery_type)
         return Phase(
             f"recover-{pod_instance.name}",

@@ -77,7 +77,7 @@ def main(argv=None) -> int:
     else:
         # multi-service, static or dynamic (reference
         # Main.java:54-82 multi paths + ExampleMultiServiceResource)
-        multi = MultiServiceScheduler(persister, cluster)
+        multi = MultiServiceScheduler(persister, cluster, metrics=metrics)
         server = ApiServer(None, port=args.port, metrics=metrics,
                            cluster=cluster, multi=multi)
         multi.set_api_server(server)

@@ -1,0 +1,11 @@
+"""frameworks/jax — the TPU training/inference service this SDK exists for.
+
+The reference ships database example frameworks (cassandra/hdfs); the
+BASELINE.json north star replaces them with a JAX service whose pods run
+``jax.distributed.initialize()`` and all-reduce over ICI, scheduled and
+healed by the SDK core. Workloads (BASELINE.json ``configs[2..4]``):
+
+* ``mnist``  — single-host MLP, 1 chip, no collectives (minimum e2e slice)
+* ``resnet`` — data-parallel ResNet-50 over a gang-placed TPU slice
+* ``llama``  — model-parallel Llama inference shards (pjit + NamedSharding)
+"""

@@ -1,0 +1,82 @@
+"""frameworks/jax scheduler entry point.
+
+Deploys one of the JAX workload scenarios (mnist / resnet / llama / svc)
+as a long-running scheduled service: gang-placed TPU pods, deploy plan,
+recovery plan with coordinated gang re-form on worker failure (the core
+recovery manager restarts siblings of a gang pod so ``jax.distributed``
+can re-initialize with stable ranks — SURVEY.md §7 hard part (3)).
+
+Usage::
+
+    python -m frameworks.jax.main [scenario] [--port N] [--state DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+from dcos_commons_tpu.agent.remote import RemoteCluster
+from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
+from dcos_commons_tpu.scheduler import ServiceScheduler
+from dcos_commons_tpu.scheduler.runner import CycleDriver
+from dcos_commons_tpu.state import FilePersister
+
+from . import scenarios
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("scenario", nargs="?", default="svc",
+                   help="workload YAML under dist/ (svc, mnist, resnet, llama)")
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get("API_PORT", "8080")))
+    p.add_argument("--state", default=os.environ.get("STATE_DIR", "./state"))
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--list", action="store_true", help="list scenarios")
+    return p
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    args = build_parser().parse_args(argv)
+    if args.list:
+        print("\n".join(scenarios.list_scenarios()))
+        return 0
+
+    metrics = MetricsRegistry()
+    statsd_host = os.environ.get("STATSD_UDP_HOST")
+    if statsd_host:
+        metrics.configure_statsd(statsd_host,
+                                 int(os.environ.get("STATSD_UDP_PORT", "8125")))
+    persister = FilePersister(args.state)
+    cluster = RemoteCluster()
+    spec = scenarios.load_scenario(args.scenario)
+    scheduler = ServiceScheduler(spec, persister, cluster, metrics=metrics)
+    server = ApiServer(scheduler, port=args.port, metrics=metrics,
+                       cluster=cluster)
+    PlanReporter(metrics, scheduler)
+    driver = CycleDriver(scheduler, interval_s=args.interval)
+
+    server.start()
+    print(f"jax scheduler API on http://127.0.0.1:{server.port}/v1/",
+          flush=True)
+    try:
+        with driver:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

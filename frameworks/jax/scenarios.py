@@ -1,0 +1,61 @@
+"""frameworks/jax scenario registry.
+
+Mirrors the helloworld registry: ``dist/<name>.yml`` rendered with
+universe-default env (the reference renders package defaults via
+``CosmosRenderer``, ``sdk/testing/.../CosmosRenderer.java``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Optional
+
+from dcos_commons_tpu.specification import ServiceSpec, load_service_yaml
+
+DIST = os.path.join(os.path.dirname(__file__), "dist")
+
+# universe/config.json option defaults (Marathon injects these in production)
+DEFAULT_ENV: Mapping[str, str] = {
+    "FRAMEWORK_NAME": "jax",
+    "SERVICE_NAME": "jax",
+    # worker gang shape: v4-32 = 4 hosts x 4 chips (north-star config)
+    "WORKER_COUNT": "4",
+    "CHIPS_PER_WORKER": "4",
+    "TPU_TOPOLOGY": "v4-32",
+    "WORKER_CPUS": "8",
+    "WORKER_MEM": "65536",
+    "CKPT_DISK": "65536",
+    # trainer knobs routed into the worker cmd
+    "TRAIN_STEPS": "200",
+    "BATCH_PER_HOST": "256",
+    "RESNET_DEPTH": "50",
+    "LLAMA_PRESET": "tiny",
+    "SHARD_COUNT": "4",
+    # fetched into every task sandbox pre-launch (reference: resource.json
+    # assets fetched by Mesos; in production the universe template overrides
+    # this with the artifact URL). Default: the locally-built binary.
+    "BOOTSTRAP_URI": "file://" + os.path.abspath(os.path.join(
+        os.path.dirname(__file__), "..", "..", "native", "bin",
+        "tpu-bootstrap")),
+}
+
+
+def scenario_env(overrides: Optional[Mapping[str, str]] = None) -> dict:
+    env = dict(DEFAULT_ENV)
+    env.update(os.environ)
+    if overrides:
+        env.update(overrides)
+    return env
+
+
+def load_scenario(name: str = "svc",
+                  env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
+    path = os.path.join(DIST, f"{name}.yml")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"unknown scenario {name!r}; available: {sorted(list_scenarios())}")
+    return load_service_yaml(path, scenario_env(env))
+
+
+def list_scenarios() -> list[str]:
+    return sorted(f[:-4] for f in os.listdir(DIST) if f.endswith(".yml"))

@@ -1,0 +1,278 @@
+"""Task-side worker: what each scheduled pod instance actually runs.
+
+The scheduler's matcher injects the JAX distributed contract into the task
+sandbox env (``JAX_COORDINATOR_ADDRESS`` / ``JAX_PROCESS_ID`` /
+``JAX_NUM_PROCESSES``, see ``dcos_commons_tpu/matching/evaluator.py``);
+``tpu-bootstrap`` re-exports it after peer-resolution (the reference's
+``sdk/bootstrap/main.go:466-513`` analogue). This module is the consumer:
+every workload starts with :func:`dcos_commons_tpu.parallel.distributed.
+initialize` — a no-op single-process, a ``jax.distributed`` bring-up on a
+gang — so one entry point serves 1 chip or a full pod slice.
+
+Usage (as a task ``cmd``)::
+
+    python3 -m frameworks.jax.worker mnist  --steps 200 --out ckpt
+    python3 -m frameworks.jax.worker resnet --steps 200 --batch 256 --out ckpt
+    python3 -m frameworks.jax.worker llama  --preset tiny --out ckpt
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import pickle
+import time
+from typing import Optional
+
+log = logging.getLogger("jax.worker")
+
+
+# ---------------------------------------------------------------- checkpoints
+
+def save_checkpoint(out_dir: str, step: int, params, process_id: int = 0,
+                    keep: int = 3) -> Optional[str]:
+    """Orbax-style step checkpoints (write-temp+rename for atomicity, prune
+    old steps). Control-plane state lives in the scheduler's state store;
+    model state lives here, on the task's persistent volume (SURVEY.md §5
+    checkpoint/resume split). Pass process_id to restrict writing to rank 0
+    where per-host volumes aren't desired; dp gangs write on every host so
+    resume step counts stay lock-step."""
+    if process_id != 0:
+        return None
+    os.makedirs(out_dir, exist_ok=True)
+    import jax
+    host_params = jax.device_get(params)
+    tmp = os.path.join(out_dir, f".tmp-step-{step}")
+    with open(tmp, "wb") as f:
+        pickle.dump({"step": step, "params": host_params}, f)
+    final = os.path.join(out_dir, f"step-{step}.ckpt")
+    os.replace(tmp, final)
+    ckpts = sorted(
+        (f for f in os.listdir(out_dir) if f.endswith(".ckpt")),
+        key=lambda f: int(f[5:-5]))
+    for old in ckpts[:-keep]:
+        os.remove(os.path.join(out_dir, old))
+    return final
+
+
+def latest_checkpoint(out_dir: str) -> Optional[dict]:
+    """Resume support: a replaced/restarted pod picks up where it left off."""
+    try:
+        ckpts = sorted(
+            (f for f in os.listdir(out_dir) if f.endswith(".ckpt")),
+            key=lambda f: int(f[5:-5]))
+    except OSError:
+        return None
+    if not ckpts:
+        return None
+    with open(os.path.join(out_dir, ckpts[-1]), "rb") as f:
+        return pickle.load(f)
+
+
+def _emit(record: dict) -> None:
+    """One JSON line per progress event; the integration-test lib greps
+    these the way the reference's sdk_metrics.py asserts on StatsD."""
+    print(json.dumps(record), flush=True)
+
+
+# ------------------------------------------------------------------ workloads
+
+def run_mnist(args) -> dict:
+    """Single-host MLP on synthetic MNIST-shaped data (zero egress: no
+    dataset downloads). BASELINE.json configs[2]."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import mlp, train
+    from dcos_commons_tpu.parallel import distributed
+
+    contract = distributed.initialize()
+    cfg = mlp.MLPConfig(in_dim=784, hidden=(512, 256), n_classes=10)
+    params = mlp.init_params(cfg, jax.random.key(0))
+    opt = train.make_optimizer(lr=1e-3)
+    step_fn = train.make_train_step(
+        lambda p, b: mlp.loss_fn(cfg, p, b), opt)
+    opt_state = opt.init(params)
+
+    resumed = latest_checkpoint(args.out) if args.out else None
+    start = 0
+    if resumed:
+        params, start = resumed["params"], resumed["step"]
+        _emit({"event": "resumed", "step": start})
+
+    key = jax.random.key(1)
+    batch = 256
+    t0 = time.perf_counter()
+    loss = None
+    for step in range(start, args.steps):
+        key, k1, k2 = jax.random.split(key, 3)
+        x = jax.random.normal(k1, (batch, 784), jnp.float32)
+        y = jax.random.randint(k2, (batch,), 0, 10)
+        params, opt_state, out = step_fn(params, opt_state, (x, y))
+        loss = out["loss"]
+        if args.out and (step + 1) % max(1, args.steps // 4) == 0:
+            save_checkpoint(args.out, step + 1, params,
+                            contract["process_id"])
+    loss = float(jax.block_until_ready(loss)) if loss is not None else 0.0
+    dt = time.perf_counter() - t0
+    steps_run = max(args.steps - start, 1)
+    result = {"workload": "mnist", "steps": steps_run, "final_loss": loss,
+              "examples_per_sec": round(batch * steps_run / dt, 1),
+              "process_id": contract["process_id"]}
+    if args.out:
+        save_checkpoint(args.out, args.steps, params, contract["process_id"])
+    return result
+
+
+def run_resnet(args) -> dict:
+    """Data-parallel ResNet-50: batch sharded over the dp mesh axis, XLA
+    inserts the ICI gradient all-reduce (BASELINE.json configs[3], the
+    north-star metric images/sec/chip)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dcos_commons_tpu.models import resnet, train
+    from dcos_commons_tpu.parallel import distributed
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+    contract = distributed.initialize()
+    n = jax.device_count()
+    mesh = MeshSpec(dp=n).build()
+
+    depth = args.depth
+    cfg = resnet.ResNetConfig(depth=depth, n_classes=1000)
+    with mesh:
+        params, state = resnet.init_params(cfg, jax.random.key(0))
+        # Gang re-form resumes, not restarts. EVERY process checkpoints to
+        # its own volume (not just rank 0): params are identical across the
+        # dp gang, and per-host checkpoints keep resume step counts in sync
+        # — a rank-0-only checkpoint would desync the lock-step collective
+        # loop after a restart.
+        start_step = 0
+        resumed = latest_checkpoint(args.out) if args.out else None
+        if resumed:
+            params, start_step = resumed["params"], resumed["step"]
+            _emit({"event": "resumed", "step": start_step})
+        opt = train.make_optimizer(lr=0.1)
+        step_fn = train.make_train_step(
+            lambda p, b: resnet.loss_fn(cfg, p, b[0], b[1]), opt,
+            has_aux_state=True)
+        opt_state = opt.init(params)
+
+        per_host = args.batch
+        n_proc = contract["num_processes"]
+        global_batch = per_host * n_proc
+        # synthetic imagenet-shaped data: each process contributes its local
+        # shard of the dp-sharded global batch
+        x_local = jax.random.normal(
+            jax.random.key(1 + contract["process_id"]),
+            (per_host, 224, 224, 3), jnp.bfloat16)
+        y_local = jax.random.randint(
+            jax.random.key(100 + contract["process_id"]),
+            (per_host,), 0, 1000)
+        sharding = NamedSharding(mesh, P("dp"))
+        if n_proc > 1:
+            x = jax.make_array_from_process_local_data(
+                sharding, x_local, (global_batch, 224, 224, 3))
+            y = jax.make_array_from_process_local_data(
+                sharding, y_local, (global_batch,))
+        else:
+            x = jax.device_put(x_local, sharding)
+            y = jax.device_put(y_local, sharding)
+
+        # warmup/compile
+        params, opt_state, state, out = step_fn(params, opt_state,
+                                                (state, (x, y)))
+        jax.block_until_ready(out["loss"])
+        steps_run = max(args.steps - start_step, 1)
+        ckpt_every = max(1, args.steps // 4)
+        t0 = time.perf_counter()
+        for step in range(start_step, args.steps):
+            params, opt_state, state, out = step_fn(params, opt_state,
+                                                    (state, (x, y)))
+            if args.out and (step + 1) % ckpt_every == 0:
+                save_checkpoint(args.out, step + 1, params)
+        loss = float(jax.block_until_ready(out["loss"]))
+        dt = time.perf_counter() - t0
+
+    if args.out:
+        save_checkpoint(args.out, args.steps, params)
+    ips = x.shape[0] * steps_run / dt
+    return {"workload": "resnet", "depth": depth, "steps": steps_run,
+            "final_loss": loss, "global_batch": global_batch,
+            "images_per_sec_per_chip": round(ips / max(n, 1), 2),
+            "process_id": contract["process_id"]}
+
+
+def run_llama(args) -> dict:
+    """Model-parallel Llama inference shard: weights pjit-sharded over the tp
+    axis (megatron column/row layout, ``models/llama.py:shard_params``),
+    decode via lax.scan (BASELINE.json configs[4])."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama
+    from dcos_commons_tpu.parallel import distributed
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+    contract = distributed.initialize()
+    n = jax.device_count()
+    if args.preset == "8b":
+        cfg = llama.LlamaConfig.llama3_8b()
+    else:
+        cfg = llama.LlamaConfig.tiny()
+    mesh = MeshSpec(tp=n).build()
+    with mesh:
+        params = llama.init_params(cfg, jax.random.key(0))
+        params = llama.shard_params(params, mesh, cfg)
+        prompt = jnp.array([[1, 2, 3, 4]], dtype=jnp.int32)
+        gen_len = args.gen_len
+        # warmup/compile
+        tokens = llama.generate(cfg, params, prompt, gen_len, mesh=mesh)
+        jax.block_until_ready(tokens)
+        t0 = time.perf_counter()
+        tokens = llama.generate(cfg, params, prompt, gen_len, mesh=mesh)
+        jax.block_until_ready(tokens)
+        dt = time.perf_counter() - t0
+
+    if args.out:  # readiness-check gate (llama.yml): shard is serving
+        os.makedirs(args.out, exist_ok=True)
+    with open("serving.ready", "w") as f:
+        f.write("ok\n")
+    return {"workload": "llama", "preset": args.preset,
+            "tokens_per_sec": round(gen_len / dt, 2),
+            "tp": n, "process_id": contract["process_id"]}
+
+
+WORKLOADS = {"mnist": run_mnist, "resnet": run_resnet, "llama": run_llama}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("workload", choices=sorted(WORKLOADS))
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--depth", type=int, default=50,
+                   help="resnet depth (18 for CPU smoke tests)")
+    p.add_argument("--preset", default="tiny", choices=["tiny", "8b"])
+    p.add_argument("--gen-len", type=int, default=16)
+    p.add_argument("--out", default="")
+    return p
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    args = build_parser().parse_args(argv)
+    _emit({"event": "start", "workload": args.workload,
+           "task": os.environ.get("TASK_NAME", "?"),
+           "pod_index": os.environ.get("POD_INSTANCE_INDEX", "0")})
+    result = WORKLOADS[args.workload](args)
+    _emit({"event": "done", **result})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

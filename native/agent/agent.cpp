@@ -32,6 +32,7 @@
 #include <fstream>
 #include <iostream>
 #include <map>
+#include <sstream>
 #include <string>
 #include <vector>
 
@@ -249,6 +250,54 @@ class Agent {
     pending_statuses_.push_back(std::move(s));
   }
 
+  // Fetch one task URI into the sandbox (reference: the Mesos fetcher,
+  // which is how sdk/bootstrap and config artifacts reach a task's
+  // sandbox). file:// and bare paths are copied; http(s):// downloaded.
+  // Fetched files are marked executable, matching how the reference's
+  // resource.json assets (bootstrap, CLI) are fetched.
+  static bool fetch_uri(const std::string& uri, const std::string& sandbox,
+                        std::string& err) {
+    std::string src, data;
+    // basename excludes any query/fragment (?sig=... on signed URLs), like
+    // the Mesos fetcher's path-component basename
+    std::string path_part = uri.substr(0, uri.find_first_of("?#"));
+    std::string base = path_part.substr(path_part.find_last_of('/') + 1);
+    if (base.empty()) { err = "uri has no basename: " + uri; return false; }
+    std::string dst = sandbox + "/" + base;
+    if (uri.rfind("http://", 0) == 0 || uri.rfind("https://", 0) == 0) {
+      if (uri.rfind("https://", 0) == 0) {
+        err = "https fetch unsupported by tpu-agent (serve artifacts over "
+              "the scheduler's plain-http ArtifactResource): " + uri;
+        return false;
+      }
+      auto resp = tpu::http_get(uri, 60);
+      if (resp.status != 200) {
+        err = "GET " + uri + " -> " + std::to_string(resp.status);
+        return false;
+      }
+      data = resp.body;
+    } else {
+      src = uri.rfind("file://", 0) == 0 ? uri.substr(7) : uri;
+      std::ifstream in(src, std::ios::binary);
+      if (!in) { err = "cannot read " + src; return false; }
+      std::ostringstream ss;
+      ss << in.rdbuf();
+      data = ss.str();
+    }
+    std::ofstream out(dst, std::ios::binary | std::ios::trunc);
+    if (!out) { err = "cannot write " + dst; return false; }
+    out << data;
+    out.close();
+    if (!out) {  // short write (disk full/quota): don't launch against a
+                 // truncated artifact
+      ::unlink(dst.c_str());
+      err = "short write to " + dst;
+      return false;
+    }
+    ::chmod(dst.c_str(), 0755);
+    return true;
+  }
+
   void launch(const Json& task) {
     const std::string task_id = task.get("task_id").as_string();
     const std::string task_name = task.get("task_name").as_string();
@@ -258,6 +307,14 @@ class Agent {
       emit(task_id, task_name, "TASK_FAILED",
            "cannot create sandbox " + sandbox);
       return;
+    }
+
+    for (const auto& uri : task.get("uris").items()) {
+      std::string err;
+      if (!fetch_uri(uri.as_string(), sandbox, err)) {
+        emit(task_id, task_name, "TASK_FAILED", "uri fetch: " + err);
+        return;
+      }
     }
 
     // write config templates for tpu-bootstrap to render (reference:

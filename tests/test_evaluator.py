@@ -179,8 +179,10 @@ class TestGangPlacement:
         assert plan.tpu.num_processes == 2
         assert plan.launches[0].env["JAX_PROCESS_ID"] == "0"
         assert plan.launches[0].env["JAX_NUM_PROCESSES"] == "2"
+        # instance 0 IS the coordinator: its own agent's (routable) hostname
+        # is exported, not a DNS convention name — we ship no DNS tier
         assert plan.launches[0].env["JAX_COORDINATOR_ADDRESS"] == \
-            "worker-0.jax.tpu.local:8476"
+            f"{plan.agent.hostname}:8476"
 
     def test_sibling_pins_slice(self):
         agents = [tpu_agent(1, "s1"), tpu_agent(2, "s1"), tpu_agent(3, "s2"),

@@ -1,0 +1,176 @@
+"""frameworks/jax — north-star service tests.
+
+Simulation tier (reference ServiceTest.java style): every workload YAML
+deploys on synthetic TPU-slice agents; the JAX distributed env contract
+(JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID / JAX_NUM_PROCESSES) lands in
+every task sandbox; killing one gang worker triggers a coordinated gang
+re-form (SURVEY.md §7 hard part (3)).
+
+Workload tier: the actual worker entry point runs tiny shapes on CPU —
+spec-to-training end to end per BASELINE.json configs[2..4].
+"""
+
+import json
+import os
+
+import pytest
+
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.state import TaskState
+from dcos_commons_tpu.testing import Expect, Send, ServiceTestRunner
+from dcos_commons_tpu.testing.simulation import (default_agents,
+                                                 tpu_slice_agents)
+
+from frameworks.jax import scenarios, worker
+
+
+PIN = {"TPU_TOPOLOGY": "v4-32", "WORKER_COUNT": "4", "SHARD_COUNT": "4",
+       "CHIPS_PER_WORKER": "4"}
+
+
+def runner_for(scenario: str, env: dict | None = None,
+               **kwargs) -> ServiceTestRunner:
+    merged = dict(PIN)
+    if env:
+        merged.update(env)
+    spec = scenarios.load_scenario(scenario, merged)
+    kwargs.setdefault("agents", tpu_slice_agents(n=4, chips=4,
+                                                 topology="v4-32"))
+    return ServiceTestRunner(spec=spec, **kwargs)
+
+
+class TestScenariosDeploy:
+    @pytest.mark.parametrize("scenario", scenarios.list_scenarios())
+    def test_deploys(self, scenario):
+        runner_for(scenario).run([
+            Send.until_quiet(),
+            Expect.deployed(),
+        ])
+
+    def test_mnist_single_chip_no_gang(self):
+        # configs[2]: one trainer, one chip, FINISH goal
+        runner = runner_for("mnist")
+        runner.run([
+            Send.until_quiet(),
+            Send.task_status("trainer-0-train", TaskState.FINISHED),
+            Send.until_quiet(),
+            Expect.deployed(),
+        ])
+        launches = runner.cluster.launch_log
+        assert len(launches) == 1
+        (launch,) = launches[0].launches
+        assert launch.env["JAX_NUM_PROCESSES"] == "1"
+
+
+class TestDistributedEnvContract:
+    """The matcher + bootstrap export the jax.distributed bring-up contract
+    (BASELINE.json north star; replaces sdk/bootstrap/main.go env export)."""
+
+    def test_resnet_worker_env(self):
+        runner = runner_for("resnet")
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        launches = {}
+        coordinator_hosts = set()
+        for plan in runner.cluster.launch_log:
+            for launch in plan.launches:
+                launches[launch.task_name] = launch
+                coordinator_hosts.add(launch.env["JAX_COORDINATOR_ADDRESS"])
+        assert sorted(launches) == [
+            f"worker-{i}-train" for i in range(4)]
+        # one coordinator, shared by every worker
+        assert len(coordinator_hosts) == 1
+        ids = sorted(int(t.env["JAX_PROCESS_ID"]) for t in launches.values())
+        assert ids == [0, 1, 2, 3]
+        for launch in launches.values():
+            assert launch.env["JAX_NUM_PROCESSES"] == "4"
+            assert launch.env["POD_INSTANCE_INDEX"] in "0123"
+
+    def test_gang_lands_on_one_slice(self):
+        # two slices available; all four workers must land on one of them
+        agents = (tpu_slice_agents(n=4, chips=4, slice_id="slice-a",
+                                   topology="v4-32")
+                  + [a for a in tpu_slice_agents(n=4, chips=4,
+                                                 slice_id="slice-b",
+                                                 topology="v4-32")])
+        # re-id the second slice's agents to avoid collisions
+        from dataclasses import replace
+        agents = agents[:4] + [
+            replace(a, agent_id=f"b-{i}", hostname=f"bhost-{i}")
+            for i, a in enumerate(agents[4:])]
+        runner = runner_for("resnet", agents=agents)
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        slices = {p.agent.tpu.slice_id for p in runner.cluster.launch_log}
+        assert len(slices) == 1
+
+
+class TestGangRecovery:
+    """One worker death => the failed pod is replaced AND every sibling is
+    restarted in place so jax.distributed re-forms with stable ranks."""
+
+    def test_worker_failure_restarts_gang(self):
+        runner = runner_for("resnet")
+        runner.run([
+            Send.until_quiet(),
+            Expect.deployed(),
+        ])
+        runner.new_launches()  # drain the deploy launches
+        runner.run([
+            Send.task_status("worker-2-train", TaskState.FAILED,
+                             message="host died"),
+            Send.until_quiet(max_cycles=100),
+        ])
+        relaunched = {name.rsplit("-", 1)[0] if name.endswith("-train")
+                      else name for name in runner.new_launches()}
+        # the whole gang relaunched, not just the failed member
+        assert relaunched == {f"worker-{i}" for i in range(4)}
+
+    def test_mnist_failure_is_solo_recovery(self):
+        runner = runner_for("mnist")
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        runner.new_launches()
+        runner.run([
+            Send.task_status("trainer-0-train", TaskState.FAILED),
+            Send.until_quiet(max_cycles=100),
+        ])
+        assert set(runner.new_launches()) == {"trainer-0-train"}
+
+
+class TestWorkerWorkloads:
+    """Run the real task-side entry point on CPU with tiny shapes."""
+
+    def test_mnist_trains_and_checkpoints(self, tmp_path):
+        out = str(tmp_path / "ckpt")
+        rc = worker.main(["mnist", "--steps", "4", "--out", out])
+        assert rc == 0
+        resumed = worker.latest_checkpoint(out)
+        assert resumed is not None and resumed["step"] == 4
+
+    def test_mnist_resumes_from_checkpoint(self, tmp_path, capsys):
+        out = str(tmp_path / "ckpt")
+        worker.main(["mnist", "--steps", "2", "--out", out])
+        capsys.readouterr()
+        worker.main(["mnist", "--steps", "4", "--out", out])
+        events = [json.loads(line)
+                  for line in capsys.readouterr().out.splitlines()]
+        assert any(e.get("event") == "resumed" and e["step"] == 2
+                   for e in events)
+
+    def test_resnet_dp_step(self, tmp_path, capsys):
+        out = str(tmp_path / "ckpt")
+        rc = worker.main(["resnet", "--steps", "1", "--batch", "8",
+                          "--depth", "18", "--out", out])
+        assert rc == 0
+        events = [json.loads(line)
+                  for line in capsys.readouterr().out.splitlines()]
+        done = [e for e in events if e.get("event") == "done"]
+        assert done and done[0]["images_per_sec_per_chip"] > 0
+
+    def test_llama_shard_serves(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        rc = worker.main(["llama", "--preset", "tiny", "--gen-len", "4"])
+        assert rc == 0
+        assert os.path.exists("serving.ready")
+        events = [json.loads(line)
+                  for line in capsys.readouterr().out.splitlines()]
+        done = [e for e in events if e.get("event") == "done"]
+        assert done and done[0]["tokens_per_sec"] > 0
