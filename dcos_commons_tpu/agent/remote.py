@@ -66,6 +66,8 @@ class RemoteCluster:
                     for n, d, t in l.config_templates],
                 "health_check_cmd": l.health_check_cmd,
                 "readiness_check_cmd": l.readiness_check_cmd,
+                "readiness_interval_s": l.readiness_interval_s,
+                "readiness_timeout_s": l.readiness_timeout_s,
                 "uris": list(l.uris),
             } for l in plan.launches]}
         with self._lock:

@@ -79,7 +79,12 @@ class PlanQueries:
         return code, body
 
     def start(self, plan_name: str) -> dict:
+        # idempotent start (reference PlansQueries.java:71-94): a COMPLETE
+        # plan restarts from scratch; an interrupted one proceeds; an
+        # in-progress one is unaffected
         plan = _find_plan(self._scheduler, plan_name)
+        if plan.status is Status.COMPLETE:
+            plan.restart()
         plan.proceed()
         return {"message": f"Started plan {plan_name}"}
 

@@ -61,6 +61,8 @@ class TaskLaunch:
     config_templates: Tuple[Tuple[str, str, str], ...] = ()  # (name, dest, template)
     health_check_cmd: Optional[str] = None
     readiness_check_cmd: Optional[str] = None
+    readiness_interval_s: float = 5.0
+    readiness_timeout_s: float = 10.0
     uris: Tuple[str, ...] = ()  # fetched into the sandbox pre-launch
     # (reference: Mesos fetcher URIs, how sdk/bootstrap reaches the task)
 
@@ -383,6 +385,12 @@ class Evaluator:
             health_check_cmd=task_spec.health_check.cmd if task_spec.health_check else None,
             readiness_check_cmd=(
                 task_spec.readiness_check.cmd if task_spec.readiness_check else None),
+            readiness_interval_s=(
+                task_spec.readiness_check.interval_s
+                if task_spec.readiness_check else 5.0),
+            readiness_timeout_s=(
+                task_spec.readiness_check.timeout_s
+                if task_spec.readiness_check else 10.0),
             uris=tuple(task_spec.uris),
         )
 

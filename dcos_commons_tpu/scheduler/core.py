@@ -73,6 +73,13 @@ class ServiceScheduler:
         # (Mesos agent-reregistration-timeout analogue)
         self.agent_grace_s = agent_grace_s
         self._agent_missing_since: Dict[str, float] = {}
+        # grace before a *live* agent's non-report of a freshly-launched
+        # task counts as lost — the launch command may still be queued for
+        # the agent's next poll (only matters for periodic re-reconciliation)
+        self.launch_report_grace_s = 15.0
+        # first-unreported time per task_id, for tasks with no status yet
+        # (StoredTask carries no launch timestamp of its own)
+        self._unreported_since: Dict[str, float] = {}
         self.namespace = namespace
         self.state = StateStore(persister, namespace)
         self.configs = ConfigStore(persister, namespace)
@@ -137,10 +144,19 @@ class ServiceScheduler:
                 lambda: self.spec, self.state, failure_monitor, self.backoff,
                 recovery_overriders)
             self.decommission_manager = DecommissionPlanManager(self)
-            self.other_managers: List[PlanManager] = [
-                PlanManager(build_plan_from_spec(
-                    self.spec, ps, self.state, self.target_config_id, self.backoff))
-                for ps in self.spec.plans if ps.name not in ("deploy", "update")]
+            # Sidecar plans (anything besides deploy/update) are created
+            # INTERRUPTED and run only when an operator starts them
+            # (reference SchedulerBuilder.java:155
+            # DefaultPlanManager.createInterrupted; cassandra backup/restore)
+            self.other_managers: List[PlanManager] = []
+            for ps in self.spec.plans:
+                if ps.name in ("deploy", "update"):
+                    continue
+                plan = build_plan_from_spec(
+                    self.spec, ps, self.state, self.target_config_id,
+                    self.backoff)
+                plan.interrupt()
+                self.other_managers.append(PlanManager(plan))
             self.coordinator = PlanCoordinator(
                 [self.deploy_manager, self.recovery_manager,
                  self.decommission_manager] + self.other_managers)
@@ -193,14 +209,31 @@ class ServiceScheduler:
                     and not status.state.terminal)
                 if task.task_id in reported:
                     reported.pop(task.task_id)
+                    self._unreported_since.pop(task.task_id, None)
                     continue
                 if not alive_in_store:
+                    self._unreported_since.pop(task.task_id, None)
                     continue
                 if task.agent_id not in live_agents:
                     first = self._agent_missing_since.setdefault(
                         task.agent_id, now)
                     if now - first < self.agent_grace_s:
                         continue  # still within re-registration grace
+                else:
+                    # a live agent not reporting the task: allow the launch
+                    # command one grace window to reach the agent, measured
+                    # from the status timestamp (or from when we first saw
+                    # the task unreported, for statusless launches)
+                    if status is not None and status.timestamp:
+                        fresh = (time.time() - status.timestamp
+                                 < self.launch_report_grace_s)
+                    else:
+                        first = self._unreported_since.setdefault(
+                            task.task_id, now)
+                        fresh = now - first < self.launch_report_grace_s
+                    if fresh:
+                        continue
+                self._unreported_since.pop(task.task_id, None)
                 lost = TaskStatus.now(task.task_id, TaskState.LOST,
                                       message="not reported by any agent")
                 self.handle_status(task.task_name, lost)

@@ -33,9 +33,13 @@ from ..state.tasks import StoredTask, TaskState, TaskStatus
 
 RECOVERY_PLAN_NAME = "recovery"
 
-# hook: (spec, pod_instance, recovery_type) -> Phase, or None to use default
-RecoveryOverrider = Callable[[ServiceSpec, PodInstance, RecoveryType],
-                             Optional[Phase]]
+# hook: (manager, spec, pod_instance, recovery_type) -> Phase, or None to
+# use the default single-pod phase. The manager is passed so overriders can
+# build recovery steps via manager.recovery_step(...) (reference
+# RecoveryPlanOverrider receives the stateStore-backed step factory the same
+# way, e.g. CassandraRecoveryPlanOverrider.java:53-162).
+RecoveryOverrider = Callable[["RecoveryPlanManager", ServiceSpec, PodInstance,
+                              RecoveryType], Optional[Phase]]
 
 
 class FailureMonitor:
@@ -183,7 +187,7 @@ class RecoveryPlanManager(PlanManager):
     def _phase_for(self, spec: ServiceSpec, pod_instance: PodInstance,
                    recovery_type: RecoveryType) -> Optional[Phase]:
         for overrider in self._overriders:
-            phase = overrider(spec, pod_instance, recovery_type)
+            phase = overrider(self, spec, pod_instance, recovery_type)
             if phase is not None:
                 return phase
         pod = pod_instance.pod
@@ -211,6 +215,27 @@ class RecoveryPlanManager(PlanManager):
                 PodInstance(pod, index), RecoveryType.TRANSIENT,
                 name_suffix=":gang-restart"))
         return Phase(f"recover-gang-{failed.name}", steps, SerialStrategy())
+
+    def recovery_step(self, pod_instance: PodInstance,
+                      recovery_type: RecoveryType,
+                      name_suffix: str = "",
+                      task_names: Optional[Sequence[str]] = None
+                      ) -> DeploymentStep:
+        """Public step factory for :data:`RecoveryOverrider` hooks.
+
+        ``task_names`` overrides the default failed-task selection — e.g.
+        the hdfs overrider's two-step bootstrap->node replace phase launches
+        specific tasks per step.
+        """
+        if task_names is not None:
+            names = tuple(task_names)
+            return DeploymentStep(
+                name=f"{pod_instance.name}:[{','.join(names)}]{name_suffix}",
+                requirement=PodInstanceRequirement(
+                    pod_instance, names, recovery_type=recovery_type),
+                backoff=self._backoff,
+                initial_status=Status.PENDING)
+        return self._recovery_step(pod_instance, recovery_type, name_suffix)
 
     def _recovery_step(self, pod_instance: PodInstance,
                        recovery_type: RecoveryType,

@@ -11,6 +11,8 @@ costs deploy latency, not correctness).
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
 import time
 
@@ -19,9 +21,14 @@ class CycleDriver:
     """Drives ``run_cycle()`` on a :class:`ServiceScheduler` or
     :class:`MultiServiceScheduler` from a background thread."""
 
-    def __init__(self, scheduler, interval_s: float = 1.0):
+    def __init__(self, scheduler, interval_s: float = 1.0,
+                 reconcile_interval_s: float = 30.0):
         self.scheduler = scheduler
         self.interval_s = interval_s
+        # periodic implicit reconciliation (reference ImplicitReconciler):
+        # catches an agent that restarted without its tasks — same agent id,
+        # empty running set — which boot-time reconciliation can't see
+        self.reconcile_interval_s = reconcile_interval_s
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._wake = threading.Event()
@@ -37,8 +44,22 @@ class CycleDriver:
         self._wake.set()
 
     def _loop(self) -> None:
+        last_reconcile = time.monotonic()
         while not self._stop.is_set():
-            self.scheduler.run_cycle()
+            # crash-don't-corrupt (reference FrameworkScheduler.java:101-104
+            # + ProcessExit): an exception in the scheduling loop must kill
+            # the process loudly, never leave a silently-dead thread behind
+            # a live API server
+            try:
+                self.scheduler.run_cycle()
+                if (time.monotonic() - last_reconcile
+                        >= self.reconcile_interval_s):
+                    last_reconcile = time.monotonic()
+                    self.scheduler.reconcile()
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "fatal error in scheduler cycle; exiting")
+                os._exit(1)
             self._wake.wait(self.interval_s)
             self._wake.clear()
 

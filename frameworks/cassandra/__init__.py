@@ -1,0 +1,10 @@
+"""frameworks/cassandra — production-grade stateful-service example.
+
+Parity with the reference's cassandra framework (``frameworks/cassandra``,
+``svc.yml`` 621 lines): shared resource-sets (sidecar tasks reuse the node's
+reservation), on-demand sidecar plans (backup/restore), persistent data
+volumes, replacement-failure-policy, and a seed-aware recovery overrider
+(``CassandraRecoveryPlanOverrider.java:38-162``): replacing a seed node
+triggers a rolling restart of the other nodes so they learn the new seed
+address.
+"""

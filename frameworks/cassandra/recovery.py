@@ -1,0 +1,39 @@
+"""Seed-aware recovery, the reference's
+``CassandraRecoveryPlanOverrider.java:38-162``: when a *seed* node (instance
+index < SEED_COUNT) is permanently replaced, every other node must be
+restarted (rolling, serial) so its seed list picks up the replacement's new
+address. Non-seed replacement and transient failures use the default
+single-pod recovery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dcos_commons_tpu.plan import Phase, SerialStrategy
+from dcos_commons_tpu.plan.requirement import RecoveryType
+from dcos_commons_tpu.specification import PodInstance, ServiceSpec
+
+
+def seed_recovery_overrider(seed_count: int):
+    """Build a RecoveryOverrider closing over the seed count."""
+
+    def overrider(manager, spec: ServiceSpec, pod_instance: PodInstance,
+                  recovery_type: RecoveryType) -> Optional[Phase]:
+        if pod_instance.pod.type != "node":
+            return None
+        if recovery_type is not RecoveryType.PERMANENT:
+            return None
+        if pod_instance.index >= seed_count:
+            return None  # non-seed: default recovery
+        steps = [manager.recovery_step(pod_instance, RecoveryType.PERMANENT)]
+        for index in range(pod_instance.pod.count):
+            if index == pod_instance.index:
+                continue
+            steps.append(manager.recovery_step(
+                PodInstance(pod_instance.pod, index), RecoveryType.TRANSIENT,
+                name_suffix=":seed-change-restart"))
+        return Phase(f"recover-seed-{pod_instance.name}", steps,
+                     SerialStrategy())
+
+    return overrider

@@ -1,0 +1,9 @@
+"""frameworks/hdfs — multi-pod-type example with a custom deploy plan DSL.
+
+Parity with the reference's hdfs framework (``frameworks/hdfs``, svc.yml
+600+ lines): three pod types (journal/name/data), a YAML ``plans:`` deploy
+DSL with per-step task lists (format-then-start ordering, reference
+``svc.yml:566-596``), and a recovery overrider where replacing a journal or
+name node is a two-step bootstrap+start phase
+(``HdfsRecoveryPlanOverrider.java:25-81``).
+"""

@@ -1,0 +1,91 @@
+"""hdfs scheduler entry point (reference ``frameworks/hdfs/.../Main.java``)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+from typing import Mapping, Optional
+
+from dcos_commons_tpu.agent.remote import RemoteCluster
+from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
+from dcos_commons_tpu.scheduler import ServiceScheduler
+from dcos_commons_tpu.scheduler.runner import CycleDriver
+from dcos_commons_tpu.specification import ServiceSpec, load_service_yaml
+from dcos_commons_tpu.state import FilePersister
+
+from .recovery import hdfs_recovery_overrider
+
+DIST = os.path.join(os.path.dirname(__file__), "dist")
+
+DEFAULT_ENV: Mapping[str, str] = {
+    "FRAMEWORK_NAME": "hdfs",
+    "SERVICE_NAME": "hdfs",
+    "JOURNAL_COUNT": "3",
+    "DATA_COUNT": "3",
+    "JOURNAL_CPUS": "1",
+    "JOURNAL_MEM": "2048",
+    "JOURNAL_DISK": "5120",
+    "NAME_CPUS": "1",
+    "NAME_MEM": "4096",
+    "NAME_DISK": "5120",
+    "DATA_CPUS": "1",
+    "DATA_MEM": "4096",
+    "DATA_DISK": "10240",
+    "SLEEP_DURATION": "1000",
+}
+
+
+def load_spec(env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
+    merged = dict(DEFAULT_ENV)
+    merged.update(os.environ)
+    if env:
+        merged.update(env)
+    return load_service_yaml(os.path.join(DIST, "svc.yml"), merged)
+
+
+def build_scheduler(persister, cluster, env=None, **kwargs):
+    spec = load_spec(env)
+    return ServiceScheduler(
+        spec, persister, cluster,
+        recovery_overriders=[hdfs_recovery_overrider], **kwargs)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get("API_PORT", "8080")))
+    p.add_argument("--state", default=os.environ.get("STATE_DIR", "./state"))
+    p.add_argument("--interval", type=float, default=1.0)
+    args = p.parse_args(argv)
+
+    metrics = MetricsRegistry()
+    persister = FilePersister(args.state)
+    cluster = RemoteCluster()
+    scheduler = build_scheduler(persister, cluster, metrics=metrics)
+    server = ApiServer(scheduler, port=args.port, metrics=metrics,
+                       cluster=cluster)
+    PlanReporter(metrics, scheduler)
+    driver = CycleDriver(scheduler, interval_s=args.interval)
+    server.start()
+    print(f"hdfs scheduler API on http://127.0.0.1:{server.port}/v1/",
+          flush=True)
+    try:
+        with driver:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

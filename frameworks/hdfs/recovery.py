@@ -1,0 +1,33 @@
+"""HDFS recovery overrider (reference
+``HdfsRecoveryPlanOverrider.java:25-81``): permanently replacing a *name*
+node is not a plain relaunch — the fresh node must first re-sync metadata
+(``bootstrapStandby``) before serving, so the phase is a serial two-step
+bootstrap -> node. Journal nodes likewise re-sync from the quorum. Data
+nodes use default recovery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dcos_commons_tpu.plan import Phase, SerialStrategy
+from dcos_commons_tpu.plan.requirement import RecoveryType
+from dcos_commons_tpu.specification import PodInstance, ServiceSpec
+
+
+def hdfs_recovery_overrider(manager, spec: ServiceSpec,
+                            pod_instance: PodInstance,
+                            recovery_type: RecoveryType) -> Optional[Phase]:
+    if recovery_type is not RecoveryType.PERMANENT:
+        return None
+    if pod_instance.pod.type not in ("name", "journal"):
+        return None
+    # two-step: re-sync first (PERMANENT => fresh placement + reservation),
+    # then the server in place on that new reservation
+    bootstrap = manager.recovery_step(pod_instance, RecoveryType.PERMANENT,
+                                      name_suffix=":bootstrap",
+                                      task_names=("bootstrap",))
+    node = manager.recovery_step(pod_instance, RecoveryType.TRANSIENT,
+                                 name_suffix=":node", task_names=("node",))
+    return Phase(f"recover-{pod_instance.name}", [bootstrap, node],
+                 SerialStrategy())

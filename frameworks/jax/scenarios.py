@@ -48,8 +48,13 @@ def scenario_env(overrides: Optional[Mapping[str, str]] = None) -> dict:
     return env
 
 
+# the default service is the north-star data-parallel trainer
+ALIASES = {"svc": "resnet"}
+
+
 def load_scenario(name: str = "svc",
                   env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
+    name = ALIASES.get(name, name)
     path = os.path.join(DIST, f"{name}.yml")
     if not os.path.exists(path):
         raise FileNotFoundError(
@@ -58,4 +63,5 @@ def load_scenario(name: str = "svc",
 
 
 def list_scenarios() -> list[str]:
-    return sorted(f[:-4] for f in os.listdir(DIST) if f.endswith(".yml"))
+    return sorted({f[:-4] for f in os.listdir(DIST) if f.endswith(".yml")}
+                  | set(ALIASES))

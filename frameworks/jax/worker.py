@@ -242,9 +242,16 @@ def run_llama(args) -> dict:
         os.makedirs(args.out, exist_ok=True)
     with open("serving.ready", "w") as f:
         f.write("ok\n")
-    return {"workload": "llama", "preset": args.preset,
-            "tokens_per_sec": round(gen_len / dt, 2),
-            "tp": n, "process_id": contract["process_id"]}
+    result = {"workload": "llama", "preset": args.preset,
+              "tokens_per_sec": round(gen_len / dt, 2),
+              "tp": n, "process_id": contract["process_id"]}
+    if args.serve:
+        # goal RUNNING: block and keep serving — exiting would read as a
+        # task failure and trigger a gang re-form loop
+        _emit({"event": "serving", **result})
+        while True:
+            time.sleep(60)
+    return result
 
 
 WORKLOADS = {"mnist": run_mnist, "resnet": run_resnet, "llama": run_llama}
@@ -259,6 +266,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resnet depth (18 for CPU smoke tests)")
     p.add_argument("--preset", default="tiny", choices=["tiny", "8b"])
     p.add_argument("--gen-len", type=int, default=16)
+    p.add_argument("--serve", action="store_true",
+                   help="llama: block after warmup (RUNNING-goal tasks)")
     p.add_argument("--out", default="")
     return p
 

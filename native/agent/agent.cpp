@@ -56,6 +56,16 @@ struct RunningTask {
   std::string goal;               // RUNNING | ONCE | FINISH
   pid_t readiness_pid = -1;       // readiness-check process, if any
   bool readiness_reported = false;
+  // readiness is retried every readiness_interval until it passes; a probe
+  // running longer than readiness_timeout is killed and retried
+  // (reference ReadinessCheckSpec interval/timeout semantics)
+  std::string readiness_cmd;
+  std::string sandbox;
+  std::map<std::string, std::string> env;
+  double readiness_next_try = 0;
+  double readiness_interval = 5;
+  double readiness_timeout = 10;
+  double readiness_deadline = 0;  // when the in-flight probe times out
   bool kill_requested = false;
   double sigkill_deadline = 0;    // when to escalate SIGTERM -> SIGKILL
 };
@@ -129,6 +139,7 @@ class Agent {
       ++polls;
       reap_children();
       escalate_kills();
+      retry_readiness();
       if (!poll_once()) {
         // scheduler asked us to re-register (restarted / expired us)
         if (!register_with_retry()) return 1;
@@ -368,25 +379,51 @@ class Agent {
     rt.task_name = task_name;
     rt.pid = pid;
     rt.goal = task.get("goal").as_string();
+    rt.sandbox = sandbox;
+    rt.readiness_cmd = task.get("readiness_check_cmd").as_string();
+    rt.readiness_interval = task.get("readiness_interval_s").as_number(5);
+    rt.readiness_timeout = task.get("readiness_timeout_s").as_number(10);
+    for (const auto& [k, v] : task.get("env").fields()) {
+      rt.env[k] = v.as_string();
+    }
+    rt.readiness_reported = rt.readiness_cmd.empty();
     tasks_[task_id] = rt;
     emit(task_id, task_name, "TASK_RUNNING", "started pid " +
                                                  std::to_string(pid));
+    spawn_readiness(tasks_[task_id]);
+  }
 
-    const std::string readiness = task.get("readiness_check_cmd").as_string();
-    if (!readiness.empty()) {
-      pid_t rp = fork();
-      if (rp == 0) {
-        setpgid(0, 0);
-        if (chdir(sandbox.c_str()) != 0) _exit(126);
-        for (const auto& [k, v] : task.get("env").fields()) {
-          setenv(k.c_str(), v.as_string().c_str(), 1);
-        }
-        execl("/bin/sh", "sh", "-c", readiness.c_str(), (char*)nullptr);
-        _exit(127);
+  void spawn_readiness(RunningTask& t) {
+    if (t.readiness_reported || t.readiness_cmd.empty() ||
+        t.readiness_pid > 0 || t.kill_requested) {
+      return;
+    }
+    pid_t rp = fork();
+    if (rp == 0) {
+      setpgid(0, 0);
+      if (chdir(t.sandbox.c_str()) != 0) _exit(126);
+      for (const auto& [k, v] : t.env) {
+        setenv(k.c_str(), v.c_str(), 1);
       }
-      tasks_[task_id].readiness_pid = rp;
-    } else {
-      tasks_[task_id].readiness_reported = true;
+      execl("/bin/sh", "sh", "-c", t.readiness_cmd.c_str(), (char*)nullptr);
+      _exit(127);
+    }
+    t.readiness_pid = rp;
+    t.readiness_deadline = now_s() + t.readiness_timeout;
+  }
+
+  // retry readiness probes that failed, and kill probes that hang past
+  // their timeout (reference ReadinessCheckSpec interval/timeout: the
+  // check repeats until it first passes)
+  void retry_readiness() {
+    double now = now_s();
+    for (auto& [task_id, t] : tasks_) {
+      if (t.readiness_reported) continue;
+      if (t.readiness_pid > 0 && now >= t.readiness_deadline) {
+        ::kill(-t.readiness_pid, SIGKILL);  // reap marks the retry time
+      } else if (t.readiness_pid < 0 && now >= t.readiness_next_try) {
+        spawn_readiness(t);
+      }
     }
   }
 
@@ -427,6 +464,8 @@ class Agent {
             t.readiness_reported = true;
             emit(t.task_id, t.task_name, "TASK_RUNNING", "readiness passed",
                  /*readiness=*/true);
+          } else if (!t.readiness_reported) {
+            t.readiness_next_try = now_s() + t.readiness_interval;
           }
           break;
         }

@@ -1,0 +1,139 @@
+"""frameworks/cassandra — stateful-service parity tests.
+
+Mirrors the reference cassandra framework's distinguishing features
+(``frameworks/cassandra``): shared-reservation sidecars, on-demand
+backup/restore plans, persistent volumes pinning nodes, and the seed-aware
+recovery overrider (``CassandraRecoveryPlanOverrider.java:38-162``).
+"""
+
+from dcos_commons_tpu.agent.inventory import AgentInfo, PortRange
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.state import TaskState
+from dcos_commons_tpu.testing import Expect, Send, ServiceTestRunner
+
+from frameworks.cassandra import main as cass_main
+from frameworks.cassandra.recovery import seed_recovery_overrider
+
+
+def agents(n: int = 5):
+    # wide port range: the service uses the classic fixed ports (9042/7000)
+    return [AgentInfo(agent_id=f"agent-{i}", hostname=f"host-{i}", cpus=8,
+                      memory_mb=16384, disk_mb=65536,
+                      ports=(PortRange(1025, 32000),))
+            for i in range(n)]
+
+
+def runner_for(env: dict | None = None, n_agents: int = 5,
+               seed_count: int = 2) -> ServiceTestRunner:
+    merged = dict(cass_main.DEFAULT_ENV)
+    if env:
+        merged.update(env)
+    spec = cass_main.load_spec(merged)
+    return ServiceTestRunner(
+        spec=spec, agents=agents(n_agents),
+        recovery_overriders=[seed_recovery_overrider(seed_count)])
+
+
+class TestDeploy:
+    def test_three_nodes_deploy_serially(self):
+        runner = runner_for()
+        runner.run([
+            Send.until_quiet(),
+            Expect.deployed(),
+            Expect.known_tasks("node-0-server", "node-1-server",
+                               "node-2-server"),
+        ])
+        # each node holds a persistent data volume => pinned reservations
+        assert sorted(r.pod_instance_name
+                      for r in runner.scheduler.ledger.all()) == [
+            "node-0", "node-1", "node-2"]
+
+    def test_sidecars_do_not_deploy_by_default(self):
+        runner = runner_for()
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        assert runner.scheduler.state.fetch_task("node-0-backup") is None
+
+
+class TestSidecarPlans:
+    def test_backup_plan_runs_on_demand(self):
+        runner = runner_for()
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        sched = runner.scheduler
+        # dormant until started (reference createInterrupted semantics)
+        assert sched.state.fetch_task("node-0-backup") is None
+        runner.run([Send.plan_proceed("backup"), Send.until_quiet()])
+        for i in range(3):
+            assert sched.state.fetch_status(f"node-{i}-backup").state \
+                is TaskState.FINISHED
+        assert sched.plan("backup").status is Status.COMPLETE
+        # servers kept running throughout
+        for i in range(3):
+            assert sched.state.fetch_status(f"node-{i}-server").state \
+                is TaskState.RUNNING
+
+    def test_restore_plan_runs_on_demand(self):
+        runner = runner_for()
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        sched = runner.scheduler
+        runner.run([Send.plan_proceed("restore"), Send.until_quiet()])
+        assert sched.plan("restore").status is Status.COMPLETE
+
+
+class TestSeedRecovery:
+    def test_seed_replace_triggers_rolling_restart(self):
+        runner = runner_for()
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        runner.new_launches()
+        before_ids = {
+            f"node-{i}-server":
+            runner.scheduler.state.fetch_task(f"node-{i}-server").task_id
+            for i in range(3)}
+        runner.run([
+            Send.pod_replace("node-0"),
+            Send.until_quiet(max_cycles=100),
+        ])
+        sched = runner.scheduler
+        after_ids = {
+            f"node-{i}-server":
+            sched.state.fetch_task(f"node-{i}-server").task_id
+            for i in range(3)}
+        # every node restarted: node-0 replaced, others seed-change-restarted
+        for name in before_ids:
+            assert after_ids[name] != before_ids[name], name
+        for i in range(3):
+            assert sched.state.fetch_status(f"node-{i}-server").state \
+                is TaskState.RUNNING
+
+    def test_non_seed_replace_is_isolated(self):
+        runner = runner_for()
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        before_ids = {
+            f"node-{i}-server":
+            runner.scheduler.state.fetch_task(f"node-{i}-server").task_id
+            for i in range(3)}
+        runner.run([
+            Send.pod_replace("node-2"),
+            Send.until_quiet(max_cycles=100),
+        ])
+        sched = runner.scheduler
+        assert sched.state.fetch_task("node-2-server").task_id \
+            != before_ids["node-2-server"]
+        for i in (0, 1):  # seeds untouched
+            assert sched.state.fetch_task(f"node-{i}-server").task_id \
+                == before_ids[f"node-{i}-server"]
+
+    def test_transient_failure_uses_default_recovery(self):
+        runner = runner_for()
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        before_node1 = runner.scheduler.state.fetch_task(
+            "node-1-server")
+        runner.run([
+            Send.task_status("node-0-server", TaskState.FAILED),
+            Send.until_quiet(max_cycles=100),
+        ])
+        sched = runner.scheduler
+        # node-0 relaunched in place (volume pins it); node-1 untouched
+        assert sched.state.fetch_status("node-0-server").state \
+            is TaskState.RUNNING
+        assert sched.state.fetch_task("node-1-server").task_id \
+            == before_node1.task_id

@@ -1,0 +1,96 @@
+"""frameworks/hdfs — multi-pod-type parity tests.
+
+Mirrors the reference hdfs framework (``frameworks/hdfs``): custom YAML
+deploy plan with per-step task lists (format-then-start ordering,
+``svc.yml:566-596``) and the two-step bootstrap->node replace recovery
+(``HdfsRecoveryPlanOverrider.java:25-81``).
+"""
+
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.state import TaskState
+from dcos_commons_tpu.testing import Expect, Send, ServiceTestRunner
+from dcos_commons_tpu.testing.simulation import default_agents
+
+from frameworks.hdfs import main as hdfs_main
+from frameworks.hdfs.recovery import hdfs_recovery_overrider
+
+
+def runner_for(env: dict | None = None, n_agents: int = 8
+               ) -> ServiceTestRunner:
+    spec = hdfs_main.load_spec(env)
+    return ServiceTestRunner(
+        spec=spec, agents=default_agents(n_agents),
+        recovery_overriders=[hdfs_recovery_overrider])
+
+
+class TestDeploy:
+    def test_full_deploy_order(self):
+        runner = runner_for()
+        sched = runner.scheduler
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        # every pod type landed
+        for name in ("journal-0-node", "journal-1-node", "journal-2-node",
+                     "name-0-node", "name-1-node",
+                     "data-0-node", "data-1-node", "data-2-node"):
+            assert sched.state.fetch_status(name).state is TaskState.RUNNING
+        # plan DSL ordering: name-0 ran format, name-1 ran bootstrapStandby
+        assert sched.state.fetch_status("name-0-format").state \
+            is TaskState.FINISHED
+        assert sched.state.fetch_status("name-1-bootstrap").state \
+            is TaskState.FINISHED
+        # name-1 never runs format; name-0 never runs bootstrap during deploy
+        assert sched.state.fetch_task("name-1-format") is None
+        assert sched.state.fetch_task("name-0-bootstrap") is None
+
+    def test_deploy_plan_shape_follows_yaml_dsl(self):
+        runner = runner_for()
+        plan = runner.scheduler.plan("deploy")
+        assert [p.name for p in plan.phases] == ["journal", "name", "data"]
+        name_phase = plan.phases[1]
+        assert [s.name for s in name_phase.steps] == [
+            "name-0:[format]", "name-0:[node]",
+            "name-1:[bootstrap]", "name-1:[node]"]
+
+
+class TestReplaceRecovery:
+    def test_name_node_replace_is_two_step(self):
+        runner = runner_for()
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        sched = runner.scheduler
+        runner.run([
+            Send.pod_replace("name-0"),
+            Send.until_quiet(max_cycles=120),
+        ])
+        # the replacement re-ran bootstrap before starting the server
+        assert sched.state.fetch_status("name-0-bootstrap").state \
+            is TaskState.FINISHED
+        assert sched.state.fetch_status("name-0-node").state \
+            is TaskState.RUNNING
+
+    def test_journal_replace_is_two_step(self):
+        runner = runner_for()
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        sched = runner.scheduler
+        runner.run([
+            Send.pod_replace("journal-1"),
+            Send.until_quiet(max_cycles=120),
+        ])
+        assert sched.state.fetch_status("journal-1-bootstrap").state \
+            is TaskState.FINISHED
+        assert sched.state.fetch_status("journal-1-node").state \
+            is TaskState.RUNNING
+
+    def test_data_node_replace_uses_default_recovery(self):
+        runner = runner_for()
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        sched = runner.scheduler
+        old_id = sched.state.fetch_task("data-0-node").task_id
+        runner.run([
+            Send.pod_replace("data-0"),
+            Send.until_quiet(max_cycles=120),
+        ])
+        assert sched.state.fetch_task("data-0-node").task_id != old_id
+        assert sched.state.fetch_status("data-0-node").state \
+            is TaskState.RUNNING
+        # no bootstrap re-run for data nodes
+        assert sched.state.fetch_task("data-0-bootstrap") is None

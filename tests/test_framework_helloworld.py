@@ -232,10 +232,9 @@ class TestFeatureScenarios:
         sched = runner.scheduler
         sidecar = sched.plan("sidecar")
         assert sidecar is not None
-        mgr = next(m for m in sched.coordinator.managers
-                   if m.plan.name == "sidecar")
-        mgr.plan.restart()  # start the sidecar run
-        runner.run([Send.until_quiet()])
+        # dormant until started (reference createInterrupted semantics)
+        assert sched.state.fetch_task("hello-0-side") is None
+        runner.run([Send.plan_proceed("sidecar"), Send.until_quiet()])
         assert sched.state.fetch_status("hello-0-side").state \
             is TaskState.FINISHED
         assert sidecar.status is Status.COMPLETE
