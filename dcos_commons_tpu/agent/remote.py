@@ -69,6 +69,7 @@ class RemoteCluster:
                 "readiness_interval_s": l.readiness_interval_s,
                 "readiness_timeout_s": l.readiness_timeout_s,
                 "uris": list(l.uris),
+                "files": [{"dest": d, "content_b64": c} for d, c in l.files],
             } for l in plan.launches]}
         with self._lock:
             self._queues.setdefault(plan.agent.agent_id, []).append(command)

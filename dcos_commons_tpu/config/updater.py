@@ -108,12 +108,129 @@ def tpu_cannot_change(old, new):
     return errs
 
 
+def service_name_dns_safe(old, new):
+    """Reference ``ServiceNameCannotBreakDNS``: the service name (slashes
+    removed) becomes a DNS subdomain and must fit in a 63-char label with
+    DNS-safe characters. Enforced on new deployments only (an upgrade of an
+    oversized legacy name is allowed, reference behavior)."""
+    if old is not None:
+        return []
+    flat = new.name.replace("/", "")
+    if len(flat) > 63:
+        return [f"service name {new.name!r} exceeds 63 chars without "
+                "slashes; its DNS subdomain would be truncated"]
+    return []
+
+
+def network_regime_cannot_change(old, new):
+    """Reference ``PodSpecsCannotChangeNetworkRegime``: moving a pod between
+    host and overlay networking changes its reachable addresses; tasks with
+    reserved resources would strand."""
+    errs = []
+    old_pods = _pods_by_type(old)
+    for pod in new.pods:
+        prev = old_pods.get(pod.type)
+        if prev is None:
+            continue
+        if bool(prev.networks) != bool(pod.networks):
+            errs.append(
+                f"pod {pod.type}: cannot move between host and overlay "
+                f"networking ({list(prev.networks)} -> {list(pod.networks)})")
+    return errs
+
+
+def pre_reservation_cannot_change(old, new):
+    """Reference ``PreReservationCannotChange``: the role resources were
+    reserved under is immutable per pod."""
+    errs = []
+    old_pods = _pods_by_type(old)
+    for pod in new.pods:
+        prev = old_pods.get(pod.type)
+        if prev is not None and prev.pre_reserved_role != pod.pre_reserved_role:
+            errs.append(f"pod {pod.type}: pre-reserved-role cannot change "
+                        f"({prev.pre_reserved_role!r} -> "
+                        f"{pod.pre_reserved_role!r})")
+    return errs
+
+
+def placement_rules_valid(old, new):
+    """Reference ``PlacementRuleIsValid``/``InvalidPlacementRule``: a rule
+    that failed to parse (e.g. a malformed marathon constraint kept as an
+    InvalidPlacementRule marker) blocks rollout with a clear error instead
+    of silently never matching."""
+    errs = []
+    for pod in new.pods:
+        rule = pod.placement_rule
+        if rule is None:
+            continue
+        problems = rule.invalid_reasons()
+        errs.extend(f"pod {pod.type}: invalid placement rule: {p}"
+                    for p in problems)
+    return errs
+
+
+def zone_placement_cannot_change(old, new):
+    """Reference ``ZoneValidator`` (wired per-framework for cassandra/hdfs):
+    toggling zone-aware placement for a pod with persistent volumes would
+    silently re-interpret where its data may live."""
+    errs = []
+    old_pods = _pods_by_type(old)
+    for pod in new.pods:
+        prev = old_pods.get(pod.type)
+        if prev is None:
+            continue
+        has_volumes = any(rs.volumes for rs in pod.resource_sets)
+        if not has_volumes:
+            continue
+        prev_zone = prev.placement_rule is not None and \
+            prev.placement_rule.references_zones()
+        new_zone = pod.placement_rule is not None and \
+            pod.placement_rule.references_zones()
+        if prev_zone != new_zone:
+            errs.append(
+                f"pod {pod.type}: cannot toggle zone-aware placement on a "
+                f"pod with persistent volumes")
+    return errs
+
+
+def task_env_cannot_change(pod_type: str, task_name: str, env_name: str
+                           ) -> ConfigValidator:
+    """Reference ``TaskEnvCannotChange``: factory for a validator pinning
+    one env var of one task (e.g. cassandra's cluster name) across updates."""
+
+    def validator(old, new):
+        if old is None:
+            return []
+        old_pod = _pods_by_type(old).get(pod_type)
+        new_pod = _pods_by_type(new).get(pod_type)
+        if old_pod is None or new_pod is None:
+            return []
+        try:
+            old_task = old_pod.task(task_name)
+            new_task = new_pod.task(task_name)
+        except (KeyError, StopIteration):
+            return []
+        old_val = old_task.env.get(env_name)
+        new_val = new_task.env.get(env_name)
+        if old_val != new_val:
+            return [f"pod {pod_type}/task {task_name}: env {env_name} "
+                    f"cannot change ({old_val!r} -> {new_val!r})"]
+        return []
+
+    return validator
+
+
 DEFAULT_VALIDATORS: tuple[ConfigValidator, ...] = (
     service_name_cannot_change,
+    service_name_dns_safe,
     user_cannot_change,
     pods_cannot_shrink,
     volumes_cannot_change,
     tpu_cannot_change,
+    network_regime_cannot_change,
+    pre_reservation_cannot_change,
+    placement_rules_valid,
+    zone_placement_cannot_change,
 )
 
 

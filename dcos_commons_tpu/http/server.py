@@ -97,6 +97,24 @@ class _Routes:
         add("GET", r"configurations/target", lambda m, p, b: configs.target())
         add("GET", r"configurations/([^/]+)", lambda m, p, b: configs.get(m[0]))
 
+        # secrets (reference: DC/OS secrets service + SecretsClient; here
+        # the scheduler owns them — names only on list, values write-only)
+        def secrets_store():
+            store = getattr(scheduler, "secrets", None)
+            if store is None:
+                raise ApiError(404, "secrets store unavailable")
+            return store
+
+        add("GET", r"secrets", lambda m, p, b: secrets_store().list())
+        add("PUT", r"secrets/(.+)",
+            lambda m, p, b: (secrets_store().put(m[0], b or b""),
+                             {"message": f"stored secret {m[0]}"})[1])
+        add("DELETE", r"secrets/(.+)",
+            lambda m, p, b: (
+                {"message": f"deleted secret {m[0]}"}
+                if secrets_store().delete(m[0])
+                else (404, {"error": f"no secret {m[0]}"})))
+
         # debug
         add("GET", r"debug/offers", lambda m, p, b: debug.offers())
         add("GET", r"debug/plans", lambda m, p, b: debug.plans())

@@ -21,6 +21,8 @@ runs over candidate agents. Two passes the reference never had:
 
 from __future__ import annotations
 
+import base64
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -31,6 +33,8 @@ from ..state.tasks import TpuAssignment
 from ..utils.ids import make_task_id, new_uuid
 from .ledger import Availability, Reservation, ReservationLedger, VolumeReservation
 from .outcome import EvaluationOutcome, OutcomeNode
+
+log = logging.getLogger(__name__)
 
 JAX_COORDINATOR_PORT = 8476
 ENV_TASK_NAME = "TASK_NAME"
@@ -65,6 +69,12 @@ class TaskLaunch:
     readiness_timeout_s: float = 10.0
     uris: Tuple[str, ...] = ()  # fetched into the sandbox pre-launch
     # (reference: Mesos fetcher URIs, how sdk/bootstrap reaches the task)
+    # raw sandbox files as (dest, base64-content): TLS artifacts and secret
+    # files — written verbatim by the agent, never mustache-rendered and
+    # never persisted in the task record (reference: Mesos secret volumes)
+    files: Tuple[Tuple[str, str], ...] = ()
+    # env keys whose values are secrets: redacted from the stored record
+    secret_env_keys: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -85,9 +95,14 @@ class LaunchPlan:
 class Evaluator:
     """Matches one PodInstanceRequirement against the agent inventory."""
 
-    def __init__(self, service_name: str, outcome_tracker=None):
+    def __init__(self, service_name: str, outcome_tracker=None,
+                 tls_provisioner=None, secrets_store=None):
         self._service_name = service_name
         self._tracker = outcome_tracker
+        # reference TLSEvaluationStage + Mesos secret volumes: both inject
+        # per-task artifacts during launch construction
+        self._tls = tls_provisioner
+        self._secrets = secrets_store
 
     def evaluate(self, requirement: PodInstanceRequirement,
                  agents: Sequence[AgentInfo], tasks: Sequence[TaskRecord],
@@ -371,6 +386,37 @@ class Evaluator:
         if agent.region:
             env["REGION"] = agent.region
 
+        # security artifacts ride the raw-file channel (written verbatim by
+        # the agent pre-launch; config templates would mustache-render — a
+        # secret or key containing '{{' must not be interpreted): TLS
+        # certs/keys from the scheduler CA (reference TLSEvaluationStage),
+        # secrets as env and/or files (reference Mesos secret volumes)
+        raw_files: List[Tuple[str, str]] = []
+        secret_env_keys: List[str] = []
+        if self._tls is not None and task_spec.transport_encryption:
+            for _, dest, content in self._tls.artifacts_for(
+                    requirement.pod_instance.name, task_name,
+                    [te.name for te in task_spec.transport_encryption]):
+                raw_files.append((dest, base64.b64encode(
+                    content.encode()).decode()))
+        if self._secrets is not None:
+            for sec in pod.secrets:
+                value = self._secrets.get(sec.secret_path)
+                if value is None:
+                    continue  # absent secret: task sees no injection
+                if sec.env_key:
+                    try:
+                        env[sec.env_key] = value.decode()
+                        secret_env_keys.append(sec.env_key)
+                    except UnicodeDecodeError:
+                        log.warning(
+                            "secret %s is not UTF-8; skipping env injection "
+                            "into %s (deliver binary secrets via file:)",
+                            sec.secret_path, sec.env_key)
+                if sec.file_path:
+                    raw_files.append(
+                        (sec.file_path, base64.b64encode(value).decode()))
+
         return TaskLaunch(
             task_name=task_name,
             task_id=make_task_id(task_name),
@@ -381,7 +427,10 @@ class Evaluator:
             goal=task_spec.goal.value,
             essential=task_spec.essential,
             config_templates=tuple(
-                (c.name, c.relative_path, c.template) for c in task_spec.configs),
+                (c.name, c.relative_path, c.template)
+                for c in task_spec.configs),
+            files=tuple(raw_files),
+            secret_env_keys=tuple(secret_env_keys),
             health_check_cmd=task_spec.health_check.cmd if task_spec.health_check else None,
             readiness_check_cmd=(
                 task_spec.readiness_check.cmd if task_spec.readiness_check else None),

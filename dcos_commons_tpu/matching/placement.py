@@ -66,6 +66,27 @@ class PlacementRule:
     def to_dict(self) -> dict[str, Any]:
         raise NotImplementedError
 
+    def invalid_reasons(self) -> list[str]:
+        """Problems blocking rollout (reference ``PlacementRuleIsValid`` +
+        ``InvalidPlacementRule``): parse-failure markers plus any
+        uncompilable matcher regex carried by this rule."""
+        children = getattr(self, "rules", None) or \
+            ((self.rule,) if hasattr(self, "rule") else ())
+        out = [r for c in children for r in c.invalid_reasons()]
+        matcher = getattr(self, "matcher", None)
+        if isinstance(matcher, StringMatcher):
+            out.extend(matcher.problems())
+        return out
+
+    def references_zones(self) -> bool:
+        """Whether zone-aware placement is in play (reference
+        ``ZoneValidator``/``PlacementUtils.placementRuleReferencesZone``)."""
+        children = getattr(self, "rules", None) or \
+            ((self.rule,) if hasattr(self, "rule") else ())
+        if any(c.references_zones() for c in children):
+            return True
+        return "zone" in self.type or getattr(self, "by", None) == "zone"
+
 
 _REGISTRY: dict[str, Callable[[Mapping[str, Any]], PlacementRule]] = {}
 
@@ -113,13 +134,30 @@ class StringMatcher:
         if self.kind == "exact":
             return s == self.value
         if self.kind == "regex":
-            return re.fullmatch(self.value, s) is not None
+            try:
+                return re.fullmatch(self.value, s) is not None
+            except re.error:
+                # surfaced to operators via invalid_reasons/config
+                # validation; an invalid rule matches nothing
+                return False
         if self.kind == "glob":
             return fnmatch.fnmatch(s, self.value)
         raise ValueError(self.kind)
 
     def to_dict(self):
         return {"kind": self.kind, "value": self.value}
+
+    def problems(self) -> list[str]:
+        """Validation issues (an uncompilable regex must surface at config
+        time through ``invalid_reasons``, not crash the agent filter)."""
+        if self.kind == "regex":
+            try:
+                re.compile(self.value)
+            except re.error as e:
+                return [f"bad regex {self.value!r}: {e}"]
+        elif self.kind not in ("any", "exact", "glob"):
+            return [f"unknown matcher kind {self.kind!r}"]
+        return []
 
     @staticmethod
     def exact(value: str) -> "StringMatcher":
@@ -470,6 +508,33 @@ class TaskTypeRule(PlacementRule):
 # --------------------------------------------------------------------------
 # marathon-style constraint strings
 
+@_register("invalid")
+@dataclass(frozen=True)
+class InvalidPlacementRule(PlacementRule):
+    """Parse-failure marker (reference ``InvalidPlacementRule.java``): keeps
+    the spec loadable so a running service isn't crashed by a bad constraint
+    in a config update — the ``placement_rules_valid`` validator blocks the
+    rollout instead, and the rule matches no agent if it somehow runs."""
+
+    constraint: str
+    reason: str
+
+    def filter(self, agent, pod_instance_name, tasks) -> Outcome:
+        return Outcome.fail(f"invalid placement rule {self.constraint!r}: "
+                            f"{self.reason}")
+
+    def invalid_reasons(self) -> list[str]:
+        return [f"{self.constraint!r}: {self.reason}"]
+
+    def to_dict(self):
+        return {"type": self.type, "constraint": self.constraint,
+                "reason": self.reason}
+
+    @staticmethod
+    def _from_dict(d):
+        return InvalidPlacementRule(d["constraint"], d["reason"])
+
+
 def parse_marathon_constraints(text: str) -> PlacementRule:
     """Parse ``[["hostname","UNIQUE"], ["zone","GROUP_BY","3"], ...]`` or the
     colon form ``hostname:UNIQUE`` (reference
@@ -519,10 +584,14 @@ def _one_marathon_rule(parts: Sequence[str]) -> PlacementRule:
         return MaxPerAttributeRule(max_count=n, attribute=fieldname)
     if op in ("CLUSTER", "IS"):
         return field_rule(StringMatcher.exact(value))
-    if op == "LIKE":
-        return field_rule(StringMatcher.regex(value))
-    if op == "UNLIKE":
-        return NotRule(field_rule(StringMatcher.regex(value)))
+    if op in ("LIKE", "UNLIKE"):
+        matcher = StringMatcher.regex(value)
+        problems = matcher.problems()
+        if problems:  # -> InvalidPlacementRule via the loader's except
+            raise ValueError("; ".join(problems))
+        if op == "LIKE":
+            return field_rule(matcher)
+        return NotRule(field_rule(matcher))
     if op == "GROUP_BY":
         n = int(value) if value else None
         if by:

@@ -109,7 +109,17 @@ class ServiceScheduler:
 
         self.backoff = backoff or DisabledBackoff()
         self.outcome_tracker = OutcomeTracker()
-        self.evaluator = Evaluator(self.spec.name, self.outcome_tracker)
+        # security: secrets always available; the CA spins up only when a
+        # task actually asks for transport-encryption
+        from ..security import SecretsStore, TLSProvisioner
+        self.secrets = SecretsStore(persister, namespace)
+        uses_tls = any(t.transport_encryption
+                       for p in self.spec.pods for t in p.tasks)
+        self.tls_provisioner = (TLSProvisioner(persister, self.spec.name)
+                                if uses_tls else None)
+        self.evaluator = Evaluator(self.spec.name, self.outcome_tracker,
+                                   tls_provisioner=self.tls_provisioner,
+                                   secrets_store=self.secrets)
         self.ledger = self.reservation_store.load_ledger()
 
         if uninstall:
@@ -383,6 +393,12 @@ class ServiceScheduler:
 
     def _stored_task(self, plan: LaunchPlan, launch: TaskLaunch) -> StoredTask:
         pod_instance = plan.requirement.pod_instance
+        # secret values must not reach the state store (the pod-info
+        # endpoint serves StoredTask.env; GET /v1/secrets is names-only by
+        # design) — the live value goes only to the agent launch payload
+        env = dict(launch.env)
+        for key in launch.secret_env_keys:
+            env[key] = "<secret>"
         return StoredTask(
             task_name=launch.task_name,
             task_id=launch.task_id,
@@ -395,7 +411,7 @@ class ServiceScheduler:
             target_config_id=self.target_config_id,
             goal=GoalState(launch.goal),
             essential=launch.essential,
-            env=dict(launch.env),
+            env=env,
             cmd=launch.cmd,
             zone=plan.agent.zone,
             region=plan.agent.region,

@@ -1,0 +1,23 @@
+"""Security subsystem: per-task TLS provisioning + a secrets store.
+
+Reference ``offer/evaluate/security/`` (``TLSArtifactsGenerator``,
+``TLSArtifactsUpdater``, ``CertificateNamesGenerator``,
+``TLSArtifactPaths``) and ``dcos/clients/SecretsClient``. The reference
+asks the DC/OS CA to sign per-task certs and stores them in the cluster
+secrets service; we are the whole control plane, so the scheduler carries
+its own CA (key in the state persister, the ZK analogue) and delivers
+artifacts to sandboxes through the existing config-template channel that
+``tpu-bootstrap`` renders.
+"""
+
+from .ca import CertificateAuthority
+from .secrets import SecretsStore
+from .tls import TLSArtifactPaths, TLSProvisioner, certificate_names
+
+__all__ = [
+    "CertificateAuthority",
+    "SecretsStore",
+    "TLSArtifactPaths",
+    "TLSProvisioner",
+    "certificate_names",
+]

@@ -1,0 +1,122 @@
+"""Self-contained certificate authority.
+
+Reference: the DC/OS CA reached through
+``dcos/clients/CertificateAuthorityClient.java`` — an external signing
+service. TPU-native: the scheduler IS the trust root for its service, so
+the CA keypair is generated once and persisted next to the rest of the
+control-plane state (``storage/Persister`` tree, the ZK analogue), and
+per-task certificates are signed locally — no external dependency, no
+network round-trip in the launch path.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Optional, Sequence, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+from ..state.persister import Persister
+
+CA_KEY_PATH = "security/ca/key.pem"
+CA_CERT_PATH = "security/ca/cert.pem"
+
+_ONE_DAY = datetime.timedelta(days=1)
+
+
+def _name(cn: str, org: str = "dcos-commons-tpu") -> x509.Name:
+    return x509.Name([
+        x509.NameAttribute(NameOID.COMMON_NAME, cn[:64]),
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+    ])
+
+
+class CertificateAuthority:
+    """Issues short-lived per-task certificates signed by a persisted CA.
+
+    EC P-256 keys: small, fast to generate in the launch path (the
+    reference generates 2048-bit RSA per task via the cluster CA round
+    trip — local EC signing is both faster and stronger per byte).
+    """
+
+    def __init__(self, persister: Persister, service_name: str,
+                 cert_days: int = 10 * 365):
+        self._persister = persister
+        self._service = service_name
+        self._cert_days = cert_days
+        self._key: Optional[ec.EllipticCurvePrivateKey] = None
+        self._cert: Optional[x509.Certificate] = None
+        self._load_or_create()
+
+    # -- CA material -------------------------------------------------------
+
+    def _load_or_create(self) -> None:
+        raw_key = self._persister.get_or_none(CA_KEY_PATH)
+        raw_cert = self._persister.get_or_none(CA_CERT_PATH)
+        if raw_key is not None and raw_cert is not None:
+            self._key = serialization.load_pem_private_key(raw_key, None)
+            self._cert = x509.load_pem_x509_certificate(raw_cert)
+            return
+        self._key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        subject = _name(f"{self._service} CA")
+        self._cert = (
+            x509.CertificateBuilder()
+            .subject_name(subject)
+            .issuer_name(subject)
+            .public_key(self._key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _ONE_DAY)
+            .not_valid_after(now + datetime.timedelta(days=self._cert_days))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                           critical=True)
+            .add_extension(x509.KeyUsage(
+                digital_signature=True, key_cert_sign=True, crl_sign=True,
+                content_commitment=False, key_encipherment=False,
+                data_encipherment=False, key_agreement=False,
+                encipher_only=False, decipher_only=False), critical=True)
+            .sign(self._key, hashes.SHA256()))
+        self._persister.set(CA_KEY_PATH, self._key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()))
+        self._persister.set(CA_CERT_PATH, self._cert.public_bytes(
+            serialization.Encoding.PEM))
+
+    @property
+    def ca_cert_pem(self) -> bytes:
+        return self._cert.public_bytes(serialization.Encoding.PEM)
+
+    # -- issuance ----------------------------------------------------------
+
+    def issue(self, cn: str, sans: Sequence[str] = (),
+              days: int = 3650) -> Tuple[bytes, bytes]:
+        """Return (cert_pem, key_pem) for one task endpoint."""
+        key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(_name(cn))
+            .issuer_name(self._cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _ONE_DAY)
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                           critical=True)
+            .add_extension(x509.ExtendedKeyUsage([
+                x509.oid.ExtendedKeyUsageOID.SERVER_AUTH,
+                x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH]), critical=False))
+        if sans:
+            builder = builder.add_extension(
+                x509.SubjectAlternativeName(
+                    [x509.DNSName(s) for s in sans]), critical=False)
+        cert = builder.sign(self._key, hashes.SHA256())
+        return (cert.public_bytes(serialization.Encoding.PEM),
+                key.private_bytes(
+                    serialization.Encoding.PEM,
+                    serialization.PrivateFormat.PKCS8,
+                    serialization.NoEncryption()))

@@ -1,0 +1,54 @@
+"""Secrets store (reference ``dcos/clients/SecretsClient.java``).
+
+The reference delegates to the DC/OS secrets service; here secrets live in
+the scheduler's own persister under ``security/secrets/<path>``. Listing
+never returns values; the HTTP surface exposes names only (values are
+injected into task sandboxes at launch, the way the reference mounts
+Mesos secret volumes).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..state.persister import NotFoundError, Persister
+
+ROOT = "security/secrets"
+
+
+def _esc(path: str) -> str:
+    return path.strip("/").replace("/", "|")
+
+
+class SecretsStore:
+    """``namespace`` isolates services sharing one persister (multi-service
+    schedulers): each service reads/writes only its own subtree, like every
+    other namespaced store (the reference's cross-service sharing runs
+    through DC/OS secrets-service ACLs we don't have)."""
+
+    def __init__(self, persister: Persister, namespace: str = ""):
+        self._persister = persister
+        # same Services/<ns>/ prefixing as StateStore/ConfigStore
+        self._root = (f"Services/{_esc(namespace)}/{ROOT}"
+                      if namespace else ROOT)
+
+    def put(self, path: str, value: bytes) -> None:
+        self._persister.set(f"{self._root}/{_esc(path)}", value)
+
+    def get(self, path: str) -> Optional[bytes]:
+        return self._persister.get_or_none(f"{self._root}/{_esc(path)}")
+
+    def delete(self, path: str) -> bool:
+        try:
+            self._persister.recursive_delete(f"{self._root}/{_esc(path)}")
+            return True
+        except NotFoundError:
+            return False
+
+    def list(self) -> List[str]:
+        """Secret *names* only — values never leave the launch path."""
+        try:
+            children = self._persister.get_children(self._root)
+        except NotFoundError:
+            return []
+        return sorted(c.replace("|", "/") for c in children)

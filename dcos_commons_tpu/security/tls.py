@@ -1,0 +1,91 @@
+"""Per-task TLS artifact provisioning.
+
+Reference ``offer/evaluate/security/``: ``TLSEvaluationStage`` inserts
+cert/key/keystore secrets into the launch; ``CertificateNamesGenerator``
+derives CN/SANs from the task's DNS identity; ``TLSArtifactPaths`` fixes
+the in-sandbox layout. Here the provisioner issues from the scheduler's
+own CA (``ca.py``) and ships artifacts through the config-template channel
+(files rendered into the sandbox before the task command runs).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from ..matching.evaluator import service_hostname
+from ..state.persister import Persister
+from .ca import CertificateAuthority
+
+
+class TLSArtifactPaths:
+    """Reference ``TLSArtifactPaths.java``: where artifacts land in the
+    sandbox, keyed by the transport-encryption name."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    @property
+    def cert(self) -> str:
+        return f"{self.name}.crt"
+
+    @property
+    def key(self) -> str:
+        return f"{self.name}.key"
+
+    @property
+    def ca_bundle(self) -> str:
+        return f"{self.name}.ca"
+
+
+def certificate_names(service_name: str, pod_instance_name: str,
+                      task_name: str) -> Tuple[str, List[str]]:
+    """CN + SANs for one task (reference ``CertificateNamesGenerator``):
+    the task's stable service DNS identity plus a pod-level wildcard-ish
+    alias so clients can address either."""
+    cn = service_hostname(service_name, pod_instance_name)
+    sans = [cn, service_hostname(service_name, task_name)]
+    return cn, sorted(set(sans))
+
+
+class TLSProvisioner:
+    """Issues artifacts for every transport-encryption entry of a task.
+
+    Artifacts are deterministic per (task, encryption-name): issued once,
+    persisted, and re-delivered verbatim on relaunch so a restarting task
+    keeps its identity (the reference stores them in the cluster secrets
+    service for the same reason, ``TLSArtifactsUpdater.java``).
+    """
+
+    def __init__(self, persister: Persister, service_name: str):
+        self._persister = persister
+        self._service = service_name
+        self._ca = CertificateAuthority(persister, service_name)
+
+    @property
+    def ca_cert_pem(self) -> bytes:
+        return self._ca.ca_cert_pem
+
+    def artifacts_for(self, pod_instance_name: str, task_instance_name: str,
+                      encryption_names: Sequence[str]
+                      ) -> List[Tuple[str, str, str]]:
+        """Returns config-template triples (name, dest, content)."""
+        out: List[Tuple[str, str, str]] = []
+        for enc_name in encryption_names:
+            paths = TLSArtifactPaths(enc_name)
+            # per-service subtree (multi-service schedulers share one CA —
+            # one trust domain, like the reference's cluster CA — but never
+            # cert storage)
+            root = f"security/tls/{self._service}/{task_instance_name}/{enc_name}"
+            cert = self._persister.get_or_none(f"{root}/cert")
+            key = self._persister.get_or_none(f"{root}/key")
+            if cert is None or key is None:
+                cn, sans = certificate_names(
+                    self._service, pod_instance_name, task_instance_name)
+                cert, key = self._ca.issue(cn, sans)
+                self._persister.set_many({f"{root}/cert": cert,
+                                          f"{root}/key": key})
+            out.append((f"tls-{enc_name}-cert", paths.cert, cert.decode()))
+            out.append((f"tls-{enc_name}-key", paths.key, key.decode()))
+            out.append((f"tls-{enc_name}-ca", paths.ca_bundle,
+                        self._ca.ca_cert_pem.decode()))
+        return out

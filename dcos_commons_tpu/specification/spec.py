@@ -185,6 +185,39 @@ class DiscoverySpec:
 
 
 @dataclass(frozen=True)
+class TransportEncryptionSpec:
+    """Reference ``specification/TransportEncryptionSpec.java``: a named TLS
+    identity the scheduler provisions into the task sandbox as
+    ``<name>.crt`` / ``<name>.key`` / ``<name>.ca`` (PEM; the reference's
+    JKS keystore variant is a JVM-ism we drop)."""
+
+    name: str
+
+    def validate(self) -> list[str]:
+        if not self.name or "/" in self.name:
+            return [f"transport-encryption name invalid: {self.name!r}"]
+        return []
+
+
+@dataclass(frozen=True)
+class SecretSpec:
+    """Reference ``specification/SecretSpec.java``: a secret delivered to
+    the task as an env var and/or a sandbox file."""
+
+    secret_path: str
+    env_key: Optional[str] = None
+    file_path: Optional[str] = None
+
+    def validate(self) -> list[str]:
+        errs = []
+        if not self.secret_path:
+            errs.append("secret: empty path")
+        if not self.env_key and not self.file_path:
+            errs.append(f"secret {self.secret_path}: needs env-key or file")
+        return errs
+
+
+@dataclass(frozen=True)
 class TaskSpec:
     """Reference ``specification/TaskSpec.java:15`` / ``DefaultTaskSpec``."""
 
@@ -200,6 +233,7 @@ class TaskSpec:
     essential: bool = True
     kill_grace_period_s: int = 0
     uris: tuple[str, ...] = ()
+    transport_encryption: tuple[TransportEncryptionSpec, ...] = ()
 
     def validate(self) -> list[str]:
         errs = []
@@ -207,6 +241,8 @@ class TaskSpec:
             errs.append(f"task {self.name}: empty cmd")
         if "__" in self.name:
             errs.append(f"task {self.name}: '__' is reserved (task-id codec)")
+        for te in self.transport_encryption:
+            errs.extend(te.validate())
         return errs
 
 
@@ -226,9 +262,12 @@ class PodSpec:
     pre_reserved_role: Optional[str] = None
     allow_decommission: bool = True
     share_pid_namespace: bool = False
+    secrets: tuple[SecretSpec, ...] = ()
 
     def validate(self) -> list[str]:
         errs = []
+        for s in self.secrets:
+            errs.extend(s.validate())
         if self.count < 1:
             errs.append(f"pod {self.type}: count must be >= 1")
         if not self.tasks:
@@ -386,6 +425,7 @@ def _service_from_dict(data: Mapping[str, Any]) -> ServiceSpec:
             pre_reserved_role=pd.get("pre_reserved_role"),
             allow_decommission=pd.get("allow_decommission", True),
             share_pid_namespace=pd.get("share_pid_namespace", False),
+            secrets=tuple(SecretSpec(**s) for s in pd.get("secrets", ())),
         ))
     rfp = data.get("replacement_failure_policy")
     return ServiceSpec(
@@ -431,6 +471,9 @@ def _task_from_dict(t: Mapping[str, Any]) -> TaskSpec:
         essential=t.get("essential", True),
         kill_grace_period_s=t.get("kill_grace_period_s", 0),
         uris=tuple(t.get("uris", ())),
+        transport_encryption=tuple(
+            TransportEncryptionSpec(**te)
+            for te in t.get("transport_encryption", ())),
     )
 
 

@@ -56,8 +56,8 @@ from ..utils.template import render_template
 from .spec import (ConfigFileSpec, DiscoverySpec, GoalState, HealthCheckSpec,
                    PhaseSpec, PlanSpecModel, PodSpec, PortSpec,
                    ReadinessCheckSpec, ReplacementFailurePolicy, ResourceSet,
-                   ServiceSpec, StepSpecEntry, TaskSpec, TpuSpec, VolumeSpec,
-                   VolumeType)
+                   SecretSpec, ServiceSpec, StepSpecEntry, TaskSpec,
+                   TpuSpec, TransportEncryptionSpec, VolumeSpec, VolumeType)
 
 TASKCFG_ALL_PREFIX = "TASKCFG_ALL_"
 TASKCFG_POD_PREFIX = "TASKCFG_"
@@ -149,8 +149,16 @@ def _map_pod(pod_type: str, raw: Mapping[str, Any], env: Mapping[str, str],
         # an empty/whitespace constraint means "no constraint" (the reference
         # MarathonConstraintParser.java:35 returns a pass-through for it, so
         # svc.ymls can say placement: '{{POD_PLACEMENT}}' with empty default)
-        rule = (parse_marathon_constraints(placement)
-                if placement.strip() else None)
+        if not placement.strip():
+            rule = None
+        else:
+            try:
+                rule = parse_marathon_constraints(placement)
+            except (ValueError, KeyError) as e:
+                # keep the spec loadable; the placement_rules_valid config
+                # validator blocks the rollout (reference InvalidPlacementRule)
+                from ..matching.placement import InvalidPlacementRule
+                rule = InvalidPlacementRule(placement, str(e))
     else:
         rule = rule_from_json(placement)
 
@@ -162,6 +170,15 @@ def _map_pod(pod_type: str, raw: Mapping[str, Any], env: Mapping[str, str],
     ) if tpu_raw else None
     if tpu is None and any(rs.tpus for rs in resource_sets):
         tpu = TpuSpec(chips=max(rs.tpus for rs in resource_sets))
+
+    secrets = []
+    for _, sec_raw in (raw.get("secrets") or {}).items():
+        sec_raw = sec_raw or {}
+        secrets.append(SecretSpec(
+            secret_path=sec_raw["secret"],
+            env_key=sec_raw.get("env-key"),
+            file_path=sec_raw.get("file"),
+        ))
 
     return PodSpec(
         type=pod_type,
@@ -178,6 +195,7 @@ def _map_pod(pod_type: str, raw: Mapping[str, Any], env: Mapping[str, str],
         pre_reserved_role=raw.get("pre-reserved-role"),
         allow_decommission=bool(raw.get("allow-decommission", True)),
         share_pid_namespace=bool(raw.get("share-pid-namespace", False)),
+        secrets=tuple(secrets),
     )
 
 
@@ -269,6 +287,9 @@ def _map_task(name: str, raw: Mapping[str, Any], rs_id: str,
         essential=bool(raw.get("essential", True)),
         kill_grace_period_s=int(raw.get("kill-grace-period", 0)),
         uris=tuple(raw.get("uris") or ()),
+        transport_encryption=tuple(
+            TransportEncryptionSpec(name=te["name"])
+            for te in raw.get("transport-encryption") or ()),
     )
 
 

@@ -36,6 +36,11 @@ DEFAULT_ENV: Mapping[str, str] = {
     "HELLO_URI": "https://example.com/artifact.tar.gz",
     "TPU_CHIPS": "4",
     "TPU_TOPOLOGY": "v4-8",
+    # locally-built bootstrap fetched into sandboxes that need template
+    # rendering (production overrides with the package artifact URL)
+    "BOOTSTRAP_URI": "file://" + os.path.abspath(os.path.join(
+        os.path.dirname(__file__), "..", "..", "native", "bin",
+        "tpu-bootstrap")),
 }
 
 

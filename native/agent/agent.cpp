@@ -261,6 +261,50 @@ class Agent {
     pending_statuses_.push_back(std::move(s));
   }
 
+  static std::string b64_decode(const std::string& in) {
+    static const std::string chars =
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+    std::string out;
+    int val = 0, bits = -8;
+    for (unsigned char c : in) {
+      if (c == '=' || c == '\n' || c == '\r') continue;
+      size_t pos = chars.find(c);
+      if (pos == std::string::npos) continue;
+      val = (val << 6) + static_cast<int>(pos);
+      bits += 6;
+      if (bits >= 0) {
+        out.push_back(static_cast<char>((val >> bits) & 0xFF));
+        bits -= 8;
+      }
+    }
+    return out;
+  }
+
+  // Write one raw sandbox file (TLS artifacts / secret files): verbatim
+  // bytes, never mustache-rendered (unlike config templates), parent dirs
+  // created, secrets kept 0600.
+  static bool write_raw_file(const std::string& dest_rel,
+                             const std::string& content,
+                             const std::string& sandbox, std::string& err) {
+    if (dest_rel.empty() || dest_rel[0] == '/' ||
+        dest_rel.find("..") != std::string::npos) {
+      err = "file dest must be sandbox-relative: " + dest_rel;
+      return false;
+    }
+    std::string dest = sandbox + "/" + dest_rel;
+    for (size_t pos = dest.find('/', sandbox.size() + 1);
+         pos != std::string::npos; pos = dest.find('/', pos + 1)) {
+      ::mkdir(dest.substr(0, pos).c_str(), 0755);
+    }
+    std::ofstream out(dest, std::ios::binary | std::ios::trunc);
+    if (!out) { err = "cannot write " + dest; return false; }
+    out << content;
+    out.close();
+    if (!out) { err = "short write to " + dest; return false; }
+    ::chmod(dest.c_str(), 0600);
+    return true;
+  }
+
   // Fetch one task URI into the sandbox (reference: the Mesos fetcher,
   // which is how sdk/bootstrap and config artifacts reach a task's
   // sandbox). file:// and bare paths are copied; http(s):// downloaded.
@@ -324,6 +368,16 @@ class Agent {
       std::string err;
       if (!fetch_uri(uri.as_string(), sandbox, err)) {
         emit(task_id, task_name, "TASK_FAILED", "uri fetch: " + err);
+        return;
+      }
+    }
+
+    for (const auto& file : task.get("files").items()) {
+      std::string err;
+      if (!write_raw_file(file.get("dest").as_string(),
+                          b64_decode(file.get("content_b64").as_string()),
+                          sandbox, err)) {
+        emit(task_id, task_name, "TASK_FAILED", "file write: " + err);
         return;
       }
     }

@@ -18,6 +18,7 @@
 #include <arpa/inet.h>
 #include <netdb.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <cstdlib>
@@ -85,6 +86,11 @@ void render_templates(bool strict) {
     }
     std::string src = spec.substr(0, comma);
     std::string dst = spec.substr(comma + 1);
+    // destinations may be nested (e.g. secrets/two): create parent dirs
+    for (size_t pos = dst.find('/'); pos != std::string::npos;
+         pos = dst.find('/', pos + 1)) {
+      if (pos > 0) ::mkdir(dst.substr(0, pos).c_str(), 0755);
+    }
     std::ifstream in(src);
     if (!in) {
       std::cerr << "[tpu-bootstrap] missing template " << src << "\n";

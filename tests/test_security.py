@@ -1,0 +1,125 @@
+"""Security subsystem (reference ``offer/evaluate/security/`` +
+``dcos/clients/SecretsClient``): CA persistence, per-task TLS issuance,
+secrets delivery, and the helloworld tls/secrets scenarios end to end.
+"""
+
+import base64
+
+from cryptography import x509
+
+from dcos_commons_tpu.security import (CertificateAuthority, SecretsStore,
+                                       TLSProvisioner)
+from dcos_commons_tpu.state import MemPersister, TaskState
+from dcos_commons_tpu.testing import Expect, Send, ServiceTestRunner
+
+from frameworks.helloworld import scenarios
+
+
+class TestCertificateAuthority:
+    def test_ca_persists_across_restarts(self):
+        p = MemPersister()
+        ca1 = CertificateAuthority(p, "svc")
+        ca2 = CertificateAuthority(p, "svc")
+        assert ca1.ca_cert_pem == ca2.ca_cert_pem
+
+    def test_issued_cert_chains_to_ca(self):
+        ca = CertificateAuthority(MemPersister(), "svc")
+        cert_pem, key_pem = ca.issue("node-0.svc.tpu.local",
+                                     ["node-0.svc.tpu.local"])
+        cert = x509.load_pem_x509_certificate(cert_pem)
+        ca_cert = x509.load_pem_x509_certificate(ca.ca_cert_pem)
+        assert cert.issuer == ca_cert.subject
+        cert.verify_directly_issued_by(ca_cert)
+        sans = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        assert "node-0.svc.tpu.local" in sans.get_values_for_type(x509.DNSName)
+        assert b"PRIVATE KEY" in key_pem
+
+
+class TestSecretsStore:
+    def test_crud_and_names_only_listing(self):
+        s = SecretsStore(MemPersister())
+        s.put("svc/db/password", b"hunter2")
+        s.put("svc/api-key", b"k")
+        assert s.list() == ["svc/api-key", "svc/db/password"]
+        assert s.get("svc/db/password") == b"hunter2"
+        assert s.delete("svc/db/password")
+        assert not s.delete("svc/db/password")
+        assert s.get("svc/db/password") is None
+
+
+class TestTLSProvisioner:
+    def test_artifacts_stable_across_relaunch(self):
+        p = MemPersister()
+        prov = TLSProvisioner(p, "svc")
+        a1 = prov.artifacts_for("node-0", "node-0-server", ["tls"])
+        a2 = prov.artifacts_for("node-0", "node-0-server", ["tls"])
+        assert a1 == a2  # same cert re-delivered, identity survives restart
+        names = [name for name, _, _ in a1]
+        assert names == ["tls-tls-cert", "tls-tls-key", "tls-tls-ca"]
+        dests = [dest for _, dest, _ in a1]
+        assert dests == ["tls.crt", "tls.key", "tls.ca"]
+
+
+class TestScenarios:
+    def test_tls_scenario_delivers_artifacts(self):
+        spec = scenarios.load_scenario("tls")
+        runner = ServiceTestRunner(spec=spec)
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        launch = runner.cluster.launch_log[0].launches[0]
+        files = {dest: base64.b64decode(content)
+                 for dest, content in launch.files}
+        assert b"BEGIN CERTIFICATE" in files["hello-tls.crt"]
+        assert b"BEGIN PRIVATE KEY" in files["hello-tls.key"]
+        assert b"BEGIN CERTIFICATE" in files["hello-tls.ca"]
+        # each pod instance gets its own identity
+        launch2 = runner.cluster.launch_log[1].launches[0]
+        files2 = {dest: base64.b64decode(content)
+                  for dest, content in launch2.files}
+        assert files2["hello-tls.crt"] != files["hello-tls.crt"]
+        # but the same trust root
+        assert files2["hello-tls.ca"] == files["hello-tls.ca"]
+
+    def test_secrets_scenario_injects_env_and_file(self):
+        spec = scenarios.load_scenario("secrets")
+        runner = ServiceTestRunner(spec=spec)
+        runner.scheduler.secrets.put("hello-world/secret1", b"from-env")
+        runner.scheduler.secrets.put("hello-world/secret2", b"from-file")
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        launch = runner.cluster.launch_log[0].launches[0]
+        assert launch.env["SECRET_ONE"] == "from-env"
+        files = {dest: base64.b64decode(content)
+                 for dest, content in launch.files}
+        assert files["secrets/two"] == b"from-file"
+        # the persisted record redacts the env secret (pod-info endpoint
+        # serves StoredTask.env; the live value goes only to the agent)
+        stored = runner.scheduler.state.fetch_task("hello-0-server")
+        assert stored.env["SECRET_ONE"] == "<secret>"
+
+    def test_binary_secret_skips_env_but_delivers_file(self):
+        spec = scenarios.load_scenario("secrets")
+        runner = ServiceTestRunner(spec=spec)
+        blob = bytes(range(256))
+        runner.scheduler.secrets.put("hello-world/secret1", blob)  # env-key
+        runner.scheduler.secrets.put("hello-world/secret2", blob)  # file
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        launch = runner.cluster.launch_log[0].launches[0]
+        assert "SECRET_ONE" not in launch.env  # not UTF-8: no env injection
+        files = {dest: base64.b64decode(content)
+                 for dest, content in launch.files}
+        assert files["secrets/two"] == blob  # binary file delivery intact
+
+    def test_absent_secret_omitted(self):
+        spec = scenarios.load_scenario("secrets")
+        runner = ServiceTestRunner(spec=spec)
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        launch = runner.cluster.launch_log[0].launches[0]
+        assert "SECRET_ONE" not in launch.env
+
+    def test_spec_roundtrip_preserves_security_fields(self):
+        spec = scenarios.load_scenario("secrets")
+        from dcos_commons_tpu.specification import ServiceSpec
+        again = ServiceSpec.from_json(spec.to_json())
+        assert again == spec
+        tls_spec = scenarios.load_scenario("tls")
+        assert ServiceSpec.from_json(tls_spec.to_json()) == tls_spec

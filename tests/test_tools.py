@@ -1,0 +1,72 @@
+"""Packaging tools (reference ``tools/universe/package_builder.py``)."""
+
+import hashlib
+import json
+import os
+
+import pytest
+
+from tools.package_builder import PackageBuildError, PackageBuilder, main
+
+FRAMEWORKS = ["frameworks/helloworld/universe", "frameworks/jax/universe",
+              "frameworks/cassandra/universe", "frameworks/hdfs/universe"]
+
+
+class TestBuild:
+    @pytest.mark.parametrize("universe", FRAMEWORKS)
+    def test_every_shipped_universe_builds(self, universe, tmp_path):
+        b = PackageBuilder(universe, "0.1.0", "https://dl.example.com/art")
+        bundle = b.write(str(tmp_path))
+        pkg = json.load(open(os.path.join(bundle, "package.json")))
+        assert pkg["version"] == "0.1.0"
+        manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+        assert "package.json" in manifest["files"]
+
+    def test_version_and_artifact_dir_rendered(self, tmp_path):
+        b = PackageBuilder("frameworks/jax/universe", "2.0.0",
+                           "https://dl.example.com/jax/2.0.0")
+        files = b.build()
+        res = files["resource.json"]
+        assert res["assets"]["uris"]["scheduler-zip"] == \
+            "https://dl.example.com/jax/2.0.0/jax-scheduler.zip"
+        # runtime mustache vars left for the operator layer
+        sched = files["scheduler.json.mustache"]["__template__"]
+        assert "{{service.name}}" in sched
+
+    def test_artifact_sha256(self, tmp_path):
+        art = tmp_path / "bootstrap.bin"
+        art.write_bytes(b"tpu!")
+        b = PackageBuilder("frameworks/jax/universe", "0.1.0",
+                           "https://dl.example.com/a",
+                           artifacts=[str(art)])
+        bundle = b.write(str(tmp_path / "out"))
+        manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+        assert manifest["artifacts"]["bootstrap.bin"]["sha256"] == \
+            hashlib.sha256(b"tpu!").hexdigest()
+
+    def test_missing_sha_artifact_errors(self, tmp_path):
+        uni = tmp_path / "universe"
+        uni.mkdir()
+        (uni / "package.json").write_text(json.dumps({
+            "name": "x", "version": "{{package-version}}"}))
+        (uni / "resource.json").write_text(json.dumps({
+            "assets": {"sha": "{{sha256:missing.bin}}"}}))
+        b = PackageBuilder(str(uni), "1.0", "https://a")
+        with pytest.raises(PackageBuildError, match="sha256:missing.bin"):
+            b.build()
+
+    def test_unversioned_package_json_rejected(self, tmp_path):
+        uni = tmp_path / "universe"
+        uni.mkdir()
+        (uni / "package.json").write_text(json.dumps({
+            "name": "x", "version": "9.9"}))
+        b = PackageBuilder(str(uni), "1.0", "https://a")
+        with pytest.raises(PackageBuildError, match="version"):
+            b.build()
+
+    def test_cli(self, tmp_path, capsys):
+        rc = main(["frameworks/helloworld/universe", "--version", "0.5.0",
+                   "--artifact-dir", "https://a", "--out", str(tmp_path)])
+        assert rc == 0
+        out = capsys.readouterr().out.strip()
+        assert out.endswith("hello-world-0.5.0")

@@ -1,0 +1,142 @@
+"""Config validators (reference ``config/validate/``, 19 validators wired at
+``SchedulerBuilder.java:469-511``): each blocks a rollout by returning error
+strings; the updater then keeps the old target config.
+"""
+
+from dcos_commons_tpu.config.updater import (
+    DEFAULT_VALIDATORS, network_regime_cannot_change, placement_rules_valid,
+    pre_reservation_cannot_change, service_name_dns_safe,
+    task_env_cannot_change, zone_placement_cannot_change)
+from dcos_commons_tpu.specification import load_service_yaml_str
+
+
+BASE = """
+name: svc
+pods:
+  web:
+    count: 2
+    {extra}
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: sleep 100
+        cpus: 0.5
+        memory: 128
+        {task_extra}
+"""
+
+
+def spec(extra: str = "", task_extra: str = "", name: str = "svc"):
+    text = BASE.format(extra=extra, task_extra=task_extra)
+    return load_service_yaml_str(text.replace("name: svc", f"name: {name}"))
+
+
+class TestDnsSafety:
+    def test_long_name_rejected_on_new_deploy(self):
+        s = spec(name="x" * 70)
+        assert service_name_dns_safe(None, s)
+
+    def test_long_name_allowed_on_upgrade(self):
+        s = spec(name="x" * 70)
+        assert service_name_dns_safe(s, s) == []
+
+    def test_unusual_chars_allowed(self):
+        # length is the only hard constraint (reference warns, not errors,
+        # on anything else; folder-style and encoded names are legitimate)
+        s = spec(name="a%2Fb")
+        assert service_name_dns_safe(None, s) == []
+
+    def test_slashes_stripped_from_length(self):
+        s = spec(name="/team/" + "x" * 55)
+        assert service_name_dns_safe(None, s) == []
+
+
+class TestNetworkRegime:
+    def test_host_to_overlay_blocked(self):
+        old = spec()
+        new = spec(extra="networks: {overlay: {}}")
+        assert network_regime_cannot_change(old, new)
+        assert network_regime_cannot_change(new, old)
+
+    def test_same_regime_ok(self):
+        old = spec(extra="networks: {overlay: {}}")
+        new = spec(extra="networks: {other: {}}")
+        assert network_regime_cannot_change(old, new) == []
+
+
+class TestPreReservation:
+    def test_role_change_blocked(self):
+        old = spec(extra="pre-reserved-role: slave_public")
+        new = spec()
+        assert pre_reservation_cannot_change(old, new)
+
+    def test_same_role_ok(self):
+        old = spec(extra="pre-reserved-role: slave_public")
+        assert pre_reservation_cannot_change(old, old) == []
+
+
+class TestPlacementRuleValidity:
+    def test_unparseable_marathon_constraint_blocks_rollout(self):
+        s = spec(extra='placement: "hostname"')  # missing operator
+        errs = placement_rules_valid(None, s)
+        assert errs and "invalid placement rule" in errs[0]
+
+    def test_valid_constraint_passes(self):
+        s = spec(extra='placement: "hostname:UNIQUE"')
+        assert placement_rules_valid(None, s) == []
+
+    def test_bad_like_regex_blocks_rollout_not_crash(self):
+        # '*foo' is not a valid regex; must surface as a config error, not
+        # a re.error during agent filtering
+        s = spec(extra='placement: "hostname:LIKE:*foo"')
+        errs = placement_rules_valid(None, s)
+        assert errs and "bad regex" in errs[0]
+
+    def test_invalid_rule_matches_no_agent(self):
+        from dcos_commons_tpu.agent.inventory import AgentInfo
+        from dcos_commons_tpu.matching.placement import InvalidPlacementRule
+        rule = InvalidPlacementRule("junk", "missing operator")
+        agent = AgentInfo(agent_id="a", hostname="h", cpus=1, memory_mb=1,
+                          disk_mb=1)
+        assert not rule.filter(agent, "web-0", []).passes
+
+
+class TestZoneToggle:
+    VOL = """volume:
+          path: data
+          size: 128
+          type: ROOT"""
+
+    def test_zone_toggle_with_volumes_blocked(self):
+        old = spec(task_extra=self.VOL)
+        new = spec(extra='placement: "zone:GROUP_BY:3"', task_extra=self.VOL)
+        assert zone_placement_cannot_change(old, new)
+
+    def test_zone_toggle_without_volumes_ok(self):
+        old = spec()
+        new = spec(extra='placement: "zone:GROUP_BY:3"')
+        assert zone_placement_cannot_change(old, new) == []
+
+    def test_stable_zone_placement_ok(self):
+        new = spec(extra='placement: "zone:GROUP_BY:3"', task_extra=self.VOL)
+        assert zone_placement_cannot_change(new, new) == []
+
+
+class TestTaskEnvPin:
+    def test_pinned_env_cannot_change(self):
+        v = task_env_cannot_change("web", "server", "CLUSTER_NAME")
+        old = spec(task_extra="env: {CLUSTER_NAME: alpha}")
+        new = spec(task_extra="env: {CLUSTER_NAME: beta}")
+        assert v(old, new)
+        assert v(old, old) == []
+        assert v(None, new) == []
+
+
+class TestRegistry:
+    def test_new_validators_registered_by_default(self):
+        assert service_name_dns_safe in DEFAULT_VALIDATORS
+        assert network_regime_cannot_change in DEFAULT_VALIDATORS
+        assert pre_reservation_cannot_change in DEFAULT_VALIDATORS
+        assert placement_rules_valid in DEFAULT_VALIDATORS
+        assert zone_placement_cannot_change in DEFAULT_VALIDATORS
+        assert len(DEFAULT_VALIDATORS) >= 10

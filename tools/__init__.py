@@ -1,0 +1,1 @@
+"""Release/packaging tooling (reference ``tools/`` + ``tools/universe/``)."""

@@ -1,0 +1,175 @@
+"""Package bundle builder.
+
+Reference ``tools/universe/package_builder.py`` (``UniversePackageBuilder``)
++ ``tools/build_package.sh``: take a framework's ``universe/`` directory
+(package.json / config.json / resource.json / scheduler.json.mustache),
+render the ``{{package-version}}`` / ``{{artifact-dir}}`` / ``{{sha256:*}}``
+template variables, and emit a versioned package bundle an operator (or the
+repo index) can serve. Artifact SHA256s are computed from the local files
+the resource.json references.
+
+Usage::
+
+    python -m tools.package_builder frameworks/jax/universe \
+        --version 0.1.0 --artifact-dir https://downloads.example.com/jax \
+        --out build/packages [--artifact path ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+PACKAGE_FILES = ("package.json", "config.json", "resource.json")
+TEMPLATE_SUFFIX = ".mustache"
+_VAR = re.compile(r"{{([a-zA-Z0-9_.:-]+)}}")
+
+
+class PackageBuildError(Exception):
+    pass
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class PackageBuilder:
+    def __init__(self, universe_dir: str, version: str, artifact_dir: str,
+                 artifacts: Optional[List[str]] = None):
+        if not os.path.isdir(universe_dir):
+            raise PackageBuildError(f"not a directory: {universe_dir}")
+        self.universe_dir = universe_dir
+        self.version = version
+        self.artifact_dir = artifact_dir.rstrip("/")
+        # local artifact files for sha256 computation, keyed by basename
+        self.artifacts: Dict[str, str] = {
+            os.path.basename(a): a for a in (artifacts or [])}
+
+    # -- templating --------------------------------------------------------
+
+    def _mapping(self) -> Dict[str, str]:
+        return {
+            "package-version": self.version,
+            "artifact-dir": self.artifact_dir,
+        }
+
+    def _render(self, content: str, filename: str) -> str:
+        mapping = self._mapping()
+
+        def sub(match: re.Match) -> str:
+            key = match.group(1)
+            if key in mapping:
+                return mapping[key]
+            if key.startswith("sha256:"):
+                name = key.split(":", 1)[1]
+                local = self.artifacts.get(name)
+                if local is None:
+                    raise PackageBuildError(
+                        f"{filename}: {{{{sha256:{name}}}}} but no local "
+                        f"artifact {name!r} passed via --artifact")
+                return _sha256(local)
+            # other variables (e.g. {{service.name}} inside
+            # scheduler.json.mustache) are runtime config — leave them
+            return match.group(0)
+
+        return _VAR.sub(sub, content)
+
+    # -- build -------------------------------------------------------------
+
+    def build(self) -> Dict[str, dict]:
+        """Render every package file; returns {filename: parsed-json}."""
+        out: Dict[str, dict] = {}
+        for fname in sorted(os.listdir(self.universe_dir)):
+            path = os.path.join(self.universe_dir, fname)
+            if not os.path.isfile(path):
+                continue
+            with open(path) as f:
+                content = f.read()
+            rendered = self._render(content, fname)
+            if fname in PACKAGE_FILES:
+                try:
+                    out[fname] = json.loads(rendered)
+                except ValueError as e:
+                    raise PackageBuildError(f"{fname}: invalid JSON after "
+                                            f"rendering: {e}") from None
+            elif fname.endswith(TEMPLATE_SUFFIX):
+                # runtime template: keep text (validated for balance only)
+                out[fname] = {"__template__": rendered}
+        self._validate(out)
+        return out
+
+    def _validate(self, files: Dict[str, dict]) -> None:
+        pkg = files.get("package.json")
+        if pkg is None:
+            raise PackageBuildError("package.json missing")
+        for key in ("name", "version"):
+            if not pkg.get(key):
+                raise PackageBuildError(f"package.json: {key} missing/empty")
+        if pkg["version"] != self.version:
+            raise PackageBuildError(
+                f"package.json version {pkg['version']!r} != --version "
+                f"{self.version!r} (is {{{{package-version}}}} templated?)")
+        cfg = files.get("config.json")
+        if cfg is not None and cfg.get("type") != "object":
+            raise PackageBuildError("config.json: root type must be 'object'")
+
+    def write(self, out_dir: str) -> str:
+        """Write the bundle to ``<out>/<name>-<version>/``; returns path."""
+        files = self.build()
+        pkg = files["package.json"]
+        bundle = os.path.join(out_dir, f"{pkg['name']}-{self.version}")
+        os.makedirs(bundle, exist_ok=True)
+        manifest = {"name": pkg["name"], "version": self.version,
+                    "files": [], "artifacts": {}}
+        for fname, data in files.items():
+            dst = os.path.join(bundle, fname)
+            with open(dst, "w") as f:
+                if "__template__" in data:
+                    f.write(data["__template__"])
+                else:
+                    json.dump(data, f, indent=2, sort_keys=True)
+                    f.write("\n")
+            manifest["files"].append(fname)
+        for name, local in sorted(self.artifacts.items()):
+            manifest["artifacts"][name] = {
+                "sha256": _sha256(local),
+                "url": f"{self.artifact_dir}/{name}",
+            }
+        with open(os.path.join(bundle, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return bundle
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("universe_dir")
+    p.add_argument("--version", required=True)
+    p.add_argument("--artifact-dir", required=True,
+                   help="base URL artifacts will be served from")
+    p.add_argument("--artifact", action="append", default=[],
+                   help="local artifact file (repeatable; enables sha256)")
+    p.add_argument("--out", default="build/packages")
+    args = p.parse_args(argv)
+    try:
+        builder = PackageBuilder(args.universe_dir, args.version,
+                                 args.artifact_dir, args.artifact)
+        bundle = builder.write(args.out)
+    except PackageBuildError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(bundle)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
