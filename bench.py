@@ -31,16 +31,18 @@ def main() -> None:
         has_aux_state=True)
     opt_state = opt.init(params)
 
-    # warmup / compile
+    # warmup / compile; host materialization (float()) forces a real sync —
+    # block_until_ready alone can return early through tunneled PJRT
+    # backends (axon), inflating throughput
     params, opt_state, state, out = step(params, opt_state, (state, (x, y)))
-    jax.block_until_ready(out["loss"])
+    float(out["loss"])
 
     n_steps = 20
     t0 = time.perf_counter()
     for _ in range(n_steps):
         params, opt_state, state, out = step(params, opt_state,
                                              (state, (x, y)))
-    jax.block_until_ready(out["loss"])
+    float(out["loss"])
     dt = time.perf_counter() - t0
 
     n_chips = jax.device_count()

@@ -48,7 +48,7 @@ class LlamaConfig:
     max_seq: int = 8192
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
-    attn_impl: str = "dense"        # dense | ring | ulysses
+    attn_impl: str = "auto"         # auto | dense | flash | ring | ulysses
     dtype: Any = jnp.bfloat16
     remat: bool = True              # jax.checkpoint each layer (training)
 
@@ -134,13 +134,42 @@ def shard_params(params: Params, mesh: Mesh, cfg: LlamaConfig) -> Params:
 # forward
 
 def _make_attn_fn(cfg: LlamaConfig, mesh: Optional[Mesh]) -> Callable:
-    """Returns f(q, k, v) on [B, S, H, D] with H == n_heads (KV repeated)."""
-    if cfg.attn_impl == "dense" or mesh is None:
+    """Returns f(q, k, v): q [B, S, H, D], k/v [B, S, KV, D].
+
+    All impls take k/v with the spec's KV head count; GQA expansion is each
+    impl's own business (the flash kernel reads KV groups through its index
+    map with no HBM repeat at all; dense repeats internally; ring/ulysses
+    repeat here because their head sharding wants H == n_heads).
+    """
+    impl = cfg.attn_impl
+    if impl == "auto":
+        # unsharded TPU -> pallas flash kernel (measured faster than both
+        # XLA dense and the upstream reference pallas kernel on v5e,
+        # ops/flash_attention.py). Sharded meshes stay on XLA dense —
+        # ring/ulysses change the collective pattern and are explicit
+        # opt-ins per model config (frameworks/jax scenarios set them).
+        impl = ("flash" if mesh is None
+                and jax.default_backend() == "tpu" else "dense")
+    if impl == "flash":
+        from ..ops.flash_attention import flash_attention, supports
+
+        def attn(q, k, v):
+            if supports(q, k):
+                return flash_attention(q, k, v, causal=True)
+            return gqa_attention(q, k, v, causal=True)
+
+        return attn
+    if impl == "dense" or mesh is None:
         return lambda q, k, v: gqa_attention(q, k, v, causal=True)
-    if cfg.attn_impl == "ring":
-        return make_ring_attention(mesh, causal=True)
-    if cfg.attn_impl == "ulysses":
-        return make_ulysses_attention(mesh, causal=True)
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    if impl == "ring":
+        ring = make_ring_attention(mesh, causal=True)
+        return lambda q, k, v: ring(q, repeat_kv(k, n_rep),
+                                    repeat_kv(v, n_rep))
+    if impl == "ulysses":
+        uly = make_ulysses_attention(mesh, causal=True)
+        return lambda q, k, v: uly(q, repeat_kv(k, n_rep),
+                                   repeat_kv(v, n_rep))
     raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
 
 
@@ -156,7 +185,6 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     b, s = tokens.shape
     rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     attn_fn = _make_attn_fn(cfg, mesh)
-    n_rep = cfg.n_heads // cfg.n_kv_heads
 
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = _constrain(x, mesh, "dp", "sp", None)
@@ -168,8 +196,7 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
         v = (h @ lp["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, rope)
         k = apply_rope(k, rope)
-        # ring/ulysses shard heads over tp: repeat KV so head counts match
-        o = attn_fn(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep))
+        o = attn_fn(q, k, v)  # GQA expansion is the impl's business
         x = x + o.reshape(b, s, -1) @ lp["wo"]
         h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
         gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))

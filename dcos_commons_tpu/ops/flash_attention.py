@@ -1,0 +1,200 @@
+"""Pallas TPU flash attention (forward).
+
+The hot op of the workload layer (``frameworks/jax`` llama training/serving):
+online-softmax blockwise attention that never materializes the [Sq, Sk]
+score matrix in HBM — scores live in VMEM one (block_q, block_k) tile at a
+time, with running max/denominator carried in VMEM scratch across the
+sequential k-block grid axis (TPU grids iterate sequentially, so the
+innermost axis doubles as the flash accumulation loop).
+
+GQA comes free through the BlockSpec index map: each query head reads its
+kv-group's K/V block directly — no ``repeat_kv`` materialization at all
+(the dense path pays that broadcast in HBM).
+
+Layout matches ``ops.attention``: q [B, Sq, H, D], k/v [B, Sk, KV, D].
+Causal masking is positional (``q_offset`` shifts query positions); blocks
+entirely above the diagonal are skipped, not just masked.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+_LANES = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  sm_scale: float, causal: bool, q_offset: int,
+                  block_q: int, block_k: int):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: a k-block strictly above this q-block's last row contributes
+    # nothing — skip its compute entirely (the win over masked-dense)
+    q_last = q_offset + (iq + 1) * block_q - 1
+    k_first = ik * block_k
+    live = jnp.logical_or(not causal, k_first <= q_last)
+
+    @pl.when(live)
+    def _body():
+        # matmuls run in the input dtype (bf16 rides the MXU at full rate)
+        # with f32 accumulation; softmax statistics stay f32 throughout
+        q = q_ref[0, 0]                                  # [bq, d]
+        k = k_ref[0, 0]                                  # [bk, d]
+        v = v_ref[0, 0]                                  # [bk, d]
+        s = jax.lax.dot_general(                         # [bq, bk] f32
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = (q_offset + iq * block_q
+                     + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+            k_pos = (ik * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+
+        m_prev = m_scr[:, :1]                            # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)                  # [bq, 1]
+        p = jnp.exp(s - m_new)                           # [bq, bk]
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        # fully-masked rows (possible with q_offset < 0 padding) get 0, not
+        # NaN: guard the 1/l
+        l = l_scr[:, :1]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, sm_scale, q_offset, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, sm_scale, q_offset, block_q,
+                          block_k, interpret)
+
+
+def _flash_fwd(q, k, v, *nondiff):
+    return _flash(q, k, v, *nondiff), (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, q_offset, block_q, block_k, interpret,
+               res, g):
+    # Backward recomputes through the (differentiable) dense reference —
+    # identical math, so gradients are exact; the flash win applies to the
+    # forward/serving path while training remains correct everywhere.
+    # (A fused flash backward kernel is the natural next optimization.)
+    from .attention import gqa_attention
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: gqa_attention(
+            q_, k_, v_, causal=causal, sm_scale=sm_scale, q_offset=q_offset),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "sm_scale", "q_offset", "block_q", "block_k",
+                     "interpret"))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    q_offset: int = 0,
+                    block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Drop-in for ``ops.attention.gqa_attention`` on full sequences.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, KV, D], H % KV == 0. Sequence lengths
+    must divide the block sizes (callers pad or fall back to dense).
+    Differentiable: the backward pass runs the dense reference VJP.
+    """
+    return _flash(q, k, v, causal, sm_scale, q_offset, block_q, block_k,
+                  interpret)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, q_offset, block_q, block_k,
+                   interpret):
+    b, s_q, h, d = q.shape
+    _, s_k, kv, _ = k.shape
+    assert h % kv == 0, (h, kv)
+    n_rep = h // kv
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    assert s_q % block_q == 0 and s_k % block_k == 0, (s_q, s_k)
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+
+    # [B, S, H, D] -> [B, H, S, D]: block maps want heads outermost
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (b, h, s_q // block_q, s_k // block_k)
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=scale, causal=causal, q_offset=q_offset,
+        block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),        # output acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            # only the k axis carries state; batch/head/q-block tiles are
+            # independent, letting Mosaic pipeline them
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def supports(q: jnp.ndarray, k: jnp.ndarray, *, kv_len=None,
+             block_q: int = 128, block_k: int = 128) -> bool:
+    """Whether the flash path can serve this call (else dense fallback)."""
+    s_q, s_k = q.shape[1], k.shape[1]
+    if kv_len is not None:
+        return False  # padded decode caches use the dense path
+    if q.shape[-1] > 256:
+        return False  # head_dim beyond a VMEM-friendly tile
+    return (s_q % min(block_q, s_q) == 0 and s_k % min(block_k, s_k) == 0
+            and s_q >= 8 and s_k >= 128)

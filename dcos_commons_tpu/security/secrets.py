@@ -32,15 +32,26 @@ class SecretsStore:
         self._root = (f"Services/{_esc(namespace)}/{ROOT}"
                       if namespace else ROOT)
 
+    @staticmethod
+    def _key(path: str) -> str:
+        # an empty/slash-only path would address the subtree root — a
+        # delete() would silently wipe every secret
+        esc = _esc(path)
+        if not esc:
+            raise ValueError(f"invalid secret path: {path!r}")
+        return esc
+
     def put(self, path: str, value: bytes) -> None:
-        self._persister.set(f"{self._root}/{_esc(path)}", value)
+        self._persister.set(f"{self._root}/{self._key(path)}", value)
 
     def get(self, path: str) -> Optional[bytes]:
-        return self._persister.get_or_none(f"{self._root}/{_esc(path)}")
+        return self._persister.get_or_none(
+            f"{self._root}/{self._key(path)}")
 
     def delete(self, path: str) -> bool:
         try:
-            self._persister.recursive_delete(f"{self._root}/{_esc(path)}")
+            self._persister.recursive_delete(
+                f"{self._root}/{self._key(path)}")
             return True
         except NotFoundError:
             return False

@@ -296,12 +296,22 @@ class Agent {
          pos != std::string::npos; pos = dest.find('/', pos + 1)) {
       ::mkdir(dest.substr(0, pos).c_str(), 0755);
     }
-    std::ofstream out(dest, std::ios::binary | std::ios::trunc);
-    if (!out) { err = "cannot write " + dest; return false; }
-    out << content;
-    out.close();
-    if (!out) { err = "short write to " + dest; return false; }
-    ::chmod(dest.c_str(), 0600);
+    // create 0600 BEFORE any secret byte lands — an ofstream would open
+    // umask-wide (0644) and chmod after the plaintext is already readable
+    int fd = ::open(dest.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0600);
+    if (fd < 0) { err = "cannot write " + dest; return false; }
+    size_t off = 0;
+    while (off < content.size()) {
+      ssize_t n = ::write(fd, content.data() + off, content.size() - off);
+      if (n <= 0) {
+        ::close(fd);
+        ::unlink(dest.c_str());
+        err = "short write to " + dest;
+        return false;
+      }
+      off += static_cast<size_t>(n);
+    }
+    if (::close(fd) != 0) { err = "close failed: " + dest; return false; }
     return true;
   }
 
