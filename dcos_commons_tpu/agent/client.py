@@ -35,6 +35,12 @@ class AgentClient(Protocol):
     def kill(self, agent_id: str, task_id: str, grace_period_s: float = 0.0) -> None:
         """Kill one task; a terminal status will be delivered."""
 
+    def destroy_volumes(self, agent_id: str, pod_instance_name: str) -> None:
+        """Delete the pod instance's persistent volumes on the agent
+        (reference: Mesos DESTROY of persistent volumes — pod replace and
+        uninstall must not leak the failed instance's data to its
+        replacement)."""
+
     def running_task_ids(self, agent_id: str) -> Sequence[str]:
         """Explicit reconciliation: what is actually running on the agent
         (reference ``ExplicitReconciler``/``ImplicitReconciler``)."""

@@ -59,6 +59,8 @@ class FakeCluster:
         self._script: Dict[str, TaskBehavior] = {}
         self._launch_log: List[LaunchPlan] = []
         self._kill_log: List[str] = []
+        # (agent_id, pod_instance_name) destroy-volume commands, for tests
+        self.destroyed_volumes: List[tuple] = []
 
     # -- test scripting ----------------------------------------------------
 
@@ -150,6 +152,9 @@ class FakeCluster:
         if task_id in self._tasks:
             self.send_status(task_id, TaskState.KILLED, message="killed by scheduler")
         # unknown task: nothing to do; scheduler already considers it dead
+
+    def destroy_volumes(self, agent_id: str, pod_instance_name: str) -> None:
+        self.destroyed_volumes.append((agent_id, pod_instance_name))
 
     def running_task_ids(self, agent_id: str) -> Sequence[str]:
         return [t.task_id for t in self._tasks.values()

@@ -70,6 +70,8 @@ class RemoteCluster:
                 "readiness_timeout_s": l.readiness_timeout_s,
                 "uris": list(l.uris),
                 "files": [{"dest": d, "content_b64": c} for d, c in l.files],
+                "pod_instance": l.pod_instance,
+                "volumes": list(l.volumes),
             } for l in plan.launches]}
         with self._lock:
             self._queues.setdefault(plan.agent.agent_id, []).append(command)
@@ -80,6 +82,12 @@ class RemoteCluster:
             self._queues.setdefault(agent_id, []).append(
                 {"type": "kill", "task_id": task_id,
                  "grace_period_s": grace_period_s})
+
+    def destroy_volumes(self, agent_id: str, pod_instance_name: str) -> None:
+        with self._lock:
+            self._queues.setdefault(agent_id, []).append(
+                {"type": "destroy_volumes",
+                 "pod_instance": pod_instance_name})
 
     def running_task_ids(self, agent_id: str) -> Sequence[str]:
         with self._lock:

@@ -207,6 +207,9 @@ class ApiServer:
                     self._respond(code, payload)
                 except ApiError as e:
                     self._respond(e.code, {"error": e.message})
+                except (ValueError, KeyError) as e:
+                    # bad client input (invalid secret path, unknown name)
+                    self._respond(400, {"error": str(e)})
                 except Exception as e:  # pragma: no cover
                     log.exception("api error")
                     self._respond(500, {"error": str(e)})

@@ -75,6 +75,12 @@ class TaskLaunch:
     files: Tuple[Tuple[str, str], ...] = ()
     # env keys whose values are secrets: redacted from the stored record
     secret_env_keys: Tuple[str, ...] = ()
+    # pod-instance identity + its volume container paths: the agent mounts
+    # (symlinks) per-pod-instance persistent dirs into every task sandbox,
+    # the reference's shared-executor-sandbox + persistent-volume semantics
+    # (tasks of one pod see one another's volumes; data survives relaunch)
+    pod_instance: str = ""
+    volumes: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -401,7 +407,12 @@ class Evaluator:
                     content.encode()).decode()))
         if self._secrets is not None:
             for sec in pod.secrets:
-                value = self._secrets.get(sec.secret_path)
+                try:
+                    value = self._secrets.get(sec.secret_path)
+                except ValueError:
+                    log.warning("spec declares invalid secret path %r; "
+                                "skipping", sec.secret_path)
+                    continue
                 if value is None:
                     continue  # absent secret: task sees no injection
                 if sec.env_key:
@@ -431,6 +442,9 @@ class Evaluator:
                 for c in task_spec.configs),
             files=tuple(raw_files),
             secret_env_keys=tuple(secret_env_keys),
+            pod_instance=requirement.pod_instance.name,
+            volumes=tuple(v.container_path for rs in pod.resource_sets
+                          for v in rs.volumes),
             health_check_cmd=task_spec.health_check.cmd if task_spec.health_check else None,
             readiness_check_cmd=(
                 task_spec.readiness_check.cmd if task_spec.readiness_check else None),

@@ -59,18 +59,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(                         # [bq, bk] f32
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
+        mask = None
         if causal:
             q_pos = (q_offset + iq * block_q
                      + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
             k_pos = (ik * block_k
                      + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
-            s = jnp.where(q_pos >= k_pos, s, _NEG)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, _NEG)
 
         m_prev = m_scr[:, :1]                            # [bq, 1]
         l_prev = l_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)                  # [bq, 1]
         p = jnp.exp(s - m_new)                           # [bq, bk]
+        if mask is not None:
+            # a fully-masked row has m_new == _NEG == its masked scores, so
+            # exp(s - m_new) would be 1, not 0 — zero p explicitly so such
+            # rows keep l == 0 and finish as 0 output, not mean-of-V
+            p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),

@@ -335,6 +335,11 @@ class ServiceScheduler:
             if requirement.recovery_type is RecoveryType.PERMANENT:
                 removed = self.ledger.remove_pod(requirement.pod_instance.name)
                 self.reservation_store.remove(removed)
+                # the replacement must not inherit the failed instance's
+                # data (reference: replace DESTROYs persistent volumes)
+                for agent_id in {r.agent_id for r in removed if r.volumes}:
+                    self.cluster.destroy_volumes(
+                        agent_id, requirement.pod_instance.name)
             task_records = self._task_records()
             plan, outcome = self.evaluator.evaluate(
                 requirement, agents, task_records, self.ledger)

@@ -40,10 +40,13 @@ def _kill_pod_action(scheduler, pod_instance_name: str) -> Callable[[], bool]:
 
 
 def _unreserve_pod_action(scheduler, pod_instance_name: str) -> Callable[[], bool]:
-    """Release the pod's reservations (reference ``ResourceCleanupStep``)."""
+    """Release the pod's reservations and destroy its persistent volumes
+    (reference ``ResourceCleanupStep``: DESTROY before UNRESERVE)."""
     def action() -> bool:
         removed = scheduler.ledger.remove_pod(pod_instance_name)
         scheduler.reservation_store.remove(removed)
+        for agent_id in {r.agent_id for r in removed if r.volumes}:
+            scheduler.cluster.destroy_volumes(agent_id, pod_instance_name)
         return True
     return action
 

@@ -210,8 +210,8 @@ class SecretSpec:
 
     def validate(self) -> list[str]:
         errs = []
-        if not self.secret_path:
-            errs.append("secret: empty path")
+        if not self.secret_path.strip("/"):
+            errs.append(f"secret: empty path {self.secret_path!r}")
         if not self.env_key and not self.file_path:
             errs.append(f"secret {self.secret_path}: needs env-key or file")
         return errs

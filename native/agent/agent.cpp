@@ -19,7 +19,9 @@
 // (reference ReadinessCheckSpec) run after launch; success is reported as
 // TASK_RUNNING with readiness_passed=true.
 
+#include <dirent.h>
 #include <fcntl.h>
+#include <limits.h>
 #include <signal.h>
 #include <sys/stat.h>
 #include <sys/types.h>
@@ -238,6 +240,8 @@ class Agent {
       const std::string type = cmd.get("type").as_string();
       if (type == "launch") {
         for (const auto& task : cmd.get("tasks").items()) launch(task);
+      } else if (type == "destroy_volumes") {
+        destroy_volumes(cmd.get("pod_instance").as_string());
       } else if (type == "kill") {
         kill_task(cmd.get("task_id").as_string(),
                   cmd.get("grace_period_s").as_number(0));
@@ -315,6 +319,36 @@ class Agent {
     return true;
   }
 
+  // Delete a pod instance's persistent volumes (reference: Mesos DESTROY
+  // of persistent volumes on pod replace / uninstall).
+  void destroy_volumes(const std::string& pod_instance) {
+    if (pod_instance.empty() || pod_instance.find('/') != std::string::npos ||
+        pod_instance.find("..") != std::string::npos) {
+      return;  // refuse anything that could escape <base>/volumes
+    }
+    std::string root = cfg_.base_dir + "/volumes/" + pod_instance;
+    rm_rf(root);
+  }
+
+  static void rm_rf(const std::string& path) {
+    DIR* dir = ::opendir(path.c_str());
+    if (dir != nullptr) {
+      while (struct dirent* e = ::readdir(dir)) {
+        std::string name = e->d_name;
+        if (name == "." || name == "..") continue;
+        std::string child = path + "/" + name;
+        struct stat st;
+        if (::lstat(child.c_str(), &st) == 0 && S_ISDIR(st.st_mode)) {
+          rm_rf(child);
+        } else {
+          ::unlink(child.c_str());
+        }
+      }
+      ::closedir(dir);
+    }
+    ::rmdir(path.c_str());
+  }
+
   // Fetch one task URI into the sandbox (reference: the Mesos fetcher,
   // which is how sdk/bootstrap and config artifacts reach a task's
   // sandbox). file:// and bare paths are copied; http(s):// downloaded.
@@ -372,6 +406,40 @@ class Agent {
       emit(task_id, task_name, "TASK_FAILED",
            "cannot create sandbox " + sandbox);
       return;
+    }
+
+    // persistent pod-instance volumes (reference: Mesos persistent volumes
+    // + the shared executor sandbox): <base>/volumes/<pod-instance>/<path>
+    // survives task relaunch and is symlinked into every sibling task's
+    // sandbox, so cassandra-style sidecars see the server's data
+    const std::string pod_instance = task.get("pod_instance").as_string();
+    for (const auto& vol : task.get("volumes").items()) {
+      const std::string rel = vol.as_string();
+      if (rel.empty() || rel[0] == '/' ||
+          rel.find("..") != std::string::npos || pod_instance.empty()) {
+        emit(task_id, task_name, "TASK_FAILED",
+             "volume path must be sandbox-relative: " + rel);
+        return;
+      }
+      std::string store = cfg_.base_dir + "/volumes/" + pod_instance +
+                          "/" + rel;
+      mkdirs(store);
+      // symlink target must be absolute: base_dir is often relative and
+      // the link is resolved from inside the sandbox cwd
+      char resolved[PATH_MAX];
+      if (::realpath(store.c_str(), resolved) != nullptr) {
+        store = resolved;
+      }
+      std::string link = sandbox + "/" + rel;
+      size_t parent_end = link.rfind('/');
+      if (parent_end != std::string::npos) {
+        mkdirs(link.substr(0, parent_end));
+      }
+      if (::symlink(store.c_str(), link.c_str()) != 0 && errno != EEXIST) {
+        emit(task_id, task_name, "TASK_FAILED",
+             "cannot link volume " + rel + " -> " + store);
+        return;
+      }
     }
 
     for (const auto& uri : task.get("uris").items()) {

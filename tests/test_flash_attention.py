@@ -45,6 +45,17 @@ class TestCorrectness:
     def test_head_dim_128(self):
         check(1, 128, 128, 2, 2, 128, causal=True)
 
+    def test_fully_masked_rows_output_zero(self):
+        # negative q_offset: rows with position < 0 attend to nothing; they
+        # must output 0, not mean-of-V (masked scores == running-max init)
+        q = rand((1, 64, 2, 32), 1)
+        k = rand((1, 64, 2, 32), 2)
+        v = rand((1, 64, 2, 32), 3)
+        out = flash_attention(q, k, v, causal=True, q_offset=-32,
+                              block_q=64, block_k=64, interpret=True)
+        assert float(jnp.abs(out[0, :32]).max()) == 0.0
+        assert float(jnp.abs(out[0, 32:]).max()) > 0.0
+
 
 class TestGradients:
     def test_custom_vjp_matches_dense_grad(self):

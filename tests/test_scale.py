@@ -1,0 +1,131 @@
+"""Scale tests (reference tier 4:
+``frameworks/helloworld/tests/scale/test_scale.py:16-35`` +
+``threading_utils.py`` — mass-install N service instances in parallel
+batches with normal and crash-loop scenarios).
+
+Here the cluster is the fake in-process agent fleet, so "scale" measures
+the scheduler's own behavior: N services over one persister and one
+cluster, batched parallel installs, deploy-to-COMPLETE for all, crash-loop
+services isolated from healthy neighbors. Marked ``scale`` so CI can select
+or skip the slow tier (the sizes below keep it fast enough for the default
+run).
+"""
+
+import threading
+
+import pytest
+
+from dcos_commons_tpu.agent import AgentInfo, FakeCluster, PortRange
+from dcos_commons_tpu.agent.fake import TaskBehavior
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler.multi import MultiServiceScheduler
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import MemPersister
+
+SVC_YML = """
+name: {name}
+pods:
+  worker:
+    count: {count}
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "sleep 1000"
+        cpus: 0.1
+        memory: 64
+"""
+
+CRASH_YML = """
+name: {name}
+pods:
+  crashworker:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "exit 1"
+        cpus: 0.1
+        memory: 64
+"""
+
+
+def agents(n):
+    return [AgentInfo(agent_id=f"a{i}", hostname=f"host{i}", cpus=64,
+                      memory_mb=65536, disk_mb=131072,
+                      ports=(PortRange(10000, 20000),))
+            for i in range(n)]
+
+
+def install_batch(multi, names, yaml_tmpl, batch_size=8, count=2):
+    """threading_utils.py analogue: parallel batched installs."""
+    errors = []
+
+    def one(name):
+        try:
+            multi.add_service(load_service_yaml_str(
+                yaml_tmpl.format(name=name, count=count), {}))
+        except Exception as e:  # pragma: no cover
+            errors.append((name, e))
+
+    for start in range(0, len(names), batch_size):
+        threads = [threading.Thread(target=one, args=(n,))
+                   for n in names[start:start + batch_size]]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+
+
+def drive_until(multi, predicate, max_cycles=400):
+    for _ in range(max_cycles):
+        multi.run_cycle()
+        if predicate():
+            return
+    raise AssertionError("not converged after max_cycles")
+
+
+@pytest.mark.scale
+class TestMassInstall:
+    def test_twenty_services_deploy(self):
+        multi = MultiServiceScheduler(MemPersister(), FakeCluster(agents(8)))
+        names = [f"svc-{i:02d}" for i in range(20)]
+        install_batch(multi, names, SVC_YML)
+        assert multi.service_names() == sorted(names)
+
+        def all_complete():
+            return all(
+                multi.get_service(n).plan("deploy").status is Status.COMPLETE
+                for n in names)
+        drive_until(multi, all_complete)
+
+    def test_crashloop_services_do_not_starve_healthy(self):
+        cluster = FakeCluster(agents(8))
+        # crash-loop behavior: every launched task fails immediately
+        multi = MultiServiceScheduler(MemPersister(), cluster)
+        healthy = [f"ok-{i}" for i in range(6)]
+        crashers = [f"crash-{i}" for i in range(3)]
+        install_batch(multi, healthy, SVC_YML, count=1)
+        install_batch(multi, crashers, CRASH_YML, count=1)
+        # crashworker pods (all crash-* services) fail on every launch
+        cluster.script("crashworker-0-server", TaskBehavior.CRASH)
+
+        def healthy_done():
+            return all(
+                multi.get_service(n).plan("deploy").status is Status.COMPLETE
+                for n in healthy)
+        drive_until(multi, healthy_done)
+
+    def test_mass_uninstall_converges(self):
+        multi = MultiServiceScheduler(MemPersister(), FakeCluster(agents(8)))
+        names = [f"svc-{i:02d}" for i in range(10)]
+        install_batch(multi, names, SVC_YML, count=1)
+
+        def all_complete():
+            return all(
+                multi.get_service(n).plan("deploy").status is Status.COMPLETE
+                for n in names)
+        drive_until(multi, all_complete)
+        for n in names:
+            multi.uninstall_service(n)
+        drive_until(multi, lambda: multi.service_names() == [])
