@@ -65,6 +65,11 @@ class RemoteCluster:
                     {"name": n, "dest": d, "template": t}
                     for n, d, t in l.config_templates],
                 "health_check_cmd": l.health_check_cmd,
+                "health_interval_s": l.health_interval_s,
+                "health_grace_s": l.health_grace_s,
+                "health_max_failures": l.health_max_failures,
+                "health_timeout_s": l.health_timeout_s,
+                "health_delay_s": l.health_delay_s,
                 "readiness_check_cmd": l.readiness_check_cmd,
                 "readiness_interval_s": l.readiness_interval_s,
                 "readiness_timeout_s": l.readiness_timeout_s,

@@ -105,15 +105,25 @@ class _Routes:
                 raise ApiError(404, "secrets store unavailable")
             return store
 
+        def secrets_put(path: str, body: bytes):
+            try:
+                secrets_store().put(path, body)
+            except ValueError as e:  # invalid path: client error, not 500
+                raise ApiError(400, str(e)) from None
+            return {"message": f"stored secret {path}"}
+
+        def secrets_delete(path: str):
+            try:
+                deleted = secrets_store().delete(path)
+            except ValueError as e:
+                raise ApiError(400, str(e)) from None
+            if not deleted:
+                return 404, {"error": f"no secret {path}"}
+            return {"message": f"deleted secret {path}"}
+
         add("GET", r"secrets", lambda m, p, b: secrets_store().list())
-        add("PUT", r"secrets/(.+)",
-            lambda m, p, b: (secrets_store().put(m[0], b or b""),
-                             {"message": f"stored secret {m[0]}"})[1])
-        add("DELETE", r"secrets/(.+)",
-            lambda m, p, b: (
-                {"message": f"deleted secret {m[0]}"}
-                if secrets_store().delete(m[0])
-                else (404, {"error": f"no secret {m[0]}"})))
+        add("PUT", r"secrets/(.+)", lambda m, p, b: secrets_put(m[0], b or b""))
+        add("DELETE", r"secrets/(.+)", lambda m, p, b: secrets_delete(m[0]))
 
         # debug
         add("GET", r"debug/offers", lambda m, p, b: debug.offers())
@@ -207,9 +217,6 @@ class ApiServer:
                     self._respond(code, payload)
                 except ApiError as e:
                     self._respond(e.code, {"error": e.message})
-                except (ValueError, KeyError) as e:
-                    # bad client input (invalid secret path, unknown name)
-                    self._respond(400, {"error": str(e)})
                 except Exception as e:  # pragma: no cover
                     log.exception("api error")
                     self._respond(500, {"error": str(e)})

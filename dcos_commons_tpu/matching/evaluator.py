@@ -64,6 +64,11 @@ class TaskLaunch:
     essential: bool
     config_templates: Tuple[Tuple[str, str, str], ...] = ()  # (name, dest, template)
     health_check_cmd: Optional[str] = None
+    health_interval_s: float = 30.0
+    health_grace_s: float = 60.0
+    health_max_failures: int = 3
+    health_timeout_s: float = 20.0
+    health_delay_s: float = 0.0
     readiness_check_cmd: Optional[str] = None
     readiness_interval_s: float = 5.0
     readiness_timeout_s: float = 10.0
@@ -446,6 +451,17 @@ class Evaluator:
             volumes=tuple(v.container_path for rs in pod.resource_sets
                           for v in rs.volumes),
             health_check_cmd=task_spec.health_check.cmd if task_spec.health_check else None,
+            health_interval_s=(task_spec.health_check.interval_s
+                               if task_spec.health_check else 30.0),
+            health_grace_s=(task_spec.health_check.grace_period_s
+                            if task_spec.health_check else 60.0),
+            health_max_failures=(
+                task_spec.health_check.max_consecutive_failures
+                if task_spec.health_check else 3),
+            health_timeout_s=(task_spec.health_check.timeout_s
+                              if task_spec.health_check else 20.0),
+            health_delay_s=(task_spec.health_check.delay_s
+                            if task_spec.health_check else 0.0),
             readiness_check_cmd=(
                 task_spec.readiness_check.cmd if task_spec.readiness_check else None),
             readiness_interval_s=(

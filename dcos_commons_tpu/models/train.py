@@ -64,10 +64,17 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     p_shard = shardings_like(None, param_spec_tree)
     b_shard = jax.tree.map(lambda s: NamedSharding(mesh, s), batch_spec,
                            is_leaf=lambda s: isinstance(s, P))
-    # opt-state sharding mirrors params; let GSPMD propagate it from inputs.
+    if p_shard is None:
+        return jax.jit(step, donate_argnums=(0, 1))
+    # pin OUTPUT params to the same spec as the inputs: without this GSPMD
+    # may resolve an output param to a different sharding, and the second
+    # step call fails its in_shardings check (a one-step smoke never sees
+    # this; any training loop does)
+    out_shardings = ((p_shard, None, None, None) if has_aux_state
+                     else (p_shard, None, None))
     return jax.jit(step, donate_argnums=(0, 1),
-                   in_shardings=(p_shard, None, b_shard) if p_shard
-                   else None)
+                   in_shardings=(p_shard, None, b_shard),
+                   out_shardings=out_shardings)
 
 
 def init_opt_state(optimizer: optax.GradientTransformation, params,

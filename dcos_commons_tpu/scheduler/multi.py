@@ -178,6 +178,9 @@ class ServiceClusterView(AgentClient):
              grace_period_s: float = 0.0) -> None:
         self._multi.cluster.kill(agent_id, task_id, grace_period_s)
 
+    def destroy_volumes(self, agent_id: str, pod_instance_name: str) -> None:
+        self._multi.cluster.destroy_volumes(agent_id, pod_instance_name)
+
     def running_task_ids(self, agent_id: str) -> Sequence[str]:
         return [tid for tid in self._multi.cluster.running_task_ids(agent_id)
                 if self._multi._owner(tid) == self._name]

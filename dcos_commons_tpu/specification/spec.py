@@ -210,7 +210,7 @@ class SecretSpec:
 
     def validate(self) -> list[str]:
         errs = []
-        if not self.secret_path.strip("/"):
+        if not (self.secret_path or "").strip("/"):
             errs.append(f"secret: empty path {self.secret_path!r}")
         if not self.env_key and not self.file_path:
             errs.append(f"secret {self.secret_path}: needs env-key or file")

@@ -31,6 +31,11 @@ DEFAULT_ENV: Mapping[str, str] = {
     "RESNET_DEPTH": "50",
     "LLAMA_PRESET": "tiny",
     "SHARD_COUNT": "4",
+    # long-context scenario knobs (longctx.yml)
+    "SEQ_LEN": "8192",
+    "ATTN_IMPL": "ring",
+    "SP": "0",
+    "TP": "0",
     # fetched into every task sandbox pre-launch (reference: resource.json
     # assets fetched by Mesos; in production the universe template overrides
     # this with the artifact URL). Default: the locally-built binary.

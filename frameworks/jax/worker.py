@@ -254,7 +254,72 @@ def run_llama(args) -> dict:
     return result
 
 
-WORKLOADS = {"mnist": run_mnist, "resnet": run_resnet, "llama": run_llama}
+def run_llama_train(args) -> dict:
+    """Long-context LM training: sequence parallelism over the ``sp`` mesh
+    axis with ring attention (``ppermute`` KV rotation over the ICI ring),
+    tensor parallelism over ``tp`` — the SURVEY §2.4 long-context module,
+    deployed as a schedulable workload (``dist/longctx.yml``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama, train
+    from dcos_commons_tpu.parallel import distributed
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+    contract = distributed.initialize()
+    n = jax.device_count()
+
+    def divisor_at_most(limit: int, total: int) -> int:
+        # largest divisor of total that is <= limit: requested axis sizes
+        # that don't factorize n are clamped, not crashed (a bad config
+        # must not crash-loop the gang)
+        for cand in range(max(min(limit, total), 1), 0, -1):
+            if total % cand == 0:
+                return cand
+        return 1
+
+    sp = (divisor_at_most(args.sp, n) if args.sp > 0
+          else (2 if n % 2 == 0 else 1))
+    tp = divisor_at_most(args.tp, n // sp) if args.tp > 0 else 1
+    dp = n // (sp * tp)
+    mesh = MeshSpec(dp=dp, sp=sp, tp=tp).build()
+    seq = args.seq
+    attn = args.attn if args.attn != "auto" else (
+        "ring" if sp > 1 else "auto")
+    cfg = llama.LlamaConfig.tiny(attn_impl=attn, max_seq=seq + 1)
+    with mesh:
+        params = llama.shard_params(
+            llama.init_params(cfg, jax.random.key(0)), mesh, cfg)
+        toks = jax.random.randint(jax.random.key(1),
+                                  (max(2 * dp, 1), seq + 1),
+                                  0, cfg.vocab_size)
+        opt = train.make_optimizer(lr=1e-3, warmup=5,
+                                   decay_steps=max(args.steps, 10))
+        step = train.make_train_step(
+            lambda p, b: llama.loss_fn(cfg, p, b, mesh), opt, mesh=mesh,
+            param_spec_tree=llama.param_specs(cfg), batch_spec=None)
+        opt_state = train.init_opt_state(opt, params, mesh,
+                                         llama.param_specs(cfg))
+        params, opt_state, out = step(params, opt_state, toks)  # compile
+        float(out["loss"])
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            params, opt_state, out = step(params, opt_state, toks)
+        loss = float(out["loss"])
+        dt = time.perf_counter() - t0
+
+    if args.out:
+        save_checkpoint(args.out, args.steps, params)
+    tokens_per_sec = toks.shape[0] * seq * args.steps / dt
+    return {"workload": "llama-train", "attn": attn, "seq": seq,
+            "mesh": {"dp": dp, "sp": sp, "tp": tp},
+            "final_loss": loss,
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "process_id": contract["process_id"]}
+
+
+WORKLOADS = {"mnist": run_mnist, "resnet": run_resnet, "llama": run_llama,
+             "llama-train": run_llama_train}
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -268,6 +333,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gen-len", type=int, default=16)
     p.add_argument("--serve", action="store_true",
                    help="llama: block after warmup (RUNNING-goal tasks)")
+    p.add_argument("--attn", default="auto",
+                   choices=["auto", "dense", "flash", "ring", "ulysses"])
+    p.add_argument("--seq", type=int, default=256,
+                   help="llama-train: sequence length")
+    p.add_argument("--sp", type=int, default=0,
+                   help="llama-train: sequence-parallel mesh size (0=auto)")
+    p.add_argument("--tp", type=int, default=0,
+                   help="llama-train: tensor-parallel mesh size (0=auto)")
     p.add_argument("--out", default="")
     return p
 

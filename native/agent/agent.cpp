@@ -68,6 +68,18 @@ struct RunningTask {
   double readiness_interval = 5;
   double readiness_timeout = 10;
   double readiness_deadline = 0;  // when the in-flight probe times out
+  // liveness probe (reference HealthCheckSpec): after the grace period the
+  // check runs every interval; max_consecutive_failures kills the task
+  std::string health_cmd;
+  pid_t health_pid = -1;
+  double health_interval = 30;
+  double health_grace_until = 0;
+  double health_next_try = 0;
+  double health_timeout = 20;
+  double health_deadline = 0;     // in-flight probe SIGKILLed past this
+  int health_max_failures = 3;
+  int health_failures = 0;
+  bool health_killed = false;  // TASK_FAILED already emitted by the probe
   bool kill_requested = false;
   double sigkill_deadline = 0;    // when to escalate SIGTERM -> SIGKILL
 };
@@ -142,6 +154,7 @@ class Agent {
       reap_children();
       escalate_kills();
       retry_readiness();
+      run_health_checks();
       if (!poll_once()) {
         // scheduler asked us to re-register (restarted / expired us)
         if (!register_with_retry()) return 1;
@@ -322,9 +335,10 @@ class Agent {
   // Delete a pod instance's persistent volumes (reference: Mesos DESTROY
   // of persistent volumes on pod replace / uninstall).
   void destroy_volumes(const std::string& pod_instance) {
-    if (pod_instance.empty() || pod_instance.find('/') != std::string::npos ||
+    if (pod_instance.empty() || pod_instance == "." ||
+        pod_instance.find('/') != std::string::npos ||
         pod_instance.find("..") != std::string::npos) {
-      return;  // refuse anything that could escape <base>/volumes
+      return;  // refuse anything that could escape or widen the target
     }
     std::string root = cfg_.base_dir + "/volumes/" + pod_instance;
     rm_rf(root);
@@ -515,6 +529,15 @@ class Agent {
     rt.readiness_cmd = task.get("readiness_check_cmd").as_string();
     rt.readiness_interval = task.get("readiness_interval_s").as_number(5);
     rt.readiness_timeout = task.get("readiness_timeout_s").as_number(10);
+    rt.health_cmd = task.get("health_check_cmd").as_string();
+    rt.health_interval = task.get("health_interval_s").as_number(30);
+    rt.health_timeout = task.get("health_timeout_s").as_number(20);
+    rt.health_grace_until =
+        now_s() + task.get("health_grace_s").as_number(60);
+    rt.health_next_try = rt.health_grace_until +
+                         task.get("health_delay_s").as_number(0);
+    rt.health_max_failures =
+        static_cast<int>(task.get("health_max_failures").as_number(3));
     for (const auto& [k, v] : task.get("env").fields()) {
       rt.env[k] = v.as_string();
     }
@@ -556,6 +579,57 @@ class Agent {
       } else if (t.readiness_pid < 0 && now >= t.readiness_next_try) {
         spawn_readiness(t);
       }
+    }
+  }
+
+  static pid_t spawn_probe(const RunningTask& t, const std::string& cmd) {
+    pid_t p = fork();
+    if (p == 0) {
+      setpgid(0, 0);
+      if (chdir(t.sandbox.c_str()) != 0) _exit(126);
+      for (const auto& [k, v] : t.env) setenv(k.c_str(), v.c_str(), 1);
+      execl("/bin/sh", "sh", "-c", cmd.c_str(), (char*)nullptr);
+      _exit(127);
+    }
+    return p;
+  }
+
+  // liveness probes (reference HealthCheckSpec): run every interval after
+  // the grace period; max consecutive failures -> kill + TASK_FAILED so
+  // the scheduler's recovery plan relaunches the pod
+  void run_health_checks() {
+    double now = now_s();
+    for (auto& [task_id, t] : tasks_) {
+      if (t.health_cmd.empty() || t.pid <= 0 || t.kill_requested) continue;
+      if (t.health_pid < 0 && now >= t.health_next_try) {
+        t.health_pid = spawn_probe(t, t.health_cmd);
+        t.health_deadline = now + t.health_timeout;
+        t.health_next_try = now + t.health_interval;
+      } else if (t.health_pid > 0 && now >= t.health_deadline) {
+        // a probe hung past its timeout counts as a failure now, not at
+        // the next interval boundary (reference HealthCheckSpec timeout)
+        ::kill(-t.health_pid, SIGKILL);
+      }
+    }
+  }
+
+  void on_health_result(RunningTask& t, bool passed) {
+    if (passed) {
+      t.health_failures = 0;
+      return;
+    }
+    ++t.health_failures;
+    if (t.health_failures >= t.health_max_failures && !t.kill_requested) {
+      std::cerr << "[tpu-agent] health check failed x"
+                << t.health_failures << " for " << t.task_name
+                << "; killing\n";
+      emit(t.task_id, t.task_name, "TASK_FAILED",
+           "health check failed " + std::to_string(t.health_failures) +
+               " times");
+      t.kill_requested = true;
+      t.health_killed = true;
+      ::kill(-t.pid, SIGTERM);
+      t.sigkill_deadline = now_s() + 5;
     }
   }
 
@@ -601,8 +675,21 @@ class Agent {
           }
           break;
         }
+        if (t.health_pid == pid) {
+          t.health_pid = -1;
+          on_health_result(t, WIFEXITED(status) && WEXITSTATUS(status) == 0);
+          break;
+        }
         if (t.pid == pid) {
           int code = WIFEXITED(status) ? WEXITSTATUS(status) : -1;
+          if (t.health_killed) {
+            // TASK_FAILED already emitted when the probe gave up; just
+            // clean up the checker processes and the record
+            if (t.readiness_pid > 0) ::kill(-t.readiness_pid, SIGKILL);
+            if (t.health_pid > 0) ::kill(-t.health_pid, SIGKILL);
+            tasks_.erase(it);
+            break;
+          }
           std::string state;
           std::string msg;
           if (t.kill_requested) {
@@ -619,7 +706,10 @@ class Agent {
           }
           emit(t.task_id, t.task_name, state, msg);
           if (t.readiness_pid > 0) {
-            ::kill(-t.readiness_pid, SIGKILL);  // don't leak the checker
+            ::kill(-t.readiness_pid, SIGKILL);  // don't leak the checkers
+          }
+          if (t.health_pid > 0) {
+            ::kill(-t.health_pid, SIGKILL);
           }
           t.pid = -1;
           tasks_.erase(it);

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# CI entry point (reference test.sh / tools/ci/test_runner.sh): build the
+# native binaries, run the full test suite on the virtual CPU mesh, and
+# build every shipped package bundle. Usage: ./test.sh [pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== native build =="
+make -C native
+
+echo "== test suite =="
+python -m pytest tests/ -q "$@"
+
+echo "== package bundles =="
+for universe in frameworks/*/universe; do
+    python -m tools.package_builder "$universe" --version 0.0.0-ci \
+        --artifact-dir https://ci.invalid/artifacts --out build/ci-packages
+done
+
+echo "OK"

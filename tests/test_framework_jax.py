@@ -165,6 +165,22 @@ class TestWorkerWorkloads:
         done = [e for e in events if e.get("event") == "done"]
         assert done and done[0]["images_per_sec_per_chip"] > 0
 
+    def test_llama_train_ring_on_cpu_mesh(self, tmp_path, capsys):
+        # long-context workload on the 8-device virtual CPU mesh: ring
+        # attention over sp, single process (the gang path is simulated in
+        # TestScenariosDeploy via longctx.yml)
+        out = str(tmp_path / "ckpt")
+        rc = worker.main(["llama-train", "--steps", "1", "--seq", "64",
+                          "--attn", "ring", "--sp", "2", "--tp", "2",
+                          "--out", out])
+        assert rc == 0
+        events = [json.loads(line)
+                  for line in capsys.readouterr().out.splitlines()]
+        done = [e for e in events if e.get("event") == "done"]
+        assert done and done[0]["attn"] == "ring"
+        assert done[0]["mesh"] == {"dp": 2, "sp": 2, "tp": 2}
+        assert done[0]["tokens_per_sec"] > 0
+
     def test_llama_shard_serves(self, tmp_path, capsys, monkeypatch):
         monkeypatch.chdir(tmp_path)
         rc = worker.main(["llama", "--preset", "tiny", "--gen-len", "4"])

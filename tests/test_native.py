@@ -394,3 +394,63 @@ def test_pod_replace_destroys_volumes(native_bins, tmp_path):
         agent.terminate()
         agent.wait(timeout=5)
         server.stop()
+
+
+HEALTH_YML = """
+name: native-health
+pods:
+  web:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "touch healthy && sleep 600"
+        cpus: 0.5
+        memory: 128
+        health-check:
+          cmd: "test -f healthy"
+          interval: 0.2
+          grace-period: 0.5
+          max-consecutive-failures: 2
+"""
+
+
+def test_failing_health_check_kills_and_recovers(native_bins, tmp_path):
+    """Liveness: after grace, repeated probe failures kill the task with
+    TASK_FAILED and recovery relaunches it (reference HealthCheckSpec)."""
+    cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.05)
+    sched = ServiceScheduler(load_service_yaml_str(HEALTH_YML),
+                             MemPersister(), cluster)
+    server = ApiServer(sched, port=0, cluster=cluster)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    sandbox_root = tmp_path / "sb"
+    agent = subprocess.Popen(
+        [str(native_bins / "tpu-agent"), "--scheduler", url,
+         "--agent-id", "h0", "--hostname", "node0",
+         "--cpus", "4", "--memory-mb", "4096", "--disk-mb", "10000",
+         "--base-dir", str(sandbox_root), "--poll-interval", "0.05",
+         "--tpu-chips", "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        drive_to(sched, "deploy", Status.COMPLETE)
+        task = sched.state.fetch_task("web-0-server")
+        sandbox = wait_for(
+            lambda: next(iter(sandbox_root.glob(f"{task.task_id}")), None),
+            message="sandbox")
+        # break the health contract: remove the file the probe tests
+        (sandbox / "healthy").unlink()
+
+        def failed_then_recovered():
+            sched.run_cycle()
+            new = sched.state.fetch_task("web-0-server")
+            status = sched.state.fetch_status("web-0-server")
+            return (new and new.task_id != task.task_id and status
+                    and status.task_id == new.task_id
+                    and status.state is TaskState.RUNNING)
+        wait_for(failed_then_recovered, timeout=30,
+                 message="health-kill then recovery relaunch")
+    finally:
+        agent.terminate()
+        agent.wait(timeout=5)
+        server.stop()
