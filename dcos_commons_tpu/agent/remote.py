@@ -70,6 +70,7 @@ class RemoteCluster:
                 "health_max_failures": l.health_max_failures,
                 "health_timeout_s": l.health_timeout_s,
                 "health_delay_s": l.health_delay_s,
+                "kill_grace_s": l.kill_grace_s,
                 "readiness_check_cmd": l.readiness_check_cmd,
                 "readiness_interval_s": l.readiness_interval_s,
                 "readiness_timeout_s": l.readiness_timeout_s,

@@ -91,6 +91,24 @@ def _describe_cmd(client: Client, args) -> int:
     return _emit(*client.get("configurations/target"))
 
 
+def _update_cmd(client: Client, args) -> int:
+    """Reference ``dcos <svc> update start --options=...``: push new
+    package options (env) and/or a new service YAML; the scheduler
+    re-validates and rolls only the changed pods."""
+    env = {}
+    for pair in args.set or ():
+        if "=" not in pair:
+            print(f"--set needs KEY=VALUE, got {pair!r}", file=sys.stderr)
+            return 2
+        key, value = pair.split("=", 1)
+        env[key] = value
+    body = {"env": env}
+    if args.yaml:
+        with open(args.yaml) as f:
+            body["yaml"] = f.read()
+    return _emit(*client.post("update", json.dumps(body).encode()))
+
+
 def _config_cmd(client: Client, args) -> int:
     if args.action == "list":
         return _emit(*client.get("configurations"))
@@ -148,6 +166,12 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("describe",
                    help="show target configuration").set_defaults(
         fn=_describe_cmd)
+
+    upd = sub.add_parser("update", help="live config update (new options)")
+    upd.add_argument("--set", action="append", metavar="KEY=VALUE",
+                     help="env/option override (repeatable)")
+    upd.add_argument("--yaml", help="replacement service YAML file")
+    upd.set_defaults(fn=_update_cmd)
 
     cfg = sub.add_parser("config", help="configuration history")
     cfg.add_argument("action", choices=["list", "show", "target-id"])

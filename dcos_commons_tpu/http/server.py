@@ -9,6 +9,7 @@ multi-service schedulers additionally mount each added service at
 from __future__ import annotations
 
 import json
+import os
 import logging
 import re
 import threading
@@ -96,6 +97,47 @@ class _Routes:
             lambda m, p, b: configs.target_id())
         add("GET", r"configurations/target", lambda m, p, b: configs.target())
         add("GET", r"configurations/([^/]+)", lambda m, p, b: configs.get(m[0]))
+
+        # live config update (reference `dcos <svc> update start`): body is
+        # {"env": {...}} rendered through the scheduler's respec hook, or
+        # {"yaml": "...", "env": {...}} rendered directly
+        def update_service(body: Optional[bytes]):
+            if not body:
+                raise ApiError(400, "JSON body required")
+            try:
+                data = json.loads(body.decode())
+            except ValueError:
+                raise ApiError(400, "request body must be JSON") from None
+            env = data.get("env") or {}
+            if not isinstance(env, dict):
+                raise ApiError(400, "env must be an object")
+            try:
+                if data.get("yaml"):
+                    from ..specification import load_service_yaml_str
+                    # render against the scheduler process env (the boot
+                    # env source in every shipped main) with the request
+                    # env layered on top — so the same svc.yml that booted
+                    # the service round-trips through the update endpoint
+                    merged = dict(os.environ)
+                    merged.update(env)
+                    candidate = load_service_yaml_str(data["yaml"], merged)
+                elif getattr(scheduler, "respec", None) is not None:
+                    candidate = scheduler.respec(env)
+                else:
+                    raise ApiError(
+                        409, "scheduler has no respec hook; send {\"yaml\"}")
+            except ApiError:
+                raise
+            except Exception as e:
+                raise ApiError(400, f"cannot render candidate spec: {e}") \
+                    from None
+            result = scheduler.update_config(candidate)
+            payload = {"targetId": result.target_id,
+                       "accepted": result.accepted,
+                       "errors": list(result.errors)}
+            return (200 if result.accepted else 400), payload
+
+        add("POST", r"update", lambda m, p, b: update_service(b))
 
         # secrets (reference: DC/OS secrets service + SecretsClient; here
         # the scheduler owns them — names only on list, values write-only)

@@ -28,7 +28,8 @@ from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 from ..agent.inventory import AgentInfo, TaskRecord
 from ..plan.requirement import PodInstanceRequirement, RecoveryType
-from ..specification.spec import PodSpec, ResourceSet
+from ..specification.spec import (HealthCheckSpec, PodSpec,
+                                  ReadinessCheckSpec, ResourceSet)
 from ..state.tasks import TpuAssignment
 from ..utils.ids import make_task_id, new_uuid
 from .ledger import Availability, Reservation, ReservationLedger, VolumeReservation
@@ -72,6 +73,7 @@ class TaskLaunch:
     readiness_check_cmd: Optional[str] = None
     readiness_interval_s: float = 5.0
     readiness_timeout_s: float = 10.0
+    kill_grace_s: float = 0.0  # SIGTERM->SIGKILL window, agent-side kills
     uris: Tuple[str, ...] = ()  # fetched into the sandbox pre-launch
     # (reference: Mesos fetcher URIs, how sdk/bootstrap reaches the task)
     # raw sandbox files as (dest, base64-content): TLS artifacts and secret
@@ -433,6 +435,15 @@ class Evaluator:
                     raw_files.append(
                         (sec.file_path, base64.b64encode(value).decode()))
 
+        # a cmd override (pause) replaces the real workload, so its health/
+        # readiness probes must not run — the paused placeholder would fail
+        # them and the agent would kill-loop a deliberately-paused task
+        overridden = task_spec_name in requirement.cmd_overrides
+        hc = None if overridden else task_spec.health_check
+        rc = None if overridden else task_spec.readiness_check
+        # defaults come from the spec dataclasses, stated once
+        hc_d = hc or HealthCheckSpec(cmd="")
+        rc_d = rc or ReadinessCheckSpec(cmd="")
         return TaskLaunch(
             task_name=task_name,
             task_id=make_task_id(task_name),
@@ -450,26 +461,16 @@ class Evaluator:
             pod_instance=requirement.pod_instance.name,
             volumes=tuple(v.container_path for rs in pod.resource_sets
                           for v in rs.volumes),
-            health_check_cmd=task_spec.health_check.cmd if task_spec.health_check else None,
-            health_interval_s=(task_spec.health_check.interval_s
-                               if task_spec.health_check else 30.0),
-            health_grace_s=(task_spec.health_check.grace_period_s
-                            if task_spec.health_check else 60.0),
-            health_max_failures=(
-                task_spec.health_check.max_consecutive_failures
-                if task_spec.health_check else 3),
-            health_timeout_s=(task_spec.health_check.timeout_s
-                              if task_spec.health_check else 20.0),
-            health_delay_s=(task_spec.health_check.delay_s
-                            if task_spec.health_check else 0.0),
-            readiness_check_cmd=(
-                task_spec.readiness_check.cmd if task_spec.readiness_check else None),
-            readiness_interval_s=(
-                task_spec.readiness_check.interval_s
-                if task_spec.readiness_check else 5.0),
-            readiness_timeout_s=(
-                task_spec.readiness_check.timeout_s
-                if task_spec.readiness_check else 10.0),
+            health_check_cmd=hc.cmd if hc else None,
+            health_interval_s=hc_d.interval_s,
+            health_grace_s=hc_d.grace_period_s,
+            health_max_failures=hc_d.max_consecutive_failures,
+            health_timeout_s=hc_d.timeout_s,
+            health_delay_s=hc_d.delay_s,
+            readiness_check_cmd=rc.cmd if rc else None,
+            readiness_interval_s=rc_d.interval_s,
+            readiness_timeout_s=rc_d.timeout_s,
+            kill_grace_s=float(task_spec.kill_grace_period_s),
             uris=tuple(task_spec.uris),
         )
 

@@ -476,6 +476,12 @@ class RoundRobinByZoneRule(_RoundRobinRule):
     by: str = "zone"
 
 
+@_register("round-robin-region")
+@dataclass(frozen=True)
+class RoundRobinByRegionRule(_RoundRobinRule):
+    by: str = "region"
+
+
 @_register("task-type")
 @dataclass(frozen=True)
 class TaskTypeRule(PlacementRule):
@@ -636,4 +642,6 @@ class MaxPerAttributeRule(PlacementRule):
 
 _MAX_PER_TYPES = {"hostname": MaxPerHostnameRule, "zone": MaxPerZoneRule,
                   "region": MaxPerRegionRule}
-_ROUND_ROBIN_TYPES = {"hostname": RoundRobinByHostnameRule, "zone": RoundRobinByZoneRule}
+_ROUND_ROBIN_TYPES = {"hostname": RoundRobinByHostnameRule,
+                      "zone": RoundRobinByZoneRule,
+                      "region": RoundRobinByRegionRule}

@@ -81,6 +81,7 @@ class ServiceScheduler:
         # (StoredTask carries no launch timestamp of its own)
         self._unreported_since: Dict[str, float] = {}
         self.namespace = namespace
+        self._persister = persister
         self.state = StateStore(persister, namespace)
         self.configs = ConfigStore(persister, namespace)
         self.framework_store = FrameworkStore(persister)
@@ -89,6 +90,14 @@ class ServiceScheduler:
         self.uninstall_mode = uninstall
         # optional MetricsRegistry (reference metrics/Metrics.java counters)
         self.metrics = metrics
+        # kept for live config updates (update_config rebuilds plans)
+        self._validators = validators
+        self._failure_monitor = failure_monitor
+        self._recovery_overriders = recovery_overriders
+        # optional hook wired by the scheduler main: env overrides -> a
+        # re-rendered candidate ServiceSpec (the reference's Cosmos
+        # option-rendering step for `dcos <svc> update start --options`)
+        self.respec = None
 
         if uninstall:
             # teardown works against whatever config is already stored
@@ -128,51 +137,90 @@ class ServiceScheduler:
             self.recovery_manager = None
             self.coordinator = PlanCoordinator([self.deploy_manager])
         else:
-            from .decommission import DecommissionPlanManager
-            # Once the initial deployment has completed, a plan named
-            # `update` (when defined) replaces the deploy plan on every
-            # subsequent boot, keeping the `deploy` name so operators/CLI
-            # see one rollout surface. Keyed off the persisted
-            # deploy-completed marker so the choice is stable across
-            # scheduler restarts mid-rollout (reference
-            # SchedulerBuilder.selectDeployPlan:644-677 uses the same
-            # persisted has-completed-deployment signal).
-            update_plan_spec = (self.spec.plan("update")
-                                if self.state.deploy_completed() else None)
-            if update_plan_spec is not None:
-                deploy_plan = build_plan_from_spec(
-                    self.spec, update_plan_spec, self.state,
-                    self.target_config_id, self.backoff)
-                deploy_plan.name = "deploy"
-            else:
-                deploy_plan = build_deploy_plan(
-                    self.spec, self.state, self.target_config_id, self.backoff)
-            if self.config_errors:
-                deploy_plan.errors.extend(self.config_errors)
-            self.deploy_manager = PlanManager(deploy_plan)
-            self.recovery_manager = RecoveryPlanManager(
-                lambda: self.spec, self.state, failure_monitor, self.backoff,
-                recovery_overriders)
-            self.decommission_manager = DecommissionPlanManager(self)
-            # Sidecar plans (anything besides deploy/update) are created
-            # INTERRUPTED and run only when an operator starts them
-            # (reference SchedulerBuilder.java:155
-            # DefaultPlanManager.createInterrupted; cassandra backup/restore)
-            self.other_managers: List[PlanManager] = []
-            for ps in self.spec.plans:
-                if ps.name in ("deploy", "update"):
-                    continue
-                plan = build_plan_from_spec(
-                    self.spec, ps, self.state, self.target_config_id,
-                    self.backoff)
-                plan.interrupt()
-                self.other_managers.append(PlanManager(plan))
-            self.coordinator = PlanCoordinator(
-                [self.deploy_manager, self.recovery_manager,
-                 self.decommission_manager] + self.other_managers)
+            self._build_plan_managers()
 
         cluster.set_status_callback(self.handle_status)
         self.reconcile()
+
+    def _build_plan_managers(self) -> None:
+        """(Re)build all plan managers against the current target config —
+        at construction and again after a live config update."""
+        from .decommission import DecommissionPlanManager
+        # Once the initial deployment has completed, a plan named
+        # `update` (when defined) replaces the deploy plan on every
+        # subsequent boot, keeping the `deploy` name so operators/CLI
+        # see one rollout surface. Keyed off the persisted
+        # deploy-completed marker so the choice is stable across
+        # scheduler restarts mid-rollout (reference
+        # SchedulerBuilder.selectDeployPlan:644-677 uses the same
+        # persisted has-completed-deployment signal).
+        update_plan_spec = (self.spec.plan("update")
+                            if self.state.deploy_completed() else None)
+        if update_plan_spec is not None:
+            deploy_plan = build_plan_from_spec(
+                self.spec, update_plan_spec, self.state,
+                self.target_config_id, self.backoff)
+            deploy_plan.name = "deploy"
+        else:
+            deploy_plan = build_deploy_plan(
+                self.spec, self.state, self.target_config_id, self.backoff)
+        if self.config_errors:
+            deploy_plan.errors.extend(self.config_errors)
+        self.deploy_manager = PlanManager(deploy_plan)
+        self.recovery_manager = RecoveryPlanManager(
+            lambda: self.spec, self.state, self._failure_monitor,
+            self.backoff, self._recovery_overriders)
+        self.decommission_manager = DecommissionPlanManager(self)
+        # Sidecar plans (anything besides deploy/update) are created
+        # INTERRUPTED and run only when an operator starts them
+        # (reference SchedulerBuilder.java:155
+        # DefaultPlanManager.createInterrupted; cassandra backup/restore)
+        self.other_managers: List[PlanManager] = []
+        for ps in self.spec.plans:
+            if ps.name in ("deploy", "update"):
+                continue
+            plan = build_plan_from_spec(
+                self.spec, ps, self.state, self.target_config_id,
+                self.backoff)
+            plan.interrupt()
+            self.other_managers.append(PlanManager(plan))
+        self.coordinator = PlanCoordinator(
+            [self.deploy_manager, self.recovery_manager,
+             self.decommission_manager] + self.other_managers)
+
+    def update_config(self, candidate: ServiceSpec) -> UpdateResult:
+        """Live config update (reference ``dcos <svc> update start``: Cosmos
+        re-launches the scheduler with new options and the updater diffs at
+        boot; here the same diff/validate/retarget runs in place and the
+        plans are rebuilt so changed pods roll without a process restart)."""
+        with self._lock:
+            if self.uninstall_mode:
+                return UpdateResult(self.target_config_id,
+                                    ("service is uninstalling",))
+            update = ConfigurationUpdater(
+                self.configs, self.state, self._validators).update(candidate)
+            if update.accepted and update.target_id != self.target_config_id:
+                self.config_errors = ()
+                self.target_config_id = update.target_id
+                self.spec = self.configs.fetch(update.target_id)
+                self._rebuild_evaluator()
+                self._build_plan_managers()
+            return update
+
+    def _rebuild_evaluator(self) -> None:
+        """The evaluator captures per-spec security wiring (TLS provisioner
+        exists only when a task asks for transport-encryption) — a live
+        update that introduces TLS must rebuild it or new launches would
+        silently ship without certs."""
+        from ..security import TLSProvisioner
+        uses_tls = any(t.transport_encryption
+                       for p in self.spec.pods for t in p.tasks)
+        if uses_tls and self.tls_provisioner is None:
+            self.tls_provisioner = TLSProvisioner(self._persister,
+                                                  self.spec.name)
+        self.evaluator = Evaluator(self.spec.name, self.outcome_tracker,
+                                   tls_provisioner=self.tls_provisioner,
+                                   secrets_store=self.secrets)
 
     @property
     def uninstall_complete(self) -> bool:

@@ -69,6 +69,7 @@ def main(argv=None) -> int:
     persister = FilePersister(args.state)
     cluster = RemoteCluster()
     scheduler = build_scheduler(persister, cluster, metrics=metrics)
+    scheduler.respec = lambda env: load_spec(env)
     server = ApiServer(scheduler, port=args.port, metrics=metrics,
                        cluster=cluster)
     PlanReporter(metrics, scheduler)

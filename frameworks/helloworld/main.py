@@ -70,6 +70,10 @@ def main(argv=None) -> int:
         spec = scenarios.load_scenario(args.scenario[0])
         scheduler = ServiceScheduler(spec, persister, cluster,
                                      metrics=metrics)
+        # live updates: re-render this scenario with new option env
+        scheduler.respec = (
+            lambda env, _name=args.scenario[0]:
+            scenarios.load_scenario(_name, env))
         server = ApiServer(scheduler, port=args.port, metrics=metrics,
                            cluster=cluster)
         PlanReporter(metrics, scheduler)

@@ -59,6 +59,8 @@ def main(argv=None) -> int:
     cluster = RemoteCluster()
     spec = scenarios.load_scenario(args.scenario)
     scheduler = ServiceScheduler(spec, persister, cluster, metrics=metrics)
+    scheduler.respec = (lambda env, _name=args.scenario:
+                        scenarios.load_scenario(_name, env))
     server = ApiServer(scheduler, port=args.port, metrics=metrics,
                        cluster=cluster)
     PlanReporter(metrics, scheduler)

@@ -80,6 +80,7 @@ struct RunningTask {
   int health_max_failures = 3;
   int health_failures = 0;
   bool health_killed = false;  // TASK_FAILED already emitted by the probe
+  double kill_grace = 5;       // SIGTERM->SIGKILL window for agent kills
   bool kill_requested = false;
   double sigkill_deadline = 0;    // when to escalate SIGTERM -> SIGKILL
 };
@@ -538,6 +539,7 @@ class Agent {
                          task.get("health_delay_s").as_number(0);
     rt.health_max_failures =
         static_cast<int>(task.get("health_max_failures").as_number(3));
+    rt.kill_grace = task.get("kill_grace_s").as_number(5);
     for (const auto& [k, v] : task.get("env").fields()) {
       rt.env[k] = v.as_string();
     }
@@ -629,7 +631,9 @@ class Agent {
       t.kill_requested = true;
       t.health_killed = true;
       ::kill(-t.pid, SIGTERM);
-      t.sigkill_deadline = now_s() + 5;
+      // honor the task's configured shutdown window (kill-grace-period),
+      // same as scheduler-initiated kills
+      t.sigkill_deadline = now_s() + t.kill_grace;
     }
   }
 

@@ -65,3 +65,21 @@ def test_cli_error_exit_code(server, capsys):
     _, base = server
     rc = main(["--url", base, "plan", "show", "bogus"])
     assert rc == 1
+
+
+def test_update_command(server, capsys, tmp_path):
+    sched, base = server
+    from tests.test_http import YML
+    new_yaml = tmp_path / "svc.yml"
+    new_yaml.write_text(YML.replace("count: 2", "count: 3"))
+    result = run_cli(base, "update", "--yaml", str(new_yaml), capsys=capsys)
+    assert result["accepted"]
+    sched.run_until_quiet()
+    assert sched.spec.pod("hello").count == 3
+
+    # invalid update -> exit 1, errors shown
+    bad_yaml = tmp_path / "bad.yml"
+    bad_yaml.write_text(YML.replace("name: websvc", "name: other"))
+    result = run_cli(base, "update", "--yaml", str(bad_yaml), expect=1,
+                     capsys=capsys)
+    assert result["errors"]

@@ -207,3 +207,57 @@ def test_multi_service_mounts():
         get(base, "/v1/plans", expect=404)  # no default mounted
     finally:
         server.stop()
+
+
+class TestLiveUpdate:
+    """POST /v1/update (reference `dcos <svc> update start`)."""
+
+    UPDATED = YML.replace("count: 2", "count: 3")
+
+    def test_yaml_update_rolls_new_pod(self, api):
+        sched, base = api
+        code, body = post(base, "/v1/update",
+                          json.dumps({"yaml": self.UPDATED}).encode())
+        assert code == 200 and body["accepted"]
+        sched.run_until_quiet()
+        assert sched.spec.pod("hello").count == 3
+        assert sched.state.fetch_status("hello-2-server") is not None
+        assert sched.plan("deploy").status is Status.COMPLETE
+
+    def test_rejected_update_keeps_target(self, api):
+        sched, base = api
+        old_target = sched.target_config_id
+        bad = YML.replace("count: 2", "count: 1")  # shrink w/o decommission?
+        # shrinking IS allowed (allow-decommission defaults true); use a
+        # genuinely invalid change instead: rename the service
+        bad = YML.replace("name: websvc", "name: renamed")
+        code, body = post(base, "/v1/update",
+                          json.dumps({"yaml": bad}).encode(), expect=400)
+        assert code == 400 and not body["accepted"]
+        assert body["errors"]
+        assert sched.target_config_id == old_target
+
+    def test_env_update_requires_respec_or_yaml(self, api):
+        sched, base = api
+        code, _ = post(base, "/v1/update",
+                       json.dumps({"env": {"X": "1"}}).encode(), expect=409)
+        assert code == 409
+
+    def test_env_update_via_respec(self, api):
+        sched, base = api
+        sched.respec = lambda env: load_service_yaml_str(
+            YML.replace("count: 2", "count: {{COUNT}}"),
+            {"COUNT": env.get("COUNT", "2")})
+        code, body = post(base, "/v1/update",
+                          json.dumps({"env": {"COUNT": "3"}}).encode())
+        assert code == 200 and body["accepted"]
+        sched.run_until_quiet()
+        assert sched.spec.pod("hello").count == 3
+
+    def test_noop_update_is_accepted_without_rebuild(self, api):
+        sched, base = api
+        deploy_before = sched.plan("deploy")
+        code, body = post(base, "/v1/update",
+                          json.dumps({"yaml": YML}).encode())
+        assert code == 200 and body["accepted"]
+        assert sched.plan("deploy") is deploy_before  # same objects: no-op

@@ -348,3 +348,38 @@ class TestTpuGang:
         assert w0.task_id != w0_before.task_id              # gang re-form
         assert w0.agent_id == w0_before.agent_id            # in place
         assert w0.tpu.process_id == 0 and w1.tpu.process_id == 1
+
+
+class TestPauseProbes:
+    YML = """
+name: probesvc
+pods:
+  web:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: ./serve
+        cpus: 0.5
+        memory: 128
+        health-check: {cmd: "check", interval: 1, grace-period: 1}
+        readiness-check: {cmd: "ready", interval: 1}
+"""
+
+    def test_paused_task_ships_no_probes(self):
+        # the pause placeholder cmd would fail the real probes and the
+        # agent would kill-loop a deliberately-paused task
+        sched, cluster, _ = make(self.YML)
+        sched.run_until_quiet()
+        launch = cluster.launch_log[-1].launches[0]
+        assert launch.health_check_cmd == "check"
+        sched.pause_pod("web-0")
+        sched.run_until_quiet()
+        paused = cluster.launch_log[-1].launches[0]
+        assert paused.cmd == sched.PAUSE_CMD
+        assert paused.health_check_cmd is None
+        assert paused.readiness_check_cmd is None
+        sched.resume_pod("web-0")
+        sched.run_until_quiet()
+        resumed = cluster.launch_log[-1].launches[0]
+        assert resumed.health_check_cmd == "check"
