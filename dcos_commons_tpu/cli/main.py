@@ -95,6 +95,10 @@ def _update_cmd(client: Client, args) -> int:
     """Reference ``dcos <svc> update start --options=...``: push new
     package options (env) and/or a new service YAML; the scheduler
     re-validates and rolls only the changed pods."""
+    if not args.set and not args.yaml:
+        print("update: provide --set KEY=VALUE and/or --yaml FILE",
+              file=sys.stderr)
+        return 2
     env = {}
     for pair in args.set or ():
         if "=" not in pair:

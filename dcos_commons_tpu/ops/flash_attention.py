@@ -1,4 +1,4 @@
-"""Pallas TPU flash attention (forward).
+"""Pallas TPU flash attention (fused forward AND backward).
 
 The hot op of the workload layer (``frameworks/jax`` llama training/serving):
 online-softmax blockwise attention that never materializes the [Sq, Sk]
@@ -7,9 +7,15 @@ time, with running max/denominator carried in VMEM scratch across the
 sequential k-block grid axis (TPU grids iterate sequentially, so the
 innermost axis doubles as the flash accumulation loop).
 
+Backward is the FlashAttention-2 recomputation scheme: the forward saves
+only O and the per-row logsumexp; two kernels (dK/dV over k-blocks, dQ
+over q-blocks) recompute P tile by tile — again never materializing the
+score matrix — with ``D = rowsum(dO * O)`` precomputed in XLA.
+
 GQA comes free through the BlockSpec index map: each query head reads its
 kv-group's K/V block directly — no ``repeat_kv`` materialization at all
-(the dense path pays that broadcast in HBM).
+(the dense path pays that broadcast in HBM). Backward computes per-q-head
+dK/dV and group-sums once in XLA.
 
 Layout matches ``ops.attention``: q [B, Sq, H, D], k/v [B, Sk, KV, D].
 Causal masking is positional (``q_offset`` shifts query positions); blocks
@@ -30,9 +36,30 @@ _NEG = -1e30
 _LANES = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  sm_scale: float, causal: bool, q_offset: int,
-                  block_q: int, block_k: int):
+def _fit_block(requested: int, seq: int) -> int:
+    """Largest power-of-two block <= requested that divides seq (callers
+    guarantee seq % 8 == 0 via ``supports``)."""
+    b = 1 << (min(requested, seq).bit_length() - 1)  # floor to power of two
+    while b > 8 and seq % b:
+        b //= 2
+    return b
+
+
+def _causal_mask(iq, ik, block_q, block_k, q_offset, shape, transpose=False):
+    q_axis, k_axis = (1, 0) if transpose else (0, 1)
+    q_pos = (q_offset + iq * block_q
+             + jax.lax.broadcasted_iota(jnp.int32, shape, q_axis))
+    k_pos = (ik * block_k
+             + jax.lax.broadcasted_iota(jnp.int32, shape, k_axis))
+    return q_pos >= k_pos
+
+
+# --------------------------------------------------------------------------
+# forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale: float, causal: bool, q_offset: int,
+                block_q: int, block_k: int):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
     n_k = pl.num_programs(3)
@@ -46,8 +73,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     # causal: a k-block strictly above this q-block's last row contributes
     # nothing — skip its compute entirely (the win over masked-dense)
     q_last = q_offset + (iq + 1) * block_q - 1
-    k_first = ik * block_k
-    live = jnp.logical_or(not causal, k_first <= q_last)
+    live = jnp.logical_or(not causal, ik * block_k <= q_last)
 
     @pl.when(live)
     def _body():
@@ -61,11 +87,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
             preferred_element_type=jnp.float32) * sm_scale
         mask = None
         if causal:
-            q_pos = (q_offset + iq * block_q
-                     + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
-            k_pos = (ik * block_k
-                     + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
-            mask = q_pos >= k_pos
+            mask = _causal_mask(iq, ik, block_q, block_k, q_offset, s.shape)
             s = jnp.where(mask, s, _NEG)
 
         m_prev = m_scr[:, :1]                            # [bq, 1]
@@ -88,69 +110,27 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     @pl.when(ik == n_k - 1)
     def _finish():
         # fully-masked rows (possible with q_offset < 0 padding) get 0, not
-        # NaN: guard the 1/l
+        # NaN: guard the 1/l; their logsumexp is recorded as _NEG so the
+        # backward recomputation also zeroes them
         l = l_scr[:, :1]
         safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[:] / safe).astype(o_ref.dtype)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, sm_scale, q_offset, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, sm_scale, q_offset, block_q,
-                          block_k, interpret)
-
-
-def _flash_fwd(q, k, v, *nondiff):
-    return _flash(q, k, v, *nondiff), (q, k, v)
-
-
-def _flash_bwd(causal, sm_scale, q_offset, block_q, block_k, interpret,
-               res, g):
-    # Backward recomputes through the (differentiable) dense reference —
-    # identical math, so gradients are exact; the flash win applies to the
-    # forward/serving path while training remains correct everywhere.
-    # (A fused flash backward kernel is the natural next optimization.)
-    from .attention import gqa_attention
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: gqa_attention(
-            q_, k_, v_, causal=causal, sm_scale=sm_scale, q_offset=q_offset),
-        q, k, v)
-    return vjp(g)
-
-
-_flash.defvjp(_flash_fwd, _flash_bwd)
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("causal", "sm_scale", "q_offset", "block_q", "block_k",
-                     "interpret"))
-def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
-                    causal: bool = True,
-                    sm_scale: Optional[float] = None,
-                    q_offset: int = 0,
-                    block_q: int = 128,
-                    block_k: int = 128,
-                    interpret: bool = False) -> jnp.ndarray:
-    """Drop-in for ``ops.attention.gqa_attention`` on full sequences.
-
-    q: [B, Sq, H, D]; k/v: [B, Sk, KV, D], H % KV == 0. Sequence lengths
-    must divide the block sizes (callers pad or fall back to dense).
-    Differentiable: the backward pass runs the dense reference VJP.
-    """
-    return _flash(q, k, v, causal, sm_scale, q_offset, block_q, block_k,
-                  interpret)
+        # lse rides in an [8, block_q] tile (8 identical sublanes): TPU
+        # block shapes need the second-to-last dim divisible by 8
+        lse = jnp.where(l[:, 0] == 0.0, _NEG,
+                        m_scr[:, 0] + jnp.log(safe[:, 0]))
+        lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], lse_ref[0, 0].shape)
 
 
 def _flash_forward(q, k, v, causal, sm_scale, q_offset, block_q, block_k,
                    interpret):
+    """Returns (o [B,Sq,H,D], lse [B,H,Sq] fp32)."""
     b, s_q, h, d = q.shape
     _, s_k, kv, _ = k.shape
     assert h % kv == 0, (h, kv)
     n_rep = h // kv
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_k)
+    block_q = _fit_block(block_q, s_q)
+    block_k = _fit_block(block_k, s_k)
     assert s_q % block_q == 0 and s_k % block_k == 0, (s_q, s_k)
     scale = sm_scale if sm_scale is not None else d ** -0.5
 
@@ -161,9 +141,9 @@ def _flash_forward(q, k, v, causal, sm_scale, q_offset, block_q, block_k,
 
     grid = (b, h, s_q // block_q, s_k // block_k)
     kernel = functools.partial(
-        _flash_kernel, sm_scale=scale, causal=causal, q_offset=q_offset,
+        _fwd_kernel, sm_scale=scale, causal=causal, q_offset=q_offset,
         block_q=block_q, block_k=block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -176,9 +156,16 @@ def _flash_forward(q, k, v, causal, sm_scale, q_offset, block_q, block_k,
                          lambda bi, hi, qi, ki, n_rep=n_rep:
                          (bi, hi // n_rep, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda bi, hi, qi, ki: (bi, hi, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, 8, s_q), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # running denom
@@ -192,16 +179,273 @@ def _flash_forward(q, k, v, causal, sm_scale, q_offset, block_q, block_k,
         ),
         interpret=interpret,
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    return out.transpose(0, 2, 1, 3), lse  # lse: [b, h, 8, sq] (8 copies)
 
 
-def supports(q: jnp.ndarray, k: jnp.ndarray, *, kv_len=None,
-             block_q: int = 128, block_k: int = 128) -> bool:
+# --------------------------------------------------------------------------
+# backward (FlashAttention-2 recomputation)
+
+def _recompute_p(q, k, lse_rows, iq, ik, block_q, block_k, causal, q_offset,
+                 sm_scale, transpose):
+    """P tile from saved logsumexp. ``transpose``: [bk, bq] layout."""
+    if transpose:
+        s = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale - lse_rows[None, :]
+    else:
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale - lse_rows[:, None]
+    p = jnp.exp(s)
+    if causal:
+        mask = _causal_mask(iq, ik, block_q, block_k, q_offset, s.shape,
+                            transpose=transpose)
+        p = jnp.where(mask, p, 0.0)
+    # rows whose lse is the _NEG sentinel are fully masked: exp(s + 1e30)
+    # would explode, so zero them explicitly. f32 multiply, not a bool
+    # where: Mosaic can't insert a minor dim on 1-bit vectors
+    alive = (lse_rows > _NEG / 2).astype(jnp.float32)
+    p = p * (alive[None, :] if transpose else alive[:, None])
+    return p
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_scr, dv_scr, *,
+                     sm_scale, causal, q_offset, block_q, block_k):
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    n_q = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_last = q_offset + (iq + 1) * block_q - 1
+    live = jnp.logical_or(not causal, ik * block_k <= q_last)
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0, 0]                  # [bq, d]
+        k = k_ref[0, 0]                  # [bk, d]
+        v = v_ref[0, 0]                  # [bk, d]
+        do = do_ref[0, 0]                # [bq, d]
+        lse = lse_ref[0, 0][0]           # [bq] f32 (row 0 of the 8 copies)
+        delta = delta_ref[0, 0][0]       # [bq] f32 (rowsum(dO*O))
+        p_t = _recompute_p(q, k, lse, iq, ik, block_q, block_k, causal,
+                           q_offset, sm_scale, transpose=True)   # [bk, bq]
+        dv_scr[:] += jax.lax.dot_general(
+            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp_t = jax.lax.dot_general(      # [bk, bq]
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds_t = p_t * (dp_t - delta[None, :]) * sm_scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == n_q - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *,
+                   sm_scale, causal, q_offset, block_q, block_k):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_last = q_offset + (iq + 1) * block_q - 1
+    live = jnp.logical_or(not causal, ik * block_k <= q_last)
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][0]
+        delta = delta_ref[0, 0][0]
+        p = _recompute_p(q, k, lse, iq, ik, block_q, block_k, causal,
+                         q_offset, sm_scale, transpose=False)    # [bq, bk]
+        dp = jax.lax.dot_general(        # [bq, bk]
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal, sm_scale, q_offset,
+                    block_q, block_k, interpret):
+    b, s_q, h, d = q.shape
+    _, s_k, kv, _ = k.shape
+    n_rep = h // kv
+    block_q = _fit_block(block_q, s_q)
+    block_k = _fit_block(block_k, s_k)
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+
+    qt = q.transpose(0, 2, 1, 3)          # [b, h, sq, d]
+    kt = k.transpose(0, 2, 1, 3)          # [b, kv, sk, d]
+    vt = v.transpose(0, 2, 1, 3)
+    dot = g.transpose(0, 2, 1, 3)         # [b, h, sq, d]
+    ot = o.transpose(0, 2, 1, 3)
+    # D = rowsum(dO * O): cheap elementwise+reduce, left to XLA; broadcast
+    # into the same [b, h, 8, sq] sublane layout as lse
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1)              # [b, h, sq] f32
+    delta = jnp.broadcast_to(delta[:, :, None, :], lse.shape)
+
+    common = dict(sm_scale=scale, causal=causal, q_offset=q_offset,
+                  block_q=block_q, block_k=block_k)
+
+    # ---- dK/dV: grid (b, h, k-blocks, q-blocks), q innermost ----
+    dkdv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, **common),
+        grid=(b, h, s_k // block_k, s_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda bi, hi, ki, qi: (bi, hi, 0, qi)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda bi, hi, ki, qi: (bi, hi, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s_k, d), k.dtype),  # per q-head
+            jax.ShapeDtypeStruct((b, h, s_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+    dk_ph, dv_ph = dkdv
+    # GQA: group-sum per-q-head grads down to the kv heads
+    dk = dk_ph.reshape(b, kv, n_rep, s_k, d).sum(axis=2)
+    dv = dv_ph.reshape(b, kv, n_rep, s_k, d).sum(axis=2)
+
+    # ---- dQ: grid (b, h, q-blocks, k-blocks), k innermost ----
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b, h, s_q // block_q, s_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda bi, hi, qi, ki: (bi, hi, 0, qi)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda bi, hi, qi, ki: (bi, hi, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    return (dq.transpose(0, 2, 1, 3),
+            dk.transpose(0, 2, 1, 3),
+            dv.transpose(0, 2, 1, 3))
+
+
+# --------------------------------------------------------------------------
+# custom VJP plumbing
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, sm_scale, q_offset, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, causal, sm_scale, q_offset, block_q,
+                            block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, q_offset, block_q, block_k,
+               interpret):
+    out, lse = _flash_forward(q, k, v, causal, sm_scale, q_offset, block_q,
+                              block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, q_offset, block_q, block_k, interpret,
+               res, g):
+    q, k, v, o, lse = res
+    return _flash_backward(q, k, v, o, lse, g, causal, sm_scale, q_offset,
+                           block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "sm_scale", "q_offset", "block_q", "block_k",
+                     "interpret"))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    q_offset: int = 0,
+                    block_q: int = 512,
+                    block_k: int = 512,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Drop-in for ``ops.attention.gqa_attention`` on full sequences.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, KV, D], H % KV == 0. Sequence lengths
+    must divide the block sizes (callers pad or fall back to dense).
+    Fully differentiable: both directions run fused pallas kernels.
+    """
+    return _flash(q, k, v, causal, sm_scale, q_offset, block_q, block_k,
+                  interpret)
+
+
+def supports(q: jnp.ndarray, k: jnp.ndarray, *, kv_len=None) -> bool:
     """Whether the flash path can serve this call (else dense fallback)."""
     s_q, s_k = q.shape[1], k.shape[1]
     if kv_len is not None:
         return False  # padded decode caches use the dense path
     if q.shape[-1] > 256:
         return False  # head_dim beyond a VMEM-friendly tile
-    return (s_q % min(block_q, s_q) == 0 and s_k % min(block_k, s_k) == 0
-            and s_q >= 8 and s_k >= 128)
+    # q blocks self-fit to any multiple of 8 (see _fit_block); k blocks
+    # must stay lane-width multiples — an s_k with small odd factors would
+    # degrade to 8-wide tiles and lose to the dense path it replaces
+    return s_q % 8 == 0 and s_k % 128 == 0

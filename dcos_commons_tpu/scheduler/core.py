@@ -119,16 +119,11 @@ class ServiceScheduler:
         self.backoff = backoff or DisabledBackoff()
         self.outcome_tracker = OutcomeTracker()
         # security: secrets always available; the CA spins up only when a
-        # task actually asks for transport-encryption
-        from ..security import SecretsStore, TLSProvisioner
+        # task actually asks for transport-encryption (_rebuild_evaluator)
+        from ..security import SecretsStore
         self.secrets = SecretsStore(persister, namespace)
-        uses_tls = any(t.transport_encryption
-                       for p in self.spec.pods for t in p.tasks)
-        self.tls_provisioner = (TLSProvisioner(persister, self.spec.name)
-                                if uses_tls else None)
-        self.evaluator = Evaluator(self.spec.name, self.outcome_tracker,
-                                   tls_provisioner=self.tls_provisioner,
-                                   secrets_store=self.secrets)
+        self.tls_provisioner = None
+        self._rebuild_evaluator()
         self.ledger = self.reservation_store.load_ledger()
 
         if uninstall:

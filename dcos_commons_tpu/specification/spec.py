@@ -231,7 +231,10 @@ class TaskSpec:
     readiness_check: Optional[ReadinessCheckSpec] = None
     discovery: Optional[DiscoverySpec] = None
     essential: bool = True
-    kill_grace_period_s: int = 0
+    # SIGTERM->SIGKILL escalation window; 5s default mirrors the Mesos
+    # executor shutdown grace so un-configured tasks still get a chance to
+    # exit cleanly (health-check kills and scheduler kills both honor it)
+    kill_grace_period_s: int = 5
     uris: tuple[str, ...] = ()
     transport_encryption: tuple[TransportEncryptionSpec, ...] = ()
 

@@ -285,7 +285,7 @@ def _map_task(name: str, raw: Mapping[str, Any], rs_id: str,
             visibility=disc_raw.get("visibility", "CLUSTER"),
         ) if disc_raw else None,
         essential=bool(raw.get("essential", True)),
-        kill_grace_period_s=int(raw.get("kill-grace-period", 0)),
+        kill_grace_period_s=int(raw.get("kill-grace-period", 5)),
         uris=tuple(raw.get("uris") or ()),
         transport_encryption=tuple(
             TransportEncryptionSpec(name=te["name"])
