@@ -179,10 +179,30 @@ def _constrain(x, mesh: Optional[Mesh], *spec):
     return lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
+def apply_layer(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
+                rope, attn_fn: Callable,
+                mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """One decoder layer on activations x [B, S, D] (shared by the dense
+    forward's scan and the pipeline-parallel stage bodies)."""
+    b, s, _ = x.shape
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ lp["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ lp["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = apply_rope(q, rope)
+    k = apply_rope(k, rope)
+    o = attn_fn(q, k, v)  # GQA expansion is the impl's business
+    x = x + o.reshape(b, s, -1) @ lp["wo"]
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
+    up = (h @ lp["w_up"]).astype(jnp.float32)
+    x = x + ((gate * up).astype(cfg.dtype) @ lp["w_down"])
+    return _constrain(x, mesh, "dp", "sp", None)
+
+
 def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
             mesh: Optional[Mesh] = None) -> jnp.ndarray:
     """tokens [B, S] int32 -> logits [B, S, V] fp32."""
-    b, s = tokens.shape
     rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     attn_fn = _make_attn_fn(cfg, mesh)
 
@@ -190,26 +210,70 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     x = _constrain(x, mesh, "dp", "sp", None)
 
     def layer(x, lp):
-        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-        k = (h @ lp["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ lp["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-        q = apply_rope(q, rope)
-        k = apply_rope(k, rope)
-        o = attn_fn(q, k, v)  # GQA expansion is the impl's business
-        x = x + o.reshape(b, s, -1) @ lp["wo"]
-        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
-        up = (h @ lp["w_up"]).astype(jnp.float32)
-        x = x + ((gate * up).astype(cfg.dtype) @ lp["w_down"])
-        x = _constrain(x, mesh, "dp", "sp", None)
-        return x, None
+        return apply_layer(cfg, x, lp, rope, attn_fn, mesh), None
 
     body = jax.checkpoint(layer) if cfg.remat else layer
     x, _ = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return _constrain(logits, mesh, "dp", "sp", None)
+
+
+def stack_pipeline_params(params: Params, pp: int) -> Params:
+    """Reshape stacked layer weights [L, ...] -> [pp, L/pp, ...] for the
+    ``make_pipeline`` stage axis; embed/norm/lm_head stay replicated."""
+    layers = jax.tree.map(
+        lambda a: a.reshape(pp, a.shape[0] // pp, *a.shape[1:]),
+        params["layers"])
+    return {**params, "layers": layers}
+
+
+def pipeline_param_specs(cfg: LlamaConfig) -> Params:
+    """Sharding for the pipelined layout: layer stacks over ``pp``."""
+    return {
+        "embed": P(),
+        "layers": jax.tree.map(lambda _: P("pp"),
+                               param_specs(cfg)["layers"]),
+        "norm": P(),
+        "lm_head": P(),
+    }
+
+
+def forward_pipelined(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                      mesh: Mesh, n_micro: int) -> jnp.ndarray:
+    """Pipeline-parallel forward (SURVEY.md §2.4 PP): the decoder trunk is
+    stage-sharded over the ``pp`` mesh axis and microbatches stream through
+    the GPipe fill/drain schedule (``parallel.pipeline``); embed / final
+    norm / lm_head run replicated outside the pipeline.
+
+    ``params`` must be in the :func:`stack_pipeline_params` layout with
+    ``cfg.n_layers %% pp == 0`` and ``B %% n_micro == 0``.
+    """
+    from dcos_commons_tpu.parallel.pipeline import make_pipeline
+
+    b, s = tokens.shape
+    rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    attn_fn = lambda q, k, v: gqa_attention(q, k, v, causal=True)  # noqa: E731
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    xm = x.reshape(n_micro, b // n_micro, s, -1)
+
+    def stage_fn(stage_layers, x_mb):
+        def body(x_, lp):
+            return apply_layer(cfg, x_, lp, rope, attn_fn), None
+        out, _ = lax.scan(body, x_mb, stage_layers)
+        return out
+
+    pipe = make_pipeline(mesh, stage_fn)
+    x = pipe(params["layers"], xm).reshape(b, s, -1)
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn_pipelined(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                      mesh: Mesh, n_micro: int):
+    logits = forward_pipelined(cfg, params, tokens[:, :-1], mesh, n_micro)
+    return softmax_cross_entropy(logits, tokens[:, 1:], z_loss=1e-4)
 
 
 def loss_fn(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,

@@ -430,8 +430,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     interpret: bool = False) -> jnp.ndarray:
     """Drop-in for ``ops.attention.gqa_attention`` on full sequences.
 
-    q: [B, Sq, H, D]; k/v: [B, Sk, KV, D], H % KV == 0. Sequence lengths
-    must divide the block sizes (callers pad or fall back to dense).
+    q: [B, Sq, H, D]; k/v: [B, Sk, KV, D], H % KV == 0. Requires
+    Sq % 8 == 0 and Sk % 128 == 0 (see ``supports``); blocks self-fit to
+    the largest power-of-two divisor, so no caller-side padding is needed.
     Fully differentiable: both directions run fused pallas kernels.
     """
     return _flash(q, k, v, causal, sm_scale, q_offset, block_q, block_k,

@@ -472,7 +472,7 @@ def _task_from_dict(t: Mapping[str, Any]) -> TaskSpec:
             ReadinessCheckSpec(**t["readiness_check"]) if t.get("readiness_check") else None),
         discovery=DiscoverySpec(**t["discovery"]) if t.get("discovery") else None,
         essential=t.get("essential", True),
-        kill_grace_period_s=t.get("kill_grace_period_s", 0),
+        kill_grace_period_s=t.get("kill_grace_period_s", 5),
         uris=tuple(t.get("uris", ())),
         transport_encryption=tuple(
             TransportEncryptionSpec(**te)

@@ -278,6 +278,8 @@ def run_llama_train(args) -> dict:
                 return cand
         return 1
 
+    if args.pp > 1:
+        return _llama_train_pipelined(args, contract, n, divisor_at_most)
     sp = (divisor_at_most(args.sp, n) if args.sp > 0
           else (2 if n % 2 == 0 else 1))
     tp = divisor_at_most(args.tp, n // sp) if args.tp > 0 else 1
@@ -318,6 +320,55 @@ def run_llama_train(args) -> dict:
             "process_id": contract["process_id"]}
 
 
+def _llama_train_pipelined(args, contract, n, divisor_at_most) -> dict:
+    """Pipeline-parallel LM training: decoder trunk stage-sharded over the
+    pp mesh axis, microbatched GPipe schedule (SURVEY.md §2.4 PP)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama, train
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+    pp = divisor_at_most(args.pp, n)
+    # mesh spans ALL devices (remainder folds into dp as replicas): a
+    # partial-device mesh would crash multi-process gangs whose local
+    # shards fall outside it and idle the rest of the reservation
+    mesh = MeshSpec(dp=n // pp, pp=pp).build()
+    seq = args.seq
+    n_layers = max(4, pp * 2)
+    cfg = llama.LlamaConfig.tiny(attn_impl="dense", max_seq=seq + 1,
+                                 n_layers=n_layers)
+    n_micro = max(2, pp)
+    batch = n_micro * 2
+    with mesh:
+        params = llama.stack_pipeline_params(
+            llama.init_params(cfg, jax.random.key(0)), pp)
+        toks = jax.random.randint(jax.random.key(1), (batch, seq + 1),
+                                  0, cfg.vocab_size)
+        opt = train.make_optimizer(lr=1e-3, warmup=5,
+                                   decay_steps=max(args.steps, 10))
+        specs = llama.pipeline_param_specs(cfg)
+        step = train.make_train_step(
+            lambda p, b: llama.loss_fn_pipelined(cfg, p, b, mesh, n_micro),
+            opt, mesh=mesh, param_spec_tree=specs, batch_spec=None)
+        opt_state = train.init_opt_state(opt, params, mesh, specs)
+        params, opt_state, out = step(params, opt_state, toks)  # compile
+        float(out["loss"])
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            params, opt_state, out = step(params, opt_state, toks)
+        loss = float(out["loss"])
+        dt = time.perf_counter() - t0
+
+    if args.out:
+        save_checkpoint(args.out, args.steps, params)
+    return {"workload": "llama-train", "attn": "dense", "seq": seq,
+            "mesh": {"pp": pp, "microbatches": n_micro},
+            "final_loss": loss,
+            "tokens_per_sec": round(batch * seq * args.steps / dt, 1),
+            "process_id": contract["process_id"]}
+
+
 WORKLOADS = {"mnist": run_mnist, "resnet": run_resnet, "llama": run_llama,
              "llama-train": run_llama_train}
 
@@ -341,6 +392,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="llama-train: sequence-parallel mesh size (0=auto)")
     p.add_argument("--tp", type=int, default=0,
                    help="llama-train: tensor-parallel mesh size (0=auto)")
+    p.add_argument("--pp", type=int, default=0,
+                   help="llama-train: pipeline-parallel stages (GPipe)")
     p.add_argument("--out", default="")
     return p
 

@@ -7,7 +7,9 @@
 // --service).
 
 #include <cstdlib>
+#include <fstream>
 #include <iostream>
+#include <sstream>
 #include <string>
 #include <vector>
 
@@ -49,6 +51,7 @@ void usage() {
       << "  endpoints [NAME]\n"
       << "  debug offers|plans|statuses|reservations\n"
       << "  describe | config list|show|target-id [ID]\n"
+      << "  update [--set KEY=VALUE ...] [--yaml FILE]\n"
       << "  state framework-id|properties|property [KEY]\n"
       << "  health\n";
 }
@@ -76,12 +79,15 @@ int main(int argc, char** argv) {
     return 2;
   }
 
-  // extract --phase/--step wherever they appear
-  std::string phase, step;
+  // extract --phase/--step/--set/--yaml wherever they appear
+  std::string phase, step, yaml_file;
+  std::vector<std::string> sets;
   std::vector<std::string> pos;
   for (size_t i = 0; i < args.size(); ++i) {
     if (args[i] == "--phase" && i + 1 < args.size()) phase = args[++i];
     else if (args[i] == "--step" && i + 1 < args.size()) step = args[++i];
+    else if (args[i] == "--set" && i + 1 < args.size()) sets.push_back(args[++i]);
+    else if (args[i] == "--yaml" && i + 1 < args.size()) yaml_file = args[++i];
     else pos.push_back(args[i]);
   }
 
@@ -92,6 +98,36 @@ int main(int argc, char** argv) {
 
     if (cmd == "health") return get(ctx, "health");
     if (cmd == "describe") return get(ctx, "configurations/target");
+
+    if (cmd == "update") {
+      // live config update (`dcos <svc> update start --options` analogue)
+      if (sets.empty() && yaml_file.empty()) {
+        std::cerr << "update: provide --set KEY=VALUE and/or --yaml FILE\n";
+        return 2;
+      }
+      tpu::Json env = tpu::Json::object();
+      for (const auto& pair : sets) {
+        size_t eq = pair.find('=');
+        if (eq == std::string::npos) {
+          std::cerr << "--set needs KEY=VALUE, got '" << pair << "'\n";
+          return 2;
+        }
+        env.set(pair.substr(0, eq), tpu::Json(pair.substr(eq + 1)));
+      }
+      tpu::Json body = tpu::Json::object();
+      body.set("env", env);
+      if (!yaml_file.empty()) {
+        std::ifstream in(yaml_file);
+        if (!in) {
+          std::cerr << "cannot read " << yaml_file << "\n";
+          return 2;
+        }
+        std::ostringstream ss;
+        ss << in.rdbuf();
+        body.set("yaml", tpu::Json(ss.str()));
+      }
+      return post(ctx, "update", body.dump());
+    }
 
     if (cmd == "plan") {
       if (action == "list" || action.empty()) return get(ctx, "plans");

@@ -190,3 +190,35 @@ class TestWorkerWorkloads:
                   for line in capsys.readouterr().out.splitlines()]
         done = [e for e in events if e.get("event") == "done"]
         assert done and done[0]["tokens_per_sec"] > 0
+
+
+class TestPipelineParallel:
+    def test_llama_train_pp_on_cpu_mesh(self, tmp_path, capsys):
+        rc = worker.main(["llama-train", "--steps", "1", "--seq", "64",
+                          "--pp", "2", "--out", str(tmp_path / "ckpt")])
+        assert rc == 0
+        events = [json.loads(line)
+                  for line in capsys.readouterr().out.splitlines()]
+        done = [e for e in events if e.get("event") == "done"]
+        assert done and done[0]["mesh"]["pp"] == 2
+        import math
+        assert math.isfinite(done[0]["final_loss"])
+
+    def test_pipelined_forward_matches_dense(self):
+        import numpy as np
+        from jax.sharding import Mesh
+        from dcos_commons_tpu.models import llama
+        import jax
+        import jax.numpy as jnp
+        cfg = llama.LlamaConfig.tiny(n_layers=4, attn_impl="dense",
+                                     dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (4, 32), 0,
+                                    cfg.vocab_size)
+        with jax.default_matmul_precision("highest"):
+            ref = llama.forward(cfg, params, tokens)
+            mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("pp",))
+            out = llama.forward_pipelined(
+                cfg, llama.stack_pipeline_params(params, 2), tokens, mesh,
+                n_micro=2)
+        assert float(jnp.abs(ref - out).max()) < 1e-5

@@ -454,3 +454,22 @@ def test_failing_health_check_kills_and_recovers(native_bins, tmp_path):
         agent.terminate()
         agent.wait(timeout=5)
         server.stop()
+
+
+def test_native_tpuctl_update(stack, native_bins):
+    sched, cluster, url, sandbox_root = stack
+    wait_for(lambda: cluster.agents(), message="agent registration")
+    drive_to(sched, "deploy", Status.COMPLETE)
+    sched.respec = lambda env: load_service_yaml_str(
+        YML.replace("count: 1", "count: {{N}}"), {"N": env.get("N", "1")})
+    out = subprocess.run(
+        [str(native_bins / "tpuctl"), "--url", url, "update",
+         "--set", "N=2"], capture_output=True, text=True, check=True)
+    assert json.loads(out.stdout)["accepted"]
+    drive_to(sched, "deploy", Status.COMPLETE)
+    assert sched.spec.pod("hello").count == 2
+    # no flags -> usage error, no request
+    rc = subprocess.run(
+        [str(native_bins / "tpuctl"), "--url", url, "update"],
+        capture_output=True, text=True)
+    assert rc.returncode == 2
