@@ -179,11 +179,9 @@ def _constrain(x, mesh: Optional[Mesh], *spec):
     return lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
-def apply_layer(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
-                rope, attn_fn: Callable,
-                mesh: Optional[Mesh] = None) -> jnp.ndarray:
-    """One decoder layer on activations x [B, S, D] (shared by the dense
-    forward's scan and the pipeline-parallel stage bodies)."""
+def attention_block(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
+                    rope, attn_fn: Callable) -> jnp.ndarray:
+    """Pre-norm attention residual step on x [B, S, D]."""
     b, s, _ = x.shape
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
@@ -192,7 +190,15 @@ def apply_layer(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
     q = apply_rope(q, rope)
     k = apply_rope(k, rope)
     o = attn_fn(q, k, v)  # GQA expansion is the impl's business
-    x = x + o.reshape(b, s, -1) @ lp["wo"]
+    return x + o.reshape(b, s, -1) @ lp["wo"]
+
+
+def apply_layer(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
+                rope, attn_fn: Callable,
+                mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """One decoder layer on activations x [B, S, D] (shared by the dense
+    forward's scan and the pipeline-parallel stage bodies)."""
+    x = attention_block(cfg, x, lp, rope, attn_fn)
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
     gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
     up = (h @ lp["w_up"]).astype(jnp.float32)
@@ -261,7 +267,8 @@ def forward_pipelined(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     def stage_fn(stage_layers, x_mb):
         def body(x_, lp):
             return apply_layer(cfg, x_, lp, rope, attn_fn), None
-        out, _ = lax.scan(body, x_mb, stage_layers)
+        out, _ = lax.scan(jax.checkpoint(body) if cfg.remat else body,
+                          x_mb, stage_layers)
         return out
 
     pipe = make_pipeline(mesh, stage_fn)
@@ -274,6 +281,84 @@ def loss_fn_pipelined(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                       mesh: Mesh, n_micro: int):
     logits = forward_pipelined(cfg, params, tokens[:, :-1], mesh, n_micro)
     return softmax_cross_entropy(logits, tokens[:, 1:], z_loss=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# mixture-of-experts variant (expert parallelism over the ep mesh axis)
+
+def init_moe_params(cfg: LlamaConfig, num_experts: int,
+                    key: jax.Array) -> Params:
+    """Like :func:`init_params` but the dense FFN is replaced by a routed
+    expert bank: ``router [L, D, E]``, ``w_in [L, E, D, F]``,
+    ``w_out [L, E, F, D]`` (SURVEY.md §2.4 EP)."""
+    params = init_params(cfg, key)
+    d, f, L, E = cfg.dim, cfg.ffn_dim, cfg.n_layers, num_experts
+    k = jax.random.split(jax.random.fold_in(key, 1), 3)
+    layers = dict(params["layers"])
+    for dense_key in ("w_gate", "w_up", "w_down"):
+        layers.pop(dense_key)
+    layers["router"] = (jax.random.normal(k[0], (L, d, E), jnp.float32)
+                        * d ** -0.5).astype(jnp.float32)
+    layers["w_in"] = (jax.random.normal(k[1], (L, E, d, f), jnp.float32)
+                      * d ** -0.5).astype(cfg.dtype)
+    layers["w_out"] = (jax.random.normal(k[2], (L, E, f, d), jnp.float32)
+                       * (f ** -0.5) / (2 * L) ** 0.5).astype(cfg.dtype)
+    return {**params, "layers": layers}
+
+
+def moe_param_specs(cfg: LlamaConfig) -> Params:
+    """Experts sharded over ``ep``; everything else replicated."""
+    return {
+        "embed": P(),
+        "layers": {
+            "attn_norm": P(), "wq": P(), "wk": P(), "wv": P(), "wo": P(),
+            "ffn_norm": P(),
+            "router": P(),
+            "w_in": P(None, "ep"),
+            "w_out": P(None, "ep"),
+        },
+        "norm": P(),
+        "lm_head": P(),
+    }
+
+
+def forward_moe(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                mesh: Mesh, moe_cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """MoE decoder forward: attention as usual, FFN replaced by the GShard
+    top-2 expert layer with all-to-all dispatch over ``ep``
+    (``parallel.moe``). Returns (logits, mean auxiliary load-balance loss).
+    """
+    from dcos_commons_tpu.parallel.moe import make_moe
+
+    b, s = tokens.shape
+    rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    attn_fn = lambda q, k, v: gqa_attention(q, k, v, causal=True)  # noqa: E731
+    moe_fn = make_moe(mesh, moe_cfg)
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def layer(carry, lp):
+        x, aux_sum = carry
+        x = attention_block(cfg, x, lp, rope, attn_fn)
+        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        out, aux = moe_fn(h.reshape(b * s, -1), lp["router"],
+                          lp["w_in"], lp["w_out"])
+        x = x + out.reshape(b, s, -1).astype(cfg.dtype)
+        return (x, aux_sum + aux.astype(jnp.float32)), None
+
+    (x, aux_sum), _ = lax.scan(
+        jax.checkpoint(layer) if cfg.remat else layer,
+        (x, jnp.float32(0.0)), params["layers"])
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, aux_sum / cfg.n_layers
+
+
+def loss_fn_moe(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                mesh: Mesh, moe_cfg, aux_weight: float = 0.01):
+    logits, aux = forward_moe(cfg, params, tokens[:, :-1], mesh, moe_cfg)
+    loss, metric = softmax_cross_entropy(logits, tokens[:, 1:], z_loss=1e-4)
+    return loss + aux_weight * aux, metric
 
 
 def loss_fn(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,

@@ -280,6 +280,8 @@ def run_llama_train(args) -> dict:
 
     if args.pp > 1:
         return _llama_train_pipelined(args, contract, n, divisor_at_most)
+    if args.ep > 1:
+        return _llama_train_moe(args, contract, n, divisor_at_most)
     sp = (divisor_at_most(args.sp, n) if args.sp > 0
           else (2 if n % 2 == 0 else 1))
     tp = divisor_at_most(args.tp, n // sp) if args.tp > 0 else 1
@@ -320,37 +322,18 @@ def run_llama_train(args) -> dict:
             "process_id": contract["process_id"]}
 
 
-def _llama_train_pipelined(args, contract, n, divisor_at_most) -> dict:
-    """Pipeline-parallel LM training: decoder trunk stage-sharded over the
-    pp mesh axis, microbatched GPipe schedule (SURVEY.md §2.4 PP)."""
+def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
+                      toks, mesh_report, attn_name):
+    """Shared optimizer/compile/timed-loop/report tail of every llama-train
+    variant (dp-sp-tp, pipeline, MoE)."""
     import jax
-    import jax.numpy as jnp
+    from dcos_commons_tpu.models import train
 
-    from dcos_commons_tpu.models import llama, train
-    from dcos_commons_tpu.parallel.mesh import MeshSpec
-
-    pp = divisor_at_most(args.pp, n)
-    # mesh spans ALL devices (remainder folds into dp as replicas): a
-    # partial-device mesh would crash multi-process gangs whose local
-    # shards fall outside it and idle the rest of the reservation
-    mesh = MeshSpec(dp=n // pp, pp=pp).build()
-    seq = args.seq
-    n_layers = max(4, pp * 2)
-    cfg = llama.LlamaConfig.tiny(attn_impl="dense", max_seq=seq + 1,
-                                 n_layers=n_layers)
-    n_micro = max(2, pp)
-    batch = n_micro * 2
     with mesh:
-        params = llama.stack_pipeline_params(
-            llama.init_params(cfg, jax.random.key(0)), pp)
-        toks = jax.random.randint(jax.random.key(1), (batch, seq + 1),
-                                  0, cfg.vocab_size)
         opt = train.make_optimizer(lr=1e-3, warmup=5,
                                    decay_steps=max(args.steps, 10))
-        specs = llama.pipeline_param_specs(cfg)
-        step = train.make_train_step(
-            lambda p, b: llama.loss_fn_pipelined(cfg, p, b, mesh, n_micro),
-            opt, mesh=mesh, param_spec_tree=specs, batch_spec=None)
+        step = train.make_train_step(loss_fn, opt, mesh=mesh,
+                                     param_spec_tree=specs, batch_spec=None)
         opt_state = train.init_opt_state(opt, params, mesh, specs)
         params, opt_state, out = step(params, opt_state, toks)  # compile
         float(out["loss"])
@@ -362,11 +345,66 @@ def _llama_train_pipelined(args, contract, n, divisor_at_most) -> dict:
 
     if args.out:
         save_checkpoint(args.out, args.steps, params)
-    return {"workload": "llama-train", "attn": "dense", "seq": seq,
-            "mesh": {"pp": pp, "microbatches": n_micro},
-            "final_loss": loss,
-            "tokens_per_sec": round(batch * seq * args.steps / dt, 1),
+    seq = toks.shape[1] - 1
+    return {"workload": "llama-train", "attn": attn_name, "seq": seq,
+            "mesh": mesh_report, "final_loss": loss,
+            "tokens_per_sec": round(toks.shape[0] * seq * args.steps / dt, 1),
             "process_id": contract["process_id"]}
+
+
+def _llama_train_pipelined(args, contract, n, divisor_at_most) -> dict:
+    """Pipeline-parallel LM training: decoder trunk stage-sharded over the
+    pp mesh axis, microbatched GPipe schedule (SURVEY.md §2.4 PP)."""
+    import jax
+
+    from dcos_commons_tpu.models import llama
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+    pp = divisor_at_most(args.pp, n)
+    # mesh spans ALL devices (remainder folds into dp as replicas): a
+    # partial-device mesh would crash multi-process gangs whose local
+    # shards fall outside it and idle the rest of the reservation
+    mesh = MeshSpec(dp=n // pp, pp=pp).build()
+    seq = args.seq
+    cfg = llama.LlamaConfig.tiny(attn_impl="dense", max_seq=seq + 1,
+                                 n_layers=max(4, pp * 2))
+    n_micro = max(2, pp)
+    params = llama.stack_pipeline_params(
+        llama.init_params(cfg, jax.random.key(0)), pp)
+    toks = jax.random.randint(jax.random.key(1), (n_micro * 2, seq + 1),
+                              0, cfg.vocab_size)
+    return _llama_train_loop(
+        args, contract, cfg, mesh,
+        lambda p, b: llama.loss_fn_pipelined(cfg, p, b, mesh, n_micro),
+        llama.pipeline_param_specs(cfg), params, toks,
+        {"pp": pp, "microbatches": n_micro}, "dense")
+
+
+def _llama_train_moe(args, contract, n, divisor_at_most) -> dict:
+    """Expert-parallel LM training: FFNs replaced by a GShard top-2 expert
+    bank sharded over the ep mesh axis with all-to-all dispatch
+    (SURVEY.md §2.4 EP)."""
+    import jax
+
+    from dcos_commons_tpu.models import llama
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+    from dcos_commons_tpu.parallel.moe import MoEConfig
+
+    ep = divisor_at_most(args.ep, n)
+    mesh = MeshSpec(dp=n // ep, ep=ep).build()
+    seq = args.seq
+    # expert count must be a multiple of ep or shard_map rejects the bank
+    num_experts = ep * max(1, -(-4 // ep))
+    cfg = llama.LlamaConfig.tiny(attn_impl="dense", max_seq=seq + 1)
+    moe_cfg = MoEConfig(num_experts=num_experts)
+    params = llama.init_moe_params(cfg, num_experts, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (4, seq + 1),
+                              0, cfg.vocab_size)
+    return _llama_train_loop(
+        args, contract, cfg, mesh,
+        lambda p, b: llama.loss_fn_moe(cfg, p, b, mesh, moe_cfg),
+        llama.moe_param_specs(cfg), params, toks,
+        {"dp": n // ep, "ep": ep, "experts": num_experts}, "dense")
 
 
 WORKLOADS = {"mnist": run_mnist, "resnet": run_resnet, "llama": run_llama,
@@ -394,6 +432,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="llama-train: tensor-parallel mesh size (0=auto)")
     p.add_argument("--pp", type=int, default=0,
                    help="llama-train: pipeline-parallel stages (GPipe)")
+    p.add_argument("--ep", type=int, default=0,
+                   help="llama-train: expert-parallel mesh size (MoE)")
     p.add_argument("--out", default="")
     return p
 

@@ -1,0 +1,86 @@
+"""Attention kernel benchmark: pallas flash vs XLA dense on the local chip.
+
+Prints one JSON line per configuration. Timing uses a device-side
+``lax.fori_loop`` with a data-dependent carry and host materialization —
+``block_until_ready`` alone under-reports through tunneled PJRT backends.
+
+Usage::
+
+    python -m tools.bench_attention [--seq 2048] [--batch 4] [--iters 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_one(attn, q, k, v, iters):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def fwd(q, k, v):
+        def body(i, acc):
+            o = attn(q + acc * 1e-6, k, v)
+            return acc + jnp.mean(o.astype(jnp.float32))
+        return lax.fori_loop(0, iters, body, 0.0)
+
+    @jax.jit
+    def fwdbwd(q, k, v):
+        def body(i, acc):
+            def loss(q_):
+                return attn(q_ + acc * 1e-6, k, v).astype(jnp.float32).sum()
+            l, g = jax.value_and_grad(loss)(q)
+            return acc + l * 1e-12 + jnp.mean(g.astype(jnp.float32))
+        return lax.fori_loop(0, iters, body, 0.0)
+
+    out = {}
+    for name, fn in (("fwd", fwd), ("fwd_bwd", fwdbwd)):
+        float(fn(q, k, v))  # compile + sync
+        t0 = time.perf_counter()
+        float(fn(q, k, v))
+        out[name + "_ms"] = round((time.perf_counter() - t0) / iters * 1000, 3)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seq", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--kv-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.ops.attention import gqa_attention
+    from dcos_commons_tpu.ops.flash_attention import flash_attention
+
+    b, s, h, kv, d = (args.batch, args.seq, args.heads, args.kv_heads,
+                      args.head_dim)
+    q = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(2), (b, s, kv, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(3), (b, s, kv, d), jnp.bfloat16)
+
+    configs = [
+        ("xla_dense", lambda q, k, v: gqa_attention(q, k, v, causal=True)),
+        ("flash_512", lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=512, block_k=512)),
+    ]
+    for name, attn in configs:
+        res = bench_one(attn, q, k, v, args.iters)
+        print(json.dumps({
+            "kernel": name, "backend": jax.default_backend(),
+            "batch": b, "seq": s, "heads": h, "kv_heads": kv,
+            "head_dim": d, **res}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
