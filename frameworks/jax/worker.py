@@ -294,32 +294,13 @@ def run_llama_train(args) -> dict:
     with mesh:
         params = llama.shard_params(
             llama.init_params(cfg, jax.random.key(0)), mesh, cfg)
-        toks = jax.random.randint(jax.random.key(1),
-                                  (max(2 * dp, 1), seq + 1),
-                                  0, cfg.vocab_size)
-        opt = train.make_optimizer(lr=1e-3, warmup=5,
-                                   decay_steps=max(args.steps, 10))
-        step = train.make_train_step(
-            lambda p, b: llama.loss_fn(cfg, p, b, mesh), opt, mesh=mesh,
-            param_spec_tree=llama.param_specs(cfg), batch_spec=None)
-        opt_state = train.init_opt_state(opt, params, mesh,
-                                         llama.param_specs(cfg))
-        params, opt_state, out = step(params, opt_state, toks)  # compile
-        float(out["loss"])
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            params, opt_state, out = step(params, opt_state, toks)
-        loss = float(out["loss"])
-        dt = time.perf_counter() - t0
-
-    if args.out:
-        save_checkpoint(args.out, args.steps, params)
-    tokens_per_sec = toks.shape[0] * seq * args.steps / dt
-    return {"workload": "llama-train", "attn": attn, "seq": seq,
-            "mesh": {"dp": dp, "sp": sp, "tp": tp},
-            "final_loss": loss,
-            "tokens_per_sec": round(tokens_per_sec, 1),
-            "process_id": contract["process_id"]}
+    toks = jax.random.randint(jax.random.key(1), (max(2 * dp, 1), seq + 1),
+                              0, cfg.vocab_size)
+    return _llama_train_loop(
+        args, contract, cfg, mesh,
+        lambda p, b: llama.loss_fn(cfg, p, b, mesh),
+        llama.param_specs(cfg), params, toks,
+        {"dp": dp, "sp": sp, "tp": tp}, attn)
 
 
 def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
