@@ -473,3 +473,68 @@ def test_native_tpuctl_update(stack, native_bins):
         [str(native_bins / "tpuctl"), "--url", url, "update"],
         capture_output=True, text=True)
     assert rc.returncode == 2
+
+
+def test_scale_down_and_uninstall_against_real_agent(native_bins, tmp_path):
+    """Decommission (live count shrink) then full uninstall against the
+    real agent: tasks killed, reservations released, volumes destroyed."""
+    cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.05)
+    persister = MemPersister()
+    # VOLUME_YML's custom plan pins steps to instance 0; deploy all
+    # instances here so db-1 exists to decommission
+    base = VOLUME_YML.replace("- [0, [server]]", "- [default, [server]]")
+    two = base.replace("count: 1", "count: 2")
+    sched = ServiceScheduler(load_service_yaml_str(two), persister, cluster)
+    server = ApiServer(sched, port=0, cluster=cluster)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    sandbox_root = tmp_path / "sb"
+    agent = subprocess.Popen(
+        [str(native_bins / "tpu-agent"), "--scheduler", url,
+         "--agent-id", "d0", "--hostname", "node0",
+         "--cpus", "8", "--memory-mb", "8192", "--disk-mb", "20000",
+         "--base-dir", str(sandbox_root), "--poll-interval", "0.05",
+         "--tpu-chips", "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        drive_to(sched, "deploy", Status.COMPLETE)
+        vol1 = sandbox_root / "volumes" / "db-1"
+        wait_for(vol1.exists, message="db-1 volume created")
+
+        # live scale-down 2 -> 1: decommission kills the highest index,
+        # releases its reservations, and destroys its volumes
+        result = sched.update_config(load_service_yaml_str(base))
+        assert result.accepted
+
+        def decommissioned():
+            sched.run_cycle()
+            return (sched.state.fetch_task("db-1-server") is None
+                    and not vol1.exists())
+        wait_for(decommissioned, timeout=30, message="db-1 decommissioned")
+        assert sched.state.fetch_status("db-0-server").state \
+            is TaskState.RUNNING
+        assert {r.pod_instance_name for r in sched.ledger.all()} == {"db-0"}
+
+        # full uninstall: the scheduler is relaunched in uninstall mode over
+        # the same state, re-serving the agent transport on the same port
+        # (reference: Cosmos restarts the scheduler with SDK_UNINSTALL)
+        port = server.port
+        server.stop()
+        unsched = ServiceScheduler(load_service_yaml_str(base),
+                                   persister, cluster, uninstall=True)
+        server = ApiServer(unsched, port=port, cluster=cluster)
+        server.start()
+
+        def torn_down():
+            unsched.run_cycle()
+            return (unsched.uninstall_complete
+                    and not (sandbox_root / "volumes" / "db-0").exists())
+        wait_for(torn_down, timeout=30, message="uninstall complete")
+        assert unsched.state.fetch_tasks() == []
+    finally:
+        agent.terminate()
+        agent.wait(timeout=5)
+        try:
+            server.stop()
+        except Exception:
+            pass
