@@ -225,32 +225,50 @@ def run_llama(args) -> dict:
     else:
         cfg = llama.LlamaConfig.tiny()
     mesh = MeshSpec(tp=n).build()
+    gen_len = args.gen_len
+
+    def timed_decode(prompt):
+        # prompt must stay (1, 4) int32 so the compiled executable is reused
+        t0 = time.perf_counter()
+        with mesh:
+            toks = llama.generate(cfg, params, prompt, gen_len, mesh=mesh)
+        jax.block_until_ready(toks)
+        return round(gen_len / max(time.perf_counter() - t0, 1e-9), 2)
+
     with mesh:
         params = llama.init_params(cfg, jax.random.key(0))
         params = llama.shard_params(params, mesh, cfg)
-        prompt = jnp.array([[1, 2, 3, 4]], dtype=jnp.int32)
-        gen_len = args.gen_len
-        # warmup/compile
-        tokens = llama.generate(cfg, params, prompt, gen_len, mesh=mesh)
-        jax.block_until_ready(tokens)
-        t0 = time.perf_counter()
-        tokens = llama.generate(cfg, params, prompt, gen_len, mesh=mesh)
-        jax.block_until_ready(tokens)
-        dt = time.perf_counter() - t0
+    prompt = jnp.array([[1, 2, 3, 4]], dtype=jnp.int32)
+    timed_decode(prompt)  # warmup/compile
+    tokens_per_sec = timed_decode(prompt)
 
     if args.out:  # readiness-check gate (llama.yml): shard is serving
         os.makedirs(args.out, exist_ok=True)
     with open("serving.ready", "w") as f:
         f.write("ok\n")
     result = {"workload": "llama", "preset": args.preset,
-              "tokens_per_sec": round(gen_len / dt, 2),
+              "tokens_per_sec": tokens_per_sec,
               "tp": n, "process_id": contract["process_id"]}
     if args.serve:
-        # goal RUNNING: block and keep serving — exiting would read as a
-        # task failure and trigger a gang re-form loop
+        # goal RUNNING: keep serving — exiting would read as a task failure
+        # and trigger a gang re-form loop. Each heartbeat decodes a fresh
+        # synthetic prompt so the serving path (and the chips) stay
+        # exercised and monitorable via the emitted tokens/sec. Transient
+        # decode failures are reported, not fatal: only the scheduler's own
+        # health/recovery machinery should decide to restart the shard.
         _emit({"event": "serving", **result})
+        i = 0
         while True:
-            time.sleep(60)
+            time.sleep(args.serve_interval)
+            i += 1
+            hb_prompt = jax.random.randint(
+                jax.random.key(1000 + i), (1, 4), 0, cfg.vocab_size
+            ).astype(jnp.int32)
+            try:
+                _emit({"event": "heartbeat", "n": i,
+                       "tokens_per_sec": timed_decode(hb_prompt)})
+            except Exception as e:
+                _emit({"event": "heartbeat_error", "n": i, "error": str(e)})
     return result
 
 
@@ -402,7 +420,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--preset", default="tiny", choices=["tiny", "8b"])
     p.add_argument("--gen-len", type=int, default=16)
     p.add_argument("--serve", action="store_true",
-                   help="llama: block after warmup (RUNNING-goal tasks)")
+                   help="llama: keep serving after warmup (RUNNING goal)")
+    p.add_argument("--serve-interval", type=float, default=30.0,
+                   help="llama --serve: seconds between decode heartbeats")
     p.add_argument("--attn", default="auto",
                    choices=["auto", "dense", "flash", "ring", "ulysses"])
     p.add_argument("--seq", type=int, default=256,
