@@ -240,3 +240,38 @@ def wait_for_metric(base_url: str, name: str, predicate,
         return value is not None and predicate(value)
 
     client.wait_for(f"metric {name}", check, timeout_s)
+
+
+# -- config updates (sdk_upgrade.py) -----------------------------------------
+
+def update_service_options(client: ServiceClient, env: Dict[str, str],
+                           yaml_text: Optional[str] = None,
+                           timeout_s: float = DEFAULT_TIMEOUT_S) -> str:
+    """Push new package options (and/or a replacement YAML) through the
+    live-update endpoint and await the rollout (reference
+    ``sdk_upgrade.update_or_upgrade_or_downgrade`` +
+    ``sdk_install.update_app``). Returns the new target config id."""
+    body: Dict[str, object] = {"env": env}
+    if yaml_text is not None:
+        body["yaml"] = yaml_text
+    code, payload = client.post("update", json.dumps(body).encode())
+    if code != 200 or not payload.get("accepted"):
+        raise IntegrationError(f"update rejected ({code}): {payload}")
+    wait_for_deployment(client, timeout_s)
+    return payload["targetId"]
+
+
+def get_target_id(client: ServiceClient) -> str:
+    code, target = client.get("configurations/targetId")
+    if code != 200:
+        raise IntegrationError(f"targetId -> {code}: {target}")
+    return target[0]
+
+
+def check_config_updated(client: ServiceClient, old_target_id: str) -> str:
+    """Assert the target config moved; returns the new id."""
+    new_id = get_target_id(client)
+    if new_id == old_target_id:
+        raise IntegrationError(
+            f"target config did not change (still {old_target_id})")
+    return new_id

@@ -207,3 +207,47 @@ class TestIntegrationLib:
         client = integration.ServiceClient(live, poll_interval_s=0.01)
         with pytest.raises(integration.IntegrationError):
             client.wait_for("never", lambda: False, timeout_s=0.1)
+
+
+class TestIntegrationUpdate:
+    """sdk_upgrade.py analogue: live option updates through HTTP only."""
+
+    @pytest.fixture()
+    def live(self):
+        from dcos_commons_tpu.agent import FakeCluster
+        from dcos_commons_tpu.http import ApiServer
+        from dcos_commons_tpu.scheduler import ServiceScheduler
+        from dcos_commons_tpu.specification import load_service_yaml_str
+        from dcos_commons_tpu.state import MemPersister
+        from dcos_commons_tpu.testing.simulation import default_agents
+
+        cluster = FakeCluster(default_agents(3))
+        sched = ServiceScheduler(load_service_yaml_str(SVC_YML),
+                                 MemPersister(), cluster)
+        server = ApiServer(sched, port=0)
+        server.start()
+        driver = CycleDriver(sched, interval_s=0.05).start()
+        yield f"http://127.0.0.1:{server.port}"
+        driver.stop()
+        server.stop()
+
+    def test_option_update_rolls_and_moves_target(self, live):
+        client = integration.ServiceClient(live)
+        integration.wait_for_deployment(client, timeout_s=20)
+        old_id = integration.get_target_id(client)
+
+        new_yaml = SVC_YML.replace("count: 2", "count: 3")
+        new_id = integration.update_service_options(
+            client, {}, yaml_text=new_yaml, timeout_s=20)
+        assert new_id == integration.check_config_updated(client, old_id)
+        code, pods = client.get("pod")
+        assert code == 200 and "hello-2" in pods
+
+    def test_rejected_update_raises(self, live):
+        client = integration.ServiceClient(live)
+        integration.wait_for_deployment(client, timeout_s=20)
+        bad = SVC_YML.replace("name: hello-world", "name: other")
+        with pytest.raises(integration.IntegrationError,
+                           match="update rejected"):
+            integration.update_service_options(client, {}, yaml_text=bad,
+                                               timeout_s=20)
