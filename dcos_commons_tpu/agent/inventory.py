@@ -53,6 +53,12 @@ class AgentInfo:
     attributes: Mapping[str, str] = field(default_factory=dict)
     zone: Optional[str] = None
     region: Optional[str] = None
+    # mount-disk profiles this host offers (reference: DC/OS disk profiles
+    # consumed by profile-mount-volumes); empty = plain disk only
+    volume_profiles: Tuple[str, ...] = ()
+    # reservation roles this host serves (reference pre-reserved-role pools
+    # like "slave_public"); "*" = the default shared pool
+    roles: Tuple[str, ...] = ("*",)
 
 
 @dataclass(frozen=True)

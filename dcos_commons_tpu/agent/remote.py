@@ -78,6 +78,9 @@ class RemoteCluster:
                 "files": [{"dest": d, "content_b64": c} for d, c in l.files],
                 "pod_instance": l.pod_instance,
                 "volumes": list(l.volumes),
+                "host_volumes": [list(hv) for hv in l.host_volumes],
+                "rlimits": [{"name": n, "soft": s, "hard": h}
+                            for n, s, h in l.rlimits],
             } for l in plan.launches]}
         with self._lock:
             self._queues.setdefault(plan.agent.agent_id, []).append(command)
@@ -126,6 +129,8 @@ class RemoteCluster:
             attributes=dict(payload.get("attributes", {})),
             zone=payload.get("zone"),
             region=payload.get("region"),
+            volume_profiles=tuple(payload.get("volume_profiles", ())),
+            roles=tuple(payload.get("roles") or ("*",)),
         )
         with self._lock:
             self._agents[info.agent_id] = info

@@ -206,9 +206,11 @@ class EndpointQueries:
         self._scheduler = scheduler
 
     def _endpoints(self) -> dict:
+        from ..matching.evaluator import DEFAULT_TLD
         eps: dict = {}
         spec = self._scheduler.spec
         ledger = self._scheduler.ledger
+        tld = getattr(self._scheduler, "tld", DEFAULT_TLD)
         for task in self._scheduler.state.fetch_tasks():
             reservation = ledger.get(task.pod_instance_name,
                                      task.resource_set_id)
@@ -218,7 +220,7 @@ class EndpointQueries:
                 entry = eps.setdefault(port_name, {"address": [], "dns": []})
                 entry["address"].append(f"{task.hostname}:{port}")
                 entry["dns"].append(
-                    f"{task.task_name}.{spec.name}.tpu.local:{port}")
+                    f"{task.task_name}.{spec.name}.{tld}:{port}")
         return eps
 
     def list(self) -> list:

@@ -38,17 +38,37 @@ from .outcome import EvaluationOutcome, OutcomeNode
 log = logging.getLogger(__name__)
 
 JAX_COORDINATOR_PORT = 8476
+# synthetic resource-set id for pod-level shared volumes; underscore-prefixed
+# so it can't collide with YAML resource-set ids used by tasks
+POD_VOLUME_SET_ID = "_pod"
+
+
+def _profile_shortfall(volumes, agent: AgentInfo) -> Optional[str]:
+    """Volume profile matching (reference profile-mount-volumes): a volume
+    listing profiles only fits an agent advertising one of them."""
+    for v in volumes:
+        if v.profiles and not set(v.profiles) & set(agent.volume_profiles):
+            return (f"volume {v.container_path} requires disk profile "
+                    f"{sorted(v.profiles)}; agent offers "
+                    f"{sorted(agent.volume_profiles)}")
+    return None
 ENV_TASK_NAME = "TASK_NAME"
 ENV_POD_INSTANCE_INDEX = "POD_INSTANCE_INDEX"
 ENV_FRAMEWORK_NAME = "FRAMEWORK_NAME"
 ENV_FRAMEWORK_HOST = "FRAMEWORK_HOST"
 
 
-def service_hostname(service_name: str, pod_instance_name: str) -> str:
+DEFAULT_TLD = "tpu.local"
+
+
+def service_hostname(service_name: str, pod_instance_name: str,
+                     tld: str = DEFAULT_TLD) -> str:
     """Stable discovery name for a pod instance (reference autoip DNS
     ``<task>.<framework>.autoip.dcos.thisdcos.directory``,
-    ``offer/taskdata/EnvConstants.java:26-34``)."""
-    return f"{pod_instance_name}.{service_name}.tpu.local"
+    ``offer/taskdata/EnvConstants.java:26-34``; the TLD is operator-
+    customizable like the reference's ``SERVICE_TLD`` env,
+    ``scheduler/SchedulerConfig.java:248-255``)."""
+    return f"{pod_instance_name}.{service_name}.{tld}"
 
 
 @dataclass(frozen=True)
@@ -88,6 +108,11 @@ class TaskLaunch:
     # (tasks of one pod see one another's volumes; data survives relaunch)
     pod_instance: str = ""
     volumes: Tuple[str, ...] = ()
+    # host directories mounted into the sandbox: (host_path, container_path)
+    host_volumes: Tuple[Tuple[str, str], ...] = ()
+    # POSIX limits applied to the task process: (name, soft, hard);
+    # soft/hard None = unlimited
+    rlimits: Tuple[Tuple[str, Optional[int], Optional[int]], ...] = ()
 
 
 @dataclass(frozen=True)
@@ -109,8 +134,10 @@ class Evaluator:
     """Matches one PodInstanceRequirement against the agent inventory."""
 
     def __init__(self, service_name: str, outcome_tracker=None,
-                 tls_provisioner=None, secrets_store=None):
+                 tls_provisioner=None, secrets_store=None,
+                 tld: str = DEFAULT_TLD):
         self._service_name = service_name
+        self._tld = tld
         self._tracker = outcome_tracker
         # reference TLSEvaluationStage + Mesos secret volumes: both inject
         # per-task artifacts during launch construction
@@ -250,6 +277,14 @@ class Evaluator:
                 "gang", f"agent not in chosen slice {gang_slice}"))
             return None
 
+        # stage: pre-reserved role (reference pre-reserved.yml: the pod's
+        # resources must come from an agent serving that role pool)
+        if pod.pre_reserved_role and pod.pre_reserved_role not in agent.roles:
+            node.add(EvaluationOutcome.fail(
+                "role", f"agent serves roles {list(agent.roles)}, pod "
+                        f"requires pre-reserved role {pod.pre_reserved_role}"))
+            return None
+
         # stage: placement rule (skipped for pinned relaunch-in-place, like
         # the reference skipping placement for existing pods,
         # OfferEvaluator.java:263-277)
@@ -273,6 +308,11 @@ class Evaluator:
                 node.add(EvaluationOutcome.ok(
                     f"reserve:{rs_id}", "reusing existing reservation"))
                 continue
+            profile_err = _profile_shortfall(rs.volumes, agent)
+            if profile_err is not None:
+                node.add(EvaluationOutcome.fail(f"volumes:{rs_id}",
+                                                profile_err))
+                return None
             reason = avail.fits(rs.cpus, rs.memory_mb, rs.disk_mb, rs.tpus)
             if reason is not None:
                 node.add(EvaluationOutcome.fail(f"reserve:{rs_id}", reason))
@@ -305,6 +345,40 @@ class Evaluator:
                 f"reserve:{rs_id}",
                 f"reserved cpus={rs.cpus} mem={rs.memory_mb} tpus={rs.tpus} "
                 f"ports={ports}"))
+
+        # stage: pod-level shared volumes (reference RawPod `volume:`) —
+        # reserved once per pod instance under the synthetic _pod set
+        if pod.volumes:
+            existing = ledger.get(pod_name, POD_VOLUME_SET_ID)
+            if existing is not None and existing.agent_id == agent.agent_id \
+                    and not replace_mode:
+                node.add(EvaluationOutcome.ok(
+                    f"reserve:{POD_VOLUME_SET_ID}",
+                    "reusing existing pod-volume reservation"))
+            else:
+                profile_err = _profile_shortfall(pod.volumes, agent)
+                if profile_err is not None:
+                    node.add(EvaluationOutcome.fail("volumes:pod",
+                                                    profile_err))
+                    return None
+                pod_disk = sum(v.size_mb for v in pod.volumes)
+                reason = avail.fits(0, 0, pod_disk, 0)
+                if reason is not None:
+                    node.add(EvaluationOutcome.fail("volumes:pod", reason))
+                    return None
+                avail.take(0, 0, pod_disk, 0)
+                new_reservations.append(Reservation(
+                    pod_instance_name=pod_name,
+                    resource_set_id=POD_VOLUME_SET_ID,
+                    agent_id=agent.agent_id, disk_mb=pod_disk,
+                    volumes=tuple(
+                        VolumeReservation(container_path=v.container_path,
+                                          size_mb=v.size_mb,
+                                          volume_id=new_uuid())
+                        for v in pod.volumes)))
+                node.add(EvaluationOutcome.ok(
+                    f"reserve:{POD_VOLUME_SET_ID}",
+                    f"reserved pod volumes disk={pod_disk}MB"))
 
         # stage: TPU process assignment
         tpu_assignment, tpu_err = self._tpu_assignment(requirement, agent,
@@ -378,7 +452,7 @@ class Evaluator:
         env[ENV_TASK_NAME] = task_name
         env[ENV_POD_INSTANCE_INDEX] = str(requirement.pod_instance.index)
         env[ENV_FRAMEWORK_NAME] = self._service_name
-        env[ENV_FRAMEWORK_HOST] = f"{self._service_name}.tpu.local"
+        env[ENV_FRAMEWORK_HOST] = f"{self._service_name}.{self._tld}"
         for port_name, port in reservation.ports.items():
             port_spec = next(p for p in pod.resource_set(
                 task_spec.resource_set_id).ports if p.name == port_name)
@@ -460,7 +534,12 @@ class Evaluator:
             secret_env_keys=tuple(secret_env_keys),
             pod_instance=requirement.pod_instance.name,
             volumes=tuple(v.container_path for rs in pod.resource_sets
-                          for v in rs.volumes),
+                          for v in rs.volumes)
+            + tuple(v.container_path for v in pod.volumes),
+            host_volumes=tuple((hv.host_path, hv.container_path)
+                               for hv in pod.host_volumes),
+            rlimits=tuple((rl.name, rl.soft, rl.hard)
+                          for rl in pod.rlimits),
             health_check_cmd=hc.cmd if hc else None,
             health_interval_s=hc_d.interval_s,
             health_grace_s=hc_d.grace_period_s,

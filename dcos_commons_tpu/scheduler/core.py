@@ -32,7 +32,8 @@ from ..agent.client import AgentClient
 from ..agent.inventory import TaskRecord
 from ..config.updater import (DEFAULT_VALIDATORS, ConfigurationUpdater,
                               UpdateResult)
-from ..matching.evaluator import Evaluator, LaunchPlan, TaskLaunch
+from ..matching.evaluator import (DEFAULT_TLD, Evaluator, LaunchPlan,
+                                  TaskLaunch)
 from ..matching.outcome import OutcomeTracker
 from ..plan.backoff import Backoff, DisabledBackoff
 from ..plan.elements import ActionStep, Plan
@@ -61,7 +62,8 @@ class ServiceScheduler:
                  recovery_overriders: Sequence[RecoveryOverrider] = (),
                  uninstall: bool = False,
                  agent_grace_s: float = 0.0,
-                 metrics=None):
+                 metrics=None,
+                 tld: Optional[str] = None):
         SchemaVersionStore(persister).check()
         # serializes run_cycle against status callbacks arriving from other
         # threads (RemoteCluster delivers on HTTP worker threads; the
@@ -116,6 +118,10 @@ class ServiceScheduler:
         # (reference SchedulerBuilder.java:479-492)
         self.spec: ServiceSpec = self.configs.fetch(self.target_config_id)
 
+        # endpoint TLD (reference SERVICE_TLD env knob,
+        # scheduler/SchedulerConfig.java:248-255)
+        import os as _os
+        self.tld = tld or _os.environ.get("SERVICE_TLD") or DEFAULT_TLD
         self.backoff = backoff or DisabledBackoff()
         self.outcome_tracker = OutcomeTracker()
         # security: secrets always available; the CA spins up only when a
@@ -212,10 +218,12 @@ class ServiceScheduler:
                        for p in self.spec.pods for t in p.tasks)
         if uses_tls and self.tls_provisioner is None:
             self.tls_provisioner = TLSProvisioner(self._persister,
-                                                  self.spec.name)
+                                                  self.spec.name,
+                                                  tld=self.tld)
         self.evaluator = Evaluator(self.spec.name, self.outcome_tracker,
                                    tls_provisioner=self.tls_provisioner,
-                                   secrets_store=self.secrets)
+                                   secrets_store=self.secrets,
+                                   tld=self.tld)
 
     @property
     def uninstall_complete(self) -> bool:

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import List, Sequence, Tuple
 
-from ..matching.evaluator import service_hostname
+from ..matching.evaluator import DEFAULT_TLD, service_hostname
 from ..state.persister import Persister
 from .ca import CertificateAuthority
 
@@ -38,12 +38,15 @@ class TLSArtifactPaths:
 
 
 def certificate_names(service_name: str, pod_instance_name: str,
-                      task_name: str) -> Tuple[str, List[str]]:
+                      task_name: str, tld: str = DEFAULT_TLD
+                      ) -> Tuple[str, List[str]]:
     """CN + SANs for one task (reference ``CertificateNamesGenerator``):
     the task's stable service DNS identity plus a pod-level wildcard-ish
-    alias so clients can address either."""
-    cn = service_hostname(service_name, pod_instance_name)
-    sans = [cn, service_hostname(service_name, task_name)]
+    alias so clients can address either. The TLD must match the one the
+    scheduler advertises (FRAMEWORK_HOST / endpoint DNS) or hostname
+    verification against the issued cert fails."""
+    cn = service_hostname(service_name, pod_instance_name, tld)
+    sans = [cn, service_hostname(service_name, task_name, tld)]
     return cn, sorted(set(sans))
 
 
@@ -56,9 +59,11 @@ class TLSProvisioner:
     service for the same reason, ``TLSArtifactsUpdater.java``).
     """
 
-    def __init__(self, persister: Persister, service_name: str):
+    def __init__(self, persister: Persister, service_name: str,
+                 tld: str = DEFAULT_TLD):
         self._persister = persister
         self._service = service_name
+        self._tld = tld
         self._ca = CertificateAuthority(persister, service_name)
 
     @property
@@ -80,7 +85,8 @@ class TLSProvisioner:
             key = self._persister.get_or_none(f"{root}/key")
             if cert is None or key is None:
                 cn, sans = certificate_names(
-                    self._service, pod_instance_name, task_instance_name)
+                    self._service, pod_instance_name, task_instance_name,
+                    self._tld)
                 cert, key = self._ca.issue(cn, sans)
                 self._persister.set_many({f"{root}/cert": cert,
                                           f"{root}/key": key})

@@ -1,6 +1,7 @@
 from .spec import (ConfigFileSpec, DiscoverySpec, GoalState, HealthCheckSpec,
-                   PhaseSpec, PlanSpecModel, PodInstance, PodSpec, PortSpec,
-                   ReadinessCheckSpec, ReplacementFailurePolicy, ResourceSet,
-                   ServiceSpec, StepSpecEntry, TaskSpec, TpuSpec, VolumeSpec,
-                   VolumeType, with_pod_count)
+                   HostVolumeSpec, PhaseSpec, PlanSpecModel, PodInstance,
+                   PodSpec, PortSpec, ReadinessCheckSpec,
+                   ReplacementFailurePolicy, ResourceSet, RLimitSpec,
+                   SecretSpec, ServiceSpec, StepSpecEntry, TaskSpec, TpuSpec,
+                   VolumeSpec, VolumeType, with_pod_count)
 from .yaml_loader import load_service_yaml, load_service_yaml_str, taskcfg_env

@@ -53,11 +53,18 @@ class VolumeType(enum.Enum):
 
 @dataclass(frozen=True)
 class VolumeSpec:
-    """Reference ``specification/VolumeSpec.java`` / ``DefaultVolumeSpec``."""
+    """Reference ``specification/VolumeSpec.java`` / ``DefaultVolumeSpec``.
+
+    ``profiles``: acceptable disk profiles for a MOUNT volume — the agent
+    advertises its mount-disk profiles and the matcher only places the volume
+    on an agent advertising one of these (reference profile-mount-volumes,
+    ``frameworks/helloworld/src/main/dist/profile-mount-volume.yml``).
+    """
 
     container_path: str
     size_mb: int
     type: VolumeType = VolumeType.ROOT
+    profiles: tuple[str, ...] = ()
 
     def validate(self) -> list[str]:
         errs = []
@@ -66,6 +73,72 @@ class VolumeSpec:
         if not self.container_path or self.container_path.startswith("/"):
             errs.append(
                 f"volume path must be relative to the sandbox: {self.container_path!r}")
+        if self.profiles and self.type is not VolumeType.MOUNT:
+            errs.append(
+                f"volume {self.container_path}: profiles require type MOUNT")
+        return errs
+
+
+@dataclass(frozen=True)
+class HostVolumeSpec:
+    """Mount a host directory into task sandboxes (read-through), the
+    reference ``specification/HostVolumeSpec.java`` /
+    ``frameworks/helloworld/src/main/dist/host-volume.yml`` semantics:
+    ``host_path`` on the agent appears at sandbox-relative
+    ``container_path``."""
+
+    host_path: str
+    container_path: str
+
+    def validate(self) -> list[str]:
+        errs = []
+        if not self.host_path.startswith("/"):
+            errs.append(
+                f"host volume {self.container_path}: host path must be "
+                f"absolute: {self.host_path!r}")
+        if not self.container_path or self.container_path.startswith("/") \
+                or ".." in self.container_path:
+            errs.append(
+                f"host volume container path must be sandbox-relative: "
+                f"{self.container_path!r}")
+        return errs
+
+
+SUPPORTED_RLIMITS = frozenset({
+    "NOFILE", "NPROC", "CORE", "CPU", "DATA", "FSIZE", "MEMLOCK", "STACK",
+    "AS", "RSS"})
+
+
+@dataclass(frozen=True)
+class RLimitSpec:
+    """POSIX resource limit applied to every task process of a pod
+    (reference ``specification/RLimitSpec.java``: name + soft/hard, where
+    both must be set together or both unset = raise to the agent's max)."""
+
+    name: str          # e.g. "RLIMIT_NOFILE" (the RLIMIT_ prefix optional)
+    soft: Optional[int] = None
+    hard: Optional[int] = None
+
+    def validate(self) -> list[str]:
+        errs = []
+        # names are validated at spec time so a typo fails the rollout,
+        # not every launch (the agent's rlimit_by_name supports this set)
+        bare = self.name.upper()
+        if bare.startswith("RLIMIT_"):
+            bare = bare[len("RLIMIT_"):]
+        if bare not in SUPPORTED_RLIMITS:
+            errs.append(
+                f"rlimit {self.name!r}: unsupported (known: "
+                f"{', '.join(sorted(SUPPORTED_RLIMITS))})")
+        if (self.soft is None) != (self.hard is None):
+            errs.append(
+                f"rlimit {self.name}: soft and hard must be set together "
+                "(both unset = unlimited)")
+        if self.soft is not None and self.hard is not None \
+                and self.soft > self.hard:
+            errs.append(
+                f"rlimit {self.name}: soft ({self.soft}) exceeds hard "
+                f"({self.hard})")
         return errs
 
 
@@ -266,11 +339,29 @@ class PodSpec:
     allow_decommission: bool = True
     share_pid_namespace: bool = False
     secrets: tuple[SecretSpec, ...] = ()
+    # pod-level persistent volumes shared by every task of the pod instance
+    # (reference RawPod `volume:`, pod-profile-mount-volume.yml)
+    volumes: tuple[VolumeSpec, ...] = ()
+    host_volumes: tuple[HostVolumeSpec, ...] = ()
+    rlimits: tuple[RLimitSpec, ...] = ()
 
     def validate(self) -> list[str]:
         errs = []
         for s in self.secrets:
             errs.extend(s.validate())
+        for v in self.volumes:
+            errs.extend(v.validate())
+        for hv in self.host_volumes:
+            errs.extend(hv.validate())
+        for rl in self.rlimits:
+            errs.extend(rl.validate())
+        seen_paths = {v.container_path for v in self.volumes}
+        for rs in self.resource_sets:
+            for v in rs.volumes:
+                if v.container_path in seen_paths:
+                    errs.append(
+                        f"pod {self.type}: volume path {v.container_path!r} "
+                        "declared at both pod and resource-set level")
         if self.count < 1:
             errs.append(f"pod {self.type}: count must be >= 1")
         if not self.tasks:
@@ -429,6 +520,11 @@ def _service_from_dict(data: Mapping[str, Any]) -> ServiceSpec:
             allow_decommission=pd.get("allow_decommission", True),
             share_pid_namespace=pd.get("share_pid_namespace", False),
             secrets=tuple(SecretSpec(**s) for s in pd.get("secrets", ())),
+            volumes=tuple(_volume_from_dict(v)
+                          for v in pd.get("volumes", ())),
+            host_volumes=tuple(HostVolumeSpec(**hv)
+                               for hv in pd.get("host_volumes", ())),
+            rlimits=tuple(RLimitSpec(**rl) for rl in pd.get("rlimits", ())),
         ))
     rfp = data.get("replacement_failure_policy")
     return ServiceSpec(
@@ -488,11 +584,16 @@ def _rs_from_dict(r: Mapping[str, Any]) -> ResourceSet:
         disk_mb=r.get("disk_mb", 0),
         tpus=r.get("tpus", 0),
         ports=tuple(PortSpec(**p) for p in r.get("ports", ())),
-        volumes=tuple(
-            VolumeSpec(container_path=v["container_path"], size_mb=v["size_mb"],
-                       type=VolumeType(v["type"]) if isinstance(v.get("type"), str)
-                       else v.get("type", VolumeType.ROOT))
-            for v in r.get("volumes", ())),
+        volumes=tuple(_volume_from_dict(v) for v in r.get("volumes", ())),
+    )
+
+
+def _volume_from_dict(v: Mapping[str, Any]) -> VolumeSpec:
+    return VolumeSpec(
+        container_path=v["container_path"], size_mb=v["size_mb"],
+        type=VolumeType(v["type"]) if isinstance(v.get("type"), str)
+        else v.get("type", VolumeType.ROOT),
+        profiles=tuple(v.get("profiles", ())),
     )
 
 

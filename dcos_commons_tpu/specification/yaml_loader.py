@@ -54,10 +54,11 @@ import yaml
 from ..matching.placement import parse_marathon_constraints, rule_from_json
 from ..utils.template import render_template
 from .spec import (ConfigFileSpec, DiscoverySpec, GoalState, HealthCheckSpec,
-                   PhaseSpec, PlanSpecModel, PodSpec, PortSpec,
-                   ReadinessCheckSpec, ReplacementFailurePolicy, ResourceSet,
-                   SecretSpec, ServiceSpec, StepSpecEntry, TaskSpec,
-                   TpuSpec, TransportEncryptionSpec, VolumeSpec, VolumeType)
+                   HostVolumeSpec, PhaseSpec, PlanSpecModel, PodSpec,
+                   PortSpec, ReadinessCheckSpec, ReplacementFailurePolicy,
+                   ResourceSet, RLimitSpec, SecretSpec, ServiceSpec,
+                   StepSpecEntry, TaskSpec, TpuSpec, TransportEncryptionSpec,
+                   VolumeSpec, VolumeType)
 
 TASKCFG_ALL_PREFIX = "TASKCFG_ALL_"
 TASKCFG_POD_PREFIX = "TASKCFG_"
@@ -180,6 +181,24 @@ def _map_pod(pod_type: str, raw: Mapping[str, Any], env: Mapping[str, str],
             file_path=sec_raw.get("file"),
         ))
 
+    host_volumes = []
+    for _, hv_raw in (raw.get("host-volumes") or {}).items():
+        hv_raw = hv_raw or {}
+        host_volumes.append(HostVolumeSpec(
+            host_path=hv_raw["host-path"],
+            container_path=hv_raw["container-path"],
+        ))
+
+    rlimits = []
+    for rl_name, rl_raw in (raw.get("rlimits") or {}).items():
+        rl_raw = rl_raw or {}
+        rlimits.append(RLimitSpec(
+            # canonical upper-case form (the agent matches case-sensitively)
+            name=str(rl_name).upper(),
+            soft=None if rl_raw.get("soft") is None else int(rl_raw["soft"]),
+            hard=None if rl_raw.get("hard") is None else int(rl_raw["hard"]),
+        ))
+
     return PodSpec(
         type=pod_type,
         count=int(raw.get("count", 1)),
@@ -196,6 +215,9 @@ def _map_pod(pod_type: str, raw: Mapping[str, Any], env: Mapping[str, str],
         allow_decommission=bool(raw.get("allow-decommission", True)),
         share_pid_namespace=bool(raw.get("share-pid-namespace", False)),
         secrets=tuple(secrets),
+        volumes=tuple(_map_volumes(raw)),
+        host_volumes=tuple(host_volumes),
+        rlimits=tuple(rlimits),
     )
 
 
@@ -212,17 +234,7 @@ def _map_resource_set(rs_id: str, raw: Mapping[str, Any]) -> ResourceSet:
             ))
         else:
             ports.append(PortSpec(name=name, port=int(port_raw)))
-    volumes = []
-    vol_raw = raw.get("volume")
-    vols_raw = list(raw.get("volumes") or ([] if vol_raw is None else [vol_raw]))
-    if vol_raw is not None and raw.get("volumes"):
-        vols_raw.append(vol_raw)
-    for v in vols_raw:
-        volumes.append(VolumeSpec(
-            container_path=v["path"],
-            size_mb=int(v["size"]),
-            type=VolumeType(str(v.get("type", "ROOT")).upper()),
-        ))
+    volumes = _map_volumes(raw)
     return ResourceSet(
         id=rs_id,
         cpus=float(raw.get("cpus", 0.0)),
@@ -232,6 +244,27 @@ def _map_resource_set(rs_id: str, raw: Mapping[str, Any]) -> ResourceSet:
         ports=tuple(ports),
         volumes=tuple(volumes),
     )
+
+
+def _map_volumes(raw: Mapping[str, Any]) -> list[VolumeSpec]:
+    """``volume:`` (single) and/or ``volumes:`` (list) -> VolumeSpecs; used
+    at both resource-set/task and pod level (reference RawPod/RawTask)."""
+    vol_raw = raw.get("volume")
+    vols_raw = list(raw.get("volumes") or ([] if vol_raw is None else [vol_raw]))
+    if vol_raw is not None and raw.get("volumes"):
+        vols_raw.append(vol_raw)
+    out = []
+    for v in vols_raw:
+        profiles = v.get("profiles") or ()
+        if isinstance(profiles, str):
+            profiles = (profiles,)
+        out.append(VolumeSpec(
+            container_path=v["path"],
+            size_mb=int(v["size"]),
+            type=VolumeType(str(v.get("type", "ROOT")).upper()),
+            profiles=tuple(str(p) for p in profiles if p),
+        ))
+    return out
 
 
 def _map_task(name: str, raw: Mapping[str, Any], rs_id: str,

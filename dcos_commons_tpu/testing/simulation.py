@@ -59,10 +59,13 @@ class _LambdaTick(Tick):
         return self._description
 
 
-def default_agents(n: int = 3) -> List[AgentInfo]:
+def default_agents(n: int = 3, volume_profiles: tuple = (),
+                   roles: tuple = ("*",)) -> List[AgentInfo]:
     return [AgentInfo(agent_id=f"agent-{i}", hostname=f"host-{i}", cpus=8,
                       memory_mb=16384, disk_mb=65536,
-                      ports=(PortRange(10000, 10500),))
+                      ports=(PortRange(10000, 10500),),
+                      volume_profiles=tuple(volume_profiles),
+                      roles=tuple(roles))
             for i in range(n)]
 
 

@@ -32,6 +32,8 @@ DEFAULT_ENV: Mapping[str, str] = {
     "HELLO_PLACEMENT": "",
     "WORLD_PLACEMENT": "",
     "SLEEP_DURATION": "1000",
+    "HELLO_VOLUME_PROFILE": "fast-ssd",
+    "TEST_BOOLEAN": "true",
     "DEPLOY_STRATEGY": "serial",
     "HELLO_URI": "https://example.com/artifact.tar.gz",
     "TPU_CHIPS": "4",

@@ -23,6 +23,7 @@
 #include <fcntl.h>
 #include <limits.h>
 #include <signal.h>
+#include <sys/resource.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <sys/wait.h>
@@ -96,6 +97,8 @@ struct Config {
   long port_lo = 10000, port_hi = 20000;
   int tpu_chips = -1;  // -1: probe /dev/accel*
   std::string slice_id, topology, zone, region;
+  std::vector<std::string> volume_profiles;  // mount-disk profiles served
+  std::vector<std::string> roles = {"*"};    // reservation role pools
   int worker_index = -1;
   double poll_interval_s = 1.0;
   long max_polls = -1;  // test hook: exit after N polls (-1 = forever)
@@ -129,6 +132,23 @@ long detect_memory_mb() {
   long page_size = sysconf(_SC_PAGE_SIZE);
   if (pages <= 0 || page_size <= 0) return 1024;
   return pages / 1024 * page_size / 1024;
+}
+
+// Resolve an rlimit name like "RLIMIT_NOFILE" / "NOFILE" to the resource
+// constant (reference specification/RLimitSpec.java name validation).
+int rlimit_by_name(std::string name) {
+  if (name.rfind("RLIMIT_", 0) == 0) name = name.substr(7);
+  if (name == "NOFILE") return RLIMIT_NOFILE;
+  if (name == "NPROC") return RLIMIT_NPROC;
+  if (name == "CORE") return RLIMIT_CORE;
+  if (name == "CPU") return RLIMIT_CPU;
+  if (name == "DATA") return RLIMIT_DATA;
+  if (name == "FSIZE") return RLIMIT_FSIZE;
+  if (name == "MEMLOCK") return RLIMIT_MEMLOCK;
+  if (name == "STACK") return RLIMIT_STACK;
+  if (name == "AS") return RLIMIT_AS;
+  if (name == "RSS") return RLIMIT_RSS;
+  return -1;
 }
 
 bool mkdirs(const std::string& path) {
@@ -193,6 +213,14 @@ class Agent {
         .set("tpu", tpu);
     if (!cfg_.zone.empty()) body.set("zone", cfg_.zone);
     if (!cfg_.region.empty()) body.set("region", cfg_.region);
+    if (!cfg_.volume_profiles.empty()) {
+      Json profiles = Json::array();
+      for (const auto& p : cfg_.volume_profiles) profiles.push_back(p);
+      body.set("volume_profiles", profiles);
+    }
+    Json roles = Json::array();
+    for (const auto& r : cfg_.roles) roles.push_back(r);
+    body.set("roles", roles);
     return body;
   }
 
@@ -457,6 +485,32 @@ class Agent {
       }
     }
 
+    // host volumes (reference host-volume.yml): an absolute host directory
+    // appears at a sandbox-relative path via symlink
+    for (const auto& hv : task.get("host_volumes").items()) {
+      const auto& pair = hv.items();
+      if (pair.size() != 2) continue;
+      const std::string host_path = pair[0].as_string();
+      const std::string rel = pair[1].as_string();
+      if (host_path.empty() || host_path[0] != '/' || rel.empty() ||
+          rel[0] == '/' || rel.find("..") != std::string::npos) {
+        emit(task_id, task_name, "TASK_FAILED",
+             "bad host volume " + host_path + " -> " + rel);
+        return;
+      }
+      std::string link = sandbox + "/" + rel;
+      size_t parent_end = link.rfind('/');
+      if (parent_end != std::string::npos) {
+        mkdirs(link.substr(0, parent_end));
+      }
+      if (::symlink(host_path.c_str(), link.c_str()) != 0 &&
+          errno != EEXIST) {
+        emit(task_id, task_name, "TASK_FAILED",
+             "cannot link host volume " + rel + " -> " + host_path);
+        return;
+      }
+    }
+
     for (const auto& uri : task.get("uris").items()) {
       std::string err;
       if (!fetch_uri(uri.as_string(), sandbox, err)) {
@@ -491,6 +545,28 @@ class Agent {
           src + "," + tmpl.get("dest").as_string());
     }
 
+    // POSIX limits for the task process (reference RLimitSpec): parsed
+    // before fork so a bad name fails the launch, applied in the child
+    struct RLimitReq { int resource; rlim_t soft; rlim_t hard; };
+    std::vector<RLimitReq> rlimits;
+    for (const auto& rl : task.get("rlimits").items()) {
+      int resource = rlimit_by_name(rl.get("name").as_string());
+      if (resource < 0) {
+        emit(task_id, task_name, "TASK_FAILED",
+             "unknown rlimit " + rl.get("name").as_string());
+        return;
+      }
+      RLimitReq req;
+      req.resource = resource;
+      req.soft = rl.get("soft").is_null()
+                     ? RLIM_INFINITY
+                     : static_cast<rlim_t>(rl.get("soft").as_number());
+      req.hard = rl.get("hard").is_null()
+                     ? RLIM_INFINITY
+                     : static_cast<rlim_t>(rl.get("hard").as_number());
+      rlimits.push_back(req);
+    }
+
     pid_t pid = fork();
     if (pid < 0) {
       emit(task_id, task_name, "TASK_FAILED", "fork failed");
@@ -512,6 +588,33 @@ class Agent {
       int err = open("stderr.log", O_WRONLY | O_CREAT | O_APPEND, 0644);
       if (out >= 0) dup2(out, 1);
       if (err >= 0) dup2(err, 2);
+      // rlimits after dup2 so failures land in stderr.log. Raising a hard
+      // limit past the inherited one needs CAP_SYS_RESOURCE; "unlimited"
+      // (RLIM_INFINITY) therefore falls back to the agent's current hard
+      // limit instead of killing the task with an opaque EPERM.
+      for (const auto& rl : rlimits) {
+        struct rlimit lim;
+        lim.rlim_cur = rl.soft;
+        lim.rlim_max = rl.hard;
+        if (setrlimit(rl.resource, &lim) != 0) {
+          struct rlimit cur;
+          if (getrlimit(rl.resource, &cur) == 0) {
+            if (lim.rlim_max == RLIM_INFINITY || lim.rlim_max > cur.rlim_max)
+              lim.rlim_max = cur.rlim_max;
+            if (lim.rlim_cur == RLIM_INFINITY || lim.rlim_cur > lim.rlim_max)
+              lim.rlim_cur = lim.rlim_max;
+            fprintf(stderr,
+                    "[tpu-agent] clamping rlimit %d to hard=%llu\n",
+                    rl.resource,
+                    static_cast<unsigned long long>(lim.rlim_max));
+          }
+          if (setrlimit(rl.resource, &lim) != 0) {
+            fprintf(stderr, "[tpu-agent] setrlimit(%d) failed: %s\n",
+                    rl.resource, strerror(errno));
+            _exit(125);
+          }
+        }
+      }
       execl("/bin/sh", "sh", "-c", cmd.c_str(), (char*)nullptr);
       _exit(127);
     }
@@ -735,6 +838,8 @@ void usage(const char* argv0) {
       << "  --tpu-chips N       TPU chips (default: probe /dev/accel*)\n"
       << "  --slice-id S --topology T --worker-index N   ICI identity\n"
       << "  --zone Z --region R\n"
+      << "  --volume-profiles P1,P2   mount-disk profiles served\n"
+      << "  --roles R1,R2       reservation role pools (default '*')\n"
       << "  --poll-interval S   seconds between polls (default 1)\n"
       << "  --max-polls N       exit after N polls (testing)\n";
 }
@@ -779,6 +884,22 @@ int main(int argc, char** argv) {
     else if (a == "--worker-index") cfg.worker_index = std::stoi(next());
     else if (a == "--zone") cfg.zone = next();
     else if (a == "--region") cfg.region = next();
+    else if (a == "--volume-profiles") {
+      cfg.volume_profiles.clear();
+      std::istringstream ss(next());
+      std::string item;
+      while (std::getline(ss, item, ',')) {
+        if (!item.empty()) cfg.volume_profiles.push_back(item);
+      }
+    } else if (a == "--roles") {
+      cfg.roles.clear();
+      std::istringstream ss(next());
+      std::string item;
+      while (std::getline(ss, item, ',')) {
+        if (!item.empty()) cfg.roles.push_back(item);
+      }
+      if (cfg.roles.empty()) cfg.roles.push_back("*");
+    }
     else if (a == "--poll-interval") cfg.poll_interval_s = std::stod(next());
     else if (a == "--max-polls") cfg.max_polls = std::stol(next());
     else {

@@ -241,3 +241,83 @@ class TestLedger:
         removed = ledger.remove_pod("p-0")
         assert len(removed) == 2
         assert [r.pod_instance_name for r in ledger.all()] == ["p-1"]
+
+
+class TestRolesAndProfiles:
+    """Pre-reserved role pools and mount-disk profile matching."""
+
+    ROLE_YML = """
+name: svc
+pods:
+  hello:
+    count: 1
+    pre-reserved-role: pool-a
+    tasks:
+      server: {goal: RUNNING, cmd: run, cpus: 0.1, memory: 32}
+"""
+
+    PROFILE_YML = """
+name: svc
+pods:
+  hello:
+    count: 1
+    volume: {path: pod-data, size: 64, type: MOUNT, profiles: [ssd]}
+    tasks:
+      server: {goal: RUNNING, cmd: run, cpus: 0.1, memory: 32}
+"""
+
+    def test_role_mismatch_fails_then_matches(self):
+        import dataclasses
+        spec = load_service_yaml_str(self.ROLE_YML, {})
+        ev = Evaluator("svc")
+        ledger = ReservationLedger()
+        plain = cpu_agent(1)
+        plan, outcome = ev.evaluate(req(spec, "hello", 0), [plain], [],
+                                    ledger)
+        assert plan is None
+        pooled = dataclasses.replace(cpu_agent(2), roles=("*", "pool-a"))
+        plan, _ = ev.evaluate(req(spec, "hello", 0), [plain, pooled], [],
+                              ledger)
+        assert plan is not None
+        assert plan.agent.agent_id == "a2"
+
+    def test_profile_mismatch_fails_then_matches(self):
+        import dataclasses
+        spec = load_service_yaml_str(self.PROFILE_YML, {})
+        ev = Evaluator("svc")
+        ledger = ReservationLedger()
+        plain = cpu_agent(1)
+        plan, _ = ev.evaluate(req(spec, "hello", 0), [plain], [], ledger)
+        assert plan is None
+        ssd = dataclasses.replace(cpu_agent(2), volume_profiles=("ssd",))
+        plan, _ = ev.evaluate(req(spec, "hello", 0), [plain, ssd], [],
+                              ledger)
+        assert plan is not None
+        pod_res = [r for r in plan.reservations
+                   if r.resource_set_id == "_pod"]
+        assert len(pod_res) == 1
+        assert pod_res[0].disk_mb == 64
+        assert plan.launches[0].volumes == ("pod-data",)
+
+    def test_pod_volume_reservation_reused_on_relaunch(self):
+        import dataclasses
+        spec = load_service_yaml_str(self.PROFILE_YML, {})
+        ev = Evaluator("svc")
+        ledger = ReservationLedger()
+        ssd = dataclasses.replace(cpu_agent(1), volume_profiles=("ssd",))
+        plan, _ = ev.evaluate(req(spec, "hello", 0), [ssd], [], ledger)
+        for r in plan.reservations:
+            ledger.add(r)
+        plan2, _ = ev.evaluate(req(spec, "hello", 0), [ssd], [], ledger)
+        assert plan2 is not None
+        # nothing newly reserved: both sets reused
+        assert plan2.reservations == ()
+
+    def test_custom_tld_in_framework_host(self):
+        spec = load_service_yaml_str(self.ROLE_YML, {})
+        import dataclasses
+        ev = Evaluator("svc", tld="corp.example")
+        ledger = ReservationLedger()
+        pooled = dataclasses.replace(cpu_agent(1), roles=("*", "pool-a"))
+        plan, _ = ev.evaluate(req(spec, "hello", 0), [pooled], [], ledger)
+        assert plan.launches[0].env["FRAMEWORK_HOST"] == "svc.corp.example"

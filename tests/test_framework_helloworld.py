@@ -34,8 +34,13 @@ class TestEveryScenarioDeploys:
         s for s in scenarios.list_scenarios()
         if s not in ("crash-loop", "canary")])
     def test_deploys(self, scenario):
-        agents = (tpu_slice_agents() if scenario == "tpu_resource"
-                  else default_agents(5))
+        if scenario == "tpu_resource":
+            agents = tpu_slice_agents()
+        else:
+            # profile/role scenarios need hosts advertising the matching
+            # mount-disk profile / pre-reserved role pool
+            agents = default_agents(5, volume_profiles=("fast-ssd",),
+                                    roles=("*", "reserved-pool"))
         # pin topology: the host's real TPU runtime env (TPU_TOPOLOGY etc.)
         # would otherwise leak through scenario_env's os.environ merge
         runner_for(scenario, {"TPU_TOPOLOGY": "v4-16"}, agents=agents).run([
@@ -264,3 +269,96 @@ class TestFeatureScenarios:
         ])
         override, _ = runner.scheduler.state.fetch_override("hello-0-server")
         assert override is GoalOverride.NONE
+
+
+class TestVolumeAndRoleScenarios:
+    """host-volume / profile-mount-volume / pre-reserved / rlimits /
+    enable-disable / custom_tld scenario behavior (reference
+    ``frameworks/helloworld/src/main/dist/`` equivalents)."""
+
+    def test_profile_volume_blocked_without_matching_agent(self):
+        runner = runner_for("profile-mount-volume",
+                            agents=default_agents(2))
+        sched = runner.run([Send.until_quiet()])
+        assert sched.plan("deploy").status is not Status.COMPLETE
+        # the outcome tracker records the profile shortfall
+        outcomes = sched.outcome_tracker.to_dict()
+        assert "profile" in str(outcomes)
+
+    def test_profile_volume_deploys_on_matching_agent(self):
+        runner = runner_for(
+            "profile-mount-volume",
+            agents=default_agents(2, volume_profiles=("fast-ssd", "hdd")))
+        runner.run([Send.until_quiet(), Expect.deployed()])
+
+    def test_pod_profile_volume_reserves_pod_set(self):
+        runner = runner_for(
+            "pod-profile-mount-volume",
+            agents=default_agents(2, volume_profiles=("fast-ssd",)))
+        sched = runner.run([Send.until_quiet(), Expect.deployed()])
+        res = sched.ledger.get("hello-0", "_pod")
+        assert res is not None
+        assert {v.container_path for v in res.volumes} == {"pod-path"}
+        # every task of the pod sees the pod-level volume
+        for plan in runner.cluster.launch_log:
+            for launch in plan.launches:
+                assert "pod-path" in launch.volumes
+
+    def test_pre_reserved_role_blocked_without_pool(self):
+        runner = runner_for("pre-reserved", agents=default_agents(3))
+        sched = runner.run([Send.until_quiet()])
+        assert sched.plan("deploy").status is not Status.COMPLETE
+
+    def test_pre_reserved_role_deploys_on_pool_agent(self):
+        runner = runner_for(
+            "pre-reserved",
+            agents=default_agents(3, roles=("*", "reserved-pool")))
+        runner.run([Send.until_quiet(), Expect.deployed()])
+
+    def test_host_volume_launches_carry_mounts(self):
+        runner = runner_for("host-volume")
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        by_pod = {}
+        for plan in runner.cluster.launch_log:
+            for launch in plan.launches:
+                by_pod[launch.task_name] = launch.host_volumes
+        assert by_pod["hello-0-server"] == (("/etc", "host-volume-etc"),)
+        assert set(by_pod["world-0-server"]) == {
+            ("/etc", "host-volume-etc"), ("/var", "host-volume-var")}
+
+    def test_rlimits_launches_carry_limits(self):
+        runner = runner_for("rlimits")
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        launch = runner.cluster.launch_log[0].launches[0]
+        limits = dict((n, (s, h)) for n, s, h in launch.rlimits)
+        assert limits["RLIMIT_NOFILE"] == (1024, 2048)
+        assert limits["RLIMIT_CORE"] == (None, None)
+
+    def test_enable_disable_toggles_steps(self):
+        enabled = scenarios.load_scenario("enable-disable",
+                                          {"TEST_BOOLEAN": "true"})
+        disabled = scenarios.load_scenario("enable-disable",
+                                           {"TEST_BOOLEAN": ""})
+        plan_on = enabled.plan("deploy")
+        plan_off = disabled.plan("deploy")
+        assert len(plan_on.phases[0].steps) == 2
+        assert len(plan_off.phases[0].steps) == 1
+
+    def test_custom_tld_in_env_and_endpoints(self):
+        runner = runner_for("custom_tld", tld="test.tld")
+        sched = runner.run([Send.until_quiet(), Expect.deployed()])
+        launch = runner.cluster.launch_log[0].launches[0]
+        assert launch.env["FRAMEWORK_HOST"] == "hello-world.test.tld"
+        from dcos_commons_tpu.http.queries import EndpointQueries
+        eps = EndpointQueries(sched)
+        entry = eps.get("test")
+        assert entry["dns"] and entry["dns"][0].endswith(
+            ":%s" % entry["address"][0].split(":")[1])
+        assert ".test.tld:" in entry["dns"][0]
+
+    def test_non_recoverable_state_stays_incomplete(self):
+        from dcos_commons_tpu.agent.fake import TaskBehavior
+        runner = runner_for("non_recoverable_state")
+        runner.cluster.script("server", TaskBehavior.CRASH)
+        sched = runner.run([Send.until_quiet()])
+        assert sched.plan("deploy").status is not Status.COMPLETE

@@ -538,3 +538,95 @@ def test_scale_down_and_uninstall_against_real_agent(native_bins, tmp_path):
             server.stop()
         except Exception:
             pass
+
+
+def test_rlimits_and_host_volumes_applied(native_bins, tmp_path):
+    """The agent applies pod rlimits via setrlimit in the task process and
+    surfaces host volumes as sandbox symlinks (reference RLimitSpec +
+    host-volume.yml)."""
+    host_dir = tmp_path / "exported"
+    host_dir.mkdir()
+    (host_dir / "marker.txt").write_text("from-host\n")
+    yml = f"""
+name: limits-svc
+pods:
+  box:
+    count: 1
+    rlimits:
+      RLIMIT_NOFILE: {{soft: 777, hard: 777}}
+    host-volumes:
+      exported: {{host-path: {host_dir}, container-path: host-view}}
+    tasks:
+      probe:
+        goal: RUNNING
+        cmd: "ulimit -n > limits.txt && cat host-view/marker.txt > seen.txt && sleep 600"
+        cpus: 0.5
+        memory: 128
+"""
+    cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.05)
+    sched = ServiceScheduler(load_service_yaml_str(yml),
+                             MemPersister(), cluster)
+    server = ApiServer(sched, port=0, cluster=cluster)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    sandbox_root = tmp_path / "sb"
+    agent = subprocess.Popen(
+        [str(native_bins / "tpu-agent"), "--scheduler", url,
+         "--agent-id", "lim0", "--hostname", "node0",
+         "--cpus", "4", "--memory-mb", "4096", "--disk-mb", "10000",
+         "--base-dir", str(sandbox_root), "--poll-interval", "0.05",
+         "--tpu-chips", "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        drive_to(sched, "deploy", Status.COMPLETE)
+        limits = wait_for(
+            lambda: next(iter(sandbox_root.glob("box-0-probe*/limits.txt")),
+                         None),
+            message="limits.txt in sandbox")
+        wait_for(lambda: limits.read_text().strip() == "777",
+                 message="ulimit applied")
+        seen = next(iter(sandbox_root.glob("box-0-probe*/seen.txt")))
+        assert seen.read_text() == "from-host\n"
+    finally:
+        agent.terminate()
+        agent.wait(timeout=5)
+        server.stop()
+
+
+def test_agent_advertises_profiles_and_roles(native_bins, tmp_path):
+    """--volume-profiles/--roles flags surface in the scheduler's agent
+    inventory and gate matching."""
+    cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.05)
+    yml = """
+name: prof-svc
+pods:
+  box:
+    pre-reserved-role: gold
+    count: 1
+    volume: {path: data, size: 16, type: MOUNT, profiles: [nvme]}
+    tasks:
+      probe: {goal: RUNNING, cmd: "sleep 600", cpus: 0.5, memory: 128}
+"""
+    sched = ServiceScheduler(load_service_yaml_str(yml),
+                             MemPersister(), cluster)
+    server = ApiServer(sched, port=0, cluster=cluster)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    agent = subprocess.Popen(
+        [str(native_bins / "tpu-agent"), "--scheduler", url,
+         "--agent-id", "pr0", "--hostname", "node0",
+         "--cpus", "4", "--memory-mb", "4096", "--disk-mb", "10000",
+         "--base-dir", str(tmp_path / "sb"), "--poll-interval", "0.05",
+         "--tpu-chips", "0",
+         "--volume-profiles", "nvme,hdd", "--roles", "*,gold"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        info = wait_for(lambda: next(iter(cluster.agents()), None),
+                        message="agent registration")
+        assert info.volume_profiles == ("nvme", "hdd")
+        assert info.roles == ("*", "gold")
+        drive_to(sched, "deploy", Status.COMPLETE)
+    finally:
+        agent.terminate()
+        agent.wait(timeout=5)
+        server.stop()

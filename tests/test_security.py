@@ -123,3 +123,11 @@ class TestScenarios:
         assert again == spec
         tls_spec = scenarios.load_scenario("tls")
         assert ServiceSpec.from_json(tls_spec.to_json()) == tls_spec
+
+
+def test_certificate_names_honor_custom_tld():
+    from dcos_commons_tpu.security.tls import certificate_names
+    cn, sans = certificate_names("svc", "hello-0", "hello-0-server",
+                                 tld="corp.example")
+    assert cn == "hello-0.svc.corp.example"
+    assert all(s.endswith(".corp.example") for s in sans)

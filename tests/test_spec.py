@@ -158,3 +158,80 @@ def test_pod_instance_names():
     inst = PodInstance(spec.pod("hello"), 1)
     assert inst.name == "hello-1"
     assert inst.task_instance_name("server") == "hello-1-server"
+
+
+class TestHostProfileRlimitSpecs:
+    """New pod-level surfaces: host volumes, volume profiles, rlimits
+    (reference HostVolumeSpec/RLimitSpec/profile-mount-volumes)."""
+
+    YML = """
+name: svc
+pods:
+  hello:
+    count: 1
+    host-volumes:
+      etc-view: {host-path: /etc, container-path: etc-view}
+    rlimits:
+      RLIMIT_NOFILE: {soft: 100, hard: 200}
+      RLIMIT_CORE: {}
+    volume: {path: pod-data, size: 64, type: MOUNT, profiles: [ssd]}
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: run
+        cpus: 0.1
+        memory: 32
+        volume: {path: data, size: 32}
+"""
+
+    def test_yaml_round_trip(self):
+        from dcos_commons_tpu.specification import ServiceSpec
+        spec = load_service_yaml_str(self.YML, {})
+        pod = spec.pod("hello")
+        assert pod.host_volumes[0].host_path == "/etc"
+        assert pod.rlimits[0].name in ("RLIMIT_NOFILE", "RLIMIT_CORE")
+        limits = {r.name: r for r in pod.rlimits}
+        assert limits["RLIMIT_NOFILE"].soft == 100
+        assert limits["RLIMIT_CORE"].soft is None
+        assert pod.volumes[0].profiles == ("ssd",)
+        # canonical JSON round-trip must preserve the new fields
+        clone = ServiceSpec.from_json(spec.to_json())
+        assert clone == spec
+
+    def test_rlimit_validation(self):
+        from dcos_commons_tpu.specification import RLimitSpec
+        assert RLimitSpec("RLIMIT_NOFILE", 10, 5).validate()
+        assert RLimitSpec("RLIMIT_NOFILE", 10, None).validate()
+        assert not RLimitSpec("RLIMIT_NOFILE", 10, 20).validate()
+        assert not RLimitSpec("RLIMIT_NOFILE").validate()
+
+    def test_host_volume_validation(self):
+        from dcos_commons_tpu.specification import HostVolumeSpec
+        assert HostVolumeSpec("relative", "x").validate()
+        assert HostVolumeSpec("/etc", "/abs").validate()
+        assert HostVolumeSpec("/etc", "../escape").validate()
+        assert not HostVolumeSpec("/etc", "ok-path").validate()
+
+    def test_profiles_require_mount(self):
+        from dcos_commons_tpu.specification import VolumeSpec, VolumeType
+        assert VolumeSpec("p", 10, VolumeType.ROOT, ("ssd",)).validate()
+        assert not VolumeSpec("p", 10, VolumeType.MOUNT, ("ssd",)).validate()
+
+    def test_pod_and_rs_volume_path_collision_rejected(self):
+        yml = """
+name: svc
+pods:
+  hello:
+    count: 1
+    volume: {path: data, size: 64}
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: run
+        cpus: 0.1
+        memory: 32
+        volume: {path: data, size: 32}
+"""
+        import pytest
+        with pytest.raises(ValueError, match="both pod and resource-set"):
+            load_service_yaml_str(yml, {})
