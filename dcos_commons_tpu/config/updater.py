@@ -90,6 +90,37 @@ def volumes_cannot_change(old, new):
             if p is not None and p.volumes != rs.volumes:
                 errs.append(f"pod {pod.type}/resource-set {rs.id}: volumes "
                             f"cannot change")
+        if prev.volumes != pod.volumes:
+            errs.append(f"pod {pod.type}: pod-level volumes cannot change")
+    return errs
+
+
+def region_placement_cannot_change(old, new):
+    """Reference ``RegionCannotChange``: moving a service between regions
+    strands reserved resources and data. Blocks both toggling region-aware
+    placement and retargeting it (any placement-rule change while a region
+    rule is in play on either side)."""
+    errs = []
+    old_pods = _pods_by_type(old)
+    for pod in new.pods:
+        prev = old_pods.get(pod.type)
+        if prev is None:
+            continue
+        prev_region = prev.placement_rule is not None and \
+            prev.placement_rule.references_regions()
+        new_region = pod.placement_rule is not None and \
+            pod.placement_rule.references_regions()
+        if not prev_region and not new_region:
+            continue
+        from ..matching.placement import rule_to_json
+        prev_json = rule_to_json(prev.placement_rule) \
+            if prev.placement_rule else None
+        new_json = rule_to_json(pod.placement_rule) \
+            if pod.placement_rule else None
+        if prev_json != new_json:
+            errs.append(
+                f"pod {pod.type}: region-aware placement cannot change "
+                "after deployment")
     return errs
 
 
@@ -231,6 +262,7 @@ DEFAULT_VALIDATORS: tuple[ConfigValidator, ...] = (
     pre_reservation_cannot_change,
     placement_rules_valid,
     zone_placement_cannot_change,
+    region_placement_cannot_change,
 )
 
 

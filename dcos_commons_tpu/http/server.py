@@ -356,6 +356,26 @@ class ApiServer:
             return 404, {"error": "no agent transport mounted"}
         if method == "GET" and rest == "agents":
             return 200, [a.agent_id for a in self._cluster.agents()]
+        if method == "GET" and rest == "agents/info":
+            # full inventory (reference: Mesos /slaves consumed by
+            # testing/sdk_agents.py); fields mirror AgentInfo
+            return 200, [{
+                "agent_id": a.agent_id,
+                "hostname": a.hostname,
+                "cpus": a.cpus,
+                "memory_mb": a.memory_mb,
+                "disk_mb": a.disk_mb,
+                "tpu": {"chips": a.tpu.chips, "slice_id": a.tpu.slice_id,
+                        "topology": a.tpu.topology,
+                        "coords": list(a.tpu.coords) if a.tpu.coords
+                        else None,
+                        "worker_index": a.tpu.worker_index},
+                "attributes": dict(a.attributes),
+                "zone": a.zone,
+                "region": a.region,
+                "volume_profiles": list(a.volume_profiles),
+                "roles": list(a.roles),
+            } for a in self._cluster.agents()]
         try:
             payload = json.loads(body.decode()) if body else {}
         except ValueError:

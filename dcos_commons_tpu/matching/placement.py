@@ -78,14 +78,24 @@ class PlacementRule:
             out.extend(matcher.problems())
         return out
 
+    def _children(self) -> tuple:
+        return getattr(self, "rules", None) or \
+            ((self.rule,) if hasattr(self, "rule") else ())
+
+    def _references(self, axis: str) -> bool:
+        if any(c._references(axis) for c in self._children()):
+            return True
+        return axis in self.type or getattr(self, "by", None) == axis
+
     def references_zones(self) -> bool:
         """Whether zone-aware placement is in play (reference
         ``ZoneValidator``/``PlacementUtils.placementRuleReferencesZone``)."""
-        children = getattr(self, "rules", None) or \
-            ((self.rule,) if hasattr(self, "rule") else ())
-        if any(c.references_zones() for c in children):
-            return True
-        return "zone" in self.type or getattr(self, "by", None) == "zone"
+        return self._references("zone")
+
+    def references_regions(self) -> bool:
+        """Region analogue of :meth:`references_zones` (reference
+        ``RegionCannotChange`` consults region rules)."""
+        return self._references("region")
 
 
 _REGISTRY: dict[str, Callable[[Mapping[str, Any]], PlacementRule]] = {}

@@ -11,4 +11,4 @@ Two tiers, mirroring the reference:
 """
 
 from .simulation import Expect, Send, ServiceTestRunner, TickFailure
-from . import integration
+from . import diag, integration

@@ -1,0 +1,75 @@
+"""Diagnostics bundle capture.
+
+Reference ``testing/sdk_diag.py``: after a failed integration test it
+collects per-test diagnostics (plan states, pod statuses, scheduler logs,
+task sandboxes) into a bundle directory for postmortem. Here the scheduler's
+debug surface is HTTP, so a bundle is a directory of JSON snapshots of every
+read-only endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+# every read-only surface worth snapshotting, service-relative
+SERVICE_PATHS = (
+    "plans",
+    "pod/status",
+    "endpoints",
+    "configurations",
+    "configurations/targetId",
+    "state/frameworkId",
+    "state/properties",
+    "debug/offers",
+    "debug/plans",
+    "debug/taskStatuses",
+    "debug/reservations",
+)
+ROOT_PATHS = ("health", "metrics", "multi", "agents", "agents/info")
+
+
+def _fetch(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return json.loads(r.read().decode() or "null")
+    except urllib.error.HTTPError as e:
+        try:
+            return {"_http_error": e.code, "body": json.loads(e.read().decode())}
+        except ValueError:
+            return {"_http_error": e.code}
+    except Exception as e:  # noqa: BLE001 — a bundle never fails the caller
+        return {"_unreachable": str(e)}
+
+
+def capture_diagnostics(base_url: str, out_dir: str,
+                        service: Optional[str] = None,
+                        label: Optional[str] = None) -> str:
+    """Snapshot every read-only endpoint into ``out_dir`` and return the
+    bundle path. Failures of individual endpoints are recorded in place
+    rather than raised (reference sdk_diag keeps collecting on error)."""
+    stamp = label or time.strftime("%Y%m%d-%H%M%S")
+    bundle = os.path.join(out_dir, f"diag-{stamp}")
+    os.makedirs(bundle, exist_ok=True)
+    base = base_url.rstrip("/")
+    prefix = f"{base}/v1/service/{service}" if service else f"{base}/v1"
+
+    def save(name: str, payload) -> None:
+        fname = name.replace("/", "_") + ".json"
+        with open(os.path.join(bundle, fname), "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+
+    for path in SERVICE_PATHS:
+        save(path, _fetch(f"{prefix}/{path}"))
+    for path in ROOT_PATHS:
+        save("root_" + path, _fetch(f"{base}/v1/{path}"))
+    # expand per-plan detail (the plans list is names only)
+    plans = _fetch(f"{prefix}/plans")
+    if isinstance(plans, list):
+        for plan in plans:
+            save(f"plan_{plan}", _fetch(f"{prefix}/plans/{plan}"))
+    return bundle

@@ -275,3 +275,108 @@ def check_config_updated(client: ServiceClient, old_target_id: str) -> str:
         raise IntegrationError(
             f"target config did not change (still {old_target_id})")
     return new_id
+
+
+# -- endpoints (sdk_networks.py) --------------------------------------------
+
+def get_endpoints(client: ServiceClient, name: Optional[str] = None):
+    """Endpoint names, or one endpoint's address/dns lists (reference
+    ``sdk_networks.get_endpoint``)."""
+    path = f"endpoints/{name}" if name else "endpoints"
+    code, body = client.get(path)
+    if code != 200:
+        raise IntegrationError(f"{path} -> {code}: {body}")
+    return body
+
+
+def wait_for_endpoint(client: ServiceClient, name: str, n_addresses: int = 1,
+                      timeout_s: float = DEFAULT_TIMEOUT_S) -> dict:
+    def check():
+        code, body = client.get(f"endpoints/{name}")
+        if code == 200 and len(body.get("address", ())) >= n_addresses:
+            return body
+        return None
+
+    return client.wait_for(f"endpoint {name} with >= {n_addresses} addrs",
+                           check, timeout_s)
+
+
+# -- agents (sdk_agents.py) --------------------------------------------------
+
+def get_agents(base_url: str) -> List[str]:
+    """Registered agent ids (reference ``sdk_agents.get_agents`` reading the
+    Mesos /slaves state)."""
+    with urllib.request.urlopen(f"{base_url}/v1/agents", timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+def get_agent_info(base_url: str) -> List[dict]:
+    """Full agent inventories (resources, TPU topology, fault domain,
+    profiles, roles) from ``/v1/agents/info``."""
+    with urllib.request.urlopen(f"{base_url}/v1/agents/info",
+                                timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+def wait_for_agents(base_url: str, n: int,
+                    timeout_s: float = 60.0) -> List[str]:
+    client = ServiceClient(base_url)
+
+    def check():
+        agents = get_agents(base_url)
+        return agents if len(agents) >= n else None
+
+    return client.wait_for(f"{n} registered agents", check, timeout_s)
+
+
+# -- fault domains (sdk_fault_domain.py) ------------------------------------
+
+def get_task_fault_domains(client: ServiceClient,
+                           prefix: str = "") -> Dict[str, tuple]:
+    """instance name -> (zone, region) from the pod status (reference
+    ``sdk_fault_domain`` helpers assert spread over zones/regions)."""
+    code, body = client.get("pod/status")
+    if code != 200:
+        raise IntegrationError(f"pod/status -> {code}: {body}")
+    out: Dict[str, tuple] = {}
+    for pod in body.get("pods", []):
+        for task in pod.get("tasks", []):
+            if task["name"].startswith(prefix):
+                out[task["name"]] = (task.get("zone"), task.get("region"))
+    return out
+
+
+def check_spread(client: ServiceClient, prefix: str,
+                 axis: str = "zone", min_distinct: int = 2) -> None:
+    """Assert tasks under ``prefix`` span >= min_distinct zones/regions."""
+    idx = 0 if axis == "zone" else 1
+    domains = {v[idx] for v in
+               get_task_fault_domains(client, prefix).values()}
+    domains.discard(None)
+    if len(domains) < min_distinct:
+        raise IntegrationError(
+            f"{prefix!r} tasks span {sorted(domains)} ({axis}); "
+            f"need >= {min_distinct}")
+
+
+# -- recovery state (sdk_recovery.py) ---------------------------------------
+
+def wait_for_recovery(client: ServiceClient,
+                      timeout_s: float = DEFAULT_TIMEOUT_S) -> dict:
+    """Await the recovery plan returning to COMPLETE (reference
+    ``sdk_recovery.check_pod_recovery`` tail)."""
+    return wait_for_plan_status(client, "recovery", "COMPLETE", timeout_s)
+
+
+def kill_task_and_await_recovery(client: ServiceClient, task_name: str,
+                                 pod_instance: str,
+                                 timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
+    """Restart one pod (the HTTP-visible kill) and await id churn +
+    recovery COMPLETE — the reference's task-kill recovery check
+    (``sdk_recovery.check_pod_restart``)."""
+    old = get_task_ids(client, task_name)
+    code, body = client.post(f"pod/{pod_instance}/restart")
+    if code != 200:
+        raise IntegrationError(f"pod restart -> {code}: {body}")
+    check_tasks_updated(client, task_name, old, timeout_s)
+    wait_for_recovery(client, timeout_s)

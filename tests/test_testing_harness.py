@@ -251,3 +251,95 @@ class TestIntegrationUpdate:
                            match="update rejected"):
             integration.update_service_options(client, {}, yaml_text=bad,
                                                timeout_s=20)
+
+
+class TestIntegrationAgentsAndDiag:
+    """sdk_agents / sdk_fault_domain / sdk_networks / sdk_diag analogues."""
+
+    ZONED_YML = """
+name: spread-svc
+pods:
+  web:
+    count: 2
+    placement: '[["zone", "GROUP_BY", "2"]]'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: ./run
+        cpus: 0.5
+        memory: 64
+        ports:
+          http: {port: 0}
+"""
+
+    @pytest.fixture()
+    def live(self):
+        import dataclasses
+        from dcos_commons_tpu.agent import FakeCluster
+        from dcos_commons_tpu.http import ApiServer
+        from dcos_commons_tpu.scheduler import ServiceScheduler
+        from dcos_commons_tpu.specification import load_service_yaml_str
+        from dcos_commons_tpu.state import MemPersister
+        from dcos_commons_tpu.testing.simulation import default_agents
+
+        agents = [dataclasses.replace(a, zone=f"zone-{i % 2}",
+                                      region="r1")
+                  for i, a in enumerate(default_agents(4))]
+        cluster = FakeCluster(agents)
+        sched = ServiceScheduler(load_service_yaml_str(self.ZONED_YML),
+                                 MemPersister(), cluster)
+        server = ApiServer(sched, port=0, cluster=cluster)
+        server.start()
+        driver = CycleDriver(sched, interval_s=0.05).start()
+        yield f"http://127.0.0.1:{server.port}"
+        driver.stop()
+        server.stop()
+
+    def test_agents_inventory_over_http(self, live):
+        ids = integration.wait_for_agents(live, 4, timeout_s=10)
+        assert len(ids) == 4
+        info = integration.get_agent_info(live)
+        assert {a["zone"] for a in info} == {"zone-0", "zone-1"}
+        assert all(a["roles"] == ["*"] for a in info)
+
+    def test_fault_domain_spread(self, live):
+        client = integration.ServiceClient(live)
+        integration.wait_for_deployment(client, timeout_s=20)
+        domains = integration.get_task_fault_domains(client, "web")
+        assert set(domains) == {"web-0-server", "web-1-server"}
+        integration.check_spread(client, "web", axis="zone",
+                                 min_distinct=2)
+        with pytest.raises(integration.IntegrationError):
+            integration.check_spread(client, "web", axis="region",
+                                     min_distinct=2)
+
+    def test_endpoints_helpers(self, live):
+        client = integration.ServiceClient(live)
+        integration.wait_for_deployment(client, timeout_s=20)
+        assert integration.get_endpoints(client) == ["http"]
+        ep = integration.wait_for_endpoint(client, "http", n_addresses=2,
+                                           timeout_s=10)
+        assert len(ep["dns"]) == 2
+
+    def test_kill_and_await_recovery(self, live):
+        client = integration.ServiceClient(live)
+        integration.wait_for_deployment(client, timeout_s=20)
+        integration.kill_task_and_await_recovery(
+            client, "web-0-server", "web-0", timeout_s=20)
+
+    def test_capture_diagnostics(self, live, tmp_path):
+        from dcos_commons_tpu.testing import diag
+        client = integration.ServiceClient(live)
+        integration.wait_for_deployment(client, timeout_s=20)
+        bundle = diag.capture_diagnostics(live, str(tmp_path),
+                                          label="testrun")
+        import json as _json
+        import os as _os
+        files = set(_os.listdir(bundle))
+        assert {"plans.json", "pod_status.json", "root_health.json",
+                "root_agents_info.json", "plan_deploy.json",
+                "debug_reservations.json"} <= files
+        with open(_os.path.join(bundle, "plan_deploy.json")) as f:
+            assert _json.load(f)["status"] == "COMPLETE"
+        with open(_os.path.join(bundle, "root_agents_info.json")) as f:
+            assert len(_json.load(f)) == 4

@@ -5,8 +5,9 @@ strings; the updater then keeps the old target config.
 
 from dcos_commons_tpu.config.updater import (
     DEFAULT_VALIDATORS, network_regime_cannot_change, placement_rules_valid,
-    pre_reservation_cannot_change, service_name_dns_safe,
-    task_env_cannot_change, zone_placement_cannot_change)
+    pre_reservation_cannot_change, region_placement_cannot_change,
+    service_name_dns_safe, task_env_cannot_change, volumes_cannot_change,
+    zone_placement_cannot_change)
 from dcos_commons_tpu.specification import load_service_yaml_str
 
 
@@ -140,3 +141,34 @@ class TestRegistry:
         assert placement_rules_valid in DEFAULT_VALIDATORS
         assert zone_placement_cannot_change in DEFAULT_VALIDATORS
         assert len(DEFAULT_VALIDATORS) >= 10
+
+
+class TestRegionPlacement:
+    def test_region_toggle_blocked(self):
+        old = spec()
+        new = spec(extra="placement: '[[\"region\", \"IS\", \"us-east1\"]]'")
+        assert region_placement_cannot_change(old, new)
+        assert region_placement_cannot_change(new, old)
+
+    def test_stable_region_placement_ok(self):
+        s = spec(extra="placement: '[[\"region\", \"IS\", \"us-east1\"]]'")
+        assert region_placement_cannot_change(s, s) == []
+        assert region_placement_cannot_change(None, s) == []
+
+
+class TestPodLevelVolumes:
+    def test_pod_volume_change_blocked(self):
+        old = spec(extra="volume: {path: data, size: 64}")
+        new = spec(extra="volume: {path: data, size: 128}")
+        assert volumes_cannot_change(old, new)
+        assert volumes_cannot_change(old, old) == []
+
+    def test_region_and_volume_validators_registered(self):
+        assert region_placement_cannot_change in DEFAULT_VALIDATORS
+
+
+class TestRegionRetarget:
+    def test_region_retarget_blocked(self):
+        old = spec(extra="placement: '[[\"region\", \"IS\", \"us-east1\"]]'")
+        new = spec(extra="placement: '[[\"region\", \"IS\", \"us-west1\"]]'")
+        assert region_placement_cannot_change(old, new)
