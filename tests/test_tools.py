@@ -70,3 +70,172 @@ class TestBuild:
         assert rc == 0
         out = capsys.readouterr().out.strip()
         assert out.endswith("hello-world-0.5.0")
+
+
+class TestPackageRepo:
+    """tools.package_repo: index + version queries (reference
+    tools/universe/package_manager.py + package.py)."""
+
+    def _bundle(self, tmp_path, name, version):
+        from tools.package_builder import PackageBuilder
+        import json, os
+        uni = tmp_path / f"uni-{name}-{version}"
+        uni.mkdir()
+        (uni / "package.json").write_text(json.dumps(
+            {"name": name, "version": "{{package-version}}"}))
+        (uni / "config.json").write_text(json.dumps({"type": "object"}))
+        b = PackageBuilder(str(uni), version, "http://a")
+        return b.write(str(tmp_path / "packages"))
+
+    def test_version_ordering(self):
+        from tools.package_repo import Version
+        assert Version("0.10.0") > Version("0.9.1")
+        assert Version("1.0.0-beta") < Version("1.0.0")
+        assert Version("2.0.0") > Version("1.99.99")
+        assert sorted([Version("1.2"), Version("1.10"),
+                       Version("1.2.1")])[-1] == Version("1.10")
+
+    def test_index_and_latest(self, tmp_path):
+        from tools.package_repo import PackageRepo, write_index
+        self._bundle(tmp_path, "svc", "0.9.0")
+        self._bundle(tmp_path, "svc", "0.10.0")
+        self._bundle(tmp_path, "other", "1.0.0")
+        write_index(str(tmp_path / "packages"))
+        repo = PackageRepo(str(tmp_path / "packages"))
+        assert [v.text for v in repo.get_package_versions("svc")] == \
+            ["0.9.0", "0.10.0"]
+        assert repo.latest("svc")["version"] == "0.10.0"
+        assert repo.latest("missing") is None
+
+    def test_cli(self, tmp_path, capsys):
+        from tools.package_repo import main
+        self._bundle(tmp_path, "svc", "0.9.0")
+        assert main(["index", str(tmp_path / "packages")]) == 0
+        assert main(["latest", str(tmp_path / "packages"), "svc"]) == 0
+        assert capsys.readouterr().out.strip().endswith("0.9.0")
+
+
+class TestReleaseBuilder:
+    """tools.release_builder: stub -> immutable release promotion
+    (reference tools/release_builder.py + package_publisher.py)."""
+
+    def _stub(self, tmp_path):
+        from tools.package_builder import PackageBuilder
+        import json
+        uni = tmp_path / "uni"
+        uni.mkdir()
+        (uni / "package.json").write_text(json.dumps(
+            {"name": "svc", "version": "{{package-version}}"}))
+        (uni / "config.json").write_text(json.dumps({"type": "object"}))
+        (uni / "resource.json").write_text(json.dumps({
+            "assets": {"uris": {
+                "bootstrap": "{{artifact-dir}}/bootstrap.bin"}}}))
+        art = tmp_path / "bootstrap.bin"
+        art.write_bytes(b"binary-contents")
+        b = PackageBuilder(str(uni), "0.1.0-dev",
+                           "http://ci.example.com/stub", [str(art)])
+        return b.write(str(tmp_path / "packages")), art
+
+    def test_release_rewrites_urls_and_copies_artifacts(self, tmp_path):
+        import json
+        from tools.release_builder import ReleaseBuilder
+        stub, art = self._stub(tmp_path)
+        rel = ReleaseBuilder(stub, "0.1.0", str(tmp_path / "rel"),
+                             "http://repo.example.com",
+                             {"bootstrap.bin": str(art)}).release()
+        manifest = json.loads(
+            (tmp_path / "rel" / "svc" / "0.1.0" / "manifest.json")
+            .read_text())
+        assert manifest["version"] == "0.1.0"
+        assert manifest["released_from"] == "0.1.0-dev"
+        url = manifest["artifacts"]["bootstrap.bin"]["url"]
+        assert url == ("http://repo.example.com/svc/0.1.0/artifacts/"
+                       "bootstrap.bin")
+        resource = json.loads((tmp_path / "rel" / "svc" / "0.1.0" /
+                               "resource.json").read_text())
+        assert resource["assets"]["uris"]["bootstrap"] == url
+        pkg = json.loads((tmp_path / "rel" / "svc" / "0.1.0" /
+                          "package.json").read_text())
+        assert pkg["version"] == "0.1.0"
+        copied = (tmp_path / "rel" / "svc" / "0.1.0" / "artifacts" /
+                  "bootstrap.bin")
+        assert copied.read_bytes() == b"binary-contents"
+        # repo.json written next to releases
+        from tools.package_repo import PackageRepo
+        assert PackageRepo(str(tmp_path / "rel")).latest(
+            "svc")["version"] == "0.1.0"
+
+    def test_release_is_immutable(self, tmp_path):
+        import pytest
+        from tools.release_builder import ReleaseBuilder, ReleaseError
+        stub, art = self._stub(tmp_path)
+        kwargs = dict(release_version="0.1.0",
+                      release_dir=str(tmp_path / "rel"),
+                      url_base="http://r",
+                      artifact_sources={"bootstrap.bin": str(art)})
+        ReleaseBuilder(stub, kwargs["release_version"],
+                       kwargs["release_dir"], kwargs["url_base"],
+                       kwargs["artifact_sources"]).release()
+        with pytest.raises(ReleaseError, match="immutable"):
+            ReleaseBuilder(stub, kwargs["release_version"],
+                           kwargs["release_dir"], kwargs["url_base"],
+                           kwargs["artifact_sources"]).release()
+
+    def test_mutated_artifact_refused(self, tmp_path):
+        import pytest
+        from tools.release_builder import ReleaseBuilder, ReleaseError
+        stub, art = self._stub(tmp_path)
+        art.write_bytes(b"tampered")
+        with pytest.raises(ReleaseError, match="sha256 mismatch"):
+            ReleaseBuilder(stub, "0.1.0", str(tmp_path / "rel"),
+                           "http://r",
+                           {"bootstrap.bin": str(art)}).release()
+
+
+class TestReleaseHardening:
+    def test_failed_release_leaves_no_junk_and_is_retryable(self, tmp_path):
+        import pytest
+        from tools.release_builder import ReleaseBuilder, ReleaseError
+        stub, art = TestReleaseBuilder()._stub(tmp_path)
+        original = art.read_bytes()
+        art.write_bytes(b"tampered")
+        with pytest.raises(ReleaseError, match="sha256 mismatch"):
+            ReleaseBuilder(stub, "0.1.0", str(tmp_path / "rel"), "http://r",
+                           {"bootstrap.bin": str(art)}).release()
+        # restore and retry the SAME version: must succeed (no junk dir)
+        art.write_bytes(original)
+        dest = ReleaseBuilder(stub, "0.1.0", str(tmp_path / "rel"),
+                              "http://r",
+                              {"bootstrap.bin": str(art)}).release()
+        assert dest.endswith("svc/0.1.0")
+
+    def test_unrebased_stub_url_refused(self, tmp_path):
+        import json, pytest
+        from tools.package_builder import PackageBuilder
+        from tools.release_builder import ReleaseBuilder, ReleaseError
+        uni = tmp_path / "uni2"
+        uni.mkdir()
+        (uni / "package.json").write_text(json.dumps(
+            {"name": "svc", "version": "{{package-version}}"}))
+        (uni / "config.json").write_text(json.dumps({"type": "object"}))
+        # two artifacts referenced, only one passed at stub-build time
+        (uni / "resource.json").write_text(json.dumps({
+            "assets": {"uris": {
+                "a": "{{artifact-dir}}/a.bin",
+                "b": "{{artifact-dir}}/b.bin"}}}))
+        a = tmp_path / "a.bin"
+        a.write_bytes(b"a")
+        stub = PackageBuilder(str(uni), "0.1.0-dev",
+                              "http://ci.example.com/stub",
+                              [str(a)]).write(str(tmp_path / "packages"))
+        with pytest.raises(ReleaseError, match="stub artifact location"):
+            ReleaseBuilder(stub, "0.1.0", str(tmp_path / "rel"), "http://r",
+                           {"a.bin": str(a)}).release()
+
+    def test_version_eq_consistent_with_ordering(self):
+        from tools.package_repo import Version
+        a, b = Version("01.0"), Version("1.0")
+        assert a == b and not (a < b) and not (a > b)
+        assert sorted([Version("1.0.0-beta.10"),
+                       Version("1.0.0-beta.2")])[-1] == \
+            Version("1.0.0-beta.10")

@@ -129,6 +129,7 @@ class PackageBuilder:
         bundle = os.path.join(out_dir, f"{pkg['name']}-{self.version}")
         os.makedirs(bundle, exist_ok=True)
         manifest = {"name": pkg["name"], "version": self.version,
+                    "artifact_dir": self.artifact_dir,
                     "files": [], "artifacts": {}}
         for fname, data in files.items():
             dst = os.path.join(bundle, fname)

@@ -1,0 +1,161 @@
+"""Package repository index + manager.
+
+Reference ``tools/universe/package_manager.py`` + ``package.py``: a package
+repo is a queryable index of released package bundles, and the manager
+answers "what versions of X exist / what's the latest". The reference talks
+to the hosted Universe server; here the repo is a directory of bundles
+produced by ``tools.package_builder`` (and promoted by
+``tools.release_builder``) indexed into one ``repo.json``, served by any
+static file server.
+
+Usage::
+
+    python -m tools.package_repo index build/packages   # writes repo.json
+    python -m tools.package_repo latest build/packages jax
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import re
+import sys
+import urllib.request
+from typing import Dict, List, Optional
+
+_NUM = re.compile(r"(\d+|\D+)")
+
+
+@functools.total_ordering
+class Version:
+    """Numeric-aware version ordering (reference ``package.Version``):
+    ``0.10.0 > 0.9.1``, ``1.0.0-beta < 1.0.0``."""
+
+    def __init__(self, text: str):
+        self.text = str(text)
+
+    @staticmethod
+    def _chunks(text: str) -> tuple:
+        parts: List[tuple] = []
+        for chunk in _NUM.findall(text.replace(".", "\x00")):
+            if chunk.isdigit():
+                parts.append((1, int(chunk)))
+            elif chunk.strip("\x00"):
+                parts.append((0, chunk))
+        return tuple(parts)
+
+    def _key(self):
+        base, dash, pre = self.text.partition("-")
+        # a pre-release sorts BELOW its release (semver rule), and its
+        # segments order numerically too (beta.2 < beta.10)
+        return (self._chunks(base), 0 if dash else 1, self._chunks(pre))
+
+    def __eq__(self, other):
+        # consistent with __lt__ (total_ordering derives the rest): equal
+        # keys ARE equal versions ("01.0" == "1.0")
+        return isinstance(other, Version) and self._key() == other._key()
+
+    def __lt__(self, other):
+        return self._key() < other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return f"Version({self.text!r})"
+
+
+def build_index(packages_dir: str) -> dict:
+    """Scan bundles into an index. Both layouts are discovered: the builder's
+    flat ``<dir>/<name>-<version>/`` and the release tree's
+    ``<dir>/<name>/<version>/`` (any dir holding a manifest.json, up to two
+    levels deep)."""
+    entries = []
+    candidates = []
+    for entry in sorted(os.listdir(packages_dir)):
+        level1 = os.path.join(packages_dir, entry)
+        if not os.path.isdir(level1):
+            continue
+        if os.path.isfile(os.path.join(level1, "manifest.json")):
+            candidates.append(entry)
+            continue
+        for sub in sorted(os.listdir(level1)):
+            if os.path.isfile(os.path.join(level1, sub, "manifest.json")):
+                candidates.append(f"{entry}/{sub}")
+    for rel in candidates:
+        with open(os.path.join(packages_dir, rel, "manifest.json")) as f:
+            manifest = json.load(f)
+        entries.append({
+            "name": manifest["name"],
+            "version": manifest["version"],
+            "path": rel,
+            "artifacts": manifest.get("artifacts", {}),
+        })
+    return {"repo_version": 1, "packages": entries}
+
+
+def write_index(packages_dir: str) -> str:
+    index = build_index(packages_dir)
+    path = os.path.join(packages_dir, "repo.json")
+    with open(path, "w") as f:
+        json.dump(index, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+class PackageRepo:
+    """Query a repo.json by local path or URL (reference PackageManager)."""
+
+    def __init__(self, location: str):
+        self.location = location.rstrip("/")
+        self._index: Optional[dict] = None
+
+    def _load(self) -> dict:
+        if self._index is None:
+            if self.location.startswith(("http://", "https://")):
+                with urllib.request.urlopen(
+                        f"{self.location}/repo.json", timeout=30) as r:
+                    self._index = json.loads(r.read().decode())
+            else:
+                with open(os.path.join(self.location, "repo.json")) as f:
+                    self._index = json.load(f)
+        return self._index
+
+    def packages(self) -> List[dict]:
+        return list(self._load()["packages"])
+
+    def get_package_versions(self, name: str) -> List[Version]:
+        return sorted(Version(p["version"]) for p in self.packages()
+                      if p["name"] == name)
+
+    def latest(self, name: str) -> Optional[dict]:
+        candidates = [p for p in self.packages() if p["name"] == name]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda p: Version(p["version"]))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    s_index = sub.add_parser("index", help="(re)build repo.json")
+    s_index.add_argument("packages_dir")
+    s_latest = sub.add_parser("latest", help="print latest version")
+    s_latest.add_argument("packages_dir")
+    s_latest.add_argument("name")
+    args = p.parse_args(argv)
+    if args.cmd == "index":
+        print(write_index(args.packages_dir))
+        return 0
+    latest = PackageRepo(args.packages_dir).latest(args.name)
+    if latest is None:
+        print(f"error: no package named {args.name!r}", file=sys.stderr)
+        return 1
+    print(latest["version"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,177 @@
+"""Release promotion: stub package bundle -> published release.
+
+Reference ``tools/release_builder.py`` + ``tools/universe/package_publisher
+.py``: a CI-built "stub" package references artifacts wherever the build
+uploaded them; releasing means copying the artifacts to their permanent
+location, rewriting every artifact URL in resource.json, re-verifying
+SHA256s, stamping the release version, and re-indexing the repo. The
+reference publishes to S3/Azure/http; here the publisher target is a
+directory (serve it with any static file server — the C++ agent fetches
+plain http).
+
+Usage::
+
+    python -m tools.release_builder build/packages/jax-0.1.0-dev \
+        --release-version 0.1.0 \
+        --release-dir /srv/releases --url-base http://repo.example.com
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+from typing import Dict, Optional
+
+from .package_builder import _sha256
+from .package_repo import write_index
+
+
+class ReleaseError(Exception):
+    pass
+
+
+class ReleaseBuilder:
+    def __init__(self, bundle_dir: str, release_version: str,
+                 release_dir: str, url_base: str,
+                 artifact_sources: Optional[Dict[str, str]] = None):
+        if not os.path.isfile(os.path.join(bundle_dir, "manifest.json")):
+            raise ReleaseError(f"not a package bundle: {bundle_dir}")
+        self.bundle_dir = bundle_dir
+        self.release_version = release_version
+        self.release_dir = release_dir
+        self.url_base = url_base.rstrip("/")
+        # local artifact files keyed by basename; default: <bundle>/artifacts
+        self.artifact_sources = dict(artifact_sources or {})
+        with open(os.path.join(bundle_dir, "manifest.json")) as f:
+            self.manifest = json.load(f)
+
+    def _resolve_artifact(self, name: str) -> str:
+        local = self.artifact_sources.get(name)
+        if local is None:
+            local = os.path.join(self.bundle_dir, "artifacts", name)
+        if not os.path.isfile(local):
+            raise ReleaseError(
+                f"artifact {name!r} not found (pass --artifact {name}=path)")
+        return local
+
+    def release(self) -> str:
+        """Publish; returns the released bundle directory."""
+        name = self.manifest["name"]
+        dest_root = os.path.join(self.release_dir, name,
+                                 self.release_version)
+        if os.path.isdir(dest_root):
+            raise ReleaseError(
+                f"release {name} {self.release_version} already exists at "
+                f"{dest_root} (releases are immutable)")
+        # stage in a temp sibling and rename into place at the end: a failed
+        # release must not leave a half-built dest_root behind (it would
+        # permanently trip the immutability check above)
+        staging = dest_root + ".releasing"
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        try:
+            self._build_into(staging, name)
+            os.rename(staging, dest_root)
+        except Exception:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+        write_index(self.release_dir)
+        return dest_root
+
+    def _build_into(self, staging: str, name: str) -> None:
+        artifact_dest = os.path.join(staging, "artifacts")
+        os.makedirs(artifact_dest)
+        url_prefix = (f"{self.url_base}/{name}/{self.release_version}"
+                      "/artifacts")
+
+        # 1. copy artifacts + recompute SHAs
+        published: Dict[str, dict] = {}
+        for art_name, meta in sorted(self.manifest.get("artifacts",
+                                                       {}).items()):
+            local = self._resolve_artifact(art_name)
+            sha = _sha256(local)
+            if meta.get("sha256") and meta["sha256"] != sha:
+                raise ReleaseError(
+                    f"artifact {art_name}: sha256 mismatch vs stub manifest "
+                    f"({sha} != {meta['sha256']}) — refusing to release "
+                    "mutated artifacts")
+            shutil.copy2(local, os.path.join(artifact_dest, art_name))
+            published[art_name] = {"sha256": sha,
+                                   "url": f"{url_prefix}/{art_name}"}
+
+        # 2. rewrite package files: version stamp + artifact URL rebase
+        old_urls = {a: m.get("url", "") for a, m in
+                    self.manifest.get("artifacts", {}).items()}
+        # every stub URL base must be fully rebased; any leftover points the
+        # "immutable" release at ephemeral CI storage
+        stub_bases = {u.rsplit("/", 1)[0] for u in old_urls.values() if u}
+        stub_bases.add(self.manifest.get("artifact_dir", ""))
+        stub_bases.discard("")
+        for fname in self.manifest["files"]:
+            src = os.path.join(self.bundle_dir, fname)
+            with open(src) as f:
+                content = f.read()
+            # quoted form only: a bare replace of e.g. version "1" would
+            # mangle every "1" in the document
+            content = content.replace(f'"{self.manifest["version"]}"',
+                                      f'"{self.release_version}"')
+            for art_name, old_url in old_urls.items():
+                if old_url:
+                    content = content.replace(old_url,
+                                              published[art_name]["url"])
+            for base in stub_bases:
+                if base in content:
+                    raise ReleaseError(
+                        f"{fname}: still references stub artifact location "
+                        f"{base} after rebasing — an artifact referenced by "
+                        "the package was not passed to the stub build via "
+                        "--artifact; releasing would point at ephemeral CI "
+                        "storage")
+            with open(os.path.join(staging, fname), "w") as f:
+                f.write(content)
+
+        # 3. released manifest
+        manifest = dict(self.manifest)
+        manifest["version"] = self.release_version
+        manifest["artifacts"] = published
+        manifest["released_from"] = self.manifest["version"]
+        with open(os.path.join(staging, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("bundle_dir", help="stub bundle from tools.package_builder")
+    p.add_argument("--release-version", required=True)
+    p.add_argument("--release-dir", required=True)
+    p.add_argument("--url-base", required=True,
+                   help="base URL the release dir will be served from")
+    p.add_argument("--artifact", action="append", default=[],
+                   metavar="NAME=PATH",
+                   help="local source for a manifest artifact (repeatable)")
+    args = p.parse_args(argv)
+    sources = {}
+    for spec in args.artifact:
+        name, _, path = spec.partition("=")
+        if not path:
+            print(f"error: --artifact expects NAME=PATH, got {spec!r}",
+                  file=sys.stderr)
+            return 2
+        sources[name] = path
+    try:
+        builder = ReleaseBuilder(args.bundle_dir, args.release_version,
+                                 args.release_dir, args.url_base, sources)
+        dest = builder.release()
+    except ReleaseError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(dest)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
