@@ -376,6 +376,10 @@ class ApiServer:
                 "volume_profiles": list(a.volume_profiles),
                 "roles": list(a.roles),
             } for a in self._cluster.agents()]
+        if not hasattr(self._cluster, "register"):
+            # in-process fake cluster: inventory GETs work above, but there
+            # is no remote transport to register/poll against
+            return 404, {"error": "no remote agent transport mounted"}
         try:
             payload = json.loads(body.decode()) if body else {}
         except ValueError:

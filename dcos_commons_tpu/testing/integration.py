@@ -75,15 +75,19 @@ class ServiceClient:
 # -- install / uninstall (sdk_install.py) ----------------------------------
 
 def install(base_url: str, name: str, yaml_text: str,
-            timeout_s: float = DEFAULT_TIMEOUT_S) -> ServiceClient:
+            timeout_s: float = DEFAULT_TIMEOUT_S,
+            wait: bool = True) -> ServiceClient:
     """Add a service to a multi-service scheduler and await deploy COMPLETE
-    (reference ``sdk_install.install:97``)."""
+    (reference ``sdk_install.install:97``). ``wait=False`` returns right
+    after the install request (for tests asserting a deploy does NOT
+    complete)."""
     client = ServiceClient(base_url, service=name)
     req = urllib.request.Request(f"{base_url}/v1/multi/{name}",
                                  method="PUT", data=yaml_text.encode())
     with urllib.request.urlopen(req, timeout=30) as r:
         assert r.status == 200
-    wait_for_deployment(client, timeout_s)
+    if wait:
+        wait_for_deployment(client, timeout_s)
     return client
 
 

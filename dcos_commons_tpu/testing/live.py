@@ -1,0 +1,59 @@
+"""In-process "live cluster" stack for integration suites.
+
+Reference: the per-framework ``tests/`` directories drive a *real* DC/OS
+cluster through HTTP. Here the equivalent stack — ApiServer + background
+CycleDriver + fake in-process agents — runs in-process, so the same
+``testing.integration`` helpers exercise the full HTTP surface with no
+cluster. Context-manager usage::
+
+    with LiveStack(scheduler=sched) as stack:
+        client = stack.client()
+        integration.wait_for_deployment(client)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..agent.fake import FakeCluster
+from ..agent.inventory import AgentInfo
+from ..http import ApiServer
+from ..scheduler.runner import CycleDriver
+from .integration import ServiceClient
+from .simulation import default_agents
+
+
+class LiveStack:
+    def __init__(self, scheduler=None, multi=None,
+                 agents: Optional[Sequence[AgentInfo]] = None,
+                 cluster=None, interval_s: float = 0.05):
+        self.cluster = cluster or FakeCluster(
+            agents if agents is not None else default_agents(3))
+        self.scheduler = scheduler
+        self.multi = multi
+        # always mount the cluster: the GET /v1/agents[/info] routes only
+        # need .agents(); transport POSTs 404 cleanly for fake clusters
+        self.server = ApiServer(scheduler, port=0, multi=multi,
+                                cluster=self.cluster)
+        if multi is not None:
+            multi.set_api_server(self.server)
+        self.driver = CycleDriver(multi if multi is not None else scheduler,
+                                  interval_s=interval_s)
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.server.port}"
+
+    def client(self, service: Optional[str] = None,
+               poll_interval_s: float = 0.05) -> ServiceClient:
+        return ServiceClient(self.url, service=service,
+                             poll_interval_s=poll_interval_s)
+
+    def __enter__(self) -> "LiveStack":
+        self.server.start()
+        self.driver.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.driver.stop()
+        self.server.stop()

@@ -1,0 +1,60 @@
+"""cassandra integration suite (reference
+``frameworks/cassandra/tests/``): deploy, run the backup sidecar plan on
+demand, seed replace triggers the rolling-restart recovery phase."""
+
+import pytest
+
+from dcos_commons_tpu.state import MemPersister
+from dcos_commons_tpu.testing import integration
+from dcos_commons_tpu.testing.live import LiveStack
+from dcos_commons_tpu.testing.simulation import default_agents
+
+from frameworks.cassandra.main import build_scheduler
+
+SMALL = {"NODE_COUNT": "3", "SEED_COUNT": "2", "NODE_CPUS": "0.5",
+         "NODE_MEM": "256", "NODE_DISK": "64"}
+
+
+@pytest.fixture()
+def stack():
+    from frameworks.conftest import make_stack
+    with make_stack(n_agents=4, full_ports=True,
+                    scheduler_factory=build_scheduler, env=SMALL) as s:
+        yield s
+
+
+def test_deploy_and_backup_plan(stack):
+    client = stack.client()
+    integration.wait_for_deployment(client, timeout_s=30)
+    ids = integration.get_task_ids(client, "node")
+    assert set(ids) == {"node-0-server", "node-1-server", "node-2-server"}
+
+    # sidecar plans start INTERRUPTED; an operator start runs them
+    code, plan = client.get("plans/backup")
+    assert plan["status"] != "COMPLETE"
+    code, _ = client.post("plans/backup/continue")
+    assert code == 200
+    integration.wait_for_plan_status(client, "backup", "COMPLETE",
+                                     timeout_s=30)
+    # backup tasks ran once per node and did not disturb the servers
+    integration.check_tasks_not_updated(client, "node", ids)
+
+
+def test_seed_replace_rolls_other_nodes(stack):
+    client = stack.client()
+    integration.wait_for_deployment(client, timeout_s=30)
+    all_ids = integration.get_task_ids(client, "node")
+    # replacing seed node-0 must also restart node-1/node-2 (rolling), the
+    # CassandraRecoveryPlanOverrider behavior
+    integration.pod_replace(client, "node-0", timeout_s=30)
+    integration.check_tasks_updated(client, "node", all_ids, timeout_s=30)
+
+
+def test_non_seed_replace_rolls_nothing_else(stack):
+    client = stack.client()
+    integration.wait_for_deployment(client, timeout_s=30)
+    others = {k: v for k, v in
+              integration.get_task_ids(client, "node").items()
+              if not k.startswith("node-2")}
+    integration.pod_replace(client, "node-2", timeout_s=30)
+    integration.check_tasks_not_updated(client, "node", others)

@@ -1,0 +1,43 @@
+"""Framework integration suites: same virtual-CPU-mesh config as tests/,
+plus the shared live-stack builder every suite's fixture wraps."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tests._jax_cpu  # noqa: E402,F401
+
+
+def make_stack(n_agents=3, full_ports=False, zones=False,
+               scheduler_factory=None, multi=False, env=None):
+    """One place to build the per-suite LiveStack: synthetic agents (with
+    optional well-known-port ranges / zone labels), a FakeCluster, and
+    either a single-service scheduler (via ``scheduler_factory(persister,
+    cluster, env=...)``, the frameworks' ``build_scheduler`` signature) or a
+    multi-service scheduler. Caller enters/exits the returned context."""
+    import dataclasses
+
+    from dcos_commons_tpu.agent.fake import FakeCluster
+    from dcos_commons_tpu.agent.inventory import PortRange
+    from dcos_commons_tpu.state import MemPersister
+    from dcos_commons_tpu.testing.live import LiveStack
+    from dcos_commons_tpu.testing.simulation import default_agents
+
+    agents = default_agents(n_agents)
+    if full_ports:
+        # services pinning well-known ports (9042, 8020, ...) need the full
+        # unprivileged range a real host would advertise
+        agents = [dataclasses.replace(a, ports=(PortRange(1025, 32000),))
+                  for a in agents]
+    if zones:
+        agents = [dataclasses.replace(a, zone=f"zone-{i % 2}")
+                  for i, a in enumerate(agents)]
+    cluster = FakeCluster(agents)
+    persister = MemPersister()
+    if multi:
+        from dcos_commons_tpu.scheduler import MultiServiceScheduler
+        return LiveStack(multi=MultiServiceScheduler(persister, cluster),
+                         cluster=cluster)
+    sched = scheduler_factory(persister, cluster, env=env)
+    return LiveStack(scheduler=sched, cluster=cluster)

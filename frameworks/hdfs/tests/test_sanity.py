@@ -1,0 +1,53 @@
+"""hdfs integration suite (reference ``frameworks/hdfs/tests/``): multi-pod
+deploy ordering and the two-step (bootstrap -> node) replace recovery for
+journal/name nodes."""
+
+import pytest
+
+from dcos_commons_tpu.state import MemPersister
+from dcos_commons_tpu.testing import integration
+from dcos_commons_tpu.testing.live import LiveStack
+from dcos_commons_tpu.testing.simulation import default_agents
+
+from frameworks.hdfs.main import build_scheduler, DEFAULT_ENV
+
+SMALL = {"JOURNAL_CPUS": "0.2", "JOURNAL_MEM": "64",
+         "NAME_CPUS": "0.2", "NAME_MEM": "64",
+         "DATA_CPUS": "0.2", "DATA_MEM": "64", "DATA_COUNT": "3"}
+
+
+@pytest.fixture()
+def stack():
+    from frameworks.conftest import make_stack
+    with make_stack(n_agents=6, full_ports=True,
+                    scheduler_factory=build_scheduler, env=SMALL) as s:
+        yield s
+
+
+def test_deploy_order_and_task_set(stack):
+    client = stack.client()
+    integration.wait_for_deployment(client, timeout_s=60)
+    plan = integration.get_plan(client, "deploy")
+    phase_names = [ph["name"] for ph in plan["phases"]]
+    # journal quorum before name nodes before data nodes (reference
+    # svc.yml plan ordering)
+    assert (phase_names.index("journal") < phase_names.index("name")
+            < phase_names.index("data")), phase_names
+
+
+def test_name_node_replace_is_two_step(stack):
+    client = stack.client()
+    integration.wait_for_deployment(client, timeout_s=60)
+    # the custom recovery phase relaunches bootstrap+node but NOT the
+    # one-time format task, so track the node task only (the generic
+    # pod_replace helper expects every task of the pod to churn)
+    old = integration.get_task_ids(client, "name-0-node")
+    code, body = client.post("pod/name-0/replace")
+    assert code == 200, body
+    integration.check_tasks_updated(client, "name-0-node", old,
+                                    timeout_s=60)
+    integration.wait_for_recovery(client, timeout_s=60)
+    # the recovery plan ran the custom two-step phase
+    code, plan = client.get("plans/recovery")
+    steps = [s["name"] for ph in plan["phases"] for s in ph["steps"]]
+    assert any("bootstrap" in s for s in steps), steps

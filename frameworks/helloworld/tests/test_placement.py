@@ -1,0 +1,56 @@
+"""helloworld placement suite (reference
+``frameworks/helloworld/tests/test_placement.py``): marathon-style
+constraints evaluated against live agent inventories."""
+
+import dataclasses
+
+import pytest
+
+from dcos_commons_tpu.scheduler import MultiServiceScheduler
+from dcos_commons_tpu.state import MemPersister
+from dcos_commons_tpu.testing import integration
+from dcos_commons_tpu.testing.live import LiveStack
+from dcos_commons_tpu.testing.simulation import default_agents
+
+from frameworks.helloworld.tests.test_sanity import SERVICE_NAME, svc_yaml
+
+
+@pytest.fixture()
+def stack():
+    from frameworks.conftest import make_stack
+    with make_stack(n_agents=4, zones=True, multi=True) as s:
+        yield s
+
+
+def test_unique_hostname_spread(stack):
+    client = integration.install(
+        stack.url, SERVICE_NAME,
+        svc_yaml(env={"HELLO_COUNT": "3", "WORLD_COUNT": "1",
+                      "HELLO_PLACEMENT": '[["hostname", "UNIQUE"]]'}),
+        timeout_s=30)
+    code, body = client.get("pod/status")
+    hosts = [t["hostname"] for pod in body["pods"]
+             for t in pod["tasks"] if t["name"].startswith("hello")]
+    assert len(hosts) == 3 and len(set(hosts)) == 3, hosts
+    integration.uninstall(stack.url, SERVICE_NAME, timeout_s=30)
+
+
+def test_zone_group_by(stack):
+    client = integration.install(
+        stack.url, SERVICE_NAME,
+        svc_yaml(env={"HELLO_COUNT": "2", "WORLD_COUNT": "1",
+                      "HELLO_PLACEMENT": '[["zone", "GROUP_BY", "2"]]'}),
+        timeout_s=30)
+    integration.check_spread(client, "hello", axis="zone", min_distinct=2)
+    integration.uninstall(stack.url, SERVICE_NAME, timeout_s=30)
+
+
+def test_infeasible_constraint_blocks_deploy(stack):
+    yaml_text = svc_yaml(env={"HELLO_COUNT": "5", "WORLD_COUNT": "1",
+                              "HELLO_PLACEMENT": '[["hostname", "UNIQUE"]]'})
+    client = integration.install(stack.url, SERVICE_NAME, yaml_text,
+                                 wait=False)
+    # 5 unique hosts on a 4-agent cluster: deploy must stall, not complete
+    with pytest.raises(integration.IntegrationError):
+        integration.wait_for_deployment(client, timeout_s=3)
+    integration.uninstall(stack.url, SERVICE_NAME, timeout_s=30)

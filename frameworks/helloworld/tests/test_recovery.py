@@ -1,0 +1,61 @@
+"""helloworld recovery suite (reference
+``frameworks/helloworld/tests/test_zzzrecovery.py``): task failure ->
+transient relaunch; agent loss -> tasks recovered; replace moves off the
+host."""
+
+import pytest
+
+from dcos_commons_tpu.scheduler import MultiServiceScheduler
+from dcos_commons_tpu.state import MemPersister, TaskState
+from dcos_commons_tpu.testing import integration
+from dcos_commons_tpu.testing.live import LiveStack
+from dcos_commons_tpu.testing.simulation import default_agents
+
+from frameworks.helloworld.tests.test_sanity import SERVICE_NAME, svc_yaml
+
+
+@pytest.fixture()
+def stack():
+    from frameworks.conftest import make_stack
+    with make_stack(n_agents=4, multi=True) as s:
+        yield s
+
+
+def test_task_failure_relaunches_in_place(stack):
+    client = integration.install(stack.url, SERVICE_NAME,
+                                 svc_yaml(env={"HELLO_COUNT": "1",
+                                               "WORLD_COUNT": "1"}),
+                                 timeout_s=30)
+    old = integration.get_task_ids(client, "hello-0")
+    code, body = client.get("pod/status")
+    before = {t["name"]: t["hostname"] for pod in body["pods"]
+              for t in pod["tasks"]}
+    # synthetic TASK_FAILED straight into the fake agent (the integration
+    # suite's `dcos task exec kill` analogue)
+    task = stack.cluster.task("hello-0-server")
+    stack.cluster.send_status(task.task_id, TaskState.FAILED, "killed")
+    integration.check_tasks_updated(client, "hello-0", old, timeout_s=30)
+    integration.wait_for_recovery(client, timeout_s=30)
+    code, body = client.get("pod/status")
+    after = {t["name"]: t["hostname"] for pod in body["pods"]
+             for t in pod["tasks"]}
+    # transient recovery relaunches on the SAME host (volumes pin)
+    assert after["hello-0-server"] == before["hello-0-server"]
+    integration.uninstall(stack.url, SERVICE_NAME, timeout_s=30)
+
+
+def test_replace_moves_off_host(stack):
+    client = integration.install(stack.url, SERVICE_NAME,
+                                 svc_yaml(env={"HELLO_COUNT": "1",
+                                               "WORLD_COUNT": "1"}),
+                                 timeout_s=30)
+    code, body = client.get("pod/status")
+    before = {t["name"]: t["hostname"] for pod in body["pods"]
+              for t in pod["tasks"]}
+    integration.pod_replace(client, "hello-0", timeout_s=30)
+    code, body = client.get("pod/status")
+    after = {t["name"]: t["hostname"] for pod in body["pods"]
+             for t in pod["tasks"]}
+    # permanent replace prefers a different host when one is available
+    assert after["hello-0-server"] != before["hello-0-server"]
+    integration.uninstall(stack.url, SERVICE_NAME, timeout_s=30)

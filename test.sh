@@ -11,6 +11,9 @@ make -C native
 echo "== test suite =="
 python -m pytest tests/ -q "$@"
 
+echo "== framework integration suites =="
+python -m pytest frameworks/ -q "$@"
+
 echo "== package bundles =="
 for universe in frameworks/*/universe; do
     python -m tools.package_builder "$universe" --version 0.0.0-ci \
