@@ -1,5 +1,6 @@
-from .persister import (CachingPersister, FilePersister, MemPersister,
-                        NotFoundError, Persister, PersisterError)
+from .persister import (CachingPersister, FilePersister, InstanceLock,
+                        LockError, MemPersister, NotFoundError, Persister,
+                        PersisterError)
 from .reservation_store import ReservationStore
 from .state_store import (ConfigStore, FrameworkStore, GoalOverride,
                           OverrideProgress, SchemaVersionStore, StateStore,

@@ -304,3 +304,55 @@ class CachingPersister(Persister):
 
     def close(self) -> None:
         self._backend.close()
+
+
+class LockError(PersisterError):
+    """Another scheduler instance holds the state root."""
+
+
+class InstanceLock:
+    """Single-instance mutex over a state root (reference
+    ``curator/CuratorLocker.java``: a ZK mutex so only one scheduler
+    process acts on a service's state at a time; a second instance must
+    fail fast rather than corrupt plans/reservations).
+
+    flock-based: released automatically by the OS if the process dies, so a
+    crashed scheduler never wedges its successor. Hold for process lifetime;
+    ``release()`` exists mainly for tests.
+    """
+
+    FILE = ".lock"
+
+    def __init__(self, root: str, timeout_s: float = 10.0,
+                 poll_interval_s: float = 0.5):
+        import fcntl
+        import time as _time
+        self._path = os.path.join(os.path.abspath(root), self.FILE)
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        self._fd = os.open(self._path, os.O_RDWR | os.O_CREAT, 0o644)
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            # only EWOULDBLOCK means contention; ENOLCK/ENOTSUP (e.g. an
+            # NFS state root without lock support) must surface as what
+            # they are, not as a phantom second instance
+            except BlockingIOError:
+                if _time.monotonic() >= deadline:
+                    os.close(self._fd)
+                    self._fd = -1
+                    raise LockError(
+                        f"another scheduler instance holds {self._path}; "
+                        "refusing to start (reference CuratorLocker "
+                        "semantics)") from None
+                _time.sleep(poll_interval_s)
+        os.truncate(self._fd, 0)
+        os.write(self._fd, f"{os.getpid()}\n".encode())
+
+    def release(self) -> None:
+        import fcntl
+        if self._fd >= 0:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = -1

@@ -18,7 +18,7 @@ from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
 from dcos_commons_tpu.scheduler import ServiceScheduler
 from dcos_commons_tpu.scheduler.runner import CycleDriver
 from dcos_commons_tpu.specification import ServiceSpec, load_service_yaml
-from dcos_commons_tpu.state import FilePersister
+from dcos_commons_tpu.state import FilePersister, InstanceLock
 
 from .recovery import seed_recovery_overrider
 
@@ -74,6 +74,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     metrics = MetricsRegistry()
+    lock = InstanceLock(args.state)  # single-instance gate
     persister = FilePersister(args.state)
     cluster = RemoteCluster()
     scheduler = build_scheduler(persister, cluster, metrics=metrics)

@@ -4,10 +4,7 @@ demand, seed replace triggers the rolling-restart recovery phase."""
 
 import pytest
 
-from dcos_commons_tpu.state import MemPersister
 from dcos_commons_tpu.testing import integration
-from dcos_commons_tpu.testing.live import LiveStack
-from dcos_commons_tpu.testing.simulation import default_agents
 
 from frameworks.cassandra.main import build_scheduler
 

@@ -4,12 +4,9 @@ journal/name nodes."""
 
 import pytest
 
-from dcos_commons_tpu.state import MemPersister
 from dcos_commons_tpu.testing import integration
-from dcos_commons_tpu.testing.live import LiveStack
-from dcos_commons_tpu.testing.simulation import default_agents
 
-from frameworks.hdfs.main import build_scheduler, DEFAULT_ENV
+from frameworks.hdfs.main import build_scheduler
 
 SMALL = {"JOURNAL_CPUS": "0.2", "JOURNAL_MEM": "64",
          "NAME_CPUS": "0.2", "NAME_MEM": "64",
@@ -36,18 +33,26 @@ def test_deploy_order_and_task_set(stack):
 
 
 def test_name_node_replace_is_two_step(stack):
+    from dcos_commons_tpu.agent.fake import TaskBehavior
     client = stack.client()
     integration.wait_for_deployment(client, timeout_s=60)
-    # the custom recovery phase relaunches bootstrap+node but NOT the
-    # one-time format task, so track the node task only (the generic
-    # pod_replace helper expects every task of the pod to churn)
+    # stall the relaunched node task so the in-flight recovery plan stays
+    # observable (completed recovery phases are pruned every cycle)
+    stack.cluster.script("name-0-node", TaskBehavior.MANUAL)
     old = integration.get_task_ids(client, "name-0-node")
     code, body = client.post("pod/name-0/replace")
     assert code == 200, body
+    # the custom recovery phase relaunches bootstrap+node but NOT the
+    # one-time format task, so track the node task only (the generic
+    # pod_replace helper expects every task of the pod to churn)
     integration.check_tasks_updated(client, "name-0-node", old,
                                     timeout_s=60)
-    integration.wait_for_recovery(client, timeout_s=60)
-    # the recovery plan ran the custom two-step phase
     code, plan = client.get("plans/recovery")
     steps = [s["name"] for ph in plan["phases"] for s in ph["steps"]]
     assert any("bootstrap" in s for s in steps), steps
+    # release the stalled task; recovery must then drain to COMPLETE
+    task = stack.cluster.task("name-0-node")
+    from dcos_commons_tpu.state import TaskState
+    stack.cluster.send_status(task.task_id, TaskState.RUNNING,
+                              readiness_passed=True)
+    integration.wait_for_recovery(client, timeout_s=60)

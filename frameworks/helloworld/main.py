@@ -29,7 +29,7 @@ from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
 from dcos_commons_tpu.scheduler import (MultiServiceScheduler,
                                         ServiceScheduler)
 from dcos_commons_tpu.scheduler.runner import CycleDriver
-from dcos_commons_tpu.state import FilePersister
+from dcos_commons_tpu.state import FilePersister, InstanceLock
 
 from . import scenarios
 
@@ -62,6 +62,7 @@ def main(argv=None) -> int:
     if statsd_host:  # reference Metrics.configureStatsd:74-79
         metrics.configure_statsd(statsd_host,
                                  int(os.environ.get("STATSD_UDP_PORT", "8125")))
+    lock = InstanceLock(args.state)  # single-instance gate
     persister = FilePersister(args.state)
     cluster = RemoteCluster()
 

@@ -5,11 +5,8 @@ host."""
 
 import pytest
 
-from dcos_commons_tpu.scheduler import MultiServiceScheduler
-from dcos_commons_tpu.state import MemPersister, TaskState
+from dcos_commons_tpu.state import TaskState
 from dcos_commons_tpu.testing import integration
-from dcos_commons_tpu.testing.live import LiveStack
-from dcos_commons_tpu.testing.simulation import default_agents
 
 from frameworks.helloworld.tests.test_sanity import SERVICE_NAME, svc_yaml
 

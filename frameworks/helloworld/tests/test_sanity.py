@@ -5,11 +5,7 @@ HTTP API via the integration lib against an in-process live stack."""
 
 import pytest
 
-from dcos_commons_tpu.scheduler import MultiServiceScheduler
-from dcos_commons_tpu.state import MemPersister
 from dcos_commons_tpu.testing import integration
-from dcos_commons_tpu.testing.live import LiveStack
-from dcos_commons_tpu.testing.simulation import default_agents
 
 from frameworks.helloworld import scenarios
 

@@ -24,7 +24,7 @@ from dcos_commons_tpu.http import ApiServer
 from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
 from dcos_commons_tpu.scheduler import ServiceScheduler
 from dcos_commons_tpu.scheduler.runner import CycleDriver
-from dcos_commons_tpu.state import FilePersister
+from dcos_commons_tpu.state import FilePersister, InstanceLock
 
 from . import scenarios
 
@@ -55,6 +55,7 @@ def main(argv=None) -> int:
     if statsd_host:
         metrics.configure_statsd(statsd_host,
                                  int(os.environ.get("STATSD_UDP_PORT", "8125")))
+    lock = InstanceLock(args.state)  # single-instance gate
     persister = FilePersister(args.state)
     cluster = RemoteCluster()
     spec = scenarios.load_scenario(args.scenario)

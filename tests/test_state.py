@@ -211,3 +211,29 @@ def test_schema_version_gate():
     p.set(SchemaVersionStore.PATH, b"99")
     with pytest.raises(StateStoreError, match="schema version 99"):
         SchemaVersionStore(p).check()
+
+
+class TestInstanceLock:
+    """Reference ``curator/CuratorLocker.java``: one scheduler per state
+    root; a second instance fails fast instead of corrupting state."""
+
+    def test_second_instance_blocked_then_freed(self, tmp_path):
+        import pytest
+        from dcos_commons_tpu.state import InstanceLock, LockError
+        first = InstanceLock(str(tmp_path))
+        with pytest.raises(LockError):
+            InstanceLock(str(tmp_path), timeout_s=0.2, poll_interval_s=0.05)
+        first.release()
+        second = InstanceLock(str(tmp_path), timeout_s=0.2)
+        second.release()
+
+    def test_lock_survives_alongside_persister(self, tmp_path):
+        from dcos_commons_tpu.state import FilePersister, InstanceLock
+        lock = InstanceLock(str(tmp_path))
+        p = FilePersister(str(tmp_path))
+        p.set("a/b", b"v")
+        assert p.get("a/b") == b"v"
+        # the lock file is not a state node
+        assert "a" in p.get_children("")
+        assert ".lock" not in p.get_children("")
+        lock.release()
