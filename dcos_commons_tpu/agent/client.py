@@ -25,6 +25,13 @@ StatusCallback = Callable[[str, TaskStatus], None]  # (task_name, status)
 
 
 class AgentClient(Protocol):
+    # default grace before tasks on an unregistered agent are declared LOST
+    # (reference: Mesos agent-reregistration-timeout). In-process fakes keep
+    # 0 (agents exist from construction); remote transports override — a
+    # restarted scheduler must give live agents time to re-register before
+    # relaunching everything they run.
+    default_agent_grace_s: float = 0.0
+
     def agents(self) -> Sequence[AgentInfo]:
         """Current inventory of registered, healthy agents."""
 

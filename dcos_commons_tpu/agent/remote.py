@@ -35,6 +35,11 @@ def _now() -> float:
 class RemoteCluster:
     """AgentClient implementation backed by polling remote agents."""
 
+    # a freshly-(re)started scheduler sees zero agents until they poll and
+    # re-register; without this grace every task would be declared LOST and
+    # relaunched on scheduler restart (ServiceScheduler.reconcile)
+    default_agent_grace_s = 30.0
+
     def __init__(self, expiry_s: float = 30.0, poll_interval_s: float = 1.0):
         self._lock = threading.Lock()
         self._expiry_s = expiry_s

@@ -1,7 +1,8 @@
 from .core import ServiceScheduler
 from .multi import (AllDiscipline, DisciplineSelectionStore,
                     MultiServiceScheduler, OfferDiscipline,
-                    ParallelFootprintDiscipline, ServiceStore)
+                    ParallelFootprintDiscipline, ServiceStore,
+                    migrate_mono_to_multi)
 from .recovery import (FailureMonitor, NeverFailureMonitor,
                        RecoveryPlanManager, TestingFailureMonitor,
                        TimedFailureMonitor, needs_recovery)

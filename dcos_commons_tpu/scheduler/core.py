@@ -61,7 +61,7 @@ class ServiceScheduler:
                  validators=DEFAULT_VALIDATORS,
                  recovery_overriders: Sequence[RecoveryOverrider] = (),
                  uninstall: bool = False,
-                 agent_grace_s: float = 0.0,
+                 agent_grace_s: Optional[float] = None,
                  metrics=None,
                  tld: Optional[str] = None):
         SchemaVersionStore(persister).check()
@@ -72,7 +72,10 @@ class ServiceScheduler:
         self._lock = threading.RLock()
         # grace before tasks on an unreported agent are declared LOST;
         # >0 for remote clusters where agents re-register asynchronously
-        # (Mesos agent-reregistration-timeout analogue)
+        # (Mesos agent-reregistration-timeout analogue). None = take the
+        # transport's default (RemoteCluster: 30s; fakes: 0)
+        if agent_grace_s is None:
+            agent_grace_s = getattr(cluster, "default_agent_grace_s", 0.0)
         self.agent_grace_s = agent_grace_s
         self._agent_missing_since: Dict[str, float] = {}
         # grace before a *live* agent's non-report of a freshly-launched

@@ -166,6 +166,10 @@ class ServiceClusterView(AgentClient):
         self._name = service_name
         self.callback: Optional[StatusCallback] = None
 
+    @property
+    def default_agent_grace_s(self) -> float:
+        return getattr(self._multi.cluster, "default_agent_grace_s", 0.0)
+
     def agents(self) -> Sequence[AgentInfo]:
         return self._multi.cluster.agents()
 
@@ -411,3 +415,68 @@ class MultiServiceScheduler:
             for task_id in list(self._ownership):
                 if task_id not in running and task_id not in stored:
                     del self._ownership[task_id]
+
+
+def migrate_mono_to_multi(persister: Persister, name: str) -> List[str]:
+    """Migrate a mono-service state root into multi-service layout.
+
+    Reference: the mono->multi migration path (``scheduler/multi`` +
+    ``SchemaVersionStore`` dual-schema support): an operator who outgrew one
+    service per scheduler process re-homes the existing service's state
+    under ``Services/<name>/`` and registers it in the :class:`ServiceStore`
+    so the next :class:`MultiServiceScheduler` start adopts it — running
+    tasks keep their ids and reservations, so adoption causes no relaunch.
+
+    Run OFFLINE (no scheduler holding the state root — take the
+    ``InstanceLock`` first if unsure). The move is one atomic ``set_many``.
+    Returns the migrated persister paths.
+    """
+    from ..state.state_store import ConfigStore
+
+    # the multi layer mounts children under the "svc-<name>" namespace
+    # (_mount above) — state must land where the adopted StateStore reads
+    ns = f"Services/svc-{_esc(name)}"
+    try:
+        existing = persister.get_children("Services")
+    except NotFoundError:
+        existing = []
+    if f"svc-{_esc(name)}" in existing:
+        raise ValueError(f"service {name!r} already exists in multi layout")
+
+    target_raw = persister.get_or_none("ConfigTarget")
+    if target_raw is None:
+        raise ValueError(
+            "no mono-service state at this root (missing ConfigTarget)")
+    spec = ConfigStore(persister).fetch(target_raw.decode())
+    if spec.name != name:
+        raise ValueError(
+            f"mono service is named {spec.name!r}, not {name!r}")
+
+    # every mono subtree that becomes service-scoped in multi layout
+    # (FrameworkID / SchemaVersion / security/tls stay process-level);
+    # sourced from the stores' own path constants so a renamed or newly
+    # namespaced store cannot be silently skipped
+    from ..security import secrets as _secrets
+    from ..state.reservation_store import ReservationStore
+    from ..state.state_store import StateStore
+    subtrees = (StateStore.TASKS, StateStore.PROPERTIES,
+                ConfigStore.CONFIGS, ConfigStore.TARGET,
+                ReservationStore.ROOT, _secrets.ROOT)
+    batch: Dict[str, Optional[bytes]] = {}
+    moved: List[str] = []
+    for subtree in subtrees:
+        try:
+            paths = [subtree] + persister.recursive_paths(subtree)
+        except NotFoundError:
+            continue  # subtree never written by this service
+        for path in paths:
+            value = persister.get_or_none(path)
+            if value is None:
+                continue  # interior node with no value of its own
+            batch[f"{ns}/{path}"] = value
+            moved.append(path)
+        batch[subtree] = None  # delete the old location
+    # register for adoption in the same transaction
+    batch[f"{ServiceStore.ROOT}/{_esc(name)}"] = spec.to_json().encode()
+    persister.set_many(batch)
+    return moved

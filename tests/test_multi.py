@@ -465,3 +465,68 @@ class TestReviewRegressions:
             sched = multi.get_service(name)
             assert sched.plan("deploy").status is Status.COMPLETE
             assert len(sched.state.fetch_tasks()) == 2
+
+
+class TestMonoToMultiMigration:
+    """Reference mono->multi migration: a root-namespace service is
+    re-homed under Services/<name>/ and adopted without relaunches."""
+
+    def _deploy_mono(self, persister, cluster):
+        from dcos_commons_tpu.scheduler import ServiceScheduler
+        yml = """
+name: legacy
+pods:
+  web:
+    count: 2
+    tasks:
+      server: {goal: RUNNING, cmd: ./run, cpus: 0.5, memory: 64}
+"""
+        sched = ServiceScheduler(load_service_yaml_str(yml), persister,
+                                 cluster)
+        for _ in range(10):
+            sched.run_cycle()
+        assert sched.plan("deploy").status is Status.COMPLETE
+        return {t.task_name: t.task_id for t in sched.state.fetch_tasks()}
+
+    def test_migrate_and_adopt(self):
+        from dcos_commons_tpu.scheduler import (MultiServiceScheduler,
+                                                migrate_mono_to_multi)
+        from dcos_commons_tpu.state import MemPersister
+        from dcos_commons_tpu.testing.simulation import default_agents
+        persister = MemPersister()
+        cluster = FakeCluster(default_agents(3))
+        ids = self._deploy_mono(persister, cluster)
+
+        moved = migrate_mono_to_multi(persister, "legacy")
+        assert any(p.startswith("Tasks") for p in moved)
+        assert persister.get_or_none("ConfigTarget") is None
+
+        multi = MultiServiceScheduler(persister, cluster)
+        assert multi.service_names() == ["legacy"]
+        sched = multi.get_service("legacy")
+        launched_before = len(cluster.launch_log)
+        for _ in range(5):
+            multi.run_cycle()
+        # adoption is relaunch-free: same ids, no new launches
+        now = {t.task_name: t.task_id for t in sched.state.fetch_tasks()}
+        assert now == ids
+        assert len(cluster.launch_log) == launched_before
+        assert sched.plan("deploy").status is Status.COMPLETE
+
+    def test_migrate_wrong_name_rejected(self):
+        import pytest
+        from dcos_commons_tpu.scheduler import migrate_mono_to_multi
+        from dcos_commons_tpu.state import MemPersister
+        from dcos_commons_tpu.testing.simulation import default_agents
+        persister = MemPersister()
+        cluster = FakeCluster(default_agents(3))
+        self._deploy_mono(persister, cluster)
+        with pytest.raises(ValueError, match="named 'legacy'"):
+            migrate_mono_to_multi(persister, "other")
+
+    def test_migrate_empty_root_rejected(self):
+        import pytest
+        from dcos_commons_tpu.scheduler import migrate_mono_to_multi
+        from dcos_commons_tpu.state import MemPersister
+        with pytest.raises(ValueError, match="no mono-service state"):
+            migrate_mono_to_multi(MemPersister(), "legacy")

@@ -1,0 +1,50 @@
+"""Offline mono -> multi state migration (operator tool).
+
+Reference: the mono-to-multi migration path of ``scheduler/multi`` — a
+service that outgrew one-scheduler-per-service moves its existing state
+under the multi-service layout so a multi-service scheduler adopts it with
+zero task relaunches.
+
+Run with BOTH schedulers stopped::
+
+    python -m tools.migrate_service --state ./state --name hello-world
+
+Then start the multi-service scheduler against the same state root.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--state", required=True, help="scheduler state root")
+    p.add_argument("--name", required=True, help="service name to migrate")
+    args = p.parse_args(argv)
+
+    from dcos_commons_tpu.scheduler import migrate_mono_to_multi
+    from dcos_commons_tpu.state import FilePersister, InstanceLock, LockError
+
+    try:
+        lock = InstanceLock(args.state, timeout_s=2.0)
+    except LockError:
+        print("error: a scheduler is still running against this state root; "
+              "stop it first", file=sys.stderr)
+        return 1
+    from dcos_commons_tpu.state import PersisterError, StateStoreError
+    try:
+        moved = migrate_mono_to_multi(FilePersister(args.state), args.name)
+    except (ValueError, PersisterError, StateStoreError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        lock.release()
+    print(f"migrated {len(moved)} state paths; start the multi-service "
+          f"scheduler against {args.state} to adopt {args.name!r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
