@@ -38,6 +38,7 @@ from .outcome import EvaluationOutcome, OutcomeNode
 log = logging.getLogger(__name__)
 
 JAX_COORDINATOR_PORT = 8476
+MEGASCALE_COORDINATOR_PORT = 8479
 # synthetic resource-set id for pod-level shared volumes; underscore-prefixed
 # so it can't collide with YAML resource-set ids used by tasks
 POD_VOLUME_SET_ID = "_pod"
@@ -212,32 +213,54 @@ class Evaluator:
     def _gang_slice(self, requirement: PodInstanceRequirement,
                     agents: Sequence[AgentInfo], tasks: Sequence[TaskRecord],
                     ledger: ReservationLedger) -> Tuple[Optional[str], Optional[str]]:
-        """Returns (slice_id or None, error or None).
+        """Returns (slice_id this instance must land on, error).
 
-        If the pod demands gang TPU placement: later instances are pinned to
-        the slice the first instance chose; the first instance picks a slice
-        that can hold the WHOLE pod group (all-or-nothing feasibility).
+        Gang TPU placement, generalized to multislice: the pod's instances
+        are split into ``tpu.slices`` contiguous groups; each group lands on
+        one DISTINCT slice; later instances are pinned to the slice their
+        group already chose; the whole assignment is all-or-nothing — if any
+        unassigned group cannot get a capable distinct slice, nothing
+        places.
         """
         pod = requirement.pod_instance.pod
         if pod.tpu is None or not pod.tpu.gang or pod.tpu.chips <= 0:
             return None, None
-        # slice already chosen by a sibling instance?
         pod_type = pod.type
+        n_slices = max(1, pod.tpu.slices)
+        group_size = pod.tpu.group_size(pod.count)
+        my_group = pod.tpu.slice_index(requirement.pod_instance.index,
+                                       pod.count)
         agents_by_id = {a.agent_id: a for a in agents}
+
+        def group_of(instance_name: str) -> Optional[int]:
+            head, _, idx = instance_name.rpartition("-")
+            if head != pod_type or not idx.isdigit():
+                return None
+            return pod.tpu.slice_index(int(idx), pod.count)
+
+        # slices already chosen by sibling instances, per group
+        chosen: Dict[int, str] = {}
         for record in tasks:
-            if record.pod_type == pod_type and record.pod_instance_name != \
-                    requirement.pod_instance.name:
-                sibling_agent = agents_by_id.get(record.agent_id)
-                if sibling_agent is not None and sibling_agent.tpu.slice_id:
-                    return sibling_agent.tpu.slice_id, None
+            if record.pod_type != pod_type or \
+                    record.pod_instance_name == requirement.pod_instance.name:
+                continue
+            sibling_agent = agents_by_id.get(record.agent_id)
+            group = group_of(record.pod_instance_name)
+            if group is not None and sibling_agent is not None \
+                    and sibling_agent.tpu.slice_id:
+                chosen[group] = sibling_agent.tpu.slice_id
         for res in ledger.all():
-            if res.tpus > 0 and res.pod_instance_name.rsplit("-", 1)[0] == pod_type \
+            group = group_of(res.pod_instance_name)
+            if res.tpus > 0 and group is not None \
                     and res.pod_instance_name != requirement.pod_instance.name:
                 res_agent = agents_by_id.get(res.agent_id)
                 if res_agent is not None and res_agent.tpu.slice_id:
-                    return res_agent.tpu.slice_id, None
-        # first instance: find a slice that can hold the whole group
-        needed_hosts = pod.count
+                    chosen.setdefault(group, res_agent.tpu.slice_id)
+        if my_group in chosen:
+            return chosen[my_group], None
+
+        # all-or-nothing: every still-unassigned group must get a capable,
+        # distinct slice
         per_host_chips = pod.tpu.chips
         slices: Dict[str, List[AgentInfo]] = {}
         for a in agents:
@@ -247,19 +270,27 @@ class Evaluator:
                 continue
             slices.setdefault(a.tpu.slice_id, []).append(a)
         exclude = requirement.pod_instance.name
+        capable: List[str] = []
         for slice_id, members in sorted(slices.items()):
-            capable = 0
-            for a in members:
-                avail = ledger.available(a, exclude_pod=exclude)
-                if avail.tpus >= per_host_chips:
-                    capable += 1
-            if capable >= needed_hosts:
-                return slice_id, None
+            if slice_id in chosen.values():
+                continue  # taken by another group
+            n_hosts = sum(
+                1 for a in members
+                if ledger.available(a, exclude_pod=exclude).tpus
+                >= per_host_chips)
+            if n_hosts >= group_size:
+                capable.append(slice_id)
+        unassigned = [g for g in range(n_slices) if g not in chosen]
+        if len(capable) >= len(unassigned):
+            # deterministic: unassigned groups take capable slices in order
+            assignment = dict(zip(unassigned, capable))
+            return assignment[my_group], None
         topo = f" with topology {pod.tpu.topology}" if pod.tpu.topology else ""
         return None, (
-            f"no TPU slice{topo} can hold all {needed_hosts} instances of pod "
-            f"{pod.type} ({per_host_chips} chips/host); gang placement is "
-            f"all-or-nothing")
+            f"need {len(unassigned)} more distinct TPU slice(s){topo} with "
+            f">= {group_size} hosts x {per_host_chips} free chips for pod "
+            f"{pod.type} ({n_slices}-slice gang, {pod.count} instances); "
+            f"have {len(capable)}; gang placement is all-or-nothing")
 
     # -- per-agent pipeline ------------------------------------------------
 
@@ -435,6 +466,9 @@ class Evaluator:
             slice_id=agent.tpu.slice_id,
             topology=pod.tpu.topology or agent.tpu.topology,
             worker_coords=agent.tpu.coords,
+            slice_index=pod.tpu.slice_index(requirement.pod_instance.index,
+                                            pod.count),
+            num_slices=max(1, pod.tpu.slices),
         ), None
 
     def _build_launch(self, requirement: PodInstanceRequirement,
@@ -468,6 +502,14 @@ class Evaluator:
                 env["TPU_TOPOLOGY"] = tpu.topology
             if tpu.worker_coords is not None:
                 env["TPU_WORKER_COORDS"] = ",".join(map(str, tpu.worker_coords))
+            if tpu.num_slices > 1:
+                # libtpu multislice (MEGASCALE) contract: slice-to-slice
+                # DCN transport forms around the same coordinator host
+                host = tpu.coordinator_address.rsplit(":", 1)[0]
+                env["MEGASCALE_NUM_SLICES"] = str(tpu.num_slices)
+                env["MEGASCALE_SLICE_ID"] = str(tpu.slice_index)
+                env["MEGASCALE_COORDINATOR_ADDRESS"] = \
+                    f"{host}:{MEGASCALE_COORDINATOR_PORT}"
         if agent.zone:
             env["ZONE"] = agent.zone
         if agent.region:

@@ -43,9 +43,17 @@ class MeshSpec:
     sp: int = 1   # sequence/context parallel (ring attention)
     tp: int = 1   # tensor/model parallel (weight shards)
     ep: int = 1   # expert parallel (MoE experts)
+    # multislice: the dp axis additionally spans this many ICI slices over
+    # DCN (slice-major ordering, so per-step gradient all-reduces cross DCN
+    # once while all inner-axis collectives stay on ICI — the standard
+    # multislice recipe). Total dp replication = dcn * dp.
+    dcn: int = 1
 
     def axis_sizes(self) -> Tuple[int, ...]:
-        return tuple(getattr(self, a) for a in AXES)
+        sizes = tuple(getattr(self, a) for a in AXES)
+        # dcn folds into the leading (dp) axis: models keep addressing the
+        # canonical five axes regardless of slice count
+        return (sizes[0] * self.dcn,) + sizes[1:]
 
     @property
     def size(self) -> int:
@@ -85,7 +93,17 @@ class MeshSpec:
                 f"mesh {dict(zip(AXES, shape))} needs {self.size} devices, "
                 f"have {len(devices)}")
         if devices and devices[0].platform == "cpu":
+            # virtual devices have no topology: slice-major order is just
+            # the given device order
             dev_array = np.array(list(devices)).reshape(shape)
+        elif self.dcn > 1:
+            # hybrid mesh: ICI axes laid out within each slice, the dcn
+            # factor of the leading axis spanning slices over DCN
+            from jax.experimental import mesh_utils
+            ici_shape = (self.dp,) + shape[1:]
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, (self.dcn,) + (1,) * (len(shape) - 1),
+                devices=list(devices))
         else:
             from jax.experimental import mesh_utils
             dev_array = mesh_utils.create_device_mesh(

@@ -175,11 +175,28 @@ class TpuSpec:
     enforces all-or-nothing placement of every pod instance onto agents of a
     single slice with mutually consistent ICI coordinates — a constraint Mesos
     never had (SURVEY.md section 7 "hard parts" (3)).
+
+    ``slices``: multislice — the pod group spans this many DISTINCT slices
+    (count must divide evenly; instances are grouped contiguously: group g =
+    index // (count/slices) lands on slice g). Tasks additionally receive
+    the ``MEGASCALE_*`` env so jax.distributed + libtpu form a
+    DCN-connected multislice job.
     """
 
     chips: int = 0
     topology: Optional[str] = None
     gang: bool = True
+    slices: int = 1
+
+    def group_size(self, count: int) -> int:
+        """Instances per slice group (count validated divisible)."""
+        return count // max(1, self.slices)
+
+    def slice_index(self, index: int, count: int) -> int:
+        """Which slice group an instance belongs to — the ONE source of
+        the grouping formula; placement and the exported MEGASCALE env must
+        agree or the physical slice and the reported slice id diverge."""
+        return index // self.group_size(count)
 
 
 @dataclass(frozen=True)
@@ -383,6 +400,19 @@ class PodSpec:
             errs.extend(t.validate())
         for r in self.resource_sets:
             errs.extend(r.validate())
+        if self.tpu is not None:
+            if self.tpu.slices < 1:
+                errs.append(f"pod {self.type}: tpu.slices must be >= 1")
+            elif self.count % self.tpu.slices != 0:
+                errs.append(
+                    f"pod {self.type}: count {self.count} not divisible by "
+                    f"tpu.slices {self.tpu.slices}")
+            if self.tpu.slices > 1 and not self.tpu.gang:
+                # without gang placement nothing guarantees the groups land
+                # on distinct physical slices, but the MEGASCALE contract
+                # would still describe them — reject the combination
+                errs.append(
+                    f"pod {self.type}: tpu.slices > 1 requires gang: true")
         total_tpus = sum(r.tpus for r in self.resource_sets)
         if total_tpus and self.tpu is None:
             errs.append(

@@ -168,6 +168,7 @@ def _map_pod(pod_type: str, raw: Mapping[str, Any], env: Mapping[str, str],
         chips=int(tpu_raw.get("chips", 0)),
         topology=tpu_raw.get("topology"),
         gang=bool(tpu_raw.get("gang", True)),
+        slices=int(tpu_raw.get("slices", 1)),
     ) if tpu_raw else None
     if tpu is None and any(rs.tpus for rs in resource_sets):
         tpu = TpuSpec(chips=max(rs.tpus for rs in resource_sets))

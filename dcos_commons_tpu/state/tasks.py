@@ -68,6 +68,10 @@ class TpuAssignment:
     slice_id: Optional[str] = None
     topology: Optional[str] = None
     worker_coords: Optional[Tuple[int, ...]] = None
+    # multislice (MEGASCALE contract): which of num_slices this worker's
+    # slice is; 1 slice = the plain single-slice job
+    slice_index: int = 0
+    num_slices: int = 1
 
 
 @dataclass(frozen=True)

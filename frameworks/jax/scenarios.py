@@ -31,6 +31,8 @@ DEFAULT_ENV: Mapping[str, str] = {
     "RESNET_DEPTH": "50",
     "LLAMA_PRESET": "tiny",
     "SHARD_COUNT": "4",
+    # multislice scenario knobs (multislice.yml)
+    "NUM_SLICES": "2",
     # long-context scenario knobs (longctx.yml)
     "SEQ_LEN": "8192",
     "ATTN_IMPL": "ring",

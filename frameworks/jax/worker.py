@@ -23,6 +23,7 @@ import json
 import logging
 import os
 import pickle
+import sys
 import time
 from typing import Optional
 
@@ -140,7 +141,10 @@ def run_resnet(args) -> dict:
 
     contract = distributed.initialize()
     n = jax.device_count()
-    mesh = MeshSpec(dp=n).build()
+    # multislice: the matcher exports MEGASCALE_NUM_SLICES; dp spans slices
+    # over DCN (slice-major), per-slice replicas stay on ICI
+    num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1"))
+    mesh = MeshSpec(dp=n // num_slices, dcn=num_slices).build()
 
     depth = args.depth
     cfg = resnet.ResNetConfig(depth=depth, n_classes=1000)
@@ -442,6 +446,16 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
     args = build_parser().parse_args(argv)
+    num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1"))
+    if num_slices > 1 and args.workload != "resnet":
+        # only the dp trainer builds a dcn-aware mesh today; any other mode
+        # would lay a pure-ICI mesh across slices and route per-layer
+        # collectives over DCN — fail fast instead
+        print(f"error: workload {args.workload!r} does not support "
+              f"multislice (MEGASCALE_NUM_SLICES={num_slices}); "
+              "use the resnet dp trainer or drop tpu.slices",
+              file=sys.stderr)
+        return 2
     _emit({"event": "start", "workload": args.workload,
            "task": os.environ.get("TASK_NAME", "?"),
            "pod_index": os.environ.get("POD_INSTANCE_INDEX", "0")})

@@ -321,3 +321,95 @@ pods:
         pooled = dataclasses.replace(cpu_agent(1), roles=("*", "pool-a"))
         plan, _ = ev.evaluate(req(spec, "hello", 0), [pooled], [], ledger)
         assert plan.launches[0].env["FRAMEWORK_HOST"] == "svc.corp.example"
+
+
+class TestMultislice:
+    """Multislice gangs: contiguous instance groups on distinct slices,
+    MEGASCALE env, all-or-nothing across slices."""
+
+    YML = """
+name: jax
+pods:
+  worker:
+    count: 4
+    tpu: {chips: 4, topology: v4-16, slices: 2}
+    resource-sets:
+      wres: {cpus: 2, memory: 4096, tpus: 4}
+    tasks:
+      train: {goal: RUNNING, cmd: python train.py, resource-set: wres}
+"""
+
+    def _agents(self, slice_ids, hosts_per_slice=2):
+        out = []
+        n = 0
+        for sid in slice_ids:
+            for h in range(hosts_per_slice):
+                out.append(AgentInfo(
+                    agent_id=f"{sid}-h{h}", hostname=f"{sid}-host{h}",
+                    cpus=16, memory_mb=65536, disk_mb=65536,
+                    tpu=TpuInventory(chips=4, slice_id=sid,
+                                     topology="v4-16", coords=(n, 0, 0),
+                                     worker_index=h)))
+                n += 1
+        return out
+
+    def _place_all(self, spec, agents):
+        ev = Evaluator("jax")
+        ledger = ReservationLedger()
+        tasks = []
+        plans = []
+        for i in range(4):
+            plan, outcome = ev.evaluate(req(spec, "worker", i), agents,
+                                        tasks, ledger)
+            assert plan is not None, (i, outcome.to_dict())
+            plans.append(plan)
+            for r in plan.reservations:
+                ledger.add(r)
+            tasks.append(TaskRecord(
+                task_name=plan.launches[0].task_name, pod_type="worker",
+                pod_index=i, agent_id=plan.agent.agent_id,
+                hostname=plan.agent.hostname))
+        return plans
+
+    def test_groups_on_distinct_slices(self):
+        spec = load_service_yaml_str(self.YML, {})
+        plans = self._place_all(spec, self._agents(["slice-a", "slice-b"]))
+        slices = [p.agent.tpu.slice_id for p in plans]
+        assert slices[0] == slices[1]
+        assert slices[2] == slices[3]
+        assert slices[0] != slices[2]
+        for i, p in enumerate(plans):
+            env = p.launches[0].env
+            assert env["MEGASCALE_NUM_SLICES"] == "2"
+            assert env["MEGASCALE_SLICE_ID"] == str(i // 2)
+            assert env["JAX_PROCESS_ID"] == str(i)
+            assert env["JAX_NUM_PROCESSES"] == "4"
+            assert env["MEGASCALE_COORDINATOR_ADDRESS"].endswith(":8479")
+        # every worker of the job shares one megascale coordinator
+        assert len({p.launches[0].env["MEGASCALE_COORDINATOR_ADDRESS"]
+                    for p in plans}) == 1
+
+    def test_one_slice_is_not_enough(self):
+        spec = load_service_yaml_str(self.YML, {})
+        ev = Evaluator("jax")
+        plan, outcome = ev.evaluate(
+            req(spec, "worker", 0), self._agents(["slice-a"],
+                                                 hosts_per_slice=4),
+            [], ReservationLedger())
+        assert plan is None
+        assert "distinct" in str(outcome.to_dict())
+
+    def test_undersized_second_slice_blocks_everything(self):
+        spec = load_service_yaml_str(self.YML, {})
+        agents = self._agents(["slice-a"]) + self._agents(["slice-b"],
+                                                          hosts_per_slice=1)
+        ev = Evaluator("jax")
+        plan, _ = ev.evaluate(req(spec, "worker", 0), agents, [],
+                              ReservationLedger())
+        assert plan is None
+
+    def test_count_must_divide_slices(self):
+        import pytest
+        bad = self.YML.replace("count: 4", "count: 3")
+        with pytest.raises(ValueError, match="not divisible"):
+            load_service_yaml_str(bad, {})

@@ -13,6 +13,8 @@ spec-to-training end to end per BASELINE.json configs[2..4].
 import json
 import os
 
+import dataclasses
+
 import pytest
 
 from dcos_commons_tpu.plan import Status
@@ -39,13 +41,45 @@ def runner_for(scenario: str, env: dict | None = None,
     return ServiceTestRunner(spec=spec, **kwargs)
 
 
+def two_slice_agents(hosts_per_slice=2):
+    """slice-a + slice-b agent sets for multislice gangs."""
+    return (tpu_slice_agents(n=hosts_per_slice, chips=4,
+                             slice_id="slice-a", topology="v4-32")
+            + [dataclasses.replace(a, agent_id=f"b-{a.agent_id}",
+                                   hostname=f"b-{a.hostname}")
+               for a in tpu_slice_agents(n=hosts_per_slice, chips=4,
+                                         slice_id="slice-b",
+                                         topology="v4-32")])
+
+
 class TestScenariosDeploy:
     @pytest.mark.parametrize("scenario", scenarios.list_scenarios())
     def test_deploys(self, scenario):
-        runner_for(scenario).run([
+        kwargs = {}
+        if scenario == "multislice":
+            # the 2-slice gang needs two distinct slices of agents
+            kwargs["agents"] = two_slice_agents()
+        runner_for(scenario, env={"WORKER_COUNT": "4"}
+                   if scenario == "multislice" else None, **kwargs).run([
             Send.until_quiet(),
             Expect.deployed(),
         ])
+
+    def test_multislice_megascale_env(self):
+        runner = runner_for("multislice", env={"WORKER_COUNT": "4"},
+                            agents=two_slice_agents())
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        launches = {l.task_name: l
+                    for p in runner.cluster.launch_log for l in p.launches}
+        by_slice = {}
+        for name, l in launches.items():
+            assert l.env["MEGASCALE_NUM_SLICES"] == "2"
+            by_slice.setdefault(l.env["MEGASCALE_SLICE_ID"],
+                                set()).add(l.env["TPU_SLICE_ID"])
+        # two groups, each on exactly one distinct slice
+        assert set(by_slice) == {"0", "1"}
+        assert all(len(v) == 1 for v in by_slice.values())
+        assert by_slice["0"] != by_slice["1"]
 
     def test_mnist_single_chip_no_gang(self):
         # configs[2]: one trainer, one chip, FINISH goal

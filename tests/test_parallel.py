@@ -167,3 +167,35 @@ class TestMoE:
             x, rand((d, e), 1), rand((e, d, f), 2), rand((e, f, d), 3))
         assert out.shape == x.shape  # dropped tokens give zero rows, no NaN
         assert not bool(jnp.isnan(out).any())
+
+
+class TestMultisliceMesh:
+    """MeshSpec.dcn: the dp axis spans virtual slices (hybrid mesh)."""
+
+    def test_dcn_folds_into_dp(self):
+        from dcos_commons_tpu.parallel.mesh import MeshSpec
+        spec = MeshSpec(dp=2, tp=2, dcn=2)
+        assert spec.size == 8
+        mesh = spec.build(jax.devices()[:8])
+        assert mesh.shape["dp"] == 4
+        assert mesh.shape["tp"] == 2
+
+    def test_dcn_mesh_trains(self):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from dcos_commons_tpu.models import mlp, train
+        from dcos_commons_tpu.parallel.mesh import MeshSpec
+        mesh = MeshSpec(dp=2, dcn=2, tp=2).build(jax.devices()[:8])
+        cfg = mlp.MLPConfig(in_dim=16, hidden=(32,), n_classes=4)
+        params = mlp.init_params(cfg, jax.random.key(0))
+        opt = train.make_optimizer(warmup=1, decay_steps=10)
+        step = train.make_train_step(
+            lambda p, b: mlp.loss_fn(cfg, p, b), opt, mesh=mesh,
+            param_spec_tree=jax.tree.map(lambda _: P(), params),
+            batch_spec=(P(("dp",)), P(("dp",))))
+        opt_state = train.init_opt_state(opt, params, mesh,
+                                         jax.tree.map(lambda _: P(), params))
+        x = jax.random.normal(jax.random.key(1), (8, 16))
+        y = jax.random.randint(jax.random.key(2), (8,), 0, 4)
+        params, opt_state, out = step(params, opt_state, (x, y))
+        assert jnp.isfinite(out["loss"])

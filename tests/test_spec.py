@@ -235,3 +235,20 @@ pods:
         import pytest
         with pytest.raises(ValueError, match="both pod and resource-set"):
             load_service_yaml_str(yml, {})
+
+
+def test_multislice_requires_gang():
+    import pytest
+    yml = """
+name: svc
+pods:
+  w:
+    count: 4
+    tpu: {chips: 4, slices: 2, gang: false}
+    resource-sets:
+      r: {cpus: 1, memory: 64, tpus: 4}
+    tasks:
+      t: {goal: RUNNING, cmd: run, resource-set: r}
+"""
+    with pytest.raises(ValueError, match="requires gang"):
+        load_service_yaml_str(yml, {})
