@@ -289,3 +289,29 @@ class TestExpertParallel:
                 cfg, p, toks, mesh, mcfg)[0])(params)
         assert float(jnp.abs(g["layers"]["w_in"]).max()) > 0
         assert float(jnp.abs(g["layers"]["router"]).max()) > 0
+
+
+class TestMultisliceRecovery:
+    def test_member_failure_reforms_gang_and_keeps_slice_groups(self):
+        runner = runner_for("multislice", env={"WORKER_COUNT": "4"},
+                            agents=two_slice_agents())
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        before = {}
+        for p in runner.cluster.launch_log:
+            for l in p.launches:
+                before[l.task_name] = l.env["TPU_SLICE_ID"]
+        n_deploy_plans = len(runner.cluster.launch_log)
+        runner.run([
+            Send.task_status("worker-3-train", TaskState.FAILED),
+            Send.until_quiet(),
+        ])
+        # gang re-form relaunched every member...
+        after = {}
+        for p in runner.cluster.launch_log[n_deploy_plans:]:
+            for l in p.launches:
+                after[l.task_name] = l.env["TPU_SLICE_ID"]
+        assert set(after) == set(before), (before, after)
+        # ...with the same group-to-slice assignment (stable MEGASCALE ids)
+        assert after == before
+        from dcos_commons_tpu.plan import Status
+        assert runner.scheduler.plan("recovery").status is Status.COMPLETE
