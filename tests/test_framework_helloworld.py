@@ -362,3 +362,11 @@ class TestVolumeAndRoleScenarios:
         runner.cluster.script("server", TaskBehavior.CRASH)
         sched = runner.run([Send.until_quiet()])
         assert sched.plan("deploy").status is not Status.COMPLETE
+
+
+def test_executor_volume_shared_across_tasks():
+    runner = runner_for("executor_volume")
+    runner.run([Send.until_quiet(), Expect.deployed()])
+    for plan in runner.cluster.launch_log:
+        for launch in plan.launches:
+            assert "shared" in launch.volumes, launch.task_name

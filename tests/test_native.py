@@ -630,3 +630,83 @@ pods:
         agent.terminate()
         agent.wait(timeout=5)
         server.stop()
+
+
+MULTISLICE_YML = """
+name: ms-svc
+pods:
+  worker:
+    count: 4
+    tpu: {chips: 4, topology: v4-16, slices: 2}
+    tasks:
+      train:
+        goal: RUNNING
+        cmd: "env | grep -E 'MEGASCALE|JAX_|TPU_SLICE' > contract.txt && sleep 600"
+        cpus: 0.5
+        memory: 64
+        tpus: 4
+"""
+
+
+def test_multislice_gang_over_real_agents(native_bins, tmp_path):
+    """Two real-agent slices; the 4-worker 2-slice gang must land groups on
+    distinct slices and export the MEGASCALE contract into every sandbox."""
+    cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.05)
+    sched = ServiceScheduler(load_service_yaml_str(MULTISLICE_YML),
+                             MemPersister(), cluster)
+    server = ApiServer(sched, port=0, cluster=cluster)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    agents = []
+    try:
+        for sid in ("sl-a", "sl-b"):
+            for h in range(2):
+                agents.append(subprocess.Popen(
+                    [str(native_bins / "tpu-agent"), "--scheduler", url,
+                     "--agent-id", f"{sid}-h{h}",
+                     "--hostname", f"{sid}-host{h}",
+                     "--cpus", "4", "--memory-mb", "2048",
+                     "--disk-mb", "8000",
+                     "--base-dir", str(tmp_path / f"{sid}-h{h}"),
+                     "--poll-interval", "0.05", "--tpu-chips", "4",
+                     "--slice-id", sid, "--topology", "v4-16",
+                     "--worker-index", str(h)],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        drive_to(sched, "deploy", Status.COMPLETE, timeout=40)
+
+        def contracts():
+            found = {}
+            for f in tmp_path.glob("*/worker-*-train__*/contract.txt"):
+                try:
+                    env = dict(l.split("=", 1)
+                               for l in f.read_text().split())
+                except ValueError:
+                    continue  # partially-written file; retry next poll
+                if "MEGASCALE_NUM_SLICES" not in env:
+                    continue  # grep output still flushing
+                found[f.parent.name.split("__")[0]] = env
+            return found if len(found) == 4 else None
+
+        env_by_task = wait_for(contracts, timeout=15,
+                               message="4 sandbox contracts")
+        by_group = {}
+        for name, env in env_by_task.items():
+            idx = int(name.split("-")[1])
+            assert env["MEGASCALE_NUM_SLICES"] == "2"
+            assert env["MEGASCALE_SLICE_ID"] == str(idx // 2), (name, env)
+            assert env["JAX_PROCESS_ID"] == str(idx)
+            by_group.setdefault(env["MEGASCALE_SLICE_ID"],
+                                set()).add(env["TPU_SLICE_ID"])
+        assert by_group["0"] != by_group["1"]
+        assert all(len(v) == 1 for v in by_group.values())
+        assert len({e["MEGASCALE_COORDINATOR_ADDRESS"]
+                    for e in env_by_task.values()}) == 1
+    finally:
+        for p in agents:
+            p.terminate()
+        for p in agents:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        server.stop()
