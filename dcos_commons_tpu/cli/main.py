@@ -16,8 +16,10 @@ class Client:
         self.base = base_url.rstrip("/")
         self.prefix = f"/v1/service/{service}" if service else "/v1"
 
-    def call(self, method: str, path: str, body: Optional[bytes] = None):
-        url = f"{self.base}{self.prefix}/{path.lstrip('/')}"
+    def call(self, method: str, path: str, body: Optional[bytes] = None,
+             root: bool = False):
+        prefix = "/v1" if root else self.prefix
+        url = f"{self.base}{prefix}/{path.lstrip('/')}"
         req = urllib.request.Request(url, method=method, data=body)
         try:
             with urllib.request.urlopen(req, timeout=30) as r:
@@ -129,6 +131,11 @@ def _state_cmd(client: Client, args) -> int:
     return _emit(*client.get(f"state/properties/{args.key}"))
 
 
+def _agents_cmd(client: Client, args) -> int:
+    path = "agents/info" if args.action == "info" else "agents"
+    return _emit(*client.call("GET", path, root=True))
+
+
 def _health_cmd(client: Client, args) -> int:
     return _emit(*client.get("health"))
 
@@ -187,6 +194,11 @@ def build_parser() -> argparse.ArgumentParser:
                                        "property"])
     st.add_argument("key", nargs="?")
     st.set_defaults(fn=_state_cmd)
+
+    ag = sub.add_parser("agents", help="registered agent inventory")
+    ag.add_argument("action", nargs="?", choices=["list", "info"],
+                    default="list")
+    ag.set_defaults(fn=_agents_cmd)
 
     sub.add_parser("health", help="scheduler health").set_defaults(
         fn=_health_cmd)

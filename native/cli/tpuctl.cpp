@@ -53,6 +53,7 @@ void usage() {
       << "  describe | config list|show|target-id [ID]\n"
       << "  update [--set KEY=VALUE ...] [--yaml FILE]\n"
       << "  state framework-id|properties|property [KEY]\n"
+      << "  agents [list|info]\n"
       << "  health\n";
 }
 
@@ -98,6 +99,18 @@ int main(int argc, char** argv) {
 
     if (cmd == "health") return get(ctx, "health");
     if (cmd == "describe") return get(ctx, "configurations/target");
+
+    if (cmd == "agents") {
+      if (!action.empty() && action != "list" && action != "info") {
+        std::cerr << "agents: unknown action '" << action
+                  << "' (expected list|info)\n";
+        return 2;
+      }
+      // process-level route: never under a /v1/service/<name> prefix
+      Ctx root = ctx;
+      root.prefix = "/v1";
+      return get(root, action == "info" ? "agents/info" : "agents");
+    }
 
     if (cmd == "update") {
       // live config update (`dcos <svc> update start --options` analogue)

@@ -14,7 +14,7 @@ from tests.test_http import make_scheduler
 def server():
     sched = make_scheduler()
     sched.run_until_quiet()
-    srv = ApiServer(sched, port=0)
+    srv = ApiServer(sched, port=0, cluster=sched.cluster)
     srv.start()
     yield sched, f"http://127.0.0.1:{srv.port}"
     srv.stop()
@@ -83,3 +83,11 @@ def test_update_command(server, capsys, tmp_path):
     result = run_cli(base, "update", "--yaml", str(bad_yaml), expect=1,
                      capsys=capsys)
     assert result["errors"]
+
+
+def test_agents_command(server, capsys):
+    _, base = server
+    ids = run_cli(base, "agents", capsys=capsys)
+    assert ids and all(isinstance(i, str) for i in ids)
+    info = run_cli(base, "agents", "info", capsys=capsys)
+    assert {"volume_profiles", "roles", "tpu"} <= set(info[0])
