@@ -95,6 +95,11 @@ class ServiceScheduler:
         self.uninstall_mode = uninstall
         # optional MetricsRegistry (reference metrics/Metrics.java counters)
         self.metrics = metrics
+        if metrics is not None:
+            # liveness of the agent fleet (the reference's closest analogue
+            # is Mesos's own /slaves; here the scheduler owns the registry)
+            metrics.gauge("agents.registered",
+                          lambda: float(len(cluster.agents())))
         # kept for live config updates (update_config rebuilds plans)
         self._validators = validators
         self._failure_monitor = failure_monitor

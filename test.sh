@@ -14,6 +14,9 @@ python -m pytest tests/ -q "$@"
 echo "== framework integration suites =="
 python -m pytest frameworks/ -q "$@"
 
+echo "== airgap lint =="
+python -m tools.airgap_linter frameworks/*/
+
 echo "== package bundles =="
 for universe in frameworks/*/universe; do
     python -m tools.package_builder "$universe" --version 0.0.0-ci \

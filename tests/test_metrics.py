@@ -72,3 +72,25 @@ class TestRegistry:
         datagram = recv.recv(1024).decode()
         assert datagram == "tpu_sdk.ops.launch:3|c"
         recv.close()
+
+
+def test_agents_registered_gauge():
+    from dcos_commons_tpu.agent import FakeCluster
+    from dcos_commons_tpu.metrics import MetricsRegistry
+    from dcos_commons_tpu.scheduler import ServiceScheduler
+    from dcos_commons_tpu.specification import load_service_yaml_str
+    from dcos_commons_tpu.state import MemPersister
+    from dcos_commons_tpu.testing.simulation import default_agents
+    metrics = MetricsRegistry()
+    cluster = FakeCluster(default_agents(3))
+    ServiceScheduler(load_service_yaml_str("""
+name: m
+pods:
+  p:
+    count: 1
+    tasks:
+      t: {goal: RUNNING, cmd: run, cpus: 0.1, memory: 32}
+"""), MemPersister(), cluster, metrics=metrics)
+    assert metrics.to_dict()["gauges"]["agents.registered"] == 3.0
+    cluster.remove_agent("agent-2")
+    assert metrics.to_dict()["gauges"]["agents.registered"] == 2.0

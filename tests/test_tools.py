@@ -239,3 +239,51 @@ class TestReleaseHardening:
         assert sorted([Version("1.0.0-beta.10"),
                        Version("1.0.0-beta.2")])[-1] == \
             Version("1.0.0-beta.10")
+
+
+class TestAirgapLinter:
+    def test_shipped_frameworks_are_clean(self):
+        import os
+        from tools.airgap_linter import lint_framework
+        frameworks = [d for d in os.listdir("frameworks")
+                      if os.path.isdir(os.path.join("frameworks", d))
+                      and d != "__pycache__"]
+        assert len(frameworks) >= 4
+        for fw in frameworks:
+            assert lint_framework(f"frameworks/{fw}") == [], fw
+
+    def test_external_url_flagged(self, tmp_path):
+        from tools.airgap_linter import lint_framework, main
+        fw = tmp_path / "fw"
+        (fw / "dist").mkdir(parents=True)
+        (fw / "dist" / "svc.yml").write_text(
+            "name: x\npods:\n  p:\n    tasks:\n      t:\n"
+            "        cmd: curl https://artifacts.prod.corp/x.tgz\n")
+        hits = lint_framework(str(fw))
+        assert len(hits) == 1 and "artifacts.prod.corp" in hits[0][2]
+        assert main([str(fw)]) == 1
+
+    def test_templated_universe_and_loopback_exempt(self, tmp_path):
+        from tools.airgap_linter import lint_framework
+        fw = tmp_path / "fw"
+        (fw / "universe").mkdir(parents=True)
+        (fw / "dist").mkdir()
+        # whole universe/ dir exempt (release tooling rebases it)
+        (fw / "universe" / "resource.json").write_text(
+            '{"assets": {"x": "https://downloads.someorg.net/x.tgz"}}')
+        (fw / "universe" / "scheduler.json.mustache").write_text(
+            '{"uri": "https://downloads.someorg.net/x.tgz"}')
+        # templated + loopback (any case) fine outside universe/
+        (fw / "dist" / "svc.yml").write_text(
+            "# see https://wiki.someorg.net (comment: exempt)\n"
+            "uris: ['{{BOOTSTRAP_URI}}']\n"
+            "probe: HTTP://LOCALHOST:8080/v1/health\n")
+        assert lint_framework(str(fw)) == []
+
+    def test_resource_json_outside_universe_flagged(self, tmp_path):
+        from tools.airgap_linter import lint_framework
+        fw = tmp_path / "fw"
+        (fw / "dist").mkdir(parents=True)
+        (fw / "dist" / "resource.json").write_text(
+            '{"x": "https://artifacts.prod.corp/x.tgz"}')
+        assert len(lint_framework(str(fw))) == 1

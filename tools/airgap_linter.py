@@ -1,0 +1,98 @@
+"""Airgap linter: frameworks must work with no external network.
+
+Reference ``tools/airgap_linter.py``: in an airgapped cluster every
+artifact must come through the package's resource.json (whose URLs the
+release tooling rebases onto the local repo, ``tools/release_builder.py``).
+A literal ``http(s)://`` URL anywhere else — a svc.yml `uris:`, a task cmd
+`curl`, a config template — would silently depend on the outside world.
+
+Usage::
+
+    python -m tools.airgap_linter frameworks/jax [frameworks/... ...]
+
+Exit 0 = clean; 1 = violations (each printed as file:line).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Iterator, List, Tuple
+
+# the universe/ package dir is WHERE external artifact URLs belong: the
+# release tooling rebases every URL there onto the local repo
+# (tools/release_builder.py); anything outside it must not reach out
+ALLOWED_DIRS = {"universe"}
+_URL = re.compile(r"https?://[^\s\"'<>)\]}]+", re.IGNORECASE)
+# loopback/example/doc hosts never leave the machine or are placeholders
+_EXEMPT_HOST = re.compile(
+    r"^(localhost|127\.0\.0\.1|0\.0\.0\.0|\[::1\]|example\.com"
+    r"|.*\.example\.com|.*\.invalid)([:/]|$)", re.IGNORECASE)
+# runtime-relevant text only (prose docs may cite external links freely)
+TEXT_SUFFIXES = (".yml", ".yaml", ".json", ".mustache", ".py", ".sh",
+                 ".cfg", ".conf")
+
+
+def _iter_files(root: str) -> Iterator[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "tests")
+                       and d not in ALLOWED_DIRS]
+        for fname in filenames:
+            if fname.endswith(TEXT_SUFFIXES):
+                yield os.path.join(dirpath, fname)
+
+
+def _is_templated(url: str) -> bool:
+    # "{{artifact-dir}}/x" style URLs are resolved by packaging, not the
+    # network at deploy time; the scheme is inside the template variable so
+    # a literal scheme followed by {{ also counts
+    return "{{" in url
+
+
+def lint_framework(root: str) -> List[Tuple[str, int, str]]:
+    violations: List[Tuple[str, int, str]] = []
+    for path in sorted(_iter_files(root)):
+        with open(path, encoding="utf-8", errors="ignore") as f:
+            for lineno, line in enumerate(f, 1):
+                stripped = line.strip()
+                if stripped.startswith(("#", "//", "*")):
+                    continue  # comments/docs may cite URLs
+                for url in _URL.findall(line):
+                    if _is_templated(url):
+                        continue
+                    host = url.split("://", 1)[1]
+                    if _EXEMPT_HOST.match(host):
+                        continue
+                    violations.append((path, lineno, url))
+    return violations
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("frameworks", nargs="+",
+                   help="framework directories to lint")
+    args = p.parse_args(argv)
+    bad = 0
+    for root in args.frameworks:
+        root = root.rstrip("/")
+        if os.path.basename(root) == "__pycache__":
+            continue  # shell globs like frameworks/*/ may include it
+        if not os.path.isdir(root):
+            print(f"error: not a directory: {root}", file=sys.stderr)
+            return 2
+        for path, lineno, url in lint_framework(root):
+            print(f"{path}:{lineno}: external URL outside universe/: "
+                  f"{url}")
+            bad += 1
+    if bad:
+        print(f"{bad} airgap violation(s)", file=sys.stderr)
+        return 1
+    print("airgap-clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
