@@ -370,3 +370,20 @@ def test_executor_volume_shared_across_tasks():
     for plan in runner.cluster.launch_log:
         for launch in plan.launches:
             assert "shared" in launch.volumes, launch.task_name
+
+
+def test_overlay_network_regime_change_blocked():
+    from dcos_commons_tpu.config.updater import network_regime_cannot_change
+    overlay = scenarios.load_scenario("overlay")
+    host = scenarios.load_scenario("simple")
+    assert overlay.pod("hello").networks == ("dcos",)
+    import dataclasses
+    host_hello = dataclasses.replace(host.pod("hello"), type="hello")
+    errs = network_regime_cannot_change(
+        overlay, dataclasses.replace(overlay, pods=(host_hello,)))
+    assert errs
+
+
+def test_share_pid_namespace_flag_parsed():
+    spec = scenarios.load_scenario("share_pid_namespace")
+    assert spec.pod("hello").share_pid_namespace is True
