@@ -8,6 +8,8 @@ anchor we report 1.0.
 """
 
 import json
+import math
+import os
 import sys
 import time
 
@@ -47,11 +49,18 @@ def main() -> None:
 
     n_chips = jax.device_count()
     ips_per_chip = batch * n_steps / dt / n_chips
+    # anchor: BENCH_BASELINE env (img/s/chip from a prior round's
+    # BENCH_r{N}.json) makes vs_baseline a real ratio; absent -> 1.0
+    try:
+        baseline = float(os.environ.get("BENCH_BASELINE", "") or 0.0)
+    except ValueError:
+        baseline = 0.0
+    valid = baseline > 0 and math.isfinite(baseline)
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips_per_chip, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": 1.0,
+        "vs_baseline": round(ips_per_chip / baseline, 3) if valid else 1.0,
     }))
 
 
