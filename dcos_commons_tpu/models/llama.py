@@ -383,16 +383,22 @@ def cache_specs() -> Params:
 
 def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
                 pos: jnp.ndarray, token: jnp.ndarray,
-                mesh: Optional[Mesh] = None
+                mesh: Optional[Mesh] = None,
+                rope: Optional[jnp.ndarray] = None
                 ) -> Tuple[jnp.ndarray, Params]:
     """One greedy-decode step.
 
     token [B] int32, pos scalar int32 (current length). Returns
     (logits [B, V], updated cache). Static shapes: the cache is a fixed
     [max] ring written at ``pos`` via dynamic_update_slice, masked reads.
+    Pass a precomputed ``rope`` table (``rope_frequencies`` output,
+    [2, max_seq, head_dim//2]) when calling from inside a scan —
+    materializing that constant inside every nested scan body explodes
+    TPU compile time (generate() hoists it once).
     """
     b = token.shape[0]
-    rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    if rope is None:
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
 
     x = params["embed"].astype(cfg.dtype)[token][:, None, :]   # [B, 1, D]
 
@@ -425,13 +431,17 @@ def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
 
 def generate(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,
              steps: int, mesh: Optional[Mesh] = None) -> jnp.ndarray:
-    """Greedy generation: prefill via forward(), then scan decode steps."""
+    """Greedy generation: prefill by scanning decode_step over the prompt
+    (cache-exact), then scan decode steps."""
     b, s = prompt.shape
     cache = init_kv_cache(cfg, b, cfg.max_seq)
+    # hoisted once: inside the scans it would be re-materialized per body
+    rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     # prefill: run each prompt token through decode (simple, cache-exact)
     def prefill(carry, i):
         cache, _ = carry
-        logits, cache = decode_step(cfg, params, cache, i, prompt[:, i], mesh)
+        logits, cache = decode_step(cfg, params, cache, i, prompt[:, i],
+                                    mesh, rope=rope)
         return (cache, logits), None
     (cache, logits), _ = lax.scan(
         prefill, (cache, jnp.zeros((b, cfg.vocab_size), jnp.float32)),
@@ -440,7 +450,8 @@ def generate(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,
     def step(carry, i):
         cache, logits = carry
         tok = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        logits, cache = decode_step(cfg, params, cache, s + i, tok, mesh)
+        logits, cache = decode_step(cfg, params, cache, s + i, tok, mesh,
+                                    rope=rope)
         return (cache, logits), tok
 
     (_, _), toks = lax.scan(step, (cache, logits), jnp.arange(steps))

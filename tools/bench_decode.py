@@ -1,0 +1,94 @@
+"""Reproducible llama decode benchmark: tokens/sec on one chip.
+
+Companion to ``tools.bench_attention`` for the inference path
+(BASELINE.json config #5): greedy KV-cache decode of a ~0.9B-parameter
+decoder in bf16 — large enough that per-token latency is HBM-bandwidth
+bound (every decode step streams all weights), which is the number that
+matters for serving. Prints one JSON line per measurement.
+
+Measurement notes (tunneled PJRT backends, see docs/performance.md): the
+decode loop is a single jitted ``lax.scan`` whose carry feeds forward, and
+a host materialization forces the sync.
+
+Usage::
+
+    python -m tools.bench_decode [--steps 64] [--batch 1] [--preset 1b|tiny]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=64,
+                   help="decode steps to time")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--prompt", type=int, default=8, help="prefill length")
+    p.add_argument("--preset", default="400m",
+                   choices=["1b", "400m", "tiny"])
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama
+
+    if args.preset == "1b":
+        # ~0.9B params (~1.8 GB bf16): decode streams the full weight set
+        # per token -> HBM-bound. NOTE: the nested-scan decode graph takes
+        # >15 min to compile through tunneled PJRT backends; prefer 400m
+        # unless compiles are local/cached.
+        cfg = llama.LlamaConfig(vocab_size=32000, dim=2048, n_layers=16,
+                                n_heads=16, n_kv_heads=8, ffn_dim=5632,
+                                max_seq=1024, remat=False,
+                                attn_impl="dense")
+    elif args.preset == "400m":
+        # ~0.4B params (~0.8 GB bf16): still weight-streaming bound, far
+        # cheaper to compile
+        cfg = llama.LlamaConfig(vocab_size=32000, dim=1536, n_layers=8,
+                                n_heads=12, n_kv_heads=6, ffn_dim=4096,
+                                max_seq=512, remat=False,
+                                attn_impl="dense")
+    else:
+        cfg = llama.LlamaConfig.tiny()
+
+    params = llama.init_params(cfg, jax.random.key(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    prompt = jax.random.randint(jax.random.key(1),
+                                (args.batch, args.prompt), 0,
+                                cfg.vocab_size)
+
+    def run(steps):
+        return llama.generate(cfg, params, prompt, steps)
+
+    # ONE compiled program (static steps): the short prefill rides along
+    # in the measured time — with prompt << steps its contribution is a few
+    # percent, and avoiding a second compile matters on tunneled backends
+    run_j = jax.jit(run, static_argnums=0)
+    toks = run_j(args.steps)          # compile + warmup
+    int(toks[0, -1])                  # host sync
+    t0 = time.perf_counter()
+    toks = run_j(args.steps)
+    int(toks[0, -1])
+    decode_dt = time.perf_counter() - t0
+    tps = args.batch * (args.steps + args.prompt) / decode_dt
+    print(json.dumps({
+        "metric": "llama_decode_tokens_per_sec",
+        "preset": args.preset,
+        "params": n_params,
+        "batch": args.batch,
+        "steps": args.steps,
+        "tokens_per_sec": round(tps, 1),
+        "ms_per_token": round(
+            1000.0 * decode_dt / (args.steps + args.prompt), 3),
+        "backend": jax.devices()[0].platform,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
