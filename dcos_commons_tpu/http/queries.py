@@ -146,7 +146,7 @@ class PodQueries:
                 "status": status.state.value if status else "NO_STATUS",
                 "override": override.value,
                 "overrideProgress": progress.value,
-                "agent_id": t.agent_id,
+                "agentId": t.agent_id,
                 "hostname": t.hostname,
                 "zone": t.zone,
                 "region": t.region,

@@ -53,6 +53,23 @@ def _profile_shortfall(volumes, agent: AgentInfo) -> Optional[str]:
                     f"{sorted(v.profiles)}; agent offers "
                     f"{sorted(agent.volume_profiles)}")
     return None
+
+
+def _role_shortfall(pod, agent: AgentInfo) -> Optional[str]:
+    """Pre-reserved-role gate (reference pre-reserved.yml): the pod's
+    resources must come from an agent serving that role pool. Shared by the
+    per-agent pipeline and the gang-slice feasibility pre-check so the two
+    cannot drift."""
+    if pod.pre_reserved_role and pod.pre_reserved_role not in agent.roles:
+        return (f"agent serves roles {list(agent.roles)}, pod requires "
+                f"pre-reserved role {pod.pre_reserved_role}")
+    return None
+
+
+def _needed_resource_sets(pod, requirement) -> List[str]:
+    """Resource sets actually launched by this requirement, sorted."""
+    return sorted({pod.task(t).resource_set_id
+                   for t in requirement.task_names})
 ENV_TASK_NAME = "TASK_NAME"
 ENV_POD_INSTANCE_INDEX = "POD_INSTANCE_INDEX"
 ENV_FRAMEWORK_NAME = "FRAMEWORK_NAME"
@@ -163,7 +180,8 @@ class Evaluator:
                    if t.pod_instance_name == pod_name))
         pinned_agent = None if replace_mode else \
             self._pinned_agent(requirement, ledger)
-        gang_slice, gang_err = self._gang_slice(requirement, agents, tasks, ledger)
+        gang_slice, gang_err = self._gang_slice(requirement, agents, tasks,
+                                                ledger, pinned_agent)
         if gang_err is not None:
             root.add(EvaluationOutcome.fail("gang", gang_err))
             self._record(root)
@@ -212,7 +230,9 @@ class Evaluator:
 
     def _gang_slice(self, requirement: PodInstanceRequirement,
                     agents: Sequence[AgentInfo], tasks: Sequence[TaskRecord],
-                    ledger: ReservationLedger) -> Tuple[Optional[str], Optional[str]]:
+                    ledger: ReservationLedger,
+                    pinned_agent: Optional[str] = None,
+                    ) -> Tuple[Optional[str], Optional[str]]:
         """Returns (slice_id this instance must land on, error).
 
         Gang TPU placement, generalized to multislice: the pod's instances
@@ -224,6 +244,17 @@ class Evaluator:
         """
         pod = requirement.pod_instance.pod
         if pod.tpu is None or not pod.tpu.gang or pod.tpu.chips <= 0:
+            return None, None
+        if pinned_agent is not None:
+            # A pinned relaunch-in-place cannot move slices, and the
+            # per-agent pipeline deliberately waives placement/profile
+            # re-checks for it — so the feasibility pre-check below must
+            # not get a vote either. The pinned agent's slice IS the gang
+            # slice; if the agent vanished from inventory, evaluate()'s
+            # pin stage reports that.
+            for a in agents:
+                if a.agent_id == pinned_agent:
+                    return a.tpu.slice_id, None
             return None, None
         pod_type = pod.type
         n_slices = max(1, pod.tpu.slices)
@@ -270,14 +301,32 @@ class Evaluator:
                 continue
             slices.setdefault(a.tpu.slice_id, []).append(a)
         exclude = requirement.pod_instance.name
+        # A host only counts toward a slice's capacity if it would also pass
+        # the per-agent hard gates downstream (pre-reserved role, placement
+        # rule, volume disk profiles); otherwise an infeasible slice gets
+        # deterministically assigned and the deploy wedges even when a
+        # viable one exists. The gates are shared helpers / the same filter
+        # call the per-agent pipeline uses, so they cannot drift.
+        pod_volumes = list(pod.volumes)
+        for rs_id in _needed_resource_sets(pod, requirement):
+            pod_volumes.extend(pod.resource_set(rs_id).volumes)
+
+        def host_capable(a: AgentInfo) -> bool:
+            if ledger.available(a, exclude_pod=exclude).tpus < per_host_chips:
+                return False
+            if _role_shortfall(pod, a) is not None:
+                return False
+            if pod.placement_rule is not None \
+                    and not pod.placement_rule.filter(a, exclude,
+                                                      tasks).passes:
+                return False
+            return _profile_shortfall(pod_volumes, a) is None
+
         capable: List[str] = []
         for slice_id, members in sorted(slices.items()):
             if slice_id in chosen.values():
                 continue  # taken by another group
-            n_hosts = sum(
-                1 for a in members
-                if ledger.available(a, exclude_pod=exclude).tpus
-                >= per_host_chips)
+            n_hosts = sum(1 for a in members if host_capable(a))
             if n_hosts >= group_size:
                 capable.append(slice_id)
         unassigned = [g for g in range(n_slices) if g not in chosen]
@@ -308,12 +357,10 @@ class Evaluator:
                 "gang", f"agent not in chosen slice {gang_slice}"))
             return None
 
-        # stage: pre-reserved role (reference pre-reserved.yml: the pod's
-        # resources must come from an agent serving that role pool)
-        if pod.pre_reserved_role and pod.pre_reserved_role not in agent.roles:
-            node.add(EvaluationOutcome.fail(
-                "role", f"agent serves roles {list(agent.roles)}, pod "
-                        f"requires pre-reserved role {pod.pre_reserved_role}"))
+        # stage: pre-reserved role
+        role_err = _role_shortfall(pod, agent)
+        if role_err is not None:
+            node.add(EvaluationOutcome.fail("role", role_err))
             return None
 
         # stage: placement rule (skipped for pinned relaunch-in-place, like
@@ -327,10 +374,9 @@ class Evaluator:
 
         # stage: per-resource-set reserve (reuse existing reservation if held)
         avail = ledger.available(agent, exclude_pod=pod_name)
-        needed_sets = {pod.task(t).resource_set_id for t in requirement.task_names}
         new_reservations: List[Reservation] = []
         reservations_by_set: Dict[str, Reservation] = {}
-        for rs_id in sorted(needed_sets):
+        for rs_id in _needed_resource_sets(pod, requirement):
             rs = pod.resource_set(rs_id)
             existing = ledger.get(pod_name, rs_id)
             if existing is not None and existing.agent_id == agent.agent_id \

@@ -372,13 +372,41 @@ class PodSpec:
             errs.extend(hv.validate())
         for rl in self.rlimits:
             errs.extend(rl.validate())
-        seen_paths = {v.container_path for v in self.volumes}
+        # Volumes mounting the same container path inside one pod silently
+        # shadow each other at runtime (the agent tolerates EEXIST on the
+        # symlink), so reject collisions among pod volumes and host volumes,
+        # and between those and any resource-set volume. Two resource sets
+        # sharing a path is allowed: the reference does exactly that
+        # (enable-disable.yml, both tasks mounting hello-container-path).
+        seen_paths: dict[str, str] = {}
+
+        def check_path(path: str, origin: str) -> None:
+            if path in seen_paths:
+                errs.append(
+                    f"pod {self.type}: container path {path!r} declared by "
+                    f"both {seen_paths[path]} and {origin}")
+            else:
+                seen_paths[path] = origin
+
+        for v in self.volumes:
+            check_path(v.container_path, "a pod volume")
+        for hv in self.host_volumes:
+            check_path(hv.container_path, "a host volume")
         for rs in self.resource_sets:
+            rs_seen: set[str] = set()
             for v in rs.volumes:
                 if v.container_path in seen_paths:
                     errs.append(
-                        f"pod {self.type}: volume path {v.container_path!r} "
-                        "declared at both pod and resource-set level")
+                        f"pod {self.type}: container path "
+                        f"{v.container_path!r} declared by both "
+                        f"{seen_paths[v.container_path]} and resource set "
+                        f"{rs.id!r}")
+                elif v.container_path in rs_seen:
+                    errs.append(
+                        f"pod {self.type}: container path "
+                        f"{v.container_path!r} declared twice in resource "
+                        f"set {rs.id!r}")
+                rs_seen.add(v.container_path)
         if self.count < 1:
             errs.append(f"pod {self.type}: count must be >= 1")
         if not self.tasks:

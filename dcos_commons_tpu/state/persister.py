@@ -331,24 +331,27 @@ class InstanceLock:
         os.makedirs(os.path.dirname(self._path), exist_ok=True)
         self._fd = os.open(self._path, os.O_RDWR | os.O_CREAT, 0o644)
         deadline = _time.monotonic() + timeout_s
-        while True:
-            try:
-                fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-                break
-            # only EWOULDBLOCK means contention; ENOLCK/ENOTSUP (e.g. an
-            # NFS state root without lock support) must surface as what
-            # they are, not as a phantom second instance
-            except BlockingIOError:
-                if _time.monotonic() >= deadline:
-                    os.close(self._fd)
-                    self._fd = -1
-                    raise LockError(
-                        f"another scheduler instance holds {self._path}; "
-                        "refusing to start (reference CuratorLocker "
-                        "semantics)") from None
-                _time.sleep(poll_interval_s)
-        os.truncate(self._fd, 0)
-        os.write(self._fd, f"{os.getpid()}\n".encode())
+        try:
+            while True:
+                try:
+                    fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    break
+                # only EWOULDBLOCK means contention; ENOLCK/ENOTSUP (e.g. an
+                # NFS state root without lock support) must surface as what
+                # they are, not as a phantom second instance
+                except BlockingIOError:
+                    if _time.monotonic() >= deadline:
+                        raise LockError(
+                            f"another scheduler instance holds {self._path}; "
+                            "refusing to start (reference CuratorLocker "
+                            "semantics)") from None
+                    _time.sleep(poll_interval_s)
+            os.truncate(self._fd, 0)
+            os.write(self._fd, f"{os.getpid()}\n".encode())
+        except BaseException:
+            os.close(self._fd)
+            self._fd = -1
+            raise
 
     def release(self) -> None:
         import fcntl

@@ -211,6 +211,88 @@ class TestGangPlacement:
                                          agents, [], self.ledger)
         assert plan is None
 
+    def test_infeasible_role_slice_excluded_from_assignment(self):
+        # slice s1 sorts first but its hosts don't serve the pod's
+        # pre-reserved role; the gang pass must skip it and choose s2
+        # instead of deterministically pinning the group to a slice every
+        # agent of which then fails the role stage (permanent wedge)
+        from dataclasses import replace as dc_replace
+        pod = dc_replace(self.spec.pod("worker"), pre_reserved_role="tpu-pool")
+        r = PodInstanceRequirement(PodInstance(pod, 0), ("train",))
+        plain = [tpu_agent(1, "s1"), tpu_agent(2, "s1")]
+        pooled = [dc_replace(tpu_agent(3, "s2"), roles=("*", "tpu-pool")),
+                  dc_replace(tpu_agent(4, "s2"), roles=("*", "tpu-pool"))]
+        plan, outcome = self.ev.evaluate(r, plain + pooled, [], self.ledger)
+        assert plan is not None
+        assert plan.agent.tpu.slice_id == "s2"
+        # and if no slice serves the role, it is all-or-nothing, not a wedge
+        plan2, outcome2 = self.ev.evaluate(r, plain, [], ReservationLedger())
+        assert plan2 is None
+        assert any("all-or-nothing" in m for m in outcome2.failure_reasons())
+
+    def test_infeasible_placement_slice_excluded_from_assignment(self):
+        # same wedge via a static placement rule: slice s1 sorts first but
+        # its hosts sit in the wrong zone
+        from dataclasses import replace as dc_replace
+        from dcos_commons_tpu.matching.placement import parse_marathon_constraints
+        rule = parse_marathon_constraints('[["zone", "IS", "zone-b"]]')
+        pod = dc_replace(self.spec.pod("worker"), placement_rule=rule)
+        r = PodInstanceRequirement(PodInstance(pod, 0), ("train",))
+        wrong = [dc_replace(tpu_agent(1, "s1"), zone="zone-a"),
+                 dc_replace(tpu_agent(2, "s1"), zone="zone-a")]
+        right = [dc_replace(tpu_agent(3, "s2"), zone="zone-b"),
+                 dc_replace(tpu_agent(4, "s2"), zone="zone-b")]
+        plan, _ = self.ev.evaluate(r, wrong + right, [], self.ledger)
+        assert plan is not None
+        assert plan.agent.tpu.slice_id == "s2"
+
+    def test_pinned_relaunch_ignores_feasibility_precheck(self):
+        # a transient relaunch pinned to its reserved agent must not be
+        # wedged by the capability pre-check even if the agent's inventory
+        # drifted (zone changed, profile withdrawn) — the per-agent
+        # pipeline waives those gates for pinned relaunches
+        from dataclasses import replace as dc_replace
+        from dcos_commons_tpu.matching.placement import parse_marathon_constraints
+        rule = parse_marathon_constraints('[["zone", "IS", "zone-b"]]')
+        pod = dc_replace(self.spec.pod("worker"), placement_rule=rule)
+        agents = [dc_replace(tpu_agent(1, "s1"), zone="zone-a"),
+                  dc_replace(tpu_agent(2, "s1"), zone="zone-a")]
+        self.ledger.add(Reservation("worker-0", "wres", "t1", cpus=4,
+                                    memory_mb=8192, tpus=4))
+        r = PodInstanceRequirement(PodInstance(pod, 0), ("train",),
+                                   recovery_type=RecoveryType.TRANSIENT)
+        plan, outcome = self.ev.evaluate(r, agents, [], self.ledger)
+        assert plan is not None, outcome.failure_reasons()
+        assert plan.agent.agent_id == "t1"
+
+    def test_infeasible_profile_slice_excluded_from_assignment(self):
+        # same wedge via volume disk profiles: s1's hosts lack the profile
+        # the pod's resource-set volume requires
+        from dataclasses import replace as dc_replace
+        spec = load_service_yaml_str("""
+name: jax
+pods:
+  worker:
+    count: 2
+    tpu: {chips: 4, topology: v4-16}
+    resource-sets:
+      wres:
+        cpus: 4
+        memory: 8192
+        tpus: 4
+        volumes:
+          - {path: ckpt, size: 512, type: MOUNT, profiles: [ssd]}
+    tasks:
+      train: {goal: RUNNING, cmd: python train.py, resource-set: wres}
+""", {})
+        r = req(spec, "worker", 0)
+        plain = [tpu_agent(1, "s1"), tpu_agent(2, "s1")]
+        ssd = [dc_replace(tpu_agent(3, "s2"), volume_profiles=("ssd",)),
+               dc_replace(tpu_agent(4, "s2"), volume_profiles=("ssd",))]
+        plan, _ = self.ev.evaluate(r, plain + ssd, [], self.ledger)
+        assert plan is not None
+        assert plan.agent.tpu.slice_id == "s2"
+
     def test_chips_accounted_in_ledger(self):
         agents = [tpu_agent(1, "s1"), tpu_agent(2, "s1")]
         plan, _ = self.ev.evaluate(req(self.spec, "worker", 0), agents, [], self.ledger)

@@ -233,8 +233,65 @@ pods:
         volume: {path: data, size: 32}
 """
         import pytest
-        with pytest.raises(ValueError, match="both pod and resource-set"):
+        with pytest.raises(ValueError, match="declared by both"):
             load_service_yaml_str(yml, {})
+
+    def test_duplicate_pod_volume_paths_rejected(self):
+        yml = """
+name: svc
+pods:
+  hello:
+    count: 1
+    volumes:
+      - {path: data, size: 64}
+      - {path: data, size: 128}
+    tasks:
+      server: {goal: RUNNING, cmd: run, cpus: 0.1, memory: 32}
+"""
+        import pytest
+        with pytest.raises(ValueError, match="declared by both"):
+            load_service_yaml_str(yml, {})
+
+    def test_host_volume_shadowing_data_volume_rejected(self):
+        yml = """
+name: svc
+pods:
+  hello:
+    count: 1
+    volume: {path: data, size: 64}
+    host-volumes:
+      etc: {host-path: /etc/config, container-path: data}
+    tasks:
+      server: {goal: RUNNING, cmd: run, cpus: 0.1, memory: 32}
+"""
+        import pytest
+        with pytest.raises(ValueError, match="declared by both"):
+            load_service_yaml_str(yml, {})
+
+    def test_rs_volumes_may_share_a_path(self):
+        # reference enable-disable.yml: two tasks' resource sets both mount
+        # the same container path — legal
+        yml = """
+name: svc
+pods:
+  hello:
+    count: 1
+    tasks:
+      a:
+        goal: RUNNING
+        cmd: run
+        cpus: 0.1
+        memory: 32
+        volume: {path: data, size: 32}
+      b:
+        goal: RUNNING
+        cmd: run
+        cpus: 0.1
+        memory: 32
+        volume: {path: data, size: 32}
+"""
+        spec = load_service_yaml_str(yml, {})
+        assert spec.pod("hello") is not None
 
 
 def test_multislice_requires_gang():

@@ -55,10 +55,15 @@ def _is_templated(url: str) -> bool:
 def lint_framework(root: str) -> List[Tuple[str, int, str]]:
     violations: List[Tuple[str, int, str]] = []
     for path in sorted(_iter_files(root)):
+        # '*' only marks a comment in C-style block continuations; in
+        # YAML/JSON it begins alias/list lines that are live config, so a
+        # URL there must not escape the lint
+        star_is_comment = not path.endswith((".yml", ".yaml", ".json"))
+        comment_leads = ("#", "//", "*") if star_is_comment else ("#", "//")
         with open(path, encoding="utf-8", errors="ignore") as f:
             for lineno, line in enumerate(f, 1):
                 stripped = line.strip()
-                if stripped.startswith(("#", "//", "*")):
+                if stripped.startswith(comment_leads):
                     continue  # comments/docs may cite URLs
                 for url in _URL.findall(line):
                     if _is_templated(url):
