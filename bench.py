@@ -1,10 +1,21 @@
-"""Benchmark: ResNet-50 training throughput (images/sec/chip).
+"""Benchmark: ResNet-50 + Llama train throughput, with MFU and hardware.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-The reference publishes no perf numbers (BASELINE.md), so vs_baseline is
-measured against the BASELINE.json north-star target recorded in
-BENCH_BASELINE (first run's value persisted would be the anchor); absent an
-anchor we report 1.0.
+Prints ONE JSON line whose primary fields are
+``{"metric", "value", "unit", "vs_baseline"}`` (the driver contract).
+Additional fields make the number legible without recomputation:
+
+- ``chip`` / ``peak_tflops_bf16``: detected TPU generation and its bf16
+  peak, so MFU is auditable.
+- ``model_flops_per_step`` / ``mfu``: analytic training FLOPs (ResNet-50:
+  ~12.3 GFLOP/image, 3x the 4.09 GFLOP forward; transformer: 6*N*tokens)
+  against the chip's peak.
+- ``llama_*``: the flagship Llama train step (the model this framework is
+  for) measured the same way — tokens/sec/chip and MFU.
+
+``vs_baseline`` is a real ratio against the prior round's anchor: the
+``BENCH_BASELINE`` env var wins, else the committed ``BENCH_BASELINE.json``,
+else 1.0 (no anchor). The reference publishes no perf numbers
+(BASELINE.md), so the anchor protocol is self-referential by design.
 """
 
 import json
@@ -13,11 +24,52 @@ import os
 import sys
 import time
 
+# bf16 peak TFLOP/s per chip by TPU generation (public spec sheets)
+_PEAK_TFLOPS = (
+    ("v6", 918.0),        # Trillium
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),   # v5e device_kind is "TPU v5 lite"
+    ("v5e", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+)
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.09e9  # fwd 4.09 GFLOP @224, bwd ~2x
 
+
+def _chip_info(jax):
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", d.platform)
+    peak = None
+    for key, tflops in _PEAK_TFLOPS:
+        if key in kind.lower():
+            peak = tflops
+            break
+    return kind, peak
+
+
+def _read_anchor() -> float:
+    """BENCH_BASELINE env (img/s/chip) wins; else BENCH_BASELINE.json."""
+    raw = os.environ.get("BENCH_BASELINE", "")
+    try:
+        v = float(raw)
+        if v > 0 and math.isfinite(v):
+            return v
+    except ValueError:
+        pass
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_BASELINE.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            v = float(json.load(f)["resnet50_train_images_per_sec_per_chip"])
+        if v > 0 and math.isfinite(v):
+            return v
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    return 0.0
+
+
+def bench_resnet(jax, jnp, n_chips):
     from dcos_commons_tpu.models import resnet, train
 
     cfg = resnet.ResNetConfig(depth=50, n_classes=1000)
@@ -46,22 +98,90 @@ def main() -> None:
                                              (state, (x, y)))
     float(out["loss"])
     dt = time.perf_counter() - t0
+    ips_per_chip = batch * n_steps / dt / n_chips
+    return ips_per_chip, RESNET50_TRAIN_FLOPS_PER_IMAGE * batch
+
+
+def bench_llama(jax, jnp, n_chips):
+    """Flagship llama train step, ~0.4B params bf16 (fits one chip with
+    Adam state; larger presets shard over the mesh in production)."""
+    from dcos_commons_tpu.models import llama, train
+
+    # batch 16 x seq 512 is the sweet spot measured on v5e (53.8% MFU);
+    # larger shapes trip the tunneled backend's compile-helper subprocess
+    # (HTTP 500), not HBM — see docs/performance.md
+    cfg = llama.LlamaConfig(vocab_size=32000, dim=1536, n_layers=8,
+                            n_heads=12, n_kv_heads=6, ffn_dim=4096,
+                            max_seq=512, remat=False, attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.key(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    batch, seq = 16, 512
+    toks = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                              cfg.vocab_size)
+
+    opt = train.make_optimizer(lr=3e-4, warmup=10, decay_steps=1000)
+    step = train.make_train_step(
+        lambda p, b: llama.loss_fn(cfg, p, b), opt)
+    opt_state = opt.init(params)
+
+    params, opt_state, out = step(params, opt_state, toks)
+    float(out["loss"])
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, out = step(params, opt_state, toks)
+    float(out["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * (seq - 1)  # next-token loss consumes S-1
+    tok_per_sec_chip = tokens_per_step * n_steps / dt / n_chips
+    flops_per_step = 6.0 * n_params * tokens_per_step
+    flops_per_sec_chip = flops_per_step * n_steps / dt / n_chips
+    return tok_per_sec_chip, flops_per_sec_chip, flops_per_step, n_params
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
 
     n_chips = jax.device_count()
-    ips_per_chip = batch * n_steps / dt / n_chips
-    # anchor: BENCH_BASELINE env (img/s/chip from a prior round's
-    # BENCH_r{N}.json) makes vs_baseline a real ratio; absent -> 1.0
-    try:
-        baseline = float(os.environ.get("BENCH_BASELINE", "") or 0.0)
-    except ValueError:
-        baseline = 0.0
-    valid = baseline > 0 and math.isfinite(baseline)
-    print(json.dumps({
+    chip, peak_tflops = _chip_info(jax)
+
+    ips_per_chip, resnet_flops_step = bench_resnet(jax, jnp, n_chips)
+    resnet_mfu = (ips_per_chip * RESNET50_TRAIN_FLOPS_PER_IMAGE
+                  / (peak_tflops * 1e12)) if peak_tflops else None
+
+    result = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips_per_chip, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(ips_per_chip / baseline, 3) if valid else 1.0,
-    }))
+        "vs_baseline": 1.0,
+        "chip": chip,
+        "n_chips": n_chips,
+        "peak_tflops_bf16": peak_tflops,
+        "model_flops_per_step": resnet_flops_step,
+        "mfu": round(resnet_mfu, 4) if resnet_mfu is not None else None,
+    }
+
+    anchor = _read_anchor()
+    if anchor:
+        result["vs_baseline"] = round(ips_per_chip / anchor, 3)
+
+    try:
+        tok_s, flops_s, llama_flops_step, n_params = bench_llama(
+            jax, jnp, n_chips)
+        result.update({
+            "llama_train_tokens_per_sec_per_chip": round(tok_s, 1),
+            "llama_params": n_params,
+            "llama_model_flops_per_step": llama_flops_step,
+            "llama_mfu": (round(flops_s / (peak_tflops * 1e12), 4)
+                          if peak_tflops else None),
+        })
+    except Exception as e:  # llama is supplementary; never lose the line
+        result["llama_error"] = str(e)[:200]
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -56,9 +56,12 @@ def lint_framework(root: str) -> List[Tuple[str, int, str]]:
     violations: List[Tuple[str, int, str]] = []
     for path in sorted(_iter_files(root)):
         # '*' only marks a comment in C-style block continuations; in
-        # YAML/JSON it begins alias/list lines that are live config, so a
-        # URL there must not escape the lint
-        star_is_comment = not path.endswith((".yml", ".yaml", ".json"))
+        # YAML/JSON (including mustache templates thereof) it begins
+        # alias/list lines that are live config, so a URL there must not
+        # escape the lint
+        effective = path[:-len(".mustache")] if path.endswith(".mustache") \
+            else path
+        star_is_comment = not effective.endswith((".yml", ".yaml", ".json"))
         comment_leads = ("#", "//", "*") if star_is_comment else ("#", "//")
         with open(path, encoding="utf-8", errors="ignore") as f:
             for lineno, line in enumerate(f, 1):
