@@ -20,7 +20,12 @@ class Client:
              root: bool = False):
         prefix = "/v1" if root else self.prefix
         url = f"{self.base}{prefix}/{path.lstrip('/')}"
-        req = urllib.request.Request(url, method=method, data=body)
+        # auth-header plumbing (reference cli/client/http.go): TPU_AUTH_TOKEN
+        # or TPU_AUTH_UID/TPU_AUTH_SECRET login against TPU_SCHEDULER
+        from ..security.auth import auth_headers_from_env
+        req = urllib.request.Request(
+            url, method=method, data=body,
+            headers=auth_headers_from_env(self.base))
         try:
             with urllib.request.urlopen(req, timeout=30) as r:
                 return r.status, json.loads(r.read().decode() or "null")
@@ -210,6 +215,17 @@ def main(argv=None) -> int:
     client = Client(args.url, args.service)
     try:
         return args.fn(client, args)
+    except urllib.error.HTTPError as e:
+        # reachable but refused — distinguish bad credentials (the login
+        # round-trip raises before Client.call's own HTTPError handling)
+        if e.code in (401, 403):
+            print(f"error: authentication failed against {args.url}: "
+                  f"HTTP {e.code} (check TPU_AUTH_UID/TPU_AUTH_SECRET/"
+                  "TPU_AUTH_TOKEN)", file=sys.stderr)
+            return 1
+        print(f"error: scheduler at {args.url} answered HTTP {e.code}: {e}",
+              file=sys.stderr)
+        return 2
     except urllib.error.URLError as e:
         print(f"error: cannot reach scheduler at {args.url}: {e}",
               file=sys.stderr)

@@ -218,12 +218,14 @@ class ApiServer:
     """
 
     def __init__(self, scheduler=None, port: int = 0, metrics=None,
-                 host: str = "127.0.0.1", cluster=None, multi=None):
+                 host: str = "127.0.0.1", cluster=None, multi=None,
+                 auth=None):
         self._services: Dict[str, _Routes] = {}
         self._default: Optional[_Routes] = None
         self._metrics = metrics
         self._cluster = cluster  # RemoteCluster: agent transport endpoint
         self._multi = multi  # MultiServiceScheduler: dynamic add/remove
+        self._auth = auth  # security.auth.Authenticator (None = open)
         if scheduler is not None:
             self._default = _Routes(scheduler, metrics)
         outer = self
@@ -255,7 +257,8 @@ class ApiServer:
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else None
                     code, payload = outer._dispatch(method, parsed.path,
-                                                    params, body)
+                                                    params, body,
+                                                    dict(self.headers))
                     self._respond(code, payload)
                 except ApiError as e:
                     self._respond(e.code, {"error": e.message})
@@ -287,10 +290,17 @@ class ApiServer:
         self._services.pop(name, None)
 
     def _dispatch(self, method: str, path: str, params: dict,
-                  body: Optional[bytes]) -> Tuple[int, object]:
+                  body: Optional[bytes],
+                  headers: Optional[dict] = None) -> Tuple[int, object]:
         if not path.startswith("/v1/"):
             return 404, {"error": "not under /v1/"}
         rest = path[len("/v1/"):].strip("/")
+        if rest == "auth/login":
+            return self._login(method, body)
+        if self._auth is not None:
+            denied = self._authorize(method, rest, headers or {})
+            if denied is not None:
+                return denied
         if self._metrics is not None and rest in ("metrics",
                                                   "metrics/prometheus"):
             if rest.endswith("prometheus"):
@@ -314,6 +324,53 @@ class ApiServer:
         if self._default is None:
             return 404, {"error": "no default service mounted"}
         return self._default.dispatch(method, rest, params, body)
+
+    # -- authentication (reference: adminrouter + IAM service accounts;
+    # here security/auth.py Authenticator) --------------------------------
+
+    def _login(self, method: str, body: Optional[bytes]) -> Tuple[int, object]:
+        from ..security.auth import AuthError
+        if self._auth is None:
+            return 404, {"error": "authentication not enabled"}
+        if method != "POST":
+            return 404, {"error": "POST {uid, secret} to /v1/auth/login"}
+        try:
+            data = json.loads(body.decode()) if body else {}
+            uid, secret = str(data["uid"]), str(data["secret"])
+        except (ValueError, KeyError, AttributeError, TypeError):
+            return 400, {"error": "body must be JSON {uid, secret}"}
+        try:
+            token = self._auth.login(uid, secret)
+        except AuthError as e:
+            return e.code, {"error": e.message}
+        return 200, {"token": token,
+                     "ttl_s": self._auth.authority.ttl_s}
+
+    def _authorize(self, method: str, rest: str,
+                   headers: dict) -> Optional[Tuple[int, object]]:
+        """None when allowed; (status, payload) when denied.
+
+        /v1/health stays open (load-balancer probes, reference
+        HealthResource behind adminrouter's /service proxy is the same
+        judgement call); the agent-transport POSTs (register, poll) take
+        the ``agent`` scope; everything else — including the fleet
+        inventory GETs under /v1/agents — requires ``operator``, so a
+        leaked fleet credential cannot enumerate the cluster.
+        """
+        from ..security.auth import (AuthError, SCOPE_AGENT,
+                                     SCOPE_OPERATOR)
+        if method == "GET" and rest == "health":
+            return None
+        scope = SCOPE_OPERATOR
+        if method == "POST" and (
+                rest == "agents/register"
+                or re.fullmatch(r"agents/[^/]+/poll", rest)):
+            scope = SCOPE_AGENT
+        try:
+            self._auth.authorize(headers, scope)
+        except AuthError as e:
+            return e.code, {"error": e.message}
+        return None
 
     def _dispatch_multi(self, method: str, name: str,
                         body: Optional[bytes]) -> Tuple[int, object]:

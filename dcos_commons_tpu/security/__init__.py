@@ -10,14 +10,25 @@ artifacts to sandboxes through the existing config-template channel that
 ``tpu-bootstrap`` renders.
 """
 
+from .auth import (Authenticator, AuthError, CachedTokenProvider, Principal,
+                   ServiceAccount, TokenAuthority, auth_headers_from_env,
+                   generate_auth_config)
 from .ca import CertificateAuthority
 from .secrets import SecretsStore
 from .tls import TLSArtifactPaths, TLSProvisioner, certificate_names
 
 __all__ = [
+    "AuthError",
+    "Authenticator",
+    "CachedTokenProvider",
     "CertificateAuthority",
+    "Principal",
     "SecretsStore",
+    "ServiceAccount",
     "TLSArtifactPaths",
     "TLSProvisioner",
+    "TokenAuthority",
+    "auth_headers_from_env",
     "certificate_names",
+    "generate_auth_config",
 ]

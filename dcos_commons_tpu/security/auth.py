@@ -1,0 +1,275 @@
+"""Control-plane authentication: service accounts + HMAC bearer tokens.
+
+The reference runs behind DC/OS adminrouter and mints service-account IAM
+tokens (``sdk/scheduler/.../dcos/auth/CachedTokenProvider.java:1``,
+``dcos/clients/ServiceAccountIAMTokenClient.java:1``; CLI auth-header
+plumbing in ``cli/client/http.go``). Here the scheduler is its own
+authority: it holds a signing secret, service accounts log in with their
+account secret at ``POST /v1/auth/login`` and receive a short-lived
+HMAC-signed bearer token, and every other route requires
+``Authorization: token=<...>`` (the DC/OS header form; ``Bearer`` is
+also accepted).
+
+Scopes:
+
+* ``operator`` — the full control surface (plans, pods, update, secrets,
+  multi, ...). What the CLI and integration tooling use.
+* ``agent`` — only the agent transport (``/v1/agents/register``,
+  ``/v1/agents/<id>/poll``). A compromised agent credential cannot push a
+  config update or read secrets.
+
+Config-template/file artifacts ship inline in launch commands (see
+``RemoteCluster.launch``), so there is no scheduler-side artifact fetch
+needing a third scope — the task sandbox never calls back into the
+control plane.
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import hashlib
+import json
+import os
+import secrets as _secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Mapping, Optional, Sequence, Tuple
+
+SCOPE_OPERATOR = "operator"
+SCOPE_AGENT = "agent"
+
+_HEADER = "Authorization"
+
+
+def _b64e(raw: bytes) -> str:
+    return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+
+def _b64d(text: str) -> bytes:
+    pad = "=" * (-len(text) % 4)
+    return base64.urlsafe_b64decode(text + pad)
+
+
+@dataclass(frozen=True)
+class Principal:
+    uid: str
+    scopes: Tuple[str, ...]
+
+    def has_scope(self, scope: str) -> bool:
+        return scope in self.scopes or SCOPE_OPERATOR in self.scopes
+
+
+class TokenAuthority:
+    """Mints and verifies HMAC-SHA256 bearer tokens (a minimal JWS)."""
+
+    def __init__(self, signing_secret: bytes, ttl_s: float = 3600.0):
+        if not signing_secret:
+            raise ValueError("signing secret must be non-empty")
+        self._secret = signing_secret
+        self.ttl_s = ttl_s
+
+    def mint(self, uid: str, scopes: Sequence[str],
+             ttl_s: Optional[float] = None) -> str:
+        payload = _b64e(json.dumps({
+            "uid": uid,
+            "scopes": list(scopes),
+            "exp": time.time() + (self.ttl_s if ttl_s is None else ttl_s),
+        }, sort_keys=True).encode())
+        sig = hmac.new(self._secret, payload.encode(),
+                       hashlib.sha256).digest()
+        return f"{payload}.{_b64e(sig)}"
+
+    def verify(self, token: str) -> Optional[Principal]:
+        """Principal for a valid unexpired token, else None."""
+        try:
+            payload_b64, sig_b64 = token.split(".", 1)
+            expect = hmac.new(self._secret, payload_b64.encode(),
+                              hashlib.sha256).digest()
+            if not hmac.compare_digest(expect, _b64d(sig_b64)):
+                return None
+            payload = json.loads(_b64d(payload_b64))
+            if float(payload["exp"]) < time.time():
+                return None
+            return Principal(uid=str(payload["uid"]),
+                             scopes=tuple(payload["scopes"]))
+        except (ValueError, KeyError, TypeError):
+            return None
+
+
+class AuthError(Exception):
+    """401 (no/bad credentials) or 403 (insufficient scope)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+@dataclass
+class ServiceAccount:
+    uid: str
+    secret: str
+    scopes: Tuple[str, ...] = (SCOPE_OPERATOR,)
+
+
+@dataclass
+class Authenticator:
+    """Server-side auth: accounts + login + per-request authorization."""
+
+    authority: TokenAuthority
+    accounts: Dict[str, ServiceAccount] = field(default_factory=dict)
+
+    @classmethod
+    def from_config(cls, data: Mapping) -> "Authenticator":
+        """Build from the auth-file schema::
+
+            {"signing_secret": "...", "ttl_s": 3600,
+             "accounts": {"ops": {"secret": "...", "scopes": ["operator"]},
+                          "fleet": {"secret": "...", "scopes": ["agent"]}}}
+        """
+        authority = TokenAuthority(
+            str(data["signing_secret"]).encode(),
+            ttl_s=float(data.get("ttl_s", 3600.0)))
+        accounts = {}
+        for uid, acct in (data.get("accounts") or {}).items():
+            accounts[uid] = ServiceAccount(
+                uid=uid, secret=str(acct["secret"]),
+                scopes=tuple(acct.get("scopes") or (SCOPE_OPERATOR,)))
+        return cls(authority=authority, accounts=accounts)
+
+    @classmethod
+    def from_file(cls, path: str) -> "Authenticator":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_config(json.load(f))
+
+    @classmethod
+    def from_env(cls) -> Optional["Authenticator"]:
+        """``TPU_AUTH_FILE`` names the accounts file; unset -> auth off."""
+        path = os.environ.get("TPU_AUTH_FILE")
+        return cls.from_file(path) if path else None
+
+    def login(self, uid: str, secret: str) -> str:
+        acct = self.accounts.get(uid)
+        # constant-time compare even for unknown accounts
+        expect = acct.secret if acct is not None else _secrets.token_hex(16)
+        if not hmac.compare_digest(expect.encode(), str(secret).encode()) \
+                or acct is None:
+            raise AuthError(401, "bad service-account credentials")
+        return self.authority.mint(acct.uid, acct.scopes)
+
+    def authorize(self, headers: Mapping[str, str],
+                  scope: str) -> Principal:
+        """Principal from the Authorization header, or AuthError."""
+        raw = headers.get(_HEADER) or headers.get(_HEADER.lower()) or ""
+        token = ""
+        if raw.startswith("token="):
+            token = raw[len("token="):]
+        elif raw.lower().startswith("bearer "):
+            token = raw[len("bearer "):]
+        if not token:
+            raise AuthError(401, "missing Authorization header "
+                                 "(token=<...> or Bearer <...>)")
+        principal = self.authority.verify(token.strip())
+        if principal is None:
+            raise AuthError(401, "invalid or expired token")
+        if not principal.has_scope(scope):
+            raise AuthError(
+                403, f"account {principal.uid!r} lacks scope {scope!r}")
+        return principal
+
+
+def generate_auth_config(operator_uid: str = "ops",
+                         agent_uid: str = "fleet",
+                         ttl_s: float = 3600.0) -> dict:
+    """Fresh accounts-file content with random secrets (setup helper;
+    ``python -m dcos_commons_tpu.security.auth`` prints one)."""
+    return {
+        "signing_secret": _secrets.token_hex(32),
+        "ttl_s": ttl_s,
+        "accounts": {
+            operator_uid: {"secret": _secrets.token_hex(24),
+                           "scopes": [SCOPE_OPERATOR]},
+            agent_uid: {"secret": _secrets.token_hex(24),
+                        "scopes": [SCOPE_AGENT]},
+        },
+    }
+
+
+class CachedTokenProvider:
+    """Client-side token cache + refresh (reference
+    ``dcos/auth/CachedTokenProvider.java:1``): logs in lazily, re-logs in
+    when the token is within ``refresh_margin_s`` of expiry."""
+
+    def __init__(self, base_url: str, uid: str, secret: str,
+                 refresh_margin_s: float = 60.0):
+        self._base_url = base_url.rstrip("/")
+        self._uid = uid
+        self._secret = secret
+        self._margin = refresh_margin_s
+        self._lock = threading.Lock()
+        self._token: Optional[str] = None
+        self._exp: float = 0.0
+
+    def _fetch(self) -> str:
+        import urllib.request
+        req = urllib.request.Request(
+            f"{self._base_url}/v1/auth/login", method="POST",
+            data=json.dumps({"uid": self._uid,
+                             "secret": self._secret}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            token = json.loads(r.read().decode())["token"]
+        try:
+            self._exp = float(json.loads(
+                _b64d(token.split(".", 1)[0]))["exp"])
+        except (ValueError, KeyError):
+            self._exp = time.time() + 300.0
+        return token
+
+    def token(self) -> str:
+        with self._lock:
+            if self._token is None or time.time() > self._exp - self._margin:
+                self._token = self._fetch()
+            return self._token
+
+    def invalidate(self) -> None:
+        """Drop the cached token (after a 401: forces re-login)."""
+        with self._lock:
+            self._token = None
+
+    def headers(self) -> Dict[str, str]:
+        return {_HEADER: f"token={self.token()}"}
+
+
+def auth_headers_from_env(base_url: Optional[str] = None) -> Dict[str, str]:
+    """Client-side convenience used by the CLI and test lib:
+    ``TPU_AUTH_TOKEN`` (pre-minted) wins, else ``TPU_AUTH_UID`` +
+    ``TPU_AUTH_SECRET`` log in against ``base_url`` (default
+    ``TPU_SCHEDULER``) lazily via a module-level provider cache. Returns
+    {} when auth is not configured."""
+    token = os.environ.get("TPU_AUTH_TOKEN")
+    if token:
+        return {_HEADER: f"token={token}"}
+    uid = os.environ.get("TPU_AUTH_UID")
+    secret = os.environ.get("TPU_AUTH_SECRET")
+    base = base_url or os.environ.get("TPU_SCHEDULER",
+                                      "http://127.0.0.1:8080")
+    if not (uid and secret):
+        return {}
+    key = (base, uid)
+    with _provider_lock:
+        provider = _providers.get(key)
+        if provider is None or provider._secret != secret:
+            provider = CachedTokenProvider(base, uid, secret)
+            _providers[key] = provider
+    return provider.headers()
+
+
+_providers: Dict[Tuple[str, str], CachedTokenProvider] = {}
+_provider_lock = threading.Lock()
+
+
+if __name__ == "__main__":  # pragma: no cover - setup convenience
+    print(json.dumps(generate_auth_config(), indent=2))

@@ -20,6 +20,18 @@ from typing import Dict, List, Optional, Sequence
 DEFAULT_TIMEOUT_S = 15 * 60  # reference testing/sdk_plan.py:17
 
 
+def _open(url: str, method: str = "GET", data: Optional[bytes] = None,
+          timeout: float = 30):
+    """urlopen with control-plane auth headers from the environment
+    (TPU_AUTH_TOKEN or TPU_AUTH_UID/TPU_AUTH_SECRET; reference
+    ``cli/client/http.go`` auth-header plumbing)."""
+    from ..security.auth import auth_headers_from_env
+    base = url.split("/v1/", 1)[0]
+    req = urllib.request.Request(url, method=method, data=data,
+                                 headers=auth_headers_from_env(base))
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
 class IntegrationError(AssertionError):
     pass
 
@@ -38,9 +50,8 @@ class ServiceClient:
              root: bool = False):
         prefix = "/v1" if root else self.prefix
         url = f"{self.base}{prefix}/{path.lstrip('/')}"
-        req = urllib.request.Request(url, method=method, data=body)
         try:
-            with urllib.request.urlopen(req, timeout=30) as r:
+            with _open(url, method=method, data=body) as r:
                 return r.status, json.loads(r.read().decode() or "null")
         except urllib.error.HTTPError as e:
             try:
@@ -82,9 +93,8 @@ def install(base_url: str, name: str, yaml_text: str,
     after the install request (for tests asserting a deploy does NOT
     complete)."""
     client = ServiceClient(base_url, service=name)
-    req = urllib.request.Request(f"{base_url}/v1/multi/{name}",
-                                 method="PUT", data=yaml_text.encode())
-    with urllib.request.urlopen(req, timeout=30) as r:
+    with _open(f"{base_url}/v1/multi/{name}", method="PUT",
+               data=yaml_text.encode()) as r:
         assert r.status == 200
     if wait:
         wait_for_deployment(client, timeout_s)
@@ -95,10 +105,8 @@ def uninstall(base_url: str, name: str,
               timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
     """Remove a service and await its disappearance (reference
     ``sdk_install.uninstall``)."""
-    req = urllib.request.Request(f"{base_url}/v1/multi/{name}",
-                                 method="DELETE")
     try:
-        with urllib.request.urlopen(req, timeout=30) as r:
+        with _open(f"{base_url}/v1/multi/{name}", method="DELETE") as r:
             assert r.status == 200
     except urllib.error.HTTPError as e:
         if e.code == 404:
@@ -231,7 +239,7 @@ def pod_restart(client: ServiceClient, pod_instance: str,
 # -- metrics (sdk_metrics.py) -----------------------------------------------
 
 def get_metrics(base_url: str) -> dict:
-    with urllib.request.urlopen(f"{base_url}/v1/metrics", timeout=30) as r:
+    with _open(f"{base_url}/v1/metrics") as r:
         return json.loads(r.read().decode())
 
 
@@ -310,15 +318,14 @@ def wait_for_endpoint(client: ServiceClient, name: str, n_addresses: int = 1,
 def get_agents(base_url: str) -> List[str]:
     """Registered agent ids (reference ``sdk_agents.get_agents`` reading the
     Mesos /slaves state)."""
-    with urllib.request.urlopen(f"{base_url}/v1/agents", timeout=30) as r:
+    with _open(f"{base_url}/v1/agents") as r:
         return json.loads(r.read().decode())
 
 
 def get_agent_info(base_url: str) -> List[dict]:
     """Full agent inventories (resources, TPU topology, fault domain,
     profiles, roles) from ``/v1/agents/info``."""
-    with urllib.request.urlopen(f"{base_url}/v1/agents/info",
-                                timeout=30) as r:
+    with _open(f"{base_url}/v1/agents/info") as r:
         return json.loads(r.read().decode())
 
 

@@ -25,6 +25,7 @@ import time
 
 from dcos_commons_tpu.agent.remote import RemoteCluster
 from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.security import Authenticator
 from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
 from dcos_commons_tpu.scheduler import (MultiServiceScheduler,
                                         ServiceScheduler)
@@ -65,6 +66,8 @@ def main(argv=None) -> int:
     lock = InstanceLock(args.state)  # single-instance gate
     persister = FilePersister(args.state)
     cluster = RemoteCluster()
+    # control-plane auth: TPU_AUTH_FILE names the accounts file
+    _auth = Authenticator.from_env()
 
     if len(args.scenario) == 1:
         # mono-service (reference Main.java runDefaultService path)
@@ -76,7 +79,7 @@ def main(argv=None) -> int:
             lambda env, _name=args.scenario[0]:
             scenarios.load_scenario(_name, env))
         server = ApiServer(scheduler, port=args.port, metrics=metrics,
-                           cluster=cluster)
+                           cluster=cluster, auth=_auth)
         PlanReporter(metrics, scheduler)
         driver = CycleDriver(scheduler, interval_s=args.interval)
     else:
@@ -84,7 +87,7 @@ def main(argv=None) -> int:
         # Main.java:54-82 multi paths + ExampleMultiServiceResource)
         multi = MultiServiceScheduler(persister, cluster, metrics=metrics)
         server = ApiServer(None, port=args.port, metrics=metrics,
-                           cluster=cluster, multi=multi)
+                           cluster=cluster, multi=multi, auth=_auth)
         multi.set_api_server(server)
         for name in args.scenario:
             spec = scenarios.load_scenario(name)

@@ -21,6 +21,7 @@ import time
 
 from dcos_commons_tpu.agent.remote import RemoteCluster
 from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.security import Authenticator
 from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
 from dcos_commons_tpu.scheduler import ServiceScheduler
 from dcos_commons_tpu.scheduler.runner import CycleDriver
@@ -58,12 +59,14 @@ def main(argv=None) -> int:
     lock = InstanceLock(args.state)  # single-instance gate
     persister = FilePersister(args.state)
     cluster = RemoteCluster()
+    # control-plane auth: TPU_AUTH_FILE names the accounts file
+    _auth = Authenticator.from_env()
     spec = scenarios.load_scenario(args.scenario)
     scheduler = ServiceScheduler(spec, persister, cluster, metrics=metrics)
     scheduler.respec = (lambda env, _name=args.scenario:
                         scenarios.load_scenario(_name, env))
     server = ApiServer(scheduler, port=args.port, metrics=metrics,
-                       cluster=cluster)
+                       cluster=cluster, auth=_auth)
     PlanReporter(metrics, scheduler)
     driver = CycleDriver(scheduler, interval_s=args.interval)
 

@@ -39,6 +39,7 @@
 #include <string>
 #include <vector>
 
+#include "../common/auth.hpp"
 #include "../common/http.hpp"
 #include "../common/json.hpp"
 
@@ -187,8 +188,21 @@ class Agent {
 
  private:
   Config cfg_;
+  tpu::AuthSession auth_{cfg_.scheduler_url};
   std::map<std::string, RunningTask> tasks_;  // task_id -> state
   std::vector<Json> pending_statuses_;
+
+  // POST with the control-plane credential; one re-login retry on 401
+  // (token expiry mid-run), mirroring CachedTokenProvider semantics.
+  tpu::HttpResponse authed_post(const std::string& url,
+                                const std::string& body) {
+    auto resp = tpu::http_post(url, body, 30, auth_.token());
+    if (resp.status == 401 && auth_.can_relogin()) {
+      auth_.invalidate();
+      resp = tpu::http_post(url, body, 30, auth_.token());
+    }
+    return resp;
+  }
 
   // -- registration -----------------------------------------------------
 
@@ -228,7 +242,7 @@ class Agent {
     std::string url = cfg_.scheduler_url + "/v1/agents/register";
     for (int attempt = 0; attempt < 120; ++attempt) {
       try {
-        auto resp = tpu::http_post(url, inventory().dump());
+        auto resp = authed_post(url, inventory().dump());
         if (resp.status == 200 &&
             Json::parse(resp.body).get("ok").as_bool()) {
           std::cerr << "[tpu-agent] registered " << cfg_.agent_id
@@ -261,7 +275,7 @@ class Agent {
         cfg_.scheduler_url + "/v1/agents/" + cfg_.agent_id + "/poll";
     Json reply;
     try {
-      auto resp = tpu::http_post(url, body.dump());
+      auto resp = authed_post(url, body.dump());
       if (resp.status != 200) {
         std::cerr << "[tpu-agent] poll HTTP " << resp.status << "\n";
         return true;  // transient; keep statuses queued

@@ -13,6 +13,7 @@
 #include <string>
 #include <vector>
 
+#include "../common/auth.hpp"
 #include "../common/http.hpp"
 #include "../common/json.hpp"
 
@@ -21,6 +22,11 @@ namespace {
 struct Ctx {
   std::string base = "http://127.0.0.1:8080";
   std::string prefix = "/v1";
+  // control-plane credential (TPU_AUTH_TOKEN or TPU_AUTH_UID/SECRET env;
+  // reference cli/client/http.go auth-header plumbing)
+  mutable tpu::AuthSession* auth = nullptr;
+
+  std::string token() const { return auth ? auth->token() : ""; }
 };
 
 int emit(const tpu::HttpResponse& resp) {
@@ -34,12 +40,14 @@ int emit(const tpu::HttpResponse& resp) {
 }
 
 int get(const Ctx& ctx, const std::string& path) {
-  return emit(tpu::http_get(ctx.base + ctx.prefix + "/" + path));
+  return emit(tpu::http_get(ctx.base + ctx.prefix + "/" + path, 30,
+                            ctx.token()));
 }
 
 int post(const Ctx& ctx, const std::string& path,
          const std::string& body = "") {
-  return emit(tpu::http_post(ctx.base + ctx.prefix + "/" + path, body));
+  return emit(tpu::http_post(ctx.base + ctx.prefix + "/" + path, body, 30,
+                             ctx.token()));
 }
 
 void usage() {
@@ -79,6 +87,8 @@ int main(int argc, char** argv) {
     usage();
     return 2;
   }
+  tpu::AuthSession auth(ctx.base);  // after --url so login hits the right host
+  ctx.auth = &auth;
 
   // extract --phase/--step/--set/--yaml wherever they appear
   std::string phase, step, yaml_file;

@@ -1,0 +1,109 @@
+// Control-plane authentication for the native clients (agent, CLI).
+//
+// The scheduler mints short-lived HMAC bearer tokens at
+// POST /v1/auth/login (see dcos_commons_tpu/security/auth.py); every
+// other route wants "Authorization: token=<...>". This is the C++ twin of
+// the reference's service-account token plumbing
+// (dcos/auth/CachedTokenProvider.java, cli/client/http.go): log in
+// lazily, cache the token, re-login once on a 401.
+//
+// Credentials come from the environment:
+//   TPU_AUTH_TOKEN        pre-minted token (wins; no login round-trip)
+//   TPU_AUTH_UID          service-account id            } login flow
+//   TPU_AUTH_SECRET       account secret                }
+//   TPU_AUTH_SECRET_FILE  file holding the secret (preferred over env:
+//                         not visible in /proc/<pid>/environ of others)
+// None set => auth disabled (open scheduler), token() returns "".
+
+#pragma once
+
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+#include <string>
+
+#include "http.hpp"
+#include "json.hpp"
+
+namespace tpu {
+
+inline std::string getenv_str(const char* name) {
+  const char* v = std::getenv(name);
+  return v == nullptr ? "" : std::string(v);
+}
+
+class AuthSession {
+ public:
+  explicit AuthSession(const std::string& scheduler_url)
+      : base_(scheduler_url) {
+    fixed_token_ = getenv_str("TPU_AUTH_TOKEN");
+    uid_ = getenv_str("TPU_AUTH_UID");
+    secret_ = getenv_str("TPU_AUTH_SECRET");
+    const std::string secret_file = getenv_str("TPU_AUTH_SECRET_FILE");
+    if (secret_.empty() && !secret_file.empty()) {
+      std::ifstream f(secret_file);
+      std::stringstream ss;
+      ss << f.rdbuf();
+      secret_ = ss.str();
+      // strip trailing newline(s) from `echo secret > file` style writes
+      while (!secret_.empty() &&
+             (secret_.back() == '\n' || secret_.back() == '\r')) {
+        secret_.pop_back();
+      }
+    }
+  }
+
+  bool enabled() const {
+    return !fixed_token_.empty() || (!uid_.empty() && !secret_.empty());
+  }
+
+  // Whether a 401 can be repaired by logging in again (a fixed
+  // TPU_AUTH_TOKEN cannot — retrying it just re-sends the same token).
+  bool can_relogin() const {
+    return fixed_token_.empty() && !uid_.empty() && !secret_.empty();
+  }
+
+  // Current token ("" when auth is disabled). Logs in on first use.
+  std::string token() {
+    if (!fixed_token_.empty()) return fixed_token_;
+    if (!enabled()) return "";
+    if (cached_.empty()) login();
+    return cached_;
+  }
+
+  // Drop the cached token (call after an HTTP 401, then retry once).
+  void invalidate() { cached_.clear(); }
+
+ private:
+  void login() {
+    std::string body = std::string("{\"uid\": \"") + json_escape(uid_) +
+                       "\", \"secret\": \"" + json_escape(secret_) + "\"}";
+    HttpResponse resp = http_post(base_ + "/v1/auth/login", body);
+    if (resp.status != 200) {
+      throw std::runtime_error("auth login failed: HTTP " +
+                               std::to_string(resp.status));
+    }
+    Json reply = Json::parse(resp.body);
+    cached_ = reply.get("token").as_string();
+    if (cached_.empty()) {
+      throw std::runtime_error("auth login returned no token");
+    }
+  }
+
+  static std::string json_escape(const std::string& s) {
+    std::string out;
+    for (char c : s) {
+      if (c == '"' || c == '\\') out.push_back('\\');
+      out.push_back(c);
+    }
+    return out;
+  }
+
+  std::string base_;
+  std::string fixed_token_;
+  std::string uid_;
+  std::string secret_;
+  std::string cached_;
+};
+
+}  // namespace tpu

@@ -55,7 +55,10 @@ inline Url parse_url(const std::string& url) {
 inline HttpResponse http_request(const std::string& method,
                                  const std::string& url,
                                  const std::string& body = "",
-                                 int timeout_s = 30) {
+                                 int timeout_s = 30,
+                                 const std::string& auth = "") {
+  // auth: bearer-token value sent as "Authorization: token=<auth>" —
+  // the scheduler's control-plane credential (see security/auth.py)
   Url u = parse_url(url);
 
   struct addrinfo hints;
@@ -89,7 +92,11 @@ inline HttpResponse http_request(const std::string& method,
                     "Host: " + u.host + ":" + u.port + "\r\n" +
                     "Content-Type: application/json\r\n" +
                     "Content-Length: " + std::to_string(body.size()) +
-                    "\r\n" + "Connection: close\r\n\r\n" + body;
+                    "\r\n";
+  if (!auth.empty()) {
+    req += "Authorization: token=" + auth + "\r\n";
+  }
+  req += "Connection: close\r\n\r\n" + body;
   size_t sent = 0;
   while (sent < req.size()) {
     ssize_t n = send(fd, req.data() + sent, req.size() - sent, 0);
@@ -122,13 +129,15 @@ inline HttpResponse http_request(const std::string& method,
   return out;
 }
 
-inline HttpResponse http_get(const std::string& url, int timeout_s = 30) {
-  return http_request("GET", url, "", timeout_s);
+inline HttpResponse http_get(const std::string& url, int timeout_s = 30,
+                             const std::string& auth = "") {
+  return http_request("GET", url, "", timeout_s, auth);
 }
 
 inline HttpResponse http_post(const std::string& url, const std::string& body,
-                              int timeout_s = 30) {
-  return http_request("POST", url, body, timeout_s);
+                              int timeout_s = 30,
+                              const std::string& auth = "") {
+  return http_request("POST", url, body, timeout_s, auth);
 }
 
 }  // namespace tpu

@@ -1,0 +1,235 @@
+"""Control-plane authentication tests.
+
+Reference behavior being mirrored: DC/OS adminrouter rejects
+unauthenticated control-plane calls, service accounts obtain IAM tokens
+(``dcos/auth/CachedTokenProvider.java:1``,
+``dcos/clients/ServiceAccountIAMTokenClient.java:1``), and the CLI sends
+``Authorization: token=...`` (``cli/client/http.go``).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dcos_commons_tpu.agent import RemoteCluster
+from dcos_commons_tpu.agent.fake import FakeCluster
+from dcos_commons_tpu.testing.simulation import default_agents
+from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler import ServiceScheduler
+from dcos_commons_tpu.security import (Authenticator, AuthError,
+                                       CachedTokenProvider, TokenAuthority,
+                                       generate_auth_config)
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import MemPersister
+
+YML = """
+name: authed
+pods:
+  hello:
+    count: 1
+    tasks:
+      server: {goal: RUNNING, cmd: run, cpus: 0.5, memory: 128}
+"""
+
+
+class TestTokenAuthority:
+    def test_mint_verify_roundtrip(self):
+        auth = TokenAuthority(b"secret", ttl_s=60)
+        tok = auth.mint("ops", ["operator"])
+        p = auth.verify(tok)
+        assert p is not None and p.uid == "ops"
+        assert p.has_scope("operator") and p.has_scope("agent")
+
+    def test_agent_scope_does_not_imply_operator(self):
+        auth = TokenAuthority(b"secret")
+        p = auth.verify(auth.mint("fleet", ["agent"]))
+        assert p.has_scope("agent") and not p.has_scope("operator")
+
+    def test_tampered_token_rejected(self):
+        auth = TokenAuthority(b"secret")
+        tok = auth.mint("ops", ["operator"])
+        payload, sig = tok.split(".")
+        # flip payload content, keep the old signature
+        other = TokenAuthority(b"secret").mint("root", ["operator"])
+        forged = other.split(".")[0] + "." + sig
+        assert auth.verify(forged) is None
+        assert auth.verify(payload + ".AAAA") is None
+        assert auth.verify("garbage") is None
+        assert auth.verify("") is None
+
+    def test_expired_token_rejected(self):
+        auth = TokenAuthority(b"secret", ttl_s=-1)
+        assert auth.verify(auth.mint("ops", ["operator"])) is None
+
+    def test_wrong_key_rejected(self):
+        a, b = TokenAuthority(b"one"), TokenAuthority(b"two")
+        assert b.verify(a.mint("ops", ["operator"])) is None
+
+
+class TestAuthenticator:
+    def setup_method(self):
+        self.auth = Authenticator.from_config(generate_auth_config())
+        self.ops_secret = self.auth.accounts["ops"].secret
+        self.fleet_secret = self.auth.accounts["fleet"].secret
+
+    def test_login_and_authorize(self):
+        tok = self.auth.login("ops", self.ops_secret)
+        p = self.auth.authorize({"Authorization": f"token={tok}"},
+                                "operator")
+        assert p.uid == "ops"
+        # Bearer form accepted too
+        self.auth.authorize({"Authorization": f"Bearer {tok}"}, "operator")
+
+    def test_bad_secret_rejected(self):
+        with pytest.raises(AuthError) as e:
+            self.auth.login("ops", "wrong")
+        assert e.value.code == 401
+        with pytest.raises(AuthError):
+            self.auth.login("nobody", "wrong")
+
+    def test_scope_enforcement(self):
+        tok = self.auth.login("fleet", self.fleet_secret)
+        self.auth.authorize({"Authorization": f"token={tok}"}, "agent")
+        with pytest.raises(AuthError) as e:
+            self.auth.authorize({"Authorization": f"token={tok}"},
+                                "operator")
+        assert e.value.code == 403
+
+    def test_missing_header_is_401(self):
+        with pytest.raises(AuthError) as e:
+            self.auth.authorize({}, "operator")
+        assert e.value.code == 401
+
+
+def _request(url, method="GET", data=None, headers=None):
+    req = urllib.request.Request(url, method=method, data=data,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read().decode() or "null")
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except ValueError:
+            return e.code, {}
+
+
+@pytest.fixture()
+def authed_server():
+    auth = Authenticator.from_config(generate_auth_config())
+    cluster = FakeCluster(default_agents(2))
+    sched = ServiceScheduler(load_service_yaml_str(YML), MemPersister(),
+                             cluster)
+    server = ApiServer(sched, port=0, cluster=cluster, auth=auth)
+    server.start()
+    try:
+        yield sched, auth, f"http://127.0.0.1:{server.port}"
+    finally:
+        server.stop()
+
+
+class TestAuthedApi:
+    def test_unauthenticated_rejected_everywhere(self, authed_server):
+        sched, auth, url = authed_server
+        # operator surface
+        assert _request(f"{url}/v1/plans")[0] == 401
+        assert _request(f"{url}/v1/pod/status")[0] == 401
+        assert _request(f"{url}/v1/update", "POST", b"{}")[0] == 401
+        assert _request(f"{url}/v1/secrets")[0] == 401
+        # agent transport: a fake agent cannot register or poll for
+        # commands (which carry task env incl. secrets)
+        assert _request(f"{url}/v1/agents/register", "POST",
+                        b'{"agent_id": "evil"}')[0] == 401
+        assert _request(f"{url}/v1/agents/evil/poll", "POST", b"{}")[0] == 401
+        assert _request(f"{url}/v1/agents")[0] == 401
+
+    def test_health_stays_open(self, authed_server):
+        _, _, url = authed_server
+        code, _ = _request(f"{url}/v1/health")
+        # 200/202/503 reflect plan state; the point is no 401 for LB probes
+        assert code in (200, 202, 503)
+
+    def test_login_flow_and_operator_access(self, authed_server):
+        sched, auth, url = authed_server
+        secret = auth.accounts["ops"].secret
+        code, body = _request(
+            f"{url}/v1/auth/login", "POST",
+            json.dumps({"uid": "ops", "secret": secret}).encode())
+        assert code == 200 and body["token"]
+        hdr = {"Authorization": f"token={body['token']}"}
+        code, plans = _request(f"{url}/v1/plans", headers=hdr)
+        assert code == 200 and "deploy" in plans
+
+    def test_bad_login_rejected(self, authed_server):
+        _, _, url = authed_server
+        code, _ = _request(f"{url}/v1/auth/login", "POST",
+                           json.dumps({"uid": "ops",
+                                       "secret": "nope"}).encode())
+        assert code == 401
+
+    def test_agent_token_cannot_reach_operator_surface(self, authed_server):
+        sched, auth, url = authed_server
+        tok = auth.login("fleet", auth.accounts["fleet"].secret)
+        hdr = {"Authorization": f"token={tok}"}
+        # even the fleet inventory GETs are operator-only: a leaked agent
+        # credential must not enumerate the cluster
+        assert _request(f"{url}/v1/agents", headers=hdr)[0] == 403
+        assert _request(f"{url}/v1/agents/info", headers=hdr)[0] == 403
+        assert _request(f"{url}/v1/plans", headers=hdr)[0] == 403
+        assert _request(f"{url}/v1/update", "POST", b"{}",
+                        headers=hdr)[0] == 403
+        assert _request(f"{url}/v1/secrets", headers=hdr)[0] == 403
+
+    def test_cached_token_provider(self, authed_server):
+        _, auth, url = authed_server
+        provider = CachedTokenProvider(url, "ops",
+                                       auth.accounts["ops"].secret)
+        h1 = provider.headers()
+        assert _request(f"{url}/v1/plans", headers=h1)[0] == 200
+        assert provider.headers() == h1  # cached, no second login
+        provider.invalidate()
+        assert provider.headers()[list(h1)[0]]  # re-login works
+
+    def test_deploy_completes_with_auth_on(self, authed_server):
+        # auth guards the HTTP surface, not the in-process scheduler loop
+        sched, auth, url = authed_server
+        for _ in range(30):
+            sched.run_cycle()
+            if sched.plan("deploy").status is Status.COMPLETE:
+                break
+        assert sched.plan("deploy").status is Status.COMPLETE
+
+
+class TestAuthedRemoteTransport:
+    """An agent service-account drives the full register/poll protocol."""
+
+    def test_remote_agent_protocol_with_auth(self):
+        auth = Authenticator.from_config(generate_auth_config())
+        cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.01)
+        sched = ServiceScheduler(load_service_yaml_str(YML), MemPersister(),
+                                 cluster)
+        server = ApiServer(sched, port=0, cluster=cluster, auth=auth)
+        server.start()
+        url = f"http://127.0.0.1:{server.port}"
+        try:
+            tok = auth.login("fleet", auth.accounts["fleet"].secret)
+            hdr = {"Authorization": f"token={tok}"}
+            code, body = _request(
+                f"{url}/v1/agents/register", "POST",
+                json.dumps({"agent_id": "a1", "hostname": "h1",
+                            "cpus": 4, "memory_mb": 4096,
+                            "disk_mb": 10000}).encode(), headers=hdr)
+            assert code == 200 and body["ok"]
+            sched.run_cycle()
+            code, body = _request(
+                f"{url}/v1/agents/a1/poll", "POST",
+                json.dumps({"running_task_ids": [],
+                            "statuses": []}).encode(), headers=hdr)
+            assert code == 200
+            assert any(c["type"] == "launch" for c in body["commands"])
+        finally:
+            server.stop()

@@ -710,3 +710,78 @@ def test_multislice_gang_over_real_agents(native_bins, tmp_path):
             except subprocess.TimeoutExpired:
                 p.kill()
         server.stop()
+
+
+def test_authenticated_control_plane_e2e(native_bins, tmp_path):
+    """With auth on: an unauthenticated agent is locked out (401, never
+    registers), a credentialed one logs in via TPU_AUTH_UID/SECRET_FILE,
+    deploys the service, and tpuctl needs the operator account (reference
+    adminrouter + IAM service-account model)."""
+    from dcos_commons_tpu.security import Authenticator, generate_auth_config
+
+    auth = Authenticator.from_config(generate_auth_config())
+    cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.05)
+    sched = ServiceScheduler(load_service_yaml_str(YML), MemPersister(),
+                             cluster)
+    server = ApiServer(sched, port=0, cluster=cluster, auth=auth)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+
+    secret_file = tmp_path / "fleet.secret"
+    secret_file.write_text(auth.accounts["fleet"].secret + "\n")
+
+    def agent_cmd(agent_id):
+        return [str(native_bins / "tpu-agent"), "--scheduler", url,
+                "--agent-id", agent_id, "--hostname", agent_id,
+                "--cpus", "4", "--memory-mb", "4096", "--disk-mb", "10000",
+                "--base-dir", str(tmp_path / agent_id),
+                "--poll-interval", "0.05", "--tpu-chips", "0"]
+
+    bad_env = {k: v for k, v in os.environ.items()
+               if not k.startswith("TPU_AUTH")}
+    good_env = dict(bad_env, TPU_AUTH_UID="fleet",
+                    TPU_AUTH_SECRET_FILE=str(secret_file))
+    intruder = subprocess.Popen(agent_cmd("intruder"), env=bad_env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+    agent = subprocess.Popen(agent_cmd("n0"), env=good_env,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+    try:
+        wait_for(lambda: any(a.agent_id == "n0" for a in cluster.agents()),
+                 message="credentialed agent registration")
+        # the intruder keeps retrying 401s and never appears
+        assert all(a.agent_id != "intruder" for a in cluster.agents())
+
+        drive_to(sched, "deploy", Status.COMPLETE)
+        assert all(a.agent_id != "intruder" for a in cluster.agents())
+
+        # tpuctl without credentials: HTTP 401 surfaces as exit 1
+        r = subprocess.run([str(native_bins / "tpuctl"), "--url", url,
+                            "plan", "list"], env=bad_env,
+                           capture_output=True, text=True)
+        assert r.returncode == 1, r.stdout + r.stderr
+        # with the operator account: works
+        ops_file = tmp_path / "ops.secret"
+        ops_file.write_text(auth.accounts["ops"].secret)
+        r = subprocess.run(
+            [str(native_bins / "tpuctl"), "--url", url, "plan", "list"],
+            env=dict(bad_env, TPU_AUTH_UID="ops",
+                     TPU_AUTH_SECRET_FILE=str(ops_file)),
+            capture_output=True, text=True)
+        assert r.returncode == 0 and "deploy" in r.stdout, (
+            r.stdout + r.stderr)
+        # the agent account must NOT drive operator routes
+        r = subprocess.run(
+            [str(native_bins / "tpuctl"), "--url", url, "plan", "list"],
+            env=good_env, capture_output=True, text=True)
+        assert r.returncode == 1, r.stdout + r.stderr
+    finally:
+        intruder.terminate()
+        agent.terminate()
+        for p in (intruder, agent):
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        server.stop()
