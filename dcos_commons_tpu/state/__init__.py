@@ -1,6 +1,8 @@
 from .persister import (CachingPersister, FilePersister, InstanceLock,
                         LockError, MemPersister, NotFoundError, Persister,
                         PersisterError)
+from .replicated import (QuorumError, ReplicatedLock, ReplicatedPersister,
+                         StateReplicaServer, open_replicated)
 from .reservation_store import ReservationStore
 from .state_store import (ConfigStore, FrameworkStore, GoalOverride,
                           OverrideProgress, SchemaVersionStore, StateStore,

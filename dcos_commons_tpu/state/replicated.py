@@ -1,0 +1,800 @@
+"""Replicated/HA state backend: quorum-replicated Persister + leader lease.
+
+Reference production persistence is ZooKeeper — transactions, ACLs, and a
+distributed instance lock (``curator/CuratorPersister.java:43`` atomic
+``setMany:229``; ``curator/CuratorLocker.java:1``). Losing the scheduler
+host there loses nothing, because state lives in the ZK ensemble. This
+module is the TPU-native equivalent with no external dependency: N small
+**state replica servers** (a durable FilePersister + a write-log index
+behind HTTP) and a client-side :class:`ReplicatedPersister` that commits
+every mutation to a **majority** of them.
+
+Correctness model (primary-backup with client-side quorum + lease fencing):
+
+* There is a single writer at a time — enforced by :class:`ReplicatedLock`,
+  a lease granted by a majority of the same servers (the CuratorLocker
+  analogue), **and fenced server-side**: every ``/apply`` and ``/resync``
+  carries the writer's owner id, and a replica holding an unexpired lease
+  for a different owner rejects it (HTTP 403). Any write majority
+  intersects the majority that granted the current lease, so a deposed
+  ex-leader cannot commit or roll the ensemble back — its writes fail
+  quorum and the client poisons itself.
+* Lease state (owner, wall-clock expiry) is persisted in replica meta, so
+  a replica restart cannot erase a live lease and admit a second writer.
+  A replica's log position can only move backwards under an unexpired
+  lease held by the requester, so even after every lease has expired a
+  resumed ex-leader cannot roll committed writes back — its stale
+  snapshot push is rejected and it poisons itself.
+* Replicas remember a digest of the entry at their head index: a repeat
+  ``/apply`` at the same index only acks when the payload matches (honest
+  retry); two divergent writers at one index surface as a conflict
+  instead of a silent phantom ack.
+* Every mutation is a log entry ``(index, {path: value|None})`` applied
+  atomically by each replica (FilePersister.set_many journal). The client
+  commits when a majority acks; replicas reject out-of-order indexes and
+  are brought back with a full snapshot push (``resync``).
+* A failed-quorum write **poisons the client** (crash-don't-corrupt,
+  the ``CycleDriver`` precedent): the local mirror may be ahead of the
+  ensemble, so every subsequent operation raises until the process is
+  replaced and re-syncs. Log indexes are therefore never reused for
+  different payloads.
+* On open, the client reads ``last_index`` from a majority and adopts the
+  snapshot of the highest index seen. Any two majorities intersect, so the
+  adopted snapshot always contains the last committed write. (A write that
+  died mid-quorum may be adopted or discarded — it was never acked.)
+* Reads are served from the client's in-memory mirror (write-through, like
+  ``storage/PersisterCache.java``) — correct because of the single-writer
+  lease.
+* Optionally every request carries ``X-State-Secret``; replicas configured
+  with a secret reject everything else. Replicas hold the whole scheduler
+  state (including secrets paths) — never expose them on an open network.
+
+A replica is just::
+
+    python -m dcos_commons_tpu.state.replicated --root /data/state-a \\
+        --port 7501 --secret-file /etc/tpu/state.secret
+
+and a scheduler opens::
+
+    ReplicatedPersister(["http://h1:7501", "http://h2:7501", "http://h3:7501"])
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import logging
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+from .persister import (FilePersister, LockError, MemPersister, NotFoundError,
+                        Persister, PersisterError)
+
+log = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# replica server
+
+
+class StateReplicaServer:
+    """One member of the state ensemble: durable KV + write log index +
+    fenced lease grants. Deliberately dumb — coordination is client-side."""
+
+    def __init__(self, root: str, port: int = 0, host: str = "127.0.0.1",
+                 secret: Optional[str] = None):
+        self._store = FilePersister(root)
+        self._meta_path = os.path.join(os.path.abspath(root), ".replica-meta")
+        self._secret = secret
+        self._lock = threading.Lock()
+        self._last_index = 0
+        self._last_digest = ""  # hash of the entry applied at last_index
+        self._lease_owner: Optional[str] = None
+        self._lease_expiry = 0.0  # wall clock: survives restart conservatively
+        self._load_meta()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("replica: " + fmt, *args)
+
+            def _reply(self, code: int, payload: dict) -> None:
+                raw = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def _authed(self) -> bool:
+                if outer._secret is None:
+                    return True
+                got = self.headers.get("X-State-Secret") or ""
+                return hmac.compare_digest(got, outer._secret)
+
+            def do_GET(self):
+                if not self._authed():
+                    self._reply(401, {"error": "bad or missing state secret"})
+                    return
+                if self.path == "/meta":
+                    with outer._lock:
+                        self._reply(200, {"last_index": outer._last_index})
+                elif self.path == "/snapshot":
+                    self._reply(200, outer._snapshot())
+                else:
+                    self._reply(404, {"error": self.path})
+
+            def do_POST(self):
+                if not self._authed():
+                    self._reply(401, {"error": "bad or missing state secret"})
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                try:
+                    body = json.loads(self.rfile.read(length).decode()
+                                      or "{}")
+                except ValueError:
+                    self._reply(400, {"error": "bad JSON"})
+                    return
+                if self.path == "/apply":
+                    self._reply(*outer._apply(body))
+                elif self.path == "/resync":
+                    self._reply(*outer._resync(body))
+                elif self.path == "/lease/acquire":
+                    self._reply(*outer._lease_acquire(body))
+                elif self.path == "/lease/release":
+                    self._reply(*outer._lease_release(body))
+                else:
+                    self._reply(404, {"error": self.path})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- meta persistence (index + lease survive restart) -------------------
+
+    def _load_meta(self) -> None:
+        try:
+            with open(self._meta_path, encoding="utf-8") as f:
+                meta = json.load(f)
+            self._last_index = int(meta["last_index"])
+            self._last_digest = str(meta.get("last_digest") or "")
+            self._lease_owner = meta.get("lease_owner") or None
+            self._lease_expiry = float(meta.get("lease_expiry") or 0.0)
+        except (OSError, ValueError, KeyError, TypeError):
+            self._last_index = 0
+
+    def _save_meta(self) -> None:
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"last_index": self._last_index,
+                       "last_digest": self._last_digest,
+                       "lease_owner": self._lease_owner,
+                       "lease_expiry": self._lease_expiry}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._meta_path)
+
+    # -- fencing ------------------------------------------------------------
+
+    def _fenced(self, owner: str) -> Optional[Tuple[int, dict]]:
+        """403 payload when an unexpired lease is held by someone else.
+        (No lease, or an expired one, fences nothing — lock-less clients
+        such as tests and read-side tools keep working.)"""
+        if self._lease_owner is not None \
+                and time.time() < self._lease_expiry \
+                and owner != self._lease_owner:
+            return 403, {"error": "fenced: lease held by another writer",
+                         "holder": self._lease_owner}
+        return None
+
+    def _holds_lease(self, owner: str) -> bool:
+        return bool(owner) and owner == self._lease_owner \
+            and time.time() < self._lease_expiry
+
+    @staticmethod
+    def _digest(index: int, entries: Mapping[str, Optional[str]]) -> str:
+        raw = json.dumps([index, sorted(entries.items())],
+                         separators=(",", ":")).encode()
+        return hashlib.sha256(raw).hexdigest()
+
+    # -- operations --------------------------------------------------------
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            data = {}
+            for path in self._store.recursive_paths():
+                value = self._store.get_or_none(path)
+                if value is not None:
+                    data[path] = value.hex()
+            return {"last_index": self._last_index,
+                    "last_digest": self._last_digest, "data": data}
+
+    def _apply(self, body: dict) -> Tuple[int, dict]:
+        try:
+            index = int(body["index"])
+            entries = body["entries"]
+            owner = str(body.get("owner") or "")
+        except (KeyError, TypeError, ValueError):
+            return 400, {"error": "need {index, entries}"}
+        with self._lock:
+            denied = self._fenced(owner)
+            if denied is not None:
+                return denied
+            digest = self._digest(index, entries)
+            if index == self._last_index:
+                if digest == self._last_digest:
+                    # duplicate delivery (client retry): already applied
+                    return 200, {"ok": True,
+                                 "last_index": self._last_index}
+                # a DIFFERENT write at our head index: divergent writer —
+                # never phantom-ack it
+                return 409, {"error": "conflicting entry at head index",
+                             "last_index": self._last_index}
+            if index != self._last_index + 1:
+                # missed one or more writes; client must resync us
+                return 409, {"error": "index gap",
+                             "last_index": self._last_index}
+            self._store.set_many({
+                p: (bytes.fromhex(v) if v is not None else None)
+                for p, v in entries.items()})
+            self._last_index = index
+            self._last_digest = digest
+            self._save_meta()
+            return 200, {"ok": True, "last_index": self._last_index}
+
+    def _resync(self, body: dict) -> Tuple[int, dict]:
+        """Adopt a full snapshot (straggler catch-up or new member)."""
+        try:
+            index = int(body["last_index"])
+            data = body["data"]
+            owner = str(body.get("owner") or "")
+        except (KeyError, TypeError, ValueError):
+            return 400, {"error": "need {last_index, data}"}
+        with self._lock:
+            denied = self._fenced(owner)
+            if denied is not None:
+                return denied
+            if index <= self._last_index and not self._holds_lease(owner):
+                # Rolling the log backwards (or rewriting the head) is
+                # only legal for the CURRENT lease holder. Without this, a
+                # resumed ex-leader whose lease (and its successor's) has
+                # expired could erase committed writes with its stale
+                # snapshot.
+                return 409, {"error": "resync would rewind the log; only "
+                                      "the lease holder may do that",
+                             "last_index": self._last_index}
+            self._store.delete_all()
+            if data:
+                self._store.set_many({p: bytes.fromhex(v)
+                                      for p, v in data.items()})
+            self._last_index = index
+            self._last_digest = str(body.get("last_digest") or "")
+            self._save_meta()
+            return 200, {"ok": True, "last_index": self._last_index}
+
+    def _lease_acquire(self, body: dict) -> Tuple[int, dict]:
+        owner = str(body.get("owner") or "")
+        ttl_s = float(body.get("ttl_s") or 10.0)
+        if not owner:
+            return 400, {"error": "need owner"}
+        with self._lock:
+            now = time.time()
+            if self._lease_owner in (None, owner) \
+                    or now >= self._lease_expiry:
+                self._lease_owner = owner
+                self._lease_expiry = now + ttl_s
+                self._save_meta()  # a restart must not forget a live lease
+                return 200, {"granted": True}
+            return 200, {"granted": False, "holder": self._lease_owner,
+                         "remaining_s": round(self._lease_expiry - now, 3)}
+
+    def _lease_release(self, body: dict) -> Tuple[int, dict]:
+        owner = str(body.get("owner") or "")
+        with self._lock:
+            if self._lease_owner == owner:
+                self._lease_owner = None
+                self._lease_expiry = 0.0
+                self._save_meta()
+            return 200, {"ok": True}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="state-replica", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# client
+
+
+def _post(url: str, payload: dict, timeout: float,
+          secret: Optional[str] = None) -> dict:
+    headers = {"Content-Type": "application/json"}
+    if secret is not None:
+        headers["X-State-Secret"] = secret
+    req = urllib.request.Request(
+        url, method="POST", data=json.dumps(payload).encode(),
+        headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _get(url: str, timeout: float, secret: Optional[str] = None) -> dict:
+    headers = {"X-State-Secret": secret} if secret is not None else {}
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+class _Fanout:
+    """Per-endpoint concurrent requests on a long-lived pool.
+
+    One dead replica must cost at most one timeout — never one timeout
+    per write serialized into the scheduler hot path — and steady-state
+    operation must not churn OS threads per call. ``quorum_wait`` returns
+    as soon as ``enough(results-so-far)`` says the verdict is decided;
+    stragglers finish on the pool and are logged, not waited for.
+    """
+
+    def __init__(self, n_endpoints: int):
+        # 2x workers: a straggler request from a previous call must not
+        # delay the next call's fan-out
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(2, 2 * n_endpoints),
+            thread_name_prefix="state-fanout")
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    def all(self, endpoints: List[str], fn: Callable[[str], object]
+            ) -> Dict[str, object]:
+        """Wait for every endpoint; map endpoint -> result or Exception."""
+        futures = {ep: self._pool.submit(fn, ep) for ep in endpoints}
+        results: Dict[str, object] = {}
+        for ep, fut in futures.items():
+            try:
+                results[ep] = fut.result()
+            except Exception as e:  # noqa: BLE001 — callers triage per-ep
+                results[ep] = e
+        return results
+
+    def quorum_wait(self, endpoints: List[str], fn: Callable[[str], object],
+                    decided: Callable[[Dict[str, object]], bool],
+                    ) -> Dict[str, object]:
+        """Collect results until ``decided(results)`` is true or all
+        endpoints have answered; abandoned stragglers just log."""
+        futures = {self._pool.submit(fn, ep): ep for ep in endpoints}
+        results: Dict[str, object] = {}
+        pending = set(futures)
+        while pending and not decided(results):
+            done, pending = wait(pending, return_when=FIRST_COMPLETED)
+            for fut in done:
+                ep = futures[fut]
+                try:
+                    results[ep] = fut.result()
+                except Exception as e:  # noqa: BLE001
+                    results[ep] = e
+        for fut in pending:  # abandoned: log when they eventually land
+            ep = futures[fut]
+            fut.add_done_callback(
+                lambda f, ep=ep: log.debug(
+                    "straggler reply from %s: %s", ep,
+                    f.exception() or "ok"))
+        return results
+
+
+class QuorumError(PersisterError):
+    """Fewer than a majority of replicas acknowledged."""
+
+
+class ReplicatedPersister(Persister):
+    """Client-side quorum replication over N :class:`StateReplicaServer`s.
+
+    Single-writer: hold a :class:`ReplicatedLock` on the same endpoints
+    (same ``owner``) before constructing one — the scheduler mains do both
+    together via :func:`open_state`. After any failed-quorum write the
+    instance is poisoned and every operation raises: the in-memory mirror
+    may be ahead of the ensemble, and continuing would reuse a log index
+    for a different payload (silent replica divergence).
+    """
+
+    def __init__(self, endpoints: List[str], owner: str = "",
+                 timeout_s: float = 5.0, secret: Optional[str] = None):
+        if not endpoints:
+            raise PersisterError("need at least one replica endpoint")
+        self._endpoints = [e.rstrip("/") for e in endpoints]
+        self._owner = owner
+        self._secret = secret
+        self._timeout = timeout_s
+        self._quorum = len(self._endpoints) // 2 + 1
+        self._lock = threading.RLock()
+        self._cache = MemPersister()
+        self._next_index = 1
+        self._poisoned: Optional[str] = None
+        self._fanout = _Fanout(len(self._endpoints))
+        try:
+            self._sync_from_majority()
+        except Exception:
+            self._fanout.close()
+            raise
+
+    def close(self) -> None:
+        self._fanout.close()
+
+    # -- open-time sync ----------------------------------------------------
+
+    def _sync_from_majority(self) -> None:
+        replies = self._fanout.all(
+            self._endpoints,
+            lambda ep: _get(ep + "/meta", self._timeout, self._secret))
+        metas: Dict[str, int] = {}
+        for ep, reply in replies.items():
+            if isinstance(reply, Exception):
+                log.warning("state replica %s unreachable at open: %s",
+                            ep, reply)
+            else:
+                metas[ep] = int(reply["last_index"])
+        if len(metas) < self._quorum:
+            raise QuorumError(
+                f"only {len(metas)}/{len(self._endpoints)} state replicas "
+                f"reachable; need {self._quorum}")
+        # adopt the highest-index snapshot; fall back down the candidate
+        # list if the best replica dies between /meta and /snapshot
+        snap = None
+        for ep in sorted(metas, key=lambda e: metas[e], reverse=True):
+            try:
+                snap = _get(ep + "/snapshot", self._timeout, self._secret)
+                break
+            except Exception as e:  # noqa: BLE001
+                log.warning("snapshot from %s failed, trying next: %s",
+                            ep, e)
+        if snap is None:
+            raise QuorumError("no reachable replica could serve a snapshot")
+        self._next_index = int(snap["last_index"]) + 1
+        for path, hexval in snap["data"].items():
+            self._cache.set(path, bytes.fromhex(hexval))
+        # bring stragglers up to date so they can ack subsequent writes
+        push = dict(snap, owner=self._owner)
+        stale = [ep for ep, last in metas.items()
+                 if last < int(snap["last_index"])]
+        for ep, reply in self._fanout.all(
+                stale, lambda ep: _post(ep + "/resync", push, self._timeout,
+                                        self._secret)).items():
+            if isinstance(reply, Exception):
+                log.warning("resync of %s failed: %s", ep, reply)
+
+    # -- replication core --------------------------------------------------
+
+    def _check_poisoned(self) -> None:
+        if self._poisoned is not None:
+            raise QuorumError(
+                "persister poisoned by earlier failed write "
+                f"({self._poisoned}); restart the scheduler to re-sync")
+
+    def _replicate(self, entries: Mapping[str, Optional[bytes]]) -> None:
+        self._check_poisoned()
+        payload = {
+            "index": self._next_index,
+            "owner": self._owner,
+            "entries": {p: (v.hex() if v is not None else None)
+                        for p, v in entries.items()},
+        }
+
+        def ok(reply: object) -> bool:
+            return not isinstance(reply, Exception)
+
+        def success_decided(results: Dict[str, object]) -> bool:
+            # return early the moment a quorum of acks is in: one dead or
+            # slow replica must not add its full timeout to every write
+            return sum(1 for r in results.values() if ok(r)) >= self._quorum
+
+        replies = self._fanout.quorum_wait(
+            self._endpoints,
+            lambda ep: _post(ep + "/apply", payload, self._timeout,
+                             self._secret),
+            success_decided)
+        acks = sum(1 for r in replies.values() if ok(r))
+        if acks >= self._quorum:
+            self._next_index += 1
+            return
+
+        # quorum not reached from acks alone (quorum_wait drained every
+        # endpoint in that case): classify the failures
+        stale: List[str] = []
+        fenced = 0
+        for ep, reply in replies.items():
+            if isinstance(reply, urllib.error.HTTPError):
+                if reply.code == 409:
+                    stale.append(ep)
+                elif reply.code == 403:
+                    fenced += 1
+                    log.error("apply to %s fenced: a newer writer holds "
+                              "the lease", ep)
+                else:
+                    log.warning("apply to %s: HTTP %s", ep, reply.code)
+            elif isinstance(reply, Exception):
+                log.warning("apply to %s failed: %s", ep, reply)
+        if stale and not fenced:
+            # replica restarted from an old disk or missed writes while
+            # partitioned: push a snapshot that includes this write, then
+            # count it as acked. Skipped the moment any replica reports
+            # us fenced: "stale" replicas are then likely ahead of us
+            # under a newer writer, and pushing our snapshot would be the
+            # rollback the fence exists to stop (the server rejects a
+            # rewind from a non-holder regardless — belt and braces).
+            snap = self._snapshot_payload(include=payload["entries"])
+            for ep, reply in self._fanout.all(
+                    stale,
+                    lambda ep: _post(ep + "/resync", snap, self._timeout,
+                                     self._secret)).items():
+                if isinstance(reply, Exception):
+                    log.warning("resync of %s failed: %s", ep, reply)
+                else:
+                    acks += 1
+        if acks < self._quorum:
+            why = ("deposed: a newer writer holds the ensemble lease"
+                   if fenced else
+                   f"acked by {acks}/{len(self._endpoints)} replicas; "
+                   f"need {self._quorum}")
+            self._poisoned = f"write {self._next_index}: {why}"
+            raise QuorumError(
+                f"write {self._next_index} failed — {why} "
+                "(crash-don't-corrupt: local mirror may be ahead of the "
+                "ensemble; this persister is now poisoned)")
+        self._next_index += 1
+
+    def _snapshot_payload(self,
+                          include: Optional[Mapping[str, Optional[str]]] = None
+                          ) -> dict:
+        data: Dict[str, str] = {}
+        for path in self._cache.recursive_paths():
+            value = self._cache.get_or_none(path)
+            if value is not None:
+                data[path] = value.hex()
+        for p, v in (include or {}).items():
+            if v is None:
+                data.pop(p, None)
+                prefix = p.rstrip("/") + "/"
+                data = {k: val for k, val in data.items()
+                        if not k.startswith(prefix)}
+            else:
+                data[p] = v
+        digest = (StateReplicaServer._digest(self._next_index, include)
+                  if include else "")
+        return {"last_index": self._next_index, "last_digest": digest,
+                "data": data, "owner": self._owner}
+
+    # -- Persister ---------------------------------------------------------
+
+    def get(self, path: str) -> bytes:
+        with self._lock:
+            self._check_poisoned()
+            return self._cache.get(path)
+
+    def set(self, path: str, value: bytes) -> None:
+        with self._lock:
+            self._replicate({path: value})
+            self._cache.set(path, value)
+
+    def set_many(self, values: Mapping[str, Optional[bytes]]) -> None:
+        with self._lock:
+            self._replicate(values)
+            self._cache.set_many(values)
+
+    def get_children(self, path: str) -> list[str]:
+        with self._lock:
+            self._check_poisoned()
+            return self._cache.get_children(path)
+
+    def recursive_delete(self, path: str) -> None:
+        with self._lock:
+            self._check_poisoned()
+            # NotFound must surface before any replication happens
+            self._cache.get_children(path)
+            self._replicate({path: None})
+            try:
+                self._cache.recursive_delete(path)
+            except NotFoundError:
+                pass
+
+
+class ReplicatedLock:
+    """Majority-lease leader lock (reference ``curator/CuratorLocker.java``).
+
+    Acquire blocks up to ``timeout_s``; a background thread renews every
+    ``ttl_s / 3``. If the holder cannot re-win a majority for a full TTL
+    (partition, deposition), ``on_lost`` fires — the scheduler mains wire
+    it to process exit (zombie leaders must step down, the
+    ``CycleDriver`` crash-don't-corrupt precedent); replica-side fencing
+    protects state integrity either way.
+    """
+
+    def __init__(self, endpoints: List[str], owner: str,
+                 ttl_s: float = 10.0, timeout_s: float = 30.0,
+                 poll_interval_s: float = 0.5, request_timeout_s: float = 5.0,
+                 secret: Optional[str] = None,
+                 on_lost: Optional[Callable[[], None]] = None):
+        self._endpoints = [e.rstrip("/") for e in endpoints]
+        self._owner = owner
+        self._ttl = ttl_s
+        self._timeout = request_timeout_s
+        self._secret = secret
+        self._on_lost = on_lost
+        self._quorum = len(self._endpoints) // 2 + 1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._fanout = _Fanout(len(self._endpoints))
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if self._try_acquire():
+                break
+            # failed round during ACQUISITION: release the partial grants
+            # we just parked on some replicas, so two racing contenders
+            # cannot starve each other (or a later arrival) for a TTL
+            self._release_grants()
+            if time.monotonic() >= deadline:
+                self._fanout.close()
+                raise LockError(
+                    f"could not acquire state-ensemble lease as "
+                    f"{owner!r} within {timeout_s}s (another scheduler "
+                    "instance holds it; reference CuratorLocker semantics)")
+            time.sleep(poll_interval_s)
+        self._last_success = time.monotonic()
+        self._thread = threading.Thread(target=self._renew_loop,
+                                        name="state-lease", daemon=True)
+        self._thread.start()
+
+    def _try_acquire(self) -> bool:
+        def decided(results: Dict[str, object]) -> bool:
+            grants = sum(1 for r in results.values()
+                         if not isinstance(r, Exception)
+                         and r.get("granted"))
+            return grants >= self._quorum
+
+        replies = self._fanout.quorum_wait(
+            self._endpoints,
+            lambda ep: _post(ep + "/lease/acquire",
+                             {"owner": self._owner, "ttl_s": self._ttl},
+                             self._timeout, self._secret),
+            decided)
+        grants = 0
+        for ep, reply in replies.items():
+            if isinstance(reply, Exception):
+                log.warning("lease acquire on %s failed: %s", ep, reply)
+            elif reply.get("granted"):
+                grants += 1
+        return grants >= self._quorum
+
+    def _release_grants(self) -> None:
+        for ep, reply in self._fanout.all(
+                self._endpoints,
+                lambda ep: _post(ep + "/lease/release",
+                                 {"owner": self._owner}, self._timeout,
+                                 self._secret)).items():
+            if isinstance(reply, Exception):
+                log.debug("lease release on %s failed: %s", ep, reply)
+
+    def _renew_loop(self) -> None:
+        while not self._stop.wait(self._ttl / 3):
+            if self._try_acquire():
+                self._last_success = time.monotonic()
+            elif time.monotonic() - self._last_success > self._ttl:
+                log.error("lost the state-ensemble lease majority for a "
+                          "full TTL; stepping down")
+                if self._on_lost is not None:
+                    self._on_lost()
+                return
+
+    def release(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._release_grants()
+        self._fanout.close()
+
+
+def open_replicated(endpoints: List[str], owner: str,
+                    ttl_s: float = 10.0, timeout_s: float = 30.0,
+                    secret: Optional[str] = None,
+                    on_lost: Optional[Callable[[], None]] = None,
+                    ) -> Tuple[ReplicatedPersister, ReplicatedLock]:
+    """Leader-elect then open: the lock is held BEFORE the snapshot read so
+    the single-writer invariant covers the open-time sync."""
+    lock = ReplicatedLock(endpoints, owner, ttl_s=ttl_s, timeout_s=timeout_s,
+                          secret=secret, on_lost=on_lost)
+    try:
+        return ReplicatedPersister(endpoints, owner=owner,
+                                   secret=secret), lock
+    except Exception:
+        lock.release()
+        raise
+
+
+def _secret_from_env() -> Optional[str]:
+    secret = os.environ.get("TPU_STATE_SECRET")
+    if secret:
+        return secret
+    path = os.environ.get("TPU_STATE_SECRET_FILE")
+    if path:
+        with open(path, encoding="utf-8") as f:
+            return f.read().strip()
+    return None
+
+
+def open_state(state_root: str, owner: Optional[str] = None):
+    """The scheduler mains' one-stop state bootstrap: returns
+    ``(persister, lock)`` — the replicated ensemble when
+    ``TPU_STATE_ENDPOINTS`` (comma-separated replica URLs) is set (with
+    ``TPU_STATE_SECRET[_FILE]`` as the ensemble credential), else the
+    single-host FilePersister + flock InstanceLock."""
+    import socket
+
+    from .persister import InstanceLock
+
+    endpoints = os.environ.get("TPU_STATE_ENDPOINTS", "")
+    if endpoints.strip():
+        owner = owner or f"{socket.gethostname()}-{os.getpid()}"
+        eps = [e.strip() for e in endpoints.split(",") if e.strip()]
+
+        def step_down() -> None:  # pragma: no cover - process exit
+            log.critical("state-ensemble lease lost; exiting")
+            os._exit(1)
+
+        return open_replicated(eps, owner, secret=_secret_from_env(),
+                               on_lost=step_down)
+    lock = InstanceLock(state_root)
+    return FilePersister(state_root), lock
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin daemon wrapper
+    import argparse
+    p = argparse.ArgumentParser(
+        description="state ensemble replica server")
+    p.add_argument("--root", required=True, help="durable state directory")
+    p.add_argument("--port", type=int, default=7501)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--secret-file",
+                   help="shared ensemble secret (required on non-loopback "
+                        "binds; replicas hold ALL scheduler state)")
+    args = p.parse_args(argv)
+    secret = None
+    if args.secret_file:
+        with open(args.secret_file, encoding="utf-8") as f:
+            secret = f.read().strip()
+    if secret is None and args.host not in ("127.0.0.1", "::1", "localhost"):
+        print("WARNING: binding a state replica to a non-loopback address "
+              "without --secret-file exposes all scheduler state; pass "
+              "--secret-file or isolate the port", flush=True)
+    server = StateReplicaServer(args.root, port=args.port, host=args.host,
+                                secret=secret)
+    server.start()
+    print(f"state replica on {args.host}:{server.port} root={args.root}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

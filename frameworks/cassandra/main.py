@@ -19,7 +19,7 @@ from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
 from dcos_commons_tpu.scheduler import ServiceScheduler
 from dcos_commons_tpu.scheduler.runner import CycleDriver
 from dcos_commons_tpu.specification import ServiceSpec, load_service_yaml
-from dcos_commons_tpu.state import FilePersister, InstanceLock
+from dcos_commons_tpu.state.replicated import open_state
 
 from .recovery import seed_recovery_overrider
 
@@ -75,8 +75,9 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     metrics = MetricsRegistry()
-    lock = InstanceLock(args.state)  # single-instance gate
-    persister = FilePersister(args.state)
+    # single-instance gate + state backend: the replicated
+    # ensemble when TPU_STATE_ENDPOINTS is set, else local files
+    persister, lock = open_state(args.state)
     cluster = RemoteCluster()
     # control-plane auth: TPU_AUTH_FILE names the accounts file
     _auth = Authenticator.from_env()

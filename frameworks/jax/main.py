@@ -25,7 +25,7 @@ from dcos_commons_tpu.security import Authenticator
 from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
 from dcos_commons_tpu.scheduler import ServiceScheduler
 from dcos_commons_tpu.scheduler.runner import CycleDriver
-from dcos_commons_tpu.state import FilePersister, InstanceLock
+from dcos_commons_tpu.state.replicated import open_state
 
 from . import scenarios
 
@@ -56,8 +56,9 @@ def main(argv=None) -> int:
     if statsd_host:
         metrics.configure_statsd(statsd_host,
                                  int(os.environ.get("STATSD_UDP_PORT", "8125")))
-    lock = InstanceLock(args.state)  # single-instance gate
-    persister = FilePersister(args.state)
+    # single-instance gate + state backend: the replicated
+    # ensemble when TPU_STATE_ENDPOINTS is set, else local files
+    persister, lock = open_state(args.state)
     cluster = RemoteCluster()
     # control-plane auth: TPU_AUTH_FILE names the accounts file
     _auth = Authenticator.from_env()

@@ -1,0 +1,336 @@
+"""Replicated state backend tests.
+
+Reference behaviors mirrored: ``curator/CuratorPersisterTest`` (atomic
+setMany transactions), ``curator/CuratorLocker`` (only one scheduler
+instance may act), and the HA property the reference gets from the ZK
+ensemble: lose the scheduler host, a standby resumes from replica state.
+"""
+
+import threading
+import time
+
+import pytest
+
+from dcos_commons_tpu.agent.fake import FakeCluster
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler import ServiceScheduler
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import (LockError, NotFoundError, QuorumError,
+                                    ReplicatedLock, ReplicatedPersister,
+                                    StateReplicaServer, open_replicated)
+from dcos_commons_tpu.testing.simulation import default_agents
+
+
+@pytest.fixture()
+def ensemble(tmp_path):
+    servers = [StateReplicaServer(str(tmp_path / f"replica-{i}"), port=0)
+               for i in range(3)]
+    for s in servers:
+        s.start()
+    endpoints = [f"http://127.0.0.1:{s.port}" for s in servers]
+    try:
+        yield servers, endpoints, tmp_path
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+class TestReplicatedPersister:
+    """The Persister conformance surface (mirrors TestPersister in
+    test_state.py) against a live 3-replica ensemble."""
+
+    def test_get_set_children_delete(self, ensemble):
+        _, endpoints, _ = ensemble
+        p = ReplicatedPersister(endpoints)
+        p.set("a/b", b"1")
+        p.set("a/c", b"2")
+        assert p.get("a/b") == b"1"
+        assert p.get_children("a") == ["b", "c"]
+        with pytest.raises(NotFoundError):
+            p.get("missing")
+        p.recursive_delete("a/b")
+        assert p.get_children("a") == ["c"]
+        with pytest.raises(NotFoundError):
+            p.recursive_delete("a/b")
+
+    def test_set_many_atomic_and_delete(self, ensemble):
+        _, endpoints, _ = ensemble
+        p = ReplicatedPersister(endpoints)
+        p.set("keep", b"k")
+        p.set_many({"x/1": b"a", "x/2": b"b", "keep": None})
+        assert p.get("x/1") == b"a" and p.get("x/2") == b"b"
+        assert p.get_or_none("keep") is None
+
+    def test_state_survives_client_reopen(self, ensemble):
+        _, endpoints, _ = ensemble
+        p = ReplicatedPersister(endpoints)
+        p.set("tasks/t0", b"payload")
+        p2 = ReplicatedPersister(endpoints)
+        assert p2.get("tasks/t0") == b"payload"
+
+    def test_writes_survive_one_replica_down(self, ensemble):
+        servers, endpoints, _ = ensemble
+        p = ReplicatedPersister(endpoints)
+        p.set("before", b"1")
+        servers[0].stop()
+        p.set("during", b"2")  # 2/3 still a majority
+        p2 = ReplicatedPersister(endpoints)
+        assert p2.get("before") == b"1" and p2.get("during") == b"2"
+
+    def test_majority_loss_refuses_writes(self, ensemble):
+        servers, endpoints, _ = ensemble
+        p = ReplicatedPersister(endpoints, timeout_s=1.0)
+        servers[0].stop()
+        servers[1].stop()
+        with pytest.raises(QuorumError):
+            p.set("x", b"1")
+        with pytest.raises(QuorumError):
+            ReplicatedPersister(endpoints, timeout_s=1.0)
+
+    def test_restarted_stale_replica_is_resynced(self, ensemble, tmp_path):
+        servers, endpoints, _ = ensemble
+        p = ReplicatedPersister(endpoints)
+        p.set("a", b"1")
+        servers[0].stop()
+        p.set("b", b"2")  # replica 0 misses this write
+        restarted = StateReplicaServer(str(tmp_path / "replica-0"), port=0)
+        restarted.start()
+        endpoints2 = [f"http://127.0.0.1:{restarted.port}"] + endpoints[1:]
+        try:
+            # next write 409s on the stale member and pushes a snapshot
+            p2 = ReplicatedPersister(endpoints2)
+            p2.set("c", b"3")
+            solo = ReplicatedPersister(
+                [endpoints2[0]])  # quorum of 1: reads replica 0 alone
+            assert solo.get("b") == b"2" and solo.get("c") == b"3"
+        finally:
+            restarted.stop()
+
+
+class TestReplicatedLock:
+    def test_second_owner_blocked_until_release(self, ensemble):
+        _, endpoints, _ = ensemble
+        lock1 = ReplicatedLock(endpoints, "sched-a", ttl_s=5.0,
+                               timeout_s=5.0)
+        with pytest.raises(LockError):
+            ReplicatedLock(endpoints, "sched-b", ttl_s=5.0, timeout_s=1.0,
+                           poll_interval_s=0.2)
+        lock1.release()
+        lock2 = ReplicatedLock(endpoints, "sched-b", ttl_s=5.0,
+                               timeout_s=5.0)
+        lock2.release()
+
+    def test_crashed_holder_expires(self, ensemble):
+        _, endpoints, _ = ensemble
+        # holder "crashes": never releases, never renews
+        lock1 = ReplicatedLock(endpoints, "sched-a", ttl_s=0.8,
+                               timeout_s=5.0)
+        lock1._stop.set()  # kill the renewal thread (simulated crash)
+        lock1._thread.join(timeout=5)
+        t0 = time.monotonic()
+        lock2 = ReplicatedLock(endpoints, "sched-b", ttl_s=5.0,
+                               timeout_s=10.0, poll_interval_s=0.1)
+        assert time.monotonic() - t0 >= 0.3  # waited out the TTL
+        lock2.release()
+
+
+class TestFencingAndPoisoning:
+    def test_deposed_writer_cannot_commit_or_rollback(self, ensemble):
+        """A revived ex-leader's writes are fenced by the successor's
+        lease: they fail quorum, poison the old client, and never roll
+        the ensemble back."""
+        _, endpoints, _ = ensemble
+        lock_a = ReplicatedLock(endpoints, "sched-a", ttl_s=0.6,
+                                timeout_s=5.0)
+        p_a = ReplicatedPersister(endpoints, owner="sched-a")
+        p_a.set("committed/by-a", b"1")
+        # A stalls: renewal stops, lease lapses
+        lock_a._stop.set()
+        lock_a._thread.join(timeout=5)
+        lock_b = ReplicatedLock(endpoints, "sched-b", ttl_s=30.0,
+                                timeout_s=10.0, poll_interval_s=0.1)
+        p_b = ReplicatedPersister(endpoints, owner="sched-b")
+        p_b.set("committed/by-b", b"2")
+        # A wakes with a pending write: fenced everywhere, poisoned
+        with pytest.raises(QuorumError, match="deposed|poisoned"):
+            p_a.set("stale/rollback-attempt", b"X")
+        with pytest.raises(QuorumError):  # stays poisoned
+            p_a.set("another", b"Y")
+        # B's committed writes survived; A's fenced write never landed
+        p_check = ReplicatedPersister(endpoints, owner="sched-b")
+        assert p_check.get("committed/by-b") == b"2"
+        assert p_check.get_or_none("stale/rollback-attempt") is None
+        lock_b.release()
+
+    def test_rollback_blocked_even_after_all_leases_expire(self, ensemble):
+        """The nastier variant: A is suspended past its TTL, successor B
+        commits and then crashes, B's lease also expires — the resumed A
+        still must not erase B's committed writes with its stale
+        snapshot (log rewind requires holding a live lease)."""
+        _, endpoints, _ = ensemble
+        lock_a = ReplicatedLock(endpoints, "sched-a", ttl_s=0.5,
+                                timeout_s=5.0)
+        p_a = ReplicatedPersister(endpoints, owner="sched-a")
+        p_a.set("base", b"0")
+        lock_a._stop.set()  # A suspended
+        lock_a._thread.join(timeout=5)
+        lock_b = ReplicatedLock(endpoints, "sched-b", ttl_s=0.5,
+                                timeout_s=10.0, poll_interval_s=0.1)
+        p_b = ReplicatedPersister(endpoints, owner="sched-b")
+        p_b.set("committed/by-b", b"2")
+        lock_b._stop.set()  # B crashes; its lease expires too
+        lock_b._thread.join(timeout=5)
+        time.sleep(0.7)
+        # A resumes with a pending write at a stale index: all replicas
+        # 409, no lease fences, but the rewind-resync is rejected
+        with pytest.raises(QuorumError):
+            p_a.set("stale/write", b"X")
+        p_check = ReplicatedPersister(endpoints)
+        assert p_check.get("committed/by-b") == b"2"
+        assert p_check.get_or_none("stale/write") is None
+
+    def test_conflicting_write_at_head_not_phantom_acked(self, ensemble):
+        """Two lock-less writers at the same index must not both believe
+        they committed: the replica compares the entry digest and rejects
+        the divergent one instead of phantom-acking a 'duplicate'."""
+        _, endpoints, _ = ensemble
+        p1 = ReplicatedPersister(endpoints)
+        p2 = ReplicatedPersister(endpoints)  # same next_index as p1
+        p1.set("winner", b"1")
+        with pytest.raises(QuorumError):
+            p2.set("loser", b"2")  # same index, different payload
+        p_check = ReplicatedPersister(endpoints)
+        assert p_check.get("winner") == b"1"
+        assert p_check.get_or_none("loser") is None
+
+    def test_failed_quorum_poisons_client(self, ensemble):
+        servers, endpoints, _ = ensemble
+        p = ReplicatedPersister(endpoints, timeout_s=1.0)
+        servers[0].stop()
+        servers[1].stop()
+        with pytest.raises(QuorumError):
+            p.set("x", b"1")
+        # every subsequent op refuses: the mirror may be ahead
+        with pytest.raises(QuorumError):
+            p.set("y", b"2")
+        with pytest.raises(QuorumError):
+            p.get("x")
+
+    def test_lease_survives_replica_restart(self, ensemble, tmp_path):
+        servers, endpoints, _ = ensemble
+        lock_a = ReplicatedLock(endpoints, "sched-a", ttl_s=30.0,
+                                timeout_s=5.0)
+        # roll-restart two replicas while A is healthy
+        restarted = []
+        for i in (0, 1):
+            servers[i].stop()
+            r = StateReplicaServer(str(tmp_path / f"replica-{i}"), port=0)
+            r.start()
+            restarted.append(r)
+        endpoints2 = [f"http://127.0.0.1:{r.port}" for r in restarted] \
+            + endpoints[2:]
+        try:
+            with pytest.raises(LockError):  # lease survived the restarts
+                ReplicatedLock(endpoints2, "sched-b", ttl_s=5.0,
+                               timeout_s=1.0, poll_interval_s=0.2)
+        finally:
+            for r in restarted:
+                r.stop()
+            lock_a.release()
+
+    def test_holder_steps_down_after_losing_majority(self, ensemble):
+        servers, endpoints, _ = ensemble
+        lost = threading.Event()
+        lock = ReplicatedLock(endpoints, "sched-a", ttl_s=0.6,
+                              timeout_s=5.0, request_timeout_s=0.5,
+                              on_lost=lost.set)
+        for s in servers:
+            s.stop()
+        assert lost.wait(timeout=10), "on_lost never fired"
+
+
+class TestEnsembleSecret:
+    def test_secret_required_when_configured(self, tmp_path):
+        server = StateReplicaServer(str(tmp_path / "r0"), port=0,
+                                    secret="hunter2")
+        server.start()
+        endpoints = [f"http://127.0.0.1:{server.port}"]
+        try:
+            with pytest.raises(QuorumError):
+                ReplicatedPersister(endpoints, timeout_s=1.0)  # no secret
+            p = ReplicatedPersister(endpoints, secret="hunter2")
+            p.set("a", b"1")
+            assert p.get("a") == b"1"
+            with pytest.raises(LockError):
+                ReplicatedLock(endpoints, "x", timeout_s=0.5,
+                               poll_interval_s=0.2)  # no secret
+            lock = ReplicatedLock(endpoints, "x", timeout_s=5.0,
+                                  secret="hunter2")
+            lock.release()
+        finally:
+            server.stop()
+
+
+YML = """
+name: hasvc
+pods:
+  hello:
+    count: 2
+    tasks:
+      server: {goal: RUNNING, cmd: run, cpus: 0.5, memory: 128}
+"""
+
+
+class TestSchedulerFailover:
+    """The VERDICT's done-criterion: kill the primary scheduler (and one
+    replica), a standby acquires the lease, resumes from replica state,
+    and reconciles without relaunching anything."""
+
+    def test_standby_resumes_from_replica_state(self, ensemble):
+        servers, endpoints, _ = ensemble
+        agents = default_agents(3)
+
+        # primary scheduler deploys to COMPLETE
+        persister_a, lock_a = open_replicated(endpoints, "sched-a",
+                                              ttl_s=0.8)
+        cluster = FakeCluster(agents)
+        sched_a = ServiceScheduler(load_service_yaml_str(YML), persister_a,
+                                   cluster)
+        for _ in range(30):
+            sched_a.run_cycle()
+            if sched_a.plan("deploy").status is Status.COMPLETE:
+                break
+        assert sched_a.plan("deploy").status is Status.COMPLETE
+        tasks_before = {t.task_name: t.task_id
+                        for t in sched_a.state.fetch_tasks()}
+        assert len(tasks_before) == 2
+
+        # primary host dies: scheduler gone (lease not released), and one
+        # replica lost with it
+        lock_a._stop.set()
+        lock_a._thread.join(timeout=5)
+        servers[0].stop()
+
+        # standby comes up against the surviving majority
+        persister_b, lock_b = open_replicated(endpoints, "sched-b",
+                                              ttl_s=5.0, timeout_s=15.0)
+        try:
+            sched_b = ServiceScheduler(load_service_yaml_str(YML),
+                                       persister_b, cluster)
+            # state carried over: same tasks, deploy plan rebuilt COMPLETE
+            tasks_after = {t.task_name: t.task_id
+                           for t in sched_b.state.fetch_tasks()}
+            assert tasks_after == tasks_before
+            sched_b.reconcile()
+            for _ in range(10):
+                sched_b.run_cycle()
+            assert sched_b.plan("deploy").status is Status.COMPLETE
+            assert {t.task_name: t.task_id
+                    for t in sched_b.state.fetch_tasks()} == tasks_before
+            # and the standby can keep writing (config updates etc.)
+            sched_b.state.store_property("owner", b"sched-b")
+        finally:
+            lock_b.release()
