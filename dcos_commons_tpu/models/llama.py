@@ -180,8 +180,11 @@ def _constrain(x, mesh: Optional[Mesh], *spec):
 
 
 def attention_block(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
-                    rope, attn_fn: Callable) -> jnp.ndarray:
-    """Pre-norm attention residual step on x [B, S, D]."""
+                    rope, attn_fn: Callable,
+                    return_kv: bool = False):
+    """Pre-norm attention residual step on x [B, S, D]. With
+    ``return_kv`` also returns the rope'd K/V (the prefill cache
+    contract, identical to what ``decode_step`` writes)."""
     b, s, _ = x.shape
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
@@ -190,7 +193,18 @@ def attention_block(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
     q = apply_rope(q, rope)
     k = apply_rope(k, rope)
     o = attn_fn(q, k, v)  # GQA expansion is the impl's business
-    return x + o.reshape(b, s, -1) @ lp["wo"]
+    out = x + o.reshape(b, s, -1) @ lp["wo"]
+    if return_kv:
+        return out, k, v
+    return out
+
+
+def ffn_block(cfg: LlamaConfig, x: jnp.ndarray, lp: Params) -> jnp.ndarray:
+    """Pre-norm SwiGLU residual step on x [B, S, D]."""
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
+    up = (h @ lp["w_up"]).astype(jnp.float32)
+    return x + ((gate * up).astype(cfg.dtype) @ lp["w_down"])
 
 
 def apply_layer(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
@@ -199,10 +213,7 @@ def apply_layer(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
     """One decoder layer on activations x [B, S, D] (shared by the dense
     forward's scan and the pipeline-parallel stage bodies)."""
     x = attention_block(cfg, x, lp, rope, attn_fn)
-    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
-    up = (h @ lp["w_up"]).astype(jnp.float32)
-    x = x + ((gate * up).astype(cfg.dtype) @ lp["w_down"])
+    x = ffn_block(cfg, x, lp)
     return _constrain(x, mesh, "dp", "sp", None)
 
 
@@ -429,23 +440,55 @@ def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
     return logits, {"k": k_new, "v": v_new}
 
 
+def prefill(cfg: LlamaConfig, params: Params, cache: Params,
+            prompt: jnp.ndarray, mesh: Optional[Mesh] = None,
+            rope: Optional[jnp.ndarray] = None
+            ) -> Tuple[jnp.ndarray, Params]:
+    """Parallel prefill: ONE forward over the whole prompt, writing every
+    layer's K/V into the cache at positions ``[0, S)``.
+
+    Returns (last-position logits [B, V], cache). Replaces the old
+    token-by-token prefill (S sequential decode steps): same cache
+    contents, but the sequence dimension runs in parallel on the MXU and
+    the compiled graph is the train forward's — which both halves
+    ``generate``'s compile time (the dominant cost at 400m+ through
+    tunneled backends, docs/performance.md) and makes prompt processing
+    O(1) dispatches instead of O(S).
+    """
+    b, s = prompt.shape
+    if rope is None:
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    # dense attention on purpose (not _make_attn_fn): the cache contract
+    # matches decode_step exactly, and ring/ulysses shard_map impls
+    # require sp-divisible sequence lengths — prompts are arbitrary
+    attn_fn = (lambda q, k, v: gqa_attention(q, k, v, causal=True))
+    x = params["embed"].astype(cfg.dtype)[prompt]
+    x = _constrain(x, mesh, "dp", None, None)
+
+    def layer(x, lp):
+        x, k, v = attention_block(cfg, x, lp, rope, attn_fn,
+                                  return_kv=True)
+        x = ffn_block(cfg, x, lp)
+        return _constrain(x, mesh, "dp", None, None), (k, v)
+
+    x, (ks, vs) = lax.scan(layer, x, params["layers"])
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = (x[:, -1, :] @ params["lm_head"]).astype(jnp.float32)
+    cache = {
+        "k": lax.dynamic_update_slice_in_dim(cache["k"], ks, 0, axis=2),
+        "v": lax.dynamic_update_slice_in_dim(cache["v"], vs, 0, axis=2),
+    }
+    return logits, cache
+
+
 def generate(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,
              steps: int, mesh: Optional[Mesh] = None) -> jnp.ndarray:
-    """Greedy generation: prefill by scanning decode_step over the prompt
-    (cache-exact), then scan decode steps."""
+    """Greedy generation: parallel prefill, then scan decode steps."""
     b, s = prompt.shape
     cache = init_kv_cache(cfg, b, cfg.max_seq)
-    # hoisted once: inside the scans it would be re-materialized per body
+    # hoisted once: inside the scan it would be re-materialized per body
     rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
-    # prefill: run each prompt token through decode (simple, cache-exact)
-    def prefill(carry, i):
-        cache, _ = carry
-        logits, cache = decode_step(cfg, params, cache, i, prompt[:, i],
-                                    mesh, rope=rope)
-        return (cache, logits), None
-    (cache, logits), _ = lax.scan(
-        prefill, (cache, jnp.zeros((b, cfg.vocab_size), jnp.float32)),
-        jnp.arange(s))
+    logits, cache = prefill(cfg, params, cache, prompt, mesh, rope=rope)
 
     def step(carry, i):
         cache, logits = carry
@@ -456,3 +499,53 @@ def generate(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,
 
     (_, _), toks = lax.scan(step, (cache, logits), jnp.arange(steps))
     return jnp.swapaxes(toks, 0, 1)                        # [B, steps]
+
+
+_STEPWISE_CACHE: dict = {}
+
+
+def _stepwise_executables(cfg: LlamaConfig, mesh: Optional[Mesh]):
+    """Jitted prefill/decode-step callables, cached per (cfg, mesh) so
+    repeat ``generate_stepwise`` calls re-trace and re-compile nothing
+    (jax.jit caches per wrapper object — a fresh lambda per call would
+    silently recompile every time)."""
+    key = (cfg, mesh)
+    hit = _STEPWISE_CACHE.get(key)
+    if hit is None:
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+        hit = (
+            jax.jit(lambda p, c, pr: prefill(cfg, p, c, pr, mesh,
+                                             rope=rope)),
+            jax.jit(lambda p, c, pos, tok: decode_step(cfg, p, c, pos,
+                                                       tok, mesh,
+                                                       rope=rope)),
+        )
+        _STEPWISE_CACHE[key] = hit
+    return hit
+
+
+def generate_stepwise(cfg: LlamaConfig, params: Params,
+                      prompt: jnp.ndarray, steps: int,
+                      mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """Greedy generation compiling only ``prefill`` + ONE ``decode_step``
+    executable, driven by a host loop.
+
+    Same outputs as :func:`generate`, different compile/dispatch trade:
+    the fused scan program amortizes dispatch but its nested-scan graph
+    takes minutes to compile at 400m+ through tunneled PJRT backends
+    (docs/performance.md); this variant compiles in seconds — decode at
+    real model sizes is HBM-bound streaming the weights every token, so
+    per-step dispatch overhead is hidden at 400m+ anyway.
+    """
+    b, s = prompt.shape
+    cache = init_kv_cache(cfg, b, cfg.max_seq)
+    prefill_x, step_x = _stepwise_executables(cfg, mesh)
+    logits, cache = prefill_x(params, cache, prompt)
+    toks = []
+    for i in range(steps):
+        tok = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        logits, cache = step_x(params, cache, jnp.int32(s + i), tok)
+        toks.append(tok)
+    if not toks:
+        return jnp.zeros((b, 0), prompt.dtype)
+    return jnp.stack(toks, axis=1)                         # [B, steps]

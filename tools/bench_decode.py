@@ -30,7 +30,18 @@ def main(argv=None) -> int:
     p.add_argument("--prompt", type=int, default=8, help="prefill length")
     p.add_argument("--preset", default="400m",
                    choices=["1b", "400m", "tiny"])
+    p.add_argument("--mode", default="auto",
+                   choices=["auto", "fused", "stepwise"],
+                   help="fused = one scan program (fast dispatch, heavy "
+                        "compile); stepwise = prefill + one decode-step "
+                        "executable driven from the host (compiles in "
+                        "seconds; the right choice at 400m+ on tunneled "
+                        "backends). auto = stepwise for 400m/1b, fused "
+                        "for tiny.")
     args = p.parse_args(argv)
+    mode = args.mode
+    if mode == "auto":
+        mode = "fused" if args.preset == "tiny" else "stepwise"
 
     import jax
     import jax.numpy as jnp
@@ -62,15 +73,21 @@ def main(argv=None) -> int:
                                 (args.batch, args.prompt), 0,
                                 cfg.vocab_size)
 
-    def run(steps):
-        return llama.generate(cfg, params, prompt, steps)
+    if mode == "fused":
+        def run(steps):
+            return llama.generate(cfg, params, prompt, steps)
+        # ONE compiled program (static steps): the short prefill rides
+        # along in the measured time — with prompt << steps its
+        # contribution is a few percent
+        run_j = jax.jit(run, static_argnums=0)
+    else:
+        def run_j(steps):
+            return llama.generate_stepwise(cfg, params, prompt, steps)
 
-    # ONE compiled program (static steps): the short prefill rides along
-    # in the measured time — with prompt << steps its contribution is a few
-    # percent, and avoiding a second compile matters on tunneled backends
-    run_j = jax.jit(run, static_argnums=0)
-    toks = run_j(args.steps)          # compile + warmup
+    t0 = time.perf_counter()
+    toks = run_j(args.steps)          # compile + warmup + one full run
     int(toks[0, -1])                  # host sync
+    first_run_dt = time.perf_counter() - t0
     t0 = time.perf_counter()
     toks = run_j(args.steps)
     int(toks[0, -1])
@@ -79,9 +96,13 @@ def main(argv=None) -> int:
     print(json.dumps({
         "metric": "llama_decode_tokens_per_sec",
         "preset": args.preset,
+        "mode": mode,
         "params": n_params,
         "batch": args.batch,
         "steps": args.steps,
+        # compile + one full generation (in stepwise mode the run part
+        # is all the per-step dispatches, not negligible on tunnels)
+        "first_run_s": round(first_run_dt, 1),
         "tokens_per_sec": round(tps, 1),
         "ms_per_token": round(
             1000.0 * decode_dt / (args.steps + args.prompt), 3),
