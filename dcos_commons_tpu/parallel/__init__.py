@@ -14,6 +14,8 @@ ring_attention  sequence-parallel blockwise attention (shard_map + ppermute)
 ulysses         all-to-all head<->sequence resharded attention
 pipeline        pipeline-parallel microbatch loop (shard_map + ppermute)
 moe             expert-parallel mixture-of-experts (all_to_all dispatch)
+checkpoint      sharded checkpoints (per-shard files + manifest, bitwise
+                resume on the same mesh)
 """
 
 from .mesh import AXES, MeshSpec, named_sharding, P  # noqa: F401

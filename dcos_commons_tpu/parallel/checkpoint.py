@@ -1,0 +1,285 @@
+"""Sharded model checkpoints: per-shard files + manifest, orbax-style.
+
+The pickle checkpoints in ``frameworks/jax/worker.py`` device_get the
+whole tree onto one host — fine for MNIST, wrong for a tp/pp-sharded
+llama whose parameters deliberately never fit one host. Here every
+process writes ONLY the array shards it owns into its own directory
+(per-task persistent volumes survive relaunch — the reference's volume
+model, ``offer/evaluate/VolumeEvaluationStage.java:1``), and a gang that
+re-forms onto the same mesh restores bitwise-identical arrays.
+
+Layout, one directory per (step, process)::
+
+    <out>/step-00000042-p0/
+        manifest.json              # leaves -> shards, shapes, dtypes
+        params.layers.wq.o0_0_0.bin    # raw bytes of one shard
+        ...
+
+Commit protocol: shards + manifest are written to a dot-tmp directory,
+then ``os.rename``d into place — a crash mid-write leaves only tmp
+litter, never a half-checkpoint (same atomicity rule as the scheduler's
+FilePersister). Within a process, replicated shards are deduped by
+index (each distinct index is stored once, so every process can restore
+all of its addressable shards from its own volume alone). Pruning keeps
+the newest ``keep`` steps of THIS process's directories; gangs save in
+lock-step, so the policy is coordinated by construction.
+
+Restore picks the newest step every gang member has (single-process:
+its own newest; multi-process: the minimum of the members' newest,
+agreed via ``process_allgather``), then rebuilds each leaf with
+``jax.make_array_from_single_device_arrays`` on the template's
+sharding.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_STEP_RE = re.compile(r"step-(\d{8})-p(\d+)$")
+
+
+def _leaf_key(path) -> str:
+    """Stable flat name for a pytree path ('params.layers.wq')."""
+    parts = []
+    for entry in path:
+        name = getattr(entry, "key", None)
+        if name is None:
+            name = getattr(entry, "name", None)
+        if name is None:
+            name = getattr(entry, "idx", None)
+        parts.append(str(name))
+    return ".".join(parts) if parts else "_root"
+
+
+def _index_key(index, shape) -> str:
+    starts = [(s.start or 0) for s in index] if index else []
+    return "o" + "_".join(str(s) for s in starts) if starts else "o"
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency; covers bfloat16, fp8, ...
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _step_dir(out_dir: str, step: int, pid: int) -> str:
+    return os.path.join(out_dir, f"step-{step:08d}-p{pid}")
+
+
+def save_sharded(out_dir: str, step: int, tree: Any, keep: int = 3) -> str:
+    """Write this process's shards of ``tree`` (any pytree of jax arrays)
+    for ``step``; returns the committed directory."""
+    import jax
+
+    pid = jax.process_index()
+    final = _step_dir(out_dir, step, pid)
+    tmp = os.path.join(out_dir, f".step-{step:08d}-p{pid}.tmp")
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    leaves: Dict[str, dict] = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        key = _leaf_key(path)
+        arr = leaf if isinstance(leaf, jax.Array) else jax.numpy.asarray(leaf)
+        shards: List[dict] = []
+        seen = set()
+        for shard in arr.addressable_shards:
+            ikey = _index_key(shard.index, arr.shape)
+            if ikey in seen:
+                continue  # replica of a shard this process already wrote
+            seen.add(ikey)
+            data = np.asarray(shard.data)
+            fname = f"{key}.{ikey}.bin"
+            with open(os.path.join(tmp, fname), "wb") as f:
+                f.write(data.tobytes())
+            shards.append({"file": fname, "index": ikey,
+                           "local_shape": list(data.shape)})
+        leaves[key] = {"global_shape": list(arr.shape),
+                       "dtype": str(arr.dtype), "shards": shards}
+
+    manifest = {"step": step, "process": pid,
+                "num_processes": jax.process_count(), "leaves": leaves}
+    with open(os.path.join(tmp, "manifest.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    if os.path.isdir(final):
+        shutil.rmtree(final)
+    os.rename(tmp, final)  # commit point
+
+    # prune THIS process's old steps (lock-step saves keep gangs aligned)
+    mine = sorted(s for s in _local_steps(out_dir, pid) if s != step)
+    for old in mine[:-(keep - 1)] if keep > 1 else mine:
+        shutil.rmtree(_step_dir(out_dir, old, pid), ignore_errors=True)
+    return final
+
+
+def _local_steps(out_dir: str, pid: int) -> List[int]:
+    steps = []
+    try:
+        names = os.listdir(out_dir)
+    except OSError:
+        return []
+    for name in names:
+        m = _STEP_RE.match(name)
+        if m and int(m.group(2)) == pid \
+                and os.path.exists(os.path.join(out_dir, name,
+                                                "manifest.json")):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(out_dir: str) -> Optional[int]:
+    """Newest step EVERY gang member has committed (None when none).
+
+    Multi-process: each member contributes its local committed steps;
+    the restore step is the max step present on all of them
+    (a member that died before saving step N forces the gang back to
+    the last step all members share).
+    """
+    import jax
+
+    local = set(_local_steps(out_dir, jax.process_index()))
+    if jax.process_count() == 1:
+        return max(local) if local else None
+    from jax.experimental import multihost_utils
+
+    # fixed-size vector of this member's newest steps, -1 padded
+    newest = sorted(local)[-8:]
+    vec = np.full((8,), -1, np.int64)
+    vec[:len(newest)] = newest
+    all_vecs = np.asarray(multihost_utils.process_allgather(vec))
+    common = None
+    sets = [set(int(s) for s in row if s >= 0) for row in all_vecs]
+    common = set.intersection(*sets) if sets else set()
+    return max(common) if common else None
+
+
+def restore_sharded(out_dir: str, template: Any,
+                    step: Optional[int] = None) -> Any:
+    """Rebuild a pytree bitwise from this process's shard files.
+
+    ``template`` supplies structure, shapes, dtypes, and shardings —
+    pass the freshly-initialized (already sharded) tree; its VALUES are
+    discarded. Raises FileNotFoundError when no complete checkpoint
+    exists (callers fall through to a cold start).
+    """
+    import jax
+
+    if step is None:
+        step = latest_step(out_dir)
+        if step is None:
+            raise FileNotFoundError(f"no complete checkpoint under "
+                                    f"{out_dir!r}")
+    pid = jax.process_index()
+    step_d = _step_dir(out_dir, step, pid)
+    with open(os.path.join(step_d, "manifest.json"),
+              encoding="utf-8") as f:
+        manifest = json.load(f)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    out_leaves = []
+    for path, leaf in flat:
+        key = _leaf_key(path)
+        entry = manifest["leaves"].get(key)
+        if entry is None:
+            raise KeyError(f"checkpoint step {step} has no leaf {key!r}")
+        dtype = _np_dtype(entry["dtype"])
+        if not isinstance(leaf, jax.Array):
+            # host-side scalar/array leaf: single stored shard — same
+            # shape/dtype contract as jax leaves
+            np_leaf = np.asarray(leaf)
+            if list(np_leaf.shape) != entry["global_shape"] \
+                    or str(np_leaf.dtype) != entry["dtype"]:
+                raise ValueError(
+                    f"leaf {key!r}: template {np_leaf.shape}/"
+                    f"{np_leaf.dtype} vs checkpoint "
+                    f"{entry['global_shape']}/{entry['dtype']} — restore "
+                    "requires the same mesh/sharding/config")
+            shard = entry["shards"][0]
+            raw = _read(step_d, shard["file"])
+            value = np.frombuffer(raw, dtype=dtype).reshape(
+                shard["local_shape"])
+            out_leaves.append(dtype.type(value)
+                              if value.shape == () else value)
+            continue
+        if list(leaf.shape) != entry["global_shape"] \
+                or str(leaf.dtype) != entry["dtype"]:
+            raise ValueError(
+                f"leaf {key!r}: template {leaf.shape}/{leaf.dtype} vs "
+                f"checkpoint {entry['global_shape']}/{entry['dtype']} — "
+                "restore requires the same mesh/sharding/config")
+        by_index = {s["index"]: s for s in entry["shards"]}
+        assembled = None  # lazy: only if shardings differ save vs restore
+        singles = []
+        for shard in leaf.addressable_shards:
+            ikey = _index_key(shard.index, leaf.shape)
+            meta = by_index.get(ikey)
+            shard_shape = [
+                len(range(*s.indices(dim)))
+                for s, dim in zip(shard.index, leaf.shape)
+            ] if shard.index else []
+            if meta is not None and meta["local_shape"] == shard_shape:
+                raw = _read(step_d, meta["file"])
+                value = np.frombuffer(raw, dtype=dtype).reshape(
+                    meta["local_shape"])
+            else:
+                # the template shards this leaf differently than it was
+                # saved (e.g. fresh-init layout vs the train step's
+                # out_shardings): assemble the saved region once, then
+                # slice the needed piece out of it
+                if assembled is None:
+                    assembled = _assemble(step_d, entry, dtype)
+                data, covered = assembled
+                idx = tuple(shard.index)
+                if not covered[idx].all():
+                    raise KeyError(
+                        f"leaf {key!r}: step {step}'s local shard files "
+                        f"do not cover template shard {ikey} (checkpoint "
+                        "from a different mesh?)")
+                value = data[idx]
+            singles.append(jax.device_put(value, shard.device))
+        out_leaves.append(jax.make_array_from_single_device_arrays(
+            leaf.shape, leaf.sharding, singles))
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+def _assemble(step_dir: str, entry: dict, dtype):
+    """Paste a leaf's saved shards into one array covering their union.
+
+    Saved shards tile disjoint index ranges; locally-saved files cover at
+    least this process's addressable region (multi-process) or the whole
+    array (single process). Returns ``(data, covered)`` — the caller
+    checks coverage per REQUESTED slice, because in a multi-process gang
+    this process's files legitimately cover only its own region of the
+    global array.
+    """
+    out = np.zeros(entry["global_shape"], dtype=dtype)
+    covered = np.zeros(entry["global_shape"], dtype=bool)
+    for meta in entry["shards"]:
+        raw = _read(step_dir, meta["file"])
+        value = np.frombuffer(raw, dtype=dtype).reshape(meta["local_shape"])
+        offsets = ([int(o) for o in meta["index"][1:].split("_")]
+                   if len(meta["index"]) > 1 else
+                   [0] * len(meta["local_shape"]))
+        slices = tuple(slice(o, o + n)
+                       for o, n in zip(offsets, meta["local_shape"]))
+        out[slices] = value
+        covered[slices] = True
+    return out, covered
+
+
+def _read(step_dir: str, fname: str) -> bytes:
+    with open(os.path.join(step_dir, fname), "rb") as f:
+        return f.read()

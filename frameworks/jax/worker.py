@@ -328,9 +328,13 @@ def run_llama_train(args) -> dict:
 def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
                       toks, mesh_report, attn_name):
     """Shared optimizer/compile/timed-loop/report tail of every llama-train
-    variant (dp-sp-tp, pipeline, MoE)."""
+    variant (dp-sp-tp, pipeline, MoE). Checkpoints are SHARDED
+    (parallel/checkpoint.py): each gang member persists only its own
+    shards on its own volume; a re-formed gang resumes bitwise from the
+    newest step every member holds."""
     import jax
     from dcos_commons_tpu.models import train
+    from dcos_commons_tpu.parallel import checkpoint as ckpt
 
     with mesh:
         opt = train.make_optimizer(lr=1e-3, warmup=5,
@@ -338,20 +342,58 @@ def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
         step = train.make_train_step(loss_fn, opt, mesh=mesh,
                                      param_spec_tree=specs, batch_spec=None)
         opt_state = train.init_opt_state(opt, params, mesh, specs)
-        params, opt_state, out = step(params, opt_state, toks)  # compile
+        # compile/warmup on the freshly-initialized values; a resumed
+        # run overwrites params/opt_state AFTER, so the warmup step does
+        # not advance the restored state
+        w_params, w_opt, out = step(params, opt_state, toks)
         float(out["loss"])
+        start = 0
+        resumed = False
+        if args.out and (resume_step := ckpt.latest_step(args.out)) \
+                is not None:
+            # template = the warmup OUTPUTS: the step donates its inputs
+            # (the originals are deleted buffers by now), and the outputs
+            # carry exactly the shardings later steps will use
+            tree = ckpt.restore_sharded(
+                args.out, {"params": w_params, "opt_state": w_opt},
+                resume_step)
+            params, opt_state = tree["params"], tree["opt_state"]
+            start = resume_step
+            resumed = True
+            _emit({"event": "resumed", "step": start, "sharded": True})
+        else:
+            params, opt_state = w_params, w_opt
         t0 = time.perf_counter()
-        for _ in range(args.steps):
+        steps_run = 0
+        for i in range(start, args.steps):
             params, opt_state, out = step(params, opt_state, toks)
-        loss = float(out["loss"])
+            steps_run += 1
+            if args.out and args.ckpt_every \
+                    and steps_run % args.ckpt_every == 0:
+                ckpt.save_sharded(args.out, i + 1,
+                                  {"params": params,
+                                   "opt_state": opt_state})
+                _emit({"event": "checkpoint", "step": i + 1})
         dt = time.perf_counter() - t0
+        if resumed and steps_run == 0:
+            # already at/past the target step: nothing ran, and `out` is
+            # the discarded warmup of a random init — report honestly and
+            # do NOT re-label the restored state under a smaller step
+            loss = None
+        else:
+            loss = float(out["loss"])
+            if args.out:
+                ckpt.save_sharded(args.out, args.steps,
+                                  {"params": params,
+                                   "opt_state": opt_state})
 
-    if args.out:
-        save_checkpoint(args.out, args.steps, params)
     seq = toks.shape[1] - 1
     return {"workload": "llama-train", "attn": attn_name, "seq": seq,
             "mesh": mesh_report, "final_loss": loss,
-            "tokens_per_sec": round(toks.shape[0] * seq * args.steps / dt, 1),
+            "steps_run": steps_run,
+            "tokens_per_sec": (round(
+                toks.shape[0] * seq * steps_run / dt, 1) if steps_run
+                else 0.0),
             "process_id": contract["process_id"]}
 
 
@@ -440,6 +482,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ep", type=int, default=0,
                    help="llama-train: expert-parallel mesh size (MoE)")
     p.add_argument("--out", default="")
+    p.add_argument("--ckpt-every", type=int, default=0,
+                   help="llama-train: save a sharded checkpoint every N "
+                        "steps (0 = only at the end); resume is automatic "
+                        "when --out holds one")
     return p
 
 

@@ -1,0 +1,174 @@
+"""Sharded checkpoint tests (parallel/checkpoint.py).
+
+The VERDICT done-criterion: a tp-sharded llama train on the CPU mesh is
+killed mid-run, the gang re-forms, and training resumes from step N with
+bitwise-identical params. Reference analogue: per-task persistent
+volumes surviving replace (``offer/evaluate/VolumeEvaluationStage.java``).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dcos_commons_tpu.models import llama, train
+from dcos_commons_tpu.parallel import checkpoint as ckpt
+from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+
+def _sharded_state(key=0):
+    mesh = MeshSpec(dp=2, tp=2, sp=2).build()
+    cfg = llama.LlamaConfig.tiny()
+    with mesh:
+        params = llama.shard_params(
+            llama.init_params(cfg, jax.random.key(key)), mesh, cfg)
+        opt = train.make_optimizer(lr=1e-3, warmup=1, decay_steps=10)
+        opt_state = train.init_opt_state(opt, params, mesh,
+                                         llama.param_specs(cfg))
+    return mesh, cfg, params, opt_state
+
+
+def _assert_tree_bitwise(a, b):
+    flat_a = jax.tree_util.tree_flatten_with_path(a)[0]
+    flat_b = jax.tree_util.tree_flatten_with_path(b)[0]
+    assert len(flat_a) == len(flat_b)
+    for (pa, la), (_, lb) in zip(flat_a, flat_b):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), pa
+        if isinstance(la, jax.Array) and isinstance(lb, jax.Array):
+            assert la.sharding == lb.sharding, pa
+
+
+class TestShardedRoundTrip:
+    def test_bitwise_restore_of_tp_sharded_tree(self, tmp_path):
+        _, _, params, opt_state = _sharded_state()
+        tree = {"params": params, "opt_state": opt_state}
+        ckpt.save_sharded(str(tmp_path), 3, tree)
+        # restore into a DIFFERENTLY-initialized template: values must
+        # come from disk, structure/sharding from the template
+        _, _, fresh, fresh_opt = _sharded_state(key=9)
+        restored = ckpt.restore_sharded(
+            str(tmp_path), {"params": fresh, "opt_state": fresh_opt})
+        _assert_tree_bitwise(restored["params"], params)
+        _assert_tree_bitwise(restored["opt_state"], opt_state)
+
+    def test_shard_files_not_whole_arrays(self, tmp_path):
+        """Every process writes per-shard files, not a device_get'd whole
+        tree: a tp-sharded weight's shard files are each a fraction of
+        the full array."""
+        _, cfg, params, _ = _sharded_state()
+        ckpt.save_sharded(str(tmp_path), 1, {"params": params})
+        step_dir = tmp_path / "step-00000001-p0"
+        manifest = json.loads((step_dir / "manifest.json").read_text())
+        wq = manifest["leaves"]["params.layers.wq"]
+        assert len(wq["shards"]) > 1  # split over tp
+        total = np.prod(wq["global_shape"])
+        for shard in wq["shards"]:
+            assert np.prod(shard["local_shape"]) < total
+
+    def test_latest_step_and_prune(self, tmp_path):
+        _, _, params, _ = _sharded_state()
+        for step in (1, 2, 3, 4, 5):
+            ckpt.save_sharded(str(tmp_path), step, {"params": params},
+                              keep=3)
+        assert ckpt.latest_step(str(tmp_path)) == 5
+        kept = sorted(p.name for p in tmp_path.iterdir()
+                      if p.name.startswith("step-"))
+        assert kept == ["step-00000003-p0", "step-00000004-p0",
+                        "step-00000005-p0"]
+
+    def test_restore_missing_is_filenotfound(self, tmp_path):
+        _, _, params, _ = _sharded_state()
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore_sharded(str(tmp_path), {"params": params})
+
+    def test_restore_rejects_shape_mismatch(self, tmp_path):
+        _, _, params, _ = _sharded_state()
+        ckpt.save_sharded(str(tmp_path), 1, {"params": params})
+        mesh = MeshSpec(dp=2, tp=2, sp=2).build()
+        other_cfg = llama.LlamaConfig.tiny(dim=32)
+        with mesh:
+            other = llama.shard_params(
+                llama.init_params(other_cfg, jax.random.key(0)), mesh,
+                other_cfg)
+        with pytest.raises(ValueError, match="restore requires"):
+            ckpt.restore_sharded(str(tmp_path), {"params": other})
+
+    def test_torn_write_is_invisible(self, tmp_path):
+        """A crash mid-save leaves a dot-tmp dir that latest_step ignores."""
+        _, _, params, _ = _sharded_state()
+        ckpt.save_sharded(str(tmp_path), 1, {"params": params})
+        (tmp_path / ".step-00000002-p0.tmp").mkdir()
+        (tmp_path / ".step-00000002-p0.tmp" / "junk.bin").write_bytes(b"x")
+        assert ckpt.latest_step(str(tmp_path)) == 1
+
+
+class TestKillAndResume:
+    """Kill a tp llama-train worker mid-run (SIGKILL, no cleanup); the
+    relaunched worker must resume from the last committed step with
+    bitwise-identical params — the scheduler-side gang re-form is covered
+    by TestGangRecovery in test_framework_jax.py; this is the task-side
+    half the volumes exist for."""
+
+    def test_worker_resumes_bitwise_after_kill(self, tmp_path):
+        out = str(tmp_path / "ckpt")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8")
+        cmd = [sys.executable, "-m", "frameworks.jax.worker",
+               "llama-train", "--steps", "40", "--seq", "32",
+               "--tp", "2", "--sp", "1", "--out", out,
+               "--ckpt-every", "1"]
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.Popen(cmd, cwd=repo, env=env,
+                                stdout=subprocess.PIPE, text=True)
+        # wait for at least two committed checkpoints, then SIGKILL
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            latest = ckpt.latest_step(out)
+            if latest is not None and latest >= 2:
+                break
+            time.sleep(0.25)
+        else:
+            proc.kill()
+            raise AssertionError("no checkpoint appeared before timeout")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+
+        resume_step = ckpt.latest_step(out)
+        assert resume_step is not None and resume_step >= 2
+        # snapshot what step N's params were on disk
+        mesh = MeshSpec(dp=4, sp=1, tp=2).build()  # mirrors the worker
+        cfg = llama.LlamaConfig.tiny(attn_impl="auto", max_seq=33)
+        with mesh:
+            template = llama.shard_params(
+                llama.init_params(cfg, jax.random.key(0)), mesh, cfg)
+        saved = ckpt.restore_sharded(out, {"params": template},
+                                     step=resume_step)["params"]
+
+        # relaunch: must emit resumed at exactly resume_step (or later if
+        # a later step committed between our poll and the kill)
+        run2 = subprocess.run(
+            cmd[:cmd.index("--steps") + 1] + [str(resume_step + 2)]
+            + cmd[cmd.index("--steps") + 2:],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=300)
+        assert run2.returncode == 0, run2.stdout + run2.stderr
+        events = [json.loads(l) for l in run2.stdout.splitlines()
+                  if l.startswith("{")]
+        resumed = [e for e in events if e.get("event") == "resumed"]
+        assert resumed and resumed[0]["step"] >= resume_step, events
+
+        # bitwise: the params the resumed run STARTED from are the params
+        # committed at the resume step
+        with mesh:
+            template2 = llama.shard_params(
+                llama.init_params(cfg, jax.random.key(1)), mesh, cfg)
+        reread = ckpt.restore_sharded(out, {"params": template2},
+                                      step=resumed[0]["step"])["params"]
+        if resumed[0]["step"] == resume_step:
+            _assert_tree_bitwise(reread, saved)
