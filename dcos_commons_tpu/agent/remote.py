@@ -86,6 +86,10 @@ class RemoteCluster:
                 "host_volumes": [list(hv) for hv in l.host_volumes],
                 "rlimits": [{"name": n, "soft": s, "hard": h}
                             for n, s, h in l.rlimits],
+                "seccomp_unconfined": l.seccomp_unconfined,
+                "seccomp_profile": l.seccomp_profile,
+                "ipc_mode": l.ipc_mode,
+                "shm_size_mb": l.shm_size_mb,
             } for l in plan.launches]}
         with self._lock:
             self._queues.setdefault(plan.agent.agent_id, []).append(command)

@@ -131,6 +131,13 @@ class TaskLaunch:
     # POSIX limits applied to the task process: (name, soft, hard);
     # soft/hard None = unlimited
     rlimits: Tuple[Tuple[str, Optional[int], Optional[int]], ...] = ()
+    # pod security controls (reference seccomp.yml / shm.yml): the agent
+    # installs the seccomp profile before exec and, for ipc PRIVATE,
+    # gives the task its own IPC namespace + tmpfs /dev/shm of shm MB
+    seccomp_unconfined: bool = False
+    seccomp_profile: Optional[str] = None
+    ipc_mode: Optional[str] = None
+    shm_size_mb: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -628,6 +635,10 @@ class Evaluator:
                                for hv in pod.host_volumes),
             rlimits=tuple((rl.name, rl.soft, rl.hard)
                           for rl in pod.rlimits),
+            seccomp_unconfined=pod.seccomp_unconfined,
+            seccomp_profile=pod.seccomp_profile,
+            ipc_mode=pod.ipc_mode,
+            shm_size_mb=pod.shm_size_mb,
             health_check_cmd=hc.cmd if hc else None,
             health_interval_s=hc_d.interval_s,
             health_grace_s=hc_d.grace_period_s,

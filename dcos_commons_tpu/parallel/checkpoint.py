@@ -57,7 +57,9 @@ def _leaf_key(path) -> str:
     return ".".join(parts) if parts else "_root"
 
 
-def _index_key(index, shape) -> str:
+def _index_key(index) -> str:
+    """Start offsets only: shards of one leaf tile disjointly, so offsets
+    identify them (extent is checked separately at restore)."""
     starts = [(s.start or 0) for s in index] if index else []
     return "o" + "_".join(str(s) for s in starts) if starts else "o"
 
@@ -72,6 +74,14 @@ def _np_dtype(name: str):
 
 def _step_dir(out_dir: str, step: int, pid: int) -> str:
     return os.path.join(out_dir, f"step-{step:08d}-p{pid}")
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def save_sharded(out_dir: str, step: int, tree: Any, keep: int = 3) -> str:
@@ -94,7 +104,7 @@ def save_sharded(out_dir: str, step: int, tree: Any, keep: int = 3) -> str:
         shards: List[dict] = []
         seen = set()
         for shard in arr.addressable_shards:
-            ikey = _index_key(shard.index, arr.shape)
+            ikey = _index_key(shard.index)
             if ikey in seen:
                 continue  # replica of a shard this process already wrote
             seen.add(ikey)
@@ -102,6 +112,8 @@ def save_sharded(out_dir: str, step: int, tree: Any, keep: int = 3) -> str:
             fname = f"{key}.{ikey}.bin"
             with open(os.path.join(tmp, fname), "wb") as f:
                 f.write(data.tobytes())
+                f.flush()
+                os.fsync(f.fileno())  # FilePersister-grade durability
             shards.append({"file": fname, "index": ikey,
                            "local_shape": list(data.shape)})
         leaves[key] = {"global_shape": list(arr.shape),
@@ -114,9 +126,11 @@ def save_sharded(out_dir: str, step: int, tree: Any, keep: int = 3) -> str:
         json.dump(manifest, f)
         f.flush()
         os.fsync(f.fileno())
+    _fsync_dir(tmp)  # directory entries of the shard files
     if os.path.isdir(final):
         shutil.rmtree(final)
     os.rename(tmp, final)  # commit point
+    _fsync_dir(out_dir)  # the rename itself
 
     # prune THIS process's old steps (lock-step saves keep gangs aligned)
     mine = sorted(s for s in _local_steps(out_dir, pid) if s != step)
@@ -160,7 +174,6 @@ def latest_step(out_dir: str) -> Optional[int]:
     vec = np.full((8,), -1, np.int64)
     vec[:len(newest)] = newest
     all_vecs = np.asarray(multihost_utils.process_allgather(vec))
-    common = None
     sets = [set(int(s) for s in row if s >= 0) for row in all_vecs]
     common = set.intersection(*sets) if sets else set()
     return max(common) if common else None
@@ -197,15 +210,16 @@ def restore_sharded(out_dir: str, template: Any,
             raise KeyError(f"checkpoint step {step} has no leaf {key!r}")
         dtype = _np_dtype(entry["dtype"])
         if not isinstance(leaf, jax.Array):
-            # host-side scalar/array leaf: single stored shard — same
-            # shape/dtype contract as jax leaves
+            # host-side scalar/array leaf: single stored shard. Shapes
+            # must match; dtype comes from the CHECKPOINT (a python int
+            # template reads back as the int32 jnp.asarray stored it as
+            # — comparing against np.asarray's int64 default would
+            # reject identical configs)
             np_leaf = np.asarray(leaf)
-            if list(np_leaf.shape) != entry["global_shape"] \
-                    or str(np_leaf.dtype) != entry["dtype"]:
+            if list(np_leaf.shape) != entry["global_shape"]:
                 raise ValueError(
-                    f"leaf {key!r}: template {np_leaf.shape}/"
-                    f"{np_leaf.dtype} vs checkpoint "
-                    f"{entry['global_shape']}/{entry['dtype']} — restore "
+                    f"leaf {key!r}: template shape {np_leaf.shape} vs "
+                    f"checkpoint {entry['global_shape']} — restore "
                     "requires the same mesh/sharding/config")
             shard = entry["shards"][0]
             raw = _read(step_d, shard["file"])
@@ -224,7 +238,7 @@ def restore_sharded(out_dir: str, template: Any,
         assembled = None  # lazy: only if shardings differ save vs restore
         singles = []
         for shard in leaf.addressable_shards:
-            ikey = _index_key(shard.index, leaf.shape)
+            ikey = _index_key(shard.index)
             meta = by_index.get(ikey)
             shard_shape = [
                 len(range(*s.indices(dim)))

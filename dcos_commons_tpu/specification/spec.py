@@ -355,6 +355,17 @@ class PodSpec:
     pre_reserved_role: Optional[str] = None
     allow_decommission: bool = True
     share_pid_namespace: bool = False
+    # seccomp profile selection (reference seccomp.yml:
+    # `seccomp-unconfined` / `seccomp-profile-name`): the agent installs
+    # the named profile (a denylist BPF filter) before exec; unconfined
+    # skips it explicitly
+    seccomp_unconfined: bool = False
+    seccomp_profile: Optional[str] = None
+    # IPC isolation + /dev/shm sizing (reference shm.yml `ipc-mode` /
+    # `shm-size`): PRIVATE = own IPC namespace with a private tmpfs
+    # /dev/shm of shm_size_mb; SHARE_PARENT = the agent's namespace
+    ipc_mode: Optional[str] = None
+    shm_size_mb: Optional[int] = None
     secrets: tuple[SecretSpec, ...] = ()
     # pod-level persistent volumes shared by every task of the pod instance
     # (reference RawPod `volume:`, pod-profile-mount-volume.yml)
@@ -411,6 +422,24 @@ class PodSpec:
             errs.append(f"pod {self.type}: count must be >= 1")
         if not self.tasks:
             errs.append(f"pod {self.type}: no tasks")
+        if self.ipc_mode not in (None, "PRIVATE", "SHARE_PARENT"):
+            errs.append(f"pod {self.type}: ipc_mode must be PRIVATE or "
+                        f"SHARE_PARENT, got {self.ipc_mode!r}")
+        if self.shm_size_mb is not None:
+            if self.shm_size_mb <= 0:
+                errs.append(f"pod {self.type}: shm_size_mb must be > 0")
+            if self.ipc_mode != "PRIVATE":
+                errs.append(f"pod {self.type}: shm-size requires "
+                            "ipc-mode: PRIVATE (a shared namespace's "
+                            "/dev/shm cannot be resized per pod)")
+        if self.seccomp_unconfined and self.seccomp_profile:
+            errs.append(f"pod {self.type}: seccomp-unconfined and "
+                        "seccomp-profile-name are mutually exclusive")
+        if self.seccomp_profile not in (None, "default"):
+            # fail at validation, not as a crash-looping TASK_FAILED —
+            # the agent only ships the "default" profile
+            errs.append(f"pod {self.type}: unknown seccomp profile "
+                        f"{self.seccomp_profile!r} (known: default)")
         if "__" in self.type or "-" in self.type and self.type.rsplit("-", 1)[-1].isdigit():
             # '<type>-<int>' must parse unambiguously back to (type, index).
             errs.append(f"pod type {self.type!r} collides with instance-name codec")
@@ -577,6 +606,10 @@ def _service_from_dict(data: Mapping[str, Any]) -> ServiceSpec:
             pre_reserved_role=pd.get("pre_reserved_role"),
             allow_decommission=pd.get("allow_decommission", True),
             share_pid_namespace=pd.get("share_pid_namespace", False),
+            seccomp_unconfined=pd.get("seccomp_unconfined", False),
+            seccomp_profile=pd.get("seccomp_profile"),
+            ipc_mode=pd.get("ipc_mode"),
+            shm_size_mb=pd.get("shm_size_mb"),
             secrets=tuple(SecretSpec(**s) for s in pd.get("secrets", ())),
             volumes=tuple(_volume_from_dict(v)
                           for v in pd.get("volumes", ())),

@@ -64,6 +64,13 @@ TASKCFG_ALL_PREFIX = "TASKCFG_ALL_"
 TASKCFG_POD_PREFIX = "TASKCFG_"
 
 
+def _yaml_bool(value: Any) -> bool:
+    """Mustache-rendered booleans arrive as strings ('true'/'false')."""
+    if isinstance(value, str):
+        return value.strip().lower() in ("true", "yes", "1")
+    return bool(value)
+
+
 def load_service_yaml(path: str | os.PathLike,
                       env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
     """Render + parse a service YAML file (reference ``RawServiceSpec.newBuilder``)."""
@@ -213,8 +220,14 @@ def _map_pod(pod_type: str, raw: Mapping[str, Any], env: Mapping[str, str],
         placement_rule=rule,
         tpu=tpu,
         pre_reserved_role=raw.get("pre-reserved-role"),
-        allow_decommission=bool(raw.get("allow-decommission", True)),
-        share_pid_namespace=bool(raw.get("share-pid-namespace", False)),
+        allow_decommission=_yaml_bool(raw.get("allow-decommission", True)),
+        share_pid_namespace=_yaml_bool(
+            raw.get("share-pid-namespace", False)),
+        seccomp_unconfined=_yaml_bool(raw.get("seccomp-unconfined", False)),
+        seccomp_profile=raw.get("seccomp-profile-name") or None,
+        ipc_mode=raw.get("ipc-mode") or None,
+        shm_size_mb=(None if raw.get("shm-size") is None
+                     else int(raw["shm-size"])),
         secrets=tuple(secrets),
         volumes=tuple(_map_volumes(raw)),
         host_volumes=tuple(host_volumes),

@@ -22,9 +22,17 @@
 #include <dirent.h>
 #include <fcntl.h>
 #include <limits.h>
+#include <linux/audit.h>
+#include <linux/filter.h>
+#include <linux/seccomp.h>
+#include <sched.h>
 #include <signal.h>
+#include <stddef.h>
+#include <sys/mount.h>
+#include <sys/prctl.h>
 #include <sys/resource.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
 #include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
@@ -150,6 +158,96 @@ int rlimit_by_name(std::string name) {
   if (name == "AS") return RLIMIT_AS;
   if (name == "RSS") return RLIMIT_RSS;
   return -1;
+}
+
+// -- pod security controls (reference seccomp.yml / shm.yml scenarios) ----
+
+// The "default" seccomp profile: a denylist of host-takeover syscalls
+// answered with EPERM (the task keeps running; the syscall just fails the
+// way it would for an unprivileged user). Mirrors the intent of the
+// reference's containerizer default profile without a container runtime.
+bool install_seccomp_default() {
+#if defined(__x86_64__)
+  constexpr unsigned int kArch = AUDIT_ARCH_X86_64;
+#elif defined(__aarch64__)
+  constexpr unsigned int kArch = AUDIT_ARCH_AARCH64;
+#else
+  return false;  // unknown arch: refuse rather than install a wrong filter
+#endif
+  static const long denied[] = {
+    SYS_mount, SYS_umount2, SYS_swapon, SYS_swapoff, SYS_reboot,
+    SYS_init_module, SYS_finit_module, SYS_delete_module,
+    SYS_pivot_root, SYS_acct, SYS_unshare, SYS_setns,
+    SYS_open_by_handle_at, SYS_kexec_load,
+#ifdef SYS_kexec_file_load
+    SYS_kexec_file_load,
+#endif
+#ifdef SYS_iopl
+    SYS_iopl,
+#endif
+#ifdef SYS_ioperm
+    SYS_ioperm,
+#endif
+  };
+  std::vector<struct sock_filter> prog;
+  // non-native ABIs would bypass a nr-based denylist (i386 via int 0x80
+  // reports a different arch; x32 reports the NATIVE arch with a biased
+  // nr) — deny both outright instead of trying to mirror the list
+  prog.push_back(BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                          offsetof(struct seccomp_data, arch)));
+  prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, kArch, 1, 0));
+  prog.push_back(BPF_STMT(BPF_RET | BPF_K,
+                          SECCOMP_RET_ERRNO | (ENOSYS & SECCOMP_RET_DATA)));
+  prog.push_back(BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                          offsetof(struct seccomp_data, nr)));
+#if defined(__x86_64__)
+  // x32 ABI: nr has bit 30 set while arch is AUDIT_ARCH_X86_64
+  prog.push_back(BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, 0x40000000u, 0, 1));
+  prog.push_back(BPF_STMT(BPF_RET | BPF_K,
+                          SECCOMP_RET_ERRNO | (ENOSYS & SECCOMP_RET_DATA)));
+#endif
+  for (long nr : denied) {
+    prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
+                            static_cast<unsigned int>(nr), 0, 1));
+    prog.push_back(BPF_STMT(BPF_RET | BPF_K,
+                            SECCOMP_RET_ERRNO | (EPERM & SECCOMP_RET_DATA)));
+  }
+  prog.push_back(BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW));
+  struct sock_fprog fprog;
+  fprog.len = static_cast<unsigned short>(prog.size());
+  fprog.filter = prog.data();
+  if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0) return false;
+  if (syscall(SYS_seccomp, SECCOMP_SET_MODE_FILTER, 0, &fprog) == 0) {
+    return true;
+  }
+  // older kernels: the prctl spelling of the same operation
+  return prctl(PR_SET_SECCOMP, SECCOMP_MODE_FILTER, &fprog) == 0;
+}
+
+// ipc-mode PRIVATE: own IPC namespace + private /dev/shm sized shm_mb
+// (reference shm.yml `ipc-mode: PRIVATE` + `shm-size:`). Runs in the
+// child BEFORE the seccomp filter (which denies unshare/mount).
+bool enter_private_ipc(long shm_mb, std::string& err) {
+  if (unshare(CLONE_NEWIPC | CLONE_NEWNS) != 0) {
+    err = std::string("unshare(ipc|mnt): ") + strerror(errno);
+    return false;
+  }
+  // keep our mounts from leaking back to the host namespace
+  if (mount(nullptr, "/", nullptr, MS_REC | MS_PRIVATE, nullptr) != 0) {
+    err = std::string("mount --make-rprivate /: ") + strerror(errno);
+    return false;
+  }
+  // PRIVATE always gets a private /dev/shm — without the mount, POSIX
+  // shm (shm_open) would still land in the host's shared tmpfs and only
+  // SysV IPC would be isolated. 64 MB default when no size was declared.
+  long size = shm_mb > 0 ? shm_mb : 64;
+  std::string opts = "mode=1777,size=" + std::to_string(size) + "m";
+  if (mount("tpu-shm", "/dev/shm", "tmpfs", MS_NOSUID | MS_NODEV,
+            opts.c_str()) != 0) {
+    err = std::string("mount tmpfs /dev/shm: ") + strerror(errno);
+    return false;
+  }
+  return true;
 }
 
 bool mkdirs(const std::string& path) {
@@ -581,6 +679,28 @@ class Agent {
       rlimits.push_back(req);
     }
 
+    // pod security controls, validated before fork so a bad value fails
+    // the launch with a readable status instead of an exit code
+    const std::string ipc_mode = task.get("ipc_mode").as_string();
+    const long shm_mb =
+        static_cast<long>(task.get("shm_size_mb").as_number(0));
+    if (!ipc_mode.empty() && ipc_mode != "PRIVATE"
+        && ipc_mode != "SHARE_PARENT") {
+      emit(task_id, task_name, "TASK_FAILED",
+           "unknown ipc_mode " + ipc_mode);
+      return;
+    }
+    const bool seccomp_unconfined =
+        task.get("seccomp_unconfined").as_bool();
+    const std::string seccomp_profile =
+        task.get("seccomp_profile").as_string();
+    if (!seccomp_unconfined && !seccomp_profile.empty()
+        && seccomp_profile != "default") {
+      emit(task_id, task_name, "TASK_FAILED",
+           "unknown seccomp profile " + seccomp_profile);
+      return;
+    }
+
     pid_t pid = fork();
     if (pid < 0) {
       emit(task_id, task_name, "TASK_FAILED", "fork failed");
@@ -627,6 +747,23 @@ class Agent {
                     rl.resource, strerror(errno));
             _exit(125);
           }
+        }
+      }
+      // ipc/shm isolation first (needs unshare+mount), seccomp LAST so
+      // the filter cannot block our own setup
+      if (ipc_mode == "PRIVATE") {
+        std::string ipc_err;
+        if (!enter_private_ipc(shm_mb, ipc_err)) {
+          fprintf(stderr, "[tpu-agent] private ipc/shm: %s\n",
+                  ipc_err.c_str());
+          _exit(124);
+        }
+      }
+      if (!seccomp_unconfined && !seccomp_profile.empty()) {
+        if (!install_seccomp_default()) {
+          fprintf(stderr, "[tpu-agent] seccomp install failed: %s\n",
+                  strerror(errno));
+          _exit(123);
         }
       }
       execl("/bin/sh", "sh", "-c", cmd.c_str(), (char*)nullptr);

@@ -785,3 +785,85 @@ def test_authenticated_control_plane_e2e(native_bins, tmp_path):
             except subprocess.TimeoutExpired:
                 p.kill()
         server.stop()
+
+
+SECCOMP_SHM_YML = """
+name: sec-svc
+pods:
+  shm:
+    count: 1
+    ipc-mode: PRIVATE
+    shm-size: 64
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "df -m /dev/shm | tail -1 > shm.out && sleep 600"
+        cpus: 0.2
+        memory: 64
+  confined:
+    count: 1
+    seccomp-profile-name: default
+    tasks:
+      probe:
+        goal: RUNNING
+        cmd: "unshare -i true 2>/dev/null; echo rc=$? > seccomp.out; sleep 600"
+        cpus: 0.2
+        memory: 64
+  unconfined:
+    count: 1
+    seccomp-unconfined: true
+    tasks:
+      probe:
+        goal: RUNNING
+        cmd: "unshare -i true 2>/dev/null; echo rc=$? > seccomp.out; sleep 600"
+        cpus: 0.2
+        memory: 64
+"""
+
+
+def test_seccomp_and_shm_enforced(native_bins, tmp_path):
+    """Reference seccomp.yml/shm.yml semantics enforced by the real agent:
+    ipc-mode PRIVATE gets a private /dev/shm of exactly shm-size MB; the
+    default seccomp profile denies namespace-escape syscalls with EPERM
+    while an unconfined pod on the same host still may."""
+    cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.05)
+    sched = ServiceScheduler(load_service_yaml_str(SECCOMP_SHM_YML),
+                             MemPersister(), cluster)
+    server = ApiServer(sched, port=0, cluster=cluster)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    sandbox_root = tmp_path / "sandboxes"
+    agent = subprocess.Popen(
+        [str(native_bins / "tpu-agent"), "--scheduler", url,
+         "--agent-id", "sec0", "--hostname", "sec0",
+         "--cpus", "4", "--memory-mb", "4096", "--disk-mb", "10000",
+         "--base-dir", str(sandbox_root), "--poll-interval", "0.05",
+         "--tpu-chips", "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        drive_to(sched, "deploy", Status.COMPLETE, timeout=40)
+
+        def sandbox_file(task_prefix, name):
+            for d in sandbox_root.iterdir():
+                if d.name.startswith(task_prefix):
+                    f = d / name
+                    if f.exists():
+                        return f.read_text()
+            return None
+
+        shm_out = wait_for(lambda: sandbox_file("shm-0-server", "shm.out"),
+                           message="shm probe output")
+        # df -m: size column is 64 for the private tmpfs
+        assert shm_out.split()[1] == "64", shm_out
+        confined = wait_for(
+            lambda: sandbox_file("confined-0-probe", "seccomp.out"),
+            message="confined probe output")
+        assert confined.strip() != "rc=0", confined  # EPERM under profile
+        unconfined = wait_for(
+            lambda: sandbox_file("unconfined-0-probe", "seccomp.out"),
+            message="unconfined probe output")
+        assert unconfined.strip() == "rc=0", unconfined
+    finally:
+        agent.terminate()
+        agent.wait(timeout=5)
+        server.stop()

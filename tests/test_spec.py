@@ -268,6 +268,33 @@ pods:
         with pytest.raises(ValueError, match="declared by both"):
             load_service_yaml_str(yml, {})
 
+    def test_ipc_and_seccomp_validation(self):
+        import pytest
+        base = """
+name: svc
+pods:
+  hello:
+    count: 1
+    %s
+    tasks:
+      server: {goal: RUNNING, cmd: run, cpus: 0.1, memory: 32}
+"""
+        spec = load_service_yaml_str(
+            base % "ipc-mode: PRIVATE\n    shm-size: 128", {})
+        pod = spec.pod("hello")
+        assert pod.ipc_mode == "PRIVATE" and pod.shm_size_mb == 128
+        spec = load_service_yaml_str(
+            base % "seccomp-profile-name: default", {})
+        assert spec.pod("hello").seccomp_profile == "default"
+        with pytest.raises(ValueError, match="ipc_mode must be"):
+            load_service_yaml_str(base % "ipc-mode: WEIRD", {})
+        with pytest.raises(ValueError, match="requires\\s+ipc-mode"):
+            load_service_yaml_str(base % "shm-size: 64", {})
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            load_service_yaml_str(
+                base % ("seccomp-unconfined: true\n"
+                        "    seccomp-profile-name: default"), {})
+
     def test_rs_volumes_may_share_a_path(self):
         # reference enable-disable.yml: two tasks' resource sets both mount
         # the same container path — legal
