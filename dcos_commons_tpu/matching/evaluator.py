@@ -540,6 +540,15 @@ class Evaluator:
         env[ENV_POD_INSTANCE_INDEX] = str(requirement.pod_instance.index)
         env[ENV_FRAMEWORK_NAME] = self._service_name
         env[ENV_FRAMEWORK_HOST] = f"{self._service_name}.{self._tld}"
+        # XLA dump plumbing (SURVEY §5): spec env asks for a dump dir via
+        # TPU_XLA_DUMP_DIR; the flag must be present BEFORE the task's
+        # interpreter initializes its backend, so the scheduler injects it
+        # into the launch env here rather than trusting task-side code to
+        # be early enough
+        dump_dir = env.get("TPU_XLA_DUMP_DIR")
+        if dump_dir and "xla_dump_to" not in env.get("XLA_FLAGS", ""):
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                                + f" --xla_dump_to={dump_dir}").strip()
         for port_name, port in reservation.ports.items():
             port_spec = next(p for p in pod.resource_set(
                 task_spec.resource_set_id).ports if p.name == port_name)

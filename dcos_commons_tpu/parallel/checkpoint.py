@@ -210,16 +210,17 @@ def restore_sharded(out_dir: str, template: Any,
             raise KeyError(f"checkpoint step {step} has no leaf {key!r}")
         dtype = _np_dtype(entry["dtype"])
         if not isinstance(leaf, jax.Array):
-            # host-side scalar/array leaf: single stored shard. Shapes
-            # must match; dtype comes from the CHECKPOINT (a python int
-            # template reads back as the int32 jnp.asarray stored it as
-            # — comparing against np.asarray's int64 default would
-            # reject identical configs)
-            np_leaf = np.asarray(leaf)
-            if list(np_leaf.shape) != entry["global_shape"]:
+            # host-side scalar/array leaf: single stored shard. Normalize
+            # the template through the same coercion save_sharded used
+            # (jnp.asarray: a python int is int32 under default jax),
+            # then hold it to the full shape+dtype contract
+            np_leaf = np.asarray(jax.numpy.asarray(leaf))
+            if list(np_leaf.shape) != entry["global_shape"] \
+                    or str(np_leaf.dtype) != entry["dtype"]:
                 raise ValueError(
-                    f"leaf {key!r}: template shape {np_leaf.shape} vs "
-                    f"checkpoint {entry['global_shape']} — restore "
+                    f"leaf {key!r}: template {np_leaf.shape}/"
+                    f"{np_leaf.dtype} vs checkpoint "
+                    f"{entry['global_shape']}/{entry['dtype']} — restore "
                     "requires the same mesh/sharding/config")
             shard = entry["shards"][0]
             raw = _read(step_d, shard["file"])

@@ -331,7 +331,7 @@ def _map_task(name: str, raw: Mapping[str, Any], rs_id: str,
             prefix=disc_raw.get("prefix"),
             visibility=disc_raw.get("visibility", "CLUSTER"),
         ) if disc_raw else None,
-        essential=bool(raw.get("essential", True)),
+        essential=_yaml_bool(raw.get("essential", True)),
         kill_grace_period_s=int(raw.get("kill-grace-period", 5)),
         uris=tuple(raw.get("uris") or ()),
         transport_encryption=tuple(

@@ -486,12 +486,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="llama-train: save a sharded checkpoint every N "
                         "steps (0 = only at the end); resume is automatic "
                         "when --out holds one")
+    p.add_argument("--profile-dir", default="",
+                   help="write a jax.profiler trace of the whole workload "
+                        "here (env TPU_PROFILE_DIR also works, so specs "
+                        "can toggle profiling via TASKCFG_* env without "
+                        "editing cmds); view with tensorboard/xprof")
     return p
 
 
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
     args = build_parser().parse_args(argv)
+    # Environments whose sitecustomize pre-registers a backend ignore the
+    # JAX_PLATFORMS env var; backend SELECTION is still lazy, so an
+    # explicit config.update honors the operator's choice (the
+    # tests/_jax_cpu.py mechanism — without this, CPU-mesh subprocess
+    # runs silently land on the default backend)
+    want_platform = os.environ.get("JAX_PLATFORMS")
+    if want_platform:
+        import jax
+        jax.config.update("jax_platforms", want_platform)
     num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1"))
     if num_slices > 1 and args.workload != "resnet":
         # only the dp trainer builds a dcn-aware mesh today; any other mode
@@ -502,10 +516,27 @@ def main(argv=None) -> int:
               "use the resnet dp trainer or drop tpu.slices",
               file=sys.stderr)
         return 2
+    # XLA dump plumbing (SURVEY §5 tracing/profiling): the flag must be in
+    # the env BEFORE jax initializes, so it only takes effect when the
+    # worker runs as its own process (the production path — tasks are
+    # `python -m frameworks.jax.worker ...`); in-process callers that
+    # already imported jax keep their existing backend flags
+    dump_dir = os.environ.get("TPU_XLA_DUMP_DIR", "")
+    if dump_dir and "xla_dump_to" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + f" --xla_dump_to={dump_dir}").strip()
     _emit({"event": "start", "workload": args.workload,
            "task": os.environ.get("TASK_NAME", "?"),
            "pod_index": os.environ.get("POD_INSTANCE_INDEX", "0")})
-    result = WORKLOADS[args.workload](args)
+    profile_dir = args.profile_dir or os.environ.get("TPU_PROFILE_DIR", "")
+    if profile_dir:
+        import jax
+        os.makedirs(profile_dir, exist_ok=True)
+        _emit({"event": "profiling", "dir": profile_dir})
+        with jax.profiler.trace(profile_dir):
+            result = WORKLOADS[args.workload](args)
+    else:
+        result = WORKLOADS[args.workload](args)
     _emit({"event": "done", **result})
     return 0
 

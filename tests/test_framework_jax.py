@@ -315,3 +315,77 @@ class TestMultisliceRecovery:
         assert after == before
         from dcos_commons_tpu.plan import Status
         assert runner.scheduler.plan("recovery").status is Status.COMPLETE
+
+
+class TestProfilerHooks:
+    """SURVEY §5: jax profiler + XLA dump hooks in the workload layer."""
+
+    def test_profile_dir_writes_a_trace(self, tmp_path, capsys):
+        prof = tmp_path / "prof"
+        rc = worker.main(["mnist", "--steps", "2",
+                          "--profile-dir", str(prof)])
+        assert rc == 0
+        events = [json.loads(line)
+                  for line in capsys.readouterr().out.splitlines()]
+        assert any(e.get("event") == "profiling" for e in events)
+        traces = list(prof.rglob("*.xplane.pb")) \
+            + list(prof.rglob("*.trace.json.gz"))
+        assert traces, f"no trace files under {prof}"
+
+    def test_profile_dir_via_env(self, tmp_path, capsys, monkeypatch):
+        prof = tmp_path / "prof-env"
+        monkeypatch.setenv("TPU_PROFILE_DIR", str(prof))
+        rc = worker.main(["mnist", "--steps", "1"])
+        assert rc == 0
+        assert list(prof.rglob("*.xplane.pb")) \
+            or list(prof.rglob("*.trace.json.gz"))
+
+    def test_xla_dump_via_launch_env(self, tmp_path):
+        # XLA_FLAGS must precede the task interpreter's backend init, so
+        # the SCHEDULER injects it into the launch env from
+        # TPU_XLA_DUMP_DIR (evaluator._build_launch); here we run the
+        # worker exactly as the agent would exec it, with that env
+        import subprocess
+        import sys
+        dump = tmp_path / "xla-dump"
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8 "
+                             f"--xla_dump_to={dump}")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, "-m", "frameworks.jax.worker", "mnist",
+             "--steps", "1"], cwd=repo, env=env, capture_output=True,
+            text=True, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert dump.exists() and any(dump.iterdir()), \
+            f"no XLA dump artifacts under {dump}"
+
+    def test_scheduler_injects_xla_flags_from_dump_env(self):
+        # the spec-env half: TPU_XLA_DUMP_DIR in task env becomes
+        # XLA_FLAGS in the launch command
+        from dcos_commons_tpu.matching import (Evaluator,
+                                               ReservationLedger)
+        from dcos_commons_tpu.plan import PodInstanceRequirement
+        from dcos_commons_tpu.specification import (PodInstance,
+                                                    load_service_yaml_str)
+        from dcos_commons_tpu.testing.simulation import default_agents
+        yml = """
+name: svc
+pods:
+  trainer:
+    count: 1
+    tasks:
+      train:
+        goal: RUNNING
+        cmd: python -m frameworks.jax.worker mnist
+        cpus: 0.5
+        memory: 128
+        env: {TPU_XLA_DUMP_DIR: /mnt/dumps}
+"""
+        spec = load_service_yaml_str(yml, {})
+        pod = spec.pod("trainer")
+        req = PodInstanceRequirement(PodInstance(pod, 0), ("train",))
+        plan, _ = Evaluator("svc").evaluate(req, default_agents(1), [],
+                                            ReservationLedger())
+        env = plan.launches[0].env
+        assert env["XLA_FLAGS"] == "--xla_dump_to=/mnt/dumps"
