@@ -180,11 +180,28 @@ class Evaluator:
 
         # a permanently-failed pod is a fresh launch no matter which plan
         # drives it (reference OfferEvaluator.java:263-277 consults the
-        # FailureUtils label, not the plan)
+        # FailureUtils label, not the plan) — UNLESS the replace is already
+        # underway: the PERMANENT step GCs old reservations before
+        # evaluating, so when a relaunched (unmarked) sibling task lives on
+        # an agent holding the pod's current reservations, those are FRESH
+        # reservations from an earlier step of this same replace (e.g.
+        # hdfs's bootstrap->node phase) and later steps must land on that
+        # agent, not scatter the pod.
+        pod_records = [t for t in tasks if t.pod_instance_name == pod_name]
+        has_marker = any(t.permanently_failed for t in pod_records)
+        # agents hosting an unmarked sibling, EXCLUDING any agent a marked
+        # record lived on: an old un-GC'd reservation on the failed agent
+        # (where ONCE sidecar records may also still sit) must not read as
+        # "replace underway" — only a sibling relaunched elsewhere can
+        failed_agents = {t.agent_id for t in pod_records
+                         if t.permanently_failed}
+        fresh_agents = {t.agent_id for t in pod_records
+                        if not t.permanently_failed} - failed_agents
+        mid_replace = any(r.agent_id in fresh_agents
+                          for r in ledger.for_pod(pod_name))
         replace_mode = (
             requirement.recovery_type is RecoveryType.PERMANENT
-            or any(t.permanently_failed for t in tasks
-                   if t.pod_instance_name == pod_name))
+            or (has_marker and not mid_replace))
         pinned_agent = None if replace_mode else \
             self._pinned_agent(requirement, ledger)
         gang_slice, gang_err = self._gang_slice(requirement, agents, tasks,

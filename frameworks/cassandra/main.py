@@ -34,11 +34,77 @@ DEFAULT_ENV: Mapping[str, str] = {
     "NODE_MEM": "8192",
     "NODE_DISK": "10240",
     "NODE_DISK_TYPE": "ROOT",
-    "BACKUP_DIR": "./backups",
+    "NODE_PLACEMENT": '[["hostname", "MAX_PER", "1"]]',
+    "SIDECAR_CPUS": "0.5",
+    "SIDECAR_MEM": "512",
     "SLEEP_DURATION": "1000",
     "PERMANENT_FAILURE_TIMEOUT_SECS": "120",
     "MIN_REPLACE_DELAY_SECS": "0",
+    # backup/restore parameterization (reference S3_BUCKET_PATH et al.;
+    # EXTERNAL_LOCATION is any mounted or remote path)
+    "BACKUP_NAME": "default-backup",
+    "EXTERNAL_LOCATION": "./backups",
+    "BACKUP_DIR": "./backups",  # legacy alias kept for operators
+    # cassandra.yaml knobs (reference universe/config.json option surface)
+    "CASSANDRA_CLUSTER_NAME": "cassandra",
+    "CASSANDRA_NATIVE_PORT": "9042",
+    "CASSANDRA_STORAGE_PORT": "7000",
+    "CASSANDRA_SSL_STORAGE_PORT": "7001",
+    "CASSANDRA_JMX_PORT": "7199",
+    "CASSANDRA_LISTEN_ADDRESS": "0.0.0.0",
+    "CASSANDRA_RPC_ADDRESS": "0.0.0.0",
+    "CASSANDRA_NUM_TOKENS": "256",
+    "CASSANDRA_HINTED_HANDOFF_ENABLED": "true",
+    "CASSANDRA_MAX_HINT_WINDOW_IN_MS": "10800000",
+    "CASSANDRA_HINTED_HANDOFF_THROTTLE_IN_KB": "1024",
+    "CASSANDRA_HINTS_FLUSH_PERIOD_IN_MS": "10000",
+    "CASSANDRA_BATCHLOG_REPLAY_THROTTLE_IN_KB": "1024",
+    "CASSANDRA_AUTHENTICATOR": "AllowAllAuthenticator",
+    "CASSANDRA_AUTHORIZER": "AllowAllAuthorizer",
+    "CASSANDRA_ROLES_VALIDITY_IN_MS": "2000",
+    "CASSANDRA_PERMISSIONS_VALIDITY_IN_MS": "2000",
+    "CASSANDRA_CONCURRENT_READS": "16",
+    "CASSANDRA_CONCURRENT_WRITES": "32",
+    "CASSANDRA_CONCURRENT_COUNTER_WRITES": "16",
+    "CASSANDRA_MEMTABLE_ALLOCATION_TYPE": "heap_buffers",
+    "CASSANDRA_MEMTABLE_FLUSH_WRITERS": "2",
+    "CASSANDRA_KEY_CACHE_SIZE_MB": "100",
+    "CASSANDRA_KEY_CACHE_SAVE_PERIOD": "14400",
+    "CASSANDRA_ROW_CACHE_SIZE_MB": "0",
+    "CASSANDRA_COUNTER_CACHE_SIZE_MB": "50",
+    "CASSANDRA_COMMITLOG_SYNC_PERIOD_IN_MS": "10000",
+    "CASSANDRA_COMMITLOG_SEGMENT_SIZE_IN_MB": "32",
+    "CASSANDRA_COMMITLOG_TOTAL_SPACE_IN_MB": "8192",
+    "CASSANDRA_COMPACTION_THROUGHPUT_MB_PER_SEC": "16",
+    "CASSANDRA_CONCURRENT_COMPACTORS": "2",
+    "CASSANDRA_READ_REQUEST_TIMEOUT_IN_MS": "5000",
+    "CASSANDRA_WRITE_REQUEST_TIMEOUT_IN_MS": "2000",
+    "CASSANDRA_RANGE_REQUEST_TIMEOUT_IN_MS": "10000",
+    "CASSANDRA_REQUEST_TIMEOUT_IN_MS": "10000",
+    "CASSANDRA_ENDPOINT_SNITCH": "GossipingPropertyFileSnitch",
+    "CASSANDRA_HEAP_MB": "4096",
+    "CASSANDRA_HEAP_NEW_MB": "400",
+    "CASSANDRA_RLIMIT_NOFILE": "100000",
+    "CASSANDRA_KEYSPACE": "system_auth",
+    "SECURITY_TRANSPORT_ENCRYPTION_ENABLED": "",
+    # locally-built bootstrap fetched into sandboxes for config rendering
+    # (production overrides with the package artifact URL)
+    "BOOTSTRAP_URI": "file://" + os.path.abspath(os.path.join(
+        os.path.dirname(__file__), "..", "..", "native", "bin",
+        "tpu-bootstrap")),
 }
+
+
+def _inject_computed_env(merged: dict) -> dict:
+    """Reference ``Main.java:33-76`` custom env injection: the seed list is
+    the stable discovery names of instances 0..SEED_COUNT-1."""
+    if not merged.get("CASSANDRA_SEEDS"):
+        name = merged["FRAMEWORK_NAME"]
+        tld = merged.get("SERVICE_TLD", "tpu.local")
+        seeds = int(merged.get("SEED_COUNT", "2"))
+        merged["CASSANDRA_SEEDS"] = ",".join(
+            f"node-{i}-server.{name}.{tld}" for i in range(seeds))
+    return merged
 
 
 def load_spec(env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
@@ -46,6 +112,7 @@ def load_spec(env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
     merged.update(os.environ)
     if env:
         merged.update(env)
+    _inject_computed_env(merged)
     return load_service_yaml(os.path.join(DIST, "svc.yml"), merged)
 
 
@@ -56,6 +123,7 @@ def build_scheduler(persister, cluster, env=None, **kwargs):
     merged.update(os.environ)
     if env:
         merged.update(env)
+    _inject_computed_env(merged)
     spec = load_service_yaml(os.path.join(DIST, "svc.yml"), merged)
     seeds = int(merged["SEED_COUNT"])
     return ServiceScheduler(

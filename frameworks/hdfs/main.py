@@ -30,14 +30,67 @@ DEFAULT_ENV: Mapping[str, str] = {
     "JOURNAL_CPUS": "1",
     "JOURNAL_MEM": "2048",
     "JOURNAL_DISK": "5120",
+    "JOURNAL_DISK_TYPE": "ROOT",
+    "JOURNAL_PLACEMENT": '[["hostname", "MAX_PER", "1"]]',
     "NAME_CPUS": "1",
     "NAME_MEM": "4096",
     "NAME_DISK": "5120",
+    "NAME_DISK_TYPE": "ROOT",
+    "NAME_PLACEMENT": '[["hostname", "MAX_PER", "1"]]',
     "DATA_CPUS": "1",
     "DATA_MEM": "4096",
     "DATA_DISK": "10240",
+    "DATA_DISK_TYPE": "ROOT",
+    "DATA_PLACEMENT": '[["hostname", "MAX_PER", "1"]]',
     "SLEEP_DURATION": "1000",
+    # hdfs-site/core-site knobs (reference universe/config.json surface)
+    "HDFS_SERVICE_NAME": "hdfs",
+    "HDFS_NAME_RPC_PORT": "9001",
+    "HDFS_NAME_HTTP_PORT": "9002",
+    "HDFS_JOURNAL_PORT": "8485",
+    "HDFS_JOURNAL_HTTP_PORT": "8480",
+    "HDFS_REPLICATION": "3",
+    "HDFS_AUTOMATIC_FAILOVER": "true",
+    "HDFS_PERMISSIONS_ENABLED": "false",
+    "HDFS_IMAGE_COMPRESS": "true",
+    "HDFS_NAME_HANDLER_COUNT": "20",
+    "HDFS_DATA_HANDLER_COUNT": "10",
+    "HDFS_HEARTBEAT_RECHECK_INTERVAL_MS": "60000",
+    "SECURITY_TRANSPORT_ENCRYPTION_ENABLED": "",
+    # locally-built bootstrap fetched into sandboxes for config rendering
+    "BOOTSTRAP_URI": "file://" + os.path.abspath(os.path.join(
+        os.path.dirname(__file__), "..", "..", "native", "bin",
+        "tpu-bootstrap")),
 }
+
+
+# knobs every task's rendered config needs: routed via TASKCFG_ALL_* (the
+# reference TaskEnvRouter mechanism) instead of triplicated env blocks
+_CONFIG_KEYS = (
+    "HDFS_SERVICE_NAME", "HDFS_NAME_RPC_PORT", "HDFS_NAME_HTTP_PORT",
+    "HDFS_JOURNAL_PORT", "HDFS_REPLICATION", "HDFS_AUTOMATIC_FAILOVER",
+    "HDFS_PERMISSIONS_ENABLED", "HDFS_IMAGE_COMPRESS",
+    "HDFS_NAME_HANDLER_COUNT", "HDFS_DATA_HANDLER_COUNT",
+    "HDFS_HEARTBEAT_RECHECK_INTERVAL_MS",
+    "SECURITY_TRANSPORT_ENCRYPTION_ENABLED", "HDFS_QJOURNAL",
+)
+
+
+def _inject_computed_env(merged: dict) -> dict:
+    """Reference Main.java-style env injection: the qjournal URI follows
+    JOURNAL_COUNT, and config knobs are routed into every task env."""
+    if not merged.get("HDFS_QJOURNAL"):
+        name = merged["FRAMEWORK_NAME"]
+        tld = merged.get("SERVICE_TLD", "tpu.local")
+        port = merged["HDFS_JOURNAL_PORT"]
+        count = int(merged.get("JOURNAL_COUNT", "3"))
+        hosts = ";".join(f"journal-{i}-node.{name}.{tld}:{port}"
+                         for i in range(count))
+        merged["HDFS_QJOURNAL"] = \
+            f"qjournal://{hosts}/{merged['HDFS_SERVICE_NAME']}"
+    for key in _CONFIG_KEYS:
+        merged.setdefault(f"TASKCFG_ALL_{key}", merged[key])
+    return merged
 
 
 def load_spec(env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
@@ -45,6 +98,7 @@ def load_spec(env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
     merged.update(os.environ)
     if env:
         merged.update(env)
+    _inject_computed_env(merged)
     return load_service_yaml(os.path.join(DIST, "svc.yml"), merged)
 
 

@@ -36,37 +36,80 @@ std::string getenv_str(const char* name, const std::string& dflt = "") {
   return v ? std::string(v) : dflt;
 }
 
-// {{VAR}} substitution from env; {{!comment}} dropped; missing var -> fatal.
-std::string render(const std::string& tmpl, const std::string& src,
-                   bool strict) {
-  std::string out;
-  size_t pos = 0;
-  while (pos < tmpl.size()) {
-    size_t open = tmpl.find("{{", pos);
+// Mustache-style rendering from env, matching the scheduler-side
+// utils/template.py subset (the reference's Go bootstrap renders full
+// mustache): {{VAR}} substitution (missing var fatal in strict mode),
+// {{!comment}} dropped, {{#KEY}}...{{/KEY}} sections rendered iff KEY is
+// set, non-empty and != "false", {{^KEY}}...{{/KEY}} inverted.
+bool env_truthy(const std::string& key) {
+  const char* v = getenv(key.c_str());
+  if (v == nullptr) return false;
+  std::string s(v);
+  if (s.empty()) return false;
+  for (auto& c : s) c = static_cast<char>(tolower(c));
+  return s != "false";
+}
+
+// Renders from `pos` until {{/until}} (or end when until empty).
+// Appends to `out` when emit; returns the position after the close tag.
+size_t render_block(const std::string& t, size_t pos,
+                    const std::string& until, bool strict, bool emit,
+                    const std::string& src, std::string& out) {
+  while (true) {
+    size_t open = t.find("{{", pos);
     if (open == std::string::npos) {
-      out += tmpl.substr(pos);
-      break;
+      if (!until.empty()) {
+        std::cerr << "[tpu-bootstrap] unclosed section {{#" << until
+                  << "}} in " << src << "\n";
+        exit(1);
+      }
+      if (emit) out += t.substr(pos);
+      return t.size();
     }
-    out += tmpl.substr(pos, open - pos);
-    size_t close = tmpl.find("}}", open);
+    if (emit) out += t.substr(pos, open - pos);
+    size_t close = t.find("}}", open);
     if (close == std::string::npos) {
       std::cerr << "[tpu-bootstrap] unterminated {{ in " << src << "\n";
       exit(1);
     }
-    std::string key = tmpl.substr(open + 2, close - open - 2);
+    std::string key = t.substr(open + 2, close - open - 2);
     pos = close + 2;
-    if (!key.empty() && key[0] == '!') continue;  // comment
+    if (key.empty()) continue;
+    if (key[0] == '!') continue;  // comment
+    if (key[0] == '/') {
+      std::string name = key.substr(1);
+      if (name != until) {
+        std::cerr << "[tpu-bootstrap] mismatched {{/" << name
+                  << "}} in " << src << " (open section: "
+                  << (until.empty() ? "<none>" : until) << ")\n";
+        exit(1);
+      }
+      return pos;
+    }
+    if (key[0] == '#' || key[0] == '^') {
+      std::string name = key.substr(1);
+      bool truthy = env_truthy(name);
+      bool inner_emit = emit && (key[0] == '#' ? truthy : !truthy);
+      pos = render_block(t, pos, name, strict, inner_emit, src, out);
+      continue;
+    }
     const char* val = getenv(key.c_str());
     if (val == nullptr) {
-      if (strict) {
+      if (strict && emit) {
         std::cerr << "[tpu-bootstrap] template " << src
                   << " references undefined env var {{" << key << "}}\n";
         exit(1);
       }
       continue;
     }
-    out += val;
+    if (emit) out += val;
   }
+}
+
+std::string render(const std::string& tmpl, const std::string& src,
+                   bool strict) {
+  std::string out;
+  render_block(tmpl, 0, "", strict, true, src, out);
   return out;
 }
 

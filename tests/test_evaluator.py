@@ -148,6 +148,29 @@ class TestBasicMatching:
         # same stable ports
         assert plan2.launches[0].env["PORT_ADMIN"] == "15000"
 
+    def test_multi_step_replace_stays_on_one_agent(self):
+        """A later TRANSIENT step of a multi-step replace phase (hdfs
+        bootstrap->node) must pin to the agent the earlier step's fresh
+        reservation landed on — the stale permanently_failed marker on
+        the old task record must not scatter the pod."""
+        a1, a2, a3 = cpu_agent(1), cpu_agent(2), cpu_agent(3)
+        # old task record: marked permanently failed, lived on a1
+        tasks = [TaskRecord("hello-0-server", "hello", 0, "a1", "host1",
+                            permanently_failed=True)]
+        # earlier replace step already made a FRESH reservation on a3 and
+        # relaunched a sibling there (unmarked record)
+        from dataclasses import replace as dc_replace
+        pod = dc_replace(self.spec.pod("hello"), placement_rule=None)
+        tasks.append(TaskRecord("hello-0-sidecar", "hello", 0, "a3",
+                                "host3"))
+        self.ledger.add(Reservation("hello-0", "other-res", "a3", cpus=0.1))
+        r = PodInstanceRequirement(PodInstance(pod, 0), ("server",),
+                                   recovery_type=RecoveryType.TRANSIENT)
+        plan, outcome = self.ev.evaluate(r, [a1, a2, a3], tasks,
+                                         self.ledger)
+        assert plan is not None, outcome.failure_reasons()
+        assert plan.agent.agent_id == "a3"
+
     def test_permanent_replace_moves(self):
         a1, a2 = cpu_agent(1), cpu_agent(2)
         plan, _ = self.ev.evaluate(req(self.spec, "hello", 0), [a1, a2], [], self.ledger)

@@ -17,9 +17,15 @@ from frameworks.hdfs.recovery import hdfs_recovery_overrider
 
 def runner_for(env: dict | None = None, n_agents: int = 8
                ) -> ServiceTestRunner:
+    import dataclasses
+
+    from dcos_commons_tpu.agent.inventory import PortRange
     spec = hdfs_main.load_spec(env)
+    # classic fixed ports (8485/9001/...) need the full host port range
+    agents = [dataclasses.replace(a, ports=(PortRange(1025, 32000),))
+              for a in default_agents(n_agents)]
     return ServiceTestRunner(
-        spec=spec, agents=default_agents(n_agents),
+        spec=spec, agents=agents,
         recovery_overriders=[hdfs_recovery_overrider])
 
 
@@ -48,8 +54,8 @@ class TestDeploy:
         assert [p.name for p in plan.phases] == ["journal", "name", "data"]
         name_phase = plan.phases[1]
         assert [s.name for s in name_phase.steps] == [
-            "name-0:[format]", "name-0:[node]",
-            "name-1:[bootstrap]", "name-1:[node]"]
+            "name-0:[format]", "name-0:[node,zkfc]",
+            "name-1:[bootstrap]", "name-1:[node,zkfc]"]
 
 
 class TestReplaceRecovery:
