@@ -297,6 +297,10 @@ class ApiServer:
         rest = path[len("/v1/"):].strip("/")
         if rest == "auth/login":
             return self._login(method, body)
+        if rest == "auth/verify":
+            return self._verify(method, body, headers or {})
+        if rest == "auth/refresh":
+            return self._refresh(method, headers or {})
         if self._auth is not None:
             denied = self._authorize(method, rest, headers or {})
             if denied is not None:
@@ -345,6 +349,52 @@ class ApiServer:
             return e.code, {"error": e.message}
         return 200, {"token": token,
                      "ttl_s": self._auth.authority.ttl_s}
+
+    def _verify(self, method: str, body: Optional[bytes],
+                headers: dict) -> Tuple[int, object]:
+        """Workload-to-workload mutual auth (the KDC ticket-validation
+        analogue): any authenticated caller — including a task presenting
+        its own TPU_TASK_TOKEN — may validate a peer's token."""
+        from ..security.auth import AuthError
+        if self._auth is None:
+            return 404, {"error": "authentication not enabled"}
+        if method != "POST":
+            return 404, {"error": "POST {token} to /v1/auth/verify"}
+        try:
+            # caller must hold SOME valid token (task scope suffices)
+            self._auth.authenticate(headers)
+        except AuthError as e:
+            return e.code, {"error": e.message}
+        try:
+            data = json.loads(body.decode()) if body else {}
+            peer = str(data["token"])
+        except (ValueError, KeyError, AttributeError, TypeError):
+            return 400, {"error": "body must be JSON {token}"}
+        principal = self._auth.authority.verify(peer)
+        if principal is None:
+            return 200, {"valid": False}
+        return 200, {"valid": True, "uid": principal.uid,
+                     "scopes": list(principal.scopes)}
+
+    def _refresh(self, method: str,
+                 headers: dict) -> Tuple[int, object]:
+        """Renewable workload identity (kerberos ticket renewal analogue):
+        a still-valid token of any scope exchanges for a fresh one with
+        the same uid/scopes, so long-lived tasks keep their identity past
+        the initial TTL by refreshing before expiry."""
+        from ..security.auth import AuthError, TASK_TOKEN_TTL_S
+        if self._auth is None:
+            return 404, {"error": "authentication not enabled"}
+        if method != "POST":
+            return 404, {"error": "POST to /v1/auth/refresh"}
+        try:
+            principal = self._auth.authenticate(headers)
+        except AuthError as e:
+            return e.code, {"error": e.message}
+        ttl = (TASK_TOKEN_TTL_S if "task" in principal.scopes
+               else self._auth.authority.ttl_s)
+        return 200, {"token": self._auth.authority.mint(
+            principal.uid, principal.scopes, ttl_s=ttl), "ttl_s": ttl}
 
     def _authorize(self, method: str, rest: str,
                    headers: dict) -> Optional[Tuple[int, object]]:

@@ -160,7 +160,7 @@ class Evaluator:
 
     def __init__(self, service_name: str, outcome_tracker=None,
                  tls_provisioner=None, secrets_store=None,
-                 tld: str = DEFAULT_TLD):
+                 tld: str = DEFAULT_TLD, task_token_minter=None):
         self._service_name = service_name
         self._tld = tld
         self._tracker = outcome_tracker
@@ -168,6 +168,9 @@ class Evaluator:
         # per-task artifacts during launch construction
         self._tls = tls_provisioner
         self._secrets = secrets_store
+        # workload identity (KDC analogue): mints a per-task bearer token
+        # injected as TPU_TASK_TOKEN (redacted from stored records)
+        self._task_token_minter = task_token_minter
 
     def evaluate(self, requirement: PodInstanceRequirement,
                  agents: Sequence[AgentInfo], tasks: Sequence[TaskRecord],
@@ -189,16 +192,19 @@ class Evaluator:
         # agent, not scatter the pod.
         pod_records = [t for t in tasks if t.pod_instance_name == pod_name]
         has_marker = any(t.permanently_failed for t in pod_records)
-        # agents hosting an unmarked sibling, EXCLUDING any agent a marked
-        # record lived on: an old un-GC'd reservation on the failed agent
-        # (where ONCE sidecar records may also still sit) must not read as
-        # "replace underway" — only a sibling relaunched elsewhere can
-        failed_agents = {t.agent_id for t in pod_records
-                         if t.permanently_failed}
-        fresh_agents = {t.agent_id for t in pod_records
-                        if not t.permanently_failed} - failed_agents
-        mid_replace = any(r.agent_id in fresh_agents
-                          for r in ledger.for_pod(pod_name))
+        mid_replace = False
+        if has_marker:  # off the hot path: healthy pods skip the scans
+            # agents hosting an unmarked sibling, EXCLUDING any agent a
+            # marked record lived on: an old un-GC'd reservation on the
+            # failed agent (where ONCE sidecar records may also sit) must
+            # not read as "replace underway" — only a sibling relaunched
+            # elsewhere can
+            failed_agents = {t.agent_id for t in pod_records
+                             if t.permanently_failed}
+            fresh_agents = {t.agent_id for t in pod_records
+                            if not t.permanently_failed} - failed_agents
+            mid_replace = any(r.agent_id in fresh_agents
+                              for r in ledger.for_pod(pod_name))
         replace_mode = (
             requirement.recovery_type is RecoveryType.PERMANENT
             or (has_marker and not mid_replace))
@@ -629,6 +635,12 @@ class Evaluator:
                 if sec.file_path:
                     raw_files.append(
                         (sec.file_path, base64.b64encode(value).decode()))
+        if self._task_token_minter is not None:
+            # workload identity (KDC analogue): a fresh task-scoped token
+            # per launch; peers validate it at POST /v1/auth/verify
+            from ..security.auth import TASK_TOKEN_ENV
+            env[TASK_TOKEN_ENV] = self._task_token_minter(task_name)
+            secret_env_keys.append(TASK_TOKEN_ENV)
 
         # a cmd override (pause) replaces the real workload, so its health/
         # readiness probes must not run — the paused placeholder would fail

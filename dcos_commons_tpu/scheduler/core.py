@@ -63,7 +63,8 @@ class ServiceScheduler:
                  uninstall: bool = False,
                  agent_grace_s: Optional[float] = None,
                  metrics=None,
-                 tld: Optional[str] = None):
+                 tld: Optional[str] = None,
+                 auth=None):
         SchemaVersionStore(persister).check()
         # serializes run_cycle against status callbacks arriving from other
         # threads (RemoteCluster delivers on HTTP worker threads; the
@@ -100,6 +101,9 @@ class ServiceScheduler:
             # is Mesos's own /slaves; here the scheduler owns the registry)
             metrics.gauge("agents.registered",
                           lambda: float(len(cluster.agents())))
+        # control-plane Authenticator; when present the evaluator also
+        # mints per-task workload-identity tokens (KDC analogue)
+        self.auth = auth
         # kept for live config updates (update_config rebuilds plans)
         self._validators = validators
         self._failure_monitor = failure_monitor
@@ -228,10 +232,18 @@ class ServiceScheduler:
             self.tls_provisioner = TLSProvisioner(self._persister,
                                                   self.spec.name,
                                                   tld=self.tld)
+        minter = None
+        if self.auth is not None:
+            from ..security.auth import SCOPE_TASK, TASK_TOKEN_TTL_S
+
+            def minter(task_name: str) -> str:
+                return self.auth.authority.mint(task_name, [SCOPE_TASK],
+                                                ttl_s=TASK_TOKEN_TTL_S)
         self.evaluator = Evaluator(self.spec.name, self.outcome_tracker,
                                    tls_provisioner=self.tls_provisioner,
                                    secrets_store=self.secrets,
-                                   tld=self.tld)
+                                   tld=self.tld,
+                                   task_token_minter=minter)
 
     @property
     def uninstall_complete(self) -> bool:

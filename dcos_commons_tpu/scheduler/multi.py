@@ -205,11 +205,15 @@ class MultiServiceScheduler:
                  discipline: Optional[OfferDiscipline] = None,
                  scheduler_factory: Optional[Callable[..., ServiceScheduler]]
                  = None,
-                 api_server=None):
+                 api_server=None,
+                 auth=None):
         self._lock = threading.RLock()
         self.persister = persister
         self.cluster = cluster
         self._metrics = metrics
+        # control-plane Authenticator, handed to every child scheduler so
+        # multi-service tasks get workload-identity tokens too
+        self._auth = auth
         self.service_store = ServiceStore(persister)
         self.discipline = discipline or AllDiscipline()
         self._factory = scheduler_factory or ServiceScheduler
@@ -281,6 +285,8 @@ class MultiServiceScheduler:
             self._ownership[task.task_id] = spec.name
         if self._metrics is not None:
             kwargs.setdefault("metrics", self._metrics)
+        if self._auth is not None:
+            kwargs.setdefault("auth", self._auth)
         scheduler = self._factory(
             spec, self.persister, view, namespace=namespace,
             uninstall=uninstall, **kwargs)

@@ -39,6 +39,13 @@ from typing import Dict, Mapping, Optional, Sequence, Tuple
 
 SCOPE_OPERATOR = "operator"
 SCOPE_AGENT = "agent"
+# workload identity (the KDC/kerberos analogue, reference tools/kdc/kdc.py:
+# authenticated workloads): the scheduler mints a per-task token at launch,
+# delivered via TPU_TASK_TOKEN env; peers validate each other's tokens at
+# POST /v1/auth/verify. A task token reaches NO control-plane surface.
+SCOPE_TASK = "task"
+TASK_TOKEN_ENV = "TPU_TASK_TOKEN"
+TASK_TOKEN_TTL_S = 7 * 24 * 3600.0  # re-minted on every (re)launch
 
 _HEADER = "Authorization"
 
@@ -159,9 +166,9 @@ class Authenticator:
             raise AuthError(401, "bad service-account credentials")
         return self.authority.mint(acct.uid, acct.scopes)
 
-    def authorize(self, headers: Mapping[str, str],
-                  scope: str) -> Principal:
-        """Principal from the Authorization header, or AuthError."""
+    def authenticate(self, headers: Mapping[str, str]) -> Principal:
+        """Principal from the Authorization header (any scope), or
+        AuthError 401. The single place the header forms are parsed."""
         raw = headers.get(_HEADER) or headers.get(_HEADER.lower()) or ""
         token = ""
         if raw.startswith("token="):
@@ -174,6 +181,12 @@ class Authenticator:
         principal = self.authority.verify(token.strip())
         if principal is None:
             raise AuthError(401, "invalid or expired token")
+        return principal
+
+    def authorize(self, headers: Mapping[str, str],
+                  scope: str) -> Principal:
+        """Principal from the Authorization header, or AuthError."""
+        principal = self.authenticate(headers)
         if not principal.has_scope(scope):
             raise AuthError(
                 403, f"account {principal.uid!r} lacks scope {scope!r}")

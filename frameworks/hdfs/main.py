@@ -127,7 +127,8 @@ def main(argv=None) -> int:
     cluster = RemoteCluster()
     # control-plane auth: TPU_AUTH_FILE names the accounts file
     _auth = Authenticator.from_env()
-    scheduler = build_scheduler(persister, cluster, metrics=metrics)
+    scheduler = build_scheduler(persister, cluster, metrics=metrics,
+                                auth=_auth)
     scheduler.respec = lambda env: load_spec(env)
     server = ApiServer(scheduler, port=args.port, metrics=metrics,
                        cluster=cluster, auth=_auth)

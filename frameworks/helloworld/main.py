@@ -74,7 +74,7 @@ def main(argv=None) -> int:
         # mono-service (reference Main.java runDefaultService path)
         spec = scenarios.load_scenario(args.scenario[0])
         scheduler = ServiceScheduler(spec, persister, cluster,
-                                     metrics=metrics)
+                                     metrics=metrics, auth=_auth)
         # live updates: re-render this scenario with new option env
         scheduler.respec = (
             lambda env, _name=args.scenario[0]:
@@ -86,7 +86,8 @@ def main(argv=None) -> int:
     else:
         # multi-service, static or dynamic (reference
         # Main.java:54-82 multi paths + ExampleMultiServiceResource)
-        multi = MultiServiceScheduler(persister, cluster, metrics=metrics)
+        multi = MultiServiceScheduler(persister, cluster, metrics=metrics,
+                                      auth=_auth)
         server = ApiServer(None, port=args.port, metrics=metrics,
                            cluster=cluster, multi=multi, auth=_auth)
         multi.set_api_server(server)

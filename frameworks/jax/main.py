@@ -63,7 +63,8 @@ def main(argv=None) -> int:
     # control-plane auth: TPU_AUTH_FILE names the accounts file
     _auth = Authenticator.from_env()
     spec = scenarios.load_scenario(args.scenario)
-    scheduler = ServiceScheduler(spec, persister, cluster, metrics=metrics)
+    scheduler = ServiceScheduler(spec, persister, cluster, metrics=metrics,
+                                 auth=_auth)
     scheduler.respec = (lambda env, _name=args.scenario:
                         scenarios.load_scenario(_name, env))
     server = ApiServer(scheduler, port=args.port, metrics=metrics,

@@ -233,3 +233,122 @@ class TestAuthedRemoteTransport:
             assert any(c["type"] == "launch" for c in body["commands"])
         finally:
             server.stop()
+
+
+class TestWorkloadIdentity:
+    """Per-task identity tokens (the KDC analogue, reference
+    tools/kdc/kdc.py): minted at launch, redacted from stored records,
+    validatable by peers at /v1/auth/verify, powerless on the control
+    plane."""
+
+    def _deployed(self):
+        auth = Authenticator.from_config(generate_auth_config())
+        cluster = FakeCluster(default_agents(2))
+        sched = ServiceScheduler(load_service_yaml_str(YML), MemPersister(),
+                                 cluster, auth=auth)
+        for _ in range(30):
+            sched.run_cycle()
+            if sched.plan("deploy").status is Status.COMPLETE:
+                break
+        assert sched.plan("deploy").status is Status.COMPLETE
+        return auth, cluster, sched
+
+    def test_task_token_minted_and_redacted(self):
+        from dcos_commons_tpu.security.auth import TASK_TOKEN_ENV
+        auth, cluster, sched = self._deployed()
+        launch = cluster.launch_log[0].launches[0]
+        token = launch.env[TASK_TOKEN_ENV]
+        principal = auth.authority.verify(token)
+        assert principal is not None
+        assert principal.uid == "hello-0-server"
+        assert principal.scopes == ("task",)
+        # redacted from the stored record (same channel as secret env)
+        stored = sched.state.fetch_task("hello-0-server")
+        assert TASK_TOKEN_ENV not in stored.env or \
+            stored.env[TASK_TOKEN_ENV] != token
+
+    def test_task_token_powerless_on_control_plane(self):
+        auth, cluster, sched = self._deployed()
+        server = ApiServer(sched, port=0, cluster=cluster, auth=auth)
+        server.start()
+        url = f"http://127.0.0.1:{server.port}"
+        try:
+            from dcos_commons_tpu.security.auth import TASK_TOKEN_ENV
+            token = cluster.launch_log[0].launches[0].env[TASK_TOKEN_ENV]
+            hdr = {"Authorization": f"token={token}"}
+            assert _request(f"{url}/v1/plans", headers=hdr)[0] == 403
+            assert _request(f"{url}/v1/secrets", headers=hdr)[0] == 403
+            assert _request(f"{url}/v1/agents/register", "POST", b"{}",
+                            headers=hdr)[0] == 403
+        finally:
+            server.stop()
+
+    def test_peer_verification_endpoint(self):
+        auth, cluster, sched = self._deployed()
+        server = ApiServer(sched, port=0, cluster=cluster, auth=auth)
+        server.start()
+        url = f"http://127.0.0.1:{server.port}"
+        try:
+            from dcos_commons_tpu.security.auth import TASK_TOKEN_ENV
+            mine = cluster.launch_log[0].launches[0].env[TASK_TOKEN_ENV]
+            hdr = {"Authorization": f"token={mine}"}
+            # a task validates a peer's token (here: its own)
+            code, body = _request(
+                f"{url}/v1/auth/verify", "POST",
+                json.dumps({"token": mine}).encode(), headers=hdr)
+            assert code == 200 and body["valid"]
+            assert body["uid"] == "hello-0-server"
+            # forged peer token: invalid, not an error
+            code, body = _request(
+                f"{url}/v1/auth/verify", "POST",
+                json.dumps({"token": mine + "x"}).encode(), headers=hdr)
+            assert code == 200 and not body["valid"]
+            # unauthenticated caller cannot use the oracle
+            code, _ = _request(f"{url}/v1/auth/verify", "POST",
+                               json.dumps({"token": mine}).encode())
+            assert code == 401
+        finally:
+            server.stop()
+
+
+def test_token_refresh_extends_workload_identity():
+    """Long-lived tasks renew their identity before expiry (kerberos
+    ticket-renewal analogue): a valid token exchanges for a fresh one
+    with the same uid/scopes; an expired one cannot."""
+    auth = Authenticator.from_config(generate_auth_config())
+    cluster = FakeCluster(default_agents(1))
+    sched = ServiceScheduler(load_service_yaml_str(YML), MemPersister(),
+                             cluster, auth=auth)
+    server = ApiServer(sched, port=0, cluster=cluster, auth=auth)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        tok = auth.authority.mint("node-0-server", ["task"], ttl_s=60)
+        hdr = {"Authorization": f"token={tok}"}
+        code, body = _request(f"{url}/v1/auth/refresh", "POST",
+                              headers=hdr)
+        assert code == 200
+        fresh = auth.authority.verify(body["token"])
+        assert fresh.uid == "node-0-server"
+        assert fresh.scopes == ("task",)
+        assert body["ttl_s"] > 60
+        expired = auth.authority.mint("node-0-server", ["task"], ttl_s=-1)
+        code, _ = _request(f"{url}/v1/auth/refresh", "POST",
+                           headers={"Authorization": f"token={expired}"})
+        assert code == 401
+    finally:
+        server.stop()
+
+
+def test_multi_service_tasks_get_identity_tokens():
+    from dcos_commons_tpu.scheduler import MultiServiceScheduler
+    from dcos_commons_tpu.security.auth import TASK_TOKEN_ENV
+    auth = Authenticator.from_config(generate_auth_config())
+    cluster = FakeCluster(default_agents(2))
+    multi = MultiServiceScheduler(MemPersister(), cluster, auth=auth)
+    multi.add_service(load_service_yaml_str(YML))
+    for _ in range(30):
+        multi.run_cycle()
+    launch = cluster.launch_log[0].launches[0]
+    principal = auth.authority.verify(launch.env[TASK_TOKEN_ENV])
+    assert principal is not None and principal.uid == "hello-0-server"
