@@ -29,6 +29,21 @@ def _truthy(value: str | None) -> bool:
     return value is not None and value != "" and value.lower() != "false"
 
 
+def render_json_template(text: str, env: Mapping[str, str], *,
+                         strict: bool = True) -> str:
+    """Render a template whose output is JSON (scheduler.json.mustache):
+    every substituted VALUE is escaped for a JSON string context, so an
+    option like a quoted placement constraint cannot break the document.
+    Section truthiness is evaluated on the raw values."""
+    import json as _json
+
+    escaped = {k: _json.dumps(str(v))[1:-1] for k, v in env.items()}
+    # sections must see raw truthiness ("false" stays falsy), and the
+    # escape of a plain string never changes emptiness/"false"-ness, so
+    # the escaped map preserves section semantics
+    return render_template(text, escaped, strict=strict)
+
+
 def render_template(text: str, env: Mapping[str, str], *, strict: bool = True) -> str:
     """Render ``text`` against ``env``.
 

@@ -98,6 +98,11 @@ DEFAULT_ENV: Mapping[str, str] = {
 def _inject_computed_env(merged: dict) -> dict:
     """Reference ``Main.java:33-76`` custom env injection: the seed list is
     the stable discovery names of instances 0..SEED_COUNT-1."""
+    # legacy knob: operators who set BACKUP_DIR (the old name) keep their
+    # backup location when EXTERNAL_LOCATION was left at its default
+    if merged.get("EXTERNAL_LOCATION", "./backups") == "./backups" \
+            and merged.get("BACKUP_DIR", "./backups") != "./backups":
+        merged["EXTERNAL_LOCATION"] = merged["BACKUP_DIR"]
     if not merged.get("CASSANDRA_SEEDS"):
         name = merged["FRAMEWORK_NAME"]
         tld = merged.get("SERVICE_TLD", "tpu.local")

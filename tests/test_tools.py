@@ -287,3 +287,75 @@ class TestAirgapLinter:
         (fw / "dist" / "resource.json").write_text(
             '{"x": "https://artifacts.prod.corp/x.tgz"}')
         assert len(lint_framework(str(fw))) == 1
+
+
+class TestUniverseSchedulerRender:
+    """The reference's CosmosRenderer contract: config.json option
+    DEFAULTS rendered through scheduler.json.mustache must produce an env
+    that boots the framework's spec — catching drift between the
+    packaging surface and the service YAML's knobs."""
+
+    @staticmethod
+    def _defaults(schema: dict, prefix="") -> dict:
+        out = {}
+        for key, sub in schema.get("properties", {}).items():
+            path = f"{prefix}{key}"
+            if sub.get("type") == "object":
+                out.update(
+                    TestUniverseSchedulerRender._defaults(sub, path + "."))
+            elif "default" in sub:
+                d = sub["default"]
+                out[path] = ("true" if d is True else
+                             "false" if d is False else str(d))
+        return out
+
+    @staticmethod
+    def _render_env(universe: str) -> dict:
+        import json as _json
+        import os
+        from dcos_commons_tpu.utils.template import render_json_template
+        with open(os.path.join(universe, "config.json")) as f:
+            schema = _json.load(f)
+        opts = TestUniverseSchedulerRender._defaults(schema)
+        with open(os.path.join(universe, "scheduler.json.mustache")) as f:
+            # strict: a template key losing its config.json default must
+            # FAIL here, not silently render as ""
+            rendered = render_json_template(f.read(), opts, strict=True)
+        return _json.loads(rendered)["env"]
+
+    def test_cassandra_defaults_boot_the_spec(self):
+        from frameworks.cassandra.main import load_spec
+        env = self._render_env("frameworks/cassandra/universe")
+        # mustache false booleans render as "false" strings; the spec
+        # layer treats them as off
+        spec = load_spec(env)
+        server = spec.pod("node").task("server")
+        assert server.env["CASSANDRA_CLUSTER_NAME"] == "cassandra"
+        assert not server.transport_encryption  # security default off
+
+    def test_hdfs_defaults_boot_the_spec(self):
+        from frameworks.hdfs.main import load_spec
+        env = self._render_env("frameworks/hdfs/universe")
+        spec = load_spec(env)
+        assert {p.type for p in spec.pods} == {"journal", "name", "data"}
+        node = spec.pod("name").task("node")
+        assert "qjournal://journal-0-node" in node.env["HDFS_QJOURNAL"]
+
+    def test_jax_defaults_render_cleanly(self):
+        assert self._render_env("frameworks/jax/universe")
+
+    def test_quoted_option_cannot_break_the_json(self):
+        import json as _json
+        from dcos_commons_tpu.utils.template import render_json_template
+        rendered = render_json_template(
+            '{"env": {"NODE_PLACEMENT": "{{c}}"}}',
+            {"c": '[["hostname", "MAX_PER", "1"]]'})
+        env = _json.loads(rendered)["env"]
+        assert env["NODE_PLACEMENT"] == '[["hostname", "MAX_PER", "1"]]'
+
+    def test_legacy_backup_dir_still_honored(self):
+        from frameworks.cassandra.main import load_spec
+        spec = load_spec({"BACKUP_DIR": "/mnt/backups",
+                          "NODE_COUNT": "1", "SEED_COUNT": "1"})
+        backup = spec.pod("node").task("backup")
+        assert "/mnt/backups" in backup.cmd
