@@ -110,9 +110,25 @@ class StateStore:
     def __init__(self, persister: Persister, namespace: str = ""):
         self._persister = persister
         self._ns = f"Services/{_esc(namespace)}/" if namespace else ""
+        # Parse memoization keyed on the RAW BYTES (path -> (raw, parsed)):
+        # the scheduler re-reads every task/status several times per cycle
+        # (plan candidates, recovery scan, GC, task records) and JSON
+        # deserialization dominated the control-plane profile. Comparing
+        # raw bytes keeps this correct even if another StateStore instance
+        # writes through the same persister — a changed value re-parses.
+        # Safe because StoredTask/TaskStatus are frozen dataclasses.
+        self._parse_cache: dict[str, tuple[bytes, object]] = {}
 
     def _path(self, *parts: str) -> str:
         return self._ns + "/".join(parts)
+
+    def _parse(self, path: str, raw: bytes, parser):
+        hit = self._parse_cache.get(path)
+        if hit is not None and hit[0] == raw:
+            return hit[1]
+        obj = parser(raw)
+        self._parse_cache[path] = (raw, obj)
+        return obj
 
     # -- tasks -------------------------------------------------------------
 
@@ -124,9 +140,11 @@ class StateStore:
             for t in tasks})
 
     def fetch_task(self, task_name: str) -> Optional[StoredTask]:
-        raw = self._persister.get_or_none(
-            self._path(self.TASKS, _esc(task_name), self.TASK_INFO))
-        return StoredTask.from_json(raw) if raw is not None else None
+        path = self._path(self.TASKS, _esc(task_name), self.TASK_INFO)
+        raw = self._persister.get_or_none(path)
+        if raw is None:
+            return None
+        return self._parse(path, raw, StoredTask.from_json)
 
     def fetch_task_names(self) -> list[str]:
         try:
@@ -155,9 +173,11 @@ class StateStore:
             status.to_json())
 
     def fetch_status(self, task_name: str) -> Optional[TaskStatus]:
-        raw = self._persister.get_or_none(
-            self._path(self.TASKS, _esc(task_name), self.TASK_STATUS))
-        return TaskStatus.from_json(raw) if raw is not None else None
+        path = self._path(self.TASKS, _esc(task_name), self.TASK_STATUS)
+        raw = self._persister.get_or_none(path)
+        if raw is None:
+            return None
+        return self._parse(path, raw, TaskStatus.from_json)
 
     def fetch_statuses(self) -> dict[str, TaskStatus]:
         out = {}
@@ -169,8 +189,12 @@ class StateStore:
 
     def delete_task(self, task_name: str) -> None:
         """Reference ``clearTask`` — used by decommission/replace GC."""
+        prefix = self._path(self.TASKS, _esc(task_name))
+        for path in list(self._parse_cache):
+            if path.startswith(prefix):
+                del self._parse_cache[path]
         try:
-            self._persister.recursive_delete(self._path(self.TASKS, _esc(task_name)))
+            self._persister.recursive_delete(prefix)
         except NotFoundError:
             pass
 
@@ -220,6 +244,7 @@ class StateStore:
         return self.fetch_property(self.DEPLOY_COMPLETED) == b"true"
 
     def delete_all(self) -> None:
+        self._parse_cache.clear()
         for child in (self.TASKS, self.PROPERTIES):
             try:
                 self._persister.recursive_delete(self._path(child).rstrip("/"))

@@ -15,6 +15,7 @@ Supported syntax (the subset the reference actually uses):
 
 from __future__ import annotations
 
+import json
 import re
 from typing import Mapping
 
@@ -35,9 +36,7 @@ def render_json_template(text: str, env: Mapping[str, str], *,
     every substituted VALUE is escaped for a JSON string context, so an
     option like a quoted placement constraint cannot break the document.
     Section truthiness is evaluated on the raw values."""
-    import json as _json
-
-    escaped = {k: _json.dumps(str(v))[1:-1] for k, v in env.items()}
+    escaped = {k: json.dumps(str(v))[1:-1] for k, v in env.items()}
     # sections must see raw truthiness ("false" stays falsy), and the
     # escape of a plain string never changes emptiness/"false"-ness, so
     # the escaped map preserves section semantics

@@ -100,8 +100,9 @@ def _inject_computed_env(merged: dict) -> dict:
     the stable discovery names of instances 0..SEED_COUNT-1."""
     # legacy knob: operators who set BACKUP_DIR (the old name) keep their
     # backup location when EXTERNAL_LOCATION was left at its default
-    if merged.get("EXTERNAL_LOCATION", "./backups") == "./backups" \
-            and merged.get("BACKUP_DIR", "./backups") != "./backups":
+    default_loc = DEFAULT_ENV["EXTERNAL_LOCATION"]
+    if merged.get("EXTERNAL_LOCATION", default_loc) == default_loc \
+            and merged.get("BACKUP_DIR", default_loc) != default_loc:
         merged["EXTERNAL_LOCATION"] = merged["BACKUP_DIR"]
     if not merged.get("CASSANDRA_SEEDS"):
         name = merged["FRAMEWORK_NAME"]

@@ -1,0 +1,107 @@
+"""Control-plane benchmark: deploy-plan time-to-COMPLETE.
+
+BASELINE.md's second north-star metric: the deploy plan should be
+agent-bound, not scheduler-bound (SURVEY.md §7 hard part (5)). This tool
+measures the scheduler side in isolation — N pod instances matched,
+reserved, WAL'd, and launched over an in-process fake cluster whose
+agents accept instantly — so the number is pure control-plane throughput:
+evaluator stages, plan-engine candidate selection, state-store writes.
+
+Prints one JSON line::
+
+    {"metric": "deploy_pods_per_sec", "pods": 100, "seconds": ...,
+     "pods_per_sec": ..., "cycles": ...}
+
+Usage::
+
+    python -m tools.bench_scheduler [--pods 100] [--tpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--pods", type=int, default=100)
+    p.add_argument("--tpu", action="store_true",
+                   help="gang-placed TPU pods instead of plain cpu pods")
+    args = p.parse_args(argv)
+
+    from dcos_commons_tpu.agent.fake import FakeCluster
+    from dcos_commons_tpu.agent.inventory import (AgentInfo, PortRange,
+                                                  TpuInventory)
+    from dcos_commons_tpu.plan import Status
+    from dcos_commons_tpu.scheduler import ServiceScheduler
+    from dcos_commons_tpu.specification import load_service_yaml_str
+    from dcos_commons_tpu.state import MemPersister
+
+    n = args.pods
+    if args.tpu:
+        yml = f"""
+name: bench
+pods:
+  worker:
+    count: {n}
+    tpu: {{chips: 4, topology: v4-16}}
+    resource-sets:
+      wres: {{cpus: 1, memory: 512, tpus: 4}}
+    tasks:
+      train: {{goal: RUNNING, cmd: run, resource-set: wres}}
+"""
+        # one slice big enough for the whole gang
+        agents = [AgentInfo(agent_id=f"t{i}", hostname=f"tpu{i}", cpus=64,
+                            memory_mb=262144, disk_mb=1 << 20,
+                            ports=(PortRange(1025, 32000),),
+                            tpu=TpuInventory(chips=4, slice_id="s0",
+                                             topology="v4-16",
+                                             worker_index=i))
+                  for i in range(n)]
+    else:
+        yml = f"""
+name: bench
+pods:
+  web:
+    count: {n}
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: run
+        cpus: 0.1
+        memory: 32
+        ports:
+          http: {{port: 0}}
+"""
+        agents = [AgentInfo(agent_id=f"a{i}", hostname=f"h{i}", cpus=64,
+                            memory_mb=262144, disk_mb=1 << 20,
+                            ports=(PortRange(1025, 32000),))
+                  for i in range(max(1, n // 10))]
+
+    sched = ServiceScheduler(load_service_yaml_str(yml, {}), MemPersister(),
+                             FakeCluster(agents))
+    t0 = time.perf_counter()
+    cycles = 0
+    while sched.plan("deploy").status is not Status.COMPLETE:
+        sched.run_cycle()
+        cycles += 1
+        if cycles > 10 * n + 100:
+            raise SystemExit(
+                f"deploy did not complete in {cycles} cycles: "
+                f"{sched.plan('deploy').status}")
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "deploy_pods_per_sec",
+        "tpu_gang": bool(args.tpu),
+        "pods": n,
+        "seconds": round(dt, 3),
+        "pods_per_sec": round(n / dt, 1),
+        "cycles": cycles,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
