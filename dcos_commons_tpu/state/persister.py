@@ -16,6 +16,7 @@ from __future__ import annotations
 import os
 import shutil
 import threading
+from functools import lru_cache
 from typing import Dict, Iterable, Mapping, Optional
 
 
@@ -27,8 +28,15 @@ class NotFoundError(PersisterError):
     pass
 
 
-def _split(path: str) -> list[str]:
-    parts = [p for p in path.split("/") if p]
+@lru_cache(maxsize=16384)
+def _split(path: str) -> tuple[str, ...]:
+    # memoized: the scheduler's cycle loop resolves the same task paths
+    # hundreds of times per cycle, and split+validate showed up in the
+    # control-plane profile (tools/bench_scheduler). Returns a TUPLE so
+    # the cached value cannot be mutated by callers. Raising calls are
+    # not cached by lru_cache — fine, bad paths are cold, and a cached
+    # exception INSTANCE would accrete traceback frames on every re-raise.
+    parts = tuple(p for p in path.split("/") if p)
     for p in parts:
         # dot-prefixed names are reserved for engine bookkeeping
         # (FilePersister's .value/.journal files) — reject uniformly so all
