@@ -302,7 +302,7 @@ class ApiServer:
         if rest == "auth/refresh":
             return self._refresh(method, headers or {})
         if self._auth is not None:
-            denied = self._authorize(method, rest, headers or {})
+            denied = self._authorize(method, rest, headers or {}, body)
             if denied is not None:
                 return denied
         if self._metrics is not None and rest in ("metrics",
@@ -396,28 +396,57 @@ class ApiServer:
         return 200, {"token": self._auth.authority.mint(
             principal.uid, principal.scopes, ttl_s=ttl), "ttl_s": ttl}
 
-    def _authorize(self, method: str, rest: str,
-                   headers: dict) -> Optional[Tuple[int, object]]:
+    def _authorize(self, method: str, rest: str, headers: dict,
+                   body: Optional[bytes] = None
+                   ) -> Optional[Tuple[int, object]]:
         """None when allowed; (status, payload) when denied.
 
         /v1/health stays open (load-balancer probes, reference
         HealthResource behind adminrouter's /service proxy is the same
-        judgement call); the agent-transport POSTs (register, poll) take
-        the ``agent`` scope; everything else — including the fleet
-        inventory GETs under /v1/agents — requires ``operator``, so a
-        leaked fleet credential cannot enumerate the cluster.
+        judgement call); agent REGISTRATION takes the shared ``agent``
+        scope; POLLS additionally require the per-agent session identity
+        minted at registration (uid ``agent:<id>``), so one compromised
+        host's credentials cannot drain another agent's command queue —
+        launch commands carry task env including secret material.
+        Everything else — including the fleet inventory GETs under
+        /v1/agents — requires ``operator``, so a leaked fleet credential
+        cannot enumerate the cluster.
         """
         from ..security.auth import (AuthError, SCOPE_AGENT,
                                      SCOPE_OPERATOR)
         if method == "GET" and rest == "health":
             return None
-        scope = SCOPE_OPERATOR
-        if method == "POST" and (
-                rest == "agents/register"
-                or re.fullmatch(r"agents/[^/]+/poll", rest)):
-            scope = SCOPE_AGENT
+        poll = (re.fullmatch(r"agents/([^/]+)/poll", rest)
+                if method == "POST" else None)
         try:
-            self._auth.authorize(headers, scope)
+            if poll is not None:
+                principal = self._auth.authorize(headers, SCOPE_AGENT)
+                if principal.uid != f"agent:{poll.group(1)}" \
+                        and not principal.has_scope(SCOPE_OPERATOR):
+                    raise AuthError(
+                        403, "poll requires this agent's session token "
+                             "(from its register reply)")
+            elif method == "POST" and rest == "agents/register":
+                principal = self._auth.authorize(headers, SCOPE_AGENT)
+                # an agent-bound identity (a session token, or a per-host
+                # service account named agent:<id>) may only register ITS
+                # OWN id — a leaked session token cannot impersonate
+                # another agent. The generic fleet account can register
+                # any id (bootstrap convenience; provision per-host
+                # accounts for full impersonation resistance).
+                if principal.uid.startswith("agent:") \
+                        and not principal.has_scope(SCOPE_OPERATOR):
+                    try:
+                        claimed = json.loads(body.decode())["agent_id"] \
+                            if body else None
+                    except (ValueError, KeyError, AttributeError):
+                        claimed = None
+                    if claimed != principal.uid[len("agent:"):]:
+                        raise AuthError(
+                            403, f"identity {principal.uid!r} may only "
+                                 f"register its own agent id")
+            else:
+                self._auth.authorize(headers, SCOPE_OPERATOR)
         except AuthError as e:
             return e.code, {"error": e.message}
         return None
@@ -493,9 +522,20 @@ class ApiServer:
             return 400, {"error": "agent payload must be JSON"}
         if method == "POST" and rest == "agents/register":
             try:
-                return 200, self._cluster.register(payload)
+                reply = self._cluster.register(payload)
             except (KeyError, ValueError, TypeError) as e:
                 return 400, {"error": f"bad register payload: {e}"}
+            if self._auth is not None and reply.get("ok"):
+                # per-agent session identity: polls must present THIS
+                # token (uid agent:<id>), so fleet credentials alone
+                # cannot read another agent's launch commands. Expiry
+                # self-heals: an expired session 401s the poll and the
+                # agent re-registers for a fresh one.
+                from ..security.auth import SCOPE_AGENT, TASK_TOKEN_TTL_S
+                reply["session_token"] = self._auth.authority.mint(
+                    f"agent:{payload['agent_id']}", [SCOPE_AGENT],
+                    ttl_s=TASK_TOKEN_TTL_S)
+            return 200, reply
         parts = rest.split("/")
         if method == "POST" and len(parts) == 3 and parts[2] == "poll":
             return 200, self._cluster.poll(parts[1], payload)

@@ -287,6 +287,10 @@ class Agent {
  private:
   Config cfg_;
   tpu::AuthSession auth_{cfg_.scheduler_url};
+  // per-agent session identity from the register reply: polls MUST carry
+  // it (the scheduler rejects fleet-credential polls, so one host's
+  // leaked credential cannot drain another agent's command queue)
+  std::string session_token_;
   std::map<std::string, RunningTask> tasks_;  // task_id -> state
   std::vector<Json> pending_statuses_;
 
@@ -341,11 +345,14 @@ class Agent {
     for (int attempt = 0; attempt < 120; ++attempt) {
       try {
         auto resp = authed_post(url, inventory().dump());
-        if (resp.status == 200 &&
-            Json::parse(resp.body).get("ok").as_bool()) {
-          std::cerr << "[tpu-agent] registered " << cfg_.agent_id
-                    << " with " << cfg_.scheduler_url << "\n";
-          return true;
+        if (resp.status == 200) {
+          Json reply = Json::parse(resp.body);
+          if (reply.get("ok").as_bool()) {
+            session_token_ = reply.get("session_token").as_string();
+            std::cerr << "[tpu-agent] registered " << cfg_.agent_id
+                      << " with " << cfg_.scheduler_url << "\n";
+            return true;
+          }
         }
         std::cerr << "[tpu-agent] register rejected: " << resp.status
                   << " " << resp.body << "\n";
@@ -373,7 +380,17 @@ class Agent {
         cfg_.scheduler_url + "/v1/agents/" + cfg_.agent_id + "/poll";
     Json reply;
     try {
-      auto resp = authed_post(url, body.dump());
+      // polls carry the per-agent session token when the scheduler
+      // issued one; plain auth otherwise (open schedulers)
+      auto resp = tpu::http_post(
+          url, body.dump(), 30,
+          session_token_.empty() ? auth_.token() : session_token_);
+      if (resp.status == 401 || resp.status == 403) {
+        // expired/rejected session: re-register for a fresh one
+        std::cerr << "[tpu-agent] poll auth " << resp.status
+                  << "; re-registering\n";
+        return false;
+      }
       if (resp.status != 200) {
         std::cerr << "[tpu-agent] poll HTTP " << resp.status << "\n";
         return true;  // transient; keep statuses queued

@@ -224,13 +224,30 @@ class TestAuthedRemoteTransport:
                             "cpus": 4, "memory_mb": 4096,
                             "disk_mb": 10000}).encode(), headers=hdr)
             assert code == 200 and body["ok"]
+            session = body["session_token"]
             sched.run_cycle()
-            code, body = _request(
-                f"{url}/v1/agents/a1/poll", "POST",
-                json.dumps({"running_task_ids": [],
-                            "statuses": []}).encode(), headers=hdr)
+            # the shared fleet credential cannot poll — only the
+            # per-agent session identity from the register reply can
+            poll_body = json.dumps({"running_task_ids": [],
+                                    "statuses": []}).encode()
+            code, _ = _request(f"{url}/v1/agents/a1/poll", "POST",
+                               poll_body, headers=hdr)
+            assert code == 403
+            shdr = {"Authorization": f"token={session}"}
+            code, body = _request(f"{url}/v1/agents/a1/poll", "POST",
+                                  poll_body, headers=shdr)
             assert code == 200
             assert any(c["type"] == "launch" for c in body["commands"])
+            # one agent's session cannot drain another's queue
+            code, body2 = _request(
+                f"{url}/v1/agents/register", "POST",
+                json.dumps({"agent_id": "a2", "hostname": "h2",
+                            "cpus": 4, "memory_mb": 4096,
+                            "disk_mb": 10000}).encode(), headers=hdr)
+            assert code == 200
+            code, _ = _request(f"{url}/v1/agents/a2/poll", "POST",
+                               poll_body, headers=shdr)
+            assert code == 403
         finally:
             server.stop()
 
@@ -352,3 +369,44 @@ def test_multi_service_tasks_get_identity_tokens():
     launch = cluster.launch_log[0].launches[0]
     principal = auth.authority.verify(launch.env[TASK_TOKEN_ENV])
     assert principal is not None and principal.uid == "hello-0-server"
+
+
+def test_agent_bound_identity_cannot_impersonate_on_register():
+    """A leaked per-agent session token (or a per-host agent:<id>
+    account) may re-register only its OWN id — it cannot register as a
+    victim agent and receive the victim's session token."""
+    auth = Authenticator.from_config(generate_auth_config())
+    cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.01)
+    sched = ServiceScheduler(load_service_yaml_str(YML), MemPersister(),
+                             cluster)
+    server = ApiServer(sched, port=0, cluster=cluster, auth=auth)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        fleet = auth.login("fleet", auth.accounts["fleet"].secret)
+        fhdr = {"Authorization": f"token={fleet}"}
+        reg = lambda aid, hdr: _request(
+            f"{url}/v1/agents/register", "POST",
+            json.dumps({"agent_id": aid, "hostname": aid, "cpus": 4,
+                        "memory_mb": 4096, "disk_mb": 1000}).encode(),
+            headers=hdr)
+        code, body = reg("a1", fhdr)
+        assert code == 200
+        session = body["session_token"]
+        shdr = {"Authorization": f"token={session}"}
+        # session may re-register ITSELF (crash recovery)
+        code, body = reg("a1", shdr)
+        assert code == 200 and body["session_token"]
+        # ...but not a victim
+        code, _ = reg("victim", shdr)
+        assert code == 403
+        # a per-host account (uid agent:h7) is bound the same way
+        from dcos_commons_tpu.security import ServiceAccount
+        auth.accounts["agent:h7"] = ServiceAccount(
+            uid="agent:h7", secret="host-secret", scopes=("agent",))
+        host = auth.login("agent:h7", "host-secret")
+        hhdr = {"Authorization": f"token={host}"}
+        assert reg("h7", hhdr)[0] == 200
+        assert reg("h8", hhdr)[0] == 403
+    finally:
+        server.stop()
