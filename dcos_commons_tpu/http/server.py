@@ -439,7 +439,11 @@ class ApiServer:
                     try:
                         claimed = json.loads(body.decode())["agent_id"] \
                             if body else None
-                    except (ValueError, KeyError, AttributeError):
+                    except (ValueError, KeyError, AttributeError,
+                            TypeError):
+                        # same catch list as the register handler's parse:
+                        # a malformed body fails the id binding (403/400),
+                        # never a 500
                         claimed = None
                     if claimed != principal.uid[len("agent:"):]:
                         raise AuthError(
@@ -531,10 +535,13 @@ class ApiServer:
                 # cannot read another agent's launch commands. Expiry
                 # self-heals: an expired session 401s the poll and the
                 # agent re-registers for a fresh one.
-                from ..security.auth import SCOPE_AGENT, TASK_TOKEN_TTL_S
+                from ..security.auth import SCOPE_AGENT
+                # honor the operator's configured token TTL (auth.json
+                # ttl_s bounds credential exposure for EVERY token);
+                # expiry self-heals via re-register, so short TTLs cost
+                # only an extra register round-trip per period
                 reply["session_token"] = self._auth.authority.mint(
-                    f"agent:{payload['agent_id']}", [SCOPE_AGENT],
-                    ttl_s=TASK_TOKEN_TTL_S)
+                    f"agent:{payload['agent_id']}", [SCOPE_AGENT])
             return 200, reply
         parts = rest.split("/")
         if method == "POST" and len(parts) == 3 and parts[2] == "poll":

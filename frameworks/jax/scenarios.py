@@ -33,6 +33,8 @@ DEFAULT_ENV: Mapping[str, str] = {
     "SHARD_COUNT": "4",
     # multislice scenario knobs (multislice.yml)
     "NUM_SLICES": "2",
+    # sharded-checkpoint cadence for llama-train scenarios (0 = final only)
+    "CKPT_EVERY": "0",
     # long-context scenario knobs (longctx.yml)
     "SEQ_LEN": "8192",
     "ATTN_IMPL": "ring",
