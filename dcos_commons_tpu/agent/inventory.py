@@ -75,6 +75,9 @@ class TaskRecord:
     zone: Optional[str] = None
     region: Optional[str] = None
     permanently_failed: bool = False  # reference FailureUtils label
+    # agent attributes captured at launch (reference AuxLabelAccess stores
+    # offer attributes into TaskInfo labels for attribute-counting rules)
+    attributes: Mapping[str, str] = field(default_factory=dict)
 
     @property
     def pod_instance_name(self) -> str:

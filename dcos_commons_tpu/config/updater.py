@@ -224,6 +224,30 @@ def zone_placement_cannot_change(old, new):
     return errs
 
 
+def tls_requires_auth(auth_enabled: bool) -> ConfigValidator:
+    """Reference ``TLSRequiresServiceAccount``: per-task TLS artifacts are
+    minted by the scheduler-owned CA, and serving them to tasks is only safe
+    when the control plane authenticates its callers — otherwise any peer
+    could fetch certificates. A spec that asks for transport encryption on a
+    control plane with auth disabled is rejected."""
+
+    def validator(old, new):
+        if auth_enabled:
+            return []
+        errs = []
+        for pod in new.pods:
+            for task in pod.tasks:
+                if task.transport_encryption:
+                    errs.append(
+                        f"pod {pod.type}/task {task.name}: transport "
+                        "encryption requires control-plane auth "
+                        "(set TPU_AUTH_FILE; reference "
+                        "TLSRequiresServiceAccount)")
+        return errs
+
+    return validator
+
+
 def task_env_cannot_change(pod_type: str, task_name: str, env_name: str
                            ) -> ConfigValidator:
     """Reference ``TaskEnvCannotChange``: factory for a validator pinning

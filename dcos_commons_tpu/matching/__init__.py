@@ -9,9 +9,11 @@ from .ledger import (Availability, Reservation, ReservationLedger,  # noqa: F401
                      VolumeReservation)
 from .outcome import EvaluationOutcome, OutcomeNode, OutcomeTracker  # noqa: F401
 from .placement import (AgentRule, AndRule, AttributeRule, HostnameRule,  # noqa: F401
-                        MaxPerHostnameRule, MaxPerRegionRule, MaxPerZoneRule,
+                        MaxPerAttributeRule, MaxPerHostnameRule,
+                        MaxPerRegionRule, MaxPerZoneRule,
                         NotRule, OrRule, Outcome, PlacementRule, RegionRule,
-                        RoundRobinByHostnameRule, RoundRobinByZoneRule,
+                        RoundRobinByAttributeRule, RoundRobinByHostnameRule,
+                        RoundRobinByZoneRule,
                         StringMatcher, TaskTypeRule, TpuSliceRule, ZoneRule,
                         parse_marathon_constraints, rule_from_json, rule_to_json)
 

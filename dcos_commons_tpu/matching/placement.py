@@ -430,6 +430,38 @@ class MaxPerRegionRule(_MaxPerRule):
     by: str = "region"
 
 
+def _round_robin_counts(pod_instance_name: str, tasks, key_of) -> dict:
+    """Per-group counts of this pod type, one count per pod instance."""
+    pod_type = pod_instance_name.rsplit("-", 1)[0]
+    counts: dict[str, int] = {}
+    seen_pods = set()
+    for t in _other_pod_tasks(pod_instance_name, tasks):
+        if t.pod_type != pod_type or t.pod_instance_name in seen_pods:
+            continue
+        seen_pods.add(t.pod_instance_name)
+        k = key_of(t)
+        if k is not None:
+            counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+def _round_robin_admit(my_key: str, counts: Mapping[str, int],
+                       group_count: Optional[int], label: str) -> Outcome:
+    """The shared floor rule: admit iff this group's count is minimal; while
+    ``group_count`` says unseen groups remain, only untouched groups are at
+    the floor."""
+    my = counts.get(my_key, 0)
+    known = len(counts) if my_key in counts else len(counts) + 1
+    if group_count is not None and known < group_count:
+        # unseen groups exist; only admit groups at the global minimum of 0
+        floor = 0
+    else:
+        floor = min(counts.values(), default=0)
+    if my <= floor:
+        return Outcome.ok(f"round-robin: {label} at floor ({my})")
+    return Outcome.fail(f"round-robin: {label} has {my} > floor {floor}")
+
+
 @dataclass(frozen=True)
 class _RoundRobinRule(PlacementRule):
     """Reference ``RoundRobinByHostnameRule`` etc.: admit the agent iff its
@@ -442,29 +474,13 @@ class _RoundRobinRule(PlacementRule):
     by: str = "hostname"
 
     def filter(self, agent, pod_instance_name, tasks) -> Outcome:
-        pod_type = pod_instance_name.rsplit("-", 1)[0]
         key = _agent_key(agent, self.by)
         if key is None:
             return Outcome.fail(f"agent has no {self.by}")
-        counts: dict[str, int] = {}
-        seen_pods = set()
-        for t in _other_pod_tasks(pod_instance_name, tasks):
-            if t.pod_type != pod_type or t.pod_instance_name in seen_pods:
-                continue
-            seen_pods.add(t.pod_instance_name)
-            k = _group_key(t, {}, self.by)
-            if k is not None:
-                counts[k] = counts.get(k, 0) + 1
-        my = counts.get(key, 0)
-        known = len(counts) if key in counts else len(counts) + 1
-        if self.group_count is not None and known < self.group_count:
-            # unseen groups exist; only admit groups at the global minimum of 0
-            floor = 0
-        else:
-            floor = min(counts.values(), default=0)
-        if my <= floor:
-            return Outcome.ok(f"round-robin: {self.by} {key!r} at floor ({my})")
-        return Outcome.fail(f"round-robin: {self.by} {key!r} has {my} > floor {floor}")
+        counts = _round_robin_counts(pod_instance_name, tasks,
+                                     lambda t: _group_key(t, {}, self.by))
+        return _round_robin_admit(key, counts, self.group_count,
+                                  f"{self.by} {key!r}")
 
     def to_dict(self):
         return {"type": self.type, "group_count": self.group_count, "by": self.by}
@@ -490,6 +506,37 @@ class RoundRobinByZoneRule(_RoundRobinRule):
 @dataclass(frozen=True)
 class RoundRobinByRegionRule(_RoundRobinRule):
     by: str = "region"
+
+
+@_register("round-robin-attribute")
+@dataclass(frozen=True)
+class RoundRobinByAttributeRule(PlacementRule):
+    """Reference ``RoundRobinByAttributeRule.java``: spread instances of this
+    pod type evenly across distinct *values* of agent attribute
+    ``attribute`` — admit the agent iff its attribute value's current count
+    is at the floor. ``group_count`` (the reference's ``attribute-count``)
+    bounds the expected number of distinct values; until that many values
+    have been seen, only untouched values are admitted."""
+
+    attribute: str
+    group_count: Optional[int] = None
+
+    def filter(self, agent, pod_instance_name, tasks) -> Outcome:
+        my_value = agent.attributes.get(self.attribute)
+        if my_value is None:
+            return Outcome.fail(f"agent has no attribute {self.attribute}")
+        counts = _round_robin_counts(pod_instance_name, tasks,
+                                     lambda t: t.attributes.get(self.attribute))
+        return _round_robin_admit(my_value, counts, self.group_count,
+                                  f"{self.attribute}={my_value!r}")
+
+    def to_dict(self):
+        return {"type": self.type, "attribute": self.attribute,
+                "group_count": self.group_count}
+
+    @staticmethod
+    def _from_dict(d):
+        return RoundRobinByAttributeRule(d["attribute"], d.get("group_count"))
 
 
 @_register("task-type")
@@ -612,7 +659,7 @@ def _one_marathon_rule(parts: Sequence[str]) -> PlacementRule:
         n = int(value) if value else None
         if by:
             return _ROUND_ROBIN_TYPES[by](group_count=n)
-        raise ValueError(f"GROUP_BY unsupported for attribute {fieldname}")
+        return RoundRobinByAttributeRule(attribute=fieldname, group_count=n)
     raise ValueError(f"unsupported constraint operator: {op}")
 
 
@@ -630,14 +677,16 @@ class MaxPerAttributeRule(PlacementRule):
         if my_value is None:
             return Outcome.ok(f"agent lacks attribute {self.attribute}; unconstrained")
         pod_type = pod_instance_name.rsplit("-", 1)[0]
-        # TaskRecord doesn't carry agent attributes; count pods of this type
-        # on this agent (exact per-attribute-value counting needs the agent
-        # registry, which the evaluator-level gang pass has — this per-agent
-        # approximation matches the reference's behavior for the common
-        # one-agent-per-attribute-value deployments).
+        # TaskRecords carry the launch-time agent attributes (reference
+        # AuxLabelAccess labels), so count per distinct attribute *value*.
+        # Legacy records stored before attributes existed fall back to
+        # same-agent counting.
         count = len({
             t.pod_instance_name for t in _other_pod_tasks(pod_instance_name, tasks)
-            if t.pod_type == pod_type and t.agent_id == agent.agent_id})
+            if t.pod_type == pod_type and (
+                t.attributes.get(self.attribute) == my_value
+                if self.attribute in t.attributes
+                else t.agent_id == agent.agent_id)})
         if count < self.max_count:
             return Outcome.ok(f"{count} < {self.max_count} per {self.attribute}")
         return Outcome.fail(f"{count} pods already on {self.attribute}={my_value}")

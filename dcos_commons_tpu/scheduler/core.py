@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Sequence
 from ..agent.client import AgentClient
 from ..agent.inventory import TaskRecord
 from ..config.updater import (DEFAULT_VALIDATORS, ConfigurationUpdater,
-                              UpdateResult)
+                              UpdateResult, tls_requires_auth)
 from ..matching.evaluator import (DEFAULT_TLD, Evaluator, LaunchPlan,
                                   TaskLaunch)
 from ..matching.outcome import OutcomeTracker
@@ -104,6 +104,9 @@ class ServiceScheduler:
         # control-plane Authenticator; when present the evaluator also
         # mints per-task workload-identity tokens (KDC analogue)
         self.auth = auth
+        # specs demanding TLS artifacts are only accepted on an authed
+        # control plane (reference TLSRequiresServiceAccount)
+        validators = tuple(validators) + (tls_requires_auth(auth is not None),)
         # kept for live config updates (update_config rebuilds plans)
         self._validators = validators
         self._failure_monitor = failure_monitor
@@ -492,6 +495,7 @@ class ServiceScheduler:
             zone=plan.agent.zone,
             region=plan.agent.region,
             tpu=plan.tpu,
+            attributes=dict(plan.agent.attributes),
         )
 
     def _task_records(self) -> List[TaskRecord]:
@@ -501,7 +505,8 @@ class ServiceScheduler:
                 task_name=task.task_name, pod_type=task.pod_type,
                 pod_index=task.pod_index, agent_id=task.agent_id,
                 hostname=task.hostname, zone=task.zone, region=task.region,
-                permanently_failed=task.permanently_failed))
+                permanently_failed=task.permanently_failed,
+                attributes=task.attributes))
         return out
 
     # -- operator verbs ----------------------------------------------------

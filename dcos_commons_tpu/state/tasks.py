@@ -95,6 +95,9 @@ class StoredTask:
     region: Optional[str] = None
     permanently_failed: bool = False   # reference FailureUtils label
     tpu: Optional[TpuAssignment] = None
+    # agent attributes at launch time (reference ``AuxLabelAccess`` offer-
+    # attribute labels, read back by attribute-counting placement rules)
+    attributes: Mapping[str, str] = field(default_factory=dict)
 
     @property
     def pod_instance_name(self) -> str:

@@ -9,6 +9,7 @@ persistent volumes, and the backup tarballs land in a real external
 location directory.
 """
 
+import os
 import subprocess
 import time
 from pathlib import Path
@@ -164,11 +165,18 @@ def test_tls_toggle_provisions_certs(native_bins, tmp_path):
            "CASSANDRA_HEAP_NEW_MB": "25",
            "SECURITY_TRANSPORT_ENCRYPTION_ENABLED": "true"}
     cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.05)
-    sched = build_scheduler(MemPersister(), cluster, env=env)
+    # TLS specs deploy only on an authed control plane (tls_requires_auth)
+    from dcos_commons_tpu.security import Authenticator, generate_auth_config
+    auth_cfg = generate_auth_config()
+    authenticator = Authenticator.from_config(auth_cfg)
+    sched = build_scheduler(
+        MemPersister(), cluster, env=env, auth=authenticator)
     from dcos_commons_tpu.http import ApiServer
-    server = ApiServer(sched, port=0, cluster=cluster)
+    server = ApiServer(sched, port=0, cluster=cluster, auth=authenticator)
     server.start()
     url = f"http://127.0.0.1:{server.port}"
+    secret_file = tmp_path / "fleet.secret"
+    secret_file.write_text(auth_cfg["accounts"]["fleet"]["secret"] + "\n")
     agent = subprocess.Popen(
         [str(native_bins / "tpu-agent"), "--scheduler", url,
          "--agent-id", "t0", "--hostname", "thost0",
@@ -176,6 +184,8 @@ def test_tls_toggle_provisions_certs(native_bins, tmp_path):
          "--base-dir", str(tmp_path / "agent-0"),
          "--ports", "1025-32000",
          "--poll-interval", "0.05", "--tpu-chips", "0"],
+        env=dict(os.environ, TPU_AUTH_UID="fleet",
+                 TPU_AUTH_SECRET_FILE=str(secret_file)),
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     try:
         drive_to(sched, "deploy", Status.COMPLETE)

@@ -41,9 +41,16 @@ class TestEveryScenarioDeploys:
             # mount-disk profile / pre-reserved role pool
             agents = default_agents(5, volume_profiles=("fast-ssd",),
                                     roles=("*", "reserved-pool"))
+        kwargs = {}
+        if scenario == "tls":
+            # TLS specs deploy only on an authed control plane
+            from dcos_commons_tpu.security import (Authenticator,
+                                                   generate_auth_config)
+            kwargs["auth"] = Authenticator.from_config(generate_auth_config())
         # pin topology: the host's real TPU runtime env (TPU_TOPOLOGY etc.)
         # would otherwise leak through scenario_env's os.environ merge
-        runner_for(scenario, {"TPU_TOPOLOGY": "v4-16"}, agents=agents).run([
+        runner_for(scenario, {"TPU_TOPOLOGY": "v4-16"}, agents=agents,
+                   **kwargs).run([
             Send.until_quiet(),
             Expect.deployed(),
         ])

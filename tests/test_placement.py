@@ -3,8 +3,10 @@
 import pytest
 
 from dcos_commons_tpu.agent import AgentInfo, TaskRecord, TpuInventory
-from dcos_commons_tpu.matching import (AndRule, HostnameRule, MaxPerHostnameRule,
+from dcos_commons_tpu.matching import (AndRule, HostnameRule,
+                                       MaxPerAttributeRule, MaxPerHostnameRule,
                                        MaxPerZoneRule, NotRule, OrRule,
+                                       RoundRobinByAttributeRule,
                                        RoundRobinByHostnameRule, StringMatcher,
                                        TaskTypeRule, TpuSliceRule, ZoneRule,
                                        parse_marathon_constraints, rule_from_json,
@@ -21,7 +23,8 @@ def task(pod_type, idx, agent_info):
     return TaskRecord(task_name=f"{pod_type}-{idx}-server", pod_type=pod_type,
                       pod_index=idx, agent_id=agent_info.agent_id,
                       hostname=agent_info.hostname, zone=agent_info.zone,
-                      region=agent_info.region)
+                      region=agent_info.region,
+                      attributes=dict(agent_info.attributes))
 
 
 def test_hostname_rule():
@@ -74,6 +77,61 @@ def test_round_robin_hostname():
     tasks.append(task("p", 2, a3))
     # all groups seen, floor is 1 -> host1 admissible again
     assert r.filter(a1, "p-3", tasks).passes
+
+
+def test_round_robin_attribute():
+    """Reference RoundRobinByAttributeRule: spread over distinct attribute
+    values (two agents can share a rack — counting is per value, not per
+    agent)."""
+    r = RoundRobinByAttributeRule(attribute="rack", group_count=2)
+    a1 = agent(1, attrs={"rack": "r1"})
+    a2 = agent(2, attrs={"rack": "r1"})   # same rack, different host
+    a3 = agent(3, attrs={"rack": "r2"})
+    no_attr = agent(4)
+    assert r.filter(a1, "p-0", []).passes
+    assert not r.filter(no_attr, "p-0", []).passes
+    tasks = [task("p", 0, a1)]
+    # rack r1 above floor while rack r2 untouched — even on the OTHER r1 host
+    assert not r.filter(a1, "p-1", tasks).passes
+    assert not r.filter(a2, "p-1", tasks).passes
+    assert r.filter(a3, "p-1", tasks).passes
+    tasks.append(task("p", 1, a3))
+    # both racks seen at 1 -> floor 1, r1 admissible again
+    assert r.filter(a2, "p-2", tasks).passes
+    # replacing a pod doesn't count itself
+    assert r.filter(a1, "p-0", tasks).passes
+
+
+def test_round_robin_attribute_json_roundtrip():
+    r = RoundRobinByAttributeRule(attribute="rack", group_count=3)
+    assert rule_from_json(rule_to_json(r)) == r
+    r2 = parse_marathon_constraints('[["rack", "GROUP_BY", "3"]]')
+    assert r2 == r
+
+
+def test_max_per_attribute_counts_by_value():
+    """Two hosts in one rack share the rack's budget (launch-time task
+    attributes, not same-agent approximation)."""
+    r = MaxPerAttributeRule(max_count=1, attribute="rack")
+    a1 = agent(1, attrs={"rack": "r1"})
+    a2 = agent(2, attrs={"rack": "r1"})
+    a3 = agent(3, attrs={"rack": "r2"})
+    tasks = [task("p", 0, a1)]
+    assert not r.filter(a2, "p-1", tasks).passes
+    assert r.filter(a3, "p-1", tasks).passes
+    # legacy records without attributes fall back to same-agent counting
+    legacy = TaskRecord(task_name="p-0-server", pod_type="p", pod_index=0,
+                        agent_id=a1.agent_id, hostname=a1.hostname)
+    assert r.filter(a2, "p-1", [legacy]).passes
+    assert not r.filter(a1, "p-1", [legacy]).passes
+    # a record with OTHER attributes but not this one also falls back to
+    # same-agent counting (an agent relabelled after launch must not open
+    # the cap on its own host)
+    other_attr = TaskRecord(task_name="p-0-server", pod_type="p", pod_index=0,
+                            agent_id=a1.agent_id, hostname=a1.hostname,
+                            attributes={"foo": "x"})
+    assert not r.filter(a1, "p-1", [other_attr]).passes
+    assert r.filter(a3, "p-1", [other_attr]).passes
 
 
 def test_task_type_rules():

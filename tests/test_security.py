@@ -63,8 +63,11 @@ class TestTLSProvisioner:
 
 class TestScenarios:
     def test_tls_scenario_delivers_artifacts(self):
+        from dcos_commons_tpu.security import Authenticator, generate_auth_config
         spec = scenarios.load_scenario("tls")
-        runner = ServiceTestRunner(spec=spec)
+        # TLS specs require an authed control plane (tls_requires_auth)
+        runner = ServiceTestRunner(
+            spec=spec, auth=Authenticator.from_config(generate_auth_config()))
         runner.run([Send.until_quiet(), Expect.deployed()])
         launch = runner.cluster.launch_log[0].launches[0]
         files = {dest: base64.b64decode(content)

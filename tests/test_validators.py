@@ -6,8 +6,8 @@ strings; the updater then keeps the old target config.
 from dcos_commons_tpu.config.updater import (
     DEFAULT_VALIDATORS, network_regime_cannot_change, placement_rules_valid,
     pre_reservation_cannot_change, region_placement_cannot_change,
-    service_name_dns_safe, task_env_cannot_change, volumes_cannot_change,
-    zone_placement_cannot_change)
+    service_name_dns_safe, task_env_cannot_change, tls_requires_auth,
+    volumes_cannot_change, zone_placement_cannot_change)
 from dcos_commons_tpu.specification import load_service_yaml_str
 
 
@@ -172,3 +172,40 @@ class TestRegionRetarget:
         old = spec(extra="placement: '[[\"region\", \"IS\", \"us-east1\"]]'")
         new = spec(extra="placement: '[[\"region\", \"IS\", \"us-west1\"]]'")
         assert region_placement_cannot_change(old, new)
+
+
+class TestTlsRequiresAuth:
+    """Reference TLSRequiresServiceAccount: TLS artifacts are only served on
+    an authenticated control plane."""
+
+    TLS_TASK = "transport-encryption: [{name: web-tls}]"
+
+    def test_tls_without_auth_blocked(self):
+        s = spec(task_extra=self.TLS_TASK)
+        errs = tls_requires_auth(False)(None, s)
+        assert errs and "auth" in errs[0]
+
+    def test_tls_with_auth_ok(self):
+        s = spec(task_extra=self.TLS_TASK)
+        assert tls_requires_auth(True)(None, s) == []
+
+    def test_plain_spec_unaffected(self):
+        assert tls_requires_auth(False)(None, spec()) == []
+
+    def test_scheduler_wires_validator(self):
+        import pytest
+        from dcos_commons_tpu.scheduler.core import ServiceScheduler
+        from dcos_commons_tpu.state.persister import MemPersister
+        from dcos_commons_tpu.testing.simulation import FakeCluster
+        s = spec(task_extra=self.TLS_TASK)
+        # initial deploy with no prior target: invalid config is a hard fail
+        with pytest.raises(ValueError, match="auth"):
+            ServiceScheduler(s, MemPersister(), FakeCluster([]))
+
+    def test_scheduler_update_keeps_old_target(self):
+        from dcos_commons_tpu.scheduler.core import ServiceScheduler
+        from dcos_commons_tpu.state.persister import MemPersister
+        from dcos_commons_tpu.testing.simulation import FakeCluster
+        sched = ServiceScheduler(spec(), MemPersister(), FakeCluster([]))
+        result = sched.update_config(spec(task_extra=self.TLS_TASK))
+        assert not result.accepted and "auth" in result.errors[0]
