@@ -23,11 +23,12 @@ class Client:
         # auth-header plumbing (reference cli/client/http.go): TPU_AUTH_TOKEN
         # or TPU_AUTH_UID/TPU_AUTH_SECRET login against TPU_SCHEDULER
         from ..security.auth import auth_headers_from_env
+        from ..security.transport import urlopen
         req = urllib.request.Request(
             url, method=method, data=body,
             headers=auth_headers_from_env(self.base))
         try:
-            with urllib.request.urlopen(req, timeout=30) as r:
+            with urlopen(req, timeout=30) as r:
                 return r.status, json.loads(r.read().decode() or "null")
         except urllib.error.HTTPError as e:
             try:

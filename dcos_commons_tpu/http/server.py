@@ -219,13 +219,17 @@ class ApiServer:
 
     def __init__(self, scheduler=None, port: int = 0, metrics=None,
                  host: str = "127.0.0.1", cluster=None, multi=None,
-                 auth=None):
+                 auth=None, tls=None):
         self._services: Dict[str, _Routes] = {}
         self._default: Optional[_Routes] = None
         self._metrics = metrics
         self._cluster = cluster  # RemoteCluster: agent transport endpoint
         self._multi = multi  # MultiServiceScheduler: dynamic add/remove
         self._auth = auth  # security.auth.Authenticator (None = open)
+        # transport security (reference: adminrouter terminates HTTPS in
+        # front of the scheduler; here the server owns its socket):
+        # an ssl.SSLContext or security.transport.ServerCredentials
+        self._tls = tls
         if scheduler is not None:
             self._default = _Routes(scheduler, metrics)
         outer = self
@@ -279,6 +283,9 @@ class ApiServer:
                 self._handle("DELETE")
 
         self._server = ThreadingHTTPServer((host, port), RequestHandler)
+        if self._tls is not None:
+            from ..security.transport import wrap_server
+            wrap_server(self._server, self._tls)
         self._thread: Optional[threading.Thread] = None
 
     # -- service registry (multi-service: Multi*Resource.java) -------------
@@ -553,6 +560,14 @@ class ApiServer:
     @property
     def port(self) -> int:
         return self._server.server_address[1]
+
+    @property
+    def scheme(self) -> str:
+        return "https" if self._tls is not None else "http"
+
+    @property
+    def url(self) -> str:
+        return f"{self.scheme}://127.0.0.1:{self.port}"
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._server.serve_forever,

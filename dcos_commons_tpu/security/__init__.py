@@ -16,6 +16,9 @@ from .auth import (Authenticator, AuthError, CachedTokenProvider, Principal,
 from .ca import CertificateAuthority
 from .secrets import SecretsStore
 from .tls import TLSArtifactPaths, TLSProvisioner, certificate_names
+from .transport import (ServerCredentials, client_context,
+                        client_context_from_env, mint_server_credentials,
+                        server_context, server_tls_from_env)
 
 __all__ = [
     "AuthError",
@@ -24,11 +27,17 @@ __all__ = [
     "CertificateAuthority",
     "Principal",
     "SecretsStore",
+    "ServerCredentials",
     "ServiceAccount",
     "TLSArtifactPaths",
     "TLSProvisioner",
     "TokenAuthority",
     "auth_headers_from_env",
     "certificate_names",
+    "client_context",
+    "client_context_from_env",
     "generate_auth_config",
+    "mint_server_credentials",
+    "server_context",
+    "server_tls_from_env",
 ]

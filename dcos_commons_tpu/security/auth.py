@@ -227,12 +227,14 @@ class CachedTokenProvider:
 
     def _fetch(self) -> str:
         import urllib.request
+
+        from .transport import urlopen
         req = urllib.request.Request(
             f"{self._base_url}/v1/auth/login", method="POST",
             data=json.dumps({"uid": self._uid,
                              "secret": self._secret}).encode(),
             headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=30) as r:
+        with urlopen(req, timeout=30) as r:
             token = json.loads(r.read().decode())["token"]
         try:
             self._exp = float(json.loads(

@@ -34,6 +34,17 @@ def _name(cn: str, org: str = "dcos-commons-tpu") -> x509.Name:
     ])
 
 
+def _san_entry(san: str) -> x509.GeneralName:
+    """IP-literal SANs become IPAddress entries (clients that dial
+    ``https://127.0.0.1:…`` verify against these); everything else is a
+    DNS name."""
+    import ipaddress
+    try:
+        return x509.IPAddress(ipaddress.ip_address(san))
+    except ValueError:
+        return x509.DNSName(san)
+
+
 class CertificateAuthority:
     """Issues short-lived per-task certificates signed by a persisted CA.
 
@@ -113,7 +124,7 @@ class CertificateAuthority:
         if sans:
             builder = builder.add_extension(
                 x509.SubjectAlternativeName(
-                    [x509.DNSName(s) for s in sans]), critical=False)
+                    [_san_entry(s) for s in sans]), critical=False)
         cert = builder.sign(self._key, hashes.SHA256())
         return (cert.public_bytes(serialization.Encoding.PEM),
                 key.private_bytes(

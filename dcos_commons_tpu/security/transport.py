@@ -1,0 +1,254 @@
+"""Control-plane transport security: TLS on every hop.
+
+Reference: the SDK gets HTTPS on every control-plane hop from DC/OS
+adminrouter plus a TLS-configured client stack
+(``sdk/scheduler/src/main/java/com/mesosphere/sdk/dcos/DcosHttpClientBuilder.java:1-80``,
+``cli/client/http.go:1-60``). This build owns both sides of every hop, so
+the scheduler's own CA (``security/ca.py``) is the trust root: servers
+(the ApiServer, the state-ensemble replicas) present a certificate minted
+from — or verifiable against — that CA, and every client (Python CLI,
+``tpuctl``, the C++ agent, the integration lib, ``ReplicatedPersister``)
+verifies the peer chain and hostname before sending credentials.
+
+Env contract (each hop upgrades independently; cleartext stays the
+no-flag default so existing single-host setups keep working, but any
+deployment that sets the knobs gets TLS end to end):
+
+- **server**: ``TPU_TLS=1`` mints a fresh server certificate at boot from
+  the CA persisted with the control-plane state (SANs: hostname,
+  ``localhost``, ``127.0.0.1`` plus ``TPU_TLS_SANS`` comma-list), and
+  exports the CA certificate to ``TPU_TLS_CA_EXPORT`` (default
+  ``<state>/ca.pem``) for distribution to clients. Alternatively
+  ``TPU_TLS_CERT``/``TPU_TLS_KEY`` name operator-provisioned PEM files.
+- **client**: an ``https://`` URL verifies the server against the CA
+  bundle named by ``TPU_TLS_CA``. ``TPU_TLS_INSECURE=1`` skips
+  verification (development only). An ``https://`` URL with neither is a
+  hard error — silently falling back to no-verify would defeat the point.
+
+The C++ twin of the client half lives in ``native/common/tls.hpp``
+(same env knobs, OpenSSL via ``dlopen`` — the image ships ``libssl.so.3``
+without headers).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import ssl
+import tempfile
+import threading
+import urllib.request
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+from ..state.persister import Persister
+from .ca import CertificateAuthority
+
+# server certs are re-minted at every boot (EC issuance is microseconds);
+# the generous lifetime only matters for processes that run for months
+SERVER_CERT_DAYS = 397
+
+
+@dataclass(frozen=True)
+class ServerCredentials:
+    """One server's TLS identity + the trust root it chains to."""
+
+    cert_pem: bytes
+    key_pem: bytes
+    ca_pem: bytes
+
+    def ssl_context(self) -> ssl.SSLContext:
+        return server_context(self.cert_pem, self.key_pem)
+
+
+def default_sans(extra: Sequence[str] = ()) -> list:
+    """Hostnames/IPs a control-plane server certificate must cover."""
+    sans = {"localhost", "127.0.0.1", socket.gethostname()}
+    try:
+        sans.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    sans.update(s for s in extra if s)
+    return sorted(sans)
+
+
+def mint_server_credentials(persister: Persister, service_name: str,
+                            sans: Sequence[str] = (),
+                            days: int = SERVER_CERT_DAYS
+                            ) -> ServerCredentials:
+    """Issue a server certificate from the service CA persisted with the
+    control-plane state (creating the CA on first use, exactly like task
+    TLS provisioning does)."""
+    ca = CertificateAuthority(persister, service_name)
+    cert, key = ca.issue(f"{service_name} control-plane",
+                         default_sans(sans), days=days)
+    return ServerCredentials(cert_pem=cert, key_pem=key,
+                             ca_pem=ca.ca_cert_pem)
+
+
+def server_context(cert_pem: bytes, key_pem: bytes) -> ssl.SSLContext:
+    """A server-side context from in-memory PEM (the ssl module only loads
+    chains from files, so stage them in a private tempdir)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    with tempfile.TemporaryDirectory(prefix="tpu-tls-") as tmp:
+        cert_file = os.path.join(tmp, "cert.pem")
+        key_file = os.path.join(tmp, "key.pem")
+        fd = os.open(key_file, os.O_WRONLY | os.O_CREAT, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(key_pem)
+        with open(cert_file, "wb") as f:
+            f.write(cert_pem)
+        ctx.load_cert_chain(cert_file, key_file)
+    return ctx
+
+
+def server_context_from_files(cert_file: str, key_file: str
+                              ) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_cert_chain(cert_file, key_file)
+    return ctx
+
+
+def server_tls_from_env(persister: Optional[Persister] = None,
+                        service_name: str = "scheduler",
+                        state_root: Optional[str] = None
+                        ) -> Optional[ssl.SSLContext]:
+    """The scheduler mains' one-stop server TLS bootstrap.
+
+    Returns ``None`` (cleartext) unless enabled; with ``TPU_TLS=1`` mints
+    from the persisted CA and exports the CA certificate for clients; with
+    ``TPU_TLS_CERT``/``TPU_TLS_KEY`` loads operator-provisioned files.
+    """
+    cert_file = os.environ.get("TPU_TLS_CERT")
+    key_file = os.environ.get("TPU_TLS_KEY")
+    if cert_file and key_file:
+        return server_context_from_files(cert_file, key_file)
+    if cert_file or key_file:
+        # a half-set pair silently booting cleartext would put bearer
+        # tokens on the wire readable — refuse to start instead
+        raise ValueError(
+            "TPU_TLS_CERT and TPU_TLS_KEY must be set together "
+            f"(got cert={'set' if cert_file else 'unset'}, "
+            f"key={'set' if key_file else 'unset'})")
+    if os.environ.get("TPU_TLS", "") not in ("1", "true", "yes"):
+        return None
+    if persister is None:
+        raise ValueError(
+            "TPU_TLS=1 needs the control-plane persister to mint from "
+            "(or provide TPU_TLS_CERT/TPU_TLS_KEY)")
+    extra = [s.strip()
+             for s in os.environ.get("TPU_TLS_SANS", "").split(",")
+             if s.strip()]
+    creds = mint_server_credentials(persister, service_name, extra)
+    export = os.environ.get("TPU_TLS_CA_EXPORT")
+    if not export and state_root:
+        export = os.path.join(state_root, "ca.pem")
+    if export:
+        with open(export, "wb") as f:
+            f.write(creds.ca_pem)
+    return creds.ssl_context()
+
+
+def wrap_server(server, tls) -> None:
+    """Turn a ``ThreadingHTTPServer`` into a TLS server (shared by the
+    ApiServer and the state replicas).
+
+    The handshake is deferred to the per-connection handler thread
+    (``do_handshake_on_connect=False``): with the default, a client that
+    connects and sends nothing would stall the single accept loop and
+    freeze the whole control plane. Failed handshakes (plain-HTTP probes,
+    wrong-CA clients) surface in the handler thread and are logged at
+    debug; anything else keeps the stock traceback so real bugs stay
+    visible.
+    """
+    import logging
+    log = logging.getLogger(__name__)
+    ctx = tls if hasattr(tls, "wrap_socket") else tls.ssl_context()
+    server.socket = ctx.wrap_socket(server.socket, server_side=True,
+                                    do_handshake_on_connect=False)
+    # a silent client now stalls only its own handler thread; bound even
+    # that (BaseHTTPRequestHandler applies .timeout to the connection)
+    if getattr(server.RequestHandlerClass, "timeout", None) is None:
+        server.RequestHandlerClass.timeout = 60
+    stock_handle_error = server.handle_error
+
+    def handle_error(request, client_address):
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ssl.SSLError, ConnectionError, TimeoutError,
+                            OSError)):
+            log.debug("dropped connection from %s: %s", client_address, exc)
+        else:
+            stock_handle_error(request, client_address)
+
+    server.handle_error = handle_error
+
+
+# ---------------------------------------------------------------------------
+# client side
+
+
+def client_context(ca_pem: Optional[bytes] = None,
+                   ca_file: Optional[str] = None,
+                   insecure: bool = False) -> ssl.SSLContext:
+    """A verifying client context trusting exactly the given CA bundle
+    (reference ``DcosHttpClientBuilder.java`` pinning the cluster CA)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    if insecure:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    ctx.check_hostname = True
+    if ca_pem is not None:
+        ctx.load_verify_locations(cadata=ca_pem.decode())
+    elif ca_file is not None:
+        ctx.load_verify_locations(cafile=ca_file)
+    else:
+        ctx.load_default_certs()
+    return ctx
+
+
+_env_ctx_lock = threading.Lock()
+_env_ctx: Optional[Tuple[tuple, ssl.SSLContext]] = None
+
+
+def client_context_from_env() -> ssl.SSLContext:
+    """Context for ``https://`` control-plane URLs per the env contract;
+    cached until the knobs — or the CA file itself — change."""
+    global _env_ctx
+    ca_file = os.environ.get("TPU_TLS_CA") or None
+    insecure = os.environ.get("TPU_TLS_INSECURE", "") in ("1", "true", "yes")
+    ca_stamp = None
+    if ca_file is not None:
+        try:
+            st = os.stat(ca_file)
+            ca_stamp = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            ca_stamp = None  # load_verify_locations will surface the error
+    key = (ca_file, ca_stamp, insecure)
+    with _env_ctx_lock:
+        if _env_ctx is not None and _env_ctx[0] == key:
+            return _env_ctx[1]
+    if not insecure and ca_file is None:
+        raise ssl.SSLError(
+            "https:// control-plane URL but no trust configured: set "
+            "TPU_TLS_CA to the scheduler's CA bundle "
+            "(or TPU_TLS_INSECURE=1 to skip verification)")
+    ctx = client_context(ca_file=ca_file, insecure=insecure)
+    with _env_ctx_lock:
+        _env_ctx = (key, ctx)
+    return ctx
+
+
+def urlopen(req, timeout: float = 30.0,
+            context: Optional[ssl.SSLContext] = None):
+    """Drop-in ``urllib.request.urlopen`` for control-plane calls: https
+    URLs get the env-configured verifying context automatically."""
+    url = req if isinstance(req, str) else req.full_url
+    if context is None and url.startswith("https://"):
+        context = client_context_from_env()
+    return urllib.request.urlopen(req, timeout=timeout, context=context)

@@ -89,7 +89,7 @@ class StateReplicaServer:
     fenced lease grants. Deliberately dumb — coordination is client-side."""
 
     def __init__(self, root: str, port: int = 0, host: str = "127.0.0.1",
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None, tls=None):
         self._store = FilePersister(root)
         self._meta_path = os.path.join(os.path.abspath(root), ".replica-meta")
         self._secret = secret
@@ -156,6 +156,14 @@ class StateReplicaServer:
                     self._reply(404, {"error": self.path})
 
         self._server = ThreadingHTTPServer((host, port), Handler)
+        self._tls = tls
+        if tls is not None:
+            # transport security for the ensemble: the docstring's "never
+            # expose on an open network" warning stops applying once the
+            # replicas verify-and-encrypt (ssl.SSLContext or
+            # security.transport.ServerCredentials)
+            from ..security.transport import wrap_server
+            wrap_server(self._server, tls)
         self._thread: Optional[threading.Thread] = None
 
     # -- meta persistence (index + lease survive restart) -------------------
@@ -327,20 +335,22 @@ class StateReplicaServer:
 
 def _post(url: str, payload: dict, timeout: float,
           secret: Optional[str] = None) -> dict:
+    from ..security import transport
     headers = {"Content-Type": "application/json"}
     if secret is not None:
         headers["X-State-Secret"] = secret
     req = urllib.request.Request(
         url, method="POST", data=json.dumps(payload).encode(),
         headers=headers)
-    with urllib.request.urlopen(req, timeout=timeout) as r:
+    with transport.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read().decode())
 
 
 def _get(url: str, timeout: float, secret: Optional[str] = None) -> dict:
+    from ..security import transport
     headers = {"X-State-Secret": secret} if secret is not None else {}
     req = urllib.request.Request(url, headers=headers)
-    with urllib.request.urlopen(req, timeout=timeout) as r:
+    with transport.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read().decode())
 
 
@@ -774,6 +784,9 @@ def main(argv=None) -> int:  # pragma: no cover - thin daemon wrapper
     p.add_argument("--secret-file",
                    help="shared ensemble secret (required on non-loopback "
                         "binds; replicas hold ALL scheduler state)")
+    p.add_argument("--tls-cert", help="serve TLS with this certificate PEM "
+                                      "(with --tls-key)")
+    p.add_argument("--tls-key", help="private key PEM for --tls-cert")
     args = p.parse_args(argv)
     secret = None
     if args.secret_file:
@@ -783,11 +796,20 @@ def main(argv=None) -> int:  # pragma: no cover - thin daemon wrapper
         print("WARNING: binding a state replica to a non-loopback address "
               "without --secret-file exposes all scheduler state; pass "
               "--secret-file or isolate the port", flush=True)
+    tls = None
+    if args.tls_cert and args.tls_key:
+        from ..security.transport import server_context_from_files
+        tls = server_context_from_files(args.tls_cert, args.tls_key)
+    elif args.host not in ("127.0.0.1", "::1", "localhost"):
+        print("WARNING: non-loopback state replica without --tls-cert/"
+              "--tls-key speaks cleartext; the ensemble secret and all "
+              "state cross the network unencrypted", flush=True)
     server = StateReplicaServer(args.root, port=args.port, host=args.host,
-                                secret=secret)
+                                secret=secret, tls=tls)
     server.start()
-    print(f"state replica on {args.host}:{server.port} root={args.root}",
-          flush=True)
+    scheme = "https" if tls is not None else "http"
+    print(f"state replica on {scheme}://{args.host}:{server.port} "
+          f"root={args.root}", flush=True)
     try:
         while True:
             time.sleep(3600)

@@ -35,10 +35,11 @@ ROOT_PATHS = ("health", "metrics", "multi", "agents", "agents/info")
 
 def _fetch(url: str):
     from ..security.auth import auth_headers_from_env
+    from ..security.transport import urlopen
     try:
         req = urllib.request.Request(
             url, headers=auth_headers_from_env(url.split("/v1", 1)[0]))
-        with urllib.request.urlopen(req, timeout=30) as r:
+        with urlopen(req, timeout=30) as r:
             return json.loads(r.read().decode() or "null")
     except urllib.error.HTTPError as e:
         try:

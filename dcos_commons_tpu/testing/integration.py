@@ -26,10 +26,11 @@ def _open(url: str, method: str = "GET", data: Optional[bytes] = None,
     (TPU_AUTH_TOKEN or TPU_AUTH_UID/TPU_AUTH_SECRET; reference
     ``cli/client/http.go`` auth-header plumbing)."""
     from ..security.auth import auth_headers_from_env
+    from ..security.transport import urlopen
     base = url.split("/v1/", 1)[0]
     req = urllib.request.Request(url, method=method, data=data,
                                  headers=auth_headers_from_env(base))
-    return urllib.request.urlopen(req, timeout=timeout)
+    return urlopen(req, timeout=timeout)
 
 
 class IntegrationError(AssertionError):

@@ -69,6 +69,10 @@ def main(argv=None) -> int:
     cluster = RemoteCluster()
     # control-plane auth: TPU_AUTH_FILE names the accounts file
     _auth = Authenticator.from_env()
+    # transport security: TPU_TLS=1 mints from the persisted CA (or
+    # TPU_TLS_CERT/TPU_TLS_KEY name provisioned PEMs)
+    from dcos_commons_tpu.security import server_tls_from_env
+    _tls = server_tls_from_env(persister, "helloworld", args.state)
 
     if len(args.scenario) == 1:
         # mono-service (reference Main.java runDefaultService path)
@@ -80,7 +84,7 @@ def main(argv=None) -> int:
             lambda env, _name=args.scenario[0]:
             scenarios.load_scenario(_name, env))
         server = ApiServer(scheduler, port=args.port, metrics=metrics,
-                           cluster=cluster, auth=_auth)
+                           cluster=cluster, auth=_auth, tls=_tls)
         PlanReporter(metrics, scheduler)
         driver = CycleDriver(scheduler, interval_s=args.interval)
     else:
@@ -89,7 +93,8 @@ def main(argv=None) -> int:
         multi = MultiServiceScheduler(persister, cluster, metrics=metrics,
                                       auth=_auth)
         server = ApiServer(None, port=args.port, metrics=metrics,
-                           cluster=cluster, multi=multi, auth=_auth)
+                           cluster=cluster, multi=multi, auth=_auth,
+                           tls=_tls)
         multi.set_api_server(server)
         for name in args.scenario:
             spec = scenarios.load_scenario(name)
@@ -97,7 +102,7 @@ def main(argv=None) -> int:
         driver = CycleDriver(multi, interval_s=args.interval)
 
     server.start()
-    print(f"helloworld scheduler API on http://127.0.0.1:{server.port}/v1/",
+    print(f"helloworld scheduler API on {server.url}/v1/",
           flush=True)
     try:
         with driver:

@@ -62,18 +62,22 @@ def main(argv=None) -> int:
     cluster = RemoteCluster()
     # control-plane auth: TPU_AUTH_FILE names the accounts file
     _auth = Authenticator.from_env()
+    # transport security: TPU_TLS=1 mints from the persisted CA (or
+    # TPU_TLS_CERT/TPU_TLS_KEY name provisioned PEMs)
+    from dcos_commons_tpu.security import server_tls_from_env
+    _tls = server_tls_from_env(persister, "jax", args.state)
     spec = scenarios.load_scenario(args.scenario)
     scheduler = ServiceScheduler(spec, persister, cluster, metrics=metrics,
                                  auth=_auth)
     scheduler.respec = (lambda env, _name=args.scenario:
                         scenarios.load_scenario(_name, env))
     server = ApiServer(scheduler, port=args.port, metrics=metrics,
-                       cluster=cluster, auth=_auth)
+                       cluster=cluster, auth=_auth, tls=_tls)
     PlanReporter(metrics, scheduler)
     driver = CycleDriver(scheduler, interval_s=args.interval)
 
     server.start()
-    print(f"jax scheduler API on http://127.0.0.1:{server.port}/v1/",
+    print(f"jax scheduler API on {server.url}/v1/",
           flush=True)
     try:
         with driver:

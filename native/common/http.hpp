@@ -1,9 +1,11 @@
-// Minimal blocking HTTP/1.1 client over POSIX sockets (C++17, no deps).
+// Minimal blocking HTTP/1.1 client over POSIX sockets (C++17).
 //
 // The native components' transport to the scheduler ApiServer — the role the
 // reference delegated to libmesos/JNI (scheduler side) and Go's net/http
-// (bootstrap/CLI side). Supports http://host:port/path only; each request
-// uses a fresh connection (Connection: close) — the protocol is low-rate
+// (bootstrap/CLI side). Supports http://host:port/path and — via tls.hpp,
+// verifying against the TPU_TLS_CA bundle like the reference's
+// cli/client/http.go verifies the cluster CA — https://. Each request uses
+// a fresh connection (Connection: close) — the protocol is low-rate
 // (1 Hz polls), so simplicity beats keep-alive.
 
 #pragma once
@@ -13,9 +15,13 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <stdexcept>
 #include <string>
+
+#include "tls.hpp"
 
 namespace tpu {
 
@@ -28,23 +34,31 @@ struct Url {
   std::string host;
   std::string port;
   std::string path;
+  bool tls = false;
 };
 
 inline Url parse_url(const std::string& url) {
-  const std::string scheme = "http://";
-  if (url.compare(0, scheme.size(), scheme) != 0) {
-    throw std::runtime_error("only http:// URLs supported: " + url);
+  const std::string http = "http://";
+  const std::string https = "https://";
+  Url out;
+  std::string rest;
+  if (url.compare(0, https.size(), https) == 0) {
+    out.tls = true;
+    rest = url.substr(https.size());
+  } else if (url.compare(0, http.size(), http) == 0) {
+    rest = url.substr(http.size());
+  } else {
+    throw std::runtime_error("only http:// and https:// URLs supported: " +
+                             url);
   }
-  std::string rest = url.substr(scheme.size());
   size_t slash = rest.find('/');
   std::string hostport = slash == std::string::npos ? rest
                                                     : rest.substr(0, slash);
-  Url out;
   out.path = slash == std::string::npos ? "/" : rest.substr(slash);
   size_t colon = hostport.rfind(':');
   if (colon == std::string::npos) {
     out.host = hostport;
-    out.port = "80";
+    out.port = out.tls ? "443" : "80";
   } else {
     out.host = hostport.substr(0, colon);
     out.port = hostport.substr(colon + 1);
@@ -88,6 +102,44 @@ inline HttpResponse http_request(const std::string& method,
                              " failed");
   }
 
+  // transport security (env contract shared with the Python clients,
+  // dcos_commons_tpu/security/transport.py)
+  std::unique_ptr<tls::Conn> tls_conn;
+  if (u.tls) {
+    const char* ca = std::getenv("TPU_TLS_CA");
+    const char* insecure_env = std::getenv("TPU_TLS_INSECURE");
+    // accepted values mirror the Python twin (transport.py): 1/true/yes
+    bool insecure = insecure_env != nullptr &&
+                    (std::string(insecure_env) == "1" ||
+                     std::string(insecure_env) == "true" ||
+                     std::string(insecure_env) == "yes");
+    if (!insecure && (ca == nullptr || *ca == '\0')) {
+      close(fd);
+      throw std::runtime_error(
+          "https:// control-plane URL but no trust configured: set "
+          "TPU_TLS_CA to the scheduler's CA bundle (or TPU_TLS_INSECURE=1)");
+    }
+    try {
+      tls_conn = std::make_unique<tls::Conn>(
+          fd, u.host, ca != nullptr ? std::string(ca) : std::string(),
+          insecure);
+    } catch (...) {
+      close(fd);
+      throw;
+    }
+  }
+  auto send_all = [&](const char* data, size_t len) -> bool {
+    size_t sent = 0;
+    while (sent < len) {
+      long n = tls_conn != nullptr
+                   ? tls_conn->write(data + sent, len - sent)
+                   : static_cast<long>(send(fd, data + sent, len - sent, 0));
+      if (n <= 0) return false;
+      sent += static_cast<size_t>(n);
+    }
+    return true;
+  };
+
   std::string req = method + " " + u.path + " HTTP/1.1\r\n" +
                     "Host: " + u.host + ":" + u.port + "\r\n" +
                     "Content-Type: application/json\r\n" +
@@ -97,23 +149,22 @@ inline HttpResponse http_request(const std::string& method,
     req += "Authorization: token=" + auth + "\r\n";
   }
   req += "Connection: close\r\n\r\n" + body;
-  size_t sent = 0;
-  while (sent < req.size()) {
-    ssize_t n = send(fd, req.data() + sent, req.size() - sent, 0);
-    if (n <= 0) {
-      close(fd);
-      throw std::runtime_error("send failed");
-    }
-    sent += static_cast<size_t>(n);
+  if (!send_all(req.data(), req.size())) {
+    tls_conn.reset();
+    close(fd);
+    throw std::runtime_error("send failed");
   }
 
   std::string raw;
   char buf[8192];
   while (true) {
-    ssize_t n = recv(fd, buf, sizeof buf, 0);
+    long n = tls_conn != nullptr
+                 ? tls_conn->read(buf, sizeof buf)
+                 : static_cast<long>(recv(fd, buf, sizeof buf, 0));
     if (n <= 0) break;
     raw.append(buf, static_cast<size_t>(n));
   }
+  tls_conn.reset();  // close_notify before the socket goes away
   close(fd);
 
   size_t header_end = raw.find("\r\n\r\n");

@@ -1,0 +1,235 @@
+// TLS client for the native control-plane components (agent, tpuctl).
+//
+// Role: the client half of the control-plane transport security that the
+// reference got from DC/OS adminrouter + a TLS-configured client stack
+// (sdk/.../dcos/DcosHttpClientBuilder.java, cli/client/http.go). The Python
+// twin is dcos_commons_tpu/security/transport.py — same env contract:
+//   TPU_TLS_CA       path to the scheduler CA bundle (verify peer + host)
+//   TPU_TLS_INSECURE "1" to skip verification (development only)
+//
+// The image ships libssl.so.3/libcrypto.so.3 but no OpenSSL headers, so the
+// handful of client-side entry points (a stable C ABI) are declared here and
+// resolved with dlopen at first use. No link-time OpenSSL dependency: a box
+// without libssl can still run cleartext http://.
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <dlfcn.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+namespace tpu {
+namespace tls {
+
+// opaque OpenSSL handles (we only pass pointers through the C ABI)
+struct SslCtx;
+struct Ssl;
+struct SslMethod;
+struct VerifyParam;
+
+// OpenSSL ABI constants (stable across 1.1/3.x)
+constexpr int kSslVerifyPeer = 0x01;
+constexpr long kSslCtrlSetMinProtoVersion = 123;
+constexpr long kTls12Version = 0x0303;
+constexpr long kSslCtrlSetTlsextHostname = 55;
+constexpr int kTlsextNametypeHostName = 0;
+constexpr int kSslErrorWantRead = 2;
+constexpr int kSslErrorWantWrite = 3;
+
+struct Api {
+  SslMethod* (*TLS_client_method)();
+  SslCtx* (*SSL_CTX_new)(SslMethod*);
+  void (*SSL_CTX_free)(SslCtx*);
+  void (*SSL_CTX_set_verify)(SslCtx*, int, void*);
+  int (*SSL_CTX_load_verify_locations)(SslCtx*, const char*, const char*);
+  int (*SSL_CTX_set_default_verify_paths)(SslCtx*);
+  long (*SSL_CTX_ctrl)(SslCtx*, int, long, void*);
+  Ssl* (*SSL_new)(SslCtx*);
+  void (*SSL_free)(Ssl*);
+  int (*SSL_set_fd)(Ssl*, int);
+  int (*SSL_connect)(Ssl*);
+  int (*SSL_read)(Ssl*, void*, int);
+  int (*SSL_write)(Ssl*, const void*, int);
+  int (*SSL_shutdown)(Ssl*);
+  int (*SSL_get_error)(const Ssl*, int);
+  long (*SSL_get_verify_result)(const Ssl*);
+  long (*SSL_ctrl)(Ssl*, int, long, void*);
+  VerifyParam* (*SSL_get0_param)(Ssl*);
+  int (*X509_VERIFY_PARAM_set1_host)(VerifyParam*, const char*, size_t);
+  int (*X509_VERIFY_PARAM_set1_ip_asc)(VerifyParam*, const char*);
+  unsigned long (*ERR_get_error)();
+  void (*ERR_error_string_n)(unsigned long, char*, size_t);
+
+  static const Api& instance() {
+    static Api api = load();
+    return api;
+  }
+
+ private:
+  static Api load() {
+    // libssl pulls in libcrypto as a dependency; RTLD_GLOBAL lets the
+    // libcrypto symbols resolve from the same namespace
+    void* ssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (ssl == nullptr) ssl = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+    if (ssl == nullptr) {
+      throw std::runtime_error(
+          "https:// requested but libssl is not available: " +
+          std::string(dlerror()));
+    }
+    void* crypto = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (crypto == nullptr) crypto = dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+    Api api;
+    auto need = [](void* lib, const char* name) -> void* {
+      void* sym = dlsym(lib, name);
+      if (sym == nullptr) {
+        throw std::runtime_error(std::string("missing OpenSSL symbol ") +
+                                 name);
+      }
+      return sym;
+    };
+#define TPU_TLS_SYM(lib, name) \
+  api.name = reinterpret_cast<decltype(api.name)>(need(lib, #name))
+    TPU_TLS_SYM(ssl, TLS_client_method);
+    TPU_TLS_SYM(ssl, SSL_CTX_new);
+    TPU_TLS_SYM(ssl, SSL_CTX_free);
+    TPU_TLS_SYM(ssl, SSL_CTX_set_verify);
+    TPU_TLS_SYM(ssl, SSL_CTX_load_verify_locations);
+    TPU_TLS_SYM(ssl, SSL_CTX_set_default_verify_paths);
+    TPU_TLS_SYM(ssl, SSL_CTX_ctrl);
+    TPU_TLS_SYM(ssl, SSL_new);
+    TPU_TLS_SYM(ssl, SSL_free);
+    TPU_TLS_SYM(ssl, SSL_set_fd);
+    TPU_TLS_SYM(ssl, SSL_connect);
+    TPU_TLS_SYM(ssl, SSL_read);
+    TPU_TLS_SYM(ssl, SSL_write);
+    TPU_TLS_SYM(ssl, SSL_shutdown);
+    TPU_TLS_SYM(ssl, SSL_get_error);
+    TPU_TLS_SYM(ssl, SSL_get_verify_result);
+    TPU_TLS_SYM(ssl, SSL_ctrl);
+    TPU_TLS_SYM(ssl, SSL_get0_param);
+    void* cl = crypto != nullptr ? crypto : ssl;
+    TPU_TLS_SYM(cl, X509_VERIFY_PARAM_set1_host);
+    TPU_TLS_SYM(cl, X509_VERIFY_PARAM_set1_ip_asc);
+    TPU_TLS_SYM(cl, ERR_get_error);
+    TPU_TLS_SYM(cl, ERR_error_string_n);
+#undef TPU_TLS_SYM
+    return api;
+  }
+};
+
+inline bool is_ip_literal(const std::string& host) {
+  unsigned char buf[sizeof(struct in6_addr)];
+  return inet_pton(AF_INET, host.c_str(), buf) == 1 ||
+         inet_pton(AF_INET6, host.c_str(), buf) == 1;
+}
+
+inline std::string last_error(const Api& api) {
+  unsigned long code = api.ERR_get_error();
+  if (code == 0) return "unknown TLS error";
+  char buf[256];
+  api.ERR_error_string_n(code, buf, sizeof buf);
+  return std::string(buf);
+}
+
+// One verified TLS session over an already-connected fd. The fd stays owned
+// by the caller (http.hpp closes it after shutdown).
+class Conn {
+ public:
+  Conn(int fd, const std::string& host, const std::string& ca_file,
+       bool insecure)
+      : api_(Api::instance()) {
+    ctx_ = api_.SSL_CTX_new(api_.TLS_client_method());
+    if (ctx_ == nullptr) throw std::runtime_error("SSL_CTX_new failed");
+    api_.SSL_CTX_ctrl(ctx_, kSslCtrlSetMinProtoVersion, kTls12Version,
+                      nullptr);
+    if (!insecure) {
+      api_.SSL_CTX_set_verify(ctx_, kSslVerifyPeer, nullptr);
+      int ok = ca_file.empty()
+                   ? api_.SSL_CTX_set_default_verify_paths(ctx_)
+                   : api_.SSL_CTX_load_verify_locations(ctx_, ca_file.c_str(),
+                                                        nullptr);
+      if (ok != 1) {
+        cleanup();
+        throw std::runtime_error("cannot load CA bundle " + ca_file + ": " +
+                                 last_error(api_));
+      }
+    }
+    ssl_ = api_.SSL_new(ctx_);
+    if (ssl_ == nullptr) {
+      cleanup();
+      throw std::runtime_error("SSL_new failed");
+    }
+    if (!insecure) {
+      // hostname (or IP SAN) verification, enforced during the handshake
+      VerifyParam* param = api_.SSL_get0_param(ssl_);
+      int ok = is_ip_literal(host)
+                   ? api_.X509_VERIFY_PARAM_set1_ip_asc(param, host.c_str())
+                   : api_.X509_VERIFY_PARAM_set1_host(param, host.c_str(), 0);
+      if (ok != 1) {
+        cleanup();
+        throw std::runtime_error("cannot pin expected peer name " + host);
+      }
+    }
+    if (!is_ip_literal(host)) {  // SNI (servers may key certs on it)
+      api_.SSL_ctrl(ssl_, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
+                    const_cast<char*>(host.c_str()));
+    }
+    api_.SSL_set_fd(ssl_, fd);
+    if (api_.SSL_connect(ssl_) != 1) {
+      long verify = api_.SSL_get_verify_result(ssl_);
+      std::string detail = last_error(api_);
+      cleanup();
+      throw std::runtime_error(
+          "TLS handshake with " + host + " failed" +
+          (verify != 0 ? " (certificate verification error " +
+                             std::to_string(verify) + ")"
+                       : "") +
+          ": " + detail);
+    }
+  }
+
+  Conn(const Conn&) = delete;
+  Conn& operator=(const Conn&) = delete;
+
+  ~Conn() {
+    if (ssl_ != nullptr) api_.SSL_shutdown(ssl_);
+    cleanup();
+  }
+
+  // recv(2) semantics: >0 bytes, 0 on orderly close, <0 on error
+  long read(char* buf, size_t len) {
+    int n = api_.SSL_read(ssl_, buf, static_cast<int>(len));
+    if (n > 0) return n;
+    int err = api_.SSL_get_error(ssl_, n);
+    // close_notify or transport EOF both end the response body
+    return (err == kSslErrorWantRead || err == kSslErrorWantWrite) ? -1 : 0;
+  }
+
+  long write(const char* buf, size_t len) {
+    int n = api_.SSL_write(ssl_, buf, static_cast<int>(len));
+    return n > 0 ? n : -1;
+  }
+
+ private:
+  void cleanup() {
+    if (ssl_ != nullptr) {
+      api_.SSL_free(ssl_);
+      ssl_ = nullptr;
+    }
+    if (ctx_ != nullptr) {
+      api_.SSL_CTX_free(ctx_);
+      ctx_ = nullptr;
+    }
+  }
+
+  const Api& api_;
+  SslCtx* ctx_ = nullptr;
+  Ssl* ssl_ = nullptr;
+};
+
+}  // namespace tls
+}  // namespace tpu
