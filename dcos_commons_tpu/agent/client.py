@@ -13,7 +13,7 @@ is gone: launch, kill, reconcile. Implementations:
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Callable, Optional, Protocol, Sequence
+from typing import TYPE_CHECKING, Callable, Protocol, Sequence
 
 from ..state.tasks import TaskStatus
 from .inventory import AgentInfo

@@ -17,8 +17,8 @@ Behavior modes per task (set via ``script``):
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field, replace
-from typing import Callable, Dict, List, Optional, Sequence
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
 
 from ..matching.evaluator import LaunchPlan, TaskLaunch
 from ..state.tasks import TaskState, TaskStatus

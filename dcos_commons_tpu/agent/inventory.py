@@ -15,7 +15,7 @@ placement consumes.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Mapping, Optional, Sequence, Tuple
+from typing import Mapping, Optional, Tuple
 
 
 @dataclass(frozen=True)

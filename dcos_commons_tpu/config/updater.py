@@ -11,7 +11,7 @@ configs.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence
 
 from ..specification.spec import PodSpec, ServiceSpec

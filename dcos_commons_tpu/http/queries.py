@@ -13,7 +13,6 @@ from typing import List, Optional
 
 from ..plan.elements import Phase, Plan, Step
 from ..plan.status import Status
-from ..state.state_store import GoalOverride
 
 
 class ApiError(Exception):

@@ -23,16 +23,15 @@ from __future__ import annotations
 
 import base64
 import logging
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 from ..agent.inventory import AgentInfo, TaskRecord
 from ..plan.requirement import PodInstanceRequirement, RecoveryType
-from ..specification.spec import (HealthCheckSpec, PodSpec,
-                                  ReadinessCheckSpec, ResourceSet)
+from ..specification.spec import HealthCheckSpec, ReadinessCheckSpec
 from ..state.tasks import TpuAssignment
 from ..utils.ids import make_task_id, new_uuid
-from .ledger import Availability, Reservation, ReservationLedger, VolumeReservation
+from .ledger import Reservation, ReservationLedger, VolumeReservation
 from .outcome import EvaluationOutcome, OutcomeNode
 
 log = logging.getLogger(__name__)

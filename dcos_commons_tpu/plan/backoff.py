@@ -10,7 +10,7 @@ Env knobs in the reference: ``ENABLE_BACKOFF``, initial/max/factor
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 
 class Backoff:

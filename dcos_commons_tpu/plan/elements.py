@@ -17,7 +17,7 @@ from typing import Dict, Iterable, List, Optional, Sequence
 from ..specification.spec import GoalState
 from ..state.tasks import TaskState, TaskStatus
 from .backoff import Backoff, DisabledBackoff
-from .requirement import PodInstanceRequirement, RecoveryType
+from .requirement import PodInstanceRequirement
 from .status import Status, aggregate
 from .strategy import SerialStrategy, Strategy
 

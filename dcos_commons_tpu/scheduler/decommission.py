@@ -8,16 +8,13 @@ unreserve-per-agent -> deregister), ``UninstallScheduler.java``.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import Callable, List
 
 from ..plan.elements import ActionStep, Phase, Plan
 from ..plan.manager import PlanManager
 from ..plan.status import Status
 from ..plan.strategy import ParallelStrategy, SerialStrategy
 from ..specification.spec import ServiceSpec
-from ..state.reservation_store import ReservationStore
-from ..state.state_store import StateStore
-from ..state.tasks import StoredTask
 
 DECOMMISSION_PLAN_NAME = "decommission"
 UNINSTALL_PLAN_NAME = "uninstall"

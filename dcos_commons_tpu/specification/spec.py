@@ -24,7 +24,7 @@ from __future__ import annotations
 import enum
 import json
 from dataclasses import asdict, dataclass, field, replace
-from typing import Any, Mapping, Optional, Sequence
+from typing import Any, Mapping, Optional
 
 from ..matching.placement import PlacementRule, rule_from_json, rule_to_json
 

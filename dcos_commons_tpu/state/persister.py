@@ -17,7 +17,7 @@ import os
 import shutil
 import threading
 from functools import lru_cache
-from typing import Dict, Iterable, Mapping, Optional
+from typing import Dict, Mapping, Optional
 
 
 class PersisterError(Exception):

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import enum
 import json
-from typing import Iterable, Mapping, Optional
+from typing import Iterable, Optional
 
 from ..specification.spec import ServiceSpec
 from ..utils.ids import new_uuid

@@ -15,7 +15,7 @@ import json
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional
 
 DEFAULT_TIMEOUT_S = 15 * 60  # reference testing/sdk_plan.py:17
 

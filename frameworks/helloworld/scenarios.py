@@ -9,7 +9,7 @@ the scheduler env so templated svc.ymls resolve without a live cluster).
 from __future__ import annotations
 
 import os
-from typing import Callable, Mapping, Optional
+from typing import Mapping, Optional
 
 from dcos_commons_tpu.specification import ServiceSpec, load_service_yaml
 

@@ -8,6 +8,9 @@ cd "$(dirname "$0")"
 echo "== native build =="
 make -C native
 
+echo "== static analysis =="
+python -m tools.static_check
+
 echo "== test suite =="
 python -m pytest tests/ -q "$@"
 

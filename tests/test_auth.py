@@ -8,7 +8,6 @@ unauthenticated control-plane calls, service accounts obtain IAM tokens
 """
 
 import json
-import time
 import urllib.error
 import urllib.request
 

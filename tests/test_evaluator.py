@@ -5,7 +5,6 @@ fit, placement integration, port allocation, volumes, reservation reuse,
 plus the TPU-native gang placement pass.
 """
 
-import pytest
 
 from dcos_commons_tpu.agent import AgentInfo, PortRange, TaskRecord, TpuInventory
 from dcos_commons_tpu.matching import (Evaluator, OutcomeTracker, Reservation,

@@ -4,7 +4,6 @@ test mesh; the real-chip path is exercised by bench/TPU runs).
 
 import jax
 import jax.numpy as jnp
-import pytest
 
 from dcos_commons_tpu.ops.attention import gqa_attention
 from dcos_commons_tpu.ops.flash_attention import flash_attention, supports

@@ -6,7 +6,6 @@ deploy plan with per-step task lists (format-then-start ordering,
 (``HdfsRecoveryPlanOverrider.java:25-81``).
 """
 
-from dcos_commons_tpu.plan import Status
 from dcos_commons_tpu.state import TaskState
 from dcos_commons_tpu.testing import Expect, Send, ServiceTestRunner
 from dcos_commons_tpu.testing.simulation import default_agents

@@ -1,6 +1,5 @@
 """Placement DSL tests (reference ``offer/evaluate/placement/*Test`` coverage)."""
 
-import pytest
 
 from dcos_commons_tpu.agent import AgentInfo, TaskRecord, TpuInventory
 from dcos_commons_tpu.matching import (AndRule, HostnameRule,

@@ -9,11 +9,10 @@ import pytest
 
 from dcos_commons_tpu.plan import (CanaryStrategy, DependencyStrategy,
                                    DeploymentStep, ExponentialBackoff,
-                                   ParallelStrategy, Phase, Plan,
-                                   PlanCoordinator, PlanManager,
-                                   PodInstanceRequirement, SerialStrategy,
-                                   Status, build_deploy_plan,
-                                   build_plan_from_spec, strategy_for)
+                                   ParallelStrategy, PlanCoordinator,
+                                   PlanManager, PodInstanceRequirement,
+                                   SerialStrategy, Status, build_deploy_plan,
+                                   strategy_for)
 from dcos_commons_tpu.specification import (PodInstance,
                                             load_service_yaml_str)
 from dcos_commons_tpu.state import (MemPersister, StateStore, StoredTask,

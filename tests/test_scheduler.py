@@ -6,7 +6,6 @@ transient->permanent escalation; ``SchedulerRestartServiceTest.java``), plus
 the TPU gang scenarios the reference never had.
 """
 
-import pytest
 
 from dcos_commons_tpu.agent import (AgentInfo, FakeCluster, PortRange,
                                     TaskBehavior, TpuInventory)

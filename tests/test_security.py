@@ -9,7 +9,7 @@ from cryptography import x509
 
 from dcos_commons_tpu.security import (CertificateAuthority, SecretsStore,
                                        TLSProvisioner)
-from dcos_commons_tpu.state import MemPersister, TaskState
+from dcos_commons_tpu.state import MemPersister
 from dcos_commons_tpu.testing import Expect, Send, ServiceTestRunner
 
 from frameworks.helloworld import scenarios

@@ -23,7 +23,7 @@ import os
 import re
 import sys
 import urllib.request
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 _NUM = re.compile(r"(\d+|\D+)")
 

@@ -1,0 +1,340 @@
+"""Static-analysis gate (reference: checkstyle + findbugs in
+``gradle/checkstyle/``, ``gradle/findbugs/``, plus ``mypy.ini`` and
+pre-commit black, ``TESTING.md:8-28``).
+
+This image ships no mypy/ruff/pyflakes, so the gate is implemented from
+the stdlib: ``symtable`` gives real scope analysis and ``ast`` the
+structure. The checks are the high-signal subset of pyflakes/findbugs —
+chosen to be zero-false-positive on idiomatic code so CI can hard-fail:
+
+U1  undefined name: a global-scoped reference that no module-level
+    binding, import, or builtin satisfies (the classic typo'd call)
+U2  unused import: bound by an import at module scope, never referenced
+    (``# noqa`` on the import line exempts deliberate re-exports)
+A1  arity: a call to a module-local function with too many/few
+    positional arguments (skipped when *args/**kwargs are involved)
+M1  mutable default argument (list/dict/set literal)
+T1  assert on a non-empty tuple literal (always true)
+D1  duplicate function/method definition in one scope (later silently
+    shadows earlier)
+
+Usage: ``python -m tools.static_check [paths...]`` (default: the package,
+frameworks, tools, tests). Exit 1 on any finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import sys
+import symtable
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+DEFAULT_PATHS = ("dcos_commons_tpu", "frameworks", "tools", "tests",
+                 "bench.py", "__graft_entry__.py")
+
+_BUILTINS = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__debug__", "__builtins__", "__path__", "__annotations__",
+    # typing's implicit runtime names inside functions under
+    # `from __future__ import annotations` stay unevaluated, but the
+    # symtable still records them; these appear in idiomatic code:
+    "__class__",
+}
+
+
+class Finding:
+    def __init__(self, path: Path, line: int, code: str, message: str):
+        self.path = path
+        self.line = line
+        self.code = code
+        self.message = message
+
+    def __str__(self) -> str:
+        try:
+            rel = self.path.relative_to(REPO)
+        except ValueError:
+            rel = self.path
+        return f"{rel}:{self.line}: {self.code} {self.message}"
+
+
+def _iter_py_files(paths) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        p = (REPO / p) if not Path(p).is_absolute() else Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def _noqa_lines(source: str) -> set:
+    return {i for i, line in enumerate(source.splitlines(), 1)
+            if "# noqa" in line}
+
+
+# ---------------------------------------------------------------------------
+# U1/U2: scope analysis via symtable
+
+
+def _names_in_expr(node: ast.AST, out: set) -> None:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            # string annotation: "Optional[Foo]"
+            try:
+                _names_in_expr(ast.parse(n.value, mode="eval"), out)
+            except SyntaxError:
+                pass
+
+
+def _annotation_names(tree: ast.Module) -> set:
+    out: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for arg in [*node.args.posonlyargs, *node.args.args,
+                        *node.args.kwonlyargs,
+                        *([node.args.vararg] if node.args.vararg else []),
+                        *([node.args.kwarg] if node.args.kwarg else [])]:
+                if arg.annotation is not None:
+                    _names_in_expr(arg.annotation, out)
+            if node.returns is not None:
+                _names_in_expr(node.returns, out)
+        elif isinstance(node, ast.AnnAssign):
+            _names_in_expr(node.annotation, out)
+    return out
+
+
+def _module_bindings(table: symtable.SymbolTable) -> set:
+    names = set()
+    for sym in table.get_symbols():
+        if sym.is_assigned() or sym.is_imported():
+            names.add(sym.get_name())
+    # defs and classes are assignments at module level too
+    for child in table.get_children():
+        names.add(child.get_name())
+    return names
+
+
+def _walk_scopes(table: symtable.SymbolTable):
+    yield table
+    for child in table.get_children():
+        yield from _walk_scopes(child)
+
+
+def _check_scopes(path: Path, source: str, tree: ast.Module,
+                  findings: List[Finding]) -> None:
+    try:
+        table = symtable.symtable(source, str(path), "exec")
+    except SyntaxError:
+        return  # syntax failures are reported by the parse step
+    module_names = _module_bindings(table)
+    has_star_import = any(
+        isinstance(n, ast.ImportFrom) and any(a.name == "*" for a in n.names)
+        for n in ast.walk(tree))
+    noqa = _noqa_lines(source)
+
+    # map import bindings to their line for U2 reporting
+    import_lines: Dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                import_lines[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue  # future statements are directives, not bindings
+            for a in node.names:
+                if a.name != "*":
+                    import_lines[a.asname or a.name] = node.lineno
+
+    # U2: unused module-level imports. is_referenced() is per-scope, so a
+    # module import used only inside a function must be collected from the
+    # scope that references it (where the name resolves as global).
+    used_globally = set()
+    for scope in _walk_scopes(table):
+        for sym in scope.get_symbols():
+            if not sym.is_referenced():
+                continue
+            if scope is table or sym.is_global() or sym.is_free():
+                used_globally.add(sym.get_name())
+    # under `from __future__ import annotations` the annotation expressions
+    # are never compiled, so symtable misses the names they reference —
+    # harvest them (incl. string annotations) from the AST
+    used_globally |= _annotation_names(tree)
+    if path.name != "__init__.py":  # __init__ imports ARE the re-export API
+        for sym in table.get_symbols():
+            name = sym.get_name()
+            if (sym.is_imported() and name not in used_globally
+                    and name in import_lines
+                    and import_lines[name] not in noqa):
+                findings.append(Finding(
+                    path, import_lines[name], "U2",
+                    f"'{name}' imported but unused"))
+
+    # U1: names referenced as globals that nothing defines
+    if has_star_import:
+        return  # star imports defeat resolution; skip U1 for this file
+    for scope in _walk_scopes(table):
+        if scope is table:
+            continue
+        for sym in scope.get_symbols():
+            name = sym.get_name()
+            if not sym.is_referenced() or sym.is_assigned():
+                continue
+            if sym.is_local() or sym.is_parameter() or sym.is_free():
+                continue
+            if not sym.is_global():
+                continue
+            if name in module_names or name in _BUILTINS:
+                continue
+            findings.append(Finding(
+                path, scope.get_lineno(), "U1",
+                f"undefined name '{name}' in scope '{scope.get_name()}'"))
+
+
+# ---------------------------------------------------------------------------
+# A1/M1/T1/D1: AST checks
+
+
+def _positional_bounds(fn: ast.FunctionDef) -> Optional[Tuple[int, int]]:
+    """(min, max) positional args accepted, or None when *args present."""
+    a = fn.args
+    if a.vararg is not None:
+        return None
+    n_pos = len(a.posonlyargs) + len(a.args)
+    n_default = len(a.defaults)
+    return n_pos - n_default, n_pos
+
+
+def _check_ast(path: Path, source: str, tree: ast.Module,
+               findings: List[Finding]) -> None:
+    noqa = _noqa_lines(source)
+
+    # module-level function signatures for the arity check
+    module_fns: Dict[str, ast.FunctionDef] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            module_fns[node.name] = node
+
+    # names rebound anywhere (a local `step = ...` shadowing a def, or a
+    # module-level reassignment) disqualify the arity check for that name
+    rebound = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        rebound.add(n.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for arg in ([*node.args.posonlyargs, *node.args.args,
+                         *node.args.kwonlyargs]
+                        + ([node.args.vararg] if node.args.vararg else [])
+                        + ([node.args.kwarg] if node.args.kwarg else [])):
+                rebound.add(arg.arg)
+
+    for node in ast.walk(tree):
+        # M1 mutable defaults
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in [*node.args.defaults, *node.args.kw_defaults]:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)) \
+                        and node.lineno not in noqa:
+                    findings.append(Finding(
+                        path, node.lineno, "M1",
+                        f"mutable default argument in '{node.name}'"))
+        # T1 assert on tuple
+        if isinstance(node, ast.Assert) \
+                and isinstance(node.test, ast.Tuple) and node.test.elts:
+            findings.append(Finding(
+                path, node.lineno, "T1",
+                "assert on a tuple literal is always true"))
+        # D1 duplicate defs in one body
+        if isinstance(node, (ast.Module, ast.ClassDef)):
+            seen: Dict[str, int] = {}
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    has_deco = bool(stmt.decorator_list)
+                    if stmt.name in seen and not has_deco \
+                            and stmt.lineno not in noqa:
+                        findings.append(Finding(
+                            path, stmt.lineno, "D1",
+                            f"'{stmt.name}' redefines line {seen[stmt.name]}"))
+                    seen[stmt.name] = stmt.lineno
+        # A1 arity of calls to module-local functions
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            fn = module_fns.get(node.func.id)
+            if fn is None or node.func.id in rebound:
+                continue
+            if any(isinstance(a, ast.Starred) for a in node.args) \
+                    or any(kw.arg is None for kw in node.keywords):
+                continue  # *args / **kwargs at the call site
+            bounds = _positional_bounds(fn)
+            if bounds is None:
+                continue
+            lo, hi = bounds
+            n_pos = len(node.args)
+            kw_names = {kw.arg for kw in node.keywords}
+            all_params = [a.arg for a in
+                          [*fn.args.posonlyargs, *fn.args.args,
+                           *fn.args.kwonlyargs]]
+            unknown = kw_names - set(all_params) \
+                if fn.args.kwarg is None else set()
+            # keywords can cover required positionals
+            covered = sum(1 for a in fn.args.args if a.arg in kw_names)
+            if node.lineno in noqa:
+                continue
+            if unknown:
+                findings.append(Finding(
+                    path, node.lineno, "A1",
+                    f"call to '{fn.name}' with unknown keyword(s) "
+                    f"{sorted(unknown)}"))
+            elif n_pos > hi:
+                findings.append(Finding(
+                    path, node.lineno, "A1",
+                    f"call to '{fn.name}' with {n_pos} positional args "
+                    f"(max {hi})"))
+            elif n_pos + covered < lo:
+                findings.append(Finding(
+                    path, node.lineno, "A1",
+                    f"call to '{fn.name}' with {n_pos} positional + "
+                    f"{covered} keyword args (needs {lo})"))
+
+
+# ---------------------------------------------------------------------------
+
+
+def check_file(path: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        source = path.read_text(encoding="utf-8")
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding(path, 0, "E0", f"unreadable: {e}")]
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "E1", f"syntax error: {e.msg}")]
+    _check_scopes(path, source, tree, findings)
+    _check_ast(path, source, tree, findings)
+    return findings
+
+
+def main(argv=None) -> int:
+    paths = (argv if argv else sys.argv[1:]) or list(DEFAULT_PATHS)
+    files = _iter_py_files(paths)
+    all_findings: List[Finding] = []
+    for f in files:
+        all_findings.extend(check_file(f))
+    for finding in all_findings:
+        print(finding)
+    print(f"static_check: {len(files)} files, {len(all_findings)} findings")
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
