@@ -69,12 +69,28 @@ def _read_anchor() -> float:
     return 0.0
 
 
+def _median_spread(vals):
+    """(median, {min, max, trials}) — the spread makes vs_baseline
+    auditable against run-to-run noise (~±2% observed on the tunneled
+    v5e backend)."""
+    vals = sorted(vals)
+    n = len(vals)
+    med = (vals[n // 2] if n % 2 else
+           0.5 * (vals[n // 2 - 1] + vals[n // 2]))
+    return med, {"min": round(vals[0], 2), "max": round(vals[-1], 2),
+                 "trials": [round(v, 2) for v in vals]}
+
+
+RESNET_BATCH = 256  # fused-BN makes 256 the measured optimum on v5e
+N_TRIALS = 5
+
+
 def bench_resnet(jax, jnp, n_chips):
     from dcos_commons_tpu.models import resnet, train
 
     cfg = resnet.ResNetConfig(depth=50, n_classes=1000)
     params, state = resnet.init_params(cfg, jax.random.key(0))
-    batch = 128
+    batch = RESNET_BATCH
     x = jax.random.normal(jax.random.key(1), (batch, 224, 224, 3),
                           jnp.bfloat16)
     y = jax.random.randint(jax.random.key(2), (batch,), 0, cfg.n_classes)
@@ -92,14 +108,17 @@ def bench_resnet(jax, jnp, n_chips):
     float(out["loss"])
 
     n_steps = 20
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        params, opt_state, state, out = step(params, opt_state,
-                                             (state, (x, y)))
-    float(out["loss"])
-    dt = time.perf_counter() - t0
-    ips_per_chip = batch * n_steps / dt / n_chips
-    return ips_per_chip, RESNET50_TRAIN_FLOPS_PER_IMAGE * batch
+    trials = []
+    for _ in range(N_TRIALS):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            params, opt_state, state, out = step(params, opt_state,
+                                                 (state, (x, y)))
+        float(out["loss"])
+        dt = time.perf_counter() - t0
+        trials.append(batch * n_steps / dt / n_chips)
+    median, spread = _median_spread(trials)
+    return median, spread, RESNET50_TRAIN_FLOPS_PER_IMAGE * batch
 
 
 def bench_llama(jax, jnp, n_chips):
@@ -128,17 +147,20 @@ def bench_llama(jax, jnp, n_chips):
     float(out["loss"])
 
     n_steps = 10
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        params, opt_state, out = step(params, opt_state, toks)
-    float(out["loss"])
-    dt = time.perf_counter() - t0
-
     tokens_per_step = batch * (seq - 1)  # next-token loss consumes S-1
-    tok_per_sec_chip = tokens_per_step * n_steps / dt / n_chips
+    trials = []
+    for _ in range(N_TRIALS):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            params, opt_state, out = step(params, opt_state, toks)
+        float(out["loss"])
+        dt = time.perf_counter() - t0
+        trials.append(tokens_per_step * n_steps / dt / n_chips)
+    tok_per_sec_chip, spread = _median_spread(trials)
     flops_per_step = 6.0 * n_params * tokens_per_step
-    flops_per_sec_chip = flops_per_step * n_steps / dt / n_chips
-    return tok_per_sec_chip, flops_per_sec_chip, flops_per_step, n_params
+    flops_per_sec_chip = tok_per_sec_chip * 6.0 * n_params
+    return tok_per_sec_chip, spread, flops_per_sec_chip, flops_per_step, \
+        n_params
 
 
 def main() -> None:
@@ -148,7 +170,7 @@ def main() -> None:
     n_chips = jax.device_count()
     chip, peak_tflops = _chip_info(jax)
 
-    ips_per_chip, resnet_flops_step = bench_resnet(jax, jnp, n_chips)
+    ips_per_chip, spread, resnet_flops_step = bench_resnet(jax, jnp, n_chips)
     resnet_mfu = (ips_per_chip * RESNET50_TRAIN_FLOPS_PER_IMAGE
                   / (peak_tflops * 1e12)) if peak_tflops else None
 
@@ -159,6 +181,8 @@ def main() -> None:
         "vs_baseline": 1.0,
         "chip": chip,
         "n_chips": n_chips,
+        "batch": RESNET_BATCH,
+        "spread": spread,
         "peak_tflops_bf16": peak_tflops,
         "model_flops_per_step": resnet_flops_step,
         "mfu": round(resnet_mfu, 4) if resnet_mfu is not None else None,
@@ -169,10 +193,11 @@ def main() -> None:
         result["vs_baseline"] = round(ips_per_chip / anchor, 3)
 
     try:
-        tok_s, flops_s, llama_flops_step, n_params = bench_llama(
-            jax, jnp, n_chips)
+        tok_s, llama_spread, flops_s, llama_flops_step, n_params = \
+            bench_llama(jax, jnp, n_chips)
         result.update({
             "llama_train_tokens_per_sec_per_chip": round(tok_s, 1),
+            "llama_spread": llama_spread,
             "llama_params": n_params,
             "llama_model_flops_per_step": llama_flops_step,
             "llama_mfu": (round(flops_s / (peak_tflops * 1e12), 4)
