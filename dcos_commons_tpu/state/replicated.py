@@ -800,6 +800,10 @@ def main(argv=None) -> int:  # pragma: no cover - thin daemon wrapper
     if args.tls_cert and args.tls_key:
         from ..security.transport import server_context_from_files
         tls = server_context_from_files(args.tls_cert, args.tls_key)
+    elif args.tls_cert or args.tls_key:
+        # same policy as transport.server_tls_from_env: a half-set pair
+        # must refuse to boot, never silently serve cleartext
+        p.error("--tls-cert and --tls-key must be given together")
     elif args.host not in ("127.0.0.1", "::1", "localhost"):
         print("WARNING: non-loopback state replica without --tls-cert/"
               "--tls-key speaks cleartext; the ensemble secret and all "

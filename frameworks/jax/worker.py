@@ -22,7 +22,6 @@ import argparse
 import json
 import logging
 import os
-import pickle
 import sys
 import time
 from typing import Optional
@@ -32,44 +31,34 @@ log = logging.getLogger("jax.worker")
 
 # ---------------------------------------------------------------- checkpoints
 
-def save_checkpoint(out_dir: str, step: int, params, process_id: int = 0,
+def save_checkpoint(out_dir: str, step: int, params,
                     keep: int = 3) -> Optional[str]:
-    """Orbax-style step checkpoints (write-temp+rename for atomicity, prune
-    old steps). Control-plane state lives in the scheduler's state store;
-    model state lives here, on the task's persistent volume (SURVEY.md §5
-    checkpoint/resume split). Pass process_id to restrict writing to rank 0
-    where per-host volumes aren't desired; dp gangs write on every host so
-    resume step counts stay lock-step."""
-    if process_id != 0:
-        return None
+    """Step checkpoints on the sharded engine (``parallel/checkpoint.py``:
+    per-shard files + manifest, write-tmp+rename atomicity, pruning).
+    Control-plane state lives in the scheduler's state store; model state
+    lives here, on the task's persistent volume (SURVEY.md §5
+    checkpoint/resume split). EVERY process writes its own shards to its
+    own volume — dp gangs stay lock-step on resume, tp/pp shards never
+    congregate on one host."""
+    from dcos_commons_tpu.parallel import checkpoint as ckpt
     os.makedirs(out_dir, exist_ok=True)
-    import jax
-    host_params = jax.device_get(params)
-    tmp = os.path.join(out_dir, f".tmp-step-{step}")
-    with open(tmp, "wb") as f:
-        pickle.dump({"step": step, "params": host_params}, f)
-    final = os.path.join(out_dir, f"step-{step}.ckpt")
-    os.replace(tmp, final)
-    ckpts = sorted(
-        (f for f in os.listdir(out_dir) if f.endswith(".ckpt")),
-        key=lambda f: int(f[5:-5]))
-    for old in ckpts[:-keep]:
-        os.remove(os.path.join(out_dir, old))
-    return final
+    return ckpt.save_sharded(out_dir, step, {"params": params}, keep=keep)
 
 
-def latest_checkpoint(out_dir: str) -> Optional[dict]:
-    """Resume support: a replaced/restarted pod picks up where it left off."""
-    try:
-        ckpts = sorted(
-            (f for f in os.listdir(out_dir) if f.endswith(".ckpt")),
-            key=lambda f: int(f[5:-5]))
-    except OSError:
+def latest_checkpoint(out_dir: str, template) -> Optional[dict]:
+    """Resume support: a replaced/restarted pod picks up where it left off.
+
+    ``template`` is the freshly-initialized (already sharded) params tree —
+    it supplies structure/shapes/shardings; values come bitwise from disk.
+    Returns ``{"step", "params"}`` or None when no complete checkpoint
+    exists.
+    """
+    from dcos_commons_tpu.parallel import checkpoint as ckpt
+    step = ckpt.latest_step(out_dir)
+    if step is None:
         return None
-    if not ckpts:
-        return None
-    with open(os.path.join(out_dir, ckpts[-1]), "rb") as f:
-        return pickle.load(f)
+    tree = ckpt.restore_sharded(out_dir, {"params": template}, step=step)
+    return {"step": step, "params": tree["params"]}
 
 
 def _emit(record: dict) -> None:
@@ -97,7 +86,7 @@ def run_mnist(args) -> dict:
         lambda p, b: mlp.loss_fn(cfg, p, b), opt)
     opt_state = opt.init(params)
 
-    resumed = latest_checkpoint(args.out) if args.out else None
+    resumed = latest_checkpoint(args.out, params) if args.out else None
     start = 0
     if resumed:
         params, start = resumed["params"], resumed["step"]
@@ -114,8 +103,7 @@ def run_mnist(args) -> dict:
         params, opt_state, out = step_fn(params, opt_state, (x, y))
         loss = out["loss"]
         if args.out and (step + 1) % max(1, args.steps // 4) == 0:
-            save_checkpoint(args.out, step + 1, params,
-                            contract["process_id"])
+            save_checkpoint(args.out, step + 1, params)
     loss = float(jax.block_until_ready(loss)) if loss is not None else 0.0
     dt = time.perf_counter() - t0
     steps_run = max(args.steps - start, 1)
@@ -123,7 +111,7 @@ def run_mnist(args) -> dict:
               "examples_per_sec": round(batch * steps_run / dt, 1),
               "process_id": contract["process_id"]}
     if args.out:
-        save_checkpoint(args.out, args.steps, params, contract["process_id"])
+        save_checkpoint(args.out, args.steps, params)
     return result
 
 
@@ -150,13 +138,17 @@ def run_resnet(args) -> dict:
     cfg = resnet.ResNetConfig(depth=depth, n_classes=1000)
     with mesh:
         params, state = resnet.init_params(cfg, jax.random.key(0))
+        # dp: params replicate over the mesh. Commit that sharding up front
+        # so a restored checkpoint (which adopts the template's sharding)
+        # is mesh-replicated too, not pinned to one device.
+        params = jax.device_put(params, NamedSharding(mesh, P()))
         # Gang re-form resumes, not restarts. EVERY process checkpoints to
         # its own volume (not just rank 0): params are identical across the
         # dp gang, and per-host checkpoints keep resume step counts in sync
         # — a rank-0-only checkpoint would desync the lock-step collective
         # loop after a restart.
         start_step = 0
-        resumed = latest_checkpoint(args.out) if args.out else None
+        resumed = latest_checkpoint(args.out, params) if args.out else None
         if resumed:
             params, start_step = resumed["params"], resumed["step"]
             _emit({"event": "resumed", "step": start_step})

@@ -155,21 +155,61 @@ inline HttpResponse http_request(const std::string& method,
     throw std::runtime_error("send failed");
   }
 
+  // Read headers + exactly Content-Length body bytes. The length lives
+  // INSIDE the TLS stream, so a torn connection (no close_notify, e.g. a
+  // truncation attack or mid-body crash) is detected as an incomplete
+  // body and must fail — it must never parse as a short-but-valid
+  // response. (Stacks like Python's ssl close without close_notify, so
+  // EOF after a complete body is accepted.)
   std::string raw;
   char buf[8192];
+  size_t header_end = std::string::npos;
+  size_t body_need = std::string::npos;  // npos = read until close
+  bool torn = false;
   while (true) {
     long n = tls_conn != nullptr
                  ? tls_conn->read(buf, sizeof buf)
                  : static_cast<long>(recv(fd, buf, sizeof buf, 0));
-    if (n <= 0) break;
+    if (n <= 0) {
+      torn = n < 0 && tls_conn != nullptr;
+      break;
+    }
     raw.append(buf, static_cast<size_t>(n));
+    if (header_end == std::string::npos) {
+      header_end = raw.find("\r\n\r\n");
+      if (header_end != std::string::npos) {
+        // case-insensitive Content-Length scan within the header block
+        std::string headers = raw.substr(0, header_end);
+        for (auto& c : headers) c = static_cast<char>(tolower(c));
+        size_t cl = headers.find("content-length:");
+        if (cl != std::string::npos) {
+          body_need = std::strtoul(headers.c_str() + cl + 15, nullptr, 10);
+        }
+      }
+    }
+    if (header_end != std::string::npos && body_need != std::string::npos &&
+        raw.size() - (header_end + 4) >= body_need) {
+      break;  // complete response; don't wait for close
+    }
   }
   tls_conn.reset();  // close_notify before the socket goes away
   close(fd);
 
-  size_t header_end = raw.find("\r\n\r\n");
   if (header_end == std::string::npos) {
-    throw std::runtime_error("malformed HTTP response");
+    header_end = raw.find("\r\n\r\n");
+  }
+  if (header_end == std::string::npos) {
+    throw std::runtime_error(torn ? "TLS read error (connection truncated)"
+                                  : "malformed HTTP response");
+  }
+  size_t body_have = raw.size() - (header_end + 4);
+  if (body_need != std::string::npos && body_have < body_need) {
+    throw std::runtime_error("truncated HTTP response body (" +
+                             std::to_string(body_have) + "/" +
+                             std::to_string(body_need) + " bytes)");
+  }
+  if (torn && body_need == std::string::npos) {
+    throw std::runtime_error("TLS read error (connection truncated)");
   }
   HttpResponse out;
   size_t sp = raw.find(' ');
@@ -177,6 +217,9 @@ inline HttpResponse http_request(const std::string& method,
     out.status = std::stoi(raw.substr(sp + 1, 3));
   }
   out.body = raw.substr(header_end + 4);
+  if (body_need != std::string::npos) {
+    out.body.resize(body_need);
+  }
   return out;
 }
 

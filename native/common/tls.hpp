@@ -37,8 +37,7 @@ constexpr long kSslCtrlSetMinProtoVersion = 123;
 constexpr long kTls12Version = 0x0303;
 constexpr long kSslCtrlSetTlsextHostname = 55;
 constexpr int kTlsextNametypeHostName = 0;
-constexpr int kSslErrorWantRead = 2;
-constexpr int kSslErrorWantWrite = 3;
+constexpr int kSslErrorZeroReturn = 6;  // clean close_notify
 
 struct Api {
   SslMethod* (*TLS_client_method)();
@@ -200,13 +199,14 @@ class Conn {
     cleanup();
   }
 
-  // recv(2) semantics: >0 bytes, 0 on orderly close, <0 on error
+  // >0 bytes; 0 ONLY on a clean close_notify; <0 on any error — including
+  // a transport EOF without close_notify, which is how a truncation attack
+  // (or a mid-body crash) looks and must NOT parse as a complete response
   long read(char* buf, size_t len) {
     int n = api_.SSL_read(ssl_, buf, static_cast<int>(len));
     if (n > 0) return n;
     int err = api_.SSL_get_error(ssl_, n);
-    // close_notify or transport EOF both end the response body
-    return (err == kSslErrorWantRead || err == kSslErrorWantWrite) ? -1 : 0;
+    return err == kSslErrorZeroReturn ? 0 : -1;
   }
 
   long write(const char* buf, size_t len) {

@@ -172,3 +172,60 @@ class TestKillAndResume:
                                       step=resumed[0]["step"])["params"]
         if resumed[0]["step"] == resume_step:
             _assert_tree_bitwise(reread, saved)
+
+
+class TestResnetDpKillAndResume:
+    """Same kill/resume contract for the dp ResNet worker, now that the
+    flagship workers run on the sharded engine (no rank-0 pickle path):
+    SIGKILL mid-run, relaunch, resume from the last committed step with
+    bitwise-identical params read back from the per-process shard files."""
+
+    def test_resnet_worker_resumes_bitwise_after_kill(self, tmp_path):
+        from dcos_commons_tpu.models import resnet
+
+        out = str(tmp_path / "ckpt")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8")
+        cmd = [sys.executable, "-m", "frameworks.jax.worker",
+               "resnet", "--steps", "12", "--batch", "8",
+               "--depth", "18", "--out", out]
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.Popen(cmd, cwd=repo, env=env,
+                                stdout=subprocess.PIPE, text=True)
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            latest = ckpt.latest_step(out)
+            if latest is not None and latest >= 3:
+                break
+            time.sleep(0.25)
+        else:
+            proc.kill()
+            raise AssertionError("no checkpoint appeared before timeout")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+
+        resume_step = ckpt.latest_step(out)
+        assert resume_step is not None and resume_step >= 3
+        cfg = resnet.ResNetConfig(depth=18, n_classes=1000)
+        template, _ = resnet.init_params(cfg, jax.random.key(5))
+        saved = ckpt.restore_sharded(out, {"params": template},
+                                     step=resume_step)["params"]
+
+        # +2 keeps the resume step inside the keep=3 prune window so the
+        # final bitwise re-read can still see it
+        run2 = subprocess.run(
+            [sys.executable, "-m", "frameworks.jax.worker",
+             "resnet", "--steps", str(resume_step + 2), "--batch", "8",
+             "--depth", "18", "--out", out],
+            cwd=repo, env=env, capture_output=True, text=True, timeout=300)
+        assert run2.returncode == 0, run2.stdout + run2.stderr
+        events = [json.loads(l) for l in run2.stdout.splitlines()
+                  if l.startswith("{")]
+        resumed = [e for e in events if e.get("event") == "resumed"]
+        assert resumed and resumed[0]["step"] >= resume_step, events
+
+        template2, _ = resnet.init_params(cfg, jax.random.key(6))
+        reread = ckpt.restore_sharded(out, {"params": template2},
+                                      step=resumed[0]["step"])["params"]
+        if resumed[0]["step"] == resume_step:
+            _assert_tree_bitwise(reread, saved)

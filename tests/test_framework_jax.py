@@ -17,11 +17,9 @@ import dataclasses
 
 import pytest
 
-from dcos_commons_tpu.plan import Status
 from dcos_commons_tpu.state import TaskState
 from dcos_commons_tpu.testing import Expect, Send, ServiceTestRunner
-from dcos_commons_tpu.testing.simulation import (default_agents,
-                                                 tpu_slice_agents)
+from dcos_commons_tpu.testing.simulation import tpu_slice_agents
 
 from frameworks.jax import scenarios, worker
 
@@ -176,7 +174,11 @@ class TestWorkerWorkloads:
         out = str(tmp_path / "ckpt")
         rc = worker.main(["mnist", "--steps", "4", "--out", out])
         assert rc == 0
-        resumed = worker.latest_checkpoint(out)
+        import jax
+        from dcos_commons_tpu.models import mlp
+        cfg = mlp.MLPConfig(in_dim=784, hidden=(512, 256), n_classes=10)
+        template = mlp.init_params(cfg, jax.random.key(7))
+        resumed = worker.latest_checkpoint(out, template)
         assert resumed is not None and resumed["step"] == 4
 
     def test_mnist_resumes_from_checkpoint(self, tmp_path, capsys):
