@@ -121,23 +121,22 @@ def bench_resnet(jax, jnp, n_chips):
     return median, spread, RESNET50_TRAIN_FLOPS_PER_IMAGE * batch
 
 
-def bench_llama(jax, jnp, n_chips):
-    """Flagship llama train step, ~0.4B params bf16 (fits one chip with
-    Adam state; larger presets shard over the mesh in production)."""
+def _llama_step_rate(jax, n_chips, batch, seq, remat, remat_policy,
+                     n_steps=10):
+    """Median tokens/sec/chip for one llama train config, with spread."""
     from dcos_commons_tpu.models import llama, train
 
-    # batch 16 x seq 512 is the sweet spot measured on v5e (53.8% MFU);
-    # larger shapes trip the tunneled backend's compile-helper subprocess
-    # (HTTP 500), not HBM — see docs/performance.md
+    # attn_impl="auto" = the production default: the pallas flash kernel on
+    # unsharded TPU (dense measures within noise at these shapes — the
+    # full-model A/B is in docs/performance.md)
     cfg = llama.LlamaConfig(vocab_size=32000, dim=1536, n_layers=8,
                             n_heads=12, n_kv_heads=6, ffn_dim=4096,
-                            max_seq=512, remat=False, attn_impl="dense")
+                            max_seq=seq, remat=remat,
+                            remat_policy=remat_policy, attn_impl="auto")
     params = llama.init_params(cfg, jax.random.key(0))
     n_params = sum(x.size for x in jax.tree.leaves(params))
-    batch, seq = 16, 512
     toks = jax.random.randint(jax.random.key(1), (batch, seq), 0,
                               cfg.vocab_size)
-
     opt = train.make_optimizer(lr=3e-4, warmup=10, decay_steps=1000)
     step = train.make_train_step(
         lambda p, b: llama.loss_fn(cfg, p, b), opt)
@@ -146,7 +145,6 @@ def bench_llama(jax, jnp, n_chips):
     params, opt_state, out = step(params, opt_state, toks)
     float(out["loss"])
 
-    n_steps = 10
     tokens_per_step = batch * (seq - 1)  # next-token loss consumes S-1
     trials = []
     for _ in range(N_TRIALS):
@@ -157,10 +155,41 @@ def bench_llama(jax, jnp, n_chips):
         dt = time.perf_counter() - t0
         trials.append(tokens_per_step * n_steps / dt / n_chips)
     tok_per_sec_chip, spread = _median_spread(trials)
+    return tok_per_sec_chip, spread, n_params, tokens_per_step
+
+
+def bench_llama(jax, jnp, n_chips):
+    """Flagship llama train step, ~0.3B params bf16 (fits one chip with
+    Adam state; larger presets shard over the mesh in production).
+
+    Two shapes: batch 16 x seq 512 (the measured single-chip throughput
+    optimum, no remat) and batch 16 x seq 1024 (selective remat —
+    ``dots_with_no_batch_dims_saveable`` — which is what unblocks the
+    tunneled backend's compile-helper at this shape; the long-context
+    proof point the flash kernel is in the path for)."""
+    tok_s, spread, n_params, tokens_per_step = _llama_step_rate(
+        jax, n_chips, batch=16, seq=512, remat=False, remat_policy=None)
     flops_per_step = 6.0 * n_params * tokens_per_step
-    flops_per_sec_chip = tok_per_sec_chip * 6.0 * n_params
-    return tok_per_sec_chip, spread, flops_per_sec_chip, flops_per_step, \
-        n_params
+    flops_per_sec_chip = tok_s * 6.0 * n_params
+    out = {
+        "llama_train_tokens_per_sec_per_chip": round(tok_s, 1),
+        "llama_spread": spread,
+        "llama_params": n_params,
+        "llama_model_flops_per_step": flops_per_step,
+        "llama_flops_per_sec_chip": flops_per_sec_chip,
+    }
+    try:
+        tok_1k, spread_1k, _, _ = _llama_step_rate(
+            jax, n_chips, batch=16, seq=1024, remat=True,
+            remat_policy="dots_with_no_batch_dims_saveable")
+        out.update({
+            "llama_seq1024_tokens_per_sec_per_chip": round(tok_1k, 1),
+            "llama_seq1024_spread": spread_1k,
+            "llama_seq1024_flops_per_sec_chip": tok_1k * 6.0 * n_params,
+        })
+    except Exception as e:  # long-seq is supplementary to the supplement
+        out["llama_seq1024_error"] = str(e)[:200]
+    return out
 
 
 def main() -> None:
@@ -193,16 +222,15 @@ def main() -> None:
         result["vs_baseline"] = round(ips_per_chip / anchor, 3)
 
     try:
-        tok_s, llama_spread, flops_s, llama_flops_step, n_params = \
-            bench_llama(jax, jnp, n_chips)
-        result.update({
-            "llama_train_tokens_per_sec_per_chip": round(tok_s, 1),
-            "llama_spread": llama_spread,
-            "llama_params": n_params,
-            "llama_model_flops_per_step": llama_flops_step,
-            "llama_mfu": (round(flops_s / (peak_tflops * 1e12), 4)
-                          if peak_tflops else None),
-        })
+        llama_out = bench_llama(jax, jnp, n_chips)
+        peak = peak_tflops * 1e12 if peak_tflops else None
+        fps = llama_out.pop("llama_flops_per_sec_chip")
+        llama_out["llama_mfu"] = round(fps / peak, 4) if peak else None
+        fps_1k = llama_out.pop("llama_seq1024_flops_per_sec_chip", None)
+        if fps_1k is not None:
+            llama_out["llama_seq1024_mfu"] = (round(fps_1k / peak, 4)
+                                              if peak else None)
+        result.update(llama_out)
     except Exception as e:  # llama is supplementary; never lose the line
         result["llama_error"] = str(e)[:200]
 

@@ -282,7 +282,13 @@ class ApiServer:
             def do_DELETE(self):
                 self._handle("DELETE")
 
-        self._server = ThreadingHTTPServer((host, port), RequestHandler)
+        # stock backlog is 5: a fleet of agents (re)registering in a burst
+        # (scheduler failover, coordinated restart) overflows it and gets
+        # connection resets — size for hundreds of concurrent pollers
+        class _Server(ThreadingHTTPServer):
+            request_queue_size = 256
+
+        self._server = _Server((host, port), RequestHandler)
         if self._tls is not None:
             from ..security.transport import wrap_server
             wrap_server(self._server, self._tls)

@@ -51,6 +51,11 @@ class LlamaConfig:
     attn_impl: str = "auto"         # auto | dense | flash | ring | ulysses
     dtype: Any = jnp.bfloat16
     remat: bool = True              # jax.checkpoint each layer (training)
+    # selective-checkpoint policy name from jax.checkpoint_policies
+    # (e.g. "dots_with_no_batch_dims_saveable": save matmul outputs,
+    # recompute only cheap elementwise ops — most of full remat's memory
+    # relief at a fraction of its recompute FLOPs); None = save nothing
+    remat_policy: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -217,6 +222,17 @@ def apply_layer(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
     return _constrain(x, mesh, "dp", "sp", None)
 
 
+def _maybe_checkpoint(fn, cfg: LlamaConfig):
+    """Per-layer rematerialization: full (save nothing) or selective via a
+    named ``jax.checkpoint_policies`` policy (``cfg.remat_policy``)."""
+    if not cfg.remat:
+        return fn
+    if cfg.remat_policy:
+        policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+        return jax.checkpoint(fn, policy=policy)
+    return jax.checkpoint(fn)
+
+
 def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
             mesh: Optional[Mesh] = None) -> jnp.ndarray:
     """tokens [B, S] int32 -> logits [B, S, V] fp32."""
@@ -229,7 +245,7 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     def layer(x, lp):
         return apply_layer(cfg, x, lp, rope, attn_fn, mesh), None
 
-    body = jax.checkpoint(layer) if cfg.remat else layer
+    body = _maybe_checkpoint(layer, cfg)
     x, _ = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
@@ -278,7 +294,7 @@ def forward_pipelined(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     def stage_fn(stage_layers, x_mb):
         def body(x_, lp):
             return apply_layer(cfg, x_, lp, rope, attn_fn), None
-        out, _ = lax.scan(jax.checkpoint(body) if cfg.remat else body,
+        out, _ = lax.scan(_maybe_checkpoint(body, cfg),
                           x_mb, stage_layers)
         return out
 
@@ -358,7 +374,7 @@ def forward_moe(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
         return (x, aux_sum + aux.astype(jnp.float32)), None
 
     (x, aux_sum), _ = lax.scan(
-        jax.checkpoint(layer) if cfg.remat else layer,
+        _maybe_checkpoint(layer, cfg),
         (x, jnp.float32(0.0)), params["layers"])
     x = rms_norm(x, params["norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)

@@ -196,9 +196,6 @@ inline HttpResponse http_request(const std::string& method,
   close(fd);
 
   if (header_end == std::string::npos) {
-    header_end = raw.find("\r\n\r\n");
-  }
-  if (header_end == std::string::npos) {
     throw std::runtime_error(torn ? "TLS read error (connection truncated)"
                                   : "malformed HTTP response");
   }

@@ -12,10 +12,15 @@ echo "== static analysis =="
 python -m tools.static_check
 
 echo "== test suite =="
-python -m pytest tests/ -q "$@"
+python -m pytest tests/ -q -m "not soak" "$@"
 
 echo "== framework integration suites =="
 python -m pytest frameworks/ -q "$@"
+
+if [[ "${TPU_SOAK:-}" == "1" ]]; then
+    echo "== soak/churn tier =="
+    python -m pytest tests/test_soak.py -m soak -q
+fi
 
 echo "== airgap lint =="
 python -m tools.airgap_linter frameworks/*/

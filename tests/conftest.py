@@ -20,3 +20,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "scale: mass-install scale tier (reference tests/scale marks)")
+    config.addinivalue_line(
+        "markers",
+        "soak: opt-in churn tier (TPU_SOAK=1; reference tier-4 soak marks)")

@@ -1,0 +1,181 @@
+"""Soak/churn tier (reference tier-4 intent:
+``frameworks/helloworld/tests/scale/test_scale.py:16-35``).
+
+~50 compressed churn cycles — task kills, pod replaces, pod restarts,
+rolling config updates — against a multi-service scheduler running on the
+replicated (quorum) state backend, with one state replica killed mid-run.
+After every cycle the invariants that long-lived clusters actually lose
+are re-checked:
+
+* no leaked reservations: the ledger's pod set equals the live task pod
+  set for every service;
+* stable JAX ranks: a TPU gang's pod->process_id map is unchanged by any
+  number of re-forms (SURVEY.md §7 hard part (4));
+* quorum intact: the ensemble keeps accepting writes on 2/3 replicas,
+  and a fresh standby persister syncs the full state at the end.
+
+Opt-in (slow tier): ``TPU_SOAK=1 ./test.sh`` or
+``TPU_SOAK=1 pytest -m soak tests/test_soak.py``.
+"""
+
+import os
+import random
+
+import pytest
+
+from dcos_commons_tpu.agent import FakeCluster
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler.multi import MultiServiceScheduler
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import StateReplicaServer, ReplicatedPersister
+from dcos_commons_tpu.state.tasks import TaskState
+from dcos_commons_tpu.testing.simulation import (default_agents,
+                                                 tpu_slice_agents)
+
+pytestmark = [
+    pytest.mark.soak,
+    pytest.mark.skipif(not os.environ.get("TPU_SOAK"),
+                       reason="soak tier is opt-in: set TPU_SOAK=1"),
+]
+
+WEB_YML = """
+name: web
+pods:
+  front:
+    count: 4
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "sleep 1000"
+        cpus: 0.2
+        memory: 64
+        env: {{REV: "{rev}"}}
+"""
+
+GANG_YML = """
+name: gang
+pods:
+  worker:
+    count: 4
+    tpu: {chips: 4, topology: v4-16}
+    resource-sets:
+      wres: {cpus: 1, memory: 256, tpus: 4}
+    tasks:
+      train: {goal: RUNNING, cmd: train, resource-set: wres}
+"""
+
+CYCLES = 50
+MAX_DRIVE = 400
+
+
+def drive_converged(multi) -> None:
+    """Cycle until every mounted service's deploy AND recovery plans are
+    quiet (recovery plans prune to empty when nothing is failing)."""
+    for _ in range(MAX_DRIVE):
+        multi.run_cycle()
+        settled = True
+        for name in multi.service_names():
+            svc = multi.get_service(name)
+            if svc is None:
+                continue
+            deploy = svc.plan("deploy")
+            if deploy is not None and deploy.status is not Status.COMPLETE:
+                settled = False
+            recovery = svc.plan("recovery")
+            if recovery is not None \
+                    and recovery.status not in (Status.COMPLETE,):
+                settled = False
+        if settled:
+            return
+    raise AssertionError("cluster did not re-converge within "
+                         f"{MAX_DRIVE} cycles")
+
+
+def assert_no_leaked_reservations(multi) -> None:
+    for name in multi.service_names():
+        svc = multi.get_service(name)
+        ledger_pods = {r.pod_instance_name for r in svc.ledger.all()}
+        task_pods = {t.pod_instance_name for t in svc.state.fetch_tasks()}
+        assert ledger_pods == task_pods, (
+            f"service {name}: reservation/task drift "
+            f"(ledger-only={ledger_pods - task_pods}, "
+            f"task-only={task_pods - ledger_pods})")
+
+
+def gang_rank_map(multi) -> dict:
+    svc = multi.get_service("gang")
+    out = {}
+    for t in svc.state.fetch_tasks():
+        assert t.tpu is not None, t.task_name
+        out[t.pod_instance_name] = t.tpu.process_id
+    return out
+
+
+class TestSoakChurn:
+    def test_fifty_churn_cycles_on_replicated_backend(self, tmp_path):
+        rng = random.Random(42)
+        replicas = [StateReplicaServer(str(tmp_path / f"r{i}"), port=0,
+                                       secret="soak")
+                    for i in range(3)]
+        for r in replicas:
+            r.start()
+        endpoints = [f"http://127.0.0.1:{r.port}" for r in replicas]
+        persister = ReplicatedPersister(endpoints, secret="soak")
+
+        cluster = FakeCluster(default_agents(6) + tpu_slice_agents(4))
+        multi = MultiServiceScheduler(persister, cluster)
+        rev = 0
+        multi.add_service(load_service_yaml_str(WEB_YML.format(rev=rev)))
+        multi.add_service(load_service_yaml_str(GANG_YML))
+        drive_converged(multi)
+        assert_no_leaked_reservations(multi)
+        ranks0 = gang_rank_map(multi)
+        assert sorted(ranks0.values()) == [0, 1, 2, 3]
+
+        killed_replica = False
+        ops_run = {"kill": 0, "replace": 0, "restart": 0, "update": 0}
+        for cycle in range(CYCLES):
+            if cycle == CYCLES // 2:
+                # lose one ensemble member mid-churn: quorum (2/3) must
+                # carry every subsequent write
+                replicas[0].stop()
+                killed_replica = True
+            op = ("kill", "replace", "restart", "update")[cycle % 4]
+            ops_run[op] += 1
+            if op == "kill":
+                svc = multi.get_service("web")
+                task = rng.choice(svc.state.fetch_tasks())
+                cluster.send_status(task.task_id, TaskState.FAILED,
+                                    "soak kill")
+            elif op == "replace":
+                svc = multi.get_service("gang")
+                pod = f"worker-{rng.randrange(4)}"
+                svc.replace_pod(pod)
+            elif op == "restart":
+                svc = multi.get_service("web")
+                svc.restart_pod(f"front-{rng.randrange(4)}")
+            elif op == "update":
+                rev += 1
+                multi.add_service(
+                    load_service_yaml_str(WEB_YML.format(rev=rev)))
+            drive_converged(multi)
+            assert_no_leaked_reservations(multi)
+            # gang ranks survive every re-form bit-for-bit
+            assert gang_rank_map(multi) == ranks0, f"cycle {cycle} ({op})"
+            # quorum still accepts writes
+            persister.set("soak/probe", str(cycle).encode())
+
+        assert killed_replica
+        assert all(n > 0 for n in ops_run.values()), ops_run
+        # the rolled config actually deployed (not just accepted)
+        web = multi.get_service("web")
+        live_envs = {t.env.get("REV") for t in web.state.fetch_tasks()}
+        assert live_envs == {str(rev)}, live_envs
+
+        # a fresh standby (new client, same ensemble) syncs everything the
+        # survivors hold — the scheduler-failover property
+        standby = ReplicatedPersister(endpoints, secret="soak")
+        assert standby.get("soak/probe") == str(CYCLES - 1).encode()
+
+        for r in replicas[1:]:
+            r.stop()

@@ -1,11 +1,19 @@
 """Control-plane benchmark: deploy-plan time-to-COMPLETE.
 
 BASELINE.md's second north-star metric: the deploy plan should be
-agent-bound, not scheduler-bound (SURVEY.md §7 hard part (5)). This tool
-measures the scheduler side in isolation — N pod instances matched,
-reserved, WAL'd, and launched over an in-process fake cluster whose
-agents accept instantly — so the number is pure control-plane throughput:
-evaluator stages, plan-engine candidate selection, state-store writes.
+agent-bound, not scheduler-bound (SURVEY.md §7 hard part (5)). Two modes:
+
+* default: the scheduler side in isolation — N pod instances matched,
+  reserved, WAL'd, and launched over an in-process fake cluster whose
+  agents accept instantly — pure control-plane throughput: evaluator
+  stages, plan-engine candidate selection, state-store writes.
+* ``--live``: the whole HTTP stack under load — N agents speaking the
+  REAL agent wire protocol (register + poll with statuses, the same
+  JSON bodies the C++ agent sends) at the real 1 Hz cadence against a
+  live :class:`ApiServer`, while the deploy runs through a real
+  :class:`CycleDriver`. Records deploy time-to-COMPLETE and poll-latency
+  percentiles, proving deploys are agent-poll-bound, not
+  server-stack-bound (reference deploy SLO ``testing/sdk_plan.py:17``).
 
 Prints one JSON line::
 
@@ -15,13 +23,178 @@ Prints one JSON line::
 Usage::
 
     python -m tools.bench_scheduler [--pods 100] [--tpu]
+    python -m tools.bench_scheduler --live [--pods 500] [--agents 200]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import threading
 import time
+import urllib.request
+
+
+class ProtocolAgent(threading.Thread):
+    """One fake agent speaking the real wire protocol over real HTTP.
+
+    Registers, then polls at ``interval`` seconds; every launch command is
+    acknowledged with a RUNNING status on the NEXT poll (an instant-accept
+    agent, so the measured deploy latency is the protocol's, not a
+    workload's). Poll round-trip latencies are appended to ``latencies``.
+    """
+
+    def __init__(self, base_url: str, agent_id: str, interval: float,
+                 latencies: list, stop: threading.Event):
+        super().__init__(name=f"agent-{agent_id}", daemon=True)
+        self.base = base_url
+        self.agent_id = agent_id
+        self.interval = interval
+        self.latencies = latencies
+        self.stop_event = stop
+        self.running: dict = {}     # task_id -> task_name
+        self.pending: list = []     # statuses for the next poll
+
+    def _post(self, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            f"{self.base}{path}", method="POST",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        last: Exception = RuntimeError("unreachable")
+        for attempt in range(3):  # the C++ agent retries transient errors
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return json.loads(r.read().decode())
+            except OSError as e:
+                last = e
+                time.sleep(0.05 * (attempt + 1))
+        raise last
+
+    def run(self) -> None:
+        self._post("/v1/agents/register", {
+            "agent_id": self.agent_id, "hostname": f"h-{self.agent_id}",
+            "cpus": 64, "memory_mb": 262144, "disk_mb": 1 << 20,
+            "ports": [[1025, 32000]],
+        })
+        while not self.stop_event.is_set():
+            t0 = time.perf_counter()
+            try:
+                reply = self._post(f"/v1/agents/{self.agent_id}/poll", {
+                    "running_task_ids": list(self.running),
+                    "statuses": self.pending,
+                })
+            except OSError:
+                if self.stop_event.is_set():
+                    return  # server shut down first; clean exit
+                raise
+            self.latencies.append(time.perf_counter() - t0)
+            self.pending = []
+            if reply.get("reregister"):
+                # expired between polls (RemoteCluster expiry): re-register
+                # and resend pending statuses next poll, like the C++ agent
+                self._post("/v1/agents/register", {
+                    "agent_id": self.agent_id,
+                    "hostname": f"h-{self.agent_id}",
+                    "cpus": 64, "memory_mb": 262144, "disk_mb": 1 << 20,
+                    "ports": [[1025, 32000]],
+                })
+                continue
+            for cmd in reply.get("commands", []):
+                if cmd.get("type") == "launch":
+                    for t in cmd.get("tasks", []):
+                        self.running[t["task_id"]] = t["task_name"]
+                        self.pending.append({
+                            "task_id": t["task_id"],
+                            "task_name": t["task_name"],
+                            "state": "TASK_RUNNING",
+                            "readiness_passed": True,
+                        })
+                elif cmd.get("type") == "kill":
+                    name = self.running.pop(cmd["task_id"], None)
+                    if name is not None:
+                        self.pending.append({
+                            "task_id": cmd["task_id"], "task_name": name,
+                            "state": "TASK_KILLED",
+                        })
+            self.stop_event.wait(self.interval)
+
+
+def run_live(pods: int, agents: int, poll_interval: float) -> int:
+    from dcos_commons_tpu.agent.remote import RemoteCluster
+    from dcos_commons_tpu.http import ApiServer
+    from dcos_commons_tpu.plan import Status
+    from dcos_commons_tpu.scheduler import ServiceScheduler
+    from dcos_commons_tpu.scheduler.runner import CycleDriver
+    from dcos_commons_tpu.specification import load_service_yaml_str
+    from dcos_commons_tpu.state import MemPersister
+
+    yml = f"""
+name: bench
+pods:
+  web:
+    count: {pods}
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: run
+        cpus: 0.1
+        memory: 32
+        ports:
+          http: {{port: 0}}
+plans:
+  deploy:
+    strategy: parallel
+    phases:
+      web-deploy:
+        pod: web
+        strategy: parallel
+"""
+    cluster = RemoteCluster(expiry_s=60.0, poll_interval_s=poll_interval)
+    sched = ServiceScheduler(load_service_yaml_str(yml, {}), MemPersister(),
+                             cluster)
+    server = ApiServer(sched, port=0, cluster=cluster)
+    server.start()
+    stop = threading.Event()
+    latencies: list = []
+    fleet = [ProtocolAgent(server.url, f"a{i}", poll_interval, latencies,
+                           stop) for i in range(agents)]
+    t_start = time.perf_counter()
+    for a in fleet:
+        a.start()
+    driver = CycleDriver(sched, interval_s=min(0.2, poll_interval))
+    deadline = time.time() + 15 * 60  # reference sdk_plan.py:17 SLO
+    try:
+        with driver:
+            while sched.plan("deploy").status is not Status.COMPLETE:
+                if time.time() > deadline:
+                    raise SystemExit(
+                        f"deploy missed the 15-min SLO: "
+                        f"{sched.plan('deploy').status}")
+                time.sleep(0.05)
+            dt = time.perf_counter() - t_start
+    finally:
+        stop.set()
+        for a in fleet:
+            a.join(timeout=5)
+        server.stop()
+    lat = sorted(latencies)
+
+    def pct(q: float) -> float:
+        return lat[min(len(lat) - 1, int(q * len(lat)))] if lat else 0.0
+
+    print(json.dumps({
+        "metric": "live_deploy_seconds",
+        "pods": pods,
+        "agents": agents,
+        "poll_interval_s": poll_interval,
+        "seconds": round(dt, 3),
+        "pods_per_sec": round(pods / dt, 1),
+        "polls": len(lat),
+        "poll_p50_ms": round(pct(0.50) * 1e3, 1),
+        "poll_p99_ms": round(pct(0.99) * 1e3, 1),
+        "poll_max_ms": round((lat[-1] if lat else 0) * 1e3, 1),
+    }))
+    return 0
 
 
 def main(argv=None) -> int:
@@ -29,7 +202,15 @@ def main(argv=None) -> int:
     p.add_argument("--pods", type=int, default=100)
     p.add_argument("--tpu", action="store_true",
                    help="gang-placed TPU pods instead of plain cpu pods")
+    p.add_argument("--live", action="store_true",
+                   help="drive the real ApiServer with protocol agents")
+    p.add_argument("--agents", type=int, default=200,
+                   help="protocol-agent count for --live")
+    p.add_argument("--poll-interval", type=float, default=1.0,
+                   help="agent poll cadence for --live (reference: 1 Hz)")
     args = p.parse_args(argv)
+    if args.live:
+        return run_live(args.pods, args.agents, args.poll_interval)
 
     from dcos_commons_tpu.agent.fake import FakeCluster
     from dcos_commons_tpu.agent.inventory import (AgentInfo, PortRange,
