@@ -32,6 +32,15 @@ class ResNetConfig:
     bn_momentum: float = 0.9
     bn_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # space-to-depth stem (MLPerf-style): fold 2x2 spatial blocks into
+    # channels so the 7x7/s2 stem over 3 channels becomes a numerically
+    # identical 4x4/s1 conv over 12 — 4x the contraction depth for the MXU
+    # on the one layer whose arithmetic intensity is worst. Weights stay in
+    # the canonical [7,7,3,w] layout (checkpoints interchangeable); the
+    # fold happens inside the jitted step. Measured on v5e: +0.4% at batch
+    # 256 with the fused BN — within noise, so off by default
+    # (docs/performance.md round-3 experiments).
+    stem_s2d: bool = False
 
     @property
     def stage_blocks(self) -> Tuple[int, ...]:
@@ -126,13 +135,36 @@ def _conv(x, w, stride=1):
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
+def _stem_s2d(x, w):
+    """The stem conv as space-to-depth: [B,H,W,3] x [7,7,3,C] -> the exact
+    SAME-padded 7x7/s2 result via a 4x4/s1 conv on 2x2-folded input.
+
+    SAME for k=7/s=2 pads (2, 3), so output i taps rows 2i-2..2i+4; in
+    2x2-block space that is blocks i-1..i+2 — a 4-block window, padding
+    (1, 2), with the kernel zero-padded to 8 rows/cols before folding.
+    """
+    b, h, w_, c = x.shape
+    xs = x.reshape(b, h // 2, 2, w_ // 2, 2, c)
+    xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w_ // 2, 4 * c)
+    w8 = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    cin, cout = w.shape[2], w.shape[3]
+    wf = w8.reshape(4, 2, 4, 2, cin, cout).transpose(0, 2, 1, 3, 4, 5)
+    wf = wf.reshape(4, 4, 4 * cin, cout)
+    return lax.conv_general_dilated(
+        xs, wf, window_strides=(1, 1), padding=((1, 2), (1, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
 def forward(cfg: ResNetConfig, params: Params, state: Params,
             x: jnp.ndarray, train: bool = True
             ) -> Tuple[jnp.ndarray, Params]:
     """x [B, H, W, 3] -> (logits [B, n_classes] fp32, new bn_state)."""
     x = x.astype(cfg.dtype)
     new_state: Params = {}
-    x = _conv(x, params["stem"]["conv"], stride=2)
+    if cfg.stem_s2d and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+        x = _stem_s2d(x, params["stem"]["conv"])
+    else:
+        x = _conv(x, params["stem"]["conv"], stride=2)
     x, st = _batch_norm(x, params["stem"]["bn"], state["stem"]["bn"], cfg,
                         train)
     new_state["stem"] = {"bn": st}

@@ -65,6 +65,29 @@ def test_resnet_train_step():
     assert np.isfinite(float(out["loss"]))
 
 
+def test_resnet_s2d_stem_is_exact():
+    """The space-to-depth stem is the SAME 7x7/s2 conv, re-tiled: fp32
+    outputs match to float tolerance, for both even input sizes and the
+    odd-size fallback path."""
+    import dataclasses
+    cfg = resnet.ResNetConfig(depth=18, n_classes=10, width=8,
+                              dtype=jnp.float32, stem_s2d=True)
+    cfg_off = dataclasses.replace(cfg, stem_s2d=False)
+    params, state = resnet.init_params(cfg, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 64, 64, 3))
+    w = params["stem"]["conv"]
+    direct = resnet._conv(x, w, stride=2)
+    folded = resnet._stem_s2d(x, w)
+    assert np.allclose(np.asarray(direct), np.asarray(folded), atol=1e-4)
+    l_on, _ = resnet.forward(cfg, params, state, x, train=False)
+    l_off, _ = resnet.forward(cfg_off, params, state, x, train=False)
+    assert np.allclose(np.asarray(l_on), np.asarray(l_off), atol=1e-2)
+    # odd spatial size falls back to the plain conv (no crash)
+    x_odd = jax.random.normal(jax.random.key(2), (2, 33, 33, 3))
+    l_odd, _ = resnet.forward(cfg, params, state, x_odd, train=False)
+    assert l_odd.shape == (2, 10)
+
+
 # ---------------------------------------------------------------- Llama
 
 def test_llama_forward_and_loss():

@@ -54,6 +54,7 @@ class ProtocolAgent(threading.Thread):
         self.stop_event = stop
         self.running: dict = {}     # task_id -> task_name
         self.pending: list = []     # statuses for the next poll
+        self.dead = False           # poll retries exhausted
 
     def _post(self, path: str, body: dict) -> dict:
         req = urllib.request.Request(
@@ -86,12 +87,13 @@ class ProtocolAgent(threading.Thread):
             except OSError:
                 if self.stop_event.is_set():
                     return  # server shut down first; clean exit
+                self.dead = True  # run_live fails fast on a dead agent
                 raise
             self.latencies.append(time.perf_counter() - t0)
-            self.pending = []
             if reply.get("reregister"):
                 # expired between polls (RemoteCluster expiry): re-register
-                # and resend pending statuses next poll, like the C++ agent
+                # and resend the KEPT pending statuses next poll, like the
+                # C++ agent (the server dropped this poll unprocessed)
                 self._post("/v1/agents/register", {
                     "agent_id": self.agent_id,
                     "hostname": f"h-{self.agent_id}",
@@ -99,6 +101,7 @@ class ProtocolAgent(threading.Thread):
                     "ports": [[1025, 32000]],
                 })
                 continue
+            self.pending = []
             for cmd in reply.get("commands", []):
                 if cmd.get("type") == "launch":
                     for t in cmd.get("tasks", []):
@@ -166,6 +169,10 @@ plans:
     try:
         with driver:
             while sched.plan("deploy").status is not Status.COMPLETE:
+                if any(a.dead for a in fleet):
+                    raise SystemExit(
+                        "harness fault: a protocol agent died after "
+                        "exhausting poll retries — result void")
                 if time.time() > deadline:
                     raise SystemExit(
                         f"deploy missed the 15-min SLO: "
