@@ -60,8 +60,19 @@ class ReservationLedger:
 
     def __init__(self, reservations: Iterable[Reservation] = ()):
         self._by_key: Dict[Tuple[str, str], Reservation] = {}
+        # per-agent index: the evaluator consults availability for every
+        # (candidate step x agent) pair, so a flat scan of all
+        # reservations per lookup turns a 500-pod gang deploy into
+        # O(pods^2 * reservations) — measured 62M reservation touches
+        self._by_agent: Dict[str, Dict[Tuple[str, str], Reservation]] = {}
+        # per-pod index: the evaluator's pin/pre-screen/mid-replace guards
+        # call for_pod() per evaluate() — same flat-scan hazard as above
+        self._by_pod: Dict[str, Dict[Tuple[str, str], Reservation]] = {}
+        # running scalar totals per agent [cpus, mem, disk, tpus] for the
+        # evaluator's O(1) capacity pre-screen over full agents
+        self._agg: Dict[str, list] = {}
         for r in reservations:
-            self._by_key[r.key] = r
+            self.add(r)
 
     def all(self) -> list[Reservation]:
         return list(self._by_key.values())
@@ -70,21 +81,44 @@ class ReservationLedger:
         return self._by_key.get((pod_instance_name, resource_set_id))
 
     def for_pod(self, pod_instance_name: str) -> list[Reservation]:
-        return [r for r in self._by_key.values()
-                if r.pod_instance_name == pod_instance_name]
+        return list(self._by_pod.get(pod_instance_name, {}).values())
 
     def for_agent(self, agent_id: str) -> list[Reservation]:
-        return [r for r in self._by_key.values() if r.agent_id == agent_id]
+        return list(self._by_agent.get(agent_id, {}).values())
+
+    def _agg_apply(self, r: Reservation, sign: int) -> None:
+        agg = self._agg.setdefault(r.agent_id, [0.0, 0, 0, 0])
+        agg[0] += sign * r.cpus
+        agg[1] += sign * r.memory_mb
+        agg[2] += sign * r.disk_mb
+        agg[3] += sign * r.tpus
+
+    def reserved_scalars(self, agent_id: str) -> tuple:
+        """(cpus, memory_mb, disk_mb, tpus) currently reserved on the
+        agent — O(1), for the evaluator's conservative pre-screen."""
+        agg = self._agg.get(agent_id)
+        return (0.0, 0, 0, 0) if agg is None else tuple(agg)
 
     def add(self, reservation: Reservation) -> None:
+        old = self._by_key.get(reservation.key)
+        if old is not None:
+            self._by_agent.get(old.agent_id, {}).pop(old.key, None)
+            self._by_pod.get(old.pod_instance_name, {}).pop(old.key, None)
+            self._agg_apply(old, -1)
         self._by_key[reservation.key] = reservation
+        self._by_agent.setdefault(reservation.agent_id,
+                                  {})[reservation.key] = reservation
+        self._by_pod.setdefault(reservation.pod_instance_name,
+                                {})[reservation.key] = reservation
+        self._agg_apply(reservation, +1)
 
     def remove_pod(self, pod_instance_name: str) -> list[Reservation]:
         """Unreserve everything a pod instance holds (replace/decommission)."""
-        removed = [r for r in self._by_key.values()
-                   if r.pod_instance_name == pod_instance_name]
+        removed = list(self._by_pod.pop(pod_instance_name, {}).values())
         for r in removed:
             del self._by_key[r.key]
+            self._by_agent.get(r.agent_id, {}).pop(r.key, None)
+            self._agg_apply(r, -1)
         return removed
 
     # -- availability ------------------------------------------------------

@@ -58,6 +58,13 @@ class Step(Element):
     def update_status(self, status: TaskStatus) -> None:
         """TaskStatus feed (reference ``DeploymentStep.update``)."""
 
+    def status_task_names(self):
+        """Task names whose statuses this step consumes, or ``None`` for
+        "unknown — deliver everything" (the conservative default for
+        subclasses that override :meth:`update_status` without declaring
+        their interest; lets :class:`Plan` route instead of broadcast)."""
+        return None
+
     def on_launch(self, task_name_to_id: Dict[str, str]) -> None:
         """The matcher launched tasks for this step."""
 
@@ -235,6 +242,9 @@ class DeploymentStep(Step):
                 return name
         return None
 
+    def status_task_names(self):
+        return tuple(self._goals)
+
     def _recompute(self) -> None:
         statuses = list(self._task_status.values())
         if all(s is Status.COMPLETE for s in statuses):
@@ -341,6 +351,14 @@ class Plan(ParentElement):
     def __init__(self, name: str, phases: Sequence[Phase],
                  strategy: Optional[Strategy] = None):
         super().__init__(name, phases, strategy)
+        self._status_index = None  # built lazily on first status
+
+    def invalidate_status_routing(self) -> None:
+        """MUST be called by any code that mutates the plan's phase/step
+        tree in place (recovery and decommission regenerate phases on a
+        long-lived plan object) — the routing index is otherwise cached
+        for the plan's lifetime."""
+        self._status_index = None
 
     @property
     def phases(self) -> List[Phase]:
@@ -351,7 +369,30 @@ class Plan(ParentElement):
         return [s for p in self.phases for s in p.steps]
 
     def update_status(self, status: TaskStatus) -> None:
-        for step in self.steps:
+        # route by the task name embedded in the id instead of fanning
+        # every status to every step — a 500-step deploy otherwise touches
+        # 250k (status x step) pairs per churn cycle. Steps that don't
+        # declare their interest (status_task_names() -> None) still get
+        # everything. The index is safe to cache: a step's task set is
+        # fixed at construction and plans are rebuilt, not mutated.
+        if self._status_index is None:
+            index: Dict[str, List[Step]] = {}
+            broadcast: List[Step] = []
+            for step in self.steps:
+                names = step.status_task_names()
+                if names is None:
+                    broadcast.append(step)
+                else:
+                    for n in names:
+                        index.setdefault(n, []).append(step)
+            self._status_index = (index, broadcast)
+        index, broadcast = self._status_index
+        name, sep, _ = status.task_id.rpartition("__")
+        if sep:
+            targets = list(index.get(name, ())) + broadcast
+        else:
+            targets = self.steps  # unroutable id: includes broadcast steps
+        for step in targets:
             step.update_status(status)
 
     def dirty_assets(self) -> set[str]:

@@ -109,6 +109,8 @@ class DecommissionPlanManager(PlanManager):
                 self._scheduler, name, "decommission")
             for name in excess_sorted
         ] or list(existing.values())
+        # the phase tree changed shape: statuses must re-route
+        self._plan.invalidate_status_routing()
 
 
 def build_uninstall_plan(scheduler) -> Plan:

@@ -153,6 +153,8 @@ class RecoveryPlanManager(PlanManager):
             if any(s.asset in existing_assets for s in phase.steps if s.asset):
                 continue
             self._plan.children.append(phase)
+        # the phase tree changed shape: statuses must re-route
+        self._plan.invalidate_status_routing()
 
     def _find_failed_pods(self, spec: ServiceSpec
                           ) -> Dict[str, tuple[PodInstance, RecoveryType]]:

@@ -72,6 +72,14 @@ class ProtocolAgent(threading.Thread):
         raise last
 
     def run(self) -> None:
+        try:
+            self._run()
+        except OSError:
+            if not self.stop_event.is_set():
+                self.dead = True  # run_live fails fast on a dead agent
+                raise
+
+    def _run(self) -> None:
         self._post("/v1/agents/register", {
             "agent_id": self.agent_id, "hostname": f"h-{self.agent_id}",
             "cpus": 64, "memory_mb": 262144, "disk_mb": 1 << 20,
@@ -79,16 +87,10 @@ class ProtocolAgent(threading.Thread):
         })
         while not self.stop_event.is_set():
             t0 = time.perf_counter()
-            try:
-                reply = self._post(f"/v1/agents/{self.agent_id}/poll", {
-                    "running_task_ids": list(self.running),
-                    "statuses": self.pending,
-                })
-            except OSError:
-                if self.stop_event.is_set():
-                    return  # server shut down first; clean exit
-                self.dead = True  # run_live fails fast on a dead agent
-                raise
+            reply = self._post(f"/v1/agents/{self.agent_id}/poll", {
+                "running_task_ids": list(self.running),
+                "statuses": self.pending,
+            })
             self.latencies.append(time.perf_counter() - t0)
             if reply.get("reregister"):
                 # expired between polls (RemoteCluster expiry): re-register
