@@ -373,8 +373,11 @@ class Plan(ParentElement):
         # every status to every step — a 500-step deploy otherwise touches
         # 250k (status x step) pairs per churn cycle. Steps that don't
         # declare their interest (status_task_names() -> None) still get
-        # everything. The index is safe to cache: a step's task set is
-        # fixed at construction and plans are rebuilt, not mutated.
+        # everything. CACHE INVARIANT: the index is valid only until the
+        # phase/step tree mutates — every in-place mutator (today:
+        # recovery and decommission phase regeneration) MUST call
+        # invalidate_status_routing(); a step's own task set is fixed at
+        # construction, so step-level changes never require it.
         if self._status_index is None:
             index: Dict[str, List[Step]] = {}
             broadcast: List[Step] = []

@@ -1,0 +1,89 @@
+"""Flagship-scale lowering proofs: the REAL Llama-3-8B configuration.
+
+The unit suites exercise tiny configs; these tests trace and lower the
+full 8B-parameter model at production sequence lengths with its real
+tp/dp/sp shardings — via ``jax.ShapeDtypeStruct``, so no parameter memory
+is ever allocated. Lowering catches what toy shapes cannot: sharding
+spec/shape mismatches (a dim that doesn't divide by tp), rope table
+sizing at seq 8192, GQA head-group math at 32q/8kv, and collective
+layout errors GSPMD would reject. This is the compile-side half of
+BASELINE.json config #5 (Llama-3-8B model-parallel); the execute-side
+half runs on real pods via frameworks/jax.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dcos_commons_tpu.models import llama, train
+from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+
+def _abstract_params(cfg, mesh):
+    """ShapeDtypeStructs with the model's real NamedShardings."""
+    specs = llama.param_specs(cfg)
+    # shapes come from a shape-only trace of init_params
+    shapes = jax.eval_shape(lambda k: llama.init_params(cfg, k),
+                            jax.random.key(0))
+    return jax.tree.map(
+        lambda s, spec: jax.ShapeDtypeStruct(
+            s.shape, s.dtype, sharding=NamedSharding(mesh, spec)),
+        shapes, specs)
+
+
+@pytest.mark.parametrize("attn_impl,sp", [("dense", 1), ("ring", 2)])
+def test_llama3_8b_train_step_lowers_with_tp_sharding(attn_impl, sp):
+    # tokens are seq+1 so the next-token shift trains exactly seq — the
+    # worker's convention, keeping the trained length sp-divisible
+    seq = 8192
+    cfg = llama.LlamaConfig(attn_impl=attn_impl, max_seq=seq + 1, remat=True,
+                            remat_policy="dots_with_no_batch_dims_saveable")
+    assert cfg.dim == 4096 and cfg.n_layers == 32  # the real 8B shape
+    mesh = MeshSpec(dp=2 // sp or 1, sp=sp, tp=4).build()
+    with mesh:
+        params = _abstract_params(cfg, mesh)
+        opt = train.make_optimizer(lr=3e-4, warmup=100, decay_steps=1000)
+        opt_state = jax.eval_shape(opt.init, params)
+        # tokens ride dp only (the worker's convention, batch_spec=None /
+        # P("dp")); the model's internal sharding constraints spread the
+        # sequence dim over sp after the shift
+        batch = 4
+        toks = jax.ShapeDtypeStruct(
+            (batch, seq + 1), jnp.int32,
+            sharding=NamedSharding(mesh, P("dp")))
+
+        step_fn = train.make_train_step(
+            lambda p, b: llama.loss_fn(cfg, p, b, mesh=mesh), opt,
+            mesh=mesh, param_spec_tree=llama.param_specs(cfg),
+            batch_spec=P("dp"))
+        lowered = jax.jit(step_fn, donate_argnums=(0, 1)).lower(
+            params, opt_state, toks)
+        hlo = lowered.as_text()
+        assert "sharding" in hlo  # GSPMD annotations survived to StableHLO
+        n_params = sum(
+            int(jnp.prod(jnp.array(s.shape)))
+            for s in jax.tree.leaves(params))
+        assert n_params > 7_000_000_000  # genuinely the 8B model
+
+
+def test_llama3_8b_pipeline_layout_lowers():
+    """PP layout: the 32-layer trunk stage-sharded over pp=4."""
+    cfg = llama.LlamaConfig(max_seq=2048, remat=True, attn_impl="dense")
+    mesh = MeshSpec(dp=2, pp=4).build()
+    with mesh:
+        shapes = jax.eval_shape(lambda k: llama.init_params(cfg, k),
+                                jax.random.key(0))
+        stacked = jax.eval_shape(
+            lambda t: llama.stack_pipeline_params(t, 4), shapes)
+        specs = llama.pipeline_param_specs(cfg)
+        params = jax.tree.map(
+            lambda s, spec: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=NamedSharding(mesh, spec)),
+            stacked, specs)
+        toks = jax.ShapeDtypeStruct(
+            (8, 2048), jnp.int32, sharding=NamedSharding(mesh, P("dp")))
+        lowered = jax.jit(
+            lambda p, t: llama.loss_fn_pipelined(cfg, p, t, mesh, n_micro=4)
+        ).lower(params, toks)
+        assert "sharding" in lowered.as_text()
