@@ -265,7 +265,9 @@ class StateQueries:
         return {"key": key, "deleted": True}
 
     def refresh_cache(self) -> dict:
-        # FilePersister/MemPersister read through; nothing cached to drop
+        # drops the StateStore's parse/task caches (for out-of-band state
+        # edits); persister reads are read-through already
+        self._scheduler.state.refresh_cache()
         return {"message": "Cache refreshed"}
 
 

@@ -499,6 +499,12 @@ class ServiceScheduler:
         )
 
     def _task_records(self) -> List[TaskRecord]:
+        # derived view cached against the task-set generation (rebuilt
+        # only when a task is stored/deleted, not every cycle)
+        gen = self.state.tasks_generation
+        cached = getattr(self, "_task_records_cache", None)
+        if cached is not None and cached[0] == gen:
+            return list(cached[1])  # defensive copy, like fetch_tasks
         out = []
         for task in self.state.fetch_tasks():
             out.append(TaskRecord(
@@ -507,7 +513,8 @@ class ServiceScheduler:
                 hostname=task.hostname, zone=task.zone, region=task.region,
                 permanently_failed=task.permanently_failed,
                 attributes=task.attributes))
-        return out
+        self._task_records_cache = (gen, out)
+        return list(out)
 
     # -- operator verbs ----------------------------------------------------
 

@@ -118,6 +118,14 @@ class StateStore:
         # writes through the same persister — a changed value re-parses.
         # Safe because StoredTask/TaskStatus are frozen dataclasses.
         self._parse_cache: dict[str, tuple[bytes, object]] = {}
+        # generation counter for the task SET (bumped by store_tasks /
+        # delete_task): fetch_tasks() runs several times per cycle and its
+        # get_children + N lookups dominate once parsing is memoized.
+        # Valid because this StateStore instance is the namespace's only
+        # writer (single-writer lease on the replicated backend; flock on
+        # files; per-service namespacing in multi).
+        self._tasks_gen = 0
+        self._tasks_cache: Optional[tuple[int, list]] = None
 
     def _path(self, *parts: str) -> str:
         return self._ns + "/".join(parts)
@@ -132,12 +140,23 @@ class StateStore:
 
     # -- tasks -------------------------------------------------------------
 
+    @property
+    def tasks_generation(self) -> int:
+        """Monotone stamp of the stored task set + task records (bumped on
+        any task write/delete); callers may cache derived views against it."""
+        return self._tasks_gen
+
     def store_tasks(self, tasks: Iterable[StoredTask]) -> None:
         """Reference ``storeTasks:213`` — atomic multi-write (the launch WAL:
         called before the agent is instructed to launch)."""
         self._persister.set_many({
             self._path(self.TASKS, _esc(t.task_name), self.TASK_INFO): t.to_json()
             for t in tasks})
+        # bump AFTER the write: an unlocked HTTP-thread reader racing this
+        # can then at worst cache pre-write data under the PRE-write
+        # generation, which this bump immediately invalidates (bumping
+        # first would let stale data be cached under the new stamp)
+        self._tasks_gen += 1
 
     def fetch_task(self, task_name: str) -> Optional[StoredTask]:
         path = self._path(self.TASKS, _esc(task_name), self.TASK_INFO)
@@ -153,12 +172,16 @@ class StateStore:
             return []
 
     def fetch_tasks(self) -> list[StoredTask]:
+        if self._tasks_cache is not None \
+                and self._tasks_cache[0] == self._tasks_gen:
+            return list(self._tasks_cache[1])
         out = []
         for name in self.fetch_task_names():
             t = self.fetch_task(name)
             if t is not None:
                 out.append(t)
-        return out
+        self._tasks_cache = (self._tasks_gen, out)
+        return list(out)
 
     def store_status(self, task_name: str, status: TaskStatus) -> None:
         """Reference ``storeStatus:257`` — validates the status belongs to the
@@ -197,6 +220,7 @@ class StateStore:
             self._persister.recursive_delete(prefix)
         except NotFoundError:
             pass
+        self._tasks_gen += 1  # after the delete; see store_tasks
 
     # -- goal overrides (pause/resume) -------------------------------------
 
@@ -243,8 +267,16 @@ class StateStore:
     def deploy_completed(self) -> bool:
         return self.fetch_property(self.DEPLOY_COMPLETED) == b"true"
 
-    def delete_all(self) -> None:
+    def refresh_cache(self) -> None:
+        """Drop derived caches so the next read hits the persister
+        (reference ``StateResource`` refresh: for operators who edited
+        state out-of-band — outside the single-writer assumption)."""
         self._parse_cache.clear()
+        self._tasks_cache = None
+        self._tasks_gen += 1
+
+    def delete_all(self) -> None:
+        self.refresh_cache()
         for child in (self.TASKS, self.PROPERTIES):
             try:
                 self._persister.recursive_delete(self._path(child).rstrip("/"))

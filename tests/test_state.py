@@ -237,3 +237,90 @@ class TestInstanceLock:
         assert "a" in p.get_children("")
         assert ".lock" not in p.get_children("")
         lock.release()
+
+
+class TestTaskSetCache:
+    """The generation-stamped fetch_tasks cache: correct invalidation on
+    every mutation path, isolation of the cached list, and the
+    out-of-band escape hatch (refresh_cache / POST /v1/state/refresh)."""
+
+    def test_fetch_tasks_cached_and_invalidated_on_store(self):
+        store = StateStore(MemPersister())
+        store.store_tasks([stored_task()])
+        first = store.fetch_tasks()
+        gen = store.tasks_generation
+        assert store.fetch_tasks() == first
+        assert store.tasks_generation == gen  # reads don't bump
+        store.store_tasks([stored_task(name="hello-1-server",
+                                       pod_index=1)])
+        assert store.tasks_generation > gen
+        assert len(store.fetch_tasks()) == 2
+
+    def test_delete_task_invalidates(self):
+        store = StateStore(MemPersister())
+        store.store_tasks([stored_task()])
+        assert len(store.fetch_tasks()) == 1
+        store.delete_task("hello-0-server")
+        assert store.fetch_tasks() == []
+
+    def test_cached_list_is_isolated_from_callers(self):
+        store = StateStore(MemPersister())
+        store.store_tasks([stored_task()])
+        got = store.fetch_tasks()
+        got.clear()  # caller mutation must not corrupt the cache
+        assert len(store.fetch_tasks()) == 1
+
+    def test_status_writes_do_not_invalidate(self):
+        store = StateStore(MemPersister())
+        t = stored_task()
+        store.store_tasks([t])
+        gen = store.tasks_generation
+        store.store_status("hello-0-server", TaskStatus.now(
+            t.task_id, TaskState.RUNNING))
+        assert store.tasks_generation == gen  # statuses aren't the task SET
+
+    def test_refresh_cache_drops_stale_view_after_oob_write(self):
+        """An out-of-band writer (second StateStore on the same persister —
+        outside the single-writer assumption) is invisible until
+        refresh_cache, and visible right after."""
+        p = MemPersister()
+        store = StateStore(p)
+        store.store_tasks([stored_task()])
+        assert len(store.fetch_tasks()) == 1
+        oob = StateStore(p)
+        oob.store_tasks([stored_task(name="hello-1-server", pod_index=1)])
+        assert len(store.fetch_tasks()) == 1  # cached: stale by design
+        store.refresh_cache()
+        assert len(store.fetch_tasks()) == 2
+
+    def test_http_refresh_endpoint_drops_caches(self):
+        from dcos_commons_tpu.http import ApiServer
+        from dcos_commons_tpu.scheduler import ServiceScheduler
+        from dcos_commons_tpu.specification import load_service_yaml_str
+        from dcos_commons_tpu.testing.simulation import (FakeCluster,
+                                                         default_agents)
+        import json as _json
+        import urllib.request
+        yml = """
+name: svc
+pods:
+  web:
+    count: 1
+    tasks:
+      server: {goal: RUNNING, cmd: x, cpus: 0.1, memory: 32}
+"""
+        sched = ServiceScheduler(load_service_yaml_str(yml), MemPersister(),
+                                 FakeCluster(default_agents(1)))
+        sched.run_cycle()
+        assert sched.state.fetch_tasks()  # warm the cache
+        gen = sched.state.tasks_generation
+        server = ApiServer(sched, port=0)
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"{server.url}/v1/state/refresh", method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert _json.loads(r.read())["message"]
+            assert sched.state.tasks_generation > gen
+        finally:
+            server.stop()
