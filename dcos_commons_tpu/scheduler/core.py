@@ -94,6 +94,8 @@ class ServiceScheduler:
         self.reservation_store = ReservationStore(persister, namespace)
         self.cluster = cluster
         self.uninstall_mode = uninstall
+        # TaskRecord view cached against StateStore.tasks_generation
+        self._task_records_cache = None
         # optional MetricsRegistry (reference metrics/Metrics.java counters)
         self.metrics = metrics
         if metrics is not None:
@@ -502,7 +504,7 @@ class ServiceScheduler:
         # derived view cached against the task-set generation (rebuilt
         # only when a task is stored/deleted, not every cycle)
         gen = self.state.tasks_generation
-        cached = getattr(self, "_task_records_cache", None)
+        cached = self._task_records_cache
         if cached is not None and cached[0] == gen:
             return list(cached[1])  # defensive copy, like fetch_tasks
         out = []

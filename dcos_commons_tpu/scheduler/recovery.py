@@ -106,6 +106,9 @@ class RecoveryPlanManager(PlanManager):
         self._monitor = failure_monitor or NeverFailureMonitor()
         self._backoff = backoff
         self._overriders = list(overriders)
+        # (spec, tasks_gen, statuses_gen) of the last scan that found
+        # nothing failing — see _find_failed_pods
+        self._empty_scan_key = None
 
     # -- plan regeneration --------------------------------------------------
 
@@ -159,7 +162,24 @@ class RecoveryPlanManager(PlanManager):
     def _find_failed_pods(self, spec: ServiceSpec
                           ) -> Dict[str, tuple[PodInstance, RecoveryType]]:
         """Reference ``getNewFailedPods`` (``DefaultRecoveryPlanManager.java:
-        286-358``): scan stored statuses, group by pod instance, classify."""
+        286-358``): scan stored statuses, group by pod instance, classify.
+
+        Healthy steady state skips the scan entirely: when a prior scan at
+        the SAME task+status generations found nothing, nothing can have
+        started failing since (every failure path writes a status or task
+        record). Only the empty verdict is cached — a non-empty one must
+        re-scan every cycle because time-based monitors
+        (``TimedFailureMonitor``) escalate classifications without any new
+        write."""
+        key = (spec, self._state.tasks_generation,
+               self._state.statuses_generation)
+        prev = self._empty_scan_key
+        # spec compared by IDENTITY (and kept referenced by the cache so the
+        # id can't be recycled): a config update swaps the spec object and
+        # can change pod counts — which changes the verdict — without any
+        # task/status write
+        if prev is not None and prev[0] is key[0] and prev[1:] == key[1:]:
+            return {}
         out: Dict[str, tuple[PodInstance, RecoveryType]] = {}
         pods_by_type = {p.type: p for p in spec.pods}
         for task in self._state.fetch_tasks():
@@ -184,6 +204,10 @@ class RecoveryPlanManager(PlanManager):
             prev = out.get(pod_instance.name)
             if prev is None or recovery is RecoveryType.PERMANENT:
                 out[pod_instance.name] = (pod_instance, recovery)
+        # cache the empty verdict at the key we scanned (escalation inside
+        # the loop bumps the generation, making the key stale — which is
+        # correct: the next cycle must re-scan)
+        self._empty_scan_key = key if not out else None
         return out
 
     def _phase_for(self, spec: ServiceSpec, pod_instance: PodInstance,

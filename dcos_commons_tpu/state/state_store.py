@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import enum
 import json
+import threading
 from typing import Iterable, Optional
 
 from ..specification.spec import ServiceSpec
@@ -126,6 +127,15 @@ class StateStore:
         # files; per-service namespacing in multi).
         self._tasks_gen = 0
         self._tasks_cache: Optional[tuple[int, list]] = None
+        # statuses generation: bumped on ANY task or status write — lets
+        # per-cycle scans (recovery's failed-pod sweep) skip re-deriving
+        # "nothing changed" verdicts
+        self._status_gen = 0
+        # guards generation bumps and cache publication: HTTP handler
+        # threads read (and refresh) through this store while the
+        # scheduler thread writes — unsynchronized `+= 1` can lose an
+        # invalidation and an unsynchronized publish can stamp stale data
+        self._cache_lock = threading.Lock()
 
     def _path(self, *parts: str) -> str:
         return self._ns + "/".join(parts)
@@ -146,17 +156,24 @@ class StateStore:
         any task write/delete); callers may cache derived views against it."""
         return self._tasks_gen
 
+    @property
+    def statuses_generation(self) -> int:
+        """Monotone stamp over tasks AND statuses."""
+        return self._status_gen
+
     def store_tasks(self, tasks: Iterable[StoredTask]) -> None:
         """Reference ``storeTasks:213`` — atomic multi-write (the launch WAL:
         called before the agent is instructed to launch)."""
         self._persister.set_many({
             self._path(self.TASKS, _esc(t.task_name), self.TASK_INFO): t.to_json()
             for t in tasks})
-        # bump AFTER the write: an unlocked HTTP-thread reader racing this
-        # can then at worst cache pre-write data under the PRE-write
-        # generation, which this bump immediately invalidates (bumping
-        # first would let stale data be cached under the new stamp)
-        self._tasks_gen += 1
+        # bump AFTER the write: an HTTP-thread reader racing this can then
+        # at worst cache pre-write data under the PRE-write generation,
+        # which this bump immediately invalidates (bumping first would let
+        # stale data be cached under the new stamp)
+        with self._cache_lock:
+            self._tasks_gen += 1
+            self._status_gen += 1
 
     def fetch_task(self, task_name: str) -> Optional[StoredTask]:
         path = self._path(self.TASKS, _esc(task_name), self.TASK_INFO)
@@ -172,15 +189,21 @@ class StateStore:
             return []
 
     def fetch_tasks(self) -> list[StoredTask]:
-        if self._tasks_cache is not None \
-                and self._tasks_cache[0] == self._tasks_gen:
-            return list(self._tasks_cache[1])
+        # capture the generation BEFORE reading: a write landing mid-build
+        # then leaves our list stamped with the pre-write generation, which
+        # the writer's bump has already invalidated
+        gen = self._tasks_gen
+        cached = self._tasks_cache
+        if cached is not None and cached[0] == gen:
+            return list(cached[1])
         out = []
         for name in self.fetch_task_names():
             t = self.fetch_task(name)
             if t is not None:
                 out.append(t)
-        self._tasks_cache = (self._tasks_gen, out)
+        with self._cache_lock:
+            if self._tasks_gen == gen:  # never publish a stale build
+                self._tasks_cache = (gen, out)
         return list(out)
 
     def store_status(self, task_name: str, status: TaskStatus) -> None:
@@ -194,6 +217,8 @@ class StateStore:
         self._persister.set(
             self._path(self.TASKS, _esc(task_name), self.TASK_STATUS),
             status.to_json())
+        with self._cache_lock:
+            self._status_gen += 1  # after the write; see store_tasks
 
     def fetch_status(self, task_name: str) -> Optional[TaskStatus]:
         path = self._path(self.TASKS, _esc(task_name), self.TASK_STATUS)
@@ -220,7 +245,9 @@ class StateStore:
             self._persister.recursive_delete(prefix)
         except NotFoundError:
             pass
-        self._tasks_gen += 1  # after the delete; see store_tasks
+        with self._cache_lock:
+            self._tasks_gen += 1  # after the delete; see store_tasks
+            self._status_gen += 1
 
     # -- goal overrides (pause/resume) -------------------------------------
 
@@ -271,17 +298,21 @@ class StateStore:
         """Drop derived caches so the next read hits the persister
         (reference ``StateResource`` refresh: for operators who edited
         state out-of-band — outside the single-writer assumption)."""
-        self._parse_cache.clear()
-        self._tasks_cache = None
-        self._tasks_gen += 1
+        with self._cache_lock:
+            self._parse_cache.clear()
+            self._tasks_cache = None
+            self._tasks_gen += 1
+            self._status_gen += 1
 
     def delete_all(self) -> None:
-        self.refresh_cache()
         for child in (self.TASKS, self.PROPERTIES):
             try:
                 self._persister.recursive_delete(self._path(child).rstrip("/"))
             except NotFoundError:
                 pass
+        # AFTER the deletes (see store_tasks): a reader racing the wipe can
+        # only cache pre-delete data under a stamp this call invalidates
+        self.refresh_cache()
 
 
 class ConfigStore:

@@ -108,6 +108,9 @@ struct Config {
   std::string slice_id, topology, zone, region;
   std::vector<std::string> volume_profiles;  // mount-disk profiles served
   std::vector<std::string> roles = {"*"};    // reservation role pools
+  // freeform host attributes (rack=r1 ...) consumed by the attribute
+  // placement rules (attribute / max-per-attribute / round-robin-attribute)
+  std::vector<std::pair<std::string, std::string>> attributes;
   int worker_index = -1;
   double poll_interval_s = 1.0;
   long max_polls = -1;  // test hook: exit after N polls (-1 = forever)
@@ -329,6 +332,11 @@ class Agent {
         .set("tpu", tpu);
     if (!cfg_.zone.empty()) body.set("zone", cfg_.zone);
     if (!cfg_.region.empty()) body.set("region", cfg_.region);
+    if (!cfg_.attributes.empty()) {
+      Json attrs = Json::object();
+      for (const auto& kv : cfg_.attributes) attrs.set(kv.first, kv.second);
+      body.set("attributes", attrs);
+    }
     if (!cfg_.volume_profiles.empty()) {
       Json profiles = Json::array();
       for (const auto& p : cfg_.volume_profiles) profiles.push_back(p);
@@ -1006,6 +1014,8 @@ void usage(const char* argv0) {
       << "  --tpu-chips N       TPU chips (default: probe /dev/accel*)\n"
       << "  --slice-id S --topology T --worker-index N   ICI identity\n"
       << "  --zone Z --region R\n"
+      << "  --attribute K=V     freeform host attribute (repeatable; "
+         "placement rules)\n"
       << "  --volume-profiles P1,P2   mount-disk profiles served\n"
       << "  --roles R1,R2       reservation role pools (default '*')\n"
       << "  --poll-interval S   seconds between polls (default 1)\n"
@@ -1052,6 +1062,15 @@ int main(int argc, char** argv) {
     else if (a == "--worker-index") cfg.worker_index = std::stoi(next());
     else if (a == "--zone") cfg.zone = next();
     else if (a == "--region") cfg.region = next();
+    else if (a == "--attribute") {
+      std::string kv = next();
+      size_t eq = kv.find('=');
+      if (eq == std::string::npos || eq == 0) {
+        std::cerr << "--attribute needs KEY=VALUE, got: " << kv << "\n";
+        return 2;
+      }
+      cfg.attributes.emplace_back(kv.substr(0, eq), kv.substr(eq + 1));
+    }
     else if (a == "--volume-profiles") {
       cfg.volume_profiles.clear();
       std::istringstream ss(next());

@@ -867,3 +867,60 @@ def test_seccomp_and_shm_enforced(native_bins, tmp_path):
         agent.terminate()
         agent.wait(timeout=5)
         server.stop()
+
+
+def test_agent_attributes_drive_placement(native_bins, tmp_path):
+    """--attribute K=V flows agent -> register payload -> placement rules:
+    two hosts in one rack + one in another, MAX_PER rack=1 puts the two
+    pods in two different racks (reference: offer attributes consumed by
+    MaxPerAttributeRule)."""
+    yml = """
+name: racked
+pods:
+  web:
+    count: 2
+    placement: '[["rack", "MAX_PER", "1"]]'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: sleep 60
+        cpus: 0.1
+        memory: 32
+"""
+    cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.05)
+    sched = ServiceScheduler(load_service_yaml_str(yml), MemPersister(),
+                             cluster)
+    server = ApiServer(sched, port=0, cluster=cluster)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    agents = []
+    for aid, rack in (("r0a", "r1"), ("r0b", "r1"), ("r1a", "r2")):
+        agents.append(subprocess.Popen(
+            [str(native_bins / "tpu-agent"), "--scheduler", url,
+             "--agent-id", aid, "--hostname", f"host-{aid}",
+             "--cpus", "4", "--memory-mb", "4096", "--disk-mb", "10000",
+             "--base-dir", str(tmp_path / aid),
+             "--attribute", f"rack={rack}", "--attribute", "tier=metal",
+             "--poll-interval", "0.05", "--tpu-chips", "0"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+    try:
+        wait_for(lambda: len(cluster.agents()) == 3,
+                 message="3 agents registered")
+        by_id = {a.agent_id: a for a in cluster.agents()}
+        assert by_id["r0a"].attributes == {"rack": "r1", "tier": "metal"}
+        drive_to(sched, "deploy", Status.COMPLETE)
+        racks = {by_id[t.agent_id].attributes["rack"]
+                 for t in sched.state.fetch_tasks()}
+        assert racks == {"r1", "r2"}, racks
+        # the stored tasks carry launch-time attributes for the rules
+        for t in sched.state.fetch_tasks():
+            assert t.attributes.get("rack") in ("r1", "r2")
+    finally:
+        for p in agents:
+            p.terminate()
+        for p in agents:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        server.stop()

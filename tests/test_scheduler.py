@@ -382,3 +382,50 @@ pods:
         sched.run_until_quiet()
         resumed = cluster.launch_log[-1].launches[0]
         assert resumed.health_check_cmd == "check"
+
+
+class TestRecoveryScanCache:
+    """The empty-verdict scan cache must re-scan when the SPEC changes,
+    even with no task/status writes in between (a config update can bring
+    a failed-but-out-of-scope task back into scope)."""
+
+    def test_spec_change_invalidates_empty_verdict(self):
+        from dcos_commons_tpu.scheduler.recovery import RecoveryPlanManager
+        from dcos_commons_tpu.state import MemPersister
+        from dcos_commons_tpu.state.state_store import StateStore
+        from dcos_commons_tpu.state.tasks import (StoredTask, TaskState,
+                                                  TaskStatus)
+        from dcos_commons_tpu.specification import load_service_yaml_str
+        from dcos_commons_tpu.utils import make_task_id
+
+        yml = """
+name: svc
+pods:
+  web:
+    count: {n}
+    tasks:
+      server: {{goal: RUNNING, cmd: x, cpus: 0.1, memory: 32}}
+"""
+        spec1 = load_service_yaml_str(yml.format(n=1))
+        spec2 = load_service_yaml_str(yml.format(n=2))
+        state = StateStore(MemPersister())
+        tid = make_task_id("web-1-server")
+        state.store_tasks([StoredTask(
+            task_name="web-1-server", task_id=tid, pod_type="web",
+            pod_index=1, task_spec_name="server",
+            resource_set_id="server-resources", agent_id="a1",
+            hostname="h1", target_config_id="cfg",
+            goal=__import__("dcos_commons_tpu.specification.spec",
+                            fromlist=["GoalState"]).GoalState.RUNNING)])
+        state.store_status("web-1-server", TaskStatus.now(
+            tid, TaskState.FAILED))
+
+        current = {"spec": spec1}
+        mgr = RecoveryPlanManager(lambda: current["spec"], state)
+        # under spec1 (count 1) web-1 is out of scope: empty verdict cached
+        assert mgr._find_failed_pods(spec1) == {}
+        assert mgr._find_failed_pods(spec1) == {}
+        # spec2 (count 2) brings web-1 into scope — with NO writes since,
+        # the scan must still re-run and find it
+        failed = mgr._find_failed_pods(spec2)
+        assert "web-1" in failed
