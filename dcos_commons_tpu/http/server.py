@@ -230,6 +230,7 @@ class ApiServer:
         # front of the scheduler; here the server owns its socket):
         # an ssl.SSLContext or security.transport.ServerCredentials
         self._tls = tls
+        self._default_scheduler = scheduler  # quota store owner (mono)
         if scheduler is not None:
             self._default = _Routes(scheduler, metrics)
         outer = self
@@ -323,6 +324,8 @@ class ApiServer:
             if rest.endswith("prometheus"):
                 return 200, self._metrics.to_prometheus().encode()
             return 200, self._metrics.to_dict()
+        if rest == "quota" or rest.startswith("quota/"):
+            return self._dispatch_quota(method, rest, body)
         if rest == "multi":
             return 200, sorted(self._services.keys())
         if rest.startswith("multi/"):
@@ -467,6 +470,68 @@ class ApiServer:
         except AuthError as e:
             return e.code, {"error": e.message}
         return None
+
+    def _dispatch_quota(self, method: str, rest: str,
+                        body: Optional[bytes]) -> Tuple[int, object]:
+        """Cluster-level role quotas (reference: Mesos enforced group
+        roles; operator scope): GET /v1/quota, PUT/DELETE
+        /v1/quota/<role>. Changes apply on the next scheduler cycle."""
+        from ..matching.quota import QuotaStore, RoleQuota
+        owner = self._multi if self._multi is not None \
+            else self._default_scheduler
+        store = getattr(owner, "quotas", None)
+        if store is None:
+            return 404, {"error": "no quota store mounted"}
+        if method == "GET" and rest == "quota":
+            return 200, [
+                {k: v for k, v in
+                 {"role": q.role, "cpus": q.cpus, "memory_mb": q.memory_mb,
+                  "disk_mb": q.disk_mb, "tpus": q.tpus}.items()
+                 if v is not None}
+                for q in store.list()]
+        if rest == "quota":
+            return 404, {"error": "PUT/DELETE /v1/quota/<role>"}
+        role = unquote(rest.split("/", 1)[1])
+        role_err = QuotaStore.validate_role(role)
+        if role_err is not None:
+            return 400, {"error": role_err}
+        if method == "PUT":
+            allowed = {"cpus", "memory_mb", "disk_mb", "tpus"}
+            try:
+                data = json.loads(body.decode()) if body else {}
+                unknown = set(data) - allowed
+                if unknown:
+                    # a typoed dimension must not 200 into an uncapped
+                    # quota the operator believes is enforced
+                    return 400, {"error": f"unknown quota field(s) "
+                                          f"{sorted(unknown)}; allowed: "
+                                          f"{sorted(allowed)}"}
+                import math
+                for k in allowed & set(data):
+                    v = float(data[k])
+                    if not math.isfinite(v) or v < 0:
+                        # json.loads accepts NaN/Infinity; a NaN cap would
+                        # compare False against everything = never enforced
+                        return 400, {"error": f"{k} must be a finite "
+                                              f"non-negative number"}
+                quota = RoleQuota(
+                    role=role,
+                    cpus=(float(data["cpus"]) if "cpus" in data else None),
+                    memory_mb=(int(data["memory_mb"])
+                               if "memory_mb" in data else None),
+                    disk_mb=(int(data["disk_mb"])
+                             if "disk_mb" in data else None),
+                    tpus=(int(data["tpus"]) if "tpus" in data else None))
+            except (ValueError, TypeError, AttributeError):
+                return 400, {"error": "body must be JSON with numeric "
+                                      "cpus/memory_mb/disk_mb/tpus caps"}
+            store.set(quota)
+            return 200, {"role": role, "status": "set"}
+        if method == "DELETE":
+            if not store.delete(role):
+                return 404, {"error": f"no quota for role {role!r}"}
+            return 200, {"role": role, "status": "deleted"}
+        return 404, {"error": f"no quota route {method} /v1/{rest}"}
 
     def _dispatch_multi(self, method: str, name: str,
                         body: Optional[bytes]) -> Tuple[int, object]:

@@ -1,0 +1,162 @@
+"""Role quotas — scheduler-enforced resource caps per reservation role.
+
+Reference: Mesos *enforced group roles* — quota set on a role caps every
+service reserving under it; the SDK's side of the contract is exercised by
+``frameworks/helloworld/tests/test_quota_deployment.py`` /
+``test_quota_upgrade.py`` / ``test_quota_downgrade.py`` and the role
+selection in ``scheduler/SchedulerBuilder.java``. The reference delegates
+the actual enforcement to the Mesos master; this build's scheduler owns
+the whole cluster view, so it enforces the caps itself at launch time:
+a step whose new reservations would push the role's aggregate usage over
+quota simply doesn't match this cycle (same observable behavior as Mesos
+withholding offers from a quota-exhausted role — deployment WAITS rather
+than fails, and resumes the moment quota is raised or usage drops).
+
+Quotas are cluster-level (stored at the persister ROOT, outside any
+service namespace) so every service of a multi-service scheduler counts
+against the same caps, like group roles. A pod's role is its
+``pre-reserved-role`` or ``"*"`` (the default shared pool).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional
+
+from ..state.persister import NotFoundError, Persister
+
+QUOTA_ROOT = "Quota"
+
+# usage vectors are [cpus, memory_mb, disk_mb, tpus]
+DIMS = ("cpus", "memory_mb", "disk_mb", "tpus")
+
+
+@dataclass(frozen=True)
+class RoleQuota:
+    """Caps for one role; ``None`` on a dimension means unlimited."""
+
+    role: str
+    cpus: Optional[float] = None
+    memory_mb: Optional[int] = None
+    disk_mb: Optional[int] = None
+    tpus: Optional[int] = None
+
+    def to_json(self) -> bytes:
+        return json.dumps(asdict(self), sort_keys=True).encode()
+
+    @staticmethod
+    def from_json(raw: bytes) -> "RoleQuota":
+        return RoleQuota(**json.loads(raw.decode()))
+
+    def shortfall(self, usage: List[float],
+                  delta: List[float]) -> Optional[str]:
+        """None when ``usage + delta`` fits; else a human-readable reason
+        (mirrors ``Availability.fits``)."""
+        caps = (self.cpus, self.memory_mb, self.disk_mb, self.tpus)
+        for dim, cap, used, want in zip(DIMS, caps, usage, delta):
+            if cap is not None and used + want > cap + 1e-9:
+                return (f"role {self.role!r} quota exceeded on {dim}: "
+                        f"cap {cap:g}, in use {used:g}, requested {want:g}")
+        return None
+
+
+class QuotaStore:
+    """Cluster-level quota persistence (``Quota/<role>`` at the persister
+    root — deliberately OUTSIDE service namespaces, shared by all services
+    the scheduler hosts).
+
+    Reads are served from an in-memory mirror so the launch hot path
+    pays no persister I/O per step. Valid because all writes to quotas go
+    through ONE store instance per process (the multi scheduler hands its
+    own instance to every child, and the HTTP surface uses the same one)
+    and the process holds the single-writer lease.
+    """
+
+    def __init__(self, persister: Persister):
+        import threading
+        self._persister = persister
+        self._lock = threading.Lock()
+        self._cache: Optional[Dict[str, RoleQuota]] = None
+
+    @staticmethod
+    def validate_role(role: str) -> Optional[str]:
+        """None when usable; else the problem (empty/dot-prefixed roles
+        would escape the per-role subtree or be persister-illegal)."""
+        if not role:
+            return "role must be non-empty"
+        if role.startswith("."):
+            return "role may not start with '.'"
+        return None
+
+    def _load(self) -> Dict[str, RoleQuota]:
+        with self._lock:
+            if self._cache is None:
+                cache: Dict[str, RoleQuota] = {}
+                try:
+                    roles = self._persister.get_children(QUOTA_ROOT)
+                except NotFoundError:
+                    roles = []
+                for key in roles:
+                    raw = self._persister.get_or_none(
+                        f"{QUOTA_ROOT}/{key}")
+                    if raw is not None:
+                        q = RoleQuota.from_json(raw)
+                        cache[q.role] = q
+                self._cache = cache
+            return self._cache
+
+    def set(self, quota: RoleQuota) -> None:
+        err = self.validate_role(quota.role)
+        if err is not None:
+            raise ValueError(err)
+        self._persister.set(f"{QUOTA_ROOT}/{_esc(quota.role)}",
+                            quota.to_json())
+        with self._lock:
+            if self._cache is not None:
+                self._cache[quota.role] = quota
+
+    def get(self, role: str) -> Optional[RoleQuota]:
+        return self._load().get(role)
+
+    def list(self) -> List[RoleQuota]:
+        return sorted(self._load().values(), key=lambda q: q.role)
+
+    def delete(self, role: str) -> bool:
+        err = self.validate_role(role)
+        if err is not None:
+            raise ValueError(err)
+        try:
+            self._persister.recursive_delete(f"{QUOTA_ROOT}/{_esc(role)}")
+            removed = True
+        except NotFoundError:
+            removed = False
+        with self._lock:
+            if self._cache is not None:
+                self._cache.pop(role, None)
+        return removed
+
+
+def _esc(role: str) -> str:
+    # "*" (the default pool) and "/"-scoped group roles must survive the
+    # persister's path rules; role names are recovered from the stored
+    # JSON, so no inverse is needed
+    return role.replace("/", "%2F").replace("*", "%2A")
+
+
+def usage_by_role(spec, ledger) -> Dict[str, List[float]]:
+    """Aggregate one service's reserved resources per role: every
+    reservation is attributed to its pod's ``pre-reserved-role`` (or
+    ``"*"``), resolved through the service spec."""
+    role_of_pod_type = {p.type: (p.pre_reserved_role or "*")
+                        for p in spec.pods}
+    out: Dict[str, List[float]] = {}
+    for r in ledger.all():
+        pod_type = r.pod_instance_name.rsplit("-", 1)[0]
+        role = role_of_pod_type.get(pod_type, "*")
+        agg = out.setdefault(role, [0.0, 0.0, 0.0, 0.0])
+        agg[0] += r.cpus
+        agg[1] += r.memory_mb
+        agg[2] += r.disk_mb
+        agg[3] += r.tpus
+    return out

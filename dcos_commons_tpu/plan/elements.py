@@ -148,6 +148,9 @@ class DeploymentStep(Step):
         self.requirement = requirement
         self._backoff = backoff or DisabledBackoff()
         self._status = initial_status
+        # last cycle's no-match reason, shown in the plan view while the
+        # step waits (reference DeploymentStep message)
+        self._last_no_match: Optional[str] = None
         # task instance name -> launched task id (current attempt)
         self._launched: Dict[str, str] = {}
         # task instance name -> per-task Status
@@ -186,6 +189,7 @@ class DeploymentStep(Step):
         return self.requirement
 
     def on_launch(self, task_name_to_id: Dict[str, str]) -> None:
+        self._last_no_match = None
         for task_name, task_id in task_name_to_id.items():
             if task_name in self._task_status:
                 self._launched[task_name] = task_id
@@ -194,8 +198,10 @@ class DeploymentStep(Step):
         self._recompute()
 
     def on_no_match(self, reason: str) -> None:
-        # stays PENDING; the outcome tracker records the reason
-        pass
+        # stays PENDING; the reason is surfaced in the plan view (the
+        # reference DeploymentStep's getMessage) and the outcome tracker
+        # keeps the full per-agent breakdown at /v1/debug/offers
+        self._last_no_match = reason
 
     def mark_prepared(self) -> None:
         """Kill-before-relaunch issued; awaiting terminal statuses before the
@@ -276,6 +282,9 @@ class DeploymentStep(Step):
     def to_dict(self) -> dict:
         d = super().to_dict()
         d["tasks"] = {t: s.value for t, s in self._task_status.items()}
+        if self._last_no_match and self.status in (Status.PENDING,
+                                                   Status.DELAYED):
+            d["message"] = f"waiting: {self._last_no_match}"
         return d
 
 

@@ -96,6 +96,14 @@ class ServiceScheduler:
         self.uninstall_mode = uninstall
         # TaskRecord view cached against StateStore.tasks_generation
         self._task_records_cache = None
+        # role quotas: cluster-level store at the persister root (shared
+        # across services, like Mesos enforced group roles); the usage
+        # supplier is replaced by the multi-service scheduler with a
+        # cross-service aggregate
+        from ..matching.quota import QuotaStore, usage_by_role
+        self.quotas = QuotaStore(persister)
+        self.role_usage_supplier = \
+            lambda: usage_by_role(self.spec, self.ledger)
         # optional MetricsRegistry (reference metrics/Metrics.java counters)
         self.metrics = metrics
         if metrics is not None:
@@ -422,6 +430,13 @@ class ServiceScheduler:
             if plan is None:
                 step.on_no_match("; ".join(outcome.failure_reasons()[:5]))
                 continue
+            quota_err = self._quota_shortfall(requirement, plan)
+            if quota_err is not None:
+                # same observable behavior as Mesos withholding offers
+                # from an exhausted role: the step waits, and proceeds the
+                # cycle after quota is raised or usage drops
+                step.on_no_match(quota_err)
+                continue
             # WAL + step bookkeeping BEFORE the agent is instructed: statuses
             # may arrive synchronously (fake cluster) or at any time after
             # launch; the step must already know its task ids
@@ -461,6 +476,28 @@ class ServiceScheduler:
                 self.cluster.kill(task.agent_id, task.task_id, grace)
                 pending = True
         return pending
+
+    def _quota_shortfall(self, requirement, plan: LaunchPlan
+                         ) -> Optional[str]:
+        """None when the launch fits the role's quota (or none is set);
+        else the reason. ``plan.reservations`` holds only NEW reservations
+        (the evaluator keeps reused ones out of the plan, and PERMANENT
+        replace GCs the old ones before evaluating), so a relaunch reusing
+        its reservation naturally consumes no additional quota."""
+        role = requirement.pod_instance.pod.pre_reserved_role or "*"
+        quota = self.quotas.get(role)
+        if quota is None:
+            return None
+        delta = [0.0, 0.0, 0.0, 0.0]
+        for r in plan.reservations:
+            delta[0] += r.cpus
+            delta[1] += r.memory_mb
+            delta[2] += r.disk_mb
+            delta[3] += r.tpus
+        if not any(delta):
+            return None
+        usage = self.role_usage_supplier().get(role, [0.0, 0.0, 0.0, 0.0])
+        return quota.shortfall(usage, delta)
 
     def _persist_launch(self, plan: LaunchPlan) -> None:
         """WAL: tasks + reservations persisted before the agent is instructed

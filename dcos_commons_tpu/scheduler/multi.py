@@ -215,6 +215,9 @@ class MultiServiceScheduler:
         # multi-service tasks get workload-identity tokens too
         self._auth = auth
         self.service_store = ServiceStore(persister)
+        # cluster-level role quotas shared by all children (group roles)
+        from ..matching.quota import QuotaStore
+        self.quotas = QuotaStore(persister)
         self.discipline = discipline or AllDiscipline()
         self._factory = scheduler_factory or ServiceScheduler
         self._api_server = api_server
@@ -238,6 +241,19 @@ class MultiServiceScheduler:
     def service_names(self) -> List[str]:
         with self._lock:
             return sorted(self._services.keys())
+
+    def role_usage(self) -> Dict[str, List[float]]:
+        """Cross-service per-role usage (the Mesos group-role aggregate)."""
+        from ..matching.quota import usage_by_role
+        with self._lock:
+            services = list(self._services.values())
+        out: Dict[str, List[float]] = {}
+        for svc in services:
+            for role, agg in usage_by_role(svc.spec, svc.ledger).items():
+                tot = out.setdefault(role, [0.0, 0.0, 0.0, 0.0])
+                for i in range(4):
+                    tot[i] += agg[i]
+        return out
 
     def get_service(self, name: str) -> Optional[ServiceScheduler]:
         with self._lock:
@@ -290,6 +306,12 @@ class MultiServiceScheduler:
         scheduler = self._factory(
             spec, self.persister, view, namespace=namespace,
             uninstall=uninstall, **kwargs)
+        # role quotas are cluster-level (Mesos group-role semantics):
+        # every child counts the WHOLE scheduler's usage against the caps,
+        # and all share ONE QuotaStore instance so its in-memory mirror
+        # sees every write
+        scheduler.role_usage_supplier = self.role_usage
+        scheduler.quotas = self.quotas
         self._services[spec.name] = scheduler
         self._views[spec.name] = view
         if self._api_server is not None:

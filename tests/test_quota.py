@@ -1,0 +1,223 @@
+"""Role quotas (reference: Mesos enforced group roles, exercised by
+``frameworks/helloworld/tests/test_quota_deployment.py`` /
+``test_quota_upgrade.py`` / ``test_quota_downgrade.py``). The reference
+delegates enforcement to the Mesos master; here the scheduler enforces
+the caps itself — deployment WAITS at the cap and resumes when quota is
+raised (never fails), exactly the observable behavior of Mesos
+withholding offers from an exhausted role."""
+
+import json
+import urllib.error
+import urllib.request
+
+from dcos_commons_tpu.matching.quota import QuotaStore, RoleQuota
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler import ServiceScheduler
+from dcos_commons_tpu.scheduler.multi import MultiServiceScheduler
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import MemPersister
+from dcos_commons_tpu.testing.simulation import FakeCluster, default_agents
+
+YML = """
+name: {name}
+pods:
+  web:
+    count: {count}
+    {role_line}
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "sleep 100"
+        cpus: 1.0
+        memory: 128
+"""
+
+
+def spec(name="svc", count=3, role=None):
+    role_line = f"pre-reserved-role: {role}" if role else ""
+    return load_service_yaml_str(
+        YML.format(name=name, count=count, role_line=role_line))
+
+
+class TestQuotaStore:
+    def test_round_trip_and_weird_roles(self):
+        store = QuotaStore(MemPersister())
+        store.set(RoleQuota(role="*", cpus=4.0))
+        store.set(RoleQuota(role="dev/teamA", tpus=8))
+        assert store.get("*").cpus == 4.0
+        assert store.get("dev/teamA").tpus == 8
+        roles = {q.role for q in store.list()}
+        assert roles == {"*", "dev/teamA"}
+        assert store.delete("*")
+        assert not store.delete("*")
+
+    def test_persists_across_reopen(self):
+        p = MemPersister()
+        QuotaStore(p).set(RoleQuota(role="*", cpus=2.0))
+        assert QuotaStore(p).get("*").cpus == 2.0
+
+
+class TestQuotaEnforcement:
+    def test_deploy_waits_at_cap_and_resumes_on_raise(self):
+        persister = MemPersister()
+        sched = ServiceScheduler(spec(count=3), persister,
+                                 FakeCluster(default_agents(3)))
+        # cap 2 cpus: only two 1-cpu pods fit
+        sched.quotas.set(RoleQuota(role="*", cpus=2.0))
+        sched.run_until_quiet()
+        assert len(sched.state.fetch_tasks()) == 2
+        deploy = sched.plan("deploy")
+        assert deploy.status is not Status.COMPLETE
+        # the waiting step surfaces the quota reason in the plan view
+        # (DeploymentStep message; what the CLI shows operators)
+        messages = [s.to_dict().get("message", "") for s in deploy.steps]
+        assert any("quota exceeded" in m for m in messages), messages
+        # raise the cap: the SAME scheduler resumes next cycle, no restart
+        sched.quotas.set(RoleQuota(role="*", cpus=3.0))
+        sched.run_until_quiet()
+        assert len(sched.state.fetch_tasks()) == 3
+        assert sched.plan("deploy").status is Status.COMPLETE
+
+    def test_unquota_role_unaffected(self):
+        persister = MemPersister()
+        sched = ServiceScheduler(spec(count=2, role="gold"), persister,
+                                 FakeCluster(default_agents(3,
+                                             roles=("*", "gold"))))
+        sched.quotas.set(RoleQuota(role="*", cpus=0.5))  # caps a DIFFERENT role
+        sched.run_until_quiet()
+        assert sched.plan("deploy").status is Status.COMPLETE
+
+    def test_relaunch_in_place_consumes_no_quota(self):
+        """Recovery on an existing reservation must not be blocked by a
+        fully-consumed quota (it adds no usage)."""
+        from dcos_commons_tpu.state.tasks import TaskState
+        cluster = FakeCluster(default_agents(3))
+        sched = ServiceScheduler(spec(count=2), MemPersister(), cluster)
+        sched.quotas.set(RoleQuota(role="*", cpus=2.0))  # exactly full
+        sched.run_until_quiet()
+        assert len(sched.state.fetch_tasks()) == 2
+        victim = cluster.task("web-0-server")
+        cluster.send_status(victim.task_id, TaskState.FAILED, "oom")
+        sched.run_until_quiet()
+        st = sched.state.fetch_status("web-0-server")
+        assert st is not None and st.state is TaskState.RUNNING
+
+    def test_multi_services_share_role_caps(self):
+        """Group-role semantics: two services under one scheduler count
+        against the same cap."""
+        persister = MemPersister()
+        multi = MultiServiceScheduler(persister,
+                                      FakeCluster(default_agents(4)))
+        multi.quotas.set(RoleQuota(role="*", cpus=3.0))
+        multi.add_service(spec(name="alpha", count=2))
+        multi.add_service(spec(name="beta", count=2))
+        for _ in range(60):
+            multi.run_cycle()
+        total = sum(len(multi.get_service(n).state.fetch_tasks())
+                    for n in multi.service_names())
+        assert total == 3  # 4 wanted, 3 fit the shared cap
+        multi.quotas.set(RoleQuota(role="*", cpus=4.0))
+        for _ in range(60):
+            multi.run_cycle()
+        total = sum(len(multi.get_service(n).state.fetch_tasks())
+                    for n in multi.service_names())
+        assert total == 4
+
+
+class TestQuotaHttp:
+    def test_quota_crud_over_http(self):
+        from dcos_commons_tpu.http import ApiServer
+        sched = ServiceScheduler(spec(count=1), MemPersister(),
+                                 FakeCluster(default_agents(1)))
+        server = ApiServer(sched, port=0)
+        server.start()
+        try:
+            def call(method, path, data=None):
+                req = urllib.request.Request(
+                    f"{server.url}{path}", method=method,
+                    data=json.dumps(data).encode() if data else None,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return json.loads(r.read())
+
+            assert call("GET", "/v1/quota") == []
+            call("PUT", "/v1/quota/*", {"cpus": 4, "tpus": 16})
+            listed = call("GET", "/v1/quota")
+            assert listed == [{"role": "*", "cpus": 4.0, "tpus": 16}]
+            assert sched.quotas.get("*").cpus == 4.0  # live, same store
+            call("DELETE", "/v1/quota/*")
+            assert call("GET", "/v1/quota") == []
+        finally:
+            server.stop()
+
+
+class TestQuotaValidation:
+    def test_empty_role_delete_rejected(self):
+        """DELETE /v1/quota/ (empty role) must 400, never wipe the root."""
+        from dcos_commons_tpu.http import ApiServer
+        sched = ServiceScheduler(spec(count=1), MemPersister(),
+                                 FakeCluster(default_agents(1)))
+        sched.quotas.set(RoleQuota(role="gold", cpus=1.0))
+        server = ApiServer(sched, port=0)
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"{server.url}/v1/quota/", method="DELETE")
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                raise AssertionError("empty role accepted")
+            except urllib.error.HTTPError as e:
+                # routing strips the trailing slash (404) before the
+                # store-level guard (400) can even fire; either refusal
+                # protects the root
+                assert e.code in (400, 404)
+            assert sched.quotas.get("gold") is not None  # survived
+            # the store-level guard protects programmatic callers too
+            import pytest
+            with pytest.raises(ValueError, match="non-empty"):
+                sched.quotas.delete("")
+        finally:
+            server.stop()
+
+    def test_unknown_field_rejected(self):
+        from dcos_commons_tpu.http import ApiServer
+        sched = ServiceScheduler(spec(count=1), MemPersister(),
+                                 FakeCluster(default_agents(1)))
+        server = ApiServer(sched, port=0)
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"{server.url}/v1/quota/gold", method="PUT",
+                data=json.dumps({"cpu": 64}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                raise AssertionError("typoed field accepted")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert b"cpu" in e.read()
+            assert sched.quotas.get("gold") is None  # nothing stored
+        finally:
+            server.stop()
+
+    def test_nonfinite_caps_rejected(self):
+        from dcos_commons_tpu.http import ApiServer
+        sched = ServiceScheduler(spec(count=1), MemPersister(),
+                                 FakeCluster(default_agents(1)))
+        server = ApiServer(sched, port=0)
+        server.start()
+        try:
+            for bad in ('{"cpus": NaN}', '{"cpus": Infinity}',
+                        '{"tpus": -4}'):
+                req = urllib.request.Request(
+                    f"{server.url}/v1/quota/gold", method="PUT",
+                    data=bad.encode(),
+                    headers={"Content-Type": "application/json"})
+                try:
+                    urllib.request.urlopen(req, timeout=10)
+                    raise AssertionError(f"accepted {bad}")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 400, bad
+            assert sched.quotas.get("gold") is None
+        finally:
+            server.stop()
