@@ -142,6 +142,35 @@ def _agents_cmd(client: Client, args) -> int:
     return _emit(*client.call("GET", path, root=True))
 
 
+def _quota_cmd(client: Client, args) -> int:
+    from urllib.parse import quote
+    if args.action == "list":
+        return _emit(*client.call("GET", "quota", root=True))
+    if not args.role:
+        print(json.dumps({"error": f"quota {args.action} needs ROLE"}))
+        return 2
+    path = "quota/" + quote(args.role, safe="")
+    if args.action == "delete":
+        return _emit(*client.call("DELETE", path, root=True))
+    caps = {}
+    for pair in args.set or []:
+        key, sep, value = pair.partition("=")
+        if not sep:
+            print(json.dumps({"error": f"--set needs DIM=N, got {pair!r}"}))
+            return 2
+        try:
+            caps[key] = float(value) if "." in value else int(value)
+        except ValueError:
+            print(json.dumps(
+                {"error": f"--set {key} needs a number, got {value!r}"}))
+            return 2
+    if not caps:
+        print(json.dumps({"error": "quota set needs --set DIM=N"}))
+        return 2
+    return _emit(*client.call("PUT", path, json.dumps(caps).encode(),
+                              root=True))
+
+
 def _health_cmd(client: Client, args) -> int:
     return _emit(*client.get("health"))
 
@@ -205,6 +234,14 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("action", nargs="?", choices=["list", "info"],
                     default="list")
     ag.set_defaults(fn=_agents_cmd)
+
+    q = sub.add_parser("quota", help="cluster role quotas")
+    q.add_argument("action", nargs="?",
+                   choices=["list", "set", "delete"], default="list")
+    q.add_argument("role", nargs="?")
+    q.add_argument("--set", action="append", metavar="DIM=N",
+                   help="cap (cpus/memory_mb/disk_mb/tpus; repeatable)")
+    q.set_defaults(fn=_quota_cmd)
 
     sub.add_parser("health", help="scheduler health").set_defaults(
         fn=_health_cmd)

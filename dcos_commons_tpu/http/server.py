@@ -514,6 +514,10 @@ class ApiServer:
                         # compare False against everything = never enforced
                         return 400, {"error": f"{k} must be a finite "
                                               f"non-negative number"}
+                    if k != "cpus" and v != int(v):
+                        # int() would silently truncate 2.5 tpus to a
+                        # STRICTER cap than the operator asked for
+                        return 400, {"error": f"{k} must be an integer"}
                 quota = RoleQuota(
                     role=role,
                     cpus=(float(data["cpus"]) if "cpus" in data else None),

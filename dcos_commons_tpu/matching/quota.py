@@ -138,10 +138,12 @@ class QuotaStore:
 
 
 def _esc(role: str) -> str:
-    # "*" (the default pool) and "/"-scoped group roles must survive the
-    # persister's path rules; role names are recovered from the stored
-    # JSON, so no inverse is needed
-    return role.replace("/", "%2F").replace("*", "%2A")
+    # full percent-encoding (like multi-service name escaping): partial
+    # escaping would let distinct roles ("a/b" vs "a%2Fb") collide onto
+    # one persister key; role names are recovered from the stored JSON,
+    # so no inverse is needed
+    from urllib.parse import quote
+    return quote(role, safe="")
 
 
 def usage_by_role(spec, ledger) -> Dict[str, List[float]]:

@@ -96,6 +96,9 @@ class ServiceScheduler:
         self.uninstall_mode = uninstall
         # TaskRecord view cached against StateStore.tasks_generation
         self._task_records_cache = None
+        # per-cycle memo of role_usage_supplier() (reset each cycle and
+        # after every launch within a cycle)
+        self._quota_usage_memo = None
         # role quotas: cluster-level store at the persister root (shared
         # across services, like Mesos enforced group roles); the usage
         # supplier is replaced by the multi-service scheduler with a
@@ -392,6 +395,7 @@ class ServiceScheduler:
         return not self.ledger.for_pod(requirement.pod_instance.name)
 
     def _run_cycle_locked(self, allow_expand: bool = True) -> int:
+        self._quota_usage_memo = None  # fresh usage view per cycle
         if self.metrics is not None:
             self.metrics.record_cycle()
         if self.agent_grace_s > 0:
@@ -496,7 +500,13 @@ class ServiceScheduler:
             delta[3] += r.tpus
         if not any(delta):
             return None
-        usage = self.role_usage_supplier().get(role, [0.0, 0.0, 0.0, 0.0])
+        # the usage map is memoized for the cycle (multi aggregates every
+        # service's ledger — O(total reservations) per computation) and
+        # invalidated on every launch so later steps in the SAME cycle see
+        # the consumed quota
+        if self._quota_usage_memo is None:
+            self._quota_usage_memo = self.role_usage_supplier()
+        usage = self._quota_usage_memo.get(role, [0.0, 0.0, 0.0, 0.0])
         return quota.shortfall(usage, delta)
 
     def _persist_launch(self, plan: LaunchPlan) -> None:
@@ -508,6 +518,7 @@ class ServiceScheduler:
         for r in plan.reservations:
             self.ledger.add(r)
         self.reservation_store.store(plan.reservations)
+        self._quota_usage_memo = None  # usage changed mid-cycle
 
     def _stored_task(self, plan: LaunchPlan, launch: TaskLaunch) -> StoredTask:
         pod_instance = plan.requirement.pod_instance

@@ -50,6 +50,29 @@ int post(const Ctx& ctx, const std::string& path,
                              ctx.token()));
 }
 
+int request(const Ctx& ctx, const std::string& method,
+            const std::string& path, const std::string& body = "") {
+  return emit(tpu::http_request(method, ctx.base + ctx.prefix + "/" + path,
+                                body, 30, ctx.token()));
+}
+
+std::string url_escape_role(const std::string& role) {
+  // full percent-encoding of non-unreserved chars ('%' included, or the
+  // server's unquote would rewrite "a%2Fb" into the DIFFERENT role "a/b")
+  static const char* hex = "0123456789ABCDEF";
+  std::string out;
+  for (unsigned char c : role) {
+    if (isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~') {
+      out += static_cast<char>(c);
+    } else {
+      out += '%';
+      out += hex[c >> 4];
+      out += hex[c & 0xF];
+    }
+  }
+  return out;
+}
+
 void usage() {
   std::cerr
       << "usage: tpuctl [--url URL] [--service NAME] <command> ...\n"
@@ -62,6 +85,7 @@ void usage() {
       << "  update [--set KEY=VALUE ...] [--yaml FILE]\n"
       << "  state framework-id|properties|property [KEY]\n"
       << "  agents [list|info]\n"
+      << "  quota list | set ROLE [--set DIM=N ...] | delete ROLE\n"
       << "  health\n";
 }
 
@@ -198,6 +222,44 @@ int main(int argc, char** argv) {
       if (action == "framework-id") return get(ctx, "state/frameworkId");
       if (action == "properties") return get(ctx, "state/properties");
       if (action == "property") return get(ctx, "state/properties/" + arg);
+    }
+
+    if (cmd == "quota") {
+      // cluster-level route, never under a service prefix
+      Ctx root = ctx;
+      root.prefix = "/v1";
+      if (action == "list" || action.empty()) return get(root, "quota");
+      if (action == "set") {
+        if (arg.empty() || sets.empty()) {
+          std::cerr << "quota set ROLE --set cpus=N [--set memory_mb=N "
+                       "--set disk_mb=N --set tpus=N]\n";
+          return 2;
+        }
+        std::string body = "{";
+        for (size_t i = 0; i < sets.size(); ++i) {
+          size_t eq = sets[i].find('=');
+          if (eq == std::string::npos) {
+            std::cerr << "--set needs DIM=N, got '" << sets[i] << "'\n";
+            return 2;
+          }
+          if (i > 0) body += ",";
+          body += "\"" + sets[i].substr(0, eq) + "\": " +
+                  sets[i].substr(eq + 1);
+        }
+        body += "}";
+        return request(root, "PUT", "quota/" + url_escape_role(arg), body);
+      }
+      if (action == "delete") {
+        if (arg.empty()) {
+          std::cerr << "quota delete ROLE\n";
+          return 2;
+        }
+        return request(root, "DELETE",
+                       "quota/" + url_escape_role(arg));
+      }
+      std::cerr << "quota: unknown action '" << action
+                << "' (expected list|set|delete)\n";
+      return 2;
     }
   } catch (const std::exception& e) {
     std::cerr << "error: " << e.what() << "\n";

@@ -221,3 +221,44 @@ class TestQuotaValidation:
             assert sched.quotas.get("gold") is None
         finally:
             server.stop()
+
+
+class TestQuotaCli:
+    def test_both_clis_manage_quota(self, capsys):
+        """tpuctl (C++) and the Python CLI drive /v1/quota the same way."""
+        import subprocess
+        from pathlib import Path
+        from dcos_commons_tpu.http import ApiServer
+        from dcos_commons_tpu.cli.main import main as cli_main
+        sched = ServiceScheduler(spec(count=1), MemPersister(),
+                                 FakeCluster(default_agents(1)))
+        server = ApiServer(sched, port=0)
+        server.start()
+        try:
+            rc = cli_main(["--url", server.url, "quota", "set", "*",
+                           "--set", "cpus=8", "--set", "tpus=32"])
+            assert rc == 0
+            capsys.readouterr()
+            assert sched.quotas.get("*").tpus == 32
+            tpuctl = Path(__file__).parent.parent / "native/bin/tpuctl"
+            out = subprocess.run(
+                [str(tpuctl), "--url", server.url, "quota", "list"],
+                capture_output=True, text=True, timeout=30)
+            assert out.returncode == 0 and '"tpus":32' in out.stdout
+            out = subprocess.run(
+                [str(tpuctl), "--url", server.url, "quota", "set", "gold",
+                 "--set", "cpus=4"],
+                capture_output=True, text=True, timeout=30)
+            assert out.returncode == 0, out.stdout + out.stderr
+            assert sched.quotas.get("gold").cpus == 4.0
+            out = subprocess.run(
+                [str(tpuctl), "--url", server.url, "quota", "delete",
+                 "gold"], capture_output=True, text=True, timeout=30)
+            assert out.returncode == 0
+            assert sched.quotas.get("gold") is None
+            rc = cli_main(["--url", server.url, "quota", "delete", "*"])
+            assert rc == 0
+            capsys.readouterr()
+            assert sched.quotas.get("*") is None
+        finally:
+            server.stop()
