@@ -250,6 +250,13 @@ class Evaluator:
                 prescreen[2] += rs.disk_mb
                 prescreen[3] += rs.tpus
 
+        # pre-screen skips beyond the first few are summarized in ONE node:
+        # at fleet scale the per-agent reason tree would allocate hundreds
+        # of thousands of outcome nodes per deploy for agents that are
+        # simply full (the detail for the first ones is kept for debugging)
+        prescreen_detail_budget = 5
+        prescreen_skipped = 0
+        prescreen_last_reason = ""
         for agent in candidates:
             if prescreen is not None:
                 rc, rm, rd, rt = ledger.reserved_scalars(agent.agent_id)
@@ -258,8 +265,11 @@ class Evaluator:
                     disk_mb=agent.disk_mb - rd, tpus=agent.tpu.chips - rt,
                     used_ports=set(), agent=agent).fits(*prescreen)
                 if reason is not None:
-                    root.child(f"agent:{agent.agent_id}").add(
-                        EvaluationOutcome.fail("capacity", reason))
+                    prescreen_skipped += 1
+                    prescreen_last_reason = reason
+                    if prescreen_skipped <= prescreen_detail_budget:
+                        root.child(f"agent:{agent.agent_id}").add(
+                            EvaluationOutcome.fail("capacity", reason))
                     continue
             node = root.child(f"agent:{agent.agent_id}")
             plan = self._evaluate_agent(requirement, agent, tasks, ledger,
@@ -269,6 +279,12 @@ class Evaluator:
                 node.add(EvaluationOutcome.ok("launch", f"all stages passed on {agent.agent_id}"))
                 self._record(root)
                 return plan, root
+        if prescreen_skipped > prescreen_detail_budget:
+            root.child("capacity-summary").add(EvaluationOutcome.fail(
+                "capacity",
+                f"{prescreen_skipped - prescreen_detail_budget} more "
+                f"agents skipped by the capacity pre-screen (last: "
+                f"{prescreen_last_reason})"))
         self._record(root)
         return None, root
 
@@ -327,26 +343,46 @@ class Evaluator:
                 return None
             return pod.tpu.slice_index(int(idx), pod.count)
 
-        # slices already chosen by sibling instances, per group
+        # slices already chosen by sibling instances, per group. The moment
+        # OUR group's slice is known we can return — all gang siblings of a
+        # group share one slice by construction, and the full `chosen` map
+        # is only needed by the all-or-nothing feasibility branch below
+        # (which runs only when our group is still unassigned). This keeps
+        # the steady-state deploy loop O(first sibling found), not
+        # O(tasks + reservations) per candidate.
         chosen: Dict[int, str] = {}
+        failed_pods = set()
         for record in tasks:
             if record.pod_type != pod_type or \
                     record.pod_instance_name == requirement.pod_instance.name:
+                continue
+            if record.permanently_failed:
+                # a sibling being replaced must not vote for the gang
+                # slice: its (suspect) slice would pin the others to a
+                # host set the replace exists to leave. This applies to
+                # its not-yet-GC'd RESERVATION too (below) — in a serial
+                # whole-gang re-form the first member evaluates while
+                # later members' old reservations still exist, and a stale
+                # vote deadlocks the phase against its own cleanup.
+                failed_pods.add(record.pod_instance_name)
                 continue
             sibling_agent = agents_by_id.get(record.agent_id)
             group = group_of(record.pod_instance_name)
             if group is not None and sibling_agent is not None \
                     and sibling_agent.tpu.slice_id:
+                if group == my_group:
+                    return sibling_agent.tpu.slice_id, None
                 chosen[group] = sibling_agent.tpu.slice_id
         for res in ledger.all():
             group = group_of(res.pod_instance_name)
             if res.tpus > 0 and group is not None \
-                    and res.pod_instance_name != requirement.pod_instance.name:
+                    and res.pod_instance_name != requirement.pod_instance.name \
+                    and res.pod_instance_name not in failed_pods:
                 res_agent = agents_by_id.get(res.agent_id)
                 if res_agent is not None and res_agent.tpu.slice_id:
+                    if group == my_group:
+                        return res_agent.tpu.slice_id, None
                     chosen.setdefault(group, res_agent.tpu.slice_id)
-        if my_group in chosen:
-            return chosen[my_group], None
 
         # all-or-nothing: every still-unassigned group must get a capable,
         # distinct slice
@@ -370,7 +406,18 @@ class Evaluator:
             pod_volumes.extend(pod.resource_set(rs_id).volumes)
 
         def host_capable(a: AgentInfo) -> bool:
-            if ledger.available(a, exclude_pod=exclude).tpus < per_host_chips:
+            free = ledger.available(a, exclude_pod=exclude).tpus
+            if failed_pods:
+                # chips still held by permanently-failed siblings count as
+                # free-able: their PERMANENT steps GC those reservations
+                # before launching, so a whole-gang re-form onto the SAME
+                # slice must not read its own members' stale holds as
+                # "full" (the per-agent reserve stage still enforces true
+                # availability at launch time — worst case the step waits
+                # a cycle for the sibling's GC)
+                free += sum(r.tpus for r in ledger.for_agent(a.agent_id)
+                            if r.pod_instance_name in failed_pods)
+            if free < per_host_chips:
                 return False
             if _role_shortfall(pod, a) is not None:
                 return False

@@ -218,6 +218,28 @@ class TestGangPlacement:
         assert plan.agent.tpu.slice_id == "s1"
         assert plan.agent.agent_id == "t2"  # t1 already holds worker-0
 
+    def test_failed_sibling_does_not_pin_slice(self):
+        """A permanently-failed sibling (mid whole-gang replace, its agent
+        still in inventory) must not vote for the gang slice — regardless
+        of task-record order, the live relaunched sibling's slice wins."""
+        agents = [tpu_agent(1, "s1"), tpu_agent(2, "s1"), tpu_agent(3, "s2"),
+                  tpu_agent(4, "s2")]
+        # a still-marked record of the pod FIRST (on s1) — e.g. a ONCE
+        # sidecar not yet cleaned — plus the fresh relaunched main task on
+        # s2 (the store keys records by task NAME, so a mixed state uses
+        # distinct task names of one pod)
+        tasks = [
+            TaskRecord("worker-0-init", "worker", 0, "t1", "tpu1",
+                       permanently_failed=True),
+            TaskRecord("worker-0-train", "worker", 0, "t3", "tpu3"),
+        ]
+        self.ledger.add(Reservation("worker-0", "wres", "t3", cpus=4,
+                                    memory_mb=8192, tpus=4))
+        plan, _ = self.ev.evaluate(req(self.spec, "worker", 1), agents,
+                                   tasks, self.ledger)
+        assert plan is not None
+        assert plan.agent.tpu.slice_id == "s2"
+
     def test_no_feasible_slice_is_all_or_nothing(self):
         # two slices, each with one capable host: gang of 2 cannot split
         agents = [tpu_agent(1, "s1"), tpu_agent(2, "s2")]

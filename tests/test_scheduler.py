@@ -429,3 +429,70 @@ pods:
         # the scan must still re-run and find it
         failed = mgr._find_failed_pods(spec2)
         assert "web-1" in failed
+
+
+class TestWholeGangReplace:
+    """Whole-gang replace (every member marked permanently failed at once)
+    must re-form without wedging: failed members' slices AND their
+    not-yet-GC'd reservations must not vote for the gang slice, and their
+    held chips count as free-able in slice feasibility — otherwise the
+    serial re-form phase deadlocks against its own cleanup."""
+
+    YML = """
+name: ms
+pods:
+  worker:
+    count: 2
+    tpu: {chips: 4, topology: v4-16}
+    resource-sets:
+      wres: {cpus: 1, memory: 512, tpus: 4}
+    tasks:
+      train: {goal: RUNNING, cmd: train, resource-set: wres}
+"""
+
+    @staticmethod
+    def _agents(slice_id, n):
+        from dcos_commons_tpu.agent.inventory import (AgentInfo, PortRange,
+                                                      TpuInventory)
+        return [AgentInfo(agent_id=f"{slice_id}-h{i}",
+                          hostname=f"{slice_id}-host{i}",
+                          cpus=16, memory_mb=65536, disk_mb=65536,
+                          ports=(PortRange(10000, 20000),),
+                          tpu=TpuInventory(chips=4, slice_id=slice_id,
+                                           topology="v4-16",
+                                           worker_index=i))
+                for i in range(n)]
+
+    def _deploy(self, agents):
+        from dcos_commons_tpu.agent import FakeCluster
+        from dcos_commons_tpu.state import MemPersister
+        cluster = FakeCluster(agents)
+        sched = ServiceScheduler(load_service_yaml_str(self.YML),
+                                 MemPersister(), cluster)
+        sched.run_until_quiet()
+        assert len(sched.state.fetch_tasks()) == 2
+        return sched, cluster
+
+    def test_reforms_on_fresh_slice_when_old_slice_degraded(self):
+        sched, cluster = self._deploy(self._agents("sA", 2)
+                                      + self._agents("sB", 2))
+        for pod in ("worker-0", "worker-1"):
+            sched.replace_pod(pod)
+        cluster.remove_agent("sA-h0")
+        for _ in range(60):
+            sched.run_cycle()
+        tasks = sched.state.fetch_tasks()
+        assert {t.tpu.slice_id for t in tasks} == {"sB"}
+        assert not any(t.permanently_failed for t in tasks)
+        assert sorted(t.tpu.process_id for t in tasks) == [0, 1]
+
+    def test_reforms_in_place_on_the_only_slice(self):
+        sched, _ = self._deploy(self._agents("sA", 2))
+        for pod in ("worker-0", "worker-1"):
+            sched.replace_pod(pod)
+        for _ in range(60):
+            sched.run_cycle()
+        tasks = sched.state.fetch_tasks()
+        assert len(tasks) == 2
+        assert not any(t.permanently_failed for t in tasks)
+        assert sorted(t.tpu.process_id for t in tasks) == [0, 1]
