@@ -17,7 +17,7 @@ Behavior modes per task (set via ``script``):
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Dict, List, Optional, Sequence
 
 from ..matching.evaluator import LaunchPlan, TaskLaunch
@@ -79,6 +79,15 @@ class FakeCluster:
 
     def add_agent(self, agent: AgentInfo) -> None:
         self._agents[agent.agent_id] = agent
+
+    def degrade_tpu(self, agent_id: str, chips_now: int) -> None:
+        """Simulate a chip falling off the bus mid-run: the agent stays
+        live and its tasks keep running, but its TPU inventory reports
+        ``chips_now`` with ``degraded=True`` — what ``RemoteCluster``
+        synthesizes when a real agent's re-probe loses chips."""
+        a = self._agents[agent_id]
+        self._agents[agent_id] = replace(
+            a, tpu=replace(a.tpu, chips=chips_now, degraded=True))
 
     def remove_agent(self, agent_id: str) -> List[FakeTask]:
         """Simulate host loss: agent gone, its tasks implicitly dead (no

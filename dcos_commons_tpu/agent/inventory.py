@@ -28,6 +28,12 @@ class TpuInventory:
     topology: Optional[str] = None       # e.g. "v4-32", "4x4x4"
     coords: Optional[Tuple[int, ...]] = None  # this host's coords in the slice
     worker_index: Optional[int] = None   # stable host index within the slice
+    # chip-level health (SURVEY.md §5): the agent re-probes its chips on
+    # every poll; fewer chips than registered (or a probe error) marks the
+    # host degraded — the matcher refuses NEW TPU work on it and the
+    # scheduler proactively re-forms gangs that have a member here, instead
+    # of waiting for the task to crash. ``chips`` reflects the live count.
+    degraded: bool = False
 
 
 @dataclass(frozen=True)

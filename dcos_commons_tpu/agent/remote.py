@@ -15,6 +15,7 @@ Liveness: an agent missing ``expiry_s`` of polls is dropped from
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -48,6 +49,9 @@ class RemoteCluster:
         self._last_seen: Dict[str, float] = {}
         self._queues: Dict[str, List[dict]] = {}
         self._running: Dict[str, List[str]] = {}
+        # agent_id -> live chip count, present only while it disagrees with
+        # the registered inventory (chip fell off the bus / probe error)
+        self._tpu_chips_now: Dict[str, int] = {}
         self._callback: Optional[StatusCallback] = None
 
     # -- AgentClient interface --------------------------------------------
@@ -55,8 +59,16 @@ class RemoteCluster:
     def agents(self) -> Sequence[AgentInfo]:
         with self._lock:
             cutoff = _now() - self._expiry_s
-            return [a for aid, a in self._agents.items()
-                    if self._last_seen.get(aid, 0) >= cutoff]
+            out = []
+            for aid, a in self._agents.items():
+                if self._last_seen.get(aid, 0) < cutoff:
+                    continue
+                chips_now = self._tpu_chips_now.get(aid)
+                if chips_now is not None:
+                    a = dataclasses.replace(a, tpu=dataclasses.replace(
+                        a.tpu, chips=chips_now, degraded=True))
+                out.append(a)
+            return out
 
     def launch(self, plan: LaunchPlan) -> None:
         command = {"type": "launch", "tasks": [
@@ -145,6 +157,9 @@ class RemoteCluster:
             self._agents[info.agent_id] = info
             self._last_seen[info.agent_id] = _now()
             self._queues.setdefault(info.agent_id, [])
+            # fresh registration advertises fresh inventory: whatever the
+            # agent reports now IS the truth, clear any stale health mark
+            self._tpu_chips_now.pop(info.agent_id, None)
         return {"ok": True, "poll_interval_s": self.poll_interval_s}
 
     def poll(self, agent_id: str, payload: dict) -> dict:
@@ -162,6 +177,28 @@ class RemoteCluster:
             self._last_seen[agent_id] = _now()
             self._running[agent_id] = list(payload.get("running_task_ids",
                                                        []))
+            health = payload.get("tpu_health")
+            if health is not None:
+                # chip-level health (SURVEY.md §5): the agent re-probes
+                # /dev/accel* every poll; losing chips vs the registered
+                # inventory (or a probe error, chips < 0) degrades the host.
+                # A later poll reporting the full count clears the mark
+                # (driver reload) — agents() reflects whichever is current.
+                registered = self._agents[agent_id].tpu.chips
+                chips_now = int(health.get("chips", registered))
+                if health.get("error") or chips_now < registered:
+                    if self._tpu_chips_now.get(agent_id) != chips_now:
+                        log.warning(
+                            "agent %s TPU-degraded: %d/%d chips%s",
+                            agent_id, max(chips_now, 0), registered,
+                            f" ({health['error']})"
+                            if health.get("error") else "")
+                    self._tpu_chips_now[agent_id] = max(chips_now, 0)
+                else:
+                    if agent_id in self._tpu_chips_now:
+                        log.warning("agent %s TPU health recovered "
+                                    "(%d chips)", agent_id, chips_now)
+                    self._tpu_chips_now.pop(agent_id, None)
         callback = self._callback
         for s in payload.get("statuses", []):
             try:

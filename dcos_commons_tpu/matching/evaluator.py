@@ -262,7 +262,12 @@ class Evaluator:
                 rc, rm, rd, rt = ledger.reserved_scalars(agent.agent_id)
                 reason = Availability(
                     cpus=agent.cpus - rc, memory_mb=agent.memory_mb - rm,
-                    disk_mb=agent.disk_mb - rd, tpus=agent.tpu.chips - rt,
+                    disk_mb=agent.disk_mb - rd,
+                    # a TPU-degraded host offers zero chips to NEW work —
+                    # exactly zero, not chips-rt (which can go negative
+                    # and would fail even zero-tpu requests)
+                    tpus=(0 if agent.tpu.degraded
+                          else max(0, agent.tpu.chips - rt)),
                     used_ports=set(), agent=agent).fits(*prescreen)
                 if reason is not None:
                     prescreen_skipped += 1
@@ -389,7 +394,7 @@ class Evaluator:
         per_host_chips = pod.tpu.chips
         slices: Dict[str, List[AgentInfo]] = {}
         for a in agents:
-            if a.tpu.slice_id is None or a.tpu.chips <= 0:
+            if a.tpu.slice_id is None or a.tpu.chips <= 0 or a.tpu.degraded:
                 continue
             if pod.tpu.topology and a.tpu.topology != pod.tpu.topology:
                 continue
@@ -460,6 +465,18 @@ class Evaluator:
         if gang_slice is not None and agent.tpu.slice_id != gang_slice:
             node.add(EvaluationOutcome.fail(
                 "gang", f"agent not in chosen slice {gang_slice}"))
+            return None
+
+        # stage: TPU health — a host that lost chips mid-run takes no NEW
+        # TPU work, even pinned relaunches (the in-place restart would land
+        # on the same suspect silicon; core._replace_tpu_degraded escalates
+        # those to a replace instead)
+        if agent.tpu.degraded and any(
+                pod.resource_set(rs_id).tpus > 0
+                for rs_id in _needed_resource_sets(pod, requirement)):
+            node.add(EvaluationOutcome.fail(
+                "tpu", f"agent TPU-degraded ({agent.tpu.chips} live "
+                       f"chips); not placing TPU work"))
             return None
 
         # stage: pre-reserved role

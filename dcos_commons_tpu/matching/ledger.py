@@ -132,7 +132,11 @@ class ReservationLedger:
             cpus=agent.cpus - sum(r.cpus for r in held),
             memory_mb=agent.memory_mb - sum(r.memory_mb for r in held),
             disk_mb=agent.disk_mb - sum(r.disk_mb for r in held),
-            tpus=agent.tpu.chips - sum(r.tpus for r in held),
+            # clamped at 0: a degraded host's live chip count can drop
+            # BELOW its held reservations, and a negative here would fail
+            # even zero-tpu requests (fits: want 0 > have -N) — locking
+            # CPU pods out of a host whose chips are sick, not its cores
+            tpus=max(0, agent.tpu.chips - sum(r.tpus for r in held)),
             used_ports=used_ports,
             agent=agent,
         )

@@ -112,6 +112,11 @@ class MetricsRegistry:
     def record_task_status(self, state: str) -> None:
         self.counter(f"task_status.{state.lower()}")
 
+    def record_tpu_degraded_replace(self) -> None:
+        """A pod proactively replaced off a TPU-degraded host (chip-level
+        health reaction, ``core._replace_tpu_degraded``)."""
+        self.counter("recovery.tpu_degraded_replace")
+
     # -- export ------------------------------------------------------------
 
     def to_dict(self) -> dict:

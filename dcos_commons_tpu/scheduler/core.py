@@ -48,7 +48,8 @@ from ..state.state_store import (ConfigStore, FrameworkStore, GoalOverride,
                                  OverrideProgress, SchemaVersionStore,
                                  StateStore, StateStoreError)
 from ..state.tasks import StoredTask, TaskState, TaskStatus
-from .recovery import FailureMonitor, RecoveryPlanManager, RecoveryOverrider
+from .recovery import (FailureMonitor, RecoveryPlanManager,
+                       RecoveryOverrider, needs_recovery)
 
 log = logging.getLogger(__name__)
 
@@ -403,6 +404,7 @@ class ServiceScheduler:
             # every cycle (reference ImplicitReconciler periodic pass)
             self.reconcile()
         agents = list(self.cluster.agents())
+        self._replace_tpu_degraded(agents)
         actions = 0
         for step in list(self.coordinator.get_candidates()):
             if isinstance(step, ActionStep):
@@ -658,16 +660,64 @@ class ServiceScheduler:
         """Mark permanently failed + kill; recovery replaces elsewhere
         (reference ``pod replace`` -> ``FailureUtils.setPermanentlyFailed``,
         SURVEY.md section 3.4)."""
-        touched = []
         with self._lock:
-            for task_name in self.pod_instance_task_names(pod_instance_name):
-                task = self.state.fetch_task(task_name)
-                if task is None:
-                    continue
-                self.state.store_tasks([task.failed_permanently()])
-                self._kill_if_running(task_name)
-                touched.append(task_name)
+            return self._replace_pod_locked(pod_instance_name)
+
+    def _replace_pod_locked(self, pod_instance_name: str) -> List[str]:
+        touched = []
+        for task_name in self.pod_instance_task_names(pod_instance_name):
+            task = self.state.fetch_task(task_name)
+            if task is None:
+                continue
+            self.state.store_tasks([task.failed_permanently()])
+            self._kill_if_running(task_name)
+            touched.append(task_name)
         return touched
+
+    def _replace_tpu_degraded(self, agents) -> None:
+        """Chip-level health reaction (SURVEY.md §5): a TPU pod with a
+        member on a host that lost chips is proactively replaced — for
+        gang pods the recovery manager re-forms the whole gang — instead
+        of waiting for the member to crash on the dead silicon. Runs every
+        cycle; marking the tasks permanently-failed is what keeps it
+        one-shot per incident (marked tasks are skipped on re-scan), and
+        the evaluator's TPU-health stage keeps the replacement off the
+        degraded host."""
+        degraded = {a.agent_id for a in agents
+                    if a.tpu is not None and a.tpu.degraded}
+        if not degraded:
+            return
+        tpu_pods = {p.type for p in self.spec.pods
+                    if any(rs.tpus > 0 for rs in p.resource_sets)}
+        if not tpu_pods:
+            return
+        replaced = set()
+        for task in self.state.fetch_tasks():
+            if (task.agent_id not in degraded or task.permanently_failed
+                    or task.pod_type not in tpu_pods
+                    or task.pod_instance_name in replaced):
+                continue
+            status = self.state.fetch_status(task.task_name)
+            if (status is not None and status.task_id == task.task_id
+                    and status.state.terminal
+                    and not needs_recovery(task, status)):
+                # cleanly-FINISHED ONCE work: recovery would never act on
+                # it — marking it would only emit a phantom replace metric
+                # and flip the pod's next re-run into replace_mode
+                continue
+            # already-CRASHED tasks do get marked (no terminal skip):
+            # TRANSIENT recovery would pin the relaunch to this degraded
+            # host, which the evaluator's TPU-health stage refuses — the
+            # pod would wedge. The permanent marker flips the pending
+            # recovery into an un-pinned replace (evaluator replace_mode
+            # reads the marker, not the step's recovery_type).
+            replaced.add(task.pod_instance_name)
+            log.warning(
+                "agent %s is TPU-degraded: proactively replacing pod %s",
+                task.agent_id, task.pod_instance_name)
+            if self.metrics is not None:
+                self.metrics.record_tpu_degraded_replace()
+            self._replace_pod_locked(task.pod_instance_name)
 
 
 def task_grace_period(requirement, task: StoredTask) -> float:

@@ -104,7 +104,15 @@ struct Config {
   long memory_mb = 0;
   long disk_mb = 0;
   long port_lo = 10000, port_hi = 20000;
-  int tpu_chips = -1;  // -1: probe /dev/accel*
+  int tpu_chips = -1;  // -1: probe <tpu_probe_dir>/accel*
+  // chip-level health (SURVEY.md §5): when probing is active the agent
+  // re-probes every poll and reports {tpu_health: {chips}} so the
+  // scheduler notices a chip falling off the bus without waiting for the
+  // task to crash. The dir override is the test hook for simulating
+  // hot-unplug against the real binary (point it at a tmp dir, remove an
+  // accelN file mid-run).
+  std::string tpu_probe_dir = "/dev";
+  bool tpu_probe = false;  // set when chips were probed or dir overridden
   std::string slice_id, topology, zone, region;
   std::vector<std::string> volume_profiles;  // mount-disk profiles served
   std::vector<std::string> roles = {"*"};    // reservation role pools
@@ -116,11 +124,11 @@ struct Config {
   long max_polls = -1;  // test hook: exit after N polls (-1 = forever)
 };
 
-int probe_tpu_chips() {
+int probe_tpu_chips(const std::string& dir = "/dev") {
   // TPU VM chips appear as /dev/accel0..N (PJRT libtpu contract)
   int count = 0;
   for (int i = 0; i < 64; ++i) {
-    std::string path = "/dev/accel" + std::to_string(i);
+    std::string path = dir + "/accel" + std::to_string(i);
     if (access(path.c_str(), F_OK) == 0) {
       ++count;
     }
@@ -383,6 +391,20 @@ class Agent {
     for (auto& s : pending_statuses_) statuses.push_back(s);
     Json body = Json::object();
     body.set("running_task_ids", running).set("statuses", statuses);
+    if (cfg_.tpu_probe) {
+      // re-probe every poll (a handful of access() calls at 1 Hz): the
+      // scheduler compares against registered inventory and degrades the
+      // host on chip loss (agent/remote.py poll handler)
+      Json th = Json::object();
+      if (access(cfg_.tpu_probe_dir.c_str(), F_OK) != 0) {
+        th.set("chips", 0.0);
+        th.set("error", "probe dir missing: " + cfg_.tpu_probe_dir);
+      } else {
+        th.set("chips",
+               static_cast<double>(probe_tpu_chips(cfg_.tpu_probe_dir)));
+      }
+      body.set("tpu_health", th);
+    }
 
     std::string url =
         cfg_.scheduler_url + "/v1/agents/" + cfg_.agent_id + "/poll";
@@ -1012,6 +1034,8 @@ void usage(const char* argv0) {
       << "  --cpus N --memory-mb N --disk-mb N   advertised resources\n"
       << "  --ports LO-HI       advertised port range\n"
       << "  --tpu-chips N       TPU chips (default: probe /dev/accel*)\n"
+      << "  --tpu-probe-dir D   probe D/accel* instead of /dev/accel* and\n"
+         "                      re-probe every poll (chip-health test hook)\n"
       << "  --slice-id S --topology T --worker-index N   ICI identity\n"
       << "  --zone Z --region R\n"
       << "  --attribute K=V     freeform host attribute (repeatable; "
@@ -1057,6 +1081,10 @@ int main(int argc, char** argv) {
       cfg.port_lo = std::stol(v.substr(0, dash));
       cfg.port_hi = std::stol(v.substr(dash + 1));
     } else if (a == "--tpu-chips") cfg.tpu_chips = std::stoi(next());
+    else if (a == "--tpu-probe-dir") {
+      cfg.tpu_probe_dir = next();
+      cfg.tpu_probe = true;
+    }
     else if (a == "--slice-id") cfg.slice_id = next();
     else if (a == "--topology") cfg.topology = next();
     else if (a == "--worker-index") cfg.worker_index = std::stoi(next());
@@ -1095,7 +1123,13 @@ int main(int argc, char** argv) {
     }
   }
   if (cfg.agent_id.empty()) cfg.agent_id = cfg.hostname;
-  if (cfg.tpu_chips < 0) cfg.tpu_chips = probe_tpu_chips();
+  if (cfg.tpu_chips < 0) {
+    cfg.tpu_chips = probe_tpu_chips(cfg.tpu_probe_dir);
+    // probed inventory stays live: re-probe + report health every poll.
+    // An explicit --tpu-chips N without a probe dir stays static (dev
+    // boxes advertise synthetic chips with no /dev/accel* to probe).
+    cfg.tpu_probe = true;
+  }
   mkdirs(cfg.base_dir);
 
   signal(SIGPIPE, SIG_IGN);

@@ -216,6 +216,57 @@ def test_agent_death_marks_tasks_lost_after_grace(native_bins, tmp_path):
         server.stop()
 
 
+def test_agent_reprobes_tpu_chips_and_reports_health(native_bins, tmp_path):
+    """Chip-level health against the real binary: the agent probes
+    <dir>/accel* every poll; removing a device file mid-run must surface
+    as a degraded agent at the scheduler (SURVEY.md §5), and restoring it
+    must clear the mark."""
+    probe_dir = tmp_path / "devs"
+    probe_dir.mkdir()
+    (probe_dir / "accel0").touch()
+    (probe_dir / "accel1").touch()
+
+    cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.05)
+    sched = ServiceScheduler(load_service_yaml_str(YML), MemPersister(),
+                             cluster)
+    server = ApiServer(sched, port=0, cluster=cluster)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    agent = subprocess.Popen(
+        [str(native_bins / "tpu-agent"), "--scheduler", url,
+         "--agent-id", "chips", "--cpus", "4", "--memory-mb", "4096",
+         "--disk-mb", "10000", "--base-dir", str(tmp_path / "sb"),
+         "--poll-interval", "0.05",
+         "--tpu-probe-dir", str(probe_dir)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        def registered():
+            agents = cluster.agents()
+            return agents[0] if agents else None
+        info = wait_for(registered, message="agent registered")
+        assert info.tpu.chips == 2 and not info.tpu.degraded
+
+        (probe_dir / "accel1").unlink()    # chip falls off the bus
+
+        def degraded():
+            agents = cluster.agents()
+            return agents and agents[0].tpu.degraded
+        wait_for(degraded, timeout=10, message="degraded after chip loss")
+        assert cluster.agents()[0].tpu.chips == 1
+
+        (probe_dir / "accel1").touch()     # driver reload brings it back
+
+        def recovered():
+            agents = cluster.agents()
+            return agents and not agents[0].tpu.degraded
+        wait_for(recovered, timeout=10, message="health recovered")
+        assert cluster.agents()[0].tpu.chips == 2
+    finally:
+        agent.terminate()
+        agent.wait(timeout=5)
+        server.stop()
+
+
 # ---------------------------------------------------------------- bootstrap
 
 def test_bootstrap_template_render(native_bins, tmp_path):

@@ -1,0 +1,292 @@
+"""Chip-level TPU health (SURVEY.md §5 failure detection): the agent
+re-probes its chips every poll; the scheduler degrades hosts that lost
+chips, refuses them new TPU work, and proactively re-forms gangs with a
+member on degraded silicon — before any task crashes.
+
+Reference analogue: task health checks + partition-aware status mapping
+(``sdk/scheduler/.../plan/DeploymentStep.java:185-197``); chip-level
+probing is TPU-specific (Mesos never looked below the task)."""
+
+from dcos_commons_tpu.agent import (AgentInfo, FakeCluster, RemoteCluster,
+                                    TpuInventory)
+from dcos_commons_tpu.metrics import MetricsRegistry
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler import ServiceScheduler
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import MemPersister, TaskState
+
+GANG_YML = """
+name: jax
+pods:
+  worker:
+    count: 2
+    tpu: {chips: 4, topology: v4-16}
+    resource-sets:
+      wres: {cpus: 2, memory: 4096, tpus: 4}
+    tasks:
+      train: {goal: RUNNING, cmd: python train.py, resource-set: wres}
+"""
+
+MIXED_YML = """
+name: mixed
+pods:
+  web:
+    count: 1
+    tasks:
+      server: {goal: RUNNING, cmd: ./serve, cpus: 0.5, memory: 256}
+  solo:
+    count: 1
+    tpu: {chips: 4}
+    resource-sets:
+      r: {cpus: 1, memory: 1024, tpus: 4}
+    tasks:
+      train: {goal: RUNNING, cmd: ./train, resource-set: r}
+"""
+
+
+def tpu_agents(n, slice_id="s0", topology="v4-16"):
+    return [AgentInfo(agent_id=f"t{i}", hostname=f"tpu{i}", cpus=8,
+                      memory_mb=32768, disk_mb=32768,
+                      tpu=TpuInventory(chips=4, slice_id=slice_id,
+                                       topology=topology, coords=(i, 0, 0),
+                                       worker_index=i))
+            for i in range(n)]
+
+
+# ------------------------------------------------------- transport level
+
+class TestRemoteClusterHealth:
+    def _register(self, rc, chips=4):
+        rc.register({"agent_id": "a1", "hostname": "h1", "cpus": 8,
+                     "memory_mb": 32768, "tpu": {"chips": chips,
+                                                 "slice_id": "s0"}})
+
+    def test_chip_loss_degrades_and_recovery_clears(self):
+        rc = RemoteCluster(expiry_s=60)
+        self._register(rc)
+        rc.poll("a1", {"tpu_health": {"chips": 4}})
+        (a,) = rc.agents()
+        assert not a.tpu.degraded and a.tpu.chips == 4
+
+        rc.poll("a1", {"tpu_health": {"chips": 2}})   # chip fell off
+        (a,) = rc.agents()
+        assert a.tpu.degraded and a.tpu.chips == 2
+
+        rc.poll("a1", {"tpu_health": {"chips": 4}})   # driver reload
+        (a,) = rc.agents()
+        assert not a.tpu.degraded and a.tpu.chips == 4
+
+    def test_probe_error_degrades_to_zero(self):
+        rc = RemoteCluster(expiry_s=60)
+        self._register(rc)
+        rc.poll("a1", {"tpu_health": {"chips": 0,
+                                      "error": "probe dir missing"}})
+        (a,) = rc.agents()
+        assert a.tpu.degraded and a.tpu.chips == 0
+
+    def test_reregistration_resets_health(self):
+        rc = RemoteCluster(expiry_s=60)
+        self._register(rc)
+        rc.poll("a1", {"tpu_health": {"chips": 1}})
+        assert rc.agents()[0].tpu.degraded
+        # agent restarts and re-registers advertising 1 chip: that IS its
+        # inventory now, not a degradation
+        self._register(rc, chips=1)
+        (a,) = rc.agents()
+        assert not a.tpu.degraded and a.tpu.chips == 1
+
+    def test_polls_without_health_never_degrade(self):
+        # agents with static --tpu-chips (no probing) send no tpu_health
+        rc = RemoteCluster(expiry_s=60)
+        self._register(rc)
+        rc.poll("a1", {})
+        assert not rc.agents()[0].tpu.degraded
+
+
+# ------------------------------------------------------ scheduler level
+
+class TestDegradedReaction:
+    def test_gang_reformed_before_any_task_exits(self):
+        """The headline e2e: a chip drops out under a RUNNING gang member
+        -> the scheduler replaces the whole gang proactively; the member's
+        task never reports a failure itself."""
+        sched = ServiceScheduler(load_service_yaml_str(GANG_YML, {}),
+                                 MemPersister(),
+                                 FakeCluster(tpu_agents(3)),
+                                 metrics=MetricsRegistry())
+        cluster = sched.cluster
+        sched.run_until_quiet()
+        assert sched.plan("deploy").status is Status.COMPLETE
+        w1_before = sched.state.fetch_task("worker-1-train")
+        w0_before = sched.state.fetch_task("worker-0-train")
+
+        cluster.degrade_tpu(w1_before.agent_id, chips_now=2)
+        sched.run_until_quiet()
+
+        w1_after = sched.state.fetch_task("worker-1-train")
+        w0_after = sched.state.fetch_task("worker-0-train")
+        # worker-1 moved off the degraded host; worker-0 re-formed in place
+        assert w1_after.agent_id != w1_before.agent_id
+        assert w0_after.task_id != w0_before.task_id
+        assert w0_after.agent_id == w0_before.agent_id
+        # ranks stable across the re-form
+        assert w0_after.tpu.process_id == 0
+        assert w1_after.tpu.process_id == 1
+        assert sched.state.fetch_status(
+            "worker-0-train").state is TaskState.RUNNING
+        assert sched.state.fetch_status(
+            "worker-1-train").state is TaskState.RUNNING
+        # proactive: the kill was scheduler-initiated (the old task was
+        # still running when the replace began)
+        assert w1_before.task_id in cluster.kill_log
+        assert sched.metrics.to_dict()["counters"][
+            "recovery.tpu_degraded_replace"] >= 1
+
+    def test_reaction_is_one_shot_while_degraded(self):
+        sched = ServiceScheduler(load_service_yaml_str(GANG_YML, {}),
+                                 MemPersister(),
+                                 FakeCluster(tpu_agents(3)),
+                                 metrics=MetricsRegistry())
+        cluster = sched.cluster
+        sched.run_until_quiet()
+        victim_agent = sched.state.fetch_task("worker-1-train").agent_id
+        cluster.degrade_tpu(victim_agent, chips_now=0)
+        sched.run_until_quiet()
+        replaced_once = sched.metrics.to_dict()["counters"][
+            "recovery.tpu_degraded_replace"]
+        # the host stays degraded; extra cycles must not replace again
+        sched.run_until_quiet()
+        sched.run_until_quiet()
+        assert sched.metrics.to_dict()["counters"][
+            "recovery.tpu_degraded_replace"] == replaced_once
+
+    def test_crashed_before_detection_still_replaced(self):
+        """Chip dies and the task crashes BEFORE the degradation poll
+        lands: a TRANSIENT relaunch would pin to the degraded host (which
+        the evaluator refuses) and wedge — the reaction must mark the
+        crashed task permanently-failed so recovery replaces it
+        elsewhere."""
+        sched = ServiceScheduler(load_service_yaml_str(GANG_YML, {}),
+                                 MemPersister(),
+                                 FakeCluster(tpu_agents(3)),
+                                 metrics=MetricsRegistry())
+        cluster = sched.cluster
+        sched.run_until_quiet()
+        victim = sched.state.fetch_task("worker-1-train")
+        # the task crashes first (FAILED status delivered)...
+        ft = cluster.task("worker-1-train")
+        cluster.send_status(ft.task_id, TaskState.FAILED,
+                            message="chip fell off mid-step")
+        # ...and only then does the degradation surface
+        cluster.degrade_tpu(victim.agent_id, chips_now=2)
+        sched.run_until_quiet()
+        w1 = sched.state.fetch_task("worker-1-train")
+        assert w1.agent_id != victim.agent_id
+        assert sched.state.fetch_status(
+            "worker-1-train").state is TaskState.RUNNING
+
+    def test_degraded_host_with_stale_tpu_reservation_serves_cpu_pods(self):
+        """A degraded host whose live chip count fell BELOW its held TPU
+        reservations must still take CPU-only pods (negative availability
+        must not fail want-0 requests)."""
+        agents = tpu_agents(1) + [
+            AgentInfo(agent_id="c0", hostname="cpu0", cpus=1,
+                      memory_mb=2048, disk_mb=8192)]
+        yml = """
+name: mixed2
+pods:
+  solo:
+    count: 1
+    tpu: {chips: 4}
+    resource-sets:
+      r: {cpus: 1, memory: 1024, tpus: 4}
+    tasks:
+      train: {goal: RUNNING, cmd: ./train, resource-set: r}
+"""
+        sched = ServiceScheduler(load_service_yaml_str(yml, {}),
+                                 MemPersister(), FakeCluster(agents))
+        cluster = sched.cluster
+        sched.run_until_quiet()   # solo lands on t0, reserving 4 chips
+        assert sched.state.fetch_task("solo-0-train").agent_id == "t0"
+        # chips collapse below the held 4-chip reservation (1 - 4 = -3):
+        # at this instant — before the proactive replace GCs anything —
+        # zero-tpu work must still fit the host
+        cluster.degrade_tpu("t0", chips_now=1)
+        t0 = next(a for a in cluster.agents() if a.agent_id == "t0")
+        avail = sched.ledger.available(t0)
+        assert avail.tpus == 0                      # clamped, not negative
+        assert avail.fits(0.5, 256, 0, 0) is None   # CPU pod fits
+
+    def test_finished_once_work_not_phantom_replaced(self):
+        """A TPU pod whose ONCE task already FINISHED on the host before
+        it degraded: recovery would never act on it, so the reaction must
+        not mark it / count a replace (phantom metric + a marker that
+        would flip its next re-run into replace_mode)."""
+        yml = """
+name: oncejob
+pods:
+  prep:
+    count: 1
+    tpu: {chips: 4, gang: false}
+    resource-sets:
+      r: {cpus: 1, memory: 1024, tpus: 4}
+    tasks:
+      compile: {goal: ONCE, cmd: ./compile, resource-set: r}
+"""
+        sched = ServiceScheduler(load_service_yaml_str(yml, {}),
+                                 MemPersister(),
+                                 FakeCluster(tpu_agents(2)),
+                                 metrics=MetricsRegistry())
+        cluster = sched.cluster
+        sched.run_until_quiet()
+        task = sched.state.fetch_task("prep-0-compile")
+        assert sched.state.fetch_status(
+            "prep-0-compile").state is TaskState.FINISHED
+        cluster.degrade_tpu(task.agent_id, chips_now=1)
+        sched.run_until_quiet()
+        counters = sched.metrics.to_dict()["counters"]
+        assert "recovery.tpu_degraded_replace" not in counters
+        assert not sched.state.fetch_task(
+            "prep-0-compile").permanently_failed
+
+    def test_degraded_host_refused_for_new_tpu_work_only(self):
+        """A degraded host takes no NEW TPU pods but keeps serving
+        CPU-only pods (the chips are sick, not the host)."""
+        agents = tpu_agents(2)
+        sched = ServiceScheduler(load_service_yaml_str(MIXED_YML, {}),
+                                 MemPersister(), FakeCluster(agents))
+        cluster = sched.cluster
+        # degrade t0 BEFORE anything deploys
+        cluster.degrade_tpu("t0", chips_now=2)
+        sched.run_until_quiet()
+        assert sched.plan("deploy").status is Status.COMPLETE
+        solo = sched.state.fetch_task("solo-0-train")
+        assert solo.agent_id == "t1"   # TPU pod avoided the degraded host
+        # CPU pod may land anywhere, including the degraded host
+        assert sched.state.fetch_status(
+            "web-0-server").state is TaskState.RUNNING
+
+    def test_no_spare_capacity_waits_with_reason(self):
+        """With nowhere to move the gang, the deploy/recovery WAITS (the
+        all-or-nothing refusal is visible) instead of flapping."""
+        sched = ServiceScheduler(load_service_yaml_str(GANG_YML, {}),
+                                 MemPersister(),
+                                 FakeCluster(tpu_agents(2)),
+                                 metrics=MetricsRegistry())
+        cluster = sched.cluster
+        sched.run_until_quiet()
+        victim_agent = sched.state.fetch_task("worker-1-train").agent_id
+        cluster.degrade_tpu(victim_agent, chips_now=0)
+        sched.run_until_quiet()
+        # replacement cannot land: only 1 healthy host for a 2-host gang
+        status = sched.state.fetch_status("worker-1-train")
+        assert status.state is not TaskState.RUNNING
+        summary = sched.outcome_tracker.to_dict()["failure_summary"]
+        assert any("TPU" in k or "tpu" in k or "slice" in k
+                   for k in summary)
+        # chips recover -> gang re-forms on its own
+        cluster._agents[victim_agent] = tpu_agents(3)[int(
+            victim_agent[1:])]
+        sched.run_until_quiet()
+        assert sched.state.fetch_status(
+            "worker-1-train").state is TaskState.RUNNING
