@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dcos_commons_tpu.ops import (apply_rope, gqa_attention, repeat_kv,
                                   rms_norm, rope_frequencies,
                                   softmax_cross_entropy)
+from dcos_commons_tpu.ops.quant import QTensor, qmm, qtake, quantize
 from dcos_commons_tpu.parallel.ring_attention import make_ring_attention
 from dcos_commons_tpu.parallel.ulysses import make_ulysses_attention
 
@@ -129,10 +130,75 @@ def param_specs(cfg: LlamaConfig) -> Params:
     }
 
 
+def _scale_spec(spec: P, s_shape: Tuple[int, ...]) -> P:
+    """Sharding for a QTensor's scales: the weight's spec with the
+    collapsed (size-1) axes unsharded — so e.g. a row-parallel ``wo``
+    keeps its scales replicated while a column-parallel ``wq`` shards
+    them along tp with the payload's out-channel axis."""
+    entries = list(spec) + [None] * (len(s_shape) - len(spec))
+    return P(*[None if s_shape[i] == 1 else entries[i]
+               for i in range(len(s_shape))])
+
+
 def shard_params(params: Params, mesh: Mesh, cfg: LlamaConfig) -> Params:
     specs = param_specs(cfg)
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+    def put(x, sp):
+        return jax.device_put(x, NamedSharding(mesh, sp))
+
+    def put_leaf(p, sp):
+        if isinstance(p, QTensor):
+            return QTensor(put(p.q, sp),
+                           put(p.s, _scale_spec(sp, p.s.shape)))
+        return put(p, sp)
+
+    return jax.tree.map(put_leaf, params, specs,
+                        is_leaf=lambda x: isinstance(x, QTensor))
+
+
+def quantize_params(params: Params) -> Params:
+    """Weight-only int8 (``ops.quant``) for the DENSE decoder's serving
+    path: matmul weights quantize per-out-channel (reduction axis -2),
+    the embedding table per row; norm gains stay high-precision — a
+    negligible byte count and numerically load-bearing. MoE trees are
+    rejected: the expert banks feed ``parallel.moe`` einsums that consume
+    raw arrays (EP serving shards experts across hosts instead of
+    squeezing one chip, so quantizing them buys nothing today)."""
+    if "router" in params["layers"]:
+        raise ValueError(
+            "quantize_params supports the dense decoder only; "
+            "MoE expert banks are not quantizable (parallel.moe)")
+    keep = ("attn_norm", "ffn_norm")
+    layers = {k: (v if k in keep else quantize(v, axis=-2))
+              for k, v in params["layers"].items()}
+    return {"embed": quantize(params["embed"], axis=-1),
+            "layers": layers,
+            "norm": params["norm"],
+            "lm_head": quantize(params["lm_head"], axis=-2)}
+
+
+def init_quantized_params(cfg: LlamaConfig, key: jax.Array,
+                          device=None) -> Params:
+    """Initialize + quantize WITHOUT materializing bf16 weights on the
+    accelerator: generation and quantization run on the host CPU backend,
+    only int8 payloads + scales transfer. An 8B config lands at ~8 GB
+    on-device; a device-side init-then-quantize would need bf16 + int8
+    resident at once (~24 GB) and cannot fit a 16 GB v5e chip."""
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError as e:
+        raise RuntimeError(
+            "init_quantized_params needs the host cpu backend to stream "
+            "weights (set JAX_PLATFORMS to include cpu, e.g. 'tpu,cpu'): "
+            f"{e}") from e
+    with jax.default_device(cpu):
+        qparams = quantize_params(init_params(cfg, key))
+        # force host materialization before any transfer below
+        qparams = jax.block_until_ready(qparams)
+    if device is not None:
+        qparams = jax.tree.map(lambda x: jax.device_put(x, device),
+                               qparams)
+    return qparams
 
 
 # ---------------------------------------------------------------------------
@@ -192,13 +258,13 @@ def attention_block(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
     contract, identical to what ``decode_step`` writes)."""
     b, s, _ = x.shape
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    k = (h @ lp["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ lp["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = qmm(h, lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = qmm(h, lp["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = qmm(h, lp["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, rope)
     k = apply_rope(k, rope)
     o = attn_fn(q, k, v)  # GQA expansion is the impl's business
-    out = x + o.reshape(b, s, -1) @ lp["wo"]
+    out = x + qmm(o.reshape(b, s, -1), lp["wo"])
     if return_kv:
         return out, k, v
     return out
@@ -207,9 +273,9 @@ def attention_block(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
 def ffn_block(cfg: LlamaConfig, x: jnp.ndarray, lp: Params) -> jnp.ndarray:
     """Pre-norm SwiGLU residual step on x [B, S, D]."""
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
-    up = (h @ lp["w_up"]).astype(jnp.float32)
-    return x + ((gate * up).astype(cfg.dtype) @ lp["w_down"])
+    gate = jax.nn.silu(qmm(h, lp["w_gate"]).astype(jnp.float32))
+    up = qmm(h, lp["w_up"]).astype(jnp.float32)
+    return x + qmm((gate * up).astype(cfg.dtype), lp["w_down"])
 
 
 def apply_layer(cfg: LlamaConfig, x: jnp.ndarray, lp: Params,
@@ -239,7 +305,7 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     attn_fn = _make_attn_fn(cfg, mesh)
 
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = qtake(params["embed"], tokens, cfg.dtype)
     x = _constrain(x, mesh, "dp", "sp", None)
 
     def layer(x, lp):
@@ -248,7 +314,7 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     body = _maybe_checkpoint(layer, cfg)
     x, _ = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = qmm(x, params["lm_head"]).astype(jnp.float32)
     return _constrain(logits, mesh, "dp", "sp", None)
 
 
@@ -427,32 +493,32 @@ def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
     if rope is None:
         rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
 
-    x = params["embed"].astype(cfg.dtype)[token][:, None, :]   # [B, 1, D]
+    x = qtake(params["embed"], token, cfg.dtype)[:, None, :]   # [B, 1, D]
 
     def layer(carry, inputs):
         x, layer_idx = carry
         lp, k_cache, v_cache = inputs
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
-        k = (h @ lp["wk"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ lp["wv"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = qmm(h, lp["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        k = qmm(h, lp["wk"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = qmm(h, lp["wv"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, rope, pos)
         k = apply_rope(k, rope, pos)
         k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
         v_cache = lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
         o = gqa_attention(q, k_cache, v_cache, causal=False,
                           q_offset=pos, kv_len=pos + 1)
-        x = x + o.reshape(b, 1, -1) @ lp["wo"]
+        x = x + qmm(o.reshape(b, 1, -1), lp["wo"])
         h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
-        up = (h @ lp["w_up"]).astype(jnp.float32)
-        x = x + ((gate * up).astype(cfg.dtype) @ lp["w_down"])
+        gate = jax.nn.silu(qmm(h, lp["w_gate"]).astype(jnp.float32))
+        up = qmm(h, lp["w_up"]).astype(jnp.float32)
+        x = x + qmm((gate * up).astype(cfg.dtype), lp["w_down"])
         return (x, layer_idx + 1), (k_cache, v_cache)
 
     (x, _), (k_new, v_new) = lax.scan(
         layer, (x, 0), (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["norm"], cfg.norm_eps)
-    logits = (x[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    logits = qmm(x[:, 0, :], params["lm_head"]).astype(jnp.float32)
     return logits, {"k": k_new, "v": v_new}
 
 
@@ -478,7 +544,7 @@ def prefill(cfg: LlamaConfig, params: Params, cache: Params,
     # matches decode_step exactly, and ring/ulysses shard_map impls
     # require sp-divisible sequence lengths — prompts are arbitrary
     attn_fn = (lambda q, k, v: gqa_attention(q, k, v, causal=True))
-    x = params["embed"].astype(cfg.dtype)[prompt]
+    x = qtake(params["embed"], prompt, cfg.dtype)
     x = _constrain(x, mesh, "dp", None, None)
 
     def layer(x, lp):
@@ -489,7 +555,7 @@ def prefill(cfg: LlamaConfig, params: Params, cache: Params,
 
     x, (ks, vs) = lax.scan(layer, x, params["layers"])
     x = rms_norm(x, params["norm"], cfg.norm_eps)
-    logits = (x[:, -1, :] @ params["lm_head"]).astype(jnp.float32)
+    logits = qmm(x[:, -1, :], params["lm_head"]).astype(jnp.float32)
     cache = {
         "k": lax.dynamic_update_slice_in_dim(cache["k"], ks, 0, axis=2),
         "v": lax.dynamic_update_slice_in_dim(cache["v"], vs, 0, axis=2),

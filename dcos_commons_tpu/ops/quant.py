@@ -1,0 +1,113 @@
+"""Weight-only int8 quantization for TPU serving.
+
+The standard v5e serving recipe: weights stored as int8 with per-channel
+bf16 scales, activations stay bf16. Decode at real model sizes is
+HBM-bandwidth bound (every token streams the full weight set), so halving
+the bytes per weight is ~2x decode throughput — and it is what lets an
+8B-parameter model (16 GB in bf16) fit a single 16 GB-HBM chip at all
+(~8 GB quantized + KV cache).
+
+Design:
+
+* :class:`QTensor` — a registered pytree node ``(q: int8, s: scale)``.
+  Because it is a pytree container, quantized weights flow through
+  ``lax.scan`` (the stacked-layer decode loop slices the leading L axis
+  of both payload and scales), ``jax.device_put``, and the sharded
+  checkpoint engine without special cases.
+* Symmetric per-channel scales with ``keepdims``: the scale tensor has
+  the same rank as the weight with the quantized (reduction) axis size 1,
+  so it broadcasts against matmul *outputs* — ``x @ dequant(w)`` equals
+  ``(x @ w.q) * w.s`` exactly when ``s`` is per-out-channel, which keeps
+  the matmul itself on the MXU in bf16 with the int8->bf16 convert fused
+  into the weight load by XLA (no dequantized copy ever materializes in
+  HBM).
+* :func:`qmm` / :func:`qtake` accept plain arrays too, so model code has
+  ONE path for quantized and unquantized weights.
+
+Reference parity: the reference repo (Java control plane) ships no
+quantization; this is the execute-side half of BASELINE.json config #5
+("Llama-3-8B inference") on single-chip v5e hardware.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class QTensor:
+    """Int8 payload + broadcastable scales; quantized axis has size 1 in
+    ``s``. Behaves as a pytree container of (q, s)."""
+
+    q: Array  # int8, original weight shape
+    s: Array  # scale, same rank, quantized axis collapsed to 1
+
+    def tree_flatten(self):
+        return (self.q, self.s), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        # the *logical* dtype models compute in, not the storage dtype
+        return self.s.dtype
+
+
+QArray = Union[Array, QTensor]
+
+
+def quantize(w: Array, axis: int = -2,
+             scale_dtype: Any = jnp.bfloat16) -> QTensor:
+    """Symmetric per-channel int8: ``axis`` is the axis folded into each
+    scale group (the matmul reduction axis for ``x @ w`` weights; the
+    embedding dim for gather tables)."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, s=s.astype(scale_dtype))
+
+
+def dequantize(w: QTensor, dtype: Any = None) -> Array:
+    dtype = dtype or w.s.dtype
+    return (w.q.astype(jnp.float32)
+            * w.s.astype(jnp.float32)).astype(dtype)
+
+
+def qmm(x: Array, w: QArray) -> Array:
+    """``x @ w`` for plain or quantized ``w``.
+
+    Quantized path: matmul against the int8 payload cast to ``x.dtype``
+    (XLA fuses the convert into the weight load), then scale the output —
+    exact for per-out-channel scales, since the scale is constant along
+    the reduction axis. Under GSPMD a row-sharded (reduction-axis) ``w``
+    all-reduces the partial products *before* the scale multiply, which
+    is the mathematically correct order.
+    """
+    if isinstance(w, QTensor):
+        # s is [..., 1, out]; drop the collapsed reduction axis so it
+        # broadcasts against the matmul output's trailing [out] dim
+        return (x @ w.q.astype(x.dtype)) * jnp.squeeze(
+            w.s, axis=-2).astype(x.dtype)
+    return x @ w
+
+
+def qtake(w: QArray, idx: Array, dtype: Any) -> Array:
+    """Embedding lookup ``w[idx]`` for plain or quantized tables (tables
+    quantize per *row*, so the gathered rows carry their own scales)."""
+    if isinstance(w, QTensor):
+        return w.q[idx].astype(dtype) * w.s[idx].astype(dtype)
+    return w.astype(dtype)[idx]

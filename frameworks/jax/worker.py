@@ -217,22 +217,42 @@ def run_llama(args) -> dict:
     contract = distributed.initialize()
     n = jax.device_count()
     if args.preset == "8b":
-        cfg = llama.LlamaConfig.llama3_8b()
+        # serving KV budget: 2048 default (0.5 GB at 8B) unless overridden;
+        # weights only fit one chip quantized (~8.5 GB int8 vs 16 GB bf16)
+        cfg = llama.LlamaConfig.llama3_8b(max_seq=args.max_seq or 2048,
+                                          remat=False)
+    elif args.max_seq:
+        cfg = llama.LlamaConfig.tiny(max_seq=args.max_seq)
     else:
         cfg = llama.LlamaConfig.tiny()
     mesh = MeshSpec(tp=n).build()
     gen_len = args.gen_len
+    # stepwise for the big preset: the fused nested-scan generate takes
+    # minutes to compile at 8B through tunneled backends; per-token
+    # dispatch is hidden behind HBM-bound weight streaming anyway
+    stepwise = args.preset == "8b" or args.quant != "none"
 
     def timed_decode(prompt):
         # prompt must stay (1, 4) int32 so the compiled executable is reused
         t0 = time.perf_counter()
         with mesh:
-            toks = llama.generate(cfg, params, prompt, gen_len, mesh=mesh)
+            if stepwise:
+                toks = llama.generate_stepwise(cfg, params, prompt,
+                                               gen_len, mesh=mesh)
+            else:
+                toks = llama.generate(cfg, params, prompt, gen_len,
+                                      mesh=mesh)
         jax.block_until_ready(toks)
         return round(gen_len / max(time.perf_counter() - t0, 1e-9), 2)
 
     with mesh:
-        params = llama.init_params(cfg, jax.random.key(0))
+        if args.quant == "int8":
+            # init + quantize on host CPU, stream int8 shards to devices —
+            # never materializes bf16 weights on-chip (models/llama.py:
+            # init_quantized_params)
+            params = llama.init_quantized_params(cfg, jax.random.key(0))
+        else:
+            params = llama.init_params(cfg, jax.random.key(0))
         params = llama.shard_params(params, mesh, cfg)
     prompt = jnp.array([[1, 2, 3, 4]], dtype=jnp.int32)
     timed_decode(prompt)  # warmup/compile
@@ -242,7 +262,11 @@ def run_llama(args) -> dict:
         os.makedirs(args.out, exist_ok=True)
     with open("serving.ready", "w") as f:
         f.write("ok\n")
+    weight_gb = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(params)
+    ) / 1e9
     result = {"workload": "llama", "preset": args.preset,
+              "quant": args.quant, "weight_gb": round(weight_gb, 2),
               "tokens_per_sec": tokens_per_sec,
               "tp": n, "process_id": contract["process_id"]}
     if args.serve:
@@ -456,6 +480,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--depth", type=int, default=50,
                    help="resnet depth (18 for CPU smoke tests)")
     p.add_argument("--preset", default="tiny", choices=["tiny", "8b"])
+    p.add_argument("--quant", default="none", choices=["none", "int8"],
+                   help="llama: weight-only int8 serving (ops/quant.py); "
+                        "required to fit the 8b preset on one 16 GB chip")
+    p.add_argument("--max-seq", type=int, default=0,
+                   help="llama: KV-cache length override (0 = preset "
+                        "default; 8b serving defaults to 2048)")
     p.add_argument("--gen-len", type=int, default=16)
     p.add_argument("--serve", action="store_true",
                    help="llama: keep serving after warmup (RUNNING goal)")
@@ -497,6 +527,13 @@ def main(argv=None) -> int:
     want_platform = os.environ.get("JAX_PLATFORMS")
     if want_platform:
         import jax
+        # keep the host cpu platform available ALONGSIDE the requested
+        # one: quantized init (llama.init_quantized_params) streams
+        # weights through the cpu backend, and jax_platforms is a
+        # priority list — the first entry stays the default backend, so
+        # appending cpu changes nothing else
+        if "cpu" not in [p.strip() for p in want_platform.split(",")]:
+            want_platform += ",cpu"
         jax.config.update("jax_platforms", want_platform)
     num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1"))
     if num_slices > 1 and args.workload != "resnet":

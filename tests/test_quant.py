@@ -1,0 +1,159 @@
+"""Weight-only int8 quantization (``ops/quant.py``): correctness of the
+QTensor algebra, the quantized llama serving path, and tp sharding of
+quantized weights on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import tests._jax_cpu  # noqa: F401
+
+from dcos_commons_tpu.models import llama
+from dcos_commons_tpu.ops.quant import (QTensor, dequantize, qmm, qtake,
+                                        quantize)
+from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+
+# ------------------------------------------------------------- primitives
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.key(0), (64, 32), jnp.float32)
+    qt = quantize(w, axis=-2)
+    assert qt.q.dtype == jnp.int8 and qt.q.shape == w.shape
+    assert qt.s.shape == (1, 32)
+    back = dequantize(qt, jnp.float32)
+    # symmetric per-channel int8: worst-case error is half a step,
+    # step = amax/127 per channel
+    step = np.abs(np.asarray(w)).max(axis=0, keepdims=True) / 127.0
+    assert np.all(np.abs(np.asarray(back) - np.asarray(w)) <= step)
+
+
+def test_qmm_matches_dequantized_matmul():
+    w = jax.random.normal(jax.random.key(0), (32, 16), jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (4, 32), jnp.float32)
+    qt = quantize(w, axis=-2, scale_dtype=jnp.float32)
+    got = qmm(x, qt)
+    want = x @ dequantize(qt, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # plain-array path is untouched
+    np.testing.assert_allclose(np.asarray(qmm(x, w)), np.asarray(x @ w),
+                               rtol=1e-6)
+
+
+def test_qtake_per_row_embedding():
+    w = jax.random.normal(jax.random.key(0), (16, 8), jnp.float32)
+    qt = quantize(w, axis=-1, scale_dtype=jnp.float32)
+    assert qt.s.shape == (16, 1)
+    idx = jnp.array([[0, 3], [15, 7]])
+    got = qtake(qt, idx, jnp.float32)
+    want = dequantize(qt, jnp.float32)[idx]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    assert got.shape == (2, 2, 8)
+
+
+def test_qtensor_scans_like_a_stacked_weight():
+    # the decode loop lax.scans over stacked [L, ...] layer weights; a
+    # QTensor must slice its leading axis like any other pytree leaf
+    w = jax.random.normal(jax.random.key(0), (4, 8, 6), jnp.float32)
+    qt = quantize(w, axis=-2, scale_dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (2, 8), jnp.float32)
+
+    def body(x, lp):
+        return x * 0 + jnp.sum(qmm(x, lp)), None
+
+    out, _ = jax.lax.scan(body, x, qt)
+    steps = []
+    acc = x
+    for i in range(4):
+        acc = acc * 0 + jnp.sum(
+            acc @ dequantize(QTensor(qt.q[i], qt.s[i]), jnp.float32))
+        steps.append(acc)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(steps[-1]),
+                               rtol=1e-4)
+
+
+# ------------------------------------------------------------ llama path
+
+def _tiny_cfg(**kw):
+    return llama.LlamaConfig.tiny(attn_impl="dense", **kw)
+
+
+def test_quantized_decode_tracks_bf16():
+    cfg = _tiny_cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    qparams = llama.quantize_params(params)
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0,
+                                cfg.vocab_size)
+
+    # prefill logits stay close in relative terms
+    cache = llama.init_kv_cache(cfg, 2, cfg.max_seq)
+    ref_logits, _ = llama.prefill(cfg, params, cache, prompt)
+    q_logits, _ = llama.prefill(cfg, qparams, cache, prompt)
+    ref = np.asarray(ref_logits, np.float64)
+    err = np.linalg.norm(np.asarray(q_logits, np.float64) - ref)
+    assert err / np.linalg.norm(ref) < 0.05
+
+    # the full stepwise generation runs end-to-end and returns tokens
+    toks = llama.generate_stepwise(cfg, qparams, prompt, steps=8)
+    assert toks.shape == (2, 8)
+    assert int(toks.max()) < cfg.vocab_size
+
+
+def test_quantized_params_byte_budget():
+    # the point of the exercise: int8 weights halve (vs bf16) the bytes
+    cfg = _tiny_cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    qparams = llama.quantize_params(params)
+
+    def nbytes(tree):
+        return sum(x.size * x.dtype.itemsize
+                   for x in jax.tree.leaves(tree))
+
+    assert nbytes(qparams) < 0.62 * nbytes(params)
+
+
+def test_quantized_tp_sharding_matches_single_device():
+    cfg = _tiny_cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    qparams = llama.quantize_params(params)
+    prompt = jax.random.randint(jax.random.key(1), (1, 6), 0,
+                                cfg.vocab_size)
+    want = llama.generate_stepwise(cfg, qparams, prompt, steps=6)
+
+    mesh = MeshSpec(tp=8).build()
+    with mesh:
+        sharded = llama.shard_params(qparams, mesh, cfg)
+        # scales follow the payload's tp axis except on collapsed dims
+        wq = sharded["layers"]["wq"]
+        assert isinstance(wq, QTensor)
+        got = llama.generate_stepwise(cfg, sharded, prompt, steps=6,
+                                      mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_quantize_rejects_moe_trees():
+    # the expert banks feed parallel.moe einsums that consume raw arrays;
+    # a silently-quantized MoE tree would explode at forward time instead
+    cfg = _tiny_cfg()
+    moe_params = llama.init_moe_params(cfg, 4, jax.random.key(0))
+    try:
+        llama.quantize_params(moe_params)
+    except ValueError as e:
+        assert "dense decoder only" in str(e)
+    else:
+        raise AssertionError("MoE tree was not rejected")
+
+
+def test_init_quantized_params_is_quantized_tree():
+    cfg = _tiny_cfg()
+    qparams = llama.init_quantized_params(cfg, jax.random.key(0))
+    assert isinstance(qparams["layers"]["w_gate"], QTensor)
+    assert isinstance(qparams["embed"], QTensor)
+    assert qparams["norm"].dtype == cfg.dtype
+    # matches quantize_params(init_params) bitwise (same key, same math)
+    ref = llama.quantize_params(llama.init_params(cfg, jax.random.key(0)))
+    np.testing.assert_array_equal(
+        np.asarray(qparams["layers"]["wq"].q),
+        np.asarray(ref["layers"]["wq"].q))

@@ -1,18 +1,22 @@
 """Reproducible llama decode benchmark: tokens/sec on one chip.
 
 Companion to ``tools.bench_attention`` for the inference path
-(BASELINE.json config #5): greedy KV-cache decode of a ~0.9B-parameter
-decoder in bf16 — large enough that per-token latency is HBM-bandwidth
-bound (every decode step streams all weights), which is the number that
-matters for serving. Prints one JSON line per measurement.
+(BASELINE.json config #5): greedy KV-cache decode of bf16 or int8
+weight-only quantized decoders — large enough that per-token latency is
+HBM-bandwidth bound (every decode step streams all weights), which is the
+number that matters for serving. The ``8b`` preset is the real
+Llama-3-8B architecture; it fits a single 16 GB chip only quantized
+(``--quant int8``, ~8.5 GB weights). Prints one JSON line per
+measurement.
 
-Measurement notes (tunneled PJRT backends, see docs/performance.md): the
-decode loop is a single jitted ``lax.scan`` whose carry feeds forward, and
-a host materialization forces the sync.
+``--quality`` runs the int8-vs-bf16 comparison instead of the timing:
+top-1 agreement and logit error over a batch of random prompts, at a
+preset small enough that both variants fit the chip at once (400m).
 
 Usage::
 
-    python -m tools.bench_decode [--steps 64] [--batch 1] [--preset 1b|tiny]
+    python -m tools.bench_decode [--steps 64] [--batch 1]
+        [--preset 8b|1b|400m|tiny] [--quant int8] [--quality]
 """
 
 from __future__ import annotations
@@ -22,6 +26,109 @@ import json
 import time
 
 
+def _build_cfg(args, llama):
+    if args.preset == "8b":
+        # the flagship: Llama-3-8B architecture, serving KV budget
+        return llama.LlamaConfig.llama3_8b(max_seq=args.max_seq or 2048,
+                                           remat=False, attn_impl="dense")
+    if args.preset == "1b":
+        # ~0.9B params (~1.8 GB bf16): decode streams the full weight set
+        # per token -> HBM-bound. NOTE: the nested-scan decode graph takes
+        # >15 min to compile through tunneled PJRT backends; prefer 400m
+        # unless compiles are local/cached.
+        return llama.LlamaConfig(vocab_size=32000, dim=2048, n_layers=16,
+                                 n_heads=16, n_kv_heads=8, ffn_dim=5632,
+                                 max_seq=args.max_seq or 1024, remat=False,
+                                 attn_impl="dense")
+    if args.preset == "400m":
+        # ~0.4B params (~0.8 GB bf16): still weight-streaming bound, far
+        # cheaper to compile
+        return llama.LlamaConfig(vocab_size=32000, dim=1536, n_layers=8,
+                                 n_heads=12, n_kv_heads=6, ffn_dim=4096,
+                                 max_seq=args.max_seq or 512, remat=False,
+                                 attn_impl="dense")
+    return llama.LlamaConfig.tiny()
+
+
+def _tree_stats(jax, params):
+    from dcos_commons_tpu.ops.quant import QTensor
+    leaves = jax.tree.leaves(
+        params, is_leaf=lambda x: isinstance(x, QTensor))
+    n = sum((x.q.size if isinstance(x, QTensor) else x.size)
+            for x in leaves)
+    nbytes = sum(
+        (x.q.size * x.q.dtype.itemsize + x.s.size * x.s.dtype.itemsize)
+        if isinstance(x, QTensor) else x.size * x.dtype.itemsize
+        for x in leaves)
+    return n, nbytes
+
+
+def run_quality(args, jax, jnp, llama) -> dict:
+    """Int8-vs-bf16 on the same weights: per-position top-1 agreement and
+    logit error over full-sequence forward logits, plus teacher-forced
+    agreement through the KV-cache decode path.
+
+    Caveat these numbers carry (zero-egress image: weights are random):
+    random-init logits are near-uniform, so argmax margins are tiny and a
+    sub-percent logit perturbation flips near-tied positions. The
+    margin-stratified agreement shows the errors concentrate exactly
+    there — on the high-margin half (what peaked trained-model logits
+    look like) agreement is near-perfect. The decode comparison is
+    teacher-forced (both variants consume the SAME bf16-chosen token each
+    step): free-running comparisons compound one near-tie flip into
+    permanent divergence and measure the random weights, not the
+    quantizer."""
+    import numpy as np
+
+    cfg = _build_cfg(args, llama)
+    params = llama.init_params(cfg, jax.random.key(0))
+    qparams = llama.quantize_params(params)
+    b, s = max(args.batch, 4), 64
+    prompt = jax.random.randint(jax.random.key(1), (b, s), 0,
+                                cfg.vocab_size)
+
+    fwd = jax.jit(lambda p, t: llama.forward(cfg, p, t))
+    ref = np.asarray(fwd(params, prompt), np.float64)
+    got = np.asarray(fwd(qparams, prompt), np.float64)
+    agree_mask = ref.argmax(-1) == got.argmax(-1)
+    rel_err = float(np.linalg.norm(got - ref) / np.linalg.norm(ref))
+    max_abs = float(np.abs(got - ref).max())
+    top2 = np.partition(ref, -2, axis=-1)[..., -2:]
+    margin = top2[..., 1] - top2[..., 0]          # top1 - top2 logit gap
+    hi = margin >= np.median(margin)
+
+    # teacher-forced decode through the jitted prefill/step executables
+    steps = args.steps
+    short = prompt[:, :8]
+    prefill_x, step_x = llama._stepwise_executables(cfg, None)
+    cache_r = llama.init_kv_cache(cfg, b, cfg.max_seq)
+    cache_q = llama.init_kv_cache(cfg, b, cfg.max_seq)
+    lr, cache_r = prefill_x(params, cache_r, short)
+    lq, cache_q = prefill_x(qparams, cache_q, short)
+    agree_steps = 0.0
+    for i in range(steps):
+        tok = jnp.argmax(lr, axis=-1).astype(short.dtype)
+        agree_steps += float((jnp.argmax(lq, axis=-1) == tok).mean())
+        lr, cache_r = step_x(params, cache_r, jnp.int32(8 + i), tok)
+        lq, cache_q = step_x(qparams, cache_q, jnp.int32(8 + i), tok)
+
+    return {
+        "metric": "llama_int8_quality",
+        "preset": args.preset,
+        "positions": b * s,
+        "top1_agreement": round(float(agree_mask.mean()), 4),
+        "top1_agreement_high_margin": round(float(agree_mask[hi].mean()),
+                                            4),
+        "median_top1_margin": round(float(np.median(margin)), 4),
+        "logit_rel_err": round(rel_err, 5),
+        "logit_max_abs_err": round(max_abs, 3),
+        "teacher_forced_decode_agreement": round(agree_steps / steps, 4),
+        "decode_steps": steps,
+        "weights": "random-init (zero-egress image)",
+        "backend": jax.devices()[0].platform,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps", type=int, default=64,
@@ -29,14 +136,21 @@ def main(argv=None) -> int:
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--prompt", type=int, default=8, help="prefill length")
     p.add_argument("--preset", default="400m",
-                   choices=["1b", "400m", "tiny"])
+                   choices=["8b", "1b", "400m", "tiny"])
+    p.add_argument("--quant", default="none", choices=["none", "int8"],
+                   help="weight-only int8 (ops/quant.py); the only way "
+                        "the 8b preset fits one 16 GB chip")
+    p.add_argument("--max-seq", type=int, default=0,
+                   help="KV-cache length override (0 = preset default)")
+    p.add_argument("--quality", action="store_true",
+                   help="compare int8 vs bf16 outputs instead of timing")
     p.add_argument("--mode", default="auto",
                    choices=["auto", "fused", "stepwise"],
                    help="fused = one scan program (fast dispatch, heavy "
                         "compile); stepwise = prefill + one decode-step "
                         "executable driven from the host (compiles in "
                         "seconds; the right choice at 400m+ on tunneled "
-                        "backends). auto = stepwise for 400m/1b, fused "
+                        "backends). auto = stepwise for 400m+, fused "
                         "for tiny.")
     args = p.parse_args(argv)
     mode = args.mode
@@ -48,27 +162,17 @@ def main(argv=None) -> int:
 
     from dcos_commons_tpu.models import llama
 
-    if args.preset == "1b":
-        # ~0.9B params (~1.8 GB bf16): decode streams the full weight set
-        # per token -> HBM-bound. NOTE: the nested-scan decode graph takes
-        # >15 min to compile through tunneled PJRT backends; prefer 400m
-        # unless compiles are local/cached.
-        cfg = llama.LlamaConfig(vocab_size=32000, dim=2048, n_layers=16,
-                                n_heads=16, n_kv_heads=8, ffn_dim=5632,
-                                max_seq=1024, remat=False,
-                                attn_impl="dense")
-    elif args.preset == "400m":
-        # ~0.4B params (~0.8 GB bf16): still weight-streaming bound, far
-        # cheaper to compile
-        cfg = llama.LlamaConfig(vocab_size=32000, dim=1536, n_layers=8,
-                                n_heads=12, n_kv_heads=6, ffn_dim=4096,
-                                max_seq=512, remat=False,
-                                attn_impl="dense")
-    else:
-        cfg = llama.LlamaConfig.tiny()
+    if args.quality:
+        print(json.dumps(run_quality(args, jax, jnp, llama)))
+        return 0
 
-    params = llama.init_params(cfg, jax.random.key(0))
-    n_params = sum(x.size for x in jax.tree.leaves(params))
+    cfg = _build_cfg(args, llama)
+    if args.quant == "int8":
+        params = llama.init_quantized_params(cfg, jax.random.key(0),
+                                             device=jax.devices()[0])
+    else:
+        params = llama.init_params(cfg, jax.random.key(0))
+    n_params, weight_bytes = _tree_stats(jax, params)
     prompt = jax.random.randint(jax.random.key(1),
                                 (args.batch, args.prompt), 0,
                                 cfg.vocab_size)
@@ -96,8 +200,10 @@ def main(argv=None) -> int:
     print(json.dumps({
         "metric": "llama_decode_tokens_per_sec",
         "preset": args.preset,
+        "quant": args.quant,
         "mode": mode,
         "params": n_params,
+        "weight_gb": round(weight_bytes / 1e9, 2),
         "batch": args.batch,
         "steps": args.steps,
         # compile + one full generation (in stepwise mode the run part
