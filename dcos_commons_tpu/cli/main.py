@@ -48,6 +48,80 @@ def _emit(code: int, payload) -> int:
     return 0 if code < 400 else 1
 
 
+# -- cluster config (reference cli/config/config.go: attached-cluster
+# ergonomics without env-var juggling) ------------------------------------
+
+def _cluster_config_path() -> str:
+    home = os.environ.get("TPUCTL_HOME") or os.path.expanduser("~/.tpuctl")
+    return os.path.join(home, "config.json")
+
+
+def load_cluster_config() -> dict:
+    try:
+        with open(_cluster_config_path()) as f:
+            cfg = json.load(f)
+        return cfg if isinstance(cfg, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def apply_cluster_config() -> None:
+    """Fold the persisted cluster config into the environment the existing
+    transport/auth plumbing already reads — WITHOUT overriding anything
+    the operator exported explicitly (env wins, config is the fallback).
+    The token file is re-read every invocation, so rotated credentials
+    are picked up with no re-configuration."""
+    cfg = load_cluster_config()
+    if cfg.get("url"):
+        os.environ.setdefault("TPU_SCHEDULER_URL", str(cfg["url"]))
+    if cfg.get("ca"):
+        os.environ.setdefault("TPU_TLS_CA", str(cfg["ca"]))
+    token_file = cfg.get("token_file")
+    if token_file and "TPU_AUTH_TOKEN" not in os.environ:
+        try:
+            with open(token_file) as f:
+                token = f.read().strip()
+            if token:
+                os.environ["TPU_AUTH_TOKEN"] = token
+        except OSError:
+            pass  # surfaces as an auth failure with the env hint
+
+
+def _set_cluster(args) -> int:
+    url = args.config_id
+    if not url or not (url.startswith("http://")
+                       or url.startswith("https://")):
+        print(json.dumps({"error": "config set-cluster needs an "
+                                   "http(s):// URL"}))
+        return 2
+    cfg = {"url": url.rstrip("/")}
+    if args.ca:
+        if not os.path.isfile(args.ca):
+            print(json.dumps({"error": f"--ca file not found: {args.ca}"}))
+            return 2
+        cfg["ca"] = os.path.abspath(args.ca)
+    if args.token_file:
+        if not os.path.isfile(args.token_file):
+            print(json.dumps({"error": "--token-file not found: "
+                                       f"{args.token_file}"}))
+            return 2
+        cfg["token_file"] = os.path.abspath(args.token_file)
+    if url.startswith("https://") and "ca" not in cfg:
+        # hard-fail later anyway (transport refuses https without a CA);
+        # fail now with the flag that fixes it
+        print(json.dumps({"error": "https cluster needs --ca FILE "
+                                   "(scheduler CA certificate)"}))
+        return 2
+    path = _cluster_config_path()
+    os.makedirs(os.path.dirname(path), mode=0o700, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(cfg, f, indent=2)
+    os.replace(tmp, path)
+    print(json.dumps({"ok": True, "path": path, **cfg}, indent=2))
+    return 0
+
+
 def _plan_cmd(client: Client, args) -> int:
     a = args.action
     if a == "list":
@@ -122,6 +196,12 @@ def _update_cmd(client: Client, args) -> int:
 
 
 def _config_cmd(client: Client, args) -> int:
+    if args.action == "set-cluster":
+        return _set_cluster(args)
+    if args.action == "show-cluster":
+        print(json.dumps({"path": _cluster_config_path(),
+                          **load_cluster_config()}, indent=2))
+        return 0
     if args.action == "list":
         return _emit(*client.get("configurations"))
     if args.action == "target-id":
@@ -219,9 +299,16 @@ def build_parser() -> argparse.ArgumentParser:
     upd.add_argument("--yaml", help="replacement service YAML file")
     upd.set_defaults(fn=_update_cmd)
 
-    cfg = sub.add_parser("config", help="configuration history")
-    cfg.add_argument("action", choices=["list", "show", "target-id"])
-    cfg.add_argument("config_id", nargs="?")
+    cfg = sub.add_parser("config",
+                         help="configuration history / cluster config")
+    cfg.add_argument("action", choices=["list", "show", "target-id",
+                                        "set-cluster", "show-cluster"])
+    cfg.add_argument("config_id", nargs="?",
+                     help="config id (show) or scheduler URL (set-cluster)")
+    cfg.add_argument("--ca", help="set-cluster: scheduler CA cert file")
+    cfg.add_argument("--token-file",
+                     help="set-cluster: file holding an auth token "
+                          "(re-read on every invocation)")
     cfg.set_defaults(fn=_config_cmd)
 
     st = sub.add_parser("state", help="framework state")
@@ -249,6 +336,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    # before the parser builds: --url's default reads TPU_SCHEDULER_URL
+    apply_cluster_config()
     args = build_parser().parse_args(argv)
     client = Client(args.url, args.service)
     try:

@@ -6,6 +6,9 @@
 // config / state / health against /v1/* (or /v1/service/<name>/* with
 // --service).
 
+#include <limits.h>
+#include <sys/stat.h>
+
 #include <cstdlib>
 #include <fstream>
 #include <iostream>
@@ -82,6 +85,8 @@ void usage() {
       << "  endpoints [NAME]\n"
       << "  debug offers|plans|statuses|reservations\n"
       << "  describe | config list|show|target-id [ID]\n"
+      << "  config set-cluster URL [--ca FILE] [--token-file FILE] | "
+      << "show-cluster\n"
       << "  update [--set KEY=VALUE ...] [--yaml FILE]\n"
       << "  state framework-id|properties|property [KEY]\n"
       << "  agents [list|info]\n"
@@ -89,10 +94,128 @@ void usage() {
       << "  health\n";
 }
 
+// -- cluster config (reference cli/config/config.go attached-cluster
+// ergonomics): ~/.tpuctl/config.json (TPUCTL_HOME overrides the dir),
+// shared byte-for-byte with the Python CLI. Precedence: flag > env >
+// config — applied by folding config values into UNSET env vars, so the
+// shared auth/TLS plumbing needs no second code path.
+
+std::string cluster_config_dir() {
+  const char* o = getenv("TPUCTL_HOME");
+  if (o != nullptr) return o;
+  const char* home = getenv("HOME");
+  return std::string(home ? home : ".") + "/.tpuctl";
+}
+
+void apply_cluster_config() {
+  std::ifstream in(cluster_config_dir() + "/config.json");
+  if (!in) return;
+  std::ostringstream ss;
+  ss << in.rdbuf();
+  tpu::Json cfg = tpu::Json::object();
+  try {
+    cfg = tpu::Json::parse(ss.str());
+  } catch (...) {
+    return;  // corrupt config: behave as unconfigured
+  }
+  std::string url = cfg.get("url").as_string();
+  if (!url.empty()) setenv("TPU_SCHEDULER_URL", url.c_str(), 0);
+  std::string ca = cfg.get("ca").as_string();
+  if (!ca.empty()) setenv("TPU_TLS_CA", ca.c_str(), 0);
+  std::string token_file = cfg.get("token_file").as_string();
+  if (!token_file.empty() && getenv("TPU_AUTH_TOKEN") == nullptr) {
+    std::ifstream tf(token_file);
+    if (tf) {
+      std::string token;
+      std::getline(tf, token);
+      while (!token.empty() &&
+             (token.back() == '\n' || token.back() == '\r' ||
+              token.back() == ' '))
+        token.pop_back();
+      if (!token.empty()) setenv("TPU_AUTH_TOKEN", token.c_str(), 1);
+    }
+  }
+}
+
+int set_cluster(const std::string& url, const std::string& ca,
+                const std::string& token_file) {
+  if (url.rfind("http://", 0) != 0 && url.rfind("https://", 0) != 0) {
+    std::cerr << "config set-cluster needs an http(s):// URL\n";
+    return 2;
+  }
+  if (url.rfind("https://", 0) == 0 && ca.empty()) {
+    std::cerr << "https cluster needs --ca FILE (scheduler CA cert)\n";
+    return 2;
+  }
+  // store ABSOLUTE paths (the Python twin does the same with abspath):
+  // the config is read from arbitrary cwds later, where a relative path
+  // written from this one would silently stop resolving
+  char resolved[PATH_MAX];
+  std::string ca_abs = ca, token_abs = token_file;
+  if (!ca.empty()) {
+    if (realpath(ca.c_str(), resolved) == nullptr) {
+      std::cerr << "--ca file not found: " << ca << "\n";
+      return 2;
+    }
+    ca_abs = resolved;
+  }
+  if (!token_file.empty()) {
+    if (realpath(token_file.c_str(), resolved) == nullptr) {
+      std::cerr << "--token-file not found: " << token_file << "\n";
+      return 2;
+    }
+    token_abs = resolved;
+  }
+  std::string trimmed = url;
+  while (!trimmed.empty() && trimmed.back() == '/') trimmed.pop_back();
+  tpu::Json cfg = tpu::Json::object();
+  cfg.set("url", trimmed);
+  if (!ca_abs.empty()) cfg.set("ca", ca_abs);
+  if (!token_abs.empty()) cfg.set("token_file", token_abs);
+  std::string dir = cluster_config_dir();
+  mkdir(dir.c_str(), 0700);  // EEXIST is fine
+  std::string path = dir + "/config.json";
+  std::string tmp = path + ".tmp";
+  {
+    std::ofstream out(tmp);
+    if (!out) {
+      std::cerr << "cannot write " << tmp << "\n";
+      return 2;
+    }
+    out << cfg.dump() << "\n";
+  }
+  if (rename(tmp.c_str(), path.c_str()) != 0) {
+    std::cerr << "cannot commit " << path << "\n";
+    return 2;
+  }
+  cfg.set("ok", true);
+  cfg.set("path", path);
+  std::cout << cfg.dump() << "\n";
+  return 0;
+}
+
+int show_cluster() {
+  std::string path = cluster_config_dir() + "/config.json";
+  tpu::Json cfg = tpu::Json::object();
+  std::ifstream in(path);
+  if (in) {
+    std::ostringstream ss;
+    ss << in.rdbuf();
+    try {
+      cfg = tpu::Json::parse(ss.str());
+    } catch (...) {
+    }
+  }
+  cfg.set("path", path);
+  std::cout << cfg.dump() << "\n";
+  return 0;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
   Ctx ctx;
+  apply_cluster_config();  // env still wins (setenv without overwrite)
   const char* env_url = getenv("TPU_SCHEDULER_URL");
   if (env_url != nullptr) ctx.base = env_url;
 
@@ -114,8 +237,9 @@ int main(int argc, char** argv) {
   tpu::AuthSession auth(ctx.base);  // after --url so login hits the right host
   ctx.auth = &auth;
 
-  // extract --phase/--step/--set/--yaml wherever they appear
-  std::string phase, step, yaml_file;
+  // extract --phase/--step/--set/--yaml/--ca/--token-file wherever they
+  // appear
+  std::string phase, step, yaml_file, ca_file, token_file;
   std::vector<std::string> sets;
   std::vector<std::string> pos;
   for (size_t i = 0; i < args.size(); ++i) {
@@ -123,6 +247,8 @@ int main(int argc, char** argv) {
     else if (args[i] == "--step" && i + 1 < args.size()) step = args[++i];
     else if (args[i] == "--set" && i + 1 < args.size()) sets.push_back(args[++i]);
     else if (args[i] == "--yaml" && i + 1 < args.size()) yaml_file = args[++i];
+    else if (args[i] == "--ca" && i + 1 < args.size()) ca_file = args[++i];
+    else if (args[i] == "--token-file" && i + 1 < args.size()) token_file = args[++i];
     else pos.push_back(args[i]);
   }
 
@@ -210,6 +336,9 @@ int main(int argc, char** argv) {
     }
 
     if (cmd == "config") {
+      if (action == "set-cluster") return set_cluster(arg, ca_file,
+                                                      token_file);
+      if (action == "show-cluster") return show_cluster();
       if (action == "list") return get(ctx, "configurations");
       if (action == "target-id") return get(ctx, "configurations/targetId");
       if (action == "show") {

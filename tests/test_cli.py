@@ -91,3 +91,115 @@ def test_agents_command(server, capsys):
     assert ids and all(isinstance(i, str) for i in ids)
     info = run_cli(base, "agents", "info", capsys=capsys)
     assert {"volume_profiles", "roles", "tpu"} <= set(info[0])
+
+
+# -- cluster config (tpuctl config set-cluster; reference cli/config/) ----
+
+@pytest.fixture()
+def clean_env(tmp_path, monkeypatch):
+    """Snapshot/restore os.environ around the test (apply_cluster_config
+    folds config into the process env, which pytest must not keep), scrub
+    every TPU_* var, and point TPUCTL_HOME at a tmp dir."""
+    import os
+    saved = os.environ.copy()
+    for k in list(os.environ):
+        if k.startswith("TPU_"):
+            del os.environ[k]
+    os.environ["TPUCTL_HOME"] = str(tmp_path / "tpuctl-home")
+    yield tmp_path / "tpuctl-home"
+    os.environ.clear()
+    os.environ.update(saved)
+
+
+def test_set_cluster_roundtrip_no_env_no_flags(server, capsys, clean_env):
+    _, base = server
+    out = run_cli(base, "config", "set-cluster", base, capsys=capsys)
+    assert out["ok"] and out["url"] == base
+    # from here on: NO --url flag, NO env vars — config is the cluster
+    rc = main(["plan", "list"])
+    assert rc == 0
+    assert "deploy" in json.loads(capsys.readouterr().out)
+    shown = run_cli(base, "config", "show-cluster", capsys=capsys)
+    assert shown["url"] == base
+
+
+def test_set_cluster_validation(server, capsys, clean_env):
+    _, base = server
+    assert main(["config", "set-cluster", "not-a-url"]) == 2
+    capsys.readouterr()
+    # https without --ca is refused up front (transport would refuse later)
+    assert main(["config", "set-cluster", "https://x:1"]) == 2
+    capsys.readouterr()
+
+
+def test_explicit_env_and_flag_beat_cluster_config(server, capsys,
+                                                   clean_env):
+    import os
+    _, base = server
+    run_cli(base, "config", "set-cluster", "http://127.0.0.1:1",
+            capsys=capsys)  # dead endpoint in the config
+    # explicit --url wins over the configured (dead) cluster
+    assert main(["--url", base, "plan", "list"]) == 0
+    capsys.readouterr()
+    # explicit env wins too
+    os.environ["TPU_SCHEDULER_URL"] = base
+    assert main(["plan", "list"]) == 0
+    capsys.readouterr()
+
+
+def test_cluster_config_tls_auth_both_clis(capsys, clean_env):
+    """The VERDICT criterion: a TLS+auth scheduler driven by BOTH CLIs
+    with no env vars and no flags — url/ca/token all from ~/.tpuctl."""
+    import os
+    import subprocess
+    from pathlib import Path
+
+    from dcos_commons_tpu.security import (Authenticator,
+                                           generate_auth_config,
+                                           mint_server_credentials)
+    from dcos_commons_tpu.state import MemPersister
+    from tests.test_http import make_scheduler
+
+    home = clean_env
+    auth_cfg = generate_auth_config()
+    auth = Authenticator.from_config(auth_cfg)
+    persister = MemPersister()
+    sched = make_scheduler()
+    sched.run_until_quiet()
+    creds = mint_server_credentials(persister, "websvc")
+    srv = ApiServer(sched, port=0, cluster=sched.cluster, tls=creds,
+                    auth=auth)
+    srv.start()
+    try:
+        url = f"https://127.0.0.1:{srv.port}"
+        ca = home.parent / "ca.pem"
+        ca.parent.mkdir(parents=True, exist_ok=True)
+        ca.write_bytes(creds.ca_pem)
+        token = auth.login("ops", auth.accounts["ops"].secret)
+        tok_file = home.parent / "ops.token"
+        tok_file.write_text(token + "\n")
+
+        out = run_cli(url, "config", "set-cluster", url, "--ca", str(ca),
+                      "--token-file", str(tok_file), capsys=capsys)
+        assert out["ok"]
+
+        # python CLI: no env, no flags
+        assert main(["plan", "list"]) == 0
+        assert "deploy" in json.loads(capsys.readouterr().out)
+
+        # native CLI: scrubbed env + same TPUCTL_HOME
+        bin_dir = Path(__file__).resolve().parent.parent / "native" / "bin"
+        scrubbed = {k: v for k, v in os.environ.items()
+                    if not k.startswith("TPU_")}
+        r = subprocess.run([str(bin_dir / "tpuctl"), "plan", "list"],
+                           env=scrubbed, capture_output=True, text=True)
+        assert r.returncode == 0 and "deploy" in r.stdout, (
+            r.stdout + r.stderr)
+        # and without the config it has no idea where the cluster is
+        r = subprocess.run(
+            [str(bin_dir / "tpuctl"), "plan", "list"],
+            env=dict(scrubbed, TPUCTL_HOME=str(home.parent / "empty")),
+            capture_output=True, text=True)
+        assert r.returncode != 0
+    finally:
+        srv.stop()
