@@ -41,6 +41,12 @@ class RemoteCluster:
     # relaunched on scheduler restart (ServiceScheduler.reconcile)
     default_agent_grace_s = 30.0
 
+    # statuses arrive on HTTP worker threads: the scheduler may persist
+    # them here but defer the plan feed to its cycle thread, so a poll
+    # never queues behind a whole-fleet match pass (core.py
+    # handle_status_nowait; p99 tail in docs/performance.md)
+    async_status_ok = True
+
     def __init__(self, expiry_s: float = 30.0, poll_interval_s: float = 1.0):
         self._lock = threading.Lock()
         self._expiry_s = expiry_s

@@ -72,6 +72,20 @@ class ServiceScheduler:
         # reference single-threads its offer pipeline the same way,
         # OfferProcessor.java:57)
         self._lock = threading.RLock()
+        # serializes whole run_cycle passes against each other (runner
+        # loop, multi-service drivers, tests); _lock alone can't once
+        # cycles release it between candidate batches (see run_cycle).
+        # Operator verbs take only _lock — they may interleave between
+        # batches, exactly as they always could between cycles. RLock so
+        # a callback that re-enters run_cycle on the same thread (fake-
+        # cluster synchronous status flows) cannot self-deadlock.
+        self._cycle_lock = threading.RLock()
+        # serializes the state store's check-then-act sequences (status
+        # generation check vs launch WAL, override read-modify-write)
+        # between the cycle thread and nowait poll threads. Held only
+        # around individual persists — a poll waits one WAL write, never
+        # a match batch. Order: _lock -> _state_lock, never the reverse.
+        self._state_lock = threading.RLock()
         # grace before tasks on an unreported agent are declared LOST;
         # >0 for remote clusters where agents re-register asynchronously
         # (Mesos agent-reregistration-timeout analogue). None = take the
@@ -169,7 +183,19 @@ class ServiceScheduler:
         else:
             self._build_plan_managers()
 
-        cluster.set_status_callback(self.handle_status)
+        # transports that deliver statuses from their own worker threads
+        # (RemoteCluster: HTTP pollers) opt into the nowait path: persist
+        # in the caller's thread — the agent's ok reply must imply
+        # durability — but feed plans from the cycle thread, so a poll
+        # never waits behind a whole-fleet match pass (p99 tail,
+        # docs/performance.md). In-process fakes keep the synchronous
+        # path: tests observe plan transitions immediately.
+        self._status_feed: List[TaskStatus] = []
+        self._feed_lock = threading.Lock()
+        if getattr(cluster, "async_status_ok", False):
+            cluster.set_status_callback(self.handle_status_nowait)
+        else:
+            cluster.set_status_callback(self.handle_status)
         self.reconcile()
 
     def _build_plan_managers(self) -> None:
@@ -346,20 +372,56 @@ class ServiceScheduler:
         with self._lock:
             self._handle_status_locked(task_name, status)
 
-    def _handle_status_locked(self, task_name: str,
-                              status: TaskStatus) -> None:
+    def handle_status_nowait(self, task_name: str,
+                             status: TaskStatus) -> None:
+        """Status ingestion OFF the match lock (HTTP poll threads).
+
+        The durable half — persist + stale-generation kill + override
+        bookkeeping — runs here, synchronously, because the transport
+        acks the agent's statuses when this returns and the agent then
+        drops them. The plan feed (``coordinator.update``) is queued for
+        the cycle thread: it only moves step state machines, and a step
+        seeing a status one batch later is the same staleness window a
+        status arriving between two cycles always had."""
+        if self._ingest_status(task_name, status):
+            with self._feed_lock:
+                self._status_feed.append(status)
+
+    def _drain_status_feed_locked(self) -> None:
+        with self._feed_lock:
+            if not self._status_feed:
+                return
+            feed, self._status_feed = self._status_feed, []
+        for status in feed:
+            self.coordinator.update(status)
+
+    def _ingest_status(self, task_name: str, status: TaskStatus) -> bool:
+        """Durable half of status handling: persist, synthesize kills for
+        stale generations, advance pause/resume overrides. Returns True
+        when plans should see the status. ``_state_lock`` makes the
+        store's check-then-act (generation check vs a concurrent launch
+        WAL; override read-modify-write vs pause/resume verbs) atomic for
+        nowait callers — the sync path already holds ``_lock`` and the
+        nested acquire is cheap."""
         if self.metrics is not None:
             self.metrics.record_task_status(status.state.value)
-        try:
-            self.state.store_status(task_name, status)
-        except StateStoreError:
-            # stale generation: a status for a task id we've since replaced
-            if not status.state.terminal and status.agent_id:
-                self.cluster.kill(status.agent_id, status.task_id)
-            return
-        if status.state is TaskState.RUNNING:
-            self._complete_override(task_name)
-        self.coordinator.update(status)
+        with self._state_lock:
+            try:
+                self.state.store_status(task_name, status)
+            except StateStoreError:
+                # stale generation: a status for a task id we've since
+                # replaced
+                if not status.state.terminal and status.agent_id:
+                    self.cluster.kill(status.agent_id, status.task_id)
+                return False
+            if status.state is TaskState.RUNNING:
+                self._complete_override(task_name)
+        return True
+
+    def _handle_status_locked(self, task_name: str,
+                              status: TaskStatus) -> None:
+        if self._ingest_status(task_name, status):
+            self.coordinator.update(status)
 
     def _complete_override(self, task_name: str) -> None:
         """Advance a pause/resume override to COMPLETE once the relaunched
@@ -378,6 +440,14 @@ class ServiceScheduler:
 
     # -- the cycle ---------------------------------------------------------
 
+    #: candidate steps matched per lock hold. Between batches the match
+    #: lock is RELEASED so agent polls (status dispatch via handle_status)
+    #: never queue behind a whole-fleet match pass: a 500-step deploy
+    #: cycle used to hold the lock for seconds, putting p99 poll latency
+    #: at multiple poll periods (docs/performance.md). One batch bounds
+    #: the head-of-line wait at ~batch x per-candidate eval time.
+    cycle_batch_size = 32
+
     def run_cycle(self, allow_expand: bool = True) -> int:
         """One evaluation pass; returns the number of actions (launches +
         kill batches) issued — zero means the cycle found no work.
@@ -386,77 +456,95 @@ class ServiceScheduler:
         reference ``ParallelFootprintDiscipline``) gates only steps that
         would *grow* the service's reservation footprint (first launch of a
         pod, or a permanent replace); recovery relaunches on existing
-        reservations and config-update rollouts always proceed."""
-        with self._lock:
-            return self._run_cycle_locked(allow_expand)
+        reservations and config-update rollouts always proceed.
+
+        Concurrency: ``_cycle_lock`` serializes whole cycles (runner loop,
+        HTTP-triggered verbs, tests may overlap); ``_lock`` protects state
+        and is dropped between candidate batches. A status landing between
+        batches is visible to the next batch — the same staleness window a
+        status arriving between two *cycles* always had."""
+        with self._cycle_lock:
+            with self._lock:
+                self._quota_usage_memo = None  # fresh usage view per cycle
+                if self.metrics is not None:
+                    self.metrics.record_cycle()
+                if self.agent_grace_s > 0:
+                    # remote clusters: agents can die mid-run; re-check
+                    # liveness every cycle (reference ImplicitReconciler
+                    # periodic pass)
+                    self.reconcile()
+                agents = list(self.cluster.agents())
+                self._replace_tpu_degraded(agents)
+                self._drain_status_feed_locked()
+                candidates = list(self.coordinator.get_candidates())
+            actions = 0
+            batch = max(1, self.cycle_batch_size)
+            for i in range(0, len(candidates), batch):
+                with self._lock:
+                    # statuses that landed while the lock was down move
+                    # their step machines before the next match batch
+                    self._drain_status_feed_locked()
+                    for step in candidates[i:i + batch]:
+                        actions += self._execute_candidate(step, agents,
+                                                           allow_expand)
+            with self._lock:
+                if (not self.uninstall_mode
+                        and self.deploy_manager.plan.status is Status.COMPLETE
+                        and not self.state.deploy_completed()):
+                    self.state.set_deploy_completed()
+            return actions
 
     def _expands_footprint(self, requirement) -> bool:
         if requirement.recovery_type is RecoveryType.PERMANENT:
             return True
         return not self.ledger.for_pod(requirement.pod_instance.name)
 
-    def _run_cycle_locked(self, allow_expand: bool = True) -> int:
-        self._quota_usage_memo = None  # fresh usage view per cycle
+    def _execute_candidate(self, step, agents, allow_expand: bool) -> int:
+        """Evaluate/launch ONE candidate step under the lock; returns the
+        number of actions issued (0 or 1)."""
+        if isinstance(step, ActionStep):
+            step.execute()
+            return 1
+        requirement = step.start()
+        if requirement is None:
+            return 0
+        if not allow_expand and self._expands_footprint(requirement):
+            step.on_no_match("footprint expansion gated by discipline")
+            return 0
+        requirement = self._apply_goal_overrides(requirement)
+        if self._kill_before_relaunch(requirement):
+            step.mark_prepared()
+            return 1
+        if requirement.recovery_type is RecoveryType.PERMANENT:
+            removed = self.ledger.remove_pod(requirement.pod_instance.name)
+            self.reservation_store.remove(removed)
+            # the replacement must not inherit the failed instance's
+            # data (reference: replace DESTROYs persistent volumes)
+            for agent_id in {r.agent_id for r in removed if r.volumes}:
+                self.cluster.destroy_volumes(
+                    agent_id, requirement.pod_instance.name)
+        task_records = self._task_records()
+        plan, outcome = self.evaluator.evaluate(
+            requirement, agents, task_records, self.ledger)
+        if plan is None:
+            step.on_no_match("; ".join(outcome.failure_reasons()[:5]))
+            return 0
+        quota_err = self._quota_shortfall(requirement, plan)
+        if quota_err is not None:
+            # same observable behavior as Mesos withholding offers
+            # from an exhausted role: the step waits, and proceeds the
+            # cycle after quota is raised or usage drops
+            step.on_no_match(quota_err)
+            return 0
+        # WAL + step bookkeeping BEFORE the agent is instructed: statuses
+        # may arrive synchronously (fake cluster) or at any time after
+        # launch; the step must already know its task ids
+        self._persist_launch(plan)
+        step.on_launch(plan.task_ids())
+        self.cluster.launch(plan)
         if self.metrics is not None:
-            self.metrics.record_cycle()
-        if self.agent_grace_s > 0:
-            # remote clusters: agents can die mid-run; re-check liveness
-            # every cycle (reference ImplicitReconciler periodic pass)
-            self.reconcile()
-        agents = list(self.cluster.agents())
-        self._replace_tpu_degraded(agents)
-        actions = 0
-        for step in list(self.coordinator.get_candidates()):
-            if isinstance(step, ActionStep):
-                step.execute()
-                actions += 1
-                continue
-            requirement = step.start()
-            if requirement is None:
-                continue
-            if not allow_expand and self._expands_footprint(requirement):
-                step.on_no_match("footprint expansion gated by discipline")
-                continue
-            requirement = self._apply_goal_overrides(requirement)
-            if self._kill_before_relaunch(requirement):
-                step.mark_prepared()
-                actions += 1
-                continue
-            if requirement.recovery_type is RecoveryType.PERMANENT:
-                removed = self.ledger.remove_pod(requirement.pod_instance.name)
-                self.reservation_store.remove(removed)
-                # the replacement must not inherit the failed instance's
-                # data (reference: replace DESTROYs persistent volumes)
-                for agent_id in {r.agent_id for r in removed if r.volumes}:
-                    self.cluster.destroy_volumes(
-                        agent_id, requirement.pod_instance.name)
-            task_records = self._task_records()
-            plan, outcome = self.evaluator.evaluate(
-                requirement, agents, task_records, self.ledger)
-            if plan is None:
-                step.on_no_match("; ".join(outcome.failure_reasons()[:5]))
-                continue
-            quota_err = self._quota_shortfall(requirement, plan)
-            if quota_err is not None:
-                # same observable behavior as Mesos withholding offers
-                # from an exhausted role: the step waits, and proceeds the
-                # cycle after quota is raised or usage drops
-                step.on_no_match(quota_err)
-                continue
-            # WAL + step bookkeeping BEFORE the agent is instructed: statuses
-            # may arrive synchronously (fake cluster) or at any time after
-            # launch; the step must already know its task ids
-            self._persist_launch(plan)
-            step.on_launch(plan.task_ids())
-            self.cluster.launch(plan)
-            if self.metrics is not None:
-                self.metrics.record_launch(len(plan.task_ids()))
-            actions += 1
-        if (not self.uninstall_mode
-                and self.deploy_manager.plan.status is Status.COMPLETE
-                and not self.state.deploy_completed()):
-            self.state.set_deploy_completed()
-        return actions
+            self.metrics.record_launch(len(plan.task_ids()))
+        return 1
 
     def run_until_quiet(self, max_cycles: int = 50) -> int:
         """Drive cycles until nothing launches (tests / sync deployments)."""
@@ -514,9 +602,13 @@ class ServiceScheduler:
     def _persist_launch(self, plan: LaunchPlan) -> None:
         """WAL: tasks + reservations persisted before the agent is instructed
         (reference ``PersistentLaunchRecorder.record()`` before ``accept()``,
-        ``DefaultScheduler.java:453-466``)."""
+        ``DefaultScheduler.java:453-466``). ``_state_lock`` orders the task
+        write against nowait status ingestion's generation check — without
+        it a late status for the REPLACED id can pass its check and land
+        under the new task's slot."""
         stored = [self._stored_task(plan, launch) for launch in plan.launches]
-        self.state.store_tasks(stored)
+        with self._state_lock:
+            self.state.store_tasks(stored)
         for r in plan.reservations:
             self.ledger.add(r)
         self.reservation_store.store(plan.reservations)
@@ -640,9 +732,13 @@ class ServiceScheduler:
         else:
             selected = instance_names
         for task_name in selected:
-            self.state.store_override(task_name, override,
-                                      OverrideProgress.PENDING)
-            self._kill_if_running(task_name)
+            # _state_lock vs nowait status ingestion: _complete_override's
+            # read-modify-write must not interleave with the verb's reset,
+            # or a stale RUNNING status can clobber a fresh pause/resume
+            with self._state_lock:
+                self.state.store_override(task_name, override,
+                                          OverrideProgress.PENDING)
+                self._kill_if_running(task_name)
         return selected
 
     def pause_pod(self, pod_instance_name: str,
@@ -666,11 +762,12 @@ class ServiceScheduler:
     def _replace_pod_locked(self, pod_instance_name: str) -> List[str]:
         touched = []
         for task_name in self.pod_instance_task_names(pod_instance_name):
-            task = self.state.fetch_task(task_name)
-            if task is None:
-                continue
-            self.state.store_tasks([task.failed_permanently()])
-            self._kill_if_running(task_name)
+            with self._state_lock:  # vs nowait ingestion's generation check
+                task = self.state.fetch_task(task_name)
+                if task is None:
+                    continue
+                self.state.store_tasks([task.failed_permanently()])
+                self._kill_if_running(task_name)
             touched.append(task_name)
         return touched
 

@@ -170,6 +170,14 @@ class ServiceClusterView(AgentClient):
     def default_agent_grace_s(self) -> float:
         return getattr(self._multi.cluster, "default_agent_grace_s", 0.0)
 
+    @property
+    def async_status_ok(self) -> bool:
+        # inherit the transport's delivery model: statuses routed from a
+        # RemoteCluster arrive on ITS HTTP threads, so children should
+        # take the same persist-now/feed-later path (core.py
+        # handle_status_nowait)
+        return getattr(self._multi.cluster, "async_status_ok", False)
+
     def agents(self) -> Sequence[AgentInfo]:
         return self._multi.cluster.agents()
 
