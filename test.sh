@@ -11,6 +11,9 @@ make -C native
 echo "== static analysis =="
 python -m tools.static_check
 
+echo "== type check =="
+python -m tools.type_check
+
 echo "== test suite =="
 python -m pytest tests/ -q -m "not soak" "$@"
 
@@ -18,8 +21,9 @@ echo "== framework integration suites =="
 python -m pytest frameworks/ -q "$@"
 
 if [[ "${TPU_SOAK:-}" == "1" ]]; then
-    echo "== soak/churn tier =="
-    python -m pytest tests/test_soak.py -m soak -q
+    echo "== soak/churn tier (TPU_SOAK_MINUTES=${TPU_SOAK_MINUTES:-1}) =="
+    python -m pytest tests/test_soak.py tests/test_soak_native.py \
+        -m soak -q -s
 fi
 
 echo "== airgap lint =="

@@ -1,0 +1,242 @@
+"""The annotation-consistency gate (``tools/type_check.py``) must flag
+seeded type errors and stay at zero findings on idiomatic code — it is a
+hard CI gate, so both directions matter."""
+
+import textwrap
+
+from tools import type_check as tc
+
+
+def run_on(tmp_path, **files):
+    """Write a mini-project and run the checker on it."""
+    paths = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        paths.append(p)
+    # re-root the checker at the tmp project
+    old_repo = tc.REPO
+    tc.REPO = tmp_path
+    try:
+        import ast
+        modules = {}
+        sources = {}
+        for f in tc._iter_py_files([str(tmp_path)]):
+            source = f.read_text()
+            info = tc._index_module(f, ast.parse(source))
+            modules[info.name] = info
+            sources[info.name] = source
+        project = tc.Project(modules)
+        findings = []
+        for info in modules.values():
+            noqa = tc._noqa_lines(sources[info.name])
+            tc._check_typed_attrs(info, project, noqa, findings)
+            tc._check_calls(info, project, noqa, findings)
+        return findings
+    finally:
+        tc.REPO = old_repo
+
+
+LIB = """
+    from dataclasses import dataclass
+
+    @dataclass(frozen=True)
+    class Task:
+        name: str
+        index: int
+        zone: str = "z1"
+
+    class Store:
+        def __init__(self, root: str, cache: bool = False):
+            self.root = root
+            self._items = {}
+
+        def fetch(self, key: str):
+            return self._items.get(key)
+
+    def launch(task: Task, retries: int = 3) -> str:
+        return task.name * retries
+"""
+
+
+def test_clean_project_has_no_findings(tmp_path):
+    findings = run_on(
+        tmp_path, **{
+            "lib.py": LIB,
+            "app.py": """
+            from lib import Store, Task, launch
+
+            def go(t: Task):
+                s = Store("/tmp", cache=True)
+                s.fetch(t.name)
+                return launch(t, retries=2), t.index, t.zone
+            """,
+        })
+    assert findings == []
+
+
+def test_attr_typo_on_annotated_param(tmp_path):
+    findings = run_on(
+        tmp_path, **{
+            "lib.py": LIB,
+            "app.py": """
+            from lib import Task
+
+            def go(t: Task):
+                return t.nam
+            """,
+        })
+    assert len(findings) == 1 and findings[0].code == "T2"
+    assert "nam" in findings[0].message
+
+
+def test_attr_typo_on_ctor_local(tmp_path):
+    findings = run_on(
+        tmp_path, **{
+            "lib.py": LIB,
+            "app.py": """
+            from lib import Store
+
+            def go():
+                s = Store("/tmp")
+                return s.fetchh("k")
+            """,
+        })
+    assert [f.code for f in findings] == ["T2"]
+
+
+def test_reassigned_local_not_pinned(tmp_path):
+    findings = run_on(
+        tmp_path, **{
+            "lib.py": LIB,
+            "app.py": """
+            from lib import Store
+
+            def go(other):
+                s = Store("/tmp")
+                s = other
+                return s.anything_goes
+            """,
+        })
+    assert findings == []
+
+
+def test_cross_module_unknown_kwarg(tmp_path):
+    findings = run_on(
+        tmp_path, **{
+            "lib.py": LIB,
+            "app.py": """
+            from lib import Task, launch
+
+            def go(t: Task):
+                return launch(t, retriez=2)
+            """,
+        })
+    assert [f.code for f in findings] == ["T3"]
+    assert "retriez" in findings[0].message
+
+
+def test_ctor_unknown_kwarg_and_missing_required(tmp_path):
+    findings = run_on(
+        tmp_path, **{
+            "lib.py": LIB,
+            "app.py": """
+            from lib import Store, Task
+
+            def go():
+                Store("/tmp", bogus=1)
+                Task(name="x")          # missing required 'index'
+            """,
+        })
+    codes = sorted(f.code for f in findings)
+    assert codes == ["T3", "T3"]
+    assert any("bogus" in f.message for f in findings)
+    assert any("index" in f.message for f in findings)
+
+
+def test_dataclass_ctor_ok(tmp_path):
+    findings = run_on(
+        tmp_path, **{
+            "lib.py": LIB,
+            "app.py": """
+            from lib import Task
+
+            def go():
+                return Task("a", 1), Task(name="b", index=2, zone="z9")
+            """,
+        })
+    assert findings == []
+
+
+def test_literal_type_mismatch(tmp_path):
+    findings = run_on(
+        tmp_path, **{
+            "lib.py": LIB,
+            "app.py": """
+            from lib import Task, launch
+
+            def go(t: Task):
+                return launch(t, retries="three")
+            """,
+        })
+    assert [f.code for f in findings] == ["T4"]
+
+
+def test_module_attr_call_checked(tmp_path):
+    findings = run_on(
+        tmp_path, **{
+            "pkg/__init__.py": "",
+            "pkg/lib.py": LIB,
+            "app.py": """
+            def go():
+                from pkg import lib
+                return lib.launch(1, 2, 3)   # max 2 positionals
+            """,
+        })
+    assert [f.code for f in findings] == ["T3"]
+
+
+def test_noqa_suppresses(tmp_path):
+    findings = run_on(
+        tmp_path, **{
+            "lib.py": LIB,
+            "app.py": """
+            from lib import Task
+
+            def go(t: Task):
+                return t.nam  # noqa: duck-typed caller
+            """,
+        })
+    assert findings == []
+
+
+def test_unknown_base_class_skipped(tmp_path):
+    findings = run_on(
+        tmp_path, **{
+            "lib.py": """
+            import threading
+
+            class Worker(threading.Thread):
+                def __init__(self):
+                    super().__init__()
+                    self.jobs = 0
+            """,
+            "app.py": """
+            from lib import Worker
+
+            def go():
+                w = Worker()
+                return w.daemon  # Thread attr: surface unresolvable, skip
+            """,
+        })
+    assert findings == []
+
+
+def test_tree_is_clean():
+    """The repo itself must stay at zero findings (CI hard gate)."""
+    import subprocess
+    import sys
+    r = subprocess.run([sys.executable, "-m", "tools.type_check"],
+                       capture_output=True, text=True, cwd=str(tc.REPO))
+    assert r.returncode == 0, r.stdout

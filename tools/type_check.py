@@ -1,0 +1,693 @@
+"""Annotation-consistency gate — the *types* half of the reference's
+static tooling (``mypy.ini:1``, ``TESTING.md:8-28``; the names/structure
+half lives in ``tools/static_check.py``).
+
+No mypy/ruff in this image, so the checks are built on ``ast`` with a
+project-wide index, scoped to what can be verified with ZERO false
+positives on idiomatic code (CI hard-fails on any finding):
+
+T2  attribute existence on typed names: a parameter annotated with a
+    project-local class is only dereferenced with attributes that class
+    (or its resolvable bases) actually defines — dataclass fields,
+    methods, class vars, properties, and every ``self.x = ...`` in any
+    method. Classes with ``__getattr__``/unresolvable bases are skipped.
+T3  cross-module call arity: calls to project functions imported from
+    other modules (``from x import f`` / ``import x; x.f(...)``) are
+    checked against the target's signature — unknown keywords, too many
+    positionals, missing required arguments (including keyword-only).
+    The same check covers CLASS constructors: plain classes via their
+    ``__init__``, ``@dataclass`` classes via their field list.
+T4  literal/annotation mismatch: a str/bytes/num/None literal passed
+    (positionally or by keyword) to a parameter annotated with a
+    disjoint builtin scalar type (e.g. a string into ``x: int``).
+
+Usage: ``python -m tools.type_check [paths...]`` (default: the package,
+frameworks, tools, tests). Exit 1 on any finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+DEFAULT_PATHS = ("dcos_commons_tpu", "frameworks", "tools", "tests",
+                 "bench.py", "__graft_entry__.py")
+
+# bases outside the project whose attribute surface we model; anything
+# else unresolvable makes the class unchackable for T2 (conservative)
+_KNOWN_BASE_ATTRS: Dict[str, Set[str]] = {
+    "object": set(),
+    "Exception": {"args", "with_traceback", "add_note"},
+    "ValueError": {"args", "with_traceback", "add_note"},
+    "RuntimeError": {"args", "with_traceback", "add_note"},
+    "Enum": {"name", "value"},
+    "IntEnum": {"name", "value"},
+    "str": set(dir(str)),
+    "int": set(dir(int)),
+    "dict": set(dir(dict)),
+    "list": set(dir(list)),
+    "tuple": set(dir(tuple)),
+}
+
+
+def _module_name(path: Path) -> str:
+    rel = path.relative_to(REPO)
+    parts = list(rel.with_suffix("").parts)
+    if parts[-1] == "__init__":
+        parts.pop()
+    return ".".join(parts)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: str
+    bases: List[ast.expr]
+    attrs: Set[str] = field(default_factory=set)
+    has_getattr: bool = False
+    is_dataclass: bool = False
+    decorated: bool = False          # non-dataclass class decorators
+    init_fn: Optional[ast.FunctionDef] = None
+    # dataclass constructor fields in order: (name, has_default)
+    dc_fields: List[Tuple[str, bool]] = field(default_factory=list)
+    # resolution state for the attr closure
+    _closed: Optional[Set[str]] = None   # None = not yet computed
+    _closing: bool = False               # cycle guard
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    path: Path
+    tree: ast.Module
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    functions: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    # local name -> ("module", dotted) for `import x.y as z`
+    #            or ("from", module, orig) for `from m import f as g`
+    imports: Dict[str, tuple] = field(default_factory=dict)
+    has_star_import: bool = False
+
+
+class Finding:
+    def __init__(self, path: Path, line: int, code: str, message: str):
+        self.path, self.line, self.code, self.message = (path, line, code,
+                                                         message)
+
+    def __str__(self) -> str:
+        try:
+            rel = self.path.relative_to(REPO)
+        except ValueError:
+            rel = self.path
+        return f"{rel}:{self.line}: {self.code} {self.message}"
+
+
+def _noqa_lines(source: str) -> set:
+    return {i for i, line in enumerate(source.splitlines(), 1)
+            if "# noqa" in line}
+
+
+def _iter_py_files(paths) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        p = (REPO / p) if not Path(p).is_absolute() else Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# index pass
+
+
+def _is_dataclass_deco(deco: ast.expr) -> bool:
+    target = deco.func if isinstance(deco, ast.Call) else deco
+    return (isinstance(target, ast.Name) and target.id == "dataclass") or \
+        (isinstance(target, ast.Attribute) and target.attr == "dataclass")
+
+
+def _collect_class(node: ast.ClassDef, module: str) -> ClassInfo:
+    info = ClassInfo(name=node.name, module=module, bases=list(node.bases))
+    for deco in node.decorator_list:
+        if _is_dataclass_deco(deco):
+            info.is_dataclass = True
+        else:
+            info.decorated = True
+    for stmt in node.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.attrs.add(stmt.name)
+            if stmt.name in ("__getattr__", "__getattribute__"):
+                info.has_getattr = True
+            if stmt.name == "__init__" and isinstance(stmt, ast.FunctionDef):
+                info.init_fn = stmt
+            # every `self.x = ...` / `self.x: T = ...` in any method
+            for sub in ast.walk(stmt):
+                targets = []
+                if isinstance(sub, ast.Assign):
+                    targets = sub.targets
+                elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [sub.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        info.attrs.add(t.attr)
+                    elif isinstance(t, ast.Tuple):
+                        for e in t.elts:
+                            if isinstance(e, ast.Attribute) and \
+                                    isinstance(e.value, ast.Name) and \
+                                    e.value.id == "self":
+                                info.attrs.add(e.attr)
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    info.attrs.add(t.id)
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target,
+                                                            ast.Name):
+            info.attrs.add(stmt.target.id)
+            if info.is_dataclass:
+                has_default = stmt.value is not None
+                info.dc_fields.append((stmt.target.id, has_default))
+    return info
+
+
+def _index_module(path: Path, tree: ast.Module) -> ModuleInfo:
+    mod = ModuleInfo(name=_module_name(path), path=path, tree=tree)
+    # imports are collected from EVERY scope (this codebase lazy-imports
+    # inside functions pervasively); a name imported differently in two
+    # places is poisoned — dropped from resolution entirely
+    poisoned: Set[str] = set()
+
+    def bind(name: str, value: tuple) -> None:
+        if name in poisoned:
+            return
+        if name in mod.imports and mod.imports[name] != value:
+            poisoned.add(name)
+            del mod.imports[name]
+            return
+        mod.imports[name] = value
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node in tree.body:
+            mod.classes[node.name] = _collect_class(node, mod.name)
+        elif isinstance(node, ast.FunctionDef) and node in tree.body:
+            mod.functions[node.name] = node
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                bind(a.asname or a.name.split(".")[0],
+                     ("module", a.name if a.asname
+                      else a.name.split(".")[0]))
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative import: resolve against this module
+                base = mod.name.split(".")
+                # drop the module's own leaf unless it's a package __init__
+                if path.name != "__init__.py":
+                    base = base[:-1]
+                base = base[:len(base) - (node.level - 1)]
+                target = ".".join(base + ([node.module] if node.module
+                                          else []))
+            else:
+                target = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    mod.has_star_import = True
+                    continue
+                bind(a.asname or a.name, ("from", target, a.name))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "lazy_exports" and len(node.args) >= 2 \
+                and isinstance(node.args[1], ast.Dict):
+            # this repo's lazy re-export idiom (dcos_commons_tpu/_lazy.py):
+            # lazy_exports(__name__, {"Exported": "submodule", ...}) —
+            # semantically `from .submodule import Exported`
+            for k, v in zip(node.args[1].keys, node.args[1].values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                        and isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str):
+                    bind(k.value, ("from", f"{mod.name}.{v.value}",
+                                   k.value))
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# resolution
+
+
+class Project:
+    def __init__(self, modules: Dict[str, ModuleInfo]):
+        self.modules = modules
+
+    def resolve_class(self, mod: ModuleInfo, name: str,
+                      _depth: int = 0) -> Optional[ClassInfo]:
+        """Resolve a bare name in ``mod`` to a project ClassInfo, chasing
+        ``from x import C`` chains (incl. package __init__ re-exports)."""
+        if _depth > 8:
+            return None
+        if name in mod.classes:
+            return mod.classes[name]
+        imp = mod.imports.get(name)
+        if imp and imp[0] == "from":
+            target_mod = self.modules.get(imp[1])
+            if target_mod is not None:
+                return self.resolve_class(target_mod, imp[2], _depth + 1)
+        return None
+
+    def resolve_function(self, mod: ModuleInfo, name: str,
+                         _depth: int = 0) -> Optional[ast.FunctionDef]:
+        if _depth > 8:
+            return None
+        if name in mod.functions:
+            return mod.functions[name]
+        if name in mod.classes:
+            return None  # classes handled separately
+        imp = mod.imports.get(name)
+        if imp and imp[0] == "from":
+            target_mod = self.modules.get(imp[1])
+            if target_mod is not None:
+                return self.resolve_function(target_mod, imp[2], _depth + 1)
+        return None
+
+    def attr_surface(self, cls: ClassInfo) -> Optional[Set[str]]:
+        """Full attribute set incl. bases, or None when not fully
+        resolvable (unknown base / __getattr__ / cycles)."""
+        if cls.has_getattr:
+            return None
+        if cls._closed is not None:
+            return cls._closed
+        if cls._closing:
+            return None
+        cls._closing = True
+        try:
+            surface = set(cls.attrs)
+            mod = self.modules.get(cls.module)
+            if mod is None:
+                return None
+            for base in cls.bases:
+                if isinstance(base, ast.Name):
+                    base_name = base.id
+                elif isinstance(base, ast.Attribute):
+                    base_name = base.attr
+                else:
+                    return None  # subscripted generic base etc.
+                if base_name in ("Generic", "Protocol"):
+                    continue
+                base_cls = self.resolve_class(mod, base_name)
+                if base_cls is not None:
+                    base_surface = self.attr_surface(base_cls)
+                    if base_surface is None:
+                        return None
+                    surface |= base_surface
+                elif base_name in _KNOWN_BASE_ATTRS:
+                    surface |= _KNOWN_BASE_ATTRS[base_name]
+                else:
+                    return None
+            cls._closed = surface
+            return surface
+        finally:
+            cls._closing = False
+
+
+# ---------------------------------------------------------------------------
+# annotation handling
+
+
+def _annotation_class_name(ann: ast.expr) -> Optional[str]:
+    """The single concrete class name an annotation pins, or None.
+
+    Handles ``Foo``, ``"Foo"``, ``Optional[Foo]``, ``mod.Foo`` (-> Foo is
+    NOT resolved through attribute annotations — skipped), and rejects
+    unions/containers (no single surface to check)."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Subscript) and isinstance(ann.value, ast.Name) \
+            and ann.value.id == "Optional" \
+            and isinstance(ann.slice, ast.Name):
+        return ann.slice.id
+    return None
+
+
+_SCALARS = {"int": (int,), "float": (int, float), "str": (str,),
+            "bytes": (bytes,), "bool": (bool, int)}
+
+
+def _literal_mismatch(ann: ast.expr, value: ast.expr) -> Optional[str]:
+    """T4: a literal argument whose type is disjoint from a builtin scalar
+    annotation. Conservative: only bare int/float/str/bytes/bool
+    annotations, only Constant literals, None never flagged against
+    Optional/unannotated."""
+    if not isinstance(ann, ast.Name) or ann.id not in _SCALARS:
+        return None
+    if not isinstance(value, ast.Constant):
+        return None
+    v = value.value
+    if v is None:
+        return f"None passed where {ann.id!r} expected"
+    if isinstance(v, bool):
+        # bool is an int subclass; accepted by int/float/bool
+        return (None if ann.id in ("bool", "int", "float")
+                else f"bool literal passed where {ann.id!r} expected")
+    accepted = _SCALARS[ann.id]
+    if isinstance(v, accepted):
+        return None
+    return (f"{type(v).__name__} literal passed where "
+            f"{ann.id!r} expected")
+
+
+# ---------------------------------------------------------------------------
+# signature checking (shared by function calls and constructors)
+
+
+def _check_signature(call: ast.Call, fn: ast.FunctionDef, label: str,
+                     skip_first: bool, path: Path, noqa: set,
+                     findings: List[Finding]) -> None:
+    if call.lineno in noqa:
+        return
+    if any(isinstance(a, ast.Starred) for a in call.args) or \
+            any(kw.arg is None for kw in call.keywords):
+        return
+    if any(not _is_dataclass_deco(d) for d in fn.decorator_list):
+        return  # an arbitrary decorator may reshape the signature
+    a = fn.args
+    pos_params = [*a.posonlyargs, *a.args]
+    if skip_first and pos_params:
+        pos_params = pos_params[1:]  # drop self/cls
+    n_defaults = len(a.defaults)
+    required_pos = [p.arg for p in (pos_params[:-n_defaults] if n_defaults
+                                    else pos_params)]
+    kw_names = {kw.arg for kw in call.keywords}
+    all_params = {p.arg for p in pos_params} | \
+        {p.arg for p in a.kwonlyargs}
+    n_pos = len(call.args)
+
+    if a.kwarg is None:
+        unknown = kw_names - all_params
+        if unknown:
+            findings.append(Finding(
+                path, call.lineno, "T3",
+                f"call to {label} with unknown keyword(s) "
+                f"{sorted(unknown)}"))
+            return
+    if a.vararg is None and n_pos > len(pos_params):
+        findings.append(Finding(
+            path, call.lineno, "T3",
+            f"call to {label} with {n_pos} positional args "
+            f"(max {len(pos_params)})"))
+        return
+    # missing required: positional-or-keyword without default, not covered
+    missing = [p for i, p in enumerate(required_pos)
+               if i >= n_pos and p not in kw_names]
+    required_kwonly = [p.arg for p, d in zip(a.kwonlyargs, a.kw_defaults)
+                       if d is None]
+    missing += [p for p in required_kwonly if p not in kw_names]
+    if missing:
+        findings.append(Finding(
+            path, call.lineno, "T3",
+            f"call to {label} missing required argument(s) "
+            f"{missing}"))
+        return
+    # T4 literal/annotation mismatches on the args that map cleanly
+    for i, arg_node in enumerate(call.args):
+        if i < len(pos_params) and pos_params[i].annotation is not None:
+            msg = _literal_mismatch(pos_params[i].annotation, arg_node)
+            if msg:
+                findings.append(Finding(
+                    path, arg_node.lineno, "T4",
+                    f"{label} parameter '{pos_params[i].arg}': {msg}"))
+    by_name = {p.arg: p for p in [*pos_params, *a.kwonlyargs]}
+    for kw in call.keywords:
+        p = by_name.get(kw.arg)
+        if p is not None and p.annotation is not None:
+            msg = _literal_mismatch(p.annotation, kw.value)
+            if msg:
+                findings.append(Finding(
+                    path, kw.value.lineno, "T4",
+                    f"{label} parameter '{p.arg}': {msg}"))
+
+
+def _check_dataclass_ctor(call: ast.Call, cls: ClassInfo, project: Project,
+                          path: Path, noqa: set,
+                          findings: List[Finding]) -> None:
+    """Constructor check for non-inherited dataclasses (inherited field
+    order needs the MRO — skipped)."""
+    mod = project.modules.get(cls.module)
+    if mod is None or cls.decorated or cls.init_fn is not None:
+        return
+    for base in cls.bases:
+        if not (isinstance(base, ast.Name) and base.id == "object"):
+            return
+    if call.lineno in noqa:
+        return
+    if any(isinstance(a, ast.Starred) for a in call.args) or \
+            any(kw.arg is None for kw in call.keywords):
+        return
+    names = [n for n, _ in cls.dc_fields]
+    kw_names = {kw.arg for kw in call.keywords}
+    unknown = kw_names - set(names)
+    if unknown:
+        findings.append(Finding(
+            path, call.lineno, "T3",
+            f"dataclass {cls.name}(...) with unknown field(s) "
+            f"{sorted(unknown)}"))
+        return
+    if len(call.args) > len(names):
+        findings.append(Finding(
+            path, call.lineno, "T3",
+            f"dataclass {cls.name}(...) with {len(call.args)} positional "
+            f"args (max {len(names)})"))
+        return
+    missing = [n for i, (n, has_default) in enumerate(cls.dc_fields)
+               if not has_default and i >= len(call.args)
+               and n not in kw_names]
+    if missing:
+        findings.append(Finding(
+            path, call.lineno, "T3",
+            f"dataclass {cls.name}(...) missing required field(s) "
+            f"{missing}"))
+
+
+# ---------------------------------------------------------------------------
+# per-file check pass
+
+
+def _rebound_names(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    for n in ast.walk(item.optional_vars):
+                        if isinstance(n, ast.Name):
+                            out.add(n.id)
+        elif isinstance(node, ast.NamedExpr) and \
+                isinstance(node.target, ast.Name):
+            out.add(node.target.id)
+    return out
+
+
+def _check_typed_attrs(mod: ModuleInfo, project: Project, noqa: set,
+                       findings: List[Finding]) -> None:
+    """T2: attribute loads on names whose class is pinned — annotated
+    parameters, plus locals bound EXACTLY once by a bare constructor
+    call (``x = SomeClass(...)``)."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        rebound = _rebound_names(node)
+
+        def pin(name: str, cname: str, typed=None) -> None:
+            cls = project.resolve_class(mod, cname)
+            if cls is None:
+                return
+            surface = project.attr_surface(cls)
+            if surface is None:
+                return
+            typed[name] = (cls, surface)
+
+        typed: Dict[str, Tuple[ClassInfo, Set[str]]] = {}
+        for arg in [*node.args.posonlyargs, *node.args.args,
+                    *node.args.kwonlyargs]:
+            if arg.annotation is None or arg.arg in rebound:
+                continue
+            cname = _annotation_class_name(arg.annotation)
+            if cname is not None:
+                pin(arg.arg, cname, typed)
+        # single-assignment constructor locals: x = ClassName(...) pins
+        # x's type iff that plain assign is the name's ONLY binding
+        assign_counts: Dict[str, int] = {}
+        ctor_binding: Dict[str, str] = {}
+        other_bound: Set[str] = {a.arg for a in
+                                 [*node.args.posonlyargs, *node.args.args,
+                                  *node.args.kwonlyargs]}
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name):
+                name = sub.targets[0].id
+                assign_counts[name] = assign_counts.get(name, 0) + 1
+                if isinstance(sub.value, ast.Call) and \
+                        isinstance(sub.value.func, ast.Name):
+                    ctor_binding[name] = sub.value.func.id
+                continue
+            targets: List[ast.expr] = []
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                targets = [sub.target]
+            elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                targets = [sub.target]
+            elif isinstance(sub, (ast.With, ast.AsyncWith)):
+                targets = [i.optional_vars for i in sub.items
+                           if i.optional_vars is not None]
+            elif isinstance(sub, ast.NamedExpr):
+                targets = [sub.target]
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not node:
+                # nested defs: their params shadow; be conservative
+                other_bound.update(
+                    a.arg for a in [*sub.args.posonlyargs, *sub.args.args,
+                                    *sub.args.kwonlyargs])
+                continue
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        other_bound.add(n.id)
+        for name, cname in ctor_binding.items():
+            if (assign_counts.get(name) == 1 and name not in typed
+                    and name not in other_bound):
+                pin(name, cname, typed)
+        if not typed:
+            continue
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.ctx, ast.Load)
+                    and isinstance(sub.value, ast.Name)):
+                continue
+            entry = typed.get(sub.value.id)
+            if entry is None or sub.lineno in noqa:
+                continue
+            cls, surface = entry
+            if sub.attr.startswith("__") or sub.attr in surface:
+                continue
+            findings.append(Finding(
+                mod.path, sub.lineno, "T2",
+                f"'{sub.value.id}: {cls.name}' has no attribute "
+                f"'{sub.attr}'"))
+
+
+def _check_calls(mod: ModuleInfo, project: Project, noqa: set,
+                 findings: List[Finding]) -> None:
+    """T3/T4 on cross-module calls (same-module calls are A1's beat)."""
+    # names rebound ANYWHERE in the module disqualify resolution
+    rebound: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        rebound.add(n.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for arg in [*node.args.posonlyargs, *node.args.args,
+                        *node.args.kwonlyargs]:
+                rebound.add(arg.arg)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = None
+        cls = None
+        label = ""
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+            if name in rebound or name in mod.functions \
+                    or name in mod.classes:
+                continue  # local defs stay A1's business
+            imp = mod.imports.get(name)
+            if imp is None or imp[0] != "from":
+                continue
+            fn = project.resolve_function(mod, name)
+            cls = project.resolve_class(mod, name)
+            label = f"'{name}'"
+        elif isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name):
+            base = node.func.value.id
+            if base in rebound:
+                continue
+            imp = mod.imports.get(base)
+            if imp is None:
+                continue
+            # `import x.y as z` binds a module; so does
+            # `from pkg import submodule` when pkg.submodule is a module
+            modname = imp[1] if imp[0] == "module" else f"{imp[1]}.{imp[2]}"
+            target = project.modules.get(modname)
+            if target is None:
+                continue
+            fn = target.functions.get(node.func.attr)
+            cls = target.classes.get(node.func.attr)
+            label = f"'{modname}.{node.func.attr}'"
+        if fn is not None:
+            _check_signature(node, fn, label, skip_first=False,
+                             path=mod.path, noqa=noqa, findings=findings)
+        elif cls is not None:
+            if cls.is_dataclass:
+                _check_dataclass_ctor(node, cls, project, mod.path, noqa,
+                                      findings)
+            elif cls.init_fn is not None and not cls.decorated \
+                    and not cls.bases:
+                _check_signature(node, cls.init_fn, f"'{cls.name}()'",
+                                 skip_first=True, path=mod.path,
+                                 noqa=noqa, findings=findings)
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    paths = (argv if argv else sys.argv[1:]) or list(DEFAULT_PATHS)
+    files = _iter_py_files(paths)
+    modules: Dict[str, ModuleInfo] = {}
+    sources: Dict[str, str] = {}
+    for f in files:
+        try:
+            source = f.read_text(encoding="utf-8")
+            tree = ast.parse(source, filename=str(f))
+        except (OSError, UnicodeDecodeError, SyntaxError):
+            continue  # static_check reports these
+        info = _index_module(f, tree)
+        modules[info.name] = info
+        sources[info.name] = source
+    project = Project(modules)
+    findings: List[Finding] = []
+    for info in modules.values():
+        noqa = _noqa_lines(sources[info.name])
+        _check_typed_attrs(info, project, noqa, findings)
+        _check_calls(info, project, noqa, findings)
+    for finding in findings:
+        print(finding)
+    print(f"type_check: {len(files)} files, {len(findings)} findings")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
