@@ -1,0 +1,216 @@
+"""Real-binary endurance soak (reference tier-4 intent,
+``frameworks/helloworld/tests/scale/test_scale.py:16-35``): N minutes of
+kill/replace/config-roll churn against the REAL C++ agent binaries over
+the REAL HTTP+TLS+auth stack, with resource-leak assertions the
+in-process churn tier (``test_soak.py``) cannot make — scheduler RSS,
+agent file descriptors, sandbox-dir accounting.
+
+Opt-in: ``TPU_SOAK=1 TPU_SOAK_MINUTES=10 ./test.sh`` (default 1 minute
+when only ``TPU_SOAK`` is set). The assertions are duration-independent:
+they compare end-state against a post-warmup baseline, so a 1-minute CI
+run and a multi-hour operator run use the same bands.
+"""
+
+import json
+import os
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+from dcos_commons_tpu.agent import RemoteCluster
+from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler import ServiceScheduler
+from dcos_commons_tpu.scheduler.runner import CycleDriver
+from dcos_commons_tpu.security import (Authenticator, generate_auth_config,
+                                       mint_server_credentials)
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import MemPersister
+
+pytestmark = pytest.mark.soak
+
+NATIVE_BIN = Path(__file__).resolve().parent.parent / "native" / "bin"
+
+SOAK_YML = """
+name: soak-svc
+pods:
+  web:
+    count: 2
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "sleep 600"
+        cpus: 0.2
+        memory: 64
+        env: {ROLL: "{{ROLL}}"}
+  store:
+    count: 1
+    volume: {path: data, size: 32}
+    tasks:
+      server: {goal: RUNNING, cmd: "sleep 600", cpus: 0.2, memory: 64}
+"""
+
+
+def _rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    raise AssertionError("no VmRSS")
+
+
+def _fd_count(pid: int) -> int:
+    return len(os.listdir(f"/proc/{pid}/fd"))
+
+
+def _wait(pred, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise AssertionError(f"soak: timed out waiting for {what}")
+
+
+def test_endurance_churn_against_real_agents(tmp_path):
+    minutes = float(os.environ.get("TPU_SOAK_MINUTES", "1"))
+    auth = Authenticator.from_config(generate_auth_config())
+    persister = MemPersister()
+    creds = mint_server_credentials(persister, "soak-svc")
+    cluster = RemoteCluster(expiry_s=10.0, poll_interval_s=0.1)
+    sched = ServiceScheduler(
+        load_service_yaml_str(SOAK_YML, {"ROLL": "0"}), persister, cluster,
+        auth=auth)
+    server = ApiServer(sched, port=0, cluster=cluster, tls=creds,
+                       auth=auth)
+    server.start()
+    url = f"https://127.0.0.1:{server.port}"
+    ca = tmp_path / "ca.pem"
+    ca.write_bytes(creds.ca_pem)
+    secret = tmp_path / "fleet.secret"
+    secret.write_text(auth.accounts["fleet"].secret + "\n")
+
+    env = dict(os.environ, TPU_TLS_CA=str(ca), TPU_AUTH_UID="fleet",
+               TPU_AUTH_SECRET_FILE=str(secret))
+    agents = []
+    sandbox_roots = []
+    for i in range(2):
+        root = tmp_path / f"sb{i}"
+        sandbox_roots.append(root)
+        agents.append(subprocess.Popen(
+            [str(NATIVE_BIN / "tpu-agent"), "--scheduler", url,
+             "--agent-id", f"s{i}", "--hostname", f"soak{i}",
+             "--cpus", "4", "--memory-mb", "4096", "--disk-mb", "8192",
+             "--base-dir", str(root), "--poll-interval", "0.1"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+
+    launched_task_ids: set = set()
+
+    def settled() -> bool:
+        if sched.plan("deploy").status is not Status.COMPLETE:
+            sched.run_cycle()
+            return False
+        recovery = sched.plan("recovery")
+        if recovery is not None and recovery.status not in (
+                Status.COMPLETE, Status.PENDING):
+            sched.run_cycle()
+            return False
+        for t in sched.state.fetch_tasks():
+            launched_task_ids.add(t.task_id)
+            s = sched.state.fetch_status(t.task_name)
+            if s is None or s.task_id != t.task_id \
+                    or s.state.value != "TASK_RUNNING":
+                sched.run_cycle()
+                return False
+        return True
+
+    driver = CycleDriver(sched, interval_s=0.1)
+    stats = {"kills": 0, "replaces": 0, "rolls": 0}
+    try:
+        with driver:
+            _wait(settled, 60, "initial deploy")
+            for t in sched.state.fetch_tasks():
+                launched_task_ids.add(t.task_id)
+
+            # post-warmup baseline AFTER one of each churn op has run
+            # (lazy allocations — TLS sessions, thread stacks, caches —
+            # land during the first ops and are not leaks)
+            deadline = time.time() + minutes * 60.0
+            roll = 0
+            i = 0
+            baseline = None
+            while time.time() < deadline:
+                op = i % 3
+                i += 1
+                if op == 0:
+                    sched.restart_pod("web-0")
+                    stats["kills"] += 1
+                elif op == 1:
+                    sched.replace_pod("store-0")
+                    stats["replaces"] += 1
+                else:
+                    roll += 1
+                    spec = load_service_yaml_str(SOAK_YML,
+                                                 {"ROLL": str(roll)})
+                    result = sched.update_config(spec)
+                    assert not result.errors, result.errors
+                    stats["rolls"] += 1
+                _wait(settled, 120, f"settle after op {i}")
+                # invariants, every iteration (test_soak.py's, live)
+                assert len(cluster.agents()) == 2
+                reservations = sched.ledger.all()
+                names = [r.pod_instance_name for r in reservations]
+                assert len(names) == len(set(
+                    (r.pod_instance_name, r.resource_set_id)
+                    for r in reservations)), "duplicate reservations"
+                assert len(reservations) <= 4, (
+                    f"reservation leak: {len(reservations)}")
+                if baseline is None and i >= 3:
+                    baseline = (_rss_mb(),
+                                [_fd_count(a.pid) for a in agents])
+            assert baseline is not None, (
+                "soak too short for a baseline: raise TPU_SOAK_MINUTES")
+
+            # leak bands: RSS may wobble with caches; a leak per churn op
+            # would grow without bound, so a generous fixed band is still
+            # a real detector over any soak length
+            rss0, fds0 = baseline
+            rss1 = _rss_mb()
+            fds1 = [_fd_count(a.pid) for a in agents]
+            assert rss1 < rss0 * 1.5 + 64, (
+                f"scheduler RSS grew {rss0:.0f} -> {rss1:.0f} MB")
+            for before, after, agent in zip(fds0, fds1, agents):
+                assert after <= before + 8, (
+                    f"agent {agent.pid} fds {before} -> {after}")
+            # sandbox accounting: every dir corresponds to a launched
+            # task id or a pod volume tree — nothing else may appear
+            for root in sandbox_roots:
+                if not root.exists():
+                    continue
+                for entry in root.iterdir():
+                    assert entry.name == "volumes" \
+                        or entry.name in launched_task_ids, (
+                            f"unaccounted sandbox dir {entry}")
+            print(json.dumps({
+                "metric": "soak_native",
+                "minutes": minutes,
+                **stats,
+                "peak_rss_mb": round(rss1, 1),
+                "agent_fds": fds1,
+                "sandboxes": sum(
+                    len(list(r.iterdir())) for r in sandbox_roots
+                    if r.exists()),
+            }))
+    finally:
+        for p in agents:
+            p.terminate()
+        for p in agents:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        server.stop()
