@@ -1,0 +1,90 @@
+"""Token sampling for the serving path: temperature, top-k, nucleus.
+
+The decode loops (``models/llama.py``: ``generate`` / ``generate_chunked``
+/ ``generate_stepwise``) are greedy by default; a sampler built here drops
+in wherever the argmax was. Everything is shape-static and branch-free so
+samplers compile into the decode scan unchanged:
+
+* top-k uses ``lax.top_k`` (k is a Python int, so the threshold — the
+  k-th largest logit — is a static-shape reduction);
+* top-p sorts the row (V ~ 32k sorts fine on TPU), takes the softmax
+  cumsum, and masks every token whose *preceding* cumulative mass already
+  reached p — the standard nucleus rule that always keeps the top token;
+* filtering composes by masking to ``-inf`` before
+  ``jax.random.categorical`` (Gumbel-max over the surviving logits).
+
+The reference scheduler has no serving stack (Java control plane; see
+SURVEY §2.4) — this is workload-layer capability for BASELINE.json
+config #5's inference path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jnp.ndarray
+
+# sample(key, logits [B, V]) -> tokens [B] int32
+Sampler = Callable[[jax.Array, Array], Array]
+
+_NEG_INF = float("-inf")
+
+
+def top_k_mask(logits: Array, k: int) -> Array:
+    """Keep the k largest logits per row, -inf elsewhere (ties at the
+    threshold all survive, matching the usual implementation)."""
+    kth = lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def top_p_mask(logits: Array, p: float) -> Array:
+    """Nucleus filtering: keep the smallest prefix of the
+    probability-sorted vocabulary whose mass reaches ``p``."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+    # mass strictly BEFORE each position: position 0 is always kept
+    before = jnp.cumsum(probs, axis=-1) - probs
+    cut = jnp.sum(before < p, axis=-1, keepdims=True)      # tokens kept
+    threshold = jnp.take_along_axis(sorted_logits, cut - 1, axis=-1)
+    return jnp.where(logits < threshold, _NEG_INF, logits)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfiguredSampler:
+    """A callable sampler that hashes/compares by its settings, so decode
+    executable caches keyed on the sampler object (``generate_chunked``)
+    hit across equal-config instances — building a fresh sampler per
+    request must not recompile."""
+
+    temperature: float
+    top_k: int
+    top_p: float
+
+    def __call__(self, key: jax.Array, logits: Array) -> Array:
+        x = logits.astype(jnp.float32)
+        if self.top_k:
+            x = top_k_mask(x, self.top_k)
+        if 0.0 < self.top_p < 1.0:
+            x = top_p_mask(x, self.top_p)
+        return jax.random.categorical(key, x / self.temperature, axis=-1)
+
+
+def make_sampler(temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0) -> Optional[Sampler]:
+    """Build a sampler, or ``None`` for greedy (temperature 0).
+
+    Filters apply in the conventional order (top-k, then top-p over the
+    survivors), then Gumbel-max categorical over ``logits/temperature``.
+    """
+    if temperature == 0.0:
+        return None
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+    return ConfiguredSampler(temperature, top_k, top_p)

@@ -216,34 +216,42 @@ def run_llama(args) -> dict:
 
     contract = distributed.initialize()
     n = jax.device_count()
+    kv_quant = getattr(args, "kv_quant", False)
     if args.preset == "8b":
         # serving KV budget: 2048 default (0.5 GB at 8B) unless overridden;
         # weights only fit one chip quantized (~8.5 GB int8 vs 16 GB bf16)
         cfg = llama.LlamaConfig.llama3_8b(max_seq=args.max_seq or 2048,
-                                          remat=False)
+                                          remat=False, kv_quant=kv_quant)
     elif args.max_seq:
-        cfg = llama.LlamaConfig.tiny(max_seq=args.max_seq)
+        cfg = llama.LlamaConfig.tiny(max_seq=args.max_seq,
+                                     kv_quant=kv_quant)
     else:
-        cfg = llama.LlamaConfig.tiny()
+        cfg = llama.LlamaConfig.tiny(kv_quant=kv_quant)
     mesh = MeshSpec(tp=n).build()
     gen_len = args.gen_len
-    # stepwise for the big preset: the fused nested-scan generate takes
-    # minutes to compile at 8B through tunneled backends; per-token
-    # dispatch is hidden behind HBM-bound weight streaming anyway
-    stepwise = args.preset == "8b" or args.quant != "none"
+    # chunked for the big preset: the fused nested-scan generate takes
+    # minutes to compile at 8B through tunneled backends; decode_chunk
+    # compiles one K-step scan in seconds and amortizes per-step
+    # dispatch K-fold (models/llama.py:decode_chunk)
+    chunked = args.preset == "8b" or args.quant != "none"
+
+    # chunked rounds the continuation up to whole chunks before trimming;
+    # divide by the EXECUTED token count or tps reads low off-alignment
+    exec_len = (1 + -(-(gen_len - 1) // 16) * 16) if chunked else gen_len
 
     def timed_decode(prompt):
         # prompt must stay (1, 4) int32 so the compiled executable is reused
         t0 = time.perf_counter()
         with mesh:
-            if stepwise:
-                toks = llama.generate_stepwise(cfg, params, prompt,
-                                               gen_len, mesh=mesh)
+            if chunked:
+                toks = llama.generate_chunked(cfg, params, prompt,
+                                              gen_len, chunk=16,
+                                              mesh=mesh)
             else:
                 toks = llama.generate(cfg, params, prompt, gen_len,
                                       mesh=mesh)
         jax.block_until_ready(toks)
-        return round(gen_len / max(time.perf_counter() - t0, 1e-9), 2)
+        return round(exec_len / max(time.perf_counter() - t0, 1e-9), 2)
 
     with mesh:
         if args.quant == "int8":
@@ -266,7 +274,8 @@ def run_llama(args) -> dict:
         x.size * x.dtype.itemsize for x in jax.tree.leaves(params)
     ) / 1e9
     result = {"workload": "llama", "preset": args.preset,
-              "quant": args.quant, "weight_gb": round(weight_gb, 2),
+              "quant": args.quant, "kv_quant": kv_quant,
+              "weight_gb": round(weight_gb, 2),
               "tokens_per_sec": tokens_per_sec,
               "tp": n, "process_id": contract["process_id"]}
     if args.serve:
@@ -480,6 +489,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--depth", type=int, default=50,
                    help="resnet depth (18 for CPU smoke tests)")
     p.add_argument("--preset", default="tiny", choices=["tiny", "8b"])
+    p.add_argument("--kv-quant", action="store_true",
+                   help="int8 KV cache (models/llama.py init_kv_cache): "
+                        "halves cache traffic / doubles KV that fits")
     p.add_argument("--quant", default="none", choices=["none", "int8"],
                    help="llama: weight-only int8 serving (ops/quant.py); "
                         "required to fit the 8b preset on one 16 GB chip")

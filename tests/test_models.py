@@ -174,6 +174,22 @@ def test_llama_generate_stepwise_matches_fused():
     assert np.array_equal(np.asarray(fused), np.asarray(stepwise))
 
 
+def test_llama_generate_chunked_matches_stepwise():
+    """Chunked decode (K steps per dispatch) emits the exact stepwise
+    token stream, including when steps is not a chunk multiple (the
+    rounded-up tail is trimmed)."""
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0,
+                                cfg.vocab_size)
+    want = llama.generate_stepwise(cfg, params, prompt, steps=7)
+    for chunk in (1, 3, 8):
+        got = llama.generate_chunked(cfg, params, prompt, steps=7,
+                                     chunk=chunk)
+        assert got.shape == want.shape
+        assert np.array_equal(np.asarray(want), np.asarray(got)), chunk
+
+
 @pytest.mark.parametrize("attn_impl", ["dense", "ring", "ulysses"])
 def test_llama_sharded_attention_impls_agree(attn_impl):
     """dp=2/sp=2/tp=2 sharded loss equals the single-device dense loss."""

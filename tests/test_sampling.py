@@ -1,0 +1,119 @@
+"""Sampling (ops/sampling.py): filters, determinism, decode integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcos_commons_tpu.models import llama
+from dcos_commons_tpu.ops import sampling
+
+
+def test_greedy_is_none():
+    assert sampling.make_sampler(temperature=0.0) is None
+
+
+def test_invalid_args_rejected():
+    with pytest.raises(ValueError):
+        sampling.make_sampler(temperature=-1.0)
+    with pytest.raises(ValueError):
+        sampling.make_sampler(temperature=1.0, top_p=1.5)
+
+
+def test_top_k_mask_keeps_k_largest():
+    logits = jnp.array([[1.0, 5.0, 3.0, 4.0, 2.0]])
+    out = np.asarray(sampling.top_k_mask(logits, 2))
+    assert np.isfinite(out[0, [1, 3]]).all()
+    assert np.isneginf(out[0, [0, 2, 4]]).all()
+
+
+def test_top_p_mask_nucleus_rule():
+    # softmax of [3, 2, 0, -10] ~ [0.72, 0.26, 0.036, ~0]
+    logits = jnp.array([[3.0, 2.0, 0.0, -10.0]])
+    # p=0.5: top token alone reaches it
+    out = np.asarray(sampling.top_p_mask(logits, 0.5))
+    assert np.isfinite(out[0, 0]) and np.isneginf(out[0, 1:]).all()
+    # p=0.9: need the second token too
+    out = np.asarray(sampling.top_p_mask(logits, 0.9))
+    assert np.isfinite(out[0, :2]).all() and np.isneginf(out[0, 2:]).all()
+
+
+def test_top_p_tiny_p_keeps_argmax():
+    logits = jnp.array([[0.1, 0.9, 0.5]])
+    out = np.asarray(sampling.top_p_mask(logits, 1e-9))
+    assert np.isfinite(out[0, 1])
+    assert np.isneginf(out[0, [0, 2]]).all()
+
+
+def test_sampler_deterministic_and_respects_top_k():
+    sampler = sampling.make_sampler(temperature=1.0, top_k=2)
+    logits = jax.random.normal(jax.random.key(0), (4, 32))
+    allowed = np.asarray(jax.lax.top_k(logits, 2)[1])
+    a = np.asarray(sampler(jax.random.key(1), logits))
+    b = np.asarray(sampler(jax.random.key(1), logits))
+    c = np.asarray(sampler(jax.random.key(2), logits))
+    assert np.array_equal(a, b)
+    assert not np.array_equal(a, c)  # 32-way rows; collision ~ impossible
+    for row, tok in enumerate(a):
+        assert tok in allowed[row]
+
+
+def test_sampler_matches_softmax_distribution():
+    """Empirical frequencies at temperature 1 track the softmax."""
+    logits = jnp.array([2.0, 1.0, 0.0, -1.0])
+    probs = np.asarray(jax.nn.softmax(logits))
+    sampler = sampling.make_sampler(temperature=1.0)
+    keys = jax.random.split(jax.random.key(0), 4000)
+    draws = np.asarray(jax.vmap(
+        lambda k: sampler(k, logits[None, :])[0])(keys))
+    freq = np.bincount(draws, minlength=4) / len(draws)
+    np.testing.assert_allclose(freq, probs, atol=0.03)
+
+
+def test_generate_chunked_sampled_deterministic_per_key():
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0,
+                                cfg.vocab_size)
+    sampler = sampling.make_sampler(temperature=1.0, top_k=8)
+    a = llama.generate_chunked(cfg, params, prompt, steps=6, chunk=4,
+                               sampler=sampler, key=jax.random.key(7))
+    b = llama.generate_chunked(cfg, params, prompt, steps=6, chunk=4,
+                               sampler=sampler, key=jax.random.key(7))
+    c = llama.generate_chunked(cfg, params, prompt, steps=6, chunk=4,
+                               sampler=sampler, key=jax.random.key(8))
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_equal_config_samplers_share_executables():
+    """Per-request make_sampler calls must hit the chunk-executable
+    cache: equal settings -> equal/hash-equal sampler objects."""
+    a = sampling.make_sampler(temperature=0.7, top_k=40, top_p=0.9)
+    b = sampling.make_sampler(temperature=0.7, top_k=40, top_p=0.9)
+    c = sampling.make_sampler(temperature=0.8, top_k=40, top_p=0.9)
+    assert a == b and hash(a) == hash(b)
+    assert a != c
+    before = len(llama._CHUNKED_CACHE)
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (1, 4), 0,
+                                cfg.vocab_size)
+    llama.generate_chunked(cfg, params, prompt, steps=4, chunk=4,
+                           sampler=a, key=jax.random.key(0))
+    llama.generate_chunked(cfg, params, prompt, steps=4, chunk=4,
+                           sampler=b, key=jax.random.key(1))
+    assert len(llama._CHUNKED_CACHE) == before + 1
+
+
+def test_generate_chunked_low_temperature_is_greedy():
+    """temperature -> 0 recovers the greedy stream (same executable)."""
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0,
+                                cfg.vocab_size)
+    greedy = llama.generate_chunked(cfg, params, prompt, steps=6, chunk=4)
+    sampler = sampling.make_sampler(temperature=1e-4)
+    cold = llama.generate_chunked(cfg, params, prompt, steps=6, chunk=4,
+                                  sampler=sampler, key=jax.random.key(3))
+    assert np.array_equal(np.asarray(greedy), np.asarray(cold))

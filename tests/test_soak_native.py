@@ -77,6 +77,10 @@ def _wait(pred, timeout, what):
 
 def test_endurance_churn_against_real_agents(tmp_path):
     minutes = float(os.environ.get("TPU_SOAK_MINUTES", "1"))
+    # build the real binaries (same entry point as test_native.py's
+    # session fixture) so a fresh checkout soaks instead of erroring
+    subprocess.run(["make", "-C", str(NATIVE_BIN.parent)], check=True,
+                   capture_output=True)
     auth = Authenticator.from_config(generate_auth_config())
     persister = MemPersister()
     creds = mint_server_credentials(persister, "soak-svc")
@@ -95,19 +99,8 @@ def test_endurance_churn_against_real_agents(tmp_path):
 
     env = dict(os.environ, TPU_TLS_CA=str(ca), TPU_AUTH_UID="fleet",
                TPU_AUTH_SECRET_FILE=str(secret))
-    agents = []
+    agents: list = []
     sandbox_roots = []
-    for i in range(2):
-        root = tmp_path / f"sb{i}"
-        sandbox_roots.append(root)
-        agents.append(subprocess.Popen(
-            [str(NATIVE_BIN / "tpu-agent"), "--scheduler", url,
-             "--agent-id", f"s{i}", "--hostname", f"soak{i}",
-             "--cpus", "4", "--memory-mb", "4096", "--disk-mb", "8192",
-             "--base-dir", str(root), "--poll-interval", "0.1"],
-            env=env, stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL))
-
     launched_task_ids: set = set()
 
     def settled() -> bool:
@@ -131,6 +124,19 @@ def test_endurance_churn_against_real_agents(tmp_path):
     driver = CycleDriver(sched, interval_s=0.1)
     stats = {"kills": 0, "replaces": 0, "rolls": 0}
     try:
+        # agents spawn inside the try so a failed Popen (missing binary,
+        # exec error) still tears down the server and earlier agents
+        for i in range(2):
+            root = tmp_path / f"sb{i}"
+            sandbox_roots.append(root)
+            agents.append(subprocess.Popen(
+                [str(NATIVE_BIN / "tpu-agent"), "--scheduler", url,
+                 "--agent-id", f"s{i}", "--hostname", f"soak{i}",
+                 "--cpus", "4", "--memory-mb", "4096",
+                 "--disk-mb", "8192",
+                 "--base-dir", str(root), "--poll-interval", "0.1"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
         with driver:
             _wait(settled, 60, "initial deploy")
             for t in sched.state.fetch_tasks():
@@ -143,7 +149,9 @@ def test_endurance_churn_against_real_agents(tmp_path):
             roll = 0
             i = 0
             baseline = None
+            peak_rss = 0.0
             while time.time() < deadline:
+                peak_rss = max(peak_rss, _rss_mb())
                 op = i % 3
                 i += 1
                 if op == 0:
@@ -180,6 +188,7 @@ def test_endurance_churn_against_real_agents(tmp_path):
             # a real detector over any soak length
             rss0, fds0 = baseline
             rss1 = _rss_mb()
+            peak_rss = max(peak_rss, rss1)
             fds1 = [_fd_count(a.pid) for a in agents]
             assert rss1 < rss0 * 1.5 + 64, (
                 f"scheduler RSS grew {rss0:.0f} -> {rss1:.0f} MB")
@@ -199,7 +208,8 @@ def test_endurance_churn_against_real_agents(tmp_path):
                 "metric": "soak_native",
                 "minutes": minutes,
                 **stats,
-                "peak_rss_mb": round(rss1, 1),
+                "peak_rss_mb": round(peak_rss, 1),
+                "final_rss_mb": round(rss1, 1),
                 "agent_fds": fds1,
                 "sandboxes": sum(
                     len(list(r.iterdir())) for r in sandbox_roots

@@ -26,7 +26,14 @@ import json
 import time
 
 
-def _build_cfg(args, llama):
+def _build_cfg(args, llama, kv_quant=None):
+    import dataclasses
+    cfg = _preset_cfg(args, llama)
+    kv = args.kv_quant if kv_quant is None else kv_quant
+    return dataclasses.replace(cfg, kv_quant=True) if kv else cfg
+
+
+def _preset_cfg(args, llama):
     if args.preset == "8b":
         # the flagship: Llama-3-8B architecture, serving KV budget
         return llama.LlamaConfig.llama3_8b(max_seq=args.max_seq or 2048,
@@ -80,7 +87,8 @@ def run_quality(args, jax, jnp, llama) -> dict:
     quantizer."""
     import numpy as np
 
-    cfg = _build_cfg(args, llama)
+    cfg = _build_cfg(args, llama, kv_quant=False)
+    qcfg = _build_cfg(args, llama)         # honors --kv-quant
     params = llama.init_params(cfg, jax.random.key(0))
     qparams = llama.quantize_params(params)
     b, s = max(args.batch, 4), 64
@@ -101,16 +109,17 @@ def run_quality(args, jax, jnp, llama) -> dict:
     steps = args.steps
     short = prompt[:, :8]
     prefill_x, step_x = llama._stepwise_executables(cfg, None)
+    prefill_q, step_q = llama._stepwise_executables(qcfg, None)
     cache_r = llama.init_kv_cache(cfg, b, cfg.max_seq)
-    cache_q = llama.init_kv_cache(cfg, b, cfg.max_seq)
+    cache_q = llama.init_kv_cache(qcfg, b, qcfg.max_seq)
     lr, cache_r = prefill_x(params, cache_r, short)
-    lq, cache_q = prefill_x(qparams, cache_q, short)
+    lq, cache_q = prefill_q(qparams, cache_q, short)
     agree_steps = 0.0
     for i in range(steps):
         tok = jnp.argmax(lr, axis=-1).astype(short.dtype)
         agree_steps += float((jnp.argmax(lq, axis=-1) == tok).mean())
         lr, cache_r = step_x(params, cache_r, jnp.int32(8 + i), tok)
-        lq, cache_q = step_x(qparams, cache_q, jnp.int32(8 + i), tok)
+        lq, cache_q = step_q(qparams, cache_q, jnp.int32(8 + i), tok)
 
     return {
         "metric": "llama_int8_quality",
@@ -122,6 +131,7 @@ def run_quality(args, jax, jnp, llama) -> dict:
         "median_top1_margin": round(float(np.median(margin)), 4),
         "logit_rel_err": round(rel_err, 5),
         "logit_max_abs_err": round(max_abs, 3),
+        "kv_quant": args.kv_quant,
         "teacher_forced_decode_agreement": round(agree_steps / steps, 4),
         "decode_steps": steps,
         "weights": "random-init (zero-egress image)",
@@ -133,6 +143,10 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps", type=int, default=64,
                    help="decode steps to time")
+    p.add_argument("--trials", type=int, default=3,
+                   help="timed repeats after compile; the JSON line "
+                        "reports the median with the full spread "
+                        "(tunnel dispatch adds run-to-run noise)")
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--prompt", type=int, default=8, help="prefill length")
     p.add_argument("--preset", default="400m",
@@ -140,22 +154,29 @@ def main(argv=None) -> int:
     p.add_argument("--quant", default="none", choices=["none", "int8"],
                    help="weight-only int8 (ops/quant.py); the only way "
                         "the 8b preset fits one 16 GB chip")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="int8 KV cache (per-position/head scales): "
+                        "halves cache traffic, doubles the batch x seq "
+                        "that fits next to the weights")
     p.add_argument("--max-seq", type=int, default=0,
                    help="KV-cache length override (0 = preset default)")
     p.add_argument("--quality", action="store_true",
                    help="compare int8 vs bf16 outputs instead of timing")
     p.add_argument("--mode", default="auto",
-                   choices=["auto", "fused", "stepwise"],
+                   choices=["auto", "fused", "stepwise", "chunked"],
                    help="fused = one scan program (fast dispatch, heavy "
                         "compile); stepwise = prefill + one decode-step "
                         "executable driven from the host (compiles in "
-                        "seconds; the right choice at 400m+ on tunneled "
-                        "backends). auto = stepwise for 400m+, fused "
-                        "for tiny.")
+                        "seconds); chunked = one K-step scan executable "
+                        "(--chunk) amortizing dispatch K-fold at "
+                        "stepwise-class compile cost. auto = chunked "
+                        "for 400m+, fused for tiny.")
+    p.add_argument("--chunk", type=int, default=16,
+                   help="decode steps per dispatch in chunked mode")
     args = p.parse_args(argv)
     mode = args.mode
     if mode == "auto":
-        mode = "fused" if args.preset == "tiny" else "stepwise"
+        mode = "fused" if args.preset == "tiny" else "chunked"
 
     import jax
     import jax.numpy as jnp
@@ -184,6 +205,10 @@ def main(argv=None) -> int:
         # along in the measured time — with prompt << steps its
         # contribution is a few percent
         run_j = jax.jit(run, static_argnums=0)
+    elif mode == "chunked":
+        def run_j(steps):
+            return llama.generate_chunked(cfg, params, prompt, steps,
+                                          chunk=args.chunk)
     else:
         def run_j(steps):
             return llama.generate_stepwise(cfg, params, prompt, steps)
@@ -192,26 +217,45 @@ def main(argv=None) -> int:
     toks = run_j(args.steps)          # compile + warmup + one full run
     int(toks[0, -1])                  # host sync
     first_run_dt = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    toks = run_j(args.steps)
-    int(toks[0, -1])
-    decode_dt = time.perf_counter() - t0
-    tps = args.batch * (args.steps + args.prompt) / decode_dt
+    # count the tokens the program EXECUTES: chunked rounds the
+    # continuation up to whole chunks before trimming, so timing its
+    # wall clock against the requested count would understate tps at
+    # non-aligned --steps (and bias cross-mode comparisons)
+    exec_steps = args.steps
+    if mode == "chunked":
+        c = -(-(args.steps - 1) // args.chunk)     # ceil div
+        exec_steps = 1 + c * args.chunk
+    tokens = args.batch * (exec_steps + args.prompt)
+    trials = []
+    for _ in range(max(args.trials, 1)):
+        t0 = time.perf_counter()
+        toks = run_j(args.steps)
+        int(toks[0, -1])
+        trials.append(tokens / (time.perf_counter() - t0))
+    trials.sort()
+    n = len(trials)
+    tps = (trials[n // 2] if n % 2 else
+           0.5 * (trials[n // 2 - 1] + trials[n // 2]))
     print(json.dumps({
         "metric": "llama_decode_tokens_per_sec",
         "preset": args.preset,
         "quant": args.quant,
         "mode": mode,
+        "chunk": args.chunk if mode == "chunked" else None,
+        "kv_quant": args.kv_quant,
         "params": n_params,
         "weight_gb": round(weight_bytes / 1e9, 2),
         "batch": args.batch,
         "steps": args.steps,
+        "executed_steps": exec_steps,
         # compile + one full generation (in stepwise mode the run part
         # is all the per-step dispatches, not negligible on tunnels)
         "first_run_s": round(first_run_dt, 1),
         "tokens_per_sec": round(tps, 1),
-        "ms_per_token": round(
-            1000.0 * decode_dt / (args.steps + args.prompt), 3),
+        # per decode position (wall time / sequence length), as before
+        "ms_per_token": round(1000.0 * args.batch / tps, 3),
+        "spread": {"min": round(trials[0], 1),
+                   "max": round(trials[-1], 1), "trials": n},
         "backend": jax.devices()[0].platform,
     }))
     return 0

@@ -1,0 +1,74 @@
+"""A/B the remat tax on the real chip at a shape that compiles both ways.
+
+The round-3 probe established batch 4 x seq 1024 as the largest llama
+train shape the tunneled backend compiles WITHOUT remat (8x1024 trips
+the compile-helper's memory ceiling; see docs/performance.md). This tool
+measures that shape under each remat policy so the seq-1024 "remat tax"
+is a number, not an extrapolation from the seq-512 bench.
+
+Prints one JSON line per variant (same fields as bench.py's llama
+section) plus a final summary line with the tax ratios.
+
+Usage::
+
+    python -m tools.bench_remat [--batch 4] [--seq 1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--seq", type=int, default=1024)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from bench import _llama_step_rate
+
+    n_chips = jax.device_count()
+    variants = [
+        ("none", False, None),
+        ("selective", True, "dots_with_no_batch_dims_saveable"),
+        ("full", True, None),
+    ]
+    rates = {}
+    for name, remat, policy in variants:
+        try:
+            tok_s, spread, n_params, _ = _llama_step_rate(
+                jax, n_chips, batch=args.batch, seq=args.seq,
+                remat=remat, remat_policy=policy)
+        except Exception as e:  # a variant that cannot compile is a result
+            print(json.dumps({"metric": "llama_remat_ab", "remat": name,
+                              "error": str(e)[:200]}))
+            continue
+        rates[name] = tok_s
+        print(json.dumps({
+            "metric": "llama_remat_ab",
+            "remat": name,
+            "batch": args.batch,
+            "seq": args.seq,
+            "params": n_params,
+            "tokens_per_sec_per_chip": round(tok_s, 1),
+            "spread": spread,
+            "backend": jax.devices()[0].platform,
+        }), flush=True)
+    if "none" in rates:
+        print(json.dumps({
+            "metric": "llama_remat_tax",
+            "batch": args.batch,
+            "seq": args.seq,
+            "selective_vs_none": round(
+                rates.get("selective", 0.0) / rates["none"], 4),
+            "full_vs_none": round(rates.get("full", 0.0) / rates["none"],
+                                  4),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
