@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dcos_commons_tpu.ops import (apply_rope, gqa_attention, repeat_kv,
                                   rms_norm, rope_frequencies,
                                   softmax_cross_entropy)
+from dcos_commons_tpu.ops.flash_decode import flash_decode
 from dcos_commons_tpu.ops.quant import (QTensor, dequantize, qmm, qtake,
                                         quantize)
 from dcos_commons_tpu.parallel.ring_attention import make_ring_attention
@@ -63,6 +64,11 @@ class LlamaConfig:
     # the weights; the convert rides the attention matmul's operand
     # load the same way weight dequant does (ops/quant.py)
     kv_quant: bool = False
+    # decode-step attention: auto | dense | flash | flash_interpret.
+    # auto = the pallas decode kernel (ops/flash_decode.py) on unsharded
+    # TPU when shapes are lane-aligned, else the dense path; flash
+    # forces it; flash_interpret runs it in interpret mode (CPU tests)
+    decode_attn: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -507,6 +513,24 @@ def cache_specs() -> Params:
             "v": P(None, "dp", None, "tp", None)}
 
 
+def _use_flash_decode(cfg: LlamaConfig, mesh: Optional[Mesh]) -> bool:
+    """Route decode_step's attention: the pallas kernel on unsharded TPU
+    with lane-aligned shapes (head_dim and max_seq % 128), dense
+    elsewhere. Sharded meshes stay dense — the kernel is not
+    GSPMD-partitionable and tp serving shards the heads axis."""
+    if cfg.decode_attn in ("flash", "flash_interpret"):
+        return True
+    if cfg.decode_attn == "dense":
+        return False
+    if cfg.decode_attn != "auto":
+        # a typo'd mode must not silently measure the dense path
+        raise ValueError(
+            f"decode_attn={cfg.decode_attn!r}: expected one of "
+            "'auto', 'dense', 'flash', 'flash_interpret'")
+    return (mesh is None and jax.default_backend() == "tpu"
+            and cfg.head_dim % 128 == 0 and cfg.max_seq % 128 == 0)
+
+
 def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
                 pos: jnp.ndarray, token: jnp.ndarray,
                 mesh: Optional[Mesh] = None,
@@ -525,6 +549,7 @@ def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
     b = token.shape[0]
     if rope is None:
         rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    flash = _use_flash_decode(cfg, mesh)
 
     x = qtake(params["embed"], token, cfg.dtype)[:, None, :]   # [B, 1, D]
 
@@ -539,8 +564,16 @@ def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
         k = apply_rope(k, rope, pos)
         k_cache, k_read = _cache_update(k_cache, k, pos, 1, cfg.dtype)
         v_cache, v_read = _cache_update(v_cache, v, pos, 1, cfg.dtype)
-        o = gqa_attention(q, k_read, v_read, causal=False,
-                          q_offset=pos, kv_len=pos + 1)
+        if flash:
+            # the pallas kernel consumes the cache in storage form (int8
+            # payload + scales dequantize in VMEM); the dense read above
+            # is dead code XLA eliminates on this branch
+            o = flash_decode(
+                q, k_cache, v_cache, pos + 1,
+                interpret=(cfg.decode_attn == "flash_interpret"))
+        else:
+            o = gqa_attention(q, k_read, v_read, causal=False,
+                              q_offset=pos, kv_len=pos + 1)
         x = x + qmm(o.reshape(b, 1, -1), lp["wo"])
         h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
         gate = jax.nn.silu(qmm(h, lp["w_gate"]).astype(jnp.float32))

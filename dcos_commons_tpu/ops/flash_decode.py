@@ -1,0 +1,214 @@
+"""Pallas TPU decode attention: one query position against a padded KV
+cache, bf16 or int8.
+
+The dense decode path (``ops.attention.gqa_attention`` called from
+``models/llama.py:decode_step``) pays three HBM taxes the pallas kernel
+removes, each a full-cache-sized read or write per decode step:
+
+* ``repeat_kv`` materializes the GQA head broadcast;
+* the fp32 cast of the whole cache for the score einsum;
+* for int8 caches, the dequantized bf16 copy.
+
+Kernel design (vs. ``ops.flash_attention``, which it follows closely):
+
+* **GQA group as MXU rows.** A decode step has Sq == 1, useless as a
+  matmul row count. But all H/KV query heads of one KV group attend to
+  the SAME K/V rows, so the kernel tiles q as [group, D] and runs
+  [group, D] @ [D, block_k] per KV head — the head broadcast becomes the
+  matmul's row axis and never touches HBM (rows pad to the 8-sublane
+  minimum).
+* **Per-row scales fold into the lanes axis.** With symmetric per-row
+  int8 scales, q.(s*kq_row) == (q.kq_row)*s and p@(s*vq) == (p*s)@vq:
+  both corrections are lane-wise multiplies on the [group, block_k]
+  score/probability tile, so scale vectors are consumed in their stored
+  orientation — no transposes, and the int8 payload feeds the MXU
+  straight from VMEM.
+* **Live-length block skipping.** ``kv_len`` arrives by scalar prefetch;
+  k-blocks at or beyond it are skipped with ``pl.when`` AND their index
+  maps clamp to the last live block — Mosaic elides the DMA when a
+  block's index repeats, so a 32-slot conversation in a 2048-slot cache
+  streams ~1/64th of it. Per-step cost tracks kv_len, not max_seq.
+
+Layout: q [B, 1, H, D]; k/v [B, S, KV, D] (QTensor for int8: payload +
+[B, S, KV, 1] scales). Output [B, 1, H, D]. Requires D % 128 == 0 and
+S % 128 == 0 (``supports_decode``); callers fall back to dense.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dcos_commons_tpu.ops.quant import QTensor
+
+_NEG = -1e30
+_LANES = 128
+_SUBLANES = 8
+
+
+def _decode_kernel(kv_len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, sm_scale: float,
+                   block_k: int, quantized: bool):
+    ik = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    kv_len = kv_len_ref[0]
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(ik * block_k < kv_len)
+    def _body():
+        q = q_ref[0, 0]                                  # [gp, d] bf16
+        k = k_ref[0, 0]                                  # [bk, d] i8/bf16
+        s = jax.lax.dot_general(                         # [gp, bk] f32
+            q, k.astype(q.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if quantized:
+            # (q . kq_row) * s_row: per-row scale lands on the lanes axis
+            s = s * ks_ref[0, 0][:1].astype(jnp.float32)
+        # mask cache slots at/after the live length
+        pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        mask = pos < kv_len
+        s = jnp.where(mask, s, _NEG)
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        if quantized:
+            # p @ (s_row * vq) == (p * s_row) @ vq
+            p = p * vs_ref[0, 0][:1].astype(jnp.float32)
+        v = v_ref[0, 0]                                  # [bk, d]
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe).astype(o_ref.dtype)
+
+
+def _clamped(block_k: int):
+    """Index map component: clamp dead k-blocks to the last live one so
+    Mosaic sees a repeated index and skips their DMAs entirely."""
+    def clamp(ki, kv_len_ref):
+        last_live = jax.lax.div(
+            jnp.maximum(kv_len_ref[0] - 1, 0), block_k)
+        return jnp.minimum(ki, last_live)
+    return clamp
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sm_scale", "block_k", "interpret"))
+def flash_decode(q: jnp.ndarray, k: Union[jnp.ndarray, QTensor],
+                 v: Union[jnp.ndarray, QTensor], kv_len: jnp.ndarray, *,
+                 sm_scale: Optional[float] = None, block_k: int = 512,
+                 interpret: bool = False) -> jnp.ndarray:
+    """Decode-step attention against a padded cache; see module doc.
+
+    Drop-in for ``gqa_attention(q, k, v, causal=False, q_offset=pos,
+    kv_len=pos+1)`` with Sq == 1 (the single new position attends to
+    every live cache slot, so no causal structure remains to exploit).
+    """
+    b, s_q, h, d = q.shape
+    assert s_q == 1, "flash_decode serves single-position decode steps"
+    quantized = isinstance(k, QTensor)
+    kq, ks = (k.q, k.s) if quantized else (k, None)
+    vq, vs = (v.q, v.s) if quantized else (v, None)
+    _, s_k, kv, _ = kq.shape
+    assert h % kv == 0, (h, kv)
+    group = h // kv
+    gp = -(-group // _SUBLANES) * _SUBLANES          # pad to sublanes
+    # largest power-of-two block <= requested that divides s_k, floored
+    # at one lane width — any s_k % 128 == 0 cache gets a legal block
+    block_k = 1 << (min(block_k, s_k).bit_length() - 1)
+    while block_k > _LANES and s_k % block_k:
+        block_k //= 2
+    assert s_k % block_k == 0 and d % _LANES == 0, (s_k, d)
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    kv_len = jnp.asarray(kv_len, jnp.int32).reshape(1)
+
+    # q: [B, 1, H, D] -> [B, KV, gp, D] (group heads as matmul rows)
+    qg = q[:, 0].reshape(b, kv, group, d)
+    if gp != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    # caches: [B, S, KV, D] -> [B, KV, S, D]
+    kt = kq.transpose(0, 2, 1, 3)
+    vt = vq.transpose(0, 2, 1, 3)
+    if quantized:
+        # scales ride as [B, KV, 8, S] tiles (8 identical sublanes, the
+        # lse-tile trick: TPU blocks want sublanes % 8)
+        kst = jnp.broadcast_to(ks[..., 0].transpose(0, 2, 1)[:, :, None, :],
+                               (b, kv, _SUBLANES, s_k))
+        vst = jnp.broadcast_to(vs[..., 0].transpose(0, 2, 1)[:, :, None, :],
+                               (b, kv, _SUBLANES, s_k))
+    else:
+        kst = vst = jnp.zeros((b, kv, _SUBLANES, _LANES), jnp.bfloat16)
+
+    clamp = _clamped(block_k)
+    n_blocks = s_k // block_k
+    scale_block = block_k if quantized else _LANES
+
+    def k_map(bi, hi, ki, kv_len_ref):
+        return (bi, hi, clamp(ki, kv_len_ref), 0)
+
+    def s_map(bi, hi, ki, kv_len_ref):
+        return (bi, hi, 0, clamp(ki, kv_len_ref) if scale_block == block_k
+                else 0)
+
+    kernel = functools.partial(
+        _decode_kernel, sm_scale=scale, block_k=block_k,
+        quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, kv, n_blocks),
+            in_specs=[
+                pl.BlockSpec((1, 1, gp, d),
+                             lambda bi, hi, ki, kv_len_ref: (bi, hi, 0, 0)),
+                pl.BlockSpec((1, 1, block_k, d), k_map),
+                pl.BlockSpec((1, 1, block_k, d), k_map),
+                pl.BlockSpec((1, 1, _SUBLANES, scale_block), s_map),
+                pl.BlockSpec((1, 1, _SUBLANES, scale_block), s_map),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, gp, d), lambda bi, hi, ki, kv_len_ref: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((gp, _LANES), jnp.float32),    # running max
+                pltpu.VMEM((gp, _LANES), jnp.float32),    # running denom
+                pltpu.VMEM((gp, d), jnp.float32),         # output acc
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv, gp, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(kv_len, qg, kt, vt, kst, vst)
+    return out[:, :, :group, :].reshape(b, 1, h, d)
+
+
+def supports_decode(q: jnp.ndarray, k) -> bool:
+    """Whether the pallas decode path can serve this call."""
+    kq = k.q if isinstance(k, QTensor) else k
+    return (q.shape[1] == 1 and q.shape[-1] % _LANES == 0
+            and kq.shape[1] % _LANES == 0)

@@ -1,0 +1,114 @@
+"""Pallas decode attention (``ops/flash_decode.py``) vs the dense path,
+in interpret mode on CPU: bf16 and int8 caches, live-length masking,
+GQA grouping, and the llama decode_step integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tests._jax_cpu  # noqa: F401
+
+from dcos_commons_tpu.models import llama
+from dcos_commons_tpu.ops.attention import gqa_attention
+from dcos_commons_tpu.ops.flash_decode import flash_decode, supports_decode
+from dcos_commons_tpu.ops.quant import dequantize, quantize
+
+B, S, KV, H, D = 2, 256, 2, 4, 128
+
+
+def _inputs(key, kv_len):
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, 1, H, D), jnp.bfloat16)
+    # only the live prefix is populated, like a real cache
+    k = jnp.zeros((B, S, KV, D), jnp.bfloat16)
+    v = jnp.zeros((B, S, KV, D), jnp.bfloat16)
+    k = k.at[:, :kv_len].set(
+        jax.random.normal(kk, (B, kv_len, KV, D), jnp.bfloat16))
+    v = v.at[:, :kv_len].set(
+        jax.random.normal(kv_, (B, kv_len, KV, D), jnp.bfloat16))
+    return q, k, v
+
+
+@pytest.mark.parametrize("kv_len", [1, 100, 256])
+def test_flash_decode_matches_dense(kv_len):
+    q, k, v = _inputs(jax.random.key(0), kv_len)
+    want = gqa_attention(q, k, v, causal=False, q_offset=kv_len - 1,
+                         kv_len=jnp.int32(kv_len))
+    got = flash_decode(q, k, v, jnp.int32(kv_len), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=2e-2, rtol=2e-2)
+
+
+def test_flash_decode_int8_matches_dequantized_dense():
+    q, k, v = _inputs(jax.random.key(1), 200)
+    qk = quantize(k, axis=-1)
+    qv = quantize(v, axis=-1)
+    want = gqa_attention(q, dequantize(qk, jnp.bfloat16),
+                         dequantize(qv, jnp.bfloat16), causal=False,
+                         q_offset=199, kv_len=jnp.int32(200))
+    got = flash_decode(q, qk, qv, jnp.int32(200), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=2e-2, rtol=2e-2)
+
+
+def test_flash_decode_non_pow2_cache_length():
+    """s_k % 128 == 0 but not % 512 (e.g. 640): the block self-fits
+    instead of tripping the divisibility assert."""
+    q = jax.random.normal(jax.random.key(0), (1, 1, H, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (1, 640, KV, D),
+                          jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (1, 640, KV, D),
+                          jnp.bfloat16)
+    want = gqa_attention(q, k, v, causal=False, q_offset=599,
+                         kv_len=jnp.int32(600))
+    got = flash_decode(q, k, v, jnp.int32(600), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=2e-2, rtol=2e-2)
+
+
+def test_unknown_decode_attn_is_loud():
+    cfg = llama.LlamaConfig.tiny(decode_attn="pallas")
+    with pytest.raises(ValueError, match="decode_attn"):
+        llama._use_flash_decode(cfg, None)
+
+
+def test_supports_decode_gate():
+    q, k, v = _inputs(jax.random.key(0), 8)
+    assert supports_decode(q, k)
+    assert supports_decode(q, quantize(k, axis=-1))
+    # head_dim not lane-aligned
+    assert not supports_decode(q[..., :64], k[..., :64])
+    # train-shaped q (Sq > 1)
+    assert not supports_decode(jnp.concatenate([q, q], axis=1), k)
+
+
+def test_decode_step_flash_matches_dense_cfg():
+    """decode_attn='flash' (interpret) equals decode_attn='dense' through
+    the real llama decode_step at a lane-aligned config."""
+    base = dict(vocab_size=128, dim=256, n_layers=2, n_heads=2,
+                n_kv_heads=1, ffn_dim=256, max_seq=128, remat=False,
+                attn_impl="dense")
+    cfg_d = llama.LlamaConfig(**base, decode_attn="dense")
+    cfg_f = llama.LlamaConfig(**base, decode_attn="flash_interpret")
+    params = llama.init_params(cfg_d, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0,
+                                base["vocab_size"])
+    cache_d = llama.init_kv_cache(cfg_d, 2, cfg_d.max_seq)
+    cache_f = llama.init_kv_cache(cfg_f, 2, cfg_f.max_seq)
+    ld, cache_d = llama.prefill(cfg_d, params, cache_d, prompt)
+    lf, cache_f = llama.prefill(cfg_f, params, cache_f, prompt)
+    np.testing.assert_allclose(np.asarray(ld), np.asarray(lf),
+                               atol=1e-4, rtol=1e-4)
+    tok = jnp.argmax(ld, axis=-1).astype(prompt.dtype)
+    for i in range(4):
+        ld, cache_d = llama.decode_step(cfg_d, params, cache_d,
+                                        jnp.int32(8 + i), tok)
+        lf, cache_f = llama.decode_step(cfg_f, params, cache_f,
+                                        jnp.int32(8 + i), tok)
+        np.testing.assert_allclose(np.asarray(ld), np.asarray(lf),
+                                   atol=5e-2, rtol=5e-2)
+        tok = jnp.argmax(ld, axis=-1).astype(prompt.dtype)

@@ -1,0 +1,161 @@
+"""Flagship Llama-3-8B serving bench: every decode variant, ONE init.
+
+The 8B preset only fits a single 16 GB chip int8-quantized, and getting
+it there is the expensive part (host-CPU init + quantize of 8B params,
+then ~8.5 GB over the device link). This tool pays that cost once and
+then measures decode variants against the SAME resident weights, so the
+comparisons are same-window (tunnel dispatch latency drifts across
+minutes — docs/performance.md):
+
+* stepwise (one dispatch per token) vs chunked (K-step scan executable);
+* bf16 vs int8 KV cache (``LlamaConfig.kv_quant``);
+* dense vs pallas decode attention (``LlamaConfig.decode_attn``,
+  ``ops/flash_decode.py``).
+
+Prints one JSON line per variant (median of --trials runs of --steps
+decode steps, after a compile+warmup run). BASELINE.json config #5's
+execute-side artifact.
+
+Usage::
+
+    python -m tools.bench_flagship [--batch 1] [--steps 32] [--trials 3]
+        [--variants stepwise,chunked,chunked+kv,chunked+flash,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+# variant name -> (mode, kv_quant, decode_attn)
+VARIANTS = {
+    "stepwise": ("stepwise", False, "dense"),
+    "stepwise+flash": ("stepwise", False, "auto"),
+    "chunked": ("chunked", False, "dense"),
+    "chunked+flash": ("chunked", False, "auto"),
+    "chunked+kv": ("chunked", True, "dense"),
+    "chunked+kv+flash": ("chunked", True, "auto"),
+}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batches", default="1",
+                   help="comma list; each batch re-traces but the "
+                        "weights stay resident")
+    p.add_argument("--steps", type=int, default=32)
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--chunk", type=int, default=8)
+    p.add_argument("--max-seq", type=int, default=2048)
+    p.add_argument("--prompt", type=int, default=8)
+    p.add_argument("--preset", default="8b", choices=["8b", "400m"],
+                   help="400m runs the same matrix cheaply (smoke)")
+    p.add_argument("--variants",
+                   default="stepwise,chunked,chunked+kv+flash")
+    args = p.parse_args(argv)
+    names = [v.strip() for v in args.variants.split(",") if v.strip()]
+    for v in names:
+        if v not in VARIANTS:
+            raise SystemExit(f"unknown variant {v!r}; "
+                             f"choices: {sorted(VARIANTS)}")
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama
+
+    if args.preset == "8b":
+        base = llama.LlamaConfig.llama3_8b(
+            max_seq=args.max_seq, remat=False, attn_impl="dense")
+    else:
+        base = llama.LlamaConfig(
+            vocab_size=32000, dim=1536, n_layers=8, n_heads=12,
+            n_kv_heads=6, ffn_dim=4096, max_seq=args.max_seq,
+            remat=False, attn_impl="dense")
+
+    t0 = time.perf_counter()
+    params = llama.init_quantized_params(base, jax.random.key(0),
+                                         device=jax.devices()[0])
+    jax.block_until_ready(params)
+    init_s = round(time.perf_counter() - t0, 1)
+    print(json.dumps({"metric": "flagship_init", "preset": args.preset,
+                      "init_and_transfer_s": init_s}), flush=True)
+
+    for batch in [int(b) for b in args.batches.split(",")]:
+        prompt = jax.random.randint(jax.random.key(1),
+                                    (batch, args.prompt), 0,
+                                    base.vocab_size)
+        _run_variants(args, names, base, params, prompt, batch)
+    return 0
+
+
+def _run_variants(args, names, base, params, prompt, batch):
+    import dataclasses
+    import jax
+
+    from dcos_commons_tpu.models import llama
+
+    from dcos_commons_tpu.ops.quant import QTensor
+    n_params = sum(
+        x.q.size for x in jax.tree.leaves(
+            params, is_leaf=lambda t: isinstance(t, QTensor))
+        if isinstance(x, QTensor))
+    for name in names:
+        mode, kv_quant, decode_attn = VARIANTS[name]
+        cfg = dataclasses.replace(base, kv_quant=kv_quant,
+                                  decode_attn=decode_attn)
+        try:
+            if mode == "chunked":
+                def run():
+                    return llama.generate_chunked(cfg, params, prompt,
+                                                  args.steps,
+                                                  chunk=args.chunk)
+            else:
+                def run():
+                    return llama.generate_stepwise(cfg, params, prompt,
+                                                   args.steps)
+            t0 = time.perf_counter()
+            int(run()[0, -1])
+            first_s = time.perf_counter() - t0
+            if mode == "chunked":
+                exec_steps = 1 + -(-(args.steps - 1) // args.chunk) \
+                    * args.chunk
+            else:
+                exec_steps = args.steps
+            tokens = batch * (exec_steps + args.prompt)
+            trials = []
+            for _ in range(args.trials):
+                t0 = time.perf_counter()
+                int(run()[0, -1])
+                trials.append(tokens / (time.perf_counter() - t0))
+            trials.sort()
+            n = len(trials)
+            tps = (trials[n // 2] if n % 2 else
+                   0.5 * (trials[n // 2 - 1] + trials[n // 2]))
+            print(json.dumps({
+                "metric": "flagship_decode",
+                "preset": args.preset,
+                "variant": name,
+                "params": n_params,
+                "batch": batch,
+                "steps": args.steps,
+                "chunk": args.chunk if mode == "chunked" else None,
+                "max_seq": args.max_seq,
+                "first_run_s": round(first_s, 1),
+                "tokens_per_sec": round(tps, 1),
+                "ms_per_step": round(1000.0 * batch / tps, 3),
+                "spread": {"min": round(trials[0], 1),
+                           "max": round(trials[-1], 1), "trials": n},
+                "backend": jax.devices()[0].platform,
+            }), flush=True)
+        except Exception as e:  # record the failure, keep the session
+            print(json.dumps({"metric": "flagship_decode",
+                              "variant": name,
+                              "error": str(e)[:300]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
