@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dcos_commons_tpu.ops import (apply_rope, gqa_attention, repeat_kv,
+from dcos_commons_tpu.ops import (apply_rope, apply_rope_at,
+                                  gqa_attention, repeat_kv,
                                   rms_norm, rope_frequencies,
                                   softmax_cross_entropy)
 from dcos_commons_tpu.ops.flash_decode import flash_decode
@@ -531,6 +532,53 @@ def _use_flash_decode(cfg: LlamaConfig, mesh: Optional[Mesh]) -> bool:
             and cfg.head_dim % 128 == 0 and cfg.max_seq % 128 == 0)
 
 
+def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
+                 token: jnp.ndarray, flash: bool, rope_fn, cache_write,
+                 kv_len) -> Tuple[jnp.ndarray, Params]:
+    """The decode step shared by :func:`decode_step` (one scalar
+    position) and :func:`decode_step_slots` (per-slot positions). The
+    callers differ ONLY in how rope is applied, where the cache row
+    lands, and the attention's live-length mask — everything else must
+    stay one body or the serving engine silently diverges from solo
+    decode."""
+    b = token.shape[0]
+    x = qtake(params["embed"], token, cfg.dtype)[:, None, :]   # [B, 1, D]
+
+    def layer(carry, inputs):
+        x, layer_idx = carry
+        lp, k_cache, v_cache = inputs
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = qmm(h, lp["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        k = qmm(h, lp["wk"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = qmm(h, lp["wv"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = rope_fn(q)
+        k = rope_fn(k)
+        k_cache, k_read = cache_write(k_cache, k)
+        v_cache, v_read = cache_write(v_cache, v)
+        if flash:
+            # the pallas kernel consumes the cache in storage form (int8
+            # payload + scales dequantize in VMEM); the dense read above
+            # is dead code XLA eliminates on this branch
+            o = flash_decode(
+                q, k_cache, v_cache, kv_len,
+                interpret=(cfg.decode_attn == "flash_interpret"))
+        else:
+            o = gqa_attention(q, k_read, v_read, causal=False,
+                              kv_len=kv_len)
+        x = x + qmm(o.reshape(b, 1, -1), lp["wo"])
+        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(qmm(h, lp["w_gate"]).astype(jnp.float32))
+        up = qmm(h, lp["w_up"]).astype(jnp.float32)
+        x = x + qmm((gate * up).astype(cfg.dtype), lp["w_down"])
+        return (x, layer_idx + 1), (k_cache, v_cache)
+
+    (x, _), (k_new, v_new) = lax.scan(
+        layer, (x, 0), (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = qmm(x[:, 0, :], params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
 def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
                 pos: jnp.ndarray, token: jnp.ndarray,
                 mesh: Optional[Mesh] = None,
@@ -546,46 +594,56 @@ def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
     materializing that constant inside every nested scan body explodes
     TPU compile time (generate() hoists it once).
     """
-    b = token.shape[0]
     if rope is None:
         rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
-    flash = _use_flash_decode(cfg, mesh)
+    return _decode_body(
+        cfg, params, cache, token, _use_flash_decode(cfg, mesh),
+        rope_fn=lambda t: apply_rope(t, rope, pos),
+        cache_write=lambda c, new: _cache_update(c, new, pos, 1,
+                                                 cfg.dtype),
+        kv_len=pos + 1)
 
-    x = qtake(params["embed"], token, cfg.dtype)[:, None, :]   # [B, 1, D]
 
-    def layer(carry, inputs):
-        x, layer_idx = carry
-        lp, k_cache, v_cache = inputs
-        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = qmm(h, lp["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
-        k = qmm(h, lp["wk"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
-        v = qmm(h, lp["wv"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
-        q = apply_rope(q, rope, pos)
-        k = apply_rope(k, rope, pos)
-        k_cache, k_read = _cache_update(k_cache, k, pos, 1, cfg.dtype)
-        v_cache, v_read = _cache_update(v_cache, v, pos, 1, cfg.dtype)
-        if flash:
-            # the pallas kernel consumes the cache in storage form (int8
-            # payload + scales dequantize in VMEM); the dense read above
-            # is dead code XLA eliminates on this branch
-            o = flash_decode(
-                q, k_cache, v_cache, pos + 1,
-                interpret=(cfg.decode_attn == "flash_interpret"))
-        else:
-            o = gqa_attention(q, k_read, v_read, causal=False,
-                              q_offset=pos, kv_len=pos + 1)
-        x = x + qmm(o.reshape(b, 1, -1), lp["wo"])
-        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(qmm(h, lp["w_gate"]).astype(jnp.float32))
-        up = qmm(h, lp["w_up"]).astype(jnp.float32)
-        x = x + qmm((gate * up).astype(cfg.dtype), lp["w_down"])
-        return (x, layer_idx + 1), (k_cache, v_cache)
+def _cache_update_slots(cache, new: jnp.ndarray, lengths: jnp.ndarray,
+                        dtype) -> Tuple[Any, jnp.ndarray]:
+    """Per-slot cache write: row ``b`` of ``new`` [B, 1, KV, D] lands at
+    position ``lengths[b]`` (scatter). Same contract as
+    :func:`_cache_update` otherwise."""
+    b = new.shape[0]
+    rows = jnp.arange(b)
+    if isinstance(cache, QTensor):
+        nq = quantize(new, axis=-1)
+        cache = QTensor(
+            cache.q.at[rows, lengths].set(nq.q[:, 0]),
+            cache.s.at[rows, lengths].set(nq.s[:, 0].astype(
+                cache.s.dtype)))
+        return cache, dequantize(cache, dtype)
+    cache = cache.at[rows, lengths].set(new[:, 0])
+    return cache, cache
 
-    (x, _), (k_new, v_new) = lax.scan(
-        layer, (x, 0), (params["layers"], cache["k"], cache["v"]))
-    x = rms_norm(x, params["norm"], cfg.norm_eps)
-    logits = qmm(x[:, 0, :], params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": k_new, "v": v_new}
+
+def decode_step_slots(cfg: LlamaConfig, params: Params, cache: Params,
+                      lengths: jnp.ndarray, tokens: jnp.ndarray,
+                      mesh: Optional[Mesh] = None,
+                      rope: Optional[jnp.ndarray] = None
+                      ) -> Tuple[jnp.ndarray, Params]:
+    """One decode step with PER-SLOT positions — the continuous-batching
+    kernel of :class:`~dcos_commons_tpu.models.serving.SlotServer`.
+
+    ``tokens`` [B] int32, ``lengths`` [B] int32 (each slot's live
+    length; its new K/V row is written at that position and it attends
+    to ``lengths[b] + 1`` slots). Identical math to :func:`decode_step`
+    per row — a batch of conversations at different positions decodes
+    in one dispatch.
+    """
+    if rope is None:
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    return _decode_body(
+        cfg, params, cache, tokens, _use_flash_decode(cfg, mesh),
+        rope_fn=lambda t: apply_rope_at(t, rope, lengths),
+        cache_write=lambda c, new: _cache_update_slots(c, new, lengths,
+                                                       cfg.dtype),
+        kv_len=lengths + 1)
 
 
 def prefill(cfg: LlamaConfig, params: Params, cache: Params,

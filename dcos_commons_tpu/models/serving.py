@@ -1,0 +1,228 @@
+"""Continuous-batching serving engine for the llama decoder.
+
+vLLM-style slot serving, built the TPU way — every shape static:
+
+* A fixed pool of B **slots** shares one KV cache [L, B, max_seq, ...].
+  Each slot holds one request at its own conversation length; one
+  ``decode_step_slots`` dispatch advances every active slot (per-slot
+  positions, per-slot cache writes, per-slot attention masks — and the
+  pallas decode kernel's block skipping makes each slot's cost track its
+  OWN length via the per-slot ``kv_len`` vector).
+* New requests **fill freed slots without touching the others**: prefill
+  runs as a bucketed [1, P] forward (prompt padded to the next
+  power-of-two, so a handful of executables serve every prompt length)
+  whose K/V scatter into the slot's cache rows. Padded positions are
+  causally downstream of the live ones, so they perturb nothing, are
+  masked by the slot's length, and are overwritten as decode advances.
+* Retirement is host-side bookkeeping (budget exhausted, EOS, or cache
+  full); retired slots keep decoding garbage rows that nothing reads —
+  the batch never reshapes, so nothing recompiles.
+
+The reference repo (a cluster scheduler) has no serving engine; this is
+workload-layer capability for BASELINE.json config #5, layered on
+``models/llama.py`` (``decode_step_slots``) and ``ops/flash_decode.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dcos_commons_tpu.models import llama
+from dcos_commons_tpu.ops import gqa_attention, rms_norm, rope_frequencies
+from dcos_commons_tpu.ops.quant import QTensor, qmm, qtake, quantize
+
+
+@dataclasses.dataclass
+class _Request:
+    request_id: Any
+    prompt_len: int
+    budget: int
+    tokens: List[int]
+
+
+def _bucket(n: int, lo: int = 8) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def _prefill_bucket(cfg, params, prompt, true_len, rope):
+    """[1, P] causal forward: (last-live-position logits [1, V],
+    ks/vs [L, 1, P, KV, D]). P is the padded bucket; positions >=
+    true_len are causally downstream of the live ones and harmless."""
+    b, s = prompt.shape
+    attn = lambda q, k, v: gqa_attention(q, k, v, causal=True)  # noqa: E731
+    x = qtake(params["embed"], prompt, cfg.dtype)
+
+    def layer(x, lp):
+        x, k, v = llama.attention_block(cfg, x, lp, rope, attn,
+                                        return_kv=True)
+        x = llama.ffn_block(cfg, x, lp)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(layer, x, params["layers"])
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    last = lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
+                                    keepdims=False)
+    logits = qmm(last, params["lm_head"]).astype(jnp.float32)
+    return logits, ks, vs
+
+
+def _scatter_slot(cache, new, slot):
+    """Write [L, 1, P, KV, D] prefill K/V into cache rows
+    [:, slot, :P] (quantizing when the cache is int8)."""
+    p = new.shape[2]
+    if isinstance(cache, QTensor):
+        nq = quantize(new, axis=-1)
+        return QTensor(
+            cache.q.at[:, slot, :p].set(nq.q[:, 0]),
+            cache.s.at[:, slot, :p].set(nq.s[:, 0].astype(cache.s.dtype)))
+    return cache.at[:, slot, :p].set(new[:, 0])
+
+
+class SlotServer:
+    """Fixed-slot continuous batching over one resident weight set.
+
+    ``submit()`` places a request in a free slot (prefill + first
+    token); ``step()`` advances every active slot by one token in one
+    dispatch; ``drain()`` loops until all requests finish. Greedy by
+    default; pass ``sampler`` (``ops.sampling.make_sampler``) + ``key``
+    for stochastic decoding.
+    """
+
+    def __init__(self, cfg: llama.LlamaConfig, params, slots: int = 8,
+                 sampler=None, key: Optional[jax.Array] = None,
+                 eos_id: Optional[int] = None):
+        self.cfg = cfg
+        self.params = params
+        self.slots = slots
+        self.sampler = sampler
+        self.eos_id = eos_id
+        self.key = key if key is not None else jax.random.key(0)
+        self.cache = llama.init_kv_cache(cfg, slots, cfg.max_seq)
+        self.lengths = jnp.zeros((slots,), jnp.int32)
+        self.cur_tok = jnp.zeros((slots,), jnp.int32)
+        self.requests: List[Optional[_Request]] = [None] * slots
+        self.finished: Dict[Any, List[int]] = {}
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+        self._prefill_x: Dict[int, Any] = {}   # bucket -> executable
+        self._rope = rope
+        self._step_x = jax.jit(
+            lambda p, c, ln, tok: llama.decode_step_slots(
+                cfg, p, c, ln, tok, rope=rope))
+
+    # ------------------------------------------------------------ intake
+
+    def free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.requests) if r is None]
+
+    def submit(self, prompt: List[int], max_new: int = 32,
+               request_id: Any = None) -> Optional[int]:
+        """Prefill ``prompt`` into a free slot; returns the slot index,
+        or None when the pool is full (caller queues and retries after
+        a step retires something)."""
+        if not prompt:
+            # must not alias the pool-full None: drain() would retry the
+            # same item forever
+            raise ValueError("empty prompt")
+        free = self.free_slots()
+        if not free:
+            return None
+        n = len(prompt)
+        if n + max_new > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt {n} + max_new {max_new} exceeds the cache "
+                f"({self.cfg.max_seq}); raise max_seq or shrink the ask")
+        slot = free[0]
+        bucket = _bucket(n)
+        if bucket > self.cfg.max_seq:
+            raise ValueError(f"prompt {n} exceeds max_seq")
+        x = self._prefill_x.get(bucket)
+        if x is None:
+            cfg, rope = self.cfg, self._rope
+            x = jax.jit(lambda p, toks, tl: _prefill_bucket(
+                cfg, p, toks, tl, rope))
+            self._prefill_x[bucket] = x
+        padded = jnp.zeros((1, bucket), jnp.int32).at[0, :n].set(
+            jnp.asarray(prompt, jnp.int32))
+        logits, ks, vs = x(self.params, padded, jnp.int32(n))
+        self.cache = {"k": _scatter_slot(self.cache["k"], ks, slot),
+                      "v": _scatter_slot(self.cache["v"], vs, slot)}
+        tok = int(self._select(logits)[0])
+        self.lengths = self.lengths.at[slot].set(n)
+        self.cur_tok = self.cur_tok.at[slot].set(tok)
+        rid = request_id if request_id is not None else object()
+        self.requests[slot] = _Request(rid, n, max_new, [tok])
+        self._maybe_retire(slot)
+        return slot
+
+    def _select(self, logits) -> jnp.ndarray:
+        if self.sampler is None:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        self.key, sub = jax.random.split(self.key)
+        return self.sampler(sub, logits).astype(jnp.int32)
+
+    # ------------------------------------------------------------- decode
+
+    def step(self) -> Dict[int, int]:
+        """Advance every active slot one token; returns {slot: token}."""
+        active = [i for i, r in enumerate(self.requests) if r is not None]
+        if not active:
+            return {}
+        logits, self.cache = self._step_x(self.params, self.cache,
+                                          self.lengths, self.cur_tok)
+        toks = self._select(logits)
+        # only active slots advance (a retired slot's write lands at its
+        # frozen length — a row nothing reads until prefill rewrites it)
+        mask = jnp.zeros((self.slots,), bool).at[
+            jnp.asarray(active, jnp.int32)].set(True)
+        self.lengths = jnp.where(mask, self.lengths + 1, self.lengths)
+        self.cur_tok = jnp.where(mask, toks, self.cur_tok)
+        out: Dict[int, int] = {}
+        # ONE device->host transfer for the batch; per-element int(t)
+        # would round-trip once per slot per step
+        host_toks = [int(t) for t in np.asarray(toks)]
+        for i in active:
+            tok = host_toks[i]
+            self.requests[i].tokens.append(tok)
+            out[i] = tok
+            self._maybe_retire(i)
+        return out
+
+    def _maybe_retire(self, slot: int) -> None:
+        r = self.requests[slot]
+        if r is None:
+            return
+        done = (len(r.tokens) >= r.budget
+                or (self.eos_id is not None
+                    and r.tokens[-1] == self.eos_id)
+                or r.prompt_len + len(r.tokens) >= self.cfg.max_seq)
+        if done:
+            self.finished[r.request_id] = r.tokens
+            self.requests[slot] = None
+
+    # -------------------------------------------------------------- drive
+
+    def drain(self, queue: List[Dict[str, Any]]) -> Dict[Any, List[int]]:
+        """Serve a whole workload: submit as slots free up, step until
+        every request finishes. Each queue item: {"prompt": [...],
+        "max_new": int, "request_id": any}."""
+        pending = list(queue)
+        while pending or any(r is not None for r in self.requests):
+            while pending:
+                item = pending[0]
+                slot = self.submit(item["prompt"],
+                                   item.get("max_new", 32),
+                                   item.get("request_id"))
+                if slot is None:
+                    break
+                pending.pop(0)
+            self.step()
+        return dict(self.finished)

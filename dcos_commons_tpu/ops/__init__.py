@@ -5,13 +5,14 @@ so XLA can tile the matmuls onto the MXU and fuse the elementwise tails.
 """
 
 from dcos_commons_tpu.ops.norms import rms_norm, layer_norm
-from dcos_commons_tpu.ops.rotary import rope_frequencies, apply_rope
+from dcos_commons_tpu.ops.rotary import (rope_frequencies, apply_rope,
+                                          apply_rope_at)
 from dcos_commons_tpu.ops.attention import gqa_attention, repeat_kv
 from dcos_commons_tpu.ops.losses import softmax_cross_entropy
 
 __all__ = [
     "rms_norm", "layer_norm",
-    "rope_frequencies", "apply_rope",
+    "rope_frequencies", "apply_rope", "apply_rope_at",
     "gqa_attention", "repeat_kv",
     "softmax_cross_entropy",
 ]

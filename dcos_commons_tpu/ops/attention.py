@@ -34,7 +34,9 @@ def gqa_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
 
     q: [B, Sq, H, D]; k/v: [B, Sk, KV, D] with H % KV == 0.
     ``q_offset`` shifts query positions (decode: Sq=1, offset=cache length).
-    ``kv_len`` optionally masks out cache slots >= kv_len (padded KV cache).
+    ``kv_len`` optionally masks out cache slots >= kv_len (padded KV
+    cache); a scalar applies to every row, a [B] vector per slot (the
+    continuous-batching decode step).
     """
     n_rep = q.shape[2] // k.shape[2]
     k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
@@ -43,15 +45,17 @@ def gqa_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
                         k.astype(jnp.float32))
     s_q, s_k = scores.shape[-2], scores.shape[-1]
+    # mask broadcasts against scores [B, H, Sq, Sk]
     mask = None
     if causal:
         q_pos = q_offset + lax.iota(jnp.int32, s_q)[:, None]
-        mask = q_pos >= lax.iota(jnp.int32, s_k)[None, :]
+        mask = (q_pos >= lax.iota(jnp.int32, s_k)[None, :])[None, None]
     if kv_len is not None:
-        valid = lax.iota(jnp.int32, s_k)[None, :] < kv_len
+        kvl = jnp.asarray(kv_len).reshape(-1, 1, 1, 1)  # [B or 1,1,1,1]
+        valid = lax.iota(jnp.int32, s_k)[None, None, None, :] < kvl
         mask = valid if mask is None else (mask & valid)
     if mask is not None:
-        scores = jnp.where(mask[None, None], scores, _NEG)
+        scores = jnp.where(mask, scores, _NEG)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)

@@ -56,7 +56,7 @@ def _decode_kernel(kv_len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
                    block_k: int, quantized: bool):
     ik = pl.program_id(2)
     n_k = pl.num_programs(2)
-    kv_len = kv_len_ref[0]
+    kv_len = kv_len_ref[pl.program_id(0)]       # per-slot live length
 
     @pl.when(ik == 0)
     def _init():
@@ -108,9 +108,9 @@ def _decode_kernel(kv_len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
 def _clamped(block_k: int):
     """Index map component: clamp dead k-blocks to the last live one so
     Mosaic sees a repeated index and skips their DMAs entirely."""
-    def clamp(ki, kv_len_ref):
+    def clamp(bi, ki, kv_len_ref):
         last_live = jax.lax.div(
-            jnp.maximum(kv_len_ref[0] - 1, 0), block_k)
+            jnp.maximum(kv_len_ref[bi] - 1, 0), block_k)
         return jnp.minimum(ki, last_live)
     return clamp
 
@@ -144,7 +144,10 @@ def flash_decode(q: jnp.ndarray, k: Union[jnp.ndarray, QTensor],
         block_k //= 2
     assert s_k % block_k == 0 and d % _LANES == 0, (s_k, d)
     scale = sm_scale if sm_scale is not None else d ** -0.5
-    kv_len = jnp.asarray(kv_len, jnp.int32).reshape(1)
+    # scalar kv_len broadcasts to every slot; a [B] vector is per-slot
+    # (continuous batching: each slot at its own conversation length)
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    kv_len = jnp.broadcast_to(kv_len.reshape(-1), (b,))
 
     # q: [B, 1, H, D] -> [B, KV, gp, D] (group heads as matmul rows)
     qg = q[:, 0].reshape(b, kv, group, d)
@@ -168,10 +171,11 @@ def flash_decode(q: jnp.ndarray, k: Union[jnp.ndarray, QTensor],
     scale_block = block_k if quantized else _LANES
 
     def k_map(bi, hi, ki, kv_len_ref):
-        return (bi, hi, clamp(ki, kv_len_ref), 0)
+        return (bi, hi, clamp(bi, ki, kv_len_ref), 0)
 
     def s_map(bi, hi, ki, kv_len_ref):
-        return (bi, hi, 0, clamp(ki, kv_len_ref) if scale_block == block_k
+        return (bi, hi, 0,
+                clamp(bi, ki, kv_len_ref) if scale_block == block_k
                 else 0)
 
     kernel = functools.partial(

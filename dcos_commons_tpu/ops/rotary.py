@@ -31,6 +31,21 @@ def apply_rope(x: jnp.ndarray, table: jnp.ndarray,
     half = x.shape[-1] // 2
     cos = lax.dynamic_slice_in_dim(table[0], offset, seq)[None, :, None, :]
     sin = lax.dynamic_slice_in_dim(table[1], offset, seq)[None, :, None, :]
+    return _rotate(x, cos, sin, half)
+
+
+def apply_rope_at(x: jnp.ndarray, table: jnp.ndarray,
+                  pos: jnp.ndarray) -> jnp.ndarray:
+    """Rotate a single decode position PER SLOT: x [B, 1, H, D], pos [B]
+    (each batch row at its own sequence position — the continuous-
+    batching decode step, where slots advance independently)."""
+    half = x.shape[-1] // 2
+    cos = table[0][pos][:, None, None, :]           # [B, 1, 1, D/2]
+    sin = table[1][pos][:, None, None, :]
+    return _rotate(x, cos, sin, half)
+
+
+def _rotate(x, cos, sin, half):
     x32 = x.astype(jnp.float32)
     x1, x2 = x32[..., :half], x32[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin,

@@ -1,0 +1,166 @@
+"""Continuous-batching serving engine (``models/serving.py``): stream
+equivalence vs solo decode, slot reuse, per-slot decode correctness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import tests._jax_cpu  # noqa: F401
+
+from dcos_commons_tpu.models import llama, serving
+from dcos_commons_tpu.ops import sampling
+
+
+def _cfg(**kw):
+    return llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                  attn_impl="dense", **kw)
+
+
+def _solo(cfg, params, prompt, steps):
+    toks = llama.generate_stepwise(cfg, params,
+                                   jnp.asarray([prompt], jnp.int32),
+                                   steps)
+    return [int(t) for t in toks[0]]
+
+
+def test_decode_step_slots_matches_decode_step_rows():
+    """A batch of slots at DIFFERENT lengths decodes each row exactly as
+    a solo decode_step at that row's position."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    rope = None
+    # build two solo caches at different lengths via prefill
+    pa = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab_size)
+    pb = jax.random.randint(jax.random.key(2), (1, 16), 0,
+                            cfg.vocab_size)
+    ca = llama.init_kv_cache(cfg, 1, cfg.max_seq)
+    cb = llama.init_kv_cache(cfg, 1, cfg.max_seq)
+    la, ca = llama.prefill(cfg, params, ca, pa)
+    lb, cb = llama.prefill(cfg, params, cb, pb)
+    ta = jnp.argmax(la, -1).astype(jnp.int32)
+    tb = jnp.argmax(lb, -1).astype(jnp.int32)
+
+    # merged 2-slot cache at lengths [8, 16]
+    merged = {
+        "k": jnp.concatenate([ca["k"], cb["k"]], axis=1),
+        "v": jnp.concatenate([ca["v"], cb["v"]], axis=1),
+    }
+    lengths = jnp.asarray([8, 16], jnp.int32)
+    tokens = jnp.concatenate([ta, tb])
+    logits, merged = llama.decode_step_slots(cfg, params, merged,
+                                             lengths, tokens, rope=rope)
+    la2, ca = llama.decode_step(cfg, params, ca, jnp.int32(8), ta)
+    lb2, cb = llama.decode_step(cfg, params, cb, jnp.int32(16), tb)
+    np.testing.assert_allclose(np.asarray(logits[0]), np.asarray(la2[0]),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(logits[1]), np.asarray(lb2[0]),
+                               atol=1e-4, rtol=1e-4)
+    # the cache rows written match the solo caches at their positions
+    np.testing.assert_allclose(
+        np.asarray(merged["k"][:, 0, 8]), np.asarray(ca["k"][:, 0, 8]),
+        atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(merged["k"][:, 1, 16]), np.asarray(cb["k"][:, 0, 16]),
+        atol=1e-6)
+
+
+def test_slot_server_streams_match_solo_decode():
+    """Three requests through a 2-slot server (forcing slot reuse) each
+    emit exactly their solo greedy stream."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompts = {
+        "a": [int(t) for t in jax.random.randint(
+            jax.random.key(1), (8,), 0, cfg.vocab_size)],
+        "b": [int(t) for t in jax.random.randint(
+            jax.random.key(2), (5,), 0, cfg.vocab_size)],  # padded bucket
+        "c": [int(t) for t in jax.random.randint(
+            jax.random.key(3), (12,), 0, cfg.vocab_size)],
+    }
+    budgets = {"a": 6, "b": 9, "c": 4}
+    server = serving.SlotServer(cfg, params, slots=2)
+    got = server.drain([
+        {"prompt": prompts[r], "max_new": budgets[r], "request_id": r}
+        for r in ("a", "b", "c")])
+    assert set(got) == {"a", "b", "c"}
+    for r in ("a", "b", "c"):
+        want = _solo(cfg, params, prompts[r], budgets[r])
+        assert got[r] == want, (r, got[r], want)
+
+
+def test_slot_server_kv_quant_and_flash_interpret():
+    """The full stack — int8 weights, int8 KV, pallas decode kernel
+    (interpret) — serves through the engine and matches its own solo
+    chunked decode."""
+    cfg = llama.LlamaConfig(vocab_size=128, dim=256, n_layers=2,
+                            n_heads=2, n_kv_heads=1, ffn_dim=256,
+                            max_seq=128, remat=False, attn_impl="dense",
+                            kv_quant=True,
+                            decode_attn="flash_interpret")
+    params = llama.quantize_params(llama.init_params(
+        llama.LlamaConfig(vocab_size=128, dim=256, n_layers=2,
+                          n_heads=2, n_kv_heads=1, ffn_dim=256,
+                          max_seq=128, remat=False),
+        jax.random.key(0)))
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.key(1), (8,), 0, 128)]
+    server = serving.SlotServer(cfg, params, slots=2)
+    got = server.drain([{"prompt": prompt, "max_new": 5,
+                         "request_id": "x"}])
+    want = _solo(cfg, params, prompt, 5)
+    assert got["x"] == want
+
+
+def test_slot_server_eos_retires():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    # find what greedy emits second, use it as the eos token
+    stream = _solo(cfg, params, prompt, 4)
+    eos = stream[1]
+    server = serving.SlotServer(cfg, params, slots=1, eos_id=eos)
+    got = server.drain([{"prompt": prompt, "max_new": 10,
+                         "request_id": "e"}])
+    assert got["e"] == stream[:2]
+
+
+def test_slot_server_sampling_deterministic():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.key(4), (8,), 0, cfg.vocab_size)]
+    sampler = sampling.make_sampler(temperature=1.0, top_k=8)
+    runs = []
+    for _ in range(2):
+        server = serving.SlotServer(cfg, params, slots=1,
+                                    sampler=sampler,
+                                    key=jax.random.key(9))
+        runs.append(server.drain([{"prompt": prompt, "max_new": 6,
+                                   "request_id": "s"}])["s"])
+    assert runs[0] == runs[1]
+    assert all(0 <= t < cfg.vocab_size for t in runs[0])
+
+
+def test_slot_server_rejects_empty_prompt():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    server = serving.SlotServer(cfg, params, slots=1)
+    try:
+        server.submit([], max_new=4)
+    except ValueError as e:
+        assert "empty" in str(e)
+    else:
+        raise AssertionError("empty prompt must raise, not alias "
+                             "pool-full")
+
+
+def test_slot_server_rejects_oversized():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    server = serving.SlotServer(cfg, params, slots=1)
+    try:
+        server.submit(list(range(8)), max_new=cfg.max_seq)
+    except ValueError as e:
+        assert "max_seq" in str(e)
+    else:
+        raise AssertionError("oversized request was not rejected")
