@@ -661,13 +661,42 @@ def prefill(cfg: LlamaConfig, params: Params, cache: Params,
     tunneled backends, docs/performance.md) and makes prompt processing
     O(1) dispatches instead of O(S).
     """
-    b, s = prompt.shape
     if rope is None:
         rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
-    # dense attention on purpose (not _make_attn_fn): the cache contract
-    # matches decode_step exactly, and ring/ulysses shard_map impls
-    # require sp-divisible sequence lengths — prompts are arbitrary
-    attn_fn = (lambda q, k, v: gqa_attention(q, k, v, causal=True))
+    x, ks, vs = prefill_trunk(cfg, params, prompt, rope, mesh)
+    logits = qmm(x[:, -1, :], params["lm_head"]).astype(jnp.float32)
+    cache = {
+        "k": _cache_update(cache["k"], ks, 0, 2, cfg.dtype)[0],
+        "v": _cache_update(cache["v"], vs, 0, 2, cfg.dtype)[0],
+    }
+    return logits, cache
+
+
+def prefill_trunk(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,
+                  rope: jnp.ndarray, mesh: Optional[Mesh] = None
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The prefill forward shared by :func:`prefill` and the serving
+    engine's bucketed slot prefill: (normed hidden states [B, S, D],
+    ks/vs [L, B, S, KV, D]) — callers pick which position's logits they
+    need and where the K/V land.
+
+    NOT _make_attn_fn: the cache contract matches decode_step exactly,
+    and ring/ulysses shard_map impls require sp-divisible sequence
+    lengths — prompts are arbitrary. Long aligned prompts route to the
+    pallas flash kernel (same serving gate as decode): the dense path
+    materializes [B, H, S, S] fp32 scores, a 26 GB transient at
+    batch 8 x seq 4096 that simply does not fit; flash streams them
+    through VMEM tiles.
+    """
+    s = prompt.shape[1]
+    if _use_flash_decode(cfg, mesh) and s % 128 == 0 \
+            and cfg.head_dim <= 256:
+        from dcos_commons_tpu.ops.flash_attention import flash_attention
+        interp = cfg.decode_attn == "flash_interpret"
+        attn_fn = (lambda q, k, v: flash_attention(
+            q, k, v, causal=True, interpret=interp))
+    else:
+        attn_fn = (lambda q, k, v: gqa_attention(q, k, v, causal=True))
     x = qtake(params["embed"], prompt, cfg.dtype)
     x = _constrain(x, mesh, "dp", None, None)
 
@@ -678,13 +707,7 @@ def prefill(cfg: LlamaConfig, params: Params, cache: Params,
         return _constrain(x, mesh, "dp", None, None), (k, v)
 
     x, (ks, vs) = lax.scan(layer, x, params["layers"])
-    x = rms_norm(x, params["norm"], cfg.norm_eps)
-    logits = qmm(x[:, -1, :], params["lm_head"]).astype(jnp.float32)
-    cache = {
-        "k": _cache_update(cache["k"], ks, 0, 2, cfg.dtype)[0],
-        "v": _cache_update(cache["v"], vs, 0, 2, cfg.dtype)[0],
-    }
-    return logits, cache
+    return rms_norm(x, params["norm"], cfg.norm_eps), ks, vs
 
 
 def generate(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,

@@ -34,8 +34,8 @@ import numpy as np
 from jax import lax
 
 from dcos_commons_tpu.models import llama
-from dcos_commons_tpu.ops import gqa_attention, rms_norm, rope_frequencies
-from dcos_commons_tpu.ops.quant import QTensor, qmm, qtake, quantize
+from dcos_commons_tpu.ops import rope_frequencies
+from dcos_commons_tpu.ops.quant import QTensor, qmm, quantize
 
 
 @dataclasses.dataclass
@@ -56,19 +56,11 @@ def _bucket(n: int, lo: int = 8) -> int:
 def _prefill_bucket(cfg, params, prompt, true_len, rope):
     """[1, P] causal forward: (last-live-position logits [1, V],
     ks/vs [L, 1, P, KV, D]). P is the padded bucket; positions >=
-    true_len are causally downstream of the live ones and harmless."""
-    b, s = prompt.shape
-    attn = lambda q, k, v: gqa_attention(q, k, v, causal=True)  # noqa: E731
-    x = qtake(params["embed"], prompt, cfg.dtype)
-
-    def layer(x, lp):
-        x, k, v = llama.attention_block(cfg, x, lp, rope, attn,
-                                        return_kv=True)
-        x = llama.ffn_block(cfg, x, lp)
-        return x, (k, v)
-
-    x, (ks, vs) = lax.scan(layer, x, params["layers"])
-    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    true_len are causally downstream of the live ones and harmless.
+    Shares :func:`llama.prefill_trunk` with solo prefill (flash routing
+    for lane-aligned buckets included) — only the logits position and
+    the cache landing differ."""
+    x, ks, vs = llama.prefill_trunk(cfg, params, prompt, rope)
     last = lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
                                     keepdims=False)
     logits = qmm(last, params["lm_head"]).astype(jnp.float32)
@@ -141,9 +133,10 @@ class SlotServer:
                 f"prompt {n} + max_new {max_new} exceeds the cache "
                 f"({self.cfg.max_seq}); raise max_seq or shrink the ask")
         slot = free[0]
-        bucket = _bucket(n)
-        if bucket > self.cfg.max_seq:
-            raise ValueError(f"prompt {n} exceeds max_seq")
+        # a power-of-two bucket can overshoot a non-power-of-two
+        # max_seq; the capacity check above already passed, so clamp —
+        # padded positions are causally inert either way
+        bucket = min(_bucket(n), self.cfg.max_seq)
         x = self._prefill_x.get(bucket)
         if x is None:
             cfg, rope = self.cfg, self._rope

@@ -280,24 +280,64 @@ def run_llama(args) -> dict:
               "tp": n, "process_id": contract["process_id"]}
     if args.serve:
         # goal RUNNING: keep serving — exiting would read as a task failure
-        # and trigger a gang re-form loop. Each heartbeat decodes a fresh
-        # synthetic prompt so the serving path (and the chips) stay
-        # exercised and monitorable via the emitted tokens/sec. Transient
-        # decode failures are reported, not fatal: only the scheduler's own
-        # health/recovery machinery should decide to restart the shard.
-        _emit({"event": "serving", **result})
-        i = 0
-        while True:
-            time.sleep(args.serve_interval)
-            i += 1
-            hb_prompt = jax.random.randint(
-                jax.random.key(1000 + i), (1, 4), 0, cfg.vocab_size
-            ).astype(jnp.int32)
-            try:
-                _emit({"event": "heartbeat", "n": i,
-                       "tokens_per_sec": timed_decode(hb_prompt)})
-            except Exception as e:
-                _emit({"event": "heartbeat_error", "n": i, "error": str(e)})
+        # and trigger a gang re-form loop. Transient decode failures are
+        # reported, not fatal: only the scheduler's own health/recovery
+        # machinery should decide to restart the shard.
+        # report the EFFECTIVE slot count: the engine is single-chip, so
+        # sharded meshes fall back to heartbeat decode and must not
+        # advertise continuous batching to monitoring
+        slot_engine = args.slots > 0 and mesh.size == 1
+        _emit({"event": "serving",
+               "slots": args.slots if slot_engine else 0, **result})
+        if slot_engine:
+            # continuous batching (models/serving.py): each heartbeat
+            # drains a burst of synthetic requests through the slot
+            # pool and reports aggregate throughput + slot stats
+            import numpy as _np
+
+            from dcos_commons_tpu.models.serving import SlotServer
+            server = SlotServer(cfg, params, slots=args.slots)
+            rng = _np.random.RandomState(0)
+            i = 0
+            while True:
+                time.sleep(args.serve_interval)
+                i += 1
+                burst = [
+                    {"prompt": [int(t) for t in rng.randint(
+                        0, cfg.vocab_size, rng.randint(4, 17))],
+                     "max_new": 16, "request_id": (i, j)}
+                    for j in range(2 * args.slots)]
+                try:
+                    t0 = time.perf_counter()
+                    res = server.drain(burst)
+                    toks = sum(len(v) for v in res.values())
+                    _emit({"event": "heartbeat", "n": i,
+                           "requests": len(burst), "tokens": toks,
+                           "tokens_per_sec": round(
+                               toks / (time.perf_counter() - t0), 2)})
+                except Exception as e:
+                    _emit({"event": "heartbeat_error", "n": i,
+                           "error": str(e)})
+                finally:
+                    # a failed drain must not leak its partial results
+                    # into the next heartbeat's token count
+                    server.finished.clear()
+        else:
+            # sharded meshes: fixed-prompt heartbeat decode (SlotServer
+            # is single-chip; tp shards heartbeat through generate_*)
+            i = 0
+            while True:
+                time.sleep(args.serve_interval)
+                i += 1
+                hb_prompt = jax.random.randint(
+                    jax.random.key(1000 + i), (1, 4), 0, cfg.vocab_size
+                ).astype(jnp.int32)
+                try:
+                    _emit({"event": "heartbeat", "n": i,
+                           "tokens_per_sec": timed_decode(hb_prompt)})
+                except Exception as e:
+                    _emit({"event": "heartbeat_error", "n": i,
+                           "error": str(e)})
     return result
 
 
@@ -499,6 +539,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="llama: KV-cache length override (0 = preset "
                         "default; 8b serving defaults to 2048)")
     p.add_argument("--gen-len", type=int, default=16)
+    p.add_argument("--slots", type=int, default=0,
+                   help="llama --serve: continuous-batching slot count "
+                        "(models/serving.py SlotServer); 0 = plain "
+                        "heartbeat decode")
     p.add_argument("--serve", action="store_true",
                    help="llama: keep serving after warmup (RUNNING goal)")
     p.add_argument("--serve-interval", type=float, default=30.0,

@@ -30,7 +30,12 @@ def _build_cfg(args, llama, kv_quant=None):
     import dataclasses
     cfg = _preset_cfg(args, llama)
     kv = args.kv_quant if kv_quant is None else kv_quant
-    return dataclasses.replace(cfg, kv_quant=True) if kv else cfg
+    changes = {}
+    if kv:
+        changes["kv_quant"] = True
+    if getattr(args, "decode_attn", "auto") != "auto":
+        changes["decode_attn"] = args.decode_attn
+    return dataclasses.replace(cfg, **changes) if changes else cfg
 
 
 def _preset_cfg(args, llama):
@@ -139,6 +144,76 @@ def run_quality(args, jax, jnp, llama) -> dict:
     }
 
 
+def run_split(args, cfg, jax, jnp, llama) -> dict:
+    """Prefill and decode timed as separate phases at a long context:
+    the decode number is ms/token AT kv_len ~= prompt length, which is
+    what the flash kernel's live-length block skipping is about."""
+    import time as _t
+
+    chunk = args.chunk
+    n_chunks = max(args.steps // chunk, 1)
+    if args.prompt + n_chunks * chunk > cfg.max_seq:
+        raise SystemExit(
+            f"--prompt {args.prompt} + {n_chunks * chunk} decode steps "
+            f"exceeds max_seq {cfg.max_seq}: the clamped cache writes "
+            "would silently corrupt the run being timed")
+    if args.quant == "int8":
+        params = llama.init_quantized_params(cfg, jax.random.key(0),
+                                             device=jax.devices()[0])
+    else:
+        params = llama.init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1),
+                                (args.batch, args.prompt), 0,
+                                cfg.vocab_size)
+    prefill_x = llama._stepwise_executables(cfg, None)[0]
+    chunk_x = jax.jit(lambda p, c, pos, tok: llama.decode_chunk(
+        cfg, p, c, pos, tok, chunk))
+
+    cache0 = llama.init_kv_cache(cfg, args.batch, cfg.max_seq)
+    logits, cache = prefill_x(params, cache0, prompt)   # compile
+    jax.block_until_ready(cache["k"].q if hasattr(cache["k"], "q")
+                          else cache["k"])
+    pf = []
+    for _ in range(max(args.trials, 1)):
+        t0 = _t.perf_counter()
+        logits, cache = prefill_x(params, cache0, prompt)
+        jax.block_until_ready(logits)
+        pf.append(args.batch * args.prompt / (_t.perf_counter() - t0))
+    pf.sort()
+
+    tok = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+    toks, cache2 = chunk_x(params, cache, jnp.int32(args.prompt), tok)
+    jax.block_until_ready(toks)                          # compile
+    dc = []
+    for _ in range(max(args.trials, 1)):
+        c, t, pos = cache, tok, args.prompt
+        t0 = _t.perf_counter()
+        for _ in range(n_chunks):
+            ts, c = chunk_x(params, c, jnp.int32(pos), t)
+            t = ts[:, -1]
+            pos += chunk
+        jax.block_until_ready(t)
+        dc.append(args.batch * n_chunks * chunk
+                  / (_t.perf_counter() - t0))
+    dc.sort()
+    mid = len(dc) // 2
+    return {
+        "metric": "llama_decode_split",
+        "preset": args.preset,
+        "quant": args.quant,
+        "kv_quant": args.kv_quant,
+        "decode_attn": cfg.decode_attn,
+        "batch": args.batch,
+        "prompt": args.prompt,
+        "max_seq": cfg.max_seq,
+        "chunk": chunk,
+        "prefill_tokens_per_sec": round(pf[len(pf) // 2], 1),
+        "decode_tokens_per_sec": round(dc[mid], 1),
+        "decode_ms_per_token": round(1000.0 * args.batch / dc[mid], 3),
+        "backend": jax.devices()[0].platform,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps", type=int, default=64,
@@ -160,8 +235,17 @@ def main(argv=None) -> int:
                         "that fits next to the weights")
     p.add_argument("--max-seq", type=int, default=0,
                    help="KV-cache length override (0 = preset default)")
+    p.add_argument("--decode-attn", default="auto",
+                   choices=["auto", "dense", "flash"],
+                   help="decode/prefill attention routing "
+                        "(LlamaConfig.decode_attn); auto = the pallas "
+                        "kernel on TPU at lane-aligned shapes")
     p.add_argument("--quality", action="store_true",
                    help="compare int8 vs bf16 outputs instead of timing")
+    p.add_argument("--split", action="store_true",
+                   help="time prefill and decode separately (long-"
+                        "context runs: a long prompt otherwise "
+                        "dominates the aggregate tokens/sec)")
     p.add_argument("--mode", default="auto",
                    choices=["auto", "fused", "stepwise", "chunked"],
                    help="fused = one scan program (fast dispatch, heavy "
@@ -188,6 +272,9 @@ def main(argv=None) -> int:
         return 0
 
     cfg = _build_cfg(args, llama)
+    if args.split:
+        print(json.dumps(run_split(args, cfg, jax, jnp, llama)))
+        return 0
     if args.quant == "int8":
         params = llama.init_quantized_params(cfg, jax.random.key(0),
                                              device=jax.devices()[0])
