@@ -533,24 +533,31 @@ def _use_flash_decode(cfg: LlamaConfig, mesh: Optional[Mesh]) -> bool:
 
 
 def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
-                 token: jnp.ndarray, flash: bool, rope_fn, cache_write,
-                 kv_len) -> Tuple[jnp.ndarray, Params]:
-    """The decode step shared by :func:`decode_step` (one scalar
-    position) and :func:`decode_step_slots` (per-slot positions). The
-    callers differ ONLY in how rope is applied, where the cache row
-    lands, and the attention's live-length mask — everything else must
-    stay one body or the serving engine silently diverges from solo
-    decode."""
-    b = token.shape[0]
-    x = qtake(params["embed"], token, cfg.dtype)[:, None, :]   # [B, 1, D]
+                 tokens: jnp.ndarray, flash: bool, rope_fn, cache_write,
+                 kv_len, causal: bool = False, q_offset=0,
+                 all_positions: bool = False
+                 ) -> Tuple[jnp.ndarray, Params]:
+    """The cache-consuming forward shared by :func:`decode_step` (one
+    scalar position), :func:`decode_step_slots` (per-slot positions),
+    and :func:`extend_step` (a K-token window). The callers differ ONLY
+    in how rope is applied, where the cache rows land, and the
+    attention mask — everything else must stay ONE body or the serving
+    engine / speculative verify silently diverge from solo decode.
+
+    ``tokens`` [B, S] (S == 1 for decode steps); ``causal``/``q_offset``
+    shape the within-window mask for S > 1; ``all_positions`` returns
+    logits [B, S, V] instead of the last position's [B, V].
+    """
+    b, s = tokens.shape
+    x = qtake(params["embed"], tokens, cfg.dtype)              # [B, S, D]
 
     def layer(carry, inputs):
         x, layer_idx = carry
         lp, k_cache, v_cache = inputs
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = qmm(h, lp["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
-        k = qmm(h, lp["wk"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
-        v = qmm(h, lp["wv"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = qmm(h, lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = qmm(h, lp["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        v = qmm(h, lp["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
         q = rope_fn(q)
         k = rope_fn(k)
         k_cache, k_read = cache_write(k_cache, k)
@@ -563,9 +570,9 @@ def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
                 q, k_cache, v_cache, kv_len,
                 interpret=(cfg.decode_attn == "flash_interpret"))
         else:
-            o = gqa_attention(q, k_read, v_read, causal=False,
-                              kv_len=kv_len)
-        x = x + qmm(o.reshape(b, 1, -1), lp["wo"])
+            o = gqa_attention(q, k_read, v_read, causal=causal,
+                              q_offset=q_offset, kv_len=kv_len)
+        x = x + qmm(o.reshape(b, s, -1), lp["wo"])
         h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
         gate = jax.nn.silu(qmm(h, lp["w_gate"]).astype(jnp.float32))
         up = qmm(h, lp["w_up"]).astype(jnp.float32)
@@ -575,7 +582,9 @@ def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
     (x, _), (k_new, v_new) = lax.scan(
         layer, (x, 0), (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["norm"], cfg.norm_eps)
-    logits = qmm(x[:, 0, :], params["lm_head"]).astype(jnp.float32)
+    if not all_positions:
+        x = x[:, -1, :]
+    logits = qmm(x, params["lm_head"]).astype(jnp.float32)
     return logits, {"k": k_new, "v": v_new}
 
 
@@ -597,11 +606,38 @@ def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
     if rope is None:
         rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     return _decode_body(
-        cfg, params, cache, token, _use_flash_decode(cfg, mesh),
+        cfg, params, cache, token[:, None], _use_flash_decode(cfg, mesh),
         rope_fn=lambda t: apply_rope(t, rope, pos),
         cache_write=lambda c, new: _cache_update(c, new, pos, 1,
                                                  cfg.dtype),
         kv_len=pos + 1)
+
+
+def extend_step(cfg: LlamaConfig, params: Params, cache: Params,
+                tokens: jnp.ndarray, pos: jnp.ndarray,
+                rope: Optional[jnp.ndarray] = None
+                ) -> Tuple[jnp.ndarray, Params]:
+    """Consume K tokens in ONE forward: ``tokens`` [B, K] occupy
+    positions ``pos..pos+K-1``; returns (logits [B, K, V] at every
+    position, updated cache).
+
+    The verify pass of speculative decoding (``models/speculative.py``)
+    and the chunked-prefill building block: the whole window's K/V
+    writes land first, then each query attends causally within the
+    window (``q_offset=pos``) and to the live cache prefix — so the
+    weights stream ONCE per K tokens instead of once per token.
+    Single-chip (no mesh parameter): sharded serving decodes through
+    ``decode_step`` / ``generate_*`` instead.
+    """
+    kk = tokens.shape[1]
+    if rope is None:
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    return _decode_body(
+        cfg, params, cache, tokens, flash=False,
+        rope_fn=lambda t: apply_rope(t, rope, pos),
+        cache_write=lambda c, new: _cache_update(c, new, pos, 1,
+                                                 cfg.dtype),
+        kv_len=pos + kk, causal=True, q_offset=pos, all_positions=True)
 
 
 def _cache_update_slots(cache, new: jnp.ndarray, lengths: jnp.ndarray,
@@ -639,7 +675,8 @@ def decode_step_slots(cfg: LlamaConfig, params: Params, cache: Params,
     if rope is None:
         rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     return _decode_body(
-        cfg, params, cache, tokens, _use_flash_decode(cfg, mesh),
+        cfg, params, cache, tokens[:, None],
+        _use_flash_decode(cfg, mesh),
         rope_fn=lambda t: apply_rope_at(t, rope, lengths),
         cache_write=lambda c, new: _cache_update_slots(c, new, lengths,
                                                        cfg.dtype),

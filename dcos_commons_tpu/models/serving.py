@@ -201,6 +201,18 @@ class SlotServer:
             self.finished[r.request_id] = r.tokens
             self.requests[slot] = None
 
+    def abort_active(self) -> int:
+        """Drop every in-flight request without recording results (a
+        failed drive loop resetting to a clean pool); returns how many
+        were dropped. Slot cache rows need no cleanup — they are masked
+        by length and rewritten by the next prefill."""
+        dropped = 0
+        for i, r in enumerate(self.requests):
+            if r is not None:
+                self.requests[i] = None
+                dropped += 1
+        return dropped
+
     # -------------------------------------------------------------- drive
 
     def drain(self, queue: List[Dict[str, Any]]) -> Dict[Any, List[int]]:

@@ -1,0 +1,125 @@
+"""Greedy speculative decoding: a draft model proposes, the target
+verifies K tokens per weight pass.
+
+Decode at real model sizes is weight-streaming bound — every emitted
+token streams the full weight set. Speculative decoding breaks that
+coupling: a cheap draft decodes K candidate tokens autoregressively,
+then the target consumes all K in ONE ``llama.extend_step`` forward
+(weights stream once) and keeps the longest prefix it agrees with, plus
+its own correction token. Per target weight pass the stream advances by
+``1 + (accepted prefix)`` tokens; the output is **the target's greedy
+stream no matter how bad the draft is** — acceptance only sets the
+speed, never the text. (Precisely: token-exact wherever the argmax
+margin exceeds the bf16 rounding difference between the K-wide verify
+matmul and solo decode's 1-wide matmul — always, for peaked
+trained-model logits; random-init near-uniform logits can flip a
+near-tie, which the tests account for.)
+
+Why rollback is free here: both models' caches are fixed ``max_seq``
+buffers with masked reads (``kv_len``) — rows written for rejected
+candidates sit beyond the live length, are never attended, and are
+overwritten when decoding reaches them. Rejection is just "don't
+advance the host-side position".
+
+The reference repo (a cluster scheduler) ships no serving stack; this
+is workload-layer capability for BASELINE.json config #5 (the 8B
+flagship is the intended target model, with a 400m-class draft).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcos_commons_tpu.models import llama
+from dcos_commons_tpu.ops import rope_frequencies
+
+Params = llama.Params
+
+
+class SpeculativeDecoder:
+    """Greedy speculative decoding for batch-1 serving (the latency
+    case K-token verification exists for)."""
+
+    def __init__(self, cfg_t: llama.LlamaConfig, params_t: Params,
+                 cfg_d: llama.LlamaConfig, params_d: Params, k: int = 4):
+        if cfg_t.vocab_size != cfg_d.vocab_size:
+            raise ValueError("draft and target must share a vocabulary")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.cfg_t, self.params_t = cfg_t, params_t
+        self.cfg_d, self.params_d = cfg_d, params_d
+        self.k = k
+        rope_t = rope_frequencies(cfg_t.head_dim, cfg_t.max_seq,
+                                  cfg_t.rope_theta)
+        rope_d = rope_frequencies(cfg_d.head_dim, cfg_d.max_seq,
+                                  cfg_d.rope_theta)
+        self._prefill_t = llama._stepwise_executables(cfg_t, None)[0]
+        self._prefill_d = llama._stepwise_executables(cfg_d, None)[0]
+        # the draft chunk runs k steps, consuming [cur, d_1..d_{k-1}]:
+        # that writes the draft cache row for EVERY window position, so
+        # a fully-accepted window leaves no K/V hole at pos+k-1 (the
+        # k-th proposal itself is discarded — it exists to write d_{k-1}
+        # into the cache). The verify window is [cur, d_1..d_{k-1}].
+        self._draft_x = jax.jit(lambda p, c, pos, tok: llama.decode_chunk(
+            self.cfg_d, p, c, pos, tok, self.k,
+            rope=rope_d)) if k > 1 else None
+        self._verify_x = jax.jit(lambda p, c, toks, pos: llama.extend_step(
+            self.cfg_t, p, c, toks, pos, rope=rope_t))
+
+    def generate(self, prompt: jnp.ndarray, steps: int
+                 ) -> Tuple[jnp.ndarray, Dict[str, float]]:
+        """Greedy-decode ``steps`` tokens; returns (tokens [1, steps],
+        stats). Emits exactly ``llama.generate_stepwise``'s stream for
+        the target model."""
+        b, s = prompt.shape
+        if b != 1:
+            raise ValueError("speculative decoding is batch-1")
+        need = s + steps + self.k
+        if need > self.cfg_t.max_seq or need > self.cfg_d.max_seq:
+            raise ValueError(
+                f"prompt {s} + steps {steps} + k {self.k} exceeds "
+                f"max_seq (target {self.cfg_t.max_seq}, draft "
+                f"{self.cfg_d.max_seq})")
+        cache_t = llama.init_kv_cache(self.cfg_t, 1, self.cfg_t.max_seq)
+        cache_d = llama.init_kv_cache(self.cfg_d, 1, self.cfg_d.max_seq)
+        lt, cache_t = self._prefill_t(self.params_t, cache_t, prompt)
+        _, cache_d = self._prefill_d(self.params_d, cache_d, prompt)
+        cur = int(jnp.argmax(lt, axis=-1)[0])
+        out = [cur]
+        pos = s                       # next write position (holds `cur`)
+        passes = 0
+        while len(out) < steps:
+            if self._draft_x is not None:
+                draft, cache_d = self._draft_x(
+                    self.params_d, cache_d, jnp.int32(pos),
+                    jnp.asarray([cur], jnp.int32))
+                draft_toks = [int(t) for t in
+                              np.asarray(draft[0])][:self.k - 1]
+            else:
+                draft_toks = []
+            window = jnp.asarray([[cur] + draft_toks], jnp.int32)
+            logits, cache_t = self._verify_x(self.params_t, cache_t,
+                                             window, jnp.int32(pos))
+            target_toks = [int(t) for t in
+                           np.asarray(jnp.argmax(logits[0], axis=-1))]
+            passes += 1
+            # accept drafted tokens while the target agrees; the token
+            # at the first disagreement is the target's own choice, so
+            # every pass emits at least one target-correct token
+            emitted = []
+            for i, t in enumerate(target_toks):
+                emitted.append(t)
+                if i >= len(draft_toks) or draft_toks[i] != t:
+                    break
+            pos += len(emitted)
+            cur = emitted[-1]
+            out.extend(emitted)
+        out = out[:steps]
+        stats = {"verify_passes": passes,
+                 "tokens_per_pass": round(len(out) / max(passes, 1), 3),
+                 "k": self.k}
+        return jnp.asarray([out], jnp.int32), stats

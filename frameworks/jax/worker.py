@@ -319,9 +319,11 @@ def run_llama(args) -> dict:
                     _emit({"event": "heartbeat_error", "n": i,
                            "error": str(e)})
                 finally:
-                    # a failed drain must not leak its partial results
-                    # into the next heartbeat's token count
+                    # a failed drain must not leak its results OR its
+                    # still-active slots into the next heartbeat's
+                    # token count — drop both
                     server.finished.clear()
+                    server.abort_active()
         else:
             # sharded meshes: fixed-prompt heartbeat decode (SlotServer
             # is single-chip; tp shards heartbeat through generate_*)

@@ -1,0 +1,96 @@
+"""Speculative-decoding economics on one chip: what a K-token verify
+pass costs vs K solo decode steps.
+
+Decode is weight-streaming bound, so ``llama.extend_step`` — K tokens
+through ONE forward — is the primitive speculative decoding banks on:
+if a K-window costs about one decode step, every accepted draft token
+is nearly free. This tool measures that ratio directly (it does not
+need a trained draft model, which a zero-egress image cannot have: the
+ratio is a property of the target alone; end-to-end speedup is
+``k_accepted_per_pass / window_cost_ratio``).
+
+Prints one JSON line per window size. Usage::
+
+    python -m tools.bench_speculative [--preset 400m] [--quant int8]
+        [--windows 1,4,8,16] [--trials 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", default="400m", choices=["8b", "400m"])
+    p.add_argument("--quant", default="int8", choices=["none", "int8"])
+    p.add_argument("--windows", default="1,4,8,16")
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--max-seq", type=int, default=2048)
+    args = p.parse_args(argv)
+    windows = [int(w) for w in args.windows.split(",")]
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama
+
+    if args.preset == "8b":
+        cfg = llama.LlamaConfig.llama3_8b(max_seq=args.max_seq,
+                                          remat=False, attn_impl="dense")
+    else:
+        cfg = llama.LlamaConfig(vocab_size=32000, dim=1536, n_layers=8,
+                                n_heads=12, n_kv_heads=6, ffn_dim=4096,
+                                max_seq=args.max_seq, remat=False,
+                                attn_impl="dense")
+    if args.quant == "int8":
+        params = llama.init_quantized_params(cfg, jax.random.key(0),
+                                             device=jax.devices()[0])
+    else:
+        params = llama.init_params(cfg, jax.random.key(0))
+
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0,
+                                cfg.vocab_size)
+    cache = llama.init_kv_cache(cfg, 1, cfg.max_seq)
+    prefill_x = llama._stepwise_executables(cfg, None)[0]
+    _, cache = prefill_x(params, cache, prompt)
+
+    base_ms = None
+    for k in windows:
+        x = jax.jit(lambda p, c, toks, pos, k=k: llama.extend_step(
+            cfg, p, c, toks, pos))
+        toks = jax.random.randint(jax.random.key(2), (1, k), 0,
+                                  cfg.vocab_size)
+        logits, _ = x(params, cache, toks, jnp.int32(8))   # compile
+        jax.block_until_ready(logits)
+        trials = []
+        for _ in range(max(args.trials, 1)):
+            t0 = time.perf_counter()
+            for _ in range(8):                    # amortize dispatch
+                logits, _ = x(params, cache, toks, jnp.int32(8))
+            jax.block_until_ready(logits)
+            trials.append((time.perf_counter() - t0) / 8 * 1000.0)
+        trials.sort()
+        ms = trials[len(trials) // 2]
+        if base_ms is None:
+            base_ms = ms
+        print(json.dumps({
+            "metric": "speculative_verify_window",
+            "preset": args.preset,
+            "quant": args.quant,
+            "window": k,
+            "ms_per_pass": round(ms, 3),
+            "cost_vs_window1": round(ms / base_ms, 3),
+            "amortization": round(k * base_ms / ms, 2),
+            "spread_ms": {"min": round(trials[0], 3),
+                          "max": round(trials[-1], 3),
+                          "trials": len(trials)},
+            "backend": jax.devices()[0].platform,
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
