@@ -129,10 +129,9 @@ def _llama_step_rate(jax, n_chips, batch, seq, remat, remat_policy,
     # attn_impl="auto" = the production default: the pallas flash kernel on
     # unsharded TPU (dense measures within noise at these shapes — the
     # full-model A/B is in docs/performance.md)
-    cfg = llama.LlamaConfig(vocab_size=32000, dim=1536, n_layers=8,
-                            n_heads=12, n_kv_heads=6, ffn_dim=4096,
-                            max_seq=seq, remat=remat,
-                            remat_policy=remat_policy, attn_impl="auto")
+    cfg = llama.LlamaConfig.llama_400m(
+        max_seq=seq, remat=remat, remat_policy=remat_policy,
+        attn_impl="auto")
     params = llama.init_params(cfg, jax.random.key(0))
     n_params = sum(x.size for x in jax.tree.leaves(params))
     toks = jax.random.randint(jax.random.key(1), (batch, seq), 0,

@@ -80,6 +80,17 @@ class LlamaConfig:
         return cls(**kw)
 
     @classmethod
+    def llama_400m(cls, **kw) -> "LlamaConfig":
+        """The mid-size bench/operator preset (~306M params): fits any
+        chip comfortably, compiles in seconds — ONE definition so the
+        worker preset and every bench tool measure the same shape."""
+        defaults = dict(vocab_size=32000, dim=1536, n_layers=8,
+                        n_heads=12, n_kv_heads=6, ffn_dim=4096,
+                        max_seq=512, remat=False)
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
     def tiny(cls, **kw) -> "LlamaConfig":
         """4-layer toy config for tests and the multi-chip dry run."""
         defaults = dict(vocab_size=256, dim=64, n_layers=4, n_heads=8,

@@ -115,6 +115,9 @@ class SlotServer:
     def free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self.requests) if r is None]
 
+    def requests_active(self) -> bool:
+        return any(r is not None for r in self.requests)
+
     def submit(self, prompt: List[int], max_new: int = 32,
                request_id: Any = None) -> Optional[int]:
         """Prefill ``prompt`` into a free slot; returns the slot index,
@@ -220,7 +223,7 @@ class SlotServer:
         every request finishes. Each queue item: {"prompt": [...],
         "max_new": int, "request_id": any}."""
         pending = list(queue)
-        while pending or any(r is not None for r in self.requests):
+        while pending or self.requests_active():
             while pending:
                 item = pending[0]
                 slot = self.submit(item["prompt"],

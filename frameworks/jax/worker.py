@@ -222,6 +222,9 @@ def run_llama(args) -> dict:
         # weights only fit one chip quantized (~8.5 GB int8 vs 16 GB bf16)
         cfg = llama.LlamaConfig.llama3_8b(max_seq=args.max_seq or 2048,
                                           remat=False, kv_quant=kv_quant)
+    elif args.preset == "400m":
+        cfg = llama.LlamaConfig.llama_400m(max_seq=args.max_seq or 2048,
+                                           kv_quant=kv_quant)
     elif args.max_seq:
         cfg = llama.LlamaConfig.tiny(max_seq=args.max_seq,
                                      kv_quant=kv_quant)
@@ -229,11 +232,11 @@ def run_llama(args) -> dict:
         cfg = llama.LlamaConfig.tiny(kv_quant=kv_quant)
     mesh = MeshSpec(tp=n).build()
     gen_len = args.gen_len
-    # chunked for the big preset: the fused nested-scan generate takes
-    # minutes to compile at 8B through tunneled backends; decode_chunk
-    # compiles one K-step scan in seconds and amortizes per-step
-    # dispatch K-fold (models/llama.py:decode_chunk)
-    chunked = args.preset == "8b" or args.quant != "none"
+    # chunked for everything but tiny: the fused nested-scan generate
+    # takes minutes to compile at 400m+ through tunneled backends;
+    # decode_chunk compiles one K-step scan in seconds and amortizes
+    # per-step dispatch K-fold (models/llama.py:decode_chunk)
+    chunked = args.preset != "tiny" or args.quant != "none"
 
     # chunked rounds the continuation up to whole chunks before trimming;
     # divide by the EXECUTED token count or tps reads low off-alignment
@@ -530,7 +533,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch", type=int, default=32)
     p.add_argument("--depth", type=int, default=50,
                    help="resnet depth (18 for CPU smoke tests)")
-    p.add_argument("--preset", default="tiny", choices=["tiny", "8b"])
+    p.add_argument("--preset", default="tiny",
+                   choices=["tiny", "400m", "8b"])
     p.add_argument("--kv-quant", action="store_true",
                    help="int8 KV cache (models/llama.py init_kv_cache): "
                         "halves cache traffic / doubles KV that fits")

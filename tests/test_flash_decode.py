@@ -86,6 +86,34 @@ def test_supports_decode_gate():
     assert not supports_decode(jnp.concatenate([q, q], axis=1), k)
 
 
+def test_flash_prefill_matches_dense_prefill():
+    """Lane-aligned prompts route prefill through the pallas flash
+    kernel (the dense path's [B, H, S, S] fp32 score transient is the
+    long-context wall); logits and cache must match dense."""
+    base = dict(vocab_size=128, dim=256, n_layers=2, n_heads=2,
+                n_kv_heads=1, ffn_dim=256, max_seq=256, remat=False,
+                attn_impl="dense")
+    cfg_d = llama.LlamaConfig(**base, decode_attn="dense")
+    cfg_f = llama.LlamaConfig(**base, decode_attn="flash_interpret")
+    params = llama.init_params(cfg_d, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 128), 0,
+                                base["vocab_size"])
+    cache_d = llama.init_kv_cache(cfg_d, 2, cfg_d.max_seq)
+    cache_f = llama.init_kv_cache(cfg_f, 2, cfg_f.max_seq)
+    ld, cache_d = llama.prefill(cfg_d, params, cache_d, prompt)
+    lf, cache_f = llama.prefill(cfg_f, params, cache_f, prompt)
+    np.testing.assert_allclose(np.asarray(ld), np.asarray(lf),
+                               atol=5e-2, rtol=5e-2)
+    # layer 0's K/V are computed BEFORE any attention runs -> exactly
+    # equal; deeper layers inherit the attention impls' bf16 rounding
+    np.testing.assert_array_equal(
+        np.asarray(cache_d["k"][0], np.float32),
+        np.asarray(cache_f["k"][0], np.float32))
+    np.testing.assert_allclose(
+        np.asarray(cache_d["k"][1], np.float32),
+        np.asarray(cache_f["k"][1], np.float32), atol=0.15, rtol=0.1)
+
+
 def test_decode_step_flash_matches_dense_cfg():
     """decode_attn='flash' (interpret) equals decode_attn='dense' through
     the real llama decode_step at a lane-aligned config."""

@@ -53,12 +53,10 @@ def _preset_cfg(args, llama):
                                  max_seq=args.max_seq or 1024, remat=False,
                                  attn_impl="dense")
     if args.preset == "400m":
-        # ~0.4B params (~0.8 GB bf16): still weight-streaming bound, far
+        # ~0.3B params (~0.6 GB bf16): still weight-streaming bound, far
         # cheaper to compile
-        return llama.LlamaConfig(vocab_size=32000, dim=1536, n_layers=8,
-                                 n_heads=12, n_kv_heads=6, ffn_dim=4096,
-                                 max_seq=args.max_seq or 512, remat=False,
-                                 attn_impl="dense")
+        return llama.LlamaConfig.llama_400m(max_seq=args.max_seq or 512,
+                                            attn_impl="dense")
     return llama.LlamaConfig.tiny()
 
 

@@ -70,10 +70,8 @@ def main(argv=None) -> int:
         base = llama.LlamaConfig.llama3_8b(
             max_seq=args.max_seq, remat=False, attn_impl="dense")
     else:
-        base = llama.LlamaConfig(
-            vocab_size=32000, dim=1536, n_layers=8, n_heads=12,
-            n_kv_heads=6, ffn_dim=4096, max_seq=args.max_seq,
-            remat=False, attn_impl="dense")
+        base = llama.LlamaConfig.llama_400m(max_seq=args.max_seq,
+                                            attn_impl="dense")
 
     t0 = time.perf_counter()
     params = llama.init_quantized_params(base, jax.random.key(0),

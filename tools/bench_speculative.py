@@ -41,10 +41,8 @@ def main(argv=None) -> int:
         cfg = llama.LlamaConfig.llama3_8b(max_seq=args.max_seq,
                                           remat=False, attn_impl="dense")
     else:
-        cfg = llama.LlamaConfig(vocab_size=32000, dim=1536, n_layers=8,
-                                n_heads=12, n_kv_heads=6, ffn_dim=4096,
-                                max_seq=args.max_seq, remat=False,
-                                attn_impl="dense")
+        cfg = llama.LlamaConfig.llama_400m(max_seq=args.max_seq,
+                                           attn_impl="dense")
     if args.quant == "int8":
         params = llama.init_quantized_params(cfg, jax.random.key(0),
                                              device=jax.devices()[0])
