@@ -32,7 +32,8 @@ from dcos_commons_tpu.ops import (apply_rope, apply_rope_at,
                                   gqa_attention, repeat_kv,
                                   rms_norm, rope_frequencies,
                                   softmax_cross_entropy)
-from dcos_commons_tpu.ops.flash_decode import flash_decode
+from dcos_commons_tpu.ops.flash_decode import (flash_decode,
+                                               flash_decode_tp)
 from dcos_commons_tpu.ops.quant import (QTensor, dequantize, qmm, qtake,
                                         quantize)
 from dcos_commons_tpu.parallel.ring_attention import make_ring_attention
@@ -525,12 +526,32 @@ def cache_specs() -> Params:
             "v": P(None, "dp", None, "tp", None)}
 
 
+def _tp_only(mesh: Optional[Mesh]) -> bool:
+    """True when the mesh shards nothing but the ``tp`` axis — the
+    head-local sharding the flash-decode shard_map wrapper serves."""
+    return (mesh is not None and "tp" in mesh.shape
+            and all(n == 1 for ax, n in mesh.shape.items()
+                    if ax != "tp"))
+
+
 def _use_flash_decode(cfg: LlamaConfig, mesh: Optional[Mesh]) -> bool:
-    """Route decode_step's attention: the pallas kernel on unsharded TPU
-    with lane-aligned shapes (head_dim and max_seq % 128), dense
-    elsewhere. Sharded meshes stay dense — the kernel is not
-    GSPMD-partitionable and tp serving shards the heads axis."""
+    """Route decode_step's attention: the pallas kernel on TPU with
+    lane-aligned shapes (head_dim and max_seq % 128) — unsharded, or
+    tp-only meshes whose axis divides the KV heads (attention is
+    head-local, so tp shards run the kernel via shard_map with no
+    collectives); dense elsewhere."""
+    def mesh_ok(m):
+        return m is None or (_tp_only(m)
+                             and cfg.n_kv_heads % m.shape["tp"] == 0)
+
     if cfg.decode_attn in ("flash", "flash_interpret"):
+        if not mesh_ok(mesh):
+            # forcing flash on a mesh the kernel cannot serve must be
+            # loud, not a silent dense run or a KeyError downstream
+            raise ValueError(
+                f"decode_attn={cfg.decode_attn!r} needs an unsharded "
+                "or tp-only mesh whose axis divides the KV heads; got "
+                f"{dict(mesh.shape)}")
         return True
     if cfg.decode_attn == "dense":
         return False
@@ -539,14 +560,17 @@ def _use_flash_decode(cfg: LlamaConfig, mesh: Optional[Mesh]) -> bool:
         raise ValueError(
             f"decode_attn={cfg.decode_attn!r}: expected one of "
             "'auto', 'dense', 'flash', 'flash_interpret'")
-    return (mesh is None and jax.default_backend() == "tpu"
-            and cfg.head_dim % 128 == 0 and cfg.max_seq % 128 == 0)
+    if jax.default_backend() != "tpu" \
+            or cfg.head_dim % 128 or cfg.max_seq % 128:
+        return False
+    return mesh_ok(mesh)
 
 
 def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
                  tokens: jnp.ndarray, flash: bool, rope_fn, cache_write,
                  kv_len, causal: bool = False, q_offset=0,
-                 all_positions: bool = False
+                 all_positions: bool = False,
+                 mesh: Optional[Mesh] = None
                  ) -> Tuple[jnp.ndarray, Params]:
     """The cache-consuming forward shared by :func:`decode_step` (one
     scalar position), :func:`decode_step_slots` (per-slot positions),
@@ -576,10 +600,15 @@ def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
         if flash:
             # the pallas kernel consumes the cache in storage form (int8
             # payload + scales dequantize in VMEM); the dense read above
-            # is dead code XLA eliminates on this branch
-            o = flash_decode(
-                q, k_cache, v_cache, kv_len,
-                interpret=(cfg.decode_attn == "flash_interpret"))
+            # is dead code XLA eliminates on this branch. tp meshes run
+            # the kernel per head shard (shard_map, no collectives).
+            interp = cfg.decode_attn == "flash_interpret"
+            if mesh is not None:
+                o = flash_decode_tp(q, k_cache, v_cache, kv_len, mesh,
+                                    interpret=interp)
+            else:
+                o = flash_decode(q, k_cache, v_cache, kv_len,
+                                 interpret=interp)
         else:
             o = gqa_attention(q, k_read, v_read, causal=causal,
                               q_offset=q_offset, kv_len=kv_len)
@@ -621,7 +650,7 @@ def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
         rope_fn=lambda t: apply_rope(t, rope, pos),
         cache_write=lambda c, new: _cache_update(c, new, pos, 1,
                                                  cfg.dtype),
-        kv_len=pos + 1)
+        kv_len=pos + 1, mesh=mesh)
 
 
 def extend_step(cfg: LlamaConfig, params: Params, cache: Params,
@@ -691,7 +720,7 @@ def decode_step_slots(cfg: LlamaConfig, params: Params, cache: Params,
         rope_fn=lambda t: apply_rope_at(t, rope, lengths),
         cache_write=lambda c, new: _cache_update_slots(c, new, lengths,
                                                        cfg.dtype),
-        kv_len=lengths + 1)
+        kv_len=lengths + 1, mesh=mesh)
 
 
 def prefill(cfg: LlamaConfig, params: Params, cache: Params,
@@ -737,7 +766,11 @@ def prefill_trunk(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,
     through VMEM tiles.
     """
     s = prompt.shape[1]
-    if _use_flash_decode(cfg, mesh) and s % 128 == 0 \
+    # flash prefill is UNSHARDED-only: unlike decode (head-local, so tp
+    # shards wrap the kernel in shard_map), prefill's pallas call on
+    # GSPMD-sharded activations has no partitioning rule — sharded
+    # meshes keep the dense path, which partitions fine
+    if mesh is None and _use_flash_decode(cfg, None) and s % 128 == 0 \
             and cfg.head_dim <= 256:
         from dcos_commons_tpu.ops.flash_attention import flash_attention
         interp = cfg.decode_attn == "flash_interpret"

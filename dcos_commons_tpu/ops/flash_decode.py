@@ -211,6 +211,44 @@ def flash_decode(q: jnp.ndarray, k: Union[jnp.ndarray, QTensor],
     return out[:, :, :group, :].reshape(b, 1, h, d)
 
 
+def flash_decode_tp(q: jnp.ndarray, k: Union[jnp.ndarray, QTensor],
+                    v: Union[jnp.ndarray, QTensor], kv_len: jnp.ndarray,
+                    mesh, *, axis: str = "tp",
+                    sm_scale: Optional[float] = None, block_k: int = 512,
+                    interpret: bool = False) -> jnp.ndarray:
+    """:func:`flash_decode` under tensor parallelism.
+
+    Attention is head-local, so megatron-sharded serving (heads split
+    over the ``tp`` mesh axis) runs the kernel independently per shard
+    on its local head group — ``shard_map`` with head-axis specs and NO
+    collectives. Requires the KV head count to divide evenly across the
+    axis (the GQA group size is then preserved per shard).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape[axis]
+    kq = k.q if isinstance(k, QTensor) else k
+    kv_heads = kq.shape[2]
+    if kv_heads % tp:
+        raise ValueError(
+            f"flash_decode_tp: {kv_heads} KV heads do not divide over "
+            f"{axis}={tp}")
+    hspec = P(None, None, axis, None)
+    cspec = (QTensor(hspec, hspec) if isinstance(k, QTensor) else hspec)
+
+    def shard(q_l, k_l, v_l, kv_len_l):
+        return flash_decode(q_l, k_l, v_l, kv_len_l, sm_scale=sm_scale,
+                            block_k=block_k, interpret=interpret)
+
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-
+    # axes annotation, and the body is collective-free by construction
+    return jax.shard_map(
+        shard, mesh=mesh,
+        in_specs=(hspec, cspec, cspec, P()),
+        out_specs=hspec, check_vma=False)(
+            q, k, v, jnp.asarray(kv_len, jnp.int32))
+
+
 def supports_decode(q: jnp.ndarray, k) -> bool:
     """Whether the pallas decode path can serve this call."""
     kq = k.q if isinstance(k, QTensor) else k

@@ -76,6 +76,53 @@ def test_unknown_decode_attn_is_loud():
         llama._use_flash_decode(cfg, None)
 
 
+def test_flash_decode_tp_sharded_matches_dense():
+    """Megatron tp sharding runs the kernel per head shard (shard_map,
+    no collectives): the sharded flash stream equals the sharded dense
+    stream and the unsharded one, int8 weights included."""
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+    base = dict(vocab_size=128, dim=1024, n_layers=2, n_heads=8,
+                n_kv_heads=8, ffn_dim=256, max_seq=128, remat=False,
+                attn_impl="dense")
+    cfg_d = llama.LlamaConfig(**base, decode_attn="dense")
+    cfg_f = llama.LlamaConfig(**base, decode_attn="flash_interpret")
+    params = llama.quantize_params(llama.init_params(
+        llama.LlamaConfig(**base), jax.random.key(0)))
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0,
+                                base["vocab_size"])
+    want = llama.generate_stepwise(cfg_d, params, prompt, steps=6)
+    mesh = MeshSpec(tp=8).build()
+    with mesh:
+        sharded = llama.shard_params(params, mesh, cfg_f)
+        got = llama.generate_stepwise(cfg_f, sharded, prompt, steps=6,
+                                      mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_forced_flash_on_incompatible_mesh_is_loud():
+    """decode_attn='flash*' with a mesh the kernel cannot serve (sharded
+    beyond tp) must raise, not silently run dense or KeyError."""
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+    cfg = llama.LlamaConfig.tiny(decode_attn="flash_interpret")
+    mesh = MeshSpec(dp=8).build()
+    with pytest.raises(ValueError, match="tp-only"):
+        llama._use_flash_decode(cfg, mesh)
+
+
+def test_flash_decode_tp_rejects_indivisible_kv():
+    from jax.sharding import Mesh
+    from dcos_commons_tpu.ops.flash_decode import flash_decode_tp
+
+    devs = np.array(jax.devices()[:2])
+    mesh = Mesh(devs, ("tp",))
+    q = jnp.zeros((1, 1, 3, 128), jnp.bfloat16)
+    k = jnp.zeros((1, 128, 3, 128), jnp.bfloat16)
+    with pytest.raises(ValueError, match="KV heads"):
+        flash_decode_tp(q, k, k, jnp.int32(4), mesh, interpret=True)
+
+
 def test_supports_decode_gate():
     q, k, v = _inputs(jax.random.key(0), 8)
     assert supports_decode(q, k)
