@@ -541,8 +541,10 @@ def _use_flash_decode(cfg: LlamaConfig, mesh: Optional[Mesh]) -> bool:
     head-local, so tp shards run the kernel via shard_map with no
     collectives); dense elsewhere."""
     def mesh_ok(m):
-        return m is None or (_tp_only(m)
-                             and cfg.n_kv_heads % m.shape["tp"] == 0)
+        # a one-device mesh shards nothing, whatever its axes are named
+        return (m is None or m.size == 1
+                or (_tp_only(m)
+                    and cfg.n_kv_heads % m.shape["tp"] == 0))
 
     if cfg.decode_attn in ("flash", "flash_interpret"):
         if not mesh_ok(mesh):
@@ -603,10 +605,12 @@ def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
             # is dead code XLA eliminates on this branch. tp meshes run
             # the kernel per head shard (shard_map, no collectives).
             interp = cfg.decode_attn == "flash_interpret"
-            if mesh is not None:
+            if mesh is not None and mesh.shape.get("tp", 1) > 1:
                 o = flash_decode_tp(q, k_cache, v_cache, kv_len, mesh,
                                     interpret=interp)
             else:
+                # no real tp sharding (no mesh, or a one-device /
+                # tp=1 mesh): the plain kernel call partitions trivially
                 o = flash_decode(q, k_cache, v_cache, kv_len,
                                  interpret=interp)
         else:

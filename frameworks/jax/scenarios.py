@@ -35,6 +35,12 @@ DEFAULT_ENV: Mapping[str, str] = {
     "NUM_SLICES": "2",
     # sharded-checkpoint cadence for llama-train scenarios (0 = final only)
     "CKPT_EVERY": "0",
+    # continuous-batching scenario knobs (serving.yml): single-chip
+    # slot-engine replicas; SERVE_FLAGS carries e.g.
+    # "--quant int8 --kv-quant" for the 8b preset
+    "SERVER_COUNT": "4",
+    "SERVE_SLOTS": "8",
+    "SERVE_FLAGS": "",
     # long-context scenario knobs (longctx.yml)
     "SEQ_LEN": "8192",
     "ATTN_IMPL": "ring",

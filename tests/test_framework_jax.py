@@ -227,6 +227,65 @@ class TestWorkerWorkloads:
         done = [e for e in events if e.get("event") == "done"]
         assert done and done[0]["tokens_per_sec"] > 0
 
+    def test_llama_serving_slots_heartbeat(self, tmp_path):
+        """The serving.yml path: --serve --slots runs the continuous-
+        batching engine; heartbeats drain request bursts and report
+        slot-engine throughput. Driven as the real process the
+        scheduler would launch (the loop never exits on its own)."""
+        import subprocess
+        import sys
+        import time as _time
+
+        # single device: the conftest's 8-device XLA_FLAGS would leak in
+        # and shard the mesh, which falls back to heartbeat decode
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=1",
+                   PYTHONPATH=os.path.abspath(
+                       os.path.join(os.path.dirname(__file__), "..")))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "frameworks.jax.worker", "llama",
+             "--serve", "--slots", "2", "--serve-interval", "0.1",
+             "--gen-len", "4"],
+            cwd=tmp_path, env=env, stdout=subprocess.PIPE, text=True)
+        try:
+            import queue
+            import threading
+
+            lines: queue.Queue = queue.Queue()
+
+            def pump():
+                for raw in proc.stdout:
+                    lines.put(raw)
+
+            # reader thread so the deadline is real: a blocked
+            # readline() would otherwise hang the suite past it
+            threading.Thread(target=pump, daemon=True).start()
+            deadline = _time.time() + 120
+            serving = heartbeat = None
+            while _time.time() < deadline:
+                try:
+                    line = lines.get(timeout=min(
+                        5.0, max(deadline - _time.time(), 0.1)))
+                except queue.Empty:
+                    continue
+                e = json.loads(line)
+                if e.get("event") == "serving":
+                    serving = e
+                if e.get("event") == "heartbeat":
+                    heartbeat = e
+                    break
+            assert serving and serving["slots"] == 2, serving
+            assert heartbeat, "no heartbeat before deadline"
+            assert heartbeat["requests"] == 4      # 2 * slots per burst
+            assert heartbeat["tokens"] > 0
+            assert (tmp_path / "serving.ready").exists()
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
 
 class TestPipelineParallel:
     def test_llama_train_pp_on_cpu_mesh(self, tmp_path, capsys):

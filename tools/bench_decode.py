@@ -45,9 +45,9 @@ def _preset_cfg(args, llama):
                                            remat=False, attn_impl="dense")
     if args.preset == "1b":
         # ~0.9B params (~1.8 GB bf16): decode streams the full weight set
-        # per token -> HBM-bound. NOTE: the nested-scan decode graph takes
-        # >15 min to compile through tunneled PJRT backends; prefer 400m
-        # unless compiles are local/cached.
+        # per token -> HBM-bound. Use chunked/stepwise modes here: only
+        # the FUSED whole-generation program has the pathological
+        # remote-compile cost at this size.
         return llama.LlamaConfig(vocab_size=32000, dim=2048, n_layers=16,
                                  n_heads=16, n_kv_heads=8, ffn_dim=5632,
                                  max_seq=args.max_seq or 1024, remat=False,
