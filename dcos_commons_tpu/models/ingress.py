@@ -1,0 +1,374 @@
+"""HTTP front door for the continuous-batching serving engine.
+
+The reference's services serve real client traffic on reserved, advertised
+ports (``http/endpoints/EndpointsResource.java:22``; cassandra's client
+ports in ``frameworks/cassandra/src/main/dist/svc.yml``). This is the
+serving-workload analogue: a request ingress in front of
+:class:`~dcos_commons_tpu.models.serving.SlotServer`, so a deployed
+serving pod accepts work instead of draining synthetic bursts.
+
+Design, TPU-first: HTTP handler threads never touch the device. They
+validate, enqueue into a BOUNDED queue (back-pressure is a 503 +
+Retry-After, not an unbounded pile-up in front of a fixed-throughput
+chip), and wait on their request's stream. ONE engine thread owns the
+SlotServer — submissions fill freed slots, one ``step()`` advances every
+active slot, and freshly decoded tokens are fanned out to the per-request
+streams with timestamps. That keeps every device dispatch on a single
+thread (no lock around the cache pytree) and makes TTFT/TPOT measurable
+per request at the ingress, where the serving benchmarks need them.
+
+API (all JSON):
+
+* ``POST /v1/generate``  ``{"prompt": [ints], "max_new": N, "stream": bool}``
+  → ``{"tokens": [...], "ttft_ms", "tpot_ms", "queue_ms"}``; with
+  ``stream`` true, chunked JSON lines ``{"token": t}`` … ``{"done": true}``.
+* ``GET /v1/healthz`` → 200 once the engine thread accepts work (the
+  serving.yml readiness gate).
+* ``GET /v1/stats`` → request/token totals + TTFT/TPOT percentiles over
+  the last window.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from dcos_commons_tpu.models.serving import SlotServer
+
+
+class _Pending:
+    """One in-flight request: filled in by the engine thread, consumed by
+    the handler thread that owns the HTTP connection."""
+
+    __slots__ = ("prompt", "max_new", "stream", "tokens", "emitted",
+                 "t_enqueue", "t_submit", "t_first", "t_done", "error",
+                 "done", "events")
+
+    def __init__(self, prompt: List[int], max_new: int):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.tokens: List[int] = []
+        self.emitted = 0                  # engine-side high-water mark
+        self.t_enqueue = time.perf_counter()
+        self.t_submit: Optional[float] = None
+        self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.error: Optional[str] = None
+        self.done = threading.Event()
+        # token stream for chunked responses: ints, then None sentinel
+        self.events: "queue.Queue" = queue.Queue()
+
+    def push(self, tokens: List[int]) -> None:
+        now = time.perf_counter()
+        for t in tokens:
+            if self.t_first is None:
+                self.t_first = now
+            self.tokens.append(t)
+            self.events.put(t)
+
+    def finish(self, error: Optional[str] = None) -> None:
+        self.error = error
+        self.t_done = time.perf_counter()
+        self.events.put(None)
+        self.done.set()
+
+    def timings_ms(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        if self.t_submit is not None:
+            out["queue_ms"] = round((self.t_submit - self.t_enqueue) * 1e3, 3)
+        if self.t_first is not None:
+            out["ttft_ms"] = round((self.t_first - self.t_enqueue) * 1e3, 3)
+        if (self.t_done is not None and self.t_first is not None
+                and len(self.tokens) > 1):
+            out["tpot_ms"] = round(
+                (self.t_done - self.t_first) / (len(self.tokens) - 1) * 1e3,
+                3)
+        return out
+
+
+def _percentiles(values: List[float]) -> Dict[str, float]:
+    if not values:
+        return {}
+    xs = sorted(values)
+    def pick(q: float) -> float:
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))], 3)
+    return {"p50": pick(0.50), "p95": pick(0.95), "p99": pick(0.99)}
+
+
+class ServingFrontend:
+    """Bounded-queue HTTP ingress over one :class:`SlotServer`."""
+
+    def __init__(self, engine: SlotServer, port: int = 0,
+                 host: str = "0.0.0.0", max_queue: int = 64,
+                 request_timeout_s: float = 600.0,
+                 idle_sleep_s: float = 0.001):
+        self.engine = engine
+        self.max_queue = max_queue
+        self.request_timeout_s = request_timeout_s
+        self._idle_sleep_s = idle_sleep_s
+        self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=max_queue)
+        self._live: Dict[int, _Pending] = {}          # slot -> pending
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._lock = threading.Lock()                 # stats only
+        self._totals = {"requests": 0, "tokens": 0, "rejected": 0}
+        self._window: deque = deque(maxlen=1024)      # (ttft_ms, tpot_ms)
+        self._engine_thread: Optional[threading.Thread] = None
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # one request per connection keeps the thread pool honest
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):             # no stderr spam
+                pass
+
+            def _json(self, code: int, payload: dict,
+                      extra_headers: Optional[dict] = None) -> None:
+                body = (json.dumps(payload) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/v1/healthz":
+                    self._json(200, frontend.health())
+                elif self.path == "/v1/stats":
+                    self._json(200, frontend.stats())
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/v1/generate":
+                    self._json(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    prompt = req.get("prompt")
+                    max_new = int(req.get("max_new", 32))
+                    stream = bool(req.get("stream", False))
+                    if (not isinstance(prompt, list) or not prompt
+                            or not all(isinstance(t, int) for t in prompt)):
+                        raise ValueError("prompt must be a non-empty "
+                                         "list of ints")
+                    if max_new < 1:
+                        raise ValueError("max_new must be >= 1")
+                    cfg = frontend.engine.cfg
+                    if len(prompt) + max_new > cfg.max_seq:
+                        raise ValueError(
+                            f"prompt {len(prompt)} + max_new {max_new} "
+                            f"exceeds the cache ({cfg.max_seq})")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                pending = _Pending(prompt, max_new)
+                if not frontend._enqueue(pending):
+                    self._json(503, {"error": "queue full"},
+                               {"Retry-After": "1"})
+                    return
+                if stream:
+                    self._stream(pending)
+                else:
+                    self._unary(pending)
+
+            def _unary(self, pending: _Pending) -> None:
+                if not pending.done.wait(frontend.request_timeout_s):
+                    self._json(504, {"error": "request timed out"})
+                    return
+                if pending.error:
+                    self._json(500, {"error": pending.error})
+                    return
+                self._json(200, {"tokens": pending.tokens,
+                                 **pending.timings_ms()})
+
+            def _stream(self, pending: _Pending) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(obj: dict) -> None:
+                    data = (json.dumps(obj) + "\n").encode()
+                    self.wfile.write(f"{len(data):x}\r\n".encode()
+                                     + data + b"\r\n")
+
+                deadline = time.time() + frontend.request_timeout_s
+                finished = False
+                while time.time() < deadline:
+                    try:
+                        tok = pending.events.get(timeout=1.0)
+                    except queue.Empty:
+                        continue
+                    if tok is None:
+                        finished = True
+                        break
+                    chunk({"token": tok})
+                if pending.error:
+                    chunk({"done": True, "error": pending.error})
+                elif not finished:
+                    # a deadline-truncated stream must NOT read as a
+                    # complete one (the unary path 504s here)
+                    chunk({"done": True, "error": "request timed out"})
+                else:
+                    chunk({"done": True, **pending.timings_ms()})
+                self.wfile.write(b"0\r\n\r\n")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._http_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ intake
+
+    def _enqueue(self, pending: _Pending) -> bool:
+        try:
+            self._queue.put_nowait(pending)
+        except queue.Full:
+            with self._lock:
+                self._totals["rejected"] += 1
+            return False
+        self._wake.set()
+        return True
+
+    # ------------------------------------------------------- engine loop
+
+    def _fill_slots(self) -> bool:
+        filled = False
+        while self.engine.free_slots():
+            try:
+                pending = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            pending.t_submit = time.perf_counter()
+            try:
+                self._live[self.engine.submit(
+                    pending.prompt, pending.max_new,
+                    request_id=pending)] = pending
+            except ValueError as e:     # belt-and-braces: validated at POST
+                pending.finish(str(e))
+                continue
+            except Exception as e:
+                # dequeued but not yet in _live: fail it HERE or the
+                # client hangs to its timeout (_fail_inflight only sees
+                # _live) — then re-raise so _run_engine resets the
+                # engine (the dispatch may have invalidated the cache)
+                pending.finish(f"engine error: {e}")
+                raise
+            filled = True
+            self._sync()                # instant retire (max_new == 1)
+        return filled
+
+    def _sync(self) -> None:
+        """Fan freshly decoded tokens out to their request streams and
+        resolve completions (engine thread only)."""
+        for slot, pending in list(self._live.items()):
+            r = self.engine.requests[slot]
+            if r is not None and r.request_id is pending:
+                if len(r.tokens) > pending.emitted:
+                    pending.push(r.tokens[pending.emitted:])
+                    pending.emitted = len(r.tokens)
+                continue
+            toks = self.engine.finished.pop(pending, None)
+            if toks is not None and len(toks) > pending.emitted:
+                pending.push(toks[pending.emitted:])
+                pending.emitted = len(toks)
+            del self._live[slot]
+            # finish() first: timings_ms() only reports tpot once t_done
+            # is stamped, so the stats window must read AFTER it
+            pending.finish()
+            with self._lock:
+                self._totals["requests"] += 1
+                self._totals["tokens"] += len(pending.tokens)
+                t = pending.timings_ms()
+                self._window.append((t.get("ttft_ms"), t.get("tpot_ms")))
+
+    def _run_engine(self) -> None:
+        while not self._stop.is_set():
+            try:
+                filled = self._fill_slots()
+                if self.engine.requests_active():
+                    self.engine.step()
+                    self._sync()
+                elif not filled:
+                    self._wake.wait(self._idle_sleep_s * 50)
+                    self._wake.clear()
+            except Exception as e:          # keep serving: only the
+                # scheduler's health machinery should kill this task.
+                # In-flight requests fail (their state is gone), the
+                # engine RESETS (the jitted step donates the cache, so
+                # after a failed dispatch the old buffer is invalid),
+                # and the loop accepts new work.
+                self._fail_inflight(f"engine error: {e}")
+
+    def _fail_inflight(self, error: str) -> None:
+        for pending in list(self._live.values()):
+            pending.finish(error)
+        self._live.clear()
+        with self._lock:
+            self._totals["errors"] = self._totals.get("errors", 0) + 1
+        try:
+            self.engine.reset()
+        except Exception:
+            # a reset failure leaves the engine unusable; surface via
+            # health (engine thread exits -> ok: false -> readiness
+            # fails -> the scheduler restarts the pod)
+            raise
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "ServingFrontend":
+        self._engine_thread = threading.Thread(target=self._run_engine,
+                                               daemon=True,
+                                               name="serving-engine")
+        self._engine_thread.start()
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="serving-http")
+        self._http_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._engine_thread:
+            self._engine_thread.join(timeout=10)
+        # fail anything still queued or in flight so no client hangs
+        while True:
+            try:
+                self._queue.get_nowait().finish("server stopped")
+            except queue.Empty:
+                break
+        for pending in list(self._live.values()):
+            pending.finish("server stopped")
+        self._live.clear()
+
+    # ------------------------------------------------------------- status
+
+    def health(self) -> dict:
+        alive = (self._engine_thread is not None
+                 and self._engine_thread.is_alive())
+        return {"ok": alive, "slots": self.engine.slots,
+                "free": len(self.engine.free_slots()),
+                "queued": self._queue.qsize()}
+
+    def stats(self) -> dict:
+        with self._lock:
+            totals = dict(self._totals)
+            window = list(self._window)
+        ttft = [t for t, _ in window if t is not None]
+        tpot = [t for _, t in window if t is not None]
+        return {**totals, "queued": self._queue.qsize(),
+                "ttft_ms": _percentiles(ttft),
+                "tpot_ms": _percentiles(tpot)}

@@ -54,6 +54,13 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     attn_impl: str = "auto"         # auto | dense | flash | ring | ulysses
+    # ring attention block order: "contiguous" (shard i holds positions
+    # [i*S/R, (i+1)*S/R); causal hops behind the diagonal skip compute
+    # but the live work is imbalanced across the ring) or "zigzag"
+    # (shard i holds chunks (i, 2R-1-i) of 2R chunks — balanced causal
+    # skipping; training lays tokens out via
+    # parallel.ring_attention.zigzag_indices, loss_fn handles it)
+    ring_layout: str = "contiguous"
     dtype: Any = jnp.bfloat16
     remat: bool = True              # jax.checkpoint each layer (training)
     # selective-checkpoint policy name from jax.checkpoint_policies
@@ -257,11 +264,23 @@ def _make_attn_fn(cfg: LlamaConfig, mesh: Optional[Mesh]) -> Callable:
         return attn
     if impl == "dense" or mesh is None:
         return lambda q, k, v: gqa_attention(q, k, v, causal=True)
-    n_rep = cfg.n_heads // cfg.n_kv_heads
     if impl == "ring":
-        ring = make_ring_attention(mesh, causal=True)
-        return lambda q, k, v: ring(q, repeat_kv(k, n_rep),
-                                    repeat_kv(v, n_rep))
+        # RAW kv heads cross the ring: the GQA broadcast happens inside
+        # the tile einsum (parallel/ring_attention.py), so the hops move
+        # H/KV-times fewer ICI bytes and no repeated copy lands in HBM.
+        # When the tp axis does NOT divide the kv heads (but does divide
+        # the query heads — the pre-round-5 working envelope), fall back
+        # to rotating the expanded heads rather than failing the gang.
+        tp_size = mesh.shape.get("tp", 1) if mesh is not None else 1
+        if tp_size > 1 and cfg.n_kv_heads % tp_size:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            ring = make_ring_attention(mesh, causal=True,
+                                       layout=cfg.ring_layout)
+            return lambda q, k, v: ring(q, repeat_kv(k, rep),
+                                        repeat_kv(v, rep))
+        return make_ring_attention(mesh, causal=True,
+                                   layout=cfg.ring_layout)
+    n_rep = cfg.n_heads // cfg.n_kv_heads
     if impl == "ulysses":
         uly = make_ulysses_attention(mesh, causal=True)
         return lambda q, k, v: uly(q, repeat_kv(k, n_rep),
@@ -325,9 +344,18 @@ def _maybe_checkpoint(fn, cfg: LlamaConfig):
 
 
 def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
-            mesh: Optional[Mesh] = None) -> jnp.ndarray:
-    """tokens [B, S] int32 -> logits [B, S, V] fp32."""
+            mesh: Optional[Mesh] = None,
+            positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """tokens [B, S] int32 -> logits [B, S, V] fp32.
+
+    ``positions`` (optional [S] int32): the global position of each
+    sequence slot, for layouts where slot != position (the zigzag ring
+    layout) — rope reads the gathered table; attention impls that mask
+    by position (ring) derive the same map from their layout.
+    """
     rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    if positions is not None:
+        rope = rope[:, jnp.asarray(positions)]
     attn_fn = _make_attn_fn(cfg, mesh)
 
     x = qtake(params["embed"], tokens, cfg.dtype)
@@ -481,9 +509,25 @@ def loss_fn_moe(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
 
 def loss_fn(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
             mesh: Optional[Mesh] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Next-token LM loss over tokens [B, S] -> (loss, accuracy)."""
-    logits = forward(cfg, params, tokens[:, :-1], mesh)
-    return softmax_cross_entropy(logits, tokens[:, 1:], z_loss=1e-4)
+    """Next-token LM loss over tokens [B, S] -> (loss, accuracy).
+
+    With the zigzag ring layout, inputs AND targets are permuted into
+    the layout order (the shift into input/target pairs happens FIRST,
+    in natural order) — cross entropy is permutation-invariant under a
+    consistent pairing, so the loss equals the natural-order loss while
+    the ring's causal work stays balanced."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    if (cfg.attn_impl == "ring" and cfg.ring_layout == "zigzag"
+            and mesh is not None):
+        from dcos_commons_tpu.parallel.ring_attention import zigzag_indices
+        perm = jnp.asarray(zigzag_indices(inputs.shape[1],
+                                          mesh.shape["sp"]))
+        logits = forward(cfg, params, inputs[:, perm], mesh,
+                         positions=perm)
+        return softmax_cross_entropy(logits, targets[:, perm],
+                                     z_loss=1e-4)
+    logits = forward(cfg, params, inputs, mesh)
+    return softmax_cross_entropy(logits, targets, z_loss=1e-4)
 
 
 # ---------------------------------------------------------------------------
@@ -770,16 +814,23 @@ def prefill_trunk(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,
     through VMEM tiles.
     """
     s = prompt.shape[1]
-    # flash prefill is UNSHARDED-only: unlike decode (head-local, so tp
-    # shards wrap the kernel in shard_map), prefill's pallas call on
-    # GSPMD-sharded activations has no partitioning rule — sharded
-    # meshes keep the dense path, which partitions fine
-    if mesh is None and _use_flash_decode(cfg, None) and s % 128 == 0 \
+    # flash prefill routes like flash decode (_use_flash_decode):
+    # unsharded runs the plain kernel; tp-only meshes whose axis divides
+    # the KV heads run it per head shard via shard_map
+    # (ops.flash_attention.flash_attention_tp — attention is head-local,
+    # no collectives). Anything else keeps the dense path, which
+    # partitions under GSPMD but pays the [B, H, S, S] fp32 transient.
+    if _use_flash_decode(cfg, mesh) and s % 128 == 0 \
             and cfg.head_dim <= 256:
-        from dcos_commons_tpu.ops.flash_attention import flash_attention
+        from dcos_commons_tpu.ops.flash_attention import (
+            flash_attention, flash_attention_tp)
         interp = cfg.decode_attn == "flash_interpret"
-        attn_fn = (lambda q, k, v: flash_attention(
-            q, k, v, causal=True, interpret=interp))
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            attn_fn = (lambda q, k, v: flash_attention_tp(
+                q, k, v, mesh, causal=True, interpret=interp))
+        else:
+            attn_fn = (lambda q, k, v: flash_attention(
+                q, k, v, causal=True, interpret=interp))
     else:
         attn_fn = (lambda q, k, v: gqa_attention(q, k, v, causal=True))
     x = qtake(params["embed"], prompt, cfg.dtype)
@@ -795,10 +846,23 @@ def prefill_trunk(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,
     return rms_norm(x, params["norm"], cfg.norm_eps), ks, vs
 
 
+def _check_capacity(cfg: LlamaConfig, prompt_len: int, steps: int) -> None:
+    """Reject requests that would write past the cache: dynamic_update_slice
+    CLAMPS out-of-range positions, so an oversized ask silently smears
+    writes onto the last cache row and returns corrupted tokens instead of
+    failing (SlotServer.submit and SpeculativeDecoder.generate carry the
+    same guard)."""
+    if prompt_len + steps > cfg.max_seq:
+        raise ValueError(
+            f"prompt {prompt_len} + steps {steps} exceeds the cache "
+            f"({cfg.max_seq}); raise max_seq or shrink the ask")
+
+
 def generate(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,
              steps: int, mesh: Optional[Mesh] = None) -> jnp.ndarray:
     """Greedy generation: parallel prefill, then scan decode steps."""
     b, s = prompt.shape
+    _check_capacity(cfg, s, steps)
     cache = init_kv_cache(cfg, b, cfg.max_seq)
     # hoisted once: inside the scan it would be re-materialized per body
     rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
@@ -841,6 +905,23 @@ def decode_chunk(cfg: LlamaConfig, params: Params, cache: Params,
     helpers). The scan body compiles once regardless of ``steps``, so
     the compile cost is one decode_step's; dispatch cost is /steps.
     """
+    toks, _, cache = decode_chunk_logits(cfg, params, cache, pos, token,
+                                         steps, mesh, rope=rope,
+                                         sampler=sampler, key=key)
+    # the unused per-step logits stack is dead code XLA eliminates
+    # under the caller's jit — ONE scan body serves both entry points
+    return toks, cache                                     # [B, steps]
+
+
+def decode_chunk_logits(cfg: LlamaConfig, params: Params, cache: Params,
+                        pos: jnp.ndarray, token: jnp.ndarray, steps: int,
+                        mesh: Optional[Mesh] = None,
+                        rope: Optional[jnp.ndarray] = None,
+                        sampler=None, key: Optional[jax.Array] = None
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray, Params]:
+    """:func:`decode_chunk` that ALSO returns every step's logits
+    [B, steps, V] — the draft side of sampled speculative decoding needs
+    q_i(x_i) for the rejection test, not just the sampled tokens."""
     if rope is None:
         rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     if key is None:
@@ -852,11 +933,37 @@ def decode_chunk(cfg: LlamaConfig, params: Params, cache: Params,
                                     mesh, rope=rope)
         k, sub = jax.random.split(k)
         nxt = _select(sampler, sub, logits, tok.dtype)
-        return (cache, nxt, k), nxt
+        return (cache, nxt, k), (nxt, logits)
 
-    (cache, _, _), toks = lax.scan(step, (cache, token, key),
-                                   jnp.arange(steps))
-    return jnp.swapaxes(toks, 0, 1), cache                 # [B, steps]
+    (cache, _, _), (toks, logits) = lax.scan(step, (cache, token, key),
+                                             jnp.arange(steps))
+    return (jnp.swapaxes(toks, 0, 1),
+            jnp.swapaxes(logits, 0, 1), cache)     # [B,steps],[B,steps,V]
+
+
+def truncate_layers(cfg: LlamaConfig, params: Params, n_layers: int
+                    ) -> Tuple[LlamaConfig, Params]:
+    """A layer-skip draft: the target's FIRST ``n_layers`` decoder layers
+    with the embed/final-norm/lm_head shared (self-speculation a la
+    layer-skip / draft-&-verify). Zero extra weights to store — the
+    stacked [L, ...] layout makes the cut a view. Works on quantized
+    trees. (On an UNTRAINED target the truncation agrees near-chance;
+    real acceptance needs a trained/distilled stack — the int8
+    self-draft in tools/bench_speculative.py is the measurable-here
+    alternative.)"""
+    if not 1 <= n_layers <= cfg.n_layers:
+        raise ValueError(
+            f"draft layers {n_layers} not in [1, {cfg.n_layers}]")
+    dcfg = dataclasses.replace(cfg, n_layers=n_layers)
+
+    def cut(x):
+        if isinstance(x, QTensor):
+            return QTensor(x.q[:n_layers], x.s[:n_layers])
+        return x[:n_layers]
+
+    layers = jax.tree.map(cut, params["layers"],
+                          is_leaf=lambda x: isinstance(x, QTensor))
+    return dcfg, {**params, "layers": layers}
 
 
 _STEPWISE_CACHE: dict = {}
@@ -896,6 +1003,7 @@ def generate_stepwise(cfg: LlamaConfig, params: Params,
     per-step dispatch overhead is hidden at 400m+ anyway.
     """
     b, s = prompt.shape
+    _check_capacity(cfg, s, steps)
     cache = init_kv_cache(cfg, b, cfg.max_seq)
     prefill_x, step_x = _stepwise_executables(cfg, mesh)
     logits, cache = prefill_x(params, cache, prompt)
@@ -927,8 +1035,12 @@ def generate_chunked(cfg: LlamaConfig, params: Params,
     dispatches instead of 1 + steps. ``steps`` is rounded up to whole
     chunks internally and trimmed, so one executable serves every
     requested length.
+    (Chunk-rounding overshoot past ``steps`` is safe even at the capacity
+    boundary: overshoot writes clamp onto the last row strictly AFTER
+    every kept token was computed, and their outputs are trimmed.)
     """
     b, s = prompt.shape
+    _check_capacity(cfg, s, steps)
     cache = init_kv_cache(cfg, b, cfg.max_seq)
     if key is None:
         key = jax.random.key(0)

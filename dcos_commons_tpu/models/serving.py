@@ -17,6 +17,12 @@ vLLM-style slot serving, built the TPU way — every shape static:
 * Retirement is host-side bookkeeping (budget exhausted, EOS, or cache
   full); retired slots keep decoding garbage rows that nothing reads —
   the batch never reshapes, so nothing recompiles.
+* **Composes with tensor parallelism**: pass ``mesh`` and the cache
+  shards over the KV-head axis next to the megatron weight shards; the
+  per-slot decode runs the flash kernel per head shard
+  (``flash_decode_tp``'s per-slot ``kv_len`` path) and bucketed prefill
+  routes through the sharded flash prefill — continuous batching and a
+  tp-sharded model are one engine, not alternatives.
 
 The reference repo (a cluster scheduler) has no serving engine; this is
 workload-layer capability for BASELINE.json config #5, layered on
@@ -53,18 +59,35 @@ def _bucket(n: int, lo: int = 8) -> int:
     return b
 
 
-def _prefill_bucket(cfg, params, prompt, true_len, rope):
+def _prefill_bucket(cfg, params, prompt, true_len, rope, mesh=None):
     """[1, P] causal forward: (last-live-position logits [1, V],
     ks/vs [L, 1, P, KV, D]). P is the padded bucket; positions >=
     true_len are causally downstream of the live ones and harmless.
     Shares :func:`llama.prefill_trunk` with solo prefill (flash routing
-    for lane-aligned buckets included) — only the logits position and
-    the cache landing differ."""
-    x, ks, vs = llama.prefill_trunk(cfg, params, prompt, rope)
+    — including the tp shard_map kernel — for lane-aligned buckets) —
+    only the logits position and the cache landing differ."""
+    x, ks, vs = llama.prefill_trunk(cfg, params, prompt, rope, mesh)
     last = lax.dynamic_index_in_dim(x, true_len - 1, axis=1,
                                     keepdims=False)
     logits = qmm(last, params["lm_head"]).astype(jnp.float32)
     return logits, ks, vs
+
+
+def _shard_cache(cache, mesh):
+    """Place the slot KV cache for tensor-parallel serving: shard over
+    the KV-head axis (payload + scales) to sit next to the megatron
+    weight shards; the SLOT axis stays unsharded — every shard serves
+    every conversation, and attention is head-local."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    kvspec = NamedSharding(mesh, P(None, None, None, "tp", None))
+
+    def place(c):
+        if isinstance(c, QTensor):
+            return QTensor(jax.device_put(c.q, kvspec),
+                           jax.device_put(c.s, kvspec))
+        return jax.device_put(c, kvspec)
+
+    return {k: place(v) for k, v in cache.items()}
 
 
 def _scatter_slot(cache, new, slot):
@@ -91,14 +114,20 @@ class SlotServer:
 
     def __init__(self, cfg: llama.LlamaConfig, params, slots: int = 8,
                  sampler=None, key: Optional[jax.Array] = None,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None, mesh=None):
         self.cfg = cfg
         self.params = params
         self.slots = slots
         self.sampler = sampler
         self.eos_id = eos_id
+        self.mesh = mesh
         self.key = key if key is not None else jax.random.key(0)
         self.cache = llama.init_kv_cache(cfg, slots, cfg.max_seq)
+        if mesh is not None and mesh.size > 1:
+            # tensor-parallel serving: decode_step_slots runs the flash
+            # kernel per head shard with the per-slot kv_len vector and
+            # NO collectives until the out-projection
+            self.cache = _shard_cache(self.cache, mesh)
         self.lengths = jnp.zeros((slots,), jnp.int32)
         self.cur_tok = jnp.zeros((slots,), jnp.int32)
         self.requests: List[Optional[_Request]] = [None] * slots
@@ -106,9 +135,19 @@ class SlotServer:
         rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
         self._prefill_x: Dict[int, Any] = {}   # bucket -> executable
         self._rope = rope
+        # the cache is donated in BOTH jitted paths: it dominates HBM at
+        # real presets (~1 GB+ at 8B) and every step/scatter returns a
+        # same-shaped cache, so XLA aliases in-place instead of holding
+        # two copies live across the update
         self._step_x = jax.jit(
             lambda p, c, ln, tok: llama.decode_step_slots(
-                cfg, p, c, ln, tok, rope=rope))
+                cfg, p, c, ln, tok, mesh=mesh, rope=rope),
+            donate_argnums=(1,))
+        self._scatter_x = jax.jit(
+            lambda c, ks, vs, slot: {
+                "k": _scatter_slot(c["k"], ks, slot),
+                "v": _scatter_slot(c["v"], vs, slot)},
+            donate_argnums=(0,))
 
     # ------------------------------------------------------------ intake
 
@@ -142,15 +181,14 @@ class SlotServer:
         bucket = min(_bucket(n), self.cfg.max_seq)
         x = self._prefill_x.get(bucket)
         if x is None:
-            cfg, rope = self.cfg, self._rope
+            cfg, rope, mesh = self.cfg, self._rope, self.mesh
             x = jax.jit(lambda p, toks, tl: _prefill_bucket(
-                cfg, p, toks, tl, rope))
+                cfg, p, toks, tl, rope, mesh))
             self._prefill_x[bucket] = x
         padded = jnp.zeros((1, bucket), jnp.int32).at[0, :n].set(
             jnp.asarray(prompt, jnp.int32))
         logits, ks, vs = x(self.params, padded, jnp.int32(n))
-        self.cache = {"k": _scatter_slot(self.cache["k"], ks, slot),
-                      "v": _scatter_slot(self.cache["v"], vs, slot)}
+        self.cache = self._scatter_x(self.cache, ks, vs, jnp.int32(slot))
         tok = int(self._select(logits)[0])
         self.lengths = self.lengths.at[slot].set(n)
         self.cur_tok = self.cur_tok.at[slot].set(tok)
@@ -203,6 +241,21 @@ class SlotServer:
         if done:
             self.finished[r.request_id] = r.tokens
             self.requests[slot] = None
+
+    def reset(self) -> None:
+        """Rebuild device state after a failed dispatch: the jitted step
+        DONATES the cache, so an exception mid-step leaves ``self.cache``
+        pointing at an invalidated buffer — re-init it (and the slot
+        bookkeeping) rather than trying to serve through it. Weights are
+        non-donated inputs and survive."""
+        self.cache = llama.init_kv_cache(self.cfg, self.slots,
+                                         self.cfg.max_seq)
+        if self.mesh is not None and self.mesh.size > 1:
+            self.cache = _shard_cache(self.cache, self.mesh)
+        self.lengths = jnp.zeros((self.slots,), jnp.int32)
+        self.cur_tok = jnp.zeros((self.slots,), jnp.int32)
+        self.requests = [None] * self.slots
+        self.finished.clear()
 
     def abort_active(self) -> int:
         """Drop every in-flight request without recording results (a

@@ -1,5 +1,6 @@
-"""Greedy speculative decoding: a draft model proposes, the target
-verifies K tokens per weight pass.
+"""Speculative decoding: a draft model proposes, the target verifies K
+tokens per weight pass — greedy exact-match or sampled rejection
+acceptance.
 
 Decode at real model sizes is weight-streaming bound — every emitted
 token streams the full weight set. Speculative decoding breaks that
@@ -21,6 +22,22 @@ candidates sit beyond the live length, are never attended, and are
 overwritten when decoding reaches them. Rejection is just "don't
 advance the host-side position".
 
+**Sampled acceptance** (``temperature > 0``) is Leviathan-style
+rejection sampling: proposal ``x_i ~ q_i`` is accepted with probability
+``min(1, p_i(x_i) / q_i(x_i))``; the first rejection resamples from the
+residual ``normalize(max(p_i - q_i, 0))``, and a fully-accepted window
+earns a bonus token from ``p_K``. The emitted marginal is EXACTLY the
+target's (tempered) sampling distribution regardless of the draft — the
+classic speculative-sampling theorem; :func:`rejection_step` is the
+per-position primitive and is distribution-tested directly.
+
+**Drafts that exist without a trained checkpoint**: ``llama.
+truncate_layers`` (layer-skip self-speculation — near-chance acceptance
+on an untrained target, included for the mechanism) and the int8
+self-draft (same model, quantized weights: ~half the HBM bytes per
+draft step, near-1 acceptance — tools/bench_speculative.py measures the
+net tok/s).
+
 The reference repo (a cluster scheduler) ships no serving stack; this
 is workload-layer capability for BASELINE.json config #5 (the 8B
 flagship is the intended target model, with a 400m-class draft).
@@ -40,19 +57,52 @@ from dcos_commons_tpu.ops import rope_frequencies
 Params = llama.Params
 
 
+def _softmax(logits: np.ndarray) -> np.ndarray:
+    x = logits.astype(np.float64)
+    x = x - x.max(axis=-1, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def rejection_step(p: np.ndarray, q: np.ndarray, x: int,
+                   rng: np.random.Generator) -> tuple[int, bool]:
+    """One position of speculative rejection sampling.
+
+    ``p``/``q``: target/draft probability rows over the vocab; ``x``:
+    the draft's proposal (sampled from ``q``). Returns (token,
+    accepted). The emitted token's marginal distribution is exactly
+    ``p`` — accept w.p. min(1, p(x)/q(x)), else resample from the
+    residual normalize(max(p - q, 0)) (Leviathan et al.; the theorem
+    is distribution-tested in tests/test_speculative.py).
+    """
+    if rng.random() < min(1.0, float(p[x]) / max(float(q[x]), 1e-30)):
+        return int(x), True
+    resid = np.maximum(p - q, 0.0)
+    total = resid.sum()
+    probs = p if total <= 0.0 else resid / total
+    return int(rng.choice(len(probs), p=probs)), False
+
+
 class SpeculativeDecoder:
-    """Greedy speculative decoding for batch-1 serving (the latency
-    case K-token verification exists for)."""
+    """Speculative decoding for batch-1 serving (the latency case
+    K-token verification exists for). ``temperature == 0`` (default) is
+    greedy exact-match acceptance; ``temperature > 0`` is sampled
+    rejection acceptance over the tempered distributions."""
 
     def __init__(self, cfg_t: llama.LlamaConfig, params_t: Params,
-                 cfg_d: llama.LlamaConfig, params_d: Params, k: int = 4):
+                 cfg_d: llama.LlamaConfig, params_d: Params, k: int = 4,
+                 temperature: float = 0.0, seed: int = 0):
         if cfg_t.vocab_size != cfg_d.vocab_size:
             raise ValueError("draft and target must share a vocabulary")
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
         self.cfg_t, self.params_t = cfg_t, params_t
         self.cfg_d, self.params_d = cfg_d, params_d
         self.k = k
+        self.temperature = temperature
+        self._rng = np.random.default_rng(seed)
         rope_t = rope_frequencies(cfg_t.head_dim, cfg_t.max_seq,
                                   cfg_t.rope_theta)
         rope_d = rope_frequencies(cfg_d.head_dim, cfg_d.max_seq,
@@ -64,17 +114,35 @@ class SpeculativeDecoder:
         # a fully-accepted window leaves no K/V hole at pos+k-1 (the
         # k-th proposal itself is discarded — it exists to write d_{k-1}
         # into the cache). The verify window is [cur, d_1..d_{k-1}].
-        self._draft_x = jax.jit(lambda p, c, pos, tok: llama.decode_chunk(
-            self.cfg_d, p, c, pos, tok, self.k,
-            rope=rope_d)) if k > 1 else None
+        # Sampled mode: the draft SAMPLES proposals from its tempered
+        # distribution and returns the per-step logits (q_i for the
+        # rejection test); the extra [k, V] output is noise next to the
+        # weight streaming either path pays.
+        if k > 1 and temperature > 0.0:
+            from dcos_commons_tpu.ops.sampling import make_sampler
+            sampler = make_sampler(temperature)
+            self._draft_x = jax.jit(
+                lambda p, c, pos, tok, key: llama.decode_chunk_logits(
+                    self.cfg_d, p, c, pos, tok, self.k, rope=rope_d,
+                    sampler=sampler, key=key))
+        elif k > 1:
+            self._draft_x = jax.jit(
+                lambda p, c, pos, tok: llama.decode_chunk(
+                    self.cfg_d, p, c, pos, tok, self.k, rope=rope_d))
+        else:
+            self._draft_x = None
         self._verify_x = jax.jit(lambda p, c, toks, pos: llama.extend_step(
             self.cfg_t, p, c, toks, pos, rope=rope_t))
 
     def generate(self, prompt: jnp.ndarray, steps: int
                  ) -> Tuple[jnp.ndarray, Dict[str, float]]:
-        """Greedy-decode ``steps`` tokens; returns (tokens [1, steps],
-        stats). Emits exactly ``llama.generate_stepwise``'s stream for
-        the target model."""
+        """Decode ``steps`` tokens; returns (tokens [1, steps], stats).
+
+        Greedy mode emits exactly ``llama.generate_stepwise``'s stream
+        for the target model; sampled mode emits tokens whose marginal
+        is the target's tempered sampling distribution (the rejection
+        theorem) — acceptance only sets the speed, never the
+        distribution."""
         b, s = prompt.shape
         if b != 1:
             raise ValueError("speculative decoding is batch-1")
@@ -84,42 +152,83 @@ class SpeculativeDecoder:
                 f"prompt {s} + steps {steps} + k {self.k} exceeds "
                 f"max_seq (target {self.cfg_t.max_seq}, draft "
                 f"{self.cfg_d.max_seq})")
+        temp = self.temperature
         cache_t = llama.init_kv_cache(self.cfg_t, 1, self.cfg_t.max_seq)
         cache_d = llama.init_kv_cache(self.cfg_d, 1, self.cfg_d.max_seq)
         lt, cache_t = self._prefill_t(self.params_t, cache_t, prompt)
         _, cache_d = self._prefill_d(self.params_d, cache_d, prompt)
-        cur = int(jnp.argmax(lt, axis=-1)[0])
+        if temp > 0.0:
+            p0 = _softmax(np.asarray(lt[0], np.float32) / temp)
+            cur = int(self._rng.choice(len(p0), p=p0))
+        else:
+            cur = int(jnp.argmax(lt, axis=-1)[0])
         out = [cur]
         pos = s                       # next write position (holds `cur`)
-        passes = 0
+        passes = proposed = accepted = 0
+        key = jax.random.key(int(self._rng.integers(2 ** 31)))
         while len(out) < steps:
-            if self._draft_x is not None:
+            draft_logits = None
+            if self._draft_x is None:
+                draft_toks = []
+            elif temp > 0.0:
+                key, sub = jax.random.split(key)
+                dtoks, dlogits, cache_d = self._draft_x(
+                    self.params_d, cache_d, jnp.int32(pos),
+                    jnp.asarray([cur], jnp.int32), sub)
+                draft_toks = [int(t) for t in
+                              np.asarray(dtoks[0])][:self.k - 1]
+                draft_logits = np.asarray(dlogits[0],
+                                          np.float32)[:self.k - 1]
+            else:
                 draft, cache_d = self._draft_x(
                     self.params_d, cache_d, jnp.int32(pos),
                     jnp.asarray([cur], jnp.int32))
                 draft_toks = [int(t) for t in
                               np.asarray(draft[0])][:self.k - 1]
-            else:
-                draft_toks = []
             window = jnp.asarray([[cur] + draft_toks], jnp.int32)
             logits, cache_t = self._verify_x(self.params_t, cache_t,
                                              window, jnp.int32(pos))
-            target_toks = [int(t) for t in
-                           np.asarray(jnp.argmax(logits[0], axis=-1))]
             passes += 1
-            # accept drafted tokens while the target agrees; the token
-            # at the first disagreement is the target's own choice, so
-            # every pass emits at least one target-correct token
-            emitted = []
-            for i, t in enumerate(target_toks):
-                emitted.append(t)
-                if i >= len(draft_toks) or draft_toks[i] != t:
-                    break
+            proposed += len(draft_toks)
+            if temp > 0.0:
+                # rejection acceptance over the tempered distributions;
+                # replacement/bonus tokens land at the NEXT pass's write
+                # position as `cur`, so both caches stay consistent
+                p = _softmax(np.asarray(logits[0], np.float32) / temp)
+                emitted = []
+                for i, x in enumerate(draft_toks):
+                    q = _softmax(draft_logits[i] / temp)
+                    tok, ok = rejection_step(p[i], q, x, self._rng)
+                    emitted.append(tok)
+                    if not ok:
+                        break
+                    accepted += 1
+                else:
+                    # whole window accepted: bonus token from the
+                    # target's distribution after the last proposal
+                    emitted.append(int(self._rng.choice(
+                        p.shape[1], p=p[len(draft_toks)])))
+            else:
+                target_toks = [int(t) for t in
+                               np.asarray(jnp.argmax(logits[0], axis=-1))]
+                # accept drafted tokens while the target agrees; the
+                # token at the first disagreement is the target's own
+                # choice, so every pass emits at least one
+                # target-correct token
+                emitted = []
+                for i, t in enumerate(target_toks):
+                    emitted.append(t)
+                    if i >= len(draft_toks) or draft_toks[i] != t:
+                        break
+                accepted += len(emitted) - 1
             pos += len(emitted)
             cur = emitted[-1]
             out.extend(emitted)
         out = out[:steps]
         stats = {"verify_passes": passes,
                  "tokens_per_pass": round(len(out) / max(passes, 1), 3),
+                 "proposed": proposed, "accepted": accepted,
+                 "accept_rate": round(accepted / max(proposed, 1), 4),
+                 "temperature": temp,
                  "k": self.k}
         return jnp.asarray([out], jnp.int32), stats

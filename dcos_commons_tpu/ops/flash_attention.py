@@ -439,6 +439,45 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                   interpret)
 
 
+def flash_attention_tp(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                       mesh, *, axis: str = "tp", causal: bool = True,
+                       sm_scale: Optional[float] = None, q_offset: int = 0,
+                       block_q: int = 512, block_k: int = 512,
+                       interpret: bool = False) -> jnp.ndarray:
+    """:func:`flash_attention` under tensor parallelism (the prefill
+    mirror of ``ops.flash_decode.flash_decode_tp``).
+
+    Attention is head-local, so megatron-sharded prefill (heads split
+    over the ``tp`` mesh axis) runs the kernel independently per shard
+    on its local head group — ``shard_map`` with head-axis specs and NO
+    collectives. This is what removes the dense path's [B, H, S, S]
+    fp32 score transient from SHARDED long-context prefill (26 GB at
+    batch 8 x seq 4096 — the single-chip wall the flash kernel already
+    removed, commit 11f24f6). Requires the KV head count to divide
+    evenly across the axis.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape[axis]
+    kv_heads = k.shape[2]
+    if kv_heads % tp:
+        raise ValueError(
+            f"flash_attention_tp: {kv_heads} KV heads do not divide "
+            f"over {axis}={tp}")
+    hspec = P(None, None, axis, None)
+
+    def shard(q_l, k_l, v_l):
+        return flash_attention(q_l, k_l, v_l, causal=causal,
+                               sm_scale=sm_scale, q_offset=q_offset,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret)
+
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-
+    # axes annotation, and the body is collective-free by construction
+    return jax.shard_map(shard, mesh=mesh, in_specs=(hspec, hspec, hspec),
+                         out_specs=hspec, check_vma=False)(q, k, v)
+
+
 def supports(q: jnp.ndarray, k: jnp.ndarray, *, kv_len=None) -> bool:
     """Whether the flash path can serve this call (else dense fallback)."""
     s_q, s_k = q.shape[1], k.shape[1]

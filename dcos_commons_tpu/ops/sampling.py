@@ -66,12 +66,15 @@ class ConfiguredSampler:
     top_p: float
 
     def __call__(self, key: jax.Array, logits: Array) -> Array:
-        x = logits.astype(jnp.float32)
+        # temperature first: nucleus membership is conventionally decided
+        # on the TEMPERED distribution (HF/vLLM warper order). top-k is
+        # order-insensitive (scaling is monotonic), top-p is not.
+        x = logits.astype(jnp.float32) / self.temperature
         if self.top_k:
             x = top_k_mask(x, self.top_k)
         if 0.0 < self.top_p < 1.0:
             x = top_p_mask(x, self.top_p)
-        return jax.random.categorical(key, x / self.temperature, axis=-1)
+        return jax.random.categorical(key, x, axis=-1)
 
 
 def make_sampler(temperature: float = 0.0, top_k: int = 0,

@@ -1,0 +1,35 @@
+"""Readiness probe for serving pods: exit 0 iff the ingress accepts work.
+
+Run by the agent as the ``serving.yml`` readiness-check with the task's
+env (so ``PORT_SERVE`` is the matcher-reserved, endpoint-advertised
+port). Gates the deploy plan on the pod actually ACCEPTING REQUESTS —
+not on a heartbeat having happened (reference readiness semantics:
+``ReadinessCheckSpec`` passes only when the service serves).
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+
+def main() -> int:
+    port = os.environ.get("PORT_SERVE", "")
+    if not port:
+        print("probe: PORT_SERVE not set", file=sys.stderr)
+        return 1
+    url = f"http://127.0.0.1:{port}/v1/healthz"
+    try:
+        with urllib.request.urlopen(url, timeout=3) as r:
+            health = json.loads(r.read())
+    except Exception as e:                       # any probe failure = not ready
+        print(f"probe: {url}: {e}", file=sys.stderr)
+        return 1
+    if health.get("ok") is not True:
+        print(f"probe: not ready: {health}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

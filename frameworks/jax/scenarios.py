@@ -40,10 +40,14 @@ DEFAULT_ENV: Mapping[str, str] = {
     # "--quant int8 --kv-quant" for the 8b preset
     "SERVER_COUNT": "4",
     "SERVE_SLOTS": "8",
+    "SERVE_CHIPS": "1",
     "SERVE_FLAGS": "",
     # long-context scenario knobs (longctx.yml)
     "SEQ_LEN": "8192",
     "ATTN_IMPL": "ring",
+    # zigzag balances causal ring work (parallel/ring_attention.py);
+    # the default long-context seq (8192) divides any 2*sp it meets
+    "RING_LAYOUT": "zigzag",
     "SP": "0",
     "TP": "0",
     # fetched into every task sandbox pre-launch (reference: resource.json

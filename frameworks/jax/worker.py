@@ -142,17 +142,7 @@ def run_resnet(args) -> dict:
         # so a restored checkpoint (which adopts the template's sharding)
         # is mesh-replicated too, not pinned to one device.
         params = jax.device_put(params, NamedSharding(mesh, P()))
-        # Gang re-form resumes, not restarts. EVERY process checkpoints to
-        # its own volume (not just rank 0): params are identical across the
-        # dp gang, and per-host checkpoints keep resume step counts in sync
-        # — a rank-0-only checkpoint would desync the lock-step collective
-        # loop after a restart.
-        start_step = 0
-        resumed = latest_checkpoint(args.out, params) if args.out else None
-        if resumed:
-            params, start_step = resumed["params"], resumed["step"]
-            _emit({"event": "resumed", "step": start_step})
-        opt = train.make_optimizer(lr=0.1)
+        opt = train.make_optimizer(lr=getattr(args, "lr", 0.1) or 0.1)
         step_fn = train.make_train_step(
             lambda p, b: resnet.loss_fn(cfg, p, b[0], b[1]), opt,
             has_aux_state=True)
@@ -179,24 +169,74 @@ def run_resnet(args) -> dict:
             x = jax.device_put(x_local, sharding)
             y = jax.device_put(y_local, sharding)
 
-        # warmup/compile
+        # warmup/compile on the fresh init. Gang re-form resumes, not
+        # restarts: EVERY process checkpoints the FULL step state
+        # (params + opt momentum + batch-norm stats) to its own volume,
+        # and a resumed run restores OVER the warmup outputs — they carry
+        # the post-step shardings the loop will use, the warmup never
+        # advances restored state, and the continued loss stream is
+        # bitwise the one the dead gang would have produced (the gang
+        # e2e tier asserts exactly this).
+        from dcos_commons_tpu.parallel import checkpoint as ckpt
         params, opt_state, state, out = step_fn(params, opt_state,
                                                 (state, (x, y)))
         jax.block_until_ready(out["loss"])
-        steps_run = max(args.steps - start_step, 1)
+        start_step = 0
+        rstep = ckpt.latest_step(args.out) if args.out else None
+        if n_proc > 1 and args.out:
+            # agree on the resume step across the gang: a kill can land
+            # BETWEEN two ranks' saves at the same boundary, leaving one
+            # rank a checkpoint ahead — resuming local-latest would run
+            # different loop counts and deadlock the lock-step
+            # collectives. Every member resumes from the MIN step the
+            # whole gang holds (save pruning keeps several, so the
+            # agreed step is still on disk for the rank that ran ahead).
+            from jax.experimental import multihost_utils
+            steps_all = multihost_utils.process_allgather(
+                jnp.int32(rstep if rstep is not None else -1))
+            agreed = int(jnp.min(steps_all))
+            rstep = agreed if agreed >= 0 else None
+        if rstep is not None:
+            tree = ckpt.restore_sharded(
+                args.out, {"params": params, "opt_state": opt_state,
+                           "state": state}, rstep)
+            params, opt_state, state = (tree["params"], tree["opt_state"],
+                                        tree["state"])
+            start_step = rstep
+            _emit({"event": "resumed", "step": start_step})
+
+        def save_full(step):
+            ckpt.save_sharded(args.out, step,
+                              {"params": params, "opt_state": opt_state,
+                               "state": state})
+
+        steps_run = max(args.steps - start_step, 0)
         ckpt_every = max(1, args.steps // 4)
+        emit_every = getattr(args, "emit_every", 0)
         t0 = time.perf_counter()
         for step in range(start_step, args.steps):
             params, opt_state, state, out = step_fn(params, opt_state,
                                                     (state, (x, y)))
+            if emit_every and (step + 1) % emit_every == 0:
+                # a per-step loss stream for the gang e2e tier: the
+                # host sync it forces is why this is opt-in
+                _emit({"event": "progress", "step": step + 1,
+                       "loss": float(jax.block_until_ready(out["loss"]))})
             if args.out and (step + 1) % ckpt_every == 0:
-                save_checkpoint(args.out, step + 1, params)
-        loss = float(jax.block_until_ready(out["loss"]))
+                save_full(step + 1)
         dt = time.perf_counter() - t0
-
-    if args.out:
-        save_checkpoint(args.out, args.steps, params)
-    ips = x.shape[0] * steps_run / dt
+        if steps_run == 0:
+            # resumed at/past the target step (a relaunch after the job
+            # finished): nothing ran, and `out` is the discarded warmup
+            # of a fresh random init — report honestly instead of
+            # labeling that warmup loss as the converged model's
+            loss = None
+            ips = 0.0
+        else:
+            loss = float(jax.block_until_ready(out["loss"]))
+            ips = x.shape[0] * steps_run / dt
+            if args.out:
+                save_full(args.steps)
     return {"workload": "resnet", "depth": depth, "steps": steps_run,
             "final_loss": loss, "global_batch": global_batch,
             "images_per_sec_per_chip": round(ips / max(n, 1), 2),
@@ -286,50 +326,57 @@ def run_llama(args) -> dict:
         # and trigger a gang re-form loop. Transient decode failures are
         # reported, not fatal: only the scheduler's own health/recovery
         # machinery should decide to restart the shard.
-        # report the EFFECTIVE slot count: the engine is single-chip, so
-        # sharded meshes fall back to heartbeat decode and must not
-        # advertise continuous batching to monitoring
-        slot_engine = args.slots > 0 and mesh.size == 1
-        _emit({"event": "serving",
-               "slots": args.slots if slot_engine else 0, **result})
+        # the slot engine composes with tensor parallelism: a sharded
+        # mesh serves continuous batching through decode_step_slots
+        # under shard_map (models/serving.py), so --slots applies to a
+        # single-process tp mesh (one host's chips — the idiomatic TPU
+        # serving shape: tp within a host, replicas across hosts,
+        # serving.yml SERVE_CHIPS). Multi-PROCESS gangs keep heartbeat
+        # decode: per-process ingresses would feed divergent
+        # submit/step sequences into lock-step SPMD collectives; a
+        # rank-0 request broadcast is the missing piece, not shard_map.
+        slot_engine = args.slots > 0 and contract["num_processes"] == 1
         if slot_engine:
-            # continuous batching (models/serving.py): each heartbeat
-            # drains a burst of synthetic requests through the slot
-            # pool and reports aggregate throughput + slot stats
-            import numpy as _np
-
+            # continuous batching behind a REAL front door: the ingress
+            # (models/ingress.py) accepts client requests on the
+            # matcher-reserved PORT_SERVE (advertised via the scheduler's
+            # endpoints surface), feeds a bounded queue into the slot
+            # pool, and measures TTFT/TPOT per request. Heartbeats report
+            # the ingress stats instead of draining synthetic bursts.
+            from dcos_commons_tpu.models.ingress import ServingFrontend
             from dcos_commons_tpu.models.serving import SlotServer
-            server = SlotServer(cfg, params, slots=args.slots)
-            rng = _np.random.RandomState(0)
+            server = SlotServer(cfg, params, slots=args.slots,
+                                mesh=mesh if mesh.size > 1 else None)
+            port = args.serve_port
+            if port < 0:          # default: the reserved port, else any
+                port = int(os.environ.get("PORT_SERVE", "0"))
+            frontend = ServingFrontend(server, port=port,
+                                       max_queue=args.queue_limit)
+            frontend.start()
+            # re-stamp the readiness marker now that the ingress is
+            # actually listening (the yml readiness probe hits healthz)
+            with open("serving.ready", "w") as f:
+                f.write(f"ok {frontend.port}\n")
+            _emit({"event": "serving", "slots": args.slots,
+                   "port": frontend.port, **result})
             i = 0
             while True:
                 time.sleep(args.serve_interval)
                 i += 1
-                burst = [
-                    {"prompt": [int(t) for t in rng.randint(
-                        0, cfg.vocab_size, rng.randint(4, 17))],
-                     "max_new": 16, "request_id": (i, j)}
-                    for j in range(2 * args.slots)]
                 try:
-                    t0 = time.perf_counter()
-                    res = server.drain(burst)
-                    toks = sum(len(v) for v in res.values())
                     _emit({"event": "heartbeat", "n": i,
-                           "requests": len(burst), "tokens": toks,
-                           "tokens_per_sec": round(
-                               toks / (time.perf_counter() - t0), 2)})
+                           **frontend.stats()})
                 except Exception as e:
                     _emit({"event": "heartbeat_error", "n": i,
                            "error": str(e)})
-                finally:
-                    # a failed drain must not leak its results OR its
-                    # still-active slots into the next heartbeat's
-                    # token count — drop both
-                    server.finished.clear()
-                    server.abort_active()
         else:
-            # sharded meshes: fixed-prompt heartbeat decode (SlotServer
-            # is single-chip; tp shards heartbeat through generate_*)
+            # no slot engine (none requested, or --slots on a
+            # multi-process gang — ignored, see above): fixed-prompt
+            # heartbeat decode keeps the solo-serving liveness signal.
+            # slots: 0 tells monitoring NOT to expect continuous
+            # batching; slots_requested makes a silent degrade loud.
+            _emit({"event": "serving", "slots": 0,
+                   "slots_requested": args.slots, **result})
             i = 0
             while True:
                 time.sleep(args.serve_interval)
@@ -382,17 +429,25 @@ def run_llama_train(args) -> dict:
     seq = args.seq
     attn = args.attn if args.attn != "auto" else (
         "ring" if sp > 1 else "auto")
-    cfg = llama.LlamaConfig.tiny(attn_impl=attn, max_seq=seq + 1)
+    ring_layout = args.ring_layout
+    if ring_layout == "zigzag" and (attn != "ring" or seq % (2 * sp)):
+        # an incompatible layout must degrade, not crash-loop the gang
+        ring_layout = "contiguous"
+    cfg = llama.LlamaConfig.tiny(attn_impl=attn, max_seq=seq + 1,
+                                 ring_layout=ring_layout)
     with mesh:
         params = llama.shard_params(
             llama.init_params(cfg, jax.random.key(0)), mesh, cfg)
     toks = jax.random.randint(jax.random.key(1), (max(2 * dp, 1), seq + 1),
                               0, cfg.vocab_size)
+    mesh_report = {"dp": dp, "sp": sp, "tp": tp}
+    if attn == "ring":
+        mesh_report["ring_layout"] = ring_layout
     return _llama_train_loop(
         args, contract, cfg, mesh,
         lambda p, b: llama.loss_fn(cfg, p, b, mesh),
         llama.param_specs(cfg), params, toks,
-        {"dp": dp, "sp": sp, "tp": tp}, attn)
+        mesh_report, attn)
 
 
 def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
@@ -551,10 +606,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "heartbeat decode")
     p.add_argument("--serve", action="store_true",
                    help="llama: keep serving after warmup (RUNNING goal)")
+    p.add_argument("--serve-port", type=int, default=-1,
+                   help="llama --serve --slots: HTTP ingress port "
+                        "(default: the PORT_SERVE env the matcher "
+                        "reserved, else an ephemeral port; the bound "
+                        "port is in the serving event)")
+    p.add_argument("--queue-limit", type=int, default=64,
+                   help="llama --serve --slots: bounded ingress queue "
+                        "(overflow answers 503 + Retry-After)")
     p.add_argument("--serve-interval", type=float, default=30.0,
                    help="llama --serve: seconds between decode heartbeats")
     p.add_argument("--attn", default="auto",
                    choices=["auto", "dense", "flash", "ring", "ulysses"])
+    p.add_argument("--ring-layout", default="contiguous",
+                   choices=["contiguous", "zigzag"],
+                   help="llama-train --attn ring: zigzag balances causal "
+                        "work across the ring (each shard holds one "
+                        "early + one late chunk); needs seq %% (2*sp) "
+                        "== 0, else falls back to contiguous")
     p.add_argument("--seq", type=int, default=256,
                    help="llama-train: sequence length")
     p.add_argument("--sp", type=int, default=0,
@@ -565,6 +634,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="llama-train: pipeline-parallel stages (GPipe)")
     p.add_argument("--ep", type=int, default=0,
                    help="llama-train: expert-parallel mesh size (MoE)")
+    p.add_argument("--lr", type=float, default=0.0,
+                   help="resnet: learning-rate override (0 = default "
+                        "0.1; the gang e2e tier uses a small lr so the "
+                        "loss stream stays informative across the "
+                        "kill/resume boundary)")
+    p.add_argument("--emit-every", type=int, default=0,
+                   help="resnet: emit a {event: progress, step, loss} "
+                        "line every N steps (0 = off; forces a per-emit "
+                        "host sync, so leave off when benchmarking)")
     p.add_argument("--out", default="")
     p.add_argument("--ckpt-every", type=int, default=0,
                    help="llama-train: save a sharded checkpoint every N "
@@ -618,7 +696,10 @@ def main(argv=None) -> int:
                                    + f" --xla_dump_to={dump_dir}").strip()
     _emit({"event": "start", "workload": args.workload,
            "task": os.environ.get("TASK_NAME", "?"),
-           "pod_index": os.environ.get("POD_INSTANCE_INDEX", "0")})
+           "pod_index": os.environ.get("POD_INSTANCE_INDEX", "0"),
+           # the interpreter's own pid (the sh wrapper's is in task.pid):
+           # fault-injection tiers kill exactly the training process
+           "pid": os.getpid()})
     profile_dir = args.profile_dir or os.environ.get("TPU_PROFILE_DIR", "")
     if profile_dir:
         import jax

@@ -155,3 +155,60 @@ class TestModelIntegration:
                 causal=True)
         assert ref.shape == (1, 128, cfg_d.vocab_size)
         assert float(jnp.abs(out - dense).max()) < 2e-5
+
+
+class TestTensorParallel:
+    """flash_attention_tp: the prefill kernel per head shard under
+    shard_map (mirror of flash_decode_tp)."""
+
+    def test_tp_matches_unsharded(self):
+        from dcos_commons_tpu.ops.flash_attention import flash_attention_tp
+        from dcos_commons_tpu.parallel.mesh import MeshSpec
+        q = rand((2, 128, 8, 64), 1)
+        k = rand((2, 128, 4, 64), 2)
+        v = rand((2, 128, 4, 64), 3)
+        mesh = MeshSpec(tp=2).build(jax.devices()[:2])
+        with jax.default_matmul_precision("highest"):
+            ref = flash_attention(q, k, v, causal=True, interpret=True)
+            out = flash_attention_tp(q, k, v, mesh, causal=True,
+                                     interpret=True)
+        assert float(jnp.abs(ref - out).max()) < 2e-5
+
+    def test_tp_rejects_indivisible_heads(self):
+        from dcos_commons_tpu.ops.flash_attention import flash_attention_tp
+        from dcos_commons_tpu.parallel.mesh import MeshSpec
+        mesh = MeshSpec(tp=4).build(jax.devices()[:4])
+        try:
+            flash_attention_tp(rand((1, 128, 6, 64), 1),
+                               rand((1, 128, 3, 64), 2),
+                               rand((1, 128, 3, 64), 3), mesh)
+        except ValueError as e:
+            assert "KV heads" in str(e)
+        else:
+            raise AssertionError("indivisible heads were not rejected")
+
+    def test_llama_sharded_prefill_routes_flash(self):
+        """prefill_trunk on a tp mesh with flash_interpret: the sharded
+        flash path (no [B,H,S,S] transient) produces the dense path's
+        logits and cache."""
+        from dcos_commons_tpu.models import llama
+        from dcos_commons_tpu.parallel.mesh import MeshSpec
+        kw = dict(vocab_size=128, dim=256, n_layers=2, n_heads=2,
+                  n_kv_heads=2, ffn_dim=256, max_seq=128, remat=False,
+                  dtype=jnp.float32)
+        cfg_flash = llama.LlamaConfig(**kw, decode_attn="flash_interpret")
+        cfg_dense = llama.LlamaConfig(**kw, decode_attn="dense")
+        params = llama.init_params(cfg_dense, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(1), (1, 128), 0, 128)
+        mesh = MeshSpec(tp=2).build(jax.devices()[:2])
+        with mesh:
+            sharded = llama.shard_params(params, mesh, cfg_dense)
+        with jax.default_matmul_precision("highest"):
+            cache_f = llama.init_kv_cache(cfg_flash, 1, cfg_flash.max_seq)
+            cache_d = llama.init_kv_cache(cfg_dense, 1, cfg_dense.max_seq)
+            lf, cache_f = llama.prefill(cfg_flash, sharded, cache_f,
+                                        prompt, mesh)
+            ld, cache_d = llama.prefill(cfg_dense, params, cache_d, prompt)
+        assert float(jnp.abs(lf - ld).max()) < 1e-3, "sharded flash " \
+            "prefill logits diverge from unsharded dense"
+        assert float(jnp.abs(cache_f["k"] - cache_d["k"]).max()) < 1e-4

@@ -79,6 +79,24 @@ class TestScenariosDeploy:
         assert all(len(v) == 1 for v in by_slice.values())
         assert by_slice["0"] != by_slice["1"]
 
+    def test_serving_endpoint_advertised(self):
+        """serving.yml reserves a named `serve` port per replica; the
+        scheduler's endpoints surface (EndpointQueries -> tpuctl
+        endpoints serve) advertises every replica's host:port, and the
+        launch env carries PORT_SERVE for the worker to bind."""
+        runner = runner_for("serving", env={"SERVER_COUNT": "2"})
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        from dcos_commons_tpu.http.queries import EndpointQueries
+        eq = EndpointQueries(runner.scheduler)
+        assert "serve" in eq.list()
+        ep = eq.get("serve")
+        assert len(ep["address"]) == 2
+        assert all(":" in a for a in ep["address"])
+        for plan in runner.cluster.launch_log:
+            for launch in plan.launches:
+                port = int(launch.env["PORT_SERVE"])
+                assert port > 0
+
     def test_mnist_single_chip_no_gang(self):
         # configs[2]: one trainer, one chip, FINISH goal
         runner = runner_for("mnist")
@@ -214,8 +232,23 @@ class TestWorkerWorkloads:
                   for line in capsys.readouterr().out.splitlines()]
         done = [e for e in events if e.get("event") == "done"]
         assert done and done[0]["attn"] == "ring"
-        assert done[0]["mesh"] == {"dp": 2, "sp": 2, "tp": 2}
+        assert done[0]["mesh"] == {"dp": 2, "sp": 2, "tp": 2,
+                                   "ring_layout": "contiguous"}
         assert done[0]["tokens_per_sec"] > 0
+
+    def test_llama_train_ring_zigzag(self, tmp_path, capsys):
+        # the balanced causal layout end to end through the worker;
+        # seq 64 % (2*sp=4) == 0 so zigzag engages
+        rc = worker.main(["llama-train", "--steps", "1", "--seq", "64",
+                          "--attn", "ring", "--ring-layout", "zigzag",
+                          "--sp", "2", "--out", str(tmp_path / "ckpt")])
+        assert rc == 0
+        events = [json.loads(line)
+                  for line in capsys.readouterr().out.splitlines()]
+        done = [e for e in events if e.get("event") == "done"]
+        assert done and done[0]["mesh"]["ring_layout"] == "zigzag"
+        import math
+        assert math.isfinite(done[0]["final_loss"])
 
     def test_llama_shard_serves(self, tmp_path, capsys, monkeypatch):
         monkeypatch.chdir(tmp_path)
@@ -227,14 +260,17 @@ class TestWorkerWorkloads:
         done = [e for e in events if e.get("event") == "done"]
         assert done and done[0]["tokens_per_sec"] > 0
 
-    def test_llama_serving_slots_heartbeat(self, tmp_path):
-        """The serving.yml path: --serve --slots runs the continuous-
-        batching engine; heartbeats drain request bursts and report
-        slot-engine throughput. Driven as the real process the
-        scheduler would launch (the loop never exits on its own)."""
+    def test_llama_serving_http_front_door(self, tmp_path):
+        """The serving.yml path, traffic included: --serve --slots runs
+        the continuous-batching engine behind the HTTP ingress; a real
+        client POSTs a prompt to the advertised port and gets tokens +
+        latency timings back; heartbeats report the ingress stats; the
+        readiness probe (frameworks/jax/probe.py) passes. Driven as the
+        real process the scheduler would launch."""
         import subprocess
         import sys
         import time as _time
+        import urllib.request
 
         # single device: the conftest's 8-device XLA_FLAGS would leak in
         # and shard the mesh, which falls back to heartbeat decode
@@ -260,25 +296,59 @@ class TestWorkerWorkloads:
             # reader thread so the deadline is real: a blocked
             # readline() would otherwise hang the suite past it
             threading.Thread(target=pump, daemon=True).start()
+
+            def next_event(deadline):
+                while _time.time() < deadline:
+                    try:
+                        return json.loads(lines.get(timeout=min(
+                            5.0, max(deadline - _time.time(), 0.1))))
+                    except queue.Empty:
+                        continue
+                return None
+
             deadline = _time.time() + 120
-            serving = heartbeat = None
-            while _time.time() < deadline:
-                try:
-                    line = lines.get(timeout=min(
-                        5.0, max(deadline - _time.time(), 0.1)))
-                except queue.Empty:
-                    continue
-                e = json.loads(line)
+            serving = None
+            while (e := next_event(deadline)) is not None:
                 if e.get("event") == "serving":
                     serving = e
-                if e.get("event") == "heartbeat":
-                    heartbeat = e
                     break
             assert serving and serving["slots"] == 2, serving
-            assert heartbeat, "no heartbeat before deadline"
-            assert heartbeat["requests"] == 4      # 2 * slots per burst
-            assert heartbeat["tokens"] > 0
-            assert (tmp_path / "serving.ready").exists()
+            port = serving["port"]
+            assert port > 0
+            # the re-stamped readiness marker carries the bound port
+            assert (tmp_path / "serving.ready").read_text().split()[1] \
+                == str(port)
+
+            # the readiness probe the yml runs — against this very worker
+            probe = subprocess.run(
+                [sys.executable, "-m", "frameworks.jax.probe"],
+                env=dict(env, PORT_SERVE=str(port)),
+                capture_output=True, text=True)
+            assert probe.returncode == 0, probe.stderr
+
+            # real traffic through the front door
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate",
+                data=json.dumps({"prompt": [1, 2, 3, 4],
+                                 "max_new": 5}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                body = json.loads(r.read())
+            assert r.status == 200
+            assert len(body["tokens"]) == 5
+            assert body["ttft_ms"] > 0 and body["tpot_ms"] > 0
+
+            # heartbeats now carry the ingress stats
+            deadline = _time.time() + 60
+            heartbeat = None
+            while (e := next_event(deadline)) is not None:
+                if e.get("event") == "heartbeat" \
+                        and e.get("requests", 0) >= 1:
+                    heartbeat = e
+                    break
+            assert heartbeat, "no post-request heartbeat before deadline"
+            assert heartbeat["tokens"] >= 5
+            assert heartbeat["ttft_ms"]["p50"] > 0
         finally:
             proc.terminate()
             try:

@@ -97,6 +97,107 @@ class TestSequenceParallelAttention:
         ref = full_attention(q, k, v, causal=causal)
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
+    def test_ring_gqa_raw_kv(self, causal):
+        """The ring rotates RAW kv-head tensors (no pre-broadcast): GQA
+        k/v with KV < H match the dense repeat_kv reference."""
+        from dcos_commons_tpu.ops import repeat_kv
+        mesh = MeshSpec(sp=4, tp=2).build()
+        kv = 2
+        q = rand((self.B, self.S, self.H, self.D), 0)
+        k = rand((self.B, self.S, kv, self.D), 1)
+        v = rand((self.B, self.S, kv, self.D), 2)
+        out = make_ring_attention(mesh, causal=causal)(q, k, v)
+        ref = full_attention(q, repeat_kv(k, self.H // kv),
+                             repeat_kv(v, self.H // kv), causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_ring_zigzag_matches_dense(self, causal):
+        """Zigzag block order: permute the sequence with zigzag_indices,
+        run the balanced ring, unpermute — equals dense attention in
+        natural order (and GQA composes)."""
+        from dcos_commons_tpu.ops import repeat_kv
+        from dcos_commons_tpu.parallel.ring_attention import (
+            zigzag_indices, zigzag_inverse)
+        mesh = MeshSpec(sp=4).build(jax.devices()[:4])
+        kv = 4
+        q = rand((self.B, self.S, self.H, self.D), 3)
+        k = rand((self.B, self.S, kv, self.D), 4)
+        v = rand((self.B, self.S, kv, self.D), 5)
+        perm = zigzag_indices(self.S, 4)
+        inv = zigzag_inverse(self.S, 4)
+        ring = make_ring_attention(mesh, causal=causal, layout="zigzag")
+        out = ring(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+        ref = full_attention(q, repeat_kv(k, self.H // kv),
+                             repeat_kv(v, self.H // kv), causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+class TestRingGqaTpFallback:
+    def test_kv_heads_indivisible_by_tp_still_works(self):
+        """tp divides the query heads but not the kv heads (the
+        pre-round-5 working envelope): the llama ring path falls back
+        to rotating expanded heads instead of dying in shard_map."""
+        from dcos_commons_tpu.models import llama
+        cfg = llama.LlamaConfig.tiny(attn_impl="ring", n_heads=6,
+                                     n_kv_heads=3, max_seq=33,
+                                     dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 33), 0,
+                                  cfg.vocab_size)
+        mesh = MeshSpec(sp=2, tp=2, dp=2).build()
+        cfg_d = llama.LlamaConfig.tiny(attn_impl="dense", n_heads=6,
+                                       n_kv_heads=3, max_seq=33,
+                                       dtype=jnp.float32)
+        with jax.default_matmul_precision("highest"):
+            with mesh:
+                loss_r, _ = llama.loss_fn(cfg, params, toks, mesh)
+            loss_d, _ = llama.loss_fn(cfg_d, params, toks)
+        assert abs(float(loss_r) - float(loss_d)) < 1e-5
+
+
+class TestZigzagLayout:
+    def test_indices_roundtrip(self):
+        from dcos_commons_tpu.parallel.ring_attention import (
+            zigzag_indices, zigzag_inverse)
+        perm = zigzag_indices(32, 4)
+        inv = zigzag_inverse(32, 4)
+        assert sorted(perm.tolist()) == list(range(32))
+        np.testing.assert_array_equal(perm[inv], np.arange(32))
+        # shard r holds chunks (r, 2R-1-r): shard 0 of ring 4 = chunks
+        # 0 and 7 of the 8 four-wide chunks
+        assert perm[:8].tolist() == [0, 1, 2, 3, 28, 29, 30, 31]
+
+    def test_indices_reject_indivisible(self):
+        from dcos_commons_tpu.parallel.ring_attention import zigzag_indices
+        with pytest.raises(ValueError):
+            zigzag_indices(30, 4)
+
+    def test_llama_zigzag_loss_matches_contiguous(self):
+        """The training integration: loss_fn with ring_layout=zigzag
+        (tokens laid out + positions-aware rope, handled inside
+        loss_fn) equals the contiguous ring's loss and the dense
+        loss on the same tokens."""
+        from dcos_commons_tpu.models import llama
+        cfg_zig = llama.LlamaConfig.tiny(attn_impl="ring",
+                                         ring_layout="zigzag",
+                                         max_seq=33,
+                                         dtype=jnp.float32)
+        cfg_ring = llama.LlamaConfig.tiny(attn_impl="ring", max_seq=33,
+                                          dtype=jnp.float32)
+        cfg_dense = llama.LlamaConfig.tiny(attn_impl="dense", max_seq=33,
+                                           dtype=jnp.float32)
+        params = llama.init_params(cfg_dense, jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 33), 0,
+                                  cfg_dense.vocab_size)
+        mesh = MeshSpec(sp=4, dp=2).build()
+        with jax.default_matmul_precision("highest"):
+            with mesh:
+                loss_z, _ = llama.loss_fn(cfg_zig, params, toks, mesh)
+                loss_r, _ = llama.loss_fn(cfg_ring, params, toks, mesh)
+            loss_d, _ = llama.loss_fn(cfg_dense, params, toks)
+        assert abs(float(loss_z) - float(loss_d)) < 1e-5
+        assert abs(float(loss_r) - float(loss_d)) < 1e-5
+
 
 class TestPipeline:
     def test_matches_sequential(self):

@@ -92,6 +92,89 @@ def test_speculative_self_draft_accepts_everything():
     assert stats["tokens_per_pass"] >= 3.9, stats
 
 
+def test_rejection_step_preserves_target_distribution():
+    """The speculative-sampling theorem, tested on the very primitive
+    the decoder uses: propose x ~ q, accept/resample via
+    rejection_step — the emitted marginal must equal p, for a q that
+    is badly wrong about p."""
+    rng = np.random.default_rng(0)
+    v = 8
+    p = np.asarray([.35, .02, .13, .2, .05, .1, .05, .1])
+    q = np.asarray([.02, .4, .02, .1, .3, .06, .05, .05])
+    n = 40000
+    counts = np.zeros(v)
+    accepted = 0
+    for _ in range(n):
+        x = int(rng.choice(v, p=q))
+        tok, ok = speculative.rejection_step(p, q, x, rng)
+        counts[tok] += 1
+        accepted += ok
+    emp = counts / n
+    np.testing.assert_allclose(emp, p, atol=0.012)
+    # acceptance rate equals 1 - TV(p, q) in expectation
+    tv = 0.5 * np.abs(p - q).sum()
+    assert abs(accepted / n - (1 - tv)) < 0.02, (accepted / n, 1 - tv)
+
+
+def test_sampled_speculative_self_draft_accepts_everything():
+    """Draft == target: p == q so every proposal is accepted (ratio 1)
+    and every pass emits the full window + bonus."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0,
+                                cfg.vocab_size)
+    dec = speculative.SpeculativeDecoder(cfg, params, cfg, params, k=4,
+                                         temperature=1.0, seed=7)
+    got, stats = dec.generate(prompt, 16)
+    assert stats["accept_rate"] == 1.0, stats
+    assert stats["tokens_per_pass"] >= 3.9, stats
+    assert got.shape == (1, 16)
+    assert all(0 <= int(t) < cfg.vocab_size for t in got[0])
+
+
+def test_sampled_speculative_hostile_draft_still_emits_and_reports():
+    """A different-seed draft under sampling: low acceptance, valid
+    stream, reproducible for a fixed seed."""
+    cfg = _cfg()
+    target = llama.init_params(cfg, jax.random.key(0))
+    draft = llama.init_params(cfg, jax.random.key(42))
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0,
+                                cfg.vocab_size)
+    runs = []
+    for _ in range(2):
+        dec = speculative.SpeculativeDecoder(cfg, target, cfg, draft,
+                                             k=4, temperature=0.8,
+                                             seed=3)
+        got, stats = dec.generate(prompt, 12)
+        runs.append(([int(t) for t in got[0]], stats["accept_rate"]))
+    assert runs[0] == runs[1]                      # seed-deterministic
+    assert 0.0 <= runs[0][1] < 1.0
+    assert stats["proposed"] == stats["verify_passes"] * 3
+
+
+def test_truncated_draft_layer_skip():
+    """llama.truncate_layers: a 2-of-4-layer draft shares weights with
+    the target, halves the stacked tree, and the greedy stream stays
+    EXACTLY the target's (draft quality only sets acceptance)."""
+    cfg = llama.LlamaConfig.tiny(max_seq=96, attn_impl="dense")  # 4 layers
+    params = llama.init_params(cfg, jax.random.key(0))
+    dcfg, dparams = llama.truncate_layers(cfg, params, 2)
+    assert dcfg.n_layers == 2
+    assert dparams["layers"]["wq"].shape[0] == 2
+    np.testing.assert_array_equal(
+        np.asarray(dparams["layers"]["wq"][0], np.float32),
+        np.asarray(params["layers"]["wq"][0], np.float32))
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0,
+                                cfg.vocab_size)
+    want = _solo(cfg, params, prompt, 12)
+    dec = speculative.SpeculativeDecoder(cfg, params, dcfg, dparams, k=4)
+    got, stats = dec.generate(prompt, 12)
+    assert [int(t) for t in got[0]] == want, stats
+    assert 0.0 <= stats["accept_rate"] <= 1.0
+    with pytest.raises(ValueError, match="draft layers"):
+        llama.truncate_layers(cfg, params, 9)
+
+
 def test_speculative_guards():
     cfg = _cfg()
     small = llama.LlamaConfig.tiny(n_layers=2, max_seq=96,

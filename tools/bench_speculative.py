@@ -1,18 +1,29 @@
-"""Speculative-decoding economics on one chip: what a K-token verify
-pass costs vs K solo decode steps.
+"""Speculative-decoding economics on one chip: window cost AND measured
+end-to-end acceptance/speedup with drafts that exist without a trained
+checkpoint.
 
-Decode is weight-streaming bound, so ``llama.extend_step`` — K tokens
-through ONE forward — is the primitive speculative decoding banks on:
-if a K-window costs about one decode step, every accepted draft token
-is nearly free. This tool measures that ratio directly (it does not
-need a trained draft model, which a zero-egress image cannot have: the
-ratio is a property of the target alone; end-to-end speedup is
-``k_accepted_per_pass / window_cost_ratio``).
+Two modes:
 
-Prints one JSON line per window size. Usage::
+* **window sweep** (default): what a K-token verify pass
+  (``llama.extend_step``) costs vs K solo decode steps. Decode is
+  weight-streaming bound, so if a K-window costs about one decode step,
+  every accepted draft token is nearly free — the ratio is a property
+  of the target alone.
+* **--e2e**: run the whole ``SpeculativeDecoder`` loop and measure the
+  ACCEPTED-token rate and net tok/s against solo decode, with the two
+  checkpoint-free drafts: ``int8`` (the same model with int8 weights —
+  half the HBM bytes per draft step, near-1 acceptance: quantized
+  self-speculation) and ``truncate`` (the target's first N layers —
+  the layer-skip mechanism; NEAR-CHANCE acceptance on this image's
+  random-init weights, reported honestly as the untrained floor; a
+  trained/distilled stack is what makes it pay).
+
+Prints one JSON line per measurement. Usage::
 
     python -m tools.bench_speculative [--preset 400m] [--quant int8]
         [--windows 1,4,8,16] [--trials 5]
+    python -m tools.bench_speculative --e2e [--draft int8]
+        [--k 8] [--steps 128] [--temperature 0]
 """
 
 from __future__ import annotations
@@ -22,14 +33,104 @@ import json
 import time
 
 
+def _run_e2e(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama, speculative
+
+    preset = (llama.LlamaConfig.llama3_8b if args.preset == "8b"
+              else llama.LlamaConfig.llama_400m)
+    cfg = preset(max_seq=args.max_seq, attn_impl="dense", remat=False)
+    dev = jax.devices()[0]
+    if args.preset == "8b" or args.quant == "int8":
+        # target int8 (the 8b must be); draft falls back to truncate
+        params_t = llama.init_quantized_params(cfg, jax.random.key(0),
+                                               device=dev)
+        target_quant = True
+    else:
+        params_t = llama.init_params(cfg, jax.random.key(0))
+        target_quant = False
+    if args.draft == "int8":
+        if target_quant:
+            raise SystemExit("--draft int8 needs a bf16 target "
+                             "(--quant none, 400m preset)")
+        # quantized self-draft: identical weights, half the bytes
+        cfg_d, params_d = cfg, llama.quantize_params(params_t)
+        params_d = jax.device_put(params_d, dev)
+    else:
+        cfg_d, params_d = llama.truncate_layers(cfg, params_t,
+                                                args.draft_layers)
+
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0,
+                                cfg.vocab_size)
+    steps = args.steps
+
+    # solo baseline: the target's chunked decode (the serving default)
+    t0 = time.perf_counter()
+    llama.generate_chunked(cfg, params_t, prompt, steps,
+                           chunk=16).block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    llama.generate_chunked(cfg, params_t, prompt, steps,
+                           chunk=16).block_until_ready()
+    solo_s = time.perf_counter() - t0
+
+    dec = speculative.SpeculativeDecoder(
+        cfg, params_t, cfg_d, params_d, k=args.k,
+        temperature=args.temperature)
+    dec.generate(prompt, min(steps, 8))            # compile both sides
+    t0 = time.perf_counter()
+    toks, stats = dec.generate(prompt, steps)
+    spec_s = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "speculative_e2e",
+        "preset": args.preset,
+        "draft": (args.draft if args.draft == "int8"
+                  else f"truncate{args.draft_layers}"),
+        "k": args.k,
+        "steps": steps,
+        "temperature": args.temperature,
+        "accept_rate": stats["accept_rate"],
+        "tokens_per_pass": stats["tokens_per_pass"],
+        "verify_passes": stats["verify_passes"],
+        "solo_tokens_per_sec": round(steps / solo_s, 2),
+        "spec_tokens_per_sec": round(steps / spec_s, 2),
+        "net_speedup": round(solo_s / spec_s, 3),
+        "compile_s": round(compile_s, 1),
+        "backend": jax.devices()[0].platform,
+    }), flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--preset", default="400m", choices=["8b", "400m"])
-    p.add_argument("--quant", default="int8", choices=["none", "int8"])
+    p.add_argument("--quant", default=None, choices=["none", "int8"],
+                   help="target weights (default: int8 for the window "
+                        "sweep; none for --e2e --draft int8, which "
+                        "needs a bf16 target)")
     p.add_argument("--windows", default="1,4,8,16")
     p.add_argument("--trials", type=int, default=5)
     p.add_argument("--max-seq", type=int, default=2048)
+    p.add_argument("--e2e", action="store_true",
+                   help="measure the full SpeculativeDecoder loop "
+                        "(acceptance rate + net tok/s) instead of the "
+                        "window-cost sweep")
+    p.add_argument("--draft", default="int8",
+                   choices=["int8", "truncate"],
+                   help="--e2e draft: int8 self-draft (bf16 target) or "
+                        "a layer-truncation of the target")
+    p.add_argument("--draft-layers", type=int, default=2)
+    p.add_argument("--k", type=int, default=8)
+    p.add_argument("--steps", type=int, default=128)
+    p.add_argument("--temperature", type=float, default=0.0)
     args = p.parse_args(argv)
+    if args.quant is None:
+        args.quant = ("none" if args.e2e and args.draft == "int8"
+                      else "int8")
+    if args.e2e:
+        return _run_e2e(args)
     windows = [int(w) for w in args.windows.split(",")]
 
     import jax
