@@ -2,18 +2,37 @@
 
 Reference ``testing/sdk_diag.py``: after a failed integration test it
 collects per-test diagnostics (plan states, pod statuses, scheduler logs,
-task sandboxes) into a bundle directory for postmortem. Here the scheduler's
-debug surface is HTTP, so a bundle is a directory of JSON snapshots of every
-read-only endpoint.
+task sandboxes) into a bundle directory for postmortem. Three capture
+surfaces here:
+
+* **HTTP** (:func:`capture_diagnostics`) — a live ApiServer's read-only
+  endpoints, JSON per route (live-cluster tier).
+* **In-process** (:func:`capture_scheduler`) — the same state through
+  the query layer directly, no server needed (the simulation tier:
+  every ``ServiceTestRunner`` scheduler can be dumped post-mortem).
+* **Sandboxes** (:func:`capture_sandboxes`) — bounded tails of every
+  task sandbox file under the given agent roots (stdout/stderr logs,
+  pid files, rendered configs) — the reference's per-task log fetch.
+
+Per-test wiring (the ``conftest.py`` hook): harnesses/tests REGISTER
+their live scheduler / API url / sandbox roots as they build them
+(:func:`register_scheduler` / :func:`register_http` — the current test
+id is read from ``PYTEST_CURRENT_TEST``); on a test failure the hook
+calls :func:`collect_registered` and a per-test bundle directory
+appears under ``TPU_DIAG_DIR`` (default ``diag_bundles/``).
+``ServiceTestRunner`` registers itself, so every simulation test gets
+failure bundles for free.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import time
 import urllib.error
 import urllib.request
+from pathlib import Path
 from typing import Optional
 
 # every read-only surface worth snapshotting, service-relative
@@ -77,3 +96,149 @@ def capture_diagnostics(base_url: str, out_dir: str,
         for plan in plans:
             save(f"plan_{plan}", _fetch(f"{prefix}/plans/{plan}"))
     return bundle
+
+
+# ------------------------------------------------------------- in-process
+
+def scheduler_snapshot(scheduler) -> dict:
+    """Dump a live (in-process) scheduler through the query layer — the
+    same shapes the HTTP surface serves, without a server. Individual
+    query failures are recorded in place, never raised."""
+    from ..http import queries as q
+
+    out: dict = {}
+
+    def grab(name, fn):
+        try:
+            val = fn()
+            # query-layer tuples are (http_code, body)
+            out[name] = val[1] if isinstance(val, tuple) else val
+        except Exception as e:  # noqa: BLE001 — keep collecting
+            out[name] = {"_error": repr(e)}
+
+    pq = q.PlanQueries(scheduler)
+    grab("plans", pq.list)
+    for plan in (out.get("plans") or []):
+        grab(f"plan_{plan}", lambda p=plan: pq.get(p))
+    grab("pod_status", q.PodQueries(scheduler).status_all)
+    eq = q.EndpointQueries(scheduler)
+    grab("endpoints", lambda: {n: eq.get(n) for n in eq.list()})
+    dq = q.DebugQueries(scheduler)
+    grab("debug_offers", dq.offers)
+    grab("debug_plans", dq.plans)
+    grab("debug_taskStatuses", dq.task_statuses)
+    grab("debug_reservations", dq.reservations)
+    grab("health", q.HealthQueries(scheduler).health)
+    grab("configurations", q.ConfigQueries(scheduler).list)
+    return out
+
+
+def capture_scheduler(scheduler, out_dir: str,
+                      label: Optional[str] = None) -> str:
+    """In-process bundle: one JSON file per query-layer surface."""
+    stamp = label or time.strftime("%Y%m%d-%H%M%S")
+    bundle = os.path.join(out_dir, f"diag-{stamp}")
+    os.makedirs(bundle, exist_ok=True)
+    for name, payload in scheduler_snapshot(scheduler).items():
+        with open(os.path.join(bundle, f"{name}.json"), "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True, default=str)
+    return bundle
+
+
+def capture_sandboxes(roots, bundle: str, tail_bytes: int = 65536) -> int:
+    """Copy a bounded tail of every file in every task sandbox under
+    ``roots`` into ``<bundle>/sandboxes/...``; returns files captured.
+    Covers the real-agent tiers (test_native / test_gang_e2e): stdout &
+    stderr logs, pid files, rendered templates — what the reference's
+    per-test task-log fetch collects."""
+    captured = 0
+    for root in roots:
+        root = Path(root)
+        if not root.is_dir():
+            continue
+        for f in sorted(root.rglob("*")):
+            if not f.is_file():
+                continue
+            rel = Path(root.name) / f.relative_to(root)
+            dst = Path(bundle) / "sandboxes" / rel
+            try:
+                # seek-based tail: a multi-GB task log must not be read
+                # whole just to keep its last 64 KB
+                with open(f, "rb") as src:
+                    src.seek(0, os.SEEK_END)
+                    src.seek(max(src.tell() - tail_bytes, 0))
+                    data = src.read(tail_bytes)
+                dst.parent.mkdir(parents=True, exist_ok=True)
+                dst.write_bytes(data)
+                captured += 1
+            except OSError:
+                continue
+    return captured
+
+
+# ----------------------------------------------------------- test wiring
+
+_REGISTRY: dict = {}   # test id -> list of collector dicts
+
+
+def _current_test() -> Optional[str]:
+    """The running test's id, from pytest's own env breadcrumb."""
+    cur = os.environ.get("PYTEST_CURRENT_TEST", "")
+    return cur.split(" ")[0] or None
+
+
+def register_scheduler(scheduler, sandbox_roots=()) -> None:
+    """Register an in-process scheduler for failure capture in the
+    current test (no-op outside pytest)."""
+    test = _current_test()
+    if test:
+        _REGISTRY.setdefault(test, []).append(
+            {"scheduler": scheduler, "roots": tuple(sandbox_roots)})
+
+
+def register_http(base_url: str, service: Optional[str] = None,
+                  sandbox_roots=()) -> None:
+    """Register a live API server url for failure capture in the
+    current test (no-op outside pytest)."""
+    test = _current_test()
+    if test:
+        _REGISTRY.setdefault(test, []).append(
+            {"url": base_url, "service": service,
+             "roots": tuple(sandbox_roots)})
+
+
+def collect_registered(test_id: str, out_root: Optional[str] = None
+                       ) -> Optional[str]:
+    """Collect every surface registered for ``test_id`` into one bundle
+    dir; returns its path, or None when nothing was registered."""
+    entries = _REGISTRY.get(test_id)
+    if not entries:
+        return None
+    out_root = out_root or os.environ.get("TPU_DIAG_DIR", "diag_bundles")
+    safe = re.sub(r"[^A-Za-z0-9_.-]+", "_", test_id)[-120:]
+    bundle = os.path.join(out_root, safe)
+    os.makedirs(bundle, exist_ok=True)
+    for i, entry in enumerate(entries):
+        sub = os.path.join(bundle, f"surface-{i}")
+        try:
+            if "scheduler" in entry:
+                capture_scheduler(entry["scheduler"], sub, label="state")
+            else:
+                capture_diagnostics(entry["url"], sub,
+                                    service=entry.get("service"),
+                                    label="state")
+            if entry.get("roots"):
+                capture_sandboxes(entry["roots"],
+                                  os.path.join(sub, "diag-state"))
+        except Exception as e:  # noqa: BLE001 — diag must not mask the test
+            try:
+                os.makedirs(sub, exist_ok=True)
+                with open(os.path.join(sub, "_diag_error.txt"), "w") as f:
+                    f.write(repr(e))
+            except OSError:
+                pass
+    return bundle
+
+
+def clear_registered(test_id: str) -> None:
+    _REGISTRY.pop(test_id, None)

@@ -103,6 +103,11 @@ class ServiceTestRunner:
                                           self.cluster, **scheduler_kwargs)
         # Expect.launched_tasks consumes the launch log incrementally
         self._launch_cursor = 0
+        # failure diagnostics for free: under pytest, a failing test
+        # that used this runner gets a state bundle (testing/diag.py +
+        # the conftest hook — reference conftest + sdk_diag)
+        from dcos_commons_tpu.testing import diag
+        diag.register_scheduler(self.scheduler)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -117,6 +122,8 @@ class ServiceTestRunner:
         kwargs = {**self.scheduler_kwargs, **scheduler_kwargs}
         self.scheduler = ServiceScheduler(self.spec, self.persister,
                                           self.cluster, **kwargs)
+        from dcos_commons_tpu.testing import diag
+        diag.register_scheduler(self.scheduler)
 
     def new_launches(self) -> List[str]:
         """Instance names launched since the last call (consuming read)."""

@@ -23,3 +23,30 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "soak: opt-in churn tier (TPU_SOAK=1; reference tier-4 soak marks)")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Failure diagnostics bundles (reference conftest + sdk_diag): any
+    test that registered a scheduler / API url / sandbox roots with
+    ``dcos_commons_tpu.testing.diag`` (ServiceTestRunner does so
+    automatically) gets its state dumped into a per-test bundle under
+    TPU_DIAG_DIR (default diag_bundles/) when it fails."""
+    outcome = yield
+    rep = outcome.get_result()
+    from dcos_commons_tpu.testing import diag
+    if rep.when == "call" and rep.failed:
+        try:
+            bundle = diag.collect_registered(item.nodeid)
+        except Exception as e:  # noqa: BLE001 — diag must not mask failures
+            bundle = None
+            rep.sections.append(("diagnostics", f"bundle capture failed: "
+                                                f"{e!r}"))
+        if bundle:
+            rep.sections.append(
+                ("diagnostics", f"state bundle written to {bundle}"))
+    if rep.when == "teardown":
+        diag.clear_registered(item.nodeid)

@@ -343,3 +343,64 @@ pods:
             assert _json.load(f)["status"] == "COMPLETE"
         with open(_os.path.join(bundle, "root_agents_info.json")) as f:
             assert len(_json.load(f)) == 4
+
+    def test_capture_scheduler_in_process(self, tmp_path):
+        """The simulation-tier bundle: no HTTP server, same surfaces
+        through the query layer."""
+        from dcos_commons_tpu.testing import ( Expect, Send,
+                                              ServiceTestRunner)
+        from dcos_commons_tpu.testing import diag
+        yml = self.ZONED_YML.replace(
+            "placement: '[[\"zone\", \"GROUP_BY\", \"2\"]]'", "")
+        runner = ServiceTestRunner(yml)
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        bundle = diag.capture_scheduler(runner.scheduler, str(tmp_path),
+                                        label="sim")
+        import json as _json
+        import os as _os
+        files = set(_os.listdir(bundle))
+        assert {"plans.json", "plan_deploy.json", "pod_status.json",
+                "debug_taskStatuses.json", "debug_reservations.json",
+                "health.json"} <= files
+        with open(_os.path.join(bundle, "plan_deploy.json")) as f:
+            assert _json.load(f)["status"] == "COMPLETE"
+        with open(_os.path.join(bundle, "debug_taskStatuses.json")) as f:
+            statuses = _json.load(f)["taskStatuses"]
+        assert {s["name"] for s in statuses} == {"web-0-server",
+                                                 "web-1-server"}
+
+    def test_capture_sandboxes_tails_files(self, tmp_path):
+        from dcos_commons_tpu.testing import diag
+        root = tmp_path / "agent0"
+        sb = root / "web-0-server__abc"
+        sb.mkdir(parents=True)
+        (sb / "stdout.log").write_text("x" * 100000)
+        (sb / "task.pid").write_text("123\n")
+        bundle = tmp_path / "bundle"
+        n = diag.capture_sandboxes([str(root)], str(bundle),
+                                   tail_bytes=1024)
+        assert n == 2
+        out = bundle / "sandboxes" / "agent0" / "web-0-server__abc"
+        assert (out / "task.pid").read_text() == "123\n"
+        assert len((out / "stdout.log").read_text()) == 1024
+
+    def test_failure_registry_collects_registered_surfaces(
+            self, tmp_path, monkeypatch):
+        """register -> collect_registered produces a per-test bundle
+        (the conftest hook calls exactly this on failure)."""
+        from dcos_commons_tpu.testing import (Expect, Send,
+                                              ServiceTestRunner)
+        from dcos_commons_tpu.testing import diag
+        monkeypatch.setenv("TPU_DIAG_DIR", str(tmp_path / "bundles"))
+        yml = self.ZONED_YML.replace(
+            "placement: '[[\"zone\", \"GROUP_BY\", \"2\"]]'", "")
+        runner = ServiceTestRunner(yml)              # self-registers
+        runner.run([Send.until_quiet(), Expect.deployed()])
+        import os as _os
+        test_id = _os.environ["PYTEST_CURRENT_TEST"].split(" ")[0]
+        bundle = diag.collect_registered(test_id)
+        assert bundle and _os.path.isdir(bundle)
+        surface = _os.path.join(bundle, "surface-0", "diag-state")
+        assert "plan_deploy.json" in _os.listdir(surface)
+        diag.clear_registered(test_id)
+        assert diag.collect_registered(test_id) is None
