@@ -91,13 +91,7 @@ class _Pending:
         return out
 
 
-def _percentiles(values: List[float]) -> Dict[str, float]:
-    if not values:
-        return {}
-    xs = sorted(values)
-    def pick(q: float) -> float:
-        return round(xs[min(len(xs) - 1, int(q * len(xs)))], 3)
-    return {"p50": pick(0.50), "p95": pick(0.95), "p99": pick(0.99)}
+from dcos_commons_tpu.utils.stats import percentiles as _percentiles
 
 
 class ServingFrontend:

@@ -1,9 +1,34 @@
-"""Expert parallelism: GShard-style top-2 MoE with all-to-all dispatch.
+"""Expert parallelism: MoE with all-to-all dispatch over the ``ep`` axis.
 
 Experts are sharded over the ``ep`` mesh axis. Token->expert routing is
 expressed as dense one-hot dispatch/combine einsums (capacity-bounded), so
 the whole layer is three large MXU-friendly contractions plus two
 ``lax.all_to_all`` collectives — no gather/scatter, no dynamic shapes.
+
+Two routers:
+
+* ``top2`` — GShard token-choice: each token picks its two best experts;
+  tokens overflowing an expert's capacity are DROPPED (residual
+  passthrough), and a Switch-style auxiliary loss fights the imbalance
+  that causes the drops.
+* ``expert_choice`` — Zhou et al. 2022: each EXPERT picks its top-C
+  tokens by affinity. Perfectly load-balanced by construction (every
+  expert processes exactly C tokens, so the expert matmuls are always
+  full), no token is ever dropped by a *popular* expert (a token may be
+  picked by several experts or none — none = residual passthrough), and
+  no auxiliary loss is needed. The dispatch/combine tensors keep the
+  same [G, E, C] shapes, so the all-to-all pattern and expert einsums
+  are IDENTICAL to top2.
+
+  **Causality caveat**: expert choice ranks token t against the WHOLE
+  group — including future positions — so for a strictly-causal LM
+  objective it leaks future context into token t's routing, and the
+  selection cannot be reproduced one-token-at-a-time at decode. That is
+  the published trade-off of the method (its home turf is
+  encoder/masked/prefix objectives and routed-layer throughput); for
+  causal-LM training where decode-time routing parity matters, use
+  ``top2``. The worker exposes it behind an explicit ``--moe-routing``
+  opt-in with this caveat in the help text.
 
 Inner (manual-collective) body + self-contained test wrapper, mirroring
 ``pipeline.py``.
@@ -25,6 +50,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 class MoEConfig:
     num_experts: int
     capacity_factor: float = 2.0  # tokens-per-expert = G/E * factor
+    routing: str = "top2"         # top2 | expert_choice
 
     def capacity(self, num_tokens: int) -> int:
         return max(1, math.ceil(num_tokens * self.capacity_factor
@@ -68,6 +94,22 @@ def top2_dispatch(gates: jnp.ndarray, capacity: int
     return combine, dispatch
 
 
+def expert_choice_dispatch(gates: jnp.ndarray, capacity: int
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-choice routing: expert ``e`` selects its ``capacity``
+    highest-affinity tokens. Returns (combine [G, E, C], dispatch
+    [G, E, C] bool) — same shapes/contract as :func:`top2_dispatch`,
+    but every expert's buffer is exactly full and no load-balance loss
+    is required."""
+    g, e = gates.shape
+    capacity = min(capacity, g)
+    vals, idx = lax.top_k(gates.T, capacity)            # [E, C]
+    oh = jax.nn.one_hot(idx, g, dtype=gates.dtype)      # [E, C, G]
+    dispatch = oh.transpose(2, 0, 1) > 0                # [G, E, C]
+    combine = (oh * vals[..., None]).transpose(2, 0, 1)
+    return combine, dispatch
+
+
 def aux_load_balance_loss(gates: jnp.ndarray) -> jnp.ndarray:
     """Switch-transformer load-balance auxiliary loss (mean_e f_e * p_e * E)."""
     e = gates.shape[-1]
@@ -92,7 +134,12 @@ def moe_apply(x: jnp.ndarray, router_w: jnp.ndarray, w_in: jnp.ndarray,
     gates = jax.nn.softmax(
         jnp.einsum("gd,de->ge", x.astype(jnp.float32),
                    router_w.astype(jnp.float32)), axis=-1)
-    combine, dispatch = top2_dispatch(gates, cap)
+    if cfg.routing == "expert_choice":
+        combine, dispatch = expert_choice_dispatch(gates, cap)
+    elif cfg.routing == "top2":
+        combine, dispatch = top2_dispatch(gates, cap)
+    else:
+        raise ValueError(f"unknown MoE routing {cfg.routing!r}")
     expert_in = jnp.einsum("gec,gd->ecd", dispatch.astype(x.dtype), x)
     # reshard: all experts x my tokens -> my experts x all tokens
     expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
@@ -102,7 +149,11 @@ def moe_apply(x: jnp.ndarray, router_w: jnp.ndarray, w_in: jnp.ndarray,
     expert_out = lax.all_to_all(expert_out, axis_name, split_axis=1,
                                 concat_axis=0, tiled=True)  # [E, C, D]
     out = jnp.einsum("gec,ecd->gd", combine.astype(x.dtype), expert_out)
-    return out, aux_load_balance_loss(gates).astype(x.dtype)
+    # expert-choice is balanced by construction: a load-balance penalty
+    # would fight the router for nothing, so the aux term is zero
+    aux = (jnp.zeros((), x.dtype) if cfg.routing == "expert_choice"
+           else aux_load_balance_loss(gates).astype(x.dtype))
+    return out, aux
 
 
 def make_moe(mesh: Mesh, cfg: MoEConfig, *, x_spec=P(), expert_spec=P("ep")):

@@ -1,0 +1,33 @@
+"""Tiny shared measurement statistics (median, percentile picks).
+
+One implementation for the serving ingress's latency windows and every
+bench tool — three hand-rolled copies of sort-and-pick-middle is how
+receipts drift."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+
+def median(values: Sequence[float]) -> float:
+    xs = sorted(values)
+    n = len(xs)
+    if not n:
+        raise ValueError("median of empty sequence")
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def percentiles(values: Sequence[float],
+                qs: Sequence[float] = (0.50, 0.95, 0.99),
+                ndigits: int = 3) -> Dict[str, float]:
+    """{"p50": ..., "p95": ..., ...} over ``values`` (empty -> {}).
+    Upper-index pick: pessimistic on small samples, which is the right
+    bias for latency reporting."""
+    if not values:
+        return {}
+    xs: List[float] = sorted(values)
+
+    def pick(q: float) -> float:
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))], ndigits)
+
+    return {f"p{int(q * 100)}": pick(q) for q in qs}

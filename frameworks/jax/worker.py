@@ -551,9 +551,12 @@ def _llama_train_pipelined(args, contract, n, divisor_at_most) -> dict:
 
 
 def _llama_train_moe(args, contract, n, divisor_at_most) -> dict:
-    """Expert-parallel LM training: FFNs replaced by a GShard top-2 expert
+    """Expert-parallel LM training: FFNs replaced by a routed expert
     bank sharded over the ep mesh axis with all-to-all dispatch
-    (SURVEY.md §2.4 EP)."""
+    (SURVEY.md §2.4 EP). Routing per ``--moe-routing``: GShard top-2
+    (causal-LM default) or expert-choice (balanced/dropless, with the
+    non-causality caveat documented in parallel/moe.py); the mesh
+    report carries the routing used."""
     import jax
 
     from dcos_commons_tpu.models import llama
@@ -566,7 +569,8 @@ def _llama_train_moe(args, contract, n, divisor_at_most) -> dict:
     # expert count must be a multiple of ep or shard_map rejects the bank
     num_experts = ep * max(1, -(-4 // ep))
     cfg = llama.LlamaConfig.tiny(attn_impl="dense", max_seq=seq + 1)
-    moe_cfg = MoEConfig(num_experts=num_experts)
+    moe_cfg = MoEConfig(num_experts=num_experts,
+                        routing=args.moe_routing)
     params = llama.init_moe_params(cfg, num_experts, jax.random.key(0))
     toks = jax.random.randint(jax.random.key(1), (4, seq + 1),
                               0, cfg.vocab_size)
@@ -574,7 +578,8 @@ def _llama_train_moe(args, contract, n, divisor_at_most) -> dict:
         args, contract, cfg, mesh,
         lambda p, b: llama.loss_fn_moe(cfg, p, b, mesh, moe_cfg),
         llama.moe_param_specs(cfg), params, toks,
-        {"dp": n // ep, "ep": ep, "experts": num_experts}, "dense")
+        {"dp": n // ep, "ep": ep, "experts": num_experts,
+         "routing": args.moe_routing}, "dense")
 
 
 WORKLOADS = {"mnist": run_mnist, "resnet": run_resnet, "llama": run_llama,
@@ -634,6 +639,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="llama-train: pipeline-parallel stages (GPipe)")
     p.add_argument("--ep", type=int, default=0,
                    help="llama-train: expert-parallel mesh size (MoE)")
+    p.add_argument("--moe-routing", default="top2",
+                   choices=["top2", "expert_choice"],
+                   help="llama-train --ep: token-choice top-2 (GShard, "
+                        "capacity drops + aux loss; the causal-LM "
+                        "default) or expert-choice (dropless, balanced "
+                        "by construction — but ranks tokens against "
+                        "FUTURE positions, so it is non-causal for "
+                        "strict next-token training; see "
+                        "parallel/moe.py)")
     p.add_argument("--lr", type=float, default=0.0,
                    help="resnet: learning-rate override (0 = default "
                         "0.1; the gang e2e tier uses a small lr so the "

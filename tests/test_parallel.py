@@ -132,6 +132,62 @@ class TestSequenceParallelAttention:
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
 
+class TestExpertChoiceRouting:
+    def test_dispatch_each_expert_exactly_full(self):
+        from dcos_commons_tpu.parallel.moe import expert_choice_dispatch
+        gates = jax.nn.softmax(rand((16, 4), 0), axis=-1)
+        combine, dispatch = expert_choice_dispatch(gates, 6)
+        # every expert picks exactly its capacity of tokens
+        np.testing.assert_array_equal(
+            np.asarray(dispatch.sum(axis=(0, 2))), np.full(4, 6))
+        # combine weight of a chosen (token, expert) is its gate value
+        d = np.asarray(dispatch)
+        c = np.asarray(combine)
+        g = np.asarray(gates)
+        for tok in range(16):
+            for e in range(4):
+                got = c[tok, e].sum()
+                want = g[tok, e] if d[tok, e].any() else 0.0
+                assert abs(got - want) < 1e-6, (tok, e, got, want)
+
+    def test_moe_expert_choice_matches_reference(self):
+        """shard_map expert-choice layer == direct per-expert compute."""
+        from dcos_commons_tpu.parallel.moe import MoEConfig, make_moe
+        mesh = MeshSpec(ep=4, dp=2).build()
+        cfg = MoEConfig(num_experts=4, capacity_factor=2.0,
+                        routing="expert_choice")
+        g, d, f = 16, 8, 16
+        x = rand((g, d), 1) * 0.5
+        router = rand((d, 4), 2) * 0.5
+        w_in = rand((4, d, f), 3) * 0.3
+        w_out = rand((4, f, d), 4) * 0.3
+        out, aux = make_moe(mesh, cfg)(x, router, w_in, w_out)
+        assert float(aux) == 0.0            # balanced by construction
+        gates = np.asarray(jax.nn.softmax(x @ router, axis=-1))
+        cap = cfg.capacity(g)
+        ref = np.zeros((g, d), np.float32)
+        for e in range(4):
+            chosen = np.argsort(-gates[:, e])[:cap]
+            for tok in chosen:
+                h = np.asarray(jax.nn.silu(x[tok] @ w_in[e]))
+                ref[tok] += gates[tok, e] * (h @ np.asarray(w_out[e]))
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4)
+
+    def test_llama_train_moe_expert_choice(self, tmp_path, capsys):
+        import json as _json
+        import math as _math
+        from frameworks.jax import worker
+        rc = worker.main(["llama-train", "--steps", "1", "--seq", "64",
+                          "--ep", "4", "--moe-routing", "expert_choice",
+                          "--out", str(tmp_path / "ckpt")])
+        assert rc == 0
+        events = [_json.loads(line)
+                  for line in capsys.readouterr().out.splitlines()]
+        done = [e for e in events if e.get("event") == "done"]
+        assert done and done[0]["mesh"]["routing"] == "expert_choice"
+        assert _math.isfinite(done[0]["final_loss"])
+
+
 class TestRingGqaTpFallback:
     def test_kv_heads_indivisible_by_tp_still_works(self):
         """tp divides the query heads but not the kv heads (the

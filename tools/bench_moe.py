@@ -1,0 +1,99 @@
+"""MoE train-step bench on one chip: routing variants, same window.
+
+Measures the expert layer's real cost on the MXU — router softmax,
+one-hot dispatch/combine einsums, expert SwiGLU matmuls — through a full
+MoE-llama train step (``llama.loss_fn_moe``), for both routers
+(``top2`` token-choice vs ``expert_choice``) against the SAME resident
+weights in one process (tunnel-window discipline). Single chip runs
+ep=1 (the all_to_all is an identity there; cross-chip dispatch is
+validated on the virtual mesh + dryrun).
+
+One JSON line per variant. Usage::
+
+    python -m tools.bench_moe [--experts 8] [--batch 8] [--seq 512]
+        [--dim 1024] [--layers 4] [--steps 10] [--trials 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--experts", type=int, default=8)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=512)
+    p.add_argument("--dim", type=int, default=1024)
+    p.add_argument("--ffn", type=int, default=2048)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--capacity-factor", type=float, default=2.0)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama, train
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+    from dcos_commons_tpu.parallel.moe import MoEConfig
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, dim=args.dim, n_layers=args.layers,
+        n_heads=args.heads, n_kv_heads=args.heads,
+        ffn_dim=args.ffn, max_seq=args.seq + 1, remat=False,
+        attn_impl="dense")
+    mesh = MeshSpec().build(jax.devices()[:1])
+    params0 = llama.init_moe_params(cfg, args.experts, jax.random.key(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params0))
+    toks = jax.random.randint(jax.random.key(1),
+                              (args.batch, args.seq + 1), 0,
+                              cfg.vocab_size)
+    tokens_per_step = args.batch * args.seq
+
+    for routing in ("top2", "expert_choice"):
+        mcfg = MoEConfig(num_experts=args.experts,
+                         capacity_factor=args.capacity_factor,
+                         routing=routing)
+        opt = train.make_optimizer(lr=1e-3, warmup=5, decay_steps=100)
+        step = train.make_train_step(
+            lambda p, b, m=mcfg: llama.loss_fn_moe(cfg, p, b, mesh, m),
+            opt)
+        params = jax.tree.map(jnp.copy, params0)
+        opt_state = opt.init(params)
+        with mesh:
+            params, opt_state, out = step(params, opt_state, toks)
+            float(out["loss"])                       # compile + sync
+            trials = []
+            for _ in range(args.trials):
+                t0 = time.perf_counter()
+                for _ in range(args.steps):
+                    params, opt_state, out = step(params, opt_state,
+                                                  toks)
+                float(out["loss"])
+                trials.append(tokens_per_step * args.steps
+                              / (time.perf_counter() - t0))
+        from dcos_commons_tpu.utils.stats import median
+        tps = median(trials)
+        print(json.dumps({
+            "metric": "moe_train_step",
+            "routing": routing,
+            "experts": args.experts,
+            "capacity_factor": args.capacity_factor,
+            "params": n_params,
+            "batch": args.batch, "seq": args.seq,
+            "tokens_per_sec": round(tps, 1),
+            "spread": {"min": round(min(trials), 1),
+                       "max": round(max(trials), 1),
+                       "trials": len(trials)},
+            "backend": jax.devices()[0].platform,
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

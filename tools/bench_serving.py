@@ -1,0 +1,157 @@
+"""Serving latency under load: Poisson arrivals through the REAL front
+door.
+
+Builds the continuous-batching engine (``models/serving.py``) behind the
+HTTP ingress (``models/ingress.py``) exactly as a deployed serving pod
+runs it, then drives it with an open-loop Poisson arrival process —
+clients do NOT wait for each other, so queueing delay is measured
+honestly (closed-loop clients hide it). Reports client-observed latency
+AND the ingress's own TTFT/TPOT percentiles plus throughput and
+back-pressure counts.
+
+One JSON line. Usage::
+
+    python -m tools.bench_serving [--preset 400m] [--quant int8]
+        [--slots 8] [--rps 4] [--duration 30] [--max-new 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+from dcos_commons_tpu.utils.stats import percentiles as _percentiles
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", default="400m",
+                   choices=["tiny", "400m", "8b"])
+    p.add_argument("--quant", default="int8", choices=["none", "int8"])
+    p.add_argument("--kv-quant", action="store_true")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--rps", type=float, default=4.0,
+                   help="mean Poisson arrival rate (requests/sec)")
+    p.add_argument("--duration", type=float, default=30.0)
+    p.add_argument("--max-new", type=int, default=32)
+    p.add_argument("--prompt-lens", default="8,16,32,64",
+                   help="request prompt lengths, sampled uniformly")
+    p.add_argument("--queue-limit", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from dcos_commons_tpu.models import llama
+    from dcos_commons_tpu.models.ingress import ServingFrontend
+    from dcos_commons_tpu.models.serving import SlotServer
+
+    if args.preset == "8b":
+        cfg = llama.LlamaConfig.llama3_8b(max_seq=2048, remat=False,
+                                          kv_quant=args.kv_quant)
+    elif args.preset == "400m":
+        cfg = llama.LlamaConfig.llama_400m(max_seq=2048,
+                                           kv_quant=args.kv_quant)
+    else:
+        cfg = llama.LlamaConfig.tiny(kv_quant=args.kv_quant)
+    if args.quant == "int8" and args.preset != "tiny":
+        params = llama.init_quantized_params(cfg, jax.random.key(0),
+                                             device=jax.devices()[0])
+        quant_applied = "int8"
+    else:
+        # tiny never quantizes; the receipt must say what actually ran
+        params = llama.init_params(cfg, jax.random.key(0))
+        quant_applied = "none"
+
+    engine = SlotServer(cfg, params, slots=args.slots)
+    fe = ServingFrontend(engine, port=0, host="127.0.0.1",
+                         max_queue=args.queue_limit).start()
+    rng = random.Random(args.seed)
+    lens = [int(x) for x in args.prompt_lens.split(",")]
+
+    # warm every prefill bucket + the decode step so the measured load
+    # sees steady-state executables (compile stalls are a COLD-start
+    # property; serving pods prefill-warm at deploy readiness)
+    for n in sorted(set(lens)):
+        prompt = [rng.randrange(cfg.vocab_size) for _ in range(n)]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fe.port}/v1/generate",
+            data=json.dumps({"prompt": prompt, "max_new": 2}).encode())
+        urllib.request.urlopen(req, timeout=600).read()
+
+    results = []        # (latency_s, tokens, ttft_ms, tpot_ms)
+    rejected = [0]
+    errors = [0]
+    threads = []
+    lock = threading.Lock()
+
+    def fire(prompt):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fe.port}/v1/generate",
+            data=json.dumps({"prompt": prompt,
+                             "max_new": args.max_new}).encode())
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=600) as r:
+                body = json.loads(r.read())
+            lat = time.perf_counter() - t0
+            with lock:
+                results.append((lat, len(body["tokens"]),
+                                body.get("ttft_ms"), body.get("tpot_ms")))
+        except urllib.error.HTTPError as e:
+            with lock:
+                (rejected if e.code == 503 else errors)[0] += 1
+        except Exception:
+            with lock:
+                errors[0] += 1
+
+    t_start = time.perf_counter()
+    offered = 0
+    while time.perf_counter() - t_start < args.duration:
+        # open-loop Poisson: exponential inter-arrival, fire-and-forget
+        time.sleep(rng.expovariate(args.rps))
+        n = rng.choice(lens)
+        prompt = [rng.randrange(cfg.vocab_size) for _ in range(n)]
+        th = threading.Thread(target=fire, args=(prompt,), daemon=True)
+        th.start()
+        threads.append(th)
+        offered += 1
+    for th in threads:
+        th.join(timeout=600)
+    wall = time.perf_counter() - t_start
+    stats = fe.stats()
+    fe.stop()
+
+    lats = [r[0] * 1000 for r in results]
+    ttfts = [r[2] for r in results if r[2] is not None]
+    tpots = [r[3] for r in results if r[3] is not None]
+    total_tokens = sum(r[1] for r in results)
+    print(json.dumps({
+        "metric": "serving_latency",
+        "preset": args.preset, "quant": quant_applied,
+        "kv_quant": args.kv_quant,
+        "slots": args.slots, "rps_offered": args.rps,
+        "duration_s": round(wall, 1),
+        "requests_offered": offered,
+        "requests_completed": len(results),
+        "rejected_503": rejected[0], "errors": errors[0],
+        "max_new": args.max_new,
+        "throughput_tokens_per_sec": round(total_tokens / wall, 1),
+        "latency_ms": _percentiles(lats),
+        "ttft_ms": _percentiles(ttfts),
+        "tpot_ms": _percentiles(tpots),
+        "ingress_stats": {k: stats[k] for k in
+                          ("requests", "tokens", "rejected")},
+        "backend": jax.devices()[0].platform,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-5 chip session: ALL real-TPU measurements, strictly serialized
+# (never two chip jobs at once — tunnel-backend discipline,
+# docs/performance.md). Each block appends JSON receipts.
+set -u
+cd "$(dirname "$0")/.."
+OUT=bench_r5
+mkdir -p $OUT
+
+echo "== window A/B/C: resnet anchor drift evidence (task: reconcile)"
+for w in A B C; do
+  echo "-- window $w $(date -u +%H:%M:%S)"
+  timeout 900 python bench.py >> $OUT/resnet_windows.jsonl 2>> $OUT/resnet_windows.err
+  sleep 45
+done
+
+echo "== 400m flagship split receipts (short + long-context prompt)"
+timeout 1800 python -m tools.bench_flagship --preset 400m --batches 1,8 \
+  --variants chunked+kv+flash --steps 32 \
+  >> $OUT/flag400_split.jsonl 2>> $OUT/flag400_split.err
+timeout 1800 python -m tools.bench_flagship --preset 400m --batches 1 \
+  --variants chunked+kv+flash --max-seq 8192 --prompt 4096 --steps 32 \
+  >> $OUT/flag400_long_split.jsonl 2>> $OUT/flag400_long_split.err
+
+echo "== serving latency under Poisson load (400m int8, slots 8)"
+timeout 1800 python -m tools.bench_serving --preset 400m --quant int8 \
+  --kv-quant --slots 8 --rps 4 --duration 45 --max-new 32 \
+  >> $OUT/serving_latency.jsonl 2>> $OUT/serving_latency.err
+timeout 1800 python -m tools.bench_serving --preset 400m --quant int8 \
+  --kv-quant --slots 8 --rps 10 --duration 45 --max-new 32 \
+  >> $OUT/serving_latency.jsonl 2>> $OUT/serving_latency.err
+
+echo "== speculative e2e: int8 self-draft (real), truncate (floor)"
+timeout 2400 python -m tools.bench_speculative --e2e --draft int8 \
+  --k 8 --steps 256 >> $OUT/spec_e2e.jsonl 2>> $OUT/spec_e2e.err
+timeout 2400 python -m tools.bench_speculative --e2e --draft int8 \
+  --k 8 --steps 256 --temperature 0.7 \
+  >> $OUT/spec_e2e.jsonl 2>> $OUT/spec_e2e.err
+timeout 2400 python -m tools.bench_speculative --e2e --draft truncate \
+  --draft-layers 2 --k 4 --steps 64 \
+  >> $OUT/spec_e2e.jsonl 2>> $OUT/spec_e2e.err
+
+echo "== 8B long-context with split prefill/decode receipt"
+timeout 5400 python -m tools.bench_flagship --preset 8b --batches 1 \
+  --variants chunked+kv+flash --max-seq 8192 --prompt 4096 --steps 32 \
+  >> $OUT/flag8b_long_split.jsonl 2>> $OUT/flag8b_long_split.err
+
+echo "== session done $(date -u +%H:%M:%S)"
