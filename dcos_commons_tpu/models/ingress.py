@@ -100,11 +100,17 @@ class ServingFrontend:
     def __init__(self, engine: SlotServer, port: int = 0,
                  host: str = "0.0.0.0", max_queue: int = 64,
                  request_timeout_s: float = 600.0,
-                 idle_sleep_s: float = 0.001):
+                 idle_sleep_s: float = 0.001,
+                 decode_window: int = 8):
         self.engine = engine
         self.max_queue = max_queue
         self.request_timeout_s = request_timeout_s
         self._idle_sleep_s = idle_sleep_s
+        # tokens decoded per device dispatch (SlotServer.step_many):
+        # dispatch latency — not the chip — bounds TPOT on tunneled
+        # backends, so the engine decodes a window per dispatch; new
+        # requests wait at most one window for a slot
+        self._decode_window = max(1, decode_window)
         self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=max_queue)
         self._live: Dict[int, _Pending] = {}          # slot -> pending
         self._stop = threading.Event()
@@ -291,7 +297,7 @@ class ServingFrontend:
             try:
                 filled = self._fill_slots()
                 if self.engine.requests_active():
-                    self.engine.step()
+                    self.engine.step_many(self._decode_window)
                     self._sync()
                 elif not filled:
                     self._wake.wait(self._idle_sleep_s * 50)

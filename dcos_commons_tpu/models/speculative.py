@@ -45,11 +45,13 @@ flagship is the intended target model, with a 400m-class draft).
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+import functools
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from dcos_commons_tpu.models import llama
 from dcos_commons_tpu.ops import rope_frequencies
@@ -133,6 +135,116 @@ class SpeculativeDecoder:
             self._draft_x = None
         self._verify_x = jax.jit(lambda p, c, toks, pos: llama.extend_step(
             self.cfg_t, p, c, toks, pos, rope=rope_t))
+        self._fused_x: Dict[int, Any] = {}     # steps -> one-dispatch loop
+
+    def generate_fused(self, prompt: jnp.ndarray, steps: int
+                       ) -> Tuple[jnp.ndarray, Dict[str, float]]:
+        """Greedy speculative decoding as ONE device program.
+
+        :meth:`generate` syncs with the host every verify pass (the
+        accept decision), so on dispatch-heavy paths (tunneled
+        backends: ~100 ms+ per round trip) the sync — not the chip —
+        bounds throughput (measured: 24 tok/s vs 659 solo at 400m
+        through the tunnel, at 0.69 acceptance). This variant runs
+        draft + verify + acceptance inside a ``lax.while_loop``: the
+        accept test is an argmax compare on device, emitted tokens land
+        in a fixed [steps+k] buffer via ``dynamic_update_slice`` (a
+        pass writes its whole window; only ``accepted+1`` of it is
+        advanced over, and the next pass overwrites the rest), and the
+        host syncs ONCE for the final buffer. Greedy only —
+        sampled/rejection acceptance keeps the host loop.
+        """
+        if self.temperature > 0.0:
+            raise ValueError("generate_fused is greedy-only; sampled "
+                             "acceptance uses generate()")
+        if self.k < 2:
+            raise ValueError("generate_fused needs k >= 2")
+        b, s = prompt.shape
+        if b != 1:
+            raise ValueError("speculative decoding is batch-1")
+        need = s + steps + self.k
+        if need > self.cfg_t.max_seq or need > self.cfg_d.max_seq:
+            raise ValueError(
+                f"prompt {s} + steps {steps} + k {self.k} exceeds "
+                f"max_seq (target {self.cfg_t.max_seq}, draft "
+                f"{self.cfg_d.max_seq})")
+        x = self._fused_x.get(steps)
+        if x is None:
+            # both caches donated: they dominate HBM at real presets and
+            # the while_loop works on its own copies — without donation
+            # XLA holds input + working buffers live across the longest
+            # dispatch in the system
+            x = jax.jit(functools.partial(self._fused_loop, steps=steps),
+                        donate_argnums=(2, 3))
+            self._fused_x[steps] = x
+        cache_t = llama.init_kv_cache(self.cfg_t, 1, self.cfg_t.max_seq)
+        cache_d = llama.init_kv_cache(self.cfg_d, 1, self.cfg_d.max_seq)
+        lt, cache_t = self._prefill_t(self.params_t, cache_t, prompt)
+        _, cache_d = self._prefill_d(self.params_d, cache_d, prompt)
+        out, n_out, passes = x(self.params_t, self.params_d, cache_t,
+                               cache_d, lt, jnp.int32(s))
+        toks = np.asarray(out)[:steps]              # the ONE host sync
+        passes = int(passes)
+        # n_out counts the prefill token (slot 0); pass emissions are
+        # n_out - 1, of which one per pass is the target's own token
+        proposed = passes * (self.k - 1)
+        accepted = int(n_out) - 1 - passes
+        stats = {"verify_passes": passes,
+                 "tokens_per_pass": round(steps / max(passes, 1), 3),
+                 "proposed": proposed, "accepted": accepted,
+                 "accept_rate": round(accepted / max(proposed, 1), 4),
+                 "temperature": 0.0, "k": self.k, "fused": True}
+        return jnp.asarray([toks], jnp.int32), stats
+
+    def _fused_loop(self, params_t, params_d, cache_t, cache_d,
+                    prefill_logits, pos0, *, steps: int):
+        """Traced body of :meth:`generate_fused`."""
+        k = self.k
+        cfg_t, cfg_d = self.cfg_t, self.cfg_d
+        rope_t = rope_frequencies(cfg_t.head_dim, cfg_t.max_seq,
+                                  cfg_t.rope_theta)
+        rope_d = rope_frequencies(cfg_d.head_dim, cfg_d.max_seq,
+                                  cfg_d.rope_theta)
+        cur0 = jnp.argmax(prefill_logits, axis=-1).astype(jnp.int32)  # [1]
+        out0 = jnp.zeros((steps + k,), jnp.int32)
+        # the prefill's token is emission #1
+        out0 = out0.at[0].set(cur0[0])
+
+        def cond(c):
+            return c[0] < steps
+
+        def body(c):
+            n_out, pos, cur, cache_t, cache_d, out, passes = c
+
+            def dstep(carry, i):
+                cache_d, tok = carry
+                lg, cache_d = llama.decode_step(cfg_d, params_d,
+                                                cache_d, pos + i, tok,
+                                                rope=rope_d)
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                return (cache_d, nxt), nxt
+
+            (cache_d, _), dtoks = lax.scan(dstep, (cache_d, cur),
+                                           jnp.arange(k))
+            dtoks = dtoks[:, 0]                          # [k]
+            window = jnp.concatenate([cur, dtoks[:k - 1]])[None, :]
+            logits, cache_t = llama.extend_step(cfg_t, params_t,
+                                                cache_t, window, pos,
+                                                rope=rope_t)
+            tgt = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [k]
+            agree = jnp.cumprod(
+                (dtoks[:k - 1] == tgt[:k - 1]).astype(jnp.int32))
+            n_emit = jnp.sum(agree) + 1                  # 1..k
+            out = lax.dynamic_update_slice(out, tgt, (n_out,))
+            cur = lax.dynamic_index_in_dim(tgt, n_emit - 1,
+                                           keepdims=True)
+            return (n_out + n_emit, pos + n_emit, cur, cache_t,
+                    cache_d, out, passes + 1)
+
+        n_out, _, _, _, _, out, passes = lax.while_loop(
+            cond, body, (jnp.int32(1), pos0, cur0, cache_t, cache_d,
+                         out0, jnp.int32(0)))
+        return out, n_out, passes
 
     def generate(self, prompt: jnp.ndarray, steps: int
                  ) -> Tuple[jnp.ndarray, Dict[str, float]]:

@@ -351,7 +351,8 @@ def run_llama(args) -> dict:
             if port < 0:          # default: the reserved port, else any
                 port = int(os.environ.get("PORT_SERVE", "0"))
             frontend = ServingFrontend(server, port=port,
-                                       max_queue=args.queue_limit)
+                                       max_queue=args.queue_limit,
+                                       decode_window=args.decode_window)
             frontend.start()
             # re-stamp the readiness marker now that the ingress is
             # actually listening (the yml readiness probe hits healthz)
@@ -619,6 +620,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue-limit", type=int, default=64,
                    help="llama --serve --slots: bounded ingress queue "
                         "(overflow answers 503 + Retry-After)")
+    p.add_argument("--decode-window", type=int, default=8,
+                   help="llama --serve --slots: tokens decoded per "
+                        "device dispatch (SlotServer.step_many); "
+                        "dispatch latency bounds TPOT on tunneled "
+                        "backends — raise to amortize, lower for "
+                        "tighter intake latency")
     p.add_argument("--serve-interval", type=float, default=30.0,
                    help="llama --serve: seconds between decode heartbeats")
     p.add_argument("--attn", default="auto",

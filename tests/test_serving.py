@@ -174,6 +174,43 @@ def test_slot_server_rejects_oversized():
         raise AssertionError("oversized request was not rejected")
 
 
+def test_step_many_streams_match_per_step():
+    """step_many(k) == k x step(): same greedy streams through
+    mid-window retirements and slot refills (the dispatch-amortized
+    window must be invisible to request outputs)."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    reqs = [{"prompt": [int(t) for t in jax.random.randint(
+                jax.random.key(40 + i), (n,), 0, cfg.vocab_size)],
+             "max_new": m, "request_id": i}
+            # budgets NOT multiples of the window: retirement lands
+            # mid-window and the tail tokens must be dropped
+            for i, (n, m) in enumerate([(8, 5), (5, 11), (12, 3),
+                                        (6, 7)])]
+    base = serving.SlotServer(cfg, params, slots=2).drain(
+        [dict(r) for r in reqs])
+    windowed = serving.SlotServer(cfg, params, slots=2).drain(
+        [dict(r) for r in reqs], decode_window=4)
+    assert windowed == base, (windowed, base)
+
+
+def test_step_many_on_tp_mesh():
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    mesh = MeshSpec(tp=2).build(jax.devices()[:2])
+    with mesh:
+        sharded = llama.shard_params(params, mesh, cfg)
+    reqs = [{"prompt": [1, 2, 3, 4, 5], "max_new": 6, "request_id": "a"},
+            {"prompt": [7, 8, 9], "max_new": 4, "request_id": "b"}]
+    base = serving.SlotServer(cfg, sharded, slots=2, mesh=mesh).drain(
+        [dict(r) for r in reqs])
+    windowed = serving.SlotServer(cfg, sharded, slots=2,
+                                  mesh=mesh).drain(
+        [dict(r) for r in reqs], decode_window=3)
+    assert windowed == base
+
+
 # ----------------------------------------------------- tensor parallelism
 
 class TestSlotServerTP:

@@ -188,3 +188,48 @@ def test_speculative_guards():
         dec.generate(jnp.zeros((1, 8), jnp.int32), steps=96)
     with pytest.raises(ValueError, match="batch-1"):
         dec.generate(jnp.zeros((2, 8), jnp.int32), steps=4)
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_fused_stream_equals_target_greedy(k):
+    """The one-dispatch fused loop must emit EXACTLY the host loop's
+    stream (which is exactly the target's greedy stream), under a
+    hostile different-seed draft."""
+    cfg = _cfg()
+    target = llama.init_params(cfg, jax.random.key(0))
+    draft = llama.init_params(cfg, jax.random.key(42))
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0,
+                                cfg.vocab_size)
+    want = _solo(cfg, target, prompt, 12)
+    dec = speculative.SpeculativeDecoder(cfg, target, cfg, draft, k=k)
+    got, stats = dec.generate_fused(prompt, 12)
+    assert [int(t) for t in got[0]] == want, (k, stats)
+    assert stats["fused"] and stats["verify_passes"] >= 1
+    # host-loop parity on the bookkeeping too
+    _, host_stats = dec.generate(prompt, 12)
+    assert stats["verify_passes"] == host_stats["verify_passes"]
+    assert stats["accept_rate"] == host_stats["accept_rate"]
+
+
+def test_fused_self_draft_full_acceptance():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0,
+                                cfg.vocab_size)
+    dec = speculative.SpeculativeDecoder(cfg, params, cfg, params, k=4)
+    got, stats = dec.generate_fused(prompt, 16)
+    assert got.shape == (1, 16)
+    assert stats["tokens_per_pass"] >= 3.9, stats
+    assert stats["accept_rate"] >= 0.99, stats
+
+
+def test_fused_guards():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    dec = speculative.SpeculativeDecoder(cfg, params, cfg, params, k=1)
+    with pytest.raises(ValueError, match="k >= 2"):
+        dec.generate_fused(jnp.zeros((1, 8), jnp.int32), 4)
+    dec = speculative.SpeculativeDecoder(cfg, params, cfg, params, k=2,
+                                         temperature=0.5)
+    with pytest.raises(ValueError, match="greedy-only"):
+        dec.generate_fused(jnp.zeros((1, 8), jnp.int32), 4)

@@ -1,0 +1,74 @@
+"""ResNet anchor reconcile: sync-share A/B in ONE process window.
+
+The bench's timed block ends with a host sync (``float(loss)``), whose
+tunnel round trip is amortized over ``n_steps`` device steps. If the
+anchor round's tunnel RTT was lower, the same binary measures lower
+today by a constant factor — this tool runs the EXACT bench.py
+measurement at several ``n_steps`` in one window, quantifying the sync
+share directly: if throughput rises with n_steps, the deficit is
+measurement overhead, not model regression.
+
+One JSON line per n_steps. Usage::
+
+    python -m tools.bench_resnet_sync_ab [--steps 20,40,80] [--trials 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", default="20,40,80")
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--batch", type=int, default=256)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import resnet, train
+    from dcos_commons_tpu.utils.stats import median
+
+    cfg = resnet.ResNetConfig(depth=50, n_classes=1000)
+    params, state = resnet.init_params(cfg, jax.random.key(0))
+    batch = args.batch
+    x = jax.random.normal(jax.random.key(1), (batch, 224, 224, 3),
+                          jnp.bfloat16)
+    y = jax.random.randint(jax.random.key(2), (batch,), 0, cfg.n_classes)
+    opt = train.make_optimizer(lr=1e-3, warmup=10, decay_steps=1000)
+    step = train.make_train_step(
+        lambda p, b: resnet.loss_fn(cfg, p, b[0], b[1]), opt,
+        has_aux_state=True)
+    opt_state = opt.init(params)
+    params, opt_state, state, out = step(params, opt_state,
+                                         (state, (x, y)))
+    float(out["loss"])                                  # compile + sync
+
+    for n_steps in [int(s) for s in args.steps.split(",")]:
+        trials = []
+        for _ in range(args.trials):
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                params, opt_state, state, out = step(params, opt_state,
+                                                     (state, (x, y)))
+            float(out["loss"])                           # ONE sync
+            trials.append(batch * n_steps
+                          / (time.perf_counter() - t0))
+        print(json.dumps({
+            "metric": "resnet_sync_share_ab",
+            "n_steps": n_steps,
+            "images_per_sec_per_chip": round(median(trials), 2),
+            "spread": {"min": round(min(trials), 2),
+                       "max": round(max(trials), 2),
+                       "trials": [round(t, 2) for t in trials]},
+            "backend": jax.devices()[0].platform,
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -43,6 +43,9 @@ def main(argv=None) -> int:
     p.add_argument("--prompt-lens", default="8,16,32,64",
                    help="request prompt lengths, sampled uniformly")
     p.add_argument("--queue-limit", type=int, default=64)
+    p.add_argument("--decode-window", type=int, default=8,
+                   help="tokens per device dispatch "
+                        "(SlotServer.step_many)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
@@ -71,7 +74,8 @@ def main(argv=None) -> int:
 
     engine = SlotServer(cfg, params, slots=args.slots)
     fe = ServingFrontend(engine, port=0, host="127.0.0.1",
-                         max_queue=args.queue_limit).start()
+                         max_queue=args.queue_limit,
+                         decode_window=args.decode_window).start()
     rng = random.Random(args.seed)
     lens = [int(x) for x in args.prompt_lens.split(",")]
 
@@ -136,7 +140,8 @@ def main(argv=None) -> int:
         "metric": "serving_latency",
         "preset": args.preset, "quant": quant_applied,
         "kv_quant": args.kv_quant,
-        "slots": args.slots, "rps_offered": args.rps,
+        "slots": args.slots, "decode_window": args.decode_window,
+        "rps_offered": args.rps,
         "duration_s": round(wall, 1),
         "requests_offered": offered,
         "requests_completed": len(results),

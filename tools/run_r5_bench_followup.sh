@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-5 chip follow-up: measurements for the fixes the FIRST session's
+# receipts motivated (dispatch-window serving, fused speculative) plus
+# the resnet sync-share A/B and the MoE routing step. Serialized.
+set -u
+cd "$(dirname "$0")/.."
+OUT=bench_r5
+mkdir -p $OUT
+
+echo "== resnet sync-share A/B (one window)"
+timeout 1800 python -m tools.bench_resnet_sync_ab --steps 20,40,80 \
+  >> $OUT/resnet_sync_ab.jsonl 2>> $OUT/resnet_sync_ab.err
+
+echo "== serving latency: decode_window 1 (control) vs 8 vs 16, one rps"
+# same offered load across all three so the window's effect is isolated
+# (the window-1 control repeats the first session's engine in THIS
+# session's tunnel conditions — same-window discipline)
+for W in 1 8 16; do
+  timeout 1800 python -m tools.bench_serving --preset 400m --quant int8 \
+    --kv-quant --slots 8 --decode-window $W --rps 4 --duration 45 \
+    --max-new 32 >> $OUT/serving_latency_windowed.jsonl \
+    2>> $OUT/serving_latency_windowed.err
+done
+
+echo "== fused speculative (one-dispatch loop), int8 self-draft"
+timeout 2400 python -m tools.bench_speculative --e2e --fused \
+  --draft int8 --k 8 --steps 256 \
+  >> $OUT/spec_e2e_fused.jsonl 2>> $OUT/spec_e2e_fused.err
+
+echo "== MoE routing A/B train step"
+timeout 2400 python -m tools.bench_moe --experts 8 --batch 8 \
+  --seq 512 >> $OUT/moe_step.jsonl 2>> $OUT/moe_step.err
+
+echo "== follow-up done $(date -u +%H:%M:%S)"
