@@ -107,7 +107,14 @@ def bench_resnet(jax, jnp, n_chips):
     params, opt_state, state, out = step(params, opt_state, (state, (x, y)))
     float(out["loss"])
 
-    n_steps = 20
+    # 80 steps per timed block: the block's single end sync rides the
+    # tunnel (RTT drifts by round), and at 20 steps that tax measured
+    # ~4% of the block — a same-window A/B (tools/bench_resnet_sync_ab,
+    # receipts bench_r5/resnet_sync_ab.jsonl: 2501 @ 20 / 2559 @ 40 /
+    # 2597 @ 80 img/s on identical code) pinned the round-4/5 anchor
+    # "slip" on exactly this overhead. Longer blocks measure the chip,
+    # not the tunnel.
+    n_steps = 80
     trials = []
     for _ in range(N_TRIALS):
         t0 = time.perf_counter()
@@ -210,6 +217,7 @@ def main() -> None:
         "chip": chip,
         "n_chips": n_chips,
         "batch": RESNET_BATCH,
+        "n_steps_per_trial": 80,
         "spread": spread,
         "peak_tflops_bf16": peak_tflops,
         "model_flops_per_step": resnet_flops_step,
