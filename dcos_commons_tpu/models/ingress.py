@@ -326,16 +326,59 @@ class ServingFrontend:
 
     # ---------------------------------------------------------- lifecycle
 
-    def start(self) -> "ServingFrontend":
-        self._engine_thread = threading.Thread(target=self._run_engine,
-                                               daemon=True,
-                                               name="serving-engine")
-        self._engine_thread.start()
+    def start(self, drive: bool = True) -> "ServingFrontend":
+        """``drive=False`` starts the HTTP listener only — an external
+        driver owns the engine (the multi-process gang loop,
+        ``models/serving_gang.py``) and calls :meth:`mark_driven`."""
+        if drive:
+            self._engine_thread = threading.Thread(
+                target=self._run_engine, daemon=True,
+                name="serving-engine")
+            self._engine_thread.start()
         self._http_thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="serving-http")
         self._http_thread.start()
         return self
+
+    def mark_driven(self) -> None:
+        """External drivers stamp liveness each iteration; health stays
+        ok while the stamp is fresh (``driven_ttl_s`` — generous by
+        default so a first-request compile inside one iteration does
+        not flap health)."""
+        self._driven_at = time.monotonic()
+
+    driven_ttl_s: float = 600.0
+
+    # ---- external-driver interface (the gang loop, serving_gang.py) ----
+
+    def drain_intake(self, budget: int):
+        """Pop up to ``budget`` queued requests for an external driver.
+        Returns the pending objects; the driver submits them and calls
+        :meth:`attach` with the slot each landed in."""
+        out = []
+        while len(out) < budget:
+            try:
+                out.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    def attach(self, slot: int, pending: "_Pending") -> None:
+        """Bind a submitted request to its slot and fan out anything the
+        submit already produced (first token / instant retire)."""
+        self._live[slot] = pending
+        self._sync()
+
+    def sync(self) -> None:
+        """Fan freshly decoded tokens out to request streams (public
+        wrapper for external drivers)."""
+        self._sync()
+
+    def fail_inflight(self, error: str) -> None:
+        """Fail every in-flight request and reset the engine (public
+        wrapper for external drivers)."""
+        self._fail_inflight(error)
 
     def stop(self) -> None:
         self._stop.set()
@@ -359,6 +402,10 @@ class ServingFrontend:
     def health(self) -> dict:
         alive = (self._engine_thread is not None
                  and self._engine_thread.is_alive())
+        driven_at = getattr(self, "_driven_at", None)
+        if not alive and driven_at is not None:
+            # externally-driven (gang loop): fresh stamp == serving
+            alive = time.monotonic() - driven_at < self.driven_ttl_s
         return {"ok": alive, "slots": self.engine.slots,
                 "free": len(self.engine.free_slots()),
                 "queued": self._queue.qsize()}

@@ -1,0 +1,195 @@
+"""Multi-process (gang) serving: rank-0 request broadcast.
+
+A tensor-parallel gang spanning PROCESSES (one `jax.distributed` mesh
+over many hosts) executes SPMD: every process must issue the SAME
+engine calls with the SAME values, or the lock-step collectives
+diverge. A per-process HTTP ingress therefore cannot drive the slot
+engine directly — the round-4 verdict's "rank-0 request broadcast is
+the missing piece". This module is that piece:
+
+* Rank 0 runs the HTTP front door (``models/ingress.py``) WITHOUT its
+  engine thread; every other rank runs nothing client-facing.
+* All ranks run :class:`GangServingDriver.run`'s loop in lock-step.
+  Each iteration: rank 0 drains up to ``min(free slots, max_intake)``
+  queued requests into a FIXED-SHAPE int32 intake array; the array is
+  ``broadcast_one_to_all``; every rank decodes it and makes identical
+  ``engine.submit`` calls (slot choice is deterministic — first free
+  slot), then one identical ``engine.step_many`` advances the pool.
+  Rank 0 fans tokens back to its HTTP clients; peers discard.
+* The broadcast is the rendezvous: idle iterations still broadcast an
+  empty intake, so no rank ever waits on a collective the others
+  skipped.
+
+Determinism requirements (asserted in tests): greedy decoding, or a
+sampler constructed with the same seed on every rank — the key stream
+then advances identically inside the jitted steps, so retirements and
+slot assignments stay rank-identical.
+
+Wire format (``encode_intake``/``decode_intake``): int32
+``[max_intake, 2 + max_prompt]``; row = (prompt_len, max_new,
+prompt..., 0 padding); prompt_len == 0 terminates.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from dcos_commons_tpu.models.ingress import ServingFrontend, _Pending
+from dcos_commons_tpu.models.serving import SlotServer
+
+
+def encode_intake(items: List[Tuple[List[int], int]], max_intake: int,
+                  max_prompt: int) -> np.ndarray:
+    """[(prompt, max_new), ...] -> fixed-shape int32 intake array."""
+    if len(items) > max_intake:
+        raise ValueError(f"{len(items)} submissions > max_intake "
+                         f"{max_intake}")
+    arr = np.zeros((max_intake, 2 + max_prompt), np.int32)
+    for i, (prompt, max_new) in enumerate(items):
+        n = len(prompt)
+        if not 0 < n <= max_prompt:
+            raise ValueError(f"prompt length {n} not in (0, {max_prompt}]")
+        arr[i, 0] = n
+        arr[i, 1] = max_new
+        arr[i, 2:2 + n] = prompt
+    return arr
+
+
+def decode_intake(arr: np.ndarray) -> List[Tuple[List[int], int]]:
+    out = []
+    for row in np.asarray(arr):
+        n = int(row[0])
+        if n == 0:
+            break
+        out.append(([int(t) for t in row[2:2 + n]], int(row[1])))
+    return out
+
+
+class GangServingDriver:
+    """Lock-step serving loop for one member of a multi-process gang.
+
+    Rank 0 passes its :class:`ServingFrontend` (started with
+    ``drive=False``); peers pass ``frontend=None``. Every rank passes
+    an identically-configured :class:`SlotServer` (same seed) over the
+    same global mesh.
+    """
+
+    def __init__(self, engine: SlotServer,
+                 frontend: Optional[ServingFrontend], *,
+                 num_processes: int, process_id: int,
+                 decode_window: int = 8, max_intake: int = 4,
+                 max_prompt: Optional[int] = None,
+                 idle_sleep_s: float = 0.02):
+        if (frontend is not None) != (process_id == 0):
+            raise ValueError("exactly rank 0 owns the HTTP frontend")
+        self.engine = engine
+        self.frontend = frontend
+        self.num_processes = num_processes
+        self.process_id = process_id
+        self.decode_window = max(1, decode_window)
+        self.max_intake = max_intake
+        # default: the full cache width — anything the POST validation
+        # accepted (prompt + max_new <= max_seq) fits the wire format,
+        # so no second, surprising limit exists
+        self.max_prompt = (min(max_prompt, engine.cfg.max_seq - 1)
+                           if max_prompt is not None
+                           else engine.cfg.max_seq - 1)
+        self._idle_sleep_s = idle_sleep_s
+        self._stop = False
+        self.iterations = 0
+        self.errors = 0
+
+    # ------------------------------------------------------------- loop
+
+    def _broadcast(self, arr: np.ndarray) -> np.ndarray:
+        if self.num_processes <= 1:
+            return arr
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.broadcast_one_to_all(arr))
+
+    def run_iteration(self) -> bool:
+        """One lock-step iteration; returns True if any work happened."""
+        fe = self.frontend
+        pendings: List[_Pending] = []
+        if fe is not None:
+            # stamp BEFORE the work: a first-request compile lives
+            # inside this iteration and must not flap health
+            fe.mark_driven()
+            budget = min(self.max_intake, len(self.engine.free_slots()))
+            for p in fe.drain_intake(budget):
+                if len(p.prompt) > self.max_prompt:
+                    # unreachable with the default (full cache width);
+                    # a narrowed wire format fails loudly, not silently
+                    p.finish(f"prompt exceeds gang max_prompt "
+                             f"{self.max_prompt}")
+                    continue
+                pendings.append(p)
+            arr = encode_intake([(p.prompt, p.max_new) for p in pendings],
+                                self.max_intake, self.max_prompt)
+        else:
+            arr = np.zeros((self.max_intake, 2 + self.max_prompt),
+                           np.int32)
+        arr = self._broadcast(arr)
+        items = decode_intake(arr)
+        for j, (prompt, max_new) in enumerate(items):
+            rid = pendings[j] if fe is not None else None
+            if rid is not None:
+                rid.t_submit = time.perf_counter()
+            slot = self.engine.submit(prompt, max_new,
+                                      request_id=rid
+                                      if rid is not None else object())
+            if fe is not None:
+                fe.attach(slot, pendings[j])     # incl. instant retire
+        worked = bool(items)
+        if self.engine.requests_active():
+            self.engine.step_many(self.decode_window)
+            if fe is not None:
+                fe.sync()
+            worked = True
+        if fe is None:
+            # peers have no frontend popping SlotServer.finished —
+            # without this, every retired request leaks a host-side
+            # entry forever on every non-zero rank
+            self.engine.finished.clear()
+        self.iterations += 1
+        return worked
+
+    def run(self, max_iterations: Optional[int] = None,
+            heartbeat_s: float = 0.0, on_heartbeat=None) -> None:
+        """Drive until stopped (or ``max_iterations``, for tests).
+        ``on_heartbeat(stats_dict)`` fires every ``heartbeat_s`` on
+        rank 0 (peers get an empty dict on the same cadence)."""
+        last_beat = time.monotonic()
+        while not self._stop:
+            if max_iterations is not None \
+                    and self.iterations >= max_iterations:
+                return
+            try:
+                worked = self.run_iteration()
+            except Exception as e:   # keep serving: transient dispatch
+                # failures must not tear the gang down. A failed
+                # collective surfaces on EVERY rank (the transport
+                # errors propagate), so each rank fails its in-flight
+                # work, resets its engine to the empty pool, and meets
+                # the others again at the next broadcast.
+                self.errors += 1
+                if self.frontend is not None:
+                    self.frontend.fail_inflight(f"engine error: {e}")
+                else:
+                    self.engine.reset()
+                worked = False
+            if not worked:
+                # the broadcast above is the rendezvous; idle ranks
+                # sleep the same nominal interval and meet again
+                time.sleep(self._idle_sleep_s)
+            if heartbeat_s and on_heartbeat is not None \
+                    and time.monotonic() - last_beat >= heartbeat_s:
+                last_beat = time.monotonic()
+                on_heartbeat(self.frontend.stats()
+                             if self.frontend is not None else {})
+
+    def stop(self) -> None:
+        self._stop = True

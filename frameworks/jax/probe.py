@@ -14,6 +14,16 @@ import urllib.request
 
 
 def main() -> int:
+    # multi-process gangs: only rank 0 exposes HTTP (the rank-0 request
+    # broadcast, models/serving_gang.py); non-zero members are ready
+    # once their worker wrote the post-warmup marker
+    if (os.environ.get("JAX_NUM_PROCESSES", "1") != "1"
+            and os.environ.get("POD_INSTANCE_INDEX", "0") != "0"):
+        if os.path.exists("serving.ready"):
+            return 0
+        print("probe: member not warmed (no serving.ready)",
+              file=sys.stderr)
+        return 1
     port = os.environ.get("PORT_SERVE", "")
     if not port:
         print("probe: PORT_SERVE not set", file=sys.stderr)

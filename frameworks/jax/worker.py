@@ -328,14 +328,16 @@ def run_llama(args) -> dict:
         # machinery should decide to restart the shard.
         # the slot engine composes with tensor parallelism: a sharded
         # mesh serves continuous batching through decode_step_slots
-        # under shard_map (models/serving.py), so --slots applies to a
-        # single-process tp mesh (one host's chips — the idiomatic TPU
-        # serving shape: tp within a host, replicas across hosts,
-        # serving.yml SERVE_CHIPS). Multi-PROCESS gangs keep heartbeat
-        # decode: per-process ingresses would feed divergent
-        # submit/step sequences into lock-step SPMD collectives; a
-        # rank-0 request broadcast is the missing piece, not shard_map.
-        slot_engine = args.slots > 0 and contract["num_processes"] == 1
+        # under shard_map (models/serving.py). Single-process (one
+        # host's chips) the ingress drives the engine directly;
+        # multi-PROCESS gangs serve through the rank-0 request
+        # broadcast (models/serving_gang.py): rank 0 owns the HTTP
+        # front door, every rank executes the identical submit/step
+        # sequence in lock-step.
+        slot_engine = args.slots > 0
+        multiproc = contract["num_processes"] > 1
+        if slot_engine and multiproc:
+            return _serve_gang(args, contract, cfg, params, mesh, result)
         if slot_engine:
             # continuous batching behind a REAL front door: the ingress
             # (models/ingress.py) accepts client requests on the
@@ -391,6 +393,50 @@ def run_llama(args) -> dict:
                 except Exception as e:
                     _emit({"event": "heartbeat_error", "n": i,
                            "error": str(e)})
+    return result
+
+
+def _serve_gang(args, contract, cfg, params, mesh, result) -> dict:
+    """Multi-process serving: rank 0 runs the HTTP front door, every
+    rank runs the lock-step broadcast/submit/step loop
+    (models/serving_gang.py). Never returns in normal operation."""
+    import jax
+
+    from dcos_commons_tpu.models.ingress import ServingFrontend
+    from dcos_commons_tpu.models.serving import SlotServer
+    from dcos_commons_tpu.models.serving_gang import GangServingDriver
+
+    rank = contract["process_id"]
+    server = SlotServer(cfg, params, slots=args.slots,
+                        mesh=mesh if mesh.size > 1 else None,
+                        key=jax.random.key(0))      # rank-identical seed
+    frontend = None
+    if rank == 0:
+        port = args.serve_port
+        if port < 0:
+            port = int(os.environ.get("PORT_SERVE", "0"))
+        frontend = ServingFrontend(server, port=port,
+                                   max_queue=args.queue_limit)
+        frontend.start(drive=False)
+        frontend.mark_driven()
+        with open("serving.ready", "w") as f:
+            f.write(f"ok {frontend.port}\n")
+        _emit({"event": "serving", "slots": args.slots,
+               "port": frontend.port, "gang": True, **result})
+    else:
+        _emit({"event": "serving", "slots": args.slots, "gang": True,
+               "rank": rank, **result})
+    driver = GangServingDriver(
+        server, frontend,
+        num_processes=contract["num_processes"], process_id=rank,
+        decode_window=args.decode_window)
+    beat = {"n": 0}
+
+    def on_heartbeat(stats):
+        beat["n"] += 1
+        _emit({"event": "heartbeat", "n": beat["n"], **stats})
+
+    driver.run(heartbeat_s=args.serve_interval, on_heartbeat=on_heartbeat)
     return result
 
 
