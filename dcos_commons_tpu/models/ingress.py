@@ -36,7 +36,7 @@ import threading
 import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from dcos_commons_tpu.models.serving import SlotServer
 
