@@ -245,24 +245,34 @@ class ServingFrontend:
     def _fill_slots(self) -> bool:
         filled = False
         while self.engine.free_slots():
-            try:
-                pending = self._queue.get_nowait()
-            except queue.Empty:
+            batch = self.drain_intake(len(self.engine.free_slots()))
+            if not batch:
                 break
-            pending.t_submit = time.perf_counter()
+            now = time.perf_counter()
+            items = []
+            for pending in batch:
+                pending.t_submit = now
+                items.append({"prompt": pending.prompt,
+                              "max_new": pending.max_new,
+                              "request_id": pending})
             try:
-                self._live[self.engine.submit(
-                    pending.prompt, pending.max_new,
-                    request_id=pending)] = pending
-            except ValueError as e:     # belt-and-braces: validated at POST
-                pending.finish(str(e))
-                continue
+                # batched admission: O(log n) prefill dispatches; the
+                # engine's own predicate fails bad items ALONE
+                # (validated at POST too, but one copy rules)
+                placed = self.engine.submit_many(
+                    items,
+                    on_invalid=lambda item, reason:
+                        item["request_id"].finish(reason))
+                for slot, pending in placed:
+                    self._live[slot] = pending
             except Exception as e:
-                # dequeued but not yet in _live: fail it HERE or the
-                # client hangs to its timeout (_fail_inflight only sees
-                # _live) — then re-raise so _run_engine resets the
-                # engine (the dispatch may have invalidated the cache)
-                pending.finish(f"engine error: {e}")
+                # dequeued but possibly not yet in _live: fail them
+                # HERE or the clients hang to their timeout
+                # (_fail_inflight only sees _live) — then re-raise so
+                # _run_engine resets the engine (the dispatch may have
+                # invalidated the cache)
+                for item in items:
+                    item["request_id"].finish(f"engine error: {e}")
                 raise
             filled = True
             self._sync()                # instant retire (max_new == 1)

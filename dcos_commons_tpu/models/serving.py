@@ -32,7 +32,7 @@ workload-layer capability for BASELINE.json config #5, layered on
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -73,6 +73,20 @@ def _prefill_bucket(cfg, params, prompt, true_len, rope, mesh=None):
     return logits, ks, vs
 
 
+def _prefill_bucket_many(cfg, params, prompts, true_lens, rope,
+                         mesh=None):
+    """[N, P] causal forward for N admitted requests in ONE dispatch:
+    (per-row last-live-position logits [N, V], ks/vs [L, N, P, KV, D]).
+    Rows are independent (batch-dim causal attention), so the math per
+    row is exactly :func:`_prefill_bucket`'s — only the dispatch count
+    changes (one per admission batch instead of one per request)."""
+    x, ks, vs = llama.prefill_trunk(cfg, params, prompts, rope, mesh)
+    last = jnp.take_along_axis(
+        x, (true_lens - 1)[:, None, None], axis=1)[:, 0]
+    logits = qmm(last, params["lm_head"]).astype(jnp.float32)
+    return logits, ks, vs
+
+
 def _shard_cache(cache, mesh):
     """Place the slot KV cache for tensor-parallel serving: shard over
     the KV-head axis (payload + scales) to sit next to the megatron
@@ -100,6 +114,19 @@ def _scatter_slot(cache, new, slot):
             cache.q.at[:, slot, :p].set(nq.q[:, 0]),
             cache.s.at[:, slot, :p].set(nq.s[:, 0].astype(cache.s.dtype)))
     return cache.at[:, slot, :p].set(new[:, 0])
+
+
+def _scatter_rows(cache, new, slots):
+    """Write [L, N, P, KV, D] prefill K/V into cache rows
+    [:, slots[i], :P] — slots are DISTINCT free slots, so the scatter
+    has no duplicate-index ordering hazard."""
+    p = new.shape[2]
+    if isinstance(cache, QTensor):
+        nq = quantize(new, axis=-1)
+        return QTensor(
+            cache.q.at[:, slots, :p].set(nq.q),
+            cache.s.at[:, slots, :p].set(nq.s.astype(cache.s.dtype)))
+    return cache.at[:, slots, :p].set(new)
 
 
 class SlotServer:
@@ -134,6 +161,8 @@ class SlotServer:
         self.finished: Dict[Any, List[int]] = {}
         rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
         self._prefill_x: Dict[int, Any] = {}   # bucket -> executable
+        self._prefill_many_x: Dict[Any, Any] = {}   # (n, bucket) -> exe
+        self._scatter_many_x: Dict[Any, Any] = {}   # (n, bucket) -> exe
         self._rope = rope
         # the cache is donated in BOTH jitted paths: it dominates HBM at
         # real presets (~1 GB+ at 8B) and every step/scatter returns a
@@ -186,9 +215,9 @@ class SlotServer:
             x = jax.jit(lambda p, toks, tl: _prefill_bucket(
                 cfg, p, toks, tl, rope, mesh))
             self._prefill_x[bucket] = x
-        padded = jnp.zeros((1, bucket), jnp.int32).at[0, :n].set(
-            jnp.asarray(prompt, jnp.int32))
-        logits, ks, vs = x(self.params, padded, jnp.int32(n))
+        arr = np.zeros((1, bucket), np.int32)
+        arr[0, :n] = prompt                       # host-side assembly
+        logits, ks, vs = x(self.params, jnp.asarray(arr), jnp.int32(n))
         self.cache = self._scatter_x(self.cache, ks, vs, jnp.int32(slot))
         tok = int(self._select(logits)[0])
         self.lengths = self.lengths.at[slot].set(n)
@@ -197,6 +226,100 @@ class SlotServer:
         self.requests[slot] = _Request(rid, n, max_new, [tok])
         self._maybe_retire(slot)
         return slot
+
+    def _validate_item(self, item: Dict[str, Any]) -> Optional[str]:
+        """None when admissible, else the rejection reason — the ONE
+        copy of the admission predicate (the POST handler and callers
+        defer to it via ``on_invalid``)."""
+        prompt = item["prompt"]
+        max_new = item.get("max_new", 32)
+        if not prompt:
+            return "empty prompt"
+        if len(prompt) + max_new > self.cfg.max_seq:
+            return (f"prompt {len(prompt)} + max_new {max_new} exceeds "
+                    f"the cache ({self.cfg.max_seq}); raise max_seq or "
+                    "shrink the ask")
+        return None
+
+    def submit_many(self, items: List[Dict[str, Any]],
+                    on_invalid=None) -> List[Tuple[int, Any]]:
+        """Admit up to ``len(free_slots())`` requests with O(log n)
+        prefill DISPATCHES instead of one per request: items are taken
+        in power-of-two batches (largest first), each batch prefilled
+        as ONE [N, P] forward whose K/V scatter into N distinct slots.
+        Each item: {"prompt": [...], "max_new": int, "request_id": any}.
+        Returns [(slot, request_id), ...] for everything admitted;
+        unadmitted items (pool full) are simply not in the result.
+        Invalid items fail ALONE: with ``on_invalid(item, reason)`` they
+        are reported and skipped (co-batched requests unaffected);
+        without it the first invalid item raises BEFORE any dispatch.
+        Power-of-two batch AND bucket sizes keep the executable count
+        logarithmic in (slots x max_seq)."""
+        admissible = []
+        for item in items:
+            reason = self._validate_item(item)
+            if reason is None:
+                admissible.append(item)
+            elif on_invalid is not None:
+                on_invalid(item, reason)
+            else:
+                raise ValueError(reason)
+        placed: List[Tuple[int, Any]] = []
+        remaining = admissible
+        while remaining:
+            free = self.free_slots()
+            if not free:
+                break
+            n = min(len(remaining), len(free))
+            k = 1 << (n.bit_length() - 1)          # largest pow2 <= n
+            batch, remaining = remaining[:k], remaining[k:]
+            placed.extend(self._submit_batch(batch, free[:k]))
+        return placed
+
+    def _submit_batch(self, batch: List[Dict[str, Any]],
+                      slots: List[int]) -> List[Tuple[int, Any]]:
+        k = len(batch)
+        lens = [len(item["prompt"]) for item in batch]
+        bucket = min(_bucket(max(lens)), self.cfg.max_seq)
+        key = (k, bucket)
+        x = self._prefill_many_x.get(key)
+        if x is None:
+            cfg, rope, mesh = self.cfg, self._rope, self.mesh
+            x = jax.jit(lambda p, toks, tl: _prefill_bucket_many(
+                cfg, p, toks, tl, rope, mesh))
+            self._prefill_many_x[key] = x
+        sx = self._scatter_many_x.get(key)
+        if sx is None:
+            sx = jax.jit(
+                lambda c, ks, vs, sl: {
+                    "k": _scatter_rows(c["k"], ks, sl),
+                    "v": _scatter_rows(c["v"], vs, sl)},
+                donate_argnums=(0,))
+            self._scatter_many_x[key] = sx
+        # assemble on the HOST: per-row device .at[].set would pay the
+        # O(n) dispatches this path exists to remove
+        arr = np.zeros((k, bucket), np.int32)
+        for i, item in enumerate(batch):
+            arr[i, :lens[i]] = item["prompt"]
+        logits, ks, vs = x(self.params, jnp.asarray(arr),
+                           jnp.asarray(lens, jnp.int32))
+        slot_arr = jnp.asarray(slots, jnp.int32)
+        self.cache = sx(self.cache, ks, vs, slot_arr)
+        toks = self._select(logits)
+        host_toks = [int(t) for t in np.asarray(toks)]
+        placed = []
+        for i, item in enumerate(batch):
+            slot = slots[i]
+            rid = item.get("request_id")
+            rid = rid if rid is not None else object()
+            self.lengths = self.lengths.at[slot].set(lens[i])
+            self.cur_tok = self.cur_tok.at[slot].set(host_toks[i])
+            self.requests[slot] = _Request(rid, lens[i],
+                                           item.get("max_new", 32),
+                                           [host_toks[i]])
+            self._maybe_retire(slot)
+            placed.append((slot, rid))
+        return placed
 
     def _select(self, logits) -> jnp.ndarray:
         if self.sampler is None:
@@ -348,13 +471,7 @@ class SlotServer:
         identical — slots are independent)."""
         pending = list(queue)
         while pending or self.requests_active():
-            while pending:
-                item = pending[0]
-                slot = self.submit(item["prompt"],
-                                   item.get("max_new", 32),
-                                   item.get("request_id"))
-                if slot is None:
-                    break
-                pending.pop(0)
+            placed = self.submit_many(pending)     # batched admission
+            pending = pending[len(placed):]
             self.step_many(decode_window)
         return dict(self.finished)

@@ -134,15 +134,21 @@ class GangServingDriver:
                            np.int32)
         arr = self._broadcast(arr)
         items = decode_intake(arr)
-        for j, (prompt, max_new) in enumerate(items):
-            rid = pendings[j] if fe is not None else None
-            if rid is not None:
-                rid.t_submit = time.perf_counter()
-            slot = self.engine.submit(prompt, max_new,
-                                      request_id=rid
-                                      if rid is not None else object())
+        if items:
+            now = time.perf_counter()
+            subs = []
+            for j, (prompt, max_new) in enumerate(items):
+                rid = pendings[j] if fe is not None else object()
+                if fe is not None:
+                    pendings[j].t_submit = now
+                subs.append({"prompt": prompt, "max_new": max_new,
+                             "request_id": rid})
+            # ONE batched admission on every rank: identical items in
+            # identical order -> identical slot choices + dispatches
+            placed = self.engine.submit_many(subs)
             if fe is not None:
-                fe.attach(slot, pendings[j])     # incl. instant retire
+                for slot, rid in placed:
+                    fe.attach(slot, rid)         # incl. instant retire
         worked = bool(items)
         if self.engine.requests_active():
             self.engine.step_many(self.decode_window)

@@ -174,6 +174,29 @@ def test_slot_server_rejects_oversized():
         raise AssertionError("oversized request was not rejected")
 
 
+def test_submit_many_batches_admissions():
+    """Batched admission: up to len(free) requests in pow2 prefill
+    batches, streams identical to one-at-a-time submits."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    reqs = [{"prompt": [int(t) for t in jax.random.randint(
+                jax.random.key(70 + i), (n,), 0, cfg.vocab_size)],
+             "max_new": m, "request_id": i}
+            for i, (n, m) in enumerate([(8, 5), (5, 6), (12, 4),
+                                        (6, 7), (9, 3)])]
+    server = serving.SlotServer(cfg, params, slots=4)
+    placed = server.submit_many([dict(r) for r in reqs])
+    # pool of 4: four admitted in pow2 batches, the 5th waits
+    assert len(placed) == 4
+    assert sorted(s for s, _ in placed) == [0, 1, 2, 3]
+    assert [rid for _, rid in placed] == [0, 1, 2, 3]
+    got = server.drain([dict(r) for r in reqs[4:]])
+    for r in reqs:
+        want = _solo(cfg, params, r["prompt"], r["max_new"])
+        assert got[r["request_id"]] == want, (r["request_id"],
+                                              got[r["request_id"]], want)
+
+
 def test_step_many_streams_match_per_step():
     """step_many(k) == k x step(): same greedy streams through
     mid-window retirements and slot refills (the dispatch-amortized

@@ -73,15 +73,33 @@ def main(argv=None) -> int:
         quant_applied = "none"
 
     engine = SlotServer(cfg, params, slots=args.slots)
-    fe = ServingFrontend(engine, port=0, host="127.0.0.1",
-                         max_queue=args.queue_limit,
-                         decode_window=args.decode_window).start()
     rng = random.Random(args.seed)
     lens = [int(x) for x in args.prompt_lens.split(",")]
 
-    # warm every prefill bucket + the decode step so the measured load
-    # sees steady-state executables (compile stalls are a COLD-start
-    # property; serving pods prefill-warm at deploy readiness)
+    # warm the whole executable matrix the load will hit — batched
+    # admission (pow2 batch x bucket prefills) and the decode window —
+    # BEFORE the frontend's engine thread exists: exactly ONE thread
+    # may ever touch the donation-based engine (ingress.py contract),
+    # so warming after start() would race the engine thread on the
+    # donated cache
+    wrng = random.Random(1)
+    for n in sorted(set(lens)):
+        k = 1
+        while k <= args.slots:
+            batch = [{"prompt": [wrng.randrange(cfg.vocab_size)
+                                 for _ in range(n)],
+                      "max_new": 2, "request_id": (n, k, j)}
+                     for j in range(k)]
+            engine.submit_many(batch)
+            while engine.requests_active():
+                engine.step_many(args.decode_window)
+            engine.finished.clear()
+            k *= 2
+    fe = ServingFrontend(engine, port=0, host="127.0.0.1",
+                         max_queue=args.queue_limit,
+                         decode_window=args.decode_window).start()
+    # HTTP-path warmup (engine already warm; these ride the engine
+    # thread like real traffic)
     for n in sorted(set(lens)):
         prompt = [rng.randrange(cfg.vocab_size) for _ in range(n)]
         req = urllib.request.Request(
@@ -126,8 +144,12 @@ def main(argv=None) -> int:
         th.start()
         threads.append(th)
         offered += 1
+    # global drain deadline: a hung client (e.g. a mid-run tunnel
+    # failure) must not stall the receipt for 600 s PER thread
+    drain_deadline = time.time() + 300
     for th in threads:
-        th.join(timeout=600)
+        th.join(timeout=max(0.1, drain_deadline - time.time()))
+    hung = sum(1 for th in threads if th.is_alive())
     wall = time.perf_counter() - t_start
     stats = fe.stats()
     fe.stop()
@@ -146,6 +168,7 @@ def main(argv=None) -> int:
         "requests_offered": offered,
         "requests_completed": len(results),
         "rejected_503": rejected[0], "errors": errors[0],
+        "unfinished_at_drain_deadline": hung,
         "max_new": args.max_new,
         "throughput_tokens_per_sec": round(total_tokens / wall, 1),
         "latency_ms": _percentiles(lats),
