@@ -196,14 +196,29 @@ def test_endurance_churn_against_real_agents(tmp_path):
                 assert after <= before + 8, (
                     f"agent {agent.pid} fds {before} -> {after}")
             # sandbox accounting: every dir corresponds to a launched
-            # task id or a pod volume tree — nothing else may appear
-            for root in sandbox_roots:
-                if not root.exists():
-                    continue
-                for entry in root.iterdir():
-                    assert entry.name == "volumes" \
-                        or entry.name in launched_task_ids, (
-                            f"unaccounted sandbox dir {entry}")
+            # task id or a pod volume tree — nothing else may appear.
+            # launched_task_ids is SAMPLED from state between churn ops,
+            # so a task launched-and-replaced between polls can own a
+            # sandbox the sample missed (seen under heavy host load);
+            # re-poll ids with a short grace before calling it a leak.
+            def stray_sandbox():
+                for root in sandbox_roots:
+                    if not root.exists():
+                        continue
+                    for entry in root.iterdir():
+                        if entry.name != "volumes" \
+                                and entry.name not in launched_task_ids:
+                            return entry
+                return None
+
+            stray = stray_sandbox()
+            grace = time.time() + 10
+            while stray is not None and time.time() < grace:
+                for t in sched.state.fetch_tasks():
+                    launched_task_ids.add(t.task_id)
+                time.sleep(0.5)
+                stray = stray_sandbox()
+            assert stray is None, f"unaccounted sandbox dir {stray}"
             print(json.dumps({
                 "metric": "soak_native",
                 "minutes": minutes,
