@@ -85,6 +85,21 @@ RESNET_BATCH = 256  # fused-BN makes 256 the measured optimum on v5e
 N_TRIALS = 5
 
 
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    return raw in ("true", "yes", "on", "1")
+
+
+# Loss-head knobs, overridable per round without code edits so BENCH_*
+# rows stay comparable: BENCH_FUSED_CE toggles the fused linear-CE path
+# (default on — the production default), BENCH_GRAD_ACCUM microbatches
+# the train step (default 1 = off).
+LLAMA_FUSED_CE = _env_bool("BENCH_FUSED_CE", True)
+LLAMA_GRAD_ACCUM = max(1, int(os.environ.get("BENCH_GRAD_ACCUM", "1") or 1))
+
+
 def bench_resnet(jax, jnp, n_chips):
     from dcos_commons_tpu.models import resnet, train
 
@@ -138,14 +153,15 @@ def _llama_step_rate(jax, n_chips, batch, seq, remat, remat_policy,
     # full-model A/B is in docs/performance.md)
     cfg = llama.LlamaConfig.llama_400m(
         max_seq=seq, remat=remat, remat_policy=remat_policy,
-        attn_impl="auto")
+        attn_impl="auto", fused_ce=LLAMA_FUSED_CE)
     params = llama.init_params(cfg, jax.random.key(0))
     n_params = sum(x.size for x in jax.tree.leaves(params))
     toks = jax.random.randint(jax.random.key(1), (batch, seq), 0,
                               cfg.vocab_size)
     opt = train.make_optimizer(lr=3e-4, warmup=10, decay_steps=1000)
     step = train.make_train_step(
-        lambda p, b: llama.loss_fn(cfg, p, b), opt)
+        lambda p, b: llama.loss_fn(cfg, p, b), opt,
+        grad_accum=LLAMA_GRAD_ACCUM)
     opt_state = opt.init(params)
 
     params, opt_state, out = step(params, opt_state, toks)
@@ -180,6 +196,8 @@ def bench_llama(jax, jnp, n_chips):
     out = {
         "llama_train_tokens_per_sec_per_chip": round(tok_s, 1),
         "llama_spread": spread,
+        "llama_fused_ce": LLAMA_FUSED_CE,
+        "grad_accum": LLAMA_GRAD_ACCUM,
         "llama_params": n_params,
         "llama_model_flops_per_step": flops_per_step,
         "llama_flops_per_sec_chip": flops_per_sec_chip,

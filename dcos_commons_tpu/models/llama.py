@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dcos_commons_tpu.ops import (apply_rope, apply_rope_at,
+                                  fused_linear_cross_entropy,
                                   gqa_attention, repeat_kv,
                                   rms_norm, rope_frequencies,
                                   softmax_cross_entropy)
@@ -78,6 +79,16 @@ class LlamaConfig:
     # TPU when shapes are lane-aligned, else the dense path; flash
     # forces it; flash_interpret runs it in interpret mode (CPU tests)
     decode_attn: str = "auto"
+    # fused linear-cross-entropy on the train loss head
+    # (ops/losses.py): the lm_head projection runs inside the
+    # sequence-chunked loss loop, so the [B, S, V] fp32 logits tensor —
+    # ~4 GB of HBM traffic per step at B=8/S=1024/V=128256 — never
+    # materializes in either direction. Identical math; the off switch
+    # exists for A/B receipts and paranoia rollbacks.
+    fused_ce: bool = True
+    # sequence chunk of the fused loss: peak logits scratch is
+    # [B, fused_ce_block, V] fp32 (S need not divide it)
+    fused_ce_block: int = 512
 
     @property
     def head_dim(self) -> int:
@@ -345,13 +356,19 @@ def _maybe_checkpoint(fn, cfg: LlamaConfig):
 
 def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
             mesh: Optional[Mesh] = None,
-            positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+            positions: Optional[jnp.ndarray] = None,
+            return_hidden: bool = False) -> jnp.ndarray:
     """tokens [B, S] int32 -> logits [B, S, V] fp32.
 
     ``positions`` (optional [S] int32): the global position of each
     sequence slot, for layouts where slot != position (the zigzag ring
     layout) — rope reads the gathered table; attention impls that mask
     by position (ring) derive the same map from their layout.
+
+    ``return_hidden`` returns the final-norm hidden states [B, S, D]
+    instead of projecting through the lm_head — the fused-loss contract
+    (``loss_fn`` feeds them to ``fused_linear_cross_entropy`` so the
+    [B, S, V] logits tensor never materializes).
     """
     rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     if positions is not None:
@@ -367,6 +384,8 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     body = _maybe_checkpoint(layer, cfg)
     x, _ = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["norm"], cfg.norm_eps)
+    if return_hidden:
+        return _constrain(x, mesh, "dp", "sp", None)
     logits = qmm(x, params["lm_head"]).astype(jnp.float32)
     return _constrain(logits, mesh, "dp", "sp", None)
 
@@ -392,7 +411,8 @@ def pipeline_param_specs(cfg: LlamaConfig) -> Params:
 
 
 def forward_pipelined(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
-                      mesh: Mesh, n_micro: int) -> jnp.ndarray:
+                      mesh: Mesh, n_micro: int,
+                      return_hidden: bool = False) -> jnp.ndarray:
     """Pipeline-parallel forward (SURVEY.md §2.4 PP): the decoder trunk is
     stage-sharded over the ``pp`` mesh axis and microbatches stream through
     the GPipe fill/drain schedule (``parallel.pipeline``); embed / final
@@ -400,6 +420,8 @@ def forward_pipelined(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
 
     ``params`` must be in the :func:`stack_pipeline_params` layout with
     ``cfg.n_layers %% pp == 0`` and ``B %% n_micro == 0``.
+    ``return_hidden`` skips the lm_head (the fused-loss contract, as in
+    :func:`forward`).
     """
     from dcos_commons_tpu.parallel.pipeline import make_pipeline
 
@@ -420,11 +442,19 @@ def forward_pipelined(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     pipe = make_pipeline(mesh, stage_fn)
     x = pipe(params["layers"], xm).reshape(b, s, -1)
     x = rms_norm(x, params["norm"], cfg.norm_eps)
+    if return_hidden:
+        return x
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
 def loss_fn_pipelined(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                       mesh: Mesh, n_micro: int):
+    if cfg.fused_ce:
+        x = forward_pipelined(cfg, params, tokens[:, :-1], mesh, n_micro,
+                              return_hidden=True)
+        return fused_linear_cross_entropy(
+            x, params["lm_head"], tokens[:, 1:], z_loss=1e-4,
+            block_size=cfg.fused_ce_block)
     logits = forward_pipelined(cfg, params, tokens[:, :-1], mesh, n_micro)
     return softmax_cross_entropy(logits, tokens[:, 1:], z_loss=1e-4)
 
@@ -469,10 +499,14 @@ def moe_param_specs(cfg: LlamaConfig) -> Params:
 
 
 def forward_moe(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
-                mesh: Mesh, moe_cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                mesh: Mesh, moe_cfg,
+                return_hidden: bool = False
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """MoE decoder forward: attention as usual, FFN replaced by the GShard
     top-2 expert layer with all-to-all dispatch over ``ep``
-    (``parallel.moe``). Returns (logits, mean auxiliary load-balance loss).
+    (``parallel.moe``). Returns (logits, mean auxiliary load-balance loss);
+    ``return_hidden`` gives final-norm hidden states instead of logits
+    (the fused-loss contract, as in :func:`forward`).
     """
     from dcos_commons_tpu.parallel.moe import make_moe
 
@@ -496,12 +530,21 @@ def forward_moe(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
         _maybe_checkpoint(layer, cfg),
         (x, jnp.float32(0.0)), params["layers"])
     x = rms_norm(x, params["norm"], cfg.norm_eps)
+    if return_hidden:
+        return x, aux_sum / cfg.n_layers
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, aux_sum / cfg.n_layers
 
 
 def loss_fn_moe(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                 mesh: Mesh, moe_cfg, aux_weight: float = 0.01):
+    if cfg.fused_ce:
+        x, aux = forward_moe(cfg, params, tokens[:, :-1], mesh, moe_cfg,
+                             return_hidden=True)
+        loss, metric = fused_linear_cross_entropy(
+            x, params["lm_head"], tokens[:, 1:], z_loss=1e-4,
+            block_size=cfg.fused_ce_block)
+        return loss + aux_weight * aux, metric
     logits, aux = forward_moe(cfg, params, tokens[:, :-1], mesh, moe_cfg)
     loss, metric = softmax_cross_entropy(logits, tokens[:, 1:], z_loss=1e-4)
     return loss + aux_weight * aux, metric
@@ -515,17 +558,34 @@ def loss_fn(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     the layout order (the shift into input/target pairs happens FIRST,
     in natural order) — cross entropy is permutation-invariant under a
     consistent pairing, so the loss equals the natural-order loss while
-    the ring's causal work stays balanced."""
+    the ring's causal work stays balanced.
+
+    With ``cfg.fused_ce`` (the default) the lm_head projection runs
+    inside ``fused_linear_cross_entropy``'s sequence-chunked loop, so
+    the full [B, S, V] fp32 logits tensor never materializes — same
+    math, a fraction of the loss head's HBM traffic
+    (docs/performance.md "HBM traffic on the loss head")."""
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     if (cfg.attn_impl == "ring" and cfg.ring_layout == "zigzag"
             and mesh is not None):
         from dcos_commons_tpu.parallel.ring_attention import zigzag_indices
         perm = jnp.asarray(zigzag_indices(inputs.shape[1],
                                           mesh.shape["sp"]))
+        if cfg.fused_ce:
+            x = forward(cfg, params, inputs[:, perm], mesh,
+                        positions=perm, return_hidden=True)
+            return fused_linear_cross_entropy(
+                x, params["lm_head"], targets[:, perm], z_loss=1e-4,
+                block_size=cfg.fused_ce_block)
         logits = forward(cfg, params, inputs[:, perm], mesh,
                          positions=perm)
         return softmax_cross_entropy(logits, targets[:, perm],
                                      z_loss=1e-4)
+    if cfg.fused_ce:
+        x = forward(cfg, params, inputs, mesh, return_hidden=True)
+        return fused_linear_cross_entropy(
+            x, params["lm_head"], targets, z_loss=1e-4,
+            block_size=cfg.fused_ce_block)
     logits = forward(cfg, params, inputs, mesh)
     return softmax_cross_entropy(logits, targets, z_loss=1e-4)
 

@@ -11,7 +11,9 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import optax
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -27,22 +29,75 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                     mesh: Optional[Mesh] = None,
                     param_spec_tree: Any = None,
                     batch_spec: Any = P("dp"),
-                    has_aux_state: bool = False) -> Callable:
+                    has_aux_state: bool = False,
+                    grad_accum: int = 1) -> Callable:
     """Build a jitted ``step(params, opt_state, batch[, aux]) -> ...``.
 
     ``loss_fn(params, batch)`` -> scalar loss (or ``(loss, (metric, aux))``
     when ``has_aux_state`` — the ResNet BN-state pattern).
     With a mesh, params/opt-state are pinned to ``param_spec_tree`` and the
     batch to ``batch_spec`` so GSPMD never resolves shardings ambiguously.
+
+    ``grad_accum > 1`` microbatches the step: every batch leaf's leading
+    axis is split into ``grad_accum`` equal slices and a ``lax.scan``
+    runs backward passes sequentially, accumulating gradients in an
+    fp32 carry (donated across iterations by XLA's scan buffer reuse)
+    and applying ONE optimizer update on the average. Peak activation
+    memory is one microbatch's, so the HBM headroom the fused loss frees
+    converts into larger *effective* batch instead of OOM. Loss/metric
+    are microbatch means — identical to the unmicrobatched step whenever
+    per-microbatch token counts are equal (the unmasked LM case).
     """
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    if grad_accum > 1 and has_aux_state:
+        # BN-style aux threads state THROUGH the loss; sequential
+        # microbatches would see stale state mid-step. No caller needs
+        # the combination today — reject loudly rather than silently
+        # training on stale statistics.
+        raise NotImplementedError(
+            "grad_accum > 1 with has_aux_state is not supported")
+
+    def _grads_single(params, batch):
+        (loss, metric), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        return loss, metric, grads
+
+    def _grads_accum(params, batch):
+        def split(a):
+            if a.shape[0] % grad_accum:
+                raise ValueError(
+                    f"batch leading dim {a.shape[0]} not divisible by "
+                    f"grad_accum={grad_accum}")
+            return a.reshape((grad_accum, a.shape[0] // grad_accum)
+                             + a.shape[1:])
+
+        micro = jax.tree.map(split, batch)
+
+        def body(carry, mb):
+            gsum, lsum, msum = carry
+            loss, metric, grads = _grads_single(params, mb)
+            gsum = jax.tree.map(
+                lambda g, a: a + g.astype(jnp.float32), grads, gsum)
+            return (gsum, lsum + loss, msum + metric), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        zf = jnp.zeros((), jnp.float32)
+        (gsum, lsum, msum), _ = lax.scan(body, (zeros, zf, zf), micro)
+        grads = jax.tree.map(
+            lambda g, p: (g / grad_accum).astype(p.dtype), gsum, params)
+        return lsum / grad_accum, msum / grad_accum, grads
 
     def step(params, opt_state, batch):
         if has_aux_state:
             (loss, (metric, aux)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, batch)
+        elif grad_accum > 1:
+            loss, metric, grads = _grads_accum(params, batch)
+            aux = None
         else:
-            (loss, metric), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch)
+            loss, metric, grads = _grads_single(params, batch)
             aux = None
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

@@ -8,11 +8,12 @@ from dcos_commons_tpu.ops.norms import rms_norm, layer_norm
 from dcos_commons_tpu.ops.rotary import (rope_frequencies, apply_rope,
                                           apply_rope_at)
 from dcos_commons_tpu.ops.attention import gqa_attention, repeat_kv
-from dcos_commons_tpu.ops.losses import softmax_cross_entropy
+from dcos_commons_tpu.ops.losses import (fused_linear_cross_entropy,
+                                         softmax_cross_entropy)
 
 __all__ = [
     "rms_norm", "layer_norm",
     "rope_frequencies", "apply_rope", "apply_rope_at",
     "gqa_attention", "repeat_kv",
-    "softmax_cross_entropy",
+    "softmax_cross_entropy", "fused_linear_cross_entropy",
 ]

@@ -1,21 +1,45 @@
-"""Loss functions (fp32 reductions, optional z-loss stabilizer)."""
+"""Loss functions (fp32 reductions, optional z-loss stabilizer).
+
+Two cross-entropy entry points:
+
+* :func:`softmax_cross_entropy` — the reference: consumes materialized
+  logits ``[..., V]``. Fine for classifier heads (V ~ 1e3); at LM vocab
+  sizes the fp32 logits tensor dominates the train step's HBM traffic.
+* :func:`fused_linear_cross_entropy` — fuses the lm_head projection INTO
+  the loss: chunks the sequence, computes ``x_blk @ lm_head`` ->
+  blockwise logsumexp -> NLL inside a ``lax.scan``, with a
+  ``jax.custom_vjp`` backward that *recomputes* each block's logits from
+  the saved per-token logsumexp (the flash-attention recomputation idea
+  applied to the loss head — cf. ``ops/flash_attention.py``). The full
+  ``[B, S, V]`` fp32 tensor never exists in either direction; peak
+  scratch is one ``[B, block, V]`` tile.
+"""
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dcos_commons_tpu.ops.quant import QTensor, qmm
 
 
 def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray, *,
                           mask: Optional[jnp.ndarray] = None,
-                          z_loss: float = 0.0
-                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                          z_loss: float = 0.0,
+                          compute_accuracy: bool = True
+                          ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Mean token cross-entropy. logits [..., V], labels [...] int32.
 
     Returns (loss, accuracy). ``z_loss`` adds the usual log-Z^2 penalty that
     keeps bf16 logits from drifting (weight is typically 1e-4).
+    ``compute_accuracy=False`` returns (loss, None) and skips the full-vocab
+    argmax — a second full read of the logits tensor that loss-only callers
+    (evaluation loops that only track loss, the z-loss probe) never use.
     """
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
@@ -24,9 +48,175 @@ def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray, *,
     nll = logz - true_logit
     if z_loss:
         nll = nll + z_loss * logz ** 2
-    correct = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    correct = ((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+               if compute_accuracy else None)
     if mask is not None:
         m = mask.astype(jnp.float32)
         denom = jnp.maximum(m.sum(), 1.0)
-        return (nll * m).sum() / denom, (correct * m).sum() / denom
-    return nll.mean(), correct.mean()
+        return ((nll * m).sum() / denom,
+                (correct * m).sum() / denom if compute_accuracy else None)
+    return nll.mean(), correct.mean() if compute_accuracy else None
+
+
+# ---------------------------------------------------------------------------
+# fused linear + cross-entropy
+
+
+def _seq_blocks(a: jnp.ndarray, block: int) -> jnp.ndarray:
+    """[B, S, ...] -> [S/block, B, block, ...] (scan-major block stack)."""
+    b, s = a.shape[:2]
+    return a.reshape((b, s // block, block) + a.shape[2:]).swapaxes(0, 1)
+
+
+def _block_logits(xb: jnp.ndarray, w) -> jnp.ndarray:
+    """One block's logits in fp32: [B, blk, D] @ [D, V] -> [B, blk, V].
+    ``w`` may be a plain array or an int8 :class:`QTensor` (qmm fuses the
+    dequant into the weight load either way)."""
+    return qmm(xb, w).astype(jnp.float32)
+
+
+def _dx_block(dlog: jnp.ndarray, w, dtype) -> jnp.ndarray:
+    """dlogits [B, blk, V] -> dx [B, blk, D] against plain or quantized
+    ``w``, fp32 accumulation. Quantized: ``W.T == q.T * s_row``, so scale
+    the cotangent per vocab column and matmul the int8 payload — no
+    dequantized [D, V] copy."""
+    if isinstance(w, QTensor):
+        srow = jnp.squeeze(w.s, axis=-2).astype(jnp.float32)     # [V]
+        dx = (dlog * srow) @ w.q.astype(jnp.float32).T
+    else:
+        dx = dlog @ w.astype(jnp.float32).T
+    return dx.astype(dtype)
+
+
+def _fused_lce_impl(x, w, labels, maskf, z_loss, block, compute_acc):
+    """Forward: scan sequence blocks, accumulate masked NLL / correct
+    counts; returns (loss, acc, per-token logz [n, B, blk]) — logz is the
+    only O(S) residual the backward needs."""
+    xs = _seq_blocks(x, block)
+    ls = _seq_blocks(labels, block)
+    ms = _seq_blocks(maskf, block)
+
+    def body(carry, inp):
+        nll_sum, cor_sum = carry
+        xb, lb, mb = inp
+        logits = _block_logits(xb, w)                      # [B, blk, V]
+        logz = jax.nn.logsumexp(logits, axis=-1)           # [B, blk]
+        true_logit = jnp.take_along_axis(logits, lb[..., None],
+                                         axis=-1)[..., 0]
+        nll = logz - true_logit
+        if z_loss:
+            nll = nll + z_loss * logz ** 2
+        nll_sum = nll_sum + (nll * mb).sum()
+        if compute_acc:
+            correct = (jnp.argmax(logits, axis=-1) == lb)
+            cor_sum = cor_sum + (correct.astype(jnp.float32) * mb).sum()
+        return (nll_sum, cor_sum), logz
+
+    zero = jnp.zeros((), jnp.float32)
+    (nll_sum, cor_sum), logz = lax.scan(body, (zero, zero), (xs, ls, ms))
+    denom = jnp.maximum(maskf.sum(), 1.0)
+    return nll_sum / denom, cor_sum / denom, (logz, denom)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused_lce(x, w, labels, maskf, z_loss, block, compute_acc):
+    loss, acc, _ = _fused_lce_impl(x, w, labels, maskf, z_loss, block,
+                                   compute_acc)
+    return loss, acc
+
+
+def _fused_lce_fwd(x, w, labels, maskf, z_loss, block, compute_acc):
+    loss, acc, (logz, denom) = _fused_lce_impl(x, w, labels, maskf,
+                                               z_loss, block, compute_acc)
+    return (loss, acc), (x, w, labels, maskf, logz, denom)
+
+
+def _fused_lce_bwd(z_loss, block, compute_acc, res, g):
+    """Recompute each block's logits from the saved logsumexp (never the
+    full [B, S, V]); per-token cotangent is
+    ``p_j * (1 + 2*z*logz) - onehot_j`` scaled by ``g * mask / denom``.
+    The accuracy output's cotangent is dropped — argmax is
+    piecewise-constant, exactly as the unfused path's autodiff sees it.
+    """
+    x, w, labels, maskf, logz, denom = res
+    g_loss = g[0]
+    xs = _seq_blocks(x, block)
+    ls = _seq_blocks(labels, block)
+    ms = _seq_blocks(maskf, block)
+    plain_w = not isinstance(w, QTensor)
+    scale = (g_loss / denom).astype(jnp.float32)
+
+    def body(dw_acc, inp):
+        xb, lb, mb, lzb = inp
+        logits = _block_logits(xb, w)
+        p = jnp.exp(logits - lzb[..., None])               # softmax, f32
+        if z_loss:
+            p = p * (1.0 + (2.0 * z_loss) * lzb)[..., None]
+        dlog = p - jax.nn.one_hot(lb, logits.shape[-1], dtype=jnp.float32)
+        dlog = dlog * (scale * mb)[..., None]              # [B, blk, V]
+        dxb = _dx_block(dlog, w, x.dtype)
+        if plain_w:
+            dw_acc = dw_acc + jnp.einsum(
+                "bsd,bsv->dv", xb.astype(jnp.float32), dlog)
+        return dw_acc, dxb
+
+    dw0 = (jnp.zeros(w.shape, jnp.float32) if plain_w
+           else jnp.zeros((), jnp.float32))
+    dw_acc, dxs = lax.scan(body, dw0, (xs, ls, ms, logz))
+    dx = dxs.swapaxes(0, 1).reshape(x.shape)
+    if plain_w:
+        dw = dw_acc.astype(w.dtype)
+    else:
+        # int8 payloads carry no tangent space (float0); scales are
+        # treated as frozen calibration constants
+        dw = QTensor(np.zeros(w.q.shape, dtype=jax.dtypes.float0),
+                     jnp.zeros_like(w.s))
+    return (dx, dw,
+            np.zeros(labels.shape, dtype=jax.dtypes.float0),
+            jnp.zeros_like(maskf))
+
+
+_fused_lce.defvjp(_fused_lce_fwd, _fused_lce_bwd)
+
+
+def fused_linear_cross_entropy(x: jnp.ndarray, lm_head, labels: jnp.ndarray,
+                               *, mask: Optional[jnp.ndarray] = None,
+                               z_loss: float = 0.0, block_size: int = 512,
+                               compute_accuracy: bool = True
+                               ) -> Tuple[jnp.ndarray,
+                                          Optional[jnp.ndarray]]:
+    """Cross-entropy of ``x @ lm_head`` WITHOUT materializing the logits.
+
+    ``x`` [..., S, D] (final-norm hidden states), ``lm_head`` [D, V]
+    (plain array or int8 :class:`~dcos_commons_tpu.ops.quant.QTensor`),
+    ``labels`` [..., S] int32. Semantics match
+    ``softmax_cross_entropy(qmm(x, lm_head).astype(f32), labels, ...)``
+    exactly: masked mean NLL (+ z-loss) and argmax accuracy, but the
+    sequence is processed in ``block_size`` chunks so peak logits scratch
+    is ``[B, block_size, V]`` fp32 instead of ``[B, S, V]`` — at Llama-3
+    vocab (V=128256) that is the difference between ~4 GB and ~128 MB
+    per direction (docs/performance.md "HBM traffic on the loss head").
+
+    The backward recomputes per-block logits from the saved per-token
+    logsumexp (O(S) residual). Differentiable w.r.t. ``x`` and a plain
+    ``lm_head``; quantized heads get cotangent only through ``x``; the
+    mask is non-differentiable. ``S % block_size != 0`` is handled by
+    masked padding. Under a ``tp``-sharded lm_head the per-block
+    reductions partition over the vocab axis like the unfused loss did.
+    """
+    lead = x.shape[:-2]
+    s, d = x.shape[-2], x.shape[-1]
+    b = int(np.prod(lead)) if lead else 1
+    xf = x.reshape((b, s, d))
+    lab = labels.reshape((b, s))
+    maskf = (jnp.ones((b, s), jnp.float32) if mask is None
+             else mask.reshape((b, s)).astype(jnp.float32))
+    block = max(1, min(int(block_size), s))
+    pad = -s % block
+    if pad:
+        xf = jnp.pad(xf, ((0, 0), (0, pad), (0, 0)))
+        lab = jnp.pad(lab, ((0, 0), (0, pad)))
+        maskf = jnp.pad(maskf, ((0, 0), (0, pad)))   # pads never count
+    loss, acc = _fused_lce(xf, lm_head, lab, maskf, float(z_loss), block,
+                           bool(compute_accuracy))
+    return loss, (acc if compute_accuracy else None)

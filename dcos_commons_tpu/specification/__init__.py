@@ -4,4 +4,5 @@ from .spec import (ConfigFileSpec, DiscoverySpec, GoalState, HealthCheckSpec,
                    ReplacementFailurePolicy, ResourceSet, RLimitSpec,
                    SecretSpec, ServiceSpec, StepSpecEntry, TaskSpec, TpuSpec,
                    VolumeSpec, VolumeType, with_pod_count)
-from .yaml_loader import load_service_yaml, load_service_yaml_str, taskcfg_env
+from .yaml_loader import (load_service_yaml, load_service_yaml_str,
+                          taskcfg_env, yaml_bool)

@@ -64,11 +64,20 @@ TASKCFG_ALL_PREFIX = "TASKCFG_ALL_"
 TASKCFG_POD_PREFIX = "TASKCFG_"
 
 
-def _yaml_bool(value: Any) -> bool:
-    """Mustache-rendered booleans arrive as strings ('true'/'false')."""
+def yaml_bool(value: Any) -> bool:
+    """Mustache-rendered booleans arrive as strings ('true'/'false').
+
+    Public because task entry points share the convention: env knobs a
+    spec routes via ``TASKCFG_*`` (e.g. ``FUSED_CE``) land in the task's
+    environment as strings and must parse the same way the scheduler
+    parses spec booleans (``frameworks/jax/worker.py --fused-ce``).
+    """
     if isinstance(value, str):
         return value.strip().lower() in ("true", "yes", "1")
     return bool(value)
+
+
+_yaml_bool = yaml_bool  # internal alias (existing call sites)
 
 
 def load_service_yaml(path: str | os.PathLike,

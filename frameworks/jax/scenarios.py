@@ -50,6 +50,10 @@ DEFAULT_ENV: Mapping[str, str] = {
     "RING_LAYOUT": "zigzag",
     "SP": "0",
     "TP": "0",
+    # loss-head knobs (ops/losses.py fused linear-CE + models/train.py
+    # microbatching); overridable per-pod via TASKCFG_* like any env knob
+    "FUSED_CE": "true",
+    "GRAD_ACCUM": "1",
     # fetched into every task sandbox pre-launch (reference: resource.json
     # assets fetched by Mesos; in production the universe template overrides
     # this with the artifact URL). Default: the locally-built binary.

@@ -481,7 +481,8 @@ def run_llama_train(args) -> dict:
         # an incompatible layout must degrade, not crash-loop the gang
         ring_layout = "contiguous"
     cfg = llama.LlamaConfig.tiny(attn_impl=attn, max_seq=seq + 1,
-                                 ring_layout=ring_layout)
+                                 ring_layout=ring_layout,
+                                 fused_ce=_fused_ce(args))
     with mesh:
         params = llama.shard_params(
             llama.init_params(cfg, jax.random.key(0)), mesh, cfg)
@@ -497,6 +498,13 @@ def run_llama_train(args) -> dict:
         mesh_report, attn)
 
 
+def _fused_ce(args) -> bool:
+    """--fused-ce arrives as a mustache-rendered string ('true'/'false');
+    parse it exactly like the scheduler parses spec booleans."""
+    from dcos_commons_tpu.specification import yaml_bool
+    return yaml_bool(getattr(args, "fused_ce", "true"))
+
+
 def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
                       toks, mesh_report, attn_name):
     """Shared optimizer/compile/timed-loop/report tail of every llama-train
@@ -508,11 +516,19 @@ def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
     from dcos_commons_tpu.models import train
     from dcos_commons_tpu.parallel import checkpoint as ckpt
 
+    grad_accum = max(1, getattr(args, "grad_accum", 1))
+    if grad_accum > 1 and toks.shape[0] % grad_accum:
+        # degrade, don't crash-loop the gang: a grad-accum the batch
+        # doesn't divide into equal microbatches falls back to one pass
+        _emit({"event": "grad_accum_fallback",
+               "requested": grad_accum, "batch": int(toks.shape[0])})
+        grad_accum = 1
     with mesh:
         opt = train.make_optimizer(lr=1e-3, warmup=5,
                                    decay_steps=max(args.steps, 10))
         step = train.make_train_step(loss_fn, opt, mesh=mesh,
-                                     param_spec_tree=specs, batch_spec=None)
+                                     param_spec_tree=specs, batch_spec=None,
+                                     grad_accum=grad_accum)
         opt_state = train.init_opt_state(opt, params, mesh, specs)
         # compile/warmup on the freshly-initialized values; a resumed
         # run overwrites params/opt_state AFTER, so the warmup step does
@@ -561,6 +577,7 @@ def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
 
     seq = toks.shape[1] - 1
     return {"workload": "llama-train", "attn": attn_name, "seq": seq,
+            "fused_ce": bool(cfg.fused_ce), "grad_accum": grad_accum,
             "mesh": mesh_report, "final_loss": loss,
             "steps_run": steps_run,
             "tokens_per_sec": (round(
@@ -584,7 +601,8 @@ def _llama_train_pipelined(args, contract, n, divisor_at_most) -> dict:
     mesh = MeshSpec(dp=n // pp, pp=pp).build()
     seq = args.seq
     cfg = llama.LlamaConfig.tiny(attn_impl="dense", max_seq=seq + 1,
-                                 n_layers=max(4, pp * 2))
+                                 n_layers=max(4, pp * 2),
+                                 fused_ce=_fused_ce(args))
     n_micro = max(2, pp)
     params = llama.stack_pipeline_params(
         llama.init_params(cfg, jax.random.key(0)), pp)
@@ -615,7 +633,8 @@ def _llama_train_moe(args, contract, n, divisor_at_most) -> dict:
     seq = args.seq
     # expert count must be a multiple of ep or shard_map rejects the bank
     num_experts = ep * max(1, -(-4 // ep))
-    cfg = llama.LlamaConfig.tiny(attn_impl="dense", max_seq=seq + 1)
+    cfg = llama.LlamaConfig.tiny(attn_impl="dense", max_seq=seq + 1,
+                                 fused_ce=_fused_ce(args))
     moe_cfg = MoEConfig(num_experts=num_experts,
                         routing=args.moe_routing)
     params = llama.init_moe_params(cfg, num_experts, jax.random.key(0))
@@ -684,6 +703,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "== 0, else falls back to contiguous")
     p.add_argument("--seq", type=int, default=256,
                    help="llama-train: sequence length")
+    p.add_argument("--fused-ce", default=os.environ.get("FUSED_CE", "true"),
+                   help="llama-train: fused linear-cross-entropy loss head "
+                        "(ops/losses.py) — never materializes the "
+                        "[B, S, V] fp32 logits. true/false; mustache "
+                        "renders the spec's {{FUSED_CE}} env knob here, "
+                        "parsed like any spec boolean (yaml_bool)")
+    p.add_argument("--grad-accum", type=int,
+                   default=int(os.environ.get("GRAD_ACCUM", "1") or 1),
+                   help="llama-train: gradient-accumulation microbatches "
+                        "per optimizer step (models/train.py); 1 = off. "
+                        "Spec env knob {{GRAD_ACCUM}}. A value the batch "
+                        "isn't divisible by degrades to 1 (a bad config "
+                        "must not crash-loop the gang)")
     p.add_argument("--sp", type=int, default=0,
                    help="llama-train: sequence-parallel mesh size (0=auto)")
     p.add_argument("--tp", type=int, default=0,
