@@ -1,0 +1,47 @@
+"""Static-analysis engine: ServiceSpec/plan S-rules + jaxpr J-rules.
+
+The spec half (``lint_spec``) is dependency-light and runs at spec-load
+time, scheduler startup (fail-fast), and in the ``lint`` CLI verb. The
+jaxpr half (``lint_entrypoints``) imports jax lazily — tracing the
+registered hot paths is a CI-gate concern, not a scheduler-runtime one.
+
+Rule catalogue: docs/static-analysis.md (generated from the registry's
+code/title/fix-hint fields; ``python -m dcos_commons_tpu.analysis
+--list-rules`` prints the same table).
+"""
+
+from .findings import (Finding, REGISTRY, Rule, Severity, errors,
+                       filter_suppressed, render_report)
+from .spec_rules import lint_spec, lint_spec_file, topology_chip_count
+
+__all__ = [
+    "Finding", "REGISTRY", "Rule", "Severity", "errors",
+    "filter_suppressed", "render_report", "lint_spec", "lint_spec_file",
+    "topology_chip_count",
+    # lazy (import jax): walk_avals, lint_jaxpr, collective_census,
+    # lint_entrypoints, compute_census, load_manifest, save_manifest,
+    # HOT_PATHS
+]
+
+_JAXPR_EXPORTS = {
+    "walk_avals": "jaxpr_rules", "walk_eqns": "jaxpr_rules",
+    "lint_jaxpr": "jaxpr_rules", "collective_census": "jaxpr_rules",
+    "rule_j1_oversized_fp32": "jaxpr_rules",
+    "rule_j2_scan_widening": "jaxpr_rules",
+    "rule_j3_census_diff": "jaxpr_rules",
+    "rule_j4_host_callbacks": "jaxpr_rules",
+    "COLLECTIVE_PRIMS": "jaxpr_rules",
+    "lint_entrypoints": "entrypoints", "compute_census": "entrypoints",
+    "load_manifest": "entrypoints", "save_manifest": "entrypoints",
+    "HOT_PATHS": "entrypoints", "HotPath": "entrypoints",
+    "register_hot_path": "entrypoints", "MANIFEST_PATH": "entrypoints",
+}
+
+
+def __getattr__(name: str):
+    module = _JAXPR_EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(name)
+    import importlib
+    mod = importlib.import_module(f".{module}", __name__)
+    return getattr(mod, name)

@@ -1,0 +1,78 @@
+"""Maintenance CLI for the analysis engine.
+
+``python -m dcos_commons_tpu.analysis --list-rules`` prints the catalogue;
+``--update-manifest`` re-traces every entrypoint and rewrites
+``collective_manifest.json`` (do this ONLY for an intentional sharding
+change, and say why in the PR — the whole point of the census is that the
+diff is reviewed). Default action: lint all entrypoints against the
+checked-in manifest (the J-half of the CI gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _force_cpu_mesh() -> None:
+    """8 virtual CPU devices, same dance as tests/_jax_cpu.py (the mesh
+    entrypoints need >= 2 devices; backend selection is lazy, so this
+    works even though sitecustomize imported jax already)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dcos_commons_tpu.analysis",
+        description="jaxpr-rule engine maintenance")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    p.add_argument("--update-manifest", action="store_true",
+                   help="re-trace entrypoints, rewrite "
+                        "collective_manifest.json")
+    p.add_argument("--entrypoints", nargs="*", default=None,
+                   help="subset of registered entrypoints")
+    p.add_argument("--suppress", default="",
+                   help="comma-separated rule codes to suppress")
+    p.add_argument("--tpu", action="store_true",
+                   help="trace on the real backend instead of the "
+                        "8-device CPU mesh")
+    args = p.parse_args(argv)
+
+    from . import REGISTRY
+    if args.list_rules:
+        for rule in REGISTRY.all():
+            print(f"{rule.code}  [{rule.family}] {rule.title}\n"
+                  f"      fix: {rule.fix_hint}")
+        return 0
+
+    if not args.tpu:
+        _force_cpu_mesh()
+    from . import render_report
+    from .entrypoints import (compute_census, lint_entrypoints,
+                              save_manifest)
+    if args.update_manifest:
+        census = compute_census(args.entrypoints)
+        save_manifest(census)
+        for name, counts in census.items():
+            live = {k: v for k, v in counts.items() if v}
+            print(f"{name}: {live or 'no collectives'}")
+        print(f"manifest updated ({len(census)} entrypoints)")
+        return 0
+
+    suppress = {c for c in args.suppress.split(",") if c}
+    findings = lint_entrypoints(args.entrypoints, suppress=suppress)
+    print(render_report(findings, label="jaxpr-lint"))
+    from . import errors
+    return 1 if errors(findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,228 @@
+"""Registry of hot-path entrypoints the J-rules trace and gate.
+
+Each entry is a *recipe* for a jaxpr: trace the train/serve hot path via
+``jax.make_jaxpr`` on abstract shapes (no arrays allocated, no FLOPs run),
+so CI lints the program the compiler will see in seconds, on any host.
+Shapes are scaled so the failure class is unambiguous: the train
+entrypoints use a vocab big enough that a full [B, S, V] fp32 logits
+tensor is several times any legitimate fp32 intermediate — the budget sits
+between the two, so J1 cannot misfire on an embedding-sized gradient yet
+always fires on the materialization.
+
+The collective census baseline lives in ``collective_manifest.json`` next
+to this module; re-generate it with
+``python -m dcos_commons_tpu.analysis --update-manifest`` after an
+*intentional* sharding change.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .findings import Finding, Severity
+from .jaxpr_rules import collective_census, lint_jaxpr
+
+MANIFEST_PATH = os.path.join(os.path.dirname(__file__),
+                             "collective_manifest.json")
+
+
+@dataclass(frozen=True)
+class HotPath:
+    """One registered entrypoint: how to trace it + its J-rule budgets."""
+
+    name: str
+    build: Callable[[], "jax.core.ClosedJaxpr"]
+    budget_bytes: int        # J1/J2 fp32-aval ceiling
+    devices_needed: int = 1  # mesh entrypoints need a real device grid
+    description: str = ""
+    # capability probe: None = traceable, else the skip reason (e.g. the
+    # installed jax lacks shard_map; mirrors the tests' skipif markers)
+    requires: Callable[[], Optional[str]] = lambda: None
+
+
+HOT_PATHS: Dict[str, HotPath] = {}
+
+
+def register_hot_path(hot_path: HotPath) -> HotPath:
+    if hot_path.name in HOT_PATHS:
+        raise ValueError(f"duplicate entrypoint {hot_path.name}")
+    HOT_PATHS[hot_path.name] = hot_path
+    return hot_path
+
+
+# ---------------------------------------------------------------------------
+# entrypoint recipes
+
+# Train-shape constants: vocab >> dim so the logits materialization
+# dominates every legitimate fp32 aval by ~2x even at toy layer sizes.
+_TRAIN_B, _TRAIN_S, _TRAIN_VOCAB = 2, 65, 4096
+
+
+def _train_cfg(fused: bool):
+    from ..models import llama
+    return llama.LlamaConfig.tiny(
+        n_layers=2, vocab_size=_TRAIN_VOCAB, fused_ce=fused,
+        fused_ce_block=16)
+
+
+def _abstract_params(init_fn):
+    """Shapes of an init without allocating it (keys trace abstractly)."""
+    return jax.eval_shape(init_fn)
+
+
+def _trace_train_step(fused: bool):
+    from ..models import llama
+    cfg = _train_cfg(fused)
+    params = _abstract_params(
+        lambda: llama.init_params(cfg, jax.random.key(0)))
+    toks = jax.ShapeDtypeStruct((_TRAIN_B, _TRAIN_S), jnp.int32)
+
+    def grads(p, t):
+        return jax.value_and_grad(
+            lambda p_: llama.loss_fn(cfg, p_, t)[0])(p)
+
+    return jax.make_jaxpr(grads)(params, toks)
+
+
+def _trace_decode_step():
+    from ..models import llama
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    slots = 4
+    params = _abstract_params(
+        lambda: llama.init_params(cfg, jax.random.key(0)))
+    cache = _abstract_params(
+        lambda: llama.init_kv_cache(cfg, slots, cfg.max_seq))
+    lengths = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+
+    def step(p, c, ln, tok):
+        return llama.decode_step_slots(cfg, p, c, ln, tok)
+
+    return jax.make_jaxpr(step)(params, cache, lengths, tokens)
+
+
+def _trace_ring_attention():
+    from ..parallel.mesh import MeshSpec
+    from ..parallel.ring_attention import make_ring_attention
+    mesh = MeshSpec(sp=2).build(jax.devices()[:2])
+    attn = make_ring_attention(mesh, causal=True)
+    b, s, h, kv, d = 2, 64, 4, 2, 16
+    q = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+    k = jax.ShapeDtypeStruct((b, s, kv, d), jnp.bfloat16)
+    v = jax.ShapeDtypeStruct((b, s, kv, d), jnp.bfloat16)
+    return jax.make_jaxpr(attn)(q, k, v)
+
+
+# Budgets (fp32 bytes). Train: full logits = B x (S-1) x V x 4 =
+# 2 x 64 x 4096 x 4 = 2 MiB; the largest legitimate fp32 aval is the
+# embedding/lm_head gradient, V x D x 4 = 1 MiB. The fused budget sits
+# between: a re-materialized logits tensor trips J1, nothing else can.
+_FULL_LOGITS = _TRAIN_B * (_TRAIN_S - 1) * _TRAIN_VOCAB * 4
+_TRAIN_BUDGET = _FULL_LOGITS - 1
+
+register_hot_path(HotPath(
+    "llama_train_step_fused", lambda: _trace_train_step(True),
+    budget_bytes=_TRAIN_BUDGET,
+    description="value_and_grad of llama.loss_fn with the fused "
+                "linear-CE head (the PR 2 hot path)"))
+register_hot_path(HotPath(
+    "llama_train_step_unfused", lambda: _trace_train_step(False),
+    # the unfused A/B reference path materializes full logits on purpose
+    # (forward + backward); budget admits exactly that, nothing bigger
+    budget_bytes=2 * _FULL_LOGITS,
+    description="the unfused A/B loss head (known, budgeted "
+                "materialization)"))
+register_hot_path(HotPath(
+    "llama_decode_step", _trace_decode_step,
+    budget_bytes=1 << 20,
+    description="decode_step_slots, the continuous-batching serving "
+                "kernel (must stay collective-free off-mesh)"))
+register_hot_path(HotPath(
+    "ring_attention_fwd", _trace_ring_attention,
+    budget_bytes=1 << 20, devices_needed=2,
+    description="ring attention forward under shard_map on an sp=2 mesh "
+                "(ppermute ring is the expected collective)",
+    requires=lambda: None if hasattr(jax, "shard_map")
+    else "jax.shard_map unavailable in this jax build"))
+
+
+# ---------------------------------------------------------------------------
+# manifest + engine
+
+def load_manifest(path: str = MANIFEST_PATH) -> Dict[str, Dict[str, int]]:
+    with open(path) as f:
+        data = json.load(f)
+    return {name: {k: int(v) for k, v in counts.items()}
+            for name, counts in data.items()}
+
+
+def save_manifest(census: Mapping[str, Mapping[str, int]],
+                  path: str = MANIFEST_PATH) -> None:
+    with open(path, "w") as f:
+        json.dump({n: dict(c) for n, c in sorted(census.items())}, f,
+                  indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def _skip_reason(hot_path: HotPath) -> Optional[str]:
+    if len(jax.devices()) < hot_path.devices_needed:
+        return (f"needs {hot_path.devices_needed} devices, have "
+                f"{len(jax.devices())}")
+    return hot_path.requires()
+
+
+def compute_census(names: Optional[Iterable[str]] = None
+                   ) -> Dict[str, Dict[str, int]]:
+    """Trace each (traceable) entrypoint and count its collectives — the
+    ``--update-manifest`` producer and the round-trip test's subject."""
+    out = {}
+    for name in (names or sorted(HOT_PATHS)):
+        hp = HOT_PATHS[name]
+        if _skip_reason(hp) is not None:
+            continue
+        out[name] = collective_census(hp.build())
+    return out
+
+
+def lint_entrypoints(names: Optional[Iterable[str]] = None,
+                     manifest: Optional[Mapping[str, Mapping[str, int]]]
+                     = None,
+                     suppress: Optional[Iterable[str]] = None
+                     ) -> List[Finding]:
+    """Trace + J-lint every registered entrypoint (or ``names``).
+
+    Entrypoints needing more devices than the host has are reported as
+    INFO, never silently dropped — a silent skip would read as 'covered'
+    in CI logs."""
+    if manifest is None:
+        manifest = load_manifest()
+    findings: List[Finding] = []
+    for name in (names or sorted(HOT_PATHS)):
+        hp = HOT_PATHS[name]
+        reason = _skip_reason(hp)
+        if reason is not None:
+            findings.append(Finding(
+                "J0", Severity.INFO, name, f"skipped: {reason}"))
+            continue
+        jaxpr = hp.build()
+        # an entrypoint with no manifest entry gets no census diff (the
+        # baseline was never recorded — e.g. traced for the first time on
+        # a host whose jax supports it); say so rather than diffing
+        # against implicit zeros
+        expected = manifest.get(name)
+        if expected is None:
+            findings.append(Finding(
+                "J0", Severity.INFO, name,
+                "no collective-manifest entry; census not diffed (run "
+                "--update-manifest to record a baseline)"))
+        findings.extend(lint_jaxpr(
+            jaxpr, budget_bytes=hp.budget_bytes,
+            expected_collectives=expected,
+            location=name, suppress=suppress))
+    return findings

@@ -1,0 +1,104 @@
+"""Coded findings — the one shape every static-analysis result takes.
+
+Reference: the checkstyle/findbugs XML reports the Java SDK gates CI on
+(``gradle/checkstyle/``, ``gradle/findbugs/``); here a finding is a frozen
+value with a stable rule code, so suppressions, CI diffs, and docs all key
+off the same identifier (docs/static-analysis.md is the catalogue).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Iterable, Optional, Sequence
+
+
+class Severity(enum.Enum):
+    """ERROR fails CI / scheduler startup; WARNING prints; INFO is census."""
+
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+    @property
+    def fails(self) -> bool:
+        return self is Severity.ERROR
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation.
+
+    ``code``: stable rule id ("S3", "J1", ...). ``location``: where in the
+    linted artifact — a spec path ("pod worker/task train") or a jaxpr
+    entrypoint name ("llama_train_step/scan"). ``detail`` is free-form;
+    everything machines key on lives in the coded fields.
+    """
+
+    code: str
+    severity: Severity
+    location: str
+    message: str
+
+    def __str__(self) -> str:
+        return (f"{self.code} {self.severity.value} {self.location}: "
+                f"{self.message}")
+
+
+def filter_suppressed(findings: Iterable[Finding],
+                      suppress: Optional[Iterable[str]] = None
+                      ) -> list[Finding]:
+    """Drop findings whose rule code is suppressed (per-rule suppression;
+    the reference's findbugs-exclude.xml analogue)."""
+    dropped = frozenset(suppress or ())
+    return [f for f in findings if f.code not in dropped]
+
+
+def errors(findings: Iterable[Finding]) -> list[Finding]:
+    return [f for f in findings if f.severity.fails]
+
+
+def render_report(findings: Sequence[Finding], label: str = "analysis"
+                  ) -> str:
+    """Human report: one line per finding + a one-line summary (the shape
+    ``tools/lint.py`` aggregates across gates)."""
+    lines = [str(f) for f in findings]
+    n_err = len(errors(findings))
+    lines.append(f"{label}: {len(findings)} finding(s), {n_err} error(s)")
+    return "\n".join(lines)
+
+
+@dataclass(frozen=True)
+class Rule:
+    """Registry entry: code + which family runs it + the docs line."""
+
+    code: str
+    family: str            # "spec" | "jaxpr"
+    title: str
+    fix_hint: str
+    default_severity: Severity = Severity.ERROR
+
+
+class RuleRegistry:
+    """Rule catalogue; ``spec_rules.py`` / ``jaxpr_rules.py`` register at
+    import time, docs and ``--list-rules`` read it back."""
+
+    def __init__(self) -> None:
+        self._rules: dict[str, Rule] = {}
+
+    def register(self, rule: Rule) -> Rule:
+        if rule.code in self._rules:
+            raise ValueError(f"duplicate rule code {rule.code}")
+        self._rules[rule.code] = rule
+        return rule
+
+    def get(self, code: str) -> Rule:
+        return self._rules[code]
+
+    def all(self, family: Optional[str] = None) -> list[Rule]:
+        return sorted((r for r in self._rules.values()
+                       if family is None or r.family == family),
+                      key=lambda r: r.code)
+
+
+REGISTRY = RuleRegistry()

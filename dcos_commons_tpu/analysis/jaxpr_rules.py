@@ -1,0 +1,205 @@
+"""J-rules: static analysis of traced jaxprs on the hot paths.
+
+GSPMD-style programs fail *silently* into slow collectives or giant
+materializations — the compiled step still returns the right numbers, just
+at a fraction of the hardware's speed, so only a program-level diff catches
+the regression (the exact class PR 2 guarded with one ad-hoc jaxpr test in
+``tests/test_fused_ce.py``; this module is that test generalized into
+rules any entrypoint can share):
+
+J1  oversized fp32 aval: any float32 intermediate over the entrypoint's
+    byte budget (the [B, S, V] logits materialization class)
+J2  dtype widening inside a ``scan`` body producing an over-budget aval:
+    a widening convert inside the loop pays its HBM bill every iteration
+J3  collective census: counts of psum/all_gather/ppermute/reduce_scatter
+    diffed against a checked-in per-entrypoint manifest — a stray
+    all-gather on the decode path is a diff, not a vibe
+J4  host callback inside a jitted hot path: every call is a device->host
+    round-trip that stalls the step
+
+All rules walk the jaxpr structurally (``walk_avals`` / ``walk_eqns``
+recurse through scan/pjit/custom-vjp sub-jaxprs), so they hold on the CPU
+test mesh exactly as on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, List, Mapping, Optional
+
+import jax
+from jax.extend import core as jex_core
+
+from .findings import REGISTRY, Finding, Rule, Severity
+
+J1 = REGISTRY.register(Rule(
+    "J1", "jaxpr", "oversized fp32 intermediate over the byte budget",
+    "keep big tensors in bf16 or chunk the computation (the fused-CE "
+    "pattern); raise the entrypoint's budget only with a bench receipt"))
+J2 = REGISTRY.register(Rule(
+    "J2", "jaxpr", "dtype widening inside a scan body over the budget",
+    "hoist the widening out of the loop or narrow the accumulator; a "
+    "per-iteration fp32 blow-up multiplies by the scan length"))
+J3 = REGISTRY.register(Rule(
+    "J3", "jaxpr", "collective census drifted from the manifest",
+    "if the new collective is intentional, re-generate the manifest "
+    "(python -m dcos_commons_tpu.analysis --update-manifest) and justify "
+    "the diff in the PR; otherwise find the sharding that inserted it"))
+J4 = REGISTRY.register(Rule(
+    "J4", "jaxpr", "host callback inside a jitted hot path",
+    "remove debug/pure/io callbacks from the step function; log outside "
+    "the jit boundary"))
+
+#: collective primitives the census counts (order = report order)
+COLLECTIVE_PRIMS = ("psum", "all_gather", "ppermute", "reduce_scatter")
+_CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback")
+
+
+# ---------------------------------------------------------------------------
+# structural walkers
+
+def _sub_jaxprs(eqn) -> Iterator["jex_core.Jaxpr"]:
+    for p in eqn.params.values():
+        for sub in jax.tree.leaves(
+                p, is_leaf=lambda t: isinstance(t, jex_core.Jaxpr)):
+            inner = getattr(sub, "jaxpr", sub)
+            if isinstance(inner, jex_core.Jaxpr):
+                yield inner
+
+
+def walk_eqns(jaxpr, path: str = "") -> Iterator[tuple]:
+    """Yield ``(eqn, path)`` for every equation, recursing through
+    sub-jaxprs (scan/while/pjit/custom-vjp bodies); ``path`` names the
+    enclosing higher-order primitives, e.g. ``"scan/pjit"``."""
+    for eqn in jaxpr.eqns:
+        yield eqn, path
+        sub_path = f"{path}/{eqn.primitive.name}" if path \
+            else eqn.primitive.name
+        for inner in _sub_jaxprs(eqn):
+            yield from walk_eqns(inner, sub_path)
+
+
+def walk_avals(jaxpr) -> Iterator:
+    """Every output aval in the jaxpr tree — the shared J1 walker
+    (previously a private copy in ``tests/test_fused_ce.py``)."""
+    for eqn, _ in walk_eqns(jaxpr):
+        for v in eqn.outvars:
+            yield v.aval
+
+
+def _closed(jaxpr):
+    return getattr(jaxpr, "jaxpr", jaxpr)
+
+
+def _nbytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = dtype.itemsize
+    for d in shape:
+        if not isinstance(d, int):
+            return 0  # dynamic/polymorphic dim: size unknowable statically
+        n *= d
+    return n
+
+
+# ---------------------------------------------------------------------------
+# rules
+
+def rule_j1_oversized_fp32(jaxpr, budget_bytes: int,
+                           location: str = "") -> List[Finding]:
+    """fp32 avals above ``budget_bytes`` (generalizes the fused-CE
+    "no full [B, S, V] fp32 logits" test)."""
+    import jax.numpy as jnp
+    out = []
+    for aval in walk_avals(_closed(jaxpr)):
+        if getattr(aval, "dtype", None) == jnp.float32:
+            size = _nbytes(aval)
+            if size > budget_bytes:
+                out.append(Finding(
+                    "J1", Severity.ERROR, location,
+                    f"fp32 aval {tuple(aval.shape)} = {size} bytes exceeds "
+                    f"the {budget_bytes}-byte budget"))
+    return out
+
+
+def rule_j2_scan_widening(jaxpr, budget_bytes: int,
+                          location: str = "") -> List[Finding]:
+    out = []
+    for eqn, path in walk_eqns(_closed(jaxpr)):
+        if "scan" not in path.split("/"):
+            continue
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = eqn.invars[0].aval
+        dst = eqn.outvars[0].aval
+        src_dt = getattr(src, "dtype", None)
+        dst_dt = getattr(dst, "dtype", None)
+        if src_dt is None or dst_dt is None:
+            continue
+        if dst_dt.itemsize <= src_dt.itemsize:
+            continue
+        size = _nbytes(dst)
+        if size > budget_bytes:
+            out.append(Finding(
+                "J2", Severity.ERROR, f"{location}/{path}" if location
+                else path,
+                f"widening {src_dt.name}->{dst_dt.name} of "
+                f"{tuple(dst.shape)} = {size} bytes inside a scan body "
+                f"(budget {budget_bytes})"))
+    return out
+
+
+def collective_census(jaxpr) -> Dict[str, int]:
+    """Counts of each collective primitive in the jaxpr tree. Always
+    returns every key in :data:`COLLECTIVE_PRIMS` (zeros included) so the
+    manifest diff is total, not sparse."""
+    census = {name: 0 for name in COLLECTIVE_PRIMS}
+    for eqn, _ in walk_eqns(_closed(jaxpr)):
+        if eqn.primitive.name in census:
+            census[eqn.primitive.name] += 1
+    return census
+
+
+def rule_j3_census_diff(jaxpr, expected: Mapping[str, int],
+                        location: str = "") -> List[Finding]:
+    actual = collective_census(jaxpr)
+    out = []
+    for prim in COLLECTIVE_PRIMS:
+        want = int(expected.get(prim, 0))
+        got = actual[prim]
+        if got != want:
+            out.append(Finding(
+                "J3", Severity.ERROR, location,
+                f"collective census drift: {prim} x{got}, manifest says "
+                f"x{want}"))
+    return out
+
+
+def rule_j4_host_callbacks(jaxpr, location: str = "") -> List[Finding]:
+    out = []
+    for eqn, path in walk_eqns(_closed(jaxpr)):
+        name = eqn.primitive.name
+        if name in _CALLBACK_PRIMS or name.endswith("_callback"):
+            out.append(Finding(
+                "J4", Severity.ERROR,
+                f"{location}/{path}" if location and path else
+                (location or path),
+                f"host callback primitive {name!r} in a jitted hot path "
+                "(device->host sync every step)"))
+    return out
+
+
+def lint_jaxpr(jaxpr, *, budget_bytes: int,
+               expected_collectives: Optional[Mapping[str, int]] = None,
+               location: str = "",
+               suppress: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run every J-rule over one traced entrypoint."""
+    from .findings import filter_suppressed
+    findings = rule_j1_oversized_fp32(jaxpr, budget_bytes, location)
+    findings += rule_j2_scan_widening(jaxpr, budget_bytes, location)
+    if expected_collectives is not None:
+        findings += rule_j3_census_diff(jaxpr, expected_collectives,
+                                        location)
+    findings += rule_j4_host_callbacks(jaxpr, location)
+    return filter_suppressed(findings, suppress)

@@ -1,0 +1,327 @@
+"""S-rules: static analysis of ServiceSpec / plan graphs.
+
+These catch the spec mistakes that take down a TPU gang at deploy time
+rather than at review time: a plan-phase dependency cycle deadlocks the
+rollout forever (the DependencyStrategy simply never yields candidates), a
+mesh-axis product that doesn't divide the slice topology wedges
+``jax.distributed`` initialization across the whole gang, and two tasks
+pinning the same static port crash-loop whichever lands second.
+
+``lint_spec`` is the one entry point; it also *promotes* the existing
+stringly ``spec.validate()`` errors into coded ``S0`` findings so every
+spec problem — old or new — arrives in one shape (code + severity +
+location) that CI, the CLI, and scheduler startup all share.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Mapping, Optional, Set, Tuple
+
+from ..specification.spec import PodSpec, ServiceSpec
+from .findings import REGISTRY, Finding, Rule, Severity
+
+S0 = REGISTRY.register(Rule(
+    "S0", "spec", "spec validation error (promoted spec.validate())",
+    "fix the spec field the message names; these are the dataclass-level "
+    "invariants from specification/spec.py"))
+S1 = REGISTRY.register(Rule(
+    "S1", "spec", "plan-phase dependency cycle",
+    "break the cycle in the phases' depends: lists — a cyclic "
+    "DependencyStrategy never releases any phase"))
+S2 = REGISTRY.register(Rule(
+    "S2", "spec", "plan-phase dependency on unknown phase",
+    "name an existing phase of the same plan in depends: (unknown names "
+    "are silently never satisfied or silently ignored)"))
+S3 = REGISTRY.register(Rule(
+    "S3", "spec", "TPU gang shape does not divide the slice topology",
+    "make (count/slices) x chips divide the topology's chip count, or fix "
+    "tpu.topology"))
+S4 = REGISTRY.register(Rule(
+    "S4", "spec", "static port collision across tasks",
+    "give each concurrently-running task its own static port, or use "
+    "port: 0 for matcher-assigned dynamic ports",
+    default_severity=Severity.ERROR))
+S5 = REGISTRY.register(Rule(
+    "S5", "spec", "unrendered {{placeholder}} in task cmd/env",
+    "the template env never defined this variable — add it to the "
+    "package defaults or remove the reference"))
+S6 = REGISTRY.register(Rule(
+    "S6", "spec", "mesh-axis product inconsistent with gang chips",
+    "make the task's DP/SP/TP/EP env product divide the gang's total "
+    "chips (chips-per-host x hosts-per-slice)"))
+
+_PLACEHOLDER = re.compile(r"\{\{\s*([A-Za-z0-9_.-]+)\s*\}\}")
+
+
+# ---------------------------------------------------------------------------
+# topology arithmetic
+
+def topology_chip_count(topology: str) -> Optional[int]:
+    """Chip count implied by a topology string.
+
+    ``"4x4x4"`` -> 64 (mesh shape product). ``"v4-32"`` -> 32, the agent
+    inventory convention (``testing/simulation.py`` advertises ``v4-16`` as
+    4 hosts x 4 chips). Unparseable strings return None — the matcher
+    treats topology as an opaque consistency label, so the linter must not
+    guess."""
+    t = topology.strip().lower()
+    if re.fullmatch(r"\d+(x\d+)+", t):
+        chips = 1
+        for part in t.split("x"):
+            chips *= int(part)
+        return chips
+    m = re.fullmatch(r"v\d+[a-z]*-(\d+)", t)
+    if m:
+        return int(m.group(1))
+    return None
+
+
+def _gang_chips(pod: PodSpec) -> Tuple[int, int]:
+    """(chips per slice group, hosts per slice group) for a gang pod."""
+    tpu = pod.tpu
+    hosts = pod.count // max(1, tpu.slices)
+    return hosts * tpu.chips, hosts
+
+
+# ---------------------------------------------------------------------------
+# individual rules (each: spec -> findings)
+
+def _rule_s0_promoted_validate(spec: ServiceSpec) -> List[Finding]:
+    return [Finding("S0", Severity.ERROR, f"service {spec.name}", msg)
+            for msg in spec.validate()]
+
+
+def _phase_dep_graph(plan) -> Dict[str, Tuple[str, ...]]:
+    return {ph.name: tuple(ph.deps) for ph in plan.phases}
+
+
+def _find_cycle(graph: Dict[str, Tuple[str, ...]]) -> Optional[List[str]]:
+    """First dependency cycle as a name path, or None (iterative DFS)."""
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    for root in graph:
+        if color[root] != WHITE:
+            continue
+        stack: List[Tuple[str, int]] = [(root, 0)]
+        path: List[str] = []
+        while stack:
+            node, edge = stack.pop()
+            if edge == 0:
+                color[node] = GREY
+                path.append(node)
+            deps = [d for d in graph.get(node, ()) if d in graph]
+            if edge < len(deps):
+                stack.append((node, edge + 1))
+                nxt = deps[edge]
+                if color[nxt] == GREY:
+                    return path[path.index(nxt):] + [nxt]
+                if color[nxt] == WHITE:
+                    stack.append((nxt, 0))
+            else:
+                color[node] = BLACK
+                path.pop()
+    return None
+
+
+def _rule_s1_s2_plan_dag(spec: ServiceSpec) -> List[Finding]:
+    out: List[Finding] = []
+    for plan in spec.plans:
+        names = {ph.name for ph in plan.phases}
+        graph = _phase_dep_graph(plan)
+        for ph in plan.phases:
+            for dep in ph.deps:
+                if dep not in names:
+                    out.append(Finding(
+                        "S2", Severity.ERROR,
+                        f"plan {plan.name}/phase {ph.name}",
+                        f"depends on unknown phase {dep!r} "
+                        f"(known: {', '.join(sorted(names))})"))
+                elif dep == ph.name:
+                    out.append(Finding(
+                        "S1", Severity.ERROR,
+                        f"plan {plan.name}/phase {ph.name}",
+                        "depends on itself"))
+        cycle = _find_cycle(graph)
+        if cycle and len(cycle) > 2:  # self-loop already reported as S1
+            out.append(Finding(
+                "S1", Severity.ERROR, f"plan {plan.name}",
+                "phase dependency cycle: " + " -> ".join(cycle)))
+    return out
+
+
+def _rule_s3_topology(spec: ServiceSpec) -> List[Finding]:
+    out: List[Finding] = []
+    for pod in spec.pods:
+        tpu = pod.tpu
+        if tpu is None or not tpu.topology or tpu.chips <= 0:
+            continue
+        topo_chips = topology_chip_count(tpu.topology)
+        if topo_chips is None:
+            continue  # opaque label; matcher-only semantics
+        gang_chips, hosts = _gang_chips(pod)
+        if gang_chips > topo_chips:
+            out.append(Finding(
+                "S3", Severity.ERROR, f"pod {pod.type}",
+                f"gang wants {gang_chips} chips ({hosts} hosts x "
+                f"{tpu.chips}) but topology {tpu.topology} has only "
+                f"{topo_chips}"))
+        elif topo_chips % gang_chips != 0:
+            out.append(Finding(
+                "S3", Severity.ERROR, f"pod {pod.type}",
+                f"gang chips {gang_chips} ({hosts} hosts x {tpu.chips}) "
+                f"do not divide topology {tpu.topology} "
+                f"({topo_chips} chips) — the slice cannot be tiled"))
+    return out
+
+
+def _rule_s4_port_collisions(spec: ServiceSpec) -> List[Finding]:
+    """Static (nonzero) port declared twice.
+
+    Within one pod, tasks of *different* resource sets may run on the same
+    host concurrently -> ERROR. Tasks sharing a resource set run one at a
+    time (the sidecar pattern), so sharing a port there is legal. Across
+    pods the tasks collide only if the matcher co-locates them -> WARNING.
+    """
+    out: List[Finding] = []
+    by_port: Dict[int, List[Tuple[str, str]]] = {}  # port -> [(pod, rs)]
+    for pod in spec.pods:
+        seen_in_pod: Dict[int, str] = {}
+        for rs in pod.resource_sets:
+            for p in rs.ports:
+                if p.port == 0:
+                    continue
+                prev_rs = seen_in_pod.get(p.port)
+                if prev_rs is not None and prev_rs != rs.id:
+                    out.append(Finding(
+                        "S4", Severity.ERROR, f"pod {pod.type}",
+                        f"static port {p.port} declared by resource sets "
+                        f"{prev_rs!r} and {rs.id!r} — concurrent tasks "
+                        "on one host will collide"))
+                seen_in_pod.setdefault(p.port, rs.id)
+                by_port.setdefault(p.port, []).append((pod.type, rs.id))
+    for port, holders in by_port.items():
+        pods_holding = sorted({pod for pod, _ in holders})
+        if len(pods_holding) > 1:
+            out.append(Finding(
+                "S4", Severity.WARNING, f"pods {', '.join(pods_holding)}",
+                f"static port {port} declared by multiple pods; they "
+                "cannot co-locate on one host"))
+    return out
+
+
+def _rule_s5_placeholders(spec: ServiceSpec) -> List[Finding]:
+    """`{{X}}` surviving into a task cmd/env means the template env never
+    defined X — at launch the shell sees the literal braces. Port env
+    names and task env keys are the runtime-substituted vocabulary the
+    bootstrap renderer knows; anything else is dead."""
+    out: List[Finding] = []
+    for pod in spec.pods:
+        runtime_vars: Set[str] = set()
+        for rs in pod.resource_sets:
+            for p in rs.ports:
+                runtime_vars.add(p.env_name)
+        for task in pod.tasks:
+            known = runtime_vars | set(task.env)
+            for where, text in (("cmd", task.cmd),
+                                *((f"env[{k}]", v)
+                                  for k, v in task.env.items())):
+                for name in _PLACEHOLDER.findall(text or ""):
+                    if name not in known:
+                        out.append(Finding(
+                            "S5", Severity.ERROR,
+                            f"pod {pod.type}/task {task.name}/{where}",
+                            f"undefined placeholder {{{{{name}}}}} — "
+                            "nothing will substitute it at launch"))
+    return out
+
+
+_MESH_AXIS_ENV = ("DP", "PP", "SP", "TP", "EP")
+
+
+def _rule_s6_mesh_product(spec: ServiceSpec) -> List[Finding]:
+    """Tasks that declare mesh-axis sizes via env (the frameworks/jax
+    convention: SP/TP/... knobs routed into worker flags) must form a
+    product that divides the gang's chips, or ``MeshSpec.build`` dies on
+    every member at once. Axis values of 0 mean 'auto' and are skipped."""
+    out: List[Finding] = []
+    for pod in spec.pods:
+        if pod.tpu is None or pod.tpu.chips <= 0:
+            continue
+        gang_chips, _ = _gang_chips(pod)
+        for task in pod.tasks:
+            product = 1
+            named = []
+            for axis in _MESH_AXIS_ENV:
+                try:
+                    size = int(task.env.get(axis, "0"))
+                except ValueError:
+                    continue
+                if size > 1:
+                    product *= size
+                    named.append(f"{axis.lower()}={size}")
+            if product > 1 and gang_chips % product != 0:
+                out.append(Finding(
+                    "S6", Severity.ERROR,
+                    f"pod {pod.type}/task {task.name}",
+                    f"mesh-axis product {product} ({', '.join(named)}) "
+                    f"does not divide the gang's {gang_chips} chips"))
+    return out
+
+
+_SPEC_RULES = (
+    _rule_s0_promoted_validate,
+    _rule_s1_s2_plan_dag,
+    _rule_s3_topology,
+    _rule_s4_port_collisions,
+    _rule_s5_placeholders,
+    _rule_s6_mesh_product,
+)
+
+
+def lint_spec(spec: ServiceSpec,
+              suppress: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run every S-rule over a spec; returns findings (suppression applied,
+    ERRORs first so CI logs lead with what failed)."""
+    from .findings import filter_suppressed
+    findings: List[Finding] = []
+    for rule_fn in _SPEC_RULES:
+        findings.extend(rule_fn(spec))
+    findings = filter_suppressed(findings, suppress)
+    findings.sort(key=lambda f: (f.severity is not Severity.ERROR, f.code))
+    return findings
+
+
+def lint_spec_file(path: str, env: Optional[Mapping[str, str]] = None,
+                   suppress: Optional[Iterable[str]] = None
+                   ) -> List[Finding]:
+    """Lint a service YAML *file* without the loader's raise-on-invalid:
+    template and validation failures come back as coded findings (S5/S0)
+    instead of exceptions, so `tpuctl lint` can report every problem in
+    one pass."""
+    import os as _os
+
+    import yaml as _yaml
+
+    from ..specification import yaml_loader
+    from ..utils.template import TemplateError, render_template
+    env = dict(env if env is not None else {})
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return [Finding("S0", Severity.ERROR, path, f"unreadable: {e}")]
+    try:
+        rendered = render_template(text, env, strict=True)
+    except TemplateError as e:
+        return [Finding(
+            "S5", Severity.ERROR, path,
+            f"template does not render: {e} (pass the missing variable "
+            "via --env or the framework's package defaults)")]
+    try:
+        raw = _yaml.safe_load(rendered)
+        spec = yaml_loader._map_raw(raw, env, _os.path.dirname(path))
+    except Exception as e:  # structural YAML/mapping failure
+        return [Finding("S0", Severity.ERROR, path,
+                        f"spec does not parse: {e}")]
+    return lint_spec(spec, suppress)

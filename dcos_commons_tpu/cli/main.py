@@ -255,6 +255,117 @@ def _health_cmd(client: Client, args) -> int:
     return _emit(*client.get("health"))
 
 
+# -- static analysis (analysis/: S-rules over specs, J-rules over jaxprs) --
+
+def _framework_default_env(path: str) -> dict:
+    """``frameworks/<fw>/dist/x.yml`` -> that framework's ``DEFAULT_ENV``
+    package defaults (the CosmosRenderer analogue), so linting a shipped
+    spec needs no hand-assembled env. {} when the file lives elsewhere."""
+    import importlib
+    parts = os.path.abspath(path).split(os.sep)
+    if "frameworks" not in parts:
+        return {}
+    i = parts.index("frameworks")
+    if i + 1 >= len(parts):
+        return {}
+    fw = parts[i + 1]
+    for mod_name in (f"frameworks.{fw}.scenarios", f"frameworks.{fw}.main"):
+        try:
+            mod = importlib.import_module(mod_name)
+        except Exception:
+            continue
+        env = getattr(mod, "DEFAULT_ENV", None)
+        if env:
+            return dict(env)
+    # import-free fallback: some framework mains need optional deps
+    # (e.g. cryptography) just to import; DEFAULT_ENV is always a literal
+    # dict, so read it straight out of the AST
+    fw_main = os.path.join(os.sep.join(parts[:i + 2]), "main.py")
+    return _default_env_from_source(fw_main)
+
+
+def _default_env_from_source(path: str) -> dict:
+    import ast
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return {}
+    for node in tree.body:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AnnAssign)
+                   else [])
+        for target in targets:
+            if (isinstance(target, ast.Name)
+                    and target.id == "DEFAULT_ENV"
+                    and isinstance(node.value, ast.Dict)):
+                env = {}
+                for k_node, v_node in zip(node.value.keys,
+                                          node.value.values):
+                    try:
+                        key = ast.literal_eval(k_node)
+                    except (ValueError, TypeError):
+                        continue
+                    try:
+                        env[str(key)] = str(ast.literal_eval(v_node))
+                    except (ValueError, TypeError):
+                        # computed value (e.g. a path built at import
+                        # time); the key existing is what rendering needs
+                        env[str(key)] = ""
+                # launch-time derived keys (merged["CASSANDRA_SEEDS"] =
+                # ... and friends) are part of the render env too
+                for sub in ast.walk(tree):
+                    if (isinstance(sub, ast.Assign)
+                            and len(sub.targets) == 1
+                            and isinstance(sub.targets[0], ast.Subscript)
+                            and isinstance(sub.targets[0].slice,
+                                           ast.Constant)
+                            and isinstance(sub.targets[0].slice.value,
+                                           str)):
+                        env.setdefault(sub.targets[0].slice.value, "")
+                return env
+    return {}
+
+
+def _lint_cmd(client: Client, args) -> int:
+    """``tpuctl lint [FILES...]``: S-rules over spec files (or the live
+    scheduler's target config when no files are given); ``--jaxpr`` adds
+    the J-rules over the registered hot-path entrypoints. Exit 0 = no
+    ERROR findings; every finding prints as ``CODE severity loc: msg``."""
+    import dataclasses as _dc
+
+    from ..analysis import (errors, lint_spec, lint_spec_file,
+                            render_report)
+    suppress = {c for c in (args.suppress or "").split(",") if c}
+    findings = []
+    if args.files:
+        for path in args.files:
+            env = _framework_default_env(path)
+            env.update(os.environ)
+            for pair in args.env or ():
+                key, _, value = pair.partition("=")
+                env[key] = value
+            findings.extend(
+                f if f.location.startswith(path)
+                else _dc.replace(f, location=f"{path}: {f.location}")
+                for f in lint_spec_file(path, env, suppress=suppress))
+    else:
+        from ..specification.spec import ServiceSpec
+        code, payload = client.get("configurations/target")
+        if code >= 400:
+            print(json.dumps(payload))
+            return 2
+        spec = ServiceSpec.from_json(json.dumps(payload))
+        findings.extend(lint_spec(spec, suppress=suppress))
+    if args.jaxpr:
+        from ..analysis.__main__ import _force_cpu_mesh
+        from ..analysis.entrypoints import lint_entrypoints
+        _force_cpu_mesh()
+        findings.extend(lint_entrypoints(suppress=suppress))
+    print(render_report(findings, label="lint"))
+    return 1 if errors(findings) else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpuctl", description="Operator CLI for a TPU-SDK scheduler")
@@ -332,6 +443,24 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("health", help="scheduler health").set_defaults(
         fn=_health_cmd)
+
+    lint = sub.add_parser(
+        "lint", help="static-analyze service specs (S-rules) and "
+                     "hot-path jaxprs (J-rules)")
+    lint.add_argument("files", nargs="*",
+                      help="service YAML files (default: lint the live "
+                           "scheduler's target configuration)")
+    lint.add_argument("--env", action="append", metavar="KEY=VALUE",
+                      help="template variable override (repeatable; "
+                           "framework package defaults + process env "
+                           "apply first)")
+    lint.add_argument("--suppress", default="", metavar="CODES",
+                      help="comma-separated rule codes to suppress "
+                           "(e.g. S4,J2)")
+    lint.add_argument("--jaxpr", action="store_true",
+                      help="also trace + lint the registered hot-path "
+                           "entrypoints (slower; imports jax)")
+    lint.set_defaults(fn=_lint_cmd)
     return p
 
 

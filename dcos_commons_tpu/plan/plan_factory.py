@@ -18,7 +18,7 @@ from .backoff import Backoff
 from .elements import DeploymentStep, Phase, Plan
 from .requirement import PodInstanceRequirement
 from .status import Status
-from .strategy import strategy_for
+from .strategy import DependencyStrategy, strategy_for
 
 DEPLOY_PLAN = "deploy"
 UPDATE_PLAN = "update"
@@ -130,4 +130,12 @@ def build_plan_from_spec(spec: ServiceSpec, plan_spec: PlanSpecModel,
                 steps.append(_make_step(PodInstance(pod, index), task_names,
                                         state_store, target_config_id, backoff))
         phases.append(Phase(phase_spec.name, steps, strategy_for(phase_spec.strategy)))
-    return Plan(plan_spec.name, phases, strategy_for(plan_spec.strategy))
+    if any(ph.deps for ph in plan_spec.phases):
+        # YAML `depends:` lists -> DAG ordering over phases (reference
+        # DependencyStrategyHelper). Cycles/unknown names never release
+        # their phases; the analysis engine rejects them up front (S1/S2).
+        strategy = DependencyStrategy(
+            {ph.name: ph.deps for ph in plan_spec.phases})
+    else:
+        strategy = strategy_for(plan_spec.strategy)
+    return Plan(plan_spec.name, phases, strategy)

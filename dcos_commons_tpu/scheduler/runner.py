@@ -34,10 +34,28 @@ class CycleDriver:
         self._wake = threading.Event()
 
     def start(self) -> "CycleDriver":
+        self._fail_fast_on_spec_errors()
         self._thread = threading.Thread(target=self._loop,
                                         name="scheduler-cycles", daemon=True)
         self._thread.start()
         return self
+
+    def _fail_fast_on_spec_errors(self) -> None:
+        """Refuse to drive a service whose spec has ERROR-level S-rule
+        findings (plan cycles, gang/topology mismatches, ...): a deploy
+        that can never converge should die at startup, not spin. Only
+        single-service schedulers expose ``.spec``; multi-service children
+        are linted by their own driver-less ``add_service`` path."""
+        spec = getattr(self.scheduler, "spec", None)
+        if spec is None:
+            return
+        from ..analysis import errors, lint_spec
+        bad = errors(lint_spec(spec))
+        if bad:
+            lines = "\n".join(str(f) for f in bad)
+            raise ValueError(
+                f"service spec fails static analysis "
+                f"({len(bad)} error(s)):\n{lines}")
 
     def poke(self) -> None:
         """Run a cycle soon (new work arrived; reference revive analogue)."""

@@ -517,6 +517,10 @@ class PhaseSpec:
     pod_type: str
     strategy: str = "serial"
     steps: tuple[StepSpecEntry, ...] = ()  # empty => one step per pod instance
+    # phases of the SAME plan that must be COMPLETE before this one starts
+    # (YAML `depends:`; reference DependencyStrategyHelper DAG plans).
+    # Cycles/unknown names are rejected by the analysis engine (S1/S2).
+    deps: tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -637,6 +641,7 @@ def _service_from_dict(data: Mapping[str, Any]) -> ServiceSpec:
                             StepSpecEntry(pod_instance=s["pod_instance"],
                                           tasks=tuple(s["tasks"]))
                             for s in ph.get("steps", ())),
+                        deps=tuple(ph.get("deps", ())),
                     )
                     for ph in pl.get("phases", ())
                 ),

@@ -370,11 +370,15 @@ def _map_plans(raw: Mapping[str, Any]) -> tuple[PlanSpecModel, ...]:
                     steps.append(StepSpecEntry(
                         pod_instance=idx,
                         tasks=tuple(tasks) if isinstance(tasks, (list, tuple)) else (tasks,)))
+            depends = phase_raw.get("depends") or ()
+            if isinstance(depends, str):
+                depends = (depends,)
             phases.append(PhaseSpec(
                 name=phase_name,
                 pod_type=phase_raw["pod"],
                 strategy=str(phase_raw.get("strategy", "serial")).lower(),
                 steps=tuple(steps),
+                deps=tuple(depends),
             ))
         plans.append(PlanSpecModel(
             name=plan_name,

@@ -8,11 +8,8 @@ cd "$(dirname "$0")"
 echo "== native build =="
 make -C native
 
-echo "== static analysis =="
-python -m tools.static_check
-
-echo "== type check =="
-python -m tools.type_check
+echo "== lint gate (static_check + type_check + airgap + spec S-rules + jaxpr J-rules) =="
+python -m tools.lint
 
 echo "== test suite =="
 python -m pytest tests/ -q -m "not soak" "$@"
@@ -25,9 +22,6 @@ if [[ "${TPU_SOAK:-}" == "1" ]]; then
     python -m pytest tests/test_soak.py tests/test_soak_native.py \
         -m soak -q -s
 fi
-
-echo "== airgap lint =="
-python -m tools.airgap_linter frameworks/*/
 
 echo "== package bundles =="
 for universe in frameworks/*/universe; do

@@ -1,0 +1,470 @@
+"""Tier-1 tests for the static-analysis engine (analysis/).
+
+S-rules get a bad spec + a clean spec each; J-rules run against small
+synthetic jitted functions on the virtual 8-device CPU mesh; the
+collective manifest is round-tripped and checked against a live trace;
+and the two injected regressions from the issue are exercised end to end
+(unfused loss head under the fused budget -> J1; an all_gather smuggled
+into the decode step -> J3 census diff).
+"""
+
+import tests._jax_cpu  # noqa: F401  (8 CPU devices before first jax use)
+
+import dataclasses
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dcos_commons_tpu.analysis import (REGISTRY, Finding, Severity, errors,
+                                       filter_suppressed, lint_spec,
+                                       lint_spec_file, render_report,
+                                       topology_chip_count)
+from dcos_commons_tpu.analysis import entrypoints as eps
+from dcos_commons_tpu.analysis.jaxpr_rules import (collective_census,
+                                                   lint_jaxpr,
+                                                   rule_j1_oversized_fp32,
+                                                   rule_j2_scan_widening,
+                                                   rule_j3_census_diff,
+                                                   rule_j4_host_callbacks)
+from dcos_commons_tpu.scheduler.runner import CycleDriver
+from dcos_commons_tpu.specification.spec import (GoalState, PhaseSpec,
+                                                 PlanSpecModel, PodSpec,
+                                                 PortSpec, ResourceSet,
+                                                 ServiceSpec, TaskSpec,
+                                                 TpuSpec)
+
+
+# ---------------------------------------------------------------------------
+# spec builders
+
+def make_pod(type="worker", count=2, chips=4, topology="v4-16", slices=1,
+             env=None, cmd="echo go", resource_sets=None):
+    if resource_sets is None:
+        resource_sets = (ResourceSet(id="rs", cpus=1.0, memory_mb=256),)
+    task = TaskSpec(name="train", goal=GoalState.RUNNING, cmd=cmd,
+                    resource_set_id=resource_sets[0].id, env=env or {})
+    tpu = TpuSpec(chips=chips, topology=topology, slices=slices) \
+        if chips else None
+    return PodSpec(type=type, count=count, tasks=(task,),
+                   resource_sets=tuple(resource_sets), tpu=tpu)
+
+
+def make_spec(pods=None, plans=()):
+    return ServiceSpec(name="svc", pods=tuple(pods or (make_pod(),)),
+                       plans=tuple(plans))
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# findings plumbing
+
+class TestFindings:
+    def test_clean_spec_is_clean(self):
+        assert lint_spec(make_spec()) == []
+
+    def test_suppression_drops_by_code(self):
+        fs = [Finding("S1", Severity.ERROR, "x", "m"),
+              Finding("S4", Severity.WARNING, "y", "m")]
+        assert codes(filter_suppressed(fs, {"S1"})) == ["S4"]
+
+    def test_errors_and_report(self):
+        fs = [Finding("S1", Severity.ERROR, "x", "m"),
+              Finding("S4", Severity.WARNING, "y", "m")]
+        assert len(errors(fs)) == 1
+        report = render_report(fs, label="t")
+        assert "t: 2 finding(s), 1 error(s)" in report
+        assert "S1 error x: m" in report
+
+    def test_registry_rejects_duplicate_codes(self):
+        from dcos_commons_tpu.analysis.findings import Rule
+        with pytest.raises(ValueError):
+            REGISTRY.register(Rule("S1", "spec", "dup", "no"))
+
+    def test_registry_catalogues_both_families(self):
+        spec_codes = {r.code for r in REGISTRY.all("spec")}
+        jaxpr_codes = {r.code for r in REGISTRY.all("jaxpr")}
+        assert {"S0", "S1", "S2", "S3", "S4", "S5", "S6"} <= spec_codes
+        assert {"J1", "J2", "J3", "J4"} <= jaxpr_codes
+
+
+# ---------------------------------------------------------------------------
+# S-rules
+
+class TestSpecRules:
+    def test_s0_promotes_validate_errors(self):
+        spec = ServiceSpec(name="", pods=(make_pod(),))
+        found = lint_spec(spec)
+        assert "S0" in codes(found)
+        assert all(f.severity is Severity.ERROR
+                   for f in found if f.code == "S0")
+
+    def test_s1_self_dependency(self):
+        plan = PlanSpecModel("deploy", phases=(
+            PhaseSpec("a", "worker", deps=("a",)),))
+        assert codes(lint_spec(make_spec(plans=(plan,)))) == ["S1"]
+
+    def test_s1_cycle_reports_path(self):
+        plan = PlanSpecModel("deploy", phases=(
+            PhaseSpec("a", "worker", deps=("b",)),
+            PhaseSpec("b", "worker", deps=("a",))))
+        found = lint_spec(make_spec(plans=(plan,)))
+        assert codes(found) == ["S1"]
+        assert "a -> b -> a" in found[0].message \
+            or "b -> a -> b" in found[0].message
+
+    def test_s1_acyclic_dag_is_clean(self):
+        plan = PlanSpecModel("deploy", phases=(
+            PhaseSpec("a", "worker"),
+            PhaseSpec("b", "worker", deps=("a",)),
+            PhaseSpec("c", "worker", deps=("a", "b"))))
+        assert lint_spec(make_spec(plans=(plan,))) == []
+
+    def test_s2_unknown_dependency(self):
+        plan = PlanSpecModel("deploy", phases=(
+            PhaseSpec("a", "worker", deps=("ghost",)),))
+        found = lint_spec(make_spec(plans=(plan,)))
+        assert codes(found) == ["S2"]
+        assert "ghost" in found[0].message
+
+    def test_s3_gang_larger_than_topology(self):
+        pod = make_pod(count=2, chips=16, topology="v4-16")  # 32 > 16
+        assert codes(lint_spec(make_spec([pod]))) == ["S3"]
+
+    def test_s3_non_dividing_gang(self):
+        pod = make_pod(count=2, chips=3, topology="v4-16")  # 16 % 6 != 0
+        assert codes(lint_spec(make_spec([pod]))) == ["S3"]
+
+    def test_s3_dividing_gang_and_opaque_topology_clean(self):
+        assert lint_spec(make_spec(
+            [make_pod(count=2, chips=4, topology="4x4x4")])) == []
+        assert lint_spec(make_spec(
+            [make_pod(count=2, chips=3, topology="donut")])) == []
+
+    def test_s4_port_collision_within_pod(self):
+        rs = (ResourceSet(id="a", cpus=1.0,
+                          ports=(PortSpec("http", 8080),)),
+              ResourceSet(id="b", cpus=1.0,
+                          ports=(PortSpec("admin", 8080),)))
+        pod = make_pod(resource_sets=rs)
+        found = lint_spec(make_spec([pod]))
+        assert codes(found) == ["S4"]
+        assert found[0].severity is Severity.ERROR
+
+    def test_s4_port_collision_across_pods_warns(self):
+        def pod(name):
+            return make_pod(
+                type=name, resource_sets=(ResourceSet(
+                    id="rs", cpus=1.0, ports=(PortSpec("http", 9090),)),))
+        found = lint_spec(make_spec([pod("x"), pod("y")]))
+        assert codes(found) == ["S4"]
+        assert found[0].severity is Severity.WARNING
+
+    def test_s4_dynamic_ports_clean(self):
+        rs = (ResourceSet(id="a", cpus=1.0, ports=(PortSpec("http", 0),)),
+              ResourceSet(id="b", cpus=1.0, ports=(PortSpec("admin", 0),)))
+        assert lint_spec(make_spec([make_pod(resource_sets=rs)])) == []
+
+    def test_s5_undefined_placeholder_in_cmd(self):
+        pod = make_pod(cmd="exec {{NOPE}}")
+        found = lint_spec(make_spec([pod]))
+        assert codes(found) == ["S5"]
+        assert "NOPE" in found[0].message
+
+    def test_s5_runtime_vocabulary_is_known(self):
+        rs = (ResourceSet(id="rs", cpus=1.0,
+                          ports=(PortSpec("http", 0),)),)
+        pod = make_pod(cmd="serve --port {{PORT_HTTP}} --n {{COUNT}}",
+                       env={"COUNT": "3"}, resource_sets=rs)
+        assert lint_spec(make_spec([pod])) == []
+
+    def test_s6_mesh_product_mismatch(self):
+        # gang = 2 hosts x 4 chips = 8; tp=3 does not divide it
+        pod = make_pod(env={"TP": "3"})
+        found = lint_spec(make_spec([pod]))
+        assert codes(found) == ["S6"]
+
+    def test_s6_dividing_product_and_auto_axes_clean(self):
+        assert lint_spec(make_spec(
+            [make_pod(env={"TP": "4", "SP": "2"})])) == []
+        assert lint_spec(make_spec(
+            [make_pod(env={"TP": "0", "SP": ""})])) == []
+
+    def test_lint_spec_suppression(self):
+        plan = PlanSpecModel("deploy", phases=(
+            PhaseSpec("a", "worker", deps=("a",)),))
+        assert lint_spec(make_spec(plans=(plan,)), suppress={"S1"}) == []
+
+    def test_topology_chip_count(self):
+        assert topology_chip_count("4x4x4") == 64
+        assert topology_chip_count("2x2") == 4
+        assert topology_chip_count("v4-16") == 16
+        assert topology_chip_count("V5e-8") == 8
+        assert topology_chip_count("donut") is None
+
+
+class TestLintSpecFile:
+    def test_template_failure_is_s5(self, tmp_path):
+        p = tmp_path / "svc.yml"
+        p.write_text("name: {{WHO}}\n")
+        found = lint_spec_file(str(p), {})
+        assert codes(found) == ["S5"]
+        assert "WHO" in found[0].message
+
+    def test_unparseable_spec_is_s0(self, tmp_path):
+        p = tmp_path / "svc.yml"
+        p.write_text("name: x\npods: [not, a, mapping]\n")
+        assert codes(lint_spec_file(str(p), {})) == ["S0"]
+
+    def test_good_file_lints_through(self, tmp_path):
+        p = tmp_path / "svc.yml"
+        p.write_text(textwrap.dedent("""\
+            name: {{NAME}}
+            pods:
+              web:
+                count: 1
+                tasks:
+                  server:
+                    goal: RUNNING
+                    cmd: "echo up"
+                    cpus: 0.1
+                    memory: 32
+        """))
+        assert lint_spec_file(str(p), {"NAME": "ok"}) == []
+
+
+# ---------------------------------------------------------------------------
+# J-rules on synthetic jaxprs
+
+class TestJaxprRules:
+    def test_j1_flags_oversized_fp32(self):
+        x = jax.ShapeDtypeStruct((512, 512), jnp.float32)  # 1 MiB
+        jaxpr = jax.make_jaxpr(lambda v: v * 2.0)(x)
+        assert codes(rule_j1_oversized_fp32(jaxpr, 1 << 19)) == ["J1"]
+        assert rule_j1_oversized_fp32(jaxpr, 1 << 21) == []
+
+    def test_j1_ignores_bf16(self):
+        x = jax.ShapeDtypeStruct((512, 512), jnp.bfloat16)
+        jaxpr = jax.make_jaxpr(lambda v: v * 2)(x)
+        assert rule_j1_oversized_fp32(jaxpr, 1) == []
+
+    def test_j2_widening_inside_scan(self):
+        x = jax.ShapeDtypeStruct((512, 512), jnp.bfloat16)
+
+        def step(v):
+            def body(c, _):
+                wide = c.astype(jnp.float32) * 2.0
+                return wide.astype(jnp.bfloat16), ()
+            out, _ = jax.lax.scan(body, v, None, length=3)
+            return out
+
+        jaxpr = jax.make_jaxpr(step)(x)
+        found = rule_j2_scan_widening(jaxpr, 1 << 19)
+        assert codes(found) == ["J2"]
+        assert "scan" in found[0].location
+
+    def test_j2_widening_outside_scan_not_flagged(self):
+        x = jax.ShapeDtypeStruct((512, 512), jnp.bfloat16)
+        jaxpr = jax.make_jaxpr(lambda v: v.astype(jnp.float32))(x)
+        assert rule_j2_scan_widening(jaxpr, 1 << 19) == []
+
+    def test_j3_census_counts_collectives(self):
+        def f(v):
+            g = jax.lax.all_gather(v, "i")
+            return jax.lax.psum(g.sum(), "i")
+
+        jaxpr = jax.make_jaxpr(f, axis_env=[("i", 8)])(
+            jax.ShapeDtypeStruct((4,), jnp.float32))
+        census = collective_census(jaxpr)
+        assert census["all_gather"] == 1
+        assert census["psum"] == 1
+        assert census["ppermute"] == 0
+        assert rule_j3_census_diff(jaxpr, census) == []
+        drift = rule_j3_census_diff(
+            jaxpr, {"all_gather": 0, "psum": 1}, "decode")
+        assert codes(drift) == ["J3"]
+        assert "all_gather" in drift[0].message
+
+    def test_j3_census_sees_through_pmap(self):
+        jaxpr = jax.make_jaxpr(
+            jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i"))(
+                jnp.zeros((8, 4)))
+        assert collective_census(jaxpr)["psum"] >= 1
+
+    def test_j4_host_callback(self):
+        def f(v):
+            jax.debug.print("v = {}", v)
+            return v + 1
+
+        jaxpr = jax.make_jaxpr(f)(jnp.zeros((4,)))
+        assert codes(rule_j4_host_callbacks(jaxpr)) == ["J4"]
+        clean = jax.make_jaxpr(lambda v: v + 1)(jnp.zeros((4,)))
+        assert rule_j4_host_callbacks(clean) == []
+
+    def test_lint_jaxpr_aggregates_and_suppresses(self):
+        x = jax.ShapeDtypeStruct((512, 512), jnp.float32)
+        jaxpr = jax.make_jaxpr(lambda v: v * 2.0)(x)
+        found = lint_jaxpr(jaxpr, budget_bytes=1 << 19)
+        assert codes(found) == ["J1"]
+        assert lint_jaxpr(jaxpr, budget_bytes=1 << 19,
+                          suppress={"J1"}) == []
+
+
+# ---------------------------------------------------------------------------
+# entrypoint registry + manifest
+
+class TestEntrypoints:
+    def test_manifest_round_trip(self, tmp_path):
+        census = {"ep_a": {"psum": 2, "all_gather": 0},
+                  "ep_b": {"ppermute": 8}}
+        path = str(tmp_path / "manifest.json")
+        eps.save_manifest(census, path)
+        assert eps.load_manifest(path) == census
+
+    def test_checked_in_manifest_matches_live_trace(self):
+        live = eps.compute_census()
+        checked_in = eps.load_manifest()
+        for name, counts in live.items():
+            assert checked_in.get(name) == counts, name
+
+    def test_untraceable_entrypoint_reported_not_dropped(self):
+        found = eps.lint_entrypoints(names=["ring_attention_fwd"])
+        assert found, "skip must surface as a finding"
+        assert all(f.code in ("J0",) or f.severity is not Severity.ERROR
+                   for f in found)
+
+    def test_shipped_entrypoints_lint_clean(self):
+        found = eps.lint_entrypoints()
+        assert errors(found) == [], render_report(found)
+
+    def test_duplicate_registration_rejected(self):
+        with pytest.raises(ValueError):
+            eps.register_hot_path(eps.HOT_PATHS["llama_decode_step"])
+
+
+# ---------------------------------------------------------------------------
+# injected regressions (the issue's acceptance checks)
+
+class TestInjectedRegressions:
+    def test_unfusing_the_train_step_trips_j1(self):
+        """Flip fused_ce off on the fused entrypoint: the full-logits
+        materialization comes back and must blow the fused budget."""
+        real = eps.HOT_PATHS["llama_train_step_fused"]
+        broken = dataclasses.replace(
+            real, build=lambda: eps._trace_train_step(False))
+        eps.HOT_PATHS[real.name] = broken
+        try:
+            found = eps.lint_entrypoints(names=[real.name])
+        finally:
+            eps.HOT_PATHS[real.name] = real
+        j1 = [f for f in errors(found) if f.code == "J1"]
+        assert j1, render_report(found)
+
+    def test_all_gather_on_decode_path_trips_j3(self):
+        """Smuggle an all_gather into the decode step: the census diff
+        against the checked-in manifest must fail."""
+        real = eps.HOT_PATHS["llama_decode_step"]
+
+        def broken_build():
+            from dcos_commons_tpu.models import llama
+            cfg = llama.LlamaConfig.tiny(n_layers=2)
+            slots = 4
+            params = jax.eval_shape(
+                lambda: llama.init_params(cfg, jax.random.key(0)))
+            cache = jax.eval_shape(
+                lambda: llama.init_kv_cache(cfg, slots, cfg.max_seq))
+            lengths = jax.ShapeDtypeStruct((slots,), jnp.int32)
+            tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+
+            def step(p, c, ln, tok):
+                out = llama.decode_step_slots(cfg, p, c, ln, tok)
+                leaked = jax.lax.all_gather(jax.tree.leaves(out)[0], "i")
+                return out, leaked
+
+            return jax.make_jaxpr(step, axis_env=[("i", 8)])(
+                params, cache, lengths, tokens)
+
+        broken = dataclasses.replace(real, build=broken_build)
+        eps.HOT_PATHS[real.name] = broken
+        try:
+            found = eps.lint_entrypoints(names=[real.name])
+        finally:
+            eps.HOT_PATHS[real.name] = real
+        j3 = [f for f in errors(found) if f.code == "J3"]
+        assert j3, render_report(found)
+        assert any("all_gather" in f.message for f in j3)
+
+
+# ---------------------------------------------------------------------------
+# scheduler startup fail-fast
+
+class _FakeScheduler:
+    def __init__(self, spec):
+        self.spec = spec
+        self.cycles = 0
+
+    def run_cycle(self):
+        self.cycles += 1
+
+    def reconcile(self):
+        pass
+
+
+class TestSchedulerFailFast:
+    def test_bad_spec_refuses_to_start(self):
+        plan = PlanSpecModel("deploy", phases=(
+            PhaseSpec("a", "worker", deps=("a",)),))
+        driver = CycleDriver(_FakeScheduler(make_spec(plans=(plan,))))
+        with pytest.raises(ValueError, match="S1"):
+            driver.start()
+
+    def test_clean_spec_starts(self):
+        driver = CycleDriver(_FakeScheduler(make_spec()), interval_s=0.01)
+        driver.start()
+        driver.stop()
+
+    def test_specless_scheduler_unaffected(self):
+        sched = _FakeScheduler(make_spec())
+        del sched.spec  # e.g. a MultiServiceScheduler
+        driver = CycleDriver(sched, interval_s=0.01)
+        driver.start()
+        driver.stop()
+
+
+# ---------------------------------------------------------------------------
+# static_check E1/F1 (the satellite rules ride the same PR)
+
+class TestStaticCheckNewRules:
+    def _check(self, tmp_path, source):
+        from tools.static_check import check_file
+        p = tmp_path / "mod.py"
+        p.write_text(source)
+        return [f.code for f in check_file(p)]
+
+    def test_e1_bare_except(self, tmp_path):
+        src = "try:\n    x = 1\nexcept:\n    pass\n"
+        assert self._check(tmp_path, src) == ["E1"]
+
+    def test_e1_typed_except_clean(self, tmp_path):
+        src = "try:\n    x = 1\nexcept ValueError:\n    pass\n"
+        assert self._check(tmp_path, src) == []
+
+    def test_e1_noqa_exempts(self, tmp_path):
+        src = "try:\n    x = 1\nexcept:  # noqa\n    pass\n"
+        assert self._check(tmp_path, src) == []
+
+    def test_f1_fstring_without_placeholders(self, tmp_path):
+        assert self._check(tmp_path, 'x = f"static"\n') == ["F1"]
+
+    def test_f1_real_fstring_and_format_spec_clean(self, tmp_path):
+        assert self._check(tmp_path, 'y = 2\nx = f"{y:>10}"\n') == []
+
+    def test_f1_noqa_exempts(self, tmp_path):
+        assert self._check(tmp_path, 'x = f"static"  # noqa\n') == []
+
+    def test_e2_syntax_error_code(self, tmp_path):
+        assert self._check(tmp_path, "def f(:\n") == ["E2"]

@@ -1,5 +1,6 @@
 """CLI tests driving a live ApiServer (reference CLI surface parity)."""
 
+import importlib.util
 import json
 
 import pytest
@@ -203,3 +204,77 @@ def test_cluster_config_tls_auth_both_clis(capsys, clean_env):
         assert r.returncode != 0
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# lint verb (analysis/ engine; no server needed for file mode)
+
+def test_lint_shipped_jax_specs_exit_zero(capsys):
+    import glob
+    files = sorted(glob.glob("frameworks/jax/dist/*.yml"))
+    assert files, "shipped jax specs missing"
+    assert main(["lint", *files]) == 0
+    out = capsys.readouterr().out
+    assert "0 error(s)" in out
+
+
+def test_lint_plan_cycle_exits_nonzero_with_code(tmp_path, capsys):
+    spec = tmp_path / "cycle.yml"
+    spec.write_text("""\
+name: cyclic
+pods:
+  server:
+    count: 1
+    tasks:
+      node:
+        goal: RUNNING
+        cmd: "echo hi"
+        cpus: 0.1
+        memory: 32
+plans:
+  deploy:
+    strategy: serial
+    phases:
+      alpha:
+        pod: server
+        steps:
+          - [default, [node]]
+        depends: beta
+      beta:
+        pod: server
+        steps:
+          - [default, [node]]
+        depends: alpha
+""")
+    assert main(["lint", str(spec)]) == 1
+    out = capsys.readouterr().out
+    assert "S1" in out and "cycle" in out
+
+
+def test_lint_env_override_fixes_missing_placeholder(tmp_path, capsys):
+    spec = tmp_path / "svc.yml"
+    spec.write_text("""\
+name: {{NAME}}
+pods:
+  web:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "echo up"
+        cpus: 0.1
+        memory: 32
+""")
+    assert main(["lint", str(spec)]) == 1
+    assert "S5" in capsys.readouterr().out
+    assert main(["lint", str(spec), "--env", "NAME=web"]) == 0
+    capsys.readouterr()
+
+
+@pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="server fixture needs the cryptography package")
+def test_lint_live_target_config(server, capsys):
+    _, base = server
+    assert main(["--url", base, "lint"]) == 0
+    assert "0 error(s)" in capsys.readouterr().out

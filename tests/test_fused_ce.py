@@ -203,38 +203,35 @@ def test_llama_moe_loss_fused_matches_unfused():
 
 
 # ---------------------------------------- no [B, S, V] fp32 in the jaxpr
-
-def _walk_avals(jaxpr):
-    for eqn in jaxpr.eqns:
-        for v in eqn.outvars:
-            yield v.aval
-        for p in eqn.params.values():
-            for sub in jax.tree.leaves(
-                    p, is_leaf=lambda t: isinstance(t, jax.extend.core.Jaxpr)):
-                inner = getattr(sub, "jaxpr", sub)
-                if isinstance(inner, jax.extend.core.Jaxpr):
-                    yield from _walk_avals(inner)
-
+# The walker and the budget rule live in dcos_commons_tpu.analysis now
+# (the J1 CI gate); this test pins the fused-CE guarantee through the
+# same code path the lint gate runs.
 
 def test_fused_train_step_never_materializes_full_logits():
-    cfg = llama.LlamaConfig.tiny(n_layers=2, fused_ce=True,
-                                 fused_ce_block=8)
+    from dcos_commons_tpu.analysis import rule_j1_oversized_fp32, walk_avals
+    # vocab is scaled up so the full-logits tensor (1 MiB) is 2x the
+    # lm_head grad and 4x the fp32 attention scores — a budget just under
+    # it can only be tripped by the materialization itself
+    cfg = llama.LlamaConfig.tiny(n_layers=2, vocab_size=2048,
+                                 fused_ce=True, fused_ce_block=8)
     params = llama.init_params(cfg, jax.random.key(0))
-    toks = jax.random.randint(jax.random.key(1), (2, 33), 0,
+    toks = jax.random.randint(jax.random.key(1), (2, 65), 0,
                               cfg.vocab_size)
-    full = (2, 32, cfg.vocab_size)  # [B, S-1, V]
+    full = (2, 64, cfg.vocab_size)  # [B, S-1, V]
+    budget = 2 * 64 * cfg.vocab_size * 4 - 1
 
     def grads(p, t):
         return jax.value_and_grad(
             lambda p_: llama.loss_fn(cfg, p_, t)[0])(p)
 
     jaxpr = jax.make_jaxpr(grads)(params, toks)
-    hits = [a for a in _walk_avals(jaxpr.jaxpr)
+    hits = [a for a in walk_avals(jaxpr.jaxpr)
             if getattr(a, "shape", None) == full
             and getattr(a, "dtype", None) == jnp.float32]
     assert not hits, f"full fp32 logits materialized: {hits}"
+    assert not rule_j1_oversized_fp32(jaxpr, budget, "fused")
 
-    # sanity: the UNFUSED step does contain it (the walker works)
+    # sanity: the UNFUSED step does contain it (walker + rule both see it)
     cfg_ref = dataclasses.replace(cfg, fused_ce=False)
 
     def grads_ref(p, t):
@@ -242,10 +239,12 @@ def test_fused_train_step_never_materializes_full_logits():
             lambda p_: llama.loss_fn(cfg_ref, p_, t)[0])(p)
 
     jaxpr_ref = jax.make_jaxpr(grads_ref)(params, toks)
-    hits_ref = [a for a in _walk_avals(jaxpr_ref.jaxpr)
+    hits_ref = [a for a in walk_avals(jaxpr_ref.jaxpr)
                 if getattr(a, "shape", None) == full
                 and getattr(a, "dtype", None) == jnp.float32]
     assert hits_ref, "reference path should materialize full logits"
+    j1 = rule_j1_oversized_fp32(jaxpr_ref, budget, "unfused")
+    assert j1 and all(f.code == "J1" for f in j1)
 
 
 # -------------------------------------------------- grad-accum microbatching

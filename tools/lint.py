@@ -1,0 +1,102 @@
+"""One-command lint gate: every static check, one summary, one exit code.
+
+Aggregates (in order) ``tools.static_check``, ``tools.type_check``,
+``tools.airgap_linter`` over ``frameworks/*/``, the S-rule spec lint of
+every shipped ``frameworks/*/dist/*.yml`` (rendered with each framework's
+package-default env), and the J-rule jaxpr lint of the registered hot-path
+entrypoints against ``collective_manifest.json``. This is what test.sh
+calls; run a single stage locally with ``--only STAGE``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _stage_static() -> int:
+    from tools import static_check
+    return static_check.main([])
+
+
+def _stage_types() -> int:
+    from tools import type_check
+    return type_check.main([])
+
+
+def _stage_airgap() -> int:
+    from tools import airgap_linter
+    dirs = sorted(glob.glob(os.path.join(_ROOT, "frameworks", "*", "")))
+    return airgap_linter.main(dirs)
+
+
+def _stage_specs() -> int:
+    """S-rules over every shipped service spec, rendered with the owning
+    framework's DEFAULT_ENV (what the package installer would inject)."""
+    from dcos_commons_tpu.analysis import errors, lint_spec_file
+    from dcos_commons_tpu.cli.main import _framework_default_env
+    files = sorted(glob.glob(
+        os.path.join(_ROOT, "frameworks", "*", "dist", "*.yml")))
+    bad = 0
+    for path in files:
+        for f in errors(lint_spec_file(path, _framework_default_env(path))):
+            rel = os.path.relpath(path, _ROOT)
+            print(f"{rel}: {f}")
+            bad += 1
+    print(f"spec-lint: {len(files)} spec(s), {bad} error(s)")
+    return 1 if bad else 0
+
+
+def _stage_jaxpr() -> int:
+    from dcos_commons_tpu.analysis.__main__ import _force_cpu_mesh
+    _force_cpu_mesh()
+    from dcos_commons_tpu.analysis import (errors, lint_entrypoints,
+                                           render_report)
+    findings = lint_entrypoints()
+    print(render_report(findings, label="jaxpr-lint"))
+    return 1 if errors(findings) else 0
+
+
+_STAGES = (
+    ("static", _stage_static),
+    ("types", _stage_types),
+    ("airgap", _stage_airgap),
+    ("specs", _stage_specs),
+    ("jaxpr", _stage_jaxpr),
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="run every lint stage; exit nonzero if any fails")
+    p.add_argument("--only", choices=[n for n, _ in _STAGES],
+                   help="run a single stage")
+    args = p.parse_args(argv)
+
+    failed = []
+    for name, stage in _STAGES:
+        if args.only and name != args.only:
+            continue
+        print(f"-- lint:{name} --")
+        try:
+            rc = stage()
+        except Exception as e:  # a crashed stage is a failed stage
+            print(f"lint:{name} crashed: {e!r}")
+            rc = 1
+        if rc:
+            failed.append(name)
+    ran = 1 if args.only else len(_STAGES)
+    if failed:
+        print(f"lint: {ran} stage(s), FAILED: {', '.join(failed)}")
+        return 1
+    print(f"lint: {ran} stage(s), all clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,13 @@ M1  mutable default argument (list/dict/set literal)
 T1  assert on a non-empty tuple literal (always true)
 D1  duplicate function/method definition in one scope (later silently
     shadows earlier)
+E1  bare ``except:`` (swallows KeyboardInterrupt/SystemExit; catch
+    Exception — or narrower — instead)
+F1  f-string with no placeholders (either a forgotten ``{var}`` or a
+    plain string wearing an ``f`` prefix)
+
+``# noqa`` on the offending line exempts any check. E0 = unreadable
+file, E2 = syntax error (structural; not suppressible).
 
 Usage: ``python -m tools.static_check [paths...]`` (default: the package,
 frameworks, tools, tests). Exit 1 on any finding.
@@ -215,6 +222,12 @@ def _check_ast(path: Path, source: str, tree: ast.Module,
                findings: List[Finding]) -> None:
     noqa = _noqa_lines(source)
 
+    # format_spec JoinedStrs (the ">10" in f"{x:>10}") legitimately hold
+    # no FormattedValue of their own; exclude them from F1
+    spec_strs = {id(n.format_spec) for n in ast.walk(tree)
+                 if isinstance(n, ast.FormattedValue)
+                 and n.format_spec is not None}
+
     # module-level function signatures for the arity check
     module_fns: Dict[str, ast.FunctionDef] = {}
     for node in tree.body:
@@ -248,6 +261,22 @@ def _check_ast(path: Path, source: str, tree: ast.Module,
                     findings.append(Finding(
                         path, node.lineno, "M1",
                         f"mutable default argument in '{node.name}'"))
+        # E1 bare except
+        if isinstance(node, ast.ExceptHandler) and node.type is None \
+                and node.lineno not in noqa:
+            findings.append(Finding(
+                path, node.lineno, "E1",
+                "bare 'except:' also catches KeyboardInterrupt/SystemExit; "
+                "catch Exception or narrower"))
+        # F1 f-string with no placeholders
+        if isinstance(node, ast.JoinedStr) and id(node) not in spec_strs \
+                and not any(isinstance(v, ast.FormattedValue)
+                            for v in node.values) \
+                and node.lineno not in noqa:
+            findings.append(Finding(
+                path, node.lineno, "F1",
+                "f-string has no placeholders (missing '{...}' or a "
+                "stray 'f' prefix)"))
         # T1 assert on tuple
         if isinstance(node, ast.Assert) \
                 and isinstance(node.test, ast.Tuple) and node.test.elts:
@@ -318,7 +347,7 @@ def check_file(path: Path) -> List[Finding]:
     try:
         tree = ast.parse(source, filename=str(path))
     except SyntaxError as e:
-        return [Finding(path, e.lineno or 0, "E1", f"syntax error: {e.msg}")]
+        return [Finding(path, e.lineno or 0, "E2", f"syntax error: {e.msg}")]
     _check_scopes(path, source, tree, findings)
     _check_ast(path, source, tree, findings)
     return findings
