@@ -13,4 +13,5 @@ __getattr__, __dir__ = lazy_exports(__name__, {
     "AgentClient": "client", "StatusCallback": "client",
     "FakeCluster": "fake", "FakeTask": "fake", "TaskBehavior": "fake",
     "RemoteCluster": "remote",
+    "RetryingAgentClient": "retry",
 }, globals())

@@ -61,6 +61,8 @@ class FakeCluster:
         self._kill_log: List[str] = []
         # (agent_id, pod_instance_name) destroy-volume commands, for tests
         self.destroyed_volumes: List[tuple] = []
+        # pre-degrade TPU inventory per agent, restored by heal_tpu
+        self._healthy_tpu: Dict[str, object] = {}
 
     # -- test scripting ----------------------------------------------------
 
@@ -86,8 +88,26 @@ class FakeCluster:
         ``chips_now`` with ``degraded=True`` — what ``RemoteCluster``
         synthesizes when a real agent's re-probe loses chips."""
         a = self._agents[agent_id]
+        self._healthy_tpu.setdefault(agent_id, a.tpu)
         self._agents[agent_id] = replace(
             a, tpu=replace(a.tpu, chips=chips_now, degraded=True))
+
+    def heal_tpu(self, agent_id: str) -> None:
+        """Inverse of :meth:`degrade_tpu` — the agent's re-probe reports the
+        full registered chip count again (driver reload / chip re-seated),
+        matching ``RemoteCluster.poll`` clearing ``_tpu_chips_now``."""
+        if agent_id not in self._agents:
+            return  # keep the healthy record; the agent may re-register
+        healthy = self._healthy_tpu.pop(agent_id, None)
+        if healthy is not None:
+            self._agents[agent_id] = replace(self._agents[agent_id],
+                                             tpu=healthy)
+
+    def live_tasks(self) -> List[FakeTask]:
+        """Every task the fake agents consider alive (non-terminal), for
+        harness-side invariants (e.g. no two live launches may share a
+        task name after recovery churn)."""
+        return [t for t in self._tasks.values() if not t.state.terminal]
 
     def remove_agent(self, agent_id: str) -> List[FakeTask]:
         """Simulate host loss: agent gone, its tasks implicitly dead (no

@@ -1,0 +1,116 @@
+"""Bounded retry + jittered backoff around the agent transport's verbs.
+
+Reference: the Mesos driver retried nothing — ``driver.acceptOffers`` either
+reached the master or the framework got a new offer cycle. Our transport has
+no offer market to re-drive a failed instruction, so the scheduler side
+hardens the launch/kill/destroy paths itself: a transient enqueue failure
+(replicated-state hiccup behind ``RemoteCluster``, a transport raising on a
+momentarily unreachable backend) is retried a bounded number of times with
+full jitter, capped by a per-call deadline. A call that exhausts the budget
+re-raises the last error — the caller's crash-don't-corrupt handling
+(``runner.CycleDriver``) still applies to genuine outages.
+
+``FakeCluster`` is never wrapped (tests talk to it directly), and wrapping
+any always-succeeding client is behavior-identical: the first attempt is
+invoked exactly as before, with zero added latency.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from typing import Callable, Optional, Sequence
+
+from .client import StatusCallback
+from .inventory import AgentInfo
+
+log = logging.getLogger(__name__)
+
+
+class RetryingAgentClient:
+    """Wraps any AgentClient; retries the *instruction* verbs only.
+
+    Read verbs (``agents``, ``running_task_ids``) pass straight through —
+    a stale read is re-taken next cycle anyway, and retrying them would
+    just add tail latency to every cycle. Unknown attributes delegate to
+    the inner client, so transport-specific surface (``RemoteCluster``'s
+    ``register``/``poll``/``async_status_ok``) keeps working through the
+    wrapper.
+    """
+
+    def __init__(self, inner, max_attempts: int = 3,
+                 base_delay_s: float = 0.05, max_delay_s: float = 2.0,
+                 call_timeout_s: float = 10.0,
+                 rng: Optional[random.Random] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self._inner = inner
+        self._max_attempts = max_attempts
+        self._base_delay_s = base_delay_s
+        self._max_delay_s = max_delay_s
+        self._call_timeout_s = call_timeout_s
+        self._rng = rng or random.Random()
+        self._sleep = sleep
+        self._clock = clock
+
+    # -- retry core --------------------------------------------------------
+
+    def _retry(self, what: str, fn: Callable[[], None]) -> None:
+        deadline = self._clock() + self._call_timeout_s
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                fn()
+                return
+            except Exception as e:
+                if attempt >= self._max_attempts:
+                    raise
+                # full jitter (0..cap]: decorrelates a fleet of schedulers
+                # hammering a recovering backend; cap doubles per attempt
+                cap = min(self._max_delay_s,
+                          self._base_delay_s * (2 ** (attempt - 1)))
+                delay = self._rng.uniform(0, cap) or cap
+                if self._clock() + delay > deadline:
+                    # the per-call deadline beats the attempt budget: a
+                    # verb must never stall the cycle longer than promised
+                    raise
+                log.warning("%s failed (attempt %d/%d), retrying in "
+                            "%.3fs: %s", what, attempt, self._max_attempts,
+                            delay, e)
+                self._sleep(delay)
+
+    # -- AgentClient -------------------------------------------------------
+
+    def agents(self) -> Sequence[AgentInfo]:
+        return self._inner.agents()
+
+    def launch(self, plan) -> None:
+        # idempotent to retry: the WAL is already written and the agent
+        # executes a launch command once per task id (a duplicate enqueue
+        # surfaces as a dup status, which ingestion dedupes)
+        self._retry(f"launch on {plan.agent.agent_id}",
+                    lambda: self._inner.launch(plan))
+
+    def kill(self, agent_id: str, task_id: str,
+             grace_period_s: float = 0.0) -> None:
+        self._retry(f"kill {task_id}",
+                    lambda: self._inner.kill(agent_id, task_id,
+                                             grace_period_s))
+
+    def destroy_volumes(self, agent_id: str, pod_instance_name: str) -> None:
+        self._retry(f"destroy_volumes {pod_instance_name}",
+                    lambda: self._inner.destroy_volumes(agent_id,
+                                                        pod_instance_name))
+
+    def running_task_ids(self, agent_id: str) -> Sequence[str]:
+        return self._inner.running_task_ids(agent_id)
+
+    def set_status_callback(self, callback: StatusCallback) -> None:
+        self._inner.set_status_callback(callback)
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)

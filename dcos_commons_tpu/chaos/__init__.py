@@ -1,0 +1,15 @@
+"""Seeded fault injection for scheduler soak testing.
+
+One RNG seed drives an entire fault schedule — which statuses are delayed,
+which agents flap, when the scheduler crash-restarts — so any failing soak
+reproduces exactly from its seed (``tpuctl chaos-soak --seed N``). The
+engine wraps the agent transport; the invariant checker audits scheduler
+state after every tick; the soak harness composes both over the simulation
+runner. See ``docs/fault-tolerance.md``.
+"""
+
+from .engine import ChaosCluster, FaultConfig  # noqa: F401
+from .invariants import InvariantChecker, Violation  # noqa: F401
+from .soak import SoakReport, run_soak  # noqa: F401
+
+FAULT_CLASSES = FaultConfig.FIELDS

@@ -1,0 +1,250 @@
+"""The fault-injecting agent transport.
+
+Reference failure model: Mesos delivered status updates at-least-once with
+no ordering guarantee (the agent retried until the scheduler acknowledged),
+offers raced with agent loss, and the scheduler process itself could die
+between any two callbacks. ``ChaosCluster`` replays that weather against
+any AgentClient: it interposes on the status callback and the instruction
+verbs, and a seeded RNG decides per event whether to delay, duplicate,
+reorder, or lose it.
+
+Semantics are chosen to match a real at-least-once transport, not a
+strawman:
+
+* **drop** means *delayed redelivery* — the transport loses the first copy
+  but the agent keeps retrying, so the status lands a few ticks late. A
+  truly-vanished RUNNING status does not exist in the reference model (and
+  would wedge any deploy step forever, which is a harness bug, not a
+  scheduler bug).
+* **lost launch** means the instruction never reached the agent: no task,
+  no status. Detection is the scheduler's job (launch-report grace ->
+  synthesized LOST in ``reconcile``).
+* **slow launch** defers the instruction a few ticks; if the target agent
+  died in the meantime the instruction is dropped on the floor, exactly
+  like an in-flight ``acceptOffers`` racing an agent partition.
+
+With ``config=FaultConfig.none()`` (or ``rng=None``) every path collapses
+to a direct passthrough — safe to leave in place around a real
+``RemoteCluster``.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, fields, replace
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..state.tasks import TaskStatus
+
+
+@dataclass(frozen=True)
+class FaultConfig:
+    """Per-event fault probabilities, all in [0, 1].
+
+    The first block is consumed by :class:`ChaosCluster` (transport
+    faults); the second by the soak harness (environment faults scheduled
+    between ticks). Keeping them in one config means one ``--faults``
+    knob selects any subset by name.
+    """
+
+    # transport faults (ChaosCluster)
+    status_drop: float = 0.0      # lose first copy; redeliver 1..max ticks late
+    status_delay: float = 0.0     # hold 1..max ticks
+    status_dup: float = 0.0       # deliver now AND again 1..max ticks later
+    status_reorder: float = 0.0   # hold to next tick; released shuffled
+    launch_fail: float = 0.0      # instruction lost: no task, no status
+    launch_slow: float = 0.0      # instruction lands 1..max ticks late
+    # environment faults (soak harness)
+    agent_flap: float = 0.0       # agent leaves, returns with tasks gone
+    agent_loss: float = 0.0       # agent leaves forever; clone joins later
+    degrade: float = 0.0          # TPU agent loses a chip, heals later
+    task_crash: float = 0.0       # a random live task FAILs
+    crash_restart: float = 0.0    # scheduler process restart mid-run
+    max_delay_ticks: int = 3
+
+    FIELDS = ("status_drop", "status_delay", "status_dup", "status_reorder",
+              "launch_fail", "launch_slow", "agent_flap", "agent_loss",
+              "degrade", "task_crash", "crash_restart")
+
+    @classmethod
+    def none(cls) -> "FaultConfig":
+        return cls()
+
+    @classmethod
+    def all_faults(cls, p: float = 0.08) -> "FaultConfig":
+        """Every fault class armed at probability ``p`` (the soak default:
+        high enough that a 40-tick schedule sees several of each, low
+        enough that the service is recovering rather than flatlined)."""
+        return cls(**{f: p for f in cls.FIELDS})
+
+    @classmethod
+    def only(cls, *names: str, p: float = 0.25) -> "FaultConfig":
+        """Arm exactly the named fault classes (regression corpus entries
+        isolate one class per test)."""
+        unknown = set(names) - set(cls.FIELDS)
+        if unknown:
+            raise ValueError(f"unknown fault classes: {sorted(unknown)}; "
+                             f"choose from {list(cls.FIELDS)}")
+        return cls(**{f: p for f in names})
+
+    def without_environment_faults(self) -> "FaultConfig":
+        """Transport-only view, for the settle phase: held statuses still
+        drain through the chaos queue but no new weather is scheduled."""
+        return replace(self, agent_flap=0.0, agent_loss=0.0, degrade=0.0,
+                       task_crash=0.0, crash_restart=0.0)
+
+
+def parse_faults(arg: str) -> FaultConfig:
+    """CLI/corpus syntax: ``all`` | comma-list of class names, e.g.
+    ``status_drop,agent_flap``."""
+    if arg in ("all", ""):
+        return FaultConfig.all_faults()
+    return FaultConfig.only(*[p.strip() for p in arg.split(",") if p.strip()])
+
+
+class ChaosCluster:
+    """AgentClient interposer: same protocol as ``inner``, worse weather.
+
+    The scheduler's status callback is captured and replaced with the
+    chaos interceptor — including across scheduler restarts, since the new
+    scheduler re-registers through this wrapper. Everything not part of
+    the fault surface (``agents``, ``kill``, test-scripting helpers like
+    ``send_status``/``add_agent``) passes straight through, so Expect
+    ticks and the soak harness keep manipulating the raw fake.
+    """
+
+    def __init__(self, inner, rng: Optional[random.Random] = None,
+                 config: Optional[FaultConfig] = None):
+        self._inner = inner
+        self._rng = rng
+        self.config = config or FaultConfig.none()
+        self._tick = 0
+        self._scheduler_cb: Optional[Callable] = None
+        # (release_tick, task_name, status) held statuses
+        self._held: List[Tuple[int, str, TaskStatus]] = []
+        # (release_tick, plan) deferred launch instructions
+        self._deferred_launches: List[Tuple[int, object]] = []
+        self.fault_counts: dict = {}
+        inner.set_status_callback(self._on_status)
+
+    # -- fault bookkeeping -------------------------------------------------
+
+    def _count(self, fault: str) -> None:
+        self.fault_counts[fault] = self.fault_counts.get(fault, 0) + 1
+
+    def _roll(self, p: float) -> bool:
+        return self._rng is not None and p > 0 and self._rng.random() < p
+
+    def _late(self) -> int:
+        return self._tick + self._rng.randint(1, max(
+            1, self.config.max_delay_ticks))
+
+    # -- status path -------------------------------------------------------
+
+    def _on_status(self, task_name: str, status: TaskStatus) -> None:
+        cfg = self.config
+        if self._roll(cfg.status_drop):
+            # first copy lost; agent-side retry redelivers late
+            self._count("status_drop")
+            self._held.append((self._late(), task_name, status))
+            return
+        if self._roll(cfg.status_delay):
+            self._count("status_delay")
+            self._held.append((self._late(), task_name, status))
+            return
+        if self._roll(cfg.status_reorder):
+            # next tick's shuffled release interleaves it behind later events
+            self._count("status_reorder")
+            self._held.append((self._tick + 1, task_name, status))
+            return
+        if self._roll(cfg.status_dup):
+            self._count("status_dup")
+            self._held.append((self._late(), task_name, status))
+        self._deliver(task_name, status)
+
+    def _deliver(self, task_name: str, status: TaskStatus) -> None:
+        if self._scheduler_cb is not None:
+            self._scheduler_cb(task_name, status)
+
+    # -- clock -------------------------------------------------------------
+
+    def tick(self) -> None:
+        """Advance the chaos clock one scheduler tick: release every held
+        status and deferred launch that has come due, in RNG-shuffled
+        order (this is where reordering actually happens)."""
+        self._tick += 1
+        due = [h for h in self._held if h[0] <= self._tick]
+        self._held = [h for h in self._held if h[0] > self._tick]
+        if self._rng is not None:
+            self._rng.shuffle(due)
+        launches_due = [d for d in self._deferred_launches
+                        if d[0] <= self._tick]
+        self._deferred_launches = [d for d in self._deferred_launches
+                                   if d[0] > self._tick]
+        for _, plan in launches_due:
+            live = {a.agent_id for a in self._inner.agents()}
+            if plan.agent.agent_id in live:
+                self._inner.launch(plan)
+            # else: in-flight instruction raced agent death; reconcile's
+            # launch-report grace turns the silence into LOST
+        for _, task_name, status in due:
+            self._deliver(task_name, status)
+
+    def flush(self) -> None:
+        """Heal the transport: everything held lands now (ordered by
+        originally scheduled release, which is fault-free FIFO enough for
+        the settle phase)."""
+        launches = sorted(self._deferred_launches, key=lambda d: d[0])
+        self._deferred_launches = []
+        for _, plan in launches:
+            live = {a.agent_id for a in self._inner.agents()}
+            if plan.agent.agent_id in live:
+                self._inner.launch(plan)
+        held = sorted(self._held, key=lambda h: h[0])
+        self._held = []
+        for _, task_name, status in held:
+            self._deliver(task_name, status)
+
+    @property
+    def pending_events(self) -> int:
+        return len(self._held) + len(self._deferred_launches)
+
+    # -- AgentClient -------------------------------------------------------
+
+    def set_status_callback(self, callback: Callable) -> None:
+        # the scheduler (original or restarted) registers here; the inner
+        # client keeps pointing at the chaos interceptor
+        self._scheduler_cb = callback
+
+    def launch(self, plan) -> None:
+        if self._roll(self.config.launch_fail):
+            self._count("launch_fail")
+            return  # instruction lost; WAL already written, reconcile detects
+        if self._roll(self.config.launch_slow):
+            self._count("launch_slow")
+            self._deferred_launches.append((self._late(), plan))
+            return
+        self._inner.launch(plan)
+
+    def agents(self) -> Sequence:
+        return self._inner.agents()
+
+    def kill(self, agent_id: str, task_id: str,
+             grace_period_s: float = 0.0) -> None:
+        # kills pass through un-faulted: the interesting failure mode (a
+        # KILLED status going missing) is already covered by the status
+        # faults on the emitted update
+        self._inner.kill(agent_id, task_id, grace_period_s)
+
+    def destroy_volumes(self, agent_id: str, pod_instance_name: str) -> None:
+        self._inner.destroy_volumes(agent_id, pod_instance_name)
+
+    def running_task_ids(self, agent_id: str) -> Sequence[str]:
+        return self._inner.running_task_ids(agent_id)
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+
+# dataclass sanity: FIELDS must track the probability fields
+assert set(FaultConfig.FIELDS) <= {f.name for f in fields(FaultConfig)}

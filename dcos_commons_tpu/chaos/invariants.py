@@ -1,0 +1,150 @@
+"""Safety invariants audited after every chaos tick.
+
+Convergence (plans reach COMPLETE) is checked at the end of a soak; these
+are the properties that must hold *during* the storm — the difference
+between "recovery is slow" and "recovery corrupted state". Each check maps
+to a real reference-era incident class:
+
+1. **unique live launches** — two tasks alive under one task name means a
+   kill-before-relaunch was skipped (reference: dual-running brokers after
+   a lost KILLED update).
+2. **ledger integrity** — the durable reservation records and the
+   in-memory ledger must agree (restart would silently change placement),
+   reservations must never exceed a healthy agent's capacity
+   (double-booking), and every reservation must belong to a pod the spec
+   still knows (leak after replace/decommission).
+3. **stable gang ranks** — a recovered gang member must keep
+   ``JAX_PROCESS_ID == pod index``; a drifting rank re-shards a training
+   job into garbage even though every task is "RUNNING".
+4. **monotone backoff** — a crash-looping task's delay may only grow or
+   be deliberately reset, never shrink, or a scheduler restart would relaunch
+   a crash-looper at full speed (reference: backoff state was lost on
+   failover and tasks hot-looped).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+from ..plan.backoff import ExponentialBackoff
+
+
+@dataclass(frozen=True)
+class Violation:
+    invariant: str
+    detail: str
+    tick: int
+
+    def __str__(self) -> str:
+        return f"[tick {self.tick}] {self.invariant}: {self.detail}"
+
+
+class InvariantChecker:
+    """Stateful auditor over a ``ServiceTestRunner`` — keeps the previous
+    backoff snapshot so monotonicity is checked across ticks (and across
+    scheduler restarts: the soak shares one backoff instance)."""
+
+    def __init__(self, runner):
+        self._runner = runner
+        # task -> (delay, entry epoch) from the previous check
+        self._prev_backoff: Dict[str, tuple] = {}
+
+    def check(self, tick: int) -> List[Violation]:
+        out: List[Violation] = []
+        out += self._check_unique_live_tasks(tick)
+        out += self._check_ledger(tick)
+        out += self._check_gang_ranks(tick)
+        out += self._check_backoff_monotone(tick)
+        return out
+
+    def _check_unique_live_tasks(self, tick: int) -> List[Violation]:
+        seen: Dict[str, str] = {}
+        out = []
+        for t in self._runner.cluster.live_tasks():
+            if t.task_name in seen:
+                out.append(Violation(
+                    "unique-live-launch",
+                    f"{t.task_name} alive twice: {seen[t.task_name]} and "
+                    f"{t.task_id}", tick))
+            else:
+                seen[t.task_name] = t.task_id
+        return out
+
+    def _check_ledger(self, tick: int) -> List[Violation]:
+        sched = self._runner.scheduler
+        out = []
+        mem = {r.key: r for r in sched.ledger.all()}
+        persisted = {r.key: r for r in
+                     sched.reservation_store.load_ledger().all()}
+        for key in mem.keys() - persisted.keys():
+            out.append(Violation(
+                "ledger-durability",
+                f"in-memory reservation {key} never persisted (a restart "
+                "would lose it)", tick))
+        for key in persisted.keys() - mem.keys():
+            out.append(Violation(
+                "ledger-leak",
+                f"persisted reservation {key} not in the live ledger "
+                "(leaked by replace/decommission GC)", tick))
+
+        degraded = {a.agent_id for a in self._runner.cluster.agents()
+                    if a.tpu.degraded}
+        for agent in self._runner.cluster.agents():
+            if agent.agent_id in degraded:
+                continue  # capacity legitimately below held reservations
+            cpus, mem_mb, disk_mb, tpus = sched.ledger.reserved_scalars(
+                agent.agent_id)
+            if (cpus > agent.cpus + 1e-9 or mem_mb > agent.memory_mb
+                    or disk_mb > agent.disk_mb or tpus > agent.tpu.chips):
+                out.append(Violation(
+                    "ledger-double-book",
+                    f"{agent.agent_id} reserved ({cpus}, {mem_mb}, "
+                    f"{disk_mb}, {tpus}) exceeds capacity ({agent.cpus}, "
+                    f"{agent.memory_mb}, {agent.disk_mb}, "
+                    f"{agent.tpu.chips})", tick))
+
+        pods = {p.type: p for p in sched.spec.pods}
+        for r in mem.values():
+            pod_type, _, idx = r.pod_instance_name.rpartition("-")
+            pod = pods.get(pod_type)
+            if pod is None or not idx.isdigit() or int(idx) >= pod.count:
+                out.append(Violation(
+                    "ledger-orphan",
+                    f"reservation {r.key} held by unknown/excess pod "
+                    f"instance {r.pod_instance_name}", tick))
+        return out
+
+    def _check_gang_ranks(self, tick: int) -> List[Violation]:
+        sched = self._runner.scheduler
+        gang_pods = {p.type for p in sched.spec.pods
+                     if p.tpu is not None and p.tpu.gang}
+        out = []
+        for task in sched.state.fetch_tasks():
+            if task.pod_type not in gang_pods:
+                continue
+            rank = task.env.get("JAX_PROCESS_ID")
+            if rank != str(task.pod_index):
+                out.append(Violation(
+                    "gang-stable-rank",
+                    f"{task.task_name} relaunched with JAX_PROCESS_ID="
+                    f"{rank!r}, expected {task.pod_index}", tick))
+        return out
+
+    def _check_backoff_monotone(self, tick: int) -> List[Violation]:
+        backoff = self._runner.scheduler.backoff
+        if not isinstance(backoff, ExponentialBackoff):
+            return []
+        out = []
+        snap = backoff.snapshot()
+        for task, (delay, epoch) in snap.items():
+            prev = self._prev_backoff.get(task)
+            # a new epoch is a deliberate reset (task reached RUNNING and
+            # crashed again); within an epoch the delay may only grow
+            if prev is not None and prev[1] == epoch and delay < prev[0]:
+                out.append(Violation(
+                    "backoff-monotone",
+                    f"{task} delay shrank {prev[0]} -> {delay} without a "
+                    "reset", tick))
+        self._prev_backoff = snap
+        return out

@@ -1,0 +1,296 @@
+"""Seeded chaos soak: one RNG seed -> one reproducible fault schedule.
+
+The soak deploys a mixed service (a plain CPU pod plus a gang-scheduled
+TPU worker pod) on a fake cluster wrapped in :class:`ChaosCluster`, then
+runs a storm phase — every tick rolls the environment fault dice (agent
+flap/loss, chip degradation, task crashes, scheduler crash-restart) while
+the transport faults chew on statuses and launches — followed by a heal
+phase where the weather stops and the service must converge back to plan
+COMPLETE within a bounded cycle budget. Invariants are audited after
+every tick of both phases.
+
+Everything nondeterministic is pinned: the RNG is ``random.Random(seed)``,
+backoff runs on a virtual clock advanced once per cycle, and every
+wall-clock grace in the scheduler is set to zero so reconciliation
+verdicts don't depend on host speed. Re-running a seed replays the exact
+schedule — which is what makes the corpus in ``tests/chaos_corpus.json``
+regression tests rather than flakes.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional
+
+from ..plan.backoff import ExponentialBackoff
+from ..plan.status import Status
+from ..scheduler.recovery import AgentGoneFailureMonitor
+from ..testing.simulation import (ServiceTestRunner, default_agents,
+                                  tpu_slice_agents)
+from ..state.tasks import TaskState
+from .engine import ChaosCluster, FaultConfig
+from .invariants import InvariantChecker, Violation
+
+# A service wide enough to exercise every recovery path: an unconstrained
+# CPU pod (plain relaunch recovery) and a gang TPU pod at full slice
+# occupancy (gang re-form, pinned reservations, slice capacity pressure).
+CHAOS_YML = """
+name: chaos-soak
+pods:
+  web:
+    count: 2
+    tasks:
+      server:
+        goal: RUNNING
+        essential: true
+        cmd: "./web"
+        cpus: 1.0
+        memory: 512
+  worker:
+    count: 4
+    tpu:
+      chips: 4
+      topology: v4-16
+      gang: true
+    tasks:
+      train:
+        goal: RUNNING
+        essential: true
+        cmd: "./train"
+        cpus: 2.0
+        memory: 2048
+        tpus: 4
+"""
+
+SETTLE_BUDGET = 80  # cycles the heal phase gets to reach COMPLETE
+MAX_AGENTS_OUT = 2  # storm never takes down more hosts at once
+
+
+@dataclass
+class SoakReport:
+    seed: int
+    ticks: int
+    converged: bool
+    violations: List[Violation]
+    fault_counts: Dict[str, int]
+    plan_statuses: Dict[str, str]
+    trace: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.converged and not self.violations
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "ticks": self.ticks,
+            "ok": self.ok,
+            "converged": self.converged,
+            "violations": [str(v) for v in self.violations],
+            "fault_counts": dict(sorted(self.fault_counts.items())),
+            "plan_statuses": self.plan_statuses,
+        }
+
+
+class _Soak:
+    def __init__(self, seed: int, ticks: int, config: FaultConfig):
+        self.seed = seed
+        self.ticks = ticks
+        self.config = config
+        self.rng = random.Random(seed)
+        self.vtime = [0.0]
+        self.trace: List[str] = []
+        self.violations: List[Violation] = []
+        # agent_id -> (return tick, AgentInfo) for flaps/clones/heals
+        self.pending_returns: List[tuple] = []
+        self.pending_heals: List[tuple] = []
+        self.env_fault_counts: Dict[str, int] = {}
+
+        # the failure monitor needs the cluster the runner is about to
+        # build; late-bind through a closure over `self`
+        monitor = AgentGoneFailureMonitor(lambda: self.runner.cluster.agents())
+        self.runner = ServiceTestRunner(
+            CHAOS_YML,
+            agents=default_agents(3) + tpu_slice_agents(4, chips=4),
+            cluster_wrapper=lambda inner: ChaosCluster(inner, self.rng,
+                                                       config),
+            backoff=ExponentialBackoff(initial_s=1.0, max_s=8.0, factor=2.0,
+                                       clock=lambda: self.vtime[0]),
+            failure_monitor=monitor,
+        )
+        self.chaos: ChaosCluster = self.runner.scheduler_cluster
+        self.checker = InvariantChecker(self.runner)
+        self._tune()
+
+    def _tune(self) -> None:
+        # zero every wall-clock grace: reconciliation verdicts must depend
+        # on the fault schedule, not on how fast this host runs a tick
+        self.runner.scheduler.launch_report_grace_s = 0.0
+
+    def _log(self, msg: str) -> None:
+        self.trace.append(msg)
+
+    def _count(self, fault: str) -> None:
+        self.env_fault_counts[fault] = self.env_fault_counts.get(fault, 0) + 1
+
+    # -- environment faults ------------------------------------------------
+
+    def _live_agent_ids(self) -> List[str]:
+        return sorted(a.agent_id for a in self.runner.cluster.agents())
+
+    def _agents_out(self) -> int:
+        return len(self.pending_returns)
+
+    def _inject(self, tick: int) -> None:
+        cfg = self.config
+        rng = self.rng
+        cluster = self.runner.cluster
+        if cfg.agent_flap and rng.random() < cfg.agent_flap \
+                and self._agents_out() < MAX_AGENTS_OUT:
+            agents = {a.agent_id: a for a in cluster.agents()}
+            victim = rng.choice(sorted(agents))
+            cluster.remove_agent(victim)
+            back = tick + rng.randint(1, 2)
+            self.pending_returns.append((back, agents[victim]))
+            self._count("agent_flap")
+            self._log(f"tick {tick}: agent_flap {victim} (back @{back})")
+        if cfg.agent_loss and rng.random() < cfg.agent_loss \
+                and self._agents_out() < MAX_AGENTS_OUT:
+            victim = rng.choice(sorted(a.agent_id
+                                       for a in cluster.agents()))
+            # the replacement ships healthy silicon: heal the victim
+            # first so the clone doesn't inherit a degraded inventory
+            # (its scheduled heal would target the dead agent id)
+            cluster.heal_tpu(victim)
+            self.pending_heals = [(t, a) for t, a in self.pending_heals
+                                  if a != victim]
+            info = {a.agent_id: a for a in cluster.agents()}[victim]
+            cluster.remove_agent(victim)
+            # a fresh host joins in its place: new id, same substrate
+            # (same slice/coords for TPU hosts, so the gang can re-form)
+            clone = replace(info,
+                            agent_id=f"{victim}-r{tick}",
+                            hostname=f"{info.hostname}-r{tick}")
+            back = tick + rng.randint(2, 4)
+            self.pending_returns.append((back, clone))
+            self._count("agent_loss")
+            self._log(f"tick {tick}: agent_loss {victim} "
+                      f"(replacement {clone.agent_id} @{back})")
+        if cfg.degrade and rng.random() < cfg.degrade:
+            tpu_ids = [a.agent_id for a in cluster.agents()
+                       if a.tpu.chips > 0 and not a.tpu.degraded]
+            if tpu_ids:
+                victim = rng.choice(sorted(tpu_ids))
+                chips = next(a.tpu.chips for a in cluster.agents()
+                             if a.agent_id == victim)
+                cluster.degrade_tpu(victim, chips - 1)
+                heal = tick + rng.randint(2, 4)
+                self.pending_heals.append((heal, victim))
+                self._count("degrade")
+                self._log(f"tick {tick}: degrade_tpu {victim} "
+                          f"-> {chips - 1} chips (heal @{heal})")
+        if cfg.task_crash and rng.random() < cfg.task_crash:
+            live = sorted(cluster.live_tasks(), key=lambda t: t.task_id)
+            if live:
+                victim = rng.choice(live)
+                cluster.send_status(victim.task_id, TaskState.FAILED,
+                                    message="chaos: task crash")
+                self._count("task_crash")
+                self._log(f"tick {tick}: task_crash {victim.task_name}")
+        if cfg.crash_restart and rng.random() < cfg.crash_restart:
+            self.runner.restart_scheduler()
+            self._tune()
+            self._count("crash_restart")
+            self._log(f"tick {tick}: scheduler crash-restart")
+
+    def _release_environment(self, tick: int, force: bool = False) -> None:
+        due = [(t, a) for t, a in self.pending_returns
+               if force or t <= tick]
+        self.pending_returns = [(t, a) for t, a in self.pending_returns
+                                if not (force or t <= tick)]
+        for _, agent in due:
+            self.runner.cluster.add_agent(agent)
+            self._log(f"tick {tick}: agent {agent.agent_id} joined")
+        live = {a.agent_id for a in self.runner.cluster.agents()}
+        keep = []
+        for t, agent_id in self.pending_heals:
+            if (force or t <= tick) and agent_id in live:
+                self.runner.cluster.heal_tpu(agent_id)
+                self._log(f"tick {tick}: tpu healed on {agent_id}")
+            else:
+                # not due yet, or flapped out: heal once it re-registers
+                keep.append((t, agent_id))
+        self.pending_heals = keep
+
+    # -- phases ------------------------------------------------------------
+
+    def _check(self, tick: int) -> None:
+        found = self.checker.check(tick)
+        for v in found:
+            self._log(f"VIOLATION {v}")
+        self.violations.extend(found)
+
+    def _cycle(self) -> None:
+        self.vtime[0] += 1.0
+        self.runner.scheduler.run_cycle()
+        self.runner.scheduler.reconcile()
+
+    def _plans_complete(self) -> bool:
+        sched = self.runner.scheduler
+        for name in ("deploy", "recovery"):
+            plan = sched.plan(name)
+            if plan is not None and plan.status is not Status.COMPLETE:
+                return False
+        return True
+
+    def run(self) -> SoakReport:
+        for tick in range(self.ticks):
+            self._release_environment(tick)
+            self._inject(tick)
+            # release the transport's due events first so zombies from
+            # late launches are visible to this tick's reconciliation
+            self.chaos.tick()
+            self._cycle()
+            self._check(tick)
+
+        # heal phase: weather stops, everything pending lands, and the
+        # service must find its way back on its own
+        self._release_environment(self.ticks, force=True)
+        self.chaos.config = FaultConfig.none()
+        self.chaos.flush()
+        converged = False
+        for i in range(SETTLE_BUDGET):
+            tick = self.ticks + i
+            self.chaos.tick()
+            self._cycle()
+            self._check(tick)
+            if self._plans_complete() and self.chaos.pending_events == 0:
+                converged = True
+                self._log(f"tick {tick}: converged after {i + 1} settle "
+                          "cycles")
+                break
+        if not converged:
+            self._log(f"NOT CONVERGED after {SETTLE_BUDGET} settle cycles: "
+                      + "; ".join(
+                          f"{p.name}={p.status.name}"
+                          for p in self.runner.scheduler.plans))
+
+        return SoakReport(
+            seed=self.seed,
+            ticks=self.ticks,
+            converged=converged,
+            violations=self.violations,
+            fault_counts={**self.chaos.fault_counts,
+                          **self.env_fault_counts},
+            plan_statuses={p.name: p.status.name
+                           for p in self.runner.scheduler.plans},
+            trace=self.trace,
+        )
+
+
+def run_soak(seed: int, ticks: int = 40,
+             config: Optional[FaultConfig] = None) -> SoakReport:
+    """Run one seeded chaos schedule; see module docstring. ``config``
+    defaults to every fault class armed (:meth:`FaultConfig.all_faults`)."""
+    return _Soak(seed, ticks, config or FaultConfig.all_faults()).run()

@@ -269,6 +269,7 @@ def _framework_default_env(path: str) -> dict:
     if i + 1 >= len(parts):
         return {}
     fw = parts[i + 1]
+    fw_main = os.path.join(os.sep.join(parts[:i + 2]), "main.py")
     for mod_name in (f"frameworks.{fw}.scenarios", f"frameworks.{fw}.main"):
         try:
             mod = importlib.import_module(mod_name)
@@ -276,11 +277,16 @@ def _framework_default_env(path: str) -> dict:
             continue
         env = getattr(mod, "DEFAULT_ENV", None)
         if env:
-            return dict(env)
+            out = dict(env)
+            # launch-time derived keys (merged["CASSANDRA_SEEDS"] = ...)
+            # live outside the literal dict; the AST scan finds them in
+            # either path so the rendered template sees every key
+            for key, val in _default_env_from_source(fw_main).items():
+                out.setdefault(key, val)
+            return out
     # import-free fallback: some framework mains need optional deps
     # (e.g. cryptography) just to import; DEFAULT_ENV is always a literal
     # dict, so read it straight out of the AST
-    fw_main = os.path.join(os.sep.join(parts[:i + 2]), "main.py")
     return _default_env_from_source(fw_main)
 
 
@@ -364,6 +370,36 @@ def _lint_cmd(client: Client, args) -> int:
         findings.extend(lint_entrypoints(suppress=suppress))
     print(render_report(findings, label="lint"))
     return 1 if errors(findings) else 0
+
+
+def _chaos_soak_cmd(client: Client, args) -> int:
+    """``tpuctl chaos-soak``: run seeded fault-injection schedules against
+    the simulated reference service (no live scheduler involved; the
+    ``--url`` flag is ignored). Exit 0 when every seed converges with zero
+    invariant violations; otherwise exit 1 and print the offending seed's
+    tick trace so ``--seed N`` reproduces it exactly. See
+    docs/fault-tolerance.md."""
+    from ..chaos import run_soak
+    from ..chaos.engine import parse_faults
+    config = parse_faults(args.faults)
+    seeds = (range(args.seeds) if args.seed is None else [args.seed])
+    failed = None
+    for seed in seeds:
+        report = run_soak(seed, ticks=args.ticks, config=config)
+        print(json.dumps(report.to_dict()))
+        if not report.ok:
+            failed = report
+            break
+    if failed is not None:
+        print(f"\nchaos-soak FAILED at seed {failed.seed} "
+              f"(reproduce: tpuctl chaos-soak --seed {failed.seed} "
+              f"--ticks {failed.ticks} --faults {args.faults})",
+              file=sys.stderr)
+        print("tick trace:", file=sys.stderr)
+        for line in failed.trace:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    return 0
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -461,6 +497,22 @@ def build_parser() -> argparse.ArgumentParser:
                       help="also trace + lint the registered hot-path "
                            "entrypoints (slower; imports jax)")
     lint.set_defaults(fn=_lint_cmd)
+
+    soak = sub.add_parser(
+        "chaos-soak", help="seeded fault-injection soak over the "
+                           "simulated reference service")
+    soak.add_argument("--seed", type=int, default=None,
+                      help="run exactly this seed (default: sweep "
+                           "0..--seeds-1)")
+    soak.add_argument("--seeds", type=int, default=100,
+                      help="number of seeds to sweep when --seed is not "
+                           "given (default 100)")
+    soak.add_argument("--ticks", type=int, default=40,
+                      help="storm-phase ticks per schedule (default 40)")
+    soak.add_argument("--faults", default="all",
+                      help="'all' or comma-separated fault classes "
+                           "(e.g. status_drop,agent_flap)")
+    soak.set_defaults(fn=_chaos_soak_cmd)
     return p
 
 

@@ -24,6 +24,11 @@ class Backoff:
         """Seconds until the task may launch again; 0 = launch now."""
         raise NotImplementedError
 
+    def forget(self, task_name: str) -> None:
+        """Drop all state for a task removed from the state store
+        (decommission/replace GC) — long-running schedulers must not
+        accumulate delay entries for tasks that no longer exist."""
+
 
 class DisabledBackoff(Backoff):
     def on_launch(self, task_name: str) -> None:
@@ -45,13 +50,21 @@ class ExponentialBackoff(Backoff):
         self._max = max_s
         self._factor = factor
         self._clock = clock
-        # task -> (current delay, not-before timestamp)
-        self._delays: Dict[str, tuple[float, float]] = {}
+        # task -> (current delay, not-before timestamp, entry epoch)
+        self._delays: Dict[str, tuple[float, float, int]] = {}
+        # bumped whenever a task (re)enters backoff after a reset, so an
+        # observer can distinguish "delay legitimately restarted at
+        # initial" from "delay regressed" (chaos backoff-monotone check)
+        self._epochs = 0
 
     def on_launch(self, task_name: str) -> None:
         prev = self._delays.get(task_name)
-        delay = self._initial if prev is None else min(prev[0] * self._factor, self._max)
-        self._delays[task_name] = (delay, self._clock() + delay)
+        if prev is None:
+            self._epochs += 1
+            delay, epoch = self._initial, self._epochs
+        else:
+            delay, epoch = min(prev[0] * self._factor, self._max), prev[2]
+        self._delays[task_name] = (delay, self._clock() + delay, epoch)
 
     def on_running(self, task_name: str) -> None:
         self._delays.pop(task_name, None)
@@ -61,3 +74,17 @@ class ExponentialBackoff(Backoff):
         if entry is None:
             return 0.0
         return max(0.0, entry[1] - self._clock())
+
+    def forget(self, task_name: str) -> None:
+        self._delays.pop(task_name, None)
+
+    def tracked_tasks(self) -> list[str]:
+        """Tasks currently holding a delay entry (soak-leak assertions and
+        the chaos invariant checker's monotonicity snapshot)."""
+        return list(self._delays)
+
+    def snapshot(self) -> Dict[str, tuple[float, int]]:
+        """task -> (current delay, entry epoch), for monotonicity checks
+        across ticks: within one epoch the delay may only grow."""
+        return {name: (entry[0], entry[2])
+                for name, entry in self._delays.items()}

@@ -268,10 +268,12 @@ class ServiceScheduler:
         exists only when a task asks for transport-encryption) — a live
         update that introduces TLS must rebuild it or new launches would
         silently ship without certs."""
-        from ..security import TLSProvisioner
         uses_tls = any(t.transport_encryption
                        for p in self.spec.pods for t in p.tasks)
         if uses_tls and self.tls_provisioner is None:
+            # deferred import: pulls in the optional ``cryptography``
+            # package, which only specs that request TLS should require
+            from ..security import TLSProvisioner
             self.tls_provisioner = TLSProvisioner(self._persister,
                                                   self.spec.name,
                                                   tld=self.tld)
@@ -328,14 +330,17 @@ class ServiceScheduler:
                 self._agent_missing_since.pop(agent_id, None)
             for task in self.state.fetch_tasks():
                 status = self.state.fetch_status(task.task_name)
-                alive_in_store = status is None or (
-                    status.task_id == task.task_id
-                    and not status.state.terminal)
+                # a status from a PREVIOUS incarnation (task relaunched,
+                # new id not yet reporting) says nothing about the current
+                # one — treat it like a statusless launch, NOT like a dead
+                # task, or a lost launch instruction after a relaunch
+                # would never be detected and the pod would wedge forever
+                same_gen = status is not None and status.task_id == task.task_id
                 if task.task_id in reported:
                     reported.pop(task.task_id)
                     self._unreported_since.pop(task.task_id, None)
                     continue
-                if not alive_in_store:
+                if same_gen and status.state.terminal:
                     self._unreported_since.pop(task.task_id, None)
                     continue
                 if task.agent_id not in live_agents:
@@ -347,8 +352,9 @@ class ServiceScheduler:
                     # a live agent not reporting the task: allow the launch
                     # command one grace window to reach the agent, measured
                     # from the status timestamp (or from when we first saw
-                    # the task unreported, for statusless launches)
-                    if status is not None and status.timestamp:
+                    # the task unreported, for statusless or relaunched
+                    # tasks whose stored status is stale)
+                    if same_gen and status.timestamp:
                         fresh = (time.time() - status.timestamp
                                  < self.launch_report_grace_s)
                     else:
@@ -407,7 +413,11 @@ class ServiceScheduler:
             self.metrics.record_task_status(status.state.value)
         with self._state_lock:
             try:
-                self.state.store_status(task_name, status)
+                if not self.state.store_status(task_name, status):
+                    # exact redelivery of an already-stored status
+                    # (at-least-once transport): fully handled the first
+                    # time; feeding it again would only churn plan steps
+                    return False
             except StateStoreError:
                 # stale generation: a status for a task id we've since
                 # replaced

@@ -53,6 +53,10 @@ def _erase_pod_action(scheduler, pod_instance_name: str) -> Callable[[], bool]:
     def action() -> bool:
         for task_name in scheduler.pod_instance_task_names(pod_instance_name):
             scheduler.state.delete_task(task_name)
+            # a deleted task must not leak its crash-loop delay entry —
+            # soaks that churn pods would otherwise grow backoff state
+            # forever (and a re-added pod would inherit a stale delay)
+            scheduler.backoff.forget(task_name)
         return True
     return action
 

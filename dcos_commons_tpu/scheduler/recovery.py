@@ -70,6 +70,26 @@ class TimedFailureMonitor(FailureMonitor):
         return (self._clock() - status.timestamp) >= self._timeout
 
 
+class AgentGoneFailureMonitor(FailureMonitor):
+    """Escalate when the failed task's agent has left the inventory: a
+    TRANSIENT relaunch pins to the pod's existing reservation, and a
+    reservation on a vanished host can never match again — the pod would
+    wedge until an operator ran ``pod replace``. Deterministic (agent
+    membership, no wall clock), which is also what lets the chaos soak
+    drive permanent-loss schedules reproducibly from one seed.
+
+    ``agents_supplier`` is typically ``cluster.agents``. An agent that is
+    merely flapping escalates too — the replace lands back on the returned
+    host once its reservations are GC'd, so the pod converges either way.
+    """
+
+    def __init__(self, agents_supplier: Callable[[], Sequence]):
+        self._agents = agents_supplier
+
+    def is_permanent(self, task, status) -> bool:
+        return task.agent_id not in {a.agent_id for a in self._agents()}
+
+
 class TestingFailureMonitor(FailureMonitor):
     """Reference ``monitor/TestingFailureMonitor`` — force classification."""
 

@@ -13,12 +13,24 @@ artifacts to sandboxes through the existing config-template channel that
 from .auth import (Authenticator, AuthError, CachedTokenProvider, Principal,
                    ServiceAccount, TokenAuthority, auth_headers_from_env,
                    generate_auth_config)
-from .ca import CertificateAuthority
 from .secrets import SecretsStore
-from .tls import TLSArtifactPaths, TLSProvisioner, certificate_names
-from .transport import (ServerCredentials, client_context,
-                        client_context_from_env, mint_server_credentials,
-                        server_context, server_tls_from_env)
+
+from .._lazy import lazy_exports
+
+# ca/tls/transport need the optional ``cryptography`` package; re-export
+# them lazily so schedulers that never provision TLS (every test, and any
+# deployment without transport-encryption specs) work on hosts where it
+# is not installed — the import error surfaces only when a spec actually
+# asks for certificates.
+__getattr__, __dir__ = lazy_exports(__name__, {
+    "CertificateAuthority": "ca",
+    "TLSArtifactPaths": "tls", "TLSProvisioner": "tls",
+    "certificate_names": "tls",
+    "ServerCredentials": "transport", "client_context": "transport",
+    "client_context_from_env": "transport",
+    "mint_server_credentials": "transport", "server_context": "transport",
+    "server_tls_from_env": "transport",
+}, globals())
 
 __all__ = [
     "AuthError",

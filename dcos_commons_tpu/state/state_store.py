@@ -206,19 +206,29 @@ class StateStore:
                 self._tasks_cache = (gen, out)
         return list(out)
 
-    def store_status(self, task_name: str, status: TaskStatus) -> None:
+    def store_status(self, task_name: str, status: TaskStatus) -> bool:
         """Reference ``storeStatus:257`` — validates the status belongs to the
         stored task id (stale statuses from a previous launch are dropped by
-        the caller; we enforce the id match here)."""
+        the caller; we enforce the id match here).
+
+        Returns False when the stored status is already byte-identical
+        (``to_json`` is sorted, so equal payloads serialize equally): an
+        at-least-once transport redelivering a status must not bump
+        ``statuses_generation`` — a dup would otherwise defeat the
+        recovery scan's empty-verdict cache every retry — nor re-feed
+        plans a verdict they already consumed."""
         task = self.fetch_task(task_name)
         if task is not None and task.task_id != status.task_id:
             raise StateStoreError(
                 f"status task id {status.task_id} != stored {task.task_id}")
-        self._persister.set(
-            self._path(self.TASKS, _esc(task_name), self.TASK_STATUS),
-            status.to_json())
+        path = self._path(self.TASKS, _esc(task_name), self.TASK_STATUS)
+        raw = status.to_json()
+        if self._persister.get_or_none(path) == raw:
+            return False
+        self._persister.set(path, raw)
         with self._cache_lock:
             self._status_gen += 1  # after the write; see store_tasks
+        return True
 
     def fetch_status(self, task_name: str) -> Optional[TaskStatus]:
         path = self._path(self.TASKS, _esc(task_name), self.TASK_STATUS)

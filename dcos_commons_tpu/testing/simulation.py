@@ -91,6 +91,7 @@ class ServiceTestRunner:
                  env: Optional[dict] = None,
                  agents: Optional[Sequence[AgentInfo]] = None,
                  persister: Optional[MemPersister] = None,
+                 cluster_wrapper: Optional[Callable[[FakeCluster], object]] = None,
                  **scheduler_kwargs):
         if (yaml_text is None) == (spec is None):
             raise ValueError("provide exactly one of yaml_text or spec")
@@ -98,9 +99,16 @@ class ServiceTestRunner:
         self.persister = persister or MemPersister()
         self.cluster = FakeCluster(agents if agents is not None
                                    else default_agents())
+        # the scheduler may talk to the fake through an interposer (the
+        # chaos engine wraps it to drop/delay/reorder statuses); ticks and
+        # Expect assertions keep reading the unwrapped fake directly
+        self.scheduler_cluster = (cluster_wrapper(self.cluster)
+                                  if cluster_wrapper else self.cluster)
+        self._cluster_wrapper = cluster_wrapper
         self.scheduler_kwargs = scheduler_kwargs
         self.scheduler = ServiceScheduler(self.spec, self.persister,
-                                          self.cluster, **scheduler_kwargs)
+                                          self.scheduler_cluster,
+                                          **scheduler_kwargs)
         # Expect.launched_tasks consumes the launch log incrementally
         self._launch_cursor = 0
         # failure diagnostics for free: under pytest, a failing test
@@ -121,7 +129,7 @@ class ServiceTestRunner:
             self.spec = load_service_yaml_str(yaml_text, env or {})
         kwargs = {**self.scheduler_kwargs, **scheduler_kwargs}
         self.scheduler = ServiceScheduler(self.spec, self.persister,
-                                          self.cluster, **kwargs)
+                                          self.scheduler_cluster, **kwargs)
         from dcos_commons_tpu.testing import diag
         diag.register_scheduler(self.scheduler)
 

@@ -13,6 +13,7 @@ import time
 from typing import Mapping, Optional
 
 from dcos_commons_tpu.agent.remote import RemoteCluster
+from dcos_commons_tpu.agent.retry import RetryingAgentClient
 from dcos_commons_tpu.http import ApiServer
 from dcos_commons_tpu.security import Authenticator
 from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
@@ -153,13 +154,17 @@ def main(argv=None) -> int:
     # ensemble when TPU_STATE_ENDPOINTS is set, else local files
     persister, lock = open_state(args.state)
     cluster = RemoteCluster()
+    # the scheduler's launch/kill RPCs ride the retrying wrapper
+    # (bounded attempts, jittered backoff, per-call deadline); the
+    # API server keeps the raw client for read-only passthrough
+    sched_cluster = RetryingAgentClient(cluster)
     # control-plane auth: TPU_AUTH_FILE names the accounts file
     _auth = Authenticator.from_env()
     # transport security: TPU_TLS=1 mints from the persisted CA (or
     # TPU_TLS_CERT/TPU_TLS_KEY name provisioned PEMs)
     from dcos_commons_tpu.security import server_tls_from_env
     _tls = server_tls_from_env(persister, "cassandra", args.state)
-    scheduler = build_scheduler(persister, cluster, metrics=metrics,
+    scheduler = build_scheduler(persister, sched_cluster, metrics=metrics,
                                 auth=_auth)
     scheduler.respec = lambda env: load_spec(env)
     server = ApiServer(scheduler, port=args.port, metrics=metrics,

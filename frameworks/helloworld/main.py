@@ -24,6 +24,7 @@ import sys
 import time
 
 from dcos_commons_tpu.agent.remote import RemoteCluster
+from dcos_commons_tpu.agent.retry import RetryingAgentClient
 from dcos_commons_tpu.http import ApiServer
 from dcos_commons_tpu.security import Authenticator
 from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
@@ -67,6 +68,10 @@ def main(argv=None) -> int:
     # ensemble when TPU_STATE_ENDPOINTS is set, else local files
     persister, lock = open_state(args.state)
     cluster = RemoteCluster()
+    # the scheduler's launch/kill RPCs ride the retrying wrapper
+    # (bounded attempts, jittered backoff, per-call deadline); the
+    # API server keeps the raw client for read-only passthrough
+    sched_cluster = RetryingAgentClient(cluster)
     # control-plane auth: TPU_AUTH_FILE names the accounts file
     _auth = Authenticator.from_env()
     # transport security: TPU_TLS=1 mints from the persisted CA (or
@@ -77,7 +82,7 @@ def main(argv=None) -> int:
     if len(args.scenario) == 1:
         # mono-service (reference Main.java runDefaultService path)
         spec = scenarios.load_scenario(args.scenario[0])
-        scheduler = ServiceScheduler(spec, persister, cluster,
+        scheduler = ServiceScheduler(spec, persister, sched_cluster,
                                      metrics=metrics, auth=_auth)
         # live updates: re-render this scenario with new option env
         scheduler.respec = (
@@ -90,8 +95,8 @@ def main(argv=None) -> int:
     else:
         # multi-service, static or dynamic (reference
         # Main.java:54-82 multi paths + ExampleMultiServiceResource)
-        multi = MultiServiceScheduler(persister, cluster, metrics=metrics,
-                                      auth=_auth)
+        multi = MultiServiceScheduler(persister, sched_cluster,
+                                      metrics=metrics, auth=_auth)
         server = ApiServer(None, port=args.port, metrics=metrics,
                            cluster=cluster, multi=multi, auth=_auth,
                            tls=_tls)

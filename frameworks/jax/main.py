@@ -20,6 +20,7 @@ import sys
 import time
 
 from dcos_commons_tpu.agent.remote import RemoteCluster
+from dcos_commons_tpu.agent.retry import RetryingAgentClient
 from dcos_commons_tpu.http import ApiServer
 from dcos_commons_tpu.security import Authenticator
 from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
@@ -60,6 +61,10 @@ def main(argv=None) -> int:
     # ensemble when TPU_STATE_ENDPOINTS is set, else local files
     persister, lock = open_state(args.state)
     cluster = RemoteCluster()
+    # the scheduler's launch/kill RPCs ride the retrying wrapper
+    # (bounded attempts, jittered backoff, per-call deadline); the
+    # API server keeps the raw client for read-only passthrough
+    sched_cluster = RetryingAgentClient(cluster)
     # control-plane auth: TPU_AUTH_FILE names the accounts file
     _auth = Authenticator.from_env()
     # transport security: TPU_TLS=1 mints from the persisted CA (or
@@ -67,8 +72,8 @@ def main(argv=None) -> int:
     from dcos_commons_tpu.security import server_tls_from_env
     _tls = server_tls_from_env(persister, "jax", args.state)
     spec = scenarios.load_scenario(args.scenario)
-    scheduler = ServiceScheduler(spec, persister, cluster, metrics=metrics,
-                                 auth=_auth)
+    scheduler = ServiceScheduler(spec, persister, sched_cluster,
+                                 metrics=metrics, auth=_auth)
     scheduler.respec = (lambda env, _name=args.scenario:
                         scenarios.load_scenario(_name, env))
     server = ApiServer(scheduler, port=args.port, metrics=metrics,

@@ -551,9 +551,17 @@ def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
             _emit({"event": "resumed", "step": start, "sharded": True})
         else:
             params, opt_state = w_params, w_opt
+
+        # fault sentinel: preemption flush, NaN rollback, stall watchdog
+        # (frameworks/jax/sentinel.py; knobs SENTINEL_* in the task env)
+        from . import sentinel as sentinel_mod
+        sent = sentinel_mod.FaultSentinel.from_env(emit=_emit)
+        sent.install()
         t0 = time.perf_counter()
         steps_run = 0
-        for i in range(start, args.steps):
+
+        def run_step(i):
+            nonlocal params, opt_state, out, steps_run
             params, opt_state, out = step(params, opt_state, toks)
             steps_run += 1
             if args.out and args.ckpt_every \
@@ -562,7 +570,42 @@ def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
                                   {"params": params,
                                    "opt_state": opt_state})
                 _emit({"event": "checkpoint", "step": i + 1})
+            return out
+
+        def save(i):
+            if args.out:
+                ckpt.save_sharded(args.out, i,
+                                  {"params": params, "opt_state": opt_state})
+                _emit({"event": "checkpoint", "step": i})
+
+        def restore():
+            nonlocal params, opt_state
+            if not args.out:
+                return None
+            restore_step = ckpt.latest_step(args.out)
+            if restore_step is None:
+                return None
+            tree = ckpt.restore_sharded(
+                args.out, {"params": params, "opt_state": opt_state},
+                restore_step)
+            # optimizer state travels with the params: the LR schedule
+            # resumes at the restored step, not at a reset one
+            params, opt_state = tree["params"], tree["opt_state"]
+            return restore_step
+
+        stopped, end_step = sentinel_mod.guarded_loop(
+            sent, start, args.steps, run_step,
+            lambda result: float(result["loss"]), save, restore, emit=_emit)
+        sent.uninstall()
         dt = time.perf_counter() - t0
+        if stopped == "preempted":
+            # checkpoint already flushed by guarded_loop; report honestly
+            # and let main() exit with the conventional SIGTERM code
+            seq = toks.shape[1] - 1
+            return {"workload": "llama-train", "attn": attn_name,
+                    "seq": seq, "mesh": mesh_report, "stopped": "preempted",
+                    "resume_step": end_step, "steps_run": steps_run,
+                    "process_id": contract["process_id"]}
         if resumed and steps_run == 0:
             # already at/past the target step: nothing ran, and `out` is
             # the discarded warmup of a random init — report honestly and
@@ -809,6 +852,10 @@ def main(argv=None) -> int:
     else:
         result = WORKLOADS[args.workload](args)
     _emit({"event": "done", **result})
+    if result.get("stopped") == "preempted":
+        # conventional SIGTERM exit: the checkpoint is flushed, and the
+        # scheduler's relaunch resumes from it
+        return 143
     return 0
 
 

@@ -11,6 +11,9 @@ make -C native
 echo "== lint gate (static_check + type_check + airgap + spec S-rules + jaxpr J-rules) =="
 python -m tools.lint
 
+echo "== chaos smoke (seeded fault-injection, time-capped) =="
+python -m tools.chaos_smoke --budget-s "${CHAOS_SMOKE_BUDGET_S:-60}"
+
 echo "== test suite =="
 python -m pytest tests/ -q -m "not soak" "$@"
 

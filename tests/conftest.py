@@ -23,6 +23,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "soak: opt-in churn tier (TPU_SOAK=1; reference tier-4 soak marks)")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tiers excluded from tier-1 (-m 'not slow')")
 
 
 import pytest  # noqa: E402

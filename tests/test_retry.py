@@ -1,0 +1,138 @@
+"""RetryingAgentClient (agent/retry.py): bounded retry, jittered backoff,
+per-call deadline, and transparency over a healthy client."""
+
+import random
+
+import pytest
+
+from dcos_commons_tpu.agent.retry import RetryingAgentClient
+from dcos_commons_tpu.testing.simulation import (Expect, Send,
+                                                 ServiceTestRunner,
+                                                 default_agents)
+
+HELLO_YML = """
+name: hello
+pods:
+  hello:
+    count: 2
+    tasks:
+      server:
+        goal: RUNNING
+        essential: true
+        cmd: "./hello"
+        cpus: 0.5
+        memory: 256
+"""
+
+
+class _Flaky:
+    """Fails each verb a scripted number of times, then succeeds."""
+
+    def __init__(self, failures: int):
+        self.failures = failures
+        self.calls = []
+
+    def _maybe_fail(self, verb):
+        self.calls.append(verb)
+        if self.failures > 0:
+            self.failures -= 1
+            raise ConnectionError(f"{verb}: backend unreachable")
+
+    def launch(self, plan):
+        self._maybe_fail("launch")
+
+    def kill(self, agent_id, task_id, grace_period_s=0.0):
+        self._maybe_fail("kill")
+
+    def destroy_volumes(self, agent_id, pod_instance_name):
+        self._maybe_fail("destroy_volumes")
+
+    def agents(self):
+        self.calls.append("agents")
+        return []
+
+
+class _Plan:
+    class agent:
+        agent_id = "agent-0"
+
+
+def _client(inner, **kw):
+    kw.setdefault("rng", random.Random(0))
+    kw.setdefault("sleep", lambda s: None)
+    return RetryingAgentClient(inner, **kw)
+
+
+def test_wrapper_is_transparent_over_fake_cluster():
+    """Satellite acceptance: FakeCluster behavior through the wrapper is
+    identical — same launch log, same deployment outcome."""
+    plain = ServiceTestRunner(HELLO_YML, agents=default_agents(1))
+    plain.run([Send.until_quiet(), Expect.deployed()])
+    wrapped = ServiceTestRunner(
+        HELLO_YML, agents=default_agents(1),
+        cluster_wrapper=lambda inner: RetryingAgentClient(inner))
+    wrapped.run([Send.until_quiet(), Expect.deployed()])
+    strip = lambda log: [[l.task_name for l in e.launches]  # noqa: E731
+                         for e in log]
+    assert strip(wrapped.cluster.launch_log) == strip(plain.cluster.launch_log)
+
+
+def test_transient_failure_retried_to_success():
+    inner = _Flaky(failures=2)
+    _client(inner).launch(_Plan())
+    assert inner.calls == ["launch", "launch", "launch"]
+
+
+def test_attempt_budget_exhausted_reraises():
+    inner = _Flaky(failures=99)
+    with pytest.raises(ConnectionError):
+        _client(inner, max_attempts=3).launch(_Plan())
+    assert inner.calls.count("launch") == 3
+
+
+def test_kill_and_destroy_volumes_also_retry():
+    inner = _Flaky(failures=1)
+    _client(inner).kill("agent-0", "t__1")
+    assert inner.calls == ["kill", "kill"]
+    inner = _Flaky(failures=1)
+    _client(inner).destroy_volumes("agent-0", "hello-0")
+    assert inner.calls == ["destroy_volumes", "destroy_volumes"]
+
+
+def test_backoff_is_jittered_and_capped():
+    delays = []
+    inner = _Flaky(failures=5)
+    _client(inner, max_attempts=6, base_delay_s=1.0, max_delay_s=2.0,
+            call_timeout_s=1000.0, sleep=delays.append).launch(_Plan())
+    assert len(delays) == 5  # sixth attempt succeeded
+    # caps double 1.0 -> 2.0 and stop: every jittered draw fits its cap
+    caps = [1.0, 2.0, 2.0, 2.0, 2.0]
+    assert all(0 < d <= c for d, c in zip(delays, caps))
+    assert len(set(delays)) > 1  # actually jittered, not fixed
+
+
+def test_per_call_deadline_beats_attempt_budget():
+    clock = [0.0]
+
+    def sleep(s):
+        clock[0] += s
+
+    inner = _Flaky(failures=99)
+    with pytest.raises(ConnectionError):
+        _client(inner, max_attempts=100, base_delay_s=1.0,
+                call_timeout_s=3.0, sleep=sleep,
+                clock=lambda: clock[0]).launch(_Plan())
+    # gave up well before 100 attempts: the deadline bounds cycle stall
+    assert inner.calls.count("launch") < 10
+
+
+def test_reads_pass_straight_through():
+    inner = _Flaky(failures=0)
+    assert _client(inner).agents() == []
+    assert inner.calls == ["agents"]  # exactly one call, no retry plumbing
+
+
+def test_unknown_attrs_delegate():
+    inner = _Flaky(failures=0)
+    inner.register = lambda: "transport-specific"
+    assert _client(inner).register() == "transport-specific"

@@ -1,0 +1,55 @@
+"""Time-capped chaos smoke for CI: a handful of seeded fault schedules.
+
+The full 100-seed sweep lives in ``tests/test_chaos.py`` (the
+``@pytest.mark.slow`` soak) and behind ``tpuctl chaos-soak``; this is the
+always-on CI slice test.sh runs next to the lint gate. It sweeps a fixed
+seed set until either the set is exhausted or the time budget runs out —
+a slow CI host skips tail seeds rather than timing out the build. Any
+non-converging seed or invariant violation fails the build and prints the
+reproduction command plus the tick trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=12,
+                    help="sweep seeds 0..N-1 (default 12)")
+    ap.add_argument("--ticks", type=int, default=40,
+                    help="storm ticks per schedule (default 40)")
+    ap.add_argument("--budget-s", type=float, default=60.0,
+                    help="wall-clock cap; tail seeds are skipped, not "
+                         "failed, when it runs out (default 60)")
+    args = ap.parse_args(argv)
+
+    from dcos_commons_tpu.chaos import run_soak
+
+    deadline = time.monotonic() + args.budget_s
+    ran = 0
+    for seed in range(args.seeds):
+        if time.monotonic() >= deadline:
+            print(f"chaos-smoke: time budget exhausted after {ran} seeds "
+                  f"(of {args.seeds}); remaining seeds skipped")
+            break
+        report = run_soak(seed, ticks=args.ticks)
+        ran += 1
+        if not report.ok:
+            print(json.dumps(report.to_dict(), indent=1))
+            print(f"\nchaos-smoke FAILED at seed {seed} (reproduce: "
+                  f"python -m dcos_commons_tpu.cli.main chaos-soak "
+                  f"--seed {seed} --ticks {args.ticks})", file=sys.stderr)
+            for line in report.trace:
+                print(f"  {line}", file=sys.stderr)
+            return 1
+    print(f"chaos-smoke: {ran} seeds converged, zero invariant violations")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
