@@ -1,0 +1,41 @@
+"""Shims that let the SDK's single modern-jax spelling run on older jax.
+
+The compute layer is written against the current jax API surface —
+``jax.shard_map(..., check_vma=...)`` and
+``pallas.tpu.CompilerParams`` — but deployment images pin whatever jax
+the TPU driver stack shipped with, and two renames straddle that range:
+
+* ``jax.shard_map`` graduated from ``jax.experimental.shard_map``; on
+  the way its replication-check knob was renamed ``check_rep`` ->
+  ``check_vma``.
+* ``pltpu.TPUCompilerParams`` was renamed ``pltpu.CompilerParams``.
+* ``lax.axis_size`` did not exist; the old spelling of the same query
+  is ``jax.core.axis_frame`` (which returns the size directly).
+
+Installing the modern names once here (imported from the package root,
+so any entry into the SDK picks them up) keeps every call site on one
+spelling instead of sprinkling per-module fallbacks.
+"""
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _compat_shard_map(f, *, mesh, in_specs, out_specs,
+                          check_vma=True, **kw):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma, **kw)
+
+    jax.shard_map = _compat_shard_map
+
+if not hasattr(jax.lax, "axis_size"):
+    jax.lax.axis_size = jax.core.axis_frame
+
+try:
+    from jax.experimental.pallas import tpu as _pltpu
+except ImportError:                                   # pallas not built in
+    _pltpu = None
+
+if _pltpu is not None and not hasattr(_pltpu, "CompilerParams"):
+    _pltpu.CompilerParams = _pltpu.TPUCompilerParams

@@ -107,6 +107,26 @@ def _trace_decode_step():
     return jax.make_jaxpr(step)(params, cache, lengths, tokens)
 
 
+def _trace_decode_step_paged():
+    from ..models import llama
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    slots, page_size = 4, 16
+    per_stream = cfg.max_seq // page_size
+    params = _abstract_params(
+        lambda: llama.init_params(cfg, jax.random.key(0)))
+    pool = _abstract_params(
+        lambda: llama.init_page_pool(cfg, slots * per_stream + 1,
+                                     page_size))
+    table = jax.ShapeDtypeStruct((slots, per_stream), jnp.int32)
+    lengths = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+
+    def step(p, pl, tbl, ln, tok):
+        return llama.decode_step_paged(cfg, p, pl, tbl, ln, tok)
+
+    return jax.make_jaxpr(step)(params, pool, table, lengths, tokens)
+
+
 def _trace_ring_attention():
     from ..parallel.mesh import MeshSpec
     from ..parallel.ring_attention import make_ring_attention
@@ -143,6 +163,14 @@ register_hot_path(HotPath(
     budget_bytes=1 << 20,
     description="decode_step_slots, the continuous-batching serving "
                 "kernel (must stay collective-free off-mesh)"))
+register_hot_path(HotPath(
+    "llama_decode_step_paged", _trace_decode_step_paged,
+    budget_bytes=1 << 20,
+    description="decode_step_paged, the block-paged serving kernel: "
+                "page-table gather + one-token attention (must stay "
+                "collective-free off-mesh, same budget as the slot "
+                "path — the gather view is never an fp32 "
+                "materialization bigger than the slot cache read)"))
 register_hot_path(HotPath(
     "ring_attention_fwd", _trace_ring_attention,
     budget_bytes=1 << 20, devices_needed=2,

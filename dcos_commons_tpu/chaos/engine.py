@@ -60,11 +60,15 @@ class FaultConfig:
     degrade: float = 0.0          # TPU agent loses a chip, heals later
     task_crash: float = 0.0       # a random live task FAILs
     crash_restart: float = 0.0    # scheduler process restart mid-run
+    # serving-facing faults (soak harness page-ledger sim): a paged
+    # serving stream vanishes without releasing its KV pages — the
+    # engine's crash sweep (PagePool.reconcile) must reclaim them
+    page_leak: float = 0.0
     max_delay_ticks: int = 3
 
     FIELDS = ("status_drop", "status_delay", "status_dup", "status_reorder",
               "launch_fail", "launch_slow", "agent_flap", "agent_loss",
-              "degrade", "task_crash", "crash_restart")
+              "degrade", "task_crash", "crash_restart", "page_leak")
 
     @classmethod
     def none(cls) -> "FaultConfig":
@@ -91,7 +95,7 @@ class FaultConfig:
         """Transport-only view, for the settle phase: held statuses still
         drain through the chaos queue but no new weather is scheduled."""
         return replace(self, agent_flap=0.0, agent_loss=0.0, degrade=0.0,
-                       task_crash=0.0, crash_restart=0.0)
+                       task_crash=0.0, crash_restart=0.0, page_leak=0.0)
 
 
 def parse_faults(arg: str) -> FaultConfig:

@@ -20,6 +20,12 @@ to a real reference-era incident class:
    be deliberately reset, never shrink, or a scheduler restart would relaunch
    a crash-looper at full speed (reference: backoff state was lost on
    failover and tasks hot-looped).
+5. **page ledger** — the paged serving engine's KV-page refcounts must
+   always be derivable from surviving state (live stream tables + the
+   prefix radix): no leaked, double-booked, or negative-refcount pages
+   after any admit/retire/abort/reset — including the ``page_leak``
+   fault, where a stream dies without releasing its pages and the
+   engine's crash sweep (``PagePool.reconcile``) must reclaim them.
 """
 
 from __future__ import annotations
@@ -56,6 +62,7 @@ class InvariantChecker:
         out += self._check_ledger(tick)
         out += self._check_gang_ranks(tick)
         out += self._check_backoff_monotone(tick)
+        out += self._check_page_ledger(tick)
         return out
 
     def _check_unique_live_tasks(self, tick: int) -> List[Violation]:
@@ -129,6 +136,21 @@ class InvariantChecker:
                     "gang-stable-rank",
                     f"{task.task_name} relaunched with JAX_PROCESS_ID="
                     f"{rank!r}, expected {task.pod_index}", tick))
+        return out
+
+    def _check_page_ledger(self, tick: int) -> List[Violation]:
+        """Audit every attached paged-serving ledger (the soak's page
+        sim, or a real ``PagedServer`` in an integration harness): the
+        pool's refcounts must exactly match the references held by live
+        stream tables + the prefix radix, with a structurally sound
+        free list."""
+        out = []
+        for sim in getattr(self._runner, "page_sims", ()):
+            # a PagedServer calls its host ledger ``ledger`` (``pool``
+            # is the device-side page store); the soak sim says ``pool``
+            pool = sim.ledger if hasattr(sim, "ledger") else sim.pool
+            for problem in pool.check(sim.expected_refs()):
+                out.append(Violation("page-ledger", problem, tick))
         return out
 
     def _check_backoff_monotone(self, tick: int) -> List[Violation]:

@@ -23,6 +23,7 @@ import random
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional
 
+from ..models.paging import PagePool, PrefixRadix
 from ..plan.backoff import ExponentialBackoff
 from ..plan.status import Status
 from ..scheduler.recovery import AgentGoneFailureMonitor
@@ -65,6 +66,97 @@ pods:
 
 SETTLE_BUDGET = 80  # cycles the heal phase gets to reach COMPLETE
 MAX_AGENTS_OUT = 2  # storm never takes down more hosts at once
+
+
+class _PageServingSim:
+    """Serving-facing page-ledger traffic riding alongside the storm.
+
+    A miniature ``PagedServer`` admission/retire/abort loop over the
+    REAL host ledger (``models/paging.py``): streams admit with prefix
+    sharing through the radix, retire by adopting their full prompt
+    pages, and occasionally abort en masse — every transition the
+    engine makes, minus the device arrays. The ``page_leak`` fault
+    models the engine crashing mid-retire: a stream vanishes without
+    unref'ing its pages, and recovery is the engine's crash sweep
+    (``PagePool.reconcile`` against surviving state), after which the
+    page-ledger invariant must find a clean ledger.
+
+    Deterministic from its OWN rng (derived from the soak seed) so
+    arming ``page_leak`` never perturbs the scheduler fault schedule —
+    pinned corpus seeds keep replaying their original storms.
+    """
+
+    def __init__(self, seed: int, *, pages: int = 24, page_size: int = 4,
+                 max_streams: int = 6):
+        self.rng = random.Random((seed << 16) ^ 0x5DEECE66D)
+        self.pool = PagePool(pages, page_size)
+        self.radix = PrefixRadix(self.pool)
+        self.max_streams = max_streams
+        # sid -> (prompt, pages the stream holds one reference to each)
+        self.streams: Dict[int, tuple] = {}
+        self._next_sid = 0
+        # a few common system prompts so the radix actually shares
+        base_rng = random.Random(seed)
+        self._bases = [[base_rng.randint(0, 96) for _ in range(3 * page_size)]
+                       for _ in range(2)]
+        self.leaks_injected = 0
+        self.leaks_reclaimed = 0
+
+    def expected_refs(self) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        for _, pages in self.streams.values():
+            for p in pages:
+                out[p] = out.get(p, 0) + 1
+        for p, n in self.radix.held().items():
+            out[p] = out.get(p, 0) + n
+        return out
+
+    def _admit(self) -> None:
+        if len(self.streams) >= self.max_streams:
+            return
+        rng, ps = self.rng, self.pool.page_size
+        base = rng.choice(self._bases)
+        prompt = (base[:rng.randint(1, len(base))]
+                  + [rng.randint(0, 96) for _ in range(rng.randint(1, ps))])
+        shared, _ = self.radix.lookup(prompt)
+        own_needed = -(-len(prompt) // ps) - len(shared)
+        pages = self.pool.alloc(own_needed)
+        if pages is None:
+            self.radix.evict(own_needed - self.pool.free_count())
+            pages = self.pool.alloc(own_needed)
+        if pages is None:                     # pool genuinely full: reject
+            for p in shared:
+                self.pool.unref(p)
+            return
+        self.streams[self._next_sid] = (prompt, shared + pages)
+        self._next_sid += 1
+
+    def _retire(self, sid: int) -> None:
+        prompt, pages = self.streams.pop(sid)
+        full = len(prompt) // self.pool.page_size
+        if full:                              # adopt BEFORE the unref
+            self.radix.insert(prompt, pages[:full])
+        for p in pages:
+            self.pool.unref(p)
+
+    def tick(self, tick: int, page_leak_p: float, count, log) -> None:
+        rng = self.rng
+        for _ in range(rng.randint(0, 2)):
+            self._admit()
+        if self.streams and rng.random() < 0.5:
+            self._retire(rng.choice(sorted(self.streams)))
+        if self.streams and rng.random() < 0.05:
+            for sid in sorted(self.streams):  # abort_active
+                self._retire(sid)
+        if page_leak_p and self.streams and rng.random() < page_leak_p:
+            victim = rng.choice(sorted(self.streams))
+            self.streams.pop(victim)          # crash: no unref
+            self.leaks_injected += 1
+            count("page_leak")
+            reclaimed = self.pool.reconcile(self.expected_refs())
+            self.leaks_reclaimed += len(reclaimed)
+            log(f"tick {tick}: page_leak stream {victim} "
+                f"(sweep reclaimed pages {reclaimed})")
 
 
 @dataclass
@@ -120,6 +212,8 @@ class _Soak:
             failure_monitor=monitor,
         )
         self.chaos: ChaosCluster = self.runner.scheduler_cluster
+        self.page_sim = _PageServingSim(seed)
+        self.runner.page_sims = [self.page_sim]
         self.checker = InvariantChecker(self.runner)
         self._tune()
 
@@ -248,6 +342,8 @@ class _Soak:
         for tick in range(self.ticks):
             self._release_environment(tick)
             self._inject(tick)
+            self.page_sim.tick(tick, self.config.page_leak,
+                               self._count, self._log)
             # release the transport's due events first so zombies from
             # late launches are visible to this tick's reconciliation
             self.chaos.tick()
@@ -262,6 +358,7 @@ class _Soak:
         converged = False
         for i in range(SETTLE_BUDGET):
             tick = self.ticks + i
+            self.page_sim.tick(tick, 0.0, self._count, self._log)
             self.chaos.tick()
             self._cycle()
             self._check(tick)

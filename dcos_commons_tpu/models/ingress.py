@@ -113,6 +113,10 @@ class ServingFrontend:
         self._decode_window = max(1, decode_window)
         self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=max_queue)
         self._live: Dict[int, _Pending] = {}          # slot -> pending
+        # drained from the queue but not yet admitted (a paged engine
+        # admits a FIFO prefix when pages run short): retried FIRST on
+        # the next fill so nothing is silently dropped
+        self._backlog: List[_Pending] = []
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._lock = threading.Lock()                 # stats only
@@ -245,13 +249,17 @@ class ServingFrontend:
     def _fill_slots(self) -> bool:
         filled = False
         while self.engine.free_slots():
-            batch = self.drain_intake(len(self.engine.free_slots()))
+            budget = len(self.engine.free_slots()) - len(self._backlog)
+            batch = self._backlog + (self.drain_intake(budget)
+                                     if budget > 0 else [])
+            self._backlog = []
             if not batch:
                 break
             now = time.perf_counter()
             items = []
             for pending in batch:
-                pending.t_submit = now
+                if pending.t_submit is None:
+                    pending.t_submit = now
                 items.append({"prompt": pending.prompt,
                               "max_new": pending.max_new,
                               "request_id": pending})
@@ -274,8 +282,16 @@ class ServingFrontend:
                 for item in items:
                     item["request_id"].finish(f"engine error: {e}")
                 raise
-            filled = True
+            # unadmitted + not-failed items wait for capacity (pages or
+            # slots), retried first next fill — NEVER dropped
+            placed_ids = {id(p) for _, p in placed}
+            self._backlog = [p for p in batch
+                             if id(p) not in placed_ids
+                             and not p.done.is_set()]
             self._sync()                # instant retire (max_new == 1)
+            if not placed:
+                break                    # no capacity: retry next tick
+            filled = True
         return filled
 
     def _sync(self) -> None:
@@ -403,6 +419,9 @@ class ServingFrontend:
                 self._queue.get_nowait().finish("server stopped")
             except queue.Empty:
                 break
+        for pending in self._backlog:
+            pending.finish("server stopped")
+        self._backlog = []
         for pending in list(self._live.values()):
             pending.finish("server stopped")
         self._live.clear()
@@ -416,9 +435,14 @@ class ServingFrontend:
         if not alive and driven_at is not None:
             # externally-driven (gang loop): fresh stamp == serving
             alive = time.monotonic() - driven_at < self.driven_ttl_s
-        return {"ok": alive, "slots": self.engine.slots,
-                "free": len(self.engine.free_slots()),
-                "queued": self._queue.qsize()}
+        out = {"ok": alive, "slots": self.engine.slots,
+               "free": len(self.engine.free_slots()),
+               "queued": self._queue.qsize()}
+        if hasattr(self.engine, "pages_free"):
+            # paged engines admit on pages: surface the real
+            # utilization signal (autoscalers key off this, not slots)
+            out["pages_free"] = self.engine.pages_free()
+        return out
 
     def stats(self) -> dict:
         with self._lock:

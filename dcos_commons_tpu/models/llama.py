@@ -34,6 +34,8 @@ from dcos_commons_tpu.ops import (apply_rope, apply_rope_at,
                                   rms_norm, rope_frequencies,
                                   softmax_cross_entropy)
 from dcos_commons_tpu.ops.flash_decode import (flash_decode,
+                                               flash_decode_paged,
+                                               flash_decode_paged_tp,
                                                flash_decode_tp)
 from dcos_commons_tpu.ops.quant import (QTensor, dequantize, qmm, qtake,
                                         quantize)
@@ -676,18 +678,26 @@ def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
                  tokens: jnp.ndarray, flash: bool, rope_fn, cache_write,
                  kv_len, causal: bool = False, q_offset=0,
                  all_positions: bool = False,
-                 mesh: Optional[Mesh] = None
+                 mesh: Optional[Mesh] = None,
+                 attn_override=None, logit_index=None
                  ) -> Tuple[jnp.ndarray, Params]:
     """The cache-consuming forward shared by :func:`decode_step` (one
     scalar position), :func:`decode_step_slots` (per-slot positions),
-    and :func:`extend_step` (a K-token window). The callers differ ONLY
-    in how rope is applied, where the cache rows land, and the
-    attention mask — everything else must stay ONE body or the serving
-    engine / speculative verify silently diverge from solo decode.
+    :func:`extend_step` (a K-token window), and the paged serving paths
+    (:func:`decode_step_paged` / :func:`prefill_chunk_paged`). The
+    callers differ ONLY in how rope is applied, where the cache rows
+    land, and the attention mask — everything else must stay ONE body
+    or the serving engine / speculative verify silently diverge from
+    solo decode.
 
     ``tokens`` [B, S] (S == 1 for decode steps); ``causal``/``q_offset``
     shape the within-window mask for S > 1; ``all_positions`` returns
     logits [B, S, V] instead of the last position's [B, V].
+    ``attn_override(q, k_cache, v_cache)`` replaces the attention read
+    entirely (the paged paths gather through a page table / run the
+    paged pallas kernel — the cache layout is theirs to interpret);
+    ``logit_index`` takes logits at a DYNAMIC position instead of the
+    last (a padded prefill chunk's last live token).
     """
     b, s = tokens.shape
     x = qtake(params["embed"], tokens, cfg.dtype)              # [B, S, D]
@@ -703,7 +713,9 @@ def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
         k = rope_fn(k)
         k_cache, k_read = cache_write(k_cache, k)
         v_cache, v_read = cache_write(v_cache, v)
-        if flash:
+        if attn_override is not None:
+            o = attn_override(q, k_cache, v_cache)
+        elif flash:
             # the pallas kernel consumes the cache in storage form (int8
             # payload + scales dequantize in VMEM); the dense read above
             # is dead code XLA eliminates on this branch. tp meshes run
@@ -730,7 +742,12 @@ def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
     (x, _), (k_new, v_new) = lax.scan(
         layer, (x, 0), (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["norm"], cfg.norm_eps)
-    if not all_positions:
+    if all_positions:
+        pass
+    elif logit_index is not None:
+        x = lax.dynamic_index_in_dim(x, logit_index, axis=1,
+                                     keepdims=False)
+    else:
         x = x[:, -1, :]
     logits = qmm(x, params["lm_head"]).astype(jnp.float32)
     return logits, {"k": k_new, "v": v_new}
@@ -829,6 +846,191 @@ def decode_step_slots(cfg: LlamaConfig, params: Params, cache: Params,
         cache_write=lambda c, new: _cache_update_slots(c, new, lengths,
                                                        cfg.dtype),
         kv_len=lengths + 1, mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# block-paged KV (PagedServer): a fixed pool of pages + per-stream
+# indirection tables instead of per-slot max_seq rows
+
+def init_page_pool(cfg: LlamaConfig, pages: int, page_size: int) -> Params:
+    """KV page pool [L, pages, page_size, KV, D] (QTensor payload +
+    per-position scales under ``cfg.kv_quant``, like
+    :func:`init_kv_cache`). One physical pool serves every stream; who
+    owns which page is host bookkeeping (``models/paging.PagePool``)."""
+    shape = (cfg.n_layers, pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    if cfg.kv_quant:
+        sshape = shape[:-1] + (1,)
+        return {"k": QTensor(jnp.zeros(shape, jnp.int8),
+                             jnp.zeros(sshape, jnp.bfloat16)),
+                "v": QTensor(jnp.zeros(shape, jnp.int8),
+                             jnp.zeros(sshape, jnp.bfloat16))}
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def page_pool_specs() -> Params:
+    """Sharding for the page pool under tensor parallelism: the KV-head
+    axis shards next to the megatron weight shards (as the slot cache
+    does); the PAGE axis stays unsharded — every shard holds every
+    page, and attention is head-local."""
+    return {"k": P(None, None, None, "tp", None),
+            "v": P(None, None, None, "tp", None)}
+
+
+def _gather_pages(cache, table: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Reassemble a per-layer pool [P, ps, KV, D] into logical-order
+    views [B, MP*ps, KV, D] through ``table`` [B, MP] — the dense-path
+    attention read. Position ``p`` of stream ``b`` lands at view index
+    ``p`` exactly (the table maps logical page p//ps to its physical
+    page), so masked attention over the view reduces in the SAME order
+    as over a monolithic cache row — greedy parity with the slot engine
+    is exact, not approximate."""
+    if isinstance(cache, QTensor):
+        view = dequantize(QTensor(cache.q[table], cache.s[table]), dtype)
+    else:
+        view = cache[table]
+    b, mp, ps, kv, d = view.shape
+    return view.reshape(b, mp * ps, kv, d)
+
+
+def _page_write(cache, rows: jnp.ndarray, phys: jnp.ndarray,
+                offs: jnp.ndarray):
+    """Scatter K/V ``rows`` [N, KV, D] into a per-layer pool at
+    (``phys[i]``, ``offs[i]``), quantizing when the pool is int8.
+    Callers guarantee writable target pages are PRIVATE to their stream
+    (prefix-shared pages are read-only; the boundary page copies at
+    admission), so the scatter needs no ownership mask."""
+    if isinstance(cache, QTensor):
+        nq = quantize(rows, axis=-1)
+        return QTensor(
+            cache.q.at[phys, offs].set(nq.q),
+            cache.s.at[phys, offs].set(nq.s.astype(cache.s.dtype)))
+    return cache.at[phys, offs].set(rows)
+
+
+def _use_flash_decode_paged(cfg: LlamaConfig, mesh: Optional[Mesh],
+                            page_size: int) -> bool:
+    """Route the paged decode step's attention: the same gate as
+    :func:`_use_flash_decode` with the lane-alignment condition on the
+    PAGE — the paged kernel's k-blocks tile pages, not max_seq rows."""
+    if not _use_flash_decode(cfg, mesh):
+        return False
+    if page_size % 128:
+        if cfg.decode_attn in ("flash", "flash_interpret"):
+            raise ValueError(
+                f"decode_attn={cfg.decode_attn!r} needs page_size % 128 "
+                f"== 0 for the paged pallas kernel; got {page_size}")
+        return False
+    return True
+
+
+def decode_step_paged(cfg: LlamaConfig, params: Params, pool: Params,
+                      table: jnp.ndarray, lengths: jnp.ndarray,
+                      tokens: jnp.ndarray, mesh: Optional[Mesh] = None,
+                      rope: Optional[jnp.ndarray] = None
+                      ) -> Tuple[jnp.ndarray, Params]:
+    """One decode step against the PAGED pool — per-row math identical
+    to :func:`decode_step_slots`, only the cache landing differs.
+
+    ``tokens``/``lengths`` [B] int32; ``table`` [B, MP] int32 maps each
+    stream's logical page to a physical pool page. Each stream's new
+    K/V row scatters into (table[b, lengths[b]//ps], lengths[b] %% ps);
+    attention reads the pool through the table (gather-based dense, or
+    the paged pallas kernel when lane-aligned). Inactive streams must
+    point their table rows at a scratch page the engine never
+    allocates — their frozen-position writes land there harmlessly.
+    """
+    if rope is None:
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    kq = pool["k"].q if isinstance(pool["k"], QTensor) else pool["k"]
+    ps = kq.shape[2]
+    mp = table.shape[1]
+    # clip: a retired stream's length can run past the table mid-window
+    # (the slot engine's frozen-row behaviour); its row is all scratch
+    page_idx = jnp.clip(lengths // ps, 0, mp - 1)
+    phys = jnp.take_along_axis(table, page_idx[:, None], axis=1)[:, 0]
+    offs = lengths % ps
+    flash = _use_flash_decode_paged(cfg, mesh, ps)
+    interp = cfg.decode_attn == "flash_interpret"
+
+    def cache_write(c, new):
+        return _page_write(c, new[:, 0], phys, offs), None
+
+    def attn_override(q, k_cache, v_cache):
+        if flash:
+            if mesh is not None and mesh.shape.get("tp", 1) > 1:
+                return flash_decode_paged_tp(q, k_cache, v_cache, table,
+                                             lengths + 1, mesh,
+                                             interpret=interp)
+            return flash_decode_paged(q, k_cache, v_cache, table,
+                                      lengths + 1, interpret=interp)
+        k_read = _gather_pages(k_cache, table, cfg.dtype)
+        v_read = _gather_pages(v_cache, table, cfg.dtype)
+        return gqa_attention(q, k_read, v_read, causal=False,
+                             kv_len=lengths + 1)
+
+    return _decode_body(
+        cfg, params, pool, tokens[:, None], False,
+        rope_fn=lambda t: apply_rope_at(t, rope, lengths),
+        cache_write=cache_write, kv_len=lengths + 1, mesh=mesh,
+        attn_override=attn_override)
+
+
+def prefill_chunk_paged(cfg: LlamaConfig, params: Params, pool: Params,
+                        table: jnp.ndarray, tokens: jnp.ndarray,
+                        start: jnp.ndarray, true_len: jnp.ndarray,
+                        logit_index: jnp.ndarray, scratch_page: int,
+                        mesh: Optional[Mesh] = None,
+                        rope: Optional[jnp.ndarray] = None
+                        ) -> Tuple[jnp.ndarray, Params]:
+    """One CHUNK of paged prefill for a single stream: ``tokens``
+    [1, C] occupy positions ``start..start+C-1``, K/V landing through
+    ``table`` [MP]. Returns (logits [1, V] at ``logit_index`` — the
+    chunk-relative last live position; garbage for non-final chunks —
+    and the pool).
+
+    This is how long prompts stop stalling running decode streams: the
+    engine interleaves ONE fixed-shape chunk per tick with the decode
+    dispatch, so a 4096-token prompt costs many small stalls instead of
+    one huge one, and one executable serves every prompt length (vs the
+    slot engine's per-bucket prefill matrix).
+
+    Padded positions at/after ``true_len`` redirect their writes to
+    ``scratch_page`` (live queries are causally upstream of them, so
+    they perturb nothing and nothing reads them). Attention gathers the
+    stream's pages in logical order — per-position math identical to
+    full-prompt prefill, chunk boundaries included, because causal
+    attention at position p sees exactly positions <= p either way.
+    """
+    if rope is None:
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    kq = pool["k"].q if isinstance(pool["k"], QTensor) else pool["k"]
+    ps = kq.shape[2]
+    mp = table.shape[0]
+    c = tokens.shape[1]
+    positions = start + jnp.arange(c, dtype=jnp.int32)
+    live = positions < true_len
+    phys = jnp.where(live,
+                     table[jnp.clip(positions // ps, 0, mp - 1)],
+                     jnp.int32(scratch_page))
+    offs = positions % ps
+    table_b = table[None]                                    # [1, MP]
+
+    def cache_write(cache, new):
+        return _page_write(cache, new[0], phys, offs), None
+
+    def attn_override(q, k_cache, v_cache):
+        k_read = _gather_pages(k_cache, table_b, cfg.dtype)
+        v_read = _gather_pages(v_cache, table_b, cfg.dtype)
+        return gqa_attention(q, k_read, v_read, causal=True,
+                             q_offset=start, kv_len=start + c)
+
+    return _decode_body(
+        cfg, params, pool, tokens, False,
+        rope_fn=lambda t: apply_rope(t, rope, start),
+        cache_write=cache_write, kv_len=start + c, causal=True,
+        q_offset=start, mesh=mesh, attn_override=attn_override,
+        logit_index=logit_index)
 
 
 def prefill(cfg: LlamaConfig, params: Params, cache: Params,

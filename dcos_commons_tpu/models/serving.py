@@ -32,6 +32,7 @@ workload-layer capability for BASELINE.json config #5, layered on
 from __future__ import annotations
 
 import dataclasses
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -40,6 +41,7 @@ import numpy as np
 from jax import lax
 
 from dcos_commons_tpu.models import llama
+from dcos_commons_tpu.models.paging import PagePool, PrefixRadix
 from dcos_commons_tpu.ops import rope_frequencies
 from dcos_commons_tpu.ops.quant import QTensor, qmm, quantize
 
@@ -159,6 +161,9 @@ class SlotServer:
         self.cur_tok = jnp.zeros((slots,), jnp.int32)
         self.requests: List[Optional[_Request]] = [None] * slots
         self.finished: Dict[Any, List[int]] = {}
+        # slot -> device scalar of the prefill's first token, awaiting
+        # ONE batched host transfer (see _flush_pending)
+        self._pending_first: Dict[int, jax.Array] = {}
         rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
         self._prefill_x: Dict[int, Any] = {}   # bucket -> executable
         self._prefill_many_x: Dict[Any, Any] = {}   # (n, bucket) -> exe
@@ -196,6 +201,7 @@ class SlotServer:
             # must not alias the pool-full None: drain() would retry the
             # same item forever
             raise ValueError("empty prompt")
+        self._flush_pending()
         free = self.free_slots()
         if not free:
             return None
@@ -219,12 +225,17 @@ class SlotServer:
         arr[0, :n] = prompt                       # host-side assembly
         logits, ks, vs = x(self.params, jnp.asarray(arr), jnp.int32(n))
         self.cache = self._scatter_x(self.cache, ks, vs, jnp.int32(slot))
-        tok = int(self._select(logits)[0])
+        # the first token stays DEVICE-RESIDENT: int(...) here would
+        # block on the prefill per admission (the r4 bench-slip lesson —
+        # host syncs inside the hot loop, not the chip, set the pace).
+        # _flush_pending materializes every deferred first token in ONE
+        # transfer at the next engine-thread entry point.
+        toks = self._select(logits)
         self.lengths = self.lengths.at[slot].set(n)
-        self.cur_tok = self.cur_tok.at[slot].set(tok)
+        self.cur_tok = self.cur_tok.at[slot].set(toks[0])
         rid = request_id if request_id is not None else object()
-        self.requests[slot] = _Request(rid, n, max_new, [tok])
-        self._maybe_retire(slot)
+        self.requests[slot] = _Request(rid, n, max_new, [])
+        self._pending_first[slot] = toks[0]
         return slot
 
     def _validate_item(self, item: Dict[str, Any]) -> Optional[str]:
@@ -264,6 +275,7 @@ class SlotServer:
                 on_invalid(item, reason)
             else:
                 raise ValueError(reason)
+        self._flush_pending()
         placed: List[Tuple[int, Any]] = []
         remaining = admissible
         while remaining:
@@ -305,19 +317,20 @@ class SlotServer:
                            jnp.asarray(lens, jnp.int32))
         slot_arr = jnp.asarray(slots, jnp.int32)
         self.cache = sx(self.cache, ks, vs, slot_arr)
+        # first tokens stay device-resident (see submit); one batched
+        # scatter updates cur_tok with NO host round-trip
         toks = self._select(logits)
-        host_toks = [int(t) for t in np.asarray(toks)]
+        self.lengths = self.lengths.at[slot_arr].set(
+            jnp.asarray(lens, jnp.int32))
+        self.cur_tok = self.cur_tok.at[slot_arr].set(toks)
         placed = []
         for i, item in enumerate(batch):
             slot = slots[i]
             rid = item.get("request_id")
             rid = rid if rid is not None else object()
-            self.lengths = self.lengths.at[slot].set(lens[i])
-            self.cur_tok = self.cur_tok.at[slot].set(host_toks[i])
             self.requests[slot] = _Request(rid, lens[i],
-                                           item.get("max_new", 32),
-                                           [host_toks[i]])
-            self._maybe_retire(slot)
+                                           item.get("max_new", 32), [])
+            self._pending_first[slot] = toks[i]
             placed.append((slot, rid))
         return placed
 
@@ -327,10 +340,33 @@ class SlotServer:
         self.key, sub = jax.random.split(self.key)
         return self.sampler(sub, logits).astype(jnp.int32)
 
+    def _flush_pending(self) -> None:
+        """Materialize every deferred first token in ONE device->host
+        transfer and run the retirement checks that waited on them.
+
+        Called at the top of every engine-thread entry point that may
+        observe request state (submit/submit_many/step/step_many) —
+        NOT from ``free_slots``/``requests_active``, which the HTTP
+        health thread reads concurrently and which must therefore stay
+        pure host bookkeeping.
+        """
+        if not self._pending_first:
+            return
+        items = sorted(self._pending_first.items())
+        self._pending_first.clear()
+        vals = np.asarray(jnp.stack([t for _, t in items]))
+        for (slot, _), tok in zip(items, vals):
+            r = self.requests[slot]
+            if r is None:
+                continue                       # aborted before flush
+            r.tokens.append(int(tok))
+            self._maybe_retire(slot)
+
     # ------------------------------------------------------------- decode
 
     def step(self) -> Dict[int, int]:
         """Advance every active slot one token; returns {slot: token}."""
+        self._flush_pending()
         active = [i for i, r in enumerate(self.requests) if r is not None]
         if not active:
             return {}
@@ -373,6 +409,7 @@ class SlotServer:
         """
         if k <= 1:
             return {slot: [tok] for slot, tok in self.step().items()}
+        self._flush_pending()
         active = [i for i, r in enumerate(self.requests) if r is not None]
         if not active:
             return {}
@@ -447,6 +484,8 @@ class SlotServer:
         self.cur_tok = jnp.zeros((self.slots,), jnp.int32)
         self.requests = [None] * self.slots
         self.finished.clear()
+        # deferred tokens reference pre-reset device state: drop them
+        self._pending_first.clear()
 
     def abort_active(self) -> int:
         """Drop every in-flight request without recording results (a
@@ -458,6 +497,7 @@ class SlotServer:
             if r is not None:
                 self.requests[i] = None
                 dropped += 1
+        self._pending_first.clear()
         return dropped
 
     # -------------------------------------------------------------- drive
@@ -475,3 +515,543 @@ class SlotServer:
             pending = pending[len(placed):]
             self.step_many(decode_window)
         return dict(self.finished)
+
+
+# ---------------------------------------------------------------------------
+# block-paged engine
+
+
+def _copy_page(cache, src, dst):
+    """Copy pool page ``src`` -> ``dst`` across every layer (payload +
+    scales for int8 pools) — the eager copy-on-write of a prefix-cached
+    boundary page at admission."""
+    if isinstance(cache, QTensor):
+        return QTensor(cache.q.at[:, dst].set(cache.q[:, src]),
+                       cache.s.at[:, dst].set(cache.s[:, src]))
+    return cache.at[:, dst].set(cache[:, src])
+
+
+class PagedServer:
+    """Block-paged, prefix-shared continuous batching — the vLLM-style
+    successor to :class:`SlotServer`, same drive surface (``submit`` /
+    ``submit_many`` / ``step`` / ``step_many`` / ``drain`` / ``reset`` /
+    ``abort_active`` and the ``requests``/``finished``/``free_slots``
+    seams ingress and the gang driver consume), different memory model:
+
+    * **Pages, not rows.** One device pool of ``pages`` fixed
+      ``(page_size, KV, D)`` K/V pages (+ one scratch page) serves every
+      stream through a per-stream page table; a request holds
+      ``ceil((prompt + max_new) / page_size)`` pages instead of pinning
+      a whole ``max_seq`` row, so admission is gated on **pages free**
+      (the host-side :class:`~dcos_commons_tpu.models.paging.PagePool`
+      ledger) — a long request no longer blocks a fistful of short ones.
+    * **Chunked prefill.** Prompts prefill in fixed ``prefill_chunk``
+      slices, ONE chunk per ``step``/``step_many`` call, interleaved
+      with the decode dispatch — running streams keep emitting while a
+      long prompt works through the queue, and one chunk executable
+      replaces the slot engine's per-bucket prefill matrix.
+    * **Prefix sharing.** Full prompt-prefix pages are hash-consed in a
+      radix (:class:`~dcos_commons_tpu.models.paging.PrefixRadix`):
+      identical system prompts across requests occupy ONE physical copy
+      behind refcounts; the partial boundary page copies eagerly at
+      admission (copy-on-write), so every page a stream *writes* is
+      private by construction and the hot paths need no ownership mask.
+    * **Scratch-page discipline.** Streams that are inactive, still
+      prefilling, or retired mid-window have their table rows pointed at
+      the scratch page for the decode dispatch, and padded chunk
+      positions write there too — garbage never lands on a live
+      (possibly shared) page.
+
+    Greedy tokens are EXACTLY the slot engine's: the gathered page view
+    reassembles the cache in logical order, so masked attention reduces
+    in the same order over the same values.
+    """
+
+    def __init__(self, cfg: llama.LlamaConfig, params, slots: int = 8,
+                 pages: Optional[int] = None, page_size: int = 64,
+                 prefill_chunk: int = 64, sampler=None,
+                 key: Optional[jax.Array] = None,
+                 eos_id: Optional[int] = None, mesh=None,
+                 prefix_cache: bool = True):
+        if page_size < 1 or cfg.max_seq % page_size:
+            raise ValueError(
+                f"page_size {page_size} must divide max_seq "
+                f"{cfg.max_seq} (the page table is fixed-width)")
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        self.cfg = cfg
+        self.params = params
+        self.slots = slots                     # concurrent stream cap
+        self.page_size = page_size
+        self.pages_per_stream = cfg.max_seq // page_size
+        self.total_pages = (int(pages) if pages is not None
+                            else slots * self.pages_per_stream)
+        if self.total_pages < 1:
+            raise ValueError(f"page pool needs >= 1 page, got "
+                             f"{self.total_pages}")
+        self.prefill_chunk = prefill_chunk
+        self.sampler = sampler
+        self.eos_id = eos_id
+        self.mesh = mesh
+        self.key = key if key is not None else jax.random.key(0)
+        # physical index total_pages is the SCRATCH page: never in the
+        # ledger, never read unmasked — inactive streams' decode writes
+        # and padded chunk positions land there
+        self.scratch = self.total_pages
+        self.pool = llama.init_page_pool(cfg, self.total_pages + 1,
+                                         page_size)
+        if mesh is not None and mesh.size > 1:
+            # same rank-5 layout as the slot cache (KV heads at axis 3),
+            # so the slot engine's placement applies verbatim; the page
+            # axis stays unsharded like the slot axis
+            self.pool = _shard_cache(self.pool, mesh)
+        self.ledger = PagePool(self.total_pages, page_size)
+        self.radix = PrefixRadix(self.ledger) if prefix_cache else None
+        self._tables = np.full((slots, self.pages_per_stream),
+                               self.scratch, np.int32)
+        self.lengths = jnp.zeros((slots,), jnp.int32)
+        self.cur_tok = jnp.zeros((slots,), jnp.int32)
+        self.requests: List[Optional[_Request]] = [None] * slots
+        self.finished: Dict[Any, List[int]] = {}
+        self._pending_first: Dict[int, jax.Array] = {}
+        self._stream_pages: List[List[int]] = [[] for _ in range(slots)]
+        self._prompts: List[Optional[List[int]]] = [None] * slots
+        self._prefill_pos = [0] * slots        # next position to prefill
+        self._prefill_q: "deque[int]" = deque()
+        self._decoding = [False] * slots       # prefill finished?
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+        self._rope = rope
+        scratch = self.scratch
+        # pool donated everywhere it flows through jit, like the slot
+        # cache: it dominates HBM and every executable returns a
+        # same-shaped pool
+        self._step_x = jax.jit(
+            lambda p, c, tbl, ln, tok: llama.decode_step_paged(
+                cfg, p, c, tbl, ln, tok, mesh=mesh, rope=rope),
+            donate_argnums=(1,))
+        self._stepk_x: Dict[int, Any] = {}
+        self._chunk_x = jax.jit(
+            lambda p, c, tbl, toks, st, tl, li:
+                llama.prefill_chunk_paged(cfg, p, c, tbl, toks, st, tl,
+                                          li, scratch, mesh=mesh,
+                                          rope=rope),
+            donate_argnums=(1,))
+        self._copy_x = jax.jit(
+            lambda c, src, dst: {"k": _copy_page(c["k"], src, dst),
+                                 "v": _copy_page(c["v"], src, dst)},
+            donate_argnums=(0,))
+
+    # the engine-thread-only helpers are identical to the slot engine's
+    _select = SlotServer._select
+    drain = SlotServer.drain
+
+    def _flush_pending(self) -> None:
+        """:meth:`SlotServer._flush_pending`, plus decode ACTIVATION:
+        a stream joins the decode batch only once its first token is in
+        ``r.tokens`` — order and EOS/budget checks then see tokens in
+        emission order."""
+        if not self._pending_first:
+            return
+        items = sorted(self._pending_first.items())
+        self._pending_first.clear()
+        vals = np.asarray(jnp.stack([t for _, t in items]))
+        for (slot, _), tok in zip(items, vals):
+            r = self.requests[slot]
+            if r is None:
+                continue                       # aborted before flush
+            r.tokens.append(int(tok))
+            self._decoding[slot] = True
+            self._maybe_retire(slot)
+
+    # ------------------------------------------------------------ intake
+
+    def free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.requests) if r is None]
+
+    def requests_active(self) -> bool:
+        return any(r is not None for r in self.requests)
+
+    def pages_free(self) -> int:
+        return self.ledger.free_count()
+
+    def _validate_item(self, item: Dict[str, Any]) -> Optional[str]:
+        prompt = item["prompt"]
+        max_new = item.get("max_new", 32)
+        if not prompt:
+            return "empty prompt"
+        if len(prompt) + max_new > self.cfg.max_seq:
+            return (f"prompt {len(prompt)} + max_new {max_new} exceeds "
+                    f"the cache ({self.cfg.max_seq}); raise max_seq or "
+                    "shrink the ask")
+        need = -(-(len(prompt) + max_new) // self.page_size)
+        if need > self.total_pages:
+            # permanently infeasible (no amount of retirement frees
+            # enough): reject loudly like over-max_seq, never queue
+            return (f"prompt {len(prompt)} + max_new {max_new} needs "
+                    f"{need} pages but the pool holds "
+                    f"{self.total_pages}; raise SERVE_PAGES or shrink "
+                    "the ask")
+        return None
+
+    def submit(self, prompt: List[int], max_new: int = 32,
+               request_id: Any = None) -> Optional[int]:
+        """Admit ``prompt``: reserve its FULL page span (prompt +
+        max_new — the table stays constant through decode), share any
+        cached full-prefix pages, copy the boundary page, and queue the
+        uncached tail for chunked prefill. Returns the stream index, or
+        None when streams or pages are exhausted (caller re-offers
+        later). No device forward happens here — prefill is paid one
+        chunk per step, interleaved with decode."""
+        reason = self._validate_item({"prompt": prompt,
+                                      "max_new": max_new})
+        if reason is not None:
+            raise ValueError(reason)
+        self._flush_pending()
+        return self._admit(list(prompt), max_new, request_id)
+
+    def _admit(self, prompt: List[int], max_new: int,
+               request_id: Any) -> Optional[int]:
+        free = self.free_slots()
+        if not free:
+            return None
+        slot = free[0]
+        n = len(prompt)
+        ps = self.page_size
+        total = -(-(n + max_new) // ps)
+        shared: List[int] = []
+        node = None
+        if self.radix is not None:
+            shared, node = self.radix.lookup(prompt)
+        own_needed = total - len(shared)
+        pages = self.ledger.alloc(own_needed)
+        if pages is None and self.radix is not None:
+            # under pressure the radix gives back LRU unshared pages
+            self.radix.evict(own_needed - self.ledger.free_count())
+            pages = self.ledger.alloc(own_needed)
+        if pages is None:
+            for p in shared:                   # undo the lookup refs
+                self.ledger.unref(p)
+            return None
+        matched = len(shared) * ps
+        start = matched
+        if node is not None:
+            b = self.radix.boundary(node, prompt, matched)
+            if b is not None:
+                src, valid = b
+                # eager COW: the cached page's first `valid` rows are
+                # bit-identical K/V for our positions; copy it into our
+                # first private page and prefill only past them (the
+                # copy's garbage tail is overwritten / never read)
+                self.pool = self._copy_x(self.pool, jnp.int32(src),
+                                         jnp.int32(pages[0]))
+                start = matched + valid
+        stream_pages = shared + pages
+        row = self._tables[slot]
+        row[:] = self.scratch
+        row[:total] = stream_pages
+        self._stream_pages[slot] = stream_pages
+        self._prompts[slot] = prompt
+        self._prefill_pos[slot] = start
+        self._decoding[slot] = False
+        rid = request_id if request_id is not None else object()
+        self.requests[slot] = _Request(rid, n, max_new, [])
+        self._prefill_q.append(slot)
+        return slot
+
+    def submit_many(self, items: List[Dict[str, Any]],
+                    on_invalid=None) -> List[Tuple[int, Any]]:
+        """Admit a FIFO PREFIX of ``items`` (first stream or page
+        exhaustion stops intake — pages behind the blocked head would
+        starve it forever under sustained load). Admission is pure host
+        bookkeeping (+ at most one page-copy dispatch per prefix hit),
+        so there is nothing to batch the way the slot engine batches
+        prefill — the device work happens chunk-by-chunk in step()."""
+        admissible = []
+        for item in items:
+            reason = self._validate_item(item)
+            if reason is None:
+                admissible.append(item)
+            elif on_invalid is not None:
+                on_invalid(item, reason)
+            else:
+                raise ValueError(reason)
+        self._flush_pending()
+        placed: List[Tuple[int, Any]] = []
+        for item in admissible:
+            slot = self._admit(list(item["prompt"]),
+                               item.get("max_new", 32),
+                               item.get("request_id"))
+            if slot is None:
+                break
+            placed.append((slot, self.requests[slot].request_id))
+        return placed
+
+    # ------------------------------------------------------------- decode
+
+    def _prefill_tick(self) -> None:
+        """Run ONE fixed-shape prefill chunk for the stream at the head
+        of the prefill queue. This is the chunked-prefill interleave:
+        every step()/step_many() pays at most one chunk before its
+        decode dispatch, so running streams never stall behind a long
+        prompt."""
+        while self._prefill_q and self.requests[self._prefill_q[0]] is None:
+            self._prefill_q.popleft()          # aborted mid-prefill
+        if not self._prefill_q:
+            return
+        slot = self._prefill_q[0]
+        prompt = self._prompts[slot]
+        n = len(prompt)
+        c = self.prefill_chunk
+        start = self._prefill_pos[slot]
+        end = min(start + c, n)
+        chunk = np.zeros((1, c), np.int32)
+        chunk[0, :end - start] = prompt[start:end]
+        last = end >= n
+        li = (n - 1 - start) if last else 0
+        logits, self.pool = self._chunk_x(
+            self.params, self.pool, jnp.asarray(self._tables[slot]),
+            jnp.asarray(chunk), jnp.int32(start), jnp.int32(n),
+            jnp.int32(li))
+        self._prefill_pos[slot] = end
+        if last:
+            toks = self._select(logits)
+            self.lengths = self.lengths.at[slot].set(n)
+            self.cur_tok = self.cur_tok.at[slot].set(toks[0])
+            # the first token stays device-resident; the stream turns
+            # decode-active at the FLUSH (next engine call's top), never
+            # in this same call — otherwise the decode window appends
+            # tokens BEFORE the first token lands in r.tokens (order
+            # corruption) and an EOS/budget-1 first token would decode
+            # steps it should not
+            self._pending_first[slot] = toks[0]
+            self._prefill_q.popleft()
+
+    def _decode_tables(self) -> np.ndarray:
+        """Tables for the decode dispatch: any stream not actively
+        decoding (idle, still prefilling, retired) points at the scratch
+        page, so its garbage write cannot land on a live page."""
+        mask = np.array(
+            [self._decoding[i] and self.requests[i] is not None
+             for i in range(self.slots)])
+        return np.where(mask[:, None], self._tables,
+                        np.int32(self.scratch))
+
+    def _window_mp(self, active: List[int], k: int) -> int:
+        """Leading table columns a ``k``-step decode window can touch.
+
+        The host mirror of the device ``lengths`` is
+        ``prompt_len + len(tokens) - 1``, so the highest position any
+        active stream writes or reads this window is that + ``k`` — the
+        dispatch only needs the tables (and the attention gather behind
+        them) over ``ceil(.../page_size)`` LEADING pages, not the full
+        ``max_seq`` span. This is a paging-only win: the attention read
+        scales with the longest live stream while the slot engine's
+        fixed rows always pay ``max_seq`` width. Frozen rows (masked,
+        all-scratch tables) may carry lengths past the truncated span;
+        their clipped writes land on scratch and their outputs are
+        discarded, exactly as with full-width tables."""
+        top = max(self.requests[i].prompt_len
+                  + len(self.requests[i].tokens) for i in active)
+        return min(self.pages_per_stream,
+                   (top + k - 2) // self.page_size + 1)
+
+    def step(self) -> Dict[int, int]:
+        """One prefill chunk (if queued) + one decode step for every
+        decode-active stream; returns {stream: token}."""
+        self._flush_pending()
+        self._prefill_tick()
+        active = [i for i in range(self.slots)
+                  if self.requests[i] is not None and self._decoding[i]]
+        if not active:
+            return {}
+        mp = self._window_mp(active, 1)
+        tbl = jnp.asarray(self._decode_tables()[:, :mp])
+        logits, self.pool = self._step_x(self.params, self.pool, tbl,
+                                         self.lengths, self.cur_tok)
+        toks = self._select(logits)
+        mask = jnp.zeros((self.slots,), bool).at[
+            jnp.asarray(active, jnp.int32)].set(True)
+        self.lengths = jnp.where(mask, self.lengths + 1, self.lengths)
+        self.cur_tok = jnp.where(mask, toks, self.cur_tok)
+        out: Dict[int, int] = {}
+        host_toks = [int(t) for t in np.asarray(toks)]   # ONE transfer
+        for i in active:
+            tok = host_toks[i]
+            self.requests[i].tokens.append(tok)
+            out[i] = tok
+            self._maybe_retire(i)
+        return out
+
+    def step_many(self, k: int) -> Dict[int, List[int]]:
+        """Up to ``k`` prefill chunks + a ``k``-step decode window in
+        ONE dispatch (same scan-window trade as the slot engine — the
+        page table is fixed for the window, which the upfront full-span
+        allocation at admission makes safe). Prefill is paced to decode
+        exactly as in :meth:`step` (one chunk per decode step): a single
+        chunk per WINDOW would starve admission under sustained load —
+        1/k the prefill throughput — while an unbounded drain would
+        spike running streams' TPOT by the whole backlog. The loop stops
+        early when the queue empties, so an idle queue costs nothing."""
+        if k <= 1:
+            return {slot: [tok] for slot, tok in self.step().items()}
+        self._flush_pending()
+        for _ in range(k):
+            self._prefill_tick()
+            if not self._prefill_q:
+                break
+        active = [i for i in range(self.slots)
+                  if self.requests[i] is not None and self._decoding[i]]
+        if not active:
+            return {}
+        x = self._stepk_x.get(k)
+        if x is None:
+            cfg, rope, mesh = self.cfg, self._rope, self.mesh
+
+            def window(p, c, tbl, ln, tok, mask, key):
+                def body(carry, _):
+                    c, ln, tok, key = carry
+                    logits, c = llama.decode_step_paged(
+                        cfg, p, c, tbl, ln, tok, mesh=mesh, rope=rope)
+                    key, sub = jax.random.split(key)
+                    if self.sampler is None:
+                        nxt = jnp.argmax(logits, axis=-1).astype(
+                            jnp.int32)
+                    else:
+                        nxt = self.sampler(sub, logits).astype(jnp.int32)
+                    nxt = jnp.where(mask, nxt, tok)
+                    ln = jnp.where(mask, ln + 1, ln)
+                    return (c, ln, nxt, key), nxt
+
+                (c, ln, tok, key), toks = lax.scan(
+                    body, (c, ln, tok, key), None, length=k)
+                return c, ln, tok, key, toks
+
+            x = jax.jit(window, donate_argnums=(1,))
+            self._stepk_x[k] = x
+        mask = jnp.zeros((self.slots,), bool).at[
+            jnp.asarray(active, jnp.int32)].set(True)
+        self.key, sub = jax.random.split(self.key)
+        mp = self._window_mp(active, k)
+        tbl = jnp.asarray(self._decode_tables()[:, :mp])
+        (self.pool, self.lengths, self.cur_tok, _, toks) = x(
+            self.params, self.pool, tbl, self.lengths, self.cur_tok,
+            mask, sub)
+        host = np.asarray(toks)                          # ONE transfer
+        out: Dict[int, List[int]] = {}
+        for i in active:
+            emitted: List[int] = []
+            r = self.requests[i]
+            for t in host[:, i]:
+                emitted.append(int(t))
+                r.tokens.append(int(t))
+                self._maybe_retire(i)
+                if self.requests[i] is None:
+                    break
+            out[i] = emitted
+        return out
+
+    # --------------------------------------------------------- retirement
+
+    def _maybe_retire(self, slot: int) -> None:
+        r = self.requests[slot]
+        if r is None or not r.tokens:
+            return
+        done = (len(r.tokens) >= r.budget
+                or (self.eos_id is not None
+                    and r.tokens[-1] == self.eos_id)
+                or r.prompt_len + len(r.tokens) >= self.cfg.max_seq)
+        if done:
+            self.finished[r.request_id] = r.tokens
+            self.requests[slot] = None
+            self._release(slot, adopt=True)
+
+    def _release(self, slot: int, adopt: bool) -> None:
+        """Give a stream's pages back: optionally adopt its full prompt
+        pages into the prefix radix (adoption takes its own references
+        BEFORE the stream's drop, so shared content survives), then drop
+        the stream's reference on every page and point the table row at
+        scratch."""
+        pages = self._stream_pages[slot]
+        prompt = self._prompts[slot]
+        if adopt and self.radix is not None and prompt is not None:
+            # full prompt pages hold prompt-determined K/V only (decode
+            # writes start at position len(prompt)), so they are safe to
+            # share; a mid-window garbage write can only land in the
+            # final allocated page, which is never a full prompt page
+            self.radix.insert(prompt, pages)
+        for p in pages:
+            self.ledger.unref(p)
+        self._stream_pages[slot] = []
+        self._prompts[slot] = None
+        self._prefill_pos[slot] = 0
+        self._decoding[slot] = False
+        self._tables[slot, :] = self.scratch
+
+    def abort_active(self) -> int:
+        """Drop every in-flight request and return EVERY page it held
+        (mid-prefill pages may hold partial garbage, so nothing is
+        adopted into the radix); returns how many were dropped."""
+        dropped = 0
+        for i, r in enumerate(self.requests):
+            if r is not None:
+                self.requests[i] = None
+                self._release(i, adopt=False)
+                dropped += 1
+        self._prefill_q.clear()
+        self._pending_first.clear()
+        return dropped
+
+    def reset(self) -> None:
+        """Rebuild device + host state after a failed dispatch (the
+        jitted paths donate the pool, so its buffer may be invalid).
+        The radix is rebuilt too: its cached K/V lived in the old pool.
+        """
+        self.pool = llama.init_page_pool(self.cfg, self.total_pages + 1,
+                                         self.page_size)
+        if self.mesh is not None and self.mesh.size > 1:
+            self.pool = _shard_cache(self.pool, self.mesh)
+        self.ledger = PagePool(self.total_pages, self.page_size)
+        self.radix = (PrefixRadix(self.ledger)
+                      if self.radix is not None else None)
+        self._tables[:] = self.scratch
+        self.lengths = jnp.zeros((self.slots,), jnp.int32)
+        self.cur_tok = jnp.zeros((self.slots,), jnp.int32)
+        self.requests = [None] * self.slots
+        self.finished.clear()
+        self._pending_first.clear()
+        self._stream_pages = [[] for _ in range(self.slots)]
+        self._prompts = [None] * self.slots
+        self._prefill_pos = [0] * self.slots
+        self._prefill_q.clear()
+        self._decoding = [False] * self.slots
+
+    # -------------------------------------------------------------- audit
+
+    def expected_refs(self) -> Dict[int, int]:
+        """page -> references actually held (live stream tables + the
+        radix) — the invariant checker's cross-check input."""
+        expected: Dict[int, int] = {}
+        for pages in self._stream_pages:
+            for p in pages:
+                expected[p] = expected.get(p, 0) + 1
+        if self.radix is not None:
+            for p, cnt in self.radix.held().items():
+                expected[p] = expected.get(p, 0) + cnt
+        return expected
+
+    def ledger_violations(self) -> List[str]:
+        """Empty when the page ledger is healthy (chaos invariant)."""
+        return self.ledger.check(self.expected_refs())
+
+    def page_stats(self) -> Dict[str, Any]:
+        return {
+            "pages": self.total_pages,
+            "page_size": self.page_size,
+            "pages_free": self.ledger.free_count(),
+            "pages_in_use": self.ledger.in_use(),
+            "pages_in_use_peak": self.ledger.in_use_peak,
+            "prefix_hits": self.radix.hits if self.radix else 0,
+            "prefix_shared_pages": (self.radix.shared_pages
+                                    if self.radix else 0),
+        }

@@ -101,6 +101,11 @@ class GangServingDriver:
         self._stop = False
         self.iterations = 0
         self.errors = 0
+        # rank 0 only: drained but unadmitted pendings (a paged engine
+        # admits a FIFO prefix under page pressure) — re-broadcast FIRST
+        # next iteration; every rank re-submits the same prefix, so the
+        # gang stays deterministic and no client is silently dropped
+        self._backlog: List[_Pending] = []
 
     # ------------------------------------------------------------- loop
 
@@ -118,8 +123,11 @@ class GangServingDriver:
             # stamp BEFORE the work: a first-request compile lives
             # inside this iteration and must not flap health
             fe.mark_driven()
-            budget = min(self.max_intake, len(self.engine.free_slots()))
-            for p in fe.drain_intake(budget):
+            pendings.extend(self._backlog)
+            self._backlog = []
+            budget = (min(self.max_intake, len(self.engine.free_slots()))
+                      - len(pendings))
+            for p in fe.drain_intake(max(0, budget)):
                 if len(p.prompt) > self.max_prompt:
                     # unreachable with the default (full cache width);
                     # a narrowed wire format fails loudly, not silently
@@ -144,11 +152,14 @@ class GangServingDriver:
                 subs.append({"prompt": prompt, "max_new": max_new,
                              "request_id": rid})
             # ONE batched admission on every rank: identical items in
-            # identical order -> identical slot choices + dispatches
+            # identical order -> identical slot choices + dispatches.
+            # Both engines admit a FIFO prefix of the batch, so
+            # pendings[len(placed):] is exactly the unadmitted tail.
             placed = self.engine.submit_many(subs)
             if fe is not None:
                 for slot, rid in placed:
                     fe.attach(slot, rid)         # incl. instant retire
+                self._backlog = pendings[len(placed):]
         worked = bool(items)
         if self.engine.requests_active():
             self.engine.step_many(self.decode_window)
@@ -199,3 +210,6 @@ class GangServingDriver:
 
     def stop(self) -> None:
         self._stop = True
+        for p in self._backlog:
+            p.finish("server stopped")
+        self._backlog = []

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dcos_commons_tpu import _jax_compat  # noqa: F401  (installs renames)
+
 _NEG = -1e30
 _LANES = 128
 

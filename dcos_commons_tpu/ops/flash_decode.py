@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dcos_commons_tpu import _jax_compat  # noqa: F401  (installs renames)
 from dcos_commons_tpu.ops.quant import QTensor
 
 _NEG = -1e30
@@ -254,3 +255,167 @@ def supports_decode(q: jnp.ndarray, k) -> bool:
     kq = k.q if isinstance(k, QTensor) else k
     return (q.shape[1] == 1 and q.shape[-1] % _LANES == 0
             and kq.shape[1] % _LANES == 0)
+
+
+# ---------------------------------------------------------------------------
+# paged variant: the cache is a pool of pages + a per-stream page table
+
+def _paged_kernel(kv_len_ref, pt_ref, *rest, **kw):
+    # the page table is consumed entirely by the index maps (it decides
+    # WHICH page each k-block DMA reads); the arithmetic body is the
+    # slot kernel's verbatim — logical positions ik*block_k+iota vs
+    # kv_len don't care where the bytes physically live
+    del pt_ref
+    _decode_kernel(kv_len_ref, *rest, **kw)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sm_scale", "block_k", "interpret"))
+def flash_decode_paged(q: jnp.ndarray, k: Union[jnp.ndarray, QTensor],
+                       v: Union[jnp.ndarray, QTensor],
+                       page_table: jnp.ndarray, kv_len: jnp.ndarray, *,
+                       sm_scale: Optional[float] = None,
+                       block_k: int = 512,
+                       interpret: bool = False) -> jnp.ndarray:
+    """:func:`flash_decode` against a PAGED pool.
+
+    ``k``/``v`` are per-layer pools [P, ps, KV, D] (QTensor for int8);
+    ``page_table`` [B, MP] int32 maps stream b's logical page j to a
+    physical pool page. Same online-softmax body as the slot kernel —
+    the only new machinery is a second scalar-prefetch argument (the
+    flattened table) consulted by the k-block index maps, so each
+    k-block DMA lands on ``pt[b, logical_block // blocks_per_page]``.
+    Block skipping via clamp-to-last-live-block survives unchanged:
+    dead logical blocks clamp to a repeated (page, offset) pair and
+    Mosaic elides their DMAs, so cost still tracks kv_len, not the
+    table width.
+    """
+    b, s_q, h, d = q.shape
+    assert s_q == 1, "flash_decode_paged serves single-position steps"
+    quantized = isinstance(k, QTensor)
+    kq, ks = (k.q, k.s) if quantized else (k, None)
+    vq, vs = (v.q, v.s) if quantized else (v, None)
+    pages, ps, kv, _ = kq.shape
+    _, mp = page_table.shape
+    assert h % kv == 0, (h, kv)
+    group = h // kv
+    gp = -(-group // _SUBLANES) * _SUBLANES
+    # the block must tile a PAGE (DMAs cannot straddle two physically
+    # unrelated pages), so divide ps rather than max_seq
+    block_k = 1 << (min(block_k, ps).bit_length() - 1)
+    while block_k > _LANES and ps % block_k:
+        block_k //= 2
+    assert ps % block_k == 0 and d % _LANES == 0, (ps, d)
+    bpp = ps // block_k                          # blocks per page
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    kv_len = jnp.broadcast_to(kv_len.reshape(-1), (b,))
+    pt_flat = page_table.astype(jnp.int32).reshape(-1)     # [B*MP]
+
+    qg = q[:, 0].reshape(b, kv, group, d)
+    if gp != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    # pools: [P, ps, KV, D] -> [P, KV, ps, D]
+    kt = kq.transpose(0, 2, 1, 3)
+    vt = vq.transpose(0, 2, 1, 3)
+    if quantized:
+        kst = jnp.broadcast_to(
+            ks[..., 0].transpose(0, 2, 1)[:, :, None, :],
+            (pages, kv, _SUBLANES, ps))
+        vst = jnp.broadcast_to(
+            vs[..., 0].transpose(0, 2, 1)[:, :, None, :],
+            (pages, kv, _SUBLANES, ps))
+    else:
+        kst = vst = jnp.zeros((1, kv, _SUBLANES, _LANES), jnp.bfloat16)
+
+    clamp = _clamped(block_k)
+    n_blocks = mp * bpp
+    scale_block = block_k if quantized else _LANES
+
+    def k_map(bi, hi, ki, kv_len_ref, pt_ref):
+        kc = clamp(bi, ki, kv_len_ref)           # live logical block
+        page = pt_ref[bi * mp + kc // bpp]
+        return (page, hi, kc % bpp, 0)
+
+    def s_map(bi, hi, ki, kv_len_ref, pt_ref):
+        if scale_block != block_k:
+            return (0, hi, 0, 0)
+        kc = clamp(bi, ki, kv_len_ref)
+        return (pt_ref[bi * mp + kc // bpp], hi, 0, kc % bpp)
+
+    def q_map(bi, hi, ki, kv_len_ref, pt_ref):
+        return (bi, hi, 0, 0)
+
+    kernel = functools.partial(
+        _paged_kernel, sm_scale=scale, block_k=block_k,
+        quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, kv, n_blocks),
+            in_specs=[
+                pl.BlockSpec((1, 1, gp, d), q_map),
+                pl.BlockSpec((1, 1, block_k, d), k_map),
+                pl.BlockSpec((1, 1, block_k, d), k_map),
+                pl.BlockSpec((1, 1, _SUBLANES, scale_block), s_map),
+                pl.BlockSpec((1, 1, _SUBLANES, scale_block), s_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, gp, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((gp, _LANES), jnp.float32),
+                pltpu.VMEM((gp, _LANES), jnp.float32),
+                pltpu.VMEM((gp, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv, gp, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(kv_len, pt_flat, qg, kt, vt, kst, vst)
+    return out[:, :, :group, :].reshape(b, 1, h, d)
+
+
+def flash_decode_paged_tp(q: jnp.ndarray, k: Union[jnp.ndarray, QTensor],
+                          v: Union[jnp.ndarray, QTensor],
+                          page_table: jnp.ndarray, kv_len: jnp.ndarray,
+                          mesh, *, axis: str = "tp",
+                          sm_scale: Optional[float] = None,
+                          block_k: int = 512,
+                          interpret: bool = False) -> jnp.ndarray:
+    """:func:`flash_decode_paged` under tensor parallelism — the page
+    axis is replicated (every shard holds every page of its OWN heads),
+    the KV-head axis shards, the table/lengths broadcast. Head-local as
+    ever: no collectives."""
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape[axis]
+    kq = k.q if isinstance(k, QTensor) else k
+    kv_heads = kq.shape[2]
+    if kv_heads % tp:
+        raise ValueError(
+            f"flash_decode_paged_tp: {kv_heads} KV heads do not divide "
+            f"over {axis}={tp}")
+    qspec = P(None, None, axis, None)
+    pspec = P(None, None, axis, None)            # [P, ps, KV, D]
+    cspec = (QTensor(pspec, pspec) if isinstance(k, QTensor) else pspec)
+
+    def shard(q_l, k_l, v_l, pt_l, kv_len_l):
+        return flash_decode_paged(q_l, k_l, v_l, pt_l, kv_len_l,
+                                  sm_scale=sm_scale, block_k=block_k,
+                                  interpret=interpret)
+
+    return jax.shard_map(
+        shard, mesh=mesh,
+        in_specs=(qspec, cspec, cspec, P(), P()),
+        out_specs=qspec, check_vma=False)(
+            q, k, v, page_table.astype(jnp.int32),
+            jnp.asarray(kv_len, jnp.int32))
+
+
+def supports_decode_paged(q: jnp.ndarray, k, page_size: int) -> bool:
+    """Whether the paged pallas decode path can serve this call."""
+    return (q.shape[1] == 1 and q.shape[-1] % _LANES == 0
+            and page_size % _LANES == 0)

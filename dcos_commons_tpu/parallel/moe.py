@@ -41,6 +41,8 @@ import math
 from typing import Tuple
 
 import jax
+
+from dcos_commons_tpu import _jax_compat  # noqa: F401,E402
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P

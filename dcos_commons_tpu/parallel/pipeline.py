@@ -21,6 +21,8 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import jax
+
+from dcos_commons_tpu import _jax_compat  # noqa: F401,E402
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P

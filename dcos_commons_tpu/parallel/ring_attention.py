@@ -52,6 +52,8 @@ import functools
 from typing import Optional
 
 import jax
+
+from dcos_commons_tpu import _jax_compat  # noqa: F401,E402
 import jax.numpy as jnp
 import numpy as np
 from jax import lax

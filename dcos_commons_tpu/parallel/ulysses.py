@@ -14,6 +14,8 @@ import functools
 from typing import Optional
 
 import jax
+
+from dcos_commons_tpu import _jax_compat  # noqa: F401,E402
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P

@@ -42,6 +42,16 @@ DEFAULT_ENV: Mapping[str, str] = {
     "SERVE_SLOTS": "8",
     "SERVE_CHIPS": "1",
     "SERVE_FLAGS": "",
+    # paged-KV engine knobs (models/serving.py PagedServer): SERVE_PAGES
+    # > 0 switches the replica to the block-paged engine with that many
+    # KV pages (-1 = auto: slots x max_seq/page_size, i.e. slot-equivalent
+    # provisioning); 0 keeps the monolithic slot engine. The worker
+    # degrades to the slot engine (loudly, never crashing) when the
+    # paged config is infeasible for the model, e.g. max_seq not a
+    # multiple of SERVE_PAGE_SIZE.
+    "SERVE_PAGES": "0",
+    "SERVE_PAGE_SIZE": "64",
+    "SERVE_PREFILL_CHUNK": "64",
     # long-context scenario knobs (longctx.yml)
     "SEQ_LEN": "8192",
     "ATTN_IMPL": "ring",

@@ -346,9 +346,8 @@ def run_llama(args) -> dict:
             # pool, and measures TTFT/TPOT per request. Heartbeats report
             # the ingress stats instead of draining synthetic bursts.
             from dcos_commons_tpu.models.ingress import ServingFrontend
-            from dcos_commons_tpu.models.serving import SlotServer
-            server = SlotServer(cfg, params, slots=args.slots,
-                                mesh=mesh if mesh.size > 1 else None)
+            server, page_stats = _make_serving_engine(args, cfg, params,
+                                                      mesh)
             port = args.serve_port
             if port < 0:          # default: the reserved port, else any
                 port = int(os.environ.get("PORT_SERVE", "0"))
@@ -361,14 +360,19 @@ def run_llama(args) -> dict:
             with open("serving.ready", "w") as f:
                 f.write(f"ok {frontend.port}\n")
             _emit({"event": "serving", "slots": args.slots,
-                   "port": frontend.port, **result})
+                   "port": frontend.port,
+                   **({"paged": page_stats} if page_stats else {}),
+                   **result})
             i = 0
             while True:
                 time.sleep(args.serve_interval)
                 i += 1
                 try:
-                    _emit({"event": "heartbeat", "n": i,
-                           **frontend.stats()})
+                    hb = {"event": "heartbeat", "n": i,
+                          **frontend.stats()}
+                    if page_stats is not None:
+                        hb["paged"] = server.page_stats()
+                    _emit(hb)
                 except Exception as e:
                     _emit({"event": "heartbeat_error", "n": i,
                            "error": str(e)})
@@ -396,6 +400,34 @@ def run_llama(args) -> dict:
     return result
 
 
+def _make_serving_engine(args, cfg, params, mesh, key=None):
+    """SlotServer or PagedServer per ``--pages``, degrade-not-crash.
+
+    A paged config the model can't satisfy (page size not dividing
+    max_seq, chunk < 1, pool too small for a single stream) falls back
+    to the monolithic slot engine with a loud ``paged_fallback`` event —
+    a serving replica must come up serving, not crash-loop on a knob.
+    The decision is pure config, so every gang rank makes the same one.
+    """
+    from dcos_commons_tpu.models.serving import PagedServer, SlotServer
+    kw = {"mesh": mesh if mesh.size > 1 else None}
+    if key is not None:
+        kw["key"] = key
+    if args.pages:
+        try:
+            engine = PagedServer(
+                cfg, params, slots=args.slots,
+                pages=None if args.pages < 0 else args.pages,
+                page_size=args.page_size,
+                prefill_chunk=args.prefill_chunk, **kw)
+            return engine, engine.page_stats()
+        except ValueError as e:
+            _emit({"event": "paged_fallback", "error": str(e),
+                   "pages": args.pages, "page_size": args.page_size,
+                   "prefill_chunk": args.prefill_chunk})
+    return SlotServer(cfg, params, slots=args.slots, **kw), None
+
+
 def _serve_gang(args, contract, cfg, params, mesh, result) -> dict:
     """Multi-process serving: rank 0 runs the HTTP front door, every
     rank runs the lock-step broadcast/submit/step loop
@@ -403,13 +435,11 @@ def _serve_gang(args, contract, cfg, params, mesh, result) -> dict:
     import jax
 
     from dcos_commons_tpu.models.ingress import ServingFrontend
-    from dcos_commons_tpu.models.serving import SlotServer
     from dcos_commons_tpu.models.serving_gang import GangServingDriver
 
     rank = contract["process_id"]
-    server = SlotServer(cfg, params, slots=args.slots,
-                        mesh=mesh if mesh.size > 1 else None,
-                        key=jax.random.key(0))      # rank-identical seed
+    server, page_stats = _make_serving_engine(
+        args, cfg, params, mesh, key=jax.random.key(0))  # rank-identical
     frontend = None
     if rank == 0:
         port = args.serve_port
@@ -422,7 +452,9 @@ def _serve_gang(args, contract, cfg, params, mesh, result) -> dict:
         with open("serving.ready", "w") as f:
             f.write(f"ok {frontend.port}\n")
         _emit({"event": "serving", "slots": args.slots,
-               "port": frontend.port, "gang": True, **result})
+               "port": frontend.port, "gang": True,
+               **({"paged": page_stats} if page_stats else {}),
+               **result})
     else:
         _emit({"event": "serving", "slots": args.slots, "gang": True,
                "rank": rank, **result})
@@ -725,6 +757,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default: the PORT_SERVE env the matcher "
                         "reserved, else an ephemeral port; the bound "
                         "port is in the serving event)")
+    p.add_argument("--pages", type=int,
+                   default=int(os.environ.get("SERVE_PAGES", "0")),
+                   help="llama --serve --slots: KV pages in the "
+                        "block-paged engine's pool (models/serving.py "
+                        "PagedServer); -1 = auto (slots x max_seq / "
+                        "page_size), 0 = monolithic slot engine. An "
+                        "infeasible paged config degrades to the slot "
+                        "engine with a paged_fallback event")
+    p.add_argument("--page-size", type=int,
+                   default=int(os.environ.get("SERVE_PAGE_SIZE", "64")),
+                   help="llama --serve --slots --pages: tokens per KV "
+                        "page (must divide max_seq; multiples of 128 "
+                        "keep the pallas decode kernel eligible)")
+    p.add_argument("--prefill-chunk", type=int,
+                   default=int(os.environ.get("SERVE_PREFILL_CHUNK",
+                                              "64")),
+                   help="llama --serve --slots --pages: prompt tokens "
+                        "prefilled per engine step, interleaved with "
+                        "decode (bounds head-of-line TTFT impact of "
+                        "long prompts)")
     p.add_argument("--queue-limit", type=int, default=64,
                    help="llama --serve --slots: bounded ingress queue "
                         "(overflow answers 503 + Retry-After)")

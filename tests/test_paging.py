@@ -1,0 +1,186 @@
+"""Host-side KV-page ledger + prefix radix (``models/paging.py``):
+refcount discipline, hash-consed sharing, COW boundary semantics, and
+the crash-recovery reconcile sweep the chaos tier leans on."""
+
+import pytest
+
+from dcos_commons_tpu.models.paging import (PageLedgerError, PagePool,
+                                            PrefixRadix)
+
+
+class TestPagePool:
+    def test_alloc_is_ascending_and_all_or_nothing(self):
+        pool = PagePool(8, 4)
+        assert pool.alloc(3) == [0, 1, 2]   # gang determinism: every
+        assert pool.alloc(2) == [3, 4]      # rank picks the same pages
+        assert pool.alloc(9) is None        # partial grant would strand
+        assert pool.free_count() == 3       # ... and nothing was taken
+        assert pool.alloc(0) == []
+
+    def test_ref_unref_free_cycle(self):
+        pool = PagePool(4, 4)
+        (p,) = pool.alloc(1)
+        pool.ref(p)
+        assert pool.refcount(p) == 2
+        pool.unref(p)
+        assert pool.refcount(p) == 1 and pool.free_count() == 3
+        pool.unref(p)
+        assert pool.free_count() == 4
+        # freed pages recirculate
+        assert pool.alloc(4) is not None
+
+    def test_double_free_and_ref_of_free_raise(self):
+        pool = PagePool(2, 4)
+        (p,) = pool.alloc(1)
+        pool.unref(p)
+        with pytest.raises(PageLedgerError, match="double free"):
+            pool.unref(p)
+        with pytest.raises(PageLedgerError, match="free page"):
+            pool.ref(p)
+        with pytest.raises(PageLedgerError, match="unknown"):
+            pool.unref(99)
+
+    def test_check_catches_corruption(self):
+        pool = PagePool(4, 4)
+        pages = pool.alloc(2)
+        assert pool.check({pages[0]: 1, pages[1]: 1}) == []
+        pool._ref[pages[0]] = -1              # simulate corruption
+        problems = pool.check()
+        assert any("negative" in p for p in problems)
+        pool._ref[pages[0]] = 0               # counted free, not listed
+        assert any("leaked" in p for p in pool.check())
+
+    def test_check_cross_checks_expected_refs(self):
+        pool = PagePool(4, 4)
+        (p,) = pool.alloc(1)
+        assert pool.check({p: 1}) == []
+        # a table row still points at a page the ledger freed (or vice
+        # versa): the cross-check names the page
+        assert any(str(p) in v for v in pool.check({p: 2}))
+        assert any("references held" in v for v in pool.check({}))
+
+    def test_reconcile_reclaims_crash_leak(self):
+        pool = PagePool(8, 4)
+        kept = pool.alloc(2)
+        lost = pool.alloc(3)                  # stream died without unref
+        expected = {p: 1 for p in kept}
+        assert sorted(pool.reconcile(expected)) == sorted(lost)
+        assert pool.free_count() == 6
+        assert pool.check(expected) == []
+
+    def test_in_use_peak_high_water(self):
+        pool = PagePool(8, 4)
+        a = pool.alloc(5)
+        for p in a:
+            pool.unref(p)
+        pool.alloc(2)
+        assert pool.in_use_peak == 5
+
+
+class TestPrefixRadix:
+    def _pair(self, pages=16, ps=4):
+        pool = PagePool(pages, ps)
+        return pool, PrefixRadix(pool)
+
+    def test_lookup_always_leaves_a_token_to_prefill(self):
+        """A prompt of exactly k full pages shares at most k-1: the
+        final prefill chunk needs >= 1 live position to take first-token
+        logits from."""
+        pool, radix = self._pair()
+        prompt = list(range(8))               # exactly 2 pages of 4
+        pages = pool.alloc(2)
+        radix.insert(prompt, pages)
+        for p in pages:                       # stream retires; the radix
+            pool.unref(p)                     # keeps its own references
+        shared, node = radix.lookup(prompt)
+        assert shared == [pages[0]]           # page 2 NOT shared
+        assert pool.refcount(pages[0]) == 2   # radix + the lookup's ref
+        assert pool.refcount(pages[1]) == 1   # radix only
+        pool.unref(pages[0])
+
+    def test_insert_hash_cons_keeps_first_copy(self):
+        pool, radix = self._pair()
+        prompt = list(range(12))
+        first = pool.alloc(3)
+        assert radix.insert(prompt, first) == 3
+        assert all(pool.refcount(p) == 2 for p in first)  # stream + radix
+        dup = pool.alloc(3)                   # a second stream's copy
+        assert radix.insert(prompt, dup) == 0  # nothing adopted
+        assert all(pool.refcount(p) == 1 for p in dup)  # stream-only
+        assert radix.held() == {p: 1 for p in first}
+
+    def test_boundary_partial_page_match(self):
+        pool, radix = self._pair()
+        prompt = list(range(8))
+        pages = pool.alloc(2)
+        radix.insert(prompt, pages)
+        # new prompt: same first page, same first 3 tokens of page 2
+        # (the longest shareable span: ps - 1), then diverges ->
+        # boundary offers page 2 for an eager COW copy
+        other = prompt[:7] + [99, 98]
+        shared, node = radix.lookup(other)
+        assert shared == [pages[0]]
+        src, valid = radix.boundary(node, other, matched_tokens=4)
+        assert src == pages[1] and valid == 3
+        pool.unref(pages[0])
+
+    def test_boundary_none_on_divergence(self):
+        pool, radix = self._pair()
+        pages = pool.alloc(2)
+        radix.insert(list(range(8)), pages)
+        shared, node = radix.lookup([0, 1, 2, 3, 77, 66])
+        assert radix.boundary(node, [0, 1, 2, 3, 77, 66], 4) is None
+        for p in shared:
+            pool.unref(p)
+
+    def test_evict_spares_shared_and_parents(self):
+        pool, radix = self._pair()
+        prompt = list(range(8))
+        pages = pool.alloc(2)
+        radix.insert(prompt, pages)
+        for p in pages:                       # original stream retires
+            pool.unref(p)
+        shared, _ = radix.lookup(prompt)      # live stream shares head
+        assert radix.evict(2) == 1            # only the childless leaf
+        assert radix.held() == {pages[0]: 1}  # shared head survives
+        pool.unref(pages[0])                  # stream retires...
+        assert radix.evict(1) == 1            # ...now it is evictable
+        assert pool.free_count() == pool.pages
+
+    def test_evict_takes_least_recently_used_first(self):
+        pool, radix = self._pair()
+        a, b = pool.alloc(1), pool.alloc(1)
+        radix.insert(list(range(4)) + [9], a)
+        radix.insert(list(range(40, 44)) + [9], b)
+        for p in a + b:                       # both streams retire
+            pool.unref(p)
+        # touch chain A so B is the LRU victim
+        shared, _ = radix.lookup(list(range(4)) + [9])
+        for p in shared:
+            pool.unref(p)
+        radix.evict(1)
+        assert radix.held() == {a[0]: 1}
+
+    def test_clear_releases_everything(self):
+        pool, radix = self._pair()
+        pages = pool.alloc(3)
+        radix.insert(list(range(12)), pages)
+        for p in pages:
+            pool.unref(p)
+        radix.clear()
+        assert radix.held() == {}
+        assert pool.free_count() == pool.pages
+        assert pool.check({}) == []
+
+    def test_stats_count_hits_and_shared_pages(self):
+        pool, radix = self._pair()
+        pages = pool.alloc(3)
+        radix.insert(list(range(12)), pages)
+        for p in pages:
+            pool.unref(p)
+        shared, _ = radix.lookup(list(range(12)))
+        assert radix.hits == 1 and radix.shared_pages == 2
+        _, _ = radix.lookup([55, 44, 33])     # miss: no count
+        assert radix.hits == 1
+        for p in shared:
+            pool.unref(p)

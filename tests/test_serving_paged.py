@@ -1,0 +1,424 @@
+"""Block-paged serving engine (``models/serving.py:PagedServer``):
+exact greedy parity with solo decode across dense / int8-KV / pallas /
+tensor-parallel stacks, chunked-prefill interleaving, prefix-sharing
+COW semantics, page-ledger hygiene through retire/abort/reset, and the
+pages-free admission seams (HTTP ingress, gang driver)."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import tests._jax_cpu  # noqa: F401
+
+from dcos_commons_tpu.models import llama, serving
+from dcos_commons_tpu.models.ingress import ServingFrontend
+from dcos_commons_tpu.models.serving_gang import GangServingDriver
+
+
+def _cfg(**kw):
+    return llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                  attn_impl="dense", **kw)
+
+
+def _solo(cfg, params, prompt, steps, mesh=None):
+    toks = llama.generate_stepwise(cfg, params,
+                                   jnp.asarray([prompt], jnp.int32),
+                                   steps, mesh=mesh)
+    return [int(t) for t in toks[0]]
+
+
+def _prompt(seed, n, vocab):
+    return [int(t) for t in jax.random.randint(
+        jax.random.key(seed), (n,), 0, vocab)]
+
+
+def _flash_cfg(n_kv_heads=1):
+    kw = dict(vocab_size=128, dim=256, n_layers=2, n_heads=2,
+              n_kv_heads=n_kv_heads, ffn_dim=256, max_seq=128,
+              remat=False)
+    cfg = llama.LlamaConfig(**kw, attn_impl="dense", kv_quant=True,
+                            decode_attn="flash_interpret")
+    params = llama.quantize_params(llama.init_params(
+        llama.LlamaConfig(**kw), jax.random.key(0)))
+    return cfg, params
+
+
+# ----------------------------------------------------------------- parity
+
+
+def test_paged_streams_match_solo_decode():
+    """Mixed-length requests through the paged engine (forcing stream
+    reuse) each emit exactly their solo greedy stream — windowed decode
+    included."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    reqs = [{"prompt": _prompt(40 + i, n, cfg.vocab_size),
+             "max_new": m, "request_id": i}
+            for i, (n, m) in enumerate([(8, 6), (5, 9), (12, 4),
+                                        (20, 7)])]
+    want = {r["request_id"]: _solo(cfg, params, r["prompt"],
+                                   r["max_new"]) for r in reqs}
+    got = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                              prefill_chunk=8).drain(
+        [dict(r) for r in reqs])
+    assert got == want, (got, want)
+    windowed = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                   prefill_chunk=8).drain(
+        [dict(r) for r in reqs], decode_window=4)
+    assert windowed == want, (windowed, want)
+
+
+def test_paged_parity_without_prefix_cache():
+    """Shared-prefix prompts with sharing DISABLED still match solo —
+    the radix is an optimization, never a correctness dependency."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    base = _prompt(50, 20, cfg.vocab_size)
+    reqs = [{"prompt": base, "max_new": 5, "request_id": "a"},
+            {"prompt": base[:16] + _prompt(51, 4, cfg.vocab_size),
+             "max_new": 6, "request_id": "b"},
+            {"prompt": base, "max_new": 4, "request_id": "c"}]
+    server = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                                 prefill_chunk=8, prefix_cache=False)
+    got = server.drain([dict(r) for r in reqs])
+    for r in reqs:
+        want = _solo(cfg, params, r["prompt"], r["max_new"])
+        assert got[r["request_id"]] == want, (r["request_id"],)
+    assert server.page_stats()["prefix_hits"] == 0
+
+
+def test_admission_blocks_on_pages_not_slots():
+    """The paged engine admits on PAGES free: with the pool sized for
+    two full streams, four free slots still only admit two requests —
+    and the backlog completes with exact parity once pages recycle."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    # each request spans 60 tokens -> 4 pages of 16; pool holds 8
+    reqs = [{"prompt": _prompt(60 + i, 40, cfg.vocab_size),
+             "max_new": 20, "request_id": i} for i in range(4)]
+    server = serving.PagedServer(cfg, params, slots=4, pages=8,
+                                 page_size=16, prefill_chunk=16)
+    placed = server.submit_many([dict(r) for r in reqs])
+    assert len(placed) == 2                      # page-bound, not slot-bound
+    assert len(server.free_slots()) == 2         # slots were NOT the limit
+    assert server.pages_free() == 0
+    got = server.drain([dict(r) for r in reqs[len(placed):]])
+    for r in reqs:
+        want = _solo(cfg, params, r["prompt"], r["max_new"])
+        assert got[r["request_id"]] == want, (r["request_id"],)
+    assert server.ledger_violations() == []
+    assert server.page_stats()["pages_in_use_peak"] == 8
+
+
+def test_kv_quant_paged_parity():
+    """int8 KV pages (QTensor pool) match solo int8-KV decode."""
+    cfg = _cfg(kv_quant=True)
+    params = llama.init_params(cfg, jax.random.key(0))
+    reqs = [{"prompt": _prompt(70 + i, n, cfg.vocab_size),
+             "max_new": m, "request_id": i}
+            for i, (n, m) in enumerate([(8, 5), (14, 6)])]
+    got = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                              prefill_chunk=8).drain(
+        [dict(r) for r in reqs])
+    for r in reqs:
+        want = _solo(cfg, params, r["prompt"], r["max_new"])
+        assert got[r["request_id"]] == want, (r["request_id"],)
+
+
+def test_flash_interpret_paged_parity():
+    """The pallas paged-decode kernel (interpret mode) + int8 KV serves
+    exactly the solo stream. head_dim and page_size are both 128-aligned
+    so the REAL kernel path (not the dense fallback) is exercised."""
+    cfg, params = _flash_cfg()
+    assert llama._use_flash_decode_paged(cfg, None, 128)
+    reqs = [{"prompt": _prompt(80 + i, n, cfg.vocab_size),
+             "max_new": m, "request_id": i}
+            for i, (n, m) in enumerate([(8, 5), (16, 7)])]
+    got = serving.PagedServer(cfg, params, slots=2, page_size=128,
+                              prefill_chunk=8).drain(
+        [dict(r) for r in reqs])
+    for r in reqs:
+        want = _solo(cfg, params, r["prompt"], r["max_new"])
+        assert got[r["request_id"]] == want, (r["request_id"],)
+
+
+class TestPagedServerTP:
+    """Paged serving composes with tensor parallelism: streams on a
+    sharded mesh equal SOLO decode on the same mesh (same reduction
+    orders — see TestSlotServerTP for why the reference must also be
+    sharded)."""
+
+    def test_tp_paged_streams_match_solo_tp(self):
+        from dcos_commons_tpu.parallel.mesh import MeshSpec
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        mesh = MeshSpec(tp=2).build(jax.devices()[:2])
+        with mesh:
+            sharded = llama.shard_params(params, mesh, cfg)
+        reqs = [{"prompt": _prompt(90 + i, n, cfg.vocab_size),
+                 "max_new": m, "request_id": i}
+                for i, (n, m) in enumerate([(8, 6), (5, 9), (12, 4)])]
+        want = {r["request_id"]: _solo(cfg, sharded, r["prompt"],
+                                       r["max_new"], mesh=mesh)
+                for r in reqs}
+        got = serving.PagedServer(cfg, sharded, slots=2, page_size=16,
+                                  prefill_chunk=8, mesh=mesh).drain(
+            [dict(r) for r in reqs])
+        assert got == want, (got, want)
+
+    def test_tp_paged_flash_kernel_int8(self):
+        """Full paged tp stack — int8 weights, int8 KV pages, pallas
+        paged kernel per head shard (interpret) — matches solo on the
+        same mesh."""
+        from dcos_commons_tpu.parallel.mesh import MeshSpec
+        cfg, params = _flash_cfg(n_kv_heads=2)
+        mesh = MeshSpec(tp=2).build(jax.devices()[:2])
+        with mesh:
+            sharded = llama.shard_params(params, mesh, cfg)
+        # same request set as test_tp_slot_flash_kernel_int8: int8
+        # weights make the bf16 logit grid coarse enough for EXACT
+        # argmax ties, which different (all-valid) tp reduction orders
+        # break differently — these prompts are tie-free at every step
+        reqs = [{"prompt": _prompt(20 + i, n, cfg.vocab_size),
+                 "max_new": m, "request_id": i}
+                for i, (n, m) in enumerate([(8, 5), (16, 7), (4, 3)])]
+        want = {r["request_id"]: _solo(cfg, sharded, r["prompt"],
+                                       r["max_new"], mesh=mesh)
+                for r in reqs}
+        got = serving.PagedServer(cfg, sharded, slots=2, page_size=128,
+                                  prefill_chunk=8, mesh=mesh).drain(
+            [dict(r) for r in reqs])
+        assert got == want, (got, want)
+
+
+# -------------------------------------------------------- chunked prefill
+
+
+class TestChunkedPrefill:
+    def test_decode_interleaves_with_long_prefill(self):
+        """A long prompt prefills one fixed chunk per step while an
+        already-running stream keeps emitting a token EVERY step — the
+        head-of-line blocking the chunking exists to kill."""
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        server = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                                     prefill_chunk=4)
+        short = _prompt(100, 4, cfg.vocab_size)
+        long = _prompt(101, 33, cfg.vocab_size)
+        sa = server.submit(short, max_new=24, request_id="a")
+        while not server._decoding[sa]:
+            server.step()
+        sb = server.submit(long, max_new=8, request_id="b")
+        interleaved = 0
+        while server._prefill_q:
+            before = len(server.requests[sa].tokens)
+            server.step()
+            assert len(server.requests[sa].tokens) == before + 1
+            # the prefilling stream must not emit mid-prefill
+            if server.requests[sb] is not None:
+                assert server.requests[sb].tokens == []
+            interleaved += 1
+        assert interleaved >= 8        # 33 tokens / chunks of 4
+        while server.requests_active():
+            server.step()
+        assert server.finished["a"] == _solo(cfg, params, short, 24)
+        assert server.finished["b"] == _solo(cfg, params, long, 8)
+        assert server.ledger_violations() == []
+
+    def test_first_token_deferred_until_next_step(self):
+        """The final chunk's sampled token stays device-resident (no
+        per-request host sync); it lands in the stream at the NEXT
+        step's flush, together with decode activation."""
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        server = serving.PagedServer(cfg, params, slots=1, page_size=8,
+                                     prefill_chunk=8)
+        prompt = _prompt(102, 6, cfg.vocab_size)
+        slot = server.submit(prompt, max_new=4, request_id="x")
+        server.step()                      # runs the one prefill chunk
+        assert slot in server._pending_first        # deferred on device
+        assert server.requests[slot].tokens == []   # nothing synced yet
+        assert not server._decoding[slot]
+        server.step()                      # flush + first decode step
+        want = _solo(cfg, params, prompt, 4)
+        assert server.requests[slot].tokens == want[:2]
+
+
+# ------------------------------------------------- prefix sharing + ledger
+
+
+class TestPrefixSharingAndLedger:
+    def test_retire_adopts_prefix_then_second_request_shares(self):
+        """A retired stream's full prompt pages live on in the radix;
+        an identical prompt re-served shares them (prefix_hits) and
+        still emits the exact solo stream."""
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        server = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                                     prefill_chunk=8)
+        prompt = _prompt(110, 20, cfg.vocab_size)
+        want = _solo(cfg, params, prompt, 6)
+        first = server.drain([{"prompt": prompt, "max_new": 6,
+                               "request_id": "a"}])
+        assert first["a"] == want
+        stats = server.page_stats()
+        assert stats["prefix_hits"] == 0
+        # 2 full prompt pages (tokens 0..16) adopted into the radix
+        assert stats["pages_in_use"] == 2
+        second = server.drain([{"prompt": list(prompt), "max_new": 6,
+                                "request_id": "b"}])
+        assert second["b"] == want
+        stats = server.page_stats()
+        assert stats["prefix_hits"] == 1
+        assert stats["prefix_shared_pages"] == 2
+        assert server.ledger_violations() == []
+
+    def test_cow_boundary_page_stays_private(self):
+        """A prompt that is a PARTIAL-page extension of a cached prefix
+        gets an eager private copy of the boundary page — its stream
+        matches solo, and decoding into the copy never corrupts the
+        cached original (the original prompt re-serves exactly)."""
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        server = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                                     prefill_chunk=8)
+        a = _prompt(111, 16, cfg.vocab_size)
+        b = a[:13]                     # full page + 5-token partial tail
+        want_a = _solo(cfg, params, a, 6)
+        want_b = _solo(cfg, params, b, 6)
+        assert server.drain([{"prompt": a, "max_new": 6,
+                              "request_id": "a"}])["a"] == want_a
+        got_b = server.drain([{"prompt": b, "max_new": 6,
+                               "request_id": "b"}])
+        assert got_b["b"] == want_b
+        assert server.page_stats()["prefix_hits"] == 1   # page 1 shared
+        # the cached original is untouched by b's COW + decode writes
+        got_a2 = server.drain([{"prompt": list(a), "max_new": 6,
+                                "request_id": "a2"}])
+        assert got_a2["a2"] == want_a
+        assert server.ledger_violations() == []
+
+    def test_abort_returns_every_page(self):
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        server = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                                     prefill_chunk=4, prefix_cache=False)
+        server.submit(_prompt(112, 20, cfg.vocab_size), max_new=10)
+        server.submit(_prompt(113, 6, cfg.vocab_size), max_new=10)
+        for _ in range(3):
+            server.step()              # one mid-prefill, one decoding
+        assert server.abort_active() == 2
+        assert server.pages_free() == server.total_pages
+        assert server.ledger_violations() == []
+        assert not server._prefill_q and not server._pending_first
+
+    def test_reset_rebuilds_clean_and_serves_again(self):
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        server = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                                     prefill_chunk=4)
+        prompt = _prompt(114, 12, cfg.vocab_size)
+        server.submit(prompt, max_new=8, request_id="pre")
+        server.step()
+        server.reset()
+        assert server.pages_free() == server.total_pages
+        assert server.ledger_violations() == []
+        assert server.page_stats()["prefix_hits"] == 0
+        got = server.drain([{"prompt": prompt, "max_new": 8,
+                             "request_id": "post"}])
+        assert got["post"] == _solo(cfg, params, prompt, 8)
+
+    def test_infeasible_configs_rejected_loudly(self):
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        with pytest.raises(ValueError, match="must divide"):
+            serving.PagedServer(cfg, params, slots=2, page_size=24)
+        with pytest.raises(ValueError, match=">= 1 page"):
+            serving.PagedServer(cfg, params, slots=2, pages=0,
+                                page_size=16)
+        server = serving.PagedServer(cfg, params, slots=2, pages=2,
+                                     page_size=16)
+        # 60 tokens need 4 pages; the pool permanently holds 2
+        with pytest.raises(ValueError, match="pages"):
+            server.submit(_prompt(115, 40, cfg.vocab_size), max_new=20)
+
+
+# ---------------------------------------------------------------- seams
+
+
+def _post(port, payload, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestPagedSeams:
+    def test_ingress_backlog_drains_under_page_pressure(self):
+        """Four concurrent HTTP clients against a pool that fits two
+        streams: the ingress re-offers the page-blocked tail until pages
+        recycle, and every client gets its exact solo stream."""
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        engine = serving.PagedServer(cfg, params, slots=4, pages=8,
+                                     page_size=16, prefill_chunk=16)
+        fe = ServingFrontend(engine, port=0, host="127.0.0.1").start()
+        try:
+            prompts = [_prompt(120 + i, 40, cfg.vocab_size)
+                       for i in range(4)]
+            results = [None] * 4
+
+            def hit(i):
+                results[i] = _post(fe.port, {"prompt": prompts[i],
+                                             "max_new": 20})
+
+            threads = [threading.Thread(target=hit, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            for i in range(4):
+                status, body = results[i]
+                assert status == 200
+                want = _solo(cfg, params, prompts[i], 20)
+                assert body["tokens"] == want, (i,)
+        finally:
+            fe.stop()
+        assert engine.ledger_violations() == []
+
+    def test_gang_driver_single_process_paged(self):
+        """The lock-step gang loop (num_processes=1 degenerate) drives
+        the paged engine behind real HTTP."""
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        engine = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                     prefill_chunk=8,
+                                     key=jax.random.key(0))
+        fe = ServingFrontend(engine, port=0, host="127.0.0.1")
+        fe.start(drive=False)
+        driver = GangServingDriver(engine, fe, num_processes=1,
+                                   process_id=0, decode_window=4)
+        t = threading.Thread(target=driver.run, daemon=True)
+        t.start()
+        try:
+            got = {}
+            for i in range(3):
+                p = _prompt(130 + i, 5 + i, cfg.vocab_size)
+                status, body = _post(fe.port, {"prompt": p,
+                                               "max_new": 6})
+                assert status == 200
+                got[i] = (body["tokens"], _solo(cfg, params, p, 6))
+            for i, (tokens, want) in got.items():
+                assert tokens == want, (i, tokens, want)
+        finally:
+            driver.stop()
+            t.join(timeout=10)
+            fe.stop()
+        assert engine.ledger_violations() == []

@@ -13,6 +13,16 @@ One JSON line. Usage::
 
     python -m tools.bench_serving [--preset 400m] [--quant int8]
         [--slots 8] [--rps 4] [--duration 30] [--max-new 32]
+        [--engine paged] [--pages -1] [--page-size 64]
+        [--prefill-chunk 64]
+
+``--engine paged`` swaps in the block-paged engine (PagedServer):
+``--pages`` sizes the KV pool (-1 = auto slot-equivalent,
+slots x max_seq/page_size), and the receipt gains ``serve_paged`` /
+``page_size`` / ``pages_in_use_peak`` / ``prefix_hits`` so a pages-vs-
+slots A/B is auditable from the two JSON lines alone. An infeasible
+paged config degrades to the slot engine and the receipt says so
+(``paged_fallback``), mirroring the worker's behaviour.
 """
 
 from __future__ import annotations
@@ -32,7 +42,12 @@ from dcos_commons_tpu.utils.stats import percentiles as _percentiles
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--preset", default="400m",
-                   choices=["tiny", "400m", "8b"])
+                   choices=["tiny", "mid", "400m", "8b"],
+                   help="'mid' (~25M params) is the CPU-scale A/B "
+                        "config: big enough that decode streams "
+                        "weights (step cost ~flat in batch width, the "
+                        "regime a real chip serves in), small enough "
+                        "to saturate in seconds")
     p.add_argument("--quant", default="int8", choices=["none", "int8"])
     p.add_argument("--kv-quant", action="store_true")
     p.add_argument("--slots", type=int, default=8)
@@ -42,10 +57,22 @@ def main(argv=None) -> int:
     p.add_argument("--max-new", type=int, default=32)
     p.add_argument("--prompt-lens", default="8,16,32,64",
                    help="request prompt lengths, sampled uniformly")
+    p.add_argument("--shared-prefix", type=int, default=0,
+                   help="prepend a fixed N-token system prompt to every "
+                        "request (on top of --prompt-lens tails) — the "
+                        "workload shape prefix sharing exists for; the "
+                        "slot engine re-prefills it per request, the "
+                        "paged engine serves it from one physical copy")
     p.add_argument("--queue-limit", type=int, default=64)
     p.add_argument("--decode-window", type=int, default=8,
                    help="tokens per device dispatch "
                         "(SlotServer.step_many)")
+    p.add_argument("--engine", default="slot", choices=["slot", "paged"])
+    p.add_argument("--pages", type=int, default=-1,
+                   help="paged engine pool size (-1 = auto: "
+                        "slots x max_seq/page_size)")
+    p.add_argument("--page-size", type=int, default=64)
+    p.add_argument("--prefill-chunk", type=int, default=64)
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
@@ -53,7 +80,7 @@ def main(argv=None) -> int:
 
     from dcos_commons_tpu.models import llama
     from dcos_commons_tpu.models.ingress import ServingFrontend
-    from dcos_commons_tpu.models.serving import SlotServer
+    from dcos_commons_tpu.models.serving import PagedServer, SlotServer
 
     if args.preset == "8b":
         cfg = llama.LlamaConfig.llama3_8b(max_seq=2048, remat=False,
@@ -61,9 +88,17 @@ def main(argv=None) -> int:
     elif args.preset == "400m":
         cfg = llama.LlamaConfig.llama_400m(max_seq=2048,
                                            kv_quant=args.kv_quant)
+    elif args.preset == "mid":
+        # GQA 8:2 and a short max_seq keep per-step KV traffic well
+        # under the ~100MB of weights, so decode stays weight-bound
+        # (step cost ~flat in width) instead of KV-gather-bound
+        cfg = llama.LlamaConfig(vocab_size=2048, dim=512, n_layers=8,
+                                n_heads=8, n_kv_heads=2, ffn_dim=1376,
+                                max_seq=128, remat=False,
+                                kv_quant=args.kv_quant)
     else:
         cfg = llama.LlamaConfig.tiny(kv_quant=args.kv_quant)
-    if args.quant == "int8" and args.preset != "tiny":
+    if args.quant == "int8" and args.preset not in ("tiny", "mid"):
         params = llama.init_quantized_params(cfg, jax.random.key(0),
                                              device=jax.devices()[0])
         quant_applied = "int8"
@@ -72,9 +107,28 @@ def main(argv=None) -> int:
         params = llama.init_params(cfg, jax.random.key(0))
         quant_applied = "none"
 
-    engine = SlotServer(cfg, params, slots=args.slots)
+    paged_fallback = None
+    if args.engine == "paged":
+        try:
+            engine = PagedServer(
+                cfg, params, slots=args.slots,
+                pages=None if args.pages < 0 else args.pages,
+                page_size=args.page_size,
+                prefill_chunk=args.prefill_chunk)
+        except ValueError as e:
+            paged_fallback = str(e)
+            engine = SlotServer(cfg, params, slots=args.slots)
+    else:
+        engine = SlotServer(cfg, params, slots=args.slots)
+    paged = isinstance(engine, PagedServer)
     rng = random.Random(args.seed)
     lens = [int(x) for x in args.prompt_lens.split(",")]
+    sys_prefix = [rng.randrange(cfg.vocab_size)
+                  for _ in range(args.shared_prefix)]
+
+    def make_prompt(r, n):
+        return sys_prefix + [r.randrange(cfg.vocab_size)
+                             for _ in range(n)]
 
     # warm the whole executable matrix the load will hit — batched
     # admission (pow2 batch x bucket prefills) and the decode window —
@@ -83,25 +137,38 @@ def main(argv=None) -> int:
     # so warming after start() would race the engine thread on the
     # donated cache
     wrng = random.Random(1)
-    for n in sorted(set(lens)):
-        k = 1
-        while k <= args.slots:
-            batch = [{"prompt": [wrng.randrange(cfg.vocab_size)
-                                 for _ in range(n)],
-                      "max_new": 2, "request_id": (n, k, j)}
-                     for j in range(k)]
-            engine.submit_many(batch)
+    if paged:
+        # the paged matrix is one chunk executable + one decode window
+        # PER live-span page count (decode dispatches read only the
+        # pages the window can touch): a request per prompt length plus
+        # a full-length decode of the longest sweeps every variant the
+        # load can hit
+        for n in sorted(set(lens)):
+            engine.submit(make_prompt(wrng, n),
+                          max_new=args.max_new if n == max(lens) else 2,
+                          request_id=("warm", n))
             while engine.requests_active():
                 engine.step_many(args.decode_window)
-            engine.finished.clear()
-            k *= 2
+        engine.finished.clear()
+    else:
+        for n in sorted(set(lens)):
+            k = 1
+            while k <= args.slots:
+                batch = [{"prompt": make_prompt(wrng, n),
+                          "max_new": 2, "request_id": (n, k, j)}
+                         for j in range(k)]
+                engine.submit_many(batch)
+                while engine.requests_active():
+                    engine.step_many(args.decode_window)
+                engine.finished.clear()
+                k *= 2
     fe = ServingFrontend(engine, port=0, host="127.0.0.1",
                          max_queue=args.queue_limit,
                          decode_window=args.decode_window).start()
     # HTTP-path warmup (engine already warm; these ride the engine
     # thread like real traffic)
     for n in sorted(set(lens)):
-        prompt = [rng.randrange(cfg.vocab_size) for _ in range(n)]
+        prompt = make_prompt(rng, n)
         req = urllib.request.Request(
             f"http://127.0.0.1:{fe.port}/v1/generate",
             data=json.dumps({"prompt": prompt, "max_new": 2}).encode())
@@ -139,7 +206,7 @@ def main(argv=None) -> int:
         # open-loop Poisson: exponential inter-arrival, fire-and-forget
         time.sleep(rng.expovariate(args.rps))
         n = rng.choice(lens)
-        prompt = [rng.randrange(cfg.vocab_size) for _ in range(n)]
+        prompt = make_prompt(rng, n)
         th = threading.Thread(target=fire, args=(prompt,), daemon=True)
         th.start()
         threads.append(th)
@@ -158,11 +225,20 @@ def main(argv=None) -> int:
     ttfts = [r[2] for r in results if r[2] is not None]
     tpots = [r[3] for r in results if r[3] is not None]
     total_tokens = sum(r[1] for r in results)
+    page_stats = engine.page_stats() if paged else {}
     print(json.dumps({
         "metric": "serving_latency",
         "preset": args.preset, "quant": quant_applied,
         "kv_quant": args.kv_quant,
+        "serve_paged": paged,
+        **({"paged_fallback": paged_fallback} if paged_fallback else {}),
+        **({"page_size": page_stats["page_size"],
+            "pages": page_stats["pages"],
+            "pages_in_use_peak": page_stats["pages_in_use_peak"],
+            "prefix_hits": page_stats["prefix_hits"],
+            "prefill_chunk": args.prefill_chunk} if paged else {}),
         "slots": args.slots, "decode_window": args.decode_window,
+        "shared_prefix": args.shared_prefix,
         "rps_offered": args.rps,
         "duration_s": round(wall, 1),
         "requests_offered": offered,

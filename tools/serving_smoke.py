@@ -1,0 +1,104 @@
+"""Time-capped serving smoke for CI: paged engine vs slot engine on the
+tiny model, exact greedy-token parity plus a page-pressure capacity
+check.
+
+The deep parity matrix (flash kernel, int8 KV, tensor-parallel mesh)
+lives in ``tests/test_serving_paged.py``; this is the always-on slice
+test.sh runs next to the chaos smoke. It serves one mixed-length
+workload through BOTH engines and fails the build on the first token
+mismatch or page-ledger violation. Checks run in a fixed order and stop
+(skip, not fail) when the time budget runs out — a slow CI host skips
+tail checks rather than timing out the build.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget-s", type=float, default=120.0,
+                    help="wall-clock cap; tail checks are skipped, not "
+                         "failed, when it runs out (default 120)")
+    args = ap.parse_args(argv)
+    deadline = time.monotonic() + args.budget_s
+
+    import jax
+
+    from dcos_commons_tpu.models import llama, serving
+
+    cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                 attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = jax.random.key(7)
+    reqs = []
+    for i, (n, m) in enumerate([(8, 6), (5, 9), (12, 4), (20, 7),
+                                (16, 5)]):
+        rng, sub = jax.random.split(rng)
+        prompt = [int(t) for t in jax.random.randint(
+            sub, (n,), 0, cfg.vocab_size)]
+        reqs.append({"prompt": prompt, "max_new": m, "request_id": i})
+
+    ran = 0
+
+    def _spent(name: str) -> bool:
+        if time.monotonic() >= deadline:
+            print(f"serving-smoke: time budget exhausted after {ran} "
+                  f"checks; {name!r} and later checks skipped")
+            return True
+        return False
+
+    # 1. the anchor: slot engine on the full workload
+    if _spent("slot-engine"):
+        return 0
+    slot = serving.SlotServer(cfg, params, slots=2).drain(
+        [dict(r) for r in reqs])
+    ran += 1
+
+    # 2. paged engine, ample pool: every stream must match token-exact
+    if _spent("paged-parity"):
+        return 0
+    engine = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                 prefill_chunk=8)
+    paged = engine.drain([dict(r) for r in reqs])
+    if paged != slot:
+        print(f"serving-smoke FAILED: paged streams != slot streams\n"
+              f"  paged: {paged}\n  slot:  {slot}", file=sys.stderr)
+        return 1
+    problems = engine.ledger_violations()
+    if problems:
+        print(f"serving-smoke FAILED: page ledger violations {problems}",
+              file=sys.stderr)
+        return 1
+    ran += 1
+
+    # 3. page pressure: a pool below slot-equivalent still drains the
+    # whole workload (admission blocks on pages, backlog re-offers) and
+    # ends with every page back
+    if _spent("page-pressure"):
+        return 0
+    tight = serving.PagedServer(cfg, params, slots=4, pages=6,
+                                page_size=16, prefill_chunk=8,
+                                prefix_cache=False)
+    pressured = tight.drain([dict(r) for r in reqs])
+    if pressured != slot:
+        print(f"serving-smoke FAILED: page-pressure streams diverged\n"
+              f"  paged: {pressured}\n  slot:  {slot}", file=sys.stderr)
+        return 1
+    if tight.pages_free() != tight.total_pages:
+        print(f"serving-smoke FAILED: {tight.total_pages - tight.pages_free()} "
+              "pages still held after drain", file=sys.stderr)
+        return 1
+    ran += 1
+
+    print(f"serving-smoke: {ran} checks passed — paged == slot "
+          f"token-exact, ledger clean "
+          f"(peak {engine.page_stats()['pages_in_use_peak']} pages)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
