@@ -259,6 +259,24 @@ def main() -> None:
     except Exception as e:  # llama is supplementary; never lose the line
         result["llama_error"] = str(e)[:200]
 
+    try:
+        # control-plane line (ROADMAP item 5): scheduler deploy
+        # throughput over an instant-accept fake cluster — plain pods
+        # and a gang-placed TPU slice — so every round's receipt
+        # carries the scheduler's own numbers next to the model's
+        from tools.bench_scheduler import run_inprocess
+        plain = run_inprocess(pods=200)
+        gang = run_inprocess(pods=64, tpu=True)
+        result["control_plane"] = {
+            "deploy_pods_per_sec": plain["pods_per_sec"],
+            "deploy_pods": plain["pods"],
+            "deploy_cycles": plain["cycles"],
+            "gang_deploy_pods_per_sec": gang["pods_per_sec"],
+            "gang_deploy_pods": gang["pods"],
+        }
+    except Exception as e:  # supplementary; never lose the line
+        result["control_plane_error"] = str(e)[:200]
+
     print(json.dumps(result))
 
 

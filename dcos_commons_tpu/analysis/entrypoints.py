@@ -127,6 +127,48 @@ def _trace_decode_step_paged():
     return jax.make_jaxpr(step)(params, pool, table, lengths, tokens)
 
 
+def _trace_prefill_chunk_paged():
+    from ..models import llama
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    page_size, chunk = 16, 16
+    per_stream = cfg.max_seq // page_size
+    pages = 4 * per_stream
+    params = _abstract_params(
+        lambda: llama.init_params(cfg, jax.random.key(0)))
+    pool = _abstract_params(
+        lambda: llama.init_page_pool(cfg, pages + 1, page_size))
+    table = jax.ShapeDtypeStruct((per_stream,), jnp.int32)
+    tokens = jax.ShapeDtypeStruct((1, chunk), jnp.int32)
+    scalar = jax.ShapeDtypeStruct((), jnp.int32)
+
+    def step(p, pl, tbl, toks, st, tl, li):
+        return llama.prefill_chunk_paged(cfg, p, pl, tbl, toks, st, tl,
+                                         li, pages)
+
+    return jax.make_jaxpr(step)(params, pool, table, tokens, scalar,
+                                scalar, scalar)
+
+
+def _trace_adopt_pages():
+    from ..models import llama
+    from ..models.serving import _install_pages
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    page_size, span_pages = 16, 3
+    pages = 4 * (cfg.max_seq // page_size)
+    pool = _abstract_params(
+        lambda: llama.init_page_pool(cfg, pages + 1, page_size))
+    side = pool["k"]
+    payload = jax.ShapeDtypeStruct(
+        (side.shape[0], span_pages) + side.shape[2:], side.dtype)
+    phys = jax.ShapeDtypeStruct((span_pages,), jnp.int32)
+
+    def install(c, kp, vp, ph):
+        return {"k": _install_pages(c["k"], kp, ph),
+                "v": _install_pages(c["v"], vp, ph)}
+
+    return jax.make_jaxpr(install)(pool, payload, payload, phys)
+
+
 def _trace_ring_attention():
     from ..parallel.mesh import MeshSpec
     from ..parallel.ring_attention import make_ring_attention
@@ -171,6 +213,22 @@ register_hot_path(HotPath(
                 "collective-free off-mesh, same budget as the slot "
                 "path — the gather view is never an fp32 "
                 "materialization bigger than the slot cache read)"))
+register_hot_path(HotPath(
+    "llama_prefill_chunk_paged", _trace_prefill_chunk_paged,
+    budget_bytes=1 << 20,
+    description="prefill_chunk_paged, the prefill-only disagg tier "
+                "kernel: chunked prompt ingest writing straight into "
+                "pool pages (must stay collective-free off-mesh — a "
+                "prefill pod owns no mesh, so any collective here is a "
+                "deploy-time crash)"))
+register_hot_path(HotPath(
+    "llama_adopt_pages_install", _trace_adopt_pages,
+    budget_bytes=1 << 20,
+    description="the adopt_pages install scatter: shipped K/V page "
+                "payloads written into reserved pool pages on the "
+                "decode tier (donated pool, no gather/collective — the "
+                "whole point of page-granular shipping is that adoption "
+                "is a pure scatter)"))
 register_hot_path(HotPath(
     "ring_attention_fwd", _trace_ring_attention,
     budget_bytes=1 << 20, devices_needed=2,

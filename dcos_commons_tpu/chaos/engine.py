@@ -64,11 +64,20 @@ class FaultConfig:
     # serving stream vanishes without releasing its KV pages — the
     # engine's crash sweep (PagePool.reconcile) must reclaim them
     page_leak: float = 0.0
+    # disaggregated-shipping faults (soak harness kv-ship sim over the
+    # same ledger, models/disagg.py seam): a shipped span arrives
+    # corrupt and its adoption ABORTS after reserving decode-tier
+    # pages (kv_ship_lost — the unwind must leak nothing), or the
+    # transfer lands 1..max_delay ticks late (kv_ship_slow — the
+    # ledger must stay clean with transfers pending)
+    kv_ship_lost: float = 0.0
+    kv_ship_slow: float = 0.0
     max_delay_ticks: int = 3
 
     FIELDS = ("status_drop", "status_delay", "status_dup", "status_reorder",
               "launch_fail", "launch_slow", "agent_flap", "agent_loss",
-              "degrade", "task_crash", "crash_restart", "page_leak")
+              "degrade", "task_crash", "crash_restart", "page_leak",
+              "kv_ship_lost", "kv_ship_slow")
 
     @classmethod
     def none(cls) -> "FaultConfig":
@@ -95,7 +104,8 @@ class FaultConfig:
         """Transport-only view, for the settle phase: held statuses still
         drain through the chaos queue but no new weather is scheduled."""
         return replace(self, agent_flap=0.0, agent_loss=0.0, degrade=0.0,
-                       task_crash=0.0, crash_restart=0.0, page_leak=0.0)
+                       task_crash=0.0, crash_restart=0.0, page_leak=0.0,
+                       kv_ship_lost=0.0, kv_ship_slow=0.0)
 
 
 def parse_faults(arg: str) -> FaultConfig:

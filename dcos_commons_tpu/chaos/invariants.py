@@ -26,6 +26,11 @@ to a real reference-era incident class:
    after any admit/retire/abort/reset — including the ``page_leak``
    fault, where a stream dies without releasing its pages and the
    engine's crash sweep (``PagePool.reconcile``) must reclaim them.
+6. **kv-ship unwind** — an aborted shipped-span adoption (corrupt or
+   orphaned in-flight transfer, the ``kv_ship_lost`` fault) must return
+   every decode-tier page reference it reserved: a page from an aborted
+   transfer may only stay referenced by its surviving legitimate owners,
+   never by the dead transfer itself.
 """
 
 from __future__ import annotations
@@ -63,6 +68,7 @@ class InvariantChecker:
         out += self._check_gang_ranks(tick)
         out += self._check_backoff_monotone(tick)
         out += self._check_page_ledger(tick)
+        out += self._check_kv_ship(tick)
         return out
 
     def _check_unique_live_tasks(self, tick: int) -> List[Violation]:
@@ -151,6 +157,29 @@ class InvariantChecker:
             pool = sim.ledger if hasattr(sim, "ledger") else sim.pool
             for problem in pool.check(sim.expected_refs()):
                 out.append(Violation("page-ledger", problem, tick))
+        return out
+
+    def _check_kv_ship(self, tick: int) -> List[Violation]:
+        """Audit aborted shipped-span adoptions (``models/disagg.py``
+        seam): every page a dead transfer touched must hold exactly the
+        references its surviving owners (streams + radix) account for —
+        a higher refcount means the abort path leaked a reservation."""
+        out = []
+        for sim in getattr(self._runner, "page_sims", ()):
+            aborted = getattr(sim, "ship_aborted", None)
+            if not aborted:
+                continue
+            pool = sim.ledger if hasattr(sim, "ledger") else sim.pool
+            expected = sim.expected_refs()
+            for pages in aborted:
+                for p in sorted(set(pages)):
+                    have, want = pool.refcount(p), expected.get(p, 0)
+                    if have > want:
+                        out.append(Violation(
+                            "kv-ship",
+                            f"page {p} from aborted transfer holds "
+                            f"{have} refs, surviving owners account for "
+                            f"{want} (adoption unwind leaked)", tick))
         return out
 
     def _check_backoff_monotone(self, tick: int) -> List[Violation]:

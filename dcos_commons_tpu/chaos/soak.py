@@ -101,6 +101,19 @@ class _PageServingSim:
                        for _ in range(2)]
         self.leaks_injected = 0
         self.leaks_reclaimed = 0
+        # disaggregated shipping traffic (models/disagg.py seam) rides
+        # the SAME ledger on its OWN derived rng: arming kv_ship_* can
+        # never perturb the main sim's draw order, so pinned corpus
+        # seeds keep replaying their original storms
+        self.ship_rng = random.Random((seed << 20) ^ 0x2545F4914F6CDD1D)
+        # tid -> (due_tick, prompt): transfers in flight to this tier
+        self.ship_inflight: Dict[int, tuple] = {}
+        self._next_tid = 0
+        # page lists of ABORTED adoptions (corrupt arrivals whose
+        # reservations were unwound) — the kv-ship invariant audits
+        # that none of these pages stayed refcounted past its owners
+        self.ship_aborted: List[List[int]] = []
+        self.ship_adopted = 0
 
     def expected_refs(self) -> Dict[int, int]:
         out: Dict[int, int] = {}
@@ -157,6 +170,72 @@ class _PageServingSim:
             self.leaks_reclaimed += len(reclaimed)
             log(f"tick {tick}: page_leak stream {victim} "
                 f"(sweep reclaimed pages {reclaimed})")
+
+    def ship_tick(self, tick: int, lost_p: float, slow_p: float,
+                  count, log) -> None:
+        """Disaggregated-shipping traffic over the same ledger: the
+        decode-tier half of ``models/disagg.py``. Prompts arrive as
+        shipped spans (possibly LATE — ``kv_ship_slow``) and adopt on
+        pages free exactly like ``PagedServer.adopt_pages``: radix
+        lookup refs shared pages, the remainder allocates, and a
+        CORRUPT arrival (``kv_ship_lost``) aborts AFTER the
+        reservation — the unwind must return every reference, which
+        the kv-ship invariant audits against ``ship_aborted``.
+        No-draw when disarmed, so legacy corpus seeds replay bitwise;
+        the settle phase still drains transfers already in flight."""
+        armed = bool(lost_p or slow_p)
+        if not armed and not self.ship_inflight:
+            return
+        rng, ps = self.ship_rng, self.pool.page_size
+        # launch a transfer: the coordinator routed a prompt to the
+        # prefill tier; it lands this tick or (kv_ship_slow) later
+        if armed and rng.random() < 0.6:
+            base = rng.choice(self._bases)
+            prompt = (base[:rng.randint(1, len(base))]
+                      + [rng.randint(0, 96)
+                         for _ in range(rng.randint(1, ps))])
+            delay = 0
+            if slow_p and rng.random() < slow_p:
+                delay = rng.randint(1, 3)
+                count("kv_ship_slow")
+                log(f"tick {tick}: kv_ship_slow transfer "
+                    f"{self._next_tid} delayed {delay} ticks")
+            self.ship_inflight[self._next_tid] = (tick + delay, prompt)
+            self._next_tid += 1
+        # arrivals adopt on pages free; corrupt arrivals abort
+        for tid in sorted(self.ship_inflight):
+            due, prompt = self.ship_inflight[tid]
+            if due > tick:
+                continue
+            del self.ship_inflight[tid]
+            corrupt = bool(lost_p) and rng.random() < lost_p
+            shared, _ = self.radix.lookup(prompt)
+            own_needed = -(-len(prompt) // ps) - len(shared)
+            pages = self.pool.alloc(own_needed)
+            if pages is None:
+                self.radix.evict(own_needed - self.pool.free_count())
+                pages = self.pool.alloc(own_needed)
+            if pages is None:                 # pages-free gate: shed
+                for p in shared:
+                    self.pool.unref(p)
+                continue
+            if corrupt:
+                # payload verification failed after the reservation:
+                # adopt_pages's abort path — unwind everything
+                for p in shared + pages:
+                    self.pool.unref(p)
+                self.ship_aborted.append(list(shared + pages))
+                count("kv_ship_lost")
+                log(f"tick {tick}: kv_ship_lost transfer {tid} aborted "
+                    f"(unwound pages {sorted(set(shared + pages))})")
+                continue
+            if len(self.streams) < self.max_streams:
+                self.streams[self._next_sid] = (prompt, shared + pages)
+                self._next_sid += 1
+                self.ship_adopted += 1
+            else:                             # no slot: drop the span
+                for p in shared + pages:
+                    self.pool.unref(p)
 
 
 @dataclass
@@ -344,6 +423,9 @@ class _Soak:
             self._inject(tick)
             self.page_sim.tick(tick, self.config.page_leak,
                                self._count, self._log)
+            self.page_sim.ship_tick(tick, self.config.kv_ship_lost,
+                                    self.config.kv_ship_slow,
+                                    self._count, self._log)
             # release the transport's due events first so zombies from
             # late launches are visible to this tick's reconciliation
             self.chaos.tick()
@@ -359,6 +441,7 @@ class _Soak:
         for i in range(SETTLE_BUDGET):
             tick = self.ticks + i
             self.page_sim.tick(tick, 0.0, self._count, self._log)
+            self.page_sim.ship_tick(tick, 0.0, 0.0, self._count, self._log)
             self.chaos.tick()
             self._cycle()
             self._check(tick)

@@ -1,0 +1,553 @@
+"""Disaggregated prefill/decode serving: paged-KV shipping between
+scheduler-placed tiers.
+
+The split receipts (``bench_r5/flag8b_long_split.jsonl``) put prefill
+and decode in different regimes — 8B prefill at 7,749 tok/s against
+decode at 52.7 tok/s — so co-locating both phases on one gang wastes
+whichever resource the traffic mixture doesn't bind. This module is the
+DistServe/Splitwise-style split over the PR-6 block-paged engine: a
+finished prefill is a list of fixed-size pages, so it ships to a decode
+tier and attaches to its pool without reshaping.
+
+Three pieces, one wire format:
+
+* :class:`KVShipper` — serializes a finished prefill span (prompt
+  tokens, first generated token, int8/bf16 K/V pages, per-page
+  prefix-hash metadata) into a framed byte blob and moves it over
+  ``security/transport.py`` (TLS when ``TPU_TLS_CA``/co. are set and
+  the optional ``cryptography`` package is present; cleartext
+  otherwise, matching every other control-plane hop).
+* :class:`PrefillWorker` — the prefill tier's front door: an HTTP
+  server wrapping one :class:`~dcos_commons_tpu.models.serving.PagedServer`
+  in prefill-only mode (``prefill_span`` — chunked prefill flat-out,
+  no decode interleave). ``POST /v1/prefill`` takes a prompt and
+  returns the packed span; pool exhaustion is a 503 (spans release
+  right after packing, so it is transient back-pressure, not failure).
+* :class:`DisaggCoordinator` — rank-0 ingress driver for the decode
+  tier, structured exactly like the gang broadcast loop
+  (``serving_gang.GangServingDriver.run_iteration``) over the same
+  external-driver seams (``mark_driven`` / ``drain_intake`` /
+  ``attach`` / ``sync`` / ``fail_inflight``): drains new prompts from
+  the front door, routes them to the prefill tier (a small sender
+  pool; the coordinator thread stays the ONLY thread that touches the
+  donation-based engine), tracks in-flight transfers, and admits
+  arrived spans into the decode tier's ``PagedServer`` on **pages
+  free** via ``adopt_pages()``. A dead or absent peer degrades, never
+  crashes: the request falls back to the co-located paged path
+  (normal ``submit``) and the receipt says so (``peer_fallbacks``).
+
+The prefix-hash metadata rides so the decode tier's ``PrefixRadix``
+can dedupe shipped system prompts (adoption shares cached full pages
+by reference and skips their payload writes) and so a corrupted or
+truncated transfer aborts BEFORE touching the ledger — and when a
+failure does land after pages are reserved, ``adopt_pages`` unwinds
+every reservation (``PagePool.check()``/``reconcile()`` hold across
+aborted transfers; the chaos tier pins seeds on exactly this seam).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import queue
+import struct
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_MAGIC = b"KVSPAN1\0"
+_WIRE_VERSION = 1
+
+
+class PageShipError(RuntimeError):
+    """A KV shipment that must not be adopted: framing, digest, or
+    prefix-hash verification failed."""
+
+
+def page_hashes(prompt: List[int], page_size: int) -> List[str]:
+    """Content hash per FULL prompt page — the prefix-hash metadata a
+    span carries. The decode tier recomputes these from the shipped
+    prompt; a mismatch means the prompt and pages disagree (corrupt or
+    mis-framed transfer) and the span is rejected before adoption."""
+    out = []
+    for j in range(len(prompt) // page_size):
+        page = np.asarray(prompt[j * page_size:(j + 1) * page_size],
+                          np.int32)
+        out.append(hashlib.blake2s(page.tobytes()).hexdigest()[:16])
+    return out
+
+
+def _flatten_payload(payload: Dict[str, Any]) -> List[Tuple[str, Any]]:
+    """Span payload as a flat (key, ndarray) list in a FIXED order —
+    the wire layout. int8 pools carry q + scales per side."""
+    out: List[Tuple[str, Any]] = []
+    for side in ("k", "v"):
+        val = payload[side]
+        if isinstance(val, dict):
+            out.append((f"{side}.q", np.asarray(val["q"])))
+            out.append((f"{side}.s", np.asarray(val["s"])))
+        else:
+            out.append((side, np.asarray(val)))
+    return out
+
+
+def _wire_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name from the wire; bfloat16 and friends live in
+    ml_dtypes (a jax dependency, always present here)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_span(span: Dict[str, Any]) -> bytes:
+    """Frame a ``PagedServer.prefill_span()`` result for the wire:
+    ``MAGIC | header_len | header JSON | raw array bytes``. The header
+    names every array (shape + dtype + offset), carries the prompt,
+    first token, page size, kv dtype flag, the per-page prefix hashes,
+    and a digest of the body — everything :func:`unpack_span` needs to
+    verify before the decode tier goes anywhere near its ledger."""
+    arrays = _flatten_payload(span["payload"])
+    body = b"".join(a.tobytes() for _, a in arrays)
+    meta = {
+        "version": _WIRE_VERSION,
+        "prompt": [int(t) for t in span["prompt"]],
+        "first_token": int(span["first_token"]),
+        "page_size": int(span["page_size"]),
+        "kv_quant": bool(span["kv_quant"]),
+        "page_hashes": page_hashes(span["prompt"], span["page_size"]),
+        "body_digest": hashlib.blake2s(body).hexdigest(),
+        "arrays": [{"key": k, "shape": list(a.shape),
+                    "dtype": a.dtype.name} for k, a in arrays],
+    }
+    header = json.dumps(meta).encode()
+    return _MAGIC + struct.pack("<I", len(header)) + header + body
+
+
+def unpack_span(data: bytes) -> Dict[str, Any]:
+    """Parse + VERIFY a framed span: magic, version, body digest, and
+    the prefix hashes against the shipped prompt. Raises
+    :class:`PageShipError` on any mismatch — a lost or mangled
+    transfer dies here, holding zero decode-tier pages."""
+    if not data.startswith(_MAGIC):
+        raise PageShipError("bad magic: not a KV span frame")
+    off = len(_MAGIC)
+    if len(data) < off + 4:
+        raise PageShipError("truncated frame: no header length")
+    (hlen,) = struct.unpack_from("<I", data, off)
+    off += 4
+    try:
+        meta = json.loads(data[off:off + hlen])
+    except ValueError as e:
+        raise PageShipError(f"bad header: {e}") from None
+    off += hlen
+    if meta.get("version") != _WIRE_VERSION:
+        raise PageShipError(f"wire version {meta.get('version')} != "
+                            f"{_WIRE_VERSION}")
+    body = data[off:]
+    if hashlib.blake2s(body).hexdigest() != meta["body_digest"]:
+        raise PageShipError("body digest mismatch: corrupt transfer")
+    prompt = [int(t) for t in meta["prompt"]]
+    if page_hashes(prompt, meta["page_size"]) != meta["page_hashes"]:
+        raise PageShipError("prefix-hash mismatch: prompt and pages "
+                            "disagree")
+    arrays: Dict[str, np.ndarray] = {}
+    pos = 0
+    for spec in meta["arrays"]:
+        dt = _wire_dtype(spec["dtype"])
+        shape = tuple(spec["shape"])
+        nbytes = dt.itemsize * int(np.prod(shape))
+        if pos + nbytes > len(body):
+            raise PageShipError(f"truncated body at {spec['key']!r}")
+        arrays[spec["key"]] = np.frombuffer(
+            body, dt, count=int(np.prod(shape)),
+            offset=pos).reshape(shape)
+        pos += nbytes
+    payload: Dict[str, Any] = {}
+    for side in ("k", "v"):
+        if side in arrays:
+            payload[side] = arrays[side]
+        elif f"{side}.q" in arrays and f"{side}.s" in arrays:
+            payload[side] = {"q": arrays[f"{side}.q"],
+                             "s": arrays[f"{side}.s"]}
+        else:
+            raise PageShipError(f"frame missing the {side!r} pages")
+    return {"version": meta["version"], "prompt": prompt,
+            "first_token": meta["first_token"],
+            "page_size": meta["page_size"],
+            "kv_quant": meta["kv_quant"],
+            "page_hashes": meta["page_hashes"], "payload": payload}
+
+
+def _transport_urlopen(req, timeout: float):
+    """Every shipped byte moves through ``security/transport.py`` when
+    it is importable (the env contract then upgrades https:// hops to
+    verified TLS); without the optional ``cryptography`` package,
+    cleartext http:// falls back to plain urllib and https:// is a
+    hard error — silently unverified TLS would defeat the point."""
+    try:
+        from dcos_commons_tpu.security.transport import urlopen
+    except ImportError:
+        url = req.full_url if hasattr(req, "full_url") else str(req)
+        if str(url).startswith("https://"):
+            raise PageShipError(
+                "https:// KV shipping needs security/transport.py "
+                "(optional cryptography package not installed)")
+        return urllib.request.urlopen(req, timeout=timeout)
+    return urlopen(req, timeout=timeout)
+
+
+class KVShipper:
+    """Moves packed prefill spans between tiers and keeps the receipt
+    counters (``bytes_shipped`` is the on-wire frame size — the number
+    the A/B bench reports as KV bytes shipped)."""
+
+    def __init__(self, timeout_s: float = 600.0):
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self.spans_shipped = 0
+        self.bytes_shipped = 0
+
+    pack = staticmethod(pack_span)
+    unpack = staticmethod(unpack_span)
+
+    def fetch(self, peer: str, prompt: List[int]) -> Dict[str, Any]:
+        """Ship ``prompt`` to the prefill tier at ``peer`` and return
+        the verified span its pages came back as. Raises
+        :class:`PageShipError` on transport failure, a peer 503
+        (pool back-pressure), or a frame that fails verification."""
+        req = urllib.request.Request(
+            peer.rstrip("/") + "/v1/prefill",
+            data=json.dumps({"prompt": [int(t) for t in prompt]}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with _transport_urlopen(req, timeout=self.timeout_s) as r:
+                data = r.read()
+        except PageShipError:
+            raise
+        except Exception as e:
+            raise PageShipError(f"peer {peer}: {e}") from None
+        span = unpack_span(data)
+        with self._lock:
+            self.spans_shipped += 1
+            self.bytes_shipped += len(data)
+        return span
+
+
+class PrefillWorker:
+    """The prefill tier's front door: one prefill-only
+    :class:`~dcos_commons_tpu.models.serving.PagedServer` behind HTTP.
+
+    ``POST /v1/prefill`` body ``{"prompt": [...]}`` runs chunked
+    prefill flat-out (no decode interleave — the engine never
+    dispatches a decode step) and answers with the packed span.
+    Exactly ONE request runs the engine at a time (the donation
+    contract); concurrent posts queue on the lock, which is the right
+    back-pressure for a tier whose whole job is sequential prefill
+    throughput. A full pool is a 503, transient by construction:
+    spans release every working page right after packing."""
+
+    def __init__(self, engine, port: int = 0, host: str = "0.0.0.0"):
+        self.engine = engine
+        self._lock = threading.Lock()
+        worker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/v1/healthz":
+                    st = worker.engine.page_stats()
+                    self._json(200, {"ok": True, "role": "prefill",
+                                     "pages_free": st["pages_free"],
+                                     "shipped_spans": st["shipped_spans"]})
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/v1/prefill":
+                    self._json(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n))
+                    prompt = [int(t) for t in body["prompt"]]
+                except Exception as e:
+                    self._json(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    with worker._lock:
+                        span = worker.engine.prefill_span(prompt)
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                except Exception as e:
+                    self._json(500, {"error": f"prefill failed: {e}"})
+                    return
+                if span is None:
+                    self._json(503, {"error": "page pool exhausted"})
+                    return
+                frame = pack_span(span)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(frame)))
+                self.end_headers()
+                self.wfile.write(frame)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PrefillWorker":
+        try:
+            # same opt-in TLS contract as the ingress: wraps when the
+            # env asks for it AND the optional dependency is present
+            from dcos_commons_tpu.security.transport import (
+                server_tls_from_env)
+            creds = server_tls_from_env()
+            if creds is not None:
+                from dcos_commons_tpu.security.transport import wrap_server
+                wrap_server(self._httpd, creds)
+        except ImportError:
+            pass
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="prefill-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+
+class DisaggCoordinator:
+    """Rank-0 ingress driver for the decode tier of a disaggregated
+    pair — the same loop shape as
+    ``serving_gang.GangServingDriver.run_iteration`` over the same
+    front-door seams, with the prefill dispatch replaced by a shipping
+    path:
+
+    1. stamp liveness (``mark_driven``) and resolve failed transfers
+       (peer down → co-located fallback submit, loudly counted);
+    2. admit ARRIVED spans head-of-FIFO into the decode engine on
+       pages free (``adopt_pages``); a span that does not fit stalls
+       the arrival queue (counted — this is the transfer-stall metric)
+       rather than leapfrogging, mirroring paged ``submit_many``;
+    3. re-offer the co-located fallback backlog, then drain NEW
+       prompts from the front door (bounded by the in-flight transfer
+       cap) into the sender pool;
+    4. one decode window + fan-out (``step_many`` + ``sync``).
+
+    The coordinator thread is the only thread that touches the
+    donation-based engine; sender threads do HTTP + numpy framing
+    only. ``run()`` wraps iterations in the gang driver's crash
+    discipline: on an engine error every in-flight request fails fast
+    and the engine resets."""
+
+    def __init__(self, engine, frontend, peer: Optional[str],
+                 shipper: Optional[KVShipper] = None,
+                 max_intake: int = 4, decode_window: int = 8,
+                 max_inflight: int = 8, transfer_workers: int = 2,
+                 idle_sleep_s: float = 0.005,
+                 colocated_fallback: bool = True):
+        self.engine = engine
+        self.frontend = frontend
+        self.peer = peer or None
+        self.shipper = shipper if shipper is not None else KVShipper()
+        self.max_intake = max(1, max_intake)
+        self.decode_window = max(1, decode_window)
+        self.max_inflight = max(1, max_inflight)
+        self.idle_sleep_s = idle_sleep_s
+        self.colocated_fallback = colocated_fallback
+        self._send_q: "queue.Queue" = queue.Queue()
+        self._arrivals: "queue.Queue" = queue.Queue()
+        self._failed: "queue.Queue" = queue.Queue()
+        self._arrival_backlog: List[Tuple[Dict[str, Any], Any]] = []
+        self._local_backlog: List[Any] = []
+        self._outstanding = 0              # transfers in flight
+        self._count_lock = threading.Lock()
+        self._stop = threading.Event()
+        self.transfer_stalls = 0
+        self.peer_fallbacks = 0
+        self.iterations = 0
+        self._senders = [
+            threading.Thread(target=self._sender_loop, daemon=True,
+                             name=f"kv-sender-{i}")
+            for i in range(max(1, transfer_workers))]
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------ sender pool
+
+    def _sender_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                pending = self._send_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                span = self.shipper.fetch(self.peer, pending.prompt)
+                self._arrivals.put((span, pending))
+            except Exception as e:
+                self._failed.put((pending, str(e)))
+
+    def _dec_outstanding(self) -> None:
+        with self._count_lock:
+            self._outstanding -= 1
+
+    # ------------------------------------------------------- drive loop
+
+    def _admit_colocated(self, pending) -> None:
+        """Degrade-not-crash: the peer is absent or failing, so this
+        request runs BOTH phases on the decode tier's engine (the
+        normal chunked-prefill path). Capacity misses re-offer from
+        the local backlog next iteration — never dropped."""
+        if pending.t_submit is None:
+            pending.t_submit = time.perf_counter()
+        slot = self.engine.submit(pending.prompt, pending.max_new,
+                                  request_id=pending)
+        if slot is None:
+            self._local_backlog.append(pending)
+        else:
+            self.frontend.attach(slot, pending)
+
+    def run_iteration(self) -> bool:
+        fe = self.frontend
+        fe.mark_driven()
+        worked = False
+        # 1. failed transfers: degrade to the co-located paged path
+        while True:
+            try:
+                pending, err = self._failed.get_nowait()
+            except queue.Empty:
+                break
+            self._dec_outstanding()
+            worked = True
+            if self.colocated_fallback:
+                self.peer_fallbacks += 1
+                self._admit_colocated(pending)
+            else:
+                pending.finish(f"prefill peer failed: {err}")
+        # 2. arrived spans admit on pages free, FIFO — a blocked head
+        # stalls the queue (transfer_stalls) instead of being leapt
+        while True:
+            try:
+                self._arrival_backlog.append(self._arrivals.get_nowait())
+            except queue.Empty:
+                break
+        while self._arrival_backlog:
+            span, pending = self._arrival_backlog[0]
+            try:
+                slot = self.engine.adopt_pages(
+                    span, max_new=pending.max_new, request_id=pending)
+            except (ValueError, PageShipError) as e:
+                self._arrival_backlog.pop(0)
+                self._dec_outstanding()
+                pending.finish(f"span rejected: {e}")
+                worked = True
+                continue
+            if slot is None:
+                self.transfer_stalls += 1
+                break
+            self._arrival_backlog.pop(0)
+            self._dec_outstanding()
+            pending.t_submit = time.perf_counter()
+            fe.attach(slot, pending)
+            worked = True
+        # 3. co-located fallback backlog, then new intake
+        backlog, self._local_backlog = self._local_backlog, []
+        for pending in backlog:
+            if pending.done.is_set():
+                continue
+            self._admit_colocated(pending)
+        with self._count_lock:
+            room = self.max_inflight - self._outstanding
+        budget = min(self.max_intake, max(0, room))
+        for pending in fe.drain_intake(budget):
+            worked = True
+            if self.peer is None:
+                self.peer_fallbacks += 1
+                self._admit_colocated(pending)
+                continue
+            with self._count_lock:
+                self._outstanding += 1
+            self._send_q.put(pending)
+        # 4. one decode window + fan-out
+        if self.engine.requests_active():
+            self.engine.step_many(self.decode_window)
+            fe.sync()
+            worked = True
+        self.iterations += 1
+        return worked
+
+    def run(self, max_iterations: Optional[int] = None) -> None:
+        """Drive until stopped (or ``max_iterations``), with the gang
+        driver's crash discipline: an engine error fails every
+        in-flight request fast and resets the engine — a serving
+        replica must come back serving."""
+        it = 0
+        while not self._stop.is_set():
+            if max_iterations is not None and it >= max_iterations:
+                break
+            it += 1
+            try:
+                worked = self.run_iteration()
+            except Exception as e:
+                self.frontend.fail_inflight(f"engine error: {e}")
+                for _, pending in self._arrival_backlog:
+                    self._dec_outstanding()
+                    pending.finish(f"engine error: {e}")
+                self._arrival_backlog = []
+                for pending in self._local_backlog:
+                    pending.finish(f"engine error: {e}")
+                self._local_backlog = []
+                self.engine.reset()
+                continue
+            if not worked:
+                time.sleep(self.idle_sleep_s)
+
+    def start(self) -> "DisaggCoordinator":
+        for th in self._senders:
+            th.start()
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name="disagg-coordinator")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        for th in self._senders:
+            th.join(timeout=2)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._count_lock:
+            outstanding = self._outstanding
+        return {
+            "peer": self.peer,
+            "spans_shipped": self.shipper.spans_shipped,
+            "kv_bytes_shipped": self.shipper.bytes_shipped,
+            "transfer_stalls": self.transfer_stalls,
+            "peer_fallbacks": self.peer_fallbacks,
+            "transfers_inflight": outstanding,
+            "iterations": self.iterations,
+        }

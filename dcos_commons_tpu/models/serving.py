@@ -531,6 +531,27 @@ def _copy_page(cache, src, dst):
     return cache.at[:, dst].set(cache[:, src])
 
 
+def _install_pages(cache, payload, phys):
+    """Write a shipped span's K/V pages ``payload``
+    ``[L, N, page, KV, D]`` into pool pages ``phys`` ``[N]`` — the
+    adoption half of the disaggregated prefill/decode shipping path
+    (``models/disagg.py``). Payload and scales both land for int8
+    pools; the write is a page-granular scatter, no reshaping."""
+    if isinstance(cache, QTensor):
+        return QTensor(cache.q.at[:, phys].set(payload.q),
+                       cache.s.at[:, phys].set(payload.s))
+    return cache.at[:, phys].set(payload)
+
+
+def _payload_slice(side, a: int, b: int):
+    """Span payload pages ``[a:b)`` as the device-ready value
+    :func:`_install_pages` writes (QTensor for int8 pools)."""
+    if isinstance(side, dict):
+        return QTensor(jnp.asarray(side["q"][:, a:b]),
+                       jnp.asarray(side["s"][:, a:b]))
+    return jnp.asarray(side[:, a:b])
+
+
 class PagedServer:
     """Block-paged, prefix-shared continuous batching — the vLLM-style
     successor to :class:`SlotServer`, same drive surface (``submit`` /
@@ -641,6 +662,14 @@ class PagedServer:
             lambda c, src, dst: {"k": _copy_page(c["k"], src, dst),
                                  "v": _copy_page(c["v"], src, dst)},
             donate_argnums=(0,))
+        # adoption scatter executables, one per installed-page count
+        self._adopt_x: Dict[int, Any] = {}
+        # disaggregation counters (page_stats): spans this engine
+        # prefilled for shipment / adopted from a peer / pages the
+        # radix deduped at adoption (shipped system prompts)
+        self.shipped_spans = 0
+        self.adopted_spans = 0
+        self.adopt_shared_pages = 0
 
     # the engine-thread-only helpers are identical to the slot engine's
     _select = SlotServer._select
@@ -786,6 +815,214 @@ class PagedServer:
                 break
             placed.append((slot, self.requests[slot].request_id))
         return placed
+
+    # ----------------------------------------------------- disaggregation
+
+    def prefill_span(self, prompt: List[int]) -> Optional[Dict[str, Any]]:
+        """Prefill-only engine mode: run ``prompt`` through chunked
+        prefill FLAT-OUT — every chunk back to back, no decode
+        interleave, no slot occupied — and return the finished span:
+        the prompt's K/V pages pulled to host plus the first generated
+        token. This is the prefill tier's entire job in disaggregated
+        serving (``models/disagg.py``): the span ships to a decode tier
+        and is installed there by :meth:`adopt_pages`.
+
+        The span's full prompt pages are adopted into THIS engine's
+        radix before its working references drop, so a repeated system
+        prompt skips the prefill compute on the next call (the prefill
+        tier keeps its own prefix cache). Returns None when the pool is
+        exhausted (transient — spans release right after extraction, so
+        the caller retries / sheds), raises ValueError for prompts this
+        engine can never prefill."""
+        prompt = list(prompt)
+        n = len(prompt)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if n + 1 > self.cfg.max_seq:
+            # the decode side must have room for >= 1 generated token
+            raise ValueError(f"prompt {n} leaves no decode room in "
+                             f"max_seq {self.cfg.max_seq}")
+        ps = self.page_size
+        span_pages = -(-n // ps)
+        if span_pages > self.total_pages:
+            raise ValueError(f"prompt {n} needs {span_pages} pages but "
+                             f"the pool holds {self.total_pages}")
+        shared: List[int] = []
+        node = None
+        if self.radix is not None:
+            shared, node = self.radix.lookup(prompt)
+        own_needed = span_pages - len(shared)
+        pages = self.ledger.alloc(own_needed)
+        if pages is None and self.radix is not None:
+            self.radix.evict(own_needed - self.ledger.free_count())
+            pages = self.ledger.alloc(own_needed)
+        if pages is None:
+            for p in shared:
+                self.ledger.unref(p)
+            return None
+        matched = len(shared) * ps
+        start = matched
+        if node is not None:
+            b = self.radix.boundary(node, prompt, matched)
+            if b is not None:
+                src, valid = b
+                self.pool = self._copy_x(self.pool, jnp.int32(src),
+                                         jnp.int32(pages[0]))
+                start = matched + valid
+        stream_pages = shared + pages
+        row = np.full((self.pages_per_stream,), self.scratch, np.int32)
+        row[:span_pages] = stream_pages
+        tbl = jnp.asarray(row)
+        c = self.prefill_chunk
+        while True:
+            end = min(start + c, n)
+            chunk = np.zeros((1, c), np.int32)
+            chunk[0, :end - start] = prompt[start:end]
+            last = end >= n
+            li = (n - 1 - start) if last else 0
+            logits, self.pool = self._chunk_x(
+                self.params, self.pool, tbl, jnp.asarray(chunk),
+                jnp.int32(start), jnp.int32(n), jnp.int32(li))
+            start = end
+            if last:
+                break
+        first = int(self._select(logits)[0])
+        payload = self._gather_span(stream_pages)
+        if self.radix is not None:
+            self.radix.insert(prompt, stream_pages)
+        for p in stream_pages:
+            self.ledger.unref(p)
+        self.shipped_spans += 1
+        return {"version": 1, "prompt": prompt, "first_token": first,
+                "page_size": ps, "kv_quant": bool(self.cfg.kv_quant),
+                "payload": payload}
+
+    def _gather_span(self, pages: List[int]) -> Dict[str, Any]:
+        """Pull the span's pages to host in logical order —
+        ``[L, N, page, KV, D]`` per side (q + scales as a dict for int8
+        pools). One device->host transfer per side; this IS the bytes
+        the shipper puts on the wire."""
+        idx = jnp.asarray(pages, jnp.int32)
+
+        def take(side):
+            if isinstance(side, QTensor):
+                return {"q": np.asarray(side.q[:, idx]),
+                        "s": np.asarray(side.s[:, idx])}
+            return np.asarray(side[:, idx])
+
+        return {"k": take(self.pool["k"]), "v": take(self.pool["v"])}
+
+    def adopt_pages(self, span: Dict[str, Any], max_new: int = 32,
+                    request_id: Any = None) -> Optional[int]:
+        """Install a foreign prefill span (:meth:`prefill_span` on a
+        peer engine, possibly shipped across the wire by
+        ``models/disagg.py``) under the normal refcount/ledger
+        discipline and start the stream decode-active at its first
+        token.
+
+        Admission is gated on **pages free** exactly like
+        :meth:`submit` — returns the stream index, or None when slots
+        or pages are exhausted (the caller re-offers later). The radix
+        dedupes shipped content: full prompt pages already cached
+        (repeated system prompts) are shared by reference and their
+        payload slices are never written. Raises ValueError for spans
+        this engine can never admit (config mismatch, over-capacity) —
+        checked BEFORE any reservation; a failure AFTER pages are
+        reserved unwinds every reservation before re-raising, so
+        ``check()``/``reconcile()`` hold across aborted adoptions."""
+        prompt = list(span["prompt"])
+        n = len(prompt)
+        first = int(span["first_token"])
+        if int(span.get("page_size", self.page_size)) != self.page_size:
+            raise ValueError(
+                f"span page_size {span.get('page_size')} != pool page "
+                f"size {self.page_size}; tiers must agree")
+        if bool(span.get("kv_quant")) != bool(self.cfg.kv_quant):
+            raise ValueError("span/pool kv_quant mismatch: shipped "
+                             "pages are raw pool bytes, tiers must "
+                             "run the same KV dtype")
+        reason = self._validate_item({"prompt": prompt,
+                                      "max_new": max_new})
+        if reason is not None:
+            raise ValueError(reason)
+        ps = self.page_size
+        span_pages = -(-n // ps)
+        payload = span["payload"]
+
+        def _shape(x):
+            return tuple((x["q"] if isinstance(x, dict) else x).shape)
+
+        want = (self.cfg.n_layers, span_pages, ps, self.cfg.n_kv_heads,
+                self.cfg.head_dim)
+        if _shape(payload["k"]) != want or _shape(payload["v"]) != want:
+            raise ValueError(f"span payload shape "
+                             f"{_shape(payload['k'])} != pool page "
+                             f"shape {want}")
+        self._flush_pending()
+        free = self.free_slots()
+        if not free:
+            return None
+        slot = free[0]
+        total = -(-(n + max_new) // ps)
+        shared: List[int] = []
+        if self.radix is not None:
+            shared, _ = self.radix.lookup(prompt)
+        own_needed = total - len(shared)
+        pages = self.ledger.alloc(own_needed)
+        if pages is None and self.radix is not None:
+            self.radix.evict(own_needed - self.ledger.free_count())
+            pages = self.ledger.alloc(own_needed)
+        if pages is None:
+            for p in shared:
+                self.ledger.unref(p)
+            return None
+        matched = len(shared)
+        try:
+            # write the shipped K/V for prompt pages past the radix
+            # match; decode-tail pages (past span_pages) start blank
+            # like any stream's — the decode loop fills them
+            install = span_pages - matched
+            if install > 0:
+                self.pool = self._adopt_exec(install)(
+                    self.pool,
+                    _payload_slice(payload["k"], matched, span_pages),
+                    _payload_slice(payload["v"], matched, span_pages),
+                    jnp.asarray(pages[:install], jnp.int32))
+        except Exception:
+            # aborted transfer: every reservation unwinds, the ledger
+            # reconciles clean (chaos invariant "kv-ship")
+            for p in shared:
+                self.ledger.unref(p)
+            for p in pages:
+                self.ledger.unref(p)
+            raise
+        stream_pages = shared + pages
+        row = self._tables[slot]
+        row[:] = self.scratch
+        row[:total] = stream_pages
+        self._stream_pages[slot] = stream_pages
+        self._prompts[slot] = prompt
+        self._prefill_pos[slot] = n
+        self._decoding[slot] = True
+        self.lengths = self.lengths.at[slot].set(n)
+        self.cur_tok = self.cur_tok.at[slot].set(first)
+        rid = request_id if request_id is not None else object()
+        self.requests[slot] = _Request(rid, n, max_new, [first])
+        self.adopted_spans += 1
+        self.adopt_shared_pages += matched
+        self._maybe_retire(slot)
+        return slot
+
+    def _adopt_exec(self, n: int):
+        x = self._adopt_x.get(n)
+        if x is None:
+            x = jax.jit(
+                lambda c, kp, vp, ph: {
+                    "k": _install_pages(c["k"], kp, ph),
+                    "v": _install_pages(c["v"], vp, ph)},
+                donate_argnums=(0,))
+            self._adopt_x[n] = x
+        return x
 
     # ------------------------------------------------------------- decode
 
@@ -1054,4 +1291,7 @@ class PagedServer:
             "prefix_hits": self.radix.hits if self.radix else 0,
             "prefix_shared_pages": (self.radix.shared_pages
                                     if self.radix else 0),
+            "shipped_spans": self.shipped_spans,
+            "adopted_spans": self.adopted_spans,
+            "adopt_shared_pages": self.adopt_shared_pages,
         }

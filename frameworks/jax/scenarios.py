@@ -52,6 +52,18 @@ DEFAULT_ENV: Mapping[str, str] = {
     "SERVE_PAGES": "0",
     "SERVE_PAGE_SIZE": "64",
     "SERVE_PREFILL_CHUNK": "64",
+    # disaggregated prefill/decode tiers (disagg.yml + models/disagg.py):
+    # SERVE_ROLE picks the tier a replica runs (colocated|prefill|decode)
+    # and SERVE_PEER points a decode replica at its prefill tier's
+    # /v1/prefill endpoint (from `tpuctl endpoints serve`; empty degrades
+    # loudly to co-located serving). DISAGG_PAGES sizes the tiers' page
+    # pools (-1 = auto slot-equivalent) — disagg is paged-only, so the
+    # yml does not inherit the co-located SERVE_PAGES=0 default.
+    "SERVE_ROLE": "colocated",
+    "SERVE_PEER": "",
+    "DISAGG_PAGES": "-1",
+    "PREFILL_COUNT": "1",
+    "DECODE_COUNT": "2",
     # long-context scenario knobs (longctx.yml)
     "SEQ_LEN": "8192",
     "ATTN_IMPL": "ring",

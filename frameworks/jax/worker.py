@@ -336,6 +336,22 @@ def run_llama(args) -> dict:
         # sequence in lock-step.
         slot_engine = args.slots > 0
         multiproc = contract["num_processes"] > 1
+        role = args.serve_role
+        if role != "colocated":
+            # disaggregated tier (dist/disagg.yml): prefill serves
+            # page spans flat-out, decode adopts them behind the
+            # client front door. _serve_disagg never returns while
+            # healthy; False means the config can't run that tier
+            # (emitted as disagg_fallback) and the co-located paths
+            # below keep the replica serving — degrade, not crash.
+            if slot_engine and not multiproc:
+                if _serve_disagg(args, cfg, params, mesh, result):
+                    return result              # unreachable (serve loop)
+            else:
+                _emit({"event": "disagg_fallback", "role": role,
+                       "reason": "disagg tiers need --slots on a "
+                                 "single-process replica; serving "
+                                 "co-located"})
         if slot_engine and multiproc:
             return _serve_gang(args, contract, cfg, params, mesh, result)
         if slot_engine:
@@ -426,6 +442,80 @@ def _make_serving_engine(args, cfg, params, mesh, key=None):
                    "pages": args.pages, "page_size": args.page_size,
                    "prefill_chunk": args.prefill_chunk})
     return SlotServer(cfg, params, slots=args.slots, **kw), None
+
+
+def _serve_disagg(args, cfg, params, mesh, result) -> bool:
+    """Disaggregated serving tiers (``SERVE_ROLE=prefill|decode``,
+    dist/disagg.yml). The prefill tier answers ``/v1/prefill`` with
+    packed page spans, chunked prefill flat-out with no decode
+    interleave; the decode tier runs the client front door with a
+    DisaggCoordinator shipping prompts to ``SERVE_PEER`` and adopting
+    the returned pages on pages free. Never returns while healthy.
+
+    Degrade-not-crash: a config the tier can't run — no page pool,
+    paged engine infeasible, decode tier without a peer — emits
+    ``disagg_fallback`` and returns False so the caller's co-located
+    paths keep the replica serving. A peer that dies LATER degrades
+    per-request inside the coordinator (``peer_fallbacks``)."""
+    from dcos_commons_tpu.models.disagg import (DisaggCoordinator,
+                                                PrefillWorker)
+    from dcos_commons_tpu.models.ingress import ServingFrontend
+    role = args.serve_role
+    if not args.pages:
+        _emit({"event": "disagg_fallback", "role": role,
+               "reason": "disagg tiers are paged-only: set "
+                         "--pages/SERVE_PAGES"})
+        return False
+    engine, page_stats = _make_serving_engine(args, cfg, params, mesh)
+    if page_stats is None:
+        _emit({"event": "disagg_fallback", "role": role,
+               "reason": "paged engine infeasible (see paged_fallback)"})
+        return False
+    port = args.serve_port
+    if port < 0:
+        port = int(os.environ.get("PORT_SERVE", "0"))
+    if role == "prefill":
+        worker = PrefillWorker(engine, port=port).start()
+        with open("serving.ready", "w") as f:
+            f.write(f"ok {worker.port}\n")
+        _emit({"event": "serving", "role": "prefill",
+               "port": worker.port, "paged": page_stats, **result})
+        i = 0
+        while True:
+            time.sleep(args.serve_interval)
+            i += 1
+            try:
+                _emit({"event": "heartbeat", "n": i, "role": "prefill",
+                       **engine.page_stats()})
+            except Exception as e:
+                _emit({"event": "heartbeat_error", "n": i,
+                       "error": str(e)})
+    peer = args.serve_peer.strip()
+    if not peer:
+        _emit({"event": "disagg_fallback", "role": role,
+               "reason": "no --serve-peer/SERVE_PEER: serving "
+                         "co-located"})
+        return False
+    frontend = ServingFrontend(engine, port=port,
+                               max_queue=args.queue_limit,
+                               decode_window=args.decode_window)
+    frontend.start(drive=False)
+    coord = DisaggCoordinator(engine, frontend, peer,
+                              decode_window=args.decode_window).start()
+    with open("serving.ready", "w") as f:
+        f.write(f"ok {frontend.port}\n")
+    _emit({"event": "serving", "role": "decode", "port": frontend.port,
+           "peer": peer, "paged": page_stats, **result})
+    i = 0
+    while True:
+        time.sleep(args.serve_interval)
+        i += 1
+        try:
+            _emit({"event": "heartbeat", "n": i, "role": "decode",
+                   **frontend.stats(), "paged": engine.page_stats(),
+                   "disagg": coord.stats()})
+        except Exception as e:
+            _emit({"event": "heartbeat_error", "n": i, "error": str(e)})
 
 
 def _serve_gang(args, contract, cfg, params, mesh, result) -> dict:
@@ -788,6 +878,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "tighter intake latency")
     p.add_argument("--serve-interval", type=float, default=30.0,
                    help="llama --serve: seconds between decode heartbeats")
+    p.add_argument("--serve-role",
+                   default=os.environ.get("SERVE_ROLE", "colocated"),
+                   choices=["colocated", "prefill", "decode"],
+                   help="llama --serve: disaggregated tier role "
+                        "(dist/disagg.yml). 'prefill' answers "
+                        "/v1/prefill with packed KV page spans, "
+                        "chunked prefill flat-out; 'decode' runs the "
+                        "client front door and adopts pages shipped "
+                        "from --serve-peer; the default serves both "
+                        "phases co-located on one engine")
+    p.add_argument("--serve-peer",
+                   default=os.environ.get("SERVE_PEER", ""),
+                   help="llama --serve --serve-role decode: prefill "
+                        "tier base URL (http[s]://host:port, from the "
+                        "scheduler's endpoints surface). Empty "
+                        "degrades loudly to co-located serving "
+                        "(disagg_fallback)")
     p.add_argument("--attn", default="auto",
                    choices=["auto", "dense", "flash", "ring", "ulysses"])
     p.add_argument("--ring-layout", default="contiguous",

@@ -1,0 +1,472 @@
+"""Disaggregated prefill/decode serving (``models/disagg.py`` +
+``PagedServer.prefill_span``/``adopt_pages``): wire-format verification,
+ship->adopt greedy parity with the co-located engine, ledger hygiene
+across adopted and ABORTED transfers, prefix dedupe of shipped spans,
+the coordinator's HTTP end-to-end path with peer-down degradation, the
+chaos kv-ship invariant, the disagg.yml plan DAG, and the gang intake
+codec's edge cases."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tests._jax_cpu  # noqa: F401
+
+from dcos_commons_tpu.chaos.invariants import InvariantChecker
+from dcos_commons_tpu.models import llama, serving
+from dcos_commons_tpu.models.disagg import (DisaggCoordinator,
+                                            KVShipper, PageShipError,
+                                            PrefillWorker, pack_span,
+                                            unpack_span)
+from dcos_commons_tpu.models.ingress import ServingFrontend
+from dcos_commons_tpu.models.paging import PagePool
+from dcos_commons_tpu.models.serving_gang import (decode_intake,
+                                                  encode_intake)
+
+
+def _cfg(**kw):
+    return llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                  attn_impl="dense", **kw)
+
+
+def _solo(cfg, params, prompt, steps):
+    toks = llama.generate_stepwise(cfg, params,
+                                   jnp.asarray([prompt], jnp.int32),
+                                   steps)
+    return [int(t) for t in toks[0]]
+
+
+def _prompt(seed, n, vocab):
+    return [int(t) for t in jax.random.randint(
+        jax.random.key(seed), (n,), 0, vocab)]
+
+
+def _pair(cfg, params, **kw):
+    """A prefill-tier engine and a decode-tier engine over one model."""
+    mk = lambda: serving.PagedServer(cfg, params, slots=2, page_size=8,
+                                     prefill_chunk=8, **kw)
+    return mk(), mk()
+
+
+def _drain_decode(engine):
+    while engine.requests_active():
+        engine.step()
+    return dict(engine.finished)
+
+
+# ------------------------------------------------------------ wire format
+
+
+def test_pack_unpack_roundtrip_bf16():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    prefill, _ = _pair(cfg, params)
+    prompt = _prompt(200, 21, cfg.vocab_size)
+    span = prefill.prefill_span(prompt)
+    frame = pack_span(span)
+    back = unpack_span(frame)
+    assert back["prompt"] == prompt
+    assert back["first_token"] == span["first_token"]
+    assert back["page_size"] == prefill.page_size
+    assert not back["kv_quant"]
+    for side in ("k", "v"):
+        np.testing.assert_array_equal(np.asarray(span["payload"][side]),
+                                      back["payload"][side])
+    assert prefill.ledger_violations() == []
+
+
+def test_pack_unpack_roundtrip_int8():
+    cfg = _cfg(kv_quant=True)
+    params = llama.init_params(cfg, jax.random.key(0))
+    prefill, _ = _pair(cfg, params)
+    span = prefill.prefill_span(_prompt(201, 17, cfg.vocab_size))
+    back = unpack_span(pack_span(span))
+    assert back["kv_quant"]
+    for side in ("k", "v"):
+        for part in ("q", "s"):
+            np.testing.assert_array_equal(
+                np.asarray(span["payload"][side][part]),
+                back["payload"][side][part])
+
+
+def test_unpack_rejects_corruption():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    prefill, _ = _pair(cfg, params)
+    frame = pack_span(prefill.prefill_span(_prompt(202, 12,
+                                                   cfg.vocab_size)))
+    with pytest.raises(PageShipError, match="magic"):
+        unpack_span(b"NOTSPAN!" + frame[8:])
+    with pytest.raises(PageShipError, match="digest"):
+        # flip one body byte (past the header): digest catches it
+        bad = bytearray(frame)
+        bad[-1] ^= 0xFF
+        unpack_span(bytes(bad))
+    with pytest.raises(PageShipError):
+        unpack_span(frame[:20])
+    # a tampered prompt disagrees with the page hashes
+    import struct as _struct
+    (hlen,) = _struct.unpack_from("<I", frame, 8)
+    meta = json.loads(frame[12:12 + hlen])
+    meta["prompt"] = [(t + 1) % cfg.vocab_size for t in meta["prompt"]]
+    hdr = json.dumps(meta).encode()
+    with pytest.raises(PageShipError, match="prefix-hash|digest"):
+        unpack_span(frame[:8] + _struct.pack("<I", len(hdr)) + hdr
+                    + frame[12 + hlen:])
+
+
+# ----------------------------------------------------- ship -> adopt path
+
+
+@pytest.mark.parametrize("kv_quant", [False, True],
+                         ids=["bf16", "int8"])
+def test_ship_adopt_parity(kv_quant):
+    """A span prefilled on one engine, shipped through the wire format,
+    and adopted by a second engine decodes token-identically to the
+    co-located paged path (which itself matches solo greedy)."""
+    cfg = _cfg(kv_quant=kv_quant)
+    params = llama.init_params(cfg, jax.random.key(0))
+    prefill, decode = _pair(cfg, params)
+    for i, (n, m) in enumerate([(9, 6), (20, 5), (13, 7)]):
+        prompt = _prompt(210 + i, n, cfg.vocab_size)
+        span = unpack_span(pack_span(prefill.prefill_span(prompt)))
+        slot = decode.adopt_pages(span, max_new=m, request_id=i)
+        assert slot is not None
+        got = _drain_decode(decode)
+        assert got[i] == _solo(cfg, params, prompt, m), (i,)
+        decode.finished.clear()
+    assert prefill.ledger_violations() == []
+    assert decode.ledger_violations() == []
+    assert decode.page_stats()["adopted_spans"] == 3
+
+
+def test_adoption_abort_unwinds_every_reservation():
+    """A failure AFTER pages are reserved (the kv_ship_lost seam) must
+    return every reference: pages_free recovers and the ledger audits
+    clean — adoption is transactional."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    prefill, decode = _pair(cfg, params)
+    span = unpack_span(pack_span(
+        prefill.prefill_span(_prompt(220, 18, cfg.vocab_size))))
+    before = decode.pages_free()
+    boom = lambda n: (_ for _ in ()).throw(RuntimeError("device lost"))
+    real = decode._adopt_exec
+    decode._adopt_exec = boom
+    try:
+        with pytest.raises(RuntimeError, match="device lost"):
+            decode.adopt_pages(span, max_new=4)
+    finally:
+        decode._adopt_exec = real
+    assert decode.pages_free() == before
+    assert decode.ledger_violations() == []
+    # and the engine still works afterwards
+    slot = decode.adopt_pages(span, max_new=4, request_id="ok")
+    assert slot is not None
+    got = _drain_decode(decode)
+    assert got["ok"] == _solo(cfg, params, span["prompt"], 4)
+    assert decode.ledger_violations() == []
+
+
+def test_adopt_dedupes_shipped_prefix():
+    """The second adoption of a repeated (system) prompt shares its
+    full pages through the decode tier's radix by reference — the
+    shipped payload for those pages is never written."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    prefill, decode = _pair(cfg, params)
+    prompt = _prompt(230, 20, cfg.vocab_size)   # 2 full pages of 8
+    for i in range(2):
+        span = unpack_span(pack_span(prefill.prefill_span(prompt)))
+        assert decode.adopt_pages(span, max_new=4,
+                                  request_id=i) is not None
+        _drain_decode(decode)
+    assert decode.page_stats()["adopt_shared_pages"] > 0
+    # sharing never bends tokens
+    want = _solo(cfg, params, prompt, 4)
+    assert decode.finished[0] == want and decode.finished[1] == want
+    assert decode.ledger_violations() == []
+    # the prefill tier's own radix also deduped the repeat
+    assert prefill.page_stats()["prefix_hits"] > 0
+
+
+def test_adopt_stalls_on_pages_free_then_succeeds():
+    """adopt_pages gates on pages free exactly like submit: a full pool
+    returns None (the coordinator counts a transfer stall and re-offers)
+    and the same span admits once streams retire."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    prefill = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                                  prefill_chunk=8)
+    decode = serving.PagedServer(cfg, params, slots=4, pages=6,
+                                 page_size=8, prefill_chunk=8,
+                                 prefix_cache=False)
+    hog = _prompt(240, 30, cfg.vocab_size)       # 30+10 -> 5 of 6 pages
+    assert decode.submit(hog, max_new=10, request_id="hog") is not None
+    span = unpack_span(pack_span(
+        prefill.prefill_span(_prompt(241, 16, cfg.vocab_size))))
+    assert decode.adopt_pages(span, max_new=8) is None   # 3 pages > 1 free
+    _drain_decode(decode)                                # hog retires
+    slot = decode.adopt_pages(span, max_new=8, request_id="late")
+    assert slot is not None
+    got = _drain_decode(decode)
+    assert got["late"] == _solo(cfg, params, span["prompt"], 8)
+    assert decode.ledger_violations() == []
+
+
+def test_adopt_rejects_mismatched_tiers():
+    """Config mismatches are ValueErrors raised BEFORE any reservation
+    — misconfigured tiers fail loudly, holding zero pages."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    prefill, decode = _pair(cfg, params)
+    span = unpack_span(pack_span(
+        prefill.prefill_span(_prompt(250, 12, cfg.vocab_size))))
+    before = decode.pages_free()
+    with pytest.raises(ValueError, match="page.size|page size"):
+        decode.adopt_pages(dict(span, page_size=16), max_new=4)
+    with pytest.raises(ValueError, match="kv_quant"):
+        decode.adopt_pages(dict(span, kv_quant=True), max_new=4)
+    with pytest.raises(ValueError):
+        decode.adopt_pages(dict(span, prompt=span["prompt"] * 10),
+                           max_new=4)
+    assert decode.pages_free() == before
+    assert decode.ledger_violations() == []
+
+
+def test_prefill_span_releases_pool_and_rejects_impossible():
+    """A prefill-only engine releases every working page right after
+    extraction (back-to-back spans reuse the same tiny pool), and
+    capacity-impossible prompts are loud ValueErrors."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    tiny = serving.PagedServer(cfg, params, slots=1, pages=2,
+                               page_size=8, prefill_chunk=8,
+                               prefix_cache=False)
+    for i in range(3):                       # 2 pages each, pool of 2
+        assert tiny.prefill_span(_prompt(260 + i, 16,
+                                         cfg.vocab_size)) is not None
+        assert tiny.pages_free() == 2
+    with pytest.raises(ValueError, match="pool holds"):
+        tiny.prefill_span(_prompt(263, 40, cfg.vocab_size))
+    with pytest.raises(ValueError, match="empty"):
+        tiny.prefill_span([])
+    with pytest.raises(ValueError, match="decode room"):
+        tiny.prefill_span(_prompt(263, cfg.max_seq, cfg.vocab_size))
+    assert tiny.ledger_violations() == []
+
+
+# ----------------------------------------------------- coordinator + HTTP
+
+
+def _post(port, payload, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestCoordinator:
+    def test_disagg_e2e_over_http(self):
+        """Client -> decode frontend -> coordinator ships to a real
+        PrefillWorker -> spans adopt -> decode: every request gets its
+        exact solo stream, and the receipts show real shipping."""
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        pre_engine, dec_engine = _pair(cfg, params)
+        worker = PrefillWorker(pre_engine, port=0,
+                               host="127.0.0.1").start()
+        fe = ServingFrontend(dec_engine, port=0, host="127.0.0.1")
+        fe.start(drive=False)
+        coord = DisaggCoordinator(
+            dec_engine, fe, f"http://127.0.0.1:{worker.port}",
+            decode_window=4).start()
+        try:
+            prompts = [_prompt(270 + i, 9 + 4 * i, cfg.vocab_size)
+                       for i in range(3)]
+            results = [None] * 3
+
+            def hit(i):
+                results[i] = _post(fe.port, {"prompt": prompts[i],
+                                             "max_new": 6})
+
+            threads = [threading.Thread(target=hit, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            for i in range(3):
+                status, body = results[i]
+                assert status == 200, (i, body)
+                assert body["tokens"] == _solo(cfg, params, prompts[i],
+                                               6), (i,)
+            st = coord.stats()
+            assert st["spans_shipped"] == 3
+            assert st["kv_bytes_shipped"] > 0
+            assert st["peer_fallbacks"] == 0
+        finally:
+            coord.stop()
+            fe.stop()
+            worker.stop()
+        assert pre_engine.ledger_violations() == []
+        assert dec_engine.ledger_violations() == []
+
+    def test_peer_down_degrades_to_colocated(self):
+        """A dead peer never drops a request: the coordinator falls
+        back to the co-located paged path per request, loudly
+        (peer_fallbacks), with exact parity."""
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        engine = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                                     prefill_chunk=8)
+        fe = ServingFrontend(engine, port=0, host="127.0.0.1")
+        fe.start(drive=False)
+        shipper = KVShipper(timeout_s=2.0)
+        coord = DisaggCoordinator(engine, fe,
+                                  "http://127.0.0.1:9",  # discard port
+                                  shipper=shipper,
+                                  decode_window=4).start()
+        try:
+            p = _prompt(280, 11, cfg.vocab_size)
+            status, body = _post(fe.port, {"prompt": p, "max_new": 5})
+            assert status == 200
+            assert body["tokens"] == _solo(cfg, params, p, 5)
+            assert coord.stats()["peer_fallbacks"] >= 1
+        finally:
+            coord.stop()
+            fe.stop()
+        assert engine.ledger_violations() == []
+
+    def test_prefill_worker_http_contract(self):
+        """The prefill front door: healthz reports the tier role, a
+        good post returns a verifiable frame, garbage is a 400."""
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        engine, _ = _pair(cfg, params)
+        worker = PrefillWorker(engine, port=0, host="127.0.0.1").start()
+        try:
+            base = f"http://127.0.0.1:{worker.port}"
+            with urllib.request.urlopen(base + "/v1/healthz",
+                                        timeout=30) as r:
+                hz = json.loads(r.read())
+            assert hz["role"] == "prefill" and hz["ok"]
+            span = KVShipper(timeout_s=120).fetch(
+                base, _prompt(290, 10, cfg.vocab_size))
+            assert span["first_token"] >= 0
+            req = urllib.request.Request(
+                base + "/v1/prefill", data=b'{"prompt": "nope"}',
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 400
+        finally:
+            worker.stop()
+
+
+# ------------------------------------------------------- chaos invariant
+
+
+class _LeakySim:
+    """A fake page sim whose aborted transfer 'forgot' one unref —
+    the kv-ship invariant must catch exactly this."""
+
+    def __init__(self):
+        self.pool = PagePool(4, 8)
+        pages = self.pool.alloc(2)
+        self.pool.unref(pages[1])           # page 0 leaks a reference
+        self.ship_aborted = [list(pages)]
+
+    def expected_refs(self):
+        return {}
+
+
+def test_kv_ship_invariant_catches_leaked_abort():
+    class _Runner:
+        page_sims = [_LeakySim()]
+
+    checker = InvariantChecker.__new__(InvariantChecker)
+    checker._runner = _Runner()
+    out = checker._check_kv_ship(tick=7)
+    assert len(out) == 1
+    assert out[0].invariant == "kv-ship"
+    assert "page 0" in out[0].detail
+
+
+def test_kv_ship_invariant_quiet_on_clean_abort():
+    sim = _LeakySim()
+    sim.pool.unref(0)                       # the missing unref lands
+
+    class _Runner:
+        page_sims = [sim]
+
+    checker = InvariantChecker.__new__(InvariantChecker)
+    checker._runner = _Runner()
+    assert checker._check_kv_ship(tick=7) == []
+
+
+# ------------------------------------------------------------- yaml plan
+
+
+def test_disagg_scenario_plan_sequences_tiers():
+    """disagg.yml: two pods, decode-deploy depends on prefill-deploy
+    (a decode replica must find its peer tier already serving), and
+    the worker cmds carry the tier roles."""
+    from frameworks.jax.scenarios import load_scenario
+    spec = load_scenario("disagg")
+    pods = {p.type: p for p in spec.pods}
+    assert set(pods) == {"prefill", "decode"}
+    cmds = {name: pod.tasks[0].cmd for name, pod in pods.items()}
+    assert "--serve-role prefill" in cmds["prefill"]
+    assert "--serve-role decode" in cmds["decode"]
+    assert "--serve-peer" in cmds["decode"]
+    deploy = next(p for p in spec.plans if p.name == "deploy")
+    phases = {ph.name: ph for ph in deploy.phases}
+    assert list(phases["decode-deploy"].deps) == ["prefill-deploy"]
+    assert list(phases["prefill-deploy"].deps) == []
+
+
+# ----------------------------------------------- gang intake codec edges
+
+
+class TestIntakeCodec:
+    def test_empty_intake_roundtrips(self):
+        arr = encode_intake([], max_intake=4, max_prompt=8)
+        assert arr.shape == (4, 10) and not arr.any()
+        assert decode_intake(arr) == []
+
+    def test_overflow_rejected(self):
+        items = [([1, 2], 4)] * 3
+        with pytest.raises(ValueError, match="max_intake"):
+            encode_intake(items, max_intake=2, max_prompt=8)
+
+    def test_large_token_ids_roundtrip(self):
+        """Token ids are int32 on the wire — a 1M-entry vocab (and a
+        large max_new) must survive the gang broadcast unclipped."""
+        items = [([1_000_000, 0, 2_147_483_647], 1_000),
+                 ([7], 1)]
+        arr = encode_intake(items, max_intake=4, max_prompt=8)
+        assert arr.dtype == np.int32
+        assert decode_intake(arr) == items
+
+    def test_zero_and_over_length_prompts_rejected(self):
+        with pytest.raises(ValueError, match="length"):
+            encode_intake([([], 4)], max_intake=2, max_prompt=8)
+        with pytest.raises(ValueError, match="length"):
+            encode_intake([(list(range(9)), 4)], max_intake=2,
+                          max_prompt=8)
+
+    def test_padding_never_truncates_mid_list(self):
+        """A zero-length row terminates decode — rows after the first
+        empty slot are ignored even if dirty."""
+        arr = encode_intake([([5, 6], 3)], max_intake=3, max_prompt=4)
+        arr[2, 0] = 2                        # dirty row past terminator
+        arr[2, 2:4] = [9, 9]
+        assert decode_intake(arr) == [([5, 6], 3)]

@@ -303,27 +303,11 @@ plans:
     return 0
 
 
-def main(argv=None) -> int:
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--pods", type=int, default=100)
-    p.add_argument("--tpu", action="store_true",
-                   help="gang-placed TPU pods instead of plain cpu pods")
-    p.add_argument("--live", action="store_true",
-                   help="drive the real ApiServer with protocol agents")
-    p.add_argument("--agents", type=int, default=200,
-                   help="protocol-agent count for --live")
-    p.add_argument("--gang", action="store_true",
-                   help="--live flagship-fleet mode: 4-chip hosts in "
-                        "4-host slices, one multislice gang over all of "
-                        "them, plus a whole-gang-replace timing (use "
-                        "--pods 64 --agents 64 for the v5e-256 shape)")
-    p.add_argument("--poll-interval", type=float, default=1.0,
-                   help="agent poll cadence for --live (reference: 1 Hz)")
-    args = p.parse_args(argv)
-    if args.live:
-        return run_live(args.pods, args.agents, args.poll_interval,
-                        gang=args.gang)
-
+def run_inprocess(pods: int = 100, tpu: bool = False) -> dict:
+    """The default mode as a callable: deploy-plan time-to-COMPLETE over
+    an instant-accept FakeCluster — pure control-plane throughput.
+    Returns the receipt dict (the CLI prints it; ``bench.py`` embeds it
+    as its ``control_plane`` section)."""
     from dcos_commons_tpu.agent.fake import FakeCluster
     from dcos_commons_tpu.agent.inventory import (AgentInfo, PortRange,
                                                   TpuInventory)
@@ -332,8 +316,8 @@ def main(argv=None) -> int:
     from dcos_commons_tpu.specification import load_service_yaml_str
     from dcos_commons_tpu.state import MemPersister
 
-    n = args.pods
-    if args.tpu:
+    n = pods
+    if tpu:
         yml = f"""
 name: bench
 pods:
@@ -385,14 +369,37 @@ pods:
                 f"deploy did not complete in {cycles} cycles: "
                 f"{sched.plan('deploy').status}")
     dt = time.perf_counter() - t0
-    print(json.dumps({
+    return {
         "metric": "deploy_pods_per_sec",
-        "tpu_gang": bool(args.tpu),
+        "tpu_gang": bool(tpu),
         "pods": n,
         "seconds": round(dt, 3),
         "pods_per_sec": round(n / dt, 1),
         "cycles": cycles,
-    }))
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--pods", type=int, default=100)
+    p.add_argument("--tpu", action="store_true",
+                   help="gang-placed TPU pods instead of plain cpu pods")
+    p.add_argument("--live", action="store_true",
+                   help="drive the real ApiServer with protocol agents")
+    p.add_argument("--agents", type=int, default=200,
+                   help="protocol-agent count for --live")
+    p.add_argument("--gang", action="store_true",
+                   help="--live flagship-fleet mode: 4-chip hosts in "
+                        "4-host slices, one multislice gang over all of "
+                        "them, plus a whole-gang-replace timing (use "
+                        "--pods 64 --agents 64 for the v5e-256 shape)")
+    p.add_argument("--poll-interval", type=float, default=1.0,
+                   help="agent poll cadence for --live (reference: 1 Hz)")
+    args = p.parse_args(argv)
+    if args.live:
+        return run_live(args.pods, args.agents, args.poll_interval,
+                        gang=args.gang)
+    print(json.dumps(run_inprocess(args.pods, tpu=args.tpu)))
     return 0
 
 

@@ -23,6 +23,16 @@ slots x max_seq/page_size), and the receipt gains ``serve_paged`` /
 slots A/B is auditable from the two JSON lines alone. An infeasible
 paged config degrades to the slot engine and the receipt says so
 (``paged_fallback``), mirroring the worker's behaviour.
+
+``--engine disagg`` runs the disaggregated pair IN THIS PROCESS at
+equal total model replicas: a second PagedServer becomes the prefill
+tier behind a real ``PrefillWorker`` HTTP endpoint, and the decode
+tier's frontend is driven by a ``DisaggCoordinator`` that ships every
+prompt over localhost and adopts the returned pages
+(``models/disagg.py``). The receipt gains ``serve_disagg`` /
+``spans_shipped`` / ``kv_bytes_shipped`` / ``transfer_stalls`` /
+``peer_fallbacks`` / ``adopt_shared_pages`` — the A/B against
+``--engine paged`` is the disaggregation receipt.
 """
 
 from __future__ import annotations
@@ -67,7 +77,8 @@ def main(argv=None) -> int:
     p.add_argument("--decode-window", type=int, default=8,
                    help="tokens per device dispatch "
                         "(SlotServer.step_many)")
-    p.add_argument("--engine", default="slot", choices=["slot", "paged"])
+    p.add_argument("--engine", default="slot",
+                   choices=["slot", "paged", "disagg"])
     p.add_argument("--pages", type=int, default=-1,
                    help="paged engine pool size (-1 = auto: "
                         "slots x max_seq/page_size)")
@@ -108,19 +119,27 @@ def main(argv=None) -> int:
         quant_applied = "none"
 
     paged_fallback = None
-    if args.engine == "paged":
+    pre_engine = None
+    if args.engine in ("paged", "disagg"):
         try:
             engine = PagedServer(
                 cfg, params, slots=args.slots,
                 pages=None if args.pages < 0 else args.pages,
                 page_size=args.page_size,
                 prefill_chunk=args.prefill_chunk)
+            if args.engine == "disagg":
+                pre_engine = PagedServer(
+                    cfg, params, slots=args.slots,
+                    pages=None if args.pages < 0 else args.pages,
+                    page_size=args.page_size,
+                    prefill_chunk=args.prefill_chunk)
         except ValueError as e:
             paged_fallback = str(e)
             engine = SlotServer(cfg, params, slots=args.slots)
     else:
         engine = SlotServer(cfg, params, slots=args.slots)
     paged = isinstance(engine, PagedServer)
+    disagg = pre_engine is not None
     rng = random.Random(args.seed)
     lens = [int(x) for x in args.prompt_lens.split(",")]
     sys_prefix = [rng.randrange(cfg.vocab_size)
@@ -137,7 +156,27 @@ def main(argv=None) -> int:
     # so warming after start() would race the engine thread on the
     # donated cache
     wrng = random.Random(1)
-    if paged:
+    if disagg:
+        # warm BOTH tiers' executable matrices through the real path:
+        # chunked prefill_span on the prefill engine, adopt + decode
+        # windows on the decode engine — all before any server thread
+        # exists (same single-thread donation contract as below)
+        from dcos_commons_tpu.models.disagg import (DisaggCoordinator,
+                                                    KVShipper,
+                                                    PrefillWorker)
+        for n in sorted(set(lens)):
+            span = KVShipper.unpack(KVShipper.pack(
+                pre_engine.prefill_span(make_prompt(wrng, n))))
+            slot = engine.adopt_pages(
+                span, max_new=args.max_new if n == max(lens) else 2,
+                request_id=("warm", n))
+            if slot is None:                 # pool too tight to warm via
+                engine.submit(span["prompt"], max_new=2,  # adoption
+                              request_id=("warm", n))
+            while engine.requests_active():
+                engine.step_many(args.decode_window)
+        engine.finished.clear()
+    elif paged:
         # the paged matrix is one chunk executable + one decode window
         # PER live-span page count (decode dispatches read only the
         # pages the window can touch): a request per prompt length plus
@@ -162,9 +201,22 @@ def main(argv=None) -> int:
                     engine.step_many(args.decode_window)
                 engine.finished.clear()
                 k *= 2
-    fe = ServingFrontend(engine, port=0, host="127.0.0.1",
-                         max_queue=args.queue_limit,
-                         decode_window=args.decode_window).start()
+    worker = coord = None
+    if disagg:
+        worker = PrefillWorker(pre_engine, port=0,
+                               host="127.0.0.1").start()
+        fe = ServingFrontend(engine, port=0, host="127.0.0.1",
+                             max_queue=args.queue_limit,
+                             decode_window=args.decode_window)
+        fe.start(drive=False)
+        coord = DisaggCoordinator(
+            engine, fe, f"http://127.0.0.1:{worker.port}",
+            decode_window=args.decode_window,
+            max_inflight=args.slots).start()
+    else:
+        fe = ServingFrontend(engine, port=0, host="127.0.0.1",
+                             max_queue=args.queue_limit,
+                             decode_window=args.decode_window).start()
     # HTTP-path warmup (engine already warm; these ride the engine
     # thread like real traffic)
     for n in sorted(set(lens)):
@@ -219,7 +271,12 @@ def main(argv=None) -> int:
     hung = sum(1 for th in threads if th.is_alive())
     wall = time.perf_counter() - t_start
     stats = fe.stats()
+    coord_stats = coord.stats() if coord else {}
+    if coord:
+        coord.stop()
     fe.stop()
+    if worker:
+        worker.stop()
 
     lats = [r[0] * 1000 for r in results]
     ttfts = [r[2] for r in results if r[2] is not None]
@@ -237,6 +294,15 @@ def main(argv=None) -> int:
             "pages_in_use_peak": page_stats["pages_in_use_peak"],
             "prefix_hits": page_stats["prefix_hits"],
             "prefill_chunk": args.prefill_chunk} if paged else {}),
+        "serve_disagg": disagg,
+        **({"spans_shipped": coord_stats["spans_shipped"],
+            "kv_bytes_shipped": coord_stats["kv_bytes_shipped"],
+            "transfer_stalls": coord_stats["transfer_stalls"],
+            "peer_fallbacks": coord_stats["peer_fallbacks"],
+            "adopt_shared_pages": page_stats["adopt_shared_pages"],
+            "prefill_prefix_hits":
+                pre_engine.page_stats()["prefix_hits"]} if disagg
+           else {}),
         "slots": args.slots, "decode_window": args.decode_window,
         "shared_prefix": args.shared_prefix,
         "rps_offered": args.rps,

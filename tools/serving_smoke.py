@@ -1,6 +1,8 @@
 """Time-capped serving smoke for CI: paged engine vs slot engine on the
 tiny model, exact greedy-token parity plus a page-pressure capacity
-check.
+check and a TWO-PROCESS disaggregated parity check (a prefill worker in
+a child process ships spans over real HTTP; the parent adopts and
+decodes — tokens must match the co-located engines exactly).
 
 The deep parity matrix (flash kernel, int8 KV, tensor-parallel mesh)
 lives in ``tests/test_serving_paged.py``; this is the always-on slice
@@ -14,8 +16,28 @@ tail checks rather than timing out the build.
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
 import time
+
+# the prefill tier of the two-process check: same deterministic tiny
+# model (init key 0), a real PrefillWorker on an OS-assigned port
+# printed to stdout, then park — the parent owns the lifetime
+_PREFILL_CHILD = """
+import time
+import jax
+from dcos_commons_tpu.models import llama, serving
+from dcos_commons_tpu.models.disagg import PrefillWorker
+cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=64, attn_impl="dense")
+params = llama.init_params(cfg, jax.random.key(0))
+engine = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                             prefill_chunk=8)
+worker = PrefillWorker(engine, port=0, host="127.0.0.1").start()
+print(worker.port, flush=True)
+while True:
+    time.sleep(1)
+"""
 
 
 def main(argv=None) -> int:
@@ -94,8 +116,60 @@ def main(argv=None) -> int:
         return 1
     ran += 1
 
+    # 4. two-process disaggregation: a prefill worker in a CHILD
+    # process ships every span over real HTTP; this process adopts the
+    # pages and decodes — shipped-pages decode must be token-identical
+    # to the co-located paged path, with a clean ledger on the adopter
+    if _spent("disagg-parity"):
+        return 0
+    from dcos_commons_tpu.models.disagg import KVShipper
+    child = subprocess.Popen(
+        [sys.executable, "-c", _PREFILL_CHILD],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        port_line = child.stdout.readline().strip()
+        if not port_line.isdigit():
+            print("serving-smoke FAILED: prefill child never published "
+                  "its port", file=sys.stderr)
+            return 1
+        peer = f"http://127.0.0.1:{port_line}"
+        shipper = KVShipper(timeout_s=max(30.0,
+                                          deadline - time.monotonic()))
+        decode = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                     prefill_chunk=8)
+        got = {}
+        for r in reqs:
+            span = shipper.fetch(peer, r["prompt"])
+            slot_idx = decode.adopt_pages(span, max_new=r["max_new"],
+                                          request_id=r["request_id"])
+            while slot_idx is None:           # pages recycle on retire
+                decode.step()
+                slot_idx = decode.adopt_pages(
+                    span, max_new=r["max_new"],
+                    request_id=r["request_id"])
+        while decode.requests_active():
+            decode.step()
+        got = dict(decode.finished)
+    finally:
+        child.kill()
+        child.wait(timeout=10)
+    if got != slot:
+        print(f"serving-smoke FAILED: shipped-span streams != slot "
+              f"streams\n  disagg: {got}\n  slot:   {slot}",
+              file=sys.stderr)
+        return 1
+    problems = decode.ledger_violations()
+    if problems:
+        print(f"serving-smoke FAILED: adopter ledger violations "
+              f"{problems}", file=sys.stderr)
+        return 1
+    ran += 1
+
     print(f"serving-smoke: {ran} checks passed — paged == slot "
-          f"token-exact, ledger clean "
+          f"token-exact, shipped spans decode identically across "
+          f"processes ({shipper.bytes_shipped} KV bytes over HTTP), "
+          f"ledger clean "
           f"(peak {engine.page_stats()['pages_in_use_peak']} pages)")
     return 0
 
