@@ -264,7 +264,7 @@ def main() -> None:
         # throughput over an instant-accept fake cluster — plain pods
         # and a gang-placed TPU slice — so every round's receipt
         # carries the scheduler's own numbers next to the model's
-        from tools.bench_scheduler import run_inprocess
+        from tools.bench_scheduler import run_inprocess, run_steady_state
         plain = run_inprocess(pods=200)
         gang = run_inprocess(pods=64, tpu=True)
         result["control_plane"] = {
@@ -273,6 +273,15 @@ def main() -> None:
             "deploy_cycles": plain["cycles"],
             "gang_deploy_pods_per_sec": gang["pods_per_sec"],
             "gang_deploy_pods": gang["pods"],
+            # fleet-size sweep under churn: steady-state cycle time must
+            # track the dirty set, not the fleet (full A/B receipt:
+            # bench_r9/control_plane.jsonl)
+            "steady_state_sweep": [
+                {k: row[k] for k in ("fleet", "cycle_p50_ms",
+                                     "cycle_p90_ms", "churn_pods_per_sec")}
+                for row in (run_steady_state(fleet, churn=True, cycles=15)
+                            for fleet in (1000, 5000, 10000))
+            ],
         }
     except Exception as e:  # supplementary; never lose the line
         result["control_plane_error"] = str(e)[:200]

@@ -88,3 +88,88 @@ class TaskRecord:
     @property
     def pod_instance_name(self) -> str:
         return f"{self.pod_type}-{self.pod_index}"
+
+
+class TaskRecords(list):
+    """An immutable-by-convention snapshot of TaskRecords with secondary
+    indexes, so matcher passes that previously scanned the whole fleet per
+    candidate (sibling lookups, gang votes, coordinator discovery) answer
+    in O(result). Consumers must treat it as frozen — only the OWNER (the
+    scheduler's generation-keyed cache) may mutate it, and only through
+    ``patch()``, which keeps every index consistent at O(changed) cost so
+    a launch no longer forces an O(fleet) rebuild. Plain ``list``/
+    ``Sequence`` callers keep working: the evaluator duck-types on the
+    index methods and falls back to scans."""
+
+    def __init__(self, records=()):
+        super().__init__(records)
+        self._by_pod: dict = {}
+        self._by_type: dict = {}        # pod_type -> {task_name: record}
+        self._coordinators: dict = {}   # pod_type -> first record at index 0
+        self._by_name: dict = {}        # task_name -> record
+        self._pos: dict = {}            # task_name -> index in the list
+        for i, r in enumerate(self):
+            self._by_name[r.task_name] = r
+            self._pos[r.task_name] = i
+            self._by_pod.setdefault(r.pod_instance_name, []).append(r)
+            self._by_type.setdefault(r.pod_type, {})[r.task_name] = r
+            if r.pod_index == 0:
+                self._coordinators.setdefault(r.pod_type, r)
+
+    def for_pod_instance(self, name: str) -> list:
+        return self._by_pod.get(name, [])
+
+    def for_pod_type(self, pod_type: str) -> list:
+        return list(self._by_type.get(pod_type, {}).values())
+
+    def coordinator(self, pod_type: str) -> Optional[TaskRecord]:
+        """The record of ``<pod_type>-0`` (any task of it), if launched."""
+        return self._coordinators.get(pod_type)
+
+    # -- owner-only incremental maintenance --------------------------------
+
+    def _drop(self, name: str) -> None:
+        r = self._by_name.pop(name, None)
+        if r is None:
+            return
+        # O(1) list removal: swap the record with the tail and pop
+        i = self._pos.pop(name)
+        last = super().pop()
+        if last is not r:
+            self[i] = last
+            self._pos[last.task_name] = i
+        bucket = self._by_pod.get(r.pod_instance_name)
+        if bucket is not None:   # short list: one pod instance's tasks
+            bucket.remove(r)
+            if not bucket:
+                del self._by_pod[r.pod_instance_name]
+        by_type = self._by_type.get(r.pod_type)
+        if by_type is not None:
+            by_type.pop(name, None)
+            if not by_type:
+                del self._by_type[r.pod_type]
+        if self._coordinators.get(r.pod_type) is r:
+            # re-elect from the remaining index-0 records of the type
+            # (rare: only when the coordinator record itself changes)
+            del self._coordinators[r.pod_type]
+            for cand in (by_type or {}).values():
+                if cand.pod_index == 0:
+                    self._coordinators[r.pod_type] = cand
+                    break
+
+    def patch(self, updates, deletes=()) -> None:
+        """Replace/insert ``updates`` records and drop ``deletes`` names,
+        keeping every index consistent — O(changed), not O(fleet). This is
+        how the scheduler's cache absorbs a mid-cycle launch; nobody else
+        may mutate the snapshot."""
+        for name in deletes:
+            self._drop(name)
+        for r in updates:
+            self._drop(r.task_name)
+            self._by_name[r.task_name] = r
+            self._pos[r.task_name] = len(self)
+            self.append(r)
+            self._by_pod.setdefault(r.pod_instance_name, []).append(r)
+            self._by_type.setdefault(r.pod_type, {})[r.task_name] = r
+            if r.pod_index == 0:
+                self._coordinators.setdefault(r.pod_type, r)

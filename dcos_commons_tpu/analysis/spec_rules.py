@@ -50,6 +50,12 @@ S6 = REGISTRY.register(Rule(
     "S6", "spec", "mesh-axis product inconsistent with gang chips",
     "make the task's DP/SP/TP/EP env product divide the gang's total "
     "chips (chips-per-host x hosts-per-slice)"))
+S7 = REGISTRY.register(Rule(
+    "S7", "spec", "plan implies super-linear per-cycle scheduler work",
+    "split the plan into smaller plans or fewer phases (steps x phases "
+    "bounds the per-cycle routing fan-out), raise TPU_PLAN_WORK_BUDGET, "
+    "or suppress S7 if the fleet really is that large",
+    default_severity=Severity.ERROR))
 
 _PLACEHOLDER = re.compile(r"\{\{\s*([A-Za-z0-9_.-]+)\s*\}\}")
 
@@ -269,6 +275,44 @@ def _rule_s6_mesh_product(spec: ServiceSpec) -> List[Finding]:
     return out
 
 
+DEFAULT_PLAN_WORK_BUDGET = 100_000
+
+
+def _plan_work_budget() -> int:
+    import os
+    try:
+        return int(os.environ.get("TPU_PLAN_WORK_BUDGET",
+                                  DEFAULT_PLAN_WORK_BUDGET))
+    except ValueError:
+        return DEFAULT_PLAN_WORK_BUDGET
+
+
+def _rule_s7_plan_work_budget(spec: ServiceSpec) -> List[Finding]:
+    """A plan's worst-case per-cycle routing work is bounded by its total
+    step count times its phase count: strategies and status routing walk
+    phases, and each phase fans out over its steps. Linear fleets (10k
+    steps in a handful of phases) are fine; a spec that multiplies both —
+    hundreds of phases each expanding to per-instance steps — makes every
+    scheduler cycle super-linear in the fleet and must be caught at review
+    time, not discovered as a pegged control plane."""
+    budget = _plan_work_budget()
+    out: List[Finding] = []
+    counts = {pod.type: pod.count for pod in spec.pods}
+    for plan in spec.plans:
+        total_steps = 0
+        for ph in plan.phases:
+            total_steps += (len(ph.steps) if ph.steps
+                            else counts.get(ph.pod_type, 0))
+        work = total_steps * len(plan.phases)
+        if work > budget:
+            out.append(Finding(
+                "S7", Severity.ERROR, f"plan {plan.name}",
+                f"{total_steps} steps x {len(plan.phases)} phases = "
+                f"{work} per-cycle work units, over the budget of {budget} "
+                "(TPU_PLAN_WORK_BUDGET)"))
+    return out
+
+
 _SPEC_RULES = (
     _rule_s0_promoted_validate,
     _rule_s1_s2_plan_dag,
@@ -276,6 +320,7 @@ _SPEC_RULES = (
     _rule_s4_port_collisions,
     _rule_s5_placeholders,
     _rule_s6_mesh_product,
+    _rule_s7_plan_work_budget,
 )
 
 

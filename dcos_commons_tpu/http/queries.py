@@ -59,21 +59,29 @@ class PlanQueries:
 
     def get(self, plan_name: str) -> tuple:
         """Returns (http_code, body): 200 when COMPLETE/WAITING, 503 while
-        the plan is still working (reference ``PlansResource.getPlanInfo``)."""
+        the plan is still working (reference ``PlansResource.getPlanInfo``).
+
+        The body comes from the scheduler's version-keyed PlanSnapshot
+        (no scheduler locks; phases unchanged since the last render are
+        served as cached dicts — response shape mirrors the reference plan
+        JSON: phases -> steps)."""
         plan = _find_plan(self._scheduler, plan_name)
-        # response shape mirrors the reference plan JSON: phases -> steps
-        body = {
-            "name": plan.name,
-            "status": plan.status.name,
-            "errors": list(plan.errors),
-            "strategy": type(plan.strategy).__name__,
-            "phases": [{
-                "name": ph.name,
-                "status": ph.status.name,
-                "strategy": type(ph.strategy).__name__,
-                "steps": [s.to_dict() for s in ph.steps],
-            } for ph in plan.phases],
-        }
+        snapshot = getattr(self._scheduler, "plan_snapshot", None)
+        if snapshot is None:
+            body = {
+                "name": plan.name,
+                "status": plan.status.name,
+                "errors": list(plan.errors),
+                "strategy": type(plan.strategy).__name__,
+                "phases": [{
+                    "name": ph.name,
+                    "status": ph.status.name,
+                    "strategy": type(ph.strategy).__name__,
+                    "steps": [s.to_dict() for s in ph.steps],
+                } for ph in plan.phases],
+            }
+        else:
+            body = snapshot.render(plan)
         code = 200 if plan.status in (Status.COMPLETE, Status.WAITING) else 503
         return code, body
 
@@ -123,19 +131,27 @@ class PodQueries:
     def __init__(self, scheduler):
         self._scheduler = scheduler
 
+    def _snapshot(self):
+        return getattr(self._scheduler, "pod_snapshot", None)
+
     def _instances(self) -> list:
-        names = sorted({t.pod_instance_name
-                        for t in self._scheduler.state.fetch_tasks()})
-        return names
+        snapshot = self._snapshot()
+        if snapshot is not None:
+            return snapshot.instances()
+        return sorted({t.pod_instance_name
+                       for t in self._scheduler.state.fetch_tasks()})
 
     def list(self) -> list:
         return self._instances()
 
-    def _pod_status(self, instance: str) -> dict:
+    def _pod_status(self, instance: str) -> Optional[dict]:
+        snapshot = self._snapshot()
+        if snapshot is not None:
+            # generation-stamped rendered body; catches up incrementally
+            # on read, so a status stored a microsecond ago is visible
+            return snapshot.body(instance)
         tasks = []
-        for t in self._scheduler.state.fetch_tasks():
-            if t.pod_instance_name != instance:
-                continue
+        for t in self._scheduler.state.fetch_tasks_by_pod().get(instance, ()):
             status = self._scheduler.state.fetch_status(t.task_name)
             override, progress = self._scheduler.state.fetch_override(
                 t.task_name)
@@ -150,22 +166,25 @@ class PodQueries:
                 "zone": t.zone,
                 "region": t.region,
             })
-        return {"name": instance, "tasks": tasks}
+        return {"name": instance, "tasks": tasks} if tasks else None
 
     def status_all(self) -> dict:
+        snapshot = self._snapshot()
+        if snapshot is not None:
+            return {"pods": snapshot.all_bodies()}
         return {"pods": [self._pod_status(i) for i in self._instances()]}
 
     def status(self, instance: str) -> dict:
-        if instance not in self._instances():
+        body = self._pod_status(instance)
+        if body is None:
             raise ApiError(404, f"no pod instance {instance!r}")
-        return self._pod_status(instance)
+        return body
 
     def info(self, instance: str) -> list:
-        infos = []
-        for t in self._scheduler.state.fetch_tasks():
-            if t.pod_instance_name == instance:
-                infos.append(t.to_dict() if hasattr(t, "to_dict")
-                             else _stored_task_dict(t))
+        infos = [t.to_dict() if hasattr(t, "to_dict")
+                 else _stored_task_dict(t)
+                 for t in self._scheduler.state.fetch_tasks_by_pod()
+                 .get(instance, ())]
         if not infos:
             raise ApiError(404, f"no pod instance {instance!r}")
         return infos

@@ -1,0 +1,175 @@
+"""Generation-stamped API snapshots — fleet-scale read path for HTTP.
+
+At 10k tasks the plan and pod endpoints were the remaining O(fleet) walks:
+``/v1/pod/status`` re-fetched and re-rendered every task per request, and
+``/v1/plans/deploy`` re-serialized a 10k-step plan tree even when nothing
+had moved since the last cycle. Both are served here from caches stamped
+with the generation counters the rest of the control plane already
+maintains:
+
+* :class:`PodStatusSnapshot` keeps rendered per-pod bodies and catches up
+  incrementally via ``StateStore.changed_since`` — a request after a quiet
+  cycle re-renders only the pods whose tasks changed.
+* :class:`PlanSnapshot` keeps rendered per-phase bodies keyed on each
+  phase's version (see ``plan.elements.Element.version``) — a completed
+  10k-step deploy phase is serialized once, not per request.
+
+Neither takes any scheduler lock: reads go through the state store's own
+thread-safe accessors and the plan tree's monotone version counters, and
+each snapshot serializes itself with a private mutex. Queries stay
+*always fresh* — every read first catches the snapshot up to the current
+generations (cheap no-op when nothing changed), so tests and operators
+observe writes immediately; the scheduler additionally pre-warms at cycle
+end so steady-state requests hit fully-built caches.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..plan.status import Status
+
+
+class PodStatusSnapshot:
+    """Rendered ``/v1/pod/<x>/status`` bodies, refreshed incrementally."""
+
+    def __init__(self, state):
+        self._state = state
+        self._lock = threading.Lock()
+        self._bodies: Dict[str, dict] = {}     # pod instance -> body
+        self._pod_of: Dict[str, str] = {}      # task name -> pod instance
+        self._tasks_gen: Optional[int] = None
+        self._statuses_gen: Optional[int] = None
+
+    def _render(self, instance: str, tasks) -> dict:
+        state = self._state
+        out = []
+        for t in tasks:
+            status = state.fetch_status(t.task_name)
+            override, progress = state.fetch_override(t.task_name)
+            self._pod_of[t.task_name] = instance
+            out.append({
+                "name": t.task_name,
+                "id": t.task_id,
+                "status": status.state.value if status else "NO_STATUS",
+                "override": override.value,
+                "overrideProgress": progress.value,
+                "agentId": t.agent_id,
+                "hostname": t.hostname,
+                "zone": t.zone,
+                "region": t.region,
+            })
+        return {"name": instance, "tasks": out}
+
+    def refresh(self) -> None:
+        """Catch up to the store's current generations. Incremental when
+        the change log can answer (re-render only pods of changed tasks);
+        full rebuild on first use or after log truncation."""
+        with self._lock:
+            # capture BEFORE reading: concurrent writes during the rebuild
+            # leave their log entries above the stamped generation, so the
+            # next refresh re-renders those pods (over-fresh, never stale)
+            tgen = self._state.tasks_generation
+            sgen = self._state.statuses_generation
+            if tgen == self._tasks_gen and sgen == self._statuses_gen:
+                return
+            changed = (self._state.changed_since(self._statuses_gen)
+                       if self._statuses_gen is not None else None)
+            by_pod = self._state.fetch_tasks_by_pod()
+            if changed is None:
+                self._pod_of = {}
+                self._bodies = {name: self._render(name, ts)
+                                for name, ts in by_pod.items()}
+            else:
+                pods = set()
+                for name in changed:
+                    task = self._state.fetch_task(name)
+                    if task is not None:
+                        pods.add(task.pod_instance_name)
+                    prev_pod = self._pod_of.get(name)
+                    if prev_pod is not None:   # deleted or moved task
+                        pods.add(prev_pod)
+                for pod_name in pods:
+                    tasks = by_pod.get(pod_name)
+                    if tasks:
+                        self._bodies[pod_name] = self._render(pod_name, tasks)
+                    else:
+                        self._bodies.pop(pod_name, None)
+            self._tasks_gen = tgen
+            self._statuses_gen = sgen
+
+    def instances(self) -> List[str]:
+        self.refresh()
+        with self._lock:
+            return sorted(self._bodies)
+
+    def body(self, instance: str) -> Optional[dict]:
+        self.refresh()
+        with self._lock:
+            return self._bodies.get(instance)
+
+    def all_bodies(self) -> List[dict]:
+        self.refresh()
+        with self._lock:
+            return [self._bodies[name] for name in sorted(self._bodies)]
+
+
+def _element_key(element) -> tuple:
+    # identity + version: a regenerated plan/phase object (recovery and
+    # decommission rebuild children in place) must never collide with its
+    # predecessor's cached body even at equal version numbers
+    return (id(element), element.version)
+
+
+class PlanSnapshot:
+    """Rendered plan bodies with per-phase caching.
+
+    A step mutation bumps its phase and plan versions (parent-chain bump),
+    so the plan-level key catches every change; only phases whose own key
+    moved are re-serialized."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plans: Dict[str, tuple] = {}   # plan name -> (key, body)
+        self._phases: Dict[tuple, tuple] = {}  # (plan, idx) -> (key, body)
+
+    def render(self, plan) -> dict:
+        with self._lock:
+            key = _element_key(plan)
+            cached = self._plans.get(plan.name)
+            if cached is not None and cached[0] == key:
+                return cached[1]
+            phases = []
+            for idx, ph in enumerate(plan.phases):
+                pkey = _element_key(ph)
+                pc = self._phases.get((plan.name, idx))
+                if pc is not None and pc[0] == pkey:
+                    phases.append(pc[1])
+                    continue
+                body = {
+                    "name": ph.name,
+                    "status": ph.status.name,
+                    "strategy": type(ph.strategy).__name__,
+                    "steps": [s.to_dict() for s in ph.steps],
+                }
+                self._phases[(plan.name, idx)] = (pkey, body)
+                phases.append(body)
+            # drop stale per-phase entries past the current phase count
+            # (plans shrink on regeneration)
+            idx = len(plan.phases)
+            while self._phases.pop((plan.name, idx), None) is not None:
+                idx += 1
+            body = {
+                "name": plan.name,
+                "status": plan.status.name,
+                "errors": list(plan.errors),
+                "strategy": type(plan.strategy).__name__,
+                "phases": phases,
+            }
+            self._plans[plan.name] = (key, body)
+            return body
+
+    def status_code(self, plan) -> int:
+        return 200 if plan.status in (Status.COMPLETE, Status.WAITING) \
+            else 503

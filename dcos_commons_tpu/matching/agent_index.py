@@ -1,0 +1,186 @@
+"""Agent inventory index — the evaluator's fleet-scale candidate filter.
+
+At 10k tasks / 1k agents the evaluator's per-candidate walk over the full
+inventory dominates the cycle: every dirty pod re-visits hundreds of agents
+that are simply full. This module buckets agents by remaining headroom
+(power-of-two levels per scalar dimension) so a fresh launch only visits
+agents that could plausibly fit, and memoizes the pure per-agent gates
+(pre-reserved role, volume disk profiles) that never change for a given
+(pod, agent) pair.
+
+The index is a snapshot: it is keyed on the identity of the agents list it
+was built from plus the reservation-ledger generation, and the evaluator
+rebuilds it (O(agents), amortized once per cycle) whenever either moves.
+Bucket filtering is strictly conservative — an agent is only excluded when
+its remaining capacity in some requested dimension provably cannot fit the
+request — and the full per-agent stages downstream remain the source of
+truth for every agent that passes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..agent.inventory import AgentInfo
+
+_DIMS = ("cpus", "memory_mb", "disk_mb", "tpus")
+
+
+def _role_shortfall(pod, agent: AgentInfo) -> Optional[str]:
+    """Pre-reserved-role gate (reference pre-reserved.yml): the pod's
+    resources must come from an agent serving that role pool. Shared by the
+    per-agent pipeline and the gang-slice feasibility pre-check so the two
+    cannot drift."""
+    if pod.pre_reserved_role and pod.pre_reserved_role not in agent.roles:
+        return (f"agent serves roles {list(agent.roles)}, pod requires "
+                f"pre-reserved role {pod.pre_reserved_role}")
+    return None
+
+
+def _profile_shortfall(volumes, agent: AgentInfo) -> Optional[str]:
+    """Volume profile matching (reference profile-mount-volumes): a volume
+    listing profiles only fits an agent advertising one of them."""
+    for v in volumes:
+        if v.profiles and not set(v.profiles) & set(agent.volume_profiles):
+            return (f"volume {v.container_path} requires disk profile "
+                    f"{sorted(v.profiles)}; agent offers "
+                    f"{sorted(agent.volume_profiles)}")
+    return None
+
+
+def _level(free: float) -> int:
+    """Headroom bucket level: ``int(free).bit_length()``. An agent at level
+    l has free < 2**l; a request with ``int(need).bit_length() == k`` can
+    only fit agents at level >= k (for l < k: free < 2**l <= 2**(k-1) <=
+    need), so levels below k are skipped without being visited."""
+    return int(free).bit_length() if free > 0 else 0
+
+
+class AgentIndex:
+    """Secondary indexes over one agent-inventory snapshot.
+
+    * ``by_id`` — agent_id -> AgentInfo (pin lookups).
+    * ``by_role`` — role -> agents serving it (pre-reserved pools).
+    * ``by_slice`` — TPU slice_id -> healthy member agents (gang placement).
+    * headroom buckets per scalar dimension, net of the ledger's reserved
+      totals at build time — ``headroom_candidates`` unions the qualifying
+      levels of the most selective dimension, in inventory order.
+    """
+
+    def __init__(self, agents: Sequence[AgentInfo], ledger):
+        self.agents = agents  # strong ref: cache identity check stays valid
+        self._ledger = ledger  # advance() only trusts THIS ledger's log
+        self.generation = ledger.generation
+        self.by_id: Dict[str, AgentInfo] = {}
+        self.by_role: Dict[str, List[AgentInfo]] = {}
+        self.by_slice: Dict[str, List[AgentInfo]] = {}
+        # dim -> level -> {inventory position: agent}; dicts (not lists) so
+        # advance() can move one agent between levels in O(1)
+        self._buckets: Dict[str, Dict[int, Dict[int, AgentInfo]]] = {
+            d: {} for d in _DIMS}
+        self._pos_of: Dict[str, int] = {}       # agent_id -> inventory pos
+        self._levels: Dict[str, Dict[str, int]] = {}  # agent_id -> dim -> lvl
+        self._role_memo: Dict[tuple, Optional[str]] = {}
+        self._profile_memo: Dict[tuple, Optional[str]] = {}
+        for pos, a in enumerate(agents):
+            self.by_id[a.agent_id] = a
+            self._pos_of[a.agent_id] = pos
+            for role in a.roles:
+                self.by_role.setdefault(role, []).append(a)
+            if a.tpu.slice_id is not None and a.tpu.chips > 0 \
+                    and not a.tpu.degraded:
+                self.by_slice.setdefault(a.tpu.slice_id, []).append(a)
+            self._bucket(pos, a, ledger)
+
+    def _bucket(self, pos: int, a: AgentInfo, ledger) -> None:
+        """(Re)compute the agent's headroom levels and file it in every
+        dimension's bucket."""
+        rc, rm, rd, rt = ledger.reserved_scalars(a.agent_id)
+        free = {"cpus": a.cpus - rc, "memory_mb": a.memory_mb - rm,
+                "disk_mb": a.disk_mb - rd,
+                # degraded hosts offer zero chips to new work — mirror
+                # the evaluator's pre-screen exactly
+                "tpus": (0 if a.tpu.degraded
+                         else max(0, a.tpu.chips - rt))}
+        levels = {}
+        for dim in _DIMS:
+            lvl = _level(free[dim])
+            levels[dim] = lvl
+            self._buckets[dim].setdefault(lvl, {})[pos] = a
+        self._levels[a.agent_id] = levels
+
+    def advance(self, ledger) -> bool:
+        """Catch the headroom buckets up to the ledger's current generation
+        by re-bucketing ONLY the agents whose reservations moved —
+        O(dirty), the reason a launch mid-cycle no longer costs an
+        O(agents) rebuild. Returns False when the ledger's change log
+        can't answer (the caller rebuilds from scratch). The pure-gate
+        memos survive: they don't depend on the ledger."""
+        if ledger is not self._ledger:
+            return False  # a different ledger's log can't patch this index
+        if ledger.generation == self.generation:
+            return True
+        dirty = ledger.agents_changed_since(self.generation)
+        if dirty is None:
+            return False
+        for agent_id in dirty:
+            a = self.by_id.get(agent_id)
+            if a is None:  # not in this inventory snapshot
+                continue
+            pos = self._pos_of[agent_id]
+            for dim, lvl in self._levels[agent_id].items():
+                bucket = self._buckets[dim].get(lvl)
+                if bucket is not None:
+                    bucket.pop(pos, None)
+                    if not bucket:
+                        del self._buckets[dim][lvl]
+            self._bucket(pos, a, ledger)
+        self.generation = ledger.generation
+        return True
+
+    def headroom_candidates(self, cpus: float, memory_mb: int, disk_mb: int,
+                            tpus: int) -> Tuple[List[AgentInfo], Optional[str]]:
+        """Agents whose build-time headroom could fit the request — a
+        conservative superset in inventory order, plus the dimension that
+        was filtered on (``None`` when nothing filtered). Filters on the
+        single most selective dimension; the caller's per-agent stages
+        re-check everything (including dimensions not filtered here) —
+        every agent excluded here provably lacks the returned dimension."""
+        needs = dict(zip(_DIMS, (cpus, memory_mb, disk_mb, tpus)))
+        best: Optional[List[Dict[int, AgentInfo]]] = None
+        best_size = None
+        best_dim = None
+        for dim, need in needs.items():
+            k = int(need).bit_length()
+            if k == 0:
+                continue  # need < 1 in this dimension: filters nothing
+            levels = [lvl for lvl in self._buckets[dim] if lvl >= k]
+            size = sum(len(self._buckets[dim][lvl]) for lvl in levels)
+            if best_size is None or size < best_size:
+                best_size = size
+                best = [self._buckets[dim][lvl] for lvl in levels]
+                best_dim = dim
+        if best is None:
+            return list(self.agents), None
+        merged = [entry for bucket in best for entry in bucket.items()]
+        merged.sort(key=lambda e: e[0])
+        return [a for _, a in merged], best_dim
+
+    # -- memoized pure per-agent gates -------------------------------------
+
+    def role_shortfall(self, pod, agent: AgentInfo) -> Optional[str]:
+        key = (id(pod), agent.agent_id)
+        memo = self._role_memo
+        if key not in memo:
+            memo[key] = _role_shortfall(pod, agent)
+        return memo[key]
+
+    def profile_shortfall(self, cache_key, volumes,
+                          agent: AgentInfo) -> Optional[str]:
+        """``cache_key`` must uniquely identify the volume list (e.g.
+        ``(id(pod), rs_id)``); the result is pure in (volumes, agent)."""
+        key = (cache_key, agent.agent_id)
+        memo = self._profile_memo
+        if key not in memo:
+            memo[key] = _profile_shortfall(volumes, agent)
+        return memo[key]

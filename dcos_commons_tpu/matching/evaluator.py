@@ -31,6 +31,7 @@ from ..plan.requirement import PodInstanceRequirement, RecoveryType
 from ..specification.spec import HealthCheckSpec, ReadinessCheckSpec
 from ..state.tasks import TpuAssignment
 from ..utils.ids import make_task_id, new_uuid
+from .agent_index import AgentIndex
 from .ledger import (Availability, Reservation, ReservationLedger,
                      VolumeReservation)
 from .outcome import EvaluationOutcome, OutcomeNode
@@ -44,26 +45,22 @@ MEGASCALE_COORDINATOR_PORT = 8479
 POD_VOLUME_SET_ID = "_pod"
 
 
-def _profile_shortfall(volumes, agent: AgentInfo) -> Optional[str]:
-    """Volume profile matching (reference profile-mount-volumes): a volume
-    listing profiles only fits an agent advertising one of them."""
-    for v in volumes:
-        if v.profiles and not set(v.profiles) & set(agent.volume_profiles):
-            return (f"volume {v.container_path} requires disk profile "
-                    f"{sorted(v.profiles)}; agent offers "
-                    f"{sorted(agent.volume_profiles)}")
-    return None
+def _records_for_pod(tasks: Sequence[TaskRecord],
+                     pod_instance_name: str) -> Sequence[TaskRecord]:
+    """Sibling records of one pod instance — O(result) when ``tasks`` is an
+    indexed TaskRecords snapshot, a scan for plain sequences."""
+    getter = getattr(tasks, "for_pod_instance", None)
+    if getter is not None:
+        return getter(pod_instance_name)
+    return [t for t in tasks if t.pod_instance_name == pod_instance_name]
 
 
-def _role_shortfall(pod, agent: AgentInfo) -> Optional[str]:
-    """Pre-reserved-role gate (reference pre-reserved.yml): the pod's
-    resources must come from an agent serving that role pool. Shared by the
-    per-agent pipeline and the gang-slice feasibility pre-check so the two
-    cannot drift."""
-    if pod.pre_reserved_role and pod.pre_reserved_role not in agent.roles:
-        return (f"agent serves roles {list(agent.roles)}, pod requires "
-                f"pre-reserved role {pod.pre_reserved_role}")
-    return None
+def _records_for_type(tasks: Sequence[TaskRecord],
+                      pod_type: str) -> Sequence[TaskRecord]:
+    getter = getattr(tasks, "for_pod_type", None)
+    if getter is not None:
+        return getter(pod_type)
+    return [t for t in tasks if t.pod_type == pod_type]
 
 
 def _needed_resource_sets(pod, requirement) -> List[str]:
@@ -171,6 +168,22 @@ class Evaluator:
         # workload identity (KDC analogue): mints a per-task bearer token
         # injected as TPU_TASK_TOKEN (redacted from stored records)
         self._task_token_minter = task_token_minter
+        # AgentIndex snapshot, valid while the same agents list object is
+        # in play; ledger movement (every launch bumps the generation) is
+        # absorbed incrementally via advance() — re-bucketing only the
+        # dirty agents — so a cycle full of launches costs O(dirty), not
+        # one O(agents) rebuild per candidate
+        self._index_cache: Optional[AgentIndex] = None
+
+    def _agent_index(self, agents: Sequence[AgentInfo],
+                     ledger: ReservationLedger) -> AgentIndex:
+        cached = self._index_cache
+        if cached is not None and cached.agents is agents \
+                and cached.advance(ledger):
+            return cached
+        index = AgentIndex(agents, ledger)
+        self._index_cache = index
+        return index
 
     def evaluate(self, requirement: PodInstanceRequirement,
                  agents: Sequence[AgentInfo], tasks: Sequence[TaskRecord],
@@ -180,6 +193,7 @@ class Evaluator:
         root = OutcomeNode.root(requirement.name)
         pod = requirement.pod_instance.pod
         pod_name = requirement.pod_instance.name
+        index = self._agent_index(agents, ledger)
 
         # a permanently-failed pod is a fresh launch no matter which plan
         # drives it (reference OfferEvaluator.java:263-277 consults the
@@ -190,7 +204,7 @@ class Evaluator:
         # reservations from an earlier step of this same replace (e.g.
         # hdfs's bootstrap->node phase) and later steps must land on that
         # agent, not scatter the pod.
-        pod_records = [t for t in tasks if t.pod_instance_name == pod_name]
+        pod_records = _records_for_pod(tasks, pod_name)
         has_marker = any(t.permanently_failed for t in pod_records)
         mid_replace = False
         if has_marker:  # off the hot path: healthy pods skip the scans
@@ -211,26 +225,12 @@ class Evaluator:
         pinned_agent = None if replace_mode else \
             self._pinned_agent(requirement, ledger)
         gang_slice, gang_err = self._gang_slice(requirement, agents, tasks,
-                                                ledger, pinned_agent)
+                                                ledger, pinned_agent,
+                                                index=index)
         if gang_err is not None:
             root.add(EvaluationOutcome.fail("gang", gang_err))
             self._record(root)
             return None, root
-
-        candidates = list(agents)
-        if pinned_agent is not None:
-            candidates = [a for a in candidates if a.agent_id == pinned_agent]
-            if not candidates:
-                root.add(EvaluationOutcome.fail(
-                    "pin", f"pinned agent {pinned_agent} not in inventory"))
-                self._record(root)
-                return None, root
-        elif replace_mode:
-            # replace exists to move off a suspect host: try the previous
-            # agent LAST (still feasible when it's the only host)
-            previous = {t.agent_id for t in tasks
-                        if t.pod_instance_name == pod_name}
-            candidates.sort(key=lambda a: a.agent_id in previous)
 
         # O(1)-per-agent capacity pre-screen over the ledger's running
         # scalar totals: a long deploy re-scans every already-full agent
@@ -249,6 +249,31 @@ class Evaluator:
                 prescreen[1] += rs.memory_mb
                 prescreen[2] += rs.disk_mb
                 prescreen[3] += rs.tpus
+
+        index_skipped = 0
+        index_dim = None
+        if pinned_agent is not None:
+            pinned = index.by_id.get(pinned_agent)
+            if pinned is None:
+                root.add(EvaluationOutcome.fail(
+                    "pin", f"pinned agent {pinned_agent} not in inventory"))
+                self._record(root)
+                return None, root
+            candidates = [pinned]
+        else:
+            if prescreen is not None:
+                # headroom-bucket filter: agents that provably cannot fit
+                # the request in some dimension are not visited at all
+                candidates, index_dim = index.headroom_candidates(*prescreen)
+                index_skipped = len(agents) - len(candidates)
+            else:
+                candidates = list(agents)
+            if replace_mode:
+                # replace exists to move off a suspect host: try the
+                # previous agent LAST (still feasible when it's the only
+                # host)
+                previous = {t.agent_id for t in pod_records}
+                candidates.sort(key=lambda a: a.agent_id in previous)
 
         # pre-screen skips beyond the first few are summarized in ONE node:
         # at fleet scale the per-agent reason tree would allocate hundreds
@@ -279,7 +304,7 @@ class Evaluator:
             node = root.child(f"agent:{agent.agent_id}")
             plan = self._evaluate_agent(requirement, agent, tasks, ledger,
                                         gang_slice, pinned_agent, node,
-                                        replace_mode)
+                                        replace_mode, index=index)
             if plan is not None:
                 node.add(EvaluationOutcome.ok("launch", f"all stages passed on {agent.agent_id}"))
                 self._record(root)
@@ -290,6 +315,17 @@ class Evaluator:
                 f"{prescreen_skipped - prescreen_detail_budget} more "
                 f"agents skipped by the capacity pre-screen (last: "
                 f"{prescreen_last_reason})"))
+        if index_skipped:
+            # same phrasing as Availability.fits — every skipped agent
+            # provably lacked the filtered dimension
+            label = {"cpus": "cpus", "memory_mb": "memory",
+                     "disk_mb": "disk", "tpus": "tpus"}[index_dim]
+            want = dict(zip(("cpus", "memory_mb", "disk_mb", "tpus"),
+                            prescreen))[index_dim]
+            root.child("capacity-summary").add(EvaluationOutcome.fail(
+                "capacity",
+                f"insufficient {label}: want {want:g} — {index_skipped} "
+                f"agents skipped by the headroom index"))
         self._record(root)
         return None, root
 
@@ -311,6 +347,7 @@ class Evaluator:
                     agents: Sequence[AgentInfo], tasks: Sequence[TaskRecord],
                     ledger: ReservationLedger,
                     pinned_agent: Optional[str] = None,
+                    index: Optional[AgentIndex] = None,
                     ) -> Tuple[Optional[str], Optional[str]]:
         """Returns (slice_id this instance must land on, error).
 
@@ -324,6 +361,8 @@ class Evaluator:
         pod = requirement.pod_instance.pod
         if pod.tpu is None or not pod.tpu.gang or pod.tpu.chips <= 0:
             return None, None
+        if index is None:
+            index = self._agent_index(agents, ledger)
         if pinned_agent is not None:
             # A pinned relaunch-in-place cannot move slices, and the
             # per-agent pipeline deliberately waives placement/profile
@@ -331,16 +370,16 @@ class Evaluator:
             # not get a vote either. The pinned agent's slice IS the gang
             # slice; if the agent vanished from inventory, evaluate()'s
             # pin stage reports that.
-            for a in agents:
-                if a.agent_id == pinned_agent:
-                    return a.tpu.slice_id, None
+            pinned = index.by_id.get(pinned_agent)
+            if pinned is not None:
+                return pinned.tpu.slice_id, None
             return None, None
         pod_type = pod.type
         n_slices = max(1, pod.tpu.slices)
         group_size = pod.tpu.group_size(pod.count)
         my_group = pod.tpu.slice_index(requirement.pod_instance.index,
                                        pod.count)
-        agents_by_id = {a.agent_id: a for a in agents}
+        agents_by_id = index.by_id
 
         def group_of(instance_name: str) -> Optional[int]:
             head, _, idx = instance_name.rpartition("-")
@@ -357,9 +396,8 @@ class Evaluator:
         # O(tasks + reservations) per candidate.
         chosen: Dict[int, str] = {}
         failed_pods = set()
-        for record in tasks:
-            if record.pod_type != pod_type or \
-                    record.pod_instance_name == requirement.pod_instance.name:
+        for record in _records_for_type(tasks, pod_type):
+            if record.pod_instance_name == requirement.pod_instance.name:
                 continue
             if record.permanently_failed:
                 # a sibling being replaced must not vote for the gang
@@ -392,13 +430,14 @@ class Evaluator:
         # all-or-nothing: every still-unassigned group must get a capable,
         # distinct slice
         per_host_chips = pod.tpu.chips
+        # healthy slice membership comes pre-grouped from the agent index
         slices: Dict[str, List[AgentInfo]] = {}
-        for a in agents:
-            if a.tpu.slice_id is None or a.tpu.chips <= 0 or a.tpu.degraded:
-                continue
-            if pod.tpu.topology and a.tpu.topology != pod.tpu.topology:
-                continue
-            slices.setdefault(a.tpu.slice_id, []).append(a)
+        for slice_id, members in index.by_slice.items():
+            if pod.tpu.topology:
+                members = [a for a in members
+                           if a.tpu.topology == pod.tpu.topology]
+            if members:
+                slices[slice_id] = members
         exclude = requirement.pod_instance.name
         # A host only counts toward a slice's capacity if it would also pass
         # the per-agent hard gates downstream (pre-reserved role, placement
@@ -424,13 +463,14 @@ class Evaluator:
                             if r.pod_instance_name in failed_pods)
             if free < per_host_chips:
                 return False
-            if _role_shortfall(pod, a) is not None:
+            if index.role_shortfall(pod, a) is not None:
                 return False
             if pod.placement_rule is not None \
                     and not pod.placement_rule.filter(a, exclude,
                                                       tasks).passes:
                 return False
-            return _profile_shortfall(pod_volumes, a) is None
+            return index.profile_shortfall(
+                (id(pod), "_gang"), pod_volumes, a) is None
 
         capable: List[str] = []
         for slice_id, members in sorted(slices.items()):
@@ -457,9 +497,13 @@ class Evaluator:
                         agent: AgentInfo, tasks: Sequence[TaskRecord],
                         ledger: ReservationLedger, gang_slice: Optional[str],
                         pinned_agent: Optional[str], node: OutcomeNode,
-                        replace_mode: bool = False) -> Optional[LaunchPlan]:
+                        replace_mode: bool = False,
+                        index: Optional[AgentIndex] = None
+                        ) -> Optional[LaunchPlan]:
         pod = requirement.pod_instance.pod
         pod_name = requirement.pod_instance.name
+        if index is None:
+            index = AgentIndex([agent], ledger)
 
         # stage: gang slice membership
         if gang_slice is not None and agent.tpu.slice_id != gang_slice:
@@ -480,7 +524,7 @@ class Evaluator:
             return None
 
         # stage: pre-reserved role
-        role_err = _role_shortfall(pod, agent)
+        role_err = index.role_shortfall(pod, agent)
         if role_err is not None:
             node.add(EvaluationOutcome.fail("role", role_err))
             return None
@@ -507,7 +551,8 @@ class Evaluator:
                 node.add(EvaluationOutcome.ok(
                     f"reserve:{rs_id}", "reusing existing reservation"))
                 continue
-            profile_err = _profile_shortfall(rs.volumes, agent)
+            profile_err = index.profile_shortfall(
+                (id(pod), rs_id), rs.volumes, agent)
             if profile_err is not None:
                 node.add(EvaluationOutcome.fail(f"volumes:{rs_id}",
                                                 profile_err))
@@ -555,7 +600,8 @@ class Evaluator:
                     f"reserve:{POD_VOLUME_SET_ID}",
                     "reusing existing pod-volume reservation"))
             else:
-                profile_err = _profile_shortfall(pod.volumes, agent)
+                profile_err = index.profile_shortfall(
+                    (id(pod), POD_VOLUME_SET_ID), pod.volumes, agent)
                 if profile_err is not None:
                     node.add(EvaluationOutcome.fail("volumes:pod",
                                                     profile_err))
@@ -617,8 +663,10 @@ class Evaluator:
         if requirement.pod_instance.index == 0:
             coordinator = agent.hostname
         else:
-            rec = next((t for t in tasks
-                        if t.pod_type == pod.type and t.pod_index == 0), None)
+            getter = getattr(tasks, "coordinator", None)
+            rec = getter(pod.type) if getter is not None else next(
+                (t for t in tasks
+                 if t.pod_type == pod.type and t.pod_index == 0), None)
             if rec is None:
                 # no fabricated fallback address: fail the match so the step
                 # retries after instance 0 lands and its record is stored

@@ -59,6 +59,18 @@ class ReservationLedger:
     scheduler (rebuild-on-restart, like the reference re-reading TaskInfos)."""
 
     def __init__(self, reservations: Iterable[Reservation] = ()):
+        # bumped on every mutation; the evaluator's AgentIndex keys its
+        # headroom buckets on this so a launch/unreserve invalidates them
+        self.generation = 0
+        # change log: (post-bump generation, agent_id) per mutation, capped
+        # — lets the AgentIndex re-bucket only the agents whose headroom
+        # actually moved instead of rebuilding O(agents) per launch. The
+        # floor marks where trimmed entries make the log unanswerable;
+        # over-reporting an agent is harmless, under-reporting is the
+        # correctness hazard.
+        self._change_log: list = []
+        self._change_floor = 0
+        self._change_log_cap = 4096
         self._by_key: Dict[Tuple[str, str], Reservation] = {}
         # per-agent index: the evaluator consults availability for every
         # (candidate step x agent) pair, so a flat scan of all
@@ -99,7 +111,31 @@ class ReservationLedger:
         agg = self._agg.get(agent_id)
         return (0.0, 0, 0, 0) if agg is None else tuple(agg)
 
+    def _log_changed(self, agent_ids) -> None:
+        gen = self.generation
+        self._change_log.extend((gen, a) for a in agent_ids)
+        overflow = len(self._change_log) - self._change_log_cap
+        if overflow > 0:
+            self._change_floor = max(self._change_floor,
+                                     self._change_log[overflow - 1][0])
+            del self._change_log[:overflow]
+
+    def agents_changed_since(self, generation: int):
+        """Agent ids whose reservations moved after ``generation`` (a past
+        value of ``self.generation``), or None when the log can't answer
+        (trimmed past the floor) and the caller must rebuild. May
+        over-report; never under-reports."""
+        if generation < self._change_floor:
+            return None
+        out = set()
+        for g, a in reversed(self._change_log):  # gen-sorted: tail walk
+            if g <= generation:
+                break
+            out.add(a)
+        return out
+
     def add(self, reservation: Reservation) -> None:
+        self.generation += 1
         old = self._by_key.get(reservation.key)
         if old is not None:
             self._by_agent.get(old.agent_id, {}).pop(old.key, None)
@@ -111,14 +147,22 @@ class ReservationLedger:
         self._by_pod.setdefault(reservation.pod_instance_name,
                                 {})[reservation.key] = reservation
         self._agg_apply(reservation, +1)
+        touched = {reservation.agent_id}
+        if old is not None:
+            touched.add(old.agent_id)
+        self._log_changed(touched)
 
     def remove_pod(self, pod_instance_name: str) -> list[Reservation]:
         """Unreserve everything a pod instance holds (replace/decommission)."""
         removed = list(self._by_pod.pop(pod_instance_name, {}).values())
+        if removed:
+            self.generation += 1
         for r in removed:
             del self._by_key[r.key]
             self._by_agent.get(r.agent_id, {}).pop(r.key, None)
             self._agg_apply(r, -1)
+        if removed:
+            self._log_changed({r.agent_id for r in removed})
         return removed
 
     # -- availability ------------------------------------------------------

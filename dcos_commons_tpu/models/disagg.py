@@ -361,17 +361,37 @@ class DisaggCoordinator:
     donation-based engine; sender threads do HTTP + numpy framing
     only. ``run()`` wraps iterations in the gang driver's crash
     discipline: on an engine error every in-flight request fails fast
-    and the engine resets."""
+    and the engine resets.
 
-    def __init__(self, engine, frontend, peer: Optional[str],
+    ``peer`` may be a single URL or a comma-separated list (the
+    ``SERVE_PEER`` convention): requests round-robin across the
+    healthy peers, a fetch failure marks that peer down and the
+    request tries the NEXT peer before degrading to the co-located
+    path, and a down peer rejoins the rotation once its
+    ``/v1/healthz`` probe answers (re-probed at most every
+    ``health_recheck_s``)."""
+
+    def __init__(self, engine, frontend, peer,
                  shipper: Optional[KVShipper] = None,
                  max_intake: int = 4, decode_window: int = 8,
                  max_inflight: int = 8, transfer_workers: int = 2,
                  idle_sleep_s: float = 0.005,
-                 colocated_fallback: bool = True):
+                 colocated_fallback: bool = True,
+                 health_recheck_s: float = 5.0):
         self.engine = engine
         self.frontend = frontend
-        self.peer = peer or None
+        if isinstance(peer, str):
+            self.peers = [p.strip() for p in peer.split(",") if p.strip()]
+        elif peer:
+            self.peers = [str(p).strip() for p in peer if str(p).strip()]
+        else:
+            self.peers = []
+        # single-peer compat: existing callers and receipts read .peer
+        self.peer = self.peers[0] if self.peers else None
+        self.health_recheck_s = health_recheck_s
+        self._peer_lock = threading.Lock()
+        self._rr = 0
+        self._peer_down: Dict[str, float] = {}  # peer -> monotonic mark
         self.shipper = shipper if shipper is not None else KVShipper()
         self.max_intake = max(1, max_intake)
         self.decode_window = max(1, decode_window)
@@ -397,17 +417,68 @@ class DisaggCoordinator:
 
     # ------------------------------------------------------ sender pool
 
+    def _probe_healthz(self, peer: str) -> bool:
+        try:
+            req = urllib.request.Request(peer.rstrip("/") + "/v1/healthz")
+            with _transport_urlopen(req, timeout=5.0) as r:
+                return bool(json.loads(r.read()).get("ok"))
+        except Exception:
+            return False
+
+    def _mark_down(self, peer: str) -> None:
+        with self._peer_lock:
+            self._peer_down[peer] = time.monotonic()
+
+    def _peer_ok(self, peer: str) -> bool:
+        """True when the peer is in rotation. A down peer stays out
+        until the recheck window elapses AND its healthz probe (done
+        here, outside the lock) answers ok."""
+        with self._peer_lock:
+            marked = self._peer_down.get(peer)
+            if marked is None:
+                return True
+            if time.monotonic() - marked < self.health_recheck_s:
+                return False
+        if self._probe_healthz(peer):
+            with self._peer_lock:
+                self._peer_down.pop(peer, None)
+            return True
+        self._mark_down(peer)
+        return False
+
+    def _peer_order(self) -> List[str]:
+        """Healthy peers in round-robin order for one request."""
+        with self._peer_lock:
+            n = len(self.peers)
+            if n == 0:
+                return []
+            start = self._rr % n
+            self._rr += 1
+            ordered = self.peers[start:] + self.peers[:start]
+        return [p for p in ordered if self._peer_ok(p)]
+
     def _sender_loop(self) -> None:
         while not self._stop.is_set():
             try:
                 pending = self._send_q.get(timeout=0.1)
             except queue.Empty:
                 continue
-            try:
-                span = self.shipper.fetch(self.peer, pending.prompt)
+            last_err = "no healthy prefill peer"
+            sent = False
+            # peer-by-peer: only after every healthy peer refused does
+            # the request degrade to the co-located path
+            for peer in self._peer_order():
+                try:
+                    span = self.shipper.fetch(peer, pending.prompt)
+                except Exception as e:
+                    last_err = str(e)
+                    self._mark_down(peer)
+                    continue
                 self._arrivals.put((span, pending))
-            except Exception as e:
-                self._failed.put((pending, str(e)))
+                sent = True
+                break
+            if not sent:
+                self._failed.put((pending, last_err))
 
     def _dec_outstanding(self) -> None:
         with self._count_lock:
@@ -483,7 +554,7 @@ class DisaggCoordinator:
         budget = min(self.max_intake, max(0, room))
         for pending in fe.drain_intake(budget):
             worked = True
-            if self.peer is None:
+            if not self.peers:
                 self.peer_fallbacks += 1
                 self._admit_colocated(pending)
                 continue
@@ -542,8 +613,12 @@ class DisaggCoordinator:
     def stats(self) -> Dict[str, Any]:
         with self._count_lock:
             outstanding = self._outstanding
+        with self._peer_lock:
+            down = sorted(self._peer_down)
         return {
             "peer": self.peer,
+            "peers": list(self.peers),
+            "peers_down": down,
             "spans_shipped": self.shipper.spans_shipped,
             "kv_bytes_shipped": self.shipper.bytes_shipped,
             "transfer_stalls": self.transfer_stalls,

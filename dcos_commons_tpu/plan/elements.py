@@ -23,11 +23,29 @@ from .strategy import SerialStrategy, Strategy
 
 
 class Element:
-    """Reference ``scheduler/plan/Element.java``."""
+    """Reference ``scheduler/plan/Element.java``.
+
+    Every element carries a monotone ``version`` that its mutators bump —
+    and the bump walks the ``_parent`` chain to the root, so an ancestor's
+    version stamps the state of its whole subtree. Aggregate views
+    (parent status, eligible candidates, dirty assets, rendered HTTP
+    bodies) cache against it: a 10k-step plan whose steps didn't change
+    this cycle answers ``status``/``candidates`` without re-walking the
+    tree. Mutation stays single-threaded (scheduler cycle thread), like
+    the reference; the version is read, not locked.
+    """
 
     def __init__(self, name: str):
         self.name = name
         self.errors: List[str] = []
+        self.version = 0
+        self._parent: Optional["Element"] = None
+
+    def _bump(self) -> None:
+        node: Optional[Element] = self
+        while node is not None:
+            node.version += 1
+            node = node._parent
 
     @property
     def status(self) -> Status:
@@ -117,19 +135,23 @@ class ActionStep(Step):
             done = self._action()
         except Exception as e:  # noqa: BLE001 — surfaced as plan error
             self.errors.append(f"{self.name}: {e}")
+            self._bump()
             return False
         self.errors.clear()
         self._status = Status.COMPLETE if done else Status.PREPARED
+        self._bump()
         return done
 
     def restart(self) -> None:
         """Operator recovery path: clears ERROR state so the action retries."""
         self.errors.clear()
         self._status = Status.PENDING
+        self._bump()
 
     def force_complete(self) -> None:
         self.errors.clear()
         self._status = Status.COMPLETE
+        self._bump()
 
 
 class DeploymentStep(Step):
@@ -182,10 +204,13 @@ class DeploymentStep(Step):
         delay = max((self._backoff.delay_remaining(t) for t in self._task_status),
                     default=0.0)
         if delay > 0:
-            self._status = Status.DELAYED
+            if self._status is not Status.DELAYED:
+                self._status = Status.DELAYED
+                self._bump()
             return None
         if self._status is Status.DELAYED:
             self._status = Status.PENDING
+            self._bump()
         return self.requirement
 
     def on_launch(self, task_name_to_id: Dict[str, str]) -> None:
@@ -196,12 +221,15 @@ class DeploymentStep(Step):
                 self._task_status[task_name] = Status.STARTING
                 self._backoff.on_launch(task_name)
         self._recompute()
+        self._bump()
 
     def on_no_match(self, reason: str) -> None:
         # stays PENDING; the reason is surfaced in the plan view (the
         # reference DeploymentStep's getMessage) and the outcome tracker
         # keeps the full per-agent breakdown at /v1/debug/offers
-        self._last_no_match = reason
+        if reason != self._last_no_match:
+            self._last_no_match = reason
+            self._bump()  # the rendered step body changed
 
     def mark_prepared(self) -> None:
         """Kill-before-relaunch issued; awaiting terminal statuses before the
@@ -209,6 +237,7 @@ class DeploymentStep(Step):
         the step launches on a later cycle)."""
         if self._status in (Status.PENDING, Status.DELAYED):
             self._status = Status.PREPARED
+            self._bump()
 
     # -- status feed --------------------------------------------------------
 
@@ -237,10 +266,15 @@ class DeploymentStep(Step):
             return
         if self._task_status.get(task_name) is Status.COMPLETE and new is not Status.COMPLETE:
             # regressions of completed tasks are recovery's business, not the
-            # deploy step's (reference keeps completed steps complete)
+            # deploy step's (reference keeps completed steps complete) — and
+            # no bump: a completed deploy step absorbing churn statuses must
+            # stay cache-transparent, or fleet churn would re-walk the plan
             return
+        if self._task_status.get(task_name) is new:
+            return  # no observable change; keep ancestor caches warm
         self._task_status[task_name] = new
         self._recompute()
+        self._bump()
 
     def _task_for_id(self, task_id: str) -> Optional[str]:
         for name, tid in self._launched.items():
@@ -273,11 +307,13 @@ class DeploymentStep(Step):
         for t in self._task_status:
             self._task_status[t] = Status.PENDING
         self._launched.clear()
+        self._bump()
 
     def force_complete(self) -> None:
         self._status = Status.COMPLETE
         for t in self._task_status:
             self._task_status[t] = Status.COMPLETE
+        self._bump()
 
     def to_dict(self) -> dict:
         d = super().to_dict()
@@ -289,30 +325,70 @@ class DeploymentStep(Step):
 
 
 class ParentElement(Element):
-    """Reference ``scheduler/plan/ParentElement.java`` + ``Interruptible``."""
+    """Reference ``scheduler/plan/ParentElement.java`` + ``Interruptible``.
+
+    Aggregate status and the eligible-candidate list are cached against
+    the element's version (bumped transitively by any descendant's
+    mutator), so a subtree that didn't change since the last cycle
+    answers in O(1) — in particular, completed phases are skipped
+    wholesale. One documented consequence: a RandomStrategy's shuffle is
+    frozen between mutations instead of re-rolled every call.
+    """
 
     def __init__(self, name: str, children: Sequence[Element],
                  strategy: Optional[Strategy] = None):
         super().__init__(name)
         self.children = list(children)
-        self.strategy = strategy or SerialStrategy()
+        for c in self.children:
+            c._parent = self
         self._interrupted = False
+        self._agg_cache: Optional[tuple] = None       # (cache key, Status)
+        self._cand_cache: Optional[tuple] = None      # (cache key, [Step])
+        self.strategy = strategy or SerialStrategy()
+
+    @property
+    def strategy(self) -> Strategy:
+        return self._strategy
+
+    @strategy.setter
+    def strategy(self, strategy: Strategy) -> None:
+        # swapping the strategy object (``phase.strategy = CanaryStrategy()``)
+        # changes reachability: stamp the owner backpointer (so a direct
+        # ``strategy.proceed()`` invalidates ancestor caches) and bump
+        self._strategy = strategy
+        strategy._owner = self
+        self._bump()
+
+    def _cache_key(self) -> tuple:
+        # the strategy's own version guards against a shared strategy object
+        # whose owner backpointer was re-stamped onto another element
+        strategy = self._strategy
+        return (self.version, id(strategy), strategy.version)
 
     @property
     def status(self) -> Status:
+        key = self._cache_key()
+        cached = self._agg_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
         if self.errors:
-            return Status.ERROR
-        return aggregate(
-            (c.status for c in self.children),
-            interrupted=(self._interrupted
-                         or self.strategy.is_interrupted(self.children)))
+            out = Status.ERROR
+        else:
+            out = aggregate(
+                (c.status for c in self.children),
+                interrupted=(self._interrupted
+                             or self.strategy.is_interrupted(self.children)))
+        self._agg_cache = (key, out)
+        return out
 
     def interrupt(self) -> None:
         self._interrupted = True
+        self._bump()
 
     def proceed(self) -> None:
         self._interrupted = False
         self.strategy.proceed()
+        self._bump()
 
     @property
     def interrupted(self) -> bool:
@@ -326,18 +402,29 @@ class ParentElement(Element):
         for c in self.children:
             c.force_complete()
 
-    def candidates(self, dirty_assets: Iterable[str]) -> List[Step]:
+    def _eligible_steps(self) -> List[Step]:
+        """Steps the strategy would offer now, BEFORE dirty-asset
+        filtering (dirty sets vary per caller; eligibility doesn't) —
+        cached against this subtree's version."""
         if self._interrupted:
             return []
-        dirty = set(dirty_assets)
+        key = self._cache_key()
+        cached = self._cand_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
         out: List[Step] = []
         for child in self.strategy.candidates(self.children):
             if isinstance(child, ParentElement):
-                out.extend(child.candidates(dirty))
-            elif isinstance(child, Step):
-                if child.is_eligible and (child.asset is None or child.asset not in dirty):
-                    out.append(child)
+                out.extend(child._eligible_steps())
+            elif isinstance(child, Step) and child.is_eligible:
+                out.append(child)
+        self._cand_cache = (key, out)
         return out
+
+    def candidates(self, dirty_assets: Iterable[str]) -> List[Step]:
+        dirty = set(dirty_assets)
+        return [s for s in self._eligible_steps()
+                if s.asset is None or s.asset not in dirty]
 
     def to_dict(self) -> dict:
         d = super().to_dict()
@@ -361,13 +448,20 @@ class Plan(ParentElement):
                  strategy: Optional[Strategy] = None):
         super().__init__(name, phases, strategy)
         self._status_index = None  # built lazily on first status
+        self._dirty_cache: Optional[tuple] = None  # (version, frozenset)
 
     def invalidate_status_routing(self) -> None:
         """MUST be called by any code that mutates the plan's phase/step
         tree in place (recovery and decommission regenerate phases on a
         long-lived plan object) — the routing index is otherwise cached
-        for the plan's lifetime."""
+        for the plan's lifetime. Also re-stamps the children's parent
+        pointers and bumps the plan version, so every version-keyed
+        aggregate (status, candidates, dirty assets, rendered snapshots)
+        sees the new tree."""
         self._status_index = None
+        for c in self.children:
+            c._parent = self
+        self._bump()
 
     @property
     def phases(self) -> List[Phase]:
@@ -391,6 +485,10 @@ class Plan(ParentElement):
             index: Dict[str, List[Step]] = {}
             broadcast: List[Step] = []
             for step in self.steps:
+                if type(step).update_status is Step.update_status:
+                    # never overridden (ActionStep): delivering is a no-op,
+                    # keep it out of the broadcast hot path entirely
+                    continue
                 names = step.status_task_names()
                 if names is None:
                     broadcast.append(step)
@@ -409,6 +507,13 @@ class Plan(ParentElement):
 
     def dirty_assets(self) -> set[str]:
         """Assets of steps currently doing work (reference
-        ``DefaultPlanCoordinator`` collects these across plans)."""
-        return {s.asset for s in self.steps
-                if s.asset is not None and s.status.running}
+        ``DefaultPlanCoordinator`` collects these across plans) — cached
+        against the plan version so an idle plan answers in O(1) instead
+        of re-walking every step each cycle."""
+        cached = self._dirty_cache
+        if cached is not None and cached[0] == self.version:
+            return set(cached[1])
+        out = {s.asset for s in self.steps
+               if s.asset is not None and s.status.running}
+        self._dirty_cache = (self.version, frozenset(out))
+        return out

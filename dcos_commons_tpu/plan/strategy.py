@@ -22,6 +22,20 @@ if TYPE_CHECKING:
 
 
 class Strategy:
+    #: bumped whenever the strategy's own gating state changes (canary
+    #: proceeds); parents key their candidate caches on this so a direct
+    #: ``strategy.proceed()`` call invalidates without an element bump
+    version = 0
+    #: the ParentElement currently using this strategy (stamped on attach);
+    #: lets a direct ``strategy.proceed()`` invalidate ancestor caches too
+    _owner = None
+
+    def _bump(self) -> None:
+        self.version += 1
+        owner = self._owner
+        if owner is not None:
+            owner._bump()
+
     def candidates(self, elements: Sequence["Element"]) -> List["Element"]:
         raise NotImplementedError
 
@@ -87,6 +101,7 @@ class CanaryStrategy(Strategy):
 
     def proceed(self) -> None:
         self._proceeds += 1
+        self._bump()
 
     def candidates(self, elements):
         if self._proceeds == 0 or not elements:

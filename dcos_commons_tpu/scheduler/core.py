@@ -29,7 +29,7 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from ..agent.client import AgentClient
-from ..agent.inventory import TaskRecord
+from ..agent.inventory import TaskRecord, TaskRecords
 from ..config.updater import (DEFAULT_VALIDATORS, ConfigurationUpdater,
                               UpdateResult, tls_requires_auth)
 from ..matching.evaluator import (DEFAULT_TLD, Evaluator, LaunchPlan,
@@ -111,6 +111,12 @@ class ServiceScheduler:
         self.uninstall_mode = uninstall
         # TaskRecord view cached against StateStore.tasks_generation
         self._task_records_cache = None
+        # generation-stamped API read path (http/snapshot.py): pod/plan
+        # queries serve rendered bodies without touching scheduler locks;
+        # run_cycle pre-warms them so steady-state requests are cache hits
+        from ..http.snapshot import PlanSnapshot, PodStatusSnapshot
+        self.pod_snapshot = PodStatusSnapshot(self.state)
+        self.plan_snapshot = PlanSnapshot()
         # per-cycle memo of role_usage_supplier() (reset each cycle and
         # after every launch within a cycle)
         self._quota_usage_memo = None
@@ -502,6 +508,12 @@ class ServiceScheduler:
                         and self.deploy_manager.plan.status is Status.COMPLETE
                         and not self.state.deploy_completed()):
                     self.state.set_deploy_completed()
+            # pre-warm the API snapshots off the request path: HTTP reads
+            # between cycles then serve fully-built caches (they still
+            # catch up on-read, so this is latency hiding, not freshness)
+            self.pod_snapshot.refresh()
+            for plan in self.plans:
+                self.plan_snapshot.render(plan)
             return actions
 
     def _expands_footprint(self, requirement) -> bool:
@@ -652,23 +664,47 @@ class ServiceScheduler:
             attributes=dict(plan.agent.attributes),
         )
 
-    def _task_records(self) -> List[TaskRecord]:
-        # derived view cached against the task-set generation (rebuilt
-        # only when a task is stored/deleted, not every cycle)
+    @staticmethod
+    def _record_of(task) -> TaskRecord:
+        return TaskRecord(
+            task_name=task.task_name, pod_type=task.pod_type,
+            pod_index=task.pod_index, agent_id=task.agent_id,
+            hostname=task.hostname, zone=task.zone, region=task.region,
+            permanently_failed=task.permanently_failed,
+            attributes=task.attributes)
+
+    def _task_records(self) -> TaskRecords:
+        # derived view cached against the task-set generation. A stale
+        # cache usually means a handful of launches since the last call
+        # (every launch mid-cycle bumps the generation), so the change log
+        # drives an O(dirty) patch of the SAME indexed snapshot — the
+        # matcher keeps same-cycle visibility of freshly launched siblings
+        # (gang coordinator discovery) without the per-candidate O(fleet)
+        # rebuild that used to dominate the cycle profile. Capture the
+        # statuses generation BEFORE reading: a write landing mid-build
+        # then over-reports into the next patch, never under-reports.
+        sgen = self.state.statuses_generation
         gen = self.state.tasks_generation
         cached = self._task_records_cache
         if cached is not None and cached[0] == gen:
-            return list(cached[1])  # defensive copy, like fetch_tasks
-        out = []
-        for task in self.state.fetch_tasks():
-            out.append(TaskRecord(
-                task_name=task.task_name, pod_type=task.pod_type,
-                pod_index=task.pod_index, agent_id=task.agent_id,
-                hostname=task.hostname, zone=task.zone, region=task.region,
-                permanently_failed=task.permanently_failed,
-                attributes=task.attributes))
-        self._task_records_cache = (gen, out)
-        return list(out)
+            return cached[2]
+        changed = (self.state.changed_since(cached[1])
+                   if cached is not None else None)
+        if changed is not None:
+            out = cached[2]
+            updates, deletes = [], []
+            for name in changed:
+                task = self.state.fetch_task(name)
+                if task is None:
+                    deletes.append(name)
+                else:
+                    updates.append(self._record_of(task))
+            out.patch(updates, deletes)
+        else:
+            out = TaskRecords(self._record_of(task)
+                              for task in self.state.fetch_tasks())
+        self._task_records_cache = (gen, sgen, out)
+        return out
 
     # -- operator verbs ----------------------------------------------------
 

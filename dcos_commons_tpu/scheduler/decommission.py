@@ -8,7 +8,7 @@ unreserve-per-agent -> deregister), ``UninstallScheduler.java``.
 
 from __future__ import annotations
 
-from typing import Callable, List
+from typing import Callable
 
 from ..plan.elements import ActionStep, Phase, Plan
 from ..plan.manager import PlanManager
@@ -86,21 +86,56 @@ class DecommissionPlanManager(PlanManager):
     def __init__(self, scheduler):
         super().__init__(Plan(DECOMMISSION_PLAN_NAME, [], ParallelStrategy()))
         self._scheduler = scheduler
+        # (spec, statuses_gen, excess pod names) of the last sweep — the
+        # excess verdict only moves with a task write (or a spec swap, which
+        # changes pod counts), so steady-state cycles re-check only pods
+        # named by StateStore.changed_since plus the current excess set
+        self._excess_state = None
 
     def get_candidates(self, dirty_assets):
         self._update_plan()
         return super().get_candidates(dirty_assets)
 
-    def _update_plan(self) -> None:
+    def _find_excess(self) -> set:
         spec: ServiceSpec = self._scheduler.spec
+        state = self._scheduler.state
+        gen = state.statuses_generation
+        prev = self._excess_state
+        changed = (state.changed_since(prev[1])
+                   if prev is not None and prev[0] is spec else None)
         pods_by_type = {p.type: p for p in spec.pods}
-        excess: List[str] = []
-        for task in self._scheduler.state.fetch_tasks():
+
+        def is_excess(task) -> bool:
             pod = pods_by_type.get(task.pod_type)
-            if pod is None or task.pod_index >= pod.count:
-                excess.append(task.pod_instance_name)
-        excess_sorted = sorted(set(excess),
+            return pod is None or task.pod_index >= pod.count
+
+        if changed is None:
+            excess = {t.pod_instance_name
+                      for t in state.fetch_tasks() if is_excess(t)}
+        else:
+            excess = set(prev[2])
+            if changed or excess:
+                by_pod = state.fetch_tasks_by_pod()
+                recheck = set(excess)  # erased tasks may empty a bucket
+                for name in changed:
+                    t = state.fetch_task(name)
+                    if t is not None:
+                        recheck.add(t.pod_instance_name)
+                    # a deleted task can't be excess, and deleting one
+                    # never makes a non-excess pod excess; excess pods
+                    # losing tasks are in the re-check set already
+                for pod_name in recheck:
+                    if any(is_excess(t) for t in by_pod.get(pod_name, ())):
+                        excess.add(pod_name)
+                    else:
+                        excess.discard(pod_name)
+        self._excess_state = (spec, gen, frozenset(excess))
+        return excess
+
+    def _update_plan(self) -> None:
+        excess_sorted = sorted(self._find_excess(),
                                key=lambda n: -int(n.rsplit("-", 1)[1]))
+        old_children = list(self._plan.children)
         # prune completed/stale phases; keep in-flight ones
         existing = {}
         for phase in self._plan.phases:
@@ -113,8 +148,10 @@ class DecommissionPlanManager(PlanManager):
                 self._scheduler, name, "decommission")
             for name in excess_sorted
         ] or list(existing.values())
-        # the phase tree changed shape: statuses must re-route
-        self._plan.invalidate_status_routing()
+        if self._plan.children != old_children:  # element identity
+            # the phase tree changed shape: statuses must re-route; a
+            # no-op regeneration must not invalidate the plan caches
+            self._plan.invalidate_status_routing()
 
 
 def build_uninstall_plan(scheduler) -> Plan:

@@ -126,9 +126,11 @@ class RecoveryPlanManager(PlanManager):
         self._monitor = failure_monitor or NeverFailureMonitor()
         self._backoff = backoff
         self._overriders = list(overriders)
-        # (spec, tasks_gen, statuses_gen) of the last scan that found
-        # nothing failing — see _find_failed_pods
-        self._empty_scan_key = None
+        # (spec, statuses_gen, failing-map) of the last completed scan —
+        # lets the next scan re-examine only pods with writes since (via
+        # StateStore.changed_since) plus the previously-failing set, see
+        # _find_failed_pods
+        self._scan_state = None
 
     # -- plan regeneration --------------------------------------------------
 
@@ -144,6 +146,7 @@ class RecoveryPlanManager(PlanManager):
         spec = self._spec_supplier()
         failures = self._find_failed_pods(spec)
 
+        old_children = list(self._plan.children)
         kept = []
         for phase in self._plan.phases:
             if phase.status is Status.COMPLETE:
@@ -176,33 +179,60 @@ class RecoveryPlanManager(PlanManager):
             if any(s.asset in existing_assets for s in phase.steps if s.asset):
                 continue
             self._plan.children.append(phase)
-        # the phase tree changed shape: statuses must re-route
-        self._plan.invalidate_status_routing()
+        if self._plan.children != old_children:  # element identity
+            # the phase tree changed shape: statuses must re-route (and
+            # version-keyed caches invalidate). A no-op regeneration —
+            # the healthy steady state — must NOT invalidate, or every
+            # cycle would re-walk the plan.
+            self._plan.invalidate_status_routing()
 
     def _find_failed_pods(self, spec: ServiceSpec
                           ) -> Dict[str, tuple[PodInstance, RecoveryType]]:
         """Reference ``getNewFailedPods`` (``DefaultRecoveryPlanManager.java:
         286-358``): scan stored statuses, group by pod instance, classify.
 
-        Healthy steady state skips the scan entirely: when a prior scan at
-        the SAME task+status generations found nothing, nothing can have
-        started failing since (every failure path writes a status or task
-        record). Only the empty verdict is cached — a non-empty one must
-        re-scan every cycle because time-based monitors
-        (``TimedFailureMonitor``) escalate classifications without any new
-        write."""
-        key = (spec, self._state.tasks_generation,
-               self._state.statuses_generation)
-        prev = self._empty_scan_key
-        # spec compared by IDENTITY (and kept referenced by the cache so the
-        # id can't be recycled): a config update swaps the spec object and
-        # can change pod counts — which changes the verdict — without any
-        # task/status write
-        if prev is not None and prev[0] is key[0] and prev[1:] == key[1:]:
-            return {}
-        out: Dict[str, tuple[PodInstance, RecoveryType]] = {}
+        Incremental: a verdict can only change for a pod with a task/status
+        write since the last scan (``StateStore.changed_since``) or one
+        already failing (time-based monitors escalate without any new
+        write), so only those pods are re-classified — the healthy steady
+        state at 10k tasks pays O(dirty), not O(fleet). Falls back to the
+        full scan when the change log can't answer or the spec object was
+        swapped (spec compared by IDENTITY, and kept referenced by the
+        cache so the id can't be recycled: a config update can change pod
+        counts — which changes the verdict — without any write)."""
+        gen = self._state.statuses_generation
+        prev = self._scan_state
+        changed = (self._state.changed_since(prev[1])
+                   if prev is not None and prev[0] is spec else None)
         pods_by_type = {p.type: p for p in spec.pods}
-        for task in self._state.fetch_tasks():
+        if changed is None:
+            out = self._classify(self._state.fetch_tasks(), pods_by_type)
+        else:
+            prev_failing: Dict[str, tuple] = prev[2]
+            recheck = set(prev_failing)
+            for name in changed:
+                task = self._state.fetch_task(name)
+                if task is not None:
+                    recheck.add(task.pod_instance_name)
+                # a DELETED task can't need recovery, and deletion alone
+                # never flips a healthy pod to failing — previously-failing
+                # pods are already in the re-check set
+            by_pod = self._state.fetch_tasks_by_pod()
+            out = dict(prev_failing)
+            for pod_name in recheck:
+                out.pop(pod_name, None)
+                out.update(self._classify(by_pod.get(pod_name, ()),
+                                          pods_by_type))
+        # stamp the PRE-scan generation: escalation writes inside the scan
+        # bump it, and the next cycle's changed_since then re-checks those
+        # pods — which is correct (superset re-checks are always safe)
+        self._scan_state = (spec, gen, dict(out))
+        return out
+
+    def _classify(self, tasks, pods_by_type
+                  ) -> Dict[str, tuple[PodInstance, RecoveryType]]:
+        out: Dict[str, tuple[PodInstance, RecoveryType]] = {}
+        for task in tasks:
             pod = pods_by_type.get(task.pod_type)
             if pod is None or task.pod_index >= pod.count:
                 continue  # decommission's business, not recovery's
@@ -221,13 +251,9 @@ class RecoveryPlanManager(PlanManager):
                 # this pod see a replace, not a pinned relaunch
                 self._state.store_tasks([task.failed_permanently()])
             pod_instance = PodInstance(pod, task.pod_index)
-            prev = out.get(pod_instance.name)
-            if prev is None or recovery is RecoveryType.PERMANENT:
+            seen = out.get(pod_instance.name)
+            if seen is None or recovery is RecoveryType.PERMANENT:
                 out[pod_instance.name] = (pod_instance, recovery)
-        # cache the empty verdict at the key we scanned (escalation inside
-        # the loop bumps the generation, making the key stale — which is
-        # correct: the next cycle must re-scan)
-        self._empty_scan_key = key if not out else None
         return out
 
     def _phase_for(self, spec: ServiceSpec, pod_instance: PodInstance,

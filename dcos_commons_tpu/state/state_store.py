@@ -126,11 +126,29 @@ class StateStore:
         # writer (single-writer lease on the replicated backend; flock on
         # files; per-service namespacing in multi).
         self._tasks_gen = 0
-        self._tasks_cache: Optional[tuple[int, list]] = None
+        # (tasks_gen, statuses_gen at build, name -> StoredTask); the
+        # statuses generation rides along so a later miss can ask the
+        # change log for the dirty names and re-read ONLY those
+        self._tasks_cache: Optional[tuple[int, int, dict]] = None
+        self._task_names_cache: Optional[tuple[int, list]] = None
+        self._tasks_by_pod_cache: Optional[tuple[int, dict]] = None
         # statuses generation: bumped on ANY task or status write — lets
         # per-cycle scans (recovery's failed-pod sweep) skip re-deriving
         # "nothing changed" verdicts
         self._status_gen = 0
+        self._statuses_cache: Optional[tuple[int, dict]] = None
+        # change log: (statuses_generation-after-bump, task_name) per
+        # write, capped — lets per-cycle consumers (recovery scan, HTTP
+        # snapshots) ask "which tasks changed since generation G?" and
+        # re-derive only those instead of re-walking the fleet. The floor
+        # is the generation below which the log is incomplete (trimmed,
+        # or invalidated wholesale by refresh_cache): changed_since()
+        # answers None there and the caller falls back to a full scan.
+        # Over-reporting a name is harmless (callers re-examine it);
+        # UNDER-reporting is the correctness hazard, hence the floor.
+        self._change_log: list[tuple[int, str]] = []
+        self._change_floor = 0
+        self._change_log_cap = 4096
         # guards generation bumps and cache publication: HTTP handler
         # threads read (and refresh) through this store while the
         # scheduler thread writes — unsynchronized `+= 1` can lose an
@@ -139,6 +157,38 @@ class StateStore:
 
     def _path(self, *parts: str) -> str:
         return self._ns + "/".join(parts)
+
+    def _log_changed_locked(self, names: Iterable[str]) -> None:
+        """Record task names touched by the bump that just advanced
+        ``_status_gen`` (caller holds ``_cache_lock``, AFTER the bump so
+        the entries carry the post-write generation)."""
+        gen = self._status_gen
+        self._change_log.extend((gen, n) for n in names)
+        overflow = len(self._change_log) - self._change_log_cap
+        if overflow > 0:
+            # trimmed entries are no longer answerable: raise the floor
+            # to the newest dropped generation so changed_since() below
+            # it reports "don't know" instead of under-reporting
+            self._change_floor = max(self._change_floor,
+                                     self._change_log[overflow - 1][0])
+            del self._change_log[:overflow]
+
+    def changed_since(self, generation: int) -> Optional[set[str]]:
+        """Task names written (task/status/delete) after ``generation``
+        (a past value of ``statuses_generation``), or None when the log
+        can't answer (generation predates the floor — trimmed entries,
+        an out-of-band refresh, or a different store incarnation) and the
+        caller must do a full scan. The result may over-report — callers
+        re-examine each name — but never under-reports."""
+        with self._cache_lock:
+            if generation < self._change_floor:
+                return None
+            out: set[str] = set()
+            for g, n in reversed(self._change_log):  # gen-sorted: tail walk
+                if g <= generation:
+                    break
+                out.add(n)
+            return out
 
     def _parse(self, path: str, raw: bytes, parser):
         hit = self._parse_cache.get(path)
@@ -164,6 +214,7 @@ class StateStore:
     def store_tasks(self, tasks: Iterable[StoredTask]) -> None:
         """Reference ``storeTasks:213`` — atomic multi-write (the launch WAL:
         called before the agent is instructed to launch)."""
+        tasks = list(tasks)
         self._persister.set_many({
             self._path(self.TASKS, _esc(t.task_name), self.TASK_INFO): t.to_json()
             for t in tasks})
@@ -174,6 +225,7 @@ class StateStore:
         with self._cache_lock:
             self._tasks_gen += 1
             self._status_gen += 1
+            self._log_changed_locked(t.task_name for t in tasks)
 
     def fetch_task(self, task_name: str) -> Optional[StoredTask]:
         path = self._path(self.TASKS, _esc(task_name), self.TASK_INFO)
@@ -183,28 +235,76 @@ class StateStore:
         return self._parse(path, raw, StoredTask.from_json)
 
     def fetch_task_names(self) -> list[str]:
-        try:
-            return self._persister.get_children(self._path(self.TASKS).rstrip("/"))
-        except NotFoundError:
-            return []
-
-    def fetch_tasks(self) -> list[StoredTask]:
-        # capture the generation BEFORE reading: a write landing mid-build
-        # then leaves our list stamped with the pre-write generation, which
-        # the writer's bump has already invalidated
+        # cached against the task-set generation: the name listing is a
+        # full persister get_children — several consumers per cycle
+        # (statuses, recovery, GC) each used to pay it at fleet size
         gen = self._tasks_gen
-        cached = self._tasks_cache
+        cached = self._task_names_cache
         if cached is not None and cached[0] == gen:
             return list(cached[1])
-        out = []
-        for name in self.fetch_task_names():
-            t = self.fetch_task(name)
-            if t is not None:
-                out.append(t)
+        try:
+            names = self._persister.get_children(
+                self._path(self.TASKS).rstrip("/"))
+        except NotFoundError:
+            names = []
         with self._cache_lock:
             if self._tasks_gen == gen:  # never publish a stale build
-                self._tasks_cache = (gen, out)
-        return list(out)
+                self._task_names_cache = (gen, names)
+        return list(names)
+
+    def fetch_tasks(self) -> list[StoredTask]:
+        return list(self._tasks_map().values())
+
+    def _tasks_map(self) -> dict[str, StoredTask]:
+        # capture the generations BEFORE reading: a write landing
+        # mid-build then leaves our map stamped with the pre-write
+        # generation, which the writer's bump has already invalidated
+        with self._cache_lock:
+            gen, sgen = self._tasks_gen, self._status_gen
+        cached = self._tasks_cache
+        if cached is not None and cached[0] == gen:
+            return cached[2]
+        # a stale cache usually means a handful of launches/deletes, not
+        # a different fleet: re-read only the change-log names (every
+        # task write logs its name), falling back to the full walk only
+        # when the log can't answer
+        changed = self.changed_since(cached[1]) if cached is not None \
+            else None
+        if changed is None:
+            out: dict[str, StoredTask] = {}
+            for name in self.fetch_task_names():
+                t = self.fetch_task(name)
+                if t is not None:
+                    out[name] = t
+        else:
+            out = dict(cached[2])
+            for name in changed:
+                t = self.fetch_task(name)
+                if t is None:
+                    out.pop(name, None)
+                else:
+                    out[name] = t
+        with self._cache_lock:
+            if self._tasks_gen == gen:  # never publish a stale build
+                self._tasks_cache = (gen, sgen, out)
+        return out
+
+    def fetch_tasks_by_pod(self) -> dict[str, list[StoredTask]]:
+        """Stored tasks grouped by pod instance name, cached against the
+        task-set generation — pod-scoped consumers (recovery's per-pod
+        re-check, the pod HTTP queries) read one bucket instead of
+        filtering the fleet. Callers must not mutate the buckets."""
+        gen = self._tasks_gen
+        cached = self._tasks_by_pod_cache
+        if cached is not None and cached[0] == gen:
+            return cached[1]
+        by_pod: dict[str, list[StoredTask]] = {}
+        for t in self.fetch_tasks():
+            by_pod.setdefault(t.pod_instance_name, []).append(t)
+        with self._cache_lock:
+            if self._tasks_gen == gen:
+                self._tasks_by_pod_cache = (gen, by_pod)
+        return by_pod
 
     def store_status(self, task_name: str, status: TaskStatus) -> bool:
         """Reference ``storeStatus:257`` — validates the status belongs to the
@@ -228,6 +328,7 @@ class StateStore:
         self._persister.set(path, raw)
         with self._cache_lock:
             self._status_gen += 1  # after the write; see store_tasks
+            self._log_changed_locked((task_name,))
         return True
 
     def fetch_status(self, task_name: str) -> Optional[TaskStatus]:
@@ -238,12 +339,35 @@ class StateStore:
         return self._parse(path, raw, TaskStatus.from_json)
 
     def fetch_statuses(self) -> dict[str, TaskStatus]:
-        out = {}
-        for name in self.fetch_task_names():
-            s = self.fetch_status(name)
-            if s is not None:
-                out[name] = s
-        return out
+        # cached against the statuses generation — previously every call
+        # paid a full persister listing plus N status reads even when
+        # nothing had changed since the last cycle
+        gen = self._status_gen
+        cached = self._statuses_cache
+        if cached is not None and cached[0] == gen:
+            return dict(cached[1])
+        # same incremental discipline as _tasks_map: re-read only the
+        # change-log names; a full walk only when the log can't answer
+        changed = self.changed_since(cached[0]) if cached is not None \
+            else None
+        if changed is None:
+            out = {}
+            for name in self.fetch_task_names():
+                s = self.fetch_status(name)
+                if s is not None:
+                    out[name] = s
+        else:
+            out = dict(cached[1])
+            for name in changed:
+                s = self.fetch_status(name)
+                if s is None:
+                    out.pop(name, None)
+                else:
+                    out[name] = s
+        with self._cache_lock:
+            if self._status_gen == gen:  # never publish a stale build
+                self._statuses_cache = (gen, out)
+        return dict(out)
 
     def delete_task(self, task_name: str) -> None:
         """Reference ``clearTask`` — used by decommission/replace GC."""
@@ -258,6 +382,7 @@ class StateStore:
         with self._cache_lock:
             self._tasks_gen += 1  # after the delete; see store_tasks
             self._status_gen += 1
+            self._log_changed_locked((task_name,))
 
     # -- goal overrides (pause/resume) -------------------------------------
 
@@ -266,6 +391,12 @@ class StateStore:
         self._persister.set(
             self._path(self.TASKS, _esc(task_name), self.OVERRIDE),
             json.dumps({"override": override.value, "progress": progress.value}).encode())
+        with self._cache_lock:
+            # an override is observable per-task state (the pod-status
+            # snapshot renders it): it must move the status generation so
+            # generation-keyed consumers notice
+            self._status_gen += 1
+            self._log_changed_locked((task_name,))
 
     def fetch_override(self, task_name: str) -> tuple[GoalOverride, OverrideProgress]:
         raw = self._persister.get_or_none(
@@ -311,8 +442,15 @@ class StateStore:
         with self._cache_lock:
             self._parse_cache.clear()
             self._tasks_cache = None
+            self._task_names_cache = None
+            self._tasks_by_pod_cache = None
+            self._statuses_cache = None
             self._tasks_gen += 1
             self._status_gen += 1
+            # out-of-band edits may have touched anything: the log can no
+            # longer answer for generations at or before this point
+            self._change_log.clear()
+            self._change_floor = self._status_gen
 
     def delete_all(self) -> None:
         for child in (self.TASKS, self.PROPERTIES):

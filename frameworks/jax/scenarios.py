@@ -55,7 +55,9 @@ DEFAULT_ENV: Mapping[str, str] = {
     # disaggregated prefill/decode tiers (disagg.yml + models/disagg.py):
     # SERVE_ROLE picks the tier a replica runs (colocated|prefill|decode)
     # and SERVE_PEER points a decode replica at its prefill tier's
-    # /v1/prefill endpoint (from `tpuctl endpoints serve`; empty degrades
+    # /v1/prefill endpoint (from `tpuctl endpoints serve`; a comma-
+    # separated list round-robins across prefill peers with per-peer
+    # /v1/healthz fallback; empty degrades
     # loudly to co-located serving). DISAGG_PAGES sizes the tiers' page
     # pools (-1 = auto slot-equivalent) — disagg is paged-only, so the
     # yml does not inherit the co-located SERVE_PAGES=0 default.

@@ -505,7 +505,8 @@ def _serve_disagg(args, cfg, params, mesh, result) -> bool:
     with open("serving.ready", "w") as f:
         f.write(f"ok {frontend.port}\n")
     _emit({"event": "serving", "role": "decode", "port": frontend.port,
-           "peer": peer, "paged": page_stats, **result})
+           "peer": peer, "peers": coord.peers, "paged": page_stats,
+           **result})
     i = 0
     while True:
         time.sleep(args.serve_interval)
@@ -891,8 +892,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-peer",
                    default=os.environ.get("SERVE_PEER", ""),
                    help="llama --serve --serve-role decode: prefill "
-                        "tier base URL (http[s]://host:port, from the "
-                        "scheduler's endpoints surface). Empty "
+                        "tier base URL(s) (http[s]://host:port, from "
+                        "the scheduler's endpoints surface; "
+                        "comma-separated for multiple peers — "
+                        "round-robin with /v1/healthz-gated "
+                        "per-peer fallback). Empty "
                         "degrades loudly to co-located serving "
                         "(disagg_fallback)")
     p.add_argument("--attn", default="auto",

@@ -17,6 +17,13 @@ python -m tools.chaos_smoke --budget-s "${CHAOS_SMOKE_BUDGET_S:-60}"
 echo "== serving smoke (paged vs slot parity + two-process disagg, time-capped) =="
 python -m tools.serving_smoke --budget-s "${SERVING_SMOKE_BUDGET_S:-120}"
 
+echo "== control-plane smoke (steady-state cycle budget under churn) =="
+# observed p50 ~6.4ms at fleet 500; the pin is ~12x that so only an
+# O(fleet) regression (not CI-host noise) trips it
+timeout -k 10 "${CONTROL_PLANE_SMOKE_TIMEOUT_S:-300}" \
+    python -m tools.bench_scheduler --fleet 500 --churn \
+    --assert-cycle-ms "${CONTROL_PLANE_CYCLE_BUDGET_MS:-75}"
+
 echo "== test suite =="
 python -m pytest tests/ -q -m "not soak" "$@"
 

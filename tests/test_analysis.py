@@ -194,6 +194,40 @@ class TestSpecRules:
         assert lint_spec(make_spec(
             [make_pod(env={"TP": "0", "SP": ""})])) == []
 
+    def test_s7_super_linear_plan_work(self, monkeypatch):
+        # 50 phases x (50 phases x 100 instances) steps = 250_000 work
+        # units over a budget of 1000: a spec whose every cycle walks
+        # a multiplicative phase x step product must die at lint time
+        monkeypatch.setenv("TPU_PLAN_WORK_BUDGET", "1000")
+        pod = make_pod(count=100, chips=0)
+        plan = PlanSpecModel("rollout", phases=tuple(
+            PhaseSpec(f"wave-{i}", "worker") for i in range(50)))
+        found = lint_spec(make_spec([pod], plans=(plan,)))
+        assert codes(found) == ["S7"]
+        assert "5000 steps x 50 phases" in found[0].message
+        # same shape under the budget is clean
+        monkeypatch.setenv("TPU_PLAN_WORK_BUDGET", "1000000")
+        assert lint_spec(make_spec([pod], plans=(plan,))) == []
+
+    def test_s7_linear_fleet_is_clean(self):
+        # a big fleet in a handful of phases is the design target, not
+        # a finding: 10k steps x 2 phases stays under the default budget
+        pod = make_pod(count=10_000, chips=0)
+        plan = PlanSpecModel("deploy", phases=(
+            PhaseSpec("canary", "worker", steps=()),
+            PhaseSpec("rest", "worker", steps=()),))
+        assert lint_spec(make_spec([pod], plans=(plan,))) == []
+
+    def test_s7_suppression_and_explicit_steps(self, monkeypatch):
+        monkeypatch.setenv("TPU_PLAN_WORK_BUDGET", "10")
+        pod = make_pod(count=4, chips=0)
+        plan = PlanSpecModel("rollout", phases=tuple(
+            PhaseSpec(f"p{i}", "worker") for i in range(4)))
+        found = lint_spec(make_spec([pod], plans=(plan,)))
+        assert codes(found) == ["S7"]
+        assert lint_spec(make_spec([pod], plans=(plan,)),
+                         suppress={"S7"}) == []
+
     def test_lint_spec_suppression(self):
         plan = PlanSpecModel("deploy", phases=(
             PhaseSpec("a", "worker", deps=("a",)),))

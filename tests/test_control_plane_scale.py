@@ -1,0 +1,201 @@
+"""Tier-1 receipts for the incremental control plane (PR 9).
+
+The steady-state contract: a cycle costs O(dirty work), not O(fleet).
+These tests pin that down where a benchmark can't — by *counting* the
+work units (persister reads, deploy-plan steps visited) at two fleet
+sizes and asserting the counts track the dirty set, plus the
+snapshot-API consistency guarantee under concurrent status ingest that
+the lock-free HTTP path relies on.
+"""
+
+import random
+import threading
+
+from dcos_commons_tpu.agent.fake import FakeCluster
+from dcos_commons_tpu.agent.inventory import AgentInfo, PortRange
+from dcos_commons_tpu.http.queries import PlanQueries, PodQueries
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.plan.elements import DeploymentStep
+from dcos_commons_tpu.scheduler import ServiceScheduler
+from dcos_commons_tpu.specification import load_service_yaml_str
+from dcos_commons_tpu.state import MemPersister
+from dcos_commons_tpu.state.tasks import TaskState
+
+
+class CountingPersister(MemPersister):
+    """MemPersister that counts reads — the regression meter for
+    ``fetch_statuses()``/``fetch_task_names()`` full-listing bugs: a
+    warm scheduler cycle with a K-task dirty set must do O(K) reads,
+    never an O(fleet) re-listing."""
+
+    def __init__(self):
+        super().__init__()
+        self.reads = 0
+
+    def get(self, path):
+        self.reads += 1
+        return super().get(path)
+
+    def get_children(self, path):
+        self.reads += 1
+        return super().get_children(path)
+
+
+def _yml(n):
+    return f"""
+name: bench
+pods:
+  web:
+    count: {n}
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: run
+        cpus: 0.1
+        memory: 32
+plans:
+  deploy:
+    strategy: parallel
+    phases:
+      web-deploy:
+        pod: web
+        strategy: parallel
+"""
+
+
+def _deployed(n):
+    """A fleet of ``n`` web pods deployed to COMPLETE over a counting
+    persister, caches warm (one quiet cycle run after the ramp)."""
+    agents = [AgentInfo(agent_id=f"a{i}", hostname=f"h{i}", cpus=64,
+                        memory_mb=262144, disk_mb=1 << 20,
+                        ports=(PortRange(1025, 32000),))
+              for i in range(max(1, n // 10))]
+    cluster = FakeCluster(agents)
+    persister = CountingPersister()
+    sched = ServiceScheduler(load_service_yaml_str(_yml(n), {}),
+                             persister, cluster)
+    sched.cycle_batch_size = 512
+    for _ in range(10 * n + 100):
+        sched.run_cycle()
+        if sched.plan("deploy").status is Status.COMPLETE:
+            break
+    assert sched.plan("deploy").status is Status.COMPLETE
+    sched.cycle_batch_size = type(sched).cycle_batch_size
+    sched.run_cycle()  # warm every generation-keyed cache
+    return sched, cluster, persister
+
+
+def _crash(cluster, rng, k):
+    live = cluster.live_tasks()
+    victims = rng.sample(live, k)
+    for t in victims:
+        cluster.send_status(t.task_id, TaskState.FAILED, message="churn")
+    return victims
+
+
+class TestCycleCostScaling:
+    def test_quiet_cycle_reads_are_constant(self):
+        """No dirty work -> near-zero persister reads, independent of
+        fleet size (the fetch_statuses full-listing regression guard)."""
+        reads = {}
+        for n in (100, 1000):
+            sched, _, persister = _deployed(n)
+            before = persister.reads
+            sched.run_cycle()
+            reads[n] = persister.reads - before
+        # a quiet cycle may touch a handful of bookkeeping keys, but
+        # never one per task
+        assert reads[100] < 50, reads
+        assert reads[1000] < 50, reads
+        assert reads[1000] <= reads[100] + 10, reads
+
+    def test_persister_reads_track_dirty_set_not_fleet(self):
+        """Crashing K tasks costs O(K) reads at 100 and at 1000 tasks:
+        the 10x fleet pays no more than a constant extra."""
+        K = 5
+        reads = {}
+        for n in (100, 1000):
+            sched, cluster, persister = _deployed(n)
+            rng = random.Random(7)
+            _crash(cluster, rng, K)
+            before = persister.reads
+            sched.run_cycle()   # ingest FAILED, recovery relaunches
+            sched.run_cycle()   # ingest RUNNING from the relaunches
+            reads[n] = persister.reads - before
+        assert reads[100] < 80 * K, reads
+        assert reads[1000] <= reads[100] + 40, reads
+
+    def test_steps_visited_track_dirty_set_not_fleet(self, monkeypatch):
+        """Status routing and candidate selection visit the dirty
+        steps, not the whole 1000-step deploy plan."""
+        K = 5
+        visits = {}
+        counted = {"n": 0}
+        orig = DeploymentStep.update_status
+
+        def counting(self, status):
+            counted["n"] += 1
+            return orig(self, status)
+
+        monkeypatch.setattr(DeploymentStep, "update_status", counting)
+        for n in (100, 1000):
+            sched, cluster, persister = _deployed(n)
+            rng = random.Random(7)
+            _crash(cluster, rng, K)
+            counted["n"] = 0
+            sched.run_cycle()
+            sched.run_cycle()
+            visits[n] = counted["n"]
+        # each crash surfaces a FAILED + a relaunch RUNNING status (plus
+        # recovery-plan steps); none of it scales with the fleet
+        assert visits[100] <= 12 * K, visits
+        assert visits[1000] <= visits[100] + 10, visits
+
+
+class TestSnapshotConsistency:
+    def test_pod_snapshot_under_concurrent_ingest(self):
+        """The HTTP pod surface stays well-formed and lock-free-fresh
+        while statuses land concurrently, and converges to the state
+        store once the storm stops."""
+        sched, cluster, _ = _deployed(60)
+        pods = PodQueries(sched)
+        plans = PlanQueries(sched)
+        stop = threading.Event()
+        errors = []
+
+        def storm():
+            rng = random.Random(3)
+            try:
+                while not stop.is_set():
+                    _crash(cluster, rng, 2)
+                    sched.run_cycle()
+            except Exception as e:  # surfaced below
+                errors.append(e)
+
+        th = threading.Thread(target=storm, daemon=True)
+        th.start()
+        valid_states = {s.value for s in TaskState} | {"NO_STATUS"}
+        try:
+            for _ in range(60):
+                body = pods.status_all()
+                for pod_body in body["pods"]:
+                    assert pod_body["name"].startswith("web-")
+                    for t in pod_body["tasks"]:
+                        assert t["name"], t
+                        assert t["status"] in valid_states, t
+                one = pods.status("web-0")
+                assert one["name"] == "web-0"
+                _, plan_body = plans.get("deploy")
+                assert plan_body["name"] == "deploy"
+        finally:
+            stop.set()
+            th.join(timeout=30)
+        assert not errors, errors
+        sched.run_until_quiet()
+        # converged: snapshot bodies now mirror the state store exactly
+        body = pods.status("web-0")
+        for t in body["tasks"]:
+            st = sched.state.fetch_status(t["name"])
+            assert t["status"] == (st.state.value if st else "NO_STATUS")
+            rec = sched.state.fetch_task(t["name"])
+            assert t["agentId"] == (rec.agent_id if rec else None)

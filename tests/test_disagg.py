@@ -345,6 +345,53 @@ class TestCoordinator:
             fe.stop()
         assert engine.ledger_violations() == []
 
+    def test_peer_list_parsing(self):
+        """SERVE_PEER may be one URL, a comma-separated list (blanks
+        dropped), or empty; ``.peer`` stays the single-peer compat
+        view."""
+        coord = DisaggCoordinator(None, None, "http://a:1, ,http://b:2,")
+        assert coord.peers == ["http://a:1", "http://b:2"]
+        assert coord.peer == "http://a:1"
+        assert DisaggCoordinator(None, None, None).peers == []
+        assert DisaggCoordinator(None, None, "").peer is None
+        assert DisaggCoordinator(
+            None, None, ["http://a:1", "http://b:2"]).peers == [
+                "http://a:1", "http://b:2"]
+
+    def test_multi_peer_skips_dead_peer_before_degrading(self):
+        """With a peer list, a dead peer is tried and dropped from
+        rotation (peers_down) while the request ships through the
+        next peer — no co-located degrade, exact parity."""
+        cfg = _cfg()
+        params = llama.init_params(cfg, jax.random.key(0))
+        pre_engine, dec_engine = _pair(cfg, params)
+        worker = PrefillWorker(pre_engine, port=0,
+                               host="127.0.0.1").start()
+        fe = ServingFrontend(dec_engine, port=0, host="127.0.0.1")
+        fe.start(drive=False)
+        dead = "http://127.0.0.1:9"  # discard port: refuses instantly
+        coord = DisaggCoordinator(
+            dec_engine, fe, f"{dead}, http://127.0.0.1:{worker.port}",
+            decode_window=4).start()
+        try:
+            prompts = [_prompt(300 + i, 9 + 4 * i, cfg.vocab_size)
+                       for i in range(2)]
+            for p in prompts:
+                status, body = _post(fe.port, {"prompt": p,
+                                               "max_new": 5})
+                assert status == 200, body
+                assert body["tokens"] == _solo(cfg, params, p, 5)
+            st = coord.stats()
+            assert st["spans_shipped"] == 2
+            assert st["peer_fallbacks"] == 0
+            assert dead in st["peers_down"]
+        finally:
+            coord.stop()
+            fe.stop()
+            worker.stop()
+        assert pre_engine.ledger_violations() == []
+        assert dec_engine.ledger_violations() == []
+
     def test_prefill_worker_http_contract(self):
         """The prefill front door: healthz reports the tier role, a
         good post returns a verifiable frame, garbage is a 400."""

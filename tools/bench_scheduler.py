@@ -20,10 +20,21 @@ Prints one JSON line::
     {"metric": "deploy_pods_per_sec", "pods": 100, "seconds": ...,
      "pods_per_sec": ..., "cycles": ...}
 
+A third mode, ``--fleet``, measures the *steady state* instead of the
+deploy ramp: deploy N pods to COMPLETE (uncounted), then time individual
+``run_cycle()`` calls while a fixed, fleet-size-independent amount of
+churn lands each tick — task crashes, an agent flap, and chaos-engine
+status weather (dup/reorder via :class:`ChaosCluster`). Because the dirty
+set per tick is constant, cycle time under ``--fleet 1000`` vs ``--fleet
+10000`` directly exposes whether the control plane pays O(dirty work) or
+O(fleet) per cycle — the receipt for the incremental-cycle work
+(``bench_r9/control_plane.jsonl``).
+
 Usage::
 
     python -m tools.bench_scheduler [--pods 100] [--tpu]
     python -m tools.bench_scheduler --live [--pods 500] [--agents 200]
+    python -m tools.bench_scheduler --fleet 10000 --churn [--variant indexed]
 """
 
 from __future__ import annotations
@@ -379,6 +390,150 @@ pods:
     }
 
 
+def _pct(seq, q: float) -> float:
+    return seq[min(len(seq) - 1, int(q * len(seq)))] if seq else 0.0
+
+
+def run_steady_state(fleet: int, churn: bool = False, cycles: int = 40,
+                     seed: int = 0, variant: str = "main",
+                     deploy_batch: int = 256) -> dict:
+    """Steady-state cycle cost at fleet scale, with constant-size churn.
+
+    Deploys ``fleet`` web pods over a FakeCluster (uncounted warmup, run
+    with a large candidate batch so the ramp is quick at 10k), then
+    measures ``cycles`` individual ``run_cycle()`` wall times while each
+    tick injects a FIXED amount of work regardless of fleet size:
+
+    * ``CRASHES_PER_TICK`` random live tasks FAIL (recovery relaunches),
+    * every 4th tick one agent flaps (leaves + returns; its tasks FAIL),
+    * with ``churn``, statuses route through a seeded :class:`ChaosCluster`
+      armed with dup/reorder weather — the status-storm shape.
+
+    The dirty set per tick being constant is the point: a control plane
+    paying O(dirty) per cycle shows flat cycle times across the 1k/5k/10k
+    sweep; one paying O(fleet) grows linearly.
+    """
+    from dcos_commons_tpu.agent.fake import FakeCluster
+    from dcos_commons_tpu.agent.inventory import AgentInfo, PortRange
+    from dcos_commons_tpu.chaos.engine import ChaosCluster, FaultConfig
+    from dcos_commons_tpu.plan import Status
+    from dcos_commons_tpu.scheduler import ServiceScheduler
+    from dcos_commons_tpu.specification import load_service_yaml_str
+    from dcos_commons_tpu.state import MemPersister
+    from dcos_commons_tpu.state.tasks import TaskState
+    import random
+
+    CRASHES_PER_TICK = 8
+    FLAP_EVERY = 4
+
+    n = fleet
+    yml = f"""
+name: bench
+pods:
+  web:
+    count: {n}
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: run
+        cpus: 0.1
+        memory: 32
+        ports:
+          http: {{port: 0}}
+plans:
+  deploy:
+    strategy: parallel
+    phases:
+      web-deploy:
+        pod: web
+        strategy: parallel
+"""
+    agent_infos = [AgentInfo(agent_id=f"a{i}", hostname=f"h{i}", cpus=64,
+                             memory_mb=262144, disk_mb=1 << 20,
+                             ports=(PortRange(1025, 32000),))
+                   for i in range(max(1, n // 10))]
+    inner = FakeCluster(agent_infos)
+    rng = random.Random(seed)
+    # weather stays disarmed through the deploy ramp (nothing ticks the
+    # chaos clock there, so held statuses would never release); the churn
+    # loop arms it right before the measured window
+    cluster = ChaosCluster(inner, rng=rng if churn else None,
+                           config=FaultConfig.none())
+    sched = ServiceScheduler(load_service_yaml_str(yml, {}), MemPersister(),
+                             cluster)
+
+    # warmup: deploy the whole fleet (big batches — the ramp is not what
+    # this mode measures; identical treatment for every variant)
+    sched.cycle_batch_size = max(32, deploy_batch)
+    t0 = time.perf_counter()
+    deploy_cycles = 0
+    while sched.plan("deploy").status is not Status.COMPLETE:
+        sched.run_cycle()
+        deploy_cycles += 1
+        if deploy_cycles > 10 * n + 100:
+            raise SystemExit(
+                f"deploy did not complete in {deploy_cycles} cycles: "
+                f"{sched.plan('deploy').status}")
+    deploy_s = time.perf_counter() - t0
+    sched.cycle_batch_size = type(sched).cycle_batch_size  # measurement uses the real batch size
+
+    def crash_some() -> None:
+        live = inner.live_tasks()
+        for t in rng.sample(live, min(CRASHES_PER_TICK, len(live))):
+            inner.send_status(t.task_id, TaskState.FAILED, message="churn")
+
+    def flap_agent() -> None:
+        info = rng.choice(agent_infos)
+        lost = inner.remove_agent(info.agent_id)
+        inner.add_agent(info)
+        # the flap's task deaths surface as FAILED statuses (the agent
+        # came back without them); without this, a FakeCluster run would
+        # need reconcile-grace machinery the bench isn't measuring
+        for t in lost:
+            inner.send_status(t.task_id, TaskState.FAILED,
+                              message="agent flap")
+
+    times: list = []
+    launches_before = len(inner.launch_log)
+    if churn:
+        cluster.config = FaultConfig.only("status_dup", "status_reorder",
+                                          p=0.05)
+    t_window = time.perf_counter()
+    for i in range(cycles):
+        if churn:
+            crash_some()
+            if i % FLAP_EVERY == 0:
+                flap_agent()
+            cluster.tick()
+        t1 = time.perf_counter()
+        sched.run_cycle()
+        times.append(time.perf_counter() - t1)
+    window_s = time.perf_counter() - t_window
+    churned = len(inner.launch_log) - launches_before
+    # settle so the run ends healthy (held weather lands, recovery drains)
+    cluster.config = FaultConfig.none()
+    cluster.flush()
+    sched.run_until_quiet()
+
+    ts = sorted(times)
+    return {
+        "metric": "steady_state_cycle",
+        "variant": variant,
+        "fleet": n,
+        "agents": len(agent_infos),
+        "churn": bool(churn),
+        "seed": seed,
+        "cycles": cycles,
+        "crashes_per_tick": CRASHES_PER_TICK if churn else 0,
+        "deploy_seconds": round(deploy_s, 3),
+        "cycle_mean_ms": round(sum(ts) / len(ts) * 1e3, 2),
+        "cycle_p50_ms": round(_pct(ts, 0.50) * 1e3, 2),
+        "cycle_p90_ms": round(_pct(ts, 0.90) * 1e3, 2),
+        "cycle_max_ms": round((ts[-1] if ts else 0) * 1e3, 2),
+        "churn_pods_per_sec": round(churned / window_s, 1) if churn else 0.0,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--pods", type=int, default=100)
@@ -395,10 +550,40 @@ def main(argv=None) -> int:
                         "--pods 64 --agents 64 for the v5e-256 shape)")
     p.add_argument("--poll-interval", type=float, default=1.0,
                    help="agent poll cadence for --live (reference: 1 Hz)")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="steady-state mode: deploy N pods (uncounted), "
+                        "then time cycles under constant-size churn")
+    p.add_argument("--churn", action="store_true",
+                   help="--fleet: inject task crashes, agent flap, and "
+                        "chaos status weather each measured tick")
+    p.add_argument("--cycles", type=int, default=40,
+                   help="--fleet: measured steady-state cycles")
+    p.add_argument("--seed", type=int, default=0,
+                   help="--fleet: churn RNG seed")
+    p.add_argument("--variant", default="main",
+                   help="--fleet: label stamped into the receipt row "
+                        "(A/B: 'main' vs 'indexed')")
+    p.add_argument("--assert-cycle-ms", type=float, default=0.0,
+                   help="--fleet: fail (exit 1) if the steady-state p50 "
+                        "cycle time exceeds this budget — the CI smoke "
+                        "gate against control-plane regressions")
     args = p.parse_args(argv)
     if args.live:
         return run_live(args.pods, args.agents, args.poll_interval,
                         gang=args.gang)
+    if args.fleet:
+        row = run_steady_state(args.fleet, churn=args.churn,
+                               cycles=args.cycles, seed=args.seed,
+                               variant=args.variant)
+        print(json.dumps(row))
+        if args.assert_cycle_ms and row["cycle_p50_ms"] > args.assert_cycle_ms:
+            print(json.dumps({
+                "error": "steady-state cycle budget exceeded",
+                "cycle_p50_ms": row["cycle_p50_ms"],
+                "budget_ms": args.assert_cycle_ms,
+            }))
+            return 1
+        return 0
     print(json.dumps(run_inprocess(args.pods, tpu=args.tpu)))
     return 0
 
