@@ -63,6 +63,13 @@ class FakeCluster:
         self.destroyed_volumes: List[tuple] = []
         # pre-degrade TPU inventory per agent, restored by heal_tpu
         self._healthy_tpu: Dict[str, object] = {}
+        # opt-in SIGTERM modeling (elastic soak / preemption tests): a
+        # kill WITH a grace period parks the task in _term_pending — it
+        # keeps running until the harness calls finish_graceful_kill
+        # (clean checkpoint-flush exit) or a grace-0 kill escalates.
+        # Default off: every existing test keeps instant KILLED kills.
+        self.graceful_kills = False
+        self._term_pending: Dict[str, float] = {}  # task_id -> grace_s
 
     # -- test scripting ----------------------------------------------------
 
@@ -117,6 +124,7 @@ class FakeCluster:
         lost = [t for t in self._tasks.values() if t.agent_id == agent_id]
         for t in lost:
             del self._tasks[t.task_id]
+            self._term_pending.pop(t.task_id, None)
         return lost
 
     def task(self, task_name: str) -> Optional[FakeTask]:
@@ -133,6 +141,9 @@ class FakeCluster:
             task.state = state
             if state.terminal:
                 del self._tasks[task_id]
+                # a task that died any other way (crash, agent op) while
+                # TERM-pending can no longer answer its SIGTERM
+                self._term_pending.pop(task_id, None)
         if self._callback is not None:
             self._callback(task_name, TaskStatus.now(
                 task_id, state, message=message,
@@ -178,9 +189,35 @@ class FakeCluster:
 
     def kill(self, agent_id: str, task_id: str, grace_period_s: float = 0.0) -> None:
         self._kill_log.append(task_id)
-        if task_id in self._tasks:
-            self.send_status(task_id, TaskState.KILLED, message="killed by scheduler")
-        # unknown task: nothing to do; scheduler already considers it dead
+        if task_id not in self._tasks:
+            return  # unknown task: scheduler already considers it dead
+        if self.graceful_kills and grace_period_s > 0:
+            # SIGTERM delivered: the task is now draining/flushing. A
+            # repeat TERM while pending is idempotent (schedulers re-fire
+            # kill steps every cycle until the terminal status lands).
+            self._term_pending.setdefault(task_id, grace_period_s)
+            return
+        escalated = self._term_pending.pop(task_id, None) is not None
+        self.send_status(task_id, TaskState.KILLED,
+                         message="killed by scheduler (grace expired)"
+                         if escalated else "killed by scheduler")
+
+    def pending_term_tasks(self) -> List[str]:
+        """Task ids holding a delivered-but-unanswered SIGTERM, sorted
+        (harness drives their flush via :meth:`finish_graceful_kill`)."""
+        return sorted(t for t in self._term_pending if t in self._tasks)
+
+    def finish_graceful_kill(self, task_id: str, message: str =
+                             "exit 143: checkpoint flushed") -> bool:
+        """The task answered its SIGTERM: checkpoint flushed, clean exit
+        143 (the sentinel contract, ``frameworks/jax/sentinel.py``).
+        Returns False if the task was not TERM-pending (already escalated,
+        crashed, or its agent vanished)."""
+        if self._term_pending.pop(task_id, None) is None \
+                or task_id not in self._tasks:
+            return False
+        self.send_status(task_id, TaskState.KILLED, message=message)
+        return True
 
     def destroy_volumes(self, agent_id: str, pod_instance_name: str) -> None:
         self.destroyed_volumes.append((agent_id, pod_instance_name))

@@ -56,6 +56,15 @@ S7 = REGISTRY.register(Rule(
     "bounds the per-cycle routing fan-out), raise TPU_PLAN_WORK_BUDGET, "
     "or suppress S7 if the fleet really is that large",
     default_severity=Severity.ERROR))
+S8 = REGISTRY.register(Rule(
+    "S8", "spec", "priority set but no checkpoint/sentinel wiring",
+    "a service with priority: participates in preemption — victims get "
+    "SIGTERM and a bounded flush grace (scheduler/elastic.py), but these "
+    "TPU tasks show no sentinel/checkpoint wiring (SENTINEL_* env or a "
+    "checkpoint path in cmd/env), so a preemption silently loses work; "
+    "wire frameworks/jax/sentinel.py's guarded_loop, or suppress S8 if "
+    "losing in-flight work is acceptable",
+    default_severity=Severity.WARNING))
 
 _PLACEHOLDER = re.compile(r"\{\{\s*([A-Za-z0-9_.-]+)\s*\}\}")
 
@@ -275,6 +284,45 @@ def _rule_s6_mesh_product(spec: ServiceSpec) -> List[Finding]:
     return out
 
 
+# evidence a task answers SIGTERM with a checkpoint flush: the sentinel's
+# env contract, or a checkpoint/restore path threaded through cmd or env
+_SENTINEL_ENV_PREFIX = "SENTINEL_"
+_CKPT_TOKENS = ("checkpoint", "ckpt")
+
+
+def _task_flush_wired(task) -> bool:
+    for key in task.env:
+        if key.startswith(_SENTINEL_ENV_PREFIX):
+            return True
+        if any(tok in key.lower() for tok in _CKPT_TOKENS):
+            return True
+    haystack = " ".join([task.cmd or "", *task.env.values()]).lower()
+    return any(tok in haystack for tok in _CKPT_TOKENS)
+
+
+def _rule_s8_priority_without_flush_wiring(spec: ServiceSpec
+                                           ) -> List[Finding]:
+    """``priority:`` opts the service into preemption arbitration. Its
+    TPU pods are eviction candidates (whole gangs, SIGTERM, bounded
+    grace); a victim task with no sentinel/checkpoint wiring just dies at
+    grace expiry and the relaunch restarts from step zero."""
+    if getattr(spec, "priority", 0) == 0:
+        return []
+    out: List[Finding] = []
+    for pod in spec.pods:
+        if not any(rs.tpus > 0 for rs in pod.resource_sets):
+            continue
+        if any(_task_flush_wired(t) for t in pod.tasks):
+            continue
+        out.append(Finding(
+            "S8", Severity.WARNING, f"pod {pod.type}",
+            f"service {spec.name} sets priority: {spec.priority} but no "
+            f"task of this TPU pod wires the preemption sentinel (no "
+            f"SENTINEL_* env, no checkpoint path in cmd/env) — a "
+            "preemption will discard its in-flight work"))
+    return out
+
+
 DEFAULT_PLAN_WORK_BUDGET = 100_000
 
 
@@ -321,6 +369,7 @@ _SPEC_RULES = (
     _rule_s5_placeholders,
     _rule_s6_mesh_product,
     _rule_s7_plan_work_budget,
+    _rule_s8_priority_without_flush_wiring,
 )
 
 

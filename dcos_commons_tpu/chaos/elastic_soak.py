@@ -1,0 +1,591 @@
+"""Seeded elastic-control-plane soak: scale events under chaos weather.
+
+Two services share one fleet through a :class:`MultiServiceScheduler`:
+
+* ``serve`` (priority 10) — a non-gang ``decode`` tier of 4-chip replicas,
+  autoscaled 1..3 by an :class:`~..scheduler.elastic.Autoscaler` off a
+  synthetic Poisson-ish load simulator's back-pressure gauges;
+* ``train`` (priority 1) — a 2x4-chip gang that backfills idle chips
+  behind a :class:`~..scheduler.elastic.BackfillGate` headroom reserve,
+  and is preempted (TERM -> flush-grace -> reclaim) by the
+  :class:`~..scheduler.elastic.Preemptor` when serving scale-up starves.
+
+The fleet is 2 CPU hosts + 4 TPU hosts x 4 chips (16 chips, one v4-16
+slice): serve@1 + train = 12 chips, so a burst that drives serve to 3
+replicas (12 chips) MUST preempt training to place — every soak run
+crosses the preemption protocol, not just the lucky seeds.
+
+On top of the legacy transport/environment weather, four scale-event
+fault classes fire between ticks (``FaultConfig.scale_up_burst``,
+``preempt_storm``, ``victim_crash_in_grace``, ``scale_mid_crash``), and
+three elastic invariants are audited every tick alongside the per-service
+:class:`InvariantChecker`: flush-grace before reclaim, priority inversion
+never persists, and no cross-service double-booking. Convergence at
+settle additionally requires the live decode fleet to match the
+controller's persisted target — the "fleet converges" invariant.
+
+Determinism contract matches ``chaos/soak.py``: one ``random.Random(seed)``
+drives the scheduler-facing weather; the load and flush simulators run on
+their own derived RNGs so arming a new fault class never perturbs the
+draw order of a pinned seed.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from ..agent.fake import FakeCluster
+from ..plan.backoff import ExponentialBackoff
+from ..plan.status import Status
+from ..scheduler.core import ServiceScheduler
+from ..scheduler.elastic import (Autoscaler, AutoscalerConfig, BackfillGate,
+                                 ElasticController, Preemptor)
+from ..scheduler.multi import MultiServiceScheduler
+from ..scheduler.recovery import AgentGoneFailureMonitor
+from ..specification.yaml_loader import load_service_yaml_str
+from ..state.persister import MemPersister
+from ..state.tasks import TaskState
+from ..testing.simulation import default_agents, tpu_slice_agents
+from .engine import ChaosCluster, FaultConfig
+from .invariants import ElasticInvariantChecker, InvariantChecker, Violation
+from .soak import SETTLE_BUDGET, SoakReport
+
+SERVE_YML = """
+name: serve
+priority: 10
+pods:
+  decode:
+    count: 1
+    tpu:
+      chips: 4
+      gang: false
+    tasks:
+      engine:
+        goal: RUNNING
+        essential: true
+        cmd: "./decode-engine"
+        cpus: 1.0
+        memory: 1024
+        tpus: 4
+"""
+
+TRAIN_YML = """
+name: train
+priority: 1
+pods:
+  learn:
+    count: 2
+    tpu:
+      chips: 4
+      topology: v4-16
+      gang: true
+    tasks:
+      trainer:
+        goal: RUNNING
+        essential: true
+        cmd: "./trainer"
+        cpus: 1.0
+        memory: 1024
+        tpus: 4
+"""
+
+MAX_AGENTS_OUT = 1  # 4 TPU hosts at full occupancy: two out would flatline
+
+AUTOSCALE = AutoscalerConfig(
+    pod_type="decode", min_count=1, max_count=3,
+    high_pressure=0.7, low_pressure=0.2,
+    debounce_ticks=2, cooldown_ticks=3)
+
+
+class _LoadSim:
+    """Synthetic serving load: a bounded queue drained at a fixed per-
+    replica rate. Quiet traffic fits one replica; a burst overwhelms it
+    (sheds) until the autoscaler grows the tier. Exposes the same gauge
+    dict shape as ``ServingFrontend.load_gauges()`` so the autoscaler's
+    ``backpressure()`` combinator runs unmodified."""
+
+    CAPACITY_PER_REPLICA = 4   # requests served per replica per tick
+    QUEUE_CAP = 16
+    WINDOW = 5                 # rolling-gauge window, ticks
+    QUIET_RATE = 2
+    BURST_RATE = 10            # > 2 replicas needed; 3 replicas absorb it
+
+    def __init__(self, seed: int):
+        self.rng = random.Random((seed << 18) ^ 0x9E3779B97F4A7C15)
+        self.queue = 0
+        self.burst_until = -1
+        self.shed_log: List[Tuple[int, int]] = []
+        self.done_log: List[Tuple[int, int]] = []
+        self.total_shed = 0
+        self.total_done = 0
+        self._now = 0
+
+    def burst(self, tick: int, duration: int) -> None:
+        self.burst_until = max(self.burst_until, tick + duration)
+
+    def tick(self, tick: int, replicas: int) -> None:
+        self._now = tick
+        rate = (self.BURST_RATE if tick < self.burst_until
+                else self.QUIET_RATE)
+        arrivals = max(0, rate + self.rng.randint(-2, 2))
+        served = min(self.queue, replicas * self.CAPACITY_PER_REPLICA)
+        self.queue -= served
+        admitted = min(arrivals, self.QUEUE_CAP - self.queue)
+        shed = arrivals - admitted
+        self.queue += admitted
+        self.total_done += served
+        self.total_shed += shed
+        if served:
+            self.done_log.append((tick, served))
+        if shed:
+            self.shed_log.append((tick, shed))
+
+    def _window_sum(self, entries: List[Tuple[int, int]]) -> int:
+        floor = self._now - self.WINDOW
+        return sum(n for t, n in entries if t > floor)
+
+    def gauges(self) -> dict:
+        return {
+            "window_s": float(self.WINDOW),
+            "queue_depth": self.queue,
+            "queue_capacity": self.QUEUE_CAP,
+            "completed": self._window_sum(self.done_log),
+            "shed": self._window_sum(self.shed_log),
+            "ttft_p95_ms": None,
+        }
+
+
+class _FlushSim:
+    """Plays the worker sentinel's side of the graceful-kill protocol:
+    every task holding a delivered-but-unanswered SIGTERM checkpoint-
+    flushes and exits 143 one or two ticks later. Training progress is a
+    per-pod-instance step counter; the flush records the checkpointed
+    step and a relaunch of that instance resumes from it — receipts for
+    the preempted-gang-resumes-from-flushed-step test."""
+
+    def __init__(self, seed: int):
+        self.rng = random.Random((seed << 22) ^ 0xB5297A4D3F84D5B5)
+        self.due: Dict[str, int] = {}           # task_id -> flush tick
+        self.steps: Dict[str, int] = {}         # pod instance -> live step
+        self.ckpt: Dict[str, int] = {}          # pod instance -> flushed step
+        self._incarnation: Dict[str, str] = {}  # pod instance -> task_id
+        self.flushes: List[Tuple[int, str, int]] = []   # (tick, inst, step)
+        self.resumes: List[Tuple[int, str, int]] = []   # (tick, inst, step)
+
+    @staticmethod
+    def _instance(task_name: str) -> str:
+        return task_name.rsplit("-", 1)[0]
+
+    def advance(self, tick: int, cluster: FakeCluster) -> None:
+        """Training steps tick forward on every live trainer; a fresh
+        incarnation of a checkpointed instance resumes from the flushed
+        step (the sentinel's restore path)."""
+        for task in cluster.live_tasks():
+            if not task.task_name.startswith("learn-"):
+                continue
+            inst = self._instance(task.task_name)
+            if self._incarnation.get(inst) != task.task_id:
+                self._incarnation[inst] = task.task_id
+                if inst in self.ckpt:
+                    self.steps[inst] = self.ckpt[inst]
+                    self.resumes.append((tick, inst, self.ckpt[inst]))
+                else:
+                    self.steps[inst] = 0
+            if task.state is TaskState.RUNNING \
+                    and task.task_id not in self.due:
+                self.steps[inst] = self.steps.get(inst, 0) + 1
+
+    def flush(self, tick: int, cluster: FakeCluster) -> List[str]:
+        """Answer due SIGTERMs; returns the task ids that exited 143."""
+        flushed = []
+        for task_id in cluster.pending_term_tasks():
+            if task_id not in self.due:
+                self.due[task_id] = tick + self.rng.randint(1, 2)
+        for task_id in sorted(self.due):
+            if self.due[task_id] > tick:
+                continue
+            del self.due[task_id]
+            task = next((t for t in cluster.live_tasks()
+                         if t.task_id == task_id), None)
+            if task is None:
+                continue  # crashed/escalated while waiting
+            inst = self._instance(task.task_name)
+            if task.task_name.startswith("learn-"):
+                step = self.steps.get(inst, 0)
+                if cluster.finish_graceful_kill(
+                        task_id,
+                        message=f"exit 143: checkpoint flushed at step "
+                                f"{step}"):
+                    self.ckpt[inst] = step
+                    self.flushes.append((tick, inst, step))
+                    flushed.append(task_id)
+            else:
+                if cluster.finish_graceful_kill(
+                        task_id, message="exit 143: drained"):
+                    flushed.append(task_id)
+        return flushed
+
+    def drop(self, task_id: str) -> None:
+        self.due.pop(task_id, None)
+
+
+class _ChildView:
+    """Runner-shaped adapter over one child service, resolved through the
+    live multi scheduler so the view survives crash-restarts."""
+
+    page_sims = ()
+
+    def __init__(self, soak: "ElasticSoak", name: str):
+        self._soak = soak
+        self.name = name
+
+    @property
+    def scheduler(self) -> ServiceScheduler:
+        return self._soak.multi.get_service(self.name)
+
+    @property
+    def cluster(self) -> FakeCluster:
+        return self._soak.cluster
+
+
+class _ChildChecker(InvariantChecker):
+    """Per-service auditor that tolerates reservations of pod instances
+    still draining through the decommission plan: a scale-down's shrunk
+    spec drops the instance immediately, but its reservation legitimately
+    survives until the kill/unreserve steps finish."""
+
+    def _check_ledger(self, tick: int) -> List[Violation]:
+        out = super()._check_ledger(tick)
+        sched = self._runner.scheduler
+        draining = {phase.name.split("-", 1)[1]
+                    for phase in sched.decommission_manager._plan.phases
+                    if phase.status is not Status.COMPLETE}
+        if not draining:
+            return out
+        return [v for v in out
+                if not (v.invariant == "ledger-orphan"
+                        and v.detail.rsplit(" ", 1)[-1] in draining)]
+
+
+class ElasticSoak:
+    """One seeded elastic schedule; ``tools/bench_autoscale.py`` drives it
+    directly (faults off, scripted bursts, ``autoscale=False`` for the
+    static-fleet baseline)."""
+
+    def __init__(self, seed: int, ticks: int, config: FaultConfig, *,
+                 autoscale: bool = True,
+                 burst_schedule: Tuple[Tuple[int, int], ...] = ()):
+        self.seed = seed
+        self.ticks = ticks
+        self.config = config
+        self.burst_schedule = dict(burst_schedule)
+        self.rng = random.Random(seed)
+        self.vtime = [0.0]
+        self.trace: List[str] = []
+        self.violations: List[Violation] = []
+        self.pending_returns: List[tuple] = []
+        self.pending_heals: List[tuple] = []
+        self.env_fault_counts: Dict[str, int] = {}
+
+        self.cluster = FakeCluster(default_agents(2)
+                                   + tpu_slice_agents(4, chips=4))
+        self.cluster.graceful_kills = True
+        self.chaos = ChaosCluster(self.cluster, self.rng, config)
+        self.persister = MemPersister()
+        self._backoffs: Dict[str, ExponentialBackoff] = {}
+        self.multi: Optional[MultiServiceScheduler] = None
+        self._build_multi()
+        self.multi.add_service(load_service_yaml_str(SERVE_YML))
+        self.multi.add_service(load_service_yaml_str(TRAIN_YML))
+
+        self.load = _LoadSim(seed)
+        self.flushsim = _FlushSim(seed)
+        self.autoscaler = Autoscaler(lambda: self.multi, "serve", AUTOSCALE,
+                                     self.load.gauges)
+        self.preemptor = Preemptor(lambda: self.multi,
+                                   grace_ticks=3, starve_ticks=2)
+        self.backfill = BackfillGate(lambda: self.multi, reserve_chips=2)
+        self.controller = ElasticController(
+            lambda: self.multi,
+            autoscalers=[self.autoscaler] if autoscale else [],
+            preemptor=self.preemptor,
+            backfill=self.backfill)
+        self.checkers = [_ChildChecker(_ChildView(self, "serve")),
+                         _ChildChecker(_ChildView(self, "train"))]
+        self.elastic_checker = ElasticInvariantChecker(self)
+
+    # -- scheduler lifecycle -----------------------------------------------
+
+    def _build_multi(self) -> None:
+        self.multi = MultiServiceScheduler(
+            self.persister, self.chaos,
+            scheduler_factory=self._make_scheduler)
+
+    def _make_scheduler(self, spec, persister, cluster, **kwargs
+                        ) -> ServiceScheduler:
+        # one backoff per service, shared across restarts (the monotone
+        # invariant is checked across the restart boundary, exactly like
+        # the single-service soak)
+        backoff = self._backoffs.get(spec.name)
+        if backoff is None:
+            backoff = self._backoffs[spec.name] = ExponentialBackoff(
+                initial_s=1.0, max_s=8.0, factor=2.0,
+                clock=lambda: self.vtime[0])
+        kwargs.setdefault("backoff", backoff)
+        kwargs.setdefault("failure_monitor", AgentGoneFailureMonitor(
+            lambda: self.cluster.agents()))
+        sched = ServiceScheduler(spec, persister, cluster, **kwargs)
+        # deterministic verdicts: no wall-clock grace
+        sched.launch_report_grace_s = 0.0
+        return sched
+
+    def _restart(self) -> None:
+        """Scheduler process death: everything in memory is gone; the new
+        multi re-mounts every service from the persisted specs (at the
+        autoscaler's latest stored target) and the controller re-attaches
+        the backfill gate to the new instance."""
+        self._build_multi()
+        self.controller.rewire()
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _log(self, msg: str) -> None:
+        self.trace.append(msg)
+
+    def _count(self, fault: str) -> None:
+        self.env_fault_counts[fault] = self.env_fault_counts.get(fault, 0) + 1
+
+    def _decode_running(self) -> int:
+        return sum(1 for t in self.cluster.live_tasks()
+                   if t.task_name.startswith("decode-")
+                   and t.state is TaskState.RUNNING)
+
+    def _train_running(self) -> int:
+        return sum(1 for t in self.cluster.live_tasks()
+                   if t.task_name.startswith("learn-")
+                   and t.state is TaskState.RUNNING)
+
+    # -- environment faults --------------------------------------------------
+
+    def _agents_out(self) -> int:
+        return len(self.pending_returns)
+
+    def _inject(self, tick: int) -> None:
+        cfg = self.config
+        rng = self.rng
+        cluster = self.cluster
+        if cfg.agent_flap and rng.random() < cfg.agent_flap \
+                and self._agents_out() < MAX_AGENTS_OUT:
+            agents = {a.agent_id: a for a in cluster.agents()}
+            victim = rng.choice(sorted(agents))
+            cluster.remove_agent(victim)
+            back = tick + rng.randint(1, 2)
+            self.pending_returns.append((back, agents[victim]))
+            self._count("agent_flap")
+            self._log(f"tick {tick}: agent_flap {victim} (back @{back})")
+        if cfg.agent_loss and rng.random() < cfg.agent_loss \
+                and self._agents_out() < MAX_AGENTS_OUT:
+            from dataclasses import replace as _dc_replace
+            victim = rng.choice(sorted(a.agent_id for a in cluster.agents()))
+            cluster.heal_tpu(victim)
+            self.pending_heals = [(t, a) for t, a in self.pending_heals
+                                  if a != victim]
+            info = {a.agent_id: a for a in cluster.agents()}[victim]
+            cluster.remove_agent(victim)
+            clone = _dc_replace(info, agent_id=f"{victim}-r{tick}",
+                                hostname=f"{info.hostname}-r{tick}")
+            back = tick + rng.randint(2, 4)
+            self.pending_returns.append((back, clone))
+            self._count("agent_loss")
+            self._log(f"tick {tick}: agent_loss {victim} "
+                      f"(replacement {clone.agent_id} @{back})")
+        if cfg.degrade and rng.random() < cfg.degrade:
+            tpu_ids = [a.agent_id for a in cluster.agents()
+                       if a.tpu.chips > 0 and not a.tpu.degraded]
+            if tpu_ids:
+                victim = rng.choice(sorted(tpu_ids))
+                chips = next(a.tpu.chips for a in cluster.agents()
+                             if a.agent_id == victim)
+                cluster.degrade_tpu(victim, chips - 1)
+                heal = tick + rng.randint(2, 4)
+                self.pending_heals.append((heal, victim))
+                self._count("degrade")
+                self._log(f"tick {tick}: degrade_tpu {victim} "
+                          f"-> {chips - 1} chips (heal @{heal})")
+        if cfg.task_crash and rng.random() < cfg.task_crash:
+            live = sorted(cluster.live_tasks(), key=lambda t: t.task_id)
+            if live:
+                victim = rng.choice(live)
+                self.flushsim.drop(victim.task_id)
+                cluster.send_status(victim.task_id, TaskState.FAILED,
+                                    message="chaos: task crash")
+                self._count("task_crash")
+                self._log(f"tick {tick}: task_crash {victim.task_name}")
+        if cfg.crash_restart and rng.random() < cfg.crash_restart:
+            self._restart()
+            self._count("crash_restart")
+            self._log(f"tick {tick}: scheduler crash-restart")
+        # -- scale-event faults --
+        if cfg.scale_up_burst and rng.random() < cfg.scale_up_burst:
+            duration = rng.randint(6, 10)
+            self.load.burst(tick, duration)
+            self._count("scale_up_burst")
+            self._log(f"tick {tick}: scale_up_burst for {duration} ticks")
+        if cfg.preempt_storm and rng.random() < cfg.preempt_storm:
+            forced = self.autoscaler.force_target(AUTOSCALE.max_count)
+            self._count("preempt_storm")
+            self._log(f"tick {tick}: preempt_storm (decode target forced "
+                      f"to {forced if forced is not None else 'max (held)'})")
+        if cfg.victim_crash_in_grace and rng.random() \
+                < cfg.victim_crash_in_grace:
+            pending = self.cluster.pending_term_tasks()
+            if pending:
+                victim = rng.choice(pending)
+                self.flushsim.drop(victim)
+                cluster.send_status(victim, TaskState.FAILED,
+                                    message="chaos: crashed during "
+                                            "flush grace")
+                self._count("victim_crash_in_grace")
+                self._log(f"tick {tick}: victim_crash_in_grace {victim}")
+        if cfg.scale_mid_crash and rng.random() < cfg.scale_mid_crash:
+            # force a resize so a scale plan is guaranteed in flight, then
+            # kill the scheduler mid-rollout; the restored plans resume it
+            current = self.autoscaler.target or AUTOSCALE.min_count
+            goal = (AUTOSCALE.max_count if current < AUTOSCALE.max_count
+                    else AUTOSCALE.min_count)
+            self.autoscaler.force_target(goal)
+            self._restart()
+            self._count("scale_mid_crash")
+            self._log(f"tick {tick}: scale_mid_crash (target {goal}, "
+                      "scheduler died mid-rollout)")
+
+    def _release_environment(self, tick: int, force: bool = False) -> None:
+        due = [(t, a) for t, a in self.pending_returns if force or t <= tick]
+        self.pending_returns = [(t, a) for t, a in self.pending_returns
+                                if not (force or t <= tick)]
+        for _, agent in due:
+            self.cluster.add_agent(agent)
+            self._log(f"tick {tick}: agent {agent.agent_id} joined")
+        live = {a.agent_id for a in self.cluster.agents()}
+        keep = []
+        for t, agent_id in self.pending_heals:
+            if (force or t <= tick) and agent_id in live:
+                self.cluster.heal_tpu(agent_id)
+                self._log(f"tick {tick}: tpu healed on {agent_id}")
+            else:
+                keep.append((t, agent_id))
+        self.pending_heals = keep
+
+    # -- phases --------------------------------------------------------------
+
+    def _check(self, tick: int) -> None:
+        found: List[Violation] = []
+        for checker in self.checkers:
+            found += checker.check(tick)
+        found += self.elastic_checker.check(tick)
+        for v in found:
+            self._log(f"VIOLATION {v}")
+        self.violations.extend(found)
+
+    def _cycle(self, tick: int) -> None:
+        self.vtime[0] += 1.0
+        if tick in self.burst_schedule:
+            self.load.burst(tick, self.burst_schedule[tick])
+        self.load.tick(tick, self._decode_running())
+        self.flushsim.advance(tick, self.cluster)
+        self.controller.tick(tick)
+        for name in self.multi.service_names():
+            sched = self.multi.get_service(name)
+            if sched is not None:
+                sched.reconcile()
+        # cluster-wide zombie cleanup (CycleDriver's periodic reconcile):
+        # a decommissioned incarnation that survived on a flapped agent is
+        # owned by no service, so only the multi-level sweep can kill it
+        self.multi.reconcile()
+
+    def _plans_complete(self) -> bool:
+        for name in self.multi.service_names():
+            sched = self.multi.get_service(name)
+            if sched is None:
+                continue
+            for plan_name in ("deploy", "recovery", "decommission"):
+                plan = sched.plan(plan_name)
+                if plan is not None and plan.status is not Status.COMPLETE:
+                    return False
+        return True
+
+    def _converged(self) -> bool:
+        """Settle-phase exit: plans quiet, transport drained, no
+        preemption mid-protocol, and the live fleet matches the elastic
+        controller's persisted targets (the fleet-convergence invariant)."""
+        return (self._plans_complete()
+                and self.chaos.pending_events == 0
+                and not self.cluster.pending_term_tasks()
+                and not self.preemptor.inflight
+                and self._decode_running() == (self.autoscaler.target or 0)
+                and self._train_running() == 2)
+
+    def run(self) -> SoakReport:
+        for tick in range(self.ticks):
+            self._release_environment(tick)
+            self._inject(tick)
+            self.flushsim.flush(tick, self.cluster)
+            self.chaos.tick()
+            self._cycle(tick)
+            self._check(tick)
+
+        # heal: weather stops, the transport drains, bursts end — the
+        # autoscaler must walk the tier back to min, training must
+        # backfill again, and the whole thing must go quiet on its own
+        self._release_environment(self.ticks, force=True)
+        self.chaos.config = FaultConfig.none()
+        self.chaos.flush()
+        converged = False
+        for i in range(SETTLE_BUDGET):
+            tick = self.ticks + i
+            self.flushsim.flush(tick, self.cluster)
+            self.chaos.tick()
+            self._cycle(tick)
+            self._check(tick)
+            if self._converged():
+                converged = True
+                self._log(f"tick {tick}: converged after {i + 1} settle "
+                          f"cycles (decode={self._decode_running()}, "
+                          f"target={self.autoscaler.target})")
+                break
+        if not converged:
+            self._log(
+                f"NOT CONVERGED after {SETTLE_BUDGET} settle cycles: "
+                f"decode={self._decode_running()} "
+                f"target={self.autoscaler.target} "
+                f"train={self._train_running()} "
+                f"inflight_preemptions={len(self.preemptor.inflight)} "
+                f"pending_events={self.chaos.pending_events} "
+                f"term_pending={self.cluster.pending_term_tasks()}")
+
+        plan_statuses = {}
+        for name in self.multi.service_names():
+            sched = self.multi.get_service(name)
+            if sched is not None:
+                for p in sched.plans:
+                    plan_statuses[f"{name}.{p.name}"] = p.status.name
+        return SoakReport(
+            seed=self.seed,
+            ticks=self.ticks,
+            converged=converged,
+            violations=self.violations,
+            fault_counts={**self.chaos.fault_counts,
+                          **self.env_fault_counts},
+            plan_statuses=plan_statuses,
+            trace=self.trace,
+        )
+
+
+def run_elastic_soak(seed: int, ticks: int = 40,
+                     config: Optional[FaultConfig] = None) -> SoakReport:
+    """Run one seeded elastic chaos schedule; ``config`` defaults to every
+    fault class armed (:meth:`FaultConfig.all_faults`), scale-event
+    classes included."""
+    return ElasticSoak(seed, ticks,
+                       config or FaultConfig.all_faults()).run()

@@ -72,12 +72,29 @@ class FaultConfig:
     # ledger must stay clean with transfers pending)
     kv_ship_lost: float = 0.0
     kv_ship_slow: float = 0.0
+    # scale-event faults (elastic soak harness, chaos/elastic_soak.py):
+    # traffic slams to max for several ticks so the autoscaler must grow
+    # through plan machinery under weather (scale_up_burst); the decode
+    # target is forced straight to max, bypassing debounce, so preemption
+    # fires while scale plans are mid-flight (preempt_storm); a TERM'd
+    # victim crashes before its checkpoint flush — the flush-grace
+    # protocol must still reclaim cleanly (victim_crash_in_grace); the
+    # scheduler process dies while a scale/preemption plan is incomplete
+    # and the restored plans must resume it (scale_mid_crash). Only the
+    # elastic harness reads these fields, so arming them never perturbs
+    # legacy pinned seeds (a fault draws from the RNG only when its
+    # probability is actually consulted).
+    scale_up_burst: float = 0.0
+    preempt_storm: float = 0.0
+    victim_crash_in_grace: float = 0.0
+    scale_mid_crash: float = 0.0
     max_delay_ticks: int = 3
 
     FIELDS = ("status_drop", "status_delay", "status_dup", "status_reorder",
               "launch_fail", "launch_slow", "agent_flap", "agent_loss",
               "degrade", "task_crash", "crash_restart", "page_leak",
-              "kv_ship_lost", "kv_ship_slow")
+              "kv_ship_lost", "kv_ship_slow", "scale_up_burst",
+              "preempt_storm", "victim_crash_in_grace", "scale_mid_crash")
 
     @classmethod
     def none(cls) -> "FaultConfig":
@@ -105,7 +122,9 @@ class FaultConfig:
         drain through the chaos queue but no new weather is scheduled."""
         return replace(self, agent_flap=0.0, agent_loss=0.0, degrade=0.0,
                        task_crash=0.0, crash_restart=0.0, page_leak=0.0,
-                       kv_ship_lost=0.0, kv_ship_slow=0.0)
+                       kv_ship_lost=0.0, kv_ship_slow=0.0,
+                       scale_up_burst=0.0, preempt_storm=0.0,
+                       victim_crash_in_grace=0.0, scale_mid_crash=0.0)
 
 
 def parse_faults(arg: str) -> FaultConfig:

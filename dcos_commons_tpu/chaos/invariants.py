@@ -199,3 +199,118 @@ class InvariantChecker:
                     "reset", tick))
         self._prev_backoff = snap
         return out
+
+
+class ElasticInvariantChecker:
+    """Scale-event invariants over an elastic multi-service harness
+    (``chaos/elastic_soak.py``), audited every tick alongside the
+    per-service :class:`InvariantChecker`:
+
+    7. **flush-grace before reclaim** — a preempted gang's reservations
+       may be reclaimed only after every victim task was observed
+       terminal, and the kill may escalate only once the bounded grace
+       actually expired. Reclaiming early corrupts placement (the
+       "freed" chips are still running a collective); escalating early
+       robs the sentinel of its checkpoint-flush window.
+    8. **priority inversion never persists** — a higher-priority service
+       starving on chips while a lower-priority service holds them is
+       legal *transiently* (that is what the grace protocol looks like
+       from the outside) but must resolve within a settle window, or the
+       preemptor has wedged.
+    9. **cross-service double-booking** — per-service ledgers each pass
+       their own capacity audit; the *sum* across services must also fit
+       every agent, or two services were promised the same chips.
+    """
+
+    def __init__(self, harness, inversion_window: int = 30):
+        self._h = harness          # needs .multi and .preemptor
+        self.inversion_window = inversion_window
+        self._inversion_streak = 0
+
+    def check(self, tick: int) -> List[Violation]:
+        out: List[Violation] = []
+        out += self._check_flush_grace(tick)
+        out += self._check_priority_inversion(tick)
+        out += self._check_cross_service_booking(tick)
+        return out
+
+    def _check_flush_grace(self, tick: int) -> List[Violation]:
+        out = []
+        preemptor = self._h.preemptor
+        if preemptor is None:
+            return out
+        for rec in preemptor.records:
+            who = f"{rec.service}/{','.join(rec.pod_instances)}"
+            if rec.reclaim_tick is not None and rec.terminal_tick is None:
+                out.append(Violation(
+                    "flush-grace",
+                    f"{who} reclaimed at tick {rec.reclaim_tick} without "
+                    "observing the victims terminal", tick))
+            if (rec.reclaim_tick is not None and rec.terminal_tick is not None
+                    and rec.reclaim_tick < rec.terminal_tick):
+                out.append(Violation(
+                    "flush-grace",
+                    f"{who} reclaimed at tick {rec.reclaim_tick} before "
+                    f"terminal observation at {rec.terminal_tick}", tick))
+            if (rec.escalated_tick is not None
+                    and rec.escalated_tick - rec.term_tick < rec.grace_ticks):
+                out.append(Violation(
+                    "flush-grace",
+                    f"{who} escalated at tick {rec.escalated_tick}, only "
+                    f"{rec.escalated_tick - rec.term_tick} ticks after TERM "
+                    f"(grace is {rec.grace_ticks})", tick))
+        return out
+
+    def _check_priority_inversion(self, tick: int) -> List[Violation]:
+        from ..scheduler.elastic import pending_expansion_chips
+        multi = self._h.multi
+        services = [(n, multi.get_service(n)) for n in multi.service_names()]
+        inverted = False
+        for name, sched in services:
+            if sched is None or sched.uninstall_mode:
+                continue
+            if pending_expansion_chips(sched) <= 0:
+                continue
+            if multi.last_cycle_actions.get(name, 0) > 0:
+                continue
+            # starving on chips: is anyone lower-priority holding any?
+            for other_name, other in services:
+                if (other is not None and other_name != name
+                        and other.spec.priority < sched.spec.priority
+                        and any(r.tpus > 0 for r in other.ledger.all())):
+                    inverted = True
+        self._inversion_streak = self._inversion_streak + 1 if inverted else 0
+        if self._inversion_streak > self.inversion_window:
+            self._inversion_streak = 0  # report once, then re-arm
+            return [Violation(
+                "priority-inversion",
+                f"a higher-priority service starved on chips held by a "
+                f"lower-priority service for more than "
+                f"{self.inversion_window} consecutive ticks", tick)]
+        return []
+
+    def _check_cross_service_booking(self, tick: int) -> List[Violation]:
+        multi = self._h.multi
+        ledgers = [multi.get_service(n).ledger
+                   for n in multi.service_names()
+                   if multi.get_service(n) is not None]
+        out = []
+        for agent in multi.cluster.agents():
+            if agent.tpu.degraded:
+                continue  # capacity legitimately below held reservations
+            cpus = mem = disk = tpus = 0.0
+            for ledger in ledgers:
+                c, m, d, t = ledger.reserved_scalars(agent.agent_id)
+                cpus += c
+                mem += m
+                disk += d
+                tpus += t
+            if (cpus > agent.cpus + 1e-9 or mem > agent.memory_mb
+                    or disk > agent.disk_mb or tpus > agent.tpu.chips):
+                out.append(Violation(
+                    "cross-service-double-book",
+                    f"{agent.agent_id} reserved ({cpus}, {mem}, {disk}, "
+                    f"{tpus}) across services exceeds capacity "
+                    f"({agent.cpus}, {agent.memory_mb}, {agent.disk_mb}, "
+                    f"{agent.tpu.chips})", tick))
+        return out

@@ -402,6 +402,36 @@ def _chaos_soak_cmd(client: Client, args) -> int:
     return 0
 
 
+def _autoscale_soak_cmd(client: Client, args) -> int:
+    """``tpuctl autoscale-soak``: seeded chaos schedules through the full
+    elastic control loop — back-pressure autoscaler, priority preemptor
+    and training backfill active over a two-service (serve + train) fleet.
+    Same contract as ``chaos-soak``: exit 0 when every seed converges with
+    zero invariant violations (flush-grace and priority-inversion
+    invariants included), else print the failing seed's tick trace."""
+    from ..chaos.elastic_soak import run_elastic_soak
+    from ..chaos.engine import parse_faults
+    config = parse_faults(args.faults)
+    seeds = (range(args.seeds) if args.seed is None else [args.seed])
+    failed = None
+    for seed in seeds:
+        report = run_elastic_soak(seed, ticks=args.ticks, config=config)
+        print(json.dumps(report.to_dict()))
+        if not report.ok:
+            failed = report
+            break
+    if failed is not None:
+        print(f"\nautoscale-soak FAILED at seed {failed.seed} "
+              f"(reproduce: tpuctl autoscale-soak --seed {failed.seed} "
+              f"--ticks {failed.ticks} --faults {args.faults})",
+              file=sys.stderr)
+        print("tick trace:", file=sys.stderr)
+        for line in failed.trace:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpuctl", description="Operator CLI for a TPU-SDK scheduler")
@@ -513,6 +543,25 @@ def build_parser() -> argparse.ArgumentParser:
                       help="'all' or comma-separated fault classes "
                            "(e.g. status_drop,agent_flap)")
     soak.set_defaults(fn=_chaos_soak_cmd)
+
+    asoak = sub.add_parser(
+        "autoscale-soak", help="seeded chaos soak through the elastic "
+                               "control loop (autoscaler + preemptor + "
+                               "backfill over a serve/train fleet)")
+    asoak.add_argument("--seed", type=int, default=None,
+                       help="run exactly this seed (default: sweep "
+                            "0..--seeds-1)")
+    asoak.add_argument("--seeds", type=int, default=100,
+                       help="number of seeds to sweep when --seed is not "
+                            "given (default 100)")
+    asoak.add_argument("--ticks", type=int, default=40,
+                       help="storm-phase ticks per schedule (default 40)")
+    asoak.add_argument("--faults", default="all",
+                       help="'all' or comma-separated fault classes (the "
+                            "elastic set adds scale_up_burst, "
+                            "preempt_storm, victim_crash_in_grace, "
+                            "scale_mid_crash)")
+    asoak.set_defaults(fn=_autoscale_soak_cmd)
     return p
 
 

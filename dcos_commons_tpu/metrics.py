@@ -117,6 +117,26 @@ class MetricsRegistry:
         health reaction, ``core._replace_tpu_degraded``)."""
         self.counter("recovery.tpu_degraded_replace")
 
+    # -- elastic control plane (scheduler/elastic.py) ----------------------
+
+    def record_scale(self, pod_type: str, direction: str) -> None:
+        """Autoscaler resize accepted (direction: ``up`` | ``down``)."""
+        self.counter(f"elastic.scale_{direction}")
+        self.counter(f"elastic.scale_{direction}.{pod_type}")
+
+    def record_preemption(self, n_pods: int = 1) -> None:
+        """Victim gang delivered SIGTERM (flush-grace window opens)."""
+        self.counter("elastic.preemptions")
+        self.counter("elastic.preempted_pods", n_pods)
+
+    def record_preemption_escalated(self) -> None:
+        """Flush grace expired without a clean exit; kill escalated."""
+        self.counter("elastic.preemption_escalations")
+
+    def record_backfill_gated(self) -> None:
+        """A low-priority expansion held back by the headroom reserve."""
+        self.counter("elastic.backfill_gated")
+
     # -- export ------------------------------------------------------------
 
     def to_dict(self) -> dict:

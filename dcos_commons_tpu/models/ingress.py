@@ -101,7 +101,8 @@ class ServingFrontend:
                  host: str = "0.0.0.0", max_queue: int = 64,
                  request_timeout_s: float = 600.0,
                  idle_sleep_s: float = 0.001,
-                 decode_window: int = 8):
+                 decode_window: int = 8,
+                 window_s: float = 60.0):
         self.engine = engine
         self.max_queue = max_queue
         self.request_timeout_s = request_timeout_s
@@ -121,7 +122,14 @@ class ServingFrontend:
         self._wake = threading.Event()
         self._lock = threading.Lock()                 # stats only
         self._totals = {"requests": 0, "tokens": 0, "rejected": 0}
-        self._window: deque = deque(maxlen=1024)      # (ttft_ms, tpot_ms)
+        # rolling-window load gauges (autoscaler input): completions and
+        # sheds are stamped with time.monotonic() so load_gauges() can
+        # report the last window_s seconds rather than lifetime totals —
+        # point samples and lifetime counters both mislead a controller
+        # (the former is noise, the latter never decays)
+        self.window_s = window_s
+        self._window: deque = deque(maxlen=1024)      # (t, ttft_ms, tpot_ms)
+        self._sheds: deque = deque(maxlen=4096)       # t of each rejection
         self._engine_thread: Optional[threading.Thread] = None
         frontend = self
 
@@ -240,6 +248,7 @@ class ServingFrontend:
         except queue.Full:
             with self._lock:
                 self._totals["rejected"] += 1
+                self._sheds.append(time.monotonic())
             return False
         self._wake.set()
         return True
@@ -316,7 +325,8 @@ class ServingFrontend:
                 self._totals["requests"] += 1
                 self._totals["tokens"] += len(pending.tokens)
                 t = pending.timings_ms()
-                self._window.append((t.get("ttft_ms"), t.get("tpot_ms")))
+                self._window.append((time.monotonic(), t.get("ttft_ms"),
+                                     t.get("tpot_ms")))
 
     def _run_engine(self) -> None:
         while not self._stop.is_set():
@@ -442,14 +452,45 @@ class ServingFrontend:
             # paged engines admit on pages: surface the real
             # utilization signal (autoscalers key off this, not slots)
             out["pages_free"] = self.engine.pages_free()
+        out["load"] = self.load_gauges()
+        return out
+
+    def load_gauges(self) -> dict:
+        """Time-windowed back-pressure signals over the last ``window_s``
+        seconds — the autoscaler contract (``scheduler/elastic.py``
+        ``backpressure()`` consumes exactly these keys). Served in the
+        ``/v1/healthz`` body and under ``stats()["window"]``."""
+        now = time.monotonic()
+        horizon = now - self.window_s
+        with self._lock:
+            shed = sum(1 for t in self._sheds if t >= horizon)
+            recent = [e for e in self._window if e[0] >= horizon]
+        completed = len(recent)
+        ttft = [t for _, t, _ in recent if t is not None]
+        out = {
+            "window_s": self.window_s,
+            "queue_depth": self._queue.qsize(),
+            "queue_capacity": self.max_queue,
+            "completed": completed,
+            "shed": shed,
+            # fraction of window arrivals turned away at the door
+            "shed_rate": shed / max(1, shed + completed),
+            "ttft_p95_ms": _percentiles(ttft).get("p95"),
+        }
+        if hasattr(self.engine, "pages_free"):
+            out["pages_free"] = self.engine.pages_free()
+            ledger = getattr(self.engine, "ledger", None)
+            if ledger is not None:
+                out["pages_total"] = ledger.pages
         return out
 
     def stats(self) -> dict:
         with self._lock:
             totals = dict(self._totals)
             window = list(self._window)
-        ttft = [t for t, _ in window if t is not None]
-        tpot = [t for _, t in window if t is not None]
+        ttft = [t for _, t, _ in window if t is not None]
+        tpot = [t for _, _, t in window if t is not None]
         return {**totals, "queued": self._queue.qsize(),
                 "ttft_ms": _percentiles(ttft),
-                "tpot_ms": _percentiles(tpot)}
+                "tpot_ms": _percentiles(tpot),
+                "window": self.load_gauges()}

@@ -29,6 +29,15 @@ class Backoff:
         (decommission/replace GC) — long-running schedulers must not
         accumulate delay entries for tasks that no longer exist."""
 
+    def on_preempted(self, task_name: str) -> None:
+        """A task was preempted (clean checkpoint-flush exit 143, or the
+        escalated kill after its grace) — NOT a crash. Clear its delay so
+        the relaunch-elsewhere is not penalized like a crash loop; the
+        next ``on_launch`` opens a fresh epoch, which is how the chaos
+        backoff-monotone invariant tells a deliberate reset from a delay
+        regression."""
+        self.forget(task_name)
+
 
 class DisabledBackoff(Backoff):
     def on_launch(self, task_name: str) -> None:

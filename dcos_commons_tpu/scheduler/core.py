@@ -817,6 +817,51 @@ class ServiceScheduler:
             touched.append(task_name)
         return touched
 
+    # -- preemption (scheduler/elastic.py Preemptor) -----------------------
+
+    def preempt_pod(self, pod_instance_name: str, grace_s: float
+                    ) -> List[str]:
+        """Deliver SIGTERM to every live task of the pod: a kill WITH a
+        grace period, so the worker sentinel gets its window to
+        checkpoint-flush and exit 143. Nothing else changes — state,
+        reservations, and plans are untouched until
+        :meth:`reclaim_preempted`. ``grace_s=0`` is the escalation path
+        (grace expired: immediate kill)."""
+        with self._lock:
+            killed = []
+            for task_name in self.pod_instance_task_names(pod_instance_name):
+                task = self.state.fetch_task(task_name)
+                status = self.state.fetch_status(task_name)
+                if (task and status and status.task_id == task.task_id
+                        and not status.state.terminal):
+                    self.cluster.kill(task.agent_id, task.task_id, grace_s)
+                    if self.metrics is not None:
+                        self.metrics.record_kill()
+                    killed.append(task_name)
+            return killed
+
+    def reclaim_preempted(self, pod_instance_name: str) -> List[str]:
+        """Reclaim a preempted pod's reservations NOW — only call after
+        every task of the pod has been observed terminal (the Preemptor's
+        flush-grace protocol guarantees this ordering; the chaos
+        flush-grace invariant audits it). Marks the tasks permanently
+        failed so recovery re-places the pod elsewhere (resuming from the
+        flushed checkpoint), releases the reservations immediately
+        (recovery's own PERMANENT path would hold them hostage until the
+        relaunch is *allowed* — but the whole point of reclaiming is to
+        free chips for the higher-priority service while the backfill
+        gate delays that relaunch), and clears the victim's launch
+        backoff: a clean eviction is not a crash."""
+        with self._lock:
+            touched = self._replace_pod_locked(pod_instance_name)
+            removed = self.ledger.remove_pod(pod_instance_name)
+            self.reservation_store.remove(removed)
+            for agent_id in {r.agent_id for r in removed if r.volumes}:
+                self.cluster.destroy_volumes(agent_id, pod_instance_name)
+            for task_name in touched:
+                self.backoff.on_preempted(task_name)
+            return touched
+
     def _replace_tpu_degraded(self, agents) -> None:
         """Chip-level health reaction (SURVEY.md §5): a TPU pod with a
         member on a host that lost chips is proactively replaced — for

@@ -22,7 +22,11 @@ UNINSTALL_PLAN_NAME = "uninstall"
 
 def _kill_pod_action(scheduler, pod_instance_name: str) -> Callable[[], bool]:
     """Kill all live tasks of the pod; complete when all are terminal
-    (reference ``TriggerDecommissionStep`` + ``TaskKillStep``)."""
+    (reference ``TriggerDecommissionStep`` + ``TaskKillStep``). The kill
+    carries the task's configured grace so a scaled-down serving replica
+    gets its SIGTERM window (drain in-flight requests, flush state)
+    instead of an abrupt kill — the step re-fires each cycle until the
+    terminal status lands, which is what bounds the grace."""
     def action() -> bool:
         alive = False
         for task_name in scheduler.pod_instance_task_names(pod_instance_name):
@@ -30,10 +34,19 @@ def _kill_pod_action(scheduler, pod_instance_name: str) -> Callable[[], bool]:
             status = scheduler.state.fetch_status(task_name)
             if (task and status and status.task_id == task.task_id
                     and not status.state.terminal):
-                scheduler.cluster.kill(task.agent_id, task.task_id)
+                scheduler.cluster.kill(task.agent_id, task.task_id,
+                                       _task_grace(scheduler, task))
                 alive = True
         return not alive
     return action
+
+
+def _task_grace(scheduler, task) -> float:
+    try:
+        pod = next(p for p in scheduler.spec.pods if p.type == task.pod_type)
+        return float(pod.task(task.task_spec_name).kill_grace_period_s)
+    except (StopIteration, KeyError):
+        return 0.0
 
 
 def _unreserve_pod_action(scheduler, pod_instance_name: str) -> Callable[[], bool]:

@@ -1,0 +1,639 @@
+"""Elastic control plane: back-pressure autoscaling, priority preemption,
+and training backfill over the multi-service scheduler.
+
+The reference SDK ran many services in one scheduler and arbitrated offers
+between them (``MultiServiceEventClient`` + ``OfferDiscipline``) but every
+service's footprint was statically sized by its spec. This module closes
+the loop the reference never had:
+
+* :class:`Autoscaler` — polls serving back-pressure (queue depth, shed
+  rate, pages free, TTFT p95 from ``ServingFrontend.load_gauges()``)
+  through a debounced :class:`HysteresisController` and resizes the decode
+  tier by **config updates** (``with_pod_count`` + ``update_config``), so
+  every grow/shrink flows through the existing plan→phase→step machinery:
+  a grow is new PENDING deploy steps, a shrink is a decommission plan, and
+  both are resumable after a scheduler crash because the target count
+  lives in the persisted spec, not in controller memory.
+
+* :class:`Preemptor` — Borg-style priority preemption. When a
+  higher-priority service cannot place new TPU work (its expansion steps
+  starve for ``starve_ticks`` consecutive cycles), victims are selected
+  from the lowest-priority service holding chips — **whole gangs, never
+  partial slices** — and walked through a TERM → flush-grace → reclaim
+  protocol: SIGTERM first (``kill`` with a grace period; the worker
+  sentinel checkpoint-flushes and exits 143), reservations are reclaimed
+  only after every victim task is observed terminal, and the kill is
+  escalated only once the bounded grace expires.
+
+* :class:`BackfillGate` — training backfill. A low-priority service may
+  expand onto idle chips only while the fleet keeps a configurable
+  serving-headroom reserve free; the idle-chip census reuses
+  ``matching/agent_index.py``'s headroom buckets over a cross-service
+  combined ledger.
+
+:class:`ElasticController` ties the three together around
+``MultiServiceScheduler.run_cycle()`` — one call per scheduler tick.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..matching.agent_index import AgentIndex
+from ..specification.spec import with_pod_count
+
+log = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------
+# back-pressure signal
+# --------------------------------------------------------------------------
+
+def backpressure(gauges: dict, ttft_slo_ms: Optional[float] = None) -> float:
+    """Collapse a ``ServingFrontend.load_gauges()`` dict into one pressure
+    scalar in [0, 1] — the max over the individual signals, because any
+    single saturated resource is enough to degrade serving:
+
+    * shedding (rejected requests in the window) pins pressure to 1.0 —
+      the queue already overflowed, scaling is overdue;
+    * queue fill: ``queue_depth / queue_capacity``;
+    * KV-page occupancy: ``1 - pages_free / pages_total`` (paged engines
+      admit on pages, so this is the real utilization signal);
+    * TTFT p95 against the SLO (when one is configured): crossing the SLO
+      reads as high pressure even before the queue backs up.
+    """
+    p = 0.0
+    cap = gauges.get("queue_capacity") or 0
+    if cap:
+        p = max(p, min(1.0, gauges.get("queue_depth", 0) / cap))
+    if gauges.get("shed", 0) > 0:
+        p = 1.0
+    total = gauges.get("pages_total") or 0
+    if total:
+        free = gauges.get("pages_free", total)
+        p = max(p, min(1.0, 1.0 - free / total))
+    ttft = gauges.get("ttft_p95_ms")
+    if ttft_slo_ms and ttft is not None:
+        p = max(p, min(1.0, 0.8 * ttft / ttft_slo_ms))
+    return p
+
+
+# --------------------------------------------------------------------------
+# hysteresis controller
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class AutoscalerConfig:
+    """Knobs for one autoscaled pod tier. ``from_env`` reads the
+    ``AUTOSCALE_*`` environment contract documented in
+    ``docs/yaml-reference.md``."""
+
+    pod_type: str
+    min_count: int = 1
+    max_count: int = 4
+    high_pressure: float = 0.75   # scale up above this ...
+    low_pressure: float = 0.25    # ... down below this; between = dead band
+    debounce_ticks: int = 3       # consecutive ticks before acting
+    cooldown_ticks: int = 5       # quiet period after any resize
+    step_up: int = 1
+    step_down: int = 1
+    ttft_slo_ms: Optional[float] = None
+
+    def __post_init__(self):
+        if self.min_count < 0 or self.max_count < max(1, self.min_count):
+            raise ValueError("need 0 <= min_count <= max_count >= 1")
+        if not (0.0 <= self.low_pressure < self.high_pressure <= 1.0):
+            raise ValueError("need 0 <= low_pressure < high_pressure <= 1")
+        if self.debounce_ticks < 1 or self.cooldown_ticks < 0:
+            raise ValueError("debounce_ticks >= 1, cooldown_ticks >= 0")
+
+    @classmethod
+    def from_env(cls, pod_type: str,
+                 env: Optional[dict] = None) -> "AutoscalerConfig":
+        e = os.environ if env is None else env
+
+        def _f(key, default):
+            raw = e.get(key)
+            return default if raw in (None, "") else float(raw)
+
+        slo = _f("AUTOSCALE_TTFT_SLO_MS", 0.0)
+        return cls(
+            pod_type=pod_type,
+            min_count=int(_f("AUTOSCALE_MIN", 1)),
+            max_count=int(_f("AUTOSCALE_MAX", 4)),
+            high_pressure=_f("AUTOSCALE_HIGH", 0.75),
+            low_pressure=_f("AUTOSCALE_LOW", 0.25),
+            debounce_ticks=int(_f("AUTOSCALE_DEBOUNCE", 3)),
+            cooldown_ticks=int(_f("AUTOSCALE_COOLDOWN", 5)),
+            step_up=int(_f("AUTOSCALE_STEP_UP", 1)),
+            step_down=int(_f("AUTOSCALE_STEP_DOWN", 1)),
+            ttft_slo_ms=slo or None,
+        )
+
+
+class HysteresisController:
+    """Debounced two-threshold controller: pressure must sit above
+    ``high_pressure`` (or below ``low_pressure``) for ``debounce_ticks``
+    consecutive observations before a resize is proposed, and every resize
+    opens a ``cooldown_ticks`` quiet window — so transport noise and the
+    scale event's own transient (new replicas warming up) can't make the
+    fleet oscillate."""
+
+    def __init__(self, config: AutoscalerConfig):
+        self.config = config
+        self._above = 0
+        self._below = 0
+        self._cooldown = 0
+
+    def reset(self) -> None:
+        self._above = self._below = 0
+
+    def observe(self, pressure: float, current: int) -> Optional[int]:
+        """Feed one pressure sample; returns the proposed new count, or
+        None to hold."""
+        cfg = self.config
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            self.reset()
+            return None
+        if pressure >= cfg.high_pressure:
+            self._above += 1
+            self._below = 0
+        elif pressure <= cfg.low_pressure:
+            self._below += 1
+            self._above = 0
+        else:
+            self.reset()
+        if self._above >= cfg.debounce_ticks and current < cfg.max_count:
+            self._cooldown = cfg.cooldown_ticks
+            self.reset()
+            return min(cfg.max_count, current + cfg.step_up)
+        if self._below >= cfg.debounce_ticks and current > cfg.min_count:
+            self._cooldown = cfg.cooldown_ticks
+            self.reset()
+            return max(cfg.min_count, current - cfg.step_down)
+        return None
+
+
+# --------------------------------------------------------------------------
+# autoscaler
+# --------------------------------------------------------------------------
+
+class Autoscaler:
+    """Resizes one pod tier of one service through **config updates**.
+
+    The controller's only actuator is
+    ``scheduler.update_config(with_pod_count(...))`` — the same verb an
+    operator uses — so a grow materializes as PENDING deploy-plan steps
+    and a shrink as a decommission plan, both persisted: after a scheduler
+    crash the restored service re-derives the very same plans from the
+    stored target config and resumes where it stopped. Controller memory
+    (debounce streaks, cooldown) is deliberately ephemeral; the *target*
+    is not.
+    """
+
+    def __init__(self, multi_fn: Callable[[], object], service_name: str,
+                 config: AutoscalerConfig,
+                 gauges_fn: Callable[[], dict],
+                 metrics=None):
+        self._multi_fn = multi_fn
+        self.service_name = service_name
+        self.config = config
+        self.gauges_fn = gauges_fn
+        self.controller = HysteresisController(config)
+        self.metrics = metrics
+        self.last_pressure: float = 0.0
+        # (new_count, pressure) per resize, newest last — bench receipts
+        self.events: List[Tuple[int, float]] = []
+
+    def _service(self):
+        multi = self._multi_fn()
+        return None if multi is None else multi.get_service(self.service_name)
+
+    @property
+    def target(self) -> Optional[int]:
+        """The current target count — read from the *persisted* spec, so
+        it survives controller and scheduler crashes alike."""
+        sched = self._service()
+        if sched is None:
+            return None
+        for pod in sched.spec.pods:
+            if pod.type == self.config.pod_type:
+                return pod.count
+        return None
+
+    def tick(self) -> Optional[int]:
+        """One control step: sample pressure, feed the hysteresis
+        controller, emit a config update when it proposes a resize.
+        Returns the new count when a resize was accepted."""
+        sched = self._service()
+        if sched is None:
+            return None
+        current = self.target
+        if current is None:
+            return None
+        self.last_pressure = backpressure(self.gauges_fn(),
+                                          self.config.ttft_slo_ms)
+        proposed = self.controller.observe(self.last_pressure, current)
+        if proposed is None or proposed == current:
+            return None
+        return self._resize(sched, current, proposed)
+
+    def force_target(self, count: int) -> Optional[int]:
+        """Jump straight to a clamped target, bypassing debounce (chaos
+        ``preempt_storm`` fault and operator override)."""
+        sched = self._service()
+        current = self.target
+        if sched is None or current is None:
+            return None
+        count = max(self.config.min_count, min(self.config.max_count, count))
+        if count == current:
+            return None
+        return self._resize(sched, current, count)
+
+    def _resize(self, sched, current: int, proposed: int) -> Optional[int]:
+        result = sched.update_config(
+            with_pod_count(sched.spec, self.config.pod_type, proposed))
+        if not result.accepted:
+            log.warning("autoscale %s/%s %d -> %d rejected: %s",
+                        self.service_name, self.config.pod_type,
+                        current, proposed, result.errors)
+            return None
+        multi = self._multi_fn()
+        if multi is not None:
+            # the spec in the durable service registry must track the new
+            # target, or a restarted multi scheduler would re-mount the
+            # service at the stale count and silently undo the resize
+            multi.service_store.store(sched.spec)
+        self.events.append((proposed, self.last_pressure))
+        if self.metrics is not None:
+            self.metrics.record_scale(
+                self.config.pod_type,
+                "up" if proposed > current else "down")
+        log.info("autoscale %s/%s: %d -> %d (pressure %.2f)",
+                 self.service_name, self.config.pod_type, current, proposed,
+                 self.last_pressure)
+        return proposed
+
+
+# --------------------------------------------------------------------------
+# preemptor
+# --------------------------------------------------------------------------
+
+@dataclass
+class PreemptionRecord:
+    """Audit trail of one preemption — the flush-grace invariant replays
+    these to prove reservations were never reclaimed before the victims
+    were observed terminal."""
+
+    service: str
+    pod_instances: Tuple[str, ...]
+    task_ids: Dict[str, str]          # task_name -> task_id at TERM time
+    term_tick: int
+    grace_ticks: int
+    terminal_tick: Optional[int] = None
+    escalated_tick: Optional[int] = None
+    reclaim_tick: Optional[int] = None
+    reclaimed_tasks: Tuple[str, ...] = ()
+
+    @property
+    def inflight(self) -> bool:
+        return self.reclaim_tick is None
+
+
+class Preemptor:
+    """TERM → flush-grace → reclaim preemption across services.
+
+    Starvation detection: a service is *starving* when it has pending TPU
+    footprint expansion (pods with no reservations yet) while its cycles
+    issue zero actions — the matcher found nowhere to put it — for
+    ``starve_ticks`` consecutive ticks. Victims come from the
+    lowest-priority service holding TPU reservations; gang pods are
+    evicted whole (every instance of the gang pod type — a partial slice
+    is useless to both sides). Victims get SIGTERM via the cluster's
+    graceful-kill path; reservations are reclaimed only once every victim
+    task is observed terminal (the sentinel's checkpoint-flush exit 143
+    path), and the kill escalates to immediate only after ``grace_ticks``
+    have elapsed without that observation.
+    """
+
+    def __init__(self, multi_fn: Callable[[], object],
+                 grace_ticks: int = 3, starve_ticks: int = 2,
+                 metrics=None):
+        if grace_ticks < 1 or starve_ticks < 1:
+            raise ValueError("grace_ticks and starve_ticks must be >= 1")
+        self._multi_fn = multi_fn
+        self.grace_ticks = grace_ticks
+        self.starve_ticks = starve_ticks
+        self.metrics = metrics
+        self.records: List[PreemptionRecord] = []
+        self._starve: Dict[str, int] = {}
+
+    @property
+    def inflight(self) -> List[PreemptionRecord]:
+        return [r for r in self.records if r.inflight]
+
+    def tick(self, tick: int) -> None:
+        """Advance in-flight preemptions, then look for new starvation.
+        Call AFTER ``multi.run_cycle()`` so the starvation detector reads
+        this tick's action counts."""
+        self._advance(tick)
+        if not self.inflight:          # one preemption in flight at a time
+            starving = self._detect_starvation()
+            if starving is not None:
+                self._preempt_for(starving, tick)
+
+    # -- grace protocol ----------------------------------------------------
+
+    def _advance(self, tick: int) -> None:
+        for rec in self.records:
+            if not rec.inflight:
+                continue
+            multi = self._multi_fn()
+            sched = None if multi is None else multi.get_service(rec.service)
+            if sched is None:          # victim service uninstalled mid-grace
+                rec.terminal_tick = rec.terminal_tick or tick
+                rec.reclaim_tick = tick
+                continue
+            if self._all_terminal(sched, rec):
+                if rec.terminal_tick is None:
+                    rec.terminal_tick = tick
+                reclaimed: List[str] = []
+                for inst in rec.pod_instances:
+                    reclaimed.extend(sched.reclaim_preempted(inst))
+                rec.reclaimed_tasks = tuple(reclaimed)
+                rec.reclaim_tick = tick
+                log.info("preemption of %s/%s reclaimed at tick %d "
+                         "(terminal at %d, escalated=%s)",
+                         rec.service, ",".join(rec.pod_instances), tick,
+                         rec.terminal_tick, rec.escalated_tick is not None)
+            elif (rec.escalated_tick is None
+                  and tick - rec.term_tick >= rec.grace_ticks):
+                # grace expired without a clean exit: escalate to an
+                # immediate kill; reclaim still waits for the KILLED status
+                rec.escalated_tick = tick
+                for inst in rec.pod_instances:
+                    sched.preempt_pod(inst, grace_s=0.0)
+                if self.metrics is not None:
+                    self.metrics.record_preemption_escalated()
+                log.warning("preemption of %s/%s escalated at tick %d "
+                            "(grace %d expired)", rec.service,
+                            ",".join(rec.pod_instances), tick,
+                            rec.grace_ticks)
+
+    @staticmethod
+    def _all_terminal(sched, rec: PreemptionRecord) -> bool:
+        for task_name, task_id in rec.task_ids.items():
+            status = sched.state.fetch_status(task_name)
+            if (status is not None and status.task_id == task_id
+                    and not status.state.terminal):
+                return False
+            # no status / different incarnation: that launch is gone
+        return True
+
+    # -- starvation + victim selection -------------------------------------
+
+    def _services(self) -> List[tuple]:
+        multi = self._multi_fn()
+        if multi is None:
+            return []
+        with multi._lock:
+            return [(name, multi.get_service(name))
+                    for name in multi.service_names()]
+
+    def _detect_starvation(self) -> Optional[str]:
+        """The highest-priority service that is starving, or None. Only
+        services with pending TPU expansion count — a service whose steps
+        merely await status (reservations already held) is waiting on the
+        transport, not on chips."""
+        multi = self._multi_fn()
+        services = self._services()
+        if not services:
+            return None
+        priorities = {name: s.spec.priority for name, s in services}
+        floor = min(priorities.values())
+        starving: List[tuple] = []
+        for name, sched in services:
+            if sched.uninstall_mode or priorities[name] <= floor:
+                self._starve[name] = 0
+                continue
+            pending = pending_expansion_chips(sched)
+            acted = multi.last_cycle_actions.get(name, 0) > 0
+            if pending > 0 and not acted:
+                self._starve[name] = self._starve.get(name, 0) + 1
+            else:
+                self._starve[name] = 0
+            if self._starve[name] >= self.starve_ticks:
+                starving.append((-priorities[name], name))
+        if not starving:
+            return None
+        return sorted(starving)[0][1]
+
+    def _preempt_for(self, starving_name: str, tick: int) -> None:
+        multi = self._multi_fn()
+        services = self._services()
+        by_name = dict(services)
+        starving = by_name.get(starving_name)
+        if starving is None:
+            return
+        victims = [(s.spec.priority, name, s) for name, s in services
+                   if s.spec.priority < starving.spec.priority
+                   and not s.uninstall_mode
+                   and self._held_tpu_instances(s)]
+        if not victims:
+            return
+        _, victim_name, victim = sorted(victims, key=lambda v: v[:2])[0]
+        instances = self._select_eviction(victim)
+        if not instances:
+            return
+        task_ids: Dict[str, str] = {}
+        for task in victim.state.fetch_tasks():
+            if task.pod_instance_name in instances:
+                task_ids[task.task_name] = task.task_id
+        for inst in instances:
+            victim.preempt_pod(inst, grace_s=float(self.grace_ticks))
+        self.records.append(PreemptionRecord(
+            service=victim_name, pod_instances=tuple(instances),
+            task_ids=task_ids, term_tick=tick,
+            grace_ticks=self.grace_ticks))
+        self._starve[starving_name] = 0
+        if self.metrics is not None:
+            self.metrics.record_preemption(len(instances))
+        log.warning("preempting %s/%s (priority %d) to unblock %s "
+                    "(priority %d) at tick %d", victim_name,
+                    ",".join(instances), victim.spec.priority, starving_name,
+                    starving.spec.priority, tick)
+
+    @staticmethod
+    def _held_tpu_instances(sched) -> Dict[str, List[str]]:
+        """pod type -> instances currently holding TPU reservations."""
+        tpu_pods = {p.type for p in sched.spec.pods
+                    if any(rs.tpus > 0 for rs in p.resource_sets)}
+        out: Dict[str, List[str]] = {}
+        for r in sched.ledger.all():
+            pod_type = r.pod_instance_name.rpartition("-")[0]
+            if pod_type in tpu_pods:
+                out.setdefault(pod_type, [])
+                if r.pod_instance_name not in out[pod_type]:
+                    out[pod_type].append(r.pod_instance_name)
+        return out
+
+    def _select_eviction(self, victim) -> List[str]:
+        """Whole gangs, never partial slices: evicting one member of a
+        gang strands the rest on a broken collective, so a gang pod type
+        is evicted in full. Non-gang pods shed their highest instance."""
+        held = self._held_tpu_instances(victim)
+        pods = {p.type: p for p in victim.spec.pods}
+        for pod_type in sorted(held):
+            pod = pods.get(pod_type)
+            if pod is not None and pod.tpu is not None and pod.tpu.gang:
+                return sorted({f"{pod_type}-{i}" for i in range(pod.count)}
+                              | set(held[pod_type]))
+        for pod_type in sorted(held):
+            return [max(held[pod_type],
+                        key=lambda n: int(n.rpartition("-")[2]))]
+        return []
+
+
+def pending_expansion_chips(sched) -> int:
+    """Chips the service's un-reserved pod instances still need — the
+    footprint its pending expansion would claim (the same no-ledger-entry
+    test ``ServiceScheduler._expands_footprint`` applies per step)."""
+    total = 0
+    for pod in sched.spec.pods:
+        per_instance = sum(rs.tpus for rs in pod.resource_sets)
+        if per_instance <= 0:
+            continue
+        for idx in range(pod.count):
+            if not sched.ledger.for_pod(f"{pod.type}-{idx}"):
+                total += per_instance
+    return total
+
+
+# --------------------------------------------------------------------------
+# training backfill gate
+# --------------------------------------------------------------------------
+
+class _CombinedLedger:
+    """Read-only cross-service reservation view, shaped like the slice of
+    the ``ReservationLedger`` API that :class:`AgentIndex` consumes — so
+    the idle-chip census genuinely reuses the headroom buckets instead of
+    reimplementing them."""
+
+    def __init__(self, ledgers: Sequence):
+        self._ledgers = list(ledgers)
+        self.generation = tuple(l.generation for l in self._ledgers)
+
+    def reserved_scalars(self, agent_id: str) -> tuple:
+        cpus = mem = disk = tpus = 0.0
+        for ledger in self._ledgers:
+            c, m, d, t = ledger.reserved_scalars(agent_id)
+            cpus += c
+            mem += m
+            disk += d
+            tpus += t
+        return (cpus, mem, disk, tpus)
+
+    def agents_changed_since(self, generation):
+        return None  # combined views are rebuilt, never advanced
+
+
+class BackfillGate:
+    """``MultiServiceScheduler.expand_gate`` hook: lower-priority services
+    may grow only while the fleet keeps ``reserve_chips`` idle for the
+    top-priority tier to scale into.
+
+    The gate admits an expansion only when ``idle - pending >= reserve``
+    where ``pending`` is the chips the service's un-reserved instances
+    need — so a training gang cannot eat through the serving headroom in
+    a single cycle. Top-priority services are never gated (the reserve
+    exists *for* them)."""
+
+    def __init__(self, multi_fn: Callable[[], object],
+                 reserve_chips: int = 0, metrics=None):
+        if reserve_chips < 0:
+            raise ValueError("reserve_chips must be >= 0")
+        self._multi_fn = multi_fn
+        self.reserve_chips = reserve_chips
+        self.metrics = metrics
+        self.gated_count = 0
+
+    def idle_chips(self) -> int:
+        """Chips free across the fleet net of every service's
+        reservations, via the headroom buckets of
+        :class:`AgentIndex` over a :class:`_CombinedLedger`."""
+        multi = self._multi_fn()
+        if multi is None:
+            return 0
+        combined = _CombinedLedger(
+            [multi.get_service(n).ledger for n in multi.service_names()])
+        agents = list(multi.cluster.agents())
+        index = AgentIndex(agents, combined)
+        candidates, _ = index.headroom_candidates(0, 0, 0, 1)
+        idle = 0
+        for agent in candidates:
+            if agent.tpu.degraded:
+                continue
+            reserved = combined.reserved_scalars(agent.agent_id)[3]
+            idle += max(0, agent.tpu.chips - int(reserved))
+        return idle
+
+    def may_expand(self, name: str, sched) -> bool:
+        multi = self._multi_fn()
+        if multi is None:
+            return True
+        priorities = [multi.get_service(n).spec.priority
+                      for n in multi.service_names()]
+        if not priorities or sched.spec.priority >= max(priorities):
+            return True
+        pending = pending_expansion_chips(sched)
+        if pending <= 0:
+            return True  # CPU-only growth never touches the chip reserve
+        allowed = self.idle_chips() - pending >= self.reserve_chips
+        if not allowed:
+            self.gated_count += 1
+            if self.metrics is not None:
+                self.metrics.record_backfill_gated()
+        return allowed
+
+
+# --------------------------------------------------------------------------
+# the brain
+# --------------------------------------------------------------------------
+
+class ElasticController:
+    """One elastic control step per scheduler tick: autoscalers sample
+    pressure and emit resizes, the multi scheduler runs its cycle (with
+    the backfill gate wired into ``expand_gate``), then the preemptor
+    advances grace protocols and reacts to starvation observed in that
+    cycle."""
+
+    def __init__(self, multi_fn: Callable[[], object],
+                 autoscalers: Sequence[Autoscaler] = (),
+                 preemptor: Optional[Preemptor] = None,
+                 backfill: Optional[BackfillGate] = None):
+        self._multi_fn = multi_fn
+        self.autoscalers = list(autoscalers)
+        self.preemptor = preemptor
+        self.backfill = backfill
+        self.rewire()
+
+    def rewire(self) -> None:
+        """(Re)attach the backfill gate to the current multi scheduler —
+        call after the scheduler process restarts (the gate hangs off the
+        multi instance, which a crash replaces)."""
+        multi = self._multi_fn()
+        if multi is not None and self.backfill is not None:
+            multi.expand_gate = self.backfill.may_expand
+
+    def tick(self, tick: int) -> int:
+        for scaler in self.autoscalers:
+            scaler.tick()
+        multi = self._multi_fn()
+        actions = multi.run_cycle() if multi is not None else 0
+        if self.preemptor is not None:
+            self.preemptor.tick(tick)
+        return actions

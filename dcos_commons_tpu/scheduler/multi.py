@@ -31,6 +31,7 @@ import json
 import logging
 import threading
 import urllib.parse
+from dataclasses import replace as dc_replace
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..agent.client import AgentClient, StatusCallback
@@ -179,7 +180,38 @@ class ServiceClusterView(AgentClient):
         return getattr(self._multi.cluster, "async_status_ok", False)
 
     def agents(self) -> Sequence[AgentInfo]:
-        return self._multi.cluster.agents()
+        """The shared inventory with every *sibling* service's reservations
+        subtracted from capacity — the inventory-model analogue of the
+        Mesos master deducting other frameworks' allocations before making
+        an offer. Without this, each child's matcher sees the full fleet
+        and two services double-book the same chips; with it, contention
+        resolves by cycle order, which ``run_cycle`` sorts by
+        ``ServiceSpec.priority`` — priority enforced at offer matching."""
+        agents = self._multi.cluster.agents()
+        ledgers = self._multi.sibling_ledgers(self._name)
+        if not ledgers:
+            return agents
+        out = []
+        for a in agents:
+            cpus = mem = disk = tpus = 0.0
+            for ledger in ledgers:
+                c, m, d, t = ledger.reserved_scalars(a.agent_id)
+                cpus += c
+                mem += m
+                disk += d
+                tpus += t
+            if not (cpus or mem or disk or tpus):
+                out.append(a)
+                continue
+            tpu = a.tpu
+            if tpus:
+                tpu = dc_replace(tpu, chips=max(0, tpu.chips - int(tpus)))
+            out.append(dc_replace(
+                a, cpus=max(0.0, a.cpus - cpus),
+                memory_mb=max(0, a.memory_mb - int(mem)),
+                disk_mb=max(0, a.disk_mb - int(disk)),
+                tpu=tpu))
+        return out
 
     def launch(self, plan) -> None:
         for launch in plan.launches:
@@ -233,6 +265,15 @@ class MultiServiceScheduler:
         self._views: Dict[str, ServiceClusterView] = {}
         self._uninstalling: set[str] = set()
         self._ownership: Dict[str, str] = {}  # task_id -> service name
+        # actions issued by each service in the most recent cycle — the
+        # elastic Preemptor's starvation detector reads this (a starving
+        # high-priority service has pending work and a zero here)
+        self.last_cycle_actions: Dict[str, int] = {}
+        # optional (name, scheduler) -> bool hook ANDed into allow_expand
+        # (scheduler/elastic.py BackfillGate: low-priority services may
+        # only expand onto idle chips net of the serving headroom reserve)
+        self.expand_gate: Optional[Callable[[str, ServiceScheduler], bool]] \
+            = None
         cluster.set_status_callback(self._route_status)
         self._restore()
 
@@ -266,6 +307,14 @@ class MultiServiceScheduler:
     def get_service(self, name: str) -> Optional[ServiceScheduler]:
         with self._lock:
             return self._services.get(name)
+
+    def sibling_ledgers(self, name: str) -> List:
+        """Every OTHER service's reservation ledger — the
+        :class:`ServiceClusterView` nets these out of the capacity it
+        advertises, so one service's matcher never places onto chips a
+        sibling already holds."""
+        with self._lock:
+            return [s.ledger for n, s in self._services.items() if n != name]
 
     def add_service(self, spec: ServiceSpec, **scheduler_kwargs
                     ) -> ServiceScheduler:
@@ -374,7 +423,11 @@ class MultiServiceScheduler:
         HTTP calls on the remote path), matching the reference's
         single-threaded offer pipeline (``OfferProcessor.java:57``)."""
         with self._lock:
-            services = list(self._services.items())
+            # priority classes (ServiceSpec.priority): higher-priority
+            # services cycle first, so in a contended cluster the serving
+            # tier claims offers before training backfills the remainder
+            services = sorted(self._services.items(),
+                              key=lambda kv: (-kv[1].spec.priority, kv[0]))
             # uninstalling services no longer count against the footprint
             # cap (they only shrink); dropping them from the live set also
             # releases any grant they held mid-deploy
@@ -390,7 +443,12 @@ class MultiServiceScheduler:
                 # steps that would grow its reservations are held back
                 allow_expand = scheduler.uninstall_mode or \
                     self.discipline.may_reserve(name, deploy_complete)
-                actions += scheduler.run_cycle(allow_expand=allow_expand)
+                if (allow_expand and not scheduler.uninstall_mode
+                        and self.expand_gate is not None):
+                    allow_expand = self.expand_gate(name, scheduler)
+                issued = scheduler.run_cycle(allow_expand=allow_expand)
+                self.last_cycle_actions[name] = issued
+                actions += issued
                 if scheduler.uninstall_complete:
                     self._finalize_uninstall(name)
             return actions
@@ -410,6 +468,7 @@ class MultiServiceScheduler:
         with self._lock:
             scheduler = self._services.pop(name, None)
             self._views.pop(name, None)
+            self.last_cycle_actions.pop(name, None)
             self.service_store.remove(name)
             self._uninstalling.discard(name)
             self._persist_uninstalling()

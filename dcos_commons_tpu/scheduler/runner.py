@@ -50,12 +50,17 @@ class CycleDriver:
         if spec is None:
             return
         from ..analysis import errors, lint_spec
-        bad = errors(lint_spec(spec))
+        findings = lint_spec(spec)
+        bad = errors(findings)
         if bad:
             lines = "\n".join(str(f) for f in bad)
             raise ValueError(
                 f"service spec fails static analysis "
                 f"({len(bad)} error(s)):\n{lines}")
+        for f in findings:
+            # non-fatal findings (e.g. S8 priority-without-sentinel) still
+            # surface at boot; suppressible via lint_spec(suppress=...)
+            logging.getLogger(__name__).warning("spec lint: %s", f)
 
     def poke(self) -> None:
         """Run a cycle soon (new work arrived; reference revive analogue)."""

@@ -540,11 +540,19 @@ class ServiceSpec:
     web_url: Optional[str] = None
     replacement_failure_policy: Optional[ReplacementFailurePolicy] = None
     plans: tuple[PlanSpecModel, ...] = ()
+    # Scheduling priority class (Borg-style): when several services share one
+    # scheduler, higher-priority services win offer arbitration, and the
+    # Preemptor may evict whole gangs of a lower-priority service to place a
+    # higher one. 0 is the neutral default — equal-priority services never
+    # preempt each other.
+    priority: int = 0
 
     def validate(self) -> list[str]:
         errs = []
         if not self.name:
             errs.append("service name is empty")
+        if self.priority < 0:
+            errs.append(f"priority must be >= 0, got {self.priority}")
         if not self.pods:
             errs.append("service has no pods")
         pod_types = set()
@@ -627,6 +635,7 @@ def _service_from_dict(data: Mapping[str, Any]) -> ServiceSpec:
         pods=tuple(pods),
         user=data.get("user"),
         web_url=data.get("web_url"),
+        priority=data.get("priority", 0),
         replacement_failure_policy=ReplacementFailurePolicy(**rfp) if rfp else None,
         plans=tuple(
             PlanSpecModel(

@@ -129,6 +129,7 @@ def _map_raw(raw: Mapping[str, Any], env: Mapping[str, str], base_dir: str) -> S
         pods=pods,
         user=raw.get("user"),
         web_url=raw.get("web-url"),
+        priority=int(raw.get("priority", 0)),
         replacement_failure_policy=ReplacementFailurePolicy(
             permanent_failure_timeout_s=_seconds(rfp_raw.get("permanent-failure-timeout-mins"), 60),
             min_replace_delay_s=_seconds(rfp_raw.get("min-replace-delay-mins"), 60) or 0.0,

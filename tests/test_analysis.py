@@ -228,6 +228,38 @@ class TestSpecRules:
         assert lint_spec(make_spec([pod], plans=(plan,)),
                          suppress={"S7"}) == []
 
+    def test_s8_priority_without_sentinel_warns(self):
+        # a TPU pod in a prioritised service with no checkpoint/sentinel
+        # wiring: a preemption would silently discard its in-flight work
+        rs = (ResourceSet(id="rs", cpus=1.0, memory_mb=256, tpus=4),)
+        spec = dataclasses.replace(
+            make_spec([make_pod(resource_sets=rs)]), priority=5)
+        found = lint_spec(spec)
+        assert codes(found) == ["S8"]
+        assert found[0].severity is Severity.WARNING
+        assert errors(found) == []   # boot warns but does not refuse
+        assert lint_spec(spec, suppress={"S8"}) == []
+
+    def test_s8_wired_or_unprioritised_is_clean(self):
+        rs = (ResourceSet(id="rs", cpus=1.0, memory_mb=256, tpus=4),)
+        # priority 0 never participates in preemption
+        assert lint_spec(make_spec([make_pod(resource_sets=rs)])) == []
+        # sentinel env wiring satisfies the rule
+        wired = dataclasses.replace(
+            make_spec([make_pod(resource_sets=rs,
+                                env={"SENTINEL_STALL_S": "120"})]),
+            priority=5)
+        assert lint_spec(wired) == []
+        # ...as does a checkpoint path anywhere in cmd/env
+        ckpt = dataclasses.replace(
+            make_spec([make_pod(resource_sets=rs,
+                                cmd="train --checkpoint-dir /ckpt")]),
+            priority=5)
+        assert lint_spec(ckpt) == []
+        # cpu-only pods hold no TPUs, so preemption never targets them
+        cpu_only = dataclasses.replace(make_spec([make_pod()]), priority=5)
+        assert lint_spec(cpu_only) == []
+
     def test_lint_spec_suppression(self):
         plan = PlanSpecModel("deploy", phases=(
             PhaseSpec("a", "worker", deps=("a",)),))

@@ -15,6 +15,7 @@ from pathlib import Path
 import pytest
 
 from dcos_commons_tpu.chaos import FaultConfig, run_soak
+from dcos_commons_tpu.chaos.elastic_soak import run_elastic_soak
 from dcos_commons_tpu.chaos.engine import parse_faults
 from dcos_commons_tpu.plan.backoff import ExponentialBackoff
 from dcos_commons_tpu.state.state_store import StateStore
@@ -29,7 +30,15 @@ CORPUS = json.loads(
 
 
 def _entry_id(entry) -> str:
-    return f"{entry['faults']}-seed{entry['seed']}"
+    prefix = entry.get("harness", "")
+    prefix = f"{prefix}-" if prefix else ""
+    return f"{prefix}{entry['faults']}-seed{entry['seed']}"
+
+
+# harness key in a corpus entry routes it to the matching soak: the legacy
+# single-service storm or the two-service elastic storm (autoscaler +
+# preemptor + backfill active)
+HARNESSES = {"": run_soak, "elastic": run_elastic_soak}
 
 
 @pytest.mark.parametrize("entry", CORPUS, ids=_entry_id)
@@ -37,8 +46,9 @@ def test_corpus_seed_converges(entry):
     """Every pinned corpus schedule converges with zero violations. A new
     violating seed found anywhere (CI smoke, tpuctl chaos-soak, the slow
     sweep) gets appended to chaos_corpus.json once fixed."""
-    report = run_soak(entry["seed"], ticks=entry["ticks"],
-                      config=parse_faults(entry["faults"]))
+    soak = HARNESSES[entry.get("harness", "")]
+    report = soak(entry["seed"], ticks=entry["ticks"],
+                  config=parse_faults(entry["faults"]))
     assert report.converged, (
         f"seed {entry['seed']} did not converge: {report.plan_statuses}\n"
         + "\n".join(report.trace))
@@ -69,6 +79,28 @@ def test_hundred_seed_soak(seed):
     """The acceptance sweep: 100 seeded storms, all converge, zero
     invariant violations (ISSUE 5 acceptance criteria)."""
     report = run_soak(seed, ticks=40)
+    assert report.ok, (
+        f"seed {seed}: converged={report.converged} "
+        f"violations={[str(v) for v in report.violations]}\n"
+        + "\n".join(report.trace))
+
+
+def test_elastic_soak_deterministic():
+    """The elastic storm replays exactly from its seed too — scale events,
+    preemption records, flush/resume receipts and all."""
+    a = run_elastic_soak(3, ticks=20)
+    b = run_elastic_soak(3, ticks=20)
+    assert a.to_dict() == b.to_dict()
+    assert a.trace == b.trace
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(100))
+def test_hundred_seed_elastic_soak(seed):
+    """Elastic acceptance sweep (ISSUE 10): 100 seeded storms through the
+    autoscaler + preemptor + backfill control loop, all converge, zero
+    violations — including flush-grace and priority-inversion invariants."""
+    report = run_elastic_soak(seed, ticks=40)
     assert report.ok, (
         f"seed {seed}: converged={report.converged} "
         f"violations={[str(v) for v in report.violations]}\n"

@@ -1,0 +1,399 @@
+"""Elastic control plane tests (dcos_commons_tpu/scheduler/elastic.py).
+
+Covers the three controllers (autoscaler, preemptor, backfill gate) plus
+the back-pressure combinator and the rolling-window load gauges they
+consume. The scale/preemption integration tests run the same two-service
+fleet as the elastic chaos soak (chaos/elastic_soak.py) with the weather
+turned off, so every protocol step is deterministic and inspectable.
+"""
+
+import pytest
+
+from dcos_commons_tpu.chaos.elastic_soak import (AUTOSCALE, ElasticSoak,
+                                                 SERVE_YML, TRAIN_YML)
+from dcos_commons_tpu.chaos.engine import FaultConfig
+from dcos_commons_tpu.metrics import MetricsRegistry
+from dcos_commons_tpu.models.ingress import ServingFrontend
+from dcos_commons_tpu.plan import Status
+from dcos_commons_tpu.scheduler.elastic import (AutoscalerConfig,
+                                                HysteresisController,
+                                                backpressure,
+                                                pending_expansion_chips)
+from dcos_commons_tpu.specification import load_service_yaml_str
+
+
+# ------------------------------------------------------- back-pressure
+
+class TestBackpressure:
+    def test_empty_gauges_zero(self):
+        assert backpressure({}) == 0.0
+
+    def test_queue_fill_fraction(self):
+        assert backpressure({"queue_depth": 4, "queue_capacity": 16}) \
+            == pytest.approx(0.25)
+
+    def test_shedding_pins_to_one(self):
+        g = {"queue_depth": 1, "queue_capacity": 16, "shed": 3}
+        assert backpressure(g) == 1.0
+
+    def test_page_occupancy(self):
+        g = {"pages_total": 100, "pages_free": 10}
+        assert backpressure(g) == pytest.approx(0.9)
+
+    def test_ttft_against_slo(self):
+        g = {"ttft_p95_ms": 200.0}
+        assert backpressure(g) == 0.0          # no SLO configured: ignored
+        assert backpressure(g, ttft_slo_ms=200.0) == pytest.approx(0.8)
+        assert backpressure(g, ttft_slo_ms=100.0) == 1.0  # clamped
+
+    def test_max_over_signals(self):
+        g = {"queue_depth": 2, "queue_capacity": 16,
+             "pages_total": 10, "pages_free": 3}
+        assert backpressure(g) == pytest.approx(0.7)
+
+
+class TestAutoscalerConfig:
+    def test_from_env_contract(self):
+        env = {"AUTOSCALE_MIN": "2", "AUTOSCALE_MAX": "8",
+               "AUTOSCALE_HIGH": "0.9", "AUTOSCALE_LOW": "0.1",
+               "AUTOSCALE_DEBOUNCE": "4", "AUTOSCALE_COOLDOWN": "6",
+               "AUTOSCALE_STEP_UP": "2", "AUTOSCALE_TTFT_SLO_MS": "250"}
+        cfg = AutoscalerConfig.from_env("decode", env)
+        assert cfg.pod_type == "decode"
+        assert (cfg.min_count, cfg.max_count) == (2, 8)
+        assert (cfg.high_pressure, cfg.low_pressure) == (0.9, 0.1)
+        assert (cfg.debounce_ticks, cfg.cooldown_ticks) == (4, 6)
+        assert cfg.step_up == 2 and cfg.step_down == 1
+        assert cfg.ttft_slo_ms == 250.0
+
+    def test_from_env_defaults(self):
+        cfg = AutoscalerConfig.from_env("decode", {})
+        assert (cfg.min_count, cfg.max_count) == (1, 4)
+        assert cfg.ttft_slo_ms is None
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AutoscalerConfig(pod_type="p", min_count=5, max_count=2)
+        with pytest.raises(ValueError):
+            AutoscalerConfig(pod_type="p", low_pressure=0.8,
+                             high_pressure=0.4)
+        with pytest.raises(ValueError):
+            AutoscalerConfig(pod_type="p", debounce_ticks=0)
+
+
+class TestHysteresis:
+    CFG = AutoscalerConfig(pod_type="decode", min_count=1, max_count=4,
+                           high_pressure=0.75, low_pressure=0.25,
+                           debounce_ticks=3, cooldown_ticks=2)
+
+    def test_debounce_requires_consecutive_samples(self):
+        c = HysteresisController(self.CFG)
+        assert c.observe(0.9, 1) is None
+        assert c.observe(0.9, 1) is None
+        assert c.observe(0.9, 1) == 2      # third consecutive high
+
+    def test_dead_band_resets_streak(self):
+        c = HysteresisController(self.CFG)
+        c.observe(0.9, 1)
+        c.observe(0.9, 1)
+        c.observe(0.5, 1)                   # dead band: streak broken
+        assert c.observe(0.9, 1) is None
+        assert c.observe(0.9, 1) is None
+        assert c.observe(0.9, 1) == 2
+
+    def test_cooldown_quiet_window(self):
+        c = HysteresisController(self.CFG)
+        for _ in range(3):
+            proposed = c.observe(0.9, 1)
+        assert proposed == 2
+        # cooldown_ticks=2: the next two observations are swallowed even
+        # at max pressure, and the debounce streak restarts after
+        assert c.observe(1.0, 2) is None
+        assert c.observe(1.0, 2) is None
+        assert c.observe(1.0, 2) is None
+        assert c.observe(1.0, 2) is None
+        assert c.observe(1.0, 2) == 3
+
+    def test_scale_down_clamped_at_min(self):
+        c = HysteresisController(self.CFG)
+        for _ in range(2):
+            assert c.observe(0.0, 1) is None
+        assert c.observe(0.0, 1) is None    # already at min: hold
+
+    def test_scale_up_clamped_at_max(self):
+        c = HysteresisController(self.CFG)
+        for _ in range(2):
+            assert c.observe(1.0, 4) is None
+        assert c.observe(1.0, 4) is None    # already at max: hold
+
+
+# ------------------------------------------------- priority on the spec
+
+class TestPrioritySpec:
+    def test_yaml_priority_parsed(self):
+        spec = load_service_yaml_str(SERVE_YML)
+        assert spec.priority == 10
+        assert load_service_yaml_str(TRAIN_YML).priority == 1
+
+    def test_priority_defaults_to_zero(self):
+        yml = SERVE_YML.replace("priority: 10\n", "")
+        assert load_service_yaml_str(yml).priority == 0
+
+
+# ------------------------------------------- integration over the fleet
+#
+# ElasticSoak with FaultConfig.none() is a deterministic two-service
+# fleet (16 chips; serve priority 10 autoscaled 1..3, train priority 1
+# as a 2x4 gang) whose tick loop runs load sim -> controllers ->
+# reconcile. No RNG-driven weather fires.
+
+def quiet_soak(**kw):
+    """No weather; pass ``autoscale=False`` for manual-target tests (an
+    active hysteresis loop walks a forced target back down as soon as
+    quiet pressure sits below the low threshold)."""
+    soak = ElasticSoak(0, 0, FaultConfig.none(), **kw)
+    soak._t = 0                               # continuous test clock
+    return soak
+
+
+def settle(soak, ticks=30, until=None, flush=True):
+    """Run up to ``ticks`` quiet cycles on the soak's continuous clock
+    (grace windows and burst horizons are tick arithmetic, so tests must
+    never jump the clock); returns the tick the condition hit."""
+    for _ in range(ticks):
+        t = soak._t
+        soak._t += 1
+        if flush:
+            soak.flushsim.flush(t, soak.cluster)
+        soak.chaos.tick()
+        soak._cycle(t)
+        assert not soak._check(t) and not soak.violations, soak.violations
+        if until is not None and until():
+            return t
+    assert until is None, "condition not reached"
+    return soak._t
+
+
+class TestAutoscalerIntegration:
+    def test_scale_up_flows_through_deploy_plan(self):
+        soak = quiet_soak()
+        settle(soak, until=soak._converged)
+        assert soak.autoscaler.target == 1
+        # sustained burst: pressure > 0.7 for debounce_ticks=2 samples
+        soak.load.burst(soak._t, 60)
+        settle(soak, until=lambda: soak.autoscaler.target > 1)
+        serve = soak.multi.get_service("serve")
+        # resize is a config update: new PENDING deploy steps, and the
+        # plan completes by launching the new replica
+        settle(soak,
+               until=lambda: serve.plan("deploy").status is Status.COMPLETE
+               and soak._decode_running() >= 2)
+        assert soak.autoscaler.events, "no resize event recorded"
+        count, pressure = soak.autoscaler.events[0]
+        assert count == 2 and pressure >= AUTOSCALE.high_pressure
+
+    def test_scale_down_flows_through_decommission(self):
+        soak = quiet_soak()
+        settle(soak, until=soak._converged)
+        soak.autoscaler.force_target(2)
+        settle(soak, until=lambda: soak._decode_running() == 2)
+        # no burst: pressure sits below 0.2, tier walks back to min
+        settle(soak, until=lambda: soak.autoscaler.target == 1)
+        serve = soak.multi.get_service("serve")
+        settle(soak, until=lambda: soak._converged())
+        assert soak._decode_running() == 1
+        assert serve.decommission_manager._plan.status is Status.COMPLETE
+        # the drained replica's reservation is gone
+        assert not serve.ledger.for_pod("decode-1")
+
+    def test_resize_survives_scheduler_crash(self):
+        """The target lives in the persisted spec: a scheduler process
+        death mid-rollout resumes to the stored count, not the boot
+        count (crash-resumable acceptance)."""
+        soak = quiet_soak(autoscale=False)
+        settle(soak, until=soak._converged)
+        soak.autoscaler.force_target(3)
+        soak._restart()                       # die mid-rollout
+        assert soak.autoscaler.target == 3    # read back from the store
+        settle(soak, ticks=80, until=lambda: soak._decode_running() == 3)
+        serve = soak.multi.get_service("serve")
+        settle(soak, ticks=40,
+               until=lambda: serve.plan("deploy").status is Status.COMPLETE)
+
+    def test_force_target_clamps(self):
+        soak = quiet_soak(autoscale=False)
+        settle(soak, until=soak._converged)
+        assert soak.autoscaler.force_target(99) == AUTOSCALE.max_count
+        assert soak.autoscaler.target == AUTOSCALE.max_count
+
+
+class TestPreemptorIntegration:
+    @staticmethod
+    def grow_to_preemption(soak):
+        settle(soak, until=soak._converged)
+        assert soak._train_running() == 2     # gang backfilled
+        soak.autoscaler.force_target(3)       # 12 chips: must preempt
+        settle(soak, ticks=60, until=lambda: soak.preemptor.records)
+        return soak.preemptor.records[0]
+
+    def test_gang_evicted_whole_with_flush_grace(self):
+        soak = quiet_soak(autoscale=False)
+        rec = self.grow_to_preemption(soak)
+        # whole gang, never a partial slice
+        assert rec.pod_instances == ("learn-0", "learn-1")
+        settle(soak, ticks=60, until=lambda: not rec.inflight)
+        # clean exit: flushed within grace, never escalated; reclaim
+        # strictly after the terminal observation
+        assert rec.escalated_tick is None
+        assert rec.terminal_tick is not None
+        assert rec.reclaim_tick >= rec.terminal_tick
+        # both victims checkpoint-flushed (exit 143) before reclaim
+        assert {inst for _, inst, _ in soak.flushsim.flushes} \
+            >= set(rec.pod_instances)
+        settle(soak, ticks=60, until=lambda: soak._decode_running() == 3)
+
+    def test_preempted_gang_resumes_from_flushed_step(self):
+        """Satellite: the relaunched gang resumes from the checkpointed
+        step its sentinel flushed on SIGTERM, not from step 0."""
+        soak = quiet_soak(autoscale=False)
+        rec = self.grow_to_preemption(soak)
+        settle(soak, ticks=60, until=lambda: not rec.inflight)
+        flushed = {inst: step for _, inst, step in soak.flushsim.flushes}
+        assert all(step > 0 for step in flushed.values()), flushed
+        # scale serve back down so the gang can relaunch
+        soak.autoscaler.force_target(1)
+        settle(soak, ticks=80, until=lambda: soak._train_running() == 2)
+        settle(soak, ticks=5)                 # let advance() observe them
+        resumed = {inst: step for _, inst, step in soak.flushsim.resumes}
+        for inst in rec.pod_instances:
+            assert resumed.get(inst) == flushed[inst], (resumed, flushed)
+
+    def test_grace_expiry_escalates_then_reclaims_on_killed(self):
+        """A victim that never answers SIGTERM is escalated after
+        grace_ticks — and reclaim still waits for the KILLED status."""
+        soak = quiet_soak(autoscale=False)
+        rec = self.grow_to_preemption(soak)
+        # go deaf NOW: the victims' SIGTERMs are never answered (the
+        # sentinel hung mid-flush), so the grace window must expire
+        settle(soak, ticks=60, flush=False, until=lambda: not rec.inflight)
+        assert rec.escalated_tick is not None
+        assert rec.escalated_tick - rec.term_tick >= rec.grace_ticks
+        assert rec.terminal_tick >= rec.escalated_tick
+        assert rec.reclaim_tick >= rec.terminal_tick
+        # the escalated kill is what terminated them, not a flush
+        assert not soak.flushsim.flushes
+
+    def test_priority_never_preempts_upward(self):
+        """Training (priority 1) starving must not evict serving: victims
+        only come from strictly lower priorities, and the floor service is
+        never counted as starving."""
+        soak = quiet_soak(autoscale=False)
+        settle(soak, until=soak._converged)
+        # occupy everything: serve@3 (12 chips) + train gang pending
+        soak.autoscaler.force_target(3)
+        settle(soak, ticks=80, until=lambda: soak._decode_running() == 3)
+        records = list(soak.preemptor.records)
+        settle(soak, ticks=20)
+        # train starves (gang can't place behind the reserve) but no new
+        # preemption targets serve
+        assert soak.preemptor.records == records
+
+
+class TestBackfillGate:
+    def test_idle_chip_census(self):
+        soak = quiet_soak()
+        settle(soak, until=soak._converged)
+        # 16 chips - serve@1 (4) - train gang (8) = 4 idle
+        assert soak.backfill.idle_chips() == 4
+
+    def test_training_gated_behind_reserve(self):
+        """After preemption hands the chips to serve@3, the evicted gang
+        wants back in (pending 8 chips) but only 4 are idle — the gate
+        holds it out rather than letting it eat the serving reserve."""
+        soak = quiet_soak(autoscale=False)
+        rec = TestPreemptorIntegration.grow_to_preemption(soak)
+        settle(soak, ticks=60, until=lambda: not rec.inflight)
+        settle(soak, ticks=60, until=lambda: soak._decode_running() == 3)
+        settle(soak, ticks=10)
+        train = soak.multi.get_service("train")
+        assert pending_expansion_chips(train) == 8
+        assert soak.backfill.idle_chips() == 4    # 16 - serve@3 (12)
+        assert not soak.backfill.may_expand("train", train)
+        assert soak.backfill.gated_count > 0
+        assert soak._train_running() == 0
+
+    def test_top_priority_never_gated(self):
+        soak = quiet_soak()
+        settle(soak, until=soak._converged)
+        serve = soak.multi.get_service("serve")
+        assert soak.backfill.may_expand("serve", serve)
+
+    def test_metrics_counters(self):
+        reg = MetricsRegistry()
+        soak = quiet_soak(autoscale=False)
+        soak.autoscaler.metrics = reg
+        soak.preemptor.metrics = reg
+        soak.backfill.metrics = reg
+        settle(soak, until=soak._converged)
+        soak.autoscaler.force_target(3)
+        settle(soak, ticks=60,
+               until=lambda: soak.preemptor.records
+               and not soak.preemptor.records[0].inflight)
+        settle(soak, ticks=5)  # post-reclaim cycles: backfill gate fires
+        counters = reg.to_dict()["counters"]
+        assert counters["elastic.scale_up"] >= 1
+        assert counters["elastic.preemptions"] == 1
+        assert counters["elastic.preempted_pods"] == 2
+        assert counters.get("elastic.backfill_gated", 0) >= 1
+
+
+# ------------------------------------------- rolling-window load gauges
+
+class _StubEngine:
+    slots = 2
+
+    def free_slots(self):
+        return [0, 1]
+
+
+class TestLoadGauges:
+    def make(self, **kw):
+        return ServingFrontend(_StubEngine(), port=0, host="127.0.0.1",
+                               max_queue=8, **kw)
+
+    def test_gauge_shape_matches_autoscaler_contract(self):
+        fe = self.make()
+        g = fe.load_gauges()
+        assert set(g) >= {"window_s", "queue_depth", "queue_capacity",
+                          "completed", "shed", "shed_rate", "ttft_p95_ms"}
+        assert g["queue_capacity"] == 8
+        assert g["shed"] == 0 and g["completed"] == 0
+        assert backpressure(g) == 0.0
+
+    def test_window_expires_old_samples(self):
+        import time as _time
+        fe = self.make(window_s=60.0)
+        now = _time.monotonic()
+        with fe._lock:
+            fe._sheds.append(now - 120)           # outside the window
+            fe._sheds.append(now - 1)             # inside
+            fe._window.append((now - 120, 5.0, 1.0))
+            fe._window.append((now - 2, 10.0, 1.0))
+            fe._window.append((now - 1, 30.0, 2.0))
+        g = fe.load_gauges()
+        assert g["shed"] == 1
+        assert g["completed"] == 2
+        assert g["shed_rate"] == pytest.approx(1 / 3)
+        assert g["ttft_p95_ms"] is not None
+
+    def test_shedding_drives_backpressure(self):
+        import time as _time
+        fe = self.make()
+        with fe._lock:
+            fe._sheds.append(_time.monotonic())
+        assert backpressure(fe.load_gauges()) == 1.0
+
+    def test_healthz_and_stats_carry_the_window(self):
+        fe = self.make()
+        assert fe.health()["load"] == fe.load_gauges()
+        assert fe.stats()["window"] == fe.load_gauges()

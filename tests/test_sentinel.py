@@ -199,3 +199,95 @@ def test_watch_noop_when_stall_disabled():
     sent = FaultSentinel(stall_s=0.0, abort=lambda s, t: pytest.fail("armed"))
     with sent.watch(0):
         time.sleep(0.01)
+
+
+# -- preemption flush under a real SIGTERM (elastic flush-grace contract) --
+
+def test_sigterm_mid_step_flushes_once_and_stops():
+    """SIGTERM delivered while a step (with its periodic checkpoint write)
+    is in flight: the in-progress work finishes, the loop flushes exactly
+    one checkpoint at the next boundary, and no further step runs."""
+    sent = FaultSentinel()
+    sent.install()
+    saves = []
+    seen = []
+    try:
+        def run_step(i):
+            seen.append(i)
+            if i == 3:
+                os.kill(os.getpid(), signal.SIGTERM)
+                for _ in range(500):     # handler runs on a next bytecode
+                    if sent.preempted:
+                        break
+                    time.sleep(0.01)
+                assert sent.preempted
+            return 0.1
+
+        reason, nxt = guarded_loop(sent, 0, 10, run_step, lambda r: r,
+                                   saves.append, lambda: None)
+    finally:
+        sent.uninstall()
+    assert (reason, nxt) == ("preempted", 4)
+    assert saves == [4]              # exactly one flush, no double-save
+    assert seen == [0, 1, 2, 3]      # nothing runs after the signal
+
+
+_PREEMPT_CHILD = r'''
+import sys, time
+from frameworks.jax.sentinel import FaultSentinel, guarded_loop
+
+sent = FaultSentinel()
+sent.install()
+flushes = []
+
+def run_step(i):
+    if i == 1:
+        print("CKPT_BEGIN", flush=True)
+        time.sleep(3.0)              # checkpoint write in progress
+        print("CKPT_END", flush=True)
+    else:
+        time.sleep(0.02)
+    return 0.1
+
+def save(i):
+    flushes.append(i)
+    print("FLUSH %d" % i, flush=True)
+
+reason, _ = guarded_loop(sent, 0, 10_000, run_step, lambda r: r,
+                         save, lambda: None)
+assert reason == "preempted", reason
+assert len(flushes) == 1, flushes
+sys.exit(143)                        # the worker-main SIGTERM convention
+'''
+
+
+def test_sigterm_mid_checkpoint_exits_143_within_grace():
+    """End-to-end flush-grace contract (the scheduler side of this is
+    Preemptor.grace_ticks): a worker-shaped child SIGTERM'd in the middle
+    of a checkpoint write lets the write finish, flushes once, and exits
+    143 well inside the grace window — never a second checkpoint, never
+    an unclean exit code."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PREEMPT_CHILD],
+        cwd=Path(__file__).resolve().parent.parent,
+        stdout=subprocess.PIPE, text=True)
+    try:
+        lines = []
+        for line in proc.stdout:
+            lines.append(line.strip())
+            if line.startswith("CKPT_BEGIN"):
+                proc.send_signal(signal.SIGTERM)   # mid-checkpoint
+                break
+        lines += [l.strip() for l in proc.stdout]  # drain to EOF
+        rc = proc.wait(timeout=30)                 # the "grace window"
+    finally:
+        proc.kill()
+    assert rc == 143, (rc, lines)
+    flushes = [l for l in lines if l.startswith("FLUSH")]
+    assert flushes == ["FLUSH 2"], lines
+    # the interrupted checkpoint completed before the flush
+    assert lines.index("CKPT_END") < lines.index("FLUSH 2")

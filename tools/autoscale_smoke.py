@@ -1,0 +1,58 @@
+"""Time-capped elastic-control-plane smoke for CI.
+
+Same shape as ``tools/chaos_smoke.py`` but routed through the elastic
+soak harness: every schedule runs a serve + train fleet with the
+back-pressure autoscaler, priority preemptor and training backfill all
+live, plus the scale-event fault classes (scale_up_burst, preempt_storm,
+victim_crash_in_grace, scale_mid_crash) armed alongside the legacy ones.
+The 100-seed acceptance sweep lives in ``tests/test_chaos.py`` behind
+``@pytest.mark.slow`` and ``tpuctl autoscale-soak``; this slice keeps the
+always-on CI gate honest without blowing its time budget — a slow host
+skips tail seeds rather than timing out the build.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=8,
+                    help="sweep seeds 0..N-1 (default 8)")
+    ap.add_argument("--ticks", type=int, default=30,
+                    help="storm ticks per schedule (default 30)")
+    ap.add_argument("--budget-s", type=float, default=60.0,
+                    help="wall-clock cap; tail seeds are skipped, not "
+                         "failed, when it runs out (default 60)")
+    args = ap.parse_args(argv)
+
+    from dcos_commons_tpu.chaos.elastic_soak import run_elastic_soak
+
+    deadline = time.monotonic() + args.budget_s
+    ran = 0
+    for seed in range(args.seeds):
+        if time.monotonic() >= deadline:
+            print(f"autoscale-smoke: time budget exhausted after {ran} "
+                  f"seeds (of {args.seeds}); remaining seeds skipped")
+            break
+        report = run_elastic_soak(seed, ticks=args.ticks)
+        ran += 1
+        if not report.ok:
+            print(json.dumps(report.to_dict(), indent=1))
+            print(f"\nautoscale-smoke FAILED at seed {seed} (reproduce: "
+                  f"python -m dcos_commons_tpu.cli.main autoscale-soak "
+                  f"--seed {seed} --ticks {args.ticks})", file=sys.stderr)
+            for line in report.trace:
+                print(f"  {line}", file=sys.stderr)
+            return 1
+    print(f"autoscale-smoke: {ran} seeds converged, "
+          "zero invariant violations")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

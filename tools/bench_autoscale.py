@@ -1,0 +1,130 @@
+"""Elasticity A/B bench: autoscaled decode tier vs a static fleet.
+
+Drives the elastic soak harness directly — chaos weather OFF, a scripted
+Poisson-ish load swing ON — twice per seed:
+
+* ``autoscaled``: the back-pressure autoscaler resizes the decode tier
+  through deploy plans; scale-up starves the training gang, so the
+  preemptor fires (SIGTERM -> checkpoint flush -> exit 143 -> reclaim)
+  and the backfill gate re-admits training once the burst passes.
+* ``static``: same seed, same arrivals, no autoscaler — the 1-replica
+  decode tier sheds everything a burst throws past its queue.
+
+Receipts land in ``bench_r10/autoscale.jsonl`` (one line per run plus an
+A/B summary per seed): scale events with the pressure that triggered
+them, preemption records with flush/resume steps, and the shed-rate
+comparison. Exit 1 if any run fails its invariants or the autoscaled
+variant fails to beat the static baseline's shed rate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+# one burst per third of the storm window: quiet -> swing -> quiet, so a
+# run exercises scale-up, preemption, scale-down and backfill re-admission
+BURST_SCHEDULE = ((6, 10), (30, 8))
+DEFAULT_TICKS = 48
+
+
+def run_variant(seed: int, ticks: int, autoscale: bool) -> dict:
+    from dcos_commons_tpu.chaos.elastic_soak import ElasticSoak
+    from dcos_commons_tpu.chaos.engine import FaultConfig
+
+    soak = ElasticSoak(seed, ticks, FaultConfig.none(),
+                       autoscale=autoscale, burst_schedule=BURST_SCHEDULE)
+    report = soak.run()
+    shed, done = soak.load.total_shed, soak.load.total_done
+    return {
+        "metric": "elastic_ab",
+        "variant": "autoscaled" if autoscale else "static",
+        "seed": seed,
+        "ticks": ticks,
+        "burst_schedule": [list(b) for b in BURST_SCHEDULE],
+        "converged": report.converged,
+        "violations": [str(v) for v in report.violations],
+        "requests_done": done,
+        "requests_shed": shed,
+        "shed_rate": round(shed / max(1, shed + done), 4),
+        "scale_events": [[n, round(p, 3)]
+                         for n, p in soak.autoscaler.events],
+        "final_decode_target": soak.autoscaler.target,
+        "preemptions": [{
+            "service": r.service,
+            "pod_instances": list(r.pod_instances),
+            "term_tick": r.term_tick,
+            "terminal_tick": r.terminal_tick,
+            "escalated_tick": r.escalated_tick,
+            "reclaim_tick": r.reclaim_tick,
+            "reclaimed_tasks": list(r.reclaimed_tasks),
+        } for r in soak.preemptor.records],
+        "checkpoint_flushes": [
+            {"tick": t, "instance": inst, "step": step}
+            for t, inst, step in soak.flushsim.flushes],
+        "checkpoint_resumes": [
+            {"tick": t, "instance": inst, "step": step}
+            for t, inst, step in soak.flushsim.resumes],
+        "plan_statuses": report.plan_statuses,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=3,
+                    help="A/B pairs to run, seeds 0..N-1 (default 3)")
+    ap.add_argument("--ticks", type=int, default=DEFAULT_TICKS,
+                    help=f"storm ticks per run (default {DEFAULT_TICKS})")
+    ap.add_argument("--out", default="bench_r10/autoscale.jsonl",
+                    help="receipts file (default bench_r10/autoscale.jsonl)")
+    args = ap.parse_args(argv)
+
+    lines = []
+    failed = False
+    for seed in range(args.seeds):
+        auto = run_variant(seed, args.ticks, autoscale=True)
+        static = run_variant(seed, args.ticks, autoscale=False)
+        improved = auto["shed_rate"] < static["shed_rate"]
+        ok = (auto["converged"] and static["converged"]
+              and not auto["violations"] and not static["violations"]
+              and improved)
+        summary = {
+            "metric": "elastic_ab_summary",
+            "seed": seed,
+            "shed_rate_autoscaled": auto["shed_rate"],
+            "shed_rate_static": static["shed_rate"],
+            "shed_improvement": round(
+                static["shed_rate"] - auto["shed_rate"], 4),
+            "scale_events": len(auto["scale_events"]),
+            "preemptions": len(auto["preemptions"]),
+            "flushes": len(auto["checkpoint_flushes"]),
+            "resumes": len(auto["checkpoint_resumes"]),
+            "ok": ok,
+        }
+        lines += [auto, static, summary]
+        print(f"seed {seed}: shed autoscaled={auto['shed_rate']:.3f} "
+              f"static={static['shed_rate']:.3f} "
+              f"scale_events={len(auto['scale_events'])} "
+              f"preemptions={len(auto['preemptions'])} "
+              f"flushes={len(auto['checkpoint_flushes'])} "
+              f"resumes={len(auto['checkpoint_resumes'])} "
+              f"{'OK' if ok else 'FAIL'}")
+        if not ok:
+            failed = True
+
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    with out.open("w") as fh:
+        for line in lines:
+            fh.write(json.dumps(line) + "\n")
+    print(f"wrote {len(lines)} receipt line(s) to {out}")
+    if failed:
+        print("bench_autoscale: FAILED — see receipts", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
