@@ -24,10 +24,17 @@ never persists, and no cross-service double-booking. Convergence at
 settle additionally requires the live decode fleet to match the
 controller's persisted target — the "fleet converges" invariant.
 
+A third layer rides on the same ticks: :class:`_RouterSim` drives the
+REAL fleet front-door primitives (``models/router.py``) against the live
+decode tier, with two more fault classes (``router_replica_down``,
+``tenant_flood``) and :class:`RouterInvariantChecker` auditing tenant
+isolation, spill-before-drop, and relay progress. Settle additionally
+requires every admitted relay to have completed.
+
 Determinism contract matches ``chaos/soak.py``: one ``random.Random(seed)``
-drives the scheduler-facing weather; the load and flush simulators run on
-their own derived RNGs so arming a new fault class never perturbs the
-draw order of a pinned seed.
+drives the scheduler-facing weather; the load, flush, and router
+simulators run on their own derived RNGs so arming a new fault class
+never perturbs the draw order of a pinned seed.
 """
 
 from __future__ import annotations
@@ -36,6 +43,7 @@ import random
 from typing import Dict, List, Optional, Tuple
 
 from ..agent.fake import FakeCluster
+from ..models.router import HashRing, QoSClass, TenantAdmission, route_key
 from ..plan.backoff import ExponentialBackoff
 from ..plan.status import Status
 from ..scheduler.core import ServiceScheduler
@@ -48,7 +56,8 @@ from ..state.persister import MemPersister
 from ..state.tasks import TaskState
 from ..testing.simulation import default_agents, tpu_slice_agents
 from .engine import ChaosCluster, FaultConfig
-from .invariants import ElasticInvariantChecker, InvariantChecker, Violation
+from .invariants import (ElasticInvariantChecker, InvariantChecker,
+                         RouterInvariantChecker, Violation)
 from .soak import SETTLE_BUDGET, SoakReport
 
 SERVE_YML = """
@@ -154,6 +163,156 @@ class _LoadSim:
             "shed": self._window_sum(self.shed_log),
             "ttft_p95_ms": None,
         }
+
+
+class _RouterSim:
+    """The fleet front door under the same weather: the REAL router
+    primitives (``models/router.py`` — :class:`HashRing`,
+    :class:`TenantAdmission`, :func:`route_key`) driven against the live
+    decode tier. Two tenants send shared-prefix prompts every storm tick
+    (gold's arrival rate fits inside its token bucket; bronze's does too
+    until a ``tenant_flood`` fires); admitted prompts become multi-tick
+    relays pinned to their prefix's ring arc, and a replica death —
+    scheduler weather killing/relaunching the decode task, or
+    ``router_replica_down`` silencing the process while the scheduler
+    still believes it RUNNING — forces the relay to spill to a surviving
+    replica. Receipts feed :class:`~.invariants.RouterInvariantChecker`:
+    a shed of a within-profile tenant, a drop without a spill attempt,
+    or a relay stalled while replicas are live is an invariant
+    violation, not bad luck.
+
+    Runs entirely on derived RNGs (arrivals/durations on one, fault
+    decisions on another), so arming the router fault classes never
+    perturbs the scheduler-facing draw order of a pinned seed."""
+
+    GOLD_ARRIVALS = 2      # per tick; < gold's refill rate: NEVER shed
+    BRONZE_ARRIVALS = 1    # < bronze's refill rate outside floods
+    FLOOD_ARRIVALS = 12    # far past bronze's bucket
+    RELAY_TICKS = (2, 4)   # decode duration range, inclusive
+    PAGE = 4               # affinity page size, tokens
+    PREFIXES = 4           # shared-prefix pool
+    STALL_WINDOW = 6       # ticks a relay may sit unserved w/ live replicas
+    PARK_LIMIT = 10        # ticks with NO live replica before a drop
+
+    CLASSES = {
+        "gold": QoSClass("gold", priority=10, rate=3.0, burst=6.0),
+        "bronze": QoSClass("bronze", priority=1, rate=2.0, burst=4.0),
+    }
+
+    def __init__(self, seed: int):
+        self.rng = random.Random((seed << 26) ^ 0xD1B54A32D192ED03)
+        self.fault_rng = random.Random((seed << 30) ^ 0x94D049BB133111EB)
+        self._now = 0
+        self.admission = TenantAdmission(self.CLASSES,
+                                         clock=lambda: float(self._now))
+        self.ring = HashRing(vnodes=16)
+        self.relays: List[dict] = []
+        self.down_until: Dict[str, int] = {}   # replica -> sim-down expiry
+        self.flood_until = -1
+        self._serial = 0
+        self._task_ids: Dict[str, str] = {}
+        # receipts audited by RouterInvariantChecker
+        self.bad_sheds: List[Tuple[int, str]] = []
+        self.drops: List[Tuple[int, str, int, bool]] = []
+        self.completed = 0
+        self.total_spills = 0
+
+    def flood(self, tick: int, duration: int) -> None:
+        self.flood_until = max(self.flood_until, tick + duration)
+
+    def _up(self, name: str, tick: int) -> bool:
+        return self.down_until.get(name, -1) <= tick
+
+    def kill_replica(self, tick: int) -> Optional[str]:
+        """``router_replica_down``: silence the replica carrying the most
+        relays (the worst case) for 1-2 ticks. The scheduler's view is
+        untouched — the task stays RUNNING; only the router must react."""
+        live = [n for n in self.ring.nodes() if self._up(n, tick)]
+        if not live:
+            return None
+        counts = {n: sum(1 for r in self.relays if r["replica"] == n)
+                  for n in live}
+        victim = max(sorted(counts), key=lambda n: counts[n])
+        self.down_until[victim] = tick + self.fault_rng.randint(1, 2)
+        return victim
+
+    def _flooding(self, tenant: str, tick: int) -> bool:
+        return tenant == "bronze" and tick < self.flood_until
+
+    def inflight(self) -> int:
+        return len(self.relays)
+
+    def tick(self, tick: int, decode_tasks: List[Tuple[str, str]],
+             storm: bool = True) -> None:
+        self._now = tick
+        live = dict(decode_tasks)
+        # ring membership follows the live decode tier
+        for name in [n for n in self.ring.nodes() if n not in live]:
+            self.ring.remove(name)
+        for name in live:
+            if name not in self.ring.nodes():
+                self.ring.add(name)
+        # a relaunched task (same name, new task id) is a NEW process:
+        # a relay pinned to the old incarnation spills exactly like a death
+        reborn = {n for n, tid in live.items()
+                  if self._task_ids.get(n, tid) != tid}
+        self._task_ids = dict(live)
+        up = [n for n in live if self._up(n, tick)]
+        if storm:
+            arrivals = [("gold", self.GOLD_ARRIVALS),
+                        ("bronze", self.FLOOD_ARRIVALS
+                         if tick < self.flood_until
+                         else self.BRONZE_ARRIVALS)]
+            for tenant, count in arrivals:
+                for _ in range(count):
+                    self._serial += 1
+                    prefix = self.rng.randrange(self.PREFIXES)
+                    prompt = [prefix] * self.PAGE + [self._serial]
+                    ok, _cls = self.admission.admit(tenant, tenant)
+                    if not ok:
+                        if not self._flooding(tenant, tick):
+                            self.bad_sheds.append((tick, tenant))
+                        continue
+                    self.relays.append({
+                        "id": f"r{self._serial}", "tenant": tenant,
+                        "key": route_key(prompt, self.PAGE),
+                        "replica": None, "ever_placed": False,
+                        "left": self.rng.randint(*self.RELAY_TICKS),
+                        "attempts": 0, "stalled": 0, "parked": 0,
+                        "born": tick,
+                    })
+        finished = []
+        for r in self.relays:
+            rep = r["replica"]
+            if rep is not None and (rep not in live or rep in reborn
+                                    or not self._up(rep, tick)):
+                # the replica died under the relay: spill attempt
+                r["attempts"] += 1
+                self.total_spills += 1
+                r["replica"] = rep = None
+            if rep is None:
+                for cand in self.ring.preference(r["key"]):
+                    if cand in up:
+                        r["replica"] = rep = cand
+                        r["ever_placed"] = True
+                        break
+            if rep is None:
+                if up:
+                    # capacity existed and the relay still went unserved
+                    r["stalled"] += 1
+                else:
+                    r["parked"] += 1
+                    if r["parked"] > self.PARK_LIMIT:
+                        self.drops.append((tick, r["id"], r["attempts"],
+                                           r["ever_placed"]))
+                        finished.append(r)
+                continue
+            r["left"] -= 1
+            if r["left"] <= 0:
+                self.completed += 1
+                finished.append(r)
+        for r in finished:
+            self.relays.remove(r)
 
 
 class _FlushSim:
@@ -301,6 +460,7 @@ class ElasticSoak:
 
         self.load = _LoadSim(seed)
         self.flushsim = _FlushSim(seed)
+        self.routersim = _RouterSim(seed)
         self.autoscaler = Autoscaler(lambda: self.multi, "serve", AUTOSCALE,
                                      self.load.gauges)
         self.preemptor = Preemptor(lambda: self.multi,
@@ -314,6 +474,7 @@ class ElasticSoak:
         self.checkers = [_ChildChecker(_ChildView(self, "serve")),
                          _ChildChecker(_ChildView(self, "train"))]
         self.elastic_checker = ElasticInvariantChecker(self)
+        self.router_checker = RouterInvariantChecker(self)
 
     # -- scheduler lifecycle -----------------------------------------------
 
@@ -365,6 +526,14 @@ class ElasticSoak:
         return sum(1 for t in self.cluster.live_tasks()
                    if t.task_name.startswith("learn-")
                    and t.state is TaskState.RUNNING)
+
+    def _decode_tasks(self) -> List[Tuple[str, str]]:
+        """RUNNING decode replicas as (task_name, task_id) — the router
+        sim's view of the tier; the id distinguishes incarnations."""
+        return sorted((t.task_name, t.task_id)
+                      for t in self.cluster.live_tasks()
+                      if t.task_name.startswith("decode-")
+                      and t.state is TaskState.RUNNING)
 
     # -- environment faults --------------------------------------------------
 
@@ -448,6 +617,22 @@ class ElasticSoak:
                                             "flush grace")
                 self._count("victim_crash_in_grace")
                 self._log(f"tick {tick}: victim_crash_in_grace {victim}")
+        # -- front-door faults (router sim's own RNG: arming them never
+        # -- perturbs the scheduler-facing draw order of pinned seeds) --
+        if cfg.router_replica_down and self.routersim.fault_rng.random() \
+                < cfg.router_replica_down:
+            victim = self.routersim.kill_replica(tick)
+            if victim is not None:
+                self._count("router_replica_down")
+                self._log(f"tick {tick}: router_replica_down {victim} "
+                          "(silent to the router, RUNNING to the scheduler)")
+        if cfg.tenant_flood and self.routersim.fault_rng.random() \
+                < cfg.tenant_flood:
+            duration = self.routersim.fault_rng.randint(3, 6)
+            self.routersim.flood(tick, duration)
+            self._count("tenant_flood")
+            self._log(f"tick {tick}: tenant_flood bronze x"
+                      f"{_RouterSim.FLOOD_ARRIVALS} for {duration} ticks")
         if cfg.scale_mid_crash and rng.random() < cfg.scale_mid_crash:
             # force a resize so a scale plan is guaranteed in flight, then
             # kill the scheduler mid-rollout; the restored plans resume it
@@ -484,6 +669,7 @@ class ElasticSoak:
         for checker in self.checkers:
             found += checker.check(tick)
         found += self.elastic_checker.check(tick)
+        found += self.router_checker.check(tick)
         for v in found:
             self._log(f"VIOLATION {v}")
         self.violations.extend(found)
@@ -493,6 +679,9 @@ class ElasticSoak:
         if tick in self.burst_schedule:
             self.load.burst(tick, self.burst_schedule[tick])
         self.load.tick(tick, self._decode_running())
+        # storm ticks admit new front-door traffic; settle only drains
+        self.routersim.tick(tick, self._decode_tasks(),
+                            storm=tick < self.ticks)
         self.flushsim.advance(tick, self.cluster)
         self.controller.tick(tick)
         for name in self.multi.service_names():
@@ -524,7 +713,8 @@ class ElasticSoak:
                 and not self.cluster.pending_term_tasks()
                 and not self.preemptor.inflight
                 and self._decode_running() == (self.autoscaler.target or 0)
-                and self._train_running() == 2)
+                and self._train_running() == 2
+                and self.routersim.inflight() == 0)
 
     def run(self) -> SoakReport:
         for tick in range(self.ticks):
@@ -562,7 +752,8 @@ class ElasticSoak:
                 f"train={self._train_running()} "
                 f"inflight_preemptions={len(self.preemptor.inflight)} "
                 f"pending_events={self.chaos.pending_events} "
-                f"term_pending={self.cluster.pending_term_tasks()}")
+                f"term_pending={self.cluster.pending_term_tasks()} "
+                f"relays_inflight={self.routersim.inflight()}")
 
         plan_statuses = {}
         for name in self.multi.service_names():

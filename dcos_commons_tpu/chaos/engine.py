@@ -88,13 +88,25 @@ class FaultConfig:
     preempt_storm: float = 0.0
     victim_crash_in_grace: float = 0.0
     scale_mid_crash: float = 0.0
+    # front-door faults (elastic soak harness router sim over the REAL
+    # models/router.py primitives): a decode replica stops answering the
+    # router while the scheduler still believes it RUNNING — every
+    # admitted relay pinned to it must spill, never silently drop
+    # (router_replica_down); one tenant slams arrivals far past its
+    # token bucket — its own bucket absorbs the flood and no other
+    # tenant's admission or in-flight relays may starve (tenant_flood).
+    # Both draw from the router sim's derived RNG, so arming them never
+    # perturbs the scheduler-facing draw order of pinned seeds.
+    router_replica_down: float = 0.0
+    tenant_flood: float = 0.0
     max_delay_ticks: int = 3
 
     FIELDS = ("status_drop", "status_delay", "status_dup", "status_reorder",
               "launch_fail", "launch_slow", "agent_flap", "agent_loss",
               "degrade", "task_crash", "crash_restart", "page_leak",
               "kv_ship_lost", "kv_ship_slow", "scale_up_burst",
-              "preempt_storm", "victim_crash_in_grace", "scale_mid_crash")
+              "preempt_storm", "victim_crash_in_grace", "scale_mid_crash",
+              "router_replica_down", "tenant_flood")
 
     @classmethod
     def none(cls) -> "FaultConfig":
@@ -124,7 +136,8 @@ class FaultConfig:
                        task_crash=0.0, crash_restart=0.0, page_leak=0.0,
                        kv_ship_lost=0.0, kv_ship_slow=0.0,
                        scale_up_burst=0.0, preempt_storm=0.0,
-                       victim_crash_in_grace=0.0, scale_mid_crash=0.0)
+                       victim_crash_in_grace=0.0, scale_mid_crash=0.0,
+                       router_replica_down=0.0, tenant_flood=0.0)
 
 
 def parse_faults(arg: str) -> FaultConfig:

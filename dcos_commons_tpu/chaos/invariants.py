@@ -314,3 +314,57 @@ class ElasticInvariantChecker:
                     f"({agent.cpus}, {agent.memory_mb}, {agent.disk_mb}, "
                     f"{agent.tpu.chips})", tick))
         return out
+
+
+class RouterInvariantChecker:
+    """Front-door invariants over the elastic harness's router sim
+    (``chaos/elastic_soak.py``), which drives the REAL
+    ``models/router.py`` admission and ring primitives against the live
+    decode tier:
+
+    10. **tenant isolation** — admission is per-tenant token buckets: a
+        tenant whose own arrival rate fits inside its configured bucket
+        is never shed, no matter how hard another tenant floods
+        (``tenant_flood``). A shed of a within-profile tenant means one
+        tenant's flood drained another tenant's budget.
+    11. **spill-before-drop** — an admitted relay whose replica dies
+        (``router_replica_down``, or scheduler weather taking the decode
+        task) is re-placed on a surviving replica — a *spill attempt* —
+        before it may ever be dropped. A drop receipt with zero attempts
+        from a relay that was actually being served means the front door
+        silently lost an admitted stream.
+    12. **no stalled relays** — an admitted relay makes progress every
+        tick at least one replica is up; a relay starved past the stall
+        window while capacity existed is a routing wedge, not load.
+    """
+
+    def __init__(self, harness):
+        self._h = harness          # needs .routersim
+        self._sheds_seen = 0
+        self._drops_seen = 0
+
+    def check(self, tick: int) -> List[Violation]:
+        sim = self._h.routersim
+        out: List[Violation] = []
+        for t, tenant in sim.bad_sheds[self._sheds_seen:]:
+            out.append(Violation(
+                "tenant-isolation",
+                f"{tenant} shed at tick {t} while inside its own bucket "
+                "profile (another tenant's flood drained its budget)",
+                tick))
+        self._sheds_seen = len(sim.bad_sheds)
+        for t, rid, attempts, ever_placed in sim.drops[self._drops_seen:]:
+            if ever_placed and attempts == 0:
+                out.append(Violation(
+                    "spill-before-drop",
+                    f"relay {rid} dropped at tick {t} with no spill "
+                    "attempt after its replica died", tick))
+        self._drops_seen = len(sim.drops)
+        for r in sim.relays:
+            if r["stalled"] > sim.STALL_WINDOW and not r.get("flagged"):
+                r["flagged"] = True
+                out.append(Violation(
+                    "relay-stall",
+                    f"relay {r['id']} ({r['tenant']}) made no progress "
+                    f"for {r['stalled']} ticks with live replicas", tick))
+        return out

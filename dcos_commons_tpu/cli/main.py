@@ -255,6 +255,32 @@ def _health_cmd(client: Client, args) -> int:
     return _emit(*client.get("health"))
 
 
+def _route_stats_cmd(client: Client, args) -> int:
+    """Routing counters from the fleet front door (``models/router.py``
+    ``GET /v1/routestats``): affinity rate, spills, sheds, per-replica
+    and per-tenant tallies. The router is its own pod, not the
+    scheduler, so this talks straight to ``--router``/``TPU_ROUTER``."""
+    base = (args.router or os.environ.get("TPU_ROUTER", "")).rstrip("/")
+    if not base:
+        print("route-stats: provide --router URL or set TPU_ROUTER "
+              "(e.g. http://router-0.example:8180)", file=sys.stderr)
+        return 2
+    try:
+        # the verifying transport needs `cryptography`; plain-http
+        # routers (the common in-cluster case) work without it
+        from ..security.transport import urlopen
+    except ImportError:
+        urlopen = urllib.request.urlopen
+    try:
+        with urlopen(f"{base}/v1/routestats", timeout=30) as r:
+            return _emit(r.status, json.loads(r.read().decode()))
+    except urllib.error.HTTPError as e:
+        return _emit(e.code, {"error": str(e)})
+    except OSError as e:
+        print(f"route-stats: {base} unreachable: {e}", file=sys.stderr)
+        return 1
+
+
 # -- static analysis (analysis/: S-rules over specs, J-rules over jaxprs) --
 
 def _framework_default_env(path: str) -> dict:
@@ -509,6 +535,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("health", help="scheduler health").set_defaults(
         fn=_health_cmd)
+
+    rs = sub.add_parser("route-stats",
+                        help="fleet front-door routing counters "
+                             "(affinity rate, spills, per-tenant QoS)")
+    rs.add_argument("--router", default=None, metavar="URL",
+                    help="router base URL (default: $TPU_ROUTER)")
+    rs.set_defaults(fn=_route_stats_cmd)
 
     lint = sub.add_parser(
         "lint", help="static-analyze service specs (S-rules) and "

@@ -54,10 +54,16 @@ import struct
 import threading
 import time
 import urllib.request
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+# the one shared prefix-hash: the wire format, the fleet router, and the
+# prefix radix must all key full prompt pages identically or affinity
+# routing sends requests where their pages are NOT (see paging.page_hashes)
+from .paging import page_hashes
 
 _MAGIC = b"KVSPAN1\0"
 _WIRE_VERSION = 1
@@ -66,19 +72,6 @@ _WIRE_VERSION = 1
 class PageShipError(RuntimeError):
     """A KV shipment that must not be adopted: framing, digest, or
     prefix-hash verification failed."""
-
-
-def page_hashes(prompt: List[int], page_size: int) -> List[str]:
-    """Content hash per FULL prompt page — the prefix-hash metadata a
-    span carries. The decode tier recomputes these from the shipped
-    prompt; a mismatch means the prompt and pages disagree (corrupt or
-    mis-framed transfer) and the span is rejected before adoption."""
-    out = []
-    for j in range(len(prompt) // page_size):
-        page = np.asarray(prompt[j * page_size:(j + 1) * page_size],
-                          np.int32)
-        out.append(hashlib.blake2s(page.tobytes()).hexdigest()[:16])
-    return out
 
 
 def _flatten_payload(payload: Dict[str, Any]) -> List[Tuple[str, Any]]:
@@ -252,9 +245,17 @@ class PrefillWorker:
     throughput. A full pool is a 503, transient by construction:
     spans release every working page right after packing."""
 
-    def __init__(self, engine, port: int = 0, host: str = "0.0.0.0"):
+    def __init__(self, engine, port: int = 0, host: str = "0.0.0.0",
+                 window_s: float = 60.0):
         self.engine = engine
         self._lock = threading.Lock()
+        # rolling-window load signal, same shape + keys as
+        # ServingFrontend.load_gauges(): the fleet router and the
+        # autoscaler read `"load"` from /v1/healthz on EVERY replica
+        # shape, prefill tier included
+        self.window_s = window_s
+        self._window: deque = deque(maxlen=4096)   # t of each span served
+        self._sheds: deque = deque(maxlen=4096)    # t of each 503
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -274,7 +275,8 @@ class PrefillWorker:
                     st = worker.engine.page_stats()
                     self._json(200, {"ok": True, "role": "prefill",
                                      "pages_free": st["pages_free"],
-                                     "shipped_spans": st["shipped_spans"]})
+                                     "shipped_spans": st["shipped_spans"],
+                                     "load": worker.load_gauges()})
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
 
@@ -299,8 +301,10 @@ class PrefillWorker:
                     self._json(500, {"error": f"prefill failed: {e}"})
                     return
                 if span is None:
+                    worker._sheds.append(time.monotonic())
                     self._json(503, {"error": "page pool exhausted"})
                     return
+                worker._window.append(time.monotonic())
                 frame = pack_span(span)
                 self.send_response(200)
                 self.send_header("Content-Type",
@@ -313,6 +317,31 @@ class PrefillWorker:
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def load_gauges(self) -> dict:
+        """The ``scheduler/elastic.py`` ``backpressure()`` contract over
+        the prefill tier: spans served stand in for completions, 503s
+        (pool exhaustion) are sheds, page occupancy is the utilization
+        signal. ``queue_depth`` is 0 by construction — concurrent posts
+        serialize on the engine lock, not a queue."""
+        horizon = time.monotonic() - self.window_s
+        shed = sum(1 for t in self._sheds if t >= horizon)
+        completed = sum(1 for t in self._window if t >= horizon)
+        out = {
+            "window_s": self.window_s,
+            "queue_depth": 0,
+            "queue_capacity": 0,
+            "completed": completed,
+            "shed": shed,
+            "shed_rate": shed / max(1, shed + completed),
+            "ttft_p95_ms": None,
+        }
+        if hasattr(self.engine, "pages_free"):
+            out["pages_free"] = self.engine.pages_free()
+            ledger = getattr(self.engine, "ledger", None)
+            if ledger is not None:
+                out["pages_total"] = ledger.pages
+        return out
 
     def start(self) -> "PrefillWorker":
         try:

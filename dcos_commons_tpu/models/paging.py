@@ -29,7 +29,34 @@ Sharing model (vLLM/SGLang-style prefix caching, TPU-simplified):
 
 from __future__ import annotations
 
-from typing import Dict, List, Mapping, Optional, Tuple
+import hashlib
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def page_hashes(prompt: Sequence[int], page_size: int) -> List[str]:
+    """Content hash per FULL page of prompt tokens — THE prefix-hash
+    every tier must agree on. One blake2s over the page's int32 bytes,
+    truncated to 16 hex chars, one entry per full page, partial tail
+    excluded (a partial page is never position-aligned shareable).
+
+    Three consumers, one function, by design: the KV-span wire format
+    (``models/disagg.py`` ``pack_span``/``unpack_span`` verify shipped
+    pages against these), the fleet router's consistent-hash affinity
+    key (``models/router.py`` ``route_key``), and this module's
+    :class:`PrefixRadix` (whose hash-cons keys are the same full-page
+    token runs these hashes summarize). If any of them hashed
+    differently, requests would land on replicas whose radix holds
+    nothing for them and affinity would silently degrade — the
+    cross-module parity test in ``tests/test_router.py`` pins this.
+    """
+    out = []
+    for j in range(len(prompt) // page_size):
+        page = np.asarray(prompt[j * page_size:(j + 1) * page_size],
+                          np.int32)
+        out.append(hashlib.blake2s(page.tobytes()).hexdigest()[:16])
+    return out
 
 
 class PageLedgerError(RuntimeError):

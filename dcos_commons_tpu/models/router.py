@@ -1,0 +1,838 @@
+"""Fleet front door: prefix-affinity router tier across decode replicas.
+
+Everything below the ingress scales — paged prefix-shared KV (PR 6),
+disaggregated tiers (PR 7), an autoscaler that grows the decode fleet
+(PR 10) — but each ``ServingFrontend`` is an island: the ``PrefixRadix``
+win is per-process, so scaling OUT resets the prefix-cache hit rate
+unless something routes a request to the replica whose radix already
+holds its pages. This module is that something — the SGLang/Mooncake
+cache-aware-routing insight as a schedulable pod tier:
+
+* **Consistent-hash affinity** (:class:`HashRing`): requests hash on
+  their prompt's radix prefix — the same full-page content hash the KV
+  wire format and the radix key on (``paging.page_hashes``; see
+  :func:`route_key`) — so the millions of users sharing a system prompt
+  land on the replica that already caches it, and a decode-tier resize
+  moves only ~K/N of the keyspace instead of reshuffling everything.
+* **Per-tenant QoS** (:class:`TenantAdmission`): token-bucket admission
+  per tenant, with :class:`QoSClass` carrying the SAME integer priority
+  classes the scheduler's ``priority:`` field uses (``dist/fleet.yml``
+  maps them onto the pod specs), a per-class TTFT SLO for conformance
+  receipts, and a spill floor — classes at/above it may chase idle
+  capacity fleet-wide when their affinity target runs hot; classes
+  below it wait their turn (spill-on-DOWN applies to everyone:
+  availability is not a paid feature).
+* **Streaming fan-in**: the router relays each replica's chunked token
+  stream straight back to the client connection, and because decode is
+  deterministic greedy, a replica that dies mid-stream is survivable —
+  the relay re-issues the request on the next candidate and skips the
+  tokens the client already has (``spill_resumes``). An admitted stream
+  is only ever dropped after every healthy candidate was attempted
+  (``dropped_streams`` — the chaos invariant pins this to spill-first).
+* **Health/load-aware spill** (:class:`ReplicaSet`): generalizes
+  ``DisaggCoordinator``'s health-gated multi-peer rotation
+  (``models/disagg.py``) — a failing replica is marked down and
+  re-probed after ``health_recheck_s`` via ``/v1/healthz``, whose
+  ``"load"`` gauges (``ServingFrontend.load_gauges()``) collapse
+  through ``scheduler/elastic.py``'s ``backpressure()`` into the
+  pressure signal that decides hot-spill and least-loaded placement.
+
+Elasticity contract: when the autoscaler resizes the decode tier,
+:meth:`Router.set_replicas` rebalances the ring — departing replicas
+leave the ring FIRST (no new affinity), while relays already attached
+to them run to completion (drain, not drop); a mid-drain death falls
+into the normal spill-resume path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from bisect import bisect_right, insort
+from collections import deque
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..scheduler.elastic import backpressure
+from .disagg import _transport_urlopen
+from .paging import page_hashes
+
+
+def route_key(prompt: Sequence[int], page_size: int,
+              affinity_pages: int = 1) -> str:
+    """The affinity key for a prompt: the chain of its first
+    ``affinity_pages`` FULL-page prefix hashes (``paging.page_hashes``
+    — the exact hashes the radix and the KV wire format agree on), so
+    two prompts share a key iff they share the radix pages affinity is
+    chasing. Prompts shorter than one page hash their raw tokens —
+    still stable, nothing cached to chase."""
+    hashes = page_hashes(prompt, page_size)[:max(1, affinity_pages)]
+    if hashes:
+        return "/".join(hashes)
+    raw = ",".join(str(int(t)) for t in prompt).encode()
+    return "p:" + hashlib.blake2s(raw).hexdigest()[:16]
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    Each replica owns ``vnodes`` points (blake2s of ``"name#i"``); a key
+    maps to the first point clockwise. Adding or removing one replica
+    moves only the keys in its arcs — the bounded-key-movement property
+    ``tests/test_router.py`` pins — so a decode-tier resize does not
+    reshuffle the whole fleet's prefix affinity."""
+
+    def __init__(self, nodes: Iterable[str] = (), vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = vnodes
+        self._points: List[Tuple[int, str]] = []   # sorted (point, node)
+        self._nodes: Dict[str, List[int]] = {}
+        for n in nodes:
+            self.add(n)
+
+    @staticmethod
+    def _point(node: str, i: int) -> int:
+        digest = hashlib.blake2s(f"{node}#{i}".encode()).digest()
+        return int.from_bytes(digest[:8], "big")
+
+    def nodes(self) -> List[str]:
+        return sorted(self._nodes)
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        pts = [self._point(node, i) for i in range(self.vnodes)]
+        self._nodes[node] = pts
+        for p in pts:
+            insort(self._points, (p, node))
+
+    def remove(self, node: str) -> None:
+        pts = self._nodes.pop(node, None)
+        if pts is None:
+            return
+        dead = set(pts)
+        self._points = [(p, n) for p, n in self._points
+                        if not (n == node and p in dead)]
+
+    def lookup(self, key: str) -> Optional[str]:
+        pref = self.preference(key, 1)
+        return pref[0] if pref else None
+
+    def preference(self, key: str, n: Optional[int] = None) -> List[str]:
+        """Distinct replicas in clockwise order from the key's point —
+        the failover order for spill, so a key's fallback target is as
+        stable as its primary."""
+        if not self._points:
+            return []
+        want = len(self._nodes) if n is None else min(n, len(self._nodes))
+        kp = int.from_bytes(hashlib.blake2s(key.encode()).digest()[:8],
+                            "big")
+        start = bisect_right(self._points, (kp, chr(0x10FFFF)))
+        out: List[str] = []
+        for i in range(len(self._points)):
+            node = self._points[(start + i) % len(self._points)][1]
+            if node not in out:
+                out.append(node)
+                if len(out) >= want:
+                    break
+        return out
+
+
+class TokenBucket:
+    """Token-bucket admission: ``burst`` capacity, ``rate`` tokens/s
+    refill. ``rate=0`` freezes the bucket — the initial burst is all it
+    ever admits; ``burst=0`` admits nothing. The clock is injectable so
+    tests and the chaos soak replay deterministically."""
+
+    def __init__(self, rate: float, burst: float, clock=time.monotonic):
+        if rate < 0 or burst < 0:
+            raise ValueError(f"rate/burst must be >= 0, got "
+                             f"{rate}/{burst}")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def available(self) -> float:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            return self._tokens
+
+
+@dataclass(frozen=True)
+class QoSClass:
+    """One tenant QoS class. ``priority`` uses the scheduler's
+    ``priority:`` integers (``specification``/``dist/fleet.yml``) so
+    tenant classes and pod tiers rank on one scale; ``rate``/``burst``
+    parameterize each tenant's admission bucket; ``ttft_slo_ms`` is the
+    per-class conformance bar the bench receipts report against."""
+
+    name: str
+    priority: int = 0
+    rate: float = float("inf")
+    burst: float = float("inf")
+    ttft_slo_ms: Optional[float] = None
+
+
+DEFAULT_CLASS = QoSClass("default")
+
+
+def parse_qos_classes(spec: str) -> Dict[str, QoSClass]:
+    """Parse the ``TENANT_CLASSES`` knob:
+    ``name:priority:rate:burst[:ttft_slo_ms]`` entries, comma-separated
+    — e.g. ``gold:10:50:100:250,free:1:2:4``. Empty spec → no classes
+    (every tenant admits unlimited under :data:`DEFAULT_CLASS`)."""
+    out: Dict[str, QoSClass] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (4, 5):
+            raise ValueError(
+                f"bad TENANT_CLASSES entry {entry!r}: want "
+                "name:priority:rate:burst[:ttft_slo_ms]")
+        name = parts[0]
+        slo = float(parts[4]) if len(parts) == 5 and parts[4] else None
+        out[name] = QoSClass(name, priority=int(parts[1]),
+                             rate=float(parts[2]), burst=float(parts[3]),
+                             ttft_slo_ms=slo)
+    return out
+
+
+class TenantAdmission:
+    """Per-tenant token buckets over the configured QoS classes.
+
+    A request names its tenant and (optionally) its class; unknown
+    classes fall back to ``default`` when configured, else to the
+    unlimited :data:`DEFAULT_CLASS`. Each TENANT gets its own bucket
+    (two gold tenants cannot eat each other's budget — the isolation
+    the ``tenant_flood`` chaos invariant leans on)."""
+
+    def __init__(self, classes: Optional[Dict[str, QoSClass]] = None,
+                 clock=time.monotonic):
+        self.classes = dict(classes or {})
+        self._clock = clock
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+        self.admitted: Dict[str, int] = {}
+        self.shed: Dict[str, int] = {}
+
+    def qos(self, qos_name: Optional[str]) -> QoSClass:
+        if qos_name and qos_name in self.classes:
+            return self.classes[qos_name]
+        return self.classes.get("default", DEFAULT_CLASS)
+
+    def admit(self, tenant: str, qos_name: Optional[str] = None
+              ) -> Tuple[bool, QoSClass]:
+        cls = self.qos(qos_name)
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None or (bucket.rate, bucket.burst) != (
+                    cls.rate, cls.burst):
+                bucket = self._buckets[tenant] = TokenBucket(
+                    cls.rate, cls.burst, clock=self._clock)
+        if bucket.burst == float("inf") or bucket.try_take():
+            with self._lock:
+                self.admitted[tenant] = self.admitted.get(tenant, 0) + 1
+            return True, cls
+        with self._lock:
+            self.shed[tenant] = self.shed.get(tenant, 0) + 1
+        return False, cls
+
+
+class ReplicaError(RuntimeError):
+    """A replica that could not serve the relayed request — transport
+    failure, truncated stream, or engine error. Marks the replica down
+    and moves the relay to the next candidate."""
+
+
+class ReplicaBusy(ReplicaError):
+    """A replica 503 (queue full): back-pressure, not death — the relay
+    tries the next candidate WITHOUT taking the replica out of
+    rotation."""
+
+
+class ReplicaSet:
+    """Health- and load-tracked view of the decode endpoints —
+    ``DisaggCoordinator``'s health-gated peer rotation generalized into
+    a reusable piece: a failed replica is marked down and stays out of
+    rotation until ``health_recheck_s`` elapses AND its ``/v1/healthz``
+    answers ok again; each probe also caches the response's ``"load"``
+    gauges, collapsed via ``scheduler/elastic.backpressure()`` into the
+    spill signal."""
+
+    def __init__(self, endpoints: Iterable[str] = (),
+                 health_recheck_s: float = 5.0,
+                 probe_timeout_s: float = 5.0, probe=None):
+        self._lock = threading.Lock()
+        self._endpoints: List[str] = []
+        self._down: Dict[str, float] = {}      # endpoint -> monotonic mark
+        self._gauges: Dict[str, dict] = {}
+        self.health_recheck_s = health_recheck_s
+        self.probe_timeout_s = probe_timeout_s
+        self._probe = probe if probe is not None else self._http_probe
+        for ep in endpoints:
+            self.add(ep)
+
+    # ------------------------------------------------------------ members
+
+    def endpoints(self) -> List[str]:
+        with self._lock:
+            return list(self._endpoints)
+
+    def add(self, endpoint: str) -> None:
+        endpoint = endpoint.rstrip("/")
+        with self._lock:
+            if endpoint not in self._endpoints:
+                self._endpoints.append(endpoint)
+
+    def remove(self, endpoint: str) -> None:
+        endpoint = endpoint.rstrip("/")
+        with self._lock:
+            if endpoint in self._endpoints:
+                self._endpoints.remove(endpoint)
+            self._down.pop(endpoint, None)
+            self._gauges.pop(endpoint, None)
+
+    # ------------------------------------------------------------- health
+
+    def _http_probe(self, endpoint: str) -> Tuple[bool, Optional[dict]]:
+        try:
+            req = urllib.request.Request(endpoint + "/v1/healthz")
+            with _transport_urlopen(req, timeout=self.probe_timeout_s) as r:
+                body = json.loads(r.read())
+            return bool(body.get("ok")), body.get("load")
+        except Exception:
+            return False, None
+
+    def mark_down(self, endpoint: str) -> None:
+        with self._lock:
+            self._down[endpoint.rstrip("/")] = time.monotonic()
+
+    def note_gauges(self, endpoint: str, gauges: Optional[dict]) -> None:
+        if gauges is not None:
+            with self._lock:
+                self._gauges[endpoint.rstrip("/")] = gauges
+
+    def gauges(self, endpoint: str) -> dict:
+        with self._lock:
+            return dict(self._gauges.get(endpoint.rstrip("/"), {}))
+
+    def pressure(self, endpoint: str,
+                 ttft_slo_ms: Optional[float] = None) -> float:
+        return backpressure(self.gauges(endpoint), ttft_slo_ms)
+
+    def ok(self, endpoint: str) -> bool:
+        """True when the endpoint is in rotation. A down endpoint stays
+        out until the recheck window elapses AND a fresh probe (done
+        here, outside the lock) answers ok."""
+        endpoint = endpoint.rstrip("/")
+        with self._lock:
+            if endpoint not in self._endpoints:
+                return False
+            marked = self._down.get(endpoint)
+            if marked is None:
+                return True
+            if time.monotonic() - marked < self.health_recheck_s:
+                return False
+        up, gauges = self._probe(endpoint)
+        if up:
+            with self._lock:
+                self._down.pop(endpoint, None)
+            self.note_gauges(endpoint, gauges)
+            return True
+        self.mark_down(endpoint)
+        return False
+
+    def healthy(self) -> List[str]:
+        return [ep for ep in self.endpoints() if self.ok(ep)]
+
+    def down(self) -> List[str]:
+        with self._lock:
+            return sorted(ep for ep in self._down
+                          if ep in self._endpoints)
+
+    def refresh(self) -> None:
+        """Probe every endpoint once: refresh cached gauges, clear or
+        set down marks. The router's probe thread calls this on its
+        interval; tests call it directly."""
+        for ep in self.endpoints():
+            up, gauges = self._probe(ep)
+            if up:
+                with self._lock:
+                    self._down.pop(ep, None)
+                self.note_gauges(ep, gauges)
+            else:
+                self.mark_down(ep)
+
+    def least_loaded(self, exclude: Iterable[str] = ()) -> Optional[str]:
+        skip = {e.rstrip("/") for e in exclude}
+        best, best_p = None, None
+        for ep in self.endpoints():
+            if ep in skip or not self.ok(ep):
+                continue
+            p = self.pressure(ep)
+            if best_p is None or p < best_p:
+                best, best_p = ep, p
+        return best
+
+
+class Router:
+    """The fleet front door: one HTTP pod routing ``/v1/generate``
+    across N decode replicas.
+
+    * ``POST /v1/generate`` — the ingress request shape plus optional
+      ``"tenant"`` / ``"qos"`` fields (headers ``X-Tenant`` /
+      ``X-QoS-Class`` also honored). 429 when the tenant's bucket is
+      dry; otherwise the request routes by prefix affinity (or
+      uniformly under ``policy="random"`` — the A/B control arm) and
+      the replica's token stream relays back, chunked or unary, with
+      ``"replica"`` and ``"routed"`` stamped into the trailer.
+    * ``GET /v1/healthz`` — router liveness + per-replica health.
+    * ``GET /v1/routestats`` — the ``tpuctl route-stats`` surface.
+    * ``POST /v1/replicas`` ``{"replicas": [...]}`` — the resize hook
+      (the worker main and the smoke drive :meth:`set_replicas`
+      through it).
+    """
+
+    def __init__(self, replicas: Iterable[str] = (), port: int = 0,
+                 host: str = "0.0.0.0", page_size: int = 64,
+                 affinity_pages: int = 1, vnodes: int = 64,
+                 classes: Optional[Dict[str, QoSClass]] = None,
+                 policy: str = "affinity",
+                 spill_pressure: float = 0.85,
+                 spill_floor: int = 0,
+                 health_recheck_s: float = 5.0,
+                 probe_interval_s: float = 2.0,
+                 request_timeout_s: float = 600.0,
+                 seed: int = 0):
+        if policy not in ("affinity", "random"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = page_size
+        self.affinity_pages = max(1, affinity_pages)
+        self.policy = policy
+        self.spill_pressure = spill_pressure
+        self.spill_floor = spill_floor
+        self.request_timeout_s = request_timeout_s
+        self.probe_interval_s = probe_interval_s
+        self.ring = HashRing(
+            (e.rstrip("/") for e in replicas), vnodes=vnodes)
+        self.replicas = ReplicaSet(replicas,
+                                   health_recheck_s=health_recheck_s)
+        self.admission = TenantAdmission(classes)
+        import random as _random
+        self._rng = _random.Random(seed)
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {
+            "routed": 0, "affinity_hits": 0, "spills_hot": 0,
+            "spills_down": 0, "spill_attempts": 0, "spill_resumes": 0,
+            "dropped_streams": 0, "sheds": 0, "rebalances": 0,
+            "errors": 0}
+        self._per_replica: Dict[str, int] = {}
+        self._active: Dict[str, int] = {}      # replica -> live relays
+        self._ttfts: deque = deque(maxlen=4096)  # (t, tenant, ttft_ms)
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code: int, payload: dict,
+                      extra_headers: Optional[dict] = None) -> None:
+                body = (json.dumps(payload) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/v1/healthz":
+                    self._json(200, router.health())
+                elif self.path in ("/v1/routestats", "/v1/stats"):
+                    self._json(200, router.stats())
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                if self.path == "/v1/replicas":
+                    eps = req.get("replicas")
+                    if (not isinstance(eps, list)
+                            or not all(isinstance(e, str) for e in eps)):
+                        self._json(400, {"error": "replicas must be a "
+                                                  "list of endpoint URLs"})
+                        return
+                    self._json(200, router.set_replicas(eps))
+                    return
+                if self.path != "/v1/generate":
+                    self._json(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    prompt = req.get("prompt")
+                    max_new = int(req.get("max_new", 32))
+                    if (not isinstance(prompt, list) or not prompt
+                            or not all(isinstance(t, int) for t in prompt)):
+                        raise ValueError("prompt must be a non-empty "
+                                         "list of ints")
+                    if max_new < 1:
+                        raise ValueError("max_new must be >= 1")
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                tenant = (req.get("tenant")
+                          or self.headers.get("X-Tenant") or "anonymous")
+                qos = (req.get("qos")
+                       or self.headers.get("X-QoS-Class") or None)
+                stream = bool(req.get("stream", False))
+                router._serve(self, prompt, max_new, stream,
+                              str(tenant), qos)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._http_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- routing
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def route_plan(self, prompt: Sequence[int],
+                   cls: QoSClass) -> Tuple[List[str], str]:
+        """The ordered candidate list for one request and how its head
+        was chosen (``affinity`` | ``spill_hot`` | ``spill_down`` |
+        ``random`` | ``none``). The tail is the mid-stream failover
+        order: the rest of the ring's preference walk (stable per key),
+        healthy-first."""
+        if self.policy == "random":
+            healthy = self.replicas.healthy()
+            if not healthy:
+                return [], "none"
+            self._rng.shuffle(healthy)
+            return healthy, "random"
+        key = route_key(prompt, self.page_size, self.affinity_pages)
+        pref = self.ring.preference(key)
+        if not pref:
+            return [], "none"
+        primary = pref[0]
+        rest = [ep for ep in pref[1:] if self.replicas.ok(ep)]
+        if self.replicas.ok(primary):
+            hot = (self.replicas.pressure(
+                primary, cls.ttft_slo_ms) >= self.spill_pressure)
+            if hot and cls.priority >= self.spill_floor and rest:
+                spill = self.replicas.least_loaded(exclude=(primary,))
+                if spill is not None:
+                    order = [spill] + [ep for ep in [primary] + rest
+                                       if ep != spill]
+                    return order, "spill_hot"
+            return [primary] + rest, "affinity"
+        if rest:
+            spill = self.replicas.least_loaded(exclude=(primary,))
+            if spill is not None and spill in rest:
+                rest = [spill] + [ep for ep in rest if ep != spill]
+            return rest, "spill_down"
+        return [], "none"
+
+    # ------------------------------------------------------------- relay
+
+    def _upstream(self, target: str, prompt: List[int], max_new: int):
+        """Generator over one replica's chunked token stream: yields
+        the parsed JSON objects, raising :class:`ReplicaError` (or
+        :class:`ReplicaBusy` on 503 back-pressure) instead of ever
+        yielding a broken tail."""
+        body = json.dumps({"prompt": prompt, "max_new": max_new,
+                           "stream": True}).encode()
+        req = urllib.request.Request(
+            target + "/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            resp = _transport_urlopen(req, timeout=self.request_timeout_s)
+        except urllib.error.HTTPError as e:
+            if e.code == 503:
+                raise ReplicaBusy(f"{target}: queue full") from None
+            raise ReplicaError(f"{target}: HTTP {e.code}") from None
+        except Exception as e:
+            raise ReplicaError(f"{target}: {e}") from None
+        with resp:
+            while True:
+                try:
+                    line = resp.readline()
+                except Exception as e:
+                    raise ReplicaError(f"{target}: {e}") from None
+                if not line:
+                    raise ReplicaError(f"{target}: stream truncated")
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue                   # keep-alive noise
+                yield obj
+                if obj.get("done"):
+                    return
+
+    def _serve(self, handler, prompt: List[int], max_new: int,
+               stream: bool, tenant: str, qos: Optional[str]) -> None:
+        t0 = time.perf_counter()
+        ok, cls = self.admission.admit(tenant, qos)
+        if not ok:
+            self._count("sheds")
+            handler._json(429, {"error": f"tenant {tenant!r} over its "
+                                         f"{cls.name} admission budget"},
+                          {"Retry-After": "1"})
+            return
+        plan, routed = self.route_plan(prompt, cls)
+        if not plan:
+            self._count("errors")
+            handler._json(503, {"error": "no healthy decode replica"},
+                          {"Retry-After": "1"})
+            return
+        self._count("routed")
+        if routed == "affinity":
+            self._count("affinity_hits")
+        elif routed == "spill_hot":
+            self._count("spills_hot")
+        elif routed == "spill_down":
+            self._count("spills_down")
+
+        chunk = None
+        if stream:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Transfer-Encoding", "chunked")
+            handler.end_headers()
+
+            def chunk(obj: dict) -> None:
+                data = (json.dumps(obj) + "\n").encode()
+                handler.wfile.write(f"{len(data):x}\r\n".encode()
+                                    + data + b"\r\n")
+
+        sent: List[int] = []
+        t_first: Optional[float] = None
+        final: Optional[dict] = None
+        last_err = "no candidates"
+        for attempt, target in enumerate(plan):
+            if attempt > 0:
+                # failover: deterministic greedy decode replays the
+                # same tokens on the next replica; skip what the
+                # client already has
+                self._count("spill_attempts")
+            with self._lock:
+                self._active[target] = self._active.get(target, 0) + 1
+            seen = 0
+            try:
+                for obj in self._upstream(target, prompt, max_new):
+                    if "token" in obj:
+                        seen += 1
+                        if seen <= len(sent):
+                            continue           # resume skip
+                        tok = int(obj["token"])
+                        if t_first is None:
+                            t_first = time.perf_counter()
+                        sent.append(tok)
+                        if chunk is not None:
+                            chunk({"token": tok})
+                    elif obj.get("done"):
+                        if obj.get("error"):
+                            raise ReplicaError(
+                                f"{target}: {obj['error']}")
+                        final = obj
+                if attempt > 0 and final is not None:
+                    self._count("spill_resumes")
+                break
+            except ReplicaBusy as e:
+                last_err = str(e)              # back-pressure: next
+            except ReplicaError as e:
+                last_err = str(e)
+                self.replicas.mark_down(target)
+            finally:
+                with self._lock:
+                    self._active[target] = max(
+                        0, self._active.get(target, 1) - 1)
+                    if final is not None:
+                        self._per_replica[target] = (
+                            self._per_replica.get(target, 0) + 1)
+            if final is not None:
+                break
+        if final is None:
+            # every candidate was attempted before giving up — the
+            # spill-before-drop invariant the chaos tier audits
+            self._count("dropped_streams")
+            err = {"error": f"all replicas failed: {last_err}"}
+            if chunk is not None:
+                chunk({"done": True, **err})
+                handler.wfile.write(b"0\r\n\r\n")
+            else:
+                handler._json(502, err)
+            return
+        ttft_ms = (round((t_first - t0) * 1e3, 3)
+                   if t_first is not None else None)
+        with self._lock:
+            self._ttfts.append((time.monotonic(), tenant, ttft_ms))
+        trailer = {k: v for k, v in final.items() if k != "done"}
+        trailer.update({"replica": target, "routed": routed,
+                        "tenant": tenant, "qos": cls.name})
+        if ttft_ms is not None:
+            trailer["router_ttft_ms"] = ttft_ms
+        if chunk is not None:
+            chunk({"done": True, **trailer})
+            handler.wfile.write(b"0\r\n\r\n")
+        else:
+            # "tokens" last: a replica trailer field must never clobber
+            # the relayed token list
+            handler._json(200, {**trailer, "tokens": sent})
+
+    # ----------------------------------------------------------- elasticity
+
+    def set_replicas(self, endpoints: Sequence[str]) -> dict:
+        """Rebalance the ring to a resized decode tier. Departing
+        replicas leave the ring and the replica set immediately — no
+        NEW streams route to them — while relays already attached keep
+        their connections and drain to completion (``draining`` counts
+        them). Arriving replicas take over only their arcs of the
+        keyspace (bounded movement)."""
+        want = [e.rstrip("/") for e in endpoints]
+        have = set(self.ring.nodes())
+        added = [e for e in want if e not in have]
+        removed = [e for e in have if e not in want]
+        for ep in added:
+            self.ring.add(ep)
+            self.replicas.add(ep)
+        for ep in removed:
+            self.ring.remove(ep)
+            self.replicas.remove(ep)
+        if added or removed:
+            self._count("rebalances")
+        with self._lock:
+            draining = {ep: n for ep, n in self._active.items()
+                        if ep in removed and n > 0}
+        return {"replicas": self.ring.nodes(), "added": sorted(added),
+                "removed": sorted(removed), "draining": draining}
+
+    # ------------------------------------------------------------- status
+
+    def health(self) -> dict:
+        eps = self.replicas.endpoints()
+        down = self.replicas.down()
+        return {"ok": True, "role": "router", "policy": self.policy,
+                "replicas": eps, "replicas_down": down,
+                "replicas_healthy": len(eps) - len(down)}
+
+    def stats(self) -> dict:
+        from dcos_commons_tpu.utils.stats import percentiles
+        with self._lock:
+            counts = dict(self._counts)
+            per_replica = dict(self._per_replica)
+            active = {ep: n for ep, n in self._active.items() if n > 0}
+            ttfts = [t for _, _, t in self._ttfts if t is not None]
+            per_tenant_ttft: Dict[str, List[float]] = {}
+            for _, tenant, t in self._ttfts:
+                if t is not None:
+                    per_tenant_ttft.setdefault(tenant, []).append(t)
+        routed = max(1, counts["routed"])
+        tenants = {}
+        seen = set(self.admission.admitted) | set(self.admission.shed)
+        for tenant in sorted(seen):
+            tenants[tenant] = {
+                "admitted": self.admission.admitted.get(tenant, 0),
+                "shed": self.admission.shed.get(tenant, 0),
+                "ttft_ms": percentiles(per_tenant_ttft.get(tenant, [])),
+            }
+        return {
+            "policy": self.policy,
+            "page_size": self.page_size,
+            "affinity_pages": self.affinity_pages,
+            "replicas": self.replicas.endpoints(),
+            "replicas_down": self.replicas.down(),
+            "ring_nodes": len(self.ring),
+            **counts,
+            "affinity_rate": round(counts["affinity_hits"] / routed, 4),
+            "per_replica": per_replica,
+            "active_relays": active,
+            "ttft_ms": percentiles(ttfts),
+            "tenants": tenants,
+            "classes": {name: {"priority": c.priority, "rate": c.rate,
+                               "burst": c.burst,
+                               "ttft_slo_ms": c.ttft_slo_ms}
+                        for name, c in self.admission.classes.items()},
+        }
+
+    # ----------------------------------------------------------- lifecycle
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            try:
+                self.replicas.refresh()
+            except Exception:
+                pass                            # probes must never kill
+
+    def start(self) -> "Router":
+        try:
+            from dcos_commons_tpu.security.transport import (
+                server_tls_from_env)
+            creds = server_tls_from_env()
+            if creds is not None:
+                from dcos_commons_tpu.security.transport import wrap_server
+                wrap_server(self._httpd, creds)
+        except ImportError:
+            pass
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="router-http")
+        self._http_thread.start()
+        if self.probe_interval_s > 0:
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, daemon=True, name="router-probe")
+            self._probe_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._http_thread is not None:
+            # shutdown() blocks on serve_forever's ack; never-started
+            # routers (construct-only use) would wait forever
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._http_thread:
+            self._http_thread.join(timeout=10)
+        if self._probe_thread:
+            self._probe_thread.join(timeout=5)

@@ -66,6 +66,22 @@ DEFAULT_ENV: Mapping[str, str] = {
     "DISAGG_PAGES": "-1",
     "PREFILL_COUNT": "1",
     "DECODE_COUNT": "2",
+    # fleet front-door knobs (fleet.yml + models/router.py): a router
+    # pod consistent-hashes prompts onto the decode replicas listed in
+    # ROUTE_REPLICAS (filled from `tpuctl endpoints serve`; resizes
+    # land at runtime via POST /v1/replicas).
+    # ROUTE_POLICY=random is the A/B control arm the bench uses.
+    # TENANT_CLASSES maps tenants onto the scheduler's priority:
+    # integers with token-bucket admission —
+    # name:priority:rate:burst[:ttft_slo_ms], comma-separated.
+    "ROUTER_COUNT": "1",
+    "ROUTE_REPLICAS": "",
+    "ROUTE_POLICY": "affinity",
+    "ROUTE_AFFINITY_PAGES": "1",
+    "ROUTE_VNODES": "64",
+    "ROUTE_SPILL_PRESSURE": "0.85",
+    "ROUTE_SPILL_FLOOR": "0",
+    "TENANT_CLASSES": "gold:10:50:100:500,bronze:1:5:10",
     # long-context scenario knobs (longctx.yml)
     "SEQ_LEN": "8192",
     "ATTN_IMPL": "ring",

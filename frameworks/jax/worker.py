@@ -247,6 +247,10 @@ def run_llama(args) -> dict:
     """Model-parallel Llama inference shard: weights pjit-sharded over the tp
     axis (megatron column/row layout, ``models/llama.py:shard_params``),
     decode via lax.scan (BASELINE.json configs[4])."""
+    if args.serve and args.serve_role == "router":
+        # the router tier is pure control plane — no model, no devices,
+        # no jax: the front door comes up before anything can fail
+        return _serve_router(args)
     import jax
     import jax.numpy as jnp
 
@@ -384,8 +388,12 @@ def run_llama(args) -> dict:
                 time.sleep(args.serve_interval)
                 i += 1
                 try:
+                    # the plain serving path reports the same rolling
+                    # load gauges /v1/healthz serves — one autoscaler/
+                    # router signal shape across every replica kind
                     hb = {"event": "heartbeat", "n": i,
-                          **frontend.stats()}
+                          **frontend.stats(),
+                          "load": frontend.load_gauges()}
                     if page_stats is not None:
                         hb["paged"] = server.page_stats()
                     _emit(hb)
@@ -515,6 +523,56 @@ def _serve_disagg(args, cfg, params, mesh, result) -> bool:
             _emit({"event": "heartbeat", "n": i, "role": "decode",
                    **frontend.stats(), "paged": engine.page_stats(),
                    "disagg": coord.stats()})
+        except Exception as e:
+            _emit({"event": "heartbeat_error", "n": i, "error": str(e)})
+
+
+def _serve_router(args) -> dict:
+    """The fleet front door (``SERVE_ROLE=router``, dist/fleet.yml):
+    prefix-affinity consistent-hash routing across the decode replicas
+    in ``--route-replicas``, per-tenant token-bucket admission from
+    ``--tenant-classes``, streaming relay with health/load-aware spill
+    (``models/router.py``). Never returns while healthy.
+
+    The router carries no model: ``--page-size`` only parameterizes the
+    affinity hash and MUST match the decode tier's page size, or
+    requests hash to keys the replicas' radixes never cache under.
+    Decode-tier resizes land through ``POST /v1/replicas`` (the
+    autoscaler's config update redeploys pods; the operator or
+    controller pushes the fresh endpoint list — ``tpuctl endpoints
+    serve`` is the source)."""
+    from dcos_commons_tpu.models.router import Router, parse_qos_classes
+    replicas = [p.strip() for p in args.route_replicas.split(",")
+                if p.strip()]
+    try:
+        classes = parse_qos_classes(args.tenant_classes)
+    except ValueError as e:
+        # a bad knob must not crash-loop the front door: serve with
+        # admission wide open and say so
+        _emit({"event": "router_config_error", "error": str(e),
+               "tenant_classes": args.tenant_classes})
+        classes = {}
+    port = args.serve_port
+    if port < 0:
+        port = int(os.environ.get("PORT_SERVE", "0"))
+    router = Router(replicas, port=port, page_size=args.page_size,
+                    affinity_pages=args.route_affinity_pages,
+                    vnodes=args.route_vnodes, classes=classes,
+                    policy=args.route_policy,
+                    spill_pressure=args.route_spill_pressure,
+                    spill_floor=args.route_spill_floor).start()
+    with open("serving.ready", "w") as f:
+        f.write(f"ok {router.port}\n")
+    _emit({"event": "serving", "role": "router", "port": router.port,
+           "replicas": replicas, "policy": args.route_policy,
+           "classes": sorted(classes)})
+    i = 0
+    while True:
+        time.sleep(args.serve_interval)
+        i += 1
+        try:
+            _emit({"event": "heartbeat", "n": i, "role": "router",
+                   **router.stats()})
         except Exception as e:
             _emit({"event": "heartbeat_error", "n": i, "error": str(e)})
 
@@ -881,14 +939,61 @@ def build_parser() -> argparse.ArgumentParser:
                    help="llama --serve: seconds between decode heartbeats")
     p.add_argument("--serve-role",
                    default=os.environ.get("SERVE_ROLE", "colocated"),
-                   choices=["colocated", "prefill", "decode"],
-                   help="llama --serve: disaggregated tier role "
-                        "(dist/disagg.yml). 'prefill' answers "
+                   choices=["colocated", "prefill", "decode", "router"],
+                   help="llama --serve: tier role. 'prefill' answers "
                         "/v1/prefill with packed KV page spans, "
                         "chunked prefill flat-out; 'decode' runs the "
                         "client front door and adopts pages shipped "
-                        "from --serve-peer; the default serves both "
+                        "from --serve-peer (dist/disagg.yml); 'router' "
+                        "runs the model-free fleet front door — "
+                        "prefix-affinity routing across "
+                        "--route-replicas (dist/fleet.yml, "
+                        "models/router.py); the default serves both "
                         "phases co-located on one engine")
+    p.add_argument("--route-replicas",
+                   default=os.environ.get("ROUTE_REPLICAS", ""),
+                   help="llama --serve --serve-role router: decode "
+                        "replica base URLs, comma-separated (from "
+                        "`tpuctl endpoints serve`). Resizes land at "
+                        "runtime via POST /v1/replicas")
+    p.add_argument("--route-policy",
+                   default=os.environ.get("ROUTE_POLICY", "affinity"),
+                   choices=["affinity", "random"],
+                   help="router: prefix-affinity consistent hashing, "
+                        "or uniform random (the A/B control arm)")
+    p.add_argument("--route-affinity-pages", type=int,
+                   default=int(os.environ.get("ROUTE_AFFINITY_PAGES",
+                                              "1")),
+                   help="router: full prompt pages hashed into the "
+                        "affinity key (1 = the shared system-prompt "
+                        "page; more pins deeper prefixes)")
+    p.add_argument("--route-vnodes", type=int,
+                   default=int(os.environ.get("ROUTE_VNODES", "64")),
+                   help="router: virtual nodes per replica on the "
+                        "hash ring (more = smoother balance, bigger "
+                        "ring)")
+    p.add_argument("--route-spill-pressure", type=float,
+                   default=float(os.environ.get("ROUTE_SPILL_PRESSURE",
+                                                "0.85")),
+                   help="router: back-pressure (scheduler/elastic.py "
+                        "backpressure() over the replica's /v1/healthz "
+                        "load gauges) above which the affinity target "
+                        "counts as hot and requests spill to the "
+                        "least-loaded healthy replica")
+    p.add_argument("--route-spill-floor", type=int,
+                   default=int(os.environ.get("ROUTE_SPILL_FLOOR", "0")),
+                   help="router: minimum QoS-class priority allowed to "
+                        "spill on HOT (spill on DOWN applies to all "
+                        "classes — availability is not a paid feature)")
+    p.add_argument("--tenant-classes",
+                   default=os.environ.get("TENANT_CLASSES", ""),
+                   help="router: per-tenant QoS classes, "
+                        "name:priority:rate:burst[:ttft_slo_ms] "
+                        "comma-separated, e.g. "
+                        "'gold:10:50:100:250,free:1:2:4'. priority "
+                        "shares the scheduler's priority: integer "
+                        "scale; rate/burst parameterize each tenant's "
+                        "token bucket; empty = admission wide open")
     p.add_argument("--serve-peer",
                    default=os.environ.get("SERVE_PEER", ""),
                    help="llama --serve --serve-role decode: prefill "

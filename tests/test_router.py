@@ -1,0 +1,469 @@
+"""Fleet front door (``models/router.py``): the consistent-hash ring's
+bounded movement under resize, token-bucket admission edges, per-tenant
+isolation, the health-gated replica set, and the Router's HTTP relay
+path — streaming fan-in token-exactness vs a direct replica connection,
+spill on dead replicas with mid-stream resume, 429 sheds, and the
+``/v1/replicas`` resize hook. Plus the cross-module prefix-hash parity
+pin: router, radix, and the KV wire format must key on the SAME hash or
+affinity silently degrades.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dcos_commons_tpu.models.paging import page_hashes
+from dcos_commons_tpu.models.router import (HashRing, QoSClass, ReplicaSet,
+                                            Router, TenantAdmission,
+                                            TokenBucket, parse_qos_classes,
+                                            route_key)
+
+# ---------------------------------------------------------- hash parity
+
+
+def test_page_hash_shared_across_modules():
+    """disagg re-exports paging's page_hashes — one function, not two
+    copies that could drift (the wire format and the router's affinity
+    key MUST agree with the radix)."""
+    from dcos_commons_tpu.models import disagg
+    assert disagg.page_hashes is page_hashes
+
+
+def test_page_hash_golden_pin():
+    """The hash is wire format (pack_span headers) and routing key at
+    once: pin its value so a silent change breaks loudly here instead
+    of as a fleet-wide affinity miss during a rolling upgrade."""
+    assert page_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4) == [
+        "2d435895cfba677c", "e043774b8d8600a7"]
+    # only FULL pages hash; the 3-token tail contributes nothing
+    assert page_hashes([1, 2, 3, 4, 5, 6, 7], 4) == ["2d435895cfba677c"]
+    assert page_hashes([1, 2, 3], 4) == []
+
+
+def test_route_key_is_page_hash_prefix():
+    prompt = list(range(100, 132))
+    assert route_key(prompt, 8) == page_hashes(prompt, 8)[0]
+    assert route_key(prompt, 8, affinity_pages=2) == "/".join(
+        page_hashes(prompt, 8)[:2])
+    # a short prompt (no full page) still routes deterministically
+    assert route_key([5, 6], 8) == route_key([5, 6], 8)
+    assert route_key([5, 6], 8) != route_key([5, 7], 8)
+    # suffix divergence past the affinity pages does NOT change the key:
+    # that is what parks shared-prefix traffic on one replica's radix
+    a = list(range(64)) + [1]
+    b = list(range(64)) + [2]
+    assert route_key(a, 8) == route_key(b, 8)
+
+
+# ------------------------------------------------------------ hash ring
+
+
+def test_ring_resize_moves_bounded_keys():
+    keys = [f"key-{i}" for i in range(300)]
+    ring = HashRing([f"r{i}" for i in range(4)])
+    before = {k: ring.lookup(k) for k in keys}
+    ring.add("r4")
+    after = {k: ring.lookup(k) for k in keys}
+    moved = sum(1 for k in keys if before[k] != after[k])
+    # expected ~1/5 move to the new node; 2/5 is a generous bound that
+    # still catches rehash-the-world (which moves ~4/5)
+    assert 0 < moved < 0.4 * len(keys)
+    # every moved key landed on the NEW node, nothing shuffled laterally
+    assert all(after[k] == "r4" for k in keys if before[k] != after[k])
+    ring.remove("r4")
+    assert {k: ring.lookup(k) for k in keys} == before
+
+
+def test_ring_preference_walk():
+    ring = HashRing(["a", "b", "c"], vnodes=32)
+    pref = ring.preference("some-key")
+    assert sorted(pref) == ["a", "b", "c"]     # all nodes, no dupes
+    assert pref == ring.preference("some-key")  # stable per key
+    assert ring.preference("some-key", 2) == pref[:2]
+    ring.remove(pref[0])
+    # survivors keep their relative order when the head leaves
+    assert ring.preference("some-key") == pref[1:]
+
+
+def test_ring_empty_and_single():
+    ring = HashRing()
+    assert ring.lookup("k") is None
+    assert ring.preference("k") == []
+    ring.add("only")
+    assert ring.lookup("k") == "only"
+
+
+# ----------------------------------------------------------- admission
+
+
+def test_token_bucket_burst_and_refill():
+    clock = [0.0]
+    b = TokenBucket(rate=1.0, burst=3.0, clock=lambda: clock[0])
+    assert [b.try_take() for _ in range(4)] == [True, True, True, False]
+    clock[0] += 2.0
+    assert b.available() == pytest.approx(2.0)
+    assert b.try_take() and b.try_take() and not b.try_take()
+    clock[0] += 100.0
+    assert b.available() == pytest.approx(3.0)  # capped at burst
+
+
+def test_token_bucket_zero_rate_freezes():
+    clock = [0.0]
+    b = TokenBucket(rate=0.0, burst=2.0, clock=lambda: clock[0])
+    assert b.try_take() and b.try_take() and not b.try_take()
+    clock[0] += 1e6
+    assert not b.try_take()          # the initial burst was all of it
+
+
+def test_token_bucket_zero_burst_admits_nothing():
+    b = TokenBucket(rate=100.0, burst=0.0, clock=lambda: 0.0)
+    assert not b.try_take()
+
+
+def test_token_bucket_rejects_negative():
+    with pytest.raises(ValueError):
+        TokenBucket(rate=-1.0, burst=1.0)
+
+
+def test_parse_qos_classes():
+    classes = parse_qos_classes("gold:10:50:100:250,free:1:2:4")
+    assert classes["gold"] == QoSClass("gold", priority=10, rate=50.0,
+                                       burst=100.0, ttft_slo_ms=250.0)
+    assert classes["free"].ttft_slo_ms is None
+    assert parse_qos_classes("") == {}
+    with pytest.raises(ValueError, match="TENANT_CLASSES"):
+        parse_qos_classes("gold:10:50")
+
+
+def test_tenant_isolation_separate_buckets():
+    """Two tenants of one class each get their OWN bucket: a flooding
+    tenant drains only its own budget (the chaos tenant_flood
+    invariant's unit-level witness)."""
+    clock = [0.0]
+    adm = TenantAdmission(parse_qos_classes("bronze:1:0:2"),
+                          clock=lambda: clock[0])
+    assert all(adm.admit("flooder", "bronze")[0] for _ in range(2))
+    assert not adm.admit("flooder", "bronze")[0]        # dry
+    assert adm.admit("quiet", "bronze")[0]              # untouched
+    assert adm.shed == {"flooder": 1}
+    # unknown class falls back to unlimited default
+    assert adm.admit("anybody", None)[0]
+
+
+# ---------------------------------------------------------- replica set
+
+
+def test_replica_set_down_and_recheck():
+    clock_ok = [False]
+    probed = []
+
+    def probe(ep):
+        probed.append(ep)
+        return clock_ok[0], {"queue_depth": 1}
+
+    rs = ReplicaSet(["http://a", "http://b"], health_recheck_s=0.0,
+                    probe=probe)
+    assert rs.healthy() == ["http://a", "http://b"]
+    rs.mark_down("http://a")
+    # recheck window elapsed (0s) -> re-probe decides; probe says down
+    assert not rs.ok("http://a")
+    assert rs.down() == ["http://a"]
+    clock_ok[0] = True
+    assert rs.ok("http://a")                 # probe recovered it
+    assert rs.down() == []
+    assert probed and set(probed) == {"http://a"}
+
+
+def test_replica_set_least_loaded():
+    gauges = {"http://a": {"window_s": 60.0, "queue_depth": 9,
+                           "queue_capacity": 10, "shed": 0},
+              "http://b": {"window_s": 60.0, "queue_depth": 1,
+                           "queue_capacity": 10, "shed": 0}}
+    rs = ReplicaSet(["http://a", "http://b"],
+                    probe=lambda ep: (True, gauges[ep]))
+    rs.refresh()
+    assert rs.least_loaded() == "http://b"
+    assert rs.least_loaded(exclude=["http://b"]) == "http://a"
+    assert rs.pressure("http://a") > rs.pressure("http://b")
+
+
+# ------------------------------------------------------- router HTTP e2e
+#
+# Stub decode replicas: deterministic token function shared by every
+# replica (the greedy-decode premise the router's resume-skip failover
+# leans on), speaking just enough of the ingress protocol.
+
+
+def _tokens(prompt, max_new):
+    return [(sum(prompt) * 31 + i) % 50000 for i in range(max_new)]
+
+
+class _StubReplica:
+    def __init__(self, fail_after=None, gauges=None, busy=False):
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"   # EOF-framed: trivial streams
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                body = json.dumps(
+                    {"ok": True, "load": stub.gauges or {}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n))
+                if stub.busy:
+                    self.send_error(503)
+                    return
+                toks = _tokens(req["prompt"], req.get("max_new", 32))
+                self.send_response(200)
+                self.end_headers()
+                stub.served += 1
+                for i, t in enumerate(toks):
+                    if stub.fail_after is not None and i >= stub.fail_after:
+                        # die mid-stream: close without the done trailer
+                        self.wfile.flush()
+                        self.connection.close()
+                        return
+                    self.wfile.write(
+                        (json.dumps({"token": t}) + "\n").encode())
+                self.wfile.write((json.dumps(
+                    {"done": True, "n": len(toks)}) + "\n").encode())
+
+        self.fail_after = fail_after
+        self.gauges = gauges
+        self.busy = busy
+        self.served = 0
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post_stream(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    toks, trailer = [], None
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        for line in r:
+            obj = json.loads(line)
+            if "token" in obj:
+                toks.append(obj["token"])
+            if obj.get("done"):
+                trailer = obj
+    return toks, trailer
+
+
+@pytest.fixture
+def fleet():
+    replicas = [_StubReplica(), _StubReplica(), _StubReplica()]
+    router = Router([r.url for r in replicas], host="127.0.0.1",
+                    page_size=4, probe_interval_s=0.0,
+                    health_recheck_s=60.0).start()
+    yield router, replicas
+    router.stop()
+    for r in replicas:
+        r.stop()
+
+
+def _affinity_prompt(router, head_url, n=4, start=0):
+    """A prompt whose ring preference head is ``head_url``."""
+    for base in range(start, start + 10000):
+        prompt = [base] * n + [base + 7]
+        key = route_key(prompt, router.page_size, router.affinity_pages)
+        if router.ring.preference(key)[0] == head_url.rstrip("/"):
+            return prompt
+    raise AssertionError("no prompt found for head")
+
+
+def test_streaming_token_exactness_vs_direct(fleet):
+    """The relay adds routing, not rewriting: tokens through the router
+    match a direct replica connection byte for byte, streamed or unary."""
+    router, replicas = fleet
+    prompt = list(range(40, 52))
+    direct = _tokens(prompt, 8)
+    base = f"http://127.0.0.1:{router.port}/v1/generate"
+    unary = _post(base, {"prompt": prompt, "max_new": 8})
+    assert unary["tokens"] == direct
+    assert unary["routed"] == "affinity"
+    assert unary["replica"] in [r.url for r in replicas]
+    toks, trailer = _post_stream(
+        base, {"prompt": prompt, "max_new": 8, "stream": True})
+    assert toks == direct
+    assert trailer["routed"] == "affinity"
+    assert router.stats()["affinity_hits"] == 2
+
+
+def test_same_prefix_same_replica(fleet):
+    """Shared-prefix prompts land on one replica (that is the whole
+    point: its radix already holds the prefix)."""
+    router, _ = fleet
+    base = f"http://127.0.0.1:{router.port}/v1/generate"
+    hits = {_post(base, {"prompt": [9, 9, 9, 9, tail], "max_new": 2}
+                  )["replica"] for tail in range(6)}
+    assert len(hits) == 1
+
+
+def test_spill_on_dead_replica(fleet):
+    """The affinity target is gone: the first request fails over
+    mid-relay (spill attempt, exact tokens); once marked down, the next
+    request routes spill_down from the start. No stream is ever lost."""
+    router, replicas = fleet
+    by_url = {r.url: r for r in replicas}
+    prompt = _affinity_prompt(router, replicas[0].url)
+    victim = by_url[router.ring.preference(
+        route_key(prompt, router.page_size))[0]]
+    victim.stop()
+    base = f"http://127.0.0.1:{router.port}/v1/generate"
+    out = _post(base, {"prompt": prompt, "max_new": 6})
+    assert out["tokens"] == _tokens(prompt, 6)
+    assert out["replica"] != victim.url
+    s = router.stats()
+    assert s["spill_attempts"] >= 1
+    assert s["dropped_streams"] == 0
+    out2 = _post(base, {"prompt": prompt, "max_new": 6})
+    assert out2["routed"] == "spill_down"
+    assert out2["tokens"] == _tokens(prompt, 6)
+
+
+def test_mid_stream_death_resumes_exactly(fleet):
+    """A replica dying after N tokens must not cost the client a single
+    token or a duplicate: the failover replay skips what was sent."""
+    router, replicas = fleet
+    prompt = _affinity_prompt(router, replicas[0].url)
+    head = {r.url: r for r in replicas}[router.ring.preference(
+        route_key(prompt, router.page_size))[0]]
+    head.fail_after = 3                       # die after 3 of 8 tokens
+    base = f"http://127.0.0.1:{router.port}/v1/generate"
+    toks, trailer = _post_stream(
+        base, {"prompt": prompt, "max_new": 8, "stream": True})
+    assert toks == _tokens(prompt, 8)
+    assert trailer["replica"] != head.url
+    s = router.stats()
+    assert s["spill_resumes"] == 1
+    assert s["dropped_streams"] == 0
+
+
+def test_tenant_bucket_sheds_429(fleet):
+    router, _ = fleet
+    router.admission = TenantAdmission(parse_qos_classes("gold:10:0:2"))
+    base = f"http://127.0.0.1:{router.port}/v1/generate"
+    req = {"prompt": [1, 2, 3, 4, 5], "max_new": 2,
+           "tenant": "alice", "qos": "gold"}
+    assert _post(base, req)["qos"] == "gold"
+    _post(base, req)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(base, req)
+    assert e.value.code == 429
+    assert e.value.headers["Retry-After"]
+    assert router.stats()["sheds"] == 1
+    assert _post(base, dict(req, tenant="bob"))["tokens"]  # isolated
+
+
+def test_set_replicas_rebalances_and_drains(fleet):
+    """The resize hook: departing replicas stop receiving NEW streams
+    immediately; arriving ones take over only their arcs."""
+    router, replicas = fleet
+    extra = _StubReplica()
+    keys = [f"k{i}" for i in range(200)]
+    before = {k: router.ring.lookup(k) for k in keys}
+    out = _post(f"http://127.0.0.1:{router.port}/v1/replicas",
+                {"replicas": [replicas[1].url, replicas[2].url,
+                              extra.url]})
+    assert out["added"] == [extra.url]
+    assert out["removed"] == [replicas[0].url]
+    after = {k: router.ring.lookup(k) for k in keys}
+    # keys that stayed on surviving replicas did not shuffle laterally
+    for k in keys:
+        if before[k] != replicas[0].url and after[k] != extra.url:
+            assert before[k] == after[k]
+    assert replicas[0].url not in router.ring.nodes()
+    assert router.stats()["rebalances"] == 1
+    out2 = _post(f"http://127.0.0.1:{router.port}/v1/generate",
+                 {"prompt": [3, 1, 4, 1, 5], "max_new": 3})
+    assert out2["replica"] != replicas[0].url
+    extra.stop()
+
+
+def test_spill_on_hot_replica_respects_floor():
+    """Back-pressure spill is a QoS feature: priority >= spill_floor
+    chases cold capacity; lower classes stay on their (hot) affinity
+    target."""
+    hot = {"window_s": 60.0, "queue_depth": 10, "queue_capacity": 10,
+           "completed": 0, "shed": 5}
+    cold = {"window_s": 60.0, "queue_depth": 0, "queue_capacity": 10,
+            "completed": 10, "shed": 0}
+    a, b = _StubReplica(gauges=hot), _StubReplica(gauges=cold)
+    router = Router([a.url, b.url], host="127.0.0.1", page_size=4,
+                    probe_interval_s=0.0, spill_floor=5)
+    try:
+        router.replicas.refresh()              # pull gauges
+        prompt = _affinity_prompt(router, a.url)
+        gold = QoSClass("gold", priority=10)
+        bronze = QoSClass("bronze", priority=1)
+        plan, how = router.route_plan(prompt, gold)
+        assert how == "spill_hot" and plan[0] == b.url
+        plan, how = router.route_plan(prompt, bronze)
+        assert how == "affinity" and plan[0] == a.url
+    finally:
+        router.stop()
+        a.stop()
+        b.stop()
+
+
+def test_random_policy_is_the_control_arm():
+    a, b = _StubReplica(), _StubReplica()
+    router = Router([a.url, b.url], host="127.0.0.1", page_size=4,
+                    probe_interval_s=0.0, policy="random").start()
+    try:
+        base = f"http://127.0.0.1:{router.port}/v1/generate"
+        for tail in range(8):
+            out = _post(base, {"prompt": [2, 2, 2, 2, tail],
+                               "max_new": 2})
+            assert out["routed"] == "random"
+        assert router.stats()["affinity_hits"] == 0
+    finally:
+        router.stop()
+        a.stop()
+        b.stop()
+
+
+def test_router_rejects_bad_requests(fleet):
+    router, _ = fleet
+    base = f"http://127.0.0.1:{router.port}/v1/generate"
+    for bad in [{"prompt": [], "max_new": 2},
+                {"prompt": "nope", "max_new": 2},
+                {"prompt": [1, 2], "max_new": 0}]:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base, bad)
+        assert e.value.code == 400
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/v1/healthz") as r:
+        health = json.loads(r.read())
+    assert health["ok"] and health["replicas"]

@@ -33,6 +33,17 @@ prompt over localhost and adopts the returned pages
 ``spans_shipped`` / ``kv_bytes_shipped`` / ``transfer_stalls`` /
 ``peer_fallbacks`` / ``adopt_shared_pages`` — the A/B against
 ``--engine paged`` is the disaggregation receipt.
+
+``--engine fleet`` runs ``--replicas`` N paged replicas IN THIS
+PROCESS, each behind its own ``ServingFrontend``, with the prefix-
+affinity ``Router`` (``models/router.py``) as the front door. The
+Poisson load draws from ``--prefix-groups`` shared system prompts
+across two tenants (``--tenant-classes`` QoS buckets), and the receipt
+gains ``route_policy`` / ``fleet_prefix_hits`` / ``fleet_prefix_hit_rate``
+/ ``router_ttft_ms`` percentiles / per-tenant SLO conformance plus the
+router's own counters — the ``--route-policy affinity`` vs ``random``
+pair at one config is the Round 12 fleet-routing receipt
+(``bench_r12/fleet_routing.jsonl``).
 """
 
 from __future__ import annotations
@@ -78,7 +89,23 @@ def main(argv=None) -> int:
                    help="tokens per device dispatch "
                         "(SlotServer.step_many)")
     p.add_argument("--engine", default="slot",
-                   choices=["slot", "paged", "disagg"])
+                   choices=["slot", "paged", "disagg", "fleet"])
+    p.add_argument("--replicas", type=int, default=2,
+                   help="fleet engine: decode replica count")
+    p.add_argument("--route-policy", default="affinity",
+                   choices=["affinity", "random"],
+                   help="fleet engine: random is the A/B control arm")
+    p.add_argument("--prefix-groups", type=int, default=4,
+                   help="fleet engine: distinct shared system prompts "
+                        "(--shared-prefix tokens each) the load draws "
+                        "from")
+    p.add_argument("--tenant-classes",
+                   default="gold:10:50:100:1500,bronze:1:5:10:4000",
+                   help="fleet engine: TENANT_CLASSES spec "
+                        "(name:priority:rate:burst[:ttft_slo_ms]); "
+                        "size the SLOs to the deployment — an SLO far "
+                        "below the engine's real p95 makes the spill "
+                        "channel scatter affinity traffic")
     p.add_argument("--pages", type=int, default=-1,
                    help="paged engine pool size (-1 = auto: "
                         "slots x max_seq/page_size)")
@@ -117,6 +144,9 @@ def main(argv=None) -> int:
         # tiny never quantizes; the receipt must say what actually ran
         params = llama.init_params(cfg, jax.random.key(0))
         quant_applied = "none"
+
+    if args.engine == "fleet":
+        return _fleet_bench(args, cfg, params, quant_applied)
 
     paged_fallback = None
     pre_engine = None
@@ -318,6 +348,184 @@ def main(argv=None) -> int:
         "tpot_ms": _percentiles(tpots),
         "ingress_stats": {k: stats[k] for k in
                           ("requests", "tokens", "rejected")},
+        "backend": jax.devices()[0].platform,
+    }), flush=True)
+    return 0
+
+
+def _fleet_bench(args, cfg, params, quant_applied) -> int:
+    """The fleet front door at N replicas: Poisson arrivals with shared
+    prefixes across two QoS tenants, routed by prefix affinity (or the
+    random control arm) — one JSON receipt with fleet prefix-hit rate,
+    router TTFT percentiles, and per-tenant SLO conformance."""
+    import jax
+
+    from dcos_commons_tpu.models.ingress import ServingFrontend
+    from dcos_commons_tpu.models.router import Router, parse_qos_classes
+    from dcos_commons_tpu.models.serving import PagedServer
+
+    rng = random.Random(args.seed)
+    lens = [int(x) for x in args.prompt_lens.split(",")]
+    prefix_len = args.shared_prefix or args.page_size
+    prefixes = [[rng.randrange(cfg.vocab_size) for _ in range(prefix_len)]
+                for _ in range(max(1, args.prefix_groups))]
+    classes = parse_qos_classes(args.tenant_classes)
+    # highest priority first: tenants[0] gets the 70% majority share
+    tenants = sorted(classes, key=lambda t: (-classes[t].priority, t)) \
+        or ["anonymous"]
+
+    def make_prompt(r):
+        return (r.choice(prefixes)
+                + [r.randrange(cfg.vocab_size)
+                   for _ in range(r.choice(lens))])
+
+    # one engine per replica, each warmed BEFORE its frontend's engine
+    # thread exists (ingress.py single-thread donation contract); every
+    # replica holds the same weights — the greedy streams are identical,
+    # which is what lets the router resume a spilled relay exactly
+    engines, fronts = [], []
+    # warm prompts match the workload LENGTHS but use fresh random
+    # tokens — warming with the shared prefixes would pre-seed every
+    # replica's radix and erase the affinity-vs-random contrast the
+    # receipt exists to measure. Each length warms twice so the
+    # prefix-hit prefill shape (tail-only) compiles too.
+    wrng = random.Random(1)
+    warm = [[wrng.randrange(cfg.vocab_size)
+             for _ in range(prefix_len + n)] for n in lens]
+    warm = [p for p in warm for _ in (0, 1)]
+    for _ in range(max(1, args.replicas)):
+        eng = PagedServer(cfg, params, slots=args.slots,
+                          pages=None if args.pages < 0 else args.pages,
+                          page_size=args.page_size,
+                          prefill_chunk=args.prefill_chunk)
+        for i, prompt in enumerate(warm):
+            eng.submit(list(prompt),
+                       max_new=args.max_new if i == 0 else 2,
+                       request_id=("warm", i))
+            while eng.requests_active():
+                eng.step_many(args.decode_window)
+        eng.finished.clear()
+        engines.append(eng)
+    for eng in engines:
+        fronts.append(ServingFrontend(eng, port=0, host="127.0.0.1",
+                                      max_queue=args.queue_limit,
+                                      decode_window=args.decode_window
+                                      ).start())
+    router = Router([f"http://127.0.0.1:{f.port}" for f in fronts],
+                    host="127.0.0.1", page_size=args.page_size,
+                    policy=args.route_policy, classes=classes,
+                    probe_interval_s=1.0, seed=args.seed).start()
+    base = f"http://127.0.0.1:{router.port}/v1/generate"
+    # HTTP-path warmup through the router (rides the engine threads)
+    for prompt in warm:
+        req = urllib.request.Request(base, data=json.dumps(
+            {"prompt": list(prompt), "max_new": 2}).encode())
+        urllib.request.urlopen(req, timeout=600).read()
+    warm_hits = sum(e.page_stats()["prefix_hits"] for e in engines)
+
+    results = []        # (latency_s, tokens, router_ttft_ms, tenant)
+    shed_429 = [0]
+    rejected = [0]
+    errors = [0]
+    threads = []
+    lock = threading.Lock()
+
+    def fire(prompt, tenant):
+        req = urllib.request.Request(base, data=json.dumps(
+            {"prompt": prompt, "max_new": args.max_new,
+             "tenant": tenant, "qos": tenant}).encode())
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=600) as r:
+                body = json.loads(r.read())
+            lat = time.perf_counter() - t0
+            with lock:
+                results.append((lat, len(body["tokens"]),
+                                body.get("router_ttft_ms"), tenant))
+        except urllib.error.HTTPError as e:
+            with lock:
+                if e.code == 429:
+                    shed_429[0] += 1
+                elif e.code == 503:
+                    rejected[0] += 1
+                else:
+                    errors[0] += 1
+        except Exception:
+            with lock:
+                errors[0] += 1
+
+    t_start = time.perf_counter()
+    offered = 0
+    while time.perf_counter() - t_start < args.duration:
+        time.sleep(rng.expovariate(args.rps))
+        # 70/30 gold/bronze keeps both tenants inside their buckets at
+        # the default rps — conformance measures latency, not sheds
+        tenant = (tenants[0] if len(tenants) == 1
+                  or rng.random() < 0.7 else tenants[-1])
+        th = threading.Thread(target=fire, args=(make_prompt(rng), tenant),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+        offered += 1
+    drain_deadline = time.time() + 300
+    for th in threads:
+        th.join(timeout=max(0.1, drain_deadline - time.time()))
+    hung = sum(1 for th in threads if th.is_alive())
+    wall = time.perf_counter() - t_start
+    rstats = router.stats()
+    router.stop()
+    for f in fronts:
+        f.stop()
+
+    fleet_hits = sum(e.page_stats()["prefix_hits"]
+                     for e in engines) - warm_hits
+    lats = [r[0] * 1000 for r in results]
+    ttfts = [r[2] for r in results if r[2] is not None]
+    total_tokens = sum(r[1] for r in results)
+    per_tenant = {}
+    for tenant in tenants:
+        mine = [r for r in results if r[3] == tenant]
+        slo = classes[tenant].ttft_slo_ms if tenant in classes else None
+        conform = None
+        if mine and slo is not None:
+            good = sum(1 for r in mine
+                       if r[2] is not None and r[2] <= slo)
+            conform = round(good / len(mine), 4)
+        per_tenant[tenant] = {
+            "completed": len(mine),
+            "ttft_slo_ms": slo,
+            "slo_conformance": conform,
+            "router_ttft_ms": _percentiles(
+                [r[2] for r in mine if r[2] is not None]),
+        }
+    print(json.dumps({
+        "metric": "fleet_routing",
+        "preset": args.preset, "quant": quant_applied,
+        "engine": "fleet", "route_policy": args.route_policy,
+        "replicas": args.replicas, "slots": args.slots,
+        "page_size": args.page_size,
+        "prefix_groups": args.prefix_groups,
+        "shared_prefix": prefix_len,
+        "tenant_classes": args.tenant_classes,
+        "rps_offered": args.rps,
+        "duration_s": round(wall, 1),
+        "requests_offered": offered,
+        "requests_completed": len(results),
+        "shed_429": shed_429[0],
+        "rejected_503": rejected[0], "errors": errors[0],
+        "unfinished_at_drain_deadline": hung,
+        "max_new": args.max_new,
+        "throughput_tokens_per_sec": round(total_tokens / wall, 1),
+        "fleet_prefix_hits": fleet_hits,
+        "fleet_prefix_hit_rate": (round(fleet_hits / len(results), 3)
+                                  if results else None),
+        "latency_ms": _percentiles(lats),
+        "router_ttft_ms": _percentiles(ttfts),
+        "per_tenant": per_tenant,
+        "router_stats": {k: rstats[k] for k in
+                         ("routed", "affinity_hits", "affinity_rate",
+                          "spills_hot", "spills_down", "spill_attempts",
+                          "spill_resumes", "dropped_streams", "sheds")},
         "backend": jax.devices()[0].platform,
     }), flush=True)
     return 0

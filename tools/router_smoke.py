@@ -1,0 +1,202 @@
+"""Time-capped fleet front-door smoke for CI: two real in-process
+decode replicas (tiny paged engines behind ``ServingFrontend``) with a
+``Router`` in front, driven by a shared-prefix workload.
+
+Two always-on checks next to the serving smoke in test.sh:
+
+1. **affinity beats random** — the same workload runs through both
+   routing policies against fresh replica radixes; the affinity arm
+   must land shared-prefix traffic on one replica (router affinity rate
+   ~1.0) AND convert that into strictly more fleet radix prefix hits
+   than the random control arm. This is the whole point of the tier —
+   if it regresses, prefix caching stops compounding across the fleet.
+2. **resize under load drops nothing** — streaming requests run while
+   ``POST /v1/replicas`` swaps a replica out and a new one in
+   mid-flight. Every admitted stream must complete token-exact
+   (departing replicas drain; arriving ones take over their arcs), with
+   ``dropped_streams == 0``.
+
+Checks run in order and stop (skip, not fail) when the time budget runs
+out — a slow CI host skips tail checks rather than timing out the
+build.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+
+def _mk_replica(cfg, params):
+    from dcos_commons_tpu.models import serving
+    from dcos_commons_tpu.models.ingress import ServingFrontend
+    engine = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                 prefill_chunk=8)
+    front = ServingFrontend(engine, port=0, host="127.0.0.1").start()
+    return engine, front
+
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _workload(cfg, rng_seed=11, groups=4, per_group=5, prefix_len=16,
+              max_new=4):
+    """Shared-prefix prompts: `groups` system prompts (one full page
+    each), `per_group` requests apiece with distinct tails."""
+    import jax
+    rng = jax.random.key(rng_seed)
+    out = []
+    for g in range(groups):
+        rng, sub = jax.random.split(rng)
+        prefix = [int(t) for t in jax.random.randint(
+            sub, (prefix_len,), 0, cfg.vocab_size)]
+        for i in range(per_group):
+            out.append({"prompt": prefix + [(g * 97 + i) % cfg.vocab_size],
+                        "max_new": max_new})
+    return out
+
+
+def _run_arm(policy, cfg, params, reqs):
+    """One A/B arm: fresh replicas (cold radixes), a router with the
+    given policy, the whole workload, fleet prefix hits out."""
+    from dcos_commons_tpu.models.router import Router
+    replicas = [_mk_replica(cfg, params) for _ in range(2)]
+    router = Router([f"http://127.0.0.1:{f.port}" for _, f in replicas],
+                    host="127.0.0.1", page_size=16, policy=policy,
+                    probe_interval_s=0.0, seed=5).start()
+    try:
+        base = f"http://127.0.0.1:{router.port}/v1/generate"
+        for r in reqs:
+            out = _post(base, r)
+            if len(out["tokens"]) != r["max_new"]:
+                raise AssertionError(
+                    f"{policy}: short stream {out}")
+        hits = sum(e.page_stats()["prefix_hits"] for e, _ in replicas)
+        return hits, router.stats()
+    finally:
+        router.stop()
+        for _, f in replicas:
+            f.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget-s", type=float, default=150.0,
+                    help="wall-clock cap; tail checks are skipped, not "
+                         "failed, when it runs out (default 150)")
+    args = ap.parse_args(argv)
+    deadline = time.monotonic() + args.budget_s
+
+    import jax
+
+    from dcos_commons_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                 attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.key(0))
+    reqs = _workload(cfg)
+    ran = 0
+
+    def _spent(name: str) -> bool:
+        if time.monotonic() >= deadline:
+            print(f"router-smoke: time budget exhausted after {ran} "
+                  f"checks; {name!r} and later checks skipped")
+            return True
+        return False
+
+    # 1. A/B: affinity must beat the random control arm on fleet
+    # prefix hits (same workload, fresh radixes per arm)
+    if _spent("affinity-vs-random"):
+        return 0
+    aff_hits, aff_stats = _run_arm("affinity", cfg, params, reqs)
+    if _spent("affinity-vs-random"):
+        return 0
+    rnd_hits, _ = _run_arm("random", cfg, params, reqs)
+    if aff_stats["affinity_rate"] < 0.99:
+        print(f"router-smoke FAILED: affinity rate "
+              f"{aff_stats['affinity_rate']} < 0.99 on a healthy fleet",
+              file=sys.stderr)
+        return 1
+    if aff_hits <= rnd_hits:
+        print(f"router-smoke FAILED: affinity prefix hits {aff_hits} "
+              f"<= random {rnd_hits} — routing is not compounding the "
+              "radix", file=sys.stderr)
+        return 1
+    ran += 1
+
+    # 2. resize mid-load: swap a replica while streams are in flight;
+    # zero admitted streams may drop
+    if _spent("resize-under-load"):
+        return 0
+    from dcos_commons_tpu.models.router import Router
+    replicas = [_mk_replica(cfg, params) for _ in range(2)]
+    spare_engine, spare = _mk_replica(cfg, params)
+    router = Router([f"http://127.0.0.1:{f.port}" for _, f in replicas],
+                    host="127.0.0.1", page_size=16,
+                    probe_interval_s=0.0).start()
+    base = f"http://127.0.0.1:{router.port}"
+    results, errors = [], []
+
+    def _client(r):
+        try:
+            results.append(_post(f"{base}/v1/generate", r))
+        except Exception as e:                    # noqa: BLE001
+            errors.append(repr(e))
+
+    try:
+        threads = [threading.Thread(target=_client, args=(dict(r),))
+                   for r in reqs * 2]
+        for i, t in enumerate(threads):
+            t.start()
+            if i == len(threads) // 2:
+                # the resize lands while half the workload is in flight
+                out = _post(f"{base}/v1/replicas", {"replicas": [
+                    f"http://127.0.0.1:{replicas[1][1].port}",
+                    f"http://127.0.0.1:{spare.port}"]})
+        for t in threads:
+            t.join(timeout=max(5.0, deadline - time.monotonic()))
+        stats = router.stats()
+        if errors:
+            print(f"router-smoke FAILED: {len(errors)} streams errored "
+                  f"across the resize: {errors[:3]}", file=sys.stderr)
+            return 1
+        if len(results) != len(threads):
+            print(f"router-smoke FAILED: {len(threads) - len(results)} "
+                  "streams never completed", file=sys.stderr)
+            return 1
+        if stats["dropped_streams"]:
+            print(f"router-smoke FAILED: {stats['dropped_streams']} "
+                  "admitted streams dropped across the resize",
+                  file=sys.stderr)
+            return 1
+        short = [r for r in results if len(r["tokens"]) != reqs[0]["max_new"]]
+        if short:
+            print(f"router-smoke FAILED: short streams {short[:2]}",
+                  file=sys.stderr)
+            return 1
+        ran += 1
+    finally:
+        router.stop()
+        for _, f in replicas:
+            f.stop()
+        spare.stop()
+
+    print(f"router-smoke: {ran} checks passed — affinity fleet prefix "
+          f"hits {aff_hits} > random {rnd_hits} (affinity rate "
+          f"{aff_stats['affinity_rate']}), resize under load moved "
+          f"{out['added']} in / {out['removed']} out with "
+          f"{stats['rebalances']} rebalance(s) and zero dropped streams")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
