@@ -26,7 +26,10 @@ cache-aware-routing insight as a schedulable pod tier:
   stream straight back to the client connection, and because decode is
   deterministic greedy, a replica that dies mid-stream is survivable —
   the relay re-issues the request on the next candidate and skips the
-  tokens the client already has (``spill_resumes``). An admitted stream
+  tokens the client already has (``spill_resumes``) — after checking
+  each replayed token against what was relayed, so replicas that
+  diverge (mixed versions mid-rolling-deploy) fail over again instead
+  of splicing two completions (``resume_divergences``). An admitted stream
   is only ever dropped after every healthy candidate was attempted
   (``dropped_streams`` — the chaos invariant pins this to spill-first).
 * **Health/load-aware spill** (:class:`ReplicaSet`): generalizes
@@ -53,7 +56,7 @@ import time
 import urllib.error
 import urllib.request
 from bisect import bisect_right, insort
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
@@ -225,44 +228,85 @@ def parse_qos_classes(spec: str) -> Dict[str, QoSClass]:
     return out
 
 
+DEFAULT_MAX_TENANTS = 4096
+
+
 class TenantAdmission:
     """Per-tenant token buckets over the configured QoS classes.
 
     A request names its tenant and (optionally) its class; unknown
     classes fall back to ``default`` when configured, else to the
-    unlimited :data:`DEFAULT_CLASS`. Each TENANT gets its own bucket
-    (two gold tenants cannot eat each other's budget — the isolation
-    the ``tenant_flood`` chaos invariant leans on)."""
+    unlimited :data:`DEFAULT_CLASS`. Buckets key on ``(tenant, class)``:
+    each TENANT gets its own bucket per class (two gold tenants cannot
+    eat each other's budget — the isolation the ``tenant_flood`` chaos
+    invariant leans on), and naming a DIFFERENT class on the next
+    request never resets an existing bucket — ``qos`` is client-
+    supplied, so a tenant alternating gold/free holds at most the sum
+    of both budgets instead of minting a fresh burst per request. If a
+    class is reconfigured in place, the old balance carries over
+    (capped at the new burst); a config change is never a refill.
+
+    All per-tenant state (buckets, admitted/shed counters) is LRU-
+    capped at ``max_tenants`` entries, so an unauthenticated client
+    spraying unique ``X-Tenant`` values cannot grow router memory
+    without bound. An idle tenant evicted by the cap restarts from a
+    fresh burst if it returns — the price of bounding state — while
+    ``admitted_total``/``shed_total`` keep exact fleet-wide tallies."""
 
     def __init__(self, classes: Optional[Dict[str, QoSClass]] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 max_tenants: int = DEFAULT_MAX_TENANTS):
+        if max_tenants < 1:
+            raise ValueError(f"max_tenants must be >= 1, "
+                             f"got {max_tenants}")
         self.classes = dict(classes or {})
         self._clock = clock
-        self._buckets: Dict[str, TokenBucket] = {}
+        self.max_tenants = int(max_tenants)
+        self._buckets: "OrderedDict[Tuple[str, str], TokenBucket]" = (
+            OrderedDict())
         self._lock = threading.Lock()
-        self.admitted: Dict[str, int] = {}
-        self.shed: Dict[str, int] = {}
+        self.admitted: "OrderedDict[str, int]" = OrderedDict()
+        self.shed: "OrderedDict[str, int]" = OrderedDict()
+        self.admitted_total = 0
+        self.shed_total = 0
 
     def qos(self, qos_name: Optional[str]) -> QoSClass:
         if qos_name and qos_name in self.classes:
             return self.classes[qos_name]
         return self.classes.get("default", DEFAULT_CLASS)
 
+    def _bump(self, counters: "OrderedDict[str, int]",
+              tenant: str) -> None:
+        counters[tenant] = counters.get(tenant, 0) + 1
+        counters.move_to_end(tenant)
+        while len(counters) > self.max_tenants:
+            counters.popitem(last=False)
+
     def admit(self, tenant: str, qos_name: Optional[str] = None
               ) -> Tuple[bool, QoSClass]:
         cls = self.qos(qos_name)
+        key = (tenant, cls.name)
         with self._lock:
-            bucket = self._buckets.get(tenant)
-            if bucket is None or (bucket.rate, bucket.burst) != (
-                    cls.rate, cls.burst):
-                bucket = self._buckets[tenant] = TokenBucket(
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = self._buckets[key] = TokenBucket(
                     cls.rate, cls.burst, clock=self._clock)
+            elif (bucket.rate, bucket.burst) != (cls.rate, cls.burst):
+                fresh = TokenBucket(cls.rate, cls.burst,
+                                    clock=self._clock)
+                fresh._tokens = min(bucket.available(), fresh.burst)
+                bucket = self._buckets[key] = fresh
+            self._buckets.move_to_end(key)
+            while len(self._buckets) > self.max_tenants:
+                self._buckets.popitem(last=False)
         if bucket.burst == float("inf") or bucket.try_take():
             with self._lock:
-                self.admitted[tenant] = self.admitted.get(tenant, 0) + 1
+                self._bump(self.admitted, tenant)
+                self.admitted_total += 1
             return True, cls
         with self._lock:
-            self.shed[tenant] = self.shed.get(tenant, 0) + 1
+            self._bump(self.shed, tenant)
+            self.shed_total += 1
         return False, cls
 
 
@@ -425,6 +469,7 @@ class Router:
                  host: str = "0.0.0.0", page_size: int = 64,
                  affinity_pages: int = 1, vnodes: int = 64,
                  classes: Optional[Dict[str, QoSClass]] = None,
+                 max_tenants: int = DEFAULT_MAX_TENANTS,
                  policy: str = "affinity",
                  spill_pressure: float = 0.85,
                  spill_floor: int = 0,
@@ -447,15 +492,17 @@ class Router:
             (e.rstrip("/") for e in replicas), vnodes=vnodes)
         self.replicas = ReplicaSet(replicas,
                                    health_recheck_s=health_recheck_s)
-        self.admission = TenantAdmission(classes)
+        self.admission = TenantAdmission(classes,
+                                         max_tenants=max_tenants)
         import random as _random
         self._rng = _random.Random(seed)
         self._lock = threading.Lock()
+        self._resize_lock = threading.Lock()
         self._counts: Dict[str, int] = {
             "routed": 0, "affinity_hits": 0, "spills_hot": 0,
             "spills_down": 0, "spill_attempts": 0, "spill_resumes": 0,
-            "dropped_streams": 0, "sheds": 0, "rebalances": 0,
-            "errors": 0}
+            "resume_divergences": 0, "dropped_streams": 0, "sheds": 0,
+            "rebalances": 0, "errors": 0}
         self._per_replica: Dict[str, int] = {}
         self._active: Dict[str, int] = {}      # replica -> live relays
         self._ttfts: deque = deque(maxlen=4096)  # (t, tenant, ttft_ms)
@@ -549,7 +596,11 @@ class Router:
             healthy = self.replicas.healthy()
             if not healthy:
                 return [], "none"
-            self._rng.shuffle(healthy)
+            with self._lock:
+                # Random instances are not thread-safe: an unguarded
+                # shuffle from concurrent handler threads corrupts the
+                # control arm's distribution and seed-determinism
+                self._rng.shuffle(healthy)
             return healthy, "random"
         key = route_key(prompt, self.page_size, self.affinity_pages)
         pref = self.ring.preference(key)
@@ -663,9 +714,20 @@ class Router:
                 for obj in self._upstream(target, prompt, max_new):
                     if "token" in obj:
                         seen += 1
-                        if seen <= len(sent):
-                            continue           # resume skip
                         tok = int(obj["token"])
+                        if seen <= len(sent):
+                            if tok != sent[seen - 1]:
+                                # the replacement replica disagrees on
+                                # the replayed prefix (mixed model or
+                                # config versions mid-rolling-deploy):
+                                # splicing the two completions would
+                                # hand the client a corrupt stream
+                                self._count("resume_divergences")
+                                raise ReplicaError(
+                                    f"{target}: resume divergence at "
+                                    f"token {seen - 1} ({tok} != "
+                                    f"{sent[seen - 1]})")
+                            continue           # resume skip, verified
                         if t_first is None:
                             t_first = time.perf_counter()
                         sent.append(tok)
@@ -729,24 +791,29 @@ class Router:
         NEW streams route to them — while relays already attached keep
         their connections and drain to completion (``draining`` counts
         them). Arriving replicas take over only their arcs of the
-        keyspace (bounded movement)."""
-        want = [e.rstrip("/") for e in endpoints]
-        have = set(self.ring.nodes())
-        added = [e for e in want if e not in have]
-        removed = [e for e in have if e not in want]
-        for ep in added:
-            self.ring.add(ep)
-            self.replicas.add(ep)
-        for ep in removed:
-            self.ring.remove(ep)
-            self.replicas.remove(ep)
-        if added or removed:
-            self._count("rebalances")
-        with self._lock:
-            draining = {ep: n for ep, n in self._active.items()
-                        if ep in removed and n > 0}
-        return {"replicas": self.ring.nodes(), "added": sorted(added),
-                "removed": sorted(removed), "draining": draining}
+        keyspace (bounded movement). The resized ring is built aside
+        and swapped in as one reference assignment — ``HashRing`` makes
+        no thread-safety promise, so concurrent ``route_plan`` calls
+        must see the old ring or the new one, never a half-mutated
+        point list."""
+        with self._resize_lock:
+            want = [e.rstrip("/") for e in endpoints]
+            have = set(self.ring.nodes())
+            added = [e for e in want if e not in have]
+            removed = [e for e in have if e not in want]
+            for ep in added:
+                self.replicas.add(ep)
+            self.ring = HashRing(want, vnodes=self.ring.vnodes)
+            for ep in removed:
+                self.replicas.remove(ep)
+            if added or removed:
+                self._count("rebalances")
+            with self._lock:
+                draining = {ep: n for ep, n in self._active.items()
+                            if ep in removed and n > 0}
+            return {"replicas": self.ring.nodes(),
+                    "added": sorted(added), "removed": sorted(removed),
+                    "draining": draining}
 
     # ------------------------------------------------------------- status
 
@@ -790,6 +857,9 @@ class Router:
             "active_relays": active,
             "ttft_ms": percentiles(ttfts),
             "tenants": tenants,
+            "tenants_tracked": len(seen),
+            "admitted_total": self.admission.admitted_total,
+            "shed_total": self.admission.shed_total,
             "classes": {name: {"priority": c.priority, "rate": c.rate,
                                "burst": c.burst,
                                "ttft_slo_ms": c.ttft_slo_ms}

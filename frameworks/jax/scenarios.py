@@ -82,6 +82,9 @@ DEFAULT_ENV: Mapping[str, str] = {
     "ROUTE_SPILL_PRESSURE": "0.85",
     "ROUTE_SPILL_FLOOR": "0",
     "TENANT_CLASSES": "gold:10:50:100:500,bronze:1:5:10",
+    # LRU cap on tracked per-tenant router state (buckets/counters):
+    # bounds memory against unique-X-Tenant floods
+    "TENANT_MAX_TRACKED": "4096",
     # long-context scenario knobs (longctx.yml)
     "SEQ_LEN": "8192",
     "ATTN_IMPL": "ring",

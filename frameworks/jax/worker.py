@@ -560,7 +560,8 @@ def _serve_router(args) -> dict:
                     vnodes=args.route_vnodes, classes=classes,
                     policy=args.route_policy,
                     spill_pressure=args.route_spill_pressure,
-                    spill_floor=args.route_spill_floor).start()
+                    spill_floor=args.route_spill_floor,
+                    max_tenants=args.tenant_max_tracked).start()
     with open("serving.ready", "w") as f:
         f.write(f"ok {router.port}\n")
     _emit({"event": "serving", "role": "router", "port": router.port,
@@ -994,6 +995,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "shares the scheduler's priority: integer "
                         "scale; rate/burst parameterize each tenant's "
                         "token bucket; empty = admission wide open")
+    p.add_argument("--tenant-max-tracked", type=int,
+                   default=int(os.environ.get("TENANT_MAX_TRACKED",
+                                              "4096")),
+                   help="router: LRU cap on tracked per-tenant state "
+                        "(buckets + counters), bounding memory against "
+                        "unique-X-Tenant floods; an idle tenant "
+                        "evicted past the cap restarts from a fresh "
+                        "burst on return")
     p.add_argument("--serve-peer",
                    default=os.environ.get("SERVE_PEER", ""),
                    help="llama --serve --serve-role decode: prefill "

@@ -154,6 +154,58 @@ def test_tenant_isolation_separate_buckets():
     assert adm.admit("anybody", None)[0]
 
 
+def test_alternating_qos_names_does_not_restore_budget():
+    """``qos`` is client-supplied: alternating between two configured
+    classes must not mint a fresh bucket per request (the review-found
+    rate-limit bypass). Buckets key on (tenant, class), so the tenant
+    holds at most the SUM of both budgets, once."""
+    clock = [0.0]
+    adm = TenantAdmission(parse_qos_classes("gold:10:0:2,free:1:0:1"),
+                          clock=lambda: clock[0])
+    results = [adm.admit("mallory", q)[0] for q in ["gold", "free"] * 6]
+    assert sum(results) == 3           # 2 gold + 1 free, never refreshed
+    assert not adm.admit("mallory", "gold")[0]
+    assert not adm.admit("mallory", "free")[0]
+    clock[0] += 1e6                    # rate 0: time refills nothing
+    assert not adm.admit("mallory", "gold")[0]
+
+
+def test_class_reconfig_never_refills():
+    """Reconfiguring a class in place carries the tenant's balance
+    (capped at the new burst) — a config push is not a refill."""
+    clock = [0.0]
+    adm = TenantAdmission(parse_qos_classes("gold:10:0:2"),
+                          clock=lambda: clock[0])
+    assert adm.admit("a", "gold")[0] and adm.admit("a", "gold")[0]
+    assert not adm.admit("a", "gold")[0]       # dry
+    adm.classes["gold"] = QoSClass("gold", priority=10, rate=0.0,
+                                   burst=10.0)
+    assert not adm.admit("a", "gold")[0]       # carried 0, not burst 10
+
+
+def test_tenant_state_is_lru_capped():
+    """A client spraying unique X-Tenant values must not grow router
+    memory without bound: buckets and per-tenant counters are LRU-
+    capped while the aggregate totals stay exact."""
+    adm = TenantAdmission(parse_qos_classes("free:1:0:1"),
+                          max_tenants=8)
+    for i in range(100):
+        adm.admit(f"t{i}", "free")
+    assert len(adm._buckets) <= 8
+    assert len(adm.admitted) <= 8 and len(adm.shed) <= 8
+    assert adm.admitted_total == 100           # burst 1 each, all admit
+    # a busy tenant's bucket survives the churn (LRU keeps the hot end)
+    adm2 = TenantAdmission(parse_qos_classes("free:1:0:1"),
+                           max_tenants=8)
+    assert adm2.admit("hot", "free")[0]
+    assert not adm2.admit("hot", "free")[0]    # dry
+    for i in range(6):
+        adm2.admit(f"cold{i}", "free")
+    assert not adm2.admit("hot", "free")[0]    # still dry, not evicted
+    with pytest.raises(ValueError):
+        TenantAdmission(max_tenants=0)
+
+
 # ---------------------------------------------------------- replica set
 
 
@@ -203,7 +255,8 @@ def _tokens(prompt, max_new):
 
 
 class _StubReplica:
-    def __init__(self, fail_after=None, gauges=None, busy=False):
+    def __init__(self, fail_after=None, gauges=None, busy=False,
+                 token_fn=None):
         stub = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -226,7 +279,8 @@ class _StubReplica:
                 if stub.busy:
                     self.send_error(503)
                     return
-                toks = _tokens(req["prompt"], req.get("max_new", 32))
+                toks = (stub.token_fn or _tokens)(req["prompt"],
+                                                 req.get("max_new", 32))
                 self.send_response(200)
                 self.end_headers()
                 stub.served += 1
@@ -244,6 +298,7 @@ class _StubReplica:
         self.fail_after = fail_after
         self.gauges = gauges
         self.busy = busy
+        self.token_fn = token_fn
         self.served = 0
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self._httpd.daemon_threads = True
@@ -367,6 +422,28 @@ def test_mid_stream_death_resumes_exactly(fleet):
     assert trailer["replica"] != head.url
     s = router.stats()
     assert s["spill_resumes"] == 1
+    assert s["dropped_streams"] == 0
+
+
+def test_resume_divergence_fails_over(fleet):
+    """A replacement replica whose replayed prefix disagrees with what
+    the client already received must NOT be spliced in: the relay
+    detects the divergence, marks the replica down, and fails over
+    again — the client still gets one coherent completion."""
+    router, replicas = fleet
+    by_url = {r.url: r for r in replicas}
+    prompt = _affinity_prompt(router, replicas[0].url)
+    pref = router.ring.preference(route_key(prompt, router.page_size))
+    by_url[pref[0]].fail_after = 3             # die after 3 of 8 tokens
+    by_url[pref[1]].token_fn = (               # divergent replay
+        lambda p, m: [t + 1 for t in _tokens(p, m)])
+    base = f"http://127.0.0.1:{router.port}/v1/generate"
+    toks, trailer = _post_stream(
+        base, {"prompt": prompt, "max_new": 8, "stream": True})
+    assert toks == _tokens(prompt, 8)          # pref[2] finished it
+    assert trailer["replica"] == pref[2]
+    s = router.stats()
+    assert s["resume_divergences"] == 1
     assert s["dropped_streams"] == 0
 
 
