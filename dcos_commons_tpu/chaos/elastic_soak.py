@@ -40,10 +40,12 @@ never perturbs the draw order of a pinned seed.
 from __future__ import annotations
 
 import random
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..agent.fake import FakeCluster
 from ..models.router import HashRing, QoSClass, TenantAdmission, route_key
+from ..tracing import TraceStore, Tracer
 from ..plan.backoff import ExponentialBackoff
 from ..plan.status import Status
 from ..scheduler.core import ServiceScheduler
@@ -216,6 +218,12 @@ class _RouterSim:
         self.drops: List[Tuple[int, str, int, bool]] = []
         self.completed = 0
         self.total_spills = 0
+        # every admitted relay carries a trace; the trace-completeness
+        # invariant audits that each one reaches a terminal span. Ids
+        # come from os.urandom (tracing.new_id), so arming tracing
+        # cannot perturb this sim's pinned-seed draw order.
+        self.trace_store = TraceStore(capacity=1 << 16)
+        self.tracer = Tracer("router-sim", self.trace_store)
 
     def flood(self, tick: int, duration: int) -> None:
         self.flood_until = max(self.flood_until, tick + duration)
@@ -269,17 +277,25 @@ class _RouterSim:
                     prefix = self.rng.randrange(self.PREFIXES)
                     prompt = [prefix] * self.PAGE + [self._serial]
                     ok, _cls = self.admission.admit(tenant, tenant)
+                    t_adm = time.perf_counter()
                     if not ok:
                         if not self._flooding(tenant, tick):
                             self.bad_sheds.append((tick, tenant))
+                        # a shed is a complete one-span trace
+                        self.tracer.record("sim.admission", t_adm, t_adm,
+                                           terminal=True, status="shed",
+                                           tenant=tenant, tick=tick)
                         continue
+                    ctx = self.tracer.record("sim.admission", t_adm,
+                                             t_adm, tenant=tenant,
+                                             tick=tick)
                     self.relays.append({
                         "id": f"r{self._serial}", "tenant": tenant,
                         "key": route_key(prompt, self.PAGE),
                         "replica": None, "ever_placed": False,
                         "left": self.rng.randint(*self.RELAY_TICKS),
                         "attempts": 0, "stalled": 0, "parked": 0,
-                        "born": tick,
+                        "born": tick, "trace": ctx,
                     })
         finished = []
         for r in self.relays:
@@ -305,14 +321,26 @@ class _RouterSim:
                     if r["parked"] > self.PARK_LIMIT:
                         self.drops.append((tick, r["id"], r["attempts"],
                                            r["ever_placed"]))
+                        self._end_trace(r, tick, "dropped")
                         finished.append(r)
                 continue
             r["left"] -= 1
             if r["left"] <= 0:
                 self.completed += 1
+                self._end_trace(r, tick, "ok")
                 finished.append(r)
         for r in finished:
             self.relays.remove(r)
+
+    def _end_trace(self, relay: dict, tick: int, status: str) -> None:
+        """Terminal ``sim.relay`` span: the relay's trace is complete —
+        every finished relay, completed or dropped, lands here exactly
+        once (the trace-completeness invariant's guarantee)."""
+        t = time.perf_counter()
+        self.tracer.record("sim.relay", t, t, parent=relay["trace"],
+                           terminal=True, status=status,
+                           relay=relay["id"], tick=tick,
+                           attempts=relay["attempts"])
 
 
 class _FlushSim:

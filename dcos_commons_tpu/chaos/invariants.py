@@ -336,12 +336,19 @@ class RouterInvariantChecker:
     12. **no stalled relays** — an admitted relay makes progress every
         tick at least one replica is up; a relay starved past the stall
         window while capacity existed is a routing wedge, not load.
+    13. **trace completeness** — every admitted relay's trace reaches a
+        terminal span (``tracing.py``): an incomplete trace whose relay
+        is no longer in flight means the observability plane silently
+        lost a request's ending — the exact blind spot tracing exists
+        to close. Checked every tick against the live relay set, so at
+        settle (inflight == 0) every retained trace must be complete.
     """
 
     def __init__(self, harness):
         self._h = harness          # needs .routersim
         self._sheds_seen = 0
         self._drops_seen = 0
+        self._orphans_seen: set = set()
 
     def check(self, tick: int) -> List[Violation]:
         sim = self._h.routersim
@@ -367,4 +374,15 @@ class RouterInvariantChecker:
                     "relay-stall",
                     f"relay {r['id']} ({r['tenant']}) made no progress "
                     f"for {r['stalled']} ticks with live replicas", tick))
+        store = getattr(sim, "trace_store", None)
+        if store is not None:
+            inflight = {r["trace"].trace_id for r in sim.relays
+                        if r.get("trace") is not None}
+            for tid in store.incomplete_trace_ids():
+                if tid not in inflight and tid not in self._orphans_seen:
+                    self._orphans_seen.add(tid)
+                    out.append(Violation(
+                        "trace-completeness",
+                        f"trace {tid} never reached a terminal span but "
+                        "its relay is no longer in flight", tick))
         return out

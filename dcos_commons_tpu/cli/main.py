@@ -281,6 +281,43 @@ def _route_stats_cmd(client: Client, args) -> int:
         return 1
 
 
+def _trace_cmd(client: Client, args) -> int:
+    """Fleet-wide request traces from the router tier (``GET
+    /v1/traces`` / ``/v1/trace/<id>``, ``models/router.py``). Without a
+    TRACE_ID, lists retained (and still-incomplete) trace ids; with
+    one, prints the merged cross-tier span list — or converts it to
+    Chrome ``trace_event`` JSON (``--chrome FILE``) for
+    ``chrome://tracing`` / Perfetto."""
+    base = (args.router or os.environ.get("TPU_ROUTER", "")).rstrip("/")
+    if not base:
+        print("trace: provide --router URL or set TPU_ROUTER "
+              "(e.g. http://router-0.example:8180)", file=sys.stderr)
+        return 2
+    try:
+        from ..security.transport import urlopen
+    except ImportError:
+        urlopen = urllib.request.urlopen
+    url = (f"{base}/v1/trace/{args.trace_id}" if args.trace_id
+           else f"{base}/v1/traces")
+    try:
+        with urlopen(url, timeout=30) as r:
+            status, payload = r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return _emit(e.code, {"error": str(e)})
+    except OSError as e:
+        print(f"trace: {base} unreachable: {e}", file=sys.stderr)
+        return 1
+    if args.trace_id and args.chrome:
+        from ..tracing import Span, chrome_trace
+        spans = [Span.from_dict(d) for d in payload.get("spans", ())]
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_trace(spans), f)
+        print(json.dumps({"trace_id": args.trace_id, "spans": len(spans),
+                          "chrome": args.chrome}))
+        return 0 if spans else 1
+    return _emit(status, payload)
+
+
 # -- static analysis (analysis/: S-rules over specs, J-rules over jaxprs) --
 
 def _framework_default_env(path: str) -> dict:
@@ -542,6 +579,16 @@ def build_parser() -> argparse.ArgumentParser:
     rs.add_argument("--router", default=None, metavar="URL",
                     help="router base URL (default: $TPU_ROUTER)")
     rs.set_defaults(fn=_route_stats_cmd)
+
+    tr = sub.add_parser("trace",
+                        help="fetch fleet-wide request traces")
+    tr.add_argument("trace_id", nargs="?", default=None,
+                    help="trace id (omit to list retained traces)")
+    tr.add_argument("--router", default=None,
+                    help="router base URL (default: $TPU_ROUTER)")
+    tr.add_argument("--chrome", default=None, metavar="FILE",
+                    help="write Chrome trace_event JSON to FILE")
+    tr.set_defaults(fn=_trace_cmd)
 
     lint = sub.add_parser(
         "lint", help="static-analyze service specs (S-rules) and "

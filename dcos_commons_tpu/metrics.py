@@ -1,18 +1,44 @@
-"""Metrics registry: counters/gauges/timers, StatsD push, Prometheus/JSON.
+"""Metrics registry: counters/gauges/histograms, StatsD push, Prometheus/JSON.
 
 Reference: ``metrics/Metrics.java:66-190`` (Codahale ``MetricRegistry`` with
 StatsD push via ``STATSD_UDP_HOST/PORT`` and pull endpoints ``/v1/metrics`` +
 ``/v1/metrics/prometheus``; counters for offers/declines/revives/operations/
 task statuses; per-plan status gauges) and ``metrics/PlanReporter.java``
 (periodic plan gauges). Stdlib-only; thread-safe.
+
+Timers are fixed-bucket histograms (geometric bounds, factor 2^(1/8) from
+100µs to >1000s), so p50/p95/p99 are exact within bucket resolution
+(~±4.4% worst case) at O(1) record cost and bounded memory — the serving
+tier records one sample per request at line rate. The same histograms
+back the Prometheus ``_bucket{le=...}`` exposition and the TTFT/TPOT
+percentiles the benches report, one source of truth with production.
 """
 
 from __future__ import annotations
 
+import hashlib
 import socket
 import threading
 import time
-from typing import Callable, Dict, Optional
+from bisect import bisect_left
+from typing import Callable, Dict, List, Optional, Tuple
+
+# geometric histogram bounds: 2^(1/8) steps from 100µs up past 1000s.
+# Within a bucket the estimate is the geometric midpoint, so the worst
+# relative error is factor^(1/2)-1 ~ 4.4% — inside the 10% the serving
+# receipts are held to.
+_BUCKET_FACTOR = 2.0 ** 0.125
+_BUCKET_MIN_S = 1e-4
+
+
+def _make_bounds() -> Tuple[float, ...]:
+    out = [_BUCKET_MIN_S]
+    while out[-1] < 1e3:
+        out.append(out[-1] * _BUCKET_FACTOR)
+    return tuple(out)
+
+
+BUCKET_BOUNDS: Tuple[float, ...] = _make_bounds()
 
 
 def _sanitize(name: str) -> str:
@@ -23,23 +49,83 @@ def _sanitize(name: str) -> str:
     return s if not s[:1].isdigit() else "_" + s
 
 
+def _unique_name(name: str, seen: Dict[str, str]) -> str:
+    """Sanitize with collision detection: two raw names mapping onto the
+    same Prometheus name would otherwise emit duplicate series (invalid
+    exposition); the later one gets a short content-hash suffix."""
+    m = _sanitize(name)
+    owner = seen.setdefault(m, name)
+    if owner == name:
+        return m
+    m = f"{m}_{hashlib.blake2s(name.encode(), digest_size=4).hexdigest()}"
+    seen[m] = name
+    return m
+
+
 class Timer:
-    """Cumulative timer: count + total/max seconds (Codahale Timer analogue)."""
+    """Cumulative latency histogram (Codahale Timer analogue, upgraded
+    from mean/max-only to bucketed percentiles)."""
+
+    __slots__ = ("count", "total_s", "max_s", "min_s", "_buckets")
 
     def __init__(self) -> None:
         self.count = 0
         self.total_s = 0.0
         self.max_s = 0.0
+        self.min_s = 0.0
+        self._buckets: Dict[int, int] = {}   # bound index -> samples
 
     def record(self, elapsed_s: float) -> None:
+        if elapsed_s < 0.0:
+            elapsed_s = 0.0
+        if self.count == 0 or elapsed_s < self.min_s:
+            self.min_s = elapsed_s
         self.count += 1
         self.total_s += elapsed_s
         self.max_s = max(self.max_s, elapsed_s)
+        idx = bisect_left(BUCKET_BOUNDS, elapsed_s)
+        self._buckets[idx] = self._buckets.get(idx, 0) + 1
+
+    def percentile(self, q: float) -> float:
+        """Estimate the q-quantile (q in [0,1]) from the buckets: the
+        geometric midpoint of the bucket holding the q-th sample, clamped
+        to the observed [min, max] envelope."""
+        if self.count == 0:
+            return 0.0
+        rank = max(1, int(round(q * self.count)))
+        seen = 0
+        for idx in sorted(self._buckets):
+            seen += self._buckets[idx]
+            if seen >= rank:
+                if idx == 0:
+                    est = BUCKET_BOUNDS[0] / (_BUCKET_FACTOR ** 0.5)
+                elif idx >= len(BUCKET_BOUNDS):
+                    est = self.max_s
+                else:
+                    lo, hi = BUCKET_BOUNDS[idx - 1], BUCKET_BOUNDS[idx]
+                    est = (lo * hi) ** 0.5
+                return min(self.max_s, max(self.min_s, est))
+        return self.max_s
+
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """Non-empty ``(upper_bound_s, cumulative_count)`` pairs for the
+        Prometheus ``_bucket{le=...}`` series (any monotone subset of the
+        bounds is valid exposition; empty buckets are elided)."""
+        out: List[Tuple[float, int]] = []
+        seen = 0
+        for idx in sorted(self._buckets):
+            seen += self._buckets[idx]
+            if idx < len(BUCKET_BOUNDS):
+                out.append((BUCKET_BOUNDS[idx], seen))
+        return out
 
     def to_dict(self) -> dict:
         mean = self.total_s / self.count if self.count else 0.0
         return {"count": self.count, "mean_s": round(mean, 6),
-                "max_s": round(self.max_s, 6)}
+                "max_s": round(self.max_s, 6),
+                "p50_s": round(self.percentile(0.50), 6),
+                "p95_s": round(self.percentile(0.95), 6),
+                "p99_s": round(self.percentile(0.99), 6)}
 
 
 class MetricsRegistry:
@@ -73,6 +159,21 @@ class MetricsRegistry:
         with self._lock:
             self._gauges.pop(name, None)
 
+    def observe(self, name: str, elapsed_s: float) -> None:
+        """Record one latency sample into the named histogram (the
+        retrospective twin of :meth:`time` — the serving tier measures
+        TTFT/TPOT from stored stamps, then lands them here)."""
+        with self._lock:
+            self._timers.setdefault(name, Timer()).record(elapsed_s)
+        if self._statsd is not None:
+            self._statsd.timing(name, elapsed_s)
+
+    def timer(self, name: str) -> Optional[dict]:
+        """Snapshot one timer (percentiles included), or None."""
+        with self._lock:
+            t = self._timers.get(name)
+            return t.to_dict() if t is not None else None
+
     def time(self, name: str):
         """Context manager recording a timer sample."""
         registry = self
@@ -83,12 +184,7 @@ class MetricsRegistry:
                 return self
 
             def __exit__(self, *exc):
-                elapsed = time.perf_counter() - self._t0
-                with registry._lock:
-                    timer = registry._timers.setdefault(name, Timer())
-                    timer.record(elapsed)
-                if registry._statsd is not None:
-                    registry._statsd.timing(name, elapsed)
+                registry.observe(name, time.perf_counter() - self._t0)
 
         return _Ctx()
 
@@ -157,24 +253,49 @@ class MetricsRegistry:
         return {"counters": counters, "gauges": gauges, "timers": timers}
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition (reference ``/v1/metrics/prometheus``)."""
+        """Prometheus text exposition (reference ``/v1/metrics/prometheus``).
+
+        Timers are exported as real histograms (``_bucket{le=...}`` +
+        ``_sum`` + ``_count``) with mean/max convenience gauges; every
+        series carries a ``# TYPE`` line and sanitized-name collisions are
+        de-duplicated with a content-hash suffix."""
         data = self.to_dict()
+        with self._lock:
+            buckets = {n: (t.cumulative_buckets(), t.count, t.total_s)
+                       for n, t in self._timers.items()}
         lines = []
+        seen: Dict[str, str] = {}
         for name, value in sorted(data["counters"].items()):
-            m = _sanitize(name)
+            m = _unique_name(name, seen)
             lines.append(f"# TYPE {m} counter")
             lines.append(f"{m} {value}")
         for name, value in sorted(data["gauges"].items()):
-            if value is None:
+            if not isinstance(value, (int, float)) \
+                    or isinstance(value, bool):
                 continue
-            m = _sanitize(name)
+            m = _unique_name(name, seen)
             lines.append(f"# TYPE {m} gauge")
             lines.append(f"{m} {value}")
         for name, timer in sorted(data["timers"].items()):
-            m = _sanitize(name)
+            # timers are conventionally named *.<op>_seconds; the unit
+            # suffix is re-appended per series, so strip it here rather
+            # than exporting router_ttft_seconds_seconds
+            base = name[:-8] if name.endswith("_seconds") else name
+            m = _unique_name(base, seen)
+            steps, count, total_s = buckets.get(name, ([], timer["count"],
+                                                       0.0))
+            lines.append(f"# TYPE {m}_seconds histogram")
+            for bound, cum in steps:
+                lines.append(
+                    f'{m}_seconds_bucket{{le="{bound:.9g}"}} {cum}')
+            lines.append(f'{m}_seconds_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{m}_seconds_sum {round(total_s, 6)}")
+            lines.append(f"{m}_seconds_count {count}")
             lines.append(f"# TYPE {m}_count counter")
             lines.append(f"{m}_count {timer['count']}")
+            lines.append(f"# TYPE {m}_mean_seconds gauge")
             lines.append(f"{m}_mean_seconds {timer['mean_s']}")
+            lines.append(f"# TYPE {m}_max_seconds gauge")
             lines.append(f"{m}_max_seconds {timer['max_s']}")
         return "\n".join(lines) + "\n"
 
@@ -183,6 +304,36 @@ class MetricsRegistry:
     def configure_statsd(self, host: str, port: int, prefix: str = "tpu_sdk"
                          ) -> None:
         self._statsd = _StatsdPusher(host, port, prefix)
+
+    def push_gauges(self) -> int:
+        """Sample every gauge supplier and push the values to StatsD
+        (counters/timings push inline at record time; gauges have no
+        record event, so a periodic driver calls this). Returns the
+        number of samples pushed."""
+        statsd = self._statsd
+        if statsd is None:
+            return 0
+        with self._lock:
+            suppliers = dict(self._gauges)
+        pushed = 0
+        for name, fn in suppliers.items():
+            try:
+                value = fn()
+            except Exception:
+                continue
+            if isinstance(value, (int, float)) \
+                    and not isinstance(value, bool):
+                statsd.gauge(name, float(value))
+                pushed += 1
+        return pushed
+
+    def close(self) -> None:
+        """Registry teardown: release the StatsD socket (a long-lived
+        scheduler that reconfigures would otherwise leak one fd per
+        registry)."""
+        statsd, self._statsd = self._statsd, None
+        if statsd is not None:
+            statsd.close()
 
 
 class _StatsdPusher:
@@ -204,6 +355,15 @@ class _StatsdPusher:
 
     def timing(self, name: str, elapsed_s: float) -> None:
         self._send(f"{self._prefix}.{name}:{elapsed_s * 1000:.3f}|ms")
+
+    def gauge(self, name: str, value: float) -> None:
+        self._send(f"{self._prefix}.{name}:{value}|g")
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
 
 class PlanReporter:

@@ -63,6 +63,8 @@ import numpy as np
 # the one shared prefix-hash: the wire format, the fleet router, and the
 # prefix radix must all key full prompt pages identically or affinity
 # routing sends requests where their pages are NOT (see paging.page_hashes)
+from ..metrics import MetricsRegistry
+from ..tracing import TRACE_HEADER, Tracer, parse_header
 from .paging import page_hashes
 
 _MAGIC = b"KVSPAN1\0"
@@ -209,15 +211,21 @@ class KVShipper:
     pack = staticmethod(pack_span)
     unpack = staticmethod(unpack_span)
 
-    def fetch(self, peer: str, prompt: List[int]) -> Dict[str, Any]:
+    def fetch(self, peer: str, prompt: List[int],
+              trace=None) -> Dict[str, Any]:
         """Ship ``prompt`` to the prefill tier at ``peer`` and return
         the verified span its pages came back as. Raises
         :class:`PageShipError` on transport failure, a peer 503
-        (pool back-pressure), or a frame that fails verification."""
+        (pool back-pressure), or a frame that fails verification.
+        ``trace`` (a ``tracing.TraceContext``) propagates over the hop
+        as the ``X-Tpu-Trace`` header."""
+        headers = {"Content-Type": "application/json"}
+        if trace is not None:
+            headers[TRACE_HEADER] = trace.header()
         req = urllib.request.Request(
             peer.rstrip("/") + "/v1/prefill",
             data=json.dumps({"prompt": [int(t) for t in prompt]}).encode(),
-            headers={"Content-Type": "application/json"})
+            headers=headers)
         try:
             with _transport_urlopen(req, timeout=self.timeout_s) as r:
                 data = r.read()
@@ -246,7 +254,9 @@ class PrefillWorker:
     spans release every working page right after packing."""
 
     def __init__(self, engine, port: int = 0, host: str = "0.0.0.0",
-                 window_s: float = 60.0):
+                 window_s: float = 60.0,
+                 metrics: Optional[MetricsRegistry] = None,
+                 trace_store=None):
         self.engine = engine
         self._lock = threading.Lock()
         # rolling-window load signal, same shape + keys as
@@ -256,6 +266,15 @@ class PrefillWorker:
         self.window_s = window_s
         self._window: deque = deque(maxlen=4096)   # t of each span served
         self._sheds: deque = deque(maxlen=4096)    # t of each 503
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._own_metrics = metrics is None
+        self.tracer = Tracer("prefill", trace_store)
+        if getattr(engine, "tracer", None) is None:
+            engine.tracer = Tracer("prefill-engine", trace_store)
+        for key in ("completed", "shed", "shed_rate", "pages_free",
+                    "pages_total"):
+            self.metrics.gauge(f"prefill.{key}",
+                               lambda k=key: self.load_gauges().get(k))
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -277,6 +296,24 @@ class PrefillWorker:
                                      "pages_free": st["pages_free"],
                                      "shipped_spans": st["shipped_spans"],
                                      "load": worker.load_gauges()})
+                elif self.path == "/v1/metrics":
+                    self._json(200, worker.metrics.to_dict())
+                elif self.path == "/v1/metrics/prometheus":
+                    body = worker.metrics.to_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/v1/traces":
+                    store = worker.tracer.store
+                    self._json(200, {
+                        "trace_ids": store.trace_ids(),
+                        "incomplete": store.incomplete_trace_ids()})
+                elif self.path.startswith("/v1/trace/"):
+                    trace_id = self.path[len("/v1/trace/"):].split("?")[0]
+                    self._json(200, worker.tracer.store.export(trace_id))
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
 
@@ -291,21 +328,39 @@ class PrefillWorker:
                 except Exception as e:
                     self._json(400, {"error": f"bad request: {e}"})
                     return
+                ctx = parse_header(self.headers.get(TRACE_HEADER))
+                t0 = time.perf_counter()
                 try:
                     with worker._lock:
-                        span = worker.engine.prefill_span(prompt)
+                        span = worker.engine.prefill_span(prompt,
+                                                          trace=ctx)
                 except ValueError as e:
                     self._json(400, {"error": str(e)})
                     return
                 except Exception as e:
+                    worker.metrics.counter("prefill.errors")
                     self._json(500, {"error": f"prefill failed: {e}"})
                     return
                 if span is None:
                     worker._sheds.append(time.monotonic())
+                    worker.metrics.counter("prefill.sheds")
+                    if ctx is not None:
+                        worker.tracer.record("prefill.request", t0,
+                                             time.perf_counter(),
+                                             parent=ctx, status="shed")
                     self._json(503, {"error": "page pool exhausted"})
                     return
                 worker._window.append(time.monotonic())
                 frame = pack_span(span)
+                worker.metrics.counter("prefill.spans_served")
+                worker.metrics.counter("prefill.bytes_served", len(frame))
+                worker.metrics.observe("prefill.span_seconds",
+                                       time.perf_counter() - t0)
+                if ctx is not None:
+                    worker.tracer.record(
+                        "prefill.request", t0, time.perf_counter(),
+                        parent=ctx, prompt_len=len(prompt),
+                        frame_bytes=len(frame))
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "application/octet-stream")
@@ -366,6 +421,8 @@ class PrefillWorker:
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=10)
+        if self._own_metrics:
+            self.metrics.close()
 
 
 class DisaggCoordinator:
@@ -435,6 +492,7 @@ class DisaggCoordinator:
         self._outstanding = 0              # transfers in flight
         self._count_lock = threading.Lock()
         self._stop = threading.Event()
+        self.tracer = Tracer("disagg")
         self.transfer_stalls = 0
         self.peer_fallbacks = 0
         self.iterations = 0
@@ -494,15 +552,27 @@ class DisaggCoordinator:
                 continue
             last_err = "no healthy prefill peer"
             sent = False
+            ctx = getattr(pending, "trace", None)
             # peer-by-peer: only after every healthy peer refused does
             # the request degrade to the co-located path
             for peer in self._peer_order():
+                t0 = time.perf_counter()
                 try:
-                    span = self.shipper.fetch(peer, pending.prompt)
+                    span = self.shipper.fetch(peer, pending.prompt,
+                                              trace=ctx)
                 except Exception as e:
                     last_err = str(e)
+                    if ctx is not None:
+                        self.tracer.record("disagg.ship", t0,
+                                           time.perf_counter(),
+                                           parent=ctx, status="error",
+                                           peer=peer)
                     self._mark_down(peer)
                     continue
+                if ctx is not None:
+                    self.tracer.record("disagg.ship", t0,
+                                       time.perf_counter(), parent=ctx,
+                                       peer=peer)
                 self._arrivals.put((span, pending))
                 sent = True
                 break

@@ -38,7 +38,9 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from dcos_commons_tpu.metrics import MetricsRegistry
 from dcos_commons_tpu.models.serving import SlotServer
+from dcos_commons_tpu.tracing import TRACE_HEADER, Tracer, parse_header
 
 
 class _Pending:
@@ -47,9 +49,9 @@ class _Pending:
 
     __slots__ = ("prompt", "max_new", "stream", "tokens", "emitted",
                  "t_enqueue", "t_submit", "t_first", "t_done", "error",
-                 "done", "events")
+                 "done", "events", "trace", "on_finish")
 
-    def __init__(self, prompt: List[int], max_new: int):
+    def __init__(self, prompt: List[int], max_new: int, trace=None):
         self.prompt = prompt
         self.max_new = max_new
         self.tokens: List[int] = []
@@ -62,6 +64,10 @@ class _Pending:
         self.done = threading.Event()
         # token stream for chunked responses: ints, then None sentinel
         self.events: "queue.Queue" = queue.Queue()
+        # incoming X-Tpu-Trace context (None for untraced callers) and
+        # the frontend's one-shot finalizer (spans + histograms)
+        self.trace = trace
+        self.on_finish = None
 
     def push(self, tokens: List[int]) -> None:
         now = time.perf_counter()
@@ -74,6 +80,14 @@ class _Pending:
     def finish(self, error: Optional[str] = None) -> None:
         self.error = error
         self.t_done = time.perf_counter()
+        # one-shot: every finish path (normal retire, engine error,
+        # shutdown) lands exactly one terminal span + histogram sample
+        hook, self.on_finish = self.on_finish, None
+        if hook is not None:
+            try:
+                hook(self)
+            except Exception:
+                pass
         self.events.put(None)
         self.done.set()
 
@@ -102,9 +116,20 @@ class ServingFrontend:
                  request_timeout_s: float = 600.0,
                  idle_sleep_s: float = 0.001,
                  decode_window: int = 8,
-                 window_s: float = 60.0):
+                 window_s: float = 60.0,
+                 metrics: Optional[MetricsRegistry] = None,
+                 trace_store=None):
         self.engine = engine
         self.max_queue = max_queue
+        # shared registry when the deployment passes one (the worker's
+        # scheduler registry), else a private one — either way the
+        # /v1/metrics endpoints below serve it
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.tracer = Tracer("serve", trace_store)
+        # the engine records per-chunk prefill/adopt spans when a tracer
+        # is present (models/serving.py checks the attribute)
+        if getattr(engine, "tracer", None) is None:
+            engine.tracer = Tracer("engine", trace_store)
         self.request_timeout_s = request_timeout_s
         self._idle_sleep_s = idle_sleep_s
         # tokens decoded per device dispatch (SlotServer.step_many):
@@ -131,6 +156,16 @@ class ServingFrontend:
         self._window: deque = deque(maxlen=1024)      # (t, ttft_ms, tpot_ms)
         self._sheds: deque = deque(maxlen=4096)       # t of each rejection
         self._engine_thread: Optional[threading.Thread] = None
+        self._own_metrics = metrics is None
+        # fold the rolling load gauges into the registry so one scrape
+        # carries queue fill, shed rate, and window TTFT p95 alongside
+        # the request histograms (suppliers run OUTSIDE the registry
+        # lock — to_dict()'s contract — so reading self._lock is safe)
+        for key in ("queue_depth", "queue_capacity", "completed", "shed",
+                    "shed_rate", "ttft_p95_ms", "pages_free",
+                    "pages_total"):
+            self.metrics.gauge(f"ingress.{key}",
+                               lambda k=key: self.load_gauges().get(k))
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -156,6 +191,24 @@ class ServingFrontend:
                     self._json(200, frontend.health())
                 elif self.path == "/v1/stats":
                     self._json(200, frontend.stats())
+                elif self.path == "/v1/metrics":
+                    self._json(200, frontend.metrics.to_dict())
+                elif self.path == "/v1/metrics/prometheus":
+                    body = frontend.metrics.to_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/v1/traces":
+                    store = frontend.tracer.store
+                    self._json(200, {
+                        "trace_ids": store.trace_ids(),
+                        "incomplete": store.incomplete_trace_ids()})
+                elif self.path.startswith("/v1/trace/"):
+                    trace_id = self.path[len("/v1/trace/"):].split("?")[0]
+                    self._json(200, frontend.tracer.store.export(trace_id))
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
 
@@ -183,8 +236,14 @@ class ServingFrontend:
                 except (ValueError, json.JSONDecodeError) as e:
                     self._json(400, {"error": str(e)})
                     return
-                pending = _Pending(prompt, max_new)
+                ctx = parse_header(self.headers.get(TRACE_HEADER))
+                pending = _Pending(prompt, max_new, trace=ctx)
+                pending.on_finish = frontend._finalize
                 if not frontend._enqueue(pending):
+                    now = time.perf_counter()
+                    frontend.tracer.record(
+                        "serve.admission", now, now, parent=ctx,
+                        terminal=True, status="shed")
                     self._json(503, {"error": "queue full"},
                                {"Retry-After": "1"})
                     return
@@ -249,9 +308,47 @@ class ServingFrontend:
             with self._lock:
                 self._totals["rejected"] += 1
                 self._sheds.append(time.monotonic())
+            self.metrics.counter("ingress.sheds")
             return False
         self._wake.set()
         return True
+
+    def _finalize(self, pending: _Pending) -> None:
+        """One-shot completion hook (``_Pending.finish``): land the
+        request's latencies in the shared histograms and emit its spans
+        retrospectively from the stored perf-counter stamps — queue wait,
+        prefill-to-first-token, decode — chained under one terminal
+        ``serve.request`` root so the trace reads end-to-end."""
+        t_done = pending.t_done if pending.t_done is not None \
+            else time.perf_counter()
+        t_sub, t_first = pending.t_submit, pending.t_first
+        m = self.metrics
+        m.counter("ingress.requests_total")
+        m.counter("ingress.tokens_total", len(pending.tokens))
+        if pending.error:
+            m.counter("ingress.request_errors")
+        if t_sub is not None:
+            m.observe("ingress.queue_seconds", t_sub - pending.t_enqueue)
+        if t_first is not None:
+            m.observe("ingress.ttft_seconds", t_first - pending.t_enqueue)
+            if len(pending.tokens) > 1:
+                m.observe("ingress.tpot_seconds",
+                          (t_done - t_first) / (len(pending.tokens) - 1))
+        status = "error" if pending.error else "ok"
+        attrs = {"tokens": len(pending.tokens)}
+        if pending.error:
+            attrs["error"] = pending.error
+        root = self.tracer.record(
+            "serve.request", pending.t_enqueue, t_done,
+            parent=pending.trace, terminal=True, status=status, **attrs)
+        if t_sub is not None:
+            self.tracer.record("serve.queue_wait", pending.t_enqueue,
+                               t_sub, parent=root)
+            if t_first is not None:
+                self.tracer.record("serve.first_token", t_sub, t_first,
+                                   parent=root)
+                self.tracer.record("serve.decode", t_first, t_done,
+                                   parent=root, tokens=len(pending.tokens))
 
     # ------------------------------------------------------- engine loop
 
@@ -435,6 +532,8 @@ class ServingFrontend:
         for pending in list(self._live.values()):
             pending.finish("server stopped")
         self._live.clear()
+        if self._own_metrics:
+            self.metrics.close()
 
     # ------------------------------------------------------------- status
 

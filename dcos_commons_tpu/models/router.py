@@ -61,7 +61,10 @@ from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from ..metrics import MetricsRegistry
 from ..scheduler.elastic import backpressure
+from ..tracing import (TRACE_HEADER, Span, TraceContext, Tracer, new_id,
+                       parse_header, perf_to_epoch)
 from .disagg import _transport_urlopen
 from .paging import page_hashes
 
@@ -476,7 +479,9 @@ class Router:
                  health_recheck_s: float = 5.0,
                  probe_interval_s: float = 2.0,
                  request_timeout_s: float = 600.0,
-                 seed: int = 0):
+                 seed: int = 0,
+                 metrics: Optional[MetricsRegistry] = None,
+                 trace_store=None):
         if policy not in ("affinity", "random"):
             raise ValueError(f"unknown routing policy {policy!r}")
         if page_size < 1:
@@ -508,6 +513,21 @@ class Router:
         self._ttfts: deque = deque(maxlen=4096)  # (t, tenant, ttft_ms)
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._own_metrics = metrics is None
+        self.tracer = Tracer("router", trace_store)
+        # routestats folded into the shared registry: counters mirror
+        # _counts via _count(); these gauges sample live fleet state
+        self.metrics.gauge("router.replicas",
+                           lambda: len(self.replicas.endpoints()))
+        self.metrics.gauge("router.replicas_down",
+                           lambda: len(self.replicas.down()))
+
+        def _relays() -> int:
+            with self._lock:
+                return sum(n for n in self._active.values() if n > 0)
+
+        self.metrics.gauge("router.active_relays", _relays)
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -532,6 +552,24 @@ class Router:
                     self._json(200, router.health())
                 elif self.path in ("/v1/routestats", "/v1/stats"):
                     self._json(200, router.stats())
+                elif self.path == "/v1/metrics":
+                    self._json(200, router.metrics.to_dict())
+                elif self.path == "/v1/metrics/prometheus":
+                    body = router.metrics.to_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/v1/traces":
+                    store = router.tracer.store
+                    self._json(200, {
+                        "trace_ids": store.trace_ids(),
+                        "incomplete": store.incomplete_trace_ids()})
+                elif self.path.startswith("/v1/trace/"):
+                    tid = self.path[len("/v1/trace/"):]
+                    self._json(200, router.trace_export(tid))
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
 
@@ -571,8 +609,9 @@ class Router:
                 qos = (req.get("qos")
                        or self.headers.get("X-QoS-Class") or None)
                 stream = bool(req.get("stream", False))
+                ctx = parse_header(self.headers.get(TRACE_HEADER))
                 router._serve(self, prompt, max_new, stream,
-                              str(tenant), qos)
+                              str(tenant), qos, ctx)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
@@ -584,6 +623,9 @@ class Router:
     def _count(self, key: str, n: int = 1) -> None:
         with self._lock:
             self._counts[key] = self._counts.get(key, 0) + n
+        # mirrored into the registry so /v1/metrics/prometheus exposes
+        # routestats without a second bookkeeping path
+        self.metrics.counter(f"router.{key}", n)
 
     def route_plan(self, prompt: Sequence[int],
                    cls: QoSClass) -> Tuple[List[str], str]:
@@ -627,16 +669,21 @@ class Router:
 
     # ------------------------------------------------------------- relay
 
-    def _upstream(self, target: str, prompt: List[int], max_new: int):
+    def _upstream(self, target: str, prompt: List[int], max_new: int,
+                  trace: Optional[TraceContext] = None):
         """Generator over one replica's chunked token stream: yields
         the parsed JSON objects, raising :class:`ReplicaError` (or
         :class:`ReplicaBusy` on 503 back-pressure) instead of ever
-        yielding a broken tail."""
+        yielding a broken tail. When the relay carries a trace, its
+        context crosses the hop in ``X-Tpu-Trace`` so the replica's
+        spans parent onto the router's."""
         body = json.dumps({"prompt": prompt, "max_new": max_new,
                            "stream": True}).encode()
+        headers = {"Content-Type": "application/json"}
+        if trace is not None:
+            headers[TRACE_HEADER] = trace.header()
         req = urllib.request.Request(
-            target + "/v1/generate", data=body,
-            headers={"Content-Type": "application/json"})
+            target + "/v1/generate", data=body, headers=headers)
         try:
             resp = _transport_urlopen(req, timeout=self.request_timeout_s)
         except urllib.error.HTTPError as e:
@@ -661,19 +708,47 @@ class Router:
                 if obj.get("done"):
                     return
 
+    def _finish_trace(self, root: TraceContext,
+                      parent: Optional[TraceContext], t0: float,
+                      status: str, **attrs) -> None:
+        """Record the terminal ``router.request`` root span — every
+        admitted request's trace ends through here exactly once, the
+        completeness guarantee the chaos tier audits."""
+        t1 = time.perf_counter()
+        self.tracer.store.add(Span(
+            root.trace_id, root.span_id,
+            parent.span_id if parent else None,
+            "router.request", self.tracer.service,
+            perf_to_epoch(t0), max(0.0, t1 - t0), attrs,
+            terminal=True, status=status))
+
     def _serve(self, handler, prompt: List[int], max_new: int,
-               stream: bool, tenant: str, qos: Optional[str]) -> None:
+               stream: bool, tenant: str, qos: Optional[str],
+               ctx: Optional[TraceContext] = None) -> None:
         t0 = time.perf_counter()
         ok, cls = self.admission.admit(tenant, qos)
         if not ok:
             self._count("sheds")
+            # a shed is a complete (one-span) trace: admitted requests
+            # are the ones whose traces must reach router.request
+            self.tracer.record("router.admission", t0,
+                               time.perf_counter(), parent=ctx,
+                               terminal=True, status="shed",
+                               tenant=tenant, qos=cls.name)
             handler._json(429, {"error": f"tenant {tenant!r} over its "
                                          f"{cls.name} admission budget"},
                           {"Retry-After": "1"})
             return
+        # the root context downstream hops parent onto; the root span
+        # itself is recorded at the end via _finish_trace
+        root = TraceContext(ctx.trace_id if ctx else new_id(), new_id())
+        self.tracer.record("router.admission", t0, time.perf_counter(),
+                           parent=root, tenant=tenant, qos=cls.name)
         plan, routed = self.route_plan(prompt, cls)
         if not plan:
             self._count("errors")
+            self._finish_trace(root, ctx, t0, "error", tenant=tenant,
+                               error="no healthy decode replica")
             handler._json(503, {"error": "no healthy decode replica"},
                           {"Retry-After": "1"})
             return
@@ -710,8 +785,11 @@ class Router:
             with self._lock:
                 self._active[target] = self._active.get(target, 0) + 1
             seen = 0
+            t_attempt = time.perf_counter()
+            relay_status = "ok"
             try:
-                for obj in self._upstream(target, prompt, max_new):
+                for obj in self._upstream(target, prompt, max_new,
+                                          trace=root):
                     if "token" in obj:
                         seen += 1
                         tok = int(obj["token"])
@@ -743,8 +821,10 @@ class Router:
                 break
             except ReplicaBusy as e:
                 last_err = str(e)              # back-pressure: next
+                relay_status = "busy"
             except ReplicaError as e:
                 last_err = str(e)
+                relay_status = "error"
                 self.replicas.mark_down(target)
             finally:
                 with self._lock:
@@ -753,12 +833,18 @@ class Router:
                     if final is not None:
                         self._per_replica[target] = (
                             self._per_replica.get(target, 0) + 1)
+                self.tracer.record("router.relay", t_attempt,
+                                   time.perf_counter(), parent=root,
+                                   status=relay_status, target=target,
+                                   attempt=attempt, tokens=seen)
             if final is not None:
                 break
         if final is None:
             # every candidate was attempted before giving up — the
             # spill-before-drop invariant the chaos tier audits
             self._count("dropped_streams")
+            self._finish_trace(root, ctx, t0, "error", tenant=tenant,
+                               routed=routed, error=last_err)
             err = {"error": f"all replicas failed: {last_err}"}
             if chunk is not None:
                 chunk({"done": True, **err})
@@ -770,6 +856,13 @@ class Router:
                    if t_first is not None else None)
         with self._lock:
             self._ttfts.append((time.monotonic(), tenant, ttft_ms))
+        if t_first is not None:
+            self.metrics.observe("router.ttft_seconds", t_first - t0)
+        self.metrics.observe("router.request_seconds",
+                             time.perf_counter() - t0)
+        self._finish_trace(root, ctx, t0, "ok", tenant=tenant,
+                           routed=routed, replica=target,
+                           tokens=len(sent), ttft_ms=ttft_ms)
         trailer = {k: v for k, v in final.items() if k != "done"}
         trailer.update({"replica": target, "routed": routed,
                         "tenant": tenant, "qos": cls.name})
@@ -814,6 +907,33 @@ class Router:
             return {"replicas": self.ring.nodes(),
                     "added": sorted(added), "removed": sorted(removed),
                     "draining": draining}
+
+    # ------------------------------------------------------------- tracing
+
+    def trace_export(self, trace_id: str) -> dict:
+        """One trace, fleet-wide: the router's local spans merged with
+        whatever each healthy replica retained for the same id (served
+        over its own ``/v1/trace`` endpoint), de-duplicated by span_id
+        — colocated tiers sharing the process-global store would
+        otherwise report every span twice."""
+        spans = {s.span_id: s.to_dict()
+                 for s in self.tracer.store.spans(trace_id)}
+        for ep in self.replicas.healthy():
+            try:
+                req = urllib.request.Request(f"{ep}/v1/trace/{trace_id}")
+                with _transport_urlopen(req, timeout=5.0) as r:
+                    body = json.loads(r.read())
+            except Exception:
+                continue
+            for d in body.get("spans", ()):
+                sid = d.get("span_id")
+                if sid:
+                    spans.setdefault(sid, d)
+        ordered = sorted(spans.values(),
+                         key=lambda d: (d.get("t_start", 0.0),
+                                        d.get("span_id", "")))
+        return {"trace_id": trace_id, "spans": ordered,
+                "complete": any(d.get("terminal") for d in ordered)}
 
     # ------------------------------------------------------------- status
 
@@ -906,3 +1026,5 @@ class Router:
             self._http_thread.join(timeout=10)
         if self._probe_thread:
             self._probe_thread.join(timeout=5)
+        if self._own_metrics:
+            self.metrics.close()

@@ -32,6 +32,7 @@ workload-layer capability for BASELINE.json config #5, layered on
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -140,6 +141,10 @@ class SlotServer:
     default; pass ``sampler`` (``ops.sampling.make_sampler``) + ``key``
     for stochastic decoding.
     """
+
+    # set by the ingress/prefill tiers (dcos_commons_tpu.tracing.Tracer);
+    # engine-level spans record only for requests that carry a trace ctx
+    tracer = None
 
     def __init__(self, cfg: llama.LlamaConfig, params, slots: int = 8,
                  sampler=None, key: Optional[jax.Array] = None,
@@ -588,6 +593,10 @@ class PagedServer:
     in the same order over the same values.
     """
 
+    # set by the ingress/prefill tiers (dcos_commons_tpu.tracing.Tracer);
+    # engine-level spans record only for requests that carry a trace ctx
+    tracer = None
+
     def __init__(self, cfg: llama.LlamaConfig, params, slots: int = 8,
                  pages: Optional[int] = None, page_size: int = 64,
                  prefill_chunk: int = 64, sampler=None,
@@ -818,7 +827,8 @@ class PagedServer:
 
     # ----------------------------------------------------- disaggregation
 
-    def prefill_span(self, prompt: List[int]) -> Optional[Dict[str, Any]]:
+    def prefill_span(self, prompt: List[int],
+                     trace=None) -> Optional[Dict[str, Any]]:
         """Prefill-only engine mode: run ``prompt`` through chunked
         prefill FLAT-OUT — every chunk back to back, no decode
         interleave, no slot occupied — and return the finished span:
@@ -833,7 +843,10 @@ class PagedServer:
         tier keeps its own prefix cache). Returns None when the pool is
         exhausted (transient — spans release right after extraction, so
         the caller retries / sheds), raises ValueError for prompts this
-        engine can never prefill."""
+        engine can never prefill. ``trace`` is an optional incoming
+        trace context (``X-Tpu-Trace``): the flat-out prefill records
+        one span under it."""
+        t_pre0 = time.perf_counter()
         prompt = list(prompt)
         n = len(prompt)
         if not prompt:
@@ -893,6 +906,12 @@ class PagedServer:
         for p in stream_pages:
             self.ledger.unref(p)
         self.shipped_spans += 1
+        tracer = self.tracer
+        if tracer is not None and trace is not None:
+            tracer.record("engine.prefill_span", t_pre0,
+                          time.perf_counter(), parent=trace,
+                          prompt_len=n, pages=span_pages,
+                          shared_pages=len(shared))
         return {"version": 1, "prompt": prompt, "first_token": first,
                 "page_size": ps, "kv_quant": bool(self.cfg.kv_quant),
                 "payload": payload}
@@ -930,6 +949,7 @@ class PagedServer:
         checked BEFORE any reservation; a failure AFTER pages are
         reserved unwinds every reservation before re-raising, so
         ``check()``/``reconcile()`` hold across aborted adoptions."""
+        t_adopt0 = time.perf_counter()
         prompt = list(span["prompt"])
         n = len(prompt)
         first = int(span["first_token"])
@@ -1010,6 +1030,13 @@ class PagedServer:
         self.requests[slot] = _Request(rid, n, max_new, [first])
         self.adopted_spans += 1
         self.adopt_shared_pages += matched
+        tracer = self.tracer
+        if tracer is not None:
+            ctx = getattr(rid, "trace", None)
+            if ctx is not None:
+                tracer.record("engine.adopt_span", t_adopt0,
+                              time.perf_counter(), parent=ctx,
+                              pages=span_pages, shared_pages=matched)
         self._maybe_retire(slot)
         return slot
 
@@ -1046,10 +1073,18 @@ class PagedServer:
         chunk[0, :end - start] = prompt[start:end]
         last = end >= n
         li = (n - 1 - start) if last else 0
+        t0 = time.perf_counter()
         logits, self.pool = self._chunk_x(
             self.params, self.pool, jnp.asarray(self._tables[slot]),
             jnp.asarray(chunk), jnp.int32(start), jnp.int32(n),
             jnp.int32(li))
+        tracer = self.tracer
+        if tracer is not None:
+            ctx = getattr(self.requests[slot].request_id, "trace", None)
+            if ctx is not None:
+                tracer.record("engine.prefill_chunk", t0,
+                              time.perf_counter(), parent=ctx,
+                              start=start, end=end, prompt_len=n)
         self._prefill_pos[slot] = end
         if last:
             toks = self._select(logits)

@@ -480,6 +480,11 @@ class ServiceScheduler:
         batches is visible to the next batch — the same staleness window a
         status arriving between two *cycles* always had."""
         with self._cycle_lock:
+            # cycle-phase profiler: where a cycle's wall-clock goes —
+            # status ingest vs plan-step walk vs offer match — exposed
+            # as cycle.*_seconds histograms on /v1/metrics
+            t_cycle0 = time.perf_counter()
+            ingest_s = plan_s = match_s = 0.0
             with self._lock:
                 self._quota_usage_memo = None  # fresh usage view per cycle
                 if self.metrics is not None:
@@ -491,18 +496,33 @@ class ServiceScheduler:
                     self.reconcile()
                 agents = list(self.cluster.agents())
                 self._replace_tpu_degraded(agents)
+                t_phase = time.perf_counter()
                 self._drain_status_feed_locked()
+                ingest_s += time.perf_counter() - t_phase
+                t_phase = time.perf_counter()
                 candidates = list(self.coordinator.get_candidates())
+                plan_s += time.perf_counter() - t_phase
             actions = 0
             batch = max(1, self.cycle_batch_size)
             for i in range(0, len(candidates), batch):
                 with self._lock:
                     # statuses that landed while the lock was down move
                     # their step machines before the next match batch
+                    t_phase = time.perf_counter()
                     self._drain_status_feed_locked()
+                    ingest_s += time.perf_counter() - t_phase
+                    t_phase = time.perf_counter()
                     for step in candidates[i:i + batch]:
                         actions += self._execute_candidate(step, agents,
                                                            allow_expand)
+                    match_s += time.perf_counter() - t_phase
+            if self.metrics is not None:
+                self.metrics.observe("cycle.status_ingest_seconds",
+                                     ingest_s)
+                self.metrics.observe("cycle.plan_step_seconds", plan_s)
+                self.metrics.observe("cycle.offer_match_seconds", match_s)
+                self.metrics.observe("cycle.total_seconds",
+                                     time.perf_counter() - t_cycle0)
             with self._lock:
                 if (not self.uninstall_mode
                         and self.deploy_manager.plan.status is Status.COMPLETE

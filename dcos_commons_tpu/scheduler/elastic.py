@@ -280,6 +280,105 @@ class Autoscaler:
 
 
 # --------------------------------------------------------------------------
+# live wiring: one scheduler + HTTP frontend gauges (framework mains)
+# --------------------------------------------------------------------------
+
+class SoloService:
+    """Adapter presenting ONE :class:`ServiceScheduler` through the
+    minimal multi-scheduler surface :class:`Autoscaler` touches
+    (``get_service`` + ``service_store``): the single-service framework
+    mains have no ``MultiServiceScheduler``, and a solo scheduler's spec
+    is already its own durable record, so ``service_store.store`` is a
+    no-op rather than a second persistence path."""
+
+    class _NullStore:
+        def store(self, spec) -> None:
+            pass
+
+    def __init__(self, scheduler):
+        self._scheduler = scheduler
+        self.service_store = self._NullStore()
+
+    def get_service(self, name: str):
+        return self._scheduler
+
+
+def http_gauges(urls: Sequence[str],
+                timeout_s: float = 5.0) -> Callable[[], dict]:
+    """A ``gauges_fn`` polling each decode frontend's ``/v1/healthz``
+    ``"load"`` dict (``ServingFrontend.load_gauges()``) over HTTP and
+    merging the fleet into one dict :func:`backpressure` understands:
+    additive signals (queue depth/capacity, completions, sheds, KV
+    pages) sum; TTFT p95 takes the worst replica. Unreachable replicas
+    are skipped — pressure reads what the reachable fleet reports."""
+    import json as _json
+    import urllib.request
+
+    def _fetch(url: str) -> Optional[dict]:
+        try:
+            from ..security.transport import urlopen as _open
+        except ImportError:
+            _open = urllib.request.urlopen
+        try:
+            with _open(url.rstrip("/") + "/v1/healthz",
+                       timeout=timeout_s) as r:
+                body = _json.loads(r.read())
+        except Exception:
+            return None
+        load = body.get("load")
+        return load if isinstance(load, dict) else None
+
+    additive = ("queue_depth", "queue_capacity", "completed", "shed",
+                "pages_free", "pages_total")
+
+    def gauges() -> dict:
+        merged: dict = {}
+        polled = 0
+        for url in urls:
+            load = _fetch(url)
+            if load is None:
+                continue
+            polled += 1
+            for key in additive:
+                value = load.get(key)
+                if isinstance(value, (int, float)):
+                    merged[key] = merged.get(key, 0) + value
+            ttft = load.get("ttft_p95_ms")
+            if isinstance(ttft, (int, float)):
+                merged["ttft_p95_ms"] = max(
+                    merged.get("ttft_p95_ms", 0.0), ttft)
+        if polled:
+            done = merged.get("completed", 0) + merged.get("shed", 0)
+            merged["shed_rate"] = (merged.get("shed", 0) / done
+                                   if done else 0.0)
+            merged["replicas_polled"] = polled
+        return merged
+
+    return gauges
+
+
+def autoscaler_from_env(scheduler, metrics=None,
+                        env: Optional[dict] = None
+                        ) -> Optional[Autoscaler]:
+    """Wire a live :class:`Autoscaler` for one scheduler from the
+    ``AUTOSCALE_*`` env contract. Armed only when BOTH
+    ``AUTOSCALE_POD_TYPE`` (the tier to resize) and
+    ``AUTOSCALE_GAUGE_URLS`` (comma-separated decode frontend base URLs
+    to poll) are set; returns None otherwise so mains stay inert by
+    default."""
+    e = os.environ if env is None else env
+    pod_type = (e.get("AUTOSCALE_POD_TYPE") or "").strip()
+    urls = [u.strip() for u in (e.get("AUTOSCALE_GAUGE_URLS") or
+                                "").split(",") if u.strip()]
+    if not pod_type or not urls:
+        return None
+    solo = SoloService(scheduler)
+    return Autoscaler(lambda: solo, scheduler.spec.name,
+                      AutoscalerConfig.from_env(pod_type, e),
+                      http_gauges(urls), metrics=metrics)
+
+
+# --------------------------------------------------------------------------
 # preemptor
 # --------------------------------------------------------------------------
 

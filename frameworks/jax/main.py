@@ -17,6 +17,7 @@ import argparse
 import logging
 import os
 import sys
+import threading
 import time
 
 from dcos_commons_tpu.agent.remote import RemoteCluster
@@ -81,6 +82,31 @@ def main(argv=None) -> int:
     PlanReporter(metrics, scheduler)
     driver = CycleDriver(scheduler, interval_s=args.interval)
 
+    # live elastic loop: AUTOSCALE_POD_TYPE + AUTOSCALE_GAUGE_URLS arm a
+    # back-pressure autoscaler fed by the decode frontends' /v1/healthz
+    # "load" gauges (ServingFrontend.load_gauges() over HTTP)
+    from dcos_commons_tpu.scheduler.elastic import autoscaler_from_env
+    autoscaler = autoscaler_from_env(scheduler, metrics=metrics)
+    auto_stop = threading.Event()
+    if autoscaler is not None:
+        interval_s = float(os.environ.get("AUTOSCALE_INTERVAL_S", "5"))
+
+        def _auto_loop():
+            while not auto_stop.wait(interval_s):
+                try:
+                    autoscaler.tick()
+                except Exception:
+                    logging.getLogger("autoscale").exception(
+                        "autoscaler tick failed")
+
+        threading.Thread(target=_auto_loop, daemon=True,
+                         name="autoscaler").start()
+        print(f"autoscaler armed: pod type "
+              f"{autoscaler.config.pod_type}, "
+              f"count {autoscaler.config.min_count}.."
+              f"{autoscaler.config.max_count}, "
+              f"tick every {interval_s}s", flush=True)
+
     server.start()
     print(f"jax scheduler API on {server.url}/v1/",
           flush=True)
@@ -91,6 +117,7 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        auto_stop.set()
         server.stop()
     return 0
 

@@ -23,6 +23,9 @@ python -m tools.serving_smoke --budget-s "${SERVING_SMOKE_BUDGET_S:-120}"
 echo "== router smoke (fleet front door: affinity A/B + resize under load, time-capped) =="
 python -m tools.router_smoke --budget-s "${ROUTER_SMOKE_BUDGET_S:-150}"
 
+echo "== metrics smoke (prometheus conformance + end-to-end trace export, time-capped) =="
+python -m tools.metrics_smoke --budget-s "${METRICS_SMOKE_BUDGET_S:-90}"
+
 echo "== control-plane smoke (steady-state cycle budget under churn) =="
 # observed p50 ~6.4ms at fleet 500; the pin is ~12x that so only an
 # O(fleet) regression (not CI-host noise) trips it

@@ -397,3 +397,106 @@ class TestLoadGauges:
         fe = self.make()
         assert fe.health()["load"] == fe.load_gauges()
         assert fe.stats()["window"] == fe.load_gauges()
+
+
+# ------------------------------------- env wiring over live frontends
+#
+# Satellite coverage for the framework-main path: autoscaler_from_env
+# arms an Autoscaler whose gauges_fn polls REAL ServingFrontend
+# /v1/healthz endpoints over HTTP (http_gauges), adapted onto a solo
+# ServiceScheduler through SoloService.
+
+ELASTIC_YML = """
+name: elastisvc
+pods:
+  decode:
+    count: 1
+    tasks:
+      server: {goal: RUNNING, cmd: ./serve, cpus: 0.1, memory: 64}
+"""
+
+
+def make_solo_scheduler():
+    from dcos_commons_tpu.agent import AgentInfo, FakeCluster, PortRange
+    from dcos_commons_tpu.scheduler import ServiceScheduler
+    from dcos_commons_tpu.state import MemPersister
+    agents = [AgentInfo(agent_id="a0", hostname="h0", cpus=8,
+                        memory_mb=16384, disk_mb=10000,
+                        ports=(PortRange(10000, 10100),))]
+    return ServiceScheduler(load_service_yaml_str(ELASTIC_YML),
+                            MemPersister(), FakeCluster(agents))
+
+
+class TestAutoscalerEnvWiring:
+    def test_inert_without_env(self):
+        from dcos_commons_tpu.scheduler.elastic import autoscaler_from_env
+        sched = make_solo_scheduler()
+        assert autoscaler_from_env(sched, env={}) is None
+        assert autoscaler_from_env(
+            sched, env={"AUTOSCALE_POD_TYPE": "decode"}) is None
+        assert autoscaler_from_env(
+            sched, env={"AUTOSCALE_GAUGE_URLS": "http://x"}) is None
+
+    def test_solo_service_adapter(self):
+        from dcos_commons_tpu.scheduler.elastic import SoloService
+        sched = make_solo_scheduler()
+        solo = SoloService(sched)
+        assert solo.get_service("anything") is sched
+        solo.service_store.store(sched.spec)    # durable no-op
+
+    def test_http_gauges_merge_live_frontends(self):
+        from dcos_commons_tpu.scheduler.elastic import http_gauges
+        frontends = [ServingFrontend(_StubEngine(), port=0,
+                                     host="127.0.0.1", max_queue=8)
+                     .start(drive=False) for _ in range(2)]
+        try:
+            urls = [f"http://127.0.0.1:{fe.port}" for fe in frontends]
+            # a dead replica is skipped, not fatal
+            gauges = http_gauges(urls + ["http://127.0.0.1:9"],
+                                 timeout_s=2.0)()
+            assert gauges["replicas_polled"] == 2
+            assert gauges["queue_capacity"] == 16    # 8 + 8, summed
+            assert gauges["queue_depth"] == 0
+            assert gauges["shed_rate"] == 0.0
+            assert backpressure(gauges) == 0.0
+        finally:
+            for fe in frontends:
+                fe.stop()
+
+    def test_env_autoscaler_scales_on_live_pressure(self):
+        """End to end: shed pressure visible on a real frontend's
+        /v1/healthz drives the env-wired autoscaler to grow the decode
+        tier of a real (solo) scheduler through its deploy plan."""
+        import time as _time
+
+        from dcos_commons_tpu.scheduler.elastic import autoscaler_from_env
+        sched = make_solo_scheduler()
+        sched.run_until_quiet()
+        fe = ServingFrontend(_StubEngine(), port=0, host="127.0.0.1",
+                             max_queue=8).start(drive=False)
+        try:
+            auto = autoscaler_from_env(sched, env={
+                "AUTOSCALE_POD_TYPE": "decode",
+                "AUTOSCALE_GAUGE_URLS": f"http://127.0.0.1:{fe.port}",
+                "AUTOSCALE_DEBOUNCE": "2",
+                "AUTOSCALE_COOLDOWN": "1",
+            })
+            assert auto is not None and auto.target == 1
+            assert auto.tick() is None          # quiet fleet: hold
+            assert auto.last_pressure == 0.0
+            # a shed in the rolling window pins pressure to 1.0
+            with fe._lock:
+                fe._sheds.append(_time.monotonic())
+            assert auto.tick() is None          # debounce sample 1
+            assert auto.last_pressure == 1.0
+            assert auto.tick() == 2             # sample 2: resize accepted
+            assert auto.target == 2             # read back from the spec
+            assert auto.events == [(2, 1.0)]
+            # the resize is a config update: the deploy plan launches the
+            # new replica on the next cycles
+            sched.run_until_quiet()
+            live = [t for t in sched.cluster.live_tasks()
+                    if t.task_name.startswith("decode-")]
+            assert len(live) == 2
+        finally:
+            fe.stop()

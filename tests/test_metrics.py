@@ -1,7 +1,9 @@
 """Metrics registry tests (reference metrics/Metrics.java + PlanReporter +
 testing/sdk_metrics.py assertions)."""
 
+import random
 import socket
+import threading
 
 from dcos_commons_tpu.agent import AgentInfo, FakeCluster, PortRange
 from dcos_commons_tpu.metrics import MetricsRegistry, PlanReporter
@@ -72,6 +74,170 @@ class TestRegistry:
         datagram = recv.recv(1024).decode()
         assert datagram == "tpu_sdk.ops.launch:3|c"
         recv.close()
+
+
+class TestHistogramPercentiles:
+    """The bucketed Timer percentiles must track an exact computation
+    (utils.stats.percentiles) within the documented bucket resolution."""
+
+    def test_lognormal_within_10pct(self):
+        from dcos_commons_tpu.utils.stats import percentiles
+        rng = random.Random(13)
+        samples = [rng.lognormvariate(-3.0, 0.8) for _ in range(5000)]
+        m = MetricsRegistry()
+        for s in samples:
+            m.observe("ttft_seconds", s)
+        snap = m.to_dict()["timers"]["ttft_seconds"]
+        exact = percentiles(samples, ndigits=9)
+        for q in ("p50", "p95", "p99"):
+            est, ref = snap[f"{q}_s"], exact[q]
+            assert abs(est - ref) / ref < 0.10, \
+                f"{q}: histogram {est} vs exact {ref}"
+
+    def test_envelope_clamp(self):
+        # a single sample: every percentile is that sample, not a bucket
+        # midpoint outside the observed [min, max] envelope
+        m = MetricsRegistry()
+        m.observe("one", 0.2)
+        snap = m.to_dict()["timers"]["one"]
+        assert snap["p50_s"] == snap["p99_s"] == 0.2
+
+    def test_out_of_range_samples(self):
+        from dcos_commons_tpu.metrics import Timer
+        t = Timer()
+        t.record(1e-7)    # below the smallest bound
+        t.record(5e3)     # beyond the largest bound
+        t.record(-1.0)    # clamped to zero
+        assert t.count == 3
+        assert t.percentile(0.99) <= t.max_s
+        assert t.percentile(0.01) >= t.min_s == 0.0
+
+
+class TestPrometheusConformance:
+    """Exposition discipline, validated with the same parser the CI smoke
+    uses against live endpoints (tools/metrics_smoke.py)."""
+
+    def _families(self, m):
+        from tools.metrics_smoke import check_histograms, parse_exposition
+        families = parse_exposition(m.to_prometheus())
+        check_histograms(families)
+        return families
+
+    def test_timer_exports_histogram_and_gauges(self):
+        m = MetricsRegistry()
+        for v in (0.001, 0.01, 0.1):
+            m.observe("router.ttft_seconds", v)
+        text = m.to_prometheus()
+        # the *_seconds timer name must not double the unit suffix
+        assert "router_ttft_seconds_seconds" not in text
+        assert "# TYPE router_ttft_seconds histogram" in text
+        assert "# TYPE router_ttft_count counter" in text
+        assert "# TYPE router_ttft_mean_seconds gauge" in text
+        assert "# TYPE router_ttft_max_seconds gauge" in text
+        fam = self._families(m)["router_ttft_seconds"]
+        count = [v for n, _, v in fam["samples"]
+                 if n == "router_ttft_seconds_count"]
+        assert count == [3.0]
+
+    def test_cumulative_buckets_nondecreasing(self):
+        rng = random.Random(7)
+        m = MetricsRegistry()
+        for _ in range(500):
+            m.observe("lat", rng.expovariate(20.0))
+        fam = self._families(m)["lat_seconds"]
+        buckets = [v for n, lbl, v in fam["samples"]
+                   if n == "lat_seconds_bucket"]
+        assert buckets == sorted(buckets)
+        assert buckets[-1] == 500.0
+
+    def test_name_collision_dedup(self):
+        # "a.b" and "a/b" both sanitize to a_b; exposition must not emit
+        # duplicate series — the later name gets a hash suffix
+        m = MetricsRegistry()
+        m.counter("a.b", 1)
+        m.counter("a/b", 2)
+        families = self._families(m)
+        names = [n for fam in families.values()
+                 for n, _, _ in fam["samples"]]
+        assert len(names) == len(set(names)) == 2
+        assert "a_b" in names
+        suffixed = [n for n in names if n != "a_b"]
+        assert suffixed and suffixed[0].startswith("a_b_")
+
+
+class TestStatsdLifecycle:
+    def _recv_socket(self):
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(5)
+        return recv, recv.getsockname()[1]
+
+    def test_push_gauges(self):
+        recv, port = self._recv_socket()
+        try:
+            m = MetricsRegistry()
+            m.configure_statsd("127.0.0.1", port)
+            m.gauge("queue.depth", lambda: 7)
+            m.gauge("broken", lambda: 1 / 0)    # skipped, not fatal
+            m.gauge("not_numeric", lambda: "x")
+            assert m.push_gauges() == 1
+            assert recv.recv(1024).decode() == "tpu_sdk.queue.depth:7.0|g"
+        finally:
+            recv.close()
+
+    def test_close_releases_socket(self):
+        recv, port = self._recv_socket()
+        try:
+            m = MetricsRegistry()
+            m.configure_statsd("127.0.0.1", port)
+            pusher_sock = m._statsd._sock
+            m.close()
+            assert pusher_sock.fileno() == -1    # closed, fd released
+            assert m.push_gauges() == 0          # statsd detached
+            m.counter("after.close")             # no crash post-close
+            m.close()                            # idempotent
+        finally:
+            recv.close()
+
+
+class TestConcurrency:
+    def test_parallel_counters_exact(self):
+        m = MetricsRegistry()
+        n_threads, n_incr = 8, 2000
+
+        def work():
+            for _ in range(n_incr):
+                m.counter("hits")
+                m.observe("lat_seconds", 0.001)
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        data = m.to_dict()
+        assert data["counters"]["hits"] == n_threads * n_incr
+        assert data["timers"]["lat_seconds"]["count"] == n_threads * n_incr
+
+    def test_gauge_supplier_may_reenter_registry(self):
+        # suppliers run outside the registry lock, so a gauge that reads
+        # the registry (a load gauge derived from counters, the ingress
+        # pattern) must not deadlock to_dict()/to_prometheus()
+        m = MetricsRegistry()
+        m.counter("served", 5)
+        m.gauge("served.copy",
+                lambda: m.to_dict()["counters"]["served"])
+        done = []
+
+        def snap():
+            done.append(m.to_dict()["gauges"]["served.copy"])
+
+        t = threading.Thread(target=snap)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), "to_dict() deadlocked on a reentrant gauge"
+        assert done == [5.0]
+        assert "served_copy 5.0" in m.to_prometheus()
 
 
 def test_agents_registered_gauge():

@@ -1,0 +1,220 @@
+"""Request tracing tests: header propagation, span stores, export.
+
+The tracing plane rides the existing HTTP hops (router -> prefill ->
+decode) via the ``X-Tpu-Trace`` header; these tests pin the pure parts
+(ids, parsing, stores, chrome export) plus an end-to-end pass through a
+live router + frontend pair.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from dcos_commons_tpu.tracing import (
+    TRACE_HEADER,
+    Span,
+    TraceContext,
+    TraceStore,
+    Tracer,
+    chrome_trace,
+    new_id,
+    parse_header,
+    perf_to_epoch,
+)
+
+
+class TestHeader:
+    def test_roundtrip(self):
+        ctx = TraceContext(new_id(), new_id())
+        parsed = parse_header(ctx.header())
+        assert (parsed.trace_id, parsed.span_id) == (ctx.trace_id,
+                                                     ctx.span_id)
+
+    def test_ids_are_hex16(self):
+        tid = new_id()
+        assert len(tid) == 16
+        int(tid, 16)    # raises if not hex
+
+    @pytest.mark.parametrize("garbage", [
+        None, "", "nodash", "xyz-123", "a-b-c", "deadbeef-",
+        "-deadbeef", "ZZZZZZZZZZZZZZZZ-0000000000000000",
+    ])
+    def test_garbage_rejected(self, garbage):
+        assert parse_header(garbage) is None
+
+
+class TestTracer:
+    def test_start_records_parented_span(self):
+        store = TraceStore()
+        tracer = Tracer("svc", store)
+        root = TraceContext(new_id(), new_id())
+        with tracer.start("child", parent=root, tenant="t0"):
+            pass
+        (span,) = store.spans(root.trace_id)
+        assert span.name == "child"
+        assert span.parent_id == root.span_id
+        assert span.trace_id == root.trace_id
+        assert span.service == "svc"
+        assert span.attrs["tenant"] == "t0"
+        assert span.dur_s >= 0.0
+
+    def test_start_without_parent_mints_trace(self):
+        store = TraceStore()
+        tracer = Tracer("svc", store)
+        with tracer.start("root", terminal=True) as sp:
+            pass
+        assert store.complete(sp.ctx.trace_id)
+
+    def test_error_status_on_exception(self):
+        store = TraceStore()
+        tracer = Tracer("svc", store)
+        with pytest.raises(RuntimeError):
+            with tracer.start("boom") as sp:
+                raise RuntimeError("x")
+        (span,) = store.spans(sp.ctx.trace_id)
+        assert span.status == "error"
+
+    def test_record_retrospective(self):
+        store = TraceStore()
+        tracer = Tracer("svc", store)
+        t0 = time.perf_counter()
+        ctx = tracer.record("measured", t0, t0 + 0.25, terminal=True, n=3)
+        (span,) = store.spans(ctx.trace_id)
+        assert span.dur_s == pytest.approx(0.25)
+        assert span.t_start == pytest.approx(perf_to_epoch(t0))
+        assert span.attrs["n"] == 3
+        assert store.complete(ctx.trace_id)
+
+    def test_perf_to_epoch_monotone(self):
+        a = perf_to_epoch(time.perf_counter())
+        b = perf_to_epoch(time.perf_counter())
+        assert b >= a
+        assert abs(a - time.time()) < 5.0    # anchored to wall clock
+
+
+class TestTraceStore:
+    def _span(self, trace_id, *, terminal=False, t=0.0):
+        return Span(trace_id=trace_id, span_id=new_id(), parent_id=None,
+                    name="s", service="svc", t_start=t, dur_s=0.0,
+                    terminal=terminal)
+
+    def test_complete_requires_terminal_span(self):
+        store = TraceStore()
+        tid = new_id()
+        store.add(self._span(tid))
+        assert not store.complete(tid)
+        assert store.incomplete_trace_ids() == [tid]
+        store.add(self._span(tid, terminal=True))
+        assert store.complete(tid)
+        assert store.incomplete_trace_ids() == []
+
+    def test_spans_sorted_by_start(self):
+        store = TraceStore()
+        tid = new_id()
+        store.add(self._span(tid, t=2.0))
+        store.add(self._span(tid, t=1.0))
+        store.add(self._span(tid, t=3.0, terminal=True))
+        assert [s.t_start for s in store.spans(tid)] == [1.0, 2.0, 3.0]
+
+    def test_whole_trace_eviction(self):
+        # capacity is in spans, but eviction drops whole traces oldest
+        # first — a partial trace is worse than a missing one
+        store = TraceStore(capacity=4)
+        first = new_id()
+        for _ in range(3):
+            store.add(self._span(first))
+        second = new_id()
+        store.add(self._span(second))
+        store.add(self._span(second))    # 5 spans > 4: evict `first`
+        assert store.trace_ids() == [second]
+        assert store.spans(first) == []
+        assert len(store) == 2
+
+    def test_last_trace_never_evicted(self):
+        # one giant trace may exceed capacity; dropping it would lose the
+        # only evidence of the request in flight
+        store = TraceStore(capacity=2)
+        tid = new_id()
+        for _ in range(5):
+            store.add(self._span(tid))
+        assert len(store.spans(tid)) == 5
+
+    def test_export_shape(self):
+        store = TraceStore()
+        tid = new_id()
+        store.add(self._span(tid, terminal=True))
+        out = store.export(tid)
+        assert out["trace_id"] == tid
+        assert out["complete"] is True
+        restored = Span.from_dict(out["spans"][0])
+        assert restored.trace_id == tid
+        assert restored.terminal is True
+
+
+class TestChromeExport:
+    def test_shape(self):
+        store = TraceStore()
+        tracer = Tracer("router", store)
+        t0 = time.perf_counter()
+        root = tracer.record("req", t0, t0 + 0.5, terminal=True)
+        tracer.record("relay", t0 + 0.1, t0 + 0.2, parent=root)
+        doc = chrome_trace(store.spans(root.trace_id))
+        events = doc["traceEvents"]
+        xs = [e for e in events if e.get("ph") == "X"]
+        assert len(xs) == 2
+        assert [e["ts"] for e in xs] == sorted(e["ts"] for e in xs)
+        for e in xs:
+            assert e["dur"] >= 0 and e["ts"] > 0
+        # process-name metadata per service, so the chrome UI labels rows
+        metas = [e for e in events if e.get("ph") == "M"]
+        assert any(e["args"]["name"] == "router" for e in metas)
+        json.dumps(doc)    # must be JSON-serializable as-is
+
+
+@pytest.mark.slow
+class TestEndToEnd:
+    """One request through a live router -> frontend pair produces a
+    complete trace fetchable from the router (the tpuctl trace path)."""
+
+    def test_router_trace_export(self):
+        import jax
+
+        from dcos_commons_tpu.models import llama, serving
+        from dcos_commons_tpu.models.ingress import ServingFrontend
+        from dcos_commons_tpu.models.router import Router
+
+        cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                     attn_impl="dense")
+        params = llama.init_params(cfg, jax.random.key(0))
+        engine = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                     prefill_chunk=8)
+        front = ServingFrontend(engine, port=0, host="127.0.0.1").start()
+        router = Router([f"http://127.0.0.1:{front.port}"],
+                        host="127.0.0.1", page_size=16,
+                        probe_interval_s=0.0, seed=3).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/v1/generate",
+                data=json.dumps({"prompt": [5] * 12, "max_new": 3,
+                                 "tenant": "t"}).encode(),
+                headers={"Content-Type": "application/json",
+                         TRACE_HEADER: "00000000000000aa-00000000000000bb"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                out = json.loads(r.read())
+            assert len(out["tokens"]) == 3
+
+            # the caller's trace id is honored end to end
+            trace = router.trace_export("00000000000000aa")
+            assert trace["complete"]
+            names = {s["name"] for s in trace["spans"]}
+            assert {"router.admission", "router.request",
+                    "serve.request", "serve.first_token"} <= names
+            starts = [s["t_start"] for s in trace["spans"]]
+            assert starts == sorted(starts)
+            services = {s["service"] for s in trace["spans"]}
+            assert {"router", "serve"} <= services
+        finally:
+            router.stop()
+            front.stop()

@@ -38,6 +38,7 @@ def run_variant(seed: int, ticks: int, autoscale: bool) -> dict:
                        autoscale=autoscale, burst_schedule=BURST_SCHEDULE)
     report = soak.run()
     shed, done = soak.load.total_shed, soak.load.total_done
+    trace_store = soak.routersim.trace_store
     return {
         "metric": "elastic_ab",
         "variant": "autoscaled" if autoscale else "static",
@@ -49,6 +50,9 @@ def run_variant(seed: int, ticks: int, autoscale: bool) -> dict:
         "requests_done": done,
         "requests_shed": shed,
         "shed_rate": round(shed / max(1, shed + done), 4),
+        "relays_completed": soak.routersim.completed,
+        "traces_retained": len(trace_store.trace_ids()),
+        "traces_incomplete": len(trace_store.incomplete_trace_ids()),
         "scale_events": [[n, round(p, 3)]
                          for n, p in soak.autoscaler.events],
         "final_decode_target": soak.autoscaler.target,
@@ -87,9 +91,14 @@ def main(argv=None) -> int:
         auto = run_variant(seed, args.ticks, autoscale=True)
         static = run_variant(seed, args.ticks, autoscale=False)
         improved = auto["shed_rate"] < static["shed_rate"]
+        # trace completeness: after settle every admitted relay's trace
+        # must have reached a terminal span (the invariant also audits
+        # this per tick; the receipt makes it visible in the A/B row)
+        traces_ok = (auto["traces_incomplete"] == 0
+                     and static["traces_incomplete"] == 0)
         ok = (auto["converged"] and static["converged"]
               and not auto["violations"] and not static["violations"]
-              and improved)
+              and improved and traces_ok)
         summary = {
             "metric": "elastic_ab_summary",
             "seed": seed,
@@ -101,6 +110,8 @@ def main(argv=None) -> int:
             "preemptions": len(auto["preemptions"]),
             "flushes": len(auto["checkpoint_flushes"]),
             "resumes": len(auto["checkpoint_resumes"]),
+            "traces_incomplete": (auto["traces_incomplete"]
+                                  + static["traces_incomplete"]),
             "ok": ok,
         }
         lines += [auto, static, summary]

@@ -60,6 +60,19 @@ import urllib.request
 from dcos_commons_tpu.utils.stats import percentiles as _percentiles
 
 
+def _hist_ms(timer) -> dict:
+    """A registry timer snapshot (``MetricsRegistry.timer``) as
+    millisecond percentiles shaped like :func:`_percentiles` — the
+    receipt carries BOTH so the histogram's fixed-bucket estimate is
+    auditable against the exact sorted-sample computation."""
+    if not timer or not timer.get("count"):
+        return {}
+    return {"count": timer["count"],
+            "p50": round(timer["p50_s"] * 1e3, 3),
+            "p95": round(timer["p95_s"] * 1e3, 3),
+            "p99": round(timer["p99_s"] * 1e3, 3)}
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--preset", default="400m",
@@ -301,6 +314,7 @@ def main(argv=None) -> int:
     hung = sum(1 for th in threads if th.is_alive())
     wall = time.perf_counter() - t_start
     stats = fe.stats()
+    ttft_hist = fe.metrics.timer("ingress.ttft_seconds")
     coord_stats = coord.stats() if coord else {}
     if coord:
         coord.stop()
@@ -345,6 +359,7 @@ def main(argv=None) -> int:
         "throughput_tokens_per_sec": round(total_tokens / wall, 1),
         "latency_ms": _percentiles(lats),
         "ttft_ms": _percentiles(ttfts),
+        "ttft_ms_hist": _hist_ms(ttft_hist),
         "tpot_ms": _percentiles(tpots),
         "ingress_stats": {k: stats[k] for k in
                           ("requests", "tokens", "rejected")},
@@ -473,6 +488,10 @@ def _fleet_bench(args, cfg, params, quant_applied) -> int:
     hung = sum(1 for th in threads if th.is_alive())
     wall = time.perf_counter() - t_start
     rstats = router.stats()
+    router_hist = router.metrics.timer("router.ttft_seconds")
+    store = router.tracer.store
+    traces_retained = len(store.trace_ids())
+    traces_incomplete = len(store.incomplete_trace_ids())
     router.stop()
     for f in fronts:
         f.stop()
@@ -521,6 +540,9 @@ def _fleet_bench(args, cfg, params, quant_applied) -> int:
                                   if results else None),
         "latency_ms": _percentiles(lats),
         "router_ttft_ms": _percentiles(ttfts),
+        "router_ttft_ms_hist": _hist_ms(router_hist),
+        "traces_retained": traces_retained,
+        "traces_incomplete": traces_incomplete,
         "per_tenant": per_tenant,
         "router_stats": {k: rstats[k] for k in
                          ("routed", "affinity_hits", "affinity_rate",

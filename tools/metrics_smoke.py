@@ -1,0 +1,305 @@
+"""Time-capped observability smoke for CI: a real router + two decode
+replicas serve traffic, then both tiers' ``/v1/metrics/prometheus``
+endpoints are scraped and validated with a small exposition parser, and
+one request's trace is exported end-to-end.
+
+Three always-on checks next to the router smoke in test.sh:
+
+1. **exposition conformance** — every scraped line parses; every
+   ``# TYPE`` names a known type; histogram ``_bucket`` series are
+   cumulative and non-decreasing with the ``+Inf`` bucket equal to
+   ``_count``; no metric name is typed twice.
+2. **the numbers are real** — the router's ``router_routed`` counter
+   and TTFT histogram count equal the number of requests actually
+   served; the frontend's ``ingress_requests_total`` agrees.
+3. **one complete trace** — an admitted request's trace, fetched from
+   the router's ``/v1/trace/<id>`` (the ``tpuctl trace`` surface),
+   reaches a terminal span, covers admission through first token, and
+   carries monotone span timestamps.
+
+Checks run in order and stop (skip, not fail) when the time budget runs
+out — a slow CI host skips tail checks rather than timing out the
+build.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.request
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^ ]+)$")
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse (a useful subset of) the Prometheus text exposition format.
+    Returns ``{metric_name: {"type": str|None, "samples": [(labels,
+    value)]}}`` keyed by the *family* name (``_bucket``/``_sum``/
+    ``_count`` suffixes folded into their histogram). Raises
+    ``ValueError`` on any malformed line — the conformance check."""
+    families: dict = {}
+
+    def family(name: str) -> dict:
+        return families.setdefault(
+            name, {"type": None, "samples": []})
+
+    def family_name(sample_name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = sample_name[:-len(suffix)]
+            if sample_name.endswith(suffix) and base in families:
+                return base
+        return sample_name
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("TYPE", "HELP"):
+                raise ValueError(f"line {lineno}: bad comment {line!r}")
+            if parts[1] == "TYPE":
+                if len(parts) != 4 or parts[3] not in (
+                        "counter", "gauge", "histogram", "summary",
+                        "untyped"):
+                    raise ValueError(
+                        f"line {lineno}: bad TYPE line {line!r}")
+                name = parts[2]
+                if not _NAME_RE.match(name):
+                    raise ValueError(
+                        f"line {lineno}: bad metric name {name!r}")
+                if families.get(name, {}).get("type") is not None:
+                    raise ValueError(
+                        f"line {lineno}: {name} TYPEd twice")
+                family(name)["type"] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: unparseable sample {line!r}")
+        labels = {}
+        for item in filter(None, (m.group("labels") or "").split(",")):
+            k, _, v = item.partition("=")
+            if not _NAME_RE.match(k) or not (v.startswith('"')
+                                             and v.endswith('"')):
+                raise ValueError(f"line {lineno}: bad label {item!r}")
+            labels[k] = v[1:-1]
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            raise ValueError(
+                f"line {lineno}: non-numeric value {line!r}") from None
+        family(family_name(m.group("name")))["samples"].append(
+            (m.group("name"), labels, value))
+    return families
+
+
+def check_histograms(families: dict) -> None:
+    """Cumulative-bucket discipline for every histogram family."""
+    for name, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        buckets = [(lbl.get("le"), v) for n, lbl, v in fam["samples"]
+                   if n == f"{name}_bucket"]
+        counts = [v for n, _, v in fam["samples"] if n == f"{name}_count"]
+        if not buckets or len(counts) != 1:
+            raise ValueError(f"{name}: want buckets and one _count")
+        if buckets[-1][0] != "+Inf":
+            raise ValueError(f"{name}: last bucket le={buckets[-1][0]!r}, "
+                             "want +Inf")
+        prev_le, prev_n = -float("inf"), 0.0
+        for le, n in buckets:
+            le_f = float("inf") if le == "+Inf" else float(le)
+            if le_f <= prev_le or n < prev_n:
+                raise ValueError(f"{name}: buckets not cumulative at "
+                                 f"le={le}")
+            prev_le, prev_n = le_f, n
+        if buckets[-1][1] != counts[0]:
+            raise ValueError(f"{name}: +Inf bucket {buckets[-1][1]} != "
+                             f"_count {counts[0]}")
+
+
+def _sample(families: dict, name: str, default: float = None) -> float:
+    for fam in families.values():
+        for n, _, v in fam["samples"]:
+            if n == name:
+                return v
+    if default is not None:
+        return default
+    raise KeyError(name)
+
+
+def _get(url: str, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        ctype = r.headers.get("Content-Type", "")
+        body = r.read()
+    return ctype, body
+
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget-s", type=float, default=90.0,
+                    help="wall-clock cap; tail checks are skipped, not "
+                         "failed, when it runs out (default 90)")
+    args = ap.parse_args(argv)
+    deadline = time.monotonic() + args.budget_s
+
+    import jax
+
+    from dcos_commons_tpu.models import llama, serving
+    from dcos_commons_tpu.models.ingress import ServingFrontend
+    from dcos_commons_tpu.models.router import Router
+
+    cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                 attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.key(0))
+    replicas = []
+    for _ in range(2):
+        engine = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                     prefill_chunk=8)
+        front = ServingFrontend(engine, port=0, host="127.0.0.1").start()
+        replicas.append((engine, front))
+    router = Router([f"http://127.0.0.1:{f.port}" for _, f in replicas],
+                    host="127.0.0.1", page_size=16,
+                    probe_interval_s=0.0, seed=7).start()
+    base = f"http://127.0.0.1:{router.port}"
+    ran = 0
+
+    def _spent(name: str) -> bool:
+        if time.monotonic() >= deadline:
+            print(f"metrics-smoke: time budget exhausted after {ran} "
+                  f"checks; {name!r} and later checks skipped")
+            return True
+        return False
+
+    try:
+        n_requests = 6
+        for i in range(n_requests):
+            out = _post(f"{base}/v1/generate",
+                        {"prompt": [7] * 16 + [i], "max_new": 4,
+                         "tenant": "smoke"})
+            if len(out["tokens"]) != 4:
+                print(f"metrics-smoke FAILED: short stream {out}",
+                      file=sys.stderr)
+                return 1
+
+        # 1. conformance: both tiers' exposition parses and histograms
+        # keep cumulative-bucket discipline
+        if _spent("exposition-conformance"):
+            return 0
+        scraped = {}
+        targets = [("router", f"{base}/v1/metrics/prometheus")]
+        targets += [(f"decode{i}", f"http://127.0.0.1:{f.port}"
+                                   "/v1/metrics/prometheus")
+                    for i, (_, f) in enumerate(replicas)]
+        for tier, url in targets:
+            ctype, body = _get(url)
+            if not ctype.startswith("text/plain"):
+                print(f"metrics-smoke FAILED: {tier} Content-Type "
+                      f"{ctype!r}", file=sys.stderr)
+                return 1
+            try:
+                families = parse_exposition(body.decode())
+                check_histograms(families)
+            except ValueError as e:
+                print(f"metrics-smoke FAILED: {tier} exposition: {e}",
+                      file=sys.stderr)
+                return 1
+            scraped[tier] = families
+        ran += 1
+
+        # 2. the numbers are real: counters and histogram counts match
+        # the traffic actually served
+        if _spent("counters-match-traffic"):
+            return 0
+        try:
+            routed = _sample(scraped["router"], "router_routed")
+            ttft_n = _sample(scraped["router"],
+                             "router_ttft_seconds_count")
+            # an idle replica never mints the counter: absent == 0
+            served = sum(
+                _sample(scraped[f"decode{i}"], "ingress_requests_total",
+                        default=0.0)
+                for i in range(len(replicas)))
+        except KeyError as e:
+            print(f"metrics-smoke FAILED: metric missing: {e}",
+                  file=sys.stderr)
+            return 1
+        if routed != n_requests or ttft_n != n_requests:
+            print(f"metrics-smoke FAILED: router saw routed={routed} "
+                  f"ttft_count={ttft_n}, served {n_requests}",
+                  file=sys.stderr)
+            return 1
+        if served != n_requests:
+            print(f"metrics-smoke FAILED: decode tier served {served} "
+                  f"of {n_requests}", file=sys.stderr)
+            return 1
+        ran += 1
+
+        # 3. one complete trace: fetched through the router's
+        # /v1/trace/<id> (the tpuctl trace surface), terminal, covering
+        # admission -> first token with monotone timestamps
+        if _spent("trace-complete"):
+            return 0
+        _, body = _get(f"{base}/v1/traces")
+        listing = json.loads(body)
+        complete_ids = [t for t in listing["trace_ids"]
+                        if t not in set(listing["incomplete"])]
+        if not complete_ids:
+            print(f"metrics-smoke FAILED: no complete trace retained "
+                  f"({listing})", file=sys.stderr)
+            return 1
+        _, body = _get(f"{base}/v1/trace/{complete_ids[-1]}")
+        trace = json.loads(body)
+        spans = trace["spans"]
+        names = {s["name"] for s in spans}
+        starts = [s["t_start"] for s in spans]
+        if not trace.get("complete"):
+            print(f"metrics-smoke FAILED: exported trace incomplete: "
+                  f"{names}", file=sys.stderr)
+            return 1
+        want = {"router.admission", "router.request", "serve.request",
+                "serve.first_token"}
+        if not want <= names:
+            print(f"metrics-smoke FAILED: trace missing spans "
+                  f"{want - names} (got {sorted(names)})",
+                  file=sys.stderr)
+            return 1
+        if starts != sorted(starts):
+            print("metrics-smoke FAILED: span timestamps not monotone",
+                  file=sys.stderr)
+            return 1
+        by_name = {s["name"]: s for s in spans}
+        if (by_name["router.admission"]["t_start"] >
+                by_name["serve.first_token"]["t_start"]):
+            print("metrics-smoke FAILED: admission span starts after "
+                  "the first-token span", file=sys.stderr)
+            return 1
+        ran += 1
+        print(f"metrics-smoke: {ran} checks passed — all expositions "
+              f"conform, counters match {n_requests} served requests, "
+              f"and trace {trace['trace_id']} exports complete with "
+              f"{len(spans)} spans across "
+              f"{len({s['service'] for s in spans})} services")
+    finally:
+        router.stop()
+        for _, f in replicas:
+            f.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
