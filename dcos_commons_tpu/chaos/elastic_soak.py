@@ -31,8 +31,17 @@ decode tier, with two more fault classes (``router_replica_down``,
 isolation, spill-before-drop, and relay progress. Settle additionally
 requires every admitted relay to have completed.
 
+Round 14 adds the cold-start layer: with ``warm_pool > 0`` the serve
+tier carries a :class:`~..scheduler.elastic.WarmPool` (pods with weights
+resident, excluded from the router ring and the load sim's capacity),
+:class:`_BootSim` books every new decode incarnation's weight source
+(peer fetch when a hot sibling exists, disk otherwise), and two more
+fault classes fire (``warm_promote_crash``, ``weight_fetch_lost``) with
+invariant 12 auditing that a warm pod is never double-counted as both
+headroom and capacity.
+
 Determinism contract matches ``chaos/soak.py``: one ``random.Random(seed)``
-drives the scheduler-facing weather; the load, flush, and router
+drives the scheduler-facing weather; the load, flush, router, and boot
 simulators run on their own derived RNGs so arming a new fault class
 never perturbs the draw order of a pinned seed.
 """
@@ -50,7 +59,7 @@ from ..plan.backoff import ExponentialBackoff
 from ..plan.status import Status
 from ..scheduler.core import ServiceScheduler
 from ..scheduler.elastic import (Autoscaler, AutoscalerConfig, BackfillGate,
-                                 ElasticController, Preemptor)
+                                 ElasticController, Preemptor, WarmPool)
 from ..scheduler.multi import MultiServiceScheduler
 from ..scheduler.recovery import AgentGoneFailureMonitor
 from ..specification.yaml_loader import load_service_yaml_str
@@ -343,6 +352,50 @@ class _RouterSim:
                            attempts=relay["attempts"])
 
 
+class _BootSim:
+    """Cold-start weight-source bookkeeping (``models/weights.py`` seam):
+    every NEW decode incarnation "loads weights" — from a hot peer when
+    at least one *other* decode replica is RUNNING at boot time, from
+    shared storage otherwise. A ``weight_fetch_lost`` fault kills the
+    next peer fetch mid-stream; the contract under audit is
+    degrade-not-crash — the boot falls back to the disk restore
+    (``fallbacks`` receipt) and NEVER fails. Runs on its own derived RNG
+    (also the warm-fault decision RNG), so arming the cold-start fault
+    classes never perturbs the scheduler-facing draw order of a pinned
+    seed."""
+
+    def __init__(self, seed: int):
+        self.rng = random.Random((seed << 14) ^ 0x853C49E6748FEA9B)
+        self._incarnation: Dict[str, str] = {}
+        self.boots: List[Tuple[int, str, str]] = []  # (tick, task, source)
+        self.peer_boots = 0
+        self.disk_boots = 0
+        self.fallbacks = 0
+        self._lose = 0
+
+    def lose_next(self) -> None:
+        self._lose += 1
+
+    def advance(self, tick: int, decode_tasks: List[Tuple[str, str]]) -> None:
+        for name, tid in decode_tasks:
+            if self._incarnation.get(name) == tid:
+                continue
+            self._incarnation[name] = tid
+            peers = len(decode_tasks) - 1
+            if peers > 0 and self._lose == 0:
+                source = "peer"
+                self.peer_boots += 1
+            elif peers > 0:
+                self._lose -= 1
+                source = "disk_fallback"
+                self.fallbacks += 1
+                self.disk_boots += 1
+            else:
+                source = "disk"
+                self.disk_boots += 1
+            self.boots.append((tick, name, source))
+
+
 class _FlushSim:
     """Plays the worker sentinel's side of the graceful-kill protocol:
     every task holding a delivered-but-unanswered SIGTERM checkpoint-
@@ -462,7 +515,8 @@ class ElasticSoak:
 
     def __init__(self, seed: int, ticks: int, config: FaultConfig, *,
                  autoscale: bool = True,
-                 burst_schedule: Tuple[Tuple[int, int], ...] = ()):
+                 burst_schedule: Tuple[Tuple[int, int], ...] = (),
+                 warm_pool: int = 0):
         self.seed = seed
         self.ticks = ticks
         self.config = config
@@ -489,11 +543,22 @@ class ElasticSoak:
         self.load = _LoadSim(seed)
         self.flushsim = _FlushSim(seed)
         self.routersim = _RouterSim(seed)
+        self.bootsim = _BootSim(seed)
+        self.warmpool = None
+        if warm_pool > 0:
+            self.warmpool = WarmPool(lambda: self.multi, "serve", "decode",
+                                     size=warm_pool, min_serving=1)
         self.autoscaler = Autoscaler(lambda: self.multi, "serve", AUTOSCALE,
-                                     self.load.gauges)
+                                     self.load.gauges,
+                                     warm_pool=self.warmpool)
         self.preemptor = Preemptor(lambda: self.multi,
                                    grace_ticks=3, starve_ticks=2)
-        self.backfill = BackfillGate(lambda: self.multi, reserve_chips=2)
+        # the warm harness also exercises the auto reserve: the rolling
+        # burst-magnitude max replaces the static count, and the pool's
+        # one-tick-reclaimable chips offset whatever it derives
+        self.backfill = BackfillGate(lambda: self.multi, reserve_chips=2,
+                                     warm_pool=self.warmpool,
+                                     auto_reserve=warm_pool > 0)
         self.controller = ElasticController(
             lambda: self.multi,
             autoscalers=[self.autoscaler] if autoscale else [],
@@ -555,13 +620,31 @@ class ElasticSoak:
                    if t.task_name.startswith("learn-")
                    and t.state is TaskState.RUNNING)
 
-    def _decode_tasks(self) -> List[Tuple[str, str]]:
+    def _warm_set(self) -> set:
+        return (set(self.warmpool.warm_instances())
+                if self.warmpool is not None else set())
+
+    def _decode_serving(self) -> int:
+        """RUNNING decode replicas that take traffic — warm-pool pods
+        are headroom, not capacity, so the load sim never counts them."""
+        warm = self._warm_set()
+        return sum(1 for t in self.cluster.live_tasks()
+                   if t.task_name.startswith("decode-")
+                   and t.state is TaskState.RUNNING
+                   and t.task_name.rsplit("-", 1)[0] not in warm)
+
+    def _decode_tasks(self, include_warm: bool = False
+                      ) -> List[Tuple[str, str]]:
         """RUNNING decode replicas as (task_name, task_id) — the router
-        sim's view of the tier; the id distinguishes incarnations."""
+        sim's view of the tier; the id distinguishes incarnations. Warm
+        instances are excluded unless asked for (the boot sim tracks
+        every incarnation; the ring must only ever see serving ones)."""
+        warm = set() if include_warm else self._warm_set()
         return sorted((t.task_name, t.task_id)
                       for t in self.cluster.live_tasks()
                       if t.task_name.startswith("decode-")
-                      and t.state is TaskState.RUNNING)
+                      and t.state is TaskState.RUNNING
+                      and t.task_name.rsplit("-", 1)[0] not in warm)
 
     # -- environment faults --------------------------------------------------
 
@@ -661,6 +744,37 @@ class ElasticSoak:
             self._count("tenant_flood")
             self._log(f"tick {tick}: tenant_flood bronze x"
                       f"{_RouterSim.FLOOD_ARRIVALS} for {duration} ticks")
+        # -- cold-start faults (boot sim's derived RNG: arming them never
+        # -- perturbs the scheduler-facing draw order of pinned seeds) --
+        if cfg.warm_promote_crash and self.bootsim.rng.random() \
+                < cfg.warm_promote_crash:
+            # kill a recently-promoted (else still-warm) decode pod
+            # before it serves: the pool must refill, the ring must
+            # never have double-counted it, and the tier must converge
+            pool = self.warmpool
+            if pool is not None:
+                candidates = set(pool.promoted[-2:]) | set(
+                    pool.warm_instances())
+                live = sorted(
+                    (t for t in cluster.live_tasks()
+                     if t.task_name.rsplit("-", 1)[0] in candidates
+                     and t.state is TaskState.RUNNING),
+                    key=lambda t: t.task_id)
+                if live:
+                    victim = self.bootsim.rng.choice(live)
+                    self.flushsim.drop(victim.task_id)
+                    cluster.send_status(victim.task_id, TaskState.FAILED,
+                                        message="chaos: warm promote "
+                                                "crash")
+                    self._count("warm_promote_crash")
+                    self._log(f"tick {tick}: warm_promote_crash "
+                              f"{victim.task_name}")
+        if cfg.weight_fetch_lost and self.bootsim.rng.random() \
+                < cfg.weight_fetch_lost:
+            self.bootsim.lose_next()
+            self._count("weight_fetch_lost")
+            self._log(f"tick {tick}: weight_fetch_lost (next peer boot "
+                      "falls back to disk)")
         if cfg.scale_mid_crash and rng.random() < cfg.scale_mid_crash:
             # force a resize so a scale plan is guaranteed in flight, then
             # kill the scheduler mid-rollout; the restored plans resume it
@@ -706,11 +820,15 @@ class ElasticSoak:
         self.vtime[0] += 1.0
         if tick in self.burst_schedule:
             self.load.burst(tick, self.burst_schedule[tick])
-        self.load.tick(tick, self._decode_running())
+        self.load.tick(tick, self._decode_serving())
         # storm ticks admit new front-door traffic; settle only drains
         self.routersim.tick(tick, self._decode_tasks(),
                             storm=tick < self.ticks)
         self.flushsim.advance(tick, self.cluster)
+        # every decode incarnation (warm pods included — they boot with
+        # weights resident precisely because they loaded them) books its
+        # weight source
+        self.bootsim.advance(tick, self._decode_tasks(include_warm=True))
         self.controller.tick(tick)
         for name in self.multi.service_names():
             sched = self.multi.get_service(name)
@@ -802,9 +920,12 @@ class ElasticSoak:
 
 
 def run_elastic_soak(seed: int, ticks: int = 40,
-                     config: Optional[FaultConfig] = None) -> SoakReport:
+                     config: Optional[FaultConfig] = None,
+                     warm_pool: int = 0) -> SoakReport:
     """Run one seeded elastic chaos schedule; ``config`` defaults to every
     fault class armed (:meth:`FaultConfig.all_faults`), scale-event
-    classes included."""
+    classes included. ``warm_pool > 0`` arms the Round 14 warm tier (the
+    ``elastic_warm`` corpus harness)."""
     return ElasticSoak(seed, ticks,
-                       config or FaultConfig.all_faults()).run()
+                       config or FaultConfig.all_faults(),
+                       warm_pool=warm_pool).run()

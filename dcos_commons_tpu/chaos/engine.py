@@ -99,6 +99,18 @@ class FaultConfig:
     # perturbs the scheduler-facing draw order of pinned seeds.
     router_replica_down: float = 0.0
     tenant_flood: float = 0.0
+    # cold-start faults (elastic soak harness warm-pool/boot sims): a
+    # freshly promoted warm pod crashes before serving its first token —
+    # the pool must refill and the promotion must never leave the pod
+    # double-counted as headroom AND capacity (warm_promote_crash); a
+    # booting replica's peer weight fetch dies mid-stream and the boot
+    # must degrade to the disk restore, never fail (weight_fetch_lost).
+    # Both draw from derived RNGs private to the warm/boot sims, and
+    # with no warm pool armed warm_promote_crash has no eligible target
+    # while weight_fetch_lost only annotates boot bookkeeping — so the
+    # legacy pinned seeds replay unperturbed.
+    warm_promote_crash: float = 0.0
+    weight_fetch_lost: float = 0.0
     max_delay_ticks: int = 3
 
     FIELDS = ("status_drop", "status_delay", "status_dup", "status_reorder",
@@ -106,7 +118,8 @@ class FaultConfig:
               "degrade", "task_crash", "crash_restart", "page_leak",
               "kv_ship_lost", "kv_ship_slow", "scale_up_burst",
               "preempt_storm", "victim_crash_in_grace", "scale_mid_crash",
-              "router_replica_down", "tenant_flood")
+              "router_replica_down", "tenant_flood",
+              "warm_promote_crash", "weight_fetch_lost")
 
     @classmethod
     def none(cls) -> "FaultConfig":
@@ -137,7 +150,8 @@ class FaultConfig:
                        kv_ship_lost=0.0, kv_ship_slow=0.0,
                        scale_up_burst=0.0, preempt_storm=0.0,
                        victim_crash_in_grace=0.0, scale_mid_crash=0.0,
-                       router_replica_down=0.0, tenant_flood=0.0)
+                       router_replica_down=0.0, tenant_flood=0.0,
+                       warm_promote_crash=0.0, weight_fetch_lost=0.0)
 
 
 def parse_faults(arg: str) -> FaultConfig:

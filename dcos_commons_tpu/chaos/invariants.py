@@ -220,18 +220,61 @@ class ElasticInvariantChecker:
     9. **cross-service double-booking** — per-service ledgers each pass
        their own capacity audit; the *sum* across services must also fit
        every agent, or two services were promised the same chips.
+    12. **warm pool is headroom XOR capacity** (harnesses with a
+        ``warmpool``) — a pod parked in the warm pool is one-tick
+        headroom and must NOT simultaneously sit in the router ring as
+        serving capacity; a promoted pod either serves or returns to the
+        pool. One tick of overlap is the legal hand-off window (the ring
+        follows the serving set on the *next* router tick); persisting
+        past it means the same chips were sold twice. The pool's held
+        count must also stay within ``[0, min(size, pod count)]``.
     """
 
     def __init__(self, harness, inversion_window: int = 30):
         self._h = harness          # needs .multi and .preemptor
         self.inversion_window = inversion_window
         self._inversion_streak = 0
+        self._warm_overlap: Dict[str, int] = {}
 
     def check(self, tick: int) -> List[Violation]:
         out: List[Violation] = []
         out += self._check_flush_grace(tick)
         out += self._check_priority_inversion(tick)
         out += self._check_cross_service_booking(tick)
+        out += self._check_warm_pool(tick)
+        return out
+
+    def _check_warm_pool(self, tick: int) -> List[Violation]:
+        pool = getattr(self._h, "warmpool", None)
+        routersim = getattr(self._h, "routersim", None)
+        if pool is None:
+            return []
+        out: List[Violation] = []
+        sched = pool._service()
+        pod = None if sched is None else pool._pod(sched)
+        count = 0 if pod is None else pod.count
+        if pool.held < 0 or pool.held > min(pool.size, count):
+            out.append(Violation(
+                "warm-pool-bounds",
+                f"held {pool.held} outside [0, min(size {pool.size}, "
+                f"pod count {count})]", tick))
+        if routersim is None:
+            return out
+        warm = set(pool.warm_instances())
+        overlap = {node for node in routersim.ring.nodes()
+                   if node.rsplit("-", 1)[0] in warm}
+        for node in list(self._warm_overlap):
+            if node not in overlap:
+                del self._warm_overlap[node]
+        for node in overlap:
+            streak = self._warm_overlap.get(node, 0) + 1
+            self._warm_overlap[node] = streak
+            if streak >= 2:
+                out.append(Violation(
+                    "warm-double-count",
+                    f"{node} is in the router ring (capacity) AND the "
+                    f"warm pool (headroom) for {streak} consecutive "
+                    "ticks", tick))
         return out
 
     def _check_flush_grace(self, tick: int) -> List[Violation]:

@@ -255,6 +255,28 @@ def _health_cmd(client: Client, args) -> int:
     return _emit(*client.get("health"))
 
 
+def _warm_pool_cmd(client: Client, args) -> int:
+    """Warm-pool tier status off the scheduler's shared metrics
+    registry (``GET /v1/metrics``): the ``autoscale.warm_pool.*``
+    gauges (size / held / ready / reclaimable chips) plus any
+    ``autoscale.cold_start*`` timer histograms the worker has
+    observed — scale-up headroom and boot cost at a glance."""
+    code, payload = client.call("GET", "metrics", root=True)
+    if code >= 400 or not isinstance(payload, dict):
+        return _emit(code, payload)
+    pool = {k.rsplit(".", 1)[1]: v
+            for k, v in (payload.get("gauges") or {}).items()
+            if k.startswith("autoscale.warm_pool.")}
+    cold = {k: v for k, v in (payload.get("timers") or {}).items()
+            if k.startswith("autoscale.cold_start")}
+    if not pool:
+        return _emit(code, {
+            "warm_pool": None,
+            "note": "no warm pool configured (WARM_POOL_SIZE unset or "
+                    "0, or the autoscaler has no shared registry)"})
+    return _emit(code, {"warm_pool": pool, "cold_start": cold})
+
+
 def _route_stats_cmd(client: Client, args) -> int:
     """Routing counters from the fleet front door (``models/router.py``
     ``GET /v1/routestats``): affinity rate, spills, sheds, per-replica
@@ -572,6 +594,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("health", help="scheduler health").set_defaults(
         fn=_health_cmd)
+
+    sub.add_parser("warm-pool",
+                   help="warm-pool headroom gauges + cold-start "
+                        "timers").set_defaults(fn=_warm_pool_cmd)
 
     rs = sub.add_parser("route-stats",
                         help="fleet front-door routing counters "

@@ -602,7 +602,7 @@ class PagedServer:
                  prefill_chunk: int = 64, sampler=None,
                  key: Optional[jax.Array] = None,
                  eos_id: Optional[int] = None, mesh=None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, compile_cache=None):
         if page_size < 1 or cfg.max_seq % page_size:
             raise ValueError(
                 f"page_size {page_size} must divide max_seq "
@@ -653,26 +653,50 @@ class PagedServer:
         rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
         self._rope = rope
         scratch = self.scratch
-        # pool donated everywhere it flows through jit, like the slot
-        # cache: it dominates HBM and every executable returns a
-        # same-shaped pool
-        self._step_x = jax.jit(
-            lambda p, c, tbl, ln, tok: llama.decode_step_paged(
-                cfg, p, c, tbl, ln, tok, mesh=mesh, rope=rope),
-            donate_argnums=(1,))
-        self._stepk_x: Dict[int, Any] = {}
-        self._chunk_x = jax.jit(
-            lambda p, c, tbl, toks, st, tl, li:
-                llama.prefill_chunk_paged(cfg, p, c, tbl, toks, st, tl,
-                                          li, scratch, mesh=mesh,
-                                          rope=rope),
-            donate_argnums=(1,))
-        self._copy_x = jax.jit(
-            lambda c, src, dst: {"k": _copy_page(c["k"], src, dst),
-                                 "v": _copy_page(c["v"], src, dst)},
-            donate_argnums=(0,))
-        # adoption scatter executables, one per installed-page count
-        self._adopt_x: Dict[int, Any] = {}
+        # greedy engines at an identical (config, topology, geometry)
+        # key share ONE set of jitted wrappers through the AOT cache —
+        # XLA's executable cache is per wrapper object, so the second
+        # homogeneous replica decodes without a re-trace/re-compile;
+        # sampled engines bypass it (the window lambda closes over
+        # self.sampler, which is engine-private)
+        ns = None
+        if compile_cache is not None and sampler is None:
+            from ..parallel.aot import engine_key
+            ns = compile_cache.namespace(engine_key(
+                cfg, mesh, kind="paged", slots=slots,
+                pages=self.total_pages, page_size=page_size,
+                prefill_chunk=prefill_chunk))
+        if ns:
+            self._step_x = ns["step"]
+            self._stepk_x = ns["stepk"]
+            self._chunk_x = ns["chunk"]
+            self._copy_x = ns["copy"]
+            self._adopt_x = ns["adopt"]
+        else:
+            # pool donated everywhere it flows through jit, like the
+            # slot cache: it dominates HBM and every executable returns
+            # a same-shaped pool
+            self._step_x = jax.jit(
+                lambda p, c, tbl, ln, tok: llama.decode_step_paged(
+                    cfg, p, c, tbl, ln, tok, mesh=mesh, rope=rope),
+                donate_argnums=(1,))
+            self._stepk_x: Dict[int, Any] = {}
+            self._chunk_x = jax.jit(
+                lambda p, c, tbl, toks, st, tl, li:
+                    llama.prefill_chunk_paged(cfg, p, c, tbl, toks, st,
+                                              tl, li, scratch, mesh=mesh,
+                                              rope=rope),
+                donate_argnums=(1,))
+            self._copy_x = jax.jit(
+                lambda c, src, dst: {"k": _copy_page(c["k"], src, dst),
+                                     "v": _copy_page(c["v"], src, dst)},
+                donate_argnums=(0,))
+            # adoption scatter executables, one per installed-page count
+            self._adopt_x: Dict[int, Any] = {}
+            if ns is not None:
+                ns.update(step=self._step_x, stepk=self._stepk_x,
+                          chunk=self._chunk_x, copy=self._copy_x,
+                          adopt=self._adopt_x)
         # disaggregation counters (page_stats): spans this engine
         # prefilled for shipment / adopted from a peer / pages the
         # radix deduped at adoption (shipped system prompts)
@@ -683,6 +707,37 @@ class PagedServer:
     # the engine-thread-only helpers are identical to the slot engine's
     _select = SlotServer._select
     drain = SlotServer.drain
+
+    def warmup(self, widths=(1,)) -> Dict[str, float]:
+        """Pre-trace + compile the serving executables BEFORE admission
+        — the cold-start ``compile`` phase, made a receipted number: one
+        prefill chunk plus one decode step per decode-table width in
+        ``widths``, every write landing on the scratch page so no live
+        state is touched. With a shared ``compile_cache`` namespace the
+        same call costs only executable lookups. ``widths`` should cover
+        the page-window widths expected at admission (a width not warmed
+        compiles lazily on first use, exactly as before). Returns
+        ``{phase: seconds}``."""
+        timings: Dict[str, float] = {}
+        t0 = time.perf_counter()
+        row = np.full((self.pages_per_stream,), self.scratch, np.int32)
+        c = self.prefill_chunk
+        logits, self.pool = self._chunk_x(
+            self.params, self.pool, jnp.asarray(row),
+            jnp.zeros((1, c), jnp.int32), jnp.int32(0), jnp.int32(c),
+            jnp.int32(c - 1))
+        jax.block_until_ready(logits)
+        timings["chunk"] = time.perf_counter() - t0
+        ones = jnp.ones((self.slots,), jnp.int32)
+        zeros = jnp.zeros((self.slots,), jnp.int32)
+        for w in widths:
+            t1 = time.perf_counter()
+            tbl = jnp.full((self.slots, int(w)), self.scratch, jnp.int32)
+            logits, self.pool = self._step_x(self.params, self.pool,
+                                             tbl, ones, zeros)
+            jax.block_until_ready(logits)
+            timings[f"step_w{int(w)}"] = time.perf_counter() - t1
+        return timings
 
     def _flush_pending(self) -> None:
         """:meth:`SlotServer._flush_pending`, plus decode ACTIVATION:

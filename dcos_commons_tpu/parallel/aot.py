@@ -1,0 +1,155 @@
+"""Ahead-of-time compile reuse for homogeneous scale-up.
+
+The third leg of the cold-start collapse: when the autoscaler adds a
+decode replica with the SAME model config on the SAME topology as the
+replicas already serving, re-tracing and re-compiling the paged-server
+executables is pure waste — the jitted wrappers the first engine built
+are exactly the ones the new engine needs.
+
+:class:`CompileCache` is the in-process form: a registry of namespaces
+keyed by :func:`engine_key` (a digest of model config + topology +
+engine geometry). Engines constructed with the same key share the SAME
+jit wrapper objects, so XLA's per-wrapper executable cache is hit
+instead of re-traced — scale-up N of a homogeneous tier compiles once.
+
+:func:`arm_persistent_cache` is the cross-process form: best-effort
+arming of JAX's on-disk compilation cache under ``AOT_CACHE_DIR`` so
+even the FIRST engine of a restarted process skips XLA re-compilation.
+Both are observable (hits/misses counters) so the bench's ``compile``
+phase timer tells the truth about what was reused.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from ..metrics import MetricsRegistry
+
+
+def config_key(cfg: Any) -> str:
+    """Stable digest of a model config (dataclass or mapping)."""
+    if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+        fields = dataclasses.asdict(cfg)
+    elif isinstance(cfg, dict):
+        fields = cfg
+    else:
+        fields = {"repr": repr(cfg)}
+    blob = ";".join(f"{k}={fields[k]!r}" for k in sorted(fields))
+    return hashlib.blake2s(blob.encode(), digest_size=8).hexdigest()
+
+
+def topology_key(mesh: Any = None) -> str:
+    """Stable digest input for the device topology: mesh axis names and
+    sizes plus device kind, or the host platform when meshless. Two
+    replicas with equal topology keys can share compiled executables."""
+    if mesh is not None:
+        axes = ",".join(f"{n}={s}" for n, s in
+                        zip(mesh.axis_names, mesh.devices.shape))
+        kind = getattr(mesh.devices.flat[0], "device_kind", "unknown")
+        return f"mesh[{axes}]:{kind}"
+    try:
+        import jax
+        devs = jax.devices()
+        return f"{devs[0].platform}:{len(devs)}"
+    except Exception:
+        return "cpu:1"
+
+
+def engine_key(cfg: Any, mesh: Any = None, **extra: Any) -> str:
+    """Cache key for one engine shape: (config, topology) per the issue,
+    plus whatever geometry the engine's executables close over (page
+    count, page size, sampler-ness) passed as ``extra``."""
+    parts = [config_key(cfg), topology_key(mesh)]
+    parts += [f"{k}={extra[k]!r}" for k in sorted(extra)]
+    return hashlib.blake2s("|".join(parts).encode(),
+                           digest_size=16).hexdigest()
+
+
+class CompileCache:
+    """Process-wide registry of shared jit-wrapper namespaces.
+
+    ``namespace(key)`` returns the same dict for the same key, so the
+    second engine built at an identical (config, topology, geometry)
+    pulls the first engine's wrappers out instead of building fresh
+    ones — no re-trace, no re-compile, and XLA executables already live
+    on-device. Thread-safe; counters make reuse receipted."""
+
+    def __init__(self, metrics: Optional[MetricsRegistry] = None):
+        self._lock = threading.Lock()
+        self._spaces: Dict[str, Dict[str, Any]] = {}
+        self.metrics = metrics
+        self.hits = 0
+        self.misses = 0
+
+    def namespace(self, key: str) -> Dict[str, Any]:
+        with self._lock:
+            ns = self._spaces.get(key)
+            if ns is None:
+                ns = self._spaces[key] = {}
+                self.misses += 1
+                if self.metrics is not None:
+                    self.metrics.counter("aot.cache_misses")
+            else:
+                self.hits += 1
+                if self.metrics is not None:
+                    self.metrics.counter("aot.cache_hits")
+            return ns
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"namespaces": len(self._spaces),
+                    "hits": self.hits, "misses": self.misses}
+
+
+def arm_persistent_cache(cache_dir: str) -> bool:
+    """Point JAX's on-disk compilation cache at ``cache_dir`` so a
+    RESTARTED process also skips XLA compilation for shapes any prior
+    process on this host compiled. Best-effort: older jaxlibs without
+    the knob, or read-only volumes, degrade to a False return — never
+    a boot failure."""
+    try:
+        import jax
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # compile results for tiny models are cheap to recompute; cache
+        # everything so the bench's homogeneous-scale-up story holds at
+        # sim scale too (default threshold skips sub-second compiles)
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:
+            pass
+        return True
+    except Exception:
+        return False
+
+
+_shared: Optional[CompileCache] = None
+_shared_lock = threading.Lock()
+
+
+def shared_cache(metrics: Optional[MetricsRegistry] = None) -> CompileCache:
+    """The process singleton — every engine in one worker process wants
+    the same registry, or homogeneous replicas in-process miss."""
+    global _shared
+    with _shared_lock:
+        if _shared is None:
+            _shared = CompileCache(metrics=metrics)
+        return _shared
+
+
+def from_env(metrics: Optional[MetricsRegistry] = None
+             ) -> Optional[CompileCache]:
+    """Boot-path wiring: ``AOT_CACHE=0`` disables wrapper sharing
+    entirely; ``AOT_CACHE_DIR`` additionally arms the persistent
+    on-disk XLA cache. Returns the shared cache (or None when off)."""
+    if os.environ.get("AOT_CACHE", "1") in ("0", "false", "no"):
+        return None
+    cache_dir = os.environ.get("AOT_CACHE_DIR", "")
+    if cache_dir:
+        arm_persistent_cache(cache_dir)
+    return shared_cache(metrics=metrics)

@@ -29,19 +29,42 @@ its own newest; multi-process: the minimum of the members' newest,
 agreed via ``process_allgather``), then rebuilds each leaf with
 ``jax.make_array_from_single_device_arrays`` on the template's
 sharding.
+
+Round 14 (cold-start collapse) additions:
+
+* every shard file's blake2s digest rides in the manifest, so a
+  truncated or bit-flipped shard dies at restore
+  (:class:`CheckpointCorrupt`) instead of silently corrupting weights —
+  and so a shard fetched from a PEER (``models/weights.py``) verifies
+  end-to-end against the digest the saving process wrote;
+* ``restore_sharded`` streams: shard files are read concurrently a
+  bounded window ahead of consumption (``workers``, default
+  ``RESTORE_WORKERS``) and each shard is ``device_put`` as it lands —
+  no full-tree host staging on the scale-up path;
+* the byte source is pluggable (``reader`` + ``manifest``): the default
+  reads this process's step directory, the booting replica passes a
+  :class:`~dcos_commons_tpu.models.weights.PeerFetcher` to pull the
+  same files from an already-hot peer over HTTP.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
 import shutil
-from typing import Any, Dict, List, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 _STEP_RE = re.compile(r"step-(\d{8})-p(\d+)$")
+
+
+class CheckpointCorrupt(ValueError):
+    """A shard failed verification (digest mismatch or truncation) —
+    restore must abort rather than hand back silently wrong weights."""
 
 
 def _leaf_key(path) -> str:
@@ -110,12 +133,15 @@ def save_sharded(out_dir: str, step: int, tree: Any, keep: int = 3) -> str:
             seen.add(ikey)
             data = np.asarray(shard.data)
             fname = f"{key}.{ikey}.bin"
+            raw = data.tobytes()
             with open(os.path.join(tmp, fname), "wb") as f:
-                f.write(data.tobytes())
+                f.write(raw)
                 f.flush()
                 os.fsync(f.fileno())  # FilePersister-grade durability
             shards.append({"file": fname, "index": ikey,
-                           "local_shape": list(data.shape)})
+                           "local_shape": list(data.shape),
+                           "bytes": len(raw),
+                           "digest": hashlib.blake2s(raw).hexdigest()})
         leaves[key] = {"global_shape": list(arr.shape),
                        "dtype": str(arr.dtype), "shards": shards}
 
@@ -179,29 +205,157 @@ def latest_step(out_dir: str) -> Optional[int]:
     return max(common) if common else None
 
 
-def restore_sharded(out_dir: str, template: Any,
-                    step: Optional[int] = None) -> Any:
+def _verify_shard(meta: dict, raw: bytes, source: str) -> None:
+    """Hold shard bytes to the manifest's contract. ``bytes`` catches
+    truncation (a prune or a cut transfer) with a message that names the
+    file; ``digest`` catches corruption — including a peer that served
+    the wrong or a mangled shard."""
+    want = meta.get("bytes")
+    if want is not None and len(raw) != want:
+        raise CheckpointCorrupt(
+            f"shard {meta['file']!r} from {source}: truncated "
+            f"({len(raw)} bytes, manifest says {want})")
+    digest = meta.get("digest")
+    if digest is not None \
+            and hashlib.blake2s(raw).hexdigest() != digest:
+        raise CheckpointCorrupt(
+            f"shard {meta['file']!r} from {source}: digest mismatch "
+            "(corrupt shard)")
+
+
+class _ShardStream:
+    """Bounded-lookahead concurrent shard source: the files restore will
+    consume, read ``workers`` at a time a window ahead of the assembly
+    loop — shard-parallel I/O without staging the full tree on the host.
+    Falls back to synchronous reads for files outside the planned order
+    (the re-shard ``_assemble`` path)."""
+
+    def __init__(self, read_fn: Callable[[str], bytes],
+                 order: List[str], workers: int):
+        self._read = read_fn
+        self._pool = (ThreadPoolExecutor(max_workers=workers)
+                      if workers > 1 and len(order) > 1 else None)
+        self._futures: Dict[str, Any] = {}
+        self._queue = list(order)
+        self._fill()
+
+    def _fill(self) -> None:
+        if self._pool is None:
+            return
+        # keep ~2x the worker count in flight: enough to hide read
+        # latency, bounded so a huge checkpoint never fully stages
+        while self._queue and len(self._futures) < \
+                2 * self._pool._max_workers:
+            fname = self._queue.pop(0)
+            self._futures[fname] = self._pool.submit(self._read, fname)
+
+    def fetch(self, fname: str) -> bytes:
+        fut = self._futures.pop(fname, None)
+        if fname in self._queue:
+            self._queue.remove(fname)
+        self._fill()
+        return fut.result() if fut is not None else self._read(fname)
+
+    def close(self) -> None:
+        for fut in self._futures.values():
+            fut.cancel()
+        self._futures.clear()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+
+
+def _restore_workers(workers: Optional[int]) -> int:
+    if workers is not None:
+        return max(1, int(workers))
+    return max(1, int(os.environ.get("RESTORE_WORKERS", "4") or 4))
+
+
+def restore_sharded(out_dir: Optional[str], template: Any,
+                    step: Optional[int] = None, *,
+                    workers: Optional[int] = None,
+                    reader: Optional[Callable[[str], bytes]] = None,
+                    manifest: Optional[dict] = None) -> Any:
     """Rebuild a pytree bitwise from this process's shard files.
 
     ``template`` supplies structure, shapes, dtypes, and shardings —
     pass the freshly-initialized (already sharded) tree; its VALUES are
     discarded. Raises FileNotFoundError when no complete checkpoint
-    exists (callers fall through to a cold start).
+    exists (callers fall through to a cold start) and
+    :class:`CheckpointCorrupt` when a shard fails its digest or length
+    check.
+
+    ``workers`` (default ``RESTORE_WORKERS``, 4) reads shard files
+    concurrently, a bounded window ahead of device placement.
+    ``reader``/``manifest`` replace the local step directory as the byte
+    source — the peer-to-peer boot path passes a
+    ``models/weights.py`` :class:`PeerFetcher` here, and every fetched
+    shard still verifies against the saving process's digests.
     """
     import jax
 
-    if step is None:
-        step = latest_step(out_dir)
+    source = "disk"
+    if reader is None:
+        if out_dir is None:
+            raise ValueError("restore_sharded needs out_dir or a reader")
         if step is None:
-            raise FileNotFoundError(f"no complete checkpoint under "
-                                    f"{out_dir!r}")
-    pid = jax.process_index()
-    step_d = _step_dir(out_dir, step, pid)
-    with open(os.path.join(step_d, "manifest.json"),
-              encoding="utf-8") as f:
-        manifest = json.load(f)
+            step = latest_step(out_dir)
+            if step is None:
+                raise FileNotFoundError(f"no complete checkpoint under "
+                                        f"{out_dir!r}")
+        pid = jax.process_index()
+        step_d = _step_dir(out_dir, step, pid)
+
+        def reader(fname: str, _d=step_d) -> bytes:
+            try:
+                return _read(_d, fname)
+            except FileNotFoundError:
+                raise FileNotFoundError(
+                    f"checkpoint step {os.path.basename(_d)} pruned "
+                    f"under restore (shard {fname!r} vanished — a "
+                    "concurrent save_sharded keep-prune?)") from None
+        if manifest is None:
+            try:
+                manifest = json.loads(
+                    _read(step_d, "manifest.json").decode("utf-8"))
+            except FileNotFoundError:
+                raise FileNotFoundError(
+                    f"no manifest for step {step} under {out_dir!r}"
+                ) from None
+    else:
+        source = "peer"
+        if manifest is None:
+            manifest = json.loads(reader("manifest.json").decode("utf-8"))
+    step = manifest.get("step", step)
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    # plan the exact-match shard files in consumption order so the
+    # stream can read ahead; re-shard fallbacks read synchronously
+    by_meta: Dict[str, dict] = {}
+    order: List[str] = []
+    for path, leaf in flat:
+        entry = manifest["leaves"].get(_leaf_key(path))
+        if entry is None:
+            continue
+        for shard_meta in entry["shards"]:
+            if shard_meta["file"] not in by_meta:
+                by_meta[shard_meta["file"]] = shard_meta
+                if isinstance(leaf, jax.Array):
+                    order.append(shard_meta["file"])
+    stream = _ShardStream(reader, order, _restore_workers(workers))
+
+    def fetch(meta: dict) -> bytes:
+        raw = stream.fetch(meta["file"])
+        _verify_shard(meta, raw, source)
+        return raw
+
+    try:
+        return _restore_tree(jax, flat, treedef, manifest, step, fetch)
+    finally:
+        stream.close()
+
+
+def _restore_tree(jax, flat, treedef, manifest: dict, step,
+                  fetch: Callable[[dict], bytes]) -> Any:
     out_leaves = []
     for path, leaf in flat:
         key = _leaf_key(path)
@@ -223,7 +377,7 @@ def restore_sharded(out_dir: str, template: Any,
                     f"{entry['global_shape']}/{entry['dtype']} — restore "
                     "requires the same mesh/sharding/config")
             shard = entry["shards"][0]
-            raw = _read(step_d, shard["file"])
+            raw = fetch(shard)
             value = np.frombuffer(raw, dtype=dtype).reshape(
                 shard["local_shape"])
             out_leaves.append(dtype.type(value)
@@ -246,7 +400,7 @@ def restore_sharded(out_dir: str, template: Any,
                 for s, dim in zip(shard.index, leaf.shape)
             ] if shard.index else []
             if meta is not None and meta["local_shape"] == shard_shape:
-                raw = _read(step_d, meta["file"])
+                raw = fetch(meta)
                 value = np.frombuffer(raw, dtype=dtype).reshape(
                     meta["local_shape"])
             else:
@@ -255,7 +409,7 @@ def restore_sharded(out_dir: str, template: Any,
                 # out_shardings): assemble the saved region once, then
                 # slice the needed piece out of it
                 if assembled is None:
-                    assembled = _assemble(step_d, entry, dtype)
+                    assembled = _assemble(entry, dtype, fetch)
                 data, covered = assembled
                 idx = tuple(shard.index)
                 if not covered[idx].all():
@@ -270,7 +424,7 @@ def restore_sharded(out_dir: str, template: Any,
     return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
 
-def _assemble(step_dir: str, entry: dict, dtype):
+def _assemble(entry: dict, dtype, fetch: Callable[[dict], bytes]):
     """Paste a leaf's saved shards into one array covering their union.
 
     Saved shards tile disjoint index ranges; locally-saved files cover at
@@ -283,7 +437,7 @@ def _assemble(step_dir: str, entry: dict, dtype):
     out = np.zeros(entry["global_shape"], dtype=dtype)
     covered = np.zeros(entry["global_shape"], dtype=bool)
     for meta in entry["shards"]:
-        raw = _read(step_dir, meta["file"])
+        raw = fetch(meta)
         value = np.frombuffer(raw, dtype=dtype).reshape(meta["local_shape"])
         offsets = ([int(o) for o in meta["index"][1:].split("_")]
                    if len(meta["index"]) > 1 else

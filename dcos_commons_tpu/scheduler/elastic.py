@@ -39,11 +39,13 @@ from __future__ import annotations
 
 import logging
 import os
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..matching.agent_index import AgentIndex
 from ..specification.spec import with_pod_count
+from ..state.tasks import TaskState
 
 log = logging.getLogger(__name__)
 
@@ -179,6 +181,192 @@ class HysteresisController:
 
 
 # --------------------------------------------------------------------------
+# warm pool
+# --------------------------------------------------------------------------
+
+class WarmPool:
+    """Pods with weights resident and ZERO traffic — the one-tick
+    scale-up tier (Round 14 cold-start collapse).
+
+    The pool is the highest-indexed ``size`` instances of the pod tier:
+    pod count = serving + warm, and the serving set is always the prefix
+    ``[0, count - held)``. **Promotion is pure bookkeeping** — the
+    boundary moves down, the already-RUNNING pod starts taking traffic
+    the same tick; the config actuator (deploy plans, cold boots) is
+    touched only to *refill* the pool afterwards, off the serving path.
+    A demotion is the mirror image: a scale-down parks a serving pod in
+    the pool instead of killing it, so the next burst promotes it back
+    for free.
+
+    ``held`` is deliberately controller memory (like debounce streaks):
+    after a scheduler crash :meth:`rederive` rebuilds a conservative
+    split — everything above ``min_serving`` is assumed to still be the
+    pool, which at worst under-counts serving capacity for one
+    autoscaler reaction, never over-counts it.
+    """
+
+    def __init__(self, multi_fn: Callable[[], object], service_name: str,
+                 pod_type: str, size: int = 0, min_serving: int = 1,
+                 metrics=None):
+        if size < 0:
+            raise ValueError("size must be >= 0")
+        self._multi_fn = multi_fn
+        self.service_name = service_name
+        self.pod_type = pod_type
+        self.size = size
+        self.min_serving = max(0, min_serving)
+        self._warm = 0
+        self.promoted: List[str] = []   # receipts, newest last
+        self.demoted: List[str] = []
+        self.refills = 0
+        if metrics is not None and hasattr(metrics, "gauge"):
+            # the same numbers `tpuctl warm-pool` reads off /v1/metrics
+            metrics.gauge("autoscale.warm_pool.size",
+                          lambda: float(self.size))
+            metrics.gauge("autoscale.warm_pool.held",
+                          lambda: float(self._warm))
+            metrics.gauge("autoscale.warm_pool.ready",
+                          lambda: float(self.available()))
+            metrics.gauge("autoscale.warm_pool.reclaimable_chips",
+                          lambda: float(self.reclaimable_chips()))
+
+    def _service(self):
+        multi = self._multi_fn()
+        return None if multi is None else multi.get_service(self.service_name)
+
+    def _pod(self, sched):
+        for pod in sched.spec.pods:
+            if pod.type == self.pod_type:
+                return pod
+        return None
+
+    @property
+    def held(self) -> int:
+        """Instances currently parked in the pool."""
+        return self._warm
+
+    def warm_instances(self) -> List[str]:
+        sched = self._service()
+        if sched is None or self._warm == 0:
+            return []
+        pod = self._pod(sched)
+        if pod is None:
+            return []
+        lo = max(0, pod.count - self._warm)
+        return [f"{self.pod_type}-{i}" for i in range(lo, pod.count)]
+
+    def available(self) -> int:
+        """Warm instances whose task is observed RUNNING — only those
+        are promotable in one tick (a warm pod still deploying is a
+        cold boot in disguise)."""
+        sched = self._service()
+        warm = set(self.warm_instances())
+        if sched is None or not warm:
+            return 0
+        ready = set()
+        for task in sched.state.fetch_tasks():
+            if task.pod_instance_name not in warm:
+                continue
+            status = sched.state.fetch_status(task.task_name)
+            if (status is not None and status.task_id == task.task_id
+                    and status.state is TaskState.RUNNING):
+                ready.add(task.pod_instance_name)
+        return len(ready)
+
+    def reclaimable_chips(self) -> int:
+        """Chips the pool hands back in one tick when a burst promotes
+        it — the :class:`BackfillGate` nets these off the serving
+        reserve, so training backfill and the warm pool share chips
+        instead of fighting over a double-counted headroom."""
+        sched = self._service()
+        if sched is None:
+            return 0
+        pod = self._pod(sched)
+        if pod is None:
+            return 0
+        per_instance = sum(rs.tpus for rs in pod.resource_sets)
+        return int(per_instance) * self.available()
+
+    def promote(self, n: int) -> int:
+        """Move up to ``n`` ready warm pods into the serving set (the
+        boundary slides — no scheduler action at all); returns how many
+        were promoted."""
+        k = min(int(n), self.available())
+        if k <= 0:
+            return 0
+        names = self.warm_instances()[:k]
+        self._warm -= k
+        self.promoted.extend(names)
+        log.info("warm-pool %s/%s promoted %s (held %d)",
+                 self.service_name, self.pod_type, ",".join(names),
+                 self._warm)
+        return k
+
+    def demote(self, n: int) -> int:
+        """Park up to ``n`` serving pods in the pool (bounded by pool
+        room and ``min_serving``); returns how many were parked."""
+        sched = self._service()
+        if sched is None:
+            return 0
+        pod = self._pod(sched)
+        if pod is None:
+            return 0
+        room = self.size - self._warm
+        serving = pod.count - self._warm
+        k = max(0, min(int(n), room, serving - self.min_serving))
+        if k <= 0:
+            return 0
+        lo = pod.count - self._warm - k
+        names = [f"{self.pod_type}-{i}" for i in range(lo, lo + k)]
+        self._warm += k
+        self.demoted.extend(names)
+        log.info("warm-pool %s/%s parked %s (held %d)",
+                 self.service_name, self.pod_type, ",".join(names),
+                 self._warm)
+        return k
+
+    def deficit(self) -> int:
+        return max(0, self.size - self._warm)
+
+    def refill(self) -> int:
+        """Top the pool back up through the config actuator: the new
+        pods cold-boot INTO the pool, off the serving path, so a
+        promotion's replacement never blocks traffic. No-op when full;
+        returns the number of pods added."""
+        d = self.deficit()
+        if d == 0:
+            return 0
+        sched = self._service()
+        if sched is None:
+            return 0
+        pod = self._pod(sched)
+        if pod is None:
+            return 0
+        result = sched.update_config(with_pod_count(
+            sched.spec, self.pod_type, pod.count + d))
+        if not result.accepted:
+            log.warning("warm-pool refill %s/%s +%d rejected: %s",
+                        self.service_name, self.pod_type, d, result.errors)
+            return 0
+        multi = self._multi_fn()
+        if multi is not None:
+            multi.service_store.store(sched.spec)
+        self._warm += d
+        self.refills += 1
+        return d
+
+    def rederive(self) -> None:
+        """Post-crash: rebuild ``held`` from the persisted pod count —
+        everything above ``min_serving`` (capped at ``size``) is assumed
+        still parked. Under-counts serving for at most one autoscaler
+        reaction; never double-counts a pod as serving AND warm."""
+        sched = self._service()
+        pod = None if sched is None else self._pod(sched)
+        count = 0 if pod is None else pod.count
+        self._warm = max(0, min(self.size, count - self.min_serving))
+
+
+# --------------------------------------------------------------------------
 # autoscaler
 # --------------------------------------------------------------------------
 
@@ -198,13 +386,14 @@ class Autoscaler:
     def __init__(self, multi_fn: Callable[[], object], service_name: str,
                  config: AutoscalerConfig,
                  gauges_fn: Callable[[], dict],
-                 metrics=None):
+                 metrics=None, warm_pool: Optional[WarmPool] = None):
         self._multi_fn = multi_fn
         self.service_name = service_name
         self.config = config
         self.gauges_fn = gauges_fn
         self.controller = HysteresisController(config)
         self.metrics = metrics
+        self.warm_pool = warm_pool
         self.last_pressure: float = 0.0
         # (new_count, pressure) per resize, newest last — bench receipts
         self.events: List[Tuple[int, float]] = []
@@ -216,7 +405,9 @@ class Autoscaler:
     @property
     def target(self) -> Optional[int]:
         """The current target count — read from the *persisted* spec, so
-        it survives controller and scheduler crashes alike."""
+        it survives controller and scheduler crashes alike. With a warm
+        pool attached this is serving + warm (every pod the tier holds);
+        :attr:`serving_target` is the traffic-taking subset."""
         sched = self._service()
         if sched is None:
             return None
@@ -225,6 +416,18 @@ class Autoscaler:
                 return pod.count
         return None
 
+    @property
+    def serving_target(self) -> Optional[int]:
+        """Replicas actually taking traffic: the persisted pod count
+        minus the instances parked in the warm pool. This is the count
+        the hysteresis controller scales — min/max bounds apply to
+        serving capacity, not to the pool's parked pods."""
+        total = self.target
+        if total is None:
+            return None
+        pool = self.warm_pool
+        return total - pool.held if pool is not None else total
+
     def tick(self) -> Optional[int]:
         """One control step: sample pressure, feed the hysteresis
         controller, emit a config update when it proposes a resize.
@@ -232,7 +435,7 @@ class Autoscaler:
         sched = self._service()
         if sched is None:
             return None
-        current = self.target
+        current = self.serving_target
         if current is None:
             return None
         self.last_pressure = backpressure(self.gauges_fn(),
@@ -246,7 +449,7 @@ class Autoscaler:
         """Jump straight to a clamped target, bypassing debounce (chaos
         ``preempt_storm`` fault and operator override)."""
         sched = self._service()
-        current = self.target
+        current = self.serving_target
         if sched is None or current is None:
             return None
         count = max(self.config.min_count, min(self.config.max_count, count))
@@ -255,27 +458,53 @@ class Autoscaler:
         return self._resize(sched, current, count)
 
     def _resize(self, sched, current: int, proposed: int) -> Optional[int]:
-        result = sched.update_config(
-            with_pod_count(sched.spec, self.config.pod_type, proposed))
-        if not result.accepted:
-            log.warning("autoscale %s/%s %d -> %d rejected: %s",
-                        self.service_name, self.config.pod_type,
-                        current, proposed, result.errors)
-            return None
-        multi = self._multi_fn()
-        if multi is not None:
-            # the spec in the durable service registry must track the new
-            # target, or a restarted multi scheduler would re-mount the
-            # service at the stale count and silently undo the resize
-            multi.service_store.store(sched.spec)
+        pool = self.warm_pool
+        promoted = demoted = 0
+        delta = proposed - current
+        if pool is not None:
+            # the pool absorbs as much of the resize as it can: a
+            # promotion is pure bookkeeping (the pod is already RUNNING
+            # with weights resident — it takes traffic THIS tick), a
+            # demotion parks a serving pod instead of killing it
+            if delta > 0:
+                promoted = pool.promote(delta)
+            elif delta < 0:
+                demoted = pool.demote(-delta)
+        remainder = delta - promoted + demoted
+        if remainder != 0:
+            total = self.target
+            result = sched.update_config(with_pod_count(
+                sched.spec, self.config.pod_type, total + remainder))
+            if not result.accepted:
+                log.warning("autoscale %s/%s %d -> %d rejected: %s",
+                            self.service_name, self.config.pod_type,
+                            current, proposed, result.errors)
+                absorbed = current + promoted - demoted
+                if absorbed == current:
+                    return None
+                # the pool's share of the resize already took effect;
+                # record the partial move honestly
+                self.events.append((absorbed, self.last_pressure))
+                return absorbed
+            multi = self._multi_fn()
+            if multi is not None:
+                # the spec in the durable service registry must track the
+                # new target, or a restarted multi scheduler would
+                # re-mount the service at the stale count and silently
+                # undo the resize
+                multi.service_store.store(sched.spec)
+        if pool is not None and delta > 0:
+            # replace what the pool gave up — the refill cold-boots OFF
+            # the serving path, so the next burst promotes again
+            pool.refill()
         self.events.append((proposed, self.last_pressure))
         if self.metrics is not None:
             self.metrics.record_scale(
                 self.config.pod_type,
                 "up" if proposed > current else "down")
-        log.info("autoscale %s/%s: %d -> %d (pressure %.2f)",
-                 self.service_name, self.config.pod_type, current, proposed,
-                 self.last_pressure)
+        log.info("autoscale %s/%s: %d -> %d (pressure %.2f, promoted %d, "
+                 "parked %d)", self.service_name, self.config.pod_type,
+                 current, proposed, self.last_pressure, promoted, demoted)
         return proposed
 
 
@@ -358,14 +587,18 @@ def http_gauges(urls: Sequence[str],
 
 
 def autoscaler_from_env(scheduler, metrics=None,
-                        env: Optional[dict] = None
-                        ) -> Optional[Autoscaler]:
+                        env: Optional[dict] = None,
+                        registry=None) -> Optional[Autoscaler]:
     """Wire a live :class:`Autoscaler` for one scheduler from the
     ``AUTOSCALE_*`` env contract. Armed only when BOTH
     ``AUTOSCALE_POD_TYPE`` (the tier to resize) and
     ``AUTOSCALE_GAUGE_URLS`` (comma-separated decode frontend base URLs
     to poll) are set; returns None otherwise so mains stay inert by
-    default."""
+    default. ``WARM_POOL_SIZE > 0`` additionally attaches a
+    :class:`WarmPool` (``WARM_POOL_MIN_SERVING`` floors the serving
+    split after a crash); ``registry`` is the shared
+    :class:`~dcos_commons_tpu.metrics.MetricsRegistry` the pool's
+    gauges land in."""
     e = os.environ if env is None else env
     pod_type = (e.get("AUTOSCALE_POD_TYPE") or "").strip()
     urls = [u.strip() for u in (e.get("AUTOSCALE_GAUGE_URLS") or
@@ -373,9 +606,17 @@ def autoscaler_from_env(scheduler, metrics=None,
     if not pod_type or not urls:
         return None
     solo = SoloService(scheduler)
+    pool = None
+    size = int(float(e.get("WARM_POOL_SIZE") or 0))
+    if size > 0:
+        pool = WarmPool(lambda: solo, scheduler.spec.name, pod_type,
+                        size=size,
+                        min_serving=int(float(
+                            e.get("WARM_POOL_MIN_SERVING") or 1)),
+                        metrics=registry)
     return Autoscaler(lambda: solo, scheduler.spec.name,
                       AutoscalerConfig.from_env(pod_type, e),
-                      http_gauges(urls), metrics=metrics)
+                      http_gauges(urls), metrics=metrics, warm_pool=pool)
 
 
 # --------------------------------------------------------------------------
@@ -649,16 +890,57 @@ class BackfillGate:
     where ``pending`` is the chips the service's un-reserved instances
     need — so a training gang cannot eat through the serving headroom in
     a single cycle. Top-priority services are never gated (the reserve
-    exists *for* them)."""
+    exists *for* them).
+
+    Round 14 refinements:
+
+    * ``auto_reserve``: instead of a static count, the reserve tracks
+      the **rolling max of observed burst magnitude** — the largest
+      ``pending_expansion_chips`` the top-priority tier showed over the
+      last ``reserve_window`` ticks (fed via :meth:`observe`). Quiet
+      fleets release the headroom to backfill; a burst re-arms it for a
+      full window. ``reserve_chips`` remains the fallback until the
+      first observation lands.
+    * a :class:`WarmPool` offsets the reserve: its pods are
+      reclaimable-in-one-tick headroom already held by the serving
+      tier, so demanding the same chips *again* as idle would
+      double-reserve them.
+    """
 
     def __init__(self, multi_fn: Callable[[], object],
-                 reserve_chips: int = 0, metrics=None):
+                 reserve_chips: int = 0, metrics=None,
+                 warm_pool: Optional[WarmPool] = None,
+                 auto_reserve: bool = False, reserve_window: int = 8):
         if reserve_chips < 0:
             raise ValueError("reserve_chips must be >= 0")
+        if reserve_window < 1:
+            raise ValueError("reserve_window must be >= 1")
         self._multi_fn = multi_fn
         self.reserve_chips = reserve_chips
         self.metrics = metrics
+        self.warm_pool = warm_pool
+        self.auto_reserve = auto_reserve
+        self._pending_window: "deque[int]" = deque(maxlen=reserve_window)
         self.gated_count = 0
+
+    def observe(self, pending_chips: int) -> None:
+        """Feed one tick's top-priority pending-expansion footprint
+        (:class:`ElasticController` does this every tick) — the auto
+        reserve is the rolling max of these samples."""
+        self._pending_window.append(max(0, int(pending_chips)))
+
+    def current_reserve(self) -> int:
+        if self.auto_reserve and self._pending_window:
+            return max(self._pending_window)
+        return self.reserve_chips
+
+    def effective_reserve(self) -> int:
+        """The reserve the gate actually enforces: the (auto or static)
+        target net of the warm pool's one-tick-reclaimable chips."""
+        reserve = self.current_reserve()
+        if self.warm_pool is not None:
+            reserve -= self.warm_pool.reclaimable_chips()
+        return max(0, reserve)
 
     def idle_chips(self) -> int:
         """Chips free across the fleet net of every service's
@@ -691,7 +973,7 @@ class BackfillGate:
         pending = pending_expansion_chips(sched)
         if pending <= 0:
             return True  # CPU-only growth never touches the chip reserve
-        allowed = self.idle_chips() - pending >= self.reserve_chips
+        allowed = self.idle_chips() - pending >= self.effective_reserve()
         if not allowed:
             self.gated_count += 1
             if self.metrics is not None:
@@ -718,20 +1000,45 @@ class ElasticController:
         self.autoscalers = list(autoscalers)
         self.preemptor = preemptor
         self.backfill = backfill
-        self.rewire()
+        self.rewire(_initial=True)
 
-    def rewire(self) -> None:
+    def rewire(self, _initial: bool = False) -> None:
         """(Re)attach the backfill gate to the current multi scheduler —
         call after the scheduler process restarts (the gate hangs off the
-        multi instance, which a crash replaces)."""
+        multi instance, which a crash replaces). A restart also rebuilds
+        each warm pool's held count from the persisted pod counts (the
+        split is controller memory); the initial wiring skips that so a
+        fresh pool starts empty and fills through :meth:`WarmPool.refill`
+        off the serving path."""
         multi = self._multi_fn()
         if multi is not None and self.backfill is not None:
             multi.expand_gate = self.backfill.may_expand
+        if not _initial:
+            for scaler in self.autoscalers:
+                if scaler.warm_pool is not None:
+                    scaler.warm_pool.rederive()
+
+    def _top_pending(self, multi) -> int:
+        """``pending_expansion_chips`` of the top-priority service — the
+        burst-magnitude sample the auto reserve tracks."""
+        with multi._lock:
+            services = [multi.get_service(name)
+                        for name in multi.service_names()]
+        best = None
+        for sched in services:
+            if best is None or sched.spec.priority > best.spec.priority:
+                best = sched
+        return pending_expansion_chips(best) if best is not None else 0
 
     def tick(self, tick: int) -> int:
         for scaler in self.autoscalers:
+            pool = scaler.warm_pool
+            if pool is not None:
+                pool.refill()      # initial fill; heals promote crashes
             scaler.tick()
         multi = self._multi_fn()
+        if multi is not None and self.backfill is not None:
+            self.backfill.observe(self._top_pending(multi))
         actions = multi.run_cycle() if multi is not None else 0
         if self.preemptor is not None:
             self.preemptor.tick(tick)

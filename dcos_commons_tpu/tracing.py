@@ -33,7 +33,7 @@ import os
 import threading
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 TRACE_HEADER = "X-Tpu-Trace"
 

@@ -84,9 +84,13 @@ def main(argv=None) -> int:
 
     # live elastic loop: AUTOSCALE_POD_TYPE + AUTOSCALE_GAUGE_URLS arm a
     # back-pressure autoscaler fed by the decode frontends' /v1/healthz
-    # "load" gauges (ServingFrontend.load_gauges() over HTTP)
+    # "load" gauges (ServingFrontend.load_gauges() over HTTP). The shared
+    # registry also carries the WARM_POOL_SIZE tier's headroom gauges
+    # (autoscale.warm_pool.*) so `tpuctl warm-pool` reads them off
+    # /v1/metrics
     from dcos_commons_tpu.scheduler.elastic import autoscaler_from_env
-    autoscaler = autoscaler_from_env(scheduler, metrics=metrics)
+    autoscaler = autoscaler_from_env(scheduler, metrics=metrics,
+                                     registry=metrics)
     auto_stop = threading.Event()
     if autoscaler is not None:
         interval_s = float(os.environ.get("AUTOSCALE_INTERVAL_S", "5"))

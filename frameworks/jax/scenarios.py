@@ -74,6 +74,26 @@ DEFAULT_ENV: Mapping[str, str] = {
     # TENANT_CLASSES maps tenants onto the scheduler's priority:
     # integers with token-bucket admission —
     # name:priority:rate:burst[:ttft_slo_ms], comma-separated.
+    # cold-start collapse knobs (scheduler/elastic.py WarmPool +
+    # models/weights.py + parallel/aot.py): WARM_POOL_SIZE > 0 keeps that
+    # many weights-resident standby pods the autoscaler promotes in one
+    # tick (WARM_POOL_MIN_SERVING floors demotion-into-the-pool);
+    # AUTOSCALE_RESERVE_AUTO=1 sizes the BackfillGate reserve from the
+    # rolling max of pending expansion demand. WEIGHT_FETCH_PEERS points
+    # a booting replica at hot peers' /v1/weights endpoints (falls back
+    # to disk, loudly, on any fetch error); WEIGHT_SERVE_PORT makes the
+    # replica serve its own shards once up (0 = ephemeral port).
+    # AOT_CACHE=0 disables the in-process compile cache shared across
+    # homogeneous engine builds; AOT_CACHE_DIR additionally arms the
+    # persistent jax compilation cache at that path.
+    "WARM_POOL_SIZE": "0",
+    "WARM_POOL_MIN_SERVING": "1",
+    "AUTOSCALE_RESERVE_AUTO": "0",
+    "WEIGHT_FETCH_PEERS": "",
+    "WEIGHT_FETCH_TIMEOUT_S": "120",
+    "WEIGHT_SERVE_PORT": "",
+    "AOT_CACHE": "1",
+    "AOT_CACHE_DIR": "",
     "ROUTER_COUNT": "1",
     "ROUTE_REPLICAS": "",
     "ROUTE_POLICY": "affinity",

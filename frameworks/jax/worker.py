@@ -309,6 +309,18 @@ def run_llama(args) -> dict:
         else:
             params = llama.init_params(cfg, jax.random.key(0))
         params = llama.shard_params(params, mesh, cfg)
+    registry = None
+    boot_report = {"source": "init", "fetch_s": 0.0, "restore_s": 0.0}
+    if args.serve:
+        from dcos_commons_tpu.metrics import MetricsRegistry
+        registry = MetricsRegistry()
+        if args.quant == "none":
+            # int8 replicas keep their freshly-quantized init: QTensor
+            # trees are outside the sharded-checkpoint template contract
+            with mesh:
+                params, boot_report = _boot_serving_weights(args, params,
+                                                            registry)
+        _emit({"event": "weights_loaded", **boot_report})
     prompt = jnp.array([[1, 2, 3, 4]], dtype=jnp.int32)
     timed_decode(prompt)  # warmup/compile
     tokens_per_sec = timed_decode(prompt)
@@ -366,21 +378,52 @@ def run_llama(args) -> dict:
             # pool, and measures TTFT/TPOT per request. Heartbeats report
             # the ingress stats instead of draining synthetic bursts.
             from dcos_commons_tpu.models.ingress import ServingFrontend
+            t_compile = time.perf_counter()
             server, page_stats = _make_serving_engine(args, cfg, params,
                                                       mesh)
+            warmup = getattr(server, "warmup", None)
+            if warmup is not None:
+                # trace + compile the serving executables NOW (AOT) so
+                # the first admitted request never pays the trace; a
+                # homogeneous scale-up with AOT_CACHE reuses a hot
+                # sibling's wrappers and this is near-free
+                warmup()
+            compile_s = time.perf_counter() - t_compile
+            registry.observe("autoscale.cold_start.compile_seconds",
+                             compile_s)
+            weight_srv = _start_weight_server(args, params, registry)
             port = args.serve_port
             if port < 0:          # default: the reserved port, else any
                 port = int(os.environ.get("PORT_SERVE", "0"))
+            t_admit = time.perf_counter()
             frontend = ServingFrontend(server, port=port,
                                        max_queue=args.queue_limit,
-                                       decode_window=args.decode_window)
+                                       decode_window=args.decode_window,
+                                       metrics=registry)
             frontend.start()
             # re-stamp the readiness marker now that the ingress is
             # actually listening (the yml readiness probe hits healthz)
             with open("serving.ready", "w") as f:
                 f.write(f"ok {frontend.port}\n")
+            admit_s = time.perf_counter() - t_admit
+            registry.observe("autoscale.cold_start.admit_seconds",
+                             admit_s)
+            cold_start_s = (boot_report["fetch_s"]
+                            + boot_report["restore_s"]
+                            + compile_s + admit_s)
+            registry.observe("autoscale.cold_start_seconds",
+                             cold_start_s)
             _emit({"event": "serving", "slots": args.slots,
                    "port": frontend.port,
+                   "cold_start": {
+                       "total_s": round(cold_start_s, 4),
+                       "source": boot_report["source"],
+                       "fetch_s": boot_report["fetch_s"],
+                       "restore_s": boot_report["restore_s"],
+                       "compile_s": round(compile_s, 4),
+                       "admit_s": round(admit_s, 4)},
+                   **({"weights_port": weight_srv.port}
+                      if weight_srv else {}),
                    **({"paged": page_stats} if page_stats else {}),
                    **result})
             i = 0
@@ -424,6 +467,97 @@ def run_llama(args) -> dict:
     return result
 
 
+def _boot_serving_weights(args, template, registry=None):
+    """Round 14 boot path: resolve serving weights from, in order, a hot
+    sibling's ``WeightServer`` (``WEIGHT_FETCH_PEERS``), the local
+    sharded checkpoint (``--out``), or the freshly-initialized template.
+    Degrade, never crash: any fetch or restore failure falls through to
+    the next source with a loud event. Phase costs land in the shared
+    registry as ``autoscale.cold_start.{fetch,restore}_seconds`` and in
+    the returned report, so the frontend's ``/v1/metrics/prometheus``
+    carries the replica's own boot breakdown.
+
+    When ``--out`` is set, a peer boot mirrors the fetched step into the
+    local checkpoint dir first (committed via the dot-tmp + rename
+    protocol) — the freshly-booted replica immediately serves its OWN
+    siblings and restarts from disk next time."""
+    from dcos_commons_tpu.parallel import checkpoint as ckpt
+
+    report = {"source": "init", "fetch_s": 0.0, "restore_s": 0.0}
+
+    def obs(phase, dt):
+        report[f"{phase}_s"] = round(dt, 4)
+        if registry is not None:
+            registry.observe(f"autoscale.cold_start.{phase}_seconds", dt)
+
+    peers = [p.strip() for p in
+             os.environ.get("WEIGHT_FETCH_PEERS", "").split(",")
+             if p.strip()]
+    timeout_s = float(os.environ.get("WEIGHT_FETCH_TIMEOUT_S") or 120.0)
+    if peers:
+        from dcos_commons_tpu.models import weights as weights_mod
+        try:
+            if args.out:
+                t0 = time.perf_counter()
+                step = weights_mod.mirror_from_peers(
+                    peers, args.out, timeout_s=timeout_s)
+                obs("fetch", time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                params = ckpt.restore_sharded(args.out, template, step)
+                obs("restore", time.perf_counter() - t0)
+            else:
+                t0 = time.perf_counter()
+                params = weights_mod.restore_from_peers(
+                    peers, template, timeout_s=timeout_s,
+                    metrics=registry)
+                obs("restore", time.perf_counter() - t0)
+            report["source"] = "peer"
+            return params, report
+        except (weights_mod.WeightFetchError, ckpt.CheckpointCorrupt,
+                OSError) as e:
+            _emit({"event": "weight_fetch_fallback", "error": str(e),
+                   "peers": peers})
+    step = ckpt.latest_step(args.out) if args.out else None
+    if step is not None:
+        try:
+            t0 = time.perf_counter()
+            params = ckpt.restore_sharded(args.out, template, step)
+            obs("restore", time.perf_counter() - t0)
+            report["source"] = "disk"
+            report["step"] = step
+            return params, report
+        except (FileNotFoundError, ckpt.CheckpointCorrupt) as e:
+            _emit({"event": "weight_restore_fallback", "error": str(e),
+                   "step": step})
+    return template, report
+
+
+def _start_weight_server(args, params, registry=None):
+    """Expose this replica's checkpoint shards to booting siblings
+    (``WEIGHT_SERVE_PORT``/``PORT_WEIGHTS`` + ``--out``). An
+    init-booted replica seeds its dir first so the tier's FIRST replica
+    is already a valid peer for the second. Failure is an event, not a
+    crash — weight serving is an accelerant, never a liveness
+    dependency."""
+    port = (os.environ.get("WEIGHT_SERVE_PORT")
+            or os.environ.get("PORT_WEIGHTS"))
+    if not args.out or port is None:
+        return None
+    from dcos_commons_tpu.models import weights as weights_mod
+    from dcos_commons_tpu.parallel import checkpoint as ckpt
+    try:
+        if ckpt.latest_step(args.out) is None:
+            ckpt.save_sharded(args.out, 0, params)
+        server = weights_mod.WeightServer(args.out, port=int(port),
+                                          metrics=registry).start()
+        _emit({"event": "weight_server", "port": server.port,
+               "steps": server.steps()})
+        return server
+    except (OSError, ValueError) as e:
+        _emit({"event": "weight_server_error", "error": str(e)})
+        return None
+
+
 def _make_serving_engine(args, cfg, params, mesh, key=None):
     """SlotServer or PagedServer per ``--pages``, degrade-not-crash.
 
@@ -432,8 +566,15 @@ def _make_serving_engine(args, cfg, params, mesh, key=None):
     to the monolithic slot engine with a loud ``paged_fallback`` event —
     a serving replica must come up serving, not crash-loop on a knob.
     The decision is pure config, so every gang rank makes the same one.
+
+    ``AOT_CACHE`` (on by default) shares one process-wide compile cache
+    across paged engines: a homogeneous scale-up (same config, same
+    topology) reuses the hot engine's jit wrappers instead of
+    re-tracing; ``AOT_CACHE_DIR`` additionally arms jax's persistent
+    compilation cache across process boots.
     """
     from dcos_commons_tpu.models.serving import PagedServer, SlotServer
+    from dcos_commons_tpu.parallel import aot
     kw = {"mesh": mesh if mesh.size > 1 else None}
     if key is not None:
         kw["key"] = key
@@ -443,7 +584,8 @@ def _make_serving_engine(args, cfg, params, mesh, key=None):
                 cfg, params, slots=args.slots,
                 pages=None if args.pages < 0 else args.pages,
                 page_size=args.page_size,
-                prefill_chunk=args.prefill_chunk, **kw)
+                prefill_chunk=args.prefill_chunk,
+                compile_cache=aot.from_env(), **kw)
             return engine, engine.page_stats()
         except ValueError as e:
             _emit({"event": "paged_fallback", "error": str(e),

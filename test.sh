@@ -17,6 +17,9 @@ python -m tools.chaos_smoke --budget-s "${CHAOS_SMOKE_BUDGET_S:-60}"
 echo "== autoscale smoke (elastic control loop under chaos, time-capped) =="
 python -m tools.autoscale_smoke --budget-s "${AUTOSCALE_SMOKE_BUDGET_S:-60}"
 
+echo "== coldstart smoke (disk vs peer vs warm boot token parity, time-capped) =="
+python -m tools.coldstart_smoke --budget-s "${COLDSTART_SMOKE_BUDGET_S:-90}"
+
 echo "== serving smoke (paged vs slot parity + two-process disagg, time-capped) =="
 python -m tools.serving_smoke --budget-s "${SERVING_SMOKE_BUDGET_S:-120}"
 

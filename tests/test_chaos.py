@@ -35,10 +35,17 @@ def _entry_id(entry) -> str:
     return f"{prefix}{entry['faults']}-seed{entry['seed']}"
 
 
+def _run_elastic_warm(seed, ticks=40, config=None):
+    return run_elastic_soak(seed, ticks=ticks, config=config, warm_pool=1)
+
+
 # harness key in a corpus entry routes it to the matching soak: the legacy
-# single-service storm or the two-service elastic storm (autoscaler +
-# preemptor + backfill active)
-HARNESSES = {"": run_soak, "elastic": run_elastic_soak}
+# single-service storm, the two-service elastic storm (autoscaler +
+# preemptor + backfill active), or the warm-pool variant (Round 14: a
+# one-pod warm tier rides the serve service, the cold-start fault classes
+# have live targets, and invariant 12 audits headroom-vs-capacity)
+HARNESSES = {"": run_soak, "elastic": run_elastic_soak,
+             "elastic_warm": _run_elastic_warm}
 
 
 @pytest.mark.parametrize("entry", CORPUS, ids=_entry_id)
@@ -92,6 +99,43 @@ def test_elastic_soak_deterministic():
     b = run_elastic_soak(3, ticks=20)
     assert a.to_dict() == b.to_dict()
     assert a.trace == b.trace
+
+
+def test_warm_pool_soak_deterministic():
+    """Warm-pool storms replay exactly too: promotions, demotions, boot
+    sources, and the cold-start fault classes all ride derived RNGs.
+    Task ids are process-random uuid4s (transport-level identity, not
+    schedule state), so the trace is compared with ids scrubbed."""
+    import re
+    uid = re.compile(r"[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}"
+                     r"-[0-9a-f]{4}-[0-9a-f]{12}")
+    a = _run_elastic_warm(3, ticks=20)
+    b = _run_elastic_warm(3, ticks=20)
+    assert a.to_dict() == b.to_dict()
+    assert [uid.sub("<id>", l) for l in a.trace] \
+        == [uid.sub("<id>", l) for l in b.trace]
+
+
+def test_warm_faults_do_not_perturb_unarmed_seeds():
+    """Arming the Round 14 fault classes against a harness with no warm
+    pool must not perturb a pinned schedule: the classes draw only from
+    the boot simulator's derived RNG, so the scheduler-facing weather is
+    identical and the only delta is weight_fetch_lost bookkeeping."""
+    import dataclasses
+    armed = FaultConfig.all_faults()
+    bare = dataclasses.replace(armed, warm_promote_crash=0.0,
+                               weight_fetch_lost=0.0)
+    a = run_elastic_soak(7, ticks=20, config=bare)
+    b = run_elastic_soak(7, ticks=20, config=armed)
+    assert a.converged and b.converged
+    assert not a.violations and not b.violations
+    assert a.plan_statuses == b.plan_statuses
+    # no pool -> no promote victims, ever
+    assert "warm_promote_crash" not in b.fault_counts
+
+    def strip(fc):
+        return {k: v for k, v in fc.items() if k != "weight_fetch_lost"}
+    assert strip(a.fault_counts) == strip(b.fault_counts)
 
 
 @pytest.mark.slow

@@ -58,6 +58,48 @@ def test_describe_config_state_health(server, capsys):
     assert run_cli(base, "health", capsys=capsys)["healthy"]
 
 
+@pytest.fixture()
+def metrics_server():
+    from dcos_commons_tpu.metrics import MetricsRegistry
+
+    sched = make_scheduler()
+    sched.run_until_quiet()
+    reg = MetricsRegistry()
+    srv = ApiServer(sched, port=0, cluster=sched.cluster, metrics=reg)
+    srv.start()
+    yield reg, f"http://127.0.0.1:{srv.port}"
+    srv.stop()
+    reg.close()
+
+
+@pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="CLI transport needs the cryptography package")
+def test_warm_pool_command(metrics_server, capsys):
+    """`tpuctl warm-pool` reads the pool gauges + cold-start timers the
+    autoscaler publishes into the shared registry (Round 14)."""
+    reg, base = metrics_server
+    reg.gauge("autoscale.warm_pool.size", lambda: 1.0)
+    reg.gauge("autoscale.warm_pool.held", lambda: 1.0)
+    reg.gauge("autoscale.warm_pool.ready", lambda: 1.0)
+    reg.gauge("autoscale.warm_pool.reclaimable_chips", lambda: 4.0)
+    reg.observe("autoscale.cold_start_seconds", 0.02)
+    out = run_cli(base, "warm-pool", capsys=capsys)
+    assert out["warm_pool"] == {"size": 1.0, "held": 1.0, "ready": 1.0,
+                                "reclaimable_chips": 4.0}
+    assert out["cold_start"]["autoscale.cold_start_seconds"]["count"] == 1
+
+
+@pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="CLI transport needs the cryptography package")
+def test_warm_pool_command_unconfigured(metrics_server, capsys):
+    _, base = metrics_server
+    out = run_cli(base, "warm-pool", capsys=capsys)
+    assert out["warm_pool"] is None
+    assert "WARM_POOL_SIZE" in out["note"]
+
+
 def test_cli_unreachable():
     assert main(["--url", "http://127.0.0.1:1", "plan", "list"]) == 2
 

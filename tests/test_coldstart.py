@@ -1,0 +1,290 @@
+"""Cold-start collapse tests (Round 14).
+
+The load path the autoscaler's one-tick promise rides on: sharded
+restore must die loudly on every corruption edge (truncation, bit
+flips, a keep-prune racing the restore), the peer-to-peer weight plane
+(models/weights.py) must verify end-to-end and rotate off a bad peer,
+and the AOT compile cache (parallel/aot.py) must hand the second
+homogeneous engine the first engine's jit wrappers. The full
+phase-timed ladder is receipted by ``tools/bench_autoscale.py --mode
+coldstart``; these are the unit edges.
+"""
+
+import json
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcos_commons_tpu.models import weights
+from dcos_commons_tpu.parallel import aot
+from dcos_commons_tpu.parallel import checkpoint as ckpt
+
+
+def _tree(key=0):
+    k1, k2 = jax.random.split(jax.random.key(key))
+    return {"w": jax.random.normal(k1, (8, 8), jnp.float32),
+            "b": jax.random.normal(k2, (16,), jnp.float32)}
+
+
+def _template(tree):
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def _assert_bitwise(a, b):
+    flat_a = jax.tree_util.tree_flatten_with_path(a)[0]
+    flat_b = jax.tree_util.tree_flatten_with_path(b)[0]
+    assert len(flat_a) == len(flat_b)
+    for (path, la), (_, lb) in zip(flat_a, flat_b):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), path
+
+
+def _shard_files(step_dir):
+    manifest = json.loads((step_dir / "manifest.json").read_text())
+    return sorted(s["file"] for e in manifest["leaves"].values()
+                  for s in e["shards"])
+
+
+def _flip_byte(path, offset=-1):
+    raw = bytearray(path.read_bytes())
+    raw[offset] ^= 0xFF
+    path.write_bytes(bytes(raw))
+
+
+# ----------------------------------------------- restore failure edges
+
+class TestRestoreFailureEdges:
+    def test_truncated_shard_is_checkpoint_corrupt(self, tmp_path):
+        tree = _tree()
+        ckpt.save_sharded(str(tmp_path), 1, tree)
+        step = tmp_path / "step-00000001-p0"
+        fname = _shard_files(step)[0]
+        raw = (step / fname).read_bytes()
+        (step / fname).write_bytes(raw[:-4])
+        with pytest.raises(ckpt.CheckpointCorrupt, match="truncated"):
+            ckpt.restore_sharded(str(tmp_path), _template(tree))
+
+    def test_bitflipped_shard_is_checkpoint_corrupt(self, tmp_path):
+        tree = _tree()
+        ckpt.save_sharded(str(tmp_path), 1, tree)
+        step = tmp_path / "step-00000001-p0"
+        _flip_byte(step / _shard_files(step)[0])
+        with pytest.raises(ckpt.CheckpointCorrupt,
+                           match="digest mismatch"):
+            ckpt.restore_sharded(str(tmp_path), _template(tree))
+
+    def test_concurrent_keep_prune_names_the_race(self, tmp_path,
+                                                  monkeypatch):
+        """A ``save_sharded`` keep-prune that wins the race mid-restore
+        must surface as a FileNotFoundError naming the vanished shard
+        and the prune, never as a silent partial tree or a raw OSError
+        from deep inside numpy."""
+        tree = _tree()
+        out = str(tmp_path)
+        ckpt.save_sharded(out, 1, tree)
+        real_read = ckpt._read
+        fired = []
+
+        def racing_read(step_dir, fname):
+            raw = real_read(step_dir, fname)
+            if fname != "manifest.json" and not fired:
+                fired.append(fname)
+                # the interleave: first shard lands, then a concurrent
+                # save's keep-prune deletes the step being restored
+                ckpt.save_sharded(out, 2, tree, keep=1)
+            return raw
+
+        monkeypatch.setattr(ckpt, "_read", racing_read)
+        with pytest.raises(FileNotFoundError,
+                           match="pruned under restore"):
+            ckpt.restore_sharded(out, _template(tree), step=1, workers=1)
+        assert fired, "racing reader never engaged"
+
+
+# ------------------------------------------------------ the wire frame
+
+class TestWireFrames:
+    def test_round_trip(self):
+        frame = weights.pack_frame({"step": 3, "file": "w.o0.bin"},
+                                   b"payload")
+        meta, body = weights.unpack_frame(frame)
+        assert (meta["step"], meta["file"]) == (3, "w.o0.bin")
+        assert body == b"payload"
+
+    def test_bad_magic(self):
+        with pytest.raises(weights.WeightFetchError, match="bad magic"):
+            weights.unpack_frame(b"NOTAFRAME")
+
+    def test_truncated_body(self):
+        frame = weights.pack_frame({"file": "x"}, b"0123456789")
+        with pytest.raises(weights.WeightFetchError,
+                           match="truncated body"):
+            weights.unpack_frame(frame[:-3])
+
+    def test_flipped_body_byte(self):
+        frame = bytearray(weights.pack_frame({"file": "x"}, b"0123456789"))
+        frame[-1] ^= 0xFF
+        with pytest.raises(weights.WeightFetchError,
+                           match="digest mismatch"):
+            weights.unpack_frame(bytes(frame))
+
+    def test_wrong_wire_version(self):
+        hdr = json.dumps({"version": 99, "body_digest": "", "body_bytes": 0}
+                         ).encode()
+        frame = weights._MAGIC + struct.pack("<I", len(hdr)) + hdr
+        with pytest.raises(weights.WeightFetchError, match="version"):
+            weights.unpack_frame(frame)
+
+
+# -------------------------------------------------- peer weight plane
+
+def _serve_dir(tmp_path, name, tree, corrupt_all=False):
+    d = tmp_path / name
+    ckpt.save_sharded(str(d), 1, tree)
+    if corrupt_all:
+        step = d / "step-00000001-p0"
+        for fname in _shard_files(step):
+            _flip_byte(step / fname)
+    return d
+
+
+class TestPeerFetch:
+    def test_peer_restore_bitwise(self, tmp_path):
+        tree = _tree()
+        d = _serve_dir(tmp_path, "src", tree)
+        srv = weights.WeightServer(str(d), port=0, host="127.0.0.1").start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            fetcher = weights.PeerFetcher([url])
+            got = weights.restore_from_peers([url], _template(tree),
+                                             fetcher=fetcher)
+            _assert_bitwise(got, tree)
+            stats = fetcher.stats()
+            assert stats["shards_fetched"] == len(
+                _shard_files(d / "step-00000001-p0"))
+            assert stats["bytes_fetched"] > 0
+            assert stats["step"] == 1
+        finally:
+            srv.stop()
+
+    def test_manifest_digest_mismatch_is_fetch_error(self, tmp_path):
+        """A peer whose frame is self-consistent but whose shard bytes
+        do not match the SAVING process's manifest digest must be
+        rejected end-to-end — with one peer, the whole fetch dies as
+        WeightFetchError (the worker then falls back to disk)."""
+        tree = _tree()
+        d = _serve_dir(tmp_path, "bad", tree, corrupt_all=True)
+        srv = weights.WeightServer(str(d), port=0, host="127.0.0.1").start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            fetcher = weights.PeerFetcher([url], health_recheck_s=60.0)
+            with pytest.raises(weights.WeightFetchError,
+                               match="manifest digest"):
+                weights.restore_from_peers([url], _template(tree),
+                                           fetcher=fetcher)
+        finally:
+            srv.stop()
+
+    def test_corrupt_peer_rotates_to_healthy_sibling(self, tmp_path):
+        """Round-robin + retry: with one corrupt and one healthy peer
+        the restore still lands bitwise, the bad peer is marked down,
+        and the retry is counted."""
+        tree = _tree()
+        bad = _serve_dir(tmp_path, "bad", tree, corrupt_all=True)
+        good = _serve_dir(tmp_path, "good", tree)
+        srv_bad = weights.WeightServer(str(bad), port=0,
+                                       host="127.0.0.1").start()
+        srv_good = weights.WeightServer(str(good), port=0,
+                                        host="127.0.0.1").start()
+        try:
+            urls = [f"http://127.0.0.1:{srv_bad.port}",
+                    f"http://127.0.0.1:{srv_good.port}"]
+            fetcher = weights.PeerFetcher(urls, health_recheck_s=60.0)
+            got = weights.restore_from_peers(urls, _template(tree),
+                                             fetcher=fetcher)
+            _assert_bitwise(got, tree)
+            stats = fetcher.stats()
+            assert stats["retries"] >= 1
+            assert urls[0] in stats["peers_down"]
+        finally:
+            srv_bad.stop()
+            srv_good.stop()
+
+    def test_no_peers_is_fetch_error(self):
+        with pytest.raises(weights.WeightFetchError, match="no weight"):
+            weights.restore_from_peers([], _template(_tree()))
+
+    def test_mirror_lands_committed_step(self, tmp_path):
+        """mirror_from_peers commits a local step directory (dot-tmp +
+        rename) the new replica can itself restore from — and serve to
+        the NEXT booting sibling."""
+        tree = _tree()
+        d = _serve_dir(tmp_path, "src", tree)
+        srv = weights.WeightServer(str(d), port=0, host="127.0.0.1").start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            dst = tmp_path / "mirror"
+            dst.mkdir()
+            step = weights.mirror_from_peers([url], str(dst))
+        finally:
+            srv.stop()
+        assert step == 1
+        assert ckpt.latest_step(str(dst)) == 1
+        _assert_bitwise(ckpt.restore_sharded(str(dst), _template(tree)),
+                        tree)
+
+
+# ---------------------------------------------------- AOT compile cache
+
+class TestAotCache:
+    def test_engine_key_stability(self):
+        cfg = {"dim": 4, "vocab": 7}
+        k = aot.engine_key(cfg, None, pages=8, page_size=64)
+        # key ordering is canonicalized on both the config and the extras
+        assert aot.engine_key({"vocab": 7, "dim": 4}, None,
+                              page_size=64, pages=8) == k
+        assert aot.engine_key(cfg, None, pages=16, page_size=64) != k
+        assert aot.engine_key({"dim": 5, "vocab": 7}, None,
+                              pages=8, page_size=64) != k
+
+    def test_namespace_reuse_is_counted(self):
+        cache = aot.CompileCache()
+        ns = cache.namespace("k")
+        ns["step"] = object()
+        assert cache.namespace("k") is ns
+        assert cache.stats() == {"namespaces": 1, "hits": 1, "misses": 1}
+        cache.namespace("other")
+        assert cache.stats() == {"namespaces": 2, "hits": 1, "misses": 2}
+
+    def test_from_env_gate(self, monkeypatch):
+        monkeypatch.delenv("AOT_CACHE_DIR", raising=False)
+        monkeypatch.setenv("AOT_CACHE", "0")
+        assert aot.from_env() is None
+        monkeypatch.setenv("AOT_CACHE", "1")
+        a = aot.from_env()
+        assert isinstance(a, aot.CompileCache)
+        assert aot.from_env() is a   # process singleton
+
+    def test_homogeneous_engines_share_wrappers(self):
+        """The scale-up contract: a second engine at the same (config,
+        topology, geometry) — booted from the same checkpoint restore
+        path a real replica uses — hits the cache and serves identical
+        tokens."""
+        from dcos_commons_tpu.models import llama, serving
+
+        cfg = llama.LlamaConfig.tiny(n_layers=1, max_seq=64,
+                                     attn_impl="dense")
+        params = llama.init_params(cfg, jax.random.key(0))
+        kw = dict(slots=2, page_size=16, prefill_chunk=8)
+        reqs = [{"prompt": [5, 7, 11, 13], "max_new": 6, "request_id": 0}]
+        cache = aot.CompileCache()
+        first = serving.PagedServer(cfg, params, compile_cache=cache,
+                                    **kw)
+        want = first.drain([dict(r) for r in reqs])
+        assert cache.stats()["misses"] >= 1
+        second = serving.PagedServer(cfg, params, compile_cache=cache,
+                                     **kw)
+        assert cache.stats()["hits"] >= 1
+        assert second.drain([dict(r) for r in reqs]) == want

@@ -16,6 +16,7 @@ from dcos_commons_tpu.metrics import MetricsRegistry
 from dcos_commons_tpu.models.ingress import ServingFrontend
 from dcos_commons_tpu.plan import Status
 from dcos_commons_tpu.scheduler.elastic import (AutoscalerConfig,
+                                                BackfillGate,
                                                 HysteresisController,
                                                 backpressure,
                                                 pending_expansion_chips)
@@ -345,6 +346,109 @@ class TestBackfillGate:
         assert counters["elastic.preemptions"] == 1
         assert counters["elastic.preempted_pods"] == 2
         assert counters.get("elastic.backfill_gated", 0) >= 1
+
+
+# -------------------------------------------------- warm pool (Round 14)
+
+class TestWarmPool:
+    def test_pool_fills_off_the_serving_path(self):
+        """WARM_POOL_SIZE=1: the tier converges at serving + warm, the
+        pool pod is RUNNING with zero traffic, and the autoscaler's
+        bounds apply to the serving subset only."""
+        soak = quiet_soak(warm_pool=1)
+        settle(soak, ticks=60,
+               until=lambda: soak.warmpool.available() == 1)
+        pool = soak.warmpool
+        assert pool.held == 1
+        assert soak.autoscaler.target == 2          # serving 1 + warm 1
+        assert soak.autoscaler.serving_target == 1
+        assert pool.warm_instances() == ["decode-1"]
+        assert pool.reclaimable_chips() == 4        # one 4-chip replica
+
+    def test_promotion_is_one_tick_bookkeeping(self):
+        """A burst promotes the warm pod the same tick the controller
+        proposes the grow — the replica is ALREADY RUNNING, no deploy
+        plan on the serving path; the refill that replaces it cold-boots
+        off-path (so the new warm slot is not 'available' until its pod
+        reports RUNNING)."""
+        soak = quiet_soak(warm_pool=1)
+        settle(soak, ticks=60,
+               until=lambda: soak.warmpool.available() == 1)
+        soak.load.burst(soak._t, 60)
+        settle(soak, ticks=30,
+               until=lambda: soak.autoscaler.serving_target == 2)
+        pool = soak.warmpool
+        assert pool.promoted == ["decode-1"]
+        # the promoted replica was serving the tick the boundary moved
+        assert soak._decode_running() >= 2
+        # refill already re-booked the slot, but a deploying pod is a
+        # cold boot in disguise: not promotable until RUNNING
+        assert pool.held == 1
+        assert pool.available() == 0
+
+    def test_promote_demote_boundary_arithmetic(self):
+        """Promotion/demotion slide the serving/warm boundary without
+        touching the config actuator, bounded by pool room and the
+        min_serving floor."""
+        soak = quiet_soak(warm_pool=1)
+        settle(soak, ticks=60,
+               until=lambda: soak.warmpool.available() == 1)
+        pool = soak.warmpool
+        assert pool.demote(1) == 0     # pool full: nowhere to park
+        assert pool.promote(1) == 1    # bookkeeping only
+        assert pool.held == 0 and pool.deficit() == 1
+        assert pool.demote(1) == 1     # the mirror image: park it back
+        assert pool.held == 1
+        assert pool.promote(0) == 0
+
+    def test_rederive_after_scheduler_crash(self):
+        """The serving/warm split is controller memory: after a crash
+        the rewired controller rebuilds a conservative split from the
+        persisted pod count (never over-counting serving)."""
+        soak = quiet_soak(warm_pool=1)
+        settle(soak, ticks=60,
+               until=lambda: soak.warmpool.available() == 1)
+        soak._restart()
+        assert soak.warmpool.held == 1   # count 2 - min_serving 1
+
+
+# --------------------------------------------- auto reserve (Round 14)
+
+class _StubPool:
+    def __init__(self, chips):
+        self._chips = chips
+
+    def reclaimable_chips(self):
+        return self._chips
+
+
+class TestBackfillAutoReserve:
+    def test_rolling_max_replaces_static_reserve(self):
+        gate = BackfillGate(lambda: None, reserve_chips=8,
+                            auto_reserve=True, reserve_window=3)
+        assert gate.current_reserve() == 8   # fallback pre-observation
+        gate.observe(4)
+        gate.observe(16)
+        gate.observe(2)
+        assert gate.current_reserve() == 16
+        gate.observe(1)
+        gate.observe(1)                      # 16 rolls out of the window
+        assert gate.current_reserve() == 2
+
+    def test_static_reserve_when_auto_off(self):
+        gate = BackfillGate(lambda: None, reserve_chips=5)
+        gate.observe(99)
+        assert gate.current_reserve() == 5
+
+    def test_warm_pool_offsets_the_reserve(self):
+        """The pool's one-tick-reclaimable chips are headroom the
+        serving tier already holds — demanding them again as idle would
+        double-reserve."""
+        gate = BackfillGate(lambda: None, reserve_chips=10,
+                            warm_pool=_StubPool(6))
+        assert gate.effective_reserve() == 4
+        gate.warm_pool = _StubPool(50)
+        assert gate.effective_reserve() == 0   # clamped, never negative
 
 
 # ------------------------------------------- rolling-window load gauges

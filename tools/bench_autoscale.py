@@ -1,7 +1,8 @@
-"""Elasticity A/B bench: autoscaled decode tier vs a static fleet.
+"""Elasticity A/B bench: autoscaled decode tier vs a static fleet, plus
+the Round 14 cold-start collapse ladder.
 
-Drives the elastic soak harness directly — chaos weather OFF, a scripted
-Poisson-ish load swing ON — twice per seed:
+**Elastic mode** drives the elastic soak harness directly — chaos
+weather OFF, a scripted Poisson-ish load swing ON — twice per seed:
 
 * ``autoscaled``: the back-pressure autoscaler resizes the decode tier
   through deploy plans; scale-up starves the training gang, so the
@@ -10,11 +11,26 @@ Poisson-ish load swing ON — twice per seed:
 * ``static``: same seed, same arrivals, no autoscaler — the 1-replica
   decode tier sheds everything a burst throws past its queue.
 
-Receipts land in ``bench_r10/autoscale.jsonl`` (one line per run plus an
-A/B summary per seed): scale events with the pressure that triggered
-them, preemption records with flush/resume steps, and the shed-rate
-comparison. Exit 1 if any run fails its invariants or the autoscaled
-variant fails to beat the static baseline's shed rate.
+**Cold-start mode** times autoscale-decision -> first token for a real
+(scaled-down) decode replica three ways, with the phase breakdown
+(fetch / restore / compile / admit) recorded through the shared
+``MetricsRegistry`` Timer histograms:
+
+* ``disk``: the baseline — restore the sharded checkpoint from shared
+  storage, trace + compile every executable, warm up, serve.
+* ``peer``: fetch digest-checked weight frames over HTTP from an
+  already-hot sibling (``models/weights.py``) and reuse its AOT compile
+  cache — no re-trace on a homogeneous scale-up.
+* ``warm``: the warm-pool tier — weights resident, executables
+  compiled; the only cold work left is admission itself.
+
+All three variants must emit bit-exact greedy tokens; ``warm`` and
+``peer`` must each beat ``disk`` on decision -> first token.
+
+Receipts land in ``bench_r14/autoscale.jsonl`` (one line per run plus a
+summary per seed). Exit 1 if any run fails its invariants, the
+autoscaled variant fails to beat the static shed rate, token parity
+breaks, or the cold-start ladder fails to collapse.
 """
 
 from __future__ import annotations
@@ -22,6 +38,8 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import tempfile
+import time
 from pathlib import Path
 
 # one burst per third of the storm window: quiet -> swing -> quiet, so a
@@ -75,19 +93,190 @@ def run_variant(seed: int, ticks: int, autoscale: bool) -> dict:
     }
 
 
+# -- cold-start ladder ------------------------------------------------------
+
+# scaled-down stand-in for the 8B homogeneous scale-up config: the phase
+# structure (fetch / restore / compile / admit) and the parity contract
+# are config-independent; absolute seconds are not the claim here, the
+# ladder ordering (warm < peer < disk) is
+COLDSTART_CONFIG = "8b-sim"
+_PHASES = ("fetch", "restore", "compile", "admit")
+
+
+def _probe_requests(vocab: int) -> list:
+    import random
+    rng = random.Random(1234)
+    return [{"prompt": [rng.randrange(vocab) for _ in range(12)],
+             "max_new": 8, "request_id": "probe"}]
+
+
+def run_coldstart(seed: int) -> list:
+    """One cold-start A/B/C at ``COLDSTART_CONFIG``: boot a decode
+    replica from disk, from a hot peer, and from the warm pool, timing
+    decision -> first token with the phase breakdown observed into a
+    shared registry (the same ``autoscale.cold_start.*`` timers the
+    worker exports over ``/v1/metrics/prometheus``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.metrics import MetricsRegistry
+    from dcos_commons_tpu.models import llama, serving, weights
+    from dcos_commons_tpu.parallel import aot
+    from dcos_commons_tpu.parallel import checkpoint as ckpt
+
+    cfg = llama.LlamaConfig.tiny()
+    engine_kw = dict(slots=2, page_size=16, prefill_chunk=8)
+    params = llama.init_params(cfg, jax.random.key(seed))
+    probe = _probe_requests(cfg.vocab_size)
+
+    lines = []
+    with tempfile.TemporaryDirectory(prefix="bench_coldstart_") as tmp:
+        ckpt_dir = str(Path(tmp) / "ckpt")
+        ckpt.save_sharded(ckpt_dir, 1, params)
+        template = jax.tree.map(jnp.zeros_like, params)
+
+        # the already-hot fleet: a serving replica holding the shared AOT
+        # cache and exposing its checkpoint shards over HTTP. Its own
+        # boot cost is NOT part of any variant — it represents steady
+        # state before the autoscale decision fires. It booted from the
+        # checkpoint like every real replica does (restored arrays are
+        # device-committed, which is part of jit's executable cache key —
+        # an init-params hot engine would never share with restored ones)
+        cache = aot.CompileCache()
+        hot = serving.PagedServer(cfg,
+                                  ckpt.restore_sharded(ckpt_dir, template),
+                                  compile_cache=cache, **engine_kw)
+        hot.warmup()
+        want = hot.drain([dict(r) for r in probe])
+        server = weights.WeightServer(ckpt_dir, port=0,
+                                      host="127.0.0.1").start()
+        peers = [f"http://127.0.0.1:{server.port}"]
+
+        # the warm-pool replica: weights resident (restored at pool-fill
+        # time), executables compiled, zero traffic — all of that
+        # happened before the decision too
+        pooled = serving.PagedServer(cfg,
+                                     ckpt.restore_sharded(ckpt_dir,
+                                                          template),
+                                     compile_cache=cache, **engine_kw)
+        pooled.warmup()
+
+        def timed(registry, phase, fn):
+            t0 = time.perf_counter()
+            out = fn()
+            dt = time.perf_counter() - t0
+            registry.observe(f"autoscale.cold_start.{phase}_seconds", dt)
+            return out, dt
+
+        def variant(name, steps):
+            """steps: ordered {phase: thunk}; unlisted phases cost 0."""
+            registry = MetricsRegistry()
+            phases = {p: 0.0 for p in _PHASES}
+            t0 = time.perf_counter()
+            out = None
+            for phase, fn in steps.items():
+                out, phases[phase] = timed(registry, phase, fn)
+            total = time.perf_counter() - t0
+            registry.observe("autoscale.cold_start_seconds", total)
+            tokens = out
+            row = {
+                "metric": "cold_start",
+                "variant": name,
+                "config": COLDSTART_CONFIG,
+                "seed": seed,
+                "cold_start_s": round(total, 4),
+                "phases_s": {p: round(v, 4) for p, v in phases.items()},
+                "parity": tokens == want,
+                "timers": {
+                    n: registry.timer(n) for n in
+                    ["autoscale.cold_start_seconds"]
+                    + [f"autoscale.cold_start.{p}_seconds"
+                       for p in _PHASES]
+                    if registry.timer(n) is not None},
+            }
+            registry.close()
+            return row
+
+        try:
+            # disk: fetch is a no-op (shared storage is "local"), every
+            # executable is traced + compiled from scratch
+            state = {}
+            disk = variant("disk", {
+                "restore": lambda: state.update(
+                    t=ckpt.restore_sharded(ckpt_dir, template)),
+                "compile": lambda: state.update(
+                    e=serving.PagedServer(cfg, state["t"], **engine_kw))
+                and None or state["e"].warmup(),
+                "admit": lambda: state["e"].drain(
+                    [dict(r) for r in probe]),
+            })
+
+            # peer: manifest pin + digest-checked shard streaming from
+            # the hot sibling; compile reuses the sibling's AOT cache
+            pstate = {"f": weights.PeerFetcher(peers)}
+            peer = variant("peer", {
+                "fetch": lambda: pstate["f"].manifest(),
+                "restore": lambda: pstate.update(
+                    t=weights.restore_from_peers(
+                        peers, template, fetcher=pstate["f"])),
+                "compile": lambda: pstate.update(
+                    e=serving.PagedServer(cfg, pstate["t"],
+                                          compile_cache=cache,
+                                          **engine_kw))
+                and None or pstate["e"].warmup(),
+                "admit": lambda: pstate["e"].drain(
+                    [dict(r) for r in probe]),
+            })
+            peer["peer_stats"] = pstate["f"].stats()
+
+            # warm: promotion is bookkeeping; admission is the whole bill
+            warm = variant("warm", {
+                "admit": lambda: pooled.drain([dict(r) for r in probe]),
+            })
+        finally:
+            server.stop()
+
+    parity = disk["parity"] and peer["parity"] and warm["parity"]
+    collapsed = (warm["cold_start_s"] < disk["cold_start_s"]
+                 and peer["cold_start_s"] < disk["cold_start_s"])
+    summary = {
+        "metric": "cold_start_summary",
+        "config": COLDSTART_CONFIG,
+        "seed": seed,
+        "cold_start_s": {v["variant"]: v["cold_start_s"]
+                         for v in (disk, peer, warm)},
+        "speedup_peer": round(disk["cold_start_s"]
+                              / max(1e-9, peer["cold_start_s"]), 2),
+        "speedup_warm": round(disk["cold_start_s"]
+                              / max(1e-9, warm["cold_start_s"]), 2),
+        "token_parity": parity,
+        "ok": parity and collapsed,
+    }
+    print(f"coldstart seed {seed}: disk={disk['cold_start_s']:.3f}s "
+          f"peer={peer['cold_start_s']:.3f}s "
+          f"warm={warm['cold_start_s']:.3f}s "
+          f"parity={parity} {'OK' if summary['ok'] else 'FAIL'}")
+    return [disk, peer, warm, summary]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seeds", type=int, default=3,
                     help="A/B pairs to run, seeds 0..N-1 (default 3)")
     ap.add_argument("--ticks", type=int, default=DEFAULT_TICKS,
                     help=f"storm ticks per run (default {DEFAULT_TICKS})")
-    ap.add_argument("--out", default="bench_r10/autoscale.jsonl",
-                    help="receipts file (default bench_r10/autoscale.jsonl)")
+    ap.add_argument("--out", default="bench_r14/autoscale.jsonl",
+                    help="receipts file (default bench_r14/autoscale.jsonl)")
+    ap.add_argument("--mode", choices=("all", "elastic", "coldstart"),
+                    default="all",
+                    help="which benches to run (default all)")
+    ap.add_argument("--coldstart-seeds", type=int, default=1,
+                    help="cold-start ladders to run (default 1)")
     args = ap.parse_args(argv)
 
     lines = []
     failed = False
-    for seed in range(args.seeds):
+    for seed in range(args.seeds if args.mode != "coldstart" else 0):
         auto = run_variant(seed, args.ticks, autoscale=True)
         static = run_variant(seed, args.ticks, autoscale=False)
         improved = auto["shed_rate"] < static["shed_rate"]
@@ -124,6 +313,13 @@ def main(argv=None) -> int:
               f"{'OK' if ok else 'FAIL'}")
         if not ok:
             failed = True
+
+    if args.mode != "elastic":
+        for seed in range(args.coldstart_seeds):
+            rows = run_coldstart(seed)
+            lines += rows
+            if not rows[-1]["ok"]:
+                failed = True
 
     out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)

@@ -1,0 +1,155 @@
+"""Time-capped cold-start smoke for CI: boot a decode replica three ways
+— sharded disk restore, digest-checked peer fetch from a live
+``WeightServer``, and a warm-pool promotion sharing the AOT compile
+cache — and fail the build on the first greedy-token divergence.
+
+The full phase-timed ladder with receipts lives in
+``tools/bench_autoscale.py --mode coldstart``; this is the always-on
+slice test.sh runs next to the other smokes. It also exercises the
+degrade-not-crash contract: a fetch aimed at a dead peer must raise
+``WeightFetchError`` (so the worker's disk fallback path fires), never
+hang or crash. Checks run in a fixed order and stop (skip, not fail)
+when the time budget runs out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget-s", type=float, default=90.0,
+                    help="wall-clock cap; tail checks are skipped, not "
+                         "failed, when it runs out (default 90)")
+    args = ap.parse_args(argv)
+    deadline = time.monotonic() + args.budget_s
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama, serving, weights
+    from dcos_commons_tpu.parallel import aot
+    from dcos_commons_tpu.parallel import checkpoint as ckpt
+
+    cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                 attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.key(0))
+    engine_kw = dict(slots=2, page_size=16, prefill_chunk=8)
+    rng = jax.random.key(11)
+    reqs = []
+    for i, (n, m) in enumerate([(8, 6), (5, 9), (12, 4)]):
+        rng, sub = jax.random.split(rng)
+        prompt = [int(t) for t in jax.random.randint(
+            sub, (n,), 0, cfg.vocab_size)]
+        reqs.append({"prompt": prompt, "max_new": m, "request_id": i})
+
+    ran = 0
+
+    def _spent(name: str) -> bool:
+        if time.monotonic() >= deadline:
+            print(f"coldstart-smoke: time budget exhausted after {ran} "
+                  f"checks; {name!r} and later checks skipped")
+            return True
+        return False
+
+    with tempfile.TemporaryDirectory(prefix="coldstart_smoke_") as tmp:
+        ckpt_dir = str(Path(tmp) / "ckpt")
+        ckpt.save_sharded(ckpt_dir, 1, params)
+        template = jax.tree.map(jnp.zeros_like, params)
+
+        # 1. the anchor: disk restore -> serve (every real replica's
+        # fallback path, and the parity reference for the peer boot)
+        if _spent("disk-restore"):
+            return 0
+        cache = aot.CompileCache()
+        disk = serving.PagedServer(
+            cfg, ckpt.restore_sharded(ckpt_dir, template),
+            compile_cache=cache, **engine_kw)
+        want = disk.drain([dict(r) for r in reqs])
+        ran += 1
+
+        # 2. peer boot: the disk-restored replica exposes its shards
+        # over live HTTP; a second replica fetches digest-checked
+        # frames and must emit bit-identical tokens
+        if _spent("peer-boot"):
+            return 0
+        server = weights.WeightServer(ckpt_dir, port=0,
+                                      host="127.0.0.1").start()
+        try:
+            peers = [f"http://127.0.0.1:{server.port}"]
+            fetcher = weights.PeerFetcher(peers)
+            booted = weights.restore_from_peers(peers, template,
+                                                fetcher=fetcher)
+            peer = serving.PagedServer(cfg, booted, compile_cache=cache,
+                                       **engine_kw)
+            got = peer.drain([dict(r) for r in reqs])
+            if got != want:
+                print(f"coldstart-smoke FAILED: peer-booted streams != "
+                      f"disk streams\n  peer: {got}\n  disk: {want}",
+                      file=sys.stderr)
+                return 1
+            stats = fetcher.stats()
+            if not stats["shards_fetched"]:
+                print("coldstart-smoke FAILED: peer boot fetched zero "
+                      "shards (restore silently used another source?)",
+                      file=sys.stderr)
+                return 1
+        finally:
+            server.stop()
+        ran += 1
+
+        # 3. warm promotion: a pool replica built against the shared
+        # compile cache serves the same tokens with zero boot work left
+        if _spent("warm-promotion"):
+            return 0
+        t0 = time.perf_counter()
+        warm = serving.PagedServer(
+            cfg, ckpt.restore_sharded(ckpt_dir, template),
+            compile_cache=cache, **engine_kw)
+        got = warm.drain([dict(r) for r in reqs])
+        promote_s = time.perf_counter() - t0
+        if got != want:
+            print(f"coldstart-smoke FAILED: warm-pool streams != disk "
+                  f"streams\n  warm: {got}\n  disk: {want}",
+                  file=sys.stderr)
+            return 1
+        if not cache.stats()["hits"]:
+            print("coldstart-smoke FAILED: warm replica missed the AOT "
+                  "compile cache (homogeneous scale-up re-traced)",
+                  file=sys.stderr)
+            return 1
+        ran += 1
+
+        # 4. degrade-not-crash: a dead peer must fail fast with
+        # WeightFetchError so the worker falls back to disk
+        if _spent("dead-peer-fallback"):
+            return 0
+        try:
+            weights.restore_from_peers(
+                ["http://127.0.0.1:9"], template,
+                fetcher=weights.PeerFetcher(["http://127.0.0.1:9"],
+                                            timeout_s=2.0))
+        except weights.WeightFetchError:
+            pass
+        else:
+            print("coldstart-smoke FAILED: dead peer did not raise "
+                  "WeightFetchError", file=sys.stderr)
+            return 1
+        ran += 1
+
+    print(f"coldstart-smoke: {ran} checks passed — peer-booted and "
+          f"warm-promoted replicas token-exact vs disk restore "
+          f"({stats['shards_fetched']} shards / "
+          f"{stats['bytes_fetched']} bytes over HTTP, warm serve in "
+          f"{promote_s:.2f}s, AOT cache "
+          f"{cache.stats()['hits']} hits), dead peer degrades cleanly")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
