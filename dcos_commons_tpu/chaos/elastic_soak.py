@@ -40,14 +40,22 @@ fault classes fire (``warm_promote_crash``, ``weight_fetch_lost``) with
 invariant 12 auditing that a warm pod is never double-counted as both
 headroom and capacity.
 
+Round 15 adds the live-migration layer: a ``migrate_mid_stream`` fault
+decommissions the busiest serving replica mid-stream and
+:class:`_MigrateSim` drains its relays to ring-preferred survivors (the
+``models/migrate.py`` drain-before-reclaim protocol), with
+:class:`~.invariants.MigrationInvariantChecker` auditing token-exact
+continuation and that no migrated stream ever drops.
+
 Determinism contract matches ``chaos/soak.py``: one ``random.Random(seed)``
-drives the scheduler-facing weather; the load, flush, router, and boot
-simulators run on their own derived RNGs so arming a new fault class
-never perturbs the draw order of a pinned seed.
+drives the scheduler-facing weather; the load, flush, router, boot, and
+migration simulators run on their own derived RNGs so arming a new fault
+class never perturbs the draw order of a pinned seed.
 """
 
 from __future__ import annotations
 
+import hashlib
 import random
 import time
 from typing import Dict, List, Optional, Tuple
@@ -68,7 +76,8 @@ from ..state.tasks import TaskState
 from ..testing.simulation import default_agents, tpu_slice_agents
 from .engine import ChaosCluster, FaultConfig
 from .invariants import (ElasticInvariantChecker, InvariantChecker,
-                         RouterInvariantChecker, Violation)
+                         MigrationInvariantChecker, RouterInvariantChecker,
+                         Violation)
 from .soak import SETTLE_BUDGET, SoakReport
 
 SERVE_YML = """
@@ -396,6 +405,75 @@ class _BootSim:
             self.boots.append((tick, name, source))
 
 
+class _MigrateSim:
+    """Live-migration drains over the router sim's relays (the
+    ``models/migrate.py`` seam): a ``migrate_mid_stream`` fault
+    decommissions the busiest serving replica MID-STREAM and every
+    relay it carries must re-home to a ring-preferred survivor with its
+    token prefix continuing exactly — the destination replays the same
+    deterministic greedy prefix the victim emitted (receipt field
+    ``exact``), and a migrated relay must never subsequently drop.
+    Receipts feed :class:`~.invariants.MigrationInvariantChecker`.
+    Runs on its own derived RNG, so arming the fault class never
+    perturbs the scheduler-facing draw order of a pinned seed."""
+
+    DOWN_TICKS = (1, 2)    # victim leaves the tier for this long
+
+    def __init__(self, seed: int):
+        self.rng = random.Random((seed << 34) ^ 0xA0761D6478BD642F)
+        # (tick, relay_id, src, dst, exact) newest last
+        self.migrations: List[Tuple[int, str, str, str, bool]] = []
+        self.migrated_ids: Dict[str, int] = {}   # relay id -> drain tick
+        self.drained_replicas = 0
+        self.failed = 0
+
+    @staticmethod
+    def _tok(rid: str, i: int) -> int:
+        # position i of relay rid's greedy stream: one pure function on
+        # BOTH sides of the drain — the sim's stand-in for greedy
+        # decode determinism (blake2s, not hash(): str hashing is
+        # salted per-process and would break seed replay)
+        return int.from_bytes(
+            hashlib.blake2s(f"{rid}:{i}".encode(),
+                            digest_size=2).digest(), "big")
+
+    def drain(self, tick: int,
+              routersim: _RouterSim) -> Optional[Tuple[str, int]]:
+        """Decommission the busiest up replica: migrate its relays to
+        ring-preferred survivors, then take it out of the serving set.
+        Returns (victim, streams moved), or None when the tier has no
+        drainable victim (no loaded replica, or no survivor)."""
+        up = [n for n in routersim.ring.nodes()
+              if routersim._up(n, tick)]
+        loaded = {n: [r for r in routersim.relays if r["replica"] == n]
+                  for n in up}
+        victims = [n for n in sorted(loaded) if loaded[n]]
+        if not victims or len(up) < 2:
+            return None
+        victim = max(victims, key=lambda n: (len(loaded[n]), n))
+        survivors = [n for n in up if n != victim]
+        moved = 0
+        for r in loaded[victim]:
+            served = max(1, tick - r["born"])
+            prefix = [self._tok(r["id"], i) for i in range(served)]
+            dest = next((c for c in routersim.ring.preference(r["key"])
+                         if c in survivors), None)
+            if dest is None:
+                self.failed += 1       # stream stays put, spills later
+                continue
+            replay = [self._tok(r["id"], i) for i in range(served)]
+            r["replica"] = dest
+            moved += 1
+            self.migrations.append(
+                (tick, r["id"], victim, dest, replay == prefix))
+            self.migrated_ids[r["id"]] = tick
+        # drain-before-reclaim: only now does the victim leave the tier
+        routersim.down_until[victim] = tick + self.rng.randint(
+            *self.DOWN_TICKS)
+        self.drained_replicas += 1
+        return victim, moved
+
+
 class _FlushSim:
     """Plays the worker sentinel's side of the graceful-kill protocol:
     every task holding a delivered-but-unanswered SIGTERM checkpoint-
@@ -544,6 +622,7 @@ class ElasticSoak:
         self.flushsim = _FlushSim(seed)
         self.routersim = _RouterSim(seed)
         self.bootsim = _BootSim(seed)
+        self.migratesim = _MigrateSim(seed)
         self.warmpool = None
         if warm_pool > 0:
             self.warmpool = WarmPool(lambda: self.multi, "serve", "decode",
@@ -568,6 +647,7 @@ class ElasticSoak:
                          _ChildChecker(_ChildView(self, "train"))]
         self.elastic_checker = ElasticInvariantChecker(self)
         self.router_checker = RouterInvariantChecker(self)
+        self.migration_checker = MigrationInvariantChecker(self)
 
     # -- scheduler lifecycle -----------------------------------------------
 
@@ -775,6 +855,17 @@ class ElasticSoak:
             self._count("weight_fetch_lost")
             self._log(f"tick {tick}: weight_fetch_lost (next peer boot "
                       "falls back to disk)")
+        # -- live-migration fault (migration sim's derived RNG: arming it
+        # -- never perturbs the scheduler-facing draw order of pinned
+        # -- seeds, and with no loaded replica there is no drain) --
+        if cfg.migrate_mid_stream and self.migratesim.rng.random() \
+                < cfg.migrate_mid_stream:
+            drained = self.migratesim.drain(tick, self.routersim)
+            if drained is not None:
+                victim, moved = drained
+                self._count("migrate_mid_stream")
+                self._log(f"tick {tick}: migrate_mid_stream {victim} "
+                          f"({moved} streams drained to survivors)")
         if cfg.scale_mid_crash and rng.random() < cfg.scale_mid_crash:
             # force a resize so a scale plan is guaranteed in flight, then
             # kill the scheduler mid-rollout; the restored plans resume it
@@ -812,6 +903,7 @@ class ElasticSoak:
             found += checker.check(tick)
         found += self.elastic_checker.check(tick)
         found += self.router_checker.check(tick)
+        found += self.migration_checker.check(tick)
         for v in found:
             self._log(f"VIOLATION {v}")
         self.violations.extend(found)

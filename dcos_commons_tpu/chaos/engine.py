@@ -111,6 +111,13 @@ class FaultConfig:
     # legacy pinned seeds replay unperturbed.
     warm_promote_crash: float = 0.0
     weight_fetch_lost: float = 0.0
+    # live-migration fault (elastic soak migration sim): a serving
+    # replica is decommissioned MID-STREAM and every live decode stream
+    # on it must drain to a ring-preferred survivor and continue
+    # token-exact — the token-exact-continuation invariant audits the
+    # receipts. Draws from a derived RNG private to the migration sim,
+    # so the legacy pinned seeds replay unperturbed.
+    migrate_mid_stream: float = 0.0
     max_delay_ticks: int = 3
 
     FIELDS = ("status_drop", "status_delay", "status_dup", "status_reorder",
@@ -119,7 +126,8 @@ class FaultConfig:
               "kv_ship_lost", "kv_ship_slow", "scale_up_burst",
               "preempt_storm", "victim_crash_in_grace", "scale_mid_crash",
               "router_replica_down", "tenant_flood",
-              "warm_promote_crash", "weight_fetch_lost")
+              "warm_promote_crash", "weight_fetch_lost",
+              "migrate_mid_stream")
 
     @classmethod
     def none(cls) -> "FaultConfig":
@@ -151,7 +159,8 @@ class FaultConfig:
                        scale_up_burst=0.0, preempt_storm=0.0,
                        victim_crash_in_grace=0.0, scale_mid_crash=0.0,
                        router_replica_down=0.0, tenant_flood=0.0,
-                       warm_promote_crash=0.0, weight_fetch_lost=0.0)
+                       warm_promote_crash=0.0, weight_fetch_lost=0.0,
+                       migrate_mid_stream=0.0)
 
 
 def parse_faults(arg: str) -> FaultConfig:

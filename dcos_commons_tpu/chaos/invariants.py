@@ -429,3 +429,50 @@ class RouterInvariantChecker:
                         f"trace {tid} never reached a terminal span but "
                         "its relay is no longer in flight", tick))
         return out
+
+
+class MigrationInvariantChecker:
+    """Live-migration invariants over the elastic harness's migration
+    sim (``chaos/elastic_soak.py`` :class:`_MigrateSim`, modelling the
+    ``models/migrate.py`` drain-before-reclaim protocol):
+
+    14. **token-exact continuation** — a decode stream drained off a
+        decommissioned replica (``migrate_mid_stream``) resumes on its
+        destination with exactly the token prefix the victim emitted;
+        a receipt with ``exact=False`` means the shipped KV/sampler
+        state diverged — the client would see a corrupt splice.
+    15. **zero-drop migration** — a migrated relay never subsequently
+        drops: drain-before-reclaim exists precisely so scale events
+        lose no admitted stream. A drop receipt for a relay after its
+        migration tick means the drain handed the stream to a
+        destination that lost it.
+    """
+
+    def __init__(self, harness):
+        self._h = harness          # needs .migratesim + .routersim
+        self._migrations_seen = 0
+        self._drops_seen = 0
+
+    def check(self, tick: int) -> List[Violation]:
+        sim = self._h.migratesim
+        rsim = self._h.routersim
+        out: List[Violation] = []
+        for t, rid, src, dst, exact in sim.migrations[
+                self._migrations_seen:]:
+            if not exact:
+                out.append(Violation(
+                    "token-exact-continuation",
+                    f"relay {rid} resumed on {dst} at tick {t} with a "
+                    f"divergent token prefix after draining off {src}",
+                    tick))
+        self._migrations_seen = len(sim.migrations)
+        for t, rid, attempts, ever_placed in rsim.drops[self._drops_seen:]:
+            mt = sim.migrated_ids.get(rid)
+            if mt is not None and t >= mt:
+                out.append(Violation(
+                    "migrated-stream-dropped",
+                    f"relay {rid} was migrated at tick {mt} but dropped "
+                    f"at tick {t} — drain-before-reclaim lost the "
+                    "stream", tick))
+        self._drops_seen = len(rsim.drops)
+        return out

@@ -303,6 +303,48 @@ def _route_stats_cmd(client: Client, args) -> int:
         return 1
 
 
+def _migrate_stats_cmd(client: Client, args) -> int:
+    """Live-migration counters. From the router (``--router`` /
+    ``TPU_ROUTER``): the "migrated-to" redirect table and how many
+    drains it has followed. From a replica's ``MigrateReceiver``
+    (``--receiver URL``): per-engine ``migrated_in``/``migrated_out``
+    and free-page headroom, via ``GET /v1/healthz``."""
+    router = (args.router or os.environ.get("TPU_ROUTER", "")).rstrip("/")
+    receiver = (args.receiver or "").rstrip("/")
+    if not router and not receiver:
+        print("migrate-stats: provide --router URL (or set TPU_ROUTER) "
+              "and/or --receiver URL", file=sys.stderr)
+        return 2
+    try:
+        from ..security.transport import urlopen
+    except ImportError:
+        urlopen = urllib.request.urlopen
+    out, code = {}, 200
+    try:
+        if router:
+            with urlopen(f"{router}/v1/routestats", timeout=30) as r:
+                stats = json.loads(r.read().decode())
+            out["router"] = {
+                "migration_redirects":
+                    stats.get("migration_redirects", 0),
+                "migration_redirects_active":
+                    stats.get("migration_redirects_active", {}),
+            }
+        if receiver:
+            with urlopen(f"{receiver}/v1/healthz", timeout=30) as r:
+                health = json.loads(r.read().decode())
+            out["receiver"] = {
+                k: health.get(k) for k in ("migrated_in",
+                                           "migrated_out",
+                                           "pages_free")}
+    except urllib.error.HTTPError as e:
+        return _emit(e.code, {"error": str(e)})
+    except OSError as e:
+        print(f"migrate-stats: unreachable: {e}", file=sys.stderr)
+        return 1
+    return _emit(code, out)
+
+
 def _trace_cmd(client: Client, args) -> int:
     """Fleet-wide request traces from the router tier (``GET
     /v1/traces`` / ``/v1/trace/<id>``, ``models/router.py``). Without a
@@ -605,6 +647,15 @@ def build_parser() -> argparse.ArgumentParser:
     rs.add_argument("--router", default=None, metavar="URL",
                     help="router base URL (default: $TPU_ROUTER)")
     rs.set_defaults(fn=_route_stats_cmd)
+
+    ms = sub.add_parser("migrate-stats",
+                        help="live-migration counters: router redirect "
+                             "table + per-replica adopt/export tallies")
+    ms.add_argument("--router", default=None, metavar="URL",
+                    help="router base URL (default: $TPU_ROUTER)")
+    ms.add_argument("--receiver", default=None, metavar="URL",
+                    help="a replica MigrateReceiver base URL")
+    ms.set_defaults(fn=_migrate_stats_cmd)
 
     tr = sub.add_parser("trace",
                         help="fetch fleet-wide request traces")

@@ -507,7 +507,13 @@ class Router:
             "routed": 0, "affinity_hits": 0, "spills_hot": 0,
             "spills_down": 0, "spill_attempts": 0, "spill_resumes": 0,
             "resume_divergences": 0, "dropped_streams": 0, "sheds": 0,
-            "rebalances": 0, "errors": 0}
+            "rebalances": 0, "errors": 0, "migration_redirects": 0}
+        # live-migration forwarding: victim endpoint -> destination the
+        # MigrationManager drained its streams to. Applied to every
+        # route plan so relays (and resume-exact failover replays)
+        # follow the stream instead of re-prefilling on a doomed or
+        # departed replica.
+        self._redirects: Dict[str, str] = {}
         self._per_replica: Dict[str, int] = {}
         self._active: Dict[str, int] = {}      # replica -> live relays
         self._ttfts: deque = deque(maxlen=4096)  # (t, tenant, ttft_ms)
@@ -745,6 +751,7 @@ class Router:
         self.tracer.record("router.admission", t0, time.perf_counter(),
                            parent=root, tenant=tenant, qos=cls.name)
         plan, routed = self.route_plan(prompt, cls)
+        plan = self._apply_redirects(plan)
         if not plan:
             self._count("errors")
             self._finish_trace(root, ctx, t0, "error", tenant=tenant,
@@ -878,6 +885,41 @@ class Router:
 
     # ----------------------------------------------------------- elasticity
 
+    def note_migration(self, src: str, dst: str) -> None:
+        """Record a "migrated-to" redirect: streams drained off ``src``
+        now live on ``dst``, so any plan that would try ``src`` tries
+        ``dst`` there instead. Existing redirects pointing AT ``src``
+        re-target ``dst`` (two scale events in a row must not leave a
+        chain through a dead middle hop)."""
+        src, dst = src.rstrip("/"), dst.rstrip("/")
+        if src == dst:
+            return
+        with self._lock:
+            for k, v in list(self._redirects.items()):
+                if v == src:
+                    self._redirects[k] = dst
+            self._redirects.pop(dst, None)      # dst is live again
+            self._redirects[src] = dst
+        self._count("migration_redirects")
+
+    def _apply_redirects(self, plan: List[str]) -> List[str]:
+        """Map a route plan through the migration redirects (chains
+        followed with a visited guard, order-preserving dedupe). Cheap
+        no-op on the common path — no redirects, no work."""
+        with self._lock:
+            if not self._redirects:
+                return plan
+            redirects = dict(self._redirects)
+        out: List[str] = []
+        for ep in plan:
+            seen = {ep}
+            while ep in redirects and redirects[ep] not in seen:
+                ep = redirects[ep]
+                seen.add(ep)
+            if ep not in out:
+                out.append(ep)
+        return out
+
     def set_replicas(self, endpoints: Sequence[str]) -> dict:
         """Rebalance the ring to a resized decode tier. Departing
         replicas leave the ring and the replica set immediately — no
@@ -902,6 +944,14 @@ class Router:
             if added or removed:
                 self._count("rebalances")
             with self._lock:
+                # migration redirects die with the fleet change that
+                # obsoletes them: a destination that departed can't
+                # receive forwards, and a victim that REJOINED is a
+                # fresh replica that should take traffic directly
+                for ep in list(self._redirects):
+                    if (self._redirects[ep] not in want
+                            or ep in added):
+                        del self._redirects[ep]
                 draining = {ep: n for ep, n in self._active.items()
                             if ep in removed and n > 0}
             return {"replicas": self.ring.nodes(),
@@ -948,6 +998,7 @@ class Router:
         from dcos_commons_tpu.utils.stats import percentiles
         with self._lock:
             counts = dict(self._counts)
+            redirects = dict(self._redirects)
             per_replica = dict(self._per_replica)
             active = {ep: n for ep, n in self._active.items() if n > 0}
             ttfts = [t for _, _, t in self._ttfts if t is not None]
@@ -972,6 +1023,7 @@ class Router:
             "replicas_down": self.replicas.down(),
             "ring_nodes": len(self.ring),
             **counts,
+            "migration_redirects_active": redirects,
             "affinity_rate": round(counts["affinity_hits"] / routed, 4),
             "per_replica": per_replica,
             "active_relays": active,

@@ -703,6 +703,11 @@ class PagedServer:
         self.shipped_spans = 0
         self.adopted_spans = 0
         self.adopt_shared_pages = 0
+        # live-migration counters (models/migrate.py): streams this
+        # engine drained away after a confirmed adoption / resumed from
+        # a peer's exported decode state
+        self.migrated_out = 0
+        self.migrated_in = 0
 
     # the engine-thread-only helpers are identical to the slot engine's
     _select = SlotServer._select
@@ -1106,6 +1111,185 @@ class PagedServer:
             self._adopt_x[n] = x
         return x
 
+    # ------------------------------------------------------ live migration
+
+    def export_stream(self, slot: int) -> Optional[Dict[str, Any]]:
+        """Freeze the decode stream in ``slot`` at a step boundary and
+        return its portable state: the KV pages covering every position
+        written so far PLUS the sampler/stream state a destination needs
+        to resume token-exact — prompt, every generated token, the
+        remaining budget, and the engine RNG key. Export is a pure READ:
+        the victim keeps all its pages and bookkeeping and keeps decoding
+        until :meth:`release_stream` confirms the adoption elsewhere, so
+        a failed migration leaves the stream untouched.
+
+        Returns None for an empty slot or a stream still prefilling
+        (nothing decoded yet — the caller re-submits the prompt on the
+        destination instead of shipping pages).
+
+        Positions: the device KV holds ``prompt_len + len(tokens) - 1``
+        written positions (the LAST emitted token's K/V lands on the
+        destination's next decode step, exactly as it would here), so
+        that — not the full reserved span — is what ships. The final
+        shipped page may be partial; its garbage tail is overwritten as
+        decode continues, like an adopted boundary page."""
+        self._flush_pending()          # a step boundary, never mid-flush
+        if not (0 <= slot < self.slots):
+            return None
+        r = self.requests[slot]
+        if r is None or not self._decoding[slot] or not r.tokens:
+            return None
+        n = r.prompt_len
+        ps = self.page_size
+        kv_len = n + len(r.tokens) - 1
+        span_pages = -(-kv_len // ps)
+        pages = self._stream_pages[slot][:span_pages]
+        try:
+            rng = np.asarray(jax.random.key_data(self.key))
+        except Exception:              # raw uint32 key arrays
+            rng = np.asarray(self.key)
+        return {"version": 1, "prompt": list(self._prompts[slot]),
+                "tokens": list(r.tokens), "max_new": int(r.budget),
+                "page_size": ps, "kv_quant": bool(self.cfg.kv_quant),
+                "rng_key": rng, "payload": self._gather_span(pages)}
+
+    def import_stream(self, state: Dict[str, Any], request_id: Any = None,
+                      adopt_rng: bool = False) -> Optional[int]:
+        """Adopt a migrated decode stream (:meth:`export_stream` on the
+        victim, possibly shipped as a ``DECSTATE`` frame by
+        ``models/migrate.py``) and resume it mid-stream: the stream
+        joins the decode batch at position ``prompt + generated - 1``
+        with its token history, remaining budget, and identity intact —
+        under greedy decode the continuation is token-exact.
+
+        The transaction discipline is :meth:`adopt_pages`'s: config and
+        shape mismatches raise ValueError BEFORE any reservation; slot
+        or page exhaustion returns None; a failure after pages are
+        reserved unwinds every reservation before re-raising — in every
+        non-success case the victim (which still holds the stream) loses
+        nothing. ``adopt_rng`` additionally installs the shipped engine
+        RNG key — an engine-global, so only sensible when the
+        destination carries no other sampled streams."""
+        t_mig0 = time.perf_counter()
+        prompt = list(state["prompt"])
+        tokens = [int(t) for t in state["tokens"]]
+        n = len(prompt)
+        max_new = int(state["max_new"])
+        if not tokens:
+            raise ValueError("decode state carries no generated tokens; "
+                             "ship a prefill span instead")
+        if int(state.get("page_size", self.page_size)) != self.page_size:
+            raise ValueError(
+                f"stream page_size {state.get('page_size')} != pool page "
+                f"size {self.page_size}; tiers must agree")
+        if bool(state.get("kv_quant")) != bool(self.cfg.kv_quant):
+            raise ValueError("stream/pool kv_quant mismatch: shipped "
+                             "pages are raw pool bytes, tiers must run "
+                             "the same KV dtype")
+        reason = self._validate_item({"prompt": prompt,
+                                      "max_new": max_new})
+        if reason is not None:
+            raise ValueError(reason)
+        if len(tokens) >= max_new or n + len(tokens) >= self.cfg.max_seq:
+            raise ValueError("stream already complete; nothing to "
+                             "resume — deliver its tokens instead")
+        ps = self.page_size
+        kv_len = n + len(tokens) - 1
+        span_pages = -(-kv_len // ps)
+        payload = state["payload"]
+
+        def _shape(x):
+            return tuple((x["q"] if isinstance(x, dict) else x).shape)
+
+        want = (self.cfg.n_layers, span_pages, ps, self.cfg.n_kv_heads,
+                self.cfg.head_dim)
+        if _shape(payload["k"]) != want or _shape(payload["v"]) != want:
+            raise ValueError(f"stream payload shape "
+                             f"{_shape(payload['k'])} != pool page "
+                             f"shape {want}")
+        self._flush_pending()
+        free = self.free_slots()
+        if not free:
+            return None
+        slot = free[0]
+        total = -(-(n + max_new) // ps)
+        shared: List[int] = []
+        if self.radix is not None:
+            # full PROMPT pages dedupe exactly as at adoption — every
+            # shared page is covered by the shipped span (kv_len >= n)
+            shared, _ = self.radix.lookup(prompt)
+        own_needed = total - len(shared)
+        pages = self.ledger.alloc(own_needed)
+        if pages is None and self.radix is not None:
+            self.radix.evict(own_needed - self.ledger.free_count())
+            pages = self.ledger.alloc(own_needed)
+        if pages is None:
+            for p in shared:
+                self.ledger.unref(p)
+            return None
+        matched = len(shared)
+        try:
+            install = span_pages - matched
+            if install > 0:
+                self.pool = self._adopt_exec(install)(
+                    self.pool,
+                    _payload_slice(payload["k"], matched, span_pages),
+                    _payload_slice(payload["v"], matched, span_pages),
+                    jnp.asarray(pages[:install], jnp.int32))
+        except Exception:
+            # aborted install: every reservation unwinds, the victim
+            # still holds the stream — it resumes untouched
+            for p in shared:
+                self.ledger.unref(p)
+            for p in pages:
+                self.ledger.unref(p)
+            raise
+        stream_pages = shared + pages
+        row = self._tables[slot]
+        row[:] = self.scratch
+        row[:total] = stream_pages
+        self._stream_pages[slot] = stream_pages
+        self._prompts[slot] = prompt
+        self._prefill_pos[slot] = n
+        self._decoding[slot] = True
+        self.lengths = self.lengths.at[slot].set(kv_len)
+        self.cur_tok = self.cur_tok.at[slot].set(tokens[-1])
+        rid = request_id if request_id is not None else object()
+        self.requests[slot] = _Request(rid, n, max_new, list(tokens))
+        if adopt_rng and state.get("rng_key") is not None:
+            try:
+                self.key = jax.random.wrap_key_data(
+                    jnp.asarray(state["rng_key"]))
+            except Exception:
+                self.key = jnp.asarray(state["rng_key"])
+        self.migrated_in += 1
+        tracer = self.tracer
+        if tracer is not None:
+            ctx = getattr(rid, "trace", None)
+            if ctx is not None:
+                tracer.record("engine.import_stream", t_mig0,
+                              time.perf_counter(), parent=ctx,
+                              pages=span_pages, shared_pages=matched,
+                              generated=len(tokens))
+        self._maybe_retire(slot)
+        return slot
+
+    def release_stream(self, slot: int) -> bool:
+        """Confirm a migration: drop the victim's copy of the stream —
+        every page unrefs, full prompt pages adopt into the radix (the
+        prompt finished prefilling, so they hold prompt-determined K/V,
+        the retirement reasoning) — WITHOUT recording a result; the
+        destination owns the stream now. Only call after the adoption
+        committed; until then the stream keeps decoding here."""
+        if not (0 <= slot < self.slots) or self.requests[slot] is None:
+            return False
+        decoded = self._decoding[slot]
+        self.requests[slot] = None
+        self._pending_first.pop(slot, None)
+        self._release(slot, adopt=decoded)
+        self.migrated_out += 1
+        return True
+
     # ------------------------------------------------------------- decode
 
     def _prefill_tick(self) -> None:
@@ -1384,4 +1568,6 @@ class PagedServer:
             "shipped_spans": self.shipped_spans,
             "adopted_spans": self.adopted_spans,
             "adopt_shared_pages": self.adopt_shared_pages,
+            "migrated_out": self.migrated_out,
+            "migrated_in": self.migrated_in,
         }

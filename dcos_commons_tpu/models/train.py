@@ -120,15 +120,45 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                            is_leaf=lambda s: isinstance(s, P))
     if p_shard is None:
         return jax.jit(step, donate_argnums=(0, 1))
+
     # pin OUTPUT params to the same spec as the inputs: without this GSPMD
     # may resolve an output param to a different sharding, and the second
     # step call fails its in_shardings check (a one-step smoke never sees
-    # this; any training loop does)
-    out_shardings = ((p_shard, None, None, None) if has_aux_state
-                     else (p_shard, None, None))
-    return jax.jit(step, donate_argnums=(0, 1),
-                   in_shardings=(p_shard, None, b_shard),
-                   out_shardings=out_shardings)
+    # this; any training loop does). The opt_state needs the same pinning
+    # on BOTH sides — it is donated, and a moment leaf whose output
+    # sharding GSPMD resolves differently from its input placement (e.g.
+    # a replicated norm moment re-resolved tp-sharded) fails XLA's
+    # donation aliasing check with a per-device size mismatch on step 1.
+    # Its structure only exists once a real opt_state arrives, so the jit
+    # is built lazily on the first call, with the opt-state leaves mapped
+    # through the same shape -> spec table ``init_opt_state`` places by.
+    jitted: Dict[str, Callable] = {}
+
+    def _opt_shardings(params, opt_state):
+        spec_by_shape: Dict[Tuple[int, ...], Any] = {}
+        flat_p = jax.tree.leaves(params)
+        flat_s = jax.tree.leaves(param_spec_tree,
+                                 is_leaf=lambda s: isinstance(s, P))
+        for leaf, spec in zip(flat_p, flat_s):
+            spec_by_shape.setdefault(leaf.shape, spec)
+        return jax.tree.map(
+            lambda x: NamedSharding(
+                mesh, spec_by_shape.get(getattr(x, "shape", None), P())),
+            opt_state)
+
+    def lazy_step(params, opt_state, batch):
+        fn = jitted.get("fn")
+        if fn is None:
+            o_shard = _opt_shardings(params, opt_state)
+            out_shardings = ((p_shard, o_shard, None, None)
+                             if has_aux_state else (p_shard, o_shard, None))
+            fn = jitted["fn"] = jax.jit(
+                step, donate_argnums=(0, 1),
+                in_shardings=(p_shard, o_shard, b_shard),
+                out_shardings=out_shardings)
+        return fn(params, opt_state, batch)
+
+    return lazy_step
 
 
 def init_opt_state(optimizer: optax.GradientTransformation, params,

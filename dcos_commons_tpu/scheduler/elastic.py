@@ -136,6 +136,42 @@ class AutoscalerConfig:
         )
 
 
+@dataclass(frozen=True)
+class MigrationConfig:
+    """Knobs for live stream migration during scale events
+    (``models/migrate.py``). ``from_env`` reads the ``MIGRATE_*``
+    environment contract documented in ``docs/yaml-reference.md``:
+    when enabled, the autoscaler's shrink path and the preemptor's
+    grace window both drain live decode streams to surviving replicas
+    BEFORE any capacity is actually reclaimed."""
+
+    enable: bool = True
+    timeout_s: float = 30.0       # per-stream freeze -> resume budget
+    max_inflight: int = 2         # concurrent stream drains
+
+    def __post_init__(self):
+        if self.timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {self.timeout_s}")
+        if self.max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1, got {self.max_inflight}")
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "MigrationConfig":
+        e = os.environ if env is None else env
+
+        def _f(key, default):
+            raw = e.get(key)
+            return default if raw in (None, "") else float(raw)
+
+        raw = (e.get("MIGRATE_ENABLE") or "1").strip().lower()
+        return cls(
+            enable=raw not in ("0", "false", "no", "off"),
+            timeout_s=_f("MIGRATE_TIMEOUT_S", 30.0),
+            max_inflight=int(_f("MIGRATE_MAX_INFLIGHT", 2)),
+        )
+
+
 class HysteresisController:
     """Debounced two-threshold controller: pressure must sit above
     ``high_pressure`` (or below ``low_pressure``) for ``debounce_ticks``
@@ -386,7 +422,8 @@ class Autoscaler:
     def __init__(self, multi_fn: Callable[[], object], service_name: str,
                  config: AutoscalerConfig,
                  gauges_fn: Callable[[], dict],
-                 metrics=None, warm_pool: Optional[WarmPool] = None):
+                 metrics=None, warm_pool: Optional[WarmPool] = None,
+                 drain_hook: Optional[Callable[[int, int], object]] = None):
         self._multi_fn = multi_fn
         self.service_name = service_name
         self.config = config
@@ -394,6 +431,13 @@ class Autoscaler:
         self.controller = HysteresisController(config)
         self.metrics = metrics
         self.warm_pool = warm_pool
+        # drain-before-reclaim (models/migrate.py): called as
+        # drain_hook(current, proposed) before a SHRINK is actuated, so
+        # live decode streams migrate off the departing replicas while
+        # they are still serving. Hook failures are recorded, never
+        # allowed to veto the resize — capacity policy wins.
+        self.drain_hook = drain_hook
+        self.drain_receipts: List[object] = []
         self.last_pressure: float = 0.0
         # (new_count, pressure) per resize, newest last — bench receipts
         self.events: List[Tuple[int, float]] = []
@@ -461,6 +505,16 @@ class Autoscaler:
         pool = self.warm_pool
         promoted = demoted = 0
         delta = proposed - current
+        if delta < 0 and self.drain_hook is not None:
+            try:
+                receipt = self.drain_hook(current, proposed)
+            except Exception as e:
+                receipt = {"error": str(e)}
+                log.warning("migration drain before %s/%s shrink "
+                            "%d -> %d failed: %s", self.service_name,
+                            self.config.pod_type, current, proposed, e)
+            if receipt is not None:
+                self.drain_receipts.append(receipt)
         if pool is not None:
             # the pool absorbs as much of the resize as it can: a
             # promotion is pure bookkeeping (the pod is already RUNNING
@@ -662,13 +716,21 @@ class Preemptor:
 
     def __init__(self, multi_fn: Callable[[], object],
                  grace_ticks: int = 3, starve_ticks: int = 2,
-                 metrics=None):
+                 metrics=None,
+                 drain_hook: Optional[Callable[..., object]] = None):
         if grace_ticks < 1 or starve_ticks < 1:
             raise ValueError("grace_ticks and starve_ticks must be >= 1")
         self._multi_fn = multi_fn
         self.grace_ticks = grace_ticks
         self.starve_ticks = starve_ticks
         self.metrics = metrics
+        # drain-before-reclaim (models/migrate.py): called as
+        # drain_hook(victim_service, pod_instances) when the TERM is
+        # issued — the grace window is exactly the time live decode
+        # streams have to migrate off the victim before escalation.
+        # Hook failures never veto the preemption.
+        self.drain_hook = drain_hook
+        self.drain_receipts: List[object] = []
         self.records: List[PreemptionRecord] = []
         self._starve: Dict[str, int] = {}
 
@@ -793,6 +855,18 @@ class Preemptor:
         for task in victim.state.fetch_tasks():
             if task.pod_instance_name in instances:
                 task_ids[task.task_name] = task.task_id
+        if self.drain_hook is not None:
+            # the drain rides INSIDE the grace window: streams migrate
+            # while the victim flushes, so reclaim finds nothing live
+            try:
+                receipt = self.drain_hook(victim_name, list(instances))
+            except Exception as e:
+                receipt = {"error": str(e)}
+                log.warning("migration drain for preemption of %s/%s "
+                            "failed: %s", victim_name,
+                            ",".join(instances), e)
+            if receipt is not None:
+                self.drain_receipts.append(receipt)
         for inst in instances:
             victim.preempt_pod(inst, grace_s=float(self.grace_ticks))
         self.records.append(PreemptionRecord(

@@ -120,6 +120,139 @@ def test_unpack_rejects_corruption():
                     + frame[12 + hlen:])
 
 
+# ------------------------------------------------- DECSTATE wire format
+
+
+def _dec_state(cfg, params, seed=210, steps=4):
+    """A live decode stream frozen mid-generation: the input every
+    DECSTATE test frames, corrupts, or round-trips."""
+    from dcos_commons_tpu.models.serving import PagedServer
+    eng = PagedServer(cfg, params, slots=2, page_size=8, prefill_chunk=8)
+    prompt = _prompt(seed, 13, cfg.vocab_size)
+    slot = eng.submit(prompt, 12, request_id="mig")
+    for _ in range(steps):
+        eng.step()
+    state = eng.export_stream(slot)
+    assert state is not None
+    return state
+
+
+def test_decstate_roundtrip_bf16():
+    from dcos_commons_tpu.models.migrate import (pack_decstate,
+                                                 unpack_decstate)
+    cfg = _cfg()
+    state = _dec_state(cfg, llama.init_params(cfg, jax.random.key(0)))
+    back = unpack_decstate(pack_decstate(state, tenant="gold",
+                                         qos="interactive",
+                                         trace="abc123-def456"))
+    assert back["prompt"] == list(state["prompt"])
+    assert back["tokens"] == [int(t) for t in state["tokens"]]
+    assert back["max_new"] == state["max_new"]
+    assert back["page_size"] == state["page_size"]
+    assert not back["kv_quant"]
+    assert (back["tenant"], back["qos"]) == ("gold", "interactive")
+    assert back["trace"] == "abc123-def456"
+    for side in ("k", "v"):
+        np.testing.assert_array_equal(np.asarray(state["payload"][side]),
+                                      back["payload"][side])
+    if state.get("rng_key") is not None:
+        np.testing.assert_array_equal(np.asarray(state["rng_key"]),
+                                      back["rng_key"])
+
+
+def test_decstate_roundtrip_int8():
+    from dcos_commons_tpu.models.migrate import (pack_decstate,
+                                                 unpack_decstate)
+    cfg = _cfg(kv_quant=True)
+    state = _dec_state(cfg, llama.init_params(cfg, jax.random.key(0)),
+                       seed=211)
+    back = unpack_decstate(pack_decstate(state))
+    assert back["kv_quant"]
+    for side in ("k", "v"):
+        for part in ("q", "s"):
+            np.testing.assert_array_equal(
+                np.asarray(state["payload"][side][part]),
+                back["payload"][side][part])
+
+
+def test_decstate_rejects_corruption():
+    """Version skew, dtype skew, and a mangled RNG key all die in
+    verification — no corrupt stream state ever reaches a reservation."""
+    import struct as _struct
+    from dcos_commons_tpu.models.migrate import (DecStateError,
+                                                 pack_decstate,
+                                                 unpack_decstate)
+    cfg = _cfg()
+    frame = pack_decstate(_dec_state(
+        cfg, llama.init_params(cfg, jax.random.key(0)), seed=212))
+    import hashlib as _hashlib
+    (hlen,) = _struct.unpack_from("<I", frame, 8)
+    meta = json.loads(frame[20:20 + hlen])
+
+    def rebuilt(m):
+        hdr = json.dumps(m).encode()
+        hdig = _hashlib.blake2s(hdr, digest_size=8).digest()
+        return (frame[:8] + _struct.pack("<I", len(hdr)) + hdig + hdr
+                + frame[20 + hlen:])
+
+    with pytest.raises(DecStateError, match="magic"):
+        unpack_decstate(b"NOTADECS" + frame[8:])
+    skewed = dict(meta, version=99)
+    with pytest.raises(DecStateError, match="version"):
+        unpack_decstate(rebuilt(skewed))
+    wrong_dtype = dict(meta)
+    wrong_dtype["arrays"] = [dict(meta["arrays"][0], dtype="complex666")] \
+        + meta["arrays"][1:]
+    with pytest.raises(DecStateError, match="dtype"):
+        unpack_decstate(rebuilt(wrong_dtype))
+    no_tokens = dict(meta, tokens=[])
+    with pytest.raises(DecStateError, match="token"):
+        unpack_decstate(rebuilt(no_tokens))
+    tampered = dict(meta)
+    tampered["prompt"] = [(t + 1) % cfg.vocab_size
+                          for t in meta["prompt"]]
+    with pytest.raises(DecStateError, match="prefix-hash"):
+        unpack_decstate(rebuilt(tampered))
+    if meta["rng_key"] is not None:
+        mangled = dict(meta, rng_key=dict(meta["rng_key"], hex="zz"))
+        with pytest.raises(DecStateError, match="rng_key"):
+            unpack_decstate(rebuilt(mangled))
+
+
+def test_decstate_fuzz_truncation_and_bitflips():
+    """Every truncation point and a spray of single-bit flips either
+    round-trips IDENTICALLY or raises DecStateError — never a crash,
+    never silently-wrong state."""
+    import random as _random
+    from dcos_commons_tpu.models.migrate import (DecStateError,
+                                                 pack_decstate,
+                                                 unpack_decstate)
+    cfg = _cfg()
+    frame = pack_decstate(_dec_state(
+        cfg, llama.init_params(cfg, jax.random.key(0)), seed=213))
+    clean = unpack_decstate(frame)
+    rng = _random.Random(0xDEC57A7E)
+    cuts = {0, 4, 8, 10, 12, len(frame) - 1} | {
+        rng.randrange(len(frame)) for _ in range(24)}
+    for cut in sorted(cuts):
+        with pytest.raises(DecStateError):
+            unpack_decstate(frame[:cut])
+    for _ in range(48):
+        flipped = bytearray(frame)
+        i = rng.randrange(len(frame))
+        flipped[i] ^= 1 << rng.randrange(8)
+        try:
+            back = unpack_decstate(bytes(flipped))
+        except DecStateError:
+            continue
+        # a flip the verifier tolerates must be semantically invisible
+        assert back["prompt"] == clean["prompt"]
+        assert back["tokens"] == clean["tokens"]
+        for side in ("k", "v"):
+            np.testing.assert_array_equal(np.asarray(back["payload"][side]),
+                                          np.asarray(clean["payload"][side]))
+
+
 # ----------------------------------------------------- ship -> adopt path
 
 

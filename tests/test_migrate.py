@@ -1,0 +1,332 @@
+"""Live migration of in-flight decode streams (``models/migrate.py``):
+the MigrationManager drain protocol end to end — freeze/ship/adopt with
+token-exact continuation against the uninterrupted greedy reference,
+the transaction discipline when every destination refuses, the
+MigrateReceiver HTTP hop (cleartext and TLS), router "migrated-to"
+redirects, and the ``MIGRATE_*`` env contract."""
+
+import importlib.util
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tests._jax_cpu  # noqa: F401
+
+from dcos_commons_tpu.models import llama, serving
+from dcos_commons_tpu.models.migrate import (DecStateError,
+                                             MigrateReceiver,
+                                             MigrationManager,
+                                             RemoteReplica,
+                                             manager_from_env,
+                                             pack_decstate, ship_stream)
+from dcos_commons_tpu.models.router import HashRing, Router
+from dcos_commons_tpu.scheduler.elastic import MigrationConfig
+
+
+def _cfg(**kw):
+    return llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                  attn_impl="dense", **kw)
+
+
+def _solo(cfg, params, prompt, steps):
+    toks = llama.generate_stepwise(cfg, params,
+                                   jnp.asarray([prompt], jnp.int32),
+                                   steps)
+    return [int(t) for t in toks[0]]
+
+
+def _prompt(seed, n, vocab):
+    return [int(t) for t in jax.random.randint(
+        jax.random.key(seed), (n,), 0, vocab)]
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("slots", 2)
+    return serving.PagedServer(cfg, params, page_size=8,
+                               prefill_chunk=8, **kw)
+
+
+def _drain(engine):
+    for _ in range(200):
+        if not engine.requests_active():
+            break
+        engine.step()
+    return dict(engine.finished)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return cfg, llama.init_params(cfg, jax.random.key(0))
+
+
+# ------------------------------------------------------------ drain protocol
+
+
+def test_drain_resumes_token_exact(model):
+    """A stream frozen mid-decode on the victim and drained through the
+    DECSTATE round-trip finishes on the destination with EXACTLY the
+    token sequence the uninterrupted engine would have produced."""
+    cfg, params = model
+    src, dst = _engine(cfg, params), _engine(cfg, params)
+    prompt = _prompt(300, 13, cfg.vocab_size)
+    slot = src.submit(prompt, 12, request_id="mig-1")
+    for _ in range(5):
+        src.step()
+    frozen = len(src.requests[slot].tokens)
+    assert 0 < frozen < 12
+
+    mgr = MigrationManager(ring=HashRing(["dst"], vnodes=8), page_size=8)
+    receipt = mgr.drain(src, "src", [("dst", dst)])
+    assert receipt == {"victim": "src", "live": 1, "migrated": 1,
+                       "resubmitted": 0, "failed": 0}
+    # the victim's copy is gone, accounted as a migration not a result
+    assert src.requests[slot] is None
+    assert "mig-1" not in src.finished
+    assert src.page_stats()["migrated_out"] == 1
+    assert src.ledger_violations() == []
+
+    done = _drain(dst)
+    assert done["mig-1"] == _solo(cfg, params, prompt, 12)
+    assert dst.page_stats()["migrated_in"] == 1
+    assert dst.ledger_violations() == []
+    st = mgr.stats()
+    assert st["migrated"] == 1 and st["failed"] == 0
+    assert st["pause_ms"]["p95"] >= 0.0
+    assert st["moves"][-1][0] == "src" and st["moves"][-1][1] == "dst"
+
+
+def test_prefilling_stream_resubmits(model):
+    """A stream that has not emitted a token yet has no decode state to
+    ship — the drain re-submits its prompt on the destination, which is
+    already token-exact."""
+    cfg, params = model
+    src, dst = _engine(cfg, params), _engine(cfg, params)
+    prompt = _prompt(301, 13, cfg.vocab_size)
+    src.submit(prompt, 10, request_id="pre-1")   # never stepped
+    mgr = MigrationManager(page_size=8)
+    receipt = mgr.drain(src, "src", [("dst", dst)])
+    assert receipt["resubmitted"] == 1 and receipt["failed"] == 0
+    assert _drain(dst)["pre-1"] == _solo(cfg, params, prompt, 10)
+
+
+def test_refused_drain_leaves_victim_untouched(model):
+    """Every destination at capacity: the drain reports the failure and
+    the victim stream keeps decoding LOCALLY, token-exact, with clean
+    ledgers on both sides — a failed migration must cost nothing."""
+    cfg, params = model
+    src, dst = _engine(cfg, params), _engine(cfg, params)
+    for i in range(2):                       # dst: both slots busy
+        dst.submit(_prompt(310 + i, 9, cfg.vocab_size), 16,
+                   request_id=f"busy-{i}")
+        dst.step()
+    prompt = _prompt(302, 13, cfg.vocab_size)
+    slot = src.submit(prompt, 12, request_id="stay-1")
+    for _ in range(5):
+        src.step()
+    mgr = MigrationManager(page_size=8)
+    receipt = mgr.drain(src, "src", [("dst", dst)])
+    assert receipt["failed"] == 1 and receipt["migrated"] == 0
+    assert src.requests[slot] is not None
+    assert src.page_stats()["migrated_out"] == 0
+    assert dst.page_stats()["migrated_in"] == 0
+    assert dst.ledger_violations() == []
+    assert _drain(src)["stay-1"] == _solo(cfg, params, prompt, 12)
+    assert src.ledger_violations() == []
+
+
+def test_disabled_manager_is_a_noop(model):
+    cfg, params = model
+    src, dst = _engine(cfg, params), _engine(cfg, params)
+    slot = src.submit(_prompt(303, 9, cfg.vocab_size), 8,
+                      request_id="off-1")
+    src.step()
+    mgr = MigrationManager(enable=False, page_size=8)
+    receipt = mgr.drain(src, "src", [("dst", dst)])
+    assert receipt["live"] == 0 and receipt["migrated"] == 0
+    assert src.requests[slot] is not None
+
+
+def test_destination_order_prefers_ring_then_appends_unknown():
+    ring = HashRing(["a", "b", "c"], vnodes=8)
+    mgr = MigrationManager(ring=ring, page_size=8)
+    prompt = list(range(16))
+    order = mgr.destination_order(prompt, ["c", "b", "a", "x"])
+    assert sorted(order) == ["a", "b", "c", "x"]
+    assert order[-1] == "x"                  # ring-unknown goes last
+    pref = [n for n in ring.preference(
+        __import__("dcos_commons_tpu.models.router",
+                   fromlist=["route_key"]).route_key(prompt, 8))
+            if n in ("a", "b", "c")]
+    assert order[:3] == pref
+
+
+# --------------------------------------------------------------- HTTP hop
+
+
+def test_receiver_http_e2e(model):
+    """Export on A, ship the DECSTATE frame over real HTTP into B's
+    MigrateReceiver, release the victim copy — the stream finishes on B
+    token-exact and healthz shows the adoption."""
+    cfg, params = model
+    src, dst = _engine(cfg, params), _engine(cfg, params)
+    recv = MigrateReceiver(dst, port=0, host="127.0.0.1").start()
+    try:
+        peer = f"http://127.0.0.1:{recv.port}"
+        prompt = _prompt(320, 13, cfg.vocab_size)
+        slot = src.submit(prompt, 12, request_id="wire-1")
+        for _ in range(5):
+            src.step()
+        state = src.export_stream(slot)
+        body = ship_stream(peer, pack_decstate(state, tenant="gold",
+                                               request_id="wire-1"))
+        assert body["ok"] and body["generated"] == len(state["tokens"])
+        src.release_stream(slot)
+        assert _drain(dst)["wire-1"] == _solo(cfg, params, prompt, 12)
+
+        with urllib.request.urlopen(peer + "/v1/healthz",
+                                    timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["migrated_in"] == 1
+
+        with pytest.raises(DecStateError, match="magic|rejected|400"):
+            ship_stream(peer, b"NOTADECS" + b"\0" * 32)
+    finally:
+        recv.stop()
+
+
+def test_remote_replica_maps_capacity_503_to_none(model):
+    """A peer out of slots answers 503; RemoteReplica turns that into
+    None so the manager tries the next survivor instead of erroring."""
+    cfg, params = model
+    src, dst = _engine(cfg, params), _engine(cfg, params)
+    for i in range(2):
+        dst.submit(_prompt(330 + i, 9, cfg.vocab_size), 16,
+                   request_id=f"full-{i}")
+        dst.step()
+    recv = MigrateReceiver(dst, port=0, host="127.0.0.1").start()
+    try:
+        slot = src.submit(_prompt(331, 13, cfg.vocab_size), 12,
+                          request_id="spill-1")
+        for _ in range(5):
+            src.step()
+        state = src.export_stream(slot)
+        remote = RemoteReplica(f"http://127.0.0.1:{recv.port}")
+        assert remote.import_stream(state, request_id="spill-1") is None
+        assert src.requests[slot] is not None     # victim untouched
+    finally:
+        recv.stop()
+
+
+@pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="TLS migration hop needs the cryptography package")
+def test_receiver_serves_migrations_over_tls(model, tmp_path,
+                                             monkeypatch):
+    """With the ``TPU_TLS_*`` env set the receiver comes up HTTPS (the
+    PrefillWorker lazy hook, followed through onto the migration path)
+    and ``ship_stream`` verifies it through the same CA contract as
+    every other control-plane hop."""
+    from dcos_commons_tpu.security import mint_server_credentials
+    from dcos_commons_tpu.state import MemPersister
+
+    creds = mint_server_credentials(MemPersister(), "migrate-svc")
+    cert, key, ca = (tmp_path / "c.pem", tmp_path / "k.pem",
+                     tmp_path / "ca.pem")
+    cert.write_bytes(creds.cert_pem)
+    key.write_bytes(creds.key_pem)
+    ca.write_bytes(creds.ca_pem)
+    monkeypatch.setenv("TPU_TLS_CERT", str(cert))
+    monkeypatch.setenv("TPU_TLS_KEY", str(key))
+    monkeypatch.setenv("TPU_TLS_CA", str(ca))
+
+    cfg, params = model
+    src, dst = _engine(cfg, params), _engine(cfg, params)
+    recv = MigrateReceiver(dst, port=0, host="127.0.0.1").start()
+    try:
+        prompt = _prompt(340, 13, cfg.vocab_size)
+        slot = src.submit(prompt, 10, request_id="tls-1")
+        for _ in range(5):
+            src.step()
+        state = src.export_stream(slot)
+        body = ship_stream(f"https://127.0.0.1:{recv.port}",
+                           pack_decstate(state, request_id="tls-1"))
+        assert body["ok"]
+        src.release_stream(slot)
+        assert _drain(dst)["tls-1"] == _solo(cfg, params, prompt, 10)
+        # a cleartext client cannot talk to the TLS port
+        with pytest.raises(DecStateError):
+            ship_stream(f"http://127.0.0.1:{recv.port}",
+                        b"NOTADECS")
+    finally:
+        recv.stop()
+
+
+# ------------------------------------------------------- router redirects
+
+
+def test_router_follows_migrations_and_collapses_chains():
+    a, b, c = "http://a:1", "http://b:1", "http://c:1"
+    router = Router([a, b, c], host="127.0.0.1", page_size=4)
+    router.note_migration(a, b)
+    router.note_migration(b, c)   # two scale events; no chain via b
+    assert router._apply_redirects([a, b, c]) == [c]
+    active = router.stats()["migration_redirects_active"]
+    assert active == {a: c, b: c}
+    assert router.stats()["migration_redirects"] == 2
+    # the destination departs: its redirects die with it
+    router.set_replicas([a, b])
+    assert router.stats()["migration_redirects_active"] == {}
+
+
+def test_router_rejoined_victim_takes_traffic_directly():
+    a, b = "http://a:1", "http://b:1"
+    router = Router([a, b], host="127.0.0.1", page_size=4)
+    router.note_migration(a, b)
+    router.set_replicas([b])      # victim leaves; redirect survives
+    assert router.stats()["migration_redirects_active"] == {a: b}
+    router.set_replicas([a, b])   # fresh replica under the old name
+    assert router.stats()["migration_redirects_active"] == {}
+    assert router._apply_redirects([a, b]) == [a, b]
+
+
+def test_router_self_loop_and_idempotent_apply():
+    a, b = "http://a:1", "http://b:1"
+    router = Router([a, b], host="127.0.0.1", page_size=4)
+    router.note_migration(a, a)   # ignored
+    assert router._apply_redirects([a, b]) == [a, b]
+    router.note_migration(a, b)
+    assert router._apply_redirects([a, b]) == [b]
+    # a cycle (b back to a) must terminate, not spin
+    router.note_migration(b, a)
+    plan = router._apply_redirects([a, b])
+    assert plan and set(plan) <= {a, b}
+
+
+# ------------------------------------------------------------- env contract
+
+
+def test_manager_from_env_contract():
+    mgr = manager_from_env({})
+    assert (mgr.enable, mgr.timeout_s, mgr.max_inflight) == (True, 30.0, 2)
+    mgr = manager_from_env({"MIGRATE_ENABLE": "off",
+                            "MIGRATE_TIMEOUT_S": "7.5",
+                            "MIGRATE_MAX_INFLIGHT": "4"})
+    assert (mgr.enable, mgr.timeout_s, mgr.max_inflight) == (False, 7.5, 4)
+
+
+def test_migration_config_from_env_and_validation():
+    cfg = MigrationConfig.from_env({})
+    assert (cfg.enable, cfg.timeout_s, cfg.max_inflight) == (True, 30.0, 2)
+    cfg = MigrationConfig.from_env({"MIGRATE_ENABLE": "0",
+                                    "MIGRATE_TIMEOUT_S": "12",
+                                    "MIGRATE_MAX_INFLIGHT": "1"})
+    assert (cfg.enable, cfg.timeout_s, cfg.max_inflight) == (False, 12.0, 1)
+    with pytest.raises(ValueError):
+        MigrationConfig(timeout_s=0.0)
+    with pytest.raises(ValueError):
+        MigrationConfig(max_inflight=0)

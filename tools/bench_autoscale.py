@@ -27,10 +27,19 @@ weather OFF, a scripted Poisson-ish load swing ON — twice per seed:
 All three variants must emit bit-exact greedy tokens; ``warm`` and
 ``peer`` must each beat ``disk`` on decision -> first token.
 
+**Migrate mode** (``--migrate``, Round 15) is the zero-drop A/B: a
+scripted decommission of one of two REAL paged engines under Poisson
+load, once with the ``models/migrate.py`` drain (every live stream
+ships to the survivor over the DECSTATE frame and must finish
+token-exact; dropped_streams must be 0) and once without (the reclaim
+aborts them — today's count, the baseline). Receipts land in
+``bench_r15/migration.jsonl`` with the migration pause p50/p95.
+
 Receipts land in ``bench_r14/autoscale.jsonl`` (one line per run plus a
 summary per seed). Exit 1 if any run fails its invariants, the
 autoscaled variant fails to beat the static shed rate, token parity
-breaks, or the cold-start ladder fails to collapse.
+breaks, the cold-start ladder fails to collapse, or a migration run
+drops or diverges a stream.
 """
 
 from __future__ import annotations
@@ -90,6 +99,165 @@ def run_variant(seed: int, ticks: int, autoscale: bool) -> dict:
             {"tick": t, "instance": inst, "step": step}
             for t, inst, step in soak.flushsim.resumes],
         "plan_statuses": report.plan_statuses,
+    }
+
+
+# -- live-migration A/B -----------------------------------------------------
+
+# scripted decommission mid-storm: arrivals stop at ARRIVAL_TICKS, the
+# victim replica is reclaimed at DECOM_TICK — late enough that it holds
+# live mid-decode streams, early enough that they are nowhere near done
+MIGRATE_TICKS = 14
+MIGRATE_ARRIVAL_TICKS = 8
+MIGRATE_DECOM_TICK = 6
+MIGRATE_LAMBDA = 1.2
+
+
+def run_migration(seed: int, migrate: bool) -> dict:
+    """One scripted scale-down under Poisson load over two REAL paged
+    engines behind a hash ring: replica B is decommissioned mid-stream
+    at ``MIGRATE_DECOM_TICK``. With ``migrate=True`` the
+    :class:`~dcos_commons_tpu.models.migrate.MigrationManager` drains
+    B's live streams to A through the DECSTATE wire round-trip first
+    (dropped_streams must be 0 and every migrated stream must finish
+    token-exact against the uninterrupted greedy reference); with
+    ``migrate=False`` the reclaim aborts them — today's behaviour, the
+    baseline the receipt quantifies."""
+    import math
+    import random as _random
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama, serving
+    from dcos_commons_tpu.models.migrate import MigrationManager
+    from dcos_commons_tpu.models.router import HashRing, route_key
+    from dcos_commons_tpu.utils.stats import percentiles
+
+    cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=64, attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.key(0))
+    kw = dict(slots=4, page_size=8, prefill_chunk=8)
+    engines = {"A": serving.PagedServer(cfg, params, **kw),
+               "B": serving.PagedServer(cfg, params, **kw)}
+    ring = HashRing(["A", "B"], vnodes=16)
+    rng = _random.Random(seed)
+
+    def poisson(lam: float) -> int:
+        L, k, p = math.exp(-lam), 0, 1.0
+        while True:
+            p *= rng.random()
+            if p <= L:
+                return k
+            k += 1
+
+    mgr = MigrationManager(enable=migrate, ring=ring, page_size=8)
+    queues = {"A": [], "B": []}
+    tokens_ref, prompts, budgets = {}, {}, {}
+    live_names = ["A", "B"]
+    serial = 0
+    dropped_rids: list = []
+    receipt = {"migrated": 0, "resubmitted": 0, "failed": 0, "live": 0}
+
+    def pump(name: str) -> None:
+        q = queues[name]
+        while q:
+            item = q[0]
+            slot = engines[name].submit(item["prompt"], item["max_new"],
+                                        request_id=item["rid"])
+            if slot is None:
+                break
+            q.pop(0)
+            tokens_ref[item["rid"]] = engines[name].requests[slot].tokens
+
+    for tick in range(MIGRATE_TICKS):
+        if tick < MIGRATE_ARRIVAL_TICKS:
+            for _ in range(poisson(MIGRATE_LAMBDA)):
+                serial += 1
+                rid = f"q{serial}"
+                prompt = [rng.randrange(cfg.vocab_size)
+                          for _ in range(rng.randint(6, 12))]
+                max_new = rng.randint(10, 16)
+                prompts[rid], budgets[rid] = prompt, max_new
+                target = next(
+                    (c for c in ring.preference(route_key(prompt, 8))
+                     if c in live_names), live_names[0])
+                queues[target].append({"rid": rid, "prompt": prompt,
+                                       "max_new": max_new})
+        if tick == MIGRATE_DECOM_TICK:
+            victim = engines["B"]
+            live_rids = [r.request_id for r in victim.requests
+                         if r is not None]
+            receipt["live"] = len(live_rids)
+            if migrate:
+                # the drain rides the grace window: a destination with
+                # no free slot refuses (victim stream untouched), the
+                # survivor steps — retirements free slots — and the
+                # drain retries until the victim is empty
+                remaining = list(live_rids)
+                for _ in range(24):
+                    r = mgr.drain(victim, "B", [("A", engines["A"])])
+                    receipt["migrated"] += r["migrated"]
+                    receipt["resubmitted"] += r["resubmitted"]
+                    # drained streams live on A now — re-point the
+                    # token refs before A steps (a short stream can
+                    # finish and retire during the grace window)
+                    for x in engines["A"].requests:
+                        if x is not None and x.request_id in live_rids:
+                            tokens_ref[x.request_id] = x.tokens
+                    remaining = [x.request_id for x in victim.requests
+                                 if x is not None]
+                    if not remaining:
+                        break
+                    engines["A"].step()
+                dropped_rids = remaining
+            else:
+                dropped_rids = live_rids
+            victim.abort_active()          # the reclaim itself
+            queues["A"].extend(queues["B"])
+            queues["B"] = []
+            live_names = ["A"]
+            ring.remove("B")
+        for name in live_names:
+            pump(name)
+            engines[name].step()
+    for _ in range(400):
+        pump("A")
+        if not engines["A"].requests_active() and not queues["A"]:
+            break
+        engines["A"].step()
+
+    moved = [rid for rid in live_rids if rid not in dropped_rids]
+    done = [rid for rid in tokens_ref
+            if rid not in dropped_rids
+            and len(tokens_ref[rid]) >= budgets[rid]]
+    # token-exactness of every MIGRATED stream against the solo greedy
+    # reference — the zero-drop claim is worthless if resumed streams
+    # diverge
+    exact = True
+    for rid in moved:
+        want = [int(t) for t in llama.generate_stepwise(
+            cfg, params, jnp.asarray(prompts[rid])[None, :],
+            budgets[rid])[0]]
+        if tokens_ref.get(rid) != want:
+            exact = False
+    return {
+        "metric": "migration",
+        "variant": "migrated" if migrate else "baseline",
+        "seed": seed,
+        "ticks": MIGRATE_TICKS,
+        "decom_tick": MIGRATE_DECOM_TICK,
+        "requests": serial,
+        "completed": len(done),
+        "live_at_decommission": len(live_rids),
+        "migrated": receipt["migrated"],
+        "resubmitted": receipt["resubmitted"],
+        "dropped_streams": len(dropped_rids),
+        "token_exact": exact,
+        "pause_ms": percentiles(mgr.pause_ms),
+        "engine_stats": {
+            n: {k: engines[n].page_stats()[k]
+                for k in ("migrated_in", "migrated_out", "pages_free")}
+            for n in engines},
     }
 
 
@@ -267,16 +435,57 @@ def main(argv=None) -> int:
                     help=f"storm ticks per run (default {DEFAULT_TICKS})")
     ap.add_argument("--out", default="bench_r14/autoscale.jsonl",
                     help="receipts file (default bench_r14/autoscale.jsonl)")
-    ap.add_argument("--mode", choices=("all", "elastic", "coldstart"),
+    ap.add_argument("--mode", choices=("all", "elastic", "coldstart",
+                                       "migrate"),
                     default="all",
                     help="which benches to run (default all)")
+    ap.add_argument("--migrate", action="store_true",
+                    help="shorthand for --mode migrate (live-migration "
+                         "A/B; receipts default to "
+                         "bench_r15/migration.jsonl)")
     ap.add_argument("--coldstart-seeds", type=int, default=1,
                     help="cold-start ladders to run (default 1)")
     args = ap.parse_args(argv)
+    if args.migrate:
+        args.mode = "migrate"
+    if args.mode == "migrate" \
+            and args.out == ap.get_default("out"):
+        args.out = "bench_r15/migration.jsonl"
 
     lines = []
     failed = False
-    for seed in range(args.seeds if args.mode != "coldstart" else 0):
+    if args.mode == "migrate":
+        for seed in range(args.seeds):
+            with_m = run_migration(seed, migrate=True)
+            without = run_migration(seed, migrate=False)
+            ok = (with_m["dropped_streams"] == 0
+                  and with_m["token_exact"]
+                  and without["dropped_streams"] > 0
+                  and with_m["live_at_decommission"] > 0)
+            summary = {
+                "metric": "migration_summary",
+                "seed": seed,
+                "live_at_decommission": with_m["live_at_decommission"],
+                "dropped_with_migration": with_m["dropped_streams"],
+                "dropped_without_migration": without["dropped_streams"],
+                "migrated": with_m["migrated"],
+                "resubmitted": with_m["resubmitted"],
+                "token_exact": with_m["token_exact"],
+                "pause_ms_p50": with_m["pause_ms"].get("p50"),
+                "pause_ms_p95": with_m["pause_ms"].get("p95"),
+                "ok": ok,
+            }
+            lines += [with_m, without, summary]
+            print(f"migrate seed {seed}: live={summary['live_at_decommission']} "
+                  f"dropped with={summary['dropped_with_migration']} "
+                  f"without={summary['dropped_without_migration']} "
+                  f"pause_p95={summary['pause_ms_p95']}ms "
+                  f"exact={summary['token_exact']} "
+                  f"{'OK' if ok else 'FAIL'}")
+            if not ok:
+                failed = True
+    for seed in range(args.seeds
+                      if args.mode in ("all", "elastic") else 0):
         auto = run_variant(seed, args.ticks, autoscale=True)
         static = run_variant(seed, args.ticks, autoscale=False)
         improved = auto["shed_rate"] < static["shed_rate"]
@@ -314,7 +523,7 @@ def main(argv=None) -> int:
         if not ok:
             failed = True
 
-    if args.mode != "elastic":
+    if args.mode in ("all", "coldstart"):
         for seed in range(args.coldstart_seeds):
             rows = run_coldstart(seed)
             lines += rows
