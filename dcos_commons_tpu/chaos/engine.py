@@ -118,6 +118,17 @@ class FaultConfig:
     # receipts. Draws from a derived RNG private to the migration sim,
     # so the legacy pinned seeds replay unperturbed.
     migrate_mid_stream: float = 0.0
+    # KV-tier faults (soak harness page-ledger sim, models/paging.py
+    # PageTierStore seam): a demoted host/disk frame goes corrupt in
+    # place — the digest check must detect EVERY corrupt frame at
+    # promote time and fall back to recompute, never install bad bytes
+    # (kv_tier_corrupt); a pending tier promote races a radix evict of
+    # the same chain — the content must resolve to exactly ONE owner,
+    # tier or radix, never both and never leaked (promote_during_evict).
+    # Both draw from a derived RNG private to the tier sim, so the
+    # legacy pinned seeds replay unperturbed.
+    kv_tier_corrupt: float = 0.0
+    promote_during_evict: float = 0.0
     max_delay_ticks: int = 3
 
     FIELDS = ("status_drop", "status_delay", "status_dup", "status_reorder",
@@ -127,7 +138,8 @@ class FaultConfig:
               "preempt_storm", "victim_crash_in_grace", "scale_mid_crash",
               "router_replica_down", "tenant_flood",
               "warm_promote_crash", "weight_fetch_lost",
-              "migrate_mid_stream")
+              "migrate_mid_stream", "kv_tier_corrupt",
+              "promote_during_evict")
 
     @classmethod
     def none(cls) -> "FaultConfig":
@@ -160,7 +172,8 @@ class FaultConfig:
                        victim_crash_in_grace=0.0, scale_mid_crash=0.0,
                        router_replica_down=0.0, tenant_flood=0.0,
                        warm_promote_crash=0.0, weight_fetch_lost=0.0,
-                       migrate_mid_stream=0.0)
+                       migrate_mid_stream=0.0, kv_tier_corrupt=0.0,
+                       promote_during_evict=0.0)
 
 
 def parse_faults(arg: str) -> FaultConfig:

@@ -31,6 +31,15 @@ to a real reference-era incident class:
    every decode-tier page reference it reserved: a page from an aborted
    transfer may only stay referenced by its surviving legitimate owners,
    never by the dead transfer itself.
+16. **kv-tier single owner** — a prefix chain lives in the radix XOR the
+    demoted host/disk tier, never both: a promote racing an evict
+    (``promote_during_evict``) that leaves two owners would double-serve
+    stale KV bytes after the radix copy mutates.
+17. **kv-tier corrupt audit** — every corrupt frame injected into the
+    tier (``kv_tier_corrupt``) is accounted for: detected by the digest
+    check at promote time, safely dropped before any promote touched it
+    (overwritten / discarded / capacity-evicted), or still resident.
+    Any other outcome means bad bytes were installed into a live pool.
 """
 
 from __future__ import annotations
@@ -69,6 +78,7 @@ class InvariantChecker:
         out += self._check_backoff_monotone(tick)
         out += self._check_page_ledger(tick)
         out += self._check_kv_ship(tick)
+        out += self._check_kv_tier(tick)
         return out
 
     def _check_unique_live_tasks(self, tick: int) -> List[Violation]:
@@ -180,6 +190,40 @@ class InvariantChecker:
                             f"page {p} from aborted transfer holds "
                             f"{have} refs, surviving owners account for "
                             f"{want} (adoption unwind leaked)", tick))
+        return out
+
+    def _check_kv_tier(self, tick: int) -> List[Violation]:
+        """Audit the demoted-page tier (``models/paging.py``
+        ``PageTierStore`` seam): a chain is owned by the radix XOR the
+        tier, and every injected corrupt frame is either detected at
+        promote time, safely dropped before any promote touched it, or
+        still resident in the tier — never silently installed."""
+        out = []
+        for sim in getattr(self._runner, "page_sims", ()):
+            tier = getattr(sim, "tier", None)
+            if tier is None:
+                continue
+            radix = getattr(sim, "radix", None)
+            if radix is not None and tier:
+                resident = {tuple(radix.prefix_tokens(n))
+                            for n in radix._iter_nodes()}
+                for key in sorted(set(tier) & resident):
+                    out.append(Violation(
+                        "kv-tier-owner",
+                        f"chain of {len(key)} tokens resident in the "
+                        "radix AND the demoted tier (promote/evict race "
+                        "left two owners)", tick))
+            injected = getattr(sim, "tier_corrupt_injected", 0)
+            detected = getattr(sim, "tier_corrupt_detected", 0)
+            lost = getattr(sim, "tier_corrupt_lost", 0)
+            in_tier = sum(1 for c in tier.values() if c)
+            if injected != detected + lost + in_tier:
+                out.append(Violation(
+                    "kv-tier-corrupt-audit",
+                    f"{injected} corrupt frames injected != {detected} "
+                    f"detected + {lost} safely dropped + {in_tier} still "
+                    "resident — a corrupt frame was installed or "
+                    "double-counted", tick))
         return out
 
     def _check_backoff_monotone(self, tick: int) -> List[Violation]:

@@ -114,6 +114,29 @@ class _PageServingSim:
         # that none of these pages stayed refcounted past its owners
         self.ship_aborted: List[List[int]] = []
         self.ship_adopted = 0
+        # KV-tier traffic (models/paging.py PageTierStore seam) on its
+        # OWN derived rng: radix evictions demote their chain to a
+        # miniature host tier (prefix-tokens -> corrupt?), promotes land
+        # one tick deferred exactly like the engine's _tier_tick, and
+        # arming kv_tier_corrupt / promote_during_evict never perturbs
+        # the main or ship draw order — pinned corpus seeds replay
+        # bitwise. The demoter is only attached once the tier sim has
+        # armed (tier_active), so legacy runs never even see it.
+        self.tier_rng = random.Random((seed << 24) ^ 0x9E3779B97F4A7C15)
+        self.tier: Dict[tuple, bool] = {}     # prefix tokens -> corrupt?
+        self.tier_cap = 8
+        self.tier_pending: List[tuple] = []   # (due_tick, prefix key)
+        self.tier_active = False
+        self.tier_demoted = 0
+        self.tier_promoted = 0
+        self.tier_corrupt_injected = 0
+        self.tier_corrupt_detected = 0
+        # corrupt frames that left the tier WITHOUT being promoted:
+        # overwritten by a fresh re-demote, discarded when the radix
+        # adopted their chain, or dropped at capacity — all safe exits
+        # (the bad bytes never installed), audited by the invariant
+        self.tier_corrupt_lost = 0
+        self.tier_fallbacks = 0
 
     def expected_refs(self) -> Dict[int, int]:
         out: Dict[int, int] = {}
@@ -135,7 +158,8 @@ class _PageServingSim:
         own_needed = -(-len(prompt) // ps) - len(shared)
         pages = self.pool.alloc(own_needed)
         if pages is None:
-            self.radix.evict(own_needed - self.pool.free_count())
+            self.radix.evict(own_needed - self.pool.free_count(),
+                             demoter=self._demoter())
             pages = self.pool.alloc(own_needed)
         if pages is None:                     # pool genuinely full: reject
             for p in shared:
@@ -144,11 +168,43 @@ class _PageServingSim:
         self.streams[self._next_sid] = (prompt, shared + pages)
         self._next_sid += 1
 
+    def _demoter(self):
+        """The radix-evict demote hook, or ``None`` while the tier sim
+        has never armed — legacy pinned seeds replay with eviction
+        byte-identical to before the tier existed."""
+        return self._tier_demote if self.tier_active else None
+
+    def _tier_demote(self, page: int, prefix_tokens: List[int]) -> None:
+        # mirrors PagedServer._demote: the evicted chain's bytes land in
+        # the host tier as a frame; capacity overflow drops the oldest
+        key = tuple(prefix_tokens)
+        if self.tier.pop(key, False):         # fresh bytes replace rot
+            self.tier_corrupt_lost += 1
+        self.tier[key] = False
+        self.tier_demoted += 1
+        while len(self.tier) > self.tier_cap:
+            if self.tier.pop(next(iter(self.tier))):
+                self.tier_corrupt_lost += 1
+
+    def _tier_discard(self, prompt: List[int]) -> None:
+        """Single-owner rule: once the radix adopts ``prompt``, every
+        tier frame holding one of its full-page prefix chains is stale
+        — discard it, exactly like ``PagedServer._radix_adopt``."""
+        if not self.tier:
+            return
+        full = len(prompt) // self.pool.page_size
+        pfx = prompt[:full * self.pool.page_size]
+        for k in [k for k in self.tier
+                  if len(k) <= len(pfx) and list(k) == pfx[:len(k)]]:
+            if self.tier.pop(k):
+                self.tier_corrupt_lost += 1
+
     def _retire(self, sid: int) -> None:
         prompt, pages = self.streams.pop(sid)
         full = len(prompt) // self.pool.page_size
         if full:                              # adopt BEFORE the unref
             self.radix.insert(prompt, pages[:full])
+            self._tier_discard(prompt)
         for p in pages:
             self.pool.unref(p)
 
@@ -213,7 +269,8 @@ class _PageServingSim:
             own_needed = -(-len(prompt) // ps) - len(shared)
             pages = self.pool.alloc(own_needed)
             if pages is None:
-                self.radix.evict(own_needed - self.pool.free_count())
+                self.radix.evict(own_needed - self.pool.free_count(),
+                                 demoter=self._demoter())
                 pages = self.pool.alloc(own_needed)
             if pages is None:                 # pages-free gate: shed
                 for p in shared:
@@ -236,6 +293,86 @@ class _PageServingSim:
             else:                             # no slot: drop the span
                 for p in shared + pages:
                     self.pool.unref(p)
+
+    def tier_tick(self, tick: int, corrupt_p: float, race_p: float,
+                  count, log) -> None:
+        """KV-tier weather over the same ledger: frames in the host
+        tier go corrupt in place (``kv_tier_corrupt`` — the digest
+        check must detect every one at promote time and fall back to
+        recompute), and an eviction storm fires while promotes are
+        pending (``promote_during_evict`` — the chain must resolve to
+        exactly one owner, tier or radix). Promotes land one tick
+        deferred, exactly the engine's async one-step deferral.
+        No-draw when disarmed; the settle phase still drains promotes
+        already pending."""
+        armed = bool(corrupt_p or race_p)
+        self.tier_active = self.tier_active or armed
+        if not self.tier_active:
+            return
+        if not armed and not self.tier and not self.tier_pending:
+            return
+        rng, ps = self.tier_rng, self.pool.page_size
+        # a resident frame's bytes rot (disk bit-flip / host stomp)
+        if corrupt_p and self.tier and rng.random() < corrupt_p:
+            victim = rng.choice(sorted(self.tier))
+            if not self.tier[victim]:
+                self.tier[victim] = True
+                self.tier_corrupt_injected += 1
+                count("kv_tier_corrupt")
+                log(f"tick {tick}: kv_tier_corrupt frame "
+                    f"({len(victim) // ps} pages)")
+        # an eviction storm races the pending promotes: victims demote
+        # (possibly re-demoting a chain a promote is about to install)
+        if race_p and self.tier_pending and rng.random() < race_p:
+            count("promote_during_evict")
+            log(f"tick {tick}: promote_during_evict storm "
+                f"({len(self.tier_pending)} promotes in flight)")
+            self.radix.evict(2, demoter=self._tier_demote)
+        # land promotes scheduled last tick (the engine's _tier_tick)
+        due = [k for t, k in self.tier_pending if t <= tick]
+        self.tier_pending = [(t, k) for t, k in self.tier_pending
+                             if t > tick]
+        for key in due:
+            corrupt = self.tier.get(key)
+            if corrupt is None:
+                # frame gone while deferred (dropped, or the radix
+                # adopted the chain first): recompute fallback — the
+                # race resolved to one owner, never two
+                self.tier_fallbacks += 1
+                continue
+            if corrupt:
+                # digest check rejects the frame: drop it, recompute
+                del self.tier[key]
+                self.tier_corrupt_detected += 1
+                self.tier_fallbacks += 1
+                log(f"tick {tick}: corrupt tier frame rejected at "
+                    "promote, recompute fallback")
+                continue
+            prompt = list(key)
+            shared, _ = self.radix.lookup(prompt)
+            own = len(prompt) // ps - len(shared)
+            pages = self.pool.alloc(own)
+            if pages is None:
+                self.radix.evict(own - self.pool.free_count(),
+                                 demoter=self._tier_demote)
+                pages = self.pool.alloc(own)
+            if pages is None:                 # HBM full: frame stays put
+                for p in shared:
+                    self.pool.unref(p)
+                self.tier_fallbacks += 1
+                continue
+            self.radix.insert(prompt, shared + pages)
+            self._tier_discard(prompt)        # single owner: radix now
+            for p in shared + pages:
+                self.pool.unref(p)
+            self.tier_promoted += 1
+        # a prefix hit on a demoted chain schedules its promote for the
+        # NEXT tick — the stream defers one step, the batch never stalls
+        if armed and self.tier and rng.random() < 0.5:
+            pending = {k for _, k in self.tier_pending}
+            hits = [k for k in sorted(self.tier) if k not in pending]
+            if hits:
+                self.tier_pending.append((tick + 1, rng.choice(hits)))
 
 
 @dataclass
@@ -426,6 +563,9 @@ class _Soak:
             self.page_sim.ship_tick(tick, self.config.kv_ship_lost,
                                     self.config.kv_ship_slow,
                                     self._count, self._log)
+            self.page_sim.tier_tick(tick, self.config.kv_tier_corrupt,
+                                    self.config.promote_during_evict,
+                                    self._count, self._log)
             # release the transport's due events first so zombies from
             # late launches are visible to this tick's reconciliation
             self.chaos.tick()
@@ -442,6 +582,7 @@ class _Soak:
             tick = self.ticks + i
             self.page_sim.tick(tick, 0.0, self._count, self._log)
             self.page_sim.ship_tick(tick, 0.0, 0.0, self._count, self._log)
+            self.page_sim.tier_tick(tick, 0.0, 0.0, self._count, self._log)
             self.chaos.tick()
             self._cycle()
             self._check(tick)

@@ -345,6 +345,50 @@ def _migrate_stats_cmd(client: Client, args) -> int:
     return _emit(code, out)
 
 
+def _kv_tiers_cmd(client: Client, args) -> int:
+    """Per-replica KV tier economics: host/disk page occupancy, tier
+    hit and promote/demote traffic, and HBM page headroom, read from
+    each replica's ``GET /v1/healthz`` ``load`` block
+    (``models/ingress.py`` ``load_gauges()``). Replicas without a tier
+    store report ``"tiers": null`` — a fleet can mix; an unreachable
+    replica reports its error without failing the sweep unless EVERY
+    replica is down."""
+    replicas = [u.strip().rstrip("/") for u in
+                (args.replicas
+                 or os.environ.get("TPU_REPLICAS", "")).split(",")
+                if u.strip()]
+    if not replicas:
+        print("kv-tiers: provide --replicas url1,url2 or set "
+              "TPU_REPLICAS", file=sys.stderr)
+        return 2
+    try:
+        from ..security.transport import urlopen
+    except ImportError:
+        urlopen = urllib.request.urlopen
+    keys = ("kv_tier_host_pages", "kv_tier_host_capacity",
+            "kv_tier_disk_pages", "kv_tier_disk_capacity",
+            "kv_tier_hits", "kv_tier_promoted", "kv_tier_demoted")
+    out, errors = {"replicas": {}}, 0
+    for base in replicas:
+        try:
+            with urlopen(f"{base}/v1/healthz", timeout=30) as r:
+                health = json.loads(r.read().decode())
+        except (OSError, ValueError, urllib.error.HTTPError) as e:
+            out["replicas"][base] = {"error": str(e)}
+            errors += 1
+            continue
+        load = health.get("load") or {}
+        row = {"pages_free": load.get("pages_free"),
+               "pages_total": load.get("pages_total")}
+        if "kv_tier_host_pages" in load:
+            row["tiers"] = {k[len("kv_tier_"):]: load.get(k)
+                            for k in keys}
+        else:
+            row["tiers"] = None
+        out["replicas"][base] = row
+    return _emit(200 if errors < len(replicas) else 502, out)
+
+
 def _trace_cmd(client: Client, args) -> int:
     """Fleet-wide request traces from the router tier (``GET
     /v1/traces`` / ``/v1/trace/<id>``, ``models/router.py``). Without a
@@ -656,6 +700,14 @@ def build_parser() -> argparse.ArgumentParser:
     ms.add_argument("--receiver", default=None, metavar="URL",
                     help="a replica MigrateReceiver base URL")
     ms.set_defaults(fn=_migrate_stats_cmd)
+
+    kt = sub.add_parser("kv-tiers",
+                        help="per-replica KV page-tier occupancy and "
+                             "hit/promote/demote traffic")
+    kt.add_argument("--replicas", default=None, metavar="URLS",
+                    help="comma-separated replica ingress base URLs "
+                         "(default: $TPU_REPLICAS)")
+    kt.set_defaults(fn=_kv_tiers_cmd)
 
     tr = sub.add_parser("trace",
                         help="fetch fleet-wide request traces")

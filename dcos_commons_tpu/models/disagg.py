@@ -240,6 +240,28 @@ class KVShipper:
         return span
 
 
+def fetch_prefix(peer: str, prompt: List[int],
+                 timeout_s: float = 30.0) -> Optional[Dict[str, Any]]:
+    """Fetch a sibling replica's longest cached prefix of ``prompt`` as
+    a verified span (``ServingFrontend``'s ``POST /v1/prefix``) — the
+    fleet prefix-adoption transport, wired as ``PagedServer``'s
+    ``peer_fetch``. Adoption is an OPTIMIZATION: on a miss (404 — the
+    sibling holds nothing resident), a transport failure, or a frame
+    that fails :func:`unpack_span` verification, this returns None and
+    the asker recomputes. Contrast :meth:`KVShipper.fetch`, where the
+    prefill tier owes an answer and every failure raises."""
+    req = urllib.request.Request(
+        peer.rstrip("/") + "/v1/prefix",
+        data=json.dumps({"prompt": [int(t) for t in prompt]}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with _transport_urlopen(req, timeout=timeout_s) as r:
+            data = r.read()
+        return unpack_span(data)
+    except Exception:
+        return None
+
+
 class PrefillWorker:
     """The prefill tier's front door: one prefill-only
     :class:`~dcos_commons_tpu.models.serving.PagedServer` behind HTTP.

@@ -26,6 +26,12 @@ API (all JSON):
   serving.yml readiness gate).
 * ``GET /v1/stats`` → request/token totals + TTFT/TPOT percentiles over
   the last window.
+* ``POST /v1/prefix`` ``{"prompt": [ints]}`` → the longest radix-resident
+  full-page prefix of the prompt as a packed KV span (octet-stream;
+  404 when nothing is cached) — the fleet prefix-adoption fetch
+  (``disagg.fetch_prefix`` is the client). Served through the engine
+  thread: handlers enqueue a job and park, because the export gathers
+  device pages with radix references held.
 """
 
 from __future__ import annotations
@@ -39,6 +45,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from dcos_commons_tpu.metrics import MetricsRegistry
+from dcos_commons_tpu.models.disagg import pack_span
 from dcos_commons_tpu.models.serving import SlotServer
 from dcos_commons_tpu.tracing import TRACE_HEADER, Tracer, parse_header
 
@@ -108,6 +115,22 @@ class _Pending:
 from dcos_commons_tpu.utils.stats import percentiles as _percentiles
 
 
+class _Export:
+    """One ``/v1/prefix`` job: the handler thread parks on ``done``
+    while the engine thread — the sole engine driver — runs
+    ``export_prefix`` at a step boundary and lands the span here. The
+    gather copies pages to host, so once ``done`` fires the handler
+    packs and writes the frame without touching the engine again."""
+
+    __slots__ = ("prompt", "span", "error", "done")
+
+    def __init__(self, prompt: List[int]):
+        self.prompt = prompt
+        self.span: Optional[dict] = None
+        self.error: Optional[str] = None
+        self.done = threading.Event()
+
+
 class ServingFrontend:
     """Bounded-queue HTTP ingress over one :class:`SlotServer`."""
 
@@ -146,6 +169,11 @@ class ServingFrontend:
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._lock = threading.Lock()                 # stats only
+        # /v1/prefix jobs for the engine thread (bounded: a sibling
+        # that can't get its export promptly recomputes — the fetch is
+        # an optimization, never a dependency)
+        self._exports: "queue.Queue[_Export]" = queue.Queue(maxsize=8)
+        self.export_timeout_s = 30.0
         self._totals = {"requests": 0, "tokens": 0, "rejected": 0}
         # rolling-window load gauges (autoscaler input): completions and
         # sheds are stamped with time.monotonic() so load_gauges() can
@@ -163,7 +191,10 @@ class ServingFrontend:
         # lock — to_dict()'s contract — so reading self._lock is safe)
         for key in ("queue_depth", "queue_capacity", "completed", "shed",
                     "shed_rate", "ttft_p95_ms", "pages_free",
-                    "pages_total"):
+                    "pages_total", "kv_tier_host_pages",
+                    "kv_tier_host_capacity", "kv_tier_disk_pages",
+                    "kv_tier_disk_capacity", "kv_tier_hits",
+                    "kv_tier_promoted", "kv_tier_demoted"):
             self.metrics.gauge(f"ingress.{key}",
                                lambda k=key: self.load_gauges().get(k))
         frontend = self
@@ -213,6 +244,9 @@ class ServingFrontend:
                     self._json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
+                if self.path == "/v1/prefix":
+                    self._prefix()
+                    return
                 if self.path != "/v1/generate":
                     self._json(404, {"error": f"no route {self.path}"})
                     return
@@ -251,6 +285,53 @@ class ServingFrontend:
                     self._stream(pending)
                 else:
                     self._unary(pending)
+
+            def _prefix(self) -> None:
+                if not callable(getattr(frontend.engine,
+                                        "export_prefix", None)):
+                    self._json(404, {"error": "engine has no prefix "
+                                              "export"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    prompt = req.get("prompt")
+                    if (not isinstance(prompt, list) or not prompt
+                            or not all(isinstance(t, int)
+                                       for t in prompt)):
+                        raise ValueError("prompt must be a non-empty "
+                                         "list of ints")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                job = _Export([int(t) for t in prompt])
+                try:
+                    frontend._exports.put_nowait(job)
+                except queue.Full:
+                    self._json(503, {"error": "export queue full"},
+                               {"Retry-After": "1"})
+                    return
+                frontend._wake.set()
+                # an externally driven engine (start(drive=False)) never
+                # drains exports; the wait bounds that to a 503, and the
+                # asker's recompute fallback covers it
+                if not job.done.wait(frontend.export_timeout_s):
+                    self._json(503, {"error": "prefix export timed out"},
+                               {"Retry-After": "1"})
+                    return
+                if job.error:
+                    self._json(500, {"error": job.error})
+                    return
+                if job.span is None:
+                    self._json(404, {"error": "no resident prefix"})
+                    return
+                body = pack_span(job.span)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _unary(self, pending: _Pending) -> None:
                 if not pending.done.wait(frontend.request_timeout_s):
@@ -425,9 +506,29 @@ class ServingFrontend:
                 self._window.append((time.monotonic(), t.get("ttft_ms"),
                                      t.get("tpot_ms")))
 
+    def _serve_exports(self) -> None:
+        """Drain ``/v1/prefix`` jobs (engine thread only — the export
+        gathers device pages with radix references held, so it runs
+        where every other engine dispatch runs). Export is a pure read:
+        a failure answers that one job and never resets the engine."""
+        while True:
+            try:
+                job = self._exports.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                job.span = self.engine.export_prefix(job.prompt)
+                if job.span is not None:
+                    self.metrics.counter("ingress.prefix_exports")
+            except Exception as e:
+                job.error = f"export error: {e}"
+            finally:
+                job.done.set()
+
     def _run_engine(self) -> None:
         while not self._stop.is_set():
             try:
+                self._serve_exports()
                 filled = self._fill_slots()
                 if self.engine.requests_active():
                     self.engine.step_many(self._decode_window)
@@ -581,6 +682,21 @@ class ServingFrontend:
             ledger = getattr(self.engine, "ledger", None)
             if ledger is not None:
                 out["pages_total"] = ledger.pages
+        tiers = getattr(self.engine, "tiers", None)
+        if tiers is not None:
+            # tiered KV engine: surface host/disk occupancy + traffic so
+            # the autoscaler's backpressure() and the router's spill
+            # logic see cold-tier pressure, not just HBM pages
+            ts = tiers.stats()
+            out["kv_tier_host_pages"] = ts["host_pages"]
+            out["kv_tier_host_capacity"] = ts["host_capacity"]
+            out["kv_tier_disk_pages"] = ts["disk_pages"]
+            out["kv_tier_disk_capacity"] = ts["disk_capacity"]
+            out["kv_tier_hits"] = ts["host_hits"] + ts["disk_hits"]
+            out["kv_tier_promoted"] = getattr(self.engine,
+                                              "tier_promoted_pages", 0)
+            out["kv_tier_demoted"] = getattr(self.engine,
+                                             "tier_demoted_pages", 0)
         return out
 
     def stats(self) -> dict:

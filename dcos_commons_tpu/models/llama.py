@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dcos_commons_tpu.ops import (apply_rope, apply_rope_at,
+                                  apply_rope_positions,
                                   fused_linear_cross_entropy,
                                   gqa_attention, repeat_kv,
                                   rms_norm, rope_frequencies,
@@ -1015,6 +1016,12 @@ def prefill_chunk_paged(cfg: LlamaConfig, params: Params, pool: Params,
                      jnp.int32(scratch_page))
     offs = positions % ps
     table_b = table[None]                                    # [1, MP]
+    # a RESUMED chunk (radix hit / tier promote / fleet adoption) can
+    # start so late that start + C overruns the rope table; rotate by
+    # per-lane gather, NOT apply_rope's dynamic_slice, whose clamped
+    # start would mis-rotate the live head of the chunk (tokens past
+    # true_len are dead either way — their clipped rope is never read)
+    rope_pos = jnp.clip(positions, 0, rope.shape[1] - 1)
 
     def cache_write(cache, new):
         return _page_write(cache, new[0], phys, offs), None
@@ -1027,7 +1034,7 @@ def prefill_chunk_paged(cfg: LlamaConfig, params: Params, pool: Params,
 
     return _decode_body(
         cfg, params, pool, tokens, False,
-        rope_fn=lambda t: apply_rope(t, rope, start),
+        rope_fn=lambda t: apply_rope_positions(t, rope, rope_pos),
         cache_write=cache_write, kv_len=start + c, causal=True,
         q_offset=start, mesh=mesh, attn_override=attn_override,
         logit_index=logit_index)

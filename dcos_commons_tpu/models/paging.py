@@ -30,7 +30,14 @@ Sharing model (vLLM/SGLang-style prefix caching, TPU-simplified):
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+import json
+import os
+import struct
+import threading
+import time
+from collections import OrderedDict
+from typing import (Any, Callable, Dict, List, Mapping, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -59,9 +66,36 @@ def page_hashes(prompt: Sequence[int], page_size: int) -> List[str]:
     return out
 
 
+def chain_keys(prompt: Sequence[int], page_size: int) -> List[str]:
+    """Position-aligned CHAIN identity per full-page prefix, derived
+    from the same :func:`page_hashes` every tier shares: entry ``j``
+    keys the whole prefix ``prompt[:(j + 1) * page_size]``, not the
+    ``j``-th page alone. A bare per-page hash depends only on that
+    page's tokens — two different prompts sharing one middle page would
+    collide — while prefix K/V is only valid for the exact
+    position-aligned token run that produced it. The chain key is what
+    the demote/promote tiers (:class:`PageTierStore`) and the fleet
+    prefix directory (:class:`PrefixDirectory`) address by; it folds
+    the per-page hashes so the router's ``route_key`` (which joins the
+    same hashes) and this identity can never disagree about what a
+    prefix *is*."""
+    out: List[str] = []
+    acc = hashlib.blake2s()
+    for h in page_hashes(prompt, page_size):
+        acc.update(h.encode())
+        out.append(acc.copy().hexdigest()[:16])
+    return out
+
+
 class PageLedgerError(RuntimeError):
     """A page transition that must never happen (double free, ref of a
     free page) — raised loudly rather than corrupting shared K/V."""
+
+
+class PageFrameError(RuntimeError):
+    """A demoted page frame that must not be promoted: framing, digest,
+    or identity verification failed (bit-rot on disk, a truncated
+    write, a frame filed under the wrong chain)."""
 
 
 class PagePool:
@@ -308,12 +342,34 @@ class PrefixRadix:
             out[node.page] = out.get(node.page, 0) + 1
         return out
 
-    def evict(self, need: int) -> int:
+    def prefix_tokens(self, node: _Node) -> List[int]:
+        """The full token run root -> ``node`` (an exact multiple of
+        ``page_size`` tokens) — the identity a demoter needs to file
+        the node's page under its chain key."""
+        parts = []
+        while node.parent is not None:
+            parts.append(node.key)
+            node = node.parent
+        out: List[int] = []
+        for key in reversed(parts):
+            out.extend(key)
+        return out
+
+    def evict(self, need: int, demoter: Optional[Callable] = None) -> int:
         """Drop least-recently-used childless nodes nobody else
         references until ``need`` pages came free (or no candidates
         remain). Shared nodes (an active stream still references the
         page) are kept: unref'ing them frees nothing now and forfeits
-        the share. Returns pages actually freed."""
+        the share. Returns pages actually freed.
+
+        ``demoter`` is THE single demote seam: when given, it is called
+        as ``demoter(page, prefix_tokens)`` for every victim BEFORE the
+        unref — the page still holds one live reference, so its device
+        content may be gathered and filed in a colder tier
+        (:class:`PageTierStore`). Eviction never releases a radix page
+        any other way (``clear()`` runs only when the device pool is
+        being re-initialized and the content is already dead), so a
+        tiered engine routes every HBM->host demotion through here."""
         freed = 0
         while freed < need:
             leaves = [n for n in self._iter_nodes()
@@ -323,6 +379,8 @@ class PrefixRadix:
                 break
             victim = min(leaves, key=lambda x: x.stamp)
             del victim.parent.children[victim.key]
+            if demoter is not None:
+                demoter(victim.page, self.prefix_tokens(victim))
             self._pool.unref(victim.page)
             freed += 1
         return freed
@@ -333,3 +391,422 @@ class PrefixRadix:
         for node in list(self._iter_nodes()):
             self._pool.unref(node.page)
         self._root.children = {}
+
+
+# ---------------------------------------------------------------------------
+# demoted-page frames: the KV-span wire discipline applied to ONE page
+
+
+_FRAME_MAGIC = b"KVPAGE1\0"
+_FRAME_VERSION = 1
+
+
+def _flatten_page_payload(payload: Dict[str, Any]
+                          ) -> List[Tuple[str, np.ndarray]]:
+    """One page's K/V payload as a flat (key, ndarray) list in a FIXED
+    order — the frame layout (int8 pools carry q + scales per side).
+    Mirrors ``models/disagg.py``'s span flattening; this module cannot
+    import disagg (disagg imports the page hashes from here)."""
+    out: List[Tuple[str, np.ndarray]] = []
+    for side in ("k", "v"):
+        val = payload[side]
+        if isinstance(val, dict):
+            out.append((f"{side}.q", np.asarray(val["q"])))
+            out.append((f"{side}.s", np.asarray(val["s"])))
+        else:
+            out.append((side, np.asarray(val)))
+    return out
+
+
+def _frame_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_page_frame(entry: Dict[str, Any]) -> bytes:
+    """Frame ONE demoted KV page for the host/disk tiers:
+    ``MAGIC | header_len | header JSON | raw array bytes`` — the span
+    wire format's shape (``disagg.pack_span``) at page granularity. The
+    header carries the chain key and per-page hash the frame is filed
+    under plus a blake2s digest of the body, so a promote can prove the
+    bytes it is about to install are exactly the bytes demoted — a
+    bit-rotted disk frame or a frame filed under the wrong prefix dies
+    in :func:`unpack_page_frame`, never on a live page table."""
+    arrays = _flatten_page_payload(entry["payload"])
+    body = b"".join(a.tobytes() for _, a in arrays)
+    meta = {
+        "version": _FRAME_VERSION,
+        "chain": str(entry["chain"]),
+        "page_hash": str(entry["page_hash"]),
+        "kv_quant": bool(entry.get("kv_quant", False)),
+        "arrays": [{"key": k, "shape": list(a.shape),
+                    "dtype": a.dtype.name} for k, a in arrays],
+    }
+    # one digest over canonical header + body: a flipped bit in the
+    # METADATA (chain, shapes, dtype) is as fatal as one in the KV
+    # bytes — installing the right bytes under the wrong identity
+    # corrupts the radix just the same
+    meta["digest"] = hashlib.blake2s(
+        json.dumps(meta, sort_keys=True).encode() + body).hexdigest()
+    header = json.dumps(meta).encode()
+    return _FRAME_MAGIC + struct.pack("<I", len(header)) + header + body
+
+
+def unpack_page_frame(data: bytes,
+                      chain: Optional[str] = None) -> Dict[str, Any]:
+    """Parse + VERIFY a demoted-page frame: magic, version, body
+    digest, and (when given) the chain key the caller is promoting —
+    raises :class:`PageFrameError` on any mismatch so a corrupt tier
+    entry is dropped holding zero pool pages."""
+    if not data.startswith(_FRAME_MAGIC):
+        raise PageFrameError("bad magic: not a KV page frame")
+    off = len(_FRAME_MAGIC)
+    if len(data) < off + 4:
+        raise PageFrameError("truncated frame: no header length")
+    (hlen,) = struct.unpack_from("<I", data, off)
+    off += 4
+    if len(data) < off + hlen:
+        raise PageFrameError("truncated frame: header cut short")
+    try:
+        meta = json.loads(data[off:off + hlen])
+    except ValueError as e:
+        raise PageFrameError(f"bad header: {e}") from None
+    off += hlen
+    if meta.get("version") != _FRAME_VERSION:
+        raise PageFrameError(f"frame version {meta.get('version')} != "
+                             f"{_FRAME_VERSION}")
+    if chain is not None and meta.get("chain") != chain:
+        raise PageFrameError(f"frame filed under chain "
+                             f"{meta.get('chain')!r}, wanted {chain!r}")
+    body = data[off:]
+    core = {k: v for k, v in meta.items() if k != "digest"}
+    want = hashlib.blake2s(
+        json.dumps(core, sort_keys=True).encode() + body).hexdigest()
+    if want != meta.get("digest"):
+        raise PageFrameError("digest mismatch: corrupt frame")
+    arrays: Dict[str, np.ndarray] = {}
+    pos = 0
+    # past the digest everything below re-derives from verified bytes,
+    # but a flipped bit can still yield VALID JSON with mangled specs —
+    # any structural surprise is a corrupt frame, never a crash
+    try:
+        for spec in meta["arrays"]:
+            dt = _frame_dtype(spec["dtype"])
+            shape = tuple(int(d) for d in spec["shape"])
+            nbytes = dt.itemsize * int(np.prod(shape))
+            if pos + nbytes > len(body):
+                raise PageFrameError(f"truncated body at {spec['key']!r}")
+            arrays[spec["key"]] = np.frombuffer(
+                body, dt, count=int(np.prod(shape)),
+                offset=pos).reshape(shape)
+            pos += nbytes
+    except PageFrameError:
+        raise
+    except Exception as e:
+        raise PageFrameError(f"bad array specs: {e}") from None
+    payload: Dict[str, Any] = {}
+    for side in ("k", "v"):
+        if side in arrays:
+            payload[side] = arrays[side]
+        elif f"{side}.q" in arrays and f"{side}.s" in arrays:
+            payload[side] = {"q": arrays[f"{side}.q"],
+                             "s": arrays[f"{side}.s"]}
+        else:
+            raise PageFrameError(f"frame missing the {side!r} page")
+    return {"version": meta["version"], "chain": meta["chain"],
+            "page_hash": meta["page_hash"],
+            "kv_quant": meta["kv_quant"], "payload": payload}
+
+
+# ---------------------------------------------------------------------------
+# host/disk page tiers
+
+
+class PageTierStore:
+    """Cold-page hierarchy under the HBM pool: demoted radix pages live
+    here as packed, digest-checked frames — pinned host memory first,
+    spilling to content-addressed files on disk when the host tier
+    fills, dropping the LRU frame when disk fills too. Capacity is
+    counted in PAGES on both tiers, so "2x the HBM pool at equal HBM"
+    is literally ``host_pages + disk_pages >= pool.pages``.
+
+    Ownership discipline (the ledger invariant, extended not weakened):
+
+    * The store holds BYTE COPIES keyed by chain key
+      (:func:`chain_keys`), never :class:`PagePool` page ids — a
+      demoted page leaves the ledger entirely (demote gathers the
+      bytes, files the frame, then unrefs), so ``check()`` /
+      ``reconcile()`` stay exact over live owners with nothing new to
+      prove about free pages.
+    * :meth:`take` POPS: the caller becomes the frame's only owner.
+      A promote racing a second promote — or racing an eviction that
+      re-demotes the same chain — resolves to exactly one owner by
+      construction; the loser misses and recomputes.
+    * :meth:`discard` drops a chain the radix re-acquired (a retiring
+      stream adopted the same prefix back into HBM): content lives in
+      the radix XOR the tiers, never both, which the chaos
+      ``kv-tier-owner`` invariant audits.
+
+    A frame that fails verification at :meth:`take` (bit-rot,
+    truncation — the ``kv_tier_corrupt`` chaos fault) is counted,
+    dropped, and reported as a miss: the caller recomputes; corrupt
+    bytes never reach a page table. Thread-safe — stats are scraped
+    from HTTP threads while the engine thread demotes/promotes."""
+
+    def __init__(self, host_pages: int = 0,
+                 disk_dir: Optional[str] = None, disk_pages: int = 0):
+        if host_pages < 0 or disk_pages < 0:
+            raise ValueError("tier capacities must be >= 0")
+        if disk_pages > 0 and not disk_dir:
+            raise ValueError("disk_pages > 0 needs disk_dir")
+        self.host_pages = int(host_pages)
+        self.disk_pages = int(disk_pages) if disk_dir else 0
+        self.disk_dir = disk_dir
+        if disk_dir and self.disk_pages > 0:
+            os.makedirs(disk_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._host: "OrderedDict[str, bytes]" = OrderedDict()
+        self._disk: "OrderedDict[str, str]" = OrderedDict()  # chain->path
+        self.demoted_host = 0     # frames filed into the host tier
+        self.demoted_disk = 0     # frames spilled host -> disk
+        self.dropped = 0          # LRU frames dropped off the disk end
+        self.host_hits = 0
+        self.disk_hits = 0
+        self.misses = 0
+        self.corrupt_frames = 0   # frames rejected at take
+        self.discarded = 0        # chains the radix re-acquired
+
+    # ------------------------------------------------------------ queries
+
+    def host_count(self) -> int:
+        with self._lock:
+            return len(self._host)
+
+    def disk_count(self) -> int:
+        with self._lock:
+            return len(self._disk)
+
+    def has(self, chain: str) -> bool:
+        with self._lock:
+            return chain in self._host or chain in self._disk
+
+    def chains(self) -> List[str]:
+        with self._lock:
+            return list(self._host) + list(self._disk)
+
+    # -------------------------------------------------------- transitions
+
+    def put(self, chain: str, entry: Dict[str, Any]) -> None:
+        """Demote: pack ``entry`` (``chain`` / ``page_hash`` /
+        ``kv_quant`` / one-page ``payload``) and file it, displacing
+        LRU frames down the hierarchy (host -> disk -> dropped). A
+        re-demoted chain replaces its stale frame."""
+        frame = pack_page_frame(entry)
+        with self._lock:
+            self._discard_locked(chain)
+            if self.host_pages > 0:
+                self._host[chain] = frame
+                self.demoted_host += 1
+                while len(self._host) > self.host_pages:
+                    old_chain, old_frame = self._host.popitem(last=False)
+                    self._spill_locked(old_chain, old_frame)
+            else:
+                self._spill_locked(chain, frame)
+
+    def _spill_locked(self, chain: str, frame: bytes) -> None:
+        if self.disk_pages <= 0:
+            self.dropped += 1
+            return
+        path = os.path.join(self.disk_dir, f"{chain}.kvpage")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(frame)
+        os.replace(tmp, path)          # commit is atomic, like weights.py
+        self._disk[chain] = path
+        self._disk.move_to_end(chain)
+        self.demoted_disk += 1
+        while len(self._disk) > self.disk_pages:
+            old_chain, old_path = self._disk.popitem(last=False)
+            try:
+                os.remove(old_path)
+            except OSError:
+                pass
+            self.dropped += 1
+
+    def take(self, chain: str) -> Optional[Dict[str, Any]]:
+        """Promote: POP the chain's frame, verify it, and return the
+        decoded entry — or None on a miss or a corrupt frame (counted;
+        the frame is gone either way, so the caller that recomputes
+        becomes the content's only owner)."""
+        with self._lock:
+            frame = self._host.pop(chain, None)
+            from_host = frame is not None
+            if frame is None:
+                path = self._disk.pop(chain, None)
+                if path is not None:
+                    try:
+                        with open(path, "rb") as f:
+                            frame = f.read()
+                    except OSError:
+                        frame = b""
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+            if frame is None:
+                self.misses += 1
+                return None
+        try:
+            entry = unpack_page_frame(frame, chain=chain)
+        except PageFrameError:
+            with self._lock:
+                self.corrupt_frames += 1
+                self.misses += 1
+            return None
+        with self._lock:
+            if from_host:
+                self.host_hits += 1
+            else:
+                self.disk_hits += 1
+        return entry
+
+    def discard(self, chain: str) -> bool:
+        """Drop a chain without reading it — the radix owns the content
+        again (a retiring stream re-adopted the prefix into HBM), so a
+        stale tier copy would make two owners."""
+        with self._lock:
+            return self._discard_locked(chain, count=True)
+
+    def _discard_locked(self, chain: str, count: bool = False) -> bool:
+        hit = self._host.pop(chain, None) is not None
+        path = self._disk.pop(chain, None)
+        if path is not None:
+            hit = True
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        if hit and count:
+            self.discarded += 1
+        return hit
+
+    def clear(self) -> None:
+        with self._lock:
+            self._host.clear()
+            for path in self._disk.values():
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            self._disk.clear()
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "host_pages": len(self._host),
+                "host_capacity": self.host_pages,
+                "disk_pages": len(self._disk),
+                "disk_capacity": self.disk_pages,
+                "demoted_host": self.demoted_host,
+                "demoted_disk": self.demoted_disk,
+                "dropped": self.dropped,
+                "host_hits": self.host_hits,
+                "disk_hits": self.disk_hits,
+                "misses": self.misses,
+                "corrupt_frames": self.corrupt_frames,
+                "discarded": self.discarded,
+            }
+
+
+# ---------------------------------------------------------------------------
+# fleet prefix directory
+
+
+class PrefixDirectory:
+    """Fleet-wide map of WHO holds WHICH cached prefix, keyed on the
+    same chain identity the tiers use (:func:`chain_keys`, folded from
+    the ``page_hashes`` the router's affinity ring already routes by).
+    Replicas publish the chains their radix adopts; a replica that
+    misses locally asks the directory for a sibling to ADOPT the
+    prefix from over the span transport instead of recomputing it.
+
+    Entries are hints, never truth: each carries the publish stamp and
+    :meth:`lookup` drops entries older than ``max_age_s`` — a stale
+    hint (the holder evicted, restarted, or died) costs the asker one
+    failed fetch and a recompute fallback, never a wrong answer (the
+    span transport digest-verifies what actually arrives). Thread-safe:
+    the router and every replica's engine thread share one instance
+    in-process."""
+
+    def __init__(self, max_age_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_age_s = float(max_age_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # chain -> {replica: publish stamp}
+        self._holders: Dict[str, Dict[str, float]] = {}
+        self.publishes = 0
+        self.hits = 0
+        self.misses = 0
+        self.stale_drops = 0
+
+    def publish(self, replica: str, chains: Sequence[str]) -> None:
+        now = self._clock()
+        with self._lock:
+            for chain in chains:
+                self._holders.setdefault(chain, {})[replica] = now
+                self.publishes += 1
+
+    def forget(self, replica: str) -> None:
+        """Drop every hint naming ``replica`` (it restarted or left the
+        fleet — its radix is gone)."""
+        with self._lock:
+            for chain in list(self._holders):
+                self._holders[chain].pop(replica, None)
+                if not self._holders[chain]:
+                    del self._holders[chain]
+
+    def lookup(self, chain: str,
+               exclude: Optional[str] = None) -> Optional[str]:
+        """Freshest replica claiming ``chain`` (excluding the asker),
+        or None. Stale claims are dropped on the way through."""
+        horizon = self._clock() - self.max_age_s
+        with self._lock:
+            holders = self._holders.get(chain)
+            if holders:
+                for replica in [r for r, t in holders.items()
+                                if t < horizon]:
+                    del holders[replica]
+                    self.stale_drops += 1
+                if not holders:
+                    del self._holders[chain]
+                    holders = None
+            if not holders:
+                self.misses += 1
+                return None
+            best = max((r for r in holders if r != exclude),
+                       key=lambda r: holders[r], default=None)
+            if best is None:
+                self.misses += 1
+                return None
+            self.hits += 1
+            return best
+
+    def holders(self, chain: str) -> List[str]:
+        with self._lock:
+            return sorted(self._holders.get(chain, ()))
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "chains": len(self._holders),
+                "publishes": self.publishes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "stale_drops": self.stale_drops,
+            }

@@ -66,7 +66,7 @@ from ..scheduler.elastic import backpressure
 from ..tracing import (TRACE_HEADER, Span, TraceContext, Tracer, new_id,
                        parse_header, perf_to_epoch)
 from .disagg import _transport_urlopen
-from .paging import page_hashes
+from .paging import chain_keys, page_hashes
 
 
 def route_key(prompt: Sequence[int], page_size: int,
@@ -481,7 +481,8 @@ class Router:
                  request_timeout_s: float = 600.0,
                  seed: int = 0,
                  metrics: Optional[MetricsRegistry] = None,
-                 trace_store=None):
+                 trace_store=None,
+                 directory=None):
         if policy not in ("affinity", "random"):
             raise ValueError(f"unknown routing policy {policy!r}")
         if page_size < 1:
@@ -493,6 +494,12 @@ class Router:
         self.spill_floor = spill_floor
         self.request_timeout_s = request_timeout_s
         self.probe_interval_s = probe_interval_s
+        # optional fleet prefix directory (paging.PrefixDirectory):
+        # replicas publish which radix chains they hold, and route_plan
+        # consults it when the affinity primary is unavailable so the
+        # stream lands where the prefix is already resident (or
+        # adoptable) instead of on an arbitrary least-loaded spill.
+        self.directory = directory
         self.ring = HashRing(
             (e.rstrip("/") for e in replicas), vnodes=vnodes)
         self.replicas = ReplicaSet(replicas,
@@ -507,7 +514,8 @@ class Router:
             "routed": 0, "affinity_hits": 0, "spills_hot": 0,
             "spills_down": 0, "spill_attempts": 0, "spill_resumes": 0,
             "resume_divergences": 0, "dropped_streams": 0, "sheds": 0,
-            "rebalances": 0, "errors": 0, "migration_redirects": 0}
+            "rebalances": 0, "errors": 0, "migration_redirects": 0,
+            "directory_hits": 0}
         # live-migration forwarding: victim endpoint -> destination the
         # MigrationManager drained its streams to. Applied to every
         # route plan so relays (and resume-exact failover replays)
@@ -637,7 +645,8 @@ class Router:
                    cls: QoSClass) -> Tuple[List[str], str]:
         """The ordered candidate list for one request and how its head
         was chosen (``affinity`` | ``spill_hot`` | ``spill_down`` |
-        ``random`` | ``none``). The tail is the mid-stream failover
+        ``directory`` | ``random`` | ``none``). The tail is the
+        mid-stream failover
         order: the rest of the ring's preference walk (stable per key),
         healthy-first."""
         if self.policy == "random":
@@ -667,11 +676,34 @@ class Router:
                     return order, "spill_hot"
             return [primary] + rest, "affinity"
         if rest:
+            holder = self._directory_hint(prompt)
+            if holder is not None and holder in rest:
+                self._count("directory_hits")
+                order = [holder] + [ep for ep in rest if ep != holder]
+                return order, "directory"
             spill = self.replicas.least_loaded(exclude=(primary,))
             if spill is not None and spill in rest:
                 rest = [spill] + [ep for ep in rest if ep != spill]
             return rest, "spill_down"
         return [], "none"
+
+    def _directory_hint(self, prompt: Sequence[int]) -> Optional[str]:
+        """Deepest fresh :class:`paging.PrefixDirectory` holder for this
+        prompt's chain, or ``None``. Only consulted when the affinity
+        primary is down: landing the stream where the prefix is already
+        resident beats least-loaded spill, because the spill target
+        would recompute the whole prefix from scratch."""
+        if self.directory is None:
+            return None
+        try:
+            chains = chain_keys(list(prompt), self.page_size)
+        except Exception:
+            return None
+        for ck in reversed(chains):
+            holder = self.directory.lookup(ck)
+            if holder is not None:
+                return holder.rstrip("/")
+        return None
 
     # ------------------------------------------------------------- relay
 

@@ -42,7 +42,9 @@ import numpy as np
 from jax import lax
 
 from dcos_commons_tpu.models import llama
-from dcos_commons_tpu.models.paging import PagePool, PrefixRadix
+from dcos_commons_tpu.models.paging import (PagePool, PageTierStore,
+                                            PrefixDirectory, PrefixRadix,
+                                            chain_keys, page_hashes)
 from dcos_commons_tpu.ops import rope_frequencies
 from dcos_commons_tpu.ops.quant import QTensor, qmm, quantize
 
@@ -602,7 +604,10 @@ class PagedServer:
                  prefill_chunk: int = 64, sampler=None,
                  key: Optional[jax.Array] = None,
                  eos_id: Optional[int] = None, mesh=None,
-                 prefix_cache: bool = True, compile_cache=None):
+                 prefix_cache: bool = True, compile_cache=None,
+                 tiers: Optional[PageTierStore] = None,
+                 directory: Optional[PrefixDirectory] = None,
+                 replica_id: str = "", peer_fetch=None):
         if page_size < 1 or cfg.max_seq % page_size:
             raise ValueError(
                 f"page_size {page_size} must divide max_seq "
@@ -708,6 +713,29 @@ class PagedServer:
         # a peer's exported decode state
         self.migrated_out = 0
         self.migrated_in = 0
+        # ------------------------------------------------- KV hierarchy
+        # cold radix pages demote HBM -> host -> disk through `tiers`
+        # (every eviction routes through the ONE demote seam,
+        # PrefixRadix.evict's demoter); `directory` + `peer_fetch`
+        # (replica_id, prompt_prefix) -> span let a miss adopt a
+        # fleet-hot prefix from a sibling instead of recomputing.
+        # Promotion is ASYNCHRONOUS: admission only plans it, the plan
+        # lands in _tier_tick at the top of the next step — the hit
+        # stream sits out exactly one step, the decode batch never
+        # blocks on a host/disk/peer read.
+        self.tiers = tiers
+        self.directory = directory
+        self.replica_id = replica_id or f"paged-{id(self):x}"
+        self.peer_fetch = peer_fetch
+        self._pending_tier: List[Dict[str, Any]] = []
+        self.tier_demoted_pages = 0    # pages gathered out on eviction
+        self.tier_promoted_pages = 0   # pages installed back from tiers
+        self.tier_fallbacks = 0        # planned promotes that recomputed
+        self.tier_promote_s = 0.0      # cumulative promote-install time
+        self.directory_hits = 0        # admissions served by a sibling
+        self.directory_fallbacks = 0   # stale hints -> recompute
+        self.adopted_prefix_pages = 0  # pages installed from siblings
+        self.exported_prefixes = 0     # prefix spans served to siblings
 
     # the engine-thread-only helpers are identical to the slot engine's
     _select = SlotServer._select
@@ -808,6 +836,84 @@ class PagedServer:
         self._flush_pending()
         return self._admit(list(prompt), max_new, request_id)
 
+    # ------------------------------------------------------- KV hierarchy
+
+    def _evict(self, need: int) -> int:
+        """THE single release path for radix pages under pressure:
+        every eviction flows through here so a tiered engine demotes
+        each victim's bytes to host/disk BEFORE its reference drops —
+        the page either stays accounted in the ledger or its content
+        moves wholly into the tier store, never a leaked in-between."""
+        demoter = self._demote if self.tiers is not None else None
+        return self.radix.evict(need, demoter=demoter)
+
+    def _demote(self, page: int, prefix_tokens: List[int]) -> None:
+        """Demoter callback (``PrefixRadix.evict``): gather the
+        victim's device bytes and file them under the prefix's chain
+        key while the page still holds its last reference. Best-effort
+        — a failed gather just loses the cold copy, never the evict."""
+        try:
+            hs = page_hashes(prefix_tokens, self.page_size)
+            ck = chain_keys(prefix_tokens, self.page_size)
+            self.tiers.put(ck[-1], {
+                "chain": ck[-1], "page_hash": hs[-1],
+                "kv_quant": bool(self.cfg.kv_quant),
+                "payload": self._gather_span([page])})
+            self.tier_demoted_pages += 1
+        except Exception:
+            pass
+
+    def _radix_adopt(self, prompt: List[int], pages: List[int]) -> int:
+        """THE single adopt path into the radix: insert, then resolve
+        ownership fleet-wide — tier frames for the re-acquired chains
+        are discarded (content lives in HBM XOR the tiers, the
+        single-owner rule a promote racing an evict relies on) and the
+        chains are published to the prefix directory so siblings can
+        adopt them instead of recomputing."""
+        adopted = self.radix.insert(prompt, pages)
+        full = min(len(prompt) // self.page_size, len(pages))
+        if full and (self.tiers is not None or self.directory is not None):
+            cks = chain_keys(prompt[:full * self.page_size],
+                             self.page_size)
+            if self.tiers is not None:
+                for ck in cks:
+                    self.tiers.discard(ck)
+            if self.directory is not None:
+                self.directory.publish(self.replica_id, cks)
+        return adopted
+
+    def _tier_plan(self, prompt: List[int],
+                   matched_pages: int) -> Optional[Dict[str, Any]]:
+        """Plan covering full prompt pages PAST the radix match from
+        colder sources: consecutive demoted frames in the tier store
+        first, else the longest fleet-hot prefix a directory sibling
+        claims. Returns the pending-promote record (landed later by
+        :meth:`_tier_tick`) or None when only recompute remains. At
+        least one prompt token always stays uncovered, mirroring the
+        radix lookup's first-token rule."""
+        if self.tiers is None and self.directory is None:
+            return None
+        ps = self.page_size
+        max_cover = (len(prompt) - 1) // ps
+        if matched_pages >= max_cover:
+            return None
+        cks = chain_keys(prompt[:max_cover * ps], ps)
+        if self.tiers is not None:
+            cover = matched_pages
+            while cover < max_cover and self.tiers.has(cks[cover]):
+                cover += 1
+            if cover > matched_pages:
+                return {"kind": "tier", "base": matched_pages,
+                        "chains": cks[matched_pages:cover]}
+        if self.directory is not None and self.peer_fetch is not None:
+            for j in range(max_cover, matched_pages, -1):
+                holder = self.directory.lookup(cks[j - 1],
+                                               exclude=self.replica_id)
+                if holder is not None:
+                    return {"kind": "fleet", "base": matched_pages,
+                            "holder": holder, "cover": j}
+        return None
+
     def _admit(self, prompt: List[int], max_new: int,
                request_id: Any) -> Optional[int]:
         free = self.free_slots()
@@ -821,11 +927,13 @@ class PagedServer:
         node = None
         if self.radix is not None:
             shared, node = self.radix.lookup(prompt)
+        plan = (self._tier_plan(prompt, len(shared))
+                if self.radix is not None else None)
         own_needed = total - len(shared)
         pages = self.ledger.alloc(own_needed)
         if pages is None and self.radix is not None:
             # under pressure the radix gives back LRU unshared pages
-            self.radix.evict(own_needed - self.ledger.free_count())
+            self._evict(own_needed - self.ledger.free_count())
             pages = self.ledger.alloc(own_needed)
         if pages is None:
             for p in shared:                   # undo the lookup refs
@@ -833,7 +941,7 @@ class PagedServer:
             return None
         matched = len(shared) * ps
         start = matched
-        if node is not None:
+        if node is not None and plan is None:
             b = self.radix.boundary(node, prompt, matched)
             if b is not None:
                 src, valid = b
@@ -854,7 +962,15 @@ class PagedServer:
         self._decoding[slot] = False
         rid = request_id if request_id is not None else object()
         self.requests[slot] = _Request(rid, n, max_new, [])
-        self._prefill_q.append(slot)
+        if plan is not None:
+            # async promote: the stream defers ONE step (it joins the
+            # prefill queue when _tier_tick lands or abandons the plan)
+            # so the decode gather never blocks on a cold-tier read
+            plan["slot"] = slot
+            plan["req"] = self.requests[slot]
+            self._pending_tier.append(plan)
+        else:
+            self._prefill_q.append(slot)
         return slot
 
     def submit_many(self, items: List[Dict[str, Any]],
@@ -927,7 +1043,7 @@ class PagedServer:
         own_needed = span_pages - len(shared)
         pages = self.ledger.alloc(own_needed)
         if pages is None and self.radix is not None:
-            self.radix.evict(own_needed - self.ledger.free_count())
+            self._evict(own_needed - self.ledger.free_count())
             pages = self.ledger.alloc(own_needed)
         if pages is None:
             for p in shared:
@@ -962,7 +1078,7 @@ class PagedServer:
         first = int(self._select(logits)[0])
         payload = self._gather_span(stream_pages)
         if self.radix is not None:
-            self.radix.insert(prompt, stream_pages)
+            self._radix_adopt(prompt, stream_pages)
         for p in stream_pages:
             self.ledger.unref(p)
         self.shipped_spans += 1
@@ -1050,7 +1166,7 @@ class PagedServer:
         own_needed = total - len(shared)
         pages = self.ledger.alloc(own_needed)
         if pages is None and self.radix is not None:
-            self.radix.evict(own_needed - self.ledger.free_count())
+            self._evict(own_needed - self.ledger.free_count())
             pages = self.ledger.alloc(own_needed)
         if pages is None:
             for p in shared:
@@ -1110,6 +1226,169 @@ class PagedServer:
                 donate_argnums=(0,))
             self._adopt_x[n] = x
         return x
+
+    # --------------------------------------------------- tier promotion
+
+    def _tier_tick(self) -> None:
+        """Land every pending promote/adoption plan queued at
+        admission: install the cold bytes into the stream's own pages,
+        adopt the covered prefix into the radix, and ONLY THEN let the
+        stream enter the prefill queue — the one-step deferral that
+        keeps the decode dispatch from ever blocking on a host/disk
+        read or a peer fetch. A plan whose frames went missing or
+        corrupt (or whose directory hint went stale) falls back to
+        recomputing from the radix-matched position; the stream loses
+        the shortcut, never tokens."""
+        if not self._pending_tier:
+            return
+        plans, self._pending_tier = self._pending_tier, []
+        for plan in plans:
+            slot = plan["slot"]
+            if self.requests[slot] is not plan["req"]:
+                continue                       # aborted while deferred
+            t0 = time.perf_counter()
+            if plan["kind"] == "tier":
+                ok = self._promote_from_tier(plan)
+                if not ok:
+                    self.tier_fallbacks += 1
+            else:
+                ok = self._adopt_from_fleet(plan)
+                if not ok:
+                    self.directory_fallbacks += 1
+            self.tier_promote_s += time.perf_counter() - t0
+            self._prefill_q.append(slot)
+
+    @staticmethod
+    def _concat_pages(sides: List[Any]):
+        """Stack per-page payloads ``[L, 1, page, KV, D]`` into one
+        span payload along the page axis (QTensor dict for int8)."""
+        if isinstance(sides[0], dict):
+            return {"q": np.concatenate([s["q"] for s in sides], axis=1),
+                    "s": np.concatenate([s["s"] for s in sides], axis=1)}
+        return np.concatenate(sides, axis=1)
+
+    def _promote_from_tier(self, plan: Dict[str, Any]) -> bool:
+        """Install the longest verified run of demoted frames for the
+        plan's chains. ``take`` POPS each frame — this promote is the
+        content's single owner the instant it holds the bytes, so an
+        eviction re-demoting the same chain mid-flight can only file a
+        NEW copy, which :meth:`_radix_adopt` discards when the chain
+        re-enters HBM (exactly-one-owner, the chaos ``kv-tier-owner``
+        invariant)."""
+        slot = plan["slot"]
+        prompt = self._prompts[slot]
+        ps = self.page_size
+        entries = []
+        for ck in plan["chains"]:
+            e = self.tiers.take(ck)
+            if (e is None
+                    or bool(e.get("kv_quant")) != bool(self.cfg.kv_quant)):
+                break                          # missing/corrupt: stop run
+            entries.append(e)
+        if not entries:
+            return False
+        m = len(entries)
+        base = plan["base"]
+        payload = {
+            "k": self._concat_pages([e["payload"]["k"] for e in entries]),
+            "v": self._concat_pages([e["payload"]["v"] for e in entries])}
+        want = (self.cfg.n_layers, m, ps, self.cfg.n_kv_heads,
+                self.cfg.head_dim)
+
+        def _shape(x):
+            return tuple((x["q"] if isinstance(x, dict) else x).shape)
+
+        if _shape(payload["k"]) != want or _shape(payload["v"]) != want:
+            return False                       # foreign geometry: recompute
+        phys = self._stream_pages[slot][base:base + m]
+        self.pool = self._adopt_exec(m)(
+            self.pool,
+            _payload_slice(payload["k"], 0, m),
+            _payload_slice(payload["v"], 0, m),
+            jnp.asarray(phys, jnp.int32))
+        self._prefill_pos[slot] = (base + m) * ps
+        self.tier_promoted_pages += m
+        self._radix_adopt(prompt[:(base + m) * ps],
+                          self._stream_pages[slot][:base + m])
+        return True
+
+    def _adopt_from_fleet(self, plan: Dict[str, Any]) -> bool:
+        """Fetch the fleet-hot prefix from the directory's hinted
+        sibling (span transport, digest-verified on the wire) and
+        install it like a tier promote. Any failure — the holder died,
+        evicted the prefix, or shipped something that does not verify —
+        is a recompute fallback, never an error: directory entries are
+        hints and the prefill path is always there."""
+        slot = plan["slot"]
+        prompt = self._prompts[slot]
+        ps = self.page_size
+        try:
+            span = self.peer_fetch(plan["holder"],
+                                   prompt[:plan["cover"] * ps])
+        except Exception:
+            span = None
+        if span is None:
+            return False
+        got = list(span.get("prompt", []))
+        if (int(span.get("page_size", ps)) != ps
+                or bool(span.get("kv_quant")) != bool(self.cfg.kv_quant)
+                or len(got) % ps
+                or got != prompt[:len(got)]):
+            return False
+        cover = min(plan["cover"], len(got) // ps)
+        base = plan["base"]
+        if cover <= base:
+            return False
+        payload = span["payload"]
+        want = (self.cfg.n_layers, len(got) // ps, ps,
+                self.cfg.n_kv_heads, self.cfg.head_dim)
+
+        def _shape(x):
+            return tuple((x["q"] if isinstance(x, dict) else x).shape)
+
+        if _shape(payload["k"]) != want or _shape(payload["v"]) != want:
+            return False
+        m = cover - base
+        phys = self._stream_pages[slot][base:cover]
+        self.pool = self._adopt_exec(m)(
+            self.pool,
+            _payload_slice(payload["k"], base, cover),
+            _payload_slice(payload["v"], base, cover),
+            jnp.asarray(phys, jnp.int32))
+        self._prefill_pos[slot] = cover * ps
+        self.directory_hits += 1
+        self.adopted_prefix_pages += m
+        self._radix_adopt(prompt[:cover * ps],
+                          self._stream_pages[slot][:cover])
+        return True
+
+    def export_prefix(self, prompt: List[int]) -> Optional[Dict[str, Any]]:
+        """Serve a sibling's prefix-adoption fetch: the longest
+        radix-cached full-page chain of ``prompt``, gathered to host as
+        a span the peer installs with the adoption machinery. The span
+        covers CACHED pages only (``first_token`` is the ``-1``
+        prefix-span sentinel — the asker still prefills its tail), and
+        the gather runs with the lookup's references held, so a
+        concurrent eviction cannot free the pages mid-read. Returns
+        None when nothing is cached — the asker recomputes."""
+        if self.radix is None:
+            return None
+        prompt = list(prompt)
+        # lookup only ever covers a PROPER prefix; pad one sentinel
+        # token so a prompt of exactly k full pages can match all k
+        shared, _ = self.radix.lookup(prompt + [-1])
+        if not shared:
+            return None
+        try:
+            payload = self._gather_span(shared)
+        finally:
+            for p in shared:
+                self.ledger.unref(p)
+        self.exported_prefixes += 1
+        return {"version": 1,
+                "prompt": prompt[:len(shared) * self.page_size],
+                "first_token": -1, "page_size": self.page_size,
+                "kv_quant": bool(self.cfg.kv_quant), "payload": payload}
 
     # ------------------------------------------------------ live migration
 
@@ -1221,7 +1500,7 @@ class PagedServer:
         own_needed = total - len(shared)
         pages = self.ledger.alloc(own_needed)
         if pages is None and self.radix is not None:
-            self.radix.evict(own_needed - self.ledger.free_count())
+            self._evict(own_needed - self.ledger.free_count())
             pages = self.ledger.alloc(own_needed)
         if pages is None:
             for p in shared:
@@ -1371,6 +1650,7 @@ class PagedServer:
         """One prefill chunk (if queued) + one decode step for every
         decode-active stream; returns {stream: token}."""
         self._flush_pending()
+        self._tier_tick()
         self._prefill_tick()
         active = [i for i in range(self.slots)
                   if self.requests[i] is not None and self._decoding[i]]
@@ -1407,6 +1687,7 @@ class PagedServer:
         if k <= 1:
             return {slot: [tok] for slot, tok in self.step().items()}
         self._flush_pending()
+        self._tier_tick()
         for _ in range(k):
             self._prefill_tick()
             if not self._prefill_q:
@@ -1490,7 +1771,7 @@ class PagedServer:
             # writes start at position len(prompt)), so they are safe to
             # share; a mid-window garbage write can only land in the
             # final allocated page, which is never a full prompt page
-            self.radix.insert(prompt, pages)
+            self._radix_adopt(prompt, pages)
         for p in pages:
             self.ledger.unref(p)
         self._stream_pages[slot] = []
@@ -1536,6 +1817,11 @@ class PagedServer:
         self._prefill_pos = [0] * self.slots
         self._prefill_q.clear()
         self._decoding = [False] * self.slots
+        # pending promote plans die with the streams; the TIER FRAMES
+        # survive — they are content-addressed host/disk byte copies,
+        # still bit-valid for the rebuilt pool, so a reset engine keeps
+        # its cold cache warm
+        self._pending_tier.clear()
 
     # -------------------------------------------------------------- audit
 
@@ -1570,4 +1856,15 @@ class PagedServer:
             "adopt_shared_pages": self.adopt_shared_pages,
             "migrated_out": self.migrated_out,
             "migrated_in": self.migrated_in,
+            "tier_demoted_pages": self.tier_demoted_pages,
+            "tier_promoted_pages": self.tier_promoted_pages,
+            "tier_fallbacks": self.tier_fallbacks,
+            "tier_promote_s": self.tier_promote_s,
+            "directory_hits": self.directory_hits,
+            "directory_fallbacks": self.directory_fallbacks,
+            "adopted_prefix_pages": self.adopted_prefix_pages,
+            "exported_prefixes": self.exported_prefixes,
+            "tiers": self.tiers.stats() if self.tiers is not None else None,
+            "directory": (self.directory.stats()
+                          if self.directory is not None else None),
         }

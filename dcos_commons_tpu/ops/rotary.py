@@ -34,6 +34,22 @@ def apply_rope(x: jnp.ndarray, table: jnp.ndarray,
     return _rotate(x, cos, sin, half)
 
 
+def apply_rope_positions(x: jnp.ndarray, table: jnp.ndarray,
+                         pos: jnp.ndarray) -> jnp.ndarray:
+    """Rotate [B, S, H, D] at explicit positions ``pos`` [S] shared by
+    every batch row — the chunked-prefill path, where a resumed chunk's
+    window ``start..start+S`` may overrun the table (its tail past
+    ``true_len`` is dead padding). :func:`apply_rope` must not be used
+    there: ``dynamic_slice`` clamps the START when the slice would run
+    off the table, silently mis-rotating the LIVE head of the chunk;
+    the gather here clamps per lane, so only dead tail lanes saturate.
+    Callers pass ``pos`` pre-clipped to the table."""
+    half = x.shape[-1] // 2
+    cos = table[0][pos][None, :, None, :]           # [1, S, 1, D/2]
+    sin = table[1][pos][None, :, None, :]
+    return _rotate(x, cos, sin, half)
+
+
 def apply_rope_at(x: jnp.ndarray, table: jnp.ndarray,
                   pos: jnp.ndarray) -> jnp.ndarray:
     """Rotate a single decode position PER SLOT: x [B, 1, H, D], pos [B]

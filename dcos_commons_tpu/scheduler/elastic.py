@@ -65,7 +65,13 @@ def backpressure(gauges: dict, ttft_slo_ms: Optional[float] = None) -> float:
     * KV-page occupancy: ``1 - pages_free / pages_total`` (paged engines
       admit on pages, so this is the real utilization signal);
     * TTFT p95 against the SLO (when one is configured): crossing the SLO
-      reads as high pressure even before the queue backs up.
+      reads as high pressure even before the queue backs up;
+    * host-tier occupancy, half-weighted: a full host tier
+      (``kv_tier_host_pages / kv_tier_host_capacity``) means cold
+      prefixes are already spilling to disk — promote latency is about
+      to climb, a leading indicator worth pressure 0.5 but never a
+      scale-up on its own (untiered replicas report no tier keys and
+      are unaffected).
     """
     p = 0.0
     cap = gauges.get("queue_capacity") or 0
@@ -77,6 +83,10 @@ def backpressure(gauges: dict, ttft_slo_ms: Optional[float] = None) -> float:
     if total:
         free = gauges.get("pages_free", total)
         p = max(p, min(1.0, 1.0 - free / total))
+    host_cap = gauges.get("kv_tier_host_capacity") or 0
+    if host_cap:
+        fill = gauges.get("kv_tier_host_pages", 0) / host_cap
+        p = max(p, 0.5 * min(1.0, fill))
     ttft = gauges.get("ttft_p95_ms")
     if ttft_slo_ms and ttft is not None:
         p = max(p, min(1.0, 0.8 * ttft / ttft_slo_ms))
@@ -612,7 +622,13 @@ def http_gauges(urls: Sequence[str],
         return load if isinstance(load, dict) else None
 
     additive = ("queue_depth", "queue_capacity", "completed", "shed",
-                "pages_free", "pages_total")
+                "pages_free", "pages_total",
+                # KV tier hierarchy (tiered replicas only): occupancy
+                # and capacity sum across the fleet like pages do, so
+                # backpressure()'s host-fill term reads fleet-wide
+                "kv_tier_host_pages", "kv_tier_host_capacity",
+                "kv_tier_disk_pages", "kv_tier_disk_capacity",
+                "kv_tier_hits", "kv_tier_promoted", "kv_tier_demoted")
 
     def gauges() -> dict:
         merged: dict = {}

@@ -52,6 +52,21 @@ DEFAULT_ENV: Mapping[str, str] = {
     "SERVE_PAGES": "0",
     "SERVE_PAGE_SIZE": "64",
     "SERVE_PREFILL_CHUNK": "64",
+    # hierarchical KV economy (models/paging.py PageTierStore +
+    # PrefixDirectory): KV_TIER_HOST_PAGES > 0 arms a pinned-host tier
+    # that cold radix pages demote into as digest-checked frames
+    # instead of being freed; KV_TIER_DISK_DIR + KV_TIER_DISK_PAGES
+    # add a disk tier the host LRU spills to (capacities in PAGES, so
+    # host+disk >= SERVE_PAGES doubles effective cache at equal HBM).
+    # PREFIX_DIRECTORY > 0 arms the fleet prefix directory with that
+    # staleness window in seconds: the replica publishes its cached
+    # chains and adopts fleet-hot prefixes from sibling /v1/prefix
+    # endpoints instead of recomputing (stale hints cost one failed
+    # fetch and fall back to recompute — never a wrong answer).
+    "KV_TIER_HOST_PAGES": "0",
+    "KV_TIER_DISK_DIR": "",
+    "KV_TIER_DISK_PAGES": "0",
+    "PREFIX_DIRECTORY": "0",
     # disaggregated prefill/decode tiers (disagg.yml + models/disagg.py):
     # SERVE_ROLE picks the tier a replica runs (colocated|prefill|decode)
     # and SERVE_PEER points a decode replica at its prefill tier's

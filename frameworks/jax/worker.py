@@ -401,6 +401,13 @@ def run_llama(args) -> dict:
                                        decode_window=args.decode_window,
                                        metrics=registry)
             frontend.start()
+            if getattr(server, "directory", None) is not None:
+                # publish prefix-directory claims under the address
+                # siblings can actually fetch from (POST /v1/prefix) —
+                # the directory key IS the adoption endpoint
+                import socket
+                server.replica_id = ("http://" + socket.gethostname()
+                                     + f":{frontend.port}")
             # re-stamp the readiness marker now that the ingress is
             # actually listening (the yml readiness probe hits healthz)
             with open("serving.ready", "w") as f:
@@ -585,13 +592,46 @@ def _make_serving_engine(args, cfg, params, mesh, key=None):
                 pages=None if args.pages < 0 else args.pages,
                 page_size=args.page_size,
                 prefill_chunk=args.prefill_chunk,
-                compile_cache=aot.from_env(), **kw)
+                compile_cache=aot.from_env(),
+                **_make_kv_tiers(args), **kw)
             return engine, engine.page_stats()
         except ValueError as e:
             _emit({"event": "paged_fallback", "error": str(e),
                    "pages": args.pages, "page_size": args.page_size,
                    "prefill_chunk": args.prefill_chunk})
     return SlotServer(cfg, params, slots=args.slots, **kw), None
+
+
+def _make_kv_tiers(args) -> dict:
+    """PagedServer tier/directory kwargs per the KV_TIER_* /
+    PREFIX_DIRECTORY knobs, degrade-not-crash: an unusable disk dir
+    (permissions, read-only volume) drops the tier store with a loud
+    ``kv_tier_fallback`` event and the replica serves single-tier —
+    the tiers are an economy, never a dependency. The directory knob
+    also wires ``disagg.fetch_prefix`` as the peer-fetch transport so
+    directory hits adopt over sibling ``/v1/prefix`` endpoints."""
+    from dcos_commons_tpu.models.disagg import fetch_prefix
+    from dcos_commons_tpu.models.paging import (PageTierStore,
+                                                PrefixDirectory)
+    kw: dict = {}
+    host = max(0, getattr(args, "kv_tier_host_pages", 0))
+    disk_dir = getattr(args, "kv_tier_disk_dir", "") or None
+    disk = max(0, getattr(args, "kv_tier_disk_pages", 0)) if disk_dir \
+        else 0
+    if host or disk:
+        try:
+            kw["tiers"] = PageTierStore(host_pages=host,
+                                        disk_dir=disk_dir,
+                                        disk_pages=disk)
+        except (OSError, ValueError) as e:
+            _emit({"event": "kv_tier_fallback", "error": str(e),
+                   "host_pages": host, "disk_dir": disk_dir,
+                   "disk_pages": disk})
+    window = getattr(args, "prefix_directory", 0.0)
+    if window and window > 0:
+        kw["directory"] = PrefixDirectory(max_age_s=window)
+        kw["peer_fetch"] = fetch_prefix
+    return kw
 
 
 def _serve_disagg(args, cfg, params, mesh, result) -> bool:
@@ -1069,6 +1109,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "prefilled per engine step, interleaved with "
                         "decode (bounds head-of-line TTFT impact of "
                         "long prompts)")
+    p.add_argument("--kv-tier-host-pages", type=int,
+                   default=int(os.environ.get("KV_TIER_HOST_PAGES",
+                                              "0")),
+                   help="llama --serve --pages: pinned-host KV tier "
+                        "capacity in pages; cold radix pages demote "
+                        "here as digest-checked frames and promote "
+                        "back asynchronously on prefix hit (0 = evict "
+                        "frees outright, no tier)")
+    p.add_argument("--kv-tier-disk-dir",
+                   default=os.environ.get("KV_TIER_DISK_DIR", ""),
+                   help="llama --serve --pages: directory for the "
+                        "disk KV tier the host tier's LRU spills to "
+                        "(empty = no disk tier)")
+    p.add_argument("--kv-tier-disk-pages", type=int,
+                   default=int(os.environ.get("KV_TIER_DISK_PAGES",
+                                              "0")),
+                   help="llama --serve --pages: disk KV tier capacity "
+                        "in pages (overflow drops the coldest frame)")
+    p.add_argument("--prefix-directory", type=float,
+                   default=float(os.environ.get("PREFIX_DIRECTORY",
+                                                "0")),
+                   help="llama --serve --pages: fleet prefix-directory "
+                        "staleness window in seconds; > 0 publishes "
+                        "this replica's cached chains and adopts "
+                        "fleet-hot prefixes from sibling /v1/prefix "
+                        "endpoints instead of recomputing (0 = off)")
     p.add_argument("--queue-limit", type=int, default=64,
                    help="llama --serve --slots: bounded ingress queue "
                         "(overflow answers 503 + Retry-After)")

@@ -32,6 +32,9 @@ python -m tools.metrics_smoke --budget-s "${METRICS_SMOKE_BUDGET_S:-90}"
 echo "== migrate smoke (live decode-stream drains, token-exact resume, time-capped) =="
 python -m tools.migrate_smoke --budget-s "${MIGRATE_SMOKE_BUDGET_S:-90}"
 
+echo "== kv-tier smoke (host/disk demote-promote + fleet prefix adoption, time-capped) =="
+python -m tools.kvtier_smoke --budget-s "${KVTIER_SMOKE_BUDGET_S:-90}"
+
 echo "== control-plane smoke (steady-state cycle budget under churn) =="
 # observed p50 ~6.4ms at fleet 500; the pin is ~12x that so only an
 # O(fleet) regression (not CI-host noise) trips it

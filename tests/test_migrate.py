@@ -11,7 +11,6 @@ import urllib.request
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 import tests._jax_cpu  # noqa: F401

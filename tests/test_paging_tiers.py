@@ -1,0 +1,470 @@
+"""Hierarchical KV economy (``models/paging.py`` page tiers +
+fleet prefix directory): demote/promote round-trip bit-exactness for
+bf16 and int8 pools, corrupted-frame rejection (truncation + bit-flip
+fuzz), the promote-during-evict race, fleet adoption parity vs
+recompute, and directory staleness falling back to recompute."""
+
+import random
+
+import numpy as np
+import pytest
+
+import tests._jax_cpu  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+
+from dcos_commons_tpu.models import llama, serving
+from dcos_commons_tpu.models.paging import (PageFrameError, PageTierStore,
+                                            PrefixDirectory, chain_keys,
+                                            page_hashes, pack_page_frame,
+                                            unpack_page_frame)
+
+
+def _cfg(**kw):
+    return llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                  attn_impl="dense", **kw)
+
+
+def _solo(cfg, params, prompt, steps):
+    toks = llama.generate_stepwise(cfg, params,
+                                   jnp.asarray([prompt], jnp.int32),
+                                   steps)
+    return [int(t) for t in toks[0]]
+
+
+def _prompt(seed, n, vocab):
+    return [int(t) for t in jax.random.randint(
+        jax.random.key(seed), (n,), 0, vocab)]
+
+
+# ------------------------------------------------------ KVPAGE1 wire format
+
+
+def _payload(seed=0, quant=False):
+    """One page of synthetic KV in the gathered span layout
+    ``[layers, 1, page, kv_heads, head_dim]``."""
+    rng = np.random.default_rng(seed)
+    shape = (2, 1, 8, 1, 4)
+    if quant:
+        return {side: {"q": rng.integers(-128, 127, shape, dtype=np.int8),
+                       "s": rng.random((2, 1, 8, 1, 1)).astype(np.float32)}
+                for side in ("k", "v")}
+    return {side: rng.random(shape).astype(np.float32)
+            for side in ("k", "v")}
+
+
+def _entry(seed=0, quant=False):
+    tokens = list(range(8))
+    return {"chain": chain_keys(tokens, 8)[-1],
+            "page_hash": page_hashes(tokens, 8)[-1],
+            "kv_quant": quant,
+            "payload": _payload(seed, quant)}
+
+
+def _assert_payload_equal(a, b):
+    for side in ("k", "v"):
+        if isinstance(a[side], dict):
+            for part in ("q", "s"):
+                np.testing.assert_array_equal(
+                    np.asarray(a[side][part]), np.asarray(b[side][part]))
+        else:
+            np.testing.assert_array_equal(np.asarray(a[side]),
+                                          np.asarray(b[side]))
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8"])
+def test_page_frame_roundtrip(quant):
+    entry = _entry(quant=quant)
+    back = unpack_page_frame(pack_page_frame(entry), chain=entry["chain"])
+    assert back["chain"] == entry["chain"]
+    assert back["page_hash"] == entry["page_hash"]
+    assert back["kv_quant"] == quant
+    _assert_payload_equal(back["payload"], entry["payload"])
+
+
+def test_page_frame_rejects_wrong_chain_and_magic():
+    entry = _entry()
+    frame = pack_page_frame(entry)
+    with pytest.raises(PageFrameError, match="magic"):
+        unpack_page_frame(b"NOTAPAGE" + frame[8:])
+    with pytest.raises(PageFrameError, match="chain"):
+        unpack_page_frame(frame, chain="0" * 16)
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8"])
+def test_page_frame_fuzz_truncation_and_bitflips(quant):
+    """Every truncation point and a spray of single-bit flips either
+    round-trips IDENTICALLY or raises PageFrameError — never a crash,
+    never silently-wrong KV bytes (the DECSTATE discipline at page
+    granularity)."""
+    entry = _entry(seed=7, quant=quant)
+    frame = pack_page_frame(entry)
+    clean = unpack_page_frame(frame)
+    rng = random.Random(0x4B5041)
+    cuts = {0, 4, 8, 10, 12, len(frame) - 1} | {
+        rng.randrange(len(frame)) for _ in range(24)}
+    for cut in sorted(cuts):
+        with pytest.raises(PageFrameError):
+            unpack_page_frame(frame[:cut])
+    for _ in range(48):
+        flipped = bytearray(frame)
+        i = rng.randrange(len(frame))
+        flipped[i] ^= 1 << rng.randrange(8)
+        try:
+            back = unpack_page_frame(bytes(flipped))
+        except PageFrameError:
+            continue
+        # a flip the verifier tolerates must be semantically invisible
+        assert back["chain"] == clean["chain"]
+        _assert_payload_equal(back["payload"], clean["payload"])
+
+
+# ------------------------------------------------------------ tier store
+
+
+def test_tier_store_host_lru_spills_to_disk_then_drops(tmp_path):
+    store = PageTierStore(host_pages=2, disk_dir=str(tmp_path),
+                          disk_pages=2)
+    entries = {}
+    for i in range(5):
+        e = _entry(seed=i)
+        e["chain"] = f"{i:016x}"
+        entries[e["chain"]] = e
+        store.put(e["chain"], e)
+    # newest 2 on host, next 2 spilled to disk, oldest dropped
+    assert store.host_count() == 2 and store.disk_count() == 2
+    st = store.stats()
+    assert st["dropped"] == 1 and st["demoted_disk"] >= 2
+    assert not store.has("0000000000000000")
+    # disk hit round-trips bit-exact and POPS the frame
+    chain = sorted(store.chains())[0]
+    back = store.take(chain)
+    _assert_payload_equal(back["payload"], entries[chain]["payload"])
+    assert not store.has(chain)
+    assert store.take(chain) is None          # POP semantics: gone
+    assert store.stats()["misses"] == 1
+
+
+def test_tier_store_rejects_corrupt_disk_frame(tmp_path):
+    store = PageTierStore(host_pages=1, disk_dir=str(tmp_path),
+                          disk_pages=4)
+    a, b = _entry(seed=1), _entry(seed=2)
+    a["chain"], b["chain"] = "a" * 16, "b" * 16
+    store.put(a["chain"], a)
+    store.put(b["chain"], b)                  # displaces a to disk
+    assert store.disk_count() == 1
+    path = next(tmp_path.iterdir())
+    blob = bytearray(path.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF              # bit-rot the body
+    path.write_bytes(bytes(blob))
+    assert store.take(a["chain"]) is None     # digest check rejects
+    assert store.stats()["corrupt_frames"] == 1
+    assert not store.has(a["chain"])          # bad frame is gone
+    # truncation dies the same way
+    store.put(a["chain"], a)
+    c = _entry(seed=3)
+    c["chain"] = "c" * 16
+    store.put(c["chain"], c)                  # displaces a to disk again
+    path = next(tmp_path.iterdir())
+    path.write_bytes(path.read_bytes()[:10])
+    assert store.take(a["chain"]) is None
+    assert store.stats()["corrupt_frames"] == 2
+
+
+def test_tier_store_requires_dir_for_disk_pages():
+    with pytest.raises(ValueError, match="disk_dir"):
+        PageTierStore(host_pages=1, disk_pages=4)
+
+
+# ------------------------------------------------------- prefix directory
+
+
+def test_directory_staleness_and_exclude():
+    clock = [0.0]
+    d = PrefixDirectory(max_age_s=5.0, clock=lambda: clock[0])
+    d.publish("r1", ["c1", "c2"])
+    clock[0] = 3.0
+    d.publish("r2", ["c1"])
+    assert d.lookup("c1", exclude="r2") == "r1"
+    assert d.lookup("c1") == "r2"             # freshest wins
+    clock[0] = 6.0                            # r1's claim is now stale
+    assert d.lookup("c1") == "r2"
+    assert d.holders("c1") == ["r2"]
+    clock[0] = 20.0
+    assert d.lookup("c1") is None             # everything aged out
+    assert d.lookup("c2") is None
+    st = d.stats()
+    assert st["stale_drops"] >= 2 and st["misses"] >= 2
+    d.publish("r3", ["c9"])
+    d.forget("r3")
+    assert d.lookup("c9") is None
+
+
+# ------------------------------------------- engine demote/promote parity
+
+
+def _radix_tail_chains(eng):
+    """Chain key of every node resident in the engine's radix."""
+    out = set()
+    for node in eng.radix._iter_nodes():
+        toks = eng.radix.prefix_tokens(node)
+        out.add(chain_keys(toks, eng.page_size)[-1])
+    return out
+
+
+def _audit(eng):
+    """Ledger + single-owner audit after a drain: every page accounted,
+    and no chain lives in both the radix and the tier store."""
+    assert eng.ledger.check(eng.radix.held()) == []
+    if eng.tiers is not None:
+        overlap = set(eng.tiers.chains()) & _radix_tail_chains(eng)
+        assert not overlap, overlap
+
+
+@pytest.mark.parametrize("pool_kind", ["bf16", "int8"])
+def test_demote_promote_roundtrip_token_and_bit_exact(pool_kind, tmp_path):
+    """Evict a cached prefix through the demote seam (host tier spilling
+    to disk), hit it again, and the async promote must restore the SAME
+    bytes — token-exact decode and bit-identical KV pages."""
+    cfg = _cfg(kv_quant=True) if pool_kind == "int8" else _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    tiers = PageTierStore(host_pages=1, disk_dir=str(tmp_path),
+                          disk_pages=8)
+    eng = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                              prefill_chunk=8, tiers=tiers)
+    prompt = _prompt(60, 24, cfg.vocab_size)
+    want = _solo(cfg, params, prompt, 6)
+    got = eng.drain([{"prompt": prompt, "max_new": 6, "request_id": "a"}])
+    assert got["a"] == want
+    # the retired stream's 3 full prompt pages are radix-cached; gather
+    # their device bytes as ground truth, then demote ALL of them
+    shared, _ = eng.radix.lookup(prompt + [-1])
+    before = eng._gather_span(shared)
+    for p in shared:
+        eng.ledger.unref(p)
+    eng._evict(eng.ledger.pages)
+    assert eng.tier_demoted_pages == 3
+    assert tiers.host_count() + tiers.disk_count() == 3
+    assert _radix_tail_chains(eng) == set()
+    # re-admission hits the tier: one-step deferred promote, then decode
+    got2 = eng.drain([{"prompt": prompt, "max_new": 6,
+                       "request_id": "b"}])
+    assert got2["b"] == want
+    assert eng.tier_promoted_pages >= 2      # max_cover leaves >=1 token
+    assert eng.tier_fallbacks == 0
+    shared2, _ = eng.radix.lookup(prompt + [-1])
+    after = eng._gather_span(shared2)
+    for p in shared2:
+        eng.ledger.unref(p)
+    _assert_payload_equal(after, before)      # bit-exact round trip
+    _audit(eng)
+
+
+def test_corrupt_tier_frame_falls_back_to_recompute(tmp_path):
+    """A bit-rotted disk frame dies in the digest check at promote time:
+    the stream recomputes and still decodes token-exact."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    tiers = PageTierStore(host_pages=0, disk_dir=str(tmp_path),
+                          disk_pages=8)
+    eng = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                              prefill_chunk=8, tiers=tiers)
+    prompt = _prompt(61, 24, cfg.vocab_size)
+    want = _solo(cfg, params, prompt, 5)
+    eng.drain([{"prompt": prompt, "max_new": 5, "request_id": "a"}])
+    eng._evict(eng.ledger.pages)
+    assert tiers.disk_count() == 3
+    for path in tmp_path.iterdir():           # rot every frame body
+        blob = bytearray(path.read_bytes())
+        blob[-3] ^= 0x55
+        path.write_bytes(bytes(blob))
+    got = eng.drain([{"prompt": prompt, "max_new": 5,
+                      "request_id": "b"}])
+    assert got["b"] == want
+    assert eng.tier_promoted_pages == 0
+    assert eng.tier_fallbacks >= 1
+    assert tiers.stats()["corrupt_frames"] >= 1
+    _audit(eng)
+
+
+def test_promote_during_evict_race_resolves_to_one_owner():
+    """An eviction (engine reset pressure) racing a planned promote:
+    take() POPs, so the plan either installs the bytes it holds or
+    recomputes — exactly one owner either way, ledger clean, tokens
+    exact."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    tiers = PageTierStore(host_pages=8)
+    eng = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                              prefill_chunk=8, tiers=tiers)
+    prompt = _prompt(62, 24, cfg.vocab_size)
+    want = _solo(cfg, params, prompt, 5)
+    eng.drain([{"prompt": prompt, "max_new": 5, "request_id": "a"}])
+    eng._evict(eng.ledger.pages)
+    demoted = set(tiers.chains())
+    assert demoted
+    # admission plans the promote (stream deferred one step)...
+    eng.submit(prompt, 5, request_id="b")
+    assert eng._pending_tier
+    # ...and the race lands first: the frames vanish from the tier
+    # (a concurrent promote took them / pressure dropped them)
+    for chain in list(tiers.chains()):
+        tiers.discard(chain)
+    for _ in range(64):
+        eng.step()
+        if "b" in eng.finished:
+            break
+    assert eng.finished["b"] == want
+    assert eng.tier_fallbacks == 1            # plan fell back, no crash
+    _audit(eng)
+
+
+# --------------------------------------------------------- fleet adoption
+
+
+def test_fleet_adoption_parity_vs_recompute():
+    """Replica B adopts a fleet-hot prefix from sibling A through the
+    directory + export_prefix instead of recomputing — token-exact."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    directory = PrefixDirectory(max_age_s=60.0)
+    a = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                            prefill_chunk=8, directory=directory,
+                            replica_id="rep-a")
+    b = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                            prefill_chunk=8, directory=directory,
+                            replica_id="rep-b",
+                            peer_fetch=lambda holder, p:
+                                a.export_prefix(p))
+    base = _prompt(63, 24, cfg.vocab_size)
+    a.drain([{"prompt": base, "max_new": 4, "request_id": "warm"}])
+    assert directory.lookup(chain_keys(base, 8)[-1],
+                            exclude="rep-b") == "rep-a"
+    prompt = base + _prompt(64, 4, cfg.vocab_size)
+    want = _solo(cfg, params, prompt, 6)
+    got = b.drain([{"prompt": prompt, "max_new": 6, "request_id": "x"}])
+    assert got["x"] == want
+    assert b.directory_hits == 1
+    assert b.adopted_prefix_pages == 3        # all of A's cached pages
+    assert a.exported_prefixes == 1
+    assert b.ledger.check(b.radix.held()) == []
+    # B now holds the prefix too and has published its claim
+    assert set(directory.holders(chain_keys(base, 8)[-1])) == {
+        "rep-a", "rep-b"}
+
+
+def test_stale_directory_hint_recomputes_gracefully():
+    """The hinted holder no longer has the prefix: the fetch comes back
+    empty and the stream recomputes — a fallback, never an error."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    directory = PrefixDirectory(max_age_s=60.0)
+    base = _prompt(65, 16, cfg.vocab_size)
+    # a ghost claim: the "holder" serves nothing
+    directory.publish("rep-ghost", chain_keys(base, 8))
+    b = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                            prefill_chunk=8, directory=directory,
+                            replica_id="rep-b",
+                            peer_fetch=lambda holder, p: None)
+    prompt = base + _prompt(66, 5, cfg.vocab_size)
+    want = _solo(cfg, params, prompt, 5)
+    got = b.drain([{"prompt": prompt, "max_new": 5, "request_id": "x"}])
+    assert got["x"] == want
+    assert b.directory_fallbacks == 1
+    assert b.directory_hits == 0
+    assert b.ledger.check(b.radix.held()) == []
+
+
+def test_http_prefix_adoption_end_to_end():
+    """The wire version of the fleet test: sibling A serves its cached
+    prefix over ``ServingFrontend``'s ``POST /v1/prefix`` (the export
+    runs on A's engine thread, never the handler's) and B adopts it
+    through ``disagg.fetch_prefix`` — token-exact, with a miss probe
+    answering None instead of raising."""
+    import json
+    import urllib.request
+
+    from dcos_commons_tpu.models.disagg import fetch_prefix
+    from dcos_commons_tpu.models.ingress import ServingFrontend
+
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    directory = PrefixDirectory(max_age_s=60.0)
+    a = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                            prefill_chunk=8, directory=directory)
+    fe = ServingFrontend(a, port=0, host="127.0.0.1")
+    url = f"http://127.0.0.1:{fe.port}"
+    a.replica_id = url         # the directory key IS the fetch address
+    fe.start()
+    try:
+        base = _prompt(67, 24, cfg.vocab_size)
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=json.dumps({"prompt": base, "max_new": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert json.loads(r.read())["tokens"]
+        assert directory.lookup(chain_keys(base, 8)[-1]) == url
+        # a prompt nothing covers: a clean miss (404 -> None)
+        assert fetch_prefix(url, _prompt(68, 9, cfg.vocab_size)) is None
+        b = serving.PagedServer(
+            cfg, params, slots=2, page_size=8, prefill_chunk=8,
+            directory=directory, replica_id="rep-b",
+            peer_fetch=lambda holder, p:
+                fetch_prefix(holder, p, timeout_s=30.0))
+        prompt = base + _prompt(69, 4, cfg.vocab_size)
+        want = _solo(cfg, params, prompt, 6)
+        got = b.drain([{"prompt": prompt, "max_new": 6,
+                        "request_id": "x"}])
+        assert got["x"] == want
+        assert b.directory_hits == 1
+        assert b.adopted_prefix_pages == 3
+        assert b.ledger.check(b.radix.held()) == []
+    finally:
+        fe.stop()
+
+
+def test_resume_chunk_past_rope_table_is_exact():
+    """Regression: a resumed prefill chunk (radix hit / tier promote /
+    fleet adoption) whose window ``start + chunk`` overruns ``max_seq``
+    must still rotate its LIVE head correctly. ``apply_rope``'s
+    dynamic_slice clamps the slice START when the window runs off the
+    rope table, silently mis-rotating every live position of the chunk
+    (the bug only bites resumes — cold prefill walks chunk-aligned
+    windows that never overrun), so the chunk path gathers rope rows
+    per position instead."""
+    # wide enough heads that a mis-rotated prefix actually flips
+    # tokens (head_dim 4 shrugs the bug off); still tiny enough for CI
+    cfg = llama.LlamaConfig(vocab_size=512, dim=128, n_layers=2,
+                            n_heads=4, n_kv_heads=2, ffn_dim=384,
+                            max_seq=64, remat=False, kv_quant=False)
+    params = llama.init_params(cfg, jax.random.key(0))
+    base = _prompt(67, 40, cfg.vocab_size)        # 5 full pages
+    prompt = base + _prompt(68, 4, cfg.vocab_size)
+    want = _solo(cfg, params, prompt, 6)
+
+    # radix-hit resume: start=40, chunk=32 -> window [40, 72) > 64
+    eng = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                              prefill_chunk=32)
+    assert eng.drain([{"prompt": prompt, "max_new": 6,
+                       "request_id": "c"}])["c"] == want
+    assert eng.drain([{"prompt": prompt, "max_new": 6,
+                       "request_id": "h"}])["h"] == want
+    assert eng.page_stats()["prefix_hits"] == 1
+
+    # fleet-adoption resume at the same overrunning offset
+    directory = PrefixDirectory(max_age_s=60.0)
+    a = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                            prefill_chunk=32, directory=directory,
+                            replica_id="rep-a")
+    a.drain([{"prompt": base, "max_new": 4, "request_id": "warm"}])
+    b = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                            prefill_chunk=32, directory=directory,
+                            replica_id="rep-b",
+                            peer_fetch=lambda holder, p:
+                                a.export_prefix(p))
+    got = b.drain([{"prompt": prompt, "max_new": 6, "request_id": "x"}])
+    assert got["x"] == want
+    assert b.directory_hits == 1
+    assert b.adopted_prefix_pages == 5

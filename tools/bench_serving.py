@@ -44,6 +44,18 @@ gains ``route_policy`` / ``fleet_prefix_hits`` / ``fleet_prefix_hit_rate``
 router's own counters — the ``--route-policy affinity`` vs ``random``
 pair at one config is the Round 12 fleet-routing receipt
 (``bench_r12/fleet_routing.jsonl``).
+
+``--kv-tiers`` runs the hierarchical-KV economy A/B at EQUAL HBM: the
+same Poisson-ordered shared-prefix request sequence drives a single-
+tier paged engine and a tiered one (host+disk ``PageTierStore`` sized
+so pool+tiers >= 3x the HBM pool), then a cold-replica probe adopts
+each fleet-hot prefix from a warm sibling (``PrefixDirectory`` +
+``export_prefix``) vs recomputing it, with a token-exact parity gate.
+Three JSON lines — capacity arm x2 + adoption arm — are the Round 16
+receipt (``bench_r16/kv_tiers.jsonl``): effective capacity multiplier,
+prefix-hit rate and tok/s uplift, tier hit/promote traffic with
+promote-vs-cold TTFT, and adoption-vs-recompute TTFT with
+``parity.ok``.
 """
 
 from __future__ import annotations
@@ -124,6 +136,12 @@ def main(argv=None) -> int:
                         "slots x max_seq/page_size)")
     p.add_argument("--page-size", type=int, default=64)
     p.add_argument("--prefill-chunk", type=int, default=64)
+    p.add_argument("--kv-tiers", action="store_true",
+                   help="hierarchical-KV A/B: single-tier vs host+disk "
+                        "tiered engine at equal HBM on one shared-"
+                        "prefix sequence, plus cold-replica adoption "
+                        "vs recompute with a token parity gate "
+                        "(3 JSON lines, bench_r16/kv_tiers.jsonl)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
@@ -158,6 +176,8 @@ def main(argv=None) -> int:
         params = llama.init_params(cfg, jax.random.key(0))
         quant_applied = "none"
 
+    if args.kv_tiers:
+        return _kv_tiers_bench(args, cfg, params, quant_applied)
     if args.engine == "fleet":
         return _fleet_bench(args, cfg, params, quant_applied)
 
@@ -548,6 +568,240 @@ def _fleet_bench(args, cfg, params, quant_applied) -> int:
                          ("routed", "affinity_hits", "affinity_rate",
                           "spills_hot", "spills_down", "spill_attempts",
                           "spill_resumes", "dropped_streams", "sheds")},
+        "backend": jax.devices()[0].platform,
+    }), flush=True)
+    return 0
+
+
+def _kv_tiers_bench(args, cfg, params, quant_applied) -> int:
+    """Hierarchical-KV economy receipts at EQUAL HBM: one Poisson-
+    ordered shared-prefix request sequence drives (A) a single-tier
+    paged engine and (B) the same pool with host+disk ``PageTierStore``
+    behind it, so the only difference is where an evicted prefix GOES;
+    then (C) a cold replica adopts each fleet-hot prefix from a warm
+    sibling (directory + ``export_prefix``) vs recomputing it, gated on
+    token-exact parity against the uninterrupted greedy reference.
+    Three JSON lines — the Round 16 ``bench_r16/kv_tiers.jsonl``."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama
+    from dcos_commons_tpu.models.paging import (PageTierStore,
+                                                PrefixDirectory)
+    from dcos_commons_tpu.models.serving import PagedServer
+
+    rng = random.Random(args.seed)
+    ps = args.page_size
+    prefix_len = max(ps, (args.shared_prefix or 4 * ps) // ps * ps)
+    groups = max(2, args.prefix_groups)
+    lens = [int(x) for x in args.prompt_lens.split(",")]
+    prefixes = [[rng.randrange(cfg.vocab_size) for _ in range(prefix_len)]
+                for _ in range(groups)]
+    prefix_pages = prefix_len // ps
+    per_req = -(-(prefix_len + max(lens) + args.max_new) // ps)
+    # the HBM pool holds ~2 of the G hot prefixes plus one stream's
+    # working set — the thrash regime the tiers exist for; host+disk
+    # each match the pool, so effective capacity is 3x at equal HBM
+    pool = args.pages if args.pages > 0 else 2 * prefix_pages + per_req
+    n_requests = max(24, min(240, int(args.rps * args.duration)))
+    seq = [rng.choice(prefixes)
+           + [rng.randrange(cfg.vocab_size)
+              for _ in range(rng.choice(lens))]
+           for _ in range(n_requests)]
+
+    def make_engine(**kw):
+        eng = PagedServer(cfg, params, slots=args.slots, pages=pool,
+                          page_size=ps,
+                          prefill_chunk=args.prefill_chunk, **kw)
+        # compile-warm every shape the sequence hits with FRESH random
+        # tokens (warming with the shared prefixes would pre-seed the
+        # radix and erase the A/B contrast), then drop the warm state
+        wrng = random.Random(1)
+        warm_prompts = []
+        for i, n in enumerate(sorted(set(lens))):
+            prompt = [wrng.randrange(cfg.vocab_size)
+                      for _ in range(prefix_len + n)]
+            warm_prompts.append(prompt)
+            eng.submit(prompt, max_new=args.max_new, request_id=("w", i))
+            while eng.requests_active():
+                eng.step()
+        if eng.tiers is not None:
+            # compile the promote path too (gather, pack, and the
+            # per-page-count adopt executables): evict everything into
+            # the tiers, then re-admit the same prompts so the timed
+            # arms measure steady-state promotes, not jit traces
+            eng._evict(eng.ledger.pages)
+            for i, prompt in enumerate(warm_prompts):
+                eng.submit(prompt, max_new=2, request_id=("wp", i))
+                while eng.requests_active():
+                    eng.step()
+        eng.finished.clear()
+        return eng
+
+    def run_arm(eng):
+        base_hits = eng.page_stats()["prefix_hits"]
+        ttfts, promote_ttfts, cold_ttfts = [], [], []
+        total_tokens = 0
+        covered = 0            # requests whose prefix came from cache
+        t_run = time.perf_counter()
+        for i, prompt in enumerate(seq):
+            pre_promoted = getattr(eng, "tier_promoted_pages", 0)
+            pre_hits = eng.page_stats()["prefix_hits"]
+            t0 = time.perf_counter()
+            slot = eng.submit(list(prompt), max_new=args.max_new,
+                              request_id=("r", i))
+            while slot is None:          # pool momentarily full: the
+                eng.step()               # evict path frees cold pages
+                slot = eng.submit(list(prompt), max_new=args.max_new,
+                                  request_id=("r", i))
+            first = None
+            while eng.requests_active():
+                if eng.step() and first is None:
+                    first = (time.perf_counter() - t0) * 1e3
+            total_tokens += len(eng.finished.pop(("r", i), []))
+            ttfts.append(first)
+            promoted = (getattr(eng, "tier_promoted_pages", 0)
+                        > pre_promoted)
+            if promoted or eng.page_stats()["prefix_hits"] > pre_hits:
+                covered += 1
+            if promoted:
+                promote_ttfts.append(first)
+            else:
+                cold_ttfts.append(first)
+        wall = time.perf_counter() - t_run
+        return {
+            "requests": len(seq),
+            "duration_s": round(wall, 2),
+            "throughput_tokens_per_sec": round(total_tokens / wall, 1),
+            "prefix_hits": eng.page_stats()["prefix_hits"] - base_hits,
+            "prefix_hit_rate": round(
+                (eng.page_stats()["prefix_hits"] - base_hits)
+                / len(seq), 3),
+            # the fleet-economy number: fraction of requests whose
+            # shared prefix was served from ANY cache level (HBM radix
+            # hit or a promote out of the host/disk tiers) instead of
+            # recomputed
+            "effective_hit_rate": round(covered / len(seq), 3),
+            "ttft_ms": _percentiles(ttfts),
+            # promote-latency receipt: TTFT of requests whose prefix
+            # came back from the tiers vs ones that recomputed cold
+            "promote_ttft_ms": _percentiles(promote_ttfts),
+            "cold_ttft_ms": _percentiles(cold_ttfts),
+        }
+
+    common = {"metric": "kv_tier_capacity", "preset": args.preset,
+              "quant": quant_applied, "slots": args.slots,
+              "page_size": ps, "hbm_pages": pool,
+              "prefix_groups": groups, "shared_prefix": prefix_len,
+              "max_new": args.max_new, "seed": args.seed,
+              "backend": jax.devices()[0].platform}
+
+    # ---- arm A: single tier (evicted prefixes are simply gone)
+    eng_a = make_engine()
+    arm_a = run_arm(eng_a)
+    print(json.dumps({**common, "arm": "single_tier",
+                      "capacity_multiplier": 1.0,
+                      "tier_stats": None, **arm_a}), flush=True)
+
+    # ---- arm B: same pool + host/disk tiers (evictions demote,
+    # prefix hits promote asynchronously)
+    with tempfile.TemporaryDirectory() as tmp:
+        tiers = PageTierStore(host_pages=pool, disk_dir=tmp,
+                              disk_pages=pool)
+        eng_b = make_engine(tiers=tiers)
+        ts0 = tiers.stats()
+        promoted0 = eng_b.tier_promoted_pages
+        demoted0 = eng_b.tier_demoted_pages
+        arm_b = run_arm(eng_b)
+        ts = tiers.stats()
+        print(json.dumps({**common, "arm": "tiered",
+                          "capacity_multiplier": round(
+                              (pool + tiers.host_pages
+                               + tiers.disk_pages) / pool, 2),
+                          "tier_stats": {
+                              # occupancy is point-in-time; traffic
+                              # counters are deltas over the timed run
+                              # (the warmup's compile probes excluded)
+                              "host_pages": ts["host_pages"],
+                              "disk_pages": ts["disk_pages"],
+                              **{k: ts[k] - ts0[k] for k in
+                                 ("host_hits", "disk_hits", "misses",
+                                  "demoted_host", "demoted_disk",
+                                  "dropped", "corrupt_frames")},
+                              "promoted_pages":
+                                  eng_b.tier_promoted_pages - promoted0,
+                              "demoted_pages":
+                                  eng_b.tier_demoted_pages - demoted0,
+                              "tier_fallbacks": eng_b.tier_fallbacks},
+                          **arm_b}), flush=True)
+
+    # ---- arm C: cold-replica TTFT, adoption vs recompute, parity-gated
+    directory = PrefixDirectory(max_age_s=600.0)
+    warm = make_engine(directory=directory)
+    warm.replica_id = "warm"
+    for i, prefix in enumerate(prefixes[:3]):
+        # fleet-hot prefixes, comfortably resident in the warm pool
+        # (the third is the adopt engine's untimed compile probe)
+        warm.submit(list(prefix) + [rng.randrange(cfg.vocab_size)],
+                    max_new=2, request_id=("h", i))
+        while warm.requests_active():
+            warm.step()
+    warm.finished.clear()
+    probes = [list(prefixes[i]) + [rng.randrange(cfg.vocab_size)
+                                   for _ in range(lens[0])]
+              for i in range(2)]
+    adopt = make_engine(directory=directory,
+                        peer_fetch=lambda holder, p: warm.export_prefix(p))
+    adopt.replica_id = "cold-adopt"
+    # one untimed adoption first: the fleet-install executable for this
+    # page count compiles here, so the timed probes measure the fetch
+    # and install, not a jit trace (prefixes[2] never appears again)
+    adopt.submit(list(prefixes[2]) + [rng.randrange(cfg.vocab_size)],
+                 max_new=2, request_id=("wa", 0))
+    while adopt.requests_active():
+        adopt.step()
+    adopt.finished.clear()
+    hits0, pages0 = adopt.directory_hits, adopt.adopted_prefix_pages
+    exported0 = warm.exported_prefixes
+    recompute = make_engine()
+    parity_ok = True
+    arm_ttfts = {"adopt": [], "recompute": []}
+    tokens = {"adopt": [], "recompute": []}
+    for name, eng in (("adopt", adopt), ("recompute", recompute)):
+        for i, prompt in enumerate(probes):
+            t0 = time.perf_counter()
+            eng.submit(list(prompt), max_new=args.max_new,
+                       request_id=("p", i))
+            first = None
+            while eng.requests_active():
+                if eng.step() and first is None:
+                    first = (time.perf_counter() - t0) * 1e3
+            arm_ttfts[name].append(first)
+            tokens[name].append(eng.finished.pop(("p", i)))
+    for i, prompt in enumerate(probes):
+        ref = llama.generate_stepwise(
+            cfg, params, jnp.asarray([prompt], jnp.int32), args.max_new)
+        ref = [int(t) for t in ref[0]]
+        if tokens["adopt"][i] != ref or tokens["recompute"][i] != ref:
+            parity_ok = False
+    adopt_mean = sum(arm_ttfts["adopt"]) / len(arm_ttfts["adopt"])
+    rec_mean = sum(arm_ttfts["recompute"]) / len(arm_ttfts["recompute"])
+    print(json.dumps({
+        "metric": "kv_tier_adoption", "preset": args.preset,
+        "quant": quant_applied, "page_size": ps,
+        "shared_prefix": prefix_len, "probes": len(probes),
+        "max_new": args.max_new, "seed": args.seed,
+        "adopt_ttft_ms": _percentiles(arm_ttfts["adopt"]),
+        "recompute_ttft_ms": _percentiles(arm_ttfts["recompute"]),
+        "adopt_ttft_mean_ms": round(adopt_mean, 3),
+        "recompute_ttft_mean_ms": round(rec_mean, 3),
+        "adopt_speedup": round(rec_mean / adopt_mean, 3),
+        "adopted_prefix_pages": adopt.adopted_prefix_pages - pages0,
+        "directory_hits": adopt.directory_hits - hits0,
+        "exported_prefixes": warm.exported_prefixes - exported0,
+        "parity": {"ok": parity_ok},
         "backend": jax.devices()[0].platform,
     }), flush=True)
     return 0

@@ -1,0 +1,184 @@
+"""Time-capped hierarchical-KV smoke for CI: demote cold radix pages
+into the host/disk tiers under pressure, promote them back on a prefix
+hit, and adopt a fleet-hot prefix across two in-process replicas over
+the real ``/v1/prefix`` HTTP transport — failing the build on the
+first token that diverges from the uninterrupted greedy reference.
+
+The full capacity-multiplier and adoption-TTFT receipts live in
+``tools/bench_serving.py --kv-tiers``; this is the always-on slice
+test.sh runs next to the other smokes. Checks run in a fixed order and
+stop (skip, not fail) when the time budget runs out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+import urllib.request
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget-s", type=float, default=90.0,
+                    help="wall-clock cap; tail checks are skipped, not "
+                         "failed, when it runs out (default 90)")
+    args = ap.parse_args(argv)
+    deadline = time.monotonic() + args.budget_s
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama, serving
+    from dcos_commons_tpu.models.disagg import fetch_prefix
+    from dcos_commons_tpu.models.ingress import ServingFrontend
+    from dcos_commons_tpu.models.paging import (PageTierStore,
+                                                PrefixDirectory,
+                                                chain_keys)
+
+    cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                 attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.key(0))
+
+    def solo(prompt, steps):
+        toks = llama.generate_stepwise(
+            cfg, params, jnp.asarray([prompt], jnp.int32), steps)
+        return [int(t) for t in toks[0]]
+
+    def rand_prompt(seed, n):
+        return [int(t) for t in jax.random.randint(
+            jax.random.key(seed), (n,), 0, cfg.vocab_size)]
+
+    ran = 0
+
+    def _spent(name: str) -> bool:
+        if time.monotonic() >= deadline:
+            print(f"kvtier-smoke: time budget exhausted after {ran} "
+                  f"checks; {name!r} and later checks skipped")
+            return True
+        return False
+
+    # 1. demote under pressure, promote on hit: the whole pool evicts
+    # through the single demote path into host+disk tiers, then a
+    # re-drain of the same prompt promotes instead of recomputing —
+    # token-exact, ledger clean, tiers emptied back into the radix
+    if _spent("demote-promote"):
+        return 0
+    with tempfile.TemporaryDirectory() as tmp:
+        tiers = PageTierStore(host_pages=2, disk_dir=tmp, disk_pages=8)
+        eng = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                                  prefill_chunk=8, tiers=tiers)
+        prompt = rand_prompt(11, 24)
+        want = solo(prompt, 6)
+        got = eng.drain([{"prompt": prompt, "max_new": 6,
+                          "request_id": "warm"}])
+        if got["warm"] != want:
+            print("kvtier-smoke FAILED: warm drain diverged",
+                  file=sys.stderr)
+            return 1
+        eng._evict(eng.ledger.pages)       # the pressure, distilled
+        if eng.tier_demoted_pages < 3 or tiers.stats()["disk_pages"] < 1:
+            print(f"kvtier-smoke FAILED: eviction did not demote "
+                  f"(demoted {eng.tier_demoted_pages}, "
+                  f"tiers {tiers.stats()})", file=sys.stderr)
+            return 1
+        got = eng.drain([{"prompt": prompt, "max_new": 6,
+                          "request_id": "hit"}])
+        if got["hit"] != want:
+            print("kvtier-smoke FAILED: post-promote drain diverged",
+                  file=sys.stderr)
+            return 1
+        if eng.tier_promoted_pages < 2:
+            print(f"kvtier-smoke FAILED: prefix hit recomputed instead "
+                  f"of promoting ({eng.tier_promoted_pages} pages)",
+                  file=sys.stderr)
+            return 1
+        if eng.ledger.check(eng.radix.held()):
+            print("kvtier-smoke FAILED: ledger violations after "
+                  "promote", file=sys.stderr)
+            return 1
+    ran += 1
+
+    # 2. fleet adoption across two in-process replicas over real HTTP:
+    # replica A serves its cached prefix on /v1/prefix (engine-thread
+    # export), B's directory hit adopts it via disagg.fetch_prefix
+    # instead of recomputing — token-exact, claims published both sides
+    if _spent("fleet-adopt"):
+        return 0
+    directory = PrefixDirectory(max_age_s=60.0)
+    a = serving.PagedServer(cfg, params, slots=2, page_size=8,
+                            prefill_chunk=8, directory=directory)
+    fe = ServingFrontend(a, port=0, host="127.0.0.1")
+    url = f"http://127.0.0.1:{fe.port}"
+    a.replica_id = url
+    fe.start()
+    try:
+        base = rand_prompt(12, 24)
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=json.dumps({"prompt": base, "max_new": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            r.read()
+        if directory.lookup(chain_keys(base, 8)[-1]) != url:
+            print("kvtier-smoke FAILED: warm replica never published "
+                  "its prefix claim", file=sys.stderr)
+            return 1
+        b = serving.PagedServer(
+            cfg, params, slots=2, page_size=8, prefill_chunk=8,
+            directory=directory, replica_id="rep-b",
+            peer_fetch=lambda holder, p: fetch_prefix(holder, p,
+                                                      timeout_s=30.0))
+        prompt = base + rand_prompt(13, 4)
+        want = solo(prompt, 6)
+        got = b.drain([{"prompt": prompt, "max_new": 6,
+                        "request_id": "adopt"}])
+        if got["adopt"] != want:
+            print("kvtier-smoke FAILED: adopted stream diverged from "
+                  "reference", file=sys.stderr)
+            return 1
+        if b.directory_hits != 1 or b.adopted_prefix_pages < 3:
+            print(f"kvtier-smoke FAILED: adoption did not happen "
+                  f"(hits {b.directory_hits}, pages "
+                  f"{b.adopted_prefix_pages})", file=sys.stderr)
+            return 1
+        if b.ledger.check(b.radix.held()):
+            print("kvtier-smoke FAILED: ledger violations after "
+                  "adoption", file=sys.stderr)
+            return 1
+    finally:
+        fe.stop()
+    ran += 1
+
+    # 3. staleness discipline: a directory hint whose holder serves
+    # nothing falls back to recompute — token-exact, never an error
+    if _spent("stale-fallback"):
+        return 0
+    directory = PrefixDirectory(max_age_s=60.0)
+    base = rand_prompt(14, 16)
+    directory.publish("http://127.0.0.1:9", chain_keys(base, 8))
+    c = serving.PagedServer(
+        cfg, params, slots=2, page_size=8, prefill_chunk=8,
+        directory=directory, replica_id="rep-c",
+        peer_fetch=lambda holder, p: fetch_prefix(holder, p,
+                                                  timeout_s=2.0))
+    prompt = base + rand_prompt(15, 5)
+    if (c.drain([{"prompt": prompt, "max_new": 5,
+                  "request_id": "ghost"}])["ghost"] != solo(prompt, 5)
+            or c.directory_fallbacks != 1):
+        print("kvtier-smoke FAILED: stale hint did not fall back to a "
+              "clean recompute", file=sys.stderr)
+        return 1
+    ran += 1
+
+    print(f"kvtier-smoke: {ran} checks passed — cold pages round-trip "
+          f"the host/disk tiers token-exact, fleet prefixes adopt over "
+          f"/v1/prefix instead of recomputing, stale hints recompute "
+          f"cleanly")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
