@@ -169,6 +169,83 @@ def _trace_adopt_pages():
     return jax.make_jaxpr(install)(pool, payload, payload, phys)
 
 
+def _trace_spec_decode_paged():
+    from ..models import llama
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    cfg_d = llama.LlamaConfig.tiny(n_layers=1)
+    slots, page_size, k = 4, 16, 4
+    per_stream = cfg.max_seq // page_size
+    params = _abstract_params(
+        lambda: llama.init_params(cfg, jax.random.key(0)))
+    params_d = _abstract_params(
+        lambda: llama.init_params(cfg_d, jax.random.key(0)))
+    pool = _abstract_params(
+        lambda: llama.init_page_pool(cfg, slots * per_stream + 1,
+                                     page_size))
+    cache_d = _abstract_params(
+        lambda: llama.init_kv_cache(cfg_d, slots, cfg_d.max_seq))
+    table = jax.ShapeDtypeStruct((slots, per_stream), jnp.int32)
+    lengths = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    mask = jax.ShapeDtypeStruct((slots,), jnp.bool_)
+
+    # the serving window program (serving.py _build_spec_x), verbatim:
+    # k-step draft scan on the slot cache -> K-wide paged verify -> on-
+    # device greedy acceptance
+    def window(p, pd, pl, cd, tbl, ln, tok, mk):
+        def dstep(carry, j):
+            cd, cur = carry
+            lg, cd = llama.decode_step_slots(cfg_d, pd, cd, ln + j, cur)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return (cd, jnp.where(mk, nxt, cur)), nxt
+
+        (cd, _), dtoks = jax.lax.scan(dstep, (cd, tok), jnp.arange(k))
+        window_toks = jnp.concatenate([tok[:, None], dtoks[:k - 1].T],
+                                      axis=1)
+        logits, pl = llama.verify_step_paged(cfg, p, pl, tbl, ln,
+                                             window_toks)
+        tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        agree = jnp.cumprod(
+            (dtoks[:k - 1].T == tgt[:, :k - 1]).astype(jnp.int32), axis=1)
+        n_emit = jnp.where(mk, jnp.sum(agree, axis=1) + 1, 0)
+        return pl, cd, tgt, n_emit, ln + n_emit
+
+    return jax.make_jaxpr(window)(params, params_d, pool, cache_d, table,
+                                  lengths, tokens, mask)
+
+
+def _trace_distill_step():
+    import dataclasses
+
+    from ..models import llama
+    from ..ops.losses import fused_linear_distillation
+    cfg_t = _train_cfg(True)
+    cfg_d = dataclasses.replace(cfg_t, n_layers=1)
+    params_t = _abstract_params(
+        lambda: llama.init_params(cfg_t, jax.random.key(0)))
+    params_d = _abstract_params(
+        lambda: llama.init_params(cfg_d, jax.random.key(0)))
+    toks = jax.ShapeDtypeStruct((_TRAIN_B, _TRAIN_S), jnp.int32)
+
+    def grads(p_d, p_t, t):
+        x_t = jax.lax.stop_gradient(
+            llama.forward(cfg_t, p_t, t, return_hidden=True))
+
+        def loss(p):
+            x_s = llama.forward(cfg_d, p, t, return_hidden=True)
+            # block << S, like the CE trace's fused_ce_block: at the
+            # default block (512 >= this S) one tile IS the full logits
+            # and the budget could not separate streaming from
+            # materialization
+            return fused_linear_distillation(x_s, p["lm_head"], x_t,
+                                             p_t["lm_head"],
+                                             block_size=16)
+
+        return jax.value_and_grad(loss)(p_d)
+
+    return jax.make_jaxpr(grads)(params_d, params_t, toks)
+
+
 def _trace_ring_attention():
     from ..parallel.mesh import MeshSpec
     from ..parallel.ring_attention import make_ring_attention
@@ -229,6 +306,29 @@ register_hot_path(HotPath(
                 "decode tier (donated pool, no gather/collective — the "
                 "whole point of page-granular shipping is that adoption "
                 "is a pure scatter)"))
+register_hot_path(HotPath(
+    "llama_spec_decode_paged", _trace_spec_decode_paged,
+    budget_bytes=1 << 20,
+    description="the speculative-decode window: k-step draft scan on a "
+                "slot cache feeding one K-wide verify_step_paged pass + "
+                "on-device greedy acceptance (must stay collective-free "
+                "off-mesh like every serving kernel; the [B, K, V] "
+                "verify logits at serving vocab are the one legitimate "
+                "fp32 aval and stay far under the slot-path budget)"))
+# Distill budget: the fused linear-KL head streams BOTH heads' logits in
+# vocab blocks, so neither the teacher's nor the student's [B, S, V] fp32
+# logits may ever materialize — the ceiling sits just below one full
+# logits tensor (B x S x V x 4; distillation masks all S positions,
+# unlike the shifted CE loss), while the largest legitimate fp32 aval
+# (the lm_head gradient, V x D x 4) is half that.
+_DISTILL_LOGITS = _TRAIN_B * _TRAIN_S * _TRAIN_VOCAB * 4
+register_hot_path(HotPath(
+    "llama_distill_step_fused", _trace_distill_step,
+    budget_bytes=_DISTILL_LOGITS - 1,
+    description="value_and_grad of the draft-distillation loss: frozen "
+                "teacher forward (stop_gradient) + student forward + "
+                "fused linear-KL head (teacher logits never materialize "
+                "at [B, S, V] fp32)"))
 register_hot_path(HotPath(
     "ring_attention_fwd", _trace_ring_attention,
     budget_bytes=1 << 20, devices_needed=2,

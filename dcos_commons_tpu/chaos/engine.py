@@ -129,6 +129,18 @@ class FaultConfig:
     # legacy pinned seeds replay unperturbed.
     kv_tier_corrupt: float = 0.0
     promote_during_evict: float = 0.0
+    # speculative-decode faults (soak harness page-ledger sim,
+    # models/serving.py arm_draft seam): the armed draft's checkpoint
+    # goes stale under the replica — retrain/overwrite breaks the
+    # save_draft manifest seal and the next arm/verify must degrade the
+    # stream to SOLO decode, never drop or corrupt it (draft_stale); a
+    # draft turns out byte-corrupt mid-service — proposals go to junk
+    # and the window must keep emitting the target's exact tokens at
+    # accept-rate ~0 (draft_corrupt). Both draw from a derived RNG
+    # private to the spec sim, so the legacy pinned seeds replay
+    # unperturbed.
+    draft_stale: float = 0.0
+    draft_corrupt: float = 0.0
     max_delay_ticks: int = 3
 
     FIELDS = ("status_drop", "status_delay", "status_dup", "status_reorder",
@@ -139,7 +151,7 @@ class FaultConfig:
               "router_replica_down", "tenant_flood",
               "warm_promote_crash", "weight_fetch_lost",
               "migrate_mid_stream", "kv_tier_corrupt",
-              "promote_during_evict")
+              "promote_during_evict", "draft_stale", "draft_corrupt")
 
     @classmethod
     def none(cls) -> "FaultConfig":
@@ -173,7 +185,8 @@ class FaultConfig:
                        router_replica_down=0.0, tenant_flood=0.0,
                        warm_promote_crash=0.0, weight_fetch_lost=0.0,
                        migrate_mid_stream=0.0, kv_tier_corrupt=0.0,
-                       promote_during_evict=0.0)
+                       promote_during_evict=0.0, draft_stale=0.0,
+                       draft_corrupt=0.0)
 
 
 def parse_faults(arg: str) -> FaultConfig:

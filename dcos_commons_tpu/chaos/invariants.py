@@ -40,6 +40,15 @@ to a real reference-era incident class:
     check at promote time, safely dropped before any promote touched it
     (overwritten / discarded / capacity-evicted), or still resident.
     Any other outcome means bad bytes were installed into a live pool.
+18. **spec-decode exactness** — speculative decoding is an accelerator,
+    never an author: every token a draft-armed stream emits must equal
+    the solo greedy stream (the verify pass consults only the target
+    pool, so a stale or corrupt draft may cost acceptance, never
+    correctness), and a draft failure (``draft_stale``,
+    ``draft_corrupt``) degrades the stream to solo decode — it never
+    drops it. Every stale hit must be matched by exactly one solo
+    fallback, or the degrade path either missed a failure or fired
+    spuriously.
 """
 
 from __future__ import annotations
@@ -79,6 +88,7 @@ class InvariantChecker:
         out += self._check_page_ledger(tick)
         out += self._check_kv_ship(tick)
         out += self._check_kv_tier(tick)
+        out += self._check_spec_decode(tick)
         return out
 
     def _check_unique_live_tasks(self, tick: int) -> List[Violation]:
@@ -224,6 +234,39 @@ class InvariantChecker:
                     f"detected + {lost} safely dropped + {in_tier} still "
                     "resident — a corrupt frame was installed or "
                     "double-counted", tick))
+        return out
+
+    def _check_spec_decode(self, tick: int) -> List[Violation]:
+        """Audit draft-armed decode (``models/serving.py`` spec seam):
+        the emitted stream is token-exact with solo greedy decode no
+        matter what the draft proposed, draft failures degrade streams
+        to solo instead of dropping them, and every injected stale hit
+        maps to exactly one solo fallback."""
+        out = []
+        for sim in getattr(self._runner, "page_sims", ()):
+            if not getattr(sim, "spec_windows", 0) and \
+                    not getattr(sim, "spec_solo_fallbacks", 0):
+                continue
+            if sim.spec_mismatches:
+                out.append(Violation(
+                    "spec-token-exact",
+                    f"{sim.spec_mismatches} of {sim.spec_checked} "
+                    "spec-emitted tokens diverged from the solo greedy "
+                    "reference (the verify pass let a draft proposal "
+                    "author output)", tick))
+            if sim.spec_dropped:
+                out.append(Violation(
+                    "spec-degrade-not-drop",
+                    f"{sim.spec_dropped} streams vanished during a spec "
+                    "window — draft failure must degrade to solo decode, "
+                    "never drop the stream", tick))
+            if sim.spec_solo_fallbacks != sim.spec_stale_injected:
+                out.append(Violation(
+                    "spec-fallback-accounting",
+                    f"{sim.spec_stale_injected} stale drafts injected != "
+                    f"{sim.spec_solo_fallbacks} solo fallbacks taken — "
+                    "the degrade path missed a failure or fired "
+                    "spuriously", tick))
         return out
 
     def _check_backoff_monotone(self, tick: int) -> List[Violation]:

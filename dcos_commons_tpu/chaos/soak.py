@@ -137,6 +137,27 @@ class _PageServingSim:
         # (the bad bytes never installed), audited by the invariant
         self.tier_corrupt_lost = 0
         self.tier_fallbacks = 0
+        # speculative-decode weather (models/serving.py arm_draft /
+        # _spec_step_many seam) on its OWN derived rng: every emitted
+        # token is recomputed through the engine's accept-or-correct
+        # discipline and audited against the stream's target reference
+        # sequence (invariant 18) — a stale draft artifact disarms to
+        # SOLO at the next window, a corrupt draft stays armed at
+        # accept ~0, and neither may ever drop a stream or emit a
+        # non-target token. No-draw when disarmed, so legacy pinned
+        # seeds replay bitwise.
+        self.spec_rng = random.Random((seed << 28) ^ 0xD1B54A32D192ED03)
+        self.spec_active = False
+        self.spec_state = "armed"
+        self.spec_rearm_at = 0
+        self.spec_pos: Dict[int, int] = {}    # sid -> tokens emitted
+        self.spec_windows = 0
+        self.spec_checked = 0
+        self.spec_mismatches = 0
+        self.spec_dropped = 0
+        self.spec_stale_injected = 0
+        self.spec_corrupt_injected = 0
+        self.spec_solo_fallbacks = 0
 
     def expected_refs(self) -> Dict[int, int]:
         out: Dict[int, int] = {}
@@ -374,6 +395,90 @@ class _PageServingSim:
             if hits:
                 self.tier_pending.append((tick + 1, rng.choice(hits)))
 
+    def _spec_ref(self, sid: int, i: int) -> int:
+        """Position ``i`` of stream ``sid``'s target greedy sequence —
+        the solo-decode reference every spec window must reproduce."""
+        return (sid * 1315423911 + i * 2654435761) % 97
+
+    def spec_tick(self, tick: int, stale_p: float, corrupt_p: float,
+                  count, log) -> None:
+        """Speculative-decode weather over the live streams
+        (``models/serving.py`` arm_draft / _spec_step_many seam). The
+        sim mirrors the engine's DISCIPLINE, not its arrays: each
+        window re-derives its emitted tokens through
+        accept-while-the-target-agrees plus the target's correction
+        token, so the emitted stream is compared against the pure
+        target reference (invariant 18's token-exact audit — a
+        regression that emits an unverified proposal or drops the
+        correction trips it immediately). ``draft_stale`` breaks the
+        save_draft manifest seal under the engine: the next window's
+        arm check degrades to SOLO (counted as a fallback, never a
+        drop) until a fresh artifact re-arms it. ``draft_corrupt``
+        junks the proposals of an armed draft: windows stay armed at
+        accept ~0 and still emit exactly the target stream. No-draw
+        when disarmed, so legacy pinned corpus seeds replay bitwise."""
+        armed = bool(stale_p or corrupt_p)
+        self.spec_active = self.spec_active or armed
+        if not self.spec_active:
+            return
+        rng = self.spec_rng
+        k = 4
+        if self.spec_state == "solo" and self.spec_rearm_at <= tick:
+            # a retrained artifact landed: the seal verifies again
+            self.spec_state = "armed"
+            log(f"tick {tick}: spec re-armed (fresh draft artifact)")
+        if stale_p and self.spec_state == "armed" \
+                and rng.random() < stale_p:
+            self.spec_state = "solo"
+            self.spec_stale_injected += 1
+            self.spec_solo_fallbacks += 1
+            self.spec_rearm_at = tick + rng.randint(2, 4)
+            count("draft_stale")
+            log(f"tick {tick}: draft_stale — manifest seal broken, "
+                f"solo fallback (re-arm @{self.spec_rearm_at})")
+        corrupt = False
+        if corrupt_p and self.spec_state == "armed" \
+                and rng.random() < corrupt_p:
+            corrupt = True
+            self.spec_corrupt_injected += 1
+            count("draft_corrupt")
+            log(f"tick {tick}: draft_corrupt — junk proposals this "
+                "window, verify must hold the line")
+        for sid in sorted(self.streams):
+            pos = self.spec_pos.get(sid, 0)
+            if self.spec_state == "armed":
+                self.spec_windows += 1
+                proposals = []
+                for j in range(k - 1):
+                    t = self._spec_ref(sid, pos + j)
+                    if not corrupt and rng.random() < 0.7:
+                        proposals.append(t)       # trained draft agrees
+                    else:
+                        proposals.append((t + 1) % 97)   # junk
+                # the engine's acceptance: keep proposals while the
+                # target agrees, then the target's own correction
+                emitted = []
+                for j, prop in enumerate(proposals):
+                    if prop != self._spec_ref(sid, pos + j):
+                        break
+                    emitted.append(prop)
+                emitted.append(self._spec_ref(sid, pos + len(emitted)))
+            else:
+                emitted = [self._spec_ref(sid, pos)]  # solo decode
+            self.spec_checked += 1
+            expect = [self._spec_ref(sid, pos + j)
+                      for j in range(len(emitted))]
+            if emitted != expect:
+                self.spec_mismatches += 1
+                log(f"tick {tick}: SPEC MISMATCH stream {sid} at "
+                    f"{pos}: {emitted} != {expect}")
+            if sid not in self.streams:
+                self.spec_dropped += 1
+            self.spec_pos[sid] = pos + len(emitted)
+        # positions of retired/aborted streams fall away with them
+        self.spec_pos = {s: p for s, p in self.spec_pos.items()
+                         if s in self.streams}
+
 
 @dataclass
 class SoakReport:
@@ -566,6 +671,9 @@ class _Soak:
             self.page_sim.tier_tick(tick, self.config.kv_tier_corrupt,
                                     self.config.promote_during_evict,
                                     self._count, self._log)
+            self.page_sim.spec_tick(tick, self.config.draft_stale,
+                                    self.config.draft_corrupt,
+                                    self._count, self._log)
             # release the transport's due events first so zombies from
             # late launches are visible to this tick's reconciliation
             self.chaos.tick()
@@ -583,6 +691,7 @@ class _Soak:
             self.page_sim.tick(tick, 0.0, self._count, self._log)
             self.page_sim.ship_tick(tick, 0.0, 0.0, self._count, self._log)
             self.page_sim.tier_tick(tick, 0.0, 0.0, self._count, self._log)
+            self.page_sim.spec_tick(tick, 0.0, 0.0, self._count, self._log)
             self.chaos.tick()
             self._cycle()
             self._check(tick)

@@ -194,7 +194,9 @@ class ServingFrontend:
                     "pages_total", "kv_tier_host_pages",
                     "kv_tier_host_capacity", "kv_tier_disk_pages",
                     "kv_tier_disk_capacity", "kv_tier_hits",
-                    "kv_tier_promoted", "kv_tier_demoted"):
+                    "kv_tier_promoted", "kv_tier_demoted",
+                    "spec_windows", "spec_proposed", "spec_accepted",
+                    "spec_accept_rate", "spec_fallbacks"):
             self.metrics.gauge(f"ingress.{key}",
                                lambda k=key: self.load_gauges().get(k))
         frontend = self
@@ -697,6 +699,20 @@ class ServingFrontend:
                                               "tier_promoted_pages", 0)
             out["kv_tier_demoted"] = getattr(self.engine,
                                              "tier_demoted_pages", 0)
+        if getattr(self.engine, "spec_windows", 0) or \
+                getattr(self.engine, "draft_k", 0):
+            # speculative decode armed (or armed once and disarmed): the
+            # accept rate is the engine's speed multiplier — tokens per
+            # target pass is 1 + accept_rate * (k - 1) — so the
+            # autoscaler/router must see it next to the queue gauges
+            proposed = getattr(self.engine, "spec_proposed", 0)
+            out["spec_windows"] = self.engine.spec_windows
+            out["spec_proposed"] = proposed
+            out["spec_accepted"] = getattr(self.engine, "spec_accepted", 0)
+            out["spec_accept_rate"] = (
+                out["spec_accepted"] / proposed if proposed else 0.0)
+            out["spec_fallbacks"] = getattr(self.engine,
+                                            "spec_fallbacks", 0)
         return out
 
     def stats(self) -> dict:

@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dcos_commons_tpu.ops import (apply_rope, apply_rope_at,
+                                  apply_rope_at_many,
                                   apply_rope_positions,
                                   fused_linear_cross_entropy,
                                   gqa_attention, repeat_kv,
@@ -975,6 +976,72 @@ def decode_step_paged(cfg: LlamaConfig, params: Params, pool: Params,
         rope_fn=lambda t: apply_rope_at(t, rope, lengths),
         cache_write=cache_write, kv_len=lengths + 1, mesh=mesh,
         attn_override=attn_override)
+
+
+def verify_step_paged(cfg: LlamaConfig, params: Params, pool: Params,
+                      table: jnp.ndarray, lengths: jnp.ndarray,
+                      tokens: jnp.ndarray, mesh: Optional[Mesh] = None,
+                      rope: Optional[jnp.ndarray] = None
+                      ) -> Tuple[jnp.ndarray, Params]:
+    """Consume a K-token window PER STREAM against the paged pool — the
+    speculative-verify counterpart of :func:`extend_step`, batched over
+    streams at independent positions.
+
+    ``tokens`` [B, K] occupy positions ``lengths[b]..lengths[b]+K-1``
+    of each stream; returns (logits [B, K, V] at every window position,
+    pool). Row (b, j)'s K/V scatters through ``table`` [B, MP] exactly
+    like :func:`decode_step_paged`'s single row would at that position,
+    so a fully-accepted window leaves the pool bitwise as K successive
+    solo steps would have — acceptance never forks the cache contents.
+    Attention is causal WITHIN the window with per-stream offsets
+    (query j of stream b sees positions <= lengths[b]+j), which is why
+    greedy argmax over these logits reproduces solo decode's stream
+    token-exactly (modulo the K-wide-vs-1-wide bf16 reduction caveat
+    ``models/speculative.py`` documents).
+
+    Rejection rollback is free by the same masked-cache argument as the
+    monolithic verify: rejected rows sit beyond the live length the
+    host keeps, are never attended (every future read masks at the
+    ADVANCED length), and are overwritten in place when decode reaches
+    them. Writes land only in pages the stream's table row maps — the
+    full-span allocation at admission — with overflow past the
+    allocated span clipping onto the engine's scratch page rows exactly
+    like a frozen stream's writes; tokens the host can still commit
+    (within the stream's max_new budget) attend only in-span positions,
+    so the shared-scratch collisions stay confined to discarded tail
+    tokens. The page ledger never hears about any of this: no page is
+    allocated or released by a verify window, which is what keeps
+    check()/reconcile() trivially clean under speculative serving.
+    """
+    if rope is None:
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    b, kk = tokens.shape
+    kq = pool["k"].q if isinstance(pool["k"], QTensor) else pool["k"]
+    ps = kq.shape[2]
+    mp = table.shape[1]
+    positions = lengths[:, None] + jnp.arange(kk, dtype=jnp.int32)[None]
+    page_idx = jnp.clip(positions // ps, 0, mp - 1)
+    phys = jnp.take_along_axis(table, page_idx, axis=1)      # [B, K]
+    offs = positions % ps
+    rope_pos = jnp.clip(positions, 0, rope.shape[1] - 1)
+
+    def cache_write(c, new):
+        # new [B, K, KV, D] -> flat scatter of every (stream, window) row
+        flat = new.reshape((b * kk,) + new.shape[2:])
+        return _page_write(c, flat, phys.reshape(-1),
+                           offs.reshape(-1)), None
+
+    def attn_override(q, k_cache, v_cache):
+        k_read = _gather_pages(k_cache, table, cfg.dtype)
+        v_read = _gather_pages(v_cache, table, cfg.dtype)
+        return gqa_attention(q, k_read, v_read, causal=True,
+                             q_offset=lengths, kv_len=lengths + kk)
+
+    return _decode_body(
+        cfg, params, pool, tokens, False,
+        rope_fn=lambda t: apply_rope_at_many(t, rope, rope_pos),
+        cache_write=cache_write, kv_len=lengths + kk, causal=True,
+        mesh=mesh, attn_override=attn_override, all_positions=True)
 
 
 def prefill_chunk_paged(cfg: LlamaConfig, params: Params, pool: Params,

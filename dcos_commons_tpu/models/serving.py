@@ -736,10 +736,194 @@ class PagedServer:
         self.directory_fallbacks = 0   # stale hints -> recompute
         self.adopted_prefix_pages = 0  # pages installed from siblings
         self.exported_prefixes = 0     # prefix spans served to siblings
+        # ---------------------------------------------- speculative decode
+        # armed via arm_draft(): the decode dispatch becomes ONE fused
+        # draft-scan + paged-verify window per step_many call. The draft
+        # keeps its KV in a private SLOT cache (it is orders cheaper than
+        # the target, so monolithic rows cost nothing that matters) and
+        # its executables stay engine-private — draft identity is not in
+        # the AOT engine key, so they must never enter the shared
+        # namespace. Disarmed (the default), nothing below is touched
+        # and every path is bitwise the solo engine.
+        self._draft: Optional[Tuple[llama.LlamaConfig, Any]] = None
+        self._draft_cache = None
+        self._draft_rope = None
+        self.draft_k = 0
+        self.metrics = None            # optional shared MetricsRegistry
+        self._spec_x = None            # the fused window executable
+        self._draft_prefill_x: Dict[int, Any] = {}   # padded len -> exe
+        self.spec_windows = 0          # fused draft+verify dispatches
+        self.spec_proposed = 0         # draft tokens offered to verify
+        self.spec_accepted = 0         # draft tokens the target kept
+        self.spec_fallbacks = 0        # windows degraded to solo decode
+        self.spec_draft_prefill_s = 0.0
+        self.spec_window_s = 0.0
 
     # the engine-thread-only helpers are identical to the slot engine's
     _select = SlotServer._select
     drain = SlotServer.drain
+
+    # ----------------------------------------------- speculative decoding
+
+    def arm_draft(self, cfg_d: llama.LlamaConfig, params_d, k: int = 4,
+                  metrics=None, warmup: bool = True) -> None:
+        """Arm the speculative decode path: ``step_many`` windows run
+        draft-propose + paged-verify fused in one dispatch, advancing
+        every stream by ``1 + accepted`` tokens per target weight pass.
+
+        Compatibility is checked HERE, before any live stream exists
+        (:class:`~dcos_commons_tpu.models.speculative.DraftIncompatible`
+        with a stable ``code`` on mismatch — the serving path catches it
+        and keeps decoding solo), and ``warmup`` traces + compiles the
+        fused window against scratch state so a draft the compiler
+        rejects also fails at arm time, not mid-stream. Greedy engines
+        only: acceptance is an argmax compare, so a sampled engine must
+        keep its host-loop semantics."""
+        from dcos_commons_tpu.models.speculative import DraftIncompatible
+        if self.sampler is not None:
+            raise DraftIncompatible(
+                "draft_sampled_engine",
+                "speculative decode is greedy-only; this engine samples")
+        if k < 2:
+            raise DraftIncompatible("draft_k", f"draft k must be >= 2, "
+                                               f"got {k}")
+        if cfg_d.vocab_size != self.cfg.vocab_size:
+            raise DraftIncompatible(
+                "draft_vocab_mismatch",
+                f"draft vocab {cfg_d.vocab_size} != target "
+                f"{self.cfg.vocab_size}")
+        if cfg_d.rope_theta != self.cfg.rope_theta:
+            raise DraftIncompatible(
+                "draft_rope_mismatch",
+                f"draft rope_theta {cfg_d.rope_theta} != target "
+                f"{self.cfg.rope_theta}")
+        if cfg_d.max_seq < self.cfg.max_seq:
+            raise DraftIncompatible(
+                "draft_max_seq",
+                f"draft max_seq {cfg_d.max_seq} < target "
+                f"{self.cfg.max_seq}: the draft cannot cover every "
+                "position this engine serves")
+        # the draft cache stays bf16 whatever the target pool does —
+        # int8 KV pays off on the model that dominates HBM, not here.
+        # Execution policy follows the engine: a sealed draft artifact
+        # records architecture only, so a loaded cfg carries DEFAULTS
+        # for the rest — attn_impl "auto" (would resolve its own
+        # attention path independently of the engine's) and remat True
+        # (per-layer jax.checkpoint: pure recompute overhead in a path
+        # that never backprops)
+        cfg_d = dataclasses.replace(cfg_d, kv_quant=False,
+                                    attn_impl=self.cfg.attn_impl,
+                                    remat=False, remat_policy=None)
+        self._draft = (cfg_d, params_d)
+        self.draft_k = int(k)
+        self.metrics = metrics
+        self._draft_rope = rope_frequencies(cfg_d.head_dim, cfg_d.max_seq,
+                                            cfg_d.rope_theta)
+        self._draft_cache = llama.init_kv_cache(cfg_d, self.slots,
+                                                cfg_d.max_seq)
+        self._spec_x = self._build_spec_x()
+        self._draft_prefill_x.clear()
+        if warmup:
+            # full-width table: the live path truncates columns per
+            # window (_window_mp), but compiling the widest shape here
+            # surfaces any compiler rejection of THIS draft at arm time
+            mask = jnp.zeros((self.slots,), bool)
+            tbl = jnp.full((self.slots, self.pages_per_stream),
+                           self.scratch, jnp.int32)
+            ones = jnp.ones((self.slots,), jnp.int32)
+            zeros = jnp.zeros((self.slots,), jnp.int32)
+            out = self._spec_x(self.params, params_d, self.pool,
+                               self._draft_cache, tbl, ones, zeros, mask)
+            (self.pool, self._draft_cache, tgt, n_emit) = out[:4]
+            jax.block_until_ready(tgt)
+
+    def disarm_draft(self) -> None:
+        """Back to solo decode; the draft cache is dropped. Counters
+        survive — a fallback must stay visible after it happens."""
+        self._draft = None
+        self._draft_cache = None
+        self._spec_x = None
+        self._draft_prefill_x.clear()
+        self.draft_k = 0
+
+    def _build_spec_x(self):
+        """ONE jitted program per armed draft: k-step draft scan (slot
+        cache, greedy) -> K-wide paged verify -> on-device acceptance.
+        Pool and draft cache are donated — together they dominate HBM
+        and both return same-shaped."""
+        cfg, mesh, rope = self.cfg, self.mesh, self._rope
+        cfg_d, _ = self._draft
+        rope_d = self._draft_rope
+        k = self.draft_k
+
+        def window(p, pd, pool, cache_d, tbl, ln, tok, mask):
+            def dstep(carry, j):
+                cache_d, cur = carry
+                lg, cache_d = llama.decode_step_slots(
+                    cfg_d, pd, cache_d, ln + j, cur, rope=rope_d)
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                nxt = jnp.where(mask, nxt, cur)
+                return (cache_d, nxt), nxt
+
+            # k draft steps consuming [cur, d_1..d_{k-1}]: the k-th
+            # proposal is discarded but its step writes d_{k-1}'s K/V,
+            # so a fully-accepted window leaves no draft-cache hole
+            # (models/speculative.py's window discipline, verbatim)
+            (cache_d, _), dtoks = lax.scan(dstep, (cache_d, tok),
+                                           jnp.arange(k))
+            window_toks = jnp.concatenate(
+                [tok[:, None], dtoks[:k - 1].T], axis=1)     # [B, k]
+            logits, pool = llama.verify_step_paged(
+                cfg, p, pool, tbl, ln, window_toks, mesh=mesh, rope=rope)
+            tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k]
+            agree = jnp.cumprod(
+                (dtoks[:k - 1].T == tgt[:, :k - 1]).astype(jnp.int32),
+                axis=1)
+            n_emit = jnp.where(mask, jnp.sum(agree, axis=1) + 1, 0)
+            new_ln = ln + n_emit
+            new_cur = jnp.take_along_axis(
+                tgt, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+            new_cur = jnp.where(mask, new_cur, tok)
+            return pool, cache_d, tgt, n_emit, new_ln, new_cur
+
+        return jax.jit(window, donate_argnums=(2, 3))
+
+    def _draft_prefill(self, slot: int, prompt: List[int]) -> None:
+        """Write the draft's K/V for a freshly-prefilled stream: one
+        whole-prompt forward (the draft is cheap enough that chunking
+        buys nothing), padded to the engine's prefill_chunk granularity
+        so a handful of executables serve every prompt length. Padded
+        tail rows are causally downstream garbage the masked reads never
+        see and decode overwrites before they become readable."""
+        cfg_d, params_d = self._draft
+        n = len(prompt)
+        c = self.prefill_chunk
+        padded = -(-n // c) * c
+        x = self._draft_prefill_x.get(padded)
+        if x is None:
+            rope_d = self._draft_rope
+
+            def run(pd, cache_d, toks, slot_i):
+                _, ks, vs = llama.prefill_trunk(cfg_d, pd, toks, rope_d)
+                at = (0, slot_i, 0, 0, 0)
+                return {"k": lax.dynamic_update_slice(
+                            cache_d["k"], ks.astype(cache_d["k"].dtype),
+                            at),
+                        "v": lax.dynamic_update_slice(
+                            cache_d["v"], vs.astype(cache_d["v"].dtype),
+                            at)}
+
+            x = jax.jit(run, donate_argnums=(1,))
+            self._draft_prefill_x[padded] = x
+        buf = np.zeros((1, padded), np.int32)
+        buf[0, :n] = prompt
+        t0 = time.perf_counter()
+        self._draft_cache = x(params_d, self._draft_cache,
+                              jnp.asarray(buf), jnp.int32(slot))
+        dt = time.perf_counter() - t0
+        self.spec_draft_prefill_s += dt
+        if self.metrics is not None:
+            self.metrics.observe("serving.spec.draft_prefill_seconds", dt)
 
     def warmup(self, widths=(1,)) -> Dict[str, float]:
         """Pre-trace + compile the serving executables BEFORE admission
@@ -1616,6 +1800,14 @@ class PagedServer:
             # steps it should not
             self._pending_first[slot] = toks[0]
             self._prefill_q.popleft()
+            if self._draft is not None:
+                # the draft sees the WHOLE prompt (including any pages
+                # the radix adopted for the target — the draft cache has
+                # no prefix sharing); streams that enter decode without
+                # passing here (migration adoption) start with a cold
+                # draft row, which costs acceptance, never correctness:
+                # the verify pass consults only the target pool
+                self._draft_prefill(slot, prompt)
 
     def _decode_tables(self) -> np.ndarray:
         """Tables for the decode dispatch: any stream not actively
@@ -1684,6 +1876,8 @@ class PagedServer:
         1/k the prefill throughput — while an unbounded drain would
         spike running streams' TPOT by the whole backlog. The loop stops
         early when the queue empties, so an idle queue costs nothing."""
+        if self._draft is not None:
+            return self._spec_step_many(k)
         if k <= 1:
             return {slot: [tok] for slot, tok in self.step().items()}
         self._flush_pending()
@@ -1741,6 +1935,77 @@ class PagedServer:
                 if self.requests[i] is None:
                     break
             out[i] = emitted
+        return out
+
+    def _spec_step_many(self, k: int) -> Dict[int, List[int]]:
+        """The armed decode dispatch: ONE fused draft-scan + paged-verify
+        window per call, advancing every active stream by ``1 +
+        accepted`` target-verified tokens (1 .. draft_k). The solo
+        window's host discipline carries over unchanged — pacing up to
+        ``k`` prefill chunks first, committing per stream until
+        retirement breaks the loop, lengths frozen for masked slots.
+        The page ledger is untouched by the window itself (the verify
+        writes only through tables already allocated at admission), so
+        ledger hygiene under speculation is the admission/retire story
+        it always was.
+
+        Any failure inside the fused dispatch disarms the draft before
+        re-raising: the caller's existing reset()/retry path then runs
+        SOLO — a broken draft degrades throughput, never liveness."""
+        self._flush_pending()
+        self._tier_tick()
+        for _ in range(k):
+            self._prefill_tick()
+            if not self._prefill_q:
+                break
+        active = [i for i in range(self.slots)
+                  if self.requests[i] is not None and self._decoding[i]]
+        if not active:
+            return {}
+        kd = self.draft_k
+        mask = jnp.zeros((self.slots,), bool).at[
+            jnp.asarray(active, jnp.int32)].set(True)
+        mp = self._window_mp(active, kd)
+        tbl = jnp.asarray(self._decode_tables()[:, :mp])
+        _, params_d = self._draft
+        t0 = time.perf_counter()
+        try:
+            (self.pool, self._draft_cache, tgt, n_emit, self.lengths,
+             self.cur_tok) = self._spec_x(
+                self.params, params_d, self.pool, self._draft_cache,
+                tbl, self.lengths, self.cur_tok, mask)
+            host_tgt = np.asarray(tgt)                   # [B, kd]
+            host_n = np.asarray(n_emit)                  # [B]
+        except Exception:
+            self.spec_fallbacks += 1
+            if self.metrics is not None:
+                self.metrics.counter("serving.spec.fallbacks")
+            self.disarm_draft()
+            raise
+        dt = time.perf_counter() - t0
+        self.spec_windows += 1
+        self.spec_window_s += dt
+        out: Dict[int, List[int]] = {}
+        for i in active:
+            n = int(host_n[i])
+            self.spec_proposed += kd - 1
+            self.spec_accepted += n - 1
+            emitted: List[int] = []
+            for t in host_tgt[i, :n]:
+                emitted.append(int(t))
+                self.requests[i].tokens.append(int(t))
+                self._maybe_retire(i)
+                if self.requests[i] is None:
+                    break
+            out[i] = emitted
+        if self.metrics is not None:
+            self.metrics.counter("serving.spec.windows")
+            self.metrics.counter("serving.spec.proposed",
+                                 float(len(active) * (kd - 1)))
+            self.metrics.counter(
+                "serving.spec.accepted",
+                float(sum(int(host_n[i]) - 1 for i in active)))
+            self.metrics.observe("serving.spec.window_seconds", dt)
         return out
 
     # --------------------------------------------------------- retirement
@@ -1822,6 +2087,12 @@ class PagedServer:
         # still bit-valid for the rebuilt pool, so a reset engine keeps
         # its cold cache warm
         self._pending_tier.clear()
+        if self._draft is not None:
+            # the spec window donates the draft cache alongside the
+            # pool, so it is just as suspect after a failed dispatch
+            cfg_d, _ = self._draft
+            self._draft_cache = llama.init_kv_cache(cfg_d, self.slots,
+                                                    cfg_d.max_seq)
 
     # -------------------------------------------------------------- audit
 
@@ -1867,4 +2138,16 @@ class PagedServer:
             "tiers": self.tiers.stats() if self.tiers is not None else None,
             "directory": (self.directory.stats()
                           if self.directory is not None else None),
+            "spec": {
+                "armed": self._draft is not None,
+                "k": self.draft_k,
+                "windows": self.spec_windows,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "accept_rate": (self.spec_accepted / self.spec_proposed
+                                if self.spec_proposed else 0.0),
+                "fallbacks": self.spec_fallbacks,
+                "draft_prefill_s": self.spec_draft_prefill_s,
+                "window_s": self.spec_window_s,
+            },
         }

@@ -46,6 +46,8 @@ flagship is the intended target model, with a 400m-class draft).
 from __future__ import annotations
 
 import functools
+import json
+import os
 from typing import Any, Dict, Tuple
 
 import jax
@@ -344,3 +346,142 @@ class SpeculativeDecoder:
                  "temperature": temp,
                  "k": self.k}
         return jnp.asarray([out], jnp.int32), stats
+
+
+# ---------------------------------------------------------------------------
+# draft artifacts: a trained draft as a loadable, compat-guarded unit
+
+class DraftIncompatible(ValueError):
+    """A draft checkpoint the serving engine must not arm, with a stable
+    ``code`` the fallback path reports (``spec_fallback`` events and the
+    chaos invariants key on it):
+
+    * ``draft_config_missing`` — no ``draft_config.json`` beside the
+      shards (not a draft artifact at all)
+    * ``draft_manifest_stale`` — the shard manifest's digest no longer
+      matches what :func:`save_draft` recorded (overwritten, truncated,
+      or bit-rotted since training)
+    * ``draft_vocab_mismatch`` / ``draft_rope_mismatch`` /
+      ``draft_max_seq`` — the draft cannot speak for this target
+    * ``draft_sampled_engine`` / ``draft_k`` — arm-time parameter
+      rejections (:meth:`PagedServer.arm_draft`)
+
+    Serving catches this and keeps decoding SOLO — a bad draft costs
+    speed, never availability.
+    """
+
+    def __init__(self, code: str, msg: str):
+        super().__init__(f"{code}: {msg}")
+        self.code = code
+
+
+_DRAFT_CFG_FIELDS = ("vocab_size", "dim", "n_layers", "n_heads",
+                     "n_kv_heads", "ffn_dim", "max_seq", "rope_theta",
+                     "norm_eps")
+
+
+def _manifest_digest(step_dir: str) -> str:
+    import hashlib
+    with open(os.path.join(step_dir, "manifest.json"), "rb") as f:
+        return hashlib.blake2s(f.read()).hexdigest()
+
+
+def save_draft(out_dir: str, step: int, cfg_d: llama.LlamaConfig,
+               params_d: Params,
+               target_cfg: "llama.LlamaConfig | None" = None) -> str:
+    """Persist a trained draft as a self-describing artifact: sharded
+    params (``parallel.checkpoint`` format, per-shard digests) plus
+    ``draft_config.json`` carrying the draft's architecture, the target
+    it was distilled against, and the blake2s of the shard manifest —
+    the staleness seal :func:`load_draft` verifies before serving ever
+    touches the weights."""
+    from dcos_commons_tpu.parallel.checkpoint import save_sharded
+    step_dir = save_sharded(out_dir, step, {"params": params_d})
+    meta = {
+        "config": {f: getattr(cfg_d, f) for f in _DRAFT_CFG_FIELDS},
+        "step": step,
+        "manifest_digest": _manifest_digest(step_dir),
+        "target": (None if target_cfg is None else
+                   {"vocab_size": target_cfg.vocab_size,
+                    "rope_theta": target_cfg.rope_theta,
+                    "max_seq": target_cfg.max_seq,
+                    "n_layers": target_cfg.n_layers,
+                    "dim": target_cfg.dim}),
+    }
+    tmp = os.path.join(out_dir, ".draft_config.json.tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(meta, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, os.path.join(out_dir, "draft_config.json"))
+    return step_dir
+
+
+def load_draft(path: str,
+               cfg_t: "llama.LlamaConfig | None" = None
+               ) -> Tuple[llama.LlamaConfig, Params, Dict[str, Any]]:
+    """Load a :func:`save_draft` artifact, running every compatibility
+    check that can fail BEFORE the weights reach an engine: the config
+    sidecar must exist, the shard manifest must hash to the recorded
+    digest (and every shard to the manifest's digests — the restore
+    layer's own check), and when ``cfg_t`` is given the draft must share
+    its vocabulary and rope and cover its positions. Raises
+    :class:`DraftIncompatible` with a stable code on any failure;
+    returns ``(cfg_d, params_d, meta)``."""
+    from dcos_commons_tpu.parallel.checkpoint import (CheckpointCorrupt,
+                                                      latest_step,
+                                                      restore_sharded)
+    cfg_path = os.path.join(path, "draft_config.json")
+    if not os.path.exists(cfg_path):
+        raise DraftIncompatible(
+            "draft_config_missing",
+            f"no draft_config.json under {path!r} — not a draft "
+            "artifact")
+    with open(cfg_path, encoding="utf-8") as f:
+        meta = json.load(f)
+    cfg_d = llama.LlamaConfig(**meta["config"])
+    if cfg_t is not None:
+        if cfg_d.vocab_size != cfg_t.vocab_size:
+            raise DraftIncompatible(
+                "draft_vocab_mismatch",
+                f"draft vocab {cfg_d.vocab_size} != target "
+                f"{cfg_t.vocab_size}")
+        if cfg_d.rope_theta != cfg_t.rope_theta:
+            raise DraftIncompatible(
+                "draft_rope_mismatch",
+                f"draft rope_theta {cfg_d.rope_theta} != target "
+                f"{cfg_t.rope_theta}")
+        if cfg_d.max_seq < cfg_t.max_seq:
+            raise DraftIncompatible(
+                "draft_max_seq",
+                f"draft max_seq {cfg_d.max_seq} < target "
+                f"{cfg_t.max_seq}")
+    step = meta.get("step")
+    if step is None or latest_step(path) != step:
+        raise DraftIncompatible(
+            "draft_manifest_stale",
+            f"recorded step {step} is not the newest committed step "
+            f"under {path!r} — the artifact was overwritten after "
+            "save_draft sealed it")
+    import jax as _jax
+    pid = _jax.process_index()
+    step_dir = os.path.join(path, f"step-{step:08d}-p{pid}")
+    try:
+        digest = _manifest_digest(step_dir)
+    except OSError:
+        raise DraftIncompatible(
+            "draft_manifest_stale",
+            f"shard manifest unreadable under {step_dir!r}") from None
+    if digest != meta.get("manifest_digest"):
+        raise DraftIncompatible(
+            "draft_manifest_stale",
+            "shard manifest digest does not match draft_config.json — "
+            "the checkpoint changed after save_draft sealed it")
+    template = {"params": llama.init_params(cfg_d, jax.random.key(0))}
+    try:
+        tree = restore_sharded(path, template, step)
+    except (CheckpointCorrupt, FileNotFoundError) as e:
+        raise DraftIncompatible(
+            "draft_manifest_stale",
+            f"draft shards failed restore: {e}") from None
+    return cfg_d, tree["params"], meta

@@ -7,6 +7,7 @@ so XLA can tile the matmuls onto the MXU and fuse the elementwise tails.
 from dcos_commons_tpu.ops.norms import rms_norm, layer_norm
 from dcos_commons_tpu.ops.rotary import (rope_frequencies, apply_rope,
                                           apply_rope_at,
+                                          apply_rope_at_many,
                                           apply_rope_positions)
 from dcos_commons_tpu.ops.attention import gqa_attention, repeat_kv
 from dcos_commons_tpu.ops.losses import (fused_linear_cross_entropy,
@@ -15,7 +16,7 @@ from dcos_commons_tpu.ops.losses import (fused_linear_cross_entropy,
 __all__ = [
     "rms_norm", "layer_norm",
     "rope_frequencies", "apply_rope", "apply_rope_at",
-    "apply_rope_positions",
+    "apply_rope_at_many", "apply_rope_positions",
     "gqa_attention", "repeat_kv",
     "softmax_cross_entropy", "fused_linear_cross_entropy",
 ]

@@ -33,10 +33,12 @@ def gqa_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     """Attention with K/V head broadcast for GQA.
 
     q: [B, Sq, H, D]; k/v: [B, Sk, KV, D] with H % KV == 0.
-    ``q_offset`` shifts query positions (decode: Sq=1, offset=cache length).
-    ``kv_len`` optionally masks out cache slots >= kv_len (padded KV
-    cache); a scalar applies to every row, a [B] vector per slot (the
-    continuous-batching decode step).
+    ``q_offset`` shifts query positions (decode: Sq=1, offset=cache
+    length); a scalar applies to every row, a [B] vector per row (the
+    paged speculative verify — every stream's K-token window starts at
+    its own length). ``kv_len`` optionally masks out cache slots >=
+    kv_len (padded KV cache); a scalar applies to every row, a [B]
+    vector per slot (the continuous-batching decode step).
     """
     n_rep = q.shape[2] // k.shape[2]
     k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
@@ -48,8 +50,12 @@ def gqa_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     # mask broadcasts against scores [B, H, Sq, Sk]
     mask = None
     if causal:
-        q_pos = q_offset + lax.iota(jnp.int32, s_q)[:, None]
-        mask = (q_pos >= lax.iota(jnp.int32, s_k)[None, :])[None, None]
+        off = jnp.asarray(q_offset, jnp.int32)
+        # [B|1, Sq, 1]: a scalar offset reshapes to [1, 1, 1] and this
+        # reduces to the classic shared causal mask
+        q_pos = (off.reshape(-1, 1, 1)
+                 + lax.iota(jnp.int32, s_q)[None, :, None])
+        mask = (q_pos >= lax.iota(jnp.int32, s_k)[None, None, :])[:, None]
     if kv_len is not None:
         kvl = jnp.asarray(kv_len).reshape(-1, 1, 1, 1)  # [B or 1,1,1,1]
         valid = lax.iota(jnp.int32, s_k)[None, None, None, :] < kvl

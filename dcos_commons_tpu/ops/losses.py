@@ -1,6 +1,6 @@
 """Loss functions (fp32 reductions, optional z-loss stabilizer).
 
-Two cross-entropy entry points:
+Three loss-head entry points:
 
 * :func:`softmax_cross_entropy` — the reference: consumes materialized
   logits ``[..., V]``. Fine for classifier heads (V ~ 1e3); at LM vocab
@@ -13,6 +13,13 @@ Two cross-entropy entry points:
   applied to the loss head — cf. ``ops/flash_attention.py``). The full
   ``[B, S, V]`` fp32 tensor never exists in either direction; peak
   scratch is one ``[B, block, V]`` tile.
+* :func:`fused_linear_distillation` — the same blockwise machinery for
+  the distillation head: KL(teacher ‖ student) of ``x_s @ head_s``
+  against a FROZEN ``x_t @ head_t``, both projections running inside
+  the sequence-chunked loop so neither model's ``[B, S, V]`` fp32
+  logits ever materializes (at Llama-3 vocab the teacher tensor alone
+  would double the train step's loss-head HBM traffic). Gradients flow
+  to the student only; the teacher side is structurally stop-gradient.
 """
 
 from __future__ import annotations
@@ -220,3 +227,165 @@ def fused_linear_cross_entropy(x: jnp.ndarray, lm_head, labels: jnp.ndarray,
     loss, acc = _fused_lce(xf, lm_head, lab, maskf, float(z_loss), block,
                            bool(compute_accuracy))
     return loss, (acc if compute_accuracy else None)
+
+
+# ---------------------------------------------------------------------------
+# fused linear + KL distillation (teacher logits never materialized)
+
+
+def softmax_kl_divergence(logits_s: jnp.ndarray, logits_t: jnp.ndarray, *,
+                          mask: Optional[jnp.ndarray] = None,
+                          temperature: float = 1.0) -> jnp.ndarray:
+    """Reference distillation loss on MATERIALIZED logits: masked mean
+    per-token ``KL(softmax(logits_t/T) || softmax(logits_s/T))``. The
+    fused head is parity-tested against this at small vocab; real train
+    steps must use :func:`fused_linear_distillation` (J1 budget)."""
+    inv = 1.0 / temperature
+    zs = logits_s.astype(jnp.float32) * inv
+    zt = logits_t.astype(jnp.float32) * inv
+    lzs = jax.nn.logsumexp(zs, axis=-1)
+    lzt = jax.nn.logsumexp(zt, axis=-1)
+    pt = jnp.exp(zt - lzt[..., None])
+    kl = (lzs - lzt) + ((zt - zs) * pt).sum(axis=-1)
+    if mask is not None:
+        m = mask.astype(jnp.float32)
+        return (kl * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return kl.mean()
+
+
+def _zero_head_cotangent(w):
+    """A frozen projection head's cotangent: float0 for int8 payloads
+    (no tangent space), zeros elsewhere — the QTensor convention
+    :func:`_fused_lce_bwd` established."""
+    if isinstance(w, QTensor):
+        return QTensor(np.zeros(w.q.shape, dtype=jax.dtypes.float0),
+                       jnp.zeros_like(w.s))
+    return jnp.zeros_like(w)
+
+
+def _fused_kl_impl(xs_s, w_s, xs_t, w_t, maskf, temp, block):
+    """Forward: scan sequence blocks; each block projects BOTH hidden
+    states to logits tiles, reduces the per-token KL, and keeps only the
+    two logsumexp rows — the O(S) residual the backward rebuilds the
+    softmaxes from."""
+    ss = _seq_blocks(xs_s, block)
+    ts = _seq_blocks(xs_t, block)
+    ms = _seq_blocks(maskf, block)
+    inv = 1.0 / temp
+
+    def body(kl_sum, inp):
+        xb_s, xb_t, mb = inp
+        zs = _block_logits(xb_s, w_s) * inv            # [B, blk, V]
+        zt = _block_logits(xb_t, w_t) * inv
+        lzs = jax.nn.logsumexp(zs, axis=-1)            # [B, blk]
+        lzt = jax.nn.logsumexp(zt, axis=-1)
+        pt = jnp.exp(zt - lzt[..., None])
+        kl = (lzs - lzt) + ((zt - zs) * pt).sum(axis=-1)
+        return kl_sum + (kl * mb).sum(), (lzs, lzt)
+
+    kl_sum, (lzs, lzt) = lax.scan(body, jnp.zeros((), jnp.float32),
+                                  (ss, ts, ms))
+    denom = jnp.maximum(maskf.sum(), 1.0)
+    return kl_sum / denom, (lzs, lzt, denom)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _fused_kl(xs_s, w_s, xs_t, w_t, maskf, temp, block):
+    loss, _ = _fused_kl_impl(xs_s, w_s, xs_t, w_t, maskf, temp, block)
+    return loss
+
+
+def _fused_kl_fwd(xs_s, w_s, xs_t, w_t, maskf, temp, block):
+    loss, (lzs, lzt, denom) = _fused_kl_impl(xs_s, w_s, xs_t, w_t, maskf,
+                                             temp, block)
+    return loss, (xs_s, w_s, xs_t, w_t, maskf, lzs, lzt, denom)
+
+
+def _fused_kl_bwd(temp, block, res, g):
+    """Recompute both blocks' logits from the saved logsumexps; the
+    per-token student-logit cotangent is the classic distillation
+    gradient ``(softmax_s - softmax_t) / T`` scaled by ``g * mask /
+    denom``. The teacher inputs get structural zeros — KL is minimized
+    OVER the student, the teacher is a frozen reference (the workload
+    additionally wraps it in stop_gradient; this makes the contract
+    hold even without the wrap)."""
+    xs_s, w_s, xs_t, w_t, maskf, lzs, lzt, denom = res
+    ss = _seq_blocks(xs_s, block)
+    ts = _seq_blocks(xs_t, block)
+    ms = _seq_blocks(maskf, block)
+    inv = 1.0 / temp
+    plain_ws = not isinstance(w_s, QTensor)
+    scale = (g / denom).astype(jnp.float32) * inv
+
+    def body(dw_acc, inp):
+        xb_s, xb_t, mb, lzs_b, lzt_b = inp
+        zs = _block_logits(xb_s, w_s) * inv
+        zt = _block_logits(xb_t, w_t) * inv
+        p_s = jnp.exp(zs - lzs_b[..., None])
+        p_t = jnp.exp(zt - lzt_b[..., None])
+        dlog = (p_s - p_t) * (scale * mb)[..., None]   # [B, blk, V]
+        dxb = _dx_block(dlog, w_s, xs_s.dtype)
+        if plain_ws:
+            dw_acc = dw_acc + jnp.einsum(
+                "bsd,bsv->dv", xb_s.astype(jnp.float32), dlog)
+        return dw_acc, dxb
+
+    dw0 = (jnp.zeros(w_s.shape, jnp.float32) if plain_ws
+           else jnp.zeros((), jnp.float32))
+    dw_acc, dxs = lax.scan(body, dw0, (ss, ts, ms, lzs, lzt))
+    dx_s = dxs.swapaxes(0, 1).reshape(xs_s.shape)
+    dw_s = (dw_acc.astype(w_s.dtype) if plain_ws
+            else _zero_head_cotangent(w_s))
+    return (dx_s, dw_s, jnp.zeros_like(xs_t), _zero_head_cotangent(w_t),
+            jnp.zeros_like(maskf))
+
+
+_fused_kl.defvjp(_fused_kl_fwd, _fused_kl_bwd)
+
+
+def fused_linear_distillation(x_s: jnp.ndarray, head_s, x_t: jnp.ndarray,
+                              head_t, *,
+                              mask: Optional[jnp.ndarray] = None,
+                              temperature: float = 1.0,
+                              block_size: int = 512) -> jnp.ndarray:
+    """KL(teacher ‖ student) of ``x_s @ head_s`` vs ``x_t @ head_t``
+    WITHOUT materializing either logits tensor.
+
+    ``x_s``/``x_t`` [..., S, D_s]/[..., S, D_t] (final-norm hidden
+    states — the dims may differ, only the vocab must match),
+    ``head_s``/``head_t`` [D, V] (plain arrays or int8
+    :class:`~dcos_commons_tpu.ops.quant.QTensor`). Semantics match
+    ``softmax_kl_divergence(x_s @ head_s, x_t @ head_t, ...)`` exactly,
+    but the sequence is processed in ``block_size`` chunks so peak
+    logits scratch is two ``[B, block, V]`` fp32 tiles instead of two
+    full ``[B, S, V]`` tensors — the distill train step's J1 budget
+    (analysis/entrypoints.py) is set just below the materialized-teacher
+    size, so a regression that materializes either tensor fails the
+    lint, not just the profile.
+
+    Differentiable w.r.t. ``x_s`` and a plain ``head_s`` ONLY: the
+    teacher side (``x_t``, ``head_t``) gets structural zero cotangents,
+    making the head safe even without an explicit ``stop_gradient`` on
+    the teacher forward. ``temperature`` tempers BOTH distributions
+    (standard Hinton distillation; gradients carry the 1/T factor).
+    """
+    if x_s.shape[:-1] != x_t.shape[:-1]:
+        raise ValueError(f"student/teacher token shapes differ: "
+                         f"{x_s.shape[:-1]} vs {x_t.shape[:-1]}")
+    if temperature <= 0.0:
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+    lead = x_s.shape[:-2]
+    s = x_s.shape[-2]
+    b = int(np.prod(lead)) if lead else 1
+    xs_s = x_s.reshape((b, s, x_s.shape[-1]))
+    xs_t = x_t.reshape((b, s, x_t.shape[-1]))
+    maskf = (jnp.ones((b, s), jnp.float32) if mask is None
+             else mask.reshape((b, s)).astype(jnp.float32))
+    block = max(1, min(int(block_size), s))
+    pad = -s % block
+    if pad:
+        xs_s = jnp.pad(xs_s, ((0, 0), (0, pad), (0, 0)))
+        xs_t = jnp.pad(xs_t, ((0, 0), (0, pad), (0, 0)))
+        maskf = jnp.pad(maskf, ((0, 0), (0, pad)))   # pads never count
+    return _fused_kl(xs_s, head_s, xs_t, head_t, maskf,
+                     float(temperature), block)

@@ -61,6 +61,19 @@ def apply_rope_at(x: jnp.ndarray, table: jnp.ndarray,
     return _rotate(x, cos, sin, half)
 
 
+def apply_rope_at_many(x: jnp.ndarray, table: jnp.ndarray,
+                       pos: jnp.ndarray) -> jnp.ndarray:
+    """Rotate a K-token window PER STREAM: x [B, K, H, D], pos [B, K]
+    (stream ``b``'s window occupies its own positions — the paged
+    speculative verify, where every stream sits at a different length).
+    Callers pass ``pos`` pre-clipped to the table, same contract as
+    :func:`apply_rope_positions`."""
+    half = x.shape[-1] // 2
+    cos = table[0][pos][:, :, None, :]              # [B, K, 1, D/2]
+    sin = table[1][pos][:, :, None, :]
+    return _rotate(x, cos, sin, half)
+
+
 def _rotate(x, cos, sin, half):
     x32 = x.astype(jnp.float32)
     x1, x2 = x32[..., :half], x32[..., half:]

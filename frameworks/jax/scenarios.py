@@ -67,6 +67,22 @@ DEFAULT_ENV: Mapping[str, str] = {
     "KV_TIER_DISK_DIR": "",
     "KV_TIER_DISK_PAGES": "0",
     "PREFIX_DIRECTORY": "0",
+    # speculative decoding on the paged engine (models/serving.py
+    # arm_draft + models/speculative.py draft artifacts): SPEC_DECODE=
+    # true arms draft-propose + fused paged-verify windows — each
+    # target pass emits 1 + accepted tokens, output token-exact with
+    # solo greedy decode. DRAFT_CHECKPOINT points at a save_draft
+    # artifact (the distill workload's --out/draft); any draft problem
+    # (missing, stale manifest seal, vocab/rope mismatch, compile
+    # rejection) degrades to solo with a coded spec_fallback event.
+    # DRAFT_K sizes the window (proposals verified per pass).
+    # DRAFT_LAYERS / DISTILL_TEMP parameterize the distill trainer
+    # (distill.yml) that produces the artifact.
+    "SPEC_DECODE": "false",
+    "DRAFT_CHECKPOINT": "",
+    "DRAFT_K": "4",
+    "DRAFT_LAYERS": "1",
+    "DISTILL_TEMP": "1.0",
     # disaggregated prefill/decode tiers (disagg.yml + models/disagg.py):
     # SERVE_ROLE picks the tier a replica runs (colocated|prefill|decode)
     # and SERVE_PEER points a decode replica at its prefill tier's

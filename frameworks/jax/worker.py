@@ -380,7 +380,8 @@ def run_llama(args) -> dict:
             from dcos_commons_tpu.models.ingress import ServingFrontend
             t_compile = time.perf_counter()
             server, page_stats = _make_serving_engine(args, cfg, params,
-                                                      mesh)
+                                                      mesh,
+                                                      registry=registry)
             warmup = getattr(server, "warmup", None)
             if warmup is not None:
                 # trace + compile the serving executables NOW (AOT) so
@@ -565,7 +566,8 @@ def _start_weight_server(args, params, registry=None):
         return None
 
 
-def _make_serving_engine(args, cfg, params, mesh, key=None):
+def _make_serving_engine(args, cfg, params, mesh, key=None,
+                         registry=None):
     """SlotServer or PagedServer per ``--pages``, degrade-not-crash.
 
     A paged config the model can't satisfy (page size not dividing
@@ -573,6 +575,12 @@ def _make_serving_engine(args, cfg, params, mesh, key=None):
     to the monolithic slot engine with a loud ``paged_fallback`` event —
     a serving replica must come up serving, not crash-loop on a knob.
     The decision is pure config, so every gang rank makes the same one.
+
+    ``--spec-decode`` with a ``--draft-checkpoint`` arms the paged
+    engine's speculative path the same way: any draft problem (missing
+    artifact, stale manifest, vocab/rope mismatch, compile rejection)
+    emits a coded ``spec_fallback`` event and the replica serves SOLO —
+    a draft is a speed-up, never a dependency.
 
     ``AOT_CACHE`` (on by default) shares one process-wide compile cache
     across paged engines: a homogeneous scale-up (same config, same
@@ -585,6 +593,7 @@ def _make_serving_engine(args, cfg, params, mesh, key=None):
     kw = {"mesh": mesh if mesh.size > 1 else None}
     if key is not None:
         kw["key"] = key
+    spec_wanted = _spec_decode_wanted(args)
     if args.pages:
         try:
             engine = PagedServer(
@@ -594,12 +603,56 @@ def _make_serving_engine(args, cfg, params, mesh, key=None):
                 prefill_chunk=args.prefill_chunk,
                 compile_cache=aot.from_env(),
                 **_make_kv_tiers(args), **kw)
+            if spec_wanted:
+                _arm_spec_decode(args, cfg, engine, registry)
             return engine, engine.page_stats()
         except ValueError as e:
             _emit({"event": "paged_fallback", "error": str(e),
                    "pages": args.pages, "page_size": args.page_size,
                    "prefill_chunk": args.prefill_chunk})
+    if spec_wanted:
+        _emit({"event": "spec_fallback", "code": "spec_needs_paged",
+               "error": "speculative decode needs the paged engine "
+                        "(--pages); serving solo"})
     return SlotServer(cfg, params, slots=args.slots, **kw), None
+
+
+def _spec_decode_wanted(args) -> bool:
+    from dcos_commons_tpu.specification import yaml_bool
+    return yaml_bool(getattr(args, "spec_decode", "false"))
+
+
+def _arm_spec_decode(args, cfg, engine, registry) -> None:
+    """Load the draft artifact and arm the paged engine, coded-fallback
+    on ANY draft problem. The load path re-verifies the save-time
+    manifest digest (a retrained/overwritten artifact reads as
+    ``draft_manifest_stale``) and arm-time compiles the fused window,
+    so everything that can go wrong goes wrong HERE, before a request
+    exists."""
+    from dcos_commons_tpu.models.speculative import (DraftIncompatible,
+                                                     load_draft)
+    path = getattr(args, "draft_checkpoint", "") or ""
+    if not path:
+        _emit({"event": "spec_fallback", "code": "draft_config_missing",
+               "error": "--spec-decode without --draft-checkpoint"})
+        return
+    try:
+        cfg_d, params_d, meta = load_draft(path, cfg)
+        engine.arm_draft(cfg_d, params_d,
+                         k=max(2, getattr(args, "draft_k", 4)),
+                         metrics=registry)
+    except DraftIncompatible as e:
+        _emit({"event": "spec_fallback", "code": e.code, "error": str(e),
+               "draft_checkpoint": path})
+        return
+    except Exception as e:  # compiler rejection at arm-time warmup
+        engine.disarm_draft()
+        _emit({"event": "spec_fallback", "code": "draft_arm_failed",
+               "error": str(e), "draft_checkpoint": path})
+        return
+    _emit({"event": "spec_armed", "draft_checkpoint": path,
+           "k": engine.draft_k, "draft_layers": cfg_d.n_layers,
+           "draft_step": meta.get("step")})
 
 
 def _make_kv_tiers(args) -> dict:
@@ -1055,8 +1108,135 @@ def _llama_train_moe(args, contract, n, divisor_at_most) -> dict:
          "routing": args.moe_routing}, "dense")
 
 
+def run_distill(args) -> dict:
+    """Draft distillation (``dist/distill.yml``): train a small draft
+    model against the FROZEN serving target's own logits so the paged
+    engine's speculative decode has something worth proposing.
+
+    The teacher is constructed exactly like the serving replica's model
+    (same preset branch, same ``init_params(cfg, key(0))`` seed), so the
+    artifact this run saves is compatible with the engine that will arm
+    it. The student starts as the teacher's first ``--draft-layers``
+    decoder layers (``llama.truncate_layers``) and trains EVERY one of
+    its own weights — embed/head included — against the teacher's
+    tempered distribution through the fused linear-KL head
+    (``ops/losses.py:fused_linear_distillation``): the teacher's
+    [B, S, V] fp32 logits never materialize, same memory contract as
+    the fused-CE training loss. Gradients flow to the draft alone (the
+    head gives the teacher structural zero cotangents; its trunk sits
+    behind ``stop_gradient``).
+
+    Saves a resumable train checkpoint under ``--out`` and, at the end,
+    a sealed draft artifact under ``--out/draft``
+    (``speculative.save_draft``) that ``--spec-decode`` serving loads
+    and compat-checks."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama, train
+    from dcos_commons_tpu.models.speculative import save_draft
+    from dcos_commons_tpu.ops.losses import fused_linear_distillation
+    from dcos_commons_tpu.parallel import checkpoint as ckpt
+    from dcos_commons_tpu.parallel import distributed
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+    contract = distributed.initialize()
+    n = jax.device_count()
+    if args.preset == "8b":
+        cfg_t = llama.LlamaConfig.llama3_8b(max_seq=args.max_seq or 2048,
+                                            remat=False)
+    elif args.preset == "400m":
+        cfg_t = llama.LlamaConfig.llama_400m(max_seq=args.max_seq or 2048)
+    elif args.max_seq:
+        cfg_t = llama.LlamaConfig.tiny(max_seq=args.max_seq)
+    else:
+        cfg_t = llama.LlamaConfig.tiny()
+    seq = min(args.seq, cfg_t.max_seq)
+    temp = max(float(getattr(args, "distill_temp", 1.0)), 1e-3)
+    layers = max(1, min(getattr(args, "draft_layers", 1),
+                        cfg_t.n_layers - 1))
+    mesh = MeshSpec(dp=n).build()
+    with mesh:
+        params_t = llama.init_params(cfg_t, jax.random.key(0))
+        cfg_d, params_d = llama.truncate_layers(cfg_t, params_t, layers)
+        # the student trains its OWN copies; the view-sharing with the
+        # teacher ends at the first optimizer step either way
+        params_d = jax.tree.map(jnp.array, params_d)
+    toks = jax.random.randint(jax.random.key(1), (max(args.batch, 1), seq),
+                              0, cfg_t.vocab_size)
+
+    def loss_fn(p_d, batch):
+        x_t = jax.lax.stop_gradient(
+            llama.forward(cfg_t, params_t, batch,
+                          mesh if n > 1 else None, return_hidden=True))
+        x_s = llama.forward(cfg_d, p_d, batch,
+                            mesh if n > 1 else None, return_hidden=True)
+        loss = fused_linear_distillation(
+            x_s, p_d["lm_head"], x_t, params_t["lm_head"],
+            temperature=temp)
+        return loss, loss
+
+    with mesh:
+        opt = train.make_optimizer(lr=1e-3, warmup=5,
+                                   decay_steps=max(args.steps, 10))
+        step = train.make_train_step(loss_fn, opt, mesh=mesh,
+                                     param_spec_tree=llama.param_specs(
+                                         cfg_d),
+                                     batch_spec=None)
+        opt_state = train.init_opt_state(opt, params_d, mesh,
+                                         llama.param_specs(cfg_d))
+        w_params, w_opt, out = step(params_d, opt_state, toks)
+        float(out["loss"])                       # compile barrier
+        start = 0
+        if args.out and (resume := ckpt.latest_step(args.out)) is not None:
+            tree = ckpt.restore_sharded(
+                args.out, {"params": w_params, "opt_state": w_opt},
+                resume)
+            params_d, opt_state = tree["params"], tree["opt_state"]
+            start = resume
+            _emit({"event": "resumed", "step": start, "sharded": True})
+        else:
+            params_d, opt_state = w_params, w_opt
+        t0 = time.perf_counter()
+        trajectory = []
+        for i in range(start, args.steps):
+            params_d, opt_state, out = step(params_d, opt_state, toks)
+            loss = float(out["loss"])
+            trajectory.append(round(loss, 6))
+            if args.emit_every and (i + 1) % args.emit_every == 0:
+                _emit({"event": "progress", "step": i + 1, "loss": loss})
+            if args.out and args.ckpt_every \
+                    and (i + 1 - start) % args.ckpt_every == 0:
+                ckpt.save_sharded(args.out, i + 1,
+                                  {"params": params_d,
+                                   "opt_state": opt_state})
+                _emit({"event": "checkpoint", "step": i + 1})
+        dt = time.perf_counter() - t0
+        draft_dir = ""
+        if args.out:
+            ckpt.save_sharded(args.out, args.steps,
+                              {"params": params_d,
+                               "opt_state": opt_state})
+            draft_dir = os.path.join(args.out, "draft")
+            save_draft(draft_dir, args.steps, cfg_d, params_d, cfg_t)
+            _emit({"event": "draft_saved", "path": draft_dir,
+                   "step": args.steps, "draft_layers": cfg_d.n_layers})
+    steps_run = len(trajectory)
+    return {"workload": "distill", "preset": args.preset,
+            "draft_layers": cfg_d.n_layers, "teacher_layers": cfg_t.n_layers,
+            "seq": seq, "temperature": temp,
+            "loss_first": trajectory[0] if trajectory else None,
+            "loss_final": trajectory[-1] if trajectory else None,
+            "loss_trajectory": trajectory[-16:],
+            "steps_run": steps_run, "draft_dir": draft_dir,
+            "tokens_per_sec": (round(
+                toks.shape[0] * seq * steps_run / dt, 1) if steps_run
+                else 0.0),
+            "process_id": contract["process_id"]}
+
+
 WORKLOADS = {"mnist": run_mnist, "resnet": run_resnet, "llama": run_llama,
-             "llama-train": run_llama_train}
+             "llama-train": run_llama_train, "distill": run_distill}
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1135,6 +1315,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "this replica's cached chains and adopts "
                         "fleet-hot prefixes from sibling /v1/prefix "
                         "endpoints instead of recomputing (0 = off)")
+    p.add_argument("--spec-decode",
+                   default=os.environ.get("SPEC_DECODE", "false"),
+                   help="llama --serve --pages: arm speculative decode "
+                        "on the paged engine — draft-propose + fused "
+                        "paged verify, 1 + accepted tokens per target "
+                        "pass, token-exact greedy output. true/false "
+                        "(spec boolean); any draft problem degrades to "
+                        "solo with a coded spec_fallback event")
+    p.add_argument("--draft-checkpoint",
+                   default=os.environ.get("DRAFT_CHECKPOINT", ""),
+                   help="llama --serve --spec-decode: save_draft "
+                        "artifact directory (the distill workload's "
+                        "--out/draft) — sharded draft weights + "
+                        "draft_config.json compat/staleness seal")
+    p.add_argument("--draft-k", type=int,
+                   default=int(os.environ.get("DRAFT_K", "4") or 4),
+                   help="speculative window: draft proposals verified "
+                        "per target pass (>= 2; each window emits "
+                        "1..k target-verified tokens)")
+    p.add_argument("--draft-layers", type=int,
+                   default=int(os.environ.get("DRAFT_LAYERS", "1") or 1),
+                   help="distill: student decoder layers (initialized "
+                        "as the teacher's first N via truncate_layers; "
+                        "clamped to teacher layers - 1)")
+    p.add_argument("--distill-temp", type=float,
+                   default=float(os.environ.get("DISTILL_TEMP", "1.0")
+                                 or 1.0),
+                   help="distill: softmax temperature both "
+                        "distributions are smoothed by in the KL loss")
     p.add_argument("--queue-limit", type=int, default=64,
                    help="llama --serve --slots: bounded ingress queue "
                         "(overflow answers 503 + Retry-After)")

@@ -35,6 +35,9 @@ python -m tools.migrate_smoke --budget-s "${MIGRATE_SMOKE_BUDGET_S:-90}"
 echo "== kv-tier smoke (host/disk demote-promote + fleet prefix adoption, time-capped) =="
 python -m tools.kvtier_smoke --budget-s "${KVTIER_SMOKE_BUDGET_S:-90}"
 
+echo "== spec smoke (distill -> sealed draft -> armed paged decode, token-exact, time-capped) =="
+python -m tools.spec_smoke --budget-s "${SPEC_SMOKE_BUDGET_S:-120}"
+
 echo "== control-plane smoke (steady-state cycle budget under churn) =="
 # observed p50 ~6.4ms at fleet 500; the pin is ~12x that so only an
 # O(fleet) regression (not CI-host noise) trips it

@@ -1,0 +1,236 @@
+"""Draft-distillation pipeline (ops/losses.py fused linear-KL +
+models/speculative.py draft artifacts + the ``distill`` workload).
+
+The fused head must be a drop-in for
+``softmax_kl_divergence(x_s @ head_s, x_t @ head_t, ...)`` — same value,
+same student gradients, structural ZEROS for every teacher input — while
+never materializing either [B, S, V] fp32 logits tensor (the registered
+``llama_distill_step_fused`` hot path checks that claim structurally).
+The artifact seam must round-trip exactly and refuse stale or
+incompatible drafts with coded errors, because serving arms whatever it
+is pointed at.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tests._jax_cpu  # noqa: F401
+
+from dcos_commons_tpu.models import llama, speculative
+from dcos_commons_tpu.ops import losses
+from dcos_commons_tpu.ops.quant import quantize
+
+B, S, DS, DT, V = 2, 16, 24, 32, 97
+
+
+def _data(key=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.key(key), 5)
+    x_s = jax.random.normal(ks[0], (B, S, DS), dtype)
+    x_t = jax.random.normal(ks[1], (B, S, DT), dtype)
+    w_s = (jax.random.normal(ks[2], (DS, V), jnp.float32) * DS ** -0.5
+           ).astype(dtype)
+    w_t = (jax.random.normal(ks[3], (DT, V), jnp.float32) * DT ** -0.5
+           ).astype(dtype)
+    mask = (jax.random.uniform(ks[4], (B, S)) > 0.3)
+    return x_s, w_s, x_t, w_t, mask
+
+
+def _ref(x_s, w_s, x_t, w_t, mask=None, temperature=1.0):
+    return losses.softmax_kl_divergence(
+        (x_s @ w_s).astype(jnp.float32), (x_t @ w_t).astype(jnp.float32),
+        mask=mask, temperature=temperature)
+
+
+# ------------------------------------------------------------------ parity
+
+@pytest.mark.parametrize("mask_on,temp,block", [
+    (False, 1.0, 4),
+    (True, 2.0, 4),
+    (True, 1.0, 16),     # block == S
+    (False, 0.5, 5),     # S % block != 0 (odd tail, masked padding)
+])
+def test_value_parity(mask_on, temp, block):
+    x_s, w_s, x_t, w_t, mask = _data()
+    m = mask if mask_on else None
+    ref = _ref(x_s, w_s, x_t, w_t, mask=m, temperature=temp)
+    got = losses.fused_linear_distillation(
+        x_s, w_s, x_t, w_t, mask=m, temperature=temp, block_size=block)
+    np.testing.assert_allclose(float(got), float(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("mask_on,temp,block", [
+    (False, 1.0, 4),
+    (True, 2.0, 4),
+    (True, 1.0, 5),
+])
+def test_student_grad_parity(mask_on, temp, block):
+    x_s, w_s, x_t, w_t, mask = _data()
+    m = mask if mask_on else None
+    gx_r, gw_r = jax.grad(
+        lambda xs, ws: _ref(xs, ws, x_t, w_t, mask=m, temperature=temp),
+        argnums=(0, 1))(x_s, w_s)
+    gx_f, gw_f = jax.grad(
+        lambda xs, ws: losses.fused_linear_distillation(
+            xs, ws, x_t, w_t, mask=m, temperature=temp,
+            block_size=block), argnums=(0, 1))(x_s, w_s)
+    np.testing.assert_allclose(np.asarray(gx_f), np.asarray(gx_r),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_r),
+                               atol=1e-5)
+
+
+def test_teacher_inputs_get_structural_zero_grads():
+    """The teacher side is a frozen reference: its cotangents are zeros
+    even WITHOUT a stop_gradient wrap (the workload adds one anyway —
+    this makes the contract hold either way)."""
+    x_s, w_s, x_t, w_t, mask = _data()
+    gxt, gwt = jax.grad(
+        lambda xt, wt: losses.fused_linear_distillation(
+            x_s, w_s, xt, wt, block_size=4), argnums=(0, 1))(x_t, w_t)
+    assert not np.asarray(gxt).any()
+    assert not np.asarray(gwt).any()
+
+
+def test_quantized_teacher_head_parity():
+    """An int8 serving target distills without dequantizing its head
+    into the loss: value matches the dequantized reference, and the
+    QTensor teacher head gets the float0/zeros cotangent convention."""
+    x_s, w_s, x_t, w_t, mask = _data()
+    q_t = quantize(w_t)
+    from dcos_commons_tpu.ops.quant import dequantize
+    ref = _ref(x_s, w_s, x_t, dequantize(q_t), mask=mask)
+    got = losses.fused_linear_distillation(x_s, w_s, x_t, q_t,
+                                           mask=mask, block_size=4)
+    np.testing.assert_allclose(float(got), float(ref), atol=1e-4)
+
+
+def test_temperature_validation():
+    x_s, w_s, x_t, w_t, _ = _data()
+    with pytest.raises(ValueError, match="temperature"):
+        losses.fused_linear_distillation(x_s, w_s, x_t, w_t,
+                                         temperature=0.0)
+    with pytest.raises(ValueError, match="token shapes"):
+        losses.fused_linear_distillation(x_s[:, :-1], w_s, x_t, w_t)
+
+
+# ------------------------------------------------------- distill train step
+
+def _tiny_pair(layers=1):
+    cfg_t = llama.LlamaConfig.tiny(n_layers=2, max_seq=64)
+    params_t = llama.init_params(cfg_t, jax.random.key(0))
+    cfg_d, params_d = llama.truncate_layers(cfg_t, params_t, layers)
+    params_d = jax.tree.map(jnp.array, params_d)  # own copies, not views
+    return cfg_t, params_t, cfg_d, params_d
+
+
+def test_distill_loss_decreases_and_grads_hit_draft_only():
+    """A few SGD steps on the distillation loss move the draft toward
+    the teacher while the teacher stays bit-identical (grads flow to the
+    draft ONLY — the whole point of freezing the target)."""
+    cfg_t, params_t, cfg_d, params_d = _tiny_pair()
+    toks = jax.random.randint(jax.random.key(1), (2, 32), 0,
+                              cfg_t.vocab_size)
+    frozen = jax.tree.map(np.asarray, params_t)
+
+    def loss_fn(p_d):
+        x_t = jax.lax.stop_gradient(
+            llama.forward(cfg_t, params_t, toks, return_hidden=True))
+        x_s = llama.forward(cfg_d, p_d, toks, return_hidden=True)
+        return losses.fused_linear_distillation(
+            x_s, p_d["lm_head"], x_t, params_t["lm_head"], block_size=8)
+
+    step = jax.jit(jax.value_and_grad(loss_fn))
+    trajectory = []
+    for _ in range(4):
+        loss, grads = step(params_d)
+        trajectory.append(float(loss))
+        params_d = jax.tree.map(lambda p, g: p - 0.05 * g, params_d,
+                                grads)
+    assert trajectory[-1] < trajectory[0], trajectory
+    leaves = jax.tree_util.tree_leaves_with_path(grads)
+    assert any(np.abs(np.asarray(g)).sum() > 0 for _, g in leaves)
+    for (path, before), (_, after) in zip(
+            jax.tree_util.tree_leaves_with_path(frozen),
+            jax.tree_util.tree_leaves_with_path(
+                jax.tree.map(np.asarray, params_t))):
+        np.testing.assert_array_equal(before, after, err_msg=str(path))
+
+
+# ------------------------------------------------------------ draft artifact
+
+def _save_tiny_draft(tmp_path, step=3):
+    cfg_t, params_t, cfg_d, params_d = _tiny_pair()
+    out = os.path.join(str(tmp_path), "draft")
+    speculative.save_draft(out, step, cfg_d, params_d, cfg_t)
+    return cfg_t, cfg_d, params_d, out
+
+
+def test_draft_checkpoint_round_trip(tmp_path):
+    cfg_t, cfg_d, params_d, out = _save_tiny_draft(tmp_path)
+    got_cfg, got_params, meta = speculative.load_draft(out, cfg_t)
+    # the sidecar records the architectural fields; engine-policy fields
+    # (attn impl, fused-CE flags) are the arming engine's business
+    for f in speculative._DRAFT_CFG_FIELDS:
+        assert getattr(got_cfg, f) == getattr(cfg_d, f), f
+    assert meta["step"] == 3
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(params_d),
+            jax.tree_util.tree_leaves_with_path(got_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(path))
+
+
+def test_draft_guards_are_coded(tmp_path):
+    """Every refusal carries a machine-readable code — the worker
+    forwards it in the spec_fallback event, so operators can tell a
+    stale seal from a wrong-model mistake without reading stacks."""
+    cfg_t, cfg_d, params_d, out = _save_tiny_draft(tmp_path)
+
+    with pytest.raises(speculative.DraftIncompatible) as e:
+        speculative.load_draft(os.path.join(str(tmp_path), "nope"),
+                               cfg_t)
+    assert e.value.code == "draft_config_missing"
+
+    wrong_vocab = dataclasses.replace(cfg_t,
+                                      vocab_size=cfg_t.vocab_size * 2)
+    with pytest.raises(speculative.DraftIncompatible) as e:
+        speculative.load_draft(out, wrong_vocab)
+    assert e.value.code == "draft_vocab_mismatch"
+
+    wrong_rope = dataclasses.replace(cfg_t, rope_theta=1234.5)
+    with pytest.raises(speculative.DraftIncompatible) as e:
+        speculative.load_draft(out, wrong_rope)
+    assert e.value.code == "draft_rope_mismatch"
+
+    # the seal: a draft dir whose weights changed after the sidecar was
+    # written (partial re-train, torn copy) must refuse to load
+    side = os.path.join(out, "draft_config.json")
+    meta = json.loads(open(side).read())
+    meta["manifest_digest"] = "0" * len(meta["manifest_digest"])
+    with open(side, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(speculative.DraftIncompatible) as e:
+        speculative.load_draft(out, cfg_t)
+    assert e.value.code == "draft_manifest_stale"
+
+
+def test_distill_workload_smoke(tmp_path):
+    """The CLI workload end-to-end at toy scale: loss moves, the sealed
+    draft loads back and is compatible with the teacher preset."""
+    from frameworks.jax import worker
+
+    args = worker.build_parser().parse_args(
+        ["distill", "--preset", "tiny", "--steps", "3", "--batch", "2",
+         "--seq", "32", "--draft-layers", "1",
+         "--out", str(tmp_path / "ckpt")])
+    result = worker.run_distill(args)
+    assert result["loss_final"] < result["loss_first"]
+    cfg_d, _, meta = speculative.load_draft(result["draft_dir"],
+                                            llama.LlamaConfig.tiny())
+    assert cfg_d.n_layers == 1

@@ -1,0 +1,245 @@
+"""Speculative decoding on the block-paged engine
+(``models/serving.py:PagedServer.arm_draft``): a draft-armed engine is
+an ACCELERATOR, never an author — every stream is token-exact with solo
+greedy decode across dense / int8-KV / tensor-parallel stacks, rejected
+window tails roll back without touching the page ledger, and every way
+a draft can be wrong (vocab, rope, sampling, k, runtime failure)
+degrades to solo decode with a coded refusal instead of crashing or
+corrupting output."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import tests._jax_cpu  # noqa: F401
+
+from dcos_commons_tpu.metrics import MetricsRegistry
+from dcos_commons_tpu.models import llama, serving
+from dcos_commons_tpu.models.ingress import ServingFrontend
+from dcos_commons_tpu.models.speculative import DraftIncompatible
+
+
+def _cfg(**kw):
+    return llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                  attn_impl="dense", **kw)
+
+
+def _solo(cfg, params, prompt, steps, mesh=None):
+    toks = llama.generate_stepwise(cfg, params,
+                                   jnp.asarray([prompt], jnp.int32),
+                                   steps, mesh=mesh)
+    return [int(t) for t in toks[0]]
+
+
+def _prompt(seed, n, vocab):
+    return [int(t) for t in jax.random.randint(
+        jax.random.key(seed), (n,), 0, vocab)]
+
+
+def _reqs(cfg, shapes, base=40):
+    return [{"prompt": _prompt(base + i, n, cfg.vocab_size),
+             "max_new": m, "request_id": i}
+            for i, (n, m) in enumerate(shapes)]
+
+
+def _truncated_draft(cfg, params, layers=1):
+    cfg_d, params_d = llama.truncate_layers(cfg, params, layers)
+    return cfg_d, jax.tree.map(jnp.array, params_d)
+
+
+# ----------------------------------------------------------------- parity
+
+def test_spec_streams_match_solo_decode_self_draft():
+    """Self-draft (draft == target): every proposal verifies, every
+    stream is exact, and the accept counters show full windows."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    reqs = _reqs(cfg, [(8, 6), (5, 9), (12, 4), (20, 7)])
+    want = {r["request_id"]: _solo(cfg, params, r["prompt"],
+                                   r["max_new"]) for r in reqs}
+    engine = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                 prefill_chunk=8)
+    engine.arm_draft(cfg, params, k=4)
+    got = engine.drain([dict(r) for r in reqs], decode_window=4)
+    assert got == want, (got, want)
+    stats = engine.page_stats()["spec"]
+    assert stats["armed"] and stats["windows"] > 0
+    assert stats["accept_rate"] == pytest.approx(1.0)
+    assert engine.ledger_violations() == []
+
+
+def test_spec_streams_match_solo_decode_truncated_draft():
+    """A 1-layer truncated draft proposes mostly-wrong tokens: window
+    tails roll back every step, the emitted streams STILL match solo
+    exactly, and the ledger audits clean after all the rollbacks."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    cfg_d, params_d = _truncated_draft(cfg, params)
+    # base=60 hits an exact bf16 argmax tie at one position, which the
+    # K-wide verify reduction legally breaks the other way (the caveat
+    # models/speculative.py documents) — these prompts are tie-free
+    reqs = _reqs(cfg, [(8, 8), (5, 10), (14, 6)], base=110)
+    want = {r["request_id"]: _solo(cfg, params, r["prompt"],
+                                   r["max_new"]) for r in reqs}
+    engine = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                 prefill_chunk=8)
+    engine.arm_draft(cfg_d, params_d, k=4)
+    got = engine.drain([dict(r) for r in reqs], decode_window=4)
+    assert got == want, (got, want)
+    stats = engine.page_stats()["spec"]
+    assert 0.0 <= stats["accept_rate"] < 1.0
+    assert engine.ledger_violations() == []
+
+
+def test_spec_int8_kv_target_matches_solo():
+    """Spec decode composes with the int8-KV paged stack: the verify
+    gather reads quantized pages while the draft keeps its own fp cache
+    (arm_draft forces kv_quant off on the draft clone)."""
+    cfg = _cfg(kv_quant=True)
+    params = llama.init_params(cfg, jax.random.key(0))
+    cfg_d, params_d = _truncated_draft(cfg, params)
+    reqs = _reqs(cfg, [(8, 6), (6, 8)], base=120)  # tie-free set
+    want = {r["request_id"]: _solo(cfg, params, r["prompt"],
+                                   r["max_new"]) for r in reqs}
+    engine = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                 prefill_chunk=8)
+    engine.arm_draft(cfg_d, params_d, k=3)
+    assert engine._draft[0].kv_quant is False
+    got = engine.drain([dict(r) for r in reqs], decode_window=4)
+    assert got == want, (got, want)
+    assert engine.ledger_violations() == []
+
+
+def test_spec_tp_matches_solo_tp():
+    """Spec decode on a tp=2 mesh: the verify pass runs sharded like
+    every paged dispatch, the (small) draft stays replicated, and the
+    streams equal SOLO decode on the same mesh."""
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    mesh = MeshSpec(tp=2).build(jax.devices()[:2])
+    with mesh:
+        sharded = llama.shard_params(params, mesh, cfg)
+    cfg_d, params_d = _truncated_draft(cfg, params)
+    reqs = _reqs(cfg, [(8, 6), (5, 9)], base=90)
+    want = {r["request_id"]: _solo(cfg, sharded, r["prompt"],
+                                   r["max_new"], mesh=mesh)
+            for r in reqs}
+    engine = serving.PagedServer(cfg, sharded, slots=2, page_size=16,
+                                 prefill_chunk=8, mesh=mesh)
+    engine.arm_draft(cfg_d, params_d, k=3)
+    got = engine.drain([dict(r) for r in reqs], decode_window=4)
+    assert got == want, (got, want)
+    assert engine.ledger_violations() == []
+
+
+def test_spec_with_prefix_sharing_and_reset():
+    """Shared-prefix admissions (COW pages under the verify scatter)
+    stay exact, and reset() rebuilds the draft cache so the next batch
+    is exact again from a cold draft."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    base = _prompt(70, 20, cfg.vocab_size)
+    reqs = [{"prompt": base[:n] + _prompt(71 + i, 4, cfg.vocab_size),
+             "max_new": 6, "request_id": i}
+            for i, n in enumerate([20, 20, 12])]
+    want = {r["request_id"]: _solo(cfg, params, r["prompt"],
+                                   r["max_new"]) for r in reqs}
+    engine = serving.PagedServer(cfg, params, slots=2, page_size=4,
+                                 prefill_chunk=4)
+    engine.arm_draft(cfg, params, k=4)
+    got = engine.drain([dict(r) for r in reqs], decode_window=4)
+    assert got == want, (got, want)
+    engine.reset()
+    assert engine.ledger_violations() == []
+    got2 = engine.drain([dict(r) for r in reqs], decode_window=4)
+    assert got2 == want, (got2, want)
+
+
+# ------------------------------------------------------------------ guards
+
+def test_arm_draft_guards_leave_engine_solo():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    engine = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                 prefill_chunk=8)
+
+    wrong = dataclasses.replace(cfg, vocab_size=cfg.vocab_size * 2)
+    with pytest.raises(DraftIncompatible) as e:
+        engine.arm_draft(wrong, params, k=4)
+    assert e.value.code == "draft_vocab_mismatch"
+
+    wrong = dataclasses.replace(cfg, rope_theta=1234.5)
+    with pytest.raises(DraftIncompatible) as e:
+        engine.arm_draft(wrong, params, k=4)
+    assert e.value.code == "draft_rope_mismatch"
+
+    with pytest.raises(DraftIncompatible) as e:
+        engine.arm_draft(cfg, params, k=1)
+    assert e.value.code == "draft_k"
+
+    assert engine._draft is None
+    # the refused engine still serves — solo
+    reqs = _reqs(cfg, [(6, 5)], base=99)
+    want = {0: _solo(cfg, params, reqs[0]["prompt"], 5)}
+    assert engine.drain([dict(r) for r in reqs]) == want
+
+
+def test_arm_draft_rejects_sampled_engine():
+    """Greedy-only: the acceptance rule IS greedy agreement, so a
+    sampling engine must refuse the arm rather than silently change its
+    distribution."""
+    from dcos_commons_tpu.ops import sampling
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    engine = serving.PagedServer(
+        cfg, params, slots=2, page_size=16, prefill_chunk=8,
+        sampler=sampling.make_sampler(temperature=1.0, top_k=8),
+        key=jax.random.key(7))
+    with pytest.raises(DraftIncompatible) as e:
+        engine.arm_draft(cfg, params, k=4)
+    assert e.value.code == "draft_sampled_engine"
+    assert engine._draft is None
+
+
+def test_disarm_returns_to_solo_path():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    engine = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                 prefill_chunk=8)
+    engine.arm_draft(cfg, params, k=4)
+    reqs = _reqs(cfg, [(8, 6), (5, 7)], base=30)
+    want = {r["request_id"]: _solo(cfg, params, r["prompt"],
+                                   r["max_new"]) for r in reqs}
+    assert engine.drain([dict(r) for r in reqs],
+                        decode_window=4) == want
+    engine.disarm_draft()
+    assert engine._draft is None and engine._spec_x is None
+    assert engine.drain([dict(r) for r in reqs],
+                        decode_window=4) == want
+    assert engine.ledger_violations() == []
+
+
+# ------------------------------------------------------------ observability
+
+def test_frontend_exports_spec_gauges():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.key(0))
+    registry = MetricsRegistry()
+    engine = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                 prefill_chunk=8)
+    engine.arm_draft(cfg, params, k=4, metrics=registry)
+    engine.drain([dict(r) for r in _reqs(cfg, [(8, 6), (5, 7)])],
+                 decode_window=4)
+    fe = ServingFrontend(engine, port=0, host="127.0.0.1",
+                         metrics=registry)
+    g = fe.load_gauges()
+    assert g["spec_windows"] > 0
+    assert g["spec_proposed"] >= g["spec_accepted"] > 0
+    assert g["spec_accept_rate"] == pytest.approx(1.0)
+    assert g["spec_fallbacks"] == 0
+    snap = registry.to_dict()
+    assert snap["counters"]["serving.spec.windows"] > 0
+    assert "serving.spec.window_seconds" in snap["timers"]

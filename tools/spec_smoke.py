@@ -1,0 +1,181 @@
+"""Time-capped speculative-decoding smoke for CI: distill a draft from
+the serving target for a handful of steps, seal and reload it through
+the artifact seam, arm it on a paged engine, and fail the build on the
+first token that diverges from solo greedy decode — plus the degrade
+paths (stale seal, vocab mismatch) that must refuse with coded errors
+instead of crashing.
+
+The full accept-rate and tok/s receipts live in
+``tools/bench_spec_paged.py``; this is the always-on slice test.sh runs
+next to the other smokes. Checks run in a fixed order and stop (skip,
+not fail) when the time budget runs out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget-s", type=float, default=120.0,
+                    help="wall-clock cap; tail checks are skipped, not "
+                         "failed, when it runs out (default 120)")
+    args = ap.parse_args(argv)
+    deadline = time.monotonic() + args.budget_s
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama, serving, speculative
+    from dcos_commons_tpu.ops import losses
+
+    cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                 attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.key(0))
+
+    def solo(prompt, steps):
+        toks = llama.generate_stepwise(
+            cfg, params, jnp.asarray([prompt], jnp.int32), steps)
+        return [int(t) for t in toks[0]]
+
+    def rand_prompt(seed, n):
+        return [int(t) for t in jax.random.randint(
+            jax.random.key(seed), (n,), 0, cfg.vocab_size)]
+
+    ran = 0
+
+    def _spent(name: str) -> bool:
+        if time.monotonic() >= deadline:
+            print(f"spec-smoke: time budget exhausted after {ran} "
+                  f"checks; {name!r} and later checks skipped")
+            return True
+        return False
+
+    # 1. distill -> seal -> reload -> arm -> token-exact drain: the
+    # whole pipeline in one process. A few SGD steps must MOVE the loss
+    # (the head is wired to the draft), the artifact must survive its
+    # own seal checks, and the armed engine must emit exactly the solo
+    # greedy streams while accepting at least some proposals.
+    if _spent("distill-arm-parity"):
+        return 0
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg_d, params_d = llama.truncate_layers(cfg, params, 1)
+        params_d = jax.tree.map(jnp.array, params_d)
+        toks = jax.random.randint(jax.random.key(1), (2, 32), 0,
+                                  cfg.vocab_size)
+
+        def loss_fn(p_d):
+            x_t = jax.lax.stop_gradient(
+                llama.forward(cfg, params, toks, return_hidden=True))
+            x_s = llama.forward(cfg_d, p_d, toks, return_hidden=True)
+            return losses.fused_linear_distillation(
+                x_s, p_d["lm_head"], x_t, params["lm_head"],
+                block_size=16)
+
+        step = jax.jit(jax.value_and_grad(loss_fn))
+        first = last = None
+        for _ in range(4):
+            loss, grads = step(params_d)
+            last = float(loss)
+            first = first if first is not None else last
+            params_d = jax.tree.map(lambda p, g: p - 0.05 * g,
+                                    params_d, grads)
+        if not last < first:
+            print(f"spec-smoke FAILED: distill loss did not move "
+                  f"({first} -> {last})", file=sys.stderr)
+            return 1
+
+        out = os.path.join(tmp, "draft")
+        speculative.save_draft(out, 4, cfg_d, params_d, cfg)
+        cfg_l, params_l, _ = speculative.load_draft(out, cfg)
+
+        reqs = [{"prompt": rand_prompt(110 + i, n), "max_new": m,
+                 "request_id": i}
+                for i, (n, m) in enumerate([(8, 8), (5, 10), (14, 6)])]
+        want = {r["request_id"]: solo(r["prompt"], r["max_new"])
+                for r in reqs}
+        eng = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                  prefill_chunk=8)
+        eng.arm_draft(cfg_l, params_l, k=4)
+        got = eng.drain([dict(r) for r in reqs], decode_window=4)
+        if got != want:
+            print("spec-smoke FAILED: draft-armed streams diverged "
+                  "from solo greedy", file=sys.stderr)
+            return 1
+        stats = eng.page_stats()["spec"]
+        if not (stats["windows"] > 0 and stats["proposed"] > 0):
+            print(f"spec-smoke FAILED: spec path never ran ({stats})",
+                  file=sys.stderr)
+            return 1
+        if eng.ledger_violations():
+            print("spec-smoke FAILED: ledger violations after spec "
+                  "drain", file=sys.stderr)
+            return 1
+
+        # 2. stale-seal refusal: weights overwritten after sealing must
+        # refuse with the coded error, not arm silently
+        if _spent("stale-seal"):
+            return 0
+        side = os.path.join(out, "draft_config.json")
+        meta = json.loads(open(side).read())
+        meta["manifest_digest"] = "0" * len(meta["manifest_digest"])
+        with open(side, "w") as f:
+            json.dump(meta, f)
+        try:
+            speculative.load_draft(out, cfg)
+        except speculative.DraftIncompatible as e:
+            if e.code != "draft_manifest_stale":
+                print(f"spec-smoke FAILED: stale seal raised "
+                      f"{e.code!r}", file=sys.stderr)
+                return 1
+        else:
+            print("spec-smoke FAILED: tampered seal loaded",
+                  file=sys.stderr)
+            return 1
+        ran += 1  # counts the stale-seal check
+    ran += 1
+
+    # 3. degrade-not-crash: an incompatible draft leaves the engine
+    # serving SOLO, token-exact
+    if _spent("solo-fallback"):
+        return 0
+    eng = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                              prefill_chunk=8)
+    wrong = dataclasses.replace(cfg, vocab_size=cfg.vocab_size * 2)
+    try:
+        eng.arm_draft(wrong, params, k=4)
+    except speculative.DraftIncompatible as e:
+        if e.code != "draft_vocab_mismatch":
+            print(f"spec-smoke FAILED: vocab mismatch raised "
+                  f"{e.code!r}", file=sys.stderr)
+            return 1
+    else:
+        print("spec-smoke FAILED: vocab-mismatched draft armed",
+              file=sys.stderr)
+        return 1
+    prompt = rand_prompt(120, 8)
+    if (eng._draft is not None
+            or eng.drain([{"prompt": prompt, "max_new": 6,
+                           "request_id": "solo"}])["solo"]
+            != solo(prompt, 6)):
+        print("spec-smoke FAILED: refused arm did not degrade to "
+              "clean solo serving", file=sys.stderr)
+        return 1
+    ran += 1
+
+    print(f"spec-smoke: {ran} checks passed — distilled draft arms and "
+          f"stays token-exact with solo greedy, stale seals and "
+          f"incompatible drafts refuse with coded errors, the engine "
+          f"degrades to solo instead of crashing")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
