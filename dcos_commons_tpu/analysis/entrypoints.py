@@ -338,6 +338,89 @@ register_hot_path(HotPath(
     else "jax.shard_map unavailable in this jax build"))
 
 
+def _trace_moe_decode_paged():
+    from ..models import llama
+    from ..parallel.mesh import MeshSpec
+    from ..parallel.moe import MoEConfig, dropless
+    mesh = MeshSpec(ep=2).build(jax.devices()[:2])
+    cfg = llama.LlamaConfig.tiny(n_layers=2, ffn_dim=_MOE_F)
+    moe = dropless(MoEConfig(_MOE_E))
+    ffn = llama.make_moe_ffn(cfg, moe, mesh)
+    slots, page_size = _MOE_G, 16
+    per_stream = cfg.max_seq // page_size
+    params = _abstract_params(
+        lambda: llama.init_moe_params(cfg, _MOE_E, jax.random.key(0)))
+    pool = _abstract_params(
+        lambda: llama.init_page_pool(cfg, slots * per_stream + 1,
+                                     page_size))
+    table = jax.ShapeDtypeStruct((slots, per_stream), jnp.int32)
+    lengths = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+
+    def step(p, pl, tbl, ln, tok):
+        return llama.decode_step_paged(cfg, p, pl, tbl, ln, tok,
+                                       mesh=mesh, ffn_override=ffn)
+
+    return jax.make_jaxpr(step)(params, pool, table, lengths, tokens)
+
+
+def _trace_prefill_ring():
+    from ..models import llama
+    from ..parallel.mesh import MeshSpec
+    mesh = MeshSpec(sp=2).build(jax.devices()[:2])
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    params = _abstract_params(
+        lambda: llama.init_params(cfg, jax.random.key(0)))
+    toks = jax.ShapeDtypeStruct((1, cfg.max_seq), jnp.int32)
+
+    def pre(p, t):
+        return llama.prefill_ring(cfg, p, t, mesh)
+
+    return jax.make_jaxpr(pre)(params, toks)
+
+
+# MoE decode budget: the banned materialization is the DENSE routing
+# intermediate — running every token through every expert at fp32,
+# [tokens, experts, d_ff] x 4 bytes. The legitimate path is
+# capacity-bounded ([E, C, D] on the all-to-all wire, model-dtype expert
+# matmuls; J1 only meters fp32, so the bf16 dispatch tensors are free by
+# construction and the fp32 avals that remain are the router gates
+# [G, E], the serving logits [G, V] and the paged-attention scores —
+# all far below the dense blow-up at these shapes (the largest, the
+# paged-attention fp32 accumulator at [G, S, H, hd], is half the
+# budget). The budget sits one byte under the dense tensor: capacity
+# bounding cannot trip, a dense fp32 fallback always does.
+_MOE_G, _MOE_E, _MOE_F = 4, 8, 2048
+_MOE_DENSE = _MOE_G * _MOE_E * _MOE_F * 4
+register_hot_path(HotPath(
+    "llama_moe_decode_step_paged", _trace_moe_decode_paged,
+    budget_bytes=_MOE_DENSE - 1, devices_needed=2,
+    description="decode_step_paged with the MoE ffn_override: paged "
+                "attention unchanged + top-2 expert dispatch under "
+                "shard_map on an ep=2 mesh (the two tiled all_to_all "
+                "reshards are the expected collectives; routing "
+                "intermediates stay capacity-bounded, never "
+                "[tokens, experts, d_ff] fp32)",
+    requires=lambda: None if hasattr(jax, "shard_map")
+    else "jax.shard_map unavailable in this jax build"))
+# Ring-prefill budget: the per-chunk fp32 score tile is
+# [B, H, S/ring, S/ring] (the online-softmax window); a full causal
+# [B, H, S, S] fp32 score materialization is ring**2 = 4x bigger. The
+# budget sits at 2x the tile — chunked scores pass with headroom, a
+# de-ringed full-sequence softmax trips J1.
+_RING_TILE = 1 * 8 * 64 * 64 * 4
+register_hot_path(HotPath(
+    "llama_prefill_ring", _trace_prefill_ring,
+    budget_bytes=2 * _RING_TILE, devices_needed=2,
+    description="prefill_ring, the one-tick sequence-parallel serving "
+                "prefill: full-prompt forward with ring attention over "
+                "the sp axis (ppermute is the expected collective), "
+                "returning final-norm hidden states + per-layer K/V for "
+                "page-aligned install into the local pool",
+    requires=lambda: None if hasattr(jax, "shard_map")
+    else "jax.shard_map unavailable in this jax build"))
+
+
 # ---------------------------------------------------------------------------
 # manifest + engine
 

@@ -49,8 +49,10 @@ J4 = REGISTRY.register(Rule(
     "remove debug/pure/io callbacks from the step function; log outside "
     "the jit boundary"))
 
-#: collective primitives the census counts (order = report order)
-COLLECTIVE_PRIMS = ("psum", "all_gather", "ppermute", "reduce_scatter")
+#: collective primitives the census counts (order = report order);
+#: all_to_all joined in round 18 for the MoE expert-dispatch reshards
+COLLECTIVE_PRIMS = ("psum", "all_gather", "ppermute", "reduce_scatter",
+                    "all_to_all")
 _CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback")
 
 

@@ -141,6 +141,21 @@ class FaultConfig:
     # unperturbed.
     draft_stale: float = 0.0
     draft_corrupt: float = 0.0
+    # serving-arithmetic faults (soak harness page-ledger sim,
+    # models/serving.py MoE-ffn / _ring_prefill seams): a non-dropless
+    # capacity factor sneaks under an expert-parallel engine and a
+    # routed token would overflow its expert's buffer — the capacity
+    # audit must trip BEFORE emit and degrade dispatch to the
+    # bitwise-equal local path, so output stays token-exact with the
+    # dense reference (expert_overflow); a gang rank stalls inside the
+    # one-tick ring prefill collective — the engine must catch the
+    # dispatch failure and degrade that prompt to chunked prefill with
+    # a coded longctx fallback, never drop the stream or emit a
+    # different first token (ring_prefill_stall). Both draw from a
+    # derived RNG private to the arith sim, so legacy pinned seeds
+    # replay unperturbed.
+    expert_overflow: float = 0.0
+    ring_prefill_stall: float = 0.0
     max_delay_ticks: int = 3
 
     FIELDS = ("status_drop", "status_delay", "status_dup", "status_reorder",
@@ -151,7 +166,8 @@ class FaultConfig:
               "router_replica_down", "tenant_flood",
               "warm_promote_crash", "weight_fetch_lost",
               "migrate_mid_stream", "kv_tier_corrupt",
-              "promote_during_evict", "draft_stale", "draft_corrupt")
+              "promote_during_evict", "draft_stale", "draft_corrupt",
+              "expert_overflow", "ring_prefill_stall")
 
     @classmethod
     def none(cls) -> "FaultConfig":
@@ -186,7 +202,8 @@ class FaultConfig:
                        warm_promote_crash=0.0, weight_fetch_lost=0.0,
                        migrate_mid_stream=0.0, kv_tier_corrupt=0.0,
                        promote_during_evict=0.0, draft_stale=0.0,
-                       draft_corrupt=0.0)
+                       draft_corrupt=0.0, expert_overflow=0.0,
+                       ring_prefill_stall=0.0)
 
 
 def parse_faults(arg: str) -> FaultConfig:

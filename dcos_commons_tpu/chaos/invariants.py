@@ -49,6 +49,17 @@ to a real reference-era incident class:
     drops it. Every stale hit must be matched by exactly one solo
     fallback, or the degrade path either missed a failure or fired
     spuriously.
+19. **serving-arithmetic exactness** — the round-18 arithmetic is an
+    accelerator, never an author: every token a routed (MoE) stream
+    emits must equal the dense reference (dropless capacity makes
+    routing grouping-free; a capacity overflow must trip the audit and
+    degrade dispatch to the bitwise-equal local path BEFORE emit,
+    never drop a share), every ring-prefilled prompt must produce the
+    single-host first token (a stalled rank degrades the prompt to
+    chunked prefill with a coded fallback, never a dropped stream),
+    and every injected fault is accounted: each ring stall maps to
+    exactly one chunked fallback, each overflow injection is either
+    covered by the audit or provably idle.
 """
 
 from __future__ import annotations
@@ -89,6 +100,7 @@ class InvariantChecker:
         out += self._check_kv_ship(tick)
         out += self._check_kv_tier(tick)
         out += self._check_spec_decode(tick)
+        out += self._check_serving_arith(tick)
         return out
 
     def _check_unique_live_tasks(self, tick: int) -> List[Violation]:
@@ -267,6 +279,50 @@ class InvariantChecker:
                     f"{sim.spec_solo_fallbacks} solo fallbacks taken — "
                     "the degrade path missed a failure or fired "
                     "spuriously", tick))
+        return out
+
+    def _check_serving_arith(self, tick: int) -> List[Violation]:
+        """Audit the round-18 serving arithmetic (``models/serving.py``
+        MoE ffn_override / _ring_prefill seams): routed decode and ring
+        prefill are token-exact with the dense/single-host reference,
+        faults degrade with coded fallbacks instead of dropping
+        streams, and every injection is accounted for."""
+        out = []
+        for sim in getattr(self._runner, "page_sims", ()):
+            if not getattr(sim, "arith_checked", 0) and \
+                    not getattr(sim, "ring_fallbacks", 0):
+                continue
+            if sim.arith_mismatches:
+                out.append(Violation(
+                    "arith-token-exact",
+                    f"{sim.arith_mismatches} of {sim.arith_checked} "
+                    "routed/ring-prefilled tokens diverged from the "
+                    "dense reference (an overflowed dispatch or a "
+                    "de-ringed prefill authored output)", tick))
+            if sim.arith_dropped:
+                out.append(Violation(
+                    "arith-degrade-not-drop",
+                    f"{sim.arith_dropped} streams vanished during a "
+                    "routed decode step — arithmetic faults must "
+                    "degrade to the local/chunked path, never drop "
+                    "the stream", tick))
+            if sim.ring_fallbacks != sim.ring_stall_injected:
+                out.append(Violation(
+                    "longctx-fallback-accounting",
+                    f"{sim.ring_stall_injected} ring stalls injected != "
+                    f"{sim.ring_fallbacks} chunked fallbacks taken — "
+                    "the degrade path missed a stall or fired "
+                    "spuriously", tick))
+            open_now = 1 if getattr(sim, "_overflow_open", False) else 0
+            if sim.moe_overflow_covered + sim.moe_overflow_idle \
+                    + open_now != sim.moe_overflow_injected:
+                out.append(Violation(
+                    "moe-overflow-accounting",
+                    f"{sim.moe_overflow_injected} overflow injections != "
+                    f"{sim.moe_overflow_covered} audit-covered + "
+                    f"{sim.moe_overflow_idle} idle (+{open_now} open) — "
+                    "an overflow window escaped the capacity audit",
+                    tick))
         return out
 
     def _check_backoff_monotone(self, tick: int) -> List[Violation]:

@@ -158,6 +158,37 @@ class _PageServingSim:
         self.spec_stale_injected = 0
         self.spec_corrupt_injected = 0
         self.spec_solo_fallbacks = 0
+        # round-18 serving-arithmetic weather (models/serving.py MoE
+        # ffn_override / _ring_prefill seams) on its OWN derived rng:
+        # every routed decode token is re-derived through the dispatch
+        # discipline (capacity audit -> routed or bitwise-equal local
+        # path) and every long prompt through the ring-or-chunked
+        # prefill discipline, then audited against the dense/single-
+        # host reference (invariant 19) — an expert-buffer overflow
+        # degrades dispatch to the local path, a stalled ring rank
+        # degrades the prompt to chunked prefill, and neither may ever
+        # drop a stream or shift a token. No-draw when disarmed, so
+        # legacy pinned seeds replay bitwise.
+        self.arith_rng = random.Random((seed << 30) ^ 0xBF58476D1CE4E5B9)
+        self.arith_active = False
+        self.moe_experts = 4
+        self.moe_factor = 4.0          # dropless: factor == experts
+        self.arith_pos: Dict[int, int] = {}   # sid -> routed tokens emitted
+        self.ring_pending: Dict[int, int] = {}  # sid -> chunked done tick
+        self.arith_checked = 0
+        self.arith_mismatches = 0
+        self.arith_dropped = 0
+        self.moe_overflow_injected = 0
+        # every injection must end up exactly one of: covered (the
+        # capacity audit fired on a live decode step) or idle (no
+        # stream decoded under the bad factor before the fix landed)
+        self.moe_overflow_covered = 0
+        self.moe_overflow_idle = 0
+        self._overflow_open = False
+        self.moe_fallbacks = 0
+        self.arith_ring_prefills = 0
+        self.ring_stall_injected = 0
+        self.ring_fallbacks = 0
 
     def expected_refs(self) -> Dict[int, int]:
         out: Dict[int, int] = {}
@@ -479,6 +510,114 @@ class _PageServingSim:
         self.spec_pos = {s: p for s, p in self.spec_pos.items()
                          if s in self.streams}
 
+    def _arith_ref(self, sid: int, i: int) -> int:
+        """Position ``i`` of stream ``sid``'s dense/single-host reference
+        sequence — what routed decode and ring prefill must reproduce."""
+        return (sid * 2246822519 + i * 3266489917) % 97
+
+    def _moe_route(self, sid: int, pos: int) -> int:
+        """The routed-dispatch discipline, mirrored: the token's two
+        expert contributions recombine to the dense value only while
+        the capacity bound holds for BOTH (dropless: factor == experts
+        makes capacity(n) == n, so nothing can overflow). A factor
+        below that drops the second expert's share — visible output
+        corruption that the engine's capacity audit must stop before
+        emit by degrading to the local path."""
+        ref = self._arith_ref(sid, pos)
+        if self.moe_factor >= self.moe_experts:
+            return ref                      # dropless: grouping-free
+        return (ref + 1) % 97               # overflow dropped a share
+
+    def arith_tick(self, tick: int, overflow_p: float, stall_p: float,
+                   count, log) -> None:
+        """Round-18 serving-arithmetic weather over the live streams
+        (``models/serving.py`` MoE ffn_override / _ring_prefill
+        seams), discipline-not-arrays like :meth:`spec_tick`. Long
+        prompts prefill via the one-tick ring path unless a gang rank
+        stalls (``ring_prefill_stall``) — then the engine's dispatch
+        try/except degrades the prompt to chunked prefill, landing a
+        tick or two later with the SAME first token and a coded
+        fallback, never a dropped stream. Decode then emits through
+        the routed-dispatch audit: ``expert_overflow`` slips a
+        non-dropless capacity factor under the engine, and the audit
+        must degrade dispatch to the bitwise-equal local path before
+        any overflowed token reaches emit (invariant 19's token-exact
+        audit). No-draw when disarmed, so legacy pinned corpus seeds
+        replay bitwise."""
+        armed = bool(overflow_p or stall_p)
+        self.arith_active = self.arith_active or armed
+        if not self.arith_active:
+            return
+        rng = self.arith_rng
+        # the operator ships a fixed capacity factor: dispatch re-arms
+        if self.moe_factor < self.moe_experts:
+            self.moe_factor = float(self.moe_experts)
+            if self._overflow_open:     # nothing decoded under the bug
+                self.moe_overflow_idle += 1
+                self._overflow_open = False
+            log(f"tick {tick}: moe dispatch re-armed (dropless factor "
+                "restored)")
+        if overflow_p and rng.random() < overflow_p:
+            # a non-dropless factor sneaks under the engine this window
+            self.moe_factor = 2.0
+            self.moe_overflow_injected += 1
+            self._overflow_open = True
+            count("expert_overflow")
+            log(f"tick {tick}: expert_overflow — capacity factor "
+                f"{self.moe_factor} < {self.moe_experts} experts")
+        # chunked-prefill fallbacks land (possibly finding their stream
+        # retired/aborted meanwhile — that is the ledger's business, not
+        # a drop; a drop is the engine losing a stream it still owns)
+        for sid in [s for s in sorted(self.ring_pending)
+                    if self.ring_pending[s] <= tick]:
+            del self.ring_pending[sid]
+            if sid in self.streams:
+                self.arith_pos[sid] = 0
+        # new streams hit the prefill fork: ring (one tick) or, when a
+        # rank stalls mid-collective, the chunked fallback
+        for sid in sorted(self.streams):
+            if sid in self.arith_pos or sid in self.ring_pending:
+                continue
+            if stall_p and rng.random() < stall_p:
+                self.ring_stall_injected += 1
+                self.ring_fallbacks += 1
+                self.ring_pending[sid] = tick + rng.randint(1, 2)
+                count("ring_prefill_stall")
+                log(f"tick {tick}: ring_prefill_stall stream {sid} — "
+                    "chunked fallback "
+                    f"(lands @{self.ring_pending[sid]})")
+            else:
+                self.arith_ring_prefills += 1
+                self.arith_pos[sid] = 0
+        # routed decode: one token per prefilled live stream, through
+        # the engine's capacity audit
+        for sid in sorted(self.streams):
+            pos = self.arith_pos.get(sid)
+            if pos is None:
+                continue
+            if self.moe_factor < self.moe_experts:
+                # capacity audit trips: local-path fallback this step
+                emitted = self._arith_ref(sid, pos)
+                self.moe_fallbacks += 1
+                if self._overflow_open:
+                    self.moe_overflow_covered += 1
+                    self._overflow_open = False
+            else:
+                emitted = self._moe_route(sid, pos)
+            self.arith_checked += 1
+            if emitted != self._arith_ref(sid, pos):
+                self.arith_mismatches += 1
+                log(f"tick {tick}: ARITH MISMATCH stream {sid} at "
+                    f"{pos}: {emitted} != {self._arith_ref(sid, pos)}")
+            if sid not in self.streams:
+                self.arith_dropped += 1
+            self.arith_pos[sid] = pos + 1
+        # positions of retired/aborted streams fall away with them
+        self.arith_pos = {s: p for s, p in self.arith_pos.items()
+                          if s in self.streams}
+        self.ring_pending = {s: t for s, t in self.ring_pending.items()
+                             if s in self.streams}
+
 
 @dataclass
 class SoakReport:
@@ -674,6 +813,9 @@ class _Soak:
             self.page_sim.spec_tick(tick, self.config.draft_stale,
                                     self.config.draft_corrupt,
                                     self._count, self._log)
+            self.page_sim.arith_tick(tick, self.config.expert_overflow,
+                                     self.config.ring_prefill_stall,
+                                     self._count, self._log)
             # release the transport's due events first so zombies from
             # late launches are visible to this tick's reconciliation
             self.chaos.tick()
@@ -692,6 +834,8 @@ class _Soak:
             self.page_sim.ship_tick(tick, 0.0, 0.0, self._count, self._log)
             self.page_sim.tier_tick(tick, 0.0, 0.0, self._count, self._log)
             self.page_sim.spec_tick(tick, 0.0, 0.0, self._count, self._log)
+            self.page_sim.arith_tick(tick, 0.0, 0.0, self._count,
+                                     self._log)
             self.chaos.tick()
             self._cycle()
             self._check(tick)

@@ -554,6 +554,105 @@ def loss_fn_moe(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     return loss + aux_weight * aux, metric
 
 
+def make_moe_ffn(cfg: LlamaConfig, moe_cfg,
+                 mesh: Optional[Mesh] = None) -> Callable:
+    """Build the ``ffn_override`` that routes :func:`_decode_body`'s FFN
+    step through ``parallel.moe`` — the MoE serving hook.
+
+    On a mesh with an ``ep`` axis > 1 the layer runs
+    :func:`~dcos_commons_tpu.parallel.moe.moe_apply` under shard_map:
+    each shard computes only its E/ep experts' FLOPs and the two
+    ``all_to_all`` collectives carry the capacity-bounded [E, C, D]
+    dispatch buffers (the analysis hot path budget). Anywhere else
+    (single host, decode smoke, the parity reference) it runs
+    :func:`~dcos_commons_tpu.parallel.moe.moe_apply_local` — the same
+    contractions expert-by-expert, so both paths agree bitwise for the
+    same token group. The auxiliary load-balance loss is dead weight at
+    inference and is dropped."""
+    from dcos_commons_tpu.parallel import moe as _moe
+
+    if mesh is not None and mesh.shape.get("ep", 1) > 1:
+        if moe_cfg.num_experts % mesh.shape["ep"]:
+            raise ValueError(
+                f"num_experts={moe_cfg.num_experts} not divisible by "
+                f"ep={mesh.shape['ep']}")
+
+        def inner(flat, rw, wi, wo):
+            out, _ = _moe.moe_apply(flat, rw, wi, wo, moe_cfg)
+            return out
+
+        apply = jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(), P(), P("ep"), P("ep")),
+            out_specs=P(), check_vma=False)
+    else:
+        def apply(flat, rw, wi, wo):
+            out, _ = _moe.moe_apply_local(flat, rw, wi, wo, moe_cfg)
+            return out
+
+    def ffn(x: jnp.ndarray, lp: Params) -> jnp.ndarray:
+        b, s, d = x.shape
+        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        out = apply(h.reshape(b * s, d), lp["router"],
+                    lp["w_in"], lp["w_out"])
+        return x + out.reshape(b, s, d).astype(cfg.dtype)
+
+    return ffn
+
+
+_MOE_STEPWISE_CACHE: dict = {}
+
+
+def _moe_stepwise_executables(cfg: LlamaConfig, moe_cfg,
+                              mesh: Optional[Mesh]):
+    """Jitted MoE prefill/decode-step callables, cached per
+    (cfg, moe_cfg, mesh) like :func:`_stepwise_executables`."""
+    key = (cfg, moe_cfg, mesh)
+    hit = _MOE_STEPWISE_CACHE.get(key)
+    if hit is None:
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+        ffn = make_moe_ffn(cfg, moe_cfg, mesh)
+        hit = (
+            jax.jit(lambda p, c, pr: extend_step(
+                cfg, p, c, pr, jnp.int32(0), rope=rope,
+                ffn_override=ffn)),
+            jax.jit(lambda p, c, pos, tok: decode_step(
+                cfg, p, c, pos, tok, mesh, rope=rope,
+                ffn_override=ffn)),
+        )
+        _MOE_STEPWISE_CACHE[key] = hit
+    return hit
+
+
+def generate_stepwise_moe(cfg: LlamaConfig, params: Params,
+                          prompt: jnp.ndarray, steps: int, moe_cfg,
+                          mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """Greedy MoE generation — the serving parity REFERENCE (what
+    :func:`generate_stepwise` is to the dense paged engine).
+
+    Whole-prompt prefill via :func:`extend_step` + one stepwise decode
+    executable, both with the :func:`make_moe_ffn` override. Note the
+    grouping contract: the paged engine routes each prefill chunk /
+    decode batch as its own dispatch group, this reference routes the
+    whole prompt then one token at a time — the two agree token-exactly
+    ONLY under dropless capacity (``parallel.moe.dropless``), where
+    per-token routing is independent of the token grouping."""
+    b, s = prompt.shape
+    _check_capacity(cfg, s, steps)
+    cache = init_kv_cache(cfg, b, cfg.max_seq)
+    prefill_x, step_x = _moe_stepwise_executables(cfg, moe_cfg, mesh)
+    logits, cache = prefill_x(params, cache, prompt)
+    logits = logits[:, -1]             # extend_step returns every position
+    toks = []
+    for i in range(steps):
+        tok = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        logits, cache = step_x(params, cache, jnp.int32(s + i), tok)
+        toks.append(tok)
+    if not toks:
+        return jnp.zeros((b, 0), prompt.dtype)
+    return jnp.stack(toks, axis=1)                         # [B, steps]
+
+
 def loss_fn(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
             mesh: Optional[Mesh] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Next-token LM loss over tokens [B, S] -> (loss, accuracy).
@@ -681,7 +780,8 @@ def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
                  kv_len, causal: bool = False, q_offset=0,
                  all_positions: bool = False,
                  mesh: Optional[Mesh] = None,
-                 attn_override=None, logit_index=None
+                 attn_override=None, logit_index=None,
+                 ffn_override=None
                  ) -> Tuple[jnp.ndarray, Params]:
     """The cache-consuming forward shared by :func:`decode_step` (one
     scalar position), :func:`decode_step_slots` (per-slot positions),
@@ -700,6 +800,9 @@ def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
     paged pallas kernel — the cache layout is theirs to interpret);
     ``logit_index`` takes logits at a DYNAMIC position instead of the
     last (a padded prefill chunk's last live token).
+    ``ffn_override(x, lp) -> x`` replaces the whole pre-norm FFN
+    residual step (the MoE serving path routes through
+    ``parallel.moe`` here); None keeps the dense SwiGLU bitwise.
     """
     b, s = tokens.shape
     x = qtake(params["embed"], tokens, cfg.dtype)              # [B, S, D]
@@ -735,10 +838,13 @@ def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
             o = gqa_attention(q, k_read, v_read, causal=causal,
                               q_offset=q_offset, kv_len=kv_len)
         x = x + qmm(o.reshape(b, s, -1), lp["wo"])
-        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(qmm(h, lp["w_gate"]).astype(jnp.float32))
-        up = qmm(h, lp["w_up"]).astype(jnp.float32)
-        x = x + qmm((gate * up).astype(cfg.dtype), lp["w_down"])
+        if ffn_override is not None:
+            x = ffn_override(x, lp)
+        else:
+            h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+            gate = jax.nn.silu(qmm(h, lp["w_gate"]).astype(jnp.float32))
+            up = qmm(h, lp["w_up"]).astype(jnp.float32)
+            x = x + qmm((gate * up).astype(cfg.dtype), lp["w_down"])
         return (x, layer_idx + 1), (k_cache, v_cache)
 
     (x, _), (k_new, v_new) = lax.scan(
@@ -758,7 +864,8 @@ def _decode_body(cfg: LlamaConfig, params: Params, cache: Params,
 def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
                 pos: jnp.ndarray, token: jnp.ndarray,
                 mesh: Optional[Mesh] = None,
-                rope: Optional[jnp.ndarray] = None
+                rope: Optional[jnp.ndarray] = None,
+                ffn_override=None
                 ) -> Tuple[jnp.ndarray, Params]:
     """One greedy-decode step.
 
@@ -777,12 +884,13 @@ def decode_step(cfg: LlamaConfig, params: Params, cache: Params,
         rope_fn=lambda t: apply_rope(t, rope, pos),
         cache_write=lambda c, new: _cache_update(c, new, pos, 1,
                                                  cfg.dtype),
-        kv_len=pos + 1, mesh=mesh)
+        kv_len=pos + 1, mesh=mesh, ffn_override=ffn_override)
 
 
 def extend_step(cfg: LlamaConfig, params: Params, cache: Params,
                 tokens: jnp.ndarray, pos: jnp.ndarray,
-                rope: Optional[jnp.ndarray] = None
+                rope: Optional[jnp.ndarray] = None,
+                ffn_override=None
                 ) -> Tuple[jnp.ndarray, Params]:
     """Consume K tokens in ONE forward: ``tokens`` [B, K] occupy
     positions ``pos..pos+K-1``; returns (logits [B, K, V] at every
@@ -804,7 +912,8 @@ def extend_step(cfg: LlamaConfig, params: Params, cache: Params,
         rope_fn=lambda t: apply_rope(t, rope, pos),
         cache_write=lambda c, new: _cache_update(c, new, pos, 1,
                                                  cfg.dtype),
-        kv_len=pos + kk, causal=True, q_offset=pos, all_positions=True)
+        kv_len=pos + kk, causal=True, q_offset=pos, all_positions=True,
+        ffn_override=ffn_override)
 
 
 def _cache_update_slots(cache, new: jnp.ndarray, lengths: jnp.ndarray,
@@ -929,7 +1038,8 @@ def _use_flash_decode_paged(cfg: LlamaConfig, mesh: Optional[Mesh],
 def decode_step_paged(cfg: LlamaConfig, params: Params, pool: Params,
                       table: jnp.ndarray, lengths: jnp.ndarray,
                       tokens: jnp.ndarray, mesh: Optional[Mesh] = None,
-                      rope: Optional[jnp.ndarray] = None
+                      rope: Optional[jnp.ndarray] = None,
+                      ffn_override=None
                       ) -> Tuple[jnp.ndarray, Params]:
     """One decode step against the PAGED pool — per-row math identical
     to :func:`decode_step_slots`, only the cache landing differs.
@@ -975,13 +1085,14 @@ def decode_step_paged(cfg: LlamaConfig, params: Params, pool: Params,
         cfg, params, pool, tokens[:, None], False,
         rope_fn=lambda t: apply_rope_at(t, rope, lengths),
         cache_write=cache_write, kv_len=lengths + 1, mesh=mesh,
-        attn_override=attn_override)
+        attn_override=attn_override, ffn_override=ffn_override)
 
 
 def verify_step_paged(cfg: LlamaConfig, params: Params, pool: Params,
                       table: jnp.ndarray, lengths: jnp.ndarray,
                       tokens: jnp.ndarray, mesh: Optional[Mesh] = None,
-                      rope: Optional[jnp.ndarray] = None
+                      rope: Optional[jnp.ndarray] = None,
+                      ffn_override=None
                       ) -> Tuple[jnp.ndarray, Params]:
     """Consume a K-token window PER STREAM against the paged pool — the
     speculative-verify counterpart of :func:`extend_step`, batched over
@@ -1041,7 +1152,8 @@ def verify_step_paged(cfg: LlamaConfig, params: Params, pool: Params,
         cfg, params, pool, tokens, False,
         rope_fn=lambda t: apply_rope_at_many(t, rope, rope_pos),
         cache_write=cache_write, kv_len=lengths + kk, causal=True,
-        mesh=mesh, attn_override=attn_override, all_positions=True)
+        mesh=mesh, attn_override=attn_override, all_positions=True,
+        ffn_override=ffn_override)
 
 
 def prefill_chunk_paged(cfg: LlamaConfig, params: Params, pool: Params,
@@ -1049,7 +1161,8 @@ def prefill_chunk_paged(cfg: LlamaConfig, params: Params, pool: Params,
                         start: jnp.ndarray, true_len: jnp.ndarray,
                         logit_index: jnp.ndarray, scratch_page: int,
                         mesh: Optional[Mesh] = None,
-                        rope: Optional[jnp.ndarray] = None
+                        rope: Optional[jnp.ndarray] = None,
+                        ffn_override=None
                         ) -> Tuple[jnp.ndarray, Params]:
     """One CHUNK of paged prefill for a single stream: ``tokens``
     [1, C] occupy positions ``start..start+C-1``, K/V landing through
@@ -1104,7 +1217,7 @@ def prefill_chunk_paged(cfg: LlamaConfig, params: Params, pool: Params,
         rope_fn=lambda t: apply_rope_positions(t, rope, rope_pos),
         cache_write=cache_write, kv_len=start + c, causal=True,
         q_offset=start, mesh=mesh, attn_override=attn_override,
-        logit_index=logit_index)
+        logit_index=logit_index, ffn_override=ffn_override)
 
 
 def prefill(cfg: LlamaConfig, params: Params, cache: Params,
@@ -1177,6 +1290,58 @@ def prefill_trunk(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,
                                   return_kv=True)
         x = ffn_block(cfg, x, lp)
         return _constrain(x, mesh, "dp", None, None), (k, v)
+
+    x, (ks, vs) = lax.scan(layer, x, params["layers"])
+    return rms_norm(x, params["norm"], cfg.norm_eps), ks, vs
+
+
+def prefill_ring(cfg: LlamaConfig, params: Params, prompt: jnp.ndarray,
+                 mesh: Mesh, rope: Optional[jnp.ndarray] = None,
+                 ffn_override=None
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sequence-parallel prefill: :func:`prefill_trunk`'s contract —
+    (normed hidden [B, S, D], ks/vs [L, B, S, KV, D]) — computed with
+    ``parallel.ring_attention`` over the ``sp`` mesh axis, so each gang
+    member pays ~S/sp of the attention work and a 128k prompt prefills
+    in ~seq/N wall-clock instead of serially on one host.
+
+    The caller pads the prompt to an sp-divisible length
+    (``ring_attention.ring_pad_len``); padded positions compute garbage
+    K/V that the serving engine routes to scratch or never reads. The
+    layout is always ``contiguous`` — K/V must land in natural position
+    order for the page-table install; the zigzag layout's balance win
+    only matters for TRAINING throughput, and its permuted cache order
+    would corrupt the paged decode gather. Requires S <= cfg.max_seq
+    (rope table bound) and a real sp axis; callers degrade to chunked
+    prefill on ValueError (the ``longctx_fallback`` discipline)."""
+    s = prompt.shape[1]
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    if sp <= 1:
+        raise ValueError("prefill_ring needs an 'sp' mesh axis > 1; "
+                         f"got {dict(mesh.shape) if mesh else None}")
+    if s % sp:
+        raise ValueError(
+            f"ring prefill needs S ({s}) % sp ({sp}) == 0; pad the "
+            "prompt with ring_attention.ring_pad_len")
+    if s > cfg.max_seq:
+        raise ValueError(f"padded prompt {s} exceeds max_seq "
+                         f"{cfg.max_seq}")
+    if rope is None:
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    ring = make_ring_attention(mesh, causal=True, layout="contiguous",
+                               spec=P(None, "sp", None, None),
+                               kv_spec=P(None, "sp", None, None))
+    x = qtake(params["embed"], prompt, cfg.dtype)
+    x = _constrain(x, mesh, None, "sp", None)
+
+    def layer(x, lp):
+        x, k, v = attention_block(cfg, x, lp, rope, ring,
+                                  return_kv=True)
+        if ffn_override is not None:
+            x = ffn_override(x, lp)
+        else:
+            x = ffn_block(cfg, x, lp)
+        return _constrain(x, mesh, None, "sp", None), (k, v)
 
     x, (ks, vs) = lax.scan(layer, x, params["layers"])
     return rms_norm(x, params["norm"], cfg.norm_eps), ks, vs

@@ -47,6 +47,7 @@ from dcos_commons_tpu.models.paging import (PagePool, PageTierStore,
                                             chain_keys, page_hashes)
 from dcos_commons_tpu.ops import rope_frequencies
 from dcos_commons_tpu.ops.quant import QTensor, qmm, quantize
+from dcos_commons_tpu.parallel.ring_attention import ring_pad_len
 
 
 @dataclasses.dataclass
@@ -607,7 +608,9 @@ class PagedServer:
                  prefix_cache: bool = True, compile_cache=None,
                  tiers: Optional[PageTierStore] = None,
                  directory: Optional[PrefixDirectory] = None,
-                 replica_id: str = "", peer_fetch=None):
+                 replica_id: str = "", peer_fetch=None,
+                 moe=None, longctx_ring: int = 0,
+                 ring_threshold: Optional[int] = None):
         if page_size < 1 or cfg.max_seq % page_size:
             raise ValueError(
                 f"page_size {page_size} must divide max_seq "
@@ -615,6 +618,51 @@ class PagedServer:
         if prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got "
                              f"{prefill_chunk}")
+        # ------------------------------------------------ MoE decode path
+        # `moe` (a parallel.moe.MoEConfig) swaps every model executable's
+        # FFN for the routed expert layer via llama.make_moe_ffn: on an
+        # `ep` mesh the dispatch all-to-alls carry capacity-bounded
+        # [E, C, D] buffers (the analysis hot-path budget); single-host
+        # engines run the bitwise-equal local path. Paged KV is untouched
+        # — routing happens entirely inside the FFN residual step.
+        if moe is not None and "router" not in params["layers"]:
+            raise ValueError(
+                "moe config given but params carry no router; build "
+                "them with llama.init_moe_params")
+        if moe is None and "router" in params["layers"]:
+            raise ValueError(
+                "params carry a router but no moe config; pass "
+                "moe=MoEConfig(...) so routing is explicit")
+        self.moe = moe
+        self._ffn = (llama.make_moe_ffn(cfg, moe, mesh)
+                     if moe is not None else None)
+        # --------------------------------------- sequence-parallel prefill
+        # `longctx_ring` > 1 arms ring prefill: a prompt at/over
+        # `ring_threshold` tokens prefills in ONE tick via
+        # llama.prefill_ring across the mesh's `sp` axis (~seq/N per-host
+        # time) and its K/V span lands page-aligned in the local pool —
+        # decode gathers stay local. Anything that disqualifies a stream
+        # (resumed prefix, over-long pad, missing axis) degrades to the
+        # chunked path and counts a coded fallback, never drops a stream.
+        self._ring_sp = int(longctx_ring)
+        if self._ring_sp > 1:
+            sp_have = mesh.shape.get("sp", 1) if mesh is not None else 1
+            if sp_have != self._ring_sp:
+                raise ValueError(
+                    f"longctx_ring={longctx_ring} needs a mesh with an "
+                    f"'sp' axis of that size; got "
+                    f"{dict(mesh.shape) if mesh is not None else None}")
+            if cfg.max_seq % self._ring_sp:
+                raise ValueError(
+                    f"longctx_ring={longctx_ring} must divide max_seq "
+                    f"{cfg.max_seq} so padded prompts stay in-table")
+            if cfg.kv_quant:
+                raise ValueError(
+                    "ring prefill installs bf16 K/V spans; kv_quant "
+                    "pools are not supported with longctx_ring")
+        self.ring_threshold = (int(ring_threshold)
+                               if ring_threshold is not None
+                               else 2 * prefill_chunk)
         self.cfg = cfg
         self.params = params
         self.slots = slots                     # concurrent stream cap
@@ -636,10 +684,14 @@ class PagedServer:
         self.scratch = self.total_pages
         self.pool = llama.init_page_pool(cfg, self.total_pages + 1,
                                          page_size)
-        if mesh is not None and mesh.size > 1:
+        if (mesh is not None and mesh.size > 1
+                and mesh.shape.get("tp", 1) > 1):
             # same rank-5 layout as the slot cache (KV heads at axis 3),
             # so the slot engine's placement applies verbatim; the page
-            # axis stays unsharded like the slot axis
+            # axis stays unsharded like the slot axis. ep/sp-only meshes
+            # keep the pool replicated: expert parallelism shards the
+            # FFN weights, ring prefill shards activations — each gang
+            # member's pages are its own local pool
             self.pool = _shard_cache(self.pool, mesh)
         self.ledger = PagePool(self.total_pages, page_size)
         self.radix = PrefixRadix(self.ledger) if prefix_cache else None
@@ -667,10 +719,19 @@ class PagedServer:
         ns = None
         if compile_cache is not None and sampler is None:
             from ..parallel.aot import engine_key
+            extra: Dict[str, Any] = {}
+            if moe is not None:
+                # routing identity is executable identity: a different
+                # expert count / capacity / router traces different HLO
+                extra.update(moe_experts=moe.num_experts,
+                             moe_capacity=moe.capacity_factor,
+                             moe_routing=moe.routing)
+            if self._ring_sp > 1:
+                extra.update(ring=self._ring_sp)
             ns = compile_cache.namespace(engine_key(
                 cfg, mesh, kind="paged", slots=slots,
                 pages=self.total_pages, page_size=page_size,
-                prefill_chunk=prefill_chunk))
+                prefill_chunk=prefill_chunk, **extra))
         if ns:
             self._step_x = ns["step"]
             self._stepk_x = ns["stepk"]
@@ -681,16 +742,19 @@ class PagedServer:
             # pool donated everywhere it flows through jit, like the
             # slot cache: it dominates HBM and every executable returns
             # a same-shaped pool
+            ffn = self._ffn
             self._step_x = jax.jit(
                 lambda p, c, tbl, ln, tok: llama.decode_step_paged(
-                    cfg, p, c, tbl, ln, tok, mesh=mesh, rope=rope),
+                    cfg, p, c, tbl, ln, tok, mesh=mesh, rope=rope,
+                    ffn_override=ffn),
                 donate_argnums=(1,))
             self._stepk_x: Dict[int, Any] = {}
             self._chunk_x = jax.jit(
                 lambda p, c, tbl, toks, st, tl, li:
                     llama.prefill_chunk_paged(cfg, p, c, tbl, toks, st,
                                               tl, li, scratch, mesh=mesh,
-                                              rope=rope),
+                                              rope=rope,
+                                              ffn_override=ffn),
                 donate_argnums=(1,))
             self._copy_x = jax.jit(
                 lambda c, src, dst: {"k": _copy_page(c["k"], src, dst),
@@ -758,6 +822,15 @@ class PagedServer:
         self.spec_fallbacks = 0        # windows degraded to solo decode
         self.spec_draft_prefill_s = 0.0
         self.spec_window_s = 0.0
+        # ------------------------------------------- long-context counters
+        # ring prefill executables are keyed on the PADDED prompt length
+        # (each distinct s_pad traces its own HLO; prompts pad to
+        # lcm(sp, page_size) multiples so the working set stays small)
+        self._ring_x: Dict[int, Any] = {}
+        self.ring_prefills = 0         # prompts prefilled via the ring
+        self.ring_prefilled_tokens = 0
+        self.ring_prefill_s = 0.0      # cumulative ring-prefill time
+        self.longctx_fallbacks = 0     # ring attempts degraded to chunks
 
     # the engine-thread-only helpers are identical to the slot engine's
     _select = SlotServer._select
@@ -784,6 +857,13 @@ class PagedServer:
             raise DraftIncompatible(
                 "draft_sampled_engine",
                 "speculative decode is greedy-only; this engine samples")
+        if self._ffn is not None:
+            raise DraftIncompatible(
+                "draft_moe_engine",
+                "speculative decode is not supported on MoE engines: the "
+                "K-wide verify pass routes a k-token group while the "
+                "accepted history was routed one token at a time, so "
+                "verify logits would not match the committed path")
         if k < 2:
             raise DraftIncompatible("draft_k", f"draft k must be >= 2, "
                                                f"got {k}")
@@ -1753,6 +1833,98 @@ class PagedServer:
         self.migrated_out += 1
         return True
 
+    # ---------------------------------------- sequence-parallel prefill
+
+    def _ring_exec(self, s_pad: int):
+        """Jitted ring-prefill program for padded prompt length
+        ``s_pad``: one :func:`llama.prefill_ring` forward (~seq/sp
+        per-host time), last-position logits through the lm_head, and a
+        page-granular scatter of the whole K/V span into the pool —
+        the adoption install path (:func:`_install_pages`) reused for
+        locally-computed pages."""
+        x = self._ring_x.get(s_pad)
+        if x is None:
+            cfg, mesh, rope = self.cfg, self.mesh, self._rope
+            ffn = self._ffn
+            ps = self.page_size
+            n_pages = s_pad // ps
+
+            def ring(p, pool, prompt, li, phys):
+                hidden, ks, vs = llama.prefill_ring(
+                    cfg, p, prompt, mesh, rope=rope, ffn_override=ffn)
+                h_last = lax.dynamic_slice_in_dim(hidden, li, 1,
+                                                  axis=1)[:, 0]
+                logits = qmm(h_last, p["lm_head"]).astype(jnp.float32)
+                kp = ks[:, 0].reshape(cfg.n_layers, n_pages, ps,
+                                      cfg.n_kv_heads, cfg.head_dim)
+                vp = vs[:, 0].reshape(cfg.n_layers, n_pages, ps,
+                                      cfg.n_kv_heads, cfg.head_dim)
+                pool = {"k": _install_pages(pool["k"], kp, phys),
+                        "v": _install_pages(pool["v"], vp, phys)}
+                return logits, pool
+
+            x = jax.jit(ring, donate_argnums=(1,))
+            self._ring_x[s_pad] = x
+        return x
+
+    def _ring_prefill(self, slot: int) -> bool:
+        """Prefill the WHOLE prompt of ``slot`` in one sequence-parallel
+        tick. Returns True when the stream is decode-ready; any
+        disqualification (padded length over ``max_seq``, missing sp
+        axis at trace time, compiler rejection) counts a coded
+        ``longctx_fallback`` and returns False — the caller falls back
+        to the chunked path, the stream is never dropped.
+
+        Only runs from position 0: a radix-resumed stream's leading
+        pages are SHARED (other streams read them), and the ring path
+        writes the full span — clobbering shared pages with
+        ring-numerics K/V is exactly the aliasing the COW discipline
+        exists to prevent, so those streams stay on chunks."""
+        prompt = self._prompts[slot]
+        n = len(prompt)
+        ps = self.page_size
+        try:
+            s_pad = ring_pad_len(n, self._ring_sp, ps)
+            if s_pad > self.cfg.max_seq:
+                raise ValueError(
+                    f"prompt {n} pads to {s_pad} for sp="
+                    f"{self._ring_sp}, over max_seq {self.cfg.max_seq}")
+            n_pages = s_pad // ps
+            own = -(-n // ps)          # pages actually covering the prompt
+            phys = np.full((n_pages,), self.scratch, np.int32)
+            phys[:own] = self._tables[slot][:own]
+            # pad pages land on scratch: their K/V is causally
+            # downstream of every live position and masked by kv_len,
+            # so the duplicate-index scatter is sacrificial by design
+            padded = np.zeros((1, s_pad), np.int32)
+            padded[0, :n] = prompt
+            t0 = time.perf_counter()
+            logits, self.pool = self._ring_exec(s_pad)(
+                self.params, self.pool, jnp.asarray(padded),
+                jnp.int32(n - 1), jnp.asarray(phys))
+        except Exception:
+            self.longctx_fallbacks += 1
+            return False
+        toks = self._select(logits)
+        self.lengths = self.lengths.at[slot].set(n)
+        self.cur_tok = self.cur_tok.at[slot].set(toks[0])
+        self._pending_first[slot] = toks[0]
+        self._prefill_pos[slot] = n
+        self.ring_prefills += 1
+        self.ring_prefilled_tokens += n
+        self.ring_prefill_s += time.perf_counter() - t0
+        tracer = self.tracer
+        if tracer is not None:
+            ctx = getattr(self.requests[slot].request_id, "trace", None)
+            if ctx is not None:
+                tracer.record("engine.prefill_ring", t0,
+                              time.perf_counter(), parent=ctx,
+                              prompt_len=n, padded=s_pad,
+                              ring=self._ring_sp)
+        if self._draft is not None:
+            self._draft_prefill(slot, prompt)
+        return True
+
     # ------------------------------------------------------------- decode
 
     def _prefill_tick(self) -> None:
@@ -1760,7 +1932,10 @@ class PagedServer:
         of the prefill queue. This is the chunked-prefill interleave:
         every step()/step_many() pays at most one chunk before its
         decode dispatch, so running streams never stall behind a long
-        prompt."""
+        prompt. With ``longctx_ring`` armed, a long-enough prompt
+        starting from position 0 prefills WHOLE in one sequence-parallel
+        tick instead (:meth:`_ring_prefill`); on any disqualification it
+        degrades to this chunked path."""
         while self._prefill_q and self.requests[self._prefill_q[0]] is None:
             self._prefill_q.popleft()          # aborted mid-prefill
         if not self._prefill_q:
@@ -1768,6 +1943,11 @@ class PagedServer:
         slot = self._prefill_q[0]
         prompt = self._prompts[slot]
         n = len(prompt)
+        if (self._ring_sp > 1 and self._prefill_pos[slot] == 0
+                and n >= self.ring_threshold):
+            if self._ring_prefill(slot):
+                self._prefill_q.popleft()
+                return
         c = self.prefill_chunk
         start = self._prefill_pos[slot]
         end = min(start + c, n)
@@ -1893,12 +2073,14 @@ class PagedServer:
         x = self._stepk_x.get(k)
         if x is None:
             cfg, rope, mesh = self.cfg, self._rope, self.mesh
+            ffn = self._ffn
 
             def window(p, c, tbl, ln, tok, mask, key):
                 def body(carry, _):
                     c, ln, tok, key = carry
                     logits, c = llama.decode_step_paged(
-                        cfg, p, c, tbl, ln, tok, mesh=mesh, rope=rope)
+                        cfg, p, c, tbl, ln, tok, mesh=mesh, rope=rope,
+                        ffn_override=ffn)
                     key, sub = jax.random.split(key)
                     if self.sampler is None:
                         nxt = jnp.argmax(logits, axis=-1).astype(
@@ -2066,7 +2248,8 @@ class PagedServer:
         """
         self.pool = llama.init_page_pool(self.cfg, self.total_pages + 1,
                                          self.page_size)
-        if self.mesh is not None and self.mesh.size > 1:
+        if (self.mesh is not None and self.mesh.size > 1
+                and self.mesh.shape.get("tp", 1) > 1):
             self.pool = _shard_cache(self.pool, self.mesh)
         self.ledger = PagePool(self.total_pages, self.page_size)
         self.radix = (PrefixRadix(self.ledger)
@@ -2149,5 +2332,18 @@ class PagedServer:
                 "fallbacks": self.spec_fallbacks,
                 "draft_prefill_s": self.spec_draft_prefill_s,
                 "window_s": self.spec_window_s,
+            },
+            "moe": ({
+                "experts": self.moe.num_experts,
+                "capacity_factor": self.moe.capacity_factor,
+                "routing": self.moe.routing,
+            } if self.moe is not None else None),
+            "longctx": {
+                "ring": self._ring_sp,
+                "threshold": self.ring_threshold,
+                "ring_prefills": self.ring_prefills,
+                "ring_prefilled_tokens": self.ring_prefilled_tokens,
+                "ring_prefill_s": self.ring_prefill_s,
+                "fallbacks": self.longctx_fallbacks,
             },
         }

@@ -25,6 +25,25 @@ sampler constructed with the same seed on every rank — the key stream
 then advances identically inside the jitted steps, so retirements and
 slot assignments stay rank-identical.
 
+Paged engines ride the same loop unchanged, and that is what makes the
+round-18 serving arithmetic gang-safe: a :class:`PagedServer` armed
+with ``longctx_ring`` decides ring-vs-chunked prefill from
+rank-identical host state (prompt length, prefill position, radix
+share), so every rank enters the SAME ``prefill_ring`` collective in
+the same iteration — the sequence-parallel prefill is just another
+lock-step dispatch, and its ~seq/N per-host time is why the gang takes
+it. Ring-prefilled K/V spans land in each member's LOCAL page pool via
+the same install path the KVSPAN/``pack_span`` adoption channel uses,
+so decode gathers never leave the host. MoE engines (``moe=``) need
+nothing extra either: expert dispatch all-to-alls live inside the
+step/chunk executables every rank already issues together. The one
+sharp edge is fallback divergence — a rank that silently degraded to
+chunked prefill while its peers ring would deadlock the gang — which
+is why ``PagedServer._ring_prefill`` disqualifies on host-side config
+checks that are pure functions of the broadcast intake, and why
+:meth:`GangServingDriver.stats` surfaces ``longctx.fallbacks`` so a
+nonzero count on any member is loud in the heartbeat stream.
+
 Wire format (``encode_intake``/``decode_intake``): int32
 ``[max_intake, 2 + max_prompt]``; row = (prompt_len, max_new,
 prompt..., 0 padding); prompt_len == 0 terminates.
@@ -174,11 +193,30 @@ class GangServingDriver:
         self.iterations += 1
         return worked
 
+    def stats(self) -> dict:
+        """Heartbeat payload: loop counters plus the engine's paged /
+        MoE / longctx counters when the engine exposes them. Lock-step
+        makes the engine numbers rank-identical, so any member's
+        heartbeat describes the gang's shared schedule — EXCEPT
+        ``pages.longctx.fallbacks`` / ``errors``, which are the
+        per-member divergence canaries monitoring watches."""
+        out = {"gang_iterations": self.iterations,
+               "gang_errors": self.errors,
+               "process_id": self.process_id,
+               "backlog": len(self._backlog)}
+        page_stats = getattr(self.engine, "page_stats", None)
+        if callable(page_stats):
+            out["pages"] = page_stats()
+        if self.frontend is not None:
+            out.update(self.frontend.stats())
+        return out
+
     def run(self, max_iterations: Optional[int] = None,
             heartbeat_s: float = 0.0, on_heartbeat=None) -> None:
         """Drive until stopped (or ``max_iterations``, for tests).
-        ``on_heartbeat(stats_dict)`` fires every ``heartbeat_s`` on
-        rank 0 (peers get an empty dict on the same cadence)."""
+        ``on_heartbeat(stats_dict)`` fires every ``heartbeat_s`` with
+        :meth:`stats` (every rank; rank 0's payload includes the
+        frontend counters)."""
         last_beat = time.monotonic()
         while not self._stop:
             if max_iterations is not None \
@@ -205,8 +243,7 @@ class GangServingDriver:
             if heartbeat_s and on_heartbeat is not None \
                     and time.monotonic() - last_beat >= heartbeat_s:
                 last_beat = time.monotonic()
-                on_heartbeat(self.frontend.stats()
-                             if self.frontend is not None else {})
+                on_heartbeat(self.stats())
 
     def stop(self) -> None:
         self._stop = True

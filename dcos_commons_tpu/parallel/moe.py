@@ -158,6 +158,47 @@ def moe_apply(x: jnp.ndarray, router_w: jnp.ndarray, w_in: jnp.ndarray,
     return out, aux
 
 
+def moe_apply_local(x: jnp.ndarray, router_w: jnp.ndarray,
+                    w_in: jnp.ndarray, w_out: jnp.ndarray,
+                    cfg: MoEConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`moe_apply` with every expert local — no collectives.
+
+    x: [G, D]. w_in: [E, D, F] / w_out: [E, F, D] — the FULL expert
+    stack. The all-to-all in the sharded path is pure data movement
+    (exact row copies), so for the same tokens this computes the same
+    contractions expert-by-expert: the sharded and local paths agree
+    bitwise, which is what the serving parity gates pin."""
+    g = x.shape[0]
+    cap = cfg.capacity(g)
+    gates = jax.nn.softmax(
+        jnp.einsum("gd,de->ge", x.astype(jnp.float32),
+                   router_w.astype(jnp.float32)), axis=-1)
+    if cfg.routing == "expert_choice":
+        combine, dispatch = expert_choice_dispatch(gates, cap)
+    elif cfg.routing == "top2":
+        combine, dispatch = top2_dispatch(gates, cap)
+    else:
+        raise ValueError(f"unknown MoE routing {cfg.routing!r}")
+    expert_in = jnp.einsum("gec,gd->ecd", dispatch.astype(x.dtype), x)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_in))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w_out)
+    out = jnp.einsum("gec,ecd->gd", combine.astype(x.dtype), expert_out)
+    aux = (jnp.zeros((), x.dtype) if cfg.routing == "expert_choice"
+           else aux_load_balance_loss(gates).astype(x.dtype))
+    return out, aux
+
+
+def dropless(cfg: MoEConfig) -> MoEConfig:
+    """The decode-side routing contract: capacity_factor = num_experts
+    makes ``capacity(n) == n`` — no token can overflow any expert's
+    buffer, so per-token outputs are independent of how tokens are
+    grouped into dispatch calls. That grouping-independence is what
+    lets chunked prefill, batched decode, and the full-sequence
+    reference agree token-exactly (chaos invariant 19)."""
+    return dataclasses.replace(cfg,
+                               capacity_factor=float(cfg.num_experts))
+
+
 def make_moe(mesh: Mesh, cfg: MoEConfig, *, x_spec=P(), expert_spec=P("ep")):
     """Self-contained shard_map wrapper for tests: x replicated, experts
     sharded over ``ep``."""

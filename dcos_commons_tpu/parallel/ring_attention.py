@@ -49,6 +49,7 @@ Used inside ``shard_map``; :func:`make_ring_attention` wires the specs.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -76,6 +77,18 @@ def zigzag_indices(seq: int, ring: int) -> np.ndarray:
         idx.extend(range(r * c, (r + 1) * c))
         idx.extend(range((2 * ring - 1 - r) * c, (2 * ring - r) * c))
     return np.asarray(idx, np.int32)
+
+
+def ring_pad_len(n: int, ring: int, multiple: int = 1) -> int:
+    """Smallest length >= ``n`` divisible by both ``ring`` and
+    ``multiple`` — the serving gang pads a prompt to this before a
+    sequence-parallel prefill (``ring`` for the sp shards, ``multiple``
+    for whole KV pages so the prefilled span installs page-aligned;
+    ``models/llama.prefill_ring`` consumes the result)."""
+    if n <= 0:
+        raise ValueError(f"prompt length must be positive, got {n}")
+    m = ring * multiple // math.gcd(ring, multiple)
+    return -(-n // m) * m
 
 
 def zigzag_inverse(seq: int, ring: int) -> np.ndarray:

@@ -136,6 +136,26 @@ DEFAULT_ENV: Mapping[str, str] = {
     # LRU cap on tracked per-tenant router state (buckets/counters):
     # bounds memory against unique-X-Tenant floods
     "TENANT_MAX_TRACKED": "4096",
+    # round-18 serving arithmetic (moe.yml + longctx.yml serving pods,
+    # frameworks/jax/worker.py _serving_arithmetic). MOE_EXPERTS > 0
+    # serves the routed-MLP Llama variant through the paged engine:
+    # raw-bf16 expert banks, decode dispatch through parallel/moe.py
+    # (experts sharded over an ep mesh axis when the replica's chip
+    # count divides the expert count — the capacity-bounded all-to-all
+    # in the analysis manifest). MOE_CAPACITY_FACTOR=0 means dropless
+    # (factor = experts): routing independent of token grouping, so
+    # serving stays token-exact vs the stepwise reference (chaos
+    # invariant 19's contract). PREFILL_SEQ_PARALLEL=true arms ring
+    # prefill on the paged engine: prompts >= 2*prefill_chunk run
+    # llama.prefill_ring over the sp mesh axis in ONE tick (~seq/N
+    # per-host time) with the K/V span landing page-aligned in the
+    # local pool; LONGCTX_RING asserts the sp size (0 = the replica's
+    # whole chip count). Every disqualifying combo degrades with a
+    # coded moe_fallback/longctx_fallback event, never a crash.
+    "MOE_EXPERTS": "0",
+    "MOE_CAPACITY_FACTOR": "0",
+    "LONGCTX_RING": "0",
+    "PREFILL_SEQ_PARALLEL": "false",
     # long-context scenario knobs (longctx.yml)
     "SEQ_LEN": "8192",
     "ATTN_IMPL": "ring",

@@ -274,7 +274,15 @@ def run_llama(args) -> dict:
                                      kv_quant=kv_quant)
     else:
         cfg = llama.LlamaConfig.tiny(kv_quant=kv_quant)
-    mesh = MeshSpec(tp=n).build()
+    # round-18 serving arithmetic: MoE decode (ep mesh) or sequence-
+    # parallel ring prefill (sp mesh) replace the tp weight shards —
+    # one replica mesh carries one inner axis. Resolved ONCE here;
+    # the engine constructors read the stash (_make_serving_engine)
+    moe_cfg, longctx_ring, arith_spec = (
+        _serving_arithmetic(args, cfg, n) if args.serve
+        else (None, 0, None))
+    args._moe_cfg, args._longctx_ring = moe_cfg, longctx_ring
+    mesh = (arith_spec or MeshSpec(tp=n)).build()
     gen_len = args.gen_len
     # chunked for everything but tiny: the fused nested-scan generate
     # takes minutes to compile at 400m+ through tunneled backends;
@@ -290,7 +298,13 @@ def run_llama(args) -> dict:
         # prompt must stay (1, 4) int32 so the compiled executable is reused
         t0 = time.perf_counter()
         with mesh:
-            if chunked:
+            if moe_cfg is not None:
+                # routed FFN: the dense generate paths read w_gate/
+                # w_up/w_down which MoE trees don't carry
+                toks = llama.generate_stepwise_moe(cfg, params, prompt,
+                                                   gen_len, moe_cfg,
+                                                   mesh=mesh)
+            elif chunked:
                 toks = llama.generate_chunked(cfg, params, prompt,
                                               gen_len, chunk=16,
                                               mesh=mesh)
@@ -301,22 +315,30 @@ def run_llama(args) -> dict:
         return round(exec_len / max(time.perf_counter() - t0, 1e-9), 2)
 
     with mesh:
-        if args.quant == "int8":
+        if moe_cfg is not None:
+            # raw bf16 expert banks, replicated shared weights: the tp
+            # param_specs tree doesn't describe router/w_in/w_out, and
+            # the ep shard_map reshards the expert axis at dispatch
+            params = llama.init_moe_params(cfg, moe_cfg.num_experts,
+                                           jax.random.key(0))
+        elif args.quant == "int8":
             # init + quantize on host CPU, stream int8 shards to devices —
             # never materializes bf16 weights on-chip (models/llama.py:
             # init_quantized_params)
             params = llama.init_quantized_params(cfg, jax.random.key(0))
+            params = llama.shard_params(params, mesh, cfg)
         else:
             params = llama.init_params(cfg, jax.random.key(0))
-        params = llama.shard_params(params, mesh, cfg)
+            params = llama.shard_params(params, mesh, cfg)
     registry = None
     boot_report = {"source": "init", "fetch_s": 0.0, "restore_s": 0.0}
     if args.serve:
         from dcos_commons_tpu.metrics import MetricsRegistry
         registry = MetricsRegistry()
-        if args.quant == "none":
+        if args.quant == "none" and moe_cfg is None:
             # int8 replicas keep their freshly-quantized init: QTensor
-            # trees are outside the sharded-checkpoint template contract
+            # trees are outside the sharded-checkpoint template
+            # contract — and MoE trees are outside the dense template
             with mesh:
                 params, boot_report = _boot_serving_weights(args, params,
                                                             registry)
@@ -566,6 +588,82 @@ def _start_weight_server(args, params, registry=None):
         return None
 
 
+def _serving_arithmetic(args, cfg, n):
+    """Resolve the round-18 serving-arithmetic knobs (``--moe-experts``,
+    ``--prefill-seq-parallel``/``--longctx-ring``) into
+    ``(moe_cfg, ring, mesh_spec)`` — or ``(None, 0, None)`` for the
+    plain dense/tp stack. Degrade-not-crash: every disqualifying combo
+    emits a coded ``moe_fallback``/``longctx_fallback`` event and drops
+    THAT feature, never the replica. The decision is pure config, so
+    every gang rank resolves identically."""
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+    from dcos_commons_tpu.parallel.moe import MoEConfig, dropless
+    from dcos_commons_tpu.specification import yaml_bool
+    moe_cfg = None
+    if args.moe_experts > 0:
+        if not args.pages:
+            _emit({"event": "moe_fallback", "code": "moe_needs_paged",
+                   "error": "MoE serving routes through the paged "
+                            "engine only: set --pages/SERVE_PAGES "
+                            "(serving dense)"})
+        elif args.quant != "none" or getattr(args, "kv_quant", False):
+            _emit({"event": "moe_fallback", "code": "moe_quant",
+                   "error": "MoE expert banks serve raw bf16 "
+                            "(quantize_params rejects router trees); "
+                            "drop --quant/--kv-quant (serving dense)"})
+        else:
+            moe_cfg = MoEConfig(args.moe_experts,
+                                capacity_factor=args.moe_capacity_factor
+                                or 1.0,
+                                routing=args.moe_routing)
+            if args.moe_capacity_factor <= 0:
+                moe_cfg = dropless(moe_cfg)
+    ring = 0
+    if yaml_bool(getattr(args, "prefill_seq_parallel", "false")):
+        want = args.longctx_ring or n
+        if moe_cfg is not None:
+            _emit({"event": "longctx_fallback",
+                   "code": "longctx_with_moe",
+                   "error": "one replica mesh carries ep OR sp; MoE "
+                            "decode wins, prefill stays chunked"})
+        elif not args.pages:
+            _emit({"event": "longctx_fallback",
+                   "code": "longctx_needs_paged",
+                   "error": "ring prefill is a paged-engine path: set "
+                            "--pages/SERVE_PAGES"})
+        elif getattr(args, "kv_quant", False):
+            _emit({"event": "longctx_fallback", "code": "longctx_quant",
+                   "error": "ring prefill installs bf16 K/V spans; "
+                            "drop --kv-quant"})
+        elif n < 2 or want != n:
+            _emit({"event": "longctx_fallback",
+                   "code": "longctx_ring_devices",
+                   "error": f"ring size {want} != device count {n}; "
+                            "this build runs the sp axis over the "
+                            "replica's whole device set"})
+        elif cfg.max_seq % n:
+            _emit({"event": "longctx_fallback",
+                   "code": "longctx_ring_max_seq",
+                   "error": f"ring {n} must divide max_seq "
+                            f"{cfg.max_seq} so padded prompts stay "
+                            "in-table"})
+        else:
+            ring = n
+    if moe_cfg is not None:
+        ep = n if moe_cfg.num_experts % n == 0 else 1
+        if ep == 1 and n > 1:
+            _emit({"event": "moe_note", "code": "moe_local_dispatch",
+                   "experts": moe_cfg.num_experts, "devices": n,
+                   "note": "device count does not divide the expert "
+                           "count; experts stay replicated and "
+                           "dispatch runs the bitwise-equal local "
+                           "path (no all-to-all)"})
+        return moe_cfg, 0, MeshSpec(ep=ep)
+    if ring:
+        return None, ring, MeshSpec(sp=ring)
+    return None, 0, None
+
+
 def _make_serving_engine(args, cfg, params, mesh, key=None,
                          registry=None):
     """SlotServer or PagedServer per ``--pages``, degrade-not-crash.
@@ -594,6 +692,11 @@ def _make_serving_engine(args, cfg, params, mesh, key=None,
     if key is not None:
         kw["key"] = key
     spec_wanted = _spec_decode_wanted(args)
+    # round-18 arithmetic resolved once in run_llama (the coded
+    # fallback events fire there); stashed on args so the disagg/gang
+    # constructors reach the same engine without signature churn
+    moe_cfg = getattr(args, "_moe_cfg", None)
+    longctx_ring = getattr(args, "_longctx_ring", 0)
     if args.pages:
         try:
             engine = PagedServer(
@@ -602,6 +705,7 @@ def _make_serving_engine(args, cfg, params, mesh, key=None,
                 page_size=args.page_size,
                 prefill_chunk=args.prefill_chunk,
                 compile_cache=aot.from_env(),
+                moe=moe_cfg, longctx_ring=longctx_ring,
                 **_make_kv_tiers(args), **kw)
             if spec_wanted:
                 _arm_spec_decode(args, cfg, engine, registry)
@@ -1344,6 +1448,47 @@ def build_parser() -> argparse.ArgumentParser:
                                  or 1.0),
                    help="distill: softmax temperature both "
                         "distributions are smoothed by in the KL loss")
+    p.add_argument("--moe-experts", type=int,
+                   default=int(os.environ.get("MOE_EXPERTS", "0") or 0),
+                   help="llama --serve --pages: experts in the routed "
+                        "MLP (0 = dense). Serving weights are built raw "
+                        "bf16 (init_moe_params) and every decode/prefill "
+                        "executable routes its FFN through parallel/"
+                        "moe.py; when the replica's device count divides "
+                        "the expert count the experts shard over an ep "
+                        "mesh axis and dispatch runs the capacity-"
+                        "bounded all-to-all (dist/moe.yml)")
+    p.add_argument("--moe-capacity-factor", type=float,
+                   default=float(os.environ.get("MOE_CAPACITY_FACTOR",
+                                                "0") or 0),
+                   help="llama --serve --moe-experts: expert buffer "
+                        "slots = tokens/experts * factor. 0 (default) = "
+                        "dropless (factor = experts): capacity never "
+                        "binds, so routing is independent of token "
+                        "grouping and serving stays token-exact vs the "
+                        "stepwise reference — the parity contract. "
+                        "Smaller factors trade that exactness for "
+                        "bounded buffers (dropped tokens pass through "
+                        "on the residual)")
+    p.add_argument("--longctx-ring", type=int,
+                   default=int(os.environ.get("LONGCTX_RING", "0") or 0),
+                   help="llama --serve --prefill-seq-parallel: sp-axis "
+                        "size for ring prefill; 0 = the replica's whole "
+                        "device count (the only size this build "
+                        "accepts, so the knob is an explicit assertion "
+                        "of gang geometry — a mismatch degrades with a "
+                        "coded longctx_fallback)")
+    p.add_argument("--prefill-seq-parallel",
+                   default=os.environ.get("PREFILL_SEQ_PARALLEL",
+                                          "false"),
+                   help="llama --serve --pages: true/false (spec "
+                        "boolean) — prompts >= 2*prefill_chunk prefill "
+                        "in ONE sequence-parallel tick via "
+                        "llama.prefill_ring over the sp mesh axis "
+                        "(~seq/N per-host time, dist/longctx.yml) "
+                        "instead of serial chunks; disqualified "
+                        "configs degrade to chunked prefill with a "
+                        "coded longctx_fallback event")
     p.add_argument("--queue-limit", type=int, default=64,
                    help="llama --serve --slots: bounded ingress queue "
                         "(overflow answers 503 + Retry-After)")

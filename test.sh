@@ -38,6 +38,12 @@ python -m tools.kvtier_smoke --budget-s "${KVTIER_SMOKE_BUDGET_S:-90}"
 echo "== spec smoke (distill -> sealed draft -> armed paged decode, token-exact, time-capped) =="
 python -m tools.spec_smoke --budget-s "${SPEC_SMOKE_BUDGET_S:-120}"
 
+echo "== moe smoke (routed-FFN paged decode vs stepwise MoE reference, token-exact, time-capped) =="
+python -m tools.moe_smoke --budget-s "${MOE_SMOKE_BUDGET_S:-90}"
+
+echo "== longctx smoke (sequence-parallel ring prefill vs single-host greedy, token-exact, time-capped) =="
+python -m tools.longctx_smoke --budget-s "${LONGCTX_SMOKE_BUDGET_S:-90}"
+
 echo "== control-plane smoke (steady-state cycle budget under churn) =="
 # observed p50 ~6.4ms at fleet 500; the pin is ~12x that so only an
 # O(fleet) regression (not CI-host noise) trips it

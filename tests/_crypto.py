@@ -1,0 +1,19 @@
+"""Skip marker for tests that need the optional ``cryptography`` wheel.
+
+The TLS/CA stack (``dcos_commons_tpu/security``) imports ``cryptography``
+lazily; hosts without the wheel can still run every other tier-1 test.
+Tests exercising secure transport, the CA, or anything that round-trips
+through them mark themselves with :data:`requires_cryptography` so a
+missing wheel reads as SKIPPED (environment), never FAILED (regression).
+"""
+
+import importlib.util
+
+import pytest
+
+HAS_CRYPTOGRAPHY = importlib.util.find_spec("cryptography") is not None
+
+requires_cryptography = pytest.mark.skipif(
+    not HAS_CRYPTOGRAPHY,
+    reason="optional dependency 'cryptography' not installed "
+           "(TLS/CA stack unavailable)")

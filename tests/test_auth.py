@@ -24,6 +24,7 @@ from dcos_commons_tpu.security import (Authenticator, AuthError,
                                        generate_auth_config)
 from dcos_commons_tpu.specification import load_service_yaml_str
 from dcos_commons_tpu.state import MemPersister
+from tests._crypto import requires_cryptography
 
 YML = """
 name: authed
@@ -183,6 +184,7 @@ class TestAuthedApi:
                         headers=hdr)[0] == 403
         assert _request(f"{url}/v1/secrets", headers=hdr)[0] == 403
 
+    @requires_cryptography
     def test_cached_token_provider(self, authed_server):
         _, auth, url = authed_server
         provider = CachedTokenProvider(url, "ops",

@@ -9,6 +9,7 @@ from dcos_commons_tpu.cli.main import main
 from dcos_commons_tpu.http import ApiServer
 
 from tests.test_http import make_scheduler
+from tests._crypto import requires_cryptography
 
 
 @pytest.fixture()
@@ -28,6 +29,7 @@ def run_cli(base, *argv, expect=0, capsys=None):
     return json.loads(out)
 
 
+@requires_cryptography
 def test_plan_commands(server, capsys):
     _, base = server
     assert "deploy" in run_cli(base, "plan", "list", capsys=capsys)
@@ -37,6 +39,7 @@ def test_plan_commands(server, capsys):
     run_cli(base, "plan", "force-complete", "deploy", capsys=capsys)
 
 
+@requires_cryptography
 def test_pod_and_endpoints_and_debug(server, capsys):
     sched, base = server
     assert run_cli(base, "pod", "list", capsys=capsys) == ["hello-0",
@@ -50,6 +53,7 @@ def test_pod_and_endpoints_and_debug(server, capsys):
     assert debug["reservations"]
 
 
+@requires_cryptography
 def test_describe_config_state_health(server, capsys):
     sched, base = server
     assert run_cli(base, "describe", capsys=capsys)["name"] == "websvc"
@@ -100,16 +104,19 @@ def test_warm_pool_command_unconfigured(metrics_server, capsys):
     assert "WARM_POOL_SIZE" in out["note"]
 
 
+@requires_cryptography
 def test_cli_unreachable():
     assert main(["--url", "http://127.0.0.1:1", "plan", "list"]) == 2
 
 
+@requires_cryptography
 def test_cli_error_exit_code(server, capsys):
     _, base = server
     rc = main(["--url", base, "plan", "show", "bogus"])
     assert rc == 1
 
 
+@requires_cryptography
 def test_update_command(server, capsys, tmp_path):
     sched, base = server
     from tests.test_http import YML
@@ -128,6 +135,7 @@ def test_update_command(server, capsys, tmp_path):
     assert result["errors"]
 
 
+@requires_cryptography
 def test_agents_command(server, capsys):
     _, base = server
     ids = run_cli(base, "agents", capsys=capsys)
@@ -154,6 +162,7 @@ def clean_env(tmp_path, monkeypatch):
     os.environ.update(saved)
 
 
+@requires_cryptography
 def test_set_cluster_roundtrip_no_env_no_flags(server, capsys, clean_env):
     _, base = server
     out = run_cli(base, "config", "set-cluster", base, capsys=capsys)
@@ -175,6 +184,7 @@ def test_set_cluster_validation(server, capsys, clean_env):
     capsys.readouterr()
 
 
+@requires_cryptography
 def test_explicit_env_and_flag_beat_cluster_config(server, capsys,
                                                    clean_env):
     import os
@@ -190,6 +200,7 @@ def test_explicit_env_and_flag_beat_cluster_config(server, capsys,
     capsys.readouterr()
 
 
+@requires_cryptography
 def test_cluster_config_tls_auth_both_clis(capsys, clean_env):
     """The VERDICT criterion: a TLS+auth scheduler driven by BOTH CLIs
     with no env vars and no flags — url/ca/token all from ~/.tpuctl."""

@@ -57,6 +57,10 @@ class TestScenariosDeploy:
         if scenario == "multislice":
             # the 2-slice gang needs two distinct slices of agents
             kwargs["agents"] = two_slice_agents()
+        elif scenario == "longctx":
+            # the trainer gang and the ring-prefill serving gang each
+            # fill a whole v4-32 slice (4 hosts x 4 chips)
+            kwargs["agents"] = two_slice_agents(hosts_per_slice=4)
         runner_for(scenario, env={"WORKER_COUNT": "4"}
                    if scenario == "multislice" else None, **kwargs).run([
             Send.until_quiet(),

@@ -17,6 +17,7 @@ from dcos_commons_tpu.scheduler.multi import MultiServiceScheduler
 from dcos_commons_tpu.specification import load_service_yaml_str
 from dcos_commons_tpu.state import MemPersister
 from dcos_commons_tpu.testing.simulation import FakeCluster, default_agents
+from tests._crypto import requires_cryptography
 
 YML = """
 name: {name}
@@ -224,6 +225,7 @@ class TestQuotaValidation:
 
 
 class TestQuotaCli:
+    @requires_cryptography
     def test_both_clis_manage_quota(self, capsys):
         """tpuctl (C++) and the Python CLI drive /v1/quota the same way."""
         import subprocess

@@ -19,6 +19,11 @@ from dcos_commons_tpu.state import (LockError, NotFoundError, QuorumError,
                                     ReplicatedLock, ReplicatedPersister,
                                     StateReplicaServer, open_replicated)
 from dcos_commons_tpu.testing.simulation import default_agents
+from tests._crypto import requires_cryptography
+
+# every replica hop rides the TLS transport, which needs the optional
+# cryptography wheel — absent wheel is an environment gap, not a failure
+pytestmark = requires_cryptography
 
 
 @pytest.fixture()

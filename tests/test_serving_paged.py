@@ -422,3 +422,132 @@ class TestPagedSeams:
             t.join(timeout=10)
             fe.stop()
         assert engine.ledger_violations() == []
+
+
+# ------------------------------------------- round 18: MoE + ring prefill
+
+
+class TestServingArithmetic:
+    """Round-18 arithmetic through the paged engine: routed-FFN (MoE)
+    decode and sequence-parallel ring prefill, both pinned token-exact
+    against their dense/single-host references with a clean ledger."""
+
+    def test_moe_paged_streams_match_stepwise_moe(self):
+        """Dropless MoE serving == the stepwise MoE reference for every
+        stream (routing is grouping-free under the dropless contract),
+        windowed decode included."""
+        from dcos_commons_tpu.parallel.moe import MoEConfig, dropless
+        cfg = _cfg()
+        moe = dropless(MoEConfig(num_experts=4))
+        params = llama.init_moe_params(cfg, 4, jax.random.key(0))
+        reqs = [{"prompt": _prompt(150 + i, n, cfg.vocab_size),
+                 "max_new": m, "request_id": i}
+                for i, (n, m) in enumerate([(8, 6), (5, 9), (17, 4)])]
+        want = {}
+        for r in reqs:
+            toks = llama.generate_stepwise_moe(
+                cfg, params, jnp.asarray([r["prompt"]], jnp.int32),
+                r["max_new"], moe)
+            want[r["request_id"]] = [int(t) for t in toks[0]]
+        server = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                     prefill_chunk=8, moe=moe)
+        got = server.drain([dict(r) for r in reqs])
+        assert got == want, (got, want)
+        assert server.ledger_violations() == []
+        windowed = serving.PagedServer(
+            cfg, params, slots=2, page_size=16, prefill_chunk=8,
+            moe=moe).drain([dict(r) for r in reqs], decode_window=4)
+        assert windowed == want, (windowed, want)
+
+    def test_moe_paged_expert_parallel_mesh_parity(self):
+        """The same streams through an ep-sharded mesh (the expert-
+        parallel all-to-all dispatch) stay token-exact — the sharded
+        path is bitwise the local path."""
+        from dcos_commons_tpu.parallel.mesh import MeshSpec
+        from dcos_commons_tpu.parallel.moe import MoEConfig, dropless
+        cfg = _cfg()
+        moe = dropless(MoEConfig(num_experts=4))
+        params = llama.init_moe_params(cfg, 4, jax.random.key(0))
+        mesh = MeshSpec(ep=4, dp=2).build()
+        reqs = [{"prompt": _prompt(160 + i, n, cfg.vocab_size),
+                 "max_new": m, "request_id": i}
+                for i, (n, m) in enumerate([(9, 5), (6, 7)])]
+        want = {}
+        for r in reqs:
+            toks = llama.generate_stepwise_moe(
+                cfg, params, jnp.asarray([r["prompt"]], jnp.int32),
+                r["max_new"], moe)
+            want[r["request_id"]] = [int(t) for t in toks[0]]
+        server = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                     prefill_chunk=8, mesh=mesh, moe=moe)
+        got = server.drain([dict(r) for r in reqs])
+        assert got == want, (got, want)
+        assert server.page_stats()["moe"]["experts"] == 4
+        assert server.ledger_violations() == []
+
+    def test_moe_requires_router_params_and_vice_versa(self):
+        from dcos_commons_tpu.parallel.moe import MoEConfig, dropless
+        cfg = _cfg()
+        dense = llama.init_params(cfg, jax.random.key(0))
+        routed = llama.init_moe_params(cfg, 4, jax.random.key(0))
+        with pytest.raises(ValueError, match="router"):
+            serving.PagedServer(cfg, dense, slots=2, page_size=16,
+                                moe=dropless(MoEConfig(num_experts=4)))
+        with pytest.raises(ValueError, match="moe"):
+            serving.PagedServer(cfg, routed, slots=2, page_size=16)
+
+    def test_moe_engine_rejects_draft_arming(self):
+        """Spec decode's K-wide verify would route a k-token group that
+        the committed history routed one token at a time — arming must
+        refuse with a coded error, not emit drifted tokens."""
+        from dcos_commons_tpu.parallel.moe import MoEConfig, dropless
+        cfg = _cfg()
+        moe = dropless(MoEConfig(num_experts=4))
+        params = llama.init_moe_params(cfg, 4, jax.random.key(0))
+        server = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                     moe=moe)
+        dcfg = llama.LlamaConfig.tiny(n_layers=1, max_seq=64,
+                                      attn_impl="dense")
+        dparams = llama.init_params(dcfg, jax.random.key(1))
+        from dcos_commons_tpu.models.speculative import DraftIncompatible
+        with pytest.raises(DraftIncompatible) as ei:
+            server.arm_draft(dcfg, dparams, k=4)
+        assert ei.value.code == "draft_moe_engine"
+
+    def test_ring_prefill_matches_single_host_reference(self):
+        """Prompts over the ring threshold prefill in ONE tick via the
+        sp-gang ring path and stay token-exact with single-host solo
+        decode — at a shape where the longest prompt's pad hits
+        max_seq exactly (the chunk-window-overrun regression class:
+        positions near max_seq must not re-clamp rope)."""
+        from dcos_commons_tpu.parallel.mesh import MeshSpec
+        cfg = _cfg()                          # max_seq = 64
+        params = llama.init_params(cfg, jax.random.key(0))
+        mesh = MeshSpec(sp=4, dp=2).build()
+        reqs = [{"prompt": _prompt(170 + i, n, cfg.vocab_size),
+                 "max_new": m, "request_id": i}
+                for i, (n, m) in enumerate([(60, 4), (33, 6), (7, 5)])]
+        want = {r["request_id"]: _solo(cfg, params, r["prompt"],
+                                       r["max_new"]) for r in reqs}
+        server = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                     prefill_chunk=8, mesh=mesh,
+                                     longctx_ring=4)
+        got = server.drain([dict(r) for r in reqs])
+        assert got == want, (got, want)
+        # the two long prompts rode the ring; the short one chunked
+        assert server.ring_prefills == 2
+        assert server.longctx_fallbacks == 0
+        stats = server.page_stats()["longctx"]
+        assert stats["ring"] == 4 and stats["ring_prefilled_tokens"] == 93
+        assert server.ledger_violations() == []
+
+    def test_ring_rejects_indivisible_max_seq(self):
+        from dcos_commons_tpu.parallel.mesh import MeshSpec
+        cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=66,
+                                     attn_impl="dense")
+        params = llama.init_params(cfg, jax.random.key(0))
+        mesh = MeshSpec(sp=4, dp=2).build()
+        with pytest.raises(ValueError, match="max_seq"):
+            serving.PagedServer(cfg, params, slots=2, page_size=6,
+                                prefill_chunk=6, mesh=mesh,
+                                longctx_ring=4)

@@ -19,6 +19,10 @@ from pathlib import Path
 
 import pytest
 
+# the native soak rides the real HTTP+TLS+auth stack: skip at collection
+# when the optional cryptography wheel is absent
+pytest.importorskip("cryptography")
+
 from dcos_commons_tpu.agent import RemoteCluster
 from dcos_commons_tpu.http import ApiServer
 from dcos_commons_tpu.plan import Status

@@ -12,6 +12,7 @@ from dcos_commons_tpu.scheduler.runner import CycleDriver
 from dcos_commons_tpu.state import TaskState
 from dcos_commons_tpu.testing import (Expect, Send, ServiceTestRunner,
                                       TickFailure, integration)
+from tests._crypto import requires_cryptography
 
 SVC_YML = """
 name: hello-world
@@ -183,6 +184,7 @@ class TestIntegrationLib:
         driver.stop()
         server.stop()
 
+    @requires_cryptography
     def test_install_replace_uninstall_flow(self, live):
         client = integration.install(live, "hello-world", SVC_YML,
                                      timeout_s=20)
@@ -231,6 +233,7 @@ class TestIntegrationUpdate:
         driver.stop()
         server.stop()
 
+    @requires_cryptography
     def test_option_update_rolls_and_moves_target(self, live):
         client = integration.ServiceClient(live)
         integration.wait_for_deployment(client, timeout_s=20)
@@ -243,6 +246,7 @@ class TestIntegrationUpdate:
         code, pods = client.get("pod")
         assert code == 200 and "hello-2" in pods
 
+    @requires_cryptography
     def test_rejected_update_raises(self, live):
         client = integration.ServiceClient(live)
         integration.wait_for_deployment(client, timeout_s=20)
@@ -295,6 +299,7 @@ pods:
         driver.stop()
         server.stop()
 
+    @requires_cryptography
     def test_agents_inventory_over_http(self, live):
         ids = integration.wait_for_agents(live, 4, timeout_s=10)
         assert len(ids) == 4
@@ -302,6 +307,7 @@ pods:
         assert {a["zone"] for a in info} == {"zone-0", "zone-1"}
         assert all(a["roles"] == ["*"] for a in info)
 
+    @requires_cryptography
     def test_fault_domain_spread(self, live):
         client = integration.ServiceClient(live)
         integration.wait_for_deployment(client, timeout_s=20)
@@ -313,6 +319,7 @@ pods:
             integration.check_spread(client, "web", axis="region",
                                      min_distinct=2)
 
+    @requires_cryptography
     def test_endpoints_helpers(self, live):
         client = integration.ServiceClient(live)
         integration.wait_for_deployment(client, timeout_s=20)
@@ -321,12 +328,14 @@ pods:
                                            timeout_s=10)
         assert len(ep["dns"]) == 2
 
+    @requires_cryptography
     def test_kill_and_await_recovery(self, live):
         client = integration.ServiceClient(live)
         integration.wait_for_deployment(client, timeout_s=20)
         integration.kill_task_and_await_recovery(
             client, "web-0-server", "web-0", timeout_s=20)
 
+    @requires_cryptography
     def test_capture_diagnostics(self, live, tmp_path):
         from dcos_commons_tpu.testing import diag
         client = integration.ServiceClient(live)

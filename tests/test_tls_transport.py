@@ -18,6 +18,11 @@ import urllib.request
 
 import pytest
 
+# the whole module exercises the TLS stack: skip at collection when the
+# optional cryptography wheel is absent (else the security imports below
+# fail the collector)
+pytest.importorskip("cryptography")
+
 from dcos_commons_tpu.agent.remote import RemoteCluster
 from dcos_commons_tpu.http import ApiServer
 from dcos_commons_tpu.plan import Status

@@ -45,6 +45,21 @@ router's own counters — the ``--route-policy affinity`` vs ``random``
 pair at one config is the Round 12 fleet-routing receipt
 (``bench_r12/fleet_routing.jsonl``).
 
+``--engine moe`` runs the routed-FFN decode economics arms in this
+process: the paged engine with a top-2 dropless expert bank
+(``--moe-experts`` x ``--moe-ffn``) vs the dense-FLOPs control arm at
+``ffn_dim = E x F``, plus an expert-parallel arm when the host has a
+4-way mesh. Every MoE line carries a token-exact ``parity`` gate
+against ``generate_stepwise_moe`` at the benched config — the Round 18
+``bench_r18/moe_decode.jsonl``.
+
+``--engine longctx`` times the CRITICAL-PATH rank's prefill compute at
+a fixed ``--prompt-tokens`` prompt for each ``--gang-sizes`` entry
+(CPU-honest: virtual meshes share one host, so one rank's S/N-query
+chunked compute is what a real N-host gang pays per host), with a
+small-scale ring-vs-single-host token parity gate on every line — the
+Round 18 ``bench_r18/longctx_prefill.jsonl``.
+
 ``--kv-tiers`` runs the hierarchical-KV economy A/B at EQUAL HBM: the
 same Poisson-ordered shared-prefix request sequence drives a single-
 tier paged engine and a tiered one (host+disk ``PageTierStore`` sized
@@ -62,6 +77,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import threading
 import time
@@ -114,7 +130,24 @@ def main(argv=None) -> int:
                    help="tokens per device dispatch "
                         "(SlotServer.step_many)")
     p.add_argument("--engine", default="slot",
-                   choices=["slot", "paged", "disagg", "fleet"])
+                   choices=["slot", "paged", "disagg", "fleet", "moe",
+                            "longctx"])
+    p.add_argument("--moe-experts", type=int, default=8,
+                   help="moe engine: expert count E (top-2 dropless)")
+    p.add_argument("--moe-ffn", type=int, default=256,
+                   help="moe engine: per-expert FFN width F; the "
+                        "dense-FLOPs control arm runs ffn_dim = E x F")
+    p.add_argument("--moe-dim", type=int, default=128,
+                   help="moe engine: model width; raise it until the "
+                        "decode step is FFN-FLOPs-bound on the host "
+                        "being benched (tiny widths are dispatch-"
+                        "latency-bound and hide the routing win)")
+    p.add_argument("--gang-sizes", default="1,2,4",
+                   help="longctx engine: sp gang sizes to time the "
+                        "critical-path rank's prefill compute at")
+    p.add_argument("--prompt-tokens", type=int, default=32768,
+                   help="longctx engine: fixed long-prompt length the "
+                        "gang-size ladder prefills")
     p.add_argument("--replicas", type=int, default=2,
                    help="fleet engine: decode replica count")
     p.add_argument("--route-policy", default="affinity",
@@ -144,6 +177,14 @@ def main(argv=None) -> int:
                         "(3 JSON lines, bench_r16/kv_tiers.jsonl)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
+
+    # the round-18 arithmetic arms build their own configs and (for the
+    # mesh parity gates) need the virtual device count set BEFORE jax's
+    # backend initializes — dispatch before the first jax import
+    if args.engine == "moe":
+        return _moe_bench(args)
+    if args.engine == "longctx":
+        return _longctx_bench(args)
 
     import jax
 
@@ -805,6 +846,220 @@ def _kv_tiers_bench(args, cfg, params, quant_applied) -> int:
         "backend": jax.devices()[0].platform,
     }), flush=True)
     return 0
+
+
+def _force_virtual_devices() -> None:
+    """Give the host platform 8 virtual devices BEFORE jax's backend
+    initializes (mirrors ``tests/_jax_cpu``) so the arithmetic arms'
+    mesh parity gates run on a laptop/CI CPU; harmless on real
+    accelerators — the flag only sizes the host platform."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _moe_bench(args) -> int:
+    """Routed-FFN decode economics, parity-gated: the paged engine with
+    a top-2 dropless expert bank (E experts x F wide) vs the
+    dense-FLOPs control arm — a dense model at ``ffn_dim = E x F``, the
+    FLOPs you pay for the same parameter capacity without routing. Each
+    MoE arm's receipt carries a token-exact parity gate against
+    ``generate_stepwise_moe`` at the benched config; one JSON line per
+    arm — the Round 18 ``bench_r18/moe_decode.jsonl``."""
+    _force_virtual_devices()
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama
+    from dcos_commons_tpu.models.serving import PagedServer
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+    from dcos_commons_tpu.parallel.moe import MoEConfig, dropless
+
+    e, f = args.moe_experts, args.moe_ffn
+    base = dict(vocab_size=512, dim=args.moe_dim, n_layers=2, n_heads=8,
+                n_kv_heads=4, max_seq=256, remat=False,
+                attn_impl="dense")
+    cfg_moe = llama.LlamaConfig(ffn_dim=f, **base)
+    cfg_dense = llama.LlamaConfig(ffn_dim=e * f, **base)
+    moe = dropless(MoEConfig(num_experts=e))
+    params_moe = llama.init_moe_params(cfg_moe, e, jax.random.key(0))
+    params_dense = llama.init_params(cfg_dense, jax.random.key(0))
+
+    rng = random.Random(args.seed)
+    n_streams = max(2, args.slots)
+    reqs = [{"prompt": [rng.randrange(cfg_moe.vocab_size)
+                        for _ in range(24 + rng.randrange(16))],
+             "max_new": args.max_new, "request_id": i}
+            for i in range(n_streams)]
+    warm = [{"prompt": list(r["prompt"]), "max_new": 2,
+             "request_id": ("w", r["request_id"])} for r in reqs]
+
+    want = {}
+    for r in reqs:
+        toks = llama.generate_stepwise_moe(
+            cfg_moe, params_moe, jnp.asarray([r["prompt"]], jnp.int32),
+            r["max_new"], moe)
+        want[r["request_id"]] = [int(t) for t in toks[0]]
+
+    ep_mesh = (MeshSpec(ep=4, dp=len(jax.devices()) // 4).build()
+               if len(jax.devices()) >= 4 and e % 4 == 0 else None)
+    arms = [("dense_flops", cfg_dense, params_dense, None, None),
+            ("moe", cfg_moe, params_moe, moe, None)]
+    if ep_mesh is not None:
+        arms.append(("moe_ep", cfg_moe, params_moe, moe, ep_mesh))
+
+    rc = 0
+    for name, cfg, params, arm_moe, mesh in arms:
+        def make():
+            return PagedServer(cfg, params, slots=n_streams,
+                               page_size=args.page_size
+                               if cfg.max_seq % args.page_size == 0
+                               else 32,
+                               prefill_chunk=args.prefill_chunk,
+                               mesh=mesh, moe=arm_moe)
+        make().drain([dict(r) for r in warm],
+                     decode_window=args.decode_window)  # compile-warm
+        eng = make()
+        t0 = time.perf_counter()
+        got = eng.drain([dict(r) for r in reqs],
+                        decode_window=args.decode_window)
+        dt = time.perf_counter() - t0
+        toks = sum(len(v) for v in got.values())
+        parity = None
+        if arm_moe is not None:
+            parity = {"ok": got == want, "streams": len(reqs)}
+            if not parity["ok"]:
+                rc = 1
+        print(json.dumps({
+            "metric": "moe_decode", "engine": "moe", "arm": name,
+            "experts": e if arm_moe is not None else None,
+            "ffn_dim": cfg.ffn_dim,
+            "active_ffn_per_token": (2 * f if arm_moe is not None
+                                     else e * f),
+            "expert_parallel": (mesh.shape["ep"] if mesh is not None
+                                else 1),
+            "streams": len(reqs), "max_new": args.max_new,
+            "decode_window": args.decode_window, "seed": args.seed,
+            "tokens": toks, "decode_s": round(dt, 3),
+            "tok_per_s": round(toks / dt, 2),
+            "parity": parity,
+            "ledger_violations": len(eng.ledger_violations()),
+            "backend": jax.devices()[0].platform,
+        }), flush=True)
+    return rc
+
+
+def _longctx_bench(args) -> int:
+    """Sequence-parallel prefill economics, CPU-honest: at a fixed long
+    prompt, time the CRITICAL-PATH rank's prefill compute for each gang
+    size N — its S/N queries attending over the full sequence, consumed
+    in fixed chunks exactly as the engine's prefill executes. Virtual
+    CPU meshes share one host, so timing the whole shard_map would
+    charge one machine for N ranks' work; timing one rank is what a
+    real N-host gang pays. A small-scale token-exact parity gate
+    (ring-prefilled paged engine vs single-host greedy) rides every
+    line; one JSON line per gang size — the Round 18
+    ``bench_r18/longctx_prefill.jsonl``."""
+    _force_virtual_devices()
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama
+
+    s = args.prompt_tokens
+    gangs = sorted({int(g) for g in args.gang_sizes.split(",")})
+    if any(s % g for g in gangs):
+        print(json.dumps({"metric": "longctx_prefill", "error":
+                          f"--prompt-tokens {s} must divide every "
+                          f"gang size in {gangs}"}), flush=True)
+        return 1
+    cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=s,
+                                 attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.key(0))
+    rope = llama.rope_frequencies(cfg.head_dim, cfg.max_seq,
+                                  cfg.rope_theta)
+    chunk = min(512, s)
+
+    @jax.jit
+    def step(params, cache, toks, pos):
+        logits, cache = llama.extend_step(cfg, params, cache, toks,
+                                          pos, rope=rope)
+        return logits[:, -1], cache
+
+    parity = _ring_parity_gate(args)
+    rng = random.Random(args.seed)
+    prompt = jnp.asarray([[rng.randrange(cfg.vocab_size)
+                           for _ in range(s)]], jnp.int32)
+    # compile + first-touch warm once; the executable is shared by all
+    # gang sizes (fixed chunk shape, traced position)
+    cache = llama.init_kv_cache(cfg, 1, cfg.max_seq)
+    out, cache = step(params, cache, prompt[:, :chunk], jnp.int32(0))
+    jax.block_until_ready(out)
+    del cache
+
+    for n in gangs:
+        qlen = s // n
+        start = s - qlen       # last rank: S/N queries over ALL S keys
+        # cache CONTENT does not change the compute; a zero cache times
+        # the same executable a real rank runs after its ring exchange
+        cache = llama.init_kv_cache(cfg, 1, cfg.max_seq)
+        t0 = time.perf_counter()
+        pos = start
+        while pos < s:
+            out, cache = step(params, cache, prompt[:, pos:pos + chunk],
+                              jnp.int32(pos))
+            pos += chunk
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        del cache
+        print(json.dumps({
+            "metric": "longctx_prefill", "engine": "longctx",
+            "gang": n, "prompt_tokens": s, "rank_tokens": qlen,
+            "chunk": chunk, "seed": args.seed,
+            "per_host_compute_s": round(dt, 3),
+            "rank_tok_per_s": round(qlen / dt, 2),
+            "parity": parity,
+            "backend": jax.devices()[0].platform,
+        }), flush=True)
+    return 0 if parity["ok"] else 1
+
+
+def _ring_parity_gate(args) -> dict:
+    """Token-exactness gate for the longctx receipts: ring-prefilled
+    streams through a real sp gang vs single-host greedy, at the small
+    scale the virtual-device mesh can execute."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama
+    from dcos_commons_tpu.models.serving import PagedServer
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+    if len(jax.devices()) < 4:
+        return {"ok": False, "skipped":
+                f"{len(jax.devices())} device(s), need 4 for the gate"}
+    cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                 attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.key(0))
+    mesh = MeshSpec(sp=4, dp=len(jax.devices()) // 4).build()
+    rng = random.Random(args.seed)
+    reqs = [{"prompt": [rng.randrange(cfg.vocab_size)
+                        for _ in range(48 + rng.randrange(12))],
+             "max_new": 5, "request_id": i} for i in range(3)]
+    want = {}
+    for r in reqs:
+        toks = llama.generate_stepwise(
+            cfg, params, jnp.asarray([r["prompt"]], jnp.int32),
+            r["max_new"])
+        want[r["request_id"]] = [int(t) for t in toks[0]]
+    eng = PagedServer(cfg, params, slots=2, page_size=16,
+                      prefill_chunk=8, mesh=mesh, longctx_ring=4)
+    got = eng.drain([dict(r) for r in reqs])
+    return {"ok": got == want and not eng.ledger_violations(),
+            "streams": len(reqs), "ring_prefills": eng.ring_prefills}
 
 
 if __name__ == "__main__":

@@ -1,0 +1,201 @@
+"""Time-capped long-context prefill smoke for CI: run the sequence-
+parallel ring prefill on a gang-sized mesh and fail the build on the
+first token where a ring-prefilled stream diverges from single-host
+greedy decode — plus the degrade discipline (a prompt the ring cannot
+take falls back to chunked prefill with a counted fallback, never a
+dropped stream) and the mesh/max_seq guards that must refuse at
+construction.
+
+The prefill-time-vs-gang-size receipts live in
+``tools/bench_serving.py --engine longctx``; this is the always-on
+slice test.sh runs next to the other smokes. Checks run in a fixed
+order and stop (skip, not fail) when the time budget runs out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+# ring prefill needs an sp mesh; mirror tests/_jax_cpu BEFORE jax's
+# backend is selected (harmless on real accelerators: the flag only
+# sizes the host platform)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget-s", type=float, default=90.0,
+                    help="wall-clock cap; tail checks are skipped, not "
+                         "failed, when it runs out (default 90)")
+    args = ap.parse_args(argv)
+    deadline = time.monotonic() + args.budget_s
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dcos_commons_tpu.models import llama, serving
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+    from dcos_commons_tpu.parallel.ring_attention import ring_pad_len
+
+    if len(jax.devices()) < 4:
+        print(f"longctx-smoke: {len(jax.devices())} device(s), need 4 "
+              "for the sp gang; all checks skipped")
+        return 0
+
+    cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                 attn_impl="dense")
+    params = llama.init_params(cfg, jax.random.key(0))
+    mesh = MeshSpec(sp=4, dp=len(jax.devices()) // 4).build()
+
+    def rand_prompt(seed, n):
+        return [int(t) for t in jax.random.randint(
+            jax.random.key(seed), (n,), 0, cfg.vocab_size)]
+
+    def solo(prompt, steps):
+        toks = llama.generate_stepwise(
+            cfg, params, jnp.asarray([prompt], jnp.int32), steps)
+        return [int(t) for t in toks[0]]
+
+    ran = 0
+
+    def _spent(name: str) -> bool:
+        if time.monotonic() >= deadline:
+            print(f"longctx-smoke: time budget exhausted after {ran} "
+                  f"checks; {name!r} and later checks skipped")
+            return True
+        return False
+
+    # 1. trunk parity: prefill_ring's hidden states and K/V must match
+    # the single-host prefill trunk — the K/V go STRAIGHT into the page
+    # table, so a mismatch here is silent cache corruption
+    if _spent("trunk-parity"):
+        return 0
+    s = ring_pad_len(48, 4, 16)
+    prompt = jnp.asarray([rand_prompt(310, s)], jnp.int32)
+    rope = llama.rope_frequencies(cfg.head_dim, cfg.max_seq,
+                                  cfg.rope_theta)
+    x_ref, ks_ref, vs_ref = llama.prefill_trunk(cfg, params, prompt,
+                                                rope)
+    x_ring, ks_ring, vs_ring = llama.prefill_ring(cfg, params, prompt,
+                                                  mesh)
+    for name, a, b in (("hidden", x_ref, x_ring),
+                       ("keys", ks_ref, ks_ring),
+                       ("values", vs_ref, vs_ring)):
+        if not np.allclose(np.asarray(a, np.float32),
+                           np.asarray(b, np.float32),
+                           atol=2e-2, rtol=2e-2):
+            print(f"longctx-smoke FAILED: ring prefill {name} diverged "
+                  f"from the single-host trunk", file=sys.stderr)
+            return 1
+    ran += 1
+
+    # 2. engine token parity: prompts over the ring threshold prefill
+    # in one tick across the gang and must decode the exact single-host
+    # greedy streams; short prompts stay on the chunked path
+    if _spent("engine-parity"):
+        return 0
+    reqs = [{"prompt": rand_prompt(320 + i, n), "max_new": m,
+             "request_id": i}
+            for i, (n, m) in enumerate([(60, 4), (33, 6), (7, 5)])]
+    want = {r["request_id"]: solo(r["prompt"], r["max_new"])
+            for r in reqs}
+    eng = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                              prefill_chunk=8, mesh=mesh,
+                              longctx_ring=4)
+    got = eng.drain([dict(r) for r in reqs])
+    if got != want:
+        print("longctx-smoke FAILED: ring-prefilled streams diverged "
+              "from single-host greedy", file=sys.stderr)
+        return 1
+    stats = eng.page_stats()["longctx"]
+    if eng.ring_prefills != 2 or stats["ring"] != 4:
+        print(f"longctx-smoke FAILED: ring path never ran ({stats})",
+              file=sys.stderr)
+        return 1
+    if eng.ledger_violations():
+        print("longctx-smoke FAILED: ledger violations after ring "
+              "drain", file=sys.stderr)
+        return 1
+    ran += 1
+
+    # 3. degrade-not-drop: when the ring executable itself fails (the
+    # compiler-rejection class _ring_prefill's except arm exists for),
+    # the stream must land on the chunked path with a counted coded
+    # fallback, still token-exact — then ring service resumes once the
+    # injected failure clears
+    if _spent("fallback-discipline"):
+        return 0
+    eng = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                              prefill_chunk=8, mesh=mesh,
+                              longctx_ring=4)
+
+    def _broken_ring_exec(s_pad):
+        raise RuntimeError("injected ring compile failure")
+
+    eng._ring_exec = _broken_ring_exec
+    long_p = rand_prompt(330, 40)
+    got = eng.drain([{"prompt": long_p, "max_new": 4,
+                      "request_id": "degraded"}])
+    if got["degraded"] != solo(long_p, 4):
+        print("longctx-smoke FAILED: fallback stream is not "
+              "token-exact", file=sys.stderr)
+        return 1
+    if eng.longctx_fallbacks != 1 or eng.ring_prefills != 0:
+        print("longctx-smoke FAILED: ring failure did not count a "
+              f"longctx fallback ({eng.page_stats()['longctx']})",
+              file=sys.stderr)
+        return 1
+    del eng._ring_exec                 # clear the injected failure
+    again = eng.drain([{"prompt": rand_prompt(332, 40), "max_new": 4,
+                        "request_id": "healed"}])
+    if eng.ring_prefills != 1 or "healed" not in again:
+        print("longctx-smoke FAILED: ring service did not resume after "
+              "the injected failure cleared", file=sys.stderr)
+        return 1
+    ran += 1
+
+    # 4. construction guards: a ring without a matching sp axis, or one
+    # that cannot divide max_seq, must refuse up front — not corrupt
+    # page tables at the first long prompt
+    if _spent("construction-guards"):
+        return 0
+    try:
+        serving.PagedServer(cfg, params, slots=2, page_size=16,
+                            longctx_ring=4)
+    except ValueError:
+        pass
+    else:
+        print("longctx-smoke FAILED: ring armed without an sp mesh",
+              file=sys.stderr)
+        return 1
+    cfg66 = llama.LlamaConfig.tiny(n_layers=2, max_seq=66,
+                                   attn_impl="dense")
+    try:
+        serving.PagedServer(cfg66, llama.init_params(
+            cfg66, jax.random.key(0)), slots=2, page_size=6,
+            prefill_chunk=6, mesh=mesh, longctx_ring=4)
+    except ValueError:
+        pass
+    else:
+        print("longctx-smoke FAILED: ring armed over an indivisible "
+              "max_seq", file=sys.stderr)
+        return 1
+    ran += 1
+
+    print(f"longctx-smoke: {ran} checks passed — ring prefill matches "
+          f"the single-host trunk and decodes token-exact streams, "
+          f"disqualified prompts degrade to chunked prefill with "
+          f"counted fallbacks, and bad ring/mesh configs refuse at "
+          f"construction")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

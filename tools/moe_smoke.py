@@ -1,0 +1,166 @@
+"""Time-capped MoE serving smoke for CI: route the tiny model's FFN
+through the top-2 expert bank and fail the build on the first token
+where the paged engine diverges from the stepwise MoE reference — plus
+the capacity-overflow discipline (deterministic degradation, never a
+dropped stream) and the router/params coupling guards that must refuse
+with coded ``ValueError``s instead of emitting silently-dense tokens.
+
+The tok/s-vs-dense receipts live in ``tools/bench_serving.py
+--engine moe``; this is the always-on slice test.sh runs next to the
+other smokes. Checks run in a fixed order and stop (skip, not fail)
+when the time budget runs out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+# the expert-parallel check needs a multi-device mesh; mirror
+# tests/_jax_cpu BEFORE jax's backend is selected (harmless on real
+# accelerators: the flag only sizes the host platform)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget-s", type=float, default=90.0,
+                    help="wall-clock cap; tail checks are skipped, not "
+                         "failed, when it runs out (default 90)")
+    args = ap.parse_args(argv)
+    deadline = time.monotonic() + args.budget_s
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import llama, serving
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+    from dcos_commons_tpu.parallel.moe import MoEConfig, dropless
+
+    cfg = llama.LlamaConfig.tiny(n_layers=2, max_seq=64,
+                                 attn_impl="dense")
+    moe = dropless(MoEConfig(num_experts=4))
+    params = llama.init_moe_params(cfg, 4, jax.random.key(0))
+
+    def rand_prompt(seed, n):
+        return [int(t) for t in jax.random.randint(
+            jax.random.key(seed), (n,), 0, cfg.vocab_size)]
+
+    reqs = [{"prompt": rand_prompt(210 + i, n), "max_new": m,
+             "request_id": i}
+            for i, (n, m) in enumerate([(8, 6), (5, 9), (14, 5)])]
+    ran = 0
+
+    def _spent(name: str) -> bool:
+        if time.monotonic() >= deadline:
+            print(f"moe-smoke: time budget exhausted after {ran} "
+                  f"checks; {name!r} and later checks skipped")
+            return True
+        return False
+
+    # 1. dropless parity: the paged engine's chunk/window grouping must
+    # not move a single token vs the whole-prompt stepwise reference —
+    # the token-exactness contract MoE serving ships under
+    if _spent("dropless-parity"):
+        return 0
+    want = {}
+    for r in reqs:
+        toks = llama.generate_stepwise_moe(
+            cfg, params, jnp.asarray([r["prompt"]], jnp.int32),
+            r["max_new"], moe)
+        want[r["request_id"]] = [int(t) for t in toks[0]]
+    eng = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                              prefill_chunk=8, moe=moe)
+    got = eng.drain([dict(r) for r in reqs], decode_window=4)
+    if got != want:
+        print("moe-smoke FAILED: paged MoE streams diverged from the "
+              "stepwise MoE reference", file=sys.stderr)
+        return 1
+    stats = eng.page_stats()["moe"]
+    if stats is None or stats["experts"] != 4:
+        print(f"moe-smoke FAILED: moe stats missing ({stats})",
+              file=sys.stderr)
+        return 1
+    if eng.ledger_violations():
+        print("moe-smoke FAILED: ledger violations after MoE drain",
+              file=sys.stderr)
+        return 1
+    ran += 1
+
+    # 2. expert-parallel parity: the same streams through an ep mesh
+    # (the all_to_all dispatch hot path) must be token-identical — the
+    # sharded layer is bitwise the local one
+    if _spent("expert-parallel-parity"):
+        return 0
+    if len(jax.devices()) >= 4:
+        mesh = MeshSpec(ep=4, dp=len(jax.devices()) // 4).build()
+        got_ep = serving.PagedServer(
+            cfg, params, slots=2, page_size=16, prefill_chunk=8,
+            mesh=mesh, moe=moe).drain([dict(r) for r in reqs])
+        if got_ep != want:
+            print("moe-smoke FAILED: expert-parallel streams diverged "
+                  "from the local MoE path", file=sys.stderr)
+            return 1
+        ran += 1
+    else:
+        print(f"moe-smoke: {len(jax.devices())} device(s); "
+              "expert-parallel parity check skipped")
+
+    # 3. overflow discipline: a tight capacity factor drops ROUTES, not
+    # streams — every request still finishes, and the degradation is
+    # bitwise deterministic (rerun-identical), never sampling noise
+    if _spent("overflow-determinism"):
+        return 0
+    tight = MoEConfig(num_experts=4, capacity_factor=0.5)
+    runs = []
+    for _ in range(2):
+        e = serving.PagedServer(cfg, params, slots=2, page_size=16,
+                                prefill_chunk=8, moe=tight)
+        runs.append(e.drain([dict(r) for r in reqs]))
+        if e.ledger_violations():
+            print("moe-smoke FAILED: ledger violations under overflow",
+                  file=sys.stderr)
+            return 1
+    if sorted(runs[0]) != sorted(r["request_id"] for r in reqs):
+        print("moe-smoke FAILED: overflow dropped a stream",
+              file=sys.stderr)
+        return 1
+    if runs[0] != runs[1]:
+        print("moe-smoke FAILED: overflow degradation is not "
+              "deterministic across reruns", file=sys.stderr)
+        return 1
+    ran += 1
+
+    # 4. coupling guards: dense params + moe config (and vice versa)
+    # must refuse at construction — a silently-dense MoE engine would
+    # pass every parity check while serving the wrong model
+    if _spent("coupling-guards"):
+        return 0
+    dense = llama.init_params(cfg, jax.random.key(0))
+    for eng_params, eng_moe, what in ((dense, moe, "router-less params"),
+                                      (params, None, "unrouted config")):
+        try:
+            serving.PagedServer(cfg, eng_params, slots=2, page_size=16,
+                                moe=eng_moe)
+        except ValueError:
+            continue
+        print(f"moe-smoke FAILED: engine accepted {what}",
+              file=sys.stderr)
+        return 1
+    ran += 1
+
+    print(f"moe-smoke: {ran} checks passed — paged MoE decode stays "
+          f"token-exact with the stepwise reference (expert-parallel "
+          f"included), capacity overflow degrades deterministically "
+          f"without dropping streams, and mismatched router/params "
+          f"refuse at construction")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
