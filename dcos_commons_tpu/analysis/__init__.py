@@ -1,9 +1,13 @@
-"""Static-analysis engine: ServiceSpec/plan S-rules + jaxpr J-rules.
+"""Static-analysis engine: ServiceSpec/plan S-rules, concurrency
+T-rules + runtime witness, and jaxpr J-rules.
 
 The spec half (``lint_spec``) is dependency-light and runs at spec-load
 time, scheduler startup (fail-fast), and in the ``lint`` CLI verb. The
-jaxpr half (``lint_entrypoints``) imports jax lazily — tracing the
-registered hot paths is a CI-gate concern, not a scheduler-runtime one.
+thread half (``lint_threads``, plus the runtime ``witness``) is
+stdlib-``ast`` only and eager for the same reason — CycleDriver
+fail-fasts on both at startup. The jaxpr half (``lint_entrypoints``)
+imports jax lazily — tracing the registered hot paths is a CI-gate
+concern, not a scheduler-runtime one.
 
 Rule catalogue: docs/static-analysis.md (generated from the registry's
 code/title/fix-hint fields; ``python -m dcos_commons_tpu.analysis
@@ -13,11 +17,16 @@ code/title/fix-hint fields; ``python -m dcos_commons_tpu.analysis
 from .findings import (Finding, REGISTRY, Rule, Severity, errors,
                        filter_suppressed, render_report)
 from .spec_rules import lint_spec, lint_spec_file, topology_chip_count
+from .thread_rules import (LOCKGRAPH_PATH, lint_threads,
+                           lint_threads_cached, update_lock_graph)
+from . import witness
 
 __all__ = [
     "Finding", "REGISTRY", "Rule", "Severity", "errors",
     "filter_suppressed", "render_report", "lint_spec", "lint_spec_file",
     "topology_chip_count",
+    "LOCKGRAPH_PATH", "lint_threads", "lint_threads_cached",
+    "update_lock_graph", "witness",
     # lazy (import jax): walk_avals, lint_jaxpr, collective_census,
     # lint_entrypoints, compute_census, load_manifest, save_manifest,
     # HOT_PATHS
@@ -30,11 +39,16 @@ _JAXPR_EXPORTS = {
     "rule_j2_scan_widening": "jaxpr_rules",
     "rule_j3_census_diff": "jaxpr_rules",
     "rule_j4_host_callbacks": "jaxpr_rules",
+    "rule_j5_donation": "jaxpr_rules",
+    "rule_j6_gang_order": "jaxpr_rules",
+    "collective_sequence": "jaxpr_rules",
     "COLLECTIVE_PRIMS": "jaxpr_rules",
     "lint_entrypoints": "entrypoints", "compute_census": "entrypoints",
     "load_manifest": "entrypoints", "save_manifest": "entrypoints",
     "HOT_PATHS": "entrypoints", "HotPath": "entrypoints",
     "register_hot_path": "entrypoints", "MANIFEST_PATH": "entrypoints",
+    "DonationSite": "entrypoints", "DONATION_SITES": "entrypoints",
+    "register_donation_site": "entrypoints",
 }
 
 

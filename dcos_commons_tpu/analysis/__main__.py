@@ -4,8 +4,12 @@
 ``--update-manifest`` re-traces every entrypoint and rewrites
 ``collective_manifest.json`` (do this ONLY for an intentional sharding
 change, and say why in the PR — the whole point of the census is that the
-diff is reviewed). Default action: lint all entrypoints against the
-checked-in manifest (the J-half of the CI gate).
+diff is reviewed). ``--update-lockgraph`` is the same workflow for the
+T-rules' ``lock_order.json``: re-derive the static lock-order graph and
+rewrite the baseline — review the edge diff in the PR. Default action:
+lint all entrypoints against the checked-in manifest (the J-half of the
+CI gate) plus the T-rule concurrency lint against the lock-graph
+baseline.
 """
 
 from __future__ import annotations
@@ -37,6 +41,9 @@ def main(argv=None) -> int:
     p.add_argument("--update-manifest", action="store_true",
                    help="re-trace entrypoints, rewrite "
                         "collective_manifest.json")
+    p.add_argument("--update-lockgraph", action="store_true",
+                   help="re-derive the serving-tier lock-order graph, "
+                        "rewrite lock_order.json")
     p.add_argument("--entrypoints", nargs="*", default=None,
                    help="subset of registered entrypoints")
     p.add_argument("--suppress", default="",
@@ -48,9 +55,19 @@ def main(argv=None) -> int:
 
     from . import REGISTRY
     if args.list_rules:
+        # the J-rules register on (lazy) jaxpr_rules import; pull them in
+        # so the catalogue is complete
+        from . import jaxpr_rules  # noqa: F401
         for rule in REGISTRY.all():
             print(f"{rule.code}  [{rule.family}] {rule.title}\n"
                   f"      fix: {rule.fix_hint}")
+        return 0
+
+    if args.update_lockgraph:
+        from . import LOCKGRAPH_PATH, update_lock_graph
+        nlocks, nedges = update_lock_graph()
+        print(f"lock_order.json updated: {nlocks} lock(s), "
+              f"{nedges} edge(s) ({LOCKGRAPH_PATH})")
         return 0
 
     if not args.tpu:
@@ -69,6 +86,9 @@ def main(argv=None) -> int:
 
     suppress = {c for c in args.suppress.split(",") if c}
     findings = lint_entrypoints(args.entrypoints, suppress=suppress)
+    if args.entrypoints is None:
+        from . import lint_threads
+        findings += lint_threads(suppress=suppress)
     print(render_report(findings, label="jaxpr-lint"))
     from . import errors
     return 1 if errors(findings) else 0

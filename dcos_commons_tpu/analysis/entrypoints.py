@@ -44,6 +44,10 @@ class HotPath:
     # capability probe: None = traceable, else the skip reason (e.g. the
     # installed jax lacks shard_map; mirrors the tests' skipif markers)
     requires: Callable[[], Optional[str]] = lambda: None
+    # entrypoints sharing a gang_group are declared gang-equivalent:
+    # every rank of the slice runs one of them in lockstep, so J6
+    # requires their collective sequences to be identical
+    gang_group: Optional[str] = None
 
 
 HOT_PATHS: Dict[str, HotPath] = {}
@@ -54,6 +58,29 @@ def register_hot_path(hot_path: HotPath) -> HotPath:
         raise ValueError(f"duplicate entrypoint {hot_path.name}")
     HOT_PATHS[hot_path.name] = hot_path
     return hot_path
+
+
+@dataclass(frozen=True)
+class DonationSite:
+    """One ``donate_argnums`` site on a hot path: how to rebuild the
+    (fn, abstract args, donated argnums) triple so J5 can check the
+    aliasing contract without compiling anything."""
+
+    name: str
+    build: Callable[[], tuple]   # -> (fn, args, donate_argnums)
+    description: str = ""
+    devices_needed: int = 1
+    requires: Callable[[], Optional[str]] = lambda: None
+
+
+DONATION_SITES: Dict[str, DonationSite] = {}
+
+
+def register_donation_site(site: DonationSite) -> DonationSite:
+    if site.name in DONATION_SITES:
+        raise ValueError(f"duplicate donation site {site.name}")
+    DONATION_SITES[site.name] = site
+    return site
 
 
 # ---------------------------------------------------------------------------
@@ -422,6 +449,137 @@ register_hot_path(HotPath(
 
 
 # ---------------------------------------------------------------------------
+# donation sites (J5): the shipped donate_argnums, as abstract recipes
+
+def _donation_train_step():
+    import optax
+
+    from ..models import llama
+    cfg = _train_cfg(True)
+    params = _abstract_params(
+        lambda: llama.init_params(cfg, jax.random.key(0)))
+    opt = optax.sgd(1e-2)
+    opt_state = jax.eval_shape(opt.init, params)
+    toks = jax.ShapeDtypeStruct((_TRAIN_B, _TRAIN_S), jnp.int32)
+
+    def step(p, s, t):
+        loss, grads = jax.value_and_grad(
+            lambda p_: llama.loss_fn(cfg, p_, t)[0])(p)
+        updates, s = opt.update(grads, s, p)
+        p = optax.apply_updates(p, updates)
+        return p, s, loss
+
+    return step, (params, opt_state, toks), (0, 1)
+
+
+def _donation_decode_step_paged():
+    from ..models import llama
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    slots, page_size = 4, 16
+    per_stream = cfg.max_seq // page_size
+    params = _abstract_params(
+        lambda: llama.init_params(cfg, jax.random.key(0)))
+    pool = _abstract_params(
+        lambda: llama.init_page_pool(cfg, slots * per_stream + 1,
+                                     page_size))
+    table = jax.ShapeDtypeStruct((slots, per_stream), jnp.int32)
+    lengths = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+
+    def step(p, pl, tbl, ln, tok):
+        return llama.decode_step_paged(cfg, p, pl, tbl, ln, tok)
+
+    return step, (params, pool, table, lengths, tokens), (1,)
+
+
+def _donation_spec_window():
+    # same window program and shapes as _trace_spec_decode_paged, but
+    # returning (fn, args, donate) instead of the traced jaxpr
+    from ..models import llama
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    cfg_d = llama.LlamaConfig.tiny(n_layers=1)
+    slots, page_size, k = 4, 16, 4
+    per_stream = cfg.max_seq // page_size
+    params = _abstract_params(
+        lambda: llama.init_params(cfg, jax.random.key(0)))
+    params_d = _abstract_params(
+        lambda: llama.init_params(cfg_d, jax.random.key(0)))
+    pool = _abstract_params(
+        lambda: llama.init_page_pool(cfg, slots * per_stream + 1,
+                                     page_size))
+    cache_d = _abstract_params(
+        lambda: llama.init_kv_cache(cfg_d, slots, cfg_d.max_seq))
+    table = jax.ShapeDtypeStruct((slots, per_stream), jnp.int32)
+    lengths = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    mask = jax.ShapeDtypeStruct((slots,), jnp.bool_)
+
+    def window(p, pd, pl, cd, tbl, ln, tok, mk):
+        def dstep(carry, j):
+            cd, cur = carry
+            lg, cd = llama.decode_step_slots(cfg_d, pd, cd, ln + j, cur)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return (cd, jnp.where(mk, nxt, cur)), nxt
+
+        (cd, _), dtoks = jax.lax.scan(dstep, (cd, tok), jnp.arange(k))
+        window_toks = jnp.concatenate([tok[:, None], dtoks[:k - 1].T],
+                                      axis=1)
+        logits, pl = llama.verify_step_paged(cfg, p, pl, tbl, ln,
+                                             window_toks)
+        tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        agree = jnp.cumprod(
+            (dtoks[:k - 1].T == tgt[:, :k - 1]).astype(jnp.int32), axis=1)
+        n_emit = jnp.where(mk, jnp.sum(agree, axis=1) + 1, 0)
+        return pl, cd, tgt, n_emit, ln + n_emit
+
+    return (window,
+            (params, params_d, pool, cache_d, table, lengths, tokens,
+             mask),
+            (2, 3))
+
+
+def _donation_adopt_install():
+    from ..models import llama
+    from ..models.serving import _install_pages
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    page_size, span_pages = 16, 3
+    pages = 4 * (cfg.max_seq // page_size)
+    pool = _abstract_params(
+        lambda: llama.init_page_pool(cfg, pages + 1, page_size))
+    side = pool["k"]
+    payload = jax.ShapeDtypeStruct(
+        (side.shape[0], span_pages) + side.shape[2:], side.dtype)
+    phys = jax.ShapeDtypeStruct((span_pages,), jnp.int32)
+
+    def install(c, kp, vp, ph):
+        return {"k": _install_pages(c["k"], kp, ph),
+                "v": _install_pages(c["v"], vp, ph)}
+
+    return install, (pool, payload, payload, phys), (0,)
+
+
+register_donation_site(DonationSite(
+    "train_step_state", _donation_train_step,
+    description="models/train.py make_train_step: params + opt_state "
+                "donated into the updated params + opt_state (the "
+                "PR 14 wedge lived exactly here)"))
+register_donation_site(DonationSite(
+    "paged_decode_pool", _donation_decode_step_paged,
+    description="PagedServer._step_x: the page pool donated through "
+                "every decode step (pool dominates HBM; the step "
+                "returns a same-shaped pool)"))
+register_donation_site(DonationSite(
+    "spec_window_pool_and_draft", _donation_spec_window,
+    description="the speculative window executable: pool + draft slot "
+                "cache donated together (serving.py donate_argnums="
+                "(2, 3))"))
+register_donation_site(DonationSite(
+    "adopt_pages_install", _donation_adopt_install,
+    description="the adopt_pages install scatter: pool donated into "
+                "the page-installed pool (serving.py _adopt_exec)"))
+
+
+# ---------------------------------------------------------------------------
 # manifest + engine
 
 def load_manifest(path: str = MANIFEST_PATH) -> Dict[str, Dict[str, int]]:
@@ -439,7 +597,9 @@ def save_manifest(census: Mapping[str, Mapping[str, int]],
         f.write("\n")
 
 
-def _skip_reason(hot_path: HotPath) -> Optional[str]:
+def _skip_reason(hot_path) -> Optional[str]:
+    # duck-typed over HotPath and DonationSite (both carry
+    # devices_needed + requires)
     if len(jax.devices()) < hot_path.devices_needed:
         return (f"needs {hot_path.devices_needed} devices, have "
                 f"{len(jax.devices())}")
@@ -469,9 +629,13 @@ def lint_entrypoints(names: Optional[Iterable[str]] = None,
     Entrypoints needing more devices than the host has are reported as
     INFO, never silently dropped — a silent skip would read as 'covered'
     in CI logs."""
+    from .findings import filter_suppressed
+    from .jaxpr_rules import (collective_sequence, rule_j5_donation,
+                              rule_j6_gang_order)
     if manifest is None:
         manifest = load_manifest()
     findings: List[Finding] = []
+    traced: Dict[str, object] = {}
     for name in (names or sorted(HOT_PATHS)):
         hp = HOT_PATHS[name]
         reason = _skip_reason(hp)
@@ -479,7 +643,7 @@ def lint_entrypoints(names: Optional[Iterable[str]] = None,
             findings.append(Finding(
                 "J0", Severity.INFO, name, f"skipped: {reason}"))
             continue
-        jaxpr = hp.build()
+        jaxpr = traced[name] = hp.build()
         # an entrypoint with no manifest entry gets no census diff (the
         # baseline was never recorded — e.g. traced for the first time on
         # a host whose jax supports it); say so rather than diffing
@@ -494,4 +658,40 @@ def lint_entrypoints(names: Optional[Iterable[str]] = None,
             jaxpr, budget_bytes=hp.budget_bytes,
             expected_collectives=expected,
             location=name, suppress=suppress))
+    # J5: the shipped donation sites, checked abstractly
+    for name in sorted(DONATION_SITES):
+        site = DONATION_SITES[name]
+        reason = _skip_reason(site)
+        if reason is not None:
+            findings.append(Finding(
+                "J0", Severity.INFO, name, f"skipped: {reason}"))
+            continue
+        fn, args, donate = site.build()
+        findings.extend(filter_suppressed(
+            rule_j5_donation(fn, args, donate, location=name), suppress))
+    # J6: gang-equivalent entrypoints must agree on collective order.
+    # Only members traced above participate; a group reduced to <2
+    # traceable members is reported, not silently passed.
+    groups: Dict[str, Dict[str, List[str]]] = {}
+    skipped_gang: Dict[str, List[str]] = {}
+    for name, hp in sorted(HOT_PATHS.items()):
+        if hp.gang_group is None:
+            continue
+        if name in traced:
+            groups.setdefault(hp.gang_group, {})[name] = \
+                collective_sequence(traced[name])
+        else:
+            skipped_gang.setdefault(hp.gang_group, []).append(name)
+    for group in sorted(set(groups) | set(skipped_gang)):
+        seqs = groups.get(group, {})
+        if len(seqs) < 2:
+            findings.append(Finding(
+                "J0", Severity.INFO, f"gang:{group}",
+                f"gang group has {len(seqs)} traceable member(s) "
+                f"(skipped: {skipped_gang.get(group, [])}); order not "
+                f"compared"))
+            continue
+        findings.extend(filter_suppressed(
+            rule_j6_gang_order(group, seqs, location=f"gang:{group}"),
+            suppress))
     return findings

@@ -16,6 +16,13 @@ J3  collective census: counts of psum/all_gather/ppermute/reduce_scatter
     all-gather on the decode path is a diff, not a vibe
 J4  host callback inside a jitted hot path: every call is a device->host
     round-trip that stalls the step
+J5  donation aliasing: every leaf of a ``donate_argnums`` argument must
+    have a shape+dtype-identical output buffer to alias into (the PR 14
+    kill/resume wedge — a donated pool that cannot alias fails XLA's
+    per-device size check on step 1)
+J6  gang collective order: entrypoints declared gang-equivalent must
+    issue the identical collective sequence in program order (the
+    static form of a collective-deadlock check)
 
 All rules walk the jaxpr structurally (``walk_avals`` / ``walk_eqns``
 recurse through scan/pjit/custom-vjp sub-jaxprs), so they hold on the CPU
@@ -48,6 +55,19 @@ J4 = REGISTRY.register(Rule(
     "J4", "jaxpr", "host callback inside a jitted hot path",
     "remove debug/pure/io callbacks from the step function; log outside "
     "the jit boundary"))
+J5 = REGISTRY.register(Rule(
+    "J5", "jaxpr", "donated input with no shape+dtype-compatible output",
+    "XLA can only alias a donated buffer into an output of identical "
+    "shape and dtype; a donation that cannot alias either errors at "
+    "compile time on TPU or silently double-buffers — return a "
+    "same-shaped value or stop donating the argument (the PR 14 "
+    "kill/resume wedge, as a lint)"))
+J6 = REGISTRY.register(Rule(
+    "J6", "jaxpr", "gang-equivalent entrypoints diverge in collective order",
+    "every rank of a gang runs the same program; if two entrypoints "
+    "declared gang-equivalent issue different collective sequences, the "
+    "slice deadlocks at the first mismatched collective — make the "
+    "programs identical or split the gang declaration"))
 
 #: collective primitives the census counts (order = report order);
 #: all_to_all joined in round 18 for the MoE expert-dispatch reshards
@@ -175,6 +195,70 @@ def rule_j3_census_diff(jaxpr, expected: Mapping[str, int],
                 "J3", Severity.ERROR, location,
                 f"collective census drift: {prim} x{got}, manifest says "
                 f"x{want}"))
+    return out
+
+
+def collective_sequence(jaxpr) -> List[str]:
+    """Collective primitive names in PROGRAM ORDER (recursing through
+    sub-jaxprs) — the J6 comparand. Two gang-equivalent programs must
+    produce the identical list, or the slice deadlocks at the first
+    position where the ranks disagree."""
+    return [eqn.primitive.name
+            for eqn, _ in walk_eqns(_closed(jaxpr))
+            if eqn.primitive.name in COLLECTIVE_PRIMS]
+
+
+def _aval_key(leaf) -> tuple:
+    import numpy as np
+    return (tuple(getattr(leaf, "shape", ())),
+            str(np.dtype(getattr(leaf, "dtype", None))))
+
+
+def rule_j5_donation(fn, args, donate_argnums: Iterable[int],
+                     location: str = "") -> List[Finding]:
+    """Every leaf of a donated argument must find an unused output leaf
+    of identical shape+dtype — the aliasing contract XLA enforces.
+    Checked abstractly via ``jax.eval_shape`` (no FLOPs, no devices)."""
+    out_leaves = jax.tree.leaves(jax.eval_shape(fn, *args))
+    avail: Dict[tuple, int] = {}
+    for leaf in out_leaves:
+        key = _aval_key(leaf)
+        avail[key] = avail.get(key, 0) + 1
+    findings: List[Finding] = []
+    for argnum in sorted(donate_argnums):
+        for leaf in jax.tree.leaves(args[argnum]):
+            key = _aval_key(leaf)
+            if avail.get(key, 0) > 0:
+                avail[key] -= 1
+                continue
+            findings.append(Finding(
+                "J5", Severity.ERROR, location,
+                f"donated arg {argnum} leaf {key[0]}:{key[1]} has no "
+                f"shape+dtype-compatible output buffer to alias into"))
+    return findings
+
+
+def rule_j6_gang_order(group: str,
+                       sequences: Mapping[str, List[str]],
+                       location: str = "") -> List[Finding]:
+    """All members of a gang group must issue the identical collective
+    sequence; the first member (sorted) is the reference."""
+    items = sorted(sequences.items())
+    if len(items) < 2:
+        return []
+    ref_name, ref = items[0]
+    out: List[Finding] = []
+    for name, seq in items[1:]:
+        if list(seq) == list(ref):
+            continue
+        idx = next((i for i, (a, b) in enumerate(zip(ref, seq))
+                    if a != b), min(len(ref), len(seq)))
+        out.append(Finding(
+            "J6", Severity.ERROR, location or group,
+            f"gang group {group!r}: {name} issues {list(seq)} but "
+            f"{ref_name} issues {list(ref)} (first divergence at "
+            f"collective #{idx}) — mismatched order deadlocks the "
+            f"slice"))
     return out
 
 

@@ -1,0 +1,947 @@
+"""T-rules: AST concurrency lint for the threaded serving tier.
+
+The serving tier (PRs 12-18) is the only genuinely multi-threaded part
+of the SDK: HTTP handler threads, sender/probe loops, and the
+loop-driven engine thread all share router/disagg/migrate/paging state
+behind ``threading.Lock``s. Two shipped bugs (the PR 14 donation shape
+mismatch, the PR 12 QoS-rename race) were defect classes a static pass
+catches before review — so, like the S-rules enforce the spec contract
+and the J-rules the jaxpr contract, the T-rules enforce the locking
+contract:
+
+* **T1** — lock-order graph. Which locks are acquired while which are
+  held, across ``with self._lock:`` scopes and helper-call edges.
+  Cycles are errors; the acyclic graph is diffed against the
+  checked-in ``lock_order.json`` baseline (maintained with
+  ``python -m dcos_commons_tpu.analysis --update-lockgraph`` — the
+  ``collective_manifest.json`` workflow). The same baseline feeds the
+  runtime witness (``analysis/witness.py``): the static graph and the
+  chaos soaks validate each other.
+* **T2** — mixed write discipline: a ``self.X`` attribute written both
+  inside and outside lock scopes of the same class (init-only and
+  GIL-atomic cases get per-attr suppressions with justifications).
+* **T3** — the PR 16 rule "HTTP handlers never touch the loop-driven
+  engine": a ``do_GET``/``do_POST`` body (or a helper reachable from
+  one) calling an engine method off the read-only allowlist must go
+  through the export queue instead.
+* **T4** — a lock held across a blocking call (HTTP, jax dispatch,
+  file I/O): the critical section inherits the tail latency of the
+  slow operation and every reader stalls behind it.
+
+Everything here is stdlib-``ast``: no imports of the analyzed modules,
+no jax, safe to run at ``CycleDriver.start()``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import (Dict, Iterable, List, Mapping, Optional, Sequence, Set,
+                    Tuple)
+
+from .findings import REGISTRY, Finding, Rule, Severity
+
+_PKG = Path(__file__).resolve().parent.parent          # dcos_commons_tpu/
+
+#: Modules whose locks join the fleet lock-order graph (T1 + witness).
+#: scheduler/core.py and metrics.py are here because serving locks nest
+#: around them (the chaos soaks observe those edges at runtime).
+LOCKGRAPH_MODULES: Tuple[str, ...] = (
+    "models/router.py",
+    "models/ingress.py",
+    "models/disagg.py",
+    "models/migrate.py",
+    "models/paging.py",
+    "models/weights.py",
+    "models/serving.py",
+    "scheduler/core.py",
+    "metrics.py",
+)
+
+#: The write/handler/blocking rules (T2-T4) run over the serving tier
+#: only — the control plane is single-writer behind RLocks by design.
+SERVING_MODULES: Tuple[str, ...] = (
+    "models/router.py",
+    "models/ingress.py",
+    "models/disagg.py",
+    "models/migrate.py",
+    "models/paging.py",
+    "models/weights.py",
+    "models/serving.py",
+)
+
+LOCKGRAPH_PATH = Path(__file__).resolve().parent / "lock_order.json"
+
+#: Engine methods a handler thread MAY call: read-only snapshots that
+#: take no pages, donate no buffers, and never advance the loop.
+ENGINE_ALLOWLIST = frozenset({
+    "page_stats", "pages_free", "free_slots", "requests_active",
+})
+
+_HANDLER_ENTRYPOINTS = ("do_GET", "do_POST", "do_PUT", "do_DELETE")
+
+_BLOCKING_OS = frozenset({
+    "replace", "remove", "rename", "makedirs", "fsync", "unlink"})
+_BLOCKING_NAMES = frozenset({
+    "urlopen", "_urlopen", "urlretrieve", "getresponse", "sleep"})
+
+#: Method names never resolved through the unique-name fallback: they
+#: shadow dict/list/set/deque/file methods, so ``self._host.pop(...)``
+#: must not bind to an analyzed class that happens to define ``pop``.
+_FALLBACK_DENYLIST = frozenset({
+    "get", "pop", "popitem", "append", "appendleft", "add", "remove",
+    "discard", "update", "clear", "items", "keys", "values",
+    "setdefault", "move_to_end", "read", "write", "close", "flush",
+    "join", "start", "copy", "count", "index", "sort", "extend",
+    "insert", "send", "put", "release", "acquire", "set", "wait",
+})
+
+#: Per-finding suppressions. Key: (rule code, finding key); value: the
+#: justification — REQUIRED non-empty, validated at lint time. A
+#: suppressed finding still prints (as INFO) so the debt stays visible.
+SUPPRESSIONS: Dict[Tuple[str, str], str] = {
+    ("T3", "disagg.prefill_span"):
+        "prefill tier has no engine loop: handler threads ARE the "
+        "engine thread, serialized by PrefillWorker._lock (the "
+        "donation contract needs exactly one prefill in flight)",
+    ("T4", "disagg.PrefillWorker.prefill_span"):
+        "the lock IS the engine serialization: prefill compute must "
+        "not overlap another prefill on the same donated buffers",
+    ("T3", "migrate.import_stream"):
+        "the receiver endpoint exists to hand a drained stream to the "
+        "destination engine; MigrateReceiver._lock serializes imports "
+        "and the engine's submit path is import-safe (PR 16 drain "
+        "protocol)",
+    ("T4", "migrate.MigrateReceiver.import_stream"):
+        "import must be atomic with respect to a second import of the "
+        "same stream id; the lock is the dedup barrier",
+}
+
+# --------------------------------------------------------------------------
+# rule registrations (docs/static-analysis.md is the rendered catalogue)
+
+T0 = REGISTRY.register(Rule(
+    code="T0", family="thread",
+    title="Lock-graph census and baseline status",
+    fix_hint="informational; run --update-lockgraph to (re)create the "
+             "lock_order.json baseline",
+    default_severity=Severity.INFO))
+T1 = REGISTRY.register(Rule(
+    code="T1", family="thread",
+    title="Lock-order cycle, or lock-order edge absent from baseline",
+    fix_hint="break the cycle by narrowing one critical section; for a "
+             "new legitimate edge, review it and run "
+             "python -m dcos_commons_tpu.analysis --update-lockgraph"))
+T2 = REGISTRY.register(Rule(
+    code="T2", family="thread",
+    title="Attribute written both inside and outside lock scopes",
+    fix_hint="move every write under the lock, or suppress the attr "
+             "with a justification (init-only / GIL-atomic)"))
+T3 = REGISTRY.register(Rule(
+    code="T3", family="thread",
+    title="HTTP handler calls the loop-driven engine directly",
+    fix_hint="route the call through the export queue "
+             "(ServingFrontend._exports); handlers may only call "
+             "read-only engine snapshots"))
+T4 = REGISTRY.register(Rule(
+    code="T4", family="thread",
+    title="Lock held across a blocking call",
+    fix_hint="snapshot state under the lock, perform the blocking "
+             "call (HTTP / jax dispatch / file I/O) outside it"))
+
+
+# --------------------------------------------------------------------------
+# module model
+
+@dataclass(frozen=True)
+class LockInfo:
+    name: str        # "router.Router._lock"
+    site: str        # "dcos_commons_tpu/models/router.py:511"
+    kind: str        # "Lock" | "RLock"
+
+
+@dataclass
+class _CallSite:
+    func: ast.expr
+    held: Tuple[str, ...]
+    loc: str
+
+
+@dataclass
+class _Write:
+    attr: str
+    owner: Tuple[str, str]       # (modstem, class name) the attr lives on
+    method: str                  # method the write happens in
+    locked: bool
+    loc: str
+
+
+@dataclass
+class _Method:
+    qual: str                    # "router.Router.set_replicas"
+    modstem: str
+    cls: Optional[str]           # None for module-level functions
+    name: str
+    acquires: Set[str] = field(default_factory=set)
+    direct_edges: List[Tuple[str, str, str]] = field(default_factory=list)
+    calls: List[_CallSite] = field(default_factory=list)
+    writes: List[_Write] = field(default_factory=list)
+    blocking: Set[Tuple[str, str]] = field(default_factory=set)
+    may_acquire: Set[str] = field(default_factory=set)
+    may_block: Set[Tuple[str, str]] = field(default_factory=set)
+
+
+@dataclass
+class _Class:
+    modstem: str
+    name: str
+    relpath: str
+    node: ast.ClassDef
+    is_handler: bool
+    enclosing: Optional[str]                  # class the handler nests in
+    aliases: Dict[str, str] = field(default_factory=dict)   # name -> class
+    locks: Dict[str, LockInfo] = field(default_factory=dict)
+    attr_types: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    methods: Dict[str, str] = field(default_factory=dict)   # name -> qual
+
+
+@dataclass
+class _Analysis:
+    locks: Dict[str, LockInfo]
+    edges: Dict[Tuple[str, str], str]
+    methods: Dict[str, _Method]
+    classes: Dict[Tuple[str, str], _Class]
+    handlers: List[_Class]
+    callees: Dict[str, List[Tuple[_CallSite, str]]] = field(
+        default_factory=dict)
+
+
+def _chain(expr: ast.expr) -> Optional[Tuple[str, ...]]:
+    """Dotted-name chain of a call target: ``worker.engine.step`` ->
+    ("worker", "engine", "step"); None when the base is not a Name."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return tuple(reversed(parts))
+
+
+def _blocking_desc(func: ast.expr) -> Optional[str]:
+    """Classify a call target as blocking (for T4), or None."""
+    ch = _chain(func)
+    if ch is None:
+        return None
+    if ch[0] == "jax" and len(ch) > 1:
+        return f"jax dispatch ({'.'.join(ch)})"
+    if ch == ("open",):
+        return "file I/O (open)"
+    if ch[0] == "os" and ch[-1] in _BLOCKING_OS:
+        return f"file I/O (os.{ch[-1]})"
+    if ch[-1] in _BLOCKING_NAMES:
+        return f"blocking call ({'.'.join(ch)})"
+    if "engine" in ch[:-1] and ch[-1] not in ENGINE_ALLOWLIST:
+        return f"engine dispatch ({'.'.join(ch)})"
+    return None
+
+
+def _modstem(relpath: str) -> str:
+    return Path(relpath).stem
+
+
+def _pkg_rel(relpath: str) -> str:
+    return f"dcos_commons_tpu/{relpath}"
+
+
+def _is_handler_class(node: ast.ClassDef) -> bool:
+    for base in node.bases:
+        name = base.attr if isinstance(base, ast.Attribute) else \
+            base.id if isinstance(base, ast.Name) else ""
+        if name.endswith("BaseHTTPRequestHandler"):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# pass 1: classes, locks, self-aliases, attribute types
+
+def _collect_classes(relpath: str, tree: ast.Module,
+                     classes: Dict[Tuple[str, str], _Class]) -> None:
+    mod = _modstem(relpath)
+
+    def visit(node: ast.AST, enclosing_cls: Optional[str],
+              enclosing_fn: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                aliases: Dict[str, str] = {}
+                if enclosing_cls is not None and enclosing_fn is not None:
+                    # nested-handler idiom: ``worker = self`` right
+                    # before ``class Handler(BaseHTTPRequestHandler)``
+                    for stmt in ast.walk(enclosing_fn):
+                        if (isinstance(stmt, ast.Assign)
+                                and len(stmt.targets) == 1
+                                and isinstance(stmt.targets[0], ast.Name)
+                                and isinstance(stmt.value, ast.Name)
+                                and stmt.value.id == "self"):
+                            aliases[stmt.targets[0].id] = enclosing_cls
+                classes[(mod, child.name)] = _Class(
+                    modstem=mod, name=child.name, relpath=relpath,
+                    node=child, is_handler=_is_handler_class(child),
+                    enclosing=enclosing_cls, aliases=aliases)
+                visit(child, child.name, None)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, enclosing_cls, child)
+            else:
+                visit(child, enclosing_cls, enclosing_fn)
+
+    visit(tree, None, None)
+
+    # locks + attribute types: ``self.X = threading.Lock()`` and
+    # ``self.X = SomeAnalyzedClass(...)`` anywhere in the class body
+    # (nested class subtrees excluded — their ``self`` is not ours)
+    def _own_stmts(root: ast.AST) -> Iterable[ast.AST]:
+        for child in ast.iter_child_nodes(root):
+            if isinstance(child, ast.ClassDef):
+                continue
+            yield child
+            yield from _own_stmts(child)
+
+    for (m, cname), cinfo in classes.items():
+        if m != mod:
+            continue
+        for stmt in _own_stmts(cinfo.node):
+            if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                continue
+            tgt = stmt.targets[0]
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            value = stmt.value
+            # unwrap ``metrics if metrics is not None else Registry()``
+            cands = [value]
+            if isinstance(value, ast.IfExp):
+                cands = [value.body, value.orelse]
+            for cand in cands:
+                if not isinstance(cand, ast.Call):
+                    continue
+                ch = _chain(cand.func)
+                if ch is None:
+                    continue
+                if ch[0] == "threading" and len(ch) == 2 \
+                        and ch[1] in ("Lock", "RLock"):
+                    cinfo.locks[tgt.attr] = LockInfo(
+                        name=f"{mod}.{cname}.{tgt.attr}",
+                        site=f"{_pkg_rel(relpath)}:{cand.lineno}",
+                        kind=ch[1])
+                else:
+                    cinfo.attr_types.setdefault(tgt.attr, (mod, ch[-1]))
+
+
+# --------------------------------------------------------------------------
+# pass 2: per-method scan (with-scopes, calls, writes)
+
+class _MethodScanner:
+    """One method (or module function, or closure) body: track the
+    lexical stack of held locks, record acquisition edges, every call
+    with the held set, every ``self.X`` write, and blocking calls."""
+
+    def __init__(self, analysis: "_Analysis", cls: Optional[_Class],
+                 relpath: str, method: _Method) -> None:
+        self.a = analysis
+        self.cls = cls
+        self.relpath = relpath
+        self.m = method
+        self.held: List[str] = []
+
+    def _loc(self, node: ast.AST) -> str:
+        return f"{_pkg_rel(self.relpath)}:{node.lineno}"
+
+    def _owner_of(self, base: str) -> Optional[_Class]:
+        if self.cls is None:
+            return None
+        if base == "self":
+            return self.cls
+        alias_cls = self.cls.aliases.get(base)
+        if alias_cls is not None:
+            return self.a.classes.get((self.cls.modstem, alias_cls))
+        return None
+
+    def _resolve_lock(self, expr: ast.expr) -> Optional[LockInfo]:
+        if not (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)):
+            return None
+        owner = self._owner_of(expr.value.id)
+        if owner is None:
+            return None
+        return owner.locks.get(expr.attr)
+
+    def run(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self.visit(stmt)
+
+    def visit(self, node: ast.AST) -> None:
+        if isinstance(node, ast.With):
+            self._visit_with(node)
+        elif isinstance(node, ast.Call):
+            self._visit_call(node)
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            self._visit_write(node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef, ast.Lambda)):
+            # closures/nested classes execute later, on other threads:
+            # never attribute the current held set to them (the caller
+            # scans them separately with a fresh stack)
+            return
+        else:
+            for child in ast.iter_child_nodes(node):
+                self.visit(child)
+
+    def _visit_with(self, node: ast.With) -> None:
+        acquired: List[LockInfo] = []
+        for item in node.items:
+            # the context expression evaluates before acquisition
+            self.visit(item.context_expr)
+            lock = self._resolve_lock(item.context_expr)
+            if lock is None:
+                continue
+            loc = self._loc(item.context_expr)
+            for held in self.held:
+                if held == lock.name and lock.kind == "RLock":
+                    continue   # reentrant self-acquire is fine
+                self.m.direct_edges.append((held, lock.name, loc))
+            self.m.acquires.add(lock.name)
+            self.held.append(lock.name)
+            acquired.append(lock)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def _visit_call(self, node: ast.Call) -> None:
+        self.m.calls.append(_CallSite(
+            func=node.func, held=tuple(self.held), loc=self._loc(node)))
+        desc = _blocking_desc(node.func)
+        if desc is not None:
+            self.m.blocking.add((desc, self._loc(node)))
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+    def _visit_write(self, node: ast.AST) -> None:
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for tgt in targets:
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)):
+                continue
+            owner = self._owner_of(tgt.value.id)
+            if owner is None:
+                continue
+            self.m.writes.append(_Write(
+                attr=tgt.attr, owner=(owner.modstem, owner.name),
+                method=self.m.name, locked=bool(self.held),
+                loc=self._loc(tgt)))
+        self.visit(node.value)
+
+
+# --------------------------------------------------------------------------
+# pass 3: whole-program analysis over the module set
+
+def _analyze(sources: Mapping[str, str]) -> _Analysis:
+    """Parse ``{relpath: source}`` and build the lock/call/write model.
+    Pure function of the sources — the unit-test seam."""
+    classes: Dict[Tuple[str, str], _Class] = {}
+    trees: Dict[str, ast.Module] = {}
+    for relpath, src in sources.items():
+        tree = ast.parse(src, filename=relpath)
+        trees[relpath] = tree
+        _collect_classes(relpath, tree, classes)
+
+    analysis = _Analysis(locks={}, edges={}, methods={}, classes=classes,
+                         handlers=[c for c in classes.values()
+                                   if c.is_handler])
+    for cinfo in classes.values():
+        for lock in cinfo.locks.values():
+            analysis.locks[lock.name] = lock
+
+    # scan every method, module function, and closure body
+    modfuncs: Dict[Tuple[str, str], str] = {}
+    name_index: Dict[str, List[str]] = {}
+
+    def scan(relpath: str, cls: Optional[_Class], fn: ast.AST,
+             qual: str, register: bool) -> None:
+        mod = _modstem(relpath)
+        method = _Method(qual=qual, modstem=mod,
+                         cls=cls.name if cls else None, name=fn.name)
+        analysis.methods[qual] = method
+        if register:
+            if cls is not None:
+                cls.methods[fn.name] = qual
+                if not fn.name.startswith("__"):
+                    name_index.setdefault(fn.name, []).append(qual)
+            else:
+                modfuncs[(mod, fn.name)] = qual
+        _MethodScanner(analysis, cls, relpath, method).run(fn.body)
+        # closures: separate scan, fresh held stack, not call-resolvable
+        for inner in ast.walk(fn):
+            if inner is fn or not isinstance(
+                    inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if any(isinstance(p, ast.ClassDef) for p in _path(fn, inner)):
+                continue   # nested-class methods scanned as methods
+            scan(relpath, cls, inner,
+                 f"{qual}.<local>.{inner.name}:{inner.lineno}",
+                 register=False)
+
+    def _path(root: ast.AST, target: ast.AST) -> List[ast.AST]:
+        # ancestor chain of target below root (exclusive), or []
+        out: List[ast.AST] = []
+
+        def rec(node: ast.AST, acc: List[ast.AST]) -> bool:
+            if node is target:
+                out.extend(acc)
+                return True
+            for child in ast.iter_child_nodes(node):
+                if rec(child, acc + [child] if child is not target
+                       else acc):
+                    return True
+            return False
+
+        rec(root, [])
+        return out
+
+    for relpath, tree in trees.items():
+        mod = _modstem(relpath)
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan(relpath, None, node, f"{mod}.{node.name}",
+                     register=True)
+        for (m, cname), cinfo in classes.items():
+            if m != mod:
+                continue
+            for node in cinfo.node.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    scan(cinfo.relpath, cinfo, node,
+                         f"{mod}.{cname}.{node.name}", register=True)
+
+    _resolve_and_fixpoint(analysis, modfuncs, name_index)
+    return analysis
+
+
+def _resolve_call(analysis: _Analysis, method: _Method,
+                  modfuncs: Mapping[Tuple[str, str], str],
+                  name_index: Mapping[str, List[str]],
+                  func: ast.expr) -> Optional[str]:
+    """Resolve a call target to an analyzed method qual, or None.
+    Order: bare module function, self/alias method, typed-attribute
+    method, then the unique-name fallback (denylisted for container
+    method names)."""
+    cls = analysis.classes.get((method.modstem, method.cls)) \
+        if method.cls else None
+    if isinstance(func, ast.Name):
+        return modfuncs.get((method.modstem, func.id))
+    if not isinstance(func, ast.Attribute):
+        return None
+    meth = func.attr
+    base = func.value
+    if isinstance(base, ast.Name) and cls is not None:
+        owner: Optional[_Class] = None
+        if base.id == "self":
+            owner = cls
+        elif base.id in cls.aliases:
+            owner = analysis.classes.get(
+                (cls.modstem, cls.aliases[base.id]))
+        if owner is not None and meth in owner.methods:
+            return owner.methods[meth]
+        if owner is not None:
+            return None   # our own class lacks it: do not guess
+    # self.ATTR.meth() via inferred attribute type
+    if (isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name) and cls is not None):
+        owner = None
+        if base.value.id == "self":
+            owner = cls
+        elif base.value.id in cls.aliases:
+            owner = analysis.classes.get(
+                (cls.modstem, cls.aliases[base.value.id]))
+        if owner is not None:
+            typed = owner.attr_types.get(base.attr)
+            if typed is not None:
+                target = analysis.classes.get(typed)
+                if target is not None and meth in target.methods:
+                    return target.methods[meth]
+    if meth in _FALLBACK_DENYLIST or meth.startswith("__"):
+        return None
+    quals = name_index.get(meth, [])
+    if len(quals) == 1:
+        return quals[0]
+    return None
+
+
+def _resolve_and_fixpoint(analysis: _Analysis,
+                          modfuncs: Mapping[Tuple[str, str], str],
+                          name_index: Mapping[str, List[str]]) -> None:
+    """Propagate may_acquire / may_block through resolved call edges,
+    then materialize the lock-order edge set."""
+    callees: Dict[str, List[Tuple[_CallSite, str]]] = {}
+    for qual, m in analysis.methods.items():
+        resolved = []
+        for site in m.calls:
+            target = _resolve_call(analysis, m, modfuncs, name_index,
+                                   site.func)
+            if target is not None and target != qual:
+                resolved.append((site, target))
+        callees[qual] = resolved
+        m.may_acquire = set(m.acquires)
+        m.may_block = set(m.blocking)
+    analysis.callees = callees
+
+    changed = True
+    while changed:
+        changed = False
+        for qual, m in analysis.methods.items():
+            for _, target in callees[qual]:
+                t = analysis.methods[target]
+                if not t.may_acquire <= m.may_acquire:
+                    m.may_acquire |= t.may_acquire
+                    changed = True
+                if not t.may_block <= m.may_block:
+                    m.may_block |= t.may_block
+                    changed = True
+
+    # lock-order edges: direct lexical nesting + helper-call closure
+    for m in analysis.methods.values():
+        for src, dst, loc in m.direct_edges:
+            analysis.edges.setdefault((src, dst), loc)
+        for site, target in callees[m.qual]:
+            if not site.held:
+                continue
+            for dst in analysis.methods[target].may_acquire:
+                for src in site.held:
+                    if src == dst:
+                        continue   # reentrant helper on an RLock
+                    analysis.edges.setdefault((src, dst), site.loc)
+
+
+# --------------------------------------------------------------------------
+# lock-order graph: cycles + baseline
+
+def find_cycles(edges: Iterable[Tuple[str, str]]) -> List[List[str]]:
+    """Elementary cycles by DFS; each returned as [a, b, ..., a]."""
+    graph: Dict[str, List[str]] = {}
+    for src, dst in edges:
+        graph.setdefault(src, []).append(dst)
+        graph.setdefault(dst, [])
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str]) -> None:
+        for nxt in graph[node]:
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                key = tuple(sorted(cyc[:-1]))
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cyc)
+                continue
+            if nxt in visited:
+                continue
+            visited.add(nxt)
+            stack.append(nxt)
+            on_stack.add(nxt)
+            dfs(nxt, stack, on_stack)
+            on_stack.discard(nxt)
+            stack.pop()
+
+    visited: Set[str] = set()
+    for start in sorted(graph):
+        if start not in visited:
+            visited.add(start)
+            dfs(start, [start], {start})
+    return cycles
+
+
+def graph_as_json(analysis: _Analysis) -> Dict[str, Dict[str, str]]:
+    return {
+        "locks": {name: info.site
+                  for name, info in sorted(analysis.locks.items())},
+        "edges": {f"{src} -> {dst}": loc
+                  for (src, dst), loc in sorted(analysis.edges.items())},
+    }
+
+
+def load_lock_graph(path: Path = LOCKGRAPH_PATH) -> Optional[dict]:
+    """The checked-in baseline, or None before first
+    ``--update-lockgraph`` (the witness also keys off this)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def save_lock_graph(analysis: _Analysis,
+                    path: Path = LOCKGRAPH_PATH) -> Dict[str, Dict[str, str]]:
+    payload = graph_as_json(analysis)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return payload
+
+
+# --------------------------------------------------------------------------
+# the T-rule passes
+
+def _t1_findings(analysis: _Analysis,
+                 baseline: Optional[dict]) -> List[Tuple[Finding, str]]:
+    out: List[Tuple[Finding, str]] = []
+    out.append((Finding(
+        "T0", Severity.INFO, "lockgraph",
+        f"{len(analysis.locks)} lock(s), {len(analysis.edges)} "
+        f"order edge(s)"), "census"))
+    for cyc in find_cycles(analysis.edges):
+        loc = analysis.edges.get((cyc[0], cyc[1]), "lockgraph")
+        key = " -> ".join(cyc)
+        out.append((Finding(
+            "T1", Severity.ERROR, loc,
+            f"lock-order cycle: {key}"), key))
+    if baseline is None:
+        out.append((Finding(
+            "T0", Severity.INFO, "lockgraph",
+            "no lock_order.json baseline checked in; run "
+            "python -m dcos_commons_tpu.analysis --update-lockgraph"),
+            "no-baseline"))
+        return out
+    base_edges = set(baseline.get("edges", {}))
+    for (src, dst), loc in sorted(analysis.edges.items()):
+        key = f"{src} -> {dst}"
+        if key not in base_edges:
+            out.append((Finding(
+                "T1", Severity.ERROR, loc,
+                f"lock-order edge not in baseline: {key} (review it, "
+                f"then run --update-lockgraph)"), key))
+    current = {f"{s} -> {d}" for s, d in analysis.edges}
+    for key in sorted(base_edges - current):
+        out.append((Finding(
+            "T1", Severity.WARNING, "lock_order.json",
+            f"baseline edge no longer observed: {key} (refresh with "
+            f"--update-lockgraph)"), key))
+    return out
+
+
+def _t2_findings(analysis: _Analysis,
+                 serving_stems: Set[str]) -> List[Tuple[Finding, str]]:
+    per_attr: Dict[Tuple[Tuple[str, str], str], Dict[str, List[str]]] = {}
+    for m in analysis.methods.values():
+        for w in m.writes:
+            if w.owner[0] not in serving_stems:
+                continue
+            owner_cls = analysis.classes.get(w.owner)
+            if owner_cls is not None and owner_cls.is_handler:
+                continue   # handler instances are per-request
+            if w.method == "__init__":
+                continue
+            bucket = per_attr.setdefault((w.owner, w.attr),
+                                         {"locked": [], "unlocked": []})
+            bucket["locked" if w.locked else "unlocked"].append(w.loc)
+    out: List[Tuple[Finding, str]] = []
+    for ((mod, cls), attr), bucket in sorted(per_attr.items()):
+        if not (bucket["locked"] and bucket["unlocked"]):
+            continue
+        key = f"{mod}.{cls}.{attr}"
+        out.append((Finding(
+            "T2", Severity.ERROR, bucket["unlocked"][0],
+            f"{cls}.{attr} written under a lock at "
+            f"{bucket['locked'][0]} but without one here"), key))
+    return out
+
+
+def _t3_findings(analysis: _Analysis,
+                 serving_stems: Set[str]) -> List[Tuple[Finding, str]]:
+    out: List[Tuple[Finding, str]] = []
+    for handler in analysis.handlers:
+        if handler.modstem not in serving_stems:
+            continue
+        reachable: Set[str] = set()
+        frontier = [m for m in _HANDLER_ENTRYPOINTS
+                    if m in handler.methods]
+        while frontier:
+            name = frontier.pop()
+            if name in reachable:
+                continue
+            reachable.add(name)
+            meth = analysis.methods[handler.methods[name]]
+            for site in meth.calls:
+                ch = _chain(site.func)
+                if (ch is not None and len(ch) == 2
+                        and ch[0] == "self" and ch[1] in handler.methods):
+                    frontier.append(ch[1])
+        for name in sorted(reachable):
+            meth = analysis.methods[handler.methods[name]]
+            for site in meth.calls:
+                ch = _chain(site.func)
+                if ch is None or "engine" not in ch[:-1]:
+                    continue
+                if ch[-1] in ENGINE_ALLOWLIST:
+                    continue
+                key = f"{handler.modstem}.{ch[-1]}"
+                ctx = handler.enclosing or handler.name
+                out.append((Finding(
+                    "T3", Severity.ERROR, site.loc,
+                    f"{ctx} handler thread calls engine method "
+                    f"{'.'.join(ch)}(); handlers may only read "
+                    f"({', '.join(sorted(ENGINE_ALLOWLIST))}) — route "
+                    f"work through the export queue"), key))
+    return out
+
+
+def _t4_findings(analysis: _Analysis,
+                 serving_stems: Set[str]) -> List[Tuple[Finding, str]]:
+    out: List[Tuple[Finding, str]] = []
+    seen: Set[Tuple[str, str]] = set()
+
+    def class_key(m: _Method) -> str:
+        cls = analysis.classes.get((m.modstem, m.cls)) if m.cls else None
+        if cls is not None and cls.is_handler and cls.enclosing:
+            return f"{m.modstem}.{cls.enclosing}"
+        return f"{m.modstem}.{m.cls or '<module>'}"
+
+    def emit(m: _Method, held: Tuple[str, ...], desc: str, loc: str,
+             via: Optional[str]) -> None:
+        if (loc, desc) in seen:
+            return
+        seen.add((loc, desc))
+        name = desc[desc.rfind("(") + 1:-1].rsplit(".", 1)[-1]
+        via_note = f" (via {via})" if via else ""
+        out.append((Finding(
+            "T4", Severity.ERROR, loc,
+            f"{held[-1]} held across {desc}{via_note}; snapshot under "
+            f"the lock, block outside it"), f"{class_key(m)}.{name}"))
+
+    for m in analysis.methods.values():
+        if m.modstem not in serving_stems:
+            continue
+        for site in m.calls:
+            if not site.held:
+                continue
+            # direct: the call itself blocks
+            desc = _blocking_desc(site.func)
+            if desc is not None:
+                emit(m, site.held, desc, site.loc, via=None)
+        # transitive: a helper called under the lock blocks somewhere
+        for site, target in analysis.callees.get(m.qual, ()):
+            if not site.held:
+                continue
+            callee = analysis.methods[target]
+            for desc, bloc in sorted(callee.may_block):
+                emit(m, site.held, desc, bloc, via=site.loc)
+    return out
+
+
+# --------------------------------------------------------------------------
+# public lint surface
+
+def validate_suppressions(
+        suppressions: Mapping[Tuple[str, str], str]) -> None:
+    """Every suppression MUST carry a non-empty justification — a bare
+    silence is indistinguishable from an unreviewed bug."""
+    for key, why in suppressions.items():
+        if (not isinstance(key, tuple) or len(key) != 2
+                or key[0] not in ("T1", "T2", "T3", "T4")):
+            raise ValueError(
+                f"suppression key must be (rule code, finding key): "
+                f"{key!r}")
+        if not isinstance(why, str) or not why.strip():
+            raise ValueError(
+                f"suppression {key!r} needs a non-empty justification")
+
+
+def _apply_suppressions(
+        keyed: List[Tuple[Finding, str]],
+        suppressions: Mapping[Tuple[str, str], str]) -> List[Finding]:
+    out: List[Finding] = []
+    used: Set[Tuple[str, str]] = set()
+    for finding, key in keyed:
+        why = suppressions.get((finding.code, key))
+        if why is not None and finding.severity is Severity.ERROR:
+            used.add((finding.code, key))
+            out.append(Finding(
+                finding.code, Severity.INFO, finding.location,
+                f"{finding.message} (suppressed: {why})"))
+        else:
+            out.append(finding)
+    for code, key in sorted(set(suppressions) - used):
+        out.append(Finding(
+            "T0", Severity.WARNING, "thread_rules.SUPPRESSIONS",
+            f"unused suppression ({code}, {key!r}) — delete it"))
+    return out
+
+
+def lint_thread_sources(
+        sources: Mapping[str, str], *,
+        baseline: Optional[dict] = None,
+        suppressions: Optional[Mapping[Tuple[str, str], str]] = None,
+        serving: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run T1-T4 over explicit ``{relpath: source}`` — the seam the
+    tests inject regressions through. ``serving`` limits T2-T4 to a
+    subset of relpaths (default: all of them)."""
+    supp = SUPPRESSIONS if suppressions is None else suppressions
+    validate_suppressions(supp)
+    analysis = _analyze(sources)
+    serving_stems = {_modstem(p) for p in (serving if serving is not None
+                                           else sources)}
+    keyed = (_t1_findings(analysis, baseline)
+             + _t2_findings(analysis, serving_stems)
+             + _t3_findings(analysis, serving_stems)
+             + _t4_findings(analysis, serving_stems))
+    return _apply_suppressions(keyed, supp)
+
+
+def _read_sources(
+        modules: Sequence[str] = LOCKGRAPH_MODULES) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for relpath in modules:
+        out[relpath] = (_PKG / relpath).read_text(encoding="utf-8")
+    return out
+
+
+def lint_threads(*, baseline_path: Path = LOCKGRAPH_PATH,
+                 suppress: Iterable[str] = ()) -> List[Finding]:
+    """The real thing: T1-T4 over the serving tier + control-plane
+    lock modules, diffed against the checked-in baseline."""
+    from .findings import filter_suppressed
+    findings = lint_thread_sources(
+        _read_sources(), baseline=load_lock_graph(baseline_path),
+        serving=SERVING_MODULES)
+    return filter_suppressed(findings, suppress)
+
+
+_CACHED: Optional[List[Finding]] = None
+
+
+def lint_threads_cached() -> List[Finding]:
+    """Process-lifetime cache for ``CycleDriver.start()`` fail-fast —
+    the tree does not change mid-process and many tests start drivers."""
+    global _CACHED
+    if _CACHED is None:
+        _CACHED = lint_threads()
+    return list(_CACHED)
+
+
+def update_lock_graph(path: Path = LOCKGRAPH_PATH) -> Tuple[int, int]:
+    """(Re)write the lock_order.json baseline from the current tree;
+    returns (locks, edges). Refuses to baseline a cyclic graph."""
+    analysis = _analyze(_read_sources())
+    cycles = find_cycles(analysis.edges)
+    if cycles:
+        raise ValueError(
+            "refusing to baseline a cyclic lock graph: "
+            + "; ".join(" -> ".join(c) for c in cycles))
+    save_lock_graph(analysis, path)
+    return len(analysis.locks), len(analysis.edges)

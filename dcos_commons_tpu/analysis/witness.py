@@ -1,0 +1,213 @@
+"""Runtime lock-order witness: the dynamic half of the T1 contract.
+
+``thread_rules.py`` derives the lock-order graph *statically* and
+checks it into ``lock_order.json``. This module proves the baseline
+against reality: while :func:`armed`, every ``threading.Lock()`` /
+``threading.RLock()`` construction returns an instrumented wrapper
+that records, per thread, which lock was acquired while which others
+were held — keyed by the *creation site* (``file:line``), the same
+identity the static lock table uses. After a run (the chaos soaks arm
+this around their seed sweeps), :func:`check` fails on
+
+* an observed edge between two baselined locks that the static graph
+  does not contain (the static pass missed a call path — fix its
+  resolution, review, ``--update-lockgraph``), and
+* any cycle in the union of baseline and observed edges (a real
+  deadlock-order violation the single run happened not to hit).
+
+Locks created outside the armed window, or at sites the baseline does
+not know (stdlib internals, modules outside the graph scope), are
+ignored: the witness proves *consistency with the baseline*, not
+total coverage. Overhead is one thread-local list walk per acquire,
+cheap enough for the time-capped CI soaks.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .findings import REGISTRY, Finding, Rule, Severity
+from .thread_rules import find_cycles, load_lock_graph
+
+_REPO = Path(__file__).resolve().parent.parent.parent
+
+W1 = REGISTRY.register(Rule(
+    code="W1", family="thread",
+    title="Runtime lock order contradicts the static baseline",
+    fix_hint="a missed static call edge (fix thread_rules resolution, "
+             "re-run --update-lockgraph) or a real ordering violation "
+             "(fix the acquiring code)"))
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+
+class _State:
+    def __init__(self) -> None:
+        self.armed = False
+        self.guard = _ORIG_LOCK()
+        # (src_site, dst_site) -> name of first thread that saw it
+        self.edges: Dict[Tuple[str, str], str] = {}
+        self.local = threading.local()
+
+
+_STATE = _State()
+
+
+def _caller_site() -> str:
+    """file:line of the frame constructing the lock, repo-relative so
+    it matches the static lock table's sites."""
+    frame = sys._getframe(2)
+    fname = frame.f_code.co_filename
+    try:
+        rel = str(Path(fname).resolve().relative_to(_REPO))
+    except ValueError:
+        rel = Path(fname).name
+    return f"{rel}:{frame.f_lineno}"
+
+
+class _WitnessedLock:
+    """Duck-types Lock/RLock; forwards everything, notes the order."""
+
+    def __init__(self, inner, site: str) -> None:
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        _note_release(self)
+        self._inner.release()
+
+    def __enter__(self) -> "_WitnessedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+
+def _stack() -> List[List]:
+    stack = getattr(_STATE.local, "stack", None)
+    if stack is None:
+        stack = _STATE.local.stack = []
+    return stack
+
+
+def _note_acquire(lock: _WitnessedLock) -> None:
+    stack = _stack()
+    for entry in stack:
+        if entry[0] is lock:
+            entry[1] += 1          # reentrant re-acquire: no new edge
+            return
+    if stack:
+        tname = threading.current_thread().name
+        with _STATE.guard:
+            for held, _ in stack:
+                _STATE.edges.setdefault(
+                    (held._site, lock._site), tname)
+    stack.append([lock, 1])
+
+
+def _note_release(lock: _WitnessedLock) -> None:
+    stack = _stack()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i][0] is lock:
+            stack[i][1] -= 1
+            if stack[i][1] == 0:
+                del stack[i]
+            return
+
+
+def _make_factory(orig):
+    def factory():
+        return _WitnessedLock(orig(), _caller_site())
+    return factory
+
+
+def arm() -> None:
+    """Patch ``threading.Lock``/``RLock`` so locks constructed from
+    here on are witnessed; clears previously observed edges."""
+    if _STATE.armed:
+        raise RuntimeError("witness already armed")
+    _STATE.armed = True
+    _STATE.edges.clear()
+    threading.Lock = _make_factory(_ORIG_LOCK)
+    threading.RLock = _make_factory(_ORIG_RLOCK)
+
+
+def disarm() -> None:
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    _STATE.armed = False
+
+
+@contextmanager
+def armed() -> Iterator[None]:
+    arm()
+    try:
+        yield
+    finally:
+        disarm()
+
+
+def observed_edges() -> Dict[Tuple[str, str], str]:
+    with _STATE.guard:
+        return dict(_STATE.edges)
+
+
+def check(baseline: Optional[dict] = None) -> List[Finding]:
+    """Diff observed acquisition order against the static baseline
+    (default: the checked-in ``lock_order.json``)."""
+    if baseline is None:
+        baseline = load_lock_graph()
+    observed = observed_edges()
+    if baseline is None:
+        return [Finding(
+            "T0", Severity.INFO, "witness",
+            f"{len(observed)} observed edge(s) but no lock_order.json "
+            f"baseline to check against (run --update-lockgraph)")]
+    site_to_name = {site: name
+                    for name, site in baseline.get("locks", {}).items()}
+    base_edges: Set[str] = set(baseline.get("edges", {}))
+    findings: List[Finding] = []
+    named: Dict[Tuple[str, str], str] = {}
+    for (src_site, dst_site), tname in sorted(observed.items()):
+        src = site_to_name.get(src_site)
+        dst = site_to_name.get(dst_site)
+        if src is None or dst is None or src == dst:
+            # outside graph scope, or two instances from one site
+            continue
+        named[(src, dst)] = tname
+        key = f"{src} -> {dst}"
+        if key not in base_edges:
+            findings.append(Finding(
+                "W1", Severity.ERROR, f"{src_site} -> {dst_site}",
+                f"runtime edge {key} (thread {tname!r}) absent from "
+                f"the static baseline"))
+    union = {tuple(k.split(" -> ")) for k in base_edges} | set(named)
+    for cyc in find_cycles(union):
+        findings.append(Finding(
+            "W1", Severity.ERROR, "witness",
+            "cycle across baseline + observed edges: "
+            + " -> ".join(cyc)))
+    findings.append(Finding(
+        "T0", Severity.INFO, "witness",
+        f"{len(observed)} observed edge(s), {len(named)} within graph "
+        f"scope, {len(base_edges)} baselined"))
+    return findings

@@ -507,14 +507,30 @@ def _default_env_from_source(path: str) -> dict:
 def _lint_cmd(client: Client, args) -> int:
     """``tpuctl lint [FILES...]``: S-rules over spec files (or the live
     scheduler's target config when no files are given); ``--jaxpr`` adds
-    the J-rules over the registered hot-path entrypoints. Exit 0 = no
-    ERROR findings; every finding prints as ``CODE severity loc: msg``."""
+    the J-rules over the registered hot-path entrypoints; ``--threads``
+    adds the T-rules over the threaded serving tier (and ``--threads``
+    alone skips the spec half entirely). ``--update-lockgraph`` re-derives
+    the lock-order graph and rewrites ``lock_order.json`` — review the
+    diff, same workflow as the collective manifest. Exit 0 = no ERROR
+    findings; every finding prints as ``CODE severity loc: msg``."""
     import dataclasses as _dc
 
     from ..analysis import (errors, lint_spec, lint_spec_file,
                             render_report)
+    if args.update_lockgraph:
+        from ..analysis import LOCKGRAPH_PATH, update_lock_graph
+        nlocks, nedges = update_lock_graph()
+        print(f"lock_order.json updated: {nlocks} lock(s), "
+              f"{nedges} edge(s) ({LOCKGRAPH_PATH})")
+        return 0
     suppress = {c for c in (args.suppress or "").split(",") if c}
     findings = []
+    if args.threads:
+        from ..analysis import lint_threads
+        findings.extend(lint_threads())
+        if not args.files and not args.jaxpr:
+            print(render_report(findings, label="lint"))
+            return 1 if errors(findings) else 0
     if args.files:
         for path in args.files:
             env = _framework_default_env(path)
@@ -732,6 +748,14 @@ def build_parser() -> argparse.ArgumentParser:
     lint.add_argument("--suppress", default="", metavar="CODES",
                       help="comma-separated rule codes to suppress "
                            "(e.g. S4,J2)")
+    lint.add_argument("--threads", action="store_true",
+                      help="run the T-rule concurrency lint over the "
+                           "threaded serving tier (alone: skips the "
+                           "spec half)")
+    lint.add_argument("--update-lockgraph", action="store_true",
+                      help="re-derive the lock-order graph and rewrite "
+                           "analysis/lock_order.json (review the diff "
+                           "in the PR)")
     lint.add_argument("--jaxpr", action="store_true",
                       help="also trace + lint the registered hot-path "
                            "entrypoints (slower; imports jax)")

@@ -603,62 +603,81 @@ class PageTierStore:
         """Demote: pack ``entry`` (``chain`` / ``page_hash`` /
         ``kv_quant`` / one-page ``payload``) and file it, displacing
         LRU frames down the hierarchy (host -> disk -> dropped). A
-        re-demoted chain replaces its stale frame."""
+        re-demoted chain replaces its stale frame.
+
+        All disk I/O happens OUTSIDE ``_lock`` (T4): a ``take()``
+        racing a chain mid-spill sees a clean miss and recomputes —
+        the documented contract — instead of every scrape and engine
+        demote stalling behind a disk write."""
         frame = pack_page_frame(entry)
+        spill: List[Tuple[str, bytes]] = []
+        unlink: List[str] = []
         with self._lock:
-            self._discard_locked(chain)
+            self._pop_locked(chain, unlink)
             if self.host_pages > 0:
                 self._host[chain] = frame
                 self.demoted_host += 1
                 while len(self._host) > self.host_pages:
-                    old_chain, old_frame = self._host.popitem(last=False)
-                    self._spill_locked(old_chain, old_frame)
+                    spill.append(self._host.popitem(last=False))
             else:
-                self._spill_locked(chain, frame)
+                spill.append((chain, frame))
+        for old_chain, old_frame in spill:
+            self._spill(old_chain, old_frame, unlink)
+        for path in unlink:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
-    def _spill_locked(self, chain: str, frame: bytes) -> None:
+    def _spill(self, chain: str, frame: bytes, unlink: List[str]) -> None:
+        """File one frame host -> disk. Called with ``_lock`` RELEASED;
+        the write lands first, the ledger entry commits under the lock
+        after, and displaced paths are appended to ``unlink`` for the
+        caller to remove (also outside the lock)."""
         if self.disk_pages <= 0:
-            self.dropped += 1
+            with self._lock:
+                self.dropped += 1
             return
         path = os.path.join(self.disk_dir, f"{chain}.kvpage")
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(frame)
         os.replace(tmp, path)          # commit is atomic, like weights.py
-        self._disk[chain] = path
-        self._disk.move_to_end(chain)
-        self.demoted_disk += 1
-        while len(self._disk) > self.disk_pages:
-            old_chain, old_path = self._disk.popitem(last=False)
-            try:
-                os.remove(old_path)
-            except OSError:
-                pass
-            self.dropped += 1
+        with self._lock:
+            self._disk[chain] = path
+            self._disk.move_to_end(chain)
+            self.demoted_disk += 1
+            while len(self._disk) > self.disk_pages:
+                old_chain, old_path = self._disk.popitem(last=False)
+                unlink.append(old_path)
+                self.dropped += 1
 
     def take(self, chain: str) -> Optional[Dict[str, Any]]:
         """Promote: POP the chain's frame, verify it, and return the
         decoded entry — or None on a miss or a corrupt frame (counted;
         the frame is gone either way, so the caller that recomputes
-        becomes the content's only owner)."""
+        becomes the content's only owner). The pop is the ownership
+        transfer and happens under ``_lock``; the disk read does not
+        (T4) — the path left the ledger, so nobody else can reach it."""
+        path: Optional[str] = None
         with self._lock:
             frame = self._host.pop(chain, None)
             from_host = frame is not None
             if frame is None:
                 path = self._disk.pop(chain, None)
-                if path is not None:
-                    try:
-                        with open(path, "rb") as f:
-                            frame = f.read()
-                    except OSError:
-                        frame = b""
-                    try:
-                        os.remove(path)
-                    except OSError:
-                        pass
-            if frame is None:
-                self.misses += 1
-                return None
+                if path is None:
+                    self.misses += 1
+                    return None
+        if frame is None:
+            try:
+                with open(path, "rb") as f:
+                    frame = f.read()
+            except OSError:
+                frame = b""
+            try:
+                os.remove(path)
+            except OSError:
+                pass
         try:
             entry = unpack_page_frame(frame, chain=chain)
         except PageFrameError:
@@ -677,31 +696,38 @@ class PageTierStore:
         """Drop a chain without reading it — the radix owns the content
         again (a retiring stream re-adopted the prefix into HBM), so a
         stale tier copy would make two owners."""
+        unlink: List[str] = []
         with self._lock:
-            return self._discard_locked(chain, count=True)
-
-    def _discard_locked(self, chain: str, count: bool = False) -> bool:
-        hit = self._host.pop(chain, None) is not None
-        path = self._disk.pop(chain, None)
-        if path is not None:
-            hit = True
+            hit = self._pop_locked(chain, unlink)
+            if hit:
+                self.discarded += 1
+        for path in unlink:
             try:
                 os.remove(path)
             except OSError:
                 pass
-        if hit and count:
-            self.discarded += 1
+        return hit
+
+    def _pop_locked(self, chain: str, unlink: List[str]) -> bool:
+        """Drop ``chain`` from both tier ledgers; any orphaned disk
+        path is appended to ``unlink`` for removal OUTSIDE the lock."""
+        hit = self._host.pop(chain, None) is not None
+        path = self._disk.pop(chain, None)
+        if path is not None:
+            hit = True
+            unlink.append(path)
         return hit
 
     def clear(self) -> None:
         with self._lock:
             self._host.clear()
-            for path in self._disk.values():
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
+            paths = list(self._disk.values())
             self._disk.clear()
+        for path in paths:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
     # -------------------------------------------------------------- stats
 

@@ -312,6 +312,13 @@ class TenantAdmission:
             self.shed_total += 1
         return False, cls
 
+    def counters(self) -> Tuple[Dict[str, int], Dict[str, int]]:
+        """Consistent (admitted, shed) snapshot — handler threads bump
+        the live OrderedDicts under ``_lock``, so a scrape iterating
+        them bare can see a mid-``popitem`` resize."""
+        with self._lock:
+            return dict(self.admitted), dict(self.shed)
+
 
 class ReplicaError(RuntimeError):
     """A replica that could not serve the relayed request — transport
@@ -1040,11 +1047,12 @@ class Router:
                     per_tenant_ttft.setdefault(tenant, []).append(t)
         routed = max(1, counts["routed"])
         tenants = {}
-        seen = set(self.admission.admitted) | set(self.admission.shed)
+        admitted, shed = self.admission.counters()
+        seen = set(admitted) | set(shed)
         for tenant in sorted(seen):
             tenants[tenant] = {
-                "admitted": self.admission.admitted.get(tenant, 0),
-                "shed": self.admission.shed.get(tenant, 0),
+                "admitted": admitted.get(tenant, 0),
+                "shed": shed.get(tenant, 0),
                 "ttft_ms": percentiles(per_tenant_ttft.get(tenant, [])),
             }
         return {

@@ -35,6 +35,7 @@ class CycleDriver:
 
     def start(self) -> "CycleDriver":
         self._fail_fast_on_spec_errors()
+        self._fail_fast_on_thread_errors()
         self._thread = threading.Thread(target=self._loop,
                                         name="scheduler-cycles", daemon=True)
         self._thread.start()
@@ -61,6 +62,21 @@ class CycleDriver:
             # non-fatal findings (e.g. S8 priority-without-sentinel) still
             # surface at boot; suppressible via lint_spec(suppress=...)
             logging.getLogger(__name__).warning("spec lint: %s", f)
+
+    def _fail_fast_on_thread_errors(self) -> None:
+        """Refuse to start the cycle thread when the serving tier's
+        concurrency lint has ERROR findings (a lock-order cycle, an
+        unlocked shared write, a handler dispatching into the engine):
+        the process about to spawn those threads is exactly the process
+        that would deadlock. Cached — every driver in a test run shares
+        one analysis pass, so startup stays cheap; stdlib-ast only."""
+        from ..analysis import errors, lint_threads_cached
+        bad = errors(lint_threads_cached())
+        if bad:
+            lines = "\n".join(str(f) for f in bad)
+            raise ValueError(
+                f"serving tier fails concurrency analysis "
+                f"({len(bad)} error(s)):\n{lines}")
 
     def poke(self) -> None:
         """Run a cycle soon (new work arrived; reference revive analogue)."""

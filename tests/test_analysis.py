@@ -545,3 +545,27 @@ class TestStaticCheckNewRules:
 
     def test_e2_syntax_error_code(self, tmp_path):
         assert self._check(tmp_path, "def f(:\n") == ["E2"]
+
+    def test_e3_lock_in_method_body(self, tmp_path):
+        src = ("import threading\n"
+               "class S:\n"
+               "    def work(self):\n"
+               "        lk = threading.Lock()\n"
+               "        with lk:\n"
+               "            pass\n")
+        assert self._check(tmp_path, src) == ["E3"]
+
+    def test_e3_init_and_module_scope_clean(self, tmp_path):
+        src = ("import threading\n"
+               "_GLOBAL = threading.RLock()\n"
+               "class S:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n")
+        assert self._check(tmp_path, src) == []
+
+    def test_e3_noqa_exempts(self, tmp_path):
+        src = ("import threading\n"
+               "class S:\n"
+               "    def work(self):\n"
+               "        return threading.Lock()  # noqa: factory method\n")
+        assert self._check(tmp_path, src) == []

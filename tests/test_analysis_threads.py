@@ -1,0 +1,593 @@
+"""Tier-1 tests for the concurrency T-rules, the runtime lock-order
+witness, and the J5/J6 donation/gang jaxpr rules.
+
+Every injected regression from the issue is exercised end to end: a
+seeded AB/BA lock-order cycle (T1), an unlocked counter write (T2), an
+HTTP handler dispatching into the engine directly (T3), a lock held
+across blocking I/O (T4), a mis-donated entrypoint (J5), a gang pair
+with divergent collective order (J6), and a witness run whose observed
+acquisition order contradicts the static baseline (W1). The shipped
+tree must pass all of them clean against the checked-in
+``lock_order.json``.
+"""
+
+import tests._jax_cpu  # noqa: F401  (8 CPU devices before first jax use)
+
+import json
+import textwrap
+import threading
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dcos_commons_tpu.analysis import (errors, lint_threads,
+                                       update_lock_graph, witness)
+from dcos_commons_tpu.analysis import entrypoints as eps
+from dcos_commons_tpu.analysis import thread_rules as tr
+from dcos_commons_tpu.analysis.jaxpr_rules import (collective_sequence,
+                                                   rule_j5_donation,
+                                                   rule_j6_gang_order)
+from dcos_commons_tpu.scheduler.runner import CycleDriver
+
+
+def _lint(sources, **kw):
+    kw.setdefault("suppressions", {})
+    return tr.lint_thread_sources(sources, **kw)
+
+
+def _codes(findings):
+    return [f.code for f in errors(findings)]
+
+
+# ---------------------------------------------------------------------------
+# T1: lock-order cycles + baseline diff
+
+_CYCLE_SRC = textwrap.dedent("""\
+    import threading
+
+    class S:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def fwd(self):
+            with self.a:
+                with self.b:
+                    pass
+
+        def rev(self):
+            with self.b:
+                with self.a:
+                    pass
+""")
+
+_ORDERED_SRC = textwrap.dedent("""\
+    import threading
+
+    class S:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def fwd(self):
+            with self.a:
+                with self.b:
+                    pass
+""")
+
+
+class TestT1LockOrder:
+    def test_ab_ba_cycle_detected(self):
+        findings = _lint({"models/synth.py": _CYCLE_SRC})
+        bad = errors(findings)
+        assert bad and all(f.code == "T1" for f in bad)
+        assert any("cycle" in f.message for f in bad)
+        assert any("synth.S.a" in f.message and "synth.S.b" in f.message
+                   for f in bad)
+
+    def test_cycle_through_helper_call(self):
+        src = textwrap.dedent("""\
+            import threading
+
+            class S:
+                def __init__(self):
+                    self.a = threading.Lock()
+                    self.b = threading.Lock()
+
+                def fwd(self):
+                    with self.a:
+                        self.grab_b()
+
+                def grab_b(self):
+                    with self.b:
+                        pass
+
+                def rev(self):
+                    with self.b:
+                        with self.a:
+                            pass
+        """)
+        assert "T1" in _codes(_lint({"models/synth.py": src}))
+
+    def test_acyclic_nesting_clean(self):
+        assert _codes(_lint({"models/synth.py": _ORDERED_SRC})) == []
+
+    def test_new_edge_vs_baseline_errors(self):
+        baseline = {"edges": {}, "locks": {}}
+        findings = _lint({"models/synth.py": _ORDERED_SRC},
+                         baseline=baseline)
+        bad = errors(findings)
+        assert [f.code for f in bad] == ["T1"]
+        assert "not in baseline" in bad[0].message
+
+    def test_baselined_edge_clean_stale_edge_warns(self):
+        baseline = {"edges": {"synth.S.a -> synth.S.b": "x",
+                              "synth.S.gone -> synth.S.a": "x"},
+                    "locks": {}}
+        findings = _lint({"models/synth.py": _ORDERED_SRC},
+                         baseline=baseline)
+        assert errors(findings) == []
+        assert any(f.code == "T1" and "gone" in f.message
+                   for f in findings)  # stale edge surfaces as WARNING
+
+
+# ---------------------------------------------------------------------------
+# T2: unlocked shared writes
+
+class TestT2UnlockedWrites:
+    def test_mixed_locked_unlocked_counter(self):
+        src = textwrap.dedent("""\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def locked_bump(self):
+                    with self._lock:
+                        self.count += 1
+
+                def unlocked_bump(self):
+                    self.count += 1
+        """)
+        bad = errors(_lint({"models/synth.py": src}))
+        assert [f.code for f in bad] == ["T2"]
+        assert "count" in bad[0].message
+
+    def test_always_locked_clean(self):
+        src = textwrap.dedent("""\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.count += 1
+
+                def bump2(self):
+                    with self._lock:
+                        self.count += 2
+        """)
+        assert _codes(_lint({"models/synth.py": src})) == []
+
+    def test_suppression_downgrades_with_justification(self):
+        src = textwrap.dedent("""\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def locked_bump(self):
+                    with self._lock:
+                        self.count += 1
+
+                def unlocked_bump(self):
+                    self.count += 1
+        """)
+        findings = _lint(
+            {"models/synth.py": src},
+            suppressions={("T2", "synth.C.count"): "GIL-atomic int bump"})
+        assert errors(findings) == []
+        assert any("GIL-atomic" in f.message for f in findings)
+
+    def test_empty_justification_rejected(self):
+        with pytest.raises(ValueError, match="justification"):
+            _lint({"models/synth.py": _ORDERED_SRC},
+                  suppressions={("T2", "synth.C.count"): ""})
+
+    def test_unused_suppression_warns(self):
+        findings = _lint(
+            {"models/synth.py": _ORDERED_SRC},
+            suppressions={("T2", "synth.Nope.gone"): "justified"})
+        assert any(f.code == "T0" and "unused suppression" in f.message
+                   for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# T3: handler -> engine discipline
+
+class TestT3HandlerEngine:
+    def test_handler_dispatching_engine_method(self):
+        src = textwrap.dedent("""\
+            import threading
+            from http.server import BaseHTTPRequestHandler
+
+            class Server:
+                def __init__(self):
+                    self.engine = object()
+
+                def serve(self):
+                    server = self
+
+                    class Handler(BaseHTTPRequestHandler):
+                        def do_GET(self):
+                            server.engine.step()
+        """)
+        bad = errors(_lint({"models/synth.py": src}))
+        assert [f.code for f in bad] == ["T3"]
+        assert "step" in bad[0].message
+
+    def test_allowlisted_read_clean(self):
+        src = textwrap.dedent("""\
+            import threading
+            from http.server import BaseHTTPRequestHandler
+
+            class Server:
+                def __init__(self):
+                    self.engine = object()
+
+                def serve(self):
+                    server = self
+
+                    class Handler(BaseHTTPRequestHandler):
+                        def do_GET(self):
+                            server.engine.page_stats()
+        """)
+        assert _codes(_lint({"models/synth.py": src})) == []
+
+    def test_helper_reachable_from_handler(self):
+        src = textwrap.dedent("""\
+            import threading
+            from http.server import BaseHTTPRequestHandler
+
+            class Server:
+                def __init__(self):
+                    self.engine = object()
+
+                def serve(self):
+                    server = self
+
+                    class Handler(BaseHTTPRequestHandler):
+                        def do_POST(self):
+                            self._work()
+
+                        def _work(self):
+                            server.engine.submit()
+        """)
+        assert "T3" in _codes(_lint({"models/synth.py": src}))
+
+
+# ---------------------------------------------------------------------------
+# T4: blocking calls under locks
+
+class TestT4BlockingUnderLock:
+    def test_file_io_under_lock(self):
+        src = textwrap.dedent("""\
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        with open("/tmp/x") as f:
+                            f.read()
+        """)
+        bad = errors(_lint({"models/synth.py": src}))
+        assert [f.code for f in bad] == ["T4"]
+        assert "file I/O" in bad[0].message
+
+    def test_transitive_blocking_via_helper(self):
+        src = textwrap.dedent("""\
+            import threading
+            import os
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        self._flush()
+
+                def _flush(self):
+                    os.replace("/tmp/a", "/tmp/b")
+        """)
+        bad = errors(_lint({"models/synth.py": src}))
+        assert bad and bad[0].code == "T4"
+
+    def test_io_outside_lock_clean(self):
+        src = textwrap.dedent("""\
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def good(self):
+                    with self._lock:
+                        snap = 1
+                    with open("/tmp/x") as f:
+                        f.read()
+                    return snap
+        """)
+        assert _codes(_lint({"models/synth.py": src})) == []
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree
+
+class TestShippedTree:
+    def test_lint_threads_clean(self):
+        findings = lint_threads()
+        assert errors(findings) == [], "\n".join(
+            str(f) for f in errors(findings))
+
+    def test_lock_graph_baseline_current(self, tmp_path):
+        """--update-lockgraph against the current tree must reproduce the
+        checked-in baseline byte for byte (else someone changed locking
+        without re-baselining)."""
+        out = tmp_path / "lock_order.json"
+        update_lock_graph(out)
+        assert out.read_text() == tr.LOCKGRAPH_PATH.read_text()
+
+    def test_update_refuses_cyclic_graph(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(
+            tr, "_read_sources",
+            lambda modules=None: {"models/synth.py": _CYCLE_SRC})
+        with pytest.raises(ValueError, match="cyclic"):
+            update_lock_graph(tmp_path / "lock_order.json")
+
+
+# ---------------------------------------------------------------------------
+# runtime witness
+
+_WIT_SRC = ("a = threading.Lock()\n"
+            "b = threading.Lock()\n"
+            "def ab():\n"
+            "    with a:\n"
+            "        with b:\n"
+            "            pass\n"
+            "def ba():\n"
+            "    with b:\n"
+            "        with a:\n"
+            "            pass\n")
+
+_WIT_BASELINE = {
+    "locks": {"wit.A": "wit_mod.py:1", "wit.B": "wit_mod.py:2"},
+    "edges": {"wit.A -> wit.B": "wit_mod.py"},
+}
+
+
+def _exec_witnessed():
+    """Construct two locks at pinned synthetic sites (the compile
+    filename becomes the witness's creation-site key)."""
+    ns = {"threading": threading}
+    exec(compile(_WIT_SRC, "/synthetic/wit_mod.py", "exec"), ns)
+    return ns
+
+
+class TestWitness:
+    def test_baselined_order_clean(self):
+        with witness.armed():
+            ns = _exec_witnessed()
+            ns["ab"]()
+        findings = witness.check(_WIT_BASELINE)
+        assert errors(findings) == []
+        assert any(f.code == "T0" for f in findings)  # census line
+
+    def test_reverse_order_fails(self):
+        with witness.armed():
+            ns = _exec_witnessed()
+            ns["ba"]()
+        bad = errors(witness.check(_WIT_BASELINE))
+        assert bad and all(f.code == "W1" for f in bad)
+        assert any("absent from" in f.message for f in bad)
+        assert any("cycle" in f.message for f in bad)
+
+    def test_unknown_sites_ignored(self):
+        with witness.armed():
+            x = threading.Lock()  # noqa: per-call on purpose (unknown site)
+            y = threading.Lock()  # noqa: per-call on purpose (unknown site)
+            with x:
+                with y:
+                    pass
+        assert errors(witness.check(_WIT_BASELINE)) == []
+
+    def test_double_arm_rejected(self):
+        with witness.armed():
+            with pytest.raises(RuntimeError, match="armed"):
+                witness.arm()
+        assert threading.Lock is witness._ORIG_LOCK
+
+    def test_rlock_reentry_records_no_self_edge(self):
+        with witness.armed():
+            ns = {"threading": threading}
+            exec(compile("r = threading.RLock()\n",
+                         "/synthetic/wit_mod.py", "exec"), ns)
+            with ns["r"]:
+                with ns["r"]:
+                    pass
+        assert witness.observed_edges() == {}
+
+
+_CORPUS = json.loads(
+    (Path(__file__).parent / "chaos_corpus.json").read_text())
+
+
+@pytest.mark.parametrize(
+    "entry", _CORPUS[:3],
+    ids=[f"{e['faults']}-seed{e['seed']}" for e in _CORPUS[:3]])
+def test_witness_chaos_smoke(entry):
+    """The three pinned corpus schedules run with the witness armed and
+    the observed acquisition order must be consistent with the static
+    baseline — the dynamic half of the T1 acceptance criterion."""
+    from dcos_commons_tpu.chaos import run_soak
+    from dcos_commons_tpu.chaos.engine import parse_faults
+    with witness.armed():
+        report = run_soak(entry["seed"], ticks=entry["ticks"],
+                          config=parse_faults(entry["faults"]))
+    assert report.ok, "\n".join(report.trace)
+    findings = witness.check()
+    assert errors(findings) == [], "\n".join(
+        str(f) for f in errors(findings))
+
+
+# ---------------------------------------------------------------------------
+# scheduler fail-fast
+
+class TestThreadFailFast:
+    def test_thread_errors_refuse_start(self, monkeypatch):
+        from dcos_commons_tpu.analysis.findings import Finding, Severity
+        monkeypatch.setattr(tr, "_CACHED", [Finding(
+            "T1", Severity.ERROR, "synth",
+            "lock-order cycle: a -> b -> a")])
+
+        class _Sched:  # spec-less: skips the S-rule gate
+            def run_cycle(self):
+                pass
+
+        with pytest.raises(ValueError, match="T1"):
+            CycleDriver(_Sched()).start()
+
+    def test_shipped_tree_starts(self):
+        class _Sched:
+            def run_cycle(self):
+                pass
+
+        driver = CycleDriver(_Sched(), interval_s=0.01)
+        driver.start()
+        driver.stop()
+
+
+# ---------------------------------------------------------------------------
+# J5: donation aliasing
+
+class TestJ5Donation:
+    def test_aliasable_donation_clean(self):
+        x = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+        assert rule_j5_donation(lambda a: a * 2, (x,), (0,)) == []
+
+    def test_misdonated_input_flagged(self):
+        x = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+        bad = rule_j5_donation(lambda a: a.sum(), (x,), (0,),
+                               location="synth")
+        assert [f.code for f in bad] == ["J5"]
+        assert "(4, 8)" in bad[0].message
+
+    def test_output_buffer_not_double_counted(self):
+        # two donated inputs, one compatible output: exactly one J5
+        x = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+        bad = rule_j5_donation(lambda a, b: a + b, (x, x), (0, 1))
+        assert [f.code for f in bad] == ["J5"]
+
+    def test_dtype_mismatch_flagged(self):
+        x = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+        bad = rule_j5_donation(
+            lambda a: a.astype(jnp.bfloat16), (x,), (0,))
+        assert [f.code for f in bad] == ["J5"]
+
+    def test_pytree_donation(self):
+        tree = {"k": jax.ShapeDtypeStruct((2, 2), jnp.float32),
+                "v": jax.ShapeDtypeStruct((2, 2), jnp.float32)}
+        assert rule_j5_donation(lambda t, i: jax.tree.map(
+            lambda l: l + i, t), (tree, 1.0), (0,)) == []
+
+    def test_shipped_donation_sites_clean(self):
+        assert sorted(eps.DONATION_SITES) == [
+            "adopt_pages_install", "paged_decode_pool",
+            "spec_window_pool_and_draft", "train_step_state"]
+        for name in sorted(eps.DONATION_SITES):
+            site = eps.DONATION_SITES[name]
+            if eps._skip_reason(site):
+                continue
+            fn, args, donate = site.build()
+            assert rule_j5_donation(fn, args, donate,
+                                    location=name) == [], name
+
+    def test_duplicate_site_rejected(self):
+        site = eps.DONATION_SITES["paged_decode_pool"]
+        with pytest.raises(ValueError, match="duplicate"):
+            eps.register_donation_site(site)
+
+
+# ---------------------------------------------------------------------------
+# J6: gang collective order
+
+def _gang_jaxpr(flavor):
+    if flavor == "ps_ag":
+        fn = lambda x: jax.lax.all_gather(jax.lax.psum(x, "i"), "i")
+    else:
+        fn = lambda x: jax.lax.psum(jax.lax.all_gather(x, "i"), "i")
+    return jax.make_jaxpr(fn, axis_env=[("i", 2)])(1.0)
+
+
+class TestJ6GangOrder:
+    def test_identical_sequences_clean(self):
+        seqs = {"x": ["psum", "all_gather"], "y": ["psum", "all_gather"]}
+        assert rule_j6_gang_order("g", seqs) == []
+
+    def test_divergent_order_flagged(self):
+        seqs = {"x": ["psum", "all_gather"], "y": ["all_gather", "psum"]}
+        bad = rule_j6_gang_order("g", seqs)
+        assert [f.code for f in bad] == ["J6"]
+        assert "#0" in bad[0].message
+
+    def test_singleton_group_vacuous(self):
+        assert rule_j6_gang_order("g", {"x": ["psum"]}) == []
+
+    def test_collective_sequence_program_order(self):
+        assert collective_sequence(_gang_jaxpr("ps_ag")) == \
+            ["psum", "all_gather"]
+        assert collective_sequence(_gang_jaxpr("ag_ps")) == \
+            ["all_gather", "psum"]
+
+    def test_lint_entrypoints_catches_divergent_gang(self, monkeypatch):
+        monkeypatch.setattr(eps, "DONATION_SITES", {})
+        names = ["zz_gang_a", "zz_gang_b"]
+        for name, flavor in zip(names, ("ps_ag", "ag_ps")):
+            eps.register_hot_path(eps.HotPath(
+                name, lambda flavor=flavor: _gang_jaxpr(flavor),
+                budget_bytes=1 << 20, gang_group="zz_test_gang"))
+        try:
+            findings = eps.lint_entrypoints(names=names, manifest={})
+            bad = errors(findings)
+            assert [f.code for f in bad] == ["J6"]
+            assert "zz_test_gang" in bad[0].message
+        finally:
+            for name in names:
+                eps.HOT_PATHS.pop(name)
+
+    def test_lint_entrypoints_reports_untraceable_gang(self, monkeypatch):
+        monkeypatch.setattr(eps, "DONATION_SITES", {})
+        eps.register_hot_path(eps.HotPath(
+            "zz_gang_solo", lambda: _gang_jaxpr("ps_ag"),
+            budget_bytes=1 << 20, devices_needed=10_000,
+            gang_group="zz_solo_gang"))
+        try:
+            findings = eps.lint_entrypoints(names=["zz_gang_solo"],
+                                            manifest={})
+            assert errors(findings) == []
+            assert any(f.code == "J0" and "zz_solo_gang" in f.location
+                       for f in findings)
+        finally:
+            eps.HOT_PATHS.pop("zz_gang_solo")

@@ -7,6 +7,13 @@ seed set until either the set is exhausted or the time budget runs out —
 a slow CI host skips tail seeds rather than timing out the build. Any
 non-converging seed or invariant violation fails the build and prints the
 reproduction command plus the tick trace.
+
+The sweep runs with the lock-order witness armed (``--no-witness`` to
+opt out): every lock the soaks construct records its real per-thread
+acquisition order, and after the sweep the observed graph is checked
+against the static ``lock_order.json`` baseline — a W1 finding (an
+observed edge the static analysis missed, or a cycle across baseline +
+observed) fails the build exactly like an invariant violation.
 """
 
 from __future__ import annotations
@@ -26,26 +33,49 @@ def main(argv=None) -> int:
     ap.add_argument("--budget-s", type=float, default=60.0,
                     help="wall-clock cap; tail seeds are skipped, not "
                          "failed, when it runs out (default 60)")
+    ap.add_argument("--no-witness", action="store_true",
+                    help="skip the runtime lock-order witness (on by "
+                         "default; see analysis/witness.py)")
     args = ap.parse_args(argv)
 
+    from dcos_commons_tpu.analysis import witness
     from dcos_commons_tpu.chaos import run_soak
 
-    deadline = time.monotonic() + args.budget_s
-    ran = 0
-    for seed in range(args.seeds):
-        if time.monotonic() >= deadline:
-            print(f"chaos-smoke: time budget exhausted after {ran} seeds "
-                  f"(of {args.seeds}); remaining seeds skipped")
-            break
-        report = run_soak(seed, ticks=args.ticks)
-        ran += 1
-        if not report.ok:
-            print(json.dumps(report.to_dict(), indent=1))
-            print(f"\nchaos-smoke FAILED at seed {seed} (reproduce: "
-                  f"python -m dcos_commons_tpu.cli.main chaos-soak "
-                  f"--seed {seed} --ticks {args.ticks})", file=sys.stderr)
-            for line in report.trace:
-                print(f"  {line}", file=sys.stderr)
+    use_witness = not args.no_witness
+    if use_witness:
+        witness.arm()
+    try:
+        deadline = time.monotonic() + args.budget_s
+        ran = 0
+        for seed in range(args.seeds):
+            if time.monotonic() >= deadline:
+                print(f"chaos-smoke: time budget exhausted after {ran} "
+                      f"seeds (of {args.seeds}); remaining seeds skipped")
+                break
+            report = run_soak(seed, ticks=args.ticks)
+            ran += 1
+            if not report.ok:
+                print(json.dumps(report.to_dict(), indent=1))
+                print(f"\nchaos-smoke FAILED at seed {seed} (reproduce: "
+                      f"python -m dcos_commons_tpu.cli.main chaos-soak "
+                      f"--seed {seed} --ticks {args.ticks})",
+                      file=sys.stderr)
+                for line in report.trace:
+                    print(f"  {line}", file=sys.stderr)
+                return 1
+    finally:
+        if use_witness:
+            witness.disarm()
+    if use_witness:
+        from dcos_commons_tpu.analysis import errors
+        findings = witness.check()
+        bad = errors(findings)
+        for f in findings:
+            print(f"witness: {f}")
+        if bad:
+            print(f"\nchaos-smoke FAILED: runtime lock order contradicts "
+                  f"the static baseline ({len(bad)} W1 finding(s))",
+                  file=sys.stderr)
             return 1
     print(f"chaos-smoke: {ran} seeds converged, zero invariant violations")
     return 0

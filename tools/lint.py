@@ -3,9 +3,11 @@
 Aggregates (in order) ``tools.static_check``, ``tools.type_check``,
 ``tools.airgap_linter`` over ``frameworks/*/``, the S-rule spec lint of
 every shipped ``frameworks/*/dist/*.yml`` (rendered with each framework's
-package-default env), and the J-rule jaxpr lint of the registered hot-path
-entrypoints against ``collective_manifest.json``. This is what test.sh
-calls; run a single stage locally with ``--only STAGE``.
+package-default env), the T-rule concurrency lint of the threaded serving
+tier against ``lock_order.json``, and the J-rule jaxpr lint of the
+registered hot-path entrypoints against ``collective_manifest.json``.
+This is what test.sh calls; run a single stage locally with
+``--only STAGE``.
 """
 
 from __future__ import annotations
@@ -51,6 +53,17 @@ def _stage_specs() -> int:
     return 1 if bad else 0
 
 
+def _stage_threads() -> int:
+    """T-rules over the threaded serving tier: lock-order graph vs the
+    checked-in ``lock_order.json``, unlocked shared writes, handler ->
+    engine discipline, blocking calls under locks. Stdlib-only."""
+    from dcos_commons_tpu.analysis import errors, render_report
+    from dcos_commons_tpu.analysis.thread_rules import lint_threads
+    findings = lint_threads()
+    print(render_report(findings, label="thread-lint"))
+    return 1 if errors(findings) else 0
+
+
 def _stage_jaxpr() -> int:
     from dcos_commons_tpu.analysis.__main__ import _force_cpu_mesh
     _force_cpu_mesh()
@@ -66,6 +79,7 @@ _STAGES = (
     ("types", _stage_types),
     ("airgap", _stage_airgap),
     ("specs", _stage_specs),
+    ("threads", _stage_threads),
     ("jaxpr", _stage_jaxpr),
 )
 

@@ -21,6 +21,12 @@ E1  bare ``except:`` (swallows KeyboardInterrupt/SystemExit; catch
     Exception — or narrower — instead)
 F1  f-string with no placeholders (either a forgotten ``{var}`` or a
     plain string wearing an ``f`` prefix)
+E3  ``threading.Lock()`` / ``threading.RLock()`` constructed inside a
+    method body other than ``__init__``: a lock created per-call
+    guards nothing (every caller gets a fresh, uncontended lock) and
+    its creation site defeats the lock-order identity the analysis
+    T-rules and the runtime witness key on — construct locks in
+    ``__init__`` or at module scope
 
 ``# noqa`` on the offending line exempts any check. E0 = unreadable
 file, E2 = syntax error (structural; not suppressible).
@@ -218,6 +224,18 @@ def _positional_bounds(fn: ast.FunctionDef) -> Optional[Tuple[int, int]]:
     return n_pos - n_default, n_pos
 
 
+def _own_calls(fn: ast.AST):
+    """Call nodes in a method body, skipping nested ClassDef subtrees
+    (a nested class's methods get their own E3 pass); closures stay in
+    scope — a lock built in a per-call closure is just as useless."""
+    for child in ast.iter_child_nodes(fn):
+        if isinstance(child, ast.ClassDef):
+            continue
+        if isinstance(child, ast.Call):
+            yield child
+        yield from _own_calls(child)
+
+
 def _check_ast(path: Path, source: str, tree: ast.Module,
                findings: List[Finding]) -> None:
     noqa = _noqa_lines(source)
@@ -333,6 +351,27 @@ def _check_ast(path: Path, source: str, tree: ast.Module,
                     path, node.lineno, "A1",
                     f"call to '{fn.name}' with {n_pos} positional + "
                     f"{covered} keyword args (needs {lo})"))
+        # E3 lock constructed per-call inside a method body
+        if isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if not isinstance(stmt,
+                                  (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if stmt.name == "__init__":
+                    continue
+                for call in _own_calls(stmt):
+                    f = call.func
+                    if (isinstance(f, ast.Attribute)
+                            and f.attr in ("Lock", "RLock")
+                            and isinstance(f.value, ast.Name)
+                            and f.value.id == "threading"
+                            and call.lineno not in noqa):
+                        findings.append(Finding(
+                            path, call.lineno, "E3",
+                            f"threading.{f.attr}() constructed inside "
+                            f"method '{node.name}.{stmt.name}': a "
+                            f"per-call lock guards nothing — create it "
+                            f"in __init__ or at module scope"))
 
 
 # ---------------------------------------------------------------------------
