@@ -538,6 +538,17 @@ def _donation_spec_window():
             (2, 3))
 
 
+def _donation_reshard_resume():
+    # the restart-free reshard install (parallel/reshard.py): ``adopt``
+    # stages a brand-new tree shaped exactly like the warmup OUTPUTS
+    # (worker.py passes those as the template), and the resumed train
+    # step consumes it with the same donate_argnums=(0, 1) as a cold
+    # start — the same program as train_step_state, registered as its
+    # own site so a template/step drift breaks J5 under the reshard
+    # name, not just the cold-start one
+    return _donation_train_step()
+
+
 def _donation_adopt_install():
     from ..models import llama
     from ..models.serving import _install_pages
@@ -577,6 +588,14 @@ register_donation_site(DonationSite(
     "adopt_pages_install", _donation_adopt_install,
     description="the adopt_pages install scatter: pool donated into "
                 "the page-installed pool (serving.py _adopt_exec)"))
+register_donation_site(DonationSite(
+    "reshard_resume_state", _donation_reshard_resume,
+    description="the restart-free reshard install (parallel/reshard.py "
+                "adopt -> worker.py resume): the staged tree is shaped "
+                "exactly like the warmup outputs, so the resumed "
+                "step's donate_argnums=(0, 1) aliases every adopted "
+                "leaf and the old mesh's buffers free on the first "
+                "post-reshard step"))
 
 
 # ---------------------------------------------------------------------------

@@ -56,6 +56,7 @@ LOCKGRAPH_MODULES: Tuple[str, ...] = (
     "models/paging.py",
     "models/weights.py",
     "models/serving.py",
+    "parallel/reshard.py",
     "scheduler/core.py",
     "metrics.py",
 )
@@ -70,6 +71,9 @@ SERVING_MODULES: Tuple[str, ...] = (
     "models/paging.py",
     "models/weights.py",
     "models/serving.py",
+    # the reshard manager's shard transfers ride the weight channel
+    # from worker threads: T4's no-I/O-under-lock applies verbatim
+    "parallel/reshard.py",
 )
 
 LOCKGRAPH_PATH = Path(__file__).resolve().parent / "lock_order.json"

@@ -47,10 +47,22 @@ decommissions the busiest serving replica mid-stream and
 :class:`~.invariants.MigrationInvariantChecker` auditing token-exact
 continuation and that no migrated stream ever drops.
 
+Round 20 adds the restart-free-resharding layer: :class:`_ReshardSim`
+models the train gang's loss trajectory as a pure hash chain over
+``(seed, step)`` and plays the ``parallel/reshard.py`` freeze ->
+transfer -> transactional-install protocol against it — spontaneous
+mesh resizes plus two fault classes (``reshard_mid_step`` aborts a
+transfer mid-step, ``reshard_peer_lost`` kills transfer sources with
+retries on survivors), with
+:class:`~.invariants.ReshardInvariantChecker` auditing invariant 20:
+the trajectory digest after ANY reshard outcome equals the chain
+recomputed independently, and every failed leg degrades to the
+sentinel-flush fallback instead of crashing.
+
 Determinism contract matches ``chaos/soak.py``: one ``random.Random(seed)``
-drives the scheduler-facing weather; the load, flush, router, boot, and
-migration simulators run on their own derived RNGs so arming a new fault
-class never perturbs the draw order of a pinned seed.
+drives the scheduler-facing weather; the load, flush, router, boot,
+migration, and reshard simulators run on their own derived RNGs so arming
+a new fault class never perturbs the draw order of a pinned seed.
 """
 
 from __future__ import annotations
@@ -76,8 +88,9 @@ from ..state.tasks import TaskState
 from ..testing.simulation import default_agents, tpu_slice_agents
 from .engine import ChaosCluster, FaultConfig
 from .invariants import (ElasticInvariantChecker, InvariantChecker,
-                         MigrationInvariantChecker, RouterInvariantChecker,
-                         Violation)
+                         MigrationInvariantChecker, ReshardInvariantChecker,
+                         RouterInvariantChecker, Violation,
+                         loss_chain_digest)
 from .soak import SETTLE_BUDGET, SoakReport
 
 SERVE_YML = """
@@ -474,6 +487,96 @@ class _MigrateSim:
         return victim, moved
 
 
+class _ReshardSim:
+    """Restart-free gang resharding over the train tier (the
+    ``parallel/reshard.py`` seam): the gang's loss trajectory is
+    modelled as the pure blake2s hash chain
+    :func:`~.invariants.loss_chain_digest` over ``(seed, step)``, and
+    every reshard event books a receipt carrying the post-event step
+    and chain digest for :class:`~.invariants.ReshardInvariantChecker`
+    (invariant 20). A successful adopt is bitwise — the frozen step's
+    digest is unchanged by moving shards between mesh widths — and a
+    failed leg (``reshard_mid_step`` corrupting a transfer,
+    ``reshard_peer_lost`` killing every source) unwinds
+    transactionally and degrades to the sentinel-flush fallback: state
+    rolls back to the last flushed step and REPLAYS the same chain,
+    never a divergent curve, never a crash. Runs on its own derived
+    RNG, so arming the fault classes never perturbs the
+    scheduler-facing draw order of a pinned seed."""
+
+    FLUSH_EVERY = 4      # sentinel flush cadence, gang steps
+    RESIZE_P = 0.15      # spontaneous autoscaler-resize probability/tick
+    MESHES = (4, 2, 1)   # legal train-gang mesh widths
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.rng = random.Random((seed << 38) ^ 0xD6E8FEB86659FD93)
+        self.workers = self.MESHES[0]
+        self.step = 0
+        self.flush_step = 0
+        self.pending_abort = False   # next transfer corrupts mid-step
+        self.pending_peer_loss = 0   # sources lost on the next transfer
+        self.receipts: List[dict] = []
+        self.fallbacks = 0
+
+    def advance(self, tick: int, train_running: int) -> None:
+        """One gang tick: progress (and flushes) only while every
+        learner is RUNNING — a preempted or flapped gang is frozen."""
+        if train_running < 2:
+            return
+        self.step += 1
+        if self.step % self.FLUSH_EVERY == 0:
+            self.flush_step = self.step
+        if self.rng.random() < self.RESIZE_P:
+            self._reshard(tick)
+
+    def _reshard(self, tick: int) -> None:
+        """Freeze at the current step boundary, move shards to a new
+        mesh width, install transactionally; book the receipt."""
+        old = self.workers
+        target = self.rng.choice([w for w in self.MESHES if w != old])
+        frozen = self.step
+        retries = 0
+        ok = True
+        fallback = None
+        if self.pending_abort:
+            # mid-step corruption: the adopt's shard digest check trips
+            # before anything installs — transactional unwind
+            self.pending_abort = False
+            ok = False
+        elif self.pending_peer_loss:
+            # one retry per surviving source; the transfer only falls
+            # back when every peer holding the frozen state is gone
+            retries = self.pending_peer_loss
+            self.pending_peer_loss = 0
+            ok = retries < old
+        if ok:
+            self.workers = target
+        else:
+            # degrade, never crash: old state untouched, then the
+            # sentinel-flush restore replays the chain from the flush
+            fallback = "sentinel-flush"
+            self.step = self.flush_step
+            self.fallbacks += 1
+        self.receipts.append({
+            "tick": tick, "step": self.step, "frozen_step": frozen,
+            "from": old, "to": self.workers, "ok": ok,
+            "fallback": fallback, "retries": retries,
+            "digest": loss_chain_digest(self.seed, self.step)})
+
+    # -- fault entry points (both force an attempt so the fault lands) --
+
+    def abort_mid_step(self, tick: int) -> dict:
+        self.pending_abort = True
+        self._reshard(tick)
+        return self.receipts[-1]
+
+    def lose_peer(self, tick: int) -> dict:
+        self.pending_peer_loss = self.rng.randint(1, self.workers)
+        self._reshard(tick)
+        return self.receipts[-1]
+
+
 class _FlushSim:
     """Plays the worker sentinel's side of the graceful-kill protocol:
     every task holding a delivered-but-unanswered SIGTERM checkpoint-
@@ -623,6 +726,7 @@ class ElasticSoak:
         self.routersim = _RouterSim(seed)
         self.bootsim = _BootSim(seed)
         self.migratesim = _MigrateSim(seed)
+        self.reshardsim = _ReshardSim(seed)
         self.warmpool = None
         if warm_pool > 0:
             self.warmpool = WarmPool(lambda: self.multi, "serve", "decode",
@@ -648,6 +752,7 @@ class ElasticSoak:
         self.elastic_checker = ElasticInvariantChecker(self)
         self.router_checker = RouterInvariantChecker(self)
         self.migration_checker = MigrationInvariantChecker(self)
+        self.reshard_checker = ReshardInvariantChecker(self)
 
     # -- scheduler lifecycle -----------------------------------------------
 
@@ -866,6 +971,24 @@ class ElasticSoak:
                 self._count("migrate_mid_stream")
                 self._log(f"tick {tick}: migrate_mid_stream {victim} "
                           f"({moved} streams drained to survivors)")
+        # -- reshard faults (reshard sim's derived RNG: arming them never
+        # -- perturbs the scheduler-facing draw order of pinned seeds) --
+        if cfg.reshard_mid_step and self.reshardsim.rng.random() \
+                < cfg.reshard_mid_step:
+            rec = self.reshardsim.abort_mid_step(tick)
+            self._count("reshard_mid_step")
+            self._log(f"tick {tick}: reshard_mid_step (transfer "
+                      f"{rec['from']} -> {rec['to']} aborted at step "
+                      f"{rec['frozen_step']}, fell back to flushed step "
+                      f"{rec['step']})")
+        if cfg.reshard_peer_lost and self.reshardsim.rng.random() \
+                < cfg.reshard_peer_lost:
+            rec = self.reshardsim.lose_peer(tick)
+            outcome = (f"retried on survivors x{rec['retries']}"
+                       if rec["ok"] else
+                       f"all sources gone, fell back to step {rec['step']}")
+            self._count("reshard_peer_lost")
+            self._log(f"tick {tick}: reshard_peer_lost ({outcome})")
         if cfg.scale_mid_crash and rng.random() < cfg.scale_mid_crash:
             # force a resize so a scale plan is guaranteed in flight, then
             # kill the scheduler mid-rollout; the restored plans resume it
@@ -904,6 +1027,7 @@ class ElasticSoak:
         found += self.elastic_checker.check(tick)
         found += self.router_checker.check(tick)
         found += self.migration_checker.check(tick)
+        found += self.reshard_checker.check(tick)
         for v in found:
             self._log(f"VIOLATION {v}")
         self.violations.extend(found)
@@ -921,6 +1045,8 @@ class ElasticSoak:
         # weights resident precisely because they loaded them) books its
         # weight source
         self.bootsim.advance(tick, self._decode_tasks(include_warm=True))
+        # the train gang only steps (and resizes) while fully running
+        self.reshardsim.advance(tick, self._train_running())
         self.controller.tick(tick)
         for name in self.multi.service_names():
             sched = self.multi.get_service(name)

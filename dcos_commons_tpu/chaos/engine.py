@@ -156,6 +156,18 @@ class FaultConfig:
     # replay unperturbed.
     expert_overflow: float = 0.0
     ring_prefill_stall: float = 0.0
+    # restart-free reshard faults (elastic soak harness reshard sim,
+    # parallel/reshard.py seam): the gang's live-state transfer aborts
+    # mid-step — after the GANGSTATE frame verified but before every
+    # shard installed — and the transaction must unwind to the
+    # sentinel-flush fallback with the loss trajectory still bitwise
+    # (reshard_mid_step); the peer serving the frozen state dies
+    # mid-fetch — the rotation must retry the next peer or land in the
+    # same fallback, never a wedge (reshard_peer_lost). Both draw from
+    # a derived RNG private to the reshard sim, so the legacy pinned
+    # seeds replay unperturbed.
+    reshard_mid_step: float = 0.0
+    reshard_peer_lost: float = 0.0
     max_delay_ticks: int = 3
 
     FIELDS = ("status_drop", "status_delay", "status_dup", "status_reorder",
@@ -167,7 +179,8 @@ class FaultConfig:
               "warm_promote_crash", "weight_fetch_lost",
               "migrate_mid_stream", "kv_tier_corrupt",
               "promote_during_evict", "draft_stale", "draft_corrupt",
-              "expert_overflow", "ring_prefill_stall")
+              "expert_overflow", "ring_prefill_stall",
+              "reshard_mid_step", "reshard_peer_lost")
 
     @classmethod
     def none(cls) -> "FaultConfig":
@@ -203,7 +216,8 @@ class FaultConfig:
                        migrate_mid_stream=0.0, kv_tier_corrupt=0.0,
                        promote_during_evict=0.0, draft_stale=0.0,
                        draft_corrupt=0.0, expert_overflow=0.0,
-                       ring_prefill_stall=0.0)
+                       ring_prefill_stall=0.0, reshard_mid_step=0.0,
+                       reshard_peer_lost=0.0)
 
 
 def parse_faults(arg: str) -> FaultConfig:

@@ -60,10 +60,19 @@ to a real reference-era incident class:
     and every injected fault is accounted: each ring stall maps to
     exactly one chunked fallback, each overflow injection is either
     covered by the audit or provably idle.
+20. **loss-trajectory-exact** — restart-free gang resharding
+    (``parallel/reshard.py``) is a placement change, never an author:
+    after any reshard — successful adopt, mid-step abort
+    (``reshard_mid_step``), or peer loss with retries
+    (``reshard_peer_lost``) — the train gang's loss trajectory digest
+    must equal the pure (seed, step) hash chain recomputed
+    independently by the checker, and a failed leg must name the
+    sentinel-flush fallback it degraded to instead of crashing.
 """
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass
 from typing import Dict, List
 
@@ -618,4 +627,71 @@ class MigrationInvariantChecker:
                     f"at tick {t} — drain-before-reclaim lost the "
                     "stream", tick))
         self._drops_seen = len(rsim.drops)
+        return out
+
+
+def loss_chain_digest(seed: int, step: int) -> str:
+    """The reshard sim's loss trajectory as a pure hash chain: the
+    digest at ``step`` is a function of ``(seed, step)`` ONLY, so any
+    state a reshard corrupts — and any fallback that fails to replay
+    the exact flushed bytes — shows up as a digest mismatch against an
+    independent recompute. blake2s, not ``hash()``: str hashing is
+    salted per-process and would break pinned-seed replay."""
+    d = hashlib.blake2s(f"loss:{seed}".encode(), digest_size=8).digest()
+    for i in range(step):
+        d = hashlib.blake2s(d + i.to_bytes(4, "big"),
+                            digest_size=8).digest()
+    return d.hex()
+
+
+class ReshardInvariantChecker:
+    """Restart-free resharding invariant over the elastic harness's
+    reshard sim (``chaos/elastic_soak.py`` :class:`_ReshardSim`,
+    modelling the ``parallel/reshard.py`` freeze -> plan -> transfer ->
+    transactional-install protocol):
+
+    20. **loss-trajectory-exact** — every reshard receipt's trajectory
+        digest equals the pure ``(seed, step)`` hash chain recomputed
+        here from first principles: a successful adopt is bitwise (the
+        frozen step's digest is unchanged by moving shards between
+        meshes), and a failed leg must unwind transactionally and
+        degrade to the sentinel-flush fallback, replaying the identical
+        chain from the flushed step. A mismatched digest means the
+        install mutated live state or the fallback restored divergent
+        bytes; a failed receipt naming no fallback means the gang
+        crashed instead of degrading; a fallback that lands *ahead* of
+        the aborted step means the unwind leaked partial progress.
+    """
+
+    def __init__(self, harness):
+        self._h = harness          # needs .reshardsim
+        self._seen = 0
+
+    def check(self, tick: int) -> List[Violation]:
+        sim = self._h.reshardsim
+        out: List[Violation] = []
+        for rec in sim.receipts[self._seen:]:
+            expect = loss_chain_digest(sim.seed, rec["step"])
+            if rec["digest"] != expect:
+                out.append(Violation(
+                    "loss-trajectory-exact",
+                    f"reshard at tick {rec['tick']} left the gang at "
+                    f"step {rec['step']} with digest {rec['digest']} != "
+                    f"chain {expect} — the loss trajectory diverged",
+                    tick))
+            if not rec["ok"]:
+                if rec.get("fallback") != "sentinel-flush":
+                    out.append(Violation(
+                        "loss-trajectory-exact",
+                        f"failed reshard at tick {rec['tick']} named no "
+                        "sentinel-flush fallback — the degrade path is "
+                        "missing", tick))
+                if rec["step"] > rec["frozen_step"]:
+                    out.append(Violation(
+                        "loss-trajectory-exact",
+                        f"failed reshard at tick {rec['tick']} fell "
+                        f"back to step {rec['step']} AHEAD of the "
+                        f"frozen step {rec['frozen_step']} — the unwind "
+                        "leaked partial progress", tick))
+        self._seen = len(sim.receipts)
         return out

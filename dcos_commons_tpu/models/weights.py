@@ -120,7 +120,16 @@ class WeightServer:
     ``/v1/weights/shard?step=N&file=F``, plus the standard
     ``/v1/healthz`` / ``/v1/metrics`` / ``/v1/metrics/prometheus``
     trio every replica shape exposes. Only files named by the step's
-    own manifest are served (no path traversal by construction)."""
+    own manifest are served (no path traversal by construction).
+
+    Round 19: the same routes can serve LIVE state — a training gang
+    frozen at a step boundary publishes its in-memory export
+    (``publish_live``: manifest + shard blobs + the GANGSTATE frame,
+    see ``parallel/reshard.py``) and peers pull it with zero checkpoint
+    I/O; ``/v1/weights/gangstate`` answers the raw frame. The live
+    snapshot shadows committed disk steps while published and vanishes
+    on ``clear_live``. ``_live_lock`` guards only the snapshot
+    reference; response bodies are written after it is released (T4)."""
 
     def __init__(self, ckpt_dir: str, port: int = 0,
                  host: str = "0.0.0.0", pid: int = 0,
@@ -129,6 +138,8 @@ class WeightServer:
         self.pid = pid
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._own_metrics = metrics is None
+        self._live_lock = threading.Lock()
+        self._live: Optional[dict] = None
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -189,6 +200,19 @@ class WeightServer:
                     self.send_header("Content-Length", str(len(frame)))
                     self.end_headers()
                     self.wfile.write(frame)
+                elif parsed.path == "/v1/weights/gangstate":
+                    frame = server.gangstate_frame()
+                    if frame is None:
+                        self._json(404,
+                                   {"error": "no live gang state published"})
+                        return
+                    server.metrics.counter("weights.gangstate_served")
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(frame)))
+                    self.end_headers()
+                    self.wfile.write(frame)
                 else:
                     self._json(404, {"error": f"no route {parsed.path}"})
 
@@ -196,6 +220,43 @@ class WeightServer:
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    # -- live state (restart-free reshard, parallel/reshard.py) --------------
+
+    def publish_live(self, step: int, manifest: dict,
+                     blobs: Dict[str, bytes],
+                     frame: Optional[bytes] = None) -> None:
+        """Expose a frozen LIVE training state on the weight routes.
+        ``blobs`` maps shard file names to raw bytes (the manifest's
+        digests still verify end-to-end); ``frame`` is the opaque
+        GANGSTATE frame served at ``/v1/weights/gangstate``. The
+        snapshot is replaced wholesale — never mutated in place — so
+        readers that copied the reference out of the lock stay
+        coherent."""
+        snap = {"step": int(step), "manifest": manifest,
+                "blobs": dict(blobs), "frame": frame}
+        with self._live_lock:
+            self._live = snap
+        self.metrics.counter("weights.live_published")
+
+    def clear_live(self) -> None:
+        with self._live_lock:
+            self._live = None
+
+    def _live_view(self, step: Optional[int] = None) -> Optional[dict]:
+        with self._live_lock:
+            live = self._live
+        if live is None or (step is not None and live["step"] != step):
+            return None
+        return live
+
+    def live_step(self) -> Optional[int]:
+        live = self._live_view()
+        return None if live is None else live["step"]
+
+    def gangstate_frame(self) -> Optional[bytes]:
+        live = self._live_view()
+        return None if live is None else live.get("frame")
 
     # -- checkpoint surface --------------------------------------------------
 
@@ -209,6 +270,11 @@ class WeightServer:
         return d
 
     def manifest(self, step: Optional[int] = None) -> dict:
+        live = self._live_view(step)
+        if live is not None:
+            steps = sorted(set(self.steps()) | {live["step"]})
+            return {"step": live["step"], "steps": steps,
+                    "manifest": live["manifest"], "live": True}
         steps = self.steps()
         if step is None:
             if not steps:
@@ -221,6 +287,14 @@ class WeightServer:
         return {"step": step, "steps": steps, "manifest": manifest}
 
     def shard_frame(self, step: int, fname: str) -> bytes:
+        live = self._live_view(step)
+        if live is not None:
+            body = live["blobs"].get(fname)
+            if body is None:
+                raise FileNotFoundError(
+                    f"live step {step} has no shard {fname!r}")
+            return pack_frame({"step": step, "file": fname, "live": True},
+                              body)
         step_d = self._step_dir(step)
         with open(os.path.join(step_d, "manifest.json"),
                   encoding="utf-8") as f:
@@ -403,6 +477,20 @@ class PeerFetcher:
                 self.metrics.counter("weights.bytes_fetched", len(body))
             return body
         raise WeightFetchError(f"shard {fname!r}: {last}")
+
+    def gangstate(self) -> bytes:
+        """Fetch the raw GANGSTATE frame a frozen gang published for its
+        live training state (``parallel/reshard.py`` verifies the whole
+        frame ladder before anything is reserved)."""
+        last = "no healthy weight peer"
+        for peer in self._order():
+            try:
+                return self._get(peer, "/v1/weights/gangstate")
+            except Exception as e:
+                last = f"{peer}: {e}"
+                self._mark_down(peer)
+                continue
+        raise WeightFetchError(f"gangstate fetch failed: {last}")
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

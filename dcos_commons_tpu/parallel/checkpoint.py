@@ -107,6 +107,50 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
+def _iter_shards(jax, arr):
+    """Yield ``(index_key, np_data)`` for this process's addressable
+    shards of one array, deduped by index (each distinct index once,
+    replicas skipped)."""
+    seen = set()
+    for shard in arr.addressable_shards:
+        ikey = _index_key(shard.index)
+        if ikey in seen:
+            continue  # replica of a shard this process already holds
+        seen.add(ikey)
+        yield ikey, np.asarray(shard.data)
+
+
+def export_tree(tree: Any) -> "tuple[Dict[str, dict], Dict[str, bytes]]":
+    """Shard a LIVE pytree into host memory: ``(leaves, blobs)`` in the
+    exact manifest schema ``save_sharded`` commits to disk (per-shard
+    blake2s digests included), without touching the filesystem.
+
+    This is the restart-free reshard path's export leg
+    (``parallel/reshard.py``): a frozen gang serves these bytes over the
+    P2P weight channel instead of round-tripping a committed checkpoint.
+    Pure read — the running arrays are untouched."""
+    import jax
+
+    leaves: Dict[str, dict] = {}
+    blobs: Dict[str, bytes] = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        key = _leaf_key(path)
+        arr = leaf if isinstance(leaf, jax.Array) else jax.numpy.asarray(leaf)
+        shards: List[dict] = []
+        for ikey, data in _iter_shards(jax, arr):
+            fname = f"{key}.{ikey}.bin"
+            raw = data.tobytes()
+            blobs[fname] = raw
+            shards.append({"file": fname, "index": ikey,
+                           "local_shape": list(data.shape),
+                           "bytes": len(raw),
+                           "digest": hashlib.blake2s(raw).hexdigest()})
+        leaves[key] = {"global_shape": list(arr.shape),
+                       "dtype": str(arr.dtype), "shards": shards}
+    return leaves, blobs
+
+
 def save_sharded(out_dir: str, step: int, tree: Any, keep: int = 3) -> str:
     """Write this process's shards of ``tree`` (any pytree of jax arrays)
     for ``step``; returns the committed directory."""
@@ -125,13 +169,7 @@ def save_sharded(out_dir: str, step: int, tree: Any, keep: int = 3) -> str:
         key = _leaf_key(path)
         arr = leaf if isinstance(leaf, jax.Array) else jax.numpy.asarray(leaf)
         shards: List[dict] = []
-        seen = set()
-        for shard in arr.addressable_shards:
-            ikey = _index_key(shard.index)
-            if ikey in seen:
-                continue  # replica of a shard this process already wrote
-            seen.add(ikey)
-            data = np.asarray(shard.data)
+        for ikey, data in _iter_shards(jax, arr):
             fname = f"{key}.{ikey}.bin"
             raw = data.tobytes()
             with open(os.path.join(tmp, fname), "wb") as f:

@@ -1,0 +1,497 @@
+"""Restart-free gang resharding: live training-state migration.
+
+The last restart-shaped recovery path in the system was the training
+tier's: every Preemptor eviction and autoscaler resize rode
+SIGTERM -> sentinel checkpoint flush (exit 143) -> relaunch ->
+``restore_sharded``, paying a disk round-trip and a process restart per
+resize (``bench_r14/autoscale.jsonl`` receipts the downtime). PR 15
+proved the better shape for *serving* — DECSTATE frames move in-flight
+decode streams token-exactly. This module is the training-side twin:
+
+* **GANGSTATE frame** — a versioned wire frame generalizing
+  DECSTATE/WTSHARD1. The header carries the frozen gang's step, the
+  data-iterator cursor, the mesh shape, a per-leaf sharding spec, and
+  the RNG key; the body is the checkpoint-schema manifest of the frozen
+  state (per-shard blake2s digests). Header and body each carry their
+  own blake2s digest; :func:`unpack_gangstate` verifies the WHOLE
+  ladder — magic, truncation, header digest, version, body digest,
+  semantic coherence — before the destination reserves anything.
+* **Shard plane** — the frozen shards themselves move as ordinary
+  WTSHARD1 frames over the existing P2P weight channel:
+  ``models/weights.py`` :class:`WeightServer` (extended to serve LIVE
+  state via ``publish_live``, not just committed step directories) and
+  :class:`PeerFetcher` (which already double-verifies every frame
+  against the manifest the exporting process wrote).
+* **:class:`ReshardManager`** — freeze -> plan -> transfer -> install:
+
+  - ``freeze(step, tree)`` exports the live tree to host memory at a
+    step boundary (:func:`checkpoint.export_tree`, a pure read) and
+    publishes it on the weight server;
+  - :func:`transfer_plan` computes the old-mesh -> new-mesh movement:
+    which frozen shard files the target sharding needs, and which of
+    those this worker already holds bitwise (digest-matched) — only
+    the missing bytes cross the wire;
+  - ``install`` is TRANSACTIONAL: reserve (a brand-new tree is staged
+    via ``restore_sharded``; the running state is never aliased) ->
+    digest-verify (frame digest + manifest digest per shard) ->
+    ``device_put`` per the target sharding -> the caller swaps the
+    returned tree in. Any failure raises :class:`ReshardError` with the
+    old state untouched — unwind is "drop the staging", nothing else.
+
+Degrade-not-crash: every entry point raises :class:`ReshardError` (or
+returns a falsy receipt) instead of wedging; callers fall back to the
+sentinel flush -> relaunch -> ``restore_sharded`` path that already
+works. Invariant 20 (chaos tier) holds the whole protocol to
+*bitwise* loss-trajectory equivalence with an uninterrupted run.
+
+Locking discipline (T-rules): ``ReshardManager._lock`` guards only the
+frozen-state reference and the receipt list. Shard export, wire
+transfer, digest verification, and device placement all run OUTSIDE the
+lock (T4: no transfer I/O under a lock).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+from . import checkpoint as ckpt
+
+_MAGIC = b"GANGSTA1"
+_WIRE_VERSION = 1
+
+
+class ReshardError(RuntimeError):
+    """A reshard leg that must not be trusted or continued in place —
+    the caller's contract is degrade-not-crash: fall back to the
+    sentinel checkpoint-flush path and count it."""
+
+
+class GangStateError(ReshardError):
+    """A GANGSTATE frame failed verification BEFORE anything was
+    reserved: bad magic, truncation, header/body digest mismatch, wrong
+    version, or a header that does not describe its body."""
+
+
+# -- live state export -------------------------------------------------------
+
+class LiveState:
+    """One gang member's frozen training state at a step boundary.
+
+    ``manifest``/``blobs`` are the checkpoint schema in host memory
+    (:func:`checkpoint.export_tree`), so the committed-checkpoint
+    machinery — ``restore_sharded``, ``_assemble`` cross-sharding
+    pastes, WTSHARD1 serving — works on live state unchanged. The loop
+    state the frame header carries (``cursor``, ``rng_key``,
+    ``mesh_shape``, per-leaf ``shardings``) rides alongside."""
+
+    def __init__(self, step: int, manifest: dict, blobs: Dict[str, bytes],
+                 *, cursor: int = 0, rng_key: str = "",
+                 mesh_shape: Optional[Dict[str, int]] = None,
+                 shardings: Optional[Dict[str, str]] = None):
+        self.step = int(step)
+        self.manifest = manifest
+        self.blobs = blobs
+        self.cursor = int(cursor)
+        self.rng_key = rng_key
+        self.mesh_shape = dict(mesh_shape or {})
+        self.shardings = dict(shardings or {})
+
+    @classmethod
+    def capture(cls, step: int, tree: Any, *, cursor: int = 0,
+                rng_key: str = "", pid: int = 0) -> "LiveState":
+        """Export a LIVE pytree to host memory — a pure read; the
+        running arrays are untouched."""
+        import jax
+
+        leaves, blobs = ckpt.export_tree(tree)
+        manifest = {"step": int(step), "process": int(pid),
+                    "num_processes": jax.process_count(), "leaves": leaves}
+        mesh_shape: Dict[str, int] = {}
+        shardings: Dict[str, str] = {}
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        for path, leaf in flat:
+            if not isinstance(leaf, jax.Array):
+                continue
+            sharding = leaf.sharding
+            shardings[ckpt._leaf_key(path)] = str(
+                getattr(sharding, "spec", sharding))
+            mesh = getattr(sharding, "mesh", None)
+            if mesh is not None and not mesh_shape:
+                mesh_shape = {str(k): int(v)
+                              for k, v in dict(mesh.shape).items()}
+        return cls(step, manifest, blobs, cursor=cursor, rng_key=rng_key,
+                   mesh_shape=mesh_shape, shardings=shardings)
+
+    def bytes_total(self) -> int:
+        return sum(len(b) for b in self.blobs.values())
+
+
+# -- GANGSTATE frame ---------------------------------------------------------
+
+def pack_gangstate(state: LiveState) -> bytes:
+    """Frame the frozen gang state for the wire::
+
+        MAGIC | <I header_len | blake2s(header, 8) | header JSON | body
+
+    The body is the manifest JSON; the header carries step, cursor,
+    mesh shape, per-leaf sharding spec, RNG key, and the body's blake2s
+    digest, so a destination can verify everything before reserving."""
+    body = json.dumps(state.manifest, sort_keys=True).encode()
+    header = {"version": _WIRE_VERSION, "step": state.step,
+              "cursor": state.cursor, "mesh_shape": state.mesh_shape,
+              "shardings": state.shardings, "rng_key": state.rng_key,
+              "body_digest": hashlib.blake2s(body).hexdigest(),
+              "body_bytes": len(body)}
+    hdr = json.dumps(header, sort_keys=True).encode()
+    return (_MAGIC + struct.pack("<I", len(hdr))
+            + hashlib.blake2s(hdr, digest_size=8).digest() + hdr + body)
+
+
+def unpack_gangstate(data: bytes) -> Tuple[dict, dict]:
+    """Parse + VERIFY one GANGSTATE frame; returns ``(header,
+    manifest)``. Raises :class:`GangStateError` on the full ladder —
+    magic, truncation, header digest, JSON, version, body digest,
+    semantic coherence — so a mangled or stale frame dies before the
+    destination reserves a single byte."""
+    if not data.startswith(_MAGIC):
+        raise GangStateError("bad magic: not a GANGSTATE frame")
+    off = len(_MAGIC)
+    if len(data) < off + 4 + 8:
+        raise GangStateError("truncated frame: no header length/digest")
+    (hlen,) = struct.unpack_from("<I", data, off)
+    off += 4
+    hdigest = data[off:off + 8]
+    off += 8
+    if len(data) < off + hlen:
+        raise GangStateError("truncated frame: header cut short")
+    hdr = data[off:off + hlen]
+    if hashlib.blake2s(hdr, digest_size=8).digest() != hdigest:
+        raise GangStateError("header digest mismatch: corrupt frame")
+    try:
+        header = json.loads(hdr)
+    except ValueError as e:
+        raise GangStateError(f"bad header: {e}") from None
+    if header.get("version") != _WIRE_VERSION:
+        raise GangStateError(
+            f"wire version {header.get('version')} != {_WIRE_VERSION}")
+    off += hlen
+    body = data[off:]
+    if len(body) != header.get("body_bytes"):
+        raise GangStateError(
+            f"truncated body: {len(body)} bytes, header says "
+            f"{header.get('body_bytes')}")
+    if hashlib.blake2s(body).hexdigest() != header.get("body_digest"):
+        raise GangStateError("body digest mismatch: corrupt manifest")
+    try:
+        manifest = json.loads(body)
+    except ValueError as e:
+        raise GangStateError(f"bad manifest body: {e}") from None
+    step = header.get("step")
+    if not isinstance(step, int) or step < 0:
+        raise GangStateError(f"nonsense step {step!r}")
+    if manifest.get("step") != step:
+        raise GangStateError(
+            f"header step {step} != manifest step {manifest.get('step')} "
+            "— frame does not describe its body")
+    if not isinstance(header.get("cursor"), int) \
+            or header["cursor"] < 0:
+        raise GangStateError(f"nonsense cursor {header.get('cursor')!r}")
+    if not isinstance(manifest.get("leaves"), dict):
+        raise GangStateError("manifest has no leaves")
+    return header, manifest
+
+
+# -- transfer planning -------------------------------------------------------
+
+def transfer_plan(manifest: dict, template: Any,
+                  local: Optional[Mapping[str, bytes]] = None) -> dict:
+    """Old-mesh -> new-mesh shard movement plan.
+
+    Walks the TARGET template's addressable shards against the frozen
+    manifest: an exact (index, shape) match needs just that file; a leaf
+    the new mesh shards differently needs every saved file of the leaf
+    (the ``_assemble`` paste path). Files whose bytes this worker
+    already holds bitwise (``local``, digest-checked) stay put — only
+    ``fetch`` crosses the weight channel."""
+    import jax
+
+    local = local or {}
+    needed: Dict[str, dict] = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(template)
+    for path, leaf in flat:
+        key = ckpt._leaf_key(path)
+        entry = manifest.get("leaves", {}).get(key)
+        if entry is None:
+            raise ReshardError(f"frozen state has no leaf {key!r} — "
+                               "model/config mismatch, not reshardable")
+        if not isinstance(leaf, jax.Array):
+            for meta in entry["shards"][:1]:
+                needed[meta["file"]] = meta
+            continue
+        by_index = {s["index"]: s for s in entry["shards"]}
+        exact: List[dict] = []
+        for shard in leaf.addressable_shards:
+            ikey = ckpt._index_key(shard.index)
+            shard_shape = [
+                len(range(*s.indices(dim)))
+                for s, dim in zip(shard.index, leaf.shape)
+            ] if shard.index else []
+            meta = by_index.get(ikey)
+            if meta is None or meta["local_shape"] != shard_shape:
+                exact = []
+                break
+            exact.append(meta)
+        for meta in (exact if exact else entry["shards"]):
+            needed[meta["file"]] = meta
+    have: List[str] = []
+    fetch: List[str] = []
+    for fname in sorted(needed):
+        meta = needed[fname]
+        raw = local.get(fname)
+        if raw is not None and len(raw) == meta.get("bytes") \
+                and hashlib.blake2s(raw).hexdigest() == meta.get("digest"):
+            have.append(fname)
+        else:
+            fetch.append(fname)
+    return {"files": needed, "local": have, "fetch": fetch,
+            "bytes_total": sum(m.get("bytes", 0) for m in needed.values()),
+            "bytes_fetch": sum(needed[f].get("bytes", 0) for f in fetch)}
+
+
+def _mesh_of(template) -> Dict[str, int]:
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(template):
+        if isinstance(leaf, jax.Array):
+            mesh = getattr(leaf.sharding, "mesh", None)
+            if mesh is not None:
+                return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    return {}
+
+
+# -- the manager -------------------------------------------------------------
+
+class ReshardManager:
+    """Freeze -> plan -> transfer -> transactionally install -> resume.
+
+    One instance per worker serves BOTH legs: the source side
+    (``freeze``/``release`` — publish frozen live state on the weight
+    server) and the destination side (``adopt`` — pull a GANGSTATE
+    frame, verify it, move only the missing shards, stage a new tree,
+    hand it back for the caller to swap). Every receipt lands in
+    ``receipts`` and on ``emit`` for the worker event stream."""
+
+    def __init__(self, *, timeout_s: float = 60.0,
+                 workers: Optional[int] = None,
+                 emit: Optional[Callable[[dict], None]] = None,
+                 metrics=None):
+        self.timeout_s = float(timeout_s)
+        self.workers = workers
+        self.metrics = metrics
+        self._emit = emit or (lambda record: None)
+        self._lock = threading.Lock()
+        self._frozen: Optional[LiveState] = None
+        self.receipts: List[dict] = []
+
+    def _receipt(self, rec: dict) -> dict:
+        with self._lock:
+            self.receipts.append(rec)
+        self._emit(rec)
+        if self.metrics is not None:
+            self.metrics.counter("reshard." + rec["event"])
+        return rec
+
+    # -- source side -------------------------------------------------------
+
+    def freeze(self, step: int, tree: Any, *, cursor: int = 0,
+               rng_key: str = "", server=None) -> LiveState:
+        """At a step boundary: export the live tree (pure read — the
+        running state is untouched), frame it, and publish it on the
+        weight server so peers pull it with zero checkpoint I/O. The
+        export runs outside the lock; only the reference swap is
+        guarded."""
+        t0 = time.monotonic()
+        state = LiveState.capture(step, tree, cursor=cursor,
+                                  rng_key=rng_key)
+        frame = pack_gangstate(state)
+        with self._lock:
+            self._frozen = state
+        if server is not None:
+            server.publish_live(state.step, state.manifest, state.blobs,
+                                frame=frame)
+        self._receipt({"event": "reshard_freeze", "step": state.step,
+                       "bytes": state.bytes_total(),
+                       "mesh": state.mesh_shape,
+                       "seconds": round(time.monotonic() - t0, 6)})
+        return state
+
+    @property
+    def frozen(self) -> Optional[LiveState]:
+        with self._lock:
+            return self._frozen
+
+    def release(self, server=None) -> None:
+        """Training resumed (or the fallback path won): drop the frozen
+        snapshot and stop serving it."""
+        with self._lock:
+            self._frozen = None
+        if server is not None:
+            server.clear_live()
+
+    # -- destination side --------------------------------------------------
+
+    def install(self, template: Any, header: dict, manifest: dict,
+                reader: Callable[[str], bytes], *,
+                local: Optional[Mapping[str, bytes]] = None) -> Any:
+        """Transactional adopt of a VERIFIED frame's state onto the
+        template's mesh: reserve (stage a brand-new tree) ->
+        digest-verify every shard -> ``device_put`` per the target
+        sharding -> return the staged tree for the caller to swap in.
+
+        The old state is never touched; any failure raises
+        :class:`ReshardError` and the unwind is simply dropping the
+        staging. ``local`` short-circuits shard files this worker
+        already holds bitwise (digest-checked in :func:`transfer_plan`);
+        only the rest go through ``reader`` (the weight channel)."""
+        plan = transfer_plan(manifest, template, local)
+        local_ok = set(plan["local"])
+        local = local or {}
+
+        # move the missing shards over the channel CONCURRENTLY
+        # (RESHARD_WORKERS wide) before the install walks the leaves:
+        # a mesh change sends every leaf down the cross-sharding
+        # assemble path, which reads synchronously — without this the
+        # whole transfer serializes on per-shard round-trips. Any fetch
+        # failure surfaces here, before a single byte is staged.
+        cache: Dict[str, bytes] = {}
+        width = max(1, self.workers if self.workers is not None
+                    else min(8, max(1, len(plan["fetch"]))))
+        if len(plan["fetch"]) > 1 and width > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=width) as pool:
+                futures = {f: pool.submit(reader, f)
+                           for f in plan["fetch"]}
+            for fname, fut in futures.items():
+                try:
+                    cache[fname] = fut.result()
+                except Exception as e:
+                    raise ReshardError(
+                        f"shard transfer failed for {fname!r}: {e}"
+                    ) from e
+
+        def read(fname: str) -> bytes:
+            if fname == "manifest.json":
+                return json.dumps(manifest).encode()
+            if fname in local_ok:
+                return local[fname]
+            blob = cache.get(fname)
+            if blob is not None:
+                return blob
+            return reader(fname)
+
+        try:
+            tree = ckpt.restore_sharded(None, template,
+                                        workers=self.workers,
+                                        reader=read, manifest=manifest)
+        except ReshardError:
+            raise
+        except Exception as e:
+            raise ReshardError(
+                f"install failed at step {header.get('step')}: {e}"
+            ) from e
+        return tree
+
+    def adopt(self, template: Any, *, frame: Optional[bytes] = None,
+              fetcher=None,
+              local: Optional[Mapping[str, bytes]] = None
+              ) -> Tuple[Any, dict, dict]:
+        """Full destination leg: obtain the GANGSTATE frame (in-process
+        bytes or over ``fetcher``, a ``models/weights.py``
+        :class:`PeerFetcher`), verify the whole ladder, move only the
+        missing shards, and transactionally install. Returns
+        ``(tree, header, receipt)``; raises :class:`ReshardError` with
+        the old state untouched — the caller falls back to the sentinel
+        flush/checkpoint-restart path."""
+        t0 = time.monotonic()
+        try:
+            if frame is None:
+                if fetcher is None:
+                    raise ReshardError("adopt needs a frame or a fetcher")
+                frame = fetcher.gangstate()
+            header, manifest = unpack_gangstate(frame)
+            plan = transfer_plan(manifest, template, local)
+            reader = self._fetch_reader(fetcher, header["step"], manifest)
+            tree = self.install(template, header, manifest, reader,
+                                local=local)
+        except ReshardError as e:
+            self._receipt({"event": "reshard_failed", "error": str(e),
+                           "fallback": "sentinel-flush",
+                           "seconds": round(time.monotonic() - t0, 6)})
+            raise
+        except Exception as e:
+            self._receipt({"event": "reshard_failed", "error": str(e),
+                           "fallback": "sentinel-flush",
+                           "seconds": round(time.monotonic() - t0, 6)})
+            raise ReshardError(f"adopt failed: {e}") from e
+        receipt = self._receipt({
+            "event": "reshard", "ok": True, "step": header["step"],
+            "cursor": header.get("cursor", 0),
+            "from_mesh": header.get("mesh_shape", {}),
+            "to_mesh": _mesh_of(template),
+            "files_total": len(plan["files"]),
+            "files_local": len(plan["local"]),
+            "files_fetched": len(plan["fetch"]),
+            "bytes_fetched": plan["bytes_fetch"],
+            "seconds": round(time.monotonic() - t0, 6)})
+        return tree, header, receipt
+
+    def _fetch_reader(self, fetcher, step: int,
+                      manifest: dict) -> Callable[[str], bytes]:
+        """Byte source over the weight channel for shards the plan says
+        are missing. In-process adopts (fetcher=None) must find every
+        file in ``local`` — a miss is a verification failure, not a
+        crash."""
+        if fetcher is None:
+            def read(fname: str) -> bytes:
+                raise ReshardError(
+                    f"shard {fname!r} missing locally and no peer "
+                    "fetcher configured")
+            return read
+        # pin the fetcher to the frame's step + manifest so every shard
+        # it serves is digest-checked against the EXPORTING process's
+        # manifest, not whatever a peer answers for
+        fetcher.step = step
+        fetcher._manifest = manifest
+        fetcher._by_file = {s["file"]: s
+                            for e in manifest["leaves"].values()
+                            for s in e["shards"]}
+        return fetcher.reader
+
+
+def manager_from_env(emit: Optional[Callable[[dict], None]] = None,
+                     metrics=None,
+                     env=os.environ) -> Optional[ReshardManager]:
+    """Worker-side construction from the task environment
+    (``RESHARD_ENABLE`` / ``RESHARD_TIMEOUT_S`` / ``RESHARD_WORKERS``).
+    Returns None when disabled (the default) — the checkpoint-flush ->
+    relaunch -> restore path stays exactly as it was."""
+    if str(env.get("RESHARD_ENABLE", "0")).strip().lower() \
+            in ("", "0", "false", "no"):
+        return None
+    try:
+        timeout_s = float(env.get("RESHARD_TIMEOUT_S", "60") or 60.0)
+        workers_raw = env.get("RESHARD_WORKERS", "") or ""
+        workers = int(workers_raw) if workers_raw.strip() else None
+    except ValueError as e:
+        # a bad knob must degrade to the restart path, not crash the gang
+        if emit is not None:
+            emit({"event": "reshard_config_invalid", "error": str(e)})
+        return None
+    return ReshardManager(timeout_s=timeout_s, workers=workers,
+                          emit=emit, metrics=metrics)

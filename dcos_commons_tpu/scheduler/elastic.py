@@ -182,6 +182,79 @@ class MigrationConfig:
         )
 
 
+@dataclass(frozen=True)
+class ReshardConfig:
+    """Knobs for restart-free gang resharding during train-tier scale
+    events (``parallel/reshard.py``). ``from_env`` reads the
+    ``RESHARD_*`` environment contract documented in
+    ``docs/yaml-reference.md``: when enabled, the autoscaler's resize
+    path and the preemptor's grace window freeze the training gang at a
+    step boundary and move live state to the surviving mesh over the
+    P2P weight channel instead of riding checkpoint-flush -> relaunch.
+    Disabled by default — the worker keeps the restart path untouched
+    unless the operator opts in."""
+
+    enable: bool = False
+    timeout_s: float = 60.0       # freeze -> install -> resume budget
+    workers: int = 4              # concurrent shard transfers per adopt
+    port: int = 0                 # live-state WeightServer port (0 = any)
+    peers: str = ""               # comma-separated peer weight endpoints
+
+    def __post_init__(self):
+        if self.timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {self.timeout_s}")
+        if self.workers < 1:
+            raise ValueError(f"workers must be >= 1, got {self.workers}")
+        if self.port < 0:
+            raise ValueError(f"port must be >= 0, got {self.port}")
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "ReshardConfig":
+        e = os.environ if env is None else env
+
+        def _f(key, default):
+            raw = e.get(key)
+            return default if raw in (None, "") else float(raw)
+
+        raw = (e.get("RESHARD_ENABLE") or "0").strip().lower()
+        return cls(
+            enable=raw not in ("", "0", "false", "no", "off"),
+            timeout_s=_f("RESHARD_TIMEOUT_S", 60.0),
+            workers=int(_f("RESHARD_WORKERS", 4)),
+            port=int(_f("RESHARD_PORT", 0)),
+            peers=(e.get("RESHARD_PEERS") or "").strip(),
+        )
+
+
+def reshard_drain_hook(freeze_fn: Callable[..., object],
+                       emit: Optional[Callable[[dict], None]] = None
+                       ) -> Callable[..., dict]:
+    """Adapt a gang-freeze callable to the ``drain_hook`` seam both
+    :class:`Autoscaler` (``drain_hook(current, proposed)``) and
+    :class:`Preemptor` (``drain_hook(victim, instances)``) already call
+    before actuating. The hook NEVER raises: a failed freeze becomes a
+    ``{"reshard": False, "fallback": "sentinel-flush"}`` receipt and the
+    scale event proceeds down the existing SIGTERM/flush path — the
+    reshard is an optimization of the drain, never a veto on it."""
+    import time as _time
+
+    def hook(a, b) -> dict:
+        t0 = _time.monotonic()
+        try:
+            detail = freeze_fn(a, b)
+        except Exception as e:  # noqa: BLE001 — degrade, never wedge
+            rec = {"reshard": False, "fallback": "sentinel-flush",
+                   "error": str(e)}
+        else:
+            rec = {"reshard": True, "detail": detail}
+        rec["seconds"] = round(_time.monotonic() - t0, 6)
+        if emit is not None:
+            emit({"event": "reshard_drain", **rec})
+        return rec
+
+    return hook
+
+
 class HysteresisController:
     """Debounced two-threshold controller: pressure must sit above
     ``high_pressure`` (or below ``low_pressure``) for ``debounce_ticks``

@@ -168,6 +168,26 @@ DEFAULT_ENV: Mapping[str, str] = {
     # microbatching); overridable per-pod via TASKCFG_* like any env knob
     "FUSED_CE": "true",
     "GRAD_ACCUM": "1",
+    # restart-free gang resharding (parallel/reshard.py + the
+    # scheduler/elastic.py ReshardConfig contract): RESHARD_ENABLE=1
+    # arms the train tier's live-migration path — on resize/preemption
+    # the gang freezes at a step boundary, publishes its state over the
+    # P2P weight channel (GANGSTATE frame + WTSHARD1 shards), and the
+    # surviving mesh adopts it transactionally; any leg that fails
+    # degrades to the sentinel checkpoint-flush -> relaunch path.
+    # RESHARD_PEERS points an adopting worker at frozen peers'
+    # /v1/weights endpoints; RESHARD_PORT serves this worker's own live
+    # state (0 = ephemeral); RESHARD_TIMEOUT_S bounds one
+    # freeze->install leg; RESHARD_WORKERS is the concurrent shard
+    # transfer width; RESHARD_LINGER_S keeps a preempted worker's
+    # live-state server up inside the grace window so peers finish
+    # pulling before exit.
+    "RESHARD_ENABLE": "0",
+    "RESHARD_PEERS": "",
+    "RESHARD_PORT": "0",
+    "RESHARD_TIMEOUT_S": "60",
+    "RESHARD_WORKERS": "4",
+    "RESHARD_LINGER_S": "0",
     # fetched into every task sandbox pre-launch (reference: resource.json
     # assets fetched by Mesos; in production the universe template overrides
     # this with the artifact URL). Default: the locally-built binary.

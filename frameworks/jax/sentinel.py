@@ -40,6 +40,7 @@ import math
 import os
 import signal
 import threading
+import time
 from typing import Callable, Optional
 
 STALL_EXIT_CODE = 74  # EX_IOERR: distinguishable from crash (1) and OOM kills
@@ -143,8 +144,14 @@ def guarded_loop(sentinel: FaultSentinel, start: int, steps: int,
     i = start
     while i < steps:
         if sentinel.preempted:
+            # stamp the SIGTERM flush receipt with the flushed step and
+            # the wall-clock flush cost: the restart-free reshard A/B
+            # (bench_r19/reshard.jsonl) needs a per-phase
+            # checkpoint-restart baseline, not just aggregate tick counts
+            t0 = time.monotonic()
             save(i)
-            emit({"event": "preempted", "step": i})
+            emit({"event": "preempted", "step": i, "flushed_step": i,
+                  "flush_s": round(time.monotonic() - t0, 6)})
             return "preempted", i
         with sentinel.watch(i):
             result = run_step(i)
@@ -159,12 +166,14 @@ def guarded_loop(sentinel: FaultSentinel, start: int, steps: int,
                         f"loss non-finite at step {i} after "
                         f"{sentinel.max_rollbacks} rollbacks — giving up so "
                         "the scheduler sees the crash-loop")
+                t0 = time.monotonic()
                 restored = restore()
                 if restored is None:
                     raise RuntimeError(
                         f"loss non-finite at step {i} and no checkpoint to "
                         "roll back to")
-                emit({"event": "rolled_back", "to_step": restored})
+                emit({"event": "rolled_back", "to_step": restored,
+                      "restore_s": round(time.monotonic() - t0, 6)})
                 i = restored
                 continue
         i += 1

@@ -1058,19 +1058,58 @@ def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
         float(out["loss"])
         start = 0
         resumed = False
-        if args.out and (resume_step := ckpt.latest_step(args.out)) \
-                is not None:
+        # restart-free reshard (parallel/reshard.py, RESHARD_* knobs):
+        # when enabled, a resized/relaunched worker first tries to ADOPT
+        # the live state a frozen peer published over the weight channel —
+        # no checkpoint round-trip; any failure degrades to the disk
+        # restore below, which stays exactly as it was
+        from dcos_commons_tpu.parallel import reshard as reshard_mod
+        rs_mgr = reshard_mod.manager_from_env(emit=_emit)
+        rs_srv = None
+        if rs_mgr is not None:
+            from dcos_commons_tpu.models import weights as weights_mod
+            rs_peers = os.environ.get("RESHARD_PEERS", "").strip()
+            if rs_peers:
+                try:
+                    t_r = time.perf_counter()
+                    fetcher = weights_mod.PeerFetcher(
+                        rs_peers, timeout_s=rs_mgr.timeout_s)
+                    tree, hdr, _ = rs_mgr.adopt(
+                        {"params": w_params, "opt_state": w_opt},
+                        fetcher=fetcher)
+                    params, opt_state = tree["params"], tree["opt_state"]
+                    start = hdr["step"]
+                    resumed = True
+                    _emit({"event": "resharded", "step": start,
+                           "cursor": hdr.get("cursor", 0),
+                           "restore_s": round(
+                               time.perf_counter() - t_r, 6)})
+                except Exception as e:  # degrade-not-crash
+                    _emit({"event": "reshard_fallback", "error": str(e)})
+            if args.out:
+                try:
+                    rs_srv = weights_mod.WeightServer(
+                        args.out,
+                        port=int(os.environ.get("RESHARD_PORT", "0") or 0),
+                        host="127.0.0.1").start()
+                    _emit({"event": "reshard_serving", "port": rs_srv.port})
+                except Exception as e:  # serving is optional, not load-bearing
+                    _emit({"event": "reshard_serve_failed", "error": str(e)})
+        if not resumed and args.out \
+                and (resume_step := ckpt.latest_step(args.out)) is not None:
             # template = the warmup OUTPUTS: the step donates its inputs
             # (the originals are deleted buffers by now), and the outputs
             # carry exactly the shardings later steps will use
+            t_r = time.perf_counter()
             tree = ckpt.restore_sharded(
                 args.out, {"params": w_params, "opt_state": w_opt},
                 resume_step)
             params, opt_state = tree["params"], tree["opt_state"]
             start = resume_step
             resumed = True
-            _emit({"event": "resumed", "step": start, "sharded": True})
-        else:
+            _emit({"event": "resumed", "step": start, "sharded": True,
+                   "restore_s": round(time.perf_counter() - t_r, 6)})
+        if not resumed:
             params, opt_state = w_params, w_opt
 
         # fault sentinel: preemption flush, NaN rollback, stall watchdog
@@ -1094,6 +1133,17 @@ def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
             return out
 
         def save(i):
+            if rs_mgr is not None and rs_srv is not None:
+                # freeze + publish LIVE state first: surviving peers can
+                # adopt over the weight channel with zero checkpoint I/O;
+                # the flush below stays the fallback either way
+                try:
+                    rs_mgr.freeze(i, {"params": params,
+                                      "opt_state": opt_state},
+                                  server=rs_srv)
+                except Exception as e:  # degrade-not-crash
+                    _emit({"event": "reshard_freeze_failed", "step": i,
+                           "error": str(e)})
             if args.out:
                 ckpt.save_sharded(args.out, i,
                                   {"params": params, "opt_state": opt_state})
@@ -1118,6 +1168,16 @@ def _llama_train_loop(args, contract, cfg, mesh, loss_fn, specs, params,
             sent, start, args.steps, run_step,
             lambda result: float(result["loss"]), save, restore, emit=_emit)
         sent.uninstall()
+        if rs_srv is not None:
+            # on preemption the frozen live state was already published;
+            # give a resharding peer its grace window to pull it before
+            # the server dies with this process (the checkpoint flush
+            # above remains the durable fallback)
+            if stopped == "preempted" and rs_mgr.frozen is not None:
+                time.sleep(min(rs_mgr.timeout_s,
+                               float(os.environ.get(
+                                   "RESHARD_LINGER_S", "0") or 0)))
+            rs_srv.stop()
         dt = time.perf_counter() - t0
         if stopped == "preempted":
             # checkpoint already flushed by guarded_loop; report honestly

@@ -44,6 +44,9 @@ python -m tools.moe_smoke --budget-s "${MOE_SMOKE_BUDGET_S:-90}"
 echo "== longctx smoke (sequence-parallel ring prefill vs single-host greedy, token-exact, time-capped) =="
 python -m tools.longctx_smoke --budget-s "${LONGCTX_SMOKE_BUDGET_S:-90}"
 
+echo "== reshard smoke (4->2->4 restart-free gang reshard, loss-bitwise, time-capped) =="
+python -m tools.reshard_smoke --budget-s "${RESHARD_SMOKE_BUDGET_S:-90}"
+
 echo "== control-plane smoke (steady-state cycle budget under churn) =="
 # observed p50 ~6.4ms at fleet 500; the pin is ~12x that so only an
 # O(fleet) regression (not CI-host noise) trips it

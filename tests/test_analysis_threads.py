@@ -515,7 +515,8 @@ class TestJ5Donation:
     def test_shipped_donation_sites_clean(self):
         assert sorted(eps.DONATION_SITES) == [
             "adopt_pages_install", "paged_decode_pool",
-            "spec_window_pool_and_draft", "train_step_state"]
+            "reshard_resume_state", "spec_window_pool_and_draft",
+            "train_step_state"]
         for name in sorted(eps.DONATION_SITES):
             site = eps.DONATION_SITES[name]
             if eps._skip_reason(site):

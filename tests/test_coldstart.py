@@ -216,6 +216,39 @@ class TestPeerFetch:
         with pytest.raises(weights.WeightFetchError, match="no weight"):
             weights.restore_from_peers([], _template(_tree()))
 
+    def test_downed_peer_reprobed_and_serves_after_heal(self, tmp_path):
+        """The re-probe half of the rotation (ISSUE 20 satellite): a
+        peer marked down on a manifest-digest mismatch stays skipped —
+        no probe traffic — inside ``health_recheck_s``, is re-probed
+        through ``/v1/healthz`` once the window elapses, and serves
+        bitwise again after healing."""
+        tree = _tree()
+        d = _serve_dir(tmp_path, "peer", tree, corrupt_all=True)
+        srv = weights.WeightServer(str(d), port=0, host="127.0.0.1").start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            fetcher = weights.PeerFetcher([url], health_recheck_s=60.0)
+            with pytest.raises(weights.WeightFetchError,
+                               match="manifest digest"):
+                weights.restore_from_peers([url], _template(tree),
+                                           fetcher=fetcher)
+            assert url in fetcher.stats()["peers_down"]
+            # inside the recheck window the peer is skipped outright
+            assert fetcher._order() == []
+            # heal the peer: recommit the step with the true bytes
+            ckpt.save_sharded(str(d), 1, tree)
+            # window still open -> still skipped, even though healed
+            assert fetcher._order() == []
+            # window elapses -> /v1/healthz re-probe clears the mark
+            fetcher.health_recheck_s = 0.0
+            assert fetcher._order() == [url]
+            got = weights.restore_from_peers([url], _template(tree),
+                                             fetcher=fetcher)
+            _assert_bitwise(got, tree)
+            assert fetcher.stats()["peers_down"] == []
+        finally:
+            srv.stop()
+
     def test_mirror_lands_committed_step(self, tmp_path):
         """mirror_from_peers commits a local step directory (dot-tmp +
         rename) the new replica can itself restore from — and serve to

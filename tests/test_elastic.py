@@ -82,6 +82,62 @@ class TestAutoscalerConfig:
             AutoscalerConfig(pod_type="p", debounce_ticks=0)
 
 
+class TestReshardConfig:
+    def test_from_env_contract(self):
+        from dcos_commons_tpu.scheduler.elastic import ReshardConfig
+        env = {"RESHARD_ENABLE": "1", "RESHARD_TIMEOUT_S": "12.5",
+               "RESHARD_WORKERS": "2", "RESHARD_PORT": "8123",
+               "RESHARD_PEERS": " http://a:1,http://b:2 "}
+        cfg = ReshardConfig.from_env(env)
+        assert cfg.enable is True
+        assert cfg.timeout_s == 12.5
+        assert (cfg.workers, cfg.port) == (2, 8123)
+        assert cfg.peers == "http://a:1,http://b:2"
+
+    def test_disabled_by_default_and_spellings(self):
+        from dcos_commons_tpu.scheduler.elastic import ReshardConfig
+        assert ReshardConfig.from_env({}).enable is False
+        for raw in ("0", "false", "no", "off", ""):
+            assert ReshardConfig.from_env(
+                {"RESHARD_ENABLE": raw}).enable is False
+
+    def test_validation(self):
+        from dcos_commons_tpu.scheduler.elastic import ReshardConfig
+        with pytest.raises(ValueError):
+            ReshardConfig(timeout_s=0)
+        with pytest.raises(ValueError):
+            ReshardConfig(workers=0)
+        with pytest.raises(ValueError):
+            ReshardConfig(port=-1)
+
+
+class TestReshardDrainHook:
+    def test_freeze_receipt_and_emit(self):
+        from dcos_commons_tpu.scheduler.elastic import reshard_drain_hook
+        events = []
+        hook = reshard_drain_hook(
+            lambda cur, prop: {"step": 7, "from": cur, "to": prop},
+            emit=events.append)
+        rec = hook(4, 2)
+        assert rec["reshard"] is True
+        assert rec["detail"] == {"step": 7, "from": 4, "to": 2}
+        assert rec["seconds"] >= 0
+        assert events and events[0]["event"] == "reshard_drain"
+
+    def test_failed_freeze_degrades_never_raises(self):
+        from dcos_commons_tpu.scheduler.elastic import reshard_drain_hook
+
+        def boom(a, b):
+            raise RuntimeError("gang not at a step boundary")
+
+        rec = reshard_drain_hook(boom)(4, 2)
+        # the reshard is an optimization of the drain, never a veto:
+        # the scale event proceeds down the SIGTERM/flush path
+        assert rec["reshard"] is False
+        assert rec["fallback"] == "sentinel-flush"
+        assert "step boundary" in rec["error"]
+
+
 class TestHysteresis:
     CFG = AutoscalerConfig(pod_type="decode", min_count=1, max_count=4,
                            high_pressure=0.75, low_pressure=0.25,

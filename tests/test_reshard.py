@@ -1,0 +1,261 @@
+"""Restart-free gang resharding (``parallel/reshard.py``, ISSUE 20).
+
+The acceptance bar is *bitwise*: a 4 -> 2 -> 4-worker reshard must
+produce exactly the loss curve of an uninterrupted run (invariant 20's
+contract), the install must be transactional (any failure leaves the
+old state untouched), and the live-state leg of the P2P weight channel
+must verify end-to-end digests the same way the committed-checkpoint
+leg already does.
+
+The toy train step is deliberately ELEMENTWISE (no cross-shard
+reductions) and the recorded loss is a fixed-order host-side sum, so
+the loss trajectory is a pure function of the state bytes — any
+reshard that is not bitwise shows up as a diverged curve.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dcos_commons_tpu.models import weights
+from dcos_commons_tpu.parallel import checkpoint as ckpt
+from dcos_commons_tpu.parallel import reshard
+
+X = np.linspace(-1.0, 1.0, 8 * 16, dtype=np.float32).reshape(8, 16)
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("dp",))
+
+
+def _sharded(mesh, value):
+    return jax.device_put(value, NamedSharding(mesh, P("dp")))
+
+
+@jax.jit
+def _step(params, x):
+    return params - jnp.float32(0.05) * (params - x)
+
+
+def _loss(params):
+    # canonical fixed-order host reduction: bitwise-comparable floats
+    return float(np.sum(np.asarray(params), dtype=np.float64))
+
+
+def _run(params, x, steps, losses):
+    for _ in range(steps):
+        params = _step(params, x)
+        losses.append(_loss(params))
+    return params
+
+
+# -- GANGSTATE frame -------------------------------------------------------
+
+def test_gangstate_roundtrip():
+    mesh = _mesh(4)
+    tree = {"params": _sharded(mesh, X)}
+    state = reshard.LiveState.capture(7, tree, cursor=42,
+                                      rng_key="ab" * 16)
+    frame = reshard.pack_gangstate(state)
+    header, manifest = reshard.unpack_gangstate(frame)
+    assert header["step"] == 7
+    assert header["cursor"] == 42
+    assert header["rng_key"] == "ab" * 16
+    assert header["mesh_shape"] == {"dp": 4}
+    assert "params" in header["shardings"]
+    assert manifest == state.manifest
+    # the blobs verify against the manifest digests end-to-end
+    for entry in manifest["leaves"].values():
+        for meta in entry["shards"]:
+            ckpt._verify_shard(meta, state.blobs[meta["file"]], "live")
+
+
+def test_gangstate_verification_ladder():
+    mesh = _mesh(2)
+    state = reshard.LiveState.capture(3, {"p": _sharded(mesh, X)})
+    frame = reshard.pack_gangstate(state)
+
+    with pytest.raises(reshard.GangStateError, match="magic"):
+        reshard.unpack_gangstate(b"NOTAGANG" + frame[8:])
+    with pytest.raises(reshard.GangStateError, match="truncated"):
+        reshard.unpack_gangstate(frame[:10])
+    # flip one header byte: the 8-byte header digest catches it
+    hdr_off = len(b"GANGSTA1") + 4 + 8
+    mangled = bytearray(frame)
+    mangled[hdr_off + 3] ^= 0x01
+    with pytest.raises(reshard.GangStateError,
+                       match="header digest|bad header|version|step"):
+        reshard.unpack_gangstate(bytes(mangled))
+    # flip one body byte: the body digest catches it
+    mangled = bytearray(frame)
+    mangled[-1] ^= 0x01
+    with pytest.raises(reshard.GangStateError, match="body digest|bad"):
+        reshard.unpack_gangstate(bytes(mangled))
+    # truncated body
+    with pytest.raises(reshard.GangStateError, match="truncated body"):
+        reshard.unpack_gangstate(frame[:-5])
+    # a header that does not describe its body (step mismatch)
+    state2 = reshard.LiveState.capture(4, {"p": _sharded(mesh, X)})
+    state2.manifest["step"] = 9
+    with pytest.raises(reshard.GangStateError, match="does not describe"):
+        reshard.unpack_gangstate(reshard.pack_gangstate(state2))
+
+
+# -- transfer planning -----------------------------------------------------
+
+def test_transfer_plan_moves_only_missing_shards():
+    mesh = _mesh(4)
+    tree = {"p": _sharded(mesh, X)}
+    state = reshard.LiveState.capture(1, tree)
+    template = {"p": _sharded(mesh, np.zeros_like(X))}
+
+    # same mesh, full local copy: nothing crosses the wire
+    plan = reshard.transfer_plan(state.manifest, template, state.blobs)
+    assert plan["fetch"] == []
+    assert len(plan["local"]) == len(plan["files"]) == 4
+    assert plan["bytes_fetch"] == 0
+
+    # drop one local shard: exactly that file is fetched
+    partial = dict(state.blobs)
+    missing = sorted(partial)[0]
+    del partial[missing]
+    plan = reshard.transfer_plan(state.manifest, template, partial)
+    assert plan["fetch"] == [missing]
+
+    # a local blob with WRONG bytes is not trusted (digest mismatch)
+    bad = dict(state.blobs)
+    bad[missing] = b"\x00" * len(bad[missing])
+    plan = reshard.transfer_plan(state.manifest, template, bad)
+    assert plan["fetch"] == [missing]
+
+    # template leaf the frozen state never had: model mismatch, refuse
+    with pytest.raises(reshard.ReshardError, match="no leaf"):
+        reshard.transfer_plan(
+            state.manifest, {"q": _sharded(mesh, X)}, state.blobs)
+
+
+# -- the acceptance bar: 4 -> 2 -> 4 bitwise -------------------------------
+
+def test_reshard_4_2_4_loss_curve_bitwise():
+    mesh4 = _mesh(4)
+    ref_losses = []
+    ref = _run(_sharded(mesh4, np.zeros_like(X)), _sharded(mesh4, X),
+               12, ref_losses)
+
+    mgr = reshard.ReshardManager()
+    losses = []
+    p = _run(_sharded(mesh4, np.zeros_like(X)), _sharded(mesh4, X),
+             4, losses)
+
+    # freeze the 4-way gang at the step boundary, adopt onto 2 workers
+    state = mgr.freeze(4, {"params": p}, cursor=4)
+    mesh2 = _mesh(2)
+    tree2, hdr, receipt = mgr.adopt(
+        {"params": _sharded(mesh2, np.zeros_like(X))},
+        frame=reshard.pack_gangstate(state), local=state.blobs)
+    assert (hdr["step"], hdr["cursor"]) == (4, 4)
+    assert receipt["ok"] and receipt["files_fetched"] == 0
+    assert receipt["from_mesh"] == {"dp": 4}
+    assert receipt["to_mesh"] == {"dp": 2}
+    p = _run(tree2["params"], _sharded(mesh2, X), 4, losses)
+
+    # and scale back out to 4
+    state2 = mgr.freeze(8, {"params": p}, cursor=8)
+    tree4, hdr2, _ = mgr.adopt(
+        {"params": _sharded(mesh4, np.zeros_like(X))},
+        frame=reshard.pack_gangstate(state2), local=state2.blobs)
+    p = _run(tree4["params"], _sharded(mesh4, X), 4, losses)
+
+    # bitwise: the resharded trajectory IS the uninterrupted one
+    assert losses == ref_losses
+    np.testing.assert_array_equal(np.asarray(p), np.asarray(ref))
+
+
+def test_adopt_is_transactional_on_corrupt_shard():
+    mesh4, mesh2 = _mesh(4), _mesh(2)
+    old = _sharded(mesh4, X)
+    old_bytes = np.asarray(old).tobytes()
+    mgr = reshard.ReshardManager()
+    state = mgr.freeze(5, {"params": old})
+
+    corrupt = dict(state.blobs)
+    victim = sorted(corrupt)[1]
+    raw = bytearray(corrupt[victim])
+    raw[0] ^= 0x40
+    corrupt[victim] = bytes(raw)
+    # the corrupt local blob fails the plan's digest check, there is no
+    # fetcher to fall back to -> ReshardError, nothing installed
+    with pytest.raises(reshard.ReshardError):
+        mgr.adopt({"params": _sharded(mesh2, np.zeros_like(X))},
+                  frame=reshard.pack_gangstate(state), local=corrupt)
+    # unwind left the old state untouched
+    assert np.asarray(old).tobytes() == old_bytes
+    # and the failure receipt names the sentinel-flush fallback
+    failed = [r for r in mgr.receipts if r["event"] == "reshard_failed"]
+    assert failed and failed[-1]["fallback"] == "sentinel-flush"
+
+
+# -- live state over the real weight channel -------------------------------
+
+def test_live_state_served_and_adopted_over_http(tmp_path):
+    mesh4, mesh2 = _mesh(4), _mesh(2)
+    p = _sharded(mesh4, X)
+    mgr = reshard.ReshardManager()
+    srv = weights.WeightServer(str(tmp_path), host="127.0.0.1").start()
+    try:
+        state = mgr.freeze(6, {"params": p}, cursor=6, server=srv)
+        assert srv.live_step() == 6
+        peer = f"http://127.0.0.1:{srv.port}"
+
+        fetcher = weights.PeerFetcher([peer], timeout_s=10.0)
+        frame = fetcher.gangstate()
+        header, _ = reshard.unpack_gangstate(frame)
+        assert header["step"] == 6
+
+        # adopt with NO local bytes: every shard crosses the live wire
+        tree, hdr, receipt = mgr.adopt(
+            {"params": _sharded(mesh2, np.zeros_like(X))},
+            fetcher=weights.PeerFetcher([peer], timeout_s=10.0))
+        assert hdr["step"] == 6
+        assert receipt["files_fetched"] == receipt["files_total"] > 0
+        assert receipt["bytes_fetched"] > 0
+        np.testing.assert_array_equal(np.asarray(tree["params"]), X)
+
+        # release: the live snapshot vanishes from every route
+        mgr.release(server=srv)
+        assert srv.live_step() is None
+        with pytest.raises(weights.WeightFetchError):
+            weights.PeerFetcher([peer], timeout_s=5.0).gangstate()
+    finally:
+        srv.stop()
+
+
+def test_adopt_from_dead_peer_degrades_to_reshard_error():
+    mesh2 = _mesh(2)
+    mgr = reshard.ReshardManager()
+    fetcher = weights.PeerFetcher(["http://127.0.0.1:9"], timeout_s=0.5,
+                                  health_recheck_s=60.0)
+    with pytest.raises(reshard.ReshardError):
+        mgr.adopt({"params": _sharded(mesh2, np.zeros_like(X))},
+                  fetcher=fetcher)
+    failed = [r for r in mgr.receipts if r["event"] == "reshard_failed"]
+    assert failed and failed[-1]["fallback"] == "sentinel-flush"
+
+
+def test_export_tree_matches_save_sharded_schema(tmp_path):
+    mesh = _mesh(4)
+    tree = {"params": _sharded(mesh, X), "count": 3}
+    leaves, blobs = ckpt.export_tree(tree)
+    ckpt.save_sharded(str(tmp_path), 2, tree)
+    on_disk = json.loads(
+        (tmp_path / "step-00000002-p0" / "manifest.json").read_text())
+    assert on_disk["leaves"] == leaves
+    for entry in leaves.values():
+        for meta in entry["shards"]:
+            assert (tmp_path / "step-00000002-p0"
+                    / meta["file"]).read_bytes() == blobs[meta["file"]]

@@ -35,11 +35,21 @@ token-exact; dropped_streams must be 0) and once without (the reclaim
 aborts them — today's count, the baseline). Receipts land in
 ``bench_r15/migration.jsonl`` with the migration pause p50/p95.
 
+**Reshard mode** (``--mode reshard``, Round 19) is the downtime A/B: a
+live 4-way training gang resizes to 2 workers mid-run, once through
+the restart road (sentinel checkpoint flush -> relaunch -> disk
+restore, today's behaviour) and once restart-free through
+``parallel/reshard.py`` (freeze -> GANGSTATE over the loopback weight
+channel -> transactional adopt). Both must rejoin the uninterrupted
+loss curve bitwise and the reshard road must be strictly faster.
+Receipts land in ``bench_r19/reshard.jsonl``.
+
 Receipts land in ``bench_r14/autoscale.jsonl`` (one line per run plus a
 summary per seed). Exit 1 if any run fails its invariants, the
 autoscaled variant fails to beat the static shed rate, token parity
-breaks, the cold-start ladder fails to collapse, or a migration run
-drops or diverges a stream.
+breaks, the cold-start ladder fails to collapse, a migration run
+drops or diverges a stream, or a reshard run diverges or fails to
+beat the restart baseline.
 """
 
 from __future__ import annotations
@@ -261,6 +271,214 @@ def run_migration(seed: int, migrate: bool) -> dict:
     }
 
 
+# -- restart-free reshard downtime A/B --------------------------------------
+
+# state sized so the A/B measures real byte movement, not fixed overheads:
+# 8 MiB of float32 params across two leaves (a scaled stand-in for the
+# train gang's sharded state; the ordering claim is size-independent)
+RESHARD_SHAPE = (512, 2048)
+RESHARD_STEPS_BEFORE = 4
+RESHARD_STEPS_AFTER = 8
+
+
+def _reshard_xs(seed: int):
+    """Deterministic problem state shared by the bench parent and the
+    relaunched baseline child — both must replay the identical bytes."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return {
+        "w": rng.standard_normal(RESHARD_SHAPE).astype(np.float32),
+        "b": rng.standard_normal(RESHARD_SHAPE).astype(np.float32),
+    }
+
+
+def _reshard_restart_child(seed: int, ckpt_dir: str) -> int:
+    """The restart road's relaunched worker (baseline leg of
+    :func:`run_reshard`): a FRESH process pays interpreter start, jax
+    import, backend init and the sharded disk restore before the gang
+    can take another step — exactly the downtime the restart-free road
+    deletes. Prints one JSON line the moment training could resume
+    (the parent's downtime endpoint) and one with the replayed losses
+    (the bitwise audit)."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dcos_commons_tpu.parallel import checkpoint as ckpt
+
+    jax.config.update("jax_platforms", "cpu")
+    xs = _reshard_xs(seed)
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("dp",))
+
+    def sharded(value):
+        return jax.device_put(value, NamedSharding(mesh2, P("dp")))
+
+    template = {k: sharded(np.zeros_like(v)) for k, v in xs.items()}
+    restored = ckpt.restore_sharded(ckpt_dir, template,
+                                    RESHARD_STEPS_BEFORE)
+    jax.block_until_ready(restored)
+    print(json.dumps({"event": "restored"}), flush=True)
+
+    @jax.jit
+    def step_fn(tree, target):
+        return jax.tree_util.tree_map(
+            lambda p, x: p - jnp.float32(0.05) * (p - x), tree, target)
+
+    target = {k: sharded(v) for k, v in xs.items()}
+    losses = []
+    tree = restored
+    for _ in range(RESHARD_STEPS_AFTER):
+        tree = step_fn(tree, target)
+        losses.append(float(sum(
+            float(np.sum(np.asarray(v), dtype=np.float64))
+            for _, v in sorted(tree.items()))))
+    print(json.dumps({"losses": losses}), flush=True)
+    return 0
+
+
+def run_reshard(seed: int) -> list:
+    """Round 19 downtime A/B: resize a live 4-way training gang down to
+    2 workers mid-run, once through the restart road (sentinel
+    checkpoint flush to disk -> worker relaunch in a fresh process ->
+    sharded restore, today's fallback) and once restart-free through
+    ``parallel/reshard.py`` (freeze at the step boundary -> GANGSTATE
+    over the loopback weight channel -> transactional adopt in the
+    surviving process). Both roads must rejoin the uninterrupted
+    reference loss curve BITWISE; the reshard road must be strictly
+    faster, every seed."""
+    import subprocess
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dcos_commons_tpu.models import weights
+    from dcos_commons_tpu.parallel import checkpoint as ckpt
+    from dcos_commons_tpu.parallel import reshard
+
+    xs = _reshard_xs(seed)
+
+    def mesh(n):
+        return Mesh(np.array(jax.devices()[:n]), ("dp",))
+
+    def sharded(m, value):
+        return jax.device_put(value, NamedSharding(m, P("dp")))
+
+    def tree_on(m, init=None):
+        return {k: sharded(m, np.zeros_like(v) if init is None else
+                           init[k]) for k, v in xs.items()}
+
+    @jax.jit
+    def step_fn(tree, target):
+        # elementwise: the trajectory is a pure function of state bytes
+        return jax.tree_util.tree_map(
+            lambda p, x: p - jnp.float32(0.05) * (p - x), tree, target)
+
+    def loss(tree):
+        return float(sum(
+            float(np.sum(np.asarray(v), dtype=np.float64))
+            for _, v in sorted(tree.items())))
+
+    def run(tree, target, steps, losses):
+        for _ in range(steps):
+            tree = step_fn(tree, target)
+            losses.append(loss(tree))
+        return tree
+
+    mesh4, mesh2 = mesh(4), mesh(2)
+    total = RESHARD_STEPS_BEFORE + RESHARD_STEPS_AFTER
+    ref_losses: list = []
+    run(tree_on(mesh4), tree_on(mesh4, xs), total, ref_losses)
+
+    rows = []
+
+    # -- baseline: flush to disk, relaunch a fresh worker, restore --
+    with tempfile.TemporaryDirectory() as td:
+        losses: list = []
+        tree = run(tree_on(mesh4), tree_on(mesh4, xs),
+                   RESHARD_STEPS_BEFORE, losses)
+        t0 = time.monotonic()
+        ckpt.save_sharded(td, RESHARD_STEPS_BEFORE, tree)   # the flush
+        flush_s = time.monotonic() - t0
+        child = subprocess.Popen(
+            [sys.executable, "-m", "tools.bench_autoscale",
+             "--reshard-restart-child", str(seed), td],
+            stdout=subprocess.PIPE, text=True)
+        try:
+            ready = json.loads(child.stdout.readline())
+            downtime_baseline = time.monotonic() - t0
+            replay = json.loads(child.stdout.readline())
+        finally:
+            child.stdout.close()
+            child.wait(timeout=120)
+        losses += replay["losses"]
+        ok_base = (ready.get("event") == "restored"
+                   and losses == ref_losses)
+        rows.append({
+            "metric": "reshard", "variant": "baseline",
+            "seed": seed, "downtime_s": round(downtime_baseline, 6),
+            "flush_s": round(flush_s, 6),
+            "restart_restore_s": round(downtime_baseline - flush_s, 6),
+            "step": RESHARD_STEPS_BEFORE, "from_workers": 4,
+            "to_workers": 2, "bitwise": losses == ref_losses,
+            "ok": ok_base,
+        })
+
+    # -- reshard: freeze, publish live, adopt over the weight channel --
+    with tempfile.TemporaryDirectory() as td:
+        mgr = reshard.ReshardManager()
+        srv = weights.WeightServer(td, host="127.0.0.1").start()
+        try:
+            losses = []
+            tree = run(tree_on(mesh4), tree_on(mesh4, xs),
+                       RESHARD_STEPS_BEFORE, losses)
+            t0 = time.monotonic()
+            mgr.freeze(RESHARD_STEPS_BEFORE, tree, server=srv)
+            adopted, hdr, receipt = mgr.adopt(
+                tree_on(mesh2),
+                fetcher=weights.PeerFetcher(
+                    [f"http://127.0.0.1:{srv.port}"], timeout_s=60.0))
+            downtime_reshard = time.monotonic() - t0
+        finally:
+            srv.stop()
+        run(adopted, tree_on(mesh2, xs), RESHARD_STEPS_AFTER, losses)
+        rows.append({
+            "metric": "reshard", "variant": "reshard",
+            "seed": seed, "downtime_s": round(downtime_reshard, 6),
+            "step": hdr["step"], "from_workers": 4, "to_workers": 2,
+            "files_fetched": receipt["files_fetched"],
+            "bytes_fetched": receipt["bytes_fetched"],
+            "bitwise": losses == ref_losses,
+            "ok": bool(receipt["ok"] and losses == ref_losses),
+        })
+
+    ok = (rows[0]["bitwise"] and rows[1]["ok"]
+          and rows[1]["downtime_s"] < rows[0]["downtime_s"])
+    rows.append({
+        "metric": "reshard_summary", "seed": seed,
+        "downtime_baseline_s": rows[0]["downtime_s"],
+        "downtime_reshard_s": rows[1]["downtime_s"],
+        "speedup": round(rows[0]["downtime_s"]
+                         / max(rows[1]["downtime_s"], 1e-9), 2),
+        "bitwise_both": rows[0]["bitwise"] and rows[1]["bitwise"],
+        "ok": ok,
+    })
+    return rows
+
+
 # -- cold-start ladder ------------------------------------------------------
 
 # scaled-down stand-in for the 8B homogeneous scale-up config: the phase
@@ -436,7 +654,7 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="bench_r14/autoscale.jsonl",
                     help="receipts file (default bench_r14/autoscale.jsonl)")
     ap.add_argument("--mode", choices=("all", "elastic", "coldstart",
-                                       "migrate"),
+                                       "migrate", "reshard"),
                     default="all",
                     help="which benches to run (default all)")
     ap.add_argument("--migrate", action="store_true",
@@ -445,15 +663,46 @@ def main(argv=None) -> int:
                          "bench_r15/migration.jsonl)")
     ap.add_argument("--coldstart-seeds", type=int, default=1,
                     help="cold-start ladders to run (default 1)")
+    ap.add_argument("--reshard-restart-child", nargs=2,
+                    metavar=("SEED", "CKPT_DIR"), help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.reshard_restart_child:
+        return _reshard_restart_child(int(args.reshard_restart_child[0]),
+                                      args.reshard_restart_child[1])
     if args.migrate:
         args.mode = "migrate"
     if args.mode == "migrate" \
             and args.out == ap.get_default("out"):
         args.out = "bench_r15/migration.jsonl"
+    if args.mode == "reshard":
+        if args.out == ap.get_default("out"):
+            args.out = "bench_r19/reshard.jsonl"
+        # the 4->2 meshes need a virtual multi-device CPU host; backend
+        # selection is lazy, so setting flags here (before the first
+        # run_reshard jax call) still wins
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     lines = []
     failed = False
+    if args.mode == "reshard":
+        for seed in range(args.seeds):
+            rows = run_reshard(seed)
+            lines += rows
+            summary = rows[-1]
+            print(f"reshard seed {seed}: "
+                  f"baseline={summary['downtime_baseline_s']:.3f}s "
+                  f"reshard={summary['downtime_reshard_s']:.3f}s "
+                  f"speedup={summary['speedup']}x "
+                  f"bitwise={summary['bitwise_both']} "
+                  f"{'OK' if summary['ok'] else 'FAIL'}")
+            if not all(r["ok"] for r in rows):
+                failed = True
     if args.mode == "migrate":
         for seed in range(args.seeds):
             with_m = run_migration(seed, migrate=True)

@@ -1,0 +1,217 @@
+"""Time-capped restart-free-resharding smoke for CI: freeze a live
+training gang at a step boundary, move its state 4 -> 2 -> 4 across
+CPU meshes over the REAL loopback weight channel (GANGSTATE frame +
+WTSHARD1 shards), and fail the build on the first loss value that is
+not bitwise-identical to the uninterrupted reference.
+
+The scripted downtime A/B with receipts lives in
+``tools/bench_autoscale.py --mode reshard``; this is the always-on
+slice test.sh runs next to the other smokes. It also exercises the
+degrade path: a peer that dies mid-transfer must abort the adopt
+transactionally (old state untouched, receipt naming the
+sentinel-flush fallback) and the gang must then recover cleanly
+through the ordinary checkpoint-restart road. Checks run in a fixed
+order and stop (skip, not fail) when the time budget runs out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+# virtual multi-device CPU mesh before jax loads (sitecustomize may have
+# registered a real backend; selection is lazy, so this still wins)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget-s", type=float, default=90.0,
+                    help="wall-clock cap; tail checks are skipped, not "
+                         "failed, when it runs out (default 90)")
+    args = ap.parse_args(argv)
+    deadline = time.monotonic() + args.budget_s
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dcos_commons_tpu.models import weights
+    from dcos_commons_tpu.parallel import checkpoint as ckpt
+    from dcos_commons_tpu.parallel import reshard
+
+    jax.config.update("jax_platforms", "cpu")
+
+    X = np.linspace(-1.0, 1.0, 8 * 32, dtype=np.float32).reshape(8, 32)
+
+    def mesh(n):
+        return Mesh(np.array(jax.devices()[:n]), ("dp",))
+
+    def sharded(m, value):
+        return jax.device_put(value, NamedSharding(m, P("dp")))
+
+    @jax.jit
+    def step_fn(params, x):
+        # elementwise on purpose: no cross-shard reductions, so the
+        # trajectory is a pure function of the state bytes and any
+        # non-bitwise reshard shows up as a diverged loss
+        return params - jnp.float32(0.05) * (params - x)
+
+    def loss(params):
+        return float(np.sum(np.asarray(params), dtype=np.float64))
+
+    def run(params, x, steps, losses):
+        for _ in range(steps):
+            params = step_fn(params, x)
+            losses.append(loss(params))
+        return params
+
+    ran = 0
+
+    def _spent(name: str) -> bool:
+        if time.monotonic() >= deadline:
+            print(f"reshard-smoke: time budget exhausted after {ran} "
+                  f"checks; {name!r} and later checks skipped")
+            return True
+        return False
+
+    mesh4, mesh2 = mesh(4), mesh(2)
+    ref_losses: list = []
+    ref = run(sharded(mesh4, np.zeros_like(X)), sharded(mesh4, X),
+              12, ref_losses)
+
+    # 1. 4 -> 2 -> 4 over the live loopback channel: every shard
+    # crosses the wire (no local bytes) and the loss curve is bitwise
+    if _spent("live-4-2-4"):
+        return 0
+    with tempfile.TemporaryDirectory() as td:
+        mgr = reshard.ReshardManager()
+        srv = weights.WeightServer(td, host="127.0.0.1").start()
+        try:
+            losses: list = []
+            p = run(sharded(mesh4, np.zeros_like(X)), sharded(mesh4, X),
+                    4, losses)
+            mgr.freeze(4, {"params": p}, cursor=4, server=srv)
+            peer = f"http://127.0.0.1:{srv.port}"
+            tree, hdr, receipt = mgr.adopt(
+                {"params": sharded(mesh2, np.zeros_like(X))},
+                fetcher=weights.PeerFetcher([peer], timeout_s=30.0))
+            if not (receipt["ok"] and hdr["step"] == 4
+                    and receipt["files_fetched"] > 0):
+                print(f"reshard-smoke FAILED: 4->2 receipt {receipt}",
+                      file=sys.stderr)
+                return 1
+            p = run(tree["params"], sharded(mesh2, X), 4, losses)
+            mgr.freeze(8, {"params": p}, cursor=8, server=srv)
+            tree, hdr, receipt = mgr.adopt(
+                {"params": sharded(mesh4, np.zeros_like(X))},
+                fetcher=weights.PeerFetcher([peer], timeout_s=30.0))
+            if not (receipt["ok"] and hdr["step"] == 8):
+                print(f"reshard-smoke FAILED: 2->4 receipt {receipt}",
+                      file=sys.stderr)
+                return 1
+            p = run(tree["params"], sharded(mesh4, X), 4, losses)
+            if losses != ref_losses:
+                bad = next(i for i, (a, b)
+                           in enumerate(zip(losses, ref_losses)) if a != b)
+                print(f"reshard-smoke FAILED: loss diverged at step "
+                      f"{bad + 1}: {losses[bad]!r} != {ref_losses[bad]!r}",
+                      file=sys.stderr)
+                return 1
+            if np.asarray(p).tobytes() != np.asarray(ref).tobytes():
+                print("reshard-smoke FAILED: final state not bitwise "
+                      "after 4->2->4", file=sys.stderr)
+                return 1
+        finally:
+            srv.stop()
+    ran += 1
+
+    # 2. peer death MID-TRANSFER: the first shard lands, then the
+    # source vanishes — the adopt must unwind transactionally and the
+    # gang recovers through the ordinary checkpoint-restart road,
+    # still bitwise
+    if _spent("mid-transfer-peer-death"):
+        return 0
+
+    class _DyingFetcher(weights.PeerFetcher):
+        """Kills its only source after the first successful shard
+        fetch — the injected mid-transfer peer death."""
+
+        def __init__(self, peers, srv, **kw):
+            super().__init__(peers, **kw)
+            self._srv = srv
+            self._shards_left = 1
+
+        def _get(self, peer, path):
+            body = super()._get(peer, path)
+            if "/v1/weights/shard" in path:
+                self._shards_left -= 1
+                if self._shards_left == 0:
+                    self._srv.stop()
+            return body
+
+    with tempfile.TemporaryDirectory() as td:
+        mgr = reshard.ReshardManager(workers=1)   # deterministic death
+        srv = weights.WeightServer(td, host="127.0.0.1").start()
+        losses = []
+        p = run(sharded(mesh4, np.zeros_like(X)), sharded(mesh4, X),
+                4, losses)
+        # the sentinel's periodic flush: the fallback road restores this
+        ckpt.save_sharded(td, 4, {"params": p})
+        p = run(p, sharded(mesh4, X), 2, losses)
+        mgr.freeze(6, {"params": p}, cursor=6, server=srv)
+        old_bytes = np.asarray(p).tobytes()
+        peer = f"http://127.0.0.1:{srv.port}"
+        died = False
+        try:
+            mgr.adopt({"params": sharded(mesh2, np.zeros_like(X))},
+                      fetcher=_DyingFetcher([peer], srv, timeout_s=5.0,
+                                            health_recheck_s=60.0))
+        except reshard.ReshardError:
+            died = True
+        if not died:
+            print("reshard-smoke FAILED: adopt survived a dead source",
+                  file=sys.stderr)
+            return 1
+        failed = [r for r in mgr.receipts if r["event"] == "reshard_failed"]
+        if not failed or failed[-1]["fallback"] != "sentinel-flush":
+            print(f"reshard-smoke FAILED: no sentinel-flush fallback "
+                  f"receipt in {mgr.receipts}", file=sys.stderr)
+            return 1
+        if np.asarray(p).tobytes() != old_bytes:
+            print("reshard-smoke FAILED: aborted adopt mutated live "
+                  "state", file=sys.stderr)
+            return 1
+        # the clean fallback: restart from the flushed checkpoint on
+        # the shrunk mesh and replay — the curve rejoins bitwise
+        restored = ckpt.restore_sharded(
+            td, {"params": sharded(mesh2, np.zeros_like(X))}, 4)
+        fb_losses = list(losses[:4])
+        p = run(restored["params"], sharded(mesh2, X), 8, fb_losses)
+        if fb_losses != ref_losses:
+            print("reshard-smoke FAILED: checkpoint-restart fallback "
+                  "diverged from the reference curve", file=sys.stderr)
+            return 1
+        if np.asarray(p).tobytes() != np.asarray(ref).tobytes():
+            print("reshard-smoke FAILED: fallback final state not "
+                  "bitwise", file=sys.stderr)
+            return 1
+    ran += 1
+
+    print(f"reshard-smoke: {ran} checks passed — 4->2->4 live reshard "
+          f"is loss-bitwise over the wire, and a mid-transfer peer "
+          f"death unwinds to a clean checkpoint-restart")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
